@@ -1,0 +1,18 @@
+"""Test configuration.
+
+JAX-touching tests run on a virtual 8-device CPU mesh (multi-chip hardware
+is not available in CI; the sharding layer is validated exactly the way the
+driver's dryrun does it).  Env vars must be set before jax is imported
+anywhere, hence this conftest does it at collection time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,142 @@
+"""Payload-fixture builders shaped like real Kubernetes API objects.
+
+The reference's tests constructed KubePod/KubeNode from inline/JSON fixture
+dicts shaped like real API payloads (SURVEY.md §5); these builders do the
+same for every test layer here.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    INSTANCE_TYPE_LABEL,
+    POOL_LABEL,
+    SLICE_ID_LABEL,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+)
+
+_uid = itertools.count(1)
+
+
+def make_pod(name="pod", namespace="default", requests=None, selectors=None,
+             phase="Pending", unschedulable=True, node_name=None,
+             labels=None, annotations=None, owner_kind=None,
+             created="2026-07-28T12:00:00Z", priority_class=None):
+    """Build a pod payload dict. Default: a pending Unschedulable pod."""
+    conditions = []
+    if phase == "Pending" and unschedulable and not node_name:
+        conditions.append({"type": "PodScheduled", "status": "False",
+                           "reason": "Unschedulable"})
+    payload = {
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "uid": f"uid-{next(_uid)}",
+            "labels": labels or {},
+            "annotations": annotations or {},
+            "creationTimestamp": created,
+        },
+        "spec": {
+            "containers": [{
+                "name": "main",
+                "resources": {"requests": requests or {}},
+            }],
+            "nodeSelector": selectors or {},
+        },
+        "status": {"phase": phase, "conditions": conditions},
+    }
+    if node_name:
+        payload["spec"]["nodeName"] = node_name
+    if owner_kind:
+        payload["metadata"]["ownerReferences"] = [
+            {"kind": owner_kind, "name": f"{name}-owner"}]
+    if priority_class:
+        payload["spec"]["priorityClassName"] = priority_class
+    return payload
+
+
+def make_tpu_pod(name="tpu-pod", chips=8, shape=None, job=None,
+                 jobset=None, job_index=None, **kw):
+    """A pod requesting TPU chips, with the GKE selector contract."""
+    selectors = dict(kw.pop("selectors", {}))
+    if shape is not None:
+        selectors.setdefault(ACCELERATOR_LABEL, shape.accelerator_type)
+        selectors.setdefault(TOPOLOGY_LABEL, shape.topology_label)
+    labels = dict(kw.pop("labels", {}))
+    if job:
+        labels["batch.kubernetes.io/job-name"] = job
+    if jobset:
+        labels["jobset.sigs.k8s.io/jobset-name"] = jobset
+        labels["jobset.sigs.k8s.io/job-index"] = str(job_index or 0)
+    requests = dict(kw.pop("requests", {}))
+    requests.setdefault(TPU_RESOURCE, str(chips))
+    owner = kw.pop("owner_kind", "Job" if (job or jobset) else None)
+    return make_pod(name=name, requests=requests, selectors=selectors,
+                    labels=labels, owner_kind=owner, **kw)
+
+
+def make_gang(shape, job="trainer", namespace="default", chips_per_pod=None,
+              jobset=None, job_index=None, **kw):
+    """Pending gang for one slice: one pod per host, chips_per_host each."""
+    chips_per_pod = chips_per_pod or shape.chips_per_host
+    return [
+        make_tpu_pod(name=f"{job}-{i}", namespace=namespace,
+                     chips=chips_per_pod, shape=shape, job=job,
+                     jobset=jobset, job_index=job_index, **kw)
+        for i in range(shape.hosts)
+    ]
+
+
+def make_node(name="node", capacity=None, labels=None, unschedulable=False,
+              ready=True, created="2026-07-28T11:00:00Z",
+              instance_type="e2-standard-8", slice_id=None, pool=None):
+    labels = dict(labels or {})
+    if instance_type:
+        labels.setdefault(INSTANCE_TYPE_LABEL, instance_type)
+    if slice_id:
+        labels[SLICE_ID_LABEL] = slice_id
+    if pool:
+        labels[POOL_LABEL] = pool
+    return {
+        "metadata": {
+            "name": name,
+            "uid": f"uid-{next(_uid)}",
+            "labels": labels,
+            "creationTimestamp": created,
+        },
+        "spec": {"unschedulable": unschedulable},
+        "status": {
+            "allocatable": capacity or {"cpu": "7910m", "memory": "27Gi",
+                                        "pods": "110"},
+            "conditions": [{"type": "Ready",
+                            "status": "True" if ready else "False"}],
+        },
+    }
+
+
+def make_tpu_node(shape, name=None, slice_id="slice-0", host_index=0,
+                  pool=None, **kw):
+    """One host of a TPU slice, labeled per the GKE contract."""
+    labels = dict(kw.pop("labels", {}))
+    labels[ACCELERATOR_LABEL] = shape.accelerator_type
+    labels[TOPOLOGY_LABEL] = shape.topology_label
+    capacity = {k: str(v) for k, v in shape.node_capacity().items()}
+    capacity["cpu"] = f"{shape.host_cpu_m}m"
+    capacity["memory"] = str(shape.host_memory)
+    capacity[TPU_RESOURCE] = str(shape.chips_per_host)
+    return make_node(
+        name=name or f"{slice_id}-host-{host_index}",
+        capacity=capacity, labels=labels,
+        instance_type=shape.machine_type, slice_id=slice_id,
+        pool=pool, **kw)
+
+
+def make_slice_nodes(shape, slice_id="slice-0", pool=None, **kw):
+    """All hosts of one slice."""
+    return [
+        make_tpu_node(shape, slice_id=slice_id, host_index=i, pool=pool, **kw)
+        for i in range(shape.hosts)
+    ]

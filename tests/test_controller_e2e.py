@@ -1,0 +1,275 @@
+"""End-to-end loop tests against the fake apiserver + fake actuator.
+
+This is the capability the reference never had (SURVEY.md §5): the full
+control loop — pending pod → plan → provision → nodes Ready → scheduler
+binds → Running — runs in-process, with simulated time, and the north-star
+latency metric is read off the controller's own metrics.
+"""
+
+import pytest
+
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.engine.planner import PoolPolicy
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.topology import shape_by_name
+
+from tests.fixtures import make_gang, make_pod, make_tpu_pod
+
+GRACE = 60.0
+IDLE = 300.0
+
+
+def make_harness(provision_delay=0.0, policy=None, **cfg_kw):
+    kube = FakeKube()
+    actuator = FakeActuator(kube, provision_delay=provision_delay)
+    config = ControllerConfig(
+        policy=policy or PoolPolicy(spare_nodes=0),
+        grace_seconds=GRACE, idle_threshold_seconds=IDLE,
+        drain_grace_seconds=30.0, **cfg_kw)
+    controller = Controller(kube, actuator, config)
+    return kube, actuator, controller
+
+
+def run_loop(kube, controller, start=0.0, until=600.0, step=1.0,
+             stop_when=None):
+    """Drive reconcile + fake scheduler over simulated time."""
+    t = start
+    while t <= until:
+        controller.reconcile_once(now=t)
+        kube.schedule_step()
+        if stop_when and stop_when():
+            # One more pass so the controller observes the final state
+            # (e.g. records the gang's scale-up latency).
+            controller.reconcile_once(now=t)
+            return t
+        t += step
+    return t
+
+
+def pod_running(kube, name, namespace="default"):
+    p = kube.get_pod(namespace, name)
+    return p is not None and p["status"]["phase"] == "Running"
+
+
+class TestConfig1CpuBaseline:
+    """BASELINE config #1: 1 pending 2-vCPU pod -> +1 agent node."""
+
+    def test_pending_cpu_pod_runs(self):
+        kube, actuator, controller = make_harness()
+        kube.add_pod(make_pod(name="web", requests={"cpu": "2"}))
+        end = run_loop(kube, controller,
+                       stop_when=lambda: pod_running(kube, "web"))
+        assert pod_running(kube, "web")
+        assert len(kube.list_nodes()) == 1
+        # Detection + actuation in a handful of reconcile passes.
+        assert end <= 5.0
+        snap = controller.metrics.snapshot()
+        assert snap["summaries"]["scale_up_latency_seconds"]["count"] == 1
+
+    def test_no_double_provision_while_in_flight(self):
+        kube, actuator, controller = make_harness(provision_delay=50.0)
+        kube.add_pod(make_pod(name="web", requests={"cpu": "2"}))
+        run_loop(kube, controller, until=40.0)
+        # Many passes while the node boots: still exactly one provision.
+        assert len(actuator.statuses()) == 1
+
+
+class TestConfig2SingleHostV5e8:
+    """BASELINE config #2: one JAX pod requesting 8 TPU chips -> v5e-8."""
+
+    def test_tpu_pod_runs_zero_stranded(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "jax"))
+        assert pod_running(kube, "jax")
+        nodes = kube.list_nodes()
+        assert len(nodes) == 1
+        labels = nodes[0]["metadata"]["labels"]
+        assert labels["cloud.google.com/gke-tpu-topology"] == "2x4"
+        snap = controller.metrics.snapshot()
+        assert snap["summaries"]["stranded_chips"]["last"] == 0
+
+    def test_provision_delay_reflected_in_latency(self):
+        kube, actuator, controller = make_harness(provision_delay=120.0)
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        end = run_loop(kube, controller, until=300.0,
+                       stop_when=lambda: pod_running(kube, "jax"))
+        assert pod_running(kube, "jax")
+        assert end == pytest.approx(121.0, abs=3)
+        snap = controller.metrics.snapshot()
+        lat = snap["summaries"]["scale_up_latency_seconds"]["last"]
+        assert 119 <= lat <= 125
+
+
+class TestMultiHostGang:
+    """BASELINE config #3: v5e-64 JobSet gang across 16 hosts."""
+
+    def test_gang_lands_atomically(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-64")
+        for p in make_gang(shape, job="gang"):
+            kube.add_pod(p)
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, f"gang-{i}") for i in range(16)))
+        assert all(pod_running(kube, f"gang-{i}") for i in range(16))
+        assert len(kube.list_nodes()) == 16
+        slice_ids = {n["metadata"]["labels"]["autoscaler.tpu.dev/slice-id"]
+                     for n in kube.list_nodes()}
+        assert len(slice_ids) == 1  # one atomic slice
+        snap = controller.metrics.snapshot()
+        assert snap["summaries"]["stranded_chips"]["last"] == 0
+        # Exactly one provision: the gang was one demand unit, not 16.
+        assert snap["counters"]["provisions_submitted"] == 1
+
+
+class TestScaleDown:
+    def test_idle_slice_reclaimed_atomically(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "jax"))
+        # Job finishes.
+        kube.delete_pod("default", "jax")
+        # Idle threshold + drain passes elapse -> slice deleted.
+        run_loop(kube, controller, start=10.0, until=10.0 + IDLE + 60.0)
+        assert kube.list_nodes() == []
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["units_deleted"] == 1
+
+    def test_busy_slice_never_reclaimed(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "jax"))
+        run_loop(kube, controller, start=10.0, until=10.0 + 3 * IDLE,
+                 step=10.0)
+        assert len(kube.list_nodes()) == 1  # still there
+        assert pod_running(kube, "jax")
+
+    def test_spare_node_kept(self):
+        kube, actuator, controller = make_harness(
+            policy=PoolPolicy(spare_nodes=1))
+        # Spare policy provisions one warm node and never reclaims it.
+        run_loop(kube, controller, until=2 * IDLE, step=10.0)
+        assert len(kube.list_nodes()) == 1
+
+    def test_requested_drain_checkpoint_contract(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "jax"))
+        slice_id = kube.list_nodes()[0]["metadata"]["labels"][
+            "autoscaler.tpu.dev/slice-id"]
+        controller.request_drain(slice_id)
+        controller.reconcile_once(now=20.0)
+        # Pod got the checkpoint annotation; nodes are cordoned.
+        pod = kube.get_pod("default", "jax")
+        assert "autoscaler.tpu.dev/checkpoint-requested" in \
+            pod["metadata"]["annotations"]
+        assert all(n["spec"].get("unschedulable")
+                   for n in kube.list_nodes())
+        # Job checkpoints and exits within the window.
+        kube.delete_pod("default", "jax")
+        controller.reconcile_once(now=25.0)
+        assert kube.list_nodes() == []
+
+    def test_drain_deadline_force_evicts(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "jax"))
+        slice_id = kube.list_nodes()[0]["metadata"]["labels"][
+            "autoscaler.tpu.dev/slice-id"]
+        controller.request_drain(slice_id)
+        controller.reconcile_once(now=20.0)
+        # Job ignores the checkpoint request; after drain_grace it is
+        # evicted and the slice reclaimed.
+        run_loop(kube, controller, start=21.0, until=120.0)
+        assert kube.get_pod("default", "jax") is None
+        assert kube.list_nodes() == []
+
+
+class TestFlags:
+    def test_no_scale(self):
+        kube, actuator, controller = make_harness(no_scale=True)
+        kube.add_pod(make_pod(name="web", requests={"cpu": "2"}))
+        run_loop(kube, controller, until=10.0)
+        assert actuator.statuses() == []
+
+    def test_no_maintenance(self):
+        kube, actuator, controller = make_harness(no_maintenance=True)
+        kube.add_pod(make_pod(name="web", requests={"cpu": "2"}))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "web"))
+        kube.delete_pod("default", "web")
+        run_loop(kube, controller, start=10.0, until=10.0 + 3 * IDLE,
+                 step=10.0)
+        assert len(kube.list_nodes()) == 1  # never reclaimed
+
+
+class TestReviewRegressions:
+    def test_cpu_nodes_not_grouped_by_gke_nodepool(self):
+        """CPU nodes in one GKE nodepool must be independent drain units."""
+        kube, actuator, controller = make_harness()
+        for i in range(3):
+            payload = make_pod(name=f"w{i}", requests={"cpu": "5"})
+            kube.add_pod(payload)
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, f"w{i}") for i in range(3)))
+        # Simulate all nodes sharing a GKE nodepool label (real clusters).
+        for n in kube.list_nodes():
+            n["metadata"]["labels"].pop("autoscaler.tpu.dev/slice-id")
+            n["metadata"]["labels"]["cloud.google.com/gke-nodepool"] = "pool"
+        # One pod exits; only ITS node may ever be reclaimed.
+        kube.delete_pod("default", "w0")
+        busy_nodes = {kube.get_pod("default", f"w{i}")["spec"]["nodeName"]
+                      for i in range(1, 3)}
+        run_loop(kube, controller, start=10.0, until=10.0 + IDLE + 60.0,
+                 step=5.0)
+        remaining = {n["metadata"]["name"] for n in kube.list_nodes()}
+        assert busy_nodes <= remaining
+        assert len(remaining) == 2  # w0's node reclaimed alone
+
+    def test_drain_force_deletes_bare_pod(self):
+        """A bare (unowned) pod cannot block slice reclamation forever."""
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="bare", chips=8, shape=shape))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "bare"))
+        slice_id = kube.list_nodes()[0]["metadata"]["labels"][
+            "autoscaler.tpu.dev/slice-id"]
+        controller.request_drain(slice_id)
+        # Bare pod ignores the checkpoint request; after the drain grace it
+        # is force-deleted and the slice reclaimed.
+        run_loop(kube, controller, start=20.0, until=150.0)
+        assert kube.get_pod("default", "bare") is None
+        assert kube.list_nodes() == []
+
+    def test_provision_failure_counted_once(self):
+        kube, _, _ = make_harness()
+        from tpu_autoscaler.actuators.fake import FakeActuator
+        from tpu_autoscaler.controller import Controller, ControllerConfig
+        actuator = FakeActuator(kube, fail_shapes={"v5e-8"})
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0)))
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        run_loop(kube, controller, until=30.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["provision_failures"] == 1

@@ -1,0 +1,169 @@
+"""Fit engine tests: shape choice + bin packing (reference: test_cluster.py
+scale-up unit math)."""
+
+import pytest
+
+from tpu_autoscaler.engine.fitter import (
+    FitError,
+    choose_shape_for_gang,
+    free_capacity,
+    pack_cpu_pods,
+)
+from tpu_autoscaler.k8s.gangs import group_into_gangs
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.topology import shape_by_name
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    DEFAULT_CPU_SHAPE,
+    TOPOLOGY_LABEL,
+)
+
+from tests.fixtures import make_gang, make_node, make_pod, make_tpu_pod
+
+
+def gang_of(payloads):
+    gangs = group_into_gangs([Pod(p) for p in payloads])
+    assert len(gangs) == 1
+    return gangs[0]
+
+
+class TestChooseShape:
+    def test_exact_topology_pin(self):
+        shape = shape_by_name("v5e-64")
+        choice = choose_shape_for_gang(gang_of(make_gang(shape, job="j")))
+        assert choice.shape.name == "v5e-64"
+        assert choice.stranded_chips == 0
+
+    def test_topology_pin_too_small_fails(self):
+        shape = shape_by_name("v5e-8")
+        g = gang_of([make_tpu_pod(chips=16, shape=shape, job="j")])
+        with pytest.raises(FitError, match="pins"):
+            choose_shape_for_gang(g)
+
+    def test_accelerator_only_rounds_up(self):
+        g = gang_of([make_tpu_pod(
+            chips=4, job="j",
+            selectors={ACCELERATOR_LABEL: "tpu-v5p-slice"},
+            requests={"google.com/tpu": "4"})])
+        # 1 pod x 4 chips on v5p -> smallest v5p shape with >= 4 chips.
+        choice = choose_shape_for_gang(g)
+        assert choice.shape.name == "v5p-4"
+        assert choice.stranded_chips == 0
+
+    def test_no_selectors_uses_default_generation(self):
+        g = gang_of([make_tpu_pod(chips=8, job="j")])
+        choice = choose_shape_for_gang(g, default_generation="v5e")
+        assert choice.shape.name == "v5e-8"
+
+    def test_stranded_chips_computed(self):
+        g = gang_of([make_tpu_pod(chips=5, job="j")])
+        choice = choose_shape_for_gang(g)
+        assert choice.shape.name == "v5e-8"
+        assert choice.stranded_chips == 3
+        assert choice.stranded_pct == pytest.approx(37.5)
+
+    def test_demand_too_large(self):
+        g = gang_of([make_tpu_pod(chips=4096, job="j")])
+        with pytest.raises(FitError, match="largest"):
+            choose_shape_for_gang(g, default_generation="v5e")
+
+    def test_unknown_accelerator(self):
+        g = gang_of([make_tpu_pod(
+            chips=8, job="j", selectors={ACCELERATOR_LABEL: "tpu-v99"})])
+        with pytest.raises(FitError, match="unknown accelerator"):
+            choose_shape_for_gang(g)
+
+    def test_unknown_topology_pin(self):
+        g = gang_of([make_tpu_pod(
+            chips=8, job="j",
+            selectors={ACCELERATOR_LABEL: "tpu-v5p-slice",
+                       TOPOLOGY_LABEL: "3x3x3"})])
+        with pytest.raises(FitError, match="no catalog shape"):
+            choose_shape_for_gang(g)
+
+    def test_north_star_256_chips(self):
+        # The north-star job: 256 chips on v5p, 0 stranded.
+        shape = shape_by_name("v5p-256")
+        choice = choose_shape_for_gang(gang_of(make_gang(shape, job="big")))
+        assert choice.shape.name == "v5p-256"
+        assert choice.stranded_chips == 0
+        assert choice.shape.hosts == 64
+
+    def test_cpu_gang_rejected(self):
+        g = gang_of([make_pod(requests={"cpu": "2"})])
+        with pytest.raises(FitError, match="no TPU chips"):
+            choose_shape_for_gang(g)
+
+
+class TestFreeCapacity:
+    def test_subtracts_bound_pods(self):
+        nodes = [Node(make_node(name="n1"))]
+        pods = [Pod(make_pod(name="p", phase="Running", node_name="n1",
+                             requests={"cpu": "2"}, unschedulable=False))]
+        free = free_capacity(nodes, pods)
+        assert free["n1"].get("cpu") == pytest.approx(7.91 - 2)
+
+    def test_skips_notready_and_cordoned(self):
+        nodes = [Node(make_node(name="bad", ready=False)),
+                 Node(make_node(name="cordoned", unschedulable=True))]
+        assert free_capacity(nodes, []) == {}
+
+
+class TestPackCpuPods:
+    def pod(self, cpu, name="p"):
+        return Pod(make_pod(name=name, requests={"cpu": cpu}))
+
+    def test_fits_existing(self):
+        free = {"n1": Node(make_node()).allocatable}
+        count, unplaced = pack_cpu_pods([self.pod("2")], free,
+                                        DEFAULT_CPU_SHAPE)
+        assert (count, unplaced) == (0, [])
+
+    def test_needs_new_nodes(self):
+        # 3 pods x 3 cpu, unit holds 7.91 -> 2 per node -> 2 new nodes.
+        pods = [self.pod("3", f"p{i}") for i in range(3)]
+        count, unplaced = pack_cpu_pods(pods, {}, DEFAULT_CPU_SHAPE)
+        assert (count, unplaced) == (2, [])
+
+    def test_pod_too_big_for_unit_surfaced(self):
+        big = self.pod("64")
+        count, unplaced = pack_cpu_pods([big], {}, DEFAULT_CPU_SHAPE)
+        assert count == 0
+        assert unplaced == [big]
+
+    def test_first_fit_uses_remaining_unit_space(self):
+        pods = [self.pod("4", "a"), self.pod("3", "b"), self.pod("4", "c")]
+        # a+b share node 1 (7 <= 7.91), c -> node 2.
+        count, _ = pack_cpu_pods(pods, {}, DEFAULT_CPU_SHAPE)
+        assert count == 2
+
+
+class TestPerHostFeasibility:
+    """Review regression: total chips alone is not feasibility."""
+
+    def test_pod_chips_exceed_host_chips_rejected(self):
+        # 3 pods x 8 chips = 24 total; v5e-32 hosts expose only 4 chips.
+        pods = [make_tpu_pod(name=f"p{i}", chips=8, job="j",
+                             requests={"google.com/tpu": "8"})
+                for i in range(3)]
+        g = gang_of(pods)
+        with pytest.raises(FitError, match="no v5e shape"):
+            choose_shape_for_gang(g)
+
+    def test_more_pods_than_host_slots_rejected(self):
+        # v5e-16: 4 hosts x 4 chips. 5 pods x 3 chips = 15 <= 16 total, but
+        # each host fits only one 3-chip pod -> 4 slots < 5 pods.
+        shape = shape_by_name("v5e-16")
+        pods = [make_tpu_pod(name=f"p{i}", chips=3, shape=shape, job="j",
+                             requests={"google.com/tpu": "3"})
+                for i in range(5)]
+        with pytest.raises(FitError, match="host slots"):
+            choose_shape_for_gang(gang_of(pods))
+
+    def test_two_pods_share_one_host(self):
+        # 2 pods x 4 chips on a v5e-8 single host: 2 slots, feasible.
+        shape = shape_by_name("v5e-8")
+        pods = [make_tpu_pod(name=f"p{i}", chips=4, shape=shape, job="j",
+                             requests={"google.com/tpu": "4"})
+                for i in range(2)]
+        assert choose_shape_for_gang(gang_of(pods)).shape.name == "v5e-8"

@@ -1,0 +1,74 @@
+"""Gang grouping tests: demand units are gangs, not pods (SURVEY.md §6.7)."""
+
+from tpu_autoscaler.k8s.gangs import group_into_gangs
+from tpu_autoscaler.k8s.objects import Pod
+from tpu_autoscaler.topology import shape_by_name
+
+from tests.fixtures import make_gang, make_pod, make_tpu_pod
+
+
+def pods(payloads):
+    return [Pod(p) for p in payloads]
+
+
+class TestGrouping:
+    def test_solo_pods_are_singleton_gangs(self):
+        gs = group_into_gangs(pods([make_pod(name="a"), make_pod(name="b")]))
+        assert len(gs) == 2
+        assert all(g.size == 1 for g in gs)
+
+    def test_job_pods_group(self):
+        shape = shape_by_name("v5e-64")
+        gs = group_into_gangs(pods(make_gang(shape, job="train")))
+        assert len(gs) == 1
+        g = gs[0]
+        assert g.size == 16          # one pod per host
+        assert g.tpu_chips == 64     # 4 chips per pod
+        assert g.key == ("job", "default", "train")
+
+    def test_jobset_replicas_are_separate_gangs(self):
+        # Multi-slice: 2 x v5p-128, one gang per slice (BASELINE config #4).
+        shape = shape_by_name("v5p-128")
+        all_pods = []
+        for idx in range(2):
+            all_pods += make_gang(shape, job=f"ms-job-{idx}", jobset="ms",
+                                  job_index=idx)
+        # Strip the job label so grouping exercises the jobset/index path.
+        for p in all_pods:
+            del p["metadata"]["labels"]["batch.kubernetes.io/job-name"]
+        gs = group_into_gangs(pods(all_pods))
+        assert len(gs) == 2
+        assert {g.key for g in gs} == {("jobset", "default", "ms/0"),
+                                       ("jobset", "default", "ms/1")}
+        assert all(g.tpu_chips == 128 for g in gs)
+        assert all(g.jobset_name == "ms" for g in gs)
+
+    def test_ordering_oldest_first(self):
+        old = make_pod(name="old", created="2026-07-28T10:00:00Z")
+        new = make_pod(name="new", created="2026-07-28T12:00:00Z")
+        untimed = make_pod(name="untimed", created=None)
+        gs = group_into_gangs(pods([new, untimed, old]))
+        assert [g.name for g in gs] == ["old", "new", "untimed"]
+
+
+class TestGangProperties:
+    def test_selectors_merged(self):
+        shape = shape_by_name("v5e-16")
+        gs = group_into_gangs(pods(make_gang(shape, job="j")))
+        sel = gs[0].node_selectors
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+
+    def test_per_pod_envelope(self):
+        a = make_tpu_pod(name="a", chips=4, job="j",
+                         requests={"cpu": "2", "google.com/tpu": "4"})
+        b = make_tpu_pod(name="b", chips=4, job="j",
+                         requests={"cpu": "8", "google.com/tpu": "4"})
+        g = group_into_gangs(pods([a, b]))[0]
+        assert g.per_pod_resources.get("cpu") == 8.0
+        assert g.per_pod_resources.get("google.com/tpu") == 4.0
+        assert g.total_resources.get("cpu") == 10.0
+
+    def test_cpu_only_gang(self):
+        g = group_into_gangs(pods([make_pod(requests={"cpu": "2"})]))[0]
+        assert not g.requests_tpu
+        assert g.tpu_chips == 0

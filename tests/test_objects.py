@@ -1,0 +1,175 @@
+"""Pod/Node wrapper tests (reference parity: test_kube.py fixtures)."""
+
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.resources import ResourceVector
+from tpu_autoscaler.topology import shape_by_name
+
+from tests.fixtures import (
+    make_node,
+    make_pod,
+    make_slice_nodes,
+    make_tpu_node,
+    make_tpu_pod,
+)
+
+
+class FakeVerbs:
+    """Records verb calls; stands in for a KubeClient in verb tests."""
+
+    def __init__(self):
+        self.calls = []
+
+    def patch_node(self, name, patch):
+        self.calls.append(("patch_node", name, patch))
+
+    def evict_pod(self, ns, name):
+        self.calls.append(("evict", ns, name))
+
+    def delete_pod(self, ns, name):
+        self.calls.append(("delete_pod", ns, name))
+
+    def delete_node(self, name):
+        self.calls.append(("delete_node", name))
+
+
+class TestPod:
+    def test_requests_parsed(self):
+        pod = Pod(make_pod(requests={"cpu": "1500m", "memory": "2Gi"}))
+        assert pod.resources.get("cpu") == 1.5
+        assert pod.resources.get("memory") == 2 * 1024**3
+        assert pod.resources.get("pods") == 1
+
+    def test_init_container_envelope(self):
+        payload = make_pod(requests={"cpu": "1"})
+        payload["spec"]["initContainers"] = [
+            {"name": "init", "resources": {"requests": {"cpu": "4"}}}]
+        assert Pod(payload).resources.get("cpu") == 4.0
+
+    def test_unschedulable_detection(self):
+        assert Pod(make_pod()).is_unschedulable
+        assert not Pod(make_pod(phase="Running", unschedulable=False,
+                                node_name="n1")).is_unschedulable
+        # Pending but already bound (scheduled, waiting on images) is not
+        # demand.
+        bound = make_pod(phase="Pending", unschedulable=False,
+                         node_name="n1")
+        assert not Pod(bound).is_unschedulable
+        assert Pod(bound).is_scheduled
+
+    def test_tpu_demand(self):
+        shape = shape_by_name("v5e-8")
+        pod = Pod(make_tpu_pod(chips=8, shape=shape))
+        assert pod.requests_tpu
+        assert pod.tpu_chips == 8
+        assert pod.tpu_accelerator == "tpu-v5-lite-device"
+        assert pod.tpu_topology == "2x4"
+        assert not Pod(make_pod()).requests_tpu
+
+    def test_classification(self):
+        assert Pod(make_pod(owner_kind="DaemonSet")).is_daemonset
+        assert Pod(make_pod(owner_kind="ReplicaSet")).is_replicated
+        assert Pod(make_pod(
+            annotations={"kubernetes.io/config.mirror": "x"})).is_mirrored
+        assert Pod(make_pod(
+            priority_class="system-node-critical")).is_critical
+        assert Pod(make_pod(annotations={
+            "cluster-autoscaler.kubernetes.io/safe-to-evict": "false"},
+        )).is_critical
+
+    def test_drainable(self):
+        assert Pod(make_pod(owner_kind="ReplicaSet")).is_drainable
+        assert Pod(make_pod(owner_kind="Job")).is_drainable
+        assert not Pod(make_pod()).is_drainable            # bare pod
+        assert not Pod(make_pod(owner_kind="DaemonSet")).is_drainable
+        assert not Pod(make_pod(owner_kind="ReplicaSet",
+                                priority_class="system-cluster-critical",
+                                )).is_drainable
+
+    def test_gang_key(self):
+        solo = Pod(make_pod(name="solo"))
+        assert solo.gang_key == ("pod", "default", "solo")
+        j = Pod(make_tpu_pod(name="w-0", job="train-job"))
+        assert j.gang_key == ("job", "default", "train-job")
+        js = Pod(make_tpu_pod(name="w-0", jobset="ms", job_index=1))
+        assert js.gang_key == ("jobset", "default", "ms/1")
+
+    def test_verbs(self):
+        c = FakeVerbs()
+        pod = Pod(make_pod(name="p1", namespace="ns1"))
+        pod.evict(c)
+        pod.delete(c)
+        assert ("evict", "ns1", "p1") in c.calls
+        assert ("delete_pod", "ns1", "p1") in c.calls
+
+
+class TestNode:
+    def test_basic_fields(self):
+        node = Node(make_node(name="n1"))
+        assert node.name == "n1"
+        assert node.instance_type == "e2-standard-8"
+        assert node.is_ready
+        assert not node.unschedulable
+        assert not node.is_tpu
+        assert node.slice_id is None
+
+    def test_legacy_instance_type_label(self):
+        payload = make_node(instance_type=None)
+        payload["metadata"]["labels"]["beta.kubernetes.io/instance-type"] = \
+            "Standard_D2"
+        assert Node(payload).instance_type == "Standard_D2"
+
+    def test_tpu_node(self):
+        shape = shape_by_name("v5e-64")
+        node = Node(make_tpu_node(shape, slice_id="s1", host_index=3))
+        assert node.is_tpu
+        assert node.slice_id == "s1"
+        assert node.allocatable.get("google.com/tpu") == 4
+        assert node.tpu_accelerator == "tpu-v5-lite-podslice"
+        assert node.tpu_topology == "8x8"
+
+    def test_slice_nodes_share_slice_id(self):
+        shape = shape_by_name("v5e-64")
+        nodes = [Node(p) for p in make_slice_nodes(shape, slice_id="sX")]
+        assert len(nodes) == 16
+        assert {n.slice_id for n in nodes} == {"sX"}
+
+    def test_gke_nodepool_label_as_slice_id(self):
+        payload = make_node()
+        payload["metadata"]["labels"]["cloud.google.com/gke-nodepool"] = \
+            "np-1"
+        assert Node(payload).slice_id == "np-1"
+
+    def test_can_fit_and_selectors(self):
+        node = Node(make_node(labels={"disktype": "ssd"}))
+        assert node.can_fit(ResourceVector({"cpu": "2"}))
+        assert not node.can_fit(ResourceVector({"cpu": "64"}))
+        assert node.matches_selectors({"disktype": "ssd"})
+        assert not node.matches_selectors({"disktype": "hdd"})
+        assert node.matches_selectors({})
+
+    def test_cordon_uncordon(self):
+        c = FakeVerbs()
+        node = Node(make_node(name="n1"))
+        node.cordon(c)
+        node.uncordon(c)
+        assert c.calls[0] == ("patch_node", "n1",
+                              {"spec": {"unschedulable": True}})
+        assert c.calls[1] == ("patch_node", "n1",
+                              {"spec": {"unschedulable": False}})
+
+    def test_drain_skips_protected(self):
+        c = FakeVerbs()
+        node = Node(make_node(name="n1"))
+        pods = [
+            Pod(make_pod(name="app", owner_kind="ReplicaSet",
+                         phase="Running", node_name="n1",
+                         unschedulable=False)),
+            Pod(make_pod(name="ds", owner_kind="DaemonSet", phase="Running",
+                         node_name="n1", unschedulable=False)),
+            Pod(make_pod(name="elsewhere", owner_kind="ReplicaSet",
+                         phase="Running", node_name="n2",
+                         unschedulable=False)),
+        ]
+        evicted = node.drain(c, pods)
+        assert evicted == 1
+        assert c.calls == [("evict", "default", "app")]

@@ -1,0 +1,190 @@
+"""Planner tests: demand + supply -> provisioning plan (reference:
+test_cluster.py scale math incl. over-provision and max-size clamps)."""
+
+from tpu_autoscaler.engine.planner import (
+    InFlight,
+    Planner,
+    PoolPolicy,
+)
+from tpu_autoscaler.k8s.gangs import group_into_gangs
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.topology import shape_by_name
+
+from tests.fixtures import (
+    make_gang,
+    make_node,
+    make_pod,
+    make_slice_nodes,
+)
+
+
+def plan_for(pod_payloads, node_payloads=(), in_flight=(), policy=None,
+             bound_pods=()):
+    pods = [Pod(p) for p in list(pod_payloads) + list(bound_pods)]
+    nodes = [Node(n) for n in node_payloads]
+    gangs = group_into_gangs([p for p in pods if p.is_unschedulable])
+    return Planner(policy or PoolPolicy(spare_nodes=0)).plan(
+        gangs, nodes, pods, list(in_flight))
+
+
+class TestTpuPlanning:
+    def test_one_slice_per_gang(self):
+        shape = shape_by_name("v5e-64")
+        plan = plan_for(make_gang(shape, job="j1")
+                        + make_gang(shape, job="j2"))
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 2
+        assert all(r.shape_name == "v5e-64" for r in tpu)
+        assert {r.gang_key for r in tpu} == {("job", "default", "j1"),
+                                             ("job", "default", "j2")}
+        assert plan.total_new_chips == 128
+
+    def test_existing_free_slice_satisfies(self):
+        shape = shape_by_name("v5e-64")
+        plan = plan_for(make_gang(shape, job="j1"),
+                        node_payloads=make_slice_nodes(shape, "s-free"))
+        assert plan.empty
+
+    def test_busy_slice_not_supply(self):
+        shape = shape_by_name("v5e-8")
+        nodes = make_slice_nodes(shape, "s-busy")
+        runner = make_pod(name="running-job", phase="Running",
+                          node_name=nodes[0]["metadata"]["name"],
+                          requests={"google.com/tpu": "8"},
+                          unschedulable=False)
+        plan = plan_for(make_gang(shape, job="j1"), node_payloads=nodes,
+                        bound_pods=[runner])
+        assert len(plan.requests) == 1
+
+    def test_two_gangs_one_free_slice(self):
+        shape = shape_by_name("v5e-8")
+        plan = plan_for(
+            make_gang(shape, job="j1") + make_gang(shape, job="j2"),
+            node_payloads=make_slice_nodes(shape, "s-free"))
+        # One gang rides the free slice; the other gets a provision.
+        assert len(plan.requests) == 1
+
+    def test_in_flight_gang_not_reprovisioned(self):
+        shape = shape_by_name("v5e-64")
+        plan = plan_for(
+            make_gang(shape, job="j1"),
+            in_flight=[InFlight(kind="tpu-slice", shape_name="v5e-64",
+                                gang_key=("job", "default", "j1"))])
+        assert plan.empty
+
+    def test_max_total_chips_clamp(self):
+        shape = shape_by_name("v5p-256")
+        plan = plan_for(make_gang(shape, job="big"),
+                        policy=PoolPolicy(spare_nodes=0,
+                                          max_total_chips=128))
+        assert plan.empty
+        assert len(plan.unsatisfiable) == 1
+        assert "max_total_chips" in plan.unsatisfiable[0][1]
+
+    def test_unsatisfiable_gang_reported(self):
+        from tests.fixtures import make_tpu_pod
+
+        plan = plan_for([make_tpu_pod(chips=4096, job="huge")])
+        assert plan.empty
+        assert len(plan.unsatisfiable) == 1
+
+    def test_preemptible_policy_propagates(self):
+        shape = shape_by_name("v5e-8")
+        plan = plan_for(make_gang(shape, job="spot"),
+                        policy=PoolPolicy(spare_nodes=0, preemptible=True))
+        assert plan.requests[0].preemptible
+
+    def test_multislice_two_gangs_two_slices(self):
+        # BASELINE config #4: 2 x v5p-128 via a JobSet with 2 replicated
+        # jobs -> two independent slice provisions, same shape.
+        shape = shape_by_name("v5p-128")
+        pods = []
+        for idx in range(2):
+            pods += make_gang(shape, job=f"ms-{idx}", jobset="ms",
+                              job_index=idx)
+        plan = plan_for(pods)
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 2
+        assert plan.total_new_chips == 256
+
+    def test_spare_slices_warm_pool(self):
+        plan = plan_for([], policy=PoolPolicy(
+            spare_nodes=0, spare_slices={"v5e-8": 2}))
+        assert len(plan.requests) == 2
+        assert all(r.gang_key is None for r in plan.requests)
+        # Existing free slice counts toward the spare target.
+        shape = shape_by_name("v5e-8")
+        plan2 = plan_for([], node_payloads=make_slice_nodes(shape, "w1"),
+                         policy=PoolPolicy(spare_nodes=0,
+                                           spare_slices={"v5e-8": 2}))
+        assert len(plan2.requests) == 1
+
+
+class TestCpuPlanning:
+    def test_pending_pod_adds_node(self):
+        # BASELINE config #1: 1 pending pod requesting 2 vCPU -> +1 node.
+        plan = plan_for([make_pod(requests={"cpu": "2"})])
+        assert len(plan.requests) == 1
+        req = plan.requests[0]
+        assert req.kind == "cpu-node"
+        assert req.count == 1
+
+    def test_fits_existing_node_no_scale(self):
+        plan = plan_for([make_pod(requests={"cpu": "2"})],
+                        node_payloads=[make_node(name="n1")])
+        assert plan.empty
+
+    def test_over_provision(self):
+        plan = plan_for([make_pod(requests={"cpu": "2"})],
+                        policy=PoolPolicy(spare_nodes=0,
+                                          over_provision_nodes=2))
+        assert plan.requests[0].count == 3
+
+    def test_spare_nodes_kept_warm(self):
+        plan = plan_for([], policy=PoolPolicy(spare_nodes=2))
+        assert plan.requests[0].count == 2
+        # Existing free node reduces the gap.
+        plan2 = plan_for([], node_payloads=[make_node(name="n1")],
+                         policy=PoolPolicy(spare_nodes=2))
+        assert plan2.requests[0].count == 1
+
+    def test_max_cpu_nodes_clamp(self):
+        pods = [make_pod(name=f"p{i}", requests={"cpu": "7"})
+                for i in range(5)]
+        plan = plan_for(pods, node_payloads=[make_node(name="n1"),
+                                             make_node(name="n2")],
+                        bound_pods=[make_pod(
+                            name="filler", phase="Running", node_name="n1",
+                            requests={"cpu": "7"}, unschedulable=False),
+                            make_pod(
+                            name="filler2", phase="Running", node_name="n2",
+                            requests={"cpu": "7"}, unschedulable=False)],
+                        policy=PoolPolicy(spare_nodes=0, max_cpu_nodes=4))
+        assert plan.requests[0].count == 2  # room for only 2 more
+
+    def test_in_flight_cpu_subtracts(self):
+        plan = plan_for([make_pod(requests={"cpu": "2"})],
+                        in_flight=[InFlight(kind="cpu-node",
+                                            shape_name="e2-standard-8")])
+        assert plan.empty
+
+
+class TestReviewRegressions:
+    """Regressions from the first code review."""
+
+    def test_oversized_cpu_pod_surfaced_not_dropped(self):
+        plan = plan_for([make_pod(name="huge", requests={"cpu": "64"})])
+        assert plan.empty
+        assert len(plan.unsatisfiable) == 1
+        assert "larger than one" in plan.unsatisfiable[0][1]
+
+    def test_daemonset_pods_do_not_break_spare_check(self):
+        # A node running only a daemonset still counts as spare-free: no
+        # extra node is provisioned every pass.
+        ds = make_pod(name="kube-proxy", owner_kind="DaemonSet",
+                      phase="Running", node_name="n1", unschedulable=False,
+                      requests={"cpu": "100m"})
+        plan = plan_for([], node_payloads=[make_node(name="n1")],
+                        bound_pods=[ds],
+                        policy=PoolPolicy(spare_nodes=1))
+        assert plan.empty

@@ -1,0 +1,103 @@
+"""Resource algebra tests (reference parity: kube.py §KubeResource)."""
+
+import pytest
+
+from tpu_autoscaler.k8s.resources import ResourceVector, parse_quantity
+
+
+class TestParseQuantity:
+    @pytest.mark.parametrize("raw,expected", [
+        ("100m", 0.1),
+        ("2", 2.0),
+        ("2.5", 2.5),
+        ("0", 0.0),
+        ("128Mi", 128 * 1024**2),
+        ("1Gi", 1024**3),
+        ("2Ki", 2048),
+        ("1Ti", 1024**4),
+        ("1Pi", 1024**5),
+        ("1Ei", 1024**6),
+        ("1k", 1000.0),
+        ("5M", 5e6),
+        ("2G", 2e9),
+        ("1T", 1e12),
+        ("1e3", 1000.0),
+        ("1E3", 1000.0),   # exponent, not exa
+        ("2E", 2e18),      # exa, not exponent
+        (4, 4.0),
+        (2.5, 2.5),
+        ("-1", -1.0),
+    ])
+    def test_values(self, raw, expected):
+        assert parse_quantity(raw) == expected
+
+    def test_garbage(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+        with pytest.raises(ValueError):
+            parse_quantity("")
+
+
+class TestResourceVector:
+    def test_construction_and_get(self):
+        r = ResourceVector({"cpu": "500m", "memory": "1Gi",
+                            "google.com/tpu": "8"})
+        assert r.get("cpu") == 0.5
+        assert r.get("memory") == 1024**3
+        assert r.get("google.com/tpu") == 8
+        assert r.get("missing") == 0.0
+
+    def test_add_sub_mul(self):
+        a = ResourceVector({"cpu": "1", "memory": "1Gi"})
+        b = ResourceVector({"cpu": "500m", "google.com/tpu": "4"})
+        s = a + b
+        assert s.get("cpu") == 1.5
+        assert s.get("google.com/tpu") == 4
+        d = s - b
+        assert d == a
+        m = b * 3
+        assert m.get("cpu") == 1.5
+        assert m.get("google.com/tpu") == 12
+        assert (2 * b).get("google.com/tpu") == 8
+
+    def test_zero_entries_canonicalized(self):
+        a = ResourceVector({"cpu": "1"})
+        z = a - a
+        assert z == ResourceVector()
+        assert z.empty
+
+    def test_fits_in(self):
+        node = ResourceVector({"cpu": "8", "memory": "32Gi", "pods": "110"})
+        assert ResourceVector({"cpu": "2"}).fits_in(node)
+        assert not ResourceVector({"cpu": "9"}).fits_in(node)
+        # A TPU request never fits a CPU node (missing axis).
+        assert not ResourceVector({"google.com/tpu": "8"}).fits_in(node)
+        # Empty request fits anywhere.
+        assert ResourceVector().fits_in(node)
+
+    def test_fits_in_tpu_node(self):
+        tpu_node = ResourceVector({"cpu": "100", "memory": "100Gi",
+                                   "google.com/tpu": "4"})
+        assert ResourceVector({"google.com/tpu": "4"}).fits_in(tpu_node)
+        assert not ResourceVector({"google.com/tpu": "8"}).fits_in(tpu_node)
+
+    def test_negative_request_ignored_in_fit(self):
+        # Only positive demands constrain the fit.
+        cap = ResourceVector({"cpu": "1"})
+        assert ResourceVector({"cpu": "-5"}).fits_in(cap)
+
+    def test_equality_and_hash(self):
+        assert ResourceVector({"cpu": "1000m"}) == ResourceVector({"cpu": 1})
+        assert hash(ResourceVector({"cpu": "1000m"})) == hash(
+            ResourceVector({"cpu": 1}))
+
+    def test_kwargs_merge(self):
+        r = ResourceVector({"cpu": "1"}, cpu="500m")
+        assert r.get("cpu") == 1.5
+
+
+class TestNanoMicroSuffixes:
+    def test_nano_and_micro(self):
+        from tpu_autoscaler.k8s.resources import parse_quantity
+        assert parse_quantity("500000n") == 0.0005
+        assert parse_quantity("250u") == 0.00025

@@ -1,0 +1,181 @@
+"""Slice state machine tests (reference: test_cluster.py made every
+ClusterNodeState reachable with crafted pods/timestamps — same here, but
+per-slice)."""
+
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.state import (
+    SliceState,
+    SliceTracker,
+    classify_slice,
+)
+from tpu_autoscaler.state.tracker import DRAIN_ANNOTATION
+from tpu_autoscaler.topology import shape_by_name
+
+from tests.fixtures import make_pod, make_slice_nodes, make_tpu_pod
+
+GRACE = 300.0
+IDLE = 1800.0
+
+
+def classify(view, spare=False):
+    return classify_slice(view, grace_seconds=GRACE,
+                          idle_threshold_seconds=IDLE, spare=spare)
+
+
+def slice_nodes(shape_name="v5e-64", slice_id="s1", **kw):
+    return [Node(p) for p in
+            make_slice_nodes(shape_by_name(shape_name), slice_id, **kw)]
+
+
+def running_pod(node_name, name="w"):
+    return Pod(make_tpu_pod(name=name, chips=4, phase="Running",
+                            node_name=node_name, unschedulable=False,
+                            job="trainer"))
+
+
+class TestBarrierAndGrace:
+    def test_not_all_ready_is_provisioning(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes()
+        # Mark one host NotReady.
+        nodes[3] = Node({**nodes[3]._p, "status": {
+            **nodes[3]._p["status"],
+            "conditions": [{"type": "Ready", "status": "False"}]}})
+        view = tracker.observe("s1", nodes, [], now=100.0)
+        assert classify(view) is SliceState.PROVISIONING
+
+    def test_all_ready_enters_grace(self):
+        tracker = SliceTracker()
+        view = tracker.observe("s1", slice_nodes(), [], now=100.0)
+        assert classify(view) is SliceState.LAUNCH_GRACE
+
+    def test_grace_expires_to_idle(self):
+        tracker = SliceTracker()
+        tracker.observe("s1", slice_nodes(), [], now=100.0)
+        view = tracker.observe("s1", slice_nodes(), [], now=100.0 + GRACE + 1)
+        assert classify(view) is SliceState.IDLE
+
+    def test_ready_then_host_lost_is_unhealthy(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes()
+        tracker.observe("s1", nodes, [], now=100.0)
+        broken = list(nodes)
+        broken[0] = Node({**nodes[0]._p, "status": {
+            **nodes[0]._p["status"],
+            "conditions": [{"type": "Ready", "status": "False"}]}})
+        view = tracker.observe("s1", broken, [], now=200.0)
+        assert classify(view) is SliceState.UNHEALTHY
+
+
+class TestBusyIdle:
+    def test_workload_makes_busy(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes()
+        pods = [running_pod(nodes[0].name)]
+        view = tracker.observe("s1", nodes, pods, now=100.0)
+        assert classify(view) is SliceState.BUSY
+
+    def test_daemonset_and_mirror_do_not_make_busy(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes()
+        tracker.observe("s1", nodes, [], now=0.0)
+        pods = [
+            Pod(make_pod(name="ds", owner_kind="DaemonSet", phase="Running",
+                         node_name=nodes[0].name, unschedulable=False)),
+            Pod(make_pod(name="mirror", phase="Running",
+                         node_name=nodes[0].name, unschedulable=False,
+                         annotations={"kubernetes.io/config.mirror": "x"})),
+        ]
+        view = tracker.observe("s1", nodes, pods, now=GRACE + 1)
+        assert classify(view) is SliceState.IDLE
+
+    def test_idle_past_threshold_drainable(self):
+        tracker = SliceTracker()
+        tracker.observe("s1", slice_nodes(), [], now=0.0)
+        view = tracker.observe("s1", slice_nodes(), [], now=IDLE + 1)
+        assert classify(view) is SliceState.IDLE_DRAINABLE
+
+    def test_idle_clock_resets_when_busy(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes()
+        tracker.observe("s1", nodes, [], now=0.0)
+        # Busy at t=1000 resets idleness.
+        tracker.observe("s1", nodes, [running_pod(nodes[0].name)],
+                        now=1000.0)
+        view = tracker.observe("s1", nodes, [], now=IDLE + 500)
+        assert classify(view) is SliceState.IDLE  # only idle since t=IDLE+500
+        view = tracker.observe("s1", nodes, [], now=2 * IDLE + 1001)
+        assert classify(view) is SliceState.IDLE_DRAINABLE
+
+    def test_spare_retained(self):
+        tracker = SliceTracker()
+        tracker.observe("s1", slice_nodes(), [], now=0.0)
+        view = tracker.observe("s1", slice_nodes(), [], now=IDLE + 1)
+        assert classify(view, spare=True) is SliceState.SPARE
+
+
+class TestCordonStates:
+    def test_our_cordon_is_draining(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes()
+        tracker.observe("s1", nodes, [], now=0.0)
+        tracker.note_cordoned("s1")
+        cordoned = [Node({**n._p, "spec": {"unschedulable": True}})
+                    for n in nodes]
+        view = tracker.observe("s1", cordoned, [], now=10.0)
+        assert classify(view) is SliceState.DRAINING
+
+    def test_foreign_cordon_is_unschedulable(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes()
+        tracker.observe("s1", nodes, [], now=0.0)
+        cordoned = [Node({**n._p, "spec": {"unschedulable": True}})
+                    for n in nodes]
+        view = tracker.observe("s1", cordoned, [], now=10.0)
+        assert classify(view) is SliceState.UNSCHEDULABLE
+
+    def test_drain_annotation_survives_restart(self):
+        # A fresh tracker (process restart) still sees our cordon via the
+        # node annotation.
+        nodes = slice_nodes()
+        annotated = []
+        for n in nodes:
+            p = {**n._p,
+                 "spec": {"unschedulable": True},
+                 "metadata": {**n._p["metadata"],
+                              "annotations": {DRAIN_ANNOTATION: "123"}}}
+            annotated.append(Node(p))
+        fresh = SliceTracker()
+        view = fresh.observe("s1", annotated, [], now=500.0)
+        assert classify(view) is SliceState.DRAINING
+
+
+class TestCpuDegenerateCase:
+    def test_single_cpu_node_flows_through_machine(self):
+        from tests.fixtures import make_node
+
+        tracker = SliceTracker()
+        node = [Node(make_node(name="n1"))]
+        tracker.observe("n1", node, [], now=0.0)
+        view = tracker.observe("n1", node, [], now=IDLE + 1)
+        assert classify(view) is SliceState.IDLE_DRAINABLE
+
+
+class TestUnhealthyDrainPath:
+    """Review regression: an unhealthy slice being reclaimed must classify
+    DRAINING so the drain completes and hardware is deleted."""
+
+    def test_our_cordon_wins_over_unhealthy(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes()
+        tracker.observe("s1", nodes, [], now=0.0)   # barrier cleared
+        tracker.note_cordoned("s1")
+        broken = []
+        for i, n in enumerate(nodes):
+            p = {**n._p, "spec": {"unschedulable": True}}
+            if i == 0:
+                p = {**p, "status": {**n._p["status"], "conditions": [
+                    {"type": "Ready", "status": "False"}]}}
+            broken.append(Node(p))
+        view = tracker.observe("s1", broken, [], now=100.0)
+        assert classify(view) is SliceState.DRAINING

@@ -1,0 +1,144 @@
+"""Exhaustive tests of the slice-shape catalog (SURVEY.md §8 step 1)."""
+
+import pytest
+
+from tpu_autoscaler.topology import (
+    ACCELERATOR_LABEL,
+    CPU_SHAPES,
+    SLICE_SHAPES,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    MultiSliceSpec,
+    SliceShape,
+    cpu_shape_by_name,
+    shape_by_name,
+    shape_from_selectors,
+    shapes_for_generation,
+    smallest_shape_for_chips,
+)
+
+
+class TestCatalogInvariants:
+    def test_every_shape_consistent(self):
+        for name, s in SLICE_SHAPES.items():
+            assert name == f"{s.generation}-{s.chips}"
+            prod = 1
+            for d in s.topology:
+                prod *= d
+            assert prod == s.chips
+            assert s.chips % s.chips_per_host == 0
+            assert s.hosts == s.chips // s.chips_per_host
+            assert s.host_cpu_m > 0 and s.host_memory > 0
+
+    def test_topology_label_roundtrip(self):
+        assert shape_by_name("v5e-64").topology_label == "8x8"
+        assert shape_by_name("v5p-128").topology_label == "4x4x8"
+        assert shape_by_name("v5p-256").topology_label == "4x8x8"
+        assert shape_by_name("v5e-8").topology_label == "2x4"
+
+    def test_driver_eval_shapes_present(self):
+        # Every shape named in BASELINE.md's eval configs must exist.
+        for name in ("v5e-8", "v5e-64", "v5p-128", "v5p-256"):
+            assert name in SLICE_SHAPES
+
+    def test_host_counts(self):
+        assert shape_by_name("v5e-8").hosts == 1     # single-host
+        assert shape_by_name("v5e-64").hosts == 16   # SURVEY §8: 16 hosts
+        assert shape_by_name("v5p-256").hosts == 64
+        assert shape_by_name("v5p-128").hosts == 32
+
+    def test_multi_host_flag(self):
+        assert not shape_by_name("v5e-8").multi_host
+        assert shape_by_name("v5e-16").multi_host
+
+    def test_v5p_product_name_counts_cores(self):
+        # Real product naming counts TensorCores (2/chip on v5p).
+        assert shape_by_name("v5p-128").product_name == "v5p-256"
+
+    def test_node_capacity_exposes_tpu_resource(self):
+        cap = shape_by_name("v5e-64").node_capacity()
+        assert cap[TPU_RESOURCE] == 4.0
+        cap8 = shape_by_name("v5e-8").node_capacity()
+        assert cap8[TPU_RESOURCE] == 8.0
+
+    def test_node_selectors_contract(self):
+        sel = shape_by_name("v5e-64").node_selectors()
+        assert sel[ACCELERATOR_LABEL] == "tpu-v5-lite-podslice"
+        assert sel[TOPOLOGY_LABEL] == "8x8"
+
+    def test_invalid_shape_rejected(self):
+        with pytest.raises(ValueError):
+            SliceShape(generation="v9", chips=7, topology=(2, 4),
+                       chips_per_host=4, accelerator_type="x",
+                       machine_type="m", host_cpu_m=1000, host_memory=1)
+        with pytest.raises(ValueError):
+            SliceShape(generation="v9", chips=6, topology=(2, 3),
+                       chips_per_host=4, accelerator_type="x",
+                       machine_type="m", host_cpu_m=1000, host_memory=1)
+
+
+class TestLookups:
+    def test_shape_by_name_unknown(self):
+        with pytest.raises(KeyError, match="unknown slice shape"):
+            shape_by_name("v9e-3")
+
+    def test_shapes_for_generation_sorted(self):
+        chips = [s.chips for s in shapes_for_generation("v5p")]
+        assert chips == sorted(chips)
+        with pytest.raises(KeyError):
+            shapes_for_generation("v99")
+
+    def test_smallest_shape_exact(self):
+        assert smallest_shape_for_chips("v5e", 64).name == "v5e-64"
+        assert smallest_shape_for_chips("v5p", 256).name == "v5p-256"
+
+    def test_smallest_shape_rounds_up(self):
+        assert smallest_shape_for_chips("v5e", 5).name == "v5e-8"
+        assert smallest_shape_for_chips("v5e", 65).name == "v5e-128"
+        assert smallest_shape_for_chips("v5p", 100).name == "v5p-128"
+
+    def test_smallest_shape_too_big(self):
+        assert smallest_shape_for_chips("v5e", 100000) is None
+
+    def test_cpu_shapes(self):
+        s = cpu_shape_by_name("e2-standard-8")
+        assert s.cpu_m == 7910
+        assert TPU_RESOURCE not in s.node_capacity()
+        with pytest.raises(KeyError):
+            cpu_shape_by_name("weird-machine")
+        assert all(v.cpu_m > 0 for v in CPU_SHAPES.values())
+
+
+class TestSelectorsInversion:
+    def test_exact_pin(self):
+        sel = {ACCELERATOR_LABEL: "tpu-v5p-slice", TOPOLOGY_LABEL: "4x8x8"}
+        assert shape_from_selectors(sel).name == "v5p-256"
+
+    def test_accelerator_only_picks_smallest(self):
+        sel = {ACCELERATOR_LABEL: "tpu-v5p-slice"}
+        assert shape_from_selectors(sel).name == "v5p-4"
+
+    def test_no_tpu_selectors(self):
+        assert shape_from_selectors({}) is None
+        assert shape_from_selectors({"disktype": "ssd"}) is None
+
+    def test_unknown_combination_raises(self):
+        with pytest.raises(KeyError, match="no catalog shape"):
+            shape_from_selectors({ACCELERATOR_LABEL: "tpu-v5p-slice",
+                                  TOPOLOGY_LABEL: "3x3x3"})
+
+    def test_all_shapes_invert(self):
+        for s in SLICE_SHAPES.values():
+            assert shape_from_selectors(s.node_selectors()).name == s.name
+
+
+class TestMultiSlice:
+    def test_2x_v5p_128(self):
+        ms = MultiSliceSpec(shape=shape_by_name("v5p-128"), num_slices=2)
+        assert ms.name == "2xv5p-128"
+        assert ms.total_chips == 256
+        assert ms.total_hosts == 64
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            MultiSliceSpec(shape=shape_by_name("v5e-8"), num_slices=0)

@@ -1,0 +1,33 @@
+"""tpu_autoscaler — a TPU-native Kubernetes cluster autoscaler.
+
+A brand-new framework with the capabilities of
+``wbuchwalter/Kubernetes-acs-engine-autoscaler`` (an Azure acs-engine
+autoscaler forked from OpenAI's kubernetes-ec2-autoscaler), redesigned from
+scratch for Cloud TPU / GKE:
+
+- Pending JAX workloads requesting ``google.com/tpu`` chips (single pods,
+  gang-scheduled multi-host JobSets, multi-slice jobs) are detected and fit
+  against a slice-shape/topology catalog (``tpu_autoscaler.topology``).
+- Exactly-fitting TPU pod slices are provisioned via GKE node pools or Cloud
+  TPU QueuedResources (``tpu_autoscaler.actuators``).
+- Scale-down is slice-atomic and checkpoint-aware: a running ``pjit``/``pmap``
+  job is never bisected, and idle slices are drained as whole ICI domains
+  (``tpu_autoscaler.state``, ``tpu_autoscaler.controller``).
+
+Layer map (analog of reference layers, see SURVEY.md §2):
+
+====  =============================  =====================================
+L5    CLI / process entry            ``tpu_autoscaler.main``
+L4    Control loop / policy          ``tpu_autoscaler.controller``
+L3a   Kubernetes model               ``tpu_autoscaler.k8s``
+L3b   Capacity model                 ``tpu_autoscaler.topology``
+L2    Scaling backends               ``tpu_autoscaler.actuators``
+L1    Cloud plumbing                 ``tpu_autoscaler.actuators.rest``
+L0    External systems               k8s API, GKE/TPU API, Slack
+====  =============================  =====================================
+
+Reference parity citations use ``<file> §<symbol>`` granularity because the
+reference mount was empty when surveyed (SURVEY.md §0).
+"""
+
+__version__ = "0.1.0"

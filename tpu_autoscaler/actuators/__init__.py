@@ -1,0 +1,16 @@
+"""Scaling actuators (L2/L1): the cloud-facing side of the autoscaler.
+
+Analog of the reference's scaler.py §Scaler / engine_scaler.py
+§EngineScaler / container_service.py, with the ARM-template machinery
+replaced by a narrow provision/delete interface over atomic supply units
+(TPU slices; CPU nodes are 1-node slices).  Implementations:
+
+- ``fake.FakeActuator`` — in-memory, materializes Ready nodes into the fake
+  apiserver; powers e2e loop tests (SURVEY.md §5 "Implication").
+- ``gke.GkeNodePoolActuator`` — GKE node-pool create/delete over REST.
+- ``queued_resources.QueuedResourceActuator`` — Cloud TPU QueuedResources.
+"""
+
+from tpu_autoscaler.actuators.base import Actuator, ProvisionStatus
+
+__all__ = ["Actuator", "ProvisionStatus"]

@@ -1,0 +1,68 @@
+"""Actuator interface: provision / delete atomic supply units.
+
+The reference's actuator contract was implicit in EngineScaler (bump ARM
+counts, trim resources, one deployment in flight — engine_scaler.py,
+deployments.py).  Here it is explicit and asynchronous, mirroring the Cloud
+TPU QueuedResource lifecycle (ACCEPTED → PROVISIONING → ACTIVE → FAILED)
+that real TPU provisioning exposes; the reconcile loop polls, it never
+blocks (SURVEY.md §3.5 "don't block the main loop beyond submission").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol, runtime_checkable
+
+from tpu_autoscaler.engine.planner import InFlight, ProvisionRequest
+
+# Provision lifecycle states (QueuedResource-shaped).
+ACCEPTED = "ACCEPTED"
+PROVISIONING = "PROVISIONING"
+ACTIVE = "ACTIVE"
+FAILED = "FAILED"
+
+_IN_FLIGHT = {ACCEPTED, PROVISIONING}
+
+
+@dataclasses.dataclass
+class ProvisionStatus:
+    id: str
+    request: ProvisionRequest
+    state: str
+    # Supply-unit ids this provision materialized (1 slice id, or one id
+    # per CPU node), known once ACTIVE.
+    unit_ids: list[str] = dataclasses.field(default_factory=list)
+    error: str | None = None
+
+    @property
+    def in_flight(self) -> bool:
+        return self.state in _IN_FLIGHT
+
+
+@runtime_checkable
+class Actuator(Protocol):
+    def provision(self, request: ProvisionRequest) -> ProvisionStatus:
+        """Submit one provisioning action; must return without blocking on
+        cloud completion."""
+        ...
+
+    def delete(self, unit_id: str) -> None:
+        """Tear down one whole supply unit (slice or CPU node) atomically."""
+        ...
+
+    def poll(self, now: float) -> None:
+        """Advance async provisioning state; called once per reconcile."""
+        ...
+
+    def statuses(self) -> list[ProvisionStatus]:
+        """All known provisions (in-flight and recently terminal)."""
+        ...
+
+
+def in_flight_of(actuator: Actuator) -> list[InFlight]:
+    """Planner's view of an actuator's outstanding work."""
+    return [
+        InFlight(kind=s.request.kind, shape_name=s.request.shape_name,
+                 gang_key=s.request.gang_key, count=s.request.count)
+        for s in actuator.statuses() if s.in_flight
+    ]

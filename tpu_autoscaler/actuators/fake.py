@@ -1,0 +1,121 @@
+"""In-memory actuator: materializes Ready nodes into a FakeKube.
+
+The reference's tests mocked the cloud entirely (mock.Mock() on the Azure
+SDK — SURVEY.md §5); this fake goes further and *behaves* like the cloud:
+provisions are asynchronous with a configurable delay and pass through the
+QueuedResource-shaped states (ACCEPTED → PROVISIONING → ACTIVE), then real
+node payloads appear in the fake apiserver with the full GKE TPU label
+contract.  Multi-host slices can materialize their hosts gradually
+(``stagger_seconds``) to exercise the all-hosts-Ready barrier.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from tpu_autoscaler.actuators.base import (
+    ACCEPTED,
+    ACTIVE,
+    FAILED,
+    PROVISIONING,
+    ProvisionStatus,
+)
+from tpu_autoscaler.engine.planner import ProvisionRequest
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.k8s.payloads import cpu_node_payload, tpu_host_payload
+from tpu_autoscaler.topology.catalog import cpu_shape_by_name, shape_by_name
+
+
+class FakeActuator:
+    """Implements the Actuator protocol against a FakeKube."""
+
+    # Terminal (ACTIVE/FAILED) statuses are pruned after this long so a
+    # long-running process doesn't accumulate them unboundedly.
+    STATUS_RETENTION_SECONDS = 900.0
+
+    def __init__(self, kube: FakeKube, *, provision_delay: float = 0.0,
+                 stagger_seconds: float = 0.0, fail_shapes: set[str] = ()):
+        self._kube = kube
+        self._delay = provision_delay
+        self._stagger = stagger_seconds
+        self._fail_shapes = set(fail_shapes)
+        self._statuses: dict[str, ProvisionStatus] = {}
+        self._submitted_at: dict[str, float] = {}
+        self._done_at: dict[str, float] = {}
+        self._ids = itertools.count(1)
+        self._now = 0.0
+        self.deleted_units: list[str] = []
+
+    # ---- Actuator protocol ---------------------------------------------
+
+    def provision(self, request: ProvisionRequest) -> ProvisionStatus:
+        pid = f"prov-{next(self._ids)}"
+        status = ProvisionStatus(id=pid, request=request, state=ACCEPTED)
+        self._statuses[pid] = status
+        self._submitted_at[pid] = self._now
+        return status
+
+    def delete(self, unit_id: str) -> None:
+        self.deleted_units.append(unit_id)
+        for payload in list(self._kube.list_nodes()):
+            labels = payload.get("metadata", {}).get("labels", {})
+            if labels.get("autoscaler.tpu.dev/slice-id") == unit_id:
+                self._kube.delete_node(payload["metadata"]["name"])
+
+    def poll(self, now: float) -> None:
+        self._now = now
+        for pid, status in self._statuses.items():
+            if status.state not in (ACCEPTED, PROVISIONING):
+                continue
+            if status.request.shape_name in self._fail_shapes:
+                status.state = FAILED
+                status.error = "fake quota exhausted"
+                continue
+            elapsed = now - self._submitted_at[pid]
+            if elapsed < self._delay:
+                status.state = PROVISIONING
+                continue
+            self._materialize(pid, status, now)
+        # Track terminal times and prune old terminal statuses.
+        for pid, status in list(self._statuses.items()):
+            if status.state in (ACTIVE, FAILED):
+                done = self._done_at.setdefault(pid, now)
+                if now - done > self.STATUS_RETENTION_SECONDS:
+                    del self._statuses[pid]
+                    self._submitted_at.pop(pid, None)
+                    self._done_at.pop(pid, None)
+
+    def statuses(self) -> list[ProvisionStatus]:
+        return list(self._statuses.values())
+
+    # ---- materialization ------------------------------------------------
+
+    def _materialize(self, pid: str, status: ProvisionStatus,
+                     now: float) -> None:
+        req = status.request
+        if req.kind == "tpu-slice":
+            shape = shape_by_name(req.shape_name)
+            slice_id = f"{req.shape_name}-{pid}"
+            elapsed = now - self._submitted_at[pid] - self._delay
+            hosts_up = (shape.hosts if self._stagger <= 0
+                        else min(shape.hosts, 1 + int(elapsed / self._stagger)))
+            for i in range(hosts_up):
+                name = f"{slice_id}-h{i}"
+                if not any(n["metadata"]["name"] == name
+                           for n in self._kube.list_nodes()):
+                    self._kube.add_node(tpu_host_payload(
+                        shape, slice_id, i, created_at=now,
+                        preemptible=req.preemptible))
+            if hosts_up == shape.hosts:
+                status.state = ACTIVE
+                status.unit_ids = [slice_id]
+            else:
+                status.state = PROVISIONING
+        else:
+            shape = cpu_shape_by_name(req.shape_name)
+            for i in range(req.count):
+                unit_id = f"cpu-{pid}-{i}"
+                self._kube.add_node(cpu_node_payload(
+                    shape, unit_id, created_at=now))
+            status.state = ACTIVE
+            status.unit_ids = [f"cpu-{pid}-{i}" for i in range(req.count)]

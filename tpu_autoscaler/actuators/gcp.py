@@ -1,0 +1,114 @@
+"""Shared GCP REST plumbing for the real actuators (L1).
+
+Analog of the reference's deployments.py (ARM submit/poll) — the thin,
+serializable cloud-API layer under the scalers.  Deliberately SDK-free:
+``requests`` + a bearer token cover the three verbs we need (POST create,
+GET poll, DELETE teardown).  Token resolution order:
+
+1. ``GCP_ACCESS_TOKEN`` env (operator-provided, e.g. `gcloud auth
+   print-access-token`),
+2. GCE/GKE metadata server (workload identity / attached SA) — the
+   in-cluster path, mirroring how the reference used in-cluster service
+   credentials.
+
+Tokens are cached until ~5 minutes before expiry.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+log = logging.getLogger(__name__)
+
+_METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                       "instance/service-accounts/default/token")
+
+
+class GcpAuthError(RuntimeError):
+    pass
+
+
+class TokenProvider:
+    def __init__(self):
+        self._token: str | None = None
+        self._expires_at = 0.0
+        self._env_token_used: str | None = None
+
+    def token(self) -> str:
+        if self._token and time.time() < self._expires_at - 300:
+            return self._token
+        env = os.environ.get("GCP_ACCESS_TOKEN")
+        if env and env != self._env_token_used:
+            # A fresh operator-provided token (gcloud tokens live <=1h);
+            # once it ages out we do NOT silently re-adopt the same stale
+            # value — we fall through to the metadata server instead.
+            self._env_token_used = env
+            self._token, self._expires_at = env, time.time() + 3000
+            return env
+        try:
+            import requests
+
+            r = requests.get(_METADATA_TOKEN_URL,
+                             headers={"Metadata-Flavor": "Google"},
+                             timeout=5)
+            r.raise_for_status()
+            data = r.json()
+            self._token = data["access_token"]
+            self._expires_at = time.time() + float(
+                data.get("expires_in", 3600))
+            return self._token
+        except Exception as e:  # noqa: BLE001
+            if env:
+                # No metadata server but the operator gave us a token:
+                # keep using it (it may be long-lived), but say so.
+                log.warning("GCP_ACCESS_TOKEN is older than its assumed "
+                            "lifetime and no metadata server is available; "
+                            "continuing with the possibly-stale token")
+                self._token, self._expires_at = env, time.time() + 3000
+                return env
+            raise GcpAuthError(
+                "no GCP credentials: set GCP_ACCESS_TOKEN or run with a "
+                "metadata server (GKE workload identity)") from e
+
+
+class GcpRest:
+    """Minimal authenticated JSON REST client with dry-run support."""
+
+    def __init__(self, dry_run: bool = False,
+                 token_provider: TokenProvider | None = None):
+        self.dry_run = dry_run
+        self._tokens = token_provider or TokenProvider()
+
+    def _headers(self) -> dict:
+        return {"Authorization": f"Bearer {self._tokens.token()}",
+                "Content-Type": "application/json"}
+
+    def get(self, url: str) -> dict:
+        import requests
+
+        r = requests.get(url, headers=self._headers(), timeout=30)
+        r.raise_for_status()
+        return r.json()
+
+    def post(self, url: str, body: dict) -> dict:
+        if self.dry_run:
+            log.info("[dry-run] POST %s %s", url, body)
+            return {}
+        import requests
+
+        r = requests.post(url, headers=self._headers(), json=body,
+                          timeout=30)
+        r.raise_for_status()
+        return r.json()
+
+    def delete(self, url: str) -> dict:
+        if self.dry_run:
+            log.info("[dry-run] DELETE %s", url)
+            return {}
+        import requests
+
+        r = requests.delete(url, headers=self._headers(), timeout=30)
+        r.raise_for_status()
+        return r.json() if r.content else {}

@@ -1,0 +1,185 @@
+"""GKE node-pool actuator: the primary real-cluster actuator (L2).
+
+TPU-native analog of the reference's engine_scaler.py §EngineScaler: where
+the reference bumped ARM-template `<pool>Count`/`<pool>Offset` parameters
+and redeployed, this creates/deletes whole GKE node pools — one node pool
+per supply unit (one TPU slice, or one CPU node), which is GKE's own
+semantics for multi-host TPU slices (a multi-host TPU node pool IS one
+slice).  Scale-down therefore deletes exactly one unit's hardware without
+touching poolmates — the same invariant as the reference's
+`delete_resources_for_node` (template_processing.py), achieved by
+construction instead of template surgery.
+
+Unlike deployments.py's one-deployment-in-flight serialization, disjoint
+node-pool operations run in parallel; idempotence comes from the planner's
+gang tagging (see engine/planner.py docstring).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+
+from tpu_autoscaler.actuators.base import (
+    ACCEPTED,
+    ACTIVE,
+    FAILED,
+    PROVISIONING,
+    ProvisionStatus,
+)
+from tpu_autoscaler.actuators.gcp import GcpRest, TokenProvider
+from tpu_autoscaler.engine.planner import ProvisionRequest
+from tpu_autoscaler.topology.catalog import (
+    POOL_LABEL,
+    SLICE_ID_LABEL,
+    SLICE_SHAPES,
+    cpu_shape_by_name,
+)
+
+log = logging.getLogger(__name__)
+
+_BASE = "https://container.googleapis.com/v1"
+
+
+class GkeNodePoolActuator:
+    """Implements the Actuator protocol over the GKE node-pools API."""
+
+    STATUS_RETENTION_SECONDS = 900.0
+
+    def __init__(self, project: str, location: str, cluster: str,
+                 dry_run: bool = False, rest: GcpRest | None = None,
+                 pool_prefix: str = "tpuas"):
+        if not (project and location and cluster):
+            raise ValueError(
+                "GKE actuator needs --project, --location and --cluster")
+        self._parent = (f"projects/{project}/locations/{location}"
+                        f"/clusters/{cluster}")
+        self._rest = rest or GcpRest(dry_run=dry_run,
+                                     token_provider=TokenProvider())
+        self._prefix = pool_prefix
+        self._statuses: dict[str, ProvisionStatus] = {}
+        self._operations: dict[str, list[str]] = {}  # provision id -> ops
+        self._pools: dict[str, list[str]] = {}       # provision id -> pools
+        self._done_at: dict[str, float] = {}
+        self._ids = itertools.count(int(time.time()) % 100000)
+
+    # ---- request -> GKE node pool spec ---------------------------------
+
+    def _pool_body(self, request: ProvisionRequest, pool_name: str) -> dict:
+        if request.kind == "tpu-slice":
+            shape = SLICE_SHAPES[request.shape_name]
+            config: dict = {
+                "machineType": shape.machine_type,
+                "labels": {SLICE_ID_LABEL: pool_name,
+                           POOL_LABEL: self._prefix},
+            }
+            if request.preemptible:
+                config["spot"] = True
+            body = {
+                "nodePool": {
+                    "name": pool_name,
+                    "initialNodeCount": shape.hosts,
+                    "config": config,
+                }
+            }
+            if shape.multi_host:
+                # Multi-host TPU pools need the slice placement policy so
+                # GKE provisions one ICI-connected slice.
+                body["nodePool"]["placementPolicy"] = {
+                    "type": "COMPACT",
+                    "tpuTopology": shape.topology_label,
+                }
+            return body
+        # CPU unit: ONE single-node pool per unit, so each CPU node stays an
+        # independent drain/delete unit (pool name == unit id == slice-id
+        # label).  A count-N pool would collapse N nodes into one unit.
+        shape_cpu = cpu_shape_by_name(request.shape_name)
+        return {
+            "nodePool": {
+                "name": pool_name,
+                "initialNodeCount": 1,
+                "config": {
+                    "machineType": shape_cpu.machine_type,
+                    "labels": {SLICE_ID_LABEL: pool_name,
+                               POOL_LABEL: self._prefix},
+                },
+            }
+        }
+
+    # ---- Actuator protocol ---------------------------------------------
+
+    def provision(self, request: ProvisionRequest) -> ProvisionStatus:
+        count = request.count if request.kind == "cpu-node" else 1
+        pool_names = [
+            (f"{self._prefix}-{request.shape_name}"
+             f"-{next(self._ids)}").replace(".", "-").lower()
+            for _ in range(count)
+        ]
+        status = ProvisionStatus(id=pool_names[0], request=request,
+                                 state=ACCEPTED)
+        self._statuses[status.id] = status
+        self._pools[status.id] = pool_names
+        ops: list[str] = []
+        try:
+            for pool_name in pool_names:
+                op = self._rest.post(f"{_BASE}/{self._parent}/nodePools",
+                                     self._pool_body(request, pool_name))
+                if op.get("name"):
+                    ops.append(op["name"])
+        except Exception as e:  # noqa: BLE001 — surface as FAILED status
+            status.state = FAILED
+            status.error = str(e)
+            log.exception("node pool create failed for %s", status.id)
+        self._operations[status.id] = ops
+        return status
+
+    def delete(self, unit_id: str) -> None:
+        try:
+            self._rest.delete(f"{_BASE}/{self._parent}/nodePools/{unit_id}")
+        except Exception:  # noqa: BLE001
+            log.exception("node pool delete failed for %s", unit_id)
+
+    def poll(self, now: float) -> None:
+        for pid, status in self._statuses.items():
+            if status.state not in (ACCEPTED, PROVISIONING):
+                continue
+            ops = self._operations.get(pid) or []
+            if not ops:
+                if not self._rest.dry_run:
+                    status.state = PROVISIONING
+                continue
+            all_done, error = True, None
+            for op_name in ops:
+                try:
+                    # Operation names are already fully qualified
+                    # (projects/.../operations/...).
+                    op = self._rest.get(f"{_BASE}/{op_name}")
+                except Exception:  # noqa: BLE001 — transient; retry later
+                    log.exception("operation poll failed for %s", pid)
+                    all_done = False
+                    break
+                if op.get("status") != "DONE":
+                    all_done = False
+                    break
+                if op.get("error"):
+                    error = str(op["error"])
+            if error is not None:
+                status.state = FAILED
+                status.error = error
+            elif all_done:
+                status.state = ACTIVE
+                status.unit_ids = list(self._pools.get(pid, [pid]))
+            else:
+                status.state = PROVISIONING
+        for pid, status in list(self._statuses.items()):
+            if status.state in (ACTIVE, FAILED):
+                done = self._done_at.setdefault(pid, now)
+                if now - done > self.STATUS_RETENTION_SECONDS:
+                    del self._statuses[pid]
+                    self._operations.pop(pid, None)
+                    self._pools.pop(pid, None)
+                    self._done_at.pop(pid, None)
+
+    def statuses(self) -> list[ProvisionStatus]:
+        return list(self._statuses.values())

@@ -1,0 +1,161 @@
+"""Cloud TPU QueuedResource actuator (L2 alternative).
+
+Second real actuator, the XPK-style slice-provisioning path: instead of GKE
+node pools, slices are requested from the Cloud TPU queued-resources API
+(the capacity queue).  Its native lifecycle (ACCEPTED → PROVISIONING →
+ACTIVE → FAILED/SUSPENDED) is exactly the ProvisionStatus state set — the
+reference's "submit deployment, poll provisioning state" pattern
+(deployments.py) maps 1:1, minus the one-in-flight restriction.
+
+Reference-parity role: this is the secondary actuator the way
+container_service.py (classic ACS) was secondary to engine_scaler.py.
+
+Scope note: queued resources create *standalone TPU VM slices*, not GKE
+nodes — use this actuator for QR-managed fleets where the supply-unit id IS
+the queued-resource id (e.g. paired with a node-registration agent that
+stamps SLICE_ID_LABEL with the qr id).  For GKE clusters use
+``GkeNodePoolActuator``, whose node pools register labeled nodes natively.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+
+from tpu_autoscaler.actuators.base import (
+    ACCEPTED,
+    ACTIVE,
+    FAILED,
+    PROVISIONING,
+    ProvisionStatus,
+)
+from tpu_autoscaler.actuators.gcp import GcpRest, TokenProvider
+from tpu_autoscaler.engine.planner import ProvisionRequest
+from tpu_autoscaler.topology.catalog import SLICE_SHAPES
+
+log = logging.getLogger(__name__)
+
+_BASE = "https://tpu.googleapis.com/v2alpha1"
+
+# Cloud TPU API state -> our ProvisionStatus state.
+_STATE_MAP = {
+    "CREATING": ACCEPTED,
+    "ACCEPTED": ACCEPTED,
+    "WAITING_FOR_RESOURCES": PROVISIONING,
+    "PROVISIONING": PROVISIONING,
+    "ACTIVE": ACTIVE,
+    "FAILED": FAILED,
+    "SUSPENDED": FAILED,
+    "SUSPENDING": FAILED,
+}
+
+
+class QueuedResourceActuator:
+    """Implements the Actuator protocol over Cloud TPU queuedResources."""
+
+    STATUS_RETENTION_SECONDS = 900.0
+
+    def __init__(self, project: str, zone: str, dry_run: bool = False,
+                 rest: GcpRest | None = None,
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 name_prefix: str = "tpuas"):
+        if not (project and zone):
+            raise ValueError(
+                "QueuedResource actuator needs --project and --location")
+        self._parent = f"projects/{project}/locations/{zone}"
+        self._rest = rest or GcpRest(dry_run=dry_run,
+                                     token_provider=TokenProvider())
+        self._runtime = runtime_version
+        self._prefix = name_prefix
+        self._statuses: dict[str, ProvisionStatus] = {}
+        self._done_at: dict[str, float] = {}
+        self._provisioned: set[str] = set()
+        self._ids = itertools.count(int(time.time()) % 100000)
+
+    def provision(self, request: ProvisionRequest) -> ProvisionStatus:
+        if request.kind != "tpu-slice":
+            raise ValueError(
+                "QueuedResource actuator only provisions TPU slices; route "
+                "cpu-node requests to the GKE actuator")
+        shape = SLICE_SHAPES[request.shape_name]
+        qr_id = (f"{self._prefix}-{request.shape_name}"
+                 f"-{next(self._ids)}").replace(".", "-").lower()
+        # The TPU API's acceleratorType uses product naming (TensorCore
+        # counts on v4/v5p) — the catalog records that as product_name.
+        accelerator = shape.product_name or shape.name
+        body: dict = {
+            "tpu": {
+                "nodeSpec": [{
+                    "parent": self._parent,
+                    "nodeId": qr_id,
+                    "node": {
+                        "acceleratorType": accelerator,
+                        "runtimeVersion": self._runtime,
+                        "labels": {"autoscaler-tpu-dev-slice-id": qr_id},
+                    },
+                }],
+            },
+        }
+        if request.preemptible:
+            body["spot"] = {}
+        status = ProvisionStatus(id=qr_id, request=request, state=ACCEPTED)
+        self._statuses[qr_id] = status
+        self._provisioned.add(qr_id)
+        try:
+            self._rest.post(
+                f"{_BASE}/{self._parent}/queuedResources"
+                f"?queuedResourceId={qr_id}", body)
+        except Exception as e:  # noqa: BLE001 — surface as FAILED status
+            status.state = FAILED
+            status.error = str(e)
+            log.exception("queued resource create failed for %s", qr_id)
+        return status
+
+    def delete(self, unit_id: str) -> None:
+        if unit_id not in self._provisioned:
+            # Unit ids from the controller come from k8s node labels;
+            # queued-resource slices are standalone TPU VM fleets (no GKE
+            # nodes), so a foreign id here means misconfiguration — say so
+            # loudly instead of letting the DELETE 404 silently while the
+            # billed slice keeps running (see class docstring: this
+            # actuator is for QR-managed fleets where unit id == qr id).
+            log.error("delete(%s): not a queued resource this actuator "
+                      "provisioned; refusing blind delete", unit_id)
+            return
+        try:
+            self._rest.delete(
+                f"{_BASE}/{self._parent}/queuedResources/{unit_id}"
+                "?force=true")
+            self._provisioned.discard(unit_id)
+        except Exception:  # noqa: BLE001
+            log.exception("queued resource delete failed for %s", unit_id)
+
+    def poll(self, now: float) -> None:
+        for qr_id, status in self._statuses.items():
+            if status.state not in (ACCEPTED, PROVISIONING):
+                continue
+            if self._rest.dry_run:
+                continue
+            try:
+                qr = self._rest.get(
+                    f"{_BASE}/{self._parent}/queuedResources/{qr_id}")
+            except Exception:  # noqa: BLE001 — transient; retry next pass
+                log.exception("queued resource poll failed for %s", qr_id)
+                continue
+            api_state = (qr.get("state") or {}).get("state", "")
+            mapped = _STATE_MAP.get(api_state, PROVISIONING)
+            status.state = mapped
+            if mapped == ACTIVE:
+                status.unit_ids = [qr_id]
+            elif mapped == FAILED:
+                status.error = api_state
+        for qr_id, status in list(self._statuses.items()):
+            if status.state in (ACTIVE, FAILED):
+                done = self._done_at.setdefault(qr_id, now)
+                if now - done > self.STATUS_RETENTION_SECONDS:
+                    del self._statuses[qr_id]
+                    self._done_at.pop(qr_id, None)
+
+    def statuses(self) -> list[ProvisionStatus]:
+        return list(self._statuses.values())

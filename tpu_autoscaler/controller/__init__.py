@@ -1,0 +1,3 @@
+from tpu_autoscaler.controller.reconciler import Controller, ControllerConfig
+
+__all__ = ["Controller", "ControllerConfig"]

@@ -1,0 +1,407 @@
+"""The control loop (L4): observe → decide → act.
+
+Analog of the reference's cluster.py §Cluster.loop_logic / §Cluster.scale /
+§Cluster.maintain, with the reconcile re-derived for slice-atomic supply:
+
+- crash-only: every pass recomputes desired state from scratch; the only
+  cross-pass memory is timers (SliceTracker) whose loss merely delays
+  scale-down (SURVEY.md §6.3);
+- non-blocking actuation: provisions are submitted and polled, never waited
+  on (reference: deployments.py "don't block beyond submission"), and
+  disjoint gangs provision in parallel (the reference's one-in-flight
+  serialization is too blunt for <6 min at 256 chips, SURVEY.md §8);
+- maintain operates on supply *units* — TPU slices and single CPU nodes —
+  cordoning, draining (checkpoint-aware), and deleting whole units only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+from tpu_autoscaler.actuators.base import FAILED, Actuator, in_flight_of
+from tpu_autoscaler.engine.planner import Planner, PoolPolicy
+from tpu_autoscaler.k8s.client import KubeClient
+from tpu_autoscaler.k8s.gangs import Gang, group_into_gangs
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.metrics import Metrics
+from tpu_autoscaler.notify import LogNotifier, Notifier
+from tpu_autoscaler.state import SliceState, SliceTracker, classify_slice
+from tpu_autoscaler.state.tracker import DRAIN_ANNOTATION
+
+log = logging.getLogger(__name__)
+
+# Annotation stamped on workload pods when their slice is being reclaimed:
+# the checkpoint contract. A TPU job that sees this on itself should write a
+# checkpoint and exit cleanly before the drain deadline (BASELINE config #5;
+# see tpu_autoscaler.workloads.checkpoint for the job-side helper).
+CHECKPOINT_ANNOTATION = "autoscaler.tpu.dev/checkpoint-requested"
+
+
+@dataclasses.dataclass
+class ControllerConfig:
+    policy: PoolPolicy = dataclasses.field(default_factory=PoolPolicy)
+    # Post-launch grace before a unit may be reclaimed (reference: launch
+    # grace period in cluster.py's state machine).
+    grace_seconds: float = 300.0
+    # Idle time before reclaim (reference: --idle-threshold, default 1800).
+    idle_threshold_seconds: float = 1800.0
+    # Bounded wait for the checkpoint contract before force-evicting.
+    drain_grace_seconds: float = 120.0
+    # A Ready slice with a NotReady host is replaced after this long.
+    unhealthy_timeout_seconds: float = 600.0
+    # Backoff before re-provisioning after a FAILED provision (the
+    # reference's blunt one-deployment-at-a-time serialization throttled
+    # retries implicitly; we need it explicit).
+    provision_retry_seconds: float = 60.0
+    # Reference parity flags (main.py --no-scale / --no-maintenance).
+    no_scale: bool = False
+    no_maintenance: bool = False
+
+
+class Controller:
+    def __init__(self, client: KubeClient, actuator: Actuator,
+                 config: ControllerConfig | None = None,
+                 notifier: Notifier | None = None,
+                 metrics: Metrics | None = None):
+        self.client = client
+        self.actuator = actuator
+        self.config = config or ControllerConfig()
+        self.notifier = notifier or LogNotifier()
+        self.metrics = metrics or Metrics()
+        self.planner = Planner(self.config.policy)
+        self.tracker = SliceTracker()
+        # Gang lifecycle: first time each gang was seen Unschedulable, for
+        # the north-star latency metric; cleared when the gang runs.
+        self._gang_first_pending: dict[tuple, float] = {}
+        self._drain_started: dict[str, float] = {}
+        self._unhealthy_since: dict[str, float] = {}
+        self._reported_unsatisfiable: set[tuple] = set()
+        self._seen_failures: set[str] = set()
+        # Retry-at times after failed provisions, per gang key and (for
+        # gang-less spare provisions) per shape name.
+        self._retry_at: dict[object, float] = {}
+        # Units the operator (or spot reclamation) asked us to evacuate.
+        self._requested_drains: set[str] = set()
+
+    # ------------------------------------------------------------------ #
+
+    def reconcile_once(self, now: float | None = None) -> None:
+        """One reconcile pass. All time injected for testability."""
+        now = time.time() if now is None else now
+        t0 = time.perf_counter()
+
+        # Poll the actuator FIRST, then observe: a provision that just went
+        # ACTIVE must have its nodes visible in this pass's observation, or
+        # the planner would see neither the in-flight provision nor the new
+        # supply and double-provision.
+        self.actuator.poll(now)
+        nodes = [Node(p) for p in self.client.list_nodes()]
+        pods = [Pod(p) for p in self.client.list_pods()]
+
+        pending = [p for p in pods if p.is_unschedulable]
+        gangs = group_into_gangs(pending)
+        self._track_gang_latency(gangs, pods, now)
+
+        if not self.config.no_scale:
+            self._scale(gangs, nodes, pods, now)
+        if not self.config.no_maintenance:
+            self._maintain(nodes, pods, now)
+
+        # Bound long-run memory: drop bookkeeping for demands/provisions
+        # that no longer exist (actuators prune terminal statuses; gangs
+        # whose pods are gone re-report if re-created, which is desired).
+        live_status_ids = {s.id for s in self.actuator.statuses()}
+        self._seen_failures &= live_status_ids
+        live_gang_keys = {p.gang_key for p in pods}
+        self._reported_unsatisfiable &= live_gang_keys
+        for key in [k for k, t in self._retry_at.items()
+                    if t < now - 3600.0]:
+            del self._retry_at[key]
+
+        self.metrics.observe("reconcile_seconds", time.perf_counter() - t0)
+        self.metrics.set_gauge("pending_gangs", len(gangs))
+        self.metrics.set_gauge("nodes", len(nodes))
+
+    def run_forever(self, interval_seconds: float = 5.0) -> None:
+        """Poll loop (reference: main.py while True / sleep).
+
+        The interval is seconds-scale, not the reference's 60 s — detection
+        latency is part of the north-star budget.  Each pass is wrapped in
+        a catch-all so the loop is crash-only (reference parity).
+        """
+        while True:
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001 — crash-only loop
+                log.exception("reconcile pass failed")
+                self.metrics.inc("reconcile_errors")
+            time.sleep(interval_seconds)
+
+    # ---- scale-up ------------------------------------------------------ #
+
+    def _scale(self, gangs: list[Gang], nodes: list[Node],
+               pods: list[Pod], now: float) -> None:
+        # Process failures FIRST so a provision that failed since last pass
+        # sets its backoff before we consider re-submitting for its demand.
+        self._note_failures(now)
+        plan = self.planner.plan(gangs, nodes, pods,
+                                 in_flight_of(self.actuator))
+        for req in plan.requests:
+            # Respect retry backoff after a failed provision for the same
+            # demand (gang, or shape for gang-less spare provisions).
+            backoff_key = req.gang_key or ("shape", req.shape_name)
+            if now < self._retry_at.get(backoff_key, 0.0):
+                continue
+            status = self.actuator.provision(req)
+            log.info("provisioning %s x%d (%s): %s", req.shape_name,
+                     req.count, status.id, req.reason)
+            self.metrics.inc("provisions_submitted")
+            if req.kind == "tpu-slice":
+                self.metrics.observe("stranded_chips", req.stranded_chips)
+            self.notifier.notify(
+                f"scaling up: {req.count}x {req.shape_name} — {req.reason}")
+        for gang, reason in plan.unsatisfiable:
+            if gang.key not in self._reported_unsatisfiable:
+                self._reported_unsatisfiable.add(gang.key)
+                log.warning("unsatisfiable %s: %s", gang, reason)
+                self.metrics.inc("unsatisfiable_gangs")
+                self.notifier.notify(f"cannot satisfy {gang.name}: {reason}")
+
+    def _note_failures(self, now: float) -> None:
+        for status in self.actuator.statuses():
+            if status.state == FAILED and status.id not in self._seen_failures:
+                self._seen_failures.add(status.id)
+                self.metrics.inc("provision_failures")
+                backoff_key = (status.request.gang_key
+                               or ("shape", status.request.shape_name))
+                self._retry_at[backoff_key] = (
+                    now + self.config.provision_retry_seconds)
+                log.warning("provision %s failed (retry in %gs): %s",
+                            status.id, self.config.provision_retry_seconds,
+                            status.error)
+                self.notifier.notify(
+                    f"provision {status.request.shape_name} failed: "
+                    f"{status.error}")
+
+    def _track_gang_latency(self, pending: list[Gang], pods: list[Pod],
+                            now: float) -> None:
+        for gang in pending:
+            self._gang_first_pending.setdefault(gang.key, now)
+        if not self._gang_first_pending:
+            return
+        by_key: dict[tuple, list[Pod]] = {}
+        for p in pods:
+            by_key.setdefault(p.gang_key, []).append(p)
+        for key, first in list(self._gang_first_pending.items()):
+            members = by_key.get(key, [])
+            if members and all(p.phase == "Running" for p in members):
+                latency = now - first
+                self.metrics.observe("scale_up_latency_seconds", latency)
+                log.info("gang %s Unschedulable→Running in %.1fs", key,
+                         latency)
+                del self._gang_first_pending[key]
+            elif not members:
+                # Gang's pods were deleted while pending: drop the entry so
+                # a reused Job name doesn't inherit a stale start time.
+                del self._gang_first_pending[key]
+
+    # ---- scale-down / maintenance -------------------------------------- #
+
+    def request_drain(self, unit_id: str) -> None:
+        """Ask for a unit to be evacuated (spot reclamation notice,
+        scale-to-zero, operator action).  Honored checkpoint-aware on the
+        next reconcile pass."""
+        self._requested_drains.add(unit_id)
+
+    def _units(self, nodes: list[Node]) -> dict[str, list[Node]]:
+        """Group nodes into supply units: slices, or single CPU nodes.
+
+        TPU hosts group by slice id (all hosts of one slice are one atomic
+        unit).  CPU nodes are each their own unit, keyed by our explicit
+        slice label if present else the node name — deliberately NOT the
+        GKE nodepool label, which would collapse a whole CPU pool into one
+        drain/delete unit.
+        """
+        from tpu_autoscaler.topology.catalog import SLICE_ID_LABEL
+
+        units: dict[str, list[Node]] = {}
+        for node in nodes:
+            if node.is_tpu and node.slice_id:
+                units.setdefault(node.slice_id, []).append(node)
+            else:
+                unit_id = node.labels.get(SLICE_ID_LABEL) or node.name
+                units.setdefault(unit_id, []).append(node)
+        return units
+
+    def _spare_units(self, units: dict[str, list[Node]],
+                     pods_by_node: dict[str, list[Pod]]) -> set[str]:
+        """Pick which idle units the spare policy retains.
+
+        CPU: newest ``spare_nodes`` idle nodes.  TPU: per shape, the newest
+        ``spare_slices[shape]`` idle slices.  (Reference: --spare-agents
+        kept N free agents, cluster.py §SPARE_AGENT.)
+        """
+        pol = self.config.policy
+        spare: set[str] = set()
+
+        def idle(unit_nodes: list[Node]) -> bool:
+            return not any(
+                p for n in unit_nodes for p in pods_by_node.get(n.name, [])
+                if not p.is_daemonset and not p.is_mirrored)
+
+        def created(unit_nodes: list[Node]) -> float:
+            times = [n.created.timestamp() for n in unit_nodes if n.created]
+            return max(times) if times else 0.0
+
+        cpu_idle = sorted(
+            (uid for uid, ns in units.items()
+             if not ns[0].is_tpu and idle(ns)),
+            key=lambda uid: -created(units[uid]))
+        spare.update(cpu_idle[:pol.spare_nodes])
+
+        for shape_name, want in pol.spare_slices.items():
+            tpu_idle = sorted(
+                (uid for uid, ns in units.items()
+                 if ns[0].is_tpu and idle(ns)
+                 and f"{_gen_of(ns[0])}-{_chips_of(ns)}" == shape_name),
+                key=lambda uid: -created(units[uid]))
+            spare.update(tpu_idle[:want])
+        return spare
+
+    def _maintain(self, nodes: list[Node], pods: list[Pod],
+                  now: float) -> None:
+        cfg = self.config
+        pods_by_node: dict[str, list[Pod]] = {}
+        for p in pods:
+            if p.node_name and p.phase in {"Pending", "Running"}:
+                pods_by_node.setdefault(p.node_name, []).append(p)
+
+        units = self._units(nodes)
+        spare_ids = self._spare_units(units, pods_by_node)
+        state_counts: dict[str, int] = {}
+
+        for unit_id, unit_nodes in units.items():
+            unit_pods = [p for n in unit_nodes
+                         for p in pods_by_node.get(n.name, [])]
+            view = self.tracker.observe(unit_id, unit_nodes, unit_pods, now)
+            state = classify_slice(
+                view, grace_seconds=cfg.grace_seconds,
+                idle_threshold_seconds=cfg.idle_threshold_seconds,
+                spare=unit_id in spare_ids)
+            state_counts[state.value] = state_counts.get(state.value, 0) + 1
+
+            try:
+                if (state in (SliceState.BUSY, SliceState.IDLE,
+                              SliceState.LAUNCH_GRACE, SliceState.SPARE)
+                        and unit_id in self._requested_drains):
+                    self._begin_drain(unit_id, unit_nodes, unit_pods, now,
+                                      reason="drain requested")
+                elif state is SliceState.IDLE_DRAINABLE:
+                    self._begin_drain(
+                        unit_id, unit_nodes, unit_pods, now,
+                        reason=f"idle > {cfg.idle_threshold_seconds:g}s")
+                elif state is SliceState.DRAINING:
+                    self._continue_drain(unit_id, unit_nodes, unit_pods, now)
+                elif state is SliceState.UNHEALTHY:
+                    self._handle_unhealthy(unit_id, unit_nodes, unit_pods,
+                                           now)
+                else:
+                    self._unhealthy_since.pop(unit_id, None)
+            except Exception:  # noqa: BLE001 — one unit's API failure must
+                # not starve maintenance of every other unit.
+                log.exception("maintenance failed for unit %s", unit_id)
+                self.metrics.inc("maintain_errors")
+
+        for key, count in state_counts.items():
+            self.metrics.set_gauge(f"units_{key.replace('-', '_')}", count)
+        # Forget tracker state for units whose nodes are gone.
+        for known in self.tracker.known_slices():
+            if known not in units:
+                self.tracker.forget(known)
+                self._drain_started.pop(known, None)
+                self._requested_drains.discard(known)
+                self._unhealthy_since.pop(known, None)
+
+    def _begin_drain(self, unit_id: str, unit_nodes: list[Node],
+                     unit_pods: list[Pod], now: float, reason: str) -> None:
+        log.info("draining unit %s (%d nodes): %s", unit_id,
+                 len(unit_nodes), reason)
+        for node in unit_nodes:
+            node.cordon(self.client)
+            self.client.patch_node(node.name, {
+                "metadata": {"annotations": {DRAIN_ANNOTATION: str(now)}}})
+        # Checkpoint contract: tell the workload to save and exit.
+        for pod in unit_pods:
+            if pod.is_drainable:
+                self.client.patch_pod(pod.namespace, pod.name, {
+                    "metadata": {"annotations": {
+                        CHECKPOINT_ANNOTATION: str(now)}}})
+        self.tracker.note_cordoned(unit_id)
+        self._drain_started[unit_id] = now
+        self.metrics.inc("drains_started")
+        self.notifier.notify(f"draining {unit_id}: {reason}")
+
+    def _continue_drain(self, unit_id: str, unit_nodes: list[Node],
+                        unit_pods: list[Pod], now: float) -> None:
+        started = self._drain_started.setdefault(unit_id, now)
+        workload = [p for p in unit_pods
+                    if not p.is_daemonset and not p.is_mirrored]
+        if workload:
+            if now - started < self.config.drain_grace_seconds:
+                return  # checkpoint window still open
+            # Deadline passed: evict what the eviction API allows, and
+            # force-delete the rest (bare pods, safe-to-evict=false) — the
+            # unit is going away regardless (spot reclamation semantics),
+            # and leaving it cordoned-forever strands the whole slice.
+            for node in unit_nodes:
+                node.drain(self.client, unit_pods)
+            for pod in workload:
+                if not pod.is_drainable:
+                    pod.delete(self.client)
+            return
+        # Unit is empty: reclaim it atomically.
+        log.info("deleting unit %s (%d nodes)", unit_id, len(unit_nodes))
+        self.actuator.delete(unit_id)
+        for node in unit_nodes:
+            node.delete(self.client)
+        self.tracker.forget(unit_id)
+        self._drain_started.pop(unit_id, None)
+        self._requested_drains.discard(unit_id)
+        self.metrics.inc("units_deleted")
+        self.notifier.notify(f"deleted idle unit {unit_id}")
+
+    def _handle_unhealthy(self, unit_id: str, unit_nodes: list[Node],
+                          unit_pods: list[Pod], now: float) -> None:
+        """A previously-Ready slice lost a host: the ICI domain is broken.
+
+        Wait out a flap window, then reclaim the whole slice (checkpoint
+        contract first) — the gang it hosted will go Pending again and the
+        scale path provisions a replacement.  Partial repair of a slice is
+        impossible by construction.
+        """
+        since = self._unhealthy_since.setdefault(unit_id, now)
+        if now - since < self.config.unhealthy_timeout_seconds:
+            return
+        if unit_id in self._drain_started:
+            return  # replacement drain already under way
+        self.metrics.inc("unhealthy_units_replaced")
+        self._begin_drain(unit_id, unit_nodes, unit_pods, now,
+                          reason="unhealthy host in slice")
+
+
+def _gen_of(node: Node) -> str:
+    from tpu_autoscaler.topology.catalog import SLICE_SHAPES
+
+    for s in SLICE_SHAPES.values():
+        if s.accelerator_type == node.tpu_accelerator \
+                and s.topology_label == node.tpu_topology:
+            return s.generation
+    return "unknown"
+
+
+def _chips_of(nodes: list[Node]) -> int:
+    from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+    return sum(int(n.allocatable.get(TPU_RESOURCE)) for n in nodes)

@@ -1,0 +1,34 @@
+"""Fit / decision engine (L4 policy math).
+
+Analog of the reference's cluster.py §Cluster.scale (first-fit bin-packing of
+pending pods into agent-pool units), rebuilt around two TPU-native ideas:
+
+- the demand unit is the *gang* (not the pod) and the supply unit is the
+  *slice* (not the node);
+- shape selection minimizes stranded chips (chips provisioned minus chips
+  requested), tie-breaking toward fewer hosts.
+"""
+
+from tpu_autoscaler.engine.fitter import (
+    FitError,
+    choose_shape_for_gang,
+    free_capacity,
+    pack_cpu_pods,
+)
+from tpu_autoscaler.engine.planner import (
+    PoolPolicy,
+    ProvisionRequest,
+    ScalePlan,
+    Planner,
+)
+
+__all__ = [
+    "FitError",
+    "Planner",
+    "PoolPolicy",
+    "ProvisionRequest",
+    "ScalePlan",
+    "choose_shape_for_gang",
+    "free_capacity",
+    "pack_cpu_pods",
+]

@@ -1,0 +1,179 @@
+"""Shape selection and bin-packing primitives.
+
+Reference parity: cluster.py §Cluster.scale did `for pod: find pool whose
+unit capacity + selectors fit` then accumulated whole-node units.  Here the
+TPU path picks a whole slice per gang (stranded-chip objective) and the CPU
+path keeps the reference's first-fit whole-node accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_autoscaler.k8s.gangs import Gang
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.resources import ResourceVector
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    shape_from_selectors,
+    shapes_for_generation,
+)
+from tpu_autoscaler.topology.shapes import CpuShape, SliceShape
+
+
+class FitError(Exception):
+    """A gang that can never be satisfied by the catalog (too big, unknown
+    selectors, inconsistent topology pin)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeChoice:
+    shape: SliceShape
+    stranded_chips: int
+
+    @property
+    def stranded_pct(self) -> float:
+        return 100.0 * self.stranded_chips / self.shape.chips
+
+
+def _generation_of_accelerator(accelerator: str) -> str | None:
+    for gen in ("v4", "v5e", "v5p", "v6e"):
+        for s in shapes_for_generation(gen):
+            if s.accelerator_type == accelerator:
+                return gen
+    return None
+
+
+def shape_feasible_for_gang(shape: SliceShape, gang: Gang) -> str | None:
+    """Why ``gang`` cannot run on one ``shape`` slice, or None if it can.
+
+    A pod cannot span hosts, so total-chip arithmetic alone is not enough:
+    each member pod's chip request must fit one host, and there must be
+    enough host slots for all members (a host holds floor(chips_per_host /
+    per_pod_chips) members).  Without this check the planner would provision
+    a slice the scheduler can never bind, see it free next pass, and
+    provision another — a runaway loop.
+    """
+    chips = gang.tpu_chips
+    per_pod = int(gang.per_pod_resources.get(TPU_RESOURCE))
+    if chips > shape.chips:
+        return (f"demands {chips} chips, shape {shape.name} has "
+                f"{shape.chips}")
+    if per_pod > shape.chips_per_host:
+        return (f"pod requests {per_pod} chips but {shape.name} hosts "
+                f"expose {shape.chips_per_host}")
+    if per_pod > 0:
+        slots = shape.hosts * (shape.chips_per_host // per_pod)
+        if gang.size > slots:
+            return (f"{gang.size} pods need {gang.size} host slots, "
+                    f"{shape.name} has {slots}")
+    return None
+
+
+def choose_shape_for_gang(gang: Gang,
+                          default_generation: str = "v5e") -> ShapeChoice:
+    """Pick the slice shape for one pending TPU gang.
+
+    Resolution order:
+
+    1. Exact topology pin (`gke-tpu-topology` selector) — the gang said
+       precisely which ICI torus it wants; honor it, but fail loudly if the
+       gang can never fit it (a gang that can never schedule).
+    2. Accelerator pin only — smallest *feasible* shape of that generation
+       (stranded-chip objective, subject to per-host fit).
+    3. No TPU selectors — smallest feasible shape of the default generation.
+    """
+    selectors = gang.node_selectors
+    chips = gang.tpu_chips
+    if chips <= 0:
+        raise FitError(f"{gang} requests no TPU chips")
+
+    if TOPOLOGY_LABEL in selectors:
+        try:
+            shape = shape_from_selectors(selectors)
+        except KeyError as e:
+            raise FitError(str(e)) from None
+        assert shape is not None
+        problem = shape_feasible_for_gang(shape, gang)
+        if problem:
+            raise FitError(f"{gang} pins {shape.topology_label}: {problem}")
+        return ShapeChoice(shape, shape.chips - chips)
+
+    accelerator = selectors.get(ACCELERATOR_LABEL)
+    if accelerator is not None:
+        gen = _generation_of_accelerator(accelerator)
+        if gen is None:
+            raise FitError(f"unknown accelerator type {accelerator!r}")
+    else:
+        gen = default_generation
+
+    last_problem = None
+    for shape in shapes_for_generation(gen):
+        if shape.chips < chips:
+            continue
+        last_problem = shape_feasible_for_gang(shape, gang)
+        if last_problem is None:
+            return ShapeChoice(shape, shape.chips - chips)
+    raise FitError(
+        f"no {gen} shape can host {gang}: "
+        f"{last_problem or f'largest is {shapes_for_generation(gen)[-1].chips} chips'}")
+
+
+def free_capacity(nodes: list[Node], pods: list[Pod]) -> dict[str, ResourceVector]:
+    """Free allocatable per schedulable Ready node (allocatable - requests).
+
+    The baseline the fit engine subtracts existing supply with, mirroring how
+    the reference computed pool `actual_capacity` from live nodes
+    (agent_pool.py §AgentPool).
+    """
+    used: dict[str, ResourceVector] = {}
+    for pod in pods:
+        if pod.node_name and pod.phase in {"Pending", "Running"}:
+            used[pod.node_name] = used.get(pod.node_name,
+                                           ResourceVector()) + pod.resources
+    free: dict[str, ResourceVector] = {}
+    for node in nodes:
+        if node.is_ready and not node.unschedulable:
+            free[node.name] = node.allocatable - used.get(node.name,
+                                                          ResourceVector())
+    return free
+
+
+def pack_cpu_pods(pods: list[Pod], free: dict[str, ResourceVector],
+                  unit: CpuShape) -> tuple[int, list[Pod]]:
+    """First-fit pending CPU pods into free capacity.
+
+    Returns ``(new_nodes_needed, unplaceable_pods)``.  Reference parity:
+    cluster.py §Cluster.scale's "first-fit bin-packing of KubeResource
+    requests into whole-node units".  ``free`` is mutated as pods are placed
+    so callers pass a fresh copy.  Pods that could never fit even an empty
+    new unit are returned as unplaceable (never silently dropped, never
+    allowed to demand infinite nodes).
+    """
+    unit_capacity = ResourceVector(
+        {k: v for k, v in unit.node_capacity().items()})
+    new_units: list[ResourceVector] = []
+    unplaceable: list[Pod] = []
+    for pod in pods:
+        placed = False
+        for name, cap in free.items():
+            if pod.resources.fits_in(cap):
+                free[name] = cap - pod.resources
+                placed = True
+                break
+        if placed:
+            continue
+        for i, cap in enumerate(new_units):
+            if pod.resources.fits_in(cap):
+                new_units[i] = cap - pod.resources
+                placed = True
+                break
+        if placed:
+            continue
+        if pod.resources.fits_in(unit_capacity):
+            new_units.append(unit_capacity - pod.resources)
+        else:
+            unplaceable.append(pod)
+    return len(new_units), unplaceable

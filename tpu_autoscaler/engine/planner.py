@@ -1,0 +1,261 @@
+"""The scale planner: pending demand + live supply -> provisioning plan.
+
+Analog of the reference's cluster.py §Cluster.scale + scaler.py policy knobs
+(--over-provision, --spare-agents, pool max sizes), re-derived for
+slice-atomic supply.  The planner is a pure function of its inputs (gangs,
+nodes, pods, in-flight provisions, policy) so it is exhaustively unit-testable
+and the reconcile loop stays crash-only: desired state is recomputed from
+scratch every iteration (SURVEY.md §6.3).
+
+Idempotence replaces the reference's "one ARM deployment in flight"
+serialization (deployments.py): each provision request is tagged with the
+gang it serves, so a reconcile pass never double-provisions for a gang that
+already has a slice in flight — but *disjoint* gangs provision in parallel,
+which is what makes <6 min at 256 chips feasible (SURVEY.md §8 hard parts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from tpu_autoscaler.engine.fitter import (
+    FitError,
+    choose_shape_for_gang,
+    free_capacity,
+    pack_cpu_pods,
+)
+from tpu_autoscaler.k8s.gangs import Gang
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.topology.catalog import (
+    DEFAULT_CPU_SHAPE,
+    TPU_RESOURCE,
+    shape_by_name,
+)
+from tpu_autoscaler.topology.shapes import CpuShape
+
+log = logging.getLogger(__name__)
+
+GangKey = tuple[str, str, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolPolicy:
+    """Scaling policy knobs (reference parity: main.py flags, §3.1)."""
+
+    default_generation: str = "v5e"
+    cpu_shape: CpuShape = DEFAULT_CPU_SHAPE
+    # Extra CPU nodes beyond computed demand (reference: --over-provision).
+    over_provision_nodes: int = 0
+    # Min free CPU nodes kept warm (reference: --spare-agents, default 1).
+    spare_nodes: int = 1
+    # Warm spare slices per shape name, e.g. {"v5e-8": 1}.
+    spare_slices: dict[str, int] = dataclasses.field(default_factory=dict)
+    # Clamps (reference: AgentPool.max_size).
+    max_cpu_nodes: int = 100
+    max_total_chips: int = 4096
+    # Provision preemptible/spot TPU capacity (BASELINE config #5).
+    preemptible: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ProvisionRequest:
+    """One atomic provisioning action for the actuator."""
+
+    kind: str                      # "tpu-slice" | "cpu-node"
+    shape_name: str                # slice shape name or CPU machine type
+    count: int = 1                 # nodes for cpu-node; always 1 per slice
+    gang_key: GangKey | None = None  # demand this provision serves
+    reason: str = ""
+    preemptible: bool = False
+    stranded_chips: int = 0        # chips provisioned beyond chips requested
+
+
+@dataclasses.dataclass
+class ScalePlan:
+    requests: list[ProvisionRequest] = dataclasses.field(default_factory=list)
+    # Gangs no catalog shape / clamp allows; surfaced, never silently dropped.
+    unsatisfiable: list[tuple[Gang, str]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not self.requests
+
+    @property
+    def total_new_chips(self) -> int:
+        return sum(shape_by_name(r.shape_name).chips
+                   for r in self.requests if r.kind == "tpu-slice")
+
+
+@dataclasses.dataclass(frozen=True)
+class InFlight:
+    """A provision the actuator has accepted but not yet materialized.
+
+    The planner's view of actuator state — analog of the reference checking
+    its single in-flight ARM deployment's provisioning state
+    (deployments.py) before submitting another.
+    """
+
+    kind: str
+    shape_name: str
+    gang_key: GangKey | None = None
+    count: int = 1
+
+
+def _free_slices(nodes: list[Node], pods: list[Pod]) -> dict[str, list[Node]]:
+    """Fully-idle Ready TPU slices, keyed by slice id.
+
+    A slice counts as free supply only when *every* host is Ready,
+    schedulable, and has zero TPU chips in use — partial slices are never
+    supply (slice-atomicity: a half-busy slice can't take a new gang without
+    bisecting the ICI domain between jobs).
+    """
+    used_tpu: dict[str, float] = {}
+    for pod in pods:
+        if pod.node_name and pod.phase in {"Pending", "Running"}:
+            used_tpu[pod.node_name] = (used_tpu.get(pod.node_name, 0.0)
+                                       + pod.resources.get(TPU_RESOURCE))
+    by_slice: dict[str, list[Node]] = {}
+    for node in nodes:
+        if node.is_tpu and node.slice_id:
+            by_slice.setdefault(node.slice_id, []).append(node)
+    free: dict[str, list[Node]] = {}
+    for slice_id, members in by_slice.items():
+        if all(n.is_ready and not n.unschedulable
+               and used_tpu.get(n.name, 0.0) == 0 for n in members):
+            free[slice_id] = members
+    return free
+
+
+def _slice_satisfies(members: list[Node], gang: Gang) -> bool:
+    selectors = gang.node_selectors
+    if not all(n.matches_selectors(selectors) for n in members):
+        return False
+    total_chips = sum(int(n.allocatable.get(TPU_RESOURCE)) for n in members)
+    if total_chips < gang.tpu_chips:
+        return False
+    # Each member pod must fit on one host of this slice.
+    return any(gang.per_pod_resources.fits_in(n.allocatable) for n in members)
+
+
+class Planner:
+    def __init__(self, policy: PoolPolicy | None = None):
+        self.policy = policy or PoolPolicy()
+
+    def plan(self, gangs: list[Gang], nodes: list[Node], pods: list[Pod],
+             in_flight: list[InFlight] = ()) -> ScalePlan:
+        plan = ScalePlan()
+        pol = self.policy
+
+        tpu_gangs = [g for g in gangs if g.requests_tpu]
+        cpu_pods = [p for g in gangs if not g.requests_tpu for p in g.pods]
+
+        # ---- TPU path: one slice per unserved gang -----------------------
+        free = _free_slices(nodes, pods)
+        claimed: set[str] = set()
+        served_keys = {f.gang_key for f in in_flight if f.gang_key}
+        existing_chips = sum(int(n.allocatable.get(TPU_RESOURCE))
+                             for n in nodes if n.is_tpu)
+        inflight_chips = sum(shape_by_name(f.shape_name).chips
+                             for f in in_flight if f.kind == "tpu-slice")
+        planned_chips = 0
+
+        for gang in tpu_gangs:
+            if gang.key in served_keys:
+                continue  # already provisioning for this gang: idempotence
+            # An existing fully-free matching slice satisfies the gang; the
+            # scheduler will bind it — provisioning would strand chips.
+            matched = next(
+                (sid for sid, members in free.items()
+                 if sid not in claimed and _slice_satisfies(members, gang)),
+                None)
+            if matched is not None:
+                claimed.add(matched)
+                continue
+            try:
+                choice = choose_shape_for_gang(gang, pol.default_generation)
+            except FitError as e:
+                plan.unsatisfiable.append((gang, str(e)))
+                continue
+            new_total = (existing_chips + inflight_chips + planned_chips
+                         + choice.shape.chips)
+            if new_total > pol.max_total_chips:
+                plan.unsatisfiable.append(
+                    (gang, f"would exceed max_total_chips="
+                           f"{pol.max_total_chips} (at {new_total})"))
+                continue
+            planned_chips += choice.shape.chips
+            plan.requests.append(ProvisionRequest(
+                kind="tpu-slice", shape_name=choice.shape.name,
+                gang_key=gang.key, preemptible=pol.preemptible,
+                stranded_chips=choice.stranded_chips,
+                reason=(f"gang {gang.name}: {gang.tpu_chips} chips, "
+                        f"{choice.stranded_chips} stranded")))
+
+        # ---- warm spare slices (reference --spare-agents, per shape) -----
+        for shape_name, want in pol.spare_slices.items():
+            shape = shape_by_name(shape_name)
+            have_free = sum(
+                1 for sid, members in free.items()
+                if sid not in claimed
+                and all(n.tpu_accelerator == shape.accelerator_type
+                        and n.tpu_topology == shape.topology_label
+                        for n in members))
+            have_inflight = sum(1 for f in in_flight
+                                if f.kind == "tpu-slice" and f.gang_key is None
+                                and f.shape_name == shape_name)
+            for _ in range(max(0, want - have_free - have_inflight)):
+                if (existing_chips + inflight_chips + planned_chips
+                        + shape.chips) > pol.max_total_chips:
+                    break
+                planned_chips += shape.chips
+                plan.requests.append(ProvisionRequest(
+                    kind="tpu-slice", shape_name=shape_name,
+                    preemptible=pol.preemptible,
+                    reason=f"spare slice policy ({want} warm {shape_name})"))
+
+        # ---- CPU path: first-fit pack, then spare + over-provision -------
+        cpu_nodes = [n for n in nodes if not n.is_tpu]
+        free_cpu = free_capacity(cpu_nodes, pods)
+        pending_cpu = [p for p in cpu_pods if p.is_unschedulable]
+        inflight_cpu = sum(f.count for f in in_flight
+                           if f.kind == "cpu-node")
+        demand_needed, unplaceable = pack_cpu_pods(pending_cpu, free_cpu,
+                                                   pol.cpu_shape)
+        if unplaceable:
+            gang_by_key = {g.key: g for g in gangs}
+            reported: set[GangKey] = set()
+            for pod in unplaceable:
+                if pod.gang_key in reported:
+                    continue
+                reported.add(pod.gang_key)
+                plan.unsatisfiable.append((
+                    gang_by_key.get(pod.gang_key,
+                                    Gang(key=pod.gang_key, pods=[pod])),
+                    f"pod {pod.name} requests {pod.resources!r}, larger "
+                    f"than one {pol.cpu_shape.machine_type} node"))
+        if demand_needed:
+            demand_needed += pol.over_provision_nodes
+        demand_needed = max(0, demand_needed - inflight_cpu)
+        # Spare: keep at least N workload-free CPU nodes warm.  "Free" means
+        # no non-daemonset/non-mirror pods — daemonsets run on every node
+        # and must not disqualify a node from being spare.
+        workload_nodes = {
+            p.node_name for p in pods
+            if p.node_name and p.phase in {"Pending", "Running"}
+            and not p.is_daemonset and not p.is_mirrored}
+        fully_free = sum(
+            1 for n in cpu_nodes
+            if n.is_ready and not n.unschedulable
+            and n.name not in workload_nodes)
+        spare_needed = max(0, pol.spare_nodes - fully_free - inflight_cpu)
+        room = max(0, pol.max_cpu_nodes - len(cpu_nodes) - inflight_cpu)
+        needed = min(max(demand_needed, spare_needed), room)
+        if needed:
+            plan.requests.append(ProvisionRequest(
+                kind="cpu-node", shape_name=pol.cpu_shape.machine_type,
+                count=needed,
+                reason=(f"{len(pending_cpu)} pending CPU pods, "
+                        f"spare={pol.spare_nodes}")))
+        return plan

@@ -1,0 +1,23 @@
+"""Kubernetes object model (L3a).
+
+TPU-native analog of the reference's ``autoscaler/kube.py``: value-object
+wrappers over raw API payload dicts with resource arithmetic, selector
+matching, pending-pod detection, and gang/JobSet awareness.  Unlike the
+reference (pykube objects), these wrappers take plain dicts so every layer
+is constructible from JSON fixtures, and all API verbs go through an
+abstract client interface (``tpu_autoscaler.k8s.client``) that the fake
+apiserver also implements.
+"""
+
+from tpu_autoscaler.k8s.resources import ResourceVector, parse_quantity
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.gangs import Gang, group_into_gangs
+
+__all__ = [
+    "Gang",
+    "Node",
+    "Pod",
+    "ResourceVector",
+    "group_into_gangs",
+    "parse_quantity",
+]

@@ -1,0 +1,138 @@
+"""In-memory fake apiserver + toy scheduler.
+
+The reference had no e2e story at all — cloud and kube were mock.Mock() and
+`--dry-run` was the manual integration path (SURVEY.md §5).  This fake
+implements the same ``KubeClient`` protocol as the real REST client plus a
+minimal kube-scheduler model (bind pending pods to fitting nodes), so the
+whole control loop runs end-to-end in-process: pending pod → plan →
+provision → nodes Ready → bind → Running.  That loop test is how the
+north-star latency metric is exercised without a cluster.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.resources import ResourceVector
+
+
+class FakeKube:
+    """Fake apiserver: payload-dict store implementing KubeClient."""
+
+    def __init__(self):
+        self._nodes: dict[str, dict] = {}
+        self._pods: dict[tuple[str, str], dict] = {}
+        self._uid = itertools.count(1)
+        self.verb_log: list[tuple] = []
+
+    # ---- KubeClient protocol -------------------------------------------
+
+    def list_nodes(self) -> list[dict]:
+        return list(self._nodes.values())
+
+    def list_pods(self) -> list[dict]:
+        return list(self._pods.values())
+
+    def patch_node(self, name: str, patch: dict) -> None:
+        self.verb_log.append(("patch_node", name, patch))
+        node = self._nodes[name]
+        spec = patch.get("spec") or {}
+        if "unschedulable" in spec:
+            node.setdefault("spec", {})["unschedulable"] = \
+                spec["unschedulable"]
+        meta = patch.get("metadata") or {}
+        for key in ("annotations", "labels"):
+            if key in meta:
+                node.setdefault("metadata", {}).setdefault(key, {}).update(
+                    meta[key])
+
+    def patch_pod(self, namespace: str, name: str, patch: dict) -> None:
+        self.verb_log.append(("patch_pod", namespace, name, patch))
+        pod = self._pods[(namespace, name)]
+        meta = patch.get("metadata") or {}
+        for key in ("annotations", "labels"):
+            if key in meta:
+                pod.setdefault("metadata", {}).setdefault(key, {}).update(
+                    meta[key])
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        self.verb_log.append(("evict", namespace, name))
+        self._pods.pop((namespace, name), None)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.verb_log.append(("delete_pod", namespace, name))
+        self._pods.pop((namespace, name), None)
+
+    def delete_node(self, name: str) -> None:
+        self.verb_log.append(("delete_node", name))
+        self._nodes.pop(name, None)
+
+    # ---- fixture mutators ----------------------------------------------
+
+    def add_node(self, payload: dict) -> None:
+        payload.setdefault("metadata", {}).setdefault(
+            "uid", f"fake-{next(self._uid)}")
+        self._nodes[payload["metadata"]["name"]] = payload
+
+    def add_pod(self, payload: dict) -> None:
+        meta = payload.setdefault("metadata", {})
+        meta.setdefault("uid", f"fake-{next(self._uid)}")
+        self._pods[(meta.get("namespace", "default"), meta["name"])] = payload
+
+    def get_pod(self, namespace: str, name: str) -> dict | None:
+        return self._pods.get((namespace, name))
+
+    def set_node_ready(self, name: str, ready: bool) -> None:
+        conds = self._nodes[name]["status"].setdefault("conditions", [])
+        for c in conds:
+            if c.get("type") == "Ready":
+                c["status"] = "True" if ready else "False"
+                return
+        conds.append({"type": "Ready", "status": "True" if ready else "False"})
+
+    # ---- toy kube-scheduler --------------------------------------------
+
+    def schedule_step(self) -> int:
+        """One scheduling pass: bind pending pods to fitting nodes.
+
+        Models just enough of kube-scheduler for the loop test: selector
+        match + resource fit against free allocatable; bound pods go
+        straight to Running.  Unbindable pods get/keep the Unschedulable
+        condition, which is exactly the demand signal the autoscaler reads.
+        Returns the number of pods bound this pass.
+        """
+        nodes = [Node(p) for p in self._nodes.values()]
+        pods = [Pod(p) for p in self._pods.values()]
+        free: dict[str, ResourceVector] = {}
+        for n in nodes:
+            if n.is_ready and not n.unschedulable:
+                free[n.name] = n.allocatable
+        for p in pods:
+            if p.node_name and p.node_name in free:
+                free[p.node_name] = free[p.node_name] - p.resources
+
+        bound = 0
+        for p in sorted((p for p in pods if not p.node_name
+                         and p.phase == "Pending"),
+                        key=lambda p: (p.created is None,
+                                       p.created.timestamp() if p.created
+                                       else 0, p.name)):
+            target = next(
+                (n for n in nodes
+                 if n.name in free and n.matches_selectors(p.node_selectors)
+                 and p.resources.fits_in(free[n.name])), None)
+            payload = self._pods[(p.namespace, p.name)]
+            if target is None:
+                conds = payload["status"].setdefault("conditions", [])
+                if not any(c.get("type") == "PodScheduled" for c in conds):
+                    conds.append({"type": "PodScheduled", "status": "False",
+                                  "reason": "Unschedulable"})
+                continue
+            free[target.name] = free[target.name] - p.resources
+            payload["spec"]["nodeName"] = target.name
+            payload["status"]["phase"] = "Running"
+            payload["status"]["conditions"] = [
+                {"type": "PodScheduled", "status": "True"}]
+            bound += 1
+        return bound

@@ -1,0 +1,111 @@
+"""Gang detection: group pending pods into atomic demand units.
+
+The reference scaled per-pod (cluster.py §Cluster.scale: first-fit each
+pending pod into a pool).  For TPUs that is wrong: a multi-host JAX job is a
+*gang* — N pods that must all land on one ICI slice simultaneously, so the
+demand unit presented to the fit engine is the gang, not the pod
+(SURVEY.md §6.7, §8.2).  One Kubernetes Job == one gang == one slice; a
+JobSet with replicated jobs is one gang per slice (multi-slice over DCN,
+BASELINE config #4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+from tpu_autoscaler.k8s.objects import Pod
+from tpu_autoscaler.k8s.resources import ResourceVector
+from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+
+@dataclasses.dataclass
+class Gang:
+    """An atomic demand unit: one or more pods that schedule together."""
+
+    key: tuple[str, str, str]
+    pods: list[Pod]
+
+    @property
+    def size(self) -> int:
+        return len(self.pods)
+
+    @property
+    def namespace(self) -> str:
+        return self.key[1]
+
+    @property
+    def name(self) -> str:
+        return self.key[2]
+
+    @property
+    def total_resources(self) -> ResourceVector:
+        total = ResourceVector()
+        for p in self.pods:
+            total = total + p.resources
+        return total
+
+    @property
+    def per_pod_resources(self) -> ResourceVector:
+        """Request of one member pod (gang members are homogeneous; if they
+        are not, the max per axis is the safe envelope)."""
+        if not self.pods:
+            return ResourceVector()
+        envelope: dict[str, float] = {}
+        for p in self.pods:
+            for k, v in p.resources.as_dict().items():
+                envelope[k] = max(envelope.get(k, 0.0), v)
+        return ResourceVector(envelope)
+
+    @property
+    def tpu_chips(self) -> int:
+        """Total chips the gang demands across all its pods."""
+        return int(self.total_resources.get(TPU_RESOURCE))
+
+    @property
+    def requests_tpu(self) -> bool:
+        return self.tpu_chips > 0
+
+    @property
+    def node_selectors(self) -> dict[str, str]:
+        """Merged nodeSelector across members.
+
+        Members of a real gang share a pod template so selectors agree; on
+        conflict the union is taken (a node must satisfy all), which can only
+        make the fit more conservative.
+        """
+        merged: dict[str, str] = {}
+        for p in self.pods:
+            merged.update(p.node_selectors)
+        return merged
+
+    @property
+    def jobset_name(self) -> str | None:
+        return self.pods[0].jobset_name if self.pods else None
+
+    @property
+    def oldest_created(self):
+        times = [p.created for p in self.pods if p.created is not None]
+        return min(times) if times else None
+
+    def __repr__(self) -> str:
+        return (f"Gang({self.key}, pods={self.size}, "
+                f"chips={self.tpu_chips})")
+
+
+def group_into_gangs(pods: Iterable[Pod]) -> list[Gang]:
+    """Group pods into gangs by gang_key, oldest demand first.
+
+    Ordering matters for fairness under capacity clamps: like the reference's
+    loop (cluster.py §Cluster.scale iterated pods in list order), we serve
+    the longest-waiting demand first — but at gang granularity.
+    """
+    by_key: dict[tuple[str, str, str], list[Pod]] = {}
+    for pod in pods:
+        by_key.setdefault(pod.gang_key, []).append(pod)
+    gangs = [Gang(key=k, pods=v) for k, v in by_key.items()]
+    # Gangs with no timestamp sort last; ties break by key for determinism.
+    gangs.sort(key=lambda g: ((g.oldest_created is None),
+                              g.oldest_created.timestamp() if g.oldest_created else 0.0,
+                              g.key))
+    return gangs

@@ -1,0 +1,314 @@
+"""Pod and Node value objects over raw Kubernetes API payloads.
+
+Analog of the reference's kube.py §KubePod / §KubeNode, with three deliberate
+changes for the TPU-native design:
+
+- Built from plain dicts (JSON payloads), not pykube objects, so every test
+  layer constructs fixtures directly (SURVEY.md §5) and the same objects work
+  against the real apiserver and the in-memory fake.
+- Nodes know their *slice membership* (``autoscaler.tpu.dev/slice-id`` /
+  GKE node-pool label): the unit of scale-down is the slice, never the node.
+- Drain uses the eviction API (the reference predates it and raw-deleted
+  pods; kube.py §KubeNode.drain).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import TYPE_CHECKING, Mapping, Sequence
+
+from tpu_autoscaler.k8s.resources import ResourceVector
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    INSTANCE_TYPE_LABEL,
+    POOL_LABEL,
+    SLICE_ID_LABEL,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+)
+
+if TYPE_CHECKING:
+    from tpu_autoscaler.k8s.client import KubeClient
+
+# Legacy instance-type label still seen on older nodes (and the one the
+# reference keyed on: kube.py §KubeNode.instance_type).
+_LEGACY_INSTANCE_TYPE_LABEL = "beta.kubernetes.io/instance-type"
+
+# Pods that opt out of autoscaler-driven eviction, same contract as the
+# upstream cluster-autoscaler uses.
+SAFE_TO_EVICT_ANNOTATION = "cluster-autoscaler.kubernetes.io/safe-to-evict"
+MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
+
+# Gang-identity labels (JobSet / Job machinery).
+JOBSET_NAME_LABEL = "jobset.sigs.k8s.io/jobset-name"
+JOBSET_JOB_INDEX_LABEL = "jobset.sigs.k8s.io/job-index"
+JOB_NAME_LABEL = "batch.kubernetes.io/job-name"
+_LEGACY_JOB_NAME_LABEL = "job-name"
+
+
+def parse_time(value: str | None) -> datetime.datetime | None:
+    """Parse an RFC3339 timestamp as emitted by the Kubernetes API."""
+    if not value:
+        return None
+    return datetime.datetime.fromisoformat(value.replace("Z", "+00:00"))
+
+
+class Pod:
+    """One pod, read-only view plus delete/evict verbs."""
+
+    def __init__(self, payload: Mapping):
+        self._p = payload
+        meta = payload.get("metadata", {})
+        self.name: str = meta.get("name", "")
+        self.namespace: str = meta.get("namespace", "default")
+        self.uid: str = meta.get("uid", "")
+        self.labels: dict[str, str] = dict(meta.get("labels") or {})
+        self.annotations: dict[str, str] = dict(meta.get("annotations") or {})
+        self.created = parse_time(meta.get("creationTimestamp"))
+        self._owners = meta.get("ownerReferences") or []
+        spec = payload.get("spec", {})
+        self.node_name: str | None = spec.get("nodeName")
+        self.node_selectors: dict[str, str] = dict(spec.get("nodeSelector") or {})
+        self.priority_class: str | None = spec.get("priorityClassName")
+        self.resources = self._sum_requests(spec)
+        status = payload.get("status", {})
+        self.phase: str = status.get("phase", "")
+        self._conditions = status.get("conditions") or []
+
+    @staticmethod
+    def _sum_requests(spec: Mapping) -> ResourceVector:
+        """Effective pod request: sum(containers) ∨ max(initContainers).
+
+        The reference summed container requests (kube.py §KubePod);
+        Kubernetes' effective-request rule additionally lower-bounds by each
+        init container, which matters for nothing TPU-specific but is the
+        correct algebra.
+        """
+        total = ResourceVector({"pods": 1})
+        for c in spec.get("containers") or []:
+            total = total + ResourceVector.from_raw(
+                (c.get("resources") or {}).get("requests")
+            )
+        for c in spec.get("initContainers") or []:
+            init = ResourceVector.from_raw(
+                (c.get("resources") or {}).get("requests")
+            )
+            bumped = {
+                k: max(total.get(k), init.get(k))
+                for k in set(total.as_dict()) | set(init.as_dict())
+            }
+            total = ResourceVector(bumped)
+        return total
+
+    # -- classification (reference: kube.py §KubePod is_mirrored/is_replicated
+    #    /is_critical) ------------------------------------------------------
+
+    @property
+    def owner_kind(self) -> str | None:
+        return self._owners[0].get("kind") if self._owners else None
+
+    @property
+    def is_mirrored(self) -> bool:
+        return MIRROR_ANNOTATION in self.annotations
+
+    @property
+    def is_daemonset(self) -> bool:
+        return self.owner_kind == "DaemonSet"
+
+    @property
+    def is_replicated(self) -> bool:
+        return self.owner_kind in {"ReplicaSet", "ReplicationController",
+                                   "StatefulSet", "Job", "JobSet"}
+
+    @property
+    def is_critical(self) -> bool:
+        if self.priority_class in {"system-cluster-critical",
+                                   "system-node-critical"}:
+            return True
+        return self.annotations.get(SAFE_TO_EVICT_ANNOTATION) == "false"
+
+    @property
+    def is_drainable(self) -> bool:
+        """Evictable during a drain: replicated, not mirror/DS/critical."""
+        return (self.is_replicated and not self.is_mirrored
+                and not self.is_daemonset and not self.is_critical)
+
+    # -- scheduling state (reference: cluster.py §get_pending_pods) ---------
+
+    @property
+    def is_scheduled(self) -> bool:
+        return bool(self.node_name) and self.phase in {"Pending", "Running"}
+
+    @property
+    def is_unschedulable(self) -> bool:
+        """Pending with the scheduler's Unschedulable verdict — the demand
+        signal that drives scale-up."""
+        if self.phase != "Pending" or self.node_name:
+            return False
+        for cond in self._conditions:
+            if (cond.get("type") == "PodScheduled"
+                    and cond.get("status") == "False"
+                    and cond.get("reason") == "Unschedulable"):
+                return True
+        return False
+
+    # -- TPU demand ---------------------------------------------------------
+
+    @property
+    def tpu_chips(self) -> int:
+        return int(self.resources.get(TPU_RESOURCE))
+
+    @property
+    def requests_tpu(self) -> bool:
+        return self.tpu_chips > 0
+
+    @property
+    def tpu_accelerator(self) -> str | None:
+        return self.node_selectors.get(ACCELERATOR_LABEL)
+
+    @property
+    def tpu_topology(self) -> str | None:
+        return self.node_selectors.get(TOPOLOGY_LABEL)
+
+    # -- gang identity ------------------------------------------------------
+
+    @property
+    def gang_key(self) -> tuple[str, str, str]:
+        """Demand-unit identity: pods sharing a key are one gang.
+
+        One Kubernetes Job == one gang == one slice (a JobSet's replicated
+        jobs are separate gangs, one per slice; BASELINE config #4).  Solo
+        pods are singleton gangs.
+        """
+        job = self.labels.get(JOB_NAME_LABEL) or self.labels.get(
+            _LEGACY_JOB_NAME_LABEL)
+        if job:
+            return ("job", self.namespace, job)
+        jobset = self.labels.get(JOBSET_NAME_LABEL)
+        if jobset:
+            idx = self.labels.get(JOBSET_JOB_INDEX_LABEL, "0")
+            return ("jobset", self.namespace, f"{jobset}/{idx}")
+        return ("pod", self.namespace, self.name)
+
+    @property
+    def jobset_name(self) -> str | None:
+        return self.labels.get(JOBSET_NAME_LABEL)
+
+    # -- verbs --------------------------------------------------------------
+
+    def evict(self, client: "KubeClient") -> None:
+        client.evict_pod(self.namespace, self.name)
+
+    def delete(self, client: "KubeClient") -> None:
+        client.delete_pod(self.namespace, self.name)
+
+    def __repr__(self) -> str:
+        return f"Pod({self.namespace}/{self.name}, phase={self.phase})"
+
+
+class Node:
+    """One node, read-only view plus cordon/uncordon/drain verbs."""
+
+    def __init__(self, payload: Mapping):
+        self._p = payload
+        meta = payload.get("metadata", {})
+        self.name: str = meta.get("name", "")
+        self.uid: str = meta.get("uid", "")
+        self.labels: dict[str, str] = dict(meta.get("labels") or {})
+        self.annotations: dict[str, str] = dict(meta.get("annotations") or {})
+        self.created = parse_time(meta.get("creationTimestamp"))
+        spec = payload.get("spec", {})
+        self.unschedulable: bool = bool(spec.get("unschedulable", False))
+        status = payload.get("status", {})
+        self.allocatable = ResourceVector.from_raw(
+            status.get("allocatable") or status.get("capacity"))
+        self._conditions = status.get("conditions") or []
+
+    @property
+    def instance_type(self) -> str | None:
+        """Machine type from the well-known label (reference: kube.py
+        §KubeNode.instance_type via beta.kubernetes.io/instance-type)."""
+        return (self.labels.get(INSTANCE_TYPE_LABEL)
+                or self.labels.get(_LEGACY_INSTANCE_TYPE_LABEL))
+
+    @property
+    def is_ready(self) -> bool:
+        for cond in self._conditions:
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return False
+
+    # -- slice / pool membership -------------------------------------------
+
+    @property
+    def slice_id(self) -> str | None:
+        """Identity of the ICI slice this host belongs to.
+
+        Preference order: our explicit slice label, then the GKE node-pool
+        label (one multi-host TPU node pool == one slice in GKE semantics).
+        CPU nodes in autoscaler-managed pools also carry the pool label but
+        each is its own unit; the state layer handles that distinction.
+        """
+        return (self.labels.get(SLICE_ID_LABEL)
+                or self.labels.get("cloud.google.com/gke-nodepool"))
+
+    @property
+    def pool(self) -> str | None:
+        return self.labels.get(POOL_LABEL)
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.allocatable.get(TPU_RESOURCE) > 0
+
+    @property
+    def tpu_accelerator(self) -> str | None:
+        return self.labels.get(ACCELERATOR_LABEL)
+
+    @property
+    def tpu_topology(self) -> str | None:
+        return self.labels.get(TOPOLOGY_LABEL)
+
+    # -- fit + selector matching (reference: kube.py §KubeNode.can_fit /
+    #    .is_match) ---------------------------------------------------------
+
+    def can_fit(self, request: ResourceVector) -> bool:
+        return request.fits_in(self.allocatable)
+
+    def matches_selectors(self, selectors: Mapping[str, str]) -> bool:
+        return all(self.labels.get(k) == v for k, v in selectors.items())
+
+    # -- verbs --------------------------------------------------------------
+
+    def cordon(self, client: "KubeClient") -> None:
+        client.patch_node(self.name, {"spec": {"unschedulable": True}})
+
+    def uncordon(self, client: "KubeClient") -> None:
+        client.patch_node(self.name, {"spec": {"unschedulable": False}})
+
+    def drain(self, client: "KubeClient", pods: Sequence[Pod]) -> int:
+        """Evict all drainable pods on this node; returns count evicted.
+
+        Mirror/daemonset/critical pods are skipped, as in the reference
+        (kube.py §KubeNode.drain), but via the eviction API so
+        PodDisruptionBudgets are honored by the apiserver.
+        """
+        import logging
+
+        evicted = 0
+        for pod in pods:
+            if pod.node_name == self.name and pod.is_drainable:
+                try:
+                    pod.evict(client)
+                    evicted += 1
+                except Exception:  # noqa: BLE001 — e.g. 429 from a PDB;
+                    # other pods (and other units) must still drain.
+                    logging.getLogger(__name__).warning(
+                        "eviction of %s/%s blocked (PDB?); will retry",
+                        pod.namespace, pod.name, exc_info=True)
+        return evicted
+
+    def delete(self, client: "KubeClient") -> None:
+        client.delete_node(self.name)
+
+    def __repr__(self) -> str:
+        return f"Node({self.name}, type={self.instance_type})"

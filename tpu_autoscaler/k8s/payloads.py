@@ -1,0 +1,86 @@
+"""Builders for node payloads the fake actuator materializes.
+
+Kept in the package (not test fixtures) because the fake actuator is a
+product surface: it powers `--fake-cloud` demo mode and the e2e loop tests.
+Payload shape mirrors what GKE writes for real TPU node pools (labels per
+the accelerator/topology contract, `google.com/tpu` in allocatable).
+"""
+
+from __future__ import annotations
+
+import datetime
+
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    INSTANCE_TYPE_LABEL,
+    POOL_LABEL,
+    SLICE_ID_LABEL,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+)
+from tpu_autoscaler.topology.shapes import CpuShape, SliceShape
+
+
+def _iso(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, tz=datetime.timezone.utc).isoformat().replace("+00:00", "Z")
+
+
+def tpu_host_payload(shape: SliceShape, slice_id: str, host_index: int,
+                     created_at: float, *, pool: str | None = None,
+                     ready: bool = True, preemptible: bool = False) -> dict:
+    labels = {
+        ACCELERATOR_LABEL: shape.accelerator_type,
+        TOPOLOGY_LABEL: shape.topology_label,
+        INSTANCE_TYPE_LABEL: shape.machine_type,
+        SLICE_ID_LABEL: slice_id,
+    }
+    if pool:
+        labels[POOL_LABEL] = pool
+    if preemptible:
+        labels["cloud.google.com/gke-spot"] = "true"
+    return {
+        "metadata": {
+            "name": f"{slice_id}-h{host_index}",
+            "labels": labels,
+            "creationTimestamp": _iso(created_at),
+        },
+        "spec": {},
+        "status": {
+            "allocatable": {
+                "cpu": f"{shape.host_cpu_m}m",
+                "memory": str(shape.host_memory),
+                "pods": str(shape.host_pods),
+                TPU_RESOURCE: str(shape.chips_per_host),
+            },
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}],
+        },
+    }
+
+
+def cpu_node_payload(shape: CpuShape, unit_id: str, created_at: float, *,
+                     pool: str | None = None, ready: bool = True) -> dict:
+    labels = {
+        INSTANCE_TYPE_LABEL: shape.machine_type,
+        SLICE_ID_LABEL: unit_id,
+    }
+    if pool:
+        labels[POOL_LABEL] = pool
+    return {
+        "metadata": {
+            "name": unit_id,
+            "labels": labels,
+            "creationTimestamp": _iso(created_at),
+        },
+        "spec": {},
+        "status": {
+            "allocatable": {
+                "cpu": f"{shape.cpu_m}m",
+                "memory": str(shape.memory),
+                "pods": str(shape.pods),
+            },
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}],
+        },
+    }

@@ -1,0 +1,132 @@
+"""Kubernetes resource-quantity algebra.
+
+The heart of the fit math — analog of the reference's kube.py §KubeResource
+(dict-vector with +, -, scalar *, and a "fits" comparison; SI/k8s unit
+parsing m/Ki/Mi/Gi).  Extended for ``google.com/tpu`` chips, the TPU analog
+of the reference's ``alpha.kubernetes.io/nvidia-gpu``.
+
+Canonical units: cpu in cores (float), memory in bytes (float), extended
+resources in counts (float).  All quantities are parsed per the Kubernetes
+quantity grammar: decimal SI suffixes (k, M, G, T, P, E), binary suffixes
+(Ki, Mi, Gi, Ti, Pi, Ei), milli suffix (m), and scientific notation.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping
+
+_BINARY_SUFFIXES = {
+    "Ki": 1024.0,
+    "Mi": 1024.0**2,
+    "Gi": 1024.0**3,
+    "Ti": 1024.0**4,
+    "Pi": 1024.0**5,
+    "Ei": 1024.0**6,
+}
+_DECIMAL_SUFFIXES = {
+    "n": 1e-9,
+    "u": 1e-6,
+    "m": 1e-3,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "E": 1e18,
+}
+
+
+def parse_quantity(value: str | int | float) -> float:
+    """Parse one Kubernetes quantity ('100m', '2', '128Mi', '1e3') to float."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = value.strip()
+    if not s:
+        raise ValueError("empty quantity")
+    for suffix, mult in _BINARY_SUFFIXES.items():
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * mult
+    # 'E' doubles as the exponent marker ('1E3'); only treat it as the exa
+    # suffix when what precedes it is a complete number on its own.
+    if s[-1] in _DECIMAL_SUFFIXES:
+        head = s[:-1]
+        try:
+            return float(head) * _DECIMAL_SUFFIXES[s[-1]]
+        except ValueError:
+            pass  # fall through: e.g. '1E3' -> float('1E3')
+    return float(s)
+
+
+class ResourceVector:
+    """Immutable-ish resource vector with elementwise arithmetic.
+
+    Keys are k8s resource names ('cpu', 'memory', 'pods', 'google.com/tpu',
+    ...); missing keys read as 0.  Comparison semantics follow the
+    reference's KubeResource: ``a.fits_in(b)`` iff every requested amount in
+    ``a`` is <= the corresponding capacity in ``b``.
+    """
+
+    __slots__ = ("_r",)
+
+    def __init__(self, raw: Mapping[str, str | int | float] | None = None, **kw):
+        merged: dict[str, float] = {}
+        for src in (raw or {}), kw:
+            for k, v in src.items():
+                merged[k] = merged.get(k, 0.0) + parse_quantity(v)
+        # Zero entries are dropped so equality/emptiness are canonical.
+        self._r = {k: v for k, v in merged.items() if v != 0.0}
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, object] | None) -> "ResourceVector":
+        return cls(raw or {})  # type: ignore[arg-type]
+
+    def get(self, key: str) -> float:
+        return self._r.get(key, 0.0)
+
+    def keys(self) -> Iterator[str]:
+        return iter(self._r.keys())
+
+    def as_dict(self) -> dict[str, float]:
+        return dict(self._r)
+
+    @property
+    def empty(self) -> bool:
+        return not any(v > 0 for v in self._r.values())
+
+    def __add__(self, other: "ResourceVector") -> "ResourceVector":
+        out = dict(self._r)
+        for k, v in other._r.items():
+            out[k] = out.get(k, 0.0) + v
+        return ResourceVector(out)
+
+    def __sub__(self, other: "ResourceVector") -> "ResourceVector":
+        out = dict(self._r)
+        for k, v in other._r.items():
+            out[k] = out.get(k, 0.0) - v
+        return ResourceVector(out)
+
+    def __mul__(self, scalar: float) -> "ResourceVector":
+        return ResourceVector({k: v * scalar for k, v in self._r.items()})
+
+    __rmul__ = __mul__
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, ResourceVector):
+            return NotImplemented
+        return self._r == other._r
+
+    def __hash__(self) -> int:
+        return hash(frozenset(self._r.items()))
+
+    def fits_in(self, capacity: "ResourceVector") -> bool:
+        """True iff this request fits inside ``capacity`` on every axis.
+
+        A positive request for a resource the capacity lacks entirely (e.g.
+        google.com/tpu on a CPU node) does not fit — this is how TPU pods
+        are excluded from CPU pools without any special-casing.
+        """
+        return all(v <= capacity.get(k) for k, v in self._r.items() if v > 0)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}={v:g}" for k, v in sorted(self._r.items()))
+        return f"ResourceVector({inner})"

@@ -1,0 +1,195 @@
+"""CLI / process entry (L5).
+
+Reference parity: main.py's click-decorated ``main()`` with flags → Cluster
+ctor → loop-with-sleep (SURVEY.md §3.1).  Differences, deliberate:
+
+- subcommands: ``run`` (real cluster), ``demo`` (fake cloud, simulated
+  time — the dry-run-plus story the reference lacked);
+- the loop interval defaults to 5 s, not 60 s: detection latency is part of
+  the north-star budget;
+- a metrics endpoint (``--metrics-port``) exports the BASELINE metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import click
+
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.engine.planner import PoolPolicy
+from tpu_autoscaler.metrics import Metrics
+from tpu_autoscaler.notify import LogNotifier, SlackNotifier
+from tpu_autoscaler.topology.catalog import cpu_shape_by_name
+
+
+def _policy(default_generation, cpu_machine_type, over_provision,
+            spare_agents, spare_slices, max_cpu_nodes, max_total_chips,
+            preemptible) -> PoolPolicy:
+    from tpu_autoscaler.topology.catalog import SLICE_SHAPES
+
+    spares: dict[str, int] = {}
+    for item in spare_slices:
+        shape, _, count = item.partition("=")
+        if shape not in SLICE_SHAPES:
+            raise click.BadParameter(
+                f"unknown slice shape {shape!r} (known: "
+                f"{', '.join(sorted(SLICE_SHAPES))})",
+                param_hint="--spare-slice")
+        try:
+            spares[shape] = int(count or "1")
+        except ValueError:
+            raise click.BadParameter(
+                f"bad count in {item!r}; expected SHAPE=N",
+                param_hint="--spare-slice") from None
+    return PoolPolicy(
+        default_generation=default_generation,
+        cpu_shape=cpu_shape_by_name(cpu_machine_type),
+        over_provision_nodes=over_provision,
+        spare_nodes=spare_agents,
+        spare_slices=spares,
+        max_cpu_nodes=max_cpu_nodes,
+        max_total_chips=max_total_chips,
+        preemptible=preemptible,
+    )
+
+
+_common = [
+    click.option("--sleep", default=5.0, show_default=True,
+                 type=click.FloatRange(min=0.1),
+                 help="Reconcile interval seconds (reference: --sleep, 60)."),
+    click.option("--idle-threshold", default=1800.0, show_default=True,
+                 help="Seconds idle before a unit is reclaimed."),
+    click.option("--grace-period", default=300.0, show_default=True,
+                 help="Post-launch grace seconds."),
+    click.option("--drain-grace", default=120.0, show_default=True,
+                 help="Checkpoint window before force-evicting."),
+    click.option("--spare-agents", default=1, show_default=True,
+                 help="Free CPU nodes kept warm (reference: --spare-agents)."),
+    click.option("--spare-slice", "spare_slices", multiple=True,
+                 help="Warm TPU slices, e.g. --spare-slice v5e-8=1."),
+    click.option("--over-provision", default=0, show_default=True,
+                 help="Extra CPU nodes beyond demand."),
+    click.option("--default-generation", default="v5e", show_default=True),
+    click.option("--cpu-machine-type", default="e2-standard-8",
+                 show_default=True),
+    click.option("--max-cpu-nodes", default=100, show_default=True),
+    click.option("--max-total-chips", default=4096, show_default=True),
+    click.option("--preemptible", is_flag=True,
+                 help="Provision spot/preemptible TPU capacity."),
+    click.option("--no-scale", is_flag=True),
+    click.option("--no-maintenance", is_flag=True),
+    click.option("--slack-hook", default=None,
+                 help="Slack incoming-webhook URL for scale events."),
+    click.option("--slack-channel", default=None),
+    click.option("--metrics-port", default=0, show_default=True,
+                 help="Serve /metrics and /healthz on this port (0=off)."),
+    click.option("-v", "--verbose", is_flag=True),
+]
+
+
+def common_options(f):
+    for opt in reversed(_common):
+        f = opt(f)
+    return f
+
+
+def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
+           drain_grace, spare_agents, spare_slices, over_provision,
+           default_generation, cpu_machine_type, max_cpu_nodes,
+           max_total_chips, preemptible, no_scale, no_maintenance,
+           slack_hook, slack_channel, metrics_port, verbose) -> Controller:
+    logging.basicConfig(
+        level=logging.DEBUG if verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stderr)
+    notifier = (SlackNotifier(slack_hook, slack_channel) if slack_hook
+                else LogNotifier())
+    metrics = Metrics()
+    if metrics_port:
+        metrics.serve(metrics_port)
+    config = ControllerConfig(
+        policy=_policy(default_generation, cpu_machine_type, over_provision,
+                       spare_agents, spare_slices, max_cpu_nodes,
+                       max_total_chips, preemptible),
+        grace_seconds=grace_period,
+        idle_threshold_seconds=idle_threshold,
+        drain_grace_seconds=drain_grace,
+        no_scale=no_scale, no_maintenance=no_maintenance)
+    return Controller(kube, actuator, config, notifier, metrics)
+
+
+@click.group()
+def cli():
+    """TPU-native Kubernetes cluster autoscaler."""
+
+
+@cli.command()
+@common_options
+@click.option("--kube-url", default=None,
+              help="Apiserver URL (default: in-cluster).")
+@click.option("--kube-token", default=None)
+@click.option("--actuator", "actuator_kind", default="gke",
+              type=click.Choice(["gke", "queued-resources"]),
+              show_default=True)
+@click.option("--project", default=None, help="GCP project id.")
+@click.option("--location", default=None, help="GCE zone / region.")
+@click.option("--cluster", default=None, help="GKE cluster name.")
+@click.option("--dry-run", is_flag=True,
+              help="Log mutations instead of performing them.")
+def run(kube_url, kube_token, actuator_kind, project, location, cluster,
+        dry_run, sleep, **kw):
+    """Run against a real cluster (in-cluster or via --kube-url)."""
+    from tpu_autoscaler.k8s.client import RestKubeClient
+
+    kube = RestKubeClient(base_url=kube_url, token=kube_token,
+                          dry_run=dry_run)
+    if actuator_kind == "gke":
+        from tpu_autoscaler.actuators.gke import GkeNodePoolActuator
+
+        actuator = GkeNodePoolActuator(project=project, location=location,
+                                       cluster=cluster, dry_run=dry_run)
+    else:
+        from tpu_autoscaler.actuators.queued_resources import (
+            QueuedResourceActuator,
+        )
+
+        actuator = QueuedResourceActuator(project=project, zone=location,
+                                          dry_run=dry_run)
+    controller = _build(kube, actuator, sleep=sleep, **kw)
+    controller.run_forever(interval_seconds=sleep)
+
+
+@cli.command()
+@common_options
+@click.option("--scenario", default="v5e-8", show_default=True,
+              type=click.Choice(["cpu", "v5e-8", "v5e-64", "2xv5p-128",
+                                 "v5p-256"]),
+              help="Pending workload to simulate (BASELINE eval configs).")
+@click.option("--provision-delay", default=90.0, show_default=True,
+              help="Simulated cloud provisioning delay seconds.")
+@click.option("--until", default=3600.0, show_default=True,
+              help="Simulated seconds to run.")
+def demo(scenario, provision_delay, until, sleep, **kw):
+    """Run the full loop against the in-memory fake cloud (simulated time).
+
+    Prints scale events and the measured Unschedulable→Running latency —
+    an executable version of BASELINE.md's eval configs.
+    """
+    from tpu_autoscaler.actuators.fake import FakeActuator
+    from tpu_autoscaler.k8s.fake import FakeKube
+    from tpu_autoscaler.sim import seed_scenario, simulate
+
+    kube = FakeKube()
+    actuator = FakeActuator(kube, provision_delay=provision_delay)
+    controller = _build(kube, actuator, sleep=sleep, **kw)
+    chips = seed_scenario(kube, scenario)
+    result = simulate(kube, controller, until=until, step=sleep,
+                      scenario=scenario, chips_requested=chips)
+    click.echo(result.describe())
+    sys.exit(0 if result.all_running else 1)
+
+
+if __name__ == "__main__":
+    cli()

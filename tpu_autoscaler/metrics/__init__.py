@@ -1,0 +1,3 @@
+from tpu_autoscaler.metrics.metrics import Metrics
+
+__all__ = ["Metrics"]

@@ -1,0 +1,124 @@
+"""Structured metrics for the detection→actuation path.
+
+The reference had only Python logging (SURVEY.md §6.1/6.5); the rebuild's
+north-star metric *is* a latency, so per-phase timers are first-class:
+
+- ``scale_up_latency_seconds``  — gang first seen Unschedulable → all pods
+  Running (the BASELINE metric).
+- ``decision_latency_seconds``  — observation → plan computed.
+- ``provision_latency_seconds`` — provision submitted → slice ACTIVE.
+- ``stranded_chips``            — per provisioned slice.
+
+Export is Prometheus text format over a trivial HTTP handler (see
+``serve``), plus a dict snapshot for tests and logs.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class _Summary:
+    __slots__ = ("count", "total", "min", "max", "last")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.last = 0.0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        self.last = value
+
+    def as_dict(self) -> dict:
+        if not self.count:
+            return {"count": 0}
+        return {"count": self.count, "sum": self.total,
+                "avg": self.total / self.count, "min": self.min,
+                "max": self.max, "last": self.last}
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._summaries: dict[str, _Summary] = defaultdict(_Summary)
+
+    def inc(self, name: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._summaries[name].observe(value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "summaries": {k: s.as_dict()
+                              for k, s in self._summaries.items()},
+            }
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (metric names sanitized)."""
+        def clean(name: str) -> str:
+            return "".join(c if c.isalnum() or c == "_" else "_"
+                           for c in name)
+
+        lines = []
+        snap = self.snapshot()
+        for name, v in sorted(snap["counters"].items()):
+            lines.append(f"# TYPE {clean(name)} counter")
+            lines.append(f"{clean(name)} {v}")
+        for name, v in sorted(snap["gauges"].items()):
+            lines.append(f"# TYPE {clean(name)} gauge")
+            lines.append(f"{clean(name)} {v}")
+        for name, s in sorted(snap["summaries"].items()):
+            n = clean(name)
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f"{n}_count {s.get('count', 0)}")
+            if s.get("count"):
+                lines.append(f"{n}_sum {s['sum']}")
+                lines.append(f"{n}_max {s['max']}")
+        return "\n".join(lines) + "\n"
+
+    def serve(self, port: int) -> threading.Thread:
+        """Serve /metrics on a daemon thread; returns the thread."""
+        import http.server
+
+        metrics = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                if self.path not in ("/metrics", "/healthz"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = (metrics.render_prometheus() if self.path == "/metrics"
+                        else "ok\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return thread

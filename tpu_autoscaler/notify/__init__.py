@@ -1,0 +1,3 @@
+from tpu_autoscaler.notify.notifier import LogNotifier, Notifier, SlackNotifier
+
+__all__ = ["LogNotifier", "Notifier", "SlackNotifier"]

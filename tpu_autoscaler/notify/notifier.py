@@ -1,0 +1,52 @@
+"""Scale-event notifications.
+
+Reference parity: notification.py §Notifier — fire-and-forget Slack
+incoming-webhook POSTs on scale events and failures, never blocking or
+failing the loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Protocol
+
+log = logging.getLogger(__name__)
+
+
+class Notifier(Protocol):
+    def notify(self, message: str) -> None: ...
+
+
+class LogNotifier:
+    """Default: events go to the structured log only."""
+
+    def notify(self, message: str) -> None:
+        log.info("event: %s", message)
+
+
+class SlackNotifier:
+    """POST to a Slack incoming webhook on a background thread.
+
+    Failures are logged and swallowed — a notification must never take the
+    control loop down (reference behavior: notification.py).
+    """
+
+    def __init__(self, hook_url: str, channel: str | None = None):
+        self._url = hook_url
+        self._channel = channel
+
+    def notify(self, message: str) -> None:
+        threading.Thread(target=self._post, args=(message,),
+                         daemon=True).start()
+
+    def _post(self, message: str) -> None:
+        try:
+            import requests
+
+            payload: dict = {"text": message}
+            if self._channel:
+                payload["channel"] = self._channel
+            requests.post(self._url, json=payload, timeout=10)
+        except Exception:  # noqa: BLE001 — never propagate
+            log.exception("slack notification failed")

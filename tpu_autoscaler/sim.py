@@ -1,0 +1,147 @@
+"""Simulation harness: BASELINE eval configs as executable scenarios.
+
+Drives the controller + fake scheduler over simulated time — the same loop
+the e2e tests use, packaged for the ``demo`` CLI command and ``bench.py``.
+The reference's only integration story was `--dry-run` by hand (SURVEY.md
+§5); here every eval config in BASELINE.md is a named, runnable scenario.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_autoscaler.controller import Controller
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    shape_by_name,
+)
+
+
+def _pod(name: str, requests: dict, selectors: dict | None = None,
+         labels: dict | None = None, owner_kind: str | None = None) -> dict:
+    payload: dict = {
+        "metadata": {"name": name, "namespace": "default",
+                     "labels": labels or {},
+                     "creationTimestamp": "1970-01-01T00:00:00Z"},
+        "spec": {
+            "containers": [{"name": "main",
+                            "resources": {"requests": requests}}],
+            "nodeSelector": selectors or {},
+        },
+        "status": {"phase": "Pending", "conditions": [
+            {"type": "PodScheduled", "status": "False",
+             "reason": "Unschedulable"}]},
+    }
+    if owner_kind:
+        payload["metadata"]["ownerReferences"] = [
+            {"kind": owner_kind, "name": f"{name}-owner"}]
+    return payload
+
+
+def _gang_pods(shape_name: str, job: str, jobset: str | None = None,
+               job_index: int | None = None) -> list[dict]:
+    shape = shape_by_name(shape_name)
+    selectors = {ACCELERATOR_LABEL: shape.accelerator_type,
+                 TOPOLOGY_LABEL: shape.topology_label}
+    labels = {"batch.kubernetes.io/job-name": job}
+    if jobset is not None:
+        labels["jobset.sigs.k8s.io/jobset-name"] = jobset
+        labels["jobset.sigs.k8s.io/job-index"] = str(job_index or 0)
+    return [
+        _pod(f"{job}-{i}", {TPU_RESOURCE: str(shape.chips_per_host)},
+             selectors, dict(labels), owner_kind="Job")
+        for i in range(shape.hosts)
+    ]
+
+
+def seed_scenario(kube: FakeKube, scenario: str) -> int:
+    """Seed pending demand for one BASELINE eval config; returns the chip
+    count requested."""
+    if scenario == "cpu":
+        kube.add_pod(_pod("web", {"cpu": "2"}))
+        return 0
+    if scenario == "v5e-8":
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(_pod(
+            "jax", {TPU_RESOURCE: "8"},
+            {ACCELERATOR_LABEL: shape.accelerator_type,
+             TOPOLOGY_LABEL: shape.topology_label},
+            {"batch.kubernetes.io/job-name": "jax"}, owner_kind="Job"))
+        return 8
+    if scenario == "v5e-64":
+        for p in _gang_pods("v5e-64", "trainer"):
+            kube.add_pod(p)
+        return 64
+    if scenario == "2xv5p-128":
+        for idx in range(2):
+            for p in _gang_pods("v5p-128", f"ms-{idx}", jobset="ms",
+                                job_index=idx):
+                kube.add_pod(p)
+        return 256
+    if scenario == "v5p-256":
+        for p in _gang_pods("v5p-256", "north-star"):
+            kube.add_pod(p)
+        return 256
+    raise ValueError(f"unknown scenario {scenario!r}")
+
+
+@dataclasses.dataclass
+class SimResult:
+    scenario: str
+    all_running: bool
+    latency_seconds: float | None
+    nodes: int
+    chips_provisioned: int
+    chips_requested: int
+    snapshot: dict
+
+    @property
+    def stranded_chips(self) -> int:
+        return max(0, self.chips_provisioned - self.chips_requested)
+
+    def describe(self) -> str:
+        if not self.all_running:
+            return (f"[{self.scenario}] FAILED: pods still pending "
+                    f"(nodes={self.nodes})")
+        return (f"[{self.scenario}] Unschedulable→Running in "
+                f"{self.latency_seconds:.1f}s; nodes={self.nodes}, "
+                f"chips={self.chips_provisioned} "
+                f"(requested {self.chips_requested}, "
+                f"stranded {self.stranded_chips})")
+
+
+def simulate(kube: FakeKube, controller: Controller, *, until: float,
+             step: float = 5.0, scenario: str = "",
+             chips_requested: int = 0) -> SimResult:
+    """Run the loop in simulated time until all pods run (or time out)."""
+    if step <= 0:
+        raise ValueError(f"simulation step must be > 0, got {step}")
+
+    def all_running() -> bool:
+        pods = kube.list_pods()
+        return bool(pods) and all(
+            p["status"]["phase"] == "Running" for p in pods)
+
+    t, finished = 0.0, None
+    while t <= until:
+        controller.reconcile_once(now=t)
+        kube.schedule_step()
+        if finished is None and all_running():
+            finished = t
+            controller.reconcile_once(now=t)  # record latency metric
+            break
+        t += step
+
+    chips = sum(
+        int(float(n["status"]["allocatable"].get(TPU_RESOURCE, 0)))
+        for n in kube.list_nodes())
+    snap = controller.metrics.snapshot()
+    lat = snap["summaries"].get("scale_up_latency_seconds", {}).get("max")
+    return SimResult(
+        scenario=scenario, all_running=all_running(),
+        latency_seconds=lat if lat is not None else finished,
+        nodes=len(kube.list_nodes()), chips_provisioned=chips,
+        chips_requested=chips_requested, snapshot=snap)

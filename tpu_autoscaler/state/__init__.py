@@ -1,0 +1,13 @@
+"""Per-slice state machine (scale-down policy).
+
+Analog of the reference's cluster.py §ClusterNodeState machine
+(INSTANCE_TERMINATED / SPARE_AGENT / GRACE_PERIOD / BUSY / IDLE_* /
+UNDER_UTILIZED_*), re-derived per-slice: grace, idle, drain, and delete all
+operate on whole ICI slices so a running pjit/pmap job is never bisected
+(SURVEY.md §8 "slice-atomic semantics").
+"""
+
+from tpu_autoscaler.state.machine import SliceState, SliceView, classify_slice
+from tpu_autoscaler.state.tracker import SliceTracker
+
+__all__ = ["SliceState", "SliceTracker", "SliceView", "classify_slice"]

@@ -1,0 +1,105 @@
+"""Slice classification: the scale-down state machine.
+
+Reference parity (cluster.py §ClusterNodeState, §Cluster.maintain), with the
+unit of classification changed from node to slice and two TPU-specific
+additions:
+
+- PROVISIONING: the multi-host readiness barrier — a v5e-64 slice is usable
+  only once all 16 hosts register Ready (SURVEY.md §8 hard parts); the
+  reference's per-VM "launch grace period" becomes this barrier plus
+  LAUNCH_GRACE after it clears.
+- DRAINING / checkpoint-awareness: a busy slice being reclaimed (spot
+  preemption, scale-to-zero) signals the job and waits a bounded time for a
+  checkpoint before evicting (BASELINE config #5); see
+  ``tpu_autoscaler.controller``.
+
+CPU nodes run through the same machine as single-node "slices", which is
+exactly the degenerate case the reference handled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Sequence
+
+from tpu_autoscaler.k8s.objects import Node, Pod
+
+
+class SliceState(str, enum.Enum):
+    # Requested from the actuator; not all hosts Ready yet (barrier).
+    PROVISIONING = "provisioning"
+    # All hosts Ready, within the post-launch grace window.
+    LAUNCH_GRACE = "launch-grace"
+    # Workload pods running somewhere on the slice.
+    BUSY = "busy"
+    # No workload pods; idle shorter than the idle threshold.
+    IDLE = "idle"
+    # Idle beyond threshold and eligible to reclaim.
+    IDLE_DRAINABLE = "idle-drainable"
+    # Idle but retained by spare/warm policy.
+    SPARE = "spare"
+    # Cordoned by us; waiting for evictions/checkpoint before delete.
+    DRAINING = "draining"
+    # Cordoned by someone else: hands off (reference IDLE_UNSCHEDULABLE).
+    UNSCHEDULABLE = "unschedulable"
+    # A host went NotReady after the slice was up: broken ICI domain.
+    UNHEALTHY = "unhealthy"
+
+
+@dataclasses.dataclass
+class SliceView:
+    """Everything the classifier needs to know about one slice."""
+
+    slice_id: str
+    nodes: list[Node]
+    pods: list[Pod]                  # pods bound to any host of the slice
+    now: float                       # seconds (monotonic-ish epoch)
+    all_ready_since: float | None    # tracker: when the barrier cleared
+    idle_since: float | None         # tracker: when it last became workload-free
+    we_cordoned: bool                # tracker: drain initiated by us
+
+    @property
+    def workload_pods(self) -> list[Pod]:
+        """Pods that make a slice busy: everything except daemonsets and
+        mirror pods (reference: cluster.py busy/idle input set)."""
+        return [p for p in self.pods
+                if not p.is_daemonset and not p.is_mirrored
+                and p.phase in {"Pending", "Running"}]
+
+
+def classify_slice(view: SliceView, *, grace_seconds: float,
+                   idle_threshold_seconds: float,
+                   spare: bool = False) -> SliceState:
+    """Classify one slice. Pure function: all time comes in via the view."""
+    nodes = view.nodes
+    # A drain we initiated takes precedence over everything, including
+    # health: an UNHEALTHY slice being reclaimed must classify DRAINING so
+    # the drain can complete and the hardware actually gets deleted.
+    if view.we_cordoned and any(n.unschedulable for n in nodes):
+        return SliceState.DRAINING
+
+    if not all(n.is_ready for n in nodes) or view.all_ready_since is None:
+        # Never fully Ready -> still behind the provisioning barrier; a
+        # previously-Ready slice with a NotReady host is broken hardware.
+        if view.all_ready_since is not None:
+            return SliceState.UNHEALTHY
+        return SliceState.PROVISIONING
+
+    if any(n.unschedulable for n in nodes):
+        return SliceState.UNSCHEDULABLE
+
+    if view.workload_pods:
+        return SliceState.BUSY
+
+    if view.now - view.all_ready_since < grace_seconds:
+        return SliceState.LAUNCH_GRACE
+
+    idle_for = view.now - (view.idle_since
+                           if view.idle_since is not None
+                           else view.all_ready_since)
+    if idle_for < idle_threshold_seconds:
+        return SliceState.IDLE
+    if spare:
+        return SliceState.SPARE
+    return SliceState.IDLE_DRAINABLE

@@ -1,0 +1,67 @@
+"""In-memory per-slice timers feeding the state machine.
+
+The reference tracked per-node idle/launch times implicitly (EC2-ancestor
+pattern); this tracker is explicit and injectable-clock so tests control
+time.  It is deliberately *crash-only* (SURVEY.md §6.3): state lives only in
+memory, and on restart timers restart — which can only delay scale-down,
+never wrongly accelerate it.  Cordons we initiate are additionally stamped
+on the node via an annotation so a restarted process still knows a DRAINING
+slice is ours.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.state.machine import SliceView
+
+# Annotation stamped on nodes we cordon, so drain ownership survives
+# process restarts (the one piece of state the crash-only design persists,
+# and it lives in the cluster, not in us).
+DRAIN_ANNOTATION = "autoscaler.tpu.dev/draining"
+
+
+@dataclasses.dataclass
+class _SliceTimes:
+    all_ready_since: float | None = None
+    idle_since: float | None = None
+    we_cordoned: bool = False
+
+
+class SliceTracker:
+    def __init__(self):
+        self._times: dict[str, _SliceTimes] = {}
+
+    def note_cordoned(self, slice_id: str) -> None:
+        self._times.setdefault(slice_id, _SliceTimes()).we_cordoned = True
+
+    def forget(self, slice_id: str) -> None:
+        self._times.pop(slice_id, None)
+
+    def observe(self, slice_id: str, nodes: list[Node], pods: list[Pod],
+                now: float) -> SliceView:
+        """Update timers from one observation and produce a SliceView."""
+        t = self._times.setdefault(slice_id, _SliceTimes())
+
+        all_ready = bool(nodes) and all(n.is_ready for n in nodes)
+        if all_ready and t.all_ready_since is None:
+            t.all_ready_since = now
+
+        view = SliceView(
+            slice_id=slice_id, nodes=nodes, pods=pods, now=now,
+            all_ready_since=t.all_ready_since, idle_since=t.idle_since,
+            we_cordoned=t.we_cordoned or any(
+                DRAIN_ANNOTATION in n.annotations for n in nodes),
+        )
+        has_workload = bool(view.workload_pods)
+        if has_workload:
+            t.idle_since = None
+        elif t.idle_since is None and all_ready:
+            t.idle_since = now
+        # Refresh the view with post-update idle time.
+        view.idle_since = t.idle_since
+        return view
+
+    def known_slices(self) -> list[str]:
+        return list(self._times)
