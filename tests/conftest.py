@@ -4,15 +4,29 @@ JAX-touching tests run on a virtual 8-device CPU mesh (multi-chip hardware
 is not available in CI; the sharding layer is validated exactly the way the
 driver's dryrun does it).  Env vars must be set before jax is imported
 anywhere, hence this conftest does it at collection time.
+
+Environment hazard handled here (discovered empirically): the image's
+``/root/.axon_site/sitecustomize.py`` imports jax AT INTERPRETER STARTUP
+with ``JAX_PLATFORMS=axon`` (single real TPU via a relay tunnel), so jax's
+config has already captured the env before any test code runs — setting
+``os.environ["JAX_PLATFORMS"]`` afterwards is silently ignored and backend
+init then blocks on the tunnel.  ``jax.config.update("jax_platforms", ...)``
+is the reliable switch; XLA_FLAGS is still read at (cpu) backend init time
+so the virtual-device count can be set via env here.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+if "jax" in sys.modules:  # sitecustomize already imported it
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,48 @@
+"""JAX-vectorized batch shape scorer tests (engine/jaxfit.py)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from tpu_autoscaler.engine.jaxfit import best_shapes, catalog_arrays  # noqa: E402
+
+
+def demand(total, per_pod, pods):
+    return [float(total), float(per_pod), float(pods)]
+
+
+class TestBatchScorer:
+    def test_matches_python_fitter_on_simple_demands(self):
+        # 64 chips, 4/pod, 16 pods -> v5e-64 with 0 stranded.
+        out = best_shapes(np.array([demand(64, 4, 16)]), generation="v5e")
+        assert out == [("v5e-64", 0.0)]
+
+    def test_stranded_cost(self):
+        out = best_shapes(np.array([demand(5, 5, 1)]), generation="v5e")
+        # 5 chips/pod needs an 8-chip host: v5e-8, 3 stranded.
+        assert out == [("v5e-8", 3.0)]
+
+    def test_per_host_feasibility_respected(self):
+        # 24 chips as 3x8: no multi-host v5e shape has 8-chip hosts.
+        out = best_shapes(np.array([demand(24, 8, 3)]), generation="v5e")
+        assert out[0][0] is None
+
+    def test_batch_of_gangs(self):
+        demands = np.array([
+            demand(8, 8, 1),      # v5e-8
+            demand(256, 4, 64),   # v5e-256
+            demand(100000, 4, 25000),  # infeasible
+        ])
+        out = best_shapes(demands, generation="v5e")
+        assert out[0] == ("v5e-8", 0.0)
+        assert out[1] == ("v5e-256", 0.0)
+        assert out[2][0] is None
+
+    def test_whole_catalog(self):
+        names, chips, cph, hosts = catalog_arrays()
+        assert len(names) == len(set(names))
+        out = best_shapes(np.array([demand(256, 4, 64)]))
+        # Cross-generation argmin picks SOME 256-chip shape, 0 stranded.
+        assert out[0][1] == 0.0
+        assert out[0][0].endswith("-256")

@@ -1,0 +1,91 @@
+"""JAX-vectorized shape scoring: the fit engine's accelerated path.
+
+``choose_shape_for_gang`` is O(shapes) Python per gang — fine for tens of
+gangs.  At fleet scale (thousands of queued gangs scored against the whole
+catalog, e.g. batch admission control or what-if capacity planning), the
+same math vectorizes: one ``[gangs, shapes]`` feasibility/cost tensor,
+computed in a single fused XLA kernel on CPU or TPU.
+
+The kernel is pure (no data-dependent Python control flow; masking instead
+of branching) so it jits once and reuses across reconcile passes — the
+XLA-first rewrite of the reference's per-pod Python loop
+(cluster.py §Cluster.scale, O(pods×pools) fit checks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_autoscaler.topology.catalog import SLICE_SHAPES
+
+_BIG = np.float32(1e9)
+
+
+def catalog_arrays(generation: str | None = None):
+    """(names, chips[S], chips_per_host[S], hosts[S]) as numpy arrays."""
+    shapes = [s for s in SLICE_SHAPES.values()
+              if generation is None or s.generation == generation]
+    shapes.sort(key=lambda s: (s.generation, s.chips))
+    names = [s.name for s in shapes]
+    chips = np.array([s.chips for s in shapes], np.float32)
+    cph = np.array([s.chips_per_host for s in shapes], np.float32)
+    hosts = np.array([s.hosts for s in shapes], np.float32)
+    return names, chips, cph, hosts
+
+
+def _score_kernel(total_chips, per_pod_chips, n_pods, chips, cph, hosts):
+    """Vectorized feasibility + stranded-chip cost.
+
+    Inputs: per-gang demand vectors [G]; catalog vectors [S].
+    Output: cost [G, S] — stranded chips, or +inf where infeasible.
+    Written against jax.numpy but numpy-compatible (tests run both).
+    """
+    import jax.numpy as jnp
+
+    total = total_chips[:, None]
+    per_pod = per_pod_chips[:, None]
+    pods = n_pods[:, None]
+    slots = hosts[None, :] * jnp.floor(
+        jnp.where(per_pod > 0, cph[None, :] / jnp.maximum(per_pod, 1), _BIG))
+    feasible = ((chips[None, :] >= total)
+                & (cph[None, :] >= per_pod)
+                & (slots >= pods))
+    stranded = chips[None, :] - total
+    return jnp.where(feasible, stranded, _BIG)
+
+
+def make_batch_scorer(generation: str | None = None):
+    """Returns (names, score_fn) where score_fn(gang_demands) -> best index
+    and stranded cost per gang, jitted once.
+
+    ``gang_demands`` is a float32 array [G, 3] of (total_chips,
+    per_pod_chips, n_pods).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    names, chips, cph, hosts = catalog_arrays(generation)
+    chips_j, cph_j, hosts_j = (jnp.asarray(chips), jnp.asarray(cph),
+                               jnp.asarray(hosts))
+
+    @jax.jit
+    def score(demands):
+        cost = _score_kernel(demands[:, 0], demands[:, 1], demands[:, 2],
+                             chips_j, cph_j, hosts_j)
+        best = jnp.argmin(cost, axis=1)
+        best_cost = jnp.min(cost, axis=1)
+        return best, best_cost
+
+    return names, score
+
+
+def best_shapes(demands: np.ndarray, generation: str | None = None
+                ) -> list[tuple[str | None, float]]:
+    """Convenience wrapper: [(shape_name | None, stranded), ...] per gang."""
+    names, score = make_batch_scorer(generation)
+    best, cost = score(np.asarray(demands, np.float32))
+    out = []
+    for b, c in zip(np.asarray(best), np.asarray(cost)):
+        out.append((None, float("inf")) if c >= _BIG
+                   else (names[int(b)], float(c)))
+    return out

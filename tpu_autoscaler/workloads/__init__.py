@@ -1,0 +1,43 @@
+"""In-tree JAX training workload + checkpoint contract.
+
+The reference is an infrastructure controller with no model code (SURVEY.md
+§3); this package is the *job side* of the TPU-native rebuild's two new
+contracts:
+
+- a flagship pjit-sharded transformer train step (``model.py``) used to
+  validate that provisioned slices actually run SPMD JAX — the mesh axes
+  (data, model) shard over exactly the ICI domains the autoscaler
+  provisions, and ``__graft_entry__.dryrun_multichip`` jits it over an
+  N-device mesh;
+- the checkpoint-aware drain contract (``checkpoint.py``): when the
+  autoscaler reclaims a slice it annotates the workload pods
+  (controller/reconciler.py §CHECKPOINT_ANNOTATION); a job using
+  ``DrainWatcher`` sees the annotation, saves an orbax checkpoint, and
+  exits before the drain deadline (BASELINE config #5).
+"""
+
+from tpu_autoscaler.workloads.model import (
+    ModelConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_sharded_train_step,
+    make_mesh,
+)
+from tpu_autoscaler.workloads.checkpoint import (
+    DrainWatcher,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = [
+    "DrainWatcher",
+    "ModelConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "make_mesh",
+    "make_sharded_train_step",
+    "restore_checkpoint",
+    "save_checkpoint",
+]

@@ -1,0 +1,135 @@
+"""Checkpoint-aware drain: the job-side contract (BASELINE config #5).
+
+The controller reclaims slices by annotating workload pods with
+``autoscaler.tpu.dev/checkpoint-requested`` (controller/reconciler.py
+§CHECKPOINT_ANNOTATION) and waiting ``drain_grace_seconds`` before force
+eviction.  A job that wants graceful preemption runs a ``DrainWatcher``:
+
+- the pod mounts its own annotations via the downward API
+  (``/etc/podinfo/annotations``, the standard ``key="value"`` lines format);
+- between steps the training loop calls ``watcher.drain_requested()``;
+- on True it saves an orbax checkpoint and exits 0 — well inside the drain
+  window, so the slice is reclaimed with zero lost work.
+
+This is new scope relative to the reference (SURVEY.md §6.4: the reference
+had no checkpoint story; statelessness was its resume strategy).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Mapping
+
+log = logging.getLogger(__name__)
+
+CHECKPOINT_ANNOTATION = "autoscaler.tpu.dev/checkpoint-requested"
+DEFAULT_ANNOTATIONS_PATH = "/etc/podinfo/annotations"
+
+
+def parse_downward_annotations(text: str) -> dict[str, str]:
+    """Parse the downward-API annotations file (``key="escaped value"``)."""
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        value = value.strip()
+        if value.startswith('"') and value.endswith('"') and len(value) >= 2:
+            value = value[1:-1].encode().decode("unicode_escape")
+        out[key.strip()] = value
+    return out
+
+
+class DrainWatcher:
+    """Polls a source of pod annotations for the checkpoint request.
+
+    ``source`` is either a path to a downward-API annotations file or a
+    callable returning the annotation dict (tests, or a kube-API poller).
+    """
+
+    def __init__(self,
+                 source: str | Callable[[], Mapping[str, str]]
+                 = DEFAULT_ANNOTATIONS_PATH,
+                 min_poll_interval: float = 2.0):
+        self._source = source
+        self._min_interval = min_poll_interval
+        self._last_poll = 0.0
+        self._cached = False
+
+    def _annotations(self) -> Mapping[str, str]:
+        if callable(self._source):
+            return self._source()
+        try:
+            with open(self._source) as f:
+                return parse_downward_annotations(f.read())
+        except OSError:
+            return {}
+
+    def drain_requested(self) -> bool:
+        """Cheap enough to call every training step (rate-limited poll)."""
+        now = time.monotonic()
+        if self._cached or now - self._last_poll < self._min_interval:
+            return self._cached
+        self._last_poll = now
+        self._cached = CHECKPOINT_ANNOTATION in self._annotations()
+        if self._cached:
+            log.info("drain requested via %s annotation",
+                     CHECKPOINT_ANNOTATION)
+        return self._cached
+
+
+# ---- orbax checkpoint io ------------------------------------------------
+
+def save_checkpoint(directory: str, step: int, state) -> str:
+    """Save a pytree checkpoint; returns the checkpoint path."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(path, state, force=True)
+    checkpointer.wait_until_finished()
+    return path
+
+
+def restore_checkpoint(directory: str, step: int, abstract_state):
+    """Restore the pytree saved by :func:`save_checkpoint`."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    checkpointer = ocp.StandardCheckpointer()
+    return checkpointer.restore(path, abstract_state)
+
+
+def latest_step(directory: str) -> int | None:
+    try:
+        steps = [int(name[len("step_"):])
+                 for name in os.listdir(directory)
+                 if name.startswith("step_")]
+    except OSError:
+        return None
+    return max(steps) if steps else None
+
+
+def train_until_drained(step_fn: Callable, state, num_steps: int,
+                        watcher: DrainWatcher, checkpoint_dir: str,
+                        make_batch: Callable[[int], object],
+                        start_step: int = 0) -> tuple[object, int, bool]:
+    """Training loop honoring the drain contract.
+
+    Returns ``(state, steps_done, drained)``; saves a checkpoint and stops
+    early when the watcher fires.  The loop structure (poll between steps,
+    save, exit cleanly) is the reference pattern for any job running under
+    this autoscaler on spot/preemptible slices.
+    """
+    step = start_step
+    while step < num_steps:
+        if watcher.drain_requested():
+            save_checkpoint(checkpoint_dir, step, state)
+            return state, step, True
+        state = step_fn(state, make_batch(step))
+        step += 1
+    save_checkpoint(checkpoint_dir, step, state)
+    return state, step, False
