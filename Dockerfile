@@ -1,0 +1,14 @@
+# Container packaging (reference parity: Dockerfile — python base +
+# requirements + CMD main loop; SURVEY.md §3 item 13).
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY requirements.txt .
+RUN pip install --no-cache-dir -r requirements.txt
+
+COPY tpu_autoscaler/ tpu_autoscaler/
+
+# In-cluster auth (service account) + GKE workload identity for the GCP
+# APIs; all configuration via flags/env (see deploy/autoscaler.yaml).
+ENTRYPOINT ["python", "-m", "tpu_autoscaler.main"]
+CMD ["run"]

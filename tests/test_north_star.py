@@ -1,0 +1,142 @@
+"""North-star extension tests (BASELINE configs #4, #5): multi-slice,
+spot preemption with checkpoint contract, scale-to-zero."""
+
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.controller.reconciler import CHECKPOINT_ANNOTATION
+from tpu_autoscaler.engine.planner import PoolPolicy
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.topology import shape_by_name
+
+from tests.fixtures import make_gang, make_tpu_pod
+from tests.test_controller_e2e import pod_running, run_loop
+
+IDLE = 120.0
+
+
+def make_harness(policy=None, **cfg):
+    kube = FakeKube()
+    actuator = FakeActuator(kube)
+    controller = Controller(kube, actuator, ControllerConfig(
+        policy=policy or PoolPolicy(spare_nodes=0),
+        grace_seconds=30.0, idle_threshold_seconds=IDLE,
+        drain_grace_seconds=20.0, **cfg))
+    return kube, actuator, controller
+
+
+class TestMultiSlice:
+    """Config #4: 2 x v5p-128 over DCN — two atomic slices, one jobset."""
+
+    def test_two_slices_provisioned_and_bound(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5p-128")
+        names = []
+        for idx in range(2):
+            for p in make_gang(shape, job=f"ms-{idx}", jobset="ms",
+                               job_index=idx):
+                kube.add_pod(p)
+                names.append(p["metadata"]["name"])
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, n) for n in names))
+        assert all(pod_running(kube, n) for n in names)
+        nodes = kube.list_nodes()
+        assert len(nodes) == 64  # 2 x 32 hosts
+        slice_ids = {n["metadata"]["labels"]["autoscaler.tpu.dev/slice-id"]
+                     for n in nodes}
+        assert len(slice_ids) == 2
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["provisions_submitted"] == 2
+        assert snap["summaries"]["stranded_chips"]["max"] == 0
+
+    def test_slices_survive_each_other_draining(self):
+        # Deleting one slice's job reclaims only that slice.
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-16")
+        names = {0: [], 1: []}
+        for idx in range(2):
+            for p in make_gang(shape, job=f"ms-{idx}", jobset="ms",
+                               job_index=idx):
+                kube.add_pod(p)
+                names[idx].append(p["metadata"]["name"])
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, n) for ns in names.values() for n in ns))
+        for n in names[0]:
+            kube.delete_pod("default", n)
+        run_loop(kube, controller, start=50.0, until=50.0 + IDLE + 60.0,
+                 step=5.0)
+        assert len(kube.list_nodes()) == 4   # slice 1's hosts only
+        assert all(pod_running(kube, n) for n in names[1])
+
+
+class TestSpotPreemption:
+    """Config #5: spot reclamation with the checkpoint contract."""
+
+    def test_preemption_checkpoint_and_replacement(self):
+        kube, actuator, controller = make_harness(
+            policy=PoolPolicy(spare_nodes=0, preemptible=True))
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="spot-job", chips=8, shape=shape,
+                                  job="spot"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "spot-job"))
+        node = kube.list_nodes()[0]
+        assert node["metadata"]["labels"]["cloud.google.com/gke-spot"] == \
+            "true"
+        slice_id = node["metadata"]["labels"]["autoscaler.tpu.dev/slice-id"]
+
+        # Spot reclamation notice arrives -> drain requested.
+        controller.request_drain(slice_id)
+        controller.reconcile_once(now=10.0)
+        pod = kube.get_pod("default", "spot-job")
+        assert CHECKPOINT_ANNOTATION in pod["metadata"]["annotations"]
+
+        # The job checkpoints and exits; its controller (Job) recreates the
+        # pod, which goes Pending again.
+        kube.delete_pod("default", "spot-job")
+        controller.reconcile_once(now=12.0)   # empty unit -> deleted
+        assert kube.list_nodes() == []
+        kube.add_pod(make_tpu_pod(name="spot-job-2", chips=8, shape=shape,
+                                  job="spot"))
+        run_loop(kube, controller, start=14.0, until=120.0,
+                 stop_when=lambda: pod_running(kube, "spot-job-2"))
+        assert pod_running(kube, "spot-job-2")
+        # Replacement is a NEW slice.
+        new_id = kube.list_nodes()[0]["metadata"]["labels"][
+            "autoscaler.tpu.dev/slice-id"]
+        assert new_id != slice_id
+
+
+class TestScaleToZero:
+    def test_cluster_drains_to_zero_nodes(self):
+        kube, actuator, controller = make_harness(
+            policy=PoolPolicy(spare_nodes=0))
+        shape = shape_by_name("v5e-64")
+        names = []
+        for p in make_gang(shape, job="batch"):
+            kube.add_pod(p)
+            names.append(p["metadata"]["name"])
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, n) for n in names))
+        assert len(kube.list_nodes()) == 16
+        # Batch job completes; demand goes to zero.
+        for n in names:
+            kube.delete_pod("default", n)
+        run_loop(kube, controller, start=100.0, until=100.0 + IDLE + 120.0,
+                 step=5.0)
+        assert kube.list_nodes() == []  # scale-to-zero
+        # And scale back UP from zero when demand returns.
+        kube.add_pod(make_tpu_pod(name="revive", chips=8,
+                                  shape=shape_by_name("v5e-8"), job="r"))
+        run_loop(kube, controller, start=500.0, until=600.0,
+                 stop_when=lambda: pod_running(kube, "revive"))
+        assert pod_running(kube, "revive")
+
+    def test_spare_slice_floor_respected(self):
+        # Scale-to-zero EXCEPT a warm spare slice floor.
+        kube, actuator, controller = make_harness(
+            policy=PoolPolicy(spare_nodes=0, spare_slices={"v5e-8": 1}))
+        run_loop(kube, controller, until=2 * IDLE + 120.0, step=5.0)
+        nodes = kube.list_nodes()
+        assert len(nodes) == 1  # the warm v5e-8 host survives idleness
+        assert nodes[0]["metadata"]["labels"][
+            "cloud.google.com/gke-tpu-topology"] == "2x4"

@@ -1,0 +1,61 @@
+"""Watch-trigger tests: level-triggered detection (controller/watch.py)."""
+
+import threading
+import time
+
+from tpu_autoscaler.controller.watch import WatchTrigger
+
+
+class FakeWatchClient:
+    def __init__(self, batches):
+        self._batches = list(batches)
+        self.calls = 0
+
+    def watch_pods(self, timeout_seconds=60):
+        self.calls += 1
+        if not self._batches:
+            time.sleep(0.05)
+            return
+        batch = self._batches.pop(0)
+        if batch == "error":
+            raise ConnectionError("watch dropped")
+        yield from batch
+
+
+class TestWatchTrigger:
+    def wait_for(self, cond, timeout=2.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def test_event_wakes_loop(self):
+        wake = threading.Event()
+        client = FakeWatchClient([[{"type": "ADDED"}]])
+        t = WatchTrigger(client, wake)
+        t.start()
+        assert self.wait_for(wake.is_set)
+        t.stop()
+
+    def test_watch_error_degrades_not_crashes(self):
+        wake = threading.Event()
+        client = FakeWatchClient(["error", [{"type": "MODIFIED"}]])
+        t = WatchTrigger(client, wake)
+        t.start()
+        # Survives the dropped watch... but the retry backoff is 5s; don't
+        # wait for it — just confirm the thread is alive after the error.
+        assert self.wait_for(lambda: client.calls >= 1)
+        time.sleep(0.1)
+        assert t.is_alive()
+        t.stop()
+
+    def test_stop_terminates(self):
+        wake = threading.Event()
+        t = WatchTrigger(FakeWatchClient([]), wake)
+        t.start()
+        t.stop()
+        t.join(timeout=2.0)
+        # Thread may be sleeping in its final empty poll; alive() False soon.
+        assert self.wait_for(lambda: not t.is_alive(), timeout=3.0)

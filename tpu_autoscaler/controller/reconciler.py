@@ -124,20 +124,31 @@ class Controller:
         self.metrics.set_gauge("pending_gangs", len(gangs))
         self.metrics.set_gauge("nodes", len(nodes))
 
-    def run_forever(self, interval_seconds: float = 5.0) -> None:
-        """Poll loop (reference: main.py while True / sleep).
+    def run_forever(self, interval_seconds: float = 5.0,
+                    watch: bool = True) -> None:
+        """Reconcile loop (reference: main.py while True / sleep).
 
         The interval is seconds-scale, not the reference's 60 s — detection
-        latency is part of the north-star budget.  Each pass is wrapped in
-        a catch-all so the loop is crash-only (reference parity).
+        latency is part of the north-star budget — and when ``watch`` is on
+        a pod watch wakes the loop the instant demand changes, making the
+        interval only a fallback (controller/watch.py).  Each pass is
+        wrapped in a catch-all so the loop is crash-only (reference parity).
         """
+        import threading
+
+        wake = threading.Event()
+        if watch and hasattr(self.client, "watch_pods"):
+            from tpu_autoscaler.controller.watch import WatchTrigger
+
+            WatchTrigger(self.client, wake).start()
         while True:
             try:
                 self.reconcile_once()
             except Exception:  # noqa: BLE001 — crash-only loop
                 log.exception("reconcile pass failed")
                 self.metrics.inc("reconcile_errors")
-            time.sleep(interval_seconds)
+            wake.wait(timeout=interval_seconds)
+            wake.clear()
 
     # ---- scale-up ------------------------------------------------------ #
 

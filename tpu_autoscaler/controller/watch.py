@@ -1,0 +1,42 @@
+"""Level-triggered wake-up for the reconcile loop.
+
+The reference's detection latency was bounded by its poll period
+(main.py --sleep, default ~60 s; SURVEY.md §7).  Here a background thread
+holds a pod watch open against the apiserver and pokes an Event whenever
+anything changes; the loop sleeps on that Event with the poll interval as a
+*fallback*, so detection is near-instant when the watch is healthy and no
+worse than the reference when it is not (crash-only: watch errors just mean
+we fall back to polling until the watch re-establishes).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+class WatchTrigger(threading.Thread):
+    def __init__(self, client, wake: threading.Event,
+                 timeout_seconds: int = 60):
+        super().__init__(daemon=True, name="pod-watch")
+        self._client = client
+        self._wake = wake
+        self._timeout = timeout_seconds
+        self._stopped = threading.Event()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                for _event in self._client.watch_pods(self._timeout):
+                    self._wake.set()
+                    if self._stopped.is_set():
+                        return
+            except Exception:  # noqa: BLE001 — degrade to poll-only
+                log.warning("pod watch failed; retrying", exc_info=True)
+                if self._stopped.wait(5.0):
+                    return

@@ -124,3 +124,22 @@ class RestKubeClient:
 
     def delete_node(self, name: str) -> None:
         self._mutate("DELETE", f"/api/v1/nodes/{name}")
+
+    def watch_pods(self, timeout_seconds: int = 60):
+        """Yield pod watch events (dicts) until the server closes the watch.
+
+        Level-trigger upgrade over the reference's poll-sleep loop
+        (main.py --sleep): the controller wakes the moment a pod changes
+        instead of up to one poll period later.  Used via
+        ``tpu_autoscaler.controller.watch.WatchTrigger``.
+        """
+        import json as _json
+
+        r = self._session.get(
+            f"{self._base}/api/v1/pods"
+            f"?watch=1&timeoutSeconds={timeout_seconds}",
+            stream=True, timeout=timeout_seconds + 10)
+        r.raise_for_status()
+        for line in r.iter_lines():
+            if line:
+                yield _json.loads(line)
