@@ -1,0 +1,87 @@
+"""Kubeconfig parsing + JSON logging tests."""
+
+import base64
+import json
+import logging
+
+import yaml
+
+from tpu_autoscaler.k8s.client import RestKubeClient
+from tpu_autoscaler.logging_setup import JsonFormatter, setup_logging
+
+
+def write_kubeconfig(tmp_path, user, cluster_extra=None, name="ctx"):
+    cfg = {
+        "current-context": name,
+        "contexts": [{"name": name,
+                      "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1",
+                      "cluster": {"server": "https://1.2.3.4:6443",
+                                  **(cluster_extra or {})}}],
+        "users": [{"name": "u1", "user": user}],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+class TestKubeconfig:
+    def test_token_auth(self, tmp_path):
+        path = write_kubeconfig(tmp_path, {"token": "sekrit"},
+                                {"insecure-skip-tls-verify": True})
+        client = RestKubeClient.from_kubeconfig(path)
+        assert client._base == "https://1.2.3.4:6443"
+        assert client._session.headers["Authorization"] == "Bearer sekrit"
+        assert client._session.verify is False
+
+    def test_client_cert_data_materialized(self, tmp_path):
+        cert = base64.b64encode(b"CERT").decode()
+        key = base64.b64encode(b"KEY").decode()
+        ca = base64.b64encode(b"CA").decode()
+        path = write_kubeconfig(
+            tmp_path,
+            {"client-certificate-data": cert, "client-key-data": key},
+            {"certificate-authority-data": ca})
+        client = RestKubeClient.from_kubeconfig(path)
+        certfile, keyfile = client._session.cert
+        assert open(certfile, "rb").read() == b"CERT"
+        assert open(keyfile, "rb").read() == b"KEY"
+        assert open(client._session.verify, "rb").read() == b"CA"
+
+    def test_explicit_context(self, tmp_path):
+        cfg = {
+            "current-context": "other",
+            "contexts": [
+                {"name": "other",
+                 "context": {"cluster": "c2", "user": "u1"}},
+                {"name": "mine",
+                 "context": {"cluster": "c1", "user": "u1"}},
+            ],
+            "clusters": [
+                {"name": "c1", "cluster": {"server": "https://right:6443",
+                                           "insecure-skip-tls-verify": True}},
+                {"name": "c2", "cluster": {"server": "https://wrong:6443",
+                                           "insecure-skip-tls-verify": True}},
+            ],
+            "users": [{"name": "u1", "user": {"token": "t"}}],
+        }
+        path = tmp_path / "kc"
+        path.write_text(yaml.safe_dump(cfg))
+        client = RestKubeClient.from_kubeconfig(str(path), context="mine")
+        assert client._base == "https://right:6443"
+
+
+class TestJsonLogging:
+    def test_formatter_emits_json(self):
+        record = logging.LogRecord("x.y", logging.WARNING, "f.py", 1,
+                                   "count=%d", (3,), None)
+        line = JsonFormatter().format(record)
+        parsed = json.loads(line)
+        assert parsed["level"] == "WARNING"
+        assert parsed["logger"] == "x.y"
+        assert parsed["msg"] == "count=3"
+
+    def test_setup_idempotent(self):
+        setup_logging(json_format=True)
+        setup_logging(json_format=False)
+        assert len(logging.getLogger().handlers) == 1

@@ -20,7 +20,12 @@ import dataclasses
 import logging
 import time
 
-from tpu_autoscaler.actuators.base import FAILED, Actuator, in_flight_of
+from tpu_autoscaler.actuators.base import (
+    ACTIVE,
+    FAILED,
+    Actuator,
+    in_flight_of,
+)
 from tpu_autoscaler.engine.planner import Planner, PoolPolicy
 from tpu_autoscaler.k8s.client import KubeClient
 from tpu_autoscaler.k8s.gangs import Gang, group_into_gangs
@@ -82,6 +87,8 @@ class Controller:
         # Retry-at times after failed provisions, per gang key and (for
         # gang-less spare provisions) per shape name.
         self._retry_at: dict[object, float] = {}
+        # Provision submit times, for the provision_latency_seconds metric.
+        self._submitted_at: dict[str, float] = {}
         # Units the operator (or spot reclamation) asked us to evacuate.
         self._requested_drains: set[str] = set()
 
@@ -114,6 +121,8 @@ class Controller:
         # whose pods are gone re-report if re-created, which is desired).
         live_status_ids = {s.id for s in self.actuator.statuses()}
         self._seen_failures &= live_status_ids
+        self._submitted_at = {k: v for k, v in self._submitted_at.items()
+                              if k in live_status_ids}
         live_gang_keys = {p.gang_key for p in pods}
         self._reported_unsatisfiable &= live_gang_keys
         for key in [k for k, t in self._retry_at.items()
@@ -168,6 +177,7 @@ class Controller:
             status = self.actuator.provision(req)
             log.info("provisioning %s x%d (%s): %s", req.shape_name,
                      req.count, status.id, req.reason)
+            self._submitted_at[status.id] = now
             self.metrics.inc("provisions_submitted")
             if req.kind == "tpu-slice":
                 self.metrics.observe("stranded_chips", req.stranded_chips)
@@ -181,6 +191,13 @@ class Controller:
                 self.notifier.notify(f"cannot satisfy {gang.name}: {reason}")
 
     def _note_failures(self, now: float) -> None:
+        # Submit→ACTIVE latency per provision (the actuation slice of the
+        # north-star budget; SURVEY.md §4.2 latency anatomy).
+        for status in self.actuator.statuses():
+            if status.state == ACTIVE and status.id in self._submitted_at:
+                self.metrics.observe(
+                    "provision_latency_seconds",
+                    now - self._submitted_at.pop(status.id))
         for status in self.actuator.statuses():
             if status.state == FAILED and status.id not in self._seen_failures:
                 self._seen_failures.add(status.id)

@@ -54,6 +54,57 @@ class RestKubeClient:
     3. ``$KUBERNETES_SERVICE_HOST`` env (in-cluster without mounts).
     """
 
+    @classmethod
+    def from_kubeconfig(cls, path: str, context: str | None = None,
+                        dry_run: bool = False) -> "RestKubeClient":
+        """Build a client from a kubeconfig file (reference parity:
+        main.py --kubeconfig).  Supports token auth, client certificates,
+        and inline base64 ``*-data`` fields (materialized to temp files)."""
+        import base64
+        import tempfile
+
+        import yaml
+
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+
+        def by_name(items, name):
+            for item in items or []:
+                if item.get("name") == name:
+                    return item
+            raise KeyError(f"{name!r} not found in kubeconfig")
+
+        ctx_name = context or cfg.get("current-context")
+        ctx = by_name(cfg.get("contexts"), ctx_name)["context"]
+        cluster = by_name(cfg.get("clusters"), ctx["cluster"])["cluster"]
+        user = by_name(cfg.get("users"), ctx["user"])["user"]
+
+        def materialize(data_b64: str, suffix: str) -> str:
+            f = tempfile.NamedTemporaryFile(delete=False, suffix=suffix)
+            f.write(base64.b64decode(data_b64))
+            f.close()
+            return f.name
+
+        ca: str | bool = True
+        if cluster.get("certificate-authority"):
+            ca = cluster["certificate-authority"]
+        elif cluster.get("certificate-authority-data"):
+            ca = materialize(cluster["certificate-authority-data"], ".crt")
+        elif cluster.get("insecure-skip-tls-verify"):
+            ca = False
+
+        client = cls(base_url=cluster["server"], token=user.get("token"),
+                     ca_cert=ca, dry_run=dry_run)
+        cert = user.get("client-certificate") or (
+            materialize(user["client-certificate-data"], ".crt")
+            if user.get("client-certificate-data") else None)
+        key = user.get("client-key") or (
+            materialize(user["client-key-data"], ".key")
+            if user.get("client-key-data") else None)
+        if cert and key:
+            client._session.cert = (cert, key)
+        return client
+
     def __init__(self, base_url: str | None = None, token: str | None = None,
                  ca_cert: str | bool = True, dry_run: bool = False):
         import requests  # local import: tests never touch this class

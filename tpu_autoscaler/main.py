@@ -12,7 +12,6 @@ ctor → loop-with-sleep (SURVEY.md §3.1).  Differences, deliberate:
 
 from __future__ import annotations
 
-import logging
 import sys
 
 import click
@@ -85,6 +84,8 @@ _common = [
     click.option("--slack-channel", default=None),
     click.option("--metrics-port", default=0, show_default=True,
                  help="Serve /metrics and /healthz on this port (0=off)."),
+    click.option("--log-json", is_flag=True,
+                 help="Emit structured JSON log lines."),
     click.option("-v", "--verbose", is_flag=True),
 ]
 
@@ -99,11 +100,11 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            drain_grace, spare_agents, spare_slices, over_provision,
            default_generation, cpu_machine_type, max_cpu_nodes,
            max_total_chips, preemptible, no_scale, no_maintenance,
-           slack_hook, slack_channel, metrics_port, verbose) -> Controller:
-    logging.basicConfig(
-        level=logging.DEBUG if verbose else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-        stream=sys.stderr)
+           slack_hook, slack_channel, metrics_port, log_json,
+           verbose) -> Controller:
+    from tpu_autoscaler.logging_setup import setup_logging
+
+    setup_logging(verbose=verbose, json_format=log_json)
     notifier = (SlackNotifier(slack_hook, slack_channel) if slack_hook
                 else LogNotifier())
     metrics = Metrics()
@@ -130,6 +131,10 @@ def cli():
 @click.option("--kube-url", default=None,
               help="Apiserver URL (default: in-cluster).")
 @click.option("--kube-token", default=None)
+@click.option("--kubeconfig", default=None,
+              help="Path to a kubeconfig file (reference: --kubeconfig).")
+@click.option("--kube-context", default=None,
+              help="kubeconfig context name (default: current-context).")
 @click.option("--actuator", "actuator_kind", default="gke",
               type=click.Choice(["gke", "queued-resources"]),
               show_default=True)
@@ -138,13 +143,18 @@ def cli():
 @click.option("--cluster", default=None, help="GKE cluster name.")
 @click.option("--dry-run", is_flag=True,
               help="Log mutations instead of performing them.")
-def run(kube_url, kube_token, actuator_kind, project, location, cluster,
-        dry_run, sleep, **kw):
-    """Run against a real cluster (in-cluster or via --kube-url)."""
+def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
+        project, location, cluster, dry_run, sleep, **kw):
+    """Run against a real cluster (in-cluster, --kubeconfig, or
+    --kube-url)."""
     from tpu_autoscaler.k8s.client import RestKubeClient
 
-    kube = RestKubeClient(base_url=kube_url, token=kube_token,
-                          dry_run=dry_run)
+    if kubeconfig:
+        kube = RestKubeClient.from_kubeconfig(kubeconfig, kube_context,
+                                              dry_run=dry_run)
+    else:
+        kube = RestKubeClient(base_url=kube_url, token=kube_token,
+                              dry_run=dry_run)
     if actuator_kind == "gke":
         from tpu_autoscaler.actuators.gke import GkeNodePoolActuator
 
