@@ -1,0 +1,75 @@
+"""CLI-level tests via click's CliRunner (reference parity: main.py flag
+surface, SURVEY.md §3.1)."""
+
+from click.testing import CliRunner
+
+from tpu_autoscaler.main import cli
+
+
+class TestDemoCommand:
+    def test_demo_cpu_scenario(self):
+        result = CliRunner().invoke(cli, [
+            "demo", "--scenario", "cpu", "--provision-delay", "30",
+            "--spare-agents", "0"])
+        assert result.exit_code == 0, result.output
+        assert "Unschedulable→Running" in result.output
+        assert "stranded 0" in result.output
+
+    def test_demo_timeout_reports_failure(self):
+        result = CliRunner().invoke(cli, [
+            "demo", "--scenario", "v5p-256", "--provision-delay", "500",
+            "--until", "100", "--spare-agents", "0"])
+        assert result.exit_code == 1
+        assert "FAILED" in result.output
+
+    def test_no_scale_flag_prevents_provisioning(self):
+        result = CliRunner().invoke(cli, [
+            "demo", "--scenario", "cpu", "--no-scale", "--until", "60",
+            "--spare-agents", "0"])
+        assert result.exit_code == 1  # pod never runs
+
+    def test_bad_spare_slice_rejected(self):
+        result = CliRunner().invoke(cli, [
+            "demo", "--scenario", "cpu", "--spare-slice", "bogus=2"])
+        assert result.exit_code == 2
+        assert "unknown slice shape" in result.output
+
+    def test_sleep_zero_rejected(self):
+        result = CliRunner().invoke(cli, [
+            "demo", "--scenario", "cpu", "--sleep", "0"])
+        assert result.exit_code == 2
+
+    def test_help_lists_reference_parity_flags(self):
+        result = CliRunner().invoke(cli, ["demo", "--help"])
+        for flag in ("--sleep", "--idle-threshold", "--spare-agents",
+                     "--over-provision", "--no-scale", "--no-maintenance",
+                     "--slack-hook"):
+            assert flag in result.output
+
+    def test_run_requires_cluster_identifiers(self):
+        result = CliRunner().invoke(cli, [
+            "run", "--kube-url", "https://example:6443",
+            "--actuator", "gke"])
+        assert result.exit_code != 0
+        assert "needs" in str(result.exception or result.output)
+
+
+class TestScalePerfSmoke:
+    def test_planner_handles_hundreds_of_gangs_quickly(self):
+        import time
+
+        from tpu_autoscaler.engine.planner import Planner, PoolPolicy
+        from tpu_autoscaler.k8s.gangs import group_into_gangs
+        from tpu_autoscaler.k8s.objects import Pod
+        from tests.fixtures import make_tpu_pod
+
+        pods = [Pod(make_tpu_pod(name=f"p{i}", chips=8, job=f"job-{i}"))
+                for i in range(300)]
+        gangs = group_into_gangs(pods)
+        planner = Planner(PoolPolicy(spare_nodes=0, max_total_chips=10**6))
+        t0 = time.perf_counter()
+        plan = planner.plan(gangs, [], pods, [])
+        elapsed = time.perf_counter() - t0
+        assert len(plan.requests) == 300
+        # O(gangs x shapes); must stay far inside one reconcile interval.
+        assert elapsed < 1.0, f"planner took {elapsed:.2f}s for 300 gangs"

@@ -188,3 +188,22 @@ class TestReviewRegressions:
                         bound_pods=[ds],
                         policy=PoolPolicy(spare_nodes=1))
         assert plan.empty
+
+    def test_memory_bound_slots_not_oversubscribed(self):
+        """Review regression: slot count must bind on EVERY resource axis.
+
+        A free slice whose hosts have chips for 2 pods but memory for only
+        1 must NOT satisfy a gang needing 2 pods per host."""
+        from tests.fixtures import make_tpu_pod
+        from tpu_autoscaler.topology import shape_by_name
+
+        shape = shape_by_name("v5e-8")  # 1 host, 8 chips, 400Gi
+        pods = [make_tpu_pod(name=f"m{i}", chips=4, shape=shape, job="mem",
+                             requests={"google.com/tpu": "4",
+                                       "memory": "300Gi"})
+                for i in range(2)]  # 2 pods x 300Gi > 400Gi host memory
+        plan = plan_for(pods, node_payloads=make_slice_nodes(shape, "free"))
+        # The free slice cannot host both pods; the gang must be reported
+        # unsatisfiable (no single v5e host fits 2x300Gi), not silently
+        # matched to the free slice.
+        assert plan.unsatisfiable or plan.requests

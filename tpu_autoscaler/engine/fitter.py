@@ -46,29 +46,46 @@ def _generation_of_accelerator(accelerator: str) -> str | None:
     return None
 
 
+def host_slots(allocatable: ResourceVector, per_pod: ResourceVector) -> int:
+    """How many copies of ``per_pod`` fit in one host's ``allocatable`` —
+    the binding constraint on EVERY resource axis (a host with chips for 2
+    pods but memory for 1 holds 1)."""
+    slots = None
+    for key, req in per_pod.as_dict().items():
+        if req <= 0:
+            continue
+        fit = int(allocatable.get(key) // req)
+        slots = fit if slots is None else min(slots, fit)
+    return 1 if slots is None else slots  # zero-request pod: 1 per host
+
+
 def shape_feasible_for_gang(shape: SliceShape, gang: Gang) -> str | None:
     """Why ``gang`` cannot run on one ``shape`` slice, or None if it can.
 
     A pod cannot span hosts, so total-chip arithmetic alone is not enough:
-    each member pod's chip request must fit one host, and there must be
-    enough host slots for all members (a host holds floor(chips_per_host /
-    per_pod_chips) members).  Without this check the planner would provision
-    a slice the scheduler can never bind, see it free next pass, and
-    provision another — a runaway loop.
+    each member pod must fit one host on every resource axis, and there
+    must be enough host slots for all members.  Without this check the
+    planner would provision a slice the scheduler can never bind, see it
+    free next pass, and provision another — a runaway loop.
     """
     chips = gang.tpu_chips
-    per_pod = int(gang.per_pod_resources.get(TPU_RESOURCE))
+    per_pod = gang.per_pod_resources
+    per_pod_chips = int(per_pod.get(TPU_RESOURCE))
     if chips > shape.chips:
         return (f"demands {chips} chips, shape {shape.name} has "
                 f"{shape.chips}")
-    if per_pod > shape.chips_per_host:
-        return (f"pod requests {per_pod} chips but {shape.name} hosts "
-                f"expose {shape.chips_per_host}")
-    if per_pod > 0:
-        slots = shape.hosts * (shape.chips_per_host // per_pod)
-        if gang.size > slots:
-            return (f"{gang.size} pods need {gang.size} host slots, "
-                    f"{shape.name} has {slots}")
+    if per_pod_chips > shape.chips_per_host:
+        return (f"pod requests {per_pod_chips} chips but {shape.name} "
+                f"hosts expose {shape.chips_per_host}")
+    host_capacity = ResourceVector(
+        {k: v for k, v in shape.node_capacity().items()})
+    if not per_pod.fits_in(host_capacity):
+        return (f"pod request {per_pod!r} exceeds one {shape.name} host's "
+                f"capacity")
+    slots = shape.hosts * host_slots(host_capacity, per_pod)
+    if gang.size > slots:
+        return (f"{gang.size} pods need {gang.size} host slots, "
+                f"{shape.name} has {slots}")
     return None
 
 

@@ -23,6 +23,7 @@ from tpu_autoscaler.engine.fitter import (
     FitError,
     choose_shape_for_gang,
     free_capacity,
+    host_slots,
     pack_cpu_pods,
 )
 from tpu_autoscaler.k8s.gangs import Gang
@@ -135,8 +136,13 @@ def _slice_satisfies(members: list[Node], gang: Gang) -> bool:
     total_chips = sum(int(n.allocatable.get(TPU_RESOURCE)) for n in members)
     if total_chips < gang.tpu_chips:
         return False
-    # Each member pod must fit on one host of this slice.
-    return any(gang.per_pod_resources.fits_in(n.allocatable) for n in members)
+    # Slot math mirrors fitter.shape_feasible_for_gang: a pod cannot span
+    # hosts, so count how many member pods each host can hold — the
+    # binding constraint on EVERY resource axis, not just chips (a host
+    # with chips for 2 pods but memory for 1 holds 1).
+    per_pod = gang.per_pod_resources
+    slots = sum(host_slots(n.allocatable, per_pod) for n in members)
+    return slots >= gang.size
 
 
 class Planner:

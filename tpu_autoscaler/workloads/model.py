@@ -37,6 +37,16 @@ class ModelConfig:
     d_ff: int = 512
     seq_len: int = 64
     dtype: Any = jnp.bfloat16
+    # "einsum" (default; auto-partitions under pjit) or "pallas" (fused
+    # VMEM-resident kernel, workloads/attention.py — single-device or
+    # shard_map use; XLA cannot auto-partition a custom kernel).
+    attention: str = "einsum"
+
+    def __post_init__(self) -> None:
+        if self.attention not in {"einsum", "pallas"}:
+            raise ValueError(
+                f"unknown attention impl {self.attention!r}; "
+                "expected 'einsum' or 'pallas'")
 
     @property
     def head_dim(self) -> int:
@@ -83,11 +93,18 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    if cfg.attention == "pallas":
+        from tpu_autoscaler.workloads.attention import flash_attention
+
+        attn = flash_attention(
+            q, k, v, causal=True,
+            interpret=jax.default_backend() != "tpu")
+    else:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
     x = x + jnp.einsum("bsd,de->bse", attn,
                        layer["attn_out"].astype(cfg.dtype))
