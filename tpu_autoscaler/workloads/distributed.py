@@ -1,0 +1,128 @@
+"""Multi-host / multi-slice bootstrap for jobs under this autoscaler.
+
+The autoscaler provisions the hardware; this module is how the job side
+assembles it into a JAX system:
+
+- **multi-host, one slice** (BASELINE config #3, v5e-64 = 16 hosts): every
+  pod calls :func:`initialize_from_env` — coordinator address and process
+  index come from the GKE TPU environment (`TPU_WORKER_HOSTNAMES`,
+  `TPU_WORKER_ID`, injected by GKE on TPU node pools) — then builds one
+  (data, model) mesh over all chips; collectives ride ICI.
+- **multi-slice over DCN** (BASELINE config #4, 2×v5p-128): the mesh gains
+  a leading ``dcn`` axis (one coordinate per slice, from
+  `MEGASCALE_SLICE_ID` or the JobSet job index).  Batch shards over
+  (dcn, data) — only data-parallel gradient reductions cross DCN; tensor
+  parallelism stays inside each slice's ICI domain, matching how the
+  autoscaler provisions each slice atomically and composes slices over
+  DCN (SURVEY.md §6.8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Mapping
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """One process's view of the job topology, parsed from env."""
+
+    coordinator: str          # "host:port" of process 0
+    num_processes: int
+    process_id: int
+    slice_id: int = 0         # which DCN slice this host belongs to
+    num_slices: int = 1
+
+    @property
+    def single_process(self) -> bool:
+        return self.num_processes <= 1
+
+
+def parse_gke_tpu_env(env: Mapping[str, str] | None = None
+                      ) -> HostTopology | None:
+    """Read the GKE TPU env contract; None when not on a TPU node pool.
+
+    - ``TPU_WORKER_HOSTNAMES``: comma-separated hostnames of all workers
+      (pods) in this slice, index order == worker id;
+    - ``TPU_WORKER_ID``: this pod's index within the slice;
+    - ``MEGASCALE_SLICE_ID`` / ``MEGASCALE_NUM_SLICES``: multi-slice
+      coordinates (fall back to the JobSet job index label when absent).
+    """
+    env = os.environ if env is None else env
+    hostnames = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",")
+                 if h]
+    if not hostnames:
+        return None
+    worker_id = int(env.get("TPU_WORKER_ID", "0"))
+    slice_id = int(env.get("MEGASCALE_SLICE_ID",
+                           env.get("JOB_COMPLETION_INDEX", "0")) or 0)
+    num_slices = int(env.get("MEGASCALE_NUM_SLICES", "1") or 1)
+    hosts_per_slice = len(hostnames)
+    return HostTopology(
+        coordinator=f"{hostnames[0]}:{_COORDINATOR_PORT}",
+        num_processes=hosts_per_slice * num_slices,
+        process_id=slice_id * hosts_per_slice + worker_id,
+        slice_id=slice_id,
+        num_slices=num_slices,
+    )
+
+
+def initialize_from_env(env: Mapping[str, str] | None = None) -> HostTopology:
+    """Bring up jax.distributed from the GKE TPU environment.
+
+    Idempotent and safe single-host: without the env contract (local dev,
+    single-host v5e-8) it is a no-op returning a 1-process topology.
+    """
+    topo = parse_gke_tpu_env(env)
+    if topo is None or topo.single_process:
+        return topo or HostTopology(coordinator="localhost:0",
+                                    num_processes=1, process_id=0)
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=topo.coordinator,
+        num_processes=topo.num_processes,
+        process_id=topo.process_id)
+    log.info("jax.distributed up: process %d/%d (slice %d/%d)",
+             topo.process_id, topo.num_processes, topo.slice_id,
+             topo.num_slices)
+    return topo
+
+
+def make_multislice_mesh(num_slices: int, model: int = 2, devices=None):
+    """(dcn, data, model) mesh: TP inside slices, DP within and across.
+
+    On real multi-slice hardware prefer
+    ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` (it orders
+    devices so the ``dcn`` axis crosses slices); on homogeneous/virtual
+    device sets (tests, CPU) a plain reshape is used.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % (num_slices * model):
+        raise ValueError(
+            f"{n} devices not divisible by num_slices*model = "
+            f"{num_slices * model}")
+    data = n // (num_slices * model)
+    try:
+        from jax.experimental.mesh_utils import create_hybrid_device_mesh
+
+        arr = create_hybrid_device_mesh(
+            mesh_shape=(data, model), dcn_mesh_shape=(num_slices, 1),
+            devices=devices)
+        # hybrid mesh returns [dcn*data, model]-shaped? normalize below.
+        arr = np.asarray(arr).reshape(num_slices, data, model)
+    except Exception:  # noqa: BLE001 — virtual/CPU devices: plain reshape
+        arr = np.asarray(devices).reshape(num_slices, data, model)
+    return Mesh(arr, axis_names=("dcn", "data", "model"))

@@ -173,8 +173,18 @@ def param_specs(cfg: ModelConfig) -> dict:
     }
 
 
-def batch_spec() -> P:
-    return P("data", None)
+def batch_spec(mesh: Mesh | None = None) -> P:
+    """Batch sharding: every mesh axis except 'model' is data-parallel.
+
+    On a plain (data, model) mesh this is P("data", None); on a
+    multi-slice (dcn, data, model) mesh the batch shards over
+    ("dcn", "data") — DP across slices over DCN, TP inside each slice
+    over ICI (workloads/distributed.py).
+    """
+    if mesh is None:
+        return P("data", None)
+    data_axes = tuple(n for n in mesh.axis_names if n != "model")
+    return P(data_axes, None)
 
 
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
@@ -187,7 +197,7 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
     p_shard = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec), p_specs,
         is_leaf=lambda x: isinstance(x, P))
-    b_shard = NamedSharding(mesh, batch_spec())
+    b_shard = NamedSharding(mesh, batch_spec(mesh))
     replicated = NamedSharding(mesh, P())
 
     def init(key):

@@ -1,0 +1,102 @@
+"""Ring attention: sequence-parallel causal attention over a mesh axis.
+
+Long-context story for the in-tree workload (and the reason the autoscaler
+is slice-atomic in the first place): when a sequence is too long for one
+chip's HBM, shard it over the ICI ring.  Each device holds a sequence
+block of Q, K, V; K/V blocks rotate around the ring via ``lax.ppermute``
+(one ICI hop per step) while each device accumulates its Q block's
+attention with an online-softmax running (max, sum, acc) — so the full
+[s, s] score matrix never exists anywhere and the per-device memory is
+O(s_local²) compute-transient, O(s_local·d) resident.
+
+This is exactly the communication pattern the autoscaler must never
+bisect: the ring rides the ICI torus of ONE slice (provision atomically,
+drain atomically).  Multi-slice jobs keep sequence parallelism inside each
+slice and data/model parallelism across slices over DCN.
+
+Built with ``shard_map`` so the collective schedule is explicit; composes
+with data/model axes by adding them to the in/out specs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
+                     sm_scale: float):
+    """Per-device body under shard_map.
+
+    q, k, v: [b, h, s_local, d] — this device's sequence block.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32) * sm_scale
+    b, h, s_loc, d = qf.shape
+
+    def step(t, carry):
+        m, l, acc, k_t, v_t = carry
+        # k_t/v_t originated on device (my_idx - t) mod axis_size.
+        src = (my_idx - t) % axis_size
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                            k_t.astype(jnp.float32))   # [b,h,sq,sk]
+        if causal:
+            q_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+            # Global ordering by block: earlier block -> all visible;
+            # same block -> lower-triangular; later block -> none.
+            block_mask = jnp.where(
+                src < my_idx, True,
+                jnp.where(src == my_idx, q_pos >= k_pos, False))
+            scores = jnp.where(block_mask, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_t.astype(jnp.float32))
+        # Rotate K/V one hop around the ring (ICI neighbor exchange).
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_next = jax.lax.ppermute(k_t, axis_name, perm)
+        v_next = jax.lax.ppermute(v_t, axis_name, perm)
+        return m_new, l_new, acc_new, k_next, v_next
+
+    # pvary: the accumulators are per-device state (they will differ across
+    # the ring), so mark them varying over the axis or the fori_loop carry
+    # types mismatch under shard_map's varying-axis tracking.
+    m0 = jax.lax.pvary(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32),
+                       axis_name)
+    l0 = jax.lax.pvary(jnp.zeros((b, h, s_loc, 1), jnp.float32), axis_name)
+    acc0 = jax.lax.pvary(jnp.zeros((b, h, s_loc, d), jnp.float32),
+                         axis_name)
+    m, l, acc, _, _ = jax.lax.fori_loop(
+        0, axis_size, step, (m0, l0, acc0, k, v))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, seq_axis: str = "sp",
+                        causal: bool = True):
+    """Build a ring-attention callable for [b, h, s, d] arrays whose
+    sequence axis is sharded over ``mesh``'s ``seq_axis``.
+
+    Returns a function operating on GLOBAL arrays; shard_map handles the
+    decomposition and the ppermute schedule rides the mesh axis.
+    """
+    spec = P(None, None, seq_axis, None)
+
+    def attn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        d = q.shape[-1]
+        body = functools.partial(_ring_attn_local, axis_name=seq_axis,
+                                 causal=causal, sm_scale=d ** -0.5)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )(q, k, v)
+
+    return attn
