@@ -24,7 +24,8 @@ _uid = itertools.count(1)
 def make_pod(name="pod", namespace="default", requests=None, selectors=None,
              phase="Pending", unschedulable=True, node_name=None,
              labels=None, annotations=None, owner_kind=None,
-             created="2026-07-28T12:00:00Z", priority_class=None):
+             created="2026-07-28T12:00:00Z", priority_class=None,
+             tolerations=None):
     """Build a pod payload dict. Default: a pending Unschedulable pod."""
     conditions = []
     if phase == "Pending" and unschedulable and not node_name:
@@ -45,6 +46,7 @@ def make_pod(name="pod", namespace="default", requests=None, selectors=None,
                 "resources": {"requests": requests or {}},
             }],
             "nodeSelector": selectors or {},
+            "tolerations": tolerations or [],
         },
         "status": {"phase": phase, "conditions": conditions},
     }
@@ -60,7 +62,11 @@ def make_pod(name="pod", namespace="default", requests=None, selectors=None,
 
 def make_tpu_pod(name="tpu-pod", chips=8, shape=None, job=None,
                  jobset=None, job_index=None, **kw):
-    """A pod requesting TPU chips, with the GKE selector contract."""
+    """A pod requesting TPU chips, with the GKE selector + toleration
+    contract."""
+    kw.setdefault("tolerations", [{"key": TPU_RESOURCE,
+                                   "operator": "Exists",
+                                   "effect": "NoSchedule"}])
     selectors = dict(kw.pop("selectors", {}))
     if shape is not None:
         selectors.setdefault(ACCELERATOR_LABEL, shape.accelerator_type)
@@ -92,7 +98,8 @@ def make_gang(shape, job="trainer", namespace="default", chips_per_pod=None,
 
 def make_node(name="node", capacity=None, labels=None, unschedulable=False,
               ready=True, created="2026-07-28T11:00:00Z",
-              instance_type="e2-standard-8", slice_id=None, pool=None):
+              instance_type="e2-standard-8", slice_id=None, pool=None,
+              taints=None):
     labels = dict(labels or {})
     if instance_type:
         labels.setdefault(INSTANCE_TYPE_LABEL, instance_type)
@@ -107,7 +114,8 @@ def make_node(name="node", capacity=None, labels=None, unschedulable=False,
             "labels": labels,
             "creationTimestamp": created,
         },
-        "spec": {"unschedulable": unschedulable},
+        "spec": {"unschedulable": unschedulable,
+                 "taints": taints or []},
         "status": {
             "allocatable": capacity or {"cpu": "7910m", "memory": "27Gi",
                                         "pods": "110"},
@@ -119,7 +127,9 @@ def make_node(name="node", capacity=None, labels=None, unschedulable=False,
 
 def make_tpu_node(shape, name=None, slice_id="slice-0", host_index=0,
                   pool=None, **kw):
-    """One host of a TPU slice, labeled per the GKE contract."""
+    """One host of a TPU slice, labeled + tainted per the GKE contract."""
+    kw.setdefault("taints", [{"key": TPU_RESOURCE, "value": "present",
+                              "effect": "NoSchedule"}])
     labels = dict(kw.pop("labels", {}))
     labels[ACCELERATOR_LABEL] = shape.accelerator_type
     labels[TOPOLOGY_LABEL] = shape.topology_label

@@ -173,3 +173,49 @@ class TestNode:
         evicted = node.drain(c, pods)
         assert evicted == 1
         assert c.calls == [("evict", "default", "app")]
+
+
+class TestTaints:
+    def taint(self):
+        return {"key": "google.com/tpu", "value": "present",
+                "effect": "NoSchedule"}
+
+    def test_pod_tolerates_exists(self):
+        pod = Pod(make_pod(tolerations=[{"key": "google.com/tpu",
+                                         "operator": "Exists",
+                                         "effect": "NoSchedule"}]))
+        assert pod.tolerates(self.taint())
+
+    def test_pod_tolerates_equal_value(self):
+        pod = Pod(make_pod(tolerations=[{"key": "google.com/tpu",
+                                         "operator": "Equal",
+                                         "value": "present"}]))
+        assert pod.tolerates(self.taint())  # empty effect matches all
+
+    def test_pod_does_not_tolerate(self):
+        assert not Pod(make_pod()).tolerates(self.taint())
+        wrong_val = Pod(make_pod(tolerations=[{
+            "key": "google.com/tpu", "operator": "Equal", "value": "no"}]))
+        assert not wrong_val.tolerates(self.taint())
+
+    def test_empty_key_exists_tolerates_everything(self):
+        pod = Pod(make_pod(tolerations=[{"operator": "Exists"}]))
+        assert pod.tolerates(self.taint())
+
+    def test_node_admits(self):
+        from tests.fixtures import make_tpu_node
+        from tpu_autoscaler.topology import shape_by_name
+
+        shape = shape_by_name("v5e-8")
+        node = Node(make_tpu_node(shape))
+        from tests.fixtures import make_tpu_pod
+
+        tolerating = Pod(make_tpu_pod(chips=8, shape=shape))
+        assert node.admits(tolerating)
+        bare = Pod(make_pod(selectors={}))
+        assert not node.admits(bare)  # taint not tolerated
+
+    def test_prefer_no_schedule_ignored(self):
+        node_payload = make_node(taints=[{"key": "x", "value": "y",
+                                          "effect": "PreferNoSchedule"}])
+        assert Node(node_payload).admits(Pod(make_pod()))

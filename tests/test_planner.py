@@ -207,3 +207,18 @@ class TestReviewRegressions:
         # unsatisfiable (no single v5e host fits 2x300Gi), not silently
         # matched to the free slice.
         assert plan.unsatisfiable or plan.requests
+
+    def test_tainted_free_slice_not_supply_for_non_tolerating_gang(self):
+        """A free TPU slice (tainted) must not satisfy a gang whose pods
+        lack the toleration — they can never bind there."""
+        from tests.fixtures import make_tpu_pod
+        from tpu_autoscaler.topology import shape_by_name
+
+        shape = shape_by_name("v5e-8")
+        pod = make_tpu_pod(name="no-tol", chips=8, shape=shape, job="j",
+                           tolerations=[])
+        plan = plan_for([pod], node_payloads=make_slice_nodes(shape, "s0"))
+        # Gang can't ride the free slice; a new slice is provisioned (the
+        # real GKE nodes will carry the same taint, but admission is the
+        # scheduler's problem then — the planner must not deadlock).
+        assert len(plan.requests) == 1

@@ -179,3 +179,79 @@ class TestUnhealthyDrainPath:
             broken.append(Node(p))
         view = tracker.observe("s1", broken, [], now=100.0)
         assert classify(view) is SliceState.DRAINING
+
+
+class TestUnderUtilized:
+    """Reference parity: UNDER_UTILIZED_DRAINABLE (cluster.py state
+    machine), rebuilt for CPU units only."""
+
+    def small_pod(self, node_name):
+        return Pod(make_pod(name="tiny", owner_kind="ReplicaSet",
+                            phase="Running", node_name=node_name,
+                            unschedulable=False,
+                            requests={"cpu": "200m", "memory": "256Mi"}))
+
+    def cpu_unit(self):
+        from tests.fixtures import make_node
+
+        return [Node(make_node(name="n1"))]
+
+    def test_low_utilization_drainable_pod(self):
+        tracker = SliceTracker()
+        nodes = self.cpu_unit()
+        tracker.observe("n1", nodes, [], now=0.0)
+        view = tracker.observe("n1", nodes, [self.small_pod("n1")],
+                               now=GRACE + 1)
+        state = classify_slice(view, grace_seconds=GRACE,
+                               idle_threshold_seconds=IDLE,
+                               utilization_threshold=0.5)
+        assert state is SliceState.UNDER_UTILIZED
+
+    def test_disabled_by_default(self):
+        tracker = SliceTracker()
+        nodes = self.cpu_unit()
+        tracker.observe("n1", nodes, [], now=0.0)
+        view = tracker.observe("n1", nodes, [self.small_pod("n1")],
+                               now=GRACE + 1)
+        assert classify(view) is SliceState.BUSY
+
+    def test_bare_pod_blocks_consolidation(self):
+        tracker = SliceTracker()
+        nodes = self.cpu_unit()
+        tracker.observe("n1", nodes, [], now=0.0)
+        bare = Pod(make_pod(name="bare", phase="Running", node_name="n1",
+                            unschedulable=False,
+                            requests={"cpu": "100m"}))
+        view = tracker.observe("n1", nodes, [bare], now=GRACE + 1)
+        state = classify_slice(view, grace_seconds=GRACE,
+                               idle_threshold_seconds=IDLE,
+                               utilization_threshold=0.5)
+        assert state is SliceState.BUSY
+
+    def test_tpu_slice_never_under_utilized(self):
+        tracker = SliceTracker()
+        nodes = slice_nodes("v5e-8", "s1")
+        tracker.observe("s1", nodes, [], now=0.0)
+        small = Pod(make_tpu_pod(name="w", chips=1, phase="Running",
+                                 node_name=nodes[0].name,
+                                 unschedulable=False, job="j",
+                                 requests={"google.com/tpu": "1",
+                                           "cpu": "100m"}))
+        view = tracker.observe("s1", nodes, [small], now=GRACE + 1)
+        state = classify_slice(view, grace_seconds=GRACE,
+                               idle_threshold_seconds=IDLE,
+                               utilization_threshold=0.9)
+        assert state is SliceState.BUSY
+
+    def test_high_utilization_stays_busy(self):
+        tracker = SliceTracker()
+        nodes = self.cpu_unit()
+        tracker.observe("n1", nodes, [], now=0.0)
+        big = Pod(make_pod(name="big", owner_kind="ReplicaSet",
+                           phase="Running", node_name="n1",
+                           unschedulable=False, requests={"cpu": "6"}))
+        view = tracker.observe("n1", nodes, [big], now=GRACE + 1)
+        state = classify_slice(view, grace_seconds=GRACE,
+                               idle_threshold_seconds=IDLE,
+                               utilization_threshold=0.5)
+        assert state is SliceState.BUSY

@@ -60,6 +60,11 @@ class ControllerConfig:
     # reference's blunt one-deployment-at-a-time serialization throttled
     # retries implicitly; we need it explicit).
     provision_retry_seconds: float = 60.0
+    # Consolidation: CPU units busier than idle but below this requested/
+    # allocatable fraction, with all pods movable, are drained so their
+    # pods repack onto other nodes (reference: UNDER_UTILIZED_DRAINABLE).
+    # 0.0 disables (default: consolidation moves pods, opt in explicitly).
+    utilization_threshold: float = 0.0
     # Reference parity flags (main.py --no-scale / --no-maintenance).
     no_scale: bool = False
     no_maintenance: bool = False
@@ -309,6 +314,10 @@ class Controller:
         units = self._units(nodes)
         spare_ids = self._spare_units(units, pods_by_node)
         state_counts: dict[str, int] = {}
+        # At most one consolidation drain per pass: gentle repacking, no
+        # mass eviction (the reference drained under-utilized nodes one
+        # loop iteration at a time too, by construction).
+        consolidated_this_pass = False
 
         for unit_id, unit_nodes in units.items():
             unit_pods = [p for n in unit_nodes
@@ -317,7 +326,8 @@ class Controller:
             state = classify_slice(
                 view, grace_seconds=cfg.grace_seconds,
                 idle_threshold_seconds=cfg.idle_threshold_seconds,
-                spare=unit_id in spare_ids)
+                spare=unit_id in spare_ids,
+                utilization_threshold=cfg.utilization_threshold)
             state_counts[state.value] = state_counts.get(state.value, 0) + 1
 
             try:
@@ -330,6 +340,15 @@ class Controller:
                     self._begin_drain(
                         unit_id, unit_nodes, unit_pods, now,
                         reason=f"idle > {cfg.idle_threshold_seconds:g}s")
+                elif (state is SliceState.UNDER_UTILIZED
+                      and not consolidated_this_pass):
+                    consolidated_this_pass = True
+                    self.metrics.inc("consolidation_drains")
+                    self._begin_drain(
+                        unit_id, unit_nodes, unit_pods, now,
+                        reason=(f"under-utilized "
+                                f"({view.utilization:.0%} < "
+                                f"{cfg.utilization_threshold:.0%})"))
                 elif state is SliceState.DRAINING:
                     self._continue_drain(unit_id, unit_nodes, unit_pods, now)
                 elif state is SliceState.UNHEALTHY:

@@ -159,7 +159,9 @@ def free_capacity(nodes: list[Node], pods: list[Pod]) -> dict[str, ResourceVecto
 
 
 def pack_cpu_pods(pods: list[Pod], free: dict[str, ResourceVector],
-                  unit: CpuShape) -> tuple[int, list[Pod]]:
+                  unit: CpuShape,
+                  nodes_by_name: dict[str, Node] | None = None
+                  ) -> tuple[int, list[Pod]]:
     """First-fit pending CPU pods into free capacity.
 
     Returns ``(new_nodes_needed, unplaceable_pods)``.  Reference parity:
@@ -176,6 +178,9 @@ def pack_cpu_pods(pods: list[Pod], free: dict[str, ResourceVector],
     for pod in pods:
         placed = False
         for name, cap in free.items():
+            node = (nodes_by_name or {}).get(name)
+            if node is not None and not node.admits(pod):
+                continue
             if pod.resources.fits_in(cap):
                 free[name] = cap - pod.resources
                 placed = True

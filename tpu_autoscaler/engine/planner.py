@@ -130,8 +130,10 @@ def _free_slices(nodes: list[Node], pods: list[Pod]) -> dict[str, list[Node]]:
 
 
 def _slice_satisfies(members: list[Node], gang: Gang) -> bool:
-    selectors = gang.node_selectors
-    if not all(n.matches_selectors(selectors) for n in members):
+    # Selector + taint admission, checked with a representative member pod
+    # (gang members share a template).
+    probe = gang.pods[0] if gang.pods else None
+    if probe is None or not all(n.admits(probe) for n in members):
         return False
     total_chips = sum(int(n.allocatable.get(TPU_RESOURCE)) for n in members)
     if total_chips < gang.tpu_chips:
@@ -227,8 +229,9 @@ class Planner:
         pending_cpu = [p for p in cpu_pods if p.is_unschedulable]
         inflight_cpu = sum(f.count for f in in_flight
                            if f.kind == "cpu-node")
-        demand_needed, unplaceable = pack_cpu_pods(pending_cpu, free_cpu,
-                                                   pol.cpu_shape)
+        demand_needed, unplaceable = pack_cpu_pods(
+            pending_cpu, free_cpu, pol.cpu_shape,
+            nodes_by_name={n.name: n for n in cpu_nodes})
         if unplaceable:
             gang_by_key = {g.key: g for g in gangs}
             reported: set[GangKey] = set()

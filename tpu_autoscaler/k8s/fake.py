@@ -120,7 +120,7 @@ class FakeKube:
                                        else 0, p.name)):
             target = next(
                 (n for n in nodes
-                 if n.name in free and n.matches_selectors(p.node_selectors)
+                 if n.name in free and n.admits(p)
                  and p.resources.fits_in(free[n.name])), None)
             payload = self._pods[(p.namespace, p.name)]
             if target is None:

@@ -69,6 +69,7 @@ class Pod:
         spec = payload.get("spec", {})
         self.node_name: str | None = spec.get("nodeName")
         self.node_selectors: dict[str, str] = dict(spec.get("nodeSelector") or {})
+        self.tolerations: list[dict] = list(spec.get("tolerations") or [])
         self.priority_class: str | None = spec.get("priorityClassName")
         self.resources = self._sum_requests(spec)
         status = payload.get("status", {})
@@ -172,6 +173,20 @@ class Pod:
 
     # -- gang identity ------------------------------------------------------
 
+    def tolerates(self, taint: Mapping) -> bool:
+        """Kubernetes toleration matching for one taint."""
+        for tol in self.tolerations:
+            op = tol.get("operator", "Equal")
+            key_match = (not tol.get("key")  # empty key + Exists: all
+                         or tol.get("key") == taint.get("key"))
+            value_match = (op == "Exists"
+                           or tol.get("value", "") == taint.get("value", ""))
+            effect_match = (not tol.get("effect")
+                            or tol.get("effect") == taint.get("effect"))
+            if key_match and value_match and effect_match:
+                return True
+        return False
+
     @property
     def gang_key(self) -> tuple[str, str, str]:
         """Demand-unit identity: pods sharing a key are one gang.
@@ -219,6 +234,7 @@ class Node:
         self.created = parse_time(meta.get("creationTimestamp"))
         spec = payload.get("spec", {})
         self.unschedulable: bool = bool(spec.get("unschedulable", False))
+        self.taints: list[dict] = list(spec.get("taints") or [])
         status = payload.get("status", {})
         self.allocatable = ResourceVector.from_raw(
             status.get("allocatable") or status.get("capacity"))
@@ -276,6 +292,20 @@ class Node:
 
     def matches_selectors(self, selectors: Mapping[str, str]) -> bool:
         return all(self.labels.get(k) == v for k, v in selectors.items())
+
+    def admits(self, pod: Pod) -> bool:
+        """Selector match + every NoSchedule/NoExecute taint tolerated.
+
+        GKE TPU node pools carry ``google.com/tpu=present:NoSchedule``; a
+        workload without the toleration can never land there, so the fit
+        engine must not count such supply for it (and vice versa: the fake
+        scheduler must not bind it).
+        """
+        if not self.matches_selectors(pod.node_selectors):
+            return False
+        return all(
+            pod.tolerates(t) for t in self.taints
+            if t.get("effect") in ("NoSchedule", "NoExecute"))
 
     # -- verbs --------------------------------------------------------------
 

@@ -45,7 +45,10 @@ def tpu_host_payload(shape: SliceShape, slice_id: str, host_index: int,
             "labels": labels,
             "creationTimestamp": _iso(created_at),
         },
-        "spec": {},
+        # GKE stamps the TPU taint on every TPU node; workloads must carry
+        # the matching toleration (deploy/example-v5e-64-jobset.yaml).
+        "spec": {"taints": [{"key": TPU_RESOURCE, "value": "present",
+                             "effect": "NoSchedule"}]},
         "status": {
             "allocatable": {
                 "cpu": f"{shape.host_cpu_m}m",

@@ -64,6 +64,9 @@ _common = [
                  help="Post-launch grace seconds."),
     click.option("--drain-grace", default=120.0, show_default=True,
                  help="Checkpoint window before force-evicting."),
+    click.option("--utilization-threshold", default=0.0, show_default=True,
+                 help="Consolidate CPU nodes below this requested fraction "
+                      "(0 disables)."),
     click.option("--spare-agents", default=1, show_default=True,
                  help="Free CPU nodes kept warm (reference: --spare-agents)."),
     click.option("--spare-slice", "spare_slices", multiple=True,
@@ -97,7 +100,8 @@ def common_options(f):
 
 
 def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
-           drain_grace, spare_agents, spare_slices, over_provision,
+           drain_grace, utilization_threshold, spare_agents, spare_slices,
+           over_provision,
            default_generation, cpu_machine_type, max_cpu_nodes,
            max_total_chips, preemptible, no_scale, no_maintenance,
            slack_hook, slack_channel, metrics_port, log_json,
@@ -117,6 +121,7 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         grace_seconds=grace_period,
         idle_threshold_seconds=idle_threshold,
         drain_grace_seconds=drain_grace,
+        utilization_threshold=utilization_threshold,
         no_scale=no_scale, no_maintenance=no_maintenance)
     return Controller(kube, actuator, config, notifier, metrics)
 

@@ -22,6 +22,9 @@ from tpu_autoscaler.topology.catalog import (
 
 def _pod(name: str, requests: dict, selectors: dict | None = None,
          labels: dict | None = None, owner_kind: str | None = None) -> dict:
+    tolerations = ([{"key": TPU_RESOURCE, "operator": "Exists",
+                     "effect": "NoSchedule"}]
+                   if TPU_RESOURCE in requests else [])
     payload: dict = {
         "metadata": {"name": name, "namespace": "default",
                      "labels": labels or {},
@@ -30,6 +33,7 @@ def _pod(name: str, requests: dict, selectors: dict | None = None,
             "containers": [{"name": "main",
                             "resources": {"requests": requests}}],
             "nodeSelector": selectors or {},
+            "tolerations": tolerations,
         },
         "status": {"phase": "Pending", "conditions": [
             {"type": "PodScheduled", "status": "False",

@@ -33,6 +33,10 @@ class SliceState(str, enum.Enum):
     LAUNCH_GRACE = "launch-grace"
     # Workload pods running somewhere on the slice.
     BUSY = "busy"
+    # Busy, but below the utilization threshold with all pods movable —
+    # candidate for consolidation (reference: UNDER_UTILIZED_DRAINABLE;
+    # CPU units only, a TPU job always owns its whole slice).
+    UNDER_UTILIZED = "under-utilized"
     # No workload pods; idle shorter than the idle threshold.
     IDLE = "idle"
     # Idle beyond threshold and eligible to reclaim.
@@ -67,10 +71,29 @@ class SliceView:
                 if not p.is_daemonset and not p.is_mirrored
                 and p.phase in {"Pending", "Running"}]
 
+    @property
+    def utilization(self) -> float:
+        """Max over cpu/memory of requested/allocatable across the unit."""
+        used_cpu = used_mem = alloc_cpu = alloc_mem = 0.0
+        for n in self.nodes:
+            alloc_cpu += n.allocatable.get("cpu")
+            alloc_mem += n.allocatable.get("memory")
+        for p in self.workload_pods:
+            used_cpu += p.resources.get("cpu")
+            used_mem += p.resources.get("memory")
+        fracs = [used / alloc for used, alloc in
+                 ((used_cpu, alloc_cpu), (used_mem, alloc_mem)) if alloc > 0]
+        return max(fracs) if fracs else 0.0
+
+    @property
+    def all_workload_drainable(self) -> bool:
+        return all(p.is_drainable for p in self.workload_pods)
+
 
 def classify_slice(view: SliceView, *, grace_seconds: float,
                    idle_threshold_seconds: float,
-                   spare: bool = False) -> SliceState:
+                   spare: bool = False,
+                   utilization_threshold: float = 0.0) -> SliceState:
     """Classify one slice. Pure function: all time comes in via the view."""
     nodes = view.nodes
     # A drain we initiated takes precedence over everything, including
@@ -90,6 +113,13 @@ def classify_slice(view: SliceView, *, grace_seconds: float,
         return SliceState.UNSCHEDULABLE
 
     if view.workload_pods:
+        past_grace = view.now - view.all_ready_since >= grace_seconds
+        if (utilization_threshold > 0.0
+                and past_grace
+                and not any(n.is_tpu for n in nodes)
+                and view.all_workload_drainable
+                and view.utilization < utilization_threshold):
+            return SliceState.UNDER_UTILIZED
         return SliceState.BUSY
 
     if view.now - view.all_ready_since < grace_seconds:
