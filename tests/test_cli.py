@@ -73,3 +73,15 @@ class TestScalePerfSmoke:
         assert len(plan.requests) == 300
         # O(gangs x shapes); must stay far inside one reconcile interval.
         assert elapsed < 1.0, f"planner took {elapsed:.2f}s for 300 gangs"
+
+
+class TestModuleEntry:
+    def test_python_dash_m_package(self):
+        import subprocess
+        import sys
+
+        result = subprocess.run(
+            [sys.executable, "-m", "tpu_autoscaler", "--help"],
+            capture_output=True, text=True, timeout=60)
+        assert result.returncode == 0
+        assert "demo" in result.stdout and "run" in result.stdout

@@ -309,3 +309,77 @@ class TestConsolidation:
         snap = controller.metrics.snapshot()
         assert snap["counters"]["consolidation_drains"] >= 1
         assert snap["counters"]["units_deleted"] >= 1
+
+
+class TestPendingClaimRace:
+    """Reference parity: a reclaimable unit that pending demand can use is
+    NOT drained (cluster.py: 'whether pending pods could use the node')."""
+
+    def test_idle_slice_spared_when_matching_gang_appears(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="one", chips=8, shape=shape,
+                                  job="j1"))
+        run_loop(kube, controller, stop_when=lambda: pod_running(kube,
+                                                                 "one"))
+        kube.delete_pod("default", "one")
+        # Let the slice cross the idle threshold WITHOUT reconciling past
+        # it, then drop in a matching gang at the exact reclaim moment.
+        t = 10.0
+        while t < 10.0 + IDLE - 5.0:
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 5.0
+        kube.add_pod(make_tpu_pod(name="two", chips=8, shape=shape,
+                                  job="j2"))
+        # The race pass: gang is pending (scheduler hasn't run yet) AND
+        # the slice is now past the idle threshold. The controller must
+        # defer the reclaim, not cordon supply the gang will bind.
+        controller.reconcile_once(now=10.0 + IDLE + 20.0)
+        assert not any(n["spec"].get("unschedulable")
+                       for n in kube.list_nodes())
+        t = 10.0 + IDLE + 25.0
+        for _ in range(5):
+            kube.schedule_step()
+            controller.reconcile_once(now=t)
+            t += 5.0
+        assert pod_running(kube, "two")
+        # Same slice reused; no cordon, no second provision.
+        assert len(kube.list_nodes()) == 1
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["provisions_submitted"] == 1
+        assert snap["counters"].get("drains_started", 0) == 0
+        assert snap["counters"]["reclaims_deferred_to_pending"] >= 1
+
+    def test_idle_cpu_node_spared_for_pending_cpu_pod(self):
+        kube, actuator, controller = make_harness()
+        kube.add_pod(make_pod(name="w1", requests={"cpu": "2"}))
+        run_loop(kube, controller, stop_when=lambda: pod_running(kube,
+                                                                 "w1"))
+        kube.delete_pod("default", "w1")
+        t = 10.0
+        while t < 10.0 + IDLE - 5.0:
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 5.0
+        kube.add_pod(make_pod(name="w2", requests={"cpu": "2"}))
+        for _ in range(5):
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 5.0
+        assert pod_running(kube, "w2")
+        assert len(kube.list_nodes()) == 1
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["provisions_submitted"] == 1
+
+
+class TestUnsatisfiableSurfacing:
+    def test_pods_annotated_with_reason(self):
+        kube, actuator, controller = make_harness()
+        kube.add_pod(make_tpu_pod(name="huge", chips=4096, job="huge"))
+        controller.reconcile_once(now=0.0)
+        pod = kube.get_pod("default", "huge-0") or kube.get_pod(
+            "default", "huge")
+        ann = pod["metadata"]["annotations"]
+        assert "autoscaler.tpu.dev/unsatisfiable" in ann
+        assert "no v5e shape" in ann["autoscaler.tpu.dev/unsatisfiable"]

@@ -1,0 +1,6 @@
+"""``python -m tpu_autoscaler`` entry point."""
+
+from tpu_autoscaler.main import cli
+
+if __name__ == "__main__":
+    cli()

@@ -26,6 +26,7 @@ from tpu_autoscaler.actuators.base import (
     Actuator,
     in_flight_of,
 )
+from tpu_autoscaler.engine.fitter import free_capacity
 from tpu_autoscaler.engine.planner import Planner, PoolPolicy
 from tpu_autoscaler.k8s.client import KubeClient
 from tpu_autoscaler.k8s.gangs import Gang, group_into_gangs
@@ -42,6 +43,10 @@ log = logging.getLogger(__name__)
 # checkpoint and exit cleanly before the drain deadline (BASELINE config #5;
 # see tpu_autoscaler.workloads.checkpoint for the job-side helper).
 CHECKPOINT_ANNOTATION = "autoscaler.tpu.dev/checkpoint-requested"
+
+# Stamped on pods of gangs the planner cannot satisfy (no catalog shape /
+# clamp exceeded), with the human-readable reason.
+UNSATISFIABLE_ANNOTATION = "autoscaler.tpu.dev/unsatisfiable"
 
 
 @dataclasses.dataclass
@@ -119,7 +124,7 @@ class Controller:
         if not self.config.no_scale:
             self._scale(gangs, nodes, pods, now)
         if not self.config.no_maintenance:
-            self._maintain(nodes, pods, now)
+            self._maintain(nodes, pods, now, pending_gangs=gangs)
 
         # Bound long-run memory: drop bookkeeping for demands/provisions
         # that no longer exist (actuators prune terminal statuses; gangs
@@ -194,6 +199,16 @@ class Controller:
                 log.warning("unsatisfiable %s: %s", gang, reason)
                 self.metrics.inc("unsatisfiable_gangs")
                 self.notifier.notify(f"cannot satisfy {gang.name}: {reason}")
+                # Stamp the verdict on the pods so `kubectl describe`
+                # answers "why is my job not scaling" without log access.
+                for pod in gang.pods:
+                    try:
+                        self.client.patch_pod(pod.namespace, pod.name, {
+                            "metadata": {"annotations": {
+                                UNSATISFIABLE_ANNOTATION: reason[:500]}}})
+                    except Exception:  # noqa: BLE001 — advisory only
+                        log.debug("could not annotate %s", pod.name,
+                                  exc_info=True)
 
     def _note_failures(self, now: float) -> None:
         # Submit→ACTIVE latency per provision (the actuation slice of the
@@ -303,8 +318,40 @@ class Controller:
             spare.update(tpu_idle[:want])
         return spare
 
+    def _claimed_by_pending(self, units: dict[str, list[Node]],
+                            pending_gangs: list[Gang],
+                            pods: list[Pod]) -> set[str]:
+        """Units that currently-pending demand will bind to: NOT drainable.
+
+        Reference parity: the reference's state machine checked "whether
+        pending pods could use the node" before reclaiming (cluster.py
+        §ClusterNodeState).  Without this, an idle slice can be cordoned
+        in the same pass a matching gang goes Pending — the planner
+        counted it as supply, so reclaiming it both strands the gang and
+        forces a redundant provision.
+        """
+        from tpu_autoscaler.engine.planner import _slice_satisfies
+
+        claimed: set[str] = set()
+        tpu_gangs = [g for g in pending_gangs if g.requests_tpu]
+        cpu_pods = [p for g in pending_gangs if not g.requests_tpu
+                    for p in g.pods]
+        for unit_id, unit_nodes in units.items():
+            if unit_nodes[0].is_tpu:
+                if any(_slice_satisfies(unit_nodes, g) for g in tpu_gangs):
+                    claimed.add(unit_id)
+            else:
+                free = free_capacity(unit_nodes, pods)
+                if any(node.admits(p) and p.resources.fits_in(cap)
+                       for p in cpu_pods
+                       for node in unit_nodes
+                       for name, cap in free.items()
+                       if name == node.name):
+                    claimed.add(unit_id)
+        return claimed
+
     def _maintain(self, nodes: list[Node], pods: list[Pod],
-                  now: float) -> None:
+                  now: float, pending_gangs: list[Gang] = ()) -> None:
         cfg = self.config
         pods_by_node: dict[str, list[Pod]] = {}
         for p in pods:
@@ -313,6 +360,8 @@ class Controller:
 
         units = self._units(nodes)
         spare_ids = self._spare_units(units, pods_by_node)
+        claimed_ids = self._claimed_by_pending(units, list(pending_gangs),
+                                               pods)
         state_counts: dict[str, int] = {}
         # At most one consolidation drain per pass: gentle repacking, no
         # mass eviction (the reference drained under-utilized nodes one
@@ -337,9 +386,14 @@ class Controller:
                     self._begin_drain(unit_id, unit_nodes, unit_pods, now,
                                       reason="drain requested")
                 elif state is SliceState.IDLE_DRAINABLE:
-                    self._begin_drain(
-                        unit_id, unit_nodes, unit_pods, now,
-                        reason=f"idle > {cfg.idle_threshold_seconds:g}s")
+                    if unit_id in claimed_ids:
+                        # Pending demand will bind here: hands off
+                        # (reference: pending pods could use the node).
+                        self.metrics.inc("reclaims_deferred_to_pending")
+                    else:
+                        self._begin_drain(
+                            unit_id, unit_nodes, unit_pods, now,
+                            reason=f"idle > {cfg.idle_threshold_seconds:g}s")
                 elif (state is SliceState.UNDER_UTILIZED
                       and not consolidated_this_pass):
                     consolidated_this_pass = True
