@@ -222,3 +222,30 @@ class TestReviewRegressions:
         # real GKE nodes will carry the same taint, but admission is the
         # scheduler's problem then — the planner must not deadlock).
         assert len(plan.requests) == 1
+
+    def test_extra_cpu_shapes_for_big_pods(self):
+        """Reference parity: multiple agent pools of different VM sizes."""
+        from tpu_autoscaler.topology.catalog import CPU_SHAPES
+
+        policy = PoolPolicy(
+            spare_nodes=0,
+            extra_cpu_shapes=(CPU_SHAPES["n2-standard-32"],))
+        plan = plan_for([make_pod(name="small", requests={"cpu": "2"}),
+                         make_pod(name="big", requests={"cpu": "16"})],
+                        policy=policy)
+        by_machine = {r.shape_name: r.count for r in plan.requests}
+        # The big pod opens one n2-standard-32; the small pod first-fits
+        # into that unit's remaining capacity — one node total.
+        assert by_machine == {"n2-standard-32": 1}
+        assert not plan.unsatisfiable
+
+    def test_unplaceable_mentions_all_shapes(self):
+        from tpu_autoscaler.topology.catalog import CPU_SHAPES
+
+        policy = PoolPolicy(
+            spare_nodes=0,
+            extra_cpu_shapes=(CPU_SHAPES["n2-standard-16"],))
+        plan = plan_for([make_pod(name="huge", requests={"cpu": "64"})],
+                        policy=policy)
+        assert plan.unsatisfiable
+        assert "n2-standard-16" in plan.unsatisfiable[0][1]

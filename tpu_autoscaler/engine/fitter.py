@@ -9,6 +9,7 @@ path keeps the reference's first-fit whole-node accumulation.
 from __future__ import annotations
 
 import dataclasses
+from typing import Sequence
 
 from tpu_autoscaler.k8s.gangs import Gang
 from tpu_autoscaler.k8s.objects import Node, Pod
@@ -158,22 +159,28 @@ def free_capacity(nodes: list[Node], pods: list[Pod]) -> dict[str, ResourceVecto
     return free
 
 
-def pack_cpu_pods(pods: list[Pod], free: dict[str, ResourceVector],
-                  unit: CpuShape,
-                  nodes_by_name: dict[str, Node] | None = None
-                  ) -> tuple[int, list[Pod]]:
-    """First-fit pending CPU pods into free capacity.
+def pack_cpu_pods_multi(pods: list[Pod], free: dict[str, ResourceVector],
+                        shapes: Sequence[CpuShape],
+                        nodes_by_name: dict[str, Node] | None = None
+                        ) -> tuple[dict[str, int], list[Pod]]:
+    """First-fit pending CPU pods into free capacity, then into new nodes.
 
-    Returns ``(new_nodes_needed, unplaceable_pods)``.  Reference parity:
-    cluster.py §Cluster.scale's "first-fit bin-packing of KubeResource
-    requests into whole-node units".  ``free`` is mutated as pods are placed
-    so callers pass a fresh copy.  Pods that could never fit even an empty
-    new unit are returned as unplaceable (never silently dropped, never
-    allowed to demand infinite nodes).
+    Returns ``(new_nodes_per_machine_type, unplaceable_pods)``.  Reference
+    parity: cluster.py §Cluster.scale first-fit packed pods into whole
+    agent-pool units and the cluster could have several pools of different
+    VM sizes — here ``shapes`` plays that role; a pod that overflows
+    existing capacity opens a unit of the SMALLEST machine type that fits
+    it.  ``free`` is mutated as pods are placed so callers pass a fresh
+    copy.  Pods that fit no machine type are returned as unplaceable
+    (never silently dropped).
     """
-    unit_capacity = ResourceVector(
-        {k: v for k, v in unit.node_capacity().items()})
-    new_units: list[ResourceVector] = []
+    shapes = sorted(shapes, key=lambda s: (s.cpu_m, s.memory))
+    capacities = {
+        s.machine_type: ResourceVector(
+            {k: v for k, v in s.node_capacity().items()})
+        for s in shapes
+    }
+    new_units: list[tuple[str, ResourceVector]] = []  # (machine, remaining)
     unplaceable: list[Pod] = []
     for pod in pods:
         placed = False
@@ -187,15 +194,32 @@ def pack_cpu_pods(pods: list[Pod], free: dict[str, ResourceVector],
                 break
         if placed:
             continue
-        for i, cap in enumerate(new_units):
+        for i, (machine, cap) in enumerate(new_units):
             if pod.resources.fits_in(cap):
-                new_units[i] = cap - pod.resources
+                new_units[i] = (machine, cap - pod.resources)
                 placed = True
                 break
         if placed:
             continue
-        if pod.resources.fits_in(unit_capacity):
-            new_units.append(unit_capacity - pod.resources)
-        else:
+        for shape in shapes:
+            cap = capacities[shape.machine_type]
+            if pod.resources.fits_in(cap):
+                new_units.append((shape.machine_type, cap - pod.resources))
+                placed = True
+                break
+        if not placed:
             unplaceable.append(pod)
-    return len(new_units), unplaceable
+    counts: dict[str, int] = {}
+    for machine, _ in new_units:
+        counts[machine] = counts.get(machine, 0) + 1
+    return counts, unplaceable
+
+
+def pack_cpu_pods(pods: list[Pod], free: dict[str, ResourceVector],
+                  unit: CpuShape,
+                  nodes_by_name: dict[str, Node] | None = None
+                  ) -> tuple[int, list[Pod]]:
+    """Single-machine-type convenience wrapper over pack_cpu_pods_multi."""
+    counts, unplaceable = pack_cpu_pods_multi(pods, free, [unit],
+                                              nodes_by_name)
+    return counts.get(unit.machine_type, 0), unplaceable

@@ -24,7 +24,7 @@ from tpu_autoscaler.engine.fitter import (
     choose_shape_for_gang,
     free_capacity,
     host_slots,
-    pack_cpu_pods,
+    pack_cpu_pods_multi,
 )
 from tpu_autoscaler.k8s.gangs import Gang
 from tpu_autoscaler.k8s.objects import Node, Pod
@@ -46,6 +46,10 @@ class PoolPolicy:
 
     default_generation: str = "v5e"
     cpu_shape: CpuShape = DEFAULT_CPU_SHAPE
+    # Additional CPU machine types: a pod too big for cpu_shape opens a
+    # node of the smallest extra shape that fits it (reference parity:
+    # multiple agent pools of different VM sizes).
+    extra_cpu_shapes: tuple[CpuShape, ...] = ()
     # Extra CPU nodes beyond computed demand (reference: --over-provision).
     over_provision_nodes: int = 0
     # Min free CPU nodes kept warm (reference: --spare-agents, default 1).
@@ -229,12 +233,14 @@ class Planner:
         pending_cpu = [p for p in cpu_pods if p.is_unschedulable]
         inflight_cpu = sum(f.count for f in in_flight
                            if f.kind == "cpu-node")
-        demand_needed, unplaceable = pack_cpu_pods(
-            pending_cpu, free_cpu, pol.cpu_shape,
+        cpu_shapes = (pol.cpu_shape, *pol.extra_cpu_shapes)
+        counts, unplaceable = pack_cpu_pods_multi(
+            pending_cpu, free_cpu, cpu_shapes,
             nodes_by_name={n.name: n for n in cpu_nodes})
         if unplaceable:
             gang_by_key = {g.key: g for g in gangs}
             reported: set[GangKey] = set()
+            shapes_desc = "/".join(s.machine_type for s in cpu_shapes)
             for pod in unplaceable:
                 if pod.gang_key in reported:
                     continue
@@ -243,10 +249,20 @@ class Planner:
                     gang_by_key.get(pod.gang_key,
                                     Gang(key=pod.gang_key, pods=[pod])),
                     f"pod {pod.name} requests {pod.resources!r}, larger "
-                    f"than one {pol.cpu_shape.machine_type} node"))
+                    f"than one {shapes_desc} node"))
+        demand_needed = sum(counts.values())
         if demand_needed:
+            counts[pol.cpu_shape.machine_type] = (
+                counts.get(pol.cpu_shape.machine_type, 0)
+                + pol.over_provision_nodes)
             demand_needed += pol.over_provision_nodes
-        demand_needed = max(0, demand_needed - inflight_cpu)
+        # In-flight nodes serve demand first (idempotence): shed greedily.
+        shed = min(demand_needed, inflight_cpu)
+        demand_needed -= shed
+        for machine in sorted(counts):
+            take = min(shed, counts[machine])
+            counts[machine] -= take
+            shed -= take
         # Spare: keep at least N workload-free CPU nodes warm.  "Free" means
         # no non-daemonset/non-mirror pods — daemonsets run on every node
         # and must not disqualify a node from being spare.
@@ -259,12 +275,23 @@ class Planner:
             if n.is_ready and not n.unschedulable
             and n.name not in workload_nodes)
         spare_needed = max(0, pol.spare_nodes - fully_free - inflight_cpu)
+        if spare_needed > demand_needed:
+            counts[pol.cpu_shape.machine_type] = (
+                counts.get(pol.cpu_shape.machine_type, 0)
+                + spare_needed - demand_needed)
+        # Clamp total new CPU nodes to the room left under max_cpu_nodes,
+        # shedding the primary shape last (reference: AgentPool.max_size).
         room = max(0, pol.max_cpu_nodes - len(cpu_nodes) - inflight_cpu)
-        needed = min(max(demand_needed, spare_needed), room)
-        if needed:
-            plan.requests.append(ProvisionRequest(
-                kind="cpu-node", shape_name=pol.cpu_shape.machine_type,
-                count=needed,
-                reason=(f"{len(pending_cpu)} pending CPU pods, "
-                        f"spare={pol.spare_nodes}")))
+        overflow = max(0, sum(counts.values()) - room)
+        for machine in sorted(counts,
+                              key=lambda m: m == pol.cpu_shape.machine_type):
+            take = min(overflow, counts[machine])
+            counts[machine] -= take
+            overflow -= take
+        for machine, count in sorted(counts.items()):
+            if count > 0:
+                plan.requests.append(ProvisionRequest(
+                    kind="cpu-node", shape_name=machine, count=count,
+                    reason=(f"{len(pending_cpu)} pending CPU pods, "
+                            f"spare={pol.spare_nodes}")))
         return plan

@@ -109,16 +109,20 @@ _ALL_SHAPES: tuple[SliceShape, ...] = (
     _v5p(1024, (8, 8, 16)),
     # ---- v4 (3-D torus, 4-chip hosts)
     _v4(8, (2, 2, 2)),
+    _v4(16, (2, 2, 4)),
     _v4(32, (2, 4, 4)),
     _v4(64, (4, 4, 4)),
     _v4(128, (4, 4, 8)),
     _v4(256, (4, 8, 8)),
+    _v4(512, (8, 8, 8)),
     # ---- v6e (Trillium; 2-D torus like v5e)
     _v6e(1, (1, 1), 1, "ct6e-standard-1t"),
     _v6e(4, (2, 2), 4, "ct6e-standard-4t"),
     _v6e(8, (2, 4), 8, "ct6e-standard-8t"),
     _v6e(16, (4, 4), 4, "ct6e-standard-4t"),
+    _v6e(32, (4, 8), 4, "ct6e-standard-4t"),
     _v6e(64, (8, 8), 4, "ct6e-standard-4t"),
+    _v6e(128, (8, 16), 4, "ct6e-standard-4t"),
     _v6e(256, (16, 16), 4, "ct6e-standard-4t"),
 )
 
