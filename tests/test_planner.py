@@ -249,3 +249,46 @@ class TestReviewRegressions:
                         policy=policy)
         assert plan.unsatisfiable
         assert "n2-standard-16" in plan.unsatisfiable[0][1]
+
+    def test_spare_never_displaces_demand_under_clamp(self):
+        """Review regression: with room for one node, the pending pod's
+        (extra-shape) node wins over a warm spare."""
+        from tpu_autoscaler.topology.catalog import CPU_SHAPES
+
+        policy = PoolPolicy(
+            spare_nodes=2, max_cpu_nodes=1,
+            extra_cpu_shapes=(CPU_SHAPES["n2-standard-32"],))
+        plan = plan_for([make_pod(name="big", requests={"cpu": "16"})],
+                        policy=policy)
+        by_machine = {r.shape_name: r.count for r in plan.requests}
+        assert by_machine == {"n2-standard-32": 1}
+
+    def test_inflight_shed_matches_machine_type(self):
+        """Review regression: an in-flight small node must not cancel
+        demand for a large node."""
+        from tpu_autoscaler.topology.catalog import CPU_SHAPES
+
+        policy = PoolPolicy(
+            spare_nodes=0,
+            extra_cpu_shapes=(CPU_SHAPES["n2-standard-32"],))
+        plan = plan_for(
+            [make_pod(name="big", requests={"cpu": "16"})],
+            in_flight=[InFlight(kind="cpu-node",
+                                shape_name="e2-standard-8")],
+            policy=policy)
+        by_machine = {r.shape_name: r.count for r in plan.requests}
+        assert by_machine.get("n2-standard-32") == 1
+
+    def test_packing_order_independent(self):
+        """Review regression: FFD — outcome must not depend on pod names
+        (which drive gang ordering)."""
+        from tpu_autoscaler.topology.catalog import CPU_SHAPES
+
+        policy = PoolPolicy(
+            spare_nodes=0,
+            extra_cpu_shapes=(CPU_SHAPES["n2-standard-32"],))
+        plan = plan_for([make_pod(name="a-small", requests={"cpu": "2"}),
+                         make_pod(name="z-big", requests={"cpu": "16"})],
+                        policy=policy)
+        by_machine = {r.shape_name: r.count for r in plan.requests}
+        assert by_machine == {"n2-standard-32": 1}

@@ -182,6 +182,11 @@ def pack_cpu_pods_multi(pods: list[Pod], free: dict[str, ResourceVector],
     }
     new_units: list[tuple[str, ResourceVector]] = []  # (machine, remaining)
     unplaceable: list[Pod] = []
+    # First-fit-DECREASING: big pods open units first so small pods pack
+    # into their remainders instead of opening units of their own (the
+    # outcome must not depend on arrival order).
+    pods = sorted(pods, key=lambda p: (-p.resources.get("cpu"),
+                                       -p.resources.get("memory")))
     for pod in pods:
         placed = False
         for name, cap in free.items():

@@ -250,19 +250,23 @@ class Planner:
                                     Gang(key=pod.gang_key, pods=[pod])),
                     f"pod {pod.name} requests {pod.resources!r}, larger "
                     f"than one {shapes_desc} node"))
-        demand_needed = sum(counts.values())
-        if demand_needed:
-            counts[pol.cpu_shape.machine_type] = (
-                counts.get(pol.cpu_shape.machine_type, 0)
-                + pol.over_provision_nodes)
-            demand_needed += pol.over_provision_nodes
-        # In-flight nodes serve demand first (idempotence): shed greedily.
-        shed = min(demand_needed, inflight_cpu)
-        demand_needed -= shed
-        for machine in sorted(counts):
-            take = min(shed, counts[machine])
+        # In-flight nodes of the SAME machine type serve demand first
+        # (idempotence): an in-flight small node must not cancel demand
+        # for a large node a pod requires.
+        inflight_by_machine: dict[str, int] = {}
+        for f in in_flight:
+            if f.kind == "cpu-node":
+                inflight_by_machine[f.shape_name] = (
+                    inflight_by_machine.get(f.shape_name, 0) + f.count)
+        for machine in list(counts):
+            take = min(counts[machine], inflight_by_machine.get(machine, 0))
             counts[machine] -= take
-            shed -= take
+        demand_needed = sum(counts.values())
+        # Over-provision and spare nodes are primary-shape EXTRAS, tracked
+        # apart from demand so clamps shed them first (a warm spare must
+        # never displace the node a pending pod needs).
+        primary = pol.cpu_shape.machine_type
+        extras = pol.over_provision_nodes if demand_needed else 0
         # Spare: keep at least N workload-free CPU nodes warm.  "Free" means
         # no non-daemonset/non-mirror pods — daemonsets run on every node
         # and must not disqualify a node from being spare.
@@ -274,20 +278,29 @@ class Planner:
             1 for n in cpu_nodes
             if n.is_ready and not n.unschedulable
             and n.name not in workload_nodes)
-        spare_needed = max(0, pol.spare_nodes - fully_free - inflight_cpu)
-        if spare_needed > demand_needed:
-            counts[pol.cpu_shape.machine_type] = (
-                counts.get(pol.cpu_shape.machine_type, 0)
-                + spare_needed - demand_needed)
-        # Clamp total new CPU nodes to the room left under max_cpu_nodes,
-        # shedding the primary shape last (reference: AgentPool.max_size).
+        spare_shortfall = max(
+            0, pol.spare_nodes - fully_free - inflight_cpu - demand_needed)
+        extras += spare_shortfall
+        # Clamp total new CPU nodes to the room left under max_cpu_nodes
+        # (reference: AgentPool.max_size).  Shed order: extras (spare /
+        # over-provision) first, then primary-shape demand (small pods are
+        # likelier to repack), extra-shape demand last (big pods have no
+        # alternative home).  Shed demand is logged, never silent.
         room = max(0, pol.max_cpu_nodes - len(cpu_nodes) - inflight_cpu)
-        overflow = max(0, sum(counts.values()) - room)
-        for machine in sorted(counts,
-                              key=lambda m: m == pol.cpu_shape.machine_type):
-            take = min(overflow, counts[machine])
-            counts[machine] -= take
-            overflow -= take
+        overflow = max(0, demand_needed + extras - room)
+        take = min(overflow, extras)
+        extras -= take
+        overflow -= take
+        if overflow:
+            log.warning(
+                "max_cpu_nodes=%d clamps %d needed CPU node(s); pods will "
+                "stay Pending", pol.max_cpu_nodes, overflow)
+            for machine in sorted(
+                    counts, key=lambda m: m != primary):
+                take = min(overflow, counts[machine])
+                counts[machine] -= take
+                overflow -= take
+        counts[primary] = counts.get(primary, 0) + extras
         for machine, count in sorted(counts.items()):
             if count > 0:
                 plan.requests.append(ProvisionRequest(
