@@ -1,0 +1,128 @@
+"""RestKubeClient integration test against a real in-process HTTP server.
+
+The reference never exercised its HTTP layer in tests (pykube was mocked —
+SURVEY.md §5); here a stdlib HTTP server speaks just enough apiserver to
+verify paths, verbs, content types, eviction bodies, and watch streaming.
+"""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from tpu_autoscaler.k8s.client import RestKubeClient
+
+
+class ApiServerStub(http.server.BaseHTTPRequestHandler):
+    requests_log: list[tuple] = []
+    pods = {"items": [{"metadata": {"name": "p1", "namespace": "ns"}}]}
+    nodes = {"items": [{"metadata": {"name": "n1"}}]}
+
+    def _send_json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        self.requests_log.append(("GET", self.path, None, dict(self.headers)))
+        if self.path == "/api/v1/nodes":
+            self._send_json(self.nodes)
+        elif self.path == "/api/v1/pods":
+            self._send_json(self.pods)
+        elif self.path.startswith("/api/v1/pods?watch=1"):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            for event in ({"type": "ADDED"}, {"type": "MODIFIED"}):
+                self.wfile.write((json.dumps(event) + "\n").encode())
+            # server closes: end of this watch window
+        else:
+            self._send_json({}, 404)
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length).decode() if length else ""
+
+    def do_PATCH(self):  # noqa: N802
+        self.requests_log.append(
+            ("PATCH", self.path, self._body(), dict(self.headers)))
+        self._send_json({})
+
+    def do_POST(self):  # noqa: N802
+        self.requests_log.append(
+            ("POST", self.path, self._body(), dict(self.headers)))
+        self._send_json({})
+
+    def do_DELETE(self):  # noqa: N802
+        self.requests_log.append(("DELETE", self.path, None,
+                                  dict(self.headers)))
+        self._send_json({})
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def server():
+    ApiServerStub.requests_log = []
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), ApiServerStub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+class TestRestKubeClient:
+    def client(self, base):
+        return RestKubeClient(base_url=base, token="tok", ca_cert=False)
+
+    def test_lists(self, server):
+        c = self.client(server)
+        assert c.list_nodes()[0]["metadata"]["name"] == "n1"
+        assert c.list_pods()[0]["metadata"]["name"] == "p1"
+        method, path, _, headers = ApiServerStub.requests_log[0]
+        assert headers.get("Authorization") == "Bearer tok"
+
+    def test_patch_node_content_type(self, server):
+        c = self.client(server)
+        c.patch_node("n1", {"spec": {"unschedulable": True}})
+        method, path, body, headers = ApiServerStub.requests_log[-1]
+        assert (method, path) == ("PATCH", "/api/v1/nodes/n1")
+        assert headers["Content-Type"] == \
+            "application/strategic-merge-patch+json"
+        assert json.loads(body) == {"spec": {"unschedulable": True}}
+
+    def test_eviction_body(self, server):
+        c = self.client(server)
+        c.evict_pod("ns", "p1")
+        method, path, body, _ = ApiServerStub.requests_log[-1]
+        assert (method, path) == (
+            "POST", "/api/v1/namespaces/ns/pods/p1/eviction")
+        parsed = json.loads(body)
+        assert parsed["kind"] == "Eviction"
+        assert parsed["metadata"] == {"name": "p1", "namespace": "ns"}
+
+    def test_deletes(self, server):
+        c = self.client(server)
+        c.delete_pod("ns", "p1")
+        c.delete_node("n1")
+        paths = [(m, p) for m, p, _, _ in ApiServerStub.requests_log]
+        assert ("DELETE", "/api/v1/namespaces/ns/pods/p1") in paths
+        assert ("DELETE", "/api/v1/nodes/n1") in paths
+
+    def test_watch_streams_events(self, server):
+        c = self.client(server)
+        events = list(c.watch_pods(timeout_seconds=5))
+        assert [e["type"] for e in events] == ["ADDED", "MODIFIED"]
+
+    def test_dry_run_suppresses_mutations(self, server):
+        c = RestKubeClient(base_url=server, token="tok", ca_cert=False,
+                           dry_run=True)
+        c.patch_node("n1", {"spec": {"unschedulable": True}})
+        c.delete_node("n1")
+        mutations = [(m, p) for m, p, _, _ in ApiServerStub.requests_log
+                     if m != "GET"]
+        assert mutations == []

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Sequence
+
 
 from tpu_autoscaler.k8s.objects import Node, Pod
 
