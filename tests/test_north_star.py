@@ -140,3 +140,53 @@ class TestScaleToZero:
         assert len(nodes) == 1  # the warm v5e-8 host survives idleness
         assert nodes[0]["metadata"]["labels"][
             "cloud.google.com/gke-tpu-topology"] == "2x4"
+
+
+class TestUnhealthySliceReplacement:
+    """A Ready slice that loses a host is a broken ICI domain: after the
+    flap window it is drained (checkpoint contract), deleted whole, and
+    the re-pending gang gets a replacement slice."""
+
+    def test_host_loss_replaces_whole_slice(self):
+        kube, actuator, controller = make_harness(
+            unhealthy_timeout_seconds=60.0)
+        shape = shape_by_name("v5e-16")
+        names = []
+        for p in make_gang(shape, job="train"):
+            kube.add_pod(p)
+            names.append(p["metadata"]["name"])
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, n) for n in names))
+        first_nodes = {n["metadata"]["name"] for n in kube.list_nodes()}
+        assert len(first_nodes) == 4
+        # One host dies (kubelet stops reporting Ready).
+        victim = sorted(first_nodes)[0]
+        kube.set_node_ready(victim, False)
+
+        # Within the flap window: nothing drastic happens.
+        controller.reconcile_once(now=20.0)
+        assert {n["metadata"]["name"]
+                for n in kube.list_nodes()} == first_nodes
+
+        # Past the window: slice drained (checkpoint request first), then
+        # deleted whole; pods re-pend (Job recreates) and a NEW slice
+        # arrives.
+        t = 90.0
+        while t < 400.0:
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            # Simulate the Job controller recreating evicted/deleted pods.
+            for n in names:
+                if kube.get_pod("default", n) is None:
+                    import tests.fixtures as fx
+
+                    kube.add_pod(fx.make_tpu_pod(
+                        name=n, chips=shape.chips_per_host, shape=shape,
+                        job="train"))
+            t += 5.0
+        assert all(pod_running(kube, n) for n in names)
+        second_nodes = {n["metadata"]["name"] for n in kube.list_nodes()}
+        assert len(second_nodes) == 4
+        assert second_nodes.isdisjoint(first_nodes)  # replacement slice
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["unhealthy_units_replaced"] == 1

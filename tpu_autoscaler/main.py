@@ -186,7 +186,10 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
               help="Simulated cloud provisioning delay seconds.")
 @click.option("--until", default=3600.0, show_default=True,
               help="Simulated seconds to run.")
-def demo(scenario, provision_delay, until, sleep, **kw):
+@click.option("--scale-down", is_flag=True,
+              help="After the job runs, complete it and demo the "
+                   "slice-atomic reclaim to zero.")
+def demo(scenario, provision_delay, until, scale_down, sleep, **kw):
     """Run the full loop against the in-memory fake cloud (simulated time).
 
     Prints scale events and the measured Unschedulable→Running latency —
@@ -201,7 +204,8 @@ def demo(scenario, provision_delay, until, sleep, **kw):
     controller = _build(kube, actuator, sleep=sleep, **kw)
     chips = seed_scenario(kube, scenario)
     result = simulate(kube, controller, until=until, step=sleep,
-                      scenario=scenario, chips_requested=chips)
+                      scenario=scenario, chips_requested=chips,
+                      scale_down=scale_down)
     click.echo(result.describe())
     sys.exit(0 if result.all_running else 1)
 

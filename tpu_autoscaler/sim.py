@@ -101,6 +101,7 @@ class SimResult:
     chips_provisioned: int
     chips_requested: int
     snapshot: dict
+    peak_nodes: int | None = None
 
     @property
     def stranded_chips(self) -> int:
@@ -110,6 +111,14 @@ class SimResult:
         if not self.all_running:
             return (f"[{self.scenario}] FAILED: pods still pending "
                     f"(nodes={self.nodes})")
+        if self.peak_nodes is not None:
+            reclaimed = "all reclaimed" if self.nodes == 0 else \
+                f"{self.nodes} nodes LEFT"
+            return (f"[{self.scenario}] Unschedulable→Running in "
+                    f"{self.latency_seconds:.1f}s; peak {self.peak_nodes} "
+                    f"nodes, then job completed → {reclaimed} "
+                    f"(units_deleted="
+                    f"{self.snapshot['counters'].get('units_deleted', 0)})")
         return (f"[{self.scenario}] Unschedulable→Running in "
                 f"{self.latency_seconds:.1f}s; nodes={self.nodes}, "
                 f"chips={self.chips_provisioned} "
@@ -119,8 +128,14 @@ class SimResult:
 
 def simulate(kube: FakeKube, controller: Controller, *, until: float,
              step: float = 5.0, scenario: str = "",
-             chips_requested: int = 0) -> SimResult:
-    """Run the loop in simulated time until all pods run (or time out)."""
+             chips_requested: int = 0,
+             scale_down: bool = False) -> SimResult:
+    """Run the loop in simulated time until all pods run (or time out).
+
+    With ``scale_down``, the workload then "completes" (pods deleted) and
+    the loop keeps running until the cluster reclaims every node — the
+    demo for the full lifecycle including slice-atomic scale-down.
+    """
     if step <= 0:
         raise ValueError(f"simulation step must be > 0, got {step}")
 
@@ -138,6 +153,25 @@ def simulate(kube: FakeKube, controller: Controller, *, until: float,
             controller.reconcile_once(now=t)  # record latency metric
             break
         t += step
+
+    if scale_down and finished is not None:
+        peak_nodes = len(kube.list_nodes())
+        for p in list(kube.list_pods()):
+            kube.delete_pod(p["metadata"].get("namespace", "default"),
+                            p["metadata"]["name"])
+        idle = controller.config.idle_threshold_seconds
+        deadline = t + idle + 20 * step + 300.0
+        while t <= deadline and kube.list_nodes():
+            controller.reconcile_once(now=t)
+            t += step
+        snap = controller.metrics.snapshot()
+        return SimResult(
+            scenario=f"{scenario}+scale-down", all_running=True,
+            latency_seconds=snap["summaries"].get(
+                "scale_up_latency_seconds", {}).get("max", finished),
+            nodes=len(kube.list_nodes()), chips_provisioned=0,
+            chips_requested=chips_requested, snapshot=snap,
+            peak_nodes=peak_nodes)
 
     chips = sum(
         int(float(n["status"]["allocatable"].get(TPU_RESOURCE, 0)))
