@@ -383,3 +383,83 @@ class TestUnsatisfiableSurfacing:
         ann = pod["metadata"]["annotations"]
         assert "autoscaler.tpu.dev/unsatisfiable" in ann
         assert "no v5e shape" in ann["autoscaler.tpu.dev/unsatisfiable"]
+
+
+class TestGangSettle:
+    def test_unpinned_gang_waits_for_full_observation(self):
+        """A gradually-appearing unpinned gang is sized only after the
+        settle window — one right-sized slice, not one per partial view."""
+        kube, actuator, controller = make_harness(gang_settle_seconds=10.0)
+        # Pods WITHOUT topology selectors (unpinned): chips demand is the
+        # only sizing signal, so partial observation would under-size.
+        kube.add_pod(make_tpu_pod(name="g-0", chips=4, job="grow",
+                                  selectors={}))
+        controller.reconcile_once(now=0.0)
+        assert actuator.statuses() == []  # settling, not sized at 4 chips
+        kube.add_pod(make_tpu_pod(name="g-1", chips=4, job="grow",
+                                  selectors={}))
+        kube.add_pod(make_tpu_pod(name="g-2", chips=4, job="grow",
+                                  selectors={}))
+        kube.add_pod(make_tpu_pod(name="g-3", chips=4, job="grow",
+                                  selectors={}))
+        run_loop(kube, controller, start=11.0, until=60.0,
+                 stop_when=lambda: all(pod_running(kube, f"g-{i}")
+                                       for i in range(4)))
+        assert all(pod_running(kube, f"g-{i}") for i in range(4))
+        # One provision sized for the FULL 16-chip gang.
+        assert len(actuator.statuses()) == 1
+        assert actuator.statuses()[0].request.shape_name == "v5e-16"
+
+    def test_pinned_gang_acts_immediately(self):
+        kube, actuator, controller = make_harness(gang_settle_seconds=30.0)
+        shape = shape_by_name("v5e-64")
+        kube.add_pod(make_gang(shape, job="pinned")[0])  # just one pod
+        controller.reconcile_once(now=0.0)
+        # Topology pin makes sizing exact: no settling delay.
+        assert len(actuator.statuses()) == 1
+        assert actuator.statuses()[0].request.shape_name == "v5e-64"
+
+    def test_slow_materialization_extends_window(self):
+        """Quiescence: pods appearing slower than the settle window still
+        produce ONE right-sized slice (the clock restarts per growth)."""
+        kube, actuator, controller = make_harness(gang_settle_seconds=10.0)
+        t = 0.0
+        for i in range(4):  # one pod every 8s — each inside a new window
+            kube.add_pod(make_tpu_pod(name=f"s-{i}", chips=4, job="slow",
+                                      selectors={}))
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 8.0
+        assert actuator.statuses() == []  # never sized while growing
+        run_loop(kube, controller, start=t + 10.0, until=t + 60.0,
+                 stop_when=lambda: all(pod_running(kube, f"s-{i}")
+                                       for i in range(4)))
+        assert len(actuator.statuses()) == 1
+        assert actuator.statuses()[0].request.shape_name == "v5e-16"
+
+    def test_settling_gang_protects_idle_supply(self):
+        """Review regression: a settling gang still claims matching idle
+        supply — _maintain must not reclaim the slice it will bind to."""
+        kube, actuator, controller = make_harness(gang_settle_seconds=30.0)
+        shape = shape_by_name("v5e-16")
+        for p in make_gang(shape, job="j1"):
+            kube.add_pod(p)
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, f"j1-{i}") for i in range(4)))
+        for i in range(4):
+            kube.delete_pod("default", f"j1-{i}")
+        # Cross the idle threshold.
+        t = 10.0
+        while t < 10.0 + IDLE - 5.0:
+            controller.reconcile_once(now=t)
+            t += 5.0
+        # New UNPINNED gang appears (settling): 4 pods x 4 chips.
+        for i in range(4):
+            kube.add_pod(make_tpu_pod(name=f"j2-{i}", chips=4, job="j2",
+                                      selectors={}))
+        # Reconcile past the idle threshold while the gang settles: the
+        # idle slice must survive (the settling gang will bind to it).
+        controller.reconcile_once(now=10.0 + IDLE + 20.0)
+        assert not any(n["spec"].get("unschedulable")
+                       for n in kube.list_nodes())
+        assert len(kube.list_nodes()) == 4

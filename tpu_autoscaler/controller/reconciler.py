@@ -70,6 +70,11 @@ class ControllerConfig:
     # pods repack onto other nodes (reference: UNDER_UTILIZED_DRAINABLE).
     # 0.0 disables (default: consolidation moves pods, opt in explicitly).
     utilization_threshold: float = 0.0
+    # Damping for gangs WITHOUT an exact topology pin: wait this long after
+    # the gang's first pod appears before sizing a slice for it, so a Job
+    # whose pods materialize gradually isn't fitted against a partial
+    # observation (pinned gangs are exact regardless and never wait).
+    gang_settle_seconds: float = 0.0
     # Reference parity flags (main.py --no-scale / --no-maintenance).
     no_scale: bool = False
     no_maintenance: bool = False
@@ -99,6 +104,9 @@ class Controller:
         self._retry_at: dict[object, float] = {}
         # Provision submit times, for the provision_latency_seconds metric.
         self._submitted_at: dict[str, float] = {}
+        # Gang size observations for the settle window: key -> (size,
+        # last-grown timestamp); swept alongside _gang_first_pending.
+        self._gang_sizes: dict[tuple, tuple[int, float]] = {}
         # Units the operator (or spot reclamation) asked us to evacuate.
         self._requested_drains: set[str] = set()
 
@@ -120,9 +128,13 @@ class Controller:
         pending = [p for p in pods if p.is_unschedulable]
         gangs = group_into_gangs(pending)
         self._track_gang_latency(gangs, pods, now)
+        # Settling only delays SIZING (the _scale path); _maintain still
+        # sees every pending gang so reclaim deferral protects supply a
+        # settling gang will bind to.
+        settled_gangs = self._settled(gangs, now)
 
         if not self.config.no_scale:
-            self._scale(gangs, nodes, pods, now)
+            self._scale(settled_gangs, nodes, pods, now)
         if not self.config.no_maintenance:
             self._maintain(nodes, pods, now, pending_gangs=gangs)
 
@@ -168,6 +180,39 @@ class Controller:
                 self.metrics.inc("reconcile_errors")
             wake.wait(timeout=interval_seconds)
             wake.clear()
+
+    def _settled(self, gangs: list[Gang], now: float) -> list[Gang]:
+        """Filter out TPU gangs still inside the settle window.
+
+        Only applies to un-pinned TPU gangs (no gke-tpu-topology selector)
+        whose observed chip demand could still be partial.  The window is
+        QUIESCENCE-based: it restarts whenever the gang grows, so slow pod
+        materialization extends the wait instead of racing it — the gang
+        is sized only after ``settle`` seconds without a new member.  The
+        wait still counts toward the reported scale-up latency (no hidden
+        time).
+        """
+        settle = self.config.gang_settle_seconds
+        if settle <= 0:
+            return gangs
+        from tpu_autoscaler.topology.catalog import TOPOLOGY_LABEL
+
+        out, settling = [], 0
+        for gang in gangs:
+            if (not gang.requests_tpu
+                    or TOPOLOGY_LABEL in gang.node_selectors):
+                out.append(gang)
+                continue
+            size, since = self._gang_sizes.get(gang.key, (0, now))
+            if gang.size != size:
+                since = now  # grew (or first seen): restart the clock
+            self._gang_sizes[gang.key] = (gang.size, since)
+            if now - since < settle:
+                settling += 1
+            else:
+                out.append(gang)
+        self.metrics.set_gauge("gangs_settling", settling)
+        return out
 
     # ---- scale-up ------------------------------------------------------ #
 
@@ -254,6 +299,9 @@ class Controller:
                 # Gang's pods were deleted while pending: drop the entry so
                 # a reused Job name doesn't inherit a stale start time.
                 del self._gang_first_pending[key]
+        live_keys = {p.gang_key for p in pods}
+        for key in [k for k in self._gang_sizes if k not in live_keys]:
+            del self._gang_sizes[key]
 
     # ---- scale-down / maintenance -------------------------------------- #
 
