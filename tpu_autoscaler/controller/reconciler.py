@@ -420,6 +420,14 @@ class Controller:
             unit_pods = [p for n in unit_nodes
                          for p in pods_by_node.get(n.name, [])]
             view = self.tracker.observe(unit_id, unit_nodes, unit_pods, now)
+            if view.all_ready_since == now:
+                # Readiness barrier just cleared: record how long the
+                # slowest host took after the first host appeared.
+                created = [n.created.timestamp() for n in unit_nodes
+                           if n.created]
+                if created:
+                    self.metrics.observe("ready_barrier_seconds",
+                                         max(0.0, now - min(created)))
             state = classify_slice(
                 view, grace_seconds=cfg.grace_seconds,
                 idle_threshold_seconds=cfg.idle_threshold_seconds,

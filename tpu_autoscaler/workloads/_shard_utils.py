@@ -1,0 +1,17 @@
+"""Small shard_map helpers shared by the parallelism modules."""
+
+from __future__ import annotations
+
+import jax
+
+
+def pvary(x, axis_name: str):
+    """Mark ``x`` as device-varying over ``axis_name``.
+
+    jax renamed ``lax.pvary`` to ``lax.pcast(..., to='varying')``; support
+    both so the workloads track jax versions without churn.
+    """
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)

@@ -1,0 +1,119 @@
+"""Expert parallelism (ep): a switch-style MoE FFN over a mesh axis.
+
+The last of the workload's parallelism modes (dp/tp: model.py, sp:
+ring_attention.py, pp: pipeline.py).  Experts shard over the ``ep`` axis —
+each device owns E/ep experts — and tokens move to their expert and back
+via two ``lax.all_to_all`` exchanges (the canonical MoE dispatch/combine,
+riding ICI within a slice):
+
+  route (top-1) → bucket by expert with capacity → all_to_all(dispatch)
+  → local expert MLPs → all_to_all(combine) → gate-weighted unbucket.
+
+Tokens over an expert's capacity are dropped (contribute zero — the
+surrounding residual connection carries them), standard switch-transformer
+semantics.  Differentiable end-to-end: all_to_all transposes to itself on
+the reverse path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    d_model: int = 32
+    d_ff: int = 64
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+
+
+def init_moe_params(key: jax.Array, cfg: MoeConfig) -> dict:
+    k_r, k1, k2 = jax.random.split(key, 3)
+    e, d, f = cfg.num_experts, cfg.d_model, cfg.d_ff
+    return {
+        "router": jax.random.normal(k_r, (d, e), jnp.float32) * 0.02,
+        "w1": jax.random.normal(k1, (e, d, f), jnp.float32) * d ** -0.5,
+        "w2": jax.random.normal(k2, (e, f, d), jnp.float32) * f ** -0.5,
+    }
+
+
+def moe_reference(params: dict, x: jax.Array,
+                  capacity: int | None = None) -> jax.Array:
+    """Unsharded oracle: top-1 routing, optional per-expert capacity."""
+    n, d = x.shape
+    e = params["router"].shape[1]
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(logits, axis=-1)                      # [n]
+    gate = jnp.take_along_axis(probs, top[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(top, e, dtype=jnp.int32)
+    rank = jnp.einsum("ne,ne->n", jnp.cumsum(onehot, axis=0) - 1,
+                      onehot.astype(jnp.int32))
+    keep = jnp.ones((n,), bool) if capacity is None else (rank < capacity)
+    h = jax.nn.gelu(jnp.einsum("nd,ndf->nf", x, params["w1"][top]))
+    out = jnp.einsum("nf,nfd->nd", h, params["w2"][top])
+    return jnp.where(keep[:, None], gate[:, None] * out, 0.0)
+
+
+def make_moe_layer(mesh: Mesh, cfg: MoeConfig, ep_axis: str = "ep"):
+    """Build ``apply(params, x)`` with experts sharded over ``ep``.
+
+    x: [tokens, d_model] sharded over ``ep`` on the token dim; params
+    shard on the expert dim (router replicates).  Token count per device
+    and expert count must divide the axis size.
+    """
+    ep = mesh.shape[ep_axis]
+    if cfg.num_experts % ep:
+        raise ValueError(
+            f"{cfg.num_experts} experts not divisible by ep={ep}")
+    e_loc = cfg.num_experts // ep
+
+    def local_apply(params, x):
+        n_loc, d = x.shape
+        e = cfg.num_experts
+        cap = max(1, int(cfg.capacity_factor * n_loc / e))
+
+        logits = x @ params["router"]                       # [n_loc, e]
+        probs = jax.nn.softmax(logits, axis=-1)
+        top = jnp.argmax(logits, axis=-1)
+        gate = jnp.take_along_axis(probs, top[:, None], axis=1)[:, 0]
+        onehot = jax.nn.one_hot(top, e, dtype=jnp.int32)
+        rank = jnp.einsum("ne,ne->n", jnp.cumsum(onehot, axis=0) - 1,
+                          onehot)
+        keep = rank < cap
+
+        # Dispatch buffer [e, cap, d]: token n -> slot (top[n], rank[n]).
+        safe_rank = jnp.where(keep, rank, 0)
+        dispatch = jnp.zeros((e, cap, d), x.dtype)
+        dispatch = dispatch.at[top, safe_rank].add(
+            jnp.where(keep[:, None], x, 0.0))
+
+        # To experts: [ep, e_loc, cap, d] -> exchange dim0 over the axis.
+        buckets = dispatch.reshape(ep, e_loc, cap, d)
+        received = jax.lax.all_to_all(buckets, ep_axis, split_axis=0,
+                                      concat_axis=0, tiled=False)
+        # received[src, e_loc, cap, d]: tokens from every source device for
+        # MY experts.  params arrive pre-sharded under shard_map: w1/w2 are
+        # the local [e_loc, ...] shards.
+        h = jax.nn.gelu(
+            jnp.einsum("seCd,edf->seCf", received, params["w1"]))
+        expert_out = jnp.einsum("seCf,efd->seCd", h, params["w2"])
+
+        # Back to sources: inverse exchange, restoring [e, cap, d] local.
+        returned = jax.lax.all_to_all(expert_out, ep_axis, split_axis=0,
+                                      concat_axis=0, tiled=False)
+        combined = returned.reshape(e, cap, d)
+        out = combined[top, safe_rank]                      # [n_loc, d]
+        return jnp.where(keep[:, None], gate[:, None] * out, 0.0)
+
+    # Router replicates; experts shard on their leading dim; tokens shard.
+    p_specs = {"router": P(None, None), "w1": P(ep_axis, None, None),
+               "w2": P(ep_axis, None, None)}
+    return jax.shard_map(local_apply, mesh=mesh,
+                         in_specs=(p_specs, P(ep_axis, None)),
+                         out_specs=P(ep_axis, None))

@@ -1,0 +1,116 @@
+"""Pipeline parallelism (pp): layers split across a mesh axis.
+
+Completes the workload's parallelism portfolio (dp/tp in model.py, sp in
+ring_attention.py, ep in moe.py): the transformer's stacked layer params
+shard over the ``pp`` axis on their leading (layer) dimension — stage i
+holds layers [i·L/P, (i+1)·L/P) — and microbatches stream through the
+stage ring via ``lax.ppermute``, GPipe-style.  The schedule is an ordinary
+``lax.fori_loop`` inside ``shard_map``, so reverse-mode AD derives the
+backward pipeline automatically (ppermute transposes to the reversed
+ring); no hand-written 1F1B pass is needed at these scales.
+
+Autoscaler relevance: a pp×dp job spans whole slices with the pp ring on
+ICI — another communication pattern that must never be bisected, which is
+why drains operate on whole slices.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_autoscaler.workloads._shard_utils import pvary
+from tpu_autoscaler.workloads.model import ModelConfig, _block, _rmsnorm
+
+
+def _stage_forward(blocks: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Run THIS stage's layer stack (leading dim = local layers)."""
+
+    def body(x, layer):
+        return _block(x, layer, cfg), None
+
+    x, _ = jax.lax.scan(body, x, blocks)
+    return x
+
+
+def make_pipeline_loss(mesh: Mesh, cfg: ModelConfig,
+                       num_microbatches: int, pp_axis: str = "pp"):
+    """Build ``loss(params, tokens)`` pipelined over ``mesh``'s pp axis.
+
+    params: the standard model pytree (model.init_params) — blocks shard
+    over pp on the layer dim, embed/unembed/ln_f replicate.  tokens:
+    [batch, seq+1] int32, batch divisible by num_microbatches.
+    """
+    n_stages = mesh.shape[pp_axis]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"{cfg.n_layers} layers not divisible by {n_stages} stages")
+
+    block_specs = {
+        "qkv": P(pp_axis, None, None), "attn_out": P(pp_axis, None, None),
+        "w1": P(pp_axis, None, None), "w2": P(pp_axis, None, None),
+        "ln1": P(pp_axis, None), "ln2": P(pp_axis, None),
+    }
+    param_specs = {"embed": P(None, None), "blocks": block_specs,
+                   "ln_f": P(None), "unembed": P(None, None)}
+
+    def local_loss(params, tokens):
+        idx = jax.lax.axis_index(pp_axis)
+        m = num_microbatches
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        b, s = inputs.shape
+        mb = b // m
+        x_mb = inputs.reshape(m, mb, s)
+
+        embedded = params["embed"].astype(cfg.dtype)[x_mb]  # [m, mb, s, d]
+        d = embedded.shape[-1]
+        zeros = jnp.zeros((mb, s, d), cfg.dtype)
+
+        def tick(t, carry):
+            buf, outs = carry
+            # Stage 0 ingests microbatch t (clamped; only used while
+            # t < m); later stages consume the ring buffer.
+            ingest = jax.lax.dynamic_index_in_dim(
+                embedded, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+            x_in = jnp.where(idx == 0, ingest, buf)
+            y = _stage_forward(params["blocks"], x_in, cfg)
+            # Last stage banks microbatch t-(P-1) when in range.
+            out_t = t - (n_stages - 1)
+            valid = jnp.logical_and(out_t >= 0, out_t < m)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                outs, y.astype(outs.dtype), jnp.clip(out_t, 0, m - 1),
+                axis=0)
+            outs = jnp.where(valid, banked, outs)
+            # Rotate activations one hop down the stage ring.
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            buf = jax.lax.ppermute(y, pp_axis, perm)
+            return buf, outs
+
+        buf0 = pvary(zeros, pp_axis)
+        outs0 = pvary(jnp.zeros((m, mb, s, d), cfg.dtype), pp_axis)
+        _, outs = jax.lax.fori_loop(0, m + n_stages - 1, tick, (buf0, outs0))
+
+        # Loss on the last stage only; psum shares it with the ring (and
+        # gives every stage the same scalar, keeping grads correct).
+        h = _rmsnorm(outs.reshape(m * mb, s, d), params["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", h,
+                            params["unembed"].astype(cfg.dtype))
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets.reshape(m * mb, s)[..., None], axis=-1)
+        local = jnp.where(idx == n_stages - 1, jnp.mean(nll), 0.0)
+        return jax.lax.psum(local, pp_axis)
+
+    sharded = jax.shard_map(
+        local_loss, mesh=mesh,
+        in_specs=(param_specs, P()), out_specs=P())
+
+    @functools.wraps(sharded)
+    def loss(params, tokens):
+        return sharded(params, tokens)
+
+    return loss

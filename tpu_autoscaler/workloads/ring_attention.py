@@ -71,11 +71,11 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
     # pvary: the accumulators are per-device state (they will differ across
     # the ring), so mark them varying over the axis or the fori_loop carry
     # types mismatch under shard_map's varying-axis tracking.
-    m0 = jax.lax.pvary(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32),
-                       axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((b, h, s_loc, 1), jnp.float32), axis_name)
-    acc0 = jax.lax.pvary(jnp.zeros((b, h, s_loc, d), jnp.float32),
-                         axis_name)
+    from tpu_autoscaler.workloads._shard_utils import pvary
+
+    m0 = pvary(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32), axis_name)
+    l0 = pvary(jnp.zeros((b, h, s_loc, 1), jnp.float32), axis_name)
+    acc0 = pvary(jnp.zeros((b, h, s_loc, d), jnp.float32), axis_name)
     m, l, acc, _, _ = jax.lax.fori_loop(
         0, axis_size, step, (m0, l0, acc0, k, v))
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
