@@ -1,0 +1,109 @@
+// Native fit/pack kernels for the decision engine.
+//
+// The reference is pure Python (SURVEY.md §3: zero native components), so
+// this is beyond-parity: the two numeric hot spots of the planner — batch
+// shape scoring and first-fit-decreasing CPU packing — as a small C++
+// library with a C ABI, loaded via ctypes (tpu_autoscaler/native.py).
+// The Python implementations in engine/fitter.py remain the reference
+// semantics; tests assert bit-identical decisions between the two.
+//
+// Build: make -C native   (or it is built on demand by native.py)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Score G gangs against S shapes.
+// gangs:   G rows of (total_chips, per_pod_chips, n_pods)
+// shapes:  S rows of (chips, chips_per_host, hosts)
+// best:    G entries out — index of the feasible shape with minimal
+//          stranded chips (ties: first/smallest in given order), -1 none.
+// stranded:G entries out — stranded chips for the chosen shape.
+void fitpack_best_shapes(const double* gangs, int64_t n_gangs,
+                         const double* shapes, int64_t n_shapes,
+                         int32_t* best, double* stranded) {
+  for (int64_t g = 0; g < n_gangs; ++g) {
+    const double total = gangs[g * 3 + 0];
+    const double per_pod = gangs[g * 3 + 1];
+    const double pods = gangs[g * 3 + 2];
+    int32_t arg = -1;
+    double best_cost = 0;
+    for (int64_t s = 0; s < n_shapes; ++s) {
+      const double chips = shapes[s * 3 + 0];
+      const double cph = shapes[s * 3 + 1];
+      const double hosts = shapes[s * 3 + 2];
+      if (chips < total || cph < per_pod) continue;
+      if (per_pod > 0) {
+        const double slots =
+            hosts * std::floor(cph / std::max(per_pod, 1.0));
+        if (slots < pods) continue;
+      }
+      const double cost = chips - total;
+      if (arg < 0 || cost < best_cost) {
+        arg = static_cast<int32_t>(s);
+        best_cost = cost;
+      }
+    }
+    best[g] = arg;
+    stranded[g] = arg < 0 ? -1.0 : best_cost;
+  }
+}
+
+// First-fit-decreasing packing of pods into existing free capacity and
+// new units of one machine shape (2 resource axes: cpu, mem).
+// pods:  N rows (cpu, mem) — NOT pre-sorted; FFD order is applied inside.
+// free:  F rows (cpu, mem) — mutated as pods are placed.
+// unit:  (cpu, mem) capacity of one new node.
+// placed_unit: N entries out — -2 placed on existing node, >=0 index of
+//              new unit, -1 unplaceable.
+// Returns the number of new units opened.
+int32_t fitpack_pack_ffd(const double* pods, int64_t n_pods, double* free,
+                         int64_t n_free, double unit_cpu, double unit_mem,
+                         int32_t* placed_unit) {
+  std::vector<int64_t> order(n_pods);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) {
+                     if (pods[a * 2] != pods[b * 2])
+                       return pods[a * 2] > pods[b * 2];
+                     return pods[a * 2 + 1] > pods[b * 2 + 1];
+                   });
+  std::vector<double> units;  // (cpu, mem) remaining per new unit
+  for (int64_t k = 0; k < n_pods; ++k) {
+    const int64_t p = order[k];
+    const double cpu = pods[p * 2], mem = pods[p * 2 + 1];
+    bool done = false;
+    for (int64_t f = 0; f < n_free && !done; ++f) {
+      if (free[f * 2] >= cpu && free[f * 2 + 1] >= mem) {
+        free[f * 2] -= cpu;
+        free[f * 2 + 1] -= mem;
+        placed_unit[p] = -2;
+        done = true;
+      }
+    }
+    for (size_t u = 0; u < units.size() / 2 && !done; ++u) {
+      if (units[u * 2] >= cpu && units[u * 2 + 1] >= mem) {
+        units[u * 2] -= cpu;
+        units[u * 2 + 1] -= mem;
+        placed_unit[p] = static_cast<int32_t>(u);
+        done = true;
+      }
+    }
+    if (!done) {
+      if (unit_cpu >= cpu && unit_mem >= mem) {
+        placed_unit[p] = static_cast<int32_t>(units.size() / 2);
+        units.push_back(unit_cpu - cpu);
+        units.push_back(unit_mem - mem);
+      } else {
+        placed_unit[p] = -1;
+      }
+    }
+  }
+  return static_cast<int32_t>(units.size() / 2);
+}
+
+}  // extern "C"

@@ -1,0 +1,107 @@
+"""Native fitpack kernels vs the Python reference engine.
+
+The native library is optional; these tests skip when no toolchain is
+present, and otherwise assert decision-identical behavior.
+"""
+
+import pytest
+
+from tpu_autoscaler import native
+from tpu_autoscaler.engine.fitter import (
+    FitError,
+    choose_shape_for_gang,
+    pack_cpu_pods,
+)
+from tpu_autoscaler.k8s.gangs import group_into_gangs
+from tpu_autoscaler.k8s.objects import Pod
+from tpu_autoscaler.topology.catalog import (
+    DEFAULT_CPU_SHAPE,
+    shapes_for_generation,
+)
+
+from tests.fixtures import make_pod, make_tpu_pod
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no native toolchain")
+
+
+def shape_rows(generation):
+    return [(float(s.chips), float(s.chips_per_host), float(s.hosts))
+            for s in shapes_for_generation(generation)]
+
+
+def gang_of(chips, per_pod, pods):
+    payloads = [make_tpu_pod(name=f"p{i}", chips=per_pod, job="j",
+                             requests={"google.com/tpu": str(per_pod)})
+                for i in range(pods)]
+    return group_into_gangs([Pod(p) for p in payloads])[0]
+
+
+class TestNativeBestShapes:
+    @pytest.mark.parametrize("per_pod,pods", [
+        (8, 1), (4, 16), (4, 64), (1, 3), (4, 3), (3, 5)])
+    def test_matches_python_fitter(self, per_pod, pods):
+        gang = gang_of(per_pod * pods, per_pod, pods)
+        rows = shape_rows("v5e")
+        out = native.best_shapes(
+            [(float(gang.tpu_chips), float(per_pod), float(pods))], rows)
+        idx, stranded = out[0]
+        try:
+            choice = choose_shape_for_gang(gang, "v5e")
+        except FitError:
+            assert idx == -1
+            return
+        shapes = shapes_for_generation("v5e")
+        assert idx >= 0
+        assert shapes[idx].name == choice.shape.name
+        assert stranded == choice.stranded_chips
+
+    def test_infeasible(self):
+        out = native.best_shapes([(100000.0, 4.0, 25000.0)],
+                                 shape_rows("v5e"))
+        assert out[0] == (-1, -1.0)
+
+
+class TestNativePackFfd:
+    def test_matches_python_pack(self):
+        cpus = ["4", "3", "4", "2", "7", "1"]
+        pods = [Pod(make_pod(name=f"p{i}", requests={"cpu": c}))
+                for i, c in enumerate(cpus)]
+        py_count, py_unplaced = pack_cpu_pods(
+            list(pods), {}, DEFAULT_CPU_SHAPE)
+        cap = DEFAULT_CPU_SHAPE
+        out = native.pack_ffd(
+            [(p.resources.get("cpu"), p.resources.get("memory"))
+             for p in pods],
+            [], (cap.cpu_m / 1000.0, float(cap.memory)))
+        n_count, placed = out
+        assert n_count == py_count
+        assert all(x != -1 for x in placed)
+        assert py_unplaced == []
+
+    def test_existing_free_used_first(self):
+        out = native.pack_ffd([(2.0, 1e9)], [(4.0, 2e9)], (8.0, 3e10))
+        count, placed = out
+        assert count == 0
+        assert placed == [-2]
+
+    def test_unplaceable_flagged(self):
+        out = native.pack_ffd([(64.0, 1e9)], [], (8.0, 3e10))
+        count, placed = out
+        assert count == 0
+        assert placed == [-1]
+
+    def test_large_scale_agrees_on_count(self):
+        import random
+
+        rng = random.Random(7)
+        pods = [Pod(make_pod(name=f"p{i}",
+                             requests={"cpu": str(rng.randint(1, 7))}))
+                for i in range(200)]
+        py_count, _ = pack_cpu_pods(list(pods), {}, DEFAULT_CPU_SHAPE)
+        cap = DEFAULT_CPU_SHAPE
+        n_count, _ = native.pack_ffd(
+            [(p.resources.get("cpu"), p.resources.get("memory"))
+             for p in pods],
+            [], (cap.cpu_m / 1000.0, float(cap.memory)))
+        assert n_count == py_count

@@ -1,0 +1,110 @@
+"""ctypes bindings for the native fit/pack kernels (native/fitpack.cpp).
+
+Optional acceleration with identical semantics to the Python engine
+(engine/fitter.py holds the reference implementation; tests assert the
+two agree decision-for-decision).  The library is built on first use with
+the system toolchain and cached; every entry point degrades to None when
+no compiler is available, so the controller never depends on it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libfitpack.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None | bool = None  # None=untried, False=unavailable
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:  # noqa: BLE001 — no compiler / make: stay Python
+        log.info("native fitpack unavailable (build failed); using the "
+                 "Python engine", exc_info=True)
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library, or None."""
+    global _lib
+    with _lock:
+        if _lib is False:
+            return None
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            _lib = False
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            log.info("native fitpack failed to load", exc_info=True)
+            _lib = False
+            return None
+        lib.fitpack_best_shapes.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.fitpack_best_shapes.restype = None
+        lib.fitpack_pack_ffd.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.c_double, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.fitpack_pack_ffd.restype = ctypes.c_int32
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def best_shapes(gangs: list[tuple[float, float, float]],
+                shapes: list[tuple[float, float, float]]
+                ) -> list[tuple[int, float]] | None:
+    """[(best_shape_index | -1, stranded_chips)] per gang, or None if the
+    native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    g = len(gangs)
+    s = len(shapes)
+    garr = (ctypes.c_double * (g * 3))(*[v for row in gangs for v in row])
+    sarr = (ctypes.c_double * (s * 3))(*[v for row in shapes for v in row])
+    best = (ctypes.c_int32 * g)()
+    stranded = (ctypes.c_double * g)()
+    lib.fitpack_best_shapes(garr, g, sarr, s, best, stranded)
+    return [(int(best[i]), float(stranded[i])) for i in range(g)]
+
+
+def pack_ffd(pods: list[tuple[float, float]],
+             free: list[tuple[float, float]],
+             unit: tuple[float, float]
+             ) -> tuple[int, list[int]] | None:
+    """(new_units, placement per pod: -2 existing / >=0 new unit / -1
+    unplaceable), or None if unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    n, f = len(pods), len(free)
+    parr = (ctypes.c_double * (n * 2))(*[v for row in pods for v in row])
+    farr = (ctypes.c_double * (f * 2))(*[v for row in free for v in row])
+    placed = (ctypes.c_int32 * n)()
+    count = lib.fitpack_pack_ffd(parr, n, farr, f, unit[0], unit[1], placed)
+    return int(count), [int(placed[i]) for i in range(n)]
