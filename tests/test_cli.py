@@ -85,3 +85,30 @@ class TestModuleEntry:
             capture_output=True, text=True, timeout=60)
         assert result.returncode == 0
         assert "demo" in result.stdout and "run" in result.stdout
+
+
+class TestConfigFile:
+    def test_yaml_defaults_applied(self, tmp_path):
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("scenario: v5e-8\nprovision_delay: 45\n"
+                       "spare_agents: 0\n")
+        result = CliRunner().invoke(cli, ["demo", "--config", str(cfg)])
+        assert result.exit_code == 0, result.output
+        assert "[v5e-8]" in result.output
+        assert "45.0s" in result.output
+
+    def test_cli_flag_overrides_config(self, tmp_path):
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("scenario: v5e-8\nspare_agents: 0\n")
+        result = CliRunner().invoke(cli, [
+            "demo", "--config", str(cfg), "--scenario", "cpu",
+            "--provision-delay", "30"])
+        assert result.exit_code == 0, result.output
+        assert "[cpu]" in result.output
+
+    def test_non_mapping_config_rejected(self, tmp_path):
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("- just\n- a list\n")
+        result = CliRunner().invoke(cli, ["demo", "--config", str(cfg)])
+        assert result.exit_code == 2
+        assert "YAML mapping" in result.output

@@ -54,7 +54,32 @@ def _policy(default_generation, cpu_machine_type, over_provision,
     )
 
 
+def _load_config(ctx, param, value):
+    """--config FILE: YAML keys become flag defaults (CLI still wins).
+
+    The reference was flags-only (SURVEY.md §6.6); a config file makes the
+    policy data.  Keys use flag names with underscores, e.g.::
+
+        idle_threshold: 900
+        spare_slice: ["v5e-8=1"]
+        default_generation: v5p
+    """
+    if value:
+        import yaml
+
+        with open(value) as f:
+            loaded = yaml.safe_load(f) or {}
+        if not isinstance(loaded, dict):
+            raise click.BadParameter("config must be a YAML mapping",
+                                     param_hint="--config")
+        ctx.default_map = {**(ctx.default_map or {}), **loaded}
+    return value
+
+
 _common = [
+    click.option("--config", type=click.Path(exists=True, dir_okay=False),
+                 is_eager=True, callback=_load_config, expose_value=False,
+                 help="YAML file of flag defaults (CLI flags override)."),
     click.option("--sleep", default=5.0, show_default=True,
                  type=click.FloatRange(min=0.1),
                  help="Reconcile interval seconds (reference: --sleep, 60)."),
