@@ -99,12 +99,47 @@ class TestConfigFile:
 
     def test_cli_flag_overrides_config(self, tmp_path):
         cfg = tmp_path / "config.yaml"
-        cfg.write_text("scenario: v5e-8\nspare_agents: 0\n")
+        cfg.write_text("scenario: v5e-8\nspare_agents: 0\n"
+                       "provision_delay: 45\n")
         result = CliRunner().invoke(cli, [
             "demo", "--config", str(cfg), "--scenario", "cpu",
             "--provision-delay", "30"])
         assert result.exit_code == 0, result.output
         assert "[cpu]" in result.output
+        assert "30.0s" in result.output  # CLI value beat the config's 45
+
+    def test_unknown_config_key_rejected(self, tmp_path):
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("idle-treshold: 900\n")  # typo'd key
+        result = CliRunner().invoke(cli, ["demo", "--config", str(cfg)])
+        assert result.exit_code == 2
+        assert "unknown config key" in result.output
+
+    def test_dashed_keys_normalized(self, tmp_path):
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("provision-delay: 45\nscenario: cpu\n"
+                       "spare-agents: 0\n")
+        result = CliRunner().invoke(cli, ["demo", "--config", str(cfg)])
+        assert result.exit_code == 0, result.output
+        assert "45.0s" in result.output
+
+    def test_malformed_yaml_clean_error(self, tmp_path):
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("foo: [unclosed\n")
+        result = CliRunner().invoke(cli, ["demo", "--config", str(cfg)])
+        assert result.exit_code == 2
+        assert "invalid YAML" in result.output
+
+    def test_spare_slices_config_key_works(self, tmp_path):
+        # The docstring's example key must actually reach the policy.
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text('spare_slices: ["v5e-8=1"]\nscenario: cpu\n'
+                       "spare_agents: 0\nidle_threshold: 99999\n")
+        result = CliRunner().invoke(cli, ["demo", "--config", str(cfg),
+                                          "--until", "400"])
+        assert result.exit_code == 0, result.output
+        # The warm v5e-8 slice provisioned alongside the cpu node.
+        assert "chips=8" in result.output
 
     def test_non_mapping_config_rejected(self, tmp_path):
         cfg = tmp_path / "config.yaml"

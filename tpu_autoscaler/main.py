@@ -58,21 +58,37 @@ def _load_config(ctx, param, value):
     """--config FILE: YAML keys become flag defaults (CLI still wins).
 
     The reference was flags-only (SURVEY.md §6.6); a config file makes the
-    policy data.  Keys use flag names with underscores, e.g.::
+    policy data.  Keys are flag names (dashes or underscores), e.g.::
 
         idle_threshold: 900
-        spare_slice: ["v5e-8=1"]
-        default_generation: v5p
-    """
-    if value:
-        import yaml
+        spare_slices: ["v5e-8=1"]
+        default-generation: v5p
 
+    Unknown keys are an error, not a silent no-op — a typo'd policy knob
+    must never quietly mis-scale a cluster.
+    """
+    if not value:
+        return value
+    import yaml
+
+    try:
         with open(value) as f:
             loaded = yaml.safe_load(f) or {}
-        if not isinstance(loaded, dict):
-            raise click.BadParameter("config must be a YAML mapping",
-                                     param_hint="--config")
-        ctx.default_map = {**(ctx.default_map or {}), **loaded}
+    except yaml.YAMLError as e:
+        raise click.BadParameter(f"invalid YAML: {e}",
+                                 param_hint="--config") from None
+    if not isinstance(loaded, dict):
+        raise click.BadParameter("config must be a YAML mapping",
+                                 param_hint="--config")
+    known = {p.name for p in ctx.command.params if p.name}
+    normalized = {str(k).replace("-", "_"): v for k, v in loaded.items()}
+    unknown = sorted(set(normalized) - known)
+    if unknown:
+        raise click.BadParameter(
+            f"unknown config key(s): {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(known))})",
+            param_hint="--config")
+    ctx.default_map = {**(ctx.default_map or {}), **normalized}
     return value
 
 
