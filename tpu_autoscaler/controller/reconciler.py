@@ -96,6 +96,9 @@ class Controller:
         # the north-star latency metric; cleared when the gang runs.
         self._gang_first_pending: dict[tuple, float] = {}
         self._drain_started: dict[str, float] = {}
+        # Drains begun for idleness (not requested/unhealthy) may be
+        # cancelled if matching demand appears before deletion.
+        self._drain_cancellable: set[str] = set()
         self._unhealthy_since: dict[str, float] = {}
         self._reported_unsatisfiable: set[tuple] = set()
         self._seen_failures: set[str] = set()
@@ -132,6 +135,19 @@ class Controller:
         # sees every pending gang so reclaim deferral protects supply a
         # settling gang will bind to.
         settled_gangs = self._settled(gangs, now)
+
+        # Cancel idle-reclaim drains that pending demand claims BEFORE
+        # planning, so the planner sees the uncordoned slice as supply
+        # instead of provisioning a redundant replacement.
+        if self._drain_cancellable and gangs:
+            units = self._units(nodes)
+            cancellable = {uid: uns for uid, uns in units.items()
+                           if uid in self._drain_cancellable}
+            claimed = self._claimed_by_pending(cancellable, gangs, pods)
+            for unit_id in claimed:
+                self._cancel_drain(unit_id, cancellable[unit_id])
+            if claimed:
+                nodes = [Node(p) for p in self.client.list_nodes()]
 
         if not self.config.no_scale:
             self._scale(settled_gangs, nodes, pods, now)
@@ -460,7 +476,15 @@ class Controller:
                                 f"({view.utilization:.0%} < "
                                 f"{cfg.utilization_threshold:.0%})"))
                 elif state is SliceState.DRAINING:
-                    self._continue_drain(unit_id, unit_nodes, unit_pods, now)
+                    if (unit_id in claimed_ids
+                            and unit_id in self._drain_cancellable):
+                        # Demand that fits this unit appeared mid-drain:
+                        # uncordon and hand it back instead of deleting
+                        # and re-provisioning identical capacity.
+                        self._cancel_drain(unit_id, unit_nodes)
+                    else:
+                        self._continue_drain(unit_id, unit_nodes,
+                                             unit_pods, now)
                 elif state is SliceState.UNHEALTHY:
                     self._handle_unhealthy(unit_id, unit_nodes, unit_pods,
                                            now)
@@ -478,6 +502,7 @@ class Controller:
             if known not in units:
                 self.tracker.forget(known)
                 self._drain_started.pop(known, None)
+                self._drain_cancellable.discard(known)
                 self._requested_drains.discard(known)
                 self._unhealthy_since.pop(known, None)
 
@@ -497,8 +522,22 @@ class Controller:
                         CHECKPOINT_ANNOTATION: str(now)}}})
         self.tracker.note_cordoned(unit_id)
         self._drain_started[unit_id] = now
+        if reason.startswith("idle"):
+            self._drain_cancellable.add(unit_id)
         self.metrics.inc("drains_started")
         self.notifier.notify(f"draining {unit_id}: {reason}")
+
+    def _cancel_drain(self, unit_id: str, unit_nodes: list[Node]) -> None:
+        log.info("cancelling drain of %s: pending demand claims it",
+                 unit_id)
+        for node in unit_nodes:
+            node.uncordon(self.client)
+            self.client.patch_node(node.name, {
+                "metadata": {"annotations": {DRAIN_ANNOTATION: None}}})
+        self.tracker.forget(unit_id)
+        self._drain_started.pop(unit_id, None)
+        self._drain_cancellable.discard(unit_id)
+        self.metrics.inc("drains_cancelled")
 
     def _continue_drain(self, unit_id: str, unit_nodes: list[Node],
                         unit_pods: list[Pod], now: float) -> None:
@@ -525,6 +564,7 @@ class Controller:
             node.delete(self.client)
         self.tracker.forget(unit_id)
         self._drain_started.pop(unit_id, None)
+        self._drain_cancellable.discard(unit_id)
         self._requested_drains.discard(unit_id)
         self.metrics.inc("units_deleted")
         self.notifier.notify(f"deleted idle unit {unit_id}")

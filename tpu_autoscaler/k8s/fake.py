@@ -41,20 +41,24 @@ class FakeKube:
         if "unschedulable" in spec:
             node.setdefault("spec", {})["unschedulable"] = \
                 spec["unschedulable"]
-        meta = patch.get("metadata") or {}
-        for key in ("annotations", "labels"):
-            if key in meta:
-                node.setdefault("metadata", {}).setdefault(key, {}).update(
-                    meta[key])
+        self._merge_meta(node, patch)
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> None:
         self.verb_log.append(("patch_pod", namespace, name, patch))
-        pod = self._pods[(namespace, name)]
+        self._merge_meta(self._pods[(namespace, name)], patch)
+
+    @staticmethod
+    def _merge_meta(obj: dict, patch: dict) -> None:
+        # Strategic-merge semantics for metadata maps: null deletes a key.
         meta = patch.get("metadata") or {}
         for key in ("annotations", "labels"):
             if key in meta:
-                pod.setdefault("metadata", {}).setdefault(key, {}).update(
-                    meta[key])
+                target = obj.setdefault("metadata", {}).setdefault(key, {})
+                for k, v in meta[key].items():
+                    if v is None:
+                        target.pop(k, None)
+                    else:
+                        target[k] = v
 
     def evict_pod(self, namespace: str, name: str) -> None:
         self.verb_log.append(("evict", namespace, name))
