@@ -147,3 +147,39 @@ class TestConfigFile:
         result = CliRunner().invoke(cli, ["demo", "--config", str(cfg)])
         assert result.exit_code == 2
         assert "YAML mapping" in result.output
+
+
+class TestBigClusterPerfSmoke:
+    def test_maintain_scales_to_hundreds_of_units(self):
+        """One reconcile pass over a big cluster stays well inside the
+        loop interval (the reference called this trivially cheap at k8s
+        scale — SURVEY §4.5; hold ourselves to the same)."""
+        import time
+
+        from tpu_autoscaler.actuators.fake import FakeActuator
+        from tpu_autoscaler.controller import Controller, ControllerConfig
+        from tpu_autoscaler.engine.planner import PoolPolicy
+        from tpu_autoscaler.k8s.fake import FakeKube
+        from tpu_autoscaler.topology import shape_by_name
+        from tests.fixtures import make_pod, make_slice_nodes, make_node
+
+        kube = FakeKube()
+        shape = shape_by_name("v5e-16")
+        # 50 TPU slices (200 nodes) + 100 CPU nodes + 300 running pods.
+        for i in range(50):
+            for payload in make_slice_nodes(shape, f"s{i}"):
+                kube.add_node(payload)
+        for i in range(100):
+            kube.add_node(make_node(name=f"cpu-{i}", slice_id=f"cpu-{i}"))
+        for i in range(300):
+            kube.add_pod(make_pod(
+                name=f"w{i}", owner_kind="ReplicaSet", phase="Running",
+                node_name=f"cpu-{i % 100}", unschedulable=False,
+                requests={"cpu": "100m"}))
+        controller = Controller(kube, FakeActuator(kube), ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0)))
+        controller.reconcile_once(now=0.0)  # warm caches/trackers
+        t0 = time.perf_counter()
+        controller.reconcile_once(now=5.0)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 1.0, f"reconcile took {elapsed:.2f}s at 300 nodes"

@@ -10,6 +10,12 @@ The kernel is pure (no data-dependent Python control flow; masking instead
 of branching) so it jits once and reuses across reconcile passes — the
 XLA-first rewrite of the reference's per-pod Python loop
 (cluster.py §Cluster.scale, O(pods×pools) fit checks).
+
+Scope: scoring is over the CHIP axes (total, per-pod, host slots) — the
+dimensions that decide TPU shape choice in practice.  The Python engine
+(engine/fitter.py) additionally binds host cpu/memory and is authoritative
+when those axes constrain; use this scorer for bulk triage, the Python
+path for the final decision.
 """
 
 from __future__ import annotations

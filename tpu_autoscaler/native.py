@@ -1,10 +1,14 @@
 """ctypes bindings for the native fit/pack kernels (native/fitpack.cpp).
 
-Optional acceleration with identical semantics to the Python engine
+Optional acceleration with matching semantics on the axes it models
 (engine/fitter.py holds the reference implementation; tests assert the
-two agree decision-for-decision).  The library is built on first use with
-the system toolchain and cached; every entry point degrades to None when
-no compiler is available, so the controller never depends on it.
+two agree decision-for-decision on those axes).  Scope: shape scoring
+covers the chip axes (total / per-pod / host slots); packing covers
+cpu+memory.  The Python engine additionally binds host cpu/memory in
+shape feasibility and taint admission in packing, and is authoritative
+where they constrain.  The library is built on first use with the system
+toolchain and cached; every entry point degrades to None when no compiler
+is available, so the controller never depends on it.
 """
 
 from __future__ import annotations
