@@ -72,3 +72,28 @@ class TestGangProperties:
         g = group_into_gangs(pods([make_pod(requests={"cpu": "2"})]))[0]
         assert not g.requests_tpu
         assert g.tpu_chips == 0
+
+
+class TestPriorityOrdering:
+    def test_higher_priority_served_first(self):
+        low = make_pod(name="low", created="2026-07-28T10:00:00Z")
+        high = make_pod(name="high", created="2026-07-28T12:00:00Z")
+        high["spec"]["priority"] = 1000
+        gs = group_into_gangs(pods([low, high]))
+        # Priority beats age.
+        assert [g.name for g in gs] == ["high", "low"]
+
+    def test_equal_priority_falls_back_to_age(self):
+        a = make_pod(name="newer", created="2026-07-28T12:00:00Z")
+        b = make_pod(name="older", created="2026-07-28T10:00:00Z")
+        for p in (a, b):
+            p["spec"]["priority"] = 5
+        gs = group_into_gangs(pods([a, b]))
+        assert [g.name for g in gs] == ["older", "newer"]
+
+    def test_gang_priority_is_max_of_members(self):
+        a = make_tpu_pod(name="a", chips=4, job="j")
+        b = make_tpu_pod(name="b", chips=4, job="j")
+        b["spec"]["priority"] = 7
+        g = group_into_gangs(pods([a, b]))[0]
+        assert g.priority == 7

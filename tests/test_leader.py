@@ -1,0 +1,110 @@
+"""Leader election tests (k8s/leader.py against the fake apiserver's
+optimistic-concurrency lease store)."""
+
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.k8s.leader import LeaseLock
+
+
+def locks(kube, ttl=15.0):
+    return (LeaseLock(kube, identity="a", lease_seconds=ttl),
+            LeaseLock(kube, identity="b", lease_seconds=ttl))
+
+
+class TestLeaseLock:
+    def test_first_acquire_wins(self):
+        kube = FakeKube()
+        a, b = locks(kube)
+        assert a.try_acquire(now=0.0)
+        assert not b.try_acquire(now=1.0)
+
+    def test_renewal_keeps_leadership(self):
+        kube = FakeKube()
+        a, b = locks(kube)
+        assert a.try_acquire(now=0.0)
+        for t in range(5, 60, 5):
+            assert a.try_acquire(now=float(t))
+            assert not b.try_acquire(now=float(t) + 1)
+
+    def test_expired_lease_fails_over(self):
+        kube = FakeKube()
+        a, b = locks(kube, ttl=15.0)
+        assert a.try_acquire(now=0.0)
+        # a stops renewing; past the ttl, b takes over.
+        assert not b.try_acquire(now=10.0)
+        assert b.try_acquire(now=16.0)
+        # a comes back: lease is b's now.
+        assert not a.try_acquire(now=17.0)
+
+    def test_conflict_rejected_one_winner(self):
+        kube = FakeKube()
+        a, b = locks(kube, ttl=15.0)
+        assert a.try_acquire(now=0.0)
+        # Both observe the expired lease and race the transition; the fake
+        # apiserver's resourceVersion check allows exactly one winner.
+        lease_before = kube.get_lease("kube-system", "tpu-autoscaler")
+        won_b = b.try_acquire(now=20.0)
+        assert won_b
+        # a races with the STALE view by writing with the old version.
+        try:
+            kube.put_lease("kube-system", "tpu-autoscaler", lease_before)
+            raced = True
+        except RuntimeError:
+            raced = False
+        assert not raced
+
+    def test_acquire_time_preserved_on_renew(self):
+        kube = FakeKube()
+        a, _ = locks(kube)
+        a.try_acquire(now=0.0)
+        first = kube.get_lease("kube-system", "tpu-autoscaler")
+        a.try_acquire(now=5.0)
+        second = kube.get_lease("kube-system", "tpu-autoscaler")
+        assert (first["spec"]["acquireTime"]
+                == second["spec"]["acquireTime"])
+        assert first["spec"]["renewTime"] != second["spec"]["renewTime"]
+
+    def test_unreachable_apiserver_means_not_leader(self):
+        class Down:
+            def get_lease(self, ns, name):
+                raise ConnectionError("apiserver down")
+
+        lock = LeaseLock(Down(), identity="x")
+        assert not lock.try_acquire(now=0.0)
+
+
+class TestControllerIntegration:
+    def test_only_leader_reconciles(self):
+        import threading
+        import time
+
+        from tpu_autoscaler.actuators.fake import FakeActuator
+        from tpu_autoscaler.controller import Controller, ControllerConfig
+        from tpu_autoscaler.engine.planner import PoolPolicy
+
+        kube = FakeKube()
+        config = ControllerConfig(policy=PoolPolicy(spare_nodes=0))
+        c1 = Controller(kube, FakeActuator(kube), config)
+        c2 = Controller(kube, FakeActuator(kube), config)
+        l1 = LeaseLock(kube, identity="c1", lease_seconds=60.0)
+        l2 = LeaseLock(kube, identity="c2", lease_seconds=60.0)
+
+        t1 = threading.Thread(
+            target=c1.run_forever,
+            kwargs={"interval_seconds": 0.1, "watch": False,
+                    "leader_lock": l1}, daemon=True)
+        t2 = threading.Thread(
+            target=c2.run_forever,
+            kwargs={"interval_seconds": 0.1, "watch": False,
+                    "leader_lock": l2}, daemon=True)
+        t1.start()
+        time.sleep(0.3)  # c1 acquires first
+        t2.start()
+        time.sleep(0.6)
+        s1 = c1.metrics.snapshot()
+        s2 = c2.metrics.snapshot()
+        assert s1["gauges"].get("is_leader") == 1
+        assert s2["gauges"].get("is_leader") == 0
+        assert s1["summaries"].get(
+            "reconcile_seconds", {}).get("count", 0) > 0
+        assert s2["summaries"].get(
+            "reconcile_seconds", {}).get("count", 0) == 0

@@ -292,3 +292,18 @@ class TestReviewRegressions:
                         policy=policy)
         by_machine = {r.shape_name: r.count for r in plan.requests}
         assert by_machine == {"n2-standard-32": 1}
+
+    def test_priority_wins_contended_chip_budget(self):
+        """Under max_total_chips, the high-priority gang gets the slice."""
+        from tests.fixtures import make_tpu_pod
+
+        low = make_tpu_pod(name="low", chips=8, job="low-j",
+                           created="2026-07-28T10:00:00Z")
+        high = make_tpu_pod(name="high", chips=8, job="high-j",
+                            created="2026-07-28T12:00:00Z")
+        high["spec"]["priority"] = 100
+        plan = plan_for([low, high],
+                        policy=PoolPolicy(spare_nodes=0, max_total_chips=8))
+        assert len(plan.requests) == 1
+        assert plan.requests[0].gang_key == ("job", "default", "high-j")
+        assert len(plan.unsatisfiable) == 1

@@ -172,7 +172,7 @@ class Controller:
         self.metrics.set_gauge("nodes", len(nodes))
 
     def run_forever(self, interval_seconds: float = 5.0,
-                    watch: bool = True) -> None:
+                    watch: bool = True, leader_lock=None) -> None:
         """Reconcile loop (reference: main.py while True / sleep).
 
         The interval is seconds-scale, not the reference's 60 s — detection
@@ -190,7 +190,13 @@ class Controller:
             WatchTrigger(self.client, wake).start()
         while True:
             try:
-                self.reconcile_once()
+                if leader_lock is not None and not leader_lock.try_acquire(
+                        time.time()):
+                    self.metrics.set_gauge("is_leader", 0)
+                else:
+                    if leader_lock is not None:
+                        self.metrics.set_gauge("is_leader", 1)
+                    self.reconcile_once()
             except Exception:  # noqa: BLE001 — crash-only loop
                 log.exception("reconcile pass failed")
                 self.metrics.inc("reconcile_errors")

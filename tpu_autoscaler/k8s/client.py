@@ -42,6 +42,10 @@ class KubeClient(Protocol):
 
     def delete_node(self, name: str) -> None: ...
 
+    def get_lease(self, namespace: str, name: str) -> dict | None: ...
+
+    def put_lease(self, namespace: str, name: str, body: dict) -> None: ...
+
 
 class RestKubeClient:
     """Real apiserver client over HTTPS.
@@ -175,6 +179,32 @@ class RestKubeClient:
 
     def delete_node(self, name: str) -> None:
         self._mutate("DELETE", f"/api/v1/nodes/{name}")
+
+    def get_lease(self, namespace: str, name: str) -> dict | None:
+        import requests
+
+        r = self._session.get(
+            f"{self._base}/apis/coordination.k8s.io/v1/namespaces/"
+            f"{namespace}/leases/{name}", timeout=10)
+        if r.status_code == 404:
+            return None
+        r.raise_for_status()
+        return r.json()
+
+    def put_lease(self, namespace: str, name: str, body: dict) -> None:
+        base = (f"{self._base}/apis/coordination.k8s.io/v1/namespaces/"
+                f"{namespace}/leases")
+        exists = "resourceVersion" in body.get("metadata", {})
+        import json as _json
+
+        import requests  # noqa: F401 — session types
+
+        r = self._session.request(
+            "PUT" if exists else "POST",
+            f"{base}/{name}" if exists else base,
+            data=_json.dumps(body),
+            headers={"Content-Type": "application/json"}, timeout=10)
+        r.raise_for_status()
 
     def watch_pods(self, timeout_seconds: int = 60):
         """Yield pod watch events (dicts) until the server closes the watch.

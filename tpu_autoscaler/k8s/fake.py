@@ -23,6 +23,7 @@ class FakeKube:
     def __init__(self):
         self._nodes: dict[str, dict] = {}
         self._pods: dict[tuple[str, str], dict] = {}
+        self._leases: dict[tuple[str, str], dict] = {}
         self._uid = itertools.count(1)
         self.verb_log: list[tuple] = []
 
@@ -71,6 +72,36 @@ class FakeKube:
     def delete_node(self, name: str) -> None:
         self.verb_log.append(("delete_node", name))
         self._nodes.pop(name, None)
+
+    def get_lease(self, namespace: str, name: str) -> dict | None:
+        import copy
+
+        lease = self._leases.get((namespace, name))
+        return copy.deepcopy(lease) if lease else None
+
+    def put_lease(self, namespace: str, name: str, body: dict) -> None:
+        """Optimistic concurrency like the real apiserver: an update whose
+        resourceVersion doesn't match (or a create over an existing lease)
+        raises — the losing candidate's write is rejected."""
+        import copy
+
+        key = (namespace, name)
+        existing = self._leases.get(key)
+        rv = body.get("metadata", {}).get("resourceVersion")
+        if existing is None:
+            if rv is not None:
+                raise RuntimeError("409: lease vanished")
+            stored = copy.deepcopy(body)
+            stored["metadata"]["resourceVersion"] = "1"
+            self._leases[key] = stored
+            return
+        current_rv = existing["metadata"]["resourceVersion"]
+        if rv is None or rv != current_rv:
+            raise RuntimeError(
+                f"409: resourceVersion conflict ({rv} != {current_rv})")
+        stored = copy.deepcopy(body)
+        stored["metadata"]["resourceVersion"] = str(int(current_rv) + 1)
+        self._leases[key] = stored
 
     # ---- fixture mutators ----------------------------------------------
 

@@ -88,24 +88,35 @@ class Gang:
         times = [p.created for p in self.pods if p.created is not None]
         return min(times) if times else None
 
+    @property
+    def priority(self) -> int:
+        """Scheduling priority from spec.priority (admission-resolved
+        priorityClassName); gang priority = max over members."""
+        return max((p.priority for p in self.pods), default=0)
+
     def __repr__(self) -> str:
         return (f"Gang({self.key}, pods={self.size}, "
                 f"chips={self.tpu_chips})")
 
 
 def group_into_gangs(pods: Iterable[Pod]) -> list[Gang]:
-    """Group pods into gangs by gang_key, oldest demand first.
+    """Group pods into gangs by gang_key, highest priority then oldest.
 
-    Ordering matters for fairness under capacity clamps: like the reference's
-    loop (cluster.py §Cluster.scale iterated pods in list order), we serve
-    the longest-waiting demand first — but at gang granularity.
+    Ordering matters for fairness under capacity clamps: the reference
+    served pods in list order (cluster.py §Cluster.scale); here demand is
+    served by (priority desc, age asc) at gang granularity, so
+    high-priority jobs win contended chip budget and equal-priority jobs
+    queue fairly.
     """
     by_key: dict[tuple[str, str, str], list[Pod]] = {}
     for pod in pods:
         by_key.setdefault(pod.gang_key, []).append(pod)
     gangs = [Gang(key=k, pods=v) for k, v in by_key.items()]
-    # Gangs with no timestamp sort last; ties break by key for determinism.
-    gangs.sort(key=lambda g: ((g.oldest_created is None),
-                              g.oldest_created.timestamp() if g.oldest_created else 0.0,
-                              g.key))
+    # Within a priority tier: gangs with no timestamp sort last; ties
+    # break by key for determinism.
+    gangs.sort(key=lambda g: (
+        -g.priority,
+        (g.oldest_created is None),
+        g.oldest_created.timestamp() if g.oldest_created else 0.0,
+        g.key))
     return gangs

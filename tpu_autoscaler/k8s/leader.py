@@ -1,0 +1,98 @@
+"""Leader election over the Kubernetes Lease API.
+
+The reference assumed a single replica (Deployment + crash-restart); with
+leader election the autoscaler can run replicated for fast failover while
+keeping exactly one writer.  Standard lease protocol (what client-go's
+leaderelection does): acquire if the lease is absent, expired, or already
+ours; renew by updating ``renewTime``; optimistic concurrency via
+``resourceVersion`` so two candidates can't both win a transition — the
+loser's conflicting update is rejected by the apiserver.
+
+Crash-only safe: leadership is only ever claimed through the apiserver,
+never remembered locally, and losing the lease simply makes the loop skip
+its write phase until re-acquired.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import socket
+import uuid
+
+log = logging.getLogger(__name__)
+
+LEASE_NAME = "tpu-autoscaler"
+LEASE_NAMESPACE = "kube-system"
+
+
+def _rfc3339(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, tz=datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse(ts: str | None) -> float | None:
+    if not ts:
+        return None
+    return datetime.datetime.fromisoformat(
+        ts.replace("Z", "+00:00")).timestamp()
+
+
+def default_identity() -> str:
+    return f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+
+
+class LeaseLock:
+    def __init__(self, client, *, name: str = LEASE_NAME,
+                 namespace: str = LEASE_NAMESPACE,
+                 identity: str | None = None,
+                 lease_seconds: float = 15.0):
+        self._client = client
+        self._name = name
+        self._namespace = namespace
+        self.identity = identity or default_identity()
+        self._ttl = lease_seconds
+
+    def try_acquire(self, now: float) -> bool:
+        """Acquire or renew; True iff we are the leader after this call."""
+        try:
+            lease = self._client.get_lease(self._namespace, self._name)
+        except Exception:  # noqa: BLE001 — apiserver unreachable: not us
+            log.warning("lease read failed", exc_info=True)
+            return False
+        if lease is None:
+            return self._write({"holderIdentity": self.identity,
+                                "acquireTime": _rfc3339(now)}, None, now)
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        renew = _parse(spec.get("renewTime")) or _parse(
+            spec.get("acquireTime")) or 0.0
+        expired = now - renew > self._ttl
+        if holder == self.identity or expired or not holder:
+            merged = {**spec, "holderIdentity": self.identity}
+            if holder != self.identity:
+                merged["acquireTime"] = _rfc3339(now)
+            return self._write(
+                merged, lease.get("metadata", {}).get("resourceVersion"),
+                now)
+        return False
+
+    def _write(self, spec: dict, resource_version: str | None,
+               now: float) -> bool:
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self._name, "namespace": self._namespace},
+            "spec": {**spec,
+                     "renewTime": _rfc3339(now),
+                     "leaseDurationSeconds": int(self._ttl)},
+        }
+        if resource_version is not None:
+            body["metadata"]["resourceVersion"] = resource_version
+        try:
+            self._client.put_lease(self._namespace, self._name, body)
+            return True
+        except Exception:  # noqa: BLE001 — conflict/network: we lost
+            log.info("lease write lost (conflict?)", exc_info=True)
+            return False

@@ -71,6 +71,7 @@ class Pod:
         self.node_selectors: dict[str, str] = dict(spec.get("nodeSelector") or {})
         self.tolerations: list[dict] = list(spec.get("tolerations") or [])
         self.priority_class: str | None = spec.get("priorityClassName")
+        self.priority: int = int(spec.get("priority") or 0)
         self.resources = self._sum_requests(spec)
         status = payload.get("status", {})
         self.phase: str = status.get("phase", "")

@@ -193,8 +193,11 @@ def cli():
 @click.option("--cluster", default=None, help="GKE cluster name.")
 @click.option("--dry-run", is_flag=True,
               help="Log mutations instead of performing them.")
+@click.option("--leader-elect", is_flag=True,
+              help="Coordinate replicas via a kube-system Lease; only the "
+                   "leader acts.")
 def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
-        project, location, cluster, dry_run, sleep, **kw):
+        project, location, cluster, dry_run, leader_elect, sleep, **kw):
     """Run against a real cluster (in-cluster, --kubeconfig, or
     --kube-url)."""
     from tpu_autoscaler.k8s.client import RestKubeClient
@@ -218,7 +221,12 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
         actuator = QueuedResourceActuator(project=project, zone=location,
                                           dry_run=dry_run)
     controller = _build(kube, actuator, sleep=sleep, **kw)
-    controller.run_forever(interval_seconds=sleep)
+    lock = None
+    if leader_elect:
+        from tpu_autoscaler.k8s.leader import LeaseLock
+
+        lock = LeaseLock(kube)
+    controller.run_forever(interval_seconds=sleep, leader_lock=lock)
 
 
 @cli.command()
