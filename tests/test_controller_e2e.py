@@ -529,3 +529,24 @@ class TestDrainCancellation:
         assert snap["counters"].get("drains_cancelled", 0) == 0
         assert snap["counters"]["units_deleted"] == 1
         assert snap["counters"]["provisions_submitted"] == 2
+
+
+class TestEvents:
+    def test_scale_up_event_on_gang_pod(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        controller.reconcile_once(now=0.0)
+        reasons = [(ns, b["reason"], b["involvedObject"]["name"])
+                   for ns, b in kube.events]
+        assert ("default", "TriggeredScaleUp", "jax") in reasons
+
+    def test_unsatisfiable_event_is_warning(self):
+        kube, actuator, controller = make_harness()
+        kube.add_pod(make_tpu_pod(name="huge", chips=4096, job="huge"))
+        controller.reconcile_once(now=0.0)
+        warnings = [b for _, b in kube.events if b["type"] == "Warning"]
+        assert warnings
+        assert warnings[0]["reason"] == "NotTriggerScaleUp"
+        assert "no v5e shape" in warnings[0]["message"]

@@ -243,6 +243,7 @@ class Controller:
         # Process failures FIRST so a provision that failed since last pass
         # sets its backoff before we consider re-submitting for its demand.
         self._note_failures(now)
+        plan_gangs = gangs
         plan = self.planner.plan(gangs, nodes, pods,
                                  in_flight_of(self.actuator))
         for req in plan.requests:
@@ -260,6 +261,14 @@ class Controller:
                 self.metrics.observe("stranded_chips", req.stranded_chips)
             self.notifier.notify(
                 f"scaling up: {req.count}x {req.shape_name} — {req.reason}")
+            if req.gang_key is not None:
+                served = next((g for g in plan_gangs
+                               if g.key == req.gang_key), None)
+                if served and served.pods:
+                    self._emit_event(
+                        served.pods[0], "TriggeredScaleUp",
+                        f"provisioning {req.shape_name} for this job "
+                        f"({req.reason})")
         for gang, reason in plan.unsatisfiable:
             if gang.key not in self._reported_unsatisfiable:
                 self._reported_unsatisfiable.add(gang.key)
@@ -268,6 +277,9 @@ class Controller:
                 self.notifier.notify(f"cannot satisfy {gang.name}: {reason}")
                 # Stamp the verdict on the pods so `kubectl describe`
                 # answers "why is my job not scaling" without log access.
+                if gang.pods:
+                    self._emit_event(gang.pods[0], "NotTriggerScaleUp",
+                                     reason, warning=True)
                 for pod in gang.pods:
                     try:
                         self.client.patch_pod(pod.namespace, pod.name, {
@@ -326,6 +338,32 @@ class Controller:
             del self._gang_sizes[key]
 
     # ---- scale-down / maintenance -------------------------------------- #
+
+    def _emit_event(self, pod: Pod, reason: str, message: str,
+                    warning: bool = False) -> None:
+        """Best-effort core/v1 Event on a pod, kubectl-describe visible
+        (upstream cluster-autoscaler behavior; the reference had only
+        Slack).  Never fails the loop."""
+        import datetime
+
+        ts = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        body = {
+            "metadata": {"generateName": "tpu-autoscaler-",
+                         "namespace": pod.namespace},
+            "involvedObject": {"kind": "Pod", "namespace": pod.namespace,
+                               "name": pod.name, "uid": pod.uid},
+            "reason": reason,
+            "message": message[:1000],
+            "type": "Warning" if warning else "Normal",
+            "source": {"component": "tpu-autoscaler"},
+            "firstTimestamp": ts,
+            "lastTimestamp": ts,
+            "count": 1,
+        }
+        try:
+            self.client.create_event(pod.namespace, body)
+        except Exception:  # noqa: BLE001 — advisory only
+            log.debug("event emission failed", exc_info=True)
 
     def request_drain(self, unit_id: str) -> None:
         """Ask for a unit to be evacuated (spot reclamation notice,

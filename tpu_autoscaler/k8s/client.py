@@ -46,6 +46,8 @@ class KubeClient(Protocol):
 
     def put_lease(self, namespace: str, name: str, body: dict) -> None: ...
 
+    def create_event(self, namespace: str, body: dict) -> None: ...
+
 
 class RestKubeClient:
     """Real apiserver client over HTTPS.
@@ -179,6 +181,10 @@ class RestKubeClient:
 
     def delete_node(self, name: str) -> None:
         self._mutate("DELETE", f"/api/v1/nodes/{name}")
+
+    def create_event(self, namespace: str, body: dict) -> None:
+        self._mutate("POST", f"/api/v1/namespaces/{namespace}/events",
+                     body)
 
     def get_lease(self, namespace: str, name: str) -> dict | None:
         import requests

@@ -26,6 +26,7 @@ class FakeKube:
         self._leases: dict[tuple[str, str], dict] = {}
         self._uid = itertools.count(1)
         self.verb_log: list[tuple] = []
+        self.events: list[tuple[str, dict]] = []
 
     # ---- KubeClient protocol -------------------------------------------
 
@@ -72,6 +73,9 @@ class FakeKube:
     def delete_node(self, name: str) -> None:
         self.verb_log.append(("delete_node", name))
         self._nodes.pop(name, None)
+
+    def create_event(self, namespace: str, body: dict) -> None:
+        self.events.append((namespace, body))
 
     def get_lease(self, namespace: str, name: str) -> dict | None:
         import copy
