@@ -550,3 +550,15 @@ class TestEvents:
         assert warnings
         assert warnings[0]["reason"] == "NotTriggerScaleUp"
         assert "no v5e shape" in warnings[0]["message"]
+
+    def test_events_on_every_gang_pod_with_simulated_time(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-16")
+        for p in make_gang(shape, job="g"):
+            kube.add_pod(p)
+        controller.reconcile_once(now=42.0)
+        ups = [b for _, b in kube.events
+               if b["reason"] == "TriggeredScaleUp"]
+        assert len(ups) == 4  # one per gang pod
+        assert all(b["firstTimestamp"].endswith("Z") for b in ups)
+        assert ups[0]["firstTimestamp"].startswith("1970-01-01T00:00:42")

@@ -243,7 +243,6 @@ class Controller:
         # Process failures FIRST so a provision that failed since last pass
         # sets its backoff before we consider re-submitting for its demand.
         self._note_failures(now)
-        plan_gangs = gangs
         plan = self.planner.plan(gangs, nodes, pods,
                                  in_flight_of(self.actuator))
         for req in plan.requests:
@@ -262,11 +261,11 @@ class Controller:
             self.notifier.notify(
                 f"scaling up: {req.count}x {req.shape_name} — {req.reason}")
             if req.gang_key is not None:
-                served = next((g for g in plan_gangs
+                served = next((g for g in gangs
                                if g.key == req.gang_key), None)
-                if served and served.pods:
+                for pod in (served.pods if served else []):
                     self._emit_event(
-                        served.pods[0], "TriggeredScaleUp",
+                        pod, now, "TriggeredScaleUp",
                         f"provisioning {req.shape_name} for this job "
                         f"({req.reason})")
         for gang, reason in plan.unsatisfiable:
@@ -277,8 +276,8 @@ class Controller:
                 self.notifier.notify(f"cannot satisfy {gang.name}: {reason}")
                 # Stamp the verdict on the pods so `kubectl describe`
                 # answers "why is my job not scaling" without log access.
-                if gang.pods:
-                    self._emit_event(gang.pods[0], "NotTriggerScaleUp",
+                for pod in gang.pods:
+                    self._emit_event(pod, now, "NotTriggerScaleUp",
                                      reason, warning=True)
                 for pod in gang.pods:
                     try:
@@ -339,14 +338,18 @@ class Controller:
 
     # ---- scale-down / maintenance -------------------------------------- #
 
-    def _emit_event(self, pod: Pod, reason: str, message: str,
+    def _emit_event(self, pod: Pod, now: float, reason: str, message: str,
                     warning: bool = False) -> None:
         """Best-effort core/v1 Event on a pod, kubectl-describe visible
         (upstream cluster-autoscaler behavior; the reference had only
-        Slack).  Never fails the loop."""
+        Slack).  Never fails the loop.  Timestamps use the injected clock
+        (canonical Z form, like payloads._iso) so e2e events are
+        deterministic under simulated time."""
         import datetime
 
-        ts = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        ts = datetime.datetime.fromtimestamp(
+            now, tz=datetime.timezone.utc).isoformat().replace(
+            "+00:00", "Z")
         body = {
             "metadata": {"generateName": "tpu-autoscaler-",
                          "namespace": pod.namespace},
