@@ -562,3 +562,72 @@ class TestEvents:
         assert len(ups) == 4  # one per gang pod
         assert all(b["firstTimestamp"].endswith("Z") for b in ups)
         assert ups[0]["firstTimestamp"].startswith("1970-01-01T00:00:42")
+
+
+class TestStuckProvisionTimeout:
+    """SURVEY §8 hard part: a provision stuck in PROVISIONING (stockout
+    without a FAILED report) must be cancelled and retried, not block its
+    gang forever."""
+
+    def test_stuck_provision_cancelled_and_retried(self):
+        kube = FakeKube()
+        # First provision never materializes (huge delay = stuck queue).
+        actuator = FakeActuator(kube, provision_delay=10_000.0)
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0),
+            provision_timeout_seconds=120.0,
+            provision_retry_seconds=30.0))
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        t = 0.0
+        while t <= 130.0:  # past the timeout
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 5.0
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["provisions_timed_out"] == 1
+        # The cloud un-sticks: shorten the delay; retry succeeds after
+        # backoff and the gang finally runs.
+        actuator._delay = 0.0
+        while t <= 300.0 and not pod_running(kube, "jax"):
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 5.0
+        assert pod_running(kube, "jax")
+        assert snap["counters"]["provisions_submitted"] == 1  # old snap
+        final = controller.metrics.snapshot()
+        assert final["counters"]["provisions_submitted"] == 2
+
+
+class TestPdbBlockedEviction:
+    def test_pdb_block_does_not_starve_other_units_then_completes(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="protected", chips=8, shape=shape,
+                                  job="p"))
+        kube.add_pod(make_pod(name="web", requests={"cpu": "2"},
+                              owner_kind="ReplicaSet"))
+        run_loop(kube, controller, stop_when=lambda: (
+            pod_running(kube, "protected") and pod_running(kube, "web")))
+        slice_id = next(
+            n["metadata"]["labels"]["autoscaler.tpu.dev/slice-id"]
+            for n in kube.list_nodes()
+            if "gke-tpu-topology" in str(n["metadata"]["labels"]))
+        kube.pdb_protected.add(("default", "protected"))
+        controller.request_drain(slice_id)
+        # Well past the drain grace: evictions 429 every pass, but the
+        # loop keeps running and other units are untouched.
+        run_loop(kube, controller, start=10.0, until=120.0, step=5.0)
+        assert pod_running(kube, "protected")  # still blocked
+        assert pod_running(kube, "web")        # other unit unharmed
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("maintain_errors", 0) == 0
+        # PDB lifts (replacement pod became ready elsewhere): drain
+        # completes and the slice is reclaimed.
+        kube.pdb_protected.clear()
+        run_loop(kube, controller, start=125.0, until=250.0, step=5.0)
+        assert kube.get_pod("default", "protected") is None
+        tpu_nodes = [n for n in kube.list_nodes()
+                     if "gke-tpu-topology" in str(n["metadata"]["labels"])]
+        assert tpu_nodes == []

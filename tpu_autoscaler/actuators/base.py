@@ -58,6 +58,12 @@ class Actuator(Protocol):
         """All known provisions (in-flight and recently terminal)."""
         ...
 
+    def cancel(self, provision_id: str) -> None:
+        """Abort an in-flight provision (stuck in ACCEPTED/PROVISIONING):
+        tear down whatever was created and mark the status FAILED so the
+        controller's backoff/retry takes over.  Idempotent."""
+        ...
+
 
 def in_flight_of(actuator: Actuator) -> list[InFlight]:
     """Planner's view of an actuator's outstanding work."""

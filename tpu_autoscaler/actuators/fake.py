@@ -88,6 +88,17 @@ class FakeActuator:
     def statuses(self) -> list[ProvisionStatus]:
         return list(self._statuses.values())
 
+    def cancel(self, provision_id: str) -> None:
+        status = self._statuses.get(provision_id)
+        if status is None or not status.in_flight:
+            return
+        # Tear down any partially-materialized hosts (staggered slices).
+        req = status.request
+        if req.kind == "tpu-slice":
+            self.delete(f"{req.shape_name}-{provision_id}")
+        status.state = FAILED
+        status.error = "cancelled: provision timeout"
+
     # ---- materialization ------------------------------------------------
 
     def _materialize(self, pid: str, status: ProvisionStatus,

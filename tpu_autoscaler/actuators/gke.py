@@ -183,3 +183,14 @@ class GkeNodePoolActuator:
 
     def statuses(self) -> list[ProvisionStatus]:
         return list(self._statuses.values())
+
+    def cancel(self, provision_id: str) -> None:
+        status = self._statuses.get(provision_id)
+        if status is None or not status.in_flight:
+            return
+        # Delete whatever pools the stuck provision created; node-pool
+        # deletion supersedes a pending create on GKE.
+        for pool_name in self._pools.get(provision_id, [provision_id]):
+            self.delete(pool_name)
+        status.state = FAILED
+        status.error = "cancelled: provision timeout"

@@ -159,3 +159,13 @@ class QueuedResourceActuator:
 
     def statuses(self) -> list[ProvisionStatus]:
         return list(self._statuses.values())
+
+    def cancel(self, provision_id: str) -> None:
+        status = self._statuses.get(provision_id)
+        if status is None or not status.in_flight:
+            return
+        # force=true deletes a queued resource in any state, including
+        # WAITING_FOR_RESOURCES (the classic stuck-queue case).
+        self.delete(provision_id)
+        status.state = FAILED
+        status.error = "cancelled: provision timeout"

@@ -65,6 +65,11 @@ class ControllerConfig:
     # reference's blunt one-deployment-at-a-time serialization throttled
     # retries implicitly; we need it explicit).
     provision_retry_seconds: float = 60.0
+    # A provision stuck in ACCEPTED/PROVISIONING this long (stockout that
+    # never reports FAILED) is cancelled and retried — without this the
+    # gang it serves waits forever behind a dead in-flight entry
+    # (SURVEY §8 hard parts: "slice stuck in PROVISIONING").
+    provision_timeout_seconds: float = 900.0
     # Consolidation: CPU units busier than idle but below this requested/
     # allocatable fraction, with all pods movable, are drained so their
     # pods repack onto other nodes (reference: UNDER_UTILIZED_DRAINABLE).
@@ -289,6 +294,17 @@ class Controller:
                                   exc_info=True)
 
     def _note_failures(self, now: float) -> None:
+        # Cancel provisions stuck in flight past the timeout; the FAILED
+        # status this produces is then handled by the normal backoff path.
+        timeout = self.config.provision_timeout_seconds
+        for status in self.actuator.statuses():
+            submitted = self._submitted_at.get(status.id)
+            if (status.in_flight and submitted is not None
+                    and now - submitted > timeout):
+                log.warning("provision %s stuck in flight for %.0fs; "
+                            "cancelling", status.id, now - submitted)
+                self.metrics.inc("provisions_timed_out")
+                self.actuator.cancel(status.id)
         # Submit→ACTIVE latency per provision (the actuation slice of the
         # north-star budget; SURVEY.md §4.2 latency anatomy).
         for status in self.actuator.statuses():

@@ -27,6 +27,8 @@ class FakeKube:
         self._uid = itertools.count(1)
         self.verb_log: list[tuple] = []
         self.events: list[tuple[str, dict]] = []
+        # (namespace, name) pairs whose eviction a PDB currently blocks.
+        self.pdb_protected: set[tuple[str, str]] = set()
 
     # ---- KubeClient protocol -------------------------------------------
 
@@ -64,6 +66,11 @@ class FakeKube:
 
     def evict_pod(self, namespace: str, name: str) -> None:
         self.verb_log.append(("evict", namespace, name))
+        if (namespace, name) in self.pdb_protected:
+            # Model the eviction API's 429 when a PodDisruptionBudget
+            # blocks the disruption.
+            raise RuntimeError("429: Cannot evict pod as it would violate "
+                               "the pod's disruption budget.")
         self._pods.pop((namespace, name), None)
 
     def delete_pod(self, namespace: str, name: str) -> None:
