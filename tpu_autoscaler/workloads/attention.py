@@ -80,21 +80,71 @@ def _flash_attention(q, k, v, causal, block_q, interpret):
     return _forward_pallas(q, k, v, causal, block_q, interpret)
 
 
+def _attn_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
+                     *, sm_scale: float, causal: bool):
+    """Fused backward for one (batch*head): recompute-p flash backward.
+
+    Whole-sequence rows per grid cell (the workload's sequence lengths
+    keep [s, s] comfortably in VMEM); probabilities are recomputed from
+    q/k — the classic flash trade: no [s, s] tensor ever round-trips HBM.
+    Masked entries have p == 0, so ds vanishes there without extra masking.
+    """
+    qs = q_ref[0].astype(jnp.float32) * sm_scale                 # [s, d]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    scores = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)                   # [s, s]
+    dv = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [s, d]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [s, s]
+    delta = jnp.sum(p * dp, axis=-1, keepdims=True)              # [s, 1]
+    ds = p * (dp - delta)
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32) * sm_scale
+    dk = jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _backward_pallas(q, k, v, do, causal, interpret):
+    b, h, s, d = q.shape
+    sm_scale = d ** -0.5
+    fold = lambda x: x.reshape(b * h, s, x.shape[-1])  # noqa: E731
+    kernel = functools.partial(_attn_bwd_kernel, sm_scale=sm_scale,
+                               causal=causal)
+    spec = pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(b * h,),
+        in_specs=[spec, spec, spec, spec],
+        out_specs=(spec, spec, spec),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((b * h, s, d), x.dtype)
+            for x in (q, k, v)),
+        interpret=interpret,
+    )(fold(q), fold(k), fold(v), fold(do))
+    unfold = lambda x: x.reshape(b, h, s, d)  # noqa: E731
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
 def _flash_fwd(q, k, v, causal, block_q, interpret):
     return _forward_pallas(q, k, v, causal, block_q, interpret), (q, k, v)
 
 
 def _flash_bwd(causal, block_q, interpret, residuals, g):
-    # Backward rematerializes through the einsum reference (identical
-    # math): pallas_call has no automatic transpose rule, and a bespoke
-    # backward kernel is not worth its complexity at these sizes.  The
-    # fused kernel still wins the forward; the backward pays one einsum
-    # recompute — the classic flash-attention trade, done with XLA ops.
     q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
-        q, k, v)
-    return vjp(g)
+    return _backward_pallas(q, k, v, g, causal, interpret)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -107,8 +157,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     interpret: bool = False) -> jax.Array:
     """q, k, v: [batch, heads, seq, head_dim] -> same-shaped output.
 
-    Differentiable: forward runs the fused Pallas kernel, backward goes
-    through the einsum reference via custom_vjp (see _flash_bwd).
+    Differentiable end-to-end in Pallas: forward is the fused per-q-block
+    kernel, backward the fused recompute-p kernel (_attn_bwd_kernel) via
+    custom_vjp — no [s, s] tensor touches HBM in either direction.
     """
     return _flash_attention(q, k, v, causal, block_q, interpret)
 
