@@ -1,0 +1,52 @@
+"""End-to-end trainer CLI tests (subprocess, CPU platform): the job-side
+binary the JobSet example runs, incl. resume and the drain contract."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def run_train(tmp_path, *args, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_autoscaler.workloads.train",
+         "--platform", "cpu", "--d-model", "32", "--n-layers", "1",
+         "--seq-len", "16", "--batch", "4",
+         "--checkpoint-dir", str(tmp_path / "ckpt"), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+class TestTrainerCli:
+    def test_trains_and_checkpoints(self, tmp_path):
+        result = run_train(tmp_path, "--steps", "20",
+                           "--checkpoint-every", "10")
+        assert result.returncode == 0, result.stderr
+        assert "training complete at step 20" in result.stderr
+        assert (tmp_path / "ckpt" / "step_20").exists()
+
+    def test_resumes_from_checkpoint(self, tmp_path):
+        first = run_train(tmp_path, "--steps", "10",
+                          "--checkpoint-every", "10")
+        assert first.returncode == 0, first.stderr
+        second = run_train(tmp_path, "--steps", "20",
+                           "--checkpoint-every", "10")
+        assert second.returncode == 0, second.stderr
+        assert "resumed from checkpoint step 10" in second.stderr
+        assert (tmp_path / "ckpt" / "step_20").exists()
+
+    def test_drain_contract_checkpoints_and_exits(self, tmp_path):
+        annotations = tmp_path / "annotations"
+        annotations.write_text(
+            'autoscaler.tpu.dev/checkpoint-requested="1"\n')
+        result = run_train(tmp_path, "--steps", "5000",
+                           "--annotations-file", str(annotations))
+        assert result.returncode == 0, result.stderr
+        assert "drain requested" in result.stderr
+        # A checkpoint exists at whatever step it stopped at.
+        ckpts = list((tmp_path / "ckpt").glob("step_*"))
+        assert ckpts

@@ -1,0 +1,110 @@
+"""Runnable trainer for slices this autoscaler provisions.
+
+``python -m tpu_autoscaler.workloads.train`` is the TRAIN_IMAGE command in
+deploy/example-v5e-64-jobset.yaml: it bootstraps jax.distributed from the
+GKE TPU env (single-host: no-op), builds the (data, model) mesh over all
+chips, trains the flagship model on synthetic data, checkpoints
+periodically, resumes from the latest checkpoint after preemption, and
+honors the checkpoint-aware drain contract — when the autoscaler reclaims
+the slice, the DrainWatcher sees the pod annotation, a final checkpoint is
+saved, and the process exits 0 inside the drain window.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import click
+
+log = logging.getLogger(__name__)
+
+
+@click.command()
+@click.option("--steps", default=100, show_default=True)
+@click.option("--batch", default=8, show_default=True)
+@click.option("--seq-len", default=64, show_default=True)
+@click.option("--d-model", default=128, show_default=True)
+@click.option("--n-layers", default=2, show_default=True)
+@click.option("--checkpoint-dir", default="/tmp/tpu-train-ckpt",
+              show_default=True)
+@click.option("--checkpoint-every", default=50, show_default=True)
+@click.option("--annotations-file", default=None,
+              help="Downward-API annotations path (default: the standard "
+                   "/etc/podinfo/annotations).")
+@click.option("--platform", default=None,
+              help="Force a jax platform (e.g. cpu for local smoke runs).")
+def main(steps, batch, seq_len, d_model, n_layers, checkpoint_dir,
+         checkpoint_every, annotations_file, platform):
+    """Train the flagship model on this job's slice (synthetic data)."""
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(asctime)s %(levelname)s: %(message)s")
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+
+    from tpu_autoscaler.workloads.checkpoint import (
+        DEFAULT_ANNOTATIONS_PATH,
+        DrainWatcher,
+        latest_step,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from tpu_autoscaler.workloads.distributed import initialize_from_env
+    from tpu_autoscaler.workloads.model import (
+        ModelConfig,
+        make_mesh,
+        make_sharded_train_step,
+    )
+
+    topo = initialize_from_env()
+    log.info("topology: process %d/%d (slice %d/%d); devices: %d",
+             topo.process_id, topo.num_processes, topo.slice_id,
+             topo.num_slices, len(jax.devices()))
+
+    cfg = ModelConfig(seq_len=seq_len, d_model=d_model, n_layers=n_layers)
+    mesh = make_mesh()
+    init_fn, step_fn = make_sharded_train_step(mesh, cfg)
+    params, opt_state = init_fn(jax.random.PRNGKey(0))
+    log.info("mesh %s; params initialized", dict(mesh.shape))
+
+    start = latest_step(checkpoint_dir) or 0
+    if start:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            {"params": params, "opt": opt_state})
+        restored = restore_checkpoint(checkpoint_dir, start, abstract)
+        params, opt_state = restored["params"], restored["opt"]
+        log.info("resumed from checkpoint step %d", start)
+
+    watcher = DrainWatcher(annotations_file or DEFAULT_ANNOTATIONS_PATH)
+
+    def batch_for(step):
+        return jax.random.randint(jax.random.PRNGKey(step),
+                                  (batch, cfg.seq_len + 1), 0, cfg.vocab,
+                                  dtype=jnp.int32)
+
+    step = start
+    while step < steps:
+        if watcher.drain_requested():
+            save_checkpoint(checkpoint_dir, step,
+                            {"params": params, "opt": opt_state})
+            log.info("drain requested: checkpointed at step %d, exiting "
+                     "cleanly", step)
+            return
+        params, opt_state, loss = step_fn(params, opt_state,
+                                          batch_for(step))
+        step += 1
+        if step % checkpoint_every == 0 or step == steps:
+            save_checkpoint(checkpoint_dir, step,
+                            {"params": params, "opt": opt_state})
+            log.info("step %d loss %.4f (checkpointed)", step, float(loss))
+        elif step % 10 == 0:
+            log.info("step %d loss %.4f", step, float(loss))
+    log.info("training complete at step %d", step)
+
+
+if __name__ == "__main__":
+    main()
