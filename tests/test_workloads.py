@@ -182,3 +182,11 @@ class TestCheckpointRoundtrip:
             make_batch=lambda i: i)
         assert not drained and steps == 4
         assert latest_step(str(tmp_path)) == 4
+
+
+class TestLatestStepRobustness:
+    def test_tolerates_orbax_tmp_dirs(self, tmp_path):
+        (tmp_path / "step_50").mkdir()
+        (tmp_path / "step_60.orbax-checkpoint-tmp-1234").mkdir()
+        (tmp_path / "garbage").mkdir()
+        assert latest_step(str(tmp_path)) == 50

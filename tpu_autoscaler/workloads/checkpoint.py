@@ -104,25 +104,41 @@ def restore_checkpoint(directory: str, step: int, abstract_state):
 
 
 def latest_step(directory: str) -> int | None:
+    """Largest completed step in the checkpoint dir.
+
+    Tolerates orbax atomic-save leftovers (``step_N.orbax-checkpoint-
+    tmp-<ts>`` from a save interrupted by preemption — exactly the
+    scenario this module exists for) and any other non-numeric entries.
+    """
     try:
-        steps = [int(name[len("step_"):])
-                 for name in os.listdir(directory)
-                 if name.startswith("step_")]
+        names = os.listdir(directory)
     except OSError:
         return None
+    steps = []
+    for name in names:
+        if not name.startswith("step_"):
+            continue
+        suffix = name[len("step_"):]
+        if suffix.isdigit():
+            steps.append(int(suffix))
     return max(steps) if steps else None
 
 
 def train_until_drained(step_fn: Callable, state, num_steps: int,
                         watcher: DrainWatcher, checkpoint_dir: str,
                         make_batch: Callable[[int], object],
-                        start_step: int = 0) -> tuple[object, int, bool]:
+                        start_step: int = 0,
+                        checkpoint_every: int | None = None,
+                        on_step: Callable[[int, object], None]
+                        | None = None) -> tuple[object, int, bool]:
     """Training loop honoring the drain contract.
 
     Returns ``(state, steps_done, drained)``; saves a checkpoint and stops
-    early when the watcher fires.  The loop structure (poll between steps,
-    save, exit cleanly) is the reference pattern for any job running under
-    this autoscaler on spot/preemptible slices.
+    early when the watcher fires, and every ``checkpoint_every`` steps when
+    set.  ``on_step(step, state)`` is a logging/metrics hook.  The loop
+    (poll between steps, save, exit cleanly) is THE drain-contract loop —
+    tpu_autoscaler.workloads.train drives this same function, so fixes to
+    the semantics land everywhere at once.
     """
     step = start_step
     while step < num_steps:
@@ -131,5 +147,10 @@ def train_until_drained(step_fn: Callable, state, num_steps: int,
             return state, step, True
         state = step_fn(state, make_batch(step))
         step += 1
+        if checkpoint_every and step % checkpoint_every == 0 \
+                and step != num_steps:
+            save_checkpoint(checkpoint_dir, step, state)
+        if on_step is not None:
+            on_step(step, state)
     save_checkpoint(checkpoint_dir, step, state)
     return state, step, False

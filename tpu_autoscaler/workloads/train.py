@@ -43,18 +43,23 @@ def main(steps, batch, seq_len, d_model, n_layers, checkpoint_dir,
 
     if platform:
         jax.config.update("jax_platforms", platform)
-    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
 
     from tpu_autoscaler.workloads.checkpoint import (
         DEFAULT_ANNOTATIONS_PATH,
         DrainWatcher,
         latest_step,
         restore_checkpoint,
-        save_checkpoint,
+        train_until_drained,
     )
-    from tpu_autoscaler.workloads.distributed import initialize_from_env
+    from tpu_autoscaler.workloads.distributed import (
+        initialize_from_env,
+        make_multislice_mesh,
+    )
     from tpu_autoscaler.workloads.model import (
         ModelConfig,
+        batch_spec,
         make_mesh,
         make_sharded_train_step,
     )
@@ -65,45 +70,64 @@ def main(steps, batch, seq_len, d_model, n_layers, checkpoint_dir,
              topo.num_slices, len(jax.devices()))
 
     cfg = ModelConfig(seq_len=seq_len, d_model=d_model, n_layers=n_layers)
-    mesh = make_mesh()
-    init_fn, step_fn = make_sharded_train_step(mesh, cfg)
+    # Multi-slice jobs get the (dcn, data, model) mesh: DP crosses slices
+    # over DCN, TP stays inside each slice's ICI domain.
+    mesh = (make_multislice_mesh(topo.num_slices) if topo.num_slices > 1
+            else make_mesh())
+    init_fn, raw_step_fn = make_sharded_train_step(mesh, cfg)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
     log.info("mesh %s; params initialized", dict(mesh.shape))
 
     start = latest_step(checkpoint_dir) or 0
+    state = {"params": params, "opt": opt_state}
     if start:
+        # Restore WITH the live shardings: the replacement slice's device
+        # layout wins over whatever topology the checkpoint was saved on.
         abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-            {"params": params, "opt": opt_state})
-        restored = restore_checkpoint(checkpoint_dir, start, abstract)
-        params, opt_state = restored["params"], restored["opt"]
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
+            state)
+        state = restore_checkpoint(checkpoint_dir, start, abstract)
         log.info("resumed from checkpoint step %d", start)
 
     watcher = DrainWatcher(annotations_file or DEFAULT_ANNOTATIONS_PATH)
+    b_sharding = NamedSharding(mesh, batch_spec(mesh))
+    n_proc = max(1, topo.num_processes)
+    local_batch = max(1, batch // n_proc)
 
     def batch_for(step):
-        return jax.random.randint(jax.random.PRNGKey(step),
-                                  (batch, cfg.seq_len + 1), 0, cfg.vocab,
-                                  dtype=jnp.int32)
+        # Synthetic data generated per process (numpy, host-local), then
+        # assembled into one global array over the mesh — jit cannot
+        # reshard a single-device array onto non-addressable devices in
+        # multi-process JAX.
+        rng = np.random.default_rng((step << 16) | topo.process_id)
+        local = rng.integers(0, cfg.vocab,
+                             (local_batch, cfg.seq_len + 1),
+                             dtype=np.int32)
+        return jax.make_array_from_process_local_data(b_sharding, local)
 
-    step = start
-    while step < steps:
-        if watcher.drain_requested():
-            save_checkpoint(checkpoint_dir, step,
-                            {"params": params, "opt": opt_state})
-            log.info("drain requested: checkpointed at step %d, exiting "
-                     "cleanly", step)
-            return
-        params, opt_state, loss = step_fn(params, opt_state,
-                                          batch_for(step))
-        step += 1
-        if step % checkpoint_every == 0 or step == steps:
-            save_checkpoint(checkpoint_dir, step,
-                            {"params": params, "opt": opt_state})
-            log.info("step %d loss %.4f (checkpointed)", step, float(loss))
-        elif step % 10 == 0:
-            log.info("step %d loss %.4f", step, float(loss))
-    log.info("training complete at step %d", step)
+    last_loss = [float("nan")]
+
+    def step_fn(state, tokens):
+        params, opt_state, loss = raw_step_fn(state["params"],
+                                              state["opt"], tokens)
+        last_loss[0] = float(loss)
+        return {"params": params, "opt": opt_state}
+
+    def on_step(step, _state):
+        if step % 10 == 0:
+            log.info("step %d loss %.4f", step, last_loss[0])
+
+    state, step, drained = train_until_drained(
+        step_fn, state, num_steps=steps, watcher=watcher,
+        checkpoint_dir=checkpoint_dir, make_batch=batch_for,
+        start_step=start, checkpoint_every=checkpoint_every,
+        on_step=on_step)
+    if drained:
+        log.info("drain requested: checkpointed at step %d, exiting "
+                 "cleanly", step)
+    else:
+        log.info("training complete at step %d", step)
 
 
 if __name__ == "__main__":
