@@ -1,0 +1,101 @@
+"""GCP token-provider tests (actuators/gcp.py) — env token lifecycle,
+metadata fallback, stale-token handling (reviewed failure modes)."""
+
+import pytest
+
+from tpu_autoscaler.actuators.gcp import GcpAuthError, TokenProvider
+
+
+class TestTokenProvider:
+    def test_env_token_used(self, monkeypatch):
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
+        tp = TokenProvider()
+        assert tp.token() == "tok-1"
+
+    def test_refreshed_env_token_adopted(self, monkeypatch):
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
+        tp = TokenProvider()
+        assert tp.token() == "tok-1"
+        # Operator rotates the env value; after expiry the new one wins.
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-2")
+        tp._expires_at = 0.0  # force expiry
+        assert tp.token() == "tok-2"
+
+    def test_stale_env_token_falls_through_to_metadata(self, monkeypatch):
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
+        tp = TokenProvider()
+        tp.token()
+        tp._expires_at = 0.0
+        # Same env value (not rotated): metadata server is consulted.
+        calls = {}
+
+        class FakeResp:
+            def raise_for_status(self):
+                pass
+
+            def json(self):
+                return {"access_token": "md-token", "expires_in": 600}
+
+        def fake_get(url, headers=None, timeout=None):
+            calls["url"] = url
+            assert headers == {"Metadata-Flavor": "Google"}
+            return FakeResp()
+
+        import requests
+
+        monkeypatch.setattr(requests, "get", fake_get)
+        assert tp.token() == "md-token"
+        assert "metadata.google.internal" in calls["url"]
+
+    def test_stale_env_token_kept_when_no_metadata(self, monkeypatch):
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
+        tp = TokenProvider()
+        tp.token()
+        tp._expires_at = 0.0
+        import requests
+
+        def boom(*a, **k):
+            raise ConnectionError("no metadata server")
+
+        monkeypatch.setattr(requests, "get", boom)
+        # Possibly long-lived operator token: keep using it (warned).
+        assert tp.token() == "tok-1"
+
+    def test_no_credentials_raises(self, monkeypatch):
+        monkeypatch.delenv("GCP_ACCESS_TOKEN", raising=False)
+        import requests
+
+        def boom(*a, **k):
+            raise ConnectionError("no metadata server")
+
+        monkeypatch.setattr(requests, "get", boom)
+        with pytest.raises(GcpAuthError, match="no GCP credentials"):
+            TokenProvider().token()
+
+
+class TestScorerCrossConsistency:
+    """jaxfit (XLA) and fitpack (C++) must agree on the chip axes they
+    both model."""
+
+    def test_native_and_jaxfit_agree(self):
+        pytest.importorskip("jax")
+        from tpu_autoscaler import native
+        from tpu_autoscaler.engine.jaxfit import best_shapes, catalog_arrays
+
+        if not native.available():
+            pytest.skip("no native toolchain")
+        import numpy as np
+
+        demands = [(8, 8, 1), (64, 4, 16), (15, 3, 5), (24, 8, 3),
+                   (256, 4, 64), (100000, 4, 25000)]
+        names, chips, cph, hosts = catalog_arrays("v5e")
+        jx = best_shapes(np.array(demands, np.float32), generation="v5e")
+        nat = native.best_shapes(
+            [(float(a), float(b), float(c)) for a, b, c in demands],
+            list(zip(chips.tolist(), cph.tolist(), hosts.tolist())))
+        for (jname, jcost), (nidx, ncost) in zip(jx, nat):
+            if jname is None:
+                assert nidx == -1
+            else:
+                assert names[nidx] == jname
+                assert ncost == jcost

@@ -190,3 +190,42 @@ class TestUnhealthySliceReplacement:
         assert second_nodes.isdisjoint(first_nodes)  # replacement slice
         snap = controller.metrics.snapshot()
         assert snap["counters"]["unhealthy_units_replaced"] == 1
+
+
+class TestImpendingTermination:
+    """GKE maintenance/spot termination taints put the whole unit into
+    the checkpoint-aware drain path before the hard kill lands."""
+
+    def test_termination_taint_triggers_checkpoint_drain(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-16")
+        names = []
+        for p in make_gang(shape, job="train"):
+            kube.add_pod(p)
+            names.append(p["metadata"]["name"])
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, n) for n in names))
+        # Maintenance notice lands on ONE host of the slice.
+        victim = kube.list_nodes()[0]
+        victim["spec"]["taints"].append(
+            {"key": "cloud.google.com/impending-node-termination",
+             "effect": "NoSchedule"})
+        controller.reconcile_once(now=50.0)
+        # Whole slice cordoned; every workload pod got the checkpoint ask.
+        assert all(n["spec"].get("unschedulable")
+                   for n in kube.list_nodes())
+        for n in names:
+            pod = kube.get_pod("default", n)
+            assert CHECKPOINT_ANNOTATION in pod["metadata"]["annotations"]
+        # Jobs checkpoint and exit; the slice is reclaimed whole.
+        for n in names:
+            kube.delete_pod("default", n)
+        controller.reconcile_once(now=55.0)
+        assert kube.list_nodes() == []
+        # Re-created pods get a fresh slice.
+        for p in make_gang(shape, job="train"):
+            kube.add_pod(p)
+        run_loop(kube, controller, start=60.0, until=200.0,
+                 stop_when=lambda: all(pod_running(kube, n)
+                                       for n in names))
+        assert all(pod_running(kube, n) for n in names)

@@ -48,6 +48,16 @@ CHECKPOINT_ANNOTATION = "autoscaler.tpu.dev/checkpoint-requested"
 # clamp exceeded), with the human-readable reason.
 UNSATISFIABLE_ANNOTATION = "autoscaler.tpu.dev/unsatisfiable"
 
+# Node taints GKE applies ahead of involuntary termination (TPU
+# maintenance events, spot/preemptible reclamation).  Any host of a unit
+# carrying one of these puts the WHOLE unit into the checkpoint-aware
+# drain path — the hardware is going away regardless; the job gets the
+# drain window instead of a hard kill.
+TERMINATION_TAINT_KEYS = frozenset({
+    "cloud.google.com/impending-node-termination",
+    "DeletionCandidateOfClusterAutoscaler",
+})
+
 
 @dataclasses.dataclass
 class ControllerConfig:
@@ -514,12 +524,17 @@ class Controller:
                 utilization_threshold=cfg.utilization_threshold)
             state_counts[state.value] = state_counts.get(state.value, 0) + 1
 
+            doomed = any(t.get("key") in TERMINATION_TAINT_KEYS
+                         for n in unit_nodes for t in n.taints)
             try:
                 if (state in (SliceState.BUSY, SliceState.IDLE,
                               SliceState.LAUNCH_GRACE, SliceState.SPARE)
-                        and unit_id in self._requested_drains):
-                    self._begin_drain(unit_id, unit_nodes, unit_pods, now,
-                                      reason="drain requested")
+                        and (unit_id in self._requested_drains or doomed)):
+                    self._begin_drain(
+                        unit_id, unit_nodes, unit_pods, now,
+                        reason=("impending node termination" if doomed
+                                and unit_id not in self._requested_drains
+                                else "drain requested"))
                 elif state is SliceState.IDLE_DRAINABLE:
                     if unit_id in claimed_ids:
                         # Pending demand will bind here: hands off
