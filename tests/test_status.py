@@ -1,0 +1,93 @@
+"""Status rendering tests (controller/status.py + CLI wiring)."""
+
+from tpu_autoscaler.controller.status import render_status
+from tpu_autoscaler.topology import shape_by_name
+
+from tests.fixtures import (
+    make_node,
+    make_pod,
+    make_slice_nodes,
+    make_tpu_pod,
+)
+
+
+class TestRenderStatus:
+    def test_empty_cluster(self):
+        out = render_status([], [])
+        assert "SUPPLY UNITS" in out and "(none)" in out
+        assert "PENDING GANGS" in out
+
+    def test_units_with_readiness_and_load(self):
+        shape = shape_by_name("v5e-16")
+        nodes = make_slice_nodes(shape, "s1")
+        nodes[2]["status"]["conditions"] = [
+            {"type": "Ready", "status": "False"}]
+        nodes += [make_node(name="cpu-1", slice_id="cpu-1")]
+        pods = [make_tpu_pod(name="w", chips=4, phase="Running",
+                             node_name=nodes[0]["metadata"]["name"],
+                             unschedulable=False, job="j")]
+        out = render_status(nodes, pods)
+        assert "s1: tpu tpu-v5-lite-podslice/4x4, hosts=4, chips=16" in out
+        assert "workload_pods=1" in out
+        assert "READY 3/4" in out
+        assert "cpu-1: cpu e2-standard-8" in out
+
+    def test_pending_gang_verdicts(self):
+        shape = shape_by_name("v5e-64")
+        from tests.fixtures import make_gang
+
+        pods = make_gang(shape, job="ok-gang")
+        pods.append(make_tpu_pod(name="doomed", chips=4096, job="doomed"))
+        pods.append(make_pod(name="webby", requests={"cpu": "2"}))
+        out = render_status([], pods)
+        assert "ok-gang: 16 pods, 64 chips -> v5e-64 (0 stranded)" in out
+        assert "doomed" in out and "UNSATISFIABLE" in out
+        assert "webby: 1 pods, cpu=2" in out
+
+    def test_cordoned_flag(self):
+        shape = shape_by_name("v5e-8")
+        nodes = make_slice_nodes(shape, "s1", unschedulable=True)
+        out = render_status(nodes, [])
+        assert "CORDONED 1" in out
+
+
+class TestStatusCli:
+    def test_status_against_stub_apiserver(self, tmp_path):
+        import http.server
+        import json
+        import threading
+
+        from click.testing import CliRunner
+
+        from tpu_autoscaler.main import cli
+
+        shape = shape_by_name("v5e-8")
+        nodes = {"items": make_slice_nodes(shape, "sX")}
+        pods = {"items": [make_tpu_pod(name="waiting", chips=8, job="w")]}
+
+        class Stub(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps(
+                    nodes if "nodes" in self.path else pods).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            result = CliRunner().invoke(cli, [
+                "status", "--kube-url",
+                f"http://127.0.0.1:{srv.server_address[1]}"])
+            assert result.exit_code == 0, result.output
+            assert "sX: tpu" in result.output
+            # Gang identity is the Job label, and the free slice satisfies
+            # it: 0 stranded.
+            assert "w: 1 pods, 8 chips -> v5e-8 (0 stranded)" in \
+                result.output
+        finally:
+            srv.shutdown()

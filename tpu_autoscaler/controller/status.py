@@ -1,0 +1,78 @@
+"""Stateless cluster status rendering (`tpu-autoscaler status`).
+
+A read-only snapshot an operator can take against any cluster: supply
+units (slices / CPU nodes) with readiness and load, and pending gangs
+with the fit engine's verdict — the same math the controller runs, with
+no timers and no writes.
+"""
+
+from __future__ import annotations
+
+from tpu_autoscaler.engine.fitter import FitError, choose_shape_for_gang
+from tpu_autoscaler.k8s.gangs import group_into_gangs
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.topology.catalog import SLICE_ID_LABEL, TPU_RESOURCE
+
+
+def _units(nodes: list[Node]) -> dict[str, list[Node]]:
+    units: dict[str, list[Node]] = {}
+    for node in nodes:
+        if node.is_tpu and node.slice_id:
+            units.setdefault(node.slice_id, []).append(node)
+        else:
+            units.setdefault(node.labels.get(SLICE_ID_LABEL) or node.name,
+                             []).append(node)
+    return units
+
+
+def render_status(node_payloads: list[dict], pod_payloads: list[dict],
+                  default_generation: str = "v5e") -> str:
+    nodes = [Node(p) for p in node_payloads]
+    pods = [Pod(p) for p in pod_payloads]
+    pods_by_node: dict[str, int] = {}
+    for p in pods:
+        if p.node_name and p.phase in {"Pending", "Running"} \
+                and not p.is_daemonset and not p.is_mirrored:
+            pods_by_node[p.node_name] = pods_by_node.get(p.node_name, 0) + 1
+
+    lines = ["SUPPLY UNITS"]
+    units = _units(nodes)
+    if not units:
+        lines.append("  (none)")
+    for unit_id, members in sorted(units.items()):
+        ready = sum(1 for n in members if n.is_ready)
+        cordoned = sum(1 for n in members if n.unschedulable)
+        chips = sum(int(n.allocatable.get(TPU_RESOURCE)) for n in members)
+        workload = sum(pods_by_node.get(n.name, 0) for n in members)
+        kind = (f"tpu {members[0].tpu_accelerator}"
+                f"/{members[0].tpu_topology}" if members[0].is_tpu
+                else f"cpu {members[0].instance_type}")
+        flags = []
+        if ready < len(members):
+            flags.append(f"READY {ready}/{len(members)}")
+        if cordoned:
+            flags.append(f"CORDONED {cordoned}")
+        lines.append(
+            f"  {unit_id}: {kind}, hosts={len(members)}, chips={chips}, "
+            f"workload_pods={workload}"
+            + (f" [{' '.join(flags)}]" if flags else ""))
+
+    lines.append("PENDING GANGS")
+    pending = [p for p in pods if p.is_unschedulable]
+    gangs = group_into_gangs(pending)
+    if not gangs:
+        lines.append("  (none)")
+    for gang in gangs:
+        if gang.requests_tpu:
+            try:
+                choice = choose_shape_for_gang(gang, default_generation)
+                verdict = (f"-> {choice.shape.name} "
+                           f"({choice.stranded_chips} stranded)")
+            except FitError as e:
+                verdict = f"UNSATISFIABLE: {e}"
+            lines.append(f"  {gang.name}: {gang.size} pods, "
+                         f"{gang.tpu_chips} chips {verdict}")
+        else:
+            cpu = gang.total_resources.get("cpu")
+            lines.append(f"  {gang.name}: {gang.size} pods, cpu={cpu:g}")
+    return "\n".join(lines)

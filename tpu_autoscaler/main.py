@@ -234,6 +234,26 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
 
 
 @cli.command()
+@click.option("--kube-url", default=None)
+@click.option("--kube-token", default=None)
+@click.option("--kubeconfig", default=None)
+@click.option("--kube-context", default=None)
+@click.option("--default-generation", default="v5e", show_default=True)
+def status(kube_url, kube_token, kubeconfig, kube_context,
+           default_generation):
+    """Read-only snapshot: supply units + pending gangs with fit verdicts."""
+    from tpu_autoscaler.controller.status import render_status
+    from tpu_autoscaler.k8s.client import RestKubeClient
+
+    if kubeconfig:
+        kube = RestKubeClient.from_kubeconfig(kubeconfig, kube_context)
+    else:
+        kube = RestKubeClient(base_url=kube_url, token=kube_token)
+    click.echo(render_status(kube.list_nodes(), kube.list_pods(),
+                             default_generation))
+
+
+@cli.command()
 @common_options
 @click.option("--scenario", default="v5e-8", show_default=True,
               type=click.Choice(["cpu", "v5e-8", "v5e-64", "2xv5p-128",

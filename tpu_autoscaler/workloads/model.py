@@ -19,6 +19,7 @@ this module is how the repo proves a provisioned slice actually trains.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -41,6 +42,11 @@ class ModelConfig:
     # VMEM-resident kernel, workloads/attention.py — single-device or
     # shard_map use; XLA cannot auto-partition a custom kernel).
     attention: str = "einsum"
+    # Rematerialize block activations on the backward pass
+    # (jax.checkpoint): trades ~1 extra forward of FLOPs per block for
+    # O(layers) less activation HBM — the standard long-context /
+    # large-batch memory lever on TPU.
+    remat: bool = False
 
     def __post_init__(self) -> None:
         if self.attention not in {"einsum", "pallas"}:
@@ -120,8 +126,12 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
     """tokens [batch, seq] int32 -> logits [batch, seq, vocab] fp32."""
     x = params["embed"].astype(cfg.dtype)[tokens]
 
+    block = functools.partial(_block, cfg=cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
     def body(x, layer):
-        return _block(x, layer, cfg), None
+        return block(x, layer), None
 
     x, _ = jax.lax.scan(body, x, params["blocks"])
     x = _rmsnorm(x, params["ln_f"])
