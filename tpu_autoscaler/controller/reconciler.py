@@ -401,24 +401,10 @@ class Controller:
         self._requested_drains.add(unit_id)
 
     def _units(self, nodes: list[Node]) -> dict[str, list[Node]]:
-        """Group nodes into supply units: slices, or single CPU nodes.
+        """Group nodes into supply units (shared rule: k8s/units.py)."""
+        from tpu_autoscaler.k8s.units import group_supply_units
 
-        TPU hosts group by slice id (all hosts of one slice are one atomic
-        unit).  CPU nodes are each their own unit, keyed by our explicit
-        slice label if present else the node name — deliberately NOT the
-        GKE nodepool label, which would collapse a whole CPU pool into one
-        drain/delete unit.
-        """
-        from tpu_autoscaler.topology.catalog import SLICE_ID_LABEL
-
-        units: dict[str, list[Node]] = {}
-        for node in nodes:
-            if node.is_tpu and node.slice_id:
-                units.setdefault(node.slice_id, []).append(node)
-            else:
-                unit_id = node.labels.get(SLICE_ID_LABEL) or node.name
-                units.setdefault(unit_id, []).append(node)
-        return units
+        return group_supply_units(nodes)
 
     def _spare_units(self, units: dict[str, list[Node]],
                      pods_by_node: dict[str, list[Pod]]) -> set[str]:

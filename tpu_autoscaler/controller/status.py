@@ -11,18 +11,8 @@ from __future__ import annotations
 from tpu_autoscaler.engine.fitter import FitError, choose_shape_for_gang
 from tpu_autoscaler.k8s.gangs import group_into_gangs
 from tpu_autoscaler.k8s.objects import Node, Pod
-from tpu_autoscaler.topology.catalog import SLICE_ID_LABEL, TPU_RESOURCE
-
-
-def _units(nodes: list[Node]) -> dict[str, list[Node]]:
-    units: dict[str, list[Node]] = {}
-    for node in nodes:
-        if node.is_tpu and node.slice_id:
-            units.setdefault(node.slice_id, []).append(node)
-        else:
-            units.setdefault(node.labels.get(SLICE_ID_LABEL) or node.name,
-                             []).append(node)
-    return units
+from tpu_autoscaler.k8s.units import group_supply_units
+from tpu_autoscaler.topology.catalog import TPU_RESOURCE
 
 
 def render_status(node_payloads: list[dict], pod_payloads: list[dict],
@@ -36,7 +26,7 @@ def render_status(node_payloads: list[dict], pod_payloads: list[dict],
             pods_by_node[p.node_name] = pods_by_node.get(p.node_name, 0) + 1
 
     lines = ["SUPPLY UNITS"]
-    units = _units(nodes)
+    units = group_supply_units(nodes)
     if not units:
         lines.append("  (none)")
     for unit_id, members in sorted(units.items()):

@@ -1,0 +1,23 @@
+"""Supply-unit grouping, shared by the reconciler and the status command.
+
+TPU hosts group by slice id (all hosts of one slice are one atomic unit).
+CPU nodes are each their own unit, keyed by our explicit slice label if
+present else the node name — deliberately NOT the GKE nodepool label,
+which would collapse a whole CPU pool into one drain/delete unit.
+"""
+
+from __future__ import annotations
+
+from tpu_autoscaler.k8s.objects import Node
+from tpu_autoscaler.topology.catalog import SLICE_ID_LABEL
+
+
+def group_supply_units(nodes: list[Node]) -> dict[str, list[Node]]:
+    units: dict[str, list[Node]] = {}
+    for node in nodes:
+        if node.is_tpu and node.slice_id:
+            units.setdefault(node.slice_id, []).append(node)
+        else:
+            units.setdefault(node.labels.get(SLICE_ID_LABEL) or node.name,
+                             []).append(node)
+    return units

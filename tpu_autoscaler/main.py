@@ -175,6 +175,36 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
     return Controller(kube, actuator, config, notifier, metrics)
 
 
+_kube_options = [
+    click.option("--kube-url", default=None,
+                 help="Apiserver URL (default: in-cluster)."),
+    click.option("--kube-token", default=None),
+    click.option("--kubeconfig", default=None,
+                 help="Path to a kubeconfig file (reference: --kubeconfig)."),
+    click.option("--kube-context", default=None,
+                 help="kubeconfig context name (default: current-context)."),
+]
+
+
+def kube_options(f):
+    for opt in reversed(_kube_options):
+        f = opt(f)
+    return f
+
+
+def make_kube_client(kube_url, kube_token, kubeconfig, kube_context,
+                     dry_run=False):
+    """One connection path for every subcommand: kubeconfig > explicit
+    URL/token > in-cluster."""
+    from tpu_autoscaler.k8s.client import RestKubeClient
+
+    if kubeconfig:
+        return RestKubeClient.from_kubeconfig(kubeconfig, kube_context,
+                                              dry_run=dry_run)
+    return RestKubeClient(base_url=kube_url, token=kube_token,
+                          dry_run=dry_run)
+
+
 @click.group()
 def cli():
     """TPU-native Kubernetes cluster autoscaler."""
@@ -182,13 +212,7 @@ def cli():
 
 @cli.command()
 @common_options
-@click.option("--kube-url", default=None,
-              help="Apiserver URL (default: in-cluster).")
-@click.option("--kube-token", default=None)
-@click.option("--kubeconfig", default=None,
-              help="Path to a kubeconfig file (reference: --kubeconfig).")
-@click.option("--kube-context", default=None,
-              help="kubeconfig context name (default: current-context).")
+@kube_options
 @click.option("--actuator", "actuator_kind", default="gke",
               type=click.Choice(["gke", "queued-resources"]),
               show_default=True)
@@ -204,14 +228,8 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
         project, location, cluster, dry_run, leader_elect, sleep, **kw):
     """Run against a real cluster (in-cluster, --kubeconfig, or
     --kube-url)."""
-    from tpu_autoscaler.k8s.client import RestKubeClient
-
-    if kubeconfig:
-        kube = RestKubeClient.from_kubeconfig(kubeconfig, kube_context,
-                                              dry_run=dry_run)
-    else:
-        kube = RestKubeClient(base_url=kube_url, token=kube_token,
-                              dry_run=dry_run)
+    kube = make_kube_client(kube_url, kube_token, kubeconfig, kube_context,
+                            dry_run=dry_run)
     if actuator_kind == "gke":
         from tpu_autoscaler.actuators.gke import GkeNodePoolActuator
 
@@ -234,21 +252,14 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
 
 
 @cli.command()
-@click.option("--kube-url", default=None)
-@click.option("--kube-token", default=None)
-@click.option("--kubeconfig", default=None)
-@click.option("--kube-context", default=None)
+@kube_options
 @click.option("--default-generation", default="v5e", show_default=True)
 def status(kube_url, kube_token, kubeconfig, kube_context,
            default_generation):
     """Read-only snapshot: supply units + pending gangs with fit verdicts."""
     from tpu_autoscaler.controller.status import render_status
-    from tpu_autoscaler.k8s.client import RestKubeClient
 
-    if kubeconfig:
-        kube = RestKubeClient.from_kubeconfig(kubeconfig, kube_context)
-    else:
-        kube = RestKubeClient(base_url=kube_url, token=kube_token)
+    kube = make_kube_client(kube_url, kube_token, kubeconfig, kube_context)
     click.echo(render_status(kube.list_nodes(), kube.list_pods(),
                              default_generation))
 
