@@ -229,3 +229,83 @@ class TestImpendingTermination:
                  stop_when=lambda: all(pod_running(kube, n)
                                        for n in names))
         assert all(pod_running(kube, n) for n in names)
+
+
+class TestPriorityPreemption:
+    """Checkpoint-aware preemption: a clamp-blocked higher-priority gang
+    reclaims chips from a lower-priority job, which gets the drain
+    window and re-queues."""
+
+    def harness(self):
+        kube = FakeKube()
+        actuator = FakeActuator(kube)
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0, max_total_chips=8),
+            grace_seconds=30.0, idle_threshold_seconds=IDLE,
+            drain_grace_seconds=20.0, enable_preemption=True))
+        return kube, actuator, controller
+
+    def test_preemption_flow(self):
+        kube, actuator, controller = self.harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="low", chips=8, shape=shape,
+                                  job="low-job"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "low"))
+        # High-priority gang arrives; the 8-chip clamp blocks it.
+        high = make_tpu_pod(name="high", chips=8, shape=shape,
+                            job="high-job")
+        high["spec"]["priority"] = 1000
+        kube.add_pod(high)
+        controller.reconcile_once(now=10.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["preemptions"] == 1
+        # Victim got the checkpoint ask on the next pass (drain begins).
+        controller.reconcile_once(now=12.0)
+        pod = kube.get_pod("default", "low")
+        assert CHECKPOINT_ANNOTATION in pod["metadata"]["annotations"]
+        # Victim checkpoints + exits; Job recreates it (still low pri).
+        kube.delete_pod("default", "low")
+        t = 14.0
+        run_loop(kube, controller, start=t, until=t + 200.0,
+                 stop_when=lambda: pod_running(kube, "high"))
+        assert pod_running(kube, "high")
+        # Re-queued low-priority job stays pending behind the clamp.
+        kube.add_pod(make_tpu_pod(name="low-2", chips=8, shape=shape,
+                                  job="low-job"))
+        run_loop(kube, controller, start=t + 210.0, until=t + 260.0,
+                 step=5.0)
+        assert not pod_running(kube, "low-2")
+        assert pod_running(kube, "high")  # never preempted by equal/lower
+
+    def test_no_preemption_for_equal_priority(self):
+        kube, actuator, controller = self.harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="first", chips=8, shape=shape,
+                                  job="first-job"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "first"))
+        kube.add_pod(make_tpu_pod(name="second", chips=8, shape=shape,
+                                  job="second-job"))
+        run_loop(kube, controller, start=10.0, until=60.0, step=5.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("preemptions", 0) == 0
+        assert pod_running(kube, "first")
+
+    def test_disabled_by_default(self):
+        kube = FakeKube()
+        controller = Controller(kube, FakeActuator(kube), ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0, max_total_chips=8)))
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="low", chips=8, shape=shape,
+                                  job="low-job"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "low"))
+        high = make_tpu_pod(name="high", chips=8, shape=shape,
+                            job="high-job")
+        high["spec"]["priority"] = 1000
+        kube.add_pod(high)
+        run_loop(kube, controller, start=10.0, until=60.0, step=5.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("preemptions", 0) == 0
+        assert pod_running(kube, "low")

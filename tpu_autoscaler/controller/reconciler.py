@@ -90,6 +90,12 @@ class ControllerConfig:
     # whose pods materialize gradually isn't fitted against a partial
     # observation (pinned gangs are exact regardless and never wait).
     gang_settle_seconds: float = 0.0
+    # Checkpoint-aware priority preemption: a gang unsatisfiable ONLY
+    # because of max_total_chips may reclaim chips from busy units whose
+    # workload has strictly lower priority — those jobs get the drain
+    # window (checkpoint + clean exit) and re-queue behind the clamp.
+    # Off by default: preemption moves victims' work.
+    enable_preemption: bool = False
     # Reference parity flags (main.py --no-scale / --no-maintenance).
     no_scale: bool = False
     no_maintenance: bool = False
@@ -283,6 +289,8 @@ class Controller:
                         pod, now, "TriggeredScaleUp",
                         f"provisioning {req.shape_name} for this job "
                         f"({req.reason})")
+        if self.config.enable_preemption:
+            self._consider_preemption(plan, nodes, pods, now)
         for gang, reason in plan.unsatisfiable:
             if gang.key not in self._reported_unsatisfiable:
                 self._reported_unsatisfiable.add(gang.key)
@@ -302,6 +310,74 @@ class Controller:
                     except Exception:  # noqa: BLE001 — advisory only
                         log.debug("could not annotate %s", pod.name,
                                   exc_info=True)
+
+    def _consider_preemption(self, plan, nodes: list[Node],
+                             pods: list[Pod], now: float) -> None:
+        """Reclaim chips from lower-priority busy units for clamp-blocked
+        higher-priority gangs.  Victims go through the normal
+        checkpoint-aware drain; the freed budget lets the planner
+        provision for the preemptor on a later pass.
+        """
+        from tpu_autoscaler.k8s.units import group_supply_units
+        from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+        blocked = [(g, r) for g, r in plan.unsatisfiable
+                   if "max_total_chips" in r]
+        if not blocked:
+            return
+        pods_by_node: dict[str, list[Pod]] = {}
+        for p in pods:
+            if p.node_name and p.phase in {"Pending", "Running"}:
+                pods_by_node.setdefault(p.node_name, []).append(p)
+        units = group_supply_units(nodes)
+
+        def unit_workload(unit_nodes):
+            return [p for n in unit_nodes
+                    for p in pods_by_node.get(n.name, [])
+                    if not p.is_daemonset and not p.is_mirrored]
+
+        for gang, _reason in blocked:
+            if now < self._retry_at.get(("preempt", gang.key), 0.0):
+                continue
+            # Victim candidates: busy TPU units, strictly lower priority,
+            # not already draining.
+            candidates = []
+            for unit_id, unit_nodes in units.items():
+                if not unit_nodes[0].is_tpu:
+                    continue
+                if unit_id in self._drain_started \
+                        or unit_id in self._requested_drains:
+                    continue
+                workload = unit_workload(unit_nodes)
+                if not workload:
+                    continue  # idle units free up via normal reclaim
+                unit_prio = max(p.priority for p in workload)
+                if unit_prio >= gang.priority:
+                    continue
+                chips = sum(int(n.allocatable.get(TPU_RESOURCE))
+                            for n in unit_nodes)
+                candidates.append((unit_prio, -chips, unit_id, chips))
+            candidates.sort()  # lowest priority first, then biggest chips
+            freed, victims = 0, []
+            for _prio, _negchips, unit_id, chips in candidates:
+                if freed >= gang.tpu_chips:
+                    break
+                victims.append(unit_id)
+                freed += chips
+            if freed < gang.tpu_chips:
+                continue  # preemption cannot help this gang
+            for unit_id in victims:
+                log.warning("preempting unit %s for higher-priority gang "
+                            "%s", unit_id, gang.name)
+                self.metrics.inc("preemptions")
+                self.notifier.notify(
+                    f"preempting {unit_id} for higher-priority "
+                    f"{gang.name}")
+                self.request_drain(unit_id)
+            # Cooldown: give the drain window time to play out before
+            # considering more victims for this gang.
+            self._retry_at[("preempt", gang.key)] = (
+                now + self.config.drain_grace_seconds + 60.0)
 
     def _note_failures(self, now: float) -> None:
         # Cancel provisions stuck in flight past the timeout; the FAILED

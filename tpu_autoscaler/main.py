@@ -114,6 +114,9 @@ _common = [
     click.option("--provision-timeout", default=900.0, show_default=True,
                  help="Cancel and retry provisions stuck in flight this "
                       "long (stockout guard)."),
+    click.option("--preemption", is_flag=True,
+                 help="Let clamp-blocked higher-priority gangs reclaim "
+                      "chips from lower-priority jobs (checkpoint-aware)."),
     click.option("--spare-agents", default=1, show_default=True,
                  help="Free CPU nodes kept warm (reference: --spare-agents)."),
     click.option("--spare-slice", "spare_slices", multiple=True,
@@ -148,7 +151,8 @@ def common_options(f):
 
 def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            drain_grace, utilization_threshold, gang_settle,
-           provision_timeout, spare_agents, spare_slices, over_provision,
+           provision_timeout, preemption, spare_agents, spare_slices,
+           over_provision,
            default_generation, cpu_machine_type, max_cpu_nodes,
            max_total_chips, preemptible, no_scale, no_maintenance,
            slack_hook, slack_channel, metrics_port, log_json,
@@ -171,6 +175,7 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         utilization_threshold=utilization_threshold,
         gang_settle_seconds=gang_settle,
         provision_timeout_seconds=provision_timeout,
+        enable_preemption=preemption,
         no_scale=no_scale, no_maintenance=no_maintenance)
     return Controller(kube, actuator, config, notifier, metrics)
 
