@@ -309,3 +309,80 @@ class TestPriorityPreemption:
         snap = controller.metrics.snapshot()
         assert snap["counters"].get("preemptions", 0) == 0
         assert pod_running(kube, "low")
+
+    def test_minimal_victim_chosen_for_overshoot(self):
+        """Review regression: free the clamp OVERSHOOT, not the gang's
+        whole demand — the small victim suffices, the big job survives."""
+        kube = FakeKube()
+        actuator = FakeActuator(kube)
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0, max_total_chips=16),
+            grace_seconds=30.0, idle_threshold_seconds=IDLE,
+            drain_grace_seconds=20.0, enable_preemption=True))
+        shape8 = shape_by_name("v5e-8")
+        shape4 = shape_by_name("v5e-4")
+        kube.add_pod(make_tpu_pod(name="big", chips=8, shape=shape8,
+                                  job="big-job"))
+        kube.add_pod(make_tpu_pod(name="small", chips=4, shape=shape4,
+                                  job="small-job"))
+        run_loop(kube, controller, stop_when=lambda: (
+            pod_running(kube, "big") and pod_running(kube, "small")))
+        # 12 chips in use; high-pri gang needs 8 -> overshoot 4: the
+        # 4-chip job is the right (and sufficient) victim.
+        high = make_tpu_pod(name="high", chips=8, shape=shape8,
+                            job="high-job")
+        high["spec"]["priority"] = 1000
+        kube.add_pod(high)
+        controller.reconcile_once(now=10.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["preemptions"] == 1
+        # Victim is the SMALL unit; the big job keeps running.
+        controller.reconcile_once(now=12.0)
+        assert CHECKPOINT_ANNOTATION in kube.get_pod(
+            "default", "small")["metadata"]["annotations"]
+        assert "annotations" not in kube.get_pod(
+            "default", "big")["metadata"] or CHECKPOINT_ANNOTATION not in \
+            kube.get_pod("default", "big")["metadata"]["annotations"]
+
+    def test_no_unsatisfiable_report_while_preempting(self):
+        kube, actuator, controller = self.harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="low", chips=8, shape=shape,
+                                  job="low-job"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "low"))
+        high = make_tpu_pod(name="high", chips=8, shape=shape,
+                            job="high-job")
+        high["spec"]["priority"] = 1000
+        kube.add_pod(high)
+        controller.reconcile_once(now=10.0)
+        # Actively making room: no unsatisfiable verdict on the pod.
+        pod = kube.get_pod("default", "high")
+        assert "autoscaler.tpu.dev/unsatisfiable" not in \
+            pod["metadata"].get("annotations", {})
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("unsatisfiable_gangs", 0) == 0
+
+    def test_no_second_wave_while_drain_in_progress(self):
+        """Draining chips are credited: a slow victim drain must not
+        trigger preemption of ANOTHER low-priority unit."""
+        kube, actuator, controller = self.harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="low", chips=8, shape=shape,
+                                  job="low-job"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "low"))
+        high = make_tpu_pod(name="high", chips=8, shape=shape,
+                            job="high-job")
+        high["spec"]["priority"] = 1000
+        kube.add_pod(high)
+        controller.reconcile_once(now=10.0)
+        # PDB blocks the victim's eviction well past the cooldown.
+        kube.pdb_protected.add(("default", "low"))
+        t = 12.0
+        while t < 300.0:
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 5.0
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["preemptions"] == 1  # no cascade

@@ -289,9 +289,13 @@ class Controller:
                         pod, now, "TriggeredScaleUp",
                         f"provisioning {req.shape_name} for this job "
                         f"({req.reason})")
-        if self.config.enable_preemption:
-            self._consider_preemption(plan, nodes, pods, now)
+        handled_by_preemption: set[tuple] = set()
+        if self.config.enable_preemption and not self.config.no_maintenance:
+            handled_by_preemption = self._consider_preemption(
+                plan, nodes, pods, now)
         for gang, reason in plan.unsatisfiable:
+            if gang.key in handled_by_preemption:
+                continue  # being actively made room for: not unsatisfiable
             if gang.key not in self._reported_unsatisfiable:
                 self._reported_unsatisfiable.add(gang.key)
                 log.warning("unsatisfiable %s: %s", gang, reason)
@@ -312,60 +316,92 @@ class Controller:
                                   exc_info=True)
 
     def _consider_preemption(self, plan, nodes: list[Node],
-                             pods: list[Pod], now: float) -> None:
+                             pods: list[Pod], now: float) -> set[tuple]:
         """Reclaim chips from lower-priority busy units for clamp-blocked
         higher-priority gangs.  Victims go through the normal
         checkpoint-aware drain; the freed budget lets the planner
-        provision for the preemptor on a later pass.
+        provision for the preemptor on a later pass.  Returns the gang
+        keys being made room for (so they are not reported unsatisfiable
+        while the room is being made).
         """
-        from tpu_autoscaler.k8s.units import group_supply_units
+        from tpu_autoscaler.engine.fitter import (
+            FitError,
+            choose_shape_for_gang,
+        )
         from tpu_autoscaler.topology.catalog import TPU_RESOURCE
 
+        handled: set[tuple] = set()
         blocked = [(g, r) for g, r in plan.unsatisfiable
                    if "max_total_chips" in r]
         if not blocked:
-            return
+            return handled
         pods_by_node: dict[str, list[Pod]] = {}
         for p in pods:
-            if p.node_name and p.phase in {"Pending", "Running"}:
+            if p.node_name:
                 pods_by_node.setdefault(p.node_name, []).append(p)
-        units = group_supply_units(nodes)
+        units = self._units(nodes)
 
-        def unit_workload(unit_nodes):
-            return [p for n in unit_nodes
-                    for p in pods_by_node.get(n.name, [])
-                    if not p.is_daemonset and not p.is_mirrored]
+        def unit_chips(unit_nodes):
+            return sum(int(n.allocatable.get(TPU_RESOURCE))
+                       for n in unit_nodes)
+
+        existing_chips = sum(unit_chips(ns) for ns in units.values()
+                             if ns[0].is_tpu)
+        # Chips already on their way out (drains in progress) free up
+        # without new victims — credit them before choosing more.
+        draining_ids = (set(self._drain_started)
+                        | self._requested_drains) & set(units)
+        draining_chips = sum(unit_chips(units[uid]) for uid in draining_ids
+                             if units[uid][0].is_tpu)
 
         for gang, _reason in blocked:
-            if now < self._retry_at.get(("preempt", gang.key), 0.0):
+            cooling = now < self._retry_at.get(("preempt", gang.key), 0.0)
+            if cooling:
+                handled.add(gang.key)  # room is being made; don't report
                 continue
-            # Victim candidates: busy TPU units, strictly lower priority,
-            # not already draining.
+            try:
+                demand_chips = choose_shape_for_gang(
+                    gang, self.config.policy.default_generation).shape.chips
+            except FitError:
+                continue  # not actually clamp-only blocked
+            # Free exactly the overshoot, not the gang's whole demand:
+            # existing - freed - draining + demand <= max_total_chips.
+            need = (existing_chips - draining_chips + demand_chips
+                    - self.config.policy.max_total_chips)
+            if need <= 0:
+                handled.add(gang.key)  # in-progress drains already suffice
+                continue
             candidates = []
             for unit_id, unit_nodes in units.items():
-                if not unit_nodes[0].is_tpu:
+                if not unit_nodes[0].is_tpu or unit_id in draining_ids:
                     continue
-                if unit_id in self._drain_started \
-                        or unit_id in self._requested_drains:
-                    continue
-                workload = unit_workload(unit_nodes)
+                workload = [p for n in unit_nodes
+                            for p in pods_by_node.get(n.name, [])
+                            if p.is_workload]
                 if not workload:
                     continue  # idle units free up via normal reclaim
                 unit_prio = max(p.priority for p in workload)
                 if unit_prio >= gang.priority:
                     continue
-                chips = sum(int(n.allocatable.get(TPU_RESOURCE))
-                            for n in unit_nodes)
-                candidates.append((unit_prio, -chips, unit_id, chips))
-            candidates.sort()  # lowest priority first, then biggest chips
+                candidates.append((unit_prio, unit_chips(unit_nodes),
+                                   unit_id))
+            # Lowest priority first, smallest unit first; then prune
+            # victims made redundant by later (bigger) picks so the set
+            # destroys the least work that still covers the need.
+            candidates.sort()
             freed, victims = 0, []
-            for _prio, _negchips, unit_id, chips in candidates:
-                if freed >= gang.tpu_chips:
+            for _prio, chips, unit_id in candidates:
+                if freed >= need:
                     break
-                victims.append(unit_id)
+                victims.append((unit_id, chips))
                 freed += chips
-            if freed < gang.tpu_chips:
+            if freed < need:
                 continue  # preemption cannot help this gang
+            for unit_id, chips in list(victims):
+                if freed - chips >= need:
+                    victims.remove((unit_id, chips))
+                    freed -= chips
+            victims = [unit_id for unit_id, _ in victims]
             for unit_id in victims:
                 log.warning("preempting unit %s for higher-priority gang "
                             "%s", unit_id, gang.name)
@@ -374,10 +410,13 @@ class Controller:
                     f"preempting {unit_id} for higher-priority "
                     f"{gang.name}")
                 self.request_drain(unit_id)
+            draining_chips += freed
+            handled.add(gang.key)
             # Cooldown: give the drain window time to play out before
             # considering more victims for this gang.
             self._retry_at[("preempt", gang.key)] = (
                 now + self.config.drain_grace_seconds + 60.0)
+        return handled
 
     def _note_failures(self, now: float) -> None:
         # Cancel provisions stuck in flight past the timeout; the FAILED
@@ -496,7 +535,7 @@ class Controller:
         def idle(unit_nodes: list[Node]) -> bool:
             return not any(
                 p for n in unit_nodes for p in pods_by_node.get(n.name, [])
-                if not p.is_daemonset and not p.is_mirrored)
+                if p.is_workload)
 
         def created(unit_nodes: list[Node]) -> float:
             times = [n.created.timestamp() for n in unit_nodes if n.created]
@@ -682,8 +721,7 @@ class Controller:
     def _continue_drain(self, unit_id: str, unit_nodes: list[Node],
                         unit_pods: list[Pod], now: float) -> None:
         started = self._drain_started.setdefault(unit_id, now)
-        workload = [p for p in unit_pods
-                    if not p.is_daemonset and not p.is_mirrored]
+        workload = [p for p in unit_pods if p.is_workload]
         if workload:
             if now - started < self.config.drain_grace_seconds:
                 return  # checkpoint window still open

@@ -135,6 +135,15 @@ class Pod:
         return (self.is_replicated and not self.is_mirrored
                 and not self.is_daemonset and not self.is_critical)
 
+    @property
+    def is_workload(self) -> bool:
+        """Counts toward a unit being busy: an active pod that is not
+        host-plumbing (daemonset/mirror).  THE busy/idle input predicate —
+        shared by the state machine, spare accounting, drain completion,
+        and preemption so they can never diverge."""
+        return (not self.is_daemonset and not self.is_mirrored
+                and self.phase in {"Pending", "Running"})
+
     # -- scheduling state (reference: cluster.py §get_pending_pods) ---------
 
     @property

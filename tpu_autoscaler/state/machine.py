@@ -67,9 +67,7 @@ class SliceView:
     def workload_pods(self) -> list[Pod]:
         """Pods that make a slice busy: everything except daemonsets and
         mirror pods (reference: cluster.py busy/idle input set)."""
-        return [p for p in self.pods
-                if not p.is_daemonset and not p.is_mirrored
-                and p.phase in {"Pending", "Running"}]
+        return [p for p in self.pods if p.is_workload]
 
     @property
     def utilization(self) -> float:
