@@ -307,3 +307,50 @@ class TestReviewRegressions:
         assert len(plan.requests) == 1
         assert plan.requests[0].gang_key == ("job", "default", "high-j")
         assert len(plan.unsatisfiable) == 1
+
+
+class TestNamespaceQuotas:
+    def policy(self, **quotas):
+        return PoolPolicy(spare_nodes=0, namespace_chip_quota=quotas)
+
+    def test_quota_blocks_over_demand(self):
+        from tests.fixtures import make_gang
+        from tpu_autoscaler.topology import shape_by_name
+
+        shape = shape_by_name("v5e-8")
+        pods = (make_gang(shape, job="a", namespace="teamx")
+                + make_gang(shape, job="b", namespace="teamx"))
+        plan = plan_for(pods, policy=self.policy(teamx=8))
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 1  # first gang fits the quota
+        assert len(plan.unsatisfiable) == 1
+        assert "chip quota 8 exceeded" in plan.unsatisfiable[0][1]
+
+    def test_running_usage_counts_against_quota(self):
+        from tests.fixtures import make_gang, make_slice_nodes, make_tpu_pod
+        from tpu_autoscaler.topology import shape_by_name
+
+        shape = shape_by_name("v5e-8")
+        nodes = make_slice_nodes(shape, "busy")
+        runner = make_tpu_pod(name="r", namespace="teamx", chips=8,
+                              shape=shape, phase="Running",
+                              node_name=nodes[0]["metadata"]["name"],
+                              unschedulable=False, job="running")
+        plan = plan_for(make_gang(shape, job="more", namespace="teamx"),
+                        node_payloads=nodes, bound_pods=[runner],
+                        policy=self.policy(teamx=8))
+        assert plan.empty or all(r.kind != "tpu-slice"
+                                 for r in plan.requests)
+        assert plan.unsatisfiable
+
+    def test_other_namespace_unaffected(self):
+        from tests.fixtures import make_gang
+        from tpu_autoscaler.topology import shape_by_name
+
+        shape = shape_by_name("v5e-8")
+        pods = (make_gang(shape, job="a", namespace="teamx")
+                + make_gang(shape, job="b", namespace="teamy"))
+        plan = plan_for(pods, policy=self.policy(teamx=0))
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 1
+        assert tpu[0].gang_key[1] == "teamy"

@@ -59,6 +59,12 @@ class PoolPolicy:
     # Clamps (reference: AgentPool.max_size).
     max_cpu_nodes: int = 100
     max_total_chips: int = 4096
+    # Multi-tenant fairness: max TPU chips (in use + in flight + planned)
+    # per namespace; namespaces absent from the map are bounded only by
+    # max_total_chips. Demand over quota is reported unsatisfiable with a
+    # quota reason, not silently queued.
+    namespace_chip_quota: dict[str, int] = dataclasses.field(
+        default_factory=dict)
     # Provision preemptible/spot TPU capacity (BASELINE config #5).
     preemptible: bool = False
 
@@ -172,6 +178,19 @@ class Planner:
         inflight_chips = sum(shape_by_name(f.shape_name).chips
                              for f in in_flight if f.kind == "tpu-slice")
         planned_chips = 0
+        # Per-namespace chip accounting for quota enforcement (enforced at
+        # provisioning time: in-use by bound pods + in-flight + planned).
+        ns_chips: dict[str, int] = {}
+        if pol.namespace_chip_quota:
+            for p in pods:
+                if p.node_name and p.phase in {"Pending", "Running"}:
+                    ns_chips[p.namespace] = (ns_chips.get(p.namespace, 0)
+                                             + p.tpu_chips)
+            for f in in_flight:
+                if f.kind == "tpu-slice" and f.gang_key:
+                    ns = f.gang_key[1]
+                    ns_chips[ns] = (ns_chips.get(ns, 0)
+                                    + shape_by_name(f.shape_name).chips)
 
         for gang in tpu_gangs:
             if gang.key in served_keys:
@@ -197,6 +216,15 @@ class Planner:
                     (gang, f"would exceed max_total_chips="
                            f"{pol.max_total_chips} (at {new_total})"))
                 continue
+            quota = pol.namespace_chip_quota.get(gang.namespace)
+            if quota is not None:
+                ns_new = ns_chips.get(gang.namespace, 0) + choice.shape.chips
+                if ns_new > quota:
+                    plan.unsatisfiable.append(
+                        (gang, f"namespace {gang.namespace!r} chip quota "
+                               f"{quota} exceeded (at {ns_new})"))
+                    continue
+                ns_chips[gang.namespace] = ns_new
             planned_chips += choice.shape.chips
             plan.requests.append(ProvisionRequest(
                 kind="tpu-slice", shape_name=choice.shape.name,

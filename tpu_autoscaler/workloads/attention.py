@@ -164,6 +164,38 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return _flash_attention(q, k, v, causal, block_q, interpret)
 
 
+def make_sharded_flash_attention(mesh, *, causal: bool = True,
+                                 block_q: int = 128,
+                                 batch_axis: str = "data",
+                                 head_axis: str = "model"):
+    """Run the fused kernel under a dp/tp mesh via shard_map.
+
+    XLA cannot auto-partition a custom kernel, but attention is
+    embarrassingly parallel over batch and heads: shard_map slices
+    [b, h, s, d] over (batch_axis, head_axis), each device runs the
+    kernel on its [b/dp, h/tp, s, d] shard, and no collectives are
+    needed.  This is how ``attention="pallas"`` composes with the
+    Megatron-style TP in model.py (heads are already split over 'model').
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis, head_axis, None, None)
+
+    def body(q, k, v):
+        return _flash_attention(
+            q, k, v, causal, block_q,
+            jax.default_backend() != "tpu")
+
+    def attn(q, k, v):
+        # check_vma=False: pallas_call's out_shape carries no varying-axis
+        # metadata; the body is per-shard pure (no collectives), so the
+        # check adds nothing here.
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
+
+    return attn
+
+
 def reference_attention(q, k, v, *, causal=True):
     """Plain einsum attention, the numerics oracle for the kernel."""
     d = q.shape[-1]
