@@ -183,3 +183,19 @@ class TestBigClusterPerfSmoke:
         controller.reconcile_once(now=5.0)
         elapsed = time.perf_counter() - t0
         assert elapsed < 1.0, f"reconcile took {elapsed:.2f}s at 300 nodes"
+
+
+class TestNamespaceQuotaFlag:
+    def test_flag_parsed_and_enforced(self):
+        result = CliRunner().invoke(cli, [
+            "demo", "--scenario", "v5e-8", "--namespace-quota", "default=4",
+            "--spare-agents", "0", "--until", "120"])
+        # The 8-chip gang exceeds default's 4-chip quota: never runs.
+        assert result.exit_code == 1
+        assert "FAILED" in result.output
+
+    def test_bad_quota_rejected(self):
+        result = CliRunner().invoke(cli, [
+            "demo", "--scenario", "cpu", "--namespace-quota", "oops"])
+        assert result.exit_code == 2
+        assert "NAMESPACE=CHIPS" in result.output

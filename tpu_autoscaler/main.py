@@ -24,8 +24,8 @@ from tpu_autoscaler.topology.catalog import cpu_shape_by_name
 
 
 def _policy(default_generation, cpu_machine_type, over_provision,
-            spare_agents, spare_slices, max_cpu_nodes, max_total_chips,
-            preemptible) -> PoolPolicy:
+            spare_agents, spare_slices, namespace_quotas, max_cpu_nodes,
+            max_total_chips, preemptible) -> PoolPolicy:
     from tpu_autoscaler.topology.catalog import SLICE_SHAPES
 
     spares: dict[str, int] = {}
@@ -42,12 +42,26 @@ def _policy(default_generation, cpu_machine_type, over_provision,
             raise click.BadParameter(
                 f"bad count in {item!r}; expected SHAPE=N",
                 param_hint="--spare-slice") from None
+    quotas: dict[str, int] = {}
+    for item in namespace_quotas:
+        ns, sep, chips = item.partition("=")
+        if not sep or not ns:
+            raise click.BadParameter(
+                f"bad quota {item!r}; expected NAMESPACE=CHIPS",
+                param_hint="--namespace-quota")
+        try:
+            quotas[ns] = int(chips)
+        except ValueError:
+            raise click.BadParameter(
+                f"bad chip count in {item!r}; expected NAMESPACE=CHIPS",
+                param_hint="--namespace-quota") from None
     return PoolPolicy(
         default_generation=default_generation,
         cpu_shape=cpu_shape_by_name(cpu_machine_type),
         over_provision_nodes=over_provision,
         spare_nodes=spare_agents,
         spare_slices=spares,
+        namespace_chip_quota=quotas,
         max_cpu_nodes=max_cpu_nodes,
         max_total_chips=max_total_chips,
         preemptible=preemptible,
@@ -121,6 +135,9 @@ _common = [
                  help="Free CPU nodes kept warm (reference: --spare-agents)."),
     click.option("--spare-slice", "spare_slices", multiple=True,
                  help="Warm TPU slices, e.g. --spare-slice v5e-8=1."),
+    click.option("--namespace-quota", "namespace_quotas", multiple=True,
+                 help="Per-namespace chip ceiling, e.g. "
+                      "--namespace-quota teamx=256."),
     click.option("--over-provision", default=0, show_default=True,
                  help="Extra CPU nodes beyond demand."),
     click.option("--default-generation", default="v5e", show_default=True),
@@ -152,7 +169,7 @@ def common_options(f):
 def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            drain_grace, utilization_threshold, gang_settle,
            provision_timeout, preemption, spare_agents, spare_slices,
-           over_provision,
+           namespace_quotas, over_provision,
            default_generation, cpu_machine_type, max_cpu_nodes,
            max_total_chips, preemptible, no_scale, no_maintenance,
            slack_hook, slack_channel, metrics_port, log_json,
@@ -167,8 +184,8 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         metrics.serve(metrics_port)
     config = ControllerConfig(
         policy=_policy(default_generation, cpu_machine_type, over_provision,
-                       spare_agents, spare_slices, max_cpu_nodes,
-                       max_total_chips, preemptible),
+                       spare_agents, spare_slices, namespace_quotas,
+                       max_cpu_nodes, max_total_chips, preemptible),
         grace_seconds=grace_period,
         idle_threshold_seconds=idle_threshold,
         drain_grace_seconds=drain_grace,
