@@ -199,3 +199,16 @@ class TestNamespaceQuotaFlag:
             "demo", "--scenario", "cpu", "--namespace-quota", "oops"])
         assert result.exit_code == 2
         assert "NAMESPACE=CHIPS" in result.output
+
+    def test_negative_and_duplicate_quota_rejected(self):
+        r = CliRunner().invoke(cli, [
+            "demo", "--scenario", "cpu", "--namespace-quota", "t=-8"])
+        assert r.exit_code == 2 and "negative" in r.output
+        r = CliRunner().invoke(cli, [
+            "demo", "--scenario", "cpu", "--namespace-quota", "t=64",
+            "--namespace-quota", "t=4096"])
+        assert r.exit_code == 2 and "duplicate" in r.output
+        r = CliRunner().invoke(cli, [
+            "demo", "--scenario", "cpu", "--spare-slice", "v5e-8=1",
+            "--spare-slice", "v5e-8=2"])
+        assert r.exit_code == 2 and "duplicate" in r.output

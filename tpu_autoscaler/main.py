@@ -36,12 +36,18 @@ def _policy(default_generation, cpu_machine_type, over_provision,
                 f"unknown slice shape {shape!r} (known: "
                 f"{', '.join(sorted(SLICE_SHAPES))})",
                 param_hint="--spare-slice")
+        if shape in spares:
+            raise click.BadParameter(
+                f"duplicate shape {shape!r}", param_hint="--spare-slice")
         try:
             spares[shape] = int(count or "1")
         except ValueError:
             raise click.BadParameter(
                 f"bad count in {item!r}; expected SHAPE=N",
                 param_hint="--spare-slice") from None
+        if spares[shape] < 0:
+            raise click.BadParameter(
+                f"negative count in {item!r}", param_hint="--spare-slice")
     quotas: dict[str, int] = {}
     for item in namespace_quotas:
         ns, sep, chips = item.partition("=")
@@ -49,12 +55,20 @@ def _policy(default_generation, cpu_machine_type, over_provision,
             raise click.BadParameter(
                 f"bad quota {item!r}; expected NAMESPACE=CHIPS",
                 param_hint="--namespace-quota")
+        if ns in quotas:
+            raise click.BadParameter(
+                f"duplicate namespace {ns!r} (one ceiling per namespace)",
+                param_hint="--namespace-quota")
         try:
             quotas[ns] = int(chips)
         except ValueError:
             raise click.BadParameter(
                 f"bad chip count in {item!r}; expected NAMESPACE=CHIPS",
                 param_hint="--namespace-quota") from None
+        if quotas[ns] < 0:
+            raise click.BadParameter(
+                f"negative quota in {item!r}",
+                param_hint="--namespace-quota")
     return PoolPolicy(
         default_generation=default_generation,
         cpu_shape=cpu_shape_by_name(cpu_machine_type),
