@@ -91,3 +91,21 @@ class TestStatusCli:
                 result.output
         finally:
             srv.shutdown()
+
+
+class TestJsonStatus:
+    def test_build_status_structure(self):
+        from tpu_autoscaler.controller.status import build_status
+
+        shape = shape_by_name("v5e-8")
+        from tests.fixtures import make_gang, make_slice_nodes
+
+        snap = build_status(make_slice_nodes(shape, "s1"),
+                            make_gang(shape_by_name("v5e-16"), job="g"))
+        assert snap["units"][0]["id"] == "s1"
+        assert snap["units"][0]["chips"] == 8
+        g = snap["pending_gangs"][0]
+        assert g["shape"] == "v5e-16" and g["stranded_chips"] == 0
+        import json
+
+        json.dumps(snap)  # fully serializable

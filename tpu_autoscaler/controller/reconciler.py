@@ -133,6 +133,7 @@ class Controller:
         self._gang_sizes: dict[tuple, tuple[int, float]] = {}
         # Units the operator (or spot reclamation) asked us to evacuate.
         self._requested_drains: set[str] = set()
+        self._seen_namespaces: set[str] = set()
 
     # ------------------------------------------------------------------ #
 
@@ -191,6 +192,19 @@ class Controller:
         self.metrics.observe("reconcile_seconds", time.perf_counter() - t0)
         self.metrics.set_gauge("pending_gangs", len(gangs))
         self.metrics.set_gauge("nodes", len(nodes))
+        # Per-namespace chip usage (quota observability): zero out
+        # namespaces that disappeared so gauges don't go stale.
+        ns_usage: dict[str, int] = {}
+        for p in pods:
+            if p.node_name and p.phase in {"Pending", "Running"} \
+                    and p.tpu_chips:
+                ns_usage[p.namespace] = (ns_usage.get(p.namespace, 0)
+                                         + p.tpu_chips)
+        for ns in self._seen_namespaces - set(ns_usage):
+            self.metrics.set_gauge(f"namespace_chips_used_{ns}", 0)
+        for ns, used in ns_usage.items():
+            self.metrics.set_gauge(f"namespace_chips_used_{ns}", used)
+        self._seen_namespaces |= set(ns_usage)
 
     def run_forever(self, interval_seconds: float = 5.0,
                     watch: bool = True, leader_lock=None) -> None:

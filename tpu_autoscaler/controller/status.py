@@ -15,54 +15,85 @@ from tpu_autoscaler.k8s.units import group_supply_units
 from tpu_autoscaler.topology.catalog import TPU_RESOURCE
 
 
-def render_status(node_payloads: list[dict], pod_payloads: list[dict],
-                  default_generation: str = "v5e") -> str:
+def build_status(node_payloads: list[dict], pod_payloads: list[dict],
+                 default_generation: str = "v5e") -> dict:
+    """Structured snapshot (the --json output; text rendering sits on
+    top)."""
     nodes = [Node(p) for p in node_payloads]
     pods = [Pod(p) for p in pod_payloads]
     pods_by_node: dict[str, int] = {}
     for p in pods:
-        if p.node_name and p.phase in {"Pending", "Running"} \
-                and not p.is_daemonset and not p.is_mirrored:
+        if p.node_name and p.is_workload:
             pods_by_node[p.node_name] = pods_by_node.get(p.node_name, 0) + 1
 
-    lines = ["SUPPLY UNITS"]
-    units = group_supply_units(nodes)
-    if not units:
-        lines.append("  (none)")
-    for unit_id, members in sorted(units.items()):
-        ready = sum(1 for n in members if n.is_ready)
-        cordoned = sum(1 for n in members if n.unschedulable)
-        chips = sum(int(n.allocatable.get(TPU_RESOURCE)) for n in members)
-        workload = sum(pods_by_node.get(n.name, 0) for n in members)
-        kind = (f"tpu {members[0].tpu_accelerator}"
-                f"/{members[0].tpu_topology}" if members[0].is_tpu
-                else f"cpu {members[0].instance_type}")
-        flags = []
-        if ready < len(members):
-            flags.append(f"READY {ready}/{len(members)}")
-        if cordoned:
-            flags.append(f"CORDONED {cordoned}")
-        lines.append(
-            f"  {unit_id}: {kind}, hosts={len(members)}, chips={chips}, "
-            f"workload_pods={workload}"
-            + (f" [{' '.join(flags)}]" if flags else ""))
+    units_out = []
+    for unit_id, members in sorted(group_supply_units(nodes).items()):
+        units_out.append({
+            "id": unit_id,
+            "kind": "tpu" if members[0].is_tpu else "cpu",
+            "accelerator": members[0].tpu_accelerator,
+            "topology": members[0].tpu_topology,
+            "machine_type": members[0].instance_type,
+            "hosts": len(members),
+            "ready_hosts": sum(1 for n in members if n.is_ready),
+            "cordoned_hosts": sum(1 for n in members if n.unschedulable),
+            "chips": sum(int(n.allocatable.get(TPU_RESOURCE))
+                         for n in members),
+            "workload_pods": sum(pods_by_node.get(n.name, 0)
+                                 for n in members),
+        })
 
-    lines.append("PENDING GANGS")
-    pending = [p for p in pods if p.is_unschedulable]
-    gangs = group_into_gangs(pending)
-    if not gangs:
-        lines.append("  (none)")
-    for gang in gangs:
+    gangs_out = []
+    for gang in group_into_gangs([p for p in pods if p.is_unschedulable]):
+        entry = {
+            "name": gang.name,
+            "namespace": gang.namespace,
+            "pods": gang.size,
+            "tpu_chips": gang.tpu_chips,
+            "priority": gang.priority,
+            "cpu": gang.total_resources.get("cpu"),
+        }
         if gang.requests_tpu:
             try:
                 choice = choose_shape_for_gang(gang, default_generation)
-                verdict = (f"-> {choice.shape.name} "
-                           f"({choice.stranded_chips} stranded)")
+                entry["shape"] = choice.shape.name
+                entry["stranded_chips"] = choice.stranded_chips
             except FitError as e:
-                verdict = f"UNSATISFIABLE: {e}"
-            lines.append(f"  {gang.name}: {gang.size} pods, "
-                         f"{gang.tpu_chips} chips {verdict}")
+                entry["unsatisfiable"] = str(e)
+        gangs_out.append(entry)
+    return {"units": units_out, "pending_gangs": gangs_out}
+
+
+def render_status(node_payloads: list[dict], pod_payloads: list[dict],
+                  default_generation: str = "v5e") -> str:
+    snap = build_status(node_payloads, pod_payloads, default_generation)
+    lines = ["SUPPLY UNITS"]
+    if not snap["units"]:
+        lines.append("  (none)")
+    for u in snap["units"]:
+        kind = (f"tpu {u['accelerator']}/{u['topology']}"
+                if u["kind"] == "tpu" else f"cpu {u['machine_type']}")
+        flags = []
+        if u["ready_hosts"] < u["hosts"]:
+            flags.append(f"READY {u['ready_hosts']}/{u['hosts']}")
+        if u["cordoned_hosts"]:
+            flags.append(f"CORDONED {u['cordoned_hosts']}")
+        lines.append(
+            f"  {u['id']}: {kind}, hosts={u['hosts']}, "
+            f"chips={u['chips']}, workload_pods={u['workload_pods']}"
+            + (f" [{' '.join(flags)}]" if flags else ""))
+
+    lines.append("PENDING GANGS")
+    if not snap["pending_gangs"]:
+        lines.append("  (none)")
+    for g in snap["pending_gangs"]:
+        if g["tpu_chips"]:
+            verdict = (f"UNSATISFIABLE: {g['unsatisfiable']}"
+                       if "unsatisfiable" in g else
+                       f"-> {g['shape']} ({g['stranded_chips']} stranded)")
+            lines.append(f"  {g['name']}: {g['pods']} pods, "
+                         f"{g['tpu_chips']} chips {verdict}")
         else:
-            cpu = gang.total_resources.get("cpu")
-            lines.append(f"  {gang.name}: {gang.size} pods, cpu={cpu:g}")
+            lines.append(f"  {g['name']}: {g['pods']} pods, "
+                         f"cpu={g['cpu']:g}")
     return "\n".join(lines)

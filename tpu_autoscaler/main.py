@@ -290,14 +290,25 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
 @cli.command()
 @kube_options
 @click.option("--default-generation", default="v5e", show_default=True)
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable output.")
 def status(kube_url, kube_token, kubeconfig, kube_context,
-           default_generation):
+           default_generation, as_json):
     """Read-only snapshot: supply units + pending gangs with fit verdicts."""
-    from tpu_autoscaler.controller.status import render_status
+    import json as _json
+
+    from tpu_autoscaler.controller.status import (
+        build_status,
+        render_status,
+    )
 
     kube = make_kube_client(kube_url, kube_token, kubeconfig, kube_context)
-    click.echo(render_status(kube.list_nodes(), kube.list_pods(),
-                             default_generation))
+    nodes, pods = kube.list_nodes(), kube.list_pods()
+    if as_json:
+        click.echo(_json.dumps(
+            build_status(nodes, pods, default_generation), indent=2))
+    else:
+        click.echo(render_status(nodes, pods, default_generation))
 
 
 @cli.command()
