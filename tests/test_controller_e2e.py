@@ -631,3 +631,26 @@ class TestPdbBlockedEviction:
         tpu_nodes = [n for n in kube.list_nodes()
                      if "gke-tpu-topology" in str(n["metadata"]["labels"])]
         assert tpu_nodes == []
+
+
+class TestGangAtomicScheduling:
+    def test_gang_never_partially_bound(self):
+        """Fake-scheduler realism: with capacity for only HALF a gang, no
+        member binds (kueue all-or-nothing), the gang stays pending, and
+        the autoscaler still provisions the full slice."""
+        from tests.fixtures import make_slice_nodes
+
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-16")  # gang of 4 pods
+        # Pre-existing free capacity for only 2 of the 4 pods (half a
+        # slice's worth of hosts).
+        for payload in make_slice_nodes(shape, "half")[:2]:
+            kube.add_node(payload)
+        for p in make_gang(shape, job="gang"):
+            kube.add_pod(p)
+        kube.schedule_step()
+        bound = [p for p in kube.list_pods() if p["spec"].get("nodeName")]
+        assert bound == []  # nothing partially placed
+        run_loop(kube, controller, stop_when=lambda: all(
+            pod_running(kube, f"gang-{i}") for i in range(4)))
+        assert all(pod_running(kube, f"gang-{i}") for i in range(4))

@@ -109,3 +109,19 @@ class TestJsonStatus:
         import json
 
         json.dumps(snap)  # fully serializable
+
+
+class TestPlan:
+    def test_build_plan_requests_and_unsatisfiable(self):
+        from tests.fixtures import make_gang, make_tpu_pod
+        from tpu_autoscaler.controller.status import build_plan
+
+        pods = make_gang(shape_by_name("v5e-16"), job="g")
+        pods.append(make_tpu_pod(name="huge", chips=4096, job="huge"))
+        plan = build_plan([], pods)
+        assert plan["requests"][0]["shape"] == "v5e-16"
+        assert plan["requests"][0]["gang"] == "g"
+        assert plan["unsatisfiable"][0]["gang"] == "huge"
+        import json
+
+        json.dumps(plan)

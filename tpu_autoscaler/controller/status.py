@@ -15,6 +15,33 @@ from tpu_autoscaler.k8s.units import group_supply_units
 from tpu_autoscaler.topology.catalog import TPU_RESOURCE
 
 
+def build_plan(node_payloads: list[dict], pod_payloads: list[dict],
+               default_generation: str = "v5e") -> dict:
+    """What-if: the exact provisioning plan the controller would submit
+    now (read-only; same planner, default policy + the given generation).
+    """
+    from tpu_autoscaler.engine.planner import Planner, PoolPolicy
+
+    nodes = [Node(p) for p in node_payloads]
+    pods = [Pod(p) for p in pod_payloads]
+    gangs = group_into_gangs([p for p in pods if p.is_unschedulable])
+    plan = Planner(PoolPolicy(
+        default_generation=default_generation, spare_nodes=0)).plan(
+        gangs, nodes, pods, [])
+    return {
+        "requests": [
+            {"kind": r.kind, "shape": r.shape_name, "count": r.count,
+             "gang": r.gang_key[2] if r.gang_key else None,
+             "stranded_chips": r.stranded_chips, "reason": r.reason}
+            for r in plan.requests
+        ],
+        "unsatisfiable": [
+            {"gang": g.name, "namespace": g.namespace, "reason": reason}
+            for g, reason in plan.unsatisfiable
+        ],
+    }
+
+
 def build_status(node_payloads: list[dict], pod_payloads: list[dict],
                  default_generation: str = "v5e") -> dict:
     """Structured snapshot (the --json output; text rendering sits on

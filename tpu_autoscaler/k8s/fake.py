@@ -140,14 +140,17 @@ class FakeKube:
     # ---- toy kube-scheduler --------------------------------------------
 
     def schedule_step(self) -> int:
-        """One scheduling pass: bind pending pods to fitting nodes.
+        """One scheduling pass: bind pending pods GANG-atomically.
 
-        Models just enough of kube-scheduler for the loop test: selector
-        match + resource fit against free allocatable; bound pods go
-        straight to Running.  Unbindable pods get/keep the Unschedulable
-        condition, which is exactly the demand signal the autoscaler reads.
-        Returns the number of pods bound this pass.
+        Models kube-scheduler + gang admission (JobSet/kueue semantics):
+        a gang's pods bind only if EVERY member fits simultaneously —
+        partial binding would misrepresent exactly the failure mode the
+        autoscaler exists to prevent.  Solo pods are singleton gangs.
+        Unbindable pods get/keep the Unschedulable condition, the demand
+        signal the autoscaler reads.  Returns pods bound this pass.
         """
+        from tpu_autoscaler.k8s.gangs import group_into_gangs
+
         nodes = [Node(p) for p in self._nodes.values()]
         pods = [Pod(p) for p in self._pods.values()]
         free: dict[str, ResourceVector] = {}
@@ -159,26 +162,39 @@ class FakeKube:
                 free[p.node_name] = free[p.node_name] - p.resources
 
         bound = 0
-        for p in sorted((p for p in pods if not p.node_name
-                         and p.phase == "Pending"),
-                        key=lambda p: (p.created is None,
-                                       p.created.timestamp() if p.created
-                                       else 0, p.name)):
-            target = next(
-                (n for n in nodes
-                 if n.name in free and n.admits(p)
-                 and p.resources.fits_in(free[n.name])), None)
-            payload = self._pods[(p.namespace, p.name)]
-            if target is None:
-                conds = payload["status"].setdefault("conditions", [])
-                if not any(c.get("type") == "PodScheduled" for c in conds):
-                    conds.append({"type": "PodScheduled", "status": "False",
-                                  "reason": "Unschedulable"})
+        pending = [p for p in pods
+                   if not p.node_name and p.phase == "Pending"]
+        for gang in group_into_gangs(pending):
+            # Tentative placement for the WHOLE gang against a copy.
+            trial = dict(free)
+            placements: list[tuple[Pod, str]] = []
+            ok = True
+            for p in gang.pods:
+                target = next(
+                    (n for n in nodes
+                     if n.name in trial and n.admits(p)
+                     and p.resources.fits_in(trial[n.name])), None)
+                if target is None:
+                    ok = False
+                    break
+                trial[target.name] = trial[target.name] - p.resources
+                placements.append((p, target.name))
+            if not ok:
+                for p in gang.pods:
+                    payload = self._pods[(p.namespace, p.name)]
+                    conds = payload["status"].setdefault("conditions", [])
+                    if not any(c.get("type") == "PodScheduled"
+                               for c in conds):
+                        conds.append({"type": "PodScheduled",
+                                      "status": "False",
+                                      "reason": "Unschedulable"})
                 continue
-            free[target.name] = free[target.name] - p.resources
-            payload["spec"]["nodeName"] = target.name
-            payload["status"]["phase"] = "Running"
-            payload["status"]["conditions"] = [
-                {"type": "PodScheduled", "status": "True"}]
-            bound += 1
+            free = trial
+            for p, node_name in placements:
+                payload = self._pods[(p.namespace, p.name)]
+                payload["spec"]["nodeName"] = node_name
+                payload["status"]["phase"] = "Running"
+                payload["status"]["conditions"] = [
+                    {"type": "PodScheduled", "status": "True"}]
+                bound += 1
         return bound

@@ -260,8 +260,11 @@ def cli():
 @click.option("--leader-elect", is_flag=True,
               help="Coordinate replicas via a kube-system Lease; only the "
                    "leader acts.")
+@click.option("--once", is_flag=True,
+              help="Single reconcile pass, then exit (cron-style).")
 def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
-        project, location, cluster, dry_run, leader_elect, sleep, **kw):
+        project, location, cluster, dry_run, leader_elect, once, sleep,
+        **kw):
     """Run against a real cluster (in-cluster, --kubeconfig, or
     --kube-url)."""
     kube = make_kube_client(kube_url, kube_token, kubeconfig, kube_context,
@@ -279,6 +282,9 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
         actuator = QueuedResourceActuator(project=project, zone=location,
                                           dry_run=dry_run)
     controller = _build(kube, actuator, sleep=sleep, **kw)
+    if once:
+        controller.reconcile_once()
+        return
     lock = None
     if leader_elect:
         from tpu_autoscaler.k8s.leader import LeaseLock
@@ -292,12 +298,16 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
 @click.option("--default-generation", default="v5e", show_default=True)
 @click.option("--json", "as_json", is_flag=True,
               help="Machine-readable output.")
+@click.option("--plan", "show_plan", is_flag=True,
+              help="Also show the provisioning plan the controller would "
+                   "submit now (what-if, read-only).")
 def status(kube_url, kube_token, kubeconfig, kube_context,
-           default_generation, as_json):
+           default_generation, as_json, show_plan):
     """Read-only snapshot: supply units + pending gangs with fit verdicts."""
     import json as _json
 
     from tpu_autoscaler.controller.status import (
+        build_plan,
         build_status,
         render_status,
     )
@@ -305,10 +315,23 @@ def status(kube_url, kube_token, kubeconfig, kube_context,
     kube = make_kube_client(kube_url, kube_token, kubeconfig, kube_context)
     nodes, pods = kube.list_nodes(), kube.list_pods()
     if as_json:
-        click.echo(_json.dumps(
-            build_status(nodes, pods, default_generation), indent=2))
-    else:
-        click.echo(render_status(nodes, pods, default_generation))
+        snap = build_status(nodes, pods, default_generation)
+        if show_plan:
+            snap["plan"] = build_plan(nodes, pods, default_generation)
+        click.echo(_json.dumps(snap, indent=2))
+        return
+    click.echo(render_status(nodes, pods, default_generation))
+    if show_plan:
+        plan = build_plan(nodes, pods, default_generation)
+        click.echo("WOULD PROVISION")
+        if not plan["requests"]:
+            click.echo("  (nothing)")
+        for r in plan["requests"]:
+            click.echo(f"  {r['count']}x {r['shape']}"
+                       + (f" for {r['gang']}" if r["gang"] else "")
+                       + f" ({r['reason']})")
+        for item in plan["unsatisfiable"]:
+            click.echo(f"  UNSATISFIABLE {item['gang']}: {item['reason']}")
 
 
 @cli.command()
