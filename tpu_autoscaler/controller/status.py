@@ -17,8 +17,13 @@ from tpu_autoscaler.topology.catalog import TPU_RESOURCE
 
 def build_plan(node_payloads: list[dict], pod_payloads: list[dict],
                default_generation: str = "v5e") -> dict:
-    """What-if: the exact provisioning plan the controller would submit
-    now (read-only; same planner, default policy + the given generation).
+    """What-if: the plan a FRESH controller with a default policy would
+    compute from current cluster state.
+
+    Read-only estimate for operators — it cannot see the running
+    controller's in-flight provisions or its configured policy (spares,
+    quotas, clamps), so it may show provisions the live controller is
+    already making or would clamp.  Same fit math, different inputs.
     """
     from tpu_autoscaler.engine.planner import Planner, PoolPolicy
 

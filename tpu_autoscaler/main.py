@@ -260,11 +260,8 @@ def cli():
 @click.option("--leader-elect", is_flag=True,
               help="Coordinate replicas via a kube-system Lease; only the "
                    "leader acts.")
-@click.option("--once", is_flag=True,
-              help="Single reconcile pass, then exit (cron-style).")
 def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
-        project, location, cluster, dry_run, leader_elect, once, sleep,
-        **kw):
+        project, location, cluster, dry_run, leader_elect, sleep, **kw):
     """Run against a real cluster (in-cluster, --kubeconfig, or
     --kube-url)."""
     kube = make_kube_client(kube_url, kube_token, kubeconfig, kube_context,
@@ -281,10 +278,12 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
 
         actuator = QueuedResourceActuator(project=project, zone=location,
                                           dry_run=dry_run)
+    # NOTE: no --once / cron mode on purpose: in-flight provision tracking
+    # and all scale-down timers are in-memory by design (crash-only), so a
+    # process-per-pass invocation would double-provision materializing
+    # slices and never reach any idle threshold. Run as a long-lived
+    # Deployment (deploy/autoscaler.yaml).
     controller = _build(kube, actuator, sleep=sleep, **kw)
-    if once:
-        controller.reconcile_once()
-        return
     lock = None
     if leader_elect:
         from tpu_autoscaler.k8s.leader import LeaseLock
@@ -299,8 +298,9 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
 @click.option("--json", "as_json", is_flag=True,
               help="Machine-readable output.")
 @click.option("--plan", "show_plan", is_flag=True,
-              help="Also show the provisioning plan the controller would "
-                   "submit now (what-if, read-only).")
+              help="Also show a what-if plan from current cluster state "
+                   "(default policy; ignores the live controller's "
+                   "in-flight work and configured policy).")
 def status(kube_url, kube_token, kubeconfig, kube_context,
            default_generation, as_json, show_plan):
     """Read-only snapshot: supply units + pending gangs with fit verdicts."""

@@ -118,7 +118,7 @@ class SimResult:
                     f"{self.latency_seconds:.1f}s; peak {self.peak_nodes} "
                     f"nodes, then job completed → {reclaimed} "
                     f"(units_deleted="
-                    f"{self.snapshot['counters'].get('units_deleted', 0)})")
+                    f"{int(self.snapshot['counters'].get('units_deleted', 0))})")
         return (f"[{self.scenario}] Unschedulable→Running in "
                 f"{self.latency_seconds:.1f}s; nodes={self.nodes}, "
                 f"chips={self.chips_provisioned} "
