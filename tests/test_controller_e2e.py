@@ -654,3 +654,19 @@ class TestGangAtomicScheduling:
         run_loop(kube, controller, stop_when=lambda: all(
             pod_running(kube, f"gang-{i}") for i in range(4)))
         assert all(pod_running(kube, f"gang-{i}") for i in range(4))
+
+
+class TestCostObservability:
+    def test_chip_seconds_accumulate(self):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "jax"))
+        controller.reconcile_once(now=100.0)
+        controller.reconcile_once(now=200.0)
+        snap = controller.metrics.snapshot()
+        assert snap["gauges"]["fleet_chips"] == 8
+        # 8 chips for >= 100s between those two passes alone.
+        assert snap["counters"]["chip_seconds_provisioned"] >= 800

@@ -134,6 +134,7 @@ class Controller:
         # Units the operator (or spot reclamation) asked us to evacuate.
         self._requested_drains: set[str] = set()
         self._seen_namespaces: set[str] = set()
+        self._last_pass_at: float | None = None
 
     # ------------------------------------------------------------------ #
 
@@ -192,6 +193,16 @@ class Controller:
         self.metrics.observe("reconcile_seconds", time.perf_counter() - t0)
         self.metrics.set_gauge("pending_gangs", len(gangs))
         self.metrics.set_gauge("nodes", len(nodes))
+        # Cost proxy: fleet chip count and its time integral.
+        from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+        fleet_chips = sum(int(n.allocatable.get(TPU_RESOURCE))
+                          for n in nodes if n.is_tpu)
+        self.metrics.set_gauge("fleet_chips", fleet_chips)
+        if self._last_pass_at is not None and now > self._last_pass_at:
+            self.metrics.inc("chip_seconds_provisioned",
+                             fleet_chips * (now - self._last_pass_at))
+        self._last_pass_at = now
         # Per-namespace chip usage (quota observability): zero out
         # namespaces that disappeared so gauges don't go stale.
         ns_usage: dict[str, int] = {}
