@@ -67,7 +67,34 @@ def run_north_star() -> dict:
     }
 
 
+def check_all_configs() -> bool:
+    """Gate: every BASELINE eval config must run clean (0 stranded)."""
+    from tpu_autoscaler.actuators.fake import FakeActuator
+    from tpu_autoscaler.controller import Controller, ControllerConfig
+    from tpu_autoscaler.engine.planner import PoolPolicy
+    from tpu_autoscaler.k8s.fake import FakeKube
+    from tpu_autoscaler.sim import seed_scenario, simulate
+
+    ok = True
+    for scenario in ("cpu", "v5e-8", "v5e-64", "2xv5p-128", "v5p-256"):
+        kube = FakeKube()
+        controller = Controller(kube, FakeActuator(kube), ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0)))
+        chips = seed_scenario(kube, scenario)
+        result = simulate(kube, controller, until=120.0, step=1.0,
+                          scenario=scenario, chips_requested=chips)
+        line_ok = result.all_running and result.stranded_chips == 0
+        ok = ok and line_ok
+        print(("PASS " if line_ok else "FAIL ") + result.describe(),
+              file=sys.stderr)
+    return ok
+
+
 def main() -> int:
+    if not check_all_configs():
+        print(json.dumps({"error": "a BASELINE config failed"}),
+              file=sys.stderr)
+        return 1
     # Warm once (imports, first-pass construction), measure best of 3 —
     # the driver wants steady-state controller overhead, not import time.
     run_north_star()
