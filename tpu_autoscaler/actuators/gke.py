@@ -49,10 +49,12 @@ class GkeNodePoolActuator:
 
     def __init__(self, project: str, location: str, cluster: str,
                  dry_run: bool = False, rest: GcpRest | None = None,
-                 pool_prefix: str = "tpuas"):
+                 pool_prefix: str = "tpuas",
+                 api_base: str = _BASE):
         if not (project and location and cluster):
             raise ValueError(
                 "GKE actuator needs --project, --location and --cluster")
+        self._api_base = api_base
         self._parent = (f"projects/{project}/locations/{location}"
                         f"/clusters/{cluster}")
         self._rest = rest or GcpRest(dry_run=dry_run,
@@ -123,7 +125,7 @@ class GkeNodePoolActuator:
         ops: list[str] = []
         try:
             for pool_name in pool_names:
-                op = self._rest.post(f"{_BASE}/{self._parent}/nodePools",
+                op = self._rest.post(f"{self._api_base}/{self._parent}/nodePools",
                                      self._pool_body(request, pool_name))
                 if op.get("name"):
                     ops.append(op["name"])
@@ -136,7 +138,7 @@ class GkeNodePoolActuator:
 
     def delete(self, unit_id: str) -> None:
         try:
-            self._rest.delete(f"{_BASE}/{self._parent}/nodePools/{unit_id}")
+            self._rest.delete(f"{self._api_base}/{self._parent}/nodePools/{unit_id}")
         except Exception:  # noqa: BLE001
             log.exception("node pool delete failed for %s", unit_id)
 
@@ -154,7 +156,7 @@ class GkeNodePoolActuator:
                 try:
                     # Operation names are already fully qualified
                     # (projects/.../operations/...).
-                    op = self._rest.get(f"{_BASE}/{op_name}")
+                    op = self._rest.get(f"{self._api_base}/{op_name}")
                 except Exception:  # noqa: BLE001 — transient; retry later
                     log.exception("operation poll failed for %s", pid)
                     all_done = False
