@@ -27,8 +27,10 @@ class FakeKube:
         self._uid = itertools.count(1)
         self.verb_log: list[tuple] = []
         self.events: list[tuple[str, dict]] = []
-        # (namespace, name) pairs whose eviction a PDB currently blocks.
+        # (namespace, name) pairs whose eviction is blocked directly
+        # (tests), plus declarative PodDisruptionBudgets (add_pdb).
         self.pdb_protected: set[tuple[str, str]] = set()
+        self._pdbs: list[dict] = []
 
     # ---- KubeClient protocol -------------------------------------------
 
@@ -66,12 +68,48 @@ class FakeKube:
 
     def evict_pod(self, namespace: str, name: str) -> None:
         self.verb_log.append(("evict", namespace, name))
-        if (namespace, name) in self.pdb_protected:
+        if (namespace, name) in self.pdb_protected \
+                or self._pdb_blocks(namespace, name):
             # Model the eviction API's 429 when a PodDisruptionBudget
             # blocks the disruption.
             raise RuntimeError("429: Cannot evict pod as it would violate "
                                "the pod's disruption budget.")
         self._pods.pop((namespace, name), None)
+
+    def _pdb_blocks(self, namespace: str, name: str) -> bool:
+        """Would evicting this pod violate a PodDisruptionBudget?
+
+        Real eviction-API semantics for minAvailable: the disruption is
+        allowed only if (healthy matching pods - 1) >= minAvailable.
+        """
+        pod = self._pods.get((namespace, name))
+        if pod is None:
+            return False
+        pod_labels = pod.get("metadata", {}).get("labels") or {}
+        for pdb in self._pdbs:
+            if pdb.get("metadata", {}).get("namespace",
+                                           "default") != namespace:
+                continue
+            selector = (pdb.get("spec", {}).get("selector", {})
+                        .get("matchLabels") or {})
+            if not selector or not all(pod_labels.get(k) == v
+                                       for k, v in selector.items()):
+                continue
+            min_available = int(pdb["spec"].get("minAvailable", 0))
+            healthy = sum(
+                1 for (ns, _), p in self._pods.items()
+                if ns == namespace
+                and p.get("status", {}).get("phase") == "Running"
+                and all((p.get("metadata", {}).get("labels") or {})
+                        .get(k) == v for k, v in selector.items()))
+            if healthy - 1 < min_available:
+                return True
+        return False
+
+    def add_pdb(self, payload: dict) -> None:
+        """Register a PodDisruptionBudget (spec.selector.matchLabels +
+        spec.minAvailable)."""
+        self._pdbs.append(payload)
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self.verb_log.append(("delete_pod", namespace, name))
