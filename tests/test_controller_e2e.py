@@ -692,9 +692,7 @@ class TestPdbObjects:
         # Evicting one of two is fine (1 healthy remains >= minAvailable).
         kube.evict_pod("default", "web-0")
         # Evicting the last violates the budget.
-        import pytest as _pytest
-
-        with _pytest.raises(RuntimeError, match="429"):
+        with pytest.raises(RuntimeError, match="429"):
             kube.evict_pod("default", "web-1")
         # A replacement comes up; the eviction unblocks.
         kube.add_pod(make_pod(
@@ -733,10 +731,39 @@ class TestPdbObjects:
         snap = controller.metrics.snapshot()
         assert snap["counters"].get("maintain_errors", 0) == 0
         # Replacement running elsewhere -> eviction allowed -> drain done.
-        kube.add_node(__import__("tests.fixtures", fromlist=["make_node"])
-                      .make_node(name="other-node", slice_id="other-node"))
+        from tests.fixtures import make_node
+
+        kube.add_node(make_node(name="other-node", slice_id="other-node"))
         kube.add_pod(make_pod(name="svc-b", owner_kind="ReplicaSet",
                               phase="Running", node_name="other-node",
                               unschedulable=False, labels={"app": "svc"}))
         run_loop(kube, controller, start=130.0, until=260.0, step=5.0)
         assert kube.get_pod("default", "svc-a") is None
+
+    def test_percentage_min_available_and_unhealthy_eviction(self):
+        kube = FakeKube()
+        kube.add_pdb(self.pdb("50%", {"app": "w"}))
+        for i, phase in enumerate(["Running", "Running", "Pending"]):
+            kube.add_pod(make_pod(name=f"w-{i}", owner_kind="ReplicaSet",
+                                  phase=phase, node_name=f"n{i}",
+                                  unschedulable=False,
+                                  labels={"app": "w"}))
+        # Unhealthy (Pending) pod: evictable even at the budget edge.
+        kube.evict_pod("default", "w-2")
+        # 50% of 2 matching = 1 must stay: one Running evictable, not both.
+        kube.evict_pod("default", "w-0")
+        import pytest as pt
+
+        with pt.raises(RuntimeError, match="429"):
+            kube.evict_pod("default", "w-1")
+
+    def test_unsupported_pdb_rejected(self):
+        kube = FakeKube()
+        import pytest as pt
+
+        with pt.raises(ValueError, match="minAvailable"):
+            kube.add_pdb({"spec": {"maxUnavailable": 1, "selector": {
+                "matchLabels": {"a": "b"}}}})
+        with pt.raises(ValueError, match="matchLabels"):
+            kube.add_pdb({"spec": {"minAvailable": 1,
+                                   "selector": {"matchLabels": {}}}})

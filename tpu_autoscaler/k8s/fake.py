@@ -79,36 +79,61 @@ class FakeKube:
     def _pdb_blocks(self, namespace: str, name: str) -> bool:
         """Would evicting this pod violate a PodDisruptionBudget?
 
-        Real eviction-API semantics for minAvailable: the disruption is
-        allowed only if (healthy matching pods - 1) >= minAvailable.
+        minAvailable semantics: the disruption is allowed only if the
+        healthy matching count AFTER the eviction stays >= minAvailable —
+        and per the real API's IfHealthyBudget default, evicting an
+        UNHEALTHY pod doesn't reduce the healthy count, so it is allowed
+        whenever the budget is currently met.
         """
+        import math
+
         pod = self._pods.get((namespace, name))
         if pod is None:
             return False
         pod_labels = pod.get("metadata", {}).get("labels") or {}
+        target_healthy = pod.get("status", {}).get("phase") == "Running"
         for pdb in self._pdbs:
             if pdb.get("metadata", {}).get("namespace",
                                            "default") != namespace:
                 continue
             selector = (pdb.get("spec", {}).get("selector", {})
                         .get("matchLabels") or {})
-            if not selector or not all(pod_labels.get(k) == v
-                                       for k, v in selector.items()):
+            if not all(pod_labels.get(k) == v
+                       for k, v in selector.items()):
                 continue
-            min_available = int(pdb["spec"].get("minAvailable", 0))
-            healthy = sum(
-                1 for (ns, _), p in self._pods.items()
+            matching = [
+                p for (ns, _), p in self._pods.items()
                 if ns == namespace
-                and p.get("status", {}).get("phase") == "Running"
                 and all((p.get("metadata", {}).get("labels") or {})
-                        .get(k) == v for k, v in selector.items()))
-            if healthy - 1 < min_available:
+                        .get(k) == v for k, v in selector.items())]
+            healthy = sum(1 for p in matching
+                          if p.get("status", {}).get("phase") == "Running")
+            raw = pdb["spec"]["minAvailable"]
+            if isinstance(raw, str) and raw.endswith("%"):
+                min_available = math.ceil(
+                    len(matching) * int(raw[:-1]) / 100)
+            else:
+                min_available = int(raw)
+            after = healthy - (1 if target_healthy else 0)
+            if after < min_available:
                 return True
         return False
 
     def add_pdb(self, payload: dict) -> None:
-        """Register a PodDisruptionBudget (spec.selector.matchLabels +
-        spec.minAvailable)."""
+        """Register a PodDisruptionBudget.
+
+        Supported subset: spec.selector.matchLabels (non-empty) +
+        spec.minAvailable (int or \"N%\"); anything else is rejected
+        loudly rather than silently never blocking.
+        """
+        spec = payload.get("spec") or {}
+        if "minAvailable" not in spec:
+            raise ValueError(
+                "fake PDB supports only minAvailable (got: "
+                f"{sorted(spec)})")
+        if not (spec.get("selector") or {}).get("matchLabels"):
+            raise ValueError(
+                "fake PDB requires a non-empty selector.matchLabels")
         self._pdbs.append(payload)
 
     def delete_pod(self, namespace: str, name: str) -> None:
