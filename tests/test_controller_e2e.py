@@ -742,7 +742,7 @@ class TestPdbObjects:
 
     def test_percentage_min_available_and_unhealthy_eviction(self):
         kube = FakeKube()
-        kube.add_pdb(self.pdb("50%", {"app": "w"}))
+        kube.add_pdb(self.pdb("50%", {"app": "w"}), expected_pods=2)
         for i, phase in enumerate(["Running", "Running", "Pending"]):
             kube.add_pod(make_pod(name=f"w-{i}", owner_kind="ReplicaSet",
                                   phase=phase, node_name=f"n{i}",
@@ -750,20 +750,32 @@ class TestPdbObjects:
                                   labels={"app": "w"}))
         # Unhealthy (Pending) pod: evictable even at the budget edge.
         kube.evict_pod("default", "w-2")
-        # 50% of 2 matching = 1 must stay: one Running evictable, not both.
+        # 50% of the 2-replica base = 1 must stay: one Running evictable,
+        # not both (the base is FIXED - no ratchet as pods are evicted).
         kube.evict_pod("default", "w-0")
-        import pytest as pt
-
-        with pt.raises(RuntimeError, match="429"):
+        with pytest.raises(RuntimeError, match="429"):
             kube.evict_pod("default", "w-1")
 
     def test_unsupported_pdb_rejected(self):
         kube = FakeKube()
-        import pytest as pt
-
-        with pt.raises(ValueError, match="minAvailable"):
+        with pytest.raises(ValueError, match="minAvailable"):
             kube.add_pdb({"spec": {"maxUnavailable": 1, "selector": {
                 "matchLabels": {"a": "b"}}}})
-        with pt.raises(ValueError, match="matchLabels"):
+        with pytest.raises(ValueError, match="matchLabels"):
             kube.add_pdb({"spec": {"minAvailable": 1,
                                    "selector": {"matchLabels": {}}}})
+        # Both fields together, negative/malformed values, extra selector
+        # machinery: all rejected at add time, not at eviction time.
+        with pytest.raises(ValueError, match="only minAvailable"):
+            kube.add_pdb({"spec": {"minAvailable": 1, "maxUnavailable": 0,
+                                   "selector": {"matchLabels": {"a": "b"}}}})
+        with pytest.raises(ValueError, match="int >= 0"):
+            kube.add_pdb(self.pdb(-5, {"a": "b"}))
+        with pytest.raises(ValueError, match="expected int or"):
+            kube.add_pdb(self.pdb("abc%", {"a": "b"}))
+        with pytest.raises(ValueError, match="expected_pods"):
+            kube.add_pdb(self.pdb("50%", {"a": "b"}))
+        with pytest.raises(ValueError, match="matchExpressions"):
+            kube.add_pdb({"spec": {"minAvailable": 1, "selector": {
+                "matchLabels": {"a": "b"},
+                "matchExpressions": [{"key": "a", "operator": "Exists"}]}}})

@@ -110,8 +110,12 @@ class FakeKube:
                           if p.get("status", {}).get("phase") == "Running")
             raw = pdb["spec"]["minAvailable"]
             if isinstance(raw, str) and raw.endswith("%"):
+                # Percent of the registered expected-pod base (real k8s
+                # derives it from the owning controller's replicas; the
+                # fake takes it explicitly so the budget can't ratchet
+                # down as pods are evicted).
                 min_available = math.ceil(
-                    len(matching) * int(raw[:-1]) / 100)
+                    pdb["_expected_pods"] * int(raw[:-1]) / 100)
             else:
                 min_available = int(raw)
             after = healthy - (1 if target_healthy else 0)
@@ -119,21 +123,43 @@ class FakeKube:
                 return True
         return False
 
-    def add_pdb(self, payload: dict) -> None:
+    def add_pdb(self, payload: dict,
+                expected_pods: int | None = None) -> None:
         """Register a PodDisruptionBudget.
 
-        Supported subset: spec.selector.matchLabels (non-empty) +
-        spec.minAvailable (int or \"N%\"); anything else is rejected
-        loudly rather than silently never blocking.
+        Supported subset, rejected loudly outside it: spec.selector.
+        matchLabels (non-empty, no matchExpressions) + spec.minAvailable
+        ONLY (int >= 0, or "N%" with ``expected_pods`` given as the
+        percentage base — real k8s derives that base from the owning
+        controller's replica count, which a fake apiserver cannot know).
         """
+        import re
+
         spec = payload.get("spec") or {}
-        if "minAvailable" not in spec:
+        unsupported = sorted(set(spec) - {"minAvailable", "selector"})
+        if "minAvailable" not in spec or unsupported:
             raise ValueError(
-                "fake PDB supports only minAvailable (got: "
+                "fake PDB supports only minAvailable+selector (got: "
                 f"{sorted(spec)})")
-        if not (spec.get("selector") or {}).get("matchLabels"):
+        selector = spec.get("selector") or {}
+        if not selector.get("matchLabels") \
+                or set(selector) - {"matchLabels"}:
             raise ValueError(
-                "fake PDB requires a non-empty selector.matchLabels")
+                "fake PDB requires a non-empty selector.matchLabels "
+                "(and nothing else, e.g. no matchExpressions)")
+        raw = spec["minAvailable"]
+        if isinstance(raw, str):
+            if not re.fullmatch(r"\d+%", raw):
+                raise ValueError(
+                    f"bad minAvailable {raw!r}: expected int or 'N%'")
+            if expected_pods is None:
+                raise ValueError(
+                    "percentage minAvailable needs expected_pods (the "
+                    "replica base real k8s gets from the controller)")
+            payload = {**payload, "_expected_pods": expected_pods}
+        elif not isinstance(raw, int) or raw < 0:
+            raise ValueError(
+                f"bad minAvailable {raw!r}: expected int >= 0 or 'N%'")
         self._pdbs.append(payload)
 
     def delete_pod(self, namespace: str, name: str) -> None:
