@@ -212,3 +212,14 @@ class TestNamespaceQuotaFlag:
             "demo", "--scenario", "cpu", "--spare-slice", "v5e-8=1",
             "--spare-slice", "v5e-8=2"])
         assert r.exit_code == 2 and "duplicate" in r.output
+
+
+class TestChurnScenario:
+    def test_churn_serves_jobs_and_summarizes(self):
+        result = CliRunner().invoke(cli, [
+            "demo", "--scenario", "churn", "--provision-delay", "60",
+            "--idle-threshold", "300", "--spare-agents", "0",
+            "--until", "20000"])
+        assert result.exit_code == 0, result.output
+        assert "jobs served" in result.output
+        assert "0 pods pending at cutoff" in result.output

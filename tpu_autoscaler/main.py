@@ -338,8 +338,9 @@ def status(kube_url, kube_token, kubeconfig, kube_context,
 @common_options
 @click.option("--scenario", default="v5e-8", show_default=True,
               type=click.Choice(["cpu", "v5e-8", "v5e-64", "2xv5p-128",
-                                 "v5p-256"]),
-              help="Pending workload to simulate (BASELINE eval configs).")
+                                 "v5p-256", "churn"]),
+              help="Pending workload to simulate (BASELINE eval configs, "
+                   "or 'churn' for randomized fleet traffic).")
 @click.option("--provision-delay", default=90.0, show_default=True,
               help="Simulated cloud provisioning delay seconds.")
 @click.option("--until", default=3600.0, show_default=True,
@@ -355,11 +356,15 @@ def demo(scenario, provision_delay, until, scale_down, sleep, **kw):
     """
     from tpu_autoscaler.actuators.fake import FakeActuator
     from tpu_autoscaler.k8s.fake import FakeKube
-    from tpu_autoscaler.sim import seed_scenario, simulate
+    from tpu_autoscaler.sim import seed_scenario, simulate, simulate_churn
 
     kube = FakeKube()
     actuator = FakeActuator(kube, provision_delay=provision_delay)
     controller = _build(kube, actuator, sleep=sleep, **kw)
+    if scenario == "churn":
+        click.echo(simulate_churn(kube, controller, until=until,
+                                  step=sleep))
+        sys.exit(0)
     chips = seed_scenario(kube, scenario)
     result = simulate(kube, controller, until=until, step=sleep,
                       scenario=scenario, chips_requested=chips,

@@ -126,6 +126,62 @@ class SimResult:
                 f"stranded {self.stranded_chips})")
 
 
+def simulate_churn(kube: FakeKube, controller: Controller, *,
+                   until: float, step: float = 5.0, seed: int = 0,
+                   arrival_rate: float = 0.02,
+                   completion_rate: float = 0.004) -> str:
+    """Randomized fleet churn: gangs of mixed shapes arrive, run, and
+    complete while the controller scales both ways.  Returns a summary —
+    the whole-system demo (`demo --scenario churn`).
+    """
+    import random
+
+    rng = random.Random(seed)
+    shapes = ["v5e-8", "v5e-16", "v5e-64"]
+    active: dict[str, list[str]] = {}
+    served = 0
+    jid = 0
+    peak_nodes = 0
+    t = 0.0
+    while t <= until:
+        if rng.random() < arrival_rate and len(active) < 10:
+            jid += 1
+            shape = shape_by_name(rng.choice(shapes))
+            names = []
+            for p in _gang_pods(shape.name, f"job-{jid}"):
+                kube.add_pod(p)
+                names.append(p["metadata"]["name"])
+            active[f"job-{jid}"] = names
+        for job, names in list(active.items()):
+            running = all(
+                (kube.get_pod("default", n) or {}).get("status", {})
+                .get("phase") == "Running" for n in names)
+            if running and rng.random() < completion_rate:
+                for n in names:
+                    kube.delete_pod("default", n)
+                del active[job]
+                served += 1
+        controller.reconcile_once(now=t)
+        kube.schedule_step()
+        peak_nodes = max(peak_nodes, len(kube.list_nodes()))
+        t += step
+
+    snap = controller.metrics.snapshot()
+    lat = snap["summaries"].get("scale_up_latency_seconds", {})
+    pending = sum(1 for p in kube.list_pods()
+                  if p["status"]["phase"] == "Pending")
+    counters = snap["counters"]
+    return (f"[churn] {served} jobs served, {len(active)} running, "
+            f"{pending} pods pending at cutoff; "
+            f"scale-up latency avg {lat.get('avg', 0):.0f}s "
+            f"max {lat.get('max', 0):.0f}s over {lat.get('count', 0)} "
+            f"gangs; peak {peak_nodes} nodes, "
+            f"{int(counters.get('provisions_submitted', 0))} provisions, "
+            f"{int(counters.get('units_deleted', 0))} reclaims, "
+            f"{int(counters.get('chip_seconds_provisioned', 0))} "
+            f"chip-seconds")
+
+
 def simulate(kube: FakeKube, controller: Controller, *, until: float,
              step: float = 5.0, scenario: str = "",
              chips_requested: int = 0,
