@@ -220,3 +220,36 @@ class TestRemat:
         params, opt_state = init_fn(jax.random.PRNGKey(0))
         _, _, loss = step_fn(params, opt_state, batch_for(TINY, batch=8))
         assert np.isfinite(float(loss))
+
+
+class TestAsyncCheckpointWriter:
+    def test_overlapped_save_lands_after_wait(self, tmp_path):
+        from tpu_autoscaler.workloads.checkpoint import (
+            AsyncCheckpointWriter,
+            restore_checkpoint,
+        )
+
+        writer = AsyncCheckpointWriter()
+        state = {"w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4)}
+        writer.save(str(tmp_path), 7, state)
+        # Simulate training continuing while the write is in flight.
+        _ = jnp.sum(state["w"] * 2)
+        writer.wait()
+        assert latest_step(str(tmp_path)) == 7
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+        restored = restore_checkpoint(str(tmp_path), 7, abstract)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(state["w"]))
+
+    def test_sequential_saves(self, tmp_path):
+        from tpu_autoscaler.workloads.checkpoint import (
+            AsyncCheckpointWriter,
+        )
+
+        writer = AsyncCheckpointWriter()
+        for step in (1, 2, 3):
+            writer.save(str(tmp_path), step,
+                        {"w": jnp.full((2,), float(step))})
+        writer.wait()
+        assert latest_step(str(tmp_path)) == 3

@@ -48,6 +48,7 @@ def main(steps, batch, seq_len, d_model, n_layers, checkpoint_dir,
 
     from tpu_autoscaler.workloads.checkpoint import (
         DEFAULT_ANNOTATIONS_PATH,
+        AsyncCheckpointWriter,
         DrainWatcher,
         latest_step,
         restore_checkpoint,
@@ -118,11 +119,13 @@ def main(steps, batch, seq_len, d_model, n_layers, checkpoint_dir,
         if step % 10 == 0:
             log.info("step %d loss %.4f", step, last_loss[0])
 
+    writer = AsyncCheckpointWriter()
     state, step, drained = train_until_drained(
         step_fn, state, num_steps=steps, watcher=watcher,
         checkpoint_dir=checkpoint_dir, make_batch=batch_for,
         start_step=start, checkpoint_every=checkpoint_every,
-        on_step=on_step)
+        on_step=on_step, save_fn=writer.save)
+    writer.wait()  # final/drain checkpoint must be durable before exit
     if drained:
         log.info("drain requested: checkpointed at step %d, exiting "
                  "cleanly", step)
