@@ -85,12 +85,9 @@ class DrainWatcher:
 
 def save_checkpoint(directory: str, step: int, state) -> str:
     """Save a pytree checkpoint (blocking); returns the checkpoint path."""
-    import orbax.checkpoint as ocp
-
-    path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    checkpointer = ocp.StandardCheckpointer()
-    checkpointer.save(path, state, force=True)
-    checkpointer.wait_until_finished()
+    writer = AsyncCheckpointWriter()
+    path = writer.save(directory, step, state)
+    writer.wait()
     return path
 
 
@@ -112,8 +109,10 @@ class AsyncCheckpointWriter:
 
     def save(self, directory: str, step: int, state) -> str:
         path = os.path.join(os.path.abspath(directory), f"step_{step}")
-        # StandardCheckpointer is AsyncCheckpointer-backed: save() kicks
-        # off the background write; only wait_until_finished blocks.
+        # StandardCheckpointer is AsyncCheckpointer-backed: save() blocks
+        # only on the PREVIOUS in-flight write + the device-to-host
+        # snapshot, then serializes in the background — the disk write
+        # itself (the long part at real sizes) overlaps training.
         self._checkpointer.save(path, state, force=True)
         return path
 

@@ -120,12 +120,17 @@ def main(steps, batch, seq_len, d_model, n_layers, checkpoint_dir,
             log.info("step %d loss %.4f", step, last_loss[0])
 
     writer = AsyncCheckpointWriter()
-    state, step, drained = train_until_drained(
-        step_fn, state, num_steps=steps, watcher=watcher,
-        checkpoint_dir=checkpoint_dir, make_batch=batch_for,
-        start_step=start, checkpoint_every=checkpoint_every,
-        on_step=on_step, save_fn=writer.save)
-    writer.wait()  # final/drain checkpoint must be durable before exit
+    try:
+        state, step, drained = train_until_drained(
+            step_fn, state, num_steps=steps, watcher=watcher,
+            checkpoint_dir=checkpoint_dir, make_batch=batch_for,
+            start_step=start, checkpoint_every=checkpoint_every,
+            on_step=on_step, save_fn=writer.save)
+    finally:
+        # Always drain the writer: makes the final/drain checkpoint
+        # durable AND surfaces any deferred background write error even
+        # when the training loop itself raised.
+        writer.wait()
     if drained:
         log.info("drain requested: checkpointed at step %d, exiting "
                  "cleanly", step)
