@@ -1,0 +1,26 @@
+"""bench.py contract test: the driver records exactly one JSON line with
+metric/value/unit/vs_baseline from stdout; a regression here would lose
+the round's benchmark silently."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_emits_one_json_line():
+    result = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert result.returncode == 0, result.stderr
+    lines = [line for line in result.stdout.splitlines() if line.strip()]
+    assert len(lines) == 1, f"stdout must be ONE line, got: {lines}"
+    payload = json.loads(lines[0])
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+    assert payload["metric"] == "north_star_v5p256_controller_overhead"
+    assert payload["unit"] == "s"
+    assert 0 < payload["value"] < 10
+    assert payload["vs_baseline"] > 1
+    # All five config gates reported PASS on stderr.
+    assert result.stderr.count("PASS ") == 5
