@@ -87,3 +87,13 @@ class TestNotifiers:
 
         monkeypatch.setattr(requests, "post", boom)
         SlackNotifier("https://hooks.example/x")._post("msg")  # no raise
+
+
+class TestDynamicGaugeSanitization:
+    def test_namespace_gauge_names_render_clean(self):
+        m = Metrics()
+        m.set_gauge("namespace_chips_used_team-x.prod/eu", 16)
+        text = m.render_prometheus()
+        assert "namespace_chips_used_team_x_prod_eu 16" in text
+        # Original (unsanitized) name never leaks into the exposition.
+        assert "team-x.prod/eu" not in text

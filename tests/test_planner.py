@@ -354,3 +354,59 @@ class TestNamespaceQuotas:
         tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
         assert len(tpu) == 1
         assert tpu[0].gang_key[1] == "teamy"
+
+
+class TestPlannerProperties:
+    """Seeded randomized invariants over demand/supply mixes: the clamp,
+    feasibility, and idempotence guarantees must hold for ANY input."""
+
+    def test_invariants_over_random_scenarios(self):
+        import random
+
+        from tests.fixtures import make_gang, make_slice_nodes, make_tpu_pod
+        from tpu_autoscaler.topology import shape_by_name
+        from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+        rng = random.Random(20260729)
+        shapes = ["v5e-8", "v5e-16", "v5e-64", "v5p-32"]
+        for trial in range(60):
+            max_chips = rng.choice([64, 128, 256, 4096])
+            policy = PoolPolicy(spare_nodes=0, max_total_chips=max_chips)
+            pods, node_payloads, in_flight = [], [], []
+            for g in range(rng.randrange(0, 5)):
+                shape = shape_by_name(rng.choice(shapes))
+                pods += make_gang(shape, job=f"t{trial}-g{g}")
+            for s in range(rng.randrange(0, 3)):
+                shape = shape_by_name(rng.choice(shapes))
+                node_payloads += make_slice_nodes(shape, f"t{trial}-s{s}")
+            for f in range(rng.randrange(0, 2)):
+                in_flight.append(InFlight(
+                    kind="tpu-slice", shape_name=rng.choice(shapes),
+                    gang_key=("job", "default", f"t{trial}-g0")))
+            if rng.random() < 0.3:
+                pods.append(make_tpu_pod(name=f"t{trial}-odd", chips=3,
+                                         job=f"t{trial}-odd",
+                                         selectors={}))
+            plan = plan_for(pods, node_payloads=node_payloads,
+                            in_flight=in_flight, policy=policy)
+
+            nodes = [Node(n) for n in node_payloads]
+            existing = sum(int(n.allocatable.get(TPU_RESOURCE))
+                           for n in nodes)
+            inflight_chips = sum(
+                shape_by_name(f.shape_name).chips for f in in_flight)
+            # INVARIANT 1: the clamp is never exceeded.
+            assert existing + inflight_chips + plan.total_new_chips \
+                <= max_chips or plan.total_new_chips == 0
+            # INVARIANT 2: at most one provision per gang, and never for a
+            # gang already in flight.
+            keys = [r.gang_key for r in plan.requests
+                    if r.kind == "tpu-slice" and r.gang_key]
+            assert len(keys) == len(set(keys))
+            assert not (set(keys)
+                        & {f.gang_key for f in in_flight if f.gang_key})
+            # INVARIANT 3: every request names a real catalog shape.
+            for r in plan.requests:
+                if r.kind == "tpu-slice":
+                    shape_by_name(r.shape_name)
+                    assert r.stranded_chips >= 0
