@@ -149,3 +149,72 @@ def test_converges_with_always_failing_shape_reports_not_spins():
     # ~once per minute, not once per 5s pass.
     assert snap["counters"]["provision_failures"] <= 11
     assert snap["counters"]["provisions_submitted"] <= 11
+
+
+def test_converges_with_all_policies_enabled():
+    """Interplay chaos: preemption + namespace quotas + consolidation +
+    settle all on at once, with priorities and failures — the loop must
+    converge, honor quotas, and never strand a high-priority gang."""
+    rng = random.Random(42)
+    kube = FakeKube()
+    actuator = FlakyActuator(kube, rng=rng, fail_prob=0.15,
+                             provision_delay=30.0)
+    controller = Controller(kube, actuator, ControllerConfig(
+        policy=PoolPolicy(spare_nodes=0, max_total_chips=96,
+                          namespace_chip_quota={"greedy": 32}),
+        grace_seconds=30.0, idle_threshold_seconds=120.0,
+        drain_grace_seconds=20.0, provision_retry_seconds=30.0,
+        utilization_threshold=0.3, gang_settle_seconds=10.0,
+        enable_preemption=True))
+
+    from tests.fixtures import make_gang
+    from tpu_autoscaler.topology import shape_by_name
+    from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+    names = {}
+    jid = 0
+    t = 0.0
+    while t <= 3000.0:
+        if rng.random() < 0.03 and len(names) < 8:
+            jid += 1
+            ns = rng.choice(["default", "greedy"])
+            prio = rng.choice([0, 0, 100])
+            shape = shape_by_name(rng.choice(["v5e-8", "v5e-16"]))
+            gang = make_gang(shape, job=f"j{jid}", namespace=ns)
+            for p in gang:
+                p["spec"]["priority"] = prio
+                kube.add_pod(p)
+            names[f"j{jid}"] = (ns, [p["metadata"]["name"] for p in gang])
+        for job, (ns, members) in list(names.items()):
+            gone = [m for m in members
+                    if kube.get_pod(ns, m) is None]
+            if gone:  # preempted/evicted: Job controller recreates
+                shape = shape_by_name("v5e-8" if len(members) == 1
+                                      else "v5e-16")
+                for m in gone:
+                    idx = int(m.rsplit("-", 1)[1])
+                    from tests.fixtures import make_tpu_pod
+
+                    kube.add_pod(make_tpu_pod(
+                        name=m, namespace=ns, chips=shape.chips_per_host,
+                        shape=shape, job=job))
+            if all((kube.get_pod(ns, m) or {}).get("status", {})
+                   .get("phase") == "Running" for m in members) \
+                    and rng.random() < 0.01:
+                for m in members:
+                    kube.delete_pod(ns, m)
+                del names[job]
+        controller.reconcile_once(now=t)
+        kube.schedule_step()
+        # INVARIANT: the greedy namespace never exceeds its chip quota in
+        # PROVISIONED-for-it capacity... enforced at planning; spot-check
+        # total chips never exceed the global clamp.
+        total = sum(int(float(n["status"]["allocatable"].get(
+            TPU_RESOURCE, 0))) for n in kube.list_nodes())
+        assert total <= 96, f"clamp violated at t={t}: {total}"
+        t += 5.0
+    # No runaway state.
+    assert len(controller.tracker.known_slices()) < 20
+    snap = controller.metrics.snapshot()
+    assert snap["counters"].get("reconcile_errors", 0) == 0
+    assert snap["counters"].get("maintain_errors", 0) == 0

@@ -101,3 +101,32 @@ class TestNanoMicroSuffixes:
         from tpu_autoscaler.k8s.resources import parse_quantity
         assert parse_quantity("500000n") == 0.0005
         assert parse_quantity("250u") == 0.00025
+
+
+class TestQuantityFuzz:
+    def test_random_quantities_roundtrip(self):
+        import random
+
+        rng = random.Random(7)
+        suffixes = {"": 1.0, "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9,
+                    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40}
+        for _ in range(500):
+            mantissa = round(rng.uniform(0, 999), rng.randrange(0, 4))
+            suffix, mult = rng.choice(list(suffixes.items()))
+            s = f"{mantissa}{suffix}"
+            assert parse_quantity(s) == pytest.approx(mantissa * mult)
+
+    @pytest.mark.parametrize("raw,expected", [
+        (".5", 0.5),
+        ("+2", 2.0),
+        (" 100m ", 0.1),
+        ("0.5Gi", 0.5 * 2**30),
+        ("007", 7.0),
+    ])
+    def test_edge_forms(self, raw, expected):
+        assert parse_quantity(raw) == pytest.approx(expected)
+
+    @pytest.mark.parametrize("bad", ["Ki", "m", "--1", "1..2", "1 Gi"])
+    def test_malformed_rejected(self, bad):
+        with pytest.raises(ValueError):
+            parse_quantity(bad)

@@ -43,6 +43,10 @@ def parse_quantity(value: str | int | float) -> float:
     s = value.strip()
     if not s:
         raise ValueError("empty quantity")
+    if any(c.isspace() for c in s):
+        # float() tolerates e.g. "1 " after suffix stripping; the k8s
+        # grammar has no internal whitespace — reject typos loudly.
+        raise ValueError(f"whitespace inside quantity {value!r}")
     for suffix, mult in _BINARY_SUFFIXES.items():
         if s.endswith(suffix):
             return float(s[: -len(suffix)]) * mult
