@@ -75,3 +75,20 @@ class TestPipelineLoss:
             params, opt_state, value = step(params, opt_state)
             losses.append(float(value))
         assert losses[-1] < losses[0] - 0.2
+
+
+class TestPipelineComposition:
+    def test_pipeline_with_remat_matches(self):
+        import dataclasses as dc
+
+        cfg = dc.replace(CFG, remat=True)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = tokens_for(batch=4)
+        ref = float(loss_fn(params, tokens, cfg))
+        loss = make_pipeline_loss(pp_mesh(4), cfg, num_microbatches=2)
+        assert float(loss(params, tokens)) == pytest.approx(ref, rel=2e-5)
+        grads = jax.grad(loss)(params, tokens)
+        ref_grads = jax.grad(lambda p: loss_fn(p, tokens, cfg))(params)
+        for g, r in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=1e-4, atol=1e-5)

@@ -85,3 +85,14 @@ class TestRingAttention:
         attn = make_ring_attention(mesh)
         with pytest.raises(Exception):  # noqa: B017 — shard_map shape error
             attn(q, k, v)
+
+
+class TestRingAtScale:
+    def test_long_sequence_256(self):
+        mesh = sp_mesh()
+        q, k, v = rand_qkv(11, b=1, h=2, s=256, d=16)
+        attn = make_ring_attention(mesh, causal=True)
+        out = attn(q, k, v)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
