@@ -779,3 +779,29 @@ class TestPdbObjects:
             kube.add_pdb({"spec": {"minAvailable": 1, "selector": {
                 "matchLabels": {"a": "b"},
                 "matchExpressions": [{"key": "a", "operator": "Exists"}]}}})
+
+
+class TestSchedulerPriorityOrder:
+    def test_high_priority_gang_binds_first_on_contended_capacity(self):
+        """The fake scheduler serves gangs in (priority, age) order, so
+        contended free capacity goes to the high-priority gang — matching
+        kube-scheduler's queue ordering."""
+        from tests.fixtures import make_slice_nodes
+
+        kube = FakeKube()
+        shape = shape_by_name("v5e-8")
+        for payload in make_slice_nodes(shape, "only"):
+            kube.add_node(payload)
+        old_low = make_tpu_pod(name="low", chips=8, shape=shape,
+                               job="low-j", created="2026-07-28T08:00:00Z")
+        new_high = make_tpu_pod(name="high", chips=8, shape=shape,
+                                job="high-j",
+                                created="2026-07-28T12:00:00Z")
+        new_high["spec"]["priority"] = 1000
+        kube.add_pod(old_low)
+        kube.add_pod(new_high)
+        kube.schedule_step()
+        assert kube.get_pod("default", "high")["status"]["phase"] == \
+            "Running"
+        assert kube.get_pod("default", "low")["status"]["phase"] == \
+            "Pending"
