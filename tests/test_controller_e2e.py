@@ -805,3 +805,17 @@ class TestSchedulerPriorityOrder:
             "Running"
         assert kube.get_pod("default", "low")["status"]["phase"] == \
             "Pending"
+
+
+class TestWallClockDefault:
+    def test_reconcile_without_injected_time(self):
+        """The production path (now=None -> wall clock) works end to end
+        against an empty cluster."""
+        kube = FakeKube()
+        controller = Controller(kube, FakeActuator(kube), ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0)))
+        controller.reconcile_once()          # wall clock
+        controller.reconcile_once()          # second pass: dt integration
+        snap = controller.metrics.snapshot()
+        assert snap["gauges"]["nodes"] == 0
+        assert snap["summaries"]["reconcile_seconds"]["count"] == 2

@@ -142,3 +142,23 @@ class TestMultiSlice:
     def test_invalid(self):
         with pytest.raises(ValueError):
             MultiSliceSpec(shape=shape_by_name("v5e-8"), num_slices=0)
+
+
+class TestCatalogDataQuality:
+    def test_machine_types_consistent_per_generation(self):
+        """Multi-host shapes of one generation share one machine type
+        (one host SKU per generation's slice pools)."""
+        for gen in ("v4", "v5p", "v5e", "v6e"):
+            machines = {s.machine_type for s in shapes_for_generation(gen)
+                        if s.multi_host}
+            assert len(machines) == 1, (gen, machines)
+
+    def test_topology_dims_positive_and_labels_unique_per_generation(self):
+        # Dims mirror GKE's real label strings (v5p-4 is "2x2x1" — NOT
+        # ascending), so only positivity and per-generation label
+        # uniqueness are invariants.
+        for s in SLICE_SHAPES.values():
+            assert all(d >= 1 for d in s.topology), s.name
+        for gen in ("v4", "v5p", "v5e", "v6e"):
+            labels = [s.topology_label for s in shapes_for_generation(gen)]
+            assert len(labels) == len(set(labels)), gen
