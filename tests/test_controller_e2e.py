@@ -819,3 +819,56 @@ class TestWallClockDefault:
         snap = controller.metrics.snapshot()
         assert snap["gauges"]["nodes"] == 0
         assert snap["summaries"]["reconcile_seconds"]["count"] == 2
+
+
+class TestNotifierIntegration:
+    def test_scale_events_reach_notifier(self):
+        class Recorder:
+            def __init__(self):
+                self.messages = []
+
+            def notify(self, message):
+                self.messages.append(message)
+
+        kube = FakeKube()
+        recorder = Recorder()
+        controller = Controller(kube, FakeActuator(kube), ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0), grace_seconds=10.0,
+            idle_threshold_seconds=30.0, drain_grace_seconds=10.0),
+            notifier=recorder)
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="jax", chips=8, shape=shape,
+                                  job="train"))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "jax"))
+        kube.delete_pod("default", "jax")
+        run_loop(kube, controller, start=10.0, until=120.0, step=5.0)
+        joined = "\n".join(recorder.messages)
+        assert "scaling up: 1x v5e-8" in joined
+        assert "draining" in joined
+        assert "deleted idle unit" in joined
+
+
+class TestRunForeverGates:
+    def test_watchless_client_runs(self):
+        """run_forever's watch gate: a client without watch_pods just
+        polls (no crash); verified by letting one interval elapse."""
+        import threading
+
+        kube = FakeKube()  # FakeKube has no watch_pods attribute
+        controller = Controller(kube, FakeActuator(kube), ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0)))
+        t = threading.Thread(
+            target=controller.run_forever,
+            kwargs={"interval_seconds": 0.05, "watch": True}, daemon=True)
+        t.start()
+        import time
+
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            if controller.metrics.snapshot()["summaries"].get(
+                    "reconcile_seconds", {}).get("count", 0) >= 2:
+                break
+            time.sleep(0.05)
+        assert controller.metrics.snapshot()["summaries"][
+            "reconcile_seconds"]["count"] >= 2
