@@ -410,3 +410,24 @@ class TestPlannerProperties:
                 if r.kind == "tpu-slice":
                     shape_by_name(r.shape_name)
                     assert r.stranded_chips >= 0
+
+    def test_tainted_cpu_node_not_packed_for_non_tolerating_pod(self):
+        """A custom-tainted CPU node is not usable capacity for a pod
+        without the toleration: a fresh node is provisioned."""
+        tainted = make_node(name="maint", taints=[
+            {"key": "maintenance", "value": "true",
+             "effect": "NoSchedule"}])
+        plan = plan_for([make_pod(name="web", requests={"cpu": "2"})],
+                        node_payloads=[tainted])
+        assert len(plan.requests) == 1
+        assert plan.requests[0].kind == "cpu-node"
+
+    def test_tolerating_pod_uses_tainted_node(self):
+        tainted = make_node(name="maint", taints=[
+            {"key": "maintenance", "value": "true",
+             "effect": "NoSchedule"}])
+        pod = make_pod(name="web", requests={"cpu": "2"},
+                       tolerations=[{"key": "maintenance",
+                                     "operator": "Exists"}])
+        plan = plan_for([pod], node_payloads=[tainted])
+        assert plan.empty
