@@ -1,0 +1,28 @@
+"""Every module in the package imports cleanly (catches syntax errors and
+missing deps in rarely-exercised modules before they reach production)."""
+
+import importlib
+import pkgutil
+
+import tpu_autoscaler
+
+
+def test_all_modules_import():
+    failures = []
+    for info in pkgutil.walk_packages(tpu_autoscaler.__path__,
+                                      prefix="tpu_autoscaler."):
+        try:
+            importlib.import_module(info.name)
+        except Exception as e:  # noqa: BLE001 — collecting all failures
+            failures.append((info.name, repr(e)))
+    assert not failures, failures
+
+
+def test_public_package_surface():
+    # The documented entry points stay importable from the top level.
+    from tpu_autoscaler.actuators import Actuator, ProvisionStatus  # noqa
+    from tpu_autoscaler.controller import Controller, ControllerConfig  # noqa
+    from tpu_autoscaler.engine import Planner, PoolPolicy  # noqa
+    from tpu_autoscaler.k8s import Gang, Node, Pod, ResourceVector  # noqa
+    from tpu_autoscaler.state import SliceState, classify_slice  # noqa
+    from tpu_autoscaler.topology import SliceShape, shape_by_name  # noqa
