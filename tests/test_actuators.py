@@ -249,3 +249,23 @@ class TestGkeHttpLevel:
             assert "/nodePools/tpuas-v5e-64-" in delete[1]
         finally:
             srv.shutdown()
+
+
+class TestInFlightView:
+    def test_only_nonterminal_statuses_are_in_flight(self):
+        from tpu_autoscaler.actuators.base import in_flight_of
+        from tpu_autoscaler.k8s.fake import FakeKube
+        from tpu_autoscaler.actuators.fake import FakeActuator
+
+        kube = FakeKube()
+        act = FakeActuator(kube, provision_delay=100.0,
+                           fail_shapes={"v5e-16"})
+        ok = act.provision(tpu_request("v5e-64"))
+        bad = act.provision(tpu_request("v5e-16"))
+        act.poll(now=1.0)  # ok -> PROVISIONING, bad -> FAILED
+        view = in_flight_of(act)
+        assert [f.shape_name for f in view] == ["v5e-64"]
+        assert view[0].gang_key == ("job", "default", "j")
+        act.poll(now=200.0)  # ok materializes -> ACTIVE
+        assert in_flight_of(act) == []
+        assert ok.state == "ACTIVE" and bad.state == "FAILED"
