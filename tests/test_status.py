@@ -125,3 +125,30 @@ class TestPlan:
         import json
 
         json.dumps(plan)
+
+
+class TestStatusEdgeCases:
+    def test_mixed_fleet_with_notready_and_cordoned(self):
+        """One render over every unit condition at once (the operator's
+        worst morning): partial slice, cordoned unit, busy CPU, pending
+        mix — no crashes, all flags present."""
+        from tests.fixtures import make_gang, make_node, make_slice_nodes
+
+        shape = shape_by_name("v5e-16")
+        nodes = make_slice_nodes(shape, "partial")
+        nodes[0]["status"]["conditions"] = [
+            {"type": "Ready", "status": "False"}]
+        nodes += make_slice_nodes(shape_by_name("v5e-8"), "cordoned",
+                                  unschedulable=True)
+        nodes += [make_node(name="busy-cpu", slice_id="busy-cpu")]
+        pods = [make_pod(name="w", owner_kind="ReplicaSet",
+                         phase="Running", node_name="busy-cpu",
+                         unschedulable=False, requests={"cpu": "1"})]
+        pods += make_gang(shape, job="waiting")
+        pods += [make_pod(name="plain", requests={"cpu": "2"})]
+        out = render_status(nodes, pods)
+        assert "READY 3/4" in out
+        assert "CORDONED 1" in out
+        assert "busy-cpu" in out and "workload_pods=1" in out
+        assert "waiting: 4 pods, 16 chips -> v5e-16 (0 stranded)" in out
+        assert "plain: 1 pods, cpu=2" in out
