@@ -106,11 +106,65 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.ndim == 3
 
-    def test_dryrun_multichip(self, capsys):
+    def test_dryrun_multichip(self, capsys, monkeypatch):
         import __graft_entry__ as g
 
+        # Hostile caller env (the round-1 failure mode): the subprocess
+        # env must override it, so this still runs on virtual CPU devices.
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("PYTHONPATH", "/root/.axon_site")
         g.dryrun_multichip(8)
         assert "OK" in capsys.readouterr().out
+
+    def test_entry_pins_cpu_when_ambient_platform_hangs(self, monkeypatch):
+        import __graft_entry__ as g
+
+        monkeypatch.setattr(g, "_ambient_platform", lambda: "axon")
+        monkeypatch.setattr(g, "_ambient_platform_initializes",
+                            lambda: False)
+        g._pin_cpu_if_ambient_hangs()
+        assert jax.config.jax_platforms == "cpu"
+
+    def test_ambient_platform_prefers_captured_config(self, monkeypatch):
+        # A later env mutation must NOT mask the platform jax captured at
+        # import time (the sitecustomize hazard this module exists for).
+        import __graft_entry__ as g
+
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        # jax is imported (conftest pinned its config to cpu): the
+        # captured config wins over the hostile env var.
+        assert g._ambient_platform() == "cpu"
+
+    def test_hermetic_env_strips_sitecustomize(self, monkeypatch):
+        import __graft_entry__ as g
+
+        monkeypatch.setenv("PYTHONPATH", "/root/.axon_site")
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("JAX_PLATFORM_NAME", "axon")
+        monkeypatch.setenv("XLA_FLAGS", "--some_stale_flag")
+        env = g._hermetic_cpu_env(8)
+        assert ".axon_site" not in env["PYTHONPATH"]
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert "JAX_PLATFORM_NAME" not in env
+        assert env["XLA_FLAGS"] == (
+            "--xla_force_host_platform_device_count=8")
+
+    def test_hermetic_subprocess_sees_virtual_cpu_devices(self, monkeypatch):
+        import subprocess
+        import sys
+
+        import __graft_entry__ as g
+
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("PYTHONPATH", "/root/.axon_site")
+        code = ("import jax; d = jax.devices(); "
+                "assert d[0].platform == 'cpu', d[0].platform; "
+                "assert len(d) == 8, len(d); print('hermetic-ok')")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=g._hermetic_cpu_env(8),
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "hermetic-ok" in proc.stdout
 
 
 class TestDownwardAnnotations:
