@@ -1,0 +1,90 @@
+"""RBAC parity: the deploy manifest must grant every verb the client uses.
+
+VERDICT r1 item 3: the round-1 manifest granted pods only list/get/delete
+while the controller also PATCHes pods (checkpoint / unsatisfiable
+annotations) and WATCHes them (pending-pod trigger) — a real cluster
+would 403. This test pins manifest ⊇ client so a new client verb cannot
+land without the matching RBAC rule.
+"""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from tpu_autoscaler.k8s.client import KubeClient, RestKubeClient
+
+MANIFEST = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "deploy", "autoscaler.yaml")
+
+# Every KubeClient method -> the (apiGroup, resource, verb) grants it
+# needs. Keep in sync with RestKubeClient's HTTP calls; the meta-test
+# below fails if a client method is missing from this map.
+METHOD_GRANTS: dict[str, set[tuple[str, str, str]]] = {
+    "list_nodes": {("", "nodes", "list")},
+    "list_pods": {("", "pods", "list")},
+    "patch_node": {("", "nodes", "patch")},
+    "patch_pod": {("", "pods", "patch")},
+    "evict_pod": {("", "pods/eviction", "create")},
+    "delete_pod": {("", "pods", "delete")},
+    "delete_node": {("", "nodes", "delete")},
+    "create_event": {("", "events", "create")},
+    "get_lease": {("coordination.k8s.io", "leases", "get")},
+    # put_lease POSTs on first acquisition, PUTs on renewal.
+    "put_lease": {("coordination.k8s.io", "leases", "create"),
+                  ("coordination.k8s.io", "leases", "update")},
+    # ?watch=1 on the pod list endpoint requires the watch verb.
+    "watch_pods": {("", "pods", "watch")},
+}
+
+
+def manifest_grants() -> set[tuple[str, str, str]]:
+    with open(MANIFEST) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    grants: set[tuple[str, str, str]] = set()
+    for doc in docs:
+        if doc.get("kind") != "ClusterRole":
+            continue
+        for rule in doc.get("rules", []):
+            for group in rule.get("apiGroups", []):
+                for resource in rule.get("resources", []):
+                    for verb in rule.get("verbs", []):
+                        grants.add((group, resource, verb))
+    return grants
+
+
+class TestRbacParity:
+    def test_manifest_covers_every_client_verb(self):
+        granted = manifest_grants()
+        missing = {
+            (method, grant)
+            for method, needs in METHOD_GRANTS.items()
+            for grant in needs if grant not in granted
+        }
+        assert not missing, (
+            f"deploy/autoscaler.yaml is missing RBAC grants: {missing}")
+
+    def test_every_client_method_has_declared_grants(self):
+        # A new KubeClient/RestKubeClient verb must declare its grants
+        # here (and thereby get checked against the manifest).
+        exempt = {"from_kubeconfig", "in_cluster"}  # constructors
+        methods = {
+            name for cls in (KubeClient, RestKubeClient)
+            for name in vars(cls)
+            if not name.startswith("_") and callable(getattr(cls, name, None))
+        } - exempt
+        undeclared = methods - set(METHOD_GRANTS)
+        assert not undeclared, (
+            f"client methods with no RBAC declaration: {undeclared}")
+
+    def test_manifest_parses_and_binds_the_role(self):
+        with open(MANIFEST) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        kinds = [d.get("kind") for d in docs]
+        assert "ClusterRole" in kinds and "ClusterRoleBinding" in kinds
+        binding = next(d for d in docs if d["kind"] == "ClusterRoleBinding")
+        role = next(d for d in docs if d["kind"] == "ClusterRole")
+        sa = next(d for d in docs if d["kind"] == "ServiceAccount")
+        assert binding["roleRef"]["name"] == role["metadata"]["name"]
+        assert binding["subjects"][0]["name"] == sa["metadata"]["name"]
