@@ -1,0 +1,286 @@
+"""Bounded real-TPU benchmark harness (SURVEY §7; VERDICT r1 item 2).
+
+Measures, on the single real TPU chip behind this image's ``axon`` relay:
+
+- flagship-model training step time + tokens/s + estimated MFU
+  (``tpu_autoscaler.workloads.model``, bf16, lax.scan blocks);
+- Pallas fused flash-attention vs reference einsum attention, forward
+  and forward+backward wall time (``tpu_autoscaler.workloads.attention``).
+
+The axon relay is known to hang on backend init for minutes-to-forever,
+so the harness is structured to be UNABLE to hang the caller:
+
+- this parent process never imports jax;
+- backend init is probed in a throwaway subprocess with a hard timeout;
+- each measurement runs in its own subprocess with a hard timeout;
+- the result file is written either way — real numbers, or an explicit
+  ``{"skipped": <reason>}`` record per phase — and the process exits 0
+  so driver pipelines never wedge on it.
+
+Usage:
+    python bench_tpu.py                 # probe + measure on the TPU
+    python bench_tpu.py --cpu-smoke     # same harness on 1 virtual CPU
+                                        # device (validates the plumbing)
+
+Output: one JSON line on stdout; full record in BENCH_TPU.json
+(or --out).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_OUT = os.path.join(REPO, "BENCH_TPU.json")
+
+# Peak dense bf16 FLOP/s per chip, by device_kind substring (public
+# Cloud TPU spec sheet numbers). Used only for the MFU estimate.
+_PEAK_FLOPS = (
+    ("v6", 918e12),      # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e reports as "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v4", 275e12),
+)
+
+
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+# --------------------------------------------------------------------------
+# Subprocess plumbing (parent side; no jax here)
+# --------------------------------------------------------------------------
+
+
+def _cpu_env() -> dict[str, str]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO  # drop sitecustomize (.axon_site)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("JAX_PLATFORM_NAME", None)
+    return env
+
+
+def _tpu_env() -> dict[str, str]:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "axon")
+    return env
+
+
+def _run_bounded(argv: list[str], env: dict[str, str],
+                 timeout_s: float) -> dict:
+    """Run argv; return {ok, rc|timeout, json|tail, seconds}."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable] + argv, env=env, cwd=REPO,
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "seconds": round(time.monotonic() - t0, 1),
+                "skipped": f"timeout after {timeout_s:.0f}s"}
+    seconds = round(time.monotonic() - t0, 1)
+    if proc.returncode != 0:
+        return {"ok": False, "seconds": seconds,
+                "skipped": f"rc={proc.returncode}",
+                "stderr_tail": proc.stderr[-1000:]}
+    # Last stdout line is the impl's JSON payload.
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        payload = json.loads(line)
+    except ValueError:
+        return {"ok": False, "seconds": seconds,
+                "skipped": "no JSON on impl stdout",
+                "stdout_tail": proc.stdout[-500:]}
+    payload.update({"ok": True, "seconds": seconds})
+    return payload
+
+
+# --------------------------------------------------------------------------
+# Impl side (runs in the bounded subprocess; jax allowed here)
+# --------------------------------------------------------------------------
+
+
+def _impl_probe() -> None:
+    import jax
+
+    d = jax.devices()[0]
+    print(json.dumps({"platform": d.platform,
+                      "device_kind": d.device_kind,
+                      "n_devices": len(jax.devices())}))
+
+
+def _impl_step(small: bool) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_autoscaler.workloads.model import (
+        ModelConfig,
+        make_mesh,
+        make_sharded_train_step,
+    )
+
+    if small:
+        cfg = ModelConfig(seq_len=64, d_model=64, n_layers=2, n_heads=2,
+                          d_ff=128)
+        batch_size, iters = 2, 3
+    else:
+        cfg = ModelConfig(vocab=32768, d_model=1024, n_layers=8,
+                          n_heads=16, d_ff=4096, seq_len=1024)
+        batch_size, iters = 8, 10
+
+    dev = jax.devices()[0]
+    mesh = make_mesh([dev])
+    init_fn, step_fn = make_sharded_train_step(mesh, cfg)
+    params, opt_state = init_fn(jax.random.PRNGKey(0))
+    batch = jax.random.randint(jax.random.PRNGKey(1),
+                               (batch_size, cfg.seq_len + 1), 0, cfg.vocab,
+                               dtype=jnp.int32)
+    # Warmup (compile) then timed steps.
+    for _ in range(2):
+        params, opt_state, loss = step_fn(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step_fn(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    step_s = (time.perf_counter() - t0) / iters
+
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    tokens = batch_size * cfg.seq_len
+    # 6ND matmul flops (fwd+bwd) + attention score/context flops.
+    flops = (6.0 * n_params * tokens
+             + 12.0 * cfg.n_layers * batch_size
+             * cfg.seq_len ** 2 * cfg.d_model)
+    peak = _peak_flops(dev.device_kind)
+    mfu = flops / (step_s * peak) if peak else None
+    print(json.dumps({
+        "device_kind": dev.device_kind,
+        "n_params": n_params,
+        "step_seconds": round(step_s, 5),
+        "tokens_per_second": round(tokens / step_s, 1),
+        "flops_per_step": flops,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "loss": float(loss),
+    }))
+
+
+def _impl_attn(small: bool) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_autoscaler.workloads.attention import (
+        flash_attention,
+        reference_attention,
+    )
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if small:
+        b, h, s, d, iters = 1, 2, 128, 32, 2
+        dtype = jnp.float32
+    else:
+        b, h, s, d, iters = 4, 8, 2048, 128, 10
+        dtype = jnp.bfloat16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), dtype) for kk in ks)
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=on_cpu)
+
+    def ref(q, k, v):
+        return reference_attention(q, k, v, causal=True)
+
+    def timed(fn):
+        f = jax.jit(fn)
+        jax.block_until_ready(f(q, k, v))  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    def grad_of(fn):
+        return jax.grad(lambda q, k, v: fn(q, k, v).sum(), argnums=(0, 1, 2))
+
+    fwd_flash, fwd_ref = timed(flash), timed(ref)
+    bwd_flash, bwd_ref = timed(grad_of(flash)), timed(grad_of(ref))
+    print(json.dumps({
+        "shape": [b, h, s, d],
+        "dtype": str(dtype.__name__ if hasattr(dtype, "__name__")
+                     else dtype),
+        "interpret_mode": on_cpu,
+        "fwd_pallas_seconds": round(fwd_flash, 6),
+        "fwd_einsum_seconds": round(fwd_ref, 6),
+        "fwd_speedup": round(fwd_ref / fwd_flash, 3),
+        "bwd_pallas_seconds": round(bwd_flash, 6),
+        "bwd_einsum_seconds": round(bwd_ref, 6),
+        "bwd_speedup": round(bwd_ref / bwd_flash, 3),
+    }))
+
+
+# --------------------------------------------------------------------------
+# Harness
+# --------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cpu-smoke", action="store_true",
+                    help="run the same harness on 1 virtual CPU device")
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--measure-timeout", type=float, default=900.0)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--impl", choices=["probe", "step", "attn"],
+                    help=argparse.SUPPRESS)  # internal subprocess entry
+    ap.add_argument("--small", action="store_true",
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.impl:
+        {"probe": _impl_probe,
+         "step": lambda: _impl_step(args.small),
+         "attn": lambda: _impl_attn(args.small)}[args.impl]()
+        return 0
+
+    env = _cpu_env() if args.cpu_smoke else _tpu_env()
+    small = args.cpu_smoke
+    record: dict = {
+        "mode": "cpu-smoke" if args.cpu_smoke else "tpu",
+        "probe_timeout_s": args.probe_timeout,
+        "measure_timeout_s": args.measure_timeout,
+    }
+
+    me = os.path.join(REPO, "bench_tpu.py")
+    record["probe"] = _run_bounded([me, "--impl", "probe"], env,
+                                   args.probe_timeout)
+    if record["probe"].get("ok"):
+        extra = ["--small"] if small else []
+        record["train_step"] = _run_bounded(
+            [me, "--impl", "step"] + extra, env, args.measure_timeout)
+        record["attention"] = _run_bounded(
+            [me, "--impl", "attn"] + extra, env, args.measure_timeout)
+    else:
+        reason = record["probe"].get("skipped", "probe failed")
+        record["train_step"] = {"ok": False,
+                                "skipped": f"backend probe: {reason}"}
+        record["attention"] = {"ok": False,
+                               "skipped": f"backend probe: {reason}"}
+
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
