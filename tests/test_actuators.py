@@ -122,6 +122,71 @@ class TestGkeActuator:
         assert status.state == FAILED
         assert "403" in status.error
 
+    def test_partial_cpu_provision_rolls_back_created_pools(self):
+        # ADVICE r1: a mid-loop POST failure must delete the pools this
+        # request already created — FAILED is terminal, so nothing else
+        # would reclaim them before the idle timeout.
+        class BoomAfterOne(FakeRest):
+            def __init__(self):
+                super().__init__()
+                self.posts = 0
+
+            def post(self, url, body):
+                self.posts += 1
+                if self.posts >= 2:
+                    raise RuntimeError("429 quota")
+                return super().post(url, body)
+
+        rest = BoomAfterOne()
+        act, _ = self.make(rest)
+        status = act.provision(ProvisionRequest(
+            kind="cpu-node", shape_name="e2-standard-8", count=3))
+        assert status.state == FAILED
+        created_name = [c for c in rest.calls
+                        if c[0] == "POST"][0][2]["nodePool"]["name"]
+        # Rollback is deferred to poll(): GKE rejects a delete while the
+        # pool's create operation is still running.
+        assert not [c for c in rest.calls if c[0] == "DELETE"]
+        act.poll(now=1.0)
+        deletes = [c for c in rest.calls if c[0] == "DELETE"]
+        assert len(deletes) == 1
+        assert deletes[0][1].endswith(f"/nodePools/{created_name}")
+        # Accepted: no further delete attempts on later polls.
+        act.poll(now=2.0)
+        assert len([c for c in rest.calls if c[0] == "DELETE"]) == 1
+
+    def test_rollback_retries_until_delete_accepted(self):
+        class BoomRest(FakeRest):
+            def __init__(self):
+                super().__init__()
+                self.posts = 0
+                self.delete_fails = 2  # create op "in progress" twice
+
+            def post(self, url, body):
+                self.posts += 1
+                if self.posts >= 2:
+                    raise RuntimeError("429 quota")
+                return super().post(url, body)
+
+            def delete(self, url):
+                if self.delete_fails > 0:
+                    self.delete_fails -= 1
+                    self.calls.append(("DELETE-REJECTED", url, None))
+                    raise RuntimeError("FAILED_PRECONDITION: op in progress")
+                return super().delete(url)
+
+        rest = BoomRest()
+        act, _ = self.make(rest)
+        act.provision(ProvisionRequest(
+            kind="cpu-node", shape_name="e2-standard-8", count=2))
+        act.poll(now=1.0)
+        act.poll(now=2.0)
+        assert not [c for c in rest.calls if c[0] == "DELETE"]
+        act.poll(now=3.0)  # create op done; delete finally accepted
+        assert len([c for c in rest.calls if c[0] == "DELETE"]) == 1
+        act.poll(now=4.0)  # and not retried after success
+        assert len([c for c in rest.calls if c[0] == "DELETE"]) == 1
+
     def test_delete_targets_named_pool(self):
         act, rest = self.make()
         act.delete("tpuas-v5e-64-7")

@@ -505,6 +505,35 @@ class TestDrainCancellation:
         assert "autoscaler.tpu.dev/draining" not in \
             node["metadata"].get("annotations", {})
 
+    def test_cpu_idle_drain_cancelled_when_demand_returns(self):
+        """CPU analog (ADVICE r1): the claim check must see cordoned
+        nodes, else a draining CPU node is deleted and identical
+        capacity immediately re-provisioned."""
+        kube, actuator, controller = make_harness()
+        kube.add_pod(make_pod(name="web", requests={"cpu": "2"}))
+        run_loop(kube, controller,
+                 stop_when=lambda: pod_running(kube, "web"))
+        kube.delete_pod("default", "web")
+        t = 10.0
+        while t < 10.0 + IDLE + 60.0:
+            controller.reconcile_once(now=t)
+            t += 5.0
+            if any(n["spec"].get("unschedulable")
+                   for n in kube.list_nodes()):
+                break
+        assert any(n["spec"].get("unschedulable")
+                   for n in kube.list_nodes())
+        # Matching CPU demand arrives while the node is cordoned.
+        kube.add_pod(make_pod(name="web-2", requests={"cpu": "2"}))
+        t += 5.0
+        run_loop(kube, controller, start=t, until=t + 120.0,
+                 stop_when=lambda: pod_running(kube, "web-2"))
+        assert pod_running(kube, "web-2")
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["drains_cancelled"] == 1
+        assert snap["counters"].get("units_deleted", 0) == 0
+        assert snap["counters"]["provisions_submitted"] == 1  # reused!
+
     def test_requested_drain_never_cancelled(self):
         """Spot reclamation drains must proceed even if demand appears."""
         kube, actuator, controller = make_harness()

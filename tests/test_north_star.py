@@ -278,6 +278,36 @@ class TestPriorityPreemption:
         assert not pod_running(kube, "low-2")
         assert pod_running(kube, "high")  # never preempted by equal/lower
 
+    def test_preemption_accounts_inflight_chips(self):
+        """ADVICE r1: the planner's clamp counts in-flight slices, so the
+        preemption overshoot must too — otherwise with a provision in
+        flight `need` computes <= 0 and no room is ever made."""
+        kube = FakeKube()
+        actuator = FakeActuator(kube, provision_delay=80.0)
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0, max_total_chips=16),
+            grace_seconds=30.0, idle_threshold_seconds=IDLE,
+            drain_grace_seconds=20.0, enable_preemption=True))
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="low", chips=8, shape=shape,
+                                  job="low-job"))
+        t = run_loop(kube, controller, until=300.0,
+                     stop_when=lambda: pod_running(kube, "low"))
+        assert pod_running(kube, "low")
+        # Second job's provision stays in flight (80 s delay).
+        kube.add_pod(make_tpu_pod(name="mid", chips=8, shape=shape,
+                                  job="mid-job"))
+        controller.reconcile_once(now=t + 1.0)
+        assert any(s.in_flight for s in actuator.statuses())
+        # High-priority gang: 8 existing + 8 in flight + 8 demand > 16.
+        high = make_tpu_pod(name="high", chips=8, shape=shape,
+                            job="high-job")
+        high["spec"]["priority"] = 1000
+        kube.add_pod(high)
+        controller.reconcile_once(now=t + 2.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("preemptions", 0) == 1
+
     def test_no_preemption_for_equal_priority(self):
         kube, actuator, controller = self.harness()
         shape = shape_by_name("v5e-8")

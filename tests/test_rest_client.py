@@ -126,3 +126,17 @@ class TestRestKubeClient:
         mutations = [(m, p) for m, p, _, _ in ApiServerStub.requests_log
                      if m != "GET"]
         assert mutations == []
+
+    def test_dry_run_suppresses_lease_writes(self, server):
+        # ADVICE r1 (medium): a --dry-run --leader-elect process must not
+        # write the real Lease and steal leadership from production.
+        c = RestKubeClient(base_url=server, token="tok", ca_cert=False,
+                           dry_run=True)
+        c.put_lease("kube-system", "tpu-autoscaler",
+                    {"metadata": {"name": "tpu-autoscaler"}})
+        c.put_lease("kube-system", "tpu-autoscaler",
+                    {"metadata": {"name": "tpu-autoscaler",
+                                  "resourceVersion": "5"}})
+        writes = [(m, p) for m, p, _, _ in ApiServerStub.requests_log
+                  if m in ("POST", "PUT")]
+        assert writes == []

@@ -64,7 +64,16 @@ class GkeNodePoolActuator:
         self._operations: dict[str, list[str]] = {}  # provision id -> ops
         self._pools: dict[str, list[str]] = {}       # provision id -> pools
         self._done_at: dict[str, float] = {}
+        # Pools created by a provision() that then failed mid-loop, still
+        # awaiting rollback delete (retried from poll(): GKE rejects
+        # mutations on a pool whose create operation is in progress, so
+        # an immediate delete would itself fail in exactly the partial-
+        # failure scenarios rollback exists for).
+        self._rollbacks: dict[str, list[str]] = {}
+        self._rollback_attempts: dict[str, int] = {}
         self._ids = itertools.count(int(time.time()) % 100000)
+
+    ROLLBACK_MAX_ATTEMPTS = 40
 
     # ---- request -> GKE node pool spec ---------------------------------
 
@@ -123,18 +132,57 @@ class GkeNodePoolActuator:
         self._statuses[status.id] = status
         self._pools[status.id] = pool_names
         ops: list[str] = []
+        created: list[str] = []
         try:
             for pool_name in pool_names:
                 op = self._rest.post(f"{self._api_base}/{self._parent}/nodePools",
                                      self._pool_body(request, pool_name))
+                created.append(pool_name)
                 if op.get("name"):
                     ops.append(op["name"])
         except Exception as e:  # noqa: BLE001 — surface as FAILED status
             status.state = FAILED
             status.error = str(e)
             log.exception("node pool create failed for %s", status.id)
+            # Queue rollback of pools already created in this request: a
+            # FAILED status is terminal (cancel() only covers in-flight
+            # states), so without this the partial pools would register
+            # nodes that only idle-timeout reclaims — billed, unused
+            # capacity — while the retry provisions a fresh full set.
+            # Deletion happens from poll(), retried until GKE accepts it
+            # (the create operation must finish first).
+            if created:
+                self._rollbacks[status.id] = list(created)
         self._operations[status.id] = ops
         return status
+
+    def _process_rollbacks(self) -> None:
+        """Retry deletes of partially-created pools until GKE accepts
+        them (or attempts run out and idle timeout becomes the backstop)."""
+        for pid, pools in list(self._rollbacks.items()):
+            attempts = self._rollback_attempts.get(pid, 0) + 1
+            self._rollback_attempts[pid] = attempts
+            remaining: list[str] = []
+            for pool_name in pools:
+                try:
+                    self._rest.delete(
+                        f"{self._api_base}/{self._parent}"
+                        f"/nodePools/{pool_name}")
+                except Exception:  # noqa: BLE001 — create op still running
+                    log.debug("rollback delete not yet accepted for %s",
+                              pool_name, exc_info=True)
+                    remaining.append(pool_name)
+            if not remaining:
+                self._rollbacks.pop(pid, None)
+                self._rollback_attempts.pop(pid, None)
+            elif attempts >= self.ROLLBACK_MAX_ATTEMPTS:
+                log.error(
+                    "giving up rollback for %s after %d attempts; pools %s "
+                    "will be reclaimed by idle timeout", pid, attempts,
+                    remaining)
+                self._rollbacks.pop(pid, None)
+            else:
+                self._rollbacks[pid] = remaining
 
     def delete(self, unit_id: str) -> None:
         try:
@@ -143,6 +191,7 @@ class GkeNodePoolActuator:
             log.exception("node pool delete failed for %s", unit_id)
 
     def poll(self, now: float) -> None:
+        self._process_rollbacks()
         for pid, status in self._statuses.items():
             if status.state not in (ACCEPTED, PROVISIONING):
                 continue

@@ -233,7 +233,7 @@ class Controller:
         if watch and hasattr(self.client, "watch_pods"):
             from tpu_autoscaler.controller.watch import WatchTrigger
 
-            WatchTrigger(self.client, wake).start()
+            WatchTrigger(self.client, wake, metrics=self.metrics).start()
         while True:
             try:
                 if leader_lock is not None and not leader_lock.try_acquire(
@@ -372,6 +372,16 @@ class Controller:
 
         existing_chips = sum(unit_chips(ns) for ns in units.values()
                              if ns[0].is_tpu)
+        # The planner's max_total_chips check counts in-flight slices as
+        # supply, so the overshoot must too — otherwise with provisions
+        # in flight preemption frees too few chips and the gang stays
+        # clamp-blocked through repeated victim rounds.
+        from tpu_autoscaler.actuators.base import in_flight_of
+        from tpu_autoscaler.topology.catalog import shape_by_name
+
+        inflight_chips = sum(
+            shape_by_name(f.shape_name).chips
+            for f in in_flight_of(self.actuator) if f.kind == "tpu-slice")
         # Chips already on their way out (drains in progress) free up
         # without new victims — credit them before choosing more.
         draining_ids = (set(self._drain_started)
@@ -390,9 +400,10 @@ class Controller:
             except FitError:
                 continue  # not actually clamp-only blocked
             # Free exactly the overshoot, not the gang's whole demand:
-            # existing - freed - draining + demand <= max_total_chips.
-            need = (existing_chips - draining_chips + demand_chips
-                    - self.config.policy.max_total_chips)
+            # existing + in-flight - freed - draining + demand
+            #   <= max_total_chips.
+            need = (existing_chips + inflight_chips - draining_chips
+                    + demand_chips - self.config.policy.max_total_chips)
             if need <= 0:
                 handled.add(gang.key)  # in-progress drains already suffice
                 continue
@@ -604,7 +615,13 @@ class Controller:
                 if any(_slice_satisfies(unit_nodes, g) for g in tpu_gangs):
                     claimed.add(unit_id)
             else:
-                free = free_capacity(unit_nodes, pods)
+                # Count cordoned nodes: a DRAINING unit's nodes are
+                # unschedulable by construction, and the whole point of
+                # the claim check is to cancel that drain when pending
+                # demand fits it (mirrors _slice_satisfies, which also
+                # ignores the cordon flag for TPU units).
+                free = free_capacity(unit_nodes, pods,
+                                     include_unschedulable=True)
                 if any(node.admits(p) and p.resources.fits_in(cap)
                        for p in cpu_pods
                        for node in unit_nodes

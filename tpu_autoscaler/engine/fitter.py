@@ -139,12 +139,19 @@ def choose_shape_for_gang(gang: Gang,
         f"{last_problem or f'largest is {shapes_for_generation(gen)[-1].chips} chips'}")
 
 
-def free_capacity(nodes: list[Node], pods: list[Pod]) -> dict[str, ResourceVector]:
+def free_capacity(nodes: list[Node], pods: list[Pod],
+                  include_unschedulable: bool = False,
+                  ) -> dict[str, ResourceVector]:
     """Free allocatable per schedulable Ready node (allocatable - requests).
 
     The baseline the fit engine subtracts existing supply with, mirroring how
     the reference computed pool `actual_capacity` from live nodes
     (agent_pool.py §AgentPool).
+
+    ``include_unschedulable=True`` counts cordoned nodes too — used when
+    deciding whether pending demand could claim a DRAINING unit (whose
+    nodes are cordoned by construction) so the drain can be cancelled
+    instead of deleting capacity the demand is about to need.
     """
     used: dict[str, ResourceVector] = {}
     for pod in pods:
@@ -153,7 +160,8 @@ def free_capacity(nodes: list[Node], pods: list[Pod]) -> dict[str, ResourceVecto
                                            ResourceVector()) + pod.resources
     free: dict[str, ResourceVector] = {}
     for node in nodes:
-        if node.is_ready and not node.unschedulable:
+        if node.is_ready and (include_unschedulable
+                              or not node.unschedulable):
             free[node.name] = node.allocatable - used.get(node.name,
                                                           ResourceVector())
     return free

@@ -198,12 +198,16 @@ class RestKubeClient:
         return r.json()
 
     def put_lease(self, namespace: str, name: str, body: dict) -> None:
+        # Lease writes honor dry-run like every other mutation: a
+        # --dry-run process must never acquire (steal) the production
+        # leader Lease and halt real scaling.
+        if self._dry_run:
+            log.info("[dry-run] put lease %s/%s", namespace, name)
+            return
         base = (f"{self._base}/apis/coordination.k8s.io/v1/namespaces/"
                 f"{namespace}/leases")
         exists = "resourceVersion" in body.get("metadata", {})
         import json as _json
-
-        import requests  # noqa: F401 — session types
 
         r = self._session.request(
             "PUT" if exists else "POST",
@@ -212,20 +216,28 @@ class RestKubeClient:
             headers={"Content-Type": "application/json"}, timeout=10)
         r.raise_for_status()
 
-    def watch_pods(self, timeout_seconds: int = 60):
+    def watch_pods(self, timeout_seconds: int = 60,
+                   resource_version: str | None = None):
         """Yield pod watch events (dicts) until the server closes the watch.
 
         Level-trigger upgrade over the reference's poll-sleep loop
         (main.py --sleep): the controller wakes the moment a pod changes
         instead of up to one poll period later.  Used via
         ``tpu_autoscaler.controller.watch.WatchTrigger``.
+
+        ``resource_version`` resumes from a prior watch's cursor instead
+        of replaying the world; bookmarks are requested so the cursor
+        stays fresh across quiet periods.
         """
         import json as _json
 
-        r = self._session.get(
-            f"{self._base}/api/v1/pods"
-            f"?watch=1&timeoutSeconds={timeout_seconds}",
-            stream=True, timeout=timeout_seconds + 10)
+        url = (f"{self._base}/api/v1/pods"
+               f"?watch=1&timeoutSeconds={timeout_seconds}"
+               f"&allowWatchBookmarks=true")
+        if resource_version:
+            url += f"&resourceVersion={resource_version}"
+        r = self._session.get(url, stream=True,
+                              timeout=timeout_seconds + 10)
         r.raise_for_status()
         for line in r.iter_lines():
             if line:
