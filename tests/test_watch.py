@@ -1,18 +1,31 @@
-"""Watch-trigger tests: level-triggered detection (controller/watch.py)."""
+"""Watch-trigger tests: level-triggered detection (controller/watch.py).
 
+VERDICT r1 item 6 coverage: failure backoff + jitter, resourceVersion
+resume across reconnects, 410 reset, watch_failures metric, and
+bookmark/irrelevant events not waking the reconciler.
+"""
+
+import random
 import threading
 import time
 
-from tpu_autoscaler.controller.watch import WatchTrigger
+from tpu_autoscaler.controller.watch import (
+    BACKOFF_BASE_S,
+    BACKOFF_CAP_S,
+    WatchTrigger,
+)
+from tpu_autoscaler.metrics.metrics import Metrics
 
 
 class FakeWatchClient:
     def __init__(self, batches):
         self._batches = list(batches)
         self.calls = 0
+        self.resource_versions = []
 
-    def watch_pods(self, timeout_seconds=60):
+    def watch_pods(self, timeout_seconds=60, resource_version=None):
         self.calls += 1
+        self.resource_versions.append(resource_version)
         if not self._batches:
             time.sleep(0.05)
             return
@@ -20,6 +33,36 @@ class FakeWatchClient:
         if batch == "error":
             raise ConnectionError("watch dropped")
         yield from batch
+
+
+class NoRvWatchClient(FakeWatchClient):
+    """A KubeClient predating the resource_version kwarg: passing it must
+    TypeError at call time (argument binding), like a real signature."""
+
+    def watch_pods(self, timeout_seconds=60):  # noqa: D102
+        return super().watch_pods(timeout_seconds)
+
+
+def ev(etype, rv=None, code=None):
+    obj = {}
+    if rv is not None:
+        obj["metadata"] = {"resourceVersion": rv}
+    if code is not None:
+        obj["code"] = code
+    return {"type": etype, "object": obj}
+
+
+class _InstantRng(random.Random):
+    """uniform() returns the ceiling: deterministic, and lets tests
+    assert on the computed backoff bound."""
+
+    def __init__(self):
+        super().__init__(0)
+        self.ceilings = []
+
+    def uniform(self, a, b):
+        self.ceilings.append(b)
+        return 0.0  # no waiting in tests
 
 
 class TestWatchTrigger:
@@ -33,7 +76,7 @@ class TestWatchTrigger:
 
     def test_event_wakes_loop(self):
         wake = threading.Event()
-        client = FakeWatchClient([[{"type": "ADDED"}]])
+        client = FakeWatchClient([[ev("ADDED")]])
         t = WatchTrigger(client, wake)
         t.start()
         assert self.wait_for(wake.is_set)
@@ -41,13 +84,10 @@ class TestWatchTrigger:
 
     def test_watch_error_degrades_not_crashes(self):
         wake = threading.Event()
-        client = FakeWatchClient(["error", [{"type": "MODIFIED"}]])
-        t = WatchTrigger(client, wake)
+        client = FakeWatchClient(["error", [ev("MODIFIED")]])
+        t = WatchTrigger(client, wake, rng=_InstantRng())
         t.start()
-        # Survives the dropped watch... but the retry backoff is 5s; don't
-        # wait for it — just confirm the thread is alive after the error.
-        assert self.wait_for(lambda: client.calls >= 1)
-        time.sleep(0.1)
+        assert self.wait_for(wake.is_set)  # recovered after the error
         assert t.is_alive()
         t.stop()
 
@@ -59,3 +99,105 @@ class TestWatchTrigger:
         t.join(timeout=2.0)
         # Thread may be sleeping in its final empty poll; alive() False soon.
         assert self.wait_for(lambda: not t.is_alive(), timeout=3.0)
+
+    # -- hardening ---------------------------------------------------------
+
+    def test_failures_counted_and_backoff_grows(self):
+        wake = threading.Event()
+        metrics = Metrics()
+        rng = _InstantRng()
+        client = FakeWatchClient(["error", "error", "error",
+                                  [ev("ADDED")]])
+        t = WatchTrigger(client, wake, metrics=metrics, rng=rng)
+        t.start()
+        assert self.wait_for(wake.is_set)
+        t.stop()
+        assert metrics.snapshot()["counters"]["watch_failures"] == 3
+        # Exponential ceilings: base, 2*base, 4*base (full jitter).
+        assert rng.ceilings[:3] == [BACKOFF_BASE_S, 2 * BACKOFF_BASE_S,
+                                    4 * BACKOFF_BASE_S]
+
+    def test_backoff_capped(self):
+        rng = _InstantRng()
+        t = WatchTrigger(FakeWatchClient([]), threading.Event(), rng=rng)
+        t._failure_streak = 50
+        t._backoff_seconds()
+        # The jitter CEILING must be capped (2^49s otherwise) — assert on
+        # what was actually passed to uniform(), not its return value.
+        assert rng.ceilings == [BACKOFF_CAP_S]
+
+    def test_resource_version_resumes_across_reconnects(self):
+        wake = threading.Event()
+        client = FakeWatchClient([
+            [ev("ADDED", rv="100"), ev("MODIFIED", rv="101")],
+            [ev("MODIFIED", rv="102")],
+        ])
+        t = WatchTrigger(client, wake, rng=_InstantRng())
+        t.start()
+        assert self.wait_for(lambda: client.calls >= 3)
+        t.stop()
+        # First watch starts cold; reconnects resume from the cursor.
+        assert client.resource_versions[0] is None
+        assert client.resource_versions[1] == "101"
+        assert client.resource_versions[2] == "102"
+
+    def test_bookmark_updates_cursor_without_waking(self):
+        wake = threading.Event()
+        client = FakeWatchClient([[ev("BOOKMARK", rv="500")]])
+        t = WatchTrigger(client, wake, rng=_InstantRng())
+        t.start()
+        assert self.wait_for(lambda: client.calls >= 2)
+        t.stop()
+        assert not wake.is_set()
+        assert client.resource_versions[1] == "500"
+
+    def test_410_gone_resets_cursor(self):
+        wake = threading.Event()
+        client = FakeWatchClient([
+            [ev("ADDED", rv="100")],
+            [ev("ERROR", code=410)],
+            [ev("ADDED", rv="200")],
+        ])
+        metrics = Metrics()
+        t = WatchTrigger(client, wake, metrics=metrics, rng=_InstantRng())
+        t.start()
+        assert self.wait_for(lambda: client.calls >= 3)
+        t.stop()
+        assert client.resource_versions[1] == "100"  # resumed
+        assert client.resource_versions[2] is None   # reset after 410
+
+    def test_error_event_counts_as_failure(self):
+        wake = threading.Event()
+        metrics = Metrics()
+        client = FakeWatchClient([[ev("ERROR", code=410)]])
+        t = WatchTrigger(client, wake, metrics=metrics, rng=_InstantRng())
+        t.start()
+        assert self.wait_for(
+            lambda: metrics.snapshot()["counters"].get("watch_failures",
+                                                       0) >= 1)
+        t.stop()
+        assert not wake.is_set()
+
+    def test_client_without_resource_version_kwarg_still_works(self):
+        wake = threading.Event()
+        client = NoRvWatchClient([[ev("ADDED", rv="1")]])
+        t = WatchTrigger(client, wake, rng=_InstantRng())
+        t.start()
+        assert self.wait_for(wake.is_set)
+        t.stop()
+
+    def test_warning_only_on_first_failure_of_streak(self, caplog):
+        import logging
+
+        wake = threading.Event()
+        client = FakeWatchClient(["error", "error", "error",
+                                  [ev("ADDED")]])
+        t = WatchTrigger(client, wake, rng=_InstantRng())
+        with caplog.at_level(logging.DEBUG,
+                             logger="tpu_autoscaler.controller.watch"):
+            t.start()
+            assert self.wait_for(wake.is_set)
+            t.stop()
+        warnings = [r for r in caplog.records
+                    if r.levelno == logging.WARNING]
+        assert len(warnings) == 1

@@ -3,40 +3,109 @@
 The reference's detection latency was bounded by its poll period
 (main.py --sleep, default ~60 s; SURVEY.md §7).  Here a background thread
 holds a pod watch open against the apiserver and pokes an Event whenever
-anything changes; the loop sleeps on that Event with the poll interval as a
-*fallback*, so detection is near-instant when the watch is healthy and no
-worse than the reference when it is not (crash-only: watch errors just mean
-we fall back to polling until the watch re-establishes).
+a pod actually changes; the loop sleeps on that Event with the poll
+interval as a *fallback*, so detection is near-instant when the watch is
+healthy and no worse than the reference when it is not (crash-only: watch
+errors just mean we fall back to polling until the watch re-establishes).
+
+Hardening (VERDICT r1 item 6):
+
+- reconnects resume from the last seen ``resourceVersion`` (with
+  bookmarks requested to keep it fresh) instead of re-listing the world;
+  a 410 Gone resets it and the next watch starts from "now";
+- failures back off exponentially with jitter (base 1 s, cap 60 s) and
+  are counted in the ``watch_failures`` metric; only the first failure
+  of a streak logs at WARNING — the rest at DEBUG, so a flapping
+  apiserver cannot spam one warning per retry;
+- only ADDED/MODIFIED/DELETED events set the wake flag: BOOKMARK and
+  ERROR events carry no reconcile-relevant state change.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import threading
 
 log = logging.getLogger(__name__)
 
+BACKOFF_BASE_S = 1.0
+BACKOFF_CAP_S = 60.0
+
+_RELEVANT_TYPES = frozenset({"ADDED", "MODIFIED", "DELETED"})
+
 
 class WatchTrigger(threading.Thread):
     def __init__(self, client, wake: threading.Event,
-                 timeout_seconds: int = 60):
+                 timeout_seconds: int = 60, metrics=None,
+                 rng: random.Random | None = None):
         super().__init__(daemon=True, name="pod-watch")
         self._client = client
         self._wake = wake
         self._timeout = timeout_seconds
         self._stopped = threading.Event()
+        self._metrics = metrics
+        self._rng = rng or random.Random()
+        self._resource_version: str | None = None
+        self._failure_streak = 0
 
     def stop(self) -> None:
         self._stopped.set()
 
+    # -- internals, factored for testability -----------------------------
+
+    def _backoff_seconds(self) -> float:
+        """Exponential with full jitter: uniform(0, min(cap, base*2^n))."""
+        ceiling = min(BACKOFF_CAP_S,
+                      BACKOFF_BASE_S * (2 ** max(0, self._failure_streak - 1)))
+        return self._rng.uniform(0.0, ceiling)
+
+    def _handle_event(self, event: dict) -> None:
+        etype = event.get("type")
+        obj = event.get("object") or {}
+        if etype == "ERROR":
+            # Expired resourceVersion (410 Gone) arrives as an ERROR
+            # event; drop the cursor so the next watch starts from "now".
+            if obj.get("code") == 410:
+                self._resource_version = None
+            raise _WatchError(str(obj.get("message", "watch ERROR event")))
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        if rv:
+            self._resource_version = rv
+        if etype in _RELEVANT_TYPES:
+            self._wake.set()
+        # BOOKMARK: cursor refreshed above; nothing to reconcile.
+
+    def _watch_once(self) -> None:
+        # Older fakes/clients may not take resource_version; only pass it
+        # when supported so the trigger works against any KubeClient.
+        try:
+            events = self._client.watch_pods(
+                self._timeout, resource_version=self._resource_version)
+        except TypeError:
+            events = self._client.watch_pods(self._timeout)
+        for event in events:
+            self._handle_event(event)
+            if self._stopped.is_set():
+                return
+
     def run(self) -> None:
         while not self._stopped.is_set():
             try:
-                for _event in self._client.watch_pods(self._timeout):
-                    self._wake.set()
-                    if self._stopped.is_set():
-                        return
-            except Exception:  # noqa: BLE001 — degrade to poll-only
-                log.warning("pod watch failed; retrying", exc_info=True)
-                if self._stopped.wait(5.0):
+                self._watch_once()
+                self._failure_streak = 0  # clean server-side close
+            except Exception as e:  # noqa: BLE001 — degrade to poll-only
+                self._failure_streak += 1
+                if self._metrics is not None:
+                    self._metrics.inc("watch_failures")
+                level = (logging.WARNING if self._failure_streak == 1
+                         else logging.DEBUG)
+                log.log(level, "pod watch failed (streak %d): %s; "
+                        "retrying with backoff", self._failure_streak, e,
+                        exc_info=self._failure_streak == 1)
+                if self._stopped.wait(self._backoff_seconds()):
                     return
+
+
+class _WatchError(RuntimeError):
+    """An ERROR event on an otherwise-healthy stream (e.g. 410 Gone)."""
