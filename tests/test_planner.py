@@ -94,9 +94,10 @@ class TestTpuPlanning:
                         policy=PoolPolicy(spare_nodes=0, preemptible=True))
         assert plan.requests[0].preemptible
 
-    def test_multislice_two_gangs_two_slices(self):
+    def test_multislice_one_request_two_slices(self):
         # BASELINE config #4: 2 x v5p-128 via a JobSet with 2 replicated
-        # jobs -> two independent slice provisions, same shape.
+        # jobs -> ONE multislice provision (a single QueuedResource with
+        # node_count=2) so Cloud TPU co-schedules the slices.
         shape = shape_by_name("v5p-128")
         pods = []
         for idx in range(2):
@@ -104,8 +105,54 @@ class TestTpuPlanning:
                               job_index=idx)
         plan = plan_for(pods)
         tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
-        assert len(tpu) == 2
+        assert len(tpu) == 1
+        assert tpu[0].count == 2
+        assert tpu[0].gang_key == ("jobset", "default", "ms")
         assert plan.total_new_chips == 256
+
+    def test_multislice_inflight_serves_all_member_gangs(self):
+        # Idempotence across the group key: while the single multislice
+        # provision is in flight, NO member gang re-provisions.
+        from tpu_autoscaler.engine.planner import InFlight
+
+        shape = shape_by_name("v5p-128")
+        pods = []
+        for idx in range(2):
+            pods += make_gang(shape, job=f"ms-{idx}", jobset="ms",
+                              job_index=idx)
+        plan = plan_for(pods, in_flight=[InFlight(
+            kind="tpu-slice", shape_name="v5p-128",
+            gang_key=("jobset", "default", "ms"), count=2)])
+        assert not [r for r in plan.requests if r.kind == "tpu-slice"]
+
+    def test_lone_jobset_sibling_provisions_solo(self):
+        # Partial multislice failure: one slice died, its gang re-pends
+        # alone -> a solo replacement provision, not a new multislice.
+        shape = shape_by_name("v5p-128")
+        pods = list(make_gang(shape, job="ms-1", jobset="ms", job_index=1))
+        plan = plan_for(pods)
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 1
+        assert tpu[0].count == 1
+        assert tpu[0].gang_key == ("job", "default", "ms-1")  # its own key
+
+    def test_multislice_sibling_binds_free_slice_rest_provision_solo(self):
+        # One sibling fits an existing free slice; only the other needs
+        # hardware -> solo provision (count=1), free slice claimed.
+        shape = shape_by_name("v5e-16")
+        pods = []
+        for idx in range(2):
+            pods += make_gang(shape, job=f"ms-{idx}", jobset="ms",
+                              job_index=idx)
+        plan = plan_for(pods, node_payloads=make_slice_nodes(shape, "w1"))
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 1
+        assert tpu[0].count == 1
+        # gang_keys names exactly the served cohort — the sibling bound
+        # to the free slice must not appear (its pods would otherwise get
+        # a misleading TriggeredScaleUp event).
+        assert len(tpu[0].gang_keys) == 1
+        assert tpu[0].gang_keys[0] == tpu[0].gang_key
 
     def test_spare_slices_warm_pool(self):
         plan = plan_for([], policy=PoolPolicy(

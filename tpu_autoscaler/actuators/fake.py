@@ -95,7 +95,11 @@ class FakeActuator:
         # Tear down any partially-materialized hosts (staggered slices).
         req = status.request
         if req.kind == "tpu-slice":
-            self.delete(f"{req.shape_name}-{provision_id}")
+            if req.count == 1:
+                self.delete(f"{req.shape_name}-{provision_id}")
+            else:
+                for i in range(req.count):
+                    self.delete(f"{req.shape_name}-{provision_id}-s{i}")
         status.state = FAILED
         status.error = "cancelled: provision timeout"
 
@@ -105,21 +109,27 @@ class FakeActuator:
                      now: float) -> None:
         req = status.request
         if req.kind == "tpu-slice":
+            # count > 1 = one multislice provisioning unit (a single
+            # QueuedResource with node_count=N): all member slices
+            # materialize under one status, like Cloud TPU co-scheduling.
             shape = shape_by_name(req.shape_name)
-            slice_id = f"{req.shape_name}-{pid}"
+            slice_ids = ([f"{req.shape_name}-{pid}"] if req.count == 1 else
+                         [f"{req.shape_name}-{pid}-s{i}"
+                          for i in range(req.count)])
             elapsed = now - self._submitted_at[pid] - self._delay
             hosts_up = (shape.hosts if self._stagger <= 0
                         else min(shape.hosts, 1 + int(elapsed / self._stagger)))
-            for i in range(hosts_up):
-                name = f"{slice_id}-h{i}"
-                if not any(n["metadata"]["name"] == name
-                           for n in self._kube.list_nodes()):
-                    self._kube.add_node(tpu_host_payload(
-                        shape, slice_id, i, created_at=now,
-                        preemptible=req.preemptible))
+            for slice_id in slice_ids:
+                for i in range(hosts_up):
+                    name = f"{slice_id}-h{i}"
+                    if not any(n["metadata"]["name"] == name
+                               for n in self._kube.list_nodes()):
+                        self._kube.add_node(tpu_host_payload(
+                            shape, slice_id, i, created_at=now,
+                            preemptible=req.preemptible))
             if hosts_up == shape.hosts:
                 status.state = ACTIVE
-                status.unit_ids = [slice_id]
+                status.unit_ids = list(slice_ids)
             else:
                 status.state = PROVISIONING
         else:

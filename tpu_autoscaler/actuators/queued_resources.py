@@ -70,7 +70,11 @@ class QueuedResourceActuator:
         self._prefix = name_prefix
         self._statuses: dict[str, ProvisionStatus] = {}
         self._done_at: dict[str, float] = {}
-        self._provisioned: set[str] = set()
+        # unit id -> owning queued-resource id.  For single-slice QRs the
+        # unit IS the qr; a multislice QR owns node_count units named
+        # "<qr>-<i>" (the API's nodeIdPrefix naming).
+        self._unit_owner: dict[str, str] = {}
+        self._qr_counts: dict[str, int] = {}
         self._ids = itertools.count(int(time.time()) % 100000)
 
     def provision(self, request: ProvisionRequest) -> ProvisionStatus:
@@ -84,24 +88,37 @@ class QueuedResourceActuator:
         # The TPU API's acceleratorType uses product naming (TensorCore
         # counts on v4/v5p) — the catalog records that as product_name.
         accelerator = shape.product_name or shape.name
-        body: dict = {
-            "tpu": {
-                "nodeSpec": [{
-                    "parent": self._parent,
-                    "nodeId": qr_id,
-                    "node": {
-                        "acceleratorType": accelerator,
-                        "runtimeVersion": self._runtime,
-                        "labels": {"autoscaler-tpu-dev-slice-id": qr_id},
-                    },
-                }],
+        node_spec: dict = {
+            "parent": self._parent,
+            "node": {
+                "acceleratorType": accelerator,
+                "runtimeVersion": self._runtime,
+                "labels": {"autoscaler-tpu-dev-slice-id": qr_id},
             },
         }
+        if request.count > 1:
+            # ONE QueuedResource, N slices: Cloud TPU co-schedules the
+            # slices of a multislice workload only when they are requested
+            # together (multisliceParams; the XPK provisioning model —
+            # SURVEY §6.8 / BASELINE config #4).  Node ids become
+            # "<qr>-0".."<qr>-N-1" per the API's nodeIdPrefix naming.
+            node_spec["multisliceParams"] = {
+                "nodeCount": request.count,
+                "nodeIdPrefix": qr_id,
+            }
+        else:
+            node_spec["nodeId"] = qr_id
+        body: dict = {"tpu": {"nodeSpec": [node_spec]}}
         if request.preemptible:
             body["spot"] = {}
         status = ProvisionStatus(id=qr_id, request=request, state=ACCEPTED)
         self._statuses[qr_id] = status
-        self._provisioned.add(qr_id)
+        self._qr_counts[qr_id] = request.count
+        # The qr id itself always maps (cancel() deletes by provision id);
+        # a multislice QR's member slices map to it too.
+        self._unit_owner[qr_id] = qr_id
+        for i in range(request.count if request.count > 1 else 0):
+            self._unit_owner[f"{qr_id}-{i}"] = qr_id
         try:
             self._rest.post(
                 f"{_BASE}/{self._parent}/queuedResources"
@@ -113,7 +130,8 @@ class QueuedResourceActuator:
         return status
 
     def delete(self, unit_id: str) -> None:
-        if unit_id not in self._provisioned:
+        qr_id = self._unit_owner.get(unit_id)
+        if qr_id is None:
             # Unit ids from the controller come from k8s node labels;
             # queued-resource slices are standalone TPU VM fleets (no GKE
             # nodes), so a foreign id here means misconfiguration — say so
@@ -123,11 +141,22 @@ class QueuedResourceActuator:
             log.error("delete(%s): not a queued resource this actuator "
                       "provisioned; refusing blind delete", unit_id)
             return
+        if self._qr_counts.get(qr_id, 1) > 1:
+            # A multislice QR is one provisioning unit: deleting any
+            # member slice tears down the whole QR (its siblings cannot
+            # outlive it — by the time the controller reclaims, the
+            # jobset spanning them is gone anyway).
+            log.warning("delete(%s): multislice queued resource %s is "
+                        "reclaimed whole (%d slices)", unit_id, qr_id,
+                        self._qr_counts.get(qr_id, 1))
         try:
             self._rest.delete(
-                f"{_BASE}/{self._parent}/queuedResources/{unit_id}"
+                f"{_BASE}/{self._parent}/queuedResources/{qr_id}"
                 "?force=true")
-            self._provisioned.discard(unit_id)
+            for uid, owner in list(self._unit_owner.items()):
+                if owner == qr_id:
+                    del self._unit_owner[uid]
+            self._qr_counts.pop(qr_id, None)
         except Exception:  # noqa: BLE001
             log.exception("queued resource delete failed for %s", unit_id)
 
@@ -147,7 +176,10 @@ class QueuedResourceActuator:
             mapped = _STATE_MAP.get(api_state, PROVISIONING)
             status.state = mapped
             if mapped == ACTIVE:
-                status.unit_ids = [qr_id]
+                count = self._qr_counts.get(qr_id, 1)
+                status.unit_ids = (
+                    [qr_id] if count == 1
+                    else [f"{qr_id}-{i}" for i in range(count)])
             elif mapped == FAILED:
                 status.error = api_state
         for qr_id, status in list(self._statuses.items()):

@@ -307,9 +307,12 @@ class Controller:
             self.notifier.notify(
                 f"scaling up: {req.count}x {req.shape_name} — {req.reason}")
             if req.gang_key is not None:
-                served = next((g for g in gangs
-                               if g.key == req.gang_key), None)
-                for pod in (served.pods if served else []):
+                # gang_keys lists the exact cohort a multislice request
+                # serves (a sibling bound to an existing free slice is not
+                # in it and must not get a misleading scale-up event).
+                member_keys = set(req.gang_keys) or {req.gang_key}
+                served_gangs = [g for g in gangs if g.key in member_keys]
+                for pod in (p for g in served_gangs for p in g.pods):
                     self._emit_event(
                         pod, now, "TriggeredScaleUp",
                         f"provisioning {req.shape_name} for this job "
@@ -380,7 +383,7 @@ class Controller:
         from tpu_autoscaler.topology.catalog import shape_by_name
 
         inflight_chips = sum(
-            shape_by_name(f.shape_name).chips
+            shape_by_name(f.shape_name).chips * f.count
             for f in in_flight_of(self.actuator) if f.kind == "tpu-slice")
         # Chips already on their way out (drains in progress) free up
         # without new victims — credit them before choosing more.
@@ -389,14 +392,36 @@ class Controller:
         draining_chips = sum(unit_chips(units[uid]) for uid in draining_ids
                              if units[uid][0].is_tpu)
 
+        # Clamp-blocked sibling gangs of one jobset are provisioned as ONE
+        # atomic multislice unit (planner cohorts), so preemption must
+        # make room for ALL their slices in one round — per-gang rounds
+        # would free one slice's worth, see need<=0 for the siblings, and
+        # leave the unit rejected for ~N drain cycles.
+        demand_units: list[list[Gang]] = []
+        grouped: dict[tuple, list[Gang]] = {}
         for gang, _reason in blocked:
-            cooling = now < self._retry_at.get(("preempt", gang.key), 0.0)
-            if cooling:
-                handled.add(gang.key)  # room is being made; don't report
+            group_key = gang.multislice_group_key
+            if group_key is None:
+                demand_units.append([gang])
+            elif group_key not in grouped:
+                grouped[group_key] = [gang]
+                demand_units.append(grouped[group_key])
+            else:
+                grouped[group_key].append(gang)
+
+        for unit_gangs in demand_units:
+            gang = max(unit_gangs, key=lambda g: g.priority)  # lead
+            member_keys = {g.key for g in unit_gangs}
+            cool_key = ("preempt",
+                        gang.multislice_group_key or gang.key)
+            if now < self._retry_at.get(cool_key, 0.0):
+                handled |= member_keys  # room is being made; don't report
                 continue
             try:
-                demand_chips = choose_shape_for_gang(
-                    gang, self.config.policy.default_generation).shape.chips
+                demand_chips = sum(
+                    choose_shape_for_gang(
+                        g, self.config.policy.default_generation).shape.chips
+                    for g in unit_gangs)
             except FitError:
                 continue  # not actually clamp-only blocked
             # Free exactly the overshoot, not the gang's whole demand:
@@ -405,7 +430,7 @@ class Controller:
             need = (existing_chips + inflight_chips - draining_chips
                     + demand_chips - self.config.policy.max_total_chips)
             if need <= 0:
-                handled.add(gang.key)  # in-progress drains already suffice
+                handled |= member_keys  # in-progress drains already suffice
                 continue
             candidates = []
             for unit_id, unit_nodes in units.items():
@@ -447,10 +472,10 @@ class Controller:
                     f"{gang.name}")
                 self.request_drain(unit_id)
             draining_chips += freed
-            handled.add(gang.key)
+            handled |= member_keys
             # Cooldown: give the drain window time to play out before
-            # considering more victims for this gang.
-            self._retry_at[("preempt", gang.key)] = (
+            # considering more victims for this demand unit.
+            self._retry_at[cool_key] = (
                 now + self.config.drain_grace_seconds + 60.0)
         return handled
 

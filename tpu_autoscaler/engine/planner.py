@@ -75,8 +75,14 @@ class ProvisionRequest:
 
     kind: str                      # "tpu-slice" | "cpu-node"
     shape_name: str                # slice shape name or CPU machine type
-    count: int = 1                 # nodes for cpu-node; always 1 per slice
+    # Nodes for cpu-node; SLICES for tpu-slice (count > 1 = one multislice
+    # provisioning unit, e.g. a single QueuedResource with node_count=N).
+    count: int = 1
     gang_key: GangKey | None = None  # demand this provision serves
+    # For multislice requests: the individual member gangs served (the
+    # cohort).  gang_key is then the jobset group key; siblings of the
+    # jobset that bound existing free slices are NOT listed here.
+    gang_keys: tuple[GangKey, ...] = ()
     reason: str = ""
     preemptible: bool = False
     stranded_chips: int = 0        # chips provisioned beyond chips requested
@@ -95,7 +101,7 @@ class ScalePlan:
 
     @property
     def total_new_chips(self) -> int:
-        return sum(shape_by_name(r.shape_name).chips
+        return sum(shape_by_name(r.shape_name).chips * r.count
                    for r in self.requests if r.kind == "tpu-slice")
 
 
@@ -175,7 +181,7 @@ class Planner:
         served_keys = {f.gang_key for f in in_flight if f.gang_key}
         existing_chips = sum(int(n.allocatable.get(TPU_RESOURCE))
                              for n in nodes if n.is_tpu)
-        inflight_chips = sum(shape_by_name(f.shape_name).chips
+        inflight_chips = sum(shape_by_name(f.shape_name).chips * f.count
                              for f in in_flight if f.kind == "tpu-slice")
         planned_chips = 0
         # Per-namespace chip accounting for quota enforcement (enforced at
@@ -189,49 +195,115 @@ class Planner:
             for f in in_flight:
                 if f.kind == "tpu-slice" and f.gang_key:
                     ns = f.gang_key[1]
-                    ns_chips[ns] = (ns_chips.get(ns, 0)
-                                    + shape_by_name(f.shape_name).chips)
+                    ns_chips[ns] = (
+                        ns_chips.get(ns, 0)
+                        + shape_by_name(f.shape_name).chips * f.count)
 
-        for gang in tpu_gangs:
-            if gang.key in served_keys:
-                continue  # already provisioning for this gang: idempotence
+        def match_free(gang: Gang) -> str | None:
             # An existing fully-free matching slice satisfies the gang; the
             # scheduler will bind it — provisioning would strand chips.
-            matched = next(
+            return next(
                 (sid for sid, members in free.items()
                  if sid not in claimed and _slice_satisfies(members, gang)),
                 None)
+
+        # ---- provisioning cohorts ------------------------------------
+        # Pending sibling gangs of one JobSet (a multislice job: one gang
+        # per slice over DCN) provision as ONE unit — a single request
+        # with count=N, which the QueuedResource actuator submits as one
+        # QR with node_count=N so Cloud TPU co-schedules the slices (the
+        # XPK model; BASELINE config #4 / SURVEY §6.8).  A lone pending
+        # sibling (e.g. replacing one failed slice of an established
+        # multislice) provisions solo.
+        cohorts: list[list[Gang]] = []
+        processed: set[GangKey] = set()
+        for gang in tpu_gangs:
+            if gang.key in processed or gang.key in served_keys:
+                continue
+            group_key = gang.multislice_group_key
+            if group_key is not None and group_key in served_keys:
+                continue  # multislice provision in flight for this jobset
+            processed.add(gang.key)
+            matched = match_free(gang)
             if matched is not None:
                 claimed.add(matched)
                 continue
-            try:
-                choice = choose_shape_for_gang(gang, pol.default_generation)
-            except FitError as e:
-                plan.unsatisfiable.append((gang, str(e)))
+            cohort = [gang]
+            if group_key is not None:
+                for sib in tpu_gangs:
+                    if (sib.key in processed or sib.key in served_keys
+                            or sib.multislice_group_key != group_key):
+                        continue
+                    processed.add(sib.key)
+                    m = match_free(sib)
+                    if m is not None:
+                        claimed.add(m)
+                    else:
+                        cohort.append(sib)
+            cohorts.append(cohort)
+
+        for cohort in cohorts:
+            members: list[tuple[Gang, object]] = []
+            for g in cohort:
+                try:
+                    members.append(
+                        (g, choose_shape_for_gang(g,
+                                                  pol.default_generation)))
+                except FitError as e:
+                    plan.unsatisfiable.append((g, str(e)))
+            if not members:
                 continue
-            new_total = (existing_chips + inflight_chips + planned_chips
-                         + choice.shape.chips)
-            if new_total > pol.max_total_chips:
-                plan.unsatisfiable.append(
-                    (gang, f"would exceed max_total_chips="
-                           f"{pol.max_total_chips} (at {new_total})"))
-                continue
-            quota = pol.namespace_chip_quota.get(gang.namespace)
-            if quota is not None:
-                ns_new = ns_chips.get(gang.namespace, 0) + choice.shape.chips
-                if ns_new > quota:
-                    plan.unsatisfiable.append(
-                        (gang, f"namespace {gang.namespace!r} chip quota "
-                               f"{quota} exceeded (at {ns_new})"))
+            # One multislice unit needs a uniform accelerator shape; a
+            # heterogeneous jobset (unusual) degrades to solo provisions.
+            if (len(members) >= 2
+                    and len({c.shape.name for _, c in members}) == 1):
+                units = [members]
+            else:
+                units = [[m] for m in members]
+            for unit in units:
+                gangs_u = [g for g, _ in unit]
+                choice = unit[0][1]
+                n = len(unit)
+                unit_chips = choice.shape.chips * n
+                new_total = (existing_chips + inflight_chips
+                             + planned_chips + unit_chips)
+                if new_total > pol.max_total_chips:
+                    for g in gangs_u:
+                        plan.unsatisfiable.append(
+                            (g, f"would exceed max_total_chips="
+                                f"{pol.max_total_chips} (at {new_total})"))
                     continue
-                ns_chips[gang.namespace] = ns_new
-            planned_chips += choice.shape.chips
-            plan.requests.append(ProvisionRequest(
-                kind="tpu-slice", shape_name=choice.shape.name,
-                gang_key=gang.key, preemptible=pol.preemptible,
-                stranded_chips=choice.stranded_chips,
-                reason=(f"gang {gang.name}: {gang.tpu_chips} chips, "
-                        f"{choice.stranded_chips} stranded")))
+                ns = gangs_u[0].namespace
+                quota = pol.namespace_chip_quota.get(ns)
+                if quota is not None:
+                    ns_new = ns_chips.get(ns, 0) + unit_chips
+                    if ns_new > quota:
+                        for g in gangs_u:
+                            plan.unsatisfiable.append(
+                                (g, f"namespace {ns!r} chip quota "
+                                    f"{quota} exceeded (at {ns_new})"))
+                        continue
+                    ns_chips[ns] = ns_new
+                planned_chips += unit_chips
+                stranded = sum(c.stranded_chips for _, c in unit)
+                if n == 1:
+                    g = gangs_u[0]
+                    key, reason = g.key, (
+                        f"gang {g.name}: {g.tpu_chips} chips, "
+                        f"{stranded} stranded")
+                else:
+                    key = gangs_u[0].multislice_group_key
+                    reason = (
+                        f"multislice jobset {key[2]}: {n}x "
+                        f"{choice.shape.name} "
+                        f"({sum(g.tpu_chips for g in gangs_u)} chips, "
+                        f"{stranded} stranded)")
+                plan.requests.append(ProvisionRequest(
+                    kind="tpu-slice", shape_name=choice.shape.name,
+                    count=n, gang_key=key,
+                    gang_keys=tuple(g.key for g in gangs_u),
+                    preemptible=pol.preemptible,
+                    stranded_chips=stranded, reason=reason))
 
         # ---- warm spare slices (reference --spare-agents, per shape) -----
         for shape_name, want in pol.spare_slices.items():

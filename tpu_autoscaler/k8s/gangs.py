@@ -84,6 +84,19 @@ class Gang:
         return self.pods[0].jobset_name if self.pods else None
 
     @property
+    def multislice_group_key(self) -> tuple[str, str, str] | None:
+        """Identity of the multislice (DCN) group this gang belongs to.
+
+        A JobSet's replicated jobs are one gang per slice; when several of
+        them are pending together the planner provisions them as ONE
+        multislice unit (a single QueuedResource with node_count=N, the
+        XPK provisioning model) so Cloud TPU co-schedules the slices.
+        ``None`` for gangs outside any JobSet.
+        """
+        js = self.jobset_name
+        return ("jobset", self.namespace, js) if js else None
+
+    @property
     def oldest_created(self):
         times = [p.created for p in self.pods if p.created is not None]
         return min(times) if times else None
