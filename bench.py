@@ -90,10 +90,68 @@ def check_all_configs() -> bool:
     return ok
 
 
+def bench_fit_batch(n_gangs: int = 512) -> dict:
+    """Python per-gang vs native batch shape scoring (the crossover that
+    justifies PoolPolicy.native_fit_threshold).  Reports any decision
+    mismatch between the two paths; main() fails the bench on one."""
+    from tpu_autoscaler import native
+    from tpu_autoscaler.engine.fitter import (
+        batch_choose_shapes,
+        choose_shape_for_gang,
+    )
+    from tpu_autoscaler.k8s.gangs import group_into_gangs
+    from tpu_autoscaler.k8s.objects import Pod
+    from tpu_autoscaler.sim import _pod
+    from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+    info: dict = {"info": "fit_batch", "gangs": n_gangs}
+    if not native.available():
+        info["skipped"] = "native toolchain unavailable"
+        return info
+    mixes = [(8, 1), (4, 4), (4, 16), (1, 3), (4, 64), (4, 32)]
+    pods = []
+    for i in range(n_gangs):
+        per, n = mixes[i % len(mixes)]
+        pods += [Pod(_pod(f"g{i}-p{j}", {TPU_RESOURCE: str(per)},
+                          labels={"batch.kubernetes.io/job-name": f"g{i}"}))
+                 for j in range(n)]
+    gangs = group_into_gangs(pods)
+    t0 = time.perf_counter()
+    py = {g.key: choose_shape_for_gang(g, "v5e") for g in gangs}
+    py_s = time.perf_counter() - t0
+    batch_choose_shapes(gangs, "v5e")  # warm (builds/loads the library)
+    t0 = time.perf_counter()
+    nat = batch_choose_shapes(gangs, "v5e")
+    nat_s = time.perf_counter() - t0
+    mismatch = sum(
+        1 for k, c in nat.items()
+        if (py[k].shape.name, py[k].stranded_chips)
+        != (c.shape.name, c.stranded_chips))
+    info.update({
+        "python_ms": round(py_s * 1e3, 2),
+        "native_ms": round(nat_s * 1e3, 2),
+        "speedup": round(py_s / nat_s, 1) if nat_s > 0 else None,
+        "native_decided": len(nat),
+        "decision_mismatches": mismatch,
+    })
+    return info
+
+
 def main() -> int:
     if not check_all_configs():
         print(json.dumps({"error": "a BASELINE config failed"}),
               file=sys.stderr)
+        return 1
+    # Informational (stderr: stdout is ONE metric line by contract) —
+    # except decision parity, which is a hard gate.
+    try:
+        fit_info = bench_fit_batch()
+    except Exception as e:  # noqa: BLE001 — optional path must not fail
+        fit_info = {"info": "fit_batch", "error": str(e)}
+    print(json.dumps(fit_info), file=sys.stderr)
+    if fit_info.get("decision_mismatches"):
+        print(json.dumps({"error": "native/python fit decisions diverged",
+                          **fit_info}), file=sys.stderr)
         return 1
     # Warm once (imports, first-pass construction), measure best of 3 —
     # the driver wants steady-state controller overhead, not import time.

@@ -105,3 +105,73 @@ class TestNativePackFfd:
              for p in pods],
             [], (cap.cpu_m / 1000.0, float(cap.memory)))
         assert n_count == py_count
+
+
+class TestPlannerNativePath:
+    """The planner's bulk-scoring hook (PoolPolicy.native_fit_threshold):
+    above the threshold, plans must be decision-identical to Python-only."""
+
+    def gangs_payloads(self, n=48):
+        from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+        mixes = [(8, 1), (4, 4), (4, 16), (1, 3)]
+        tol = [{"key": TPU_RESOURCE, "operator": "Exists",
+                "effect": "NoSchedule"}]
+        pods = []
+        for i in range(n):
+            per, cnt = mixes[i % len(mixes)]
+            pods += [make_pod(
+                name=f"g{i}-p{j}", requests={TPU_RESOURCE: str(per)},
+                labels={"batch.kubernetes.io/job-name": f"g{i}"},
+                tolerations=tol)
+                for j in range(cnt)]
+        return pods
+
+    def test_plan_identical_native_vs_python(self):
+        from tpu_autoscaler.engine.planner import Planner, PoolPolicy
+        from tpu_autoscaler.k8s.gangs import group_into_gangs
+
+        payloads = self.gangs_payloads()
+        def plan_with(threshold):
+            pods = [Pod(p) for p in payloads]
+            gangs = group_into_gangs(pods)
+            pol = PoolPolicy(spare_nodes=0,
+                             native_fit_threshold=threshold)
+            return Planner(pol).plan(gangs, [], pods, [])
+
+        native_plan = plan_with(1)          # forced through the kernel
+        python_plan = plan_with(10 ** 9)    # pure Python
+        def normalize(plan):
+            return sorted(
+                (r.shape_name, r.count, r.gang_key, r.stranded_chips)
+                for r in plan.requests if r.kind == "tpu-slice")
+        assert normalize(native_plan) == normalize(python_plan)
+        assert len(native_plan.requests) == 48
+
+    def test_fractional_chip_gangs_stay_on_python_path(self):
+        # The kernel clamps per-pod chips to >=1 (fitpack.cpp slot math),
+        # which diverges from Python host_slots for fractional requests —
+        # such gangs must be absent from the batch result.
+        from tpu_autoscaler.engine.fitter import batch_choose_shapes
+        from tpu_autoscaler.k8s.gangs import group_into_gangs
+        from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+        pods = [Pod(make_pod(
+            name=f"f{j}", requests={TPU_RESOURCE: "500m"},
+            labels={"batch.kubernetes.io/job-name": "frac"}))
+            for j in range(8)]
+        gangs = group_into_gangs(pods)
+        assert batch_choose_shapes(gangs, "v5e") == {}
+
+    def test_batch_choose_shapes_parity(self):
+        from tpu_autoscaler.engine.fitter import batch_choose_shapes
+        from tpu_autoscaler.k8s.gangs import group_into_gangs
+
+        pods = [Pod(p) for p in self.gangs_payloads()]
+        gangs = group_into_gangs(pods)
+        batch = batch_choose_shapes(gangs, "v5e")
+        assert len(batch) == len(gangs)  # all tpu-only: all decided
+        for g in gangs:
+            py = choose_shape_for_gang(g, "v5e")
+            assert batch[g.key].shape.name == py.shape.name
+            assert batch[g.key].stranded_chips == py.stranded_chips

@@ -60,6 +60,19 @@ def host_slots(allocatable: ResourceVector, per_pod: ResourceVector) -> int:
     return 1 if slots is None else slots  # zero-request pod: 1 per host
 
 
+_host_capacity_cache: dict[str, ResourceVector] = {}
+
+
+def _host_capacity(shape: SliceShape) -> ResourceVector:
+    """One host's capacity vector, cached per shape (the catalog is
+    static data, and feasibility checks run O(gangs x shapes) per pass)."""
+    cached = _host_capacity_cache.get(shape.name)
+    if cached is None:
+        cached = ResourceVector(dict(shape.node_capacity()))
+        _host_capacity_cache[shape.name] = cached
+    return cached
+
+
 def shape_feasible_for_gang(shape: SliceShape, gang: Gang) -> str | None:
     """Why ``gang`` cannot run on one ``shape`` slice, or None if it can.
 
@@ -78,8 +91,7 @@ def shape_feasible_for_gang(shape: SliceShape, gang: Gang) -> str | None:
     if per_pod_chips > shape.chips_per_host:
         return (f"pod requests {per_pod_chips} chips but {shape.name} "
                 f"hosts expose {shape.chips_per_host}")
-    host_capacity = ResourceVector(
-        {k: v for k, v in shape.node_capacity().items()})
+    host_capacity = _host_capacity(shape)
     if not per_pod.fits_in(host_capacity):
         return (f"pod request {per_pod!r} exceeds one {shape.name} host's "
                 f"capacity")
@@ -137,6 +149,72 @@ def choose_shape_for_gang(gang: Gang,
     raise FitError(
         f"no {gen} shape can host {gang}: "
         f"{last_problem or f'largest is {shapes_for_generation(gen)[-1].chips} chips'}")
+
+
+def batch_choose_shapes(gangs: list[Gang],
+                        default_generation: str = "v5e"
+                        ) -> dict[tuple, "ShapeChoice"]:
+    """Bulk shape choice via the native fitpack kernel (native/fitpack.cpp).
+
+    Scores every unpinned gang against the generation's catalog in one
+    C call instead of O(gangs x shapes) Python — the planner switches to
+    this above ``PoolPolicy.native_fit_threshold`` simultaneous decisions.
+
+    Decision safety: the native kernel covers the chip axes only, so each
+    native pick is re-validated with the authoritative Python
+    ``shape_feasible_for_gang`` (host cpu/memory binding).  Gangs whose
+    pick fails validation, gangs with accelerator/topology pins, and all
+    gangs when no toolchain is available are simply absent from the
+    result — the caller falls back to ``choose_shape_for_gang``, so the
+    two paths can never disagree on a final decision.
+    """
+    from tpu_autoscaler import native
+
+    if not native.available():
+        return {}
+    def integral_chips(g: Gang) -> bool:
+        # The kernel's slot math clamps per-pod to >=1 chip; fractional
+        # TPU requests (parseable, if nonsensical) would diverge from
+        # Python host_slots — keep such gangs on the Python path.
+        per = g.per_pod_resources.get(TPU_RESOURCE)
+        return per >= 1 and per == int(per)
+
+    eligible = [
+        g for g in gangs
+        if g.tpu_chips > 0 and g.size > 0 and integral_chips(g)
+        and ACCELERATOR_LABEL not in g.node_selectors
+        and TOPOLOGY_LABEL not in g.node_selectors
+    ]
+    if not eligible:
+        return {}
+    shapes = shapes_for_generation(default_generation)
+    shape_rows = [(float(s.chips), float(s.chips_per_host), float(s.hosts))
+                  for s in shapes]
+    gang_rows = [
+        (float(g.tpu_chips),
+         float(g.per_pod_resources.get(TPU_RESOURCE)),
+         float(g.size))
+        for g in eligible
+    ]
+    scored = native.best_shapes(gang_rows, shape_rows)
+    if scored is None:
+        return {}
+    out: dict[tuple, ShapeChoice] = {}
+    for g, (idx, stranded) in zip(eligible, scored):
+        if idx < 0:
+            continue  # infeasible: Python path reports the exact reason
+        shape = shapes[idx]
+        # When the gang's per-pod request has ONLY the TPU axis, the C
+        # kernel's math (total chips, chips/host, host slots) is exactly
+        # shape_feasible_for_gang's — provably the same decision, no
+        # re-validation needed.  Any other axis (cpu/memory bind on the
+        # host) gets the authoritative Python check; a failed check drops
+        # the gang to the per-gang Python fallback.
+        per_pod_axes = set(g.per_pod_resources.as_dict())
+        if (per_pod_axes <= {TPU_RESOURCE}
+                or shape_feasible_for_gang(shape, g) is None):
+            out[g.key] = ShapeChoice(shape, int(stranded))
+    return out
 
 
 def free_capacity(nodes: list[Node], pods: list[Pod],

@@ -21,6 +21,7 @@ import logging
 
 from tpu_autoscaler.engine.fitter import (
     FitError,
+    batch_choose_shapes,
     choose_shape_for_gang,
     free_capacity,
     host_slots,
@@ -67,6 +68,14 @@ class PoolPolicy:
         default_factory=dict)
     # Provision preemptible/spot TPU capacity (BASELINE config #5).
     preemptible: bool = False
+    # At/above this many simultaneous shape decisions in one pass, score
+    # them in one native fitpack call (C, O(gangs*shapes) without Python
+    # overhead) instead of per-gang Python; each native pick is still
+    # validated by the authoritative Python feasibility check, and any
+    # gang the native path can't decide falls back per-gang, so the two
+    # paths never disagree.  Python-only below the threshold: for tens of
+    # gangs the crossover doesn't pay (see bench.py fit_batch line).
+    native_fit_threshold: int = 32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -242,9 +251,19 @@ class Planner:
                         cohort.append(sib)
             cohorts.append(cohort)
 
+        # Bulk-score large decision batches with the native kernel
+        # (fleet-scale admission); absent entries fall back per-gang.
+        decisions = [g for cohort in cohorts for g in cohort]
+        batch_choices = (
+            batch_choose_shapes(decisions, pol.default_generation)
+            if len(decisions) >= pol.native_fit_threshold else {})
+
         for cohort in cohorts:
             members: list[tuple[Gang, object]] = []
             for g in cohort:
+                if g.key in batch_choices:
+                    members.append((g, batch_choices[g.key]))
+                    continue
                 try:
                     members.append(
                         (g, choose_shape_for_gang(g,

@@ -12,6 +12,7 @@ BASELINE config #4).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Iterable
 
 from tpu_autoscaler.k8s.objects import Pod
@@ -38,19 +39,21 @@ class Gang:
     def name(self) -> str:
         return self.key[2]
 
-    @property
+    @functools.cached_property
     def total_resources(self) -> ResourceVector:
+        # Cached: gangs are rebuilt from pods every reconcile pass, so
+        # the aggregate can never go stale, and the fit engine reads
+        # these properties O(shapes) times per gang.
         total = ResourceVector()
         for p in self.pods:
             total = total + p.resources
         return total
 
-    @property
+    @functools.cached_property
     def per_pod_resources(self) -> ResourceVector:
         """Request of one member pod (gang members are homogeneous; if they
-        are not, the max per axis is the safe envelope)."""
-        if not self.pods:
-            return ResourceVector()
+        are not, the max per axis is the safe envelope).  Cached (see
+        total_resources)."""
         envelope: dict[str, float] = {}
         for p in self.pods:
             for k, v in p.resources.as_dict().items():
