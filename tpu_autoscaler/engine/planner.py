@@ -172,6 +172,107 @@ def _slice_satisfies(members: list[Node], gang: Gang) -> bool:
     return slots >= gang.size
 
 
+class _PlannedNode:
+    """A not-yet-existing node, for predicate simulation (NodeLike)."""
+
+    def __init__(self, name: str, machine_type: str):
+        from tpu_autoscaler.k8s.scheduling import HOSTNAME_KEY
+        from tpu_autoscaler.topology.catalog import INSTANCE_TYPE_LABEL
+
+        self.name = name
+        self.labels = {HOSTNAME_KEY: name,
+                       INSTANCE_TYPE_LABEL: machine_type}
+
+
+def _place_constrained_cpu(constrained: list[Pod],
+                           free: dict[str, "ResourceVector"],
+                           shapes: Sequence[CpuShape],
+                           all_nodes: list[Node],
+                           all_pods: list[Pod],
+                           ) -> tuple[dict[str, int], list[Pod]]:
+    """Place CPU pods that carry hard affinity/anti-affinity/spread
+    constraints, using the same predicates the (fake or real) scheduler
+    enforces — plain first-fit would count capacity the scheduler will
+    refuse, and the pending pod would deadlock with no provision.
+
+    Mutates ``free`` as pods land on existing nodes.  New capacity is
+    simulated with synthetic nodes (hostname + machine-type labels only:
+    constraints keyed on labels we cannot know pre-creation, e.g. zone,
+    conservatively block, surfacing the pod as unplaceable rather than
+    provisioning capacity the scheduler may still refuse).
+
+    Returns ``(new_nodes_per_machine_type, unplaceable_pods,
+    planned_leftovers)`` — the last maps each planned node's synthetic
+    name to its remaining capacity, so the caller can offer it to the
+    unconstrained packing pass (the real node will have that room).
+    """
+    import itertools
+
+    from tpu_autoscaler.k8s.resources import ResourceVector
+    from tpu_autoscaler.k8s.scheduling import scheduling_blocks
+
+    nodes_by_name: dict[str, object] = {n.name: n for n in all_nodes}
+    placements: dict[str, list[Pod]] = {}
+    for p in all_pods:
+        if p.node_name and p.phase in {"Pending", "Running"}:
+            placements.setdefault(p.node_name, []).append(p)
+    shapes = sorted(shapes, key=lambda s: (s.cpu_m, s.memory))
+    caps = {s.machine_type: ResourceVector(dict(s.node_capacity()))
+            for s in shapes}
+    new_nodes: list[list] = []  # [name, machine_type, remaining]
+    counts: dict[str, int] = {}
+    unplaceable: list[Pod] = []
+    seq = itertools.count(1)
+    for pod in sorted(constrained,
+                      key=lambda p: (-p.resources.get("cpu"),
+                                     -p.resources.get("memory"))):
+        placed = False
+        for name, cap in free.items():
+            node = nodes_by_name.get(name)
+            if (node is None or not node.admits(pod)
+                    or not pod.resources.fits_in(cap)
+                    or scheduling_blocks(pod, node, placements,
+                                         nodes_by_name)):
+                continue
+            free[name] = cap - pod.resources
+            placements.setdefault(name, []).append(pod)
+            placed = True
+            break
+        if placed:
+            continue
+        for entry in new_nodes:
+            name, _machine, rem = entry
+            if (not pod.resources.fits_in(rem)
+                    or scheduling_blocks(pod, nodes_by_name[name],
+                                         placements, nodes_by_name)):
+                continue
+            entry[2] = rem - pod.resources
+            placements.setdefault(name, []).append(pod)
+            placed = True
+            break
+        if placed:
+            continue
+        for s in shapes:
+            cap = caps[s.machine_type]
+            if not pod.resources.fits_in(cap):
+                continue
+            name = f"planned-{s.machine_type}-{next(seq)}"
+            node = _PlannedNode(name, s.machine_type)
+            nodes_by_name[name] = node
+            if scheduling_blocks(pod, node, placements, nodes_by_name):
+                del nodes_by_name[name]
+                continue
+            new_nodes.append([name, s.machine_type, cap - pod.resources])
+            placements[name] = [pod]
+            counts[s.machine_type] = counts.get(s.machine_type, 0) + 1
+            placed = True
+            break
+        if not placed:
+            unplaceable.append(pod)
+    leftovers = {name: rem for name, _machine, rem in new_nodes}
+    return counts, unplaceable, leftovers
+
+
 class Planner:
     def __init__(self, policy: PoolPolicy | None = None):
         self.policy = policy or PoolPolicy()
@@ -353,22 +454,53 @@ class Planner:
         inflight_cpu = sum(f.count for f in in_flight
                            if f.kind == "cpu-node")
         cpu_shapes = (pol.cpu_shape, *pol.extra_cpu_shapes)
+        # Pods with hard affinity/anti-affinity/spread constraints go
+        # through predicate-aware placement FIRST (they are the pickiest);
+        # plain resource packing would credit capacity the scheduler will
+        # refuse and the pod would deadlock pending.
+        from tpu_autoscaler.k8s.scheduling import has_scheduling_constraints
+
+        total_pending_cpu = len(pending_cpu)
+        constrained = [p for p in pending_cpu
+                       if has_scheduling_constraints(p)]
+        c_counts: dict[str, int] = {}
+        c_unplaceable: list[Pod] = []
+        if constrained:
+            pending_cpu = [p for p in pending_cpu
+                           if not has_scheduling_constraints(p)]
+            c_counts, c_unplaceable, c_leftovers = _place_constrained_cpu(
+                constrained, free_cpu, cpu_shapes, nodes, pods)
+            # Planned nodes' remaining room is real capacity-to-be:
+            # offer it to the unconstrained pass so mixed demand doesn't
+            # open a second node where one suffices.
+            free_cpu.update(c_leftovers)
         counts, unplaceable = pack_cpu_pods_multi(
             pending_cpu, free_cpu, cpu_shapes,
             nodes_by_name={n.name: n for n in cpu_nodes})
+        for machine, n_new in c_counts.items():
+            counts[machine] = counts.get(machine, 0) + n_new
+        unplaceable = list(unplaceable) + c_unplaceable
         if unplaceable:
             gang_by_key = {g.key: g for g in gangs}
             reported: set[GangKey] = set()
             shapes_desc = "/".join(s.machine_type for s in cpu_shapes)
+            constrained_keys = {id(p) for p in c_unplaceable}
             for pod in unplaceable:
                 if pod.gang_key in reported:
                     continue
                 reported.add(pod.gang_key)
+                if id(pod) in constrained_keys:
+                    reason = (f"pod {pod.name}: hard affinity/spread "
+                              "constraints admit no existing node and "
+                              "cannot be satisfied by new capacity")
+                else:
+                    reason = (f"pod {pod.name} requests "
+                              f"{pod.resources!r}, larger than one "
+                              f"{shapes_desc} node")
                 plan.unsatisfiable.append((
                     gang_by_key.get(pod.gang_key,
                                     Gang(key=pod.gang_key, pods=[pod])),
-                    f"pod {pod.name} requests {pod.resources!r}, larger "
-                    f"than one {shapes_desc} node"))
+                    reason))
         # In-flight nodes of the SAME machine type serve demand first
         # (idempotence): an in-flight small node must not cancel demand
         # for a large node a pod requires.
@@ -424,6 +556,6 @@ class Planner:
             if count > 0:
                 plan.requests.append(ProvisionRequest(
                     kind="cpu-node", shape_name=machine, count=count,
-                    reason=(f"{len(pending_cpu)} pending CPU pods, "
+                    reason=(f"{total_pending_cpu} pending CPU pods, "
                             f"spare={pol.spare_nodes}")))
         return plan

@@ -239,16 +239,26 @@ class FakeKube:
         signal the autoscaler reads.  Returns pods bound this pass.
         """
         from tpu_autoscaler.k8s.gangs import group_into_gangs
+        from tpu_autoscaler.k8s.scheduling import scheduling_blocks
 
         nodes = [Node(p) for p in self._nodes.values()]
+        nodes_by_name = {n.name: n for n in nodes}
         pods = [Pod(p) for p in self._pods.values()]
         free: dict[str, ResourceVector] = {}
         for n in nodes:
             if n.is_ready and not n.unschedulable:
                 free[n.name] = n.allocatable
+        # Placements (bound pods, then tentative same-pass bindings) feed
+        # the affinity/anti-affinity/spread predicates.
+        placed_by_node: dict[str, list[Pod]] = {}
         for p in pods:
-            if p.node_name and p.node_name in free:
-                free[p.node_name] = free[p.node_name] - p.resources
+            # Terminated pods neither hold resources nor count for the
+            # affinity/spread predicates (kube-scheduler semantics; also
+            # keeps this aligned with the planner's placement view).
+            if p.node_name and p.phase in {"Pending", "Running"}:
+                placed_by_node.setdefault(p.node_name, []).append(p)
+                if p.node_name in free:
+                    free[p.node_name] = free[p.node_name] - p.resources
 
         bound = 0
         pending = [p for p in pods
@@ -256,17 +266,21 @@ class FakeKube:
         for gang in group_into_gangs(pending):
             # Tentative placement for the WHOLE gang against a copy.
             trial = dict(free)
+            trial_placed = {k: list(v) for k, v in placed_by_node.items()}
             placements: list[tuple[Pod, str]] = []
             ok = True
             for p in gang.pods:
                 target = next(
                     (n for n in nodes
                      if n.name in trial and n.admits(p)
-                     and p.resources.fits_in(trial[n.name])), None)
+                     and p.resources.fits_in(trial[n.name])
+                     and scheduling_blocks(p, n, trial_placed,
+                                           nodes_by_name) is None), None)
                 if target is None:
                     ok = False
                     break
                 trial[target.name] = trial[target.name] - p.resources
+                trial_placed.setdefault(target.name, []).append(p)
                 placements.append((p, target.name))
             if not ok:
                 for p in gang.pods:
@@ -279,6 +293,7 @@ class FakeKube:
                                       "reason": "Unschedulable"})
                 continue
             free = trial
+            placed_by_node = trial_placed
             for p, node_name in placements:
                 payload = self._pods[(p.namespace, p.name)]
                 payload["spec"]["nodeName"] = node_name

@@ -72,6 +72,12 @@ class Pod:
         self.tolerations: list[dict] = list(spec.get("tolerations") or [])
         self.priority_class: str | None = spec.get("priorityClassName")
         self.priority: int = int(spec.get("priority") or 0)
+        # Hard scheduling constraints beyond node-local admission
+        # (evaluated by k8s/scheduling.py in the fake scheduler and the
+        # planner's CPU packing path).
+        self.affinity: dict = dict(spec.get("affinity") or {})
+        self.topology_spread: list[dict] = list(
+            spec.get("topologySpreadConstraints") or [])
         self.resources = self._sum_requests(spec)
         status = payload.get("status", {})
         self.phase: str = status.get("phase", "")

@@ -1,0 +1,175 @@
+"""Scheduler predicates beyond node-local admission.
+
+Pod affinity / anti-affinity and topology spread are CLUSTER-state
+predicates (they depend on where other pods sit), so they live here
+rather than on ``Node.admits``.  Shared by the fake scheduler
+(``FakeKube.schedule_step``) and the planner's CPU packing path — a
+predicate modeled in only one of the two places either deadlocks pending
+pods (planner thinks a pod fits a node the scheduler will refuse) or
+over-provisions.
+
+Scope, mirroring kube-scheduler's *filter* phase:
+
+- ``requiredDuringSchedulingIgnoredDuringExecution`` pod affinity and
+  anti-affinity (preferred/scoring terms are ignored);
+- ``topologySpreadConstraints`` with ``whenUnsatisfiable: DoNotSchedule``
+  (``ScheduleAnyway`` is scoring — ignored);
+- label selectors support ``matchLabels`` and ``matchExpressions`` with
+  In / NotIn / Exists / DoesNotExist;
+- affinity terms default to the pod's own namespace; an explicit
+  ``namespaces`` list is honored.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Protocol
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+_REQUIRED = "requiredDuringSchedulingIgnoredDuringExecution"
+
+
+class NodeLike(Protocol):
+    """What the predicates need from a node: identity + labels.  Real
+    ``Node`` objects satisfy this; the planner also passes synthetic
+    not-yet-existing nodes when simulating new capacity."""
+
+    name: str
+    labels: Mapping[str, str]
+
+
+def topology_value(node: NodeLike, key: str) -> str | None:
+    if key == HOSTNAME_KEY:
+        # kubelet stamps hostname == node name; synthetic nodes may not
+        # carry the label explicitly.
+        return node.labels.get(key, node.name)
+    return node.labels.get(key)
+
+
+def label_selector_matches(selector: Mapping, labels: Mapping[str, str]
+                           ) -> bool:
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key = expr.get("key", "")
+        op = expr.get("operator")
+        values = expr.get("values") or []
+        if op == "In":
+            if labels.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if labels.get(key) in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:  # unknown operator: conservatively no match
+            return False
+    return True
+
+
+def has_scheduling_constraints(pod) -> bool:
+    """Does this pod carry any HARD constraint these predicates model?"""
+    aff = pod.affinity
+    if (aff.get("podAffinity") or {}).get(_REQUIRED):
+        return True
+    if (aff.get("podAntiAffinity") or {}).get(_REQUIRED):
+        return True
+    return any(c.get("whenUnsatisfiable") == "DoNotSchedule"
+               for c in pod.topology_spread)
+
+
+def _term_namespaces(term: Mapping, pod_namespace: str) -> set[str]:
+    ns = term.get("namespaces")
+    return set(ns) if ns else {pod_namespace}
+
+
+def _placed_matching(term: Mapping, pod_namespace: str,
+                     placements: Mapping[str, list]) -> Iterable[str]:
+    """Node names hosting a placed pod matched by the term's selector."""
+    selector = term.get("labelSelector") or {}
+    namespaces = _term_namespaces(term, pod_namespace)
+    for node_name, placed in placements.items():
+        for q in placed:
+            if (q.namespace in namespaces
+                    and label_selector_matches(selector, q.labels)):
+                yield node_name
+                break
+
+
+def scheduling_blocks(pod, node: NodeLike,
+                      placements: Mapping[str, list],
+                      nodes_by_name: Mapping[str, NodeLike]) -> str | None:
+    """Why ``pod`` cannot go on ``node`` given current ``placements``
+    (bound pods AND tentative same-gang placements, keyed by node name),
+    or None if the hard constraints allow it."""
+    aff = pod.affinity
+
+    for term in (aff.get("podAffinity") or {}).get(_REQUIRED, []):
+        key = term.get("topologyKey", HOSTNAME_KEY)
+        here = topology_value(node, key)
+        if here is None:
+            return f"affinity topologyKey {key} absent on node"
+        matching = [m for m in _placed_matching(term, pod.namespace,
+                                                placements)
+                    if m in nodes_by_name]
+        ok = any(topology_value(nodes_by_name[m], key) == here
+                 for m in matching)
+        if not ok and not matching:
+            # kube-scheduler's bootstrap rule: a pod whose OWN labels
+            # match the term may schedule when no matching pod exists
+            # anywhere — otherwise the first replica of a self-affine
+            # set could never start.
+            selector = term.get("labelSelector") or {}
+            ok = label_selector_matches(selector, pod.labels)
+        if not ok:
+            return (f"pod affinity: no matching pod in topology "
+                    f"{key}={here}")
+
+    for term in (aff.get("podAntiAffinity") or {}).get(_REQUIRED, []):
+        key = term.get("topologyKey", HOSTNAME_KEY)
+        here = topology_value(node, key)
+        if here is None:
+            continue  # node outside the topology: nothing to conflict with
+        clash = any(
+            topology_value(nodes_by_name[m], key) == here
+            for m in _placed_matching(term, pod.namespace, placements)
+            if m in nodes_by_name)
+        if clash:
+            return (f"pod anti-affinity: matching pod already in "
+                    f"topology {key}={here}")
+
+    for c in pod.topology_spread:
+        if c.get("whenUnsatisfiable") != "DoNotSchedule":
+            continue
+        key = c.get("topologyKey", "")
+        max_skew = int(c.get("maxSkew", 1))
+        here = topology_value(node, key)
+        if here is None:
+            return f"topology spread key {key} absent on node"
+        selector = c.get("labelSelector") or {}
+        counts: dict[str, int] = {}
+        for n in nodes_by_name.values():
+            v = topology_value(n, key)
+            if v is not None:
+                counts.setdefault(v, 0)
+        for node_name, placed in placements.items():
+            n = nodes_by_name.get(node_name)
+            v = topology_value(n, key) if n is not None else None
+            if v is None:
+                continue
+            counts[v] = counts.get(v, 0) + sum(
+                1 for q in placed
+                if q.namespace == pod.namespace
+                and label_selector_matches(selector, q.labels))
+        floor = min(counts.values(), default=0)
+        if counts.get(here, 0) + 1 - floor > max_skew:
+            return (f"topology spread: placing in {key}={here} would "
+                    f"skew {counts.get(here, 0) + 1 - floor} > "
+                    f"maxSkew {max_skew}")
+
+    return None
