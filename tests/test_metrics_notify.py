@@ -41,8 +41,8 @@ class TestMetrics:
     def test_http_endpoint(self):
         m = Metrics()
         m.inc("reconcile_errors")
-        port = 19309
-        m.serve(port)
+        m.serve(0)  # ephemeral: parallel test runs must not collide
+        port = m.bound_port
         deadline = time.time() + 5
         body = None
         while time.time() < deadline:

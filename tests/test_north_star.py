@@ -278,6 +278,72 @@ class TestImpendingTermination:
         assert all(pod_running(kube, n) for n in names)
 
 
+class TestGenerationFallback:
+    """Capacity stockout: repeated provision failures on the default
+    generation fall back to policy.generation_fallbacks in order."""
+
+    def test_stockout_falls_back_to_next_generation(self):
+        kube = FakeKube()
+        # Every v5e shape is stocked out; v5p provisions fine.
+        actuator = FakeActuator(
+            kube, fail_shapes={"v5e-4"})
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0,
+                              generation_fallbacks=("v5p",),
+                              fallback_after_failures=2),
+            grace_seconds=30.0, idle_threshold_seconds=IDLE,
+            drain_grace_seconds=20.0, provision_retry_seconds=5.0))
+        pod = make_tpu_pod(name="job", chips=4, job="fb-job", selectors={})
+        kube.add_pod(pod)
+        run_loop(kube, controller, until=120.0,
+                 stop_when=lambda: pod_running(kube, "job"))
+        assert pod_running(kube, "job")
+        # Landed on v5p hardware after (exactly) the failure threshold.
+        node = kube.list_nodes()[0]
+        assert "v5p" in node["metadata"]["labels"][
+            "cloud.google.com/gke-tpu-accelerator"]
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["provision_failures"] == 2
+        assert snap["counters"]["generation_fallbacks"] == 1
+
+    def test_no_fallback_without_policy(self):
+        kube = FakeKube()
+        actuator = FakeActuator(kube, fail_shapes={"v5e-4"})
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0),
+            grace_seconds=30.0, idle_threshold_seconds=IDLE,
+            drain_grace_seconds=20.0, provision_retry_seconds=5.0))
+        kube.add_pod(make_tpu_pod(name="job", chips=4, job="fb-job",
+                                  selectors={}))
+        run_loop(kube, controller, until=60.0, step=5.0)
+        assert not pod_running(kube, "job")  # keeps retrying v5e
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("generation_fallbacks", 0) == 0
+
+    def test_pinned_gang_never_falls_back(self):
+        kube = FakeKube()
+        actuator = FakeActuator(kube, fail_shapes={"v5e-8"})
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0,
+                              generation_fallbacks=("v5p",),
+                              fallback_after_failures=2),
+            grace_seconds=30.0, idle_threshold_seconds=IDLE,
+            drain_grace_seconds=20.0, provision_retry_seconds=5.0))
+        shape = shape_by_name("v5e-8")
+        kube.add_pod(make_tpu_pod(name="pinned", chips=8, shape=shape,
+                                  job="pin-job"))
+        run_loop(kube, controller, until=60.0, step=5.0)
+        # The pin is the user's contract: still pending, still v5e.
+        assert not pod_running(kube, "pinned")
+        assert all("v5p" not in n["metadata"]["labels"].get(
+            "cloud.google.com/gke-tpu-accelerator", "")
+            for n in kube.list_nodes())
+        # And no false "falling back" observability either: the fitter
+        # honors the pin, so the metric/notification must not fire.
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("generation_fallbacks", 0) == 0
+
+
 class TestPriorityPreemption:
     """Checkpoint-aware preemption: a clamp-blocked higher-priority gang
     reclaims chips from a lower-priority job, which gets the drain

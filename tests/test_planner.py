@@ -15,6 +15,7 @@ from tests.fixtures import (
     make_node,
     make_pod,
     make_slice_nodes,
+    make_tpu_pod,
 )
 
 
@@ -153,6 +154,20 @@ class TestTpuPlanning:
         # a misleading TriggeredScaleUp event).
         assert len(tpu[0].gang_keys) == 1
         assert tpu[0].gang_keys[0] == tpu[0].gang_key
+
+    def test_generation_override_changes_shape(self):
+        from tpu_autoscaler.engine.planner import Planner
+
+        # UNPINNED gang (no selectors): the override decides the catalog.
+        pod_objs = [Pod(make_tpu_pod(name="p0", chips=4, job="j1",
+                                     selectors={}))]
+        gangs = group_into_gangs(pod_objs)
+        plan = Planner(PoolPolicy(spare_nodes=0)).plan(
+            gangs, [], pod_objs, [],
+            generation_overrides={gangs[0].key: "v5p"})
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 1
+        assert tpu[0].shape_name.startswith("v5p-")
 
     def test_spare_slices_warm_pool(self):
         plan = plan_for([], policy=PoolPolicy(

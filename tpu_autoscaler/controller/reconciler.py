@@ -126,6 +126,12 @@ class Controller:
         # Retry-at times after failed provisions, per gang key and (for
         # gang-less spare provisions) per shape name.
         self._retry_at: dict[object, float] = {}
+        # Consecutive provision failures per demand unit, driving the
+        # capacity-stockout generation fallback (policy
+        # generation_fallbacks); reset when a provision for the unit
+        # goes ACTIVE or its demand disappears.
+        self._failure_streak: dict[object, int] = {}
+        self._fallback_noted: dict[object, str] = {}
         # Provision submit times, for the provision_latency_seconds metric.
         self._submitted_at: dict[str, float] = {}
         # Gang size observations for the settle window: key -> (size,
@@ -189,6 +195,26 @@ class Controller:
         for key in [k for k, t in self._retry_at.items()
                     if t < now - 3600.0]:
             del self._retry_at[key]
+        # Failure streaks (generation fallback) are pruned against LIVE
+        # demand — every pod's gang key plus jobset group keys — not the
+        # settle-filtered gang list, so a gang that resizes mid-stockout
+        # keeps the fallback it earned.  Shape-keyed entries (gang-less
+        # spares) persist until their provision lands.
+        live_demand_keys = set(live_gang_keys)
+        for key in live_gang_keys:
+            if key and key[0] == "jobset":
+                live_demand_keys.add(("jobset", key[1],
+                                      key[2].split("/", 1)[0]))
+        for p in pods:
+            if p.jobset_name:
+                live_demand_keys.add(("jobset", p.namespace,
+                                      p.jobset_name))
+        for key in [k for k in self._failure_streak
+                    if not (isinstance(k, tuple) and k
+                            and k[0] == "shape")
+                    and k not in live_demand_keys]:
+            del self._failure_streak[key]
+            self._fallback_noted.pop(key, None)
 
         self.metrics.observe("reconcile_seconds", time.perf_counter() - t0)
         self.metrics.set_gauge("pending_gangs", len(gangs))
@@ -289,8 +315,10 @@ class Controller:
         # Process failures FIRST so a provision that failed since last pass
         # sets its backoff before we consider re-submitting for its demand.
         self._note_failures(now)
+        overrides = self._generation_overrides(gangs, now)
         plan = self.planner.plan(gangs, nodes, pods,
-                                 in_flight_of(self.actuator))
+                                 in_flight_of(self.actuator),
+                                 generation_overrides=overrides)
         for req in plan.requests:
             # Respect retry backoff after a failed provision for the same
             # demand (gang, or shape for gang-less spare provisions).
@@ -479,6 +507,59 @@ class Controller:
                 now + self.config.drain_grace_seconds + 60.0)
         return handled
 
+    def _generation_overrides(self, gangs: list[Gang],
+                              now: float) -> dict[tuple, str]:
+        """Capacity-stockout fallback: after ``fallback_after_failures``
+        consecutive failed provisions for a demand unit, fit it on the
+        next generation in ``policy.generation_fallbacks`` instead of the
+        default.  Selector-pinned gangs are unaffected (the fit engine
+        honors pins regardless of the generation argument)."""
+        pol = self.config.policy
+        fallbacks = pol.generation_fallbacks
+        overrides: dict[tuple, str] = {}
+        if not fallbacks:
+            return overrides
+        from tpu_autoscaler.topology.catalog import (
+            ACCELERATOR_LABEL,
+            TOPOLOGY_LABEL,
+        )
+
+        after = max(1, pol.fallback_after_failures)
+        for gang in gangs:
+            selectors = gang.node_selectors
+            if (TOPOLOGY_LABEL in selectors
+                    or ACCELERATOR_LABEL in selectors):
+                # Pinned: the fitter honors the pin regardless of the
+                # generation argument — no override, and crucially no
+                # false "falling back" notification either.
+                continue
+            group_key = gang.multislice_group_key
+            streak = self._failure_streak.get(gang.key, 0)
+            if group_key is not None:
+                streak = max(streak,
+                             self._failure_streak.get(group_key, 0))
+            if streak < after:
+                continue
+            gen = fallbacks[min(streak // after - 1, len(fallbacks) - 1)]
+            overrides[gang.key] = gen
+            note_key = group_key or gang.key
+            if self._fallback_noted.get(note_key) != gen:
+                self._fallback_noted[note_key] = gen
+                self.metrics.inc("generation_fallbacks")
+                log.warning(
+                    "capacity fallback for %s after %d failed "
+                    "provisions: trying %s", gang.name, streak, gen)
+                self.notifier.notify(
+                    f"capacity stockout for {gang.name}: falling back "
+                    f"to {gen}")
+                for pod in gang.pods:
+                    self._emit_event(
+                        pod, now, "GenerationFallback",
+                        f"provisioning on {gen} after {streak} failed "
+                        "attempts on the default generation",
+                        warning=True)
+        return overrides
+
     def _note_failures(self, now: float) -> None:
         # Cancel provisions stuck in flight past the timeout; the FAILED
         # status this produces is then handled by the normal backoff path.
@@ -498,12 +579,18 @@ class Controller:
                 self.metrics.observe(
                     "provision_latency_seconds",
                     now - self._submitted_at.pop(status.id))
+                success_key = (status.request.gang_key
+                               or ("shape", status.request.shape_name))
+                self._failure_streak.pop(success_key, None)
+                self._fallback_noted.pop(success_key, None)
         for status in self.actuator.statuses():
             if status.state == FAILED and status.id not in self._seen_failures:
                 self._seen_failures.add(status.id)
                 self.metrics.inc("provision_failures")
                 backoff_key = (status.request.gang_key
                                or ("shape", status.request.shape_name))
+                self._failure_streak[backoff_key] = (
+                    self._failure_streak.get(backoff_key, 0) + 1)
                 self._retry_at[backoff_key] = (
                     now + self.config.provision_retry_seconds)
                 log.warning("provision %s failed (retry in %gs): %s",

@@ -68,6 +68,14 @@ class PoolPolicy:
         default_factory=dict)
     # Provision preemptible/spot TPU capacity (BASELINE config #5).
     preemptible: bool = False
+    # Capacity stockout fallback: when provisioning for an UNPINNED gang
+    # keeps failing (quota / stockout), retry on these generations in
+    # order (e.g. ("v6e", "v5p")).  Gangs pinned by accelerator/topology
+    # selectors never fall back — the pin is the user's contract.
+    generation_fallbacks: tuple[str, ...] = ()
+    # Consecutive failures per demand unit before stepping to the next
+    # fallback generation.
+    fallback_after_failures: int = 2
     # At/above this many simultaneous shape decisions in one pass, score
     # them in one native fitpack call (C, O(gangs*shapes) without Python
     # overhead) instead of per-gang Python; each native pick is still
@@ -278,9 +286,15 @@ class Planner:
         self.policy = policy or PoolPolicy()
 
     def plan(self, gangs: list[Gang], nodes: list[Node], pods: list[Pod],
-             in_flight: list[InFlight] = ()) -> ScalePlan:
+             in_flight: list[InFlight] = (),
+             generation_overrides: dict[GangKey, str] | None = None
+             ) -> ScalePlan:
+        """``generation_overrides`` maps a gang key to the TPU generation
+        to fit it on instead of the policy default — the controller sets
+        it from failure streaks (capacity stockout fallback)."""
         plan = ScalePlan()
         pol = self.policy
+        gen_override = generation_overrides or {}
 
         tpu_gangs = [g for g in gangs if g.requests_tpu]
         cpu_pods = [p for g in gangs if not g.requests_tpu for p in g.pods]
@@ -354,7 +368,10 @@ class Planner:
 
         # Bulk-score large decision batches with the native kernel
         # (fleet-scale admission); absent entries fall back per-gang.
-        decisions = [g for cohort in cohorts for g in cohort]
+        # Gangs with a generation override go per-gang (the batch scorer
+        # runs against the default generation's catalog).
+        decisions = [g for cohort in cohorts for g in cohort
+                     if g.key not in gen_override]
         batch_choices = (
             batch_choose_shapes(decisions, pol.default_generation)
             if len(decisions) >= pol.native_fit_threshold else {})
@@ -367,8 +384,9 @@ class Planner:
                     continue
                 try:
                     members.append(
-                        (g, choose_shape_for_gang(g,
-                                                  pol.default_generation)))
+                        (g, choose_shape_for_gang(
+                            g, gen_override.get(g.key,
+                                                pol.default_generation))))
                 except FitError as e:
                     plan.unsatisfiable.append((g, str(e)))
             if not members:

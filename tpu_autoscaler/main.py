@@ -23,10 +23,21 @@ from tpu_autoscaler.notify import LogNotifier, SlackNotifier
 from tpu_autoscaler.topology.catalog import cpu_shape_by_name
 
 
-def _policy(default_generation, cpu_machine_type, over_provision,
-            spare_agents, spare_slices, namespace_quotas, max_cpu_nodes,
-            max_total_chips, preemptible) -> PoolPolicy:
-    from tpu_autoscaler.topology.catalog import SLICE_SHAPES
+def _policy(default_generation, generation_fallbacks, cpu_machine_type,
+            over_provision, spare_agents, spare_slices, namespace_quotas,
+            max_cpu_nodes, max_total_chips, preemptible) -> PoolPolicy:
+    from tpu_autoscaler.topology.catalog import (
+        SLICE_SHAPES,
+        shapes_for_generation,
+    )
+
+    for gen in generation_fallbacks:
+        try:
+            shapes_for_generation(gen)
+        except KeyError:
+            raise click.BadParameter(
+                f"unknown TPU generation {gen!r}",
+                param_hint="--generation-fallback") from None
 
     spares: dict[str, int] = {}
     for item in spare_slices:
@@ -71,6 +82,7 @@ def _policy(default_generation, cpu_machine_type, over_provision,
                 param_hint="--namespace-quota")
     return PoolPolicy(
         default_generation=default_generation,
+        generation_fallbacks=tuple(generation_fallbacks),
         cpu_shape=cpu_shape_by_name(cpu_machine_type),
         over_provision_nodes=over_provision,
         spare_nodes=spare_agents,
@@ -155,6 +167,12 @@ _common = [
     click.option("--over-provision", default=0, show_default=True,
                  help="Extra CPU nodes beyond demand."),
     click.option("--default-generation", default="v5e", show_default=True),
+    click.option("--generation-fallback", "generation_fallbacks",
+                 multiple=True,
+                 help="Fallback TPU generation(s), in order, for unpinned "
+                      "gangs whose provisions keep failing (capacity "
+                      "stockout), e.g. --generation-fallback v6e "
+                      "--generation-fallback v5p."),
     click.option("--cpu-machine-type", default="e2-standard-8",
                  show_default=True),
     click.option("--max-cpu-nodes", default=100, show_default=True),
@@ -184,10 +202,10 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            drain_grace, utilization_threshold, gang_settle,
            provision_timeout, preemption, spare_agents, spare_slices,
            namespace_quotas, over_provision,
-           default_generation, cpu_machine_type, max_cpu_nodes,
-           max_total_chips, preemptible, no_scale, no_maintenance,
-           slack_hook, slack_channel, metrics_port, log_json,
-           verbose) -> Controller:
+           default_generation, generation_fallbacks, cpu_machine_type,
+           max_cpu_nodes, max_total_chips, preemptible, no_scale,
+           no_maintenance, slack_hook, slack_channel, metrics_port,
+           log_json, verbose) -> Controller:
     from tpu_autoscaler.logging_setup import setup_logging
 
     setup_logging(verbose=verbose, json_format=log_json)
@@ -197,7 +215,8 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
     if metrics_port:
         metrics.serve(metrics_port)
     config = ControllerConfig(
-        policy=_policy(default_generation, cpu_machine_type, over_provision,
+        policy=_policy(default_generation, generation_fallbacks,
+                       cpu_machine_type, over_provision,
                        spare_agents, spare_slices, namespace_quotas,
                        max_cpu_nodes, max_total_chips, preemptible),
         grace_seconds=grace_period,

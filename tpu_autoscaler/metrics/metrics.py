@@ -119,6 +119,9 @@ class Metrics:
                 pass
 
         server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        # The actually-bound port (stable even with port=0, which lets
+        # tests and co-located processes avoid collisions).
+        self.bound_port = server.server_address[1]
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         return thread
