@@ -24,6 +24,21 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _fit_block(s: int, block: int) -> int:
+    """Largest divisor of ``s`` not exceeding ``block``, so any sequence
+    length works (the einsum path accepts any s; the kernels must too,
+    not crash on s % 128 != 0)."""
+    block = min(block, s)
+    while s % block:
+        block -= 1
+    return block
+
+
+def _fold_heads(x: jax.Array) -> jax.Array:
+    """[b, h, ...] -> [b*h, ...] (one grid cell per batch*head)."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
                  causal: bool, block_q: int):
     qi = pl.program_id(1)
@@ -49,15 +64,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
 
 def _forward_pallas(q, k, v, causal, block_q, interpret):
     b, h, s, d = q.shape
-    # Largest divisor of s not exceeding the requested block, so any
-    # sequence length works (the einsum path accepts any s; this one must
-    # too, not crash on s % 128 != 0).
-    block_q = min(block_q, s)
-    while s % block_q:
-        block_q -= 1
+    block_q = _fit_block(s, block_q)
     sm_scale = d ** -0.5
 
-    fold = lambda x: x.reshape(b * h, s, x.shape[-1])  # noqa: E731
+    fold = _fold_heads
     kernel = functools.partial(_attn_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q)
     out = pl.pallas_call(
@@ -194,6 +204,76 @@ def make_sharded_flash_attention(mesh, *, causal: bool = True,
                              out_specs=spec, check_vma=False)(q, k, v)
 
     return attn
+
+
+def _ring_step_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                      m_out, l_out, acc_out, *, sm_scale: float,
+                      diag: bool, block_q: int):
+    """One ring-attention hop, fused: QK^T → (diag mask) → online-softmax
+    merge into the carried (m, l, acc) — the cross-device analog of the
+    flash forward, with the running stats living across ppermute hops
+    instead of across k-blocks.  ``diag=True`` is the src==self hop of a
+    causal ring (lower-triangular block); fully-visible hops use
+    ``diag=False``; invisible hops never reach the kernel (lax.switch
+    skips them outside)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale           # [bq, d]
+    k = k_ref[0].astype(jnp.float32)                      # [sk, d]
+    v = v_ref[0].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [bq, sk]
+    if diag:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    m_prev = m_ref[0]                                      # [bq, 1]
+    l_prev = l_ref[0]
+    acc_prev = acc_ref[0]                                  # [bq, d]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    p = jnp.exp(scores - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    m_out[0] = m_new
+    l_out[0] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_out[0] = acc_prev * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def ring_flash_step(q, k_t, v_t, m, l, acc, *, diag: bool,
+                    block_q: int = 128, interpret: bool = False):
+    """Merge one rotating K/V block into the ring carry, fused in VMEM.
+
+    q: [b, h, sq, d] (this device's queries; any dtype);
+    k_t, v_t: [b, h, sk, d] (the block currently visiting);
+    m, l: [b, h, sq, 1] f32; acc: [b, h, sq, d] f32.
+    Returns the updated (m, l, acc).  No [sq, sk] tensor touches HBM.
+    """
+    b, h, sq, d = q.shape
+    sk = k_t.shape[2]
+    block_q = _fit_block(sq, block_q)
+    sm_scale = d ** -0.5
+    fold = _fold_heads
+    kernel = functools.partial(_ring_step_kernel, sm_scale=sm_scale,
+                               diag=diag, block_q=block_q)
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0))
+    kspec = pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0))
+    mspec = pl.BlockSpec((1, block_q, 1), lambda bh, i: (bh, i, 0))
+    m2, l2, acc2 = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[qspec, kspec, kspec, mspec, mspec, qspec],
+        out_specs=(mspec, mspec, qspec),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
+        ),
+        interpret=interpret,
+    )(fold(q), fold(k_t), fold(v_t), fold(m), fold(l), fold(acc))
+    unfold = lambda x: x.reshape(b, h, *x.shape[1:])  # noqa: E731
+    return unfold(m2), unfold(l2), unfold(acc2)
 
 
 def reference_attention(q, k, v, *, causal=True):

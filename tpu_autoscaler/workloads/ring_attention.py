@@ -29,50 +29,48 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
-                     sm_scale: float):
-    """Per-device body under shard_map.
+def _ring_driver(q, k, v, *, axis_name: str, causal: bool, merge):
+    """The ring schedule, shared by the einsum and pallas impls.
 
-    q, k, v: [b, h, s_local, d] — this device's sequence block.
+    ``merge(k_t, v_t, m, l, acc, diag)`` folds one visiting K/V block
+    into the online-softmax carry; the driver owns everything else —
+    src computation, hop-visibility dispatch (a causal ring SKIPS
+    invisible hops entirely instead of masking them), the ppermute
+    rotation, carry init, and the final normalization — so the two
+    impls cannot drift apart on schedule or numerics.
     """
+    from tpu_autoscaler.workloads._shard_utils import pvary
+
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-
-    qf = q.astype(jnp.float32) * sm_scale
-    b, h, s_loc, d = qf.shape
+    b, h, s_loc, d = q.shape
 
     def step(t, carry):
         m, l, acc, k_t, v_t = carry
         # k_t/v_t originated on device (my_idx - t) mod axis_size.
         src = (my_idx - t) % axis_size
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qf,
-                            k_t.astype(jnp.float32))   # [b,h,sq,sk]
         if causal:
-            q_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
-            k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-            # Global ordering by block: earlier block -> all visible;
-            # same block -> lower-triangular; later block -> none.
-            block_mask = jnp.where(
-                src < my_idx, True,
-                jnp.where(src == my_idx, q_pos >= k_pos, False))
-            scores = jnp.where(block_mask, scores, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        p = jnp.exp(scores - m_new)
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * correction + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_t.astype(jnp.float32))
+            # 0: later block (invisible) — skip the merge entirely;
+            # 1: own block — lower-triangular; 2: earlier — all visible.
+            mode = jnp.where(src > my_idx, 0,
+                             jnp.where(src == my_idx, 1, 2))
+            m, l, acc = jax.lax.switch(
+                mode,
+                [lambda c: c[:3],
+                 lambda c: merge(c[3], c[4], *c[:3], diag=True),
+                 lambda c: merge(c[3], c[4], *c[:3], diag=False)],
+                (m, l, acc, k_t, v_t))
+        else:
+            m, l, acc = merge(k_t, v_t, m, l, acc, diag=False)
         # Rotate K/V one hop around the ring (ICI neighbor exchange).
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_next = jax.lax.ppermute(k_t, axis_name, perm)
         v_next = jax.lax.ppermute(v_t, axis_name, perm)
-        return m_new, l_new, acc_new, k_next, v_next
+        return m, l, acc, k_next, v_next
 
     # pvary: the accumulators are per-device state (they will differ across
     # the ring), so mark them varying over the axis or the fori_loop carry
     # types mismatch under shard_map's varying-axis tracking.
-    from tpu_autoscaler.workloads._shard_utils import pvary
-
     m0 = pvary(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32), axis_name)
     l0 = pvary(jnp.zeros((b, h, s_loc, 1), jnp.float32), axis_name)
     acc0 = pvary(jnp.zeros((b, h, s_loc, d), jnp.float32), axis_name)
@@ -81,17 +79,75 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
+                     sm_scale: float):
+    """Per-device body under shard_map: einsum per-hop merge.
+
+    q, k, v: [b, h, s_local, d] — this device's sequence block.
+    """
+    qf = q.astype(jnp.float32) * sm_scale
+
+    def merge(k_t, v_t, m, l, acc, diag):
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                            k_t.astype(jnp.float32))   # [b,h,sq,sk]
+        if diag:
+            q_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_t.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    return _ring_driver(q, k, v, axis_name=axis_name, causal=causal,
+                        merge=merge)
+
+
+def _ring_attn_local_pallas(q, k, v, *, axis_name: str, causal: bool,
+                            block_q: int, interpret: bool):
+    """Per-device body: the same ring schedule with the per-hop math
+    fused into the Pallas ring-step kernel (attention.py::
+    ring_flash_step) — the [s_local, s_local] score block of each hop
+    lives in VMEM only, never HBM."""
+    from tpu_autoscaler.workloads.attention import ring_flash_step
+
+    def merge(k_t, v_t, m, l, acc, diag):
+        return ring_flash_step(q, k_t, v_t, m, l, acc, diag=diag,
+                               block_q=block_q, interpret=interpret)
+
+    return _ring_driver(q, k, v, axis_name=axis_name, causal=causal,
+                        merge=merge)
+
+
 def make_ring_attention(mesh: Mesh, seq_axis: str = "sp",
-                        causal: bool = True):
+                        causal: bool = True, impl: str = "einsum",
+                        block_q: int = 128,
+                        interpret: bool | None = None):
     """Build a ring-attention callable for [b, h, s, d] arrays whose
     sequence axis is sharded over ``mesh``'s ``seq_axis``.
 
     Returns a function operating on GLOBAL arrays; shard_map handles the
     decomposition and the ppermute schedule rides the mesh axis.
+
+    ``impl``:
+
+    - ``"einsum"`` (default) — XLA-fused per-hop math, differentiable
+      end-to-end through the ring (use for training).
+    - ``"pallas"`` — each hop's QK^T→softmax-merge→PV is one fused VMEM
+      kernel (attention.py::ring_flash_step), so no per-hop score block
+      round-trips HBM.  The forward is the fused ring; gradients are
+      provided by a custom_vjp that recomputes through the einsum ring
+      (same memory profile as training with ``impl="einsum"``, faster
+      forward — the long-context eval/serving path).
     """
+    if impl not in {"einsum", "pallas"}:
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     spec = P(None, None, seq_axis, None)
 
-    def attn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    def einsum_attn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         d = q.shape[-1]
         body = functools.partial(_ring_attn_local, axis_name=seq_axis,
                                  causal=causal, sm_scale=d ** -0.5)
@@ -99,4 +155,32 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "sp",
             body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         )(q, k, v)
 
+    if impl == "einsum":
+        return einsum_attn
+
+    run_interpret = (jax.default_backend() != "tpu"
+                     if interpret is None else interpret)
+
+    def pallas_forward(q, k, v):
+        body = functools.partial(
+            _ring_attn_local_pallas, axis_name=seq_axis, causal=causal,
+            block_q=block_q, interpret=run_interpret)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return pallas_forward(q, k, v)
+
+    def attn_fwd(q, k, v):
+        return pallas_forward(q, k, v), (q, k, v)
+
+    def attn_bwd(residuals, g):
+        q, k, v = residuals
+        _, vjp = jax.vjp(einsum_attn, q, k, v)
+        return vjp(g)
+
+    attn.defvjp(attn_fwd, attn_bwd)
     return attn
