@@ -169,6 +169,76 @@ class TestTpuPlanning:
         assert len(tpu) == 1
         assert tpu[0].shape_name.startswith("v5p-")
 
+    def test_fair_share_orders_low_usage_namespace_first(self):
+        # team-a already holds 8 chips; team-b holds none.  Equal
+        # priority, team-a's gang OLDER.  Clamp admits only one gang:
+        # fair-share serves team-b, FIFO (default) serves team-a.
+        shape = shape_by_name("v5e-8")
+        bound_nodes = make_slice_nodes(shape, "a-busy")
+        runner = make_tpu_pod(name="a-run", namespace="team-a", chips=8,
+                              job="a-old", phase="Running",
+                              node_name=bound_nodes[0]["metadata"]["name"],
+                              unschedulable=False)
+        pending = (
+            make_gang(shape, job="a-new", namespace="team-a",
+                      created="2026-07-28T10:00:00Z")
+            + make_gang(shape, job="b-new", namespace="team-b",
+                        created="2026-07-28T11:00:00Z"))
+        clamp = PoolPolicy(spare_nodes=0, max_total_chips=16,
+                           fair_share=True)
+        plan = plan_for(pending, node_payloads=bound_nodes,
+                        bound_pods=[runner], policy=clamp)
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 1
+        assert tpu[0].gang_key == ("job", "team-b", "b-new")
+        # Default FIFO: the older gang (team-a) wins instead.
+        fifo = PoolPolicy(spare_nodes=0, max_total_chips=16)
+        plan2 = plan_for(pending, node_payloads=bound_nodes,
+                         bound_pods=[runner], policy=fifo)
+        tpu2 = [r for r in plan2.requests if r.kind == "tpu-slice"]
+        assert len(tpu2) == 1
+        assert tpu2[0].gang_key == ("job", "team-a", "a-new")
+
+    def test_fair_share_reweighs_within_one_pass(self):
+        # Both namespaces start at 0 chips; team-b has TWO older gangs,
+        # team-a one newer.  Clamp admits two 8-chip units: after team-b's
+        # first admission its ledger reads 8 vs team-a's 0, so the second
+        # slot goes to team-a — one each, not b,b.
+        shape = shape_by_name("v5e-8")
+        pending = (
+            make_gang(shape, job="b-1", namespace="team-b",
+                      created="2026-07-28T10:00:00Z")
+            + make_gang(shape, job="b-2", namespace="team-b",
+                        created="2026-07-28T10:30:00Z")
+            + make_gang(shape, job="a-1", namespace="team-a",
+                        created="2026-07-28T11:00:00Z"))
+        plan = plan_for(pending, policy=PoolPolicy(
+            spare_nodes=0, max_total_chips=16, fair_share=True))
+        served = {r.gang_key for r in plan.requests
+                  if r.kind == "tpu-slice"}
+        assert served == {("job", "team-b", "b-1"),
+                          ("job", "team-a", "a-1")}
+
+    def test_fair_share_priority_still_dominates(self):
+        shape = shape_by_name("v5e-8")
+        bound_nodes = make_slice_nodes(shape, "a-busy")
+        runner = make_tpu_pod(name="a-run", namespace="team-a", chips=8,
+                              job="a-old", phase="Running",
+                              node_name=bound_nodes[0]["metadata"]["name"],
+                              unschedulable=False)
+        high = make_gang(shape, job="a-high", namespace="team-a")
+        for p in high:
+            p["spec"]["priority"] = 100
+        pending = high + make_gang(shape, job="b-low", namespace="team-b")
+        plan = plan_for(pending, node_payloads=bound_nodes,
+                        bound_pods=[runner],
+                        policy=PoolPolicy(spare_nodes=0,
+                                          max_total_chips=16,
+                                          fair_share=True))
+        tpu = [r for r in plan.requests if r.kind == "tpu-slice"]
+        assert len(tpu) == 1
+        assert tpu[0].gang_key == ("job", "team-a", "a-high")
+
     def test_spare_slices_warm_pool(self):
         plan = plan_for([], policy=PoolPolicy(
             spare_nodes=0, spare_slices={"v5e-8": 2}))

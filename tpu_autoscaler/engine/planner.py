@@ -68,6 +68,13 @@ class PoolPolicy:
         default_factory=dict)
     # Provision preemptible/spot TPU capacity (BASELINE config #5).
     preemptible: bool = False
+    # Multi-tenant fair-share: when chip budget is contended, serve
+    # equal-priority gangs from the namespace currently using the FEWEST
+    # chips (in use + in flight) first, instead of strict age order —
+    # one namespace cannot monopolize a clamped budget by arriving
+    # first.  Priority still dominates; off by default (reference-like
+    # FIFO within priority).
+    fair_share: bool = False
     # Capacity stockout fallback: when provisioning for an UNPINNED gang
     # keeps failing (quota / stockout), retry on these generations in
     # order (e.g. ("v6e", "v5p")).  Gangs pinned by accelerator/topology
@@ -178,6 +185,37 @@ def _slice_satisfies(members: list[Node], gang: Gang) -> bool:
     per_pod = gang.per_pod_resources
     slots = sum(host_slots(n.allocatable, per_pod) for n in members)
     return slots >= gang.size
+
+
+def _chips_by_namespace(pods: list[Pod],
+                        in_flight: list[InFlight]) -> dict[str, int]:
+    """TPU chips per namespace: bound (Pending/Running) pods plus
+    in-flight slice provisions.  The single source of truth for both
+    quota enforcement and fair-share ordering."""
+    used: dict[str, int] = {}
+    for p in pods:
+        if p.node_name and p.phase in {"Pending", "Running"}:
+            used[p.namespace] = used.get(p.namespace, 0) + p.tpu_chips
+    for f in in_flight:
+        if f.kind == "tpu-slice" and f.gang_key:
+            ns = f.gang_key[1]
+            used[ns] = (used.get(ns, 0)
+                        + shape_by_name(f.shape_name).chips * f.count)
+    return used
+
+
+def _cohort_fair_key(cohort: list[Gang], ns_usage: dict[str, int]):
+    """Admission order under fair-share: priority desc, then namespace
+    chip ledger asc, then age asc (the (None-flag, timestamp) pattern —
+    naive/aware datetimes never compare), then key for determinism."""
+    prio = max(g.priority for g in cohort)
+    ns = cohort[0].namespace
+    times = [g.oldest_created for g in cohort
+             if g.oldest_created is not None]
+    oldest = min(times) if times else None
+    return (-prio, ns_usage.get(ns, 0), oldest is None,
+            oldest.timestamp() if oldest is not None else 0.0,
+            cohort[0].key)
 
 
 class _PlannedNode:
@@ -310,18 +348,12 @@ class Planner:
         planned_chips = 0
         # Per-namespace chip accounting for quota enforcement (enforced at
         # provisioning time: in-use by bound pods + in-flight + planned).
-        ns_chips: dict[str, int] = {}
-        if pol.namespace_chip_quota:
-            for p in pods:
-                if p.node_name and p.phase in {"Pending", "Running"}:
-                    ns_chips[p.namespace] = (ns_chips.get(p.namespace, 0)
-                                             + p.tpu_chips)
-            for f in in_flight:
-                if f.kind == "tpu-slice" and f.gang_key:
-                    ns = f.gang_key[1]
-                    ns_chips[ns] = (
-                        ns_chips.get(ns, 0)
-                        + shape_by_name(f.shape_name).chips * f.count)
+        # One per-namespace chip ledger (in use + in flight, then updated
+        # with planned chips at each admission) serves BOTH quota
+        # enforcement and fair-share ordering — one algebra, no drift.
+        ns_chips: dict[str, int] = (
+            _chips_by_namespace(pods, in_flight)
+            if pol.namespace_chip_quota or pol.fair_share else {})
 
         def match_free(gang: Gang) -> str | None:
             # An existing fully-free matching slice satisfies the gang; the
@@ -376,7 +408,15 @@ class Planner:
             batch_choose_shapes(decisions, pol.default_generation)
             if len(decisions) >= pol.native_fit_threshold else {})
 
-        for cohort in cohorts:
+        remaining = list(cohorts)
+        while remaining:
+            if pol.fair_share:
+                # Re-weigh EVERY admission: each admitted unit raises its
+                # namespace's ledger, so the next pick goes to whichever
+                # namespace now uses the least — a single low-usage
+                # namespace cannot capture every slot in one pass.
+                remaining.sort(key=lambda c: _cohort_fair_key(c, ns_chips))
+            cohort = remaining.pop(0)
             members: list[tuple[Gang, object]] = []
             for g in cohort:
                 if g.key in batch_choices:
@@ -412,16 +452,15 @@ class Planner:
                                 f"{pol.max_total_chips} (at {new_total})"))
                     continue
                 ns = gangs_u[0].namespace
+                ns_new = ns_chips.get(ns, 0) + unit_chips
                 quota = pol.namespace_chip_quota.get(ns)
-                if quota is not None:
-                    ns_new = ns_chips.get(ns, 0) + unit_chips
-                    if ns_new > quota:
-                        for g in gangs_u:
-                            plan.unsatisfiable.append(
-                                (g, f"namespace {ns!r} chip quota "
-                                    f"{quota} exceeded (at {ns_new})"))
-                        continue
-                    ns_chips[ns] = ns_new
+                if quota is not None and ns_new > quota:
+                    for g in gangs_u:
+                        plan.unsatisfiable.append(
+                            (g, f"namespace {ns!r} chip quota "
+                                f"{quota} exceeded (at {ns_new})"))
+                    continue
+                ns_chips[ns] = ns_new
                 planned_chips += unit_chips
                 stranded = sum(c.stranded_chips for _, c in unit)
                 if n == 1:

@@ -25,7 +25,8 @@ from tpu_autoscaler.topology.catalog import cpu_shape_by_name
 
 def _policy(default_generation, generation_fallbacks, cpu_machine_type,
             over_provision, spare_agents, spare_slices, namespace_quotas,
-            max_cpu_nodes, max_total_chips, preemptible) -> PoolPolicy:
+            max_cpu_nodes, max_total_chips, preemptible,
+            fair_share=False) -> PoolPolicy:
     from tpu_autoscaler.topology.catalog import (
         SLICE_SHAPES,
         shapes_for_generation,
@@ -91,6 +92,7 @@ def _policy(default_generation, generation_fallbacks, cpu_machine_type,
         max_cpu_nodes=max_cpu_nodes,
         max_total_chips=max_total_chips,
         preemptible=preemptible,
+        fair_share=fair_share,
     )
 
 
@@ -179,6 +181,10 @@ _common = [
     click.option("--max-total-chips", default=4096, show_default=True),
     click.option("--preemptible", is_flag=True,
                  help="Provision spot/preemptible TPU capacity."),
+    click.option("--fair-share", is_flag=True,
+                 help="Serve equal-priority gangs from the namespace "
+                      "using the fewest chips first (multi-tenant "
+                      "fairness under a contended chip budget)."),
     click.option("--no-scale", is_flag=True),
     click.option("--no-maintenance", is_flag=True),
     click.option("--slack-hook", default=None,
@@ -203,9 +209,9 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            provision_timeout, preemption, spare_agents, spare_slices,
            namespace_quotas, over_provision,
            default_generation, generation_fallbacks, cpu_machine_type,
-           max_cpu_nodes, max_total_chips, preemptible, no_scale,
-           no_maintenance, slack_hook, slack_channel, metrics_port,
-           log_json, verbose) -> Controller:
+           max_cpu_nodes, max_total_chips, preemptible, fair_share,
+           no_scale, no_maintenance, slack_hook, slack_channel,
+           metrics_port, log_json, verbose) -> Controller:
     from tpu_autoscaler.logging_setup import setup_logging
 
     setup_logging(verbose=verbose, json_format=log_json)
@@ -218,7 +224,8 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         policy=_policy(default_generation, generation_fallbacks,
                        cpu_machine_type, over_provision,
                        spare_agents, spare_slices, namespace_quotas,
-                       max_cpu_nodes, max_total_chips, preemptible),
+                       max_cpu_nodes, max_total_chips, preemptible,
+                       fair_share),
         grace_seconds=grace_period,
         idle_threshold_seconds=idle_threshold,
         drain_grace_seconds=drain_grace,
