@@ -145,14 +145,18 @@ def _impl_step(small: bool) -> None:
     batch = jax.random.randint(jax.random.PRNGKey(1),
                                (batch_size, cfg.seq_len + 1), 0, cfg.vocab,
                                dtype=jnp.int32)
-    # Warmup (compile) then timed steps.
+    # Warmup (compile) then timed steps.  Sync via an actual device->host
+    # transfer, not block_until_ready: through this image's axon relay
+    # block_until_ready returns at dispatch time (round-1 capture showed
+    # a physically impossible 102% MFU), while fetching the scalar loss
+    # cannot complete before the step it depends on has.
     for _ in range(2):
         params, opt_state, loss = step_fn(params, opt_state, batch)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, loss = step_fn(params, opt_state, batch)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     step_s = (time.perf_counter() - t0) / iters
 
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
@@ -199,13 +203,20 @@ def _impl_attn(small: bool) -> None:
     def ref(q, k, v):
         return reference_attention(q, k, v, causal=True)
 
+    def sync(out):
+        # Real device->host fetch of a tiny slice: forces completion of
+        # the whole computation it depends on (see _impl_step note on the
+        # axon relay's non-blocking block_until_ready).
+        leaf = out[0] if isinstance(out, tuple) else out
+        jax.device_get(leaf[(0,) * (leaf.ndim - 1) + (slice(0, 1),)])
+
     def timed(fn):
         f = jax.jit(fn)
-        jax.block_until_ready(f(q, k, v))  # compile
+        sync(f(q, k, v))  # compile
         t0 = time.perf_counter()
         for _ in range(iters):
             out = f(q, k, v)
-        jax.block_until_ready(out)
+        sync(out)
         return (time.perf_counter() - t0) / iters
 
     def grad_of(fn):
