@@ -70,6 +70,22 @@ def _block_visible(qi, ki, block_q: int, block_k: int, causal: bool):
     return (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
 
 
+def _online_softmax_merge(scores, v, m_prev, l_prev, acc_prev):
+    """Merge one score tile into the flash carry (m, l, acc).
+
+    The single source of truth for the online-softmax update, shared by
+    the k-block loop of the forward kernel and the cross-device hop of
+    the ring kernel (same math, different iteration axis)."""
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    p = jnp.exp(scores - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc_prev * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
 # --------------------------------------------------------------------------
 # Forward: grid (b*h, q-blocks, k-blocks), k innermost; carry in scratch
 # --------------------------------------------------------------------------
@@ -98,16 +114,8 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32)           # [bq, bk]
         if causal:
             scores = _block_mask(scores, qi, ki, block_q, block_k)
-        m_prev, l_prev, acc_prev = m_scr[...], l_scr[...], acc_scr[...]
-        m_new = jnp.maximum(m_prev,
-                            jnp.max(scores, axis=-1, keepdims=True))
-        p = jnp.exp(scores - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        m_scr[...] = m_new
-        l_scr[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[...] = acc_prev * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        m_scr[...], l_scr[...], acc_scr[...] = _online_softmax_merge(
+            scores, v, m_scr[...], l_scr[...], acc_scr[...])
 
     @pl.when(ki == n_kb - 1)
     def _finish():
@@ -384,21 +392,11 @@ def _ring_step_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)               # [bq, sk]
     if diag:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0)
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
-    m_prev = m_ref[0]                                      # [bq, 1]
-    l_prev = l_ref[0]
-    acc_prev = acc_ref[0]                                  # [bq, d]
-    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-    p = jnp.exp(scores - m_new)
-    corr = jnp.exp(m_prev - m_new)
-    m_out[0] = m_new
-    l_out[0] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_out[0] = acc_prev * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        # The diag hop's visible keys start at this shard's position 0,
+        # i.e. k-block index 0 with a k-block offset of ki*block_k == 0.
+        scores = _block_mask(scores, qi, 0, block_q, 0)
+    m_out[0], l_out[0], acc_out[0] = _online_softmax_merge(
+        scores, v, m_ref[0], l_ref[0], acc_ref[0])
 
 
 def ring_flash_step(q, k_t, v_t, m, l, acc, *, diag: bool,
