@@ -96,6 +96,30 @@ class TestFlashAttention:
 
 
 class TestModelIntegration:
+    def test_auto_attention_resolution(self):
+        # "auto" must resolve per backend (einsum off-TPU), and the
+        # sharded train step must never route "auto" onto the Pallas
+        # kernel for a multi-device mesh — GSPMD cannot auto-partition a
+        # custom kernel, so that combination only fails on real
+        # multi-chip hardware where no CI runs.
+        import numpy as onp
+        from jax.sharding import Mesh
+
+        from tpu_autoscaler.workloads import model as m
+
+        cfg = m.ModelConfig()
+        assert cfg.attention == "auto"
+        assert cfg.resolved_attention() == (
+            "pallas" if jax.default_backend() == "tpu" else "einsum")
+        devs = jax.devices()
+        multi = Mesh(onp.asarray(devs).reshape(-1), axis_names=("data",))
+        assert multi.size > 1
+        assert cfg.resolved_for_mesh(multi).attention == "einsum"
+        single = Mesh(onp.asarray(devs[:1]), axis_names=("data",))
+        assert cfg.resolved_for_mesh(single).attention == "auto"
+        explicit = m.ModelConfig(attention="pallas")
+        assert explicit.resolved_for_mesh(multi).attention == "pallas"
+
     def test_pallas_attention_matches_einsum_forward(self):
         import dataclasses as dc
 

@@ -329,8 +329,8 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = True, block_q: int = 128,
-                    block_k: int = 512,
+                    causal: bool = True, block_q: int = 512,
+                    block_k: int = 1024,
                     interpret: bool = False) -> jax.Array:
     """q, k, v: [batch, heads, seq, head_dim] -> same-shaped output.
 
@@ -343,7 +343,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 
 def make_sharded_flash_attention(mesh, *, causal: bool = True,
-                                 block_q: int = 128, block_k: int = 512,
+                                 block_q: int = 512, block_k: int = 1024,
                                  batch_axis: str = "data",
                                  head_axis: str = "model"):
     """Run the fused kernel under a dp/tp mesh via shard_map.
