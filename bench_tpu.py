@@ -119,6 +119,38 @@ def _impl_probe() -> None:
                       "n_devices": len(jax.devices())}))
 
 
+def _sync(x) -> None:
+    """Force completion via a real device->host fetch of a tiny slice.
+
+    Through this image's axon relay, block_until_ready returns at
+    dispatch time (round-1 capture showed a physically impossible 102%
+    MFU); a transfer cannot complete before the computation it depends
+    on has."""
+    import jax
+
+    jax.device_get(x[(0,) * (x.ndim - 1) + (slice(0, 1),)])
+
+
+def _scanned(op, q, k, v, n_apps: int):
+    """One jitted program applying ``op`` n_apps times with a serial
+    dependency (output feeds back as q), so a single dispatch amortizes
+    the host->relay->device round trip (~6 ms — measured larger than the
+    ops themselves, compressing every per-call speedup toward 1x)."""
+    import jax
+
+    @jax.jit
+    def many(q, k, v):
+        def body(c, _):
+            return op(c, k, v).astype(c.dtype), ()
+        out, _ = jax.lax.scan(body, q, None, length=n_apps)
+        return out
+
+    _sync(many(q, k, v))  # compile
+    t0 = time.perf_counter()
+    _sync(many(q, k, v))
+    return (time.perf_counter() - t0) / n_apps
+
+
 def _impl_step(small: bool) -> None:
     import jax
     import jax.numpy as jnp
@@ -147,11 +179,8 @@ def _impl_step(small: bool) -> None:
     batch = jax.random.randint(jax.random.PRNGKey(1),
                                (batch_size, cfg.seq_len + 1), 0, cfg.vocab,
                                dtype=jnp.int32)
-    # Warmup (compile) then timed steps.  Sync via an actual device->host
-    # transfer, not block_until_ready: through this image's axon relay
-    # block_until_ready returns at dispatch time (round-1 capture showed
-    # a physically impossible 102% MFU), while fetching the scalar loss
-    # cannot complete before the step it depends on has.
+    # Warmup (compile) then timed steps; _sync-style device_get forces
+    # real completion (see _sync).
     for _ in range(2):
         params, opt_state, loss = step_fn(params, opt_state, batch)
     float(jax.device_get(loss))
@@ -171,7 +200,7 @@ def _impl_step(small: bool) -> None:
     mfu = flops / (step_s * peak) if peak else None
     print(json.dumps({
         "device_kind": dev.device_kind,
-        "attention": cfg.resolved_attention(),
+        "attention": cfg.resolved_for_mesh(mesh).resolved_attention(),
         "batch_size": batch_size,
         "n_params": n_params,
         "step_seconds": round(step_s, 5),
@@ -207,49 +236,22 @@ def _impl_attn(small: bool) -> None:
     def ref(q, k, v):
         return reference_attention(q, k, v, causal=True)
 
-    def sync(x):
-        # Real device->host fetch of a tiny slice: forces completion of
-        # the whole computation it depends on (see _impl_step note on the
-        # axon relay's non-blocking block_until_ready).
-        jax.device_get(x[(0,) * (x.ndim - 1) + (slice(0, 1),)])
-
-    # n_apps serially-dependent applications inside ONE jitted scan, so a
-    # single dispatch amortizes the host->relay->device round trip (~6 ms
-    # here — measured larger than the op itself, so per-call timing only
-    # measured the relay, compressing every speedup toward 1x).
-    def timed_fwd(op):
-        @jax.jit
-        def many(q, k, v):
-            def body(c, _):
-                return op(c, k, v).astype(c.dtype), ()
-            out, _ = jax.lax.scan(body, q, None, length=n_apps)
-            return out
-        sync(many(q, k, v))  # compile
-        t0 = time.perf_counter()
-        sync(many(q, k, v))
-        return (time.perf_counter() - t0) / n_apps
-
-    def timed_grad(op):
+    def grad_op(op):
         # All three grads, folded into the carry so none is dead code —
         # argnums=(0,) would let XLA eliminate the whole dk/dv kernel.
         g = jax.grad(
             lambda q, k, v: op(q, k, v).astype(jnp.float32).sum(),
             argnums=(0, 1, 2))
 
-        @jax.jit
-        def many(q, k, v):
-            def body(c, _):
-                dq, dk, dv = g(c, k, v)
-                return (dq + dk + dv).astype(c.dtype), ()
-            out, _ = jax.lax.scan(body, q, None, length=n_apps)
-            return out
-        sync(many(q, k, v))  # compile
-        t0 = time.perf_counter()
-        sync(many(q, k, v))
-        return (time.perf_counter() - t0) / n_apps
+        def combined(c, k, v):
+            dq, dk, dv = g(c, k, v)
+            return dq + dk + dv
+        return combined
 
-    fwd_flash, fwd_ref = timed_fwd(flash), timed_fwd(ref)
-    bwd_flash, bwd_ref = timed_grad(flash), timed_grad(ref)
+    fwd_flash = _scanned(flash, q, k, v, n_apps)
+    fwd_ref = _scanned(ref, q, k, v, n_apps)
+    bwd_flash = _scanned(grad_op(flash), q, k, v, n_apps)
+    bwd_ref = _scanned(grad_op(ref), q, k, v, n_apps)
     print(json.dumps({
         "shape": [b, h, s, d],
         "dtype": str(dtype.__name__ if hasattr(dtype, "__name__")
@@ -270,6 +272,74 @@ def _impl_attn(small: bool) -> None:
 # --------------------------------------------------------------------------
 
 
+def _impl_longctx(small: bool) -> None:
+    """Long-context evidence: the flash kernel at sequence lengths where
+    einsum attention cannot exist (scores alone exceed HBM), plus a
+    remat'd train step at 8k tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_autoscaler.workloads.attention import flash_attention
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if small:
+        b, h, s, d, n_apps = 1, 2, 256, 32, 2
+        dtype = jnp.float32
+    else:
+        # At [2, 8, 16384, 128] the einsum path's f32 scores would be
+        # 2*8*16384^2 * 4B = 16 GiB > 15.75 GiB usable HBM by themselves.
+        b, h, s, d, n_apps = 2, 8, 16384, 128, 5
+        dtype = jnp.bfloat16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), dtype) for kk in ks)
+
+    attn_s = _scanned(
+        lambda c, k, v: flash_attention(c, k, v, causal=True,
+                                        interpret=on_cpu),
+        q, k, v, n_apps)
+    # Causal attention flops: 4*b*h*s^2*d (QK^T + PV), halved by the mask.
+    attn_flops = 2.0 * b * h * s * s * d
+
+    rec = {
+        "attn_shape": [b, h, s, d],
+        "attn_seconds_per_app": round(attn_s, 6),
+        "attn_tflops": round(attn_flops / attn_s / 1e12, 1),
+        "einsum_feasible": bool(small),
+    }
+
+    if not small:
+        from tpu_autoscaler.workloads.model import (
+            ModelConfig,
+            make_mesh,
+            make_sharded_train_step,
+        )
+
+        cfg = ModelConfig(vocab=32768, d_model=1024, n_layers=4,
+                          n_heads=8, d_ff=4096, seq_len=8192, remat=True)
+        mesh = make_mesh([jax.devices()[0]])
+        init_fn, step_fn = make_sharded_train_step(mesh, cfg)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        batch = jax.random.randint(jax.random.PRNGKey(1),
+                                   (2, cfg.seq_len + 1), 0, cfg.vocab,
+                                   dtype=jnp.int32)
+        for _ in range(2):
+            params, opt_state, loss = step_fn(params, opt_state, batch)
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            params, opt_state, loss = step_fn(params, opt_state, batch)
+        float(jax.device_get(loss))
+        step_s = (time.perf_counter() - t0) / 5
+        tokens = 2 * cfg.seq_len
+        rec.update({
+            "train_seq_len": cfg.seq_len,
+            "train_remat": True,
+            "train_step_seconds": round(step_s, 5),
+            "train_tokens_per_second": round(tokens / step_s, 1),
+        })
+    print(json.dumps(rec))
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--cpu-smoke", action="store_true",
@@ -277,7 +347,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--probe-timeout", type=float, default=90.0)
     ap.add_argument("--measure-timeout", type=float, default=900.0)
     ap.add_argument("--out", default=DEFAULT_OUT)
-    ap.add_argument("--impl", choices=["probe", "step", "attn"],
+    ap.add_argument("--impl", choices=["probe", "step", "attn", "longctx"],
                     help=argparse.SUPPRESS)  # internal subprocess entry
     ap.add_argument("--small", action="store_true",
                     help=argparse.SUPPRESS)
@@ -286,7 +356,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.impl:
         {"probe": _impl_probe,
          "step": lambda: _impl_step(args.small),
-         "attn": lambda: _impl_attn(args.small)}[args.impl]()
+         "attn": lambda: _impl_attn(args.small),
+         "longctx": lambda: _impl_longctx(args.small)}[args.impl]()
         return 0
 
     env = _cpu_env() if args.cpu_smoke else _tpu_env()
@@ -306,12 +377,16 @@ def main(argv: list[str] | None = None) -> int:
             [me, "--impl", "step"] + extra, env, args.measure_timeout)
         record["attention"] = _run_bounded(
             [me, "--impl", "attn"] + extra, env, args.measure_timeout)
+        record["long_context"] = _run_bounded(
+            [me, "--impl", "longctx"] + extra, env, args.measure_timeout)
     else:
         reason = record["probe"].get("skipped", "probe failed")
         record["train_step"] = {"ok": False,
                                 "skipped": f"backend probe: {reason}"}
         record["attention"] = {"ok": False,
                                "skipped": f"backend probe: {reason}"}
+        record["long_context"] = {"ok": False,
+                                  "skipped": f"backend probe: {reason}"}
 
     with open(args.out, "w") as f:
         json.dump(record, f, indent=1)

@@ -112,8 +112,9 @@ class TestModelIntegration:
         assert cfg.resolved_attention() == (
             "pallas" if jax.default_backend() == "tpu" else "einsum")
         devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs >=2 devices for the multi-device mesh")
         multi = Mesh(onp.asarray(devs).reshape(-1), axis_names=("data",))
-        assert multi.size > 1
         assert cfg.resolved_for_mesh(multi).attention == "einsum"
         single = Mesh(onp.asarray(devs[:1]), axis_names=("data",))
         assert cfg.resolved_for_mesh(single).attention == "auto"
