@@ -95,6 +95,78 @@ class TestFlashAttention:
                                    rtol=2e-5, atol=2e-5)
 
 
+class TestGroupedQueryAttention:
+    """GQA/MQA: kv_heads < heads, shared at the kernel index-map level."""
+
+    def rand_gqa(self, key, b=2, h=8, h_kv=2, s=64, d=32,
+                 dtype=jnp.float32):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(key), 3)
+        return (jax.random.normal(kq, (b, h, s, d), dtype),
+                jax.random.normal(kk, (b, h_kv, s, d), dtype),
+                jax.random.normal(kv, (b, h_kv, s, d), dtype))
+
+    @pytest.mark.parametrize("h_kv", [1, 2, 4])  # MQA .. GQA
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, h_kv, causal):
+        q, k, v = self.rand_gqa(10, h=4, h_kv=h_kv)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_multi_block_grads_match(self, causal):
+        # Small blocks force several (q-head-in-group, q-block) inner
+        # iterations in the dkv kernel's accumulation.
+        q, k, v = self.rand_gqa(11, h=4, h_kv=2, s=64, d=16)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+        grads = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=16, block_k=16,
+            interpret=True)), argnums=(0, 1, 2))(q, k, v)
+        ref_grads = jax.grad(loss(lambda q, k, v: reference_attention(
+            q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+        for g, rg in zip(grads, ref_grads):
+            assert g.shape == rg.shape
+            np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_kv_grads_have_kv_shape(self):
+        q, k, v = self.rand_gqa(12, h=4, h_kv=2, s=32, d=16)
+        grads = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, interpret=True) ** 2),
+            argnums=(1, 2))(q, k, v)
+        assert grads[0].shape == k.shape
+        assert grads[1].shape == v.shape
+
+    def test_indivisible_heads_rejected(self):
+        q, k, v = self.rand_gqa(13, h=4, h_kv=3, s=32, d=16)
+        with pytest.raises(ValueError, match="multiple of kv heads"):
+            flash_attention(q, k, v, interpret=True)
+
+    def test_kv_shape_mismatch_rejected(self):
+        q, k, v = self.rand_gqa(14, h=4, h_kv=2, s=32, d=16)
+        with pytest.raises(ValueError, match="k/v shape mismatch"):
+            flash_attention(q, k, v[:, :1], interpret=True)
+
+    def test_shorter_kv_seq_rejected(self):
+        # Cross-attention / KV-cache shapes are out of scope: silently
+        # clamped index maps would repeat keys, not error.
+        q, k, v = self.rand_gqa(15, h=4, h_kv=4, s=32, d=16)
+        with pytest.raises(ValueError, match="share batch, seq"):
+            flash_attention(q, k[:, :, :16], v[:, :, :16], interpret=True)
+
+    def test_zero_or_negative_kv_heads_rejected(self):
+        from tpu_autoscaler.workloads.model import ModelConfig
+
+        for bad in (0, -2):
+            with pytest.raises(ValueError, match="n_kv_heads must be"):
+                ModelConfig(n_heads=4, n_kv_heads=bad)
+
+
 class TestModelIntegration:
     def test_auto_attention_resolution(self):
         # "auto" must resolve per backend (einsum off-TPU), and the
@@ -120,6 +192,52 @@ class TestModelIntegration:
         assert cfg.resolved_for_mesh(single).attention == "auto"
         explicit = m.ModelConfig(attention="pallas")
         assert explicit.resolved_for_mesh(multi).attention == "pallas"
+
+    def test_gqa_model_pallas_matches_einsum(self):
+        import dataclasses as dc
+
+        from tpu_autoscaler.workloads.model import (
+            ModelConfig,
+            forward,
+            init_params,
+        )
+
+        cfg_e = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, d_ff=64, seq_len=16,
+                            dtype=jnp.float32, attention="einsum")
+        cfg_p = dc.replace(cfg_e, attention="pallas")
+        params = init_params(jax.random.PRNGKey(0), cfg_e)
+        assert params["blocks"]["qkv"].shape == (2, 32, 32 + 2 * 2 * 8)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64,
+                                    dtype=jnp.int32)
+        out_e = forward(params, tokens, cfg_e)
+        out_p = forward(params, tokens, cfg_p)
+        np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_p),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_gqa_train_step_grads_finite(self):
+        from tpu_autoscaler.workloads.model import (
+            ModelConfig,
+            init_params,
+            loss_fn,
+        )
+
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4,
+                          n_kv_heads=1, d_ff=64, seq_len=16,
+                          dtype=jnp.float32, attention="pallas")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 64,
+                                    dtype=jnp.int32)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        assert np.isfinite(float(loss))
+        assert all(np.all(np.isfinite(np.asarray(g)))
+                   for g in jax.tree.leaves(grads))
+
+    def test_gqa_indivisible_rejected(self):
+        from tpu_autoscaler.workloads.model import ModelConfig
+
+        with pytest.raises(ValueError, match="multiple of n_kv_heads"):
+            ModelConfig(n_heads=4, n_kv_heads=3)
 
     def test_pallas_attention_matches_einsum_forward(self):
         import dataclasses as dc

@@ -124,12 +124,29 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_scr[...] + jnp.log(l)
 
 
+def _kv_head_map(h: int, h_kv: int):
+    """Fold-space index of the KV head serving fold-space q-head ``bh``.
+
+    GQA: h query heads share h_kv KV heads in contiguous groups (head g
+    reads KV head g // (h // h_kv)); with h == h_kv this is identity
+    (MHA), with h_kv == 1 it is MQA.  Pure index arithmetic, so KV blocks
+    are shared at the DMA level — never materialized per q-head."""
+    group = h // h_kv
+
+    def to_kv(bh):
+        return (bh // h) * h_kv + (bh % h) // group
+
+    return to_kv
+
+
 def _forward_pallas(q, k, v, causal, block_q, block_k, interpret):
     b, h, s, d = q.shape
+    h_kv = k.shape[1]
     block_q = _fit_block(s, block_q)
     block_k = _fit_block(s, block_k)
     n_kb = s // block_k
     sm_scale = d ** -0.5
+    kv_of = _kv_head_map(h, h_kv)
 
     fold = _fold_heads
     kernel = functools.partial(
@@ -140,8 +157,10 @@ def _forward_pallas(q, k, v, causal, block_q, block_k, interpret):
         grid=(b * h, s // block_q, n_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki: (kv_of(bh), ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki: (kv_of(bh), ki, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -211,11 +230,15 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dk_ref, dv_ref, dk_scr, dv_scr, *,
                          sm_scale: float, causal: bool, block_q: int,
-                         block_k: int, n_qb: int):
+                         block_k: int, n_qb: int, n_inner: int):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    # Inner axis enumerates (q-head-in-group, q-block) pairs: each KV
+    # head accumulates dk/dv over every q-head of its GQA group and
+    # every q-block (n_inner == group * n_qb; MHA is group == 1).
+    inner = pl.program_id(2)
+    qi = inner % n_qb
 
-    @pl.when(qi == 0)
+    @pl.when(inner == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -238,7 +261,7 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
 
-    @pl.when(qi == n_qb - 1)
+    @pl.when(inner == n_inner - 1)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -247,10 +270,13 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _backward_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
                      interpret):
     b, h, s, d = q.shape
+    h_kv = k.shape[1]
+    group = h // h_kv
     block_q = _fit_block(s, block_q)
     block_k = _fit_block(s, block_k)
     n_qb, n_kb = s // block_q, s // block_k
     sm_scale = d ** -0.5
+    kv_of = _kv_head_map(h, h_kv)
 
     # delta = rowsum(do * o): cheap elementwise, fused by XLA outside.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -260,43 +286,53 @@ def _backward_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
     fq, fk, fv, fdo = fold(q), fold(k), fold(v), fold(do)
     flse, fdelta = fold(lse), fold(delta)
 
-    qspec = lambda i: pl.BlockSpec(  # noqa: E731
-        (1, block_q, d), lambda bh, a, b_: (bh, (a, b_)[i], 0))
-    kspec = lambda i: pl.BlockSpec(  # noqa: E731
-        (1, block_k, d), lambda bh, a, b_: (bh, (a, b_)[i], 0))
-    rspec = lambda i: pl.BlockSpec(  # noqa: E731
-        (1, block_q, 1), lambda bh, a, b_: (bh, (a, b_)[i], 0))
-
+    # dq: grid (b*h, q-blocks, k-blocks), k innermost; KV heads mapped.
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    rspec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0))
+    kspec = pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki: (kv_of(bh), ki, 0))
     dq = pl.pallas_call(
         functools.partial(
             _attn_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, n_kb=n_kb),
-        grid=(b * h, n_qb, n_kb),                         # k innermost
-        in_specs=[qspec(0), kspec(1), kspec(1), qspec(0), rspec(0),
-                  rspec(0)],
-        out_specs=qspec(0),
+        grid=(b * h, n_qb, n_kb),
+        in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
+        out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(fq, fk, fv, fdo, flse, fdelta)
 
+    # dk/dv: grid (b*h_kv, k-blocks, group*q-blocks) — the inner axis
+    # walks every (q-head-in-group, q-block) pair feeding this KV head.
+    def q_of(bhk, inner):
+        return ((bhk // h_kv) * h + (bhk % h_kv) * group + inner // n_qb,
+                inner % n_qb, 0)
+
+    qspec_g = pl.BlockSpec((1, block_q, d),
+                           lambda bhk, ki, inner: q_of(bhk, inner))
+    rspec_g = pl.BlockSpec((1, block_q, 1),
+                           lambda bhk, ki, inner: q_of(bhk, inner))
+    kspec_g = pl.BlockSpec((1, block_k, d),
+                           lambda bhk, ki, inner: (bhk, ki, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _attn_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, n_qb=n_qb),
-        grid=(b * h, n_kb, n_qb),                         # q innermost
-        in_specs=[qspec(1), kspec(0), kspec(0), qspec(1), rspec(1),
-                  rspec(1)],
-        out_specs=(kspec(0), kspec(0)),
-        out_shape=(jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)),
+            block_q=block_q, block_k=block_k, n_qb=n_qb,
+            n_inner=group * n_qb),
+        grid=(b * h_kv, n_kb, group * n_qb),
+        in_specs=[qspec_g, kspec_g, kspec_g, qspec_g, rspec_g, rspec_g],
+        out_specs=(kspec_g, kspec_g),
+        out_shape=(jax.ShapeDtypeStruct((b * h_kv, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h_kv, s, d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(fq, fk, fv, fdo, flse, fdelta)
 
-    unfold = lambda x: x.reshape(b, h, s, d)  # noqa: E731
-    return unfold(dq), unfold(dk), unfold(dv)
+    unfold_q = lambda x: x.reshape(b, h, s, d)  # noqa: E731
+    unfold_kv = lambda x: x.reshape(b, h_kv, s, d)  # noqa: E731
+    return unfold_q(dq), unfold_kv(dk), unfold_kv(dv)
 
 
 # --------------------------------------------------------------------------
@@ -332,13 +368,33 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, block_q: int = 512,
                     block_k: int = 1024,
                     interpret: bool = False) -> jax.Array:
-    """q, k, v: [batch, heads, seq, head_dim] -> same-shaped output.
+    """q: [batch, heads, seq, head_dim]; k, v: [batch, kv_heads, seq,
+    head_dim] with heads % kv_heads == 0 -> output shaped like q.
+
+    kv_heads == heads is classic MHA; kv_heads < heads is GQA (MQA at
+    kv_heads == 1): contiguous groups of heads // kv_heads query heads
+    share one KV head, wired at the kernel index-map level so shared KV
+    blocks are never materialized per q-head.
 
     Differentiable end-to-end in Pallas: forward is the KV-blocked
     online-softmax kernel (saving lse), backward the pair of blocked
     recompute-p kernels via custom_vjp — no [s, s] tensor touches HBM or
     VMEM in either direction.
     """
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"query heads ({q.shape[1]}) must be a multiple of kv heads "
+            f"({k.shape[1]})")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if (q.shape[0], q.shape[2], q.shape[3]) != (
+            k.shape[0], k.shape[2], k.shape[3]):
+        # Self-attention only: a shorter KV (cross-attention / KV-cache
+        # shape) would make the KV index maps read out of range, which
+        # Pallas clamps to the last block — silently wrong output.
+        raise ValueError(
+            f"q and k/v must share batch, seq and head_dim; got q "
+            f"{q.shape} vs kv {k.shape}")
     return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
 
 
@@ -435,7 +491,15 @@ def ring_flash_step(q, k_t, v_t, m, l, acc, *, diag: bool,
 
 
 def reference_attention(q, k, v, *, causal=True):
-    """Plain einsum attention, the numerics oracle for the kernel."""
+    """Plain einsum attention, the numerics oracle for the kernel.
+
+    Accepts the same GQA layout as flash_attention (kv_heads dividing
+    heads), materializing the repeat the straightforward HBM-hungry way.
+    """
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     d = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * d ** -0.5

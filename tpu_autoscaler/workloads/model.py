@@ -37,6 +37,11 @@ class ModelConfig:
     n_heads: int = 4
     d_ff: int = 512
     seq_len: int = 64
+    # GQA: number of shared KV heads (llama-family layout); None means
+    # n_heads (classic MHA), 1 is MQA.  Shrinks the KV projection and,
+    # on the Pallas path, shares KV blocks across the head group at the
+    # kernel index-map level.
+    n_kv_heads: int | None = None
     dtype: Any = jnp.bfloat16
     # "auto" (default): the fused Pallas flash kernel on TPU, einsum
     # elsewhere.  "einsum" auto-partitions under pjit; "pallas"
@@ -56,6 +61,13 @@ class ModelConfig:
             raise ValueError(
                 f"unknown attention impl {self.attention!r}; "
                 "expected 'auto', 'einsum' or 'pallas'")
+        if self.n_kv_heads is not None and self.n_kv_heads < 1:
+            raise ValueError(f"n_kv_heads must be >= 1, got "
+                             f"{self.n_kv_heads}")
+        if self.n_heads % self.kv_heads:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must be a multiple of "
+                f"n_kv_heads ({self.kv_heads})")
 
     def resolved_attention(self) -> str:
         """'auto' -> the fast impl for the ambient backend (resolved at
@@ -82,6 +94,11 @@ class ModelConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None \
+            else self.n_heads
+
 
 def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
     """Stacked-layer params (leading dim = layer) for lax.scan."""
@@ -94,7 +111,11 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
     return {
         "embed": norm(k_emb, (cfg.vocab, d), 0.02),
         "blocks": {
-            "qkv": norm(k_qkv, (L, d, 3 * d), d ** -0.5),
+            # q projection (d wide) + k and v projections (kv_heads *
+            # head_dim wide each); equals 3*d for MHA.
+            "qkv": norm(k_qkv,
+                        (L, d, d + 2 * cfg.kv_heads * cfg.head_dim),
+                        d ** -0.5),
             "attn_out": norm(k_o, (L, d, d), d ** -0.5),
             "w1": norm(k_w1, (L, d, f), d ** -0.5),
             "w2": norm(k_w2, (L, f, d), f ** -0.5),
@@ -117,12 +138,13 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
     b, s, d = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
 
+    hkv = cfg.kv_heads
     y = _rmsnorm(x, layer["ln1"])
     qkv = jnp.einsum("bsd,de->bse", y, layer["qkv"].astype(cfg.dtype))
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [d, d + hkv * hd], axis=-1)
     q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
-    k = k.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
-    v = v.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, hkv, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, hkv, hd).transpose(0, 2, 1, 3)
     if cfg.resolved_attention() == "pallas":
         from tpu_autoscaler.workloads.attention import flash_attention
 
@@ -130,6 +152,9 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
             q, k, v, causal=True,
             interpret=jax.default_backend() != "tpu")
     else:
+        if hkv != h:
+            k = jnp.repeat(k, h // hkv, axis=1)
+            v = jnp.repeat(v, h // hkv, axis=1)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
         causal = jnp.tril(jnp.ones((s, s), bool))
         scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
