@@ -51,23 +51,37 @@ def _fold_heads(x: jax.Array) -> jax.Array:
     return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
 
 
-def _block_mask(scores, qi, ki, block_q, block_k):
-    """Causal mask for one [block_q, block_k] score tile."""
+def _block_mask(scores, qi, ki, block_q, block_k, window=None):
+    """Causal (optionally sliding-window) mask for one [block_q,
+    block_k] score tile: key visible iff q_pos - window < k_pos <=
+    q_pos."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, scores.shape, 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, scores.shape, 1)
-    return jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    keep = q_pos >= k_pos
+    if window is not None:
+        keep &= q_pos - k_pos < window
+    return jnp.where(keep, scores, NEG_INF)
 
 
-def _block_visible(qi, ki, block_q: int, block_k: int, causal: bool):
+def _block_visible(qi, ki, block_q: int, block_k: int, causal: bool,
+                   window=None):
     """Whether tile (qi, ki) has any unmasked entry.  Under causality a
     k-block is fully masked iff its first key comes after the q-block's
-    last query; the kernels skip such tiles' (MXU) work via pl.when.
-    Must stay consistent with _block_mask.  k-block 0 is always visible,
-    so the forward's online-softmax carry never ends at its NEG_INF
-    init."""
-    return (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+    last query; with a sliding window, also iff its last key precedes
+    the q-block's first query by >= window.  The kernels skip such
+    tiles' (MXU) work via pl.when — for a window the live tiles form a
+    diagonal band, so compute is O(seq * window), not O(seq^2).
+    Must stay consistent with _block_mask.  The diagonal tile is always
+    visible (q attends to itself), so the forward's online-softmax
+    carry never ends at its NEG_INF init."""
+    if not causal:
+        return True
+    vis = qi * block_q + block_q - 1 >= ki * block_k
+    if window is not None:
+        vis &= qi * block_q - (ki * block_k + block_k - 1) < window
+    return vis
 
 
 def _online_softmax_merge(scores, v, m_prev, l_prev, acc_prev):
@@ -80,44 +94,130 @@ def _online_softmax_merge(scores, v, m_prev, l_prev, acc_prev):
     p = jnp.exp(scores - m_new)
     corr = jnp.exp(m_prev - m_new)
     l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    # PV in v's dtype (bf16 in training) with f32 accumulation: the MXU
+    # runs its native-precision path; p in f32 would force a slow f32
+    # matmul (flash-attention's standard low-precision-p trade).
     acc_new = acc_prev * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     return m_new, l_new, acc_new
 
 
+def _validate_attention_args(q, k, v, causal, window) -> None:
+    """Shared by every public entry point; Pallas index-map clamping
+    would otherwise turn these shape/flag errors into silently wrong
+    output."""
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"query heads ({q.shape[1]}) must be a multiple of kv heads "
+            f"({k.shape[1]})")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1")
+    if (q.shape[0], q.shape[2], q.shape[3]) != (
+            k.shape[0], k.shape[2], k.shape[3]):
+        # Self-attention only: a shorter KV (cross-attention / KV-cache
+        # shape) would make the KV index maps read out of range.
+        raise ValueError(
+            f"q and k/v must share batch, seq and head_dim; got q "
+            f"{q.shape} vs kv {k.shape}")
+
+
+def causal_band_mask(s: int, window: int | None = None) -> jax.Array:
+    """[s, s] boolean mask: key visible iff q - window < k <= q.
+
+    The dense counterpart of the kernels' _block_mask, shared by the
+    einsum paths (model._block, reference_attention) so the window
+    semantics have one definition."""
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    if window is not None:
+        pos = jnp.arange(s)
+        mask &= (pos[:, None] - pos[None, :]) < window
+    return mask
+
+
+def _cld(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _kv_band(window, block_q: int, block_k: int, n_kb: int):
+    """(n_vis, ki_of): how many k-block positions each q-block visits,
+    and the TRUE k-block index for inner grid position j.
+
+    window=None: every k-block (ki_of is identity; the causal upper
+    triangle is pl.when-skipped but still streamed).  With a window the
+    inner grid axis covers only the diagonal band — k-blocks that can
+    intersect [q_lo - window + 1, q_hi] — so both compute AND the DMA
+    stream scale O(seq * window).  ki_of may return a negative index at
+    the left edge; callers clamp the BlockSpec index to 0 (harmless
+    duplicate fetch) and pl.when-skip the compute."""
+    if window is None:
+        return n_kb, (lambda qi, j: j)
+    n_vis = min(n_kb, _cld(block_q + window - 1, block_k) + 1)
+
+    def ki_of(qi, j):
+        kb_hi = (qi * block_q + block_q - 1) // block_k
+        return kb_hi - (n_vis - 1) + j
+
+    return n_vis, ki_of
+
+
+def _q_band(window, block_q: int, block_k: int, n_qb: int):
+    """(n_visq, qb_of): the dk/dv-kernel mirror of _kv_band — the
+    q-blocks that can see k-block ki.  qb_of may run past n_qb - 1 at
+    the right edge; callers clamp the BlockSpec index and pl.when-skip
+    the compute."""
+    if window is None:
+        return n_qb, (lambda ki, j: j)
+    n_visq = min(n_qb, _cld(block_k + window - 1, block_q) + 1)
+
+    def qb_of(ki, j):
+        return (ki * block_k) // block_q + j
+
+    return n_visq, qb_of
+
+
 # --------------------------------------------------------------------------
-# Forward: grid (b*h, q-blocks, k-blocks), k innermost; carry in scratch
+# Forward: grid (b*h, q-blocks, k-band), k innermost; carry in scratch
 # --------------------------------------------------------------------------
 
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                      m_scr, l_scr, acc_scr, *, sm_scale: float,
                      causal: bool, block_q: int, block_k: int,
-                     n_kb: int):
+                     n_vis: int, ki_of, window=None):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
+    ki = ki_of(qi, j)
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_block_visible(qi, ki, block_q, block_k, causal))
-    def _step():
-        q = q_ref[0].astype(jnp.float32) * sm_scale       # [bq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [bq, bk]
-        if causal:
-            scores = _block_mask(scores, qi, ki, block_q, block_k)
-        m_scr[...], l_scr[...], acc_scr[...] = _online_softmax_merge(
-            scores, v, m_scr[...], l_scr[...], acc_scr[...])
+    live = _block_visible(qi, ki, block_q, block_k, causal, window)
+    if window is not None:
+        live &= ki >= 0
 
-    @pl.when(ki == n_kb - 1)
+    @pl.when(live)
+    def _step():
+        # QK^T in the input dtype with f32 accumulation — bf16 inputs
+        # take the MXU's native path; upcasting first would force an
+        # f32 matmul several times slower.  sm_scale applies to the f32
+        # scores, not bf16 q, to keep its precision.
+        scores = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            scores = _block_mask(scores, qi, ki, block_q, block_k,
+                                 window)
+        m_scr[...], l_scr[...], acc_scr[...] = _online_softmax_merge(
+            scores, v_ref[0], m_scr[...], l_scr[...], acc_scr[...])
+
+    @pl.when(j == n_vis - 1)
     def _finish():
         l = l_scr[...]
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
@@ -139,7 +239,8 @@ def _kv_head_map(h: int, h_kv: int):
     return to_kv
 
 
-def _forward_pallas(q, k, v, causal, block_q, block_k, interpret):
+def _forward_pallas(q, k, v, causal, window, block_q, block_k,
+                    interpret):
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     block_q = _fit_block(s, block_q)
@@ -147,24 +248,30 @@ def _forward_pallas(q, k, v, causal, block_q, block_k, interpret):
     n_kb = s // block_k
     sm_scale = d ** -0.5
     kv_of = _kv_head_map(h, h_kv)
+    n_vis, ki_of = _kv_band(window, block_q, block_k, n_kb)
+
+    def kv_block(bh, qi, j):
+        ki = ki_of(qi, j)
+        if window is not None:
+            ki = jnp.maximum(ki, 0)  # left-edge clamp; compute skipped
+        return (kv_of(bh), ki, 0)
 
     fold = _fold_heads
     kernel = functools.partial(
         _attn_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, n_kb=n_kb)
+        block_q=block_q, block_k=block_k, n_vis=n_vis, ki_of=ki_of,
+        window=window)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, s // block_q, n_kb),
+        grid=(b * h, s // block_q, n_vis),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ki: (kv_of(bh), ki, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ki: (kv_of(bh), ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_block),
+            pl.BlockSpec((1, block_k, d), kv_block),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, j: (bh, qi, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
@@ -186,43 +293,48 @@ def _forward_pallas(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, *, sm_scale, causal,
-                 block_q, block_k):
-    """Rebuild this tile's probabilities from q, k and the saved lse."""
-    q = q_ref[0].astype(jnp.float32)                      # [bq, d]
-    k = k_ref[0].astype(jnp.float32)                      # [bk, d]
+                 block_q, block_k, window=None):
+    """Rebuild this tile's probabilities from q, k and the saved lse.
+
+    Input-dtype QK^T with f32 accumulation (native MXU path for bf16)."""
     scores = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale    # [bq, bk]
     if causal:
-        scores = _block_mask(scores, qi, ki, block_q, block_k)
+        scores = _block_mask(scores, qi, ki, block_q, block_k, window)
     return jnp.exp(scores - lse_ref[0])                   # masked -> 0
 
 
 def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         dq_ref, dq_scr, *, sm_scale: float, causal: bool,
-                        block_q: int, block_k: int, n_kb: int):
+                        block_q: int, block_k: int, n_vis: int, ki_of,
+                        window=None):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
+    ki = ki_of(qi, j)
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_block_visible(qi, ki, block_q, block_k, causal))
+    live = _block_visible(qi, ki, block_q, block_k, causal, window)
+    if window is not None:
+        live &= ki >= 0
+
+    @pl.when(live)
     def _step():
         p = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, sm_scale=sm_scale,
-                         causal=causal, block_q=block_q, block_k=block_k)
-        do = do_ref[0].astype(jnp.float32)                # [bq, d]
-        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         window=window)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
         ds = p * (dp - delta_ref[0])
         dq_scr[...] += jax.lax.dot_general(
-            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
 
-    @pl.when(ki == n_kb - 1)
+    @pl.when(j == n_vis - 1)
     def _finish():
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
@@ -230,35 +342,40 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dk_ref, dv_ref, dk_scr, dv_scr, *,
                          sm_scale: float, causal: bool, block_q: int,
-                         block_k: int, n_qb: int, n_inner: int):
+                         block_k: int, n_qb: int, n_visq: int, qb_of,
+                         n_inner: int, window=None):
     ki = pl.program_id(1)
-    # Inner axis enumerates (q-head-in-group, q-block) pairs: each KV
-    # head accumulates dk/dv over every q-head of its GQA group and
-    # every q-block (n_inner == group * n_qb; MHA is group == 1).
+    # Inner axis enumerates (q-head-in-group, q-band position) pairs:
+    # each KV head accumulates dk/dv over every q-head of its GQA group
+    # and every q-block that can see it (n_inner == group * n_visq;
+    # MHA with no window is group == 1, n_visq == n_qb).
     inner = pl.program_id(2)
-    qi = inner % n_qb
+    qi = qb_of(ki, inner % n_visq)
 
     @pl.when(inner == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_block_visible(qi, ki, block_q, block_k, causal))
+    live = _block_visible(qi, ki, block_q, block_k, causal, window)
+    if window is not None:
+        live &= qi <= n_qb - 1
+
+    @pl.when(live)
     def _step():
         p = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, sm_scale=sm_scale,
-                         causal=causal, block_q=block_q, block_k=block_k)
-        q = q_ref[0].astype(jnp.float32)                  # [bq, d]
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         window=window)
+        p_lo = p.astype(do_ref.dtype)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_lo, do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bk, d]
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
         ds = p * (dp - delta_ref[0])
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
 
     @pl.when(inner == n_inner - 1)
@@ -267,8 +384,8 @@ def _attn_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _backward_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
-                     interpret):
+def _backward_pallas(q, k, v, o, lse, do, causal, window, block_q,
+                     block_k, interpret):
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     group = h // h_kv
@@ -277,6 +394,8 @@ def _backward_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
     n_qb, n_kb = s // block_q, s // block_k
     sm_scale = d ** -0.5
     kv_of = _kv_head_map(h, h_kv)
+    n_vis, ki_of = _kv_band(window, block_q, block_k, n_kb)
+    n_visq, qb_of = _q_band(window, block_q, block_k, n_qb)
 
     # delta = rowsum(do * o): cheap elementwise, fused by XLA outside.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -286,16 +405,22 @@ def _backward_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
     fq, fk, fv, fdo = fold(q), fold(k), fold(v), fold(do)
     flse, fdelta = fold(lse), fold(delta)
 
-    # dq: grid (b*h, q-blocks, k-blocks), k innermost; KV heads mapped.
-    qspec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
-    rspec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0))
-    kspec = pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ki: (kv_of(bh), ki, 0))
+    # dq: grid (b*h, q-blocks, k-band), k innermost; KV heads mapped.
+    def kv_block(bh, qi, j):
+        ki = ki_of(qi, j)
+        if window is not None:
+            ki = jnp.maximum(ki, 0)  # left-edge clamp; compute skipped
+        return (kv_of(bh), ki, 0)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0))
+    rspec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, j: (bh, qi, 0))
+    kspec = pl.BlockSpec((1, block_k, d), kv_block)
     dq = pl.pallas_call(
         functools.partial(
             _attn_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, n_kb=n_kb),
-        grid=(b * h, n_qb, n_kb),
+            block_q=block_q, block_k=block_k, n_vis=n_vis, ki_of=ki_of,
+            window=window),
+        grid=(b * h, n_qb, n_vis),
         in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
@@ -303,24 +428,26 @@ def _backward_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
         interpret=interpret,
     )(fq, fk, fv, fdo, flse, fdelta)
 
-    # dk/dv: grid (b*h_kv, k-blocks, group*q-blocks) — the inner axis
-    # walks every (q-head-in-group, q-block) pair feeding this KV head.
-    def q_of(bhk, inner):
-        return ((bhk // h_kv) * h + (bhk % h_kv) * group + inner // n_qb,
-                inner % n_qb, 0)
+    # dk/dv: grid (b*h_kv, k-blocks, group*q-band) — the inner axis
+    # walks every (q-head-in-group, visible q-block) pair feeding this
+    # KV head.
+    def q_of(bhk, ki, inner):
+        qb = qb_of(ki, inner % n_visq)
+        if window is not None:
+            qb = jnp.minimum(qb, n_qb - 1)  # right-edge clamp
+        return ((bhk // h_kv) * h + (bhk % h_kv) * group
+                + inner // n_visq, qb, 0)
 
-    qspec_g = pl.BlockSpec((1, block_q, d),
-                           lambda bhk, ki, inner: q_of(bhk, inner))
-    rspec_g = pl.BlockSpec((1, block_q, 1),
-                           lambda bhk, ki, inner: q_of(bhk, inner))
+    qspec_g = pl.BlockSpec((1, block_q, d), q_of)
+    rspec_g = pl.BlockSpec((1, block_q, 1), q_of)
     kspec_g = pl.BlockSpec((1, block_k, d),
                            lambda bhk, ki, inner: (bhk, ki, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _attn_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, n_qb=n_qb,
-            n_inner=group * n_qb),
-        grid=(b * h_kv, n_kb, group * n_qb),
+            block_q=block_q, block_k=block_k, n_qb=n_qb, n_visq=n_visq,
+            qb_of=qb_of, n_inner=group * n_visq, window=window),
+        grid=(b * h_kv, n_kb, group * n_visq),
         in_specs=[qspec_g, kspec_g, kspec_g, qspec_g, rspec_g, rspec_g],
         out_specs=(kspec_g, kspec_g),
         out_shape=(jax.ShapeDtypeStruct((b * h_kv, s, d), k.dtype),
@@ -340,33 +467,35 @@ def _backward_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _forward_pallas(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, window, block_q, block_k,
+                     interpret):
+    out, _ = _forward_pallas(q, k, v, causal, window, block_q, block_k,
+                             interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _forward_pallas(q, k, v, causal, block_q, block_k,
+def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret):
+    out, lse = _forward_pallas(q, k, v, causal, window, block_q, block_k,
                                interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, window, block_q, block_k, interpret, residuals, g):
     q, k, v, o, lse = residuals
-    return _backward_pallas(q, k, v, o, lse, g, causal, block_q, block_k,
-                            interpret)
+    return _backward_pallas(q, k, v, o, lse, g, causal, window, block_q,
+                            block_k, interpret)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "block_q", "block_k",
-                                    "interpret"))
+                   static_argnames=("causal", "window", "block_q",
+                                    "block_k", "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = True, block_q: int = 512,
-                    block_k: int = 1024,
+                    causal: bool = True, window: int | None = None,
+                    block_q: int = 512, block_k: int = 1024,
                     interpret: bool = False) -> jax.Array:
     """q: [batch, heads, seq, head_dim]; k, v: [batch, kv_heads, seq,
     head_dim] with heads % kv_heads == 0 -> output shaped like q.
@@ -376,29 +505,23 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     share one KV head, wired at the kernel index-map level so shared KV
     blocks are never materialized per q-head.
 
+    ``window=w`` (requires causal) is sliding-window attention
+    (Mistral-family): each query sees only the w most recent keys
+    including itself.  Tiles outside the diagonal band are skipped
+    entirely, so compute scales O(seq * window) instead of O(seq^2).
+
     Differentiable end-to-end in Pallas: forward is the KV-blocked
     online-softmax kernel (saving lse), backward the pair of blocked
     recompute-p kernels via custom_vjp — no [s, s] tensor touches HBM or
     VMEM in either direction.
     """
-    if q.shape[1] % k.shape[1]:
-        raise ValueError(
-            f"query heads ({q.shape[1]}) must be a multiple of kv heads "
-            f"({k.shape[1]})")
-    if k.shape != v.shape:
-        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
-    if (q.shape[0], q.shape[2], q.shape[3]) != (
-            k.shape[0], k.shape[2], k.shape[3]):
-        # Self-attention only: a shorter KV (cross-attention / KV-cache
-        # shape) would make the KV index maps read out of range, which
-        # Pallas clamps to the last block — silently wrong output.
-        raise ValueError(
-            f"q and k/v must share batch, seq and head_dim; got q "
-            f"{q.shape} vs kv {k.shape}")
-    return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    _validate_attention_args(q, k, v, causal, window)
+    return _flash_attention(q, k, v, causal, window, block_q, block_k,
+                            interpret)
 
 
 def make_sharded_flash_attention(mesh, *, causal: bool = True,
+                                 window: int | None = None,
                                  block_q: int = 512, block_k: int = 1024,
                                  batch_axis: str = "data",
                                  head_axis: str = "model"):
@@ -416,8 +539,9 @@ def make_sharded_flash_attention(mesh, *, causal: bool = True,
     spec = P(batch_axis, head_axis, None, None)
 
     def body(q, k, v):
+        _validate_attention_args(q, k, v, causal, window)
         return _flash_attention(
-            q, k, v, causal, block_q, block_k,
+            q, k, v, causal, window, block_q, block_k,
             jax.default_backend() != "tpu")
 
     def attn(q, k, v):
@@ -441,12 +565,12 @@ def _ring_step_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
     ``diag=False``; invisible hops never reach the kernel (lax.switch
     skips them outside)."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale           # [bq, d]
-    k = k_ref[0].astype(jnp.float32)                      # [sk, d]
-    v = v_ref[0].astype(jnp.float32)
+    # Input-dtype QK^T with f32 accumulation (native MXU path for bf16);
+    # sm_scale applies to the f32 scores.
     scores = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)               # [bq, sk]
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale    # [bq, sk]
+    v = v_ref[0]
     if diag:
         # The diag hop's visible keys start at this shard's position 0,
         # i.e. k-block index 0 with a k-block offset of ki*block_k == 0.
@@ -490,7 +614,7 @@ def ring_flash_step(q, k_t, v_t, m, l, acc, *, diag: bool,
     return unfold(m2), unfold(l2), unfold(acc2)
 
 
-def reference_attention(q, k, v, *, causal=True):
+def reference_attention(q, k, v, *, causal=True, window=None):
     """Plain einsum attention, the numerics oracle for the kernel.
 
     Accepts the same GQA layout as flash_attention (kv_heads dividing
@@ -504,8 +628,7 @@ def reference_attention(q, k, v, *, causal=True):
     scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * d ** -0.5
     if causal:
-        s = scores.shape[-1]
-        mask = jnp.tril(jnp.ones((s, s), bool))
+        mask = causal_band_mask(scores.shape[-1], window)
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs,

@@ -42,6 +42,11 @@ class ModelConfig:
     # on the Pallas path, shares KV blocks across the head group at the
     # kernel index-map level.
     n_kv_heads: int | None = None
+    # Sliding-window attention (Mistral-family): each position attends
+    # to the most recent ``attention_window`` keys only.  None = full
+    # causal.  On the Pallas path off-band tiles are skipped (O(s*w)
+    # compute); the einsum path applies the band mask.
+    attention_window: int | None = None
     dtype: Any = jnp.bfloat16
     # "auto" (default): the fused Pallas flash kernel on TPU, einsum
     # elsewhere.  "einsum" auto-partitions under pjit; "pallas"
@@ -61,6 +66,9 @@ class ModelConfig:
             raise ValueError(
                 f"unknown attention impl {self.attention!r}; "
                 "expected 'auto', 'einsum' or 'pallas'")
+        if self.attention_window is not None and self.attention_window < 1:
+            raise ValueError(f"attention_window must be >= 1, got "
+                             f"{self.attention_window}")
         if self.n_kv_heads is not None and self.n_kv_heads < 1:
             raise ValueError(f"n_kv_heads must be >= 1, got "
                              f"{self.n_kv_heads}")
@@ -149,17 +157,22 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
         from tpu_autoscaler.workloads.attention import flash_attention
 
         attn = flash_attention(
-            q, k, v, causal=True,
+            q, k, v, causal=True, window=cfg.attention_window,
             interpret=jax.default_backend() != "tpu")
     else:
-        if hkv != h:
-            k = jnp.repeat(k, h // hkv, axis=1)
-            v = jnp.repeat(v, h // hkv, axis=1)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
-        causal = jnp.tril(jnp.ones((s, s), bool))
+        from tpu_autoscaler.workloads.attention import causal_band_mask
+
+        # Grouped einsum (n = KV head, g = query heads per KV head):
+        # GQA without materializing repeated K/V — this is the path
+        # multi-device meshes take (resolved_for_mesh), where a repeat
+        # would cost the exact HBM the layout exists to save.
+        qg = q.reshape(b, hkv, h // hkv, s, hd)
+        scores = jnp.einsum("bngqd,bnkd->bngqk", qg, k) / np.sqrt(hd)
+        causal = causal_band_mask(s, cfg.attention_window)
         scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        attn = jnp.einsum("bngqk,bnkd->bngqd", probs, v)
+        attn = attn.reshape(b, h, s, hd)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
     x = x + jnp.einsum("bsd,de->bse", attn,
                        layer["attn_out"].astype(cfg.dtype))
