@@ -279,6 +279,45 @@ class TestSlidingWindowAttention:
             ModelConfig(attention_window=0)
 
 
+class TestRope:
+    def test_relative_position_property(self):
+        # The defining RoPE property: the rotated dot product q_i . k_j
+        # depends only on the offset i - j, not the absolute positions —
+        # a frequency or pairing bug breaks this even when both compared
+        # model paths share the same (buggy) _rope.
+        from tpu_autoscaler.workloads.model import _rope
+
+        hd = 16
+        key = jax.random.PRNGKey(30)
+        q1, k1 = jax.random.normal(key, (2, 1, 1, 1, hd))
+        s = 12
+        q = jnp.broadcast_to(q1, (1, 1, s, hd))
+        k = jnp.broadcast_to(k1, (1, 1, s, hd))
+        qr, kr = _rope(q, 10000.0), _rope(k, 10000.0)
+        dots = jnp.einsum("bhqd,bhkd->bhqk", qr, kr)[0, 0]
+        for off in (0, 1, 5):
+            vals = jnp.diagonal(dots, offset=off)
+            np.testing.assert_allclose(np.asarray(vals),
+                                       float(vals[0]), rtol=1e-4)
+
+    def test_rotation_preserves_norm(self):
+        from tpu_autoscaler.workloads.model import _rope
+
+        x = jax.random.normal(jax.random.PRNGKey(31), (2, 2, 8, 32))
+        xr = _rope(x, 10000.0)
+        np.testing.assert_allclose(
+            np.asarray(jnp.linalg.norm(xr, axis=-1)),
+            np.asarray(jnp.linalg.norm(x, axis=-1)), rtol=1e-5)
+
+    def test_odd_head_dim_rejected_with_rope(self):
+        from tpu_autoscaler.workloads.model import ModelConfig
+
+        with pytest.raises(ValueError, match="even head_dim"):
+            ModelConfig(d_model=100, n_heads=4)
+        # rope off: odd head_dim stays legal (pre-RoPE behavior).
+        assert ModelConfig(d_model=100, n_heads=4, rope=False).head_dim == 25
+
+
 class TestModelIntegration:
     def test_auto_attention_resolution(self):
         # "auto" must resolve per backend (einsum off-TPU), and the

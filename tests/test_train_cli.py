@@ -50,3 +50,20 @@ class TestTrainerCli:
         # A checkpoint exists at whatever step it stopped at.
         ckpts = list((tmp_path / "ckpt").glob("step_*"))
         assert ckpts
+
+    def test_attention_flags_wired(self, tmp_path):
+        # GQA + sliding window + remat + no-rope survive the CLI->
+        # ModelConfig wiring and train end-to-end.
+        result = run_train(tmp_path, "--steps", "4",
+                           "--checkpoint-every", "4",
+                           "--n-kv-heads", "2",
+                           "--attention-window", "16",
+                           "--no-rope", "--remat")
+        assert result.returncode == 0, result.stderr
+        assert "training complete at step 4" in result.stderr
+
+    def test_bad_attention_flags_rejected(self, tmp_path):
+        result = run_train(tmp_path, "--steps", "1",
+                           "--n-kv-heads", "3")  # 4 heads % 3 != 0
+        assert result.returncode != 0
+        assert "multiple of n_kv_heads" in (result.stderr + result.stdout)

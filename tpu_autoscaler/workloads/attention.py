@@ -154,14 +154,19 @@ def _kv_band(window, block_q: int, block_k: int, n_kb: int):
     the left edge; callers clamp the BlockSpec index to 0 (harmless
     duplicate fetch) and pl.when-skip the compute."""
     if window is None:
-        return n_kb, (lambda qi, j: j)
+        return n_kb, (lambda qi, j: j), (lambda qi, j: j)
     n_vis = min(n_kb, _cld(block_q + window - 1, block_k) + 1)
 
     def ki_of(qi, j):
         kb_hi = (qi * block_q + block_q - 1) // block_k
         return kb_hi - (n_vis - 1) + j
 
-    return n_vis, ki_of
+    def ki_clamped(qi, j):
+        # Left-edge clamp for BlockSpec index maps (compute is skipped
+        # for the duplicate fetch via pl.when on the true index).
+        return jnp.maximum(ki_of(qi, j), 0)
+
+    return n_vis, ki_of, ki_clamped
 
 
 def _q_band(window, block_q: int, block_k: int, n_qb: int):
@@ -248,13 +253,10 @@ def _forward_pallas(q, k, v, causal, window, block_q, block_k,
     n_kb = s // block_k
     sm_scale = d ** -0.5
     kv_of = _kv_head_map(h, h_kv)
-    n_vis, ki_of = _kv_band(window, block_q, block_k, n_kb)
+    n_vis, ki_of, ki_clamped = _kv_band(window, block_q, block_k, n_kb)
 
     def kv_block(bh, qi, j):
-        ki = ki_of(qi, j)
-        if window is not None:
-            ki = jnp.maximum(ki, 0)  # left-edge clamp; compute skipped
-        return (kv_of(bh), ki, 0)
+        return (kv_of(bh), ki_clamped(qi, j), 0)
 
     fold = _fold_heads
     kernel = functools.partial(
@@ -394,7 +396,7 @@ def _backward_pallas(q, k, v, o, lse, do, causal, window, block_q,
     n_qb, n_kb = s // block_q, s // block_k
     sm_scale = d ** -0.5
     kv_of = _kv_head_map(h, h_kv)
-    n_vis, ki_of = _kv_band(window, block_q, block_k, n_kb)
+    n_vis, ki_of, ki_clamped = _kv_band(window, block_q, block_k, n_kb)
     n_visq, qb_of = _q_band(window, block_q, block_k, n_qb)
 
     # delta = rowsum(do * o): cheap elementwise, fused by XLA outside.
@@ -407,10 +409,7 @@ def _backward_pallas(q, k, v, o, lse, do, causal, window, block_q,
 
     # dq: grid (b*h, q-blocks, k-band), k innermost; KV heads mapped.
     def kv_block(bh, qi, j):
-        ki = ki_of(qi, j)
-        if window is not None:
-            ki = jnp.maximum(ki, 0)  # left-edge clamp; compute skipped
-        return (kv_of(bh), ki, 0)
+        return (kv_of(bh), ki_clamped(qi, j), 0)
 
     qspec = pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0))
     rspec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, j: (bh, qi, 0))

@@ -26,6 +26,14 @@ log = logging.getLogger(__name__)
 @click.option("--seq-len", default=64, show_default=True)
 @click.option("--d-model", default=128, show_default=True)
 @click.option("--n-layers", default=2, show_default=True)
+@click.option("--n-kv-heads", default=None, type=int,
+              help="GQA: shared KV heads (default: n_heads, i.e. MHA).")
+@click.option("--attention-window", default=None, type=int,
+              help="Sliding-window attention width (default: full causal).")
+@click.option("--no-rope", is_flag=True,
+              help="Disable rotary position embeddings.")
+@click.option("--remat", is_flag=True,
+              help="Rematerialize activations (long-context memory lever).")
 @click.option("--checkpoint-dir", default="/tmp/tpu-train-ckpt",
               show_default=True)
 @click.option("--checkpoint-every", default=50, show_default=True)
@@ -34,7 +42,8 @@ log = logging.getLogger(__name__)
                    "/etc/podinfo/annotations).")
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu for local smoke runs).")
-def main(steps, batch, seq_len, d_model, n_layers, checkpoint_dir,
+def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
+         attention_window, no_rope, remat, checkpoint_dir,
          checkpoint_every, annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -70,7 +79,10 @@ def main(steps, batch, seq_len, d_model, n_layers, checkpoint_dir,
              topo.process_id, topo.num_processes, topo.slice_id,
              topo.num_slices, len(jax.devices()))
 
-    cfg = ModelConfig(seq_len=seq_len, d_model=d_model, n_layers=n_layers)
+    cfg = ModelConfig(seq_len=seq_len, d_model=d_model, n_layers=n_layers,
+                      n_kv_heads=n_kv_heads,
+                      attention_window=attention_window,
+                      rope=not no_rope, remat=remat)
     # Multi-slice jobs get the (dcn, data, model) mesh: DP crosses slices
     # over DCN, TP stays inside each slice's ICI domain.
     mesh = (make_multislice_mesh(topo.num_slices) if topo.num_slices > 1
