@@ -320,11 +320,12 @@ class TestRope:
 
 class TestModelIntegration:
     def test_auto_attention_resolution(self):
-        # "auto" must resolve per backend (einsum off-TPU), and the
-        # sharded train step must never route "auto" onto the Pallas
-        # kernel for a multi-device mesh — GSPMD cannot auto-partition a
-        # custom kernel, so that combination only fails on real
-        # multi-chip hardware where no CI runs.
+        # "auto" must resolve per backend (einsum off-TPU).  For a
+        # multi-device mesh "auto" picks Pallas only when on TPU AND the
+        # mesh can shard it (shard_map over batch x heads); off-TPU it
+        # stays einsum so CI's CPU meshes never pay interpret-mode cost.
+        import unittest.mock as mock
+
         import numpy as onp
         from jax.sharding import Mesh
 
@@ -338,11 +339,22 @@ class TestModelIntegration:
         if len(devs) < 2:
             pytest.skip("needs >=2 devices for the multi-device mesh")
         multi = Mesh(onp.asarray(devs).reshape(-1), axis_names=("data",))
-        assert cfg.resolved_for_mesh(multi).attention == "einsum"
+        if jax.default_backend() != "tpu":
+            assert cfg.resolved_for_mesh(multi).attention == "einsum"
         single = Mesh(onp.asarray(devs[:1]), axis_names=("data",))
         assert cfg.resolved_for_mesh(single).attention == "auto"
         explicit = m.ModelConfig(attention="pallas")
         assert explicit.resolved_for_mesh(multi).attention == "pallas"
+        # On TPU, "auto" routes multi-device meshes onto the shard_map
+        # kernel path exactly when the mesh divides the heads.
+        tp2 = Mesh(onp.asarray(devs[:2]).reshape(1, 2),
+                   axis_names=("data", "model"))
+        with mock.patch.object(jax, "default_backend", return_value="tpu"):
+            assert cfg.resolved_for_mesh(tp2).attention == "pallas"
+            mqa = m.ModelConfig(n_kv_heads=1)
+            assert mqa.resolved_for_mesh(tp2).attention == "einsum"
+        if jax.default_backend() != "tpu":
+            assert cfg.resolved_for_mesh(tp2).attention == "einsum"
 
     def test_gqa_model_pallas_matches_einsum(self):
         import dataclasses as dc

@@ -78,3 +78,93 @@ class TestMultisliceMesh:
                                    dtype=jnp.int32)
         params, opt_state, loss = step_fn(params, opt_state, batch)
         assert np.isfinite(float(loss))
+
+
+class TestShardedPallasAttention:
+    """attention="pallas" under multi-device pjit meshes: _block weaves
+    the fused kernel in through shard_map (batch over non-'model' axes,
+    heads over 'model'), so the kernel's perf survives DP+TP instead of
+    silently degrading to einsum.  Parity is checked against the einsum
+    step, which GSPMD partitions natively — same mesh, same params, same
+    tokens."""
+
+    def _steps(self, mesh, cfg):
+        import dataclasses as dc
+
+        from tpu_autoscaler.workloads.model import make_sharded_train_step
+
+        out = {}
+        for impl in ("pallas", "einsum"):
+            init_fn, step_fn = make_sharded_train_step(
+                mesh, dc.replace(cfg, attention=impl))
+            params, opt = init_fn(jax.random.PRNGKey(0))
+            tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17),
+                                        0, 64, dtype=jnp.int32)
+            out[impl] = step_fn(params, opt, tokens)
+        return out
+
+    def test_dp_tp_mesh_step_matches_einsum(self):
+        from tpu_autoscaler.workloads.model import make_mesh
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >=4 devices")
+        mesh = make_mesh(tp=2)
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=64, seq_len=16,
+                          dtype=jnp.float32)
+        out = self._steps(mesh, cfg)
+        p_params, _, p_loss = out["pallas"]
+        e_params, _, e_loss = out["einsum"]
+        np.testing.assert_allclose(float(p_loss), float(e_loss), rtol=1e-4)
+        for a, b in zip(jax.tree.leaves(p_params),
+                        jax.tree.leaves(e_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)
+
+    def test_multislice_mesh_with_gqa_and_window(self):
+        # Tuple batch axes (dcn, data) + GQA + sliding window, all
+        # through the shard_map kernel path on the 3-D mesh.
+        mesh = make_multislice_mesh(num_slices=2, model=2)
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4,
+                          n_kv_heads=2, attention_window=8, d_ff=64,
+                          seq_len=16, dtype=jnp.float32)
+        out = self._steps(mesh, cfg)
+        np.testing.assert_allclose(float(out["pallas"][2]),
+                                   float(out["einsum"][2]), rtol=1e-4)
+
+    def test_uneven_batch_falls_back_to_einsum(self):
+        # shard_map cannot split an uneven batch (GSPMD pads, shard_map
+        # does not): the block must warn and keep training on einsum
+        # rather than fail mid-trace — configs valid before the sharded
+        # kernel path existed must stay valid.
+        from tpu_autoscaler.workloads.model import (
+            forward,
+            init_params,
+            make_mesh,
+        )
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >=4 devices")
+        mesh = make_mesh(tp=2)  # dp=4: batch 6 does not divide
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4,
+                          d_ff=64, seq_len=16, dtype=jnp.float32,
+                          attention="pallas")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (6, 16), 0, 64,
+                                    dtype=jnp.int32)
+        with pytest.warns(UserWarning, match="not divisible"):
+            out = forward(params, tokens, cfg, mesh)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_unshardable_explicit_pallas_rejected(self):
+        from tpu_autoscaler.workloads.model import (
+            make_mesh,
+            make_sharded_train_step,
+        )
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >=2 devices")
+        mesh = make_mesh(tp=2)
+        cfg = ModelConfig(n_heads=4, n_kv_heads=1, attention="pallas")
+        with pytest.raises(ValueError, match="cannot shard"):
+            make_sharded_train_step(mesh, cfg)

@@ -532,6 +532,12 @@ def make_sharded_flash_attention(mesh, *, causal: bool = True,
     kernel on its [b/dp, h/tp, s, d] shard, and no collectives are
     needed.  This is how ``attention="pallas"`` composes with the
     Megatron-style TP in model.py (heads are already split over 'model').
+
+    ``batch_axis`` may be a tuple of mesh axes (multi-slice meshes shard
+    batch over ("dcn", "data")); ``head_axis=None`` replicates heads
+    (meshes with no 'model' axis).  GQA constraint: the head_axis size
+    must divide both q heads and kv heads so each shard keeps whole
+    contiguous KV-head groups (ModelConfig.mesh_shardable).
     """
     from jax.sharding import PartitionSpec as P
 

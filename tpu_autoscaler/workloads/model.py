@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import Any
 
 import jax
@@ -51,9 +52,10 @@ class ModelConfig:
     # "auto" (default): the fused Pallas flash kernel on TPU, einsum
     # elsewhere.  "einsum" auto-partitions under pjit; "pallas"
     # (workloads/attention.py) keeps scores in VMEM and on real v5e is
-    # 1.4x faster per train step at 1.4x the max batch (BENCH_TPU.json)
-    # — but XLA cannot auto-partition a custom kernel, so it runs
-    # per-shard (single-device or shard_map).
+    # 1.4x faster per train step at 1.4x the max batch (BENCH_TPU.json).
+    # XLA cannot auto-partition a custom kernel, so under a multi-device
+    # mesh _block routes it through shard_map (batch x heads); see
+    # mesh_shardable for when that is legal.
     attention: str = "auto"
     # Rotary position embeddings (llama-standard).  Elementwise sin/cos
     # rotations of q/k fuse into the surrounding ops on TPU; applied
@@ -93,18 +95,41 @@ class ModelConfig:
             return self.attention
         return "pallas" if jax.default_backend() == "tpu" else "einsum"
 
+    def mesh_shardable(self, mesh: "Mesh") -> bool:
+        """Whether the Pallas kernel can run per-shard under ``mesh``.
+
+        The kernel is embarrassingly parallel over batch and heads, so a
+        shard_map over (non-model axes -> batch, 'model' -> heads) needs
+        every shard to hold whole KV-head groups: both n_heads and
+        kv_heads must divide by the 'model' axis size (kv_heads % tp == 0
+        also keeps each shard's contiguous query-head range aligned to
+        its own KV heads, so the kernel's group index arithmetic is the
+        global layout restricted to the shard)."""
+        tp = mesh.shape.get("model", 1)
+        return self.n_heads % tp == 0 and self.kv_heads % tp == 0
+
     def resolved_for_mesh(self, mesh: "Mesh") -> "ModelConfig":
         """The config a mesh-sharded step should compile.
 
-        'auto' resolves to the Pallas kernel only on a single-device
-        mesh: under multi-device GSPMD the custom kernel cannot be
-        auto-partitioned (that needs the shard_map wrapper in
-        make_sharded_flash_attention woven into the scanned block), so
-        the sharded step keeps the einsum path, which pjit partitions
-        over (data, model) natively.  Explicit attention="pallas" is
-        honored as written."""
+        Under a multi-device mesh the custom kernel cannot be
+        auto-partitioned by GSPMD, but _block weaves it in through the
+        shard_map wrapper (make_sharded_flash_attention), which is legal
+        whenever mesh_shardable holds.  'auto' therefore resolves to
+        "pallas" on TPU when shardable and to "einsum" otherwise (the
+        grouped einsum is what pjit partitions natively); an explicit
+        "pallas" that cannot shard is rejected here, at trace-build time,
+        rather than failing inside shard_map."""
+        if self.attention == "pallas" and mesh.size > 1 \
+                and not self.mesh_shardable(mesh):
+            raise ValueError(
+                f"attention='pallas' cannot shard over mesh "
+                f"{dict(mesh.shape)}: n_heads ({self.n_heads}) and "
+                f"kv_heads ({self.kv_heads}) must both be multiples of "
+                f"the 'model' axis size")
         if self.attention == "auto" and mesh.size > 1:
-            return dataclasses.replace(self, attention="einsum")
+            use = ("pallas" if jax.default_backend() == "tpu"
+                   and self.mesh_shardable(mesh) else "einsum")
+            return dataclasses.replace(self, attention=use)
         return self
 
     @property
@@ -164,8 +189,14 @@ def _rmsnorm(x: jax.Array, gain: jax.Array) -> jax.Array:
         x.dtype)
 
 
-def _block(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
-    """One transformer block; x: [batch, seq, d_model] in compute dtype."""
+def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
+           mesh: Mesh | None = None) -> jax.Array:
+    """One transformer block; x: [batch, seq, d_model] in compute dtype.
+
+    ``mesh``: when given and multi-device, the Pallas attention path runs
+    through shard_map (batch over the non-'model' axes, heads over
+    'model') so the fused kernel composes with the pjit-sharded step —
+    see make_sharded_flash_attention."""
     b, s, d = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
 
@@ -179,26 +210,54 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
     if cfg.rope:
         q = _rope(q, cfg.rope_theta)
         k = _rope(k, cfg.rope_theta)
-    if cfg.resolved_attention() == "pallas":
-        from tpu_autoscaler.workloads.attention import flash_attention
-
-        attn = flash_attention(
-            q, k, v, causal=True, window=cfg.attention_window,
-            interpret=jax.default_backend() != "tpu")
-    else:
+    def einsum_attn():
         from tpu_autoscaler.workloads.attention import causal_band_mask
 
         # Grouped einsum (n = KV head, g = query heads per KV head):
-        # GQA without materializing repeated K/V — this is the path
-        # multi-device meshes take (resolved_for_mesh), where a repeat
-        # would cost the exact HBM the layout exists to save.
+        # GQA without materializing repeated K/V — the fallback path for
+        # meshes/shapes the kernel cannot shard over and for non-TPU
+        # backends, where a repeat would cost the exact HBM the layout
+        # exists to save.  pjit partitions these einsums natively.
         qg = q.reshape(b, hkv, h // hkv, s, hd)
         scores = jnp.einsum("bngqd,bnkd->bngqk", qg, k) / np.sqrt(hd)
         causal = causal_band_mask(s, cfg.attention_window)
         scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("bngqk,bnkd->bngqd", probs, v)
-        attn = attn.reshape(b, h, s, hd)
+        return jnp.einsum("bngqk,bnkd->bngqd", probs, v).reshape(
+            b, h, s, hd)
+
+    if cfg.resolved_attention() == "pallas":
+        from tpu_autoscaler.workloads.attention import (
+            flash_attention,
+            make_sharded_flash_attention,
+        )
+
+        if mesh is not None and mesh.size > 1:
+            batch_axes = data_axes(mesh)
+            dp = int(np.prod([mesh.shape[a] for a in batch_axes]))
+            if b % dp:
+                # shard_map cannot split an uneven batch (GSPMD pads;
+                # shard_map does not).  Keep such configs training on
+                # the einsum path rather than failing mid-trace.
+                warnings.warn(
+                    f"attention='pallas': global batch {b} is not "
+                    f"divisible by the {dp}-way data parallelism of "
+                    f"mesh {dict(mesh.shape)}; falling back to einsum "
+                    f"attention for this step", stacklevel=2)
+                attn = einsum_attn()
+            else:
+                attn = make_sharded_flash_attention(
+                    mesh, causal=True, window=cfg.attention_window,
+                    batch_axis=batch_axes,
+                    head_axis=("model" if "model" in mesh.axis_names
+                               else None),
+                )(q, k, v)
+        else:
+            attn = flash_attention(
+                q, k, v, causal=True, window=cfg.attention_window,
+                interpret=jax.default_backend() != "tpu")
+    else:
+        attn = einsum_attn()
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
     x = x + jnp.einsum("bsd,de->bse", attn,
                        layer["attn_out"].astype(cfg.dtype))
@@ -210,11 +269,12 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
     return x
 
 
-def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
+            mesh: Mesh | None = None) -> jax.Array:
     """tokens [batch, seq] int32 -> logits [batch, seq, vocab] fp32."""
     x = params["embed"].astype(cfg.dtype)[tokens]
 
-    block = functools.partial(_block, cfg=cfg)
+    block = functools.partial(_block, cfg=cfg, mesh=mesh)
     if cfg.remat:
         block = jax.checkpoint(block)
 
@@ -228,9 +288,10 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
     return logits.astype(jnp.float32)
 
 
-def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig,
+            mesh: Mesh | None = None) -> jax.Array:
     """Next-token cross-entropy."""
-    logits = forward(params, tokens[:, :-1], cfg)
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -271,6 +332,16 @@ def param_specs(cfg: ModelConfig) -> dict:
     }
 
 
+def data_axes(mesh: Mesh) -> tuple:
+    """The mesh axes that carry batch: every axis except 'model'.
+
+    The single source of the DP-axis rule — batch_spec (step I/O
+    sharding) and _block's shard_map attention path (kernel batch
+    sharding) both derive from it, so they cannot diverge.
+    """
+    return tuple(n for n in mesh.axis_names if n != "model")
+
+
 def batch_spec(mesh: Mesh | None = None) -> P:
     """Batch sharding: every mesh axis except 'model' is data-parallel.
 
@@ -281,8 +352,7 @@ def batch_spec(mesh: Mesh | None = None) -> P:
     """
     if mesh is None:
         return P("data", None)
-    data_axes = tuple(n for n in mesh.axis_names if n != "model")
-    return P(data_axes, None)
+    return P(data_axes(mesh), None)
 
 
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
@@ -304,8 +374,11 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
         params = init_params(key, cfg)
         return params, optimizer.init(params)
 
+    attn_mesh = mesh if cfg.resolved_attention() == "pallas" else None
+
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
+                                                  attn_mesh)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
