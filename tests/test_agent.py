@@ -1,0 +1,254 @@
+"""Slice-registration agent (tpu_autoscaler/agent.py).
+
+The agent closes the QueuedResource unit-id loop: the id the actuator
+names a slice with must come back to the controller as the node's
+SLICE_ID_LABEL.  These tests pin the round trip end to end against the
+actuator's real naming, plus identity discovery precedence and the
+level-triggered patch loop.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+import yaml
+
+from tpu_autoscaler.agent import (
+    DEFAULT_POOL,
+    AgentIdentity,
+    assert_labels,
+    discover_identity,
+    parse_tpu_env,
+    run_agent,
+    shape_for_product,
+    unit_id_from_hostname,
+)
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    POOL_LABEL,
+    SLICE_ID_LABEL,
+    SLICE_SHAPES,
+    TOPOLOGY_LABEL,
+)
+
+
+class FakePatchClient:
+    def __init__(self, fail_times: int = 0):
+        self.patches: list[tuple[str, dict]] = []
+        self._fail = fail_times
+
+    def patch_node(self, name: str, patch: dict) -> None:
+        if self._fail > 0:
+            self._fail -= 1
+            raise RuntimeError("node not registered yet")
+        self.patches.append((name, patch))
+
+
+class TestHostnameConvention:
+    def test_worker_suffix_stripped(self):
+        assert unit_id_from_hostname("tpuas-v5e-64-123-w-0") == \
+            "tpuas-v5e-64-123"
+        assert unit_id_from_hostname("tpuas-v5p-128-9-w-15") == \
+            "tpuas-v5p-128-9"
+
+    def test_no_suffix_is_own_unit(self):
+        assert unit_id_from_hostname("some-host") == "some-host"
+
+    def test_multislice_member_keeps_index(self):
+        # Multislice QR "<qr>-<i>" node ids: the member slice id (which
+        # the actuator's _unit_owner maps back to the QR) must survive.
+        assert unit_id_from_hostname("tpuas-2xv5p-7-1-w-3") == \
+            "tpuas-2xv5p-7-1"
+
+
+class TestTpuEnvParsing:
+    def test_quoted_colon_format(self):
+        env = parse_tpu_env(
+            "ACCELERATOR_TYPE: 'v5litepod-16'\n"
+            "CHIPS_PER_HOST_BOUNDS: '2,2,1'\n"
+            "WORKER_ID: '3'\n")
+        assert env["ACCELERATOR_TYPE"] == "v5litepod-16"
+        assert env["WORKER_ID"] == "3"
+
+    def test_equals_and_unquoted_tolerated(self):
+        assert parse_tpu_env("ACCELERATOR_TYPE=v5p-256\n")[
+            "ACCELERATOR_TYPE"] == "v5p-256"
+
+    def test_garbage_ignored(self):
+        assert parse_tpu_env("not a kv line\n\n") == {}
+
+
+class TestProductRoundTrip:
+    def test_every_catalog_shape_round_trips(self):
+        # The exact inverse of the naming the QR actuator sends as
+        # acceleratorType (product_name or name) — one mapping, both
+        # directions, for all 31 shapes.
+        for shape in SLICE_SHAPES.values():
+            product = shape.product_name or shape.name
+            assert shape_for_product(product) is shape
+
+    def test_unknown_product_is_none(self):
+        assert shape_for_product("v99-1234") is None
+
+
+class TestDiscoverIdentity:
+    def test_env_overrides_win(self):
+        ident = discover_identity(
+            {"TPU_AUTOSCALER_SLICE_ID": "sl-1", "TPU_AUTOSCALER_POOL": "p",
+             "TPU_AUTOSCALER_SHAPE": "v5e-8", "NODE_NAME": "node-a"},
+            hostname="ignored-w-0",
+            tpu_env_text="ACCELERATOR_TYPE: 'v5p-256'\n")
+        assert ident.node_name == "node-a"
+        assert ident.unit_id == "sl-1"
+        assert ident.pool == "p"
+        assert ident.shape is SLICE_SHAPES["v5e-8"]
+
+    def test_tpu_env_and_hostname_fallback(self):
+        # v5p-256 product naming = catalog shape v5p-128 (TensorCore
+        # counts double the chip count on v5p).
+        ident = discover_identity(
+            {}, hostname="tpuas-v5p-128-42-w-7",
+            tpu_env_text="ACCELERATOR_TYPE: 'v5p-256'\n")
+        assert ident.unit_id == "tpuas-v5p-128-42"
+        assert ident.node_name == "tpuas-v5p-128-42-w-7"
+        assert ident.pool == DEFAULT_POOL
+        assert ident.shape is SLICE_SHAPES["v5p-128"]
+
+    def test_daemonset_pod_hostname_does_not_leak_into_unit_id(self):
+        # In the DaemonSet deployment socket.gethostname() is the POD
+        # name; the unit id must derive from NODE_NAME (downward API),
+        # which is the TPU VM host name carrying the -w-<n> convention.
+        ident = discover_identity(
+            {"NODE_NAME": "tpuas-v5e-64-8-w-2"},
+            hostname="tpu-autoscaler-agent-x7k2p")
+        assert ident.unit_id == "tpuas-v5e-64-8"
+        assert ident.node_name == "tpuas-v5e-64-8-w-2"
+
+    def test_unknown_product_stamps_identity_only(self):
+        ident = discover_identity(
+            {}, hostname="h-w-0",
+            tpu_env_text="ACCELERATOR_TYPE: 'v99-8'\n")
+        assert ident.shape is None
+        labels = ident.labels()
+        assert ACCELERATOR_LABEL not in labels
+        assert labels[SLICE_ID_LABEL] == "h"
+
+    def test_bad_shape_env_rejected(self):
+        with pytest.raises(ValueError, match="not a catalog shape"):
+            discover_identity({"TPU_AUTOSCALER_SHAPE": "nope"},
+                              hostname="h")
+
+
+class TestLabels:
+    def test_full_label_set_matches_gang_selector_contract(self):
+        # The labels the agent stamps must satisfy the nodeSelector a
+        # gang carries for the shape (shapes.py::node_selectors) — the
+        # whole point of registration.
+        shape = SLICE_SHAPES["v5e-64"]
+        ident = AgentIdentity(node_name="n", unit_id="u", pool="tpuas",
+                              shape=shape)
+        labels = ident.labels()
+        for key, want in shape.node_selectors().items():
+            assert labels.get(key) == want
+        assert labels[SLICE_ID_LABEL] == "u"
+        assert labels[POOL_LABEL] == "tpuas"
+        assert labels[TOPOLOGY_LABEL] == "8x8"
+
+
+class TestRunAgent:
+    def _ident(self):
+        return AgentIdentity(node_name="n0", unit_id="u0", pool="tpuas",
+                             shape=SLICE_SHAPES["v5e-8"])
+
+    def test_once_patches_once(self):
+        client = FakePatchClient()
+        run_agent(client, self._ident(), once=True)
+        assert len(client.patches) == 1
+        name, patch = client.patches[0]
+        assert name == "n0"
+        assert patch == {"metadata": {"labels": self._ident().labels()}}
+
+    def test_failure_retries_next_tick(self):
+        # Node may not be registered yet: failures must not kill the
+        # loop, and the next tick succeeds.
+        client = FakePatchClient(fail_times=1)
+        ticks = []
+
+        def fake_sleep(s):
+            ticks.append(s)
+            if len(ticks) >= 2:
+                raise KeyboardInterrupt  # stop the loop
+
+        with pytest.raises(KeyboardInterrupt):
+            run_agent(client, self._ident(), interval=60.0,
+                      sleep=fake_sleep)
+        assert len(client.patches) == 1  # 1st failed, 2nd landed
+        assert all(54.0 <= t <= 66.0 for t in ticks)  # jittered interval
+
+    def test_assert_labels_is_strategic_merge_shape(self):
+        client = FakePatchClient()
+        assert_labels(client, self._ident())
+        (_, patch), = client.patches
+        assert set(patch) == {"metadata"}
+        assert set(patch["metadata"]) == {"labels"}
+
+
+class TestAgentManifest:
+    MANIFEST = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deploy", "agent-daemonset.yaml")
+
+    def _docs(self):
+        with open(self.MANIFEST) as f:
+            return list(yaml.safe_load_all(f))
+
+    def test_rbac_covers_the_one_call(self):
+        docs = self._docs()
+        role, = [d for d in docs if d["kind"] == "ClusterRole"]
+        grants = {(r.get("apiGroups", [""])[0], res, v)
+                  for r in role["rules"] for res in r["resources"]
+                  for v in r["verbs"]}
+        assert ("", "nodes", "patch") in grants
+        # Least privilege: the agent needs nothing else.
+        assert grants == {("", "nodes", "patch")}
+
+    def test_daemonset_wires_node_name_downward_api(self):
+        docs = self._docs()
+        ds, = [d for d in docs if d["kind"] == "DaemonSet"]
+        container, = ds["spec"]["template"]["spec"]["containers"]
+        env = {e["name"]: e for e in container.get("env", [])}
+        assert env["NODE_NAME"]["valueFrom"]["fieldRef"][
+            "fieldPath"] == "spec.nodeName"
+        assert container["args"][0] == "agent"
+
+    def test_daemonset_tolerates_tpu_taint(self):
+        docs = self._docs()
+        ds, = [d for d in docs if d["kind"] == "DaemonSet"]
+        tolerations = ds["spec"]["template"]["spec"]["tolerations"]
+        assert any(t.get("key") == "google.com/tpu" for t in tolerations)
+
+
+class TestQrActuatorRoundTrip:
+    def test_agent_returns_ids_delete_accepts(self):
+        """End-to-end identity loop: QR actuator names a multislice; the
+        agent on each host derives the member unit id from its hostname;
+        the controller hands that id back to delete() and the actuator
+        recognizes it."""
+        from tpu_autoscaler.actuators.gcp import GcpRest
+        from tpu_autoscaler.actuators.queued_resources import (
+            QueuedResourceActuator,
+        )
+        from tpu_autoscaler.engine.planner import ProvisionRequest
+
+        rest = GcpRest(dry_run=True)
+        act = QueuedResourceActuator(project="p", zone="z", rest=rest)
+        status = act.provision(ProvisionRequest(
+            kind="tpu-slice", shape_name="v5p-128", count=2,
+            gang_key="g1"))
+        qr_id = status.id
+        # Host 3 of member slice 1 registers; the agent derives:
+        unit = unit_id_from_hostname(f"{qr_id}-1-w-3")
+        assert unit == f"{qr_id}-1"
+        assert unit in act._unit_owner
+        act.delete(unit)  # must resolve to the owning QR, not error
+        assert unit not in act._unit_owner

@@ -12,9 +12,11 @@ container_service.py (classic ACS) was secondary to engine_scaler.py.
 
 Scope note: queued resources create *standalone TPU VM slices*, not GKE
 nodes — use this actuator for QR-managed fleets where the supply-unit id IS
-the queued-resource id (e.g. paired with a node-registration agent that
-stamps SLICE_ID_LABEL with the qr id).  For GKE clusters use
-``GkeNodePoolActuator``, whose node pools register labeled nodes natively.
+the queued-resource id, paired with the node-registration agent
+(``tpu_autoscaler/agent.py``, ``deploy/agent-daemonset.yaml``) that stamps
+SLICE_ID_LABEL with that id on each host's Node object.  For GKE clusters
+use ``GkeNodePoolActuator``, whose node pools register labeled nodes
+natively.
 """
 
 from __future__ import annotations

@@ -1,0 +1,193 @@
+"""Slice-registration agent for QueuedResource-managed TPU VM fleets.
+
+Closes the unit-id loop the QueuedResource actuator's docstring defines
+(actuators/queued_resources.py): slices created through the Cloud TPU
+queuedResources API are standalone TPU VM hosts, not GKE nodes — when
+those hosts join a cluster the controller manages (self-managed kubelet),
+nothing stamps them with the identity labels the controller keys on
+(k8s/objects.py::KubeNode.slice_id / .pool and the accelerator/topology
+contract from topology/catalog.py).  GKE node pools get these labels
+natively from the GKE actuator; QR fleets get them from this agent.
+
+It runs on every TPU VM host (DaemonSet or systemd unit), derives the
+slice identity from the TPU VM environment, and level-triggered
+re-asserts four labels on its own Node object:
+
+    autoscaler.tpu.dev/slice-id                (SLICE_ID_LABEL)
+    autoscaler.tpu.dev/pool                    (POOL_LABEL)
+    cloud.google.com/gke-tpu-accelerator       (ACCELERATOR_LABEL)
+    cloud.google.com/gke-tpu-topology          (TOPOLOGY_LABEL)
+
+Identity sources, in preference order:
+
+1. Env overrides — ``TPU_AUTOSCALER_SLICE_ID`` / ``TPU_AUTOSCALER_POOL``
+   / ``TPU_AUTOSCALER_SHAPE`` (catalog shape name) and ``NODE_NAME``
+   (downward API).  The fully-explicit path; what the DaemonSet manifest
+   wires.
+2. The TPU VM environment: the GCE metadata attribute ``tpu-env``
+   (``ACCELERATOR_TYPE: 'v5p-256'`` product naming) resolves the catalog
+   shape, and the queuedResources host-naming convention — workers of
+   node id ``foo`` are hosts ``foo-w-0`` .. ``foo-w-{n-1}`` — recovers
+   the unit id from the hostname, which is exactly the id the actuator's
+   ``_unit_owner`` map expects back from the controller.
+
+The patch is a strategic-merge of labels only, issued unconditionally
+every interval: blind idempotent assertion needs no read permission, no
+local state, and converges after any drift (crash-only, like the
+controller itself — SURVEY §6.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import re
+import socket
+import time
+import urllib.request
+
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    POOL_LABEL,
+    SLICE_ID_LABEL,
+    SLICE_SHAPES,
+    TOPOLOGY_LABEL,
+)
+from tpu_autoscaler.topology.shapes import SliceShape
+
+log = logging.getLogger(__name__)
+
+METADATA_TPU_ENV = ("http://metadata.google.internal/computeMetadata/v1/"
+                    "instance/attributes/tpu-env")
+
+_WORKER_HOST = re.compile(r"^(?P<unit>.+)-w-\d+$")
+_TPU_ENV_LINE = re.compile(r"^\s*([A-Z0-9_]+)\s*[:=]\s*(.*?)\s*$")
+
+DEFAULT_POOL = "tpuas"  # matches the actuators' default name_prefix
+
+
+def unit_id_from_hostname(hostname: str) -> str:
+    """QueuedResources name a node id's hosts ``<id>-w-<worker>``; the
+    unit id is the hostname minus that suffix.  A hostname without the
+    suffix (single-host slice created without workers, or a test box) is
+    its own unit id."""
+    m = _WORKER_HOST.match(hostname)
+    return m.group("unit") if m else hostname
+
+
+def parse_tpu_env(text: str) -> dict[str, str]:
+    """Parse the ``tpu-env`` metadata attribute: one ``KEY: 'value'``
+    per line (quotes optional; ``=`` tolerated)."""
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        m = _TPU_ENV_LINE.match(line)
+        if m:
+            out[m.group(1)] = m.group(2).strip("'\"")
+    return out
+
+
+def shape_for_product(product: str) -> SliceShape | None:
+    """Catalog shape for a Cloud TPU product accelerator name.
+
+    Inverse of the naming the QueuedResource actuator sends
+    (``shape.product_name or shape.name``), so identities round-trip
+    actuator -> cloud -> tpu-env -> agent."""
+    for shape in SLICE_SHAPES.values():
+        if (shape.product_name or shape.name) == product:
+            return shape
+    return None
+
+
+def fetch_tpu_env(timeout: float = 5.0) -> str | None:
+    """GET the tpu-env metadata attribute; None off-GCE (or any error —
+    the caller falls back to env/hostname identity)."""
+    req = urllib.request.Request(
+        METADATA_TPU_ENV, headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except Exception as e:  # noqa: BLE001 — off-GCE is a normal case
+        log.debug("no tpu-env metadata (%s)", e)
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class AgentIdentity:
+    """What the agent will assert about its host."""
+
+    node_name: str
+    unit_id: str
+    pool: str
+    shape: SliceShape | None  # None: stamp identity labels only
+
+    def labels(self) -> dict[str, str]:
+        out = {SLICE_ID_LABEL: self.unit_id, POOL_LABEL: self.pool}
+        if self.shape is not None:
+            out[ACCELERATOR_LABEL] = self.shape.accelerator_type
+            out[TOPOLOGY_LABEL] = self.shape.topology_label
+        return out
+
+
+def discover_identity(env: dict | None = None,
+                      hostname: str | None = None,
+                      tpu_env_text: str | None = None) -> AgentIdentity:
+    """Resolve this host's identity (see module docstring for the
+    precedence).  ``tpu_env_text`` is injectable for tests; pass the
+    result of fetch_tpu_env() in production."""
+    env = dict(os.environ if env is None else env)
+    hostname = hostname or socket.gethostname().split(".")[0]
+    tpu_env = parse_tpu_env(tpu_env_text) if tpu_env_text else {}
+
+    # Node name before pod hostname for the derivation: in the DaemonSet
+    # deployment socket.gethostname() is the POD name (no hostNetwork),
+    # while NODE_NAME (downward API) is the TPU VM host's name — the one
+    # that carries the "<qr-id>-w-<n>" convention.
+    node_name = env.get("NODE_NAME") or hostname
+    unit_id = env.get("TPU_AUTOSCALER_SLICE_ID") or unit_id_from_hostname(
+        node_name)
+    pool = env.get("TPU_AUTOSCALER_POOL") or DEFAULT_POOL
+
+    shape: SliceShape | None = None
+    shape_name = env.get("TPU_AUTOSCALER_SHAPE")
+    if shape_name:
+        if shape_name not in SLICE_SHAPES:
+            raise ValueError(
+                f"TPU_AUTOSCALER_SHAPE={shape_name!r} is not a catalog "
+                f"shape; known: {sorted(SLICE_SHAPES)}")
+        shape = SLICE_SHAPES[shape_name]
+    elif tpu_env.get("ACCELERATOR_TYPE"):
+        product = tpu_env["ACCELERATOR_TYPE"]
+        shape = shape_for_product(product)
+        if shape is None:
+            log.warning(
+                "tpu-env ACCELERATOR_TYPE %r is not in the catalog; "
+                "stamping identity labels only", product)
+    return AgentIdentity(node_name=node_name, unit_id=unit_id, pool=pool,
+                         shape=shape)
+
+
+def assert_labels(client, identity: AgentIdentity) -> None:
+    """One level-triggered assertion of the identity labels."""
+    client.patch_node(identity.node_name,
+                      {"metadata": {"labels": identity.labels()}})
+
+
+def run_agent(client, identity: AgentIdentity, interval: float = 60.0,
+              once: bool = False, sleep=time.sleep) -> None:
+    """Assert the labels forever (or once).  Failures log and retry on
+    the next tick — the Node object may simply not exist yet while the
+    kubelet is still registering."""
+    log.info("registration agent: node=%s labels=%s",
+             identity.node_name, identity.labels())
+    while True:
+        try:
+            assert_labels(client, identity)
+        except Exception:  # noqa: BLE001 — crash-only, keep asserting
+            log.exception("label assert failed for node %s; will retry",
+                          identity.node_name)
+        if once:
+            return
+        # Jitter so a slice's hosts don't synchronize their patches.
+        sleep(interval * (1.0 + random.uniform(-0.1, 0.1)))

@@ -12,6 +12,7 @@ ctor → loop-with-sleep (SURVEY.md §3.1).  Differences, deliberate:
 
 from __future__ import annotations
 
+import os
 import sys
 
 import click
@@ -316,6 +317,62 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
 
         lock = LeaseLock(kube)
     controller.run_forever(interval_seconds=sleep, leader_lock=lock)
+
+
+@cli.command()
+@kube_options
+@click.option("--node-name", default=None,
+              help="Node object to label (default: NODE_NAME env via the "
+                   "downward API, else this host's hostname).")
+@click.option("--slice-id", default=None,
+              help="Unit id to stamp (default: TPU_AUTOSCALER_SLICE_ID "
+                   "env, else derived from the '<id>-w-<n>' hostname "
+                   "convention).")
+@click.option("--pool", default=None,
+              help="Pool label value (default: TPU_AUTOSCALER_POOL env, "
+                   "else 'tpuas').")
+@click.option("--shape", default=None,
+              help="Catalog shape name (e.g. v5e-8). Default: "
+                   "TPU_AUTOSCALER_SHAPE env, else resolved from the "
+                   "tpu-env metadata's ACCELERATOR_TYPE.")
+@click.option("--interval", default=60.0, show_default=True,
+              type=click.FloatRange(min=1.0),
+              help="Seconds between label assertions.")
+@click.option("--once", is_flag=True, help="Assert once and exit.")
+@click.option("--dry-run", is_flag=True,
+              help="Log the patch instead of sending it.")
+@click.option("--log-json", is_flag=True, help="Structured JSON logs.")
+@click.option("-v", "--verbose", is_flag=True)
+def agent(kube_url, kube_token, kubeconfig, kube_context, node_name,
+          slice_id, pool, shape, interval, once, dry_run, log_json,
+          verbose):
+    """Slice-registration agent for QueuedResource TPU VM fleets.
+
+    Runs on each TPU VM host and stamps its Node object with the
+    slice-id / pool / accelerator / topology labels the controller keys
+    on (GKE node pools get these natively; QR fleets need the agent).
+    """
+    from tpu_autoscaler import agent as agent_mod
+    from tpu_autoscaler.logging_setup import setup_logging
+
+    setup_logging(verbose=verbose, json_format=log_json)
+    env = dict(os.environ)
+    if slice_id:
+        env["TPU_AUTOSCALER_SLICE_ID"] = slice_id
+    if pool:
+        env["TPU_AUTOSCALER_POOL"] = pool
+    if shape:
+        env["TPU_AUTOSCALER_SHAPE"] = shape
+    if node_name:
+        env["NODE_NAME"] = node_name
+    try:
+        identity = agent_mod.discover_identity(
+            env, tpu_env_text=agent_mod.fetch_tpu_env())
+    except ValueError as e:
+        raise click.UsageError(str(e)) from e
+    kube = make_kube_client(kube_url, kube_token, kubeconfig, kube_context,
+                            dry_run=dry_run)
+    agent_mod.run_agent(kube, identity, interval=interval, once=once)
 
 
 @cli.command()

@@ -235,14 +235,21 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
         if mesh is not None and mesh.size > 1:
             batch_axes = data_axes(mesh)
             dp = int(np.prod([mesh.shape[a] for a in batch_axes]))
-            if b % dp:
+            if b % dp or not cfg.mesh_shardable(mesh):
                 # shard_map cannot split an uneven batch (GSPMD pads;
-                # shard_map does not).  Keep such configs training on
+                # shard_map does not) nor a head count the 'model' axis
+                # doesn't divide.  make_sharded_train_step rejects the
+                # latter up front (resolved_for_mesh); direct forward()
+                # callers get the same safety net here: keep training on
                 # the einsum path rather than failing mid-trace.
+                why = (f"global batch {b} is not divisible by the "
+                       f"{dp}-way data parallelism"
+                       if b % dp else
+                       f"heads ({h} q / {hkv} kv) do not divide by the "
+                       f"'model' axis")
                 warnings.warn(
-                    f"attention='pallas': global batch {b} is not "
-                    f"divisible by the {dp}-way data parallelism of "
-                    f"mesh {dict(mesh.shape)}; falling back to einsum "
+                    f"attention='pallas': {why} of mesh "
+                    f"{dict(mesh.shape)}; falling back to einsum "
                     f"attention for this step", stacklevel=2)
                 attn = einsum_attn()
             else:
