@@ -340,6 +340,67 @@ def _impl_longctx(small: bool) -> None:
     print(json.dumps(rec))
 
 
+def _impl_decode(small: bool) -> None:
+    """KV-cache inference throughput (workloads/decode.py): one jitted
+    generate() whose lax.scan amortizes every decode step into a single
+    dispatch (same rationale as _scanned), measured for an MHA cache and
+    a GQA 8:1 cache — decode is HBM-bandwidth-bound on the cache reads,
+    so the grouped layout's 8x smaller cache should show up directly."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_autoscaler.workloads.decode import generate
+    from tpu_autoscaler.workloads.model import ModelConfig, init_params
+
+    if small:
+        base = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                    seq_len=16)
+        batch, prompt_len, steps = 2, 4, 8
+        kv_variants = {"mha": None, "gqa": 2}
+    else:
+        base = dict(vocab=32768, d_model=1024, n_layers=8, n_heads=16,
+                    d_ff=4096, seq_len=1024)
+        batch, prompt_len, steps = 8, 128, 256
+        kv_variants = {"mha": None, "gqa": 2}
+
+    from tpu_autoscaler.workloads.decode import prefill
+
+    rec: dict = {"batch": batch, "prompt_len": prompt_len, "steps": steps}
+    for tag, n_kv in kv_variants.items():
+        cfg = ModelConfig(n_kv_heads=n_kv, **base)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, prompt_len), 0, cfg.vocab,
+                                    dtype=jnp.int32)
+
+        # generate() = prefill + decode scan in one dispatch; timing a
+        # prefill-only program separately isolates decode, which is the
+        # cache-bandwidth-bound phase this benchmark is about.
+        pf = jax.jit(lambda p, pr: prefill(p, pr, cfg,
+                                           prompt_len + steps)[0])
+        fn = jax.jit(lambda p, pr: generate(p, pr, cfg, steps))
+        _sync(pf(params, prompt))  # compile
+        _sync(fn(params, prompt))
+        t0 = time.perf_counter()
+        _sync(pf(params, prompt))
+        pf_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _sync(fn(params, prompt))
+        decode_dt = max(time.perf_counter() - t0 - pf_dt, 1e-9)
+        rec[tag] = {
+            "kv_heads": cfg.kv_heads,
+            "prefill_seconds": round(pf_dt, 5),
+            "decode_seconds": round(decode_dt, 5),
+            "decode_tokens_per_second": round(
+                batch * steps / decode_dt, 1),
+            "ms_per_step": round(decode_dt / steps * 1e3, 3),
+        }
+    if "mha" in rec and "gqa" in rec:
+        rec["gqa_speedup"] = round(
+            rec["mha"]["decode_seconds"] / rec["gqa"]["decode_seconds"], 3)
+    print(json.dumps(rec))
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--cpu-smoke", action="store_true",
@@ -347,7 +408,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--probe-timeout", type=float, default=90.0)
     ap.add_argument("--measure-timeout", type=float, default=900.0)
     ap.add_argument("--out", default=DEFAULT_OUT)
-    ap.add_argument("--impl", choices=["probe", "step", "attn", "longctx"],
+    ap.add_argument("--impl",
+                    choices=["probe", "step", "attn", "longctx", "decode"],
                     help=argparse.SUPPRESS)  # internal subprocess entry
     ap.add_argument("--small", action="store_true",
                     help=argparse.SUPPRESS)
@@ -357,7 +419,8 @@ def main(argv: list[str] | None = None) -> int:
         {"probe": _impl_probe,
          "step": lambda: _impl_step(args.small),
          "attn": lambda: _impl_attn(args.small),
-         "longctx": lambda: _impl_longctx(args.small)}[args.impl]()
+         "longctx": lambda: _impl_longctx(args.small),
+         "decode": lambda: _impl_decode(args.small)}[args.impl]()
         return 0
 
     env = _cpu_env() if args.cpu_smoke else _tpu_env()
@@ -379,14 +442,13 @@ def main(argv: list[str] | None = None) -> int:
             [me, "--impl", "attn"] + extra, env, args.measure_timeout)
         record["long_context"] = _run_bounded(
             [me, "--impl", "longctx"] + extra, env, args.measure_timeout)
+        record["decode"] = _run_bounded(
+            [me, "--impl", "decode"] + extra, env, args.measure_timeout)
     else:
         reason = record["probe"].get("skipped", "probe failed")
-        record["train_step"] = {"ok": False,
-                                "skipped": f"backend probe: {reason}"}
-        record["attention"] = {"ok": False,
-                               "skipped": f"backend probe: {reason}"}
-        record["long_context"] = {"ok": False,
-                                  "skipped": f"backend probe: {reason}"}
+        for phase in ("train_step", "attention", "long_context", "decode"):
+            record[phase] = {"ok": False,
+                             "skipped": f"backend probe: {reason}"}
 
     with open(args.out, "w") as f:
         json.dump(record, f, indent=1)

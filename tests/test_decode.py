@@ -1,0 +1,146 @@
+"""KV-cache inference (workloads/decode.py).
+
+The oracle is the trainer's forward(): a cache is correct iff decode
+logits at every step bit-match (to float tolerance) the teacher-forced
+logits of the growing sequence.  Covers GQA caches, RoPE position
+offsets, sliding-window visibility, greedy/sampled generation, and the
+static-shape compile contract (one program for all positions).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_autoscaler.workloads.decode import (  # noqa: E402
+    KVCache,
+    decode_step,
+    generate,
+    prefill,
+)
+from tpu_autoscaler.workloads.model import (  # noqa: E402
+    ModelConfig,
+    forward,
+    init_params,
+)
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                  n_kv_heads=2, d_ff=64, seq_len=16, dtype=jnp.float32)
+
+
+def _prompt(b=2, s=5, key=1):
+    return jax.random.randint(jax.random.PRNGKey(key), (b, s), 0, CFG.vocab,
+                              dtype=jnp.int32)
+
+
+def _assert_decode_matches_forward(cfg, steps=5):
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = _prompt()
+    logits, cache = prefill(params, prompt, cfg,
+                            max_len=prompt.shape[1] + steps)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(forward(params, prompt, cfg)),
+        rtol=2e-4, atol=2e-4)
+    seq = prompt
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    for _ in range(steps):
+        seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+        step_logits, cache = decode_step(params, cache, tok, cfg)
+        teacher = forward(params, seq, cfg)[:, -1]
+        np.testing.assert_allclose(np.asarray(step_logits),
+                                   np.asarray(teacher),
+                                   rtol=5e-4, atol=5e-4)
+        tok = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+    assert int(cache.length) == prompt.shape[1] + steps
+
+
+class TestCacheParity:
+    def test_gqa_cache_matches_teacher_forcing(self):
+        _assert_decode_matches_forward(CFG)
+
+    def test_mha_and_rope_off(self):
+        import dataclasses as dc
+
+        _assert_decode_matches_forward(
+            dc.replace(CFG, n_kv_heads=None, rope=False))
+
+    def test_sliding_window_visibility(self):
+        import dataclasses as dc
+
+        # Window smaller than the decoded length: late steps must drop
+        # early cache entries exactly like the trainer's band mask.
+        _assert_decode_matches_forward(
+            dc.replace(CFG, attention_window=4), steps=6)
+
+    def test_cache_stores_kv_heads_not_q_heads(self):
+        cache = KVCache.zeros(CFG, batch=2, max_len=8)
+        assert cache.k.shape == (CFG.n_layers, 2, CFG.kv_heads, 8,
+                                 CFG.head_dim)
+        assert cache.max_len == 8
+
+
+class TestGenerate:
+    def test_greedy_prefix_and_shape(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        prompt = _prompt()
+        out = generate(params, prompt, CFG, steps=6)
+        assert out.shape == (2, 11)
+        np.testing.assert_array_equal(np.asarray(out[:, :5]),
+                                      np.asarray(prompt))
+
+    def test_greedy_equals_manual_decode(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        prompt = _prompt()
+        steps = 4
+        out = generate(params, prompt, CFG, steps=steps)
+        logits, cache = prefill(params, prompt, CFG,
+                                max_len=prompt.shape[1] + steps)
+        tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        manual = [tok]
+        for _ in range(steps - 1):
+            step_logits, cache = decode_step(params, cache, tok, CFG)
+            tok = jnp.argmax(step_logits, -1).astype(jnp.int32)
+            manual.append(tok)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, 5:]), np.asarray(jnp.stack(manual, axis=1)))
+
+    def test_sampled_generate_under_jit(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        prompt = _prompt()
+        fn = jax.jit(lambda p, pr, k: generate(
+            p, pr, CFG, steps=3, key=k, temperature=0.8, top_k=10))
+        out = fn(params, prompt, jax.random.PRNGKey(3))
+        assert out.shape == (2, 8)
+        assert np.all(np.asarray(out) >= 0)
+        assert np.all(np.asarray(out) < CFG.vocab)
+
+    def test_sampling_without_key_rejected(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        with pytest.raises(ValueError, match="PRNG key"):
+            generate(params, _prompt(), CFG, steps=2, temperature=0.5)
+
+    def test_overflow_rejected(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            generate(params, _prompt(), CFG, steps=4, max_len=6)
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            prefill(params, _prompt(s=9), CFG, max_len=6)
+
+
+class TestStaticShapes:
+    def test_one_compiled_program_serves_all_positions(self):
+        # The decode step must not recompile as the cache fills: cache
+        # length is traced, shapes are static.
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        prompt = _prompt()
+        _, cache = prefill(params, prompt, CFG, max_len=16)
+        step = jax.jit(lambda c, t: decode_step(params, c, t, CFG))
+        tok = jnp.zeros((2,), jnp.int32)
+        compiled = step.lower(cache, tok).compile()
+        for _ in range(8):
+            logits, cache = compiled(cache, tok)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        assert int(cache.length) == 5 + 8

@@ -24,6 +24,12 @@ from tpu_autoscaler.workloads.model import (
     make_sharded_train_step,
     make_mesh,
 )
+from tpu_autoscaler.workloads.decode import (
+    KVCache,
+    decode_step,
+    generate,
+    prefill,
+)
 from tpu_autoscaler.workloads.checkpoint import (
     DrainWatcher,
     restore_checkpoint,
@@ -32,12 +38,16 @@ from tpu_autoscaler.workloads.checkpoint import (
 
 __all__ = [
     "DrainWatcher",
+    "KVCache",
     "ModelConfig",
+    "decode_step",
     "forward",
+    "generate",
     "init_params",
     "loss_fn",
     "make_mesh",
     "make_sharded_train_step",
+    "prefill",
     "restore_checkpoint",
     "save_checkpoint",
 ]

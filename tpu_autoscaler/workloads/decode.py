@@ -1,0 +1,210 @@
+"""Autoregressive inference for the flagship model: KV cache + generate.
+
+The training side (model.py) proves a provisioned slice trains; this is
+the serving side of the same checkpoint — prefill + single-token decode
+steps over a preallocated KV cache, the standard TPU inference shape:
+
+- **Static shapes throughout**: the cache is preallocated at
+  ``max_len`` and written with ``lax.dynamic_update_slice`` at a traced
+  position, so one compiled decode step serves every position — no
+  per-step recompilation, XLA-friendly by construction.
+- **GQA pays off here**: the cache stores ``kv_heads`` heads, so an
+  8:1 grouped layout cuts cache HBM (the decode-bandwidth bottleneck)
+  by 8x relative to MHA.
+- **RoPE at cache positions**: the new token's q/k rotate at absolute
+  position ``cache.length`` (model._rope's offset arg), so decode
+  logits bit-match teacher-forced forward() logits.
+- **Sliding window as a mask**: the visibility mask bounds attention to
+  the ``attention_window`` most recent cache entries; the cache itself
+  stays linear (a ring buffer would shrink HBM to O(window) — noted as
+  a further optimization, not needed at these sizes).
+- ``generate`` runs decode under ``lax.scan`` (one compiled program for
+  the whole rollout) with greedy or temperature/top-k sampling.
+
+The reference has no model/inference code at all (SURVEY §3: it is an
+infrastructure controller); this module is beyond-parity evidence that
+slices the autoscaler provisions serve traffic, not just train.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from tpu_autoscaler.workloads.model import (
+    ModelConfig,
+    _rmsnorm,
+    _rope,
+    _split_qkv,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Preallocated per-layer K/V cache.
+
+    k, v: [layers, batch, kv_heads, max_len, head_dim] in compute dtype;
+    length: scalar int32, number of filled positions (same for every
+    sequence in the batch — left-aligned prompts; padding support would
+    add a per-row length vector and mask term).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+    @classmethod
+    def zeros(cls, cfg: ModelConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.head_dim)
+        return cls(k=jnp.zeros(shape, cfg.dtype),
+                   v=jnp.zeros(shape, cfg.dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def _cached_attention(q, k_cache, v_cache, length, cfg: ModelConfig):
+    """Attend q [b, h, sq, hd] (positions length-sq .. length-1, already
+    rotated) over the cache's first ``length`` entries with causal +
+    window visibility.  Grouped-einsum GQA, f32 softmax."""
+    b, h, sq, hd = q.shape
+    hkv = k_cache.shape[1]
+    max_len = k_cache.shape[2]
+    qg = q.reshape(b, hkv, h // hkv, sq, hd)
+    scores = jnp.einsum("bngqd,bnkd->bngqk", qg, k_cache) * hd ** -0.5
+    # Visibility of cache slot j for the query at absolute position p
+    # (p = length - sq + qi): j <= p, and with a window, j > p - window.
+    kpos = jnp.arange(max_len)
+    qpos = length - sq + jnp.arange(sq)
+    visible = kpos[None, :] <= qpos[:, None]
+    if cfg.attention_window is not None:
+        visible &= kpos[None, :] > qpos[:, None] - cfg.attention_window
+    scores = jnp.where(visible[None, None, None], scores.astype(jnp.float32),
+                       -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bngqk,bnkd->bngqd", probs, v_cache)
+    return out.reshape(b, h, sq, hd)
+
+
+def _block_with_cache(x, layer, k_cache, v_cache, cfg: ModelConfig,
+                      offset):
+    """One transformer block over [b, s, d], reading/writing the cache.
+
+    Mirrors model._block's math exactly (rmsnorm -> qkv -> rope ->
+    attention -> residual -> mlp) but writes this chunk's k/v into the
+    cache at ``offset`` and attends over cache contents — one code path
+    for prefill (s = prompt len, offset 0) and decode (s = 1, offset =
+    cache.length)."""
+    b, s, d = x.shape
+    y = _rmsnorm(x, layer["ln1"])
+    q, k, v = _split_qkv(y, layer["qkv"], cfg)
+    if cfg.rope:
+        q = _rope(q, cfg.rope_theta, offset)
+        k = _rope(k, cfg.rope_theta, offset)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, offset, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, offset, 0))
+    attn = _cached_attention(q, k_cache, v_cache, offset + s, cfg)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = x + jnp.einsum("bsd,de->bse", attn,
+                       layer["attn_out"].astype(cfg.dtype))
+    y = _rmsnorm(x, layer["ln2"])
+    hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
+    hdn = jax.nn.gelu(hdn)
+    x = x + jnp.einsum("bsf,fd->bsd", hdn, layer["w2"].astype(cfg.dtype))
+    return x, k_cache, v_cache
+
+
+def _run_blocks(params, x, cache: KVCache, cfg: ModelConfig, offset):
+    """lax.scan over stacked layer params, threading the cache."""
+
+    def body(carry, inputs):
+        x = carry
+        layer, k_c, v_c = inputs
+        x, k_c, v_c = _block_with_cache(x, layer, k_c, v_c, cfg, offset)
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v))
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,dv->bsv", x,
+                        params["unembed"].astype(cfg.dtype))
+    new_len = offset + x.shape[1]
+    return logits.astype(jnp.float32), KVCache(k=k_new, v=v_new,
+                                               length=new_len)
+
+
+def prefill(params: dict, tokens: jax.Array, cfg: ModelConfig,
+            max_len: int) -> tuple[jax.Array, KVCache]:
+    """Run the prompt [b, s] through the model, filling a fresh cache.
+
+    Returns (logits [b, s, vocab] fp32, cache with length == s).  The
+    last position's logits seed generation."""
+    b, s = tokens.shape
+    if s > max_len:
+        raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
+    cache = KVCache.zeros(cfg, b, max_len)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    return _run_blocks(params, x, cache, cfg, 0)
+
+
+def decode_step(params: dict, cache: KVCache, tokens: jax.Array,
+                cfg: ModelConfig) -> tuple[jax.Array, KVCache]:
+    """One token per sequence: tokens [b] int32 at position cache.length.
+
+    Returns (logits [b, vocab] fp32, cache advanced by one).  Fully
+    jittable at a traced cache length — one compiled program serves all
+    positions."""
+    x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]
+    logits, cache = _run_blocks(params, x, cache, cfg, cache.length)
+    return logits[:, 0], cache
+
+
+def _sample(logits: jax.Array, key, temperature: float,
+            top_k: int | None) -> jax.Array:
+    """Greedy at temperature 0.0 (static branch), else softmax sampling
+    with optional top-k truncation."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
+             steps: int, *, key: jax.Array | None = None,
+             temperature: float = 0.0, top_k: int | None = None,
+             max_len: int | None = None) -> jax.Array:
+    """Prefill the prompt [b, s], then decode ``steps`` tokens under one
+    lax.scan.  Returns [b, s + steps] (prompt + generated).  Greedy by
+    default; pass key + temperature (and optionally top_k) to sample."""
+    b, s = prompt.shape
+    max_len = max_len if max_len is not None else s + steps
+    if s + steps > max_len:
+        raise ValueError(
+            f"prompt {s} + steps {steps} exceeds max_len {max_len}")
+    if temperature != 0.0 and key is None:
+        raise ValueError("sampling (temperature != 0) needs a PRNG key")
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    key = key if key is not None else jax.random.PRNGKey(0)
+    all_keys = jax.random.split(key, steps)
+    first = _sample(logits[:, -1], all_keys[0], temperature, top_k)
+
+    def body(carry, step_key):
+        cache, token = carry
+        logits, cache = decode_step(params, cache, token, cfg)
+        nxt = _sample(logits, step_key, temperature, top_k)
+        return (cache, nxt), nxt
+
+    # steps-1 decode_steps: the prefill already produced token 1 of
+    # ``steps``; the final sampled token is emitted without a trailing
+    # (wasted) decode of it.
+    (_, _), rest = jax.lax.scan(body, (cache, first), all_keys[1:])
+    out = jnp.concatenate([first[:, None], rest.T], axis=1)
+    return jnp.concatenate([prompt, out.astype(prompt.dtype)], axis=1)

@@ -169,13 +169,16 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
     }
 
 
-def _rope(x: jax.Array, theta: float) -> jax.Array:
+def _rope(x: jax.Array, theta: float, offset=0) -> jax.Array:
     """Rotary embedding over [batch, heads, seq, head_dim] (pairs the
-    two halves of head_dim; positions are absolute sequence indices)."""
+    two halves of head_dim).  Positions are absolute sequence indices
+    ``offset .. offset+seq-1``; a (traced) nonzero offset is the decode
+    path rotating a new token at its cache position."""
     b, h, s, hd = x.shape
     half = hd // 2
     freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    positions = offset + jnp.arange(s, dtype=jnp.float32)
+    angles = positions[:, None] * freqs[None, :]
     cos = jnp.cos(angles).astype(x.dtype)                 # [s, half]
     sin = jnp.sin(angles).astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
@@ -187,6 +190,21 @@ def _rmsnorm(x: jax.Array, gain: jax.Array) -> jax.Array:
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * gain.astype(
         x.dtype)
+
+
+def _split_qkv(y: jax.Array, layer_qkv: jax.Array, cfg: ModelConfig):
+    """Project [b, s, d] through the packed qkv weight -> q [b, h, s, hd],
+    k/v [b, hkv, s, hd].  The single definition of the GQA packing layout
+    (q | k | v, split at [d, d + hkv*hd]) — train (_block) and serve
+    (workloads/decode.py) must agree on it byte for byte."""
+    b, s, d = y.shape
+    h, hd, hkv = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    qkv = jnp.einsum("bsd,de->bse", y, layer_qkv.astype(cfg.dtype))
+    q, k, v = jnp.split(qkv, [d, d + hkv * hd], axis=-1)
+    q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, hkv, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, hkv, hd).transpose(0, 2, 1, 3)
+    return q, k, v
 
 
 def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
@@ -202,11 +220,7 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
 
     hkv = cfg.kv_heads
     y = _rmsnorm(x, layer["ln1"])
-    qkv = jnp.einsum("bsd,de->bse", y, layer["qkv"].astype(cfg.dtype))
-    q, k, v = jnp.split(qkv, [d, d + hkv * hd], axis=-1)
-    q = q.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
-    k = k.reshape(b, s, hkv, hd).transpose(0, 2, 1, 3)
-    v = v.reshape(b, s, hkv, hd).transpose(0, 2, 1, 3)
+    q, k, v = _split_qkv(y, layer["qkv"], cfg)
     if cfg.rope:
         q = _rope(q, cfg.rope_theta)
         k = _rope(k, cfg.rope_theta)
