@@ -128,6 +128,19 @@ class TestGenerate:
             generate(params, _prompt(), CFG, steps=4, max_len=6)
         with pytest.raises(ValueError, match="exceeds max_len"):
             prefill(params, _prompt(s=9), CFG, max_len=6)
+        with pytest.raises(ValueError, match="steps must be"):
+            generate(params, _prompt(), CFG, steps=0)
+
+    def test_full_cache_decode_rejected(self):
+        # Past max_len dynamic_update_slice would clamp the write and
+        # silently corrupt the last slot; eager callers must get an
+        # error instead.
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        _, cache = prefill(params, _prompt(s=5), CFG, max_len=6)
+        tok = jnp.zeros((2,), jnp.int32)
+        _, cache = decode_step(params, cache, tok, CFG)  # fills slot 5
+        with pytest.raises(ValueError, match="KV cache full"):
+            decode_step(params, cache, tok, CFG)
 
 
 class TestStaticShapes:

@@ -58,6 +58,7 @@ class TestTrainerCli:
                            "--checkpoint-every", "4",
                            "--n-kv-heads", "2",
                            "--attention-window", "16",
+                           "--ce-chunk", "8",
                            "--no-rope", "--remat")
         assert result.returncode == 0, result.stderr
         assert "training complete at step 4" in result.stderr

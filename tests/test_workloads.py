@@ -276,6 +276,70 @@ class TestRemat:
         assert np.isfinite(float(loss))
 
 
+class TestChunkedCrossEntropy:
+    def test_chunked_matches_full_loss_and_grads(self):
+        import dataclasses as dc
+
+        # batch_for feeds seq_len+1 tokens, so the loss sequence length
+        # is seq_len itself; the chunk must divide THAT or loss_fn
+        # silently falls back to full logits and this test proves
+        # nothing.  f32 compute for a tight bound — under bf16 the
+        # chunked matmul legitimately rounds differently (~2e-4 on
+        # grads), which would mask a real indexing bug here.
+        cfg = dc.replace(TINY, dtype=jnp.float32)
+        chunk = 4
+        assert cfg.seq_len % chunk == 0 and chunk < cfg.seq_len
+        cfg_c = dc.replace(cfg, ce_chunk=chunk)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = batch_for(TINY)
+        loss_full, grads_full = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg)
+        loss_chunk, grads_chunk = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg_c)
+        np.testing.assert_allclose(float(loss_full), float(loss_chunk),
+                                   rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(grads_full),
+                        jax.tree.leaves(grads_chunk)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        # bf16 (the production dtype) stays within rounding noise.
+        bf_full = loss_fn(params, tokens, TINY)
+        bf_chunk = loss_fn(params, tokens, dc.replace(TINY, ce_chunk=chunk))
+        np.testing.assert_allclose(float(bf_full), float(bf_chunk),
+                                   rtol=2e-3)
+
+    def test_non_dividing_chunk_falls_back_to_full(self):
+        import dataclasses as dc
+
+        bad = 7
+        assert TINY.seq_len % bad
+        cfg_c = dc.replace(TINY, ce_chunk=bad)
+        tokens = batch_for(TINY)
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        np.testing.assert_allclose(
+            float(loss_fn(params, tokens, cfg_c)),
+            float(loss_fn(params, tokens, TINY)), rtol=1e-6)
+
+    def test_composes_with_remat_and_sharding(self):
+        import dataclasses as dc
+
+        mesh = make_mesh()
+        assert TINY.seq_len % 4 == 0
+        cfg = dc.replace(TINY, remat=True, ce_chunk=4)
+        init_fn, step_fn = make_sharded_train_step(mesh, cfg)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        _, _, loss = step_fn(params, opt_state, batch_for(TINY, batch=8))
+        assert np.isfinite(float(loss))
+
+    def test_invalid_chunk_rejected(self):
+        import dataclasses as dc
+
+        import pytest
+
+        with pytest.raises(ValueError, match="ce_chunk"):
+            dc.replace(TINY, ce_chunk=0)
+
+
 class TestAsyncCheckpointWriter:
     def test_overlapped_save_lands_after_wait(self, tmp_path):
         from tpu_autoscaler.workloads.checkpoint import (

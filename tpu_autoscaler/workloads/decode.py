@@ -159,6 +159,15 @@ def decode_step(params: dict, cache: KVCache, tokens: jax.Array,
     Returns (logits [b, vocab] fp32, cache advanced by one).  Fully
     jittable at a traced cache length — one compiled program serves all
     positions."""
+    if not isinstance(cache.length, jax.core.Tracer) \
+            and int(cache.length) >= cache.max_len:
+        # Past max_len, dynamic_update_slice would silently CLAMP the
+        # write offset and corrupt the last cache slot.  A traced length
+        # (inside jit/scan) cannot be checked here — generate() guards
+        # its own loop; direct jitted callers own the bound.
+        raise ValueError(
+            f"KV cache full: length {int(cache.length)} >= max_len "
+            f"{cache.max_len}")
     x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]
     logits, cache = _run_blocks(params, x, cache, cfg, cache.length)
     return logits[:, 0], cache
@@ -172,7 +181,9 @@ def _sample(logits: jax.Array, key, temperature: float,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / temperature
     if top_k is not None:
-        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        # lax.top_k is O(V) vs a full O(V log V) vocab sort — this runs
+        # inside the hot decode scan.
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
@@ -185,6 +196,8 @@ def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
     lax.scan.  Returns [b, s + steps] (prompt + generated).  Greedy by
     default; pass key + temperature (and optionally top_k) to sample."""
     b, s = prompt.shape
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
     max_len = max_len if max_len is not None else s + steps
     if s + steps > max_len:
         raise ValueError(

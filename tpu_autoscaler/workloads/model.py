@@ -67,6 +67,13 @@ class ModelConfig:
     # O(layers) less activation HBM — the standard long-context /
     # large-batch memory lever on TPU.
     remat: bool = False
+    # Chunked cross-entropy: compute the unembedding + softmax over
+    # sequence chunks of this size instead of materializing the full
+    # [b, s, vocab] fp32 logits (the step's largest activation at LM
+    # vocab sizes).  None = full logits; must divide the loss sequence
+    # length — the trainer feeds seq_len+1 tokens, so that is seq_len
+    # itself — or loss_fn falls back to full logits.
+    ce_chunk: int | None = None
 
     def __post_init__(self) -> None:
         if self.attention not in {"auto", "einsum", "pallas"}:
@@ -79,6 +86,8 @@ class ModelConfig:
         if self.n_kv_heads is not None and self.n_kv_heads < 1:
             raise ValueError(f"n_kv_heads must be >= 1, got "
                              f"{self.n_kv_heads}")
+        if self.ce_chunk is not None and self.ce_chunk < 1:
+            raise ValueError(f"ce_chunk must be >= 1, got {self.ce_chunk}")
         if self.n_heads % self.kv_heads:
             raise ValueError(
                 f"n_heads ({self.n_heads}) must be a multiple of "
@@ -290,9 +299,10 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
     return x
 
 
-def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
-            mesh: Mesh | None = None) -> jax.Array:
-    """tokens [batch, seq] int32 -> logits [batch, seq, vocab] fp32."""
+def features(params: dict, tokens: jax.Array, cfg: ModelConfig,
+             mesh: Mesh | None = None) -> jax.Array:
+    """tokens [batch, seq] int32 -> final-norm features [batch, seq,
+    d_model] in compute dtype (everything before the unembedding)."""
     x = params["embed"].astype(cfg.dtype)[tokens]
 
     block = functools.partial(_block, cfg=cfg, mesh=mesh)
@@ -303,17 +313,61 @@ def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
         return block(x, layer), None
 
     x, _ = jax.lax.scan(body, x, params["blocks"])
-    x = _rmsnorm(x, params["ln_f"])
+    return _rmsnorm(x, params["ln_f"])
+
+
+def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
+            mesh: Mesh | None = None) -> jax.Array:
+    """tokens [batch, seq] int32 -> logits [batch, seq, vocab] fp32."""
+    x = features(params, tokens, cfg, mesh)
     logits = jnp.einsum("bsd,dv->bsv", x,
                         params["unembed"].astype(cfg.dtype))
     return logits.astype(jnp.float32)
 
 
+def _chunked_ce(x: jax.Array, unembed: jax.Array, targets: jax.Array,
+                chunk: int, dtype) -> jax.Array:
+    """Cross-entropy without materializing [b, s, vocab] logits.
+
+    The full-vocab logits tensor is the largest single activation of the
+    train step (b*s*V fp32 — ~2 GiB at b16/s1024/V32k, plus its
+    gradient); scanning the unembedding over sequence chunks keeps only
+    [b, chunk, V] live at a time, trading one big matmul for s/chunk
+    serial ones of the same total FLOPs — the standard HBM lever for
+    large-vocab LMs (same spirit as cfg.remat for the blocks).
+    """
+    b, s, d = x.shape
+    n = s // chunk
+    xc = x.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    def body(total, inp):
+        xi, ti = inp
+        logits = jnp.einsum("bcd,dv->bcv", xi, unembed.astype(dtype)
+                            ).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, ti[..., None], axis=-1)[..., 0]
+        return total + jnp.sum(lse - tgt), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xc, tc))
+    return total / (b * s)
+
+
 def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig,
             mesh: Mesh | None = None) -> jax.Array:
-    """Next-token cross-entropy."""
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
-    targets = tokens[:, 1:]
+    """Next-token cross-entropy.
+
+    With ``cfg.ce_chunk`` set (and dividing seq) the unembedding +
+    softmax run chunked over the sequence (_chunked_ce); otherwise the
+    straightforward full-logits form.
+    """
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    s = inputs.shape[1]
+    if cfg.ce_chunk is not None and s % cfg.ce_chunk == 0:
+        x = features(params, inputs, cfg, mesh)
+        return _chunked_ce(x, params["unembed"], targets, cfg.ce_chunk,
+                           cfg.dtype)
+    logits = forward(params, inputs, cfg, mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return jnp.mean(nll)

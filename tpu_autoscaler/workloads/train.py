@@ -34,6 +34,9 @@ log = logging.getLogger(__name__)
               help="Disable rotary position embeddings.")
 @click.option("--remat", is_flag=True,
               help="Rematerialize activations (long-context memory lever).")
+@click.option("--ce-chunk", default=None, type=int,
+              help="Chunked cross-entropy: unembed+softmax over sequence "
+                   "chunks of this size (large-vocab HBM lever).")
 @click.option("--checkpoint-dir", default="/tmp/tpu-train-ckpt",
               show_default=True)
 @click.option("--checkpoint-every", default=50, show_default=True)
@@ -43,7 +46,7 @@ log = logging.getLogger(__name__)
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu for local smoke runs).")
 def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
-         attention_window, no_rope, remat, checkpoint_dir,
+         attention_window, no_rope, remat, ce_chunk, checkpoint_dir,
          checkpoint_every, annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -82,7 +85,7 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
     cfg = ModelConfig(seq_len=seq_len, d_model=d_model, n_layers=n_layers,
                       n_kv_heads=n_kv_heads,
                       attention_window=attention_window,
-                      rope=not no_rope, remat=remat)
+                      rope=not no_rope, remat=remat, ce_chunk=ce_chunk)
     # Multi-slice jobs get the (dcn, data, model) mesh: DP crosses slices
     # over DCN, TP stays inside each slice's ICI domain.
     mesh = (make_multislice_mesh(topo.num_slices) if topo.num_slices > 1
