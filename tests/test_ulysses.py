@@ -1,0 +1,108 @@
+"""Ulysses all-to-all sequence parallelism vs the global reference
+(workloads/ulysses.py), on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from tpu_autoscaler.workloads.attention import reference_attention  # noqa: E402
+from tpu_autoscaler.workloads.ulysses import make_ulysses_attention  # noqa: E402
+
+
+def sp_mesh(n=8):
+    return Mesh(np.asarray(jax.devices()[:n]), axis_names=("sp",))
+
+
+def rand_qkv(key, b=2, h=8, s=128, d=16, hkv=None, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(key), 3)
+    hkv = h if hkv is None else hkv
+    return (jax.random.normal(kq, (b, h, s, d), dtype),
+            jax.random.normal(kk, (b, hkv, s, d), dtype),
+            jax.random.normal(kv, (b, hkv, s, d), dtype))
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_einsum_matches_global_reference(self, causal):
+        mesh = sp_mesh()
+        q, k, v = rand_qkv(0)
+        attn = make_ulysses_attention(mesh, causal=causal, impl="einsum")
+        out = attn(q, k, v)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_pallas_matches_global_reference(self):
+        # The local attention after the all_to_all is the single-device
+        # fused flash kernel at full sequence length, unchanged.
+        mesh = sp_mesh()
+        q, k, v = rand_qkv(1, s=64)
+        attn = make_ulysses_attention(mesh, impl="pallas")
+        out = attn(q, k, v)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("impl", ["einsum", "pallas"])
+    def test_gqa_and_window_compose(self, impl):
+        # kv_heads=4 divides sp=4; sliding window banding and the GQA
+        # group index maps must survive the all_to_all head re-sharding
+        # on BOTH impls (pallas is the default and the advertised one).
+        mesh = sp_mesh(4)
+        q, k, v = rand_qkv(2, h=8, hkv=4, s=64)
+        attn = make_ulysses_attention(mesh, window=16, impl=impl)
+        out = attn(q, k, v)
+        ref = reference_attention(q, k, v, causal=True, window=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_invalid_flag_combos_rejected(self):
+        # Validation must not be bypassed by the shard_map wrapper:
+        # window without causal, and globally indivisible GQA layouts
+        # that DO pass the sp-divisibility checks.
+        mesh = sp_mesh(2)
+        q, k, v = rand_qkv(6, h=8, hkv=6, s=64)  # 8 % 6 != 0, both % 2 == 0
+        with pytest.raises(ValueError, match="multiple"):
+            make_ulysses_attention(mesh)(q, k, v)
+        q2, k2, v2 = rand_qkv(7, h=8, s=64)
+        with pytest.raises(ValueError, match="causal"):
+            make_ulysses_attention(mesh, causal=False, window=8)(q2, k2, v2)
+
+    def test_differentiable_end_to_end(self):
+        # all_to_all transposes to its inverse; the kernel has a
+        # custom_vjp — gradients must match the global reference's.
+        mesh = sp_mesh(4)
+        q, k, v = rand_qkv(3, h=4, s=64)
+
+        def grads_of(op):
+            return jax.grad(
+                lambda q, k, v: (op(q, k, v).astype(jnp.float32) ** 2)
+                .sum(), argnums=(0, 1, 2))(q, k, v)
+
+        g_u = grads_of(make_ulysses_attention(mesh, impl="pallas"))
+        g_ref = grads_of(
+            lambda q, k, v: reference_attention(q, k, v, causal=True))
+        for a, b in zip(g_u, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5)
+
+    def test_indivisible_heads_rejected(self):
+        mesh = sp_mesh(8)
+        q, k, v = rand_qkv(4, h=8, hkv=2)  # hkv 2 % sp 8 != 0
+        attn = make_ulysses_attention(mesh)
+        with pytest.raises(ValueError, match="ring attention"):
+            attn(q, k, v)
+
+    def test_indivisible_seq_rejected(self):
+        mesh = sp_mesh(8)
+        q, k, v = rand_qkv(5, s=100)  # 100 % 8 != 0
+        attn = make_ulysses_attention(mesh)
+        with pytest.raises(ValueError, match="sequence length"):
+            attn(q, k, v)
+
+    def test_bad_impl_rejected(self):
+        with pytest.raises(ValueError, match="impl"):
+            make_ulysses_attention(sp_mesh(), impl="nope")

@@ -1,0 +1,117 @@
+"""Ulysses-style sequence parallelism: all-to-all + local flash attention.
+
+The second sequence-parallel strategy next to ring attention
+(ring_attention.py), trading collective pattern for kernel shape:
+
+- **Ring**: K/V blocks rotate via ``ppermute`` (axis_size - 1 neighbor
+  hops over ICI), each device computes [s_loc, s_loc] score blocks with
+  an online-softmax carry.  No head-count constraint; traffic is spread
+  over the whole schedule.
+- **Ulysses** (DeepSpeed-Ulysses pattern): ONE ``all_to_all`` re-shards
+  the activations from sequence-sharded [b, h, s/sp, d] to
+  head-sharded [b, h/sp, s, d]; each device then runs a plain LOCAL
+  causal flash attention over the FULL sequence for its subset of
+  heads, and a second all_to_all restores sequence sharding.  Two
+  collectives total, and the attention itself is the single-device
+  fused Pallas kernel at full sequence length — reusing its tiling,
+  sliding-window banding, and custom_vjp backward unchanged.
+
+Constraint: the head counts must divide by the axis (h % sp == 0 and,
+for GQA, kv_heads % sp == 0) — exactly the shard_map head-sharding rule
+of ModelConfig.mesh_shardable, but over the sp axis.  Ring has no such
+constraint; that is the structural reason to keep both.
+
+Like the ring, the all-to-alls ride the ICI of ONE slice — the
+autoscaler's slice-atomic invariant is what keeps them off DCN.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
+                   window: int | None, block_q: int, block_k: int,
+                   impl: str, interpret: bool):
+    """Per-device body under shard_map.
+
+    q: [b, h_loc, s_loc, d]; k/v: [b, hkv_loc, s_loc, d] — sequence
+    sharded.  all_to_all splits heads across the axis and concatenates
+    sequence (tiled), attention runs locally at full sequence, and the
+    inverse all_to_all restores the input sharding.
+    """
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    qh = a2a(q, split_axis=1, concat_axis=2)   # [b, h/sp, s, d]
+    kh = a2a(k, split_axis=1, concat_axis=2)
+    vh = a2a(v, split_axis=1, concat_axis=2)
+    if impl == "pallas":
+        from tpu_autoscaler.workloads.attention import _flash_attention
+
+        out = _flash_attention(qh, kh, vh, causal, window, block_q,
+                               block_k, interpret)
+    else:
+        from tpu_autoscaler.workloads.attention import reference_attention
+
+        out = reference_attention(qh, kh, vh, causal=causal, window=window)
+    return a2a(out, split_axis=2, concat_axis=1)  # [b, h_loc, s_loc, d]
+
+
+def make_ulysses_attention(mesh: Mesh, seq_axis: str = "sp",
+                           causal: bool = True, window: int | None = None,
+                           impl: str = "pallas", block_q: int = 512,
+                           block_k: int = 1024,
+                           interpret: bool | None = None):
+    """Build an all-to-all sequence-parallel attention callable for
+    [b, h, s, d] arrays whose sequence axis is sharded over ``mesh``'s
+    ``seq_axis``.  Same contract as make_ring_attention: takes and
+    returns GLOBAL arrays; GQA layouts (kv_heads < heads) pass through
+    to the local kernel.
+
+    ``impl="pallas"`` (default) uses the fused flash kernel locally —
+    differentiable end-to-end, since both the kernel (custom_vjp) and
+    all_to_all (transposes to the inverse all_to_all) have gradients.
+    ``impl="einsum"`` uses the reference einsum attention locally (the
+    numerics oracle, and cheap on CPU test meshes where interpret-mode
+    Pallas is slow).
+    """
+    if impl not in {"einsum", "pallas"}:
+        raise ValueError(f"unknown ulysses attention impl {impl!r}")
+    sp = mesh.shape[seq_axis]
+    spec = P(None, None, seq_axis, None)
+    run_interpret = (jax.default_backend() != "tpu"
+                     if interpret is None else interpret)
+
+    def attn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        from tpu_autoscaler.workloads.attention import (
+            _validate_attention_args,
+        )
+
+        # Global-shape validation (h % hkv, window-requires-causal, dim
+        # agreement): the same rules hold per-shard once the head counts
+        # divide sp, and skipping them means silently wrong kernel
+        # output (see _validate_attention_args).
+        _validate_attention_args(q, k, v, causal, window)
+        h, hkv = q.shape[1], k.shape[1]
+        if h % sp or hkv % sp:
+            raise ValueError(
+                f"ulysses needs heads divisible by the '{seq_axis}' axis "
+                f"(size {sp}): got {h} q heads / {hkv} kv heads — use "
+                f"ring attention for indivisible head counts")
+        if q.shape[2] % sp:
+            raise ValueError(
+                f"sequence length {q.shape[2]} must divide by the "
+                f"'{seq_axis}' axis (size {sp})")
+        body = functools.partial(
+            _ulysses_local, axis_name=seq_axis, causal=causal,
+            window=window, block_q=block_q, block_k=block_k, impl=impl,
+            interpret=run_interpret)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    return attn
