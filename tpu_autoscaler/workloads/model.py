@@ -430,12 +430,72 @@ def batch_spec(mesh: Mesh | None = None) -> P:
     return P(data_axes(mesh), None)
 
 
+def _zero1_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """ZeRO-1 sharding for one optimizer-moment buffer.
+
+    Keep the param's TP sharding and additionally shard the first
+    still-replicated axis whose size divides the total data parallelism
+    over the data axes.  If no axis qualifies (tiny ln gains), the
+    moment stays param-sharded — correct, just not sliced.
+    """
+    daxes = data_axes(mesh)
+    dp = int(np.prod([mesh.shape[a] for a in daxes])) if daxes else 1
+    if dp <= 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (dim, entry) in enumerate(zip(shape, entries)):
+        if entry is None and dim % dp == 0:
+            entries[i] = daxes if len(daxes) > 1 else daxes[0]
+            return P(*entries)
+    return spec
+
+
+def _opt_state_shardings(optimizer, params: dict, p_specs: dict,
+                         mesh: Mesh, zero1: bool):
+    """NamedShardings for the optimizer state.
+
+    The moment buffers inside optax's state mirror the param tree as
+    sub-trees, so each array leaf's path ends with the dict-key path of
+    its param — match on that suffix to give every moment its param's
+    spec (plus the ZeRO-1 data-axis slice when requested).  Leaves with
+    no param suffix (step counts) replicate.
+    """
+    flat_specs = {
+        tuple(k.key for k in path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            p_specs, is_leaf=lambda x: isinstance(x, P))[0]
+    }
+    shapes = jax.eval_shape(optimizer.init, params)
+
+    def leaf_sharding(path, leaf):
+        dict_suffix = tuple(
+            k.key for k in path
+            if isinstance(k, jax.tree_util.DictKey))
+        spec = flat_specs.get(dict_suffix)
+        if spec is None or leaf.ndim == 0:
+            return NamedSharding(mesh, P())
+        if zero1:
+            spec = _zero1_spec(spec, leaf.shape, mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, shapes)
+
+
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
-                            learning_rate: float = 1e-3):
+                            learning_rate: float = 1e-3,
+                            zero1: bool = False):
     """Build (init_fn, step_fn) jitted over ``mesh`` with real DP+TP
     shardings.  step_fn: (params, opt_state, tokens) -> (params, opt_state,
     loss).  ``attention="auto"`` is resolved per the mesh — see
-    ModelConfig.resolved_for_mesh."""
+    ModelConfig.resolved_for_mesh.
+
+    ``zero1``: shard the AdamW moment buffers over the data axes on top
+    of their TP sharding (ZeRO-1).  Declared entirely through
+    out_shardings — XLA lowers the gradient psum into reduce-scatter
+    ahead of the sharded moment update and all-gathers the updates into
+    the replicated params, with no hand-written collectives.  Cuts the
+    fp32 moments (2x param bytes) by the DP degree per device.
+    """
     cfg = cfg.resolved_for_mesh(mesh)
     optimizer = optax.adamw(learning_rate)
     p_specs = param_specs(cfg)
@@ -444,6 +504,9 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
         is_leaf=lambda x: isinstance(x, P))
     b_shard = NamedSharding(mesh, batch_spec(mesh))
     replicated = NamedSharding(mesh, P())
+    o_shard = _opt_state_shardings(optimizer, jax.eval_shape(
+        functools.partial(init_params, cfg=cfg),
+        jax.random.PRNGKey(0)), p_specs, mesh, zero1)
 
     def init(key):
         params = init_params(key, cfg)
@@ -458,13 +521,11 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    # Optimizer state sharding is left to the compiler (it mirrors the
-    # param shardings for moment buffers and replicates scalars).
-    init_jit = jax.jit(init, out_shardings=(p_shard, None))
+    init_jit = jax.jit(init, out_shardings=(p_shard, o_shard))
     step_jit = jax.jit(
         step,
-        in_shardings=(p_shard, None, b_shard),
-        out_shardings=(p_shard, None, replicated),
+        in_shardings=(p_shard, o_shard, b_shard),
+        out_shardings=(p_shard, o_shard, replicated),
         donate_argnums=(0, 1),
     )
     return init_jit, step_jit

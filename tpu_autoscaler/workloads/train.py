@@ -37,6 +37,9 @@ log = logging.getLogger(__name__)
 @click.option("--ce-chunk", default=None, type=int,
               help="Chunked cross-entropy: unembed+softmax over sequence "
                    "chunks of this size (large-vocab HBM lever).")
+@click.option("--zero1", is_flag=True,
+              help="ZeRO-1: shard AdamW moments over the data axes "
+                   "(cuts fp32 optimizer HBM by the DP degree).")
 @click.option("--checkpoint-dir", default="/tmp/tpu-train-ckpt",
               show_default=True)
 @click.option("--checkpoint-every", default=50, show_default=True)
@@ -46,7 +49,8 @@ log = logging.getLogger(__name__)
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu for local smoke runs).")
 def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
-         attention_window, no_rope, remat, ce_chunk, checkpoint_dir,
+         attention_window, no_rope, remat, ce_chunk, zero1,
+         checkpoint_dir,
          checkpoint_every, annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -90,7 +94,8 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
     # over DCN, TP stays inside each slice's ICI domain.
     mesh = (make_multislice_mesh(topo.num_slices) if topo.num_slices > 1
             else make_mesh())
-    init_fn, raw_step_fn = make_sharded_train_step(mesh, cfg)
+    init_fn, raw_step_fn = make_sharded_train_step(mesh, cfg,
+                                                   zero1=zero1)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
     log.info("mesh %s; params initialized", dict(mesh.shape))
 
