@@ -1,0 +1,108 @@
+"""Token-shard loaders (tpu_autoscaler/dataio.py + native/tokenloader.cpp).
+
+The native and numpy engines must produce bit-identical streams — the
+sampling rule is shared verbatim — and the stream must be a pure
+function of (seed, step) so checkpoint resume replays it exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpu_autoscaler.dataio import (
+    NativeTokenLoader,
+    PyTokenLoader,
+    open_token_loader,
+    row_offset,
+    write_token_file,
+)
+
+
+@pytest.fixture
+def shard(tmp_path):
+    path = str(tmp_path / "tokens.bin")
+    rng = np.random.default_rng(0)
+    write_token_file(path, rng.integers(0, 50_000, 4096, dtype=np.uint32))
+    return path
+
+
+def native_or_skip(*args, **kwargs):
+    try:
+        return NativeTokenLoader(*args, **kwargs)
+    except RuntimeError:
+        pytest.skip("no native toolchain")
+
+
+class TestPyLoader:
+    def test_shapes_and_determinism(self, shard):
+        ld = PyTokenLoader(shard, batch=4, window=17, seed=7)
+        a, b = ld.next(3), ld.next(3)
+        assert a.shape == (4, 17) and a.dtype == np.uint32
+        np.testing.assert_array_equal(a, b)  # pure function of step
+        assert not np.array_equal(ld.next(4), a)
+
+    def test_windows_are_real_slices(self, shard):
+        ld = PyTokenLoader(shard, batch=2, window=9, seed=1)
+        tokens = np.memmap(shard, dtype="<u4", mode="r")
+        span = ld.n_tokens - ld.window + 1
+        batch = ld.next(5)
+        for r in range(2):
+            off = row_offset(1, 5, r, span)
+            np.testing.assert_array_equal(batch[r],
+                                          tokens[off:off + 9])
+
+    def test_too_short_shard_rejected(self, tmp_path):
+        path = str(tmp_path / "tiny.bin")
+        write_token_file(path, np.arange(4, dtype=np.uint32))
+        with pytest.raises(ValueError, match="window"):
+            PyTokenLoader(path, batch=1, window=8)
+
+
+class TestNativeLoader:
+    def test_bit_identical_to_python(self, shard):
+        nat = native_or_skip(shard, batch=8, window=33, seed=42)
+        ref = PyTokenLoader(shard, batch=8, window=33, seed=42)
+        try:
+            for step in (0, 1, 7, 1000, 2**40):
+                np.testing.assert_array_equal(nat.next(step),
+                                              ref.next(step))
+        finally:
+            nat.close()
+
+    def test_prefetched_step_matches_cold_read(self, shard):
+        # next(step) kicks off prefetch of step+1; the buffered read
+        # must equal a cold loader's.
+        nat = native_or_skip(shard, batch=4, window=16, seed=9)
+        try:
+            nat.next(0)  # prefetches 1
+            warm = nat.next(1)
+            cold = PyTokenLoader(shard, batch=4, window=16, seed=9).next(1)
+            np.testing.assert_array_equal(warm, cold)
+        finally:
+            nat.close()
+
+    def test_missing_file_rejected(self, shard, tmp_path):
+        native_or_skip(shard, batch=1, window=4).close()  # toolchain gate
+        with pytest.raises(ValueError, match="tl_open"):
+            NativeTokenLoader(str(tmp_path / "missing.bin"), batch=1,
+                              window=4)
+
+    def test_open_token_loader_prefers_native(self, shard):
+        ld = open_token_loader(shard, batch=2, window=8)
+        try:
+            assert ld.next(0).shape == (2, 8)
+        finally:
+            ld.close()
+
+
+class TestResumeSemantics:
+    def test_stream_replay_after_restart(self, shard):
+        # A "restarted" loader (fresh instance, same seed) continues the
+        # stream exactly — the checkpoint-resume contract.
+        first = PyTokenLoader(shard, batch=2, window=8, seed=3)
+        run1 = [first.next(s) for s in range(10)]
+        resumed = PyTokenLoader(shard, batch=2, window=8, seed=3)
+        run2 = [resumed.next(s) for s in range(5, 10)]
+        for a, b in zip(run1[5:], run2):
+            np.testing.assert_array_equal(a, b)

@@ -63,6 +63,21 @@ class TestTrainerCli:
         assert result.returncode == 0, result.stderr
         assert "training complete at step 4" in result.stderr
 
+    def test_trains_from_token_shard(self, tmp_path):
+        import numpy as np
+
+        from tpu_autoscaler.dataio import write_token_file
+
+        shard = str(tmp_path / "tokens.bin")
+        write_token_file(shard, np.random.default_rng(0).integers(
+            0, 50_000, 2048, dtype=np.uint32))
+        result = run_train(tmp_path, "--steps", "3",
+                           "--checkpoint-every", "3",
+                           "--data-file", shard, "--zero1")
+        assert result.returncode == 0, result.stderr
+        assert "token shard" in result.stderr
+        assert "training complete at step 3" in result.stderr
+
     def test_bad_attention_flags_rejected(self, tmp_path):
         result = run_train(tmp_path, "--steps", "1",
                            "--n-kv-heads", "3")  # 4 heads % 3 != 0

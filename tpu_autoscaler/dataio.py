@@ -1,0 +1,147 @@
+"""Token-shard data loading for the in-tree trainer.
+
+Runtime IO infrastructure for the workload side (the reference has no
+data path — SURVEY §3): binary uint32 token shards served as
+[batch, seq+1] next-token windows.
+
+Two engines with bit-identical output:
+
+- ``NativeTokenLoader`` — the C++ loader (native/tokenloader.cpp):
+  mmap'd shard, double-buffered background prefetch so the next step's
+  batch materializes while the device runs the current one.
+- ``PyTokenLoader`` — pure numpy fallback (no compiler needed), same
+  stateless splitmix64 sampling.
+
+Sampling is a pure function of (seed, step, row): checkpoint resume
+replays the exact stream with no loader state to persist, and the two
+engines can be asserted equal row for row (tests/test_dataio.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+
+import numpy as np
+
+from tpu_autoscaler.native import load_native_lib
+
+log = logging.getLogger(__name__)
+
+_tl_cache: dict = {}
+
+_MASK = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """Bit-identical twin of tokenloader.cpp::splitmix64."""
+    x = (x + 0x9E3779B97F4A7C15) & _MASK
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK
+    return (x ^ (x >> 31)) & _MASK
+
+
+def row_offset(seed: int, step: int, row: int, span: int) -> int:
+    """Start offset of (step, row) — THE sampling rule, shared verbatim
+    with the native loader (tokenloader.cpp::row_offset)."""
+    return _splitmix64(seed ^ _splitmix64(step ^ _splitmix64(row))) % span
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Write a uint32 token shard (little-endian, the loaders' format)."""
+    np.asarray(tokens, dtype="<u4").tofile(path)
+
+
+def _configure_tokenloader(lib: ctypes.CDLL) -> None:
+    lib.tl_open.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                            ctypes.c_int64, ctypes.c_uint64]
+    lib.tl_open.restype = ctypes.c_int64
+    lib.tl_next.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                            ctypes.POINTER(ctypes.c_uint32)]
+    lib.tl_next.restype = ctypes.c_int
+    lib.tl_prefetch.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.tl_prefetch.restype = ctypes.c_int
+    lib.tl_n_tokens.argtypes = [ctypes.c_int64]
+    lib.tl_n_tokens.restype = ctypes.c_int64
+    lib.tl_close.argtypes = [ctypes.c_int64]
+    lib.tl_close.restype = ctypes.c_int
+
+
+def _load_lib() -> ctypes.CDLL | None:
+    return load_native_lib("libtokenloader.so",
+                           configure=_configure_tokenloader,
+                           cache=_tl_cache)
+
+
+class PyTokenLoader:
+    """Numpy reference engine (and no-toolchain fallback)."""
+
+    def __init__(self, path: str, batch: int, window: int, seed: int = 0):
+        if window < 2 or batch < 1:
+            raise ValueError("window must be >= 2 and batch >= 1")
+        self._tokens = np.memmap(path, dtype="<u4", mode="r")
+        if self._tokens.size < window:
+            raise ValueError(
+                f"shard {path} has {self._tokens.size} tokens, need at "
+                f"least one window of {window}")
+        self.batch, self.window, self.seed = batch, window, seed
+        self.n_tokens = int(self._tokens.size)
+
+    def next(self, step: int) -> np.ndarray:
+        span = self.n_tokens - self.window + 1
+        out = np.empty((self.batch, self.window), np.uint32)
+        for r in range(self.batch):
+            off = row_offset(self.seed, step, r, span)
+            out[r] = self._tokens[off:off + self.window]
+        return out
+
+    def close(self) -> None:
+        self._tokens = None
+
+
+class NativeTokenLoader:
+    """ctypes front end of the C++ loader; raises if unavailable."""
+
+    def __init__(self, path: str, batch: int, window: int, seed: int = 0):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native token loader unavailable")
+        handle = lib.tl_open(path.encode(), window, batch, seed)
+        if handle < 0:
+            raise ValueError(
+                f"tl_open({path!r}) failed with code {handle} (missing "
+                f"file, or shard shorter than one window of {window})")
+        self._lib, self._handle = lib, handle
+        self.batch, self.window = batch, window
+        self.n_tokens = int(lib.tl_n_tokens(handle))
+
+    def next(self, step: int) -> np.ndarray:
+        out = np.empty((self.batch, self.window), np.uint32)
+        rc = self._lib.tl_next(
+            self._handle, step,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        if rc != 0:
+            raise RuntimeError(f"tl_next failed rc={rc}")
+        # Overlap the NEXT step's fill with the device step.
+        self._lib.tl_prefetch(self._handle, step + 1)
+        return out
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.tl_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def open_token_loader(path: str, batch: int, window: int, seed: int = 0):
+    """Native when the toolchain allows, numpy otherwise — identical
+    streams either way."""
+    try:
+        return NativeTokenLoader(path, batch, window, seed)
+    except RuntimeError:
+        return PyTokenLoader(path, batch, window, seed)

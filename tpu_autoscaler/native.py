@@ -23,56 +23,75 @@ log = logging.getLogger(__name__)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libfitpack.so")
 
 _lock = threading.Lock()
-_lib: ctypes.CDLL | None | bool = None  # None=untried, False=unavailable
 
 
-def _build() -> bool:
-    try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
-        return True
-    except Exception:  # noqa: BLE001 — no compiler / make: stay Python
-        log.info("native fitpack unavailable (build failed); using the "
-                 "Python engine", exc_info=True)
-        return False
+def load_native_lib(so_name: str, *, configure,
+                    cache: dict | None = None) -> ctypes.CDLL | None:
+    """Shared build-on-first-use scaffolding for in-repo native libs.
+
+    Builds ``native/build/<so_name>`` via make (target = its build path),
+    CDLL-loads it, runs ``configure(lib)`` to declare prototypes, and
+    caches the verdict in ``cache['lib']`` (tri-state: absent=untried,
+    False=unavailable, CDLL=ready).  Returns None when no toolchain is
+    available — callers degrade to their Python engines.  One
+    implementation so the fitpack and tokenloader front ends cannot
+    drift on build/caching/fallback policy.
+    """
+    cache = cache if cache is not None else {}
+    with _lock:
+        cached = cache.get("lib")
+        if cached is False:
+            return None
+        if cached is not None:
+            return cached
+        lib_path = os.path.join(_NATIVE_DIR, "build", so_name)
+        if not os.path.exists(lib_path):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, f"build/{so_name}"],
+                    check=True, capture_output=True, timeout=120)
+            except Exception:  # noqa: BLE001 — no compiler: stay Python
+                log.info("%s unavailable (build failed); using the "
+                         "Python engine", so_name, exc_info=True)
+                cache["lib"] = False
+                return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+            configure(lib)
+        except OSError:
+            log.info("%s failed to load", so_name, exc_info=True)
+            cache["lib"] = False
+            return None
+        cache["lib"] = lib
+        return lib
+
+
+_fitpack_cache: dict = {}
+
+
+def _configure_fitpack(lib: ctypes.CDLL) -> None:
+    lib.fitpack_best_shapes.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.fitpack_best_shapes.restype = None
+    lib.fitpack_pack_ffd.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.c_double, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.fitpack_pack_ffd.restype = ctypes.c_int32
 
 
 def load() -> ctypes.CDLL | None:
-    """Load (building if needed) the native library, or None."""
-    global _lib
-    with _lock:
-        if _lib is False:
-            return None
-        if _lib is not None:
-            return _lib
-        if not os.path.exists(_LIB_PATH) and not _build():
-            _lib = False
-            return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            log.info("native fitpack failed to load", exc_info=True)
-            _lib = False
-            return None
-        lib.fitpack_best_shapes.argtypes = [
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_double),
-        ]
-        lib.fitpack_best_shapes.restype = None
-        lib.fitpack_pack_ffd.argtypes = [
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
-            ctypes.c_double, ctypes.c_double,
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.fitpack_pack_ffd.restype = ctypes.c_int32
-        _lib = lib
-        return lib
+    """Load (building if needed) the fitpack library, or None."""
+    return load_native_lib("libfitpack.so", configure=_configure_fitpack,
+                           cache=_fitpack_cache)
 
 
 def available() -> bool:

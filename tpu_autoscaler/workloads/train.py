@@ -40,6 +40,13 @@ log = logging.getLogger(__name__)
 @click.option("--zero1", is_flag=True,
               help="ZeRO-1: shard AdamW moments over the data axes "
                    "(cuts fp32 optimizer HBM by the DP degree).")
+@click.option("--data-file", default=None,
+              help="Binary uint32 token shard to train on (native mmap "
+                   "loader with prefetch; numpy fallback).  Default: "
+                   "synthetic random tokens.")
+@click.option("--profile-dir", default=None,
+              help="Capture a jax.profiler trace of steps 2-5 into this "
+                   "directory (view with TensorBoard / xprof).")
 @click.option("--checkpoint-dir", default="/tmp/tpu-train-ckpt",
               show_default=True)
 @click.option("--checkpoint-every", default=50, show_default=True)
@@ -49,8 +56,8 @@ log = logging.getLogger(__name__)
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu for local smoke runs).")
 def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
-         attention_window, no_rope, remat, ce_chunk, zero1,
-         checkpoint_dir,
+         attention_window, no_rope, remat, ce_chunk, zero1, data_file,
+         profile_dir, checkpoint_dir,
          checkpoint_every, annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -116,15 +123,38 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
     n_proc = max(1, topo.num_processes)
     local_batch = max(1, batch // n_proc)
 
+    loader = None
+    if data_file:
+        from tpu_autoscaler.dataio import open_token_loader
+
+        # Per-process seed: each host samples disjoint crops of the
+        # shared shard; the stream stays a pure function of (seed, step)
+        # so resume replays it exactly.
+        try:
+            loader = open_token_loader(data_file, batch=local_batch,
+                                       window=cfg.seq_len + 1,
+                                       seed=topo.process_id)
+        except (ValueError, OSError) as e:
+            # ValueError from the native loader's tl_open codes;
+            # OSError/FileNotFoundError from the numpy fallback's memmap.
+            raise click.UsageError(str(e)) from e
+        log.info("token shard %s: %d tokens (%s loader)", data_file,
+                 loader.n_tokens, type(loader).__name__)
+
     def batch_for(step):
-        # Synthetic data generated per process (numpy, host-local), then
-        # assembled into one global array over the mesh — jit cannot
-        # reshard a single-device array onto non-addressable devices in
-        # multi-process JAX.
-        rng = np.random.default_rng((step << 16) | topo.process_id)
-        local = rng.integers(0, cfg.vocab,
-                             (local_batch, cfg.seq_len + 1),
-                             dtype=np.int32)
+        # Host-local numpy rows assembled into one global array over the
+        # mesh — jit cannot reshard a single-device array onto
+        # non-addressable devices in multi-process JAX.
+        if loader is not None:
+            # Clip to the model's vocab: shards may be tokenized with a
+            # larger vocabulary than this run trains.
+            local = (loader.next(step) % np.uint32(cfg.vocab)).astype(
+                np.int32)
+        else:
+            rng = np.random.default_rng((step << 16) | topo.process_id)
+            local = rng.integers(0, cfg.vocab,
+                                 (local_batch, cfg.seq_len + 1),
+                                 dtype=np.int32)
         return jax.make_array_from_process_local_data(b_sharding, local)
 
     last_loss = [float("nan")]
@@ -135,9 +165,30 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
         last_loss[0] = float(loss)
         return {"params": params, "opt": opt_state}
 
+    # Throughput between log lines (wall time includes host data prep —
+    # the number an operator compares against BENCH_TPU.json).
+    import time as _time
+
+    global_tokens_per_step = local_batch * n_proc * cfg.seq_len
+    tp_state = {"t": _time.perf_counter(), "step": start}
+    profiling = [False]
+
     def on_step(step, _state):
+        if profile_dir and step == start + 2 and not profiling[0]:
+            jax.profiler.start_trace(profile_dir)
+            profiling[0] = True
+        if profiling[0] and step >= start + 5:
+            jax.profiler.stop_trace()
+            profiling[0] = False
+            log.info("profiler trace written to %s", profile_dir)
         if step % 10 == 0:
-            log.info("step %d loss %.4f", step, last_loss[0])
+            now = _time.perf_counter()
+            dsteps = step - tp_state["step"]
+            tok_s = (global_tokens_per_step * dsteps
+                     / max(now - tp_state["t"], 1e-9)) if dsteps else 0.0
+            tp_state.update(t=now, step=step)
+            log.info("step %d loss %.4f (%.0f tok/s)", step, last_loss[0],
+                     tok_s)
 
     writer = AsyncCheckpointWriter()
     try:
@@ -151,6 +202,9 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
         # durable AND surfaces any deferred background write error even
         # when the training loop itself raised.
         writer.wait()
+        if profiling[0]:  # steps ended inside the trace window
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", profile_dir)
     if drained:
         log.info("drain requested: checkpointed at step %d, exiting "
                  "cleanly", step)
