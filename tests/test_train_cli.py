@@ -83,3 +83,47 @@ class TestTrainerCli:
                            "--n-kv-heads", "3")  # 4 heads % 3 != 0
         assert result.returncode != 0
         assert "multiple of n_kv_heads" in (result.stderr + result.stdout)
+
+
+def run_generate(tmp_path, *args, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_autoscaler.workloads.generate",
+         "--platform", "cpu", "--d-model", "32", "--n-layers", "1",
+         "--seq-len", "16",
+         "--checkpoint-dir", str(tmp_path / "ckpt"), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+class TestGenerateCli:
+    def test_serves_a_trained_checkpoint(self, tmp_path):
+        # train -> generate round trip: the serving-side proof that the
+        # trainer's checkpoint layout is consumable.
+        result = run_train(tmp_path, "--steps", "3",
+                           "--checkpoint-every", "3")
+        assert result.returncode == 0, result.stderr
+        result = run_generate(tmp_path, "--steps", "6", "--batch", "2",
+                              "--prompt", "1,2,3")
+        assert result.returncode == 0, result.stderr
+        lines = [ln for ln in result.stdout.splitlines() if "|" in ln]
+        assert len(lines) == 2
+        prompt, gen = lines[0].split("|")
+        assert prompt.strip() == "1,2,3"
+        assert len(gen.strip().split(",")) == 6
+
+    def test_flag_mismatch_is_a_clean_error(self, tmp_path):
+        result = run_train(tmp_path, "--steps", "3",
+                           "--checkpoint-every", "3")
+        assert result.returncode == 0, result.stderr
+        result = run_generate(tmp_path, "--d-model", "64")
+        assert result.returncode != 0
+        assert "does not match the model flags" in result.stderr
+        assert "Traceback" not in result.stderr
+
+    def test_no_checkpoint_is_a_clean_error(self, tmp_path):
+        result = run_generate(tmp_path)
+        assert result.returncode != 0
+        assert "no checkpoint found" in result.stderr

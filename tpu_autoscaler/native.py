@@ -60,7 +60,10 @@ def load_native_lib(so_name: str, *, configure,
         try:
             lib = ctypes.CDLL(lib_path)
             configure(lib)
-        except OSError:
+        except Exception:  # noqa: BLE001 — OSError from CDLL, or
+            # AttributeError from configure() on a stale prebuilt .so
+            # missing newly-declared symbols: either way the contract is
+            # "degrade to the Python engine", never crash the caller.
             log.info("%s failed to load", so_name, exc_info=True)
             cache["lib"] = False
             return None

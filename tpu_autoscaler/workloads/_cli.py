@@ -1,0 +1,43 @@
+"""Shared CLI plumbing for the workload commands.
+
+train.py and generate.py must agree on the model-architecture flags —
+a checkpoint is only consumable when both sides build the same
+ModelConfig — so the flag block exists exactly once, here.
+"""
+
+from __future__ import annotations
+
+import click
+
+_MODEL_ARCH_OPTIONS = [
+    click.option("--seq-len", default=64, show_default=True),
+    click.option("--d-model", default=128, show_default=True),
+    click.option("--n-layers", default=2, show_default=True),
+    click.option("--n-kv-heads", default=None, type=int,
+                 help="GQA: shared KV heads (default: n_heads, i.e. "
+                      "MHA)."),
+    click.option("--attention-window", default=None, type=int,
+                 help="Sliding-window attention width (default: full "
+                      "causal)."),
+    click.option("--no-rope", is_flag=True,
+                 help="Disable rotary position embeddings."),
+]
+
+
+def model_arch_options(f):
+    """The architecture flags every checkpoint-sharing command takes."""
+    for opt in reversed(_MODEL_ARCH_OPTIONS):
+        f = opt(f)
+    return f
+
+
+def model_config(seq_len, d_model, n_layers, n_kv_heads,
+                 attention_window, no_rope, **extra):
+    """Build the ModelConfig these flags describe (extra kwargs pass
+    through to training-only fields like remat/ce_chunk)."""
+    from tpu_autoscaler.workloads.model import ModelConfig
+
+    return ModelConfig(seq_len=seq_len, d_model=d_model,
+                       n_layers=n_layers, n_kv_heads=n_kv_heads,
+                       attention_window=attention_window,
+                       rope=not no_rope, **extra)

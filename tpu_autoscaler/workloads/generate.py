@@ -1,0 +1,133 @@
+"""Runnable generation CLI: serve a checkpoint trained by train.py.
+
+``python -m tpu_autoscaler.workloads.generate --checkpoint-dir ...``
+restores the latest checkpoint's params (the trainer's state layout) and
+runs the KV-cache decode path (workloads/decode.py) — the serving-side
+proof that a slice the autoscaler provisioned answers, not just trains.
+
+The model flags must match the training run (same rule as resume); the
+prompt is token ids (comma-separated) or random with ``--prompt-len``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import click
+
+log = logging.getLogger(__name__)
+
+
+from tpu_autoscaler.workloads._cli import model_arch_options, model_config
+
+
+@click.command()
+@click.option("--checkpoint-dir", default="/tmp/tpu-train-ckpt",
+              show_default=True)
+@click.option("--steps", default=32, show_default=True,
+              help="Tokens to generate.")
+@click.option("--prompt", default=None,
+              help="Comma-separated token ids (default: random).")
+@click.option("--prompt-len", default=8, show_default=True,
+              help="Random prompt length when --prompt is not given.")
+@click.option("--batch", default=1, show_default=True)
+@click.option("--temperature", default=0.0, show_default=True,
+              help="0 = greedy; > 0 samples.")
+@click.option("--top-k", default=None, type=click.IntRange(min=1))
+@click.option("--seed", default=0, show_default=True)
+@model_arch_options
+@click.option("--platform", default=None,
+              help="Force a jax platform (e.g. cpu).")
+def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
+         top_k, seed, seq_len, d_model, n_layers, n_kv_heads,
+         attention_window, no_rope, platform):
+    """Generate tokens from the latest checkpoint in --checkpoint-dir."""
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(asctime)s %(levelname)s: %(message)s")
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+
+    from tpu_autoscaler.workloads.checkpoint import (
+        latest_step,
+        restore_checkpoint,
+    )
+    from tpu_autoscaler.workloads.decode import generate
+    from tpu_autoscaler.workloads.model import init_params
+
+    cfg = model_config(seq_len, d_model, n_layers, n_kv_heads,
+                       attention_window, no_rope)
+    if top_k is not None and top_k > cfg.vocab:
+        raise click.UsageError(
+            f"--top-k {top_k} exceeds the vocab size {cfg.vocab}")
+
+    step = latest_step(checkpoint_dir)
+    if step is None:
+        raise click.UsageError(
+            f"no checkpoint found in {checkpoint_dir!r} (train first: "
+            f"python -m tpu_autoscaler.workloads.train)")
+    # The trainer checkpoints {"params": ..., "opt": ...}; orbax restores
+    # whole trees, so mirror the trainer's state shapes (the AdamW
+    # hyperparams don't affect state SHAPES) and discard the opt half.
+    import optax
+
+    def abstract_state(key):
+        params = init_params(key, cfg)
+        return {"params": params, "opt": optax.adamw(1e-3).init(params)}
+
+    abstract = jax.eval_shape(abstract_state, jax.random.PRNGKey(0))
+    try:
+        state = restore_checkpoint(checkpoint_dir, step, abstract)
+    except Exception as e:  # noqa: BLE001 — tree-structure mismatch
+        raise click.UsageError(
+            f"checkpoint at step {step} does not match the model flags "
+            f"(train and generate must agree on "
+            f"--d-model/--n-layers/...): {e}") from e
+    # Orbax restores the SAVED shapes regardless of the abstract tree's,
+    # so a flag mismatch surfaces here, not in restore.
+    mismatches = [
+        f"{'/'.join(str(k.key) for k in path)}: checkpoint "
+        f"{tuple(got.shape)} vs flags {tuple(want.shape)}"
+        for (path, got), (_, want) in zip(
+            jax.tree_util.tree_flatten_with_path(state["params"])[0],
+            jax.tree_util.tree_flatten_with_path(abstract["params"])[0])
+        if tuple(got.shape) != tuple(want.shape)]
+    if mismatches:
+        raise click.UsageError(
+            "checkpoint does not match the model flags: "
+            + "; ".join(mismatches[:4]))
+    params = state["params"]
+    log.info("restored step %d from %s", step, checkpoint_dir)
+
+    if prompt is not None:
+        try:
+            ids = [int(t) for t in prompt.split(",") if t.strip()]
+        except ValueError as e:
+            raise click.UsageError(
+                f"--prompt must be comma-separated ints: {e}") from e
+        if not ids:
+            raise click.UsageError("--prompt is empty")
+        if any(t < 0 or t >= cfg.vocab for t in ids):
+            raise click.UsageError(
+                f"--prompt ids must be in [0, {cfg.vocab})")
+        tokens = jnp.asarray([ids] * batch, jnp.int32)
+    else:
+        tokens = jax.random.randint(jax.random.PRNGKey(seed),
+                                    (batch, prompt_len), 0, cfg.vocab,
+                                    dtype=jnp.int32)
+
+    key = jax.random.PRNGKey(seed) if temperature > 0 else None
+    out = generate(params, tokens, cfg, steps, key=key,
+                   temperature=temperature, top_k=top_k)
+    prompt_n = tokens.shape[1]
+    for row in out:
+        ids = [int(t) for t in row]
+        print(f"{','.join(map(str, ids[:prompt_n]))} | "
+              f"{','.join(map(str, ids[prompt_n:]))}")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,18 +20,13 @@ import click
 log = logging.getLogger(__name__)
 
 
+from tpu_autoscaler.workloads._cli import model_arch_options, model_config
+
+
 @click.command()
 @click.option("--steps", default=100, show_default=True)
 @click.option("--batch", default=8, show_default=True)
-@click.option("--seq-len", default=64, show_default=True)
-@click.option("--d-model", default=128, show_default=True)
-@click.option("--n-layers", default=2, show_default=True)
-@click.option("--n-kv-heads", default=None, type=int,
-              help="GQA: shared KV heads (default: n_heads, i.e. MHA).")
-@click.option("--attention-window", default=None, type=int,
-              help="Sliding-window attention width (default: full causal).")
-@click.option("--no-rope", is_flag=True,
-              help="Disable rotary position embeddings.")
+@model_arch_options
 @click.option("--remat", is_flag=True,
               help="Rematerialize activations (long-context memory lever).")
 @click.option("--ce-chunk", default=None, type=int,
@@ -82,7 +77,6 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
         make_multislice_mesh,
     )
     from tpu_autoscaler.workloads.model import (
-        ModelConfig,
         batch_spec,
         make_mesh,
         make_sharded_train_step,
@@ -93,10 +87,9 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
              topo.process_id, topo.num_processes, topo.slice_id,
              topo.num_slices, len(jax.devices()))
 
-    cfg = ModelConfig(seq_len=seq_len, d_model=d_model, n_layers=n_layers,
-                      n_kv_heads=n_kv_heads,
-                      attention_window=attention_window,
-                      rope=not no_rope, remat=remat, ce_chunk=ce_chunk)
+    cfg = model_config(seq_len, d_model, n_layers, n_kv_heads,
+                       attention_window, no_rope, remat=remat,
+                       ce_chunk=ce_chunk)
     # Multi-slice jobs get the (dcn, data, model) mesh: DP crosses slices
     # over DCN, TP stays inside each slice's ICI domain.
     mesh = (make_multislice_mesh(topo.num_slices) if topo.num_slices > 1
