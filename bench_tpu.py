@@ -381,21 +381,34 @@ def _impl_decode(small: bool) -> None:
         fn = jax.jit(lambda p, pr: generate(p, pr, cfg, steps))
         _sync(pf(params, prompt))  # compile
         _sync(fn(params, prompt))
+        # Average several timed iterations: decode time is the
+        # DIFFERENCE of two measured programs, so single-shot timing
+        # noise can drive it negative — average, and mark the record
+        # not-ok instead of clamping to an absurd tokens/s.
+        reps = 3
         t0 = time.perf_counter()
-        _sync(pf(params, prompt))
-        pf_dt = time.perf_counter() - t0
+        for _ in range(reps):
+            _sync(pf(params, prompt))
+        pf_dt = (time.perf_counter() - t0) / reps
         t0 = time.perf_counter()
-        _sync(fn(params, prompt))
-        decode_dt = max(time.perf_counter() - t0 - pf_dt, 1e-9)
+        for _ in range(reps):
+            _sync(fn(params, prompt))
+        gen_dt = (time.perf_counter() - t0) / reps
+        decode_dt = gen_dt - pf_dt
+        ok = decode_dt > 0
         rec[tag] = {
             "kv_heads": cfg.kv_heads,
+            "ok": ok,
             "prefill_seconds": round(pf_dt, 5),
             "decode_seconds": round(decode_dt, 5),
-            "decode_tokens_per_second": round(
-                batch * steps / decode_dt, 1),
-            "ms_per_step": round(decode_dt / steps * 1e3, 3),
         }
-    if "mha" in rec and "gqa" in rec:
+        if ok:
+            rec[tag].update({
+                "decode_tokens_per_second": round(
+                    batch * steps / decode_dt, 1),
+                "ms_per_step": round(decode_dt / steps * 1e3, 3),
+            })
+    if rec.get("mha", {}).get("ok") and rec.get("gqa", {}).get("ok"):
         rec["gqa_speedup"] = round(
             rec["mha"]["decode_seconds"] / rec["gqa"]["decode_seconds"], 3)
     print(json.dumps(rec))

@@ -131,6 +131,48 @@ class TestGenerate:
         with pytest.raises(ValueError, match="steps must be"):
             generate(params, _prompt(), CFG, steps=0)
 
+    def test_top_p_generate_valid_tokens(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        out = generate(params, _prompt(), CFG, steps=3,
+                       key=jax.random.PRNGKey(3), temperature=0.8,
+                       top_p=0.9)
+        assert out.shape == (2, 8)
+        assert np.all(np.asarray(out) >= 0)
+        assert np.all(np.asarray(out) < CFG.vocab)
+
+    def test_top_p_one_matches_plain_sampling(self):
+        # top_p=1.0 keeps the whole vocab: identical samples, same key.
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        kw = dict(steps=3, key=jax.random.PRNGKey(3), temperature=0.8)
+        a = generate(params, _prompt(), CFG, top_p=1.0, **kw)
+        b = generate(params, _prompt(), CFG, **kw)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_tiny_top_p_is_greedy(self):
+        # top_p -> 0 keeps only the argmax token: sampling == greedy.
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        a = generate(params, _prompt(), CFG, steps=3,
+                     key=jax.random.PRNGKey(3), temperature=0.8,
+                     top_p=1e-6)
+        g = generate(params, _prompt(), CFG, steps=3)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(g))
+
+    def test_sampling_knob_validation(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        k = jax.random.PRNGKey(3)
+        with pytest.raises(ValueError, match="top_k must be"):
+            generate(params, _prompt(), CFG, steps=2, key=k,
+                     temperature=0.8, top_k=CFG.vocab + 1)
+        with pytest.raises(ValueError, match="top_p must be"):
+            generate(params, _prompt(), CFG, steps=2, key=k,
+                     temperature=0.8, top_p=0.0)
+        # Truncation knobs are meaningless under greedy decoding —
+        # reject rather than silently ignore.
+        with pytest.raises(ValueError, match="temperature > 0"):
+            generate(params, _prompt(), CFG, steps=2, top_k=5)
+        with pytest.raises(ValueError, match="temperature > 0"):
+            generate(params, _prompt(), CFG, steps=2, top_p=0.9)
+
     def test_full_cache_decode_rejected(self):
         # Past max_len dynamic_update_slice would clamp the write and
         # silently corrupt the last slot; eager callers must get an

@@ -63,3 +63,124 @@ class TestMoe:
             np.testing.assert_allclose(np.asarray(grads[key]),
                                        np.asarray(ref_grads[key]),
                                        rtol=1e-3, atol=1e-4)
+
+
+class TestRouteTopk:
+    """The shared routing rule's index arithmetic, pinned."""
+
+    def _route(self, n=32, e=8, k=2, cap=4, seed=0):
+        from tpu_autoscaler.workloads.moe import route_topk
+
+        logits = jax.random.normal(jax.random.PRNGKey(seed), (n, e))
+        return route_topk(logits, k, cap)
+
+    def test_slots_are_unique_per_expert(self):
+        # No two kept assignments may share an (expert, rank) slot —
+        # a collision would silently overwrite a capacity buffer entry.
+        expert, rank, gate, keep, _ = self._route()
+        expert, rank, keep = map(np.asarray, (expert, rank, keep))
+        slots = [(int(e), int(r))
+                 for e, r, kp in zip(expert.ravel(), rank.ravel(),
+                                     keep.ravel()) if kp]
+        assert len(slots) == len(set(slots))
+
+    def test_capacity_respected(self):
+        expert, rank, gate, keep, _ = self._route(cap=2)
+        rank, keep = np.asarray(rank), np.asarray(keep)
+        assert (rank[keep] < 2).all()
+
+    def test_choices_are_distinct_experts(self):
+        expert, *_ = self._route()
+        expert = np.asarray(expert)
+        assert (expert[:, 0] != expert[:, 1]).all()
+
+    def test_first_choices_have_priority(self):
+        # Choice-major ranking: every first-choice assignment to an
+        # expert outranks (smaller rank than) every second-choice one.
+        expert, rank, _, _, _ = self._route(cap=10**6)
+        expert, rank = np.asarray(expert), np.asarray(rank)
+        for e in range(8):
+            first = rank[:, 0][expert[:, 0] == e]
+            second = rank[:, 1][expert[:, 1] == e]
+            if len(first) and len(second):
+                assert first.max() < second.min()
+
+    def test_top1_gate_is_raw_router_prob(self):
+        # Switch-style: renormalizing a single choice would pin the gate
+        # to 1.0 and cut the router out of the gradient.
+        from tpu_autoscaler.workloads.moe import route_topk
+
+        logits = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+        _, _, gate, _, _ = route_topk(logits, 1, 16)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1)).max(axis=1)
+        np.testing.assert_allclose(np.asarray(gate)[:, 0], probs,
+                                   rtol=1e-6)
+
+    def test_topk_gates_renormalized(self):
+        _, _, gate, _, _ = self._route(k=2)
+        np.testing.assert_allclose(np.asarray(gate).sum(axis=1), 1.0,
+                                   rtol=1e-5)
+
+    def test_balanced_logits_give_unit_balance_loss(self):
+        # Uniform routing minimizes E * sum(f * p) at exactly 1.0.
+        from tpu_autoscaler.workloads.moe import route_topk
+
+        n, e = 64, 8
+        # Round-robin peaked logits: perfectly uniform assignment.
+        logits = -10.0 * jnp.ones((n, e))
+        logits = logits.at[jnp.arange(n), jnp.arange(n) % e].set(10.0)
+        _, _, _, _, aux = route_topk(logits, 1, n)
+        assert abs(float(aux["balance_loss"]) - 1.0) < 0.05
+        frac = np.asarray(aux["expert_fraction"])
+        np.testing.assert_allclose(frac, 1 / e, atol=1e-6)
+
+    def test_collapsed_logits_give_large_balance_loss(self):
+        from tpu_autoscaler.workloads.moe import route_topk
+
+        logits = jnp.zeros((64, 8)).at[:, 3].set(10.0)
+        _, _, _, _, aux = route_topk(logits, 1, 64)
+        assert float(aux["balance_loss"]) > 4.0
+
+
+class TestTopKMoeLayer:
+    def test_top2_matches_reference_without_drops(self):
+        cfg = MoeConfig(num_experts=8, capacity_factor=float(8), top_k=2)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, cfg.d_model))
+        out = make_moe_layer(ep_mesh(4), cfg)(params, x)
+        ref = moe_reference(params, x, top_k=2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16_in_bf16_out(self):
+        # The fp32 gate must not promote the residual stream.
+        cfg = MoeConfig(num_experts=8, capacity_factor=float(8), top_k=2)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, cfg.d_model),
+                              jnp.bfloat16)
+        p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+        assert make_moe_layer(ep_mesh(4), cfg)(p16, x).dtype \
+            == jnp.bfloat16
+        assert moe_reference(p16, x, top_k=2).dtype == jnp.bfloat16
+
+    def test_with_aux_returns_mesh_metrics(self):
+        cfg = MoeConfig(num_experts=8, top_k=2)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, cfg.d_model))
+        out, aux = make_moe_layer(ep_mesh(4), cfg, with_aux=True)(
+            params, x)
+        assert out.shape == x.shape
+        assert np.isfinite(float(aux["balance_loss"]))
+        assert np.isfinite(float(aux["z_loss"]))
+        frac = np.asarray(aux["expert_fraction"])
+        assert frac.shape == (8,)
+        np.testing.assert_allclose(frac.sum(), 1.0, rtol=1e-5)
+
+    def test_top2_differentiable_through_router(self):
+        cfg = MoeConfig(num_experts=8, capacity_factor=float(8), top_k=2)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (32, cfg.d_model))
+        layer = make_moe_layer(ep_mesh(4), cfg)
+        grads = jax.jit(jax.grad(
+            lambda p: jnp.sum(layer(p, x) ** 2)))(params)
+        assert float(jnp.abs(grads["router"]).sum()) > 0

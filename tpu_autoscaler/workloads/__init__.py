@@ -18,9 +18,11 @@ contracts:
 
 from tpu_autoscaler.workloads.model import (
     ModelConfig,
+    TrainConfig,
     forward,
     init_params,
     loss_fn,
+    make_optimizer,
     make_sharded_train_step,
     make_mesh,
 )
@@ -40,12 +42,14 @@ __all__ = [
     "DrainWatcher",
     "KVCache",
     "ModelConfig",
+    "TrainConfig",
     "decode_step",
     "forward",
     "generate",
     "init_params",
     "loss_fn",
     "make_mesh",
+    "make_optimizer",
     "make_sharded_train_step",
     "prefill",
     "restore_checkpoint",

@@ -38,6 +38,7 @@ from tpu_autoscaler.workloads.model import (
     _rmsnorm,
     _rope,
     _split_qkv,
+    moe_ffn,
 )
 
 
@@ -113,9 +114,17 @@ def _block_with_cache(x, layer, k_cache, v_cache, cfg: ModelConfig,
     x = x + jnp.einsum("bsd,de->bse", attn,
                        layer["attn_out"].astype(cfg.dtype))
     y = _rmsnorm(x, layer["ln2"])
-    hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
-    hdn = jax.nn.gelu(hdn)
-    x = x + jnp.einsum("bsf,fd->bsd", hdn, layer["w2"].astype(cfg.dtype))
+    if cfg.moe_experts is None:
+        hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
+        hdn = jax.nn.gelu(hdn)
+        x = x + jnp.einsum("bsf,fd->bsd", hdn,
+                           layer["w2"].astype(cfg.dtype))
+    else:
+        # MoE checkpoints serve with the training-side routing rule
+        # (model.moe_ffn); at decode s=1 each token simply visits its
+        # top-k experts.
+        ffn_out, _aux = moe_ffn(y, layer, cfg)
+        x = x + ffn_out
     return x, k_cache, v_cache
 
 
@@ -174,9 +183,9 @@ def decode_step(params: dict, cache: KVCache, tokens: jax.Array,
 
 
 def _sample(logits: jax.Array, key, temperature: float,
-            top_k: int | None) -> jax.Array:
+            top_k: int | None, top_p: float | None = None) -> jax.Array:
     """Greedy at temperature 0.0 (static branch), else softmax sampling
-    with optional top-k truncation."""
+    with optional top-k and/or top-p (nucleus) truncation."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / temperature
@@ -185,16 +194,34 @@ def _sample(logits: jax.Array, key, temperature: float,
         # inside the hot decode scan.
         kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p is not None:
+        # Nucleus sampling: keep the smallest set of tokens whose
+        # probability mass reaches top_p.  Sort descending, find the
+        # cutoff on the cumulative mass, map it back through a
+        # rank-threshold (all static shapes; the sort is the cost, so
+        # apply top_k first to cheapen it when both are set).
+        sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Token i survives when the mass BEFORE it is < top_p (the
+        # first token always survives).
+        keep_sorted = (cum - probs) < top_p
+        n_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)
+        # The n_keep-th largest logit is the cutoff.
+        cutoff = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
 def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
              steps: int, *, key: jax.Array | None = None,
              temperature: float = 0.0, top_k: int | None = None,
+             top_p: float | None = None,
              max_len: int | None = None) -> jax.Array:
     """Prefill the prompt [b, s], then decode ``steps`` tokens under one
     lax.scan.  Returns [b, s + steps] (prompt + generated).  Greedy by
-    default; pass key + temperature (and optionally top_k) to sample."""
+    default; pass key + temperature (and optionally top_k / top_p) to
+    sample."""
     b, s = prompt.shape
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
@@ -204,15 +231,28 @@ def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
             f"prompt {s} + steps {steps} exceeds max_len {max_len}")
     if temperature != 0.0 and key is None:
         raise ValueError("sampling (temperature != 0) needs a PRNG key")
+    if temperature == 0.0 and (top_k is not None or top_p is not None):
+        # Greedy decoding never consults the truncation knobs; erroring
+        # beats silently returning argmax the caller thinks was sampled.
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (temperature 0 is "
+            "greedy argmax; truncation would be silently ignored)")
+    vocab = params["unembed"].shape[-1]
+    if top_k is not None and not 1 <= top_k <= vocab:
+        # Validate here, not inside lax.top_k's trace, so direct API
+        # callers get the same clear error the CLI gives.
+        raise ValueError(f"top_k must be in [1, {vocab}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     logits, cache = prefill(params, prompt, cfg, max_len)
     key = key if key is not None else jax.random.PRNGKey(0)
     all_keys = jax.random.split(key, steps)
-    first = _sample(logits[:, -1], all_keys[0], temperature, top_k)
+    first = _sample(logits[:, -1], all_keys[0], temperature, top_k, top_p)
 
     def body(carry, step_key):
         cache, token = carry
         logits, cache = decode_step(params, cache, token, cfg)
-        nxt = _sample(logits, step_key, temperature, top_k)
+        nxt = _sample(logits, step_key, temperature, top_k, top_p)
         return (cache, nxt), nxt
 
     # steps-1 decode_steps: the prefill already produced token 1 of

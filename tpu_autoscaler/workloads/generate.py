@@ -35,12 +35,17 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--temperature", default=0.0, show_default=True,
               help="0 = greedy; > 0 samples.")
 @click.option("--top-k", default=None, type=click.IntRange(min=1))
+@click.option("--top-p", default=None, type=click.FloatRange(min=0.0,
+                                                             max=1.0,
+                                                             min_open=True),
+              help="Nucleus sampling: keep the smallest token set with "
+                   "cumulative probability >= this.")
 @click.option("--seed", default=0, show_default=True)
 @model_arch_options
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
-         top_k, seed, seq_len, d_model, n_layers, n_kv_heads,
+         top_k, top_p, seed, seq_len, d_model, n_layers, n_kv_heads,
          attention_window, no_rope, platform):
     """Generate tokens from the latest checkpoint in --checkpoint-dir."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -63,37 +68,45 @@ def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
     if top_k is not None and top_k > cfg.vocab:
         raise click.UsageError(
             f"--top-k {top_k} exceeds the vocab size {cfg.vocab}")
+    if temperature == 0.0 and (top_k is not None or top_p is not None):
+        raise click.UsageError(
+            "--top-k/--top-p need --temperature > 0 (the default 0 is "
+            "greedy decoding, which ignores truncation)")
 
     step = latest_step(checkpoint_dir)
     if step is None:
         raise click.UsageError(
             f"no checkpoint found in {checkpoint_dir!r} (train first: "
             f"python -m tpu_autoscaler.workloads.train)")
-    # The trainer checkpoints {"params": ..., "opt": ...}; orbax restores
-    # whole trees, so mirror the trainer's state shapes (the AdamW
-    # hyperparams don't affect state SHAPES) and discard the opt half.
-    import optax
-
-    def abstract_state(key):
-        params = init_params(key, cfg)
-        return {"params": params, "opt": optax.adamw(1e-3).init(params)}
-
-    abstract = jax.eval_shape(abstract_state, jax.random.PRNGKey(0))
-    try:
-        state = restore_checkpoint(checkpoint_dir, step, abstract)
-    except Exception as e:  # noqa: BLE001 — tree-structure mismatch
+    # The trainer checkpoints {"params": ..., "opt": ...}.  Restore
+    # WITHOUT an abstract tree (orbax reads the saved structure from its
+    # own metadata): serving must not depend on which optimizer recipe —
+    # schedules, clipping, accumulation all change the opt-state SHAPE —
+    # produced the checkpoint.  Genuine I/O failures (permissions,
+    # truncation) propagate with their own error; only a params-tree
+    # mismatch against the flags is diagnosed as a flag mismatch.
+    state = restore_checkpoint(checkpoint_dir, step, None)
+    if not isinstance(state, dict) or "params" not in state:
         raise click.UsageError(
-            f"checkpoint at step {step} does not match the model flags "
-            f"(train and generate must agree on "
-            f"--d-model/--n-layers/...): {e}") from e
-    # Orbax restores the SAVED shapes regardless of the abstract tree's,
-    # so a flag mismatch surfaces here, not in restore.
+            f"checkpoint at step {step} is not a trainer checkpoint "
+            f"(expected a {{'params', 'opt'}} tree)")
+    abstract = jax.eval_shape(
+        lambda key: init_params(key, cfg), jax.random.PRNGKey(0))
+    got_paths = jax.tree_util.tree_flatten_with_path(state["params"])[0]
+    want_paths = jax.tree_util.tree_flatten_with_path(abstract)[0]
+
+    def path_str(path):
+        return "/".join(str(k.key) for k in path)
+
+    if [path_str(p) for p, _ in got_paths] \
+            != [path_str(p) for p, _ in want_paths]:
+        raise click.UsageError(
+            "checkpoint params tree does not match the model flags "
+            "(train and generate must agree on --d-model/--n-layers/...)")
     mismatches = [
-        f"{'/'.join(str(k.key) for k in path)}: checkpoint "
-        f"{tuple(got.shape)} vs flags {tuple(want.shape)}"
-        for (path, got), (_, want) in zip(
-            jax.tree_util.tree_flatten_with_path(state["params"])[0],
-            jax.tree_util.tree_flatten_with_path(abstract["params"])[0])
+        f"{path_str(path)}: checkpoint {tuple(got.shape)} vs flags "
+        f"{tuple(want.shape)}"
+        for (path, got), (_, want) in zip(got_paths, want_paths)
         if tuple(got.shape) != tuple(want.shape)]
     if mismatches:
         raise click.UsageError(
@@ -121,7 +134,7 @@ def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
 
     key = jax.random.PRNGKey(seed) if temperature > 0 else None
     out = generate(params, tokens, cfg, steps, key=key,
-                   temperature=temperature, top_k=top_k)
+                   temperature=temperature, top_k=top_k, top_p=top_p)
     prompt_n = tokens.shape[1]
     for row in out:
         ids = [int(t) for t in row]

@@ -74,6 +74,20 @@ class ModelConfig:
     # length — the trainer feeds seq_len+1 tokens, so that is seq_len
     # itself — or loss_fn falls back to full logits.
     ce_chunk: int | None = None
+    # Mixture-of-experts FFN: when set, every block's dense MLP becomes
+    # ``moe_experts`` expert MLPs with top-``moe_top_k`` token routing
+    # (workloads/moe.py::route_topk — the ep-sharded layer shares the
+    # exact routing rule).  Dispatch is per-sequence (capacity =
+    # moe_capacity_factor * seq * k / E per expert per row), which keeps
+    # the scatter batch-local so pjit's DP sharding never crosses rows.
+    # The router's load-balance and z losses are returned by
+    # features_with_aux and folded into loss_fn with the weights below —
+    # without them top-k routing collapses onto one expert.
+    moe_experts: int | None = None
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_balance_weight: float = 0.01
+    moe_z_weight: float = 1e-3
 
     def __post_init__(self) -> None:
         if self.attention not in {"auto", "einsum", "pallas"}:
@@ -88,6 +102,18 @@ class ModelConfig:
                              f"{self.n_kv_heads}")
         if self.ce_chunk is not None and self.ce_chunk < 1:
             raise ValueError(f"ce_chunk must be >= 1, got {self.ce_chunk}")
+        if self.moe_experts is not None:
+            if self.moe_experts < 2:
+                raise ValueError(f"moe_experts must be >= 2, got "
+                                 f"{self.moe_experts}")
+            if not 1 <= self.moe_top_k <= self.moe_experts:
+                raise ValueError(
+                    f"moe_top_k must be in [1, {self.moe_experts}], got "
+                    f"{self.moe_top_k}")
+            if self.moe_capacity_factor <= 0:
+                raise ValueError(
+                    f"moe_capacity_factor must be > 0, got "
+                    f"{self.moe_capacity_factor}")
         if self.n_heads % self.kv_heads:
             raise ValueError(
                 f"n_heads ({self.n_heads}) must be a multiple of "
@@ -153,11 +179,24 @@ class ModelConfig:
 
 def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
     """Stacked-layer params (leading dim = layer) for lax.scan."""
-    k_emb, k_qkv, k_o, k_w1, k_w2, k_out = jax.random.split(key, 6)
+    k_emb, k_qkv, k_o, k_w1, k_w2, k_out, k_r = jax.random.split(key, 7)
     L, d, f = cfg.n_layers, cfg.d_model, cfg.d_ff
 
     def norm(k, shape, scale):
         return (jax.random.normal(k, shape, jnp.float32) * scale)
+
+    if cfg.moe_experts is None:
+        ffn = {
+            "w1": norm(k_w1, (L, d, f), d ** -0.5),
+            "w2": norm(k_w2, (L, f, d), f ** -0.5),
+        }
+    else:
+        E = cfg.moe_experts
+        ffn = {
+            "router": norm(k_r, (L, d, E), 0.02),
+            "w1": norm(k_w1, (L, E, d, f), d ** -0.5),
+            "w2": norm(k_w2, (L, E, f, d), f ** -0.5),
+        }
 
     return {
         "embed": norm(k_emb, (cfg.vocab, d), 0.02),
@@ -168,8 +207,7 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
                         (L, d, d + 2 * cfg.kv_heads * cfg.head_dim),
                         d ** -0.5),
             "attn_out": norm(k_o, (L, d, d), d ** -0.5),
-            "w1": norm(k_w1, (L, d, f), d ** -0.5),
-            "w2": norm(k_w2, (L, f, d), f ** -0.5),
+            **ffn,
             "ln1": jnp.ones((L, d), jnp.float32),
             "ln2": jnp.ones((L, d), jnp.float32),
         },
@@ -216,9 +254,61 @@ def _split_qkv(y: jax.Array, layer_qkv: jax.Array, cfg: ModelConfig):
     return q, k, v
 
 
+def moe_ffn(y: jax.Array, layer: dict, cfg: ModelConfig):
+    """Top-k MoE FFN over [b, s, d] normed activations.
+
+    Routing is workloads/moe.py::route_topk (the single routing rule in
+    the tree); dispatch is per-sequence — each row routes its seq tokens
+    into [E, cap, d] buffers via batch-local scatter, experts run as one
+    batched einsum over the expert dim (MXU-friendly), and the combine
+    gathers each token's k expert outputs gate-weighted.  Per-row
+    dispatch keeps every tensor leading-batch so pjit's DP sharding
+    passes through untouched; the ep-sharded all_to_all variant lives in
+    workloads/moe.py for expert-parallel meshes.
+
+    Returns (out [b, s, d], aux) with scalar balance/z losses averaged
+    over rows.  Serving reuses this from decode.py so MoE checkpoints
+    decode with the exact training semantics.
+    """
+    from tpu_autoscaler.workloads.moe import route_topk
+
+    b, s, d = y.shape
+    E, k = cfg.moe_experts, cfg.moe_top_k
+    cap = max(1, int(cfg.moe_capacity_factor * s * k / E))
+    router = layer["router"].astype(jnp.float32)
+    logits = jnp.einsum("bsd,de->bse", y.astype(jnp.float32), router)
+
+    w1 = layer["w1"].astype(cfg.dtype)
+    w2 = layer["w2"].astype(cfg.dtype)
+
+    def per_row(y_row, logits_row):
+        expert, rank, gate, keep, aux = route_topk(logits_row, k, cap)
+        safe_rank = jnp.where(keep, rank, 0)
+        dispatch = jnp.zeros((E, cap, d), y_row.dtype)
+        for c in range(k):
+            dispatch = dispatch.at[expert[:, c], safe_rank[:, c]].add(
+                jnp.where(keep[:, c, None], y_row, 0.0))
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", dispatch, w1))
+        out_buf = jnp.einsum("ecf,efd->ecd", h, w2)
+        out = jnp.zeros_like(y_row)
+        for c in range(k):
+            o = out_buf[expert[:, c], safe_rank[:, c]]
+            out = out + jnp.where(keep[:, c, None],
+                                  gate[:, c, None].astype(o.dtype) * o,
+                                  0.0)
+        return out, {"balance_loss": aux["balance_loss"],
+                     "z_loss": aux["z_loss"]}
+
+    out, aux = jax.vmap(per_row)(y, logits)
+    return out, jax.tree.map(jnp.mean, aux)
+
+
 def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
            mesh: Mesh | None = None) -> jax.Array:
     """One transformer block; x: [batch, seq, d_model] in compute dtype.
+
+    Returns ``(x, aux)`` where aux holds the MoE router losses (zeros
+    for dense FFN blocks, so the scan carry structure is uniform).
 
     ``mesh``: when given and multi-device, the Pallas attention path runs
     through shard_map (batch over the non-'model' axes, heads over
@@ -293,16 +383,24 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
                        layer["attn_out"].astype(cfg.dtype))
 
     y = _rmsnorm(x, layer["ln2"])
-    hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
-    hdn = jax.nn.gelu(hdn)
-    x = x + jnp.einsum("bsf,fd->bsd", hdn, layer["w2"].astype(cfg.dtype))
-    return x
+    if cfg.moe_experts is None:
+        hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
+        hdn = jax.nn.gelu(hdn)
+        x = x + jnp.einsum("bsf,fd->bsd", hdn,
+                           layer["w2"].astype(cfg.dtype))
+        aux = {"balance_loss": jnp.zeros((), jnp.float32),
+               "z_loss": jnp.zeros((), jnp.float32)}
+    else:
+        ffn_out, aux = moe_ffn(y, layer, cfg)
+        x = x + ffn_out
+    return x, aux
 
 
-def features(params: dict, tokens: jax.Array, cfg: ModelConfig,
-             mesh: Mesh | None = None) -> jax.Array:
-    """tokens [batch, seq] int32 -> final-norm features [batch, seq,
-    d_model] in compute dtype (everything before the unembedding)."""
+def features_with_aux(params: dict, tokens: jax.Array, cfg: ModelConfig,
+                      mesh: Mesh | None = None):
+    """tokens [batch, seq] int32 -> (final-norm features [batch, seq,
+    d_model] in compute dtype, aux dict of per-layer-MEAN router
+    losses)."""
     x = params["embed"].astype(cfg.dtype)[tokens]
 
     block = functools.partial(_block, cfg=cfg, mesh=mesh)
@@ -310,10 +408,19 @@ def features(params: dict, tokens: jax.Array, cfg: ModelConfig,
         block = jax.checkpoint(block)
 
     def body(x, layer):
-        return block(x, layer), None
+        x, aux = block(x, layer)
+        return x, aux
 
-    x, _ = jax.lax.scan(body, x, params["blocks"])
-    return _rmsnorm(x, params["ln_f"])
+    x, aux_stacked = jax.lax.scan(body, x, params["blocks"])
+    aux = jax.tree.map(jnp.mean, aux_stacked)
+    return _rmsnorm(x, params["ln_f"]), aux
+
+
+def features(params: dict, tokens: jax.Array, cfg: ModelConfig,
+             mesh: Mesh | None = None) -> jax.Array:
+    """tokens [batch, seq] int32 -> final-norm features [batch, seq,
+    d_model] in compute dtype (everything before the unembedding)."""
+    return features_with_aux(params, tokens, cfg, mesh)[0]
 
 
 def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
@@ -353,9 +460,14 @@ def _chunked_ce(x: jax.Array, unembed: jax.Array, targets: jax.Array,
     return total / (b * s)
 
 
-def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig,
-            mesh: Mesh | None = None) -> jax.Array:
-    """Next-token cross-entropy.
+def loss_and_metrics(params: dict, tokens: jax.Array, cfg: ModelConfig,
+                     mesh: Mesh | None = None):
+    """Training loss and its decomposition.
+
+    Returns ``(loss, metrics)``: loss = next-token cross-entropy plus,
+    for MoE configs, the weighted router load-balance and z losses
+    (without which top-k routing collapses onto one expert); metrics
+    reports each term unweighted.
 
     With ``cfg.ce_chunk`` set (and dividing seq) the unembedding +
     softmax run chunked over the sequence (_chunked_ce); otherwise the
@@ -363,14 +475,122 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig,
     """
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     s = inputs.shape[1]
+    x, aux = features_with_aux(params, inputs, cfg, mesh)
     if cfg.ce_chunk is not None and s % cfg.ce_chunk == 0:
-        x = features(params, inputs, cfg, mesh)
-        return _chunked_ce(x, params["unembed"], targets, cfg.ce_chunk,
-                           cfg.dtype)
-    logits = forward(params, inputs, cfg, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+        ce = _chunked_ce(x, params["unembed"], targets, cfg.ce_chunk,
+                         cfg.dtype)
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["unembed"].astype(cfg.dtype)
+                            ).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        ce = jnp.mean(nll)
+    loss = ce
+    metrics = {"ce": ce, **aux}
+    if cfg.moe_experts is not None:
+        loss = (loss + cfg.moe_balance_weight * aux["balance_loss"]
+                + cfg.moe_z_weight * aux["z_loss"])
+    return loss, metrics
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: ModelConfig,
+            mesh: Mesh | None = None) -> jax.Array:
+    """Next-token cross-entropy (+ weighted MoE router losses)."""
+    return loss_and_metrics(params, tokens, cfg, mesh)[0]
+
+
+# ---- optimizer ----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Optimizer hyperparameters for a REAL training run.
+
+    The defaults reproduce the bare ``optax.adamw(1e-3)`` the trainer
+    used before schedules existed, so every existing caller/checkpoint
+    is unchanged unless it opts in.
+
+    - ``warmup_steps`` / ``decay_steps``: linear warmup from 0 to
+      ``learning_rate`` then, when ``decay_steps`` is set, cosine decay
+      to ``learning_rate * min_lr_ratio`` by step ``decay_steps``
+      (warmup included — pass the run's total steps).  Both are counted
+      in TRAINER steps (microbatches), even with ``accum_steps > 1``:
+      make_optimizer rescales the schedule so accumulation never
+      stretches the warmup/decay horizon.  Without ``decay_steps`` the
+      LR holds constant after warmup.
+    - ``grad_clip``: global-norm gradient clipping (applied before the
+      Adam update, the standard LM stabilizer).
+    - ``accum_steps``: gradient accumulation — every k-th step applies
+      the mean of the last k microbatch gradients (optax.MultiSteps);
+      multiplies the effective batch without multiplying live HBM.
+    """
+
+    learning_rate: float = 1e-3
+    warmup_steps: int = 0
+    decay_steps: int | None = None
+    min_lr_ratio: float = 0.1
+    weight_decay: float = 1e-4          # optax.adamw's default
+    b1: float = 0.9
+    b2: float = 0.999
+    grad_clip: float | None = None
+    accum_steps: int = 1
+
+    def __post_init__(self) -> None:
+        if self.warmup_steps < 0:
+            raise ValueError(f"warmup_steps must be >= 0, got "
+                             f"{self.warmup_steps}")
+        if self.decay_steps is not None \
+                and self.decay_steps <= self.warmup_steps:
+            raise ValueError(
+                f"decay_steps ({self.decay_steps}) must exceed "
+                f"warmup_steps ({self.warmup_steps})")
+        if self.grad_clip is not None and self.grad_clip <= 0:
+            raise ValueError(f"grad_clip must be > 0, got {self.grad_clip}")
+        if self.accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got "
+                             f"{self.accum_steps}")
+
+    def schedule(self):
+        """The LR as an optax schedule fn (step -> lr), or a constant."""
+        peak = self.learning_rate
+        if self.decay_steps is not None:
+            return optax.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=peak,
+                warmup_steps=self.warmup_steps,
+                decay_steps=self.decay_steps,
+                end_value=peak * self.min_lr_ratio)
+        if self.warmup_steps:
+            return optax.join_schedules(
+                [optax.linear_schedule(0.0, peak, self.warmup_steps),
+                 optax.constant_schedule(peak)],
+                [self.warmup_steps])
+        return peak
+
+    def lr_at(self, step: int) -> float:
+        """Host-side LR readout for logging."""
+        sched = self.schedule()
+        return float(sched(step)) if callable(sched) else float(sched)
+
+
+def make_optimizer(train: TrainConfig):
+    """The trainer's optax chain: [clip ->] adamw(schedule) [-> accum].
+
+    With accumulation, the inner optimizer's step count advances once
+    per ``accum_steps`` microbatches, so the schedule is evaluated at
+    ``count * accum_steps`` — keeping TrainConfig's warmup/decay
+    horizons in trainer steps regardless of accumulation.
+    """
+    sched = train.schedule()
+    if callable(sched) and train.accum_steps > 1:
+        inner, k = sched, train.accum_steps
+        sched = lambda count: inner(count * k)  # noqa: E731
+    tx = optax.adamw(sched, b1=train.b1, b2=train.b2,
+                     weight_decay=train.weight_decay)
+    if train.grad_clip is not None:
+        tx = optax.chain(optax.clip_by_global_norm(train.grad_clip), tx)
+    if train.accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=train.accum_steps)
+    return tx
 
 
 # ---- sharding -----------------------------------------------------------
@@ -392,13 +612,26 @@ def make_mesh(devices=None, tp: int | None = None) -> Mesh:
 
 def param_specs(cfg: ModelConfig) -> dict:
     """PartitionSpecs: Megatron TP over the 'model' axis."""
+    if cfg.moe_experts is None:
+        ffn = {
+            "w1": P(None, None, "model"),        # column-parallel
+            "w2": P(None, "model", None),        # row-parallel
+        }
+    else:
+        # Experts replicate over 'model'; TP splits each expert's d_ff
+        # (same column/row-parallel pattern as the dense MLP, one expert
+        # dim to the left).  The router is tiny and replicates.
+        ffn = {
+            "router": P(None, None, None),
+            "w1": P(None, None, None, "model"),
+            "w2": P(None, None, "model", None),
+        }
     return {
         "embed": P(None, "model"),
         "blocks": {
             "qkv": P(None, None, "model"),       # heads split
             "attn_out": P(None, "model", None),  # row-parallel
-            "w1": P(None, None, "model"),        # column-parallel
-            "w2": P(None, "model", None),        # row-parallel
+            **ffn,
             "ln1": P(None, None),
             "ln2": P(None, None),
         },
@@ -430,13 +663,17 @@ def batch_spec(mesh: Mesh | None = None) -> P:
     return P(data_axes(mesh), None)
 
 
-def _zero1_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
-    """ZeRO-1 sharding for one optimizer-moment buffer.
+def _zero1_spec(spec: P, shape: tuple, mesh: Mesh,
+                skip_axes: tuple = ()) -> P:
+    """Data-axis sharding for one param-shaped buffer (ZeRO/FSDP).
 
     Keep the param's TP sharding and additionally shard the first
     still-replicated axis whose size divides the total data parallelism
-    over the data axes.  If no axis qualifies (tiny ln gains), the
-    moment stays param-sharded — correct, just not sliced.
+    over the data axes.  ``skip_axes`` excludes axes that must stay
+    whole (the stacked-layer scan axis: slicing it per-device would put
+    a cross-device gather inside every scan iteration).  If no axis
+    qualifies (tiny ln gains), the buffer stays param-sharded —
+    correct, just not sliced.
     """
     daxes = data_axes(mesh)
     dp = int(np.prod([mesh.shape[a] for a in daxes])) if daxes else 1
@@ -444,10 +681,40 @@ def _zero1_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
     for i, (dim, entry) in enumerate(zip(shape, entries)):
+        if i in skip_axes:
+            continue
         if entry is None and dim % dp == 0:
             entries[i] = daxes if len(daxes) > 1 else daxes[0]
             return P(*entries)
     return spec
+
+
+def fsdp_param_specs(cfg: ModelConfig, mesh: Mesh) -> dict:
+    """FSDP/ZeRO-3 PartitionSpecs: TP sharding plus a data-axis shard on
+    each param's first eligible replicated axis.
+
+    Declared entirely through in/out shardings on the jitted step — the
+    GSPMD way: XLA all-gathers each layer's weight shard on use inside
+    the ``lax.scan`` body (one layer live at a time, the FSDP access
+    pattern for free) and reduce-scatters its gradient, with no
+    hand-written collectives.  Per-device param/grad/moment HBM drops by
+    the DP degree — the lever that fits ≥0.5B-param models on one v5e
+    chip's 16 GiB.  The stacked-layer axis (axis 0 of every ``blocks``
+    leaf) is never sharded: it is the scan axis.
+    """
+    shapes = jax.eval_shape(
+        functools.partial(init_params, cfg=cfg), jax.random.PRNGKey(0))
+    specs = param_specs(cfg)
+
+    def one(path, spec):
+        leaf = shapes
+        for k in path:
+            leaf = leaf[k.key]
+        skip = (0,) if path and path[0].key == "blocks" else ()
+        return _zero1_spec(spec, leaf.shape, mesh, skip_axes=skip)
+
+    return jax.tree_util.tree_map_with_path(
+        one, specs, is_leaf=lambda x: isinstance(x, P))
 
 
 def _opt_state_shardings(optimizer, params: dict, p_specs: dict,
@@ -483,22 +750,46 @@ def _opt_state_shardings(optimizer, params: dict, p_specs: dict,
 
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
                             learning_rate: float = 1e-3,
-                            zero1: bool = False):
+                            zero1: bool = False,
+                            train: TrainConfig | None = None,
+                            shard: str | None = None):
     """Build (init_fn, step_fn) jitted over ``mesh`` with real DP+TP
     shardings.  step_fn: (params, opt_state, tokens) -> (params, opt_state,
     loss).  ``attention="auto"`` is resolved per the mesh — see
     ModelConfig.resolved_for_mesh.
 
-    ``zero1``: shard the AdamW moment buffers over the data axes on top
-    of their TP sharding (ZeRO-1).  Declared entirely through
-    out_shardings — XLA lowers the gradient psum into reduce-scatter
-    ahead of the sharded moment update and all-gathers the updates into
-    the replicated params, with no hand-written collectives.  Cuts the
-    fp32 moments (2x param bytes) by the DP degree per device.
+    ``train``: the full optimizer recipe (LR schedule, clipping,
+    accumulation — see TrainConfig); defaults to bare
+    adamw(``learning_rate``) for backward compatibility.
+
+    ``shard`` — how much state shards over the data axes (all declared
+    through in/out shardings; XLA inserts the reduce-scatters and
+    all-gathers, no hand-written collectives):
+
+    - ``"none"``: params/grads/moments replicated over data (pure DP+TP).
+    - ``"zero1"``: AdamW moment buffers (2x param bytes, fp32) shard
+      over the data axes on top of their TP sharding; params and grads
+      stay replicated.  XLA lowers the gradient psum into
+      reduce-scatter ahead of the sharded moment update and all-gathers
+      the updates back into the replicated params.
+    - ``"fsdp"``: params, grads AND moments shard over the data axes
+      (ZeRO-3, see fsdp_param_specs) — per-layer all-gather inside the
+      scan on the forward/backward, reduce-scattered grads, per-device
+      state HBM divided by the DP degree.
+
+    ``zero1=True`` is the legacy spelling of ``shard="zero1"``.
     """
+    if shard is None:
+        shard = "zero1" if zero1 else "none"
+    if shard not in {"none", "zero1", "fsdp"}:
+        raise ValueError(f"unknown shard mode {shard!r}; expected "
+                         "'none', 'zero1' or 'fsdp'")
     cfg = cfg.resolved_for_mesh(mesh)
-    optimizer = optax.adamw(learning_rate)
-    p_specs = param_specs(cfg)
+    if train is None:
+        train = TrainConfig(learning_rate=learning_rate)
+    optimizer = make_optimizer(train)
+    p_specs = (fsdp_param_specs(cfg, mesh) if shard == "fsdp"
+               else param_specs(cfg))
     p_shard = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec), p_specs,
         is_leaf=lambda x: isinstance(x, P))
@@ -506,7 +797,7 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
     replicated = NamedSharding(mesh, P())
     o_shard = _opt_state_shardings(optimizer, jax.eval_shape(
         functools.partial(init_params, cfg=cfg),
-        jax.random.PRNGKey(0)), p_specs, mesh, zero1)
+        jax.random.PRNGKey(0)), p_specs, mesh, shard == "zero1")
 
     def init(key):
         params = init_params(key, cfg)

@@ -1,18 +1,34 @@
-"""Expert parallelism (ep): a switch-style MoE FFN over a mesh axis.
+"""Expert parallelism (ep): a trainable top-k MoE FFN over a mesh axis.
 
-The last of the workload's parallelism modes (dp/tp: model.py, sp:
+One of the workload's parallelism modes (dp/tp: model.py, sp:
 ring_attention.py, pp: pipeline.py).  Experts shard over the ``ep`` axis —
-each device owns E/ep experts — and tokens move to their expert and back
+each device owns E/ep experts — and tokens move to their experts and back
 via two ``lax.all_to_all`` exchanges (the canonical MoE dispatch/combine,
 riding ICI within a slice):
 
-  route (top-1) → bucket by expert with capacity → all_to_all(dispatch)
+  route (top-k) → bucket by expert with capacity → all_to_all(dispatch)
   → local expert MLPs → all_to_all(combine) → gate-weighted unbucket.
 
 Tokens over an expert's capacity are dropped (contribute zero — the
 surrounding residual connection carries them), standard switch-transformer
 semantics.  Differentiable end-to-end: all_to_all transposes to itself on
 the reverse path.
+
+TRAINABLE, not just runnable: routing collapses onto one expert unless the
+router is regularized, so the layer computes the two standard auxiliary
+losses —
+
+- **load-balance loss** (Switch/GShard): ``E * Σ_e f_e · p_e`` where
+  ``f_e`` is the fraction of routed assignments hitting expert e and
+  ``p_e`` the mean router probability of e.  Minimized exactly when both
+  are uniform; keeps the dispatch balanced so capacity drops stay rare.
+- **router z-loss** (ST-MoE): ``mean(logsumexp(logits)²)`` — bounds the
+  router logit scale, which otherwise drifts up and saturates the
+  softmax.
+
+The flagship model's MoE blocks (model.py with ``moe_experts`` set) reuse
+``route_topk`` so the two dispatch implementations cannot disagree on
+routing semantics.
 """
 
 from __future__ import annotations
@@ -30,6 +46,13 @@ class MoeConfig:
     d_ff: int = 64
     num_experts: int = 8
     capacity_factor: float = 1.25
+    top_k: int = 1
+
+    def __post_init__(self) -> None:
+        if not 1 <= self.top_k <= self.num_experts:
+            raise ValueError(
+                f"top_k must be in [1, {self.num_experts}], got "
+                f"{self.top_k}")
 
 
 def init_moe_params(key: jax.Array, cfg: MoeConfig) -> dict:
@@ -42,30 +65,89 @@ def init_moe_params(key: jax.Array, cfg: MoeConfig) -> dict:
     }
 
 
+def route_topk(logits: jax.Array, k: int, capacity: int):
+    """THE routing rule, shared by every MoE impl in the tree.
+
+    logits: [n, e] fp32 router scores for n tokens.  Returns
+    ``(expert, rank, gate, keep, aux)`` each [n, k]:
+
+    - ``expert[i, c]``: the c-th choice expert of token i;
+    - ``rank[i, c]``: its slot within that expert's capacity buffer —
+      choices are prioritized choice-major (all first choices before any
+      second choice, GShard-style), then token-major;
+    - ``gate[i, c]``: combine weight (softmax prob renormalized over the
+      k choices);
+    - ``keep[i, c]``: False when the expert was already at ``capacity``;
+    - ``aux``: dict with the scalar ``balance_loss`` (Switch aux,
+      E·Σ f_e·p_e over kept+dropped assignments) and ``z_loss``
+      (mean logsumexp² of the raw logits), plus ``expert_fraction``
+      [e] — the assignment histogram tests/benchmarks report.
+    """
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)                     # [n, k]
+    if k == 1:
+        # Switch-style: the raw router prob IS the gate — renormalizing
+        # a single choice would pin it to 1.0 and cut the router out of
+        # the gradient entirely.
+        gate = topv
+    else:
+        # Mixtral/GShard-style: renormalize over the k choices.
+        gate = topv / jnp.maximum(
+            jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(topi, e, dtype=jnp.int32)        # [n, k, e]
+    # Slot of assignment (token i, choice c) within its expert: count
+    # earlier choices of ALL tokens, then same-choice earlier tokens.
+    per_choice = onehot.transpose(1, 0, 2)                   # [k, n, e]
+    within = jnp.cumsum(per_choice, axis=1) - per_choice     # before me, same c
+    prior_choices = jnp.cumsum(
+        jnp.sum(per_choice, axis=1), axis=0) - jnp.sum(per_choice, axis=1)
+    rank_full = within + prior_choices[:, None, :]           # [k, n, e]
+    rank = jnp.sum(rank_full.transpose(1, 0, 2) * onehot, axis=-1)  # [n, k]
+    keep = rank < capacity
+
+    # Aux losses over the full (pre-capacity) assignment distribution.
+    frac = jnp.mean(
+        jnp.sum(onehot, axis=1).astype(jnp.float32), axis=0) / k  # [e]
+    mean_prob = jnp.mean(probs, axis=0)                      # [e]
+    balance = e * jnp.sum(frac * mean_prob)
+    z = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    aux = {"balance_loss": balance, "z_loss": z, "expert_fraction": frac}
+    return topi, rank.astype(jnp.int32), gate, keep, aux
+
+
 def moe_reference(params: dict, x: jax.Array,
-                  capacity: int | None = None) -> jax.Array:
-    """Unsharded oracle: top-1 routing, optional per-expert capacity."""
+                  capacity: int | None = None,
+                  top_k: int = 1) -> jax.Array:
+    """Unsharded oracle: top-k routing, optional per-expert capacity."""
     n, d = x.shape
     e = params["router"].shape[1]
-    logits = x @ params["router"]
-    probs = jax.nn.softmax(logits, axis=-1)
-    top = jnp.argmax(logits, axis=-1)                      # [n]
-    gate = jnp.take_along_axis(probs, top[:, None], axis=1)[:, 0]
-    onehot = jax.nn.one_hot(top, e, dtype=jnp.int32)
-    rank = jnp.einsum("ne,ne->n", jnp.cumsum(onehot, axis=0) - 1,
-                      onehot.astype(jnp.int32))
-    keep = jnp.ones((n,), bool) if capacity is None else (rank < capacity)
-    h = jax.nn.gelu(jnp.einsum("nd,ndf->nf", x, params["w1"][top]))
-    out = jnp.einsum("nf,nfd->nd", h, params["w2"][top])
-    return jnp.where(keep[:, None], gate[:, None] * out, 0.0)
+    logits = (x @ params["router"]).astype(jnp.float32)
+    cap = capacity if capacity is not None else n * top_k
+    expert, rank, gate, keep, _ = route_topk(logits, top_k, cap)
+    out = jnp.zeros_like(x)
+    for c in range(top_k):
+        h = jax.nn.gelu(
+            jnp.einsum("nd,ndf->nf", x, params["w1"][expert[:, c]]))
+        o = jnp.einsum("nf,nfd->nd", h, params["w2"][expert[:, c]])
+        out = out + jnp.where(keep[:, c, None],
+                              gate[:, c, None].astype(o.dtype) * o, 0.0)
+    return out.astype(x.dtype)
 
 
-def make_moe_layer(mesh: Mesh, cfg: MoeConfig, ep_axis: str = "ep"):
+def make_moe_layer(mesh: Mesh, cfg: MoeConfig, ep_axis: str = "ep",
+                   with_aux: bool = False):
     """Build ``apply(params, x)`` with experts sharded over ``ep``.
 
     x: [tokens, d_model] sharded over ``ep`` on the token dim; params
     shard on the expert dim (router replicates).  Token count per device
     and expert count must divide the axis size.
+
+    ``with_aux=True``: apply returns ``(out, aux)`` where aux holds the
+    mesh-averaged ``balance_loss`` / ``z_loss`` scalars and the global
+    ``expert_fraction`` histogram — add the scalars (weighted) to the
+    training loss to keep routing balanced.
     """
     ep = mesh.shape[ep_axis]
     if cfg.num_experts % ep:
@@ -75,23 +157,20 @@ def make_moe_layer(mesh: Mesh, cfg: MoeConfig, ep_axis: str = "ep"):
 
     def local_apply(params, x):
         n_loc, d = x.shape
-        e = cfg.num_experts
-        cap = max(1, int(cfg.capacity_factor * n_loc / e))
+        e, k = cfg.num_experts, cfg.top_k
+        cap = max(1, int(cfg.capacity_factor * n_loc * k / e))
 
-        logits = x @ params["router"]                       # [n_loc, e]
-        probs = jax.nn.softmax(logits, axis=-1)
-        top = jnp.argmax(logits, axis=-1)
-        gate = jnp.take_along_axis(probs, top[:, None], axis=1)[:, 0]
-        onehot = jax.nn.one_hot(top, e, dtype=jnp.int32)
-        rank = jnp.einsum("ne,ne->n", jnp.cumsum(onehot, axis=0) - 1,
-                          onehot)
-        keep = rank < cap
+        logits = (x @ params["router"]).astype(jnp.float32)  # [n_loc, e]
+        expert, rank, gate, keep, aux = route_topk(logits, k, cap)
 
-        # Dispatch buffer [e, cap, d]: token n -> slot (top[n], rank[n]).
+        # Dispatch buffer [e, cap, d]: assignment (i, c) -> slot
+        # (expert[i,c], rank[i,c]).  A token can occupy up to k slots
+        # across different experts.
         safe_rank = jnp.where(keep, rank, 0)
         dispatch = jnp.zeros((e, cap, d), x.dtype)
-        dispatch = dispatch.at[top, safe_rank].add(
-            jnp.where(keep[:, None], x, 0.0))
+        for c in range(k):
+            dispatch = dispatch.at[expert[:, c], safe_rank[:, c]].add(
+                jnp.where(keep[:, c, None], x, 0.0))
 
         # To experts: [ep, e_loc, cap, d] -> exchange dim0 over the axis.
         buckets = dispatch.reshape(ep, e_loc, cap, d)
@@ -108,12 +187,30 @@ def make_moe_layer(mesh: Mesh, cfg: MoeConfig, ep_axis: str = "ep"):
         returned = jax.lax.all_to_all(expert_out, ep_axis, split_axis=0,
                                       concat_axis=0, tiled=False)
         combined = returned.reshape(e, cap, d)
-        out = combined[top, safe_rank]                      # [n_loc, d]
-        return jnp.where(keep[:, None], gate[:, None] * out, 0.0)
+        out = jnp.zeros_like(x)
+        for c in range(k):
+            o = combined[expert[:, c], safe_rank[:, c]]      # [n_loc, d]
+            # Cast the fp32 gate into the compute dtype: the combine
+            # must not silently promote a bf16 residual stream to fp32.
+            out = out + jnp.where(keep[:, c, None],
+                                  gate[:, c, None].astype(o.dtype) * o,
+                                  0.0)
+        if not with_aux:
+            return out
+        # Mesh-wide aux: mean of the per-device scalars / histograms.
+        mesh_aux = {
+            key: jax.lax.pmean(val, ep_axis)
+            for key, val in aux.items()
+        }
+        return out, mesh_aux
 
     # Router replicates; experts shard on their leading dim; tokens shard.
     p_specs = {"router": P(None, None), "w1": P(ep_axis, None, None),
                "w2": P(ep_axis, None, None)}
+    out_specs = ((P(ep_axis, None),
+                  {"balance_loss": P(), "z_loss": P(),
+                   "expert_fraction": P()})
+                 if with_aux else P(ep_axis, None))
     return jax.shard_map(local_apply, mesh=mesh,
                          in_specs=(p_specs, P(ep_axis, None)),
-                         out_specs=P(ep_axis, None))
+                         out_specs=out_specs)

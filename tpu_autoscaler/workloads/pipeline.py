@@ -30,7 +30,8 @@ def _stage_forward(blocks: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     """Run THIS stage's layer stack (leading dim = local layers)."""
 
     def body(x, layer):
-        return _block(x, layer, cfg), None
+        x, _aux = _block(x, layer, cfg)
+        return x, None
 
     x, _ = jax.lax.scan(body, x, blocks)
     return x

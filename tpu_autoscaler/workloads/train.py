@@ -33,15 +33,34 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Chunked cross-entropy: unembed+softmax over sequence "
                    "chunks of this size (large-vocab HBM lever).")
 @click.option("--zero1", is_flag=True,
-              help="ZeRO-1: shard AdamW moments over the data axes "
-                   "(cuts fp32 optimizer HBM by the DP degree).")
+              help="Deprecated alias for --shard zero1.")
+@click.option("--shard", "shard_mode",
+              type=click.Choice(["none", "zero1", "fsdp"]), default=None,
+              help="Data-axis state sharding: zero1 = AdamW moments "
+                   "(cuts fp32 optimizer HBM by the DP degree); fsdp = "
+                   "params+grads+moments (ZeRO-3, fits ~DPx larger "
+                   "models).  Default: none.")
+@click.option("--lr", default=1e-3, show_default=True,
+              help="Peak learning rate.")
+@click.option("--warmup-steps", default=0, show_default=True,
+              help="Linear LR warmup from 0 to --lr.")
+@click.option("--lr-schedule", type=click.Choice(["constant", "cosine"]),
+              default="constant", show_default=True,
+              help="cosine: decay to --min-lr-ratio * --lr over --steps.")
+@click.option("--min-lr-ratio", default=0.1, show_default=True)
+@click.option("--grad-clip", default=None, type=float,
+              help="Global-norm gradient clipping threshold.")
+@click.option("--accum-steps", default=1, show_default=True,
+              help="Gradient accumulation: apply the optimizer every k "
+                   "microbatch steps (k-times the effective batch).")
+@click.option("--weight-decay", default=1e-4, show_default=True)
 @click.option("--data-file", default=None,
               help="Binary uint32 token shard to train on (native mmap "
                    "loader with prefetch; numpy fallback).  Default: "
                    "synthetic random tokens.")
 @click.option("--profile-dir", default=None,
-              help="Capture a jax.profiler trace of steps 2-5 into this "
-                   "directory (view with TensorBoard / xprof).")
+              help="Capture a jax.profiler trace of steps start+3..start+5 "
+                   "into this directory (view with TensorBoard / xprof).")
 @click.option("--checkpoint-dir", default="/tmp/tpu-train-ckpt",
               show_default=True)
 @click.option("--checkpoint-every", default=50, show_default=True)
@@ -51,7 +70,9 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu for local smoke runs).")
 def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
-         attention_window, no_rope, remat, ce_chunk, zero1, data_file,
+         attention_window, no_rope, remat, ce_chunk, zero1, shard_mode,
+         lr, warmup_steps, lr_schedule, min_lr_ratio, grad_clip,
+         accum_steps, weight_decay, data_file,
          profile_dir, checkpoint_dir,
          checkpoint_every, annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
@@ -77,6 +98,7 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
         make_multislice_mesh,
     )
     from tpu_autoscaler.workloads.model import (
+        TrainConfig,
         batch_spec,
         make_mesh,
         make_sharded_train_step,
@@ -94,8 +116,14 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
     # over DCN, TP stays inside each slice's ICI domain.
     mesh = (make_multislice_mesh(topo.num_slices) if topo.num_slices > 1
             else make_mesh())
-    init_fn, raw_step_fn = make_sharded_train_step(mesh, cfg,
-                                                   zero1=zero1)
+    train_cfg = TrainConfig(
+        learning_rate=lr, warmup_steps=warmup_steps,
+        decay_steps=steps if lr_schedule == "cosine" else None,
+        min_lr_ratio=min_lr_ratio, weight_decay=weight_decay,
+        grad_clip=grad_clip, accum_steps=accum_steps)
+    init_fn, raw_step_fn = make_sharded_train_step(
+        mesh, cfg, train=train_cfg,
+        shard=shard_mode or ("zero1" if zero1 else "none"))
     params, opt_state = init_fn(jax.random.PRNGKey(0))
     log.info("mesh %s; params initialized", dict(mesh.shape))
 
@@ -134,6 +162,8 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
         log.info("token shard %s: %d tokens (%s loader)", data_file,
                  loader.n_tokens, type(loader).__name__)
 
+    vocab_warned = [False]
+
     def batch_for(step):
         # Host-local numpy rows assembled into one global array over the
         # mesh — jit cannot reshard a single-device array onto
@@ -141,8 +171,14 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
         if loader is not None:
             # Clip to the model's vocab: shards may be tokenized with a
             # larger vocabulary than this run trains.
-            local = (loader.next(step) % np.uint32(cfg.vocab)).astype(
-                np.int32)
+            raw = loader.next(step)
+            if not vocab_warned[0] and int(raw.max()) >= cfg.vocab:
+                vocab_warned[0] = True
+                log.warning(
+                    "token shard contains ids >= model vocab %d; they "
+                    "are aliased with modulo — retokenize or raise "
+                    "--vocab if this is unintended", cfg.vocab)
+            local = (raw % np.uint32(cfg.vocab)).astype(np.int32)
         else:
             rng = np.random.default_rng((step << 16) | topo.process_id)
             local = rng.integers(0, cfg.vocab,
