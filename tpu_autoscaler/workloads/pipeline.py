@@ -5,9 +5,20 @@ ring_attention.py, ep in moe.py): the transformer's stacked layer params
 shard over the ``pp`` axis on their leading (layer) dimension — stage i
 holds layers [i·L/P, (i+1)·L/P) — and microbatches stream through the
 stage ring via ``lax.ppermute``, GPipe-style.  The schedule is an ordinary
-``lax.fori_loop`` inside ``shard_map``, so reverse-mode AD derives the
-backward pipeline automatically (ppermute transposes to the reversed
-ring); no hand-written 1F1B pass is needed at these scales.
+``lax.fori_loop`` (static trip count, so it lowers to scan) inside
+``shard_map``, and reverse-mode AD derives the backward pipeline
+automatically (ppermute transposes to the reversed ring); no hand-written
+1F1B pass is needed at these scales.
+
+Schedule economics (GPipe): with P stages and m microbatches the loop
+runs m+P-1 ticks, of which P-1 are bubble — bubble fraction
+(P-1)/(m+P-1), so m >= 4P keeps it under ~20%.  1F1B would cut the
+activation stash from O(m) to O(P) microbatches but requires scheduling
+the backward by hand (JAX's AD owns it here); the same memory lever is
+exposed instead as ``remat=True`` on the train step, which checkpoints
+each tick's stage forward so AD stores only the O(m) inter-stage carries
+and recomputes block activations in the backward — the standard
+GPipe-with-remat recipe.
 
 Autoscaler relevance: a pp×dp job spans whole slices with the pp ring on
 ICI — another communication pattern that must never be bisected, which is
@@ -20,43 +31,80 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_autoscaler.workloads._shard_utils import pvary
-from tpu_autoscaler.workloads.model import ModelConfig, _block, _rmsnorm
+from tpu_autoscaler.workloads.model import (
+    ModelConfig,
+    TrainConfig,
+    _block,
+    _rmsnorm,
+    make_optimizer,
+)
 
 
-def _stage_forward(blocks: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """Run THIS stage's layer stack (leading dim = local layers)."""
+def _stage_forward(blocks: dict, x: jax.Array, cfg: ModelConfig):
+    """Run THIS stage's layer stack (leading dim = local layers).
+
+    Returns (x, aux) with aux meaned over the local layers (MoE router
+    losses; zeros for dense blocks)."""
 
     def body(x, layer):
-        x, _aux = _block(x, layer, cfg)
-        return x, None
+        x, aux = _block(x, layer, cfg)
+        return x, aux
 
-    x, _ = jax.lax.scan(body, x, blocks)
-    return x
+    x, aux_stacked = jax.lax.scan(body, x, blocks)
+    return x, jax.tree.map(jnp.mean, aux_stacked)
+
+
+def pipeline_param_specs(cfg: ModelConfig, pp_axis: str = "pp") -> dict:
+    """PartitionSpecs for the standard model pytree under pp: blocks
+    shard over ``pp_axis`` on the layer dim, embed/unembed/ln_f
+    replicate (stage 0 uses the embedding, the last stage the
+    unembedding; replication keeps the pytree uniform)."""
+    if cfg.moe_experts is None:
+        ffn = {"w1": P(pp_axis, None, None), "w2": P(pp_axis, None, None)}
+    else:
+        ffn = {"router": P(pp_axis, None, None),
+               "w1": P(pp_axis, None, None, None),
+               "w2": P(pp_axis, None, None, None)}
+    block_specs = {
+        "qkv": P(pp_axis, None, None), "attn_out": P(pp_axis, None, None),
+        **ffn,
+        "ln1": P(pp_axis, None), "ln2": P(pp_axis, None),
+    }
+    return {"embed": P(None, None), "blocks": block_specs,
+            "ln_f": P(None), "unembed": P(None, None)}
 
 
 def make_pipeline_loss(mesh: Mesh, cfg: ModelConfig,
-                       num_microbatches: int, pp_axis: str = "pp"):
+                       num_microbatches: int, pp_axis: str = "pp",
+                       remat: bool = False):
     """Build ``loss(params, tokens)`` pipelined over ``mesh``'s pp axis.
 
     params: the standard model pytree (model.init_params) — blocks shard
     over pp on the layer dim, embed/unembed/ln_f replicate.  tokens:
     [batch, seq+1] int32, batch divisible by num_microbatches.
+
+    ``remat``: checkpoint each tick's stage forward — AD then stores
+    only the inter-stage ppermute carries and recomputes the block
+    activations in the backward (the GPipe memory lever; see module
+    docstring).
+
+    MoE configs fold the router balance/z losses in exactly like
+    model.loss_and_metrics (weighted by cfg.moe_*_weight), so the
+    pipelined loss stays comparable to the unpipelined one.
     """
     n_stages = mesh.shape[pp_axis]
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"{cfg.n_layers} layers not divisible by {n_stages} stages")
 
-    block_specs = {
-        "qkv": P(pp_axis, None, None), "attn_out": P(pp_axis, None, None),
-        "w1": P(pp_axis, None, None), "w2": P(pp_axis, None, None),
-        "ln1": P(pp_axis, None), "ln2": P(pp_axis, None),
-    }
-    param_specs = {"embed": P(None, None), "blocks": block_specs,
-                   "ln_f": P(None), "unembed": P(None, None)}
+    param_specs = pipeline_param_specs(cfg, pp_axis)
+    stage_fwd = functools.partial(_stage_forward, cfg=cfg)
+    if remat:
+        stage_fwd = jax.checkpoint(stage_fwd)
 
     def local_loss(params, tokens):
         idx = jax.lax.axis_index(pp_axis)
@@ -71,13 +119,19 @@ def make_pipeline_loss(mesh: Mesh, cfg: ModelConfig,
         zeros = jnp.zeros((mb, s, d), cfg.dtype)
 
         def tick(t, carry):
-            buf, outs = carry
+            buf, outs, aux_sum = carry
             # Stage 0 ingests microbatch t (clamped; only used while
             # t < m); later stages consume the ring buffer.
             ingest = jax.lax.dynamic_index_in_dim(
                 embedded, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
             x_in = jnp.where(idx == 0, ingest, buf)
-            y = _stage_forward(params["blocks"], x_in, cfg)
+            y, aux = stage_fwd(params["blocks"], x_in)
+            # This stage is processing microbatch t - idx; its aux only
+            # counts while that is a real microbatch (not bubble).
+            stage_valid = jnp.logical_and(t - idx >= 0, t - idx < m)
+            aux_sum = jax.tree.map(
+                lambda acc, a: acc + jnp.where(stage_valid, a, 0.0),
+                aux_sum, aux)
             # Last stage banks microbatch t-(P-1) when in range.
             out_t = t - (n_stages - 1)
             valid = jnp.logical_and(out_t >= 0, out_t < m)
@@ -88,11 +142,16 @@ def make_pipeline_loss(mesh: Mesh, cfg: ModelConfig,
             # Rotate activations one hop down the stage ring.
             perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
             buf = jax.lax.ppermute(y, pp_axis, perm)
-            return buf, outs
+            return buf, outs, aux_sum
 
         buf0 = pvary(zeros, pp_axis)
         outs0 = pvary(jnp.zeros((m, mb, s, d), cfg.dtype), pp_axis)
-        _, outs = jax.lax.fori_loop(0, m + n_stages - 1, tick, (buf0, outs0))
+        aux0 = jax.tree.map(
+            lambda a: pvary(a, pp_axis),
+            {"balance_loss": jnp.zeros((), jnp.float32),
+             "z_loss": jnp.zeros((), jnp.float32)})
+        _, outs, aux_sum = jax.lax.fori_loop(
+            0, m + n_stages - 1, tick, (buf0, outs0, aux0))
 
         # Loss on the last stage only; psum shares it with the ring (and
         # gives every stage the same scalar, keeping grads correct).
@@ -104,7 +163,18 @@ def make_pipeline_loss(mesh: Mesh, cfg: ModelConfig,
         nll = -jnp.take_along_axis(
             logp, targets.reshape(m * mb, s)[..., None], axis=-1)
         local = jnp.where(idx == n_stages - 1, jnp.mean(nll), 0.0)
-        return jax.lax.psum(local, pp_axis)
+        loss = jax.lax.psum(local, pp_axis)
+        if cfg.moe_experts is not None:
+            # Each stage's aux_sum is Σ over its m microbatches of its
+            # local-layer mean; psum over stages then / (m·P) recovers
+            # the all-layer, all-microbatch mean — the same quantity
+            # model.loss_and_metrics reports.
+            aux = jax.tree.map(
+                lambda a: jax.lax.psum(a, pp_axis)
+                / (m * n_stages), aux_sum)
+            loss = (loss + cfg.moe_balance_weight * aux["balance_loss"]
+                    + cfg.moe_z_weight * aux["z_loss"])
+        return loss
 
     sharded = jax.shard_map(
         local_loss, mesh=mesh,
@@ -115,3 +185,63 @@ def make_pipeline_loss(mesh: Mesh, cfg: ModelConfig,
         return sharded(params, tokens)
 
     return loss
+
+
+def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig,
+                             num_microbatches: int, pp_axis: str = "pp",
+                             learning_rate: float = 1e-3,
+                             train: TrainConfig | None = None,
+                             remat: bool = True):
+    """Build (init_fn, step_fn) for GPipe training over ``mesh``'s pp
+    axis: grads and the optimizer both live under the pp shardings, so
+    each stage updates only the layer shard it owns (plus the small
+    replicated embed/unembed/ln leaves).
+
+    step_fn: (params, opt_state, tokens) -> (params, opt_state, loss),
+    jitted with the pipeline in/out shardings; loss matches the
+    unpipelined train step's (tests pin the parity).  ``remat`` defaults
+    True — microbatch rematerialization is the point of pipelining at
+    memory-bound scales.
+
+    The optimizer recipe is the trainer's (model.make_optimizer):
+    schedules, clipping and accumulation all apply unchanged because
+    they act on the (stage-sharded) grads elementwise or via a global
+    norm XLA computes with a cross-stage psum.
+    """
+    from tpu_autoscaler.workloads.model import (
+        _opt_state_shardings,
+        init_params,
+    )
+
+    if train is None:
+        train = TrainConfig(learning_rate=learning_rate)
+    optimizer = make_optimizer(train)
+    loss_fn = make_pipeline_loss(mesh, cfg, num_microbatches, pp_axis,
+                                 remat=remat)
+    p_specs = pipeline_param_specs(cfg, pp_axis)
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), p_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    replicated = NamedSharding(mesh, P())
+    o_shard = _opt_state_shardings(optimizer, jax.eval_shape(
+        functools.partial(init_params, cfg=cfg),
+        jax.random.PRNGKey(0)), p_specs, mesh, False)
+
+    def init(key):
+        params = init_params(key, cfg)
+        return params, optimizer.init(params)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    init_jit = jax.jit(init, out_shardings=(p_shard, o_shard))
+    step_jit = jax.jit(
+        step,
+        in_shardings=(p_shard, o_shard, replicated),
+        out_shardings=(p_shard, o_shard, replicated),
+        donate_argnums=(0, 1),
+    )
+    return init_jit, step_jit

@@ -54,6 +54,13 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Gradient accumulation: apply the optimizer every k "
                    "microbatch steps (k-times the effective batch).")
 @click.option("--weight-decay", default=1e-4, show_default=True)
+@click.option("--pp-stages", default=1, show_default=True,
+              help="Pipeline parallelism: split layers over this many "
+                   "stages (GPipe with microbatch remat).  1 = off "
+                   "(dp+tp mesh).")
+@click.option("--pp-microbatches", default=4, show_default=True,
+              help="Microbatches streamed through the pipeline per step "
+                   "(bubble fraction = (P-1)/(m+P-1)).")
 @click.option("--data-file", default=None,
               help="Binary uint32 token shard to train on (native mmap "
                    "loader with prefetch; numpy fallback).  Default: "
@@ -72,7 +79,7 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
          attention_window, no_rope, remat, ce_chunk, zero1, shard_mode,
          lr, warmup_steps, lr_schedule, min_lr_ratio, grad_clip,
-         accum_steps, weight_decay, data_file,
+         accum_steps, weight_decay, pp_stages, pp_microbatches, data_file,
          profile_dir, checkpoint_dir,
          checkpoint_every, annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
@@ -114,16 +121,50 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
                        ce_chunk=ce_chunk)
     # Multi-slice jobs get the (dcn, data, model) mesh: DP crosses slices
     # over DCN, TP stays inside each slice's ICI domain.
-    mesh = (make_multislice_mesh(topo.num_slices) if topo.num_slices > 1
-            else make_mesh())
     train_cfg = TrainConfig(
         learning_rate=lr, warmup_steps=warmup_steps,
         decay_steps=steps if lr_schedule == "cosine" else None,
         min_lr_ratio=min_lr_ratio, weight_decay=weight_decay,
         grad_clip=grad_clip, accum_steps=accum_steps)
-    init_fn, raw_step_fn = make_sharded_train_step(
-        mesh, cfg, train=train_cfg,
-        shard=shard_mode or ("zero1" if zero1 else "none"))
+    shard = shard_mode or ("zero1" if zero1 else "none")
+    if pp_stages > 1:
+        # Pipeline mode: layers over a pp ring (GPipe, microbatch
+        # remat); tokens replicate across stages.
+        if shard != "none":
+            raise click.UsageError(
+                "--shard composes with the dp+tp step, not --pp-stages "
+                "(stage-sharded state is already partitioned)")
+        if batch % pp_microbatches:
+            raise click.UsageError(
+                f"--pp-microbatches {pp_microbatches} must divide "
+                f"--batch {batch}")
+        if topo.num_processes > 1:
+            # The pp step replicates tokens across stages; per-process
+            # batch assembly (each host building its local rows) is only
+            # wired for the dp/tp data-sharded path.
+            raise click.UsageError(
+                "--pp-stages is single-process only for now; multi-host "
+                "jobs should use the dp+tp step (--shard)")
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        from tpu_autoscaler.workloads.pipeline import (
+            make_pipeline_train_step,
+        )
+
+        if len(jax.devices()) < pp_stages:
+            raise click.UsageError(
+                f"--pp-stages {pp_stages} exceeds the {len(jax.devices())}"
+                f" available devices")
+        mesh = Mesh(_np.asarray(jax.devices()[:pp_stages]),
+                    axis_names=("pp",))
+        init_fn, raw_step_fn = make_pipeline_train_step(
+            mesh, cfg, num_microbatches=pp_microbatches, train=train_cfg)
+    else:
+        mesh = (make_multislice_mesh(topo.num_slices)
+                if topo.num_slices > 1 else make_mesh())
+        init_fn, raw_step_fn = make_sharded_train_step(
+            mesh, cfg, train=train_cfg, shard=shard)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
     log.info("mesh %s; params initialized", dict(mesh.shape))
 
@@ -140,7 +181,12 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
         log.info("resumed from checkpoint step %d", start)
 
     watcher = DrainWatcher(annotations_file or DEFAULT_ANNOTATIONS_PATH)
-    b_sharding = NamedSharding(mesh, batch_spec(mesh))
+    from jax.sharding import PartitionSpec as _P
+
+    # Pipeline stages all see the full batch (the pp loop microbatches
+    # internally); dp/tp meshes shard it over the data axes.
+    b_sharding = NamedSharding(
+        mesh, _P() if pp_stages > 1 else batch_spec(mesh))
     n_proc = max(1, topo.num_processes)
     local_batch = max(1, batch // n_proc)
 
