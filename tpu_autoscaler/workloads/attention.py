@@ -559,54 +559,83 @@ def make_sharded_flash_attention(mesh, *, causal: bool = True,
     return attn
 
 
-def _ring_step_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+def _rel_mask(scores, offset, window):
+    """Causal/window mask on a [..., sq, sk] score block whose q
+    positions lead its k positions by ``offset`` (traced): key visible
+    iff 0 <= offset + q - k (< window).  The single definition of the
+    ring hops' mask semantics, shared by the einsum merge
+    (ring_attention.py) and the pallas ring kernels below."""
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape,
+                                     scores.ndim - 2)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape,
+                                     scores.ndim - 1)
+    rel = offset + q_pos - k_pos
+    keep = rel >= 0
+    if window is not None:
+        keep &= rel < window
+    return jnp.where(keep, scores, NEG_INF)
+
+
+def _ring_mask(scores, off, qi, block_q: int, window):
+    """_rel_mask for one [block_q, sk] tile at q-block ``qi``: fold the
+    tile's q start into the hop offset."""
+    return _rel_mask(scores, off + qi * block_q, window)
+
+
+def _ring_step_kernel(off_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
                       m_out, l_out, acc_out, *, sm_scale: float,
-                      diag: bool, block_q: int):
-    """One ring-attention hop, fused: QK^T → (diag mask) → online-softmax
+                      masked: bool, window, block_q: int):
+    """One ring-attention hop, fused: QK^T → (mask) → online-softmax
     merge into the carried (m, l, acc) — the cross-device analog of the
     flash forward, with the running stats living across ppermute hops
-    instead of across k-blocks.  ``diag=True`` is the src==self hop of a
-    causal ring (lower-triangular block); fully-visible hops use
-    ``diag=False``; invisible hops never reach the kernel (lax.switch
-    skips them outside)."""
+    instead of across k-blocks.  ``masked=True`` applies the causal (and
+    sliding-window) mask from the hop's element offset in SMEM; fully
+    visible hops compile with ``masked=False`` and skip the iota work;
+    invisible hops never reach the kernel (lax.switch skips them in the
+    ring driver)."""
     qi = pl.program_id(1)
     # Input-dtype QK^T with f32 accumulation (native MXU path for bf16);
     # sm_scale applies to the f32 scores.
     scores = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale    # [bq, sk]
-    v = v_ref[0]
-    if diag:
-        # The diag hop's visible keys start at this shard's position 0,
-        # i.e. k-block index 0 with a k-block offset of ki*block_k == 0.
-        scores = _block_mask(scores, qi, 0, block_q, 0)
+    if masked:
+        scores = _ring_mask(scores, off_ref[0], qi, block_q, window)
     m_out[0], l_out[0], acc_out[0] = _online_softmax_merge(
-        scores, v, m_ref[0], l_ref[0], acc_ref[0])
+        scores, v_ref[0], m_ref[0], l_ref[0], acc_ref[0])
 
 
-def ring_flash_step(q, k_t, v_t, m, l, acc, *, diag: bool,
-                    block_q: int = 128, interpret: bool = False):
+def ring_flash_step(q, k_t, v_t, m, l, acc, *, offset, masked: bool,
+                    window: int | None = None, block_q: int = 128,
+                    interpret: bool = False):
     """Merge one rotating K/V block into the ring carry, fused in VMEM.
 
     q: [b, h, sq, d] (this device's queries; any dtype);
-    k_t, v_t: [b, h, sk, d] (the block currently visiting);
-    m, l: [b, h, sq, 1] f32; acc: [b, h, sq, d] f32.
+    k_t, v_t: [b, h_kv, sk, d] (the block currently visiting; h_kv may
+    divide h — GQA wired at the index-map level like the flash kernels);
+    m, l: [b, h, sq, 1] f32; acc: [b, h, sq, d] f32;
+    offset: traced int32, global(q_block_start) - global(k_block_start)
+    — only read when ``masked``.
     Returns the updated (m, l, acc).  No [sq, sk] tensor touches HBM.
     """
     b, h, sq, d = q.shape
-    sk = k_t.shape[2]
+    h_kv, sk = k_t.shape[1], k_t.shape[2]
     block_q = _fit_block(sq, block_q)
     sm_scale = d ** -0.5
+    kv_of = _kv_head_map(h, h_kv)
     fold = _fold_heads
     kernel = functools.partial(_ring_step_kernel, sm_scale=sm_scale,
-                               diag=diag, block_q=block_q)
+                               masked=masked, window=window,
+                               block_q=block_q)
     qspec = pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0))
-    kspec = pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0))
+    kspec = pl.BlockSpec((1, sk, d), lambda bh, i: (kv_of(bh), 0, 0))
     mspec = pl.BlockSpec((1, block_q, 1), lambda bh, i: (bh, i, 0))
+    off = jnp.asarray(offset, jnp.int32).reshape(1)
     m2, l2, acc2 = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
-        in_specs=[qspec, kspec, kspec, mspec, mspec, qspec],
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, kspec, kspec, mspec, mspec, qspec],
         out_specs=(mspec, mspec, qspec),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
@@ -614,9 +643,131 @@ def ring_flash_step(q, k_t, v_t, m, l, acc, *, diag: bool,
             jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
         ),
         interpret=interpret,
-    )(fold(q), fold(k_t), fold(v_t), fold(m), fold(l), fold(acc))
+    )(off, fold(q), fold(k_t), fold(v_t), fold(m), fold(l), fold(acc))
     unfold = lambda x: x.reshape(b, h, *x.shape[1:])  # noqa: E731
     return unfold(m2), unfold(l2), unfold(acc2)
+
+
+def _ring_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dq_ref, *, sm_scale: float,
+                        masked: bool, window, block_q: int):
+    """Per-hop dq: rebuild this (q-block, visiting-KV) tile's p from the
+    saved lse — no forward recompute — then dq = (p∘(dp-δ)) K · scale."""
+    qi = pl.program_id(1)
+    scores = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale    # [bq, sk]
+    if masked:
+        scores = _ring_mask(scores, off_ref[0], qi, block_q, window)
+    p = jnp.exp(scores - lse_ref[0])                      # masked -> 0
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])
+    dq_ref[0] = jax.lax.dot_general(
+        ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+
+
+def _ring_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                         sm_scale: float, masked: bool, window,
+                         block_q: int, n_qb: int, n_inner: int):
+    """Per-hop dk/dv for the visiting block, accumulated in VMEM scratch
+    over every (q-head-in-group, q-block) pair feeding this KV head."""
+    inner = pl.program_id(1)
+    qi = inner % n_qb
+
+    @pl.when(inner == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    scores = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale    # [bq, sk]
+    if masked:
+        scores = _ring_mask(scores, off_ref[0], qi, block_q, window)
+    p = jnp.exp(scores - lse_ref[0])
+    dv_scr[...] += jax.lax.dot_general(
+        p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [sk, d]
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])
+    dk_scr[...] += jax.lax.dot_general(
+        ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(inner == n_inner - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...]
+        dv_ref[0] = dv_scr[...]
+
+
+def ring_flash_bwd_step(q, k_t, v_t, do, lse, delta, *, offset,
+                        masked: bool, window: int | None = None,
+                        block_q: int = 128, interpret: bool = False):
+    """One backward ring hop, fused: given this device's (q, do, lse, δ)
+    and the visiting (k_t, v_t), return (dq_add [b,h,sq,d] f32,
+    dk_add/dv_add [b,h_kv,sk,d] f32) — the contributions this hop adds
+    to the local dq accumulator and to the rotating dk/dv buffers.
+    Probabilities are rebuilt from the saved lse (recompute-p flash
+    backward), so no forward pass and no [sq, sk] HBM tensor."""
+    b, h, sq, d = q.shape
+    h_kv, sk = k_t.shape[1], k_t.shape[2]
+    group = h // h_kv
+    block_q = _fit_block(sq, block_q)
+    n_qb = sq // block_q
+    sm_scale = d ** -0.5
+    kv_of = _kv_head_map(h, h_kv)
+    fold = _fold_heads
+    fq, fk, fv, fdo = fold(q), fold(k_t), fold(v_t), fold(do)
+    flse, fdelta = fold(lse), fold(delta)
+    off = jnp.asarray(offset, jnp.int32).reshape(1)
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0))
+    kspec = pl.BlockSpec((1, sk, d), lambda bh, i: (kv_of(bh), 0, 0))
+    rspec = pl.BlockSpec((1, block_q, 1), lambda bh, i: (bh, i, 0))
+    dq_add = pl.pallas_call(
+        functools.partial(_ring_bwd_dq_kernel, sm_scale=sm_scale,
+                          masked=masked, window=window, block_q=block_q),
+        grid=(b * h, n_qb),
+        in_specs=[sspec, qspec, kspec, kspec, qspec, rspec, rspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
+        interpret=interpret,
+    )(off, fq, fk, fv, fdo, flse, fdelta)
+
+    # dk/dv: grid (b*h_kv, group*n_qb) — inner axis walks every
+    # (q-head-in-group, q-block) pair feeding this KV head.
+    def q_of(bhk, inner):
+        return ((bhk // h_kv) * h + (bhk % h_kv) * group + inner // n_qb,
+                inner % n_qb, 0)
+
+    qspec_g = pl.BlockSpec((1, block_q, d), q_of)
+    rspec_g = pl.BlockSpec((1, block_q, 1), q_of)
+    kspec_g = pl.BlockSpec((1, sk, d), lambda bhk, inner: (bhk, 0, 0))
+    dk_add, dv_add = pl.pallas_call(
+        functools.partial(_ring_bwd_dkv_kernel, sm_scale=sm_scale,
+                          masked=masked, window=window, block_q=block_q,
+                          n_qb=n_qb, n_inner=group * n_qb),
+        grid=(b * h_kv, group * n_qb),
+        in_specs=[sspec, qspec_g, kspec_g, kspec_g, qspec_g, rspec_g,
+                  rspec_g],
+        out_specs=(kspec_g, kspec_g),
+        out_shape=(jax.ShapeDtypeStruct((b * h_kv, sk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b * h_kv, sk, d), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((sk, d), jnp.float32),
+                        pltpu.VMEM((sk, d), jnp.float32)],
+        interpret=interpret,
+    )(off, fq, fk, fv, fdo, flse, fdelta)
+
+    unfold_q = lambda x: x.reshape(b, h, sq, d)  # noqa: E731
+    unfold_kv = lambda x: x.reshape(b, h_kv, sk, d)  # noqa: E731
+    return unfold_q(dq_add), unfold_kv(dk_add), unfold_kv(dv_add)
 
 
 def reference_attention(q, k, v, *, causal=True, window=None):

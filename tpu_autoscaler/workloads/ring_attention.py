@@ -9,6 +9,13 @@ attention with an online-softmax running (max, sum, acc) — so the full
 [s, s] score matrix never exists anywhere and the per-device memory is
 O(s_local²) compute-transient, O(s_local·d) resident.
 
+Composes with the round-2 attention features: K/V may carry fewer heads
+than Q (GQA/MQA — the ring then also moves group-times less ICI traffic),
+and ``window=w`` restricts each query to its w most recent keys, with
+fully-out-of-window hops skipped entirely (compute AND ppermute payload
+still rotate, but the merge is elided, so compute scales with the live
+band).
+
 This is exactly the communication pattern the autoscaler must never
 bisect: the ring rides the ICI torus of ONE slice (provision atomically,
 drain atomically).  Multi-slice jobs keep sequence parallelism inside each
@@ -29,15 +36,41 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _ring_driver(q, k, v, *, axis_name: str, causal: bool, merge):
+def _hop_mode(src, my_idx, s_loc: int, causal: bool, window):
+    """(mode, offset) for the hop whose visiting K/V block originated at
+    ``src``: mode 0 = invisible (skip the merge entirely), 1 = partially
+    masked (apply the causal/window mask), 2 = fully visible.  offset =
+    global(q_block_start) - global(k_block_start) = (my - src)·s_loc, the
+    single number the element-level mask needs.
+
+    Causality hides src > my.  A window additionally hides blocks whose
+    NEWEST key is already >= window behind this block's OLDEST query
+    (offset - (s_loc-1) >= w), and forces masking on the diag block and
+    on any block the window cuts through (offset + s_loc - 1 >= w)."""
+    offset = (my_idx - src) * s_loc
+    if not causal:
+        return jnp.int32(2), offset  # window requires causal (validated)
+    skip = src > my_idx
+    needs_mask = offset == 0
+    if window is not None:
+        skip |= offset - (s_loc - 1) >= window
+        needs_mask |= offset + s_loc - 1 >= window
+    return jnp.where(skip, 0, jnp.where(needs_mask, 1, 2)), offset
+
+
+def _ring_driver(q, k, v, *, axis_name: str, causal: bool, window, merge):
     """The ring schedule, shared by the einsum and pallas impls.
 
-    ``merge(k_t, v_t, m, l, acc, diag)`` folds one visiting K/V block
-    into the online-softmax carry; the driver owns everything else —
-    src computation, hop-visibility dispatch (a causal ring SKIPS
-    invisible hops entirely instead of masking them), the ppermute
-    rotation, carry init, and the final normalization — so the two
-    impls cannot drift apart on schedule or numerics.
+    ``merge(k_t, v_t, m, l, acc, offset=, masked=)`` folds one visiting
+    K/V block into the online-softmax carry (``masked`` is static — the
+    lax.switch branch — ``offset`` traced); the driver owns everything
+    else — src computation, hop-visibility dispatch (invisible hops are
+    SKIPPED entirely, not masked), the ppermute rotation, carry init,
+    and the final normalization — so the two impls cannot drift apart on
+    schedule or numerics.
+
+    Returns (out [b,h,s_loc,d], lse [b,h,s_loc,1] f32) — the logsumexp
+    the blocked backward's recompute-p needs.
     """
     from tpu_autoscaler.workloads._shard_utils import pvary
 
@@ -49,19 +82,14 @@ def _ring_driver(q, k, v, *, axis_name: str, causal: bool, merge):
         m, l, acc, k_t, v_t = carry
         # k_t/v_t originated on device (my_idx - t) mod axis_size.
         src = (my_idx - t) % axis_size
-        if causal:
-            # 0: later block (invisible) — skip the merge entirely;
-            # 1: own block — lower-triangular; 2: earlier — all visible.
-            mode = jnp.where(src > my_idx, 0,
-                             jnp.where(src == my_idx, 1, 2))
-            m, l, acc = jax.lax.switch(
-                mode,
-                [lambda c: c[:3],
-                 lambda c: merge(c[3], c[4], *c[:3], diag=True),
-                 lambda c: merge(c[3], c[4], *c[:3], diag=False)],
-                (m, l, acc, k_t, v_t))
-        else:
-            m, l, acc = merge(k_t, v_t, m, l, acc, diag=False)
+        mode, offset = _hop_mode(src, my_idx, s_loc, causal, window)
+        m, l, acc = jax.lax.switch(
+            mode,
+            [lambda c: c[:3],
+             lambda c: merge(c[3], c[4], *c[:3], offset=c[5], masked=True),
+             lambda c: merge(c[3], c[4], *c[:3], offset=c[5],
+                             masked=False)],
+            (m, l, acc, k_t, v_t, offset))
         # Rotate K/V one hop around the ring (ICI neighbor exchange).
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_next = jax.lax.ppermute(k_t, axis_name, perm)
@@ -76,84 +104,164 @@ def _ring_driver(q, k, v, *, axis_name: str, causal: bool, merge):
     acc0 = pvary(jnp.zeros((b, h, s_loc, d), jnp.float32), axis_name)
     m, l, acc, _, _ = jax.lax.fori_loop(
         0, axis_size, step, (m0, l0, acc0, k, v))
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe).astype(q.dtype)
+    return out, m + jnp.log(l_safe)
 
 
-def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool,
+def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, window,
                      sm_scale: float):
     """Per-device body under shard_map: einsum per-hop merge.
 
-    q, k, v: [b, h, s_local, d] — this device's sequence block.
+    q: [b, h, s_local, d]; k, v: [b, h_kv, s_local, d] (GQA when
+    h_kv < h — the einsum runs grouped so K/V are never repeated).
     """
-    qf = q.astype(jnp.float32) * sm_scale
+    b, h, s_loc, d = q.shape
+    h_kv = k.shape[1]
+    g = h // h_kv
+    qf5 = (q.astype(jnp.float32) * sm_scale).reshape(b, h_kv, g, s_loc, d)
 
-    def merge(k_t, v_t, m, l, acc, diag):
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qf,
-                            k_t.astype(jnp.float32))   # [b,h,sq,sk]
-        if diag:
-            q_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
-            k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    def merge(k_t, v_t, m, l, acc, *, offset, masked):
+        from tpu_autoscaler.workloads.attention import _rel_mask
+
+        kf = k_t.astype(jnp.float32)
+        scores = jnp.einsum("bngqd,bnkd->bngqk", qf5, kf).reshape(
+            b, h, s_loc, -1)                               # [b,h,sq,sk]
+        if masked:
+            scores = _rel_mask(scores, offset, window)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
         correction = jnp.exp(m - m_new)
         l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * correction + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_t.astype(jnp.float32))
+        pv = jnp.einsum("bngqk,bnkd->bngqd",
+                        p.reshape(b, h_kv, g, s_loc, -1),
+                        v_t.astype(jnp.float32)).reshape(b, h, s_loc, d)
+        acc_new = acc * correction + pv
         return m_new, l_new, acc_new
 
     return _ring_driver(q, k, v, axis_name=axis_name, causal=causal,
-                        merge=merge)
+                        window=window, merge=merge)
 
 
 def _ring_attn_local_pallas(q, k, v, *, axis_name: str, causal: bool,
-                            block_q: int, interpret: bool):
+                            window, block_q: int, interpret: bool):
     """Per-device body: the same ring schedule with the per-hop math
     fused into the Pallas ring-step kernel (attention.py::
     ring_flash_step) — the [s_local, s_local] score block of each hop
     lives in VMEM only, never HBM."""
     from tpu_autoscaler.workloads.attention import ring_flash_step
 
-    def merge(k_t, v_t, m, l, acc, diag):
-        return ring_flash_step(q, k_t, v_t, m, l, acc, diag=diag,
+    def merge(k_t, v_t, m, l, acc, *, offset, masked):
+        return ring_flash_step(q, k_t, v_t, m, l, acc, offset=offset,
+                               masked=masked, window=window,
                                block_q=block_q, interpret=interpret)
 
     return _ring_driver(q, k, v, axis_name=axis_name, causal=causal,
-                        merge=merge)
+                        window=window, merge=merge)
+
+
+def _ring_bwd_local_pallas(q, k, v, do, lse, delta, *, axis_name: str,
+                           causal: bool, window, block_q: int,
+                           interpret: bool):
+    """Per-device blocked backward ring: the same hop schedule run once
+    more, with each hop's dq/dk/dv computed by the fused recompute-p
+    kernels (attention.py::ring_flash_bwd_step) from the forward's saved
+    lse — NOT by recomputing the forward.  dq accumulates locally; dk/dv
+    accumulate into buffers that rotate WITH their K/V block, so after
+    axis_size hops each block's gradient arrives back home."""
+    from tpu_autoscaler.workloads._shard_utils import pvary
+    from tpu_autoscaler.workloads.attention import ring_flash_bwd_step
+
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    h_kv = k.shape[1]
+
+    def hop(masked):
+        def run(c):
+            return ring_flash_bwd_step(
+                q, c[0], c[1], do, lse, delta, offset=c[2], masked=masked,
+                window=window, block_q=block_q, interpret=interpret)
+
+        return run
+
+    def skip(c):
+        return (jnp.zeros((b, h, s_loc, d), jnp.float32),
+                jnp.zeros((b, h_kv, s_loc, d), jnp.float32),
+                jnp.zeros((b, h_kv, s_loc, d), jnp.float32))
+
+    def step(t, carry):
+        dq, k_t, v_t, dk_t, dv_t = carry
+        src = (my_idx - t) % axis_size
+        mode, offset = _hop_mode(src, my_idx, s_loc, causal, window)
+        dq_add, dk_add, dv_add = jax.lax.switch(
+            mode, [skip, hop(True), hop(False)], (k_t, v_t, offset))
+        dq += dq_add
+        dk_t += dk_add
+        dv_t += dv_add
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        dk_t = jax.lax.ppermute(dk_t, axis_name, perm)
+        dv_t = jax.lax.ppermute(dv_t, axis_name, perm)
+        return dq, k_t, v_t, dk_t, dv_t
+
+    dq0 = pvary(jnp.zeros((b, h, s_loc, d), jnp.float32), axis_name)
+    dk0 = pvary(jnp.zeros((b, h_kv, s_loc, d), jnp.float32), axis_name)
+    dv0 = pvary(jnp.zeros((b, h_kv, s_loc, d), jnp.float32), axis_name)
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, axis_size, step, (dq0, k, v, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def make_ring_attention(mesh: Mesh, seq_axis: str = "sp",
                         causal: bool = True, impl: str = "einsum",
+                        window: int | None = None,
                         block_q: int = 128,
                         interpret: bool | None = None):
-    """Build a ring-attention callable for [b, h, s, d] arrays whose
-    sequence axis is sharded over ``mesh``'s ``seq_axis``.
+    """Build a ring-attention callable for q [b, h, s, d] / k, v
+    [b, kv_heads, s, d] arrays whose sequence axis is sharded over
+    ``mesh``'s ``seq_axis``.
 
     Returns a function operating on GLOBAL arrays; shard_map handles the
     decomposition and the ppermute schedule rides the mesh axis.
 
+    ``kv_heads`` may divide ``h`` (GQA; MQA at 1) — the rotating K/V
+    payload then also shrinks by the group factor.  ``window=w``
+    (requires causal) is sliding-window attention with out-of-window
+    hops skipped.
+
     ``impl``:
 
     - ``"einsum"`` (default) — XLA-fused per-hop math, differentiable
-      end-to-end through the ring (use for training).
+      end-to-end through the ring (AD transposes the ppermute schedule).
     - ``"pallas"`` — each hop's QK^T→softmax-merge→PV is one fused VMEM
       kernel (attention.py::ring_flash_step), so no per-hop score block
-      round-trips HBM.  The forward is the fused ring; gradients are
-      provided by a custom_vjp that recomputes through the einsum ring
-      (same memory profile as training with ``impl="einsum"``, faster
-      forward — the long-context eval/serving path).
+      round-trips HBM; the backward is a second blocked ring
+      (ring_flash_bwd_step) rebuilding probabilities from the saved
+      logsumexp — the recompute-p flash backward, NOT a forward
+      recompute — so training cost matches the single-device flash
+      kernel's economics.
     """
     if impl not in {"einsum", "pallas"}:
         raise ValueError(f"unknown ring attention impl {impl!r}")
+    from tpu_autoscaler.workloads.attention import _validate_attention_args
+
     spec = P(None, None, seq_axis, None)
 
-    def einsum_attn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    def einsum_body(q, k, v):
         d = q.shape[-1]
-        body = functools.partial(_ring_attn_local, axis_name=seq_axis,
-                                 causal=causal, sm_scale=d ** -0.5)
-        return jax.shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        return _ring_attn_local(q, k, v, axis_name=seq_axis,
+                                causal=causal, window=window,
+                                sm_scale=d ** -0.5)
+
+    def einsum_attn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        _validate_attention_args(q, k, v, causal, window)
+        out, _lse = jax.shard_map(
+            einsum_body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=(spec, spec),
         )(q, k, v)
+        return out
 
     if impl == "einsum":
         return einsum_attn
@@ -164,23 +272,43 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "sp",
     def pallas_forward(q, k, v):
         body = functools.partial(
             _ring_attn_local_pallas, axis_name=seq_axis, causal=causal,
-            block_q=block_q, interpret=run_interpret)
+            window=window, block_q=block_q, interpret=run_interpret)
+        # check_vma=False: pallas_call's out_shape carries no
+        # varying-axis metadata.
         return jax.shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False,
+            body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=(spec, spec), check_vma=False,
         )(q, k, v)
+
+    def pallas_backward(q, k, v, do, lse, delta):
+        body = functools.partial(
+            _ring_bwd_local_pallas, axis_name=seq_axis, causal=causal,
+            window=window, block_q=block_q, interpret=run_interpret)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec,) * 6,
+            out_specs=(spec, spec, spec), check_vma=False,
+        )(q, k, v, do, lse, delta)
 
     @jax.custom_vjp
     def attn(q, k, v):
-        return pallas_forward(q, k, v)
+        return pallas_forward(q, k, v)[0]
 
     def attn_fwd(q, k, v):
-        return pallas_forward(q, k, v), (q, k, v)
+        out, lse = pallas_forward(q, k, v)
+        return out, (q, k, v, out, lse)
 
     def attn_bwd(residuals, g):
-        q, k, v = residuals
-        _, vjp = jax.vjp(einsum_attn, q, k, v)
-        return vjp(g)
+        q, k, v, o, lse = residuals
+        # delta = rowsum(do ∘ o): elementwise, XLA fuses it outside the
+        # kernels (same as the single-device flash backward).
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        return pallas_backward(q, k, v, g, lse, delta)
 
     attn.defvjp(attn_fwd, attn_bwd)
-    return attn
+
+    def checked(q, k, v):
+        _validate_attention_args(q, k, v, causal, window)
+        return attn(q, k, v)
+
+    return checked
