@@ -411,6 +411,48 @@ def _impl_decode(small: bool) -> None:
     if rec.get("mha", {}).get("ok") and rec.get("gqa", {}).get("ok"):
         rec["gqa_speedup"] = round(
             rec["mha"]["decode_seconds"] / rec["gqa"]["decode_seconds"], 3)
+
+    # Fused flash_decode kernel vs the einsum cached-attention path, on
+    # the GQA config, across a batch sweep (decode is bandwidth-bound:
+    # larger batches amortize the per-step weight read, so the kernel's
+    # single-pass cache read should show most at the high end).
+    cfg_gqa = ModelConfig(n_kv_heads=2, **base)
+    params = init_params(jax.random.PRNGKey(0), cfg_gqa)
+    sweep: dict = {}
+    for b2 in dict.fromkeys((batch, 4 * batch)):
+        prompt2 = jax.random.randint(jax.random.PRNGKey(2),
+                                     (b2, prompt_len), 0,
+                                     cfg_gqa.vocab, dtype=jnp.int32)
+        entry: dict = {}
+        for impl in ("einsum", "pallas"):
+            cfg2 = ModelConfig(n_kv_heads=2, attention=impl, **base)
+            pf = jax.jit(lambda p, pr, c=cfg2: prefill(
+                p, pr, c, prompt_len + steps)[0])
+            fn = jax.jit(lambda p, pr, c=cfg2: generate(
+                p, pr, c, steps))
+            _sync(pf(params, prompt2))
+            _sync(fn(params, prompt2))
+            reps = 3
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                _sync(pf(params, prompt2))
+            pf_dt = (time.perf_counter() - t0) / reps
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                _sync(fn(params, prompt2))
+            decode_dt = (time.perf_counter() - t0) / reps - pf_dt
+            ok = decode_dt > 0
+            entry[impl] = {"ok": ok,
+                           "decode_seconds": round(decode_dt, 5)}
+            if ok:
+                entry[impl]["decode_tokens_per_second"] = round(
+                    b2 * steps / decode_dt, 1)
+        if entry["einsum"].get("ok") and entry["pallas"].get("ok"):
+            entry["fused_speedup"] = round(
+                entry["einsum"]["decode_seconds"]
+                / entry["pallas"]["decode_seconds"], 3)
+        sweep[f"batch{b2}"] = entry
+    rec["fused_vs_einsum"] = sweep
     print(json.dumps(rec))
 
 

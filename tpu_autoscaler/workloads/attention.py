@@ -770,6 +770,95 @@ def ring_flash_bwd_step(q, k_t, v_t, do, lse, delta, *, offset,
     return unfold_q(dq_add), unfold_kv(dk_add), unfold_kv(dv_add)
 
 
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, sm_scale: float, window, block_k: int,
+                   n_kb: int):
+    """Single-token cached attention, blocked over the KV cache: one
+    GQA group's queries ([group, d]) stream the cache's k-blocks through
+    VMEM with the online-softmax carry in scratch — probabilities never
+    touch HBM.  Blocks entirely past ``length`` (or behind the window)
+    skip their MXU work via pl.when on the SMEM length."""
+    j = pl.program_id(1)
+    qpos = len_ref[0] - 1    # the new token's absolute position
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    live = j * block_k <= qpos
+    if window is not None:
+        live &= j * block_k + block_k - 1 > qpos - window
+
+    @pl.when(live)
+    def _step():
+        scores = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [g, bk]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        keep = k_pos <= qpos
+        if window is not None:
+            keep &= k_pos > qpos - window
+        scores = jnp.where(keep, scores, NEG_INF)
+        m_scr[...], l_scr[...], acc_scr[...] = _online_softmax_merge(
+            scores, v_ref[0], m_scr[...], l_scr[...], acc_scr[...])
+
+    @pl.when(j == n_kb - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
+
+
+def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
+                 block_k: int = 512, interpret: bool = False):
+    """Fused cached attention for one decode step.
+
+    q: [b, h, 1, d] (the new token's queries, already rotated);
+    k_cache, v_cache: [b, kv_heads, max_len, d] (the new k/v already
+    written at position length-1); length: traced int32 count of filled
+    slots.  Returns [b, h, 1, d].
+
+    Decode is HBM-bandwidth-bound (the cache read IS the cost); this
+    kernel makes that read single-pass — QK^T, masked online softmax,
+    and PV fused per k-block — instead of the einsum path's
+    score-materialize + second cache pass.  GQA groups share each
+    streamed KV block at the index-map level."""
+    b, h, sq, d = q.shape
+    if sq != 1:
+        raise ValueError(f"flash_decode is single-token (sq=1); got {sq}")
+    h_kv, max_len = k_cache.shape[1], k_cache.shape[2]
+    group = h // h_kv
+    block_k = _fit_block(max_len, block_k)
+    n_kb = max_len // block_k
+    sm_scale = d ** -0.5
+    # One grid row per (batch, kv head): its GQA group's queries attend
+    # together so the KV block is fetched once for the whole group.
+    qg = q.reshape(b, h_kv, group, d).reshape(b * h_kv, group, d)
+    fk = k_cache.reshape(b * h_kv, max_len, d)
+    fv = v_cache.reshape(b * h_kv, max_len, d)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale,
+                          window=window, block_k=block_k, n_kb=n_kb),
+        grid=(b * h_kv, n_kb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, group, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, group, d), lambda bh, j: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h_kv, group, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(length, jnp.int32).reshape(1), qg, fk, fv)
+    return out.reshape(b, h, 1, d)
+
+
 def reference_attention(q, k, v, *, causal=True, window=None):
     """Plain einsum attention, the numerics oracle for the kernel.
 

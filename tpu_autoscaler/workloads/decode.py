@@ -92,8 +92,86 @@ def _cached_attention(q, k_cache, v_cache, length, cfg: ModelConfig):
     return out.reshape(b, h, sq, hd)
 
 
+def _attend(q, k, v, k_cache, v_cache, cfg: ModelConfig, offset, s,
+            mesh):
+    """Pick the attention path for one cached block.
+
+    ``attention="pallas"`` (or "auto" on TPU) fuses both phases:
+    decode (s == 1) runs the blocked flash_decode kernel over the cache
+    (single-pass HBM read, probabilities never materialized); prefill
+    (s > 1 at offset 0) runs the training flash kernel directly on the
+    fresh k/v — identical math, since the cache beyond the prompt is
+    invisible.  Multi-device meshes wrap the kernels in shard_map
+    (batch over the data axes, heads over 'model'), exactly like the
+    trainer's model._block.  The einsum path needs no wrapping — GSPMD
+    partitions it from the operand shardings."""
+    impl = cfg.resolved_attention()
+    if impl == "pallas" and (s == 1 or (isinstance(offset, int)
+                                        and offset == 0)):
+        from tpu_autoscaler.workloads.attention import (
+            flash_attention,
+            flash_decode,
+            make_sharded_flash_attention,
+        )
+        from tpu_autoscaler.workloads.model import data_axes
+
+        interpret = jax.default_backend() != "tpu"
+        multi = mesh is not None and mesh.size > 1
+        if multi:
+            # Mirror model._block's fallback: the kernel shard_map needs
+            # the batch to divide over the data axes (mesh_shardable
+            # covers only heads); otherwise serve via the einsum path,
+            # which GSPMD partitions for any batch.
+            import numpy as _np
+
+            daxes = data_axes(mesh)
+            dp = int(_np.prod([mesh.shape[a] for a in daxes])) if daxes \
+                else 1
+            if q.shape[0] % dp:
+                import warnings
+
+                warnings.warn(
+                    f"attention='pallas': batch {q.shape[0]} does not "
+                    f"divide over the {dp} data-parallel devices of mesh "
+                    f"{dict(mesh.shape)}; serving this step with einsum "
+                    f"attention", stacklevel=2)
+                return _cached_attention(q, k_cache, v_cache, offset + s,
+                                         cfg)
+        if s == 1:
+            if multi:
+                from jax.sharding import PartitionSpec as P
+
+                dspec = P(data_axes(mesh),
+                          "model" if "model" in mesh.axis_names else None,
+                          None, None)
+
+                def body(q, kc, vc, ln):
+                    return flash_decode(q, kc, vc, ln,
+                                        window=cfg.attention_window,
+                                        interpret=interpret)
+
+                return jax.shard_map(
+                    body, mesh=mesh,
+                    in_specs=(dspec, dspec, dspec, P()),
+                    out_specs=dspec, check_vma=False,
+                )(q, k_cache, v_cache, offset + s)
+            return flash_decode(q, k_cache, v_cache, offset + s,
+                                window=cfg.attention_window,
+                                interpret=interpret)
+        if multi:
+            attn = make_sharded_flash_attention(
+                mesh, causal=True, window=cfg.attention_window,
+                batch_axis=data_axes(mesh),
+                head_axis="model" if "model" in mesh.axis_names else None)
+            return attn(q, k, v)
+        return flash_attention(q, k, v, causal=True,
+                               window=cfg.attention_window,
+                               interpret=interpret)
+    return _cached_attention(q, k_cache, v_cache, offset + s, cfg)
+
+
 def _block_with_cache(x, layer, k_cache, v_cache, cfg: ModelConfig,
-                      offset):
+                      offset, mesh=None):
     """One transformer block over [b, s, d], reading/writing the cache.
 
     Mirrors model._block's math exactly (rmsnorm -> qkv -> rope ->
@@ -109,7 +187,7 @@ def _block_with_cache(x, layer, k_cache, v_cache, cfg: ModelConfig,
         k = _rope(k, cfg.rope_theta, offset)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, offset, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, offset, 0))
-    attn = _cached_attention(q, k_cache, v_cache, offset + s, cfg)
+    attn = _attend(q, k, v, k_cache, v_cache, cfg, offset, s, mesh)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
     x = x + jnp.einsum("bsd,de->bse", attn,
                        layer["attn_out"].astype(cfg.dtype))
@@ -128,13 +206,15 @@ def _block_with_cache(x, layer, k_cache, v_cache, cfg: ModelConfig,
     return x, k_cache, v_cache
 
 
-def _run_blocks(params, x, cache: KVCache, cfg: ModelConfig, offset):
+def _run_blocks(params, x, cache: KVCache, cfg: ModelConfig, offset,
+                mesh=None):
     """lax.scan over stacked layer params, threading the cache."""
 
     def body(carry, inputs):
         x = carry
         layer, k_c, v_c = inputs
-        x, k_c, v_c = _block_with_cache(x, layer, k_c, v_c, cfg, offset)
+        x, k_c, v_c = _block_with_cache(x, layer, k_c, v_c, cfg, offset,
+                                        mesh)
         return x, (k_c, v_c)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -147,22 +227,72 @@ def _run_blocks(params, x, cache: KVCache, cfg: ModelConfig, offset):
                                                length=new_len)
 
 
+def cache_specs(mesh) -> KVCache:
+    """PartitionSpecs for a KVCache under a (data, model) mesh: batch
+    over the data axes, KV heads over 'model' — the serving layout the
+    trainer's param_specs implies (qkv heads already split over
+    'model'), so an 8-way TP slice holds 1/8 of the decode-bandwidth-
+    critical cache.  Requires kv_heads % tp == 0 (cfg.mesh_shardable)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_autoscaler.workloads.model import data_axes
+
+    kv = P(None, data_axes(mesh), "model", None, None)
+    return KVCache(k=kv, v=kv, length=P())
+
+
+def _constrain_cache(cache: KVCache, mesh) -> KVCache:
+    """Pin the cache's layout under GSPMD so the einsum path keeps it
+    TP-sharded instead of letting the partitioner replicate it.
+
+    Degrades per-dimension: a batch that doesn't divide the data axes
+    (or KV heads that don't divide tp) stays unsharded on that dim —
+    a sharding constraint demands exact divisibility, and serving an
+    uneven batch must degrade, not crash (model._block's fallback
+    philosophy)."""
+    if mesh is None or mesh.size == 1:
+        return cache
+    import numpy as _np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_autoscaler.workloads.model import data_axes
+
+    daxes = data_axes(mesh)
+    dp = int(_np.prod([mesh.shape[a] for a in daxes])) if daxes else 1
+    tp = mesh.shape.get("model", 1)
+    b, hkv = cache.k.shape[1], cache.k.shape[2]
+    spec = P(None,
+             daxes if dp > 1 and b % dp == 0 else None,
+             "model" if tp > 1 and hkv % tp == 0 else None,
+             None, None)
+    shard = NamedSharding(mesh, spec)
+    return KVCache(
+        k=jax.lax.with_sharding_constraint(cache.k, shard),
+        v=jax.lax.with_sharding_constraint(cache.v, shard),
+        length=cache.length)
+
+
 def prefill(params: dict, tokens: jax.Array, cfg: ModelConfig,
-            max_len: int) -> tuple[jax.Array, KVCache]:
+            max_len: int, mesh=None) -> tuple[jax.Array, KVCache]:
     """Run the prompt [b, s] through the model, filling a fresh cache.
 
     Returns (logits [b, s, vocab] fp32, cache with length == s).  The
-    last position's logits seed generation."""
+    last position's logits seed generation.  ``mesh``: serve under the
+    trainer's (data, model) mesh — the cache shards per cache_specs and
+    the pallas kernels run via shard_map."""
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds max_len {max_len}")
-    cache = KVCache.zeros(cfg, b, max_len)
+    if mesh is not None:
+        cfg = cfg.resolved_for_mesh(mesh)
+    cache = _constrain_cache(KVCache.zeros(cfg, b, max_len), mesh)
     x = params["embed"].astype(cfg.dtype)[tokens]
-    return _run_blocks(params, x, cache, cfg, 0)
+    logits, cache = _run_blocks(params, x, cache, cfg, 0, mesh)
+    return logits, _constrain_cache(cache, mesh)
 
 
 def decode_step(params: dict, cache: KVCache, tokens: jax.Array,
-                cfg: ModelConfig) -> tuple[jax.Array, KVCache]:
+                cfg: ModelConfig, mesh=None) -> tuple[jax.Array, KVCache]:
     """One token per sequence: tokens [b] int32 at position cache.length.
 
     Returns (logits [b, vocab] fp32, cache advanced by one).  Fully
@@ -177,9 +307,11 @@ def decode_step(params: dict, cache: KVCache, tokens: jax.Array,
         raise ValueError(
             f"KV cache full: length {int(cache.length)} >= max_len "
             f"{cache.max_len}")
+    if mesh is not None:
+        cfg = cfg.resolved_for_mesh(mesh)
     x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]
-    logits, cache = _run_blocks(params, x, cache, cfg, cache.length)
-    return logits[:, 0], cache
+    logits, cache = _run_blocks(params, x, cache, cfg, cache.length, mesh)
+    return logits[:, 0], _constrain_cache(cache, mesh)
 
 
 def _sample(logits: jax.Array, key, temperature: float,
@@ -217,11 +349,12 @@ def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
              steps: int, *, key: jax.Array | None = None,
              temperature: float = 0.0, top_k: int | None = None,
              top_p: float | None = None,
-             max_len: int | None = None) -> jax.Array:
+             max_len: int | None = None, mesh=None) -> jax.Array:
     """Prefill the prompt [b, s], then decode ``steps`` tokens under one
     lax.scan.  Returns [b, s + steps] (prompt + generated).  Greedy by
     default; pass key + temperature (and optionally top_k / top_p) to
-    sample."""
+    sample.  ``mesh``: serve under the trainer's mesh (see
+    make_sharded_generate for the jitted end-to-end wrapper)."""
     b, s = prompt.shape
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
@@ -244,14 +377,16 @@ def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
         raise ValueError(f"top_k must be in [1, {vocab}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    logits, cache = prefill(params, prompt, cfg, max_len)
+    if mesh is not None:
+        cfg = cfg.resolved_for_mesh(mesh)
+    logits, cache = prefill(params, prompt, cfg, max_len, mesh)
     key = key if key is not None else jax.random.PRNGKey(0)
     all_keys = jax.random.split(key, steps)
     first = _sample(logits[:, -1], all_keys[0], temperature, top_k, top_p)
 
     def body(carry, step_key):
         cache, token = carry
-        logits, cache = decode_step(params, cache, token, cfg)
+        logits, cache = decode_step(params, cache, token, cfg, mesh)
         nxt = _sample(logits, step_key, temperature, top_k, top_p)
         return (cache, nxt), nxt
 
@@ -261,3 +396,39 @@ def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
     (_, _), rest = jax.lax.scan(body, (cache, first), all_keys[1:])
     out = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt, out.astype(prompt.dtype)], axis=1)
+
+
+def make_sharded_generate(mesh, cfg: ModelConfig, steps: int, *,
+                          temperature: float = 0.0,
+                          top_k: int | None = None,
+                          top_p: float | None = None,
+                          max_len: int | None = None):
+    """Build ``run(params, prompt, key) -> tokens`` jitted under the
+    trainer's (data, model) mesh: the checkpoint serves with the SAME
+    TP layout it trained with (model.param_specs — no resharding on the
+    train->serve handoff), prompts/outputs shard over the data axes,
+    and the KV cache shards over KV heads on 'model' (cache_specs) so
+    each TP shard streams only its slice of the decode-bandwidth-
+    critical cache.  The pallas decode/prefill kernels run per-shard
+    via shard_map; the einsum path is GSPMD-partitioned from the same
+    shardings."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_autoscaler.workloads.model import (
+        batch_spec,
+        param_specs,
+    )
+
+    cfg = cfg.resolved_for_mesh(mesh)
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    tok_shard = NamedSharding(mesh, batch_spec(mesh))
+
+    def run(params, prompt, key):
+        return generate(params, prompt, cfg, steps, key=key,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, max_len=max_len, mesh=mesh)
+
+    return jax.jit(run, in_shardings=(p_shard, tok_shard, None),
+                   out_shardings=tok_shard)
