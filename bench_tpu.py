@@ -211,6 +211,92 @@ def _impl_step(small: bool) -> None:
     }))
 
 
+def _impl_step_large(small: bool) -> None:
+    """Training-step MFU at representative scale (VERDICT r2 item 1):
+    a ~0.67B-param config — d_model 1536 (12 heads x head_dim 128, the
+    MXU-native lane width), 20 layers, seq 2048 — with remat + chunked
+    CE so optimizer state + activations fit single-chip HBM, measured
+    over a small flash-attention tile sweep (the 512/1024 default was
+    never tuned for head_dim 128 at this length)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_autoscaler.workloads.model import (
+        ModelConfig,
+        make_mesh,
+        make_sharded_train_step,
+    )
+
+    if small:
+        base = dict(seq_len=64, d_model=64, n_layers=2, n_heads=2,
+                    d_ff=128, remat=True, ce_chunk=32)
+        batch_size, iters = 2, 2
+        tiles = [(512, 1024), (64, 64)]
+    else:
+        base = dict(vocab=32768, d_model=1536, n_layers=20, n_heads=12,
+                    d_ff=6144, seq_len=2048, remat=True, ce_chunk=256)
+        batch_size, iters = 8, 6
+        tiles = [(512, 1024), (512, 2048), (1024, 1024)]
+
+    dev = jax.devices()[0]
+    mesh = make_mesh([dev])
+    batch = None
+    best: dict | None = None
+    sweep: dict = {}
+    n_params = None
+    for bq, bk in tiles:
+        cfg = ModelConfig(attn_block_q=bq, attn_block_k=bk, **base)
+        init_fn, step_fn = make_sharded_train_step(mesh, cfg)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        if n_params is None:
+            n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+        if batch is None:
+            batch = jax.random.randint(
+                jax.random.PRNGKey(1), (batch_size, cfg.seq_len + 1), 0,
+                cfg.vocab, dtype=jnp.int32)
+        for _ in range(2):
+            params, opt_state, loss = step_fn(params, opt_state, batch)
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step_fn(params, opt_state, batch)
+        float(jax.device_get(loss))
+        step_s = (time.perf_counter() - t0) / iters
+        sweep[f"bq{bq}_bk{bk}"] = round(step_s, 5)
+        if best is None or step_s < best["step_seconds"]:
+            best = {"attn_block_q": bq, "attn_block_k": bk,
+                    "step_seconds": step_s, "loss": float(loss)}
+        del params, opt_state
+
+    cfg = ModelConfig(**base)
+    tokens = batch_size * cfg.seq_len
+    # 6ND matmul flops (fwd+bwd) + attention score/context flops; remat
+    # recomputes the block forward, but MFU conventionally counts the
+    # model's algorithmic flops, not the recompute (hardware does more
+    # work than the numerator — the honest direction).
+    flops = (6.0 * n_params * tokens
+             + 12.0 * cfg.n_layers * batch_size
+             * cfg.seq_len ** 2 * cfg.d_model)
+    peak = _peak_flops(dev.device_kind)
+    step_s = best["step_seconds"]
+    mfu = flops / (step_s * peak) if peak else None
+    print(json.dumps({
+        "device_kind": dev.device_kind,
+        "attention": cfg.resolved_for_mesh(mesh).resolved_attention(),
+        "batch_size": batch_size,
+        "n_params": n_params,
+        "remat": True,
+        "tile_sweep_step_seconds": sweep,
+        "attn_block_q": best["attn_block_q"],
+        "attn_block_k": best["attn_block_k"],
+        "step_seconds": round(step_s, 5),
+        "tokens_per_second": round(tokens / step_s, 1),
+        "flops_per_step": flops,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "loss": best["loss"],
+    }))
+
+
 def _impl_attn(small: bool) -> None:
     import jax
     import jax.numpy as jnp
@@ -456,6 +542,125 @@ def _impl_decode(small: bool) -> None:
     print(json.dumps(rec))
 
 
+def _impl_converge(small: bool) -> None:
+    """Real-training evidence (VERDICT r2 item 2): drive the trainer CLI
+    on a STRUCTURED token shard (noisy linear-congruential bigram — a
+    learnable next-token rule, unlike uniform synthetic data), SIGKILL
+    it mid-run, re-launch the identical command, and verify (a) it
+    resumes from the checkpoint, (b) the data stream replays exactly
+    (pure function of seed/step — dataio.row_offset), and (c) the loss
+    curve over the full run decreases toward the rule's entropy floor.
+
+    No jax in this phase: the trainer subprocesses own the device; this
+    orchestrator watches their logs."""
+    import re
+    import signal
+    import tempfile
+
+    import numpy as np
+
+    from tpu_autoscaler.dataio import write_token_file
+
+    if small:
+        steps, kill_at, ckpt_every = 60, 30, 10
+        arch = ["--d-model", "64", "--n-layers", "2", "--seq-len", "32",
+                "--batch", "4", "--vocab", "256"]
+        vocab, n_tokens = 256, 200_000
+    else:
+        steps, kill_at, ckpt_every = 1000, 500, 100
+        arch = ["--d-model", "512", "--n-layers", "6", "--seq-len", "256",
+                "--batch", "16", "--vocab", "4096"]
+        vocab, n_tokens = 4096, 2_000_000
+
+    workdir = tempfile.mkdtemp(prefix="bench-converge-")
+    shard = os.path.join(workdir, "shard.bin")
+    rng = np.random.default_rng(7)
+    # 90% deterministic bigram (t -> (a*t + c) mod V), 10% uniform noise:
+    # cross-entropy floor ~= 0.1*ln(V) + H(0.9) ~ well below ln(V), so a
+    # learning trainer separates cleanly from a broken one.
+    toks = np.empty(n_tokens, np.uint32)
+    toks[0] = 1
+    a, c = 31, 17
+    noise = rng.random(n_tokens) < 0.1
+    rand = rng.integers(0, vocab, n_tokens, dtype=np.uint32)
+    for i in range(1, n_tokens):
+        toks[i] = rand[i] if noise[i] else (a * int(toks[i - 1]) + c) % vocab
+    write_token_file(shard, toks)
+
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    cmd = [sys.executable, "-m", "tpu_autoscaler.workloads.train",
+           "--steps", str(steps), *arch,
+           "--data-file", shard, "--checkpoint-dir", ckpt_dir,
+           "--checkpoint-every", str(ckpt_every),
+           "--lr", "3e-3", "--warmup-steps", str(max(steps // 20, 2)),
+           "--lr-schedule", "cosine", "--grad-clip", "1.0",
+           "--annotations-file", os.path.join(workdir, "nonexistent")]
+
+    step_re = re.compile(r"step (\d+) loss ([0-9.naif]+)")
+    resume_re = re.compile(r"resumed from checkpoint step (\d+)")
+
+    def run(kill_at_step=None):
+        """Run the trainer, returning (losses {step: loss}, resumed_at,
+        killed_bool)."""
+        proc = subprocess.Popen(cmd, cwd=REPO, text=True,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE)
+        losses, resumed = {}, None
+        try:
+            for line in proc.stderr:
+                m = resume_re.search(line)
+                if m:
+                    resumed = int(m.group(1))
+                m = step_re.search(line)
+                if m:
+                    losses[int(m.group(1))] = float(m.group(2))
+                    if kill_at_step and int(m.group(1)) >= kill_at_step:
+                        proc.send_signal(signal.SIGKILL)
+                        proc.wait()
+                        return losses, resumed, True
+            proc.wait()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        return losses, resumed, False
+
+    losses1, _, killed = run(kill_at_step=kill_at)
+    losses2, resumed_at, _ = run()
+
+    # The two runs' logs compose into one curve across the kill: run 1
+    # covers the start, run 2 (post-resume) the rest.
+    import math
+
+    curve = {**losses1, **losses2}
+    steps_sorted = sorted(curve)
+    first = curve[steps_sorted[0]] if steps_sorted else float("nan")
+    last = curve[steps_sorted[-1]] if steps_sorted else float("nan")
+    ln_v = math.log(vocab)
+    post = sorted(losses2)
+    rec = {
+        "steps": steps,
+        "killed_mid_run": killed,
+        "kill_after_step": kill_at,
+        "resumed_from_step": resumed_at,
+        "loss_first": first,
+        "loss_last": last,
+        "loss_uniform_floor": round(ln_v, 4),
+        "curve": {str(s): curve[s]
+                  for s in steps_sorted[:: max(1, len(steps_sorted)
+                                               // 12)]},
+        # Learned: the end of the curve sits well under the uniform
+        # entropy AND under where it started.
+        "decreasing": bool(steps_sorted and last < first - 0.5
+                           and last < ln_v - 0.5),
+        # The relaunched run continued the curve (first post-resume
+        # loss far below a from-scratch start), not restarted.
+        "resume_continued_curve": bool(
+            resumed_at is not None and post
+            and losses2[post[0]] < ln_v - 0.2),
+    }
+    print(json.dumps(rec))
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--cpu-smoke", action="store_true",
@@ -464,7 +669,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--measure-timeout", type=float, default=900.0)
     ap.add_argument("--out", default=DEFAULT_OUT)
     ap.add_argument("--impl",
-                    choices=["probe", "step", "attn", "longctx", "decode"],
+                    choices=["probe", "step", "step_large", "attn",
+                             "longctx", "decode", "converge"],
                     help=argparse.SUPPRESS)  # internal subprocess entry
     ap.add_argument("--small", action="store_true",
                     help=argparse.SUPPRESS)
@@ -473,9 +679,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.impl:
         {"probe": _impl_probe,
          "step": lambda: _impl_step(args.small),
+         "step_large": lambda: _impl_step_large(args.small),
          "attn": lambda: _impl_attn(args.small),
          "longctx": lambda: _impl_longctx(args.small),
-         "decode": lambda: _impl_decode(args.small)}[args.impl]()
+         "decode": lambda: _impl_decode(args.small),
+         "converge": lambda: _impl_converge(args.small)}[args.impl]()
         return 0
 
     env = _cpu_env() if args.cpu_smoke else _tpu_env()
@@ -493,15 +701,21 @@ def main(argv: list[str] | None = None) -> int:
         extra = ["--small"] if small else []
         record["train_step"] = _run_bounded(
             [me, "--impl", "step"] + extra, env, args.measure_timeout)
+        record["train_step_large"] = _run_bounded(
+            [me, "--impl", "step_large"] + extra, env,
+            args.measure_timeout)
         record["attention"] = _run_bounded(
             [me, "--impl", "attn"] + extra, env, args.measure_timeout)
         record["long_context"] = _run_bounded(
             [me, "--impl", "longctx"] + extra, env, args.measure_timeout)
         record["decode"] = _run_bounded(
             [me, "--impl", "decode"] + extra, env, args.measure_timeout)
+        record["convergence"] = _run_bounded(
+            [me, "--impl", "converge"] + extra, env, args.measure_timeout)
     else:
         reason = record["probe"].get("skipped", "probe failed")
-        for phase in ("train_step", "attention", "long_context", "decode"):
+        for phase in ("train_step", "train_step_large", "attention",
+                      "long_context", "decode", "convergence"):
             record[phase] = {"ok": False,
                              "skipped": f"backend probe: {reason}"}
 

@@ -496,6 +496,7 @@ class TestShardedFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_differentiable_sharded(self):
         from jax.sharding import Mesh
 

@@ -1,6 +1,7 @@
 """CLI-level tests via click's CliRunner (reference parity: main.py flag
 surface, SURVEY.md §3.1)."""
 
+import pytest
 from click.testing import CliRunner
 
 from tpu_autoscaler.main import cli
@@ -215,6 +216,7 @@ class TestNamespaceQuotaFlag:
 
 
 class TestChurnScenario:
+    @pytest.mark.slow
     def test_churn_serves_jobs_and_summarizes(self):
         result = CliRunner().invoke(cli, [
             "demo", "--scenario", "churn", "--provision-delay", "60",

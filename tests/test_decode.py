@@ -58,6 +58,7 @@ def _assert_decode_matches_forward(cfg, steps=5):
 
 
 class TestCacheParity:
+    @pytest.mark.slow
     def test_gqa_cache_matches_teacher_forcing(self):
         _assert_decode_matches_forward(CFG)
 
@@ -67,6 +68,7 @@ class TestCacheParity:
         _assert_decode_matches_forward(
             dc.replace(CFG, n_kv_heads=None, rope=False))
 
+    @pytest.mark.slow
     def test_sliding_window_visibility(self):
         import dataclasses as dc
 
@@ -189,6 +191,7 @@ class TestFusedDecode:
     """The pallas serving path (flash_decode for decode steps, the
     training flash kernel for prefill) against the einsum oracle."""
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("window", [None, 3])
     def test_pallas_decode_matches_forward(self, window):
         import dataclasses as dc
@@ -282,6 +285,7 @@ class TestShardedServing:
         want = generate(params, prompt, CFG, steps=4)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    @pytest.mark.slow
     def test_sharded_sampled_generate(self):
         from tpu_autoscaler.workloads.decode import make_sharded_generate
 

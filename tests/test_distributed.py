@@ -65,6 +65,7 @@ class TestMultisliceMesh:
         mesh = make_multislice_mesh(num_slices=2, model=2)
         assert batch_spec(mesh) == P(("dcn", "data"), None)
 
+    @pytest.mark.slow
     def test_train_step_on_multislice_mesh(self):
         mesh = make_multislice_mesh(num_slices=2, model=2)
         cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2,
@@ -111,6 +112,7 @@ class TestZero1:
         assert mu_specs["embed"] == P("data", "model")
         assert adam.count.sharding.spec == P()
 
+    @pytest.mark.slow
     def test_zero1_step_parity_with_replicated_moments(self):
         from tpu_autoscaler.workloads.model import (
             make_mesh,
@@ -196,6 +198,7 @@ class TestFsdp:
         # dp=4: the big matrices shrink 4x; ln gains stay whole.
         assert sizes["fsdp"] < sizes["none"] / 2
 
+    @pytest.mark.slow
     def test_fsdp_step_parity_with_replicated(self):
         from tpu_autoscaler.workloads.model import (
             make_mesh,
@@ -256,6 +259,7 @@ class TestShardedPallasAttention:
             out[impl] = step_fn(params, opt, tokens)
         return out
 
+    @pytest.mark.slow
     def test_dp_tp_mesh_step_matches_einsum(self):
         from tpu_autoscaler.workloads.model import make_mesh
 
@@ -274,6 +278,7 @@ class TestShardedPallasAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-3, atol=5e-3)
 
+    @pytest.mark.slow
     def test_multislice_mesh_with_gqa_and_window(self):
         # Tuple batch axes (dcn, data) + GQA + sliding window, all
         # through the shard_map kernel path on the 3-D mesh.

@@ -20,6 +20,7 @@ def ep_mesh(n):
 
 
 class TestMoe:
+    @pytest.mark.slow
     @pytest.mark.parametrize("ep", [2, 4, 8])
     def test_matches_reference_without_drops(self, ep):
         # Capacity generous enough that nothing drops: sharded == oracle.
@@ -143,6 +144,7 @@ class TestRouteTopk:
 
 
 class TestTopKMoeLayer:
+    @pytest.mark.slow
     def test_top2_matches_reference_without_drops(self):
         cfg = MoeConfig(num_experts=8, capacity_factor=float(8), top_k=2)
         params = init_moe_params(jax.random.PRNGKey(0), cfg)
@@ -152,6 +154,7 @@ class TestTopKMoeLayer:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_bf16_in_bf16_out(self):
         # The fp32 gate must not promote the residual stream.
         cfg = MoeConfig(num_experts=8, capacity_factor=float(8), top_k=2)
@@ -163,6 +166,7 @@ class TestTopKMoeLayer:
             == jnp.bfloat16
         assert moe_reference(p16, x, top_k=2).dtype == jnp.bfloat16
 
+    @pytest.mark.slow
     def test_with_aux_returns_mesh_metrics(self):
         cfg = MoeConfig(num_experts=8, top_k=2)
         params = init_moe_params(jax.random.PRNGKey(0), cfg)

@@ -42,6 +42,7 @@ class TestPipelineLoss:
         got = float(loss(params, tokens))
         assert got == pytest.approx(ref, rel=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_match(self):
         params = init_params(jax.random.PRNGKey(0), CFG)
         tokens = tokens_for(batch=4)
@@ -58,6 +59,7 @@ class TestPipelineLoss:
         with pytest.raises(ValueError, match="not divisible"):
             make_pipeline_loss(pp_mesh(8), CFG, num_microbatches=2)
 
+    @pytest.mark.slow
     def test_jitted_and_trains(self):
         import optax
 
@@ -83,6 +85,7 @@ class TestPipelineLoss:
 class TestPipelineTrainStep:
     """GPipe training: grads + optimizer under the pp mesh."""
 
+    @pytest.mark.slow
     def test_step_parity_with_unpipelined_step(self):
         from tpu_autoscaler.workloads.model import (
             make_mesh,
@@ -122,6 +125,7 @@ class TestPipelineTrainStep:
         mu_qkv = opt[0].mu["blocks"]["qkv"]
         assert mu_qkv.sharding.shard_shape(mu_qkv.shape)[0] == 1
 
+    @pytest.mark.slow
     def test_remat_step_matches_unremat(self):
         tokens = tokens_for(batch=8)
         losses = {}
@@ -134,6 +138,7 @@ class TestPipelineTrainStep:
             losses[remat] = float(loss)
         assert losses[False] == pytest.approx(losses[True], rel=1e-5)
 
+    @pytest.mark.slow
     def test_train_recipe_applies(self):
         from tpu_autoscaler.workloads.model import TrainConfig
 
@@ -150,6 +155,7 @@ class TestPipelineTrainStep:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0] - 0.2
 
+    @pytest.mark.slow
     def test_moe_trains_through_pipeline(self):
         import dataclasses as dc
 
@@ -165,6 +171,7 @@ class TestPipelineTrainStep:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0] - 0.1
 
+    @pytest.mark.slow
     def test_moe_pipeline_loss_matches_unpipelined(self):
         import dataclasses as dc
 
@@ -182,6 +189,7 @@ class TestPipelineTrainStep:
 
 
 class TestPipelineComposition:
+    @pytest.mark.slow
     def test_pipeline_with_remat_matches(self):
         import dataclasses as dc
 

@@ -49,6 +49,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_pallas_ring_grads_match_einsum_ring(self):
         mesh = sp_mesh()
         q, k, v = rand_qkv(4, s=64)
@@ -94,6 +95,7 @@ class TestRingAttention:
                                    np.asarray(out2[:, :, :-16]),
                                    rtol=1e-6, atol=1e-6)
 
+    @pytest.mark.slow
     def test_differentiable(self):
         mesh = sp_mesh()
         q, k, v = rand_qkv(3, s=64)

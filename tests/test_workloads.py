@@ -88,6 +88,7 @@ class TestShardedTrainStep:
         # Memorizing one small batch: loss must drop substantially.
         assert losses[-1] < losses[0] - 0.3
 
+    @pytest.mark.slow
     def test_tp1_mesh_also_works(self):
         mesh = make_mesh(jax.devices()[:5], tp=1)  # odd count -> pure DP
         assert mesh.shape == {"data": 5, "model": 1}
@@ -186,6 +187,7 @@ class TestTrainConfig:
         # Update 2 (trainer step 4): sched(4) = peak (warmup over).
         np.testing.assert_allclose(deltas[3], peak, rtol=0.05)
 
+    @pytest.mark.slow
     def test_sharded_step_with_full_recipe_learns(self):
         from tpu_autoscaler.workloads.model import TrainConfig
 
@@ -234,6 +236,7 @@ class TestMoeModel:
                     + cfg.moe_z_weight * float(metrics["z_loss"]))
         np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_sharded_moe_step_learns_and_stays_balanced(self):
         from tpu_autoscaler.workloads.model import loss_and_metrics
 
@@ -277,6 +280,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.ndim == 3
 
+    @pytest.mark.slow
     def test_dryrun_multichip(self, capsys, monkeypatch):
         import __graft_entry__ as g
 
@@ -418,6 +422,7 @@ class TestLatestStepRobustness:
 
 
 class TestRemat:
+    @pytest.mark.slow
     def test_remat_matches_plain_gradients(self):
         import dataclasses as dc
 

@@ -30,8 +30,10 @@ from tpu_autoscaler.workloads.decode import (
     KVCache,
     decode_step,
     generate,
+    make_sharded_generate,
     prefill,
 )
+from tpu_autoscaler.workloads.pipeline import make_pipeline_train_step
 from tpu_autoscaler.workloads.checkpoint import (
     DrainWatcher,
     restore_checkpoint,
@@ -50,6 +52,8 @@ __all__ = [
     "loss_fn",
     "make_mesh",
     "make_optimizer",
+    "make_pipeline_train_step",
+    "make_sharded_generate",
     "make_sharded_train_step",
     "prefill",
     "restore_checkpoint",

@@ -10,6 +10,9 @@ from __future__ import annotations
 import click
 
 _MODEL_ARCH_OPTIONS = [
+    click.option("--vocab", default=256, show_default=True,
+                 help="Vocabulary size (must match the tokenizer of any "
+                      "--data-file shard)."),
     click.option("--seq-len", default=64, show_default=True),
     click.option("--d-model", default=128, show_default=True),
     click.option("--n-layers", default=2, show_default=True),
@@ -31,13 +34,13 @@ def model_arch_options(f):
     return f
 
 
-def model_config(seq_len, d_model, n_layers, n_kv_heads,
+def model_config(vocab, seq_len, d_model, n_layers, n_kv_heads,
                  attention_window, no_rope, **extra):
     """Build the ModelConfig these flags describe (extra kwargs pass
     through to training-only fields like remat/ce_chunk)."""
     from tpu_autoscaler.workloads.model import ModelConfig
 
-    return ModelConfig(seq_len=seq_len, d_model=d_model,
+    return ModelConfig(vocab=vocab, seq_len=seq_len, d_model=d_model,
                        n_layers=n_layers, n_kv_heads=n_kv_heads,
                        attention_window=attention_window,
                        rope=not no_rope, **extra)

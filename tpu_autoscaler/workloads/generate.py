@@ -45,7 +45,7 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
-         top_k, top_p, seed, seq_len, d_model, n_layers, n_kv_heads,
+         top_k, top_p, seed, vocab, seq_len, d_model, n_layers, n_kv_heads,
          attention_window, no_rope, platform):
     """Generate tokens from the latest checkpoint in --checkpoint-dir."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -63,7 +63,7 @@ def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
     from tpu_autoscaler.workloads.decode import generate
     from tpu_autoscaler.workloads.model import init_params
 
-    cfg = model_config(seq_len, d_model, n_layers, n_kv_heads,
+    cfg = model_config(vocab, seq_len, d_model, n_layers, n_kv_heads,
                        attention_window, no_rope)
     if top_k is not None and top_k > cfg.vocab:
         raise click.UsageError(

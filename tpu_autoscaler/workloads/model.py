@@ -57,6 +57,12 @@ class ModelConfig:
     # mesh _block routes it through shard_map (batch x heads); see
     # mesh_shardable for when that is legal.
     attention: str = "auto"
+    # Pallas flash-attention tile sizes (ignored on the einsum path).
+    # The defaults are sane for v5e at seq 1-2k / head_dim 64-128;
+    # bench_tpu.py's attention phase sweeps candidates per shape so a
+    # profile-driven run can pin better ones for its geometry.
+    attn_block_q: int = 512
+    attn_block_k: int = 1024
     # Rotary position embeddings (llama-standard).  Elementwise sin/cos
     # rotations of q/k fuse into the surrounding ops on TPU; applied
     # outside the attention kernel so flash/einsum paths share them.
@@ -368,6 +374,7 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
             else:
                 attn = make_sharded_flash_attention(
                     mesh, causal=True, window=cfg.attention_window,
+                    block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
                     batch_axis=batch_axes,
                     head_axis=("model" if "model" in mesh.axis_names
                                else None),
@@ -375,6 +382,7 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
         else:
             attn = flash_attention(
                 q, k, v, causal=True, window=cfg.attention_window,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
                 interpret=jax.default_backend() != "tpu")
     else:
         attn = einsum_attn()

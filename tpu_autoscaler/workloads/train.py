@@ -76,7 +76,7 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
                    "/etc/podinfo/annotations).")
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu for local smoke runs).")
-def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
+def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
          attention_window, no_rope, remat, ce_chunk, zero1, shard_mode,
          lr, warmup_steps, lr_schedule, min_lr_ratio, grad_clip,
          accum_steps, weight_decay, pp_stages, pp_microbatches, data_file,
@@ -116,7 +116,7 @@ def main(steps, batch, seq_len, d_model, n_layers, n_kv_heads,
              topo.process_id, topo.num_processes, topo.slice_id,
              topo.num_slices, len(jax.devices()))
 
-    cfg = model_config(seq_len, d_model, n_layers, n_kv_heads,
+    cfg = model_config(vocab, seq_len, d_model, n_layers, n_kv_heads,
                        attention_window, no_rope, remat=remat,
                        ce_chunk=ce_chunk)
     # Multi-slice jobs get the (dcn, data, model) mesh: DP crosses slices
