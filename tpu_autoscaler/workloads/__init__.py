@@ -34,6 +34,7 @@ from tpu_autoscaler.workloads.decode import (
     prefill,
 )
 from tpu_autoscaler.workloads.pipeline import make_pipeline_train_step
+from tpu_autoscaler.workloads.sp import make_sp_mesh, make_sp_train_step
 from tpu_autoscaler.workloads.checkpoint import (
     DrainWatcher,
     restore_checkpoint,
@@ -54,6 +55,8 @@ __all__ = [
     "make_optimizer",
     "make_pipeline_train_step",
     "make_sharded_generate",
+    "make_sp_mesh",
+    "make_sp_train_step",
     "make_sharded_train_step",
     "prefill",
     "restore_checkpoint",

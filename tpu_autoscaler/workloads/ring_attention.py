@@ -27,8 +27,6 @@ with data/model axes by adding them to the in/out specs.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -214,6 +212,42 @@ def _ring_bwd_local_pallas(q, k, v, do, lse, delta, *, axis_name: str,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def make_local_ring_attention(*, axis_name: str, causal: bool = True,
+                              window: int | None = None,
+                              block_q: int = 128,
+                              interpret: bool = False):
+    """Per-device pallas ring attention for use INSIDE a caller-owned
+    shard_map (the sp train step embeds it in a full model step):
+    ``attn(q, k, v) -> out`` on this device's sequence shard, with a
+    custom_vjp running the blocked backward ring (the pallas kernels
+    have no AD rules; the recompute-p backward from the saved lse is
+    both the differentiation rule and the right economics)."""
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        out, _ = _ring_attn_local_pallas(
+            q, k, v, axis_name=axis_name, causal=causal, window=window,
+            block_q=block_q, interpret=interpret)
+        return out
+
+    def attn_fwd(q, k, v):
+        out, lse = _ring_attn_local_pallas(
+            q, k, v, axis_name=axis_name, causal=causal, window=window,
+            block_q=block_q, interpret=interpret)
+        return out, (q, k, v, out, lse)
+
+    def attn_bwd(residuals, g):
+        q, k, v, o, lse = residuals
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        return _ring_bwd_local_pallas(
+            q, k, v, g, lse, delta, axis_name=axis_name, causal=causal,
+            window=window, block_q=block_q, interpret=interpret)
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    return attn
+
+
 def make_ring_attention(mesh: Mesh, seq_axis: str = "sp",
                         causal: bool = True, impl: str = "einsum",
                         window: int | None = None,
@@ -268,47 +302,17 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "sp",
 
     run_interpret = (jax.default_backend() != "tpu"
                      if interpret is None else interpret)
-
-    def pallas_forward(q, k, v):
-        body = functools.partial(
-            _ring_attn_local_pallas, axis_name=seq_axis, causal=causal,
-            window=window, block_q=block_q, interpret=run_interpret)
-        # check_vma=False: pallas_call's out_shape carries no
-        # varying-axis metadata.
-        return jax.shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=(spec, spec), check_vma=False,
-        )(q, k, v)
-
-    def pallas_backward(q, k, v, do, lse, delta):
-        body = functools.partial(
-            _ring_bwd_local_pallas, axis_name=seq_axis, causal=causal,
-            window=window, block_q=block_q, interpret=run_interpret)
-        return jax.shard_map(
-            body, mesh=mesh, in_specs=(spec,) * 6,
-            out_specs=(spec, spec, spec), check_vma=False,
-        )(q, k, v, do, lse, delta)
-
-    @jax.custom_vjp
-    def attn(q, k, v):
-        return pallas_forward(q, k, v)[0]
-
-    def attn_fwd(q, k, v):
-        out, lse = pallas_forward(q, k, v)
-        return out, (q, k, v, out, lse)
-
-    def attn_bwd(residuals, g):
-        q, k, v, o, lse = residuals
-        # delta = rowsum(do ∘ o): elementwise, XLA fuses it outside the
-        # kernels (same as the single-device flash backward).
-        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                        axis=-1, keepdims=True)
-        return pallas_backward(q, k, v, g, lse, delta)
-
-    attn.defvjp(attn_fwd, attn_bwd)
+    local = make_local_ring_attention(
+        axis_name=seq_axis, causal=causal, window=window,
+        block_q=block_q, interpret=run_interpret)
 
     def checked(q, k, v):
         _validate_attention_args(q, k, v, causal, window)
-        return attn(q, k, v)
+        # check_vma=False: pallas_call's out_shape carries no
+        # varying-axis metadata.
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False,
+        )(q, k, v)
 
     return checked

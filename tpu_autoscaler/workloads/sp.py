@@ -1,0 +1,218 @@
+"""Context-parallel (sequence-parallel) TRAINING for the flagship model.
+
+Ring attention (ring_attention.py) gives the long-context forward; this
+module is where it meets the optimizer: the full train step — embed,
+blocks, loss, grads, AdamW — runs under ``shard_map`` with the SEQUENCE
+dimension sharded over the mesh's ``sp`` axis (and batch over ``data``),
+so a context that does not fit one chip's HBM trains across the ICI
+ring:
+
+- every pointwise/matmul op (norms, qkv/mlp projections, unembedding,
+  CE) touches only this device's [b_loc, s_loc] token block — no
+  communication;
+- RoPE rotates at GLOBAL positions (shard_index * s_loc offset), so the
+  sharded model is bit-equivalent to the unsharded one;
+- attention is the ring: K/V blocks rotate via ppermute while each
+  device folds them into its online-softmax carry (einsum merge for
+  training-grade AD, or the fused Pallas hop kernel);
+- the loss is a psum-mean over (data, sp); reverse-mode AD through
+  shard_map inserts the grad psums for the replicated params
+  automatically (broadcast transposes to psum) and reverses the ring's
+  ppermute schedule for dK/dV.
+
+Memory: resident activations are O(s_local) per device; with
+``cfg.remat`` the blocks recompute in the backward, which composes with
+the ring exactly as on one device.  MoE blocks are not supported under
+sp (token routing is sequence-local today); use the dp/tp or ep paths.
+
+Autoscaler relevance (SURVEY §6.7/§6.8): an sp job is the purest case
+for slice atomicity — the ring rides one slice's ICI torus every step,
+so bisecting the slice kills the job.  The dryrun gate jits this step
+over the virtual mesh the same way the driver validates dp/tp/pp/ep.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_autoscaler.workloads.model import (
+    ModelConfig,
+    TrainConfig,
+    _rmsnorm,
+    _rope,
+    _split_qkv,
+    init_params,
+    make_optimizer,
+)
+from tpu_autoscaler.workloads.ring_attention import (
+    _ring_attn_local,
+    make_local_ring_attention,
+)
+
+
+def make_sp_mesh(devices=None, sp: int | None = None) -> Mesh:
+    """(data, sp) mesh: batch over ``data``, sequence over ``sp``.
+
+    sp defaults to all devices (pure context parallelism); pass a
+    divisor for hybrid data x context parallelism."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if sp is None:
+        sp = n
+    if n % sp:
+        raise ValueError(f"{n} devices not divisible by sp={sp}")
+    arr = np.asarray(devices).reshape(n // sp, sp)
+    return Mesh(arr, axis_names=("data", "sp"))
+
+
+def _sp_block(x, layer, cfg: ModelConfig, *, seq_axis: str, impl: str,
+              block_q: int, interpret: bool):
+    """model._block restricted to this device's sequence shard: same
+    math (model.py::_block is the parity oracle, pinned in
+    tests/test_sp.py), with the attention mix replaced by the ring."""
+    b, s_loc, d = x.shape
+    y = _rmsnorm(x, layer["ln1"])
+    q, k, v = _split_qkv(y, layer["qkv"], cfg)
+    if cfg.rope:
+        # Global positions: this shard's tokens sit at offset
+        # shard_index * s_loc of the full sequence.
+        offset = jax.lax.axis_index(seq_axis) * s_loc
+        q = _rope(q, cfg.rope_theta, offset)
+        k = _rope(k, cfg.rope_theta, offset)
+    if impl == "pallas":
+        attn = make_local_ring_attention(
+            axis_name=seq_axis, causal=True,
+            window=cfg.attention_window, block_q=block_q,
+            interpret=interpret)(q, k, v)
+    else:
+        attn, _lse = _ring_attn_local(
+            q, k, v, axis_name=seq_axis, causal=True,
+            window=cfg.attention_window, sm_scale=cfg.head_dim ** -0.5)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s_loc, d)
+    x = x + jnp.einsum("bsd,de->bse", attn.astype(cfg.dtype),
+                       layer["attn_out"].astype(cfg.dtype))
+    y = _rmsnorm(x, layer["ln2"])
+    hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
+    hdn = jax.nn.gelu(hdn)
+    x = x + jnp.einsum("bsf,fd->bsd", hdn, layer["w2"].astype(cfg.dtype))
+    return x
+
+
+def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
+                       train: TrainConfig | None = None,
+                       impl: str | None = None,
+                       learning_rate: float = 1e-3,
+                       block_q: int = 128,
+                       interpret: bool | None = None,
+                       data_axis: str = "data", seq_axis: str = "sp"):
+    """Build (init_fn, step_fn) training with the sequence sharded over
+    ``mesh``'s ``seq_axis`` and batch over ``data_axis``.
+
+    step_fn: (params, opt_state, tokens [b, s+1]) -> (params, opt_state,
+    loss), jitted; params and optimizer state replicate (compose ZeRO
+    later if params dominate — under sp the ACTIVATIONS are the memory
+    problem).  ``impl``: "einsum" (XLA per-hop math) or "pallas" (fused
+    ring hop kernel with the blocked lse backward); None resolves like
+    ModelConfig.attention="auto" — pallas on TPU, einsum elsewhere.
+
+    ``cfg.ce_chunk`` is honored: the unembedding + CE scan over local
+    sequence chunks, so long-context sp runs don't materialize
+    [b_loc, s_loc, vocab] fp32 logits.
+
+    The trainer's full optimizer recipe applies unchanged (clipping's
+    global norm sees the psum'd global grads).
+    """
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "einsum"
+    if impl not in {"einsum", "pallas"}:
+        raise ValueError(f"unknown sp impl {impl!r}")
+    if cfg.moe_experts is not None:
+        raise ValueError(
+            "MoE blocks are not supported under sequence parallelism "
+            "(token routing is sequence-local); use the dp/tp or ep "
+            "paths")
+    if cfg.seq_len % mesh.shape[seq_axis]:
+        raise ValueError(
+            f"seq_len {cfg.seq_len} not divisible by the {seq_axis} "
+            f"axis ({mesh.shape[seq_axis]})")
+    if train is None:
+        train = TrainConfig(learning_rate=learning_rate)
+    optimizer = make_optimizer(train)
+    run_interpret = (jax.default_backend() != "tpu"
+                     if interpret is None else interpret)
+
+    block = functools.partial(
+        _sp_block, cfg=cfg, seq_axis=seq_axis, impl=impl,
+        block_q=block_q, interpret=run_interpret)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def local_loss(params, inputs, targets):
+        """This device's [b_loc, s_loc] token block through the model;
+        returns the GLOBAL mean NLL (psum over both axes — every device
+        sees the same scalar, keeping grads correct)."""
+        x = params["embed"].astype(cfg.dtype)[inputs]
+
+        def body(x, layer):
+            return block(x, layer), None
+
+        x, _ = jax.lax.scan(body, x, params["blocks"])
+        x = _rmsnorm(x, params["ln_f"])
+        b_loc, s_loc = inputs.shape
+        if cfg.ce_chunk is not None and s_loc % cfg.ce_chunk == 0:
+            # Chunked CE over the LOCAL sequence: the [b_loc, s_loc,
+            # vocab] fp32 logits never materialize (the point of
+            # ce_chunk, doubly so at sp's context lengths).
+            from tpu_autoscaler.workloads.model import _chunked_ce
+
+            local_sum = _chunked_ce(
+                x, params["unembed"], targets, cfg.ce_chunk, cfg.dtype
+            ) * (b_loc * s_loc)
+        else:
+            logits = jnp.einsum("bsd,dv->bsv", x,
+                                params["unembed"].astype(cfg.dtype)
+                                ).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            local_sum = jnp.sum(
+                -jnp.take_along_axis(logp, targets[..., None], axis=-1))
+        total = jax.lax.psum(local_sum, (data_axis, seq_axis))
+        n_tok = (b_loc * s_loc
+                 * jax.lax.psum(1, data_axis) * jax.lax.psum(1, seq_axis))
+        return total / n_tok
+
+    tok_spec = P(data_axis, seq_axis)
+    sharded_loss = jax.shard_map(
+        local_loss, mesh=mesh,
+        in_specs=(P(), tok_spec, tok_spec), out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss(params, tokens):
+        return sharded_loss(params, tokens[:, :-1], tokens[:, 1:])
+
+    def init(key):
+        params = init_params(key, cfg)
+        return params, optimizer.init(params)
+
+    def step(params, opt_state, tokens):
+        loss_val, grads = jax.value_and_grad(loss)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss_val
+
+    replicated = NamedSharding(mesh, P())
+    batch_shard = NamedSharding(mesh, P(data_axis, None))
+    init_jit = jax.jit(init, out_shardings=(replicated, replicated))
+    step_jit = jax.jit(
+        step,
+        in_shardings=(replicated, replicated, batch_shard),
+        out_shardings=(replicated, replicated, replicated),
+        donate_argnums=(0, 1),
+    )
+    return init_jit, step_jit

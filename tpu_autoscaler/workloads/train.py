@@ -61,6 +61,10 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--pp-microbatches", default=4, show_default=True,
               help="Microbatches streamed through the pipeline per step "
                    "(bubble fraction = (P-1)/(m+P-1)).")
+@click.option("--sp", "sp_degree", default=1, show_default=True,
+              help="Context parallelism: shard the SEQUENCE over this "
+                   "many devices (ring attention over the ICI ring; "
+                   "remaining devices are data-parallel).  1 = off.")
 @click.option("--data-file", default=None,
               help="Binary uint32 token shard to train on (native mmap "
                    "loader with prefetch; numpy fallback).  Default: "
@@ -79,8 +83,8 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
          attention_window, no_rope, remat, ce_chunk, zero1, shard_mode,
          lr, warmup_steps, lr_schedule, min_lr_ratio, grad_clip,
-         accum_steps, weight_decay, pp_stages, pp_microbatches, data_file,
-         profile_dir, checkpoint_dir,
+         accum_steps, weight_decay, pp_stages, pp_microbatches, sp_degree,
+         data_file, profile_dir, checkpoint_dir,
          checkpoint_every, annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -127,7 +131,41 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
         min_lr_ratio=min_lr_ratio, weight_decay=weight_decay,
         grad_clip=grad_clip, accum_steps=accum_steps)
     shard = shard_mode or ("zero1" if zero1 else "none")
-    if pp_stages > 1:
+    if pp_stages > 1 and sp_degree > 1:
+        raise click.UsageError(
+            "--pp-stages and --sp are separate strategies; pick one "
+            "(pp x sp composition is not wired in the CLI)")
+    if sp_degree > 1:
+        # Context parallelism: sequence over the sp ring, batch over
+        # the remaining (data-parallel) devices.
+        if shard != "none":
+            raise click.UsageError(
+                "--shard composes with the dp+tp step, not --sp")
+        if topo.num_processes > 1:
+            raise click.UsageError(
+                "--sp is single-process only for now; multi-host jobs "
+                "should use the dp+tp step")
+        if len(jax.devices()) % sp_degree:
+            raise click.UsageError(
+                f"--sp {sp_degree} must divide the "
+                f"{len(jax.devices())} available devices")
+        if seq_len % sp_degree:
+            raise click.UsageError(
+                f"--sp {sp_degree} must divide --seq-len {seq_len}")
+        dp_n = len(jax.devices()) // sp_degree
+        if batch % dp_n:
+            raise click.UsageError(
+                f"--batch {batch} must divide over the {dp_n} "
+                f"data-parallel devices (devices / sp)")
+        from tpu_autoscaler.workloads.sp import (
+            make_sp_mesh,
+            make_sp_train_step,
+        )
+
+        mesh = make_sp_mesh(jax.devices(), sp=sp_degree)
+        init_fn, raw_step_fn = make_sp_train_step(mesh, cfg,
+                                                  train=train_cfg)
+    elif pp_stages > 1:
         # Pipeline mode: layers over a pp ring (GPipe, microbatch
         # remat); tokens replicate across stages.
         if shard != "none":
@@ -184,9 +222,15 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
     from jax.sharding import PartitionSpec as _P
 
     # Pipeline stages all see the full batch (the pp loop microbatches
-    # internally); dp/tp meshes shard it over the data axes.
-    b_sharding = NamedSharding(
-        mesh, _P() if pp_stages > 1 else batch_spec(mesh))
+    # internally); sp meshes shard batch over 'data' only (the 'sp'
+    # axis carries sequence); dp/tp meshes shard over the data axes.
+    if pp_stages > 1:
+        b_spec = _P()
+    elif sp_degree > 1:
+        b_spec = _P("data", None)
+    else:
+        b_spec = batch_spec(mesh)
+    b_sharding = NamedSharding(mesh, b_spec)
     n_proc = max(1, topo.num_processes)
     local_batch = max(1, batch // n_proc)
 
