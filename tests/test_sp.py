@@ -112,6 +112,37 @@ class TestSpTrainStep:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0] - 0.2
 
+    def test_ulysses_impl_parity_with_unsharded(self):
+        # n_heads=4 / kv=2 divide sp=2: ulysses legal; same numbers as
+        # the unsharded oracle (all_to_all is a permutation, the local
+        # attention is the reference einsum on CPU).
+        tokens = tokens_for(key=6)
+        mesh = make_sp_mesh(jax.devices()[:4], sp=2)
+        init_fn, step_fn = make_sp_train_step(mesh, CFG, impl="ulysses")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(3):
+            p, o, loss = step_fn(p, o, tokens)
+            losses.append(float(loss))
+        ref, _ = ref_losses_and_params(CFG, tokens)
+        np.testing.assert_allclose(losses, ref, rtol=1e-4)
+
+    def test_ulysses_window_parity(self):
+        cfg = dc.replace(CFG, attention_window=8)
+        tokens = tokens_for(key=7)
+        mesh = make_sp_mesh(jax.devices()[:2], sp=2)
+        init_fn, step_fn = make_sp_train_step(mesh, cfg, impl="ulysses")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss = step_fn(p, o, tokens)
+        ref, _ = ref_losses_and_params(cfg, tokens, steps=1)
+        assert float(loss) == pytest.approx(ref[0], rel=1e-4)
+
+    def test_ulysses_head_divisibility_rejected(self):
+        cfg = dc.replace(CFG, n_heads=6, n_kv_heads=3, d_model=48)
+        with pytest.raises(ValueError, match="divisible"):
+            make_sp_train_step(make_sp_mesh(jax.devices()[:4], sp=4),
+                               cfg, impl="ulysses")
+
     def test_ce_chunk_matches_full_logits(self):
         # ce_chunk must be honored (not silently ignored) and change
         # nothing numerically.

@@ -90,6 +90,20 @@ def _sp_block(x, layer, cfg: ModelConfig, *, seq_axis: str, impl: str,
             axis_name=seq_axis, causal=True,
             window=cfg.attention_window, block_q=block_q,
             interpret=interpret)(q, k, v)
+    elif impl == "ulysses":
+        from tpu_autoscaler.workloads.ulysses import _ulysses_local
+
+        # Local attention at FULL sequence -> the model's flash tile
+        # knobs (cfg.attn_block_q/k) apply, not the ring's per-hop
+        # block_q.  Kernel choice follows the backend (einsum is the
+        # AD-able oracle off-TPU); pass interpret for pallas-on-CPU
+        # debugging via make_ulysses_attention directly.
+        attn = _ulysses_local(
+            q, k, v, axis_name=seq_axis, causal=True,
+            window=cfg.attention_window, block_q=cfg.attn_block_q,
+            block_k=cfg.attn_block_k,
+            impl="pallas" if jax.default_backend() == "tpu" else "einsum",
+            interpret=interpret)
     else:
         attn, _lse = _ring_attn_local(
             q, k, v, axis_name=seq_axis, causal=True,
@@ -117,9 +131,15 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
     step_fn: (params, opt_state, tokens [b, s+1]) -> (params, opt_state,
     loss), jitted; params and optimizer state replicate (compose ZeRO
     later if params dominate — under sp the ACTIVATIONS are the memory
-    problem).  ``impl``: "einsum" (XLA per-hop math) or "pallas" (fused
-    ring hop kernel with the blocked lse backward); None resolves like
-    ModelConfig.attention="auto" — pallas on TPU, einsum elsewhere.
+    problem).  ``impl``: "einsum" (ring, XLA per-hop math), "pallas"
+    (ring, fused hop kernel with the blocked lse backward), or
+    "ulysses" (all-to-all to head sharding + local flash attention at
+    full sequence — needs heads AND kv heads divisible by sp); None
+    resolves like ModelConfig.attention="auto" — the pallas ring on
+    TPU, the einsum ring elsewhere.  ``block_q`` is the ring impls'
+    per-hop q tile; the ulysses local kernel tiles with
+    cfg.attn_block_q/attn_block_k (it runs the model's own flash
+    kernel at full sequence).
 
     ``cfg.ce_chunk`` is honored: the unembedding + CE scan over local
     sequence chunks, so long-context sp runs don't materialize
@@ -130,8 +150,16 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
     """
     if impl is None:
         impl = "pallas" if jax.default_backend() == "tpu" else "einsum"
-    if impl not in {"einsum", "pallas"}:
+    if impl not in {"einsum", "pallas", "ulysses"}:
         raise ValueError(f"unknown sp impl {impl!r}")
+    if impl == "ulysses":
+        sp_size = mesh.shape[seq_axis]
+        if cfg.n_heads % sp_size or cfg.kv_heads % sp_size:
+            raise ValueError(
+                f"impl='ulysses' needs heads divisible by the "
+                f"{seq_axis} axis ({sp_size}): got {cfg.n_heads} q / "
+                f"{cfg.kv_heads} kv heads — use the ring impls for "
+                f"indivisible head counts")
     if cfg.moe_experts is not None:
         raise ValueError(
             "MoE blocks are not supported under sequence parallelism "

@@ -65,6 +65,13 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Context parallelism: shard the SEQUENCE over this "
                    "many devices (ring attention over the ICI ring; "
                    "remaining devices are data-parallel).  1 = off.")
+@click.option("--sp-impl",
+              type=click.Choice(["auto", "einsum", "pallas", "ulysses"]),
+              default="auto", show_default=True,
+              help="Sequence-parallel attention strategy: einsum/pallas "
+                   "= ring (ppermute hops); ulysses = all-to-all to "
+                   "head sharding + local flash attention (needs heads "
+                   "divisible by --sp).  auto = pallas ring on TPU.")
 @click.option("--data-file", default=None,
               help="Binary uint32 token shard to train on (native mmap "
                    "loader with prefetch; numpy fallback).  Default: "
@@ -84,7 +91,7 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
          attention_window, no_rope, remat, ce_chunk, zero1, shard_mode,
          lr, warmup_steps, lr_schedule, min_lr_ratio, grad_clip,
          accum_steps, weight_decay, pp_stages, pp_microbatches, sp_degree,
-         data_file, profile_dir, checkpoint_dir,
+         sp_impl, data_file, profile_dir, checkpoint_dir,
          checkpoint_every, annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -163,8 +170,12 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
         )
 
         mesh = make_sp_mesh(jax.devices(), sp=sp_degree)
-        init_fn, raw_step_fn = make_sp_train_step(mesh, cfg,
-                                                  train=train_cfg)
+        try:
+            init_fn, raw_step_fn = make_sp_train_step(
+                mesh, cfg, train=train_cfg,
+                impl=None if sp_impl == "auto" else sp_impl)
+        except ValueError as e:  # e.g. ulysses head-divisibility
+            raise click.UsageError(str(e)) from e
     elif pp_stages > 1:
         # Pipeline mode: layers over a pp ring (GPipe, microbatch
         # remat); tokens replicate across stages.
