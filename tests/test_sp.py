@@ -157,6 +157,32 @@ class TestSpTrainStep:
             losses[chunk] = float(loss)
         assert losses[8] == pytest.approx(losses[None], rel=1e-5)
 
+    def test_zero1_parity_and_sharded_moments(self):
+        tokens = tokens_for(key=8)
+        mesh = make_sp_mesh(jax.devices()[:4], sp=2)
+        init_fn, step_fn = make_sp_train_step(mesh, CFG, shard="zero1")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        # AdamW moments shard over data x sp (4 devices); params stay
+        # replicated.
+        mu_qkv = o[0].mu["blocks"]["qkv"]
+        full = int(np.prod(mu_qkv.shape))
+        shard_elems = int(np.prod(
+            mu_qkv.sharding.shard_shape(mu_qkv.shape)))
+        assert shard_elems == full // 4
+        emb = p["embed"]
+        assert emb.sharding.shard_shape(emb.shape) == emb.shape
+        losses = []
+        for _ in range(3):
+            p, o, loss = step_fn(p, o, tokens)
+            losses.append(float(loss))
+        ref, _ = ref_losses_and_params(CFG, tokens)
+        np.testing.assert_allclose(losses, ref, rtol=1e-4)
+
+    def test_bad_shard_rejected(self):
+        with pytest.raises(ValueError, match="zero1"):
+            make_sp_train_step(make_sp_mesh(jax.devices()[:2]), CFG,
+                               shard="fsdp")
+
     def test_moe_rejected(self):
         cfg = dc.replace(CFG, moe_experts=4)
         with pytest.raises(ValueError, match="MoE"):

@@ -756,6 +756,18 @@ def _opt_state_shardings(optimizer, params: dict, p_specs: dict,
     return jax.tree_util.tree_map_with_path(leaf_sharding, shapes)
 
 
+def opt_state_shardings(cfg: ModelConfig, optimizer, p_specs: dict,
+                        mesh: Mesh, zero1: bool):
+    """NamedShardings for ``optimizer``'s state given the params'
+    PartitionSpecs — the one place the eval_shape + moment-suffix
+    matching happens (model, pipeline and sp steps all build their
+    optimizer shardings here)."""
+    abstract = jax.eval_shape(
+        functools.partial(init_params, cfg=cfg), jax.random.PRNGKey(0))
+    return _opt_state_shardings(optimizer, abstract, p_specs, mesh,
+                                zero1)
+
+
 def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
                             learning_rate: float = 1e-3,
                             zero1: bool = False,
@@ -803,9 +815,8 @@ def make_sharded_train_step(mesh: Mesh, cfg: ModelConfig,
         is_leaf=lambda x: isinstance(x, P))
     b_shard = NamedSharding(mesh, batch_spec(mesh))
     replicated = NamedSharding(mesh, P())
-    o_shard = _opt_state_shardings(optimizer, jax.eval_shape(
-        functools.partial(init_params, cfg=cfg),
-        jax.random.PRNGKey(0)), p_specs, mesh, shard == "zero1")
+    o_shard = opt_state_shardings(cfg, optimizer, p_specs, mesh,
+                                  shard == "zero1")
 
     def init(key):
         params = init_params(key, cfg)

@@ -209,8 +209,8 @@ def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig,
     norm XLA computes with a cross-stage psum.
     """
     from tpu_autoscaler.workloads.model import (
-        _opt_state_shardings,
         init_params,
+        opt_state_shardings,
     )
 
     if train is None:
@@ -223,9 +223,7 @@ def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig,
         lambda spec: NamedSharding(mesh, spec), p_specs,
         is_leaf=lambda x: isinstance(x, P))
     replicated = NamedSharding(mesh, P())
-    o_shard = _opt_state_shardings(optimizer, jax.eval_shape(
-        functools.partial(init_params, cfg=cfg),
-        jax.random.PRNGKey(0)), p_specs, mesh, False)
+    o_shard = opt_state_shardings(cfg, optimizer, p_specs, mesh, False)
 
     def init(key):
         params = init_params(key, cfg)

@@ -124,14 +124,15 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
                        learning_rate: float = 1e-3,
                        block_q: int = 128,
                        interpret: bool | None = None,
+                       shard: str = "none",
                        data_axis: str = "data", seq_axis: str = "sp"):
     """Build (init_fn, step_fn) training with the sequence sharded over
     ``mesh``'s ``seq_axis`` and batch over ``data_axis``.
 
     step_fn: (params, opt_state, tokens [b, s+1]) -> (params, opt_state,
-    loss), jitted; params and optimizer state replicate (compose ZeRO
-    later if params dominate — under sp the ACTIVATIONS are the memory
-    problem).  ``impl``: "einsum" (ring, XLA per-hop math), "pallas"
+    loss), jitted; params replicate (under sp the ACTIVATIONS are the
+    memory problem; ``shard="zero1"`` below slices the optimizer
+    moments).  ``impl``: "einsum" (ring, XLA per-hop math), "pallas"
     (ring, fused hop kernel with the blocked lse backward), or
     "ulysses" (all-to-all to head sharding + local flash attention at
     full sequence — needs heads AND kv heads divisible by sp); None
@@ -147,7 +148,18 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
 
     The trainer's full optimizer recipe applies unchanged (clipping's
     global norm sees the psum'd global grads).
+
+    ``shard="zero1"`` shards the AdamW moments over BOTH mesh axes
+    (params replicate, so every axis is a "data" axis from the
+    optimizer's point of view) — the fp32 moment HBM drops by the full
+    device count while the step math is untouched (the optimizer runs
+    under GSPMD outside the shard_map).
     """
+    if shard not in {"none", "zero1"}:
+        raise ValueError(
+            f"sp supports shard='none' or 'zero1', got {shard!r} "
+            "(params replicate under sp; fsdp belongs to the dp/tp "
+            "step)")
     if impl is None:
         impl = "pallas" if jax.default_backend() == "tpu" else "einsum"
     if impl not in {"einsum", "pallas", "ulysses"}:
@@ -236,11 +248,21 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
 
     replicated = NamedSharding(mesh, P())
     batch_shard = NamedSharding(mesh, P(data_axis, None))
-    init_jit = jax.jit(init, out_shardings=(replicated, replicated))
+    if shard == "zero1":
+        from tpu_autoscaler.workloads.model import opt_state_shardings
+
+        abstract = jax.eval_shape(
+            functools.partial(init_params, cfg=cfg), jax.random.PRNGKey(0))
+        o_shard = opt_state_shardings(
+            cfg, optimizer, jax.tree.map(lambda _: P(), abstract), mesh,
+            True)
+    else:
+        o_shard = replicated
+    init_jit = jax.jit(init, out_shardings=(replicated, o_shard))
     step_jit = jax.jit(
         step,
-        in_shardings=(replicated, replicated, batch_shard),
-        out_shardings=(replicated, replicated, replicated),
+        in_shardings=(replicated, o_shard, batch_shard),
+        out_shardings=(replicated, o_shard, replicated),
         donate_argnums=(0, 1),
     )
     return init_jit, step_jit

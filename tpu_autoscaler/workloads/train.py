@@ -145,9 +145,10 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
     if sp_degree > 1:
         # Context parallelism: sequence over the sp ring, batch over
         # the remaining (data-parallel) devices.
-        if shard != "none":
+        if shard == "fsdp":
             raise click.UsageError(
-                "--shard composes with the dp+tp step, not --sp")
+                "--shard fsdp composes with the dp+tp step, not --sp "
+                "(params replicate under sp; --shard zero1 composes)")
         if topo.num_processes > 1:
             raise click.UsageError(
                 "--sp is single-process only for now; multi-host jobs "
@@ -173,7 +174,8 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
         try:
             init_fn, raw_step_fn = make_sp_train_step(
                 mesh, cfg, train=train_cfg,
-                impl=None if sp_impl == "auto" else sp_impl)
+                impl=None if sp_impl == "auto" else sp_impl,
+                shard=shard)
         except ValueError as e:  # e.g. ulysses head-divisibility
             raise click.UsageError(str(e)) from e
     elif pp_stages > 1:
