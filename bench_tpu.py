@@ -192,9 +192,11 @@ def _impl_step(small: bool) -> None:
 
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
     tokens = batch_size * cfg.seq_len
-    # 6ND matmul flops (fwd+bwd) + attention score/context flops.
+    # 6ND matmul flops (fwd+bwd) + attention score/context flops
+    # (4*b*s^2*d per layer fwd, 3x for bwd, halved for the causal mask —
+    # the kernel only computes the live triangle).
     flops = (6.0 * n_params * tokens
-             + 12.0 * cfg.n_layers * batch_size
+             + 6.0 * cfg.n_layers * batch_size
              * cfg.seq_len ** 2 * cfg.d_model)
     peak = _peak_flops(dev.device_kind)
     mfu = flops / (step_s * peak) if peak else None
@@ -270,12 +272,13 @@ def _impl_step_large(small: bool) -> None:
 
     cfg = ModelConfig(**base)
     tokens = batch_size * cfg.seq_len
-    # 6ND matmul flops (fwd+bwd) + attention score/context flops; remat
-    # recomputes the block forward, but MFU conventionally counts the
-    # model's algorithmic flops, not the recompute (hardware does more
-    # work than the numerator — the honest direction).
+    # 6ND matmul flops (fwd+bwd) + attention score/context flops
+    # (causal-halved, same convention as _impl_step); remat recomputes
+    # the block forward, but MFU conventionally counts the model's
+    # algorithmic flops, not the recompute (hardware does more work
+    # than the numerator — the honest direction).
     flops = (6.0 * n_params * tokens
-             + 12.0 * cfg.n_layers * batch_size
+             + 6.0 * cfg.n_layers * batch_size
              * cfg.seq_len ** 2 * cfg.d_model)
     peak = _peak_flops(dev.device_kind)
     step_s = best["step_seconds"]

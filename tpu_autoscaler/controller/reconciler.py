@@ -699,7 +699,8 @@ class Controller:
             tpu_idle = sorted(
                 (uid for uid, ns in units.items()
                  if ns[0].is_tpu and idle(ns)
-                 and f"{_gen_of(ns[0])}-{_chips_of(ns)}" == shape_name),
+                 and f"{_gen_of(ns[0], self.metrics)}-{_chips_of(ns)}"
+                 == shape_name),
                 key=lambda uid: -created(units[uid]))
             spare.update(tpu_idle[:want])
         return spare
@@ -920,13 +921,32 @@ class Controller:
                           reason="unhealthy host in slice")
 
 
-def _gen_of(node: Node) -> str:
+_warned_unknown_shapes: set = set()
+
+
+def _gen_of(node: Node, metrics=None) -> str:
     from tpu_autoscaler.topology.catalog import SLICE_SHAPES
 
     for s in SLICE_SHAPES.values():
         if s.accelerator_type == node.tpu_accelerator \
                 and s.topology_label == node.tpu_topology:
             return s.generation
+    # A TPU node whose accelerator/topology labels match no catalog
+    # shape: the spare-slice policy will never retain it, silently.
+    # Count + log once per label combo (NOT per call: this runs inside
+    # the per-shape spare filter every reconcile pass, so an undeduped
+    # counter would measure loop iterations, not unknown nodes).
+    combo = (node.tpu_accelerator, node.tpu_topology)
+    if combo not in _warned_unknown_shapes:
+        _warned_unknown_shapes.add(combo)
+        if metrics is not None:
+            metrics.inc("nodes_unknown_shape")
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "node %s has accelerator=%r topology=%r matching no catalog "
+            "shape; spare-slice retention will skip it", node.name,
+            combo[0], combo[1])
     return "unknown"
 
 

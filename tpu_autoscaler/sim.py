@@ -115,12 +115,12 @@ class SimResult:
             reclaimed = "all reclaimed" if self.nodes == 0 else \
                 f"{self.nodes} nodes LEFT"
             return (f"[{self.scenario}] Unschedulable→Running in "
-                    f"{self.latency_seconds:.1f}s; peak {self.peak_nodes} "
-                    f"nodes, then job completed → {reclaimed} "
-                    f"(units_deleted="
+                    f"{self.latency_seconds:.1f}s sim-time; peak "
+                    f"{self.peak_nodes} nodes, then job completed → "
+                    f"{reclaimed} (units_deleted="
                     f"{int(self.snapshot['counters'].get('units_deleted', 0))})")
         return (f"[{self.scenario}] Unschedulable→Running in "
-                f"{self.latency_seconds:.1f}s; nodes={self.nodes}, "
+                f"{self.latency_seconds:.1f}s sim-time; nodes={self.nodes}, "
                 f"chips={self.chips_provisioned} "
                 f"(requested {self.chips_requested}, "
                 f"stranded {self.stranded_chips})")

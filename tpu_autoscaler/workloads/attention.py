@@ -807,7 +807,10 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
 
     @pl.when(j == n_kb - 1)
     def _finish():
-        o_ref[0] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
+        # length=0 leaves no live block (l stays 0); clamp like
+        # _ring_driver so the kernel emits zeros, not 0/0 NaN.
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
 def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,

@@ -221,16 +221,32 @@ def make_local_ring_attention(*, axis_name: str, causal: bool = True,
     ``attn(q, k, v) -> out`` on this device's sequence shard, with a
     custom_vjp running the blocked backward ring (the pallas kernels
     have no AD rules; the recompute-p backward from the saved lse is
-    both the differentiation rule and the right economics)."""
+    both the differentiation rule and the right economics).
+
+    Validates like the other public entries (_validate_attention_args'
+    rules): window requires causal here at build time — _hop_mode
+    treats causal=False as fully-visible and would silently ignore the
+    window — and the per-call shape checks (GQA head divisibility, k/v
+    match) run on the local shards inside ``attn``."""
+    from tpu_autoscaler.workloads.attention import _validate_attention_args
+
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1")
+
+    def _check_shapes(q, k, v):
+        _validate_attention_args(q, k, v, causal, window)
 
     @jax.custom_vjp
     def attn(q, k, v):
+        _check_shapes(q, k, v)
         out, _ = _ring_attn_local_pallas(
             q, k, v, axis_name=axis_name, causal=causal, window=window,
             block_q=block_q, interpret=interpret)
         return out
 
     def attn_fwd(q, k, v):
+        _check_shapes(q, k, v)
         out, lse = _ring_attn_local_pallas(
             q, k, v, axis_name=axis_name, causal=causal, window=window,
             block_q=block_q, interpret=interpret)
