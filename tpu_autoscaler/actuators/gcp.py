@@ -12,12 +12,20 @@ GET poll, DELETE teardown).  Token resolution order:
    credentials.
 
 Tokens are cached until ~5 minutes before expiry.
+
+Every verb retries transient failures (429 / 5xx / connection errors)
+with bounded exponential backoff + full jitter, honoring Retry-After —
+the reference's deployments.py tolerated flaky ARM polls the same way;
+without this a single 503 surfaced as a whole reconcile-pass exception.
+A 401 mid-flight invalidates the cached token and re-resolves once
+(metadata-server tokens rotate under us in-cluster).
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import random
 import time
 
 log = logging.getLogger(__name__)
@@ -35,6 +43,14 @@ class TokenProvider:
         self._token: str | None = None
         self._expires_at = 0.0
         self._env_token_used: str | None = None
+
+    def invalidate(self) -> None:
+        """Drop the cached token so the next token() re-resolves — the
+        401 recovery path: a metadata-server token can be revoked/rotated
+        before its advertised expiry, and a stale env token adopted on
+        metadata failure would otherwise 401 forever."""
+        self._token = None
+        self._expires_at = 0.0
 
     def token(self) -> str:
         if self._token and time.time() < self._expires_at - 300:
@@ -73,42 +89,101 @@ class TokenProvider:
                 "metadata server (GKE workload identity)") from e
 
 
+#: HTTP statuses worth retrying: rate limits and server-side hiccups.
+_RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
 class GcpRest:
-    """Minimal authenticated JSON REST client with dry-run support."""
+    """Minimal authenticated JSON REST client with dry-run support and
+    transient-failure retries (see module docstring).
+
+    ``metrics``: optional Metrics sink — each retried attempt increments
+    ``rest_retries`` so operators can see a flaky control plane before
+    it becomes an outage.  ``sleep``/``rng`` are injectable for tests.
+    """
+
+    max_attempts = 5
+    backoff_base_s = 0.5
+    backoff_cap_s = 8.0
 
     def __init__(self, dry_run: bool = False,
-                 token_provider: TokenProvider | None = None):
+                 token_provider: TokenProvider | None = None,
+                 metrics=None, sleep=time.sleep,
+                 rng: random.Random | None = None):
         self.dry_run = dry_run
         self._tokens = token_provider or TokenProvider()
+        self._metrics = metrics
+        self._sleep = sleep
+        self._rng = rng or random.Random()
 
     def _headers(self) -> dict:
         return {"Authorization": f"Bearer {self._tokens.token()}",
                 "Content-Type": "application/json"}
 
-    def get(self, url: str) -> dict:
+    def _backoff_seconds(self, attempt: int, retry_after) -> float:
+        """Retry-After wins when the server said it; else exponential
+        with full jitter (the watch loop's scheme: uniform(0, min(cap,
+        base·2^n)))."""
+        if retry_after is not None:
+            try:
+                return min(float(retry_after), self.backoff_cap_s * 4)
+            except (TypeError, ValueError):
+                pass
+        return self._rng.uniform(
+            0, min(self.backoff_cap_s, self.backoff_base_s * 2 ** attempt))
+
+    def _note_retry(self, why: str, url: str, attempt: int) -> None:
+        if self._metrics is not None:
+            self._metrics.inc("rest_retries")
+        log.warning("GCP REST %s (attempt %d/%d) %s — retrying",
+                    why, attempt + 1, self.max_attempts, url)
+
+    def _request(self, method: str, url: str, body: dict | None) -> dict:
         import requests
 
-        r = requests.get(url, headers=self._headers(), timeout=30)
-        r.raise_for_status()
-        return r.json()
+        reauthed = False
+        attempt = 0
+        while True:
+            try:
+                r = requests.request(
+                    method, url, headers=self._headers(),
+                    json=body if method == "POST" else None, timeout=30)
+            except requests.exceptions.RequestException as e:
+                if attempt + 1 >= self.max_attempts:
+                    raise
+                self._note_retry(f"connection error ({e.__class__.__name__})",
+                                 url, attempt)
+                self._sleep(self._backoff_seconds(attempt, None))
+                attempt += 1
+                continue
+            if r.status_code == 401 and not reauthed:
+                # Token revoked/rotated under us: re-resolve once, and
+                # don't burn a backoff attempt on it.
+                reauthed = True
+                self._tokens.invalidate()
+                self._note_retry("401 (re-resolving token)", url, attempt)
+                continue
+            if r.status_code in _RETRYABLE_STATUSES \
+                    and attempt + 1 < self.max_attempts:
+                self._note_retry(f"{r.status_code}", url, attempt)
+                self._sleep(self._backoff_seconds(
+                    attempt, r.headers.get("Retry-After")))
+                attempt += 1
+                continue
+            r.raise_for_status()
+            return r.json() if r.content else {}
+
+    def get(self, url: str) -> dict:
+        return self._request("GET", url, None)
 
     def post(self, url: str, body: dict) -> dict:
         if self.dry_run:
             log.info("[dry-run] POST %s %s", url, body)
             return {}
-        import requests
-
-        r = requests.post(url, headers=self._headers(), json=body,
-                          timeout=30)
-        r.raise_for_status()
-        return r.json()
+        return self._request("POST", url, body)
 
     def delete(self, url: str) -> dict:
         if self.dry_run:
             log.info("[dry-run] DELETE %s", url)
             return {}
-        import requests
-
-        r = requests.delete(url, headers=self._headers(), timeout=30)
-        r.raise_for_status()
-        return r.json() if r.content else {}
+        return self._request("DELETE", url, None)

@@ -73,6 +73,12 @@ class GkeNodePoolActuator:
         self._rollback_attempts: dict[str, int] = {}
         self._ids = itertools.count(int(time.time()) % 100000)
 
+    def set_metrics(self, metrics) -> None:
+        """Wire the controller's metrics into the REST layer (the
+        Controller calls this on construction) so rest_retries lands in
+        the same registry as every other counter."""
+        self._rest._metrics = metrics
+
     ROLLBACK_MAX_ATTEMPTS = 40
 
     # ---- request -> GKE node pool spec ---------------------------------

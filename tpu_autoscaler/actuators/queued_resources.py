@@ -79,6 +79,12 @@ class QueuedResourceActuator:
         self._qr_counts: dict[str, int] = {}
         self._ids = itertools.count(int(time.time()) % 100000)
 
+    def set_metrics(self, metrics) -> None:
+        """Wire the controller's metrics into the REST layer (the
+        Controller calls this on construction) so rest_retries lands in
+        the same registry as every other counter."""
+        self._rest._metrics = metrics
+
     def provision(self, request: ProvisionRequest) -> ProvisionStatus:
         if request.kind != "tpu-slice":
             raise ValueError(

@@ -111,6 +111,10 @@ class Controller:
         self.config = config or ControllerConfig()
         self.notifier = notifier or LogNotifier()
         self.metrics = metrics or Metrics()
+        # Actuators that do REST I/O surface their retry counters
+        # through the controller's metrics registry (gcp.py GcpRest).
+        if hasattr(actuator, "set_metrics"):
+            actuator.set_metrics(self.metrics)
         self.planner = Planner(self.config.policy)
         self.tracker = SliceTracker()
         # Gang lifecycle: first time each gang was seen Unschedulable, for
