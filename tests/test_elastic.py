@@ -1,0 +1,149 @@
+"""Elastic checkpoint restore: a checkpoint saved on one mesh shape
+restores onto a different device count/topology with identical weights
+(VERDICT r3 item 6).
+
+Why this matters for the autoscaler: spot reclaim → generation-fallback
+replacement can produce a DIFFERENT slice shape than the one the job
+checkpointed on (reconciler.py's capacity-stockout fallback).  The
+trainer restores with the LIVE shardings (train.py builds the abstract
+state from the freshly-initialized step's shardings, not the
+checkpoint's), so orbax reshards on read and training continues on the
+new topology.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_autoscaler.workloads.checkpoint import (  # noqa: E402
+    DrainWatcher,
+    restore_checkpoint,
+    save_checkpoint,
+    train_until_drained,
+)
+from tpu_autoscaler.workloads.model import (  # noqa: E402
+    ModelConfig,
+    loss_fn,
+    make_mesh,
+    make_sharded_train_step,
+)
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                  seq_len=16, dtype=jnp.float32)
+
+
+def tokens_for(batch=8, key=3):
+    return jax.random.randint(jax.random.PRNGKey(key),
+                              (batch, CFG.seq_len + 1), 0, CFG.vocab,
+                              dtype=jnp.int32)
+
+
+def live_abstract(state):
+    """The trainer's restore recipe (train.py): abstract state carrying
+    the CURRENT step's shardings, so the new topology wins."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=x.sharding), state)
+
+
+def save_on_mesh(tmpdir, shard="fsdp", steps=2):
+    tokens = tokens_for()
+    mesh4 = make_mesh(jax.devices()[:4], tp=2)
+    init_fn, step_fn = make_sharded_train_step(mesh4, CFG, shard=shard)
+    p, o = init_fn(jax.random.PRNGKey(0))
+    for _ in range(steps):
+        p, o, loss = step_fn(p, o, tokens)
+    save_checkpoint(tmpdir, steps, {"params": p, "opt": o})
+    eval_loss = float(loss_fn(jax.device_get(p), tokens, CFG))
+    return tokens, eval_loss
+
+
+class TestElasticRestore:
+    @pytest.mark.parametrize("n,tp", [(8, 2), (2, 2)])
+    @pytest.mark.slow
+    def test_fsdp_checkpoint_restores_on_other_mesh(self, n, tp):
+        with tempfile.TemporaryDirectory() as d:
+            tokens, want = save_on_mesh(d, shard="fsdp")
+            mesh = make_mesh(jax.devices()[:n], tp=tp)
+            init_fn, step_fn = make_sharded_train_step(mesh, CFG,
+                                                       shard="fsdp")
+            pn, on = init_fn(jax.random.PRNGKey(1))  # shardings donor
+            restored = restore_checkpoint(
+                d, 2, live_abstract({"params": pn, "opt": on}))
+            got = float(loss_fn(jax.device_get(restored["params"]),
+                                tokens, CFG))
+            assert got == pytest.approx(want, abs=1e-6)
+            # And the new-topology step keeps training from it.
+            p2, o2, loss = step_fn(restored["params"], restored["opt"],
+                                   tokens)
+            assert float(loss) == pytest.approx(want, abs=1e-5)
+
+    def test_zero1_checkpoint_restores_on_smaller_mesh(self):
+        with tempfile.TemporaryDirectory() as d:
+            tokens, want = save_on_mesh(d, shard="zero1")
+            mesh = make_mesh(jax.devices()[:2], tp=1)
+            init_fn, step_fn = make_sharded_train_step(mesh, CFG,
+                                                       shard="zero1")
+            pn, on = init_fn(jax.random.PRNGKey(1))
+            restored = restore_checkpoint(
+                d, 2, live_abstract({"params": pn, "opt": on}))
+            got = float(loss_fn(jax.device_get(restored["params"]),
+                                tokens, CFG))
+            assert got == pytest.approx(want, abs=1e-6)
+            _, _, loss = step_fn(restored["params"], restored["opt"],
+                                 tokens)
+            assert np.isfinite(float(loss))
+
+    @pytest.mark.slow
+    def test_drain_then_resume_on_new_shape_e2e(self):
+        """The full spot-reclaim story at the workload layer: the drain
+        watcher fires mid-run -> checkpoint -> a replacement slice with
+        a DIFFERENT shape restores and keeps improving the loss."""
+        tokens = tokens_for()
+        annotations = {}
+        watcher = DrainWatcher(lambda: annotations, min_poll_interval=0)
+
+        mesh4 = make_mesh(jax.devices()[:4], tp=2)
+        init_fn, step4 = make_sharded_train_step(mesh4, CFG, shard="fsdp")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        losses = []
+
+        def step_fn(state, batch):
+            p2, o2, loss = step4(state["params"], state["opt"], batch)
+            losses.append(float(loss))
+            return {"params": p2, "opt": o2}
+
+        with tempfile.TemporaryDirectory() as d:
+            def on_step(step, _state):
+                if step == 3:
+                    # Controller requests the drain (reclaim imminent).
+                    annotations["autoscaler.tpu.dev/checkpoint-requested"] \
+                        = "now"
+
+            state = {"params": p, "opt": o}
+            state, done, drained = train_until_drained(
+                step_fn, state, 10, watcher, d,
+                make_batch=lambda s: tokens, on_step=on_step)
+            assert drained and done == 3
+
+            # Generation fallback landed a different shape: 2 devices.
+            mesh2 = make_mesh(jax.devices()[:2], tp=1)
+            init2, step2 = make_sharded_train_step(mesh2, CFG,
+                                                   shard="fsdp")
+            pn, on2 = init2(jax.random.PRNGKey(1))
+            restored = restore_checkpoint(
+                d, 3, live_abstract({"params": pn, "opt": on2}))
+            resumed = []
+            st = restored
+            for _ in range(3):
+                p2, o2, loss = step2(st["params"], st["opt"], tokens)
+                st = {"params": p2, "opt": o2}
+                resumed.append(float(loss))
+            # Resumed exactly where we left: next loss continues the
+            # descent from the drained run's last value.
+            assert resumed[0] < losses[-1]
+            assert resumed[-1] < resumed[0]

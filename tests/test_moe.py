@@ -188,3 +188,94 @@ class TestTopKMoeLayer:
         grads = jax.jit(jax.grad(
             lambda p: jnp.sum(layer(p, x) ** 2)))(params)
         assert float(jnp.abs(grads["router"]).sum()) > 0
+
+
+class TestEpTrainStep:
+    """dp×ep MoE training: experts sharded over 'ep' in the full model
+    step (VERDICT r3 item 8)."""
+
+    def mesh(self, dp=2, ep=4):
+        from tpu_autoscaler.workloads.moe import make_ep_mesh
+
+        return make_ep_mesh(jax.devices()[:dp * ep], ep=ep)
+
+    def cfg(self, **kw):
+        from tpu_autoscaler.workloads.model import ModelConfig
+
+        base = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                    seq_len=16, dtype=jnp.float32, moe_experts=8,
+                    moe_top_k=2, moe_capacity_factor=64.0)
+        base.update(kw)
+        return ModelConfig(**base)
+
+    def tokens(self, batch=8, key=3):
+        cfg = self.cfg()
+        return jax.random.randint(jax.random.PRNGKey(key),
+                                  (batch, cfg.seq_len + 1), 0, cfg.vocab,
+                                  dtype=jnp.int32)
+
+    def test_no_drop_parity_with_unsharded_moe(self):
+        """Ample capacity -> zero drops on either dispatch -> the
+        pool-routed ep loss equals model.loss_and_metrics' per-row
+        dispatch exactly (same route_topk on the same logits)."""
+        from tpu_autoscaler.workloads.model import (
+            init_params,
+            loss_and_metrics,
+        )
+        from tpu_autoscaler.workloads.moe import make_ep_train_step
+
+        cfg = self.cfg()
+        tokens = self.tokens()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ref, ref_m = loss_and_metrics(params, tokens, cfg)
+        init_fn, step_fn = make_ep_train_step(self.mesh(), cfg)
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss, m = step_fn(p, o, tokens)
+        assert float(loss) == pytest.approx(float(ref), rel=2e-5)
+        assert float(m["balance_loss"]) == pytest.approx(
+            float(ref_m["balance_loss"]), abs=1e-4)
+        frac = np.asarray(m["expert_fraction"])
+        np.testing.assert_allclose(frac.sum(), 1.0, rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_capacity_drop_path_trains(self):
+        from tpu_autoscaler.workloads.moe import make_ep_train_step
+
+        cfg = self.cfg(moe_capacity_factor=1.0)
+        tokens = self.tokens()
+        init_fn, step_fn = make_ep_train_step(self.mesh(), cfg)
+        p, o = init_fn(jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(6):
+            p, o, loss, m = step_fn(p, o, tokens)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_expert_weights_and_moments_shard(self):
+        from tpu_autoscaler.workloads.moe import make_ep_train_step
+
+        cfg = self.cfg()
+        init_fn, _ = make_ep_train_step(self.mesh(), cfg)
+        p, o = init_fn(jax.random.PRNGKey(0))
+        w1 = p["blocks"]["w1"]
+        # 8 experts over ep=4 -> 2 local experts on the expert dim.
+        assert w1.sharding.shard_shape(w1.shape)[1] == 2
+        mu_w1 = o[0].mu["blocks"]["w1"]
+        assert mu_w1.sharding.shard_shape(mu_w1.shape)[1] == 2
+        # Dense weights replicate.
+        qkv = p["blocks"]["qkv"]
+        assert qkv.sharding.shard_shape(qkv.shape) == qkv.shape
+
+    def test_dense_cfg_rejected(self):
+        from tpu_autoscaler.workloads.moe import make_ep_train_step
+
+        with pytest.raises(ValueError, match="moe_experts"):
+            make_ep_train_step(self.mesh(), self.cfg(moe_experts=None))
+
+    def test_indivisible_experts_rejected(self):
+        from tpu_autoscaler.workloads.moe import make_ep_train_step
+
+        with pytest.raises(ValueError, match="not divisible"):
+            make_ep_train_step(self.mesh(dp=2, ep=4),
+                               self.cfg(moe_experts=6))

@@ -204,3 +204,171 @@ class TestPipelineComposition:
         for g, r in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
             np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                        rtol=1e-4, atol=1e-5)
+
+
+class TestPipeline3D:
+    """dp×pp×tp composition: one GPipe step with batch over data and
+    Megatron TP over model (VERDICT r3 item 2)."""
+
+    CFG4 = ModelConfig(vocab=64, d_model=32, n_layers=4, n_heads=4,
+                       d_ff=64, seq_len=16, dtype=jnp.float32)
+
+    def mesh(self, dp=2, pp=2, tp=2):
+        from tpu_autoscaler.workloads.pipeline import make_pipeline_mesh
+
+        return make_pipeline_mesh(jax.devices()[:dp * pp * tp], pp=pp,
+                                  tp=tp)
+
+    def test_split_merge_roundtrip(self):
+        from tpu_autoscaler.workloads.pipeline import (
+            merge_qkv_weights,
+            split_qkv_weights,
+        )
+
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=64, dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        back = merge_qkv_weights(split_qkv_weights(params, cfg), cfg)
+        for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("dp,pp,tp,m", [(2, 2, 2, 2), (1, 2, 4, 4),
+                                            (4, 2, 1, 2)])
+    def test_loss_matches_unpipelined(self, dp, pp, tp, m):
+        from tpu_autoscaler.workloads.pipeline import (
+            make_pipeline3d_loss,
+            split_qkv_weights,
+        )
+
+        cfg = self.CFG4
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(3),
+                                    (8, cfg.seq_len + 1), 0, cfg.vocab,
+                                    dtype=jnp.int32)
+        ref = float(loss_fn(params, tokens, cfg))
+        loss = make_pipeline3d_loss(self.mesh(dp, pp, tp), cfg,
+                                    num_microbatches=m)
+        got = float(loss(split_qkv_weights(params, cfg), tokens))
+        assert got == pytest.approx(ref, rel=2e-5)
+
+    @pytest.mark.slow
+    def test_gqa_loss_matches(self):
+        from tpu_autoscaler.workloads.pipeline import (
+            make_pipeline3d_loss,
+            split_qkv_weights,
+        )
+
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=4, n_heads=4,
+                          n_kv_heads=2, d_ff=64, seq_len=16,
+                          dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = tokens_for(batch=8)
+        ref = float(loss_fn(params, tokens, cfg))
+        loss = make_pipeline3d_loss(self.mesh(2, 2, 2), cfg,
+                                    num_microbatches=2)
+        got = float(loss(split_qkv_weights(params, cfg), tokens))
+        assert got == pytest.approx(ref, rel=2e-5)
+
+    @pytest.mark.slow
+    def test_step_parity_with_dp_tp_step(self):
+        """Leaf-for-leaf: 4 steps of the 2x2x2 pipelined step must land
+        on the same params as the unpipelined dp/tp step."""
+        from tpu_autoscaler.workloads.model import (
+            make_mesh,
+            make_sharded_train_step,
+        )
+        from tpu_autoscaler.workloads.pipeline import (
+            make_pipeline3d_train_step,
+            merge_qkv_weights,
+        )
+
+        cfg = self.CFG4
+        tokens = jax.random.randint(jax.random.PRNGKey(3),
+                                    (8, cfg.seq_len + 1), 0, cfg.vocab,
+                                    dtype=jnp.int32)
+        init3d, step3d = make_pipeline3d_train_step(
+            self.mesh(2, 2, 2), cfg, num_microbatches=2)
+        p, o = init3d(jax.random.PRNGKey(0))
+        losses3d = []
+        for _ in range(4):
+            p, o, loss = step3d(p, o, tokens)
+            losses3d.append(float(loss))
+
+        ref_mesh = make_mesh(jax.devices()[:4], tp=2)
+        init_r, step_r = make_sharded_train_step(ref_mesh, cfg)
+        pr, orr = init_r(jax.random.PRNGKey(0))
+        ref_losses = []
+        for _ in range(4):
+            pr, orr, loss = step_r(pr, orr, tokens)
+            ref_losses.append(float(loss))
+        np.testing.assert_allclose(losses3d, ref_losses, rtol=1e-4)
+        merged = merge_qkv_weights(p, cfg)
+        flat_a = jax.tree_util.tree_flatten_with_path(merged)[0]
+        flat_b = jax.tree_util.tree_flatten_with_path(pr)[0]
+        for (path_a, a), (path_b, b) in zip(flat_a, flat_b):
+            assert path_a == path_b
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5,
+                err_msg=str(path_a))
+
+    def test_params_shard_over_model_and_pp(self):
+        from tpu_autoscaler.workloads.pipeline import (
+            make_pipeline3d_train_step,
+        )
+
+        init_fn, _ = make_pipeline3d_train_step(
+            self.mesh(2, 2, 2), self.CFG4, num_microbatches=2)
+        params, opt = init_fn(jax.random.PRNGKey(0))
+        wq = params["blocks"]["wq"]
+        # 4 layers over 2 stages; the head dim [h*hd=32] halved over
+        # model; d intact.
+        assert wq.sharding.shard_shape(wq.shape) == (2, 32, 16)
+        w2 = params["blocks"]["w2"]
+        assert w2.sharding.shard_shape(w2.shape) == (2, 32, 32)
+        mu_wq = opt[0].mu["blocks"]["wq"]
+        assert mu_wq.sharding.shard_shape(mu_wq.shape) == (2, 32, 16)
+
+    def test_rejects_moe_and_indivisible(self):
+        import dataclasses as dc
+
+        from tpu_autoscaler.workloads.pipeline import make_pipeline3d_loss
+
+        with pytest.raises(ValueError, match="MoE"):
+            make_pipeline3d_loss(
+                self.mesh(2, 2, 2),
+                dc.replace(self.CFG4, moe_experts=4), num_microbatches=2)
+        with pytest.raises(ValueError, match="heads"):
+            make_pipeline3d_loss(
+                self.mesh(1, 2, 4),
+                dc.replace(self.CFG4, n_heads=2), num_microbatches=2)
+
+    def test_train_step_dispatches_on_3axis_mesh(self):
+        init_fn, step_fn = make_pipeline_train_step(
+            self.mesh(2, 2, 2), self.CFG4, num_microbatches=2)
+        p, o = init_fn(jax.random.PRNGKey(0))
+        assert "wq" in p["blocks"] and "qkv" not in p["blocks"]
+        tokens = jax.random.randint(jax.random.PRNGKey(3),
+                                    (8, self.CFG4.seq_len + 1), 0,
+                                    self.CFG4.vocab, dtype=jnp.int32)
+        p, o, loss = step_fn(p, o, tokens)
+        assert np.isfinite(float(loss))
+
+    @pytest.mark.slow
+    def test_remat_matches_unremat(self):
+        from tpu_autoscaler.workloads.pipeline import (
+            make_pipeline3d_train_step,
+        )
+
+        tokens = jax.random.randint(jax.random.PRNGKey(3),
+                                    (8, self.CFG4.seq_len + 1), 0,
+                                    self.CFG4.vocab, dtype=jnp.int32)
+        losses = {}
+        for remat in (False, True):
+            init_fn, step_fn = make_pipeline3d_train_step(
+                self.mesh(2, 2, 2), self.CFG4, num_microbatches=2,
+                remat=remat)
+            p, o = init_fn(jax.random.PRNGKey(0))
+            for _ in range(3):
+                p, o, loss = step_fn(p, o, tokens)
+            losses[remat] = float(loss)
+        assert losses[False] == pytest.approx(losses[True], rel=1e-5)

@@ -201,3 +201,75 @@ class TestSpTrainStep:
     def test_bad_mesh_rejected(self):
         with pytest.raises(ValueError, match="divisible"):
             make_sp_mesh(jax.devices()[:6], sp=4)
+
+
+class TestSpTpComposition:
+    """sp×tp: heads/d_ff Megatron-sharded over 'model' inside the sp
+    train step (VERDICT r3 item 3)."""
+
+    def test_three_step_parity_with_unsharded(self):
+        tokens = tokens_for()
+        mesh = make_sp_mesh(jax.devices(), sp=2, tp=2)  # data 2 sp 2 tp 2
+        assert dict(mesh.shape) == {"data": 2, "sp": 2, "model": 2}
+        init_fn, step_fn = make_sp_train_step(mesh, CFG, impl="einsum")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(3):
+            p, o, loss = step_fn(p, o, tokens)
+            losses.append(float(loss))
+        ref, ref_p = ref_losses_and_params(CFG, tokens)
+        np.testing.assert_allclose(losses, ref, rtol=1e-4)
+        for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(ref_p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-5)
+
+    @pytest.mark.slow
+    def test_ulysses_under_tp_parity(self):
+        # Local heads after tp=2: 4/2 q, 2/2 kv — kv_loc=1 equals MQA
+        # locally; sp must divide local heads so sp=1... use n_heads=8.
+        cfg = dc.replace(CFG, n_heads=8, n_kv_heads=4, d_model=64)
+        tokens = tokens_for()
+        mesh = make_sp_mesh(jax.devices(), sp=2, tp=2)
+        init_fn, step_fn = make_sp_train_step(mesh, cfg, impl="ulysses")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss = step_fn(p, o, tokens)
+        ref, _ = ref_losses_and_params(cfg, tokens, steps=1)
+        assert float(loss) == pytest.approx(ref[0], rel=1e-4)
+
+    @pytest.mark.slow
+    def test_zero1_under_tp(self):
+        tokens = tokens_for()
+        mesh = make_sp_mesh(jax.devices(), sp=2, tp=2)
+        init_fn, step_fn = make_sp_train_step(mesh, CFG, impl="einsum",
+                                              shard="zero1")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss = step_fn(p, o, tokens)
+        ref, _ = ref_losses_and_params(CFG, tokens, steps=1)
+        assert float(loss) == pytest.approx(ref[0], rel=1e-4)
+        # Moments sliced over the data axes (params stay replicated).
+        mu_emb = o[0].mu["embed"]
+        shard = mu_emb.sharding.shard_shape(mu_emb.shape)
+        assert shard[0] < mu_emb.shape[0]
+
+    def test_window_gqa_under_tp(self):
+        cfg = dc.replace(CFG, attention_window=16)
+        tokens = tokens_for()
+        mesh = make_sp_mesh(jax.devices(), sp=2, tp=2)
+        init_fn, step_fn = make_sp_train_step(mesh, cfg, impl="einsum")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss = step_fn(p, o, tokens)
+        ref, _ = ref_losses_and_params(cfg, tokens, steps=1)
+        assert float(loss) == pytest.approx(ref[0], rel=1e-4)
+
+    def test_indivisible_heads_rejected(self):
+        cfg = dc.replace(CFG, n_heads=3, n_kv_heads=3, d_model=48)
+        with pytest.raises(ValueError, match="heads divisible"):
+            make_sp_train_step(make_sp_mesh(jax.devices(), sp=2, tp=2),
+                               cfg)
+
+    def test_ulysses_local_head_divisibility_rejected(self):
+        # h=4/tp=2 -> 2 local heads; kv 2/2=1 local kv; sp=2 needs
+        # kv_loc % sp == 0 -> rejected.
+        with pytest.raises(ValueError, match="per-TP-rank heads"):
+            make_sp_train_step(make_sp_mesh(jax.devices(), sp=2, tp=2),
+                               CFG, impl="ulysses")

@@ -33,8 +33,20 @@ from tpu_autoscaler.workloads.decode import (
     make_sharded_generate,
     prefill,
 )
-from tpu_autoscaler.workloads.pipeline import make_pipeline_train_step
+from tpu_autoscaler.workloads.pipeline import (
+    make_pipeline3d_train_step,
+    make_pipeline_mesh,
+    make_pipeline_train_step,
+    merge_qkv_weights,
+    split_qkv_weights,
+)
 from tpu_autoscaler.workloads.sp import make_sp_mesh, make_sp_train_step
+from tpu_autoscaler.workloads.moe import make_ep_mesh, make_ep_train_step
+from tpu_autoscaler.workloads.serving import (
+    ContinuousBatcher,
+    Request,
+    SlotKVCache,
+)
 from tpu_autoscaler.workloads.checkpoint import (
     DrainWatcher,
     restore_checkpoint,
@@ -42,23 +54,32 @@ from tpu_autoscaler.workloads.checkpoint import (
 )
 
 __all__ = [
+    "ContinuousBatcher",
     "DrainWatcher",
     "KVCache",
     "ModelConfig",
+    "Request",
+    "SlotKVCache",
     "TrainConfig",
     "decode_step",
     "forward",
     "generate",
     "init_params",
     "loss_fn",
+    "make_ep_mesh",
+    "make_ep_train_step",
     "make_mesh",
     "make_optimizer",
+    "make_pipeline3d_train_step",
+    "make_pipeline_mesh",
     "make_pipeline_train_step",
     "make_sharded_generate",
     "make_sp_mesh",
     "make_sp_train_step",
     "make_sharded_train_step",
+    "merge_qkv_weights",
     "prefill",
     "restore_checkpoint",
     "save_checkpoint",
+    "split_qkv_weights",
 ]

@@ -310,7 +310,7 @@ def moe_ffn(y: jax.Array, layer: dict, cfg: ModelConfig):
 
 
 def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
-           mesh: Mesh | None = None) -> jax.Array:
+           mesh: Mesh | None = None, ffn=None) -> jax.Array:
     """One transformer block; x: [batch, seq, d_model] in compute dtype.
 
     Returns ``(x, aux)`` where aux holds the MoE router losses (zeros
@@ -319,7 +319,12 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
     ``mesh``: when given and multi-device, the Pallas attention path runs
     through shard_map (batch over the non-'model' axes, heads over
     'model') so the fused kernel composes with the pjit-sharded step —
-    see make_sharded_flash_attention."""
+    see make_sharded_flash_attention.
+
+    ``ffn``: optional hook replacing the FFN half: ``ffn(y, layer) ->
+    (out, aux)`` on the post-ln2 activations.  Keeps the attention path
+    single-sourced for steps that only swap the FFN (the expert-parallel
+    train step routes through here with its all_to_all dispatch)."""
     b, s, d = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
 
@@ -391,7 +396,10 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
                        layer["attn_out"].astype(cfg.dtype))
 
     y = _rmsnorm(x, layer["ln2"])
-    if cfg.moe_experts is None:
+    if ffn is not None:
+        ffn_out, aux = ffn(y, layer)
+        x = x + ffn_out
+    elif cfg.moe_experts is None:
         hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
         hdn = jax.nn.gelu(hdn)
         x = x + jnp.einsum("bsf,fd->bsd", hdn,

@@ -214,3 +214,202 @@ def make_moe_layer(mesh: Mesh, cfg: MoeConfig, ep_axis: str = "ep",
     return jax.shard_map(local_apply, mesh=mesh,
                          in_specs=(p_specs, P(ep_axis, None)),
                          out_specs=out_specs)
+
+
+def make_ep_mesh(devices=None, ep: int | None = None):
+    """(data, ep) mesh for expert-parallel training: the batch shards
+    over BOTH axes (every device is data-parallel for the dense ops);
+    ``ep`` is additionally the expert-exchange axis for the MoE blocks.
+    """
+    import numpy as np
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if ep is None:
+        ep = n
+    if n % ep:
+        raise ValueError(f"{n} devices not divisible by ep={ep}")
+    arr = np.asarray(devices).reshape(n // ep, ep)
+    return Mesh(arr, axis_names=("data", "ep"))
+
+
+def _ep_moe_ffn(y, layer, cfg, ep_axis: str, ep: int):
+    """Expert-parallel MoE FFN on this device's token pool: route over
+    the LOCAL pool (capacity = capacity_factor·n_loc·k/E, pool-level
+    GShard semantics, vs model.moe_ffn's per-row dispatch), all_to_all
+    to the expert owners, local expert MLPs, all_to_all back,
+    gate-weighted combine.  Returns (out, aux)."""
+    b, s, d = y.shape
+    n_loc = b * s
+    e, k = cfg.moe_experts, cfg.moe_top_k
+    e_loc = e // ep
+    cap = max(1, int(cfg.moe_capacity_factor * n_loc * k / e))
+    flat = y.reshape(n_loc, d)
+    logits = jnp.einsum(
+        "nd,de->ne", flat, layer["router"].astype(cfg.dtype)
+    ).astype(jnp.float32)
+    expert, rank, gate, keep, aux = route_topk(logits, k, cap)
+
+    safe_rank = jnp.where(keep, rank, 0)
+    dispatch = jnp.zeros((e, cap, d), flat.dtype)
+    for c in range(k):
+        dispatch = dispatch.at[expert[:, c], safe_rank[:, c]].add(
+            jnp.where(keep[:, c, None], flat, 0.0))
+
+    buckets = dispatch.reshape(ep, e_loc, cap, d)
+    received = jax.lax.all_to_all(buckets, ep_axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+    w1 = layer["w1"].astype(cfg.dtype)   # local [e_loc, d, f]
+    w2 = layer["w2"].astype(cfg.dtype)
+    h = jax.nn.gelu(jnp.einsum("seCd,edf->seCf", received, w1))
+    expert_out = jnp.einsum("seCf,efd->seCd", h, w2)
+    returned = jax.lax.all_to_all(expert_out, ep_axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+    combined = returned.reshape(e, cap, d)
+    out = jnp.zeros_like(flat)
+    for c in range(k):
+        o = combined[expert[:, c], safe_rank[:, c]]
+        out = out + jnp.where(keep[:, c, None],
+                              gate[:, c, None].astype(o.dtype) * o, 0.0)
+    return out.reshape(b, s, d), aux
+
+
+def make_ep_train_step(mesh: Mesh, cfg, *, train=None,
+                       learning_rate: float = 1e-3,
+                       data_axis: str = "data", ep_axis: str = "ep"):
+    """Build (init_fn, step_fn) for dp×ep MoE training: the flagship
+    model (cfg.moe_experts set) with expert weights sharded over
+    ``ep_axis`` and the batch over BOTH mesh axes, in one jitted step.
+
+    step_fn: (params, opt_state, tokens [b, s+1]) ->
+    (params, opt_state, loss, metrics) — metrics carries the
+    mesh-averaged ``balance_loss`` / ``z_loss`` and the global
+    ``expert_fraction`` histogram (layer-meaned), the observability a
+    trainable MoE needs.  Dense (non-expert) params replicate; expert
+    w1/w2 (and their optimizer moments) shard on the expert dim, so
+    per-device expert HBM drops by the ep degree — the lever that
+    scales expert count past one chip.
+
+    Routing uses pool-level capacity over each device's local tokens
+    (GShard semantics); with ample ``moe_capacity_factor`` no token
+    drops and the loss equals model.loss_fn's per-row-dispatch MoE
+    exactly (tests pin it).
+    """
+    from tpu_autoscaler.workloads.model import (
+        ModelConfig,
+        TrainConfig,
+        _block,
+        _rmsnorm,
+        init_params,
+        make_optimizer,
+        opt_state_shardings,
+    )
+
+    assert isinstance(cfg, ModelConfig)
+    if cfg.moe_experts is None:
+        raise ValueError("make_ep_train_step needs cfg.moe_experts set")
+    ep = mesh.shape[ep_axis]
+    if cfg.moe_experts % ep:
+        raise ValueError(
+            f"{cfg.moe_experts} experts not divisible by the {ep_axis} "
+            f"axis ({ep})")
+    if train is None:
+        train = TrainConfig(learning_rate=learning_rate)
+    optimizer = make_optimizer(train)
+
+    def ep_ffn(y, layer):
+        out, aux = _ep_moe_ffn(y, layer, cfg, ep_axis, ep)
+        return out, {"balance_loss": aux["balance_loss"],
+                     "z_loss": aux["z_loss"],
+                     "expert_fraction": aux["expert_fraction"]}
+
+    def block(x, layer):
+        """model._block's attention path untouched (mesh=None: we are
+        inside shard_map, attention is device-local) with the FFN half
+        replaced by the expert-parallel dispatch via the ffn hook."""
+        return _block(x, layer, cfg, mesh=None, ffn=ep_ffn)
+
+    blk = jax.checkpoint(block) if cfg.remat else block
+
+    def local_loss(params, inputs, targets):
+        x = params["embed"].astype(cfg.dtype)[inputs]
+
+        def body(x, layer):
+            x, aux = blk(x, layer)
+            return x, aux
+
+        x, aux_stacked = jax.lax.scan(body, x, params["blocks"])
+        aux = jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stacked)
+        x = _rmsnorm(x, params["ln_f"])
+        b_loc, s_loc = inputs.shape
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["unembed"].astype(cfg.dtype)
+                            ).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        local_sum = jnp.sum(
+            -jnp.take_along_axis(logp, targets[..., None], axis=-1))
+        total = jax.lax.psum(local_sum, (data_axis, ep_axis))
+        n_tok = (b_loc * s_loc * jax.lax.psum(1, data_axis)
+                 * jax.lax.psum(1, ep_axis))
+        ce = total / n_tok
+        # Mesh-wide aux: mean over every device's local routing stats.
+        aux = jax.tree.map(
+            lambda a: jax.lax.pmean(a, (data_axis, ep_axis)), aux)
+        loss = (ce + cfg.moe_balance_weight * aux["balance_loss"]
+                + cfg.moe_z_weight * aux["z_loss"])
+        return loss, {"ce": ce, **aux}
+
+    p_specs = {
+        "embed": P(None, None),
+        "blocks": {
+            "qkv": P(None, None, None),
+            "attn_out": P(None, None, None),
+            "router": P(None, None, None),
+            "w1": P(None, ep_axis, None, None),
+            "w2": P(None, ep_axis, None, None),
+            "ln1": P(None, None), "ln2": P(None, None),
+        },
+        "ln_f": P(None),
+        "unembed": P(None, None),
+    }
+    tok_spec = P((data_axis, ep_axis), None)
+    metric_specs = {"ce": P(), "balance_loss": P(), "z_loss": P(),
+                    "expert_fraction": P()}
+    sharded_loss = jax.shard_map(
+        local_loss, mesh=mesh,
+        in_specs=(p_specs, tok_spec, tok_spec),
+        out_specs=(P(), metric_specs), check_vma=False)
+
+    def loss(params, tokens):
+        return sharded_loss(params, tokens[:, :-1], tokens[:, 1:])
+
+    def init(key):
+        params = init_params(key, cfg)
+        return params, optimizer.init(params)
+
+    def step(params, opt_state, tokens):
+        import optax
+
+        (loss_val, metrics), grads = jax.value_and_grad(
+            loss, has_aux=True)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss_val, metrics
+
+    from jax.sharding import NamedSharding
+
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), p_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    replicated = NamedSharding(mesh, P())
+    batch_shard = NamedSharding(mesh, P((data_axis, ep_axis), None))
+    metric_shard = {k: replicated for k in metric_specs}
+    o_shard = opt_state_shardings(cfg, optimizer, p_specs, mesh, False)
+    init_jit = jax.jit(init, out_shardings=(p_shard, o_shard))
+    step_jit = jax.jit(
+        step,
+        in_shardings=(p_shard, o_shard, batch_shard),
+        out_shardings=(p_shard, o_shard, replicated, metric_shard),
+        donate_argnums=(0, 1),
+    )
+    return init_jit, step_jit

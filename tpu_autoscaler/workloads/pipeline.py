@@ -23,6 +23,18 @@ GPipe-with-remat recipe.
 Autoscaler relevance: a pp×dp job spans whole slices with the pp ring on
 ICI — another communication pattern that must never be bisected, which is
 why drains operate on whole slices.
+
+3-axis composition (dp×pp×tp): pass a mesh carrying ``data`` and
+``model`` axes alongside ``pp`` and the same GPipe schedule runs with
+the batch sharded over ``data`` and every stage's layer weights
+Megatron-sharded over ``model`` (column-parallel qkv/w1, row-parallel
+attn_out/w2, one psum per half-block riding ICI).  Because the standard
+pytree packs q|k|v on one output dim — whose contiguous ``model``
+chunks would NOT align with whole attention heads — the 3-axis step
+trains on a split-weight pytree (``wq``/``wk``/``wv``; see
+split_qkv_weights) so the TP shards hold whole GQA groups with zero
+extra collectives.  Converters to/from the standard pytree keep
+checkpoints interchangeable.
 """
 
 from __future__ import annotations
@@ -31,6 +43,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -40,6 +53,7 @@ from tpu_autoscaler.workloads.model import (
     TrainConfig,
     _block,
     _rmsnorm,
+    _rope,
     make_optimizer,
 )
 
@@ -56,6 +70,121 @@ def _stage_forward(blocks: dict, x: jax.Array, cfg: ModelConfig):
 
     x, aux_stacked = jax.lax.scan(body, x, blocks)
     return x, jax.tree.map(jnp.mean, aux_stacked)
+
+
+def split_qkv_weights(params: dict, cfg: ModelConfig) -> dict:
+    """Standard pytree -> the 3-axis pipeline's split-weight pytree.
+
+    blocks.qkv [L, d, d + 2·hkv·hd] splits at the q|k|v packing
+    boundaries (model._split_qkv's single source of truth) into
+    wq [L, d, h·hd], wk/wv [L, d, hkv·hd] so each weight's output dim
+    is pure heads and a contiguous ``model`` shard holds whole GQA
+    groups.  Pure reshape/split — invertible bit-for-bit
+    (merge_qkv_weights), so checkpoints convert either way."""
+    d, hkv, hd = cfg.d_model, cfg.kv_heads, cfg.head_dim
+    blocks = dict(params["blocks"])
+    wq, wk, wv = jnp.split(blocks.pop("qkv"), [d, d + hkv * hd], axis=-1)
+    blocks.update(wq=wq, wk=wk, wv=wv)
+    return {**params, "blocks": blocks}
+
+
+def merge_qkv_weights(params3d: dict, cfg: ModelConfig) -> dict:
+    """Inverse of split_qkv_weights: repack wq|wk|wv into blocks.qkv."""
+    blocks = dict(params3d["blocks"])
+    qkv = jnp.concatenate(
+        [blocks.pop("wq"), blocks.pop("wk"), blocks.pop("wv")], axis=-1)
+    blocks["qkv"] = qkv
+    return {**params3d, "blocks": blocks}
+
+
+def pipeline3d_param_specs(cfg: ModelConfig, pp_axis: str = "pp",
+                           model_axis: str = "model") -> dict:
+    """PartitionSpecs for the SPLIT-WEIGHT pytree under pp×tp: blocks
+    shard over ``pp_axis`` on the layer dim and over ``model_axis``
+    Megatron-style (wq/wk/wv/w1 column-parallel, attn_out/w2
+    row-parallel); embed/unembed/ln replicate (model.param_specs:638's
+    TP pattern, with the layer dim in front)."""
+    return {
+        "embed": P(None, None),
+        "blocks": {
+            "wq": P(pp_axis, None, model_axis),
+            "wk": P(pp_axis, None, model_axis),
+            "wv": P(pp_axis, None, model_axis),
+            "attn_out": P(pp_axis, model_axis, None),
+            "w1": P(pp_axis, None, model_axis),
+            "w2": P(pp_axis, model_axis, None),
+            "ln1": P(pp_axis, None),
+            "ln2": P(pp_axis, None),
+        },
+        "ln_f": P(None),
+        "unembed": P(None, None),
+    }
+
+
+def _tp_block(x: jax.Array, layer: dict, cfg: ModelConfig, *,
+              model_axis: str, tp: int):
+    """One transformer block on this TP rank's head/d_ff shard —
+    model._block's math (the parity oracle) with the Megatron split
+    made explicit for shard_map: q/k/v projections are column-parallel
+    (this rank holds n_heads/tp query heads = whole GQA groups),
+    attention runs entirely locally, and the two row-parallel output
+    projections each finish with one psum over ``model_axis``."""
+    b, s, d = x.shape
+    h_loc = cfg.n_heads // tp
+    hkv_loc = cfg.kv_heads // tp
+    hd = cfg.head_dim
+
+    y = _rmsnorm(x, layer["ln1"])
+    q = jnp.einsum("bsd,de->bse", y, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,de->bse", y, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,de->bse", y, layer["wv"].astype(cfg.dtype))
+    q = q.reshape(b, s, h_loc, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, hkv_loc, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, hkv_loc, hd).transpose(0, 2, 1, 3)
+    if cfg.rope:
+        q = _rope(q, cfg.rope_theta)
+        k = _rope(k, cfg.rope_theta)
+
+    if cfg.resolved_attention() == "pallas":
+        from tpu_autoscaler.workloads.attention import flash_attention
+
+        attn = flash_attention(
+            q, k, v, causal=True, window=cfg.attention_window,
+            block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+            interpret=jax.default_backend() != "tpu")
+    else:
+        from tpu_autoscaler.workloads.attention import causal_band_mask
+
+        qg = q.reshape(b, hkv_loc, h_loc // hkv_loc, s, hd)
+        scores = jnp.einsum("bngqd,bnkd->bngqk", qg, k) / np.sqrt(hd)
+        causal = causal_band_mask(s, cfg.attention_window)
+        scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bngqk,bnkd->bngqd", probs, v).reshape(
+            b, h_loc, s, hd)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h_loc * hd)
+    # Row-parallel output projection: this rank's rows are exactly its
+    # heads' slice of attn_out; psum completes the full-d sum.
+    out = jnp.einsum("bse,ed->bsd", attn,
+                     layer["attn_out"].astype(cfg.dtype))
+    x = x + jax.lax.psum(out, model_axis)
+
+    y = _rmsnorm(x, layer["ln2"])
+    hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
+    hdn = jax.nn.gelu(hdn)
+    out = jnp.einsum("bsf,fd->bsd", hdn, layer["w2"].astype(cfg.dtype))
+    return x + jax.lax.psum(out, model_axis)
+
+
+def _tp_stage_forward(blocks: dict, x: jax.Array, cfg: ModelConfig,
+                      model_axis: str, tp: int):
+    """Run THIS stage's layer stack under TP (dense blocks only)."""
+
+    def body(x, layer):
+        return _tp_block(x, layer, cfg, model_axis=model_axis, tp=tp), None
+
+    x, _ = jax.lax.scan(body, x, blocks)
+    return x
 
 
 def pipeline_param_specs(cfg: ModelConfig, pp_axis: str = "pp") -> dict:
@@ -76,6 +205,179 @@ def pipeline_param_specs(cfg: ModelConfig, pp_axis: str = "pp") -> dict:
     }
     return {"embed": P(None, None), "blocks": block_specs,
             "ln_f": P(None), "unembed": P(None, None)}
+
+
+def make_pipeline_mesh(devices=None, pp: int = 2, tp: int = 1) -> Mesh:
+    """(data, pp, model) mesh: batch over ``data``, stages over ``pp``,
+    Megatron TP over ``model``; dp takes the rest of the devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % (pp * tp):
+        raise ValueError(f"{n} devices not divisible by pp*tp = {pp * tp}")
+    arr = np.asarray(devices).reshape(n // (pp * tp), pp, tp)
+    return Mesh(arr, axis_names=("data", "pp", "model"))
+
+
+def make_pipeline3d_loss(mesh: Mesh, cfg: ModelConfig,
+                         num_microbatches: int, pp_axis: str = "pp",
+                         data_axis: str = "data",
+                         model_axis: str = "model",
+                         remat: bool = False):
+    """Build ``loss(params3d, tokens)`` pipelined over ``pp_axis`` with
+    the batch sharded over ``data_axis`` and the stage weights
+    Megatron-sharded over ``model_axis`` — the dp×pp×tp composition.
+
+    params3d: the SPLIT-WEIGHT pytree (split_qkv_weights).  tokens:
+    [batch, seq+1] int32, batch divisible by dp·num_microbatches.
+    Dense blocks only (MoE routing composes with ep, not tp-inside-pp).
+    """
+    n_stages = mesh.shape[pp_axis]
+    tp = mesh.shape[model_axis]
+    dp = mesh.shape[data_axis]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"{cfg.n_layers} layers not divisible by {n_stages} stages")
+    if cfg.n_heads % tp or cfg.kv_heads % tp:
+        raise ValueError(
+            f"heads ({cfg.n_heads} q / {cfg.kv_heads} kv) must divide by "
+            f"the {model_axis} axis ({tp})")
+    if cfg.d_ff % tp:
+        raise ValueError(
+            f"d_ff ({cfg.d_ff}) must divide by the {model_axis} axis "
+            f"({tp})")
+    if cfg.moe_experts is not None:
+        raise ValueError(
+            "MoE blocks are not supported in the tp-composed pipeline; "
+            "use the pp-only pipeline or the dp/ep step")
+
+    param_specs = pipeline3d_param_specs(cfg, pp_axis, model_axis)
+    stage_fwd = functools.partial(_tp_stage_forward, cfg=cfg,
+                                  model_axis=model_axis, tp=tp)
+    if remat:
+        stage_fwd = jax.checkpoint(stage_fwd)
+
+    def local_loss(params, tokens):
+        idx = jax.lax.axis_index(pp_axis)
+        m = num_microbatches
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        b_loc, s = inputs.shape
+        if b_loc % m:
+            raise ValueError(
+                f"per-data-shard batch {b_loc} not divisible by "
+                f"{m} microbatches")
+        mb = b_loc // m
+        x_mb = inputs.reshape(m, mb, s)
+
+        embedded = params["embed"].astype(cfg.dtype)[x_mb]  # [m, mb, s, d]
+        d = embedded.shape[-1]
+        zeros = jnp.zeros((mb, s, d), cfg.dtype)
+
+        def tick(t, carry):
+            buf, outs = carry
+            ingest = jax.lax.dynamic_index_in_dim(
+                embedded, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+            x_in = jnp.where(idx == 0, ingest, buf)
+            y = stage_fwd(params["blocks"], x_in)
+            out_t = t - (n_stages - 1)
+            valid = jnp.logical_and(out_t >= 0, out_t < m)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                outs, y.astype(outs.dtype), jnp.clip(out_t, 0, m - 1),
+                axis=0)
+            outs = jnp.where(valid, banked, outs)
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            buf = jax.lax.ppermute(y, pp_axis, perm)
+            return buf, outs
+
+        buf0 = pvary(zeros, pp_axis)
+        outs0 = pvary(jnp.zeros((m, mb, s, d), cfg.dtype), pp_axis)
+        _, outs = jax.lax.fori_loop(
+            0, m + n_stages - 1, tick, (buf0, outs0))
+
+        # Loss on the last stage; psum over (pp, data) shares the same
+        # scalar with the whole mesh.  Model ranks hold replicated
+        # activations after the forward psums, so no reduction over
+        # model (it would multiply by tp).
+        h = _rmsnorm(outs.reshape(m * mb, s, d), params["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", h,
+                            params["unembed"].astype(cfg.dtype))
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets.reshape(m * mb, s)[..., None], axis=-1)
+        local = jnp.where(idx == n_stages - 1, jnp.mean(nll), 0.0)
+        return jax.lax.psum(local, (pp_axis, data_axis)) / dp
+
+    sharded = jax.shard_map(
+        local_loss, mesh=mesh,
+        in_specs=(param_specs, P(data_axis, None)), out_specs=P(),
+        check_vma=False)
+
+    def loss(params3d, tokens):
+        return sharded(params3d, tokens)
+
+    return loss
+
+
+def make_pipeline3d_train_step(mesh: Mesh, cfg: ModelConfig,
+                               num_microbatches: int, pp_axis: str = "pp",
+                               data_axis: str = "data",
+                               model_axis: str = "model",
+                               learning_rate: float = 1e-3,
+                               train: TrainConfig | None = None,
+                               remat: bool = True):
+    """Build (init_fn, step_fn) for dp×pp×tp training: GPipe over
+    ``pp_axis``, batch over ``data_axis``, Megatron TP over
+    ``model_axis``, all in ONE jitted step over ``mesh``.
+
+    step_fn: (params3d, opt_state, tokens) -> (params3d, opt_state,
+    loss) on the split-weight pytree; convert standard checkpoints with
+    split_qkv_weights / merge_qkv_weights.  Loss matches the
+    unpipelined dp/tp step leaf-for-leaf (tests pin it).  The
+    trainer's optimizer recipe applies unchanged — grads arrive under
+    the pp×tp shardings with the data-axis psum already inserted by AD.
+    """
+    if train is None:
+        train = TrainConfig(learning_rate=learning_rate)
+    optimizer = make_optimizer(train)
+    loss_fn = make_pipeline3d_loss(
+        mesh, cfg, num_microbatches, pp_axis, data_axis, model_axis,
+        remat=remat)
+    from tpu_autoscaler.workloads.model import (
+        _opt_state_shardings,
+        init_params,
+    )
+
+    p_specs = pipeline3d_param_specs(cfg, pp_axis, model_axis)
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), p_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    replicated = NamedSharding(mesh, P())
+    batch_shard = NamedSharding(mesh, P(data_axis, None))
+
+    def init(key):
+        params = split_qkv_weights(init_params(key, cfg), cfg)
+        return params, optimizer.init(params)
+
+    abstract3d = jax.eval_shape(
+        lambda k: split_qkv_weights(init_params(k, cfg), cfg),
+        jax.random.PRNGKey(0))
+    o_shard = _opt_state_shardings(optimizer, abstract3d, p_specs, mesh,
+                                   False)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    init_jit = jax.jit(init, out_shardings=(p_shard, o_shard))
+    step_jit = jax.jit(
+        step,
+        in_shardings=(p_shard, o_shard, batch_shard),
+        out_shardings=(p_shard, o_shard, replicated),
+        donate_argnums=(0, 1),
+    )
+    return init_jit, step_jit
 
 
 def make_pipeline_loss(mesh: Mesh, cfg: ModelConfig,
@@ -207,7 +509,24 @@ def make_pipeline_train_step(mesh: Mesh, cfg: ModelConfig,
     schedules, clipping and accumulation all apply unchanged because
     they act on the (stage-sharded) grads elementwise or via a global
     norm XLA computes with a cross-stage psum.
+
+    A mesh carrying ``data``/``model`` axes alongside ``pp`` routes to
+    the dp×pp×tp step (make_pipeline3d_train_step) — note its
+    init_fn/step_fn work on the split-weight pytree.
     """
+    if len(mesh.axis_names) > 1:
+        others = [a for a in mesh.axis_names if a != pp_axis]
+        if pp_axis not in mesh.axis_names or len(others) != 2:
+            raise ValueError(
+                f"pipeline meshes are either ({pp_axis!r},) or 3-axis "
+                f"(data, {pp_axis!r}, model); got {mesh.axis_names} "
+                "(make_pipeline_mesh builds the 3-axis form)")
+        model_axis = "model" if "model" in others else others[-1]
+        others.remove(model_axis)
+        return make_pipeline3d_train_step(
+            mesh, cfg, num_microbatches, pp_axis,
+            data_axis=others[0], model_axis=model_axis,
+            learning_rate=learning_rate, train=train, remat=remat)
     from tpu_autoscaler.workloads.model import (
         init_params,
         opt_state_shardings,

@@ -56,29 +56,76 @@ from tpu_autoscaler.workloads.ring_attention import (
 )
 
 
-def make_sp_mesh(devices=None, sp: int | None = None) -> Mesh:
+def make_sp_mesh(devices=None, sp: int | None = None,
+                 tp: int = 1) -> Mesh:
     """(data, sp) mesh: batch over ``data``, sequence over ``sp``.
 
     sp defaults to all devices (pure context parallelism); pass a
-    divisor for hybrid data x context parallelism."""
+    divisor for hybrid data x context parallelism.  ``tp > 1`` appends
+    a ``model`` axis — (data, sp, model) — for the sp×tp composition:
+    attention heads and d_ff Megatron-shard over ``model`` inside the
+    sp train step (see make_sp_train_step)."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if sp is None:
-        sp = n
-    if n % sp:
-        raise ValueError(f"{n} devices not divisible by sp={sp}")
-    arr = np.asarray(devices).reshape(n // sp, sp)
-    return Mesh(arr, axis_names=("data", "sp"))
+        sp = n // tp
+    if n % (sp * tp):
+        raise ValueError(
+            f"{n} devices not divisible by sp*tp = {sp * tp}")
+    if tp == 1:
+        arr = np.asarray(devices).reshape(n // sp, sp)
+        return Mesh(arr, axis_names=("data", "sp"))
+    arr = np.asarray(devices).reshape(n // (sp * tp), sp, tp)
+    return Mesh(arr, axis_names=("data", "sp", "model"))
+
+
+def _local_qkv(y, layer_qkv, cfg: ModelConfig, model_axis: str | None,
+               tp: int):
+    """This TP rank's q/k/v heads from the packed qkv weight.
+
+    tp == 1 is model._split_qkv exactly.  Under tp the packed q|k|v
+    layout cannot be contiguously column-sharded into whole heads, so
+    each rank dynamic-slices its own head columns (rank t takes q heads
+    [t·h/tp, (t+1)·h/tp) and the matching GQA kv groups) and projects
+    only those — column-parallel with the slice done on the replicated
+    weight, no collective."""
+    if tp == 1:
+        return _split_qkv(y, layer_qkv, cfg)
+    b, s, d = y.shape
+    h, hd, hkv = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    h_loc, hkv_loc = h // tp, hkv // tp
+    t = jax.lax.axis_index(model_axis)
+    w = layer_qkv.astype(cfg.dtype)
+    wq = jax.lax.dynamic_slice_in_dim(w, t * h_loc * hd, h_loc * hd, 1)
+    wk = jax.lax.dynamic_slice_in_dim(
+        w, d + t * hkv_loc * hd, hkv_loc * hd, 1)
+    wv = jax.lax.dynamic_slice_in_dim(
+        w, d + hkv * hd + t * hkv_loc * hd, hkv_loc * hd, 1)
+    q = jnp.einsum("bsd,de->bse", y, wq)
+    k = jnp.einsum("bsd,de->bse", y, wk)
+    v = jnp.einsum("bsd,de->bse", y, wv)
+    q = q.reshape(b, s, h_loc, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, hkv_loc, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, hkv_loc, hd).transpose(0, 2, 1, 3)
+    return q, k, v
 
 
 def _sp_block(x, layer, cfg: ModelConfig, *, seq_axis: str, impl: str,
-              block_q: int, interpret: bool):
+              block_q: int, interpret: bool,
+              model_axis: str | None = None, tp: int = 1):
     """model._block restricted to this device's sequence shard: same
     math (model.py::_block is the parity oracle, pinned in
-    tests/test_sp.py), with the attention mix replaced by the ring."""
+    tests/test_sp.py), with the attention mix replaced by the ring.
+
+    Under sp×tp (``tp > 1``) the heads additionally shard over
+    ``model_axis``: the ring rotates this rank's K/V head subset only
+    (ICI traffic drops by tp), attn_out/w2 run row-parallel with one
+    psum over ``model_axis`` each, and w1 column-parallel — Megatron
+    inside the ring, weights replicated (under sp the ACTIVATIONS are
+    the memory problem; ZeRO-1 shards the moments)."""
     b, s_loc, d = x.shape
     y = _rmsnorm(x, layer["ln1"])
-    q, k, v = _split_qkv(y, layer["qkv"], cfg)
+    q, k, v = _local_qkv(y, layer["qkv"], cfg, model_axis, tp)
     if cfg.rope:
         # Global positions: this shard's tokens sit at offset
         # shard_index * s_loc of the full sequence.
@@ -108,14 +155,34 @@ def _sp_block(x, layer, cfg: ModelConfig, *, seq_axis: str, impl: str,
         attn, _lse = _ring_attn_local(
             q, k, v, axis_name=seq_axis, causal=True,
             window=cfg.attention_window, sm_scale=cfg.head_dim ** -0.5)
-    attn = attn.transpose(0, 2, 1, 3).reshape(b, s_loc, d)
-    x = x + jnp.einsum("bsd,de->bse", attn.astype(cfg.dtype),
-                       layer["attn_out"].astype(cfg.dtype))
+    h_loc = attn.shape[1]
+    hd = cfg.head_dim
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s_loc, h_loc * hd)
+    if tp == 1:
+        x = x + jnp.einsum("bsd,de->bse", attn.astype(cfg.dtype),
+                           layer["attn_out"].astype(cfg.dtype))
+        y = _rmsnorm(x, layer["ln2"])
+        hdn = jnp.einsum("bsd,df->bsf", y,
+                         layer["w1"].astype(cfg.dtype))
+        hdn = jax.nn.gelu(hdn)
+        return x + jnp.einsum("bsf,fd->bsd", hdn,
+                              layer["w2"].astype(cfg.dtype))
+    # Row-parallel attn_out: this rank's rows are its heads' slice.
+    t = jax.lax.axis_index(model_axis)
+    wo = jax.lax.dynamic_slice_in_dim(
+        layer["attn_out"].astype(cfg.dtype), t * h_loc * hd,
+        h_loc * hd, 0)
+    out = jnp.einsum("bse,ed->bsd", attn.astype(cfg.dtype), wo)
+    x = x + jax.lax.psum(out, model_axis)
     y = _rmsnorm(x, layer["ln2"])
-    hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
-    hdn = jax.nn.gelu(hdn)
-    x = x + jnp.einsum("bsf,fd->bsd", hdn, layer["w2"].astype(cfg.dtype))
-    return x
+    f_loc = cfg.d_ff // tp
+    w1 = jax.lax.dynamic_slice_in_dim(
+        layer["w1"].astype(cfg.dtype), t * f_loc, f_loc, 1)
+    w2 = jax.lax.dynamic_slice_in_dim(
+        layer["w2"].astype(cfg.dtype), t * f_loc, f_loc, 0)
+    hdn = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, w1))
+    out = jnp.einsum("bsf,fd->bsd", hdn, w2)
+    return x + jax.lax.psum(out, model_axis)
 
 
 def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
@@ -128,6 +195,12 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
                        data_axis: str = "data", seq_axis: str = "sp"):
     """Build (init_fn, step_fn) training with the sequence sharded over
     ``mesh``'s ``seq_axis`` and batch over ``data_axis``.
+
+    A mesh carrying a ``model`` axis (make_sp_mesh(..., tp=N)) turns on
+    the sp×tp composition: attention heads and d_ff Megatron-shard over
+    ``model`` inside every block (the ring then rotates 1/tp of the K/V
+    payload per rank), composing context and tensor parallelism in one
+    step; requires n_heads, kv_heads and d_ff divisible by tp.
 
     step_fn: (params, opt_state, tokens [b, s+1]) -> (params, opt_state,
     loss), jitted; params replicate (under sp the ACTIVATIONS are the
@@ -164,14 +237,26 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
         impl = "pallas" if jax.default_backend() == "tpu" else "einsum"
     if impl not in {"einsum", "pallas", "ulysses"}:
         raise ValueError(f"unknown sp impl {impl!r}")
+    model_axis = "model" if "model" in mesh.axis_names else None
+    tp = mesh.shape[model_axis] if model_axis else 1
+    if tp > 1:
+        if cfg.n_heads % tp or cfg.kv_heads % tp:
+            raise ValueError(
+                f"sp×tp needs heads divisible by the {model_axis} axis "
+                f"({tp}): got {cfg.n_heads} q / {cfg.kv_heads} kv heads")
+        if cfg.d_ff % tp:
+            raise ValueError(
+                f"sp×tp needs d_ff ({cfg.d_ff}) divisible by the "
+                f"{model_axis} axis ({tp})")
     if impl == "ulysses":
         sp_size = mesh.shape[seq_axis]
-        if cfg.n_heads % sp_size or cfg.kv_heads % sp_size:
+        if (cfg.n_heads // tp) % sp_size or (cfg.kv_heads // tp) % sp_size:
             raise ValueError(
-                f"impl='ulysses' needs heads divisible by the "
-                f"{seq_axis} axis ({sp_size}): got {cfg.n_heads} q / "
-                f"{cfg.kv_heads} kv heads — use the ring impls for "
-                f"indivisible head counts")
+                f"impl='ulysses' needs per-TP-rank heads divisible by "
+                f"the {seq_axis} axis ({sp_size}): got "
+                f"{cfg.n_heads // tp} q / {cfg.kv_heads // tp} kv local "
+                f"heads — use the ring impls for indivisible head "
+                f"counts")
     if cfg.moe_experts is not None:
         raise ValueError(
             "MoE blocks are not supported under sequence parallelism "
@@ -189,7 +274,8 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
 
     block = functools.partial(
         _sp_block, cfg=cfg, seq_axis=seq_axis, impl=impl,
-        block_q=block_q, interpret=run_interpret)
+        block_q=block_q, interpret=run_interpret,
+        model_axis=model_axis, tp=tp)
     if cfg.remat:
         block = jax.checkpoint(block)
 
