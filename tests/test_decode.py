@@ -315,3 +315,168 @@ class TestStaticShapes:
             logits, cache = compiled(cache, tok)
             tok = jnp.argmax(logits, -1).astype(jnp.int32)
         assert int(cache.length) == 5 + 8
+
+
+class TestContinuousBatching:
+    """Slot-cache serving engine (workloads/serving.py): mixed-length
+    batches, admit/evict, chunked prefill (VERDICT r3 item 4)."""
+
+    def cfg(self):
+        return ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                           d_ff=64, seq_len=64, dtype=jnp.float32)
+
+    def test_mixed_lengths_match_single_sequence_generate(self):
+        """Per-slot parity: 5 requests of different prompt lengths
+        through 3 slots (forcing admit/evict churn) must reproduce each
+        request's single-sequence greedy rollout exactly."""
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = self.cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, cfg.vocab, (n,)).astype(np.int32)
+                   for n in (5, 17, 33, 9, 41)]
+        new_tokens = [6, 4, 8, 3, 5]
+        oracle = []
+        for pr, nt in zip(prompts, new_tokens):
+            out = generate(params, jnp.asarray(pr)[None], cfg, nt)
+            oracle.append(np.asarray(out[0, len(pr):]))
+        eng = ContinuousBatcher(params, cfg, slots=3, max_len=64,
+                                chunk=8)
+        reqs = [Request(prompt=pr, max_new_tokens=nt)
+                for pr, nt in zip(prompts, new_tokens)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        for r, want in zip(reqs, oracle):
+            assert r.done
+            np.testing.assert_array_equal(
+                np.asarray(r.generated, np.int64), want)
+
+    @pytest.mark.slow
+    def test_eos_evicts_early_and_slot_reused(self):
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = self.cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(1)
+        pr = rng.integers(0, cfg.vocab, (7,)).astype(np.int32)
+        ref = np.asarray(
+            generate(params, jnp.asarray(pr)[None], cfg, 8)[0, 7:])
+        # Early-stop on a token value at its FIRST occurrence (a tiny
+        # greedy model repeats itself quickly, so search; fall back to
+        # the very first token — still an early stop vs max 8).
+        cut = next((i for i in range(1, len(ref))
+                    if ref[i] not in ref[:i]), 0)
+        eos = int(ref[cut])
+        eng = ContinuousBatcher(params, cfg, slots=1, max_len=64,
+                                chunk=4)
+        first = Request(prompt=pr, max_new_tokens=8, eos_id=eos)
+        second = Request(prompt=pr, max_new_tokens=2)
+        eng.submit(first)
+        eng.submit(second)
+        eng.run()
+        assert first.done and first.generated[-1] == eos
+        assert len(first.generated) == cut + 1
+        # The evicted slot served the second request correctly.
+        np.testing.assert_array_equal(
+            np.asarray(second.generated, np.int64), ref[:2])
+
+    @pytest.mark.slow
+    def test_gqa_and_window_through_engine(self):
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                          n_kv_heads=2, attention_window=16, d_ff=64,
+                          seq_len=64, dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, cfg.vocab, (n,)).astype(np.int32)
+                   for n in (21, 6)]
+        oracle = [np.asarray(
+            generate(params, jnp.asarray(p)[None], cfg, 4)[0, len(p):])
+            for p in prompts]
+        eng = ContinuousBatcher(params, cfg, slots=2, max_len=64,
+                                chunk=8)
+        reqs = [Request(prompt=p, max_new_tokens=4) for p in prompts]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        for r, want in zip(reqs, oracle):
+            np.testing.assert_array_equal(
+                np.asarray(r.generated, np.int64), want)
+
+    @pytest.mark.slow
+    def test_slot_decode_under_tp_mesh(self):
+        """The slot decode step serves under the trainer's (data, model)
+        mesh: per-slot lengths + TP-sharded heads."""
+        from jax.sharding import Mesh
+
+        from tpu_autoscaler.workloads.serving import (
+            SlotKVCache,
+            make_prefill_chunk,
+            make_slot_decode_step,
+        )
+
+        cfg = self.cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    axis_names=("data", "model"))
+        cache = SlotKVCache.zeros(cfg, slots=4, max_len=32)
+        fill = make_prefill_chunk(cfg, chunk=8)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, cfg.vocab, (n,)).astype(np.int32)
+                   for n in (5, 3, 7, 2)]
+        seeds = []
+        for i, p in enumerate(prompts):
+            buf = np.zeros((8,), np.int32)
+            buf[:len(p)] = p
+            logits, cache = fill(params, cache, jnp.int32(i),
+                                 jnp.asarray(buf), jnp.int32(len(p)))
+            seeds.append(int(np.argmax(np.asarray(logits))))
+        active = jnp.ones((4,), bool)
+        step_tp = make_slot_decode_step(cfg, mesh)
+        logits_tp, cache_tp = step_tp(params, cache,
+                                      jnp.asarray(seeds, jnp.int32),
+                                      active)
+        step_1 = make_slot_decode_step(cfg)
+        logits_1, _ = step_1(params, cache, jnp.asarray(seeds, jnp.int32),
+                             active)
+        np.testing.assert_allclose(np.asarray(logits_tp),
+                                   np.asarray(logits_1), rtol=2e-4,
+                                   atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(cache_tp.lengths),
+                                      np.asarray(cache.lengths) + 1)
+
+    def test_oversized_and_empty_requests_rejected(self):
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = self.cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = ContinuousBatcher(params, cfg, slots=1, max_len=32,
+                                chunk=8)
+        with pytest.raises(ValueError, match="cache slots"):
+            eng.submit(Request(prompt=np.zeros((30,), np.int32),
+                               max_new_tokens=8))
+        with pytest.raises(ValueError, match="cache slots"):
+            # Prompt 31 pads to 32 <= 32 but + 2 new tokens overflows.
+            eng.submit(Request(prompt=np.zeros((31,), np.int32),
+                               max_new_tokens=2))
+        with pytest.raises(ValueError, match="empty prompt"):
+            eng.submit(Request(prompt=np.zeros((0,), np.int32),
+                               max_new_tokens=2))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit(Request(prompt=np.zeros((4,), np.int32),
+                               max_new_tokens=0))

@@ -772,14 +772,17 @@ def ring_flash_bwd_step(q, k_t, v_t, do, lse, delta, *, offset,
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
                    acc_scr, *, sm_scale: float, window, block_k: int,
-                   n_kb: int):
+                   n_kb: int, h_kv: int):
     """Single-token cached attention, blocked over the KV cache: one
     GQA group's queries ([group, d]) stream the cache's k-blocks through
     VMEM with the online-softmax carry in scratch — probabilities never
-    touch HBM.  Blocks entirely past ``length`` (or behind the window)
-    skip their MXU work via pl.when on the SMEM length."""
+    touch HBM.  Blocks entirely past the row's ``length`` (or behind the
+    window) skip their MXU work via pl.when on the SMEM lengths —
+    per-ROW lengths, so a continuous-batching slot batch pays each
+    sequence only its own cache read."""
     j = pl.program_id(1)
-    qpos = len_ref[0] - 1    # the new token's absolute position
+    row = pl.program_id(0) // h_kv          # batch/slot of this grid row
+    qpos = len_ref[row] - 1  # this row's new-token absolute position
 
     @pl.when(j == 0)
     def _init():
@@ -820,7 +823,9 @@ def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
     q: [b, h, 1, d] (the new token's queries, already rotated);
     k_cache, v_cache: [b, kv_heads, max_len, d] (the new k/v already
     written at position length-1); length: traced int32 count of filled
-    slots.  Returns [b, h, 1, d].
+    slots — a scalar (all rows equal: the fixed-batch path) or a [b]
+    vector (per-row lengths: the continuous-batching slot path).
+    Returns [b, h, 1, d].
 
     Decode is HBM-bandwidth-bound (the cache read IS the cost); this
     kernel makes that read single-pass — QK^T, masked online softmax,
@@ -840,9 +845,12 @@ def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
     qg = q.reshape(b, h_kv, group, d).reshape(b * h_kv, group, d)
     fk = k_cache.reshape(b * h_kv, max_len, d)
     fv = v_cache.reshape(b * h_kv, max_len, d)
+    lengths = jnp.broadcast_to(
+        jnp.asarray(length, jnp.int32).reshape(-1), (b,))
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale,
-                          window=window, block_k=block_k, n_kb=n_kb),
+                          window=window, block_k=block_k, n_kb=n_kb,
+                          h_kv=h_kv),
         grid=(b * h_kv, n_kb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -858,7 +866,7 @@ def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
             pltpu.VMEM((group, d), jnp.float32),
         ],
         interpret=interpret,
-    )(jnp.asarray(length, jnp.int32).reshape(1), qg, fk, fv)
+    )(lengths, qg, fk, fv)
     return out.reshape(b, h, 1, d)
 
 

@@ -35,10 +35,10 @@ import jax.numpy as jnp
 
 from tpu_autoscaler.workloads.model import (
     ModelConfig,
+    _ffn_residual,
     _rmsnorm,
     _rope,
     _split_qkv,
-    moe_ffn,
 )
 
 
@@ -192,17 +192,10 @@ def _block_with_cache(x, layer, k_cache, v_cache, cfg: ModelConfig,
     x = x + jnp.einsum("bsd,de->bse", attn,
                        layer["attn_out"].astype(cfg.dtype))
     y = _rmsnorm(x, layer["ln2"])
-    if cfg.moe_experts is None:
-        hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
-        hdn = jax.nn.gelu(hdn)
-        x = x + jnp.einsum("bsf,fd->bsd", hdn,
-                           layer["w2"].astype(cfg.dtype))
-    else:
-        # MoE checkpoints serve with the training-side routing rule
-        # (model.moe_ffn); at decode s=1 each token simply visits its
-        # top-k experts.
-        ffn_out, _aux = moe_ffn(y, layer, cfg)
-        x = x + ffn_out
+    # MoE checkpoints serve with the training-side routing rule
+    # (model.moe_ffn via _ffn_residual); at decode s=1 each token
+    # simply visits its top-k experts.
+    x = _ffn_residual(x, y, layer, cfg)
     return x, k_cache, v_cache
 
 

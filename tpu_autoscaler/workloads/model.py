@@ -309,6 +309,22 @@ def moe_ffn(y: jax.Array, layer: dict, cfg: ModelConfig):
     return out, jax.tree.map(jnp.mean, aux)
 
 
+def _ffn_residual(x: jax.Array, y: jax.Array, layer: dict,
+                  cfg: ModelConfig) -> jax.Array:
+    """The FFN half of a block (dense gelu MLP or MoE) added onto the
+    residual stream; y is the post-ln2 activations.  ONE definition
+    shared by the cached-decode and serving bodies (and matching
+    _block's training math), so block numerics cannot diverge between
+    train and serve."""
+    if cfg.moe_experts is None:
+        hdn = jnp.einsum("bsd,df->bsf", y, layer["w1"].astype(cfg.dtype))
+        hdn = jax.nn.gelu(hdn)
+        return x + jnp.einsum("bsf,fd->bsd", hdn,
+                              layer["w2"].astype(cfg.dtype))
+    ffn_out, _aux = moe_ffn(y, layer, cfg)
+    return x + ffn_out
+
+
 def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
            mesh: Mesh | None = None, ffn=None) -> jax.Array:
     """One transformer block; x: [batch, seq, d_model] in compute dtype.

@@ -1,0 +1,505 @@
+"""Continuous-batching serving engine: slot KV cache + chunked prefill.
+
+workloads/decode.py serves one fixed-shape batch end-to-end; real
+traffic is requests of different lengths arriving at different times.
+This module adds the serving layer that makes a TPU slice earn its keep
+under that traffic (VERDICT r3 item 4), with every device-side shape
+STATIC (the XLA constraint that shapes the whole design):
+
+- **SlotKVCache**: a fixed pool of ``slots`` sequences, each with its
+  own cache region and its own ``length`` — mixed-length sequences
+  decode together in ONE batched step (the per-row lengths flow into
+  the flash_decode kernel's SMEM, so each row pays only its own cache
+  read).
+- **admit/evict**: a finished sequence frees its slot and the next
+  request takes it over — the cache is reset per-slot (lengths[slot]=0)
+  with no reallocation and no recompilation.
+- **chunked prefill**: prompts enter the cache in fixed-size chunks
+  interleaved with decode steps (one chunk per engine tick), so a long
+  arriving prompt delays in-flight decodes by one bounded chunk, not by
+  its full length — the Orca/vLLM scheduling insight, here with the
+  chunk as the compiled unit.
+- **one compiled program each** for (decode tick, prefill chunk): all
+  control flow (which slot, how many valid tokens) is traced data, not
+  shape.
+
+Under the trainer's (data, model) mesh the slot batch shards over the
+data axes and the cache/heads over 'model' exactly like decode.py's
+fixed-batch path (cache_specs) — make_slot_decode_step takes the same
+``mesh`` argument.
+
+The reference has no serving stack at all (SURVEY §3); this is
+beyond-parity evidence, continuing decode.py's story.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_autoscaler.workloads.decode import _sample
+from tpu_autoscaler.workloads.model import (
+    ModelConfig,
+    _rmsnorm,
+    _split_qkv,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SlotKVCache:
+    """Per-slot KV cache: k, v [layers, slots, kv_heads, max_len,
+    head_dim]; lengths [slots] int32 — slot s holds a sequence whose
+    first ``lengths[s]`` positions are live.  Free slots simply have
+    length 0; admission resets a slot by writing 0 (stale K/V beyond
+    every write point is never visible — writes always start exactly at
+    the slot's current length)."""
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+    @property
+    def slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+    @classmethod
+    def zeros(cls, cfg: ModelConfig, slots: int,
+              max_len: int) -> "SlotKVCache":
+        shape = (cfg.n_layers, slots, cfg.kv_heads, max_len, cfg.head_dim)
+        return cls(k=jnp.zeros(shape, cfg.dtype),
+                   v=jnp.zeros(shape, cfg.dtype),
+                   lengths=jnp.zeros((slots,), jnp.int32))
+
+
+def _rope_rows(x: jax.Array, theta: float, positions: jax.Array):
+    """RoPE with a PER-ROW position: x [b, h, s, hd], positions [b]
+    (each row's absolute offset; within-row positions increment).
+    model._rope generalized from one scalar offset to one per row —
+    what a slot batch needs, where every slot sits at its own depth."""
+    b, h, s, hd = x.shape
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    pos = positions[:, None].astype(jnp.float32) + jnp.arange(
+        s, dtype=jnp.float32)[None, :]                      # [b, s]
+    angles = pos[..., None] * freqs[None, None, :]          # [b, s, half]
+    cos = jnp.cos(angles).astype(x.dtype)[:, None]          # [b, 1, s, half]
+    sin = jnp.sin(angles).astype(x.dtype)[:, None]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _slot_cached_attention(q, k_cache, v_cache, lengths, cfg: ModelConfig):
+    """Per-row-length cached attention (einsum path): q [b, h, 1, hd]
+    at absolute positions ``lengths - 1``; row b sees cache slots
+    j <= lengths[b]-1 (and within the window).  decode.py::
+    _cached_attention generalized from one shared length."""
+    b, h, sq, hd = q.shape
+    hkv = k_cache.shape[1]
+    max_len = k_cache.shape[2]
+    qg = q.reshape(b, hkv, h // hkv, sq, hd)
+    scores = jnp.einsum("bngqd,bnkd->bngqk", qg, k_cache) * hd ** -0.5
+    kpos = jnp.arange(max_len)
+    qpos = (lengths - 1)[:, None]                          # [b, 1]
+    visible = kpos[None, :] <= qpos                        # [b, max_len]
+    if cfg.attention_window is not None:
+        visible &= kpos[None, :] > qpos - cfg.attention_window
+    scores = jnp.where(visible[:, None, None, None],
+                       scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bngqk,bnkd->bngqd", probs, v_cache)
+    return out.reshape(b, h, sq, hd)
+
+
+def _write_rows(cache, new, positions):
+    """Write new [b, hkv, s, hd] into cache [b, hkv, max_len, hd] at
+    per-row offsets (vmapped dynamic_update_slice)."""
+    return jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0))
+    )(cache, new, positions)
+
+
+def _slot_attend(q, k_c, v_c, new_len, cfg: ModelConfig, mesh):
+    """The cache read for one slot-decode layer: the flash_decode
+    kernel with per-row lengths on TPU (wrapped in shard_map under a
+    multi-device mesh — GSPMD cannot auto-partition a pallas_call;
+    decode.py::_attend's recipe), the per-row einsum mask elsewhere or
+    when the slot count does not divide the data axes."""
+    if cfg.resolved_attention() != "pallas":
+        return _slot_cached_attention(q, k_c, v_c, new_len, cfg)
+    from tpu_autoscaler.workloads.attention import flash_decode
+
+    interpret = jax.default_backend() != "tpu"
+    if mesh is None or mesh.size == 1:
+        return flash_decode(q, k_c, v_c, new_len,
+                            window=cfg.attention_window,
+                            interpret=interpret)
+    import numpy as _np
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_autoscaler.workloads.model import data_axes
+
+    daxes = data_axes(mesh)
+    dp = int(_np.prod([mesh.shape[a] for a in daxes])) if daxes else 1
+    if q.shape[0] % dp:
+        # Static shapes at trace time: an indivisible slot count serves
+        # through the einsum path (model._block's fallback philosophy).
+        return _slot_cached_attention(q, k_c, v_c, new_len, cfg)
+    head_ax = "model" if "model" in mesh.axis_names else None
+    dspec = P(daxes, head_ax, None, None)
+
+    def kern(q, kc, vc, ln):
+        return flash_decode(q, kc, vc, ln, window=cfg.attention_window,
+                            interpret=interpret)
+
+    return jax.shard_map(
+        kern, mesh=mesh, in_specs=(dspec, dspec, dspec, P(daxes)),
+        out_specs=dspec, check_vma=False)(q, k_c, v_c, new_len)
+
+
+def make_slot_decode_step(cfg: ModelConfig, mesh=None):
+    """Build ``step(params, cache, tokens, active) -> (logits, cache)``:
+    one token for EVERY slot in one batched program — slot s's token
+    sits at its own position ``cache.lengths[s]``.  ``active`` [slots]
+    bool marks the slots that really decode this tick: inactive slots
+    compute garbage the engine ignores (the static-shape price — a
+    masked lane is cheaper than a recompile) and their lengths do NOT
+    advance, so the garbage K/V they wrote is overwritten by their next
+    real write.
+
+    tokens: [slots] int32.  Returns logits [slots, vocab] fp32 and the
+    cache with active lengths advanced by 1.
+
+    On TPU the cache read runs the flash_decode kernel with the
+    PER-ROW lengths in SMEM (shard_mapped under a multi-device mesh);
+    elsewhere the einsum path masks per row.  ``mesh``: shard slots
+    over the data axes and KV heads over 'model' (decode.py::
+    cache_specs layout).
+    """
+    if mesh is not None:
+        cfg = cfg.resolved_for_mesh(mesh)
+
+    def step(params, cache: SlotKVCache, tokens, active):
+        from tpu_autoscaler.workloads.model import _ffn_residual
+
+        x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]
+        positions = cache.lengths                      # [slots]
+
+        def body(carry, inputs):
+            x = carry
+            layer, k_c, v_c = inputs
+            b, s, d = x.shape
+            y = _rmsnorm(x, layer["ln1"])
+            q, k, v = _split_qkv(y, layer["qkv"], cfg)
+            if cfg.rope:
+                q = _rope_rows(q, cfg.rope_theta, positions)
+                k = _rope_rows(k, cfg.rope_theta, positions)
+            k_c = _write_rows(k_c, k, positions)
+            v_c = _write_rows(v_c, v, positions)
+            new_len = positions + 1
+            attn = _slot_attend(q, k_c, v_c, new_len, cfg, mesh)
+            attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+            x = x + jnp.einsum("bsd,de->bse", attn,
+                               layer["attn_out"].astype(cfg.dtype))
+            y = _rmsnorm(x, layer["ln2"])
+            return _ffn_residual(x, y, layer, cfg), (k_c, v_c)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["blocks"], cache.k, cache.v))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["unembed"].astype(cfg.dtype))
+        new_cache = SlotKVCache(
+            k=k_new, v=v_new,
+            lengths=cache.lengths + active.astype(jnp.int32))
+        return logits[:, 0].astype(jnp.float32), new_cache
+
+    if mesh is None:
+        return jax.jit(step)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_autoscaler.workloads.model import data_axes, param_specs
+
+    daxes = data_axes(mesh)
+    tp_ok = "model" in mesh.axis_names
+    kv = P(None, daxes, "model" if tp_ok else None, None, None)
+    cache_shard = SlotKVCache(
+        k=NamedSharding(mesh, kv), v=NamedSharding(mesh, kv),
+        lengths=NamedSharding(mesh, P(daxes)))
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    tok_shard = NamedSharding(mesh, P(daxes))
+    logit_shard = NamedSharding(mesh, P(daxes, None))
+    return jax.jit(step,
+                   in_shardings=(p_shard, cache_shard, tok_shard,
+                                 tok_shard),
+                   out_shardings=(logit_shard, cache_shard))
+
+
+def make_prefill_chunk(cfg: ModelConfig, chunk: int, mesh=None):
+    """Build ``fill(params, cache, slot, tokens, n_valid) -> (logits,
+    cache)``: append ``n_valid`` (<= chunk, traced) prompt tokens to ONE
+    slot's cache at its current length.  tokens: [chunk] int32 (padded
+    past n_valid; the pad lanes compute but their K/V is overwritten by
+    the next write at the corrected length, so they are never visible).
+    Returns the last VALID position's logits [vocab] — the seed of
+    generation when this was the prompt's final chunk.
+
+    One compiled program per chunk size serves every prompt length:
+    the engine splits prompts into ceil(len/chunk) calls interleaved
+    with decode ticks.
+    """
+    if mesh is not None:
+        cfg = cfg.resolved_for_mesh(mesh)
+
+    def fill(params, cache: SlotKVCache, slot, tokens, n_valid):
+        x = params["embed"].astype(cfg.dtype)[tokens][None]  # [1, chunk, d]
+        offset = cache.lengths[slot]
+
+        def body(carry, inputs):
+            x = carry
+            layer, k_all, v_all = inputs           # [slots, hkv, max, hd]
+            b, s, d = x.shape
+            y = _rmsnorm(x, layer["ln1"])
+            q, k, v = _split_qkv(y, layer["qkv"], cfg)
+            if cfg.rope:
+                from tpu_autoscaler.workloads.model import _rope
+
+                q = _rope(q, cfg.rope_theta, offset)
+                k = _rope(k, cfg.rope_theta, offset)
+            k_slot = jax.lax.dynamic_update_slice(
+                k_all, k, (slot, 0, offset, 0))
+            v_slot = jax.lax.dynamic_update_slice(
+                v_all, v, (slot, 0, offset, 0))
+            # Attend over this slot's cache: causal within the chunk,
+            # plus everything before the offset.
+            kc = jax.lax.dynamic_index_in_dim(k_slot, slot, 0,
+                                              keepdims=True)
+            vc = jax.lax.dynamic_index_in_dim(v_slot, slot, 0,
+                                              keepdims=True)
+            hkv = kc.shape[1]
+            max_len = kc.shape[2]
+            hd = cfg.head_dim
+            qg = q.reshape(1, hkv, cfg.n_heads // hkv, s, hd)
+            scores = jnp.einsum("bngqd,bnkd->bngqk", qg, kc) * hd ** -0.5
+            kpos = jnp.arange(max_len)
+            qpos = offset + jnp.arange(s)
+            visible = kpos[None, :] <= qpos[:, None]
+            if cfg.attention_window is not None:
+                visible &= kpos[None, :] > qpos[:, None] \
+                    - cfg.attention_window
+            scores = jnp.where(visible[None, None, None],
+                               scores.astype(jnp.float32), -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            attn = jnp.einsum("bngqk,bnkd->bngqd", probs, vc).reshape(
+                1, cfg.n_heads, s, hd)
+            attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+            x = x + jnp.einsum("bsd,de->bse", attn,
+                               layer["attn_out"].astype(cfg.dtype))
+            y = _rmsnorm(x, layer["ln2"])
+            from tpu_autoscaler.workloads.model import _ffn_residual
+
+            return _ffn_residual(x, y, layer, cfg), (k_slot, v_slot)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["blocks"], cache.k, cache.v))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["unembed"].astype(cfg.dtype))
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], n_valid - 1, axis=0, keepdims=False)
+        lengths = cache.lengths.at[slot].add(n_valid)
+        return last.astype(jnp.float32), SlotKVCache(
+            k=k_new, v=v_new, lengths=lengths)
+
+    return jax.jit(fill)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request for the engine."""
+
+    prompt: np.ndarray                   # [len] int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_id: int | None = None
+    # Filled by the engine:
+    generated: list = dataclasses.field(default_factory=list)
+    done: bool = False
+
+
+@dataclasses.dataclass
+class _SlotState:
+    request: Request | None = None
+    remaining_prompt: np.ndarray | None = None
+    seeded: bool = False                 # last-chunk logits sampled?
+
+
+class ContinuousBatcher:
+    """Host-side scheduler over the compiled slot programs.
+
+    Admission: a FREE slot takes the next queued request and prefills
+    its prompt one chunk per tick.  Every tick also runs ONE batched
+    decode step for all slots holding live generations.  Eviction: a
+    sequence that hits max_new_tokens (or eos) frees its slot on the
+    spot — the next request is admitted the same tick.  Shapes never
+    change; slot occupancy is pure data.
+
+    This is deliberately simple single-thread scheduling (tick =
+    [maybe one prefill chunk] + [one decode step]); the point is the
+    compiled-program inventory and the slot-cache semantics that make
+    real schedulers possible.
+    """
+
+    def __init__(self, params, cfg: ModelConfig, *, slots: int = 4,
+                 max_len: int = 256, chunk: int = 32, mesh=None,
+                 key=None):
+        self.params = params
+        self.cfg = cfg
+        self.chunk = chunk
+        self.max_len = max_len
+        self.cache = SlotKVCache.zeros(
+            cfg.resolved_for_mesh(mesh) if mesh is not None else cfg,
+            slots, max_len)
+        self._decode = make_slot_decode_step(cfg, mesh)
+        self._prefill = make_prefill_chunk(cfg, chunk, mesh)
+        self._slots = [_SlotState() for _ in range(slots)]
+        self._queue: list[Request] = []
+        self._pending_token = np.zeros((slots,), np.int32)
+        self._has_pending = np.zeros((slots,), bool)
+        self._key = key if key is not None else jax.random.PRNGKey(0)
+        self.ticks = 0
+        self.decode_tokens = 0
+
+    def submit(self, request: Request) -> None:
+        """Queue a request, validating its cache footprint UP FRONT —
+        the compiled steps run at traced lengths and cannot check
+        bounds; an oversized request would silently clamp
+        dynamic_update_slice writes and corrupt live cache."""
+        plen = len(request.prompt)
+        if plen < 1:
+            raise ValueError("empty prompt (the engine seeds generation "
+                             "from the prompt's last logits)")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got "
+                f"{request.max_new_tokens}")
+        # Prefill writes chunk-wide blocks: the last chunk's write must
+        # fit below max_len even though only n_valid entries are real.
+        padded = int(np.ceil(plen / self.chunk) * self.chunk)
+        need = max(padded, plen + request.max_new_tokens)
+        if need > self.max_len:
+            raise ValueError(
+                f"request needs {need} cache slots (prompt {plen} "
+                f"padded to chunk {self.chunk} multiples, + "
+                f"{request.max_new_tokens} new tokens) but max_len is "
+                f"{self.max_len}")
+        self._queue.append(request)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and all(
+            s.request is None for s in self._slots)
+
+    def _admit(self) -> None:
+        for i, slot in enumerate(self._slots):
+            if slot.request is None and self._queue:
+                req = self._queue.pop(0)
+                slot.request = req
+                slot.remaining_prompt = np.asarray(req.prompt, np.int32)
+                slot.seeded = False
+                self._has_pending[i] = False
+                # Reset the slot: stale cache beyond every future write
+                # point is invisible by construction.
+                self.cache = SlotKVCache(
+                    k=self.cache.k, v=self.cache.v,
+                    lengths=self.cache.lengths.at[i].set(0))
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _sample_host(self, logits, req: Request):
+        tok = _sample(logits, self._next_key(), req.temperature,
+                      None, None)
+        return int(np.asarray(tok))
+
+    def _finish_if_done(self, i: int) -> None:
+        slot = self._slots[i]
+        req = slot.request
+        if req is None:
+            return
+        if len(req.generated) >= req.max_new_tokens or (
+                req.eos_id is not None and req.generated
+                and req.generated[-1] == req.eos_id):
+            req.done = True
+            slot.request = None
+            slot.remaining_prompt = None
+            self._has_pending[i] = False
+
+    def tick(self) -> None:
+        """One engine step: admit, at most one prefill chunk, then one
+        batched decode step for every slot with a pending token."""
+        self._admit()
+        self.ticks += 1
+
+        # Chunked prefill: the first slot still holding prompt gets one
+        # chunk this tick (bounded head-of-line cost for decoders).
+        for i, slot in enumerate(self._slots):
+            if slot.request is None or slot.remaining_prompt is None \
+                    or len(slot.remaining_prompt) == 0:
+                continue
+            take = min(self.chunk, len(slot.remaining_prompt))
+            buf = np.zeros((self.chunk,), np.int32)
+            buf[:take] = slot.remaining_prompt[:take]
+            slot.remaining_prompt = slot.remaining_prompt[take:]
+            logits, self.cache = self._prefill(
+                self.params, self.cache, jnp.int32(i), jnp.asarray(buf),
+                jnp.int32(take))
+            if len(slot.remaining_prompt) == 0:
+                # Prompt complete: sample the first generated token.
+                tok = self._sample_host(np.asarray(logits), slot.request)
+                slot.request.generated.append(tok)
+                slot.seeded = True
+                self._pending_token[i] = tok
+                self._has_pending[i] = True
+                self._finish_if_done(i)
+            break
+
+        if not self._has_pending.any():
+            return
+
+        # Batched decode over every live slot.  Slots without a pending
+        # token run masked garbage; the active mask keeps their lengths
+        # from advancing ON DEVICE (no host round-trip on the hot
+        # path), so their garbage K/V is overwritten by the next real
+        # write.
+        logits, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(self._pending_token),
+            jnp.asarray(self._has_pending))
+        logits_np = np.asarray(logits)
+        for i, slot in enumerate(self._slots):
+            if not self._has_pending[i] or slot.request is None:
+                continue
+            self.decode_tokens += 1
+            tok = self._sample_host(logits_np[i], slot.request)
+            slot.request.generated.append(tok)
+            self._pending_token[i] = tok
+            self._finish_if_done(i)
+
+    def run(self, max_ticks: int = 10_000) -> None:
+        """Drive until every submitted request completes."""
+        for _ in range(max_ticks):
+            if self.idle:
+                return
+            self.tick()
+        raise RuntimeError(f"engine did not drain in {max_ticks} ticks")
