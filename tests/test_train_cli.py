@@ -127,3 +127,58 @@ class TestGenerateCli:
         result = run_generate(tmp_path)
         assert result.returncode != 0
         assert "no checkpoint found" in result.stderr
+
+
+def run_train_multi(tmp_path, *args, n_devices=8, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_devices} "
+        "--xla_backend_optimization_level=0")
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_autoscaler.workloads.train",
+         "--platform", "cpu", "--d-model", "32", "--n-layers", "2",
+         "--seq-len", "16", "--batch", "8",
+         "--checkpoint-dir", str(tmp_path / "ckpt"), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+class TestComposedCli:
+    """Round-4 composed parallelism through the trainer CLI."""
+
+    def test_pp_tp_trains_and_checkpoints(self, tmp_path):
+        result = run_train_multi(
+            tmp_path, "--steps", "4", "--pp-stages", "2", "--tp", "2",
+            "--pp-microbatches", "2", "--checkpoint-every", "4")
+        assert result.returncode == 0, result.stderr
+        assert "training complete at step 4" in result.stderr
+        assert (tmp_path / "ckpt" / "step_4").exists()
+
+    def test_sp_tp_trains(self, tmp_path):
+        result = run_train_multi(
+            tmp_path, "--steps", "3", "--sp", "2", "--tp", "2",
+            "--sp-impl", "einsum")
+        assert result.returncode == 0, result.stderr
+        assert "training complete at step 3" in result.stderr
+
+    def test_ep_trains_with_balance_logs(self, tmp_path):
+        result = run_train_multi(
+            tmp_path, "--steps", "10", "--ep", "4",
+            "--moe-experts", "8", "--checkpoint-every", "10")
+        assert result.returncode == 0, result.stderr
+        assert "training complete at step 10" in result.stderr
+        assert "balance" in result.stderr
+
+    def test_ep_without_moe_rejected(self, tmp_path):
+        result = run_train_multi(tmp_path, "--steps", "2", "--ep", "2")
+        assert result.returncode != 0
+        assert "--ep needs --moe-experts" in result.stderr
+
+    def test_ep_with_sp_rejected(self, tmp_path):
+        result = run_train_multi(
+            tmp_path, "--steps", "2", "--ep", "2", "--sp", "2",
+            "--moe-experts", "4")
+        assert result.returncode != 0
+        assert "dp×ep" in result.stderr or "pick it OR" in result.stderr

@@ -24,6 +24,13 @@ _MODEL_ARCH_OPTIONS = [
                       "causal)."),
     click.option("--no-rope", is_flag=True,
                  help="Disable rotary position embeddings."),
+    click.option("--moe-experts", default=None, type=int,
+                 help="Mixture-of-experts FFN: replace every block's "
+                      "dense MLP with this many expert MLPs (top-k "
+                      "routed).  Changes the checkpoint pytree, so the "
+                      "generate CLI needs the same value."),
+    click.option("--moe-top-k", default=2, show_default=True,
+                 help="Experts each token visits (with --moe-experts)."),
 ]
 
 
@@ -35,7 +42,8 @@ def model_arch_options(f):
 
 
 def model_config(vocab, seq_len, d_model, n_layers, n_kv_heads,
-                 attention_window, no_rope, **extra):
+                 attention_window, no_rope, moe_experts=None,
+                 moe_top_k=2, **extra):
     """Build the ModelConfig these flags describe (extra kwargs pass
     through to training-only fields like remat/ce_chunk)."""
     from tpu_autoscaler.workloads.model import ModelConfig
@@ -43,4 +51,5 @@ def model_config(vocab, seq_len, d_model, n_layers, n_kv_heads,
     return ModelConfig(vocab=vocab, seq_len=seq_len, d_model=d_model,
                        n_layers=n_layers, n_kv_heads=n_kv_heads,
                        attention_window=attention_window,
-                       rope=not no_rope, **extra)
+                       rope=not no_rope, moe_experts=moe_experts,
+                       moe_top_k=moe_top_k, **extra)

@@ -46,7 +46,7 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
          top_k, top_p, seed, vocab, seq_len, d_model, n_layers, n_kv_heads,
-         attention_window, no_rope, platform):
+         attention_window, no_rope, moe_experts, moe_top_k, platform):
     """Generate tokens from the latest checkpoint in --checkpoint-dir."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -64,7 +64,7 @@ def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
     from tpu_autoscaler.workloads.model import init_params
 
     cfg = model_config(vocab, seq_len, d_model, n_layers, n_kv_heads,
-                       attention_window, no_rope)
+                       attention_window, no_rope, moe_experts, moe_top_k)
     if top_k is not None and top_k > cfg.vocab:
         raise click.UsageError(
             f"--top-k {top_k} exceeds the vocab size {cfg.vocab}")

@@ -54,6 +54,18 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Gradient accumulation: apply the optimizer every k "
                    "microbatch steps (k-times the effective batch).")
 @click.option("--weight-decay", default=1e-4, show_default=True)
+@click.option("--tp", "tp_degree", default=None, type=int,
+              help="Tensor parallelism degree.  Composes with every "
+                   "mode: alone it sets the dp+tp mesh's 'model' axis "
+                   "(default: 2 when the device count is even); with "
+                   "--pp-stages it builds the 3-axis dp×pp×tp GPipe "
+                   "step; with --sp it Megatron-shards heads/d_ff "
+                   "inside the context-parallel step.")
+@click.option("--ep", "ep_degree", default=1, show_default=True,
+              help="Expert parallelism (needs --moe-experts): shard "
+                   "experts over this many devices with all_to_all "
+                   "dispatch; the rest are data-parallel.  1 = off "
+                   "(MoE runs replicated under the dp+tp step).")
 @click.option("--pp-stages", default=1, show_default=True,
               help="Pipeline parallelism: split layers over this many "
                    "stages (GPipe with microbatch remat).  1 = off "
@@ -88,11 +100,12 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu for local smoke runs).")
 def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
-         attention_window, no_rope, remat, ce_chunk, zero1, shard_mode,
-         lr, warmup_steps, lr_schedule, min_lr_ratio, grad_clip,
-         accum_steps, weight_decay, pp_stages, pp_microbatches, sp_degree,
-         sp_impl, data_file, profile_dir, checkpoint_dir,
-         checkpoint_every, annotations_file, platform):
+         attention_window, no_rope, moe_experts, moe_top_k, remat,
+         ce_chunk, zero1, shard_mode, lr, warmup_steps, lr_schedule,
+         min_lr_ratio, grad_clip, accum_steps, weight_decay, tp_degree,
+         ep_degree, pp_stages, pp_microbatches, sp_degree, sp_impl,
+         data_file, profile_dir, checkpoint_dir, checkpoint_every,
+         annotations_file, platform):
     """Train the flagship model on this job's slice (synthetic data)."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -128,8 +141,8 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
              topo.num_slices, len(jax.devices()))
 
     cfg = model_config(vocab, seq_len, d_model, n_layers, n_kv_heads,
-                       attention_window, no_rope, remat=remat,
-                       ce_chunk=ce_chunk)
+                       attention_window, no_rope, moe_experts, moe_top_k,
+                       remat=remat, ce_chunk=ce_chunk)
     # Multi-slice jobs get the (dcn, data, model) mesh: DP crosses slices
     # over DCN, TP stays inside each slice's ICI domain.
     train_cfg = TrainConfig(
@@ -142,7 +155,55 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
         raise click.UsageError(
             "--pp-stages and --sp are separate strategies; pick one "
             "(pp x sp composition is not wired in the CLI)")
-    if sp_degree > 1:
+    if ep_degree > 1 and (pp_stages > 1 or sp_degree > 1):
+        raise click.UsageError(
+            "--ep composes with data parallelism (dp×ep); pick it OR "
+            "--pp-stages/--sp")
+    if ep_degree > 1:
+        # Expert parallelism: experts over ep with all_to_all dispatch,
+        # batch over data×ep (every device is data-parallel for the
+        # dense ops).
+        if moe_experts is None:
+            raise click.UsageError("--ep needs --moe-experts")
+        if shard != "none":
+            raise click.UsageError(
+                "--shard composes with the dp+tp step, not --ep "
+                "(expert state is already partitioned)")
+        if topo.num_processes > 1:
+            raise click.UsageError(
+                "--ep is single-process only for now; multi-host jobs "
+                "should use the dp+tp step")
+        n_dev = len(jax.devices())
+        if n_dev % ep_degree:
+            raise click.UsageError(
+                f"--ep {ep_degree} must divide the {n_dev} available "
+                f"devices")
+        if batch % n_dev:
+            raise click.UsageError(
+                f"--batch {batch} must divide over all {n_dev} devices "
+                f"(the batch shards over data×ep)")
+        from tpu_autoscaler.workloads.moe import (
+            make_ep_mesh,
+            make_ep_train_step,
+        )
+
+        mesh = make_ep_mesh(jax.devices(), ep=ep_degree)
+        try:
+            ep_init, ep_step = make_ep_train_step(mesh, cfg,
+                                                  train=train_cfg)
+        except ValueError as e:
+            raise click.UsageError(str(e)) from e
+        init_fn = ep_init
+        last_moe_metrics: dict = {}
+
+        def raw_step_fn(params, opt_state, tokens):
+            params, opt_state, loss, metrics = ep_step(
+                params, opt_state, tokens)
+            last_moe_metrics.update(
+                balance=float(metrics["balance_loss"]),
+                z=float(metrics["z_loss"]))
+            return params, opt_state, loss
+    elif sp_degree > 1:
         # Context parallelism: sequence over the sp ring, batch over
         # the remaining (data-parallel) devices.
         if shard == "fsdp":
@@ -153,24 +214,25 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
             raise click.UsageError(
                 "--sp is single-process only for now; multi-host jobs "
                 "should use the dp+tp step")
-        if len(jax.devices()) % sp_degree:
+        sp_tp = tp_degree or 1
+        if len(jax.devices()) % (sp_degree * sp_tp):
             raise click.UsageError(
-                f"--sp {sp_degree} must divide the "
+                f"--sp {sp_degree} x --tp {sp_tp} must divide the "
                 f"{len(jax.devices())} available devices")
         if seq_len % sp_degree:
             raise click.UsageError(
                 f"--sp {sp_degree} must divide --seq-len {seq_len}")
-        dp_n = len(jax.devices()) // sp_degree
+        dp_n = len(jax.devices()) // (sp_degree * sp_tp)
         if batch % dp_n:
             raise click.UsageError(
                 f"--batch {batch} must divide over the {dp_n} "
-                f"data-parallel devices (devices / sp)")
+                f"data-parallel devices (devices / (sp*tp))")
         from tpu_autoscaler.workloads.sp import (
             make_sp_mesh,
             make_sp_train_step,
         )
 
-        mesh = make_sp_mesh(jax.devices(), sp=sp_degree)
+        mesh = make_sp_mesh(jax.devices(), sp=sp_degree, tp=sp_tp)
         try:
             init_fn, raw_step_fn = make_sp_train_step(
                 mesh, cfg, train=train_cfg,
@@ -200,6 +262,7 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
         from jax.sharding import Mesh
 
         from tpu_autoscaler.workloads.pipeline import (
+            make_pipeline_mesh,
             make_pipeline_train_step,
         )
 
@@ -207,13 +270,37 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
             raise click.UsageError(
                 f"--pp-stages {pp_stages} exceeds the {len(jax.devices())}"
                 f" available devices")
-        mesh = Mesh(_np.asarray(jax.devices()[:pp_stages]),
-                    axis_names=("pp",))
-        init_fn, raw_step_fn = make_pipeline_train_step(
-            mesh, cfg, num_microbatches=pp_microbatches, train=train_cfg)
+        if tp_degree is not None:
+            # dp×pp×tp: the 3-axis GPipe step (stage weights Megatron-
+            # sharded, batch over data).  NOTE: the checkpoint pytree is
+            # the split-weight form (wq/wk/wv); convert with
+            # pipeline.merge_qkv_weights to serve it elsewhere.
+            pp_tp = tp_degree
+            n_dev = len(jax.devices())
+            if n_dev % (pp_stages * pp_tp):
+                raise click.UsageError(
+                    f"--pp-stages {pp_stages} x --tp {pp_tp} must "
+                    f"divide the {n_dev} available devices")
+            dp_n = n_dev // (pp_stages * pp_tp)
+            if batch % (dp_n * pp_microbatches):
+                raise click.UsageError(
+                    f"--batch {batch} must divide over {dp_n} data "
+                    f"shards x {pp_microbatches} microbatches")
+            mesh = make_pipeline_mesh(jax.devices(), pp=pp_stages,
+                                      tp=pp_tp)
+        else:
+            mesh = Mesh(_np.asarray(jax.devices()[:pp_stages]),
+                        axis_names=("pp",))
+        try:
+            init_fn, raw_step_fn = make_pipeline_train_step(
+                mesh, cfg, num_microbatches=pp_microbatches,
+                train=train_cfg)
+        except ValueError as e:
+            raise click.UsageError(str(e)) from e
     else:
         mesh = (make_multislice_mesh(topo.num_slices)
-                if topo.num_slices > 1 else make_mesh())
+                if topo.num_slices > 1
+                else make_mesh(tp=tp_degree))
         init_fn, raw_step_fn = make_sharded_train_step(
             mesh, cfg, train=train_cfg, shard=shard)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
@@ -235,12 +322,16 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
     from jax.sharding import PartitionSpec as _P
 
     # Pipeline stages all see the full batch (the pp loop microbatches
-    # internally); sp meshes shard batch over 'data' only (the 'sp'
-    # axis carries sequence); dp/tp meshes shard over the data axes.
+    # internally) unless the 3-axis mesh shards it over 'data'; sp/ep
+    # meshes shard batch over their data axes ('sp' carries sequence,
+    # 'ep' is also data-parallel for the dense ops); dp/tp meshes shard
+    # over the data axes.
     if pp_stages > 1:
-        b_spec = _P()
+        b_spec = _P("data", None) if "data" in mesh.axis_names else _P()
     elif sp_degree > 1:
         b_spec = _P("data", None)
+    elif ep_degree > 1:
+        b_spec = _P(("data", "ep"), None)
     else:
         b_spec = batch_spec(mesh)
     b_sharding = NamedSharding(mesh, b_spec)
@@ -319,8 +410,12 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
             tok_s = (global_tokens_per_step * dsteps
                      / max(now - tp_state["t"], 1e-9)) if dsteps else 0.0
             tp_state.update(t=now, step=step)
-            log.info("step %d loss %.4f (%.0f tok/s)", step, last_loss[0],
-                     tok_s)
+            moe_note = ""
+            if ep_degree > 1 and last_moe_metrics:
+                moe_note = (f" balance {last_moe_metrics['balance']:.3f}"
+                            f" z {last_moe_metrics['z']:.3f}")
+            log.info("step %d loss %.4f (%.0f tok/s)%s", step,
+                     last_loss[0], tok_s, moe_note)
 
     writer = AsyncCheckpointWriter()
     try:
