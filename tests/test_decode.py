@@ -480,3 +480,64 @@ class TestContinuousBatching:
         with pytest.raises(ValueError, match="max_new_tokens"):
             eng.submit(Request(prompt=np.zeros((4,), np.int32),
                                max_new_tokens=0))
+
+    @pytest.mark.slow
+    def test_engine_under_mesh_matches_single_device(self):
+        """The whole ContinuousBatcher under a (data, model) mesh must
+        reproduce the unmeshed engine's greedy tokens exactly."""
+        from jax.sharding import Mesh
+
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = self.cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    axis_names=("data", "model"))
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, cfg.vocab, (n,)).astype(np.int32)
+                   for n in (6, 13)]
+
+        def serve(mesh_arg):
+            eng = ContinuousBatcher(params, cfg, slots=2, max_len=64,
+                                    chunk=8, mesh=mesh_arg)
+            reqs = [Request(prompt=p, max_new_tokens=4) for p in prompts]
+            for r in reqs:
+                eng.submit(r)
+            eng.run()
+            return [list(r.generated) for r in reqs]
+
+        assert serve(mesh) == serve(None)
+
+    def test_per_request_sampling_knobs(self):
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = self.cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = ContinuousBatcher(params, cfg, slots=2, max_len=64,
+                                chunk=8)
+        with pytest.raises(ValueError, match="temperature > 0"):
+            eng.submit(Request(prompt=np.zeros((4,), np.int32),
+                               max_new_tokens=2, top_k=5))
+        with pytest.raises(ValueError, match="top_p must be"):
+            eng.submit(Request(prompt=np.zeros((4,), np.int32),
+                               max_new_tokens=2, temperature=0.5,
+                               top_p=1.5))
+        # Mixed greedy + sampled traffic in one batch completes and
+        # yields in-vocab tokens.
+        reqs = [Request(prompt=np.zeros((4,), np.int32),
+                        max_new_tokens=3),
+                Request(prompt=np.zeros((4,), np.int32),
+                        max_new_tokens=3, temperature=0.8, top_k=10,
+                        top_p=0.9)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        for r in reqs:
+            assert r.done and len(r.generated) == 3
+            assert all(0 <= t < cfg.vocab for t in r.generated)

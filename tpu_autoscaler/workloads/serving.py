@@ -326,11 +326,15 @@ def make_prefill_chunk(cfg: ModelConfig, chunk: int, mesh=None):
 
 @dataclasses.dataclass
 class Request:
-    """One generation request for the engine."""
+    """One generation request for the engine.  Sampling knobs are
+    PER-REQUEST (each slot samples its own row of the batched logits
+    host-side, so mixed greedy/sampled traffic batches together)."""
 
     prompt: np.ndarray                   # [len] int32
     max_new_tokens: int
     temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
     eos_id: int | None = None
     # Filled by the engine:
     generated: list = dataclasses.field(default_factory=list)
@@ -380,6 +384,23 @@ class ContinuousBatcher:
         self.ticks = 0
         self.decode_tokens = 0
 
+        # Device-side batched sampling (the hot path): greedy rows take
+        # argmax, temperature rows sample categorically at their own
+        # temperature — only the [slots] token ids cross to host, not
+        # the [slots, vocab] logits.  Rows with top_k/top_p fall back
+        # to the host sampler (per-row truncation needs data-dependent
+        # shapes the batched path cannot express).
+        def _batch_sample(logits, key, temps, greedy):
+            scaled = logits / jnp.where(greedy, 1.0, temps)[:, None]
+            keys = jax.random.split(key, logits.shape[0])
+            sampled = jax.vmap(
+                lambda k, row: jax.random.categorical(k, row))(
+                    keys, scaled)
+            return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                             sampled).astype(jnp.int32)
+
+        self._batch_sample = jax.jit(_batch_sample)
+
     def submit(self, request: Request) -> None:
         """Queue a request, validating its cache footprint UP FRONT —
         the compiled steps run at traced lengths and cannot check
@@ -393,6 +414,17 @@ class ContinuousBatcher:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got "
                 f"{request.max_new_tokens}")
+        if request.temperature == 0.0 and (
+                request.top_k is not None or request.top_p is not None):
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (temperature 0 is "
+                "greedy argmax; truncation would be silently ignored)")
+        if request.top_p is not None and not 0.0 < request.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {request.top_p}")
+        if request.top_k is not None and request.top_k < 1:
+            raise ValueError(
+                f"top_k must be >= 1, got {request.top_k}")
         # Prefill writes chunk-wide blocks: the last chunk's write must
         # fit below max_len even though only n_valid entries are real.
         padded = int(np.ceil(plen / self.chunk) * self.chunk)
@@ -430,7 +462,7 @@ class ContinuousBatcher:
 
     def _sample_host(self, logits, req: Request):
         tok = _sample(logits, self._next_key(), req.temperature,
-                      None, None)
+                      req.top_k, req.top_p)
         return int(np.asarray(tok))
 
     def _finish_if_done(self, i: int) -> None:
@@ -486,13 +518,27 @@ class ContinuousBatcher:
         logits, self.cache = self._decode(
             self.params, self.cache, jnp.asarray(self._pending_token),
             jnp.asarray(self._has_pending))
-        logits_np = np.asarray(logits)
+        # Sample ON DEVICE for rows without truncation knobs; only the
+        # [slots] token ids come back to host (EOS checks/output need
+        # them anyway).  Truncated rows re-sample their own logits row
+        # host-side.
+        temps = np.array(
+            [s.request.temperature if s.request else 0.0
+             for s in self._slots], np.float32)
+        greedy = temps == 0.0
+        toks = np.asarray(self._batch_sample(
+            logits, self._next_key(), jnp.asarray(temps),
+            jnp.asarray(greedy)))
         for i, slot in enumerate(self._slots):
             if not self._has_pending[i] or slot.request is None:
                 continue
             self.decode_tokens += 1
-            tok = self._sample_host(logits_np[i], slot.request)
-            slot.request.generated.append(tok)
+            req = slot.request
+            if req.top_k is not None or req.top_p is not None:
+                tok = self._sample_host(np.asarray(logits[i]), req)
+            else:
+                tok = int(toks[i])
+            req.generated.append(tok)
             self._pending_token[i] = tok
             self._finish_if_done(i)
 
