@@ -541,3 +541,29 @@ class TestContinuousBatching:
         for r in reqs:
             assert r.done and len(r.generated) == 3
             assert all(0 <= t < cfg.vocab for t in r.generated)
+
+    @pytest.mark.slow
+    def test_moe_checkpoint_serves_through_engine(self):
+        """An MoE config runs the engine end-to-end (the FFN hook path
+        shared with cached decode) and matches per-request generate."""
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                          d_ff=64, seq_len=64, dtype=jnp.float32,
+                          moe_experts=4, moe_top_k=2,
+                          moe_capacity_factor=8.0)
+        params = init_params(jax.random.PRNGKey(4), cfg)
+        rng = np.random.default_rng(6)
+        pr = rng.integers(0, cfg.vocab, (9,)).astype(np.int32)
+        want = np.asarray(
+            generate(params, jnp.asarray(pr)[None], cfg, 4)[0, 9:])
+        eng = ContinuousBatcher(params, cfg, slots=1, max_len=64,
+                                chunk=8)
+        req = Request(prompt=pr, max_new_tokens=4)
+        eng.submit(req)
+        eng.run()
+        np.testing.assert_array_equal(
+            np.asarray(req.generated, np.int64), want)
