@@ -567,3 +567,43 @@ class TestContinuousBatching:
         eng.run()
         np.testing.assert_array_equal(
             np.asarray(req.generated, np.int64), want)
+
+    def test_ring_cache_matches_linear_for_windowed_model(self):
+        """ring=True: O(window) cache, sequences running past the ring
+        width — tokens must match the linear-cache generate() exactly."""
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                          n_kv_heads=2, attention_window=16, d_ff=64,
+                          seq_len=64, dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(7), cfg)
+        rng = np.random.default_rng(7)
+        # prompt 21 + 12 new = 33 > ring width (16 + 8 = 24): wraps.
+        prompts = [rng.integers(0, cfg.vocab, (n,)).astype(np.int32)
+                   for n in (21, 5)]
+        new_tokens = [12, 9]
+        oracle = [np.asarray(generate(params, jnp.asarray(p)[None],
+                                      cfg, nt)[0, len(p):])
+                  for p, nt in zip(prompts, new_tokens)]
+        eng = ContinuousBatcher(params, cfg, slots=2, max_len=64,
+                                chunk=8, ring=True)
+        assert eng.cache.max_len == 24  # window 16 + chunk 8
+        reqs = [Request(prompt=p, max_new_tokens=nt)
+                for p, nt in zip(prompts, new_tokens)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        for r, want in zip(reqs, oracle):
+            np.testing.assert_array_equal(
+                np.asarray(r.generated, np.int64), want)
+
+    def test_ring_requires_window(self):
+        from tpu_autoscaler.workloads.serving import ContinuousBatcher
+
+        cfg = self.cfg()  # no attention_window
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="attention_window"):
+            ContinuousBatcher(params, cfg, slots=1, ring=True)

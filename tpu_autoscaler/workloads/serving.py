@@ -127,6 +127,42 @@ def _write_rows(cache, new, positions):
     )(cache, new, positions)
 
 
+def _ring_abs_pos(lengths, ring: int):
+    """Absolute sequence position held by each ring slot, per row.
+
+    Slot j of a row at logical length L holds the LARGEST position
+    p ≡ j (mod ring) with p <= L-1: p = (L-1) - ((L-1-j) mod ring).
+    Slots never written (L < ring) come out negative — mask on >= 0.
+    Returns [rows, ring] int32."""
+    j = jnp.arange(ring)[None, :]
+    last = (lengths - 1)[:, None]
+    return last - jnp.mod(last - j, ring)
+
+
+def _slot_ring_attention(q, k_cache, v_cache, lengths, cfg: ModelConfig,
+                         window: int):
+    """_slot_cached_attention over a RING buffer: the cache holds only
+    the last ``ring`` positions (ring = window + chunk slack, chosen so
+    in-flight writes never displace keys still inside a live query's
+    window); each slot's absolute position is recovered from the row's
+    logical length, and visibility is the same causal+window rule on
+    absolute positions."""
+    b, h, sq, hd = q.shape
+    hkv = k_cache.shape[1]
+    ring = k_cache.shape[2]
+    qg = q.reshape(b, hkv, h // hkv, sq, hd)
+    scores = jnp.einsum("bngqd,bnkd->bngqk", qg, k_cache) * hd ** -0.5
+    abs_pos = _ring_abs_pos(lengths, ring)                 # [b, ring]
+    qpos = (lengths - 1)[:, None]
+    visible = (abs_pos >= 0) & (abs_pos <= qpos) \
+        & (abs_pos > qpos - window)
+    scores = jnp.where(visible[:, None, None, None],
+                       scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bngqk,bnkd->bngqd", probs, v_cache)
+    return out.reshape(b, h, sq, hd)
+
+
 def _slot_attend(q, k_c, v_c, new_len, cfg: ModelConfig, mesh):
     """The cache read for one slot-decode layer: the flash_decode
     kernel with per-row lengths on TPU (wrapped in shard_map under a
@@ -165,7 +201,8 @@ def _slot_attend(q, k_c, v_c, new_len, cfg: ModelConfig, mesh):
         out_specs=dspec, check_vma=False)(q, k_c, v_c, new_len)
 
 
-def make_slot_decode_step(cfg: ModelConfig, mesh=None):
+def make_slot_decode_step(cfg: ModelConfig, mesh=None,
+                          ring: bool = False):
     """Build ``step(params, cache, tokens, active) -> (logits, cache)``:
     one token for EVERY slot in one batched program — slot s's token
     sits at its own position ``cache.lengths[s]``.  ``active`` [slots]
@@ -183,7 +220,18 @@ def make_slot_decode_step(cfg: ModelConfig, mesh=None):
     elsewhere the einsum path masks per row.  ``mesh``: shard slots
     over the data axes and KV heads over 'model' (decode.py::
     cache_specs layout).
+
+    ``ring=True`` (requires cfg.attention_window): the cache is a RING
+    over its buffer width — writes land at position % width, each
+    slot's absolute position is recovered from the row's logical
+    length, and per-slot HBM is O(window) instead of O(max sequence):
+    sequence length becomes unbounded.  The cache read takes the
+    einsum path (the fused kernel's block skipping assumes a linear
+    layout).
     """
+    if ring and cfg.attention_window is None:
+        raise ValueError("ring=True needs cfg.attention_window (the "
+                         "ring holds exactly the window of live keys)")
     if mesh is not None:
         cfg = cfg.resolved_for_mesh(mesh)
 
@@ -202,10 +250,18 @@ def make_slot_decode_step(cfg: ModelConfig, mesh=None):
             if cfg.rope:
                 q = _rope_rows(q, cfg.rope_theta, positions)
                 k = _rope_rows(k, cfg.rope_theta, positions)
-            k_c = _write_rows(k_c, k, positions)
-            v_c = _write_rows(v_c, v, positions)
-            new_len = positions + 1
-            attn = _slot_attend(q, k_c, v_c, new_len, cfg, mesh)
+            if ring:
+                width = k_c.shape[2]
+                k_c = _write_rows(k_c, k, positions % width)
+                v_c = _write_rows(v_c, v, positions % width)
+                attn = _slot_ring_attention(
+                    q, k_c, v_c, positions + 1, cfg,
+                    cfg.attention_window)
+            else:
+                k_c = _write_rows(k_c, k, positions)
+                v_c = _write_rows(v_c, v, positions)
+                attn = _slot_attend(q, k_c, v_c, positions + 1, cfg,
+                                    mesh)
             attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
             x = x + jnp.einsum("bsd,de->bse", attn,
                                layer["attn_out"].astype(cfg.dtype))
@@ -245,7 +301,8 @@ def make_slot_decode_step(cfg: ModelConfig, mesh=None):
                    out_shardings=(logit_shard, cache_shard))
 
 
-def make_prefill_chunk(cfg: ModelConfig, chunk: int, mesh=None):
+def make_prefill_chunk(cfg: ModelConfig, chunk: int, mesh=None,
+                       ring: bool = False):
     """Build ``fill(params, cache, slot, tokens, n_valid) -> (logits,
     cache)``: append ``n_valid`` (<= chunk, traced) prompt tokens to ONE
     slot's cache at its current length.  tokens: [chunk] int32 (padded
@@ -257,7 +314,15 @@ def make_prefill_chunk(cfg: ModelConfig, chunk: int, mesh=None):
     One compiled program per chunk size serves every prompt length:
     the engine splits prompts into ceil(len/chunk) calls interleaved
     with decode ticks.
+
+    ``ring=True``: the cache is a ring over its buffer width (which
+    must be >= cfg.attention_window + chunk, so a chunk's writes never
+    displace keys still inside its own queries' windows); valid
+    entries scatter at position % width and visibility runs on
+    absolute positions.
     """
+    if ring and cfg.attention_window is None:
+        raise ValueError("ring=True needs cfg.attention_window")
     if mesh is not None:
         cfg = cfg.resolved_for_mesh(mesh)
 
@@ -276,27 +341,50 @@ def make_prefill_chunk(cfg: ModelConfig, chunk: int, mesh=None):
 
                 q = _rope(q, cfg.rope_theta, offset)
                 k = _rope(k, cfg.rope_theta, offset)
-            k_slot = jax.lax.dynamic_update_slice(
-                k_all, k, (slot, 0, offset, 0))
-            v_slot = jax.lax.dynamic_update_slice(
-                v_all, v, (slot, 0, offset, 0))
+            hkv = k_all.shape[1]
+            hd = cfg.head_dim
+            if ring:
+                # Scatter the VALID chunk entries at position % width
+                # (mode='drop' discards the pad lanes: in a ring, a pad
+                # write would displace a live key — unlike the linear
+                # cache, where the next write overwrites it first).
+                width = k_all.shape[2]
+                i = jnp.arange(s)
+                idx = jnp.where(i < n_valid, (offset + i) % width, width)
+                k_slot = k_all.at[slot, :, idx, :].set(
+                    k.transpose(2, 0, 1, 3)[:, 0], mode="drop")
+                v_slot = v_all.at[slot, :, idx, :].set(
+                    v.transpose(2, 0, 1, 3)[:, 0], mode="drop")
+            else:
+                k_slot = jax.lax.dynamic_update_slice(
+                    k_all, k, (slot, 0, offset, 0))
+                v_slot = jax.lax.dynamic_update_slice(
+                    v_all, v, (slot, 0, offset, 0))
             # Attend over this slot's cache: causal within the chunk,
             # plus everything before the offset.
             kc = jax.lax.dynamic_index_in_dim(k_slot, slot, 0,
                                               keepdims=True)
             vc = jax.lax.dynamic_index_in_dim(v_slot, slot, 0,
                                               keepdims=True)
-            hkv = kc.shape[1]
             max_len = kc.shape[2]
-            hd = cfg.head_dim
             qg = q.reshape(1, hkv, cfg.n_heads // hkv, s, hd)
             scores = jnp.einsum("bngqd,bnkd->bngqk", qg, kc) * hd ** -0.5
-            kpos = jnp.arange(max_len)
             qpos = offset + jnp.arange(s)
-            visible = kpos[None, :] <= qpos[:, None]
-            if cfg.attention_window is not None:
-                visible &= kpos[None, :] > qpos[:, None] \
-                    - cfg.attention_window
+            if ring:
+                # Per-query visibility on ABSOLUTE positions recovered
+                # from the ring layout at this chunk's end state.
+                abs_pos = _ring_abs_pos(
+                    (offset + n_valid)[None], max_len)[0]  # [width]
+                visible = (abs_pos[None, :] >= 0) \
+                    & (abs_pos[None, :] <= qpos[:, None]) \
+                    & (abs_pos[None, :] > qpos[:, None]
+                       - cfg.attention_window)
+            else:
+                kpos = jnp.arange(max_len)
+                visible = kpos[None, :] <= qpos[:, None]
+                if cfg.attention_window is not None:
+                    visible &= kpos[None, :] > qpos[:, None] \
+                        - cfg.attention_window
             scores = jnp.where(visible[None, None, None],
                                scores.astype(jnp.float32), -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
@@ -366,16 +454,27 @@ class ContinuousBatcher:
 
     def __init__(self, params, cfg: ModelConfig, *, slots: int = 4,
                  max_len: int = 256, chunk: int = 32, mesh=None,
-                 key=None):
+                 key=None, ring: bool = False):
+        """``ring=True`` (needs cfg.attention_window): per-slot cache
+        HBM becomes O(window + chunk) instead of O(max_len), and
+        sequences may run PAST max_len — max_len then only bounds the
+        per-request budget check, not the buffer."""
         self.params = params
         self.cfg = cfg
         self.chunk = chunk
         self.max_len = max_len
+        self.ring = ring
+        if ring:
+            if cfg.attention_window is None:
+                raise ValueError("ring=True needs cfg.attention_window")
+            buf_len = cfg.attention_window + chunk
+        else:
+            buf_len = max_len
         self.cache = SlotKVCache.zeros(
             cfg.resolved_for_mesh(mesh) if mesh is not None else cfg,
-            slots, max_len)
-        self._decode = make_slot_decode_step(cfg, mesh)
-        self._prefill = make_prefill_chunk(cfg, chunk, mesh)
+            slots, buf_len)
+        self._decode = make_slot_decode_step(cfg, mesh, ring=ring)
+        self._prefill = make_prefill_chunk(cfg, chunk, mesh, ring=ring)
         self._slots = [_SlotState() for _ in range(slots)]
         self._queue: list[Request] = []
         self._pending_token = np.zeros((slots,), np.int32)
