@@ -182,3 +182,52 @@ class TestComposedCli:
             "--moe-experts", "4")
         assert result.returncode != 0
         assert "dp×ep" in result.stderr or "pick it OR" in result.stderr
+
+
+@pytest.mark.slow
+class TestServeCli:
+    """Continuous-batching server CLI over a trained checkpoint."""
+
+    def run_serve(self, tmp_path, *args, timeout=300):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        return subprocess.run(
+            [sys.executable, "-m", "tpu_autoscaler.workloads.serve",
+             "--platform", "cpu", "--d-model", "32", "--n-layers", "1",
+             "--seq-len", "16",
+             "--checkpoint-dir", str(tmp_path / "ckpt"), *args],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    def test_serves_jsonl_requests(self, tmp_path):
+        import json
+
+        trained = run_train(tmp_path, "--steps", "4",
+                            "--checkpoint-every", "4")
+        assert trained.returncode == 0, trained.stderr
+        reqs = tmp_path / "reqs.jsonl"
+        reqs.write_text(
+            '{"prompt": [3, 17, 4], "max_new_tokens": 5}\n'
+            '{"prompt": [9], "max_new_tokens": 3, "temperature": 0.8}\n')
+        result = self.run_serve(tmp_path, "--requests", str(reqs),
+                                "--slots", "2", "--chunk", "4",
+                                "--max-len", "32")
+        assert result.returncode == 0, result.stderr
+        lines = [json.loads(x) for x in
+                 result.stdout.strip().splitlines()]
+        assert [r["id"] for r in lines] == [0, 1]
+        assert len(lines[0]["tokens"]) == 5 and lines[0]["done"]
+        assert len(lines[1]["tokens"]) == 3 and lines[1]["done"]
+
+    def test_random_requests_and_no_checkpoint_error(self, tmp_path):
+        result = self.run_serve(tmp_path, "--random", "2")
+        assert result.returncode != 0
+        assert "no checkpoint" in result.stderr
+        trained = run_train(tmp_path, "--steps", "4",
+                            "--checkpoint-every", "4")
+        assert trained.returncode == 0, trained.stderr
+        result = self.run_serve(tmp_path, "--random", "3", "--slots",
+                                "2", "--chunk", "4", "--max-len", "32",
+                                "--max-new-tokens", "4")
+        assert result.returncode == 0, result.stderr
+        assert len(result.stdout.strip().splitlines()) == 3

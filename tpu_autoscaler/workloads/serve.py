@@ -1,0 +1,158 @@
+"""Runnable continuous-batching server CLI.
+
+``python -m tpu_autoscaler.workloads.serve --checkpoint-dir ...
+--requests reqs.jsonl`` restores the latest trainer checkpoint and
+drives the ContinuousBatcher (workloads/serving.py) over a batch of
+mixed-length requests — the traffic-shaped counterpart of generate.py's
+single fixed batch.  Requests are JSON lines:
+
+    {"prompt": [3, 17, 4], "max_new_tokens": 16}
+    {"prompt": [9], "max_new_tokens": 8, "temperature": 0.8,
+     "top_k": 40, "eos_id": 0}
+
+(or ``--random N`` synthesizes N random requests).  Output is one JSON
+line per request, in submission order:
+
+    {"id": 0, "prompt_len": 3, "tokens": [..generated..], "done": true}
+
+Model flags must match the training run (shared block in _cli.py);
+``--ring`` turns on the O(window) ring cache for windowed models.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+import click
+
+log = logging.getLogger(__name__)
+
+
+from tpu_autoscaler.workloads._cli import model_arch_options, model_config
+
+
+@click.command()
+@click.option("--checkpoint-dir", default="/tmp/tpu-train-ckpt",
+              show_default=True)
+@click.option("--requests", "requests_file", default=None,
+              help="JSONL file of requests (see module docstring); "
+                   "'-' reads stdin.")
+@click.option("--random", "random_n", default=None, type=int,
+              help="Synthesize N random requests instead of --requests.")
+@click.option("--max-new-tokens", default=16, show_default=True,
+              help="Default/maximum for --random requests.")
+@click.option("--slots", default=4, show_default=True,
+              help="Concurrent sequences resident in the cache.")
+@click.option("--max-len", default=256, show_default=True,
+              help="Per-slot cache capacity (prompt + generation).")
+@click.option("--chunk", default=32, show_default=True,
+              help="Prefill chunk size (one chunk per engine tick).")
+@click.option("--ring", is_flag=True,
+              help="Ring cache: O(--attention-window) per-slot HBM, "
+                   "unbounded sequence length (needs a window).")
+@click.option("--seed", default=0, show_default=True)
+@model_arch_options
+@click.option("--platform", default=None,
+              help="Force a jax platform (e.g. cpu).")
+def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
+         max_len, chunk, ring, seed, vocab, seq_len, d_model, n_layers,
+         n_kv_heads, attention_window, no_rope, moe_experts, moe_top_k,
+         platform):
+    """Serve mixed-length requests from the latest checkpoint."""
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(asctime)s %(levelname)s: %(message)s")
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import numpy as np
+
+    from tpu_autoscaler.workloads.checkpoint import (
+        latest_step,
+        restore_checkpoint,
+    )
+    from tpu_autoscaler.workloads.serving import (
+        ContinuousBatcher,
+        Request,
+    )
+
+    cfg = model_config(vocab, seq_len, d_model, n_layers, n_kv_heads,
+                       attention_window, no_rope, moe_experts, moe_top_k)
+    if (requests_file is None) == (random_n is None):
+        raise click.UsageError("pass exactly one of --requests/--random")
+
+    step = latest_step(checkpoint_dir)
+    if step is None:
+        raise click.UsageError(
+            f"no checkpoint found in {checkpoint_dir!r} (train first: "
+            f"python -m tpu_autoscaler.workloads.train)")
+    state = restore_checkpoint(checkpoint_dir, step, None)
+    if not isinstance(state, dict) or "params" not in state:
+        raise click.UsageError(
+            f"checkpoint at step {step} is not a trainer checkpoint "
+            f"(expected a {{'params', 'opt'}} tree)")
+    params = state["params"]
+    log.info("restored step %d from %s", step, checkpoint_dir)
+
+    reqs: list[Request] = []
+    if random_n is not None:
+        rng = np.random.default_rng(seed)
+        for _ in range(random_n):
+            plen = int(rng.integers(1, max(2, cfg.seq_len // 2)))
+            reqs.append(Request(
+                prompt=rng.integers(0, cfg.vocab, (plen,)).astype(
+                    np.int32),
+                max_new_tokens=int(rng.integers(1, max_new_tokens + 1))))
+    else:
+        src = sys.stdin if requests_file == "-" else open(requests_file)
+        try:
+            for n, line in enumerate(src):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                    reqs.append(Request(
+                        prompt=np.asarray(obj["prompt"], np.int32),
+                        max_new_tokens=int(
+                            obj.get("max_new_tokens", max_new_tokens)),
+                        temperature=float(obj.get("temperature", 0.0)),
+                        top_k=obj.get("top_k"),
+                        top_p=obj.get("top_p"),
+                        eos_id=obj.get("eos_id")))
+                except (KeyError, ValueError, TypeError) as e:
+                    raise click.UsageError(
+                        f"bad request on line {n + 1}: {e}") from e
+        finally:
+            if src is not sys.stdin:
+                src.close()
+    if not reqs:
+        raise click.UsageError("no requests to serve")
+
+    engine = ContinuousBatcher(params, cfg, slots=slots, max_len=max_len,
+                               chunk=chunk, ring=ring,
+                               key=jax.random.PRNGKey(seed))
+    import time
+
+    t0 = time.perf_counter()
+    try:
+        for r in reqs:
+            engine.submit(r)
+    except ValueError as e:
+        raise click.UsageError(str(e)) from e
+    engine.run()
+    dt = time.perf_counter() - t0
+    for i, r in enumerate(reqs):
+        print(json.dumps({"id": i, "prompt_len": len(r.prompt),
+                          "tokens": [int(t) for t in r.generated],
+                          "done": r.done}))
+    decoded = sum(len(r.generated) for r in reqs)
+    log.info("%d requests, %d tokens in %.2fs (%.0f tok/s, %d ticks)",
+             len(reqs), decoded, dt, decoded / max(dt, 1e-9),
+             engine.ticks)
+
+
+if __name__ == "__main__":
+    main()
