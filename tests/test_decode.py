@@ -227,6 +227,55 @@ class TestFusedDecode:
         with pytest.raises(ValueError, match="single-token"):
             flash_decode(q, kc, kc, jnp.int32(4), interpret=True)
 
+    def test_flash_decode_vector_lengths_match_per_row(self):
+        """Per-row lengths (the slot-batch path): each row must equal a
+        scalar-length call at its own length."""
+        from tpu_autoscaler.workloads.attention import flash_decode
+
+        b, h, hkv, max_len, d = 3, 4, 2, 16, 8
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(6), 3)
+        q = jax.random.normal(kq, (b, h, 1, d))
+        k_cache = jax.random.normal(kk, (b, hkv, max_len, d))
+        v_cache = jax.random.normal(kv, (b, hkv, max_len, d))
+        lengths = jnp.asarray([3, 16, 9], jnp.int32)
+        got = flash_decode(q, k_cache, v_cache, lengths, block_k=8,
+                           interpret=True)
+        for i in range(b):
+            want = flash_decode(q[i:i + 1], k_cache[i:i + 1],
+                                v_cache[i:i + 1], lengths[i], block_k=8,
+                                interpret=True)
+            np.testing.assert_allclose(np.asarray(got[i]),
+                                       np.asarray(want[0]), rtol=2e-5,
+                                       atol=2e-5)
+
+    def test_flash_decode_ring_matches_ring_reference(self):
+        """Ring mode: logical lengths past the buffer width; oracle is
+        serving.py's einsum ring mask."""
+        from tpu_autoscaler.workloads.attention import flash_decode
+        from tpu_autoscaler.workloads.serving import (
+            _slot_ring_attention,
+        )
+
+        b, h, hkv, width, d, window = 2, 4, 2, 16, 8, 12
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(8), 3)
+        q = jax.random.normal(kq, (b, h, 1, d))
+        k_cache = jax.random.normal(kk, (b, hkv, width, d))
+        v_cache = jax.random.normal(kv, (b, hkv, width, d))
+        cfg = ModelConfig(vocab=64, d_model=32, n_heads=h,
+                          n_kv_heads=hkv, attention_window=window,
+                          dtype=jnp.float32)
+        for lengths in ([5, 13], [21, 40]):  # pre- and post-wrap
+            ln = jnp.asarray(lengths, jnp.int32)
+            got = flash_decode(q, k_cache, v_cache, ln, window=window,
+                               ring=True, block_k=8, interpret=True)
+            want = _slot_ring_attention(q, k_cache, v_cache, ln, cfg,
+                                        window)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-5)
+        with pytest.raises(ValueError, match="requires a window"):
+            flash_decode(q, k_cache, v_cache, jnp.int32(4), ring=True,
+                         interpret=True)
+
 
 class TestShardedServing:
     """Serving under the trainer's (data, model) mesh: same tokens as

@@ -772,14 +772,20 @@ def ring_flash_bwd_step(q, k_t, v_t, do, lse, delta, *, offset,
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
                    acc_scr, *, sm_scale: float, window, block_k: int,
-                   n_kb: int, h_kv: int):
+                   n_kb: int, h_kv: int, ring: bool):
     """Single-token cached attention, blocked over the KV cache: one
     GQA group's queries ([group, d]) stream the cache's k-blocks through
     VMEM with the online-softmax carry in scratch — probabilities never
     touch HBM.  Blocks entirely past the row's ``length`` (or behind the
     window) skip their MXU work via pl.when on the SMEM lengths —
     per-ROW lengths, so a continuous-batching slot batch pays each
-    sequence only its own cache read."""
+    sequence only its own cache read.
+
+    ``ring=True``: the cache is a ring buffer (serving.py's O(window)
+    layout) — slot s holds absolute position (L-1) - ((L-1-s) mod
+    width); the causal+window mask runs on those absolute positions.
+    No block skipping: a ring sized to the window is almost always
+    fully live."""
     j = pl.program_id(1)
     row = pl.program_id(0) // h_kv          # batch/slot of this grid row
     qpos = len_ref[row] - 1  # this row's new-token absolute position
@@ -790,20 +796,30 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    live = j * block_k <= qpos
-    if window is not None:
-        live &= j * block_k + block_k - 1 > qpos - window
+    if ring:
+        live = True
+    else:
+        live = j * block_k <= qpos
+        if window is not None:
+            live &= j * block_k + block_k - 1 > qpos - window
 
     @pl.when(live)
     def _step():
         scores = jax.lax.dot_general(
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [g, bk]
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
+        k_slot = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
-        keep = k_pos <= qpos
-        if window is not None:
-            keep &= k_pos > qpos - window
+        if ring:
+            width = n_kb * block_k
+            k_pos = qpos - jnp.mod(qpos - k_slot, width)
+            keep = (k_pos >= 0) & (k_pos <= qpos) \
+                & (k_pos > qpos - window)
+        else:
+            k_pos = k_slot
+            keep = k_pos <= qpos
+            if window is not None:
+                keep &= k_pos > qpos - window
         scores = jnp.where(keep, scores, NEG_INF)
         m_scr[...], l_scr[...], acc_scr[...] = _online_softmax_merge(
             scores, v_ref[0], m_scr[...], l_scr[...], acc_scr[...])
@@ -817,7 +833,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
 
 
 def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
-                 block_k: int = 512, interpret: bool = False):
+                 ring: bool = False, block_k: int = 512,
+                 interpret: bool = False):
     """Fused cached attention for one decode step.
 
     q: [b, h, 1, d] (the new token's queries, already rotated);
@@ -827,6 +844,12 @@ def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
     vector (per-row lengths: the continuous-batching slot path).
     Returns [b, h, 1, d].
 
+    ``ring=True`` (requires ``window``): the cache is serving.py's ring
+    layout over its max_len width — the mask recovers each slot's
+    absolute position from the row's logical length, which may exceed
+    the width (the new k/v must already be written at position
+    (length-1) % width).
+
     Decode is HBM-bandwidth-bound (the cache read IS the cost); this
     kernel makes that read single-pass — QK^T, masked online softmax,
     and PV fused per k-block — instead of the einsum path's
@@ -835,6 +858,8 @@ def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
     b, h, sq, d = q.shape
     if sq != 1:
         raise ValueError(f"flash_decode is single-token (sq=1); got {sq}")
+    if ring and window is None:
+        raise ValueError("ring=True requires a window")
     h_kv, max_len = k_cache.shape[1], k_cache.shape[2]
     group = h // h_kv
     block_k = _fit_block(max_len, block_k)
@@ -850,7 +875,7 @@ def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale,
                           window=window, block_k=block_k, n_kb=n_kb,
-                          h_kv=h_kv),
+                          h_kv=h_kv, ring=ring),
         grid=(b * h_kv, n_kb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),

@@ -225,9 +225,10 @@ def make_slot_decode_step(cfg: ModelConfig, mesh=None,
     over its buffer width — writes land at position % width, each
     slot's absolute position is recovered from the row's logical
     length, and per-slot HBM is O(window) instead of O(max sequence):
-    sequence length becomes unbounded.  The cache read takes the
-    einsum path (the fused kernel's block skipping assumes a linear
-    layout).
+    sequence length becomes unbounded.  On TPU the read runs the
+    fused flash_decode kernel in its ring mode (absolute positions
+    recovered in-kernel); multi-device meshes fall back to the einsum
+    path for now.
     """
     if ring and cfg.attention_window is None:
         raise ValueError("ring=True needs cfg.attention_window (the "
@@ -254,9 +255,20 @@ def make_slot_decode_step(cfg: ModelConfig, mesh=None,
                 width = k_c.shape[2]
                 k_c = _write_rows(k_c, k, positions % width)
                 v_c = _write_rows(v_c, v, positions % width)
-                attn = _slot_ring_attention(
-                    q, k_c, v_c, positions + 1, cfg,
-                    cfg.attention_window)
+                if cfg.resolved_attention() == "pallas" and (
+                        mesh is None or mesh.size == 1):
+                    from tpu_autoscaler.workloads.attention import (
+                        flash_decode,
+                    )
+
+                    attn = flash_decode(
+                        q, k_c, v_c, positions + 1,
+                        window=cfg.attention_window, ring=True,
+                        interpret=jax.default_backend() != "tpu")
+                else:
+                    attn = _slot_ring_attention(
+                        q, k_c, v_c, positions + 1, cfg,
+                        cfg.attention_window)
             else:
                 k_c = _write_rows(k_c, k, positions)
                 v_c = _write_rows(v_c, v, positions)
