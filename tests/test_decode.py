@@ -656,3 +656,32 @@ class TestContinuousBatching:
         params = init_params(jax.random.PRNGKey(0), cfg)
         with pytest.raises(ValueError, match="attention_window"):
             ContinuousBatcher(params, cfg, slots=1, ring=True)
+
+    def test_drain_finishes_in_flight_and_stops_admitting(self):
+        """The serving half of the drain contract: on a drain request,
+        in-flight sequences complete, queued requests stay unserved."""
+        from tpu_autoscaler.workloads.checkpoint import DrainWatcher
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        cfg = self.cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = ContinuousBatcher(params, cfg, slots=1, max_len=64,
+                                chunk=8)
+        annotations = {}
+        watcher = DrainWatcher(lambda: annotations, min_poll_interval=0)
+        first = Request(prompt=np.zeros((4,), np.int32),
+                        max_new_tokens=6)
+        second = Request(prompt=np.zeros((4,), np.int32),
+                         max_new_tokens=2)
+        eng.submit(first)
+        eng.submit(second)
+        # Fire the drain after the first tick admits request 1.
+        eng.tick()
+        annotations["autoscaler.tpu.dev/checkpoint-requested"] = "1"
+        eng.run(watcher=watcher)
+        assert first.done and len(first.generated) == 6
+        assert not second.done and second.generated == []
+        assert eng.draining

@@ -53,13 +53,20 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Ring cache: O(--attention-window) per-slot HBM, "
                    "unbounded sequence length (needs a window).")
 @click.option("--seed", default=0, show_default=True)
+@click.option("--annotations-file", default=None,
+              help="Downward-API annotations path for the drain "
+                   "contract (default: the standard "
+                   "/etc/podinfo/annotations).  When the autoscaler "
+                   "requests the slice back, the server stops "
+                   "admitting, finishes in-flight sequences, and "
+                   "exits 0 inside the drain window.")
 @model_arch_options
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
-         max_len, chunk, ring, seed, vocab, seq_len, d_model, n_layers,
-         n_kv_heads, attention_window, no_rope, moe_experts, moe_top_k,
-         platform):
+         max_len, chunk, ring, seed, annotations_file, vocab, seq_len,
+         d_model, n_layers, n_kv_heads, attention_window, no_rope,
+         moe_experts, moe_top_k, platform):
     """Serve mixed-length requests from the latest checkpoint."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -70,6 +77,8 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
     import numpy as np
 
     from tpu_autoscaler.workloads.checkpoint import (
+        DEFAULT_ANNOTATIONS_PATH,
+        DrainWatcher,
         latest_step,
         restore_checkpoint,
     )
@@ -136,13 +145,14 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
                                key=jax.random.PRNGKey(seed))
     import time
 
+    watcher = DrainWatcher(annotations_file or DEFAULT_ANNOTATIONS_PATH)
     t0 = time.perf_counter()
     try:
         for r in reqs:
             engine.submit(r)
     except ValueError as e:
         raise click.UsageError(str(e)) from e
-    engine.run()
+    engine.run(watcher=watcher)
     dt = time.perf_counter() - t0
     for i, r in enumerate(reqs):
         print(json.dumps({"id": i, "prompt_len": len(r.prompt),
@@ -152,6 +162,10 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
     log.info("%d requests, %d tokens in %.2fs (%.0f tok/s, %d ticks)",
              len(reqs), decoded, dt, decoded / max(dt, 1e-9),
              engine.ticks)
+    if engine.draining:
+        unserved = sum(1 for r in reqs if not r.done)
+        log.info("drain requested: in-flight sequences completed, %d "
+                 "queued requests unserved; exiting cleanly", unserved)
 
 
 if __name__ == "__main__":

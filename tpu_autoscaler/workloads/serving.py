@@ -163,20 +163,28 @@ def _slot_ring_attention(q, k_cache, v_cache, lengths, cfg: ModelConfig,
     return out.reshape(b, h, sq, hd)
 
 
-def _slot_attend(q, k_c, v_c, new_len, cfg: ModelConfig, mesh):
+def _slot_attend(q, k_c, v_c, new_len, cfg: ModelConfig, mesh,
+                 ring: bool = False):
     """The cache read for one slot-decode layer: the flash_decode
     kernel with per-row lengths on TPU (wrapped in shard_map under a
     multi-device mesh — GSPMD cannot auto-partition a pallas_call;
     decode.py::_attend's recipe), the per-row einsum mask elsewhere or
-    when the slot count does not divide the data axes."""
-    if cfg.resolved_attention() != "pallas":
+    when the slot count does not divide the data axes.  ``ring``
+    selects the ring-layout mask on both paths."""
+    def einsum_path():
+        if ring:
+            return _slot_ring_attention(q, k_c, v_c, new_len, cfg,
+                                        cfg.attention_window)
         return _slot_cached_attention(q, k_c, v_c, new_len, cfg)
+
+    if cfg.resolved_attention() != "pallas":
+        return einsum_path()
     from tpu_autoscaler.workloads.attention import flash_decode
 
     interpret = jax.default_backend() != "tpu"
     if mesh is None or mesh.size == 1:
         return flash_decode(q, k_c, v_c, new_len,
-                            window=cfg.attention_window,
+                            window=cfg.attention_window, ring=ring,
                             interpret=interpret)
     import numpy as _np
     from jax.sharding import PartitionSpec as P
@@ -188,13 +196,13 @@ def _slot_attend(q, k_c, v_c, new_len, cfg: ModelConfig, mesh):
     if q.shape[0] % dp:
         # Static shapes at trace time: an indivisible slot count serves
         # through the einsum path (model._block's fallback philosophy).
-        return _slot_cached_attention(q, k_c, v_c, new_len, cfg)
+        return einsum_path()
     head_ax = "model" if "model" in mesh.axis_names else None
     dspec = P(daxes, head_ax, None, None)
 
     def kern(q, kc, vc, ln):
         return flash_decode(q, kc, vc, ln, window=cfg.attention_window,
-                            interpret=interpret)
+                            ring=ring, interpret=interpret)
 
     return jax.shard_map(
         kern, mesh=mesh, in_specs=(dspec, dspec, dspec, P(daxes)),
@@ -227,8 +235,8 @@ def make_slot_decode_step(cfg: ModelConfig, mesh=None,
     length, and per-slot HBM is O(window) instead of O(max sequence):
     sequence length becomes unbounded.  On TPU the read runs the
     fused flash_decode kernel in its ring mode (absolute positions
-    recovered in-kernel); multi-device meshes fall back to the einsum
-    path for now.
+    recovered in-kernel), shard_mapped under multi-device meshes like
+    the linear path.
     """
     if ring and cfg.attention_window is None:
         raise ValueError("ring=True needs cfg.attention_window (the "
@@ -255,25 +263,11 @@ def make_slot_decode_step(cfg: ModelConfig, mesh=None,
                 width = k_c.shape[2]
                 k_c = _write_rows(k_c, k, positions % width)
                 v_c = _write_rows(v_c, v, positions % width)
-                if cfg.resolved_attention() == "pallas" and (
-                        mesh is None or mesh.size == 1):
-                    from tpu_autoscaler.workloads.attention import (
-                        flash_decode,
-                    )
-
-                    attn = flash_decode(
-                        q, k_c, v_c, positions + 1,
-                        window=cfg.attention_window, ring=True,
-                        interpret=jax.default_backend() != "tpu")
-                else:
-                    attn = _slot_ring_attention(
-                        q, k_c, v_c, positions + 1, cfg,
-                        cfg.attention_window)
             else:
                 k_c = _write_rows(k_c, k, positions)
                 v_c = _write_rows(v_c, v, positions)
-                attn = _slot_attend(q, k_c, v_c, positions + 1, cfg,
-                                    mesh)
+            attn = _slot_attend(q, k_c, v_c, positions + 1, cfg, mesh,
+                                ring=ring)
             attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
             x = x + jnp.einsum("bsd,de->bse", attn,
                                layer["attn_out"].astype(cfg.dtype))
@@ -554,6 +548,8 @@ class ContinuousBatcher:
             s.request is None for s in self._slots)
 
     def _admit(self) -> None:
+        if getattr(self, "draining", False):
+            return
         for i, slot in enumerate(self._slots):
             if slot.request is None and self._queue:
                 req = self._queue.pop(0)
@@ -653,9 +649,24 @@ class ContinuousBatcher:
             self._pending_token[i] = tok
             self._finish_if_done(i)
 
-    def run(self, max_ticks: int = 10_000) -> None:
-        """Drive until every submitted request completes."""
+    def run(self, max_ticks: int = 10_000, watcher=None) -> None:
+        """Drive until every submitted request completes.
+
+        ``watcher`` (a checkpoint.DrainWatcher): when the autoscaler
+        requests the slice back mid-run, stop ADMITTING queued requests
+        but finish every in-flight sequence — serving's half of the
+        drain contract (there is no state to checkpoint; bounded
+        completion inside the drain window is the whole obligation).
+        Unserved requests stay queued with done=False for the caller
+        to re-dispatch."""
+        self.draining = False
         for _ in range(max_ticks):
+            if watcher is not None and not self.draining \
+                    and watcher.drain_requested():
+                self.draining = True
+            if self.draining and all(
+                    s.request is None for s in self._slots):
+                return
             if self.idle:
                 return
             self.tick()
