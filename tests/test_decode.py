@@ -685,3 +685,74 @@ class TestContinuousBatching:
         assert first.done and len(first.generated) == 6
         assert not second.done and second.generated == []
         assert eng.draining
+
+
+class TestSpeculativeDecoding:
+    """Greedy speculative decoding: the draft only changes the step
+    count, NEVER the tokens (decode.py::speculative_generate)."""
+
+    def setup_method(self):
+        self.cfg = ModelConfig(vocab=64, d_model=32, n_layers=4,
+                               n_heads=4, d_ff=64, seq_len=64,
+                               dtype=jnp.float32)
+        self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+        # Cheap draft: the target's first layer only.
+        self.dcfg = ModelConfig(vocab=64, d_model=32, n_layers=1,
+                                n_heads=4, d_ff=64, seq_len=64,
+                                dtype=jnp.float32)
+        self.dparams = {**self.params, "blocks": jax.tree.map(
+            lambda x: x[:1], self.params["blocks"])}
+
+    def test_matches_plain_greedy(self):
+        from tpu_autoscaler.workloads.decode import speculative_generate
+
+        prompt = _prompt(b=1, s=7, key=3)
+        for steps, k in [(12, 4), (5, 2)]:
+            want = generate(self.params, prompt, self.cfg, steps)
+            got, stats = speculative_generate(
+                self.params, self.dparams, prompt, self.cfg, steps,
+                draft_cfg=self.dcfg, k=k)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+            assert stats["rounds"] >= 1
+
+    @pytest.mark.slow
+    def test_self_draft_accepts_everything(self):
+        """draft == target: every proposal accepted, k+1 tokens per
+        round — the efficiency ceiling, and a strict bookkeeping test
+        (the all-accepted path exercises the draft-cache replay)."""
+        from tpu_autoscaler.workloads.decode import speculative_generate
+
+        prompt = _prompt(b=1, s=7, key=3)
+        want = generate(self.params, prompt, self.cfg, 12)
+        got, stats = speculative_generate(
+            self.params, self.params, prompt, self.cfg, 12, k=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert stats["accept_rate"] == 1.0
+        assert stats["rounds"] == 3  # ceil(11 remaining / (k+1))
+
+    @pytest.mark.slow
+    def test_batched_matches_greedy(self):
+        from tpu_autoscaler.workloads.decode import speculative_generate
+
+        prompt = _prompt(b=3, s=6, key=5)
+        want = generate(self.params, prompt, self.cfg, 8)
+        got, _ = speculative_generate(
+            self.params, self.dparams, prompt, self.cfg, 8,
+            draft_cfg=self.dcfg, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_validation(self):
+        from tpu_autoscaler.workloads.decode import speculative_generate
+
+        prompt = _prompt(b=1, s=4, key=1)
+        with pytest.raises(ValueError, match="steps must be"):
+            speculative_generate(self.params, self.dparams, prompt,
+                                 self.cfg, 0, draft_cfg=self.dcfg)
+        with pytest.raises(ValueError, match="k must be"):
+            speculative_generate(self.params, self.dparams, prompt,
+                                 self.cfg, 4, draft_cfg=self.dcfg, k=0)
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            speculative_generate(self.params, self.dparams, prompt,
+                                 self.cfg, 8, draft_cfg=self.dcfg,
+                                 max_len=10)

@@ -29,9 +29,11 @@ from tpu_autoscaler.workloads.model import (
 from tpu_autoscaler.workloads.decode import (
     KVCache,
     decode_step,
+    extend_step,
     generate,
     make_sharded_generate,
     prefill,
+    speculative_generate,
 )
 from tpu_autoscaler.workloads.pipeline import (
     make_pipeline3d_train_step,
@@ -62,6 +64,7 @@ __all__ = [
     "SlotKVCache",
     "TrainConfig",
     "decode_step",
+    "extend_step",
     "forward",
     "generate",
     "init_params",
@@ -81,5 +84,6 @@ __all__ = [
     "prefill",
     "restore_checkpoint",
     "save_checkpoint",
+    "speculative_generate",
     "split_qkv_weights",
 ]

@@ -32,6 +32,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_autoscaler.workloads.model import (
     ModelConfig,
@@ -389,6 +390,139 @@ def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
     (_, _), rest = jax.lax.scan(body, (cache, first), all_keys[1:])
     out = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt, out.astype(prompt.dtype)], axis=1)
+
+
+def extend_step(params: dict, cache: KVCache, tokens: jax.Array,
+                cfg: ModelConfig, mesh=None) -> tuple[jax.Array, KVCache]:
+    """Append ``tokens`` [b, s] to the cache in ONE forward: returns
+    (logits [b, s, vocab] fp32 for every appended position, cache
+    advanced by s).  The multi-token sibling of decode_step — the
+    verification primitive for speculative decoding (one cached pass
+    scores k draft tokens) and a building block for chunked appends."""
+    if not isinstance(cache.length, jax.core.Tracer) \
+            and int(cache.length) + tokens.shape[1] > cache.max_len:
+        raise ValueError(
+            f"KV cache overflow: length {int(cache.length)} + "
+            f"{tokens.shape[1]} > max_len {cache.max_len}")
+    if mesh is not None:
+        cfg = cfg.resolved_for_mesh(mesh)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    logits, cache = _run_blocks(params, x, cache, cfg, cache.length, mesh)
+    return logits, _constrain_cache(cache, mesh)
+
+
+def _rewind(cache: KVCache, length) -> KVCache:
+    """Roll the logical length back (rejected speculative entries stay
+    as garbage beyond ``length``; the next write at ``length``
+    overwrites them before they can ever become visible)."""
+    return KVCache(k=cache.k, v=cache.v,
+                   length=jnp.asarray(length, jnp.int32))
+
+
+def speculative_generate(params: dict, draft_params: dict,
+                         prompt: jax.Array, cfg: ModelConfig,
+                         steps: int, *, draft_cfg: ModelConfig | None = None,
+                         k: int = 4, max_len: int | None = None,
+                         mesh=None):
+    """Greedy speculative decoding: a cheap DRAFT model proposes ``k``
+    tokens autoregressively, the target model scores all k in ONE
+    cached forward (extend_step), and the longest prefix agreeing with
+    the target's own greedy choices is accepted — plus one corrected
+    token from the target logits, so every round emits between 1 and
+    k+1 tokens for a single target pass.
+
+    Output matches the target's greedy rollout token for token (tests
+    pin it): acceptance only changes the step count, never the tokens
+    — the standard speculative guarantee specialized to greedy.  The
+    one caveat is numerics, not algorithm: every emitted token is the
+    argmax of the TARGET's verification logits (einsum cached
+    attention), while plain generate() on TPU may score decode steps
+    with the fused flash kernel — a vocab-logit near-tie at the
+    kernels' float tolerance could argmax differently there.  Decode
+    is bandwidth-bound on the target's weights/cache, so wall-clock
+    improves by roughly the mean accepted length when the draft is
+    much cheaper (e.g. fewer layers) and agrees often.
+
+    Returns (tokens [b, prompt+steps], stats dict with ``rounds`` and
+    ``accept_rate``).  Batched rows share each round's accepted length
+    (the minimum across rows) to keep one cache length — b=1 is the
+    sweet spot; larger b still matches greedy exactly, just with lower
+    effective acceptance.  Peak cache use is exactly ``prompt +
+    steps`` (the last round's draft is capped at the tokens
+    remaining), the same capacity generate() needs.
+    """
+    if draft_cfg is None:
+        draft_cfg = cfg
+    b, s = prompt.shape
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    max_len = max_len if max_len is not None else s + steps
+    if s + steps > max_len:
+        raise ValueError(
+            f"prompt {s} + steps {steps} exceeds max_len {max_len}")
+    logits_t, cache_t = prefill(params, prompt, cfg, max_len, mesh)
+    _, cache_d = prefill(draft_params, prompt, draft_cfg, max_len, mesh)
+    cur = jnp.argmax(logits_t[:, -1], axis=-1).astype(jnp.int32)  # [b]
+
+    out = [cur]
+    rounds = 0
+    accepted_total = 0
+    drafted_total = 0
+    while len(out) < steps:
+        rounds += 1
+        # Draft greedily from the draft's own cache — capped at the
+        # tokens still needed, so the last round never does k drafts
+        # to emit one token (and peak cache use stays s + steps).
+        k_eff = min(k, steps - len(out))
+        drafted_total += k_eff
+        draft_toks = []
+        tok_d = cur
+        for _ in range(k_eff):
+            dlogits, cache_d = decode_step(draft_params, cache_d, tok_d,
+                                           draft_cfg, mesh)
+            tok_d = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            draft_toks.append(tok_d)
+        drafts = jnp.stack(draft_toks, axis=1)           # [b, k_eff]
+        # One target pass scores cur + the k drafts: logits[:, i] is
+        # the target's prediction AFTER seeing cur, d1..di.
+        block = jnp.concatenate([cur[:, None], drafts], axis=1)
+        tlogits, cache_t = extend_step(params, cache_t, block, cfg,
+                                       mesh)
+        targets = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)
+        match = np.asarray(drafts == targets[:, :k_eff])  # [b, k_eff]
+        # Accepted length shared across rows: min over the batch.
+        n_acc = int(min(
+            (np.argmin(row) if not row.all() else k_eff)
+            for row in match))
+        emit = np.asarray(targets[:, :n_acc + 1])        # [b, n_acc+1]
+        accepted_total += n_acc
+        for j in range(emit.shape[1]):
+            if len(out) < steps:
+                out.append(jnp.asarray(emit[:, j]))
+        cur = jnp.asarray(emit[:, -1])
+        # Rewind both caches to the confirmed stream: target holds
+        # prompt + generated-so-far (excluding cur, which the next
+        # round's block re-appends).
+        confirmed = s + len(out) - 1
+        cache_t = _rewind(cache_t, confirmed)
+        # The draft cache wrote [cur, d1..d_{k-1}] — valid exactly on
+        # the confirmed prefix, but when every draft was accepted the
+        # stream ran one token PAST what the draft ever wrote (d_k was
+        # computed, never cached).  Rewind to the valid prefix, then
+        # replay the missing confirmed tokens through the draft.
+        cache_d = _rewind(cache_d, min(int(cache_d.length), confirmed))
+        behind = confirmed - int(cache_d.length)
+        if behind > 0:
+            replay = jnp.stack(out[-(behind + 1):-1], axis=1)
+            _, cache_d = extend_step(draft_params, cache_d, replay,
+                                     draft_cfg, mesh)
+    tokens = jnp.stack(out[:steps], axis=1)
+    stats = {"rounds": rounds,
+             "accept_rate": accepted_total / max(drafted_total, 1)}
+    return jnp.concatenate([prompt, tokens.astype(prompt.dtype)],
+                           axis=1), stats
 
 
 def make_sharded_generate(mesh, cfg: ModelConfig, steps: int, *,
