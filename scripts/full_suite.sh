@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Full-suite (fast + slow tier) validation, split to keep each pytest
+# invocation inside a bounded wall-clock on a 1-core box.  This is the
+# pre-round / pre-release gate VERDICT r3 weak-item 7 asked to make
+# enforceable: run it before declaring a build done.
+#
+#   ./scripts/full_suite.sh            # everything
+#   ./scripts/full_suite.sh fast       # fast tier only (default addopts)
+#
+# Exits non-zero on the first failing split.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+controller_ignores=(
+  --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py
+  --ignore=tests/test_sp.py --ignore=tests/test_pipeline.py
+  --ignore=tests/test_moe.py --ignore=tests/test_decode.py
+  --ignore=tests/test_workloads.py --ignore=tests/test_elastic.py
+  --ignore=tests/test_distributed.py --ignore=tests/test_ulysses.py
+  --ignore=tests/test_train_cli.py
+)
+
+run() { echo "== pytest $*"; python -m pytest -q "$@"; }
+
+# Fast tier, split controller-side vs workload-side.
+run tests/ "${controller_ignores[@]}" tests/test_train_cli.py
+run tests/test_attention.py tests/test_ring_attention.py \
+    tests/test_ulysses.py tests/test_distributed.py tests/test_elastic.py
+run tests/test_sp.py tests/test_pipeline.py tests/test_moe.py \
+    tests/test_decode.py tests/test_workloads.py
+
+if [[ "${1:-all}" == "fast" ]]; then exit 0; fi
+
+# Slow tier, one heavy file (or pair) per invocation.
+run -m "" tests/test_attention.py tests/test_ring_attention.py \
+    tests/test_ulysses.py
+run -m "" tests/test_sp.py
+run -m "" tests/test_moe.py
+run -m "" tests/test_pipeline.py
+run -m "" tests/test_decode.py tests/test_workloads.py
+run -m "" tests/test_train_cli.py tests/test_distributed.py \
+    tests/test_elastic.py
+run -m "slow" tests/ "${controller_ignores[@]}"
+echo "FULL SUITE GREEN"
