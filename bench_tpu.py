@@ -630,6 +630,27 @@ def _impl_serve(small: bool) -> None:
     }))
 
 
+def _make_bigram_shard(path: str, vocab: int, n_tokens: int):
+    """THE structured training shard, shared by the converge and spec
+    phases: 90% deterministic bigram (t -> (31t + 17) mod V), 10%
+    uniform noise — a learnable next-token rule whose cross-entropy
+    floor sits well below ln(V).  Returns the token array."""
+    import numpy as np
+
+    from tpu_autoscaler.dataio import write_token_file
+
+    rng = np.random.default_rng(7)
+    toks = np.empty(n_tokens, np.uint32)
+    toks[0] = 1
+    a, c = 31, 17
+    noise = rng.random(n_tokens) < 0.1
+    rand = rng.integers(0, vocab, n_tokens, dtype=np.uint32)
+    for i in range(1, n_tokens):
+        toks[i] = rand[i] if noise[i] else (a * int(toks[i - 1]) + c) % vocab
+    write_token_file(path, toks)
+    return toks
+
+
 def _impl_spec(small: bool) -> None:
     """Speculative-decoding economics on TRAINED models: fit a target
     and a cheaper draft (fewer layers) on the same structured bigram
@@ -638,11 +659,10 @@ def _impl_spec(small: bool) -> None:
     target_pass_ratio = target forward passes / tokens (1.0 for plain
     decode; 1/(mean accepted + 1) speculative) — decode is bound by the
     target's weight/cache reads, so wall-clock at scale tracks it."""
+    import shutil
     import tempfile
 
     import numpy as np
-
-    from tpu_autoscaler.dataio import write_token_file
 
     if small:
         vocab, n_tokens, steps_train = 256, 120_000, 50
@@ -655,90 +675,85 @@ def _impl_spec(small: bool) -> None:
 
     workdir = tempfile.mkdtemp(prefix="bench-spec-")
     shard = os.path.join(workdir, "shard.bin")
-    rng = np.random.default_rng(7)
-    toks = np.empty(n_tokens, np.uint32)
-    toks[0] = 1
-    a, c = 31, 17
-    noise = rng.random(n_tokens) < 0.1
-    rand = rng.integers(0, vocab, n_tokens, dtype=np.uint32)
-    for i in range(1, n_tokens):
-        toks[i] = rand[i] if noise[i] else (a * int(toks[i - 1]) + c) % vocab
-    write_token_file(shard, toks)
+    toks = _make_bigram_shard(shard, vocab, n_tokens)
 
-    def train(layers, ckpt):
-        cmd = [sys.executable, "-m", "tpu_autoscaler.workloads.train",
-               "--steps", str(steps_train), "--d-model", str(d_model),
-               "--n-layers", str(layers), "--seq-len", str(seq),
-               "--batch", "4", "--vocab", str(vocab),
-               "--data-file", shard, "--checkpoint-dir", ckpt,
-               "--checkpoint-every", str(steps_train),
-               "--lr", "3e-3", "--grad-clip", "1.0",
-               "--annotations-file", os.path.join(workdir, "none")]
-        proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
-                              text=True, timeout=600)
-        if proc.returncode != 0:
-            raise RuntimeError(f"trainer failed: {proc.stderr[-500:]}")
+    try:
+        def train(layers, ckpt):
+            cmd = [sys.executable, "-m", "tpu_autoscaler.workloads.train",
+                   "--steps", str(steps_train), "--d-model", str(d_model),
+                   "--n-layers", str(layers), "--seq-len", str(seq),
+                   "--batch", "4", "--vocab", str(vocab),
+                   "--data-file", shard, "--checkpoint-dir", ckpt,
+                   "--checkpoint-every", str(steps_train),
+                   "--lr", "3e-3", "--grad-clip", "1.0",
+                   "--annotations-file", os.path.join(workdir, "none")]
+            proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                                  text=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(f"trainer failed: {proc.stderr[-500:]}")
 
-    t_ckpt = os.path.join(workdir, "target")
-    d_ckpt = os.path.join(workdir, "draft")
-    train(t_layers, t_ckpt)
-    train(d_layers, d_ckpt)
+        t_ckpt = os.path.join(workdir, "target")
+        d_ckpt = os.path.join(workdir, "draft")
+        train(t_layers, t_ckpt)
+        train(d_layers, d_ckpt)
 
-    import jax
-    import jax.numpy as jnp
+        import jax
+        import jax.numpy as jnp
 
-    from tpu_autoscaler.workloads.checkpoint import restore_checkpoint
-    from tpu_autoscaler.workloads.decode import (
-        generate,
-        speculative_generate,
-    )
-    from tpu_autoscaler.workloads.model import ModelConfig
+        from tpu_autoscaler.workloads.checkpoint import restore_checkpoint
+        from tpu_autoscaler.workloads.decode import (
+            generate,
+            speculative_generate,
+        )
+        from tpu_autoscaler.workloads.model import ModelConfig
 
-    t_cfg = ModelConfig(vocab=vocab, d_model=d_model, n_layers=t_layers,
-                        seq_len=seq)
-    d_cfg = ModelConfig(vocab=vocab, d_model=d_model, n_layers=d_layers,
-                        seq_len=seq)
-    t_params = restore_checkpoint(t_ckpt, steps_train, None)["params"]
-    d_params = restore_checkpoint(d_ckpt, steps_train, None)["params"]
-    prompt = jnp.asarray(toks[:16].astype(np.int32))[None]
+        t_cfg = ModelConfig(vocab=vocab, d_model=d_model, n_layers=t_layers,
+                            seq_len=seq)
+        d_cfg = ModelConfig(vocab=vocab, d_model=d_model, n_layers=d_layers,
+                            seq_len=seq)
+        t_params = restore_checkpoint(t_ckpt, steps_train, None)["params"]
+        d_params = restore_checkpoint(d_ckpt, steps_train, None)["params"]
+        prompt = jnp.asarray(toks[:16].astype(np.int32))[None]
 
-    fn = jax.jit(lambda p, pr: generate(p, pr, t_cfg, gen_steps))
-    _sync(fn(t_params, prompt))
-    t0 = time.perf_counter()
-    _sync(fn(t_params, prompt))
-    plain_dt = time.perf_counter() - t0
-    # Token-parity oracle runs EAGERLY: whole-program jit fuses
-    # differently and can flip a bf16 near-tie argmax, which would
-    # falsely read as a speculative mismatch.
-    plain = generate(t_params, prompt, t_cfg, gen_steps)
+        fn = jax.jit(lambda p, pr: generate(p, pr, t_cfg, gen_steps))
+        _sync(fn(t_params, prompt))
+        t0 = time.perf_counter()
+        _sync(fn(t_params, prompt))
+        plain_dt = time.perf_counter() - t0
+        # Token-parity oracle runs EAGERLY: whole-program jit fuses
+        # differently and can flip a bf16 near-tie argmax, which would
+        # falsely read as a speculative mismatch.
+        plain = generate(t_params, prompt, t_cfg, gen_steps)
 
-    spec, stats = speculative_generate(
-        t_params, d_params, prompt, t_cfg, gen_steps, draft_cfg=d_cfg,
-        k=k)  # warm
-    t0 = time.perf_counter()
-    spec, stats = speculative_generate(
-        t_params, d_params, prompt, t_cfg, gen_steps, draft_cfg=d_cfg,
-        k=k)
-    spec_dt = time.perf_counter() - t0
-    tokens_match = bool(np.array_equal(np.asarray(plain),
-                                       np.asarray(spec)))
+        spec, stats = speculative_generate(
+            t_params, d_params, prompt, t_cfg, gen_steps, draft_cfg=d_cfg,
+            k=k)  # warm
+        t0 = time.perf_counter()
+        spec, stats = speculative_generate(
+            t_params, d_params, prompt, t_cfg, gen_steps, draft_cfg=d_cfg,
+            k=k)
+        spec_dt = time.perf_counter() - t0
+        tokens_match = bool(np.array_equal(np.asarray(plain),
+                                           np.asarray(spec)))
 
-    print(json.dumps({
-        "target_layers": t_layers, "draft_layers": d_layers,
-        "train_steps": steps_train, "gen_steps": gen_steps, "k": k,
-        "accept_rate": round(stats["accept_rate"], 3),
-        "rounds": stats["rounds"],
-        # Target forward passes per generated token (prefill excluded):
-        # plain decode = 1.0; the speculative win at decode-bound scale.
-        "target_pass_ratio": round(stats["rounds"] / gen_steps, 3),
-        "tokens_match_plain_greedy": tokens_match,
-        "plain_seconds": round(plain_dt, 4),
-        "speculative_seconds": round(spec_dt, 4),
-        "note": ("speculative wall-clock includes per-round host "
-                 "scheduling; at small scale the jitted plain scan "
-                 "wins on seconds — target_pass_ratio is the "
-                 "scale-relevant number"),
-    }))
+        print(json.dumps({
+            "target_layers": t_layers, "draft_layers": d_layers,
+            "train_steps": steps_train, "gen_steps": gen_steps, "k": k,
+            "accept_rate": round(stats["accept_rate"], 3),
+            "rounds": stats["rounds"],
+            # Target forward passes per generated token (prefill excluded):
+            # plain decode = 1.0; the speculative win at decode-bound scale.
+            "target_pass_ratio": round(stats["rounds"] / gen_steps, 3),
+            "tokens_match_plain_greedy": tokens_match,
+            "plain_seconds": round(plain_dt, 4),
+            "speculative_seconds": round(spec_dt, 4),
+            "note": ("speculative wall-clock includes per-round host "
+                     "scheduling; at small scale the jitted plain scan "
+                     "wins on seconds — target_pass_ratio is the "
+                     "scale-relevant number"),
+        }))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def _impl_converge(small: bool) -> None:
@@ -753,12 +768,9 @@ def _impl_converge(small: bool) -> None:
     No jax in this phase: the trainer subprocesses own the device; this
     orchestrator watches their logs."""
     import re
+    import shutil
     import signal
     import tempfile
-
-    import numpy as np
-
-    from tpu_autoscaler.dataio import write_token_file
 
     if small:
         steps, kill_at, ckpt_every = 60, 30, 10
@@ -773,18 +785,9 @@ def _impl_converge(small: bool) -> None:
 
     workdir = tempfile.mkdtemp(prefix="bench-converge-")
     shard = os.path.join(workdir, "shard.bin")
-    rng = np.random.default_rng(7)
-    # 90% deterministic bigram (t -> (a*t + c) mod V), 10% uniform noise:
-    # cross-entropy floor ~= 0.1*ln(V) + H(0.9) ~ well below ln(V), so a
+    # Cross-entropy floor ~= 0.1*ln(V) + H(0.9), well below ln(V), so a
     # learning trainer separates cleanly from a broken one.
-    toks = np.empty(n_tokens, np.uint32)
-    toks[0] = 1
-    a, c = 31, 17
-    noise = rng.random(n_tokens) < 0.1
-    rand = rng.integers(0, vocab, n_tokens, dtype=np.uint32)
-    for i in range(1, n_tokens):
-        toks[i] = rand[i] if noise[i] else (a * int(toks[i - 1]) + c) % vocab
-    write_token_file(shard, toks)
+    _make_bigram_shard(shard, vocab, n_tokens)
 
     ckpt_dir = os.path.join(workdir, "ckpt")
     cmd = [sys.executable, "-m", "tpu_autoscaler.workloads.train",
@@ -823,8 +826,11 @@ def _impl_converge(small: bool) -> None:
                 proc.kill()
         return losses, resumed, False
 
-    losses1, _, killed = run(kill_at_step=kill_at)
-    losses2, resumed_at, _ = run()
+    try:
+        losses1, _, killed = run(kill_at_step=kill_at)
+        losses2, resumed_at, _ = run()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
     # The two runs' logs compose into one curve across the kill: run 1
     # covers the start, run 2 (post-resume) the rest.
