@@ -273,3 +273,19 @@ class TestSpTpComposition:
         with pytest.raises(ValueError, match="per-TP-rank heads"):
             make_sp_train_step(make_sp_mesh(jax.devices(), sp=2, tp=2),
                                CFG, impl="ulysses")
+
+    @pytest.mark.slow
+    def test_pallas_ring_under_tp_matches_einsum(self):
+        """The fused ring (interpret mode on CPU) composes with the
+        Megatron head sharding: same losses as the einsum ring."""
+        tokens = tokens_for()
+        mesh = make_sp_mesh(jax.devices(), sp=2, tp=2)
+        losses = {}
+        for impl in ("einsum", "pallas"):
+            init_fn, step_fn = make_sp_train_step(mesh, CFG, impl=impl,
+                                                  interpret=True)
+            p, o = init_fn(jax.random.PRNGKey(0))
+            _, _, loss = step_fn(p, o, tokens)
+            losses[impl] = float(loss)
+        assert losses["pallas"] == pytest.approx(losses["einsum"],
+                                                 rel=2e-5)
