@@ -279,3 +279,105 @@ class TestEpTrainStep:
         with pytest.raises(ValueError, match="not divisible"):
             make_ep_train_step(self.mesh(dp=2, ep=4),
                                self.cfg(moe_experts=6))
+
+
+class TestEpTpComposition:
+    """dp×ep×tp: expert parallelism with Megatron TP on the dense
+    attention AND each expert's d_ff (the other half of VERDICT r3
+    item 8's 'dp×ep or dp×ep×tp')."""
+
+    def cfg(self, **kw):
+        from tpu_autoscaler.workloads.model import ModelConfig
+
+        base = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                    seq_len=16, dtype=jnp.float32, moe_experts=4,
+                    moe_top_k=2, moe_capacity_factor=64.0)
+        base.update(kw)
+        return ModelConfig(**base)
+
+    def test_no_drop_parity_one_row_pools(self):
+        """batch == data*ep -> one row per routing pool, where the
+        pool-level aux estimator coincides with the per-row one: the
+        ep×tp loss must equal model.loss_and_metrics exactly."""
+        from tpu_autoscaler.workloads.model import (
+            init_params,
+            loss_and_metrics,
+        )
+        from tpu_autoscaler.workloads.moe import (
+            make_ep_mesh,
+            make_ep_train_step,
+        )
+
+        cfg = self.cfg()
+        mesh = make_ep_mesh(jax.devices(), ep=2, tp=2)  # data=2 ep=2 tp=2
+        assert dict(mesh.shape) == {"data": 2, "ep": 2, "model": 2}
+        tokens = jax.random.randint(jax.random.PRNGKey(3),
+                                    (4, cfg.seq_len + 1), 0, cfg.vocab,
+                                    dtype=jnp.int32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ref, ref_m = loss_and_metrics(params, tokens, cfg)
+        init_fn, step_fn = make_ep_train_step(mesh, cfg)
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss, m = step_fn(p, o, tokens)
+        assert float(loss) == pytest.approx(float(ref), rel=2e-5)
+        assert float(m["balance_loss"]) == pytest.approx(
+            float(ref_m["balance_loss"]), abs=1e-4)
+
+    def test_ce_parity_multi_row_pools(self):
+        """With aux weights off, multi-row pools must still match the
+        reference CE to float tolerance (the aux covariance term is the
+        ONLY pool-vs-row difference when nothing drops)."""
+        from tpu_autoscaler.workloads.model import (
+            init_params,
+            loss_and_metrics,
+        )
+        from tpu_autoscaler.workloads.moe import (
+            make_ep_mesh,
+            make_ep_train_step,
+        )
+
+        cfg = self.cfg(moe_balance_weight=0.0, moe_z_weight=0.0)
+        mesh = make_ep_mesh(jax.devices(), ep=2, tp=2)
+        tokens = jax.random.randint(jax.random.PRNGKey(3),
+                                    (8, cfg.seq_len + 1), 0, cfg.vocab,
+                                    dtype=jnp.int32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ref, _ = loss_and_metrics(params, tokens, cfg)
+        init_fn, step_fn = make_ep_train_step(mesh, cfg)
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss, _ = step_fn(p, o, tokens)
+        assert float(loss) == pytest.approx(float(ref), rel=2e-5)
+
+    @pytest.mark.slow
+    def test_trains_with_drops_and_sharded_state(self):
+        from tpu_autoscaler.workloads.moe import (
+            make_ep_mesh,
+            make_ep_train_step,
+        )
+
+        cfg = self.cfg(moe_capacity_factor=1.0)
+        mesh = make_ep_mesh(jax.devices(), ep=2, tp=2)
+        tokens = jax.random.randint(jax.random.PRNGKey(3),
+                                    (8, cfg.seq_len + 1), 0, cfg.vocab,
+                                    dtype=jnp.int32)
+        init_fn, step_fn = make_ep_train_step(mesh, cfg)
+        p, o = init_fn(jax.random.PRNGKey(0))
+        w1 = p["blocks"]["w1"]
+        # 4 experts over ep=2 AND d_ff 64 over tp=2.
+        assert w1.sharding.shard_shape(w1.shape)[1] == 2
+        assert w1.sharding.shard_shape(w1.shape)[3] == 32
+        losses = []
+        for _ in range(5):
+            p, o, loss, m = step_fn(p, o, tokens)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_indivisible_heads_rejected(self):
+        from tpu_autoscaler.workloads.moe import (
+            make_ep_mesh,
+            make_ep_train_step,
+        )
+
+        with pytest.raises(ValueError, match="heads divisible"):
+            make_ep_train_step(make_ep_mesh(jax.devices(), ep=2, tp=2),
+                               self.cfg(n_heads=3, d_model=48))

@@ -216,29 +216,52 @@ def make_moe_layer(mesh: Mesh, cfg: MoeConfig, ep_axis: str = "ep",
                          out_specs=out_specs)
 
 
-def make_ep_mesh(devices=None, ep: int | None = None):
+def make_ep_mesh(devices=None, ep: int | None = None, tp: int = 1):
     """(data, ep) mesh for expert-parallel training: the batch shards
     over BOTH axes (every device is data-parallel for the dense ops);
     ``ep`` is additionally the expert-exchange axis for the MoE blocks.
+    ``tp > 1`` appends a ``model`` axis — (data, ep, model) — for the
+    dp×ep×tp composition: dense attention heads Megatron-shard over
+    ``model`` and each expert's d_ff column/row-shards over it too.
     """
     import numpy as np
 
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    if tp < 1 or tp > n:
+        raise ValueError(f"tp={tp} must be in [1, {n}] for {n} devices")
     if ep is None:
-        ep = n
-    if n % ep:
-        raise ValueError(f"{n} devices not divisible by ep={ep}")
-    arr = np.asarray(devices).reshape(n // ep, ep)
-    return Mesh(arr, axis_names=("data", "ep"))
+        ep = n // tp
+    if ep < 1 or n % (ep * tp):
+        raise ValueError(
+            f"{n} devices not divisible by ep*tp = {ep * tp}")
+    if tp == 1:
+        arr = np.asarray(devices).reshape(n // ep, ep)
+        return Mesh(arr, axis_names=("data", "ep"))
+    arr = np.asarray(devices).reshape(n // (ep * tp), ep, tp)
+    return Mesh(arr, axis_names=("data", "ep", "model"))
 
 
-def _ep_moe_ffn(y, layer, cfg, ep_axis: str, ep: int):
+def _ep_moe_ffn(y, layer, cfg, ep_axis: str, ep: int,
+                model_axis: str | None = None):
     """Expert-parallel MoE FFN on this device's token pool: route over
     the LOCAL pool (capacity = capacity_factor·n_loc·k/E, pool-level
     GShard semantics, vs model.moe_ffn's per-row dispatch), all_to_all
     to the expert owners, local expert MLPs, all_to_all back,
-    gate-weighted combine.  Returns (out, aux)."""
+    gate-weighted combine.  Returns (out, aux).
+
+    ``model_axis``: each expert's d_ff additionally column/row-shards
+    over it (w1 holds f/tp columns, w2 f/tp rows; one psum completes
+    each expert's output before the return exchange) — expert compute
+    and weights drop by tp on top of the ep sharding.
+
+    Aux-loss estimator note: the balance loss E·Σ frac·p is NONLINEAR
+    in (frac, p), so the pool-level estimate (product of pool means)
+    differs from model.moe_ffn's per-row estimate (mean of per-row
+    products) by the cross-row covariance — O(1e-2) unweighted on
+    multi-row pools, zero when each pool is one row.  Both are
+    legitimate GShard-style regularizers; parity tests pin exactness
+    on 1-row pools and train-quality elsewhere."""
     b, s, d = y.shape
     n_loc = b * s
     e, k = cfg.moe_experts, cfg.moe_top_k
@@ -259,7 +282,7 @@ def _ep_moe_ffn(y, layer, cfg, ep_axis: str, ep: int):
     buckets = dispatch.reshape(ep, e_loc, cap, d)
     received = jax.lax.all_to_all(buckets, ep_axis, split_axis=0,
                                   concat_axis=0, tiled=False)
-    w1 = layer["w1"].astype(cfg.dtype)   # local [e_loc, d, f]
+    w1 = layer["w1"].astype(cfg.dtype)   # local [e_loc, d, f(/tp)]
     w2 = layer["w2"].astype(cfg.dtype)
     h = jax.nn.gelu(jnp.einsum("seCd,edf->seCf", received, w1))
     expert_out = jnp.einsum("seCf,efd->seCd", h, w2)
@@ -271,7 +294,30 @@ def _ep_moe_ffn(y, layer, cfg, ep_axis: str, ep: int):
         o = combined[expert[:, c], safe_rank[:, c]]
         out = out + jnp.where(keep[:, c, None],
                               gate[:, c, None].astype(o.dtype) * o, 0.0)
+    if model_axis is not None:
+        # Row-parallel completion of the expert outputs.  The return
+        # all_to_all, the gather-by-rank combine, and the gate weights
+        # are all LINEAR in expert_out, so the psum commutes to here —
+        # reducing [n_loc, d] instead of the capacity_factor·k×-larger
+        # [e, cap, d] buffer.
+        out = jax.lax.psum(out, model_axis)
     return out.reshape(b, s, d), aux
+
+
+def _ep_tp_block(x, layer, cfg, *, ep_axis: str, ep: int,
+                 model_axis: str, tp: int, ep_ffn):
+    """One block of the dp×ep×tp step: the SHARED full-seq TP attention
+    (sp.py::tp_attention — flash or einsum per cfg, row-parallel psum)
+    followed by the expert-parallel FFN with model-sharded expert
+    d_ff."""
+    from tpu_autoscaler.workloads.model import _rmsnorm
+    from tpu_autoscaler.workloads.sp import tp_attention
+
+    y = _rmsnorm(x, layer["ln1"])
+    x = tp_attention(x, y, layer, cfg, model_axis=model_axis, tp=tp)
+    y = _rmsnorm(x, layer["ln2"])
+    out, aux = ep_ffn(y, layer)
+    return x + out, aux
 
 
 def make_ep_train_step(mesh: Mesh, cfg, *, train=None,
@@ -313,21 +359,41 @@ def make_ep_train_step(mesh: Mesh, cfg, *, train=None,
         raise ValueError(
             f"{cfg.moe_experts} experts not divisible by the {ep_axis} "
             f"axis ({ep})")
+    model_axis = "model" if "model" in mesh.axis_names else None
+    tp = mesh.shape[model_axis] if model_axis else 1
+    if tp > 1:
+        if cfg.n_heads % tp or cfg.kv_heads % tp:
+            raise ValueError(
+                f"ep×tp needs heads divisible by the {model_axis} axis "
+                f"({tp}): got {cfg.n_heads} q / {cfg.kv_heads} kv heads")
+        if cfg.d_ff % tp:
+            raise ValueError(
+                f"ep×tp needs d_ff ({cfg.d_ff}) divisible by the "
+                f"{model_axis} axis ({tp})")
     if train is None:
         train = TrainConfig(learning_rate=learning_rate)
     optimizer = make_optimizer(train)
 
     def ep_ffn(y, layer):
-        out, aux = _ep_moe_ffn(y, layer, cfg, ep_axis, ep)
+        out, aux = _ep_moe_ffn(y, layer, cfg, ep_axis, ep,
+                               model_axis if tp > 1 else None)
         return out, {"balance_loss": aux["balance_loss"],
                      "z_loss": aux["z_loss"],
                      "expert_fraction": aux["expert_fraction"]}
 
-    def block(x, layer):
-        """model._block's attention path untouched (mesh=None: we are
-        inside shard_map, attention is device-local) with the FFN half
-        replaced by the expert-parallel dispatch via the ffn hook."""
-        return _block(x, layer, cfg, mesh=None, ffn=ep_ffn)
+    if tp > 1:
+        import functools
+
+        block = functools.partial(
+            _ep_tp_block, cfg=cfg, ep_axis=ep_axis, ep=ep,
+            model_axis=model_axis, tp=tp, ep_ffn=ep_ffn)
+    else:
+        def block(x, layer):
+            """model._block's attention path untouched (mesh=None: we
+            are inside shard_map, attention is device-local) with the
+            FFN half replaced by the expert-parallel dispatch via the
+            ffn hook."""
+            return _block(x, layer, cfg, mesh=None, ffn=ep_ffn)
 
     blk = jax.checkpoint(block) if cfg.remat else block
 
@@ -359,14 +425,24 @@ def make_ep_train_step(mesh: Mesh, cfg, *, train=None,
                 + cfg.moe_z_weight * aux["z_loss"])
         return loss, {"ce": ce, **aux}
 
+    # Expert weights shard over ep on the expert dim; under ep×tp each
+    # expert's d_ff additionally column/row-shards over model.  Dense
+    # weights replicate (under tp each rank slices its own head/d_ff
+    # columns — the sp×tp approach, no split pytree needed).
+    if tp > 1:
+        w1_spec = P(None, ep_axis, None, model_axis)
+        w2_spec = P(None, ep_axis, model_axis, None)
+    else:
+        w1_spec = P(None, ep_axis, None, None)
+        w2_spec = P(None, ep_axis, None, None)
     p_specs = {
         "embed": P(None, None),
         "blocks": {
             "qkv": P(None, None, None),
             "attn_out": P(None, None, None),
             "router": P(None, None, None),
-            "w1": P(None, ep_axis, None, None),
-            "w2": P(None, ep_axis, None, None),
+            "w1": w1_spec,
+            "w2": w2_spec,
             "ln1": P(None, None), "ln2": P(None, None),
         },
         "ln_f": P(None),

@@ -110,6 +110,51 @@ def _local_qkv(y, layer_qkv, cfg: ModelConfig, model_axis: str | None,
     return q, k, v
 
 
+def tp_attention(x, y, layer, cfg: ModelConfig, *, model_axis: str,
+                 tp: int):
+    """Full-sequence TP attention on the packed weights, shared by the
+    tp-composed steps (ep×tp today; any future full-seq TP consumer):
+    per-rank head columns via _local_qkv (whole GQA groups), the fused
+    flash kernel or grouped einsum per cfg.resolved_attention(), and
+    the row-parallel output projection completed by one psum over
+    ``model_axis``.  Returns ``x + attention_out`` (the residual add).
+    """
+    import numpy as np
+
+    b, s, d = x.shape
+    h_loc = cfg.n_heads // tp
+    hkv_loc = cfg.kv_heads // tp
+    hd = cfg.head_dim
+    q, k, v = _local_qkv(y, layer["qkv"], cfg, model_axis, tp)
+    if cfg.rope:
+        q = _rope(q, cfg.rope_theta)
+        k = _rope(k, cfg.rope_theta)
+    if cfg.resolved_attention() == "pallas":
+        from tpu_autoscaler.workloads.attention import flash_attention
+
+        attn = flash_attention(
+            q, k, v, causal=True, window=cfg.attention_window,
+            block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+            interpret=jax.default_backend() != "tpu")
+    else:
+        from tpu_autoscaler.workloads.attention import causal_band_mask
+
+        qg = q.reshape(b, hkv_loc, h_loc // hkv_loc, s, hd)
+        scores = jnp.einsum("bngqd,bnkd->bngqk", qg, k) / np.sqrt(hd)
+        causal = causal_band_mask(s, cfg.attention_window)
+        scores = jnp.where(causal, scores.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bngqk,bnkd->bngqd", probs, v).reshape(
+            b, h_loc, s, hd)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h_loc * hd)
+    t = jax.lax.axis_index(model_axis)
+    wo = jax.lax.dynamic_slice_in_dim(
+        layer["attn_out"].astype(cfg.dtype), t * h_loc * hd,
+        h_loc * hd, 0)
+    out = jnp.einsum("bse,ed->bsd", attn, wo)
+    return x + jax.lax.psum(out, model_axis)
+
+
 def _sp_block(x, layer, cfg: ModelConfig, *, seq_axis: str, impl: str,
               block_q: int, interpret: bool,
               model_axis: str | None = None, tp: int = 1):
