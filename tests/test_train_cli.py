@@ -171,6 +171,15 @@ class TestComposedCli:
         assert "training complete at step 10" in result.stderr
         assert "balance" in result.stderr
 
+    def test_ep_tp_trains(self, tmp_path):
+        result = run_train_multi(
+            tmp_path, "--steps", "4", "--ep", "2", "--tp", "2",
+            "--moe-experts", "4", "--checkpoint-every", "4")
+        assert result.returncode == 0, result.stderr
+        assert "training complete at step 4" in result.stderr
+        # (balance logging fires on step%10 ticks — covered by
+        # test_ep_trains_with_balance_logs' 10-step run)
+
     def test_ep_without_moe_rejected(self, tmp_path):
         result = run_train_multi(tmp_path, "--steps", "2", "--ep", "2")
         assert result.returncode != 0

@@ -173,21 +173,22 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
             raise click.UsageError(
                 "--ep is single-process only for now; multi-host jobs "
                 "should use the dp+tp step")
+        ep_tp = tp_degree or 1
         n_dev = len(jax.devices())
-        if n_dev % ep_degree:
+        if n_dev % (ep_degree * ep_tp):
             raise click.UsageError(
-                f"--ep {ep_degree} must divide the {n_dev} available "
-                f"devices")
-        if batch % n_dev:
+                f"--ep {ep_degree} x --tp {ep_tp} must divide the "
+                f"{n_dev} available devices")
+        if batch % (n_dev // ep_tp):
             raise click.UsageError(
-                f"--batch {batch} must divide over all {n_dev} devices "
-                f"(the batch shards over data×ep)")
+                f"--batch {batch} must divide over the {n_dev // ep_tp} "
+                f"data×ep devices")
         from tpu_autoscaler.workloads.moe import (
             make_ep_mesh,
             make_ep_train_step,
         )
 
-        mesh = make_ep_mesh(jax.devices(), ep=ep_degree)
+        mesh = make_ep_mesh(jax.devices(), ep=ep_degree, tp=ep_tp)
         try:
             ep_init, ep_step = make_ep_train_step(mesh, cfg,
                                                   train=train_cfg)
