@@ -228,6 +228,35 @@ class TestServeCli:
         assert len(lines[0]["tokens"]) == 5 and lines[0]["done"]
         assert len(lines[1]["tokens"]) == 3 and lines[1]["done"]
 
+    def test_serves_under_tp_mesh(self, tmp_path):
+        import json
+
+        trained = run_train(tmp_path, "--steps", "4",
+                            "--checkpoint-every", "4")
+        assert trained.returncode == 0, trained.stderr
+        reqs = tmp_path / "reqs.jsonl"
+        reqs.write_text('{"prompt": [3, 17, 4], "max_new_tokens": 4}\n')
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        # Same device count the training subprocess used (it inherits
+        # conftest's 8 virtual devices): the no-abstract restore pins
+        # the saved topology.
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        result = subprocess.run(
+            [sys.executable, "-m", "tpu_autoscaler.workloads.serve",
+             "--platform", "cpu", "--d-model", "32", "--n-layers", "1",
+             "--seq-len", "16",
+             "--checkpoint-dir", str(tmp_path / "ckpt"),
+             "--requests", str(reqs), "--slots", "4", "--chunk", "4",
+             "--max-len", "32", "--tp", "2"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert result.returncode == 0, result.stderr
+        assert "mesh {'data': 4, 'model': 2}" in result.stderr
+        out = json.loads(result.stdout.strip().splitlines()[0])
+        assert len(out["tokens"]) == 4 and out["done"]
+
     def test_random_requests_and_no_checkpoint_error(self, tmp_path):
         result = self.run_serve(tmp_path, "--random", "2")
         assert result.returncode != 0

@@ -52,6 +52,10 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--ring", is_flag=True,
               help="Ring cache: O(--attention-window) per-slot HBM, "
                    "unbounded sequence length (needs a window).")
+@click.option("--tp", "tp_degree", default=None, type=int,
+              help="Serve under a (data, model) mesh: slots shard over "
+                   "data, KV heads + cache over 'model' (the trainer's "
+                   "TP layout).  Default: single-device.")
 @click.option("--seed", default=0, show_default=True)
 @click.option("--annotations-file", default=None,
               help="Downward-API annotations path for the drain "
@@ -64,9 +68,9 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
-         max_len, chunk, ring, seed, annotations_file, vocab, seq_len,
-         d_model, n_layers, n_kv_heads, attention_window, no_rope,
-         moe_experts, moe_top_k, platform):
+         max_len, chunk, ring, tp_degree, seed, annotations_file, vocab,
+         seq_len, d_model, n_layers, n_kv_heads, attention_window,
+         no_rope, moe_experts, moe_top_k, platform):
     """Serve mixed-length requests from the latest checkpoint."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -97,7 +101,21 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
         raise click.UsageError(
             f"no checkpoint found in {checkpoint_dir!r} (train first: "
             f"python -m tpu_autoscaler.workloads.train)")
-    state = restore_checkpoint(checkpoint_dir, step, None)
+    try:
+        state = restore_checkpoint(checkpoint_dir, step, None)
+    except ValueError as e:
+        if "available devices are different" in str(e):
+            # Restoring WITHOUT an abstract tree inherits the saved
+            # shardings, which pins the device topology.  The trainer
+            # restores elastically (it rebuilds the abstract from its
+            # own live shardings — train.py); the server does not know
+            # the checkpoint's optimizer recipe, so it cannot.
+            raise click.UsageError(
+                "checkpoint was saved under a different device "
+                "topology; serve with the same device count, or resume "
+                "the trainer once on this topology to rewrite it: "
+                + str(e)) from e
+        raise
     if not isinstance(state, dict) or "params" not in state:
         raise click.UsageError(
             f"checkpoint at step {step} is not a trainer checkpoint "
@@ -140,8 +158,25 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
     if not reqs:
         raise click.UsageError("no requests to serve")
 
+    mesh = None
+    if tp_degree is not None and tp_degree > 1:
+        from tpu_autoscaler.workloads.model import make_mesh
+
+        n_dev = len(jax.devices())
+        if n_dev % tp_degree:
+            raise click.UsageError(
+                f"--tp {tp_degree} must divide the {n_dev} available "
+                f"devices")
+        dp = n_dev // tp_degree
+        if slots % dp:
+            raise click.UsageError(
+                f"--slots {slots} must divide over the {dp} "
+                f"data-parallel devices (devices / tp) — the slot "
+                f"batch shards over them")
+        mesh = make_mesh(tp=tp_degree)
+        log.info("serving under mesh %s", dict(mesh.shape))
     engine = ContinuousBatcher(params, cfg, slots=slots, max_len=max_len,
-                               chunk=chunk, ring=ring,
+                               chunk=chunk, ring=ring, mesh=mesh,
                                key=jax.random.PRNGKey(seed))
     import time
 

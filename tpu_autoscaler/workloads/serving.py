@@ -415,7 +415,30 @@ def make_prefill_chunk(cfg: ModelConfig, chunk: int, mesh=None,
         return last.astype(jnp.float32), SlotKVCache(
             k=k_new, v=v_new, lengths=lengths)
 
-    return jax.jit(fill)
+    if mesh is None:
+        return jax.jit(fill)
+    # Pin the SAME cache/param layouts as the decode step: without
+    # out_shardings, XLA's propagation would hand the decode step a
+    # cache committed to whatever layout the prefill computation chose,
+    # and its in_shardings would reject it.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_autoscaler.workloads.model import data_axes, param_specs
+
+    daxes = data_axes(mesh)
+    tp_ok = "model" in mesh.axis_names
+    kv = P(None, daxes, "model" if tp_ok else None, None, None)
+    cache_shard = SlotKVCache(
+        k=NamedSharding(mesh, kv), v=NamedSharding(mesh, kv),
+        lengths=NamedSharding(mesh, P(daxes)))
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(fill,
+                   in_shardings=(p_shard, cache_shard, replicated,
+                                 replicated, replicated),
+                   out_shardings=(replicated, cache_shard))
 
 
 @dataclasses.dataclass
@@ -465,6 +488,21 @@ class ContinuousBatcher:
         HBM becomes O(window + chunk) instead of O(max_len), and
         sequences may run PAST max_len — max_len then only bounds the
         per-request budget check, not the buffer."""
+        if mesh is not None:
+            # Re-place the params onto THIS mesh's TP layout: restored
+            # checkpoints arrive committed to the shardings they were
+            # saved under, and jit rejects committed args whose
+            # sharding differs from the step's in_shardings.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from tpu_autoscaler.workloads.model import param_specs
+
+            p_shard = jax.tree.map(
+                lambda spec: NamedSharding(mesh, spec),
+                param_specs(cfg.resolved_for_mesh(mesh)),
+                is_leaf=lambda x: isinstance(x, P))
+            params = jax.device_put(params, p_shard)
         self.params = params
         self.cfg = cfg
         self.chunk = chunk
