@@ -114,6 +114,26 @@ class TestGenerateCli:
         assert prompt.strip() == "1,2,3"
         assert len(gen.strip().split(",")) == 6
 
+    def test_serves_under_tp_mesh(self, tmp_path):
+        trained = run_train(tmp_path, "--steps", "4",
+                            "--checkpoint-every", "4")
+        assert trained.returncode == 0, trained.stderr
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        result = subprocess.run(
+            [sys.executable, "-m", "tpu_autoscaler.workloads.generate",
+             "--platform", "cpu", "--d-model", "32", "--n-layers", "1",
+             "--seq-len", "16",
+             "--checkpoint-dir", str(tmp_path / "ckpt"),
+             "--steps", "4", "--batch", "4", "--tp", "2"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert result.returncode == 0, result.stderr
+        assert "mesh {'data': 4, 'model': 2}" in result.stderr
+        assert len(result.stdout.strip().splitlines()) == 4
+
     def test_flag_mismatch_is_a_clean_error(self, tmp_path):
         result = run_train(tmp_path, "--steps", "3",
                            "--checkpoint-every", "3")

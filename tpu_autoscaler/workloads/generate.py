@@ -41,12 +41,18 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Nucleus sampling: keep the smallest token set with "
                    "cumulative probability >= this.")
 @click.option("--seed", default=0, show_default=True)
+@click.option("--tp", "tp_degree", default=None, type=int,
+              help="Serve under a (data, model) mesh via "
+                   "make_sharded_generate: prompts shard over data, "
+                   "params + KV cache over 'model' (the trainer's TP "
+                   "layout).  Default: single-device.")
 @model_arch_options
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
-         top_k, top_p, seed, vocab, seq_len, d_model, n_layers, n_kv_heads,
-         attention_window, no_rope, moe_experts, moe_top_k, platform):
+         top_k, top_p, seed, tp_degree, vocab, seq_len, d_model, n_layers,
+         n_kv_heads, attention_window, no_rope, moe_experts, moe_top_k,
+         platform):
     """Generate tokens from the latest checkpoint in --checkpoint-dir."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -133,8 +139,39 @@ def main(checkpoint_dir, steps, prompt, prompt_len, batch, temperature,
                                     dtype=jnp.int32)
 
     key = jax.random.PRNGKey(seed) if temperature > 0 else None
-    out = generate(params, tokens, cfg, steps, key=key,
-                   temperature=temperature, top_k=top_k, top_p=top_p)
+    if tp_degree is not None and tp_degree > 1:
+        from tpu_autoscaler.workloads.decode import make_sharded_generate
+        from tpu_autoscaler.workloads.model import make_mesh, param_specs
+
+        n_dev = len(jax.devices())
+        if n_dev % tp_degree:
+            raise click.UsageError(
+                f"--tp {tp_degree} must divide the {n_dev} available "
+                f"devices")
+        mesh = make_mesh(tp=tp_degree)
+        dp = n_dev // tp_degree
+        if batch % dp:
+            raise click.UsageError(
+                f"--batch {batch} must divide over the {dp} "
+                f"data-parallel devices (devices / tp)")
+        log.info("serving under mesh %s", dict(mesh.shape))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        p_shard = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            param_specs(cfg.resolved_for_mesh(mesh)),
+            is_leaf=lambda x: isinstance(x, P))
+        # Restored params arrive committed to their saved shardings;
+        # re-place them onto this mesh's TP layout.
+        params = jax.device_put(params, p_shard)
+        run = make_sharded_generate(
+            mesh, cfg, steps, temperature=temperature, top_k=top_k,
+            top_p=top_p)
+        out = run(params, tokens,
+                  key if key is not None else jax.random.PRNGKey(seed))
+    else:
+        out = generate(params, tokens, cfg, steps, key=key,
+                       temperature=temperature, top_k=top_k, top_p=top_p)
     prompt_n = tokens.shape[1]
     for row in out:
         ids = [int(t) for t in row]
