@@ -1,19 +1,25 @@
-"""Benchmark: north-star scale-up path, controller-side.
+"""Benchmark: the north-star scale-up path, end to end.
 
 The BASELINE metric is "Scale-up latency (Pending→Running) + stranded-chip %
-per N-chip JAX job".  Cloud VM boot time is out of the controller's hands
-(and unmeasurable in a bench sandbox), so this measures the part the
-framework owns: the REAL wall-clock the controller spends taking the
-256-chip north-star job from Unschedulable to Running against an
-instant-provisioning cloud — detection, gang grouping, shape fit, plan,
-actuation, readiness barrier, latency accounting — plus the scheduler sim.
+per N-chip JAX job".  Two tiers run here:
 
-Baseline comparison: the reference's detection alone is bounded by its
---sleep poll (default ~60 s, SURVEY.md §7) and its actuation is serialized
-one-ARM-deployment-at-a-time.  vs_baseline is reference_detection_bound /
-measured_overhead (higher is better).
+1. Zero-delay tier: every BASELINE config against an instant-provisioning
+   cloud — proves correctness (all Running, 0 stranded) and gates pure
+   controller overhead (detection, gang grouping, shape fit, plan,
+   actuation, readiness barrier) against a 20 ms CPU-time budget with the
+   cross-round trend.
+2. Realistic tier (the headline): every config again with the latency the
+   cloud actually charges — 90 s slice creation/VM boot, 2 s/host
+   registration spread (the PROVISIONING barrier), 5 s scheduler bind
+   batching — gated on the north star itself: v5p-256 Unschedulable→
+   Running < 360 s sim-time, with the detect/provision/register/bind
+   phase anatomy printed per config.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric": "north_star_v5p256_realistic_scaleup",
+"value": <sim seconds>, "unit": "s_simtime", "vs_baseline": budget/actual}
+(vs_baseline > 1 beats the < 6 min BASELINE.json north-star target; the
+reference publishes no numbers of its own, SURVEY.md §7).  The controller
+overhead stays visible as a stderr info line and keeps its regression gate.
 """
 
 from __future__ import annotations
@@ -34,18 +40,32 @@ OVERHEAD_BUDGET_S = 0.020
 
 def _overhead_trend() -> list:
     """Prior rounds' north-star overhead, oldest first, from the
-    BENCH_r*.json records the driver leaves at the repo root."""
+    BENCH_r*.json records the driver leaves at the repo root.
+
+    Rounds ≤ 4 carried the overhead as the parsed stdout headline; later
+    rounds emit it as a stderr info line (captured in the record's
+    "tail") because the headline became the realistic end-to-end latency.
+    """
     trend = []
     here = os.path.dirname(os.path.abspath(__file__))
     for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
         try:
             with open(path) as f:
-                parsed = json.load(f).get("parsed") or {}
-            if parsed.get("metric") == "north_star_v5p256_controller_overhead":
-                trend.append({"round": os.path.basename(path),
-                              "value_s": parsed.get("value")})
+                record = json.load(f)
         except (OSError, ValueError):
             continue
+        candidates = [record.get("parsed") or {}]
+        for line in (record.get("tail") or "").splitlines():
+            try:
+                candidates.append(json.loads(line))
+            except ValueError:
+                continue
+        for obj in candidates:
+            if (isinstance(obj, dict) and obj.get("metric")
+                    == "north_star_v5p256_controller_overhead"):
+                trend.append({"round": os.path.basename(path),
+                              "value_s": obj.get("value")})
+                break
     return trend
 
 
@@ -92,6 +112,105 @@ def run_north_star() -> dict:
         "chips": chips,
         "stranded": max(0, chips - chips_requested),
     }
+
+
+# Realistic-actuation profile for the end-to-end gate (VERDICT r4 item 1):
+# the zero-delay configs above prove correctness + controller overhead; this
+# profile re-runs every BASELINE config with the latency terms the cloud
+# actually charges — slice creation / VM boot, per-host registration spread
+# (the PROVISIONING barrier), and kube-scheduler bind batching — and gates
+# the north star itself: v5p-256 Unschedulable→Running < 360 s sim-time.
+REALISTIC_PROVISION_DELAY_S = 90.0   # QR accept → first VM boots
+REALISTIC_HOST_STAGGER_S = 2.0       # per-host kubelet registration spread
+REALISTIC_SCHEDULER_PERIOD_S = 5.0   # kube-scheduler bind batching
+NORTH_STAR_BUDGET_S = 360.0          # BASELINE.json north_star: < 6 min
+
+
+def run_realistic(scenario: str, chips_budget_s: float) -> dict:
+    """One BASELINE config under the realistic-actuation profile.
+
+    Returns {ok, latency_s, stranded, phases} where phases is the
+    detect / provision / register / bind anatomy of the latency, read
+    from the controller's own phase metrics (reconciler.py
+    PHASE_LATENCY_METRICS) — the same series a real cluster exports.
+    """
+    from tpu_autoscaler.actuators.fake import FakeActuator
+    from tpu_autoscaler.controller import Controller, ControllerConfig
+    from tpu_autoscaler.engine.planner import PoolPolicy
+    from tpu_autoscaler.k8s.fake import FakeKube
+    from tpu_autoscaler.sim import seed_scenario
+    from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+    kube = FakeKube()
+    actuator = FakeActuator(
+        kube, provision_delay=REALISTIC_PROVISION_DELAY_S,
+        stagger_seconds=REALISTIC_HOST_STAGGER_S)
+    controller = Controller(kube, actuator, ControllerConfig(
+        policy=PoolPolicy(spare_nodes=0)))
+    chips_requested = seed_scenario(kube, scenario)
+
+    def all_running() -> bool:
+        pods = kube.list_pods()
+        return bool(pods) and all(
+            p["status"]["phase"] == "Running" for p in pods)
+
+    sim_t, finished = 0.0, None
+    while sim_t <= 600.0:
+        controller.reconcile_once(now=sim_t)
+        if sim_t % REALISTIC_SCHEDULER_PERIOD_S == 0.0:
+            kube.schedule_step()
+        if all_running():
+            finished = sim_t
+            controller.reconcile_once(now=sim_t)  # record gang latency
+            break
+        sim_t += 1.0
+
+    snap = controller.metrics.snapshot()
+    summaries = snap["summaries"]
+    phases = {}
+    for name, label in (("detect_latency_seconds", "detect"),
+                        ("provision_latency_seconds", "provision"),
+                        ("ready_barrier_seconds", "register"),
+                        ("bind_latency_seconds", "bind")):
+        s = summaries.get(name, {})
+        if s.get("count"):
+            phases[label] = round(s["max"], 1)
+    chips = sum(
+        int(float(n["status"]["allocatable"].get(TPU_RESOURCE, 0)))
+        for n in kube.list_nodes())
+    latency = summaries.get("scale_up_latency_seconds", {}).get("max")
+    if latency is None:
+        latency = finished
+    stranded = max(0, chips - chips_requested)
+    ok = (finished is not None and stranded == 0
+          and latency is not None and latency < chips_budget_s)
+    return {"ok": ok, "latency_s": latency, "stranded": stranded,
+            "phases": phases}
+
+
+def check_realistic_configs() -> tuple[bool, float | None]:
+    """Gate every BASELINE config under realistic actuation latency.
+
+    Returns (all_ok, north_star_latency_s) — the latter is the v5p-256
+    end-to-end sim-time, the bench's headline metric.
+    """
+    ok, north_star = True, None
+    for scenario in ("cpu", "v5e-8", "v5e-64", "2xv5p-128", "v5p-256"):
+        r = run_realistic(scenario, NORTH_STAR_BUDGET_S)
+        ok = ok and r["ok"]
+        if scenario == "v5p-256":
+            north_star = r["latency_s"]
+        phase_txt = " ".join(f"{k}={v:g}s" for k, v in r["phases"].items())
+        lat = ("timeout" if r["latency_s"] is None
+               else f"{r['latency_s']:.1f}s")
+        print(f"{'PASS' if r['ok'] else 'FAIL'} [{scenario} realistic] "
+              f"Unschedulable→Running in {lat} sim-time "
+              f"(budget {NORTH_STAR_BUDGET_S:g}s, provision_delay="
+              f"{REALISTIC_PROVISION_DELAY_S:g}s, host_stagger="
+              f"{REALISTIC_HOST_STAGGER_S:g}s, scheduler_period="
+              f"{REALISTIC_SCHEDULER_PERIOD_S:g}s); stranded="
+              f"{r['stranded']}; phases: {phase_txt}", file=sys.stderr)
+    return ok, north_star
 
 
 def check_all_configs() -> bool:
@@ -169,6 +288,11 @@ def main() -> int:
         print(json.dumps({"error": "a BASELINE config failed"}),
               file=sys.stderr)
         return 1
+    realistic_ok, north_star_s = check_realistic_configs()
+    if not realistic_ok or north_star_s is None:
+        print(json.dumps({"error": "a BASELINE config failed under "
+                          "realistic actuation latency"}), file=sys.stderr)
+        return 1
     # Informational (stderr: stdout is ONE metric line by contract) —
     # except decision parity, which is a hard gate.
     try:
@@ -208,11 +332,23 @@ def main() -> int:
             "budget_s": OVERHEAD_BUDGET_S,
             "prior_rounds": trend}), file=sys.stderr)
         return 1
+    print(json.dumps({"info": "controller_overhead",
+                      "metric": "north_star_v5p256_controller_overhead",
+                      "value": round(value, 4), "unit": "s",
+                      "vs_detection_bound": round(
+                          REFERENCE_DETECTION_BOUND_S / value, 1)}),
+          file=sys.stderr)
+    # Headline: the BASELINE metric itself — end-to-end Unschedulable→
+    # Running sim-time for the 256-chip north star under realistic
+    # actuation latency.  vs_baseline is budget/actual against the
+    # < 6 min north-star target (>1 beats it); the old headline (pure
+    # controller overhead vs the reference's 60 s poll bound) stays as
+    # the stderr info line above.
     print(json.dumps({
-        "metric": "north_star_v5p256_controller_overhead",
-        "value": round(value, 4),
-        "unit": "s",
-        "vs_baseline": round(REFERENCE_DETECTION_BOUND_S / value, 1),
+        "metric": "north_star_v5p256_realistic_scaleup",
+        "value": round(north_star_s, 1),
+        "unit": "s_simtime",
+        "vs_baseline": round(NORTH_STAR_BUDGET_S / north_star_s, 2),
     }))
     return 0
 

@@ -18,9 +18,20 @@ def test_bench_emits_one_json_line():
     assert len(lines) == 1, f"stdout must be ONE line, got: {lines}"
     payload = json.loads(lines[0])
     assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
-    assert payload["metric"] == "north_star_v5p256_controller_overhead"
-    assert payload["unit"] == "s"
-    assert 0 < payload["value"] < 10
+    assert payload["metric"] == "north_star_v5p256_realistic_scaleup"
+    assert payload["unit"] == "s_simtime"
+    # The BASELINE north star: < 6 min end-to-end under realistic
+    # actuation latency; vs_baseline is budget/actual (>1 beats it).
+    assert 0 < payload["value"] < 360
     assert payload["vs_baseline"] > 1
-    # All five config gates reported PASS on stderr.
-    assert result.stderr.count("PASS ") == 5
+    # Five zero-delay config gates + five realistic-latency gates PASSed.
+    assert result.stderr.count("PASS ") == 10
+    realistic = [ln for ln in result.stderr.splitlines()
+                 if "realistic]" in ln]
+    assert len(realistic) == 5
+    # The v5p-256 line carries the per-phase latency anatomy.
+    ns = next(ln for ln in realistic if "v5p-256" in ln)
+    for phase in ("detect=", "provision=", "register=", "bind="):
+        assert phase in ns, ns
+    # The controller-overhead regression gate still ran (stderr info).
+    assert "north_star_v5p256_controller_overhead" in result.stderr

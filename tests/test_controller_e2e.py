@@ -107,6 +107,65 @@ class TestConfig2SingleHostV5e8:
         assert 119 <= lat <= 125
 
 
+class TestPhaseLatencyAnatomy:
+    """The north-star latency's detect/provision/register/bind phase
+    metrics populate (as histograms) under staggered host registration."""
+
+    def test_phases_populate_under_stagger(self):
+        kube = FakeKube()
+        actuator = FakeActuator(kube, provision_delay=30.0,
+                                stagger_seconds=2.0)
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0), grace_seconds=GRACE,
+            idle_threshold_seconds=IDLE))
+        shape = shape_by_name("v5e-64")  # 16 hosts
+        for p in make_gang(shape, job="gang"):
+            kube.add_pod(p)
+        run_loop(kube, controller, until=300.0, stop_when=lambda: all(
+            pod_running(kube, f"gang-{i}") for i in range(16)))
+        snap = controller.metrics.snapshot()
+        s = snap["summaries"]
+        detect = s["detect_latency_seconds"]["last"]
+        provision = s["provision_latency_seconds"]["last"]
+        register = s["ready_barrier_seconds"]["last"]
+        bind = s["bind_latency_seconds"]["last"]
+        total = s["scale_up_latency_seconds"]["last"]
+        assert detect <= 1.0          # watch-speed detection
+        # Provision spans boot (30 s) + the 15-host registration tail.
+        assert provision == pytest.approx(60.0, abs=3)
+        assert register == pytest.approx(30.0, abs=3)  # 15 hosts x 2 s
+        assert 0.0 <= bind <= 3.0
+        assert total == pytest.approx(detect + provision + bind, abs=3)
+        # Declared as histograms: bucket counts populated on the endpoint.
+        hist = snap["histograms"]["provision_latency_seconds"]["buckets"]
+        assert any(c > 0 for _, c in hist)
+        text = controller.metrics.render_prometheus()
+        assert 'provision_latency_seconds_bucket{le="+Inf"} 1' in text
+        assert 'bind_latency_seconds_bucket{le=' in text
+
+    def test_barrier_holds_while_hosts_register(self):
+        """While hosts are still registering, pods must not bind and the
+        unit must classify PROVISIONING (tracker barrier vs catalog host
+        count) — regression guard for the bind-latency accounting."""
+        kube = FakeKube()
+        actuator = FakeActuator(kube, provision_delay=10.0,
+                                stagger_seconds=10.0)
+        controller = Controller(kube, actuator, ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0), grace_seconds=GRACE,
+            idle_threshold_seconds=IDLE))
+        shape = shape_by_name("v5e-64")
+        for p in make_gang(shape, job="gang"):
+            kube.add_pod(p)
+        # 60 s in: boot done, ~6 of 16 hosts registered, all Ready.
+        run_loop(kube, controller, until=60.0)
+        nodes = kube.list_nodes()
+        assert 0 < len(nodes) < 16
+        slice_id = nodes[0]["metadata"]["labels"][
+            "autoscaler.tpu.dev/slice-id"]
+        assert controller.tracker.all_ready_since(slice_id) is None
+        assert not any(pod_running(kube, f"gang-{i}") for i in range(16))
+
+
 class TestMultiHostGang:
     """BASELINE config #3: v5e-64 JobSet gang across 16 hosts."""
 

@@ -33,6 +33,24 @@ class TestMetrics:
         assert "scale_up_latency_seconds_count 1" in text
         assert "scale_up_latency_seconds_max 42.0" in text
 
+    def test_histogram_declaration_and_rendering(self):
+        m = Metrics()
+        m.declare_histogram("scale_up_latency_seconds", (60.0, 360.0))
+        m.observe("scale_up_latency_seconds", 42.0)
+        m.observe("scale_up_latency_seconds", 200.0)
+        m.observe("scale_up_latency_seconds", 999.0)
+        snap = m.snapshot()
+        assert snap["histograms"]["scale_up_latency_seconds"]["buckets"] \
+            == [(60.0, 1), (360.0, 2)]
+        text = m.render_prometheus()
+        assert "# TYPE scale_up_latency_seconds histogram" in text
+        assert 'scale_up_latency_seconds_bucket{le="60"} 1' in text
+        assert 'scale_up_latency_seconds_bucket{le="360"} 2' in text
+        assert 'scale_up_latency_seconds_bucket{le="+Inf"} 3' in text
+        assert "scale_up_latency_seconds_count 3" in text
+        # Histogram names must not ALSO render in summary form.
+        assert "# TYPE scale_up_latency_seconds summary" not in text
+
     def test_metric_name_sanitized(self):
         m = Metrics()
         m.inc("weird-name.with/chars")

@@ -55,6 +55,23 @@ class TestBarrierAndGrace:
         view = tracker.observe("s1", slice_nodes(), [], now=100.0 + GRACE + 1)
         assert classify(view) is SliceState.IDLE
 
+    def test_partial_registration_holds_barrier(self):
+        """Hosts of a multi-host slice register gradually: a subset that is
+        individually Ready must NOT clear the barrier (a 4-of-16 v5e-64 is
+        not a usable slice) — the catalog's host count is the authority."""
+        tracker = SliceTracker()
+        nodes = slice_nodes()  # v5e-64: 16 hosts
+        view = tracker.observe("s1", nodes[:4], [], now=100.0)
+        assert view.all_ready_since is None
+        assert classify(view) is SliceState.PROVISIONING
+        # Still partial at a later pass: barrier still holds.
+        view = tracker.observe("s1", nodes[:15], [], now=150.0)
+        assert classify(view) is SliceState.PROVISIONING
+        # Full registration clears it at the CURRENT pass's time.
+        view = tracker.observe("s1", nodes, [], now=200.0)
+        assert view.all_ready_since == 200.0
+        assert classify(view) is SliceState.LAUNCH_GRACE
+
     def test_ready_then_host_lost_is_unhealthy(self):
         tracker = SliceTracker()
         nodes = slice_nodes()

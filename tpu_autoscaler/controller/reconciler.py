@@ -101,6 +101,29 @@ class ControllerConfig:
     no_maintenance: bool = False
 
 
+# Prometheus histogram bucket bounds (seconds) for the north-star phase
+# latencies.  Spans watch-triggered detection (sub-second) through the
+# 6-minute BASELINE budget and the cloud's worst provisioning tail, so a
+# real cluster run exports the end-to-end latency distribution directly.
+LATENCY_BUCKETS: tuple[float, ...] = (
+    0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0, 240.0, 360.0, 600.0,
+    1200.0)
+
+# The per-gang phase anatomy of scale_up_latency_seconds (SURVEY §4.2):
+#   detect    — gang first seen Unschedulable → provision submitted
+#   provision — provision submitted → slice ACTIVE (VM boot + registration)
+#   register  — first host registered → all hosts Ready (the barrier;
+#               overlaps the provision tail by definition)
+#   bind      — supply Ready (and gang pending) → all pods Running
+PHASE_LATENCY_METRICS: tuple[str, ...] = (
+    "detect_latency_seconds",
+    "provision_latency_seconds",
+    "ready_barrier_seconds",
+    "bind_latency_seconds",
+    "scale_up_latency_seconds",
+)
+
+
 class Controller:
     def __init__(self, client: KubeClient, actuator: Actuator,
                  config: ControllerConfig | None = None,
@@ -117,9 +140,14 @@ class Controller:
             actuator.set_metrics(self.metrics)
         self.planner = Planner(self.config.policy)
         self.tracker = SliceTracker()
+        for name in PHASE_LATENCY_METRICS:
+            self.metrics.declare_histogram(name, LATENCY_BUCKETS)
         # Gang lifecycle: first time each gang was seen Unschedulable, for
         # the north-star latency metric; cleared when the gang runs.
         self._gang_first_pending: dict[tuple, float] = {}
+        # Gangs whose detect phase (first pending → first provision
+        # submitted) has been observed; swept with _gang_first_pending.
+        self._gang_detect_observed: set[tuple] = set()
         self._drain_started: dict[str, float] = {}
         # Drains begun for idleness (not requested/unhealthy) may be
         # cancelled if matching demand appears before deletion.
@@ -163,7 +191,7 @@ class Controller:
 
         pending = [p for p in pods if p.is_unschedulable]
         gangs = group_into_gangs(pending)
-        self._track_gang_latency(gangs, pods, now)
+        self._track_gang_latency(gangs, pods, nodes, now)
         # Settling only delays SIZING (the _scale path); _maintain still
         # sees every pending gang so reclaim deferral protects supply a
         # settling gang will bind to.
@@ -338,11 +366,18 @@ class Controller:
                 self.metrics.observe("stranded_chips", req.stranded_chips)
             self.notifier.notify(
                 f"scaling up: {req.count}x {req.shape_name} — {req.reason}")
+            if req.kind == "cpu-node":
+                # CPU provisions aggregate demand across gangs (no
+                # gang_key): every pending CPU gang is being detected by
+                # this submission for the phase anatomy's purposes.
+                self._observe_detect(
+                    (g.key for g in gangs if not g.requests_tpu), now)
             if req.gang_key is not None:
                 # gang_keys lists the exact cohort a multislice request
                 # serves (a sibling bound to an existing free slice is not
                 # in it and must not get a misleading scale-up event).
                 member_keys = set(req.gang_keys) or {req.gang_key}
+                self._observe_detect(member_keys, now)
                 served_gangs = [g for g in gangs if g.key in member_keys]
                 for pod in (p for g in served_gangs for p in g.pods):
                     self._emit_event(
@@ -604,8 +639,18 @@ class Controller:
                     f"provision {status.request.shape_name} failed: "
                     f"{status.error}")
 
+    def _observe_detect(self, gang_keys, now: float) -> None:
+        """Detect phase: gang first seen Unschedulable → first provision
+        submitted on its behalf.  Once per gang lifetime."""
+        for key in gang_keys:
+            first = self._gang_first_pending.get(key)
+            if first is not None and key not in self._gang_detect_observed:
+                self._gang_detect_observed.add(key)
+                self.metrics.observe("detect_latency_seconds",
+                                     max(0.0, now - first))
+
     def _track_gang_latency(self, pending: list[Gang], pods: list[Pod],
-                            now: float) -> None:
+                            nodes: list[Node], now: float) -> None:
         for gang in pending:
             self._gang_first_pending.setdefault(gang.key, now)
         if not self._gang_first_pending:
@@ -613,21 +658,52 @@ class Controller:
         by_key: dict[tuple, list[Pod]] = {}
         for p in pods:
             by_key.setdefault(p.gang_key, []).append(p)
+        node_by_name = {n.name: n for n in nodes}
         for key, first in list(self._gang_first_pending.items()):
             members = by_key.get(key, [])
             if members and all(p.phase == "Running" for p in members):
                 latency = now - first
                 self.metrics.observe("scale_up_latency_seconds", latency)
+                self._observe_bind_latency(members, node_by_name, first,
+                                           now)
                 log.info("gang %s Unschedulable→Running in %.1fs", key,
                          latency)
                 del self._gang_first_pending[key]
+                self._gang_detect_observed.discard(key)
             elif not members:
                 # Gang's pods were deleted while pending: drop the entry so
                 # a reused Job name doesn't inherit a stale start time.
                 del self._gang_first_pending[key]
+                self._gang_detect_observed.discard(key)
         live_keys = {p.gang_key for p in pods}
         for key in [k for k in self._gang_sizes if k not in live_keys]:
             del self._gang_sizes[key]
+
+    def _observe_bind_latency(self, members: list[Pod],
+                              node_by_name: dict[str, Node],
+                              first_pending: float, now: float) -> None:
+        """Bind phase: supply Ready (and gang pending) → all pods Running.
+
+        Measured from the latest of (slowest unit's barrier clear, gang
+        first pending) — a gang that binds to a slice Ready long before it
+        arrived spent no time at all waiting on the scheduler's account.
+        """
+        from tpu_autoscaler.k8s.units import group_supply_units
+
+        bound_nodes = [node_by_name[p.node_name] for p in members
+                       if p.node_name in node_by_name]
+        if len(bound_nodes) < len(members):
+            return  # a member's node is already gone: no honest number
+        ready_times = []
+        for unit_id in group_supply_units(bound_nodes):
+            since = self.tracker.all_ready_since(unit_id)
+            if since is None:
+                return  # barrier not tracked yet this process lifetime
+            ready_times.append(since)
+        if ready_times:
+            start = max(max(ready_times), first_pending)
+            self.metrics.observe("bind_latency_seconds",
+                                 max(0.0, now - start))
 
     # ---- scale-down / maintenance -------------------------------------- #
 

@@ -50,6 +50,22 @@ class Metrics:
         self._counters: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
         self._summaries: dict[str, _Summary] = defaultdict(_Summary)
+        # name -> sorted upper bounds; observations on a declared name
+        # additionally populate cumulative bucket counts so the north-star
+        # phase latencies export as real Prometheus histograms (a cluster
+        # run produces the BASELINE latency distribution directly, not
+        # just count/sum/max).
+        self._hist_buckets: dict[str, tuple[float, ...]] = {}
+        self._hist_counts: dict[str, list[int]] = {}
+
+    def declare_histogram(self, name: str,
+                          buckets: tuple[float, ...]) -> None:
+        with self._lock:
+            bounds = tuple(sorted(buckets))
+            if self._hist_buckets.get(name) == bounds:
+                return
+            self._hist_buckets[name] = bounds
+            self._hist_counts[name] = [0] * len(bounds)
 
     def inc(self, name: str, by: float = 1.0) -> None:
         with self._lock:
@@ -62,6 +78,12 @@ class Metrics:
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._summaries[name].observe(value)
+            bounds = self._hist_buckets.get(name)
+            if bounds:
+                counts = self._hist_counts[name]
+                for i, le in enumerate(bounds):
+                    if value <= le:
+                        counts[i] += 1
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -70,6 +92,10 @@ class Metrics:
                 "gauges": dict(self._gauges),
                 "summaries": {k: s.as_dict()
                               for k, s in self._summaries.items()},
+                "histograms": {
+                    name: {"buckets": list(zip(bounds,
+                                               self._hist_counts[name]))}
+                    for name, bounds in self._hist_buckets.items()},
             }
 
     def render_prometheus(self) -> str:
@@ -86,13 +112,26 @@ class Metrics:
         for name, v in sorted(snap["gauges"].items()):
             lines.append(f"# TYPE {clean(name)} gauge")
             lines.append(f"{clean(name)} {v}")
+        hists = snap.get("histograms", {})
         for name, s in sorted(snap["summaries"].items()):
             n = clean(name)
+            if name in hists:
+                continue  # exported as a histogram below
             lines.append(f"# TYPE {n} summary")
             lines.append(f"{n}_count {s.get('count', 0)}")
             if s.get("count"):
                 lines.append(f"{n}_sum {s['sum']}")
                 lines.append(f"{n}_max {s['max']}")
+        for name, h in sorted(hists.items()):
+            n = clean(name)
+            s = snap["summaries"].get(name, {})
+            count = s.get("count", 0)
+            lines.append(f"# TYPE {n} histogram")
+            for le, cum in h["buckets"]:
+                lines.append(f'{n}_bucket{{le="{le:g}"}} {cum}')
+            lines.append(f'{n}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{n}_sum {s.get('sum', 0.0)}")
+            lines.append(f"{n}_count {count}")
         return "\n".join(lines) + "\n"
 
     def serve(self, port: int) -> threading.Thread:

@@ -15,6 +15,7 @@ import dataclasses
 
 from tpu_autoscaler.k8s.objects import Node, Pod
 from tpu_autoscaler.state.machine import SliceView
+from tpu_autoscaler.topology.catalog import shape_from_selectors
 
 # Annotation stamped on nodes we cordon, so drain ownership survives
 # process restarts (the one piece of state the crash-only design persists,
@@ -39,12 +40,31 @@ class SliceTracker:
     def forget(self, slice_id: str) -> None:
         self._times.pop(slice_id, None)
 
+    def all_ready_since(self, slice_id: str) -> float | None:
+        """When the slice's readiness barrier cleared (None if it never
+        has this process lifetime) — feeds bind_latency_seconds."""
+        t = self._times.get(slice_id)
+        return t.all_ready_since if t else None
+
     def observe(self, slice_id: str, nodes: list[Node], pods: list[Pod],
                 now: float) -> SliceView:
         """Update timers from one observation and produce a SliceView."""
         t = self._times.setdefault(slice_id, _SliceTimes())
 
         all_ready = bool(nodes) and all(n.is_ready for n in nodes)
+        if all_ready and nodes[0].is_tpu:
+            # Hosts of a multi-host slice register gradually; until the
+            # count matches the shape's host count the barrier holds even
+            # if every host seen SO FAR is Ready (a 1-of-64-registered
+            # v5p-256 is not a usable slice).  The expected count comes
+            # from the accelerator/topology labels every GKE TPU node
+            # carries; an unknown shape falls back to observed-only.
+            try:
+                shape = shape_from_selectors(nodes[0].labels)
+            except KeyError:
+                shape = None
+            if shape is not None and len(nodes) < shape.hosts:
+                all_ready = False
         if all_ready and t.all_ready_since is None:
             t.all_ready_since = now
 
