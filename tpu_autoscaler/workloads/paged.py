@@ -1,0 +1,611 @@
+"""Paged KV cache + batched prefill: the serving engine's memory system.
+
+serving.py's SlotKVCache reserves ``slots x max_len`` HBM up front —
+every admitted sequence pays for the longest possible sequence whether it
+uses it or not, which caps concurrency at mixed lengths.  This module
+replaces that reservation with the vLLM/PagedAttention design, re-shaped
+for XLA's static-shape constraint:
+
+- **PagedKVCache**: one global pool of fixed-size blocks
+  (``k, v: [layers, num_blocks, kv_heads, block_size, head_dim]``).  A
+  sequence owns a *block table* — the list of pool blocks holding its
+  keys in order.  HBM cost per sequence is ceil(len / block_size) blocks,
+  not max_len.
+- **Host-side allocator, device-side data**: block allocation/free is
+  host scheduling (BlockAllocator's free list); the compiled programs
+  receive block tables as traced int32 inputs.  No device-side shape
+  ever depends on occupancy — admission, growth, eviction, and
+  preemption all happen without recompilation.
+- **On-demand growth + preemption**: blocks are allocated as sequences
+  cross block boundaries.  A full pool preempts the youngest sequence
+  (its blocks free instantly; the request re-queues for a fresh
+  prefill) — so the pool can be sized for the *expected* load, not the
+  worst case, exactly the PagedAttention economics.
+- **Batched prefill**: up to ``prefill_lanes`` prompts enter the cache
+  per tick in ONE compiled program (serving.py admits one chunk per
+  tick — a deep queue of short prompts serializes behind it).  Each
+  lane scatters its chunk into its own pages and attends with its own
+  causal+window mask.
+
+The decode/prefill reads gather each row's pages into a contiguous
+[row, kv_heads, len, head_dim] view and then reuse the SAME per-row-
+length attention as the linear engine (flash_decode's SMEM lengths on
+TPU, the einsum mask elsewhere) — greedy decoding is bit-exact vs the
+linear cache, which the parity tests assert.
+
+Reference: the reference repo has no serving stack at all (SURVEY §3);
+this extends the beyond-parity serving story of serving.py (VERDICT r4
+item 3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_autoscaler.workloads.model import (
+    ModelConfig,
+    _rmsnorm,
+    _split_qkv,
+)
+from tpu_autoscaler.workloads.serving import (
+    ContinuousBatcher,
+    Request,
+    _rope_rows,
+    _slot_attend,
+)
+
+__all__ = ["PagedKVCache", "BlockAllocator", "PagedBatcher", "Request",
+           "make_paged_decode_step", "make_paged_prefill"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """Global block pool + per-slot block tables.
+
+    k, v: [layers, num_blocks, kv_heads, block_size, head_dim].
+    lengths: [slots] int32 — logical sequence length per slot.
+    Block tables live HOST-side in the engine (numpy) and enter each
+    compiled call as arguments; the pool itself is the only large
+    device buffer.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+    @classmethod
+    def zeros(cls, cfg: ModelConfig, num_blocks: int,
+              block_size: int) -> "PagedKVCache":
+        shape = (cfg.n_layers, num_blocks, cfg.kv_heads, block_size,
+                 cfg.head_dim)
+        return cls(k=jnp.zeros(shape, cfg.dtype),
+                   v=jnp.zeros(shape, cfg.dtype),
+                   lengths=jnp.zeros((0,), jnp.int32))  # set by engine
+
+
+class BlockAllocator:
+    """Host-side free list over the pool.  ``-1`` in a block table means
+    "no block" — compiled programs turn it into an out-of-range index
+    whose reads are masked by the per-row length and whose writes drop
+    (jnp ``mode='drop'`` semantics)."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def alloc(self) -> int | None:
+        return self._free.pop() if self._free else None
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if b >= 0:
+                self._free.append(int(b))
+
+
+def _gather_rows(pool, tables):
+    """[L?, nb, hkv, bs, hd] pool + [rows, tpr] tables ->
+    [rows, hkv, tpr*bs, hd] contiguous per-row caches (one layer's
+    pool: [nb, hkv, bs, hd]).  Table entries < 0 read block 0 — their
+    positions sit at/after the row's length, so attention never looks
+    at them and writes never target them."""
+    nb, hkv, bs, hd = pool.shape
+    safe = jnp.clip(tables, 0, nb - 1)
+    rows_blocks = pool[safe]                     # [rows, tpr, hkv, bs, hd]
+    rows, tpr = tables.shape
+    return rows_blocks.transpose(0, 2, 1, 3, 4).reshape(
+        rows, hkv, tpr * bs, hd)
+
+
+def _scatter_token(pool, new, tables, positions, active):
+    """Write one token per row into the pool.  pool [nb, hkv, bs, hd];
+    new [rows, hkv, 1, hd]; positions [rows] absolute; active [rows]
+    bool.  Inactive rows (or rows whose block table has no block at the
+    position — cannot happen when the engine allocates ahead) drop."""
+    nb, hkv, bs, hd = pool.shape
+    block_idx = jnp.clip(positions // bs, 0, tables.shape[1] - 1)
+    block = jnp.take_along_axis(tables, block_idx[:, None], axis=1)[:, 0]
+    block = jnp.where(active & (block >= 0), block, nb)  # nb => drop
+    off = positions % bs
+    return pool.at[block, :, off, :].set(new[:, :, 0, :], mode="drop")
+
+
+def _scatter_chunk(pool, new, table_row, offset, n_valid):
+    """Write one lane's prefill chunk into its pages.  pool
+    [nb, hkv, bs, hd]; new [hkv, chunk, hd]; table_row [tpr];
+    offset scalar (lane's length before the chunk); lanes drop entries
+    past n_valid."""
+    nb, hkv, bs, hd = pool.shape
+    chunk = new.shape[1]
+    i = jnp.arange(chunk)
+    pos = offset + i
+    block = table_row[jnp.clip(pos // bs, 0, table_row.shape[0] - 1)]
+    block = jnp.where((i < n_valid) & (block >= 0), block, nb)
+    off = pos % bs
+    return pool.at[block, :, off, :].set(
+        new.transpose(1, 0, 2), mode="drop")
+
+
+def make_paged_decode_step(cfg: ModelConfig, tokens_per_row: int,
+                           mesh=None):
+    """Build ``step(params, cache, tables, tokens, active) -> (logits,
+    cache)``: one token for every slot, reading/writing through the
+    block tables.  tables: [slots, tokens_per_row // block_size] int32.
+
+    The cache read gathers each row's pages into a contiguous view and
+    runs the same per-row-length kernel as the linear engine
+    (flash_decode on TPU via _slot_attend) — bit-exact parity with
+    serving.py's decode step.
+
+    ``mesh``: tensor-parallel serving shards KV heads over 'model' and
+    replicates the pool's block dim + the slot rows (the pool is shared
+    state across all slots, so slots cannot shard over data axes the
+    way the linear cache's rows do; data-parallel serving runs one
+    engine per replica instead — see PagedBatcher docstring).
+    """
+    if mesh is not None:
+        cfg = cfg.resolved_for_mesh(mesh)
+
+    def step(params, cache: PagedKVCache, tables, tokens, active):
+        from tpu_autoscaler.workloads.model import _ffn_residual
+
+        x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]
+        positions = cache.lengths                          # [slots]
+
+        def body(carry, inputs):
+            x = carry
+            layer, k_pool, v_pool = inputs
+            b, s, d = x.shape
+            y = _rmsnorm(x, layer["ln1"])
+            q, k, v = _split_qkv(y, layer["qkv"], cfg)
+            if cfg.rope:
+                q = _rope_rows(q, cfg.rope_theta, positions)
+                k = _rope_rows(k, cfg.rope_theta, positions)
+            k_pool = _scatter_token(k_pool, k, tables, positions, active)
+            v_pool = _scatter_token(v_pool, v, tables, positions, active)
+            k_rows = _gather_rows(k_pool, tables)
+            v_rows = _gather_rows(v_pool, tables)
+            attn = _slot_attend(q, k_rows, v_rows, positions + 1, cfg,
+                                mesh)
+            attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+            x = x + jnp.einsum("bsd,de->bse", attn,
+                               layer["attn_out"].astype(cfg.dtype))
+            y = _rmsnorm(x, layer["ln2"])
+            return _ffn_residual(x, y, layer, cfg), (k_pool, v_pool)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["blocks"], cache.k, cache.v))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["unembed"].astype(cfg.dtype))
+        new_cache = PagedKVCache(
+            k=k_new, v=v_new,
+            lengths=cache.lengths + active.astype(jnp.int32))
+        return logits[:, 0].astype(jnp.float32), new_cache
+
+    if mesh is None:
+        return jax.jit(step)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_autoscaler.workloads.model import param_specs
+
+    tp_ok = "model" in mesh.axis_names
+    kv = P(None, None, "model" if tp_ok else None, None, None)
+    cache_shard = PagedKVCache(
+        k=NamedSharding(mesh, kv), v=NamedSharding(mesh, kv),
+        lengths=NamedSharding(mesh, P()))
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(step,
+                   in_shardings=(p_shard, cache_shard, repl, repl, repl),
+                   out_shardings=(repl, cache_shard))
+
+
+def make_paged_prefill(cfg: ModelConfig, chunk: int, lanes: int,
+                       tokens_per_row: int, mesh=None):
+    """Build ``fill(params, cache, tables, tokens, offsets, n_valid) ->
+    (logits, cache)``: append one chunk to EACH of ``lanes`` prompts in
+    one compiled program.
+
+    tables:  [lanes, tokens_per_row // block_size] — each lane's pages.
+    tokens:  [lanes, chunk] int32 (padded past n_valid).
+    offsets: [lanes] int32 — lane's length before this chunk.
+    n_valid: [lanes] int32 — real tokens this chunk (0 = inactive lane).
+
+    Returns logits [lanes, vocab] at each lane's last valid position
+    (the generation seed when the lane just finished its prompt) and
+    the updated pool.  serving.py admits ONE chunk per tick — this is
+    the batched-admission fix (VERDICT r4 item 3): a burst of short
+    prompts admits together instead of serializing, and a long prompt
+    no longer blocks the queue behind its full length.
+    """
+    if mesh is not None:
+        cfg = cfg.resolved_for_mesh(mesh)
+
+    def fill(params, cache: PagedKVCache, tables, tokens, offsets,
+             n_valid):
+        from tpu_autoscaler.workloads.model import _ffn_residual
+
+        x = params["embed"].astype(cfg.dtype)[tokens]     # [lanes, chunk, d]
+
+        def body(carry, inputs):
+            x = carry
+            layer, k_pool, v_pool = inputs
+            b, s, d = x.shape
+            y = _rmsnorm(x, layer["ln1"])
+            q, k, v = _split_qkv(y, layer["qkv"], cfg)     # [b, h, s, hd]
+            if cfg.rope:
+                q = _rope_rows(q, cfg.rope_theta, offsets)
+                k = _rope_rows(k, cfg.rope_theta, offsets)
+            k_pool = jax.lax.fori_loop(
+                0, b, lambda i, p: _scatter_chunk(
+                    p, k[i], tables[i], offsets[i], n_valid[i]), k_pool)
+            v_pool = jax.lax.fori_loop(
+                0, b, lambda i, p: _scatter_chunk(
+                    p, v[i], tables[i], offsets[i], n_valid[i]), v_pool)
+            # Attend: each lane over its own gathered pages; causal
+            # within the chunk plus everything before the offset.
+            k_rows = _gather_rows(k_pool, tables)  # [lanes, hkv, T, hd]
+            v_rows = _gather_rows(v_pool, tables)
+            hkv = k_rows.shape[1]
+            hd = cfg.head_dim
+            max_len = k_rows.shape[2]
+            qg = q.reshape(b, hkv, cfg.n_heads // hkv, s, hd)
+            scores = jnp.einsum("bngqd,bnkd->bngqk", qg,
+                                k_rows) * hd ** -0.5
+            qpos = offsets[:, None] + jnp.arange(s)[None, :]   # [b, s]
+            kpos = jnp.arange(max_len)
+            visible = kpos[None, None, :] <= qpos[..., None]   # [b,s,T]
+            if cfg.attention_window is not None:
+                visible &= kpos[None, None, :] > (
+                    qpos[..., None] - cfg.attention_window)
+            scores = jnp.where(visible[:, None, None],
+                               scores.astype(jnp.float32), -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            attn = jnp.einsum("bngqk,bnkd->bngqd", probs,
+                              v_rows).reshape(b, cfg.n_heads, s, hd)
+            attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+            x = x + jnp.einsum("bsd,de->bse", attn,
+                               layer["attn_out"].astype(cfg.dtype))
+            y = _rmsnorm(x, layer["ln2"])
+            return _ffn_residual(x, y, layer, cfg), (k_pool, v_pool)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["blocks"], cache.k, cache.v))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["unembed"].astype(cfg.dtype))
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
+        )[:, 0]                                            # [lanes, vocab]
+        return last.astype(jnp.float32), PagedKVCache(
+            k=k_new, v=v_new, lengths=cache.lengths)
+
+    if mesh is None:
+        return jax.jit(fill)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_autoscaler.workloads.model import param_specs
+
+    tp_ok = "model" in mesh.axis_names
+    kv = P(None, None, "model" if tp_ok else None, None, None)
+    cache_shard = PagedKVCache(
+        k=NamedSharding(mesh, kv), v=NamedSharding(mesh, kv),
+        lengths=NamedSharding(mesh, P()))
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(fill,
+                   in_shardings=(p_shard, cache_shard, repl, repl, repl,
+                                 repl),
+                   out_shardings=(repl, cache_shard))
+
+
+class PagedBatcher(ContinuousBatcher):
+    """Continuous batching over the paged cache.
+
+    Differences from the linear ContinuousBatcher it subclasses:
+
+    - HBM is the POOL (``num_blocks * block_size`` token-slots shared by
+      all sequences), not slots x max_len.  ``slots`` bounds concurrent
+      sequences; memory bounds them only through actual usage.
+    - Admission allocates blocks for the prompt only; decode grows a
+      sequence block-by-block as it crosses block boundaries.
+    - Pool exhaustion preempts the YOUNGEST sequence (fewest generated
+      tokens — the cheapest prefill to redo): its blocks free
+      immediately and its request re-queues, un-done.  Head-of-line
+      sequences therefore always complete (no deadlock).
+    - Up to ``prefill_lanes`` prompts prefill per tick in one program.
+
+    Tensor-parallel serving passes ``mesh`` (KV heads shard over
+    'model'); for data-parallel serving run one engine per replica —
+    the pool is shared mutable state across slots, which is exactly
+    what data sharding cannot cut.
+    """
+
+    def __init__(self, params, cfg: ModelConfig, *, slots: int = 4,
+                 max_len: int = 256, block_size: int = 16,
+                 num_blocks: int | None = None, chunk: int = 32,
+                 prefill_lanes: int = 2, mesh=None, key=None):
+        if max_len % block_size:
+            raise ValueError(f"max_len {max_len} must be a multiple of "
+                             f"block_size {block_size}")
+        # Paged geometry must exist before the parent's init calls our
+        # _build_device_state override.
+        self.block_size = block_size
+        self.tokens_per_row = max_len
+        self.blocks_per_row = max_len // block_size
+        self._num_blocks = (num_blocks if num_blocks is not None
+                            else slots * self.blocks_per_row)
+        self.prefill_lanes = prefill_lanes
+        self.preemptions = 0
+        super().__init__(params, cfg, slots=slots, max_len=max_len,
+                         chunk=chunk, mesh=mesh, key=key, ring=False)
+
+    def _build_device_state(self, cfg, slots, max_len, chunk, mesh,
+                            ring) -> None:
+        self.allocator = BlockAllocator(self._num_blocks)
+        self.tables = np.full((slots, self.blocks_per_row), -1, np.int32)
+        run_cfg = cfg.resolved_for_mesh(mesh) if mesh is not None else cfg
+        pool = PagedKVCache.zeros(run_cfg, self._num_blocks,
+                                  self.block_size)
+        self.cache = PagedKVCache(
+            k=pool.k, v=pool.v, lengths=jnp.zeros((slots,), jnp.int32))
+        self._decode = make_paged_decode_step(cfg, max_len, mesh)
+        self._prefill = make_paged_prefill(cfg, chunk,
+                                           self.prefill_lanes, max_len,
+                                           mesh)
+
+    # ---- accounting ----------------------------------------------------
+
+    def live_tokens(self) -> int:
+        lengths = np.asarray(self.cache.lengths)
+        return int(sum(
+            int(lengths[i]) for i, s in enumerate(self._slots)
+            if s.request is not None))
+
+    def check_accounting(self) -> None:
+        """The paged invariant: allocated blocks cover live tokens with
+        less than one block of slack per live sequence (+ the blocks
+        pre-allocated for in-flight prefill chunks)."""
+        live = self.live_tokens()
+        used = self.allocator.used_blocks * self.block_size
+        live_seqs = sum(1 for s in self._slots if s.request is not None)
+        slack = live_seqs * (self.block_size + self.chunk)
+        assert used <= live + slack, (
+            f"paged accounting violated: {used} token-slots allocated "
+            f"for {live} live tokens (+{slack} slack)")
+        # And the free list + tables agree with the pool size.
+        table_blocks = int((self.tables >= 0).sum())
+        assert table_blocks == self.allocator.used_blocks, (
+            f"table/allocator divergence: {table_blocks} vs "
+            f"{self.allocator.used_blocks}")
+
+    # ---- block management ----------------------------------------------
+
+    def _ensure_blocks(self, i: int, upto_tokens: int) -> bool:
+        """Grow slot i's table to cover ``upto_tokens`` positions;
+        False when the pool is exhausted (caller preempts)."""
+        need = int(np.ceil(upto_tokens / self.block_size))
+        row = self.tables[i]
+        have = int((row >= 0).sum())
+        while have < need:
+            b = self.allocator.alloc()
+            if b is None:
+                return False
+            row[have] = b
+            have += 1
+        return True
+
+    def _release_slot(self, i: int) -> None:
+        self.allocator.free(self.tables[i][self.tables[i] >= 0])
+        self.tables[i] = -1
+        self.cache = PagedKVCache(
+            k=self.cache.k, v=self.cache.v,
+            lengths=self.cache.lengths.at[i].set(0))
+
+    def _finish_if_done(self, i: int) -> None:
+        before = self._slots[i].request
+        super()._finish_if_done(i)
+        if before is not None and self._slots[i].request is None:
+            self._release_slot(i)
+
+    def _preempt_youngest(self) -> bool:
+        """Evict the live sequence with the fewest generated tokens back
+        to the queue (cheapest re-prefill); False if none is live."""
+        candidates = [
+            (len(s.request.generated), i)
+            for i, s in enumerate(self._slots) if s.request is not None]
+        if not candidates:
+            return False
+        _, i = min(candidates)
+        slot = self._slots[i]
+        req = slot.request
+        # Reset request progress: it will re-prefill from scratch.
+        req.generated.clear()
+        req.done = False
+        self._queue.insert(0, req)
+        slot.request = None
+        slot.remaining_prompt = None
+        slot.seeded = False
+        self._has_pending[i] = False
+        self._release_slot(i)
+        self.preemptions += 1
+        return True
+
+    # ---- engine loop ---------------------------------------------------
+
+    def _admit(self) -> None:
+        if getattr(self, "draining", False):
+            return
+        for i, slot in enumerate(self._slots):
+            if slot.request is None and self._queue:
+                req = self._queue[0]
+                # Admission only needs the FIRST chunk's blocks; growth
+                # is on-demand.  If even that fails, return the partial
+                # allocation and stop admitting — decode progress will
+                # free blocks.
+                first = min(self.chunk, len(req.prompt))
+                if not self._ensure_blocks(i, first):
+                    self._release_slot(i)
+                    return
+                self._queue.pop(0)
+                slot.request = req
+                slot.remaining_prompt = np.asarray(req.prompt, np.int32)
+                slot.seeded = False
+                self._has_pending[i] = False
+                self.cache = PagedKVCache(
+                    k=self.cache.k, v=self.cache.v,
+                    lengths=self.cache.lengths.at[i].set(0))
+
+    def tick(self) -> None:
+        """One engine step: admit, one BATCHED prefill over up to
+        ``prefill_lanes`` slots still holding prompt, then one batched
+        decode step for every slot with a pending token."""
+        self._admit()
+        self.ticks += 1
+
+        # ---- batched prefill over up to `lanes` slots ----
+        lanes: list[int] = []
+        for i, slot in enumerate(self._slots):
+            if len(lanes) == self.prefill_lanes:
+                break
+            if slot.request is None or slot.remaining_prompt is None \
+                    or len(slot.remaining_prompt) == 0:
+                continue
+            take = min(self.chunk, len(slot.remaining_prompt))
+            upto = int(np.asarray(self.cache.lengths[i])) + take
+            while not self._ensure_blocks(i, upto):
+                if not self._preempt_youngest():
+                    break
+                if self._slots[i].request is None:
+                    break  # preempted ourselves: lane skipped
+            if self._slots[i].request is None or not self._ensure_blocks(
+                    i, upto):
+                continue
+            lanes.append(i)
+        # A LATER lane's block pressure may have preempted an EARLIER
+        # collected lane (youngest-first victim choice): drop lanes
+        # whose slot no longer holds a request.
+        lanes = [i for i in lanes
+                 if self._slots[i].request is not None
+                 and self._slots[i].remaining_prompt is not None]
+        if lanes:
+            tok = np.zeros((self.prefill_lanes, self.chunk), np.int32)
+            offs = np.zeros((self.prefill_lanes,), np.int32)
+            nval = np.zeros((self.prefill_lanes,), np.int32)
+            tabs = np.zeros((self.prefill_lanes, self.blocks_per_row),
+                            np.int32) - 1
+            takes = {}
+            lengths_now = np.asarray(self.cache.lengths)
+            for lane, i in enumerate(lanes):
+                slot = self._slots[i]
+                take = min(self.chunk, len(slot.remaining_prompt))
+                tok[lane, :take] = slot.remaining_prompt[:take]
+                offs[lane] = lengths_now[i]
+                nval[lane] = take
+                tabs[lane] = self.tables[i]
+                takes[i] = take
+            logits, self.cache = self._prefill(
+                self.params, self.cache, jnp.asarray(tabs),
+                jnp.asarray(tok), jnp.asarray(offs), jnp.asarray(nval))
+            # Host-side length advance (the prefill program can't: its
+            # lanes are a view, not the slot axis).
+            new_lengths = self.cache.lengths
+            for lane, i in enumerate(lanes):
+                slot = self._slots[i]
+                take = takes[i]
+                slot.remaining_prompt = slot.remaining_prompt[take:]
+                new_lengths = new_lengths.at[i].add(take)
+                if len(slot.remaining_prompt) == 0:
+                    tokn = self._sample_host(np.asarray(logits[lane]),
+                                             slot.request)
+                    slot.request.generated.append(tokn)
+                    slot.seeded = True
+                    self._pending_token[i] = tokn
+                    self._has_pending[i] = True
+            self.cache = PagedKVCache(
+                k=self.cache.k, v=self.cache.v, lengths=new_lengths)
+            for i in list(lanes):
+                self._finish_if_done(i)
+
+        if not self._has_pending.any():
+            return
+
+        # ---- grow-then-decode ----
+        lengths_now = np.asarray(self.cache.lengths)
+        for i, slot in enumerate(self._slots):
+            if not self._has_pending[i] or slot.request is None:
+                continue
+            while not self._ensure_blocks(i, int(lengths_now[i]) + 1):
+                if not self._preempt_youngest():
+                    raise RuntimeError(
+                        "paged pool exhausted with nothing to preempt")
+                if self._slots[i].request is None:
+                    break  # we preempted ourselves; skip this row
+        logits, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(self.tables),
+            jnp.asarray(self._pending_token),
+            jnp.asarray(self._has_pending))
+        temps = np.array(
+            [s.request.temperature if s.request else 0.0
+             for s in self._slots], np.float32)
+        greedy = temps == 0.0
+        toks = np.asarray(self._batch_sample(
+            logits, self._next_key(), jnp.asarray(temps),
+            jnp.asarray(greedy)))
+        for i, slot in enumerate(self._slots):
+            if not self._has_pending[i] or slot.request is None:
+                continue
+            self.decode_tokens += 1
+            req = slot.request
+            if req.top_k is not None or req.top_p is not None:
+                tok = self._sample_host(np.asarray(logits[i]), req)
+            else:
+                tok = int(toks[i])
+            req.generated.append(tok)
+            self._pending_token[i] = tok
+            self._finish_if_done(i)

@@ -508,17 +508,7 @@ class ContinuousBatcher:
         self.chunk = chunk
         self.max_len = max_len
         self.ring = ring
-        if ring:
-            if cfg.attention_window is None:
-                raise ValueError("ring=True needs cfg.attention_window")
-            buf_len = cfg.attention_window + chunk
-        else:
-            buf_len = max_len
-        self.cache = SlotKVCache.zeros(
-            cfg.resolved_for_mesh(mesh) if mesh is not None else cfg,
-            slots, buf_len)
-        self._decode = make_slot_decode_step(cfg, mesh, ring=ring)
-        self._prefill = make_prefill_chunk(cfg, chunk, mesh, ring=ring)
+        self._build_device_state(cfg, slots, max_len, chunk, mesh, ring)
         self._slots = [_SlotState() for _ in range(slots)]
         self._queue: list[Request] = []
         self._pending_token = np.zeros((slots,), np.int32)
@@ -543,6 +533,23 @@ class ContinuousBatcher:
                              sampled).astype(jnp.int32)
 
         self._batch_sample = jax.jit(_batch_sample)
+
+    def _build_device_state(self, cfg, slots, max_len, chunk, mesh,
+                            ring) -> None:
+        """Allocate the cache and build the compiled step inventory.
+        Subclasses with a different memory system (paged.PagedBatcher)
+        override this — the host-side scheduling above is shared."""
+        if ring:
+            if cfg.attention_window is None:
+                raise ValueError("ring=True needs cfg.attention_window")
+            buf_len = cfg.attention_window + chunk
+        else:
+            buf_len = max_len
+        self.cache = SlotKVCache.zeros(
+            cfg.resolved_for_mesh(mesh) if mesh is not None else cfg,
+            slots, buf_len)
+        self._decode = make_slot_decode_step(cfg, mesh, ring=ring)
+        self._prefill = make_prefill_chunk(cfg, chunk, mesh, ring=ring)
 
     def submit(self, request: Request) -> None:
         """Queue a request, validating its cache footprint UP FRONT —
