@@ -756,3 +756,119 @@ class TestSpeculativeDecoding:
             speculative_generate(self.params, self.dparams, prompt,
                                  self.cfg, 8, draft_cfg=self.dcfg,
                                  max_len=10)
+
+
+class TestSpeculativeSampling:
+    """Distribution-preserving speculative sampling
+    (decode.py::speculative_sample_generate): the accept/reject
+    construction must leave the emitted stream distributed exactly as
+    target-only sampling, for ANY draft."""
+
+    def setup_method(self):
+        # Deliberately tiny (vocab 16, 1 layer) so many-row marginal
+        # histograms are cheap and well-resolved per bin.
+        self.cfg = ModelConfig(vocab=16, d_model=16, n_layers=1,
+                               n_heads=2, d_ff=32, seq_len=16,
+                               dtype=jnp.float32)
+        self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+        # A DIFFERENT model as draft: q genuinely differs from p, so
+        # acceptance is partial and the residual path exercises.
+        self.dparams = init_params(jax.random.PRNGKey(9), self.cfg)
+
+    @staticmethod
+    def _tv(a, b, vocab):
+        ha = np.bincount(a, minlength=vocab) / len(a)
+        hb = np.bincount(b, minlength=vocab) / len(b)
+        return 0.5 * np.abs(ha - hb).sum()
+
+    def test_marginals_match_plain_sampling(self):
+        """Many-seed histogram: each generated position's marginal under
+        speculative sampling matches plain target sampling within
+        sampling noise (total variation), despite a mismatched draft."""
+        from tpu_autoscaler.workloads.decode import (
+            speculative_sample_generate,
+        )
+
+        n = 4000
+        prompt = jnp.tile(_prompt(b=1, s=3, key=2), (n, 1))
+        steps = 3
+        plain = generate(self.params, prompt, self.cfg, steps,
+                         key=jax.random.PRNGKey(11), temperature=1.0)
+        spec, stats = speculative_sample_generate(
+            self.params, self.dparams, prompt, self.cfg, steps,
+            key=jax.random.PRNGKey(22), temperature=1.0, k=2)
+        plain = np.asarray(plain[:, 3:])
+        spec = np.asarray(spec[:, 3:])
+        assert 0.0 < stats["accept_rate"] < 1.0  # draft really differs
+        for pos in range(steps):
+            tv = self._tv(spec[:, pos], plain[:, pos], self.cfg.vocab)
+            assert tv < 0.08, (
+                f"position {pos}: TV {tv:.3f} vs plain sampling")
+
+    @pytest.mark.slow
+    def test_marginals_match_with_topk_warping(self):
+        """top-k warps BOTH p and q through the same _warp_logits; the
+        output must match plain top-k sampling's marginals."""
+        from tpu_autoscaler.workloads.decode import (
+            speculative_sample_generate,
+        )
+
+        n = 4000
+        prompt = jnp.tile(_prompt(b=1, s=3, key=4), (n, 1))
+        plain = generate(self.params, prompt, self.cfg, 2,
+                         key=jax.random.PRNGKey(5), temperature=0.8,
+                         top_k=6)
+        spec, _ = speculative_sample_generate(
+            self.params, self.dparams, prompt, self.cfg, 2,
+            key=jax.random.PRNGKey(6), temperature=0.8, top_k=6, k=2)
+        plain = np.asarray(plain[:, 3:])
+        spec = np.asarray(spec[:, 3:])
+        for pos in range(2):
+            tv = self._tv(spec[:, pos], plain[:, pos], self.cfg.vocab)
+            assert tv < 0.08
+        # Warping really truncated.  Only position 0 has a single
+        # conditional distribution across rows (same prompt); later
+        # positions are mixtures over prefixes, each with its own
+        # top-6 set, so their marginal support can exceed 6.
+        assert len(np.unique(spec[:, 0])) <= 6
+
+    def test_self_draft_accepts_everything(self):
+        """p == q: min(1, p/q) = 1 — acceptance must be (numerically)
+        total, the sharp internal-consistency check of the ratio."""
+        from tpu_autoscaler.workloads.decode import (
+            speculative_sample_generate,
+        )
+
+        prompt = _prompt(b=8, s=4, key=7)
+        _, stats = speculative_sample_generate(
+            self.params, self.params, prompt, self.cfg, 12,
+            key=jax.random.PRNGKey(1), temperature=1.0, k=4)
+        assert stats["accept_rate"] > 0.99
+
+    def test_temperature_zero_delegates_to_greedy(self):
+        from tpu_autoscaler.workloads.decode import (
+            speculative_generate,
+            speculative_sample_generate,
+        )
+
+        prompt = _prompt(b=1, s=5, key=3)
+        want, _ = speculative_generate(
+            self.params, self.dparams, prompt, self.cfg, 6, k=3)
+        got, _ = speculative_sample_generate(
+            self.params, self.dparams, prompt, self.cfg, 6,
+            key=jax.random.PRNGKey(0), temperature=0.0, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_same_key_is_deterministic(self):
+        from tpu_autoscaler.workloads.decode import (
+            speculative_sample_generate,
+        )
+
+        prompt = _prompt(b=2, s=4, key=8)
+        a, _ = speculative_sample_generate(
+            self.params, self.dparams, prompt, self.cfg, 5,
+            key=jax.random.PRNGKey(42), temperature=0.9, k=2)
+        b, _ = speculative_sample_generate(
+            self.params, self.dparams, prompt, self.cfg, 5,
+            key=jax.random.PRNGKey(42), temperature=0.9, k=2)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

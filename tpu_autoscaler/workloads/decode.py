@@ -308,12 +308,12 @@ def decode_step(params: dict, cache: KVCache, tokens: jax.Array,
     return logits[:, 0], _constrain_cache(cache, mesh)
 
 
-def _sample(logits: jax.Array, key, temperature: float,
-            top_k: int | None, top_p: float | None = None) -> jax.Array:
-    """Greedy at temperature 0.0 (static branch), else softmax sampling
-    with optional top-k and/or top-p (nucleus) truncation."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _warp_logits(logits: jax.Array, temperature: float,
+                 top_k: int | None, top_p: float | None) -> jax.Array:
+    """Temperature/top-k/top-p warping (temperature must be > 0).
+    softmax of the result IS the sampling distribution — shared by
+    _sample and the speculative accept/reject, which must agree on the
+    warped distributions for exactness."""
     scaled = logits / temperature
     if top_k is not None:
         # lax.top_k is O(V) vs a full O(V log V) vocab sort — this runs
@@ -336,7 +336,17 @@ def _sample(logits: jax.Array, key, temperature: float,
         # The n_keep-th largest logit is the cutoff.
         cutoff = jnp.take_along_axis(sorted_logits, n_keep - 1, axis=-1)
         scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
-    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return scaled
+
+
+def _sample(logits: jax.Array, key, temperature: float,
+            top_k: int | None, top_p: float | None = None) -> jax.Array:
+    """Greedy at temperature 0.0 (static branch), else softmax sampling
+    with optional top-k and/or top-p (nucleus) truncation."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    warped = _warp_logits(logits, temperature, top_k, top_p)
+    return jax.random.categorical(key, warped, axis=-1).astype(jnp.int32)
 
 
 def generate(params: dict, prompt: jax.Array, cfg: ModelConfig,
@@ -512,6 +522,151 @@ def speculative_generate(params: dict, draft_params: dict,
         # stream ran one token PAST what the draft ever wrote (d_k was
         # computed, never cached).  Rewind to the valid prefix, then
         # replay the missing confirmed tokens through the draft.
+        cache_d = _rewind(cache_d, min(int(cache_d.length), confirmed))
+        behind = confirmed - int(cache_d.length)
+        if behind > 0:
+            replay = jnp.stack(out[-(behind + 1):-1], axis=1)
+            _, cache_d = extend_step(draft_params, cache_d, replay,
+                                     draft_cfg, mesh)
+    tokens = jnp.stack(out[:steps], axis=1)
+    stats = {"rounds": rounds,
+             "accept_rate": accepted_total / max(drafted_total, 1)}
+    return jnp.concatenate([prompt, tokens.astype(prompt.dtype)],
+                           axis=1), stats
+
+
+def speculative_sample_generate(
+        params: dict, draft_params: dict, prompt: jax.Array,
+        cfg: ModelConfig, steps: int, *, key: jax.Array,
+        temperature: float = 1.0, top_k: int | None = None,
+        top_p: float | None = None, draft_cfg: ModelConfig | None = None,
+        k: int = 4, max_len: int | None = None, mesh=None):
+    """Distribution-preserving speculative SAMPLING (the stochastic
+    sibling of speculative_generate's greedy path).
+
+    The standard accept/reject construction (speculative decoding /
+    rejection-sampling transport): the draft proposes x_i ~ q_i, the
+    target scores all k proposals in ONE cached pass (extend_step),
+    and each x_i is accepted with probability min(1, p_i(x_i) /
+    q_i(x_i)); the first rejection resamples from the residual
+    norm(max(p_i - q_i, 0)) and ends the round.  The emitted stream is
+    then distributed EXACTLY as sampling from the target alone —
+    regardless of the draft — which the marginal-distribution tests
+    pin (TestSpeculativeSampling).  Temperature / top-k / top-p warp
+    BOTH p and q through the same _warp_logits the plain sampler uses;
+    temperature 0 delegates to the greedy speculative path.
+
+    Batched rows each accept/reject independently; the shared cache
+    truncates every round at the batch's minimum accept length
+    (rows that accepted further emit their accepted token at the
+    truncation point — still a valid p-sample, so exactness holds
+    per row; b=1 pays no truncation at all).
+
+    Returns (tokens [b, prompt+steps], stats with ``rounds``,
+    ``accept_rate``).
+    """
+    if temperature == 0.0:
+        if top_k is not None or top_p is not None:
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (temperature 0 is "
+                "greedy argmax; truncation would be silently ignored)")
+        return speculative_generate(
+            params, draft_params, prompt, cfg, steps,
+            draft_cfg=draft_cfg, k=k, max_len=max_len, mesh=mesh)
+    if draft_cfg is None:
+        draft_cfg = cfg
+    b, s = prompt.shape
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    max_len = max_len if max_len is not None else s + steps
+    if s + steps > max_len:
+        raise ValueError(
+            f"prompt {s} + steps {steps} exceeds max_len {max_len}")
+
+    def warped_probs(logits):
+        return jax.nn.softmax(
+            _warp_logits(logits.astype(jnp.float32), temperature,
+                         top_k, top_p), axis=-1)
+
+    def next_key():
+        nonlocal key
+        key, sub = jax.random.split(key)
+        return sub
+
+    logits_t, cache_t = prefill(params, prompt, cfg, max_len, mesh)
+    _, cache_d = prefill(draft_params, prompt, draft_cfg, max_len, mesh)
+    cur = _sample(logits_t[:, -1], next_key(), temperature, top_k, top_p)
+
+    out = [cur]
+    rounds = 0
+    accepted_total = 0
+    drafted_total = 0
+    while len(out) < steps:
+        rounds += 1
+        k_eff = min(k, steps - len(out))
+        drafted_total += b * k_eff
+        draft_toks, draft_q = [], []
+        tok_d = cur
+        for _ in range(k_eff):
+            dlogits, cache_d = decode_step(draft_params, cache_d, tok_d,
+                                           draft_cfg, mesh)
+            q = warped_probs(dlogits)                      # [b, V]
+            tok_d = jax.random.categorical(
+                next_key(), jnp.log(q + 1e-30), axis=-1).astype(jnp.int32)
+            draft_toks.append(tok_d)
+            draft_q.append(q)
+        drafts = jnp.stack(draft_toks, axis=1)             # [b, k_eff]
+        qs = jnp.stack(draft_q, axis=1)                    # [b, k_eff, V]
+        block = jnp.concatenate([cur[:, None], drafts], axis=1)
+        tlogits, cache_t = extend_step(params, cache_t, block, cfg, mesh)
+        ps = warped_probs(tlogits)                         # [b, k_eff+1, V]
+        # Accept x_i with prob min(1, p_i(x)/q_i(x)); first rejection
+        # per row ends its accepted prefix.
+        p_x = jnp.take_along_axis(ps[:, :k_eff], drafts[..., None],
+                                  axis=-1)[..., 0]         # [b, k_eff]
+        q_x = jnp.take_along_axis(qs, drafts[..., None], axis=-1)[..., 0]
+        u = jax.random.uniform(next_key(), p_x.shape)
+        accept = np.asarray(u * q_x < p_x)                 # [b, k_eff]
+        acc_len = np.asarray([
+            int(np.argmin(row)) if not row.all() else k_eff
+            for row in accept])                            # [b]
+        n_acc = int(acc_len.min())
+        # accept_rate is PER-ROW acceptance (the economics signal); the
+        # shared cache only truncates emission at the batch minimum.
+        accepted_total += int(acc_len.sum())
+        # Token n_acc per row: rejected rows draw from the residual
+        # norm(max(p - q, 0)); rows that accepted past the truncation
+        # point emit their accepted draft token (a valid p-sample).
+        p_n = ps[:, n_acc]                                 # [b, V]
+        if n_acc < k_eff:
+            residual = jnp.maximum(p_n - qs[:, n_acc], 0.0)
+            # A zero residual (p==q) can only arise when acceptance was
+            # certain, so the row cannot be in the rejected set; the
+            # fallback to p_n keeps categorical() well-defined anyway.
+            rsum = residual.sum(axis=-1, keepdims=True)
+            residual = jnp.where(rsum > 0, residual / rsum, p_n)
+            res_tok = jax.random.categorical(
+                next_key(), jnp.log(residual + 1e-30),
+                axis=-1).astype(jnp.int32)
+            rejected_here = jnp.asarray(acc_len == n_acc)
+            bonus = jnp.where(rejected_here, res_tok, drafts[:, n_acc])
+        else:
+            # Every row accepted the whole block: the (k+1)-th logits
+            # row is a fresh target sample past the last draft.
+            bonus = jax.random.categorical(
+                next_key(), jnp.log(p_n + 1e-30),
+                axis=-1).astype(jnp.int32)
+        emit = (np.asarray(drafts[:, :n_acc]), np.asarray(bonus))
+        for j in range(n_acc):
+            if len(out) < steps:
+                out.append(jnp.asarray(emit[0][:, j]))
+        if len(out) < steps:
+            out.append(jnp.asarray(emit[1]))
+        cur = out[-1]
+        confirmed = s + len(out) - 1
+        cache_t = _rewind(cache_t, confirmed)
         cache_d = _rewind(cache_d, min(int(cache_d.length), confirmed))
         behind = confirmed - int(cache_d.length)
         if behind > 0:
