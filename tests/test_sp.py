@@ -183,9 +183,11 @@ class TestSpTrainStep:
             make_sp_train_step(make_sp_mesh(jax.devices()[:2]), CFG,
                                shard="fsdp")
 
-    def test_moe_rejected(self):
-        cfg = dc.replace(CFG, moe_experts=4)
-        with pytest.raises(ValueError, match="MoE"):
+    def test_moe_supported_with_divisible_experts(self):
+        """MoE under sp is the sp×ep composition (TestSpEpComposition);
+        only expert-count divisibility by the sp axis is required."""
+        cfg = dc.replace(CFG, moe_experts=3)
+        with pytest.raises(ValueError, match="divisible"):
             make_sp_train_step(make_sp_mesh(jax.devices()[:2]), cfg)
 
     def test_uneven_seq_rejected(self):
@@ -289,3 +291,86 @@ class TestSpTpComposition:
             losses[impl] = float(loss)
         assert losses["pallas"] == pytest.approx(losses["einsum"],
                                                  rel=2e-5)
+
+
+class TestSpEpComposition:
+    """sp×ep: MoE blocks under sequence parallelism — the sp axis
+    doubles as the expert axis (ring attention on the sequence
+    sharding, all_to_all expert dispatch across the same axis;
+    VERDICT r4 item 9 closes sp.py's former exclusion)."""
+
+    def moe_cfg(self, **kw):
+        base = dict(moe_experts=8, moe_top_k=2,
+                    moe_capacity_factor=64.0)
+        base.update(kw)
+        return dc.replace(CFG, **base)
+
+    def test_no_drop_ce_parity_with_unsharded_moe(self):
+        """Ample capacity -> zero drops -> the sp×ep CE equals the
+        per-row-dispatch MoE oracle exactly (same route_topk).  The
+        balance loss uses the pool-level estimator (multi-row pools
+        differ from the per-row estimate by the cross-row covariance
+        — moe._ep_moe_ffn's documented semantics), so it is pinned
+        loosely."""
+        from tpu_autoscaler.workloads.model import (
+            init_params,
+            loss_and_metrics,
+        )
+
+        cfg = self.moe_cfg()
+        tokens = tokens_for()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        _, ref_m = loss_and_metrics(params, tokens, cfg)
+        mesh = make_sp_mesh(jax.devices()[:4], sp=2)  # data 2 x sp 2
+        init_fn, step_fn = make_sp_train_step(mesh, cfg, impl="einsum")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss, m = step_fn(p, o, tokens)
+        assert float(m["ce"]) == pytest.approx(float(ref_m["ce"]),
+                                               rel=1e-4)
+        assert float(m["balance_loss"]) == pytest.approx(
+            float(ref_m["balance_loss"]), abs=5e-2)
+        frac = np.asarray(m["expert_fraction"])
+        np.testing.assert_allclose(frac.sum(), 1.0, rtol=1e-5)
+        assert np.isfinite(float(loss))
+
+    def test_pure_sp_expert_axis(self):
+        """sp covering every device (no data axis worth 1 lane each):
+        8 experts over sp=4, training moves the loss down."""
+        cfg = self.moe_cfg(moe_capacity_factor=2.0)
+        tokens = tokens_for()
+        mesh = make_sp_mesh(jax.devices()[:4], sp=4)
+        init_fn, step_fn = make_sp_train_step(mesh, cfg, impl="einsum")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(6):
+            p, o, loss, m = step_fn(p, o, tokens)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    @pytest.mark.slow
+    def test_sp_ep_tp_composition(self):
+        """sp×ep×tp: expert d_ff additionally column/row-shards over
+        'model' — CE still matches the oracle with ample capacity."""
+        from tpu_autoscaler.workloads.model import (
+            init_params,
+            loss_and_metrics,
+        )
+
+        cfg = self.moe_cfg()
+        tokens = tokens_for()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        _, ref_m = loss_and_metrics(params, tokens, cfg)
+        mesh = make_sp_mesh(jax.devices(), sp=2, tp=2)  # data2 sp2 tp2
+        init_fn, step_fn = make_sp_train_step(mesh, cfg, impl="einsum")
+        p, o = init_fn(jax.random.PRNGKey(0))
+        _, _, loss, m = step_fn(p, o, tokens)
+        assert float(m["ce"]) == pytest.approx(float(ref_m["ce"]),
+                                               rel=1e-4)
+        assert np.isfinite(float(loss))
+
+    def test_indivisible_experts_rejected(self):
+        cfg = self.moe_cfg(moe_experts=6)
+        with pytest.raises(ValueError, match="moe_experts"):
+            make_sp_train_step(make_sp_mesh(jax.devices()[:4], sp=4),
+                               cfg)

@@ -1,0 +1,22 @@
+"""16-device composition tier (VERDICT r4 item 5).
+
+The default suite (and the 8-device conftest pin) runs every
+composition at axis size 2, where uneven-split layout bugs hide.  This
+spawns the hermetic dryrun at n=16 — dp2×pp2×tp4, ep8×tp2, sp4×tp2,
+each parity-checked inside the subprocess against the eager
+single-device oracle (__graft_entry__._dryrun_multichip_impl's tier16
+block) — on a fresh 16-virtual-device CPU topology.
+"""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_dryrun_16_device_tier(capfd):
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(16)
+    out = capfd.readouterr().out
+    assert "tier16=dp2pp2tp4=" in out
+    assert "ep8tp2=" in out and "sp4tp2=" in out
+    assert out.count("OK") >= 1

@@ -22,8 +22,10 @@ ring:
 
 Memory: resident activations are O(s_local) per device; with
 ``cfg.remat`` the blocks recompute in the backward, which composes with
-the ring exactly as on one device.  MoE blocks are not supported under
-sp (token routing is sequence-local today); use the dp/tp or ep paths.
+the ring exactly as on one device.  MoE blocks compose too (sp×ep): the
+sp axis doubles as the expert axis — ring attention on the sequence
+sharding, then tokens all_to_all to their experts across the same axis
+and back (_sp_moe_ffn).
 
 Autoscaler relevance (SURVEY §6.7/§6.8): an sp job is the purest case
 for slice atomicity — the ring rides one slice's ICI torus every step,
@@ -206,20 +208,29 @@ def _sp_block(x, layer, cfg: ModelConfig, *, seq_axis: str, impl: str,
     if tp == 1:
         x = x + jnp.einsum("bsd,de->bse", attn.astype(cfg.dtype),
                            layer["attn_out"].astype(cfg.dtype))
-        y = _rmsnorm(x, layer["ln2"])
+    else:
+        # Row-parallel attn_out: this rank's rows are its heads' slice.
+        t = jax.lax.axis_index(model_axis)
+        wo = jax.lax.dynamic_slice_in_dim(
+            layer["attn_out"].astype(cfg.dtype), t * h_loc * hd,
+            h_loc * hd, 0)
+        out = jnp.einsum("bse,ed->bsd", attn.astype(cfg.dtype), wo)
+        x = x + jax.lax.psum(out, model_axis)
+    y = _rmsnorm(x, layer["ln2"])
+    if cfg.moe_experts is not None:
+        # sp×ep: the sp axis does double duty — sequence for the ring
+        # attention above, EXPERT axis for the FFN here.  Tokens of
+        # this rank's sequence shard all_to_all to their experts
+        # across sp and back; see _sp_moe_ffn.
+        out, aux = _sp_moe_ffn(y, layer, cfg, seq_axis=seq_axis,
+                               model_axis=model_axis, tp=tp)
+        return x + out, aux
+    if tp == 1:
         hdn = jnp.einsum("bsd,df->bsf", y,
                          layer["w1"].astype(cfg.dtype))
         hdn = jax.nn.gelu(hdn)
         return x + jnp.einsum("bsf,fd->bsd", hdn,
                               layer["w2"].astype(cfg.dtype))
-    # Row-parallel attn_out: this rank's rows are its heads' slice.
-    t = jax.lax.axis_index(model_axis)
-    wo = jax.lax.dynamic_slice_in_dim(
-        layer["attn_out"].astype(cfg.dtype), t * h_loc * hd,
-        h_loc * hd, 0)
-    out = jnp.einsum("bse,ed->bsd", attn.astype(cfg.dtype), wo)
-    x = x + jax.lax.psum(out, model_axis)
-    y = _rmsnorm(x, layer["ln2"])
     f_loc = cfg.d_ff // tp
     w1 = jax.lax.dynamic_slice_in_dim(
         layer["w1"].astype(cfg.dtype), t * f_loc, f_loc, 1)
@@ -228,6 +239,40 @@ def _sp_block(x, layer, cfg: ModelConfig, *, seq_axis: str, impl: str,
     hdn = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, w1))
     out = jnp.einsum("bsf,fd->bsd", hdn, w2)
     return x + jax.lax.psum(out, model_axis)
+
+
+def _sp_moe_ffn(y, layer, cfg: ModelConfig, *, seq_axis: str,
+                model_axis: str | None, tp: int):
+    """MoE FFN under sequence parallelism (the composition sp.py's
+    docstring previously excluded — VERDICT r4 item 9).
+
+    The sp axis is reused as the expert axis: rank t owns experts
+    [t·E/sp, (t+1)·E/sp), this rank's LOCAL sequence shard's tokens
+    route over the whole expert set, and two all_to_all exchanges over
+    ``seq_axis`` move them to their expert owners and back
+    (moe._ep_moe_ffn — the exact dispatch/combine the dp×ep step
+    runs, pointed at the sp axis).  Expert WEIGHTS stay replicated
+    like every other sp param (sp's contract: activations are the
+    memory problem, ZeRO-1 shards the moments); each rank dynamic-
+    slices its expert block before the dispatch, so expert COMPUTE
+    still drops by sp — and by tp on top of it (expert d_ff
+    column/row-shards over ``model_axis``, moe._ep_moe_ffn's tp
+    path).  Returns (ffn_out [b, s_loc, d], aux losses)."""
+    from tpu_autoscaler.workloads.moe import _ep_moe_ffn
+
+    sp = jax.lax.psum(1, seq_axis)  # static under shard_map tracing
+    e_loc = cfg.moe_experts // sp
+    t = jax.lax.axis_index(seq_axis)
+    w1 = jax.lax.dynamic_slice_in_dim(layer["w1"], t * e_loc, e_loc, 0)
+    w2 = jax.lax.dynamic_slice_in_dim(layer["w2"], t * e_loc, e_loc, 0)
+    if tp > 1:
+        f_loc = w1.shape[-1] // tp
+        m = jax.lax.axis_index(model_axis)
+        w1 = jax.lax.dynamic_slice_in_dim(w1, m * f_loc, f_loc, 2)
+        w2 = jax.lax.dynamic_slice_in_dim(w2, m * f_loc, f_loc, 1)
+    local = {**layer, "w1": w1, "w2": w2}
+    return _ep_moe_ffn(y, local, cfg, seq_axis, sp,
+                       model_axis if tp > 1 else None)
 
 
 def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
@@ -302,11 +347,14 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
                 f"{cfg.n_heads // tp} q / {cfg.kv_heads // tp} kv local "
                 f"heads — use the ring impls for indivisible head "
                 f"counts")
-    if cfg.moe_experts is not None:
-        raise ValueError(
-            "MoE blocks are not supported under sequence parallelism "
-            "(token routing is sequence-local); use the dp/tp or ep "
-            "paths")
+    moe = cfg.moe_experts is not None
+    if moe:
+        sp_size = mesh.shape[seq_axis]
+        if cfg.moe_experts % sp_size:
+            raise ValueError(
+                f"sp×ep needs moe_experts ({cfg.moe_experts}) divisible "
+                f"by the {seq_axis} axis ({sp_size}) — the sp axis is "
+                "reused as the expert axis (_sp_moe_ffn)")
     if cfg.seq_len % mesh.shape[seq_axis]:
         raise ValueError(
             f"seq_len {cfg.seq_len} not divisible by the {seq_axis} "
@@ -327,13 +375,16 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
     def local_loss(params, inputs, targets):
         """This device's [b_loc, s_loc] token block through the model;
         returns the GLOBAL mean NLL (psum over both axes — every device
-        sees the same scalar, keeping grads correct)."""
+        sees the same scalar, keeping grads correct).  With MoE blocks
+        the per-layer aux losses ride along (ep step's contract)."""
         x = params["embed"].astype(cfg.dtype)[inputs]
 
         def body(x, layer):
+            if moe:
+                return block(x, layer)  # (x, aux)
             return block(x, layer), None
 
-        x, _ = jax.lax.scan(body, x, params["blocks"])
+        x, aux_stacked = jax.lax.scan(body, x, params["blocks"])
         x = _rmsnorm(x, params["ln_f"])
         b_loc, s_loc = inputs.shape
         if cfg.ce_chunk is not None and s_loc % cfg.ce_chunk == 0:
@@ -355,12 +406,23 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
         total = jax.lax.psum(local_sum, (data_axis, seq_axis))
         n_tok = (b_loc * s_loc
                  * jax.lax.psum(1, data_axis) * jax.lax.psum(1, seq_axis))
-        return total / n_tok
+        ce = total / n_tok
+        if not moe:
+            return ce
+        aux = jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stacked)
+        aux = jax.tree.map(
+            lambda a: jax.lax.pmean(a, (data_axis, seq_axis)), aux)
+        full = (ce + cfg.moe_balance_weight * aux["balance_loss"]
+                + cfg.moe_z_weight * aux["z_loss"])
+        return full, {"ce": ce, **aux}
 
     tok_spec = P(data_axis, seq_axis)
+    metric_specs = {"ce": P(), "balance_loss": P(), "z_loss": P(),
+                    "expert_fraction": P()}
     sharded_loss = jax.shard_map(
         local_loss, mesh=mesh,
-        in_specs=(P(), tok_spec, tok_spec), out_specs=P(),
+        in_specs=(P(), tok_spec, tok_spec),
+        out_specs=(P(), metric_specs) if moe else P(),
         check_vma=False,
     )
 
@@ -377,6 +439,13 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss_val
 
+    def step_moe(params, opt_state, tokens):
+        (loss_val, metrics), grads = jax.value_and_grad(
+            loss, has_aux=True)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss_val, metrics
+
     replicated = NamedSharding(mesh, P())
     batch_shard = NamedSharding(mesh, P(data_axis, None))
     if shard == "zero1":
@@ -390,10 +459,23 @@ def make_sp_train_step(mesh: Mesh, cfg: ModelConfig, *,
     else:
         o_shard = replicated
     init_jit = jax.jit(init, out_shardings=(replicated, o_shard))
-    step_jit = jax.jit(
-        step,
-        in_shardings=(replicated, o_shard, batch_shard),
-        out_shardings=(replicated, o_shard, replicated),
-        donate_argnums=(0, 1),
-    )
+    if moe:
+        # step_fn: (params, opt, tokens) -> (params, opt, loss, metrics)
+        # — the ep step's signature, so callers treat sp×ep and dp×ep
+        # uniformly.
+        metric_shard = {k: replicated for k in metric_specs}
+        step_jit = jax.jit(
+            step_moe,
+            in_shardings=(replicated, o_shard, batch_shard),
+            out_shardings=(replicated, o_shard, replicated,
+                           metric_shard),
+            donate_argnums=(0, 1),
+        )
+    else:
+        step_jit = jax.jit(
+            step,
+            in_shardings=(replicated, o_shard, batch_shard),
+            out_shardings=(replicated, o_shard, replicated),
+            donate_argnums=(0, 1),
+        )
     return init_jit, step_jit

@@ -159,6 +159,21 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
         raise click.UsageError(
             "--ep composes with data parallelism (dp×ep); pick it OR "
             "--pp-stages/--sp")
+    last_moe_metrics: dict = {}
+
+    def wrap_moe_step(step4):
+        """Adapt a 4-tuple MoE step (params, opt, loss, metrics) to the
+        trainer loop's 3-tuple contract, siphoning the router metrics
+        into the progress log."""
+        def raw_step_fn(params, opt_state, tokens):
+            params, opt_state, loss, metrics = step4(
+                params, opt_state, tokens)
+            last_moe_metrics.update(
+                balance=float(metrics["balance_loss"]),
+                z=float(metrics["z_loss"]))
+            return params, opt_state, loss
+        return raw_step_fn
+
     if ep_degree > 1:
         # Expert parallelism: experts over ep with all_to_all dispatch,
         # batch over data×ep (every device is data-parallel for the
@@ -195,15 +210,7 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
         except ValueError as e:
             raise click.UsageError(str(e)) from e
         init_fn = ep_init
-        last_moe_metrics: dict = {}
-
-        def raw_step_fn(params, opt_state, tokens):
-            params, opt_state, loss, metrics = ep_step(
-                params, opt_state, tokens)
-            last_moe_metrics.update(
-                balance=float(metrics["balance_loss"]),
-                z=float(metrics["z_loss"]))
-            return params, opt_state, loss
+        raw_step_fn = wrap_moe_step(ep_step)
     elif sp_degree > 1:
         # Context parallelism: sequence over the sp ring, batch over
         # the remaining (data-parallel) devices.
@@ -235,12 +242,16 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
 
         mesh = make_sp_mesh(jax.devices(), sp=sp_degree, tp=sp_tp)
         try:
-            init_fn, raw_step_fn = make_sp_train_step(
+            init_fn, sp_step = make_sp_train_step(
                 mesh, cfg, train=train_cfg,
                 impl=None if sp_impl == "auto" else sp_impl,
                 shard=shard)
         except ValueError as e:  # e.g. ulysses head-divisibility
             raise click.UsageError(str(e)) from e
+        # --sp with --moe-experts is the sp×ep composition: the MoE
+        # step returns router metrics like the ep step does.
+        raw_step_fn = (wrap_moe_step(sp_step) if moe_experts is not None
+                       else sp_step)
     elif pp_stages > 1:
         # Pipeline mode: layers over a pp ring (GPipe, microbatch
         # remat); tokens replicate across stages.
@@ -412,7 +423,7 @@ def main(steps, batch, vocab, seq_len, d_model, n_layers, n_kv_heads,
                      / max(now - tp_state["t"], 1e-9)) if dsteps else 0.0
             tp_state.update(t=now, step=step)
             moe_note = ""
-            if ep_degree > 1 and last_moe_metrics:
+            if last_moe_metrics:
                 moe_note = (f" balance {last_moe_metrics['balance']:.3f}"
                             f" z {last_moe_metrics['z']:.3f}")
             log.info("step %d loss %.4f (%.0f tok/s)%s", step,
