@@ -189,19 +189,21 @@ class TestGcpRestRetries:
         assert sleeps == [2.0]
 
     def test_gives_up_after_max_attempts(self, monkeypatch):
-        import requests
+        from tpu_autoscaler.actuators.gcp import GcpApiError
 
         rest, transport, _ = _rest(monkeypatch, [_Resp(503)] * 5)
-        with pytest.raises(requests.exceptions.HTTPError):
+        with pytest.raises(GcpApiError) as exc:
             rest.get("https://x/y")
+        assert exc.value.http_status == 503
         assert len(transport.calls) == 5
 
     def test_4xx_not_retried(self, monkeypatch):
-        import requests
+        from tpu_autoscaler.actuators.gcp import GcpApiError
 
         rest, transport, _ = _rest(monkeypatch, [_Resp(404)])
-        with pytest.raises(requests.exceptions.HTTPError):
+        with pytest.raises(GcpApiError) as exc:
             rest.get("https://x/y")
+        assert exc.value.http_status == 404
         assert len(transport.calls) == 1
 
     def test_401_reresolves_token_once(self, monkeypatch):
@@ -214,11 +216,12 @@ class TestGcpRestRetries:
         assert len(transport.calls) == 2
 
     def test_second_401_raises(self, monkeypatch):
-        import requests
+        from tpu_autoscaler.actuators.gcp import GcpApiError
 
         rest, transport, _ = _rest(monkeypatch, [_Resp(401), _Resp(401)])
-        with pytest.raises(requests.exceptions.HTTPError):
+        with pytest.raises(GcpApiError) as exc:
             rest.get("https://x/y")
+        assert exc.value.http_status == 401
         assert len(transport.calls) == 2
 
     def test_post_and_delete_retry(self, monkeypatch):

@@ -33,10 +33,22 @@ class ProvisionStatus:
     # per CPU node), known once ACTIVE.
     unit_ids: list[str] = dataclasses.field(default_factory=list)
     error: str | None = None
+    # Machine-readable failure category (errors.classify_provision_error:
+    # stockout / quota / permission / bad-shape / transient / unknown);
+    # set alongside ``error`` by the real actuators.
+    reason: str | None = None
 
     @property
     def in_flight(self) -> bool:
         return self.state in _IN_FLIGHT
+
+    def fail(self, error) -> None:
+        """Mark FAILED with the error text and its taxonomy category."""
+        from tpu_autoscaler.actuators.errors import classify_provision_error
+
+        self.state = FAILED
+        self.error = str(error)
+        self.reason = classify_provision_error(error)
 
 
 @runtime_checkable

@@ -1,0 +1,116 @@
+"""Machine-readable provisioning-failure taxonomy.
+
+The reference surfaced cloud failures as raw exception strings in logs
+(deployments.py); here every FAILED provision is classified into a small
+closed vocabulary so the controller can (a) count failures per cause in
+metrics, (b) stamp the cause on the starved pods' UNSATISFIABLE
+annotation, and (c) let policy distinguish "wait it out" (stockout) from
+"never going to work" (bad shape, permission).
+
+Categories:
+
+- ``stockout``   — the zone has no capacity for the shape right now
+                   (GCE_STOCKOUT / resource pool exhausted / QR denied
+                   for capacity).  Retry/generation-fallback territory.
+- ``quota``      — the PROJECT's quota is exhausted; retrying won't help
+                   until quota changes.
+- ``permission`` — auth/IAM (401/403 PERMISSION_DENIED).
+- ``bad-shape``  — the request itself is invalid (unknown machine type /
+                   accelerator / topology; 400 INVALID_ARGUMENT).
+- ``transient``  — retries exhausted on 429/5xx/connection errors; the
+                   next reconcile pass will try again.
+- ``unknown``    — anything else.
+
+Fixture-pinned in tests/test_actuator_fixtures.py against sanitized
+real-shape GKE/Cloud-TPU response JSON.
+"""
+
+from __future__ import annotations
+
+STOCKOUT_MARKERS = (
+    "gce_stockout",
+    "resource pool exhausted",
+    "zone_resource_pool_exhausted",
+    "does not have enough resources",
+    "no more capacity",
+    "out of capacity",
+    "stockout",
+    "resource_exhausted_for_capacity",
+)
+
+QUOTA_MARKERS = (
+    "quota",
+    "limit exceeded for",
+    "rate_limit_exceeded",
+)
+
+PERMISSION_MARKERS = (
+    "permission_denied",
+    "permission denied",
+    "forbidden",
+    "unauthenticated",
+    "caller does not have permission",
+    "access not configured",
+)
+
+BAD_SHAPE_MARKERS = (
+    "invalid_argument",
+    "invalid machine type",
+    "machine type with name",
+    "accelerator type",
+    "not found in zone",
+    "unsupported tpu topology",
+    "invalid value for field",
+)
+
+# No bare numeric HTTP-status substrings here: "401"/"503" would match
+# inside unrelated numbers ("after 4013ms").  Status codes classify via
+# GcpApiError.http_status, which carries them reliably.
+TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline_exceeded",
+    "internal error",
+    "backend error",
+    "connection error",
+    "timed out",
+)
+
+_TRANSIENT_HTTP = frozenset({408, 429, 500, 502, 503, 504})
+
+
+def classify_provision_error(error) -> str:
+    """Map a provisioning failure (exception, GcpApiError, or raw
+    string) to one taxonomy category.
+
+    Order matters: quota wording often contains "exhausted" and stockout
+    wording often contains "resource", so the most specific markers are
+    checked first within each category's own list, and stockout (the
+    actionable, expected-in-production case) is checked before the
+    generic buckets.
+    """
+    from tpu_autoscaler.actuators.gcp import GcpApiError
+
+    text_parts = [str(error)]
+    if isinstance(error, GcpApiError):
+        text_parts += [error.status, error.message, *error.reasons]
+        if error.http_status in (401, 403) and not any(
+                m in " ".join(text_parts).lower()
+                for m in QUOTA_MARKERS):
+            return "permission"
+        if error.http_status == 400:
+            return "bad-shape"
+    text = " ".join(text_parts).lower()
+    if any(m in text for m in STOCKOUT_MARKERS):
+        return "stockout"
+    if any(m in text for m in QUOTA_MARKERS):
+        return "quota"
+    if any(m in text for m in PERMISSION_MARKERS):
+        return "permission"
+    if any(m in text for m in BAD_SHAPE_MARKERS):
+        return "bad-shape"
+    if isinstance(error, GcpApiError) \
+            and error.http_status in _TRANSIENT_HTTP:
+        return "transient"
+    if any(m in text for m in TRANSIENT_MARKERS):
+        return "transient"
+    return "unknown"

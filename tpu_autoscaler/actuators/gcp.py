@@ -38,6 +38,25 @@ class GcpAuthError(RuntimeError):
     pass
 
 
+class GcpApiError(RuntimeError):
+    """A non-retryable (or retries-exhausted) googleapis error with the
+    error envelope parsed out — requests' raise_for_status() loses the
+    body, which is exactly where quota-vs-stockout-vs-permission lives
+    (errors.classify_provision_error consumes this)."""
+
+    def __init__(self, http_status: int, url: str, body: dict | str):
+        self.http_status = http_status
+        err = body.get("error", {}) if isinstance(body, dict) else {}
+        self.status = err.get("status", "")            # e.g. RESOURCE_EXHAUSTED
+        self.message = err.get("message", "") or (
+            body if isinstance(body, str) else "")
+        self.reasons = [e.get("reason", "") for e in err.get("errors", [])]
+        detail = self.message or self.status or "(no error body)"
+        super().__init__(
+            f"HTTP {http_status} {self.status or ''} {detail} [{url}]"
+            .replace("  ", " "))
+
+
 class TokenProvider:
     def __init__(self):
         self._token: str | None = None
@@ -170,7 +189,12 @@ class GcpRest:
                     attempt, r.headers.get("Retry-After")))
                 attempt += 1
                 continue
-            r.raise_for_status()
+            if r.status_code >= 400:
+                try:
+                    body = r.json()
+                except ValueError:
+                    body = (r.text or "")[:500]
+                raise GcpApiError(r.status_code, url, body)
             return r.json() if r.content else {}
 
     def get(self, url: str) -> dict:

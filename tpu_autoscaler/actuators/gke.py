@@ -147,9 +147,9 @@ class GkeNodePoolActuator:
                 if op.get("name"):
                     ops.append(op["name"])
         except Exception as e:  # noqa: BLE001 — surface as FAILED status
-            status.state = FAILED
-            status.error = str(e)
-            log.exception("node pool create failed for %s", status.id)
+            status.fail(e)
+            log.exception("node pool create failed for %s (%s)",
+                          status.id, status.reason)
             # Queue rollback of pools already created in this request: a
             # FAILED status is terminal (cancel() only covers in-flight
             # states), so without this the partial pools would register
@@ -222,8 +222,7 @@ class GkeNodePoolActuator:
                 if op.get("error"):
                     error = str(op["error"])
             if error is not None:
-                status.state = FAILED
-                status.error = error
+                status.fail(error)
             elif all_done:
                 status.state = ACTIVE
                 status.unit_ids = list(self._pools.get(pid, [pid]))

@@ -132,9 +132,9 @@ class QueuedResourceActuator:
                 f"{_BASE}/{self._parent}/queuedResources"
                 f"?queuedResourceId={qr_id}", body)
         except Exception as e:  # noqa: BLE001 — surface as FAILED status
-            status.state = FAILED
-            status.error = str(e)
-            log.exception("queued resource create failed for %s", qr_id)
+            status.fail(e)
+            log.exception("queued resource create failed for %s (%s)",
+                          qr_id, status.reason)
         return status
 
     def delete(self, unit_id: str) -> None:
@@ -180,16 +180,31 @@ class QueuedResourceActuator:
             except Exception:  # noqa: BLE001 — transient; retry next pass
                 log.exception("queued resource poll failed for %s", qr_id)
                 continue
-            api_state = (qr.get("state") or {}).get("state", "")
+            state_obj = qr.get("state") or {}
+            api_state = state_obj.get("state", "")
             mapped = _STATE_MAP.get(api_state, PROVISIONING)
-            status.state = mapped
             if mapped == ACTIVE:
+                status.state = mapped
                 count = self._qr_counts.get(qr_id, 1)
                 status.unit_ids = (
                     [qr_id] if count == 1
                     else [f"{qr_id}-{i}" for i in range(count)])
             elif mapped == FAILED:
-                status.error = api_state
+                # The API attaches the denial detail as a google.rpc
+                # Status under the state's *Data field (failedData for
+                # FAILED, suspendedData/suspendingData otherwise) —
+                # that message is where stockout-vs-quota lives.
+                detail = ""
+                for key in ("failedData", "suspendedData",
+                            "suspendingData"):
+                    err = (state_obj.get(key) or {}).get("error") or {}
+                    if err.get("message"):
+                        detail = err["message"]
+                        break
+                status.fail(f"{api_state}: {detail}" if detail
+                            else api_state)
+            else:
+                status.state = mapped
         for qr_id, status in list(self._statuses.items()):
             if status.state in (ACTIVE, FAILED):
                 done = self._done_at.setdefault(qr_id, now)

@@ -30,7 +30,11 @@ from tpu_autoscaler.engine.fitter import free_capacity
 from tpu_autoscaler.engine.planner import Planner, PoolPolicy
 from tpu_autoscaler.k8s.client import KubeClient
 from tpu_autoscaler.k8s.gangs import Gang, group_into_gangs
-from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.objects import (
+    UNSATISFIABLE_ANNOTATION,
+    Node,
+    Pod,
+)
 from tpu_autoscaler.metrics import Metrics
 from tpu_autoscaler.notify import LogNotifier, Notifier
 from tpu_autoscaler.state import SliceState, SliceTracker, classify_slice
@@ -44,9 +48,10 @@ log = logging.getLogger(__name__)
 # see tpu_autoscaler.workloads.checkpoint for the job-side helper).
 CHECKPOINT_ANNOTATION = "autoscaler.tpu.dev/checkpoint-requested"
 
-# Stamped on pods of gangs the planner cannot satisfy (no catalog shape /
-# clamp exceeded), with the human-readable reason.
-UNSATISFIABLE_ANNOTATION = "autoscaler.tpu.dev/unsatisfiable"
+# UNSATISFIABLE_ANNOTATION (stamped on pods of gangs the planner cannot
+# satisfy, or whose provisions fail — with the reason) is defined in
+# k8s/objects.py and re-exported via the import block above, keeping
+# read-only consumers decoupled from this module.
 
 # Node taints GKE applies ahead of involuntary termination (TPU
 # maintenance events, spot/preemptible reclamation).  Any host of a unit
@@ -346,7 +351,7 @@ class Controller:
                pods: list[Pod], now: float) -> None:
         # Process failures FIRST so a provision that failed since last pass
         # sets its backoff before we consider re-submitting for its demand.
-        self._note_failures(now)
+        self._note_failures(now, pods)
         overrides = self._generation_overrides(gangs, now)
         plan = self.planner.plan(gangs, nodes, pods,
                                  in_flight_of(self.actuator),
@@ -599,7 +604,7 @@ class Controller:
                         warning=True)
         return overrides
 
-    def _note_failures(self, now: float) -> None:
+    def _note_failures(self, now: float, pods: list[Pod] = ()) -> None:
         # Cancel provisions stuck in flight past the timeout; the FAILED
         # status this produces is then handled by the normal backoff path.
         timeout = self.config.provision_timeout_seconds
@@ -626,6 +631,14 @@ class Controller:
             if status.state == FAILED and status.id not in self._seen_failures:
                 self._seen_failures.add(status.id)
                 self.metrics.inc("provision_failures")
+                # Per-cause counter + annotation (actuators/errors.py
+                # taxonomy): operators see stockout-vs-quota on the
+                # metrics endpoint and on the starved pods themselves.
+                reason = getattr(status, "reason", None)
+                if reason:
+                    self.metrics.inc(
+                        f"provision_failures_{reason.replace('-', '_')}")
+                    self._annotate_failure_reason(status, reason, pods)
                 backoff_key = (status.request.gang_key
                                or ("shape", status.request.shape_name))
                 self._failure_streak[backoff_key] = (
@@ -648,6 +661,28 @@ class Controller:
                 self._gang_detect_observed.add(key)
                 self.metrics.observe("detect_latency_seconds",
                                      max(0.0, now - first))
+
+    def _annotate_failure_reason(self, status, reason: str,
+                                 pods: list[Pod]) -> None:
+        """Stamp the failed provision's taxonomy category on the pods it
+        was serving, so `kubectl describe` / `status --json` answer
+        "why is my job not starting" with stockout-vs-quota-vs-config
+        instead of a log hunt.  Advisory: never fails the loop."""
+        served = set(status.request.gang_keys or ())
+        if status.request.gang_key is not None:
+            served.add(status.request.gang_key)
+        if not served:
+            return
+        note = f"provision failed ({reason}): {status.error or ''}"[:500]
+        for pod in pods:
+            if pod.gang_key in served and pod.phase == "Pending":
+                try:
+                    self.client.patch_pod(pod.namespace, pod.name, {
+                        "metadata": {"annotations": {
+                            UNSATISFIABLE_ANNOTATION: note}}})
+                except Exception:  # noqa: BLE001 — advisory only
+                    log.debug("could not annotate %s", pod.name,
+                              exc_info=True)
 
     def _track_gang_latency(self, pending: list[Gang], pods: list[Pod],
                             nodes: list[Node], now: float) -> None:

@@ -10,7 +10,11 @@ from __future__ import annotations
 
 from tpu_autoscaler.engine.fitter import FitError, choose_shape_for_gang
 from tpu_autoscaler.k8s.gangs import group_into_gangs
-from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.objects import (
+    UNSATISFIABLE_ANNOTATION,
+    Node,
+    Pod,
+)
 from tpu_autoscaler.k8s.units import group_supply_units
 from tpu_autoscaler.topology.catalog import TPU_RESOURCE
 
@@ -92,6 +96,14 @@ def build_status(node_payloads: list[dict], pod_payloads: list[dict],
                 entry["stranded_chips"] = choice.stranded_chips
             except FitError as e:
                 entry["unsatisfiable"] = str(e)
+        # The controller stamps failed-provision causes (stockout /
+        # quota / ... — actuators/errors.py taxonomy) on the pods; a
+        # read-only status sees them without controller state.
+        notes = {p.annotations.get(UNSATISFIABLE_ANNOTATION)
+                 for p in gang.pods
+                 if p.annotations.get(UNSATISFIABLE_ANNOTATION)}
+        if notes:
+            entry["provisioning_blocked"] = sorted(notes)[0]
         gangs_out.append(entry)
     return {"units": units_out, "pending_gangs": gangs_out}
 

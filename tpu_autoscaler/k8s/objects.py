@@ -39,6 +39,13 @@ _LEGACY_INSTANCE_TYPE_LABEL = "beta.kubernetes.io/instance-type"
 SAFE_TO_EVICT_ANNOTATION = "cluster-autoscaler.kubernetes.io/safe-to-evict"
 MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
 
+# Stamped on pods the autoscaler cannot (currently) serve — planner
+# verdicts (no catalog shape / clamp exceeded) and failed-provision
+# causes (actuators/errors.py taxonomy).  Lives here, not in the
+# reconciler, so read-only consumers (controller/status.py) don't pull
+# the whole control loop in for one string.
+UNSATISFIABLE_ANNOTATION = "autoscaler.tpu.dev/unsatisfiable"
+
 # Gang-identity labels (JobSet / Job machinery).
 JOBSET_NAME_LABEL = "jobset.sigs.k8s.io/jobset-name"
 JOBSET_JOB_INDEX_LABEL = "jobset.sigs.k8s.io/job-index"
