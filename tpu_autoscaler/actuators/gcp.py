@@ -140,16 +140,12 @@ class GcpRest:
                 "Content-Type": "application/json"}
 
     def _backoff_seconds(self, attempt: int, retry_after) -> float:
-        """Retry-After wins when the server said it; else exponential
-        with full jitter (the watch loop's scheme: uniform(0, min(cap,
-        base·2^n)))."""
-        if retry_after is not None:
-            try:
-                return min(float(retry_after), self.backoff_cap_s * 4)
-            except (TypeError, ValueError):
-                pass
-        return self._rng.uniform(
-            0, min(self.backoff_cap_s, self.backoff_base_s * 2 ** attempt))
+        from tpu_autoscaler.backoff import backoff_seconds
+
+        return backoff_seconds(
+            attempt, retry_after, base_s=self.backoff_base_s,
+            cap_s=self.backoff_cap_s,
+            retry_after_cap_s=self.backoff_cap_s * 4, rng=self._rng)
 
     def _note_retry(self, why: str, url: str, attempt: int) -> None:
         if self._metrics is not None:

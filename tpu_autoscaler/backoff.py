@@ -1,0 +1,22 @@
+"""Shared retry-backoff arithmetic.
+
+One formula for every REST layer (k8s/client.py, actuators/gcp.py):
+Retry-After wins when the server said it — capped, because an unbounded
+server hint must not park a single-threaded control loop (or outlive a
+leader lease) — else exponential with full jitter.  The retry LOOPS
+stay with their owners (they genuinely differ: GCP re-resolves tokens
+on 401, the kube client treats DELETE-404 as success); only the
+drift-prone math lives here.
+"""
+
+from __future__ import annotations
+
+
+def backoff_seconds(attempt: int, retry_after, *, base_s: float,
+                    cap_s: float, retry_after_cap_s: float, rng) -> float:
+    if retry_after is not None:
+        try:
+            return min(float(retry_after), retry_after_cap_s)
+        except (TypeError, ValueError):
+            pass
+    return rng.uniform(0, min(cap_s, base_s * 2 ** attempt))

@@ -140,9 +140,12 @@ class Controller:
         self.notifier = notifier or LogNotifier()
         self.metrics = metrics or Metrics()
         # Actuators that do REST I/O surface their retry counters
-        # through the controller's metrics registry (gcp.py GcpRest).
+        # through the controller's metrics registry (gcp.py GcpRest);
+        # the real kube client does the same (kube_retries).
         if hasattr(actuator, "set_metrics"):
             actuator.set_metrics(self.metrics)
+        if hasattr(client, "set_metrics"):
+            client.set_metrics(self.metrics)
         self.planner = Planner(self.config.policy)
         self.tracker = SliceTracker()
         for name in PHASE_LATENCY_METRICS:

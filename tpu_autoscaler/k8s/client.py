@@ -111,10 +111,28 @@ class RestKubeClient:
             client._session.cert = (cert, key)
         return client
 
+    #: Transient apiserver responses worth retrying — rate limiting
+    #: (429, the flooded-apiserver case that flaps leader election) and
+    #: server-side hiccups.  Same scheme as actuators/gcp.py::GcpRest
+    #: (bounded exponential backoff, full jitter, Retry-After honored),
+    #: with a smaller budget: the reconcile loop re-runs every pass, so
+    #: a verb only needs to survive a blip, not an outage.
+    RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+    max_attempts = 4
+    backoff_base_s = 0.25
+    backoff_cap_s = 4.0
+
     def __init__(self, base_url: str | None = None, token: str | None = None,
-                 ca_cert: str | bool = True, dry_run: bool = False):
+                 ca_cert: str | bool = True, dry_run: bool = False,
+                 sleep=None, rng=None):
+        import random
+        import time as _time
+
         import requests  # local import: tests never touch this class
 
+        self._sleep = sleep or _time.sleep
+        self._rng = rng or random.Random()
+        self._metrics = None
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
@@ -134,21 +152,100 @@ class RestKubeClient:
             self._session.headers["Authorization"] = f"Bearer {token}"
         self._session.verify = ca_cert
 
+    def set_metrics(self, metrics) -> None:
+        """Wire the controller's metrics registry in (Controller calls
+        this on construction) so ``kube_retries`` lands next to the
+        actuators' ``rest_retries``."""
+        self._metrics = metrics
+
+    def _request_retrying(self, method: str, url: str, *, timeout: float,
+                          retryable: frozenset | None = None,
+                          max_attempts: int | None = None,
+                          backoff_cap_s: float | None = None,
+                          retry_after_cap_s: float | None = None,
+                          **kw):
+        """One apiserver request with bounded-backoff retries on
+        connection errors and ``retryable`` statuses.  Returns the final
+        Response (callers keep their own status handling — 404-is-None,
+        409-is-conflict, raise_for_status — unchanged).
+
+        Per-call overrides let the lease path shrink its budget below
+        the lease TTL and the eviction path drop 429 (see callers).
+        """
+        import requests
+
+        retryable = self.RETRYABLE_STATUSES if retryable is None \
+            else retryable
+        attempts = max_attempts or self.max_attempts
+        attempt = 0
+        while True:
+            try:
+                r = self._session.request(method, url, timeout=timeout,
+                                          **kw)
+            except requests.exceptions.RequestException as e:
+                if attempt + 1 >= attempts:
+                    raise
+                self._note_retry(
+                    f"connection error ({e.__class__.__name__})", url,
+                    attempt, attempts)
+                self._sleep(self._backoff_seconds(
+                    attempt, None, backoff_cap_s, retry_after_cap_s))
+                attempt += 1
+                continue
+            if r.status_code in retryable and attempt + 1 < attempts:
+                self._note_retry(str(r.status_code), url, attempt,
+                                 attempts)
+                self._sleep(self._backoff_seconds(
+                    attempt, r.headers.get("Retry-After"),
+                    backoff_cap_s, retry_after_cap_s))
+                attempt += 1
+                continue
+            return r
+
+    def _backoff_seconds(self, attempt: int, retry_after,
+                         cap_s: float | None = None,
+                         retry_after_cap_s: float | None = None) -> float:
+        from tpu_autoscaler.backoff import backoff_seconds
+
+        cap = cap_s if cap_s is not None else self.backoff_cap_s
+        return backoff_seconds(
+            attempt, retry_after, base_s=self.backoff_base_s, cap_s=cap,
+            retry_after_cap_s=(retry_after_cap_s if retry_after_cap_s
+                               is not None else cap * 4),
+            rng=self._rng)
+
+    def _note_retry(self, why: str, url: str, attempt: int,
+                    attempts: int) -> None:
+        if self._metrics is not None:
+            self._metrics.inc("kube_retries")
+        log.warning("apiserver %s (attempt %d/%d) %s — retrying", why,
+                    attempt + 1, attempts, url)
+
     def _get(self, path: str) -> dict:
-        r = self._session.get(f"{self._base}{path}", timeout=30)
+        r = self._request_retrying("GET", f"{self._base}{path}",
+                                   timeout=30)
         r.raise_for_status()
         return r.json()
 
     def _mutate(self, method: str, path: str, body: dict | None = None,
-                content_type: str = "application/json") -> None:
+                content_type: str = "application/json",
+                retryable: frozenset | None = None,
+                ok_404: bool = False) -> None:
         if self._dry_run:
             log.info("[dry-run] %s %s %s", method, path,
                      json.dumps(body) if body else "")
             return
-        r = self._session.request(
+        r = self._request_retrying(
             method, f"{self._base}{path}",
             data=json.dumps(body) if body is not None else None,
-            headers={"Content-Type": content_type}, timeout=30)
+            headers={"Content-Type": content_type}, timeout=30,
+            retryable=retryable)
+        if (ok_404 or method == "DELETE") and r.status_code == 404:
+            # Idempotent teardown: a retried DELETE/eviction whose first
+            # attempt committed (or a racing deleter) reports success,
+            # not an exception that fails the reconcile step.
+            log.debug("%s already gone (404)", path)
+            return
         r.raise_for_status()
 
     def list_nodes(self) -> list[dict]:
@@ -172,9 +269,16 @@ class RestKubeClient:
             "kind": "Eviction",
             "metadata": {"name": name, "namespace": namespace},
         }
+        # 429 on THIS path is not a transient fault: the Eviction API
+        # answers 429 when a PodDisruptionBudget disallows the
+        # disruption — a policy verdict the drain loop must see now,
+        # not after three backoffs that stall the whole reconcile pass.
+        # 404 = already evicted/gone = success (retried POSTs whose
+        # first attempt committed land here).
         self._mutate(
             "POST", f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
-            body)
+            body, retryable=self.RETRYABLE_STATUSES - {429},
+            ok_404=True)
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self._mutate("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
@@ -186,12 +290,27 @@ class RestKubeClient:
         self._mutate("POST", f"/api/v1/namespaces/{namespace}/events",
                      body)
 
-    def get_lease(self, namespace: str, name: str) -> dict | None:
-        import requests
+    # Lease verbs run on a TIGHT retry budget: the whole point is to
+    # renew before the ~15 s lease TTL, so one blocked call must stay
+    # well under it (2 attempts, <=1 s jitter, Retry-After clamped to
+    # 2 s, 5 s socket timeout → worst case ~13 s; the default budget's
+    # Retry-After cap alone exceeds the TTL).
+    LEASE_ATTEMPTS = 2
+    LEASE_BACKOFF_CAP_S = 1.0
+    LEASE_RETRY_AFTER_CAP_S = 2.0
+    LEASE_TIMEOUT_S = 5.0
 
-        r = self._session.get(
+    def get_lease(self, namespace: str, name: str) -> dict | None:
+        # Retried (within the lease budget): a 429 on the lease READ
+        # path must not make the current leader think it lost
+        # (leader-election flap under a flooded apiserver).
+        r = self._request_retrying(
+            "GET",
             f"{self._base}/apis/coordination.k8s.io/v1/namespaces/"
-            f"{namespace}/leases/{name}", timeout=10)
+            f"{namespace}/leases/{name}", timeout=self.LEASE_TIMEOUT_S,
+            max_attempts=self.LEASE_ATTEMPTS,
+            backoff_cap_s=self.LEASE_BACKOFF_CAP_S,
+            retry_after_cap_s=self.LEASE_RETRY_AFTER_CAP_S)
         if r.status_code == 404:
             return None
         r.raise_for_status()
@@ -209,11 +328,20 @@ class RestKubeClient:
         exists = "resourceVersion" in body.get("metadata", {})
         import json as _json
 
-        r = self._session.request(
+        # Retry-safe: the write is guarded by resourceVersion, so a
+        # retry of an already-committed PUT surfaces as a 409 conflict
+        # (NOT retried — losing the optimistic-concurrency race is a
+        # leader-election outcome, not a transient fault; the next
+        # try_acquire re-reads and recovers).
+        r = self._request_retrying(
             "PUT" if exists else "POST",
             f"{base}/{name}" if exists else base,
             data=_json.dumps(body),
-            headers={"Content-Type": "application/json"}, timeout=10)
+            headers={"Content-Type": "application/json"},
+            timeout=self.LEASE_TIMEOUT_S,
+            max_attempts=self.LEASE_ATTEMPTS,
+            backoff_cap_s=self.LEASE_BACKOFF_CAP_S,
+            retry_after_cap_s=self.LEASE_RETRY_AFTER_CAP_S)
         r.raise_for_status()
 
     def watch_pods(self, timeout_seconds: int = 60,

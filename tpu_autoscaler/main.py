@@ -261,11 +261,28 @@ def make_kube_client(kube_url, kube_token, kubeconfig, kube_context,
     URL/token > in-cluster."""
     from tpu_autoscaler.k8s.client import RestKubeClient
 
-    if kubeconfig:
-        return RestKubeClient.from_kubeconfig(kubeconfig, kube_context,
-                                              dry_run=dry_run)
-    return RestKubeClient(base_url=kube_url, token=kube_token,
-                          dry_run=dry_run)
+    import yaml
+
+    try:
+        if kubeconfig:
+            return RestKubeClient.from_kubeconfig(kubeconfig, kube_context,
+                                                  dry_run=dry_run)
+    except (OSError, KeyError, AttributeError, TypeError, ValueError,
+            yaml.YAMLError) as e:
+        # Malformed/missing kubeconfig: a clean CLI error naming the
+        # file, not a traceback — and not misdiagnosed as connectivity.
+        raise click.UsageError(
+            f"could not load kubeconfig {kubeconfig!r}: "
+            f"{e.__class__.__name__}: {e}") from e
+    try:
+        return RestKubeClient(base_url=kube_url, token=kube_token,
+                              dry_run=dry_run)
+    except (RuntimeError, OSError) as e:
+        # No cluster reachable: `run` outside a cluster is a common
+        # first touch — fail politely.
+        raise click.UsageError(
+            f"cannot connect to a cluster: {e} — pass --kube-url/"
+            "--kubeconfig or run in-cluster") from e
 
 
 @click.group()
