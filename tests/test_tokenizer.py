@@ -1,0 +1,186 @@
+"""Byte-level BPE tokenizer (workloads/tokenizer.py) + the real-corpus
+data path (VERDICT r4 item 8)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_autoscaler.workloads.tokenizer import (
+    ByteBPE,
+    _merge_pair,
+    build_shard,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SAMPLE = (b"the autoscaler provisions the slice and the scheduler binds "
+          b"the gang to the slice; the slice registers and the gang runs "
+          b"on the slice until the gang completes and the slice drains. "
+          * 20)
+
+
+class TestMergeKernel:
+    def test_simple_merge(self):
+        arr = np.array([1, 2, 3, 1, 2], np.uint32)
+        out = _merge_pair(arr, 1, 2, 99)
+        np.testing.assert_array_equal(out, [99, 3, 99])
+
+    def test_self_pair_overlap_greedy_left(self):
+        """aaa merges its FIRST pair only: (aa)a, never a(aa)."""
+        arr = np.array([7, 7, 7, 7, 7], np.uint32)
+        out = _merge_pair(arr, 7, 7, 50)
+        np.testing.assert_array_equal(out, [50, 50, 7])
+
+    def test_no_match_returns_same(self):
+        arr = np.array([1, 2, 3], np.uint32)
+        np.testing.assert_array_equal(_merge_pair(arr, 5, 6, 99), arr)
+
+
+class TestByteBPE:
+    def test_roundtrip_exact(self):
+        bpe = ByteBPE.train(SAMPLE, 300)
+        ids = bpe.encode(SAMPLE)
+        assert bpe.decode(ids) == SAMPLE
+        assert len(ids) < len(SAMPLE) / 2  # it actually compresses
+
+    def test_unseen_text_roundtrips(self):
+        """Byte-level: ANY input encodes, including bytes/scripts the
+        corpus never saw."""
+        bpe = ByteBPE.train(SAMPLE, 300)
+        novel = "Zürich 東京 \x00\xff binary\n".encode()
+        assert bpe.decode(bpe.encode(novel)) == novel
+        assert bpe.decode_str(bpe.encode("héllo")) == "héllo"
+
+    def test_training_deterministic(self):
+        a = ByteBPE.train(SAMPLE, 300)
+        b = ByteBPE.train(SAMPLE, 300)
+        assert a.merges == b.merges
+
+    def test_vocab_size_respected_and_early_stop(self):
+        bpe = ByteBPE.train(SAMPLE, 300)
+        assert bpe.vocab_size == 300
+        # A tiny corpus exhausts repeating pairs before a huge vocab.
+        tiny = ByteBPE.train(b"ababab", 10_000)
+        assert tiny.vocab_size < 300
+        assert tiny.decode(tiny.encode(b"ababab")) == b"ababab"
+
+    def test_save_load_identity(self, tmp_path):
+        bpe = ByteBPE.train(SAMPLE, 280)
+        path = str(tmp_path / "tok.json")
+        bpe.save(path)
+        again = ByteBPE.load(path)
+        assert again.merges == bpe.merges
+        np.testing.assert_array_equal(again.encode(SAMPLE),
+                                      bpe.encode(SAMPLE))
+
+    def test_bad_file_rejected(self, tmp_path):
+        path = str(tmp_path / "bad.json")
+        with open(path, "w") as f:
+            json.dump({"format": "other"}, f)
+        with pytest.raises(ValueError, match="byte-bpe-v1"):
+            ByteBPE.load(path)
+
+    def test_vocab_floor(self):
+        with pytest.raises(ValueError, match="must be >= 256"):
+            ByteBPE.train(SAMPLE, 100)
+
+
+class TestCommittedArtifacts:
+    """The committed tokenizer/corpus/shard stay consistent with each
+    other and with the data loader."""
+
+    def test_committed_tokenizer_and_shard_consistent(self):
+        tok_path = os.path.join(REPO, "data", "tokenizer.json")
+        shard_path = os.path.join(REPO, "data", "corpus.bin")
+        corpus_path = os.path.join(REPO, "data", "corpus.txt")
+        for p in (tok_path, shard_path, corpus_path):
+            assert os.path.exists(p), f"missing committed artifact {p}"
+        bpe = ByteBPE.load(tok_path)
+        assert bpe.vocab_size == 8192
+        shard = np.fromfile(shard_path, np.uint32)
+        assert shard.max() < bpe.vocab_size
+        # Decoding the shard reproduces the corpus bytes exactly.
+        corpus = open(corpus_path, "rb").read()
+        head = bpe.decode(shard[:2000])
+        assert corpus.startswith(head)
+        # Realistic compression for mixed prose/code at vocab 8k.
+        assert len(corpus) / len(shard) > 3.0
+
+    def test_shard_serves_through_data_loader(self):
+        from tpu_autoscaler.dataio import PyTokenLoader
+
+        shard_path = os.path.join(REPO, "data", "corpus.bin")
+        loader = PyTokenLoader(shard_path, batch=4, window=33, seed=3)
+        batch = loader.next(step=0)
+        assert batch.shape == (4, 33)
+        assert batch.dtype == np.uint32
+        assert batch.max() < 8192
+        # Stateless resume: the same (seed, step) replays exactly.
+        np.testing.assert_array_equal(batch, loader.next(step=0))
+
+    def test_build_shard_reuses_committed_tokenizer(self, tmp_path):
+        """build_shard must NOT retrain when tokenizer.json matches the
+        requested vocab (training is the slow step).  Runs on a COPY of
+        the committed tokenizer: build_shard writes to its tokenizer
+        path on a cache miss, and a test must never be one corrupted
+        artifact away from overwriting a committed file."""
+        import shutil
+
+        out = str(tmp_path / "shard.bin")
+        corpus = str(tmp_path / "c.txt")
+        with open(corpus, "wb") as f:
+            f.write(SAMPLE)
+        tok = str(tmp_path / "tokenizer.json")
+        shutil.copy(os.path.join(REPO, "data", "tokenizer.json"), tok)
+        bpe, ids = build_shard(corpus, tok, out, 8192)
+        # Retraining on the 3 KB SAMPLE would early-stop far below
+        # vocab 8192, so full vocab == the committed tokenizer was
+        # reused, not retrained.
+        assert bpe.vocab_size == 8192
+        assert bpe.decode(ids) == SAMPLE
+
+
+@pytest.mark.slow
+class TestRealCorpusConvergence:
+    def test_loss_drops_on_real_corpus(self):
+        """The convergence gate at realistic token statistics: a tiny
+        model on the committed vocab-8192 shard must move from the
+        uniform floor toward the corpus statistics within 50 steps."""
+        import jax
+
+        from tpu_autoscaler.dataio import PyTokenLoader
+        from tpu_autoscaler.workloads.model import (
+            ModelConfig,
+            make_mesh,
+            make_sharded_train_step,
+        )
+
+        from tpu_autoscaler.workloads.model import TrainConfig
+
+        cfg = ModelConfig(vocab=8192, d_model=64, n_layers=2, n_heads=4,
+                          d_ff=128, seq_len=64)
+        mesh = make_mesh(jax.devices()[:1])
+        init_fn, step_fn = make_sharded_train_step(
+            mesh, cfg, train=TrainConfig(learning_rate=3e-3,
+                                         warmup_steps=10,
+                                         grad_clip=1.0))
+        params, opt = init_fn(jax.random.PRNGKey(0))
+        loader = PyTokenLoader(
+            os.path.join(REPO, "data", "corpus.bin"),
+            batch=8, window=cfg.seq_len + 1, seed=0)
+        losses = []
+        for step in range(300):
+            batch = loader.next(step).astype(np.int32)
+            params, opt, loss = step_fn(params, opt, batch)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        # BPE flattens the token distribution (that is its job), so the
+        # meaningful bar is the UNIGRAM entropy of the shard (8.22 nats
+        # measured), not ln(V)=9.01: ending clearly below unigram means
+        # the model learned CONTEXT, not just token frequencies.
+        unigram_h = 8.22
+        assert losses[0] > unigram_h + 0.5   # starts near uniform
+        assert losses[-1] < losses[0] - 1.5  # and moves a long way
+        assert losses[-1] < unigram_h - 0.2  # below what unigrams allow

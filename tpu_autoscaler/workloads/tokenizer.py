@@ -1,0 +1,196 @@
+"""Byte-level BPE tokenizer: the real-text data path for the trainer.
+
+dataio.py serves uint32 token shards; until round 5 the only in-repo
+shard was a vocab-256 synthetic bigram stream, so convergence evidence
+proved plumbing, not learning at realistic token statistics (VERDICT r4
+item 8).  This module closes that: a byte-level BPE (GPT-2 family
+lineage: every byte is a base token, so ANY input encodes — no OOV, no
+normalization table) trained in pure Python/numpy, a committed corpus
+(data/corpus.txt — this repo's own docs + source, ~1.2 MB of mixed
+prose/code), and a CLI that writes tokenizer.json plus a
+loader-compatible uint32 shard.
+
+Training is the textbook greedy loop — repeatedly merge the most
+frequent adjacent pair — vectorized so each merge is a handful of numpy
+passes over the (shrinking) corpus instead of a Python scan: pair
+counting packs (left, right) into one uint64 key for np.unique;
+merging writes the new id at each match site and deletes the right
+element, with a small Python pass only to drop overlapping matches of
+self-pairs (aaa → (aa)a, not a(aa)).
+
+Encoding arbitrary NEW text replays the merges in rank order on the
+text's byte array (same numpy kernel); decode expands ids through the
+vocab table back to bytes.  Round-trip is exact by construction and
+pinned in tests/test_tokenizer.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+#: Base alphabet: every byte value is a token, so encoding never fails.
+N_BYTES = 256
+
+
+def _pair_counts(arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(pairs [n, 2], counts [n]) of adjacent pairs, via one uint64 key."""
+    if len(arr) < 2:
+        return np.empty((0, 2), np.uint32), np.empty((0,), np.int64)
+    keys = (arr[:-1].astype(np.uint64) << np.uint64(32)) \
+        | arr[1:].astype(np.uint64)
+    uniq, counts = np.unique(keys, return_counts=True)
+    pairs = np.stack([(uniq >> np.uint64(32)).astype(np.uint32),
+                      (uniq & np.uint64(0xFFFFFFFF)).astype(np.uint32)],
+                     axis=1)
+    return pairs, counts
+
+
+def _merge_pair(arr: np.ndarray, a: int, b: int,
+                new_id: int) -> np.ndarray:
+    """Replace every non-overlapping (a, b) occurrence with new_id."""
+    m = (arr[:-1] == a) & (arr[1:] == b)
+    idx = np.nonzero(m)[0]
+    if len(idx) == 0:
+        return arr
+    if a == b:
+        # Greedy left-to-right: a run "aaa" merges its FIRST pair only.
+        keep, last = [], -2
+        for i in idx:
+            if i == last + 1:
+                continue
+            keep.append(i)
+            last = i
+        idx = np.asarray(keep, idx.dtype)
+    arr = arr.copy()
+    arr[idx] = new_id
+    return np.delete(arr, idx + 1)
+
+
+class ByteBPE:
+    """merges: list of (left_id, right_id); merge i creates id 256+i."""
+
+    def __init__(self, merges: list[tuple[int, int]]):
+        self.merges = [tuple(m) for m in merges]
+        # id -> bytes expansion table.
+        table: list[bytes] = [bytes([i]) for i in range(N_BYTES)]
+        for a, b in self.merges:
+            table.append(table[a] + table[b])
+        self._table = table
+
+    @property
+    def vocab_size(self) -> int:
+        return N_BYTES + len(self.merges)
+
+    # ---- training ------------------------------------------------------
+
+    @classmethod
+    def train(cls, data: bytes, vocab_size: int,
+              min_count: int = 2) -> "ByteBPE":
+        """Greedy BPE to ``vocab_size`` (stops early when no pair
+        repeats ``min_count`` times — merging singletons memorizes the
+        corpus instead of compressing it)."""
+        if vocab_size < N_BYTES:
+            raise ValueError(
+                f"vocab_size must be >= {N_BYTES}, got {vocab_size}")
+        arr = np.frombuffer(data, np.uint8).astype(np.uint32)
+        merges: list[tuple[int, int]] = []
+        while N_BYTES + len(merges) < vocab_size:
+            pairs, counts = _pair_counts(arr)
+            if len(counts) == 0 or counts.max() < min_count:
+                break
+            a, b = pairs[int(np.argmax(counts))]
+            new_id = N_BYTES + len(merges)
+            merges.append((int(a), int(b)))
+            arr = _merge_pair(arr, int(a), int(b), new_id)
+        return cls(merges)
+
+    # ---- encode / decode ----------------------------------------------
+
+    def encode(self, data: bytes | str) -> np.ndarray:
+        """Encode bytes/str -> uint32 ids (merges replayed in rank
+        order — the canonical BPE encode)."""
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        arr = np.frombuffer(data, np.uint8).astype(np.uint32)
+        for rank, (a, b) in enumerate(self.merges):
+            if len(arr) < 2:
+                break
+            arr = _merge_pair(arr, a, b, N_BYTES + rank)
+        return arr
+
+    def decode(self, ids) -> bytes:
+        return b"".join(self._table[int(i)] for i in np.asarray(ids))
+
+    def decode_str(self, ids) -> str:
+        return self.decode(ids).decode("utf-8", errors="replace")
+
+    # ---- persistence ---------------------------------------------------
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"format": "byte-bpe-v1",
+                       "vocab_size": self.vocab_size,
+                       "merges": [list(m) for m in self.merges]}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "ByteBPE":
+        with open(path) as f:
+            obj = json.load(f)
+        if obj.get("format") != "byte-bpe-v1":
+            raise ValueError(f"{path}: not a byte-bpe-v1 tokenizer file")
+        return cls([tuple(m) for m in obj["merges"]])
+
+
+def build_shard(corpus_path: str, tokenizer_path: str, shard_path: str,
+                vocab_size: int = 8192) -> tuple[ByteBPE, np.ndarray]:
+    """Train (or reuse) a tokenizer on the corpus and write the encoded
+    corpus as a dataio-compatible uint32 shard.  Reuses an existing
+    tokenizer.json if its vocab matches (training is the slow step)."""
+    import os
+
+    from tpu_autoscaler.dataio import write_token_file
+
+    with open(corpus_path, "rb") as f:
+        data = f.read()
+    bpe = None
+    if os.path.exists(tokenizer_path):
+        try:
+            cached = ByteBPE.load(tokenizer_path)
+            if cached.vocab_size == vocab_size:
+                bpe = cached
+        except (ValueError, KeyError, json.JSONDecodeError):
+            bpe = None
+    if bpe is None:
+        bpe = ByteBPE.train(data, vocab_size)
+        bpe.save(tokenizer_path)
+    ids = bpe.encode(data)
+    write_token_file(shard_path, ids.astype(np.uint32))
+    return bpe, ids
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Train a byte-level BPE and shard a corpus for the "
+                    "trainer (--data-file).")
+    p.add_argument("--corpus", default="data/corpus.txt")
+    p.add_argument("--vocab", type=int, default=8192)
+    p.add_argument("--tokenizer-out", default="data/tokenizer.json")
+    p.add_argument("--shard-out", default="data/corpus.bin")
+    args = p.parse_args(argv)
+    import os
+
+    bpe, ids = build_shard(args.corpus, args.tokenizer_out,
+                           args.shard_out, args.vocab)
+    ratio = os.path.getsize(args.corpus) / max(1, len(ids))
+    print(f"tokenizer: vocab {bpe.vocab_size} -> {args.tokenizer_out}\n"
+          f"shard: {len(ids)} tokens ({ratio:.2f} bytes/token) -> "
+          f"{args.shard_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -86,8 +86,12 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
                    "divisible by --sp).  auto = pallas ring on TPU.")
 @click.option("--data-file", default=None,
               help="Binary uint32 token shard to train on (native mmap "
-                   "loader with prefetch; numpy fallback).  Default: "
-                   "synthetic random tokens.")
+                   "loader with prefetch; numpy fallback).  The repo "
+                   "ships data/corpus.bin (byte-BPE vocab 8192, "
+                   "data/tokenizer.json; rebuild or retokenize with "
+                   "`python -m tpu_autoscaler.workloads.tokenizer`) — "
+                   "pair it with --vocab 8192.  Default: synthetic "
+                   "random tokens.")
 @click.option("--profile-dir", default=None,
               help="Capture a jax.profiler trace of steps start+3..start+5 "
                    "into this directory (view with TensorBoard / xprof).")
