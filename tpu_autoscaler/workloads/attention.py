@@ -895,6 +895,122 @@ def flash_decode(q, k_cache, v_cache, length, *, window: int | None = None,
     return out.reshape(b, h, 1, d)
 
 
+def _paged_decode_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, sm_scale: float,
+                         window, block_size: int, n_blocks: int,
+                         h_kv: int):
+    """_decode_kernel's math over a PAGED cache: grid step (row, j)
+    streams the j-th table entry's POOL block, fetched in place by the
+    scalar-prefetched block table (the index map chases tab_ref) — the
+    vLLM/PagedAttention read pattern without the gather copy the
+    einsum path pays.  Dead table slots (-1: positions past the row's
+    length) skip their MXU work via the same pl.when the linear kernel
+    uses for past-length blocks."""
+    j = pl.program_id(1)
+    row = pl.program_id(0) // h_kv
+    qpos = len_ref[row] - 1
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    live = (j * block_size <= qpos) & (tab_ref[row, j] >= 0)
+    if window is not None:
+        live &= j * block_size + block_size - 1 > qpos - window
+
+    @pl.when(live)
+    def _step():
+        scores = jax.lax.dot_general(
+            q_ref[0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [g, bs]
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        keep = k_pos <= qpos
+        if window is not None:
+            keep &= k_pos > qpos - window
+        scores = jnp.where(keep, scores, NEG_INF)
+        m_scr[...], l_scr[...], acc_scr[...] = _online_softmax_merge(
+            scores, v_ref[0, 0], m_scr[...], l_scr[...], acc_scr[...])
+
+    @pl.when(j == n_blocks - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_flash_decode(q, k_pool, v_pool, tables, lengths, *,
+                       window: int | None = None,
+                       interpret: bool = False):
+    """Fused cached attention for one decode step over a PAGED cache.
+
+    q: [slots, h, 1, d]; k_pool, v_pool: [num_blocks, kv_heads,
+    block_size, d] (the global block pool — workloads/paged.py's
+    layout, one layer's slice); tables: [slots, tpr] int32 block ids
+    (-1 = no block); lengths: [slots] int32.  Returns [slots, h, 1, d].
+
+    The pool blocks are read IN PLACE: the k/v index maps look the
+    block id up in the scalar-prefetched table, so no [slots, tpr*bs]
+    contiguous gather copy (which doubles the decode step's HBM
+    traffic — the decode cost) happens before the read.  Dead table
+    entries still fetch a (clamped) block per BlockSpec semantics;
+    only their MXU work is skipped — the saving is the gather copy,
+    not fewer-than-tpr fetches.  Same per-row online-softmax math as
+    flash_decode; parity pinned in tests/test_paged.py."""
+    slots, h, sq, d = q.shape
+    if sq != 1:
+        raise ValueError(
+            f"paged_flash_decode is single-token (sq=1); got {sq}")
+    nb, h_kv, block_size, dk = k_pool.shape
+    if dk != d:
+        raise ValueError(f"head dim mismatch: q {d} vs pool {dk}")
+    tpr = tables.shape[1]
+    group = h // h_kv
+    sm_scale = d ** -0.5
+    qg = q.reshape(slots, h_kv, group, d).reshape(slots * h_kv, group, d)
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(slots)
+    tables = jnp.asarray(tables, jnp.int32)
+
+    def q_map(bh, j, len_ref, tab_ref):
+        return (bh, 0, 0)
+
+    def kv_map(bh, j, len_ref, tab_ref):
+        # Chase the block table: grid step (row, j) reads pool block
+        # tables[row, j] for this row's kv head.  Out-of-range entries
+        # clamp into the pool (same [0, nb-1] clip as _gather_rows);
+        # dead entries' compute is pl.when-skipped.
+        row = bh // h_kv
+        head = bh % h_kv
+        return (jnp.clip(tab_ref[row, j], 0, nb - 1), head, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots * h_kv, tpr),
+        in_specs=[
+            pl.BlockSpec((1, group, d), q_map),
+            pl.BlockSpec((1, 1, block_size, d), kv_map),
+            pl.BlockSpec((1, 1, block_size, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, group, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sm_scale=sm_scale,
+                          window=window, block_size=block_size,
+                          n_blocks=tpr, h_kv=h_kv),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots * h_kv, group, d),
+                                       q.dtype),
+        interpret=interpret,
+    )(lengths, tables, qg, k_pool, v_pool)
+    return out.reshape(slots, h, 1, d)
+
+
 def reference_attention(q, k, v, *, causal=True, window=None):
     """Plain einsum attention, the numerics oracle for the kernel.
 

@@ -166,6 +166,51 @@ def _scatter_chunk(pool, new, table_row, offset, n_valid):
         new.transpose(1, 0, 2), mode="drop")
 
 
+def _paged_attend(q, k_pool, v_pool, tables, new_len, cfg: ModelConfig,
+                  mesh):
+    """The paged cache read for one decode layer.
+
+    On TPU (and under interpret for tests) the fused paged kernel
+    (attention.paged_flash_decode) reads each row's pool blocks IN
+    PLACE through the scalar-prefetched block table — no contiguous
+    gather copy, so the decode step's HBM traffic is exactly the live
+    cache bytes.  Under a TP mesh the kernel shard_maps with KV heads
+    on 'model' (pool block dim + tables replicate).  Everywhere else:
+    gather the rows and reuse the linear engine's per-row attention
+    (_slot_attend — einsum mask or linear flash kernel)."""
+    if cfg.resolved_attention() == "pallas":
+        from tpu_autoscaler.workloads.attention import paged_flash_decode
+
+        interpret = jax.default_backend() != "tpu"
+        if mesh is None or mesh.size == 1:
+            return paged_flash_decode(
+                q, k_pool, v_pool, tables, new_len,
+                window=cfg.attention_window, interpret=interpret)
+        # Head divisibility is already enforced upstream: the step
+        # builders run cfg.resolved_for_mesh(mesh), which rejects an
+        # unshardable explicit 'pallas' and downgrades 'auto'.
+        tp_only = all(mesh.shape[a] == 1 for a in mesh.axis_names
+                      if a != "model")
+        if tp_only and "model" in mesh.axis_names:
+            from jax.sharding import PartitionSpec as P
+
+            hspec = P(None, "model", None, None)
+
+            def kern(q, kp, vp, tb, ln):
+                return paged_flash_decode(
+                    q, kp, vp, tb, ln, window=cfg.attention_window,
+                    interpret=interpret)
+
+            return jax.shard_map(
+                kern, mesh=mesh,
+                in_specs=(hspec, hspec, hspec, P(), P()),
+                out_specs=hspec, check_vma=False)(
+                    q, k_pool, v_pool, tables, new_len)
+    k_rows = _gather_rows(k_pool, tables)
+    v_rows = _gather_rows(v_pool, tables)
+    return _slot_attend(q, k_rows, v_rows, new_len, cfg, mesh)
+
+
 def make_paged_decode_step(cfg: ModelConfig, tokens_per_row: int,
                            mesh=None):
     """Build ``step(params, cache, tables, tokens, active) -> (logits,
@@ -203,10 +248,8 @@ def make_paged_decode_step(cfg: ModelConfig, tokens_per_row: int,
                 k = _rope_rows(k, cfg.rope_theta, positions)
             k_pool = _scatter_token(k_pool, k, tables, positions, active)
             v_pool = _scatter_token(v_pool, v, tables, positions, active)
-            k_rows = _gather_rows(k_pool, tables)
-            v_rows = _gather_rows(v_pool, tables)
-            attn = _slot_attend(q, k_rows, v_rows, positions + 1, cfg,
-                                mesh)
+            attn = _paged_attend(q, k_pool, v_pool, tables,
+                                 positions + 1, cfg, mesh)
             attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
             x = x + jnp.einsum("bsd,de->bse", attn,
                                layer["attn_out"].astype(cfg.dtype))
