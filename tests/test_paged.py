@@ -159,6 +159,17 @@ class TestPreemption:
 
 
 class TestPoolPressureEdgeCases:
+    def test_request_larger_than_pool_rejected_at_submit(self):
+        """A request whose worst case exceeds the whole pool would
+        self-preempt forever; submit must reject it up front."""
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        eng = PagedBatcher(params, CFG, slots=2, max_len=64,
+                           block_size=8, num_blocks=4, chunk=8)
+        with pytest.raises(ValueError, match="never be scheduled"):
+            eng.submit(Request(
+                prompt=(np.arange(40, dtype=np.int32) % CFG.vocab),
+                max_new_tokens=8))  # 48 tokens > 32-token pool
+
     def test_admission_partial_allocation_released(self):
         """Admission needing 2 blocks with only 1 free must return the
         partial allocation to the pool (review finding: the old path

@@ -277,6 +277,50 @@ class TestServeCli:
         out = json.loads(result.stdout.strip().splitlines()[0])
         assert len(out["tokens"]) == 4 and out["done"]
 
+    def test_serves_paged(self, tmp_path):
+        import json
+
+        trained = run_train(tmp_path, "--steps", "4",
+                            "--checkpoint-every", "4")
+        assert trained.returncode == 0, trained.stderr
+        reqs = tmp_path / "reqs.jsonl"
+        reqs.write_text(
+            '{"prompt": [3, 17, 4], "max_new_tokens": 5}\n'
+            '{"prompt": [9, 2, 2, 8, 1], "max_new_tokens": 3}\n')
+        result = self.run_serve(tmp_path, "--requests", str(reqs),
+                                "--paged", "--block-size", "8",
+                                "--slots", "2", "--chunk", "4",
+                                "--max-len", "32")
+        assert result.returncode == 0, result.stderr
+        lines = [json.loads(x) for x in
+                 result.stdout.strip().splitlines()]
+        assert len(lines[0]["tokens"]) == 5 and lines[0]["done"]
+        assert len(lines[1]["tokens"]) == 3 and lines[1]["done"]
+        # Paged output matches the linear engine's greedy output.
+        linear = self.run_serve(tmp_path, "--requests", str(reqs),
+                                "--slots", "2", "--chunk", "4",
+                                "--max-len", "32")
+        assert linear.returncode == 0, linear.stderr
+        lin = [json.loads(x) for x in linear.stdout.strip().splitlines()]
+        assert [r["tokens"] for r in lines] == [r["tokens"] for r in lin]
+
+    def test_paged_flag_validation_is_instant(self, tmp_path):
+        """Pure flag conflicts error BEFORE the checkpoint restore (no
+        training needed to reach them)."""
+        result = self.run_serve(tmp_path, "--random", "1", "--paged",
+                                "--ring", "--attention-window", "8")
+        assert result.returncode != 0
+        assert "pick one" in result.stderr
+        result = self.run_serve(tmp_path, "--random", "1", "--paged",
+                                "--num-blocks", "1", "--block-size", "8",
+                                "--chunk", "32")
+        assert result.returncode != 0
+        assert "livelock" in result.stderr
+        result = self.run_serve(tmp_path, "--random", "1", "--paged",
+                                "--block-size", "24", "--max-len", "32")
+        assert result.returncode != 0
+        assert "multiple of" in result.stderr
+
     def test_random_requests_and_no_checkpoint_error(self, tmp_path):
         result = self.run_serve(tmp_path, "--random", "2")
         assert result.returncode != 0

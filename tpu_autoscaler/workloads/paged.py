@@ -442,6 +442,23 @@ class PagedBatcher(ContinuousBatcher):
                                            self.prefill_lanes, max_len,
                                            mesh)
 
+    def submit(self, request: Request) -> None:
+        """Linear-engine validation plus the pool-feasibility check: a
+        request whose worst-case footprint exceeds the WHOLE pool could
+        never run even alone — without this it would self-preempt in a
+        loop (admit → grow → preempt itself → re-queue) forever."""
+        need_blocks = -(-(len(request.prompt) + request.max_new_tokens)
+                        // self.block_size)
+        if need_blocks > self.allocator.num_blocks:
+            raise ValueError(
+                f"request needs {need_blocks} blocks "
+                f"({len(request.prompt)} prompt + "
+                f"{request.max_new_tokens} new at block_size "
+                f"{self.block_size}) but the pool holds only "
+                f"{self.allocator.num_blocks}; it can never be "
+                "scheduled")
+        super().submit(request)
+
     # ---- accounting ----------------------------------------------------
 
     def live_tokens(self) -> int:

@@ -52,6 +52,17 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--ring", is_flag=True,
               help="Ring cache: O(--attention-window) per-slot HBM, "
                    "unbounded sequence length (needs a window).")
+@click.option("--paged", is_flag=True,
+              help="Paged KV cache (workloads/paged.py): block pool + "
+                   "per-slot block tables, on-demand growth, batched "
+                   "prefill — HBM scales with LIVE tokens, not "
+                   "slots x max-len.  Mutually exclusive with --ring.")
+@click.option("--block-size", default=16, show_default=True,
+              help="Paged cache block size (tokens per pool block).")
+@click.option("--num-blocks", default=None, type=int,
+              help="Paged pool size in blocks (default: worst case "
+                   "slots * max-len / block-size; smaller pools "
+                   "oversubscribe HBM and preempt under pressure).")
 @click.option("--tp", "tp_degree", default=None, type=int,
               help="Serve under a (data, model) mesh: slots shard over "
                    "data, KV heads + cache over 'model' (the trainer's "
@@ -68,9 +79,10 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
-         max_len, chunk, ring, tp_degree, seed, annotations_file, vocab,
-         seq_len, d_model, n_layers, n_kv_heads, attention_window,
-         no_rope, moe_experts, moe_top_k, platform):
+         max_len, chunk, ring, paged, block_size, num_blocks, tp_degree,
+         seed, annotations_file, vocab, seq_len, d_model, n_layers,
+         n_kv_heads, attention_window, no_rope, moe_experts, moe_top_k,
+         platform):
     """Serve mixed-length requests from the latest checkpoint."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -95,6 +107,25 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
                        attention_window, no_rope, moe_experts, moe_top_k)
     if (requests_file is None) == (random_n is None):
         raise click.UsageError("pass exactly one of --requests/--random")
+    # Pure flag validation BEFORE the checkpoint restore (the expensive
+    # step): a bad combination must error instantly.
+    if paged and ring:
+        raise click.UsageError(
+            "--paged and --ring are different cache layouts; pick one")
+    if paged:
+        if block_size < 1:
+            raise click.UsageError(
+                f"--block-size must be >= 1, got {block_size}")
+        if max_len % block_size:
+            raise click.UsageError(
+                f"--max-len {max_len} must be a multiple of "
+                f"--block-size {block_size}")
+        min_blocks = -(-chunk // block_size)  # one prefill chunk
+        if num_blocks is not None and num_blocks < min_blocks:
+            raise click.UsageError(
+                f"--num-blocks {num_blocks} cannot hold even one "
+                f"prefill chunk (--chunk {chunk} needs >= {min_blocks} "
+                f"blocks of {block_size}); admission would livelock")
 
     step = latest_step(checkpoint_dir)
     if step is None:
@@ -175,9 +206,23 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
                 f"batch shards over them")
         mesh = make_mesh(tp=tp_degree)
         log.info("serving under mesh %s", dict(mesh.shape))
-    engine = ContinuousBatcher(params, cfg, slots=slots, max_len=max_len,
-                               chunk=chunk, ring=ring, mesh=mesh,
-                               key=jax.random.PRNGKey(seed))
+    if paged:
+        from tpu_autoscaler.workloads.paged import PagedBatcher
+
+        if mesh is not None and len(jax.devices()) // tp_degree > 1:
+            raise click.UsageError(
+                "--paged serves TP-only meshes (all slots share ONE "
+                "block pool, which data sharding cannot cut); for data "
+                "parallelism run one server per replica, or use "
+                "devices == --tp")
+        engine = PagedBatcher(params, cfg, slots=slots, max_len=max_len,
+                              block_size=block_size,
+                              num_blocks=num_blocks, chunk=chunk,
+                              mesh=mesh, key=jax.random.PRNGKey(seed))
+    else:
+        engine = ContinuousBatcher(
+            params, cfg, slots=slots, max_len=max_len, chunk=chunk,
+            ring=ring, mesh=mesh, key=jax.random.PRNGKey(seed))
     import time
 
     watcher = DrainWatcher(annotations_file or DEFAULT_ANNOTATIONS_PATH)
