@@ -13,6 +13,7 @@ quantity grammar: decimal SI suffixes (k, M, G, T, P, E), binary suffixes
 
 from __future__ import annotations
 
+import functools
 from typing import Iterator, Mapping
 
 _BINARY_SUFFIXES = {
@@ -37,9 +38,21 @@ _DECIMAL_SUFFIXES = {
 
 
 def parse_quantity(value: str | int | float) -> float:
-    """Parse one Kubernetes quantity ('100m', '2', '128Mi', '1e3') to float."""
+    """Parse one Kubernetes quantity ('100m', '2', '128Mi', '1e3') to float.
+
+    Memoized for strings: every reconcile pass re-wraps hundreds of
+    pod/node payloads whose quantities are drawn from a tiny set of
+    distinct strings ('2', '8', '110', '128Mi', ...), and this parser
+    dominated the controller-overhead profile before the cache.  The
+    function is pure, so the cache is semantics-free.
+    """
     if isinstance(value, (int, float)):
         return float(value)
+    return _parse_quantity_str(value)
+
+
+@functools.lru_cache(maxsize=4096)
+def _parse_quantity_str(value: str) -> float:
     s = value.strip()
     if not s:
         raise ValueError("empty quantity")
