@@ -1,0 +1,265 @@
+"""Speculative decoding inside the paged serving engine
+(workloads/spec_serving.py).
+
+Oracle: the plain PagedBatcher (itself pinned bit-exact against
+single-sequence generate) — greedy speculative serving must emit the
+IDENTICAL token streams, only in fewer target passes.  The per-slot
+accept math mirrors decode.speculative_sample_generate, whose marginal
+exactness is pinned in test_decode.py."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_autoscaler.workloads.model import (  # noqa: E402
+    ModelConfig,
+    init_params,
+)
+from tpu_autoscaler.workloads.paged import PagedBatcher  # noqa: E402
+from tpu_autoscaler.workloads.spec_serving import (  # noqa: E402
+    Request,
+    SpeculativePagedBatcher,
+)
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=4, n_heads=4,
+                  d_ff=64, seq_len=64, dtype=jnp.float32)
+DCFG = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4,
+                   d_ff=64, seq_len=64, dtype=jnp.float32)
+
+
+def make_models(seed=0):
+    params = init_params(jax.random.PRNGKey(seed), CFG)
+    # Cheap draft: the target's first layer only (decode.py's
+    # TestSpeculativeDecoding recipe) — agrees often, not always.
+    dparams = {**params, "blocks": jax.tree.map(
+        lambda x: x[:1], params["blocks"])}
+    return params, dparams
+
+
+def plain_rollouts(params, prompts, new_tokens, **eng_kw):
+    eng = PagedBatcher(params, CFG, **eng_kw)
+    reqs = [Request(prompt=p, max_new_tokens=nt)
+            for p, nt in zip(prompts, new_tokens)]
+    for r in reqs:
+        eng.submit(r)
+    eng.run()
+    return [list(r.generated) for r in reqs]
+
+
+class TestGreedyParity:
+    def test_matches_plain_paged_engine(self):
+        """Mixed lengths through 3 slots: token-for-token identical to
+        the non-speculative engine, in strictly fewer target passes."""
+        params, dparams = make_models()
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, CFG.vocab, (n,)).astype(np.int32)
+                   for n in (5, 17, 9, 26)]
+        new_tokens = [8, 6, 10, 5]
+        kw = dict(slots=3, max_len=64, block_size=8, chunk=8)
+        want = plain_rollouts(params, prompts, new_tokens, **kw)
+        eng = SpeculativePagedBatcher(params, CFG, dparams, DCFG, k=3,
+                                      **kw)
+        reqs = [Request(prompt=p, max_new_tokens=nt)
+                for p, nt in zip(prompts, new_tokens)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        for r, w in zip(reqs, want):
+            assert r.done
+            assert list(r.generated) == w
+        # Each request's FIRST token is seeded by prefill, not decode.
+        decode_total = sum(new_tokens) - len(prompts)
+        assert eng.decode_tokens == decode_total
+        # The speculative economics: fewer verify passes than tokens.
+        assert eng.verify_passes < decode_total
+        assert 0.0 < eng.target_pass_ratio < 1.0
+
+    def test_self_draft_accepts_everything(self):
+        """draft == target: every proposal accepted — the efficiency
+        ceiling, and the sharpest bookkeeping check (full-accept
+        exercises the draft replay every round)."""
+        params, _ = make_models()
+        kw = dict(slots=2, max_len=64, block_size=8, chunk=8)
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, CFG.vocab, (n,)).astype(np.int32)
+                   for n in (6, 11)]
+        want = plain_rollouts(params, prompts, [9, 9], **kw)
+        eng = SpeculativePagedBatcher(params, CFG, params, CFG, k=4,
+                                      **kw)
+        reqs = [Request(prompt=p, max_new_tokens=9) for p in prompts]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        for r, w in zip(reqs, want):
+            assert list(r.generated) == w
+        assert eng.accept_rate == 1.0
+        # 9 tokens per request at k=4: ceil((9-1)/5)+1 = 3 verify
+        # rounds each, interleaved in at most 4 engine passes.
+        assert eng.target_pass_ratio <= 0.5
+
+    def test_replay_write_at_block_boundary(self):
+        """Full acceptance whose replay position starts a NEW draft
+        block (len 4 + k 4 = position 8 at block_size 8): without the
+        +1 draft reservation the write dropped silently and the draft
+        attended over garbage from then on (review finding) — with a
+        self-draft, acceptance must stay total through the boundary."""
+        params, _ = make_models()
+        p = (np.arange(4, dtype=np.int32) * 7) % CFG.vocab
+        kw = dict(slots=1, max_len=64, block_size=8, chunk=8)
+        want = plain_rollouts(params, [p], [16], **kw)[0]
+        eng = SpeculativePagedBatcher(params, CFG, params, CFG, k=4,
+                                      **kw)
+        r = Request(prompt=p, max_new_tokens=16)
+        eng.submit(r)
+        eng.run()
+        assert list(r.generated) == want
+        assert eng.accept_rate == 1.0
+
+    def test_eos_mid_accepted_block(self):
+        """An eos inside an accepted block truncates the emission and
+        frees the slot (the next queued request is served)."""
+        params, dparams = make_models()
+        kw = dict(slots=1, max_len=64, block_size=8, chunk=8)
+        rng = np.random.default_rng(2)
+        p1 = rng.integers(0, CFG.vocab, (7,)).astype(np.int32)
+        p2 = rng.integers(0, CFG.vocab, (5,)).astype(np.int32)
+        ref = plain_rollouts(params, [p1], [10], **kw)[0]
+        # Choose an eos that appears mid-stream in the reference.
+        cut = next((i for i in range(1, len(ref))
+                    if ref[i] not in ref[:i]), 0)
+        eos = int(ref[cut])
+        ref2 = plain_rollouts(params, [p2], [4], **kw)[0]
+        eng = SpeculativePagedBatcher(params, CFG, dparams, DCFG, k=3,
+                                      **kw)
+        r1 = Request(prompt=p1, max_new_tokens=10, eos_id=eos)
+        r2 = Request(prompt=p2, max_new_tokens=4)
+        eng.submit(r1)
+        eng.submit(r2)
+        eng.run()
+        assert r1.done and r1.generated[-1] == eos
+        assert len(r1.generated) == cut + 1
+        assert list(r1.generated) == ref[:cut + 1]
+        assert list(r2.generated) == ref2
+
+    def test_max_new_tokens_never_exceeded(self):
+        """The per-slot k_eff cap: a request one token from its budget
+        degenerates to plain decode instead of overshooting."""
+        params, dparams = make_models()
+        kw = dict(slots=2, max_len=64, block_size=8, chunk=8)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, CFG.vocab, (n,)).astype(np.int32)
+                   for n in (6, 9)]
+        new_tokens = [1, 2]  # tiny budgets force k_eff 0/1
+        want = plain_rollouts(params, prompts, new_tokens, **kw)
+        eng = SpeculativePagedBatcher(params, CFG, dparams, DCFG, k=4,
+                                      **kw)
+        reqs = [Request(prompt=p, max_new_tokens=nt)
+                for p, nt in zip(prompts, new_tokens)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        for r, w, nt in zip(reqs, want, new_tokens):
+            assert len(r.generated) == nt
+            assert list(r.generated) == w
+
+
+class TestAccountingAndPressure:
+    def test_accounting_holds_every_tick(self):
+        params, dparams = make_models()
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, CFG.vocab, (n,)).astype(np.int32)
+                   for n in (20, 9, 14)]
+        eng = SpeculativePagedBatcher(
+            params, CFG, dparams, DCFG, k=3, slots=2, max_len=64,
+            block_size=8, chunk=8)
+        for p in prompts:
+            eng.submit(Request(prompt=p, max_new_tokens=5))
+        for _ in range(10_000):
+            if eng.idle:
+                break
+            eng.tick()
+            eng.check_accounting()
+        assert eng.idle
+        assert eng.allocator.used_blocks == 0
+        assert eng.d_allocator.used_blocks == 0
+
+    def test_pool_pressure_preempts_and_stays_exact(self):
+        """A pool half the worst case: preemption churns BOTH caches
+        and the greedy output still matches the plain engine."""
+        params, dparams = make_models()
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, CFG.vocab, (n,)).astype(np.int32)
+                   for n in (30, 30, 30)]
+        kw = dict(slots=3, max_len=64, block_size=8, chunk=8)
+        want = plain_rollouts(params, prompts, [6, 6, 6], **kw)
+        eng = SpeculativePagedBatcher(
+            params, CFG, dparams, DCFG, k=3, slots=3, max_len=64,
+            block_size=8, num_blocks=14, chunk=8)
+        reqs = [Request(prompt=p, max_new_tokens=6) for p in prompts]
+        for r in reqs:
+            eng.submit(r)
+        for _ in range(10_000):
+            if eng.idle:
+                break
+            eng.tick()
+            eng.check_accounting()
+        assert eng.idle and eng.preemptions > 0
+        for r, w in zip(reqs, want):
+            assert r.done
+            assert list(r.generated) == w
+
+
+class TestSampledServing:
+    def test_sampled_self_draft_accepts_everything(self):
+        """p == q at every position: min(1, p/q) = 1 — total
+        acceptance, the internal-consistency check of the sampled
+        accept ratio through the engine."""
+        params, _ = make_models()
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, CFG.vocab, (n,)).astype(np.int32)
+                   for n in (6, 10)]
+        eng = SpeculativePagedBatcher(
+            params, CFG, params, CFG, k=3, slots=2, max_len=64,
+            block_size=8, chunk=8)
+        for p in prompts:
+            eng.submit(Request(prompt=p, max_new_tokens=8,
+                               temperature=1.0))
+        eng.run()
+        assert eng.accept_rate > 0.99
+
+    def test_mixed_greedy_and_sampled_slots(self):
+        """Greedy and sampled requests batch together: the greedy row
+        stays exactly the plain engine's stream while its neighbor
+        samples."""
+        params, dparams = make_models()
+        rng = np.random.default_rng(7)
+        gp = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+        sp = rng.integers(0, CFG.vocab, (6,)).astype(np.int32)
+        kw = dict(slots=2, max_len=64, block_size=8, chunk=8)
+        want = plain_rollouts(params, [gp], [7], **kw)[0]
+        eng = SpeculativePagedBatcher(params, CFG, dparams, DCFG, k=3,
+                                      **kw)
+        greedy = Request(prompt=gp, max_new_tokens=7)
+        sampled = Request(prompt=sp, max_new_tokens=7, temperature=0.9)
+        eng.submit(greedy)
+        eng.submit(sampled)
+        eng.run()
+        assert list(greedy.generated) == want
+        assert len(sampled.generated) == 7
+
+    def test_validation(self):
+        params, dparams = make_models()
+        with pytest.raises(ValueError, match="k must be"):
+            SpeculativePagedBatcher(params, CFG, dparams, DCFG, k=0)
+        with pytest.raises(ValueError, match="must be < chunk"):
+            SpeculativePagedBatcher(params, CFG, dparams, DCFG, k=8,
+                                    chunk=8)
+        import dataclasses as dc
+
+        bad = dc.replace(DCFG, vocab=32)
+        with pytest.raises(ValueError, match="vocab"):
+            SpeculativePagedBatcher(params, CFG, dparams, bad)

@@ -304,6 +304,38 @@ class TestServeCli:
         lin = [json.loads(x) for x in linear.stdout.strip().splitlines()]
         assert [r["tokens"] for r in lines] == [r["tokens"] for r in lin]
 
+    @pytest.mark.slow
+    def test_serves_speculative_paged(self, tmp_path):
+        """--spec-k: draft-assisted paged serving matches the plain
+        paged engine's greedy output and reports the economics."""
+        import json
+
+        trained = run_train(tmp_path, "--steps", "4", "--n-layers", "2",
+                            "--checkpoint-every", "4")
+        assert trained.returncode == 0, trained.stderr
+        reqs = tmp_path / "reqs.jsonl"
+        reqs.write_text(
+            '{"prompt": [3, 17, 4], "max_new_tokens": 5}\n'
+            '{"prompt": [9, 2], "max_new_tokens": 4}\n')
+        common = ["--requests", str(reqs), "--paged", "--block-size",
+                  "8", "--slots", "2", "--chunk", "8", "--max-len",
+                  "32", "--n-layers", "2"]
+        spec = self.run_serve(tmp_path, *common, "--spec-k", "2",
+                              "--draft-layers", "1")
+        assert spec.returncode == 0, spec.stderr
+        assert "target_pass_ratio" in spec.stderr
+        plain = self.run_serve(tmp_path, *common)
+        assert plain.returncode == 0, plain.stderr
+        s = [json.loads(x) for x in spec.stdout.strip().splitlines()]
+        p = [json.loads(x) for x in plain.stdout.strip().splitlines()]
+        assert [r["tokens"] for r in s] == [r["tokens"] for r in p]
+
+    def test_spec_k_needs_paged(self, tmp_path):
+        result = self.run_serve(tmp_path, "--random", "1", "--spec-k",
+                                "2")
+        assert result.returncode != 0
+        assert "add --paged" in result.stderr
+
     def test_paged_flag_validation_is_instant(self, tmp_path):
         """Pure flag conflicts error BEFORE the checkpoint restore (no
         training needed to reach them)."""

@@ -287,7 +287,8 @@ def make_paged_decode_step(cfg: ModelConfig, tokens_per_row: int,
 
 
 def make_paged_prefill(cfg: ModelConfig, chunk: int, lanes: int,
-                       tokens_per_row: int, mesh=None):
+                       tokens_per_row: int, mesh=None,
+                       return_all_logits: bool = False):
     """Build ``fill(params, cache, tables, tokens, offsets, n_valid) ->
     (logits, cache)``: append one chunk to EACH of ``lanes`` prompts in
     one compiled program.
@@ -303,6 +304,11 @@ def make_paged_prefill(cfg: ModelConfig, chunk: int, lanes: int,
     the batched-admission fix (VERDICT r4 item 3): a burst of short
     prompts admits together instead of serializing, and a long prompt
     no longer blocks the queue behind its full length.
+
+    ``return_all_logits=True``: return [lanes, chunk, vocab] instead —
+    every appended position's logits, the VERIFICATION primitive for
+    in-engine speculative decoding (spec_serving.py): one call scores
+    each slot's [pending, d1..dk] block against the target.
     """
     if mesh is not None:
         cfg = cfg.resolved_for_mesh(mesh)
@@ -360,11 +366,13 @@ def make_paged_prefill(cfg: ModelConfig, chunk: int, lanes: int,
         x = _rmsnorm(x, params["ln_f"])
         logits = jnp.einsum("bsd,dv->bsv", x,
                             params["unembed"].astype(cfg.dtype))
+        new_cache = PagedKVCache(k=k_new, v=v_new, lengths=cache.lengths)
+        if return_all_logits:
+            return logits.astype(jnp.float32), new_cache
         last = jnp.take_along_axis(
             logits, jnp.maximum(n_valid - 1, 0)[:, None, None], axis=1
         )[:, 0]                                            # [lanes, vocab]
-        return last.astype(jnp.float32), PagedKVCache(
-            k=k_new, v=v_new, lengths=cache.lengths)
+        return last.astype(jnp.float32), new_cache
 
     if mesh is None:
         return jax.jit(fill)
@@ -522,6 +530,12 @@ class PagedBatcher(ContinuousBatcher):
         if not candidates:
             return False
         _, i = min(candidates)
+        self._preempt_slot(i)
+        return True
+
+    def _preempt_slot(self, i: int) -> None:
+        """Evict slot i's sequence back to the queue head: its request
+        restarts from a fresh prefill; every block frees immediately."""
         slot = self._slots[i]
         req = slot.request
         # Reset request progress: it will re-prefill from scratch.
@@ -534,7 +548,6 @@ class PagedBatcher(ContinuousBatcher):
         self._has_pending[i] = False
         self._release_slot(i)
         self.preemptions += 1
-        return True
 
     # ---- engine loop ---------------------------------------------------
 
@@ -564,10 +577,27 @@ class PagedBatcher(ContinuousBatcher):
     def tick(self) -> None:
         """One engine step: admit, one BATCHED prefill over up to
         ``prefill_lanes`` slots still holding prompt, then one batched
-        decode step for every slot with a pending token."""
+        decode step for every slot with a pending token.  The two
+        device phases are overridable hooks (spec_serving.py replaces
+        the decode phase with draft-propose/target-verify rounds and
+        mirrors the prefill into the draft cache)."""
         self._admit()
         self.ticks += 1
+        served = self._prefill_phase()
+        self._after_prefill(served)
+        if not self._has_pending.any():
+            return
+        self._decode_phase()
 
+    def _after_prefill(self, served: list) -> None:
+        """Hook: called with the prefill phase's served chunks
+        ``[(slot, tokens, take, offset_before)]`` (possibly empty).
+        Subclasses that mirror the prefill elsewhere (the draft cache)
+        must do so BEFORE calling _prefill_finish, which may release
+        completed slots."""
+        self._prefill_finish(served)
+
+    def _prefill_phase(self) -> list:
         # ---- batched prefill over up to `lanes` slots ----
         lanes: list[int] = []
         for i, slot in enumerate(self._slots):
@@ -593,6 +623,7 @@ class PagedBatcher(ContinuousBatcher):
         lanes = [i for i in lanes
                  if self._slots[i].request is not None
                  and self._slots[i].remaining_prompt is not None]
+        served: list = []
         if lanes:
             tok = np.zeros((self.prefill_lanes, self.chunk), np.int32)
             offs = np.zeros((self.prefill_lanes,), np.int32)
@@ -609,6 +640,8 @@ class PagedBatcher(ContinuousBatcher):
                 nval[lane] = take
                 tabs[lane] = self.tables[i]
                 takes[i] = take
+                served.append((i, tok[lane].copy(), take,
+                               int(lengths_now[i])))
             logits, self.cache = self._prefill(
                 self.params, self.cache, jnp.asarray(tabs),
                 jnp.asarray(tok), jnp.asarray(offs), jnp.asarray(nval))
@@ -629,12 +662,15 @@ class PagedBatcher(ContinuousBatcher):
                     self._has_pending[i] = True
             self.cache = PagedKVCache(
                 k=self.cache.k, v=self.cache.v, lengths=new_lengths)
-            for i in list(lanes):
-                self._finish_if_done(i)
+        return served
 
-        if not self._has_pending.any():
-            return
+    def _prefill_finish(self, served: list) -> None:
+        """Completion checks for just-seeded prompt lanes (separated so
+        subclasses mirror the prefill BEFORE slots can be released)."""
+        for i, _, _, _ in served:
+            self._finish_if_done(i)
 
+    def _decode_phase(self) -> None:
         # ---- grow-then-decode ----
         lengths_now = np.asarray(self.cache.lengths)
         for i, slot in enumerate(self._slots):

@@ -63,6 +63,14 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Paged pool size in blocks (default: worst case "
                    "slots * max-len / block-size; smaller pools "
                    "oversubscribe HBM and preempt under pressure).")
+@click.option("--spec-k", default=0, show_default=True,
+              help="Speculative decoding inside the paged engine "
+                   "(needs --paged): a draft proposes K tokens per "
+                   "round, the target verifies them in one pass per "
+                   "round.  0 = off.")
+@click.option("--draft-layers", default=1, show_default=True,
+              help="Draft model = the target's first N layers "
+                   "(with --spec-k).")
 @click.option("--tp", "tp_degree", default=None, type=int,
               help="Serve under a (data, model) mesh: slots shard over "
                    "data, KV heads + cache over 'model' (the trainer's "
@@ -79,10 +87,10 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
-         max_len, chunk, ring, paged, block_size, num_blocks, tp_degree,
-         seed, annotations_file, vocab, seq_len, d_model, n_layers,
-         n_kv_heads, attention_window, no_rope, moe_experts, moe_top_k,
-         platform):
+         max_len, chunk, ring, paged, block_size, num_blocks, spec_k,
+         draft_layers, tp_degree, seed, annotations_file, vocab,
+         seq_len, d_model, n_layers, n_kv_heads, attention_window,
+         no_rope, moe_experts, moe_top_k, platform):
     """Serve mixed-length requests from the latest checkpoint."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -112,6 +120,21 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
     if paged and ring:
         raise click.UsageError(
             "--paged and --ring are different cache layouts; pick one")
+    if spec_k:
+        if not paged:
+            raise click.UsageError(
+                "--spec-k runs inside the paged engine: add --paged")
+        if not 1 <= draft_layers < n_layers:
+            raise click.UsageError(
+                f"--draft-layers must be in [1, {n_layers - 1}] "
+                f"(a {n_layers}-layer target), got {draft_layers}")
+        if spec_k >= chunk:
+            raise click.UsageError(
+                f"--spec-k {spec_k} must be < --chunk {chunk}")
+        if moe_experts is not None:
+            raise click.UsageError(
+                "--spec-k with MoE targets is not wired (the layer-"
+                "prefix draft would need its own router scaling)")
     if paged:
         if block_size < 1:
             raise click.UsageError(
@@ -215,10 +238,26 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
                 "block pool, which data sharding cannot cut); for data "
                 "parallelism run one server per replica, or use "
                 "devices == --tp")
-        engine = PagedBatcher(params, cfg, slots=slots, max_len=max_len,
-                              block_size=block_size,
-                              num_blocks=num_blocks, chunk=chunk,
-                              mesh=mesh, key=jax.random.PRNGKey(seed))
+        if spec_k:
+            import dataclasses as _dc
+
+            from tpu_autoscaler.workloads.spec_serving import (
+                SpeculativePagedBatcher,
+            )
+
+            dparams = {**params, "blocks": jax.tree.map(
+                lambda x: x[:draft_layers], params["blocks"])}
+            dcfg = _dc.replace(cfg, n_layers=draft_layers)
+            engine = SpeculativePagedBatcher(
+                params, cfg, dparams, dcfg, k=spec_k, slots=slots,
+                max_len=max_len, block_size=block_size,
+                num_blocks=num_blocks, chunk=chunk, mesh=mesh,
+                key=jax.random.PRNGKey(seed), seed=seed)
+        else:
+            engine = PagedBatcher(
+                params, cfg, slots=slots, max_len=max_len,
+                block_size=block_size, num_blocks=num_blocks,
+                chunk=chunk, mesh=mesh, key=jax.random.PRNGKey(seed))
     else:
         engine = ContinuousBatcher(
             params, cfg, slots=slots, max_len=max_len, chunk=chunk,
@@ -242,6 +281,10 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
     log.info("%d requests, %d tokens in %.2fs (%.0f tok/s, %d ticks)",
              len(reqs), decoded, dt, decoded / max(dt, 1e-9),
              engine.ticks)
+    if spec_k:
+        log.info("speculative: accept_rate %.3f, target_pass_ratio "
+                 "%.3f (plain decode = 1.0)", engine.accept_rate,
+                 engine.target_pass_ratio)
     if engine.draining:
         unserved = sum(1 for r in reqs if not r.done)
         log.info("drain requested: in-flight sequences completed, %d "
