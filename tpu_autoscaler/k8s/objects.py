@@ -314,7 +314,14 @@ class Node:
         return request.fits_in(self.allocatable)
 
     def matches_selectors(self, selectors: Mapping[str, str]) -> bool:
-        return all(self.labels.get(k) == v for k, v in selectors.items())
+        # Plain loop, not all(genexpr): this runs O(pods x nodes) per
+        # scheduler/planner pass and the generator frame was visible in
+        # the controller-overhead profile.
+        labels = self.labels
+        for k, v in selectors.items():
+            if labels.get(k) != v:
+                return False
+        return True
 
     def admits(self, pod: Pod) -> bool:
         """Selector match + every NoSchedule/NoExecute taint tolerated.
@@ -326,9 +333,11 @@ class Node:
         """
         if not self.matches_selectors(pod.node_selectors):
             return False
-        return all(
-            pod.tolerates(t) for t in self.taints
-            if t.get("effect") in ("NoSchedule", "NoExecute"))
+        for t in self.taints:
+            if t.get("effect") in ("NoSchedule", "NoExecute") \
+                    and not pod.tolerates(t):
+                return False
+        return True
 
     # -- verbs --------------------------------------------------------------
 
