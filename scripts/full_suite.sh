@@ -11,6 +11,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Static gate first: the invariant linter is sub-second and catches
+# architectural regressions (planner purity, thread discipline,
+# exception hygiene, jax purity) before any test burns wall-clock.
+./scripts/lint.sh
+
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py
   --ignore=tests/test_sp.py --ignore=tests/test_pipeline.py

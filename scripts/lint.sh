@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Fast static gate: the invariant linter (docs/ANALYSIS.md) plus mypy
+# on the strict islands (mypy.ini) when mypy is installed.  Sub-second
+# without mypy — run it before every commit; full_suite.sh runs it too.
+#
+#   ./scripts/lint.sh              # analyzer + mypy-if-present
+#   ./scripts/lint.sh --no-mypy    # analyzer only
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== invariant linter (python -m tpu_autoscaler.analysis)"
+python -m tpu_autoscaler.analysis tpu_autoscaler/
+
+if [[ "${1:-}" != "--no-mypy" ]]; then
+  if python -c "import mypy" >/dev/null 2>&1; then
+    echo "== mypy (strict islands: engine/, k8s/objects.py)"
+    python -m mypy --config-file mypy.ini \
+      tpu_autoscaler/engine tpu_autoscaler/k8s/objects.py
+  else
+    echo "== mypy not installed; skipping (config: mypy.ini)"
+  fi
+fi
+
+echo "LINT GREEN"

@@ -18,6 +18,10 @@ class FakeRest:
     def __init__(self, get_responses=None):
         self.calls = []
         self._get_responses = dict(get_responses or {})
+        self.counters = {}
+
+    def inc(self, name):
+        self.counters[name] = self.counters.get(name, 0) + 1
 
     def post(self, url, body):
         self.calls.append(("POST", url, body))

@@ -1,0 +1,778 @@
+"""Invariant-linter self-tests (tpu_autoscaler/analysis/).
+
+Each checker gets fixture pairs: a snippet that violates the invariant
+(fails: findings emitted) and the fixed pattern (passes: none).  Plus
+core plumbing — waivers, baseline codec, runner, CLI exit codes — and
+the repo gate itself: the tree this test runs in must be analysis-clean
+under the shipped baseline.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from tpu_autoscaler.analysis import (
+    ExceptionHygieneChecker,
+    JaxPurityChecker,
+    PurityChecker,
+    ThreadDisciplineChecker,
+    default_checkers,
+    parse_baseline,
+    render_baseline,
+    run_analysis,
+)
+from tpu_autoscaler.analysis.core import Finding, SourceFile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check(checker, code, rel="mod.py"):
+    src = SourceFile("<fixture>", rel, textwrap.dedent(code))
+    assert checker.applies_to(rel)
+    return src.tree and checker.check(src)
+
+
+def codes_of(findings):
+    return sorted({f.code for f in findings})
+
+
+# --------------------------------------------------------------------- #
+# purity (TAP1xx)
+# --------------------------------------------------------------------- #
+
+class TestPurityChecker:
+    def checker(self):
+        return PurityChecker(scope=("mod.py",))
+
+    def test_forbidden_import_and_call(self):
+        bad = """
+            import time
+            import random
+
+            def decide(x):
+                time.sleep(1)
+                return x + random.random()
+        """
+        found = check(self.checker(), bad)
+        assert "TAP102" in codes_of(found)
+        assert "TAP101" in codes_of(found)
+
+    def test_env_access_flagged(self):
+        bad = """
+            import os
+
+            def decide():
+                return os.environ["MODE"], os.getenv("X")
+        """
+        found = check(self.checker(), bad)
+        assert "TAP103" in codes_of(found)
+
+    def test_env_access_reported_once_per_line(self):
+        bad = """
+            import os
+
+            def decide():
+                return os.environ["MODE"]
+
+            def mode():
+                return os.environ.get("MODE")
+        """
+        found = check(self.checker(), bad)
+        tap103 = [f for f in found if f.code == "TAP103"]
+        # One finding per access, not one per matching AST node (the
+        # Call/Subscript and its inner os.environ Attribute both match).
+        assert len(tap103) == 2
+        assert len({f.line for f in tap103}) == 2
+
+    def test_global_mutation_flagged_then_fixed(self):
+        bad = """
+            _CACHE = {}
+
+            def capacity(shape):
+                if shape not in _CACHE:
+                    _CACHE[shape] = shape * 2
+                return _CACHE[shape]
+        """
+        assert codes_of(check(self.checker(), bad)) == ["TAP104"]
+        fixed = """
+            import functools
+
+            @functools.lru_cache(maxsize=None)
+            def capacity(shape):
+                return shape * 2
+        """
+        assert check(self.checker(), fixed) == []
+
+    def test_global_statement_and_mutating_method(self):
+        bad = """
+            _SEEN = set()
+            _N = 0
+
+            def note(x):
+                global _N
+                _N += 1
+                _SEEN.add(x)
+        """
+        found = check(self.checker(), bad)
+        assert codes_of(found) == ["TAP104"]
+        assert len(found) >= 2  # the global stmt and the .add()
+
+    def test_builtin_io_flagged(self):
+        bad = """
+            def decide(path):
+                print("deciding")
+                return open(path).read()
+        """
+        assert codes_of(check(self.checker(), bad)) == ["TAP105"]
+
+    def test_pure_module_is_clean(self):
+        good = """
+            import dataclasses
+            import logging
+
+            log = logging.getLogger(__name__)
+
+            def plan(gangs, nodes):
+                log.warning("planning %d", len(gangs))
+                return sorted(gangs) + sorted(nodes)
+        """
+        assert check(self.checker(), good) == []
+
+    def test_scoped_to_decision_modules(self):
+        assert not self.checker().applies_to("other.py")
+        default = PurityChecker()
+        assert default.applies_to("tpu_autoscaler/engine/planner.py")
+        assert not default.applies_to(
+            "tpu_autoscaler/controller/reconciler.py")
+
+
+# --------------------------------------------------------------------- #
+# thread discipline (TAT2xx)
+# --------------------------------------------------------------------- #
+
+class TestThreadDisciplineChecker:
+    def checker(self):
+        return ThreadDisciplineChecker()
+
+    def test_unguarded_write_in_lock_class_then_fixed(self):
+        bad = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def inc(self):
+                    self._n += 1
+        """
+        assert codes_of(check(self.checker(), bad)) == ["TAT201"]
+        fixed = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+        """
+        assert check(self.checker(), fixed) == []
+
+    def test_mutating_method_call_needs_lock(self):
+        bad = """
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    self._items.update({k: v})
+        """
+        assert codes_of(check(self.checker(), bad)) == ["TAT201"]
+
+    def test_thread_owned_state_is_fine(self):
+        good = """
+            import threading
+
+            class Watcher(threading.Thread):
+                def __init__(self):
+                    super().__init__(daemon=True)
+                    self._stopped = threading.Event()
+                    self._cursor = None
+
+                def stop(self):
+                    self._stopped.set()
+
+                def run(self):
+                    while not self._stopped.is_set():
+                        self._step()
+
+                def _step(self):
+                    self._cursor = "x"
+        """
+        assert check(self.checker(), good) == []
+
+    def test_cross_thread_write_flagged(self):
+        bad = """
+            import threading
+
+            class Watcher(threading.Thread):
+                def __init__(self):
+                    super().__init__(daemon=True)
+                    self._cursor = None
+
+                def run(self):
+                    while True:
+                        self._cursor = "x"
+
+                def reset(self):
+                    self._cursor = None
+        """
+        found = check(self.checker(), bad)
+        assert codes_of(found) == ["TAT202"]
+        assert all("reset" in f.message for f in found)
+
+    def test_method_shared_between_run_and_public_is_flagged(self):
+        bad = """
+            import threading
+
+            class Watcher(threading.Thread):
+                def run(self):
+                    self._shared_step()
+
+                def kick(self):
+                    self._shared_step()
+
+                def _shared_step(self):
+                    self._state = 1
+        """
+        assert codes_of(check(self.checker(), bad)) == ["TAT202"]
+
+    def test_annotated_lock_assignment_recognized(self):
+        # ``self._lock: threading.Lock = threading.Lock()`` must make
+        # the class lock-holding exactly like the unannotated form —
+        # a type annotation must not silently disable the invariant.
+        bad = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock: threading.Lock = threading.Lock()
+                    self._n: int = 0
+
+                def inc(self):
+                    self._n += 1
+        """
+        assert codes_of(check(self.checker(), bad)) == ["TAT201"]
+
+    def test_annotated_event_is_sanctioned_channel(self):
+        good = """
+            import threading
+
+            class Watcher(threading.Thread):
+                def __init__(self):
+                    super().__init__(daemon=True)
+                    self._stopped: threading.Event = threading.Event()
+
+                def stop(self):
+                    self._stopped.set()
+
+                def run(self):
+                    self._stopped.wait()
+        """
+        assert check(self.checker(), good) == []
+
+    def test_nested_class_self_is_not_ours(self):
+        good = """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def serve(self):
+                    class Handler:
+                        def handle(self):
+                            self.done = True
+                    return Handler
+        """
+        assert check(self.checker(), good) == []
+
+    def test_plain_class_unchecked(self):
+        good = """
+            class Plain:
+                def set(self, v):
+                    self.v = v
+        """
+        assert check(self.checker(), good) == []
+
+
+# --------------------------------------------------------------------- #
+# exception hygiene (TAE3xx)
+# --------------------------------------------------------------------- #
+
+class TestExceptionHygieneChecker:
+    def checker(self):
+        return ExceptionHygieneChecker(scope=("ctl/",))
+
+    def test_swallowing_handler_flagged_then_each_fix_passes(self):
+        bad = """
+            def act(client, log):
+                try:
+                    client.call()
+                except Exception:
+                    log.debug("oops")
+        """
+        assert codes_of(check(self.checker(), bad, "ctl/x.py")) == [
+            "TAE301"]
+
+        reraise = """
+            def act(client, log):
+                try:
+                    client.call()
+                except Exception:
+                    log.debug("oops")
+                    raise
+        """
+        assert check(self.checker(), reraise, "ctl/x.py") == []
+
+        metric = bad.replace('log.debug("oops")',
+                             'metrics.inc("act_errors")')
+        assert check(self.checker(), metric, "ctl/x.py") == []
+
+        waived = bad.replace(
+            "except Exception:",
+            "except Exception:  # crash-only: advisory, retried next pass")
+        assert check(self.checker(), waived, "ctl/x.py") == []
+
+    def test_waiver_between_except_and_first_statement(self):
+        ok = """
+            def act(client):
+                try:
+                    client.call()
+                except Exception:
+                    # crash-only: poll retries next pass
+                    pass
+        """
+        assert check(self.checker(), ok, "ctl/x.py") == []
+
+    def test_bare_except_never_waivable(self):
+        bad = """
+            def act(client):
+                try:
+                    client.call()
+                except:  # crash-only: nope
+                    pass
+        """
+        assert codes_of(check(self.checker(), bad, "ctl/x.py")) == [
+            "TAE302"]
+
+    def test_narrow_handlers_unflagged(self):
+        good = """
+            def act(client):
+                try:
+                    client.call()
+                except (KeyError, ValueError):
+                    pass
+        """
+        assert check(self.checker(), good, "ctl/x.py") == []
+
+    def test_out_of_scope_file_skipped(self):
+        assert not self.checker().applies_to("workloads/x.py")
+        default = ExceptionHygieneChecker()
+        assert default.applies_to(
+            "tpu_autoscaler/controller/reconciler.py")
+        assert default.applies_to("tpu_autoscaler/actuators/gke.py")
+        assert not default.applies_to("tpu_autoscaler/engine/planner.py")
+
+
+# --------------------------------------------------------------------- #
+# jax purity (TAJ4xx)
+# --------------------------------------------------------------------- #
+
+class TestJaxPurityChecker:
+    def checker(self):
+        return JaxPurityChecker(scope=("wl/",))
+
+    def test_item_in_jitted_function_then_fixed(self):
+        bad = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                return jnp.sum(x).item()
+        """
+        assert codes_of(check(self.checker(), bad, "wl/m.py")) == [
+            "TAJ401"]
+        fixed = bad.replace(".item()", "")
+        assert check(self.checker(), fixed, "wl/m.py") == []
+
+    def test_reachable_helper_checked(self):
+        bad = """
+            import jax
+            import numpy as np
+
+            def _helper(x):
+                return np.asarray(x)
+
+            @jax.jit
+            def step(x):
+                return _helper(x) + 1
+        """
+        found = check(self.checker(), bad, "wl/m.py")
+        assert codes_of(found) == ["TAJ401"]
+        assert "np.asarray" in found[0].message
+
+    def test_unreachable_host_code_unflagged(self):
+        good = """
+            import jax
+            import numpy as np
+
+            def host_summary(x):
+                return float(np.asarray(x).mean())
+
+            @jax.jit
+            def step(x):
+                return x * 2
+        """
+        assert check(self.checker(), good, "wl/m.py") == []
+
+    def test_side_effects_flagged(self):
+        bad = """
+            import jax
+            import logging
+
+            log = logging.getLogger(__name__)
+
+            @jax.jit
+            def step(x):
+                print("step", x)
+                log.info("stepping")
+                return x
+        """
+        found = check(self.checker(), bad, "wl/m.py")
+        assert codes_of(found) == ["TAJ402"]
+        assert len(found) == 2
+
+    def test_partial_jit_and_call_form_are_roots(self):
+        bad = """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnums=(1,))
+            def a(x, n):
+                return x.item()
+
+            def b(x):
+                return x.tolist()
+
+            b_fast = jax.jit(b)
+        """
+        found = check(self.checker(), bad, "wl/m.py")
+        assert codes_of(found) == ["TAJ401"]
+        assert {f.message.split("'")[3] for f in found} == {"a", "b"}
+
+    def test_other_functions_closure_not_claimed_by_name(self):
+        # A jit root referencing the NAME 'helper' must not mark some
+        # other function's private closure of that name as reachable.
+        good = """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def other():
+                def helper(y):
+                    print(y)
+                return helper
+        """
+        assert check(self.checker(), good, "wl/m.py") == []
+
+    def test_jit_call_on_nested_def_is_still_a_root(self):
+        # The make_train_step pattern: a factory defines step() locally
+        # and returns jax.jit(step) — the nested body IS traced.
+        bad = """
+            import jax
+
+            def make_step():
+                def step(x):
+                    return x.item()
+                return jax.jit(step)
+        """
+        assert codes_of(check(self.checker(), bad, "wl/m.py")) == [
+            "TAJ401"]
+
+    def test_name_clash_scans_every_def_bound_to_a_rooted_name(self):
+        # A clean top-level step() must not mask the dirty nested step()
+        # that jax.jit(step) actually traces — name clashes are
+        # statically ambiguous, so every def under a rooted name is
+        # scanned (a false positive is visible and waivable; a silent
+        # miss is not).
+        bad = """
+            import jax
+
+            def step(x):
+                return x * 2
+
+            def make():
+                def step(x):
+                    return x.item()
+                return jax.jit(step)
+        """
+        assert codes_of(check(self.checker(), bad, "wl/m.py")) == [
+            "TAJ401"]
+
+    def test_jax_random_is_not_a_side_effect(self):
+        # ``from jax import random`` shadows the stdlib effect-module
+        # name with jax's trace-pure PRNG — must not be flagged.
+        good = """
+            import jax
+            from jax import random
+
+            @jax.jit
+            def step(key, x):
+                k1, k2 = random.split(key)
+                return x + random.normal(k1, x.shape)
+        """
+        assert check(self.checker(), good, "wl/m.py") == []
+
+    def test_shape_subterm_does_not_launder_host_sync(self):
+        # int(x.sum() * x.shape[0]): the .shape factor must not exempt
+        # the sibling .sum() host sync — the WHOLE expression has to be
+        # static metadata arithmetic.
+        bad = """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return int(jax.numpy.sum(x) * x.shape[0])
+        """
+        assert codes_of(check(self.checker(), bad, "wl/m.py")) == [
+            "TAJ401"]
+
+    def test_static_shape_arithmetic_exempt(self):
+        good = """
+            import jax
+
+            @jax.jit
+            def step(x):
+                n = int(x.shape[0])
+                return x.reshape(n, -1) * float(len(x.shape))
+        """
+        assert check(self.checker(), good, "wl/m.py") == []
+
+    def test_callback_escape_hatch_exempt(self):
+        good = """
+            import jax
+            import numpy as np
+
+            def host_fn(x):
+                return np.asarray(x)
+
+            @jax.jit
+            def step(x):
+                return jax.pure_callback(host_fn, x, x)
+        """
+        assert check(self.checker(), good, "wl/m.py") == []
+
+    def test_module_without_jit_skipped_entirely(self):
+        good = """
+            import numpy as np
+
+            def anything(x):
+                return np.asarray(x).item()
+        """
+        assert check(self.checker(), good, "wl/m.py") == []
+
+
+# --------------------------------------------------------------------- #
+# core: waivers, baseline codec, runner, CLI
+# --------------------------------------------------------------------- #
+
+class TestCore:
+    def test_inline_allow_waives_exact_code_on_exact_line(self):
+        src = SourceFile("<f>", "mod.py", textwrap.dedent("""
+            import time  # analysis: allow=TAP102 boot-time only
+
+            def decide():
+                return time.time()
+        """))
+        checker = PurityChecker(scope=("mod.py",))
+        live = [f for f in checker.check(src)
+                if f.code not in src.allowed_codes(f.line)]
+        assert codes_of(live) == ["TAP101"]  # the call is NOT waived
+
+    def test_baseline_roundtrip(self):
+        f = Finding("a/b.py", 3, "TAP104", "writes module-level 'X'")
+        text = render_baseline([f], {f.key: "grandfathered: pre-PR1"})
+        entries = parse_baseline(text)
+        assert entries == [{
+            "file": "a/b.py", "code": "TAP104",
+            "message": "writes module-level 'X'",
+            "reason": "grandfathered: pre-PR1"}]
+
+    def test_baseline_rejects_missing_reason(self):
+        f = Finding("a/b.py", 3, "TAP104", "writes module-level 'X'")
+        text = render_baseline([f])  # empty reason
+        with pytest.raises(ValueError, match="reason"):
+            parse_baseline(text)
+
+    def test_baseline_rejects_garbage(self):
+        with pytest.raises(ValueError, match="cannot parse"):
+            parse_baseline("[[finding]]\nfile = unquoted\n")
+
+    def test_runner_waives_via_baseline_and_reports_stale(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(textwrap.dedent("""
+            _C = {}
+
+            def f(k):
+                _C[k] = 1
+        """))
+        checker = PurityChecker(scope=("mod.py",))
+        res = run_analysis([str(mod)], [checker], root=str(tmp_path))
+        assert codes_of(res.findings) == ["TAP104"]
+        baseline = [{
+            "file": "mod.py", "code": "TAP104",
+            "message": res.findings[0].message, "reason": "legacy"}]
+        stale_entry = {"file": "mod.py", "code": "TAP104",
+                       "message": "no longer exists", "reason": "old"}
+        res2 = run_analysis([str(mod)], [checker],
+                            baseline=baseline + [stale_entry],
+                            root=str(tmp_path))
+        assert res2.findings == []
+        assert len(res2.waived) == 1
+        assert res2.stale_baseline == [stale_entry]
+
+    def test_runner_surfaces_syntax_errors(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        res = run_analysis([str(bad)], [ThreadDisciplineChecker()],
+                           root=str(tmp_path))
+        assert res.errors and "bad.py" in res.errors[0]
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        from tpu_autoscaler.analysis.__main__ import main
+
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert main([str(clean), "--no-baseline"]) == 0
+
+        # The default checkers scope on repo-shaped paths; give the
+        # fixture one.
+        dirty = tmp_path / "tpu_autoscaler" / "controller"
+        dirty.mkdir(parents=True)
+        mod = dirty / "m.py"
+        mod.write_text(textwrap.dedent("""
+            def f(c):
+                try:
+                    c()
+                except Exception:
+                    pass
+        """))
+        assert main([str(mod), "--no-baseline"]) == 1
+        out = capsys.readouterr().out
+        assert "TAE301" in out and "controller/m.py:" in out
+
+    def test_cli_write_baseline_then_gate_passes(self, tmp_path, capsys):
+        from tpu_autoscaler.analysis.__main__ import main
+
+        ctl = tmp_path / "tpu_autoscaler" / "controller"
+        ctl.mkdir(parents=True)
+        src = ctl / "loop.py"
+        src.write_text(textwrap.dedent("""
+            def f(c):
+                try:
+                    c()
+                except Exception:
+                    pass
+        """))
+        baseline = tmp_path / "baseline.toml"
+        assert main([str(src), "--baseline", str(baseline),
+                     "--write-baseline"]) == 0
+        text = baseline.read_text()
+        assert "TAE301" in text
+        # Empty reasons must block the gate until a human fills them in.
+        assert main([str(src), "--baseline", str(baseline)]) == 2
+        baseline.write_text(text.replace('reason = ""',
+                                         'reason = "legacy handler"'))
+        assert main([str(src), "--baseline", str(baseline)]) == 0
+
+    def test_cli_gate_is_cwd_independent(self, tmp_path, monkeypatch):
+        # Baseline entries key on repo-root-relative paths; the gate
+        # must pass from any working directory, not just the repo root.
+        from tpu_autoscaler.analysis.__main__ import main
+
+        monkeypatch.chdir(tmp_path)
+        assert main([os.path.join(REPO_ROOT, "tpu_autoscaler")]) == 0
+
+    def test_cli_rewrite_baseline_preserves_reasons(self, tmp_path,
+                                                    capsys):
+        # Regenerating over a baseline that still has empty reasons (its
+        # own fresh entries) must not deadlock on the strict parser, and
+        # must keep reasons a human already filled in.
+        from tpu_autoscaler.analysis.__main__ import main
+
+        ctl = tmp_path / "tpu_autoscaler" / "controller"
+        ctl.mkdir(parents=True)
+        (ctl / "a.py").write_text(
+            "def f(c):\n    try:\n        c()\n"
+            "    except Exception:\n        pass\n")
+        (ctl / "b.py").write_text(
+            "def g(c):\n    try:\n        c()\n"
+            "    except Exception:\n        pass\n")
+        baseline = tmp_path / "baseline.toml"
+        assert main([str(ctl), "--baseline", str(baseline),
+                     "--write-baseline"]) == 0
+        text = baseline.read_text()
+        # A human justifies one entry; the other stays empty.
+        baseline.write_text(text.replace(
+            'reason = ""', 'reason = "a.py is legacy"', 1))
+        # Re-running regeneration must succeed despite the remaining
+        # empty reason, and must carry the filled one forward.
+        assert main([str(ctl), "--baseline", str(baseline),
+                     "--write-baseline"]) == 0
+        regenerated = baseline.read_text()
+        assert 'reason = "a.py is legacy"' in regenerated
+        assert regenerated.count("[[finding]]") == 2
+
+    def test_cli_select_filters_codes(self, tmp_path, capsys):
+        from tpu_autoscaler.analysis.__main__ import main
+
+        ctl = tmp_path / "tpu_autoscaler" / "controller"
+        ctl.mkdir(parents=True)
+        src = ctl / "loop.py"
+        src.write_text(
+            "def f(c):\n    try:\n        c()\n"
+            "    except Exception:\n        pass\n")
+        assert main([str(src), "--no-baseline", "--select", "TAP"]) == 0
+        assert main([str(src), "--no-baseline", "--select", "TAE"]) == 1
+
+
+# --------------------------------------------------------------------- #
+# the repo gate: this tree must be analysis-clean under its baseline
+# --------------------------------------------------------------------- #
+
+class TestRepoIsClean:
+    def test_repo_passes_own_linter(self):
+        baseline_path = os.path.join(
+            REPO_ROOT, "tpu_autoscaler", "analysis", "baseline.toml")
+        with open(baseline_path, encoding="utf-8") as f:
+            baseline = parse_baseline(f.read(), baseline_path)
+        res = run_analysis(
+            [os.path.join(REPO_ROOT, "tpu_autoscaler")],
+            default_checkers(), baseline=baseline, root=REPO_ROOT)
+        assert res.errors == []
+        assert res.findings == [], "\n".join(
+            f.render() for f in res.findings)
+        assert res.stale_baseline == [], (
+            "baseline entries no longer match any finding; regenerate "
+            "with --write-baseline")

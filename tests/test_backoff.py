@@ -1,0 +1,135 @@
+"""Tests for the shared backoff arithmetic (tpu_autoscaler/backoff.py)
+and the WatchTrigger cursor contract (controller/watch.py) — both were
+previously asserted only in docstrings.
+
+Covers: full-jitter bounds stay within [0, min(cap, base*2^attempt)],
+the cap holds after arbitrarily many failures (no 2^49s sleeps),
+Retry-After wins but is itself capped, malformed Retry-After falls back
+to the computed backoff, and the 410 Gone ERROR event drops the watch
+resourceVersion cursor (next watch starts from "now") while other ERROR
+events keep it.
+"""
+
+import random
+import threading
+
+import pytest
+
+from tpu_autoscaler.backoff import backoff_seconds
+from tpu_autoscaler.controller.watch import (
+    BACKOFF_BASE_S,
+    BACKOFF_CAP_S,
+    WatchTrigger,
+    _WatchError,
+)
+
+
+class _RecordingRng(random.Random):
+    """uniform() records its bounds and returns the upper one, so tests
+    can assert on the jitter CEILING, not a sampled value."""
+
+    def __init__(self):
+        super().__init__(0)
+        self.bounds = []
+
+    def uniform(self, a, b):
+        self.bounds.append((a, b))
+        return b
+
+
+class TestBackoffSeconds:
+    BASE, CAP, RA_CAP = 0.5, 8.0, 32.0
+
+    def call(self, attempt, retry_after=None, rng=None):
+        return backoff_seconds(
+            attempt, retry_after, base_s=self.BASE, cap_s=self.CAP,
+            retry_after_cap_s=self.RA_CAP,
+            rng=rng if rng is not None else random.Random(1234))
+
+    def test_jitter_within_base_and_cap(self):
+        # Sampled values never exceed min(cap, base * 2^attempt) and
+        # never go negative — the full-jitter window of the docstring.
+        rng = random.Random(42)
+        for attempt in range(12):
+            ceiling = min(self.CAP, self.BASE * 2 ** attempt)
+            for _ in range(200):
+                s = self.call(attempt, rng=rng)
+                assert 0.0 <= s <= ceiling
+
+    def test_exponential_ceiling_doubles_per_attempt(self):
+        rng = _RecordingRng()
+        for attempt in range(5):
+            self.call(attempt, rng=rng)
+        assert [b for _a, b in rng.bounds] == [
+            self.BASE, self.BASE * 2, self.BASE * 4, self.BASE * 8,
+            self.CAP]  # 0.5,1,2,4 then capped at 8
+
+    def test_cap_respected_after_many_failures(self):
+        # attempt=60 would be base*2^60 seconds uncapped — ~18k years.
+        rng = _RecordingRng()
+        assert self.call(60, rng=rng) == self.CAP
+        assert rng.bounds == [(0, self.CAP)]
+
+    def test_retry_after_wins_and_is_capped(self):
+        assert self.call(0, retry_after="3") == 3.0
+        assert self.call(0, retry_after=2.5) == 2.5
+        # An hour-long server hint must not park the control loop.
+        assert self.call(0, retry_after="3600") == self.RA_CAP
+
+    def test_malformed_retry_after_falls_back_to_jitter(self):
+        rng = _RecordingRng()
+        s = self.call(2, retry_after="Wed, 21 Oct 2015 07:28:00 GMT",
+                      rng=rng)
+        assert s == self.BASE * 4  # computed ceiling, not the header
+        assert self.call(1, retry_after=None) <= self.BASE * 2
+
+
+class TestWatchTriggerCursor:
+    """Unit tests of the cursor contract, no threads started."""
+
+    def trigger(self):
+        return WatchTrigger(client=None, wake=threading.Event())
+
+    def ev(self, etype, rv=None, code=None, message=None):
+        obj = {}
+        if rv is not None:
+            obj["metadata"] = {"resourceVersion": rv}
+        if code is not None:
+            obj["code"] = code
+        if message is not None:
+            obj["message"] = message
+        return {"type": etype, "object": obj}
+
+    def test_410_gone_resets_cursor(self):
+        t = self.trigger()
+        t._handle_event(self.ev("ADDED", rv="100"))
+        assert t._resource_version == "100"
+        with pytest.raises(_WatchError):
+            t._handle_event(self.ev("ERROR", code=410,
+                                    message="too old resource version"))
+        assert t._resource_version is None  # next watch starts from now
+
+    def test_non_410_error_keeps_cursor(self):
+        # A transient ERROR (e.g. 500) must NOT throw away the resume
+        # point — relisting the world is the expensive path.
+        t = self.trigger()
+        t._handle_event(self.ev("MODIFIED", rv="7"))
+        with pytest.raises(_WatchError):
+            t._handle_event(self.ev("ERROR", code=500, message="boom"))
+        assert t._resource_version == "7"
+
+    def test_events_advance_cursor_monotonically_by_stream_order(self):
+        t = self.trigger()
+        for rv in ("1", "2", "3"):
+            t._handle_event(self.ev("MODIFIED", rv=rv))
+        assert t._resource_version == "3"
+
+    def test_watch_backoff_ceiling_capped_like_shared_formula(self):
+        rng = _RecordingRng()
+        t = WatchTrigger(client=None, wake=threading.Event(), rng=rng)
+        t._failure_streak = 1
+        assert t._backoff_seconds() == BACKOFF_BASE_S
+        t._failure_streak = 99
+        assert t._backoff_seconds() == BACKOFF_CAP_S
+        assert rng.bounds == [(0.0, BACKOFF_BASE_S),
+                              (0.0, BACKOFF_CAP_S)]

@@ -147,9 +147,14 @@ class GcpRest:
             cap_s=self.backoff_cap_s,
             retry_after_cap_s=self.backoff_cap_s * 4, rng=self._rng)
 
-    def _note_retry(self, why: str, url: str, attempt: int) -> None:
+    def inc(self, name: str) -> None:
+        """Increment a counter on the wired metrics sink (no-op until
+        the Controller calls the actuator's set_metrics)."""
         if self._metrics is not None:
-            self._metrics.inc("rest_retries")
+            self._metrics.inc(name)
+
+    def _note_retry(self, why: str, url: str, attempt: int) -> None:
+        self.inc("rest_retries")
         log.warning("GCP REST %s (attempt %d/%d) %s — retrying",
                     why, attempt + 1, self.max_attempts, url)
 

@@ -147,6 +147,7 @@ class GkeNodePoolActuator:
                 if op.get("name"):
                     ops.append(op["name"])
         except Exception as e:  # noqa: BLE001 — surface as FAILED status
+            self._rest.inc("actuator_api_errors")
             status.fail(e)
             log.exception("node pool create failed for %s (%s)",
                           status.id, status.reason)
@@ -175,6 +176,7 @@ class GkeNodePoolActuator:
                         f"{self._api_base}/{self._parent}"
                         f"/nodePools/{pool_name}")
                 except Exception:  # noqa: BLE001 — create op still running
+                    self._rest.inc("rollback_retries")
                     log.debug("rollback delete not yet accepted for %s",
                               pool_name, exc_info=True)
                     remaining.append(pool_name)
@@ -193,7 +195,8 @@ class GkeNodePoolActuator:
     def delete(self, unit_id: str) -> None:
         try:
             self._rest.delete(f"{self._api_base}/{self._parent}/nodePools/{unit_id}")
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — retried by the maintain loop
+            self._rest.inc("actuator_delete_errors")
             log.exception("node pool delete failed for %s", unit_id)
 
     def poll(self, now: float) -> None:
@@ -213,6 +216,7 @@ class GkeNodePoolActuator:
                     # (projects/.../operations/...).
                     op = self._rest.get(f"{self._api_base}/{op_name}")
                 except Exception:  # noqa: BLE001 — transient; retry later
+                    self._rest.inc("actuator_poll_errors")
                     log.exception("operation poll failed for %s", pid)
                     all_done = False
                     break

@@ -132,6 +132,7 @@ class QueuedResourceActuator:
                 f"{_BASE}/{self._parent}/queuedResources"
                 f"?queuedResourceId={qr_id}", body)
         except Exception as e:  # noqa: BLE001 — surface as FAILED status
+            self._rest.inc("actuator_api_errors")
             status.fail(e)
             log.exception("queued resource create failed for %s (%s)",
                           qr_id, status.reason)
@@ -165,7 +166,8 @@ class QueuedResourceActuator:
                 if owner == qr_id:
                     del self._unit_owner[uid]
             self._qr_counts.pop(qr_id, None)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — retried by the maintain loop
+            self._rest.inc("actuator_delete_errors")
             log.exception("queued resource delete failed for %s", unit_id)
 
     def poll(self, now: float) -> None:
@@ -178,6 +180,7 @@ class QueuedResourceActuator:
                 qr = self._rest.get(
                     f"{_BASE}/{self._parent}/queuedResources/{qr_id}")
             except Exception:  # noqa: BLE001 — transient; retry next pass
+                self._rest.inc("actuator_poll_errors")
                 log.exception("queued resource poll failed for %s", qr_id)
                 continue
             state_obj = qr.get("state") or {}

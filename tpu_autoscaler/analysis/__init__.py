@@ -1,0 +1,43 @@
+"""Invariant linter: the repo's architectural contracts as code.
+
+``python -m tpu_autoscaler.analysis tpu_autoscaler/`` runs four AST
+checkers (planner purity, thread discipline, crash-only exception
+hygiene, jax trace purity) and exits non-zero on any finding not
+waived inline or grandfathered in ``analysis/baseline.toml``.
+See docs/ANALYSIS.md.
+"""
+
+from tpu_autoscaler.analysis.core import (
+    AnalysisResult,
+    Checker,
+    Finding,
+    SourceFile,
+    parse_baseline,
+    render_baseline,
+    run_analysis,
+)
+from tpu_autoscaler.analysis.exceptions import ExceptionHygieneChecker
+from tpu_autoscaler.analysis.jaxpurity import JaxPurityChecker
+from tpu_autoscaler.analysis.purity import PurityChecker
+from tpu_autoscaler.analysis.threads import ThreadDisciplineChecker
+
+
+def default_checkers() -> list[Checker]:
+    return [PurityChecker(), ThreadDisciplineChecker(),
+            ExceptionHygieneChecker(), JaxPurityChecker()]
+
+
+__all__ = [
+    "AnalysisResult",
+    "Checker",
+    "ExceptionHygieneChecker",
+    "Finding",
+    "JaxPurityChecker",
+    "PurityChecker",
+    "SourceFile",
+    "ThreadDisciplineChecker",
+    "default_checkers",
+    "parse_baseline",
+    "render_baseline",
+    "run_analysis",
+]

@@ -1,0 +1,113 @@
+"""CLI: ``python -m tpu_autoscaler.analysis [paths] [options]``.
+
+Exit codes: 0 clean (or baseline-waived), 1 findings, 2 usage/parse
+errors.  ``--write-baseline`` regenerates ``analysis/baseline.toml``
+from the current findings, preserving existing reasons; new entries get
+an empty reason the parser rejects, so a human must justify each one
+before the baseline loads again.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tpu_autoscaler.analysis import (
+    default_checkers,
+    parse_baseline,
+    render_baseline,
+    run_analysis,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.toml")
+
+#: Baseline entries key on repo-root-relative paths, so findings must
+#: be relativized against the tree the package lives in — NOT the cwd,
+#: or the gate would spuriously fail when run from anywhere else.
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_autoscaler.analysis",
+        description="Invariant linter: planner purity, thread "
+                    "discipline, exception hygiene, jax purity.")
+    parser.add_argument("paths", nargs="*",
+                        default=[os.path.join(REPO_ROOT, "tpu_autoscaler")],
+                        help="files or directories (default: the "
+                             "installed tpu_autoscaler tree)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="grandfather allowlist (default: the "
+                             "packaged analysis/baseline.toml)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignoring the "
+                             "baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from current "
+                             "findings (preserves existing reasons)")
+    parser.add_argument("--select", default="",
+                        help="comma-separated code prefixes to report "
+                             "(e.g. TAP,TAE3)")
+    parser.add_argument("--list-codes", action="store_true",
+                        help="print every checker's codes and exit")
+    args = parser.parse_args(argv)
+
+    checkers = default_checkers()
+    if args.list_codes:
+        for checker in checkers:
+            for code, desc in sorted(checker.codes.items()):
+                print(f"{code}  [{checker.name}]  {desc}")
+        return 0
+
+    baseline: list[dict] = []
+    reasons: dict[tuple, str] = {}
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            with open(args.baseline, encoding="utf-8") as f:
+                # Regeneration parses leniently: it only harvests
+                # reasons, and must be able to read a baseline whose
+                # fresh entries still have the empty reason it wrote.
+                baseline = parse_baseline(
+                    f.read(), args.baseline,
+                    require_reasons=not args.write_baseline)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        reasons = {(e["file"], e["code"], e["message"]): e.get("reason", "")
+                   for e in baseline}
+
+    result = run_analysis(args.paths, checkers,
+                          baseline=None if args.write_baseline
+                          else baseline, root=REPO_ROOT)
+    for err in result.errors:
+        print(f"error: {err}", file=sys.stderr)
+
+    if args.write_baseline:
+        text = render_baseline(result.findings, reasons)
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {len(result.findings)} finding(s) to "
+              f"{args.baseline}; fill in empty 'reason' fields")
+        return 0
+
+    prefixes = tuple(p for p in args.select.split(",") if p)
+    shown = [f for f in result.findings
+             if not prefixes or f.code.startswith(prefixes)]
+    for f in shown:
+        print(f.render())
+    for entry in result.stale_baseline:
+        print(f"stale baseline entry (no longer matches anything): "
+              f"{entry['code']} {entry['file']}: {entry['message']}",
+              file=sys.stderr)
+    if shown:
+        print(f"\n{len(shown)} finding(s) "
+              f"({len(result.waived)} baseline-waived)", file=sys.stderr)
+    if result.errors:
+        return 2
+    return 1 if shown else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

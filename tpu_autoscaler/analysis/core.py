@@ -1,0 +1,255 @@
+"""Shared infrastructure for the invariant linter (docs/ANALYSIS.md).
+
+The repo's architectural invariants — planner purity, thread discipline,
+crash-only exception hygiene, jit-traced purity — live in docstrings
+(engine/planner.py, controller/watch.py, SURVEY §6.3).  This package
+makes them machine-checked: each checker walks a file's AST and emits
+``Finding`` records; the runner filters them through inline waivers and
+the grandfather baseline (``analysis/baseline.toml``) and the CLI exits
+non-zero on anything left.
+
+Design constraints:
+
+- stdlib only (ast + tokenize); the container must not need new deps;
+- Python 3.10 (no ``tomllib``), so the baseline file is read/written by
+  a deliberately tiny TOML-subset codec (``[[finding]]`` tables of
+  string scalars — exactly what the baseline needs, nothing more);
+- waivers are explicit and greppable: a finding is silenced only by an
+  ``# analysis: allow=CODE`` comment on its line, a checker-specific
+  waiver (the exception checker's ``# crash-only: <reason>``), or a
+  baseline entry carrying a ``reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Iterable, Sequence
+
+#: Inline waiver: ``# analysis: allow=TAP104`` (comma-separate several
+#: codes).  Anything after the codes is the human reason.
+_ALLOW_RE = re.compile(r"#\s*analysis:\s*allow=([A-Z0-9,]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One invariant violation.  ``key`` (file, code, message) identifies
+    the finding across line drift — the baseline matches on it, never on
+    line numbers."""
+
+    file: str
+    line: int
+    code: str
+    message: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.file, self.code, self.message)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}:{self.code} {self.message}"
+
+
+class SourceFile:
+    """A parsed module plus its comment map (line -> comment text)."""
+
+    def __init__(self, path: str, rel_path: str, text: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:  # analysis must not die on odd input
+            pass
+
+    @classmethod
+    def load(cls, path: str, root: str | None = None) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        rel = os.path.relpath(path, root or os.getcwd())
+        return cls(path, rel.replace(os.sep, "/"), text)
+
+    def allowed_codes(self, line: int) -> set[str]:
+        """Codes inline-waived on ``line`` via ``# analysis: allow=``."""
+        m = _ALLOW_RE.search(self.comments.get(line, ""))
+        return set(m.group(1).split(",")) if m else set()
+
+    def comment_in_range(self, first: int, last: int,
+                         needle: str) -> bool:
+        return any(needle in self.comments.get(n, "")
+                   for n in range(first, last + 1))
+
+
+class Checker:
+    """Interface: subclasses set ``name``/``codes`` and implement both
+    ``applies_to`` (path scoping) and ``check``."""
+
+    name: str = ""
+    codes: dict[str, str] = {}
+
+    def applies_to(self, rel_path: str) -> bool:
+        raise NotImplementedError
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        raise NotImplementedError
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def root_name(node: ast.AST) -> str | None:
+    """The leftmost Name of a Name/Attribute/Subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ("__pycache__", ".git"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+        elif p.endswith(".py"):
+            yield p
+
+
+# ---------------------------------------------------------------------- #
+# Baseline: the grandfather list.  TOML subset: ``[[finding]]`` tables
+# with ``key = "string"`` pairs; comments and blank lines.
+# ---------------------------------------------------------------------- #
+
+BASELINE_KEYS = ("file", "code", "message", "reason")
+
+
+def parse_baseline(text: str, path: str = "baseline.toml",
+                   require_reasons: bool = True) -> list[dict[str, str]]:
+    """``require_reasons=False`` is for ``--write-baseline``: it must be
+    able to HARVEST reasons from a baseline that still has empty ones
+    (its own freshly-written entries), or regeneration would deadlock on
+    the very file it produced."""
+    entries: list[dict[str, str]] = []
+    current: dict[str, str] | None = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[finding]]":
+            current = {}
+            entries.append(current)
+            continue
+        m = re.match(r'^(\w+)\s*=\s*(".*")\s*$', line)
+        if m is None or current is None:
+            raise ValueError(
+                f"{path}:{lineno}: cannot parse {line!r} (expected "
+                f"'[[finding]]' or 'key = \"value\"')")
+        key, value = m.group(1), m.group(2)
+        try:
+            current[key] = ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            raise ValueError(
+                f"{path}:{lineno}: bad string literal {value!r}") from None
+    for i, e in enumerate(entries):
+        missing = [k for k in ("file", "code", "message") if k not in e]
+        if missing:
+            raise ValueError(
+                f"{path}: finding #{i + 1} missing key(s): {missing}")
+        if require_reasons and not e.get("reason"):
+            raise ValueError(
+                f"{path}: finding #{i + 1} ({e['code']} in {e['file']}) "
+                f"has no 'reason' — every grandfathered finding must "
+                f"say why it is acceptable")
+    return entries
+
+
+def _toml_str(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def render_baseline(findings: Sequence[Finding],
+                    reasons: dict[tuple, str] | None = None) -> str:
+    """Serialize findings as a baseline file.  ``reasons`` maps finding
+    keys to justification strings (existing entries keep theirs on
+    regeneration; new ones get a TODO the parser will reject until a
+    human fills it in — regeneration must not silently bless findings)."""
+    reasons = reasons or {}
+    out = [
+        "# Grandfathered invariant-linter findings (docs/ANALYSIS.md).",
+        "# Regenerate: python -m tpu_autoscaler.analysis --write-baseline"
+        " tpu_autoscaler/",
+        "# Every entry needs a human-written 'reason'.",
+    ]
+    for f in sorted(set(findings), key=lambda f: (f.file, f.code, f.line)):
+        out += [
+            "",
+            "[[finding]]",
+            f"file = {_toml_str(f.file)}",
+            f"code = {_toml_str(f.code)}",
+            f"message = {_toml_str(f.message)}",
+            f"reason = {_toml_str(reasons.get(f.key, ''))}",
+        ]
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------- #
+# Runner
+# ---------------------------------------------------------------------- #
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list[Finding]          # live (unwaived) findings
+    waived: list[Finding]            # silenced by baseline entries
+    stale_baseline: list[dict]       # baseline entries matching nothing
+    errors: list[str]                # unparseable files etc.
+
+
+def run_analysis(paths: Sequence[str], checkers: Sequence[Checker],
+                 baseline: Sequence[dict] | None = None,
+                 root: str | None = None) -> AnalysisResult:
+    baseline = list(baseline or [])
+    by_key = {(e["file"], e["code"], e["message"]): e for e in baseline}
+    live: list[Finding] = []
+    waived: list[Finding] = []
+    matched: set[tuple] = set()
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        try:
+            src = SourceFile.load(path, root=root)
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{path}: {e}")
+            continue
+        for checker in checkers:
+            if not checker.applies_to(src.rel_path):
+                continue
+            for f in checker.check(src):
+                if f.code in src.allowed_codes(f.line):
+                    continue
+                if f.key in by_key:
+                    matched.add(f.key)
+                    waived.append(f)
+                else:
+                    live.append(f)
+    stale = [e for e in baseline
+             if (e["file"], e["code"], e["message"]) not in matched]
+    live.sort(key=lambda f: (f.file, f.line, f.code))
+    return AnalysisResult(live, waived, stale, errors)

@@ -1,0 +1,103 @@
+"""Crash-only exception-hygiene checker (TAE3xx).
+
+The control plane is crash-only (SURVEY §6.3): a broad ``except
+Exception`` is legitimate ONLY as a deliberate degradation point — the
+reconcile loop's catch-all, an advisory API write, an actuator poll that
+retries next pass.  Every such point must be observable or explicitly
+justified, or it silently swallows the exact failures (actuator errors,
+apiserver flakes) an operator needs to see.
+
+A broad handler in ``controller/``, ``actuators/``, or ``k8s/`` passes
+the check when it does at least one of:
+
+- re-raises (a ``raise`` anywhere in the handler body);
+- increments a metric (a ``*.inc(...)`` call — the ``watch_failures``
+  pattern from controller/watch.py);
+- carries an explicit waiver comment ``# crash-only: <reason>`` on the
+  ``except`` line or between it and the handler's first statement.
+
+Codes:
+
+- TAE301 — broad handler with none of the three;
+- TAE302 — bare ``except:`` (catches SystemExit/KeyboardInterrupt; name
+  ``Exception`` instead — never waivable).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_autoscaler.analysis.core import Checker, Finding, SourceFile
+
+WAIVER = "crash-only:"
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+DEFAULT_SCOPE = (
+    "tpu_autoscaler/controller/",
+    "tpu_autoscaler/actuators/",
+    "tpu_autoscaler/k8s/",
+)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    t = handler.type
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _increments_metric(handler: ast.ExceptHandler) -> bool:
+    # ``metrics.inc(...)``, ``self._rest.inc(...)`` — any .inc() call.
+    for n in ast.walk(handler):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "inc"):
+            return True
+    return False
+
+
+class ExceptionHygieneChecker(Checker):
+    name = "exception-hygiene"
+    codes = {
+        "TAE301": "broad except without re-raise, metric, or waiver",
+        "TAE302": "bare except (catches SystemExit/KeyboardInterrupt)",
+    }
+
+    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE):
+        self._scope = scope
+
+    def applies_to(self, rel_path: str) -> bool:
+        return any(s in rel_path for s in self._scope)
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(Finding(
+                    src.rel_path, node.lineno, "TAE302",
+                    "bare 'except:' also catches SystemExit/"
+                    "KeyboardInterrupt; catch Exception explicitly"))
+                continue
+            if not _is_broad(node):
+                continue
+            if _reraises(node) or _increments_metric(node):
+                continue
+            first_stmt = node.body[0].lineno if node.body else node.lineno
+            if src.comment_in_range(node.lineno, first_stmt, WAIVER):
+                continue
+            findings.append(Finding(
+                src.rel_path, node.lineno, "TAE301",
+                "broad 'except Exception' swallows errors: re-raise, "
+                "increment a metric, or add '# crash-only: <reason>'"))
+        return findings

@@ -1,0 +1,310 @@
+"""JAX trace-purity checker (TAJ4xx).
+
+Functions traced by ``jax.jit``/``pjit`` run as staged XLA programs:
+host-sync primitives (``.item()``, ``float(traced)``, ``np.asarray`` on
+a traced value) silently insert device→host transfers — under tracing
+they either fail or, worse, constant-fold a value that should be
+data-dependent — and Python side effects (print, logging, RNG, clock
+reads) execute once at trace time, not per step.  The sanctioned escape
+hatches are ``jax.debug.print``/``jax.debug.callback`` and
+``jax.pure_callback``/``io_callback``; anything else is a latent
+correctness bug that only manifests on real hardware.
+
+Reachability is static and module-local: roots are functions decorated
+with (or wrapped in) ``jax.jit``/``jit``/``pjit`` — including
+``functools.partial(jax.jit, ...)`` — plus every module function or
+same-class method a reachable function references by name (reference,
+not just call: functions handed to ``lax.scan``/``lax.cond`` etc. are
+traced too).  Names passed to the callback escape hatches are host
+functions by design and are NOT marked reachable.
+
+Codes:
+
+- TAJ401 — host synchronization inside a jit-reachable function;
+- TAJ402 — Python side effect inside a jit-reachable function.
+
+Static-shape arithmetic (``int(x.shape[0])``, ``len(xs)``,
+``math.prod(shape)``) is trace-safe and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_autoscaler.analysis.core import (
+    Checker,
+    Finding,
+    SourceFile,
+    dotted_name,
+)
+
+DEFAULT_SCOPE = ("tpu_autoscaler/workloads/",)
+
+#: attribute calls that force a device→host sync
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+#: jax APIs that pull values to host
+_SYNC_CALLS = frozenset({"device_get", "copy_to_host_async"})
+
+#: builtins that coerce a traced array on host
+_COERCIONS = frozenset({"float", "int", "bool", "complex"})
+
+#: obvious trace-time side effects
+_EFFECT_BUILTINS = frozenset({"print", "input", "open"})
+
+#: modules whose calls are trace-time side effects inside jit
+_EFFECT_MODULES = frozenset({"time", "random", "logging"})
+
+#: callback escape hatches: Names passed here are host-side by design
+_CALLBACK_SINKS = frozenset({
+    "pure_callback", "io_callback", "callback", "debug_callback",
+})
+
+_NUMPY_TOP = frozenset({"numpy"})
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Functions/methods, import aliases, and jit roots of one module."""
+
+    def __init__(self) -> None:
+        #: (class_name | None, func_name) -> every def bound to that
+        #: name, nested included.  A name clash (top-level def + a
+        #: nested def of the same name, one of them jitted) is
+        #: statically ambiguous — ALL defs under a rooted key are
+        #: scanned, erring toward a visible (waivable) finding over a
+        #: silent miss.
+        self.functions: dict[tuple[str | None, str], list[ast.AST]] = {}
+        #: module-level functions and class-body methods only — the set
+        #: by-name references may resolve to (a def nested inside some
+        #: OTHER function is a private closure; resolving a root's name
+        #: reference to it would be a mere name collision)
+        self.top_level: set[tuple[str | None, str]] = set()
+        self.np_aliases: set[str] = set()     # numpy (NOT jax.numpy)
+        #: names that are jax submodules (``from jax import random``,
+        #: ``import jax.random as random``) — trace-pure, never side
+        #: effects even when the local name shadows an effect module
+        self.jax_aliases: set[str] = set()
+        self.jit_names: set[str] = set()      # bare names bound to jit
+        self.roots: set[tuple[str | None, str]] = set()
+        self._class: str | None = None
+        self._fn_depth = 0
+
+    # -- imports -------------------------------------------------------- #
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name.split(".")[0] in _NUMPY_TOP \
+                    and alias.name != "jax.numpy":
+                self.np_aliases.add(alias.asname or alias.name)
+            if alias.name.startswith("jax.") and alias.asname:
+                self.jax_aliases.add(alias.asname)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        for alias in node.names:
+            if mod == "jax" and alias.name in ("jit", "pjit"):
+                self.jit_names.add(alias.asname or alias.name)
+            if mod == "jax" or mod.startswith("jax."):
+                self.jax_aliases.add(alias.asname or alias.name)
+            if mod.startswith("jax.experimental.pjit") \
+                    and alias.name == "pjit":
+                self.jit_names.add(alias.asname or alias.name)
+
+    # -- definitions ---------------------------------------------------- #
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _is_jit_expr(self, expr: ast.AST) -> bool:
+        """Is ``expr`` jax.jit / jit / pjit / partial(jit, ...)?"""
+        d = dotted_name(expr)
+        if d is not None:
+            last = d.split(".")[-1]
+            if last in ("jit", "pjit") or d.split(".")[0] in self.jit_names:
+                return True
+        if isinstance(expr, ast.Call):
+            # partial(jax.jit, static_argnums=...) or jax.jit(...) with
+            # only keyword/config args (decorator-factory form).
+            if self._is_jit_expr(expr.func):
+                return True
+            fd = dotted_name(expr.func)
+            if fd is not None and fd.split(".")[-1] == "partial":
+                return any(self._is_jit_expr(a) for a in expr.args[:1])
+        return False
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        key = (self._class, node.name)
+        self.functions.setdefault(key, []).append(node)
+        if self._fn_depth == 0:
+            self.top_level.add(key)
+        if any(self._is_jit_expr(d) for d in node.decorator_list):
+            self.roots.add(key)
+        self._fn_depth += 1
+        self.generic_visit(node)
+        self._fn_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+def _collect_call_roots(index: _ModuleIndex, tree: ast.Module) -> None:
+    """``jax.jit(f)`` / ``jit(f, ...)`` call forms: mark ``f``."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not index._is_jit_expr(node.func):
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Name):
+                for key in index.functions:
+                    if key[1] == arg.id:
+                        index.roots.add(key)
+
+
+def _referenced_functions(index: _ModuleIndex, fn: ast.AST,
+                          cls: str | None) -> set[tuple[str | None, str]]:
+    """Module functions / same-class methods referenced by name inside
+    ``fn`` — excluding names passed to callback escape hatches."""
+    callback_args: set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            if d.split(".")[-1] in _CALLBACK_SINKS:
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        callback_args.add(id(a))
+    refs: set[tuple[str | None, str]] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and id(node) not in callback_args:
+            if (None, node.id) in index.top_level:
+                refs.add((None, node.id))
+        elif isinstance(node, ast.Attribute):
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id == "self" and cls is not None
+                    and (cls, node.attr) in index.top_level):
+                refs.add((cls, node.attr))
+    return refs
+
+
+_META_ATTRS = frozenset({"shape", "ndim", "size", "dtype", "itemsize"})
+
+
+def _static_shape_arith(node: ast.AST) -> bool:
+    """int()/float() over static trace-time metadata is trace-safe:
+    constants, ``.shape``/``.ndim``/``.size``/``.dtype`` access,
+    ``len()``, ``math.*`` over those.  The whole expression must be
+    built from safe parts — one ``.shape`` sub-term must not launder a
+    sibling ``x.sum()`` host sync past the check."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _META_ATTRS
+    if isinstance(node, ast.Subscript):
+        return _static_shape_arith(node.value)  # .shape[0] — index is
+        # a Python int by construction or the jit trace itself fails
+    if isinstance(node, ast.BinOp):
+        return (_static_shape_arith(node.left)
+                and _static_shape_arith(node.right))
+    if isinstance(node, ast.UnaryOp):
+        return _static_shape_arith(node.operand)
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func) or ""
+        return d == "len" or d.startswith("math.")
+    if isinstance(node, ast.Tuple):
+        return all(_static_shape_arith(e) for e in node.elts)
+    return False  # bare Name included: could be a traced array
+
+
+class JaxPurityChecker(Checker):
+    name = "jax-purity"
+    codes = {
+        "TAJ401": "host synchronization inside a jit-traced function",
+        "TAJ402": "Python side effect inside a jit-traced function",
+    }
+
+    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE):
+        self._scope = scope
+
+    def applies_to(self, rel_path: str) -> bool:
+        return any(s in rel_path for s in self._scope)
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        index = _ModuleIndex()
+        index.visit(src.tree)
+        _collect_call_roots(index, src.tree)
+        if not index.roots:
+            return []
+
+        # Transitive closure over by-name references; every def bound
+        # to a reachable key is scanned (see _ModuleIndex.functions).
+        reachable: set[tuple[str | None, str]] = set()
+        frontier = list(index.roots)
+        while frontier:
+            key = frontier.pop()
+            if key in reachable:
+                continue
+            reachable.add(key)
+            for fn in index.functions[key]:
+                for ref in _referenced_functions(index, fn, key[0]):
+                    if ref not in reachable:
+                        frontier.append(ref)
+
+        findings: list[Finding] = []
+        for key in sorted(reachable, key=lambda k: (k[0] or "", k[1])):
+            for fn in index.functions[key]:
+                findings.extend(self._check_function(src, index, fn, key))
+        # A nested def sharing its encloser's name is walked both as a
+        # list member and inside the encloser's body — report once.
+        return list(dict.fromkeys(findings))
+
+    def _check_function(self, src: SourceFile, index: _ModuleIndex,
+                        fn: ast.AST, key: tuple[str | None, str]
+                        ) -> list[Finding]:
+        where = f"{key[0]}.{key[1]}" if key[0] else key[1]
+        findings: list[Finding] = []
+
+        def emit(node: ast.AST, code: str, msg: str) -> None:
+            findings.append(Finding(
+                src.rel_path, node.lineno, code,
+                f"{msg} in jit-reachable '{where}'"))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            d = dotted_name(func)
+            if isinstance(func, ast.Attribute):
+                if func.attr in _SYNC_METHODS:
+                    emit(node, "TAJ401",
+                         f"host sync '.{func.attr}()'")
+                    continue
+                if d is not None:
+                    top = d.split(".")[0]
+                    last = d.split(".")[-1]
+                    if top in index.np_aliases and last in (
+                            "asarray", "array", "save", "load"):
+                        emit(node, "TAJ401",
+                             f"'{d}()' materializes on host (use "
+                             f"jax.numpy inside traced code)")
+                        continue
+                    if (top in _EFFECT_MODULES
+                            and top not in index.jax_aliases) or (
+                            top in ("log", "logger", "logging")
+                            and last in ("debug", "info", "warning",
+                                         "error", "exception")):
+                        emit(node, "TAJ402",
+                             f"trace-time side effect '{d}()'")
+                        continue
+            elif isinstance(func, ast.Name):
+                if func.id in _EFFECT_BUILTINS:
+                    emit(node, "TAJ402",
+                         f"trace-time side effect '{func.id}()'")
+                elif func.id in _COERCIONS and node.args:
+                    arg = node.args[0]
+                    if not _static_shape_arith(arg):
+                        emit(node, "TAJ401",
+                             f"'{func.id}()' on a possibly-traced value "
+                             f"forces a host sync (hint: trace-safe "
+                             f"shape arithmetic is exempt)")
+        return findings

@@ -1,0 +1,214 @@
+"""Planner-purity checker (TAP1xx).
+
+The reconcile design is crash-only: the planner must stay a pure
+function of (gangs, nodes, pods, in-flight, policy) so desired state can
+be recomputed from scratch every pass (engine/planner.py docstring,
+SURVEY §6.3).  This checker enforces it mechanically on the decision
+modules: no I/O, no clocks, no randomness, no environment reads, no
+module-global mutation.
+
+Explicitly ALLOWED: ``logging`` (telemetry never feeds back into the
+decision) and ``functools`` memoization (``lru_cache`` over immutable
+catalog data is referentially transparent — unlike a hand-rolled dict
+cache, it mutates no inspectable module state).
+
+Codes:
+
+- TAP101 — call into a forbidden module (time/random/socket/...);
+- TAP102 — import of a forbidden module (module or function scope);
+- TAP103 — environment access (os.environ / os.getenv);
+- TAP104 — module-global mutation (``global``, assignment or mutating
+  method call on a module-level name from inside a function);
+- TAP105 — builtin I/O call (open/input/print).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_autoscaler.analysis.core import (
+    Checker,
+    Finding,
+    SourceFile,
+    dotted_name,
+    root_name,
+)
+
+#: Modules whose very import into a pure decision module is a finding.
+FORBIDDEN_MODULES = frozenset({
+    "time", "random", "secrets", "socket", "subprocess", "requests",
+    "urllib", "http", "shutil", "tempfile", "io", "pathlib", "threading",
+    "multiprocessing", "asyncio", "signal",
+})
+
+#: ``os`` is forbidden too, but env access gets its own code (TAP103).
+_ENV_CALLS = frozenset({"os.environ", "os.getenv", "os.putenv",
+                        "os.environb"})
+
+#: Wall-clock reads via datetime (datetime arithmetic itself is pure).
+_CLOCK_CALLS = frozenset({
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today", "datetime.now",
+    "datetime.utcnow", "date.today",
+})
+
+_IO_BUILTINS = frozenset({"open", "input", "print", "exec", "eval"})
+
+#: Method calls that mutate their receiver in place.
+MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "clear", "add",
+    "discard", "update", "setdefault", "popitem", "sort", "reverse",
+    "__setitem__",
+})
+
+#: Default scope: the decision modules named by the invariant.
+DEFAULT_SCOPE = (
+    "tpu_autoscaler/engine/planner.py",
+    "tpu_autoscaler/engine/fitter.py",
+    "tpu_autoscaler/k8s/scheduling.py",
+)
+
+
+class PurityChecker(Checker):
+    name = "purity"
+    codes = {
+        "TAP101": "call into a forbidden (impure) module",
+        "TAP102": "import of a forbidden module in a pure module",
+        "TAP103": "environment access in a pure module",
+        "TAP104": "module-global mutation in a pure module",
+        "TAP105": "builtin I/O call in a pure module",
+    }
+
+    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE):
+        self._scope = scope
+
+    def applies_to(self, rel_path: str) -> bool:
+        return any(rel_path.endswith(s) for s in self._scope)
+
+    # ------------------------------------------------------------------ #
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        module_names = _module_level_names(src.tree)
+
+        def emit(node: ast.AST, code: str, message: str) -> None:
+            findings.append(Finding(src.rel_path, node.lineno, code,
+                                    message))
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    top = alias.name.split(".")[0]
+                    if top in FORBIDDEN_MODULES or top == "os":
+                        emit(node, "TAP102",
+                             f"pure module imports {alias.name!r}")
+            elif isinstance(node, ast.ImportFrom):
+                top = (node.module or "").split(".")[0]
+                if top in FORBIDDEN_MODULES or top == "os":
+                    emit(node, "TAP102",
+                         f"pure module imports from {node.module!r}")
+            elif isinstance(node, ast.Call):
+                findings.extend(self._check_call(src, node))
+            elif isinstance(node, (ast.Attribute, ast.Subscript)):
+                d = dotted_name(node.value if isinstance(node, ast.Subscript)
+                                else node)
+                if d in ("os.environ", "os.environb"):
+                    emit(node, "TAP103",
+                         "pure module reads the process environment")
+            elif isinstance(node, ast.Global):
+                emit(node, "TAP104",
+                     f"'global {', '.join(node.names)}' in a pure module")
+
+        findings.extend(self._check_global_mutation(src, module_names))
+        # One env access yields matches on nested nodes (the Call AND
+        # its inner ``os.environ`` Attribute, on the same line); keep
+        # the first — walk order puts the most specific message first.
+        env_lines: set[int] = set()
+        deduped: list[Finding] = []
+        for f in findings:
+            if f.code == "TAP103":
+                if f.line in env_lines:
+                    continue
+                env_lines.add(f.line)
+            deduped.append(f)
+        return deduped
+
+    def _check_call(self, src: SourceFile,
+                    node: ast.Call) -> list[Finding]:
+        out: list[Finding] = []
+        func = node.func
+        d = dotted_name(func)
+        if d is not None:
+            top = d.split(".")[0]
+            if d in _ENV_CALLS or d.startswith("os.environ"):
+                out.append(Finding(src.rel_path, node.lineno, "TAP103",
+                                   f"environment access via {d}()"))
+            elif top in FORBIDDEN_MODULES or top == "os":
+                out.append(Finding(
+                    src.rel_path, node.lineno, "TAP101",
+                    f"pure module calls {d}()"))
+            elif d in _CLOCK_CALLS:
+                out.append(Finding(
+                    src.rel_path, node.lineno, "TAP101",
+                    f"pure module reads the wall clock via {d}()"))
+        if isinstance(func, ast.Name) and func.id in _IO_BUILTINS:
+            out.append(Finding(
+                src.rel_path, node.lineno, "TAP105",
+                f"pure module calls builtin {func.id}()"))
+        return out
+
+    def _check_global_mutation(self, src: SourceFile,
+                               module_names: set[str]) -> list[Finding]:
+        """Writes to module-level names from inside function bodies."""
+        out: list[Finding] = []
+
+        def visit_fn(fn: ast.AST) -> None:
+            for node in ast.walk(fn):
+                targets: list[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = list(node.targets)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                elif isinstance(node, ast.Delete):
+                    targets = list(node.targets)
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr in MUTATING_METHODS):
+                        root = root_name(f.value)
+                        if root in module_names:
+                            out.append(Finding(
+                                src.rel_path, node.lineno, "TAP104",
+                                f"mutates module-level {root!r} via "
+                                f".{f.attr}()"))
+                    continue
+                for t in targets:
+                    # Plain Name assignment inside a function is a LOCAL
+                    # binding (unless global-declared, caught above);
+                    # only subscript/attribute writes reach module state.
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        root = root_name(t)
+                        if root in module_names:
+                            out.append(Finding(
+                                src.rel_path, node.lineno, "TAP104",
+                                f"writes module-level {root!r} from a "
+                                f"function body"))
+
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                visit_fn(node)
+        return out
+
+
+def _module_level_names(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+    return names

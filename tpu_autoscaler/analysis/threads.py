@@ -1,0 +1,238 @@
+"""Thread-discipline checker (TAT2xx).
+
+The codebase's threading contract (controller/watch.py docstring): a
+background thread (``WatchTrigger``) shares state with the reconcile
+loop only through ``threading.Event``/``Lock`` primitives — everything
+else a thread object mutates after ``__init__`` must be owned by the
+thread (touched only from ``run()`` and its private helpers), and
+classes that hold a ``Lock`` must take it around every shared write.
+This checker turns that contract into findings:
+
+- a class is IN SCOPE when it subclasses ``threading.Thread`` or
+  assigns a ``threading.Lock()``/``RLock()`` to ``self`` in
+  ``__init__``;
+- attribute writes in ``__init__`` are construction, always fine;
+- calls on synchronization primitives themselves (``self._stop.set()``)
+  are the sanctioned cross-thread channel, always fine;
+- for lock-holding classes, every other ``self.X`` write must sit
+  lexically inside ``with self.<lock>:`` (TAT201);
+- for Thread subclasses, ``self.X`` writes are additionally fine in
+  methods reachable ONLY from ``run()`` (thread-owned state); a write
+  in a method callable from outside the thread is a cross-thread race
+  unless lock-guarded (TAT202).
+
+Codes:
+
+- TAT201 — unguarded attribute write in a lock-holding class;
+- TAT202 — cross-thread attribute write in a Thread subclass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_autoscaler.analysis.core import (
+    Checker,
+    Finding,
+    SourceFile,
+    dotted_name,
+)
+from tpu_autoscaler.analysis.purity import MUTATING_METHODS
+
+_LOCK_CTORS = frozenset({"Lock", "RLock"})
+_SYNC_CTORS = frozenset({"Lock", "RLock", "Event", "Condition",
+                         "Semaphore", "BoundedSemaphore", "Barrier"})
+
+
+def _ctor_name(value: ast.AST) -> str | None:
+    """'Lock' for ``threading.Lock()`` / ``Lock()``, else None."""
+    if isinstance(value, ast.Call):
+        d = dotted_name(value.func)
+        if d is not None:
+            return d.split(".")[-1]
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for a bare ``self.x`` expression."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _self_attr_root(node: ast.AST) -> str | None:
+    """'x' for ``self.x``, ``self.x[...]``, ``self.x.y`` chains."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        found = _self_attr(node)
+        if found is not None:
+            return found
+        node = node.value
+    return None
+
+
+def _walk_method(fn: ast.AST):
+    """Walk a method body WITHOUT descending into nested classes (their
+    ``self`` is a different object) or nested functions that rebind
+    ``self`` as a parameter; plain closures keep the outer ``self`` and
+    are walked."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(a.arg == "self" for a in node.args.args):
+                continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.is_thread = any(
+            (dotted_name(b) or "").split(".")[-1] == "Thread"
+            for b in node.bases)
+        self.lock_attrs: set[str] = set()
+        self.sync_attrs: set[str] = set()
+        self.methods: dict[str, ast.FunctionDef] = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        init = self.methods.get("__init__")
+        if init is not None:
+            for sub in ast.walk(init):
+                # Plain and annotated assignment both bind primitives:
+                # ``self._lock = Lock()`` and
+                # ``self._lock: threading.Lock = Lock()``.
+                if isinstance(sub, ast.Assign):
+                    targets, value = sub.targets, sub.value
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    targets, value = [sub.target], sub.value
+                else:
+                    continue
+                ctor = _ctor_name(value)
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr and ctor in _SYNC_CTORS:
+                        self.sync_attrs.add(attr)
+                        if ctor in _LOCK_CTORS:
+                            self.lock_attrs.add(attr)
+
+    def thread_owned_methods(self) -> set[str]:
+        """Methods reachable from ``run()`` and from NOWHERE else in the
+        class — the thread's private call graph.  A method also called
+        by an externally-callable method is shared, hence not owned."""
+        calls: dict[str, set[str]] = {}
+        for name, fn in self.methods.items():
+            called: set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    attr = _self_attr(sub.func)
+                    if attr in self.methods:
+                        called.add(attr)
+            calls[name] = called
+
+        def closure(roots: set[str]) -> set[str]:
+            seen = set(roots)
+            frontier = list(roots)
+            while frontier:
+                for nxt in calls.get(frontier.pop(), ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        frontier.append(nxt)
+            return seen
+
+        if "run" not in self.methods:
+            return set()
+        from_run = closure({"run"})
+        external_roots = {n for n in self.methods
+                          if n not in from_run and n != "__init__"}
+        from_external = closure(external_roots)
+        return from_run - from_external
+
+
+class ThreadDisciplineChecker(Checker):
+    """Self-scoping: runs on every file, reports only on classes that
+    subclass Thread or hold locks."""
+
+    name = "thread-discipline"
+    codes = {
+        "TAT201": "unguarded attribute write in a lock-holding class",
+        "TAT202": "cross-thread attribute write in a Thread subclass",
+    }
+
+    def applies_to(self, rel_path: str) -> bool:
+        return True
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                info = _ClassInfo(node)
+                if info.is_thread or info.lock_attrs:
+                    findings.extend(self._check_class(src, info))
+        return findings
+
+    def _check_class(self, src: SourceFile,
+                     info: _ClassInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        owned = info.thread_owned_methods() if info.is_thread else set()
+        for name, fn in info.methods.items():
+            if name == "__init__" or name in owned:
+                continue
+            findings.extend(self._check_method(src, info, name, fn))
+        return findings
+
+    def _check_method(self, src: SourceFile, info: _ClassInfo,
+                      method: str, fn: ast.FunctionDef) -> list[Finding]:
+        findings: list[Finding] = []
+        guarded: set[int] = set()  # line numbers under a lock guard
+
+        for sub in _walk_method(fn):
+            if isinstance(sub, ast.With):
+                if any(_self_attr(item.context_expr) in info.lock_attrs
+                       for item in sub.items):
+                    guarded.update(range(sub.lineno,
+                                         (sub.end_lineno or sub.lineno) + 1))
+
+        def emit(node: ast.AST, attr: str, how: str) -> None:
+            if node.lineno in guarded:
+                return
+            if info.lock_attrs:
+                findings.append(Finding(
+                    src.rel_path, node.lineno, "TAT201",
+                    f"{info.node.name}.{method} {how} 'self.{attr}' "
+                    f"outside 'with self.{sorted(info.lock_attrs)[0]}:'"))
+            else:
+                findings.append(Finding(
+                    src.rel_path, node.lineno, "TAT202",
+                    f"{info.node.name}.{method} {how} 'self.{attr}' but "
+                    f"is callable from outside the thread (only run()'s "
+                    f"private call graph may touch thread-owned state)"))
+
+        for sub in _walk_method(fn):
+            targets: list[ast.AST] = []
+            if isinstance(sub, ast.Assign):
+                targets = list(sub.targets)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            elif isinstance(sub, ast.Delete):
+                targets = list(sub.targets)
+            elif isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in MUTATING_METHODS:
+                    # Calls ON a sync primitive (Event.clear etc.) are
+                    # the sanctioned channel; reassigning the primitive
+                    # itself (handled below) is not.
+                    attr = _self_attr_root(f.value)
+                    if attr is not None and attr not in info.sync_attrs:
+                        emit(sub, attr, f"mutates (.{f.attr})")
+                continue
+            for t in targets:
+                attr = _self_attr_root(t)
+                if attr is not None:
+                    emit(t, attr, "writes")
+        return findings

@@ -415,6 +415,7 @@ class Controller:
                             "metadata": {"annotations": {
                                 UNSATISFIABLE_ANNOTATION: reason[:500]}}})
                     except Exception:  # noqa: BLE001 — advisory only
+                        self.metrics.inc("advisory_errors")
                         log.debug("could not annotate %s", pod.name,
                                   exc_info=True)
 
@@ -684,6 +685,7 @@ class Controller:
                         "metadata": {"annotations": {
                             UNSATISFIABLE_ANNOTATION: note}}})
                 except Exception:  # noqa: BLE001 — advisory only
+                    self.metrics.inc("advisory_errors")
                     log.debug("could not annotate %s", pod.name,
                               exc_info=True)
 
@@ -773,6 +775,7 @@ class Controller:
         try:
             self.client.create_event(pod.namespace, body)
         except Exception:  # noqa: BLE001 — advisory only
+            self.metrics.inc("advisory_errors")
             log.debug("event emission failed", exc_info=True)
 
     def request_drain(self, unit_id: str) -> None:

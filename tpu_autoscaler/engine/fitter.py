@@ -9,6 +9,7 @@ path keeps the reference's first-fit whole-node accumulation.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Sequence
 
 from tpu_autoscaler.k8s.gangs import Gang
@@ -60,17 +61,13 @@ def host_slots(allocatable: ResourceVector, per_pod: ResourceVector) -> int:
     return 1 if slots is None else slots  # zero-request pod: 1 per host
 
 
-_host_capacity_cache: dict[str, ResourceVector] = {}
-
-
+@functools.lru_cache(maxsize=None)
 def _host_capacity(shape: SliceShape) -> ResourceVector:
-    """One host's capacity vector, cached per shape (the catalog is
-    static data, and feasibility checks run O(gangs x shapes) per pass)."""
-    cached = _host_capacity_cache.get(shape.name)
-    if cached is None:
-        cached = ResourceVector(dict(shape.node_capacity()))
-        _host_capacity_cache[shape.name] = cached
-    return cached
+    """One host's capacity vector, memoized per shape (the catalog is
+    static data, and feasibility checks run O(gangs x shapes) per pass).
+    lru_cache over the frozen SliceShape keeps this module free of
+    mutable global state (TAP104)."""
+    return ResourceVector(dict(shape.node_capacity()))
 
 
 def shape_feasible_for_gang(shape: SliceShape, gang: Gang) -> str | None:
@@ -153,7 +150,7 @@ def choose_shape_for_gang(gang: Gang,
 
 def batch_choose_shapes(gangs: list[Gang],
                         default_generation: str = "v5e"
-                        ) -> dict[tuple, "ShapeChoice"]:
+                        ) -> dict[tuple[str, str, str], "ShapeChoice"]:
     """Bulk shape choice via the native fitpack kernel (native/fitpack.cpp).
 
     Scores every unpinned gang against the generation's catalog in one
@@ -199,7 +196,7 @@ def batch_choose_shapes(gangs: list[Gang],
     scored = native.best_shapes(gang_rows, shape_rows)
     if scored is None:
         return {}
-    out: dict[tuple, ShapeChoice] = {}
+    out: dict[tuple[str, str, str], ShapeChoice] = {}
     for g, (idx, stranded) in zip(eligible, scored):
         if idx < 0:
             continue  # infeasible: Python path reports the exact reason

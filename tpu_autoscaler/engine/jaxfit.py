@@ -20,6 +20,8 @@ path for the final decision.
 
 from __future__ import annotations
 
+from typing import Any, Callable
+
 import numpy as np
 
 from tpu_autoscaler.topology.catalog import SLICE_SHAPES
@@ -27,7 +29,8 @@ from tpu_autoscaler.topology.catalog import SLICE_SHAPES
 _BIG = np.float32(1e9)
 
 
-def catalog_arrays(generation: str | None = None):
+def catalog_arrays(generation: str | None = None
+                   ) -> tuple[list[str], Any, Any, Any]:
     """(names, chips[S], chips_per_host[S], hosts[S]) as numpy arrays."""
     shapes = [s for s in SLICE_SHAPES.values()
               if generation is None or s.generation == generation]
@@ -39,7 +42,8 @@ def catalog_arrays(generation: str | None = None):
     return names, chips, cph, hosts
 
 
-def _score_kernel(total_chips, per_pod_chips, n_pods, chips, cph, hosts):
+def _score_kernel(total_chips: Any, per_pod_chips: Any, n_pods: Any,
+                  chips: Any, cph: Any, hosts: Any) -> Any:
     """Vectorized feasibility + stranded-chip cost.
 
     Inputs: per-gang demand vectors [G]; catalog vectors [S].
@@ -60,7 +64,8 @@ def _score_kernel(total_chips, per_pod_chips, n_pods, chips, cph, hosts):
     return jnp.where(feasible, stranded, _BIG)
 
 
-def make_batch_scorer(generation: str | None = None):
+def make_batch_scorer(generation: str | None = None
+                      ) -> tuple[list[str], Callable[[Any], Any]]:
     """Returns (names, score_fn) where score_fn(gang_demands) -> best index
     and stranded cost per gang, jitted once.
 
@@ -90,7 +95,7 @@ def best_shapes(demands: np.ndarray, generation: str | None = None
     """Convenience wrapper: [(shape_name | None, stranded), ...] per gang."""
     names, score = make_batch_scorer(generation)
     best, cost = score(np.asarray(demands, np.float32))
-    out = []
+    out: list[tuple[str | None, float]] = []
     for b, c in zip(np.asarray(best), np.asarray(cost)):
         out.append((None, float("inf")) if c >= _BIG
                    else (names[int(b)], float(c)))

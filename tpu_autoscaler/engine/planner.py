@@ -18,9 +18,11 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+from typing import Any, Sequence
 
 from tpu_autoscaler.engine.fitter import (
     FitError,
+    ShapeChoice,
     batch_choose_shapes,
     choose_shape_for_gang,
     free_capacity,
@@ -29,6 +31,7 @@ from tpu_autoscaler.engine.fitter import (
 )
 from tpu_autoscaler.k8s.gangs import Gang
 from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.resources import ResourceVector
 from tpu_autoscaler.topology.catalog import (
     DEFAULT_CPU_SHAPE,
     TPU_RESOURCE,
@@ -204,7 +207,8 @@ def _chips_by_namespace(pods: list[Pod],
     return used
 
 
-def _cohort_fair_key(cohort: list[Gang], ns_usage: dict[str, int]):
+def _cohort_fair_key(cohort: list[Gang], ns_usage: dict[str, int]
+                     ) -> tuple[int, int, bool, float, GangKey]:
     """Admission order under fair-share: priority desc, then namespace
     chip ledger asc, then age asc (the (None-flag, timestamp) pattern —
     naive/aware datetimes never compare), then key for determinism."""
@@ -221,7 +225,7 @@ def _cohort_fair_key(cohort: list[Gang], ns_usage: dict[str, int]):
 class _PlannedNode:
     """A not-yet-existing node, for predicate simulation (NodeLike)."""
 
-    def __init__(self, name: str, machine_type: str):
+    def __init__(self, name: str, machine_type: str) -> None:
         from tpu_autoscaler.k8s.scheduling import HOSTNAME_KEY
         from tpu_autoscaler.topology.catalog import INSTANCE_TYPE_LABEL
 
@@ -231,11 +235,12 @@ class _PlannedNode:
 
 
 def _place_constrained_cpu(constrained: list[Pod],
-                           free: dict[str, "ResourceVector"],
+                           free: dict[str, ResourceVector],
                            shapes: Sequence[CpuShape],
                            all_nodes: list[Node],
                            all_pods: list[Pod],
-                           ) -> tuple[dict[str, int], list[Pod]]:
+                           ) -> tuple[dict[str, int], list[Pod],
+                                      dict[str, ResourceVector]]:
     """Place CPU pods that carry hard affinity/anti-affinity/spread
     constraints, using the same predicates the (fake or real) scheduler
     enforces — plain first-fit would count capacity the scheduler will
@@ -254,10 +259,10 @@ def _place_constrained_cpu(constrained: list[Pod],
     """
     import itertools
 
-    from tpu_autoscaler.k8s.resources import ResourceVector
     from tpu_autoscaler.k8s.scheduling import scheduling_blocks
 
-    nodes_by_name: dict[str, object] = {n.name: n for n in all_nodes}
+    # Values are Node or _PlannedNode (the NodeLike protocol).
+    nodes_by_name: dict[str, Any] = {n.name: n for n in all_nodes}
     placements: dict[str, list[Pod]] = {}
     for p in all_pods:
         if p.node_name and p.phase in {"Pending", "Running"}:
@@ -265,7 +270,7 @@ def _place_constrained_cpu(constrained: list[Pod],
     shapes = sorted(shapes, key=lambda s: (s.cpu_m, s.memory))
     caps = {s.machine_type: ResourceVector(dict(s.node_capacity()))
             for s in shapes}
-    new_nodes: list[list] = []  # [name, machine_type, remaining]
+    new_nodes: list[list[Any]] = []  # [name, machine_type, remaining]
     counts: dict[str, int] = {}
     unplaceable: list[Pod] = []
     seq = itertools.count(1)
@@ -320,11 +325,11 @@ def _place_constrained_cpu(constrained: list[Pod],
 
 
 class Planner:
-    def __init__(self, policy: PoolPolicy | None = None):
+    def __init__(self, policy: PoolPolicy | None = None) -> None:
         self.policy = policy or PoolPolicy()
 
     def plan(self, gangs: list[Gang], nodes: list[Node], pods: list[Pod],
-             in_flight: list[InFlight] = (),
+             in_flight: Sequence[InFlight] = (),
              generation_overrides: dict[GangKey, str] | None = None
              ) -> ScalePlan:
         """``generation_overrides`` maps a gang key to the TPU generation
@@ -417,7 +422,7 @@ class Planner:
                 # namespace cannot capture every slot in one pass.
                 remaining.sort(key=lambda c: _cohort_fair_key(c, ns_chips))
             cohort = remaining.pop(0)
-            members: list[tuple[Gang, object]] = []
+            members: list[tuple[Gang, ShapeChoice]] = []
             for g in cohort:
                 if g.key in batch_choices:
                     members.append((g, batch_choices[g.key]))

@@ -58,7 +58,8 @@ class LeaseLock:
         """Acquire or renew; True iff we are the leader after this call."""
         try:
             lease = self._client.get_lease(self._namespace, self._name)
-        except Exception:  # noqa: BLE001 — apiserver unreachable: not us
+        except Exception:  # crash-only: apiserver unreachable means "not
+            # leader" — the loop skips its write phase until re-acquired
             log.warning("lease read failed", exc_info=True)
             return False
         if lease is None:
@@ -93,6 +94,7 @@ class LeaseLock:
         try:
             self._client.put_lease(self._namespace, self._name, body)
             return True
-        except Exception:  # noqa: BLE001 — conflict/network: we lost
+        except Exception:  # crash-only: losing the optimistic-concurrency
+            # write IS the protocol outcome — the other candidate won
             log.info("lease write lost (conflict?)", exc_info=True)
             return False

@@ -15,7 +15,7 @@ changes for the TPU-native design:
 from __future__ import annotations
 
 import datetime
-from typing import TYPE_CHECKING, Mapping, Sequence
+from typing import TYPE_CHECKING, Any, Mapping, Sequence
 
 from tpu_autoscaler.k8s.resources import ResourceVector
 from tpu_autoscaler.topology.catalog import (
@@ -63,7 +63,7 @@ def parse_time(value: str | None) -> datetime.datetime | None:
 class Pod:
     """One pod, read-only view plus delete/evict verbs."""
 
-    def __init__(self, payload: Mapping):
+    def __init__(self, payload: Mapping[str, Any]) -> None:
         self._p = payload
         meta = payload.get("metadata", {})
         self.name: str = meta.get("name", "")
@@ -71,27 +71,31 @@ class Pod:
         self.uid: str = meta.get("uid", "")
         self.labels: dict[str, str] = dict(meta.get("labels") or {})
         self.annotations: dict[str, str] = dict(meta.get("annotations") or {})
-        self.created = parse_time(meta.get("creationTimestamp"))
-        self._owners = meta.get("ownerReferences") or []
+        self.created: datetime.datetime | None = parse_time(
+            meta.get("creationTimestamp"))
+        self._owners: list[dict[str, Any]] = meta.get(
+            "ownerReferences") or []
         spec = payload.get("spec", {})
         self.node_name: str | None = spec.get("nodeName")
         self.node_selectors: dict[str, str] = dict(spec.get("nodeSelector") or {})
-        self.tolerations: list[dict] = list(spec.get("tolerations") or [])
+        self.tolerations: list[dict[str, Any]] = list(
+            spec.get("tolerations") or [])
         self.priority_class: str | None = spec.get("priorityClassName")
         self.priority: int = int(spec.get("priority") or 0)
         # Hard scheduling constraints beyond node-local admission
         # (evaluated by k8s/scheduling.py in the fake scheduler and the
         # planner's CPU packing path).
-        self.affinity: dict = dict(spec.get("affinity") or {})
-        self.topology_spread: list[dict] = list(
+        self.affinity: dict[str, Any] = dict(spec.get("affinity") or {})
+        self.topology_spread: list[dict[str, Any]] = list(
             spec.get("topologySpreadConstraints") or [])
-        self.resources = self._sum_requests(spec)
+        self.resources: ResourceVector = self._sum_requests(spec)
         status = payload.get("status", {})
         self.phase: str = status.get("phase", "")
-        self._conditions = status.get("conditions") or []
+        self._conditions: list[dict[str, Any]] = status.get(
+            "conditions") or []
 
     @staticmethod
-    def _sum_requests(spec: Mapping) -> ResourceVector:
+    def _sum_requests(spec: Mapping[str, Any]) -> ResourceVector:
         """Effective pod request: sum(containers) ∨ max(initContainers).
 
         The reference summed container requests (kube.py §KubePod);
@@ -196,7 +200,7 @@ class Pod:
 
     # -- gang identity ------------------------------------------------------
 
-    def tolerates(self, taint: Mapping) -> bool:
+    def tolerates(self, taint: Mapping[str, Any]) -> bool:
         """Kubernetes toleration matching for one taint."""
         for tol in self.tolerations:
             op = tol.get("operator", "Equal")
@@ -247,21 +251,23 @@ class Pod:
 class Node:
     """One node, read-only view plus cordon/uncordon/drain verbs."""
 
-    def __init__(self, payload: Mapping):
+    def __init__(self, payload: Mapping[str, Any]) -> None:
         self._p = payload
         meta = payload.get("metadata", {})
         self.name: str = meta.get("name", "")
         self.uid: str = meta.get("uid", "")
         self.labels: dict[str, str] = dict(meta.get("labels") or {})
         self.annotations: dict[str, str] = dict(meta.get("annotations") or {})
-        self.created = parse_time(meta.get("creationTimestamp"))
+        self.created: datetime.datetime | None = parse_time(
+            meta.get("creationTimestamp"))
         spec = payload.get("spec", {})
         self.unschedulable: bool = bool(spec.get("unschedulable", False))
-        self.taints: list[dict] = list(spec.get("taints") or [])
+        self.taints: list[dict[str, Any]] = list(spec.get("taints") or [])
         status = payload.get("status", {})
-        self.allocatable = ResourceVector.from_raw(
+        self.allocatable: ResourceVector = ResourceVector.from_raw(
             status.get("allocatable") or status.get("capacity"))
-        self._conditions = status.get("conditions") or []
+        self._conditions: list[dict[str, Any]] = status.get(
+            "conditions") or []
 
     @property
     def instance_type(self) -> str | None:
@@ -362,8 +368,9 @@ class Node:
                 try:
                     pod.evict(client)
                     evicted += 1
-                except Exception:  # noqa: BLE001 — e.g. 429 from a PDB;
-                    # other pods (and other units) must still drain.
+                except Exception:  # crash-only: 429 from a PDB is the
+                    # eviction API working as designed; other pods (and
+                    # other units) must still drain, retried next pass.
                     logging.getLogger(__name__).warning(
                         "eviction of %s/%s blocked (PDB?); will retry",
                         pod.namespace, pod.name, exc_info=True)

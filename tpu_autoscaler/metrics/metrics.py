@@ -159,8 +159,11 @@ class Metrics:
 
         server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
         # The actually-bound port (stable even with port=0, which lets
-        # tests and co-located processes avoid collisions).
-        self.bound_port = server.server_address[1]
+        # tests and co-located processes avoid collisions).  Published
+        # under the lock: serve() may be called while scrapes are
+        # already running (thread discipline, TAT201).
+        with self._lock:
+            self.bound_port = server.server_address[1]
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         return thread

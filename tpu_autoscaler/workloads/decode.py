@@ -126,8 +126,8 @@ def _attend(q, k, v, k_cache, v_cache, cfg: ModelConfig, offset, s,
             import numpy as _np
 
             daxes = data_axes(mesh)
-            dp = int(_np.prod([mesh.shape[a] for a in daxes])) if daxes \
-                else 1
+            dp = (int(_np.prod([mesh.shape[a] for a in daxes]))  # analysis: allow=TAJ401 mesh axis sizes are static ints
+                  if daxes else 1)
             if q.shape[0] % dp:
                 import warnings
 
@@ -252,7 +252,7 @@ def _constrain_cache(cache: KVCache, mesh) -> KVCache:
     from tpu_autoscaler.workloads.model import data_axes
 
     daxes = data_axes(mesh)
-    dp = int(_np.prod([mesh.shape[a] for a in daxes])) if daxes else 1
+    dp = int(_np.prod([mesh.shape[a] for a in daxes])) if daxes else 1  # analysis: allow=TAJ401 mesh axis sizes are static ints
     tp = mesh.shape.get("model", 1)
     b, hkv = cache.k.shape[1], cache.k.shape[2]
     spec = P(None,
@@ -293,13 +293,13 @@ def decode_step(params: dict, cache: KVCache, tokens: jax.Array,
     jittable at a traced cache length — one compiled program serves all
     positions."""
     if not isinstance(cache.length, jax.core.Tracer) \
-            and int(cache.length) >= cache.max_len:
+            and int(cache.length) >= cache.max_len:  # analysis: allow=TAJ401 Tracer-guarded
         # Past max_len, dynamic_update_slice would silently CLAMP the
         # write offset and corrupt the last cache slot.  A traced length
         # (inside jit/scan) cannot be checked here — generate() guards
         # its own loop; direct jitted callers own the bound.
         raise ValueError(
-            f"KV cache full: length {int(cache.length)} >= max_len "
+            f"KV cache full: length {int(cache.length)} >= max_len "  # analysis: allow=TAJ401 concrete by the guard above
             f"{cache.max_len}")
     if mesh is not None:
         cfg = cfg.resolved_for_mesh(mesh)

@@ -374,7 +374,7 @@ def _block(x: jax.Array, layer: dict, cfg: ModelConfig,
 
         if mesh is not None and mesh.size > 1:
             batch_axes = data_axes(mesh)
-            dp = int(np.prod([mesh.shape[a] for a in batch_axes]))
+            dp = int(np.prod([mesh.shape[a] for a in batch_axes]))  # analysis: allow=TAJ401 mesh axis sizes are static ints
             if b % dp or not cfg.mesh_shardable(mesh):
                 # shard_map cannot split an uneven batch (GSPMD pads;
                 # shard_map does not) nor a head count the 'model' axis

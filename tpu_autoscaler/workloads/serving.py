@@ -192,7 +192,7 @@ def _slot_attend(q, k_c, v_c, new_len, cfg: ModelConfig, mesh,
     from tpu_autoscaler.workloads.model import data_axes
 
     daxes = data_axes(mesh)
-    dp = int(_np.prod([mesh.shape[a] for a in daxes])) if daxes else 1
+    dp = int(_np.prod([mesh.shape[a] for a in daxes])) if daxes else 1  # analysis: allow=TAJ401 mesh axis sizes are static ints
     if q.shape[0] % dp:
         # Static shapes at trace time: an indivisible slot count serves
         # through the einsum path (model._block's fallback philosophy).
