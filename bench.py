@@ -286,7 +286,172 @@ def bench_fit_batch(n_gangs: int = 512) -> dict:
     return info
 
 
-def main() -> int:
+# Observe-path tier (ISSUE 2): steady-state per-pass observation cost —
+# list + parse of the whole cluster — at production scale, relist
+# baseline vs the informer's delta-applying cache (k8s/informer.py).
+# The informer pays O(churn) parses per pass instead of O(cluster); the
+# gate requires >= 5x on a 5k-pod / 600-node cluster with 1% churn.
+OBSERVE_PODS = 5000
+OBSERVE_NODES = 600
+OBSERVE_CHURN = 0.01
+OBSERVE_PASSES = 5
+OBSERVE_SPEEDUP_FLOOR = 5.0
+
+
+def _observe_pod_payload(i: int, rv: int) -> dict:
+    running = i % 50 != 0  # ~2% pending (the demand tail)
+    payload = {
+        "metadata": {
+            "name": f"pod-{i}", "namespace": f"ns-{i % 20}",
+            "uid": f"uid-pod-{i}", "resourceVersion": str(rv),
+            "labels": {"batch.kubernetes.io/job-name": f"job-{i // 4}",
+                       "app": f"app-{i % 100}"},
+            "annotations": {},
+            "creationTimestamp": "2026-01-01T00:00:00Z",
+            "ownerReferences": [{"kind": "Job", "name": f"job-{i // 4}"}],
+        },
+        "spec": {
+            "nodeName": f"node-{i % OBSERVE_NODES}" if running else None,
+            "nodeSelector": {},
+            "tolerations": [{"key": "google.com/tpu",
+                             "operator": "Exists",
+                             "effect": "NoSchedule"}],
+            "containers": [{"name": "main", "resources": {
+                "requests": {"cpu": "2", "memory": "4Gi",
+                             "google.com/tpu": "4"}}}],
+        },
+        "status": {"phase": "Running" if running else "Pending",
+                   "conditions": [] if running else [
+                       {"type": "PodScheduled", "status": "False",
+                        "reason": "Unschedulable"}]},
+    }
+    return payload
+
+
+def _observe_node_payload(i: int, rv: int) -> dict:
+    return {
+        "metadata": {
+            "name": f"node-{i}", "uid": f"uid-node-{i}",
+            "resourceVersion": str(rv),
+            "labels": {
+                "cloud.google.com/gke-nodepool": f"pool-{i // 4}",
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+                "cloud.google.com/gke-tpu-topology": "2x2x1",
+                "node.kubernetes.io/instance-type": "ct5p-hightpu-4t",
+            },
+            "annotations": {},
+            "creationTimestamp": "2026-01-01T00:00:00Z",
+        },
+        "spec": {"taints": [{"key": "google.com/tpu", "value": "present",
+                             "effect": "NoSchedule"}]},
+        "status": {
+            "allocatable": {"cpu": "208", "memory": "400Gi",
+                            "pods": "110", "google.com/tpu": "4"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def bench_observe_path(n_pods: int = OBSERVE_PODS,
+                       n_nodes: int = OBSERVE_NODES,
+                       churn: float = OBSERVE_CHURN) -> dict:
+    """Relist baseline vs informer steady-state, best-of-N passes each.
+
+    Baseline = exactly what ``reconcile_once`` did before the informer:
+    construct every ``Node``/``Pod`` from the freshly-listed payloads.
+    Informer = apply the pass's churn deltas (bumped resourceVersions)
+    to warm caches, then snapshot — parse work is O(churn) through the
+    (uid, resourceVersion) memo, snapshot is an O(n) list copy.
+    """
+    from tpu_autoscaler.k8s.informer import ObjectCache
+    from tpu_autoscaler.k8s.objects import (
+        Node,
+        Pod,
+        clear_parse_caches,
+        parse_node,
+        parse_pod,
+    )
+
+    rv = 1
+    pod_payloads = [_observe_pod_payload(i, rv) for i in range(n_pods)]
+    node_payloads = [_observe_node_payload(i, rv) for i in range(n_nodes)]
+
+    # -- relist baseline: full re-parse each pass ------------------------
+    baseline_s = float("inf")
+    for _ in range(OBSERVE_PASSES):
+        t0 = time.perf_counter()
+        nodes = [Node(p) for p in node_payloads]
+        pods = [Pod(p) for p in pod_payloads]
+        baseline_s = min(baseline_s, time.perf_counter() - t0)
+    assert len(nodes) == n_nodes and len(pods) == n_pods
+
+    # -- informer steady state: churn deltas + snapshot ------------------
+    clear_parse_caches()
+    pod_cache = ObjectCache("pods", parse_pod)
+    node_cache = ObjectCache("nodes", parse_node)
+    pod_cache.replace(pod_payloads, str(rv))
+    node_cache.replace(node_payloads, str(rv))
+
+    # Pre-build each pass's churn events (the watch stream's job, not
+    # the observe path's): churn% of pods and nodes, new resourceVersion.
+    churn_pods = max(1, int(n_pods * churn))
+    churn_nodes = max(1, int(n_nodes * churn))
+    passes = []
+    for p in range(OBSERVE_PASSES):
+        events = []
+        for j in range(churn_pods):
+            rv += 1
+            i = (p * churn_pods + j) % n_pods
+            events.append({"type": "MODIFIED",
+                           "object": _observe_pod_payload(i, rv)})
+        for j in range(churn_nodes):
+            rv += 1
+            i = (p * churn_nodes + j) % n_nodes
+            events.append({"type": "MODIFIED",
+                           "object": _observe_node_payload(i, rv)})
+        passes.append(events)
+
+    informer_s = float("inf")
+    for events in passes:
+        t0 = time.perf_counter()
+        for ev in events:
+            kind = "pods" if "pod-" in ev["object"]["metadata"]["name"] \
+                else "nodes"
+            (pod_cache if kind == "pods" else node_cache).apply(ev)
+        nodes = node_cache.snapshot()
+        pods = pod_cache.snapshot()
+        informer_s = min(informer_s, time.perf_counter() - t0)
+    assert len(nodes) == n_nodes and len(pods) == n_pods
+    clear_parse_caches()
+
+    return {
+        "info": "observe_path",
+        "pods": n_pods, "nodes": n_nodes, "churn": churn,
+        "baseline_ms": round(baseline_s * 1e3, 2),
+        "informer_ms": round(informer_s * 1e3, 2),
+        "speedup": round(baseline_s / informer_s, 1)
+        if informer_s > 0 else None,
+        "floor": OBSERVE_SPEEDUP_FLOOR,
+    }
+
+
+def check_observe_path() -> bool:
+    """Gate: informer observe path >= OBSERVE_SPEEDUP_FLOOR x faster
+    than the relist baseline at production scale."""
+    info = bench_observe_path()
+    print(json.dumps(info), file=sys.stderr)
+    ok = (info.get("speedup") or 0) >= OBSERVE_SPEEDUP_FLOOR
+    if not ok:
+        print(json.dumps({"error": "observe-path regression: informer "
+                          "speedup below floor", **info}), file=sys.stderr)
+    return ok
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "observe":
+        # Observe tier only (scripts/full_suite.sh): sub-second gate.
+        return 0 if check_observe_path() else 1
     if not check_all_configs():
         print(json.dumps({"error": "a BASELINE config failed"}),
               file=sys.stderr)
@@ -295,6 +460,8 @@ def main() -> int:
     if not realistic_ok or north_star_s is None:
         print(json.dumps({"error": "a BASELINE config failed under "
                           "realistic actuation latency"}), file=sys.stderr)
+        return 1
+    if not check_observe_path():
         return 1
     # Informational (stderr: stdout is ONE metric line by contract) —
     # except decision parity, which is a hard gate.

@@ -16,6 +16,11 @@ cd "$(dirname "$0")/.."
 # exception hygiene, jax purity) before any test burns wall-clock.
 ./scripts/lint.sh
 
+# Observe-path tier: informer vs relist-baseline at 5k pods/600 nodes
+# with 1% churn must hold the >= 5x speedup floor (ISSUE 2).  Also
+# sub-second, so it runs before the test splits.
+JAX_PLATFORMS=cpu python bench.py observe
+
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py
   --ignore=tests/test_sp.py --ignore=tests/test_pipeline.py

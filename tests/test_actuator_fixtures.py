@@ -221,6 +221,30 @@ class TestErrorTaxonomy:
         err = GcpApiError(502, "https://example/api", "Bad Gateway")
         assert classify_provision_error(err) == "transient"
 
+    def test_rate_limits_are_transient_not_quota(self):
+        """GCP serves per-minute rate quotas with 'quota' wording (and
+        often over 403) — they clear within a backoff window, so the
+        taxonomy must say retry, not give-up (ADVICE r5 #1)."""
+        cases = [
+            "RATE_LIMIT_EXCEEDED: too many requests",
+            "Quota exceeded for quota metric 'Queries' and limit "
+            "'Queries per minute' of service compute.googleapis.com",
+            "Rate limit exceeded for resource",
+        ]
+        for text in cases:
+            assert classify_provision_error(text) == "transient", text
+        # A capacity quota (no rate wording) still classifies as quota.
+        assert classify_provision_error(
+            "Quota 'TPUS_PER_PROJECT' exceeded. Limit: 32.0") == "quota"
+
+    def test_403_rate_limit_envelope_is_transient(self):
+        err = GcpApiError(403, "https://example/api", {"error": {
+            "code": 403, "status": "RESOURCE_EXHAUSTED",
+            "message": "Quota exceeded for quota metric 'Read requests' "
+                       "and limit 'Read requests per minute'",
+            "errors": [{"reason": "rateLimitExceeded"}]}})
+        assert classify_provision_error(err) == "transient"
+
 
 class TestReasonSurfacing:
     """The controller exports the taxonomy: per-cause counters and the

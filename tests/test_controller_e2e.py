@@ -943,8 +943,22 @@ class TestRunForeverGates:
         polls (no crash); verified by letting one interval elapse."""
         import threading
 
-        kube = FakeKube()  # FakeKube has no watch_pods attribute
-        controller = Controller(kube, FakeActuator(kube), ControllerConfig(
+        class WatchlessKube:
+            """FakeKube minus its watch verbs (since ISSUE 2 FakeKube
+            CAN watch, so the gate needs a genuinely watchless client)."""
+
+            def __init__(self, kube):
+                self._kube = kube
+
+            def list_nodes(self):
+                return self._kube.list_nodes()
+
+            def list_pods(self):
+                return self._kube.list_pods()
+
+        kube = WatchlessKube(FakeKube())
+        controller = Controller(kube, FakeActuator(kube._kube),
+                                ControllerConfig(
             policy=PoolPolicy(spare_nodes=0)))
         t = threading.Thread(
             target=controller.run_forever,
@@ -960,3 +974,4 @@ class TestRunForeverGates:
             time.sleep(0.05)
         assert controller.metrics.snapshot()["summaries"][
             "reconcile_seconds"]["count"] >= 2
+        assert controller.informer is None  # gate held: poll-only

@@ -24,6 +24,10 @@ MANIFEST = os.path.join(os.path.dirname(os.path.dirname(
 METHOD_GRANTS: dict[str, set[tuple[str, str, str]]] = {
     "list_nodes": {("", "nodes", "list")},
     "list_pods": {("", "pods", "list")},
+    # Raw list verbs hit the same endpoints (the informer needs the
+    # collection resourceVersion to resume its watch from).
+    "list_nodes_raw": {("", "nodes", "list")},
+    "list_pods_raw": {("", "pods", "list")},
     "patch_node": {("", "nodes", "patch")},
     "patch_pod": {("", "pods", "patch")},
     "evict_pod": {("", "pods/eviction", "create")},
@@ -34,8 +38,10 @@ METHOD_GRANTS: dict[str, set[tuple[str, str, str]]] = {
     # put_lease POSTs on first acquisition, PUTs on renewal.
     "put_lease": {("coordination.k8s.io", "leases", "create"),
                   ("coordination.k8s.io", "leases", "update")},
-    # ?watch=1 on the pod list endpoint requires the watch verb.
+    # ?watch=1 on the list endpoints requires the watch verb; nodes are
+    # watched by the informer's supply-side cache (k8s/informer.py).
     "watch_pods": {("", "pods", "watch")},
+    "watch_nodes": {("", "nodes", "watch")},
 }
 
 

@@ -33,7 +33,8 @@ class ApiServerStub(http.server.BaseHTTPRequestHandler):
             self._send_json(self.nodes)
         elif self.path == "/api/v1/pods":
             self._send_json(self.pods)
-        elif self.path.startswith("/api/v1/pods?watch=1"):
+        elif self.path.startswith(("/api/v1/pods?watch=1",
+                                   "/api/v1/nodes?watch=1")):
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.end_headers()
@@ -117,6 +118,26 @@ class TestRestKubeClient:
         c = self.client(server)
         events = list(c.watch_pods(timeout_seconds=5))
         assert [e["type"] for e in events] == ["ADDED", "MODIFIED"]
+
+    def test_watch_nodes_hits_node_endpoint_with_cursor(self, server):
+        c = self.client(server)
+        list(c.watch_nodes(timeout_seconds=5, resource_version="42"))
+        method, path, _, _ = ApiServerStub.requests_log[-1]
+        assert method == "GET"
+        assert path.startswith("/api/v1/nodes?watch=1")
+        assert "resourceVersion=42" in path
+        assert "allowWatchBookmarks=true" in path
+
+    def test_raw_lists_return_collection_metadata(self, server):
+        """The informer resumes its watch from the LIST response's
+        collection resourceVersion — list_*_raw must expose it."""
+        ApiServerStub.nodes = {"metadata": {"resourceVersion": "77"},
+                               "items": [{"metadata": {"name": "n1"}}]}
+        c = self.client(server)
+        raw = c.list_nodes_raw()
+        assert raw["metadata"]["resourceVersion"] == "77"
+        assert raw["items"][0]["metadata"]["name"] == "n1"
+        assert c.list_pods_raw()["items"] == c.list_pods()
 
     def test_dry_run_suppresses_mutations(self, server):
         c = RestKubeClient(base_url=server, token="tok", ca_cert=False,
@@ -267,6 +288,39 @@ class TestKubeClientRetries:
         key = ("PUT", "/apis/coordination.k8s.io/v1/namespaces/"
                       "kube-system/leases/tpu-autoscaler")
         assert FlakyApiStub.hits.count(key) == 1  # conflict is terminal
+
+    def test_create_409_with_our_holder_is_acquired(self, flaky_server):
+        """A retried lease-create POST whose FIRST attempt committed
+        answers 409 on the retry; re-reading and finding holder == us
+        must count as acquired, not a lost election (ADVICE r5 #3)."""
+        lease_base = ("/apis/coordination.k8s.io/v1/namespaces/"
+                      "kube-system/leases")
+        FlakyApiStub.script[("POST", lease_base)] = [409]
+        FlakyApiStub.lease = {
+            "metadata": {"name": "tpu-autoscaler",
+                         "resourceVersion": "1"},
+            "spec": {"holderIdentity": "me"}}
+        c = self.client(flaky_server)
+        c.put_lease("kube-system", "tpu-autoscaler", {
+            "metadata": {"name": "tpu-autoscaler"},
+            "spec": {"holderIdentity": "me"}})  # no raise: we hold it
+
+    def test_create_409_with_other_holder_still_conflicts(self,
+                                                          flaky_server):
+        import requests
+
+        lease_base = ("/apis/coordination.k8s.io/v1/namespaces/"
+                      "kube-system/leases")
+        FlakyApiStub.script[("POST", lease_base)] = [409]
+        FlakyApiStub.lease = {
+            "metadata": {"name": "tpu-autoscaler",
+                         "resourceVersion": "1"},
+            "spec": {"holderIdentity": "somebody-else"}}
+        c = self.client(flaky_server)
+        with pytest.raises(requests.exceptions.HTTPError):
+            c.put_lease("kube-system", "tpu-autoscaler", {
+                "metadata": {"name": "tpu-autoscaler"},
+                "spec": {"holderIdentity": "me"}})
 
     def test_leader_renewal_survives_flaky_apiserver(self, flaky_server):
         """The incumbent leader renews through a 429 on the lease READ

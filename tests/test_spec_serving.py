@@ -144,6 +144,11 @@ class TestGreedyParity:
         assert len(r1.generated) == cut + 1
         assert list(r1.generated) == ref[:cut + 1]
         assert list(r2.generated) == ref2
+        # Accept accounting after eos truncation (ADVICE r5 #4): drafts
+        # past the eos were never emitted, so accepted can never exceed
+        # emitted — pre-fix, an eos mid-block overstated accept_rate.
+        assert eng.accepted_tokens <= eng.decode_tokens
+        assert eng.accept_rate <= 1.0
 
     def test_max_new_tokens_never_exceeded(self):
         """The per-slot k_eff cap: a request one token from its budget

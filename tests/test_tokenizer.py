@@ -141,6 +141,35 @@ class TestCommittedArtifacts:
         assert bpe.vocab_size == 8192
         assert bpe.decode(ids) == SAMPLE
 
+    def test_build_shard_reuses_early_stopped_tokenizer(self, tmp_path,
+                                                        monkeypatch):
+        """An early-stopped (min_count) tokenizer's actual vocab never
+        equals the request; the recorded requested_vocab_size must make
+        the second build a cache hit, not a silent retrain (ADVICE r5
+        #2)."""
+        out = str(tmp_path / "shard.bin")
+        corpus = str(tmp_path / "c.txt")
+        with open(corpus, "wb") as f:
+            f.write(b"ababab" * 20)  # exhausts pairs long before 8192
+        tok = str(tmp_path / "tokenizer.json")
+        first, _ = build_shard(corpus, tok, out, 8192)
+        assert first.vocab_size < 8192  # early-stopped
+
+        def boom(*a, **k):
+            raise AssertionError("cache miss: build_shard retrained")
+
+        monkeypatch.setattr(ByteBPE, "train", boom)
+        again, ids = build_shard(corpus, tok, out, 8192)
+        assert again.merges == first.merges
+        assert again.decode(ids) == b"ababab" * 20
+
+    def test_requested_vocab_survives_save_load(self, tmp_path):
+        bpe = ByteBPE.train(b"ababab", 10_000)
+        assert bpe.requested_vocab_size == 10_000
+        path = str(tmp_path / "tok.json")
+        bpe.save(path)
+        assert ByteBPE.load(path).requested_vocab_size == 10_000
+
 
 @pytest.mark.slow
 class TestRealCorpusConvergence:

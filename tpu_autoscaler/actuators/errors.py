@@ -12,8 +12,11 @@ Categories:
 - ``stockout``   — the zone has no capacity for the shape right now
                    (GCE_STOCKOUT / resource pool exhausted / QR denied
                    for capacity).  Retry/generation-fallback territory.
-- ``quota``      — the PROJECT's quota is exhausted; retrying won't help
-                   until quota changes.
+- ``quota``      — the PROJECT's capacity quota is exhausted; retrying
+                   won't help until quota changes.  Per-MINUTE rate
+                   quotas are deliberately NOT this: they self-heal
+                   within a backoff window, so they classify as
+                   ``transient`` (ADVICE r5 #1).
 - ``permission`` — auth/IAM (401/403 PERMISSION_DENIED).
 - ``bad-shape``  — the request itself is invalid (unknown machine type /
                    accelerator / topology; 400 INVALID_ARGUMENT).
@@ -41,7 +44,17 @@ STOCKOUT_MARKERS = (
 QUOTA_MARKERS = (
     "quota",
     "limit exceeded for",
+)
+
+# Rate limiting is a QUOTA in GCP's vocabulary ("Quota exceeded for
+# quota metric ... per minute") but transient in ours: it clears within
+# a backoff window, so policy must keep retrying instead of giving up.
+# Checked BEFORE the quota bucket.
+RATE_LIMIT_MARKERS = (
     "rate_limit_exceeded",
+    "ratelimitexceeded",
+    "rate limit",
+    "per minute",
 )
 
 PERMISSION_MARKERS = (
@@ -93,13 +106,19 @@ def classify_provision_error(error) -> str:
     text_parts = [str(error)]
     if isinstance(error, GcpApiError):
         text_parts += [error.status, error.message, *error.reasons]
+    text = " ".join(text_parts).lower()
+    # Rate limits first: GCP serves them as 403s with quota wording
+    # ("Quota exceeded for quota metric ... per minute",
+    # rateLimitExceeded), which would otherwise land in the permission
+    # or quota buckets — both documented as not-retryable.
+    if any(m in text for m in RATE_LIMIT_MARKERS):
+        return "transient"
+    if isinstance(error, GcpApiError):
         if error.http_status in (401, 403) and not any(
-                m in " ".join(text_parts).lower()
-                for m in QUOTA_MARKERS):
+                m in text for m in QUOTA_MARKERS):
             return "permission"
         if error.http_status == 400:
             return "bad-shape"
-    text = " ".join(text_parts).lower()
     if any(m in text for m in STOCKOUT_MARKERS):
         return "stockout"
     if any(m in text for m in QUOTA_MARKERS):

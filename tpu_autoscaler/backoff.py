@@ -11,6 +11,21 @@ drift-prone math lives here.
 
 from __future__ import annotations
 
+#: Watch-reconnect schedule, shared by controller/watch.py
+#: (WatchTrigger) and k8s/informer.py (ResourceWatch) so the two watch
+#: loops can never drift apart on tuning.
+WATCH_BACKOFF_BASE_S = 1.0
+WATCH_BACKOFF_CAP_S = 60.0
+
+
+def watch_backoff_seconds(failure_streak: int, rng) -> float:
+    """Watch-reconnect delay: exponential with full jitter,
+    uniform(0, min(cap, base * 2^(streak-1)))."""
+    return backoff_seconds(
+        max(0, failure_streak - 1), None,
+        base_s=WATCH_BACKOFF_BASE_S, cap_s=WATCH_BACKOFF_CAP_S,
+        retry_after_cap_s=WATCH_BACKOFF_CAP_S, rng=rng)
+
 
 def backoff_seconds(attempt: int, retry_after, *, base_s: float,
                     cap_s: float, retry_after_cap_s: float, rng) -> float:

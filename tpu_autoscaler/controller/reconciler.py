@@ -133,12 +133,21 @@ class Controller:
     def __init__(self, client: KubeClient, actuator: Actuator,
                  config: ControllerConfig | None = None,
                  notifier: Notifier | None = None,
-                 metrics: Metrics | None = None):
+                 metrics: Metrics | None = None,
+                 informer=None):
         self.client = client
         self.actuator = actuator
         self.config = config or ControllerConfig()
         self.notifier = notifier or LogNotifier()
         self.metrics = metrics or Metrics()
+        # Cached observe path (k8s/informer.py): when set, reconcile
+        # passes read watch-fed snapshots instead of re-LISTing and
+        # re-parsing the world.  None = the relist-every-pass baseline;
+        # run_forever auto-creates one when the client can watch.
+        self.informer = informer
+        # Sticky staleness guard (_observe): node names a direct LIST
+        # saw that the informer's node cache has not delivered yet.
+        self._nodes_awaiting_cache: set[str] = set()
         # Actuators that do REST I/O surface their retry counters
         # through the controller's metrics registry (gcp.py GcpRest);
         # the real kube client does the same (kube_retries).
@@ -194,8 +203,10 @@ class Controller:
         # the planner would see neither the in-flight provision nor the new
         # supply and double-provision.
         self.actuator.poll(now)
-        nodes = [Node(p) for p in self.client.list_nodes()]
-        pods = [Pod(p) for p in self.client.list_pods()]
+        t_obs = time.perf_counter()
+        nodes, pods = self._observe()
+        self.metrics.observe("observe_seconds",
+                             time.perf_counter() - t_obs)
 
         pending = [p for p in pods if p.is_unschedulable]
         gangs = group_into_gangs(pending)
@@ -216,7 +227,10 @@ class Controller:
             for unit_id in claimed:
                 self._cancel_drain(unit_id, cancellable[unit_id])
             if claimed:
-                nodes = [Node(p) for p in self.client.list_nodes()]
+                # Mid-pass refresh after the uncordon patches — must
+                # bypass the informer cache (the watch hasn't delivered
+                # our own writes yet).
+                nodes = self._fresh_nodes()
 
         if not self.config.no_scale:
             self._scale(settled_gangs, nodes, pods, now)
@@ -283,23 +297,82 @@ class Controller:
             self.metrics.set_gauge(f"namespace_chips_used_{ns}", used)
         self._seen_namespaces |= set(ns_usage)
 
+    def _observe(self) -> tuple[list[Node], list[Pod]]:
+        """One pass's world view: informer snapshots when attached
+        (watch-fed cache, LIST fallback while unsynced), else the
+        relist-every-pass baseline.
+
+        Staleness guard: when a provision transitioned to ACTIVE since
+        its submission was recorded, the node side bypasses the cache —
+        the planner must see the new supply in the SAME pass the
+        provision stops being in-flight, and the node watch may not
+        have delivered it yet (the one ordering the crash-only loop
+        cannot recompute its way out of: it would double-provision).
+        The bypass is STICKY, not one-pass: the ACTIVE status (and its
+        ``_submitted_at`` entry) is gone by the next pass, but the
+        watch's delivery lag is independent of pass boundaries — a
+        wake-triggered pass milliseconds later would otherwise see
+        neither the in-flight provision nor the new supply.  So the
+        bypass persists until the node cache contains every node a
+        direct LIST sees (nodes the cache has EXTRA are fine: deletion
+        lag only defers reclaim by a pass).
+        """
+        if self.informer is None:
+            return ([Node(p) for p in self.client.list_nodes()],
+                    [Pod(p) for p in self.client.list_pods()])
+        just_active = any(
+            s.state == ACTIVE and s.id in self._submitted_at
+            for s in self.actuator.statuses())
+        if just_active or self._nodes_awaiting_cache:
+            nodes = self._fresh_nodes()
+            snap = self.informer.node_cache.snapshot()
+            if snap is None:
+                # Cache unsynced: node reads fall back to a direct LIST
+                # anyway, so there is no staleness to guard against.
+                self._nodes_awaiting_cache = set()
+            else:
+                self._nodes_awaiting_cache = (
+                    {n.name for n in nodes} - {n.name for n in snap})
+        else:
+            nodes = self.informer.nodes()
+        return nodes, self.informer.pods()
+
+    def _fresh_nodes(self) -> list[Node]:
+        """Direct LIST, bypassing the informer cache (memo-parsed, so
+        only nodes that actually changed are re-parsed)."""
+        from tpu_autoscaler.k8s.objects import parse_node
+
+        if self.informer is None:
+            return [Node(p) for p in self.client.list_nodes()]
+        self.metrics.inc("informer_bypass_lists")
+        return [parse_node(p) for p in self.client.list_nodes()]
+
     def run_forever(self, interval_seconds: float = 5.0,
                     watch: bool = True, leader_lock=None) -> None:
         """Reconcile loop (reference: main.py while True / sleep).
 
         The interval is seconds-scale, not the reference's 60 s — detection
         latency is part of the north-star budget — and when ``watch`` is on
-        a pod watch wakes the loop the instant demand changes, making the
-        interval only a fallback (controller/watch.py).  Each pass is
-        wrapped in a catch-all so the loop is crash-only (reference parity).
+        an informer (k8s/informer.py) both wakes the loop the instant
+        demand changes AND feeds reconcile passes from its watch-fed
+        cache, making the interval only a fallback and the observe path
+        O(churn) instead of O(cluster).  Each pass is wrapped in a
+        catch-all so the loop is crash-only (reference parity).
         """
         import threading
 
         wake = threading.Event()
-        if watch and hasattr(self.client, "watch_pods"):
-            from tpu_autoscaler.controller.watch import WatchTrigger
+        if watch and self.informer is None \
+                and hasattr(self.client, "watch_pods"):
+            from tpu_autoscaler.k8s.informer import ClusterInformer
 
-            WatchTrigger(self.client, wake, metrics=self.metrics).start()
+            self.informer = ClusterInformer(
+                self.client, wake=wake, metrics=self.metrics)
+            self.informer.start()
+        elif self.informer is not None:
+            # Injected informer: sleep on ITS wake event so its deltas
+            # still cut detection latency.
+            wake = self.informer.wake
         while True:
             try:
                 if leader_lock is not None and not leader_lock.try_acquire(

@@ -254,6 +254,16 @@ class RestKubeClient:
     def list_pods(self) -> list[dict]:
         return self._get("/api/v1/pods").get("items", [])
 
+    # Raw list verbs: the full List response, not just items — the
+    # informer needs the collection's metadata.resourceVersion as the
+    # only safe point to resume a watch from after a LIST (k8s/
+    # informer.py).  Same endpoints (and RBAC grants) as list_*.
+    def list_nodes_raw(self) -> dict:
+        return self._get("/api/v1/nodes")
+
+    def list_pods_raw(self) -> dict:
+        return self._get("/api/v1/pods")
+
     def patch_node(self, name: str, patch: dict) -> None:
         self._mutate("PATCH", f"/api/v1/nodes/{name}", patch,
                      content_type="application/strategic-merge-patch+json")
@@ -342,6 +352,22 @@ class RestKubeClient:
             max_attempts=self.LEASE_ATTEMPTS,
             backoff_cap_s=self.LEASE_BACKOFF_CAP_S,
             retry_after_cap_s=self.LEASE_RETRY_AFTER_CAP_S)
+        if r.status_code == 409 and not exists:
+            # A retried CREATE whose first attempt committed (response
+            # lost to a blip) answers 409 on the retry — the same
+            # lost-response window the PUT path closes via
+            # resourceVersion.  Re-read: if the lease's holder is the
+            # identity we just wrote, the create succeeded and we ARE
+            # the leader; failing here would make the winning candidate
+            # skip a lease cycle (ADVICE r5 #3).  Any other holder is a
+            # genuinely lost race, surfaced as the 409 below.
+            current = self.get_lease(namespace, name) or {}
+            ours = (body.get("spec") or {}).get("holderIdentity")
+            if ours is not None and (current.get("spec") or {}).get(
+                    "holderIdentity") == ours:
+                log.info("lease %s/%s create raced its own retry; "
+                         "holder is us — acquired", namespace, name)
+                return
         r.raise_for_status()
 
     def watch_pods(self, timeout_seconds: int = 60,
@@ -351,15 +377,28 @@ class RestKubeClient:
         Level-trigger upgrade over the reference's poll-sleep loop
         (main.py --sleep): the controller wakes the moment a pod changes
         instead of up to one poll period later.  Used via
-        ``tpu_autoscaler.controller.watch.WatchTrigger``.
+        ``tpu_autoscaler.k8s.informer`` (and the older
+        ``controller.watch.WatchTrigger``).
 
         ``resource_version`` resumes from a prior watch's cursor instead
         of replaying the world; bookmarks are requested so the cursor
         stays fresh across quiet periods.
         """
+        return self._watch("/api/v1/pods", timeout_seconds,
+                           resource_version)
+
+    def watch_nodes(self, timeout_seconds: int = 60,
+                    resource_version: str | None = None):
+        """Yield node watch events — the informer's supply-side feed
+        (slice hosts registering / going NotReady / being reclaimed)."""
+        return self._watch("/api/v1/nodes", timeout_seconds,
+                           resource_version)
+
+    def _watch(self, path: str, timeout_seconds: int,
+               resource_version: str | None):
         import json as _json
 
-        url = (f"{self._base}/api/v1/pods"
+        url = (f"{self._base}{path}"
                f"?watch=1&timeoutSeconds={timeout_seconds}"
                f"&allowWatchBookmarks=true")
         if resource_version:

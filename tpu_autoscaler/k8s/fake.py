@@ -11,14 +11,33 @@ north-star latency metric is exercised without a cluster.
 
 from __future__ import annotations
 
+import copy
 import itertools
+import threading
+import time
 
 from tpu_autoscaler.k8s.objects import Node, Pod
 from tpu_autoscaler.k8s.resources import ResourceVector
 
+#: Watch journal bound: events older than this many mutations are
+#: dropped; a watcher resuming from before the floor gets a 410 ERROR
+#: event, exactly like a real apiserver whose etcd window expired.
+_JOURNAL_MAX = 1000
+
 
 class FakeKube:
-    """Fake apiserver: payload-dict store implementing KubeClient."""
+    """Fake apiserver: payload-dict store implementing KubeClient.
+
+    Since ISSUE 2 it also models the two apiserver mechanisms the
+    informer (k8s/informer.py) is built on: every mutation bumps the
+    object's ``metadata.resourceVersion`` from one global sequence, and
+    ``watch_pods``/``watch_nodes`` stream ADDED/MODIFIED/DELETED events
+    from a bounded journal (Condition-signalled, so watch threads block
+    instead of spinning; resuming below the journal floor yields a 410
+    ERROR event).  Journaling only engages once the first watch is
+    opened — the pure poll-mode tests and the north-star overhead bench
+    pay one integer bump per mutation, nothing more.
+    """
 
     def __init__(self):
         self._nodes: dict[str, dict] = {}
@@ -31,6 +50,93 @@ class FakeKube:
         # (tests), plus declarative PodDisruptionBudgets (add_pdb).
         self.pdb_protected: set[tuple[str, str]] = set()
         self._pdbs: list[dict] = []
+        # Watch machinery: one global resourceVersion sequence, a
+        # bounded (seq, kind, type, payload-copy) journal, and a
+        # Condition watchers block on.  _journaling stays False (and the
+        # floor tracks the head) until the first watch_* call, so
+        # journal copies cost nothing in poll-only use.
+        self._watch_cond = threading.Condition()
+        self._last_seq = 0
+        self._journal: list[tuple[int, str, str, dict]] = []
+        self._journal_floor = 0
+        self._journaling = False
+
+    # ---- resourceVersion + watch journal -------------------------------
+
+    def _note_change(self, kind: str, payload: dict, etype: str) -> None:
+        """Record one mutation: bump the object's resourceVersion and,
+        when a watcher exists, append a snapshot to the journal.
+
+        The whole mutation happens under ``_watch_cond``: e2e tests
+        drive the fake from the controller thread AND the test thread,
+        so an unguarded ``_last_seq += 1`` could mint duplicate seqs —
+        breaking the ``seq > cursor`` resume invariant watchers rely on.
+        """
+        with self._watch_cond:
+            self._last_seq += 1
+            seq = self._last_seq
+            payload.setdefault("metadata", {})["resourceVersion"] = str(seq)
+            if not self._journaling:
+                self._journal_floor = seq
+                return
+            self._journal.append((seq, kind, etype,
+                                  copy.deepcopy(payload)))
+            if len(self._journal) > _JOURNAL_MAX:
+                dropped = self._journal[:-_JOURNAL_MAX]
+                self._journal = self._journal[-_JOURNAL_MAX:]
+                self._journal_floor = dropped[-1][0]
+            self._watch_cond.notify_all()
+
+    def watch_pods(self, timeout_seconds: int = 60,
+                   resource_version: str | None = None):
+        # Journaling engages at CALL time (like the HTTP request
+        # opening), not first iteration — mutations between opening the
+        # watch and consuming it must not be lost.
+        with self._watch_cond:
+            self._journaling = True
+        return self._watch("pods", timeout_seconds, resource_version)
+
+    def watch_nodes(self, timeout_seconds: int = 60,
+                    resource_version: str | None = None):
+        with self._watch_cond:
+            self._journaling = True
+        return self._watch("nodes", timeout_seconds, resource_version)
+
+    def _watch(self, kind: str, timeout_seconds: int,
+               resource_version: str | None):
+        """Stream journal events with seq > resource_version until the
+        window closes (generator return = server-side close).  No
+        resource_version means "from now" — the informer always relists
+        first and passes the list's resourceVersion."""
+        deadline = time.monotonic() + timeout_seconds
+        with self._watch_cond:
+            cursor = (int(resource_version) if resource_version
+                      else self._last_seq)
+        while True:
+            with self._watch_cond:
+                # Floor check EVERY round, not just at open: the journal
+                # can trim past a slow consumer's cursor mid-stream, and
+                # silently skipping the dropped events would hand the
+                # watcher a gapped view with no signal to relist.  (All
+                # yields happen outside the lock — a generator suspended
+                # mid-`with` would hold it across consumer code.)
+                gone = cursor < self._journal_floor
+                batch = [] if gone else [e for e in self._journal
+                                         if e[0] > cursor and e[1] == kind]
+                if not gone and not batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._watch_cond.wait(min(remaining, 0.05))
+                    continue
+            if gone:
+                yield {"type": "ERROR",
+                       "object": {"code": 410,
+                                  "message": "too old resource version"}}
+                return
+            for seq, _kind, etype, payload in batch:
+                cursor = seq
+                yield {"type": etype, "object": payload}
 
     # ---- KubeClient protocol -------------------------------------------
 
@@ -40,6 +146,14 @@ class FakeKube:
     def list_pods(self) -> list[dict]:
         return list(self._pods.values())
 
+    def list_nodes_raw(self) -> dict:
+        return {"metadata": {"resourceVersion": str(self._last_seq)},
+                "items": list(self._nodes.values())}
+
+    def list_pods_raw(self) -> dict:
+        return {"metadata": {"resourceVersion": str(self._last_seq)},
+                "items": list(self._pods.values())}
+
     def patch_node(self, name: str, patch: dict) -> None:
         self.verb_log.append(("patch_node", name, patch))
         node = self._nodes[name]
@@ -48,10 +162,13 @@ class FakeKube:
             node.setdefault("spec", {})["unschedulable"] = \
                 spec["unschedulable"]
         self._merge_meta(node, patch)
+        self._note_change("nodes", node, "MODIFIED")
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> None:
         self.verb_log.append(("patch_pod", namespace, name, patch))
-        self._merge_meta(self._pods[(namespace, name)], patch)
+        pod = self._pods[(namespace, name)]
+        self._merge_meta(pod, patch)
+        self._note_change("pods", pod, "MODIFIED")
 
     @staticmethod
     def _merge_meta(obj: dict, patch: dict) -> None:
@@ -74,7 +191,9 @@ class FakeKube:
             # blocks the disruption.
             raise RuntimeError("429: Cannot evict pod as it would violate "
                                "the pod's disruption budget.")
-        self._pods.pop((namespace, name), None)
+        gone = self._pods.pop((namespace, name), None)
+        if gone is not None:
+            self._note_change("pods", gone, "DELETED")
 
     def _pdb_blocks(self, namespace: str, name: str) -> bool:
         """Would evicting this pod violate a PodDisruptionBudget?
@@ -164,11 +283,15 @@ class FakeKube:
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self.verb_log.append(("delete_pod", namespace, name))
-        self._pods.pop((namespace, name), None)
+        gone = self._pods.pop((namespace, name), None)
+        if gone is not None:
+            self._note_change("pods", gone, "DELETED")
 
     def delete_node(self, name: str) -> None:
         self.verb_log.append(("delete_node", name))
-        self._nodes.pop(name, None)
+        gone = self._nodes.pop(name, None)
+        if gone is not None:
+            self._note_change("nodes", gone, "DELETED")
 
     def create_event(self, namespace: str, body: dict) -> None:
         self.events.append((namespace, body))
@@ -209,22 +332,28 @@ class FakeKube:
         payload.setdefault("metadata", {}).setdefault(
             "uid", f"fake-{next(self._uid)}")
         self._nodes[payload["metadata"]["name"]] = payload
+        self._note_change("nodes", payload, "ADDED")
 
     def add_pod(self, payload: dict) -> None:
         meta = payload.setdefault("metadata", {})
         meta.setdefault("uid", f"fake-{next(self._uid)}")
         self._pods[(meta.get("namespace", "default"), meta["name"])] = payload
+        self._note_change("pods", payload, "ADDED")
 
     def get_pod(self, namespace: str, name: str) -> dict | None:
         return self._pods.get((namespace, name))
 
     def set_node_ready(self, name: str, ready: bool) -> None:
-        conds = self._nodes[name]["status"].setdefault("conditions", [])
+        node = self._nodes[name]
+        conds = node["status"].setdefault("conditions", [])
         for c in conds:
             if c.get("type") == "Ready":
                 c["status"] = "True" if ready else "False"
-                return
-        conds.append({"type": "Ready", "status": "True" if ready else "False"})
+                break
+        else:
+            conds.append({"type": "Ready",
+                          "status": "True" if ready else "False"})
+        self._note_change("nodes", node, "MODIFIED")
 
     # ---- toy kube-scheduler --------------------------------------------
 
@@ -291,6 +420,7 @@ class FakeKube:
                         conds.append({"type": "PodScheduled",
                                       "status": "False",
                                       "reason": "Unschedulable"})
+                        self._note_change("pods", payload, "MODIFIED")
                 continue
             free = trial
             placed_by_node = trial_placed
@@ -300,5 +430,6 @@ class FakeKube:
                 payload["status"]["phase"] = "Running"
                 payload["status"]["conditions"] = [
                     {"type": "PodScheduled", "status": "True"}]
+                self._note_change("pods", payload, "MODIFIED")
                 bound += 1
         return bound

@@ -1,0 +1,353 @@
+"""Watch-fed object store: the cached observe path for the reconciler.
+
+``Controller.reconcile_once`` used to re-LIST and re-parse every node and
+pod from the apiserver on every pass.  At 60 s polls that was noise; the
+watch-triggered loop (controller/watch.py) wakes near-instantly, so at
+production scale (thousands of pods, 5 s fallback interval) state
+collection dominated both controller CPU and apiserver load.  This
+module generalizes ``WatchTrigger`` into an informer, the standard
+client-go shape:
+
+- one background thread per resource (pods, and nodes when the client
+  supports ``watch_nodes``) holds a watch open and applies
+  ADDED/MODIFIED/DELETED deltas to a lock-guarded ``ObjectCache``;
+- parsing happens once per delta via the (uid, resourceVersion)-memoized
+  ``parse_pod``/``parse_node`` (k8s/objects.py), so an unchanged object
+  is never re-parsed — a snapshot is an O(n) list copy of already-parsed
+  objects;
+- a 410 Gone (expired cursor) or any watch failure marks the cache
+  unsynced; the watch thread relists (full LIST, counted in
+  ``informer_relists``) and resumes watching from the list's
+  resourceVersion.  Crash-only: the cache is a pure optimization — a
+  cold start, a watch gap, or a crashed thread just mean the next read
+  falls back to a direct LIST (``informer_fallback_lists``) until the
+  watch re-syncs;
+- relevant deltas set the reconcile loop's wake Event, subsuming
+  WatchTrigger's level-trigger role.
+
+Consistency model (docs/INFORMER.md): snapshots are immutable lists of
+read-only objects, internally consistent as of the cache's cursor, and
+at most one watch-delivery behind the apiserver when healthy.  The two
+places the reconciler must not act on a stale view — supply that just
+went ACTIVE, and a drain cancelled mid-pass — bypass the cache with a
+direct LIST (reconciler.py ``_fresh_nodes``).
+
+Thread discipline (TAT2xx): the watch thread shares state with readers
+only through ``ObjectCache`` (every mutation under its Lock), the wake
+``threading.Event``, and the stop Event.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Any, Callable, Iterable, Mapping
+
+from tpu_autoscaler.backoff import watch_backoff_seconds
+
+log = logging.getLogger(__name__)
+
+#: Delta types that change reconcile-relevant state (BOOKMARK and ERROR
+#: events move the cursor / signal failure but carry no state change).
+RELEVANT_TYPES = frozenset({"ADDED", "MODIFIED", "DELETED"})
+
+
+class WatchGone(RuntimeError):
+    """410 Gone: the watch cursor expired; a full relist is required."""
+
+
+class WatchError(RuntimeError):
+    """An ERROR event on an otherwise-open stream (non-410)."""
+
+
+class ObjectCache:
+    """Lock-guarded store of one resource's payloads + parsed objects.
+
+    Written only by its resource's watch thread (replace/apply/
+    mark_unsynced); read by the reconcile thread (snapshot).  ``synced``
+    is False until the first successful relist and again after any
+    watch failure — readers fall back to a direct LIST while unsynced.
+    """
+
+    def __init__(self, kind: str,
+                 parse: Callable[[Mapping[str, Any]], Any]) -> None:
+        self.kind = kind
+        self._parse = parse
+        self._lock = threading.Lock()
+        self._objects: dict[str, dict] = {}
+        self._parsed: dict[str, Any] = {}
+        self._resource_version: str | None = None
+        self._synced = False
+
+    @staticmethod
+    def _key(obj: Mapping[str, Any]) -> str | None:
+        meta = obj.get("metadata") or {}
+        return meta.get("uid") or meta.get("name") or None
+
+    @property
+    def synced(self) -> bool:
+        with self._lock:
+            return self._synced
+
+    @property
+    def resource_version(self) -> str | None:
+        with self._lock:
+            return self._resource_version
+
+    def replace(self, items: Iterable[dict],
+                resource_version: str | None) -> None:
+        """Install a full LIST result (relist / initial sync)."""
+        objects: dict[str, dict] = {}
+        parsed: dict[str, Any] = {}
+        for item in items:
+            key = self._key(item)
+            if key is None:
+                continue
+            objects[key] = item
+            # Memoized on (uid, resourceVersion): a relist re-parses
+            # only objects that actually changed since last seen.
+            parsed[key] = self._parse(item)
+        with self._lock:
+            self._objects = objects
+            self._parsed = parsed
+            self._resource_version = resource_version
+            self._synced = True
+
+    def apply(self, event: Mapping[str, Any]) -> bool:
+        """Apply one watch event; True iff it changed relevant state.
+
+        Raises ``WatchGone`` on a 410 ERROR event (cursor expired, the
+        caller must relist) and ``WatchError`` on any other ERROR.
+        """
+        etype = event.get("type")
+        obj = event.get("object") or {}
+        if etype == "ERROR":
+            if obj.get("code") == 410:
+                raise WatchGone(str(obj.get("message", "410 Gone")))
+            raise WatchError(str(obj.get("message", "watch ERROR event")))
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        key = self._key(obj)
+        if etype in ("ADDED", "MODIFIED") and key is not None:
+            parsed = self._parse(obj)
+            with self._lock:
+                self._objects[key] = dict(obj)
+                self._parsed[key] = parsed
+                if rv:
+                    self._resource_version = rv
+            return True
+        with self._lock:
+            if etype == "DELETED" and key is not None:
+                self._objects.pop(key, None)
+                self._parsed.pop(key, None)
+            if rv:
+                # BOOKMARK (and DELETED) keep the cursor fresh.
+                self._resource_version = rv
+        return etype in RELEVANT_TYPES
+
+    def mark_unsynced(self) -> None:
+        """Watch failed or gapped: serve LIST fallbacks until relisted.
+
+        Keeps the cached content (the next relist reuses its parsed
+        objects through the memo) but drops the cursor — resuming a
+        possibly-gapped watch from the old cursor could miss deltas.
+        """
+        with self._lock:
+            self._synced = False
+            self._resource_version = None
+
+    def snapshot(self) -> list[Any] | None:
+        """Parsed objects as an immutable-by-convention list, or None
+        when unsynced (caller falls back to a direct LIST)."""
+        with self._lock:
+            if not self._synced:
+                return None
+            return list(self._parsed.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+class ResourceWatch(threading.Thread):
+    """One resource's relist+watch loop, feeding its ObjectCache.
+
+    Failure semantics match WatchTrigger (VERDICT r1 item 6): bounded
+    exponential backoff with full jitter, ``watch_failures`` counted,
+    only the first failure of a streak logged at WARNING.  On top of
+    that: every failure (and every 410) marks the cache unsynced so the
+    next loop iteration relists before re-watching — counted in
+    ``informer_relists``.
+    """
+
+    def __init__(self, cache: ObjectCache,
+                 list_fn: Callable[[], tuple[list[dict], str | None]],
+                 watch_fn: Callable[..., Iterable[Mapping[str, Any]]],
+                 wake: threading.Event | None = None,
+                 timeout_seconds: int = 60,
+                 resync_seconds: float = 900.0,
+                 metrics=None, rng: random.Random | None = None):
+        super().__init__(daemon=True, name=f"{cache.kind}-informer")
+        self._cache = cache
+        self._list = list_fn
+        self._watch = watch_fn
+        self._wake = wake
+        self._timeout = timeout_seconds
+        self._resync_seconds = resync_seconds
+        self._stopped = threading.Event()
+        self._metrics = metrics
+        self._rng = rng or random.Random()
+        self._failure_streak = 0
+        self._last_relist_mono: float | None = None
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    # -- internals, factored for testability (all thread-owned) ----------
+
+    def _backoff_seconds(self) -> float:
+        return watch_backoff_seconds(self._failure_streak, self._rng)
+
+    def _inc(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name)
+
+    def _relist(self) -> None:
+        items, rv = self._list()
+        self._cache.replace(items, rv)
+        self._inc("informer_relists")
+        self._last_relist_mono = time.monotonic()
+        if self._wake is not None:
+            # The world may have changed arbitrarily across the gap.
+            self._wake.set()
+
+    def _watch_once(self) -> None:
+        for event in self._watch(
+                self._timeout,
+                resource_version=self._cache.resource_version):
+            relevant = self._cache.apply(event)
+            self._inc("informer_events")
+            if relevant and self._wake is not None:
+                self._wake.set()
+            if self._stopped.is_set():
+                return
+
+    def _run_once(self) -> None:
+        due = (self._last_relist_mono is None
+               or time.monotonic() - self._last_relist_mono
+               >= self._resync_seconds)
+        if not self._cache.synced or due:
+            self._relist()
+        self._watch_once()
+
+    def run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self._run_once()
+                self._failure_streak = 0  # clean server-side close
+            except Exception as e:  # noqa: BLE001 — crash-only: degrade
+                # to LIST-fallback reads until the watch re-syncs
+                self._cache.mark_unsynced()
+                self._failure_streak += 1
+                self._inc("watch_failures")
+                level = (logging.WARNING if self._failure_streak == 1
+                         else logging.DEBUG)
+                log.log(level, "%s watch failed (streak %d): %s; relist "
+                        "+ retry with backoff", self._cache.kind,
+                        self._failure_streak, e,
+                        exc_info=self._failure_streak == 1)
+                if self._stopped.wait(self._backoff_seconds()):
+                    return
+
+
+def _list_with_rv(client, kind: str) -> tuple[list[dict], str | None]:
+    """Full LIST returning (items, collection resourceVersion).
+
+    Prefers the raw list verbs (which expose the collection's
+    resourceVersion — the only safe watch-resume point after a LIST);
+    clients without them still work, at the cost of the follow-up watch
+    starting from "now" (the periodic resync bounds the gap).
+    """
+    raw = getattr(client, f"list_{kind}_raw", None)
+    if raw is not None:
+        body = raw()
+        return (body.get("items", []),
+                (body.get("metadata") or {}).get("resourceVersion"))
+    return getattr(client, f"list_{kind}")(), None
+
+
+class ClusterInformer:
+    """Watch-fed pod + node store, the reconciler's observe source.
+
+    ``pods()``/``nodes()`` serve cached parsed snapshots when the watch
+    is healthy and fall back to a direct (memo-parsed) LIST when it is
+    not — never worse than the relist-every-pass baseline.  The node
+    watch is optional: against a client with only ``watch_pods`` the
+    pod side is cached and node reads always fall back.
+    """
+
+    def __init__(self, client, wake: threading.Event | None = None,
+                 metrics=None, timeout_seconds: int = 60,
+                 resync_seconds: float = 900.0,
+                 rng: random.Random | None = None):
+        from tpu_autoscaler.k8s.objects import parse_node, parse_pod
+
+        self._client = client
+        self._metrics = metrics
+        self.wake = wake if wake is not None else threading.Event()
+        self.pod_cache = ObjectCache("pods", parse_pod)
+        self.node_cache = ObjectCache("nodes", parse_node)
+        self._watches: list[ResourceWatch] = []
+        if hasattr(client, "watch_pods"):
+            self._watches.append(ResourceWatch(
+                self.pod_cache, lambda: _list_with_rv(client, "pods"),
+                client.watch_pods, wake=self.wake,
+                timeout_seconds=timeout_seconds,
+                resync_seconds=resync_seconds, metrics=metrics, rng=rng))
+        if hasattr(client, "watch_nodes"):
+            self._watches.append(ResourceWatch(
+                self.node_cache, lambda: _list_with_rv(client, "nodes"),
+                client.watch_nodes, wake=self.wake,
+                timeout_seconds=timeout_seconds,
+                resync_seconds=resync_seconds, metrics=metrics, rng=rng))
+
+    def start(self) -> None:
+        for w in self._watches:
+            w.start()
+
+    def stop(self) -> None:
+        for w in self._watches:
+            w.stop()
+
+    def pump(self) -> None:
+        """Synchronous drive: relist if unsynced/due, then drain the
+        pending watch events, once per resource.  For tests and
+        simulations that want informer semantics without threads —
+        construct with ``timeout_seconds=0`` so the drain doesn't
+        block, and never mix with ``start()``.
+        """
+        for w in self._watches:
+            w._run_once()
+
+    def pods(self):
+        """Parsed Pod snapshot (cache when synced, LIST fallback)."""
+        snap = self.pod_cache.snapshot()
+        if snap is not None:
+            return snap
+        return self._fallback("pods")
+
+    def nodes(self):
+        """Parsed Node snapshot (cache when synced, LIST fallback)."""
+        snap = self.node_cache.snapshot()
+        if snap is not None:
+            return snap
+        return self._fallback("nodes")
+
+    def _fallback(self, kind: str):
+        from tpu_autoscaler.k8s.objects import parse_node, parse_pod
+
+        if self._metrics is not None:
+            self._metrics.inc("informer_fallback_lists")
+        parse = parse_pod if kind == "pods" else parse_node
+        return [parse(p) for p in getattr(self._client, f"list_{kind}")()]

@@ -14,8 +14,10 @@ changes for the TPU-native design:
 
 from __future__ import annotations
 
+import collections
 import datetime
-from typing import TYPE_CHECKING, Any, Mapping, Sequence
+import threading
+from typing import TYPE_CHECKING, Any, Mapping, Sequence, TypeVar
 
 from tpu_autoscaler.k8s.resources import ResourceVector
 from tpu_autoscaler.topology.catalog import (
@@ -381,3 +383,75 @@ class Node:
 
     def __repr__(self) -> str:
         return f"Node({self.name}, type={self.instance_type})"
+
+
+# ---- memoized parsing --------------------------------------------------
+#
+# The informer observe path (k8s/informer.py) hands the reconciler the
+# same payloads pass after pass; re-running the ``Pod``/``Node``
+# constructors on unchanged objects was the dominant per-pass cost at
+# cluster scale (ISSUE 2).  The apiserver guarantees that
+# (uid, resourceVersion) identifies one immutable version of an object,
+# so parsing is memoized on exactly that key.  Payloads missing either
+# field (hand-built test fixtures, fakes that don't track versions)
+# parse fresh every time — memoization is a pure optimization and
+# opting out is always correct.
+#
+# The caches are bounded LRU (eviction on insert) and guarded by one
+# lock: the informer's watch threads parse at delta-apply time while
+# the reconcile thread parses on its fallback/refresh LIST path.
+
+_PARSE_CACHE_MAX = 16384
+
+_T = TypeVar("_T", "Pod", "Node")
+
+_parse_lock = threading.Lock()
+_pod_cache: collections.OrderedDict[tuple[str, str], Pod] = \
+    collections.OrderedDict()
+_node_cache: collections.OrderedDict[tuple[str, str], Node] = \
+    collections.OrderedDict()
+
+
+def _parse_memoized(cache: collections.OrderedDict[tuple[str, str], _T],
+                    cls: type[_T], payload: Mapping[str, Any]) -> _T:
+    meta = payload.get("metadata") or {}
+    uid = meta.get("uid")
+    rv = meta.get("resourceVersion")
+    if not uid or not rv:
+        return cls(payload)
+    key = (uid, rv)
+    with _parse_lock:
+        hit = cache.get(key)
+        if hit is not None:
+            cache.move_to_end(key)
+            return hit
+    obj = cls(payload)
+    with _parse_lock:
+        cache[key] = obj
+        cache.move_to_end(key)
+        while len(cache) > _PARSE_CACHE_MAX:
+            cache.popitem(last=False)
+    return obj
+
+
+def parse_pod(payload: Mapping[str, Any]) -> Pod:
+    """Dict → ``Pod``, memoized on (uid, resourceVersion)."""
+    return _parse_memoized(_pod_cache, Pod, payload)
+
+
+def parse_node(payload: Mapping[str, Any]) -> Node:
+    """Dict → ``Node``, memoized on (uid, resourceVersion)."""
+    return _parse_memoized(_node_cache, Node, payload)
+
+
+def parse_cache_info() -> dict[str, int]:
+    """Current cache sizes (tests + the observe-path bench)."""
+    with _parse_lock:
+        return {"pods": len(_pod_cache), "nodes": len(_node_cache)}
+
+
+def clear_parse_caches() -> None:
+    """Drop both memo caches (test isolation)."""
+    with _parse_lock:
+        _pod_cache.clear()
+        _node_cache.clear()

@@ -356,13 +356,16 @@ class SpeculativePagedBatcher(PagedBatcher):
             ke = int(k_eff[i])
             emitted, n_acc = self._accept_row(T[i], drafts[:, i],
                                               qs[:, i], req, ke)
-            self.accepted_tokens += n_acc
             # eos truncation: stop at the first eos emitted.
             if req.eos_id is not None:
                 for j, t in enumerate(emitted):
                     if t == req.eos_id:
                         emitted = emitted[:j + 1]
                         break
+            # Accepted-token accounting AFTER truncation: drafts past
+            # the eos were never used, and counting them overstated
+            # accept_rate for eos-terminating sequences (ADVICE r5 #4).
+            self.accepted_tokens += min(n_acc, len(emitted))
             req.generated.extend(emitted)
             self.decode_tokens += len(emitted)
             m = len(emitted)

@@ -73,6 +73,12 @@ class ByteBPE:
 
     def __init__(self, merges: list[tuple[int, int]]):
         self.merges = [tuple(m) for m in merges]
+        # The vocab_size train() was ASKED for — may exceed the actual
+        # vocab when training stopped early (min_count).  Persisted in
+        # tokenizer.json so build_shard's cache check can recognize an
+        # early-stopped tokenizer instead of silently re-training on
+        # every invocation (ADVICE r5 #2).
+        self.requested_vocab_size: int | None = None
         # id -> bytes expansion table.
         table: list[bytes] = [bytes([i]) for i in range(N_BYTES)]
         for a, b in self.merges:
@@ -104,7 +110,9 @@ class ByteBPE:
             new_id = N_BYTES + len(merges)
             merges.append((int(a), int(b)))
             arr = _merge_pair(arr, int(a), int(b), new_id)
-        return cls(merges)
+        bpe = cls(merges)
+        bpe.requested_vocab_size = vocab_size
+        return bpe
 
     # ---- encode / decode ----------------------------------------------
 
@@ -129,10 +137,13 @@ class ByteBPE:
     # ---- persistence ---------------------------------------------------
 
     def save(self, path: str) -> None:
+        obj = {"format": "byte-bpe-v1",
+               "vocab_size": self.vocab_size,
+               "merges": [list(m) for m in self.merges]}
+        if self.requested_vocab_size is not None:
+            obj["requested_vocab_size"] = self.requested_vocab_size
         with open(path, "w") as f:
-            json.dump({"format": "byte-bpe-v1",
-                       "vocab_size": self.vocab_size,
-                       "merges": [list(m) for m in self.merges]}, f)
+            json.dump(obj, f)
 
     @classmethod
     def load(cls, path: str) -> "ByteBPE":
@@ -140,7 +151,9 @@ class ByteBPE:
             obj = json.load(f)
         if obj.get("format") != "byte-bpe-v1":
             raise ValueError(f"{path}: not a byte-bpe-v1 tokenizer file")
-        return cls([tuple(m) for m in obj["merges"]])
+        bpe = cls([tuple(m) for m in obj["merges"]])
+        bpe.requested_vocab_size = obj.get("requested_vocab_size")
+        return bpe
 
 
 def build_shard(corpus_path: str, tokenizer_path: str, shard_path: str,
@@ -158,7 +171,13 @@ def build_shard(corpus_path: str, tokenizer_path: str, shard_path: str,
     if os.path.exists(tokenizer_path):
         try:
             cached = ByteBPE.load(tokenizer_path)
-            if cached.vocab_size == vocab_size:
+            # Match on the REQUESTED vocab when recorded: an
+            # early-stopped (min_count) tokenizer's actual vocab never
+            # equals the request, and without this it re-trained —
+            # silently, slowly — on every invocation (ADVICE r5 #2).
+            # Files predating the field keep the actual-vocab check.
+            if vocab_size in (cached.requested_vocab_size,
+                              cached.vocab_size):
                 bpe = cached
         except (ValueError, KeyError, json.JSONDecodeError):
             bpe = None
