@@ -447,11 +447,153 @@ def check_observe_path() -> bool:
     return ok
 
 
+# Actuation tier (ISSUE 3): one reconcile pass's actuation wall-clock at
+# a busy-fleet working set — 64 in-flight provisions being polled plus 16
+# new submissions — against a latency-injecting fake Cloud TPU transport
+# charging one real RTT per HTTP call.  Serial baseline = the
+# pre-executor behavior (blocking POSTs, per-id GET polling):
+# O(in-flight + new) RTTs.  Pipelined = ActuationExecutor dispatch + ONE
+# batched queuedResources LIST: ~1 RTT.  Gate: >= 10x.
+ACTUATE_IN_FLIGHT = 64
+ACTUATE_NEW = 16
+ACTUATE_RTT_S = 0.05
+ACTUATE_WORKERS = 16
+ACTUATE_SPEEDUP_FLOOR = 10.0
+
+
+class _LatencyQrTransport:
+    """requests-shaped fake Cloud TPU API charging ``rtt_s`` of real
+    wall-clock per call.  Thread-safe: executor workers call it
+    concurrently (list.append is atomic; state is append-only)."""
+
+    class _Resp:
+        status_code = 200
+        headers: dict = {}
+        content = b"{}"
+
+        def __init__(self, body):
+            self._body = body
+
+        def json(self):
+            return self._body
+
+    def __init__(self, rtt_s: float = 0.0):
+        self.rtt_s = rtt_s
+        self.calls: list = []
+        self._created: list = []
+
+    def __call__(self, method, url, headers=None, json=None, timeout=None):
+        if self.rtt_s:
+            time.sleep(self.rtt_s)
+        self.calls.append((method, url))
+        if method == "POST":
+            self._created.append(url.rsplit("queuedResourceId=", 1)[-1])
+            return self._Resp({})
+        if "pageSize" in url:  # batched LIST
+            return self._Resp({"queuedResources": [
+                {"name": f"p/queuedResources/{qid}",
+                 "state": {"state": "ACTIVE"}}
+                for qid in list(self._created)]})
+        return self._Resp({"state": {"state": "ACTIVE"}})  # per-id GET
+
+
+def bench_actuation_path() -> dict:
+    from tpu_autoscaler.actuators.executor import ActuationExecutor
+    from tpu_autoscaler.actuators.gcp import GcpRest, TokenProvider
+    from tpu_autoscaler.actuators.queued_resources import (
+        QueuedResourceActuator,
+    )
+    from tpu_autoscaler.engine.planner import ProvisionRequest
+
+    def make(batch_poll, executor=None):
+        tp = TokenProvider()
+        tp._token, tp._expires_at = "bench-token", time.time() + 3600.0
+        transport = _LatencyQrTransport()
+        rest = GcpRest(token_provider=tp, transport=transport,
+                       sleep=lambda s: None)
+        act = QueuedResourceActuator(project="bench", zone="z", rest=rest,
+                                     executor=executor,
+                                     batch_poll=batch_poll)
+        return act, transport
+
+    def req(i):
+        return ProvisionRequest(kind="tpu-slice", shape_name="v5e-8",
+                                gang_key=("job", "bench", f"g{i}"))
+
+    # -- serial baseline: blocking POSTs + per-id GET polling ------------
+    act, transport = make(batch_poll=False)
+    for i in range(ACTUATE_IN_FLIGHT):
+        act.provision(req(i))          # RTT off while seeding in-flight
+    transport.rtt_s = ACTUATE_RTT_S
+    t0 = time.perf_counter()
+    act.poll(0.0)                      # 64 serial GETs
+    for i in range(ACTUATE_NEW):
+        act.provision(req(1000 + i))   # 16 serial, blocking POSTs
+    serial_s = time.perf_counter() - t0
+    assert sum(1 for s in act.statuses()
+               if s.state == "ACTIVE") == ACTUATE_IN_FLIGHT
+
+    # -- pipelined: executor dispatch + ONE batched LIST -----------------
+    executor = ActuationExecutor(max_workers=ACTUATE_WORKERS)
+    act2, transport2 = make(batch_poll=True, executor=executor)
+    for i in range(ACTUATE_IN_FLIGHT):
+        act2.provision(req(i))
+    executor.wait(timeout=30)
+    executor.drain()                   # creates land -> pollable
+    transport2.rtt_s = ACTUATE_RTT_S
+    t0 = time.perf_counter()
+    act2.poll(0.0)                     # dispatches ONE LIST
+    for i in range(ACTUATE_NEW):
+        act2.provision(req(1000 + i))  # 16 concurrent POST dispatches
+    executor.wait(timeout=30)
+    executor.drain()                   # everything applied on the drain
+    piped_s = time.perf_counter() - t0
+    executor.shutdown()
+    assert sum(1 for s in act2.statuses()
+               if s.state == "ACTIVE") == ACTUATE_IN_FLIGHT
+    assert len(act2._created) == ACTUATE_IN_FLIGHT + ACTUATE_NEW
+
+    return {
+        "info": "actuation_path",
+        "in_flight": ACTUATE_IN_FLIGHT, "new": ACTUATE_NEW,
+        "rtt_ms": ACTUATE_RTT_S * 1e3, "workers": ACTUATE_WORKERS,
+        "serial_ms": round(serial_s * 1e3, 1),
+        "pipelined_ms": round(piped_s * 1e3, 1),
+        "speedup": round(serial_s / piped_s, 1) if piped_s > 0 else None,
+        "floor": ACTUATE_SPEEDUP_FLOOR,
+    }
+
+
+def check_actuation_path() -> tuple[bool, dict]:
+    """Gate: pipelined actuation pass >= ACTUATE_SPEEDUP_FLOOR x faster
+    than the serial baseline at the busy-fleet working set."""
+    info = bench_actuation_path()
+    print(json.dumps(info), file=sys.stderr)
+    ok = (info.get("speedup") or 0) >= ACTUATE_SPEEDUP_FLOOR
+    if not ok:
+        print(json.dumps({"error": "actuation-path regression: pipelined "
+                          "speedup below floor", **info}), file=sys.stderr)
+    return ok, info
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "observe":
         # Observe tier only (scripts/full_suite.sh): sub-second gate.
         return 0 if check_observe_path() else 1
+    if argv and argv[0] == "actuate":
+        # Actuation tier only (scripts/full_suite.sh): ~4 s (the serial
+        # baseline honestly pays its 80 RTTs).  Emits the measured
+        # speedup as a BENCH-record-style metric line on stdout.
+        ok, info = check_actuation_path()
+        print(json.dumps({
+            "metric": "actuation_pipeline_speedup",
+            "value": info["speedup"],
+            "unit": "x_vs_serial",
+            "vs_baseline": round((info["speedup"] or 0)
+                                 / ACTUATE_SPEEDUP_FLOOR, 2),
+        }))
+        return 0 if ok else 1
     if not check_all_configs():
         print(json.dumps({"error": "a BASELINE config failed"}),
               file=sys.stderr)
@@ -462,6 +604,8 @@ def main(argv: list[str] | None = None) -> int:
                           "realistic actuation latency"}), file=sys.stderr)
         return 1
     if not check_observe_path():
+        return 1
+    if not check_actuation_path()[0]:
         return 1
     # Informational (stderr: stdout is ONE metric line by contract) —
     # except decision parity, which is a hard gate.

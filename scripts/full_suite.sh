@@ -21,6 +21,12 @@ cd "$(dirname "$0")/.."
 # sub-second, so it runs before the test splits.
 JAX_PLATFORMS=cpu python bench.py observe
 
+# Actuation tier: pipelined executor (pooled dispatch + ONE batched
+# LIST poll) vs the serial blocking baseline at 64 in-flight / 16 new
+# provisions with 50 ms injected RTT must hold the >= 10x floor
+# (ISSUE 3; ~4 s — the serial baseline honestly pays its 80 RTTs).
+JAX_PLATFORMS=cpu python bench.py actuate
+
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py
   --ignore=tests/test_sp.py --ignore=tests/test_pipeline.py

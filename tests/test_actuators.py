@@ -2,16 +2,21 @@
 reference parity: Azure SDK replaced with mocks, asserts on the *calls*
 (SURVEY.md §5 'Cloud mocked, never called')."""
 
+import functools
+
 import pytest
 
 from tpu_autoscaler.actuators.base import ACTIVE, FAILED, PROVISIONING
+from tpu_autoscaler.actuators.gcp import GcpApiError
 from tpu_autoscaler.actuators.gke import GkeNodePoolActuator
 from tpu_autoscaler.actuators.queued_resources import QueuedResourceActuator
 from tpu_autoscaler.engine.planner import ProvisionRequest
 
 
 class FakeRest:
-    """Stands in for GcpRest; canned responses, recorded calls."""
+    """Stands in for GcpRest; canned responses, recorded calls.
+    Implements both dispatch modes: the blocking verbs AND the
+    executor-facing once()/dispatch() the pipelined path uses."""
 
     dry_run = False
 
@@ -19,9 +24,13 @@ class FakeRest:
         self.calls = []
         self._get_responses = dict(get_responses or {})
         self.counters = {}
+        self.observed = {}
 
     def inc(self, name):
         self.counters[name] = self.counters.get(name, 0) + 1
+
+    def observe(self, name, value):
+        self.observed.setdefault(name, []).append(value)
 
     def post(self, url, body):
         self.calls.append(("POST", url, body))
@@ -32,12 +41,40 @@ class FakeRest:
         self.calls.append(("GET", url, None))
         for key, resp in self._get_responses.items():
             if key in url:
+                if isinstance(resp, Exception):
+                    raise resp
                 return resp
         return {}
 
     def delete(self, url):
         self.calls.append(("DELETE", url, None))
         return {}
+
+    def once(self, method, url, body=None):
+        if method == "POST":
+            return self.post(url, body)
+        if method == "DELETE":
+            return self.delete(url)
+        return self.get(url)
+
+    def dispatch(self, executor, method, url, body=None, *, on_done,
+                 label=""):
+        if self.dry_run and method in ("POST", "DELETE"):
+            on_done({}, None)
+            return
+        executor.submit(functools.partial(self.once, method, url, body),
+                        on_done, label=label)
+
+
+#: GKE operations-LIST response matching FakeRest.post's op name.
+OPS_LIST_DONE = {"operations": [
+    {"name": "projects/p/locations/l/operations/op-1", "status": "DONE"}]}
+
+
+def qr_list_response(*qr_entries):
+    return {"queuedResources": [
+        {"name": f"projects/p/locations/us-central2-b/queuedResources/{qid}",
+         "state": {"state": state}} for qid, state in qr_entries]}
 
 
 def tpu_request(shape="v5e-64", preemptible=False):
@@ -98,23 +135,52 @@ class TestGkeActuator:
         assert len(names) == 3
 
     def test_poll_operation_done(self):
-        rest = FakeRest(get_responses={"operations/op-1":
-                                       {"status": "DONE"}})
+        # Batched polling: ONE operations LIST resolves the provision.
+        rest = FakeRest(get_responses={"/operations": OPS_LIST_DONE})
         act, _ = self.make(rest)
         status = act.provision(tpu_request())
         act.poll(now=10.0)
         assert status.state == ACTIVE
         assert status.unit_ids == [status.id]
+        gets = [c for c in rest.calls if c[0] == "GET"]
+        assert len(gets) == 1
+        assert gets[0][1].endswith(
+            "/projects/p/locations/us-central2-b/operations")
 
     def test_poll_operation_error(self):
-        rest = FakeRest(get_responses={
-            "operations/op-1": {"status": "DONE",
-                                "error": {"message": "quota"}}})
+        rest = FakeRest(get_responses={"/operations": {"operations": [
+            {"name": "projects/p/locations/l/operations/op-1",
+             "status": "DONE", "error": {"message": "quota"}}]}})
         act, _ = self.make(rest)
         status = act.provision(tpu_request())
         act.poll(now=10.0)
         assert status.state == FAILED
         assert "quota" in status.error
+
+    def test_poll_list_unavailable_falls_back_to_per_op_get(self):
+        # LIST 404 (old API surface / restrictive IAM): the SAME pass
+        # falls back to per-op GETs, and later passes skip the LIST.
+        rest = FakeRest(get_responses={
+            "locations/us-central2-b/operations": GcpApiError(
+                404, "https://gke/operations", "not found"),
+            "operations/op-1": {"status": "DONE"}})
+        act, _ = self.make(rest)
+        status = act.provision(tpu_request())
+        act.poll(now=1.0)
+        assert status.state == ACTIVE
+        act.provision(tpu_request())
+        list_gets = [c for c in rest.calls if c[0] == "GET"
+                     and c[1].endswith("us-central2-b/operations")]
+        act.poll(now=2.0)
+        assert [c for c in rest.calls if c[0] == "GET"
+                and c[1].endswith("us-central2-b/operations")] == list_gets
+
+    def test_poll_batch_size_observed(self):
+        rest = FakeRest(get_responses={"/operations": OPS_LIST_DONE})
+        act, _ = self.make(rest)
+        act.provision(tpu_request())
+        act.poll(now=1.0)
+        assert rest.observed["poll_batch_size"] == [1]
 
     def test_post_failure_is_failed_status(self):
         class BoomRest(FakeRest):
@@ -198,8 +264,7 @@ class TestGkeActuator:
         assert rest.calls[-1][1].endswith("/nodePools/tpuas-v5e-64-7")
 
     def test_terminal_status_pruned(self):
-        rest = FakeRest(get_responses={"operations/op-1":
-                                       {"status": "DONE"}})
+        rest = FakeRest(get_responses={"/operations": OPS_LIST_DONE})
         act, _ = self.make(rest)
         act.provision(tpu_request())
         act.poll(now=0.0)
@@ -234,18 +299,21 @@ class TestQueuedResourceActuator:
                                            shape_name="e2-standard-8"))
 
     def test_poll_state_mapping(self):
-        rest = FakeRest(get_responses={"queuedResources/": {
-            "state": {"state": "ACTIVE"}}})
-        act, _ = self.make(rest)
+        # Batched polling: ONE queuedResources LIST covers every id.
+        act, rest = self.make()
         status = act.provision(tpu_request("v5e-64"))
+        rest._get_responses["queuedResources?"] = qr_list_response(
+            (status.id, "ACTIVE"))
         act.poll(now=5.0)
         assert status.state == ACTIVE
+        gets = [c for c in rest.calls if c[0] == "GET"]
+        assert len(gets) == 1 and "pageSize" in gets[0][1]
 
     def test_poll_failed_state(self):
-        rest = FakeRest(get_responses={"queuedResources/": {
-            "state": {"state": "SUSPENDED"}}})
-        act, _ = self.make(rest)
+        act, rest = self.make()
         status = act.provision(tpu_request("v5e-64"))
+        rest._get_responses["queuedResources?"] = qr_list_response(
+            (status.id, "SUSPENDED"))
         act.poll(now=5.0)
         assert status.state == FAILED
 
@@ -267,10 +335,10 @@ class TestQueuedResourceActuator:
         assert spec["multisliceParams"]["nodeIdPrefix"]
 
     def test_multislice_active_reports_member_units(self):
-        rest = FakeRest(get_responses={"queuedResources/": {
-            "state": {"state": "ACTIVE"}}})
-        act, _ = self.make(rest)
+        act, rest = self.make()
         status = act.provision(self.multislice_request(count=2))
+        rest._get_responses["queuedResources?"] = qr_list_response(
+            (status.id, "ACTIVE"))
         act.poll(now=5.0)
         assert status.state == ACTIVE
         assert status.unit_ids == [f"{status.id}-0", f"{status.id}-1"]
@@ -298,6 +366,463 @@ class TestQueuedResourceActuator:
         # Second member delete is a no-op (owner mapping cleared).
         act.delete(f"{status.id}-0")
         assert len([c for c in rest.calls if c[0] == "DELETE"]) == 1
+
+
+class TestQueuedResourceBatchedPoll:
+    def make(self, rest=None, **kw):
+        rest = rest or FakeRest()
+        return QueuedResourceActuator(project="p", zone="us-central2-b",
+                                      rest=rest, **kw), rest
+
+    def test_one_list_covers_many_in_flight(self):
+        act, rest = self.make()
+        statuses = [act.provision(tpu_request("v5e-8")) for _ in range(5)]
+        rest._get_responses["queuedResources?"] = qr_list_response(
+            *[(s.id, "ACTIVE") for s in statuses])
+        act.poll(now=1.0)
+        assert all(s.state == ACTIVE for s in statuses)
+        gets = [c for c in rest.calls if c[0] == "GET"]
+        assert len(gets) == 1  # ONE LIST, not 5 per-id GETs
+        assert rest.observed["poll_batch_size"] == [5]
+
+    def test_list_pagination_followed_with_token_encoding(self):
+        act, rest = self.make()
+        s1 = act.provision(tpu_request("v5e-8"))
+        s2 = act.provision(tpu_request("v5e-8"))
+        page1 = qr_list_response((s1.id, "ACTIVE"))
+        # Opaque token with reserved characters: must be URL-encoded or
+        # the server's 400 would permanently disable batched polling.
+        page1["nextPageToken"] = "pa+ge/2=="
+        rest._get_responses["pageToken=pa%2Bge%2F2%3D%3D"] = \
+            qr_list_response((s2.id, "ACTIVE"))
+        rest._get_responses["queuedResources?"] = page1
+        act.poll(now=1.0)
+        assert s1.state == ACTIVE and s2.state == ACTIVE
+        assert len([c for c in rest.calls if c[0] == "GET"]) == 2
+
+    def test_failed_status_pruning_clears_ownership_bookkeeping(self):
+        # A FAILED provision's unit-owner/count entries must not leak
+        # past retention (chronic stockout = fresh qr_id every retry).
+        act, rest = self.make()
+        status = act.provision(ProvisionRequest(
+            kind="tpu-slice", shape_name="v5p-128", count=2,
+            gang_key=("jobset", "default", "ms")))
+        rest._get_responses["queuedResources?"] = qr_list_response(
+            (status.id, "SUSPENDED"))
+        act.poll(now=0.0)
+        assert status.state == FAILED
+        assert status.id in act._unit_owner
+        act.poll(now=act.STATUS_RETENTION_SECONDS + 1)
+        assert act.statuses() == []
+        assert act._unit_owner == {} and act._qr_counts == {}
+
+    def test_list_unavailable_falls_back_to_per_id_gets(self):
+        act, rest = self.make()
+        status = act.provision(tpu_request("v5e-8"))
+        rest._get_responses["queuedResources?"] = GcpApiError(
+            404, "https://tpu/queuedResources", "no list here")
+        rest._get_responses[f"queuedResources/{status.id}"] = {
+            "state": {"state": "ACTIVE"}}
+        act.poll(now=1.0)  # LIST 404 -> same-pass per-id fallback
+        assert status.state == ACTIVE
+        assert rest.counters["poll_list_fallbacks"] == 1
+        s2 = act.provision(tpu_request("v5e-8"))
+        rest._get_responses[f"queuedResources/{s2.id}"] = {
+            "state": {"state": "ACTIVE"}}
+        before = len([c for c in rest.calls if "pageSize" in c[1]])
+        act.poll(now=2.0)  # fallback is sticky: no LIST retried
+        assert len([c for c in rest.calls if "pageSize" in c[1]]) == before
+        assert s2.state == ACTIVE
+
+    def test_transient_list_failure_keeps_list_mode(self):
+        act, rest = self.make()
+        status = act.provision(tpu_request("v5e-8"))
+        rest._get_responses["queuedResources?"] = GcpApiError(
+            503, "https://tpu/queuedResources", "hiccup")
+        act.poll(now=1.0)
+        assert status.state == "ACCEPTED"  # nothing applied this pass
+        assert rest.counters["actuator_poll_errors"] == 1
+        rest._get_responses["queuedResources?"] = qr_list_response(
+            (status.id, "ACTIVE"))
+        act.poll(now=2.0)  # LIST mode retained and works again
+        assert status.state == ACTIVE
+
+    def test_absent_from_consecutive_lists_confirms_then_fails(self):
+        from tpu_autoscaler.actuators.queued_resources import (
+            LIST_MISS_THRESHOLD,
+        )
+
+        act, rest = self.make()
+        status = act.provision(tpu_request("v5e-8"))
+        rest._get_responses["queuedResources?"] = qr_list_response()
+        rest._get_responses[f"queuedResources/{status.id}"] = GcpApiError(
+            404, "https://tpu/queuedResources/x", "gone")
+        for i in range(LIST_MISS_THRESHOLD - 1):
+            act.poll(now=float(i))
+            # One miss could be read-after-write lag: still in flight,
+            # and no per-id confirm GET issued yet.
+            assert status.in_flight
+            assert not [c for c in rest.calls
+                        if c[0] == "GET" and status.id in c[1]]
+        act.poll(now=10.0)  # threshold hit -> per-id confirm GET -> 404
+        assert status.state == FAILED
+        assert status.reason == "deleted-out-of-band"
+        assert "deleted out of band" in status.error
+        assert [c for c in rest.calls
+                if c[0] == "GET" and status.id in c[1]]
+
+    def test_list_absence_with_healthy_get_is_not_failed(self):
+        # LIST index lagging writes: the confirm GET finds the QR, so
+        # absence from N LISTs must NOT kill it (no false
+        # deleted-out-of-band, no double-provision).
+        act, rest = self.make()
+        status = act.provision(tpu_request("v5e-8"))
+        rest._get_responses["queuedResources?"] = qr_list_response()
+        rest._get_responses[f"queuedResources/{status.id}"] = {
+            "state": {"state": "PROVISIONING"}}
+        for i in range(5):
+            act.poll(now=float(i))
+        assert status.in_flight
+        assert status.state == PROVISIONING  # confirm GET applied state
+
+    def test_reappearing_resets_miss_count(self):
+        act, rest = self.make()
+        status = act.provision(tpu_request("v5e-8"))
+        rest._get_responses["queuedResources?"] = qr_list_response()
+        act.poll(now=1.0)  # miss 1
+        rest._get_responses["queuedResources?"] = qr_list_response(
+            (status.id, "PROVISIONING"))
+        act.poll(now=2.0)  # found again: miss count resets
+        rest._get_responses["queuedResources?"] = qr_list_response()
+        act.poll(now=3.0)  # miss 1 again, not 2
+        assert status.in_flight
+
+    def test_per_id_get_404_is_terminal(self):
+        # Satellite: a 404 (deleted out of band) must NOT be re-polled
+        # forever as transient — classify terminal so the demand
+        # re-provisions.
+        act, rest = self.make(batch_poll=False)
+        status = act.provision(tpu_request("v5e-8"))
+        rest._get_responses[f"queuedResources/{status.id}"] = GcpApiError(
+            404, "https://tpu/queuedResources/x", "gone")
+        act.poll(now=1.0)
+        assert status.state == FAILED
+        assert status.reason == "deleted-out-of-band"
+        gets_before = len(rest.calls)
+        act.poll(now=2.0)  # terminal: not polled again
+        assert len(rest.calls) == gets_before
+
+    def test_per_id_get_transient_error_still_retries(self):
+        act, rest = self.make(batch_poll=False)
+        status = act.provision(tpu_request("v5e-8"))
+        rest._get_responses[f"queuedResources/{status.id}"] = GcpApiError(
+            503, "https://tpu/queuedResources/x", "hiccup")
+        act.poll(now=1.0)
+        assert status.in_flight
+        assert rest.counters["actuator_poll_errors"] == 1
+
+
+def make_executor(**kw):
+    from tpu_autoscaler.actuators.executor import ActuationExecutor
+
+    return ActuationExecutor(max_workers=4, **kw)
+
+
+def settle(executor, act, now=0.0, rounds=3):
+    """Wait for dispatched futures, drain, and re-poll a few rounds —
+    the reconcile loop's drain-then-poll cadence, compressed."""
+    for i in range(rounds):
+        executor.wait()
+        executor.drain()
+        act.poll(now + i)
+
+
+class TestQueuedResourceExecutorMode:
+    def make(self, rest=None):
+        rest = rest or FakeRest()
+        executor = make_executor()
+        act = QueuedResourceActuator(project="p", zone="us-central2-b",
+                                     rest=rest, executor=executor)
+        return act, rest, executor
+
+    def test_provision_dispatches_nonblocking_then_polls_active(self):
+        act, rest, executor = self.make()
+        try:
+            status = act.provision(tpu_request("v5e-8"))
+            # Submission returned without the POST necessarily applied;
+            # the status is in flight either way (planner sees it).
+            assert status.state == "ACCEPTED"
+            executor.wait()
+            executor.drain()  # create POST lands -> pollable
+            assert [c[0] for c in rest.calls] == ["POST"]
+            rest._get_responses["queuedResources?"] = qr_list_response(
+                (status.id, "ACTIVE"))
+            act.poll(now=1.0)   # dispatches the LIST
+            executor.wait()
+            executor.drain()    # LIST result applied on drain
+            assert status.state == ACTIVE
+        finally:
+            executor.shutdown()
+
+    def test_poll_never_piles_up_lists(self):
+        act, rest, executor = self.make()
+        try:
+            act.provision(tpu_request("v5e-8"))
+            executor.wait()
+            executor.drain()
+            act.poll(now=1.0)
+            act.poll(now=2.0)  # previous LIST not drained yet: no pile-up
+            executor.wait()
+            assert len([c for c in rest.calls if c[0] == "GET"]) == 1
+        finally:
+            executor.shutdown()
+
+    def test_create_failure_surfaces_as_failed_status(self):
+        class BoomRest(FakeRest):
+            def post(self, url, body):
+                raise RuntimeError("403 caller does not have permission")
+
+        act, rest, executor = self.make(BoomRest())
+        try:
+            status = act.provision(tpu_request("v5e-8"))
+            executor.wait()
+            executor.drain()
+            assert status.state == FAILED
+            assert status.reason == "permission"
+            assert rest.counters["actuator_api_errors"] == 1
+        finally:
+            executor.shutdown()
+
+    def test_cancel_before_create_lands_stays_cancelled(self):
+        # Satellite: cancel of a provision whose create future completes
+        # later must stay FAILED("cancelled"), not be resurrected.
+        act, rest, executor = self.make()
+        try:
+            status = act.provision(tpu_request("v5e-8"))
+            act.cancel(status.id)
+            assert status.state == FAILED
+            assert "cancelled" in status.error
+            executor.wait()
+            executor.drain()  # create POST result lands after cancel
+            # The QR now exists with no owner: the drain tears it down
+            # (cancel's own DELETE ran before the QR existed).
+            deletes = [c for c in rest.calls if c[0] == "DELETE"]
+            assert len(deletes) == 2
+            assert all(status.id in c[1] for c in deletes)
+            rest._get_responses["queuedResources?"] = qr_list_response(
+                (status.id, "ACTIVE"))
+            act.poll(now=1.0)
+            executor.wait()
+            executor.drain()
+            assert status.state == FAILED
+            assert "cancelled" in status.error
+        finally:
+            executor.shutdown()
+
+
+class TestGkeExecutorMode:
+    def make(self, rest=None):
+        rest = rest or FakeRest()
+        executor = make_executor()
+        act = GkeNodePoolActuator(project="p", location="us-central2-b",
+                                  cluster="c", rest=rest,
+                                  executor=executor)
+        return act, rest, executor
+
+    def test_cpu_creates_dispatch_concurrently_one_list_poll(self):
+        act, rest, executor = self.make()
+        try:
+            rest._get_responses["/operations"] = OPS_LIST_DONE
+            status = act.provision(ProvisionRequest(
+                kind="cpu-node", shape_name="e2-standard-8", count=3))
+            executor.wait()
+            executor.drain()  # all three POSTs resolved
+            assert len([c for c in rest.calls if c[0] == "POST"]) == 3
+            act.poll(now=1.0)   # ONE ops LIST for the whole request
+            executor.wait()
+            executor.drain()
+            assert status.state == ACTIVE
+            assert len(status.unit_ids) == 3
+            assert len([c for c in rest.calls if c[0] == "GET"]) == 1
+        finally:
+            executor.shutdown()
+
+    def test_partial_create_failure_rolls_back_created_siblings(self):
+        class BoomAfterOne(FakeRest):
+            def __init__(self):
+                super().__init__()
+                self.posts = 0
+
+            def post(self, url, body):
+                # Concurrent workers: count atomically via list append.
+                self.calls.append(("POST", url, body))
+                self.posts += 1
+                if body["nodePool"]["name"].endswith("-1"):
+                    raise RuntimeError("429 quota")
+                return {"name": "projects/p/locations/l/operations/"
+                        + body["nodePool"]["name"], "status": "RUNNING"}
+
+        rest = BoomAfterOne()
+        act, _, executor = self.make(rest)
+        try:
+            import itertools
+
+            act._ids = itertools.count(0)  # pool names ...-0, -1, -2
+            status = act.provision(ProvisionRequest(
+                kind="cpu-node", shape_name="e2-standard-8", count=3))
+            executor.wait()
+            executor.drain()
+            assert status.state == FAILED
+            # The two sibling pools that DID create are queued for
+            # rollback; deletes dispatch from poll().
+            act.poll(now=1.0)
+            executor.wait()
+            executor.drain()
+            deletes = [c for c in rest.calls if c[0] == "DELETE"]
+            assert len(deletes) == 2
+            act.poll(now=2.0)  # accepted: nothing further to delete
+            executor.wait()
+            executor.drain()
+            assert len([c for c in rest.calls if c[0] == "DELETE"]) == 2
+        finally:
+            executor.shutdown()
+
+    def test_rollback_raced_by_concurrent_poll_no_double_dispatch(self):
+        # Satellite: a rollback delete still in flight while another
+        # poll() runs must not be dispatched twice.
+        class SlowDeleteRest(FakeRest):
+            def __init__(self):
+                super().__init__()
+                import threading
+
+                self.release = threading.Event()
+
+            def post(self, url, body):
+                raise RuntimeError("429 quota")
+
+            def delete(self, url):
+                self.release.wait(timeout=5)
+                return super().delete(url)
+
+        rest = SlowDeleteRest()
+        act, _, executor = self.make(rest)
+        try:
+            # Seed a rollback: serial path queues created pools; here
+            # ALL posts fail so fabricate one created pool directly.
+            status = act.provision(ProvisionRequest(
+                kind="cpu-node", shape_name="e2-standard-8", count=1))
+            executor.wait()
+            executor.drain()
+            assert status.state == FAILED
+            act._rollbacks[status.id] = ["tpuas-doomed-pool"]
+            act.poll(now=1.0)   # dispatches the rollback delete (blocked)
+            act.poll(now=2.0)   # raced poll: delete still in flight
+            act.poll(now=3.0)
+            rest.release.set()
+            executor.wait()
+            executor.drain()
+            deletes = [c for c in rest.calls if c[0] == "DELETE"]
+            assert len(deletes) == 1  # never double-dispatched
+            assert act._rollbacks == {}
+        finally:
+            executor.shutdown()
+
+    def test_rollback_retries_after_rejected_delete(self):
+        class RejectOnceRest(FakeRest):
+            def __init__(self):
+                super().__init__()
+                self.rejections = 1
+
+            def post(self, url, body):
+                raise RuntimeError("429 quota")
+
+            def delete(self, url):
+                if self.rejections > 0:
+                    self.rejections -= 1
+                    raise RuntimeError(
+                        "FAILED_PRECONDITION: op in progress")
+                return super().delete(url)
+
+        rest = RejectOnceRest()
+        act, _, executor = self.make(rest)
+        try:
+            status = act.provision(ProvisionRequest(
+                kind="cpu-node", shape_name="e2-standard-8", count=1))
+            executor.wait()
+            executor.drain()
+            act._rollbacks[status.id] = ["tpuas-doomed-pool"]
+            act.poll(now=1.0)
+            executor.wait()
+            executor.drain()  # first delete rejected (create op running)
+            assert act._rollbacks[status.id] == ["tpuas-doomed-pool"]
+            assert rest.counters["rollback_retries"] == 1
+            act.poll(now=2.0)  # re-dispatched after the failure drained
+            executor.wait()
+            executor.drain()
+            assert act._rollbacks == {}
+        finally:
+            executor.shutdown()
+
+    def test_ops_never_resolve_while_sibling_create_parked(self):
+        # A multi-pool provision must not go ACTIVE off the ops that DID
+        # land while a sibling's create POST is parked on a retry.
+        from tpu_autoscaler.actuators.executor import (
+            ActuationExecutor,
+            RetryLater,
+        )
+
+        class OneParkedRest(FakeRest):
+            def post(self, url, body):
+                if body["nodePool"]["name"].endswith("-1"):
+                    raise RetryLater("503")
+                return super().post(url, body)
+
+        rest = OneParkedRest()
+        rest._get_responses["/operations"] = OPS_LIST_DONE
+        # Frozen clock: the parked retry never wakes during the test.
+        executor = ActuationExecutor(max_workers=4, clock=lambda: 0.0)
+        act = GkeNodePoolActuator(project="p", location="us-central2-b",
+                                  cluster="c", rest=rest,
+                                  executor=executor)
+        try:
+            import itertools
+
+            act._ids = itertools.count(0)
+            status = act.provision(ProvisionRequest(
+                kind="cpu-node", shape_name="e2-standard-8", count=2))
+            executor.wait()
+            executor.drain()  # pool-0 created (op recorded); pool-1 parked
+            assert act._operations[status.id]
+            act.poll(now=1.0)
+            executor.wait()
+            executor.drain()
+            assert status.in_flight  # NOT resolved off the partial ops
+            # No ops poll was even dispatched for the half-created request.
+            assert not [c for c in rest.calls if c[0] == "GET"]
+        finally:
+            executor.shutdown()
+
+    def test_cancel_after_create_completed_is_not_resurrected(self):
+        # Satellite: cancel() of a provision whose create future already
+        # completed — a later ops-LIST result saying DONE must not flip
+        # the cancelled status back to ACTIVE.
+        act, rest, executor = self.make()
+        try:
+            status = act.provision(tpu_request("v5e-64"))
+            executor.wait()
+            executor.drain()  # create done, op recorded
+            act.poll(now=1.0)  # ops LIST dispatched...
+            act.cancel(status.id)  # ...then the controller cancels
+            assert status.state == FAILED
+            deletes = [c for c in rest.calls if c[0] == "DELETE"]
+            assert len(deletes) == 1  # pool torn down
+            rest._get_responses["/operations"] = OPS_LIST_DONE
+            executor.wait()
+            executor.drain()  # stale LIST result lands after the cancel
+            assert status.state == FAILED
+            assert "cancelled" in status.error
+        finally:
+            executor.shutdown()
 
 
 class TestGkeHttpLevel:
@@ -332,6 +857,12 @@ class TestGkeHttpLevel:
             def do_GET(self):
                 calls.append(("GET", self.path, None,
                               self.headers.get("Authorization")))
+                if self.path.endswith("/operations"):
+                    # Batched poll: operations LIST under the location.
+                    self._send({"operations": [
+                        {"name": "projects/p/locations/l/operations/op9",
+                         "status": "DONE"}]})
+                    return
                 self._send({"status": "DONE"})
 
             def do_DELETE(self):
@@ -363,7 +894,9 @@ class TestGkeHttpLevel:
                 "tpuTopology"] == "8x8"
             assert post[3] == "Bearer test-token"
             get = next(c for c in calls if c[0] == "GET")
-            assert get[1].endswith("/operations/op9")
+            # Batched poll: ONE LIST under the location, not per-op GETs.
+            assert get[1].endswith(
+                "/projects/p/locations/us-central2-b/operations")
             delete = next(c for c in calls if c[0] == "DELETE")
             assert "/nodePools/tpuas-v5e-64-" in delete[1]
         finally:

@@ -1,0 +1,276 @@
+"""ActuationExecutor tests (actuators/executor.py): bounded concurrency,
+drain-side completion delivery on the reconcile thread, and
+deadline-aware retry RESCHEDULING (a backing-off call is parked at
+retry_at, never slept on, and never occupies a worker slot)."""
+
+import functools
+import threading
+
+import pytest
+
+from tpu_autoscaler.actuators.executor import ActuationExecutor, RetryLater
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+class Sink:
+    def __init__(self):
+        self.counts = {}
+        self.observed = {}
+        self.gauges = {}
+
+    def inc(self, name, by=1.0):
+        self.counts[name] = self.counts.get(name, 0) + by
+
+    def observe(self, name, value):
+        self.observed.setdefault(name, []).append(value)
+
+    def set_gauge(self, name, value):
+        self.gauges[name] = value
+
+
+@pytest.fixture()
+def executor():
+    ex = ActuationExecutor(max_workers=4, clock=FakeClock())
+    yield ex
+    ex.shutdown()
+
+
+def run_settled(ex, rounds=50):
+    """wait+drain until idle (real worker threads finish fast)."""
+    for _ in range(rounds):
+        ex.wait(timeout=5)
+        ex.drain()
+        if not ex.depth:
+            return
+    raise AssertionError(f"executor never went idle (depth={ex.depth})")
+
+
+class TestDelivery:
+    def test_success_delivered_on_drain_only(self, executor):
+        done = []
+        executor.submit(lambda: 42, lambda r, e: done.append((r, e)))
+        executor.wait()
+        assert done == []  # completion exists but is NOT delivered yet
+        executor.drain()
+        assert done == [(42, None)]
+
+    def test_callbacks_run_on_draining_thread(self, executor):
+        tids = []
+        executor.submit(lambda: threading.get_ident(),
+                        lambda r, e: tids.append((r, threading.get_ident())))
+        executor.wait()
+        executor.drain()
+        worker_tid, callback_tid = tids[0]
+        assert callback_tid == threading.get_ident()  # reconcile thread
+        assert worker_tid != callback_tid             # work ran off-thread
+
+    def test_terminal_exception_delivered_as_error(self, executor):
+        done = []
+        boom = ValueError("no")
+
+        def fn():
+            raise boom
+
+        executor.submit(fn, lambda r, e: done.append((r, e)))
+        executor.wait()
+        executor.drain()
+        assert done == [(None, boom)]
+
+    def test_callback_exception_does_not_starve_drain(self):
+        sink = Sink()
+        ex = ActuationExecutor(max_workers=2, metrics=sink)
+        try:
+            done = []
+
+            def bad_callback(r, e):
+                raise RuntimeError("callback bug")
+
+            ex.submit(lambda: 1, bad_callback)
+            ex.submit(lambda: 2, lambda r, e: done.append(r))
+            run_settled(ex)
+            assert done == [2]
+            assert sink.counts["actuation_callback_errors"] == 1
+        finally:
+            ex.shutdown()
+
+    def test_concurrency_is_real(self):
+        # 4 calls must run simultaneously to pass the barrier at all.
+        ex = ActuationExecutor(max_workers=4)
+        try:
+            barrier = threading.Barrier(4, timeout=5)
+            done = []
+            for _ in range(4):
+                ex.submit(barrier.wait, lambda r, e: done.append(e))
+            run_settled(ex)
+            assert done == [None] * 4
+        finally:
+            ex.shutdown()
+
+
+class TestRescheduling:
+    def test_retry_parked_until_retry_at_then_succeeds(self):
+        clock = FakeClock()
+        sink = Sink()
+        ex = ActuationExecutor(max_workers=2, clock=clock, metrics=sink)
+        try:
+            attempts = []
+            done = []
+
+            def flaky():
+                attempts.append(1)
+                if len(attempts) < 3:
+                    raise RetryLater("503", retry_after="2")
+                return "ok"
+
+            ex.submit(flaky, lambda r, e: done.append((r, e)))
+            ex.wait()
+            ex.drain()  # first failure -> parked at now+2 (Retry-After)
+            assert done == [] and ex.depth == 1
+            assert sink.counts["actuation_retries_rescheduled"] == 1
+            ex.drain()  # retry_at not reached: stays parked, no dispatch
+            assert len(attempts) == 1
+            clock.advance(2.0)
+            ex.drain()  # woken and redispatched
+            ex.wait()
+            ex.drain()  # second failure -> parked again
+            assert len(attempts) == 2 and done == []
+            clock.advance(2.0)
+            ex.drain()
+            ex.wait()
+            ex.drain()
+            assert done == [("ok", None)]
+            assert ex.depth == 0
+        finally:
+            ex.shutdown()
+
+    def test_retries_exhausted_delivers_terminal(self):
+        clock = FakeClock()
+        ex = ActuationExecutor(max_workers=2, clock=clock, max_attempts=2)
+        try:
+            done = []
+
+            def always_503():
+                raise RetryLater("503", retry_after="1")
+
+            ex.submit(always_503, lambda r, e: done.append(e))
+            ex.wait()
+            ex.drain()  # attempt 0 failed -> parked (1 of 2 attempts used)
+            clock.advance(1.0)
+            ex.drain()
+            ex.wait()
+            ex.drain()  # attempt 1 failed -> attempts exhausted
+            assert len(done) == 1
+            assert isinstance(done[0], RetryLater)
+        finally:
+            ex.shutdown()
+
+    def test_deadline_blocks_reschedule(self):
+        # A reschedule that would land past the call's deadline delivers
+        # the terminal error instead of parking.
+        clock = FakeClock()
+        ex = ActuationExecutor(max_workers=2, clock=clock, max_attempts=5)
+        try:
+            done = []
+
+            def always_503():
+                raise RetryLater("503", retry_after="30")
+
+            ex.submit(always_503, lambda r, e: done.append(e),
+                      deadline_s=10.0)
+            ex.wait()
+            ex.drain()  # retry_at = now+30 > deadline now+10 -> terminal
+            assert len(done) == 1 and isinstance(done[0], RetryLater)
+            assert ex.depth == 0
+        finally:
+            ex.shutdown()
+
+    def test_reschedule_with_gcp_rest_fake_transport(self, monkeypatch):
+        """End-to-end satellite: GcpRest.once through the executor — a
+        503 is RESCHEDULED at retry_at (no reconcile-thread sleep), the
+        redispatched attempt resends the same call and succeeds."""
+        import random
+
+        from tpu_autoscaler.actuators.gcp import GcpRest
+
+        class Resp:
+            def __init__(self, status, body):
+                self.status_code = status
+                self._body = body
+                self.headers = {}
+                self.content = b"x"
+
+            def json(self):
+                return self._body
+
+        script = [Resp(503, {"error": {"message": "hiccup"}}),
+                  Resp(200, {"state": {"state": "ACTIVE"}})]
+        calls = []
+
+        def transport(method, url, headers=None, json=None, timeout=None):
+            calls.append((method, url, json))
+            return script.pop(0)
+
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-x")
+        sleeps = []
+        rest = GcpRest(sleep=sleeps.append, rng=random.Random(0),
+                       transport=transport)
+        clock = FakeClock()
+        ex = ActuationExecutor(max_workers=2, clock=clock,
+                               rng=random.Random(0))
+        try:
+            done = []
+            ex.submit(functools.partial(rest.once, "GET", "https://t/qr"),
+                      lambda r, e: done.append((r, e)), label="qr-poll")
+            ex.wait()
+            ex.drain()  # 503 -> parked
+            assert done == [] and len(calls) == 1
+            clock.advance(10.0)  # past any jittered backoff (cap 8 s)
+            ex.drain()
+            ex.wait()
+            ex.drain()
+            assert done == [({"state": {"state": "ACTIVE"}}, None)]
+            assert len(calls) == 2
+            assert sleeps == []  # NOTHING slept in-place
+        finally:
+            ex.shutdown()
+
+
+class TestMetrics:
+    def test_dispatch_latency_and_depth_exported(self):
+        clock = FakeClock()
+        sink = Sink()
+        ex = ActuationExecutor(max_workers=2, clock=clock, metrics=sink)
+        try:
+            ex.submit(lambda: 1, lambda r, e: None)
+            ex.wait()
+            clock.advance(0.25)
+            ex.drain()
+            assert sink.observed[
+                "actuation_dispatch_latency_seconds"] == [0.25]
+            assert sink.gauges["actuation_pool_depth"] == 0
+        finally:
+            ex.shutdown()
+
+    def test_depth_counts_parked_retries(self):
+        clock = FakeClock()
+        sink = Sink()
+        ex = ActuationExecutor(max_workers=2, clock=clock, metrics=sink)
+        try:
+            ex.submit(lambda: (_ for _ in ()).throw(RetryLater("503")),
+                      lambda r, e: None)
+            ex.wait()
+            ex.drain()
+            assert ex.depth == 1  # parked, not running
+            assert sink.gauges["actuation_pool_depth"] == 1
+        finally:
+            ex.shutdown()

@@ -145,15 +145,13 @@ class _Sink:
 def _rest(monkeypatch, script, **kw):
     import random
 
-    import requests
-
     from tpu_autoscaler.actuators.gcp import GcpRest
 
     monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
     transport = _FlakyTransport(script)
-    monkeypatch.setattr(requests, "request", transport)
     sleeps = []
-    rest = GcpRest(sleep=sleeps.append, rng=random.Random(0), **kw)
+    rest = GcpRest(sleep=sleeps.append, rng=random.Random(0),
+                   transport=transport, **kw)
     return rest, transport, sleeps
 
 
@@ -241,3 +239,251 @@ class TestGcpRestRetries:
         assert rest.post("https://x/y", {}) == {}
         assert rest.delete("https://x/y") == {}
         assert transport.calls == []
+
+    def test_split_connect_read_timeouts(self, monkeypatch):
+        from tpu_autoscaler.actuators.gcp import (
+            CONNECT_TIMEOUT_S,
+            READ_TIMEOUT_S,
+            GcpRest,
+        )
+
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
+        timeouts = []
+
+        def transport(method, url, headers=None, json=None, timeout=None):
+            timeouts.append(timeout)
+            return _Resp(200, {})
+
+        GcpRest(transport=transport).get("https://x/y")
+        assert timeouts == [(CONNECT_TIMEOUT_S, READ_TIMEOUT_S)]
+
+    def test_retry_resends_original_post_body(self, monkeypatch):
+        """Regression for the body-shadowing bug: an error response with
+        a parse-able JSON body must never clobber the request payload —
+        every retried POST resends the ORIGINAL body."""
+        payload = {"nodePool": {"name": "keep-me"}}
+        rest, transport, _ = _rest(
+            monkeypatch,
+            [_Resp(503, {"error": {"message": "backend error"}}),
+             _Resp(429, {"error": {"message": "slow down"}}),
+             _Resp(200, {"ok": 1})])
+        assert rest.post("https://x/y", payload) == {"ok": 1}
+        assert [c[3] for c in transport.calls] == [payload] * 3
+
+    def test_exhausted_retries_raise_with_parsed_error_body(self,
+                                                            monkeypatch):
+        from tpu_autoscaler.actuators.gcp import GcpApiError
+
+        rest, _, _ = _rest(
+            monkeypatch,
+            [_Resp(503, {"error": {"message": "zone melting"}})] * 5)
+        with pytest.raises(GcpApiError) as exc:
+            rest.get("https://x/y")
+        assert exc.value.http_status == 503
+        assert exc.value.message == "zone melting"
+
+
+class TestGcpRestOnce:
+    """Single-attempt semantics for the actuation executor: once() never
+    sleeps — it raises GcpRetryable (a RetryLater) so the executor can
+    reschedule at retry_at instead."""
+
+    def test_retryable_status_raises_retry_later(self, monkeypatch):
+        from tpu_autoscaler.actuators.executor import RetryLater
+        from tpu_autoscaler.actuators.gcp import GcpRetryable
+
+        rest, _, sleeps = _rest(
+            monkeypatch, [_Resp(429, headers={"Retry-After": "3"})])
+        with pytest.raises(GcpRetryable) as exc:
+            rest.once("GET", "https://x/y")
+        assert isinstance(exc.value, RetryLater)
+        assert exc.value.retry_after == "3"
+        assert exc.value.http_status == 429
+        assert sleeps == []  # never sleeps in-place
+
+    def test_terminal_4xx_raises_api_error(self, monkeypatch):
+        from tpu_autoscaler.actuators.gcp import GcpApiError
+
+        rest, _, _ = _rest(monkeypatch, [_Resp(404)])
+        with pytest.raises(GcpApiError) as exc:
+            rest.once("GET", "https://x/y")
+        assert exc.value.http_status == 404
+
+    def test_401_invalidates_token_and_is_retryable(self, monkeypatch):
+        from tpu_autoscaler.actuators.gcp import GcpRetryable
+
+        rest, _, _ = _rest(monkeypatch, [_Resp(401)])
+        rest._tokens.token()
+        assert rest._tokens._expires_at > 0
+        with pytest.raises(GcpRetryable) as exc:
+            rest.once("GET", "https://x/y")
+        assert exc.value.http_status == 401
+        assert rest._tokens._token is None  # invalidated for re-resolve
+
+    def test_connection_error_terminal_is_original_exception(
+            self, monkeypatch):
+        import requests
+
+        from tpu_autoscaler.actuators.gcp import GcpRetryable
+
+        boom = requests.exceptions.ConnectionError("reset")
+        rest, _, _ = _rest(monkeypatch, [boom])
+        with pytest.raises(GcpRetryable) as exc:
+            rest.once("GET", "https://x/y")
+        assert exc.value.terminal() is boom
+
+    def test_dispatch_runs_via_executor(self, monkeypatch):
+        from tpu_autoscaler.actuators.executor import ActuationExecutor
+
+        rest, _, _ = _rest(monkeypatch, [_Resp(200, {"ok": 1})])
+        ex = ActuationExecutor(max_workers=2)
+        done = []
+        rest.dispatch(ex, "GET", "https://x/y",
+                      on_done=lambda r, e: done.append((r, e)))
+        ex.wait()
+        ex.drain()
+        ex.shutdown()
+        assert done == [({"ok": 1}, None)]
+
+    def test_401_free_retry_through_executor(self, monkeypatch):
+        # Blocking-loop parity: one 401 re-resolves the token and
+        # redispatches IMMEDIATELY — no attempt burned, no backoff
+        # parking (the call would otherwise wait a full drain cycle).
+        from tpu_autoscaler.actuators.executor import ActuationExecutor
+
+        rest, transport, _ = _rest(
+            monkeypatch, [_Resp(401), _Resp(200, {"ok": 1})])
+        ex = ActuationExecutor(max_workers=2, clock=lambda: 0.0)
+        try:
+            done = []
+            import functools
+
+            ex.submit(functools.partial(rest.once, "GET", "https://x/y"),
+                      lambda r, e: done.append((r, e)))
+            for _ in range(10):
+                ex.wait()
+                ex.drain()
+                if done:
+                    break
+            # Frozen clock: a parked (backoff) retry could never wake,
+            # so delivery proves the redispatch was immediate.
+            assert done == [({"ok": 1}, None)]
+            assert len(transport.calls) == 2
+        finally:
+            ex.shutdown()
+
+    def test_second_401_terminal_through_executor(self, monkeypatch):
+        from tpu_autoscaler.actuators.executor import ActuationExecutor
+        from tpu_autoscaler.actuators.gcp import GcpApiError
+
+        rest, transport, _ = _rest(monkeypatch, [_Resp(401), _Resp(401)])
+        ex = ActuationExecutor(max_workers=2, clock=lambda: 0.0)
+        try:
+            done = []
+            import functools
+
+            ex.submit(functools.partial(rest.once, "GET", "https://x/y"),
+                      lambda r, e: done.append(e))
+            for _ in range(10):
+                ex.wait()
+                ex.drain()
+                if done:
+                    break
+            assert isinstance(done[0], GcpApiError)
+            assert done[0].http_status == 401
+            assert len(transport.calls) == 2  # same as the blocking loop
+        finally:
+            ex.shutdown()
+
+    def test_dispatch_dry_run_resolves_immediately(self, monkeypatch):
+        rest, transport, _ = _rest(monkeypatch, [], dry_run=True)
+        done = []
+        rest.dispatch(None, "POST", "https://x/y", {"a": 1},
+                      on_done=lambda r, e: done.append((r, e)))
+        assert done == [({}, None)]
+        assert transport.calls == []
+
+
+class TestTokenProviderThreadSafety:
+    """Satellite: concurrent executor workers must not stampede the
+    metadata server nor interleave _token/_expires_at writes — the
+    refresh is lock-guarded and single-flight."""
+
+    def test_concurrent_refresh_single_flights_the_fetch(self,
+                                                         monkeypatch):
+        import threading
+
+        monkeypatch.delenv("GCP_ACCESS_TOKEN", raising=False)
+        fetches = []
+        release = threading.Event()
+
+        class SlowResp:
+            def raise_for_status(self):
+                pass
+
+            def json(self):
+                return {"access_token": "md-token", "expires_in": 600}
+
+        def slow_http(url, headers=None, timeout=None):
+            fetches.append(url)
+            release.wait(timeout=5)
+            return SlowResp()
+
+        tp = TokenProvider(http=slow_http)
+        results = []
+        threads = [threading.Thread(target=lambda: results.append(
+            tp.token())) for _ in range(8)]
+        for t in threads:
+            t.start()
+        release.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert results == ["md-token"] * 8
+        # ONE metadata fetch for the whole stampede, not eight.
+        assert len(fetches) == 1
+
+    def test_invalidate_is_lock_guarded_with_refresh(self, monkeypatch):
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
+        tp = TokenProvider()
+        assert tp.token() == "tok-1"
+        tp.invalidate()
+        assert tp._token is None and tp._expires_at == 0.0
+
+    def test_session_attached_once(self):
+        tp = TokenProvider(http="injected")
+        tp.attach_http("pooled-session-get")
+        # An explicitly injected transport is never overridden.
+        assert tp._http == "injected"
+        tp2 = TokenProvider()
+        tp2.attach_http("pooled-session-get")
+        assert tp2._http == "pooled-session-get"
+
+
+class TestPooledSession:
+    def test_default_transport_is_pooled_session_shared_with_tokens(
+            self, monkeypatch):
+        import requests
+
+        from tpu_autoscaler.actuators.gcp import (
+            SESSION_POOL_MAXSIZE,
+            GcpRest,
+            TokenProvider,
+        )
+
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
+        tp = TokenProvider()
+        rest = GcpRest(token_provider=tp)
+        session = rest._transport.__self__
+        assert isinstance(session, requests.Session)
+        adapter = session.get_adapter("https://tpu.googleapis.com/")
+        assert adapter._pool_maxsize == SESSION_POOL_MAXSIZE
+        # The token provider's metadata fetches ride the same session.
+        assert tp._http.__self__ is session
+
+    def test_pool_maxsize_scales_with_worker_count(self, monkeypatch):
+        from tpu_autoscaler.actuators.gcp import GcpRest
+
+        monkeypatch.setenv("GCP_ACCESS_TOKEN", "tok-1")
+        rest = GcpRest(pool_maxsize=64)  # e.g. --actuation-workers=64
+        session = rest._transport.__self__
+        assert session.get_adapter("https://x/")._pool_maxsize == 64

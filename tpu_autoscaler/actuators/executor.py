@@ -1,0 +1,246 @@
+"""Bounded-concurrency actuation executor: the pipelined dispatch layer.
+
+Why this exists (ISSUE 3): the planner was built so disjoint gangs
+provision in parallel (engine/planner.py module docstring), yet every
+actuation HTTP round-trip used to run serially on the reconcile thread
+— ``Reconciler._scale`` submitted provisions one blocking POST at a
+time, each actuator ``poll()`` GET'd in-flight provisions one by one,
+and ``GcpRest._request`` slept its retry backoffs in-place.  A pass
+over a busy fleet cost O(in-flight + new requests) RTTs of wall-clock;
+this executor makes it ~1 RTT.
+
+Threading / consistency model (docs/ACTUATION.md):
+
+- ``submit()`` hands a thunk (one HTTP attempt, typically
+  ``GcpRest.once``) to a capped ``ThreadPoolExecutor``.  The thunk runs
+  off-thread and must not touch actuator or executor state — it only
+  returns a value or raises.
+- ALL executor bookkeeping (``_running``, ``_parked``) and every
+  ``on_done`` completion callback run on the reconcile thread, inside
+  ``drain()`` — called at the top of ``reconcile_once``.  Actuator
+  state is therefore mutated only on the reconcile thread, and the
+  class needs no locks at all: the TAT2xx thread-discipline checker
+  stays clean with zero waivers by construction, not by annotation.
+- A thunk that must back off raises :class:`RetryLater` instead of
+  sleeping.  ``drain()`` parks the call and re-dispatches it once its
+  ``retry_at`` arrives — the reconcile thread never sleeps, and a
+  backing-off call never occupies a worker slot.
+- Retries are deadline-aware: a reschedule that would land past the
+  call's deadline (or past ``max_attempts``) delivers the terminal
+  error to ``on_done`` instead.
+
+Metrics: ``actuation_dispatch_latency_seconds`` (submit → result
+delivered), ``actuation_pool_depth`` (outstanding calls after each
+drain), ``actuation_retries_rescheduled``, ``actuation_callback_errors``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import heapq
+import itertools
+import logging
+import random
+import time
+from typing import Any, Callable
+
+from tpu_autoscaler.backoff import (
+    REST_BACKOFF_BASE_S,
+    REST_BACKOFF_CAP_S,
+    REST_RETRY_AFTER_CAP_FACTOR,
+    backoff_seconds,
+)
+
+log = logging.getLogger(__name__)
+
+DEFAULT_MAX_WORKERS = 16
+DEFAULT_MAX_ATTEMPTS = 5
+#: Default per-call deadline: submit → final delivery, including parked
+#: backoff time.  Generous (a provision POST is idempotent-keyed and
+#: the controller's provision_timeout backstops it anyway).
+DEFAULT_DEADLINE_S = 120.0
+
+
+class RetryLater(Exception):
+    """Raised by a dispatched thunk to mean "transient — try the same
+    call again after a backoff".  The executor reschedules the call at
+    ``retry_at`` instead of anyone sleeping.
+
+    ``retry_after``: optional server hint (Retry-After) in seconds.
+    ``attempt_free``: the retry neither burns a backoff attempt nor
+    waits (401 token re-resolution — mirrors the blocking loop, which
+    re-authes immediately exactly once; a second attempt-free failure
+    goes terminal).
+    ``terminal()``: the exception delivered to ``on_done`` when retries
+    are exhausted or the deadline passes (subclasses refine it —
+    ``gcp.GcpRetryable`` turns itself back into a ``GcpApiError``).
+    """
+
+    def __init__(self, cause: str, retry_after: Any = None,
+                 attempt_free: bool = False):
+        super().__init__(cause)
+        self.cause = cause
+        self.retry_after = retry_after
+        self.attempt_free = attempt_free
+
+    def terminal(self) -> Exception:
+        return self.__cause__ if self.__cause__ is not None else self
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics for list removal
+class _Call:
+    fn: Callable[[], Any]
+    on_done: Callable[[Any, Exception | None], None]
+    label: str
+    submitted_at: float
+    deadline_at: float
+    attempt: int = 0
+    free_retries_used: int = 0
+    future: concurrent.futures.Future | None = None
+
+
+class ActuationExecutor:
+    """Capped-concurrency dispatcher for actuator HTTP calls.
+
+    ``submit()`` and ``drain()`` must be called from the reconcile
+    thread only (see module docstring).  ``clock`` is injectable for
+    the deadline/reschedule tests; it must be monotonic-like.
+    """
+
+    def __init__(self, max_workers: int = DEFAULT_MAX_WORKERS,
+                 metrics=None, max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                 deadline_s: float = DEFAULT_DEADLINE_S,
+                 backoff_base_s: float = REST_BACKOFF_BASE_S,
+                 backoff_cap_s: float = REST_BACKOFF_CAP_S,
+                 rng: random.Random | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_workers = max_workers
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="actuation")
+        self._metrics = metrics
+        self._max_attempts = max_attempts
+        self._deadline_s = deadline_s
+        self._backoff_base_s = backoff_base_s
+        self._backoff_cap_s = backoff_cap_s
+        self._rng = rng or random.Random()
+        self._clock = clock
+        self._running: list[_Call] = []
+        # Parked retries: (retry_at, seq, call) min-heap.
+        self._parked: list[tuple[float, int, _Call]] = []
+        self._seq = itertools.count()
+
+    # -- wiring ----------------------------------------------------------
+
+    def set_metrics(self, metrics) -> None:
+        """Wire the controller's metrics registry (the Controller calls
+        this on construction, like Actuator.set_metrics)."""
+        self._metrics = metrics
+
+    def _inc(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name)
+
+    def _observe(self, name: str, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.observe(name, value)
+
+    # -- reconcile-thread API --------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        """Outstanding calls: dispatched + parked for retry."""
+        return len(self._running) + len(self._parked)
+
+    def submit(self, fn: Callable[[], Any],
+               on_done: Callable[[Any, Exception | None], None], *,
+               label: str = "", deadline_s: float | None = None) -> None:
+        """Dispatch ``fn`` to the pool; ``on_done(result, error)`` fires
+        on the reconcile thread during a later ``drain()``."""
+        now = self._clock()
+        call = _Call(fn=fn, on_done=on_done, label=label, submitted_at=now,
+                     deadline_at=now + (deadline_s if deadline_s is not None
+                                        else self._deadline_s))
+        self._dispatch(call)
+
+    def _dispatch(self, call: _Call) -> None:
+        call.future = self._pool.submit(call.fn)
+        self._running.append(call)
+
+    def drain(self) -> int:
+        """Deliver completed calls and wake due retries; returns the
+        number of completions delivered.  The ONLY place on_done runs."""
+        now = self._clock()
+        while self._parked and self._parked[0][0] <= now:
+            _, _, call = heapq.heappop(self._parked)
+            call.attempt += 1
+            self._dispatch(call)
+        completed = [c for c in self._running
+                     if c.future is not None and c.future.done()]
+        # Rebuild _running BEFORE running callbacks: an on_done that
+        # submits new work must land in the live list, not be lost to a
+        # post-loop reassignment.
+        self._running = [c for c in self._running if c not in completed]
+        delivered = 0
+        for call in completed:
+            delivered += self._finish(call, now)
+        if self._metrics is not None:
+            self._metrics.set_gauge("actuation_pool_depth", self.depth)
+        return delivered
+
+    def _finish(self, call: _Call, now: float) -> int:
+        """One completed future: park a retry, or deliver the result."""
+        exc = call.future.exception()
+        if isinstance(exc, RetryLater) and exc.attempt_free:
+            if call.free_retries_used < 1:
+                # 401-style re-resolution: immediate redispatch, no
+                # attempt burned, no backoff — blocking-loop parity.
+                call.free_retries_used += 1
+                self._dispatch(call)
+                return 0
+            exc = exc.terminal()  # second auth failure is terminal
+        if isinstance(exc, RetryLater):
+            delay = backoff_seconds(
+                call.attempt, exc.retry_after,
+                base_s=self._backoff_base_s, cap_s=self._backoff_cap_s,
+                retry_after_cap_s=(self._backoff_cap_s
+                                   * REST_RETRY_AFTER_CAP_FACTOR),
+                rng=self._rng)
+            retry_at = now + delay
+            if (call.attempt + 1 < self._max_attempts
+                    and retry_at <= call.deadline_at):
+                heapq.heappush(self._parked,
+                               (retry_at, next(self._seq), call))
+                self._inc("actuation_retries_rescheduled")
+                log.debug("actuation call %s rescheduled in %.2fs "
+                          "(attempt %d/%d): %s", call.label, delay,
+                          call.attempt + 1, self._max_attempts, exc.cause)
+                return 0
+            exc = exc.terminal()
+        self._observe("actuation_dispatch_latency_seconds",
+                      now - call.submitted_at)
+        try:
+            if exc is None:
+                call.on_done(call.future.result(), None)
+            else:
+                call.on_done(None, exc)
+        except Exception:  # noqa: BLE001 — one callback must not starve
+            # the rest of the drain (crash-only degradation point).
+            if self._metrics is not None:
+                self._metrics.inc("actuation_callback_errors")
+            log.exception("actuation completion callback failed (%s)",
+                          call.label)
+        return 1
+
+    # -- tests / bench / shutdown only -----------------------------------
+
+    def wait(self, timeout: float | None = 10.0) -> None:
+        """Block until every currently-dispatched future completes.
+        Parked retries are NOT waited on (they need a drain to wake).
+        Tests and bench only — the control loop never blocks here."""
+        concurrent.futures.wait(
+            [c.future for c in self._running if c.future is not None],
+            timeout=timeout)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -11,27 +11,85 @@ GET poll, DELETE teardown).  Token resolution order:
    in-cluster path, mirroring how the reference used in-cluster service
    credentials.
 
-Tokens are cached until ~5 minutes before expiry.
+Tokens are cached until ~5 minutes before expiry; the cache is
+lock-guarded and the refresh single-flighted, because the actuation
+executor calls ``token()`` from concurrent worker threads.
 
-Every verb retries transient failures (429 / 5xx / connection errors)
-with bounded exponential backoff + full jitter, honoring Retry-After —
-the reference's deployments.py tolerated flaky ARM polls the same way;
-without this a single 503 surfaced as a whole reconcile-pass exception.
-A 401 mid-flight invalidates the cached token and re-resolves once
-(metadata-server tokens rotate under us in-cluster).
+Transport is a pooled ``requests.Session`` (connection/TLS reuse across
+calls AND across worker threads — urllib3's pool is thread-safe) with
+split connect/read timeouts, shared with the token provider's metadata
+fetches.  Tests inject a ``transport`` callable instead.
+
+Two dispatch modes share one attempt implementation (``once``):
+
+- ``_request`` — the blocking loop: retries transient failures
+  (429 / 5xx / connection errors) with bounded exponential backoff +
+  full jitter, honoring Retry-After, sleeping in-place.  A 401
+  mid-flight invalidates the cached token and re-resolves once.
+- ``dispatch`` — the pipelined path: hands ONE attempt to the
+  :class:`~tpu_autoscaler.actuators.executor.ActuationExecutor`; a
+  retryable outcome raises :class:`GcpRetryable` (a ``RetryLater``) and
+  the executor reschedules it at ``retry_at`` instead of sleeping.
 """
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import random
+import threading
 import time
+
+from tpu_autoscaler.actuators.executor import RetryLater
+from tpu_autoscaler.backoff import (
+    REST_BACKOFF_BASE_S,
+    REST_BACKOFF_CAP_S,
+    REST_RETRY_AFTER_CAP_FACTOR,
+)
 
 log = logging.getLogger(__name__)
 
 _METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
                        "instance/service-accounts/default/token")
+
+#: Split timeouts: connect failures (dead VIP, blackholed route) surface
+#: in seconds while a slow-but-alive response keeps the full read window.
+CONNECT_TIMEOUT_S = 5.0
+READ_TIMEOUT_S = 30.0
+METADATA_TIMEOUT_S = (2.0, 5.0)
+
+#: Connection-pool floor for the shared Session — matches the actuation
+#: executor's default worker cap so concurrent dispatches never queue on
+#: a pool slot below the concurrency cap (an actuator built with a
+#: bigger executor passes its worker count through ``pool_maxsize``).
+SESSION_POOL_MAXSIZE = 16
+
+#: HTTP statuses on a batched-LIST endpoint meaning "this surface does
+#: not support (or permit) listing" — shared by both actuators' poll
+#: fallback machines so they can never drift on the classification.
+LIST_UNAVAILABLE_STATUSES = frozenset({400, 403, 404, 501})
+
+
+def list_unavailable(error) -> bool:
+    """True when a batched-LIST failure means the endpoint is
+    unavailable (flip to per-id polling for good) rather than a
+    transient hiccup (keep LIST mode, retry next pass)."""
+    return (isinstance(error, GcpApiError)
+            and error.http_status in LIST_UNAVAILABLE_STATUSES)
+
+
+def note_list_failure(rest, error, what: str) -> bool:
+    """Shared batched-LIST failure handling for both actuators (they
+    must never drift on this): counts the fallback, logs, and returns
+    True when the caller should flip to per-id polling for good."""
+    if list_unavailable(error):
+        rest.inc("poll_list_fallbacks")
+        log.warning("%s LIST unavailable (HTTP %d); falling back to "
+                    "per-id polling", what, error.http_status)
+        return True
+    log.warning("%s batched poll failed: %s", what, error)
+    return False
 
 
 class GcpAuthError(RuntimeError):
@@ -57,55 +115,113 @@ class GcpApiError(RuntimeError):
             .replace("  ", " "))
 
 
+class GcpRetryable(RetryLater):
+    """A retryable HTTP outcome (429/5xx/connection error, or a 401
+    pending token re-resolution) surfaced as data instead of an
+    in-place sleep.  Carries enough to reconstruct the terminal error
+    when retries run out."""
+
+    def __init__(self, cause: str, retry_after=None,
+                 http_status: int | None = None,
+                 err_body: dict | str | None = None, url: str = "",
+                 attempt_free: bool = False):
+        super().__init__(cause, retry_after, attempt_free=attempt_free)
+        self.http_status = http_status
+        self.err_body = err_body
+        self.url = url
+
+    def terminal(self) -> Exception:
+        if self.http_status is not None:
+            return GcpApiError(self.http_status, self.url,
+                               self.err_body if self.err_body is not None
+                               else "")
+        return self.__cause__ if self.__cause__ is not None else self
+
+
+def _parse_error_body(r) -> dict | str:
+    """The googleapis error envelope (or truncated text) from an error
+    response.  A local helper — NOT a variable named ``body`` — so the
+    request payload can never be shadowed/clobbered on an error path."""
+    try:
+        return r.json()
+    except ValueError:
+        return (r.text or "")[:500]
+
+
 class TokenProvider:
-    def __init__(self):
+    """Cached bearer-token resolution, thread-safe: the actuation
+    executor's workers all call ``token()`` concurrently, so the cache
+    is lock-guarded and a refresh is single-flight — one metadata-server
+    fetch per expiry, not a stampede (waiters block on the lock and
+    then read the fresh cache)."""
+
+    def __init__(self, http=None):
+        self._lock = threading.Lock()
         self._token: str | None = None
         self._expires_at = 0.0
         self._env_token_used: str | None = None
+        # Metadata-fetch callable (requests.get-shaped).  GcpRest
+        # attaches its pooled session's .get here so token refreshes
+        # reuse the same connection pool.
+        self._http = http
+
+    def attach_http(self, http) -> None:
+        """Adopt a transport for metadata fetches (first one wins — an
+        explicitly injected ``http`` is never overridden)."""
+        with self._lock:
+            if self._http is None:
+                self._http = http
 
     def invalidate(self) -> None:
         """Drop the cached token so the next token() re-resolves — the
         401 recovery path: a metadata-server token can be revoked/rotated
         before its advertised expiry, and a stale env token adopted on
         metadata failure would otherwise 401 forever."""
-        self._token = None
-        self._expires_at = 0.0
+        with self._lock:
+            self._token = None
+            self._expires_at = 0.0
 
     def token(self) -> str:
-        if self._token and time.time() < self._expires_at - 300:
-            return self._token
-        env = os.environ.get("GCP_ACCESS_TOKEN")
-        if env and env != self._env_token_used:
-            # A fresh operator-provided token (gcloud tokens live <=1h);
-            # once it ages out we do NOT silently re-adopt the same stale
-            # value — we fall through to the metadata server instead.
-            self._env_token_used = env
-            self._token, self._expires_at = env, time.time() + 3000
-            return env
-        try:
-            import requests
-
-            r = requests.get(_METADATA_TOKEN_URL,
-                             headers={"Metadata-Flavor": "Google"},
-                             timeout=5)
-            r.raise_for_status()
-            data = r.json()
-            self._token = data["access_token"]
-            self._expires_at = time.time() + float(
-                data.get("expires_in", 3600))
-            return self._token
-        except Exception as e:  # noqa: BLE001
-            if env:
-                # No metadata server but the operator gave us a token:
-                # keep using it (it may be long-lived), but say so.
-                log.warning("GCP_ACCESS_TOKEN is older than its assumed "
-                            "lifetime and no metadata server is available; "
-                            "continuing with the possibly-stale token")
+        with self._lock:
+            if self._token and time.time() < self._expires_at - 300:
+                return self._token
+            env = os.environ.get("GCP_ACCESS_TOKEN")
+            if env and env != self._env_token_used:
+                # A fresh operator-provided token (gcloud tokens live
+                # <=1h); once it ages out we do NOT silently re-adopt the
+                # same stale value — we fall through to the metadata
+                # server instead.
+                self._env_token_used = env
                 self._token, self._expires_at = env, time.time() + 3000
                 return env
-            raise GcpAuthError(
-                "no GCP credentials: set GCP_ACCESS_TOKEN or run with a "
-                "metadata server (GKE workload identity)") from e
+            try:
+                import requests
+
+                http = self._http if self._http is not None \
+                    else requests.get
+                r = http(_METADATA_TOKEN_URL,
+                         headers={"Metadata-Flavor": "Google"},
+                         timeout=METADATA_TIMEOUT_S)
+                r.raise_for_status()
+                data = r.json()
+                self._token = data["access_token"]
+                self._expires_at = time.time() + float(
+                    data.get("expires_in", 3600))
+                return self._token
+            except Exception as e:  # noqa: BLE001
+                if env:
+                    # No metadata server but the operator gave us a
+                    # token: keep using it (it may be long-lived), but
+                    # say so.
+                    log.warning(
+                        "GCP_ACCESS_TOKEN is older than its assumed "
+                        "lifetime and no metadata server is available; "
+                        "continuing with the possibly-stale token")
+                    self._token, self._expires_at = env, time.time() + 3000
+                    return env
+                raise GcpAuthError(
+                    "no GCP credentials: set GCP_ACCESS_TOKEN or run with "
+                    "a metadata server (GKE workload identity)") from e
 
 
 #: HTTP statuses worth retrying: rate limits and server-side hiccups.
@@ -118,22 +234,37 @@ class GcpRest:
 
     ``metrics``: optional Metrics sink — each retried attempt increments
     ``rest_retries`` so operators can see a flaky control plane before
-    it becomes an outage.  ``sleep``/``rng`` are injectable for tests.
+    it becomes an outage.  ``sleep``/``rng``/``transport`` are
+    injectable for tests; the default transport is a pooled Session.
     """
 
     max_attempts = 5
-    backoff_base_s = 0.5
-    backoff_cap_s = 8.0
+    backoff_base_s = REST_BACKOFF_BASE_S
+    backoff_cap_s = REST_BACKOFF_CAP_S
 
     def __init__(self, dry_run: bool = False,
                  token_provider: TokenProvider | None = None,
                  metrics=None, sleep=time.sleep,
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None,
+                 transport=None, pool_maxsize: int | None = None):
         self.dry_run = dry_run
         self._tokens = token_provider or TokenProvider()
         self._metrics = metrics
         self._sleep = sleep
         self._rng = rng or random.Random()
+        if transport is None:
+            import requests
+
+            session = requests.Session()
+            adapter = requests.adapters.HTTPAdapter(
+                pool_connections=4,
+                pool_maxsize=max(pool_maxsize or 0, SESSION_POOL_MAXSIZE))
+            session.mount("https://", adapter)
+            session.mount("http://", adapter)
+            transport = session.request
+            # Token refreshes ride the same connection pool.
+            self._tokens.attach_http(session.get)
+        self._transport = transport
 
     def _headers(self) -> dict:
         return {"Authorization": f"Bearer {self._tokens.token()}",
@@ -145,7 +276,9 @@ class GcpRest:
         return backoff_seconds(
             attempt, retry_after, base_s=self.backoff_base_s,
             cap_s=self.backoff_cap_s,
-            retry_after_cap_s=self.backoff_cap_s * 4, rng=self._rng)
+            retry_after_cap_s=(self.backoff_cap_s
+                               * REST_RETRY_AFTER_CAP_FACTOR),
+            rng=self._rng)
 
     def inc(self, name: str) -> None:
         """Increment a counter on the wired metrics sink (no-op until
@@ -153,50 +286,81 @@ class GcpRest:
         if self._metrics is not None:
             self._metrics.inc(name)
 
+    def observe(self, name: str, value: float) -> None:
+        """Record a summary observation on the wired metrics sink."""
+        if self._metrics is not None:
+            self._metrics.observe(name, value)
+
     def _note_retry(self, why: str, url: str, attempt: int) -> None:
         self.inc("rest_retries")
         log.warning("GCP REST %s (attempt %d/%d) %s — retrying",
                     why, attempt + 1, self.max_attempts, url)
 
-    def _request(self, method: str, url: str, body: dict | None) -> dict:
+    # -- one attempt (shared by both dispatch modes) ---------------------
+
+    def once(self, method: str, url: str, body: dict | None = None) -> dict:
+        """ONE HTTP attempt.  Returns parsed JSON on success; raises
+        :class:`GcpRetryable` for outcomes the caller may retry
+        (429/5xx/connection errors, and a 401 after invalidating the
+        cached token) and :class:`GcpApiError` for terminal ones.
+        Never sleeps — retry pacing belongs to the caller: ``_request``'s
+        bounded sleep loop, or the ActuationExecutor's reschedule.
+        Thread-safe (workers call it concurrently)."""
         import requests
 
+        try:
+            r = self._transport(
+                method, url, headers=self._headers(),
+                json=body if method == "POST" else None,
+                timeout=(CONNECT_TIMEOUT_S, READ_TIMEOUT_S))
+        except requests.exceptions.RequestException as e:
+            raise GcpRetryable(
+                f"connection error ({e.__class__.__name__})",
+                url=url) from e
+        if r.status_code == 401:
+            # Token revoked/rotated under us: invalidate so the retry
+            # re-resolves (the provider single-flights the refresh).
+            # attempt_free: both dispatch modes re-auth immediately,
+            # exactly once, without burning a backoff attempt.
+            self._tokens.invalidate()
+            raise GcpRetryable("401 (re-resolving token)", http_status=401,
+                               err_body=_parse_error_body(r), url=url,
+                               attempt_free=True)
+        if r.status_code in _RETRYABLE_STATUSES:
+            raise GcpRetryable(str(r.status_code),
+                               retry_after=r.headers.get("Retry-After"),
+                               http_status=r.status_code,
+                               err_body=_parse_error_body(r), url=url)
+        if r.status_code >= 400:
+            raise GcpApiError(r.status_code, url, _parse_error_body(r))
+        return r.json() if r.content else {}
+
+    # -- blocking mode ----------------------------------------------------
+
+    def _request(self, method: str, url: str, body: dict | None) -> dict:
         reauthed = False
         attempt = 0
         while True:
             try:
-                r = requests.request(
-                    method, url, headers=self._headers(),
-                    json=body if method == "POST" else None, timeout=30)
-            except requests.exceptions.RequestException as e:
+                return self.once(method, url, body)
+            except GcpRetryable as e:
+                if e.http_status == 401:
+                    if reauthed:
+                        # Second 401: the fresh token is rejected too.
+                        raise GcpApiError(
+                            401, url,
+                            e.err_body if e.err_body is not None
+                            else "") from e
+                    # Re-resolving the token doesn't burn a backoff
+                    # attempt.
+                    reauthed = True
+                    self._note_retry(e.cause, url, attempt)
+                    continue
                 if attempt + 1 >= self.max_attempts:
-                    raise
-                self._note_retry(f"connection error ({e.__class__.__name__})",
-                                 url, attempt)
-                self._sleep(self._backoff_seconds(attempt, None))
+                    raise e.terminal() from e
+                self._note_retry(e.cause, url, attempt)
+                self._sleep(self._backoff_seconds(attempt, e.retry_after))
                 attempt += 1
-                continue
-            if r.status_code == 401 and not reauthed:
-                # Token revoked/rotated under us: re-resolve once, and
-                # don't burn a backoff attempt on it.
-                reauthed = True
-                self._tokens.invalidate()
-                self._note_retry("401 (re-resolving token)", url, attempt)
-                continue
-            if r.status_code in _RETRYABLE_STATUSES \
-                    and attempt + 1 < self.max_attempts:
-                self._note_retry(f"{r.status_code}", url, attempt)
-                self._sleep(self._backoff_seconds(
-                    attempt, r.headers.get("Retry-After")))
-                attempt += 1
-                continue
-            if r.status_code >= 400:
-                try:
-                    body = r.json()
-                except ValueError:
-                    body = (r.text or "")[:500]
-                raise GcpApiError(r.status_code, url, body)
-            return r.json() if r.content else {}
 
     def get(self, url: str) -> dict:
         return self._request("GET", url, None)
@@ -212,3 +376,19 @@ class GcpRest:
             log.info("[dry-run] DELETE %s", url)
             return {}
         return self._request("DELETE", url, None)
+
+    # -- pipelined mode ---------------------------------------------------
+
+    def dispatch(self, executor, method: str, url: str,
+                 body: dict | None = None, *, on_done,
+                 label: str = "") -> None:
+        """Submit ONE call through the actuation executor (non-blocking).
+        ``on_done(result, error)`` fires on the reconcile thread at a
+        later ``drain()``.  Dry-run mutations resolve immediately with
+        an empty result, mirroring the blocking verbs."""
+        if self.dry_run and method in ("POST", "DELETE"):
+            log.info("[dry-run] %s %s %s", method, url, body or "")
+            on_done({}, None)
+            return
+        executor.submit(functools.partial(self.once, method, url, body),
+                        on_done, label=label)

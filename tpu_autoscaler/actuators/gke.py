@@ -13,10 +13,19 @@ construction instead of template surgery.
 Unlike deployments.py's one-deployment-in-flight serialization, disjoint
 node-pool operations run in parallel; idempotence comes from the planner's
 gang tagging (see engine/planner.py docstring).
+
+Actuation pipeline (ISSUE 3, docs/ACTUATION.md): with an
+``ActuationExecutor`` attached, pool-create POSTs (including a CPU
+request's N per-node pools) and rollback deletes dispatch concurrently,
+and polling batches into ONE operations LIST under the cluster's
+project/location instead of one GET per create operation (per-op GET
+remains the fallback when LIST is unavailable).  All actuator state
+mutates on the reconcile thread via drain-run callbacks.
 """
 
 from __future__ import annotations
 
+import functools
 import itertools
 import logging
 import time
@@ -28,7 +37,11 @@ from tpu_autoscaler.actuators.base import (
     PROVISIONING,
     ProvisionStatus,
 )
-from tpu_autoscaler.actuators.gcp import GcpRest, TokenProvider
+from tpu_autoscaler.actuators.gcp import (
+    GcpRest,
+    TokenProvider,
+    note_list_failure,
+)
 from tpu_autoscaler.engine.planner import ProvisionRequest
 from tpu_autoscaler.topology.catalog import (
     POOL_LABEL,
@@ -50,16 +63,21 @@ class GkeNodePoolActuator:
     def __init__(self, project: str, location: str, cluster: str,
                  dry_run: bool = False, rest: GcpRest | None = None,
                  pool_prefix: str = "tpuas",
-                 api_base: str = _BASE):
+                 api_base: str = _BASE,
+                 executor=None, batch_poll: bool = True):
         if not (project and location and cluster):
             raise ValueError(
                 "GKE actuator needs --project, --location and --cluster")
         self._api_base = api_base
         self._parent = (f"projects/{project}/locations/{location}"
                         f"/clusters/{cluster}")
-        self._rest = rest or GcpRest(dry_run=dry_run,
-                                     token_provider=TokenProvider())
+        # Operations live under the project/location, not the cluster.
+        self._ops_parent = f"projects/{project}/locations/{location}"
+        self._rest = rest or GcpRest(
+            dry_run=dry_run, token_provider=TokenProvider(),
+            pool_maxsize=getattr(executor, "max_workers", None))
         self._prefix = pool_prefix
+        self.executor = executor
         self._statuses: dict[str, ProvisionStatus] = {}
         self._operations: dict[str, list[str]] = {}  # provision id -> ops
         self._pools: dict[str, list[str]] = {}       # provision id -> pools
@@ -72,6 +90,14 @@ class GkeNodePoolActuator:
         self._rollbacks: dict[str, list[str]] = {}
         self._rollback_attempts: dict[str, int] = {}
         self._ids = itertools.count(int(time.time()) % 100000)
+        # Executor-mode bookkeeping (all mutated on the reconcile thread
+        # via drain-run callbacks):
+        self._pending_creates: dict[str, int] = {}   # pid -> outstanding POSTs
+        self._created_pools: dict[str, list[str]] = {}
+        self._rollback_inflight: set[str] = set()    # pool names
+        self._list_ok = batch_poll
+        self._poll_inflight = False
+        self._op_gets_inflight: set[str] = set()     # op names
 
     def set_metrics(self, metrics) -> None:
         """Wire the controller's metrics into the REST layer (the
@@ -137,12 +163,28 @@ class GkeNodePoolActuator:
                                  state=ACCEPTED)
         self._statuses[status.id] = status
         self._pools[status.id] = pool_names
+        if self.executor is not None:
+            # All pool creates for this request dispatch concurrently;
+            # success/failure (and the rollback set for a partial
+            # failure) resolve in _on_create_done at a later drain.
+            self._operations[status.id] = []
+            self._pending_creates[status.id] = len(pool_names)
+            for pool_name in pool_names:
+                self._rest.dispatch(
+                    self.executor, "POST",
+                    f"{self._api_base}/{self._parent}/nodePools",
+                    self._pool_body(request, pool_name),
+                    on_done=functools.partial(self._on_create_done,
+                                              status, pool_name),
+                    label=f"pool-create:{pool_name}")
+            return status
         ops: list[str] = []
         created: list[str] = []
         try:
             for pool_name in pool_names:
-                op = self._rest.post(f"{self._api_base}/{self._parent}/nodePools",
-                                     self._pool_body(request, pool_name))
+                op = self._rest.post(
+                    f"{self._api_base}/{self._parent}/nodePools",
+                    self._pool_body(request, pool_name))
                 created.append(pool_name)
                 if op.get("name"):
                     ops.append(op["name"])
@@ -163,14 +205,66 @@ class GkeNodePoolActuator:
         self._operations[status.id] = ops
         return status
 
+    def _on_create_done(self, status: ProvisionStatus, pool_name: str,
+                        result, error) -> None:
+        """One pool-create POST resolved (reconcile thread, via drain)."""
+        pid = status.id
+        remaining = self._pending_creates.get(pid, 1) - 1
+        self._pending_creates[pid] = remaining
+        if error is not None:
+            self._rest.inc("actuator_api_errors")
+            if status.state != FAILED:
+                status.fail(error)
+                log.error("node pool create failed for %s (%s): %s",
+                          pid, status.reason, error)
+        else:
+            self._created_pools.setdefault(pid, []).append(pool_name)
+            if result.get("name"):
+                self._operations.setdefault(pid, []).append(result["name"])
+        if remaining > 0:
+            return
+        # Last POST of the request resolved: settle the outcome.
+        self._pending_creates.pop(pid, None)
+        created = self._created_pools.pop(pid, [])
+        if status.state == FAILED and created:
+            # Partial failure: roll back the siblings that DID create
+            # (same contract as the serial path).
+            self._rollbacks[pid] = created
+
     def _process_rollbacks(self) -> None:
         """Retry deletes of partially-created pools until GKE accepts
-        them (or attempts run out and idle timeout becomes the backstop)."""
+        them (or attempts run out and idle timeout becomes the backstop).
+        Executor mode dispatches the deletes concurrently, guarded so a
+        pool with a delete already in flight is never double-dispatched
+        (poll runs every pass; completions land at drain time)."""
         for pid, pools in list(self._rollbacks.items()):
+            pending = [p for p in pools if p in self._rollback_inflight]
+            to_try = [p for p in pools if p not in self._rollback_inflight]
+            if not to_try:
+                continue  # whole set already dispatched, awaiting drain
             attempts = self._rollback_attempts.get(pid, 0) + 1
             self._rollback_attempts[pid] = attempts
-            remaining: list[str] = []
-            for pool_name in pools:
+            if attempts > self.ROLLBACK_MAX_ATTEMPTS:
+                log.error(
+                    "giving up rollback for %s after %d attempts; pools %s "
+                    "will be reclaimed by idle timeout", pid, attempts - 1,
+                    pools)
+                self._rollbacks.pop(pid, None)
+                self._rollback_attempts.pop(pid, None)
+                continue
+            if self.executor is not None:
+                for pool_name in to_try:
+                    self._rollback_inflight.add(pool_name)
+                    self._rest.dispatch(
+                        self.executor, "DELETE",
+                        f"{self._api_base}/{self._parent}"
+                        f"/nodePools/{pool_name}",
+                        on_done=functools.partial(self._on_rollback_done,
+                                                  pid, pool_name),
+                        label=f"pool-rollback:{pool_name}")
+                continue
+            remaining: list[str] = list(pending)
+            for pool_name in to_try:
                 try:
                     self._rest.delete(
                         f"{self._api_base}/{self._parent}"
@@ -183,37 +277,174 @@ class GkeNodePoolActuator:
             if not remaining:
                 self._rollbacks.pop(pid, None)
                 self._rollback_attempts.pop(pid, None)
-            elif attempts >= self.ROLLBACK_MAX_ATTEMPTS:
-                log.error(
-                    "giving up rollback for %s after %d attempts; pools %s "
-                    "will be reclaimed by idle timeout", pid, attempts,
-                    remaining)
-                self._rollbacks.pop(pid, None)
             else:
                 self._rollbacks[pid] = remaining
 
+    def _on_rollback_done(self, pid: str, pool_name: str, result,
+                          error) -> None:
+        """Rollback DELETE resolved (reconcile thread, via drain)."""
+        self._rollback_inflight.discard(pool_name)
+        if error is not None:
+            # Create op likely still running; poll() redispatches until
+            # ROLLBACK_MAX_ATTEMPTS runs out.
+            self._rest.inc("rollback_retries")
+            log.debug("rollback delete not yet accepted for %s: %s",
+                      pool_name, error)
+            return
+        remaining = [p for p in self._rollbacks.get(pid, [])
+                     if p != pool_name]
+        if remaining:
+            self._rollbacks[pid] = remaining
+        else:
+            self._rollbacks.pop(pid, None)
+            self._rollback_attempts.pop(pid, None)
+
     def delete(self, unit_id: str) -> None:
         try:
-            self._rest.delete(f"{self._api_base}/{self._parent}/nodePools/{unit_id}")
+            # Blocking in both modes (rare, scale-down path; see
+            # docs/ACTUATION.md).
+            self._rest.delete(
+                f"{self._api_base}/{self._parent}/nodePools/{unit_id}")
         except Exception:  # noqa: BLE001 — retried by the maintain loop
             self._rest.inc("actuator_delete_errors")
             log.exception("node pool delete failed for %s", unit_id)
 
+    # ---- poll -----------------------------------------------------------
+
     def poll(self, now: float) -> None:
         self._process_rollbacks()
+        if not self._rest.dry_run and self._pending_ops():
+            if self._list_ok:
+                # A serial LIST that proves unavailable flips _list_ok
+                # and the SAME pass falls through to per-op GETs below
+                # (executor mode learns at the next drain instead).
+                self._poll_ops_via_list()
+            if not self._list_ok:
+                self._poll_ops_each()
+        # Statuses with no operations recorded (dry-run returns no op
+        # names; creates may still be dispatching) stay/advance here.
         for pid, status in self._statuses.items():
             if status.state not in (ACCEPTED, PROVISIONING):
                 continue
-            ops = self._operations.get(pid) or []
-            if not ops:
-                if not self._rest.dry_run:
-                    status.state = PROVISIONING
+            if not (self._operations.get(pid) or []) \
+                    and not self._rest.dry_run \
+                    and pid not in self._pending_creates:
+                status.state = PROVISIONING
+        for pid, status in list(self._statuses.items()):
+            if status.state in (ACTIVE, FAILED):
+                done = self._done_at.setdefault(pid, now)
+                if now - done > self.STATUS_RETENTION_SECONDS:
+                    del self._statuses[pid]
+                    self._operations.pop(pid, None)
+                    self._pools.pop(pid, None)
+                    self._done_at.pop(pid, None)
+
+    def _pending_ops(self) -> dict[str, list[str]]:
+        """provision id -> operation names still being waited on.
+        Excludes provisions with create POSTs still outstanding: a
+        multi-pool request must never resolve ACTIVE off the ops that
+        DID land while a sibling's create is parked on a retry."""
+        return {pid: ops for pid, status in self._statuses.items()
+                if status.state in (ACCEPTED, PROVISIONING)
+                and pid not in self._pending_creates
+                and (ops := self._operations.get(pid))}
+
+    # -- batched operations LIST
+
+    def _poll_ops_via_list(self) -> None:
+        if self.executor is not None:
+            if self._poll_inflight:
+                return
+            self._poll_inflight = True
+            self.executor.submit(self._fetch_ops_once,
+                                 self._on_ops_list_done, label="gke-ops")
+            return
+        try:
+            ops_map = self._fetch_ops(self._rest.get)
+        except Exception as e:  # noqa: BLE001 — transient; retry next pass
+            self._rest.inc("actuator_poll_errors")
+            self._note_list_failure(e)
+            return
+        self._apply_ops(ops_map)
+
+    def _fetch_ops_once(self) -> dict[str, dict]:
+        """Worker-thread LIST: no actuator state beyond immutable config."""
+        return self._fetch_ops(lambda url: self._rest.once("GET", url))
+
+    def _fetch_ops(self, fetch) -> dict[str, dict]:
+        """ONE operations LIST under the project/location, indexed by
+        both the full operation name and its last path segment (create
+        responses record fully-qualified names; the list returns
+        whatever the API surface uses)."""
+        resp = fetch(f"{self._api_base}/{self._ops_parent}/operations")
+        ops_map: dict[str, dict] = {}
+        for op in resp.get("operations", []):
+            name = op.get("name", "")
+            if not name:
                 continue
+            ops_map[name] = op
+            ops_map[name.rsplit("/", 1)[-1]] = op
+        return ops_map
+
+    def _on_ops_list_done(self, ops_map, error) -> None:
+        self._poll_inflight = False
+        if error is not None:
+            self._rest.inc("actuator_poll_errors")
+            self._note_list_failure(error)
+            return
+        self._apply_ops(ops_map)
+
+    def _note_list_failure(self, error) -> None:
+        if note_list_failure(self._rest, error, "GKE operations"):
+            self._list_ok = False
+
+    def _apply_ops(self, ops_map: dict[str, dict]) -> None:
+        """Resolve every waiting provision against one ops snapshot
+        (reconcile thread).  An operation absent from the snapshot is
+        treated as still running — the controller's provision_timeout
+        backstops an operation that truly vanished."""
+        batch = 0
+        for pid, ops in self._pending_ops().items():
+            status = self._statuses[pid]
+            all_done, error = True, None
+            for op_name in ops:
+                op = ops_map.get(op_name) \
+                    or ops_map.get(op_name.rsplit("/", 1)[-1])
+                if op is None:
+                    all_done = False
+                    self._rest.inc("poll_ops_missing")
+                    continue
+                batch += 1
+                if op.get("status") != "DONE":
+                    all_done = False
+                    continue
+                if op.get("error"):
+                    error = str(op["error"])
+            self._resolve(status, pid, all_done, error)
+        self._rest.observe("poll_batch_size", batch)
+
+    # -- per-operation GET fallback
+
+    def _poll_ops_each(self) -> None:
+        for pid, ops in self._pending_ops().items():
+            if self.executor is not None:
+                for op_name in ops:
+                    if op_name in self._op_gets_inflight:
+                        continue
+                    self._op_gets_inflight.add(op_name)
+                    # Operation names are already fully qualified
+                    # (projects/.../operations/...).
+                    self._rest.dispatch(
+                        self.executor, "GET",
+                        f"{self._api_base}/{op_name}",
+                        on_done=functools.partial(self._on_op_get_done,
+                                                  pid, op_name),
+                        label=f"op-poll:{op_name.rsplit('/', 1)[-1]}")
+                continue
+            status = self._statuses[pid]
             all_done, error = True, None
             for op_name in ops:
                 try:
-                    # Operation names are already fully qualified
-                    # (projects/.../operations/...).
                     op = self._rest.get(f"{self._api_base}/{op_name}")
                 except Exception:  # noqa: BLE001 — transient; retry later
                     self._rest.inc("actuator_poll_errors")
@@ -225,21 +456,42 @@ class GkeNodePoolActuator:
                     break
                 if op.get("error"):
                     error = str(op["error"])
-            if error is not None:
-                status.fail(error)
-            elif all_done:
-                status.state = ACTIVE
-                status.unit_ids = list(self._pools.get(pid, [pid]))
-            else:
-                status.state = PROVISIONING
-        for pid, status in list(self._statuses.items()):
-            if status.state in (ACTIVE, FAILED):
-                done = self._done_at.setdefault(pid, now)
-                if now - done > self.STATUS_RETENTION_SECONDS:
-                    del self._statuses[pid]
-                    self._operations.pop(pid, None)
-                    self._pools.pop(pid, None)
-                    self._done_at.pop(pid, None)
+            self._resolve(status, pid, all_done, error)
+
+    def _on_op_get_done(self, pid: str, op_name: str, op, error) -> None:
+        """Per-op GET resolved (reconcile thread, via drain).  Completed
+        ops are dropped from the provision's waiting list; when the list
+        empties the provision resolves."""
+        self._op_gets_inflight.discard(op_name)
+        status = self._statuses.get(pid)
+        if status is None or status.state not in (ACCEPTED, PROVISIONING):
+            return
+        if error is not None:
+            self._rest.inc("actuator_poll_errors")
+            log.warning("operation poll failed for %s: %s", pid, error)
+            return
+        if op.get("status") != "DONE":
+            return
+        if op.get("error"):
+            status.fail(str(op["error"]))
+            return
+        remaining = [o for o in self._operations.get(pid, [])
+                     if o != op_name]
+        self._operations[pid] = remaining
+        if not remaining:
+            self._resolve(status, pid, True, None)
+
+    # -- shared resolution
+
+    def _resolve(self, status: ProvisionStatus, pid: str, all_done: bool,
+                 error: str | None) -> None:
+        if error is not None:
+            status.fail(error)
+        elif all_done:
+            status.state = ACTIVE
+            status.unit_ids = list(self._pools.get(pid, [pid]))
+        else:
+            status.state = PROVISIONING
 
     def statuses(self) -> list[ProvisionStatus]:
         return list(self._statuses.values())
@@ -249,7 +501,9 @@ class GkeNodePoolActuator:
         if status is None or not status.in_flight:
             return
         # Delete whatever pools the stuck provision created; node-pool
-        # deletion supersedes a pending create on GKE.
+        # deletion supersedes a pending create on GKE.  Marking FAILED
+        # first also parks any still-in-flight create/poll dispatches:
+        # their drain-time callbacks skip non-in-flight statuses.
         for pool_name in self._pools.get(provision_id, [provision_id]):
             self.delete(pool_name)
         status.state = FAILED

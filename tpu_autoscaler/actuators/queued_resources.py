@@ -17,10 +17,29 @@ the queued-resource id, paired with the node-registration agent
 SLICE_ID_LABEL with that id on each host's Node object.  For GKE clusters
 use ``GkeNodePoolActuator``, whose node pools register labeled nodes
 natively.
+
+Actuation pipeline (ISSUE 3, docs/ACTUATION.md):
+
+- With an :class:`~tpu_autoscaler.actuators.executor.ActuationExecutor`
+  attached, create POSTs and polls are *dispatched* non-blocking;
+  results apply to actuator state only via completion callbacks run by
+  ``executor.drain()`` on the reconcile thread (top of
+  ``reconcile_once``).  Without one, every call is the old blocking
+  round-trip — tests and the bench's serial baseline use that mode.
+- Polling is batched: ONE server-side LIST of ``queuedResources`` under
+  the parent replaces N per-id GETs.  When LIST is unavailable
+  (404/403/400/501 — older API surfaces, restrictive IAM), polling
+  falls back to per-id GETs permanently for the process lifetime.
+- An id that is gone (per-id GET 404, or absent from
+  ``LIST_MISS_THRESHOLD`` consecutive complete LISTs) was deleted out
+  of band: that provision is terminally FAILED (reason
+  ``deleted-out-of-band``) so its demand can re-provision, instead of
+  being re-polled forever as transient.
 """
 
 from __future__ import annotations
 
+import functools
 import itertools
 import logging
 import time
@@ -32,7 +51,12 @@ from tpu_autoscaler.actuators.base import (
     PROVISIONING,
     ProvisionStatus,
 )
-from tpu_autoscaler.actuators.gcp import GcpRest, TokenProvider
+from tpu_autoscaler.actuators.gcp import (
+    GcpApiError,
+    GcpRest,
+    TokenProvider,
+    note_list_failure,
+)
 from tpu_autoscaler.engine.planner import ProvisionRequest
 from tpu_autoscaler.topology.catalog import SLICE_SHAPES
 
@@ -52,6 +76,12 @@ _STATE_MAP = {
     "SUSPENDING": FAILED,
 }
 
+#: Consecutive complete LISTs an id must be absent from before its
+#: absence is even worth confirming (one miss could be read-after-write
+#: lag on a just-created resource).  Absence alone is never terminal:
+#: it triggers a per-id GET, and only that GET's 404 kills.
+LIST_MISS_THRESHOLD = 2
+
 
 class QueuedResourceActuator:
     """Implements the Actuator protocol over Cloud TPU queuedResources."""
@@ -61,15 +91,18 @@ class QueuedResourceActuator:
     def __init__(self, project: str, zone: str, dry_run: bool = False,
                  rest: GcpRest | None = None,
                  runtime_version: str = "tpu-ubuntu2204-base",
-                 name_prefix: str = "tpuas"):
+                 name_prefix: str = "tpuas",
+                 executor=None, batch_poll: bool = True):
         if not (project and zone):
             raise ValueError(
                 "QueuedResource actuator needs --project and --location")
         self._parent = f"projects/{project}/locations/{zone}"
-        self._rest = rest or GcpRest(dry_run=dry_run,
-                                     token_provider=TokenProvider())
+        self._rest = rest or GcpRest(
+            dry_run=dry_run, token_provider=TokenProvider(),
+            pool_maxsize=getattr(executor, "max_workers", None))
         self._runtime = runtime_version
         self._prefix = name_prefix
+        self.executor = executor
         self._statuses: dict[str, ProvisionStatus] = {}
         self._done_at: dict[str, float] = {}
         # unit id -> owning queued-resource id.  For single-slice QRs the
@@ -78,12 +111,26 @@ class QueuedResourceActuator:
         self._unit_owner: dict[str, str] = {}
         self._qr_counts: dict[str, int] = {}
         self._ids = itertools.count(int(time.time()) % 100000)
+        # qr ids whose create POST has succeeded — only these are
+        # pollable (and only these may be declared deleted when a LIST
+        # doesn't return them; a pending create is legitimately absent).
+        self._created: set[str] = set()
+        # Batched-LIST polling state: enabled until the endpoint proves
+        # unavailable, then per-id GETs forever (the fallback).
+        self._list_ok = batch_poll
+        self._list_misses: dict[str, int] = {}
+        # Dispatch guards (executor mode): at most one LIST in flight,
+        # at most one GET per id in flight.
+        self._poll_inflight = False
+        self._gets_inflight: set[str] = set()
 
     def set_metrics(self, metrics) -> None:
         """Wire the controller's metrics into the REST layer (the
         Controller calls this on construction) so rest_retries lands in
         the same registry as every other counter."""
         self._rest._metrics = metrics
+
+    # ---- provision ------------------------------------------------------
 
     def provision(self, request: ProvisionRequest) -> ProvisionStatus:
         if request.kind != "tpu-slice":
@@ -127,16 +174,46 @@ class QueuedResourceActuator:
         self._unit_owner[qr_id] = qr_id
         for i in range(request.count if request.count > 1 else 0):
             self._unit_owner[f"{qr_id}-{i}"] = qr_id
+        url = (f"{_BASE}/{self._parent}/queuedResources"
+               f"?queuedResourceId={qr_id}")
+        if self.executor is not None:
+            self._rest.dispatch(
+                self.executor, "POST", url, body,
+                on_done=functools.partial(self._on_create_done, status),
+                label=f"qr-create:{qr_id}")
+            return status
         try:
-            self._rest.post(
-                f"{_BASE}/{self._parent}/queuedResources"
-                f"?queuedResourceId={qr_id}", body)
+            self._rest.post(url, body)
+            self._created.add(qr_id)
         except Exception as e:  # noqa: BLE001 — surface as FAILED status
             self._rest.inc("actuator_api_errors")
             status.fail(e)
             log.exception("queued resource create failed for %s (%s)",
                           qr_id, status.reason)
         return status
+
+    def _on_create_done(self, status: ProvisionStatus, result,
+                        error) -> None:
+        """Create-POST completion (reconcile thread, via drain)."""
+        if error is not None:
+            self._rest.inc("actuator_api_errors")
+            if status.in_flight:  # a cancel() may have resolved it first
+                status.fail(error)
+                log.error("queued resource create failed for %s (%s): %s",
+                          status.id, status.reason, error)
+            return
+        self._created.add(status.id)
+        if not status.in_flight:
+            # cancel() raced the create and its DELETE 404'd (the QR
+            # did not exist yet).  It exists NOW, billed, with a
+            # terminal status nothing will poll or reclaim — tear it
+            # down (the GKE path's rollback queue, QR-style: a forced
+            # delete supersedes any state).
+            log.warning("queued resource %s created after its provision "
+                        "was cancelled; deleting the orphan", status.id)
+            self._delete_qr(status.id)
+
+    # ---- delete / cancel ------------------------------------------------
 
     def delete(self, unit_id: str) -> None:
         qr_id = self._unit_owner.get(unit_id)
@@ -158,7 +235,13 @@ class QueuedResourceActuator:
             log.warning("delete(%s): multislice queued resource %s is "
                         "reclaimed whole (%d slices)", unit_id, qr_id,
                         self._qr_counts.get(qr_id, 1))
+        self._delete_qr(qr_id)
+
+    def _delete_qr(self, qr_id: str) -> None:
         try:
+            # Deletes stay blocking in both modes: they are rare
+            # (scale-down / cancel), and their bookkeeping must only
+            # clear on confirmed success (docs/ACTUATION.md).
             self._rest.delete(
                 f"{_BASE}/{self._parent}/queuedResources/{qr_id}"
                 "?force=true")
@@ -168,55 +251,7 @@ class QueuedResourceActuator:
             self._qr_counts.pop(qr_id, None)
         except Exception:  # noqa: BLE001 — retried by the maintain loop
             self._rest.inc("actuator_delete_errors")
-            log.exception("queued resource delete failed for %s", unit_id)
-
-    def poll(self, now: float) -> None:
-        for qr_id, status in self._statuses.items():
-            if status.state not in (ACCEPTED, PROVISIONING):
-                continue
-            if self._rest.dry_run:
-                continue
-            try:
-                qr = self._rest.get(
-                    f"{_BASE}/{self._parent}/queuedResources/{qr_id}")
-            except Exception:  # noqa: BLE001 — transient; retry next pass
-                self._rest.inc("actuator_poll_errors")
-                log.exception("queued resource poll failed for %s", qr_id)
-                continue
-            state_obj = qr.get("state") or {}
-            api_state = state_obj.get("state", "")
-            mapped = _STATE_MAP.get(api_state, PROVISIONING)
-            if mapped == ACTIVE:
-                status.state = mapped
-                count = self._qr_counts.get(qr_id, 1)
-                status.unit_ids = (
-                    [qr_id] if count == 1
-                    else [f"{qr_id}-{i}" for i in range(count)])
-            elif mapped == FAILED:
-                # The API attaches the denial detail as a google.rpc
-                # Status under the state's *Data field (failedData for
-                # FAILED, suspendedData/suspendingData otherwise) —
-                # that message is where stockout-vs-quota lives.
-                detail = ""
-                for key in ("failedData", "suspendedData",
-                            "suspendingData"):
-                    err = (state_obj.get(key) or {}).get("error") or {}
-                    if err.get("message"):
-                        detail = err["message"]
-                        break
-                status.fail(f"{api_state}: {detail}" if detail
-                            else api_state)
-            else:
-                status.state = mapped
-        for qr_id, status in list(self._statuses.items()):
-            if status.state in (ACTIVE, FAILED):
-                done = self._done_at.setdefault(qr_id, now)
-                if now - done > self.STATUS_RETENTION_SECONDS:
-                    del self._statuses[qr_id]
-                    self._done_at.pop(qr_id, None)
-
-    def statuses(self) -> list[ProvisionStatus]:
-        return list(self._statuses.values())
+            log.exception("queued resource delete failed for %s", qr_id)
 
     def cancel(self, provision_id: str) -> None:
         status = self._statuses.get(provision_id)
@@ -227,3 +262,221 @@ class QueuedResourceActuator:
         self.delete(provision_id)
         status.state = FAILED
         status.error = "cancelled: provision timeout"
+
+    # ---- poll -----------------------------------------------------------
+
+    def poll(self, now: float) -> None:
+        """Advance provisioning state.  Executor mode only *dispatches*
+        I/O here; results land at the next pass's drain.  Serial mode
+        applies them in-place (old blocking behavior)."""
+        if not self._rest.dry_run:
+            pollable = [qr_id for qr_id, s in self._statuses.items()
+                        if s.state in (ACCEPTED, PROVISIONING)
+                        and qr_id in self._created]
+            if pollable and self._list_ok:
+                # A serial LIST that proves unavailable flips _list_ok
+                # and the SAME pass falls through to per-id GETs below
+                # (executor mode learns at the next drain instead).
+                self._poll_via_list()
+            if pollable and not self._list_ok:
+                self._poll_each(pollable)
+        for qr_id, status in list(self._statuses.items()):
+            if status.state in (ACTIVE, FAILED):
+                done = self._done_at.setdefault(qr_id, now)
+                if now - done > self.STATUS_RETENTION_SECONDS:
+                    del self._statuses[qr_id]
+                    self._done_at.pop(qr_id, None)
+                    self._created.discard(qr_id)
+                    self._list_misses.pop(qr_id, None)
+                    if status.state == FAILED:
+                        # A FAILED provision's units never registered:
+                        # its ownership bookkeeping would otherwise leak
+                        # forever under a chronic-stockout retry loop
+                        # (fresh qr_id every pass).  ACTIVE units keep
+                        # theirs until delete() reclaims them.
+                        for uid, owner in list(self._unit_owner.items()):
+                            if owner == qr_id:
+                                del self._unit_owner[uid]
+                        self._qr_counts.pop(qr_id, None)
+
+    # -- batched LIST path
+
+    def _poll_via_list(self) -> None:
+        if self.executor is not None:
+            if self._poll_inflight:
+                return  # previous LIST still pending: no pile-up
+            self._poll_inflight = True
+            self.executor.submit(self._fetch_list_once,
+                                 self._on_list_done, label="qr-list")
+            return
+        try:
+            items = self._fetch_list_blocking()
+        except Exception as e:  # noqa: BLE001 — transient; retry next pass
+            self._rest.inc("actuator_poll_errors")
+            self._note_list_failure(e)
+            return
+        self._apply_list(items)
+
+    def _list_url(self, page_token: str) -> str:
+        from urllib.parse import quote
+
+        # The page token is opaque and may hold reserved characters; an
+        # unencoded '+' would decode as a space server-side and the 400
+        # would permanently flip polling to per-id GETs — on exactly the
+        # large fleets where batching matters.
+        return (f"{_BASE}/{self._parent}/queuedResources?pageSize=500"
+                + (f"&pageToken={quote(page_token, safe='')}"
+                   if page_token else ""))
+
+    def _fetch_list_once(self) -> dict[str, dict]:
+        """All queuedResources under the parent, keyed by short id.
+        Runs on an executor worker: touches NO actuator state beyond
+        immutable config.  A retryable failure mid-pagination restarts
+        the whole LIST via the executor's reschedule."""
+        return self._fetch_pages(lambda url: self._rest.once("GET", url))
+
+    def _fetch_list_blocking(self) -> dict[str, dict]:
+        return self._fetch_pages(self._rest.get)
+
+    def _fetch_pages(self, fetch) -> dict[str, dict]:
+        items: dict[str, dict] = {}
+        page_token = ""
+        while True:
+            resp = fetch(self._list_url(page_token))
+            for qr in resp.get("queuedResources", []):
+                name = qr.get("name", "")
+                items[name.rsplit("/", 1)[-1]] = qr
+            page_token = resp.get("nextPageToken", "")
+            if not page_token:
+                return items
+
+    def _on_list_done(self, items, error) -> None:
+        """LIST completion (reconcile thread, via drain)."""
+        self._poll_inflight = False
+        if error is not None:
+            self._rest.inc("actuator_poll_errors")
+            self._note_list_failure(error)
+            return
+        self._apply_list(items)
+
+    def _note_list_failure(self, error) -> None:
+        if note_list_failure(self._rest, error, "queuedResources"):
+            self._list_ok = False
+
+    def _apply_list(self, items: dict[str, dict]) -> None:
+        """Apply one complete LIST to every pollable status (reconcile
+        thread).  Recomputes the pollable set at apply time — statuses
+        may have changed since the LIST was dispatched."""
+        batch = 0
+        confirm: list[str] = []
+        for qr_id, status in self._statuses.items():
+            if status.state not in (ACCEPTED, PROVISIONING) \
+                    or qr_id not in self._created:
+                continue
+            qr = items.get(qr_id)
+            if qr is None:
+                misses = self._list_misses.get(qr_id, 0) + 1
+                self._list_misses[qr_id] = misses
+                if misses >= LIST_MISS_THRESHOLD:
+                    # Absence alone never kills — the LIST index can lag
+                    # writes by several poll intervals.  Confirm with a
+                    # per-id GET; only its 404 is terminal
+                    # (deleted-out-of-band), a found QR applies state.
+                    confirm.append(qr_id)
+                continue
+            self._list_misses.pop(qr_id, None)
+            batch += 1
+            self._apply_state(status, qr)
+        self._rest.observe("poll_batch_size", batch)
+        if confirm:
+            self._poll_each(confirm)
+
+    # -- per-id GET fallback
+
+    def _poll_each(self, pollable: list[str]) -> None:
+        for qr_id in pollable:
+            url = f"{_BASE}/{self._parent}/queuedResources/{qr_id}"
+            if self.executor is not None:
+                if qr_id in self._gets_inflight:
+                    continue
+                self._gets_inflight.add(qr_id)
+                self._rest.dispatch(
+                    self.executor, "GET", url,
+                    on_done=functools.partial(self._on_get_done, qr_id),
+                    label=f"qr-poll:{qr_id}")
+                continue
+            try:
+                qr = self._rest.get(url)
+            except GcpApiError as e:
+                if e.http_status == 404:
+                    # Deleted out of band (operator, janitor, TTL): a
+                    # 404 can never heal, so mark the provision FAILED
+                    # — its demand re-provisions — instead of re-polling
+                    # it forever as transient.
+                    self._fail_deleted(self._statuses[qr_id])
+                    continue
+                self._rest.inc("actuator_poll_errors")
+                log.exception("queued resource poll failed for %s", qr_id)
+                continue
+            except Exception:  # noqa: BLE001 — transient; retry next pass
+                self._rest.inc("actuator_poll_errors")
+                log.exception("queued resource poll failed for %s", qr_id)
+                continue
+            self._apply_state(self._statuses[qr_id], qr)
+
+    def _on_get_done(self, qr_id: str, qr, error) -> None:
+        """Per-id GET completion (reconcile thread, via drain)."""
+        self._gets_inflight.discard(qr_id)
+        status = self._statuses.get(qr_id)
+        if status is None or status.state not in (ACCEPTED, PROVISIONING):
+            return  # pruned or terminal (e.g. cancelled) while in flight
+        if error is not None:
+            if isinstance(error, GcpApiError) and error.http_status == 404:
+                self._fail_deleted(status)
+                return
+            self._rest.inc("actuator_poll_errors")
+            log.warning("queued resource poll failed for %s: %s",
+                        qr_id, error)
+            return
+        self._apply_state(status, qr)
+
+    # -- shared state application
+
+    def _fail_deleted(self, status: ProvisionStatus) -> None:
+        status.state = FAILED
+        status.error = ("queued resource deleted out of band "
+                        "(not found while polling)")
+        status.reason = "deleted-out-of-band"
+        self._list_misses.pop(status.id, None)
+        log.warning("queued resource %s deleted out of band; marking "
+                    "FAILED so its demand re-provisions", status.id)
+
+    def _apply_state(self, status: ProvisionStatus, qr: dict) -> None:
+        state_obj = qr.get("state") or {}
+        api_state = state_obj.get("state", "")
+        mapped = _STATE_MAP.get(api_state, PROVISIONING)
+        if mapped == ACTIVE:
+            status.state = mapped
+            count = self._qr_counts.get(status.id, 1)
+            status.unit_ids = (
+                [status.id] if count == 1
+                else [f"{status.id}-{i}" for i in range(count)])
+        elif mapped == FAILED:
+            # The API attaches the denial detail as a google.rpc
+            # Status under the state's *Data field (failedData for
+            # FAILED, suspendedData/suspendingData otherwise) —
+            # that message is where stockout-vs-quota lives.
+            detail = ""
+            for key in ("failedData", "suspendedData",
+                        "suspendingData"):
+                err = (state_obj.get(key) or {}).get("error") or {}
+                if err.get("message"):
+                    detail = err["message"]
+                    break
+            status.fail(f"{api_state}: {detail}" if detail
+                        else api_state)
+        else:
+            status.state = mapped
+
+    def statuses(self) -> list[ProvisionStatus]:
+        return list(self._statuses.values())

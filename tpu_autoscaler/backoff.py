@@ -17,6 +17,16 @@ from __future__ import annotations
 WATCH_BACKOFF_BASE_S = 1.0
 WATCH_BACKOFF_CAP_S = 60.0
 
+#: Cloud-REST retry schedule, shared by actuators/gcp.py (GcpRest's
+#: blocking loop) and actuators/executor.py (ActuationExecutor's
+#: reschedule-at-retry_at path) so the serial and pipelined dispatch
+#: modes back off identically for the same failure.
+REST_BACKOFF_BASE_S = 0.5
+REST_BACKOFF_CAP_S = 8.0
+#: A server-sent Retry-After is honored up to this multiple of the cap
+#: (longer hints must not park a provision past its dispatch deadline).
+REST_RETRY_AFTER_CAP_FACTOR = 4.0
+
 
 def watch_backoff_seconds(failure_streak: int, rng) -> float:
     """Watch-reconnect delay: exponential with full jitter,

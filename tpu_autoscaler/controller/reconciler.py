@@ -134,7 +134,7 @@ class Controller:
                  config: ControllerConfig | None = None,
                  notifier: Notifier | None = None,
                  metrics: Metrics | None = None,
-                 informer=None):
+                 informer=None, executor=None):
         self.client = client
         self.actuator = actuator
         self.config = config or ControllerConfig()
@@ -145,6 +145,17 @@ class Controller:
         # re-parsing the world.  None = the relist-every-pass baseline;
         # run_forever auto-creates one when the client can watch.
         self.informer = informer
+        # Pipelined actuation (actuators/executor.py): completed
+        # dispatches are drained at the top of every pass — the ONLY
+        # place actuator state mutates off the poll/provision calls —
+        # keeping all mutation on the reconcile thread.  Defaults to
+        # the executor the actuator was built with (main.py wires one
+        # into both); None = the serial blocking baseline.
+        self.executor = (executor if executor is not None
+                         else getattr(actuator, "executor", None))
+        if self.executor is not None \
+                and hasattr(self.executor, "set_metrics"):
+            self.executor.set_metrics(self.metrics)
         # Sticky staleness guard (_observe): node names a direct LIST
         # saw that the informer's node cache has not delivered yet.
         self._nodes_awaiting_cache: set[str] = set()
@@ -198,10 +209,16 @@ class Controller:
         now = time.time() if now is None else now
         t0 = time.perf_counter()
 
-        # Poll the actuator FIRST, then observe: a provision that just went
-        # ACTIVE must have its nodes visible in this pass's observation, or
-        # the planner would see neither the in-flight provision nor the new
+        # Drain the actuation executor, then poll the actuator, THEN
+        # observe.  Drain first: completed dispatches (create POSTs,
+        # batched polls) mutate actuator state here, on the reconcile
+        # thread — executor workers never touch it (docs/ACTUATION.md).
+        # Poll before observe: a provision that just went ACTIVE must
+        # have its nodes visible in this pass's observation, or the
+        # planner would see neither the in-flight provision nor the new
         # supply and double-provision.
+        if self.executor is not None:
+            self.executor.drain()
         self.actuator.poll(now)
         t_obs = time.perf_counter()
         nodes, pods = self._observe()

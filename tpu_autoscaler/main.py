@@ -304,24 +304,36 @@ def cli():
 @click.option("--leader-elect", is_flag=True,
               help="Coordinate replicas via a kube-system Lease; only the "
                    "leader acts.")
+@click.option("--actuation-workers", default=16, show_default=True,
+              type=click.IntRange(min=0),
+              help="Concurrent actuation dispatches (pooled sessions, "
+                   "batched polling; 0 = serial blocking actuation).")
 def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
-        project, location, cluster, dry_run, leader_elect, sleep, **kw):
+        project, location, cluster, dry_run, leader_elect,
+        actuation_workers, sleep, **kw):
     """Run against a real cluster (in-cluster, --kubeconfig, or
     --kube-url)."""
     kube = make_kube_client(kube_url, kube_token, kubeconfig, kube_context,
                             dry_run=dry_run)
+    executor = None
+    if actuation_workers > 0:
+        from tpu_autoscaler.actuators.executor import ActuationExecutor
+
+        executor = ActuationExecutor(max_workers=actuation_workers)
     if actuator_kind == "gke":
         from tpu_autoscaler.actuators.gke import GkeNodePoolActuator
 
         actuator = GkeNodePoolActuator(project=project, location=location,
-                                       cluster=cluster, dry_run=dry_run)
+                                       cluster=cluster, dry_run=dry_run,
+                                       executor=executor)
     else:
         from tpu_autoscaler.actuators.queued_resources import (
             QueuedResourceActuator,
         )
 
         actuator = QueuedResourceActuator(project=project, zone=location,
-                                          dry_run=dry_run)
+                                          dry_run=dry_run,
+                                          executor=executor)
     # NOTE: no --once / cron mode on purpose: in-flight provision tracking
     # and all scale-down timers are in-memory by design (crash-only), so a
     # process-per-pass invocation would double-provision materializing
