@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# CI-friendly static + concurrency gate (ISSUE 4 satellite): runs
+# analysis → mypy → race tier in order, each stage with a DISTINCT exit
+# code so a CI job can tell which stage failed from $? alone:
+#
+#   0  everything green
+#   2  invariant analysis (all checkers incl. TAR5xx, unused waivers,
+#      stale baseline parse errors)
+#   3  mypy strict islands (only when mypy is importable)
+#   4  deterministic-schedule race tier
+#
+# Analysis output defaults to GitHub Actions workflow-command
+# annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
+# plain file:line:CODE lines locally.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fmt="${ANALYSIS_FORMAT:-github}"
+
+echo "== [1/3] invariant analysis (--format=$fmt)"
+python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
+
+echo "== [2/3] mypy strict islands"
+# One source of truth for the strict-island list: lint.sh.
+./scripts/lint.sh --mypy-only || exit 3
+
+echo "== [3/3] deterministic-schedule race tier"
+# One source of truth for the tier invocation: race.sh (its static
+# TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
+./scripts/race.sh || exit 4
+
+echo "CI GATE GREEN"

@@ -13,8 +13,14 @@ cd "$(dirname "$0")/.."
 
 # Static gate first: the invariant linter is sub-second and catches
 # architectural regressions (planner purity, thread discipline,
-# exception hygiene, jax purity) before any test burns wall-clock.
+# exception hygiene, jax purity, interprocedural races) before any
+# test burns wall-clock.
 ./scripts/lint.sh
+
+# Race gate (ISSUE 4): static TAR5xx pass + the deterministic-schedule
+# concurrency tier (seeded interleavings of the real informer/executor/
+# reconciler paths under a vector-clock happens-before checker).
+./scripts/race.sh
 
 # Observe-path tier: informer vs relist-baseline at 5k pods/600 nodes
 # with 1% churn must hold the >= 5x speedup floor (ISSUE 2).  Also

@@ -5,20 +5,44 @@
 #
 #   ./scripts/lint.sh              # analyzer + mypy-if-present
 #   ./scripts/lint.sh --no-mypy    # analyzer only
+#   ./scripts/lint.sh --mypy-only  # just the mypy stage (ci_gate.sh
+#                                  # reuses this so the strict-island
+#                                  # list lives in exactly one place)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# THE strict-island list (mirrored in mypy.ini's per-module sections).
+MYPY_TARGETS=(
+  tpu_autoscaler/engine
+  tpu_autoscaler/k8s/objects.py
+  tpu_autoscaler/analysis
+  tpu_autoscaler/actuators/executor.py
+)
+
+run_mypy() {
+  if python -c "import mypy" >/dev/null 2>&1; then
+    echo "== mypy (strict islands: ${MYPY_TARGETS[*]})"
+    # Explicit hard-fail: an installed-but-failing mypy must gate, not
+    # merely report (ISSUE 4 satellite).
+    if ! python -m mypy --config-file mypy.ini "${MYPY_TARGETS[@]}"; then
+      echo "mypy FAILED on the strict islands" >&2
+      return 1
+    fi
+  else
+    echo "== mypy not installed; skipping (config: mypy.ini)"
+  fi
+}
+
+if [[ "${1:-}" == "--mypy-only" ]]; then
+  run_mypy
+  exit $?
+fi
 
 echo "== invariant linter (python -m tpu_autoscaler.analysis)"
 python -m tpu_autoscaler.analysis tpu_autoscaler/
 
 if [[ "${1:-}" != "--no-mypy" ]]; then
-  if python -c "import mypy" >/dev/null 2>&1; then
-    echo "== mypy (strict islands: engine/, k8s/objects.py)"
-    python -m mypy --config-file mypy.ini \
-      tpu_autoscaler/engine tpu_autoscaler/k8s/objects.py
-  else
-    echo "== mypy not installed; skipping (config: mypy.ini)"
-  fi
+  run_mypy
 fi
 
 echo "LINT GREEN"

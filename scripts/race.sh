@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Race gate (docs/ANALYSIS.md, ISSUE 4): both layers of the race
+# detector, cheapest first.
+#
+#   layer 1 — static: the interprocedural escape/lockset pass (TAR5xx)
+#             over the whole package (sub-2s);
+#   layer 2 — dynamic: the deterministic-schedule concurrency tier
+#             (tests/test_sched.py + tests/test_races.py), which drives
+#             the real informer/executor/reconciler code through seeded
+#             interleavings under a vector-clock happens-before checker.
+#
+# Run standalone before touching anything threaded; full_suite.sh runs
+# it too (after the lint gate).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== race layer 1: static TAR5xx (python -m tpu_autoscaler.analysis --races)"
+python -m tpu_autoscaler.analysis --races tpu_autoscaler/
+
+echo "== race layer 2: deterministic-schedule tier"
+JAX_PLATFORMS=cpu python -m pytest -q tests/test_sched.py tests/test_races.py \
+  -p no:cacheprovider
+
+echo "RACE GATE GREEN"

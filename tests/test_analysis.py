@@ -13,6 +13,7 @@ import textwrap
 import pytest
 
 from tpu_autoscaler.analysis import (
+    EscapeRaceChecker,
     ExceptionHygieneChecker,
     JaxPurityChecker,
     PurityChecker,
@@ -594,6 +595,386 @@ class TestJaxPurityChecker:
 
 
 # --------------------------------------------------------------------- #
+# interprocedural escape/lockset races (TAR5xx)
+# --------------------------------------------------------------------- #
+
+def check_program(code, rel="tpu_autoscaler/mod.py"):
+    src = SourceFile("<fixture>", rel, textwrap.dedent(code))
+    checker = EscapeRaceChecker()
+    assert checker.applies_to(rel)
+    return checker.check_program([src])
+
+
+class TestEscapeRaceChecker:
+    def test_tar501_unlocked_write_races_locked_write_then_fixed(self):
+        bad = """
+            import threading
+
+            class Shared:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    self.n = self.n + 1
+
+                def reset(self):
+                    with self._lock:
+                        self.n = 0
+
+            class W(threading.Thread):
+                def __init__(self, s: Shared):
+                    super().__init__()
+                    self._s = s
+
+                def run(self):
+                    self._s.bump()
+        """
+        found = check_program(bad)
+        assert "TAR501" in codes_of(found)
+        assert any("W.run" in f.message and "main" in f.message
+                   for f in found)
+        fixed = bad.replace(
+            "    self.n = self.n + 1",
+            "    with self._lock:\n"
+            "                        self.n = self.n + 1")
+        assert check_program(fixed) == []
+
+    def test_tar502_unlocked_read_races_write_then_fixed(self):
+        bad = """
+            import threading
+
+            class Shared:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n = self.n + 1
+
+                def peek(self):
+                    return self.n
+
+            class W(threading.Thread):
+                def __init__(self, s: Shared):
+                    super().__init__()
+                    self._s = s
+
+                def run(self):
+                    self._s.bump()
+        """
+        found = check_program(bad)
+        assert codes_of(found) == ["TAR502"]
+        fixed = bad.replace(
+            "    return self.n",
+            "    with self._lock:\n"
+            "                        return self.n")
+        assert check_program(fixed) == []
+
+    def test_tar503_lockless_escape_then_fixed_with_lock(self):
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self.v = None
+
+                def put(self, v):
+                    self.v = v
+
+            class W(threading.Thread):
+                def __init__(self, b: Box):
+                    super().__init__()
+                    self._b = b
+
+                def run(self):
+                    self._b.put(1)
+
+            def use(b: Box):
+                b.put(2)
+        """
+        assert codes_of(check_program(bad)) == ["TAR503"]
+        fixed = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.v = None
+
+                def put(self, v):
+                    with self._lock:
+                        self.v = v
+
+            class W(threading.Thread):
+                def __init__(self, b: Box):
+                    super().__init__()
+                    self._b = b
+
+                def run(self):
+                    self._b.put(1)
+
+            def use(b: Box):
+                b.put(2)
+        """
+        assert check_program(fixed) == []
+
+    def test_init_construction_and_event_channel_are_exempt(self):
+        good = """
+            import threading
+
+            class Watcher(threading.Thread):
+                def __init__(self, items):
+                    super().__init__(daemon=True)
+                    self._items = items
+                    self._stopped = threading.Event()
+
+                def stop(self):
+                    self._stopped.set()
+
+                def run(self):
+                    while not self._stopped.is_set():
+                        self._step()
+
+                def _step(self):
+                    self._cursor = len(self._items)
+        """
+        assert check_program(good) == []
+
+    def test_pool_submit_thunk_is_a_thread_root(self):
+        bad = """
+            from concurrent.futures import ThreadPoolExecutor
+
+            class Svc:
+                def __init__(self):
+                    self._pool = ThreadPoolExecutor(max_workers=2)
+                    self.hits = 0
+
+                def _work(self):
+                    self.hits = self.hits + 1
+
+                def kick(self):
+                    self._pool.submit(self._work)
+
+                def reset(self):
+                    self.hits = 0
+        """
+        found = check_program(bad)
+        assert codes_of(found) == ["TAR503"]
+        assert any("thunk:Svc._work" in f.message for f in found)
+
+    def test_thread_target_and_cross_module_sharing_resolved(self):
+        # Two modules: a worker module defining the thread, a driver
+        # module constructing it against a class from a third — the
+        # whole point of WHOLE-program analysis.
+        shared = SourceFile("<s>", "tpu_autoscaler/shared.py",
+                            textwrap.dedent("""
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self):
+                    self.n = self.n + 1
+        """))
+        driver = SourceFile("<d>", "tpu_autoscaler/driver.py",
+                            textwrap.dedent("""
+            import threading
+
+            from tpu_autoscaler.shared import Counter
+
+            def main_loop():
+                c = Counter()
+                t = threading.Thread(target=c.bump)
+                t.start()
+                c.bump()
+        """))
+        found = EscapeRaceChecker().check_program([shared, driver])
+        assert codes_of(found) == ["TAR503"]
+        assert any("thunk" in f.message or "thread:" in f.message
+                   for f in found)
+
+    def test_getattr_dispatch_is_invisible_by_design(self):
+        # The static-blind seeded fixture contract (the schedule
+        # harness catches this one: tests/test_sched.py).
+        blind = """
+            import threading
+
+            class DynamicCounter:
+                def __init__(self):
+                    self._op = "bump"
+                    self.value = 0
+
+                def bump(self):
+                    self.value = self.value + 1
+
+                def poke(self):
+                    getattr(self, self._op)()
+
+            class W(threading.Thread):
+                def __init__(self, c: DynamicCounter):
+                    super().__init__()
+                    self._c = c
+
+                def run(self):
+                    self._c.poke()
+
+            def drive(c: DynamicCounter):
+                c.poke()
+        """
+        assert check_program(blind) == []
+
+    def test_module_level_lock_identity_is_shared(self):
+        good = """
+            import threading
+
+            _LOCK = threading.Lock()
+
+            class Store:
+                def __init__(self):
+                    self.data = {}
+
+                def put(self, k, v):
+                    with _LOCK:
+                        self.data[k] = v
+
+                def get(self, k):
+                    with _LOCK:
+                        return self.data.get(k)
+
+            class W(threading.Thread):
+                def __init__(self, s: Store):
+                    super().__init__()
+                    self._s = s
+
+                def run(self):
+                    self._s.put("a", 1)
+        """
+        assert check_program(good) == []
+
+    def test_repo_scale_run_is_fast(self):
+        # Acceptance: the WHOLE analysis (all checkers incl. TAR5xx)
+        # stays under 10 s on this repo; the escape pass alone must be
+        # well inside that.
+        import time
+
+        t0 = time.perf_counter()
+        res = run_analysis(
+            [os.path.join(REPO_ROOT, "tpu_autoscaler")],
+            default_checkers(), root=REPO_ROOT)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 10.0, f"analysis took {elapsed:.1f}s"
+        assert res.errors == []
+
+
+# --------------------------------------------------------------------- #
+# unused-waiver audit (TAW00x)
+# --------------------------------------------------------------------- #
+
+class TestUnusedWaivers:
+    def test_used_inline_waiver_is_not_reported(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(textwrap.dedent("""
+            _C = {}
+
+            def f(k):
+                _C[k] = 1  # analysis: allow=TAP104 fixture cache
+        """))
+        res = run_analysis([str(mod)], [PurityChecker(scope=("mod.py",))],
+                           root=str(tmp_path))
+        assert res.findings == []
+        assert res.unused_waivers == []
+
+    def test_dead_inline_waiver_is_reported(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(textwrap.dedent("""
+            def f(k):
+                return k  # analysis: allow=TAP104 nothing here anymore
+        """))
+        res = run_analysis([str(mod)], [PurityChecker(scope=("mod.py",))],
+                           root=str(tmp_path))
+        assert [f.code for f in res.unused_waivers] == ["TAW001"]
+        assert "TAP104" in res.unused_waivers[0].message
+
+    def test_dead_crash_only_waiver_is_reported(self, tmp_path):
+        ctl = tmp_path / "tpu_autoscaler" / "controller"
+        ctl.mkdir(parents=True)
+        mod = ctl / "m.py"
+        mod.write_text(textwrap.dedent("""
+            def act(client, metrics):
+                try:
+                    client.call()
+                except Exception:  # crash-only: already counted below
+                    metrics.inc("errors")
+        """))
+        res = run_analysis([str(mod)], [ExceptionHygieneChecker()],
+                           root=str(tmp_path))
+        assert res.findings == []
+        assert [f.code for f in res.unused_waivers] == ["TAW002"]
+
+    def test_live_crash_only_waiver_is_not_reported(self, tmp_path):
+        ctl = tmp_path / "tpu_autoscaler" / "controller"
+        ctl.mkdir(parents=True)
+        mod = ctl / "m.py"
+        mod.write_text(textwrap.dedent("""
+            def act(client):
+                try:
+                    client.call()
+                except Exception:  # crash-only: advisory write
+                    pass
+        """))
+        res = run_analysis([str(mod)], [ExceptionHygieneChecker()],
+                           root=str(tmp_path))
+        assert res.findings == []
+        assert res.unused_waivers == []
+
+    def test_prose_quoting_waiver_syntax_is_not_a_waiver(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(textwrap.dedent("""
+            #: docs say use ``# analysis: allow=TAP104`` on the line
+            def f(k):
+                return k
+        """))
+        res = run_analysis([str(mod)], [PurityChecker(scope=("mod.py",))],
+                           root=str(tmp_path))
+        assert res.unused_waivers == []
+
+    def test_cli_fails_on_unused_waiver_and_github_format(self, tmp_path,
+                                                          capsys):
+        from tpu_autoscaler.analysis.__main__ import main
+
+        mod = tmp_path / "mod.py"
+        mod.write_text("x = 1  # analysis: allow=TAE301 dead\n")
+        assert main([str(mod), "--no-baseline"]) == 1
+        out = capsys.readouterr().out
+        assert "TAW001" in out
+
+        assert main([str(mod), "--no-baseline",
+                     "--format", "github"]) == 1
+        out = capsys.readouterr().out
+        assert out.startswith("::error file=")
+        assert "title=TAW001" in out
+
+    def test_cli_races_selects_tar_only(self, tmp_path, capsys):
+        from tpu_autoscaler.analysis.__main__ import main
+
+        pkg = tmp_path / "tpu_autoscaler" / "controller"
+        pkg.mkdir(parents=True)
+        mod = pkg / "m.py"
+        # A TAE301 finding but no TAR finding: --races must pass.
+        mod.write_text(textwrap.dedent("""
+            def f(c):
+                try:
+                    c()
+                except Exception:
+                    pass
+        """))
+        assert main([str(mod), "--no-baseline"]) == 1
+        capsys.readouterr()
+        assert main([str(mod), "--no-baseline", "--races"]) == 0
+
+
+# --------------------------------------------------------------------- #
 # core: waivers, baseline codec, runner, CLI
 # --------------------------------------------------------------------- #
 
@@ -776,3 +1157,5 @@ class TestRepoIsClean:
         assert res.stale_baseline == [], (
             "baseline entries no longer match any finding; regenerate "
             "with --write-baseline")
+        assert res.unused_waivers == [], "\n".join(
+            f.render() for f in res.unused_waivers)

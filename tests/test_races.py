@@ -1,0 +1,309 @@
+"""E2E race scenarios: production plumbing under the schedule harness.
+
+The deterministic scheduler (tpu_autoscaler/testing/sched.py) drives
+REAL informer/executor/reconciler code through seeded interleavings
+with the vector-clock happens-before checker watching shared state:
+
+- the watch-fed ObjectCache + ResourceWatch path is race-free;
+- the actuation executor + single-flight TokenProvider path is
+  race-free (and really single-flights under worker concurrency);
+- the full Controller + ClusterInformer + FakeActuator loop converges
+  race-free with live watch threads;
+- the ACTIVE→node-registration double-provision window: the harness
+  REPRODUCES it on the pre-fix serial observe path (emulated by
+  disabling the sticky supply guard) and proves the fix closes it —
+  the regression the detector earns its keep on (ISSUE 4).
+"""
+
+import pytest
+
+from tpu_autoscaler import concurrency
+from tpu_autoscaler.actuators.base import (
+    ACCEPTED,
+    ACTIVE,
+    ProvisionStatus,
+)
+from tpu_autoscaler.actuators.executor import ActuationExecutor
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.actuators.gcp import GcpRest, TokenProvider
+from tpu_autoscaler.controller import Controller
+from tpu_autoscaler.controller.reconciler import ControllerConfig
+from tpu_autoscaler.engine.planner import PoolPolicy
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.k8s.informer import ClusterInformer, ObjectCache, ResourceWatch
+from tpu_autoscaler.k8s.payloads import tpu_host_payload
+from tpu_autoscaler.sim import seed_scenario
+from tpu_autoscaler.testing.sched import find_races, run_schedule
+from tpu_autoscaler.topology.catalog import shape_by_name
+
+pytestmark = pytest.mark.race
+
+SCHEDULES = 12
+
+#: No warm spares: the regression scenarios must see exactly the TPU
+#: gang's provision, nothing policy-driven.
+NO_SPARES = ControllerConfig(policy=PoolPolicy(spare_nodes=0))
+
+
+# --------------------------------------------------------------------- #
+# informer path
+# --------------------------------------------------------------------- #
+
+class TestInformerPath:
+    def test_resource_watch_feeding_cache_is_race_free(self):
+        events = [{"type": "MODIFIED",
+                   "object": {"metadata": {"name": f"pod-{i}",
+                                           "uid": f"u{i}",
+                                           "resourceVersion": str(10 + i)}}}
+                  for i in range(3)]
+
+        def scenario(s):
+            cache = s.tracker.track(ObjectCache("pods", dict))
+            wake = concurrency.Event()
+            served = []
+
+            def list_fn():
+                return ([{"metadata": {"name": "pod-0", "uid": "u0",
+                                       "resourceVersion": "1"}}], "1")
+
+            def watch_fn(timeout, resource_version=None):
+                if not served:
+                    served.append(True)
+                    yield from events
+
+            w = ResourceWatch(cache, list_fn, watch_fn, wake=wake,
+                              timeout_seconds=0)
+            w.start()
+            snaps = 0
+            while snaps < 5:
+                cache.snapshot()
+                cache.resource_version
+                snaps += 1
+                s.step()
+            w.stop()
+
+        assert find_races(scenario, schedules=SCHEDULES) == []
+
+
+# --------------------------------------------------------------------- #
+# executor + token provider path
+# --------------------------------------------------------------------- #
+
+class _Resp:
+    status_code = 200
+    content = b"{}"
+    headers: dict = {}
+
+    def json(self):
+        return {"ok": True}
+
+    def raise_for_status(self):
+        pass
+
+
+class _MetaResp(_Resp):
+    def json(self):
+        return {"access_token": "tok", "expires_in": 3600}
+
+
+class TestExecutorPath:
+    def test_dispatch_through_pool_with_shared_tokens_is_race_free(
+            self, monkeypatch):
+        monkeypatch.delenv("GCP_ACCESS_TOKEN", raising=False)
+        meta_calls_per_run = []
+
+        def scenario(s):
+            meta_calls = []
+
+            def meta_http(url, headers=None, timeout=None):
+                meta_calls.append(url)
+                return _MetaResp()
+
+            tokens = s.tracker.track(TokenProvider(http=meta_http))
+            rest = GcpRest(token_provider=tokens,
+                           transport=lambda *a, **k: _Resp())
+            executor = ActuationExecutor(max_workers=4)
+            results = []
+            for i in range(4):
+                rest.dispatch(executor, "GET", f"https://cloud/{i}",
+                              on_done=lambda r, e: results.append((r, e)))
+            guard = 0
+            while len(results) < 4 and guard < 2000:
+                executor.drain()
+                s.step()
+                guard += 1
+            assert len(results) == 4
+            assert all(e is None for _, e in results), results
+            meta_calls_per_run.append(len(meta_calls))
+
+        assert find_races(scenario, schedules=SCHEDULES) == []
+        # Single-flight: 4 concurrent workers, exactly ONE metadata
+        # fetch per schedule — the TokenProvider contract, now proven
+        # under permuted interleavings instead of prose.
+        assert set(meta_calls_per_run) == {1}
+
+
+# --------------------------------------------------------------------- #
+# full loop: Controller + ClusterInformer (live watch threads)
+# --------------------------------------------------------------------- #
+
+class TestFullLoop:
+    def test_reconcile_with_live_informer_converges_race_free(self):
+        def scenario(s):
+            kube = FakeKube()
+            seed_scenario(kube, "v5e-8")
+            actuator = FakeActuator(kube)
+            informer = ClusterInformer(kube, timeout_seconds=0)
+            s.tracker.track(informer.pod_cache)
+            s.tracker.track(informer.node_cache)
+            controller = Controller(kube, actuator, informer=informer)
+            informer.start()
+            now = 1000.0
+            for _ in range(8):
+                controller.reconcile_once(now=now)
+                kube.schedule_step()
+                now += 5.0
+            informer.stop()
+            phases = [p["status"]["phase"] for p in kube.list_pods()]
+            assert "Running" in phases, phases
+
+        assert find_races(scenario, schedules=3) == []
+
+
+# --------------------------------------------------------------------- #
+# the double-provision regression (ISSUE 4 satellite)
+# --------------------------------------------------------------------- #
+
+class SlowRegisterActuator:
+    """Actuator whose provisions go ACTIVE (with unit_ids) BEFORE their
+    nodes register — the real-cloud registration lag, made explicit so
+    the schedule harness can interleave registration against reconcile
+    passes."""
+
+    def __init__(self, kube: FakeKube):
+        self._kube = kube
+        self._statuses: dict[str, ProvisionStatus] = {}
+        self._n = 0
+        self.submissions = 0
+
+    def provision(self, request) -> ProvisionStatus:
+        self._n += 1
+        self.submissions += 1
+        pid = f"prov-{self._n}"
+        status = ProvisionStatus(id=pid, request=request, state=ACCEPTED)
+        self._statuses[pid] = status
+        return status
+
+    def poll(self, now: float) -> None:
+        for pid, status in self._statuses.items():
+            if status.state == ACCEPTED:
+                status.state = ACTIVE
+                status.unit_ids = [f"{status.request.shape_name}-{pid}"]
+
+    def register_nodes(self, now: float) -> None:
+        """Materialize the k8s nodes for every ACTIVE provision — the
+        kubelet-registration step, decoupled from ACTIVE.  Iterates a
+        snapshot: the reconcile thread may insert a new provision
+        mid-registration (the harness caught exactly that)."""
+        for status in list(self._statuses.values()):
+            if status.state != ACTIVE:
+                continue
+            shape = shape_by_name(status.request.shape_name)
+            for slice_id in status.unit_ids:
+                for i in range(shape.hosts):
+                    if not any(n["metadata"]["name"] == f"{slice_id}-h{i}"
+                               for n in self._kube.list_nodes()):
+                        self._kube.add_node(tpu_host_payload(
+                            shape, slice_id, i, created_at=now))
+
+    def statuses(self):
+        return list(self._statuses.values())
+
+    def delete(self, unit_id: str) -> None:
+        pass
+
+    def cancel(self, provision_id: str) -> None:
+        pass
+
+
+def _provision_counts(with_fix: bool, schedules: int) -> list[int]:
+    counts: list[int] = []
+
+    def scenario(s):
+        kube = FakeKube()
+        seed_scenario(kube, "v5e-8")
+        actuator = SlowRegisterActuator(kube)
+        controller = Controller(kube, actuator, NO_SPARES)
+        assert controller.informer is None     # the SERIAL observe path
+        if not with_fix:
+            # Pre-fix emulation: the sticky supply guard is the fix;
+            # disabling it restores the pre-ISSUE-4 serial path.
+            controller._update_supply_guard = lambda nodes, now: None
+        controller.reconcile_once(now=1000.0)  # pass 1: submit
+
+        def registrar():
+            actuator.register_nodes(now=1001.0)
+
+        t = concurrency.Thread(target=registrar)
+        t.start()
+        controller.reconcile_once(now=1001.0)  # pass 2: ACTIVE, nodes?
+        controller.reconcile_once(now=1002.0)
+        t.join()
+        counts.append(actuator.submissions)
+
+    for seed in range(schedules):
+        run_schedule(scenario, seed=seed)
+    return counts
+
+
+class TestDoubleProvisionRegression:
+    def test_harness_reproduces_window_on_prefix_code(self):
+        counts = _provision_counts(with_fix=False, schedules=SCHEDULES)
+        # Registration lands after the next reconcile pass in explored
+        # interleavings and the planner double-provisions — the
+        # pre-existing bug, reproduced deterministically.  (The
+        # with_fix=True run below is the control arm proving the
+        # duplicates come from the window, not from the planner
+        # re-requesting unconditionally.)
+        assert max(counts) >= 2, counts
+
+    def test_supply_guard_closes_window_under_every_schedule(self):
+        counts = _provision_counts(with_fix=True, schedules=SCHEDULES)
+        assert counts == [1] * SCHEDULES, counts
+
+
+class TestSupplyGuardSerial:
+    """Deterministic (no-harness) unit coverage of the guard itself."""
+
+    def _controller(self):
+        kube = FakeKube()
+        seed_scenario(kube, "v5e-8")
+        actuator = SlowRegisterActuator(kube)
+        return kube, actuator, Controller(kube, actuator, NO_SPARES)
+
+    def test_guard_holds_until_nodes_register(self):
+        _kube, actuator, controller = self._controller()
+        controller.reconcile_once(now=1000.0)
+        assert actuator.submissions == 1
+        controller.reconcile_once(now=1001.0)  # ACTIVE, unregistered
+        assert actuator.submissions == 1       # guard counts it in-flight
+        assert controller._supply_awaiting_nodes
+        actuator.register_nodes(now=1001.5)
+        controller.reconcile_once(now=1002.0)
+        assert actuator.submissions == 1
+        assert controller._supply_awaiting_nodes == {}
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["supply_guard_engaged"] == 1
+
+    def test_guard_expires_after_provision_timeout(self):
+        _kube, actuator, controller = self._controller()
+        controller.reconcile_once(now=1000.0)
+        controller.reconcile_once(now=1001.0)  # guard engages
+        assert actuator.submissions == 1
+        # Nodes never register: past provision_timeout_seconds the guard
+        # must stop shielding the demand or a lost slice starves it.
+        timeout = controller.config.provision_timeout_seconds
+        controller.reconcile_once(now=1001.0 + timeout + 1.0)
+        assert actuator.submissions == 2
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["supply_guard_expired"] == 1

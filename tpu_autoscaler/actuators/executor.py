@@ -45,6 +45,7 @@ import random
 import time
 from typing import Any, Callable
 
+from tpu_autoscaler import concurrency
 from tpu_autoscaler.backoff import (
     REST_BACKOFF_BASE_S,
     REST_BACKOFF_CAP_S,
@@ -78,7 +79,7 @@ class RetryLater(Exception):
     """
 
     def __init__(self, cause: str, retry_after: Any = None,
-                 attempt_free: bool = False):
+                 attempt_free: bool = False) -> None:
         super().__init__(cause)
         self.cause = cause
         self.retry_after = retry_after
@@ -109,15 +110,16 @@ class ActuationExecutor:
     """
 
     def __init__(self, max_workers: int = DEFAULT_MAX_WORKERS,
-                 metrics=None, max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                 metrics: Any = None,
+                 max_attempts: int = DEFAULT_MAX_ATTEMPTS,
                  deadline_s: float = DEFAULT_DEADLINE_S,
                  backoff_base_s: float = REST_BACKOFF_BASE_S,
                  backoff_cap_s: float = REST_BACKOFF_CAP_S,
                  rng: random.Random | None = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.max_workers = max_workers
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max_workers, thread_name_prefix="actuation")
+        self._pool = concurrency.pool_executor(
+            max_workers, thread_name_prefix="actuation")
         self._metrics = metrics
         self._max_attempts = max_attempts
         self._deadline_s = deadline_s
@@ -132,7 +134,7 @@ class ActuationExecutor:
 
     # -- wiring ----------------------------------------------------------
 
-    def set_metrics(self, metrics) -> None:
+    def set_metrics(self, metrics: Any) -> None:
         """Wire the controller's metrics registry (the Controller calls
         this on construction, like Actuator.set_metrics)."""
         self._metrics = metrics

@@ -41,7 +41,8 @@ import random
 import threading
 import time
 
-from tpu_autoscaler.actuators.executor import RetryLater
+from tpu_autoscaler import concurrency
+from tpu_autoscaler.actuators.executor import ActuationExecutor, RetryLater
 from tpu_autoscaler.backoff import (
     REST_BACKOFF_BASE_S,
     REST_BACKOFF_CAP_S,
@@ -156,7 +157,7 @@ class TokenProvider:
     then read the fresh cache)."""
 
     def __init__(self, http=None):
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._token: str | None = None
         self._expires_at = 0.0
         self._env_token_used: str | None = None
@@ -379,7 +380,7 @@ class GcpRest:
 
     # -- pipelined mode ---------------------------------------------------
 
-    def dispatch(self, executor, method: str, url: str,
+    def dispatch(self, executor: ActuationExecutor, method: str, url: str,
                  body: dict | None = None, *, on_done,
                  label: str = "") -> None:
         """Submit ONE call through the actuation executor (non-blocking).

@@ -37,6 +37,7 @@ from tpu_autoscaler.actuators.base import (
     PROVISIONING,
     ProvisionStatus,
 )
+from tpu_autoscaler.actuators.executor import ActuationExecutor
 from tpu_autoscaler.actuators.gcp import (
     GcpRest,
     TokenProvider,
@@ -64,7 +65,8 @@ class GkeNodePoolActuator:
                  dry_run: bool = False, rest: GcpRest | None = None,
                  pool_prefix: str = "tpuas",
                  api_base: str = _BASE,
-                 executor=None, batch_poll: bool = True):
+                 executor: ActuationExecutor | None = None,
+                 batch_poll: bool = True):
         if not (project and location and cluster):
             raise ValueError(
                 "GKE actuator needs --project, --location and --cluster")

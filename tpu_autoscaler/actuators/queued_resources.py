@@ -51,6 +51,7 @@ from tpu_autoscaler.actuators.base import (
     PROVISIONING,
     ProvisionStatus,
 )
+from tpu_autoscaler.actuators.executor import ActuationExecutor
 from tpu_autoscaler.actuators.gcp import (
     GcpApiError,
     GcpRest,
@@ -92,7 +93,8 @@ class QueuedResourceActuator:
                  rest: GcpRest | None = None,
                  runtime_version: str = "tpu-ubuntu2204-base",
                  name_prefix: str = "tpuas",
-                 executor=None, batch_poll: bool = True):
+                 executor: ActuationExecutor | None = None,
+                 batch_poll: bool = True):
         if not (project and zone):
             raise ValueError(
                 "QueuedResource actuator needs --project and --location")

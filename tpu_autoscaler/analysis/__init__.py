@@ -11,11 +11,13 @@ from tpu_autoscaler.analysis.core import (
     AnalysisResult,
     Checker,
     Finding,
+    ProgramChecker,
     SourceFile,
     parse_baseline,
     render_baseline,
     run_analysis,
 )
+from tpu_autoscaler.analysis.escape import EscapeRaceChecker
 from tpu_autoscaler.analysis.exceptions import ExceptionHygieneChecker
 from tpu_autoscaler.analysis.jaxpurity import JaxPurityChecker
 from tpu_autoscaler.analysis.purity import PurityChecker
@@ -23,16 +25,21 @@ from tpu_autoscaler.analysis.threads import ThreadDisciplineChecker
 
 
 def default_checkers() -> list[Checker]:
+    # TAT2xx stays in the lineup as the fallback for sharing the
+    # interprocedural TAR5xx pass cannot resolve (docs/ANALYSIS.md).
     return [PurityChecker(), ThreadDisciplineChecker(),
-            ExceptionHygieneChecker(), JaxPurityChecker()]
+            ExceptionHygieneChecker(), JaxPurityChecker(),
+            EscapeRaceChecker()]
 
 
 __all__ = [
     "AnalysisResult",
     "Checker",
+    "EscapeRaceChecker",
     "ExceptionHygieneChecker",
     "Finding",
     "JaxPurityChecker",
+    "ProgramChecker",
     "PurityChecker",
     "SourceFile",
     "ThreadDisciplineChecker",

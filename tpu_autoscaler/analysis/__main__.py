@@ -50,9 +50,24 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--select", default="",
                         help="comma-separated code prefixes to report "
                              "(e.g. TAP,TAE3)")
+    parser.add_argument("--races", action="store_true",
+                        help="report only the interprocedural race "
+                             "pass (TAR5xx) — the static half of "
+                             "scripts/race.sh")
+    parser.add_argument("--format", default="text",
+                        choices=("text", "github"),
+                        help="'github' emits ::error workflow-command "
+                             "annotations for CI")
     parser.add_argument("--list-codes", action="store_true",
                         help="print every checker's codes and exit")
     args = parser.parse_args(argv)
+    if args.races:
+        if args.select:
+            # Refusing beats silently discarding the user's filter: a
+            # gate invoked with --select TAT --races must not exit 0 on
+            # live TAT findings.
+            parser.error("--races and --select are mutually exclusive")
+        args.select = "TAR"
 
     checkers = default_checkers()
     if args.list_codes:
@@ -61,8 +76,8 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"{code}  [{checker.name}]  {desc}")
         return 0
 
-    baseline: list[dict] = []
-    reasons: dict[tuple, str] = {}
+    baseline: list[dict[str, str]] = []
+    reasons: dict[tuple[str, str, str], str] = {}
     if not args.no_baseline and os.path.exists(args.baseline):
         try:
             with open(args.baseline, encoding="utf-8") as f:
@@ -95,8 +110,16 @@ def main(argv: list[str] | None = None) -> int:
     prefixes = tuple(p for p in args.select.split(",") if p)
     shown = [f for f in result.findings
              if not prefixes or f.code.startswith(prefixes)]
+    # Unused waivers (TAW00x) are meta-findings: always reported, never
+    # code-selectable away — a dead waiver is debt regardless of which
+    # slice of the analysis is being gated.
+    shown += result.unused_waivers
     for f in shown:
-        print(f.render())
+        if args.format == "github":
+            print(f"::error file={f.file},line={f.line},"
+                  f"title={f.code}::{f.message}")
+        else:
+            print(f.render())
     for entry in result.stale_baseline:
         print(f"stale baseline entry (no longer matches anything): "
               f"{entry['code']} {entry['file']}: {entry['message']}",

@@ -1,0 +1,594 @@
+"""Whole-package call graph with class/attribute type resolution.
+
+The substrate for the interprocedural race detector (escape.py, TAR5xx):
+where the per-class TAT2xx heuristic sees one class at a time, this
+module indexes every module under analysis and resolves
+
+- classes (with transitive base chasing, so a ``concurrency.Thread``
+  subclass is a thread class just like a ``threading.Thread`` one),
+- attribute types, from ``__init__``/method assignments whose right-hand
+  side is a resolvable constructor or an annotated parameter, from
+  ``AnnAssign`` annotations, and from container ``append`` calls (the
+  element type of ``self._watches``),
+- call edges: ``self.m()``, ``obj.m()``/``obj.prop`` on objects whose
+  type is known, module functions, cross-module imports (chasing
+  ``__init__`` re-exports), and constructors,
+- thread roots: ``run()`` of Thread subclasses, ``Thread(target=f)``
+  targets, and thunks handed to a worker pool — either a raw
+  ``ThreadPoolExecutor``/``concurrency.pool_executor`` ``submit`` or
+  the ``submit`` of a class that owns a pool (the ActuationExecutor
+  shape), where the first argument (unwrapped through
+  ``functools.partial``) runs on a worker thread while the completion
+  callback runs on the submitting thread per the drain contract.
+
+Resolution is deliberately conservative: an unresolvable callee simply
+produces no edge.  The consequences are asymmetric by design — a missed
+edge can only HIDE sharing (handled by the TAT2xx fallback and the
+dynamic schedule harness), never invent it, so everything the escape
+pass reports rests on evidence the graph actually resolved.
+
+Known holes (documented, covered by layer 2): lambdas and callables
+stored through dataclass fields are not chased; module-global objects
+are not modeled; instances of one class are conflated (class-level
+granularity).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tpu_autoscaler.analysis.core import SourceFile, dotted_name
+
+#: Synthetic type markers (anything not a package-class qname).
+SYNC_LOCK = "@sync:Lock"
+SYNC_RLOCK = "@sync:RLock"
+SYNC_EVENT = "@sync:Event"
+SYNC_CONDITION = "@sync:Condition"
+SYNC_OTHER = "@sync:Other"
+POOL = "@pool"
+
+_SYNC_CTORS: dict[str, str] = {
+    "Lock": SYNC_LOCK,
+    "RLock": SYNC_RLOCK,
+    "Event": SYNC_EVENT,
+    "Condition": SYNC_CONDITION,
+    "Semaphore": SYNC_OTHER,
+    "BoundedSemaphore": SYNC_OTHER,
+    "Barrier": SYNC_OTHER,
+}
+_POOL_CTORS = frozenset({"ThreadPoolExecutor", "pool_executor"})
+LOCK_TYPES = frozenset({SYNC_LOCK, SYNC_RLOCK, SYNC_CONDITION})
+SYNC_TYPES = frozenset(_SYNC_CTORS.values())
+
+#: The root every externally-callable function belongs to.
+MAIN_ROOT = "main"
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qname: str                      # module.Class.method / module.func
+    rel_path: str
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    cls: "ClassInfo | None"
+    src: SourceFile
+
+
+class ClassInfo:
+    def __init__(self, qname: str, name: str, rel_path: str,
+                 node: ast.ClassDef, src: SourceFile) -> None:
+        self.qname = qname
+        self.name = name
+        self.rel_path = rel_path
+        self.node = node
+        self.src = src
+        self.base_names: list[str] = [
+            d for b in node.bases if (d := dotted_name(b)) is not None]
+        self.methods: dict[str, FuncInfo] = {}
+        self.attr_types: dict[str, str] = {}
+        self.elem_types: dict[str, str] = {}   # container attr -> element
+        self.sync_attrs: set[str] = set()
+        self.lock_attrs: set[str] = set()
+        self.is_thread = False                 # set by PackageGraph
+
+
+class ModuleInfo:
+    def __init__(self, modname: str, src: SourceFile) -> None:
+        self.modname = modname
+        self.src = src
+        self.imports: dict[str, str] = {}      # local name -> dotted target
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.global_types: dict[str, str] = {}  # module-level name -> type
+
+
+def _module_name(rel_path: str) -> str:
+    parts = rel_path[:-3].split("/")           # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class PackageGraph:
+    """Index + resolver + reachability over a set of SourceFiles."""
+
+    def __init__(self, files: list[SourceFile]) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.edges: dict[str, set[str]] = {}
+        #: root id -> entry func qname ("main" handled separately)
+        self.thread_roots: dict[str, str] = {}
+        #: func qname -> set of root ids (incl. MAIN_ROOT)
+        self.roots_of: dict[str, frozenset[str]] = {}
+        for src in files:
+            self._index_module(src)
+        self._resolve_thread_classes()
+        self._infer_attr_types()
+        self._build_edges_and_roots()
+        self._compute_reachability()
+
+    # -- indexing ---------------------------------------------------------
+
+    def _index_module(self, src: SourceFile) -> None:
+        mod = ModuleInfo(_module_name(src.rel_path), src)
+        self.modules[mod.modname] = mod
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name.split(".")[0]] \
+                        = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                base = node.module
+                if node.level:                 # relative import
+                    parts = mod.modname.split(".")
+                    is_pkg = src.rel_path.endswith("__init__.py")
+                    keep = len(parts) - node.level + (1 if is_pkg else 0)
+                    base = ".".join(parts[:max(keep, 1)] + [node.module])
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] \
+                        = f"{base}.{alias.name}"
+        for stmt in src.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qname = f"{mod.modname}.{stmt.name}"
+                fi = FuncInfo(qname, src.rel_path, stmt, None, src)
+                mod.functions[stmt.name] = fi
+                self.funcs[qname] = fi
+            elif isinstance(stmt, ast.ClassDef):
+                qname = f"{mod.modname}.{stmt.name}"
+                ci = ClassInfo(qname, stmt.name, src.rel_path, stmt, src)
+                mod.classes[stmt.name] = ci
+                self.classes[qname] = ci
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        mq = f"{qname}.{sub.name}"
+                        mi = FuncInfo(mq, src.rel_path, sub, ci, src)
+                        ci.methods[sub.name] = mi
+                        self.funcs[mq] = mi
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                t = self._value_type_shallow(stmt.value)
+                if t is not None:
+                    mod.global_types[stmt.targets[0].id] = t
+
+    @staticmethod
+    def _value_type_shallow(value: ast.AST) -> str | None:
+        """Sync/pool markers from a bare constructor call (no module
+        context needed — the ctor NAME is the contract)."""
+        if isinstance(value, ast.Call):
+            d = dotted_name(value.func)
+            if d is not None:
+                leaf = d.split(".")[-1]
+                if leaf in _SYNC_CTORS:
+                    return _SYNC_CTORS[leaf]
+                if leaf in _POOL_CTORS:
+                    return POOL
+        return None
+
+    # -- symbol resolution ------------------------------------------------
+
+    def resolve_symbol(
+            self, dotted: str) -> "ClassInfo | FuncInfo | None":
+        """A dotted name -> ClassInfo | FuncInfo | None, chasing
+        re-exports through package ``__init__`` modules."""
+        for _ in range(8):                      # re-export chase bound
+            if "." not in dotted:
+                return None
+            modname, leaf = dotted.rsplit(".", 1)
+            mod = self.modules.get(modname)
+            if mod is None:
+                # maybe 'a.b.c' where 'a.b.c' is itself a module: no leaf
+                return None
+            if leaf in mod.classes:
+                return mod.classes[leaf]
+            if leaf in mod.functions:
+                return mod.functions[leaf]
+            if leaf in mod.imports:
+                dotted = mod.imports[leaf]
+                continue
+            return None
+        return None
+
+    def _resolve_name(self, name: str,
+                      mod: ModuleInfo) -> "ClassInfo | FuncInfo | None":
+        """A bare name in module scope -> ClassInfo/FuncInfo/None."""
+        if name in mod.classes:
+            return mod.classes[name]
+        if name in mod.functions:
+            return mod.functions[name]
+        if name in mod.imports:
+            return self.resolve_symbol(mod.imports[name])
+        return None
+
+    def _resolve_thread_classes(self) -> None:
+        def chase(ci: ClassInfo, depth: int = 0) -> bool:
+            if depth > 8:
+                return False
+            for base in ci.base_names:
+                if base.split(".")[-1] == "Thread":
+                    return True
+                mod = self.modules[_module_name(ci.rel_path)]
+                target = self._resolve_name(base.split(".")[0], mod) \
+                    if "." not in base else self.resolve_symbol(
+                        self._qualify(base, mod))
+                if isinstance(target, ClassInfo) \
+                        and chase(target, depth + 1):
+                    return True
+            return False
+
+        for ci in self.classes.values():
+            ci.is_thread = chase(ci)
+
+    def _qualify(self, dotted: str, mod: ModuleInfo) -> str:
+        head, _, rest = dotted.partition(".")
+        target = mod.imports.get(head)
+        if target is None:
+            return f"{mod.modname}.{dotted}"
+        return f"{target}.{rest}" if rest else target
+
+    # -- type inference ---------------------------------------------------
+
+    def _annotation_type(self, ann: ast.AST | None,
+                         mod: ModuleInfo) -> str | None:
+        """'ObjectCache', 'Metrics | None', Optional[X], 'X' strings."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._annotation_type(ann.left, mod)
+                    or self._annotation_type(ann.right, mod))
+        if isinstance(ann, ast.Subscript):
+            d = dotted_name(ann.value)
+            if d is not None and d.split(".")[-1] == "Optional":
+                return self._annotation_type(ann.slice, mod)
+            return None                         # list[X] etc: no instance
+        d = dotted_name(ann)
+        if d is None:
+            return None
+        leaf = d.split(".")[-1]
+        if leaf in _SYNC_CTORS:
+            return _SYNC_CTORS[leaf]
+        target = self.resolve_symbol(self._qualify(d, mod)) \
+            if "." in d else self._resolve_name(d, mod)
+        if isinstance(target, ClassInfo):
+            return target.qname
+        return None
+
+    def _param_types(self, fn: FuncInfo) -> dict[str, str]:
+        mod = self.modules[_module_name(fn.rel_path)]
+        out: dict[str, str] = {}
+        args = fn.node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            t = self._annotation_type(a.annotation, mod)
+            if t is not None:
+                out[a.arg] = t
+        return out
+
+    def expr_type(self, expr: ast.AST, fn: FuncInfo,
+                  local_types: dict[str, str]) -> str | None:
+        """Type of an expression: Name via locals/params/globals,
+        Attribute via the owner class's attr table, Call via ctor."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fn.cls is not None:
+                return fn.cls.qname
+            if expr.id in local_types:
+                return local_types[expr.id]
+            mod = self.modules[_module_name(fn.rel_path)]
+            if expr.id in mod.global_types:
+                return mod.global_types[expr.id]
+            return None
+        if isinstance(expr, ast.Attribute):
+            base_t = self.expr_type(expr.value, fn, local_types)
+            ci = self.classes.get(base_t) if base_t else None
+            if ci is not None:
+                return self._attr_type(ci, expr.attr)
+            return None
+        if isinstance(expr, ast.Call):
+            return self._ctor_type(expr, fn)
+        if isinstance(expr, ast.BoolOp):
+            # ``rest or GcpRest(...)``: first resolvable operand wins.
+            for v in expr.values:
+                t = self.expr_type(v, fn, local_types)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(expr, ast.IfExp):
+            return (self.expr_type(expr.body, fn, local_types)
+                    or self.expr_type(expr.orelse, fn, local_types))
+        return None
+
+    def _attr_type(self, ci: ClassInfo, attr: str,
+                   depth: int = 0) -> str | None:
+        if attr in ci.attr_types:
+            return ci.attr_types[attr]
+        if depth < 6:
+            for base in self._package_bases(ci):
+                t = self._attr_type(base, attr, depth + 1)
+                if t is not None:
+                    return t
+        return None
+
+    def _package_bases(self, ci: ClassInfo) -> list[ClassInfo]:
+        mod = self.modules[_module_name(ci.rel_path)]
+        out: list[ClassInfo] = []
+        for base in ci.base_names:
+            target = self.resolve_symbol(self._qualify(base, mod)) \
+                if "." in base else self._resolve_name(base, mod)
+            if isinstance(target, ClassInfo):
+                out.append(target)
+        return out
+
+    def _ctor_type(self, call: ast.Call, fn: FuncInfo) -> str | None:
+        d = dotted_name(call.func)
+        if d is None:
+            return None
+        leaf = d.split(".")[-1]
+        if leaf in _SYNC_CTORS:
+            return _SYNC_CTORS[leaf]
+        if leaf in _POOL_CTORS:
+            return POOL
+        mod = self.modules[_module_name(fn.rel_path)]
+        target = self.resolve_symbol(self._qualify(d, mod)) \
+            if "." in d else self._resolve_name(d, mod)
+        if isinstance(target, ClassInfo):
+            return target.qname
+        return None
+
+    def local_types(self, fn: FuncInfo) -> dict[str, str]:
+        """Flow-insensitive local name types: annotated params, ctor
+        assignments, aliases of typed attributes, typed loop vars."""
+        out = self._param_types(fn)
+        for _ in range(2):                      # two passes: aliases chain
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    t = self.expr_type(node.value, fn, out)
+                    if t is not None:
+                        out.setdefault(node.targets[0].id, t)
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    mod = self.modules[_module_name(fn.rel_path)]
+                    t = self._annotation_type(node.annotation, mod)
+                    if t is not None:
+                        out.setdefault(node.target.id, t)
+                elif isinstance(node, ast.For) \
+                        and isinstance(node.target, ast.Name):
+                    t = self._elem_type(node.iter, fn, out)
+                    if t is not None:
+                        out.setdefault(node.target.id, t)
+        return out
+
+    def _elem_type(self, it: ast.AST, fn: FuncInfo,
+                   local_types: dict[str, str]) -> str | None:
+        """Element type of ``for x in self.attr`` via append inference."""
+        if isinstance(it, ast.Attribute):
+            base_t = self.expr_type(it.value, fn, local_types)
+            ci = self.classes.get(base_t) if base_t else None
+            if ci is not None:
+                return ci.elem_types.get(it.attr)
+        return None
+
+    def _infer_attr_types(self) -> None:
+        """attr -> type for every class, from every method body."""
+        for ci in self.classes.values():
+            for name, fn in ci.methods.items():
+                locals_ = self._param_types(fn)
+                for node in ast.walk(fn.node):
+                    if isinstance(node, ast.Assign):
+                        targets: list[ast.AST] = list(node.targets)
+                        value: ast.AST | None = node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        targets = [node.target]
+                        value = node.value
+                    else:
+                        if isinstance(node, ast.Call) \
+                                and isinstance(node.func, ast.Attribute) \
+                                and node.func.attr == "append" \
+                                and node.args:
+                            holder = node.func.value
+                            if self._is_self_attr(holder):
+                                et = self.expr_type(node.args[0], fn,
+                                                    locals_)
+                                if et is not None and isinstance(
+                                        holder, ast.Attribute):
+                                    ci.elem_types.setdefault(
+                                        holder.attr, et)
+                        continue
+                    t: str | None = None
+                    if value is not None:
+                        t = self.expr_type(value, fn, locals_)
+                    if t is None and isinstance(node, ast.AnnAssign):
+                        mod = self.modules[_module_name(fn.rel_path)]
+                        t = self._annotation_type(node.annotation, mod)
+                    if t is None:
+                        continue
+                    for tgt in targets:
+                        if self._is_self_attr(tgt):
+                            attr = tgt.attr  # type: ignore[union-attr]
+                            ci.attr_types.setdefault(attr, t)
+                            if t in SYNC_TYPES:
+                                ci.sync_attrs.add(attr)
+                            if t in LOCK_TYPES:
+                                ci.lock_attrs.add(attr)
+
+    @staticmethod
+    def _is_self_attr(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    # -- call edges + thread roots ----------------------------------------
+
+    def resolve_callable(self, expr: ast.AST, fn: FuncInfo,
+                         local_types: dict[str, str]) -> FuncInfo | None:
+        """A callable-valued expression -> its FuncInfo: ``self.m``,
+        ``obj.m`` (typed), module functions, ``functools.partial(f,..)``."""
+        if isinstance(expr, ast.Call):          # partial(f, ...)
+            d = dotted_name(expr.func)
+            if d is not None and d.split(".")[-1] == "partial" \
+                    and expr.args:
+                return self.resolve_callable(expr.args[0], fn, local_types)
+            return None
+        if isinstance(expr, ast.Name):
+            mod = self.modules[_module_name(fn.rel_path)]
+            target = self._resolve_name(expr.id, mod)
+            return target if isinstance(target, FuncInfo) else None
+        if isinstance(expr, ast.Attribute):
+            base_t = self.expr_type(expr.value, fn, local_types)
+            ci = self.classes.get(base_t) if base_t else None
+            if ci is not None:
+                return self._method(ci, expr.attr)
+            mod = self.modules[_module_name(fn.rel_path)]
+            d = dotted_name(expr)
+            if d is not None:
+                target = self.resolve_symbol(self._qualify(d, mod))
+                if isinstance(target, FuncInfo):
+                    return target
+        return None
+
+    def _method(self, ci: ClassInfo, name: str,
+                depth: int = 0) -> FuncInfo | None:
+        if name in ci.methods:
+            return ci.methods[name]
+        if depth < 6:
+            for base in self._package_bases(ci):
+                m = self._method(base, name, depth + 1)
+                if m is not None:
+                    return m
+        return None
+
+    def _owns_pool(self, ci: ClassInfo) -> bool:
+        return POOL in ci.attr_types.values()
+
+    def _build_edges_and_roots(self) -> None:
+        for fn in list(self.funcs.values()):
+            edges = self.edges.setdefault(fn.qname, set())
+            locals_ = self.local_types(fn)
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    self._edge_for_call(node, fn, locals_, edges)
+                elif isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load):
+                    # Property access on a typed object is a call.
+                    base_t = self.expr_type(node.value, fn, locals_)
+                    ci = self.classes.get(base_t) if base_t else None
+                    if ci is not None:
+                        m = self._method(ci, node.attr)
+                        if m is not None and _is_property(m.node):
+                            edges.add(m.qname)
+        # Thread-subclass run() roots.
+        for ci in self.classes.values():
+            if ci.is_thread:
+                run = self._method(ci, "run")
+                if run is not None:
+                    self.thread_roots[f"{ci.name}.run"] = run.qname
+
+    def _edge_for_call(self, node: ast.Call, fn: FuncInfo,
+                       locals_: dict[str, str], edges: set[str]) -> None:
+        target = self.resolve_callable(node.func, fn, locals_)
+        if target is not None:
+            edges.add(target.qname)
+        # Constructor edge + thread target roots.
+        d = dotted_name(node.func)
+        mod = self.modules[_module_name(fn.rel_path)]
+        ctor: ClassInfo | None = None
+        if d is not None:
+            leaf_target = self.resolve_symbol(self._qualify(d, mod)) \
+                if "." in d else self._resolve_name(d, mod)
+            if isinstance(leaf_target, ClassInfo):
+                ctor = leaf_target
+                init = self._method(ctor, "__init__")
+                if init is not None:
+                    edges.add(init.qname)
+        is_thread_ctor = (
+            (d is not None and d.split(".")[-1] == "Thread")
+            or (ctor is not None and ctor.is_thread))
+        if is_thread_ctor:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    t = self.resolve_callable(kw.value, fn, locals_)
+                    if t is not None:
+                        self.thread_roots[f"thread:{_short(t.qname)}"] \
+                            = t.qname
+        # Pool thunks: <pool>.submit(fn, ...) or <pool-owner>.submit(...).
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "submit" and node.args:
+            recv_t = self.expr_type(node.func.value, fn, locals_)
+            recv_ci = self.classes.get(recv_t) if recv_t else None
+            if recv_t == POOL or (recv_ci is not None
+                                  and self._owns_pool(recv_ci)):
+                t = self.resolve_callable(node.args[0], fn, locals_)
+                if t is not None:
+                    self.thread_roots[f"thunk:{_short(t.qname)}"] = t.qname
+
+    # -- reachability -----------------------------------------------------
+
+    def _closure(self, entries: set[str]) -> set[str]:
+        seen = set(entries)
+        frontier = list(entries)
+        while frontier:
+            for nxt in self.edges.get(frontier.pop(), ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        return seen
+
+    def _compute_reachability(self) -> None:
+        per_root: dict[str, set[str]] = {
+            rid: self._closure({entry})
+            for rid, entry in self.thread_roots.items()}
+        thread_closure: set[str] = set()
+        for reach in per_root.values():
+            thread_closure |= reach
+        # Anything OUTSIDE the thread closure can be called by the main
+        # thread (tests, CLI, the reconcile loop): those are the main
+        # entries, and main additionally reaches into the closure
+        # through resolved edges (e.g. the informer's pump()).
+        main_entries = set(self.funcs) - thread_closure
+        main_reach = self._closure(main_entries)
+        roots: dict[str, set[str]] = {q: set() for q in self.funcs}
+        for rid, reach in per_root.items():
+            for q in reach:
+                if q in roots:
+                    roots[q].add(rid)
+        for q in main_reach:
+            if q in roots:
+                roots[q].add(MAIN_ROOT)
+        self.roots_of = {q: frozenset(r) for q, r in roots.items()}
+
+
+def _is_property(node: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    return any(isinstance(d, ast.Name) and d.id == "property"
+               for d in node.decorator_list)
+
+
+def _short(qname: str) -> str:
+    parts = qname.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else qname

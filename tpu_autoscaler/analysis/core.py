@@ -30,9 +30,11 @@ import re
 import tokenize
 from typing import Iterable, Sequence
 
-#: Inline waiver: ``# analysis: allow=TAP104`` (comma-separate several
-#: codes).  Anything after the codes is the human reason.
-_ALLOW_RE = re.compile(r"#\s*analysis:\s*allow=([A-Z0-9,]+)")
+#: Inline waiver: a comment STARTING ``# analysis: allow=TAP104``
+#: (comma-separate several codes).  Anything after the codes is the
+#: human reason.  Anchored so prose QUOTING the syntax (like this
+#: file's docstrings) is not itself a waiver.
+_ALLOW_RE = re.compile(r"\A#\s*analysis:\s*allow=([A-Z0-9,]+)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,7 +59,7 @@ class Finding:
 class SourceFile:
     """A parsed module plus its comment map (line -> comment text)."""
 
-    def __init__(self, path: str, rel_path: str, text: str):
+    def __init__(self, path: str, rel_path: str, text: str) -> None:
         self.path = path
         self.rel_path = rel_path
         self.text = text
@@ -82,6 +84,17 @@ class SourceFile:
         m = _ALLOW_RE.search(self.comments.get(line, ""))
         return set(m.group(1).split(",")) if m else set()
 
+    def waiver_lines(self) -> dict[int, set[str]]:
+        """Every ``# analysis: allow=`` comment: line -> waived codes.
+        Feeds the unused-waiver audit (a waiver matching no finding is
+        itself a finding, so waivers shrink as debt is paid)."""
+        out: dict[int, set[str]] = {}
+        for line in self.comments:
+            codes = self.allowed_codes(line)
+            if codes:
+                out[line] = codes
+        return out
+
     def comment_in_range(self, first: int, last: int,
                          needle: str) -> bool:
         return any(needle in self.comments.get(n, "")
@@ -99,6 +112,19 @@ class Checker:
         raise NotImplementedError
 
     def check(self, src: SourceFile) -> list[Finding]:
+        raise NotImplementedError
+
+
+class ProgramChecker(Checker):
+    """A checker that needs the WHOLE program at once (the
+    interprocedural escape/race pass): the runner hands it every
+    in-scope SourceFile in one call instead of one file at a time.
+    Findings flow through the same waiver/baseline machinery."""
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        return self.check_program([src])
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
         raise NotImplementedError
 
 
@@ -187,7 +213,7 @@ def _toml_str(s: str) -> str:
 
 
 def render_baseline(findings: Sequence[Finding],
-                    reasons: dict[tuple, str] | None = None) -> str:
+                    reasons: dict[tuple[str, str, str], str] | None = None) -> str:
     """Serialize findings as a baseline file.  ``reasons`` maps finding
     keys to justification strings (existing entries keep theirs on
     regeneration; new ones get a TODO the parser will reject until a
@@ -219,37 +245,77 @@ def render_baseline(findings: Sequence[Finding],
 class AnalysisResult:
     findings: list[Finding]          # live (unwaived) findings
     waived: list[Finding]            # silenced by baseline entries
-    stale_baseline: list[dict]       # baseline entries matching nothing
+    stale_baseline: list[dict[str, str]]  # entries matching nothing
     errors: list[str]                # unparseable files etc.
+    # Waivers that silenced nothing (TAW001 inline allow=, TAW002
+    # crash-only) — reported like findings so debt-paying shrinks them,
+    # mirroring the stale-baseline rule.
+    unused_waivers: list[Finding] = dataclasses.field(
+        default_factory=list)
 
 
 def run_analysis(paths: Sequence[str], checkers: Sequence[Checker],
-                 baseline: Sequence[dict] | None = None,
+                 baseline: Sequence[dict[str, str]] | None = None,
                  root: str | None = None) -> AnalysisResult:
     baseline = list(baseline or [])
     by_key = {(e["file"], e["code"], e["message"]): e for e in baseline}
     live: list[Finding] = []
     waived: list[Finding] = []
-    matched: set[tuple] = set()
+    matched: set[tuple[str, str, str]] = set()
     errors: list[str] = []
+    sources: list[SourceFile] = []
     for path in iter_py_files(paths):
         try:
-            src = SourceFile.load(path, root=root)
+            sources.append(SourceFile.load(path, root=root))
         except (OSError, SyntaxError, ValueError) as e:
             errors.append(f"{path}: {e}")
-            continue
-        for checker in checkers:
-            if not checker.applies_to(src.rel_path):
+    src_by_rel = {s.rel_path: s for s in sources}
+    used_inline: set[tuple[str, int, str]] = set()
+
+    def consume(findings: Iterable[Finding]) -> None:
+        for f in findings:
+            src = src_by_rel.get(f.file)
+            if src is not None and f.code in src.allowed_codes(f.line):
+                used_inline.add((f.file, f.line, f.code))
                 continue
-            for f in checker.check(src):
-                if f.code in src.allowed_codes(f.line):
-                    continue
-                if f.key in by_key:
-                    matched.add(f.key)
-                    waived.append(f)
-                else:
-                    live.append(f)
+            if f.key in by_key:
+                matched.add(f.key)
+                waived.append(f)
+            else:
+                live.append(f)
+
+    per_file = [c for c in checkers if not isinstance(c, ProgramChecker)]
+    program = [c for c in checkers if isinstance(c, ProgramChecker)]
+    for src in sources:
+        for checker in per_file:
+            if checker.applies_to(src.rel_path):
+                consume(checker.check(src))
+    for checker in program:
+        consume(checker.check_program(
+            [s for s in sources if checker.applies_to(s.rel_path)]))
+
+    unused: list[Finding] = []
+    for src in sources:
+        for line, codes in src.waiver_lines().items():
+            for code in sorted(codes):
+                if (src.rel_path, line, code) not in used_inline:
+                    unused.append(Finding(
+                        src.rel_path, line, "TAW001",
+                        f"unused waiver: allow={code} matches no "
+                        f"finding on this line"))
+        for checker in per_file:
+            audit = getattr(checker, "waiver_audit", None)
+            if audit is None or not checker.applies_to(src.rel_path):
+                continue
+            all_lines, used_lines = audit(src)
+            for line in sorted(all_lines - used_lines):
+                unused.append(Finding(
+                    src.rel_path, line, "TAW002",
+                    "unused waiver: 'crash-only:' comment on a handler "
+                    "that passes without it (or on no handler at all)"))
+
     stale = [e for e in baseline
              if (e["file"], e["code"], e["message"]) not in matched]
     live.sort(key=lambda f: (f.file, f.line, f.code))
-    return AnalysisResult(live, waived, stale, errors)
+    unused.sort(key=lambda f: (f.file, f.line, f.code))
+    return AnalysisResult(live, waived, stale, errors, unused)

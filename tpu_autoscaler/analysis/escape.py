@@ -1,0 +1,240 @@
+"""Interprocedural escape/lockset race detector (TAR5xx, layer 1).
+
+TAT2xx checks one class at a time: a lock-holding class must guard its
+own writes, a Thread subclass must keep its state inside ``run()``'s
+private call graph.  What it cannot see is an object CONSTRUCTED on one
+thread and MUTATED from another — the informer's ObjectCache, the
+executor's bookkeeping, the TokenProvider cache.  This pass can:
+
+1. thread roots come from the whole-package call graph
+   (``callgraph.PackageGraph``): ``run()`` of every Thread subclass,
+   every resolvable ``Thread(target=...)``, every thunk handed to a
+   worker pool's ``submit`` (the ActuationExecutor dispatch path);
+   everything not exclusively reachable from thread roots is also
+   reachable from the ``main`` root (tests, CLI, the reconcile loop);
+2. every attribute access whose receiver type resolves to a package
+   class is attributed to the accessing function's root set — an object
+   whose attributes are reached from two or more roots has ESCAPED to
+   multiple threads;
+3. each access carries its lexical lockset (the ``with self._lock:`` /
+   ``with _module_lock:`` blocks enclosing it); conflicting accesses
+   (at least one write) from different roots with DISJOINT locksets are
+   races:
+
+   - TAR501 — cross-thread write/write with no common lock;
+   - TAR502 — read racing a cross-thread write with no common lock;
+   - TAR503 — object shared across roots by a class that holds no lock
+     at all (nothing to guard with: share it through a Lock or hand it
+     off through an Event).
+
+Construction is exempt: accesses inside ``__init__`` happen before the
+object can escape (the ``Thread.start()`` edge publishes them), and
+calls ON synchronization primitives (``self._stopped.set()``) are the
+sanctioned channel, never data accesses.
+
+Precision notes: locksets are lexical (a method that takes its own lock
+intersects with every caller — the repo's idiom); conflation is
+class-level (two instances of one class are not distinguished); what
+the graph cannot resolve produces no evidence and therefore no finding
+— the TAT2xx heuristic and the deterministic-schedule harness
+(testing/sched.py) cover that remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tpu_autoscaler.analysis.callgraph import (
+    LOCK_TYPES,
+    MAIN_ROOT,
+    ClassInfo,
+    FuncInfo,
+    PackageGraph,
+    _module_name,
+)
+from tpu_autoscaler.analysis.core import (
+    Finding,
+    ProgramChecker,
+    SourceFile,
+)
+from tpu_autoscaler.analysis.purity import MUTATING_METHODS
+from tpu_autoscaler.analysis.threads import _walk_method
+
+WRITE = "write"
+READ = "read"
+
+
+@dataclasses.dataclass
+class _Access:
+    cls: ClassInfo
+    attr: str
+    kind: str
+    fn: FuncInfo
+    line: int
+    locks: frozenset[str]
+
+    @property
+    def where(self) -> str:
+        parts = self.fn.qname.split(".")
+        return ".".join(parts[-2:]) if self.fn.cls is not None \
+            else parts[-1]
+
+
+# Body walker: skip nested classes and nested functions that rebind
+# ``self`` (plain closures keep the outer self and are walked).  ONE
+# copy of the scoping rule for the whole package — the TAT2xx checker
+# owns it.
+_walk_scoped = _walk_method
+
+
+class EscapeRaceChecker(ProgramChecker):
+    name = "escape-race"
+    codes = {
+        "TAR501": "cross-thread write/write with no common lock",
+        "TAR502": "read racing a cross-thread write with no common lock",
+        "TAR503": "object shared across thread roots without any lock",
+    }
+
+    def applies_to(self, rel_path: str) -> bool:
+        # The deterministic scheduler (testing/sched.py) is the one
+        # module whose mutual exclusion is BY CONSTRUCTION (exactly one
+        # managed thread runs at a time, handed off through semaphores)
+        # rather than by locks — a lockset model cannot express that,
+        # and the harness's own unit tests prove it instead.
+        return "tpu_autoscaler/testing/" not in rel_path
+
+    # -- access extraction ------------------------------------------------
+
+    def _lock_id(self, expr: ast.AST, fn: FuncInfo,
+                 locals_: dict[str, str], graph: PackageGraph) -> str | None:
+        """Stable identity for the lock object in ``with <expr>:``."""
+        t = graph.expr_type(expr, fn, locals_)
+        if t not in LOCK_TYPES:
+            return None
+        if isinstance(expr, ast.Attribute):
+            base_t = graph.expr_type(expr.value, fn, locals_)
+            if base_t is not None:
+                return f"{base_t}.{expr.attr}"
+            return f"{fn.qname}?.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            mod = _module_name(fn.rel_path)
+            if expr.id in graph.modules[mod].global_types:
+                return f"{mod}.{expr.id}"
+            return f"{fn.qname}:{expr.id}"     # local lock variable
+        return None
+
+    def _guard_ranges(self, fn: FuncInfo, locals_: dict[str, str],
+                      graph: PackageGraph) -> list[tuple[int, int, str]]:
+        out: list[tuple[int, int, str]] = []
+        for node in _walk_scoped(fn.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = self._lock_id(item.context_expr, fn, locals_,
+                                        graph)
+                    if lid is not None:
+                        out.append((node.lineno,
+                                    node.end_lineno or node.lineno, lid))
+        return out
+
+    def _accesses_in(self, fn: FuncInfo,
+                     graph: PackageGraph) -> list[_Access]:
+        if fn.node.name == "__init__":
+            return []                          # construction is exempt
+        locals_ = graph.local_types(fn)
+        guards = self._guard_ranges(fn, locals_, graph)
+
+        def locks_at(line: int) -> frozenset[str]:
+            return frozenset(lid for lo, hi, lid in guards
+                             if lo <= line <= hi)
+
+        out: list[_Access] = []
+
+        def target_class(expr: ast.AST) -> ClassInfo | None:
+            t = graph.expr_type(expr, fn, locals_)
+            return graph.classes.get(t) if t else None
+
+        def note(expr: ast.AST, kind: str) -> None:
+            if not isinstance(expr, ast.Attribute):
+                return
+            ci = target_class(expr.value)
+            if ci is None:
+                return
+            attr = expr.attr
+            if attr in ci.sync_attrs:
+                return                          # the sanctioned channel
+            if graph._method(ci, attr) is not None:
+                return                          # method/property: an edge
+            out.append(_Access(ci, attr, kind, fn, expr.lineno,
+                               locks_at(expr.lineno)))
+
+        for node in _walk_scoped(fn.node):
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    note(node, WRITE)
+                else:
+                    note(node, READ)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATING_METHODS:
+                # self.x.append(...) mutates x.
+                note(node.func.value, WRITE)
+        return out
+
+    # -- conflict detection -----------------------------------------------
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
+        graph = PackageGraph(files)
+        by_attr: dict[tuple[str, str], list[_Access]] = {}
+        for fn in graph.funcs.values():
+            for acc in self._accesses_in(fn, graph):
+                by_attr.setdefault((acc.cls.qname, acc.attr), []) \
+                    .append(acc)
+
+        findings: list[Finding] = []
+        seen: set[tuple[str, int, str, str]] = set()
+
+        def emit(f: Finding) -> None:
+            key = (f.file, f.line, f.code, f.message)
+            if key not in seen:
+                seen.add(key)
+                findings.append(f)
+
+        for (cls_q, attr), accs in sorted(by_attr.items()):
+            cls = graph.classes[cls_q]
+            writes = [a for a in accs if a.kind == WRITE]
+            if not writes:
+                continue
+            for w in writes:
+                wr = graph.roots_of.get(w.fn.qname, frozenset())
+                for other in accs:
+                    orr = graph.roots_of.get(other.fn.qname, frozenset())
+                    if not wr or not orr or len(wr | orr) < 2:
+                        continue                # never on two roots
+                    if w is other and len(wr) < 2:
+                        continue
+                    if w.locks & other.locks:
+                        continue                # common lock: synchronized
+                    roots = ", ".join(sorted(wr | orr))
+                    if not cls.lock_attrs:
+                        emit(Finding(
+                            w.fn.rel_path, w.line, "TAR503",
+                            f"'{cls.name}.{attr}' escapes to roots "
+                            f"[{roots}] (written in {w.where}) but "
+                            f"{cls.name} holds no lock — guard it or "
+                            f"hand it off through an Event"))
+                    elif other.kind == WRITE:
+                        emit(Finding(
+                            w.fn.rel_path, w.line, "TAR501",
+                            f"write to '{cls.name}.{attr}' in {w.where} "
+                            f"races write in {other.where} across roots "
+                            f"[{roots}] with no common lock"))
+                    else:
+                        emit(Finding(
+                            other.fn.rel_path, other.line, "TAR502",
+                            f"read of '{cls.name}.{attr}' in "
+                            f"{other.where} races write in {w.where} "
+                            f"across roots [{roots}] with no common "
+                            f"lock"))
+        findings.sort(key=lambda f: (f.file, f.line, f.code))
+        return findings

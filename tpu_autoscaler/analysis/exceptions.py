@@ -72,7 +72,7 @@ class ExceptionHygieneChecker(Checker):
         "TAE302": "bare except (catches SystemExit/KeyboardInterrupt)",
     }
 
-    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE):
+    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE) -> None:
         self._scope = scope
 
     def applies_to(self, rel_path: str) -> bool:
@@ -101,3 +101,24 @@ class ExceptionHygieneChecker(Checker):
                 "broad 'except Exception' swallows errors: re-raise, "
                 "increment a metric, or add '# crash-only: <reason>'"))
         return findings
+
+    def waiver_audit(self, src: SourceFile) -> tuple[set[int], set[int]]:
+        """(every 'crash-only:' comment line, the subset whose waiver
+        actually suppressed a finding).  The difference is dead waivers:
+        comments on handlers that re-raise/count anyway, or on no
+        handler at all — reported by the runner as TAW002 so waiver debt
+        shrinks as handlers are fixed."""
+        all_lines = {n for n, c in src.comments.items() if WAIVER in c}
+        used: set[int] = set()
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None or not _is_broad(node):
+                continue                       # TAE302 is never waivable
+            if _reraises(node) or _increments_metric(node):
+                continue                       # passes without the waiver
+            first_stmt = node.body[0].lineno if node.body else node.lineno
+            for n in range(node.lineno, first_stmt + 1):
+                if WAIVER in src.comments.get(n, ""):
+                    used.add(n)
+        return all_lines, used

@@ -223,7 +223,7 @@ class JaxPurityChecker(Checker):
         "TAJ402": "Python side effect inside a jit-traced function",
     }
 
-    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE):
+    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE) -> None:
         self._scope = scope
 
     def applies_to(self, rel_path: str) -> bool:

@@ -79,7 +79,7 @@ class PurityChecker(Checker):
         "TAP105": "builtin I/O call in a pure module",
     }
 
-    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE):
+    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE) -> None:
         self._scope = scope
 
     def applies_to(self, rel_path: str) -> bool:

@@ -30,6 +30,7 @@ Codes:
 from __future__ import annotations
 
 import ast
+from typing import Iterator
 
 from tpu_autoscaler.analysis.core import (
     Checker,
@@ -72,7 +73,7 @@ def _self_attr_root(node: ast.AST) -> str | None:
     return None
 
 
-def _walk_method(fn: ast.AST):
+def _walk_method(fn: ast.AST) -> Iterator[ast.AST]:
     """Walk a method body WITHOUT descending into nested classes (their
     ``self`` is a different object) or nested functions that rebind
     ``self`` as a parameter; plain closures keep the outer ``self`` and
@@ -90,7 +91,7 @@ def _walk_method(fn: ast.AST):
 
 
 class _ClassInfo:
-    def __init__(self, node: ast.ClassDef):
+    def __init__(self, node: ast.ClassDef) -> None:
         self.node = node
         self.is_thread = any(
             (dotted_name(b) or "").split(".")[-1] == "Thread"

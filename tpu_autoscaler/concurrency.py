@@ -1,0 +1,101 @@
+"""Threading seam: one place the control plane's primitives come from.
+
+Like ``backoff.py`` for retry arithmetic, this is drift-prone plumbing
+centralized: every concurrent subsystem (k8s/informer.py watch threads,
+controller/watch.py, actuators/executor.py's worker pool, gcp.py's
+TokenProvider, the metrics registry, FakeKube's watch condition)
+constructs its threads and synchronization primitives HERE instead of
+reaching for ``threading`` directly.
+
+In production the seam is a 1:1 pass-through to ``threading`` /
+``concurrent.futures`` — zero behavior change, zero overhead beyond one
+attribute read.  Under test, the deterministic-schedule harness
+(``tpu_autoscaler/testing/sched.py``) installs a scheduler here; every
+primitive constructed while it is active is scheduler-controlled, which
+is what lets the harness serialize execution, permute interleavings at
+sync points, and run its vector-clock happens-before checker over the
+real informer/executor/reconciler code paths (docs/ANALYSIS.md).
+
+Module-level primitives created at import time (e.g. the parse-memo
+lock in ``k8s/objects.py``) deliberately stay on raw ``threading``: they
+outlive any one scheduler activation, and a scheduler-owned primitive
+must never escape its scheduler's lifetime.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading as _threading
+from typing import Any, Optional
+
+#: The active deterministic scheduler, or None (production).  Installed
+#: only by tpu_autoscaler/testing/sched.py; never set in production.
+_scheduler: Any = None
+
+
+def install_scheduler(sched: Any) -> None:
+    """Install (or, with None, remove) the deterministic scheduler.
+    Harness-only; refuses to stack two schedulers."""
+    global _scheduler
+    if sched is not None and _scheduler is not None:
+        raise RuntimeError("a deterministic scheduler is already active")
+    _scheduler = sched
+
+
+def active_scheduler() -> Any:
+    return _scheduler
+
+
+class Thread(_threading.Thread):
+    """``threading.Thread`` that an active deterministic scheduler
+    adopts at ``start()`` time (its ``run()`` becomes a managed,
+    schedule-controlled thread); identical to ``threading.Thread``
+    otherwise."""
+
+    def start(self) -> None:
+        sched = _scheduler
+        if sched is not None:
+            sched.adopt_thread(self)
+        else:
+            super().start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        sched = _scheduler
+        if sched is not None and sched.owns_thread(self):
+            sched.join_thread(self)
+        else:
+            super().join(timeout)
+
+
+def Lock():  # noqa: N802 — mirrors the threading API it stands in for
+    sched = _scheduler
+    return sched.create_lock() if sched is not None else _threading.Lock()
+
+
+def RLock():  # noqa: N802
+    sched = _scheduler
+    return sched.create_rlock() if sched is not None else _threading.RLock()
+
+
+def Event():  # noqa: N802
+    sched = _scheduler
+    return sched.create_event() if sched is not None else _threading.Event()
+
+
+def Condition(lock=None):  # noqa: N802
+    sched = _scheduler
+    if sched is not None:
+        return sched.create_condition(lock)
+    return _threading.Condition(lock)
+
+
+def pool_executor(max_workers: int, thread_name_prefix: str = ""):
+    """A ``ThreadPoolExecutor``-shaped pool (``submit`` returning a
+    ``concurrent.futures.Future``, ``shutdown``).  Under the harness,
+    every submitted thunk runs as a managed thread so the scheduler can
+    interleave worker execution with the reconcile thread."""
+    sched = _scheduler
+    if sched is not None:
+        return sched.create_pool(max_workers)
+    return concurrent.futures.ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix=thread_name_prefix)

@@ -27,7 +27,7 @@ from tpu_autoscaler.actuators.base import (
     in_flight_of,
 )
 from tpu_autoscaler.engine.fitter import free_capacity
-from tpu_autoscaler.engine.planner import Planner, PoolPolicy
+from tpu_autoscaler.engine.planner import InFlight, Planner, PoolPolicy
 from tpu_autoscaler.k8s.client import KubeClient
 from tpu_autoscaler.k8s.gangs import Gang, group_into_gangs
 from tpu_autoscaler.k8s.objects import (
@@ -159,6 +159,15 @@ class Controller:
         # Sticky staleness guard (_observe): node names a direct LIST
         # saw that the informer's node cache has not delivered yet.
         self._nodes_awaiting_cache: set[str] = set()
+        # Sticky supply guard (_update_supply_guard): provisions that
+        # went ACTIVE but whose supply units have not REGISTERED as
+        # nodes yet.  The informer guard above closes the cache-lag
+        # half of the ACTIVE→registration window; this closes the
+        # apiserver-lag half — on the serial path it is the ONLY guard
+        # (the double-provision window the race harness reproduces,
+        # tests/test_races.py).  id -> (planner view, unit ids, since).
+        self._supply_awaiting_nodes: dict[
+            str, tuple[InFlight, tuple[str, ...], float]] = {}
         # Actuators that do REST I/O surface their retry counters
         # through the controller's metrics registry (gcp.py GcpRest);
         # the real kube client does the same (kube_retries).
@@ -224,6 +233,7 @@ class Controller:
         nodes, pods = self._observe()
         self.metrics.observe("observe_seconds",
                              time.perf_counter() - t_obs)
+        self._update_supply_guard(nodes, now)
 
         pending = [p for p in pods if p.is_unschedulable]
         gangs = group_into_gangs(pending)
@@ -354,6 +364,50 @@ class Controller:
             nodes = self.informer.nodes()
         return nodes, self.informer.pods()
 
+    def _update_supply_guard(self, nodes: list[Node], now: float) -> None:
+        """Close the ACTIVE→node-registration double-provision window.
+
+        A provision stops counting as in-flight the moment it reports
+        ACTIVE, but its nodes register with the apiserver asynchronously
+        — in that window the planner sees neither the in-flight work nor
+        the new supply and would submit a duplicate (the pre-existing
+        gap the schedule harness reproduces on the pre-fix serial path).
+        Mirror of the informer's sticky ``_nodes_awaiting_cache`` guard,
+        one layer down: keep a planner-visible ``InFlight`` for every
+        just-ACTIVE provision until each of its supply units appears
+        among the observed nodes.  Bounded: an entry whose nodes never
+        register expires after ``provision_timeout_seconds`` so a lost
+        slice cannot shield its demand from re-provisioning forever.
+        """
+        seen_units = set(self._units(nodes))
+        for status in self.actuator.statuses():
+            if (status.state == ACTIVE and status.unit_ids
+                    and status.id in self._submitted_at
+                    and status.id not in self._supply_awaiting_nodes
+                    and any(u not in seen_units for u in status.unit_ids)):
+                self._supply_awaiting_nodes[status.id] = (
+                    InFlight(kind=status.request.kind,
+                             shape_name=status.request.shape_name,
+                             gang_key=status.request.gang_key,
+                             count=status.request.count),
+                    tuple(status.unit_ids), now)
+                self.metrics.inc("supply_guard_engaged")
+        for pid, (_inf, unit_ids, since) in list(
+                self._supply_awaiting_nodes.items()):
+            if all(u in seen_units for u in unit_ids):
+                del self._supply_awaiting_nodes[pid]
+            elif now - since > self.config.provision_timeout_seconds:
+                del self._supply_awaiting_nodes[pid]
+                self.metrics.inc("supply_guard_expired")
+
+    def _in_flight(self) -> list[InFlight]:
+        """The planner's view of outstanding work: the actuator's
+        in-flight provisions plus ACTIVE ones still awaiting node
+        registration (the sticky supply guard)."""
+        return (in_flight_of(self.actuator)
+                + [inf for inf, _, _ in
+                   self._supply_awaiting_nodes.values()])
+
     def _fresh_nodes(self) -> list[Node]:
         """Direct LIST, bypassing the informer cache (memo-parsed, so
         only nodes that actually changed are re-parsed)."""
@@ -376,9 +430,9 @@ class Controller:
         O(churn) instead of O(cluster).  Each pass is wrapped in a
         catch-all so the loop is crash-only (reference parity).
         """
-        import threading
+        from tpu_autoscaler import concurrency
 
-        wake = threading.Event()
+        wake = concurrency.Event()
         if watch and self.informer is None \
                 and hasattr(self.client, "watch_pods"):
             from tpu_autoscaler.k8s.informer import ClusterInformer
@@ -446,8 +500,7 @@ class Controller:
         # sets its backoff before we consider re-submitting for its demand.
         self._note_failures(now, pods)
         overrides = self._generation_overrides(gangs, now)
-        plan = self.planner.plan(gangs, nodes, pods,
-                                 in_flight_of(self.actuator),
+        plan = self.planner.plan(gangs, nodes, pods, self._in_flight(),
                                  generation_overrides=overrides)
         for req in plan.requests:
             # Respect retry backoff after a failed provision for the same
@@ -542,15 +595,15 @@ class Controller:
         existing_chips = sum(unit_chips(ns) for ns in units.values()
                              if ns[0].is_tpu)
         # The planner's max_total_chips check counts in-flight slices as
-        # supply, so the overshoot must too — otherwise with provisions
-        # in flight preemption frees too few chips and the gang stays
+        # supply (including supply-guarded just-ACTIVE ones), so the
+        # overshoot must too — otherwise with provisions in flight
+        # preemption frees too few chips and the gang stays
         # clamp-blocked through repeated victim rounds.
-        from tpu_autoscaler.actuators.base import in_flight_of
         from tpu_autoscaler.topology.catalog import shape_by_name
 
         inflight_chips = sum(
             shape_by_name(f.shape_name).chips * f.count
-            for f in in_flight_of(self.actuator) if f.kind == "tpu-slice")
+            for f in self._in_flight() if f.kind == "tpu-slice")
         # Chips already on their way out (drains in progress) free up
         # without new victims — credit them before choosing more.
         draining_ids = (set(self._drain_started)

@@ -33,6 +33,7 @@ import logging
 import random
 import threading
 
+from tpu_autoscaler import concurrency
 from tpu_autoscaler.backoff import (
     WATCH_BACKOFF_BASE_S as BACKOFF_BASE_S,
     WATCH_BACKOFF_CAP_S as BACKOFF_CAP_S,
@@ -44,7 +45,7 @@ log = logging.getLogger(__name__)
 _RELEVANT_TYPES = frozenset({"ADDED", "MODIFIED", "DELETED"})
 
 
-class WatchTrigger(threading.Thread):
+class WatchTrigger(concurrency.Thread):
     def __init__(self, client, wake: threading.Event,
                  timeout_seconds: int = 60, metrics=None,
                  rng: random.Random | None = None):
@@ -52,7 +53,7 @@ class WatchTrigger(threading.Thread):
         self._client = client
         self._wake = wake
         self._timeout = timeout_seconds
-        self._stopped = threading.Event()
+        self._stopped = concurrency.Event()
         self._metrics = metrics
         self._rng = rng or random.Random()
         self._resource_version: str | None = None

@@ -13,9 +13,9 @@ from __future__ import annotations
 
 import copy
 import itertools
-import threading
 import time
 
+from tpu_autoscaler import concurrency
 from tpu_autoscaler.k8s.objects import Node, Pod
 from tpu_autoscaler.k8s.resources import ResourceVector
 
@@ -55,7 +55,7 @@ class FakeKube:
         # Condition watchers block on.  _journaling stays False (and the
         # floor tracks the head) until the first watch_* call, so
         # journal copies cost nothing in poll-only use.
-        self._watch_cond = threading.Condition()
+        self._watch_cond = concurrency.Condition()
         self._last_seq = 0
         self._journal: list[tuple[int, str, str, dict]] = []
         self._journal_floor = 0

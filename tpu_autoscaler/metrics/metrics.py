@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+from tpu_autoscaler import concurrency
+
 
 class _Summary:
     __slots__ = ("count", "total", "min", "max", "last")
@@ -46,7 +48,7 @@ class _Summary:
 
 class Metrics:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = concurrency.Lock()
         self._counters: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
         self._summaries: dict[str, _Summary] = defaultdict(_Summary)

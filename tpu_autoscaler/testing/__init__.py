@@ -1,0 +1,8 @@
+"""Test-side harnesses shipped with the package.
+
+``tpu_autoscaler.testing.sched`` is layer 2 of the race detector
+(docs/ANALYSIS.md): a deterministic scheduler that serializes the
+control plane's threads through the ``tpu_autoscaler.concurrency`` seam
+and permutes interleavings across seeded schedules while a vector-clock
+happens-before checker watches shared state.
+"""

@@ -1,0 +1,114 @@
+"""Seeded-bug fixtures for the race detector's cross-validation tests.
+
+The two layers of the detector must each catch something the other is
+blind to (docs/ANALYSIS.md):
+
+- ``DynamicCounter``/``hammer`` is the STATIC-BLIND fixture: the
+  mutating call is resolved through ``getattr`` at runtime, so the
+  TAR5xx call graph cannot connect the thread root to the write — the
+  static pass reports NOTHING on this module (asserted in
+  tests/test_sched.py), while the deterministic-schedule harness flags
+  the unsynchronized ``value`` access within its seeded budget.
+
+- ``LeakyCache``/``leaky_informer_scenario`` is the injected
+  informer/executor-shaped race: a watch-thread-fed cache whose
+  ``apply`` path skips the lock — exactly the bug class the real
+  ``ObjectCache`` guards against — driven through a real
+  ``ResourceWatch`` thread so the harness proves it catches the bug in
+  production-shaped plumbing, not only in toy classes.
+
+These are FIXTURES: intentionally racy, never imported by production
+code.  Do not "fix" them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Mapping
+
+from tpu_autoscaler import concurrency
+
+
+class DynamicCounter:
+    """Racy on purpose; invisible to the static pass (getattr dispatch).
+
+    ``value`` is read-modify-written with no lock, but the only path to
+    ``bump`` goes through ``poke``'s ``getattr(self, self._op)`` — an
+    edge no sound-by-evidence call graph can resolve.
+    """
+
+    def __init__(self) -> None:
+        self._op = "bump"
+        self.value = 0
+
+    def bump(self) -> None:
+        self.value = self.value + 1
+
+    def poke(self) -> None:
+        getattr(self, self._op)()
+
+
+def hammer(counter: DynamicCounter, rounds: int = 2) -> None:
+    """Poke ``counter`` from a spawned thread and the caller at once."""
+    t = concurrency.Thread(target=counter.poke)
+    t.start()
+    for _ in range(rounds):
+        counter.poke()
+    t.join()
+
+
+class LeakyCache:
+    """An ObjectCache-shaped store whose delta path SKIPS the lock.
+
+    ``replace`` (the relist path) takes the lock like the real informer
+    cache; ``apply`` (the hot watch-delta path) does not — the seeded
+    informer bug layer 2 must flag.
+    """
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+        self._lock = concurrency.Lock()
+        self._objects: dict[str, dict] = {}
+        self.version: str | None = None
+
+    def replace(self, items: Iterable[dict],
+                resource_version: str | None) -> None:
+        objects = {i["metadata"]["name"]: i for i in items}
+        with self._lock:
+            self._objects = objects
+            self.version = resource_version
+
+    def apply(self, event: Mapping[str, Any]) -> bool:
+        obj = dict(event.get("object") or {})
+        name = obj.get("metadata", {}).get("name")
+        if name is None:
+            return False
+        # BUG (seeded): no lock around the mutation or the cursor bump.
+        self._objects[name] = obj  # analysis: allow=TAT201 seeded-bug fixture (the bug is the point)
+        self.version = obj.get("metadata", {}).get("resourceVersion")  # analysis: allow=TAT201 seeded-bug fixture
+        return True
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._objects.values())
+
+    def peek_version(self) -> str | None:
+        # BUG (seeded): unlocked read racing apply()'s unlocked write.
+        return self.version
+
+
+def drive_leaky_cache(cache: LeakyCache,
+                      events: list[Mapping[str, Any]],
+                      reads: int,
+                      spawn: Callable[..., Any] = concurrency.Thread) -> None:
+    """Feed ``events`` through a background thread while the caller
+    reads — the minimal informer-shaped drive for the seeded bug."""
+    def feeder() -> None:
+        for event in events:
+            cache.apply(event)
+
+    t = spawn(target=feeder)
+    t.start()
+    for _ in range(reads):
+        cache.peek_version()
+        cache.snapshot()
+    t.join()

@@ -1,0 +1,734 @@
+"""Deterministic-schedule concurrency harness (race detector layer 2).
+
+Layer 1 (``tpu_autoscaler/analysis/escape.py``) proves the *quiet*
+paths statically; this module proves the *loud* ones by running them.
+It drives real production code — informer watch threads, the actuation
+executor's workers, the reconcile loop — under a deterministic
+scheduler:
+
+- every ``Thread``/``Lock``/``RLock``/``Event``/``Condition``/worker
+  pool constructed through the ``tpu_autoscaler.concurrency`` seam
+  while a scheduler is active becomes scheduler-controlled;
+- execution is fully SERIALIZED: exactly one managed thread runs at a
+  time, and at every synchronization point (lock acquire/release,
+  event set/wait/is_set, thread start/join, tracked-attribute write)
+  the scheduler picks the next thread to run from a seeded RNG — so a
+  scenario replayed with the same seed takes the same interleaving,
+  and sweeping seeds systematically permutes interleavings;
+- timeouts are *schedule choices*, not wall-clock: a thread in a timed
+  wait can be woken by its signal or "expired" by the scheduler
+  (always expired when nothing else is runnable — virtual time), so
+  scenarios terminate without sleeping;
+- a vector-clock happens-before checker watches attribute accesses on
+  objects registered via ``tracker.track(obj)``: two accesses to the
+  same attribute, at least one a write, from different threads, with
+  disjoint locksets and no happens-before edge between them, are a
+  data race — reported with BOTH stacks regardless of whether the
+  explored interleaving actually corrupted anything.
+
+Scenario shape::
+
+    def scenario(sched):
+        cache = sched.tracker.track(ObjectCache("pods", parse_pod))
+        w = ResourceWatch(cache, list_fn, watch_fn)   # concurrency.Thread
+        w.start()
+        ... drive the reconcile side ...
+        w.stop()
+
+    races = find_races(scenario, schedules=20)
+    assert not races, races[0].describe()
+
+The harness is single-core honest: it cannot observe true parallelism,
+but any unsynchronized conflicting pair IS caught by the happens-before
+check in whichever schedule makes both accesses happen — that is the
+point of sweeping seeds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import random
+import sys
+import threading as _threading
+import traceback
+from typing import Any, Callable, Iterator
+
+import concurrent.futures
+
+from tpu_autoscaler import concurrency
+
+#: Managed-thread states.
+RUNNABLE = "runnable"
+BLOCKED = "blocked"      # only a state change (release/set/exit) wakes it
+TIMED = "timed"          # timed wait: a signal OR a schedule choice wakes it
+DONE = "done"
+
+
+class SchedulerError(RuntimeError):
+    pass
+
+
+class DeadlockError(SchedulerError):
+    """Every managed thread is blocked and no timed wait can expire."""
+
+
+class StepBudgetExceeded(SchedulerError):
+    """The schedule ran past ``max_steps`` sync points (livelock guard)."""
+
+
+class _Shutdown(BaseException):
+    """Unwinds managed threads at scheduler teardown.  BaseException on
+    purpose: the control plane's crash-only ``except Exception`` loops
+    must not be able to swallow it."""
+
+
+def _vc_join(dst: dict[int, int], src: dict[int, int]) -> None:
+    for k, v in src.items():
+        if v > dst.get(k, 0):
+            dst[k] = v
+
+
+def _vc_hb(a: dict[int, int], b: dict[int, int]) -> bool:
+    """True iff clock ``a`` happened-before (or equals) clock ``b``."""
+    return all(v <= b.get(k, 0) for k, v in a.items())
+
+
+class _TCB:
+    """One managed thread's control block."""
+
+    __slots__ = ("tid", "name", "sem", "state", "vc", "locks",
+                 "waiting_on", "wake_flag", "crash")
+
+    def __init__(self, tid: int, name: str):
+        self.tid = tid
+        self.name = name
+        self.sem = _threading.Semaphore(0)
+        self.state = RUNNABLE
+        self.vc: dict[int, int] = {tid: 1}
+        self.locks: list[Any] = []      # held SchedLock/SchedRLock, in order
+        self.waiting_on: Any = None
+        self.wake_flag = False
+        self.crash: BaseException | None = None
+
+
+# --------------------------------------------------------------------- #
+# Shim primitives (constructed via the concurrency seam)
+# --------------------------------------------------------------------- #
+
+class SchedLock:
+    def __init__(self, sched: "DeterministicScheduler", reentrant: bool):
+        self._sched = sched
+        self._reentrant = reentrant
+        self._owner: _TCB | None = None
+        self._count = 0
+        self.vc: dict[int, int] = {}
+
+    def acquire(self, blocking: bool = True,
+                timeout: float | None = None) -> bool:
+        if timeout is not None and timeout != -1:
+            # Timed lock acquisition is not modeled (nothing behind the
+            # seam uses it); failing loudly beats silently blocking
+            # forever and reporting a spurious deadlock.
+            raise NotImplementedError(
+                "timed Lock.acquire is not modeled by the scheduler")
+        return self._sched.lock_acquire(self, blocking)
+
+    def release(self) -> None:
+        self._sched.lock_release(self)
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def __enter__(self) -> "SchedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+
+class SchedEvent:
+    def __init__(self, sched: "DeterministicScheduler"):
+        self._sched = sched
+        self._set = False
+        self.vc: dict[int, int] = {}
+
+    def is_set(self) -> bool:
+        self._sched.step()
+        return self._set
+
+    def set(self) -> None:
+        self._sched.event_set(self)
+
+    def clear(self) -> None:
+        self._sched.step()
+        self._set = False
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._sched.event_wait(self, timeout)
+
+
+class SchedCondition:
+    def __init__(self, sched: "DeterministicScheduler", lock=None):
+        self._sched = sched
+        self._lock = lock if lock is not None else SchedLock(sched, True)
+
+    def acquire(self, *a: Any) -> bool:
+        return self._lock.acquire()
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> "SchedCondition":
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._lock.release()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._sched.cond_wait(self, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._sched.cond_notify(self, n)
+
+    def notify_all(self) -> None:
+        self._sched.cond_notify(self, sys.maxsize)
+
+
+class SchedPool:
+    """ThreadPoolExecutor stand-in: each submitted thunk runs as its own
+    managed thread (the worker cap is a throughput knob, irrelevant to
+    interleaving coverage, so it is not modeled)."""
+
+    def __init__(self, sched: "DeterministicScheduler", max_workers: int):
+        self._sched = sched
+        self._ids = itertools.count(1)
+
+    def submit(self, fn: Callable[..., Any], /, *args: Any,
+               **kwargs: Any) -> "concurrent.futures.Future[Any]":
+        fut: concurrent.futures.Future[Any] = concurrent.futures.Future()
+        fut.set_running_or_notify_cancel()
+
+        def run() -> None:
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except _Shutdown:
+                raise
+            except BaseException as e:  # noqa: BLE001 — future protocol
+                fut.set_exception(e)
+
+        self._sched.spawn(run, name=f"pool-worker-{next(self._ids)}")
+        return fut
+
+    def shutdown(self, wait: bool = True,
+                 cancel_futures: bool = False) -> None:
+        pass  # managed threads are unwound at scheduler teardown
+
+
+# --------------------------------------------------------------------- #
+# Happens-before access tracker
+# --------------------------------------------------------------------- #
+
+class _Access:
+    __slots__ = ("tid", "thread", "op", "vc", "lockset", "where", "stack")
+
+    def __init__(self, tid: int, thread: str, op: str, vc: dict[int, int],
+                 lockset: frozenset, where: str, stack: str):
+        self.tid = tid
+        self.thread = thread
+        self.op = op
+        self.vc = vc
+        self.lockset = lockset
+        self.where = where
+        self.stack = stack
+
+
+class RaceReport:
+    def __init__(self, cls: str, attr: str, a: _Access, b: _Access):
+        self.cls = cls
+        self.attr = attr
+        self.a = a
+        self.b = b
+
+    def describe(self) -> str:
+        return (
+            f"data race on {self.cls}.{self.attr}: "
+            f"{self.a.op} by '{self.a.thread}' at {self.a.where} vs "
+            f"{self.b.op} by '{self.b.thread}' at {self.b.where} "
+            f"(disjoint locksets, no happens-before edge)\n"
+            f"--- first access stack ---\n{self.a.stack}"
+            f"--- second access stack ---\n{self.b.stack}")
+
+    def __repr__(self) -> str:
+        return (f"RaceReport({self.cls}.{self.attr}: {self.a.thread} "
+                f"{self.a.op} vs {self.b.thread} {self.b.op})")
+
+
+_SYNC_TYPES = (SchedLock, SchedEvent, SchedCondition,
+               type(_threading.Lock()), type(_threading.RLock()),
+               _threading.Event, _threading.Condition,
+               _threading.Semaphore)
+
+_MAX_ACCESSES_PER_KEY = 64
+_MAX_RACES = 100
+
+
+class AccessTracker:
+    """Records reads/writes on tracked objects and checks every
+    conflicting pair for a happens-before edge and a common lock."""
+
+    def __init__(self, sched: "DeterministicScheduler"):
+        self._sched = sched
+        self._busy = _threading.local()
+        self._by_key: dict[tuple[int, str], list[_Access]] = {}
+        self._cls_of: dict[int, str] = {}
+        self._subclasses: dict[type, type] = {}
+        self.races: list[RaceReport] = []
+
+    def track(self, obj: Any) -> Any:
+        """Swap ``obj``'s class for an access-recording subclass and
+        return it.  The object behaves identically otherwise."""
+        cls = obj.__class__
+        sub = self._subclasses.get(cls)
+        if sub is None:
+            tracker = self
+
+            def __setattr__(s: Any, name: str, value: Any) -> None:
+                if not isinstance(value, _SYNC_TYPES):
+                    tracker._record(s, name, "write")
+                object.__setattr__(s, name, value)
+
+            def __getattribute__(s: Any, name: str) -> Any:
+                value = object.__getattribute__(s, name)
+                tracker._maybe_record_read(s, name, value)
+                return value
+
+            sub = type("Tracked" + cls.__name__, (cls,), {
+                "__setattr__": __setattr__,
+                "__getattribute__": __getattribute__,
+            })
+            self._subclasses[cls] = sub
+        self._cls_of[id(obj)] = cls.__name__
+        obj.__class__ = sub
+        return obj
+
+    def _maybe_record_read(self, obj: Any, name: str, value: Any) -> None:
+        if name.startswith("__") or callable(value) \
+                or isinstance(value, _SYNC_TYPES):
+            return
+        if name not in object.__getattribute__(obj, "__dict__"):
+            return
+        self._record(obj, name, "read")
+
+    def _record(self, obj: Any, name: str, op: str) -> None:
+        if getattr(self._busy, "flag", False):
+            return
+        tcb = self._sched.current_tcb()
+        if tcb is None or name.startswith("__"):
+            return
+        self._busy.flag = True
+        try:
+            where, stack = self._caller()
+            acc = _Access(
+                tcb.tid, tcb.name, op, dict(tcb.vc),
+                frozenset(id(lk) for lk in tcb.locks), where, stack)
+            key = (id(obj), name)
+            prior = self._by_key.setdefault(key, [])
+            for prev in prior:
+                if len(self.races) >= _MAX_RACES:
+                    break
+                if (prev.op == "write" or op == "write") \
+                        and prev.tid != acc.tid \
+                        and not (prev.lockset & acc.lockset) \
+                        and not _vc_hb(prev.vc, acc.vc):
+                    self.races.append(RaceReport(
+                        self._cls_of.get(id(obj), type(obj).__name__),
+                        name, prev, acc))
+            prior.append(acc)
+            del prior[:-_MAX_ACCESSES_PER_KEY]
+        finally:
+            self._busy.flag = False
+        if op == "write":
+            # A shared write is itself an interleaving point.
+            self._sched.step()
+
+    @staticmethod
+    def _caller() -> tuple[str, str]:
+        frame = sys._getframe(2)
+        while frame is not None and frame.f_code.co_filename == __file__:
+            frame = frame.f_back
+        if frame is None:
+            return "<unknown>", ""
+        where = (f"{frame.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                 f"{frame.f_lineno} ({frame.f_code.co_name})")
+        stack = "".join(traceback.format_stack(frame, limit=6))
+        return where, stack
+
+
+# --------------------------------------------------------------------- #
+# The scheduler
+# --------------------------------------------------------------------- #
+
+class DeterministicScheduler:
+    """Serialized seeded-interleaving executor for seam-built threads."""
+
+    def __init__(self, seed: int = 0, max_steps: int = 200_000):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._tids = itertools.count()
+        self._tcbs: dict[int, _TCB] = {}
+        self._local = _threading.local()
+        self._threads: dict[int, _threading.Thread] = {}  # real backing
+        self._adopted: dict[int, _TCB] = {}  # id(Thread obj) -> tcb
+        self._main: _TCB | None = None
+        self._steps = 0
+        self._max_steps = max_steps
+        self._shutdown = False
+        self.tracker = AccessTracker(self)
+        self.crashes: list[tuple[str, BaseException]] = []
+
+    # -- seam factories ---------------------------------------------------
+
+    def create_lock(self) -> SchedLock:
+        return SchedLock(self, reentrant=False)
+
+    def create_rlock(self) -> SchedLock:
+        return SchedLock(self, reentrant=True)
+
+    def create_event(self) -> SchedEvent:
+        return SchedEvent(self)
+
+    def create_condition(self, lock=None) -> SchedCondition:
+        return SchedCondition(self, lock)
+
+    def create_pool(self, max_workers: int) -> SchedPool:
+        return SchedPool(self, max_workers)
+
+    # -- lifecycle --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def active(self) -> Iterator["DeterministicScheduler"]:
+        """Install the scheduler and register the calling thread as the
+        managed 'main' thread for the duration of the block."""
+        concurrency.install_scheduler(self)
+        main = _TCB(next(self._tids), "main")
+        self._tcbs[main.tid] = main
+        self._local.tcb = main
+        self._main = main
+        try:
+            yield self
+        finally:
+            try:
+                self._teardown(main)
+            finally:
+                self._local.tcb = None
+                concurrency.install_scheduler(None)
+
+    def _teardown(self, main: _TCB) -> None:
+        self._shutdown = True
+        for _ in range(10_000):
+            live = [t for t in self._tcbs.values()
+                    if t is not main and t.state != DONE]
+            if not live:
+                break
+            for t in live:
+                # Force-wake: the next sync point each thread hits will
+                # raise _Shutdown and unwind it.
+                if t.state in (BLOCKED, TIMED):
+                    t.state = RUNNABLE
+                    t.wake_flag = False
+            self._switch(live[0])
+        for rt in self._threads.values():
+            rt.join(timeout=5.0)
+
+    # -- thread management ------------------------------------------------
+
+    def current_tcb(self) -> _TCB | None:
+        return getattr(self._local, "tcb", None)
+
+    def _cur(self) -> _TCB:
+        tcb = self.current_tcb()
+        if tcb is None:
+            raise SchedulerError(
+                "scheduler-managed primitive used from an unmanaged "
+                "thread; create threads through the concurrency seam")
+        return tcb
+
+    def spawn(self, fn: Callable[[], Any], name: str) -> _TCB:
+        parent = self._cur()
+        tcb = _TCB(next(self._tids), name)
+        _vc_join(tcb.vc, parent.vc)          # start() edge: parent → child
+        tcb.vc[tcb.tid] = tcb.vc.get(tcb.tid, 0) + 1
+        parent.vc[parent.tid] += 1
+        self._tcbs[tcb.tid] = tcb
+        real = _threading.Thread(
+            target=self._managed_main, args=(tcb, fn),
+            name=f"sched-{name}", daemon=True)
+        self._threads[tcb.tid] = real
+        real.start()
+        self.step()                          # child may run immediately
+        return tcb
+
+    def adopt_thread(self, thread: _threading.Thread) -> None:
+        tcb = self.spawn(thread.run, name=thread.name or "thread")
+        self._adopted[id(thread)] = tcb
+
+    def owns_thread(self, thread: _threading.Thread) -> bool:
+        return id(thread) in self._adopted
+
+    def join_thread(self, thread: _threading.Thread) -> None:
+        cur = self._cur()
+        target = self._adopted[id(thread)]
+        while target.state != DONE:
+            cur.state = BLOCKED
+            cur.waiting_on = target
+            self._wait_scheduled(cur)
+        cur.waiting_on = None
+        _vc_join(cur.vc, target.vc)          # join() edge: child → parent
+
+    def _managed_main(self, tcb: _TCB, fn: Callable[[], Any]) -> None:
+        self._local.tcb = tcb
+        tcb.sem.acquire()                    # wait to be scheduled first
+        if self._shutdown:
+            self._finish(tcb)
+            return
+        try:
+            fn()
+        except _Shutdown:
+            pass
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            # at find_races level; a silent dead thread would look like
+            # a passing scenario.
+            tcb.crash = e
+            self.crashes.append((tcb.name, e))
+        self._finish(tcb)
+
+    def _finish(self, tcb: _TCB) -> None:
+        tcb.state = DONE
+        for t in self._tcbs.values():
+            if t.state == BLOCKED and t.waiting_on is tcb:
+                t.state = RUNNABLE           # wake joiners
+        nxt = self._choose(exclude=tcb)
+        if nxt is not None:
+            self._handoff(nxt)
+        else:
+            main = self._main
+            if main is not None and main.state != DONE:
+                # Everyone else is blocked: the run ends in deadlock.
+                self.crashes.append((tcb.name, DeadlockError(
+                    "all threads blocked at thread exit")))
+                main.state = RUNNABLE
+                main.sem.release()
+
+    # -- core scheduling --------------------------------------------------
+
+    def step(self) -> None:
+        """One scheduling decision point (preemption opportunity)."""
+        cur = self.current_tcb()
+        if cur is None:
+            return
+        self._check_shutdown(cur)
+        self._steps += 1
+        if self._steps > self._max_steps:
+            raise StepBudgetExceeded(
+                f"schedule exceeded {self._max_steps} sync points "
+                f"(seed {self.seed}) — livelock or missing stop signal")
+        nxt = self._choose()
+        if nxt is not None and nxt is not cur:
+            self._switch(nxt)
+
+    def _check_shutdown(self, cur: _TCB) -> None:
+        if self._shutdown and cur is not self._main:
+            raise _Shutdown()
+
+    def _choose(self, exclude: _TCB | None = None) -> _TCB | None:
+        """Pick the next thread: any RUNNABLE, or a TIMED waiter (picking
+        one means its timeout fires — timeouts are schedule choices)."""
+        pool = [t for t in sorted(self._tcbs.values(), key=lambda t: t.tid)
+                if t is not exclude and t.state in (RUNNABLE, TIMED)]
+        if not pool:
+            return None
+        return self._rng.choice(pool)
+
+    def _handoff(self, to: _TCB) -> None:
+        if to.state == TIMED:
+            to.state = RUNNABLE
+            to.wake_flag = False             # its timeout fired
+        to.sem.release()
+
+    def _switch(self, to: _TCB) -> None:
+        cur = self._cur()
+        if to is cur:
+            return
+        self._handoff(to)
+        cur.sem.acquire()                    # park until re-scheduled
+        self._check_shutdown(cur)
+
+    def _wait_scheduled(self, cur: _TCB) -> None:
+        """Current thread is BLOCKED/TIMED: run someone else until a
+        wake-up (or, for timed waits, a virtual-time expiry)."""
+        nxt = self._choose(exclude=cur)
+        if nxt is None:
+            if cur.state == TIMED:
+                cur.state = RUNNABLE         # nothing else can run: the
+                cur.wake_flag = False        # timeout fires (virtual time)
+                return
+            raise DeadlockError(self._deadlock_report(cur))
+        self._switch(nxt)
+
+    def _deadlock_report(self, cur: _TCB) -> str:
+        lines = [f"deadlock (seed {self.seed}): every thread is blocked"]
+        for t in sorted(self._tcbs.values(), key=lambda t: t.tid):
+            lines.append(f"  {t.name}: {t.state}"
+                         + (f" on {type(t.waiting_on).__name__}"
+                            if t.waiting_on is not None else ""))
+        return "\n".join(lines)
+
+    # -- primitive semantics ----------------------------------------------
+
+    def lock_acquire(self, lock: SchedLock, blocking: bool) -> bool:
+        cur = self._cur()
+        self.step()                          # interleave BEFORE acquiring
+        if lock._owner is cur:
+            if not lock._reentrant:
+                raise DeadlockError(
+                    f"'{cur.name}' re-acquired a non-reentrant lock")
+            lock._count += 1
+            return True
+        while lock._owner is not None:
+            if not blocking:
+                return False
+            cur.state = BLOCKED
+            cur.waiting_on = lock
+            self._wait_scheduled(cur)
+            self._check_shutdown(cur)
+        cur.waiting_on = None
+        lock._owner = cur
+        lock._count = 1
+        cur.locks.append(lock)
+        _vc_join(cur.vc, lock.vc)            # release → acquire edge
+        cur.vc[cur.tid] += 1
+        return True
+
+    def lock_release(self, lock: SchedLock) -> None:
+        cur = self._cur()
+        if lock._owner is not cur:
+            raise SchedulerError(
+                f"'{cur.name}' released a lock it does not hold")
+        if lock._reentrant and lock._count > 1:
+            lock._count -= 1
+            return
+        cur.vc[cur.tid] += 1
+        _vc_join(lock.vc, cur.vc)
+        lock._owner = None
+        lock._count = 0
+        if lock in cur.locks:
+            cur.locks.remove(lock)
+        for t in self._tcbs.values():
+            if t.state == BLOCKED and t.waiting_on is lock:
+                t.state = RUNNABLE           # re-contends in its loop
+        self.step()
+
+    def event_set(self, ev: SchedEvent) -> None:
+        cur = self._cur()
+        cur.vc[cur.tid] += 1
+        _vc_join(ev.vc, cur.vc)              # set → wait edge
+        ev._set = True
+        for t in self._tcbs.values():
+            if t.state in (BLOCKED, TIMED) and t.waiting_on is ev:
+                t.state = RUNNABLE
+                t.wake_flag = True
+                t.waiting_on = None
+        self.step()
+
+    def event_wait(self, ev: SchedEvent, timeout: float | None) -> bool:
+        cur = self._cur()
+        self.step()
+        if ev._set:
+            _vc_join(cur.vc, ev.vc)
+            return True
+        if timeout is not None and timeout <= 0:
+            return False
+        cur.state = TIMED if timeout is not None else BLOCKED
+        cur.waiting_on = ev
+        cur.wake_flag = False
+        while cur.state != RUNNABLE:
+            self._wait_scheduled(cur)
+        self._check_shutdown(cur)
+        cur.waiting_on = None
+        if cur.wake_flag or ev._set:
+            _vc_join(cur.vc, ev.vc)
+            return True
+        return False                         # timeout fired
+
+    def cond_wait(self, cond: SchedCondition, timeout: float | None) -> bool:
+        cur = self._cur()
+        lock = cond._lock
+        if lock._owner is not cur:
+            raise SchedulerError("cond.wait() without holding the lock")
+        saved = lock._count
+        lock._count = 1
+        # Register as a waiter BEFORE releasing the lock (atomic in real
+        # threading): a notify landing between release and registration
+        # must not be lost.
+        cur.state = TIMED if timeout is not None else BLOCKED
+        cur.waiting_on = cond
+        cur.wake_flag = False
+        self.lock_release(lock)              # full release, waiters wake
+        while cur.state != RUNNABLE:
+            self._wait_scheduled(cur)
+        self._check_shutdown(cur)
+        cur.waiting_on = None
+        woken = cur.wake_flag
+        self.lock_acquire(lock, blocking=True)
+        lock._count = saved
+        return woken
+
+    def cond_notify(self, cond: SchedCondition, n: int) -> None:
+        cur = self._cur()
+        cur.vc[cur.tid] += 1
+        woken = 0
+        for t in sorted(self._tcbs.values(), key=lambda t: t.tid):
+            if woken >= n:
+                break
+            if t.state in (BLOCKED, TIMED) and t.waiting_on is cond:
+                _vc_join(t.vc, cur.vc)       # notify → wake edge
+                t.state = RUNNABLE
+                t.wake_flag = True
+                t.waiting_on = None
+                woken += 1
+        self.step()
+
+
+# --------------------------------------------------------------------- #
+# Entry points
+# --------------------------------------------------------------------- #
+
+def run_schedule(scenario: Callable[[DeterministicScheduler], None], *,
+                 seed: int = 0,
+                 max_steps: int = 200_000) -> DeterministicScheduler:
+    """Run ``scenario`` under ONE seeded schedule; returns the scheduler
+    (``.tracker.races``, ``.crashes``)."""
+    sched = DeterministicScheduler(seed=seed, max_steps=max_steps)
+    with sched.active():
+        scenario(sched)
+    if sched.crashes:
+        name, exc = sched.crashes[0]
+        raise SchedulerError(
+            f"managed thread '{name}' crashed under seed {seed}: "
+            f"{exc!r}") from exc
+    return sched
+
+
+def find_races(scenario: Callable[[DeterministicScheduler], None], *,
+               schedules: int = 20, seed0: int = 0,
+               max_steps: int = 200_000) -> list[RaceReport]:
+    """Sweep ``schedules`` seeded interleavings of ``scenario`` and
+    return every race found (empty = race-free within the budget)."""
+    races: list[RaceReport] = []
+    for i in range(schedules):
+        sched = run_schedule(scenario, seed=seed0 + i, max_steps=max_steps)
+        races.extend(sched.tracker.races)
+    return races
