@@ -354,7 +354,8 @@ def _observe_node_payload(i: int, rv: int) -> dict:
 
 def bench_observe_path(n_pods: int = OBSERVE_PODS,
                        n_nodes: int = OBSERVE_NODES,
-                       churn: float = OBSERVE_CHURN) -> dict:
+                       churn: float = OBSERVE_CHURN,
+                       tracer=None) -> dict:
     """Relist baseline vs informer steady-state, best-of-N passes each.
 
     Baseline = exactly what ``reconcile_once`` did before the informer:
@@ -362,6 +363,10 @@ def bench_observe_path(n_pods: int = OBSERVE_PODS,
     Informer = apply the pass's churn deltas (bumped resourceVersions)
     to warm caches, then snapshot — parse work is O(churn) through the
     (uid, resourceVersion) memo, snapshot is an O(n) list copy.
+
+    ``tracer``: when set, each informer pass carries the tracing work
+    ``reconcile_once`` adds per pass (a span end + a decision record) —
+    the traced variant the tracer-overhead gate compares (ISSUE 5).
     """
     from tpu_autoscaler.k8s.informer import ObjectCache
     from tpu_autoscaler.k8s.objects import (
@@ -412,14 +417,24 @@ def bench_observe_path(n_pods: int = OBSERVE_PODS,
         passes.append(events)
 
     informer_s = float("inf")
-    for events in passes:
+    for p, events in enumerate(passes):
         t0 = time.perf_counter()
+        span = (tracer.start("observe", attrs={"pass": p})
+                if tracer is not None else None)
         for ev in events:
             kind = "pods" if "pod-" in ev["object"]["metadata"]["name"] \
                 else "nodes"
             (pod_cache if kind == "pods" else node_cache).apply(ev)
         nodes = node_cache.snapshot()
         pods = pod_cache.snapshot()
+        if tracer is not None:
+            tracer.end(span, attrs={"nodes": len(nodes),
+                                    "pods": len(pods)})
+            if tracer.recorder is not None:
+                tracer.recorder.record_pass(
+                    {"pass": p, "t": time.time(),
+                     "inputs": {"nodes": len(nodes), "pods": len(pods)},
+                     "events": []})
         informer_s = min(informer_s, time.perf_counter() - t0)
     assert len(nodes) == n_nodes and len(pods) == n_pods
     clear_parse_caches()
@@ -497,28 +512,72 @@ class _LatencyQrTransport:
         return self._Resp({"state": {"state": "ACTIVE"}})  # per-id GET
 
 
-def bench_actuation_path() -> dict:
-    from tpu_autoscaler.actuators.executor import ActuationExecutor
+def _make_qr_bench_actuator(batch_poll, executor=None):
+    """Bench QueuedResource actuator over the latency-injecting fake
+    transport (shared by the actuation tier and the tracer-overhead
+    tier so they can never measure different setups)."""
     from tpu_autoscaler.actuators.gcp import GcpRest, TokenProvider
     from tpu_autoscaler.actuators.queued_resources import (
         QueuedResourceActuator,
     )
+
+    tp = TokenProvider()
+    tp._token, tp._expires_at = "bench-token", time.time() + 3600.0
+    transport = _LatencyQrTransport()
+    rest = GcpRest(token_provider=tp, transport=transport,
+                   sleep=lambda s: None)
+    act = QueuedResourceActuator(project="bench", zone="z", rest=rest,
+                                 executor=executor,
+                                 batch_poll=batch_poll)
+    return act, transport
+
+
+def _qr_bench_request(i):
     from tpu_autoscaler.engine.planner import ProvisionRequest
 
-    def make(batch_poll, executor=None):
-        tp = TokenProvider()
-        tp._token, tp._expires_at = "bench-token", time.time() + 3600.0
-        transport = _LatencyQrTransport()
-        rest = GcpRest(token_provider=tp, transport=transport,
-                       sleep=lambda s: None)
-        act = QueuedResourceActuator(project="bench", zone="z", rest=rest,
-                                     executor=executor,
-                                     batch_poll=batch_poll)
-        return act, transport
+    return ProvisionRequest(kind="tpu-slice", shape_name="v5e-8",
+                            gang_key=("job", "bench", f"g{i}"))
 
-    def req(i):
-        return ProvisionRequest(kind="tpu-slice", shape_name="v5e-8",
-                                gang_key=("job", "bench", f"g{i}"))
+
+def _pipelined_actuation_run(tracer=None):
+    """One pipelined busy-fleet actuation pass (the measurement BOTH
+    the actuate tier and the tracer-overhead tier run — one loop, so
+    they can never measure different workloads), optionally with the
+    tracer attached the way the Controller attaches it.  Returns
+    (elapsed_seconds, actuator)."""
+    from tpu_autoscaler.actuators.executor import ActuationExecutor
+
+    executor = ActuationExecutor(max_workers=ACTUATE_WORKERS)
+    if tracer is not None:
+        executor.set_tracer(tracer)
+    act, transport = _make_qr_bench_actuator(batch_poll=True,
+                                             executor=executor)
+    if tracer is not None:
+        act.set_tracer(tracer)
+    for i in range(ACTUATE_IN_FLIGHT):
+        act.provision(_qr_bench_request(i))
+    executor.wait(timeout=30)
+    executor.drain()                   # creates land -> pollable
+    transport.rtt_s = ACTUATE_RTT_S
+    t0 = time.perf_counter()
+    act.poll(0.0)                      # dispatches ONE LIST
+    for i in range(ACTUATE_NEW):
+        act.provision(_qr_bench_request(1000 + i))  # concurrent POSTs
+    executor.wait(timeout=30)
+    executor.drain()                   # everything applied on the drain
+    elapsed = time.perf_counter() - t0
+    executor.shutdown()
+    assert sum(1 for s in act.statuses()
+               if s.state == "ACTIVE") == ACTUATE_IN_FLIGHT
+    return elapsed, act
+
+
+def _pipelined_actuation_seconds(tracer=None) -> float:
+    return _pipelined_actuation_run(tracer)[0]
+
+
+def bench_actuation_path() -> dict:
+    make, req = _make_qr_bench_actuator, _qr_bench_request
 
     # -- serial baseline: blocking POSTs + per-id GET polling ------------
     act, transport = make(batch_poll=False)
@@ -533,24 +592,9 @@ def bench_actuation_path() -> dict:
     assert sum(1 for s in act.statuses()
                if s.state == "ACTIVE") == ACTUATE_IN_FLIGHT
 
-    # -- pipelined: executor dispatch + ONE batched LIST -----------------
-    executor = ActuationExecutor(max_workers=ACTUATE_WORKERS)
-    act2, transport2 = make(batch_poll=True, executor=executor)
-    for i in range(ACTUATE_IN_FLIGHT):
-        act2.provision(req(i))
-    executor.wait(timeout=30)
-    executor.drain()                   # creates land -> pollable
-    transport2.rtt_s = ACTUATE_RTT_S
-    t0 = time.perf_counter()
-    act2.poll(0.0)                     # dispatches ONE LIST
-    for i in range(ACTUATE_NEW):
-        act2.provision(req(1000 + i))  # 16 concurrent POST dispatches
-    executor.wait(timeout=30)
-    executor.drain()                   # everything applied on the drain
-    piped_s = time.perf_counter() - t0
-    executor.shutdown()
-    assert sum(1 for s in act2.statuses()
-               if s.state == "ACTIVE") == ACTUATE_IN_FLIGHT
+    # -- pipelined: executor dispatch + ONE batched LIST (the shared
+    # measurement loop — the tracer-overhead tier runs the same one)
+    piped_s, act2 = _pipelined_actuation_run()
     assert len(act2._created) == ACTUATE_IN_FLIGHT + ACTUATE_NEW
 
     return {
@@ -576,6 +620,66 @@ def check_actuation_path() -> tuple[bool, dict]:
     return ok, info
 
 
+# Tracer-overhead tier (ISSUE 5): the observe (PR-2) and actuate (PR-3)
+# wins are wall-clock numbers this repo gates on; instrumentation that
+# silently ate them would be a regression wearing an observability hat.
+# Each tier runs twice — untraced (tracer=None at every seam: zero span
+# work) and traced (recorder-backed tracer attached the way the
+# Controller attaches it) — and the traced run must stay within 5%.
+# GRACE absorbs sub-millisecond timer noise on the observe tier (whose
+# per-pass time is ~1-3 ms); it is far below anything a real
+# instrumentation regression would cost at these scales.
+TRACE_OVERHEAD_FACTOR = 1.05
+TRACE_OVERHEAD_GRACE_S = 0.0005
+TRACE_ACTUATE_ROUNDS = 3
+
+
+def bench_tracer_overhead() -> dict:
+    from tpu_autoscaler.obs import FlightRecorder, Tracer
+
+    # -- observe tier: per-pass span + decision record ------------------
+    plain_obs = bench_observe_path()
+    recorder = FlightRecorder()
+    traced_obs = bench_observe_path(
+        tracer=Tracer(recorder=recorder))
+    # -- actuate tier: executor + actuator spans -------------------------
+    plain_act = min(_pipelined_actuation_seconds()
+                    for _ in range(TRACE_ACTUATE_ROUNDS))
+    traced_act = min(
+        _pipelined_actuation_seconds(
+            tracer=Tracer(recorder=FlightRecorder()))
+        for _ in range(TRACE_ACTUATE_ROUNDS))
+    spans = recorder.dump()["counts"]["spans_recorded"]
+    return {
+        "info": "tracer_overhead",
+        "observe_untraced_ms": plain_obs["informer_ms"],
+        "observe_traced_ms": traced_obs["informer_ms"],
+        "actuate_untraced_ms": round(plain_act * 1e3, 1),
+        "actuate_traced_ms": round(traced_act * 1e3, 1),
+        "observe_spans_recorded": spans,
+        "factor": TRACE_OVERHEAD_FACTOR,
+        "grace_ms": TRACE_OVERHEAD_GRACE_S * 1e3,
+    }
+
+
+def check_tracer_overhead() -> tuple[bool, dict]:
+    """Gate: traced observe + actuate passes within 5% of untraced."""
+    info = bench_tracer_overhead()
+    budget_obs = (info["observe_untraced_ms"] * TRACE_OVERHEAD_FACTOR
+                  + TRACE_OVERHEAD_GRACE_S * 1e3)
+    budget_act = (info["actuate_untraced_ms"] * TRACE_OVERHEAD_FACTOR
+                  + TRACE_OVERHEAD_GRACE_S * 1e3)
+    ok = (info["observe_traced_ms"] <= budget_obs
+          and info["actuate_traced_ms"] <= budget_act
+          and info["observe_spans_recorded"] > 0)
+    print(json.dumps(info), file=sys.stderr)
+    if not ok:
+        print(json.dumps({"error": "tracer overhead above the 5% gate "
+                          "(instrumentation is eating the PR-2/PR-3 "
+                          "wins)", **info}), file=sys.stderr)
+    return ok, info
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "observe":
@@ -594,6 +698,18 @@ def main(argv: list[str] | None = None) -> int:
                                  / ACTUATE_SPEEDUP_FLOOR, 2),
         }))
         return 0 if ok else 1
+    if argv and argv[0] == "trace":
+        # Tracer-overhead tier only (scripts/full_suite.sh /
+        # ci_gate.sh): traced observe + actuate within 5% of untraced.
+        ok, info = check_tracer_overhead()
+        print(json.dumps({
+            "metric": "tracer_overhead_actuate_ratio",
+            "value": round(info["actuate_traced_ms"]
+                           / max(info["actuate_untraced_ms"], 1e-9), 3),
+            "unit": "x_vs_untraced",
+            "vs_baseline": TRACE_OVERHEAD_FACTOR,
+        }))
+        return 0 if ok else 1
     if not check_all_configs():
         print(json.dumps({"error": "a BASELINE config failed"}),
               file=sys.stderr)
@@ -606,6 +722,8 @@ def main(argv: list[str] | None = None) -> int:
     if not check_observe_path():
         return 1
     if not check_actuation_path()[0]:
+        return 1
+    if not check_tracer_overhead()[0]:
         return 1
     # Informational (stderr: stdout is ONE metric line by contract) —
     # except decision parity, which is a hard gate.

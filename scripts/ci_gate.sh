@@ -4,10 +4,12 @@
 # code so a CI job can tell which stage failed from $? alone:
 #
 #   0  everything green
-#   2  invariant analysis (all checkers incl. TAR5xx, unused waivers,
-#      stale baseline parse errors)
+#   2  invariant analysis (all checkers incl. TAR5xx + TAO6xx
+#      metric/doc drift, unused waivers, stale baseline parse errors)
 #   3  mypy strict islands (only when mypy is importable)
 #   4  deterministic-schedule race tier
+#   5  tracer-overhead gate (bench.py trace: traced observe/actuate
+#      within 5% of untraced — ISSUE 5)
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -17,16 +19,19 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/3] invariant analysis (--format=$fmt)"
+echo "== [1/4] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/3] mypy strict islands"
+echo "== [2/4] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/3] deterministic-schedule race tier"
+echo "== [3/4] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
+
+echo "== [4/4] tracer-overhead gate"
+JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
 echo "CI GATE GREEN"

@@ -33,6 +33,11 @@ JAX_PLATFORMS=cpu python bench.py observe
 # (ISSUE 3; ~4 s — the serial baseline honestly pays its 80 RTTs).
 JAX_PLATFORMS=cpu python bench.py actuate
 
+# Tracer-overhead tier: the observe + actuate benches re-run with the
+# decision tracer attached must stay within 5% of untraced (ISSUE 5 —
+# instrumentation can never silently eat the PR-2/PR-3 wins).
+JAX_PLATFORMS=cpu python bench.py trace
+
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py
   --ignore=tests/test_sp.py --ignore=tests/test_pipeline.py

@@ -1142,6 +1142,146 @@ class TestCore:
 # the repo gate: this tree must be analysis-clean under its baseline
 # --------------------------------------------------------------------- #
 
+class TestMetricsDocChecker:
+    """TAO6xx: metric/runbook drift, both directions."""
+
+    DOC = textwrap.dedent("""\
+        # Operations runbook
+
+        ## Metrics to alert on
+
+        | Metric | Type | Meaning |
+        |---|---|---|
+        | `scale_ups` | counter | Scale-ups. |
+        | `rest_retries`, `kube_retries` | counters | Retries. |
+        | `units_<state>` | gauges | Per-state unit counts. |
+
+        ## Another section
+
+        | `not_a_metric` | x | Tables elsewhere are not the contract. |
+        """)
+
+    #: Emits every metric the fixture DOC documents (appended to
+    #: fixtures that test the code→doc direction in isolation).
+    COVERS = """
+        def _covers(m, state):
+            m.inc("scale_ups")
+            m.inc("rest_retries")
+            m.inc("kube_retries")
+            m.set_gauge(f"units_{state}", 1)
+    """
+
+    #: The registry module's rel path is the checker's full-package
+    #: sentinel: dead-doc (TAO602) findings only fire when it is in
+    #: the analyzed set.
+    SENTINEL = "tpu_autoscaler/metrics/metrics.py"
+
+    def checker(self, doc=None):
+        from tpu_autoscaler.analysis import MetricsDocChecker
+
+        return MetricsDocChecker(doc_text=self.DOC if doc is None else doc)
+
+    def run(self, code, doc=None, covers=True,
+            rel="tpu_autoscaler/mod.py"):
+        text = textwrap.dedent(code) \
+            + (textwrap.dedent(self.COVERS) if covers else "")
+        files = [SourceFile("<fixture>", rel, text)]
+        if rel != self.SENTINEL:
+            files.append(SourceFile("<sentinel>", self.SENTINEL, ""))
+        return self.checker(doc).check_program(files)
+
+    def test_documented_metrics_pass(self):
+        found = self.run("", covers=True)
+        assert found == []
+
+    def test_undocumented_metric_fails_tao601(self):
+        found = self.run("""
+            def f(m):
+                m.observe("mystery_latency_seconds", 1.0)
+        """)
+        assert codes_of(found) == ["TAO601"]
+        assert "mystery_latency_seconds" in found[0].message
+        assert found[0].file == "tpu_autoscaler/mod.py"
+
+    def test_tracer_metric_keyword_counts_as_export(self):
+        found = self.run("""
+            def f(tracer, root):
+                tracer.record("provision", start=0.0, end=1.0,
+                              parent=root, metric="mystery_seconds")
+        """)
+        assert codes_of(found) == ["TAO601"]
+        assert "mystery_seconds" in found[0].message
+
+    def test_dynamic_family_needs_family_row(self):
+        found = self.run("""
+            def f(m, ns):
+                m.set_gauge(f"namespace_chips_used_{ns}", 1)
+        """)
+        assert codes_of(found) == ["TAO601"]
+        assert "namespace_chips_used_<...>" in found[0].message
+
+    def test_dynamic_name_without_prefix_is_unmatchable(self):
+        found = self.run("""
+            def f(m, name):
+                m.inc(f"{name}_total")
+        """)
+        assert codes_of(found) == ["TAO601"]
+        assert "no literal prefix" in found[0].message
+
+    def test_dead_doc_entry_fails_tao602(self):
+        found = self.run("""
+            def f(m):
+                m.inc("rest_retries")
+                m.inc("kube_retries")
+                m.inc("scale_ups")
+        """, covers=False)
+        # units_<state> family has no emitter in this fixture.
+        assert codes_of(found) == ["TAO602"]
+        assert found[0].file == "docs/OPERATIONS.md"
+        assert "units_<...>" in found[0].message
+
+    def test_dead_doc_skipped_without_full_package_view(self):
+        # Same fixture WITHOUT the registry sentinel: a subset run
+        # proves nothing about absence, so no TAO602.
+        src = SourceFile("<fixture>", "tpu_autoscaler/mod.py",
+                         textwrap.dedent("""
+            def f(m):
+                m.inc("rest_retries")
+        """))
+        assert self.checker().check_program([src]) == []
+        assert self.checker().check_program([]) == []
+
+    def test_concrete_doc_row_covered_by_dynamic_family(self):
+        doc = self.DOC.replace(
+            "| `units_<state>` | gauges | Per-state unit counts. |",
+            "| `units_<state>` | gauges | Per-state unit counts. |\n"
+            "| `units_busy` | gauge | Busy units (family instance). |")
+        found = self.run("", doc=doc, covers=True)
+        assert found == []
+
+    def test_tables_outside_metrics_section_ignored(self):
+        # `not_a_metric` lives in another section: no TAO602 for it,
+        # and emitting it is still undocumented.
+        found = self.run("""
+            def f(m):
+                m.inc("not_a_metric")
+        """)
+        assert codes_of(found) == ["TAO601"]
+        assert "not_a_metric" in found[0].message
+
+    def test_variable_names_are_skipped(self):
+        found = self.run("""
+            def f(m, name):
+                m.inc(name)
+                m.observe(name, 2.0)
+        """, covers=False)
+        assert codes_of(found) == ["TAO602"]  # doc drift only
+
+    def test_scoped_to_package(self):
+        assert not self.checker().applies_to("tests/test_x.py")
+        assert self.checker().applies_to("tpu_autoscaler/obs/trace.py")
+
+
 class TestRepoIsClean:
     def test_repo_passes_own_linter(self):
         baseline_path = os.path.join(

@@ -33,6 +33,46 @@ class TestMetrics:
         assert "scale_up_latency_seconds_count 1" in text
         assert "scale_up_latency_seconds_max 42.0" in text
 
+    def test_help_and_type_for_every_family(self):
+        """Exposition-format contract: # HELP + # TYPE precede every
+        family — counters, gauges, summaries AND histograms."""
+        m = Metrics()
+        m.inc("drains_started")
+        m.set_gauge("units_idle", 2)
+        m.observe("poll_batch_size", 3.0)
+        m.declare_histogram("scale_up_latency_seconds", (60.0,))
+        m.observe("scale_up_latency_seconds", 42.0)
+        text = m.render_prometheus()
+        for name in ("drains_started", "units_idle", "poll_batch_size",
+                     "scale_up_latency_seconds"):
+            assert f"# HELP {name} " in text, name
+        lines = text.splitlines()
+        for i, line in enumerate(lines):
+            if line.startswith("# TYPE"):
+                name = line.split()[2]
+                assert lines[i - 1].startswith(f"# HELP {name} ")
+
+    def test_empty_summary_never_renders_inf(self):
+        """Guard: before the first observe, min=inf/max=-inf must not
+        leak into the exposition, the snapshot, or any JSON dump."""
+        import json
+
+        m = Metrics()
+        m.declare_histogram("scale_up_latency_seconds", (60.0, 360.0))
+        text = m.render_prometheus()
+        assert "inf" not in text.replace("+Inf", "")  # only bucket +Inf
+        snap = m.snapshot()
+        json.dumps(snap, allow_nan=False)  # would raise on inf
+        # A summary touched into existence but never observed exports
+        # count alone (the gauges-style min/max export stays guarded).
+        from tpu_autoscaler.metrics.metrics import _Summary
+
+        assert _Summary().as_dict() == {"count": 0}
+        m.observe("poll_batch_size", 2.0)
+        text = m.render_prometheus()
+        assert "poll_batch_size_min 2.0" in text
+        assert "poll_batch_size_max 2.0" in text
+
     def test_histogram_declaration_and_rendering(self):
         m = Metrics()
         m.declare_histogram("scale_up_latency_seconds", (60.0, 360.0))
@@ -62,19 +102,28 @@ class TestMetrics:
         m.serve(0)  # ephemeral: parallel test runs must not collide
         port = m.bound_port
         deadline = time.time() + 5
-        body = None
+        body = ctype = None
         while time.time() < deadline:
             try:
                 with urllib.request.urlopen(
                         f"http://127.0.0.1:{port}/metrics") as r:
                     body = r.read().decode()
+                    ctype = r.headers["Content-Type"]
                 break
             except OSError:
                 time.sleep(0.05)
         assert body and "reconcile_errors 1" in body
+        # The Prometheus exposition content type, version included.
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8"
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz") as r:
             assert r.read() == b"ok\n"
+        # Without a debugz provider, /debugz is a 404 like any other.
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/debugz")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
 
 
 class TestNotifiers:
@@ -105,6 +154,88 @@ class TestNotifiers:
 
         monkeypatch.setattr(requests, "post", boom)
         SlackNotifier("https://hooks.example/x")._post("msg")  # no raise
+
+
+class RaisingNotifier:
+    """A notifier whose delivery always raises — the failure mode the
+    control loop must survive (webhook outage, buggy custom notifier)."""
+
+    def __init__(self):
+        self.attempts = 0
+
+    def notify(self, message: str) -> None:
+        self.attempts += 1
+        raise RuntimeError("webhook down")
+
+
+class TestNotifierFailurePaths:
+    """A raising notifier must never abort a reconcile pass: the error
+    is counted (notifier_errors), not propagated."""
+
+    def _harness(self):
+        from tpu_autoscaler.actuators.fake import FakeActuator
+        from tpu_autoscaler.controller import (
+            Controller,
+            ControllerConfig,
+        )
+        from tpu_autoscaler.engine.planner import PoolPolicy
+        from tpu_autoscaler.k8s.fake import FakeKube
+
+        from tests.fixtures import make_gang
+        from tpu_autoscaler.topology import shape_by_name
+
+        kube = FakeKube()
+        notifier = RaisingNotifier()
+        controller = Controller(
+            kube, FakeActuator(kube),
+            ControllerConfig(policy=PoolPolicy(spare_nodes=0)),
+            notifier=notifier)
+        names = []
+        for p in make_gang(shape_by_name("v5e-16"), job="noisy"):
+            kube.add_pod(p)
+            names.append(p["metadata"]["name"])
+        return kube, controller, notifier, names
+
+    def test_scale_up_survives_raising_notifier(self):
+        kube, controller, notifier, names = self._harness()
+        t = 0.0
+        while t <= 60.0 and not all(
+                kube.get_pod("default", n)["status"]["phase"] == "Running"
+                for n in names):
+            controller.reconcile_once(now=t)  # must not raise
+            kube.schedule_step()
+            t += 1.0
+        assert all(kube.get_pod("default", n)["status"]["phase"]
+                   == "Running" for n in names)
+        controller.reconcile_once(now=t)  # observe the final state
+        assert notifier.attempts >= 1  # the notifier WAS invoked
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["notifier_errors"] == notifier.attempts
+        # The scale-up itself was unaffected.
+        assert snap["summaries"]["scale_up_latency_seconds"]["count"] == 1
+        assert "reconcile_errors" not in snap["counters"]
+
+    def test_drain_notification_failure_does_not_block_reclaim(self):
+        kube, controller, notifier, names = self._harness()
+        t = 0.0
+        while t <= 60.0 and not all(
+                kube.get_pod("default", n)["status"]["phase"] == "Running"
+                for n in names):
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 1.0
+        for n in names:
+            kube.delete_pod("default", n)
+        idle = controller.config.idle_threshold_seconds
+        grace = controller.config.grace_seconds
+        end = t + idle + grace + 400.0
+        while t <= end and kube.list_nodes():
+            controller.reconcile_once(now=t)
+            t += 30.0
+        assert kube.list_nodes() == []  # reclaim completed regardless
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["notifier_errors"] == notifier.attempts
+        assert snap["counters"].get("reconcile_errors", 0) == 0
 
 
 class TestDynamicGaugeSanitization:

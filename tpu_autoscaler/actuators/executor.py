@@ -99,6 +99,12 @@ class _Call:
     attempt: int = 0
     free_retries_used: int = 0
     future: concurrent.futures.Future | None = None
+    # Trace span for this call (obs/trace.py), opened at submit() on
+    # the reconcile thread — capturing the submitting context is how a
+    # trace crosses the pool boundary: the worker thunk never touches
+    # the tracer, and the span is ended at drain time on the reconcile
+    # thread again (docs/OBSERVABILITY.md).
+    span: Any = None
 
 
 class ActuationExecutor:
@@ -127,6 +133,7 @@ class ActuationExecutor:
         self._backoff_cap_s = backoff_cap_s
         self._rng = rng or random.Random()
         self._clock = clock
+        self._tracer: Any = None
         self._running: list[_Call] = []
         # Parked retries: (retry_at, seq, call) min-heap.
         self._parked: list[tuple[float, int, _Call]] = []
@@ -138,6 +145,13 @@ class ActuationExecutor:
         """Wire the controller's metrics registry (the Controller calls
         this on construction, like Actuator.set_metrics)."""
         self._metrics = metrics
+
+    def set_tracer(self, tracer: Any) -> None:
+        """Wire the controller's tracer: every dispatched call gets a
+        span from submit() to drain-time delivery, parented under
+        whatever span was current at submit time (the provision's
+        ``dispatch`` span).  None (the default) costs nothing."""
+        self._tracer = tracer
 
     def _inc(self, name: str) -> None:
         if self._metrics is not None:
@@ -163,6 +177,9 @@ class ActuationExecutor:
         call = _Call(fn=fn, on_done=on_done, label=label, submitted_at=now,
                      deadline_at=now + (deadline_s if deadline_s is not None
                                         else self._deadline_s))
+        if self._tracer is not None:
+            call.span = self._tracer.start(
+                f"actuate:{label or 'call'}")
         self._dispatch(call)
 
     def _dispatch(self, call: _Call) -> None:
@@ -214,6 +231,12 @@ class ActuationExecutor:
                 heapq.heappush(self._parked,
                                (retry_at, next(self._seq), call))
                 self._inc("actuation_retries_rescheduled")
+                if self._tracer is not None and call.span is not None:
+                    self._tracer.event(
+                        call.span, "rescheduled",
+                        {"attempt": call.attempt + 1,
+                         "delay_s": round(delay, 3), "cause": exc.cause},
+                        t=call.span.start + (now - call.submitted_at))
                 log.debug("actuation call %s rescheduled in %.2fs "
                           "(attempt %d/%d): %s", call.label, delay,
                           call.attempt + 1, self._max_attempts, exc.cause)
@@ -221,6 +244,18 @@ class ActuationExecutor:
             exc = exc.terminal()
         self._observe("actuation_dispatch_latency_seconds",
                       now - call.submitted_at)
+        if self._tracer is not None and call.span is not None:
+            # Anchor at the tracer's clock, duration from the
+            # executor's clock: the span duration equals the
+            # actuation_dispatch_latency_seconds observation exactly,
+            # under real AND injected/simulated clocks.
+            attrs: dict[str, Any] = {"attempts": call.attempt + 1}
+            if exc is not None:
+                attrs["error"] = str(exc)
+            self._tracer.end(
+                call.span,
+                t=call.span.start + (now - call.submitted_at),
+                attrs=attrs)
         try:
             if exc is None:
                 call.on_done(call.future.result(), None)

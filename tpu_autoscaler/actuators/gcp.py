@@ -251,6 +251,12 @@ class GcpRest:
         self.dry_run = dry_run
         self._tokens = token_provider or TokenProvider()
         self._metrics = metrics
+        # Optional tracer (obs/trace.py): retries annotate whatever span
+        # is current in the calling context (the serial dispatch span);
+        # on executor workers the context is deliberately empty, so the
+        # same code is a no-op there (the executor's own span carries
+        # the attempt count instead).
+        self.tracer = None
         self._sleep = sleep
         self._rng = rng or random.Random()
         if transport is None:
@@ -294,6 +300,9 @@ class GcpRest:
 
     def _note_retry(self, why: str, url: str, attempt: int) -> None:
         self.inc("rest_retries")
+        if self.tracer is not None:
+            self.tracer.event_current(
+                "rest_retry", {"why": why, "attempt": attempt + 1})
         log.warning("GCP REST %s (attempt %d/%d) %s — retrying",
                     why, attempt + 1, self.max_attempts, url)
 

@@ -44,6 +44,7 @@ from tpu_autoscaler.actuators.gcp import (
     note_list_failure,
 )
 from tpu_autoscaler.engine.planner import ProvisionRequest
+from tpu_autoscaler.obs import maybe_span
 from tpu_autoscaler.topology.catalog import (
     POOL_LABEL,
     SLICE_ID_LABEL,
@@ -100,12 +101,21 @@ class GkeNodePoolActuator:
         self._list_ok = batch_poll
         self._poll_inflight = False
         self._op_gets_inflight: set[str] = set()     # op names
+        self._tracer = None
 
     def set_metrics(self, metrics) -> None:
         """Wire the controller's metrics into the REST layer (the
         Controller calls this on construction) so rest_retries lands in
         the same registry as every other counter."""
         self._rest._metrics = metrics
+
+    def set_tracer(self, tracer) -> None:
+        """Wire the controller's tracer (obs/trace.py): serial creates
+        and batched operations-LIST polls get spans; REST retries
+        annotate them.  Executor-mode dispatches are spanned by the
+        executor itself."""
+        self._tracer = tracer
+        self._rest.tracer = tracer
 
     ROLLBACK_MAX_ATTEMPTS = 40
 
@@ -184,9 +194,11 @@ class GkeNodePoolActuator:
         created: list[str] = []
         try:
             for pool_name in pool_names:
-                op = self._rest.post(
-                    f"{self._api_base}/{self._parent}/nodePools",
-                    self._pool_body(request, pool_name))
+                with maybe_span(self._tracer, "pool-create",
+                                attrs={"pool": pool_name}):
+                    op = self._rest.post(
+                        f"{self._api_base}/{self._parent}/nodePools",
+                        self._pool_body(request, pool_name))
                 created.append(pool_name)
                 if op.get("name"):
                     ops.append(op["name"])
@@ -362,7 +374,8 @@ class GkeNodePoolActuator:
                                  self._on_ops_list_done, label="gke-ops")
             return
         try:
-            ops_map = self._fetch_ops(self._rest.get)
+            with maybe_span(self._tracer, "gke-ops-list"):
+                ops_map = self._fetch_ops(self._rest.get)
         except Exception as e:  # noqa: BLE001 — transient; retry next pass
             self._rest.inc("actuator_poll_errors")
             self._note_list_failure(e)

@@ -59,6 +59,7 @@ from tpu_autoscaler.actuators.gcp import (
     note_list_failure,
 )
 from tpu_autoscaler.engine.planner import ProvisionRequest
+from tpu_autoscaler.obs import maybe_span
 from tpu_autoscaler.topology.catalog import SLICE_SHAPES
 
 log = logging.getLogger(__name__)
@@ -125,12 +126,20 @@ class QueuedResourceActuator:
         # at most one GET per id in flight.
         self._poll_inflight = False
         self._gets_inflight: set[str] = set()
+        self._tracer = None
 
     def set_metrics(self, metrics) -> None:
         """Wire the controller's metrics into the REST layer (the
         Controller calls this on construction) so rest_retries lands in
         the same registry as every other counter."""
         self._rest._metrics = metrics
+
+    def set_tracer(self, tracer) -> None:
+        """Wire the controller's tracer (obs/trace.py): serial creates
+        and batched-LIST polls get spans; REST retries annotate them.
+        Executor-mode dispatches are spanned by the executor itself."""
+        self._tracer = tracer
+        self._rest.tracer = tracer
 
     # ---- provision ------------------------------------------------------
 
@@ -185,7 +194,9 @@ class QueuedResourceActuator:
                 label=f"qr-create:{qr_id}")
             return status
         try:
-            self._rest.post(url, body)
+            with maybe_span(self._tracer, "qr-create",
+                            attrs={"qr": qr_id}):
+                self._rest.post(url, body)
             self._created.add(qr_id)
         except Exception as e:  # noqa: BLE001 — surface as FAILED status
             self._rest.inc("actuator_api_errors")
@@ -312,7 +323,8 @@ class QueuedResourceActuator:
                                  self._on_list_done, label="qr-list")
             return
         try:
-            items = self._fetch_list_blocking()
+            with maybe_span(self._tracer, "qr-list"):
+                items = self._fetch_list_blocking()
         except Exception as e:  # noqa: BLE001 — transient; retry next pass
             self._rest.inc("actuator_poll_errors")
             self._note_list_failure(e)
@@ -408,7 +420,9 @@ class QueuedResourceActuator:
                     label=f"qr-poll:{qr_id}")
                 continue
             try:
-                qr = self._rest.get(url)
+                with maybe_span(self._tracer, "qr-poll",
+                                attrs={"qr": qr_id}):
+                    qr = self._rest.get(url)
             except GcpApiError as e:
                 if e.http_status == 404:
                     # Deleted out of band (operator, janitor, TTL): a

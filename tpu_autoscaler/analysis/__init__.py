@@ -20,6 +20,7 @@ from tpu_autoscaler.analysis.core import (
 from tpu_autoscaler.analysis.escape import EscapeRaceChecker
 from tpu_autoscaler.analysis.exceptions import ExceptionHygieneChecker
 from tpu_autoscaler.analysis.jaxpurity import JaxPurityChecker
+from tpu_autoscaler.analysis.metricsdoc import MetricsDocChecker
 from tpu_autoscaler.analysis.purity import PurityChecker
 from tpu_autoscaler.analysis.threads import ThreadDisciplineChecker
 
@@ -29,7 +30,7 @@ def default_checkers() -> list[Checker]:
     # interprocedural TAR5xx pass cannot resolve (docs/ANALYSIS.md).
     return [PurityChecker(), ThreadDisciplineChecker(),
             ExceptionHygieneChecker(), JaxPurityChecker(),
-            EscapeRaceChecker()]
+            EscapeRaceChecker(), MetricsDocChecker()]
 
 
 __all__ = [
@@ -39,6 +40,7 @@ __all__ = [
     "ExceptionHygieneChecker",
     "Finding",
     "JaxPurityChecker",
+    "MetricsDocChecker",
     "ProgramChecker",
     "PurityChecker",
     "SourceFile",

@@ -1,0 +1,215 @@
+"""Metrics/runbook drift checker (TAO6xx).
+
+docs/OPERATIONS.md's "Metrics to alert on" table is the operator
+contract for every series the controller exports — but nothing kept it
+honest: PR 2/3 each added metrics the runbook never learned about, and
+nothing would notice a doc row whose metric was renamed away.  This
+checker closes the loop in both directions:
+
+- **TAO601** — a metric name ``inc``/``observe``/``set_gauge``/
+  ``declare_histogram``'d (or fed via a tracer ``metric=`` keyword) in
+  the package does not appear in the runbook table;
+- **TAO602** — a runbook table entry matches no metric in the code
+  (dead documentation — worse than none: operators alert on it).
+
+Dynamic names are matched by family: code like
+``f"namespace_chips_used_{ns}"`` is documented as
+``namespace_chips_used_<ns>`` — the literal prefix before the first
+interpolation must equal the doc entry's prefix before ``<``.  An
+f-string with NO literal prefix is unmatchable and reported as TAO601
+(name the family or hoist a prefix).
+
+It is a :class:`ProgramChecker`: the code side needs every file, the
+doc side one read of the runbook.  Wired into ``default_checkers`` so
+``scripts/lint.sh``, ``scripts/ci_gate.sh`` and ``TestRepoIsClean``
+all gate on it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tpu_autoscaler.analysis.core import (
+    Finding,
+    ProgramChecker,
+    SourceFile,
+)
+
+#: Registry verbs (and the private wrappers the executor / informer /
+#: GcpRest layer put in front of them).
+_METRIC_METHODS = frozenset({
+    "inc", "_inc", "observe", "_observe", "set_gauge",
+    "declare_histogram",
+})
+
+#: The runbook section that IS the metrics contract.
+_DOC_SECTION = "## Metrics to alert on"
+
+_DEFAULT_DOC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "docs", "OPERATIONS.md")
+
+
+def _joinedstr_prefix(node: ast.JoinedStr) -> str:
+    """Literal prefix of an f-string before its first interpolation."""
+    prefix = ""
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            prefix += part.value
+        else:
+            break
+    return prefix
+
+
+class MetricsDocChecker(ProgramChecker):
+    """Every exported metric documented; every documented metric real."""
+
+    name = "metrics-doc"
+    codes = {
+        "TAO601": "metric exported in code but missing from "
+                  "docs/OPERATIONS.md 'Metrics to alert on'",
+        "TAO602": "documented metric matches no metric in the code",
+    }
+
+    def __init__(self, doc_path: str | None = None,
+                 doc_text: str | None = None) -> None:
+        self._doc_path = doc_path or _DEFAULT_DOC
+        self._doc_text = doc_text  # tests inject the table directly
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith("tpu_autoscaler/")
+
+    # -- doc side ---------------------------------------------------------
+
+    def _doc_entries(self) -> tuple[dict[str, int], dict[str, int], str]:
+        """(exact name -> line, family prefix -> line, doc rel path).
+        Names come from backticked tokens in the first column of the
+        metrics table; ``foo_<x>`` rows become the family prefix
+        ``foo_``."""
+        if self._doc_text is not None:
+            text, rel = self._doc_text, "docs/OPERATIONS.md"
+        else:
+            try:
+                with open(self._doc_path, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                return {}, {}, "docs/OPERATIONS.md"
+            rel = "docs/OPERATIONS.md"
+        exact: dict[str, int] = {}
+        families: dict[str, int] = {}
+        in_section = False
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if line.startswith("## "):
+                in_section = line.strip() == _DOC_SECTION
+                continue
+            if not in_section or not line.startswith("|"):
+                continue
+            first_cell = line.split("|")[1] if line.count("|") >= 2 else ""
+            for token in re.findall(r"`([^`]+)`", first_cell):
+                token = token.strip()
+                if not token or token in ("Metric", "---"):
+                    continue
+                if "<" in token:
+                    families.setdefault(token.split("<", 1)[0], lineno)
+                else:
+                    exact.setdefault(token, lineno)
+        return exact, families, rel
+
+    # -- code side --------------------------------------------------------
+
+    @staticmethod
+    def _code_metrics(files: list[SourceFile]) -> tuple[
+            dict[str, tuple[str, int]], dict[str, tuple[str, int]],
+            list[tuple[str, int]]]:
+        """(exact name -> first site, dynamic prefix -> first site,
+        unmatchable dynamic sites)."""
+        exact: dict[str, tuple[str, int]] = {}
+        prefixes: dict[str, tuple[str, int]] = {}
+        unmatchable: list[tuple[str, int]] = []
+        for src in files:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                args: list[ast.expr] = []
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _METRIC_METHODS
+                        and node.args):
+                    args.append(node.args[0])
+                # Tracer span→histogram feeds: metric="name" keywords
+                # (obs/trace.py record/end) count as exports too.
+                for kw in node.keywords:
+                    if kw.arg == "metric":
+                        args.append(kw.value)
+                for arg in args:
+                    site = (src.rel_path, arg.lineno)
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        exact.setdefault(arg.value, site)
+                    elif isinstance(arg, ast.JoinedStr):
+                        prefix = _joinedstr_prefix(arg)
+                        if prefix:
+                            prefixes.setdefault(prefix, site)
+                        else:
+                            unmatchable.append(site)
+        return exact, prefixes, unmatchable
+
+    # -- the check --------------------------------------------------------
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
+        if not files:
+            return []  # nothing in scope (foreign tree): no evidence
+        doc_exact, doc_families, doc_rel = self._doc_entries()
+        code_exact, code_prefixes, unmatchable = self._code_metrics(files)
+        findings: list[Finding] = []
+        # Dead-doc-entry findings (TAO602) need the WHOLE package in
+        # view — on a subset run (`... analysis tpu_autoscaler/k8s/`)
+        # an absent metric proves nothing.  The registry module is the
+        # sentinel: if it was scanned, this is a full-package run.
+        full_view = any(
+            s.rel_path == "tpu_autoscaler/metrics/metrics.py"
+            for s in files)
+
+        def documented(name: str) -> bool:
+            return name in doc_exact or any(
+                name.startswith(p) for p in doc_families)
+
+        for name, (path, line) in sorted(code_exact.items()):
+            if not documented(name):
+                findings.append(Finding(
+                    path, line, "TAO601",
+                    f"metric '{name}' is exported here but not in "
+                    f"{doc_rel} '{_DOC_SECTION[3:]}'"))
+        for prefix, (path, line) in sorted(code_prefixes.items()):
+            if prefix not in doc_families:
+                findings.append(Finding(
+                    path, line, "TAO601",
+                    f"dynamic metric family '{prefix}<...>' is exported "
+                    f"here but has no '{prefix}<...>' row in {doc_rel}"))
+        for path, line in unmatchable:
+            findings.append(Finding(
+                path, line, "TAO601",
+                "dynamic metric name has no literal prefix — it cannot "
+                "be matched against the runbook; hoist a stable prefix"))
+        if not full_view:
+            return findings
+        for name, lineno in sorted(doc_exact.items()):
+            if name in code_exact:
+                continue
+            if any(name.startswith(p) for p in code_prefixes):
+                continue  # a concrete instance of a dynamic family
+            findings.append(Finding(
+                doc_rel, lineno, "TAO602",
+                f"documented metric '{name}' matches nothing in the "
+                f"code (renamed or removed?)"))
+        for prefix, lineno in sorted(doc_families.items()):
+            if prefix in code_prefixes:
+                continue
+            if any(n.startswith(prefix) for n in code_exact):
+                continue  # family documented, members emitted literally
+            findings.append(Finding(
+                doc_rel, lineno, "TAO602",
+                f"documented metric family '{prefix}<...>' matches "
+                f"nothing in the code"))
+        return findings

@@ -37,6 +37,7 @@ from tpu_autoscaler.k8s.objects import (
 )
 from tpu_autoscaler.metrics import Metrics
 from tpu_autoscaler.notify import LogNotifier, Notifier
+from tpu_autoscaler.obs import FlightRecorder, Span, Tracer
 from tpu_autoscaler.state import SliceState, SliceTracker, classify_slice
 from tpu_autoscaler.state.tracker import DRAIN_ANNOTATION
 
@@ -134,12 +135,34 @@ class Controller:
                  config: ControllerConfig | None = None,
                  notifier: Notifier | None = None,
                  metrics: Metrics | None = None,
-                 informer=None, executor=None):
+                 informer=None, executor=None,
+                 tracer: Tracer | None = None,
+                 recorder: FlightRecorder | None = None):
         self.client = client
         self.actuator = actuator
         self.config = config or ControllerConfig()
         self.notifier = notifier or LogNotifier()
         self.metrics = metrics or Metrics()
+        # Decision tracing (docs/OBSERVABILITY.md): one trace per gang
+        # scale-up, per-pass decision records, all retained in the
+        # bounded flight recorder and served on /debugz + SIGUSR1.  The
+        # tracer's clock matters only for spans recorded without an
+        # explicit time (actuation dispatches, informer relists); every
+        # controller-side span uses the injected reconcile clock so
+        # simulated-time runs produce coherent traces.
+        if tracer is not None:
+            self.tracer = tracer
+            # An injected zero-retention tracer (recorder=None) must not
+            # leave the pass-record sink None — reconcile_once records
+            # unconditionally.
+            self.recorder = (recorder if recorder is not None
+                             else tracer.recorder) or FlightRecorder()
+        else:
+            self.recorder = recorder if recorder is not None \
+                else FlightRecorder()
+            self.tracer = Tracer(recorder=self.recorder,
+                                 metrics=self.metrics)
+        self.tracer.bind_metrics(self.metrics)
         # Cached observe path (k8s/informer.py): when set, reconcile
         # passes read watch-fed snapshots instead of re-LISTing and
         # re-parsing the world.  None = the relist-every-pass baseline;
@@ -156,6 +179,11 @@ class Controller:
         if self.executor is not None \
                 and hasattr(self.executor, "set_metrics"):
             self.executor.set_metrics(self.metrics)
+        if self.executor is not None \
+                and hasattr(self.executor, "set_tracer"):
+            self.executor.set_tracer(self.tracer)
+        if hasattr(actuator, "set_tracer"):
+            actuator.set_tracer(self.tracer)
         # Sticky staleness guard (_observe): node names a direct LIST
         # saw that the informer's node cache has not delivered yet.
         self._nodes_awaiting_cache: set[str] = set()
@@ -182,6 +210,22 @@ class Controller:
         # Gang lifecycle: first time each gang was seen Unschedulable, for
         # the north-star latency metric; cleared when the gang runs.
         self._gang_first_pending: dict[tuple, float] = {}
+        # Root "scale_up" span per pending gang (same lifecycle as
+        # _gang_first_pending — minted on first-Unschedulable, ended
+        # when the gang runs or its pods disappear).
+        self._gang_traces: dict[tuple, Span] = {}
+        # Open "node_registration" spans per supply-guarded provision
+        # (see _update_supply_guard), keyed by provision id.
+        self._registration_spans: dict[str, Span] = {}
+        # Per-pass decision record state (reset at the top of every
+        # reconcile_once; reconcile-thread-only).
+        self._pass_seq = 0
+        self._pass_events: list[dict] = []
+        # The current pass's shared phase windows, replayed into each
+        # served gang's trace at dispatch time: (pass now, observe
+        # seconds) and plan seconds.
+        self._pass_obs: tuple[float, float] = (0.0, 0.0)
+        self._pass_plan_s = 0.0
         # Gangs whose detect phase (first pending → first provision
         # submitted) has been observed; swept with _gang_first_pending.
         self._gang_detect_observed: set[tuple] = set()
@@ -217,6 +261,8 @@ class Controller:
         """One reconcile pass. All time injected for testability."""
         now = time.time() if now is None else now
         t0 = time.perf_counter()
+        self._pass_seq += 1
+        self._pass_events = []
 
         # Drain the actuation executor, then poll the actuator, THEN
         # observe.  Drain first: completed dispatches (create POSTs,
@@ -231,8 +277,11 @@ class Controller:
         self.actuator.poll(now)
         t_obs = time.perf_counter()
         nodes, pods = self._observe()
-        self.metrics.observe("observe_seconds",
-                             time.perf_counter() - t_obs)
+        observe_s = time.perf_counter() - t_obs
+        self.metrics.observe("observe_seconds", observe_s)
+        # Replayed into each served gang's trace at dispatch time: a
+        # pass observes once for every gang it serves.
+        self._pass_obs = (now, observe_s)
         self._update_supply_guard(nodes, now)
 
         pending = [p for p in pods if p.is_unschedulable]
@@ -323,6 +372,26 @@ class Controller:
         for ns, used in ns_usage.items():
             self.metrics.set_gauge(f"namespace_chips_used_{ns}", used)
         self._seen_namespaces |= set(ns_usage)
+        # Decision record: this pass's inputs digest + per-unit reasons
+        # ("why did/didn't we provision"), for `explain` / /debugz.
+        # The digest is an O(n) frozenset hash — cheap enough for the
+        # controller-overhead budget, strong enough to show whether two
+        # passes saw the same world.
+        digest = (hash(frozenset((p.uid, p.phase, p.node_name or "")
+                                 for p in pods))
+                  ^ hash(frozenset(n.name for n in nodes)))
+        self.recorder.record_pass({
+            "pass": self._pass_seq,
+            "t": now,
+            "inputs": {"nodes": len(nodes), "pods": len(pods),
+                       "pending_gangs": len(gangs),
+                       "in_flight": sum(
+                           1 for s in self.actuator.statuses()
+                           if s.in_flight),
+                       "digest": f"{digest & 0xffffffffffffffff:016x}"},
+            "duration_s": time.perf_counter() - t0,
+            "events": self._pass_events,
+        })
 
     def _observe(self) -> tuple[list[Node], list[Pod]]:
         """One pass's world view: informer snapshots when attached
@@ -392,13 +461,29 @@ class Controller:
                              count=status.request.count),
                     tuple(status.unit_ids), now)
                 self.metrics.inc("supply_guard_engaged")
+                self._explain(status.id, "supply-guard engaged",
+                              "ACTIVE but units not yet registered as "
+                              "nodes", units=",".join(status.unit_ids))
+                # The node_registration span is NOT started here: the
+                # guard engages before this pass records the provision
+                # span (_note_failures), and seq order is the render's
+                # causal order — the span opens there, after it.
         for pid, (_inf, unit_ids, since) in list(
                 self._supply_awaiting_nodes.items()):
             if all(u in seen_units for u in unit_ids):
                 del self._supply_awaiting_nodes[pid]
+                self.tracer.end(self._registration_spans.pop(pid, None),
+                                t=now)
+                self._explain(pid, "supply-guard released",
+                              "all units registered as nodes")
             elif now - since > self.config.provision_timeout_seconds:
                 del self._supply_awaiting_nodes[pid]
                 self.metrics.inc("supply_guard_expired")
+                self.tracer.end(self._registration_spans.pop(pid, None),
+                                t=now, attrs={"expired": True})
+                self._explain(pid, "supply-guard expired",
+                              "units never registered within "
+                              "provision_timeout")
 
     def _in_flight(self) -> list[InFlight]:
         """The planner's view of outstanding work: the actuator's
@@ -407,6 +492,69 @@ class Controller:
         return (in_flight_of(self.actuator)
                 + [inf for inf, _, _ in
                    self._supply_awaiting_nodes.values()])
+
+    # ---- observability helpers ----------------------------------------- #
+
+    def debug_dump(self) -> dict:
+        """The flight-recorder dump served on /debugz and written on
+        SIGUSR1: completed spans, decision records, still-open spans
+        (what a stuck pass is waiting on), and the metrics snapshot —
+        everything needed to diagnose a live controller without a
+        restart (docs/OBSERVABILITY.md)."""
+        out = self.recorder.dump(tracer=self.tracer)
+        out["metrics"] = self.metrics.snapshot()
+        # This dict is reconcile-thread-owned and deliberately
+        # lock-free (giving the Controller a lock would put EVERY
+        # field under the thread-discipline checker); the /debugz
+        # thread reads it concurrently, so copy with a bounded retry —
+        # a resize mid-copy raises RuntimeError, and a diagnostic
+        # endpoint must degrade, not 500, exactly when the controller
+        # is busy.
+        for _ in range(5):
+            try:
+                out["supply_guard"] = {
+                    pid: {"units": list(unit_ids), "since": since}
+                    for pid, (_inf, unit_ids, since)
+                    in list(self._supply_awaiting_nodes.items())}
+                break
+            except RuntimeError:  # mutated mid-copy; retry
+                continue
+        else:
+            out["supply_guard"] = {"unavailable": "mutating"}
+        return out
+
+    def _notify(self, message: str) -> None:
+        """Notifier calls are advisory: a webhook outage (or a buggy
+        custom Notifier) must never abort a reconcile pass.  Counted,
+        logged, swallowed — like the other advisory paths."""
+        try:
+            self.notifier.notify(message)
+        except Exception:  # noqa: BLE001 — advisory only
+            self.metrics.inc("notifier_errors")
+            log.warning("notifier failed for %r", message, exc_info=True)
+
+    def _explain(self, subject, decision: str, reason: str = "",
+                 **attrs) -> None:
+        """Append one per-unit reason to this pass's decision record
+        (flight recorder; `tpu-autoscaler explain`)."""
+        event = {"subject": str(subject), "decision": decision}
+        if reason:
+            event["reason"] = reason
+        event.update({k: v for k, v in attrs.items() if v is not None})
+        self._pass_events.append(event)
+
+    def _trace_roots(self, request) -> list[Span]:
+        """Root spans of every pending gang a provision serves (the
+        multislice cohort's members each get the story in their own
+        trace; CPU requests aggregate demand and map to no one gang)."""
+        keys: list[tuple] = []
+        if request.gang_key is not None:
+            keys.append(request.gang_key)
+        for key in request.gang_keys or ():
+            if key not in keys:
+                keys.append(key)
+        return [self._gang_traces[k] for k in keys
+                if k in self._gang_traces]
 
     def _fresh_nodes(self) -> list[Node]:
         """Direct LIST, bypassing the informer cache (memo-parsed, so
@@ -438,7 +586,8 @@ class Controller:
             from tpu_autoscaler.k8s.informer import ClusterInformer
 
             self.informer = ClusterInformer(
-                self.client, wake=wake, metrics=self.metrics)
+                self.client, wake=wake, metrics=self.metrics,
+                tracer=self.tracer)
             self.informer.start()
         elif self.informer is not None:
             # Injected informer: sleep on ITS wake event so its deltas
@@ -487,6 +636,8 @@ class Controller:
             self._gang_sizes[gang.key] = (gang.size, since)
             if now - since < settle:
                 settling += 1
+                self._explain(gang.name, "sizing deferred",
+                              "inside the gang-settle window")
             else:
                 out.append(gang)
         self.metrics.set_gauge("gangs_settling", settling)
@@ -500,22 +651,33 @@ class Controller:
         # sets its backoff before we consider re-submitting for its demand.
         self._note_failures(now, pods)
         overrides = self._generation_overrides(gangs, now)
+        t_plan = time.perf_counter()
         plan = self.planner.plan(gangs, nodes, pods, self._in_flight(),
                                  generation_overrides=overrides)
+        self._pass_plan_s = time.perf_counter() - t_plan
         for req in plan.requests:
             # Respect retry backoff after a failed provision for the same
             # demand (gang, or shape for gang-less spare provisions).
             backoff_key = req.gang_key or ("shape", req.shape_name)
             if now < self._retry_at.get(backoff_key, 0.0):
+                self._explain(
+                    backoff_key, "provision deferred",
+                    "retry backoff after a failed provision",
+                    retry_at=round(self._retry_at[backoff_key], 3),
+                    shape=req.shape_name)
                 continue
-            status = self.actuator.provision(req)
+            status = self._dispatch_provision(req, now)
             log.info("provisioning %s x%d (%s): %s", req.shape_name,
                      req.count, status.id, req.reason)
             self._submitted_at[status.id] = now
             self.metrics.inc("provisions_submitted")
+            self._explain(req.gang_key or ("shape", req.shape_name),
+                          "provision submitted", req.reason,
+                          provision_id=status.id, shape=req.shape_name,
+                          count=req.count)
             if req.kind == "tpu-slice":
                 self.metrics.observe("stranded_chips", req.stranded_chips)
-            self.notifier.notify(
+            self._notify(
                 f"scaling up: {req.count}x {req.shape_name} — {req.reason}")
             if req.kind == "cpu-node":
                 # CPU provisions aggregate demand across gangs (no
@@ -541,12 +703,15 @@ class Controller:
                 plan, nodes, pods, now)
         for gang, reason in plan.unsatisfiable:
             if gang.key in handled_by_preemption:
+                self._explain(gang.name, "not provisioned",
+                              "preemption is making room")
                 continue  # being actively made room for: not unsatisfiable
+            self._explain(gang.name, "unsatisfiable", reason)
             if gang.key not in self._reported_unsatisfiable:
                 self._reported_unsatisfiable.add(gang.key)
                 log.warning("unsatisfiable %s: %s", gang, reason)
                 self.metrics.inc("unsatisfiable_gangs")
-                self.notifier.notify(f"cannot satisfy {gang.name}: {reason}")
+                self._notify(f"cannot satisfy {gang.name}: {reason}")
                 # Stamp the verdict on the pods so `kubectl describe`
                 # answers "why is my job not scaling" without log access.
                 for pod in gang.pods:
@@ -561,6 +726,57 @@ class Controller:
                         self.metrics.inc("advisory_errors")
                         log.debug("could not annotate %s", pod.name,
                                   exc_info=True)
+
+    def _dispatch_provision(self, req, now: float):
+        """Submit one provision with its trace story attached.
+
+        The pass's shared observe/plan windows are replayed into every
+        served gang's trace (a pass observes once no matter how many
+        gangs it serves), then the actual ``actuator.provision`` call
+        runs inside a ``dispatch`` span made current — so actuator- and
+        executor-level spans (create POSTs, including ones that resolve
+        at a later drain) parent under it, across the pool boundary.
+        Span timestamps ride the injected reconcile clock offset by the
+        measured perf-counter phase durations, keeping one coherent
+        time base per trace even under simulated time.
+        """
+        roots = self._trace_roots(req)
+        if not roots:
+            return self.actuator.provision(req)
+        pass_now, observe_s = self._pass_obs
+        t_obs_end = pass_now + observe_s
+        t_plan_end = t_obs_end + self._pass_plan_s
+        for root in roots:
+            self.tracer.record("observe", start=pass_now, end=t_obs_end,
+                               parent=root)
+            self.tracer.record("plan", start=t_obs_end, end=t_plan_end,
+                               parent=root)
+        dspan = self.tracer.start(
+            "dispatch", parent=roots[0], t=t_plan_end,
+            attrs={"shape": req.shape_name, "count": req.count,
+                   "reason": req.reason})
+        t_d0 = time.perf_counter()
+        try:
+            with self.tracer.use(dspan):
+                status = self.actuator.provision(req)
+        except Exception as e:
+            self.tracer.end(dspan, t=t_plan_end
+                            + (time.perf_counter() - t_d0),
+                            attrs={"error": str(e)})
+            raise
+        t_d_end = t_plan_end + (time.perf_counter() - t_d0)
+        self.tracer.end(dspan, t=t_d_end,
+                        attrs={"provision_id": status.id})
+        for root in roots[1:]:
+            # Multislice siblings: each member's trace carries the
+            # shared dispatch (same timestamps, cross-linked by id).
+            self.tracer.record("dispatch", start=t_plan_end, end=t_d_end,
+                               parent=root,
+                               attrs={"shape": req.shape_name,
+                                      "count": req.count,
+                                      "provision_id": status.id,
+                                      "shared_with": roots[0].trace_id})
+        return status
 
     def _consider_preemption(self, plan, nodes: list[Node],
                              pods: list[Pod], now: float) -> set[tuple]:
@@ -686,7 +902,10 @@ class Controller:
                 log.warning("preempting unit %s for higher-priority gang "
                             "%s", unit_id, gang.name)
                 self.metrics.inc("preemptions")
-                self.notifier.notify(
+                self._explain(unit_id, "preempted",
+                              f"making room for higher-priority "
+                              f"{gang.name}")
+                self._notify(
                     f"preempting {unit_id} for higher-priority "
                     f"{gang.name}")
                 self.request_drain(unit_id)
@@ -740,7 +959,10 @@ class Controller:
                 log.warning(
                     "capacity fallback for %s after %d failed "
                     "provisions: trying %s", gang.name, streak, gen)
-                self.notifier.notify(
+                self._explain(gang.name, "generation fallback",
+                              f"{streak} failed provisions on the "
+                              f"default generation", fallback=gen)
+                self._notify(
                     f"capacity stockout for {gang.name}: falling back "
                     f"to {gen}")
                 for pod in gang.pods:
@@ -762,14 +984,54 @@ class Controller:
                 log.warning("provision %s stuck in flight for %.0fs; "
                             "cancelling", status.id, now - submitted)
                 self.metrics.inc("provisions_timed_out")
+                self._explain(status.id, "provision cancelled",
+                              f"stuck in flight > {timeout:g}s")
                 self.actuator.cancel(status.id)
         # Submit→ACTIVE latency per provision (the actuation slice of the
         # north-star budget; SURVEY.md §4.2 latency anatomy).
         for status in self.actuator.statuses():
             if status.state == ACTIVE and status.id in self._submitted_at:
-                self.metrics.observe(
-                    "provision_latency_seconds",
-                    now - self._submitted_at.pop(status.id))
+                submitted = self._submitted_at.pop(status.id)
+                value = now - submitted
+                # The "provision" span (submit → ACTIVE) lands in every
+                # served gang's trace; the FIRST emission feeds the
+                # provision_latency_seconds histogram so the metric is
+                # observed exactly once per provision — gang-less
+                # provisions (CPU aggregate, spares) keep the direct
+                # observation.
+                roots = self._trace_roots(status.request)
+                for i, root in enumerate(roots):
+                    self.tracer.record(
+                        "provision", start=submitted, end=now, parent=root,
+                        attrs={"provision_id": status.id,
+                               "units": ",".join(status.unit_ids)},
+                        metric=("provision_latency_seconds" if i == 0
+                                else None), value=value)
+                if not roots:
+                    self.metrics.observe("provision_latency_seconds",
+                                         value)
+                self._explain(status.id, "provision ACTIVE",
+                              units=",".join(status.unit_ids),
+                              latency_s=round(value, 3))
+                if roots and status.id in self._supply_awaiting_nodes:
+                    # Supply guard engaged earlier this pass: open the
+                    # registration span NOW (after the provision span,
+                    # so seq order stays causal); the guard's release
+                    # or expiry in _update_supply_guard ends it.
+                    self._registration_spans[status.id] = \
+                        self.tracer.start(
+                            "node_registration", parent=roots[0], t=now,
+                            attrs={"provision_id": status.id,
+                                   "units": ",".join(status.unit_ids)})
+                elif roots:
+                    # Units already registered when ACTIVE was observed
+                    # (the fake cloud; fast node pools): the
+                    # registration phase collapsed to a point — record
+                    # it so every trace shows the full anatomy.
+                    self.tracer.record(
+                        "node_registration", start=now, end=now,
+                        parent=roots[0],
+                        attrs={"provision_id": status.id})
                 success_key = (status.request.gang_key
                                or ("shape", status.request.shape_name))
                 self._failure_streak.pop(success_key, None)
@@ -778,6 +1040,18 @@ class Controller:
             if status.state == FAILED and status.id not in self._seen_failures:
                 self._seen_failures.add(status.id)
                 self.metrics.inc("provision_failures")
+                for root in self._trace_roots(status.request):
+                    self.tracer.record(
+                        "provision_failed",
+                        start=self._submitted_at.get(status.id, now),
+                        end=now, parent=root,
+                        attrs={"provision_id": status.id,
+                               "error": (status.error or "")[:200],
+                               "reason": getattr(status, "reason", None)})
+                self._explain(
+                    status.id, "provision FAILED",
+                    (status.error or "")[:200],
+                    reason_class=getattr(status, "reason", None))
                 # Per-cause counter + annotation (actuators/errors.py
                 # taxonomy): operators see stockout-vs-quota on the
                 # metrics endpoint and on the starved pods themselves.
@@ -795,7 +1069,7 @@ class Controller:
                 log.warning("provision %s failed (retry in %gs): %s",
                             status.id, self.config.provision_retry_seconds,
                             status.error)
-                self.notifier.notify(
+                self._notify(
                     f"provision {status.request.shape_name} failed: "
                     f"{status.error}")
 
@@ -806,8 +1080,17 @@ class Controller:
             first = self._gang_first_pending.get(key)
             if first is not None and key not in self._gang_detect_observed:
                 self._gang_detect_observed.add(key)
-                self.metrics.observe("detect_latency_seconds",
-                                     max(0.0, now - first))
+                root = self._gang_traces.get(key)
+                if root is not None:
+                    # Span AND histogram in one emission (the tracer
+                    # feeds the metric), so they can never disagree.
+                    self.tracer.record(
+                        "detect", start=first, end=now, parent=root,
+                        metric="detect_latency_seconds",
+                        value=max(0.0, now - first))
+                else:
+                    self.metrics.observe("detect_latency_seconds",
+                                         max(0.0, now - first))
 
     def _annotate_failure_reason(self, status, reason: str,
                                  pods: list[Pod]) -> None:
@@ -835,7 +1118,16 @@ class Controller:
     def _track_gang_latency(self, pending: list[Gang], pods: list[Pod],
                             nodes: list[Node], now: float) -> None:
         for gang in pending:
-            self._gang_first_pending.setdefault(gang.key, now)
+            if gang.key not in self._gang_first_pending:
+                self._gang_first_pending[gang.key] = now
+                # Mint THE trace for this scale-up: everything from here
+                # to last-pod-Running hangs off this root span.
+                self._gang_traces[gang.key] = self.tracer.start(
+                    "scale_up",
+                    trace_id=self.tracer.new_trace("scaleup"), t=now,
+                    attrs={"gang": "/".join(str(p) for p in gang.key
+                                            or ()),
+                           "pods": gang.size})
         if not self._gang_first_pending:
             return
         by_key: dict[tuple, list[Pod]] = {}
@@ -846,9 +1138,34 @@ class Controller:
             members = by_key.get(key, [])
             if members and all(p.phase == "Running" for p in members):
                 latency = now - first
-                self.metrics.observe("scale_up_latency_seconds", latency)
-                self._observe_bind_latency(members, node_by_name, first,
-                                           now)
+                root = self._gang_traces.pop(key, None)
+                bind_start = self._bind_start(members, node_by_name)
+                if bind_start is not None:
+                    start = max(bind_start, first)
+                    if root is not None:
+                        self.tracer.record(
+                            "pods_running", start=start, end=now,
+                            parent=root, metric="bind_latency_seconds",
+                            value=max(0.0, now - start))
+                    else:
+                        self.metrics.observe("bind_latency_seconds",
+                                             max(0.0, now - start))
+                elif root is not None:
+                    # Barrier untracked this process lifetime: no honest
+                    # bind number, but the trace still shows the phase.
+                    self.tracer.record("pods_running", start=first,
+                                       end=now, parent=root,
+                                       attrs={"bind_start": "untracked"})
+                if root is not None:
+                    self.tracer.end(root, t=now,
+                                    metric="scale_up_latency_seconds",
+                                    value=latency,
+                                    attrs={"latency_s": round(latency, 3)})
+                else:
+                    self.metrics.observe("scale_up_latency_seconds",
+                                         latency)
+                self._explain(key, "gang running",
+                              f"Unschedulable→Running in {latency:.1f}s")
                 log.info("gang %s Unschedulable→Running in %.1fs", key,
                          latency)
                 del self._gang_first_pending[key]
@@ -856,37 +1173,40 @@ class Controller:
             elif not members:
                 # Gang's pods were deleted while pending: drop the entry so
                 # a reused Job name doesn't inherit a stale start time.
+                root = self._gang_traces.pop(key, None)
+                if root is not None:
+                    self.tracer.end(
+                        root, t=now,
+                        attrs={"aborted": "pods deleted while pending"})
                 del self._gang_first_pending[key]
                 self._gang_detect_observed.discard(key)
         live_keys = {p.gang_key for p in pods}
         for key in [k for k in self._gang_sizes if k not in live_keys]:
             del self._gang_sizes[key]
 
-    def _observe_bind_latency(self, members: list[Pod],
-                              node_by_name: dict[str, Node],
-                              first_pending: float, now: float) -> None:
-        """Bind phase: supply Ready (and gang pending) → all pods Running.
-
-        Measured from the latest of (slowest unit's barrier clear, gang
-        first pending) — a gang that binds to a slice Ready long before it
-        arrived spent no time at all waiting on the scheduler's account.
-        """
+    def _bind_start(self, members: list[Pod],
+                    node_by_name: dict[str, Node]) -> float | None:
+        """Start of the bind phase: when the slowest supply unit the
+        gang bound to cleared its readiness barrier.  The caller clamps
+        to first-pending (a gang that binds to a slice Ready long
+        before it arrived spent no time waiting on the scheduler's
+        account) and feeds ``bind_latency_seconds`` through the
+        ``pods_running`` span.  None = no honest number (a member's
+        node already gone, or the barrier untracked this process
+        lifetime)."""
         from tpu_autoscaler.k8s.units import group_supply_units
 
         bound_nodes = [node_by_name[p.node_name] for p in members
                        if p.node_name in node_by_name]
         if len(bound_nodes) < len(members):
-            return  # a member's node is already gone: no honest number
+            return None  # a member's node is already gone
         ready_times = []
         for unit_id in group_supply_units(bound_nodes):
             since = self.tracker.all_ready_since(unit_id)
             if since is None:
-                return  # barrier not tracked yet this process lifetime
+                return None  # barrier not tracked this process lifetime
             ready_times.append(since)
-        if ready_times:
-            start = max(max(ready_times), first_pending)
-            self.metrics.observe("bind_latency_seconds",
-                                 max(0.0, now - start))
+        return max(ready_times) if ready_times else None
 
     # ---- scale-down / maintenance -------------------------------------- #
 
@@ -1060,6 +1380,8 @@ class Controller:
                         # Pending demand will bind here: hands off
                         # (reference: pending pods could use the node).
                         self.metrics.inc("reclaims_deferred_to_pending")
+                        self._explain(unit_id, "reclaim deferred",
+                                      "pending demand claims this unit")
                     else:
                         self._begin_drain(
                             unit_id, unit_nodes, unit_pods, now,
@@ -1123,7 +1445,8 @@ class Controller:
         if reason.startswith("idle"):
             self._drain_cancellable.add(unit_id)
         self.metrics.inc("drains_started")
-        self.notifier.notify(f"draining {unit_id}: {reason}")
+        self._explain(unit_id, "drain started", reason)
+        self._notify(f"draining {unit_id}: {reason}")
 
     def _cancel_drain(self, unit_id: str, unit_nodes: list[Node]) -> None:
         log.info("cancelling drain of %s: pending demand claims it",
@@ -1136,6 +1459,8 @@ class Controller:
         self._drain_started.pop(unit_id, None)
         self._drain_cancellable.discard(unit_id)
         self.metrics.inc("drains_cancelled")
+        self._explain(unit_id, "drain cancelled",
+                      "pending demand claims this unit")
 
     def _continue_drain(self, unit_id: str, unit_nodes: list[Node],
                         unit_pods: list[Pod], now: float) -> None:
@@ -1164,7 +1489,8 @@ class Controller:
         self._drain_cancellable.discard(unit_id)
         self._requested_drains.discard(unit_id)
         self.metrics.inc("units_deleted")
-        self.notifier.notify(f"deleted idle unit {unit_id}")
+        self._explain(unit_id, "unit deleted", "drain complete")
+        self._notify(f"deleted idle unit {unit_id}")
 
     def _handle_unhealthy(self, unit_id: str, unit_nodes: list[Node],
                           unit_pods: list[Pod], now: float) -> None:

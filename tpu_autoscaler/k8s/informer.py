@@ -194,7 +194,8 @@ class ResourceWatch(concurrency.Thread):
                  timeout_seconds: int = 60,
                  resync_seconds: float = 900.0,
                  metrics: "Metrics | None" = None,
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None,
+                 tracer=None):
         super().__init__(daemon=True, name=f"{cache.kind}-informer")
         self._cache = cache
         self._list = list_fn
@@ -204,6 +205,10 @@ class ResourceWatch(concurrency.Thread):
         self._resync_seconds = resync_seconds
         self._stopped = concurrency.Event()
         self._metrics = metrics
+        # Tracer (obs/trace.py): relists and failures are span-worthy
+        # (rare, and exactly what a slow-detection investigation needs);
+        # per-event deltas are NOT traced — the hot path stays hot.
+        self._tracer = tracer
         self._rng = rng or random.Random()
         self._failure_streak = 0
         self._last_relist_mono: float | None = None
@@ -221,7 +226,15 @@ class ResourceWatch(concurrency.Thread):
             self._metrics.inc(name)
 
     def _relist(self) -> None:
-        items, rv = self._list()
+        from tpu_autoscaler.obs import maybe_span
+
+        with maybe_span(self._tracer, "informer.relist",
+                        attrs={"kind": self._cache.kind}) as span:
+            items, rv = self._list()
+            if span is not None:
+                # Via the tracer lock: /debugz may be copying this
+                # still-open span concurrently.
+                self._tracer.annotate(span, objects=len(items))
         self._cache.replace(items, rv)
         self._inc("informer_relists")
         self._last_relist_mono = time.monotonic()  # analysis: allow=TAR503 pump() is the threadless drive mode and is never mixed with start() (see pump docstring)
@@ -258,6 +271,13 @@ class ResourceWatch(concurrency.Thread):
                 self._cache.mark_unsynced()
                 self._failure_streak += 1
                 self._inc("watch_failures")
+                if self._tracer is not None:
+                    t = self._tracer.clock()
+                    self._tracer.record(
+                        "informer.watch_failure", start=t, end=t,
+                        attrs={"kind": self._cache.kind,
+                               "streak": self._failure_streak,
+                               "error": f"{e.__class__.__name__}: {e}"})
                 level = (logging.WARNING if self._failure_streak == 1
                          else logging.DEBUG)
                 log.log(level, "%s watch failed (streak %d): %s; relist "
@@ -298,7 +318,8 @@ class ClusterInformer:
                  metrics: "Metrics | None" = None,
                  timeout_seconds: int = 60,
                  resync_seconds: float = 900.0,
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None,
+                 tracer=None):
         from tpu_autoscaler.k8s.objects import parse_node, parse_pod
 
         self._client = client
@@ -312,13 +333,15 @@ class ClusterInformer:
                 self.pod_cache, lambda: _list_with_rv(client, "pods"),
                 client.watch_pods, wake=self.wake,
                 timeout_seconds=timeout_seconds,
-                resync_seconds=resync_seconds, metrics=metrics, rng=rng))
+                resync_seconds=resync_seconds, metrics=metrics, rng=rng,
+                tracer=tracer))
         if hasattr(client, "watch_nodes"):
             self._watches.append(ResourceWatch(
                 self.node_cache, lambda: _list_with_rv(client, "nodes"),
                 client.watch_nodes, wake=self.wake,
                 timeout_seconds=timeout_seconds,
-                resync_seconds=resync_seconds, metrics=metrics, rng=rng))
+                resync_seconds=resync_seconds, metrics=metrics, rng=rng,
+                tracer=tracer))
 
     def start(self) -> None:
         for w in self._watches:

@@ -1,6 +1,14 @@
 """Structured logging (SURVEY.md §6.1: the reference had only plain
 ``logging`` with -v/--debug; the rebuild's north star is a latency, so logs
-must be machine-parsable for the detection→actuation trail)."""
+must be machine-parsable for the detection→actuation trail).
+
+JSON mode stamps every record with the active trace context
+(``trace_id`` + ``span``, from ``tpu_autoscaler.obs.trace``): a log line
+emitted while a gang's dispatch span is current carries that gang's
+scale-up trace id, so `grep <trace_id>` over the log stream and
+`tpu-autoscaler trace <trace_id>` over the flight recorder tell the
+same story (docs/OBSERVABILITY.md).
+"""
 
 from __future__ import annotations
 
@@ -8,9 +16,11 @@ import json
 import logging
 import sys
 
+from tpu_autoscaler.obs.trace import current_span
+
 
 class JsonFormatter(logging.Formatter):
-    """One JSON object per line: ts, level, logger, msg (+exc)."""
+    """One JSON object per line: ts, level, logger, msg (+exc, +trace)."""
 
     def format(self, record: logging.LogRecord) -> str:
         entry = {
@@ -19,6 +29,10 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        span = current_span()
+        if span is not None:
+            entry["trace_id"] = span.trace_id
+            entry["span"] = span.name
         if record.exc_info:
             entry["exc"] = self.formatException(record.exc_info)
         return json.dumps(entry)

@@ -219,8 +219,6 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
     notifier = (SlackNotifier(slack_hook, slack_channel) if slack_hook
                 else LogNotifier())
     metrics = Metrics()
-    if metrics_port:
-        metrics.serve(metrics_port)
     config = ControllerConfig(
         policy=_policy(default_generation, generation_fallbacks,
                        cpu_machine_type, over_provision,
@@ -235,7 +233,12 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         provision_timeout_seconds=provision_timeout,
         enable_preemption=preemption,
         no_scale=no_scale, no_maintenance=no_maintenance)
-    return Controller(kube, actuator, config, notifier, metrics)
+    controller = Controller(kube, actuator, config, notifier, metrics)
+    if metrics_port:
+        # Serve /metrics + /healthz + /debugz together: the flight-
+        # recorder dump rides the port operators already expose.
+        metrics.serve(metrics_port, debugz=controller.debug_dump)
+    return controller
 
 
 _kube_options = [
@@ -340,6 +343,11 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
     # slices and never reach any idle threshold. Run as a long-lived
     # Deployment (deploy/autoscaler.yaml).
     controller = _build(kube, actuator, sleep=sleep, **kw)
+    # SIGUSR1 → flight-recorder dump to /tmp, for controllers whose
+    # metrics port is off or firewalled (docs/OBSERVABILITY.md).
+    from tpu_autoscaler.obs import install_sigusr1
+
+    install_sigusr1(controller.debug_dump)
     lock = None
     if leader_elect:
         from tpu_autoscaler.k8s.leader import LeaseLock
@@ -483,6 +491,90 @@ def demo(scenario, provision_delay, until, scale_down, sleep, **kw):
                       scale_down=scale_down)
     click.echo(result.describe())
     sys.exit(0 if result.all_running else 1)
+
+
+def _load_dump(source, url):
+    """Read a flight-recorder dump: a SIGUSR1 file (``--from``) or a
+    live controller's ``/debugz`` endpoint (``--url``, which may be
+    just ``host:port``)."""
+    import json as _json
+
+    if bool(source) == bool(url):
+        raise click.UsageError(
+            "pass exactly one of --from FILE (a SIGUSR1 dump) or "
+            "--url http://HOST:METRICS_PORT (a live /debugz)")
+    if source:
+        try:
+            with open(source, encoding="utf-8") as f:
+                return _json.load(f)
+        except (OSError, ValueError) as e:
+            raise click.UsageError(
+                f"could not read dump {source!r}: {e}") from e
+    import urllib.request
+
+    if "://" not in url:
+        url = f"http://{url}"
+    if not url.rstrip("/").endswith("/debugz"):
+        url = url.rstrip("/") + "/debugz"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return _json.loads(r.read().decode())
+    except (OSError, ValueError) as e:
+        raise click.UsageError(
+            f"could not fetch {url!r}: {e} — is the controller running "
+            "with --metrics-port?") from e
+
+
+_dump_options = [
+    click.option("--from", "source", default=None,
+                 type=click.Path(exists=True, dir_okay=False),
+                 help="Flight-recorder dump file (written on SIGUSR1)."),
+    click.option("--url", default=None,
+                 help="Live controller /debugz URL (or just host:port)."),
+]
+
+
+def dump_options(f):
+    for opt in reversed(_dump_options):
+        f = opt(f)
+    return f
+
+
+@cli.command()
+@dump_options
+@click.argument("trace_id", required=False)
+def trace(source, url, trace_id):
+    """Render one gang scale-up as a span tree (no TRACE_ID: list
+    recorded traces).
+
+    The tree runs first-Unschedulable → observe/plan/dispatch →
+    provision ACTIVE → node registration → all pods Running; span
+    durations decompose `scale_up_latency_seconds` per phase
+    (docs/OBSERVABILITY.md).
+    """
+    from tpu_autoscaler.obs.render import list_traces, render_trace
+
+    dump = _load_dump(source, url)
+    if trace_id:
+        click.echo(render_trace(dump, trace_id))
+    else:
+        click.echo(list_traces(dump))
+
+
+@cli.command()
+@dump_options
+@click.option("--last", default=5, show_default=True,
+              help="How many recent reconcile passes to show (0=all).")
+@click.option("--subject", default=None,
+              help="Filter decisions by substring (gang, unit, shape).")
+def explain(source, url, last, subject):
+    """Explain recent reconcile passes: inputs digest + per-unit
+    decisions ("why did/didn't we provision") from the flight
+    recorder."""
+    from tpu_autoscaler.obs.render import render_passes
+
+    dump = _load_dump(source, url)
+    click.echo(render_passes(dump, last=last, subject=subject))
 
 
 if __name__ == "__main__":

@@ -39,6 +39,11 @@ class _Summary:
         self.last = value
 
     def as_dict(self) -> dict:
+        # Empty-case guard: before the first observe, min/max sit at
+        # their +-inf sentinels — exporting them would leak "Infinity"
+        # into every JSON rendering (strict parsers reject it) and into
+        # any gauge-style export of the summary extrema.  An empty
+        # summary exports count alone; every renderer keys off it.
         if not self.count:
             return {"count": 0}
         return {"count": self.count, "sum": self.total,
@@ -101,34 +106,58 @@ class Metrics:
             }
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition (metric names sanitized)."""
+        """Prometheus text exposition (metric names sanitized).
+
+        Every family gets ``# HELP`` + ``# TYPE`` lines (exposition-
+        format contract; scrapers and promtool lint on them), not just
+        histograms.  The HELP text points at the operator runbook —
+        docs/OPERATIONS.md is the one place metric meanings live (and
+        the TAO6xx checker keeps code and runbook in sync), so the
+        exposition references it instead of duplicating prose.
+        Empty-summary guard: min/max/sum render only after the first
+        observation (see ``_Summary.as_dict``) — an idle process must
+        never expose ``inf``/``-inf`` samples.
+        """
         def clean(name: str) -> str:
             return "".join(c if c.isalnum() or c == "_" else "_"
                            for c in name)
 
+        def head(n: str, kind: str) -> list[str]:
+            return [f"# HELP {n} tpu-autoscaler {n.replace('_', ' ')} "
+                    f"(see docs/OPERATIONS.md)",
+                    f"# TYPE {n} {kind}"]
+
         lines = []
         snap = self.snapshot()
         for name, v in sorted(snap["counters"].items()):
-            lines.append(f"# TYPE {clean(name)} counter")
+            lines += head(clean(name), "counter")
             lines.append(f"{clean(name)} {v}")
         for name, v in sorted(snap["gauges"].items()):
-            lines.append(f"# TYPE {clean(name)} gauge")
+            lines += head(clean(name), "gauge")
             lines.append(f"{clean(name)} {v}")
         hists = snap.get("histograms", {})
         for name, s in sorted(snap["summaries"].items()):
             n = clean(name)
             if name in hists:
                 continue  # exported as a histogram below
-            lines.append(f"# TYPE {n} summary")
+            lines += head(n, "summary")
             lines.append(f"{n}_count {s.get('count', 0)}")
             if s.get("count"):
                 lines.append(f"{n}_sum {s['sum']}")
+                # Extrema ride as their OWN gauge families: _min/_max
+                # are not summary-family samples, so hiding them under
+                # the summary TYPE would make promtool flag undeclared
+                # series (empty-case guarded: absent before the first
+                # observe, never inf).
+                lines += head(f"{n}_min", "gauge")
+                lines.append(f"{n}_min {s['min']}")
+                lines += head(f"{n}_max", "gauge")
                 lines.append(f"{n}_max {s['max']}")
         for name, h in sorted(hists.items()):
             n = clean(name)
             s = snap["summaries"].get(name, {})
             count = s.get("count", 0)
-            lines.append(f"# TYPE {n} histogram")
+            lines += head(n, "histogram")
             for le, cum in h["buckets"]:
                 lines.append(f'{n}_bucket{{le="{le:g}"}} {cum}')
             lines.append(f'{n}_bucket{{le="+Inf"}} {count}')
@@ -136,22 +165,42 @@ class Metrics:
             lines.append(f"{n}_count {count}")
         return "\n".join(lines) + "\n"
 
-    def serve(self, port: int) -> threading.Thread:
-        """Serve /metrics on a daemon thread; returns the thread."""
+    def serve(self, port: int, debugz=None) -> threading.Thread:
+        """Serve /metrics (+ /healthz, + /debugz) on a daemon thread.
+
+        ``debugz``: optional zero-arg callable returning a JSON-able
+        dict — the flight-recorder dump (``Controller.debug_dump``), so
+        a stuck production controller can be inspected over the port it
+        already exposes, without a restart (docs/OBSERVABILITY.md).
+        Serialized with ``allow_nan=False``: an ``inf`` anywhere in the
+        dump is a bug (empty-summary guard) and must fail loudly here,
+        not in whichever strict JSON parser reads the dump later.
+        """
         import http.server
+        import json
 
         metrics = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib naming)
-                if self.path not in ("/metrics", "/healthz"):
+                if self.path.split("?", 1)[0] == "/debugz" \
+                        and debugz is not None:
+                    body = json.dumps(debugz(), indent=2, default=str,
+                                      allow_nan=False).encode()
+                    ctype = "application/json"
+                elif self.path == "/metrics":
+                    body = metrics.render_prometheus().encode()
+                    # The Prometheus exposition-format content type.
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path == "/healthz":
+                    body = b"ok\n"
+                    ctype = "text/plain; charset=utf-8"
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = (metrics.render_prometheus() if self.path == "/metrics"
-                        else "ok\n").encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

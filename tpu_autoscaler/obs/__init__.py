@@ -1,0 +1,28 @@
+"""Observability layer (ISSUE 5): end-to-end decision tracing.
+
+- ``trace``    — dependency-free spans + tracer with context propagation
+                 (one trace per gang scale-up; docs/OBSERVABILITY.md);
+- ``recorder`` — bounded flight recorder of completed spans and
+                 per-pass decision records, served on ``/debugz`` and
+                 dumped on SIGUSR1;
+- ``render``   — the ``trace`` / ``explain`` CLI's formatting layer.
+"""
+
+from tpu_autoscaler.obs.recorder import FlightRecorder, install_sigusr1
+from tpu_autoscaler.obs.trace import (
+    Span,
+    Tracer,
+    current_span,
+    current_trace_id,
+    maybe_span,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "Span",
+    "Tracer",
+    "current_span",
+    "current_trace_id",
+    "install_sigusr1",
+    "maybe_span",
+]

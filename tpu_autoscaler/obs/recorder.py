@@ -1,0 +1,136 @@
+"""Flight recorder: bounded in-memory history of completed spans and
+per-pass decision records, dumpable from a LIVE process.
+
+Why (ISSUE 5): when a production controller is stuck or slow, the
+Prometheus endpoint says *that* something is wrong, not *why*.  The
+recorder keeps the last N completed spans (the per-phase latency
+anatomy of recent scale-ups) and the last M reconcile decision records
+("why did/didn't we provision") in two lock-guarded ring buffers, and
+exposes them two ways that both work without a restart:
+
+- ``/debugz`` on the metrics port (``Metrics.serve(port, debugz=...)``)
+  returns the dump as JSON;
+- SIGUSR1 (``install_sigusr1``) writes the dump to a timestamped file
+  under ``/tmp`` — for controllers whose metrics port is firewalled or
+  was never enabled.
+
+Retention is bounded by construction (``collections.deque`` maxlen):
+the recorder can never grow past ``max_spans + max_passes`` entries no
+matter how long the process runs — crash-only discipline applied to
+introspection state.  Everything in a dump is JSON-serializable with
+``allow_nan=False`` (guarded empty-summary exports; no ``inf`` leaks).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import signal
+import time
+from typing import Any, Callable
+
+from tpu_autoscaler import concurrency
+from tpu_autoscaler.obs.trace import Span
+
+log = logging.getLogger(__name__)
+
+#: Ring bounds (docs/OBSERVABILITY.md).  4096 spans ≈ 500 scale-ups of
+#: 8 spans each; 512 passes ≈ 40 min of 5 s-interval history.
+DEFAULT_MAX_SPANS = 4096
+DEFAULT_MAX_PASSES = 512
+
+
+class FlightRecorder:
+    """Lock-guarded ring buffers of spans + decision records.
+
+    Writers: the reconcile thread (most spans, every pass record) and
+    the informer watch threads (relist spans) — hence the lock.  The
+    ``/debugz`` HTTP handler and the SIGUSR1 handler read via
+    ``dump()``, which copies under the lock.
+    """
+
+    def __init__(self, max_spans: int = DEFAULT_MAX_SPANS,
+                 max_passes: int = DEFAULT_MAX_PASSES) -> None:
+        self._lock = concurrency.Lock()
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=max_spans)
+        self._passes: collections.deque[dict[str, Any]] = collections.deque(
+            maxlen=max_passes)
+        self._spans_recorded = 0
+        self._passes_recorded = 0
+
+    # -- writers ----------------------------------------------------------
+
+    def record_span(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            self._spans_recorded += 1
+
+    def record_pass(self, record: dict[str, Any]) -> None:
+        with self._lock:
+            self._passes.append(record)
+            self._passes_recorded += 1
+
+    # -- readers ----------------------------------------------------------
+
+    def dump(self, tracer: Any = None) -> dict[str, Any]:
+        """JSON-able snapshot: completed spans (recording order — causal
+        within a thread), decision records, and — when the owning tracer
+        is passed — still-open spans (the "what is it stuck on" view)."""
+        with self._lock:
+            spans = [s.as_dict() for s in self._spans]
+            passes = list(self._passes)
+            counts = {"spans_recorded": self._spans_recorded,
+                      "passes_recorded": self._passes_recorded,
+                      "spans_retained": len(spans),
+                      "passes_retained": len(passes)}
+        out: dict[str, Any] = {"generated_at": time.time(),
+                               "counts": counts,
+                               "spans": spans, "passes": passes}
+        if tracer is not None:
+            out["active_spans"] = [s.as_dict()
+                                   for s in tracer.active_spans()]
+        return out
+
+
+def install_sigusr1(dump_fn: Callable[[], dict[str, Any]],
+                    path_prefix: str = "/tmp/tpu-autoscaler-debugz") -> bool:
+    """SIGUSR1 → write ``dump_fn()`` as JSON to a timestamped file.
+
+    Returns False on platforms without SIGUSR1 (Windows).  Install from
+    the main thread only (a Python signal.signal constraint).  The
+    handler is crash-only: a failing dump logs and never takes the
+    process down.
+
+    The dump runs on a THROWAWAY THREAD, never inline in the handler:
+    Python signal handlers interrupt the main thread between bytecodes,
+    and ``dump_fn`` acquires the recorder/tracer/metrics locks — all
+    non-reentrant.  An inline dump that lands while the interrupted
+    reconcile frame holds one of those locks would deadlock the very
+    controller it exists to diagnose; a thread just blocks until the
+    main thread releases the lock and then writes the file.
+    """
+    if not hasattr(signal, "SIGUSR1"):
+        return False
+
+    def _write() -> None:
+        path = f"{path_prefix}-{int(time.time())}.json"
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(dump_fn(), f, indent=2, default=str,
+                          allow_nan=False)
+            log.warning("SIGUSR1: flight-recorder dump written to %s", path)
+        except Exception:  # noqa: BLE001 — diagnostics must not kill
+            log.exception("SIGUSR1 flight-recorder dump failed")
+
+    def _handler(signum: int, frame: Any) -> None:
+        # Raw threading on purpose: this fires only in production
+        # processes (main.run), outside any scheduler's lifetime.
+        import threading
+
+        threading.Thread(target=_write, daemon=True,
+                         name="debugz-dump").start()
+
+    signal.signal(signal.SIGUSR1, _handler)
+    return True

@@ -1,0 +1,136 @@
+"""Human rendering of flight-recorder dumps — the `trace` / `explain`
+CLI subcommands' formatting layer, kept importable so tests and other
+tools can render a dump without going through click.
+
+Input is always the JSON shape ``FlightRecorder.dump`` produces (from
+``/debugz``, a SIGUSR1 file, or ``Controller.debug_dump()`` directly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+
+def _fmt_duration(seconds: float | None) -> str:
+    if seconds is None:
+        return "…open"
+    if seconds >= 60:
+        return f"{seconds / 60:.1f}m"
+    if seconds >= 1:
+        return f"{seconds:.2f}s"
+    return f"{seconds * 1e3:.1f}ms"
+
+
+def _fmt_attrs(attrs: dict[str, Any]) -> str:
+    if not attrs:
+        return ""
+    inner = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+    return f"  [{inner}]"
+
+
+def _all_spans(dump: dict[str, Any]) -> list[dict[str, Any]]:
+    return list(dump.get("spans", ())) + list(dump.get("active_spans", ()))
+
+
+def trace_ids(dump: dict[str, Any]) -> list[str]:
+    """Distinct trace ids, oldest first (first-span order)."""
+    seen: dict[str, None] = {}
+    for span in _all_spans(dump):
+        seen.setdefault(span["trace_id"])
+    return list(seen)
+
+
+def list_traces(dump: dict[str, Any]) -> str:
+    """One line per trace: id, root span, start, duration."""
+    lines = []
+    for tid in trace_ids(dump):
+        spans = [s for s in _all_spans(dump) if s["trace_id"] == tid]
+        roots = [s for s in spans if s.get("parent_id") is None]
+        root = min(roots or spans, key=lambda s: (s["start"], s["seq"]))
+        lines.append(
+            f"{tid}  {root['name']}"
+            f"  start={root['start']:g}"
+            f"  {_fmt_duration(root.get('duration_s'))}"
+            f"  spans={len(spans)}{_fmt_attrs(root.get('attrs', {}))}")
+    return "\n".join(lines) if lines else "(no traces recorded)"
+
+
+def render_trace(dump: dict[str, Any], trace_id: str) -> str:
+    """The single-tree view: one scale-up from first-Unschedulable to
+    last-pod-Running, children in causal order.  Causal = recording
+    ``seq``, not start timestamp: a retroactive span (a pass's shared
+    observe window) and a submitted-at-pass-start provision share
+    timestamps under simulated time, but recording order is the order
+    things actually happened."""
+    spans = [s for s in _all_spans(dump) if s["trace_id"] == trace_id]
+    if not spans:
+        known = ", ".join(trace_ids(dump)) or "(none)"
+        return f"trace {trace_id!r} not found; known traces: {known}"
+    by_parent: dict[str | None, list[dict[str, Any]]] = {}
+    span_ids = {s["span_id"] for s in spans}
+    for s in spans:
+        parent = s.get("parent_id")
+        # A parent evicted from the ring leaves an orphan: promote it to
+        # the top level rather than dropping it silently.
+        if parent is not None and parent not in span_ids:
+            parent = None
+        by_parent.setdefault(parent, []).append(s)
+    for children in by_parent.values():
+        children.sort(key=lambda s: s["seq"])
+
+    lines = [f"trace {trace_id}"]
+
+    def walk(parent: str | None, depth: int) -> None:
+        for s in by_parent.get(parent, ()):
+            events = (f"  ({len(s['events'])} events)"
+                      if s.get("events") else "")
+            lines.append(
+                f"{'  ' * depth}{'└─ ' if depth else ''}{s['name']}"
+                f"  {_fmt_duration(s.get('duration_s'))}"
+                f"  @{s['start']:g}"
+                f"{_fmt_attrs(s.get('attrs', {}))}{events}")
+            walk(s["span_id"], depth + 1)
+
+    walk(None, 0)
+    return "\n".join(lines)
+
+
+def span_names_in_order(dump: dict[str, Any], trace_id: str) -> list[str]:
+    """Span names of one trace in causal (recording seq) order — the
+    e2e acceptance assertion's view."""
+    spans = [s for s in _all_spans(dump) if s["trace_id"] == trace_id]
+    return [s["name"] for s in sorted(spans, key=lambda s: s["seq"])]
+
+
+def render_passes(dump: dict[str, Any], last: int = 5,
+                  subject: str | None = None) -> str:
+    """The explainability view: recent reconcile decision records, each
+    with its inputs digest and per-unit reasons.  ``subject`` filters
+    events by substring (gang name, unit id, shape…)."""
+    passes: Iterable[dict[str, Any]] = dump.get("passes", ())
+    picked = list(passes)[-last:] if last else list(passes)
+    if not picked:
+        return "(no reconcile passes recorded)"
+    lines = []
+    for rec in picked:
+        inputs = rec.get("inputs", {})
+        lines.append(
+            f"pass #{rec.get('pass')}  t={rec.get('t'):g}  "
+            f"nodes={inputs.get('nodes')} pods={inputs.get('pods')} "
+            f"pending_gangs={inputs.get('pending_gangs')} "
+            f"digest={inputs.get('digest')} "
+            f"took={_fmt_duration(rec.get('duration_s'))}")
+        events = rec.get("events", ())
+        shown = [e for e in events
+                 if subject is None or subject in str(e.get("subject", ""))]
+        if not shown:
+            lines.append("  (no decisions"
+                         + (f" matching {subject!r}" if subject else "")
+                         + ")")
+        for e in shown:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("subject", "decision", "reason")}
+            lines.append(f"  {e.get('subject')}: {e.get('decision')}"
+                         + (f" — {e['reason']}" if e.get("reason") else "")
+                         + _fmt_attrs(extra))
+    return "\n".join(lines)
