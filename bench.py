@@ -240,9 +240,12 @@ def check_all_configs() -> bool:
 
 
 def bench_fit_batch(n_gangs: int = 512) -> dict:
-    """Python per-gang vs native batch shape scoring (the crossover that
-    justifies PoolPolicy.native_fit_threshold).  Reports any decision
-    mismatch between the two paths; main() fails the bench on one."""
+    """Python per-gang vs batch-kernel shape scoring (the crossover that
+    justifies PoolPolicy.native_fit_threshold).  Uses the native kernel
+    when a toolchain exists, else the vectorized jaxfit/numpy kernel —
+    so the zero-decision-mismatch parity gate always runs.  Reports any
+    decision mismatch between the paths; main() fails the bench on one.
+    """
     from tpu_autoscaler import native
     from tpu_autoscaler.engine.fitter import (
         batch_choose_shapes,
@@ -253,10 +256,9 @@ def bench_fit_batch(n_gangs: int = 512) -> dict:
     from tpu_autoscaler.sim import _pod
     from tpu_autoscaler.topology.catalog import TPU_RESOURCE
 
-    info: dict = {"info": "fit_batch", "gangs": n_gangs}
-    if not native.available():
-        info["skipped"] = "native toolchain unavailable"
-        return info
+    backend = "native" if native.available() else "jaxfit"
+    info: dict = {"info": "fit_batch", "gangs": n_gangs,
+                  "backend": backend}
     mixes = [(8, 1), (4, 4), (4, 16), (1, 3), (4, 64), (4, 32)]
     pods = []
     for i in range(n_gangs):
@@ -268,22 +270,67 @@ def bench_fit_batch(n_gangs: int = 512) -> dict:
     t0 = time.perf_counter()
     py = {g.key: choose_shape_for_gang(g, "v5e") for g in gangs}
     py_s = time.perf_counter() - t0
-    batch_choose_shapes(gangs, "v5e")  # warm (builds/loads the library)
+    # Warm (builds/loads the library, or first numpy dispatch).
+    batch_choose_shapes(gangs, "v5e", backend=backend)
     t0 = time.perf_counter()
-    nat = batch_choose_shapes(gangs, "v5e")
-    nat_s = time.perf_counter() - t0
+    batch = batch_choose_shapes(gangs, "v5e", backend=backend)
+    batch_s = time.perf_counter() - t0
     mismatch = sum(
-        1 for k, c in nat.items()
+        1 for k, c in batch.items()
         if (py[k].shape.name, py[k].stranded_chips)
         != (c.shape.name, c.stranded_chips))
     info.update({
         "python_ms": round(py_s * 1e3, 2),
-        "native_ms": round(nat_s * 1e3, 2),
-        "speedup": round(py_s / nat_s, 1) if nat_s > 0 else None,
-        "native_decided": len(nat),
+        "batch_ms": round(batch_s * 1e3, 2),
+        # Back-compat key: pre-scale rounds called this native_ms.
+        "native_ms": round(batch_s * 1e3, 2),
+        "speedup": round(py_s / batch_s, 1) if batch_s > 0 else None,
+        "batch_decided": len(batch),
+        "native_decided": len(batch),
         "decision_mismatches": mismatch,
     })
     return info
+
+
+# Large-batch fit tier (ISSUE 6): the 512-gang default above proved the
+# crossover; this tier gates the claim at fleet-admission scale.
+FIT_BATCH_SCALE_GANGS = 8192
+FIT_BATCH_SPEEDUP_FLOOR = 2.0
+
+
+def check_fit_batch(n_gangs: int,
+                    floor: float = FIT_BATCH_SPEEDUP_FLOOR
+                    ) -> tuple[bool, dict]:
+    """Gate: zero python/kernel decision mismatches AND the batch path
+    at least ``floor``x faster than per-gang Python at ``n_gangs``."""
+    info = bench_fit_batch(n_gangs)
+    info["floor"] = floor
+    print(json.dumps(info), file=sys.stderr)
+    _record_scale_tier("fit_batch", info)
+    ok = (info.get("decision_mismatches") == 0
+          and info.get("batch_decided", 0) > 0
+          and (info.get("speedup") or 0) >= floor)
+    if not ok:
+        print(json.dumps({"error": "fit_batch regression: decision "
+                          "mismatch or speedup below floor", **info}),
+              file=sys.stderr)
+    return ok, info
+
+
+def _record_scale_tier(key: str, info: dict) -> None:
+    """Merge one scale-tier result into BENCH_SCALE.json (repo root)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_SCALE.json")
+    record: dict = {}
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        record = {}
+    record[key] = info
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
 
 
 # Observe-path tier (ISSUE 2): steady-state per-pass observation cost —
@@ -298,7 +345,8 @@ OBSERVE_PASSES = 5
 OBSERVE_SPEEDUP_FLOOR = 5.0
 
 
-def _observe_pod_payload(i: int, rv: int) -> dict:
+def _observe_pod_payload(i: int, rv: int,
+                         n_nodes: int = OBSERVE_NODES) -> dict:
     running = i % 50 != 0  # ~2% pending (the demand tail)
     payload = {
         "metadata": {
@@ -311,7 +359,7 @@ def _observe_pod_payload(i: int, rv: int) -> dict:
             "ownerReferences": [{"kind": "Job", "name": f"job-{i // 4}"}],
         },
         "spec": {
-            "nodeName": f"node-{i % OBSERVE_NODES}" if running else None,
+            "nodeName": f"node-{i % n_nodes}" if running else None,
             "nodeSelector": {},
             "tolerations": [{"key": "google.com/tpu",
                              "operator": "Exists",
@@ -459,6 +507,124 @@ def check_observe_path() -> bool:
     if not ok:
         print(json.dumps({"error": "observe-path regression: informer "
                           "speedup below floor", **info}), file=sys.stderr)
+    return ok
+
+
+# Mega-cluster observe tier (ISSUE 6): steady-state per-pass observe
+# cost on the RECONCILE thread at 100k pods / 10k nodes — what a pass
+# actually pulls (Unschedulable pods, per-node/per-pool free capacity)
+# — indexed reads vs the snapshot-scan path (materialize the full
+# parsed snapshot, scan it for pending demand, re-derive free capacity
+# from every pod).  Watch-delta ingestion is the watch thread's work
+# and identical for both paths, so it stays outside the timed windows;
+# the indexed path's incremental CapacityView fold IS timed (it runs
+# per pass).  Gate: >= 20x.
+OBSERVE_SCALE_PODS = 100_000
+OBSERVE_SCALE_NODES = 10_000
+OBSERVE_SCALE_PASSES = 3
+OBSERVE_SCALE_FLOOR = 20.0
+
+
+def bench_observe_scale(n_pods: int = OBSERVE_SCALE_PODS,
+                        n_nodes: int = OBSERVE_SCALE_NODES,
+                        churn: float = OBSERVE_CHURN,
+                        passes: int = OBSERVE_SCALE_PASSES) -> dict:
+    """Indexed observe vs snapshot-scan at mega-cluster scale.
+
+    Setup streams payload generators straight into ``replace`` —
+    nothing is materialized as a Python list before the caches, so the
+    tier's wall-clock measures the observe paths, not fixture
+    construction (and peak memory stays one payload dict per object).
+    """
+    from tpu_autoscaler.engine.fitter import free_capacity
+    from tpu_autoscaler.k8s.informer import (
+        PENDING,
+        CapacityView,
+        make_node_cache,
+        make_pod_cache,
+    )
+    from tpu_autoscaler.k8s.objects import clear_parse_caches
+
+    clear_parse_caches()
+    rv = 1
+    pod_cache = make_pod_cache()
+    node_cache = make_node_cache()
+    # Streamed: replace() consumes the generator item by item.
+    pod_cache.replace(
+        (_observe_pod_payload(i, rv, n_nodes) for i in range(n_pods)),
+        str(rv))
+    node_cache.replace(
+        (_observe_node_payload(i, rv) for i in range(n_nodes)), str(rv))
+    view = CapacityView(node_cache, pod_cache)
+    view.refresh()  # initial full build (cold start, untimed)
+
+    churn_pods = max(1, int(n_pods * churn))
+    churn_nodes = max(1, int(n_nodes * churn))
+    scan_s = indexed_s = float("inf")
+    n_pending_scan = n_pending_idx = -1
+    for p in range(passes):
+        # The pass's churn, applied the way the watch thread applies it
+        # (identical ingestion for both paths; generated lazily).
+        for j in range(churn_pods):
+            rv += 1
+            i = (p * churn_pods + j) % n_pods
+            pod_cache.apply({"type": "MODIFIED",
+                             "object": _observe_pod_payload(i, rv,
+                                                            n_nodes)})
+        for j in range(churn_nodes):
+            rv += 1
+            i = (p * churn_nodes + j) % n_nodes
+            node_cache.apply({"type": "MODIFIED",
+                              "object": _observe_node_payload(i, rv)})
+
+        # -- snapshot-scan path: what a pass costs without indices ----
+        t0 = time.perf_counter()
+        pods = pod_cache.snapshot()
+        nodes = node_cache.snapshot()
+        pending = [pod for pod in pods if pod.is_unschedulable]
+        free = free_capacity(nodes, pods)
+        scan_s = min(scan_s, time.perf_counter() - t0)
+        n_pending_scan = len(pending)
+
+        # -- indexed path: select + incremental capacity fold ---------
+        t0 = time.perf_counter()
+        pending_idx = pod_cache.select("unschedulable", PENDING)
+        view.refresh()
+        indexed_s = min(indexed_s, time.perf_counter() - t0)
+        n_pending_idx = len(pending_idx)
+
+    # Cross-path sanity: same demand set, same free-capacity support.
+    assert n_pending_scan == n_pending_idx > 0
+    assert set(view.free) == set(free)
+    sample = next(iter(free))
+    assert abs(view.free[sample].get("cpu")
+               - free[sample].get("cpu")) < 1e-6
+    clear_parse_caches()
+    return {
+        "info": "observe_scale",
+        "pods": n_pods, "nodes": n_nodes, "churn": churn,
+        "pending": n_pending_idx,
+        "scan_ms": round(scan_s * 1e3, 2),
+        "indexed_ms": round(indexed_s * 1e3, 3),
+        "speedup": round(scan_s / indexed_s, 1) if indexed_s > 0
+        else None,
+        "floor": OBSERVE_SCALE_FLOOR,
+    }
+
+
+def check_observe_scale(n_pods: int, n_nodes: int,
+                        floor: float = OBSERVE_SCALE_FLOOR) -> bool:
+    """Gate: indexed observe >= ``floor``x faster than snapshot-scan at
+    the requested scale; records the tier in BENCH_SCALE.json."""
+    info = bench_observe_scale(n_pods, n_nodes)
+    info["floor"] = floor
+    print(json.dumps(info), file=sys.stderr)
+    _record_scale_tier("observe_scale", info)
+    ok = (info.get("speedup") or 0) >= floor
+    if not ok:
+        print(json.dumps({"error": "observe-scale regression: indexed "
+                          "speedup below floor", **info}),
+              file=sys.stderr)
     return ok
 
 
@@ -681,10 +847,43 @@ def check_tracer_overhead() -> tuple[bool, dict]:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import argparse
+
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "observe":
-        # Observe tier only (scripts/full_suite.sh): sub-second gate.
-        return 0 if check_observe_path() else 1
+        # Observe tiers (scripts/full_suite.sh).  Bare: the PR-2
+        # informer-vs-relist gate (sub-second).  With --pods/--nodes:
+        # the mega-cluster indexed-vs-snapshot-scan tier (ISSUE 6).
+        ap = argparse.ArgumentParser(prog="bench.py observe")
+        ap.add_argument("--pods", type=int, default=None)
+        ap.add_argument("--nodes", type=int, default=None)
+        ap.add_argument("--floor", type=float,
+                        default=OBSERVE_SCALE_FLOOR)
+        args = ap.parse_args(argv[1:])
+        if args.pods is None and args.nodes is None:
+            return 0 if check_observe_path() else 1
+        return 0 if check_observe_scale(
+            args.pods or OBSERVE_SCALE_PODS,
+            args.nodes or OBSERVE_SCALE_NODES,
+            floor=args.floor) else 1
+    if argv and argv[0] == "fit_batch":
+        # Large-batch fit tier (ISSUE 6): python/kernel decision parity
+        # + speedup floor at --gangs scale; records BENCH_SCALE.json.
+        ap = argparse.ArgumentParser(prog="bench.py fit_batch")
+        ap.add_argument("--gangs", type=int,
+                        default=FIT_BATCH_SCALE_GANGS)
+        ap.add_argument("--floor", type=float,
+                        default=FIT_BATCH_SPEEDUP_FLOOR)
+        args = ap.parse_args(argv[1:])
+        ok, info = check_fit_batch(args.gangs, floor=args.floor)
+        print(json.dumps({
+            "metric": "fit_batch_speedup",
+            "value": info.get("speedup"),
+            "unit": "x_vs_python",
+            "vs_baseline": round((info.get("speedup") or 0)
+                                 / args.floor, 2),
+        }))
+        return 0 if ok else 1
     if argv and argv[0] == "actuate":
         # Actuation tier only (scripts/full_suite.sh): ~4 s (the serial
         # baseline honestly pays its 80 RTTs).  Emits the measured

@@ -106,4 +106,80 @@ int32_t fitpack_pack_ffd(const double* pods, int64_t n_pods, double* free,
   return static_cast<int32_t>(units.size() / 2);
 }
 
+// Multi-shape, K-axis, admission-aware first-fit packing (ISSUE 6):
+// the wide entry point behind engine/fitter.py::pack_cpu_pods_multi at
+// fleet scale.  The Python caller pre-sorts pods into FFD order (the
+// exact `sorted` call the reference path uses, so ordering semantics
+// can never drift) and pre-computes the template×node admission mask
+// (selectors + taints stay Python-authoritative); this kernel does the
+// numeric inner loop — the O(pods × nodes) hot spot.
+//
+// pods:   N rows × K axes, ALREADY in first-fit-decreasing order.
+// tmpl:   N entries — admission-template id per pod (0..T-1).
+// free:   F rows × K axes — existing nodes' free capacity, mutated.
+// admit:  T×F bytes — nonzero iff template t may land on free-node f.
+// shapes: S rows × K axes — capacity of one new node per shape, tried
+//         in the caller's order (smallest machine first).
+// placed: N entries out — -2 existing node (free row untracked),
+//         >=0 index of opened unit, -1 unplaceable.
+// unit_shape: out, shape index per opened unit (capacity N).
+// Returns the number of new units opened.
+int32_t fitpack_pack_ffd_multi(const double* pods, int64_t n_pods,
+                               int64_t k, const int32_t* tmpl,
+                               double* free_caps, int64_t n_free,
+                               const uint8_t* admit, int64_t n_tmpl,
+                               const double* shapes, int64_t n_shapes,
+                               int32_t* placed, int32_t* unit_shape) {
+  (void)n_tmpl;
+  auto fits = [k](const double* need, const double* cap) {
+    for (int64_t a = 0; a < k; ++a) {
+      if (need[a] > 0 && need[a] > cap[a]) return false;
+    }
+    return true;
+  };
+  std::vector<double> units;  // remaining capacity per opened unit
+  int32_t n_units = 0;
+  for (int64_t p = 0; p < n_pods; ++p) {
+    const double* need = pods + p * k;
+    const uint8_t* row = admit + static_cast<int64_t>(tmpl[p]) * n_free;
+    bool done = false;
+    for (int64_t f = 0; f < n_free && !done; ++f) {
+      double* cap = free_caps + f * k;
+      if (row[f] && fits(need, cap)) {
+        for (int64_t a = 0; a < k; ++a) cap[a] -= need[a];
+        placed[p] = -2;
+        done = true;
+      }
+    }
+    // Previously opened units, in creation order (the Python path
+    // checks no admission here either: a planned node's labels are
+    // unknown pre-creation).
+    for (int32_t u = 0; u < n_units && !done; ++u) {
+      double* cap = units.data() + static_cast<int64_t>(u) * k;
+      if (fits(need, cap)) {
+        for (int64_t a = 0; a < k; ++a) cap[a] -= need[a];
+        placed[p] = u;
+        done = true;
+      }
+    }
+    if (!done) {
+      for (int64_t s = 0; s < n_shapes; ++s) {
+        const double* cap = shapes + s * k;
+        if (fits(need, cap)) {
+          placed[p] = n_units;
+          unit_shape[n_units] = static_cast<int32_t>(s);
+          units.resize(units.size() + k);
+          double* rem = units.data() + static_cast<int64_t>(n_units) * k;
+          for (int64_t a = 0; a < k; ++a) rem[a] = cap[a] - need[a];
+          ++n_units;
+          done = true;
+          break;
+        }
+      }
+    }
+    if (!done) placed[p] = -1;
+  }
+  return n_units;
+}
+
 }  // extern "C"

@@ -10,6 +10,9 @@
 #   4  deterministic-schedule race tier
 #   5  tracer-overhead gate (bench.py trace: traced observe/actuate
 #      within 5% of untraced — ISSUE 5)
+#   6  mega-cluster scale tiers (bench.py observe --pods 100000
+#      --nodes 10000 >= 20x indexed-vs-scan; fit_batch --gangs 8192
+#      zero decision mismatches + >= 2x — ISSUE 6)
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -19,19 +22,23 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/4] invariant analysis (--format=$fmt)"
+echo "== [1/5] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/4] mypy strict islands"
+echo "== [2/5] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/4] deterministic-schedule race tier"
+echo "== [3/5] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
 
-echo "== [4/4] tracer-overhead gate"
+echo "== [4/5] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
+
+echo "== [5/5] mega-cluster scale tiers"
+JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
+JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
 echo "CI GATE GREEN"

@@ -27,6 +27,18 @@ cd "$(dirname "$0")/.."
 # sub-second, so it runs before the test splits.
 JAX_PLATFORMS=cpu python bench.py observe
 
+# Mega-cluster observe tier (ISSUE 6): indexed informer reads
+# (unschedulable select + incremental CapacityView) vs the
+# snapshot-scan path at 100k pods / 10k nodes with 1% churn, explicit
+# >= 20x floor; the result is recorded in BENCH_SCALE.json.
+JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20
+
+# Large-batch fit tier (ISSUE 6): python vs batch-kernel (native, or
+# the vectorized jaxfit fallback) shape decisions at 8192 gangs — zero
+# decision mismatches, explicit >= 2x floor; recorded in
+# BENCH_SCALE.json.
+JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2
+
 # Actuation tier: pipelined executor (pooled dispatch + ONE batched
 # LIST poll) vs the serial blocking baseline at 64 in-flight / 16 new
 # provisions with 50 ms injected RTT must hold the >= 10x floor

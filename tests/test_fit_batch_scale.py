@@ -1,0 +1,168 @@
+"""Large-batch fit tests (ISSUE 6): the wide native pack kernel and the
+vectorized jaxfit batch scorer must agree decision-for-decision with
+the reference Python engine — the same zero-mismatch contract the
+bench gates (`bench.py fit_batch --gangs 8192`).
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+
+import pytest
+
+from tpu_autoscaler import native
+from tpu_autoscaler.engine.fitter import (
+    batch_choose_shapes,
+    choose_shape_for_gang,
+    pack_cpu_pods_multi,
+)
+from tpu_autoscaler.k8s.gangs import group_into_gangs
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.resources import ResourceVector
+from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+from tpu_autoscaler.topology.shapes import CpuShape
+
+needs_native = pytest.mark.skipif(not native.pack_multi_available(),
+                                  reason="native toolchain unavailable")
+
+
+def mkpod(i, cpu, mem_mi, sel=None, tol=None):
+    return Pod({"metadata": {"name": f"p{i}", "uid": f"u{i}"},
+                "spec": {"containers": [{"resources": {"requests": {
+                    "cpu": str(cpu), "memory": f"{mem_mi}Mi"}}}],
+                    "nodeSelector": sel or {},
+                    "tolerations": tol or []},
+                "status": {"phase": "Pending"}})
+
+
+def mknode(i, tainted=False):
+    return Node({"metadata": {"name": f"n{i}", "uid": f"nu{i}",
+                              "labels": {"zone": "a" if i % 2 else "b"}},
+                 "spec": {"taints": ([{"key": "k", "value": "v",
+                                       "effect": "NoSchedule"}]
+                                     if tainted else [])},
+                 "status": {"allocatable": {"cpu": "8", "memory": "16Gi",
+                                            "pods": "110"},
+                            "conditions": [{"type": "Ready",
+                                            "status": "True"}]}})
+
+
+SHAPES = [CpuShape("e2-standard-4", cpu_m=3_920, memory=13 * 1024**3),
+          CpuShape("n2-standard-16", cpu_m=15_890, memory=56 * 1024**3)]
+
+
+@needs_native
+class TestPackNativeParity:
+    @pytest.mark.parametrize("seed", [3, 11, 77, 1009])
+    def test_randomized_parity_with_python_path(self, seed):
+        """Counts, unplaceable set AND ORDER, and the mutated free map
+        must be identical between the Python loop and the native
+        kernel across randomized selector/taint/size mixes."""
+        rng = random.Random(seed)
+        for _trial in range(60):
+            pods = []
+            for i in range(rng.randint(0, 40)):
+                sel = ({"zone": rng.choice(["a", "b"])}
+                       if rng.random() < 0.4 else None)
+                tol = ([{"key": "k", "operator": "Exists"}]
+                       if rng.random() < 0.3 else None)
+                pods.append(mkpod(i, rng.choice(["250m", "1", "2", "7",
+                                                 "12", "30"]),
+                                  rng.choice([256, 1024, 4096, 60_000]),
+                                  sel, tol))
+            nodes = [mknode(i, tainted=rng.random() < 0.3)
+                     for i in range(rng.randint(0, 8))]
+            nbn = {n.name: n for n in nodes}
+            free_py = {n.name: ResourceVector(
+                {"cpu": rng.choice(["2", "4", "8"]), "memory": "8Gi",
+                 "pods": "110"}) for n in nodes}
+            free_nat = copy.deepcopy(free_py)
+            c_py, u_py = pack_cpu_pods_multi(list(pods), free_py,
+                                             SHAPES, nbn)
+            c_nat, u_nat = pack_cpu_pods_multi(
+                list(pods), free_nat, SHAPES, nbn, native_threshold=0)
+            assert c_py == c_nat
+            assert [p.name for p in u_py] == [p.name for p in u_nat]
+            assert free_py == free_nat
+
+    def test_threshold_gates_the_kernel(self, monkeypatch):
+        calls = []
+        real = native.pack_ffd_multi
+
+        def counting(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(native, "pack_ffd_multi", counting)
+        pods = [mkpod(i, "1", 512) for i in range(8)]
+        pack_cpu_pods_multi(list(pods), {}, SHAPES,
+                            native_threshold=100)
+        assert not calls  # below threshold: pure Python
+        pack_cpu_pods_multi(list(pods), {}, SHAPES, native_threshold=4)
+        assert len(calls) == 1
+
+    def test_admission_mask_is_honored(self):
+        """A pod whose selector no free node satisfies must open a new
+        unit on both paths, never land on the rejecting node."""
+        pod = mkpod(0, "1", 512, sel={"zone": "a"})
+        node_b = mknode(0)  # even index -> zone "b": rejects the pod
+        free = {node_b.name: ResourceVector({"cpu": "8",
+                                             "memory": "8Gi"})}
+        nbn = {node_b.name: node_b}
+        c_nat, u_nat = pack_cpu_pods_multi(
+            [pod], dict(free), SHAPES, nbn, native_threshold=0)
+        c_py, u_py = pack_cpu_pods_multi([pod], dict(free), SHAPES, nbn)
+        assert c_nat == c_py == {"e2-standard-4": 1}
+        assert not u_nat and not u_py
+
+
+class TestJaxfitBackendParity:
+    def _gangs(self, n=96):
+        mixes = [(8, 1), (4, 4), (4, 16), (1, 3), (4, 64), (4, 32)]
+        pods = []
+        for i in range(n):
+            per, cnt = mixes[i % len(mixes)]
+            pods += [Pod({"metadata": {
+                "name": f"g{i}-p{j}", "uid": f"g{i}-p{j}",
+                "labels": {"batch.kubernetes.io/job-name": f"g{i}"}},
+                "spec": {"containers": [{"resources": {"requests": {
+                    TPU_RESOURCE: str(per)}}}]},
+                "status": {"phase": "Pending"}})
+                for j in range(cnt)]
+        return group_into_gangs(pods)
+
+    def test_jaxfit_matches_python_decisions(self):
+        gangs = self._gangs()
+        py = {g.key: choose_shape_for_gang(g, "v5e") for g in gangs}
+        jx = batch_choose_shapes(gangs, "v5e", backend="jaxfit")
+        assert len(jx) == len(gangs)
+        for key, choice in jx.items():
+            assert (choice.shape.name, choice.stranded_chips) == \
+                (py[key].shape.name, py[key].stranded_chips)
+
+    def test_jaxfit_matches_native_when_available(self):
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        gangs = self._gangs()
+        nat = batch_choose_shapes(gangs, "v5e", backend="native")
+        jx = batch_choose_shapes(gangs, "v5e", backend="jaxfit")
+        assert {k: (c.shape.name, c.stranded_chips)
+                for k, c in nat.items()} \
+            == {k: (c.shape.name, c.stranded_chips)
+                for k, c in jx.items()}
+
+    def test_pinned_and_fractional_gangs_fall_through(self):
+        from tpu_autoscaler.topology.catalog import ACCELERATOR_LABEL
+
+        pinned = Pod({"metadata": {
+            "name": "pin", "uid": "pin",
+            "labels": {"batch.kubernetes.io/job-name": "pin"}},
+            "spec": {"nodeSelector": {
+                ACCELERATOR_LABEL: "tpu-v5-lite-podslice"},
+                "containers": [{"resources": {"requests": {
+                    TPU_RESOURCE: "4"}}}]},
+            "status": {"phase": "Pending"}})
+        gangs = group_into_gangs([pinned])
+        assert batch_choose_shapes(gangs, "v5e",
+                                   backend="jaxfit") == {}

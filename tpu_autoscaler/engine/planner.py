@@ -538,7 +538,8 @@ class Planner:
             free_cpu.update(c_leftovers)
         counts, unplaceable = pack_cpu_pods_multi(
             pending_cpu, free_cpu, cpu_shapes,
-            nodes_by_name={n.name: n for n in cpu_nodes})
+            nodes_by_name={n.name: n for n in cpu_nodes},
+            native_threshold=pol.native_fit_threshold)
         for machine, n_new in c_counts.items():
             counts[machine] = counts.get(machine, 0) + n_new
         unplaceable = list(unplaceable) + c_unplaceable

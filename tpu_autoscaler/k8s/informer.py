@@ -41,11 +41,20 @@ from the ``concurrency`` seam so the deterministic-schedule harness
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import random
 import threading
 import time
-from typing import TYPE_CHECKING, Any, Callable, Iterable, Mapping
+from typing import (
+    TYPE_CHECKING,
+    Any,
+    Callable,
+    Hashable,
+    Iterable,
+    Mapping,
+    Sequence,
+)
 
 from tpu_autoscaler import concurrency
 from tpu_autoscaler.backoff import watch_backoff_seconds
@@ -68,6 +77,27 @@ class WatchError(RuntimeError):
     """An ERROR event on an otherwise-open stream (non-410)."""
 
 
+#: An indexer maps one parsed object to the index keys it files under
+#: (zero or more; e.g. a pod's phase, its gang key, its node).
+Indexer = Callable[[Any], Iterable[Hashable]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Fold:
+    """An incrementally-maintained aggregate over the cache's objects.
+
+    ``key`` buckets each object (None = excluded); ``value`` is summed
+    into the bucket with ``+`` on insert and removed with ``-`` on
+    delete, so bucket totals stay current per watch delta instead of
+    being recomputed by scanning the store.  ``zero`` is the empty
+    aggregate — a bucket returning to it is dropped.
+    """
+
+    key: Callable[[Any], Hashable | None]
+    value: Callable[[Any], Any]
+    zero: Callable[[], Any]
+
+
 class ObjectCache:
     """Lock-guarded store of one resource's payloads + parsed objects.
 
@@ -75,17 +105,54 @@ class ObjectCache:
     mark_unsynced); read by the reconcile thread (snapshot).  ``synced``
     is False until the first successful relist and again after any
     watch failure — readers fall back to a direct LIST while unsynced.
+
+    Secondary indices (ISSUE 6): ``indexers`` file every object under
+    derived keys, maintained *incrementally* in ``apply``/``replace``
+    under the same lock as the primary store — so index reads
+    (``select``) are O(result), never O(store).  Each index bucket also
+    carries a 64-bit XOR **digest** of its members' (key,
+    resourceVersion) pairs: XOR is exact and order-free, so the digest
+    is maintainable per delta and two equal digests mean the bucket's
+    membership+versions are (collision-probability aside) unchanged —
+    the primitive the reconciler's delta-driven planning hashes against.
+    ``folds`` maintain numeric aggregates (e.g. requested resources per
+    node) the same way.  Consumers registered via ``watch_dirty`` get
+    the set of dirty tags (e.g. node names) touched since their last
+    ``drain_dirty`` — None after a relist/unsync, meaning rebuild.
     """
 
     def __init__(self, kind: str,
-                 parse: Callable[[Mapping[str, Any]], Any]) -> None:
+                 parse: Callable[[Mapping[str, Any]], Any],
+                 indexers: Mapping[str, Indexer] | None = None,
+                 folds: Mapping[str, Fold] | None = None,
+                 dirty_tags: Callable[[Any], Iterable[Hashable]]
+                 | None = None,
+                 reserve: Callable[[int], None] | None = None) -> None:
         self.kind = kind
         self._parse = parse
-        self._lock = concurrency.Lock()
+        # Re-entrant: the index-maintenance helpers (_index_add/_remove,
+        # _rebuild_indices) take the lock themselves so every index
+        # mutation is lexically guarded, and their callers (apply/
+        # replace) hold it around the whole store+index update so the
+        # two can never be observed out of step.
+        self._lock = concurrency.RLock()
         self._objects: dict[str, dict] = {}
         self._parsed: dict[str, Any] = {}
         self._resource_version: str | None = None
         self._synced = False
+        self._indexers: dict[str, Indexer] = dict(indexers or {})
+        self._indices: dict[str, dict[Hashable, dict[str, Any]]] = {
+            name: {} for name in self._indexers}
+        self._idx_digests: dict[str, dict[Hashable, int]] = {
+            name: {} for name in self._indexers}
+        self._fold_defs: dict[str, Fold] = dict(folds or {})
+        self._fold_state: dict[str, dict[Hashable, Any]] = {
+            name: {} for name in self._fold_defs}
+        self._dirty_tags = dirty_tags
+        # consumer -> set of tags touched since its last drain; None =
+        # a replace()/mark_unsynced() happened (full rebuild required).
+        self._dirty: dict[str, set[Hashable] | None] = {}
+        self._reserve = reserve
 
     @staticmethod
     def _key(obj: Mapping[str, Any]) -> str | None:
@@ -102,9 +169,89 @@ class ObjectCache:
         with self._lock:
             return self._resource_version
 
+    # -- index maintenance (self._lock is re-entrant: callers hold it
+    #    around the whole store+index update, and each helper takes it
+    #    again so every index mutation is lexically lock-guarded) ------
+
+    @staticmethod
+    def _contrib(key: str, parsed: Any) -> int:
+        """One object's XOR contribution to its buckets' digests."""
+        return hash((key, getattr(parsed, "resource_version", None)))
+
+    def _index_add(self, key: str, parsed: Any) -> None:
+        contrib = self._contrib(key, parsed)
+        with self._lock:
+            for name, indexer in self._indexers.items():
+                index = self._indices[name]
+                digests = self._idx_digests[name]
+                for ikey in indexer(parsed):
+                    index.setdefault(ikey, {})[key] = parsed
+                    digests[ikey] = digests.get(ikey, 0) ^ contrib
+            for name, fold in self._fold_defs.items():
+                fkey = fold.key(parsed)
+                if fkey is None:
+                    continue
+                state = self._fold_state[name]
+                cur = state.get(fkey)
+                val = fold.value(parsed)
+                state[fkey] = val if cur is None else cur + val
+            if self._dirty_tags is not None and self._dirty:
+                targets = [p for p in self._dirty.values()
+                           if p is not None]
+                if targets:
+                    tags = tuple(self._dirty_tags(parsed))
+                    for pending in targets:
+                        pending.update(tags)
+
+    def _index_remove(self, key: str, parsed: Any) -> None:
+        contrib = self._contrib(key, parsed)
+        with self._lock:
+            for name, indexer in self._indexers.items():
+                index = self._indices[name]
+                digests = self._idx_digests[name]
+                for ikey in indexer(parsed):
+                    bucket = index.get(ikey)
+                    if bucket is not None:
+                        bucket.pop(key, None)
+                        if not bucket:
+                            del index[ikey]
+                            digests.pop(ikey, None)
+                            continue
+                    digests[ikey] = digests.get(ikey, 0) ^ contrib
+            for name, fold in self._fold_defs.items():
+                fkey = fold.key(parsed)
+                if fkey is None:
+                    continue
+                state = self._fold_state[name]
+                if fkey in state:
+                    state[fkey] = state[fkey] - fold.value(parsed)
+                    if state[fkey] == fold.zero():
+                        del state[fkey]
+            if self._dirty_tags is not None and self._dirty:
+                targets = [p for p in self._dirty.values()
+                           if p is not None]
+                if targets:
+                    tags = tuple(self._dirty_tags(parsed))
+                    for pending in targets:
+                        pending.update(tags)
+
+    def _rebuild_indices(self) -> None:
+        with self._lock:
+            self._indices = {name: {} for name in self._indexers}
+            self._idx_digests = {name: {} for name in self._indexers}
+            self._fold_state = {name: {} for name in self._fold_defs}
+            for key, parsed in self._parsed.items():
+                self._index_add(key, parsed)
+
+    # -- writes (the resource's watch thread) ----------------------------
+
     def replace(self, items: Iterable[dict],
                 resource_version: str | None) -> None:
-        """Install a full LIST result (relist / initial sync)."""
+        """Install a full LIST result (relist / initial sync).
+
+        ``items`` may be any iterable (generators included) — payloads
+        are consumed streaming, never materialized as a second list.
+        """
         objects: dict[str, dict] = {}
         parsed: dict[str, Any] = {}
         for item in items:
@@ -115,11 +262,21 @@ class ObjectCache:
             # Memoized on (uid, resourceVersion): a relist re-parses
             # only objects that actually changed since last seen.
             parsed[key] = self._parse(item)
+        if self._reserve is not None:
+            # Size the parse memo for this store (k8s/objects.py): a
+            # fixed bound thrashes once the store outgrows it.
+            self._reserve(len(objects))
         with self._lock:
             self._objects = objects
             self._parsed = parsed
             self._resource_version = resource_version
             self._synced = True
+            # Null the consumers FIRST: the world was replaced, so
+            # they rebuild regardless — _index_add skips None entries,
+            # sparing O(store) tag-set updates per consumer.
+            for consumer in self._dirty:
+                self._dirty[consumer] = None
+            self._rebuild_indices()
 
     def apply(self, event: Mapping[str, Any]) -> bool:
         """Apply one watch event; True iff it changed relevant state.
@@ -138,15 +295,21 @@ class ObjectCache:
         if etype in ("ADDED", "MODIFIED") and key is not None:
             parsed = self._parse(obj)
             with self._lock:
+                old = self._parsed.get(key)
+                if old is not None:
+                    self._index_remove(key, old)
                 self._objects[key] = dict(obj)
                 self._parsed[key] = parsed
+                self._index_add(key, parsed)
                 if rv:
                     self._resource_version = rv
             return True
         with self._lock:
             if etype == "DELETED" and key is not None:
+                old = self._parsed.pop(key, None)
                 self._objects.pop(key, None)
-                self._parsed.pop(key, None)
+                if old is not None:
+                    self._index_remove(key, old)
             if rv:
                 # BOOKMARK (and DELETED) keep the cursor fresh.
                 self._resource_version = rv
@@ -162,6 +325,10 @@ class ObjectCache:
         with self._lock:
             self._synced = False
             self._resource_version = None
+            for consumer in self._dirty:
+                self._dirty[consumer] = None  # gap of unknown size
+
+    # -- reads (the reconcile thread) ------------------------------------
 
     def snapshot(self) -> list[Any] | None:
         """Parsed objects as an immutable-by-convention list, or None
@@ -171,9 +338,300 @@ class ObjectCache:
                 return None
             return list(self._parsed.values())
 
+    def select(self, index: str, ikey: Hashable) -> list[Any] | None:
+        """Objects filed under ``ikey`` in ``index`` — O(result), or
+        None when unsynced (caller falls back to a scan)."""
+        with self._lock:
+            if not self._synced:
+                return None
+            bucket = self._indices[index].get(ikey)
+            return list(bucket.values()) if bucket else []
+
+    def select_many(self, index: str, ikeys: Sequence[Hashable]
+                    ) -> list[list[Any]] | None:
+        """Bulk ``select`` under ONE lock acquisition (the per-call
+        lock round-trip dominates at fleet-scale churn), or None when
+        unsynced."""
+        with self._lock:
+            if not self._synced:
+                return None
+            buckets = self._indices[index]
+            return [list(b.values()) if (b := buckets.get(k)) else []
+                    for k in ikeys]
+
+    def snapshot_and_select(self, index: str, ikey: Hashable
+                            ) -> tuple[list[Any], list[Any]] | None:
+        """A full snapshot plus one index bucket, consistent with each
+        other (single lock acquisition), or None when unsynced."""
+        with self._lock:
+            if not self._synced:
+                return None
+            bucket = self._indices[index].get(ikey)
+            return (list(self._parsed.values()),
+                    list(bucket.values()) if bucket else [])
+
+    def index_keys(self, index: str) -> list[Hashable] | None:
+        with self._lock:
+            if not self._synced:
+                return None
+            return list(self._indices[index])
+
+    def digest(self, index: str, ikey: Hashable) -> int:
+        """The bucket's XOR membership digest (0 when empty/absent)."""
+        with self._lock:
+            return self._idx_digests[index].get(ikey, 0)
+
+    def digests(self, index: str,
+                ikeys: Sequence[Hashable]) -> list[int]:
+        """Bulk ``digest`` under one lock acquisition."""
+        with self._lock:
+            digests = self._idx_digests[index]
+            return [digests.get(k, 0) for k in ikeys]
+
+    def fold_value(self, name: str, key: Hashable,
+                   default: Any = None) -> Any:
+        with self._lock:
+            return self._fold_state[name].get(key, default)
+
+    def fold_values(self, name: str, keys: Sequence[Hashable],
+                    default: Any = None) -> list[Any]:
+        with self._lock:
+            state = self._fold_state[name]
+            return [state.get(k, default) for k in keys]
+
+    def watch_dirty(self, consumer: str) -> None:
+        """Register ``consumer`` for dirty-tag tracking (starts in the
+        rebuild-required state)."""
+        with self._lock:
+            self._dirty[consumer] = None
+
+    def unwatch_dirty(self, consumer: str) -> None:
+        """Deregister a dirty-tag consumer (every registration costs
+        O(1) tag-set work per delta, so abandoned views must detach)."""
+        with self._lock:
+            self._dirty.pop(consumer, None)
+
+    def drain_dirty(self, consumer: str) -> set[Hashable] | None:
+        """Tags touched since the consumer's last drain, or None when a
+        replace/unsync invalidated everything (full rebuild needed)."""
+        with self._lock:
+            pending = self._dirty.get(consumer)
+            self._dirty[consumer] = set()
+            return pending
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._objects)
+
+
+# ---- standard index configuration --------------------------------------
+#
+# The reconciler's demand/supply queries, expressed as indices so a pass
+# reads exactly what it needs instead of scanning a full snapshot:
+# pods by phase / gang key / node / unschedulability; nodes by supply
+# pool / accelerator class / name / readiness.  The ``usage`` fold keeps
+# per-node requested resources current per delta — free capacity becomes
+# an O(nodes-of-interest) read instead of an O(pods) scan.
+
+#: Index-key sentinel for the unschedulable-pods bucket.
+PENDING = "pending"
+
+
+def _unit_key_of(node: Any) -> str:
+    """The node's supply-unit key — the k8s/units.py grouping rule."""
+    from tpu_autoscaler.topology.catalog import SLICE_ID_LABEL
+
+    if node.is_tpu and node.slice_id:
+        return node.slice_id
+    return node.labels.get(SLICE_ID_LABEL) or node.name
+
+
+def _accel_class_of(node: Any) -> str:
+    """Supply class for delta-planning digests: the accelerator label
+    for TPU nodes, 'cpu' for everything else."""
+    return node.tpu_accelerator or ("cpu" if not node.is_tpu else "tpu")
+
+
+def make_pod_cache(reserve: Callable[[int], None] | None = None
+                   ) -> ObjectCache:
+    from tpu_autoscaler.k8s.objects import parse_pod
+    from tpu_autoscaler.k8s.resources import ResourceVector
+
+    def usage_key(p):
+        return (p.node_name
+                if p.node_name and p.phase in ("Pending", "Running")
+                else None)
+
+    return ObjectCache(
+        "pods", parse_pod,
+        indexers={
+            "phase": lambda p: (p.phase,) if p.phase else (),
+            "gang": lambda p: (p.gang_key,),
+            "node": lambda p: (p.node_name,) if p.node_name else (),
+            "unschedulable": lambda p: ((PENDING,) if p.is_unschedulable
+                                        else ()),
+        },
+        folds={"usage": Fold(key=usage_key, value=lambda p: p.resources,
+                             zero=ResourceVector)},
+        dirty_tags=lambda p: (p.node_name,) if p.node_name else (),
+        reserve=reserve)
+
+
+def make_node_cache(reserve: Callable[[int], None] | None = None
+                    ) -> ObjectCache:
+    from tpu_autoscaler.k8s.objects import parse_node
+
+    return ObjectCache(
+        "nodes", parse_node,
+        indexers={
+            "name": lambda n: (n.name,),
+            "pool": lambda n: (_unit_key_of(n),),
+            "accel": lambda n: (_accel_class_of(n),),
+            "ready": lambda n: (bool(n.is_ready
+                                     and not n.unschedulable),),
+        },
+        dirty_tags=lambda n: (n.name,),
+        reserve=reserve)
+
+
+@dataclasses.dataclass
+class PoolState:
+    """One supply pool's incrementally-maintained summary."""
+
+    nodes: set = dataclasses.field(default_factory=set)
+    tpu: bool = False
+    chips: float = 0.0       # allocatable TPU chips across the pool
+    ready: int = 0           # Ready + schedulable member count
+    used_chips: float = 0.0  # TPU chips requested by bound pods
+
+    @property
+    def free_slice(self) -> bool:
+        """Mirrors planner._free_slices: every host Ready, schedulable,
+        and chip-idle (chip counts are integers, so the incremental
+        float arithmetic is exact)."""
+        return (self.tpu and self.nodes
+                and self.ready == len(self.nodes)
+                and self.used_chips == 0)
+
+
+class CapacityView:
+    """Per-node free capacity + per-pool summary, maintained O(churn).
+
+    Owned by ONE consumer thread (the reconcile loop, or a bench): each
+    ``refresh()`` drains the caches' dirty-tag sets (node names touched
+    since last time) and recomputes only those nodes' entries and their
+    pools' summaries; a relist/unsync rebuilds from scratch.  Between
+    refreshes the view's dicts are plain thread-local state.
+
+    ``free`` matches ``engine.fitter.free_capacity(nodes, pods)`` up to
+    float associativity on the cpu/memory axes (the ``usage`` fold adds
+    and subtracts per delta instead of re-summing); chip axes are
+    integral and therefore exact — which is what ``free_slice`` keys on.
+    """
+
+    _SEQ = [0]
+
+    def __init__(self, node_cache: ObjectCache,
+                 pod_cache: ObjectCache) -> None:
+        self._node_cache = node_cache
+        self._pod_cache = pod_cache
+        CapacityView._SEQ[0] += 1
+        self._consumer = f"capacity-{CapacityView._SEQ[0]}"
+        node_cache.watch_dirty(self._consumer)
+        pod_cache.watch_dirty(self._consumer)
+        #: node name -> free ResourceVector (Ready + schedulable only)
+        self.free: dict[str, Any] = {}
+        #: pool key -> PoolState
+        self.pools: dict[str, PoolState] = {}
+        self._node_pool: dict[str, str] = {}  # name -> its pool key
+
+    def close(self) -> None:
+        """Detach from the caches (a dangling registration would keep
+        costing tag-set work on every delta forever)."""
+        self._node_cache.unwatch_dirty(self._consumer)
+        self._pod_cache.unwatch_dirty(self._consumer)
+
+    def refresh(self) -> bool:
+        """Fold pending churn into the view; False when either cache is
+        unsynced (view contents are then stale — don't use them)."""
+        if not (self._node_cache.synced and self._pod_cache.synced):
+            # Leave the dirty sets accumulating; the next synced refresh
+            # sees None (the unsync reset them) and rebuilds.
+            return False
+        node_dirty = self._node_cache.drain_dirty(self._consumer)
+        pod_dirty = self._pod_cache.drain_dirty(self._consumer)
+        if node_dirty is None or pod_dirty is None:
+            names = self._node_cache.index_keys("name")
+            if names is None:
+                return False
+            self.free.clear()
+            self.pools.clear()
+            self._node_pool.clear()
+            dirty = set(names)
+        else:
+            dirty = node_dirty | pod_dirty
+        if dirty:
+            self._refresh_nodes(list(dirty))
+        return True
+
+    def _refresh_nodes(self, names: list[str]) -> None:
+        """Recompute the dirty nodes' free entries and recount each
+        affected pool ONCE, with bulk (single-lock) cache reads — the
+        per-call lock round-trip is the refresh cost at churn scale."""
+        hits = self._node_cache.select_many("name", names) or []
+        usages = self._pod_cache.fold_values("usage", names)
+        touched_pools: set[str] = set()
+        nodes_by_name: dict[str, Any] = {}
+        for name, node_hits, usage in zip(names, hits, usages):
+            node = node_hits[0] if node_hits else None
+            nodes_by_name[name] = node
+            old_pool = self._node_pool.pop(name, None)
+            if old_pool is not None:
+                touched_pools.add(old_pool)
+            self.free.pop(name, None)
+            if node is None:
+                continue  # deleted (or a pod's node never existed)
+            if node.is_ready and not node.unschedulable:
+                self.free[name] = (node.allocatable - usage
+                                   if usage is not None
+                                   else node.allocatable)
+            pool_key = _unit_key_of(node)
+            self._node_pool[name] = pool_key
+            touched_pools.add(pool_key)
+        if touched_pools:
+            self._recount_pools(sorted(touched_pools))
+
+    def _recount_pools(self, pool_keys: list[str]) -> None:
+        from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+        member_lists = (self._node_cache.select_many("pool", pool_keys)
+                        or [[] for _ in pool_keys])
+        all_names = [n.name for members in member_lists for n in members]
+        usage_by_name = dict(zip(
+            all_names, self._pod_cache.fold_values("usage", all_names)))
+        for pool_key, members in zip(pool_keys, member_lists):
+            if not members:
+                self.pools.pop(pool_key, None)
+                continue
+            pool = self.pools.setdefault(pool_key, PoolState())
+            pool.nodes = set()
+            pool.tpu = False
+            pool.chips = 0.0
+            pool.ready = 0
+            pool.used_chips = 0.0
+            for node in members:
+                pool.nodes.add(node.name)
+                pool.tpu = pool.tpu or node.is_tpu
+                pool.chips += node.allocatable.get(TPU_RESOURCE)
+                if node.is_ready and not node.unschedulable:
+                    pool.ready += 1
+                usage = usage_by_name.get(node.name)
+                if usage is not None:
+                    pool.used_chips += usage.get(TPU_RESOURCE)
+
+    def free_slices(self) -> set[str]:
+        """Pool keys whose every host is Ready, schedulable, chip-idle."""
+        return {key for key, pool in self.pools.items() if pool.free_slice}
 
 
 class ResourceWatch(concurrency.Thread):
@@ -237,6 +695,17 @@ class ResourceWatch(concurrency.Thread):
                 self._tracer.annotate(span, objects=len(items))
         self._cache.replace(items, rv)
         self._inc("informer_relists")
+        if self._metrics is not None:
+            # Memo health after the relist: a hit rate sinking toward 0
+            # at steady state means the LRU is undersized for the store
+            # (docs/OPERATIONS.md).
+            from tpu_autoscaler.k8s.objects import parse_cache_info
+
+            info = parse_cache_info()
+            self._metrics.set_gauge("parse_cache_entries",
+                                    info["pods"] + info["nodes"])
+            self._metrics.set_gauge("parse_cache_hit_rate",
+                                    info["hit_rate"])
         self._last_relist_mono = time.monotonic()  # analysis: allow=TAR503 pump() is the threadless drive mode and is never mixed with start() (see pump docstring)
         if self._wake is not None:
             # The world may have changed arbitrarily across the gap.
@@ -312,6 +781,13 @@ class ClusterInformer:
     not — never worse than the relist-every-pass baseline.  The node
     watch is optional: against a client with only ``watch_pods`` the
     pod side is cached and node reads always fall back.
+
+    On top of the snapshots, the caches carry the standard secondary
+    indices (``make_pod_cache``/``make_node_cache``): the reconciler
+    pulls Unschedulable pods (``pods_and_pending``), per-node pod
+    digests (``pod_node_digests``) and per-accelerator-class supply
+    digests (``supply_digests``) without scanning the store, and the
+    memoized ``capacity_view()`` serves free capacity O(churn).
     """
 
     def __init__(self, client, wake: threading.Event | None = None,
@@ -320,13 +796,17 @@ class ClusterInformer:
                  resync_seconds: float = 900.0,
                  rng: random.Random | None = None,
                  tracer=None):
-        from tpu_autoscaler.k8s.objects import parse_node, parse_pod
+        import functools
+
+        from tpu_autoscaler.k8s.objects import reserve_parse_cache
 
         self._client = client
         self._metrics = metrics
         self.wake = wake if wake is not None else concurrency.Event()
-        self.pod_cache = ObjectCache("pods", parse_pod)
-        self.node_cache = ObjectCache("nodes", parse_node)
+        self.pod_cache = make_pod_cache(
+            reserve=functools.partial(reserve_parse_cache, "pods"))
+        self.node_cache = make_node_cache(
+            reserve=functools.partial(reserve_parse_cache, "nodes"))
         self._watches: list[ResourceWatch] = []
         if hasattr(client, "watch_pods"):
             self._watches.append(ResourceWatch(
@@ -382,3 +862,55 @@ class ClusterInformer:
             self._metrics.inc("informer_fallback_lists")
         parse = parse_pod if kind == "pods" else parse_node
         return [parse(p) for p in getattr(self._client, f"list_{kind}")()]
+
+    # -- indexed reads (ISSUE 6) -----------------------------------------
+
+    def pods_and_pending(self):
+        """(all pods, Unschedulable pods) — mutually consistent (one
+        lock hold), with the pending side read from the index instead
+        of a full-store scan.  Falls back to LIST + scan when unsynced.
+        """
+        both = self.pod_cache.snapshot_and_select("unschedulable",
+                                                  PENDING)
+        if both is not None:
+            return both
+        pods = self._fallback("pods")
+        return pods, [p for p in pods if p.is_unschedulable]
+
+    def pod_node_digests(self, names: Sequence[str]) -> list[int] | None:
+        """Per-node pod-membership digests (None while unsynced) — the
+        O(1)-per-node input to delta-planning supply digests."""
+        if not self.pod_cache.synced:
+            return None
+        return self.pod_cache.digests("node", names)
+
+    def supply_digests(self, nodes) -> dict[str, int] | None:
+        """Per-accelerator-class digest of supply-relevant state: every
+        node's (uid, resourceVersion, readiness, cordon) plus the
+        digest of the pods bound to it.  A gang's candidate supply is
+        an accelerator class (or 'cpu'), so comparing these across
+        passes detects any change that could alter that gang's plan —
+        in O(nodes), with the pod side O(1) per node via the index.
+        None while the pod cache is unsynced (caller plans fully).
+        """
+        pod_digests = self.pod_node_digests([n.name for n in nodes])
+        if pod_digests is None:
+            return None
+        out: dict[str, int] = {}
+        for node, pod_digest in zip(nodes, pod_digests):
+            accel = _accel_class_of(node)
+            contrib = hash((node.uid or node.name, node.resource_version,
+                            node.is_ready, node.unschedulable))
+            out[accel] = out.get(accel, 0) ^ contrib ^ pod_digest
+        return out
+
+    def capacity_view(self) -> CapacityView:
+        """THE informer's incrementally-maintained capacity view
+        (single-consumer; call ``refresh()`` per pass).  Memoized: each
+        CapacityView registers a dirty-tag consumer on both caches, so
+        a view-per-call would leak tag-set work on every delta."""
+        view = getattr(self, "_capacity_view", None)
+        if view is None:
+            view = self._capacity_view = CapacityView(self.node_cache,
+                                                      self.pod_cache)
+        return view

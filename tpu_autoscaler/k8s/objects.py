@@ -71,6 +71,7 @@ class Pod:
         self.name: str = meta.get("name", "")
         self.namespace: str = meta.get("namespace", "default")
         self.uid: str = meta.get("uid", "")
+        self.resource_version: str | None = meta.get("resourceVersion")
         self.labels: dict[str, str] = dict(meta.get("labels") or {})
         self.annotations: dict[str, str] = dict(meta.get("annotations") or {})
         self.created: datetime.datetime | None = parse_time(
@@ -258,6 +259,7 @@ class Node:
         meta = payload.get("metadata", {})
         self.name: str = meta.get("name", "")
         self.uid: str = meta.get("uid", "")
+        self.resource_version: str | None = meta.get("resourceVersion")
         self.labels: dict[str, str] = dict(meta.get("labels") or {})
         self.annotations: dict[str, str] = dict(meta.get("annotations") or {})
         self.created: datetime.datetime | None = parse_time(
@@ -400,8 +402,16 @@ class Node:
 # The caches are bounded LRU (eviction on insert) and guarded by one
 # lock: the informer's watch threads parse at delta-apply time while
 # the reconcile thread parses on its fallback/refresh LIST path.
+#
+# Sizing: a fixed bound thrashes at mega-cluster scale — with 100k pods
+# in the informer store, a 16k memo evicts every entry before its next
+# hit and silently turns into a miss machine.  The informer therefore
+# reports its store size through ``reserve_parse_cache`` after every
+# relist and the bound tracks 2x the largest store seen (headroom for
+# one full version churn), never below the floor.  Hit/miss counters
+# feed the ``parse_cache_hit_rate`` gauge (docs/OPERATIONS.md).
 
-_PARSE_CACHE_MAX = 16384
+_PARSE_CACHE_FLOOR = 16384
 
 _T = TypeVar("_T", "Pod", "Node")
 
@@ -410,9 +420,27 @@ _pod_cache: collections.OrderedDict[tuple[str, str], Pod] = \
     collections.OrderedDict()
 _node_cache: collections.OrderedDict[tuple[str, str], Node] = \
     collections.OrderedDict()
+_parse_limits: dict[str, int] = {"pods": _PARSE_CACHE_FLOOR,
+                                 "nodes": _PARSE_CACHE_FLOOR}
+_parse_hits: dict[str, int] = {"pods": 0, "nodes": 0}
+_parse_misses: dict[str, int] = {"pods": 0, "nodes": 0}
 
 
-def _parse_memoized(cache: collections.OrderedDict[tuple[str, str], _T],
+def reserve_parse_cache(kind: str, store_size: int) -> None:
+    """Size ``kind``'s memo for a store of ``store_size`` objects.
+
+    Called by the informer after a relist; the bound only ratchets up
+    (2x the largest store seen, floor ``_PARSE_CACHE_FLOOR``) so a
+    transiently small LIST can't shrink a warm cache.
+    """
+    want = max(_PARSE_CACHE_FLOOR, 2 * int(store_size))
+    with _parse_lock:
+        if want > _parse_limits.get(kind, 0):
+            _parse_limits[kind] = want
+
+
+def _parse_memoized(kind: str,
+                    cache: collections.OrderedDict[tuple[str, str], _T],
                     cls: type[_T], payload: Mapping[str, Any]) -> _T:
     meta = payload.get("metadata") or {}
     uid = meta.get("uid")
@@ -423,35 +451,49 @@ def _parse_memoized(cache: collections.OrderedDict[tuple[str, str], _T],
     with _parse_lock:
         hit = cache.get(key)
         if hit is not None:
+            _parse_hits[kind] += 1
             cache.move_to_end(key)
             return hit
+        _parse_misses[kind] += 1
     obj = cls(payload)
     with _parse_lock:
         cache[key] = obj
         cache.move_to_end(key)
-        while len(cache) > _PARSE_CACHE_MAX:
+        limit = _parse_limits[kind]
+        while len(cache) > limit:
             cache.popitem(last=False)
     return obj
 
 
 def parse_pod(payload: Mapping[str, Any]) -> Pod:
     """Dict → ``Pod``, memoized on (uid, resourceVersion)."""
-    return _parse_memoized(_pod_cache, Pod, payload)
+    return _parse_memoized("pods", _pod_cache, Pod, payload)
 
 
 def parse_node(payload: Mapping[str, Any]) -> Node:
     """Dict → ``Node``, memoized on (uid, resourceVersion)."""
-    return _parse_memoized(_node_cache, Node, payload)
+    return _parse_memoized("nodes", _node_cache, Node, payload)
 
 
-def parse_cache_info() -> dict[str, int]:
-    """Current cache sizes (tests + the observe-path bench)."""
+def parse_cache_info() -> dict[str, float]:
+    """Cache sizes, limits, and hit/miss counts (tests, the observe
+    bench, and the informer's ``parse_cache_hit_rate`` gauge)."""
     with _parse_lock:
-        return {"pods": len(_pod_cache), "nodes": len(_node_cache)}
+        hits = _parse_hits["pods"] + _parse_hits["nodes"]
+        misses = _parse_misses["pods"] + _parse_misses["nodes"]
+        return {"pods": len(_pod_cache), "nodes": len(_node_cache),
+                "pods_limit": _parse_limits["pods"],
+                "nodes_limit": _parse_limits["nodes"],
+                "hits": hits, "misses": misses,
+                "hit_rate": hits / (hits + misses) if hits + misses else 0.0}
 
 
 def clear_parse_caches() -> None:
-    """Drop both memo caches (test isolation)."""
+    """Drop both memo caches and reset sizing/counters (test isolation)."""
     with _parse_lock:
         _pod_cache.clear()
         _node_cache.clear()
+        for kind in ("pods", "nodes"):
+            _parse_limits[kind] = _PARSE_CACHE_FLOOR
+            _parse_hits[kind] = 0
+            _parse_misses[kind] = 0

@@ -47,16 +47,21 @@ def load_native_lib(so_name: str, *, configure,
         if cached is not None:
             return cached
         lib_path = os.path.join(_NATIVE_DIR, "build", so_name)
-        if not os.path.exists(lib_path):
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR, f"build/{so_name}"],
-                    check=True, capture_output=True, timeout=120)
-            except Exception:  # noqa: BLE001 — no compiler: stay Python
+        # Always invoke make (a fresh build is a no-op): a prebuilt .so
+        # older than its source would otherwise be loaded stale and
+        # silently lack newly-added entry points.
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, f"build/{so_name}"],
+                check=True, capture_output=True, timeout=120)
+        except Exception:  # noqa: BLE001 — no compiler: stay Python
+            if not os.path.exists(lib_path):
                 log.info("%s unavailable (build failed); using the "
                          "Python engine", so_name, exc_info=True)
                 cache["lib"] = False
                 return None
+            log.info("%s rebuild failed; loading the existing library",
+                     so_name, exc_info=True)
         try:
             lib = ctypes.CDLL(lib_path)
             configure(lib)
@@ -89,6 +94,24 @@ def _configure_fitpack(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_int32),
     ]
     lib.fitpack_pack_ffd.restype = ctypes.c_int32
+    # The wide multi-shape pack kernel (ISSUE 6) may be absent from a
+    # stale prebuilt .so when no toolchain exists to rebuild it; the
+    # legacy entry points must keep working in that case.
+    try:
+        lib.fitpack_pack_ffd_multi.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.fitpack_pack_ffd_multi.restype = ctypes.c_int32
+        _fitpack_cache["pack_multi"] = True
+    except AttributeError:
+        _fitpack_cache["pack_multi"] = False
 
 
 def load() -> ctypes.CDLL | None:
@@ -117,6 +140,49 @@ def best_shapes(gangs: list[tuple[float, float, float]],
     stranded = (ctypes.c_double * g)()
     lib.fitpack_best_shapes(garr, g, sarr, s, best, stranded)
     return [(int(best[i]), float(stranded[i])) for i in range(g)]
+
+
+def pack_multi_available() -> bool:
+    """True when the wide multi-shape pack entry point is loadable."""
+    return load() is not None and bool(_fitpack_cache.get("pack_multi"))
+
+
+def pack_ffd_multi(pods: list[list[float]], tmpl: list[int],
+                   free: list[list[float]], admit: bytes, n_tmpl: int,
+                   shapes: list[list[float]]
+                   ) -> tuple[list[int], list[int], list[list[float]]] | None:
+    """K-axis, multi-shape, admission-masked first-fit packing.
+
+    ``pods`` must already be in first-fit-decreasing order (the caller
+    owns ordering semantics); ``admit`` is the row-major T×F template
+    admission mask.  Returns ``(placed, unit_shapes, free_after)`` —
+    placement code per pod (-2 existing / -1 unplaceable / >=0 opened
+    unit), the shape index of each opened unit, and the mutated free
+    capacities — or None when the kernel is unavailable.
+    """
+    lib = load()
+    if lib is None or not _fitpack_cache.get("pack_multi"):
+        return None
+    n, f, s = len(pods), len(free), len(shapes)
+    k = len(shapes[0]) if shapes else (len(pods[0]) if pods else 0)
+    if k == 0:
+        return None
+    parr = (ctypes.c_double * (n * k))(*[v for row in pods for v in row])
+    tarr = (ctypes.c_int32 * max(n, 1))(*tmpl)
+    farr = (ctypes.c_double * max(f * k, 1))(
+        *[v for row in free for v in row])
+    aarr = (ctypes.c_uint8 * max(len(admit), 1))(*admit)
+    sarr = (ctypes.c_double * (s * k))(*[v for row in shapes for v in row])
+    placed = (ctypes.c_int32 * max(n, 1))()
+    unit_shape = (ctypes.c_int32 * max(n, 1))()
+    lib.fitpack_pack_ffd_multi(parr, n, k, tarr, farr, f, aarr, n_tmpl,
+                               sarr, s, placed, unit_shape)
+    free_after = [[farr[i * k + a] for a in range(k)] for i in range(f)]
+    n_units = max((placed[i] for i in range(n) if placed[i] >= 0),
+                  default=-1) + 1
+    return ([int(placed[i]) for i in range(n)],
+            [int(unit_shape[u]) for u in range(n_units)],
+            free_after)
 
 
 def pack_ffd(pods: list[tuple[float, float]],
