@@ -13,6 +13,9 @@
 #   6  mega-cluster scale tiers (bench.py observe --pods 100000
 #      --nodes 10000 >= 20x indexed-vs-scan; fit_batch --gangs 8192
 #      zero decision mismatches + >= 2x — ISSUE 6)
+#   7  generative chaos corpus (python -m tpu_autoscaler.chaos
+#      --seed-corpus: 200 seeds under a fixed wall-clock budget; every
+#      property invariant must hold — ISSUE 7, docs/CHAOS.md)
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -22,23 +25,31 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/5] invariant analysis (--format=$fmt)"
+echo "== [1/6] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/5] mypy strict islands"
+echo "== [2/6] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/5] deterministic-schedule race tier"
+echo "== [3/6] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
 
-echo "== [4/5] tracer-overhead gate"
+echo "== [4/6] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [5/5] mega-cluster scale tiers"
+echo "== [5/6] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
+
+echo "== [6/6] generative chaos corpus (200 seeds, 480 s budget)"
+# Every seed must hold every property invariant (no stranded chips, no
+# double provision, whole-slice deletes only, gang ICI integrity,
+# convergence, complete traces).  The CLI exits 2 on a violation and 3
+# when the budget blows; both fail this stage with exit 7.
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 480 || exit 7
 
 echo "CI GATE GREEN"

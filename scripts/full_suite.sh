@@ -45,6 +45,13 @@ JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2
 # (ISSUE 3; ~4 s — the serial baseline honestly pays its 80 RTTs).
 JAX_PLATFORMS=cpu python bench.py actuate
 
+# Chaos corpus (ISSUE 7): 200 seeded generative scenarios (brownouts,
+# watch storms, 410 floods, stockouts, preemptions, partial slice host
+# failures) through the real control loop, every property invariant
+# asserted per step, under a fixed wall-clock budget (docs/CHAOS.md).
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 480
+
 # Tracer-overhead tier: the observe + actuate benches re-run with the
 # decision tracer attached must stay within 5% of untraced (ISSUE 5 —
 # instrumentation can never silently eat the PR-2/PR-3 wins).

@@ -15,7 +15,6 @@ rebuild exceeding that floor.  Failure modes exercised:
 
 import random
 
-from tpu_autoscaler.actuators.base import ACCEPTED, FAILED, PROVISIONING
 from tpu_autoscaler.actuators.fake import FakeActuator
 from tpu_autoscaler.controller import Controller, ControllerConfig
 from tpu_autoscaler.engine.planner import PoolPolicy
@@ -24,30 +23,9 @@ from tpu_autoscaler.topology import shape_by_name
 
 from tests.fixtures import make_gang
 
-
-class FlakyActuator(FakeActuator):
-    """Fails each provision attempt with probability p (seeded)."""
-
-    def __init__(self, kube, *, rng, fail_prob=0.3, **kw):
-        super().__init__(kube, **kw)
-        self._rng = rng
-        self._fail_prob = fail_prob
-        self._doomed: set[str] = set()
-
-    def provision(self, request):
-        status = super().provision(request)
-        if self._rng.random() < self._fail_prob:
-            self._doomed.add(status.id)
-        return status
-
-    def poll(self, now):
-        for pid, status in list(self._statuses.items()):
-            if pid in self._doomed and status.state in (ACCEPTED,
-                                                        PROVISIONING):
-                status.state = FAILED
-                status.error = "chaos: injected quota failure"
-                self._doomed.discard(pid)
-        super().poll(now)
+# The seeded flaky-provision fault model is first-class in FakeActuator
+# since ISSUE 7 (rng + fail_prob knobs), shared with the generative
+# chaos engine (tpu_autoscaler/chaos) instead of a test-local subclass.
 
 
 SHAPES = ["v5e-8", "v5e-16", "v5e-64"]
@@ -56,7 +34,7 @@ SHAPES = ["v5e-8", "v5e-16", "v5e-64"]
 def test_converges_under_churn_and_failures():
     rng = random.Random(20260728)
     kube = FakeKube()
-    actuator = FlakyActuator(kube, rng=rng, fail_prob=0.3,
+    actuator = FakeActuator(kube, rng=rng, fail_prob=0.3,
                              provision_delay=40.0, stagger_seconds=5.0)
     controller = Controller(kube, actuator, ControllerConfig(
         policy=PoolPolicy(spare_nodes=0, max_total_chips=2048),
@@ -140,7 +118,7 @@ def test_converges_with_anti_affine_services_amid_tpu_churn():
 
     rng = random.Random(20260729)
     kube = FakeKube()
-    actuator = FlakyActuator(kube, rng=rng, fail_prob=0.2,
+    actuator = FakeActuator(kube, rng=rng, fail_prob=0.2,
                              provision_delay=30.0)
     controller = Controller(kube, actuator, ControllerConfig(
         policy=PoolPolicy(spare_nodes=0, max_total_chips=1024),
@@ -220,7 +198,7 @@ def test_converges_with_all_policies_enabled():
     converge, honor quotas, and never strand a high-priority gang."""
     rng = random.Random(42)
     kube = FakeKube()
-    actuator = FlakyActuator(kube, rng=rng, fail_prob=0.15,
+    actuator = FakeActuator(kube, rng=rng, fail_prob=0.15,
                              provision_delay=30.0)
     controller = Controller(kube, actuator, ControllerConfig(
         policy=PoolPolicy(spare_nodes=0, max_total_chips=96,

@@ -1,0 +1,149 @@
+"""Generative chaos engine (ISSUE 7): grammar, invariants, corpus.
+
+The smoke tier (default tier-1) runs a handful of seeds per profile;
+the full 200-seed CI corpus runs via ``scripts/ci_gate.sh`` /
+``python -m tpu_autoscaler.chaos --seed-corpus`` and as the
+``chaos``-marked slow test here.
+"""
+
+import pytest
+
+from tpu_autoscaler.chaos import generate, run_corpus, run_scenario
+from tpu_autoscaler.chaos.engine import BrownoutKube
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.testing.chaosfixtures import (
+    ALL_REGRESSIONS,
+    GANG_SPLIT_BACKFILL,
+    LATE_PROVISION_SPAN,
+    ORPHANED_PARTIAL_SLICE,
+    SABOTAGE,
+)
+
+
+class TestScenarioGrammar:
+    def test_generation_is_deterministic(self):
+        assert generate(7) == generate(7)
+        assert generate(7) != generate(8)
+
+    def test_quiet_tail_is_guaranteed(self):
+        from tpu_autoscaler.chaos.scenario import QUIET_TAIL
+
+        for seed in range(40):
+            program = generate(seed)
+            for e in program.events:
+                end = e.t + e.args.get("duration", 0.0)
+                assert end <= program.until - QUIET_TAIL + 1e-9
+
+    def test_repair_profile_always_has_a_host_failure(self):
+        for seed in range(20):
+            program = generate(seed, profile="repair")
+            assert any(e.kind == "host_fail" for e in program.events)
+
+    def test_unknown_profile_rejected(self):
+        with pytest.raises(ValueError):
+            generate(0, profile="nope")
+
+
+class TestBrownoutKube:
+    def test_verbs_fail_only_inside_the_window(self):
+        kube = FakeKube()
+        proxy = BrownoutKube(kube)
+        proxy.add_window(10.0, 20.0)
+        proxy.set_now(5.0)
+        assert proxy.list_pods() == []
+        proxy.set_now(15.0)
+        with pytest.raises(RuntimeError, match="brownout"):
+            proxy.list_pods()
+        # Fixture mutators stay reachable for the engine.
+        kube.add_pod({"metadata": {"name": "p", "namespace": "default"},
+                      "spec": {}, "status": {"phase": "Pending"}})
+        proxy.set_now(25.0)
+        assert len(proxy.list_pods()) == 1
+
+
+class TestFakeKubeFaultHooks:
+    def test_taint_node_is_idempotent(self):
+        from tests.fixtures import make_node
+
+        kube = FakeKube()
+        kube.add_node(make_node(name="n1"))
+        kube.taint_node("n1", "k")
+        kube.taint_node("n1", "k")
+        taints = kube.list_nodes()[0]["spec"]["taints"]
+        assert [t["key"] for t in taints] == ["k"]
+
+    def test_expire_watch_window_410s_old_cursors(self):
+        from tests.fixtures import make_pod
+
+        kube = FakeKube()
+        watch = kube.watch_pods(timeout_seconds=0, resource_version="0")
+        kube.add_pod(make_pod(name="a"))
+        kube.expire_watch_window()
+        events = list(kube.watch_pods(timeout_seconds=0,
+                                      resource_version="0"))
+        assert events and events[0]["type"] == "ERROR"
+        assert events[0]["object"]["code"] == 410
+        watch.close()
+
+
+class TestSmokeCorpus:
+    """A few seeds per profile hold every invariant (the fast gate; the
+    200-seed corpus runs in scripts/ci_gate.sh stage 6)."""
+
+    @pytest.mark.parametrize("profile", ["mixed", "faults", "api",
+                                         "repair"])
+    def test_profile_seeds_hold_invariants(self, profile):
+        for seed in range(4):
+            result = run_scenario(seed, profile=profile)
+            assert result.ok, "\n".join(result.violations)
+            assert result.converged_at is not None
+
+    def test_sched_drive_holds_invariants(self):
+        """The DeterministicScheduler drive: real informer watch
+        threads, seeded interleavings."""
+        result = run_scenario(7, profile="mixed", drive="sched",
+                              schedules=2)
+        assert result.ok, "\n".join(result.violations)
+
+    def test_budget_blown_is_reported(self):
+        results, blown = run_corpus(range(50), budget_seconds=0.0)
+        assert blown
+        assert len(results) < 50
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestFullCorpus:
+    def test_two_hundred_seeds(self):
+        results, blown = run_corpus(range(200), budget_seconds=480.0)
+        assert not blown, f"corpus budget blown after {len(results)} seeds"
+        failures = [r for r in results if not r.ok]
+        assert not failures, "\n".join(
+            v for r in failures for v in r.violations)
+        # The corpus genuinely exercises the repair subsystem.
+        assert sum(r.repairs for r in results) >= 20
+
+
+class TestPromotedRegressions:
+    """Fuzzer-found failures promoted to seeded fixtures
+    (testing/chaosfixtures.py): the fix holds under the originating
+    seed, and the sabotaged (pre-fix) run is CAUGHT by the named
+    invariant — proving the detector, not just the fix."""
+
+    @pytest.mark.parametrize("fixture", ALL_REGRESSIONS,
+                             ids=lambda f: f.name)
+    def test_fix_holds_under_originating_seed(self, fixture):
+        result = fixture.run()
+        assert result.ok, "\n".join(result.violations)
+
+    @pytest.mark.parametrize("fixture", [LATE_PROVISION_SPAN,
+                                         ORPHANED_PARTIAL_SLICE,
+                                         GANG_SPLIT_BACKFILL],
+                             ids=lambda f: f.name)
+    def test_sabotaged_run_is_caught_by_the_invariant(self, fixture):
+        result = fixture.run(sabotage=SABOTAGE[fixture.name])
+        assert not result.ok, (
+            f"{fixture.name}: sabotage no longer trips "
+            f"{fixture.invariant} — the fixture has gone stale")
+        assert any(fixture.invariant in v for v in result.violations), \
+            "\n".join(result.violations)

@@ -276,14 +276,12 @@ class TestIndexConsistencyThroughInformer:
             kube.delete_pod("default", f"pod-{i}")
         saw_410 = False
         for _ in range(4):
-            try:
-                informer.pump()
-            except Exception:
-                # run() marks the failing watch's cache unsynced; the
-                # journal floor is global, so both cursors expired.
+            # Since ISSUE 7 pump() mirrors run()'s failure semantics:
+            # the 410 marks the cache unsynced internally (no raise)
+            # and the NEXT pump relists.
+            informer.pump()
+            if not informer.pod_cache.synced:
                 saw_410 = True
-                informer.pod_cache.mark_unsynced()
-                informer.node_cache.mark_unsynced()
         informer.pump()
         assert saw_410, "journal trim should have produced a 410"
         assert informer.pod_cache.synced

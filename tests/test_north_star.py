@@ -235,8 +235,12 @@ class TestUnhealthySliceReplacement:
         second_nodes = {n["metadata"]["name"] for n in kube.list_nodes()}
         assert len(second_nodes) == 4
         assert second_nodes.isdisjoint(first_nodes)  # replacement slice
+        # Since ISSUE 7 a workload-bearing broken slice goes through the
+        # ICI-atomic repair path (same whole-slice replacement, now
+        # traced + counted as a repair).
         snap = controller.metrics.snapshot()
-        assert snap["counters"]["unhealthy_units_replaced"] == 1
+        assert snap["counters"]["slice_repairs_started"] == 1
+        assert snap["counters"]["slice_repairs_completed"] == 1
 
 
 class TestImpendingTermination:

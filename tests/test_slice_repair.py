@@ -1,0 +1,366 @@
+"""ICI-atomic slice repair (ISSUE 7).
+
+The acceptance scenario: one failed host inside a live v5p slice
+resolves via cordon → checkpoint drain → whole-slice replacement —
+never a full fleet re-provision, never a lone-host backfill — with a
+complete ``slice_repair`` span in the flight recorder, and the supply
+guard held across the repair's re-provision (no phantom-free-capacity
+window even when its TTL expires mid-repair).
+"""
+
+import pytest
+
+from tpu_autoscaler.actuators.base import ACTIVE
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.engine.planner import PoolPolicy
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.k8s.payloads import tpu_host_payload
+from tpu_autoscaler.obs import trace_gaps
+from tpu_autoscaler.topology import shape_by_name
+
+from tests.fixtures import make_gang, make_tpu_pod
+
+SHAPE = "v5p-16"  # 4 hosts, 4 chips each — the smallest multi-host v5p
+
+
+def make_harness(policy=None, **cfg):
+    kube = FakeKube()
+    actuator = FakeActuator(kube)
+    cfg.setdefault("grace_seconds", 30.0)
+    cfg.setdefault("idle_threshold_seconds", 120.0)
+    cfg.setdefault("drain_grace_seconds", 20.0)
+    cfg.setdefault("provision_retry_seconds", 30.0)
+    cfg.setdefault("slice_repair_after_seconds", 30.0)
+    controller = Controller(kube, actuator, ControllerConfig(
+        policy=policy or PoolPolicy(spare_nodes=0), **cfg))
+    return kube, actuator, controller
+
+
+def drive(kube, controller, shape, names, job, t0, until, step=5.0):
+    """Sim loop with a Job-controller model: evicted/GC'd members are
+    recreated Pending; pods bound to deleted nodes are GC'd."""
+    t = t0
+    while t <= until:
+        node_names = {n["metadata"]["name"] for n in kube.list_nodes()}
+        for p in list(kube.list_pods()):
+            bound = p["spec"].get("nodeName")
+            if bound and bound not in node_names:
+                kube.delete_pod(p["metadata"].get("namespace", "default"),
+                                p["metadata"]["name"])
+        for n in names:
+            if kube.get_pod("default", n) is None:
+                kube.add_pod(make_tpu_pod(
+                    name=n, chips=shape.chips_per_host, shape=shape,
+                    job=job))
+        controller.reconcile_once(now=t)
+        kube.schedule_step()
+        t += step
+    return t
+
+
+def running(kube, names):
+    return all((kube.get_pod("default", n) or {}).get(
+        "status", {}).get("phase") == "Running" for n in names)
+
+
+def start_gang(kube, controller, shape, job="train"):
+    names = []
+    for p in make_gang(shape, job=job):
+        kube.add_pod(p)
+        names.append(p["metadata"]["name"])
+    t = 0.0
+    while t <= 100.0 and not running(kube, names):
+        controller.reconcile_once(now=t)
+        kube.schedule_step()
+        t += 5.0
+    assert running(kube, names)
+    return names, t
+
+
+class TestSliceRepairAcceptance:
+    def _run(self, kill_mode):
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name(SHAPE)
+        names, t = start_gang(kube, controller, shape)
+        first_nodes = {n["metadata"]["name"] for n in kube.list_nodes()}
+        assert len(first_nodes) == shape.hosts
+        submitted_before = int(controller.metrics.snapshot()[
+            "counters"]["provisions_submitted"])
+
+        victim = sorted(first_nodes)[0]
+        actuator.fail_host(victim, kill_mode)
+        drive(kube, controller, shape, names, "train", t, t + 400.0)
+        assert running(kube, names)
+        second_nodes = {n["metadata"]["name"] for n in kube.list_nodes()}
+        # Whole-slice replacement: a fresh slice, full host count, and
+        # NO surviving host of the original (never a lone-host backfill
+        # into the old ICI domain).
+        assert len(second_nodes) == shape.hosts
+        assert second_nodes.isdisjoint(first_nodes)
+        # Never a fleet re-provision: exactly ONE replacement provision.
+        snap = controller.metrics.snapshot()
+        assert int(snap["counters"]["provisions_submitted"]) \
+            == submitted_before + 1
+        assert snap["counters"]["slice_repairs_started"] == 1
+        assert snap["counters"]["slice_repairs_completed"] == 1
+        assert snap["summaries"]["slice_repair_seconds"]["count"] == 1
+        # The slice_repair trace is in the recorder and complete.
+        dump = controller.recorder.dump(tracer=controller.tracer)
+        repair_traces = {s["trace_id"] for s in dump["spans"]
+                         if s["name"] == "slice_repair"}
+        assert len(repair_traces) == 1
+        (trace_id,) = repair_traces
+        assert trace_gaps(dump, trace_id) == []
+        span_names = {s["name"] for s in dump["spans"]
+                      if s["trace_id"] == trace_id}
+        # The repair story carries its drain AND the replacement's
+        # dispatch/provision (repair-ahead provisioning).
+        assert {"slice_repair", "repair_drain", "dispatch",
+                "provision"} <= span_names
+        return controller, dump
+
+    def test_notready_host_in_live_v5p_slice(self):
+        self._run("notready")
+
+    def test_deleted_host_in_live_v5p_slice(self):
+        self._run("delete")
+
+    def test_flap_window_holds_for_notready(self):
+        """A NotReady blip shorter than slice_repair_after_seconds never
+        starts a repair."""
+        kube, actuator, controller = make_harness(
+            slice_repair_after_seconds=60.0)
+        shape = shape_by_name(SHAPE)
+        names, t = start_gang(kube, controller, shape)
+        victim = sorted(n["metadata"]["name"]
+                        for n in kube.list_nodes())[0]
+        kube.set_node_ready(victim, False)
+        controller.reconcile_once(now=t)
+        controller.reconcile_once(now=t + 20.0)
+        kube.set_node_ready(victim, True)  # flap over
+        controller.reconcile_once(now=t + 40.0)
+        controller.reconcile_once(now=t + 120.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("slice_repairs_started", 0) == 0
+        assert running(kube, names)
+
+    def test_deleted_host_repairs_without_flap_window(self):
+        """A DELETED host starts the repair on the very pass it is
+        observed — there is nothing to flap."""
+        kube, actuator, controller = make_harness(
+            slice_repair_after_seconds=3600.0)
+        shape = shape_by_name(SHAPE)
+        names, t = start_gang(kube, controller, shape)
+        victim = sorted(n["metadata"]["name"]
+                        for n in kube.list_nodes())[0]
+        actuator.fail_host(victim, "delete")
+        controller.reconcile_once(now=t)
+        assert controller.metrics.snapshot()[
+            "counters"]["slice_repairs_started"] == 1
+
+    def test_repair_disabled_falls_back_to_legacy_replace(self):
+        kube, actuator, controller = make_harness(
+            enable_slice_repair=False, unhealthy_timeout_seconds=30.0)
+        shape = shape_by_name(SHAPE)
+        names, t = start_gang(kube, controller, shape)
+        victim = sorted(n["metadata"]["name"]
+                        for n in kube.list_nodes())[0]
+        kube.set_node_ready(victim, False)
+        drive(kube, controller, shape, names, "train", t, t + 400.0)
+        assert running(kube, names)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get("slice_repairs_started", 0) == 0
+        assert snap["counters"]["unhealthy_units_replaced"] == 1
+
+
+class TestNoLoneHostBackfill:
+    def test_recreated_member_never_planned_solo(self):
+        """The dead host's recreated pod must not be sized alone (a
+        1-pod gang would fit a tiny slice — bisecting the job across
+        ICI domains); it waits for the whole-gang replacement."""
+        kube, actuator, controller = make_harness()
+        shape = shape_by_name(SHAPE)
+        names, t = start_gang(kube, controller, shape)
+        victim = sorted(n["metadata"]["name"]
+                        for n in kube.list_nodes())[0]
+        actuator.fail_host(victim, "delete")
+        drive(kube, controller, shape, names, "train", t, t + 400.0)
+        assert running(kube, names)
+        # Every provision ever submitted was the FULL slice shape.
+        shapes = {s.request.shape_name for s in actuator.statuses()}
+        assert shapes <= {SHAPE}
+        for req_shape in shapes:
+            assert shape_by_name(req_shape).hosts == shape.hosts
+        # And the gang ended up on ONE slice.
+        slice_ids = {n["metadata"]["labels"][
+            "autoscaler.tpu.dev/slice-id"] for n in kube.list_nodes()}
+        assert len(slice_ids) == 1
+
+
+class SlowRegisterActuator(FakeActuator):
+    """Provisions go ACTIVE immediately but their nodes register only
+    when the test says so — the real-cloud registration lag, long
+    enough here to outlive the supply-guard TTL."""
+
+    def __init__(self, kube):
+        super().__init__(kube)
+        self.register_held: set[str] = set()
+
+    def _materialize(self, pid, status, now):
+        req = status.request
+        if req.kind == "tpu-slice" and pid in self.register_held:
+            status.state = ACTIVE
+            status.unit_ids = [f"{req.shape_name}-{pid}"]
+            return
+        super()._materialize(pid, status, now)
+
+    def release(self, now):
+        for pid in list(self.register_held):
+            self.register_held.discard(pid)
+            status = self._statuses.get(pid)
+            if status is None:
+                continue
+            shape = shape_by_name(status.request.shape_name)
+            for slice_id in status.unit_ids:
+                for i in range(shape.hosts):
+                    self._kube.add_node(tpu_host_payload(
+                        shape, slice_id, i, created_at=now))
+
+
+class TestSupplyGuardRepairHold:
+    """ISSUE 7 satellite: supply-guard TTL expiry racing an in-flight
+    slice repair — the guard must stay engaged across the repair's
+    re-provision; no window where the planner sees phantom free
+    capacity and double-provisions."""
+
+    def _run(self, *, hold_enabled):
+        kube, actuator, controller = make_harness(
+            provision_timeout_seconds=40.0)
+        actuator.__class__ = SlowRegisterActuator
+        actuator.register_held = set()
+        shape = shape_by_name(SHAPE)
+        names, t = start_gang(kube, controller, shape)
+        if not hold_enabled:
+            controller._repair_depends_on = lambda gang_key: False
+        # Every FUTURE provision registers its nodes late.
+        real_provision = actuator.provision
+
+        def held_provision(request):
+            status = real_provision(request)
+            actuator.register_held.add(status.id)
+            return status
+
+        actuator.provision = held_provision
+        victim = sorted(n["metadata"]["name"]
+                        for n in kube.list_nodes())[0]
+        actuator.fail_host(victim, "delete")
+        # Run well past the 40 s guard TTL with registration held.
+        t_end = drive(kube, controller, shape, names, "train", t,
+                      t + 160.0)
+        snap = controller.metrics.snapshot()
+        replacements = int(snap["counters"]["provisions_submitted"]) - 1
+        holds = int(snap["counters"].get("supply_guard_repair_holds", 0))
+        # Let registration finally complete and the repair finish.
+        actuator.release(t_end)
+        drive(kube, controller, shape, names, "train", t_end,
+              t_end + 200.0)
+        return replacements, holds, kube, names, controller
+
+    def test_guard_held_across_repair_reprovision(self):
+        replacements, holds, kube, names, controller = self._run(
+            hold_enabled=True)
+        assert replacements == 1, \
+            "guard hold must prevent a duplicate replacement"
+        assert holds >= 1
+        assert running(kube, names)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["slice_repairs_completed"] == 1
+
+    def test_without_hold_guard_expiry_double_provisions(self):
+        """Seeded-bug direction (racefixtures-style): with the hold
+        disabled, TTL expiry mid-repair opens the phantom-capacity
+        window and a duplicate replacement IS submitted — proving the
+        hold is load-bearing, not decorative."""
+        replacements, _holds, _kube, _names, _controller = self._run(
+            hold_enabled=False)
+        assert replacements >= 2
+
+
+class TestRepairDeferredUnderClamp:
+    def test_repair_waits_for_headroom_never_unsatisfiable(self):
+        """With max_total_chips exactly the fleet size, the replacement
+        cannot pre-provision; it is DEFERRED (explained, never reported
+        unsatisfiable) until the broken slice is deleted, then lands."""
+        shape = shape_by_name(SHAPE)
+        kube, actuator, controller = make_harness(
+            policy=PoolPolicy(spare_nodes=0,
+                              max_total_chips=shape.chips))
+        names, t = start_gang(kube, controller, shape)
+        victim = sorted(n["metadata"]["name"]
+                        for n in kube.list_nodes())[0]
+        actuator.fail_host(victim, "delete")
+        drive(kube, controller, shape, names, "train", t, t + 500.0)
+        assert running(kube, names)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["slice_repairs_completed"] == 1
+        assert snap["counters"].get("unsatisfiable_gangs", 0) == 0
+
+
+class TestOrphanedPartialReclaim:
+    def test_partial_slice_with_no_backing_provision_is_reclaimed(self):
+        """Fuzzer-found: a provision that FAILs after materializing
+        some hosts leaves a forever-PROVISIONING partial slice; it is
+        reclaimed whole after provision_timeout_seconds."""
+        kube, actuator, controller = make_harness(
+            provision_timeout_seconds=60.0)
+        shape = shape_by_name(SHAPE)
+        # Orphan hosts: 2 of 4, no actuator status behind them.
+        for i in range(2):
+            kube.add_node(tpu_host_payload(shape, "orphan-1", i,
+                                           created_at=0.0))
+        t = 0.0
+        while t <= 120.0:
+            controller.reconcile_once(now=t)
+            t += 5.0
+        assert kube.list_nodes() == []
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["orphaned_partial_units_reclaimed"] == 1
+
+    def test_workload_bearing_partial_slice_is_not_orphan_reclaimed(self):
+        """A partial slice HOSTING pods goes through repair, never the
+        orphan path."""
+        kube, actuator, controller = make_harness(
+            provision_timeout_seconds=60.0)
+        shape = shape_by_name(SHAPE)
+        names, t = start_gang(kube, controller, shape)
+        victim = sorted(n["metadata"]["name"]
+                        for n in kube.list_nodes())[0]
+        actuator.fail_host(victim, "delete")
+        drive(kube, controller, shape, names, "train", t, t + 400.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"].get(
+            "orphaned_partial_units_reclaimed", 0) == 0
+        assert snap["counters"]["slice_repairs_completed"] == 1
+
+
+class TestRepairTimeout:
+    def test_abandoned_repair_is_bounded_and_traced(self):
+        """A repair whose replacement never lands closes abandoned at
+        slice_repair_timeout_seconds (bookkeeping bounded; span ends
+        with the error attr so the trace is still whole)."""
+        kube, actuator, controller = make_harness(
+            slice_repair_timeout_seconds=100.0)
+        shape = shape_by_name(SHAPE)
+        names, t = start_gang(kube, controller, shape)
+        # Every future provision fails: no replacement can ever land.
+        actuator._fail_shapes.add(SHAPE)
+        victim = sorted(n["metadata"]["name"]
+                        for n in kube.list_nodes())[0]
+        actuator.fail_host(victim, "delete")
+        drive(kube, controller, shape, names, "train", t, t + 300.0)
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["slice_repairs_started"] == 1
+        assert snap["counters"]["slice_repairs_abandoned"] == 1
+        assert controller._slice_repairs == {}
+        assert controller._repair_roots == {}

@@ -7,11 +7,30 @@ QueuedResource-shaped states (ACCEPTED → PROVISIONING → ACTIVE), then real
 node payloads appear in the fake apiserver with the full GKE TPU label
 contract.  Multi-host slices can materialize their hosts gradually
 (``stagger_seconds``) to exercise the all-hosts-Ready barrier.
+
+Fault injection (ISSUE 7) is first-class and seedable, so the chaos
+engine (``tpu_autoscaler/chaos``) and the repair/regression tests share
+ONE fault model instead of ad-hoc subclasses:
+
+- ``fail_prob``     — each provision is doomed with this probability
+                      (drawn from the injected ``rng`` at submit time)
+                      and FAILs on a later poll, like a quota rejection;
+- ``fail_shapes``   — shapes that always FAIL (hard stockout);
+- ``fail_window``   — a time window during which every in-flight
+                      provision FAILs (zonal stockout; mid-provision
+                      stockouts are provisions caught in flight by it);
+- ``host_failure_prob`` — after a slice goes ACTIVE, one of its hosts
+                      dies ``host_failure_delay`` seconds later
+                      (``notready`` or ``delete``) — the partial-slice
+                      failure the ICI-atomic repair path exists for;
+- ``preempt_unit``  — stamps the impending-termination taint on a live
+                      unit's hosts (spot reclamation notice).
 """
 
 from __future__ import annotations
 
 import itertools
+import random
 
 from tpu_autoscaler.actuators.base import (
     ACCEPTED,
@@ -23,7 +42,16 @@ from tpu_autoscaler.actuators.base import (
 from tpu_autoscaler.engine.planner import ProvisionRequest
 from tpu_autoscaler.k8s.fake import FakeKube
 from tpu_autoscaler.k8s.payloads import cpu_node_payload, tpu_host_payload
-from tpu_autoscaler.topology.catalog import cpu_shape_by_name, shape_by_name
+from tpu_autoscaler.topology.catalog import (
+    SLICE_ID_LABEL,
+    cpu_shape_by_name,
+    shape_by_name,
+)
+
+#: Taint key the reconciler treats as an impending involuntary
+#: termination (asserted against reconciler.TERMINATION_TAINT_KEYS at
+#: use, so a rename there cannot silently de-claw chaos preemptions).
+PREEMPT_TAINT = "cloud.google.com/impending-node-termination"
 
 
 class FakeActuator:
@@ -34,17 +62,78 @@ class FakeActuator:
     STATUS_RETENTION_SECONDS = 900.0
 
     def __init__(self, kube: FakeKube, *, provision_delay: float = 0.0,
-                 stagger_seconds: float = 0.0, fail_shapes: set[str] = ()):
+                 stagger_seconds: float = 0.0, fail_shapes: set[str] = (),
+                 rng: random.Random | None = None, fail_prob: float = 0.0,
+                 host_failure_prob: float = 0.0,
+                 host_failure_delay: float = 30.0,
+                 host_failure_mode: str = "notready"):
         self._kube = kube
         self._delay = provision_delay
         self._stagger = stagger_seconds
         self._fail_shapes = set(fail_shapes)
+        self._rng = rng if rng is not None else random.Random(0)
+        self._fail_prob = fail_prob
+        self._host_failure_prob = host_failure_prob
+        self._host_failure_delay = host_failure_delay
+        if host_failure_mode not in ("notready", "delete"):
+            raise ValueError(
+                f"host_failure_mode must be 'notready' or 'delete', "
+                f"got {host_failure_mode!r}")
+        self._host_failure_mode = host_failure_mode
         self._statuses: dict[str, ProvisionStatus] = {}
         self._submitted_at: dict[str, float] = {}
         self._done_at: dict[str, float] = {}
         self._ids = itertools.count(1)
         self._now = 0.0
         self.deleted_units: list[str] = []
+        # Fault state: provisions doomed at submit time (fail_prob /
+        # fail_in_flight), the active stockout window, and host
+        # failures scheduled for ACTIVE slices: (at, node, mode).
+        self._doomed: dict[str, str] = {}
+        self._fail_window: tuple[float, float, str] | None = None
+        self._host_failures: list[tuple[float, str, str]] = []
+        self.injected_host_failures: list[str] = []
+
+    # ---- fault-injection knobs (ISSUE 7) --------------------------------
+
+    def set_fail_window(self, start: float, end: float,
+                        error: str = "chaos: zone out of capacity "
+                                     "(stockout)") -> None:
+        """Provisions in flight while ``start <= now < end`` FAIL with
+        ``error`` — a zonal stockout window.  One window at a time."""
+        self._fail_window = (start, end, error)
+
+    def fail_in_flight(self, error: str = "chaos: provisioning aborted "
+                                          "(out of capacity)") -> None:
+        """Doom every currently in-flight provision (mid-provision
+        stockout): each FAILs on its next poll."""
+        for pid, status in self._statuses.items():
+            if status.in_flight:
+                self._doomed.setdefault(pid, error)
+
+    def preempt_unit(self, unit_id: str) -> None:
+        """Stamp the impending-termination taint on the unit's hosts —
+        the spot-reclamation notice the drain path keys on."""
+        from tpu_autoscaler.controller.reconciler import (
+            TERMINATION_TAINT_KEYS,
+        )
+
+        assert PREEMPT_TAINT in TERMINATION_TAINT_KEYS, \
+            "PREEMPT_TAINT drifted from the reconciler's taint set"
+        for payload in self._kube.list_nodes():
+            labels = payload.get("metadata", {}).get("labels", {})
+            if labels.get(SLICE_ID_LABEL) == unit_id:
+                self._kube.taint_node(payload["metadata"]["name"],
+                                      PREEMPT_TAINT)
+
+    def fail_host(self, node_name: str, mode: str = "notready") -> None:
+        """Kill one host now: NotReady (kubelet gone) or delete (node
+        object withdrawn) — the partial-slice failure primitive."""
+        self.injected_host_failures.append(node_name)
+        if mode == "delete":
+            self._kube.delete_node(node_name)
+        else:
+            self._kube.set_node_ready(node_name, False)
 
     # ---- Actuator protocol ---------------------------------------------
 
@@ -53,19 +142,29 @@ class FakeActuator:
         status = ProvisionStatus(id=pid, request=request, state=ACCEPTED)
         self._statuses[pid] = status
         self._submitted_at[pid] = self._now
+        if self._fail_prob > 0.0 and self._rng.random() < self._fail_prob:
+            self._doomed[pid] = "chaos: injected quota failure"
         return status
 
     def delete(self, unit_id: str) -> None:
         self.deleted_units.append(unit_id)
         for payload in list(self._kube.list_nodes()):
             labels = payload.get("metadata", {}).get("labels", {})
-            if labels.get("autoscaler.tpu.dev/slice-id") == unit_id:
+            if labels.get(SLICE_ID_LABEL) == unit_id:
                 self._kube.delete_node(payload["metadata"]["name"])
 
     def poll(self, now: float) -> None:
         self._now = now
+        window = self._fail_window
         for pid, status in self._statuses.items():
             if status.state not in (ACCEPTED, PROVISIONING):
+                continue
+            doom = self._doomed.pop(pid, None)
+            if doom is not None:
+                status.fail(doom)
+                continue
+            if window is not None and window[0] <= now < window[1]:
+                status.fail(window[2])
                 continue
             if status.request.shape_name in self._fail_shapes:
                 status.state = FAILED
@@ -76,6 +175,15 @@ class FakeActuator:
                 status.state = PROVISIONING
                 continue
             self._materialize(pid, status, now)
+        # Scheduled host failures whose time came.
+        due = [f for f in self._host_failures if f[0] <= now]
+        if due:
+            self._host_failures = [f for f in self._host_failures
+                                   if f[0] > now]
+            current = {n["metadata"]["name"] for n in self._kube.list_nodes()}
+            for _at, node_name, mode in due:
+                if node_name in current:
+                    self.fail_host(node_name, mode)
         # Track terminal times and prune old terminal statuses.
         for pid, status in list(self._statuses.items()):
             if status.state in (ACTIVE, FAILED):
@@ -130,6 +238,17 @@ class FakeActuator:
             if hosts_up == shape.hosts:
                 status.state = ACTIVE
                 status.unit_ids = list(slice_ids)
+                if (self._host_failure_prob > 0.0 and shape.hosts > 1
+                        and self._rng.random() < self._host_failure_prob):
+                    # One host of one member slice dies later: the
+                    # partial-slice failure the repair path must turn
+                    # into a whole-slice replacement, never a backfill.
+                    victim_slice = self._rng.choice(slice_ids)
+                    host = self._rng.randrange(shape.hosts)
+                    self._host_failures.append((
+                        now + self._host_failure_delay,
+                        f"{victim_slice}-h{host}",
+                        self._host_failure_mode))
             else:
                 status.state = PROVISIONING
         else:

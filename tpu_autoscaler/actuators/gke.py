@@ -316,9 +316,13 @@ class GkeNodePoolActuator:
     def delete(self, unit_id: str) -> None:
         try:
             # Blocking in both modes (rare, scale-down path; see
-            # docs/ACTUATION.md).
-            self._rest.delete(
-                f"{self._api_base}/{self._parent}/nodePools/{unit_id}")
+            # docs/ACTUATION.md).  The span parents under the caller's
+            # context — a slice repair's whole-unit delete shows up in
+            # its slice_repair trace (docs/CHAOS.md).
+            with maybe_span(self._tracer, "pool-delete",
+                            attrs={"unit": unit_id}):
+                self._rest.delete(
+                    f"{self._api_base}/{self._parent}/nodePools/{unit_id}")
         except Exception:  # noqa: BLE001 — retried by the maintain loop
             self._rest.inc("actuator_delete_errors")
             log.exception("node pool delete failed for %s", unit_id)

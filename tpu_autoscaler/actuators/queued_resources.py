@@ -254,10 +254,14 @@ class QueuedResourceActuator:
         try:
             # Deletes stay blocking in both modes: they are rare
             # (scale-down / cancel), and their bookkeeping must only
-            # clear on confirmed success (docs/ACTUATION.md).
-            self._rest.delete(
-                f"{_BASE}/{self._parent}/queuedResources/{qr_id}"
-                "?force=true")
+            # clear on confirmed success (docs/ACTUATION.md).  Traced
+            # under the caller's context so a slice repair's whole-QR
+            # delete lands in its slice_repair trace (docs/CHAOS.md).
+            with maybe_span(self._tracer, "qr-delete",
+                            attrs={"qr": qr_id}):
+                self._rest.delete(
+                    f"{_BASE}/{self._parent}/queuedResources/{qr_id}"
+                    "?force=true")
             for uid, owner in list(self._unit_owner.items()):
                 if owner == qr_id:
                     del self._unit_owner[uid]

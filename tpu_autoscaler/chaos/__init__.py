@@ -1,0 +1,30 @@
+"""Generative chaos engine (ISSUE 7).
+
+Seeded random *scenario programs* — workload churn plus API brownouts,
+watch storms, 410 floods, stockouts (up-front and mid-provision),
+preemptions, and partial slice host failures — compiled into event
+schedules and driven through the same fake-cluster loop as ``sim.py``,
+with property invariants asserted at every step:
+
+- no stranded chips;
+- never a double provision (the supply guard honored);
+- slices only ever deleted whole, never a lone-host backfill;
+- convergence under every seed;
+- every scale-up / slice-repair trace complete in the flight recorder.
+
+``python -m tpu_autoscaler.chaos --seed-corpus`` runs the CI corpus
+(docs/CHAOS.md: scenario grammar, invariant catalog, seed triage).
+Failures found here get promoted to ``testing/chaosfixtures.py``.
+"""
+
+from tpu_autoscaler.chaos.engine import ChaosResult, run_corpus, run_scenario
+from tpu_autoscaler.chaos.scenario import Event, ScenarioProgram, generate
+
+__all__ = [
+    "ChaosResult",
+    "Event",
+    "ScenarioProgram",
+    "generate",
+    "run_corpus",
+    "run_scenario",
+]

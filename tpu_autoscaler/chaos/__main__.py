@@ -1,0 +1,111 @@
+"""Chaos CLI (ISSUE 7): ``python -m tpu_autoscaler.chaos``.
+
+Exit codes (scripts/ci_gate.sh keys on them):
+
+- 0 — every seed held every invariant;
+- 2 — at least one invariant violation (seeds printed for triage:
+      replay with ``--seed N -v``, then promote the failure to
+      ``testing/chaosfixtures.py`` — docs/CHAOS.md workflow);
+- 3 — the wall-clock budget ran out before the corpus finished.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_autoscaler.chaos.engine import run_corpus, run_scenario
+from tpu_autoscaler.chaos.scenario import generate
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_autoscaler.chaos",
+        description="Generative chaos corpus over the control loop "
+                    "(docs/CHAOS.md).")
+    parser.add_argument("--seed-corpus", action="store_true",
+                        help="run the seeded corpus (--seeds of them, "
+                             "from --seed0)")
+    parser.add_argument("--seeds", type=int, default=200,
+                        help="corpus size (default 200)")
+    parser.add_argument("--seed0", type=int, default=0,
+                        help="first seed (default 0)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="run ONE seed (triage mode)")
+    parser.add_argument("--profile", default="mixed",
+                        choices=("mixed", "faults", "api", "repair"),
+                        help="fault alphabet (docs/CHAOS.md)")
+    parser.add_argument("--drive", default="pump",
+                        choices=("pump", "sched"),
+                        help="threadless pump (fast) or the "
+                             "DeterministicScheduler with real watch "
+                             "threads (interleaving sweep)")
+    parser.add_argument("--budget", type=float, default=600.0,
+                        help="corpus wall-clock budget seconds "
+                             "(default 600; exit 3 when blown)")
+    parser.add_argument("--json", dest="json_path", default=None,
+                        help="also write results as JSON to this file")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="per-seed result lines")
+    args = parser.parse_args(argv)
+
+    if args.seed is None and not args.seed_corpus:
+        parser.error("pass --seed N (triage) or --seed-corpus (CI)")
+
+    if args.seed is not None:
+        program = generate(args.seed, profile=args.profile)
+        print(program.describe())
+        for event in program.events:
+            print(f"  t={event.t:7.1f}  {event.kind}  {event.args}")
+        result = run_scenario(program, drive=args.drive)
+        print(result.describe())
+        return 0 if result.ok else 2
+
+    seeds = range(args.seed0, args.seed0 + args.seeds)
+
+    def progress(result) -> None:
+        if args.verbose or not result.ok:
+            print(result.describe(), flush=True)
+
+    results, budget_blown = run_corpus(
+        seeds, profile=args.profile, budget_seconds=args.budget,
+        progress=progress)
+    failures = [r for r in results if not r.ok]
+    converged = sum(1 for r in results if r.converged_at is not None)
+    repairs = sum(r.repairs for r in results)
+    wall = sum(r.wall_seconds for r in results)
+    print(f"chaos corpus: {len(results)}/{len(seeds)} seeds run, "
+          f"{len(failures)} failing, {converged} converged, "
+          f"{repairs} slice repairs exercised, {wall:.1f}s wall "
+          f"(budget {args.budget:g}s)")
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as f:
+            json.dump({"profile": args.profile,
+                       "seeds": [args.seed0, args.seed0 + args.seeds],
+                       "failures": [dataclass_dict(r) for r in failures],
+                       "run": len(results),
+                       "converged": converged,
+                       "repairs": repairs,
+                       "wall_seconds": wall}, f, indent=2)
+    if budget_blown:
+        print(f"BUDGET EXCEEDED after {len(results)} seeds — the corpus "
+              f"did not finish inside {args.budget:g}s", file=sys.stderr)
+        return 3
+    if failures:
+        print("failing seeds (replay: python -m tpu_autoscaler.chaos "
+              f"--seed N --profile {args.profile}): "
+              + ", ".join(str(r.seed) for r in failures),
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+def dataclass_dict(result) -> dict:
+    import dataclasses
+
+    return dataclasses.asdict(result)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

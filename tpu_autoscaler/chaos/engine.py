@@ -1,0 +1,407 @@
+"""Scenario executor: drive a program through the real control loop.
+
+One run wires the production Controller (+ ClusterInformer in threadless
+pump mode when the program says so) against a FakeKube behind a
+brownout-injecting proxy and a FakeActuator with its seedable fault
+knobs, then steps simulated time exactly like ``sim.py``: events fire,
+the world is GC'd/recreated (a minimal Job-controller model), one
+reconcile pass runs crash-only, the toy scheduler binds, and the
+invariant monitor (``chaos/invariants.py``) checks every step.
+
+Two drive modes:
+
+- ``pump`` (default) — threadless, one deterministic interleaving;
+  fast enough for the 200-seed CI corpus;
+- ``sched`` — the same scenario under ``testing/sched.py``'s
+  DeterministicScheduler with REAL informer watch threads, sweeping
+  seeded interleavings; the expensive smoke tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time as _time
+
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.chaos.invariants import SLICE_LABEL, InvariantMonitor
+from tpu_autoscaler.chaos.scenario import ScenarioProgram, generate
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.engine.planner import PoolPolicy
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.sim import gang_pods
+
+log = logging.getLogger(__name__)
+
+#: Verbs the brownout proxy fails — every apiserver read/write the
+#: controller or informer performs (fixture mutators stay reachable:
+#: the engine injects through the inner FakeKube directly).
+_BROWNOUT_VERBS = frozenset({
+    "list_pods", "list_nodes", "list_pods_raw", "list_nodes_raw",
+    "patch_pod", "patch_node", "evict_pod", "delete_pod", "delete_node",
+    "create_event", "watch_pods", "watch_nodes", "get_lease", "put_lease",
+})
+
+
+class BrownoutKube:
+    """KubeClient proxy: every verb raises while a brownout window is
+    open (sim-clocked via ``set_now``).  The controller must degrade —
+    crash-only pass, informer unsync + relist — and converge after."""
+
+    def __init__(self, inner: FakeKube) -> None:
+        self._inner = inner
+        self._windows: list[tuple[float, float]] = []
+        self._now = 0.0
+
+    def set_now(self, now: float) -> None:
+        self._now = now
+
+    def add_window(self, start: float, end: float) -> None:
+        self._windows.append((start, end))
+
+    def in_brownout(self, now: float | None = None) -> bool:
+        now = self._now if now is None else now
+        return any(start <= now < end for start, end in self._windows)
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in _BROWNOUT_VERBS and callable(attr):
+            def guarded(*args, **kwargs):
+                if self.in_brownout():
+                    raise RuntimeError(
+                        "chaos: apiserver brownout (503 service "
+                        "unavailable)")
+                return attr(*args, **kwargs)
+
+            return guarded
+        return attr
+
+
+@dataclasses.dataclass
+class ChaosResult:
+    seed: int
+    ok: bool
+    violations: list[str]
+    passes: int
+    converged_at: float | None
+    description: str
+    wall_seconds: float
+    reconcile_errors: int
+    repairs: int
+
+    def describe(self) -> str:
+        status = "ok" if self.ok else "FAIL"
+        tail = "" if self.ok else (
+            "\n  " + "\n  ".join(self.violations[:5]))
+        conv = (f"converged@{self.converged_at:g}s"
+                if self.converged_at is not None else "never converged")
+        return (f"[{status}] {self.description} — {conv}, "
+                f"{self.passes} passes, {self.repairs} repairs, "
+                f"{self.reconcile_errors} brownout-pass errors, "
+                f"{self.wall_seconds:.2f}s wall{tail}")
+
+
+def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
+           informer) -> tuple[Controller, FakeActuator]:
+    import random
+
+    actuator = FakeActuator(
+        kube, rng=random.Random(program.seed ^ 0x5EED),
+        provision_delay=program.provision_delay,
+        stagger_seconds=program.stagger_seconds)
+    controller = Controller(
+        kube_for_controller, actuator,
+        ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0,
+                              max_total_chips=program.max_total_chips),
+            grace_seconds=30.0, idle_threshold_seconds=120.0,
+            drain_grace_seconds=20.0, provision_retry_seconds=30.0,
+            provision_timeout_seconds=150.0,
+            unhealthy_timeout_seconds=120.0,
+            slice_repair_after_seconds=30.0),
+        informer=informer)
+    return controller, actuator
+
+
+class _Run:
+    """One scenario execution (pump mode)."""
+
+    def __init__(self, program: ScenarioProgram):
+        from tpu_autoscaler.k8s.objects import clear_parse_caches
+
+        # Hermetic seeds: every FakeKube restarts uids/resourceVersions
+        # from 1, so the process-global (uid, rv) parse memo would hand
+        # one seed another seed's parsed objects.
+        clear_parse_caches()
+        self.program = program
+        self.kube = FakeKube()
+        self.proxy = BrownoutKube(self.kube)
+        self.informer = None
+        if program.informer:
+            from tpu_autoscaler.k8s.informer import ClusterInformer
+
+            self.informer = ClusterInformer(self.proxy, timeout_seconds=0)
+        self.controller, self.actuator = _build(
+            program, self.proxy, self.kube, self.informer)
+        self.monitor = InvariantMonitor(program.seed, self.kube,
+                                        self.controller)
+        self.live_jobs: dict[str, list[str]] = {}
+        self.arrived: set[str] = set()
+        self.passes = 0
+        self.reconcile_errors = 0
+        import random
+
+        self.rng = random.Random(program.seed ^ 0xC0FFEE)
+
+    # -- world model ------------------------------------------------------
+
+    def _arrivals(self, t: float) -> None:
+        for w in self.program.workloads:
+            if w.job in self.arrived or w.arrival > t:
+                continue
+            self.arrived.add(w.job)
+            names = []
+            for payload in gang_pods(w.shape, w.job,
+                                     pin_topology=w.pinned):
+                self.kube.add_pod(payload)
+                names.append(payload["metadata"]["name"])
+            self.live_jobs[w.job] = names
+
+    def _completions(self, t: float) -> None:
+        for w in self.program.workloads:
+            names = self.live_jobs.get(w.job)
+            if not names or w.completion_prob <= 0.0:
+                continue
+            if all((self.kube.get_pod("default", n) or {}).get(
+                    "status", {}).get("phase") == "Running"
+                   for n in names) \
+                    and self.rng.random() < w.completion_prob:
+                for n in names:
+                    self.kube.delete_pod("default", n)
+                del self.live_jobs[w.job]
+
+    def _node_gc_and_job_controller(self, t: float) -> None:
+        """Model the two cluster actors the fake lacks: node-lifecycle
+        GC (pods bound to deleted nodes are removed) and the Job
+        controller (missing members of a live job are recreated)."""
+        node_names = {n["metadata"]["name"]
+                      for n in self.kube.list_nodes()}
+        for p in list(self.kube.list_pods()):
+            bound = p["spec"].get("nodeName")
+            if bound and bound not in node_names:
+                self.kube.delete_pod(
+                    p["metadata"].get("namespace", "default"),
+                    p["metadata"]["name"])
+        by_job = {w.job: w for w in self.program.workloads}
+        for job, names in self.live_jobs.items():
+            missing = [n for n in names
+                       if self.kube.get_pod("default", n) is None]
+            if not missing:
+                continue
+            fresh = {p["metadata"]["name"]: p
+                     for p in gang_pods(by_job[job].shape, job,
+                                        pin_topology=by_job[job].pinned)}
+            for n in missing:
+                self.kube.add_pod(fresh[n])
+
+    # -- fault events -----------------------------------------------------
+
+    def _apply_event(self, event, t: float) -> None:
+        kind = event.kind
+        if kind == "brownout":
+            self.proxy.add_window(t, t + event.args["duration"])
+        elif kind == "watch_storm":
+            # Burst of irrelevant churn: annotation patches on existing
+            # pods flood the watch journal and the delta path.
+            pods = self.kube.list_pods()
+            for i in range(event.args["count"]):
+                if not pods:
+                    break
+                p = self.rng.choice(pods)
+                self.kube.patch_pod(
+                    p["metadata"].get("namespace", "default"),
+                    p["metadata"]["name"],
+                    {"metadata": {"annotations": {
+                        "chaos.tpu.dev/storm": str(i)}}})
+        elif kind == "flood_410":
+            self.kube.expire_watch_window()
+        elif kind == "stockout":
+            self.actuator.set_fail_window(t, t + event.args["duration"])
+        elif kind == "mid_provision_stockout":
+            self.actuator.fail_in_flight()
+        elif kind == "preempt":
+            unit = self._pick_busy_unit()
+            if unit is not None:
+                self.actuator.preempt_unit(unit)
+        elif kind == "host_fail":
+            victim = self._pick_victim_host()
+            if victim is not None:
+                if event.args["mode"] == "delete":
+                    self.monitor.injected_deletes.add(victim)
+                self.actuator.fail_host(victim, event.args["mode"])
+        else:
+            raise ValueError(f"unknown chaos event kind {kind!r}")
+
+    def _busy_slices(self) -> dict[str, list[str]]:
+        used: set[str] = set()
+        for p in self.kube.list_pods():
+            if p["spec"].get("nodeName") \
+                    and p["status"].get("phase") == "Running":
+                used.add(p["spec"]["nodeName"])
+        out: dict[str, list[str]] = {}
+        for n in self.kube.list_nodes():
+            labels = n["metadata"].get("labels", {})
+            sid = labels.get(SLICE_LABEL)
+            if sid and labels.get("cloud.google.com/gke-tpu-accelerator"):
+                out.setdefault(sid, []).append(n["metadata"]["name"])
+        return {sid: hosts for sid, hosts in out.items()
+                if any(h in used for h in hosts)}
+
+    def _pick_busy_unit(self) -> str | None:
+        busy = sorted(self._busy_slices())
+        return self.rng.choice(busy) if busy else None
+
+    def _pick_victim_host(self) -> str | None:
+        multi = {sid: hosts for sid, hosts in self._busy_slices().items()
+                 if len(hosts) > 1}
+        if not multi:
+            return None
+        sid = self.rng.choice(sorted(multi))
+        return self.rng.choice(sorted(multi[sid]))
+
+    # -- the loop ---------------------------------------------------------
+
+    def _step(self, t: float, events, completions: bool = True) -> None:
+        self.proxy.set_now(t)
+        for event in events:
+            self._apply_event(event, t)
+        self._arrivals(t)
+        self._node_gc_and_job_controller(t)
+        if completions:
+            self._completions(t)
+        if self.informer is not None:
+            self.informer.pump()
+        self.monitor.before_pass()
+        try:
+            self.controller.reconcile_once(now=t)
+        except Exception:  # noqa: BLE001 — crash-only, like run_forever
+            self.controller.metrics.inc("reconcile_errors")
+            self.reconcile_errors += 1
+            if not self.proxy.in_brownout(t):
+                log.exception("reconcile pass crashed outside a brownout")
+                self.monitor._fail(t, "crash-only-loop",
+                                   "reconcile pass raised outside any "
+                                   "brownout window")
+        self.passes += 1
+        self.kube.schedule_step()
+        self.monitor.after_pass(t)
+
+    def execute(self) -> ChaosResult:
+        t0 = _time.perf_counter()
+        program = self.program
+        pending_events = list(program.events)
+        t = 0.0
+        converged_at = None
+        # Driven phase: events fire on schedule.
+        while t <= program.until:
+            due = [e for e in pending_events if e.t <= t]
+            pending_events = [e for e in pending_events if e.t > t]
+            self._step(t, due)
+            t += program.step
+        # Settle phase: no new faults; run until converged or deadline.
+        deadline = program.until + program.settle
+        while t <= deadline:
+            self._step(t, ())
+            if self.monitor.check_converged(t, self.live_jobs):
+                converged_at = t
+                break
+            t += program.step
+        # Reclaim window: idle supply must drain to zero (the
+        # no-stranded-chips property needs the idle/grace/drain clocks
+        # to have run out).
+        reclaim_window = (self.controller.config.idle_threshold_seconds
+                          + self.controller.config.grace_seconds
+                          + self.controller.config.drain_grace_seconds
+                          + 4 * program.step)
+        if converged_at is not None:
+            # Completions freeze here: a job finishing mid-reclaim
+            # would reset the idle clocks the stranded check reads.
+            end = t + reclaim_window + 4 * program.step
+            while t <= end:
+                self._step(t, (), completions=False)
+                t += program.step
+        self.monitor.check_terminal(
+            t, self.live_jobs, converged=converged_at is not None,
+            reclaim_window=reclaim_window)
+        snap = self.controller.metrics.snapshot()
+        return ChaosResult(
+            seed=program.seed,
+            ok=not self.monitor.violations,
+            violations=[str(v) for v in self.monitor.violations],
+            passes=self.passes, converged_at=converged_at,
+            description=program.describe(),
+            wall_seconds=_time.perf_counter() - t0,
+            reconcile_errors=self.reconcile_errors,
+            repairs=int(snap["counters"].get("slice_repairs_started", 0)))
+
+
+def run_scenario(program_or_seed, *, profile: str = "mixed",
+                 drive: str = "pump", schedules: int = 3) -> ChaosResult:
+    """Execute one scenario program (or generate it from a seed).
+
+    ``drive="sched"`` replays the same program under the deterministic
+    scheduler with real informer watch threads, sweeping ``schedules``
+    seeded interleavings; the LAST interleaving's result is returned
+    with any earlier violation carried over.
+    """
+    program = (generate(program_or_seed, profile=profile)
+               if isinstance(program_or_seed, int) else program_or_seed)
+    if drive == "pump":
+        return _Run(program).execute()
+    if drive != "sched":
+        raise ValueError(f"unknown drive mode {drive!r}")
+    from tpu_autoscaler.testing.sched import run_schedule
+
+    results: list[ChaosResult] = []
+
+    def scenario(sched) -> None:
+        # Threaded twin of _Run: the normal constructor (informer
+        # forced on — interleaving coverage is the point), then live
+        # watch threads instead of the pump drive.
+        run = _Run(dataclasses.replace(program, informer=True))
+        run.informer.start()
+        # Threads pump the caches; _step still calls pump() — with live
+        # watches that is a no-op-ish double drain, so drop it.
+        run.informer.pump = lambda: None
+        try:
+            results.append(run.execute())
+        finally:
+            run.informer.stop()
+
+    for i in range(schedules):
+        run_schedule(scenario, seed=program.seed + i, max_steps=2_000_000)
+    merged = results[-1]
+    for r in results[:-1]:
+        if not r.ok:
+            merged = dataclasses.replace(
+                merged, ok=False, violations=r.violations + merged.violations)
+    return merged
+
+
+def run_corpus(seeds, *, profile: str = "mixed",
+               budget_seconds: float | None = None,
+               progress=None) -> tuple[list[ChaosResult], bool]:
+    """Run many seeds; returns (results, budget_blown).  Stops early —
+    with the flag set — if the wall-clock budget runs out before the
+    corpus completes, so CI fails loudly instead of hanging."""
+    t0 = _time.perf_counter()
+    results: list[ChaosResult] = []
+    for seed in seeds:
+        if budget_seconds is not None \
+                and _time.perf_counter() - t0 > budget_seconds:
+            return results, True
+        result = run_scenario(seed, profile=profile)
+        results.append(result)
+        if progress is not None:
+            progress(result)
+    return results, False

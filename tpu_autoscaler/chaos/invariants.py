@@ -1,0 +1,257 @@
+"""Property invariants asserted over every chaos step (docs/CHAOS.md).
+
+The monitor watches the fake cluster from OUTSIDE the controller — it
+reads the apiserver's verb log and node/pod store, plus the planner's
+in-flight view — so a controller bug cannot hide itself by also
+corrupting the evidence.  Engine-injected faults (host kills, node GC)
+are registered with the monitor so they are never mistaken for
+controller actions.
+
+Step invariants:
+
+- **running-pod safety / slice-atomic deletes** — a node the CONTROLLER
+  deletes never still hosts a live Running pod, and the controller's
+  TPU deletions always take the whole slice in one pass;
+- **no lone-host backfill** — once a slice id loses a host, no node is
+  ever added back under that id (replacement is a fresh slice);
+- **no double provision** — at most one planner-visible in-flight entry
+  (actuator in-flight + supply-guard holds) per gang key.
+
+Terminal invariants (after the quiet tail): convergence, no stranded
+chips, flight-recorder trace completeness (``obs.trace_gaps``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+SLICE_LABEL = "autoscaler.tpu.dev/slice-id"
+
+
+@dataclasses.dataclass
+class Violation:
+    seed: int
+    t: float
+    invariant: str
+    detail: str
+
+    def __str__(self) -> str:
+        return (f"[seed {self.seed} t={self.t:g}] {self.invariant}: "
+                f"{self.detail}")
+
+
+class InvariantMonitor:
+    """Step-wise property checks over one scenario run."""
+
+    def __init__(self, seed: int, kube, controller) -> None:
+        self.seed = seed
+        self._kube = kube
+        self._controller = controller
+        self.violations: list[Violation] = []
+        #: Node names the ENGINE deleted/killed (never the controller).
+        self.injected_deletes: set[str] = set()
+        self._verb_cursor = 0
+        # slice id -> (last host count seen, has shrunk below it)
+        self._slice_watermark: dict[str, tuple[int, bool]] = {}
+        # slice id -> sim time it was first observed workload-free
+        # (reset when busy): the stranded-chips clock.
+        self._idle_since: dict[str, float] = {}
+        self._pre_nodes: dict[str, dict] = {}
+        self._pre_running: dict[str, set[str]] = {}
+
+    def _fail(self, t: float, invariant: str, detail: str) -> None:
+        self.violations.append(Violation(self.seed, t, invariant, detail))
+
+    # -- per-step ---------------------------------------------------------
+
+    def before_pass(self) -> None:
+        self._pre_nodes = {n["metadata"]["name"]: n
+                           for n in self._kube.list_nodes()}
+        running: dict[str, set[str]] = {}
+        for p in self._kube.list_pods():
+            node = p["spec"].get("nodeName")
+            if node and p["status"].get("phase") == "Running":
+                running.setdefault(node, set()).add(
+                    p["metadata"]["name"])
+        self._pre_running = running
+
+    def after_pass(self, t: float) -> None:
+        new_verbs = self._kube.verb_log[self._verb_cursor:]
+        self._verb_cursor = len(self._kube.verb_log)
+        deleted = [v[1] for v in new_verbs if v[0] == "delete_node"
+                   and v[1] not in self.injected_deletes]
+        live_pods = {p["metadata"]["name"]
+                     for p in self._kube.list_pods()}
+        remaining = {n["metadata"]["name"]: n
+                     for n in self._kube.list_nodes()}
+
+        touched_slices: set[str] = set()
+        for name in deleted:
+            pre = self._pre_nodes.get(name)
+            if pre is None:
+                continue
+            still_running = self._pre_running.get(name, set()) & live_pods
+            if still_running:
+                self._fail(t, "running-pod-safety",
+                           f"controller deleted node {name} while pods "
+                           f"{sorted(still_running)} still exist Running")
+            sid = pre["metadata"].get("labels", {}).get(SLICE_LABEL)
+            if sid and pre["metadata"].get("labels", {}).get(
+                    "cloud.google.com/gke-tpu-accelerator"):
+                touched_slices.add(sid)
+        for sid in touched_slices:
+            survivors = [
+                name for name, n in remaining.items()
+                if n["metadata"].get("labels", {}).get(SLICE_LABEL) == sid
+                and name not in self.injected_deletes]
+            if survivors:
+                self._fail(t, "whole-slice-deletes",
+                           f"slice {sid} partially deleted; hosts "
+                           f"{sorted(survivors)} left behind")
+
+        # Lone-host backfill: a shrunk slice id never grows again.
+        counts: dict[str, int] = {}
+        for name, n in remaining.items():
+            sid = n["metadata"].get("labels", {}).get(SLICE_LABEL)
+            if sid and n["metadata"].get("labels", {}).get(
+                    "cloud.google.com/gke-tpu-accelerator"):
+                counts[sid] = counts.get(sid, 0) + 1
+        for sid, count in counts.items():
+            last, shrunk = self._slice_watermark.get(sid, (count, False))
+            if count < last:
+                shrunk = True
+            elif shrunk and count > last:
+                self._fail(t, "no-lone-host-backfill",
+                           f"slice {sid} regrew to {count} hosts after "
+                           f"losing one (ICI domains are replaced whole, "
+                           f"never backfilled)")
+            self._slice_watermark[sid] = (count, shrunk)
+        for sid in [s for s in self._slice_watermark if s not in counts]:
+            del self._slice_watermark[sid]  # slice fully gone; ids are fresh
+
+        # Idle clock per slice (feeds the terminal stranded-chips check).
+        busy: set[str] = set()
+        for p in self._kube.list_pods():
+            node = p["spec"].get("nodeName")
+            if node and p["status"].get("phase") == "Running":
+                n = remaining.get(node)
+                if n is not None:
+                    sid = n["metadata"].get("labels", {}).get(SLICE_LABEL)
+                    if sid:
+                        busy.add(sid)
+        for sid in counts:
+            if sid in busy:
+                self._idle_since.pop(sid, None)
+            else:
+                self._idle_since.setdefault(sid, t)
+        for sid in [s for s in self._idle_since if s not in counts]:
+            del self._idle_since[sid]
+
+        # Double provision: one planner-visible in-flight entry per key.
+        per_key: dict[tuple, int] = {}
+        for inf in self._controller._in_flight():
+            if inf.gang_key is not None:
+                per_key[inf.gang_key] = per_key.get(inf.gang_key, 0) + 1
+        for key, n in per_key.items():
+            if n > 1:
+                self._fail(t, "no-double-provision",
+                           f"{n} concurrent in-flight provisions for "
+                           f"gang {key} (supply guard breached)")
+
+    # -- terminal ---------------------------------------------------------
+
+    def check_converged(self, t: float, live_jobs: dict[str, list[str]]
+                        ) -> bool:
+        """True when every live job runs, nothing is pending or in
+        flight, and no repair is open — the convergence predicate."""
+        pods = {p["metadata"]["name"]: p for p in self._kube.list_pods()}
+        for names in live_jobs.values():
+            for name in names:
+                pod = pods.get(name)
+                if pod is None or pod["status"].get("phase") != "Running":
+                    return False
+        if any(p["status"].get("phase") == "Pending"
+               for p in pods.values()):
+            return False
+        if self._controller._in_flight():
+            return False
+        if self._controller._slice_repairs:
+            return False
+        return True
+
+    def check_terminal(self, t: float, live_jobs: dict[str, list[str]],
+                       *, converged: bool,
+                       reclaim_window: float) -> None:
+        if not converged:
+            pending = [p["metadata"]["name"]
+                       for p in self._kube.list_pods()
+                       if p["status"].get("phase") == "Pending"]
+            self._fail(t, "convergence",
+                       f"scenario did not converge: pending={pending} "
+                       f"in_flight={len(self._controller._in_flight())} "
+                       f"repairs={list(self._controller._slice_repairs)}")
+            return
+        # Stranded chips: every TPU slice either hosts Running workload,
+        # has been idle for less than the reclaim window, or is being
+        # drained (cordoned).  The idle clock comes from after_pass.
+        cordoned: set[str] = set()
+        for n in self._kube.list_nodes():
+            if n.get("spec", {}).get("unschedulable"):
+                sid = n["metadata"].get("labels", {}).get(SLICE_LABEL)
+                if sid:
+                    cordoned.add(sid)
+        for sid, since in sorted(self._idle_since.items()):
+            if sid in cordoned:
+                continue
+            idle_for = t - since
+            if idle_for > reclaim_window:
+                self._fail(t, "no-stranded-chips",
+                           f"slice {sid} idle for {idle_for:g}s — "
+                           f"capacity leaked past the reclaim window "
+                           f"({reclaim_window:g}s)")
+
+        # Gang ICI integrity: a live TPU gang's pods all share ONE
+        # slice — a gang silently split across ICI domains (the
+        # lone-host-backfill failure mode seen end-to-end) "runs" by
+        # pod phase while the job's collective is broken.
+        nodes_by_name = {n["metadata"]["name"]: n
+                         for n in self._kube.list_nodes()}
+        pods_by_name = {p["metadata"]["name"]: p
+                        for p in self._kube.list_pods()}
+        for job, names in sorted(live_jobs.items()):
+            slices: set[str] = set()
+            for name in names:
+                pod = pods_by_name.get(name)
+                node = nodes_by_name.get(
+                    (pod or {}).get("spec", {}).get("nodeName", ""))
+                if node is None:
+                    continue
+                sid = node["metadata"].get("labels", {}).get(SLICE_LABEL)
+                if sid and node["metadata"].get("labels", {}).get(
+                        "cloud.google.com/gke-tpu-accelerator"):
+                    slices.add(sid)
+            if len(slices) > 1:
+                self._fail(t, "gang-ici-integrity",
+                           f"job {job} runs split across slices "
+                           f"{sorted(slices)} — one gang, one ICI "
+                           f"domain")
+
+        # Flight-recorder completeness: every finished trace is whole.
+        from tpu_autoscaler.obs import trace_gaps
+
+        dump = self._controller.recorder.dump(
+            tracer=self._controller.tracer)
+        finished: set[str] = set()
+        for span in dump["spans"]:
+            if span["name"] in ("scale_up", "slice_repair") \
+                    and span["parent_id"] is None \
+                    and span["end"] is not None:
+                finished.add(span["trace_id"])
+        for trace_id in sorted(finished):
+            for gap in trace_gaps(dump, trace_id):
+                self._fail(t, "trace-completeness", gap)
+        for span in dump.get("active_spans", []):
+            if span["name"] in ("scale_up", "slice_repair"):
+                self._fail(t, "trace-completeness",
+                           f"trace {span['trace_id']}: {span['name']} "
+                           f"span still open after convergence")

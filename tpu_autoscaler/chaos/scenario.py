@@ -1,0 +1,151 @@
+"""Scenario grammar: seed -> scenario program (docs/CHAOS.md).
+
+A program is workloads plus a time-ordered fault schedule.  Generation
+is a pure function of the seed (``random.Random(seed)``), so every
+corpus failure replays exactly from its seed number.  Fault windows all
+close before the quiet tail, making convergence a decidable property.
+
+Event kinds (args in parentheses):
+
+- ``brownout`` (duration)     — apiserver fails every verb in a window;
+- ``watch_storm`` (count)     — burst of irrelevant object churn;
+- ``flood_410``               — etcd compaction: watch cursors go stale,
+                                every watcher must relist;
+- ``stockout`` (duration)     — provisions in flight FAIL (zone dry);
+- ``mid_provision_stockout``  — provisions already in flight FAIL once;
+- ``preempt``                 — impending-termination taint on a random
+                                busy unit (spot reclaim notice);
+- ``host_fail`` (mode)        — one host of a live multi-host slice
+                                goes NotReady or is deleted (the
+                                partial-slice failure slice repair
+                                exists for).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+#: Multi-host shapes small enough to keep a corpus seed sub-second;
+#: v5p-16 covers the acceptance scenario's generation (4-host v5p).
+GANG_SHAPES = ("v5e-8", "v5e-16", "v5e-32", "v5p-16")
+
+#: Sim-seconds of guaranteed fault-free tail before convergence is
+#: judged (every generated event fires before ``until - QUIET_TAIL``).
+QUIET_TAIL = 300.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    t: float
+    kind: str
+    args: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    job: str
+    shape: str
+    arrival: float
+    # Per-step completion probability once fully Running (0 = runs to
+    # scenario end; the terminal convergence check then requires it
+    # Running).
+    completion_prob: float = 0.0
+    # False = accelerator-only selectors (no topology pin): the fitter
+    # sizes from observed chip demand — the surface partial-gang
+    # planning bugs live on.
+    pinned: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioProgram:
+    seed: int
+    step: float
+    until: float                  # end of the driven (event) phase
+    settle: float                 # extra sim-seconds allowed to converge
+    workloads: tuple[Workload, ...]
+    events: tuple[Event, ...]
+    informer: bool                # cached observe path vs serial LISTs
+    provision_delay: float
+    stagger_seconds: float
+    max_total_chips: int
+
+    def describe(self) -> str:
+        kinds: dict[str, int] = {}
+        for e in self.events:
+            kinds[e.kind] = kinds.get(e.kind, 0) + 1
+        faults = ",".join(f"{k}x{n}" for k, n in sorted(kinds.items())) \
+            or "none"
+        return (f"seed={self.seed} jobs={len(self.workloads)} "
+                f"({'/'.join(w.shape for w in self.workloads)}) "
+                f"faults=[{faults}] informer={self.informer} "
+                f"delay={self.provision_delay:g}s "
+                f"clamp={self.max_total_chips}")
+
+
+def generate(seed: int, *, profile: str = "mixed") -> ScenarioProgram:
+    """Compile one seeded scenario program.
+
+    Profiles narrow the fault alphabet for triage (docs/CHAOS.md):
+    ``mixed`` (default, everything), ``faults`` (no API-layer chaos),
+    ``api`` (only API-layer chaos), ``repair`` (always a host failure).
+    """
+    if profile not in ("mixed", "faults", "api", "repair"):
+        raise ValueError(f"unknown chaos profile {profile!r}")
+    rng = random.Random(seed)
+    informer = rng.random() < 0.7
+    jobs = rng.randint(1, 3)
+    workloads = []
+    for i in range(jobs):
+        shape = rng.choice(GANG_SHAPES)
+        if profile == "repair" and i == 0:
+            # Guarantee a multi-host victim for the host failure.
+            shape = rng.choice(("v5e-16", "v5e-32", "v5p-16"))
+        workloads.append(Workload(
+            job=f"chaos-{seed}-{i}", shape=shape,
+            arrival=rng.uniform(0.0, 120.0),
+            completion_prob=rng.choice((0.0, 0.0, 0.01)),
+            pinned=rng.random() < 0.6))
+
+    api_chaos = profile in ("mixed", "api")
+    fault_chaos = profile in ("mixed", "faults", "repair")
+    events: list[Event] = []
+
+    def fire(probability: float) -> bool:
+        return rng.random() < probability
+
+    if api_chaos and fire(0.5):
+        start = rng.uniform(60.0, 260.0)
+        events.append(Event(start, "brownout",
+                            {"duration": rng.uniform(15.0, 60.0)}))
+    if api_chaos and informer and fire(0.4):
+        events.append(Event(rng.uniform(30.0, 300.0), "watch_storm",
+                            {"count": rng.randint(20, 60)}))
+    if api_chaos and informer and fire(0.4):
+        for _ in range(rng.randint(1, 3)):
+            events.append(Event(rng.uniform(30.0, 300.0), "flood_410"))
+    if fault_chaos and fire(0.5):
+        start = rng.uniform(0.0, 200.0)
+        events.append(Event(start, "stockout",
+                            {"duration": rng.uniform(30.0, 120.0)}))
+    if fault_chaos and fire(0.35):
+        events.append(Event(rng.uniform(20.0, 260.0),
+                            "mid_provision_stockout"))
+    if fault_chaos and fire(0.3):
+        events.append(Event(rng.uniform(150.0, 330.0), "preempt"))
+    if profile == "repair" or (fault_chaos and fire(0.5)):
+        events.append(Event(
+            rng.uniform(150.0, 330.0), "host_fail",
+            {"mode": rng.choice(("notready", "delete"))}))
+
+    events.sort(key=lambda e: e.t)
+    last = max([e.t + e.args.get("duration", 0.0) for e in events],
+               default=0.0)
+    until = max(last, 120.0) + QUIET_TAIL
+    return ScenarioProgram(
+        seed=seed, step=5.0, until=until, settle=600.0,
+        workloads=tuple(workloads), events=tuple(events),
+        informer=informer,
+        provision_delay=rng.choice((10.0, 30.0, 60.0)),
+        stagger_seconds=rng.choice((0.0, 0.0, 5.0)),
+        max_total_chips=rng.choice((256, 1024)))

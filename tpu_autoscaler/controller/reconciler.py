@@ -77,6 +77,22 @@ class ControllerConfig:
     drain_grace_seconds: float = 120.0
     # A Ready slice with a NotReady host is replaced after this long.
     unhealthy_timeout_seconds: float = 600.0
+    # ICI-atomic slice repair (ISSUE 7): a broken slice that still hosts
+    # Running workload pods is repaired — cordon + checkpoint-drain the
+    # gang, replace the WHOLE slice as one unit (never a lone host into
+    # an ICI domain), with advisory replacement demand fed to the
+    # planner so provisioning overlaps the drain.
+    enable_slice_repair: bool = True
+    # Flap window before a NotReady host inside a workload-bearing slice
+    # triggers repair.  A host whose Node object was DELETED repairs
+    # immediately: the apiserver affirmatively removed it, there is
+    # nothing to flap.  (unhealthy_timeout_seconds still governs
+    # workload-free unhealthy slices — nothing to repair toward.)
+    slice_repair_after_seconds: float = 120.0
+    # Give up TRACKING a repair after this long (span closes abandoned,
+    # supply-guard holds release); the normal drain/backoff machinery
+    # keeps converging regardless — this only bounds bookkeeping.
+    slice_repair_timeout_seconds: float = 3600.0
     # Backoff before re-provisioning after a FAILED provision (the
     # reference's blunt one-deployment-at-a-time serialization throttled
     # retries implicitly; we need it explicit).
@@ -246,6 +262,11 @@ class Controller:
         # submitted) has been observed; swept with _gang_first_pending.
         self._gang_detect_observed: set[tuple] = set()
         self._drain_started: dict[str, float] = {}
+        # First time each supply unit was observed, for the orphaned
+        # partial-slice reclaim (fuzzer-found: a provision that FAILs
+        # after materializing SOME hosts leaks a forever-PROVISIONING
+        # partial slice nothing else cleans up).
+        self._unit_first_seen: dict[str, float] = {}
         # Drains begun for idleness (not requested/unhealthy) may be
         # cancelled if matching demand appears before deletion.
         self._drain_cancellable: set[str] = set()
@@ -263,6 +284,12 @@ class Controller:
         self._fallback_noted: dict[object, str] = {}
         # Provision submit times, for the provision_latency_seconds metric.
         self._submitted_at: dict[str, float] = {}
+        # Trace roots captured at dispatch time, per provision id: a
+        # provision can resolve AFTER its gang's trace closed (the gang
+        # ran off other supply while this one raced), and its
+        # provision/provision_failed span must still land in the trace
+        # that dispatched it (fuzzer-found: "missing provision span").
+        self._provision_roots: dict[str, list[Span]] = {}
         # Gang size observations for the settle window: key -> (size,
         # last-grown timestamp); swept alongside _gang_first_pending.
         self._gang_sizes: dict[tuple, tuple[int, float]] = {}
@@ -278,6 +305,16 @@ class Controller:
         self._requested_drains: set[str] = set()
         self._seen_namespaces: set[str] = set()
         self._last_pass_at: float | None = None
+        # ICI-atomic slice repair (ISSUE 7): unit id -> repair
+        # bookkeeping (root span, drain span, served gang keys, the
+        # like-for-like replacement shape, linked provision id).
+        # Reconcile-thread-only; bounded by slice_repair_timeout.
+        self._slice_repairs: dict[str, dict] = {}
+        # slice_repair root spans by gang key, so replacement
+        # provisions trace under the repair root (_trace_roots).
+        self._repair_roots: dict[tuple, Span] = {}
+        self.metrics.declare_histogram("slice_repair_seconds",
+                                       LATENCY_BUCKETS)
 
     # ------------------------------------------------------------------ #
 
@@ -315,6 +352,27 @@ class Controller:
         # settling gang will bind to.
         settled_gangs = self._settled(gangs, now)
 
+        # ICI-atomic slice repair (ISSUE 7): advisory replacement demand
+        # for units under repair, plus pending gangs whose siblings are
+        # still bound to a broken/draining slice — those are sized only
+        # as part of the whole-gang repair, NEVER solo (a recreated
+        # member planned alone would backfill a lone host's worth of
+        # capacity into a job that needs one ICI domain).
+        advisory, repair_deferred = self._repair_advisory(
+            nodes, pods, gangs, now)
+        self.metrics.set_gauge("gangs_deferred_to_repair",
+                               len(repair_deferred))
+        if repair_deferred:
+            settled_gangs = [g for g in settled_gangs
+                             if g.key not in repair_deferred]
+            for key in repair_deferred:
+                # Force a re-plan when the gang stops being deferred —
+                # a stale matching digest must not skip it.
+                self._gang_plan_digests.pop(key, None)
+                self._explain(key, "planning deferred to slice repair",
+                              "gang members still bound to a broken or "
+                              "draining slice; sized whole, never solo")
+
         # Cancel idle-reclaim drains that pending demand claims BEFORE
         # planning, so the planner sees the uncordoned slice as supply
         # instead of provisioning a redundant replacement.
@@ -338,9 +396,14 @@ class Controller:
                                                  nodes, now)
         if not self.config.no_scale:
             self._scale(plan_gangs, nodes, pods, now,
-                        all_gangs=settled_gangs, plan_mode=plan_mode)
+                        all_gangs=settled_gangs, plan_mode=plan_mode,
+                        advisory=advisory)
         if not self.config.no_maintenance:
-            self._maintain(nodes, pods, now, pending_gangs=gangs)
+            # Advisory repair gangs count as pending demand for the
+            # reclaim-deferral check: an idle slice the repair will
+            # hand the gang to must not be reclaimed meanwhile.
+            self._maintain(nodes, pods, now,
+                           pending_gangs=gangs + [g for g, _ in advisory])
 
         # Bound long-run memory: drop bookkeeping for demands/provisions
         # that no longer exist (actuators prune terminal statuses; gangs
@@ -349,6 +412,9 @@ class Controller:
         self._seen_failures &= live_status_ids
         self._submitted_at = {k: v for k, v in self._submitted_at.items()
                               if k in live_status_ids}
+        self._provision_roots = {
+            k: v for k, v in self._provision_roots.items()
+            if k in live_status_ids}
         live_gang_keys = {p.gang_key for p in pods}
         self._reported_unsatisfiable &= live_gang_keys
         for key in [k for k, t in self._retry_at.items()
@@ -523,6 +589,23 @@ class Controller:
                 self._explain(pid, "supply-guard released",
                               "all units registered as nodes")
             elif now - since > self.config.provision_timeout_seconds:
+                if self._repair_depends_on(_inf.gang_key):
+                    # An in-flight slice repair rides this provision:
+                    # expiring the entry would show the planner neither
+                    # in-flight work nor supply for the gang mid-repair
+                    # — phantom free capacity, then a double provision.
+                    # Hold the guard (refresh the clock) until the
+                    # repair completes or is abandoned; repairs are
+                    # themselves bounded (slice_repair_timeout), so the
+                    # hold cannot live forever.
+                    self._supply_awaiting_nodes[pid] = (_inf, unit_ids,
+                                                        now)
+                    self.metrics.inc("supply_guard_repair_holds")
+                    self._explain(pid, "supply-guard held",
+                                  "registration overdue but a slice "
+                                  "repair depends on this provision "
+                                  "staying planner-visible")
+                    continue
                 del self._supply_awaiting_nodes[pid]
                 self.metrics.inc("supply_guard_expired")
                 self.tracer.end(self._registration_spans.pop(pid, None),
@@ -538,6 +621,263 @@ class Controller:
         return (in_flight_of(self.actuator)
                 + [inf for inf, _, _ in
                    self._supply_awaiting_nodes.values()])
+
+    # ---- ICI-atomic slice repair (ISSUE 7) -----------------------------
+
+    def _repair_depends_on(self, gang_key) -> bool:
+        """Whether an active repair rides the given gang key's
+        provision (the supply-guard hold predicate)."""
+        return (gang_key is not None
+                and any(gang_key in st["gang_keys"]
+                        for st in self._slice_repairs.values()))
+
+    def _repair_advisory(self, nodes: list[Node], pods: list[Pod],
+                         gangs: list[Gang], now: float
+                         ) -> tuple[list[tuple[Gang, str]], set[tuple]]:
+        """Advisory replacement demand for active repairs, and the
+        pending gang keys to withhold from solo planning.
+
+        Broken units: under repair, carrying a cordoned/NotReady host,
+        or missing a host outright (fewer nodes than the shape's count
+        after the readiness barrier once cleared).  Any pending gang
+        with members still bound to one is deferred — sizing the
+        pending fraction alone is exactly the lone-host backfill the
+        ICI contract forbids; crash-only on purpose: derived from
+        observed node state, not repair memory, so a restarted
+        controller still never backfills mid-drain.
+
+        Advisory demand is built only for units in ``_slice_repairs``:
+        the full gang (members of every phase) paired with the broken
+        unit's OWN shape — a like-for-like replacement the planner
+        admits with its normal algebra (plan.deferred when clamped).
+        """
+        from tpu_autoscaler.topology.catalog import shape_from_selectors
+
+        units = self._units(nodes)
+        unready: set[str] | None = None
+        if self.informer is not None \
+                and hasattr(self.informer, "unready_nodes"):
+            sel = self.informer.unready_nodes()
+            if sel is not None:
+                # O(failures) read off the readiness index — the
+                # node-failure delta surface (docs/INFORMER.md).
+                unready = {n.name for n in sel}
+        broken: dict[str, list[Node]] = {}
+        for unit_id, unit_nodes in units.items():
+            if not unit_nodes[0].is_tpu:
+                continue
+            if unit_id in self._slice_repairs:
+                broken[unit_id] = unit_nodes
+                continue
+            if unready is not None:
+                damaged = any(n.name in unready for n in unit_nodes)
+            else:
+                damaged = any(n.unschedulable or not n.is_ready
+                              for n in unit_nodes)
+            if not damaged:
+                # Fewer hosts than the shape says: a host deleted from
+                # a live slice, OR a partial slice still materializing
+                # (or never completing — a failed staggered provision).
+                # Both defer solo planning of any gang with members
+                # aboard: the remainder must never be sized against an
+                # incomplete ICI domain.
+                try:
+                    shape = shape_from_selectors(unit_nodes[0].labels)
+                except KeyError:
+                    shape = None
+                damaged = (shape is not None
+                           and len(unit_nodes) < shape.hosts)
+            if damaged:
+                broken[unit_id] = unit_nodes
+        if not broken:
+            return [], set()
+
+        broken_nodes = [n for uns in broken.values() for n in uns]
+        by_node = self._pods_by_node(broken_nodes, pods)
+        broken_keys: set[tuple] = set()
+        for unit_nodes in broken.values():
+            for n in unit_nodes:
+                for p in by_node.get(n.name, ()):
+                    if p.is_workload and p.gang_key is not None:
+                        broken_keys.add(p.gang_key)
+        deferred = {g.key for g in gangs if g.key in broken_keys}
+
+        advisory: list[tuple[Gang, str]] = []
+        emitted: set[tuple] = set()
+        for unit_id, st in self._slice_repairs.items():
+            unit_names = {n.name for n in broken.get(unit_id, ())}
+            for key in st["gang_keys"]:
+                if key in emitted:
+                    continue
+                members = self._gang_members(pods, key)
+                if not members:
+                    continue  # eviction gap; the in-flight entry covers it
+                if any(p.node_name and p.node_name not in unit_names
+                       and p.phase == "Running" for p in members):
+                    # A member already runs OFF the broken unit: the
+                    # replacement landed (or is landing) and the rest
+                    # of the gang binds beside it — more advisory
+                    # demand would double-provision the repair.
+                    continue
+                emitted.add(key)
+                advisory.append((Gang(key=key, pods=members),
+                                 st["shape_name"]))
+        return advisory, deferred
+
+    def _maybe_start_repair(self, unit_id: str, unit_nodes: list[Node],
+                            unit_pods: list[Pod], now: float) -> None:
+        """Open an ICI-atomic repair for a broken, workload-bearing TPU
+        slice: whole-slice cordon + checkpoint drain now, advisory
+        like-for-like replacement demand from the next pass on."""
+        from tpu_autoscaler.topology.catalog import shape_from_selectors
+
+        if unit_id in self._slice_repairs \
+                or unit_id in self._drain_started:
+            return
+        try:
+            shape = shape_from_selectors(unit_nodes[0].labels)
+        except KeyError:
+            shape = None
+        if shape is None:
+            # Unknown shape: no like-for-like replacement to name —
+            # fall back to the plain unhealthy-replace path.
+            self._handle_unhealthy_legacy(unit_id, unit_nodes, unit_pods,
+                                          now)
+            return
+        missing = len(unit_nodes) < shape.hosts
+        since = self._unhealthy_since.setdefault(unit_id, now)
+        if not missing \
+                and now - since < self.config.slice_repair_after_seconds:
+            return  # NotReady flap window still open
+        gang_keys = tuple(sorted({p.gang_key for p in unit_pods
+                                  if p.is_workload
+                                  and p.gang_key is not None}))
+        why = (("slice short of hosts (deleted from a live slice, or a "
+                "partial slice that never completed)") if missing
+               else "NotReady host in live slice")
+        span = self.tracer.start(
+            "slice_repair", trace_id=self.tracer.new_trace("repair"),
+            t=now, attrs={"unit": unit_id, "reason": why,
+                          "shape": shape.name,
+                          "gangs": [("/".join(str(p) for p in k))
+                                    for k in gang_keys]})
+        drain_span = self.tracer.start("repair_drain", parent=span, t=now,
+                                       attrs={"unit": unit_id})
+        self._slice_repairs[unit_id] = {
+            "gang_keys": gang_keys, "shape_name": shape.name,
+            "started": now, "span": span, "drain_span": drain_span,
+            "provision_id": None,
+        }
+        for key in gang_keys:
+            self._repair_roots[key] = span
+        self.metrics.inc("slice_repairs_started")
+        log.warning("slice repair: %s (%s) — cordon + drain, replacing "
+                    "the whole slice", unit_id, why)
+        self._explain(unit_id, "slice repair started", why,
+                      shape=shape.name)
+        self._notify(f"repairing {unit_id}: {why}; replacing the whole "
+                     f"slice ({shape.name})")
+        self._begin_drain(unit_id, unit_nodes, unit_pods, now,
+                          reason=f"slice repair: {why}")
+
+    def _end_repair(self, unit_id: str, st: dict, now: float, *,
+                    outcome: str, attrs: dict | None = None,
+                    metric: str | None = None) -> None:
+        self.tracer.end(st.pop("drain_span", None), t=now)
+        self.tracer.end(st["span"], t=now, attrs=attrs, metric=metric,
+                        value=(now - st["started"]) if metric else None)
+        for key in st["gang_keys"]:
+            if self._repair_roots.get(key) is st["span"]:
+                del self._repair_roots[key]
+        del self._slice_repairs[unit_id]
+        self._unhealthy_since.pop(unit_id, None)
+        self._explain(unit_id, f"slice repair {outcome}")
+
+    def _sweep_repairs(self, units: dict[str, list[Node]],
+                       pods: list[Pod], now: float) -> None:
+        """Advance repair bookkeeping: close repairs whose gang runs
+        again on healthy supply, bound every repair by the timeout."""
+        for unit_id, st in list(self._slice_repairs.items()):
+            if now - st["started"] \
+                    > self.config.slice_repair_timeout_seconds:
+                self.metrics.inc("slice_repairs_abandoned")
+                log.warning("slice repair for %s abandoned after %.0fs",
+                            unit_id, now - st["started"])
+                self._end_repair(unit_id, st, now, outcome="abandoned",
+                                 attrs={"error": "repair timed out"})
+                continue
+            if unit_id in units:
+                continue  # broken unit still draining/deleting
+            if st.get("drain_span") is not None:
+                self.tracer.end(st.pop("drain_span"), t=now)
+            members = [p for key in st["gang_keys"]
+                       for p in self._gang_members(pods, key)]
+            if members and all(p.phase == "Running" for p in members):
+                latency = now - st["started"]
+                self.metrics.inc("slice_repairs_completed")
+                log.info("slice repair for %s complete in %.1fs",
+                         unit_id, latency)
+                self._notify(f"slice repair complete: {unit_id} replaced "
+                             f"in {latency:.0f}s")
+                self._end_repair(unit_id, st, now, outcome="completed",
+                                 attrs={"latency_s": round(latency, 3)},
+                                 metric="slice_repair_seconds")
+
+    def _note_repair_provision(self, req, status, now: float) -> None:
+        """Link a just-dispatched provision to the repair it serves."""
+        if req.gang_key is None:
+            return
+        for st in self._slice_repairs.values():
+            if req.gang_key in st["gang_keys"]:
+                st["provision_id"] = status.id
+                self.tracer.event(st["span"], "replacement_submitted",
+                                  {"provision_id": status.id,
+                                   "shape": req.shape_name}, t=now)
+
+    # ---- observe-side index reads (ISSUE 7 satellite) ------------------
+
+    def _pod_cache(self):
+        cache = getattr(self.informer, "pod_cache", None) \
+            if self.informer is not None else None
+        return cache if cache is not None and cache.synced else None
+
+    def _pods_by_node(self, nodes: list[Node], pods: list[Pod]
+                      ) -> dict[str, list[Pod]]:
+        """Pending/Running pods bound to the given nodes, keyed by node
+        name — the informer's node index when synced (O(result)), else
+        one scan of the pod snapshot.  The index may run a delta ahead
+        of the pass's snapshot; maintenance states all sit behind grace
+        windows, so a one-delta skew only shifts a decision by a pass.
+        """
+        names = [n.name for n in nodes]
+        cache = self._pod_cache()
+        if cache is not None:
+            hits = cache.select_many("node", names)
+            if hits is not None:
+                out: dict[str, list[Pod]] = {}
+                for name, sel in zip(names, hits):
+                    kept = [p for p in sel
+                            if p.phase in ("Pending", "Running")]
+                    if kept:
+                        out[name] = kept
+                return out
+        wanted = set(names)
+        out = {}
+        for p in pods:
+            if p.node_name in wanted \
+                    and p.phase in {"Pending", "Running"}:
+                out.setdefault(p.node_name, []).append(p)
+        return out
+
+    def _gang_members(self, pods: list[Pod], key: tuple) -> list[Pod]:
+        """All pods of one gang (any phase) — the informer's gang index
+        when synced, else a snapshot scan."""
+        cache = self._pod_cache()
+        if cache is not None:
+            sel = cache.select("gang", key)
+            if sel is not None:
+                return sel
+        return [p for p in pods if p.gang_key == key]
 
     # ---- delta-driven planning (ISSUE 6) -------------------------------
 
@@ -786,15 +1126,24 @@ class Controller:
     def _trace_roots(self, request) -> list[Span]:
         """Root spans of every pending gang a provision serves (the
         multislice cohort's members each get the story in their own
-        trace; CPU requests aggregate demand and map to no one gang)."""
+        trace; CPU requests aggregate demand and map to no one gang).
+        A gang under ICI-atomic repair adds its ``slice_repair`` root,
+        so replacement provisions trace under the repair story too —
+        and are the ONLY root while the gang's pods are still Running
+        on the broken slice (repair-ahead provisioning)."""
         keys: list[tuple] = []
         if request.gang_key is not None:
             keys.append(request.gang_key)
         for key in request.gang_keys or ():
             if key not in keys:
                 keys.append(key)
-        return [self._gang_traces[k] for k in keys
-                if k in self._gang_traces]
+        roots = [self._gang_traces[k] for k in keys
+                 if k in self._gang_traces]
+        for key in keys:
+            span = self._repair_roots.get(key)
+            if span is not None and all(span is not r for r in roots):
+                roots.append(span)
+        return roots
 
     def _fresh_nodes(self) -> list[Node]:
         """Direct LIST, bypassing the informer cache (memo-parsed, so
@@ -888,12 +1237,15 @@ class Controller:
     def _scale(self, gangs: list[Gang], nodes: list[Node],
                pods: list[Pod], now: float,
                all_gangs: list[Gang] | None = None,
-               plan_mode: str = "full") -> None:
+               plan_mode: str = "full",
+               advisory: list[tuple[Gang, str]] = ()) -> None:
         # ``gangs`` is the planning scope (all settled gangs in full
         # mode; only input-changed ones in delta mode); ``all_gangs``
         # is the complete settled list, used for side-effect-bearing
         # bookkeeping that must not depend on the scope and for the
-        # verify-mode full plan.
+        # verify-mode full plan.  ``advisory`` is slice-repair
+        # replacement demand (gang, like-for-like shape) the planner
+        # admits alongside — always in scope, never delta-skipped.
         if all_gangs is None:
             all_gangs = gangs
         # Process failures FIRST so a provision that failed since last pass
@@ -903,13 +1255,21 @@ class Controller:
         t_plan = time.perf_counter()
         in_flight = self._in_flight()
         plan = self.planner.plan(gangs, nodes, pods, in_flight,
-                                 generation_overrides=overrides)
+                                 generation_overrides=overrides,
+                                 advisory_gangs=advisory)
         self._pass_plan_s = time.perf_counter() - t_plan
+        for gang, reason in plan.deferred:
+            # Repair demand waiting for clamp/quota headroom: explained,
+            # never reported unsatisfiable (the gang is not stuck — its
+            # replacement is queued behind policy).
+            self._explain(gang.name, "repair provisioning deferred",
+                          reason)
         if plan_mode == "delta" and self.config.verify_delta_plans:
             # Parity gate (tests/bench): the incremental path must
             # produce byte-identical requests to full planning.
             full = self.planner.plan(all_gangs, nodes, pods, in_flight,
-                                     generation_overrides=overrides)
+                                     generation_overrides=overrides,
+                                     advisory_gangs=advisory)
             if full.requests != plan.requests:
                 self.metrics.inc("delta_plan_mismatches")
                 log.error(
@@ -932,6 +1292,7 @@ class Controller:
             status = self._dispatch_provision(req, now)
             log.info("provisioning %s x%d (%s): %s", req.shape_name,
                      req.count, status.id, req.reason)
+            self._note_repair_provision(req, status, now)
             self._submitted_at[status.id] = now
             self.metrics.inc("provisions_submitted")
             self._explain(req.gang_key or ("shape", req.shape_name),
@@ -969,6 +1330,12 @@ class Controller:
                 self._explain(gang.name, "not provisioned",
                               "preemption is making room")
                 continue  # being actively made room for: not unsatisfiable
+            if self._repair_depends_on(gang.key):
+                # Clamp-blocked only until the repair deletes the broken
+                # slice — room is being made, same as preemption.
+                self._explain(gang.name, "not provisioned",
+                              "slice repair is making room")
+                continue
             self._explain(gang.name, "unsatisfiable", reason)
             if gang.key not in self._reported_unsatisfiable:
                 self._reported_unsatisfiable.add(gang.key)
@@ -1030,6 +1397,7 @@ class Controller:
         t_d_end = t_plan_end + (time.perf_counter() - t_d0)
         self.tracer.end(dspan, t=t_d_end,
                         attrs={"provision_id": status.id})
+        self._provision_roots[status.id] = roots
         for root in roots[1:]:
             # Multislice siblings: each member's trace carries the
             # shared dispatch (same timestamps, cross-linked by id).
@@ -1261,8 +1629,11 @@ class Controller:
                 # provision_latency_seconds histogram so the metric is
                 # observed exactly once per provision — gang-less
                 # provisions (CPU aggregate, spares) keep the direct
-                # observation.
-                roots = self._trace_roots(status.request)
+                # observation.  Dispatch-time roots win: the gang's
+                # trace may have closed since (it ran off other supply)
+                # and the span still belongs in it.
+                roots = (self._provision_roots.pop(status.id, None)
+                         or self._trace_roots(status.request))
                 for i, root in enumerate(roots):
                     self.tracer.record(
                         "provision", start=submitted, end=now, parent=root,
@@ -1303,7 +1674,8 @@ class Controller:
             if status.state == FAILED and status.id not in self._seen_failures:
                 self._seen_failures.add(status.id)
                 self.metrics.inc("provision_failures")
-                for root in self._trace_roots(status.request):
+                for root in (self._provision_roots.pop(status.id, None)
+                             or self._trace_roots(status.request)):
                     self.tracer.record(
                         "provision_failed",
                         start=self._submitted_at.get(status.id, now),
@@ -1393,12 +1765,18 @@ class Controller:
                            "pods": gang.size})
         if not self._gang_first_pending:
             return
-        by_key: dict[tuple, list[Pod]] = {}
-        for p in pods:
-            by_key.setdefault(p.gang_key, []).append(p)
+        # Tracked gangs read off the informer's gang index when synced
+        # — O(tracked gangs) instead of a full pod-list scan per pass
+        # (the ISSUE 6 leftover); one scan-built map otherwise.
+        by_key: dict[tuple, list[Pod]] | None = None
+        if self._pod_cache() is None:
+            by_key = {}
+            for p in pods:
+                by_key.setdefault(p.gang_key, []).append(p)
         node_by_name = {n.name: n for n in nodes}
         for key, first in list(self._gang_first_pending.items()):
-            members = by_key.get(key, [])
+            members = (by_key.get(key, []) if by_key is not None
+                       else self._gang_members(pods, key))
             if members and all(p.phase == "Running" for p in members):
                 latency = now - first
                 root = self._gang_traces.pop(key, None)
@@ -1443,7 +1821,10 @@ class Controller:
                         attrs={"aborted": "pods deleted while pending"})
                 del self._gang_first_pending[key]
                 self._gang_detect_observed.discard(key)
-        live_keys = {p.gang_key for p in pods}
+        cache = self._pod_cache()
+        index_keys = cache.index_keys("gang") if cache is not None else None
+        live_keys = (set(index_keys) if index_keys is not None
+                     else {p.gang_key for p in pods})
         for key in [k for k in self._gang_sizes if k not in live_keys]:
             del self._gang_sizes[key]
 
@@ -1593,10 +1974,10 @@ class Controller:
     def _maintain(self, nodes: list[Node], pods: list[Pod],
                   now: float, pending_gangs: list[Gang] = ()) -> None:
         cfg = self.config
-        pods_by_node: dict[str, list[Pod]] = {}
-        for p in pods:
-            if p.node_name and p.phase in {"Pending", "Running"}:
-                pods_by_node.setdefault(p.node_name, []).append(p)
+        # Informer node-index read when synced (O(bound pods of these
+        # nodes)) instead of the full pod-list scan — the ISSUE 6
+        # leftover that kept a 100k-pod control loop O(cluster).
+        pods_by_node = self._pods_by_node(nodes, pods)
 
         units = self._units(nodes)
         spare_ids = self._spare_units(units, pods_by_node)
@@ -1611,6 +1992,7 @@ class Controller:
         for unit_id, unit_nodes in units.items():
             unit_pods = [p for n in unit_nodes
                          for p in pods_by_node.get(n.name, [])]
+            self._unit_first_seen.setdefault(unit_id, now)
             view = self.tracker.observe(unit_id, unit_nodes, unit_pods, now)
             if view.all_ready_since == now:
                 # Readiness barrier just cleared: record how long the
@@ -1671,6 +2053,9 @@ class Controller:
                 elif state is SliceState.UNHEALTHY:
                     self._handle_unhealthy(unit_id, unit_nodes, unit_pods,
                                            now)
+                elif state is SliceState.PROVISIONING:
+                    self._reclaim_if_orphaned(unit_id, unit_nodes,
+                                              unit_pods, now)
                 else:
                     self._unhealthy_since.pop(unit_id, None)
             except Exception:  # noqa: BLE001 — one unit's API failure must
@@ -1680,6 +2065,7 @@ class Controller:
 
         for key, count in state_counts.items():
             self.metrics.set_gauge(f"units_{key.replace('-', '_')}", count)
+        self._sweep_repairs(units, pods, now)
         # Forget tracker state for units whose nodes are gone.
         for known in self.tracker.known_slices():
             if known not in units:
@@ -1688,6 +2074,7 @@ class Controller:
                 self._drain_cancellable.discard(known)
                 self._requested_drains.discard(known)
                 self._unhealthy_since.pop(known, None)
+                self._unit_first_seen.pop(known, None)
 
     def _begin_drain(self, unit_id: str, unit_nodes: list[Node],
                      unit_pods: list[Pod], now: float, reason: str) -> None:
@@ -1755,15 +2142,67 @@ class Controller:
         self._explain(unit_id, "unit deleted", "drain complete")
         self._notify(f"deleted idle unit {unit_id}")
 
+    def _reclaim_if_orphaned(self, unit_id: str, unit_nodes: list[Node],
+                             unit_pods: list[Pod], now: float) -> None:
+        """Reclaim a unit stuck behind the provisioning barrier with no
+        workload past ``provision_timeout_seconds`` — orphaned partial
+        supply (fuzzer-found): a provision that FAILED after
+        materializing some hosts, or a slice whose hosts never go
+        Ready.  Any backing provision was already cancelled by
+        ``_note_failures`` at the SAME timeout, so what remains is
+        capacity nothing will ever complete or bind to.  Deleted whole,
+        like every unit.
+
+        With workload ABOARD (a scheduler bound pods to the partial
+        slice's individually-Ready hosts before it completed — also
+        fuzzer-found), the unit is a broken ICI domain serving pods:
+        it goes through the slice-REPAIR path instead, after the same
+        timeout."""
+        first = self._unit_first_seen.get(unit_id, now)
+        if now - first <= self.config.provision_timeout_seconds:
+            return
+        if any(p.is_workload for p in unit_pods):
+            if self.config.enable_slice_repair and unit_nodes[0].is_tpu:
+                self._maybe_start_repair(unit_id, unit_nodes, unit_pods,
+                                         now)
+            return
+        log.warning("reclaiming orphaned partial unit %s (%d hosts, "
+                    "behind the barrier for %.0fs with no backing "
+                    "provision)", unit_id, len(unit_nodes), now - first)
+        self.metrics.inc("orphaned_partial_units_reclaimed")
+        self._explain(unit_id, "orphaned partial unit reclaimed",
+                      f"stuck PROVISIONING > "
+                      f"{self.config.provision_timeout_seconds:g}s with "
+                      f"no workload")
+        self._notify(f"reclaiming orphaned partial unit {unit_id}")
+        self.actuator.delete(unit_id)
+        for node in unit_nodes:
+            node.delete(self.client)
+        self.tracker.forget(unit_id)
+        self.metrics.inc("units_deleted")
+
     def _handle_unhealthy(self, unit_id: str, unit_nodes: list[Node],
                           unit_pods: list[Pod], now: float) -> None:
         """A previously-Ready slice lost a host: the ICI domain is broken.
 
-        Wait out a flap window, then reclaim the whole slice (checkpoint
-        contract first) — the gang it hosted will go Pending again and the
-        scale path provisions a replacement.  Partial repair of a slice is
-        impossible by construction.
+        Workload-bearing TPU slices go through the ICI-atomic REPAIR
+        path (ISSUE 7): prompt whole-slice cordon + checkpoint drain
+        with advisory like-for-like replacement demand, traced end to
+        end.  Everything else keeps the flap-window replace: wait, then
+        reclaim the whole slice — the gang it hosted re-pends and the
+        scale path provisions anew.  Partial repair of a slice is
+        impossible by construction either way.
         """
+        if (self.config.enable_slice_repair and unit_nodes[0].is_tpu
+                and any(p.is_workload for p in unit_pods)):
+            self._maybe_start_repair(unit_id, unit_nodes, unit_pods, now)
+            return
+        self._handle_unhealthy_legacy(unit_id, unit_nodes, unit_pods, now)
+
+    def _handle_unhealthy_legacy(self, unit_id: str,
+                                 unit_nodes: list[Node],
+                                 unit_pods: list[Pod],
+                                 now: float) -> None:
         since = self._unhealthy_since.setdefault(unit_id, now)
         if now - since < self.config.unhealthy_timeout_seconds:
             return

@@ -121,6 +121,11 @@ class ScalePlan:
     # Gangs no catalog shape / clamp allows; surfaced, never silently dropped.
     unsatisfiable: list[tuple[Gang, str]] = dataclasses.field(
         default_factory=list)
+    # Advisory (slice-repair) demand that could not be admitted THIS
+    # pass (clamp/quota headroom): waiting, not misconfigured — the
+    # controller explains it but never reports it unsatisfiable.
+    deferred: list[tuple[Gang, str]] = dataclasses.field(
+        default_factory=list)
 
     @property
     def empty(self) -> bool:
@@ -170,6 +175,31 @@ def _free_slices(nodes: list[Node], pods: list[Pod]) -> dict[str, list[Node]]:
                and used_tpu.get(n.name, 0.0) == 0 for n in members):
             free[slice_id] = members
     return free
+
+
+def _gang_claims_partial(members: list[Node], gang: Gang,
+                         occupants: list[Pod]) -> bool:
+    """A slice partially occupied ONLY by this gang's own members
+    counts as the gang's supply: same-gang co-residency cannot bisect
+    the ICI domain, and provisioning another slice for the remainder
+    WOULD split the gang across domains.  (Fuzzer-found during slice
+    repair: a recreated member binds to the fresh replacement before
+    its siblings drain over; the remainder must target that slice,
+    not new capacity.)  ``occupants`` is the slice's bound workload,
+    precomputed once per plan.  Conservative slot math: only fully
+    chip-idle Ready hosts count as room."""
+    probe = gang.pods[0] if gang.pods else None
+    if probe is None or not all(n.admits(probe) for n in members):
+        return False
+    if not occupants or any(p.gang_key != gang.key for p in occupants):
+        return False
+    used = {p.node_name for p in occupants}
+    per_pod = gang.per_pod_resources
+    free_slots = sum(host_slots(n.allocatable, per_pod)
+                     for n in members
+                     if n.name not in used and n.is_ready
+                     and not n.unschedulable)
+    return free_slots >= gang.size
 
 
 def _slice_satisfies(members: list[Node], gang: Gang) -> bool:
@@ -330,11 +360,23 @@ class Planner:
 
     def plan(self, gangs: list[Gang], nodes: list[Node], pods: list[Pod],
              in_flight: Sequence[InFlight] = (),
-             generation_overrides: dict[GangKey, str] | None = None
+             generation_overrides: dict[GangKey, str] | None = None,
+             advisory_gangs: Sequence[tuple[Gang, str]] = ()
              ) -> ScalePlan:
         """``generation_overrides`` maps a gang key to the TPU generation
         to fit it on instead of the policy default — the controller sets
-        it from failure streaks (capacity stockout fallback)."""
+        it from failure streaks (capacity stockout fallback).
+
+        ``advisory_gangs`` is repair demand (ISSUE 7): ``(gang,
+        shape_name)`` pairs naming the exact like-for-like replacement
+        slice for a gang whose unit is under ICI-atomic repair.  The
+        controller supplies the shape (the broken unit's own — the gang
+        may be partially observed mid-repair, so refitting from its
+        pods could undershoot); the planner still decides admission
+        with the same free-slice/clamp/quota algebra as organic demand.
+        Inadmissible advisory demand lands in ``plan.deferred``, never
+        ``plan.unsatisfiable``.  The planner stays a pure function of
+        its inputs (TAP1xx)."""
         plan = ScalePlan()
         pol = self.policy
         gen_override = generation_overrides or {}
@@ -360,12 +402,60 @@ class Planner:
             _chips_by_namespace(pods, in_flight)
             if pol.namespace_chip_quota or pol.fair_share else {})
 
+        # Gang keys served by THIS plan's organic pass (free-slice match
+        # or an emitted request): the advisory repair pass must never
+        # double up on them.
+        served_now: set[GangKey] = set()
+
+        # Partial-claim state (slice membership + bound workload per
+        # slice), built LAZILY at most once per plan: only gangs that
+        # fall through the fully-free match need it, and the common
+        # all-matched/all-provisioned pass must not pay an extra
+        # O(nodes)+O(pods) walk (the PR-6 O(churn) contract — plan()
+        # runs twice per pass under verify_delta_plans).
+        partial_state: tuple[dict[str, list[Node]],
+                             dict[str, list[Pod]]] | None = None
+
+        def partial_claims() -> tuple[dict[str, list[Node]],
+                                      dict[str, list[Pod]]]:
+            nonlocal partial_state
+            if partial_state is None:
+                by_slice: dict[str, list[Node]] = {}
+                node_slice: dict[str, str] = {}
+                for node in nodes:
+                    if node.is_tpu and node.slice_id:
+                        by_slice.setdefault(node.slice_id,
+                                            []).append(node)
+                        node_slice[node.name] = node.slice_id
+                occupants: dict[str, list[Pod]] = {}
+                for p in pods:
+                    if p.node_name and p.phase in {"Pending", "Running"} \
+                            and p.is_workload:
+                        sid_of = node_slice.get(p.node_name)
+                        if sid_of is not None:
+                            occupants.setdefault(sid_of, []).append(p)
+                partial_state = (by_slice, occupants)
+            return partial_state
+
         def match_free(gang: Gang) -> str | None:
             # An existing fully-free matching slice satisfies the gang; the
             # scheduler will bind it — provisioning would strand chips.
-            return next(
+            sid = next(
                 (sid for sid, members in free.items()
                  if sid not in claimed and _slice_satisfies(members, gang)),
+                None)
+            if sid is not None:
+                return sid
+            # A slice the gang ALREADY partially occupies (and nothing
+            # else does) is its supply too — the remainder binds beside
+            # its siblings instead of splitting the gang.  Candidates
+            # prefiltered to slices whose occupants lead with this gang.
+            by_slice, occupants_by_slice = partial_claims()
+            return next(
+                (sid for sid, occ in occupants_by_slice.items()
+                 if sid not in free and sid not in claimed
+                 and occ[0].gang_key == gang.key
+                 and _gang_claims_partial(by_slice[sid], gang, occ)),
                 None)
 
         # ---- provisioning cohorts ------------------------------------
@@ -388,6 +478,7 @@ class Planner:
             matched = match_free(gang)
             if matched is not None:
                 claimed.add(matched)
+                served_now.add(gang.key)
                 continue
             cohort = [gang]
             if group_key is not None:
@@ -399,6 +490,7 @@ class Planner:
                     m = match_free(sib)
                     if m is not None:
                         claimed.add(m)
+                        served_now.add(sib.key)
                     else:
                         cohort.append(sib)
             cohorts.append(cohort)
@@ -480,12 +572,75 @@ class Planner:
                         f"{choice.shape.name} "
                         f"({sum(g.tpu_chips for g in gangs_u)} chips, "
                         f"{stranded} stranded)")
+                served_now.update(g.key for g in gangs_u)
+                if key is not None:
+                    served_now.add(key)
                 plan.requests.append(ProvisionRequest(
                     kind="tpu-slice", shape_name=choice.shape.name,
                     count=n, gang_key=key,
                     gang_keys=tuple(g.key for g in gangs_u),
                     preemptible=pol.preemptible,
                     stranded_chips=stranded, reason=reason))
+
+        # ---- advisory repair demand (ISSUE 7) ----------------------------
+        # Like-for-like replacement slices for units under ICI-atomic
+        # repair.  Admitted AFTER organic demand (a re-pended gang
+        # outranks a pre-provisioned repair under clamp contention —
+        # the repaired gang becomes organic demand itself once its pods
+        # are evicted) and BEFORE spares.  A free slice of exactly the
+        # replacement shape satisfies the repair without provisioning:
+        # the drain hands the gang to it.
+        for gang, shape_name in advisory_gangs:
+            if not gang.requests_tpu:
+                continue  # repairs are slice-scoped by construction
+            group_key = gang.multislice_group_key
+            if gang.key in served_keys or gang.key in served_now \
+                    or (group_key is not None
+                        and (group_key in served_keys
+                             or group_key in served_now)):
+                continue  # replacement already in flight / served above
+            shape = shape_by_name(shape_name)
+            # Exact-shape match, with the same selector/taint admission
+            # probe as the organic path: a tainted free slice (e.g. an
+            # impending-termination notice) must not silently satisfy
+            # the repair and suppress the real replacement.
+            probe = gang.pods[0] if gang.pods else None
+            matched = next(
+                (sid for sid, members in free.items()
+                 if sid not in claimed
+                 and len(members) == shape.hosts
+                 and probe is not None
+                 and all(n.tpu_accelerator == shape.accelerator_type
+                         and n.tpu_topology == shape.topology_label
+                         and n.admits(probe)
+                         for n in members)),
+                None)
+            if matched is not None:
+                claimed.add(matched)
+                continue
+            new_total = (existing_chips + inflight_chips + planned_chips
+                         + shape.chips)
+            if new_total > pol.max_total_chips:
+                plan.deferred.append(
+                    (gang, f"would exceed max_total_chips="
+                           f"{pol.max_total_chips} (at {new_total})"))
+                continue
+            ns = gang.namespace
+            quota = pol.namespace_chip_quota.get(ns)
+            if quota is not None:
+                ns_new = ns_chips.get(ns, 0) + shape.chips
+                if ns_new > quota:
+                    plan.deferred.append(
+                        (gang, f"namespace {ns!r} chip quota {quota} "
+                               f"exceeded (at {ns_new})"))
+                    continue
+                ns_chips[ns] = ns_new
+            planned_chips += shape.chips
+            plan.requests.append(ProvisionRequest(
+                kind="tpu-slice", shape_name=shape.name, count=1,
+                gang_key=gang.key, preemptible=pol.preemptible,
+                reason=(f"slice repair: like-for-like {shape.name} "
+                        f"replacement for gang {gang.name}")))
 
         # ---- warm spare slices (reference --spare-agents, per shape) -----
         for shape_name, want in pol.spare_slices.items():

@@ -343,6 +343,26 @@ class FakeKube:
     def get_pod(self, namespace: str, name: str) -> dict | None:
         return self._pods.get((namespace, name))
 
+    def taint_node(self, name: str, key: str,
+                   effect: str = "NoSchedule") -> None:
+        """Apply one taint to a node (idempotent) — how the fault tier
+        models GKE's impending-termination / spot-reclaim notices."""
+        node = self._nodes[name]
+        taints = node.setdefault("spec", {}).setdefault("taints", [])
+        if not any(t.get("key") == key for t in taints):
+            taints.append({"key": key, "effect": effect})
+            self._note_change("nodes", node, "MODIFIED")
+
+    def expire_watch_window(self) -> None:
+        """Advance the watch journal floor to the head, modeling an etcd
+        compaction: every watcher holding an older cursor gets a 410 on
+        its next read and must relist.  The chaos tier's 410 flood uses
+        this instead of thousands of synthetic mutations."""
+        with self._watch_cond:
+            self._journal_floor = self._last_seq
+            self._journal = []
+            self._watch_cond.notify_all()
+
     def set_node_ready(self, name: str, ready: bool) -> None:
         node = self._nodes[name]
         conds = node["status"].setdefault("conditions", [])
@@ -392,26 +412,56 @@ class FakeKube:
         bound = 0
         pending = [p for p in pods
                    if not p.node_name and p.phase == "Pending"]
-        for gang in group_into_gangs(pending):
-            # Tentative placement for the WHOLE gang against a copy.
-            trial = dict(free)
-            trial_placed = {k: list(v) for k, v in placed_by_node.items()}
+
+        def try_place(gang, allowed, trial, trial_placed):
             placements: list[tuple[Pod, str]] = []
-            ok = True
             for p in gang.pods:
                 target = next(
-                    (n for n in nodes
+                    (n for n in allowed
                      if n.name in trial and n.admits(p)
                      and p.resources.fits_in(trial[n.name])
                      and scheduling_blocks(p, n, trial_placed,
                                            nodes_by_name) is None), None)
                 if target is None:
-                    ok = False
-                    break
+                    return None
                 trial[target.name] = trial[target.name] - p.resources
                 trial_placed.setdefault(target.name, []).append(p)
                 placements.append((p, target.name))
-            if not ok:
+            return placements
+
+        for gang in group_into_gangs(pending):
+            # Tentative placement for the WHOLE gang against a copy.
+            trial = dict(free)
+            trial_placed = {k: list(v) for k, v in placed_by_node.items()}
+            if gang.requests_tpu:
+                # Slice-atomic placement (GKE TPU semantics): every
+                # member lands within ONE slice — first-fit per pod
+                # could interleave two same-shape gangs across two
+                # identical slices, bisecting both ICI domains (the
+                # chaos tier's gang-integrity invariant caught exactly
+                # that).  Slices already hosting this gang's pods are
+                # preferred, modeling topology-aware scheduling.
+                by_slice: dict[str, list[Node]] = {}
+                for n in nodes:
+                    if n.is_tpu and n.slice_id:
+                        by_slice.setdefault(n.slice_id, []).append(n)
+                mine = {n.slice_id for n in nodes
+                        if n.is_tpu and n.slice_id
+                        for q in placed_by_node.get(n.name, [])
+                        if q.gang_key == gang.key}
+                placements = None
+                for sid in sorted(by_slice,
+                                  key=lambda s: (s not in mine, s)):
+                    trial = dict(free)
+                    trial_placed = {k: list(v)
+                                    for k, v in placed_by_node.items()}
+                    placements = try_place(gang, by_slice[sid], trial,
+                                           trial_placed)
+                    if placements is not None:
+                        break
+            else:
+                placements = try_place(gang, nodes, trial, trial_placed)
+            if placements is None:
                 for p in gang.pods:
                     payload = self._pods[(p.namespace, p.name)]
                     conds = payload["status"].setdefault("conditions", [])

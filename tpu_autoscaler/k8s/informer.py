@@ -837,9 +837,22 @@ class ClusterInformer:
         simulations that want informer semantics without threads —
         construct with ``timeout_seconds=0`` so the drain doesn't
         block, and never mix with ``start()``.
+
+        Failure semantics mirror the threaded loop (ISSUE 7: the chaos
+        engine drives apiserver brownouts through here): an error marks
+        the cache unsynced — reads degrade to the LIST fallback — and
+        counts ``watch_failures``; the next pump relists.
         """
         for w in self._watches:
-            w._run_once()
+            try:
+                w._run_once()
+            except Exception:  # noqa: BLE001 — crash-only: degrade to
+                # the LIST fallback, like run().  (The backoff streak is
+                # run()'s alone: pump mode is never mixed with threads.)
+                w._cache.mark_unsynced()
+                w._inc("watch_failures")
+                log.debug("pump: %s failed; unsynced until next relist",
+                          w._cache.kind, exc_info=True)
 
     def pods(self):
         """Parsed Pod snapshot (cache when synced, LIST fallback)."""
@@ -876,6 +889,13 @@ class ClusterInformer:
             return both
         pods = self._fallback("pods")
         return pods, [p for p in pods if p.is_unschedulable]
+
+    def unready_nodes(self):
+        """Parsed nodes currently NotReady or cordoned — the node-failure
+        delta surface (ISSUE 7): the repair detector reads the readiness
+        index in O(failures) instead of re-deriving per-node health from
+        the full snapshot.  None while the node cache is unsynced."""
+        return self.node_cache.select("ready", False)
 
     def pod_node_digests(self, names: Sequence[str]) -> list[int] | None:
         """Per-node pod-membership digests (None while unsynced) — the

@@ -8,7 +8,11 @@
 - ``render``   — the ``trace`` / ``explain`` CLI's formatting layer.
 """
 
-from tpu_autoscaler.obs.recorder import FlightRecorder, install_sigusr1
+from tpu_autoscaler.obs.recorder import (
+    FlightRecorder,
+    install_sigusr1,
+    trace_gaps,
+)
 from tpu_autoscaler.obs.trace import (
     Span,
     Tracer,
@@ -25,4 +29,5 @@ __all__ = [
     "current_trace_id",
     "install_sigusr1",
     "maybe_span",
+    "trace_gaps",
 ]

@@ -94,6 +94,60 @@ class FlightRecorder:
         return out
 
 
+def trace_gaps(dump: dict[str, Any], trace_id: str) -> list[str]:
+    """Completeness check for one recorded trace (ISSUE 7): the chaos
+    engine's "every scale-up trace is complete" invariant, also usable
+    against any ``/debugz`` / SIGUSR1 dump.
+
+    Returns human-readable gaps (empty == complete):
+
+    - the root span (``scale_up`` or ``slice_repair``) exists and is
+      closed;
+    - every span of the trace is closed (``end`` set);
+    - a scale-up that dispatched work carries the full phase anatomy
+      (observe/plan/dispatch/provision/node_registration) plus
+      ``pods_running``; one that bound existing supply needs only
+      ``pods_running``;
+    - a slice repair carries its drain phase.
+    """
+    spans = [s for s in dump.get("spans", []) if s["trace_id"] == trace_id]
+    if not spans:
+        return [f"trace {trace_id}: no spans recorded"]
+    gaps: list[str] = []
+    names = {s["name"] for s in spans}
+    roots = [s for s in spans if s["parent_id"] is None]
+    if not roots:
+        gaps.append(f"trace {trace_id}: no root span")
+    for s in spans:
+        if s["end"] is None:
+            gaps.append(f"trace {trace_id}: span {s['name']} "
+                        f"({s['span_id']}) never closed")
+    if "scale_up" in names:
+        required: tuple[str, ...] = ("pods_running",)
+        if "dispatch" in names:
+            required += ("observe", "plan")
+            # A trace whose every dispatched provision FAILED can still
+            # complete off existing supply; one that provisioned must
+            # show the registration phase too.
+            if "provision" in names:
+                required += ("node_registration",)
+            elif "provision_failed" not in names:
+                required += ("provision",)
+        aborted = any(s["name"] == "scale_up" and "aborted" in s["attrs"]
+                      for s in spans)
+        if not aborted:
+            for phase in required:
+                if phase not in names:
+                    gaps.append(f"trace {trace_id}: missing {phase} span")
+    elif "slice_repair" in names:
+        abandoned = any(s["name"] == "slice_repair"
+                        and ("error" in s["attrs"]
+                             or "aborted" in s["attrs"]) for s in spans)
+        if not abandoned and "repair_drain" not in names:
+            gaps.append(f"trace {trace_id}: missing repair_drain span")
+    return gaps
+
+
 def install_sigusr1(dump_fn: Callable[[], dict[str, Any]],
                     path_prefix: str = "/tmp/tpu-autoscaler-debugz") -> bool:
     """SIGUSR1 → write ``dump_fn()`` as JSON to a timestamped file.

@@ -20,6 +20,37 @@ from tpu_autoscaler.topology.catalog import (
 )
 
 
+def pod_payload(name: str, requests: dict, selectors: dict | None = None,
+                labels: dict | None = None,
+                owner_kind: str | None = None) -> dict:
+    """Pending-pod payload builder, shared with the chaos engine
+    (tpu_autoscaler/chaos) so scenario programs seed demand exactly the
+    way the named BASELINE scenarios do."""
+    return _pod(name, requests, selectors, labels, owner_kind)
+
+
+def gang_pods(shape_name: str, job: str, jobset: str | None = None,
+              job_index: int | None = None,
+              namespace: str = "default",
+              pin_topology: bool = True) -> list[dict]:
+    """One slice-shaped gang's pod payloads (public twin of
+    ``_gang_pods`` for the chaos engine's workload model).
+
+    ``pin_topology=False`` drops the gke-tpu-topology selector,
+    modeling jobs that pin only the accelerator — the fitter then
+    sizes from observed chip demand, which is exactly the surface the
+    lone-host-backfill bug class lives on (chaos coverage wants both).
+    """
+    pods = _gang_pods(shape_name, job, jobset=jobset, job_index=job_index)
+    for p in pods:
+        if namespace != "default":
+            p["metadata"]["namespace"] = namespace
+        if not pin_topology:
+            # The gang shares one selectors dict; pop is idempotent.
+            p["spec"]["nodeSelector"].pop(TOPOLOGY_LABEL, None)
+    return pods
+
+
 def _pod(name: str, requests: dict, selectors: dict | None = None,
          labels: dict | None = None, owner_kind: str | None = None) -> dict:
     tolerations = ([{"key": TPU_RESOURCE, "operator": "Exists",

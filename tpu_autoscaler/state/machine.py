@@ -62,6 +62,11 @@ class SliceView:
     all_ready_since: float | None    # tracker: when the barrier cleared
     idle_since: float | None         # tracker: when it last became workload-free
     we_cordoned: bool                # tracker: drain initiated by us
+    # Catalog host count for the slice's shape (None for CPU units or
+    # unknown shapes).  A slice observed with FEWER hosts than expected
+    # after its barrier once cleared lost a node object outright — a
+    # broken ICI domain that looks healthy host-by-host (ISSUE 7).
+    expected_hosts: int | None = None
 
     @property
     def workload_pods(self) -> list[Pod]:
@@ -100,9 +105,15 @@ def classify_slice(view: SliceView, *, grace_seconds: float,
     if view.we_cordoned and any(n.unschedulable for n in nodes):
         return SliceState.DRAINING
 
-    if not all(n.is_ready for n in nodes) or view.all_ready_since is None:
+    missing_host = (view.expected_hosts is not None
+                    and 0 < len(nodes) < view.expected_hosts)
+    if (not all(n.is_ready for n in nodes) or missing_host
+            or view.all_ready_since is None):
         # Never fully Ready -> still behind the provisioning barrier; a
-        # previously-Ready slice with a NotReady host is broken hardware.
+        # previously-Ready slice with a NotReady host — or one whose
+        # host's Node object was DELETED out from under it (same broken
+        # ICI domain, invisible to per-host readiness) — is broken
+        # hardware.
         if view.all_ready_since is not None:
             return SliceState.UNHEALTHY
         return SliceState.PROVISIONING

@@ -52,7 +52,8 @@ class SliceTracker:
         t = self._times.setdefault(slice_id, _SliceTimes())
 
         all_ready = bool(nodes) and all(n.is_ready for n in nodes)
-        if all_ready and nodes[0].is_tpu:
+        expected_hosts = None
+        if nodes and nodes[0].is_tpu:
             # Hosts of a multi-host slice register gradually; until the
             # count matches the shape's host count the barrier holds even
             # if every host seen SO FAR is Ready (a 1-of-64-registered
@@ -63,8 +64,10 @@ class SliceTracker:
                 shape = shape_from_selectors(nodes[0].labels)
             except KeyError:
                 shape = None
-            if shape is not None and len(nodes) < shape.hosts:
-                all_ready = False
+            if shape is not None:
+                expected_hosts = shape.hosts
+                if len(nodes) < shape.hosts:
+                    all_ready = False
         if all_ready and t.all_ready_since is None:
             t.all_ready_since = now
 
@@ -73,6 +76,7 @@ class SliceTracker:
             all_ready_since=t.all_ready_since, idle_since=t.idle_since,
             we_cordoned=t.we_cordoned or any(
                 DRAIN_ANNOTATION in n.annotations for n in nodes),
+            expected_hosts=expected_hosts,
         )
         has_workload = bool(view.workload_pods)
         if has_workload:

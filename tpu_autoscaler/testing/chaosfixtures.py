@@ -1,0 +1,110 @@
+"""Fuzzer-found failures, promoted to seeded regression fixtures
+(racefixtures-style; docs/CHAOS.md seed-triage workflow).
+
+Each fixture names the ORIGINATING seed and a ``sabotage`` hook that
+re-opens the fixed bug on a live controller, so one test asserts both
+directions: the chaos engine's invariants CATCH the bug class when
+present (the detector earns its keep), and the shipped code holds
+under the exact seed that found it.
+
+These are FIXTURES: the sabotage hooks intentionally reintroduce bugs.
+Never call them outside tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_autoscaler.chaos.engine import ChaosResult, _Run
+from tpu_autoscaler.chaos.scenario import ScenarioProgram, generate
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosRegression:
+    name: str
+    seed: int
+    profile: str
+    invariant: str           # the invariant the original failure tripped
+    description: str
+
+    def program(self) -> ScenarioProgram:
+        return generate(self.seed, profile=self.profile)
+
+    def run(self, sabotage=None) -> ChaosResult:
+        run = _Run(self.program())
+        if sabotage is not None:
+            sabotage(run)
+        return run.execute()
+
+
+def _lose_dispatch_roots(run: _Run) -> None:
+    """Pre-fix emulation for LATE_PROVISION_SPAN: drop the dispatch-time
+    trace-root capture, so a provision resolving after its gang's trace
+    closed records no provision span (the original bug)."""
+    controller = run.controller
+    original = controller.reconcile_once
+
+    def reconcile_once(now=None):
+        controller._provision_roots.clear()
+        return original(now=now)
+
+    controller.reconcile_once = reconcile_once
+
+
+def _disable_orphan_reclaim(run: _Run) -> None:
+    """Pre-fix emulation for ORPHANED_PARTIAL_SLICE: a provision that
+    FAILs after materializing some hosts leaks them forever."""
+    run.controller._reclaim_if_orphaned = \
+        lambda unit_id, unit_nodes, unit_pods, now: None
+
+
+def _disable_repair_deferral(run: _Run) -> None:
+    """Pre-fix emulation for GANG_SPLIT_BACKFILL: no repair subsystem —
+    no whole-gang deferral, no advisory replacement — so a recreated
+    member of a broken slice is sized SOLO and the gang converges split
+    across ICI domains."""
+    run.controller.config = dataclasses.replace(
+        run.controller.config, enable_slice_repair=False,
+        unhealthy_timeout_seconds=60.0)
+    run.controller._repair_advisory = \
+        lambda nodes, pods, gangs, now: ([], set())
+
+
+#: A provision can go ACTIVE/FAILED after its gang's trace closed (the
+#: gang ran off other supply); its span must still land in the trace
+#: that dispatched it.  Fixed by dispatch-time trace-root capture
+#: (reconciler._provision_roots).
+LATE_PROVISION_SPAN = ChaosRegression(
+    name="late-provision-span", seed=4, profile="mixed",
+    invariant="trace-completeness",
+    description="provision resolves after the scale-up trace closed; "
+                "span lost without dispatch-time root capture")
+
+#: A mid-provision stockout against a staggered slice leaves partially
+#: materialized hosts with no backing provision; nothing reclaimed them.
+#: Fixed by the orphaned-partial-unit reclaim
+#: (reconciler._reclaim_if_orphaned).
+ORPHANED_PARTIAL_SLICE = ChaosRegression(
+    name="orphaned-partial-slice", seed=1, profile="mixed",
+    invariant="no-stranded-chips",
+    description="FAILED provision strands partially materialized hosts "
+                "behind the barrier forever")
+
+#: A host deleted from a live slice gets its pod recreated; without the
+#: whole-gang repair deferral the lone member is sized solo and the
+#: gang converges split across two ICI domains.  Fixed by the repair
+#: subsystem's advisory demand + solo-planning deferral.
+GANG_SPLIT_BACKFILL = ChaosRegression(
+    name="gang-split-backfill", seed=13, profile="repair",
+    invariant="gang-ici-integrity",
+    description="recreated member of a broken slice planned solo; gang "
+                "runs split across slices")
+
+SABOTAGE = {
+    LATE_PROVISION_SPAN.name: _lose_dispatch_roots,
+    ORPHANED_PARTIAL_SLICE.name: _disable_orphan_reclaim,
+    GANG_SPLIT_BACKFILL.name: _disable_repair_deferral,
+}
+
+ALL_REGRESSIONS = (LATE_PROVISION_SPAN, ORPHANED_PARTIAL_SLICE,
+                   GANG_SPLIT_BACKFILL)
