@@ -47,10 +47,22 @@ JAX_PLATFORMS=cpu python bench.py actuate
 
 # Chaos corpus (ISSUE 7): 200 seeded generative scenarios (brownouts,
 # watch storms, 410 floods, stockouts, preemptions, partial slice host
-# failures) through the real control loop, every property invariant
-# asserted per step, under a fixed wall-clock budget (docs/CHAOS.md).
+# failures, multislice jobsets) through the real control loop, every
+# property invariant asserted per step, under a fixed wall-clock
+# budget (docs/CHAOS.md).  The policy profile (ISSUE 8) re-runs the
+# corpus with the PolicyEngine attached — mispredicted prewarms must
+# never violate no-double-provision or no-stranded-chips.
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 480
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 300 --profile policy
+
+# Policy replay tier (ISSUE 8): the recurring north-star trace must
+# show prewarmed detect->running <= 0.25x the reactive baseline, and a
+# regime-change (misprediction) trace must keep wasted chip-seconds
+# under the configured budget; results merge into BENCH_POLICY.json
+# (docs/POLICY.md).
+JAX_PLATFORMS=cpu python bench.py policy
 
 # Tracer-overhead tier: the observe + actuate benches re-run with the
 # decision tracer attached must stay within 5% of untraced (ISSUE 5 —

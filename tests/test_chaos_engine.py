@@ -91,12 +91,44 @@ class TestSmokeCorpus:
     200-seed corpus runs in scripts/ci_gate.sh stage 6)."""
 
     @pytest.mark.parametrize("profile", ["mixed", "faults", "api",
-                                         "repair"])
+                                         "repair", "policy"])
     def test_profile_seeds_hold_invariants(self, profile):
         for seed in range(4):
             result = run_scenario(seed, profile=profile)
             assert result.ok, "\n".join(result.violations)
             assert result.converged_at is not None
+
+    def test_multislice_jobset_seed_holds_invariants(self):
+        """A seed whose program carries a 2-slice jobset (ISSUE 8
+        grammar addition): the atomic multislice provision converges
+        with gang-ICI-integrity held per member job."""
+        from tpu_autoscaler.chaos.scenario import generate
+
+        seed = next(s for s in range(200)
+                    if any(w.jobset_slices > 1
+                           for w in generate(s).workloads))
+        program = generate(seed)
+        result = run_scenario(program)
+        assert result.ok, "\n".join(result.violations)
+        assert result.converged_at is not None
+
+    def test_policy_profile_exercises_prewarms_safely(self):
+        """Across a few policy-profile seeds the PolicyEngine actually
+        fires (decisions recorded) and every invariant still holds —
+        mispredictions may waste bounded chips, never break safety."""
+        from tpu_autoscaler.chaos.engine import _Run
+        from tpu_autoscaler.chaos.scenario import generate
+
+        decisions = 0
+        for seed in range(8):
+            run = _Run(generate(seed, profile="policy"))
+            result = run.execute()
+            assert result.ok, "\n".join(result.violations)
+            snap = run.controller.metrics.snapshot()["counters"]
+            decisions += int(snap.get("prewarm_decisions", 0))
+        assert decisions > 0, (
+            "policy profile never fired a prewarm — the chaos-scale "
+            "policy config has gone stale")
 
     def test_sched_drive_holds_invariants(self):
         """The DeterministicScheduler drive: real informer watch

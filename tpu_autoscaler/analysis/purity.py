@@ -61,11 +61,16 @@ MUTATING_METHODS = frozenset({
     "__setitem__",
 })
 
-#: Default scope: the decision modules named by the invariant.
+#: Default scope: the decision modules named by the invariant.  The
+#: policy subsystem's forecast/SLO math (ISSUE 8) is pure computation
+#: over injected timestamps by the same contract — the stateful
+#: PolicyEngine wrapper (engine.py) stays outside, like the Reconciler.
 DEFAULT_SCOPE = (
     "tpu_autoscaler/engine/planner.py",
     "tpu_autoscaler/engine/fitter.py",
     "tpu_autoscaler/k8s/scheduling.py",
+    "tpu_autoscaler/policy/forecast.py",
+    "tpu_autoscaler/policy/slo.py",
 )
 
 

@@ -16,7 +16,7 @@ import json
 import sys
 
 from tpu_autoscaler.chaos.engine import run_corpus, run_scenario
-from tpu_autoscaler.chaos.scenario import generate
+from tpu_autoscaler.chaos.scenario import PROFILES, generate
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -34,8 +34,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--seed", type=int, default=None,
                         help="run ONE seed (triage mode)")
     parser.add_argument("--profile", default="mixed",
-                        choices=("mixed", "faults", "api", "repair"),
-                        help="fault alphabet (docs/CHAOS.md)")
+                        choices=PROFILES,
+                        help="fault alphabet (docs/CHAOS.md; 'policy' "
+                             "runs recurring traffic with the "
+                             "PolicyEngine attached)")
     parser.add_argument("--drive", default="pump",
                         choices=("pump", "sched"),
                         help="threadless pump (fast) or the "

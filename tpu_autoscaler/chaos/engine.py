@@ -25,7 +25,11 @@ import time as _time
 
 from tpu_autoscaler.actuators.fake import FakeActuator
 from tpu_autoscaler.chaos.invariants import SLICE_LABEL, InvariantMonitor
-from tpu_autoscaler.chaos.scenario import ScenarioProgram, generate
+from tpu_autoscaler.chaos.scenario import (
+    ScenarioProgram,
+    Workload,
+    generate,
+)
 from tpu_autoscaler.controller import Controller, ControllerConfig
 from tpu_autoscaler.engine.planner import PoolPolicy
 from tpu_autoscaler.k8s.fake import FakeKube
@@ -101,6 +105,34 @@ class ChaosResult:
                 f"{self.wall_seconds:.2f}s wall{tail}")
 
 
+#: Chaos-scale PolicyEngine hold/threshold bounds (ISSUE 8): the
+#: reclaim window the no-stranded-chips invariant allows is widened by
+#: exactly this allowance when the policy is on — a prewarm may sit
+#: warm through its hold and then still owes the normal idle clock.
+POLICY_RECLAIM_ALLOWANCE = 360.0
+
+
+def _policy_engine(program: ScenarioProgram):
+    """Chaos-scale PolicyEngine: aggressive enough to actually fire
+    inside a short scenario (tiny SLO target, short holds), bounded so
+    its mispredictions stay inside POLICY_RECLAIM_ALLOWANCE."""
+    if not program.policy:
+        return None
+    from tpu_autoscaler.policy import PolicyConfig, PolicyEngine, SloPolicy
+
+    return PolicyEngine(PolicyConfig(
+        slo=SloPolicy(
+            target_scaleup_seconds=5.0,  # reactive always "misses"
+            min_confidence=0.55,
+            provision_estimate_seconds=program.provision_delay + 20.0,
+            lead_slack_seconds=15.0,
+            prewarm_hold_seconds=60.0,
+            waste_budget_chip_seconds=50_000.0,
+            idle_floor_seconds=60.0,
+            idle_ceiling_seconds=240.0),
+        hw_bin_seconds=30.0, hw_season_bins=8))
+
+
 def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
            informer) -> tuple[Controller, FakeActuator]:
     import random
@@ -119,7 +151,8 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
             provision_timeout_seconds=150.0,
             unhealthy_timeout_seconds=120.0,
             slice_repair_after_seconds=30.0),
-        informer=informer)
+        informer=informer,
+        policy_engine=_policy_engine(program))
     return controller, actuator
 
 
@@ -145,7 +178,15 @@ class _Run:
             program, self.proxy, self.kube, self.informer)
         self.monitor = InvariantMonitor(program.seed, self.kube,
                                         self.controller)
+        #: member job name -> its pod names (a multislice jobset
+        #: contributes one entry per member job — the ICI-integrity
+        #: invariant holds per job/slice, the jobset spans DCN).
         self.live_jobs: dict[str, list[str]] = {}
+        #: member job name -> launch spec, for Job-controller
+        #: recreation of missing pods.
+        self._job_spec: dict[str, dict] = {}
+        #: pending recurring re-launches: (at, workload, run index).
+        self._relaunches: list[tuple[float, Workload, int]] = []
         self.arrived: set[str] = set()
         self.passes = 0
         self.reconcile_errors = 0
@@ -155,30 +196,82 @@ class _Run:
 
     # -- world model ------------------------------------------------------
 
+    def _member_jobs(self, w: Workload, run: int) -> list[dict]:
+        """Launch specs for one run of a workload: N member jobs for a
+        multislice jobset, else one job.  Recurring workloads carry a
+        run suffix from run 0 so every run shares one base name (what
+        the recurring predictor mines)."""
+        base = w.job if w.repeat == 0 else f"{w.job}-r{run}"
+        if w.jobset_slices <= 1:
+            return [{"job": base, "shape": w.shape, "pinned": w.pinned,
+                     "jobset": None, "job_index": None,
+                     "workload": w.job}]
+        return [{"job": f"{base}-s{i}", "shape": w.shape,
+                 "pinned": w.pinned, "jobset": base, "job_index": i,
+                 "workload": w.job}
+                for i in range(w.jobset_slices)]
+
+    def _launch(self, w: Workload, run: int) -> None:
+        for spec in self._member_jobs(w, run):
+            names = []
+            for payload in gang_pods(spec["shape"], spec["job"],
+                                     jobset=spec["jobset"],
+                                     job_index=spec["job_index"],
+                                     pin_topology=spec["pinned"]):
+                self.kube.add_pod(payload)
+                names.append(payload["metadata"]["name"])
+            self.live_jobs[spec["job"]] = names
+            self._job_spec[spec["job"]] = spec
+
     def _arrivals(self, t: float) -> None:
         for w in self.program.workloads:
             if w.job in self.arrived or w.arrival > t:
                 continue
             self.arrived.add(w.job)
-            names = []
-            for payload in gang_pods(w.shape, w.job,
-                                     pin_topology=w.pinned):
-                self.kube.add_pod(payload)
-                names.append(payload["metadata"]["name"])
-            self.live_jobs[w.job] = names
+            self._launch(w, 0)
+        # Recurring re-launches whose gap elapsed (scheduled only
+        # inside the driven phase — see _completions).
+        due = [r for r in self._relaunches if r[0] <= t]
+        if due:
+            self._relaunches = [r for r in self._relaunches if r[0] > t]
+            for _at, w, run in due:
+                self._launch(w, run)
+
+    def _workload_members(self, w: Workload) -> list[str]:
+        return [job for job, spec in self._job_spec.items()
+                if spec["workload"] == w.job and job in self.live_jobs]
 
     def _completions(self, t: float) -> None:
         for w in self.program.workloads:
-            names = self.live_jobs.get(w.job)
-            if not names or w.completion_prob <= 0.0:
+            if w.completion_prob <= 0.0:
                 continue
+            members = self._workload_members(w)
+            if not members:
+                continue
+            names = [n for job in members for n in self.live_jobs[job]]
             if all((self.kube.get_pod("default", n) or {}).get(
                     "status", {}).get("phase") == "Running"
                    for n in names) \
                     and self.rng.random() < w.completion_prob:
                 for n in names:
                     self.kube.delete_pod("default", n)
-                del self.live_jobs[w.job]
+                runs_done = 0
+                for job in members:
+                    spec = self._job_spec.pop(job)
+                    del self.live_jobs[job]
+                    # Run index of the completed run (suffix-free
+                    # workloads are single-run).
+                    if w.repeat > 0:
+                        runs_done = int(
+                            job[len(w.job) + 2:].split("-")[0] or 0)
+                next_run = runs_done + 1
+                relaunch_at = t + w.repeat_gap
+                if next_run <= w.repeat \
+                        and relaunch_at <= self.program.until:
+                    # Scheduled strictly inside the driven phase so
+                    # the quiet tail stays quiet (convergence remains
+                    # a decidable property).
+                    self._relaunches.append((relaunch_at, w, next_run))
 
     def _node_gc_and_job_controller(self, t: float) -> None:
         """Model the two cluster actors the fake lacks: node-lifecycle
@@ -192,15 +285,17 @@ class _Run:
                 self.kube.delete_pod(
                     p["metadata"].get("namespace", "default"),
                     p["metadata"]["name"])
-        by_job = {w.job: w for w in self.program.workloads}
         for job, names in self.live_jobs.items():
             missing = [n for n in names
                        if self.kube.get_pod("default", n) is None]
             if not missing:
                 continue
+            spec = self._job_spec[job]
             fresh = {p["metadata"]["name"]: p
-                     for p in gang_pods(by_job[job].shape, job,
-                                        pin_topology=by_job[job].pinned)}
+                     for p in gang_pods(spec["shape"], job,
+                                        jobset=spec["jobset"],
+                                        job_index=spec["job_index"],
+                                        pin_topology=spec["pinned"])}
             for n in missing:
                 self.kube.add_pod(fresh[n])
 
@@ -323,6 +418,12 @@ class _Run:
                           + self.controller.config.grace_seconds
                           + self.controller.config.drain_grace_seconds
                           + 4 * program.step)
+        if program.policy:
+            # A prewarmed slice may legitimately sit warm through its
+            # hold window and a stretched idle threshold before the
+            # normal reclaim clocks run — the allowance is part of the
+            # policy profile's contract (docs/CHAOS.md).
+            reclaim_window += POLICY_RECLAIM_ALLOWANCE
         if converged_at is not None:
             # Completions freeze here: a job finishing mid-reclaim
             # would reset the idle clocks the stranded check reads.

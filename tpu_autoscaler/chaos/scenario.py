@@ -19,6 +19,17 @@ Event kinds (args in parentheses):
                                 goes NotReady or is deleted (the
                                 partial-slice failure slice repair
                                 exists for).
+
+Workloads (ISSUE 8 additions):
+
+- ``jobset_slices > 1`` — a multislice JobSet: one gang per slice,
+  provisioned as ONE atomic multislice unit (gang-ICI-integrity is
+  asserted per member job — each job on ONE slice);
+- ``repeat``/``repeat_gap`` — a recurring job: after completing, the
+  engine re-launches it (run-suffixed name, same base) — the traffic
+  the ``policy`` profile's PolicyEngine learns from, and the surface
+  where mispredictions must never violate no-double-provision or
+  no-stranded-chips.
 """
 
 from __future__ import annotations
@@ -33,6 +44,9 @@ GANG_SHAPES = ("v5e-8", "v5e-16", "v5e-32", "v5p-16")
 #: Sim-seconds of guaranteed fault-free tail before convergence is
 #: judged (every generated event fires before ``until - QUIET_TAIL``).
 QUIET_TAIL = 300.0
+
+#: Known profiles (docs/CHAOS.md; ``policy`` is ISSUE 8).
+PROFILES = ("mixed", "faults", "api", "repair", "policy")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +69,14 @@ class Workload:
     # sizes from observed chip demand — the surface partial-gang
     # planning bugs live on.
     pinned: bool = True
+    # Multislice JobSet: one gang per slice (one atomic provisioning
+    # unit of N slices); 1 = a plain single-slice Job.
+    jobset_slices: int = 1
+    # Recurring job: re-launched this many times after completing,
+    # ``repeat_gap`` sim-seconds after each completion (run-suffixed
+    # names share one base, so the recurring predictor can mine them).
+    repeat: int = 0
+    repeat_gap: float = 60.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,6 +91,9 @@ class ScenarioProgram:
     provision_delay: float
     stagger_seconds: float
     max_total_chips: int
+    # ISSUE 8: run the scenario with the PolicyEngine attached — its
+    # prewarms/holds ride the same corpus invariants.
+    policy: bool = False
 
     def describe(self) -> str:
         kinds: dict[str, int] = {}
@@ -76,23 +101,39 @@ class ScenarioProgram:
             kinds[e.kind] = kinds.get(e.kind, 0) + 1
         faults = ",".join(f"{k}x{n}" for k, n in sorted(kinds.items())) \
             or "none"
+        tags = []
+        if any(w.jobset_slices > 1 for w in self.workloads):
+            tags.append("multislice")
+        if self.policy:
+            tags.append("policy")
+        tagtxt = f" [{'+'.join(tags)}]" if tags else ""
         return (f"seed={self.seed} jobs={len(self.workloads)} "
-                f"({'/'.join(w.shape for w in self.workloads)}) "
+                f"({'/'.join(w.shape for w in self.workloads)}){tagtxt} "
                 f"faults=[{faults}] informer={self.informer} "
                 f"delay={self.provision_delay:g}s "
                 f"clamp={self.max_total_chips}")
 
 
-def generate(seed: int, *, profile: str = "mixed") -> ScenarioProgram:
+def generate(seed: int, *, profile: str = "mixed",
+             multislice: bool = True) -> ScenarioProgram:
     """Compile one seeded scenario program.
 
     Profiles narrow the fault alphabet for triage (docs/CHAOS.md):
     ``mixed`` (default, everything), ``faults`` (no API-layer chaos),
-    ``api`` (only API-layer chaos), ``repair`` (always a host failure).
+    ``api`` (only API-layer chaos), ``repair`` (always a host
+    failure), ``policy`` (PolicyEngine enabled over recurring traffic
+    with the mixed fault alphabet — mispredictions under fire).
+
+    ``multislice=False`` suppresses the jobset overlay: promoted
+    regression fixtures pin pre-ISSUE-8 seed programs exactly.
     """
-    if profile not in ("mixed", "faults", "api", "repair"):
+    if profile not in PROFILES:
         raise ValueError(f"unknown chaos profile {profile!r}")
     rng = random.Random(seed)
+    # The multislice draw rides a DERIVED stream so pre-ISSUE-8 seeds
+    # keep their exact programs (promoted regression fixtures in
+    # testing/chaosfixtures.py pin seed numbers).
+    rng_ms = random.Random(seed ^ 0x515CE5)
     informer = rng.random() < 0.7
     jobs = rng.randint(1, 3)
     workloads = []
@@ -101,14 +142,36 @@ def generate(seed: int, *, profile: str = "mixed") -> ScenarioProgram:
         if profile == "repair" and i == 0:
             # Guarantee a multi-host victim for the host failure.
             shape = rng.choice(("v5e-16", "v5e-32", "v5p-16"))
+        # Draw order matters: arrival -> completion -> pinned is the
+        # pre-ISSUE-8 stream (keyword evaluation order of the original
+        # constructor call), and seed programs must stay reproducible.
+        arrival = rng.uniform(0.0, 120.0)
+        completion = rng.choice((0.0, 0.0, 0.01))
+        pinned = rng.random() < 0.6
+        repeat, repeat_gap = 0, 60.0
+        if profile == "policy" and i == 0:
+            # The recurring job the PolicyEngine learns from: completes
+            # quickly and re-arrives on a quasi-stable gap.  (New
+            # profile: its extra draws shift no legacy stream.)
+            repeat = rng.randint(2, 4)
+            repeat_gap = rng.uniform(50.0, 90.0)
+            completion = 0.25
         workloads.append(Workload(
             job=f"chaos-{seed}-{i}", shape=shape,
-            arrival=rng.uniform(0.0, 120.0),
-            completion_prob=rng.choice((0.0, 0.0, 0.01)),
-            pinned=rng.random() < 0.6))
+            arrival=arrival, completion_prob=completion, pinned=pinned,
+            repeat=repeat, repeat_gap=repeat_gap))
+    if multislice and profile in ("mixed", "faults") \
+            and workloads[-1].repeat == 0 and rng_ms.random() < 0.25:
+        # Multislice jobset (ISSUE 8 headroom item): the last workload
+        # becomes one gang per slice over DCN — small shapes so the
+        # chip clamp still admits the whole atomic unit.
+        workloads[-1] = dataclasses.replace(
+            workloads[-1],
+            shape=rng_ms.choice(("v5e-8", "v5e-16")),
+            jobset_slices=2)
 
-    api_chaos = profile in ("mixed", "api")
-    fault_chaos = profile in ("mixed", "faults", "repair")
+    api_chaos = profile in ("mixed", "api", "policy")
+    fault_chaos = profile in ("mixed", "faults", "repair", "policy")
     events: list[Event] = []
 
     def fire(probability: float) -> bool:
@@ -141,11 +204,17 @@ def generate(seed: int, *, profile: str = "mixed") -> ScenarioProgram:
     events.sort(key=lambda e: e.t)
     last = max([e.t + e.args.get("duration", 0.0) for e in events],
                default=0.0)
-    until = max(last, 120.0) + QUIET_TAIL
+    # Recurring traffic needs a longer driven phase: every repeat must
+    # have ROOM to complete and re-arrive before the quiet tail.
+    repeats_span = max(
+        [w.arrival + (w.repeat + 1) * (w.repeat_gap + 120.0)
+         for w in workloads if w.repeat > 0], default=0.0)
+    until = max(last, repeats_span, 120.0) + QUIET_TAIL
     return ScenarioProgram(
         seed=seed, step=5.0, until=until, settle=600.0,
         workloads=tuple(workloads), events=tuple(events),
         informer=informer,
         provision_delay=rng.choice((10.0, 30.0, 60.0)),
         stagger_seconds=rng.choice((0.0, 0.0, 5.0)),
-        max_total_chips=rng.choice((256, 1024)))
+        max_total_chips=rng.choice((256, 1024)),
+        policy=(profile == "policy"))

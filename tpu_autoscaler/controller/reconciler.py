@@ -169,7 +169,8 @@ class Controller:
                  metrics: Metrics | None = None,
                  informer=None, executor=None,
                  tracer: Tracer | None = None,
-                 recorder: FlightRecorder | None = None):
+                 recorder: FlightRecorder | None = None,
+                 policy_engine=None):
         self.client = client
         self.actuator = actuator
         self.config = config or ControllerConfig()
@@ -247,8 +248,9 @@ class Controller:
         # when the gang runs or its pods disappear).
         self._gang_traces: dict[tuple, Span] = {}
         # Open "node_registration" spans per supply-guarded provision
-        # (see _update_supply_guard), keyed by provision id.
-        self._registration_spans: dict[str, Span] = {}
+        # (see _update_supply_guard), keyed by provision id — one span
+        # per served trace (multislice siblings each get the anatomy).
+        self._registration_spans: dict[str, list[Span]] = {}
         # Per-pass decision record state (reset at the top of every
         # reconcile_once; reconcile-thread-only).
         self._pass_seq = 0
@@ -315,6 +317,24 @@ class Controller:
         self._repair_roots: dict[tuple, Span] = {}
         self.metrics.declare_histogram("slice_repair_seconds",
                                        LATENCY_BUCKETS)
+        # Predictive SLO-driven policy (ISSUE 8, docs/POLICY.md):
+        # strictly ADVISORY — the engine forecasts demand and this
+        # loop feeds its prewarm demand through the planner's existing
+        # advisory_gangs hook; a policy failure degrades to the
+        # reactive baseline, never aborts a pass.  Reconcile-thread-
+        # only, like every other piece of controller bookkeeping.
+        self.policy_engine = policy_engine
+        if policy_engine is not None:
+            policy_engine.bind(
+                metrics=self.metrics, tracer=self.tracer,
+                default_generation=self.config.policy.default_generation)
+        # This pass's policy outputs: units held for an un-consumed
+        # prewarm, per-unit idle-threshold overrides (SLO/cost
+        # scale-down tradeoff), and the advice digest folded into the
+        # pass record.
+        self._policy_holds: set[str] = set()
+        self._policy_idle_overrides: dict[str, float] = {}
+        self._policy_digest = 0
 
     # ------------------------------------------------------------------ #
 
@@ -346,6 +366,10 @@ class Controller:
         self._update_supply_guard(nodes, now)
 
         gangs = group_into_gangs(pending)
+        # Policy pass BEFORE latency tracking: a prediction consumed
+        # this pass records its prewarm span into the gang's still-open
+        # scale-up trace (the root ends in _track_gang_latency below).
+        policy_advisory = self._policy_pass(gangs, nodes, pods, now)
         self._track_gang_latency(gangs, pods, nodes, now)
         # Settling only delays SIZING (the _scale path); _maintain still
         # sees every pending gang so reclaim deferral protects supply a
@@ -360,6 +384,11 @@ class Controller:
         # capacity into a job that needs one ICI domain).
         advisory, repair_deferred = self._repair_advisory(
             nodes, pods, gangs, now)
+        # Policy prewarm demand rides the SAME advisory hook as repair
+        # replacements — admitted by the pure planner AFTER organic
+        # demand and repairs (a misprediction can never displace real
+        # work under clamp contention).
+        advisory = advisory + policy_advisory
         self.metrics.set_gauge("gangs_deferred_to_repair",
                                len(repair_deferred))
         if repair_deferred:
@@ -485,7 +514,12 @@ class Controller:
                                    for s in self.actuator.statuses()))
                   ^ hash(frozenset(
                       (pid, unit_ids) for pid, (_inf, unit_ids, _since)
-                      in self._supply_awaiting_nodes.items())))
+                      in self._supply_awaiting_nodes.items()))
+                  # Policy outputs fold in (ISSUE 8): advisory prewarm
+                  # demand, holds and idle overrides are pass inputs
+                  # like any other — "unchanged" must never span a
+                  # policy decision.
+                  ^ hash(("policy", self._policy_digest)))
         self.recorder.record_pass({
             "pass": self._pass_seq,
             "t": now,
@@ -584,8 +618,8 @@ class Controller:
                 self._supply_awaiting_nodes.items()):
             if all(u in seen_units for u in unit_ids):
                 del self._supply_awaiting_nodes[pid]
-                self.tracer.end(self._registration_spans.pop(pid, None),
-                                t=now)
+                for span in self._registration_spans.pop(pid, ()):
+                    self.tracer.end(span, t=now)
                 self._explain(pid, "supply-guard released",
                               "all units registered as nodes")
             elif now - since > self.config.provision_timeout_seconds:
@@ -608,8 +642,9 @@ class Controller:
                     continue
                 del self._supply_awaiting_nodes[pid]
                 self.metrics.inc("supply_guard_expired")
-                self.tracer.end(self._registration_spans.pop(pid, None),
-                                t=now, attrs={"expired": True})
+                for span in self._registration_spans.pop(pid, ()):
+                    self.tracer.end(span, t=now,
+                                    attrs={"expired": True})
                 self._explain(pid, "supply-guard expired",
                               "units never registered within "
                               "provision_timeout")
@@ -621,6 +656,54 @@ class Controller:
         return (in_flight_of(self.actuator)
                 + [inf for inf, _, _ in
                    self._supply_awaiting_nodes.values()])
+
+    # ---- predictive policy (ISSUE 8) -----------------------------------
+
+    def _policy_pass(self, gangs: list[Gang], nodes: list[Node],
+                     pods: list[Pod], now: float
+                     ) -> list[tuple[Gang, str]]:
+        """Consult the PolicyEngine for this pass's advice.
+
+        Strictly advisory and crash-only: any policy failure zeroes
+        the advice and the loop continues as the reactive baseline —
+        a forecasting bug must never take down scaling.  Returns the
+        prewarm advisory gangs for the planner; holds and idle
+        overrides land on ``self`` for ``_maintain``.
+        """
+        self._policy_holds = set()
+        self._policy_idle_overrides = {}
+        self._policy_digest = 0
+        if self.policy_engine is None:
+            return []
+        try:
+            self.policy_engine.observe(
+                gangs, nodes, pods, self.actuator.statuses(), now,
+                gang_traces=self._gang_traces)
+            advice = self.policy_engine.advise(
+                nodes, pods, now,
+                base_idle_threshold=self.config.idle_threshold_seconds)
+        except Exception:  # noqa: BLE001 — advisory only
+            self.metrics.inc("policy_errors")
+            log.exception("policy engine pass failed; continuing with "
+                          "the reactive baseline")
+            return []
+        self._policy_holds = advice.hold_units
+        self._policy_idle_overrides = advice.idle_overrides
+        self._policy_digest = advice.digest
+        for d in advice.decisions:
+            self._explain(d.key, "prewarm decided", d.reason,
+                          shape=d.shape_name)
+            self._notify(
+                f"prewarm: provisioning {d.shape_name} ahead of "
+                f"forecast demand ({d.key})")
+        if len(advice.rejections) <= 8:
+            for r in advice.rejections:
+                self._explain("policy", "prewarm rejected", r)
+        elif advice.rejections:
+            self._explain("policy", "prewarm rejected",
+                          f"{len(advice.rejections)} forecasts below "
+                          f"the firing bar")
+        return advice.advisory
 
     # ---- ICI-atomic slice repair (ISSUE 7) -----------------------------
 
@@ -812,6 +895,27 @@ class Controller:
                 self.tracer.end(st.pop("drain_span"), t=now)
             members = [p for key in st["gang_keys"]
                        for p in self._gang_members(pods, key)]
+            if not members:
+                # Broken unit gone AND the gang has zero pods.  The
+                # normal eviction gap (drain deleted members, the Job
+                # controller recreates them next pass) closes within
+                # seconds — a gang still absent after the grace means
+                # the job itself was deleted or completed mid-repair,
+                # and nothing will ever consume the replacement: close
+                # the repair instead of holding its bookkeeping (and
+                # its supply-guard riders) until the 3600 s timeout.
+                gone_since = st.setdefault("members_gone_since", now)
+                if now - gone_since > self.config.drain_grace_seconds \
+                        + 30.0:
+                    self.metrics.inc("slice_repairs_abandoned")
+                    log.warning("slice repair for %s closed: gang "
+                                "disappeared mid-repair (job deleted "
+                                "or completed)", unit_id)
+                    self._end_repair(
+                        unit_id, st, now, outcome="abandoned",
+                        attrs={"error": "gang disappeared mid-repair"})
+                continue
+            st.pop("members_gone_since", None)
             if members and all(p.phase == "Running" for p in members):
                 latency = now - st["started"]
                 self.metrics.inc("slice_repairs_completed")
@@ -1083,6 +1187,10 @@ class Controller:
         restart (docs/OBSERVABILITY.md)."""
         out = self.recorder.dump(tracer=self.tracer)
         out["metrics"] = self.metrics.snapshot()
+        if self.policy_engine is not None:
+            # Prewarm table + provision estimate (reconcile-thread
+            # state read concurrently; values are scalars/copies).
+            out["policy"] = self.policy_engine.debug_state()
         # This dict is reconcile-thread-owned and deliberately
         # lock-free (giving the Controller a lock would put EVERY
         # field under the thread-discipline checker); the /debugz
@@ -1259,10 +1367,13 @@ class Controller:
                                  advisory_gangs=advisory)
         self._pass_plan_s = time.perf_counter() - t_plan
         for gang, reason in plan.deferred:
-            # Repair demand waiting for clamp/quota headroom: explained,
-            # never reported unsatisfiable (the gang is not stuck — its
-            # replacement is queued behind policy).
-            self._explain(gang.name, "repair provisioning deferred",
+            # Advisory demand waiting for clamp/quota headroom:
+            # explained, never reported unsatisfiable (a repair's
+            # replacement is queued behind policy; a prewarm simply
+            # does not fire — organic demand keeps its headroom).
+            what = ("prewarm" if gang.key and gang.key[0] == "prewarm"
+                    else "repair")
+            self._explain(gang.name, f"{what} provisioning deferred",
                           reason)
         if plan_mode == "delta" and self.config.verify_delta_plans:
             # Parity gate (tests/bench): the incremental path must
@@ -1649,23 +1760,29 @@ class Controller:
                               latency_s=round(value, 3))
                 if roots and status.id in self._supply_awaiting_nodes:
                     # Supply guard engaged earlier this pass: open the
-                    # registration span NOW (after the provision span,
+                    # registration spans NOW (after the provision span,
                     # so seq order stays causal); the guard's release
-                    # or expiry in _update_supply_guard ends it.
-                    self._registration_spans[status.id] = \
+                    # or expiry in _update_supply_guard ends them.  One
+                    # span PER served trace: a multislice cohort's
+                    # sibling traces each carry the full phase anatomy
+                    # (trace_gaps holds per trace), mirroring the
+                    # provision-span loop above.
+                    self._registration_spans[status.id] = [
                         self.tracer.start(
-                            "node_registration", parent=roots[0], t=now,
+                            "node_registration", parent=root, t=now,
                             attrs={"provision_id": status.id,
                                    "units": ",".join(status.unit_ids)})
+                        for root in roots]
                 elif roots:
                     # Units already registered when ACTIVE was observed
                     # (the fake cloud; fast node pools): the
                     # registration phase collapsed to a point — record
                     # it so every trace shows the full anatomy.
-                    self.tracer.record(
-                        "node_registration", start=now, end=now,
-                        parent=roots[0],
-                        attrs={"provision_id": status.id})
+                    for root in roots:
+                        self.tracer.record(
+                            "node_registration", start=now, end=now,
+                            parent=root,
+                            attrs={"provision_id": status.id})
                 success_key = (status.request.gang_key
                                or ("shape", status.request.shape_name))
                 self._failure_streak.pop(success_key, None)
@@ -2002,9 +2119,15 @@ class Controller:
                 if created:
                     self.metrics.observe("ready_barrier_seconds",
                                          max(0.0, now - min(created)))
+            # Per-unit idle threshold: the policy engine's SLO/cost
+            # tradeoff (ISSUE 8) — stretched when demand is forecast
+            # for this unit's class, shrunk toward the floor when the
+            # class shows no predicted demand (early reclaim).
+            idle_threshold = self._policy_idle_overrides.get(
+                unit_id, cfg.idle_threshold_seconds)
             state = classify_slice(
                 view, grace_seconds=cfg.grace_seconds,
-                idle_threshold_seconds=cfg.idle_threshold_seconds,
+                idle_threshold_seconds=idle_threshold,
                 spare=unit_id in spare_ids,
                 utilization_threshold=cfg.utilization_threshold)
             state_counts[state.value] = state_counts.get(state.value, 0) + 1
@@ -2021,16 +2144,31 @@ class Controller:
                                 and unit_id not in self._requested_drains
                                 else "drain requested"))
                 elif state is SliceState.IDLE_DRAINABLE:
-                    if unit_id in claimed_ids:
+                    if unit_id in self._policy_holds:
+                        # An un-consumed prewarm rides this unit: a
+                        # warm slice reclaimed seconds before its
+                        # predicted gang arrives is the worst of both
+                        # worlds.  Bounded: the hold dies with the
+                        # prediction's window (docs/POLICY.md).
+                        self.metrics.inc("prewarm_holds")
+                        self._explain(unit_id, "reclaim deferred",
+                                      "held warm for a forecast "
+                                      "prewarm")
+                    elif unit_id in claimed_ids:
                         # Pending demand will bind here: hands off
                         # (reference: pending pods could use the node).
                         self.metrics.inc("reclaims_deferred_to_pending")
                         self._explain(unit_id, "reclaim deferred",
                                       "pending demand claims this unit")
                     else:
+                        if idle_threshold < cfg.idle_threshold_seconds:
+                            # The policy shrank this unit's threshold:
+                            # cost won over a demand forecast that
+                            # never came (docs/POLICY.md scale-down).
+                            self.metrics.inc("policy_early_reclaims")
                         self._begin_drain(
                             unit_id, unit_nodes, unit_pods, now,
-                            reason=f"idle > {cfg.idle_threshold_seconds:g}s")
+                            reason=f"idle > {idle_threshold:g}s")
                 elif (state is SliceState.UNDER_UTILIZED
                       and not consolidated_this_pass):
                     consolidated_this_pass = True

@@ -367,13 +367,15 @@ class Planner:
         to fit it on instead of the policy default — the controller sets
         it from failure streaks (capacity stockout fallback).
 
-        ``advisory_gangs`` is repair demand (ISSUE 7): ``(gang,
-        shape_name)`` pairs naming the exact like-for-like replacement
-        slice for a gang whose unit is under ICI-atomic repair.  The
-        controller supplies the shape (the broken unit's own — the gang
-        may be partially observed mid-repair, so refitting from its
-        pods could undershoot); the planner still decides admission
-        with the same free-slice/clamp/quota algebra as organic demand.
+        ``advisory_gangs`` is advisory demand: ``(gang, shape_name)``
+        pairs naming an exact slice shape.  Two producers ride it —
+        ICI-atomic repair replacements (ISSUE 7: the broken unit's own
+        shape, because the gang may be partially observed mid-repair)
+        and the policy engine's predictive prewarms (ISSUE 8:
+        synthetic gangs keyed ``("prewarm", ...)`` ahead of forecast
+        demand).  Either way the planner decides admission with the
+        same free-slice/clamp/quota algebra as organic demand, AFTER
+        organic demand (advisory work never displaces a real gang).
         Inadmissible advisory demand lands in ``plan.deferred``, never
         ``plan.unsatisfiable``.  The planner stays a pure function of
         its inputs (TAP1xx)."""
@@ -636,11 +638,20 @@ class Planner:
                     continue
                 ns_chips[ns] = ns_new
             planned_chips += shape.chips
+            # Advisory demand is repair replacements (ISSUE 7) or
+            # policy prewarms (ISSUE 8) — same admission algebra, told
+            # apart by the synthetic "prewarm" key prefix so logs and
+            # notifications say what the chips are actually for.
+            if gang.key and gang.key[0] == "prewarm":
+                reason = (f"predictive prewarm: {shape.name} ahead of "
+                          f"forecast demand ({gang.name})")
+            else:
+                reason = (f"slice repair: like-for-like {shape.name} "
+                          f"replacement for gang {gang.name}")
             plan.requests.append(ProvisionRequest(
                 kind="tpu-slice", shape_name=shape.name, count=1,
                 gang_key=gang.key, preemptible=pol.preemptible,
-                reason=(f"slice repair: like-for-like {shape.name} "
-                        f"replacement for gang {gang.name}")))
+                reason=reason))
 
         # ---- warm spare slices (reference --spare-agents, per shape) -----
         for shape_name, want in pol.spare_slices.items():

@@ -188,6 +188,24 @@ _common = [
                       "fairness under a contended chip budget)."),
     click.option("--no-scale", is_flag=True),
     click.option("--no-maintenance", is_flag=True),
+    click.option("--policy", "enable_policy", is_flag=True,
+                 help="Enable the predictive SLO-driven policy engine: "
+                      "forecast demand and prewarm slices ahead of the "
+                      "Unschedulable event (docs/POLICY.md)."),
+    click.option("--policy-min-confidence", default=0.6,
+                 show_default=True, type=click.FloatRange(0.0, 1.0),
+                 help="Forecast confidence below which no prewarm "
+                      "fires."),
+    click.option("--policy-waste-budget", default=120000.0,
+                 show_default=True, type=click.FloatRange(min=0.0),
+                 help="Rolling wasted-chip-seconds budget per hour for "
+                      "mispredicted prewarms."),
+    click.option("--policy-early-reclaim", is_flag=True,
+                 help="Also let the policy SHRINK idle thresholds for "
+                      "classes with no forecast demand (cost wins; "
+                      "idle units may be reclaimed well before "
+                      "--idle-threshold). Off by default: --policy "
+                      "alone only prewarms and holds."),
     click.option("--slack-hook", default=None,
                  help="Slack incoming-webhook URL for scale events."),
     click.option("--slack-channel", default=None),
@@ -211,8 +229,10 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            namespace_quotas, over_provision,
            default_generation, generation_fallbacks, cpu_machine_type,
            max_cpu_nodes, max_total_chips, preemptible, fair_share,
-           no_scale, no_maintenance, slack_hook, slack_channel,
-           metrics_port, log_json, verbose) -> Controller:
+           no_scale, no_maintenance, enable_policy,
+           policy_min_confidence, policy_waste_budget,
+           policy_early_reclaim, slack_hook,
+           slack_channel, metrics_port, log_json, verbose) -> Controller:
     from tpu_autoscaler.logging_setup import setup_logging
 
     setup_logging(verbose=verbose, json_format=log_json)
@@ -233,7 +253,26 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         provision_timeout_seconds=provision_timeout,
         enable_preemption=preemption,
         no_scale=no_scale, no_maintenance=no_maintenance)
-    controller = Controller(kube, actuator, config, notifier, metrics)
+    policy_engine = None
+    if enable_policy:
+        from tpu_autoscaler.policy import (
+            PolicyConfig,
+            PolicyEngine,
+            SloPolicy,
+        )
+
+        policy_engine = PolicyEngine(PolicyConfig(slo=SloPolicy(
+            min_confidence=policy_min_confidence,
+            waste_budget_chip_seconds=policy_waste_budget,
+            # Early reclaim is an explicit operator opt-in from the
+            # CLI: during the cold-start learning window no class has
+            # a confident forecast, and silently shrinking every idle
+            # threshold to the floor would override --idle-threshold
+            # the operator configured.
+            early_reclaim=policy_early_reclaim,
+            idle_ceiling_seconds=max(7200.0, idle_threshold * 4))))
+    controller = Controller(kube, actuator, config, notifier, metrics,
+                            policy_engine=policy_engine)
     if metrics_port:
         # Serve /metrics + /healthz + /debugz together: the flight-
         # recorder dump rides the port operators already expose.

@@ -1,0 +1,43 @@
+"""Predictive SLO-driven scaling policy (ISSUE 8, docs/POLICY.md).
+
+The advisory decision layer over the reactive control loop: demand
+forecasters (``forecast``), the SLO/cost algebra (``slo``), the
+per-pass engine the Reconciler consults (``engine``), and the offline
+replay/eval harness (``replay``; CLI ``python -m tpu_autoscaler.policy``).
+"""
+
+from tpu_autoscaler.policy.engine import (
+    PREWARM_NAMESPACE,
+    PolicyAdvice,
+    PolicyConfig,
+    PolicyEngine,
+)
+from tpu_autoscaler.policy.forecast import (
+    EwmaForecaster,
+    Forecast,
+    HoltWintersForecaster,
+    RecurringGangPredictor,
+    merge_forecasts,
+)
+from tpu_autoscaler.policy.slo import (
+    PrewarmDecision,
+    SloPolicy,
+    decide_prewarms,
+    idle_threshold_for,
+)
+
+__all__ = [
+    "PREWARM_NAMESPACE",
+    "PolicyAdvice",
+    "PolicyConfig",
+    "PolicyEngine",
+    "EwmaForecaster",
+    "Forecast",
+    "HoltWintersForecaster",
+    "RecurringGangPredictor",
+    "merge_forecasts",
+    "PrewarmDecision",
+    "SloPolicy",
+    "decide_prewarms",
+    "idle_threshold_for",
+]

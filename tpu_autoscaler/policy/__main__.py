@@ -1,0 +1,57 @@
+"""Policy replay CLI: ``python -m tpu_autoscaler.policy``.
+
+Replays a traffic program through the real control loop (docs/POLICY.md
+workflow) and prints the scorecard as JSON.  ``--compare`` runs the
+program twice — reactive baseline vs PolicyEngine — and reports the
+tail-latency ratio the bench gates on.
+
+Exit codes: 0 ok; 2 the replay left pods pending (the policy broke
+convergence — never acceptable for an advisory layer).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_autoscaler.policy.replay import compare, make_program, replay
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_autoscaler.policy",
+        description="Offline policy evaluation: replay traffic programs "
+                    "and score SLO attainment vs wasted chip-seconds.")
+    parser.add_argument("--program", default="recurring",
+                        choices=("recurring", "diurnal", "spike",
+                                 "coldstart", "regime"))
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--shape", default="v5e-16",
+                        help="slice shape the traffic demands")
+    parser.add_argument("--period", type=float, default=900.0,
+                        help="base period seconds (default 900)")
+    parser.add_argument("--cycles", type=int, default=6,
+                        help="recurring arrivals (default 6)")
+    parser.add_argument("--compare", action="store_true",
+                        help="run reactive AND policy-enabled, report "
+                             "the tail-latency ratio")
+    parser.add_argument("--no-policy", action="store_true",
+                        help="reactive baseline only")
+    args = parser.parse_args(argv)
+
+    program = make_program(args.program, args.seed, shape=args.shape,
+                           period=args.period, cycles=args.cycles)
+    if args.compare:
+        card = compare(program)
+        print(json.dumps(card, indent=2))
+        pending = (card["reactive"]["pending_at_end"]
+                   + card["policy"]["pending_at_end"])
+        return 2 if pending else 0
+    result = replay(program, policy=not args.no_policy)
+    print(json.dumps(result.as_dict(), indent=2))
+    return 2 if result.pending_at_end else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,608 @@
+"""PolicyEngine: the advisory decision layer the Reconciler consults.
+
+Sits BESIDE the control loop, never inside the planner (ISSUE 8): each
+reconcile pass feeds it the pass's own observation (pending gangs,
+nodes, pods, actuator statuses) and gets back :class:`PolicyAdvice` —
+
+- **advisory prewarm demand** through the planner's existing
+  ``advisory_gangs`` hook: synthetic one-pod gangs keyed
+  ``("prewarm", ns, name)`` naming an exact slice shape.  The planner
+  stays a pure function (TAP1xx) and admits them with its normal
+  free-slice / clamp / quota algebra, AFTER organic demand — a
+  misprediction can never displace a real gang;
+- **prewarm-hold hints**: supply units carrying an un-consumed prewarm
+  are deferred from idle reclaim until the prediction's hold window
+  closes (a warm slice reclaimed seconds before its predicted gang
+  arrives is the worst of both worlds);
+- **early-reclaim hints**: per-unit idle-threshold overrides from the
+  SLO/cost tradeoff (``slo.idle_threshold_for``) — idle capacity whose
+  class shows no forecast demand is returned early.
+
+Observability is first-class (docs/OBSERVABILITY.md): when a predicted
+gang lands on prewarmed supply, the engine records a ``prewarm`` span
+into that gang's own scale-up trace (the provision happened BEFORE the
+trace began — the span shows the latency that was hidden), and exports
+forecast error, prewarm hit rate, hidden-provision seconds and wasted
+chip-seconds (docs/OPERATIONS.md, TAO6xx-checked).
+
+Threading: the engine is reconcile-thread-only state, like the rest of
+the controller's bookkeeping — no locks, no threads, nothing for the
+race detector to find.  Every method takes the injected pass clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Mapping, Sequence
+
+from tpu_autoscaler.k8s.gangs import Gang
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.policy.forecast import (
+    EwmaForecaster,
+    Forecast,
+    HoltWintersForecaster,
+    RecurringGangPredictor,
+    merge_forecasts,
+)
+from tpu_autoscaler.policy.slo import (
+    PrewarmDecision,
+    SloPolicy,
+    decide_prewarms,
+    expires_at,
+    idle_threshold_for,
+)
+
+log = logging.getLogger(__name__)
+
+GangKey = tuple[str, str, str]
+
+#: Namespace synthetic prewarm gangs carry (kept out of tenant quota
+#: maps on purpose: prewarms ride the global chip clamp only).
+PREWARM_NAMESPACE = "tpu-autoscaler"
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyConfig:
+    """PolicyEngine wiring (docs/POLICY.md)."""
+
+    slo: SloPolicy = dataclasses.field(default_factory=SloPolicy)
+    use_ewma: bool = True
+    use_holt_winters: bool = True
+    use_recurring: bool = True
+    ewma_alpha: float = 0.3
+    hw_bin_seconds: float = 300.0
+    hw_season_bins: int = 24
+    recurring_max_cv: float = 0.25
+    # Terminal (consumed/expired) prewarm records are kept this long
+    # for /debugz introspection, then dropped (bounded state).
+    retention_seconds: float = 3600.0
+
+
+@dataclasses.dataclass
+class PolicyAdvice:
+    """One pass's policy output, folded into the reconcile pass."""
+
+    advisory: list[tuple[Gang, str]] = dataclasses.field(
+        default_factory=list)
+    hold_units: set[str] = dataclasses.field(default_factory=set)
+    idle_overrides: dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    rejections: list[str] = dataclasses.field(default_factory=list)
+    decisions: list[PrewarmDecision] = dataclasses.field(
+        default_factory=list)
+    digest: int = 0
+
+
+@dataclasses.dataclass
+class _Prewarm:
+    """Lifecycle record of one prewarm (reconcile-thread-only)."""
+
+    decision: PrewarmDecision
+    gang: Gang
+    created_at: float
+    provision_id: str | None = None
+    submitted_at: float | None = None
+    ready_at: float | None = None
+    unit_ids: tuple[str, ...] = ()
+    covered_unit: str | None = None     # pre-existing free slice
+    consumed_by: GangKey | None = None
+    consumed_at: float | None = None
+    expired_at: float | None = None
+
+    @property
+    def key(self) -> str:
+        return self.decision.key
+
+    @property
+    def terminal(self) -> bool:
+        return self.consumed_by is not None or self.expired_at is not None
+
+    @property
+    def warm_units(self) -> tuple[str, ...]:
+        if self.unit_ids:
+            return self.unit_ids
+        if self.covered_unit is not None:
+            return (self.covered_unit,)
+        return ()
+
+
+def _probe_pod_payload(shape_name: str, name: str,
+                       namespace: str) -> dict[str, Any]:
+    """A pending-pod payload shaped like one member of the predicted
+    gang, used ONLY as the planner's admission probe — it is never
+    written to the cluster."""
+    from tpu_autoscaler.topology.catalog import (
+        ACCELERATOR_LABEL,
+        TOPOLOGY_LABEL,
+        TPU_RESOURCE,
+        shape_by_name,
+    )
+
+    shape = shape_by_name(shape_name)
+    return {
+        "metadata": {
+            "name": name, "namespace": namespace,
+            "labels": {"batch.kubernetes.io/job-name": name},
+            "creationTimestamp": "1970-01-01T00:00:00Z",
+        },
+        "spec": {
+            "containers": [{"name": "main", "resources": {
+                "requests": {TPU_RESOURCE: str(shape.chips_per_host)}}}],
+            "nodeSelector": {ACCELERATOR_LABEL: shape.accelerator_type,
+                             TOPOLOGY_LABEL: shape.topology_label},
+            "tolerations": [{"key": TPU_RESOURCE, "operator": "Exists",
+                             "effect": "NoSchedule"}],
+        },
+        "status": {"phase": "Pending", "conditions": [
+            {"type": "PodScheduled", "status": "False",
+             "reason": "Unschedulable"}]},
+    }
+
+
+class PolicyEngine:
+    """Forecast -> SLO/cost -> advisory demand, one pass at a time."""
+
+    def __init__(self, config: PolicyConfig | None = None) -> None:
+        self.config = config or PolicyConfig()
+        cfg = self.config
+        self.ewma = EwmaForecaster(alpha=cfg.ewma_alpha)
+        self.holt_winters = HoltWintersForecaster(
+            bin_seconds=cfg.hw_bin_seconds,
+            season_bins=cfg.hw_season_bins)
+        self.recurring = RecurringGangPredictor(
+            max_cv=cfg.recurring_max_cv)
+        self._metrics: Any = None
+        self._tracer: Any = None
+        self._default_generation = "v5e"
+        self._prewarms: dict[str, _Prewarm] = {}
+        self._seq = 0
+        # Gang keys already counted as arrivals (bounded: pruned
+        # against the live pod set every pass).
+        self._seen_pending: set[GangKey] = set()
+        # Per-class nearest active prediction, for forecast error:
+        # class -> (predicted_at, forecast key).
+        self._pending_prediction: dict[str, tuple[float, str]] = {}
+        # Rolling realized-waste events: (t, chip_seconds).
+        self._waste_events: list[tuple[float, float]] = []
+        # Measured provision durations (prewarms the engine itself
+        # timed), EWMA-folded over the configured estimate.
+        self._provision_estimate: float | None = None
+        self._hits = 0
+        self._expired = 0
+
+    # -- wiring -----------------------------------------------------------
+
+    def bind(self, metrics: Any = None, tracer: Any = None,
+             default_generation: str | None = None) -> None:
+        """Adopt the controller's metrics/tracer and planner default
+        generation (the Controller calls this at construction)."""
+        if metrics is not None:
+            self._metrics = metrics
+        if tracer is not None:
+            self._tracer = tracer
+        if default_generation is not None:
+            self._default_generation = default_generation
+
+    def bootstrap(self, dump: Mapping[str, Any]) -> int:
+        """Recover learned periods from a flight-recorder dump (a
+        restarted controller re-learns from its own history instead of
+        from zero).  Returns arrivals ingested."""
+        return self.recurring.ingest_dump(dict(dump))
+
+    # -- metrics helpers --------------------------------------------------
+
+    def _inc(self, name: str, by: float = 1.0) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name, by)
+
+    def _observe(self, name: str, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.observe(name, value)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(name, value)
+
+    def provision_estimate(self) -> float:
+        """Reactive provision latency estimate: measured (EWMA over
+        provisions the engine timed) when available, else configured."""
+        if self._provision_estimate is not None:
+            return self._provision_estimate
+        return self.config.slo.provision_estimate_seconds
+
+    def _note_provision_duration(self, seconds: float) -> None:
+        if seconds <= 0.0:
+            return
+        if self._provision_estimate is None:
+            self._provision_estimate = seconds
+        else:
+            self._provision_estimate = (0.7 * self._provision_estimate
+                                        + 0.3 * seconds)
+
+    # -- observe side -----------------------------------------------------
+
+    def _classify_gang(self, gang: Gang) -> tuple[str, str | None]:
+        """(accelerator class, exact shape name|None) for one gang."""
+        from tpu_autoscaler.engine.fitter import (
+            FitError,
+            choose_shape_for_gang,
+        )
+        from tpu_autoscaler.topology.catalog import ACCELERATOR_LABEL
+
+        shape_name: str | None = None
+        accel = gang.node_selectors.get(ACCELERATOR_LABEL)
+        try:
+            choice = choose_shape_for_gang(gang, self._default_generation)
+            shape_name = choice.shape.name
+            if accel is None:
+                accel = choice.shape.accelerator_type
+        except FitError:
+            pass
+        return accel or "unknown", shape_name
+
+    def observe(self, gangs: Sequence[Gang], nodes: Sequence[Node],
+                pods: Sequence[Pod], statuses: Sequence[Any],
+                now: float,
+                gang_traces: Mapping[GangKey, Any] | None = None
+                ) -> None:
+        """Feed one pass's world into the forecasters and advance every
+        prewarm's lifecycle (provisioned -> ready -> consumed|expired).
+        Call BEFORE the pass's latency tracking so a consumption span
+        lands in the gang's still-open trace."""
+        cfg = self.config
+        # ---- arrivals: first-pending TPU gangs --------------------------
+        live_keys = {p.gang_key for p in pods}
+        self._seen_pending &= live_keys
+        for gang in gangs:
+            if not gang.requests_tpu or gang.key in self._seen_pending:
+                continue
+            if gang.key and gang.key[0] == "prewarm":
+                continue  # never learn from our own synthetic demand
+            self._seen_pending.add(gang.key)
+            accel, shape_name = self._classify_gang(gang)
+            chips = gang.tpu_chips
+            if cfg.use_ewma:
+                self.ewma.note(accel, shape_name, now, chips)
+            if cfg.use_holt_winters:
+                self.holt_winters.note(accel, shape_name, now, chips)
+            if cfg.use_recurring and shape_name is not None:
+                self.recurring.note(gang.name, accel, shape_name, now)
+            predicted = self._pending_prediction.pop(accel, None)
+            if predicted is not None:
+                self._observe("forecast_error_seconds",
+                                     abs(now - predicted[0]))
+        if cfg.use_holt_winters:
+            self.holt_winters.observe_silence(now)
+
+        # ---- prewarm lifecycle off the actuator statuses ----------------
+        by_key: dict[GangKey, Any] = {}
+        for status in statuses:
+            key = getattr(status.request, "gang_key", None)
+            if key is not None and key and key[0] == "prewarm":
+                by_key[key] = status
+        for pw in self._prewarms.values():
+            if pw.terminal:
+                continue
+            status = by_key.get(pw.gang.key)
+            if status is None:
+                continue
+            if pw.provision_id != status.id:
+                pw.provision_id = status.id
+                pw.submitted_at = now if pw.submitted_at is None \
+                    else pw.submitted_at
+            if status.state == "ACTIVE" and pw.ready_at is None:
+                pw.ready_at = now
+                pw.unit_ids = tuple(status.unit_ids)
+                if pw.submitted_at is not None:
+                    self._note_provision_duration(now - pw.submitted_at)
+            elif status.state == "FAILED":
+                # Advisory re-emission resumes; the reconciler's
+                # per-key backoff paces the retry.
+                pw.provision_id = None
+
+        # ---- consumption: predicted gang runs on warm supply ------------
+        slice_of: dict[str, str] = {}
+        for n in nodes:
+            if n.is_tpu and n.slice_id:
+                slice_of[n.name] = n.slice_id
+        warm_owner: dict[str, _Prewarm] = {}
+        for pw in self._prewarms.values():
+            if pw.terminal:
+                continue
+            for unit in pw.warm_units:
+                warm_owner.setdefault(unit, pw)
+        if warm_owner:
+            for p in pods:
+                if not p.is_workload or p.node_name is None \
+                        or p.phase != "Running":
+                    continue
+                sid = slice_of.get(p.node_name)
+                pw = warm_owner.get(sid) if sid is not None else None
+                if pw is None or pw.terminal \
+                        or p.gang_key is None \
+                        or p.gang_key[0] == "prewarm":
+                    continue
+                self._consume(pw, p.gang_key, now, gang_traces)
+
+        # ---- expiry: the hold window closed unconsumed ------------------
+        for pw in self._prewarms.values():
+            if pw.terminal:
+                continue
+            if now >= expires_at(pw.decision.predicted_at, cfg.slo):
+                pw.expired_at = now
+                self._expired += 1
+                self._inc("prewarm_expired")
+                warm_since = pw.ready_at if pw.ready_at is not None \
+                    else (pw.created_at if pw.covered_unit else None)
+                if warm_since is not None:
+                    waste = pw.decision.chips * max(0.0, now - warm_since)
+                    self._inc("wasted_prewarm_chip_seconds", waste)
+                    self._waste_events.append((now, waste))
+                log.info("prewarm %s expired unconsumed (%s)",
+                         pw.key, pw.decision.shape_name)
+
+        # ---- bounded state ----------------------------------------------
+        horizon = now - cfg.retention_seconds
+        for key in [k for k, pw in self._prewarms.items()
+                    if pw.terminal
+                    and (pw.consumed_at or pw.expired_at or 0.0)
+                    < horizon]:
+            del self._prewarms[key]
+        window = now - cfg.slo.waste_window_seconds
+        self._waste_events = [(t, w) for t, w in self._waste_events
+                              if t >= window]
+        total = self._hits + self._expired
+        if total:
+            self.set_gauge("prewarm_hit_rate", self._hits / total)
+
+    def _consume(self, pw: _Prewarm, consumer: GangKey, now: float,
+                 gang_traces: Mapping[GangKey, Any] | None) -> None:
+        pw.consumed_by = consumer
+        pw.consumed_at = now
+        self._hits += 1
+        self._inc("prewarm_hits")
+        covered = pw.provision_id is None or pw.ready_at is None
+        if not covered:
+            # Only a prewarm that actually PROVISIONED hid latency; a
+            # covered one (an adopted free slice the hold protected)
+            # saved a reclaim, not a provision — claiming the estimate
+            # would inflate the operator-facing hidden-latency series
+            # whenever free capacity already existed.
+            hidden = (pw.ready_at or now) - (pw.submitted_at or now)
+            self._observe("hidden_provision_seconds", hidden)
+        else:
+            hidden = 0.0
+        log.info("prewarm %s consumed by %s (%s)",
+                 pw.key, consumer,
+                 "held free slice" if covered
+                 else f"hid {hidden:.0f}s of provision")
+        root = (gang_traces or {}).get(consumer)
+        if root is not None and self._tracer is not None:
+            # The provision ran BEFORE this gang's trace was minted:
+            # the span records the latency that never reached the
+            # critical path (docs/OBSERVABILITY.md prewarm model).
+            start = pw.submitted_at if pw.submitted_at is not None \
+                else pw.created_at
+            self._tracer.record(
+                "prewarm", start=start,
+                end=pw.ready_at if pw.ready_at is not None else now,
+                parent=root,
+                attrs={"shape": pw.decision.shape_name,
+                       "forecast": pw.key,
+                       "provision_id": pw.provision_id,
+                       "covered": covered,
+                       "hidden_s": round(hidden, 3),
+                       "confidence": round(pw.decision.confidence, 3)})
+
+    # -- advise side ------------------------------------------------------
+
+    def forecasts(self, now: float) -> list[Forecast]:
+        cfg = self.config
+        streams: list[list[Forecast]] = []
+        if cfg.use_recurring:
+            streams.append(self.recurring.forecasts(now))
+        if cfg.use_holt_winters:
+            streams.append(self.holt_winters.forecasts(now))
+        if cfg.use_ewma:
+            streams.append(self.ewma.forecasts(now))
+        return merge_forecasts(streams)
+
+    def _free_slices_by_shape(self, nodes: Sequence[Node],
+                              pods: Sequence[Pod]) -> dict[str, str]:
+        """Map free slice id -> its catalog shape name."""
+        from tpu_autoscaler.engine.planner import _free_slices
+        from tpu_autoscaler.topology.catalog import shape_from_selectors
+
+        out: dict[str, str] = {}
+        for sid, members in _free_slices(list(nodes), list(pods)).items():
+            try:
+                shape = shape_from_selectors(members[0].labels)
+            except KeyError:
+                continue
+            if shape is not None and len(members) == shape.hosts:
+                out[sid] = shape.name
+        return out
+
+    def advise(self, nodes: Sequence[Node], pods: Sequence[Pod],
+               now: float, *, base_idle_threshold: float
+               ) -> PolicyAdvice:
+        """Turn the current forecast set into this pass's advice."""
+        cfg = self.config
+        slo = cfg.slo
+        advice = PolicyAdvice()
+        forecasts = self.forecasts(now)
+
+        # Forecast-error bookkeeping: remember the nearest active
+        # prediction per class; the next arrival scores it.
+        for f in forecasts:
+            if f.confidence < slo.min_confidence:
+                continue
+            cur = self._pending_prediction.get(f.accel_class)
+            if cur is None or f.at < cur[0]:
+                self._pending_prediction[f.accel_class] = (f.at, f.key)
+
+        active = [pw for pw in self._prewarms.values() if not pw.terminal]
+        committed = sum(pw.decision.expected_waste_chip_seconds
+                        for pw in active)
+        realized = sum(w for _t, w in self._waste_events)
+        # Belt over the key-level dedup: one predicted event must never
+        # hold two prewarms — drop forecasts whose shape already has an
+        # active prewarm with an overlapping predicted window (keys can
+        # legitimately differ across forecaster sources).
+        def _duplicates_active(f: Forecast) -> bool:
+            return any(
+                pw.decision.shape_name == f.shape_name
+                and abs(pw.decision.predicted_at - f.at)
+                < slo.prewarm_hold_seconds
+                for pw in active)
+
+        forecasts_to_gate = [f for f in forecasts
+                             if not _duplicates_active(f)]
+        decisions, rejections = decide_prewarms(
+            forecasts_to_gate, now, policy=slo,
+            provision_estimate=self.provision_estimate(),
+            waste_spent_chip_seconds=committed + realized,
+            active_prewarms=len(active),
+            active_keys=frozenset(pw.key for pw in active))
+        advice.rejections = rejections
+        advice.decisions = decisions
+
+        for d in decisions:
+            self._seq += 1
+            name = f"prewarm-{self._seq}-{d.shape_name}"
+            gang = Gang(
+                key=("prewarm", PREWARM_NAMESPACE, name),
+                pods=[Pod(_probe_pod_payload(d.shape_name, name,
+                                             PREWARM_NAMESPACE))])
+            pw = _Prewarm(decision=d, gang=gang, created_at=now)
+            self._prewarms[pw.key] = pw
+            active.append(pw)
+            self._inc("prewarm_decisions")
+            log.info("prewarm decided: %s (%s)", d.key, d.reason)
+
+        # A free slice of exactly the predicted shape covers a prewarm
+        # without provisioning: hold it for the prediction instead.
+        free_by_shape = self._free_slices_by_shape(nodes, pods) \
+            if active else {}
+        covered_units = {pw.covered_unit for pw in active
+                         if pw.covered_unit is not None}
+        for pw in active:
+            if pw.unit_ids or pw.covered_unit is not None:
+                continue
+            for sid, shape in sorted(free_by_shape.items()):
+                if shape == pw.decision.shape_name \
+                        and sid not in covered_units:
+                    pw.covered_unit = sid
+                    covered_units.add(sid)
+                    break
+
+        for pw in active:
+            if pw.covered_unit is None and not pw.unit_ids:
+                advice.advisory.append((pw.gang,
+                                        pw.decision.shape_name))
+            advice.hold_units.update(pw.warm_units)
+
+        # ---- early-reclaim / hold idle-threshold overrides --------------
+        next_by_class: dict[str, tuple[float, float]] = {}
+        for f in forecasts:
+            cur = next_by_class.get(f.accel_class)
+            if cur is None or f.at < cur[0]:
+                next_by_class[f.accel_class] = (f.at, f.confidence)
+        idle_units = self._idle_tpu_units(nodes, pods)
+        for unit_id, accel in sorted(idle_units.items()):
+            if unit_id in advice.hold_units:
+                continue  # the prewarm hold already protects it
+            nxt = next_by_class.get(accel)
+            override = idle_threshold_for(
+                accel, now, policy=slo,
+                base_threshold=base_idle_threshold,
+                provision_estimate=self.provision_estimate(),
+                next_arrival_at=nxt[0] if nxt else None,
+                confidence=nxt[1] if nxt else 0.0)
+            if override != base_idle_threshold:
+                advice.idle_overrides[unit_id] = override
+
+        advice.digest = hash((
+            tuple(sorted((g.key, s) for g, s in advice.advisory)),
+            tuple(sorted(advice.hold_units)),
+            tuple(sorted(advice.idle_overrides.items())),
+        ))
+        self.set_gauge("policy_advisory_gangs", len(advice.advisory))
+        return advice
+
+    def _idle_tpu_units(self, nodes: Sequence[Node],
+                        pods: Sequence[Pod]) -> dict[str, str]:
+        """Workload-free TPU units -> accelerator class."""
+        from tpu_autoscaler.k8s.units import group_supply_units
+
+        busy: set[str] = set()
+        for p in pods:
+            if p.node_name and p.is_workload \
+                    and p.phase in ("Pending", "Running"):
+                busy.add(p.node_name)
+        out: dict[str, str] = {}
+        for unit_id, unit_nodes in group_supply_units(
+                list(nodes)).items():
+            if not unit_nodes[0].is_tpu:
+                continue
+            if any(n.name in busy for n in unit_nodes):
+                continue
+            accel = unit_nodes[0].tpu_accelerator
+            if accel:
+                out[unit_id] = accel
+        return out
+
+    # -- introspection ----------------------------------------------------
+
+    def debug_state(self) -> dict[str, Any]:
+        """JSON-able prewarm table for /debugz.
+
+        Called from the /debugz HTTP thread while the reconcile thread
+        mutates ``_prewarms`` lock-free — copy with a bounded retry
+        (the ``debug_dump`` supply-guard pattern): a resize mid-copy
+        raises RuntimeError, and a diagnostic endpoint must degrade,
+        not 500, exactly when the controller is busy."""
+        for _ in range(5):
+            try:
+                prewarms = {
+                    pw.key: {
+                        "shape": pw.decision.shape_name,
+                        "predicted_at": pw.decision.predicted_at,
+                        "confidence": pw.decision.confidence,
+                        "provision_id": pw.provision_id,
+                        "units": list(pw.warm_units),
+                        "consumed_by": ("/".join(str(x) for x in
+                                                 pw.consumed_by)
+                                        if pw.consumed_by else None),
+                        "expired_at": pw.expired_at,
+                    } for pw in list(self._prewarms.values())}
+                break
+            except RuntimeError:  # mutated mid-copy; retry
+                continue
+        else:
+            prewarms = {"unavailable": "mutating"}
+        return {
+            "provision_estimate_s": round(self.provision_estimate(), 3),
+            "prewarms": prewarms,
+        }

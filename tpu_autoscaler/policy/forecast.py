@@ -1,0 +1,412 @@
+"""Demand forecasters for the predictive scaling policy (ISSUE 8).
+
+The planner is reactive: a gang must go Unschedulable before
+provisioning starts, and the PR-5 phase traces show provision dominates
+the north-star latency (216 s of the 220 s v5p-256 realistic scale-up).
+Prediction is the only remaining lever: these forecasters turn the
+arrival history the controller already observes into *explicit,
+confidence-weighted* predictions of future demand, which ``slo.py``
+converts into prewarm decisions and ``engine.py`` feeds to the pure
+planner as advisory demand.
+
+Three models, cheapest first (NimbusGuard / SLO-driven-autoscaling
+lineage from PAPERS.md, without the RL machinery — the repo's
+deterministic replay harness is the evaluation loop):
+
+- :class:`EwmaForecaster` — exponentially-weighted inter-arrival model
+  per accelerator class.  Confidence is ``1 - cv`` (coefficient of
+  variation of the inter-arrival gap): regular traffic forecasts
+  sharply, Poisson-ish traffic honestly reports low confidence.
+- :class:`HoltWintersForecaster` — additive Holt-Winters over binned
+  per-class chip-arrival counts with a fixed season length (diurnal
+  traffic).  Confidence comes from the in-sample one-step error
+  relative to the mean demand level, ramped by seasons observed.
+- :class:`RecurringGangPredictor` — mines scale-up records (live
+  arrivals, or a flight-recorder dump via ``ingest_dump``) for gangs
+  whose *base name* (trailing run counters stripped) re-arrives on a
+  stable period: the nightly-training-job pattern.  This is the only
+  model precise enough to name an exact slice shape, so it is the one
+  that drives shape-exact prewarms.
+
+Everything here is pure computation over injected timestamps — no
+clocks, no randomness, no I/O (the module sits in the purity checker's
+TAP1xx scope next to the planner it advises).  All mutation is
+instance-local; callers (the reconcile thread) own the objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from collections import deque
+from typing import Any, Iterable
+
+#: Trailing run counters stripped to find a recurring gang's identity:
+#: ``nightly-train-17`` and ``nightly-train-18`` are the same job.
+_RUN_SUFFIX = re.compile(r"[-_]?\d+$")
+
+#: Minimum observations before a model reports a forecast at all.
+MIN_OBSERVATIONS = 3
+
+
+def base_name(name: str) -> str:
+    """Recurring-job identity: the gang name with trailing run
+    counters stripped (``ckpt-eval-0042`` -> ``ckpt-eval``)."""
+    return _RUN_SUFFIX.sub("", name) or name
+
+
+@dataclasses.dataclass(frozen=True)
+class Forecast:
+    """One predicted demand event, with explicit confidence.
+
+    ``key`` is a stable identity for deduplication across passes: the
+    same underlying prediction (same source, same basis, same predicted
+    window) must not spawn a second prewarm when re-emitted next pass.
+    """
+
+    accel_class: str        # gke-tpu-accelerator value the demand needs
+    shape_name: str | None  # exact catalog shape (recurring model only)
+    at: float               # predicted arrival time (same clock as input)
+    chips: int              # predicted chip demand
+    confidence: float       # 0..1, honest (see per-model docstrings)
+    source: str             # "ewma" | "holt_winters" | "recurring"
+    key: str                # stable dedup identity
+
+    def describe(self) -> str:
+        return (f"{self.source}: {self.chips} chips of "
+                f"{self.shape_name or self.accel_class} at t={self.at:g} "
+                f"(confidence {self.confidence:.2f})")
+
+
+def _ramp(count: int, full_at: int) -> float:
+    """Observation-count confidence ramp: 0 below MIN_OBSERVATIONS,
+    linear to 1.0 at ``full_at`` — a model must earn its confidence."""
+    if count < MIN_OBSERVATIONS:
+        return 0.0
+    return min(1.0, count / float(full_at))
+
+
+class EwmaForecaster:
+    """Per-class EWMA of inter-arrival gaps and chip sizes.
+
+    ``note`` once per gang arrival; ``forecasts`` predicts each class's
+    next arrival at ``last + mean_gap`` with confidence
+    ``(1 - cv) * ramp``.  Bursty traffic (cv >= 1) reports 0.
+    """
+
+    def __init__(self, alpha: float = 0.3, full_at: int = 8) -> None:
+        self.alpha = alpha
+        self.full_at = full_at
+        # class -> [last_t, mean_gap, mean_abs_dev, mean_chips, count]
+        self._state: dict[str, list[float]] = {}
+        # class -> modal shape bookkeeping (shape -> arrivals seen)
+        self._shapes: dict[str, dict[str, int]] = {}
+
+    def note(self, accel_class: str, shape_name: str | None, t: float,
+             chips: int) -> None:
+        a = self.alpha
+        st = self._state.get(accel_class)
+        if st is None:
+            self._state[accel_class] = [t, 0.0, 0.0, float(chips), 1.0]
+        else:
+            gap = max(0.0, t - st[0])
+            if st[4] < 2:
+                st[1], st[2] = gap, 0.0
+            else:
+                dev = abs(gap - st[1])
+                st[1] = (1 - a) * st[1] + a * gap
+                st[2] = (1 - a) * st[2] + a * dev
+            st[0] = t
+            st[3] = (1 - a) * st[3] + a * float(chips)
+            st[4] += 1.0
+        if shape_name is not None:
+            counts = self._shapes.setdefault(accel_class, {})
+            counts[shape_name] = counts.get(shape_name, 0) + 1
+
+    def modal_shape(self, accel_class: str) -> str | None:
+        counts = self._shapes.get(accel_class)
+        if not counts:
+            return None
+        return max(sorted(counts), key=lambda s: counts[s])
+
+    def forecasts(self, now: float) -> list[Forecast]:
+        out: list[Forecast] = []
+        for cls in sorted(self._state):
+            last_t, gap, dev, chips, count = self._state[cls]
+            if count < MIN_OBSERVATIONS or gap <= 0.0:
+                continue
+            cv = dev / gap
+            confidence = max(0.0, 1.0 - cv) * _ramp(int(count),
+                                                    self.full_at)
+            if confidence <= 0.0:
+                continue
+            at = expected = last_t + gap
+            # A prediction already in the past rolls forward one period
+            # (the arrival is late, not cancelled) — but only one: two
+            # missed periods mean the pattern broke.  The dedup KEY
+            # stays anchored to the expected event, never the rolled
+            # time: a late arrival must not mint a fresh key every
+            # pass and spawn duplicate prewarms for one event.
+            if at < now:
+                if now - at > gap:
+                    continue
+                at += gap
+                expected = at
+            out.append(Forecast(
+                accel_class=cls, shape_name=self.modal_shape(cls),
+                at=at, chips=int(round(chips)),
+                confidence=confidence, source="ewma",
+                key=f"ewma:{cls}:{int(expected // max(1.0, gap))}"))
+        return out
+
+
+class HoltWintersForecaster:
+    """Additive Holt-Winters over fixed-width arrival bins per class.
+
+    Chip arrivals are accumulated into ``bin_seconds`` buckets; the
+    classic level/trend/seasonal recursion updates once per *closed*
+    bin (empty bins update with 0 — silence is data).  ``forecasts``
+    scans the next season for the first bin whose prediction clears
+    ``min_chips`` and reports its start time.
+
+    Confidence: ``1 - err/level`` (one-step absolute forecast error
+    EWMA over the demand level EWMA), ramped by full seasons observed —
+    a model that has not seen one whole season yet predicts nothing.
+    """
+
+    def __init__(self, bin_seconds: float = 300.0, season_bins: int = 24,
+                 alpha: float = 0.35, beta: float = 0.05,
+                 gamma: float = 0.3, min_chips: int = 1) -> None:
+        if season_bins < 2:
+            raise ValueError(f"season_bins must be >= 2, got {season_bins}")
+        self.bin_seconds = bin_seconds
+        self.season_bins = season_bins
+        self.alpha, self.beta, self.gamma = alpha, beta, gamma
+        self.min_chips = min_chips
+        # class -> mutable state dict
+        self._state: dict[str, dict[str, Any]] = {}
+        self._shapes: dict[str, dict[str, int]] = {}
+
+    def _new_state(self, t: float) -> dict[str, Any]:
+        return {
+            "origin": t, "bin": 0, "acc": 0.0,
+            "level": 0.0, "trend": 0.0,
+            "seasonal": [0.0] * self.season_bins,
+            "bins_closed": 0, "err": 0.0, "demand": 0.0,
+        }
+
+    def _close_bins(self, st: dict[str, Any], upto_bin: int) -> None:
+        while st["bin"] < upto_bin:
+            y = st["acc"]
+            st["acc"] = 0.0
+            i = st["bin"] % self.season_bins
+            seasonal: list[float] = st["seasonal"]
+            if st["bins_closed"] < self.season_bins:
+                # First season: seed level/seasonal from raw data.
+                st["level"] = ((st["level"] * st["bins_closed"] + y)
+                               / (st["bins_closed"] + 1))
+                seasonal[i] = y - st["level"]
+            else:
+                predicted = st["level"] + st["trend"] + seasonal[i]
+                err = abs(y - predicted)
+                st["err"] = 0.8 * st["err"] + 0.2 * err
+                st["demand"] = 0.8 * st["demand"] + 0.2 * abs(y)
+                last_level = st["level"]
+                st["level"] = (self.alpha * (y - seasonal[i])
+                               + (1 - self.alpha)
+                               * (st["level"] + st["trend"]))
+                st["trend"] = (self.beta * (st["level"] - last_level)
+                               + (1 - self.beta) * st["trend"])
+                seasonal[i] = (self.gamma * (y - st["level"])
+                               + (1 - self.gamma) * seasonal[i])
+            st["bin"] += 1
+            st["bins_closed"] += 1
+
+    def _bin_of(self, st: dict[str, Any], t: float) -> int:
+        return max(0, int((t - st["origin"]) // self.bin_seconds))
+
+    def note(self, accel_class: str, shape_name: str | None, t: float,
+             chips: int) -> None:
+        st = self._state.get(accel_class)
+        if st is None:
+            st = self._new_state(t)
+            self._state[accel_class] = st
+        self._close_bins(st, self._bin_of(st, t))
+        st["acc"] += float(chips)
+        if shape_name is not None:
+            counts = self._shapes.setdefault(accel_class, {})
+            counts[shape_name] = counts.get(shape_name, 0) + 1
+
+    def observe_silence(self, now: float) -> None:
+        """Close empty bins up to ``now`` — quiet periods train the
+        seasonal profile too; call once per control pass."""
+        for st in self._state.values():
+            self._close_bins(st, self._bin_of(st, now))
+
+    def modal_shape(self, accel_class: str) -> str | None:
+        counts = self._shapes.get(accel_class)
+        if not counts:
+            return None
+        return max(sorted(counts), key=lambda s: counts[s])
+
+    def predict_bin(self, accel_class: str, h: int) -> float:
+        """Predicted chip arrivals ``h`` bins ahead (h >= 1)."""
+        st = self._state.get(accel_class)
+        if st is None:
+            return 0.0
+        i = (st["bin"] + h - 1) % self.season_bins
+        seasonal: list[float] = st["seasonal"]
+        return max(0.0, st["level"] + h * st["trend"] + seasonal[i])
+
+    def confidence(self, accel_class: str) -> float:
+        st = self._state.get(accel_class)
+        if st is None:
+            return 0.0
+        seasons = st["bins_closed"] / float(self.season_bins)
+        if seasons < 2.0:
+            return 0.0  # needs one full season past the seed season
+        rel_err = st["err"] / max(st["demand"], 1e-9)
+        return max(0.0, 1.0 - rel_err) * min(1.0, (seasons - 1.0) / 2.0)
+
+    def forecasts(self, now: float) -> list[Forecast]:
+        out: list[Forecast] = []
+        for cls in sorted(self._state):
+            st = self._state[cls]
+            self._close_bins(st, self._bin_of(st, now))
+            confidence = self.confidence(cls)
+            if confidence <= 0.0:
+                continue
+            # A "demand bin" must clear half the learned demand level,
+            # not just min_chips: level+trend leak small positives into
+            # quiet bins, and predicting those would fire prewarms into
+            # the valley instead of the next peak.
+            floor = max(float(self.min_chips), 0.5 * st["demand"])
+            for h in range(1, self.season_bins + 1):
+                chips = self.predict_bin(cls, h)
+                if chips < floor:
+                    continue
+                at = (st["origin"]
+                      + (st["bin"] + h - 1) * self.bin_seconds)
+                out.append(Forecast(
+                    accel_class=cls, shape_name=self.modal_shape(cls),
+                    at=at, chips=int(round(chips)),
+                    confidence=confidence, source="holt_winters",
+                    key=f"hw:{cls}:{st['bin'] + h - 1}"))
+                break  # nearest predicted-demand bin per class
+        return out
+
+
+class RecurringGangPredictor:
+    """Period mining over per-(base gang, shape) arrival histories.
+
+    The model behind shape-exact prewarms: a gang whose base name
+    re-arrives with a stable period (inter-arrival cv <= ``max_cv``)
+    predicts its next run at ``last + mean_period`` with confidence
+    ``(1 - cv / max_cv) ... * ramp``.  History is bounded per key.
+    """
+
+    def __init__(self, max_cv: float = 0.25, history: int = 16,
+                 full_at: int = 4) -> None:
+        self.max_cv = max_cv
+        self.full_at = full_at
+        # (base, shape, class) -> bounded arrival times
+        self._arrivals: dict[tuple[str, str, str], deque[float]] = {}
+        self._history = history
+
+    def note(self, gang_name: str, accel_class: str,
+             shape_name: str, t: float) -> None:
+        key = (base_name(gang_name), shape_name, accel_class)
+        times = self._arrivals.setdefault(
+            key, deque(maxlen=self._history))
+        if times and t <= times[-1]:
+            return  # replays/duplicates never corrupt the period
+        times.append(t)
+
+    def ingest_dump(self, dump: dict[str, Any]) -> int:
+        """Bootstrap from a flight-recorder dump (``/debugz`` shape):
+        every completed ``scale_up`` root is one arrival; the trace's
+        ``dispatch`` span names the shape.  Returns arrivals ingested —
+        how a restarted controller recovers its learned periods."""
+        shapes: dict[str, str] = {}
+        for span in dump.get("spans", ()):
+            if span.get("name") == "dispatch" \
+                    and span.get("attrs", {}).get("shape"):
+                shapes.setdefault(span["trace_id"],
+                                  span["attrs"]["shape"])
+        ingested = 0
+        roots = [s for s in dump.get("spans", ())
+                 if s.get("name") == "scale_up"
+                 and s.get("parent_id") is None]
+        roots.sort(key=lambda s: s.get("start", 0.0))
+        for span in roots:
+            gang = span.get("attrs", {}).get("gang", "")
+            shape = shapes.get(span["trace_id"])
+            if not gang or shape is None:
+                continue
+            name = gang.rsplit("/", 1)[-1]
+            from tpu_autoscaler.topology.catalog import shape_by_name
+
+            try:
+                accel = shape_by_name(shape).accelerator_type
+            except KeyError:
+                continue
+            self.note(name, accel, shape, float(span["start"]))
+            ingested += 1
+        return ingested
+
+    def forecasts(self, now: float) -> list[Forecast]:
+        from tpu_autoscaler.topology.catalog import shape_by_name
+
+        out: list[Forecast] = []
+        for (base, shape_name, cls) in sorted(self._arrivals):
+            times = self._arrivals[(base, shape_name, cls)]
+            if len(times) < MIN_OBSERVATIONS:
+                continue
+            seq = list(times)
+            gaps = [b - a for a, b in zip(seq, seq[1:])]
+            mean = sum(gaps) / len(gaps)
+            if mean <= 0.0:
+                continue
+            var = sum((g - mean) ** 2 for g in gaps) / len(gaps)
+            cv = math.sqrt(var) / mean
+            if cv > self.max_cv:
+                continue
+            confidence = ((1.0 - cv / self.max_cv) * 0.5 + 0.5) \
+                * _ramp(len(seq), self.full_at)
+            if confidence <= 0.0:
+                continue
+            at = expected = seq[-1] + mean
+            if at < now:
+                if now - at > 0.5 * mean:
+                    continue  # a missed period breaks the pattern
+                at = now
+            try:
+                chips = shape_by_name(shape_name).chips
+            except KeyError:
+                continue
+            # Key anchored to the EXPECTED run, not the (possibly
+            # rolled) `at`: while an arrival runs late the same
+            # predicted event keeps one identity, so the prewarm gate
+            # never fires twice for it.
+            out.append(Forecast(
+                accel_class=cls, shape_name=shape_name, at=at,
+                chips=chips, confidence=confidence, source="recurring",
+                key=f"recurring:{base}:{shape_name}:"
+                    f"{int(expected // max(1.0, mean / 2))}"))
+        return out
+
+
+def merge_forecasts(streams: Iterable[Iterable[Forecast]]
+                    ) -> list[Forecast]:
+    """Combine forecaster outputs: per (class, shape) keep the single
+    most confident prediction (recurring's shape-exact forecasts do not
+    compete with class-level rate forecasts for a different shape)."""
+    best: dict[tuple[str, str | None], Forecast] = {}
+    for stream in streams:
+        for f in stream:
+            k = (f.accel_class, f.shape_name)
+            cur = best.get(k)
+            if cur is None or f.confidence > cur.confidence:
+                best[k] = f
+    return sorted(best.values(), key=lambda f: (f.at, f.key))

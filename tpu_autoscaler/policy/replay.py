@@ -1,0 +1,325 @@
+"""Offline policy evaluation: replay traffic programs through the real
+control loop and score SLO attainment vs wasted chip-seconds.
+
+The KIS-S-style loop the ROADMAP called for, without the RL: a traffic
+program (a pure function of its seed) drives ``FakeKube`` + the
+production ``Controller`` exactly like ``sim.py``, once reactively and
+once with the PolicyEngine attached, and the scorecard answers the only
+question that matters — *how much provision latency did prediction hide,
+and what did the mispredictions cost?*
+
+Programs (docs/POLICY.md):
+
+- ``recurring`` — the acceptance trace: one gang of a fixed shape
+  re-arrives on a fixed period (nightly-training pattern); later
+  arrivals should find prewarmed supply;
+- ``diurnal``   — sinusoidal arrival intensity over repeating days;
+- ``spike``     — quiet, then an unforecastable burst (the honesty
+  check: the policy must not pretend to predict it);
+- ``coldstart`` — a single first arrival (no history: the policy must
+  stay silent);
+- ``regime``    — a stable period that abruptly changes (confidence
+  must collapse, then recover on the new period).
+
+Run it: ``python -m tpu_autoscaler.policy --program recurring
+--compare`` — or through ``bench.py policy``, which gates the
+north-star claim (prewarmed detect->running <= 0.25x reactive) and
+records BENCH_POLICY.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Any
+
+from tpu_autoscaler.policy.engine import PolicyConfig, PolicyEngine
+from tpu_autoscaler.policy.slo import SloPolicy
+
+#: Realistic-actuation profile, mirrored from bench.py's realistic tier
+#: (slice create/VM boot, per-host registration spread, bind batching).
+PROVISION_DELAY_S = 90.0
+HOST_STAGGER_S = 2.0
+SCHEDULER_PERIOD_S = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    t: float
+    job: str
+    shape: str
+    run_seconds: float  # job runtime once fully Running
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficProgram:
+    kind: str
+    seed: int
+    arrivals: tuple[Arrival, ...]
+    until: float
+    step: float = 5.0
+    # Reactive reclaim pace: short enough that slices do NOT survive
+    # between recurring arrivals on their own — warm supply between
+    # arrivals must be EARNED by prediction, not by a lazy idle clock.
+    idle_threshold: float = 240.0
+
+    def describe(self) -> str:
+        shapes = sorted({a.shape for a in self.arrivals})
+        return (f"{self.kind} seed={self.seed}: {len(self.arrivals)} "
+                f"arrivals of {'/'.join(shapes)} over {self.until:g}s")
+
+
+def make_program(kind: str, seed: int = 0, *, shape: str = "v5e-16",
+                 period: float = 900.0, cycles: int = 6,
+                 run_seconds: float = 240.0) -> TrafficProgram:
+    """Compile one traffic program (pure function of its arguments)."""
+    rng = random.Random(seed)
+    arrivals: list[Arrival] = []
+    if kind == "recurring":
+        for k in range(cycles):
+            arrivals.append(Arrival(
+                t=60.0 + k * period, job=f"nightly-{k}", shape=shape,
+                run_seconds=run_seconds))
+        until = 60.0 + cycles * period
+    elif kind == "diurnal":
+        day = period * 4
+        t = 0.0
+        k = 0
+        while t < day * 2:
+            # Two "days": arrivals cluster in each day's first half.
+            phase = (t % day) / day
+            rate = 0.9 if phase < 0.5 else 0.1
+            if rng.random() < rate:
+                arrivals.append(Arrival(
+                    t=t + rng.uniform(0.0, 30.0), job=f"web-{k}",
+                    shape=shape, run_seconds=run_seconds))
+                k += 1
+            t += period / 2
+        until = day * 2 + period
+    elif kind == "spike":
+        arrivals = [Arrival(t=period * 2 + i * 10.0, job=f"burst-{i}",
+                            shape=shape, run_seconds=run_seconds)
+                    for i in range(3)]
+        until = period * 3
+    elif kind == "coldstart":
+        arrivals = [Arrival(t=60.0, job="first-0", shape=shape,
+                            run_seconds=run_seconds)]
+        until = period
+    elif kind == "regime":
+        t = 60.0
+        for k in range(cycles):
+            arrivals.append(Arrival(t=t, job=f"shift-{k}", shape=shape,
+                                    run_seconds=run_seconds))
+            t += period if k < cycles // 2 else period * 2
+        until = t + period
+    else:
+        raise ValueError(f"unknown traffic program {kind!r}")
+    arrivals.sort(key=lambda a: a.t)
+    return TrafficProgram(kind=kind, seed=seed,
+                          arrivals=tuple(arrivals), until=until)
+
+
+@dataclasses.dataclass
+class ReplayResult:
+    program: str
+    policy_enabled: bool
+    latencies: dict[str, float]          # job -> detect->Running seconds
+    arrival_order: list[str]             # job names, by arrival time
+    slo_attainment: float                # fraction <= target
+    target_seconds: float
+    prewarm_hits: int
+    prewarm_expired: int
+    hidden_provision_seconds: float      # summed over hits
+    wasted_prewarm_chip_seconds: float
+    chip_seconds_provisioned: float
+    pending_at_end: int
+    # Raw counter subset for scorecards/tests (holds, early reclaims,
+    # decisions — the policy's maintenance-side fingerprints).
+    counters: dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @property
+    def max_latency(self) -> float:
+        return max(self.latencies.values(), default=0.0)
+
+    def tail_latencies(self, warmup: int) -> list[float]:
+        """Latencies of arrivals after the first ``warmup`` (the
+        history the forecasters need before they may fire), in
+        ARRIVAL order — job names sort lexicographically ("web-10" <
+        "web-2"), so name order would slice the wrong warmup set."""
+        ordered = [self.latencies[j] for j in self.arrival_order
+                   if j in self.latencies]
+        return ordered[warmup:]
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "program": self.program,
+            "policy": self.policy_enabled,
+            "latencies_s": {k: round(v, 1)
+                            for k, v in sorted(self.latencies.items())},
+            "slo_attainment": round(self.slo_attainment, 3),
+            "target_s": self.target_seconds,
+            "prewarm_hits": self.prewarm_hits,
+            "prewarm_expired": self.prewarm_expired,
+            "hidden_provision_s": round(self.hidden_provision_seconds, 1),
+            "wasted_prewarm_chip_s":
+                round(self.wasted_prewarm_chip_seconds, 1),
+            "chip_seconds_provisioned":
+                round(self.chip_seconds_provisioned, 1),
+            "pending_at_end": self.pending_at_end,
+        }
+
+
+def default_policy_config(program: TrafficProgram) -> PolicyConfig:
+    """Replay-scale policy config: thresholds sized to the program's
+    clock (a 900 s period needs shorter holds than a real day)."""
+    return PolicyConfig(
+        slo=SloPolicy(
+            target_scaleup_seconds=60.0,
+            min_confidence=0.6,
+            provision_estimate_seconds=PROVISION_DELAY_S + 60.0,
+            lead_slack_seconds=45.0,
+            prewarm_hold_seconds=300.0,
+            waste_budget_chip_seconds=600_000.0,
+            idle_floor_seconds=PROVISION_DELAY_S,
+            idle_ceiling_seconds=program.until,
+        ),
+        hw_bin_seconds=120.0,
+        hw_season_bins=12,
+    )
+
+
+def replay(program: TrafficProgram, *, policy: bool,
+           policy_config: PolicyConfig | None = None) -> ReplayResult:
+    """Drive one traffic program through the real control loop."""
+    from tpu_autoscaler.actuators.fake import FakeActuator
+    from tpu_autoscaler.controller import Controller, ControllerConfig
+    from tpu_autoscaler.engine.planner import PoolPolicy
+    from tpu_autoscaler.k8s.fake import FakeKube
+    from tpu_autoscaler.k8s.objects import clear_parse_caches
+    from tpu_autoscaler.sim import gang_pods
+
+    clear_parse_caches()  # hermetic across replays (fresh FakeKube uids)
+    kube = FakeKube()
+    actuator = FakeActuator(kube, provision_delay=PROVISION_DELAY_S,
+                            stagger_seconds=HOST_STAGGER_S)
+    engine = (PolicyEngine(policy_config
+                           or default_policy_config(program))
+              if policy else None)
+    controller = Controller(
+        kube, actuator,
+        ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0),
+            grace_seconds=60.0,
+            idle_threshold_seconds=program.idle_threshold,
+            drain_grace_seconds=30.0,
+            provision_timeout_seconds=600.0),
+        policy_engine=engine)
+
+    target = (engine.config.slo.target_scaleup_seconds if engine
+              else default_policy_config(program)
+              .slo.target_scaleup_seconds)
+    pending_jobs: list[Arrival] = list(program.arrivals)
+    live: dict[str, list[str]] = {}
+    running_since: dict[str, float] = {}
+    started_at: dict[str, float] = {}
+    latencies: dict[str, float] = {}
+
+    t = 0.0
+    horizon = program.until + 600.0
+    while t <= horizon:
+        for a in [a for a in pending_jobs if a.t <= t]:
+            names = []
+            for payload in gang_pods(a.shape, a.job):
+                kube.add_pod(payload)
+                names.append(payload["metadata"]["name"])
+            live[a.job] = names
+            started_at[a.job] = t
+        pending_jobs = [a for a in pending_jobs if a.t > t]
+        # Completions: a job that has been fully Running for its
+        # runtime finishes (pods deleted -> the slice idles).
+        by_arrival = {a.job: a for a in program.arrivals}
+        for job, names in list(live.items()):
+            all_running = all(
+                (kube.get_pod("default", n) or {}).get(
+                    "status", {}).get("phase") == "Running"
+                for n in names)
+            if not all_running:
+                running_since.pop(job, None)
+                continue
+            if job not in latencies:
+                latencies[job] = t - started_at[job]
+            since = running_since.setdefault(job, t)
+            if t - since >= by_arrival[job].run_seconds:
+                for n in names:
+                    kube.delete_pod("default", n)
+                del live[job]
+                running_since.pop(job, None)
+        controller.reconcile_once(now=t)
+        if t % SCHEDULER_PERIOD_S == 0.0:
+            kube.schedule_step()
+        if not pending_jobs and not live \
+                and t > (program.arrivals[-1].t
+                         if program.arrivals else 0.0):
+            break
+        t += program.step
+
+    snap = controller.metrics.snapshot()
+    counters = snap["counters"]
+    summaries = snap["summaries"]
+    met = sum(1 for v in latencies.values() if v <= target)
+    pending = sum(1 for p in kube.list_pods()
+                  if p["status"]["phase"] == "Pending")
+    return ReplayResult(
+        program=program.describe(),
+        policy_enabled=policy,
+        latencies=latencies,
+        arrival_order=[a.job for a in program.arrivals],
+        slo_attainment=(met / len(latencies)) if latencies else 0.0,
+        target_seconds=target,
+        prewarm_hits=int(counters.get("prewarm_hits", 0)),
+        prewarm_expired=int(counters.get("prewarm_expired", 0)),
+        hidden_provision_seconds=float(
+            summaries.get("hidden_provision_seconds", {}).get("sum",
+                                                              0.0)),
+        wasted_prewarm_chip_seconds=float(
+            counters.get("wasted_prewarm_chip_seconds", 0.0)),
+        chip_seconds_provisioned=float(
+            counters.get("chip_seconds_provisioned", 0.0)),
+        pending_at_end=pending,
+        counters={k: float(counters.get(k, 0.0))
+                  for k in ("prewarm_decisions", "prewarm_holds",
+                            "policy_early_reclaims", "policy_errors")},
+    )
+
+
+def compare(program: TrafficProgram,
+            policy_config: PolicyConfig | None = None
+            ) -> dict[str, Any]:
+    """Reactive vs policy-enabled scorecard for one program."""
+    reactive = replay(program, policy=False)
+    predictive = replay(program, policy=True,
+                        policy_config=policy_config)
+    warmup = _warmup_arrivals(program)
+    r_tail = reactive.tail_latencies(warmup)
+    p_tail = predictive.tail_latencies(warmup)
+    return {
+        "program": program.describe(),
+        "warmup_arrivals": warmup,
+        "reactive": reactive.as_dict(),
+        "policy": predictive.as_dict(),
+        "tail_latency_reactive_s":
+            round(max(r_tail), 1) if r_tail else None,
+        "tail_latency_policy_s":
+            round(max(p_tail), 1) if p_tail else None,
+        "tail_ratio": (round(max(p_tail) / max(r_tail), 3)
+                       if r_tail and p_tail and max(r_tail) > 0
+                       else None),
+    }
+
+
+def _warmup_arrivals(program: TrafficProgram) -> int:
+    """Arrivals the forecasters may spend learning before the scored
+    tail begins (MIN_OBSERVATIONS periods for the recurring model)."""
+    from tpu_autoscaler.policy.forecast import MIN_OBSERVATIONS
+
+    return min(MIN_OBSERVATIONS, max(0, len(program.arrivals) - 1))

@@ -1,0 +1,202 @@
+"""SLO/cost model: forecasts in, prewarm + reclaim decisions out.
+
+The policy question is never "will demand arrive?" alone — it is
+"does hiding the provision latency *pay*?".  This module is the pure
+algebra that answers it (docs/POLICY.md):
+
+**Prewarm side.**  A class whose reactive scale-up latency (dominated
+by the measured provision time) already meets its SLO target gains
+nothing from prediction; one that misses it gains the whole provision
+phase.  A forecast therefore converts into a prewarm decision iff
+
+- its confidence clears ``min_confidence`` (low-confidence predictions
+  must emit NO advisory demand — wasted chips are real money),
+- the predicted arrival is within the *firing window*: close enough
+  that provisioning now finishes just-in-time (``provision estimate +
+  lead slack`` before the arrival), not yet past the hold window,
+- the *expected waste* fits the budget: a prewarm that goes unused
+  burns ``chips x hold`` chip-seconds, which happens with probability
+  ``(1 - confidence)`` — the expectation is charged against a rolling
+  wasted-chip-seconds budget BEFORE the prewarm fires, so a string of
+  bad predictions exhausts the budget and the policy self-mutes.
+
+**Scale-down side.**  The fixed idle threshold becomes a tradeoff:
+holding an idle slice costs ``chips x seconds`` chip-seconds; releasing
+it risks paying the full reactive provision latency if demand returns
+first.  With demand forecast inside the hold horizon the threshold
+stretches to cover the predicted arrival; with no forecast in sight it
+shrinks toward ``idle_floor_seconds`` (capacity is returned early —
+the cost term wins when the SLO term is not in play).
+
+Pure computation over injected values only (TAP1xx scope): the engine
+measures, this module decides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+from tpu_autoscaler.policy.forecast import Forecast
+
+
+@dataclasses.dataclass(frozen=True)
+class SloPolicy:
+    """Knobs of the SLO/cost algebra (docs/POLICY.md)."""
+
+    # Target detect->Running latency per accelerator class; classes
+    # absent from the map use the default.  A class whose reactive
+    # latency already meets target is never prewarmed.
+    target_scaleup_seconds: float = 120.0
+    class_targets: Mapping[str, float] = dataclasses.field(
+        default_factory=dict)
+    # Forecasts below this confidence emit NO advisory demand.
+    min_confidence: float = 0.6
+    # Reactive provision estimate used until the controller has
+    # measured provision_latency_seconds itself.
+    provision_estimate_seconds: float = 240.0
+    # Fire a prewarm this long BEFORE provisioning must start, so a
+    # slightly-early arrival still finds the slice Ready.
+    lead_slack_seconds: float = 60.0
+    # How long past the predicted arrival a prewarmed slice is held
+    # before it is declared a misprediction and released to reclaim.
+    prewarm_hold_seconds: float = 600.0
+    # Rolling wasted-chip-seconds budget: expected waste of decided
+    # prewarms plus realized waste of expired ones, per window.
+    waste_budget_chip_seconds: float = 120_000.0
+    waste_window_seconds: float = 3600.0
+    # Scale-down tradeoff bounds (see idle_threshold_for).
+    idle_floor_seconds: float = 120.0
+    idle_ceiling_seconds: float = 7200.0
+    early_reclaim: bool = True
+    # At most this many concurrent un-consumed prewarms fleet-wide.
+    max_concurrent_prewarms: int = 4
+
+    def target_for(self, accel_class: str) -> float:
+        return self.class_targets.get(accel_class,
+                                      self.target_scaleup_seconds)
+
+
+@dataclasses.dataclass(frozen=True)
+class PrewarmDecision:
+    """One approved prewarm: provision ``shape_name`` ahead of the
+    forecast so the arrival finds warm supply."""
+
+    key: str                # the forecast's dedup identity
+    shape_name: str
+    accel_class: str
+    chips: int
+    predicted_at: float
+    confidence: float
+    expected_waste_chip_seconds: float
+    reason: str
+
+
+def fire_at(forecast: Forecast, provision_estimate: float,
+            policy: SloPolicy) -> float:
+    """When provisioning must start for the slice to be Ready on
+    arrival."""
+    return forecast.at - provision_estimate - policy.lead_slack_seconds
+
+
+def expires_at(predicted_at: float, policy: SloPolicy) -> float:
+    """When an unconsumed prewarm becomes a misprediction."""
+    return predicted_at + policy.prewarm_hold_seconds
+
+
+def decide_prewarms(forecasts: list[Forecast], now: float, *,
+                    policy: SloPolicy, provision_estimate: float,
+                    waste_spent_chip_seconds: float,
+                    active_prewarms: int,
+                    active_keys: frozenset[str] = frozenset(),
+                    ) -> tuple[list[PrewarmDecision], list[str]]:
+    """The prewarm gate.  Returns ``(decisions, rejections)`` —
+    rejections are human-readable "why not" lines for the flight
+    recorder, so a silent policy is still an explainable one."""
+    decisions: list[PrewarmDecision] = []
+    rejections: list[str] = []
+    budget = policy.waste_budget_chip_seconds
+    committed = waste_spent_chip_seconds
+    slots = policy.max_concurrent_prewarms - active_prewarms
+    for f in forecasts:
+        if f.key in active_keys:
+            continue  # already being prewarmed (re-emitted forecast)
+        if f.shape_name is None:
+            rejections.append(
+                f"{f.key}: no exact shape to prewarm (class-level "
+                f"forecast; needs a recurring or modal shape)")
+            continue
+        if f.confidence < policy.min_confidence:
+            rejections.append(
+                f"{f.key}: confidence {f.confidence:.2f} < "
+                f"min {policy.min_confidence:g} — no advisory demand")
+            continue
+        if provision_estimate <= policy.target_for(f.accel_class):
+            rejections.append(
+                f"{f.key}: reactive provisioning "
+                f"(~{provision_estimate:g}s) already meets the "
+                f"{policy.target_for(f.accel_class):g}s target")
+            continue
+        start = fire_at(f, provision_estimate, policy)
+        if now < start:
+            rejections.append(
+                f"{f.key}: too early (fires at t={start:g})")
+            continue
+        if now >= expires_at(f.at, policy):
+            rejections.append(f"{f.key}: window already passed")
+            continue
+        hold = (expires_at(f.at, policy)
+                - max(now, fire_at(f, provision_estimate, policy)))
+        expected_waste = f.chips * hold * (1.0 - f.confidence)
+        if committed + expected_waste > budget:
+            rejections.append(
+                f"{f.key}: expected waste {expected_waste:.0f} "
+                f"chip-s would blow the {budget:g} budget "
+                f"({committed:.0f} committed)")
+            continue
+        if slots <= 0:
+            rejections.append(
+                f"{f.key}: max_concurrent_prewarms "
+                f"({policy.max_concurrent_prewarms}) reached")
+            continue
+        slots -= 1
+        committed += expected_waste
+        decisions.append(PrewarmDecision(
+            key=f.key, shape_name=f.shape_name,
+            accel_class=f.accel_class, chips=f.chips,
+            predicted_at=f.at, confidence=f.confidence,
+            expected_waste_chip_seconds=expected_waste,
+            reason=(f"forecast {f.source} predicts {f.chips} chips "
+                    f"({f.shape_name}) at t={f.at:g} with confidence "
+                    f"{f.confidence:.2f}; reactive would miss the "
+                    f"{policy.target_for(f.accel_class):g}s target")))
+    return decisions, rejections
+
+
+def idle_threshold_for(accel_class: str, now: float, *,
+                       policy: SloPolicy, base_threshold: float,
+                       provision_estimate: float,
+                       next_arrival_at: float | None,
+                       confidence: float) -> float:
+    """Effective idle threshold for an idle unit of ``accel_class`` —
+    the fixed-threshold scale-down turned into an SLO/cost tradeoff.
+
+    - Demand forecast confidently inside the ceiling: stretch the
+      threshold so the unit survives until the arrival (the
+      prewarm-hold hint: warm supply beats a fresh provision).
+    - No confident forecast and early reclaim on: shrink toward
+      ``idle_floor_seconds`` — but never below the provision estimate
+      (thrash guard: reclaiming faster than we could re-provision
+      converts every blip into a full scale-up).
+    - Early reclaim off: the configured threshold stands.
+    """
+    if next_arrival_at is not None \
+            and confidence >= policy.min_confidence:
+        wait = (next_arrival_at - now) + policy.lead_slack_seconds
+        if wait <= policy.idle_ceiling_seconds:
+            return min(policy.idle_ceiling_seconds,
+                       max(base_threshold, wait))
+    if not policy.early_reclaim:
+        return base_threshold
+    floor = max(policy.idle_floor_seconds, provision_estimate)
+    return min(base_threshold, floor)
