@@ -22,6 +22,11 @@
 #   8  policy replay tier (bench.py policy: recurring-trace prewarmed
 #      tail latency <= 0.25x reactive, misprediction waste under
 #      budget; BENCH_POLICY.json — ISSUE 8, docs/POLICY.md)
+#   9  serving tier (bench.py serving: metrics-adapter fold <= 1 ms
+#      per pass at 10k replicas, >= 10x over the naive scan, AND the
+#      diurnal+spike millions-of-users replay where signal-driven
+#      scaling must beat pod-pending reactive tail SLO attainment;
+#      BENCH_SERVING.json — ISSUE 9, docs/SERVING.md)
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -31,38 +36,47 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/7] invariant analysis (--format=$fmt)"
+echo "== [1/8] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/7] mypy strict islands"
+echo "== [2/8] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/7] deterministic-schedule race tier"
+echo "== [3/8] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
 
-echo "== [4/7] tracer-overhead gate"
+echo "== [4/8] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [5/7] mega-cluster scale tiers"
+echo "== [5/8] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [6/7] generative chaos corpora (200 seeds mixed + 200 policy)"
+echo "== [6/8] generative chaos corpora (200 mixed + 200 policy + 200 serving)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
 # when the budget blows; both fail this stage with exit 7.  The policy
 # profile re-runs the corpus with the PolicyEngine attached:
-# mispredicted prewarms must never break the same invariants.
+# mispredicted prewarms must never break the same invariants.  The
+# serving profile (ISSUE 9) fuzzes the metrics-adapter path — replica
+# restarts mid-window, counter resets, stale/out-of-order snapshots —
+# asserting rates never go negative and the incremental folds match a
+# from-scratch rebuild, per step.
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 480 || exit 7
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 300 --profile policy || exit 7
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 300 --profile serving || exit 7
 
-echo "== [7/7] policy replay tier"
+echo "== [7/8] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
+
+echo "== [8/8] serving tier (adapter hot path + outcome replay)"
+JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
 echo "CI GATE GREEN"

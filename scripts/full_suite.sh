@@ -56,6 +56,12 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 480
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 300 --profile policy
+# Serving profile (ISSUE 9): fuzz the metrics-adapter path — replica
+# restarts mid-window, counter resets, stale/out-of-order snapshots —
+# with the ServingScaler's advisory demand riding the same invariants;
+# counter resets must never yield negative rates, per step.
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 300 --profile serving
 
 # Policy replay tier (ISSUE 8): the recurring north-star trace must
 # show prewarmed detect->running <= 0.25x the reactive baseline, and a
@@ -63,6 +69,15 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 # under the configured budget; results merge into BENCH_POLICY.json
 # (docs/POLICY.md).
 JAX_PLATFORMS=cpu python bench.py policy
+
+# Serving tier (ISSUE 9): the metrics adapter must fold a
+# 10k-replica fleet's snapshots in <= 1 ms per reconcile pass and
+# beat the naive every-replica scan >= 10x, and on the diurnal+spike
+# millions-of-users replay through the real Controller the
+# signal-driven path must beat pod-pending reactive tail SLO
+# attainment; results merge into BENCH_SERVING.json (docs/SERVING.md
+# "Autoscaler integration").
+JAX_PLATFORMS=cpu python bench.py serving
 
 # Tracer-overhead tier: the observe + actuate benches re-run with the
 # decision tracer attached must stay within 5% of untraced (ISSUE 5 —

@@ -1,0 +1,412 @@
+"""Serving metrics adapter + scaler (ISSUE 9).
+
+Property-style like tests/test_informer_indices.py: after ANY seeded
+sequence of replica adds, removes, restarts (epoch bumps with zeroed
+counters), raw counter resets, and stale/out-of-order deliveries, the
+adapter's incrementally-maintained pool sums must match a from-scratch
+rebuild, and no rate may ever be negative.  Seeded sequences print
+their seed on failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from tpu_autoscaler.serving.adapter import (
+    ServingMetricsAdapter,
+    scan_aggregate,
+)
+from tpu_autoscaler.serving.scaler import (
+    ServingPolicy,
+    ServingScaler,
+)
+from tpu_autoscaler.serving.stats import ServingSnapshot
+
+
+def snap(epoch=1, seq=1, queue=0, active=0, slots=16, kv_used=0,
+         kv_cap=4096, admitted=0, preempted=0, finished=0, slo_ok=0,
+         tokens=0) -> ServingSnapshot:
+    return ServingSnapshot(
+        epoch=epoch, seq=seq, queue_depth=queue, active=active,
+        slots=slots, kv_used=kv_used, kv_capacity=kv_cap,
+        admitted_total=admitted, preempted_total=preempted,
+        finished_total=finished, slo_ok_total=slo_ok,
+        decode_tokens_total=tokens, queue_depth_mean=float(queue),
+        tokens_per_tick=0.0, latency_p50_ticks=0.0,
+        latency_p95_ticks=0.0)
+
+
+class TestAdapterBasics:
+    def test_single_replica_rates(self):
+        a = ServingMetricsAdapter(rate_alpha=1.0)
+        a.ingest("r1", "web", "v5l", "v5e-4",
+                 snap(seq=1, finished=0, tokens=0), now=0.0)
+        a.fold(0.0)
+        a.ingest("r1", "web", "v5l", "v5e-4",
+                 snap(seq=2, queue=3, active=8, finished=50,
+                      slo_ok=45, tokens=5000), now=10.0)
+        a.fold(10.0)
+        sig = a.signals()["web"]
+        assert sig.replicas == 1
+        assert sig.queue_depth == 3 and sig.active == 8
+        assert sig.finished_per_s == pytest.approx(5.0)
+        assert sig.slo_ok_per_s == pytest.approx(4.5)
+        assert sig.tokens_per_s == pytest.approx(500.0)
+        assert sig.slo_attainment == pytest.approx(0.9)
+        assert 0.0 < sig.utilization < 1.0
+
+    def test_stale_and_out_of_order_dropped(self):
+        a = ServingMetricsAdapter()
+        fresh = snap(seq=5, finished=100)
+        old = snap(seq=3, finished=60)
+        assert a.ingest("r1", "web", "v5l", "v5e-4", fresh, now=0.0)
+        assert not a.ingest("r1", "web", "v5l", "v5e-4", old, now=1.0)
+        assert not a.ingest("r1", "web", "v5l", "v5e-4", fresh,
+                            now=2.0)  # duplicate
+
+    def test_restart_epoch_resets_baseline(self):
+        a = ServingMetricsAdapter(rate_alpha=1.0)
+        a.ingest("r1", "web", "v5l", "v5e-4",
+                 snap(epoch=1, seq=100, finished=1000), now=0.0)
+        a.fold(0.0)
+        # Restart: fresh epoch, counters from zero.  The new totals
+        # are the delta; rates must be >= 0, never negative.
+        a.ingest("r1", "web", "v5l", "v5e-4",
+                 snap(epoch=2, seq=1, finished=30), now=10.0)
+        a.fold(10.0)
+        sig = a.signals()["web"]
+        assert sig.finished_per_s == pytest.approx(3.0)
+
+    def test_pre_restart_snapshot_after_restart_is_stale(self):
+        """Epochs are increasing: an OLD-epoch snapshot re-delivered
+        after a restart must drop as stale, not re-ingest the dead
+        incarnation's lifetime totals as one giant delta."""
+        a = ServingMetricsAdapter(rate_alpha=1.0)
+        a.ingest("r1", "web", "v5l", "v5e-4",
+                 snap(epoch=7, seq=500, finished=10_000), now=0.0)
+        a.fold(0.0)
+        a.ingest("r1", "web", "v5l", "v5e-4",
+                 snap(epoch=8, seq=1, finished=10), now=10.0)
+        # The transport re-delivers a queued epoch-7 snapshot.
+        assert not a.ingest("r1", "web", "v5l", "v5e-4",
+                            snap(epoch=7, seq=499, finished=9_990),
+                            now=11.0)
+        a.fold(11.0)
+        sig = a.signals()["web"]
+        assert sig.finished_per_s == pytest.approx(1.0)  # 10 / 10 s
+
+    def test_recorder_epochs_survive_process_restart_semantics(self):
+        """The recorder's epoch base is per-process-start, so a fresh
+        incarnation's epoch exceeds every pre-restart epoch (the
+        adapter contract the previous test leans on)."""
+        from tpu_autoscaler.serving import stats as stats_mod
+
+        old = stats_mod.ServingStatsRecorder(slots=1).epoch
+        assert old > stats_mod._EPOCH_BASE
+        # A "new process" = a fresh (later) base with a reset counter.
+        assert stats_mod._EPOCH_BASE + 1 <= old
+        later_base = (stats_mod._EPOCH_BASE
+                      + (1 << 12))  # >= 1 ms later restart
+        assert later_base + 1 > old
+
+    def test_raw_counter_reset_clamps(self):
+        """Totals going BACKWARDS with an unchanged epoch (buggy
+        exporter) clamp to the new total — never a negative rate."""
+        a = ServingMetricsAdapter(rate_alpha=1.0)
+        a.ingest("r1", "web", "v5l", "v5e-4",
+                 snap(seq=1, finished=500), now=0.0)
+        a.fold(0.0)
+        a.ingest("r1", "web", "v5l", "v5e-4",
+                 snap(seq=2, finished=40), now=10.0)
+        a.fold(10.0)
+        sig = a.signals()["web"]
+        assert sig.finished_per_s == pytest.approx(4.0)
+        assert (a._pool_sums >= -1e-9).all()
+
+    def test_remove_subtracts_contribution(self):
+        a = ServingMetricsAdapter()
+        for i in range(3):
+            a.ingest(f"r{i}", "web", "v5l", "v5e-4",
+                     snap(seq=1, queue=2), now=0.0)
+        a.fold(0.0)
+        assert a.signals()["web"].queue_depth == 6
+        a.remove("r1")
+        assert a.signals()["web"].queue_depth == 4
+        assert a.signals()["web"].replicas == 2
+
+
+class TestAdapterProperty:
+    """Seeded churn vs from-scratch rebuild (the informer-indices
+    property shape)."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_incremental_matches_rebuild(self, seed):
+        rng = random.Random(seed)
+        a = ServingMetricsAdapter(capacity=8)
+        pools = ["web", "api", "batch"]
+        state: dict[str, dict] = {}
+        now = 0.0
+        for step in range(300):
+            now += rng.uniform(0.5, 5.0)
+            op = rng.random()
+            if op < 0.15 or not state:
+                rid = f"r{rng.randrange(40)}"
+                st = state.setdefault(rid, {
+                    "pool": rng.choice(pools), "epoch": rng.randrange(
+                        1, 1000000), "seq": 0, "fin": 0, "tok": 0})
+            else:
+                rid = rng.choice(sorted(state))
+                st = state[rid]
+            if op > 0.92:
+                state.pop(rid)
+                a.remove(rid)
+                continue
+            if op > 0.85:
+                # Restart: new epoch, counters to zero.
+                st["epoch"] += 1000000
+                st["seq"] = 0
+                st["fin"] = 0
+                st["tok"] = 0
+            if op > 0.80:
+                # Raw reset, same epoch.
+                st["fin"] = max(0, st["fin"] - rng.randrange(50))
+            st["seq"] += rng.choice([0, 1, 1, 2])  # 0 = stale resend
+            st["fin"] += rng.randrange(20)
+            st["tok"] = st["fin"] * 100
+            a.ingest(rid, st["pool"], "v5l", "v5e-4",
+                     snap(epoch=st["epoch"], seq=st["seq"],
+                          queue=rng.randrange(10),
+                          active=rng.randrange(16),
+                          finished=st["fin"],
+                          slo_ok=int(st["fin"] * 0.9),
+                          tokens=st["tok"]),
+                     now=now)
+            if rng.random() < 0.5:
+                a.fold(now)
+                sums = a._pool_sums
+                assert np.isfinite(sums).all(), f"seed {seed}"
+                assert (sums >= -1e-6).all(), \
+                    f"seed {seed}: negative aggregate {sums.min()}"
+        a.fold(now)
+        scale = max(1.0, float(np.abs(a._pool_sums).max())) \
+            if a._pool_sums.size else 1.0
+        assert a.drift() <= 1e-6 * scale, f"seed {seed}"
+        # Replica census per pool matches the live set.
+        by_pool: dict[str, int] = {}
+        for st in state.values():
+            by_pool[st["pool"]] = by_pool.get(st["pool"], 0) + 1
+        sigs = a.signals()
+        for pool, n in by_pool.items():
+            assert sigs[pool].replicas == n, f"seed {seed}"
+
+    def test_scan_baseline_agrees_on_gauges(self):
+        """The bench's naive scan and the fold agree on the gauge
+        sums (the rate paths differ by smoothing, by design)."""
+        a = ServingMetricsAdapter()
+        rows = []
+        for i in range(20):
+            s = snap(seq=2, queue=i % 5, active=i % 7,
+                     finished=100 + i, tokens=(100 + i) * 10)
+            a.ingest(f"r{i}", "web", "v5l", "v5e-4", s, now=5.0)
+            rows.append((f"r{i}", "web", "v5l", "v5e-4", s,
+                         float(s.decode_tokens_total), 5.0))
+        a.fold(5.0)
+        scanned = scan_aggregate(rows)["web"]
+        sig = a.signals()["web"]
+        assert scanned["queue_depth"] == sig.queue_depth
+        assert scanned["active"] == sig.active
+        assert scanned["replicas"] == sig.replicas
+
+
+def _statuses(entries):
+    """Minimal actuator-status stand-ins (gang_key + state + id)."""
+    out = []
+    for key, state, pid in entries:
+        req = dataclasses.make_dataclass("R", ["gang_key"])(key)
+        out.append(dataclasses.make_dataclass(
+            "S", ["request", "state", "id"])(req, state, pid))
+    return out
+
+
+class TestServingScaler:
+    def _loaded_adapter(self, replicas=2, queue=40, active=16):
+        a = ServingMetricsAdapter(rate_alpha=1.0)
+        for i in range(replicas):
+            a.ingest(f"r{i}", "web", "v5l", "v5e-4",
+                     snap(seq=2, queue=queue // replicas,
+                          active=active // replicas,
+                          finished=100, slo_ok=100, tokens=1000),
+                     now=0.0)
+        return a
+
+    def test_deficit_emits_advisory_gangs(self):
+        scaler = ServingScaler(
+            self._loaded_adapter(),
+            ServingPolicy(forecast=False, max_replicas=8))
+        advice = scaler.advise([], now=10.0)
+        # Backlog 56 over 2 replicas of 16 slots at 0.75 target ->
+        # desired 5, deficit 3.
+        assert advice.desired["web"] == 5
+        assert len(advice.advisory) == 3
+        keys = {g.key for g, _ in advice.advisory}
+        assert all(k[0] == "serving" for k in keys)
+        # Re-advising does NOT mint more records (pending counted).
+        advice2 = scaler.advise([], now=15.0)
+        assert len(advice2.advisory) == 3
+        assert {g.key for g, _ in advice2.advisory} == keys
+
+    def test_active_records_stop_emitting_but_count(self):
+        scaler = ServingScaler(
+            self._loaded_adapter(),
+            ServingPolicy(forecast=False, max_replicas=8,
+                          replica_grace_seconds=60.0))
+        advice = scaler.advise([], now=0.0)
+        key = advice.advisory[0][0].key
+        statuses = _statuses([(key, "ACTIVE", "prov-1")])
+        advice2 = scaler.advise(statuses, now=5.0)
+        emitted = {g.key for g, _ in advice2.advisory}
+        assert key not in emitted          # ACTIVE: stop emitting
+        assert len(advice2.advisory) == 2  # others still pending
+        # ...and no replacement was minted for it (still counted).
+        assert len(scaler._scaleouts) == 3
+
+    def test_replica_join_retires_records(self):
+        adapter = self._loaded_adapter(replicas=2)
+        scaler = ServingScaler(
+            adapter, ServingPolicy(forecast=False, max_replicas=8))
+        scaler.advise([], now=0.0)
+        assert len(scaler._scaleouts) == 3
+        # A third replica joins the census.
+        adapter.ingest("r-new", "web", "v5l", "v5e-4",
+                       snap(seq=2, queue=0, active=0), now=5.0)
+        scaler.advise([], now=10.0)
+        assert len(scaler._scaleouts) == 2
+
+    def test_scale_in_deadband_and_hold(self):
+        a = ServingMetricsAdapter(rate_alpha=1.0)
+        for i in range(10):
+            a.ingest(f"r{i}", "web", "v5l", "v5e-4",
+                     snap(seq=2, queue=0, active=1, finished=10,
+                          slo_ok=10), now=0.0)
+        pol = ServingPolicy(forecast=False, max_replicas=16,
+                            scalein_hold_seconds=60.0,
+                            scalein_step_div=4)
+        scaler = ServingScaler(a, pol)
+        first = scaler.advise([], now=0.0)
+        assert first.scale_in == {}        # hold not elapsed
+        second = scaler.advise([], now=61.0)
+        # Surplus capped at replicas // 4.
+        assert second.scale_in == {"web": 2}
+
+    def test_scale_from_zero_honors_min_replicas(self):
+        """A pool whose census drops to zero vanishes from signals()
+        but must still scale back out to min_replicas."""
+        a = ServingMetricsAdapter()
+        a.ingest("r0", "web", "v5l", "v5e-4", snap(seq=1), now=0.0)
+        a.fold(0.0)
+        scaler = ServingScaler(
+            a, ServingPolicy(forecast=False, min_replicas=2,
+                             max_replicas=8))
+        scaler.advise([], now=0.0)
+        a.remove("r0")  # the last replica dies
+        advice = scaler.advise([], now=10.0)
+        assert "web" not in a.signals()
+        assert advice.desired["web"] == 2
+        assert len(advice.advisory) == 2
+        # ...and the pool's scale-in hysteresis state was cleared.
+        assert "web" not in scaler._surplus_since
+
+    def test_forecast_series_is_per_pool(self):
+        """Two pools on one accelerator class keep independent demand
+        series (one interleaved series would poison the seasonal
+        model and cross-assign forecasts)."""
+        a = ServingMetricsAdapter(rate_alpha=1.0)
+        a.ingest("r0", "web", "v5l", "v5e-4",
+                 snap(seq=2, active=8), now=0.0)
+        a.ingest("r1", "api", "v5l", "v5e-4",
+                 snap(seq=2, active=2), now=0.0)
+        a.fold(0.0)
+        scaler = ServingScaler(
+            a, ServingPolicy(max_replicas=8, sample_seconds=1.0))
+        scaler.advise([], now=0.0)
+        scaler.advise([], now=5.0)
+        assert set(scaler._hw._state) == {"web", "api"}
+
+    def test_crash_only_wiring(self):
+        """A broken adapter degrades the pass to reactive (the
+        Controller hook swallows + counts)."""
+        from tpu_autoscaler.actuators.fake import FakeActuator
+        from tpu_autoscaler.controller import (
+            Controller,
+            ControllerConfig,
+        )
+        from tpu_autoscaler.engine.planner import PoolPolicy
+        from tpu_autoscaler.k8s.fake import FakeKube
+
+        class Boom:
+            def fold(self, now):
+                raise RuntimeError("fuzz")
+
+            _metrics = None
+
+            def signals(self):
+                raise RuntimeError("fuzz")
+
+            @property
+            def replicas(self):
+                return 0
+
+        kube = FakeKube()
+        controller = Controller(
+            kube, FakeActuator(kube),
+            ControllerConfig(policy=PoolPolicy(spare_nodes=0)),
+            serving_scaler=ServingScaler(Boom(), ServingPolicy()))
+        controller.reconcile_once(now=0.0)  # must not raise
+        snap_ = controller.metrics.snapshot()
+        assert snap_["counters"]["serving_errors"] == 1
+        assert controller.serving_advice is None
+
+
+class TestSharedTraffic:
+    """The dedupe satellite: one day-shape for gang-level programs and
+    request-level replay."""
+
+    def test_gang_diurnal_uses_shared_day_shape(self):
+        from tpu_autoscaler.policy import traffic
+        from tpu_autoscaler.policy.replay import make_program
+
+        prog = make_program("diurnal", seed=4)
+        # Re-derive with the shared sampler: identical arrivals.
+        rng = random.Random(4)
+        want = traffic.diurnal_arrival_times(rng, 3600.0, 450.0,
+                                             days=2)
+        assert [a.t for a in prog.arrivals] == sorted(want)
+
+    def test_spike_schedule_shared(self):
+        from tpu_autoscaler.policy import traffic
+        from tpu_autoscaler.policy.replay import make_program
+
+        prog = make_program("spike", seed=9, period=600.0)
+        assert [a.t for a in prog.arrivals] \
+            == traffic.spike_times(1200.0)
+
+    def test_request_rate_day_shape(self):
+        from tpu_autoscaler.policy import traffic
+
+        day = 1000.0
+        peak = traffic.request_rate(day * 0.25, day, 100.0, 10.0)
+        trough = traffic.request_rate(day * 0.75, day, 100.0, 10.0)
+        assert peak == 100.0 and trough == 10.0
+        # Ramp shoulders interpolate.
+        mid = traffic.request_rate(day * 0.5, day, 100.0, 10.0,
+                                   ramp_fraction=0.1)
+        assert 10.0 < mid < 100.0
+        # Spikes multiply inside their window only.
+        spiked = traffic.request_rate(
+            day * 0.75, day, 100.0, 10.0,
+            spikes=((day * 0.7, day * 0.1, 3.0),))
+        assert spiked == 30.0

@@ -1,0 +1,103 @@
+"""Serving replay + chaos serving profile (ISSUE 9).
+
+Small-scale smokes of the ``bench.py serving`` evaluation loop: both
+scaling modes drive the REAL Controller, the drain contract loses no
+request, and the signal mode's scaler actually exercises the advisory
+path.  The full-scale gates (10k-replica adapter hot path, the
+diurnal+spike outcome ratio) live in the bench, not here.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_autoscaler.serving.replay import (
+    ServingReplayConfig,
+    compare,
+    replay,
+)
+
+#: One compressed mini-trace: small fleet, two days, cheap enough for
+#: tier-1 (a replay is a few hundred reconcile passes).
+MINI = ServingReplayConfig(
+    seed=0, day_seconds=600.0, days=2, step=5.0,
+    peak_rps=80.0, trough_rps=16.0, spike_duration=60.0,
+    baseline_replicas=3, max_replicas=24)
+
+
+class TestServingReplay:
+    @pytest.mark.parametrize("mode", ["reactive", "signal"])
+    def test_no_request_lost(self, mode):
+        """The drain contract end to end: every arrived request is
+        served even as replicas drain and slices are reclaimed."""
+        result = replay(MINI, mode=mode)
+        assert result.arrived > 1000
+        assert result.unserved == 0
+        assert result.served == result.arrived
+        assert 0.0 < result.attainment <= 1.0
+
+    def test_signal_mode_exercises_advisory_path(self):
+        result = replay(MINI, mode="signal")
+        assert result.scaleouts > 0
+        assert result.provisions > 0
+        assert result.peak_replicas > MINI.baseline_replicas
+
+    def test_reactive_mode_uses_pending_pods_only(self):
+        result = replay(MINI, mode="reactive")
+        assert result.scaleouts == 0          # no scaler attached
+        assert result.provisions > 0          # pod-pending provisions
+
+    def test_fleet_scales_with_the_day(self):
+        seen = []
+        replay(MINI, mode="signal",
+               probe=lambda t, n, b, s: seen.append((t, n)))
+        peak_fleet = max(n for _t, n in seen)
+        trough_fleet = min(
+            n for t, n in seen if MINI.day_seconds * 0.6 < t
+            < MINI.day_seconds * 0.9)
+        assert peak_fleet > trough_fleet
+
+    def test_compare_scorecard_shape(self):
+        card = compare(MINI)
+        assert card["trace"]["modeled_users"] > 0
+        assert set(card) >= {"reactive", "signal", "miss_rate_ratio",
+                             "tail_attainment_reactive",
+                             "tail_attainment_signal"}
+
+
+class TestServingChaosProfile:
+    def test_profile_generates_serving_events(self):
+        from tpu_autoscaler.chaos.scenario import generate
+
+        programs = [generate(s, profile="serving") for s in range(12)]
+        assert all(p.serving for p in programs)
+        kinds = {e.kind for p in programs for e in p.events}
+        assert "replica_restart" in kinds
+        assert kinds & {"counter_reset", "stale_burst",
+                        "replica_churn"}
+
+    def test_seed_runs_green(self):
+        from tpu_autoscaler.chaos.engine import run_scenario
+
+        result = run_scenario(3, profile="serving")
+        assert result.ok, result.violations
+        assert result.converged_at is not None
+
+    def test_counter_reset_invariant_is_armed(self):
+        """Sabotage the adapter mid-run: the serving fuzz monitor must
+        catch a negative aggregate (proves the invariant has teeth)."""
+        from tpu_autoscaler.chaos.engine import _Run
+        from tpu_autoscaler.chaos.scenario import generate
+
+        program = generate(3, profile="serving")
+        run = _Run(program)
+        fuzz = run.serving_fuzz
+        assert fuzz is not None
+        fuzz.step(0.0)
+        run.controller.serving_scaler.adapter.fold(0.0)
+        # Corrupt a raw rate sum the way a signed-delta bug would.
+        adapter = run.controller.serving_scaler.adapter
+        adapter._pool_sums[:, 5:] = -100.0
+        fuzz.check(0.0)
+        assert any(v.invariant == "serving-nonnegative-rates"
+                   for v in run.monitor.violations)

@@ -1,0 +1,187 @@
+"""Serving signal export (serving/stats.py + the batcher family).
+
+The recorder's contract: every write is O(1) host work (ints + ring
+rows), snapshots are fixed-cost regardless of uptime, the (epoch, seq)
+pair orders deliveries, and the engines export real scheduling facts —
+admissions, preemptions, completions with latency, KV occupancy —
+without touching a device array on the tick path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpu_autoscaler.serving.stats import (
+    ServingSnapshot,
+    ServingStatsRecorder,
+)
+
+
+class TestRecorder:
+    def test_counters_and_rings(self):
+        rec = ServingStatsRecorder(slots=4, slo_ticks=3)
+        rec.note_admit(2)
+        rec.note_finish(2)   # inside the target
+        rec.note_finish(7)   # outside
+        for i in range(5):
+            rec.end_tick(queue_depth=i, active=2, kv_used=10,
+                         kv_capacity=100, decode_tokens_total=4 * i)
+        snap = rec.snapshot()
+        assert snap.admitted_total == 2
+        assert snap.finished_total == 2 and snap.slo_ok_total == 1
+        assert snap.slo_attainment == 0.5
+        assert snap.seq == 5 and snap.queue_depth == 4
+        assert snap.kv_occupancy == pytest.approx(0.1)
+        # Per-tick token deltas: totals 0,4,8,12,16 -> 0,4,4,4,4.
+        assert snap.tokens_per_tick == pytest.approx(16 / 5)
+        assert snap.latency_p50_ticks > 0
+
+    def test_no_target_means_everything_attains(self):
+        rec = ServingStatsRecorder(slots=1)
+        rec.note_finish(10_000)
+        assert rec.snapshot().slo_attainment == 1.0
+
+    def test_rings_are_fixed_width(self):
+        rec = ServingStatsRecorder(slots=1, tick_window=8,
+                                   latency_window=4)
+        for i in range(100):
+            rec.note_finish(i)
+            rec.end_tick(queue_depth=1, active=1, kv_used=0,
+                         kv_capacity=0, decode_tokens_total=i)
+        snap = rec.snapshot()
+        assert rec._q_ring.shape == (8,)
+        assert rec._lat_ring.shape == (4,)
+        assert snap.finished_total == 100  # counters are unbounded
+        # Percentiles come from the last 4 completions only.
+        assert snap.latency_p50_ticks >= 96
+
+    def test_epochs_are_distinct_across_restarts(self):
+        a = ServingStatsRecorder(slots=1)
+        b = ServingStatsRecorder(slots=1)
+        assert a.epoch != b.epoch
+
+    def test_snapshot_is_plain_data(self):
+        rec = ServingStatsRecorder(slots=2)
+        rec.end_tick(queue_depth=0, active=0, kv_used=0, kv_capacity=0,
+                     decode_tokens_total=0)
+        d = rec.snapshot().as_dict()
+        assert isinstance(d["slo_attainment"], float)
+        assert set(d) >= {"epoch", "seq", "queue_depth", "active",
+                          "finished_total", "decode_tokens_total"}
+
+
+class TestEngineExport:
+    """The batcher family exports real scheduling facts."""
+
+    @pytest.fixture(scope="class")
+    def engine_setup(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from tpu_autoscaler.workloads.model import (
+            ModelConfig,
+            init_params,
+        )
+
+        cfg = ModelConfig(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                          d_ff=32, seq_len=32, dtype=jnp.float32)
+        return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+    def test_continuous_batcher_stats(self, engine_setup):
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        params, cfg = engine_setup
+        eng = ContinuousBatcher(params, cfg, slots=2, max_len=32,
+                                chunk=8, slo_ticks=100)
+        rng = np.random.default_rng(0)
+        for n in (3, 5, 2):
+            eng.submit(Request(
+                prompt=rng.integers(0, cfg.vocab, (n,)).astype(
+                    np.int32),
+                max_new_tokens=2))
+        eng.run()
+        snap = eng.stats()
+        assert isinstance(snap, ServingSnapshot)
+        assert snap.admitted_total == 3
+        assert snap.finished_total == 3
+        assert snap.slo_ok_total == 3
+        assert snap.seq == eng.ticks
+        assert snap.decode_tokens_total == eng.decode_tokens
+        assert snap.queue_depth == 0 and snap.active == 0
+        assert snap.kv_capacity == 2 * 32
+        # Freed slots stop counting: an idle engine reports zero live
+        # KV, not its historical peak.
+        assert snap.kv_used == 0
+
+    def test_request_latency_ticks_recorded(self, engine_setup):
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        params, cfg = engine_setup
+        eng = ContinuousBatcher(params, cfg, slots=1, max_len=32,
+                                chunk=8)
+        req = Request(prompt=np.arange(3, dtype=np.int32),
+                      max_new_tokens=2)
+        eng.submit(req)
+        eng.run()
+        assert req.submitted_tick == 0
+        assert req.finished_tick is not None
+        assert req.finished_tick >= 1
+
+    def test_paged_batcher_exports_pool_occupancy(self, engine_setup):
+        from tpu_autoscaler.workloads.paged import (
+            PagedBatcher,
+            Request,
+        )
+
+        params, cfg = engine_setup
+        eng = PagedBatcher(params, cfg, slots=2, max_len=32,
+                           block_size=8, num_blocks=4, chunk=8)
+        rng = np.random.default_rng(1)
+        for n in (9, 9, 9):
+            eng.submit(Request(
+                prompt=rng.integers(0, cfg.vocab, (n,)).astype(
+                    np.int32),
+                max_new_tokens=4))
+        eng.run()
+        snap = eng.stats()
+        assert snap.finished_total == 3
+        assert snap.kv_capacity == 4 * 8
+        # The tiny pool forced at least one preemption... or not —
+        # either way the counter must equal the engine's own.
+        assert snap.preempted_total == eng.preemptions
+
+    def test_final_stats_payload(self, engine_setup):
+        """serve.py's drain receipt: unserved counts + per-request
+        latencies, machine readable (ISSUE 9 satellite)."""
+        from tpu_autoscaler.workloads.serve import final_stats_payload
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        params, cfg = engine_setup
+        eng = ContinuousBatcher(params, cfg, slots=2, max_len=32,
+                                chunk=8)
+        rng = np.random.default_rng(2)
+        reqs = [Request(prompt=rng.integers(0, cfg.vocab, (4,)).astype(
+                    np.int32), max_new_tokens=2) for _ in range(3)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        out = final_stats_payload(reqs, eng, 1.25)
+        assert out["event"] == "final_stats"
+        assert out["served"] == 3 and out["unserved"] == 0
+        assert len(out["request_latency_ticks"]) == 3
+        assert all(isinstance(v, int)
+                   for v in out["request_latency_ticks"])
+        assert out["stats"]["finished_total"] == 3
+        import json
+
+        json.dumps(out)  # must be JSON-serializable as-is

@@ -105,6 +105,172 @@ class ChaosResult:
                 f"{self.wall_seconds:.2f}s wall{tail}")
 
 
+#: ISSUE 9: serving scale-out records may hold adopted slices through
+#: their TTL after traffic dies; widen the stranded-chips reclaim
+#: window by exactly that bound when the serving profile is on.
+SERVING_RECLAIM_ALLOWANCE = 240.0
+
+
+class _ServingFuzz:
+    """Fuzzed serving fleet feeding the metrics adapter (ISSUE 9).
+
+    A handful of synthetic replicas advance real
+    :class:`ServingStatsRecorder` rings every step under a seeded load
+    profile; the fuzz then delivers their snapshots ADVERSARIALLY —
+    restarts mid-window (fresh epoch, counters from zero), raw counter
+    resets with an unchanged epoch (buggy exporter), stale and
+    out-of-order re-deliveries, replica add/remove churn — and asserts
+    the adapter's step invariants after every reconcile pass:
+
+    - every pool signal's rates/gauges are finite and >= 0 (counter
+      resets must NEVER yield a negative rate);
+    - the incremental pool sums match a from-scratch rebuild
+      (CapacityView-consistency, tests/test_serving_adapter.py's
+      property at corpus scale).
+
+    Load ramps to zero before the quiet tail so the ServingScaler's
+    advisory demand drains and convergence stays decidable.
+    """
+
+    def __init__(self, program: ScenarioProgram, adapter,
+                 monitor: InvariantMonitor) -> None:
+        import random
+
+        from tpu_autoscaler.serving.stats import ServingStatsRecorder
+
+        self._recorder_cls = ServingStatsRecorder
+        self.adapter = adapter
+        self.monitor = monitor
+        self.rng = random.Random(program.seed ^ 0x5E41)
+        self.program = program
+        self.pool = "chaos-web"
+        self.shape = "v5e-4"
+        self.accel = "tpu-v5-lite-device"
+        self._replicas: dict[str, object] = {}
+        self._last_snap: dict[str, object] = {}
+        self._seq = 0
+        for _ in range(self.rng.randint(3, 6)):
+            self._add_replica()
+        #: Faults scheduled by the scenario, applied at their step.
+        self._stale_budget = 0
+        self._reset_next = False
+        self._restart_next = False
+
+    def _add_replica(self) -> None:
+        self._seq += 1
+        name = f"fuzz-rep-{self._seq}"
+        self._replicas[name] = self._recorder_cls(slots=16, slo_ticks=4)
+
+    def apply_event(self, event) -> None:
+        kind = event.kind
+        if kind == "replica_restart":
+            self._restart_next = True
+        elif kind == "counter_reset":
+            self._reset_next = True
+        elif kind == "stale_burst":
+            self._stale_budget += event.args["count"]
+        elif kind == "replica_churn":
+            for _ in range(event.args.get("add", 0)):
+                self._add_replica()
+            for _ in range(event.args.get("remove", 0)):
+                if len(self._replicas) > 1:
+                    name = self.rng.choice(sorted(self._replicas))
+                    del self._replicas[name]
+                    self._last_snap.pop(name, None)
+                    self.adapter.remove(name)
+        else:
+            raise ValueError(f"unknown serving event kind {kind!r}")
+
+    def _load(self, t: float) -> int:
+        """Queued requests at t: a noisy pulse inside the driven
+        phase, hard zero before the quiet tail."""
+        from tpu_autoscaler.chaos.scenario import QUIET_TAIL
+
+        if t >= self.program.until - QUIET_TAIL:
+            return 0
+        return self.rng.randint(0, 40)
+
+    def step(self, t: float) -> None:
+        """Advance every replica a few ticks and deliver snapshots,
+        sometimes adversarially."""
+        rng = self.rng
+        if self._restart_next:
+            self._restart_next = False
+            name = rng.choice(sorted(self._replicas))
+            # Mid-window restart: fresh recorder, fresh epoch — the
+            # adapter must treat the zeroed counters as a reset.
+            self._replicas[name] = self._recorder_cls(slots=16,
+                                                      slo_ticks=4)
+        for name in sorted(self._replicas):
+            rec = self._replicas[name]
+            load = self._load(t)
+            for _ in range(rng.randint(1, 3)):
+                done = rng.randint(0, min(8, load + 4))
+                for _ in range(done):
+                    rec.note_finish(rng.randint(0, 8))
+                rec.note_admit(done)
+                rec.end_tick(
+                    queue_depth=max(0, load - 16),
+                    active=min(16, load),
+                    kv_used=min(16, load) * 100, kv_capacity=4096,
+                    decode_tokens_total=rec.finished_total * 100)
+            if self._reset_next:
+                self._reset_next = False
+                # Raw counter reset, SAME epoch: a buggy exporter's
+                # totals went backwards.  Rates must clamp, never go
+                # negative.
+                rec.finished_total = max(0, rec.finished_total - 200)
+                rec.slo_ok_total = min(rec.slo_ok_total,
+                                       rec.finished_total)
+                rec._decode_tokens_total = rec.finished_total * 100
+            snap = rec.snapshot()
+            if self._stale_budget > 0 and name in self._last_snap \
+                    and rng.random() < 0.5:
+                # Out-of-order / duplicate delivery: the OLD snapshot
+                # arrives after the new one.
+                self._stale_budget -= 1
+                self.adapter.ingest(name, self.pool, self.accel,
+                                    self.shape, snap, now=t)
+                self.adapter.ingest(name, self.pool, self.accel,
+                                    self.shape, self._last_snap[name],
+                                    now=t)
+            else:
+                self.adapter.ingest(name, self.pool, self.accel,
+                                    self.shape, snap, now=t)
+            self._last_snap[name] = snap
+
+    def check(self, t: float) -> None:
+        """Step invariants over the folded signals (the reconcile pass
+        the controller just ran did the fold)."""
+        import numpy as np
+
+        # RAW pool sums, not the clamped PoolSignal view (the export
+        # clamps defensively; the invariant is that the fold never
+        # NEEDED the clamp — beyond bounded float drift).
+        sums = self.adapter._pool_sums
+        if not np.isfinite(sums).all():
+            self.monitor._fail(
+                t, "serving-nonnegative-rates",
+                "non-finite pool aggregate after resets/stale "
+                "deliveries")
+        elif sums.size and float(sums.min()) < -1e-6:
+            self.monitor._fail(
+                t, "serving-nonnegative-rates",
+                f"negative pool aggregate {float(sums.min())} — a "
+                f"counter reset leaked a negative rate")
+        # Raw incremental sums vs a from-scratch rebuild: bounded
+        # float drift only (RELATIVE to the aggregate magnitude —
+        # add/subtract maintenance accumulates ~1 ulp per fold).
+        drift = self.adapter.drift()
+        scale = max(1.0, float(np.abs(sums).max())) if sums.size \
+            else 1.0
+        if drift > 1e-6 * scale:
+            self.monitor._fail(
+                t, "serving-fold-consistency",
+                f"incremental pool sums drifted {drift} from rebuild "
+                f"(scale {scale:g})")
+
+
 #: Chaos-scale PolicyEngine hold/threshold bounds (ISSUE 8): the
 #: reclaim window the no-stranded-chips invariant allows is widened by
 #: exactly this allowance when the policy is on — a prewarm may sit
@@ -133,6 +299,27 @@ def _policy_engine(program: ScenarioProgram):
         hw_bin_seconds=30.0, hw_season_bins=8))
 
 
+def _serving_scaler(program: ScenarioProgram):
+    """Chaos-scale ServingScaler over a fresh adapter: small fleet
+    cap, short record TTLs (a scenario is minutes, not hours),
+    forecasting off — the corpus fuzzes the ADAPTER path and the
+    scale-out lifecycle, not the seasonal model."""
+    if not program.serving:
+        return None
+    from tpu_autoscaler.serving.adapter import ServingMetricsAdapter
+    from tpu_autoscaler.serving.scaler import (
+        ServingPolicy,
+        ServingScaler,
+    )
+
+    return ServingScaler(
+        ServingMetricsAdapter(),
+        ServingPolicy(
+            max_replicas=4, min_replicas=0,
+            scaleout_hold_seconds=60.0, replica_grace_seconds=30.0,
+            scalein_hold_seconds=60.0, forecast=False))
+
+
 def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
            informer) -> tuple[Controller, FakeActuator]:
     import random
@@ -152,7 +339,8 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
             unhealthy_timeout_seconds=120.0,
             slice_repair_after_seconds=30.0),
         informer=informer,
-        policy_engine=_policy_engine(program))
+        policy_engine=_policy_engine(program),
+        serving_scaler=_serving_scaler(program))
     return controller, actuator
 
 
@@ -178,6 +366,13 @@ class _Run:
             program, self.proxy, self.kube, self.informer)
         self.monitor = InvariantMonitor(program.seed, self.kube,
                                         self.controller)
+        # ISSUE 9: serving-profile scenarios drive a fuzzed replica
+        # fleet into the controller's metrics adapter.
+        self.serving_fuzz = None
+        if self.controller.serving_scaler is not None:
+            self.serving_fuzz = _ServingFuzz(
+                program, self.controller.serving_scaler.adapter,
+                self.monitor)
         #: member job name -> its pod names (a multislice jobset
         #: contributes one entry per member job — the ICI-integrity
         #: invariant holds per job/slice, the jobset spans DCN).
@@ -334,6 +529,10 @@ class _Run:
                 if event.args["mode"] == "delete":
                     self.monitor.injected_deletes.add(victim)
                 self.actuator.fail_host(victim, event.args["mode"])
+        elif self.serving_fuzz is not None and kind in (
+                "replica_restart", "counter_reset", "stale_burst",
+                "replica_churn"):
+            self.serving_fuzz.apply_event(event)
         else:
             raise ValueError(f"unknown chaos event kind {kind!r}")
 
@@ -376,6 +575,8 @@ class _Run:
             self._completions(t)
         if self.informer is not None:
             self.informer.pump()
+        if self.serving_fuzz is not None:
+            self.serving_fuzz.step(t)
         self.monitor.before_pass()
         try:
             self.controller.reconcile_once(now=t)
@@ -390,6 +591,8 @@ class _Run:
         self.passes += 1
         self.kube.schedule_step()
         self.monitor.after_pass(t)
+        if self.serving_fuzz is not None:
+            self.serving_fuzz.check(t)
 
     def execute(self) -> ChaosResult:
         t0 = _time.perf_counter()
@@ -424,6 +627,10 @@ class _Run:
             # normal reclaim clocks run — the allowance is part of the
             # policy profile's contract (docs/CHAOS.md).
             reclaim_window += POLICY_RECLAIM_ALLOWANCE
+        if program.serving:
+            # Serving scale-out records may hold an adopted slice
+            # through their TTL after the fuzzed load dies.
+            reclaim_window += SERVING_RECLAIM_ALLOWANCE
         if converged_at is not None:
             # Completions freeze here: a job finishing mid-reclaim
             # would reset the idle clocks the stranded check reads.

@@ -45,8 +45,10 @@ GANG_SHAPES = ("v5e-8", "v5e-16", "v5e-32", "v5p-16")
 #: judged (every generated event fires before ``until - QUIET_TAIL``).
 QUIET_TAIL = 300.0
 
-#: Known profiles (docs/CHAOS.md; ``policy`` is ISSUE 8).
-PROFILES = ("mixed", "faults", "api", "repair", "policy")
+#: Known profiles (docs/CHAOS.md; ``policy`` is ISSUE 8, ``serving``
+#: is ISSUE 9 — fuzz the serving metrics-adapter path under the mixed
+#: fault alphabet).
+PROFILES = ("mixed", "faults", "api", "repair", "policy", "serving")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,6 +96,12 @@ class ScenarioProgram:
     # ISSUE 8: run the scenario with the PolicyEngine attached — its
     # prewarms/holds ride the same corpus invariants.
     policy: bool = False
+    # ISSUE 9: run with the serving metrics adapter + ServingScaler
+    # attached, fed by fuzzed replica snapshots (restarts mid-window,
+    # counter resets, stale/out-of-order deliveries).  The adapter's
+    # step invariant: counter resets must NEVER yield negative rates,
+    # and the incremental pool sums must match a from-scratch rebuild.
+    serving: bool = False
 
     def describe(self) -> str:
         kinds: dict[str, int] = {}
@@ -106,6 +114,8 @@ class ScenarioProgram:
             tags.append("multislice")
         if self.policy:
             tags.append("policy")
+        if self.serving:
+            tags.append("serving")
         tagtxt = f" [{'+'.join(tags)}]" if tags else ""
         return (f"seed={self.seed} jobs={len(self.workloads)} "
                 f"({'/'.join(w.shape for w in self.workloads)}){tagtxt} "
@@ -126,6 +136,14 @@ def generate(seed: int, *, profile: str = "mixed",
 
     ``multislice=False`` suppresses the jobset overlay: promoted
     regression fixtures pin pre-ISSUE-8 seed programs exactly.
+
+    ``serving`` (ISSUE 9): the mixed API/fault alphabet plus a fuzzed
+    serving-replica fleet feeding the metrics adapter — replica
+    restarts mid-window, raw counter resets, stale and out-of-order
+    snapshot deliveries (scheduled as ``replica_restart`` /
+    ``counter_reset`` / ``stale_burst`` / ``replica_churn`` events the
+    engine's serving driver consumes), with the ServingScaler's
+    advisory demand riding the normal corpus invariants.
     """
     if profile not in PROFILES:
         raise ValueError(f"unknown chaos profile {profile!r}")
@@ -170,8 +188,9 @@ def generate(seed: int, *, profile: str = "mixed",
             shape=rng_ms.choice(("v5e-8", "v5e-16")),
             jobset_slices=2)
 
-    api_chaos = profile in ("mixed", "api", "policy")
-    fault_chaos = profile in ("mixed", "faults", "repair", "policy")
+    api_chaos = profile in ("mixed", "api", "policy", "serving")
+    fault_chaos = profile in ("mixed", "faults", "repair", "policy",
+                              "serving")
     events: list[Event] = []
 
     def fire(probability: float) -> bool:
@@ -200,6 +219,23 @@ def generate(seed: int, *, profile: str = "mixed",
         events.append(Event(
             rng.uniform(150.0, 330.0), "host_fail",
             {"mode": rng.choice(("notready", "delete"))}))
+    if profile == "serving":
+        # Serving-path faults, consumed by the engine's serving
+        # driver (new profile: its draws shift no legacy stream).
+        for _ in range(rng.randint(1, 3)):
+            events.append(Event(rng.uniform(30.0, 300.0),
+                                "replica_restart"))
+        if fire(0.7):
+            events.append(Event(rng.uniform(30.0, 300.0),
+                                "counter_reset"))
+        if fire(0.7):
+            events.append(Event(rng.uniform(30.0, 300.0), "stale_burst",
+                                {"count": rng.randint(3, 12)}))
+        if fire(0.5):
+            events.append(Event(rng.uniform(60.0, 300.0),
+                                "replica_churn",
+                                {"add": rng.randint(0, 2),
+                                 "remove": rng.randint(0, 2)}))
 
     events.sort(key=lambda e: e.t)
     last = max([e.t + e.args.get("duration", 0.0) for e in events],
@@ -217,4 +253,5 @@ def generate(seed: int, *, profile: str = "mixed",
         provision_delay=rng.choice((10.0, 30.0, 60.0)),
         stagger_seconds=rng.choice((0.0, 0.0, 5.0)),
         max_total_chips=rng.choice((256, 1024)),
-        policy=(profile == "policy"))
+        policy=(profile == "policy"),
+        serving=(profile == "serving"))

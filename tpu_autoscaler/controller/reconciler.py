@@ -170,7 +170,7 @@ class Controller:
                  informer=None, executor=None,
                  tracer: Tracer | None = None,
                  recorder: FlightRecorder | None = None,
-                 policy_engine=None):
+                 policy_engine=None, serving_scaler=None):
         self.client = client
         self.actuator = actuator
         self.config = config or ControllerConfig()
@@ -335,6 +335,18 @@ class Controller:
         self._policy_holds: set[str] = set()
         self._policy_idle_overrides: dict[str, float] = {}
         self._policy_digest = 0
+        # Serving-aware scaling (ISSUE 9, docs/SERVING.md): live
+        # engine signals folded into replica-target advice, expressed
+        # through the SAME advisory hook as prewarms and repairs.
+        # Strictly advisory, crash-only, reconcile-thread-only.
+        self.serving_scaler = serving_scaler
+        if serving_scaler is not None:
+            serving_scaler.bind(metrics=self.metrics,
+                                tracer=self.tracer)
+        #: The last pass's serving advice (scale-in counts are read by
+        #: the serving platform / replay driver, not acted on here —
+        #: replica drain rides the serve.py drain contract).
+        self.serving_advice = None
 
     # ------------------------------------------------------------------ #
 
@@ -370,6 +382,10 @@ class Controller:
         # this pass records its prewarm span into the gang's still-open
         # scale-up trace (the root ends in _track_gang_latency below).
         policy_advisory = self._policy_pass(gangs, nodes, pods, now)
+        # Serving signals fold AFTER policy (both are advisory; order
+        # only affects log readability) — live replica-target demand
+        # rides the same hook below.
+        serving_advisory = self._serving_pass(now)
         self._track_gang_latency(gangs, pods, nodes, now)
         # Settling only delays SIZING (the _scale path); _maintain still
         # sees every pending gang so reclaim deferral protects supply a
@@ -384,11 +400,12 @@ class Controller:
         # capacity into a job that needs one ICI domain).
         advisory, repair_deferred = self._repair_advisory(
             nodes, pods, gangs, now)
-        # Policy prewarm demand rides the SAME advisory hook as repair
-        # replacements — admitted by the pure planner AFTER organic
-        # demand and repairs (a misprediction can never displace real
-        # work under clamp contention).
-        advisory = advisory + policy_advisory
+        # Policy prewarm demand and serving replica-target demand ride
+        # the SAME advisory hook as repair replacements — admitted by
+        # the pure planner AFTER organic demand and repairs (a
+        # misprediction can never displace real work under clamp
+        # contention).
+        advisory = advisory + policy_advisory + serving_advisory
         self.metrics.set_gauge("gangs_deferred_to_repair",
                                len(repair_deferred))
         if repair_deferred:
@@ -703,6 +720,31 @@ class Controller:
             self._explain("policy", "prewarm rejected",
                           f"{len(advice.rejections)} forecasts below "
                           f"the firing bar")
+        return advice.advisory
+
+    # ---- serving-aware scaling (ISSUE 9) -------------------------------
+
+    def _serving_pass(self, now: float) -> list[tuple[Gang, str]]:
+        """Consult the ServingScaler for this pass's replica-target
+        advice.  Strictly advisory and crash-only, exactly like
+        ``_policy_pass``: a signal-path failure zeroes the advice and
+        scaling degrades to the reactive (pod-pending) baseline."""
+        self.serving_advice = None
+        if self.serving_scaler is None:
+            return []
+        try:
+            advice = self.serving_scaler.advise(
+                self.actuator.statuses(), now)
+        except Exception:  # noqa: BLE001 — advisory only
+            self.metrics.inc("serving_errors")
+            log.exception("serving scaler pass failed; continuing "
+                          "with reactive scaling")
+            return []
+        self.serving_advice = advice
+        for pool, n in advice.scale_in.items():
+            self._explain(("serving", pool), "serving scale-in advised",
+                          f"{n} surplus replica(s); platform drains "
+                          f"via the serve.py drain contract")
         return advice.advisory
 
     # ---- ICI-atomic slice repair (ISSUE 7) -----------------------------
@@ -1191,6 +1233,17 @@ class Controller:
             # Prewarm table + provision estimate (reconcile-thread
             # state read concurrently; values are scalars/copies).
             out["policy"] = self.policy_engine.debug_state()
+        if self.serving_scaler is not None:
+            # Scale-out table + replica census: scalar copies, same
+            # bounded-concurrency caveats as the policy table.
+            for _ in range(5):
+                try:
+                    out["serving"] = self.serving_scaler.debug_state()
+                    break
+                except RuntimeError:  # mutated mid-copy; retry
+                    continue
+            else:
+                out["serving"] = {"unavailable": "mutating"}
         # This dict is reconcile-thread-owned and deliberately
         # lock-free (giving the Controller a lock would put EVERY
         # field under the thread-discipline checker); the /debugz

@@ -73,6 +73,8 @@ def make_program(kind: str, seed: int = 0, *, shape: str = "v5e-16",
                  period: float = 900.0, cycles: int = 6,
                  run_seconds: float = 240.0) -> TrafficProgram:
     """Compile one traffic program (pure function of its arguments)."""
+    from tpu_autoscaler.policy import traffic
+
     rng = random.Random(seed)
     arrivals: list[Arrival] = []
     if kind == "recurring":
@@ -82,24 +84,22 @@ def make_program(kind: str, seed: int = 0, *, shape: str = "v5e-16",
                 run_seconds=run_seconds))
         until = 60.0 + cycles * period
     elif kind == "diurnal":
+        # Two "days" of the SHARED day-shape (policy/traffic.py —
+        # arrivals cluster in each day's first half); draw-for-draw
+        # identical to the pre-ISSUE-9 inline loop, so seeded programs
+        # are unchanged.
         day = period * 4
-        t = 0.0
-        k = 0
-        while t < day * 2:
-            # Two "days": arrivals cluster in each day's first half.
-            phase = (t % day) / day
-            rate = 0.9 if phase < 0.5 else 0.1
-            if rng.random() < rate:
-                arrivals.append(Arrival(
-                    t=t + rng.uniform(0.0, 30.0), job=f"web-{k}",
-                    shape=shape, run_seconds=run_seconds))
-                k += 1
-            t += period / 2
+        arrivals = [
+            Arrival(t=t, job=f"web-{k}", shape=shape,
+                    run_seconds=run_seconds)
+            for k, t in enumerate(traffic.diurnal_arrival_times(
+                rng, day, period / 2, days=2))]
         until = day * 2 + period
     elif kind == "spike":
-        arrivals = [Arrival(t=period * 2 + i * 10.0, job=f"burst-{i}",
-                            shape=shape, run_seconds=run_seconds)
-                    for i in range(3)]
+        arrivals = [Arrival(t=t, job=f"burst-{i}", shape=shape,
+                            run_seconds=run_seconds)
+                    for i, t in enumerate(
+                        traffic.spike_times(period * 2))]
         until = period * 3
     elif kind == "coldstart":
         arrivals = [Arrival(t=60.0, job="first-0", shape=shape,

@@ -1,0 +1,141 @@
+"""Shared traffic-shape primitives (ISSUE 9 dedupe).
+
+The diurnal and spike patterns used to live twice: once inside
+``policy/replay.py``'s gang-level ``make_program`` and (nearly) again
+in the serving bench's request-level generator.  Two copies of "what a
+day of traffic looks like" drift apart; this module is the single
+definition both consume:
+
+- ``diurnal_phase_rate`` — the day-shape: a busy first half and a
+  quiet second half (optionally with linear shoulders for
+  request-level intensity; the gang-level program keeps the hard
+  split so historical seeds reproduce exactly);
+- ``diurnal_arrival_times`` — the gang-level arrival sampler
+  ``make_program("diurnal")`` uses (draw-for-draw identical to the
+  pre-ISSUE-9 loop, so seeded programs are unchanged);
+- ``spike_times`` — the unforecastable-burst schedule shared by
+  ``make_program("spike")`` and the serving replay's spike overlay;
+- ``request_rate`` — request-level intensity (requests/second) for the
+  millions-of-users serving replay: the same day-shape scaled to an
+  rps band, with multiplicative spike windows on top.
+
+Everything is a pure function of its arguments (injected rng included)
+— same determinism contract as the rest of the policy package.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Sequence
+
+#: Fraction of the day that is the busy phase.
+DIURNAL_PEAK_FRACTION = 0.5
+
+#: Gang-level per-step arrival probabilities in the busy/quiet phases
+#: (the original ``make_program("diurnal")`` constants).
+DIURNAL_HIGH_RATE = 0.9
+DIURNAL_LOW_RATE = 0.1
+
+#: Jitter added to each gang-level diurnal arrival.
+ARRIVAL_JITTER_S = 30.0
+
+#: Gang-level spike schedule: burst size and spacing.
+SPIKE_COUNT = 3
+SPIKE_SPACING_S = 10.0
+
+
+def diurnal_phase_rate(phase: float, high: float = DIURNAL_HIGH_RATE,
+                       low: float = DIURNAL_LOW_RATE,
+                       ramp_fraction: float = 0.0) -> float:
+    """Rate at ``phase`` in [0, 1) of the day: ``high`` through the
+    busy first half, ``low`` after.  ``ramp_fraction`` > 0 replaces
+    the hard edges with linear shoulders of that width (request-level
+    traffic ramps; job-level traffic switches) — the ramp is exactly
+    the surface predictive scaling wins on."""
+    phase = phase % 1.0
+    split = DIURNAL_PEAK_FRACTION
+    if ramp_fraction <= 0.0:
+        return high if phase < split else low
+    r = min(ramp_fraction, split / 2.0)
+    # Shoulders: rise over [1-r, 1)->[0, r) wrap, fall over
+    # [split-r, split+r).
+    if phase < r:
+        f = 0.5 + 0.5 * (phase / r)
+        return low + (high - low) * f
+    if phase < split - r:
+        return high
+    if phase < split + r:
+        f = 1.0 - (phase - (split - r)) / (2.0 * r)
+        return low + (high - low) * f
+    if phase < 1.0 - r:
+        return low
+    f = 0.5 * (phase - (1.0 - r)) / r
+    return low + (high - low) * f
+
+
+def diurnal_arrival_times(rng: random.Random, day: float, step: float,
+                          days: int = 2,
+                          jitter: float = ARRIVAL_JITTER_S
+                          ) -> list[float]:
+    """Gang-level diurnal arrival times over ``days`` repeating days.
+
+    Draw-for-draw identical to the pre-ISSUE-9 ``make_program`` loop
+    (one ``rng.random()`` per step, one ``rng.uniform`` per hit), so
+    every historical seed compiles to the same program.
+    """
+    out: list[float] = []
+    t = 0.0
+    while t < day * days:
+        phase = (t % day) / day
+        if rng.random() < diurnal_phase_rate(phase):
+            out.append(t + rng.uniform(0.0, jitter))
+        t += step
+    return out
+
+
+def spike_times(start: float, count: int = SPIKE_COUNT,
+                spacing: float = SPIKE_SPACING_S) -> list[float]:
+    """The unforecastable burst: ``count`` arrivals from ``start`` at
+    fixed ``spacing`` (quiet before, nothing after)."""
+    return [start + i * spacing for i in range(count)]
+
+
+def request_rate(t: float, day: float, peak_rps: float,
+                 trough_rps: float, ramp_fraction: float = 0.15,
+                 spikes: Sequence[tuple[float, float, float]] = ()
+                 ) -> float:
+    """Request-level intensity (requests/second) at sim-time ``t``:
+    the shared day-shape scaled to [trough_rps, peak_rps], times any
+    open spike window's multiplier.  ``spikes``: (start, duration,
+    multiplier) triples."""
+    rate = diurnal_phase_rate((t % day) / day, high=peak_rps,
+                              low=trough_rps,
+                              ramp_fraction=ramp_fraction)
+    for start, duration, mult in spikes:
+        if start <= t < start + duration:
+            rate *= mult
+    return rate
+
+
+def arrivals_in_step(rng, rate: float, dt: float) -> int:
+    """Poisson arrival count for one sim step (``rng`` is a
+    ``numpy.random.Generator``; rate in 1/s)."""
+    lam = max(0.0, rate * dt)
+    if lam <= 0.0:
+        return 0
+    return int(rng.poisson(lam))
+
+
+def total_requests(day: float, peak_rps: float, trough_rps: float,
+                   days: int = 2, ramp_fraction: float = 0.15,
+                   spikes: Iterable[tuple[float, float, float]] = (),
+                   step: float = 5.0) -> float:
+    """Expected request volume of a replay (reporting: the
+    "millions of users" derivation in BENCH_SERVING.json)."""
+    total = 0.0
+    t = 0.0
+    while t < day * days:
+        total += request_rate(t, day, peak_rps, trough_rps,
+                              ramp_fraction, tuple(spikes)) * step
+        t += step
+    return total

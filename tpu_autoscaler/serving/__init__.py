@@ -1,0 +1,32 @@
+"""Serving-aware autoscaling (ISSUE 9): the live-signal hot path from
+the serving engines to the planner.
+
+Three layers, engineered to fleet scale (docs/SERVING.md "Autoscaler
+integration"):
+
+- ``stats``   — per-engine tick statistics as fixed numpy rings with an
+  O(1) snapshot API (zero per-request Python object churn; export costs
+  nothing on the decode path).  The batcher family
+  (``workloads/serving.py`` / ``paged.py`` / ``spec_serving.py``)
+  owns one recorder each and exposes ``stats()``.
+- ``adapter`` — folds snapshots from thousands of replicas into
+  per-pool demand signals with CapacityView-style incremental sums
+  (O(churn) per reconcile pass, vectorized over the dirty set; full
+  rebuild on demand).  Counter resets and stale/out-of-order snapshots
+  are absorbed here — rates are never negative.
+- ``scaler``  — turns SLO pressure into advisory replica demand
+  through the planner's existing ``advisory_gangs`` hook (planner
+  stays pure), with the PR 8 forecasters fed by the live queue-depth /
+  throughput series as arrival sources.  Scale-in advice rides the
+  ``serve.py`` drain contract: a replica finishes its queue before its
+  slice is reclaimed.
+
+``replay`` is the evaluation loop: a diurnal+spike millions-of-users
+traffic replay through the real Controller, signal-driven vs
+pod-pending reactive — the ``bench.py serving`` gate.
+"""
+
+from tpu_autoscaler.serving.stats import (  # noqa: F401
+    ServingSnapshot,
+    ServingStatsRecorder,
+)

@@ -1,0 +1,625 @@
+"""Serving replay: millions-of-users traffic through the real
+Controller, signal-driven vs pod-pending reactive.
+
+The evaluation loop behind ``bench.py serving`` (the ISSUE 9 outcome
+gate), shaped like ``policy/replay.py``: a seeded diurnal+spike
+request-level traffic program (``policy/traffic.py`` — the SAME
+day-shape the gang-level programs use) drives a fleet of simulated
+serving replicas against ``FakeKube`` + the production ``Controller``,
+once per scaling mode:
+
+- ``reactive``  — pod-pending scaling, the pre-ISSUE-9 world: replica
+  demand enters the control plane only as a pending serving pod, so
+  provisioning starts when the pod goes Unschedulable (after the
+  overload already exists);
+- ``signal``    — the live-signal hot path: every replica exports its
+  engine stats (real :class:`ServingStatsRecorder` rings), the
+  metrics adapter folds them O(churn), and the ServingScaler's
+  replica-target / forecast advice prewarms supply through the
+  planner's advisory hook before the ramp bites.
+
+Replicas are queueing models, not JAX engines (thousands of engines
+would measure JAX, not the autoscaler): FIFO request cohorts, a fixed
+service rate, a slot cap — but their export path is the REAL stats
+recorder and the adapter/scaler under test are the production objects.
+Scale-in honors the serve.py drain contract in both modes: a surplus
+replica stops admitting, finishes its queue (work re-routes), and only
+then does its slice idle into reclaim — the zero-lost-requests
+assertion at the end of every replay.
+
+Scored like the policy bench: the first ``days - 1`` days are warmup
+(the Holt-Winters forecaster must earn its seasonal confidence), the
+last day — ramp, peak, and an unforecastable spike — is the scored
+tail.  The gate compares per-request SLO attainment there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from tpu_autoscaler.policy import traffic
+from tpu_autoscaler.serving.adapter import ServingMetricsAdapter
+from tpu_autoscaler.serving.scaler import ServingPolicy, ServingScaler
+from tpu_autoscaler.serving.stats import ServingStatsRecorder
+
+#: Realistic-actuation profile (mirrors policy/replay.py).
+PROVISION_DELAY_S = 90.0
+HOST_STAGGER_S = 2.0
+
+#: Serving replica slice shape: single-host v5e-4 (one replica = one
+#: slice = one node; the cheapest unit the catalog offers).
+REPLICA_SHAPE = "v5e-4"
+
+#: "Millions of users" derivation: modeled requests per user per hour.
+REQS_PER_USER_PER_HOUR = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingReplayConfig:
+    """One replay's traffic + fleet geometry (pure data)."""
+
+    seed: int = 0
+    day_seconds: float = 2400.0     # one compressed "day"
+    # Last day is the scored tail; the Holt-Winters forecaster needs
+    # two complete seasons before it reports confidence at all
+    # (forecast.py), so 4 days = 3 warmup days + a scored day with a
+    # confident seasonal model.
+    days: int = 4
+    step: float = 5.0
+    peak_rps: float = 600.0
+    trough_rps: float = 60.0
+    # Sharp shoulders: the ramp (~190 s) is shorter than reactive
+    # detection + provision (~100 s lag against a moving target) —
+    # exactly the regime where signal lead time shows.
+    ramp_fraction: float = 0.08
+    # The unforecastable burst, in the LAST day's quiet phase:
+    # (start offset into the last day, duration, rate multiplier).
+    spike_offset: float = 0.75
+    spike_duration: float = 240.0
+    spike_mult: float = 5.0
+    # Replica service model.
+    slots_per_replica: int = 16
+    replica_rps: float = 8.0        # completions/s at saturation
+    tokens_per_request: int = 100
+    slo_seconds: float = 15.0       # arrival -> completion target
+    # Scale-in pacing, SHARED by both modes (the comparison must not
+    # hand either side a lazier drain): deadband utilization floor,
+    # persistence hold, per-decision fleet-fraction cap.
+    scalein_utilization: float = 0.45
+    scalein_hold_seconds: float = 120.0
+    scalein_step_div: int = 4
+    report_every_steps: int = 3     # snapshot export period (staggered)
+    baseline_replicas: int = 16     # warm fleet at t=0 (both modes)
+    max_replicas: int = 160
+    target_utilization: float = 0.75
+    # Reactive trigger hysteresis: overload must persist this many
+    # steps before the pod-pending submitter fires (HPA-ish lag).
+    reactive_hold_steps: int = 2
+    idle_threshold_seconds: float = 180.0
+
+    @property
+    def spikes(self) -> tuple[tuple[float, float, float], ...]:
+        start = (self.day_seconds * (self.days - 1)
+                 + self.spike_offset * self.day_seconds)
+        return ((start, self.spike_duration, self.spike_mult),)
+
+    @property
+    def until(self) -> float:
+        return self.day_seconds * self.days
+
+    @property
+    def modeled_users(self) -> int:
+        """Users whose aggregate peak demand this trace models."""
+        return int(self.peak_rps * 3600.0 / REQS_PER_USER_PER_HOUR)
+
+    def rate(self, t: float) -> float:
+        return traffic.request_rate(
+            t, self.day_seconds, self.peak_rps, self.trough_rps,
+            ramp_fraction=self.ramp_fraction, spikes=self.spikes)
+
+
+class _Replica:
+    """One simulated serving replica: FIFO cohorts + a real recorder.
+
+    Service model: ``slots`` concurrent requests, each occupying its
+    slot for ``tau = slots / replica_rps`` seconds — so saturation
+    throughput is ``replica_rps`` and the *active* count at the end of
+    a step reflects true occupancy (``lambda * tau`` when subcritical,
+    ``slots`` when saturated).  That occupancy is the load signal the
+    stats recorder exports; without it, instantaneous queues carry no
+    information at steady state."""
+
+    __slots__ = ("name", "node", "fifo", "queued", "carry", "draining",
+                 "recorder", "decode_tokens", "active")
+
+    def __init__(self, name: str, node: str,
+                 cfg: ServingReplayConfig) -> None:
+        self.name = name
+        self.node = node
+        self.fifo: deque[list] = deque()   # [arrival_t, n] cohorts
+        self.queued = 0
+        self.carry = 0.0
+        self.draining = False
+        self.decode_tokens = 0
+        self.active = 0
+        self.recorder = ServingStatsRecorder(
+            cfg.slots_per_replica,
+            slo_ticks=max(1, int(cfg.slo_seconds // cfg.step)))
+
+    def assign(self, t: float, n: int) -> None:
+        if n <= 0:
+            return
+        self.fifo.append([t, n])
+        self.queued += n
+        self.recorder.note_admit(n)
+
+    def reroute(self) -> list[list]:
+        """Drain contract, queue half: everything beyond one slot-full
+        of in-flight work re-routes to other replicas (nothing is
+        lost; the in-flight tail finishes here before the slice may
+        idle into reclaim)."""
+        keep = min(self.queued, self.recorder.slots)
+        out: list[list] = []
+        surplus = self.queued - keep
+        while surplus > 0 and self.fifo:
+            tail = self.fifo[-1]
+            take = min(surplus, tail[1])
+            tail[1] -= take
+            surplus -= take
+            self.queued -= take
+            out.append([tail[0], take])
+            if tail[1] == 0:
+                self.fifo.pop()
+        return out
+
+    def step(self, t: float, cfg: ServingReplayConfig,
+             score) -> None:
+        """Serve one sim step: FIFO completions at the service rate,
+        then close the stats tick."""
+        cap = self.carry + cfg.replica_rps * cfg.step
+        done = 0
+        while cap >= 1.0 and self.fifo:
+            head = self.fifo[0]
+            take = min(int(cap), head[1])
+            if take <= 0:
+                break
+            head[1] -= take
+            cap -= take
+            done += take
+            self.queued -= take
+            latency = t + cfg.step - head[0]
+            score(head[0], t + cfg.step, take)
+            lat_ticks = max(0, int(latency // cfg.step))
+            for _ in range(min(take, 32)):
+                # Bounded per-cohort recorder writes: the ring only
+                # needs the latency distribution, not every request.
+                self.recorder.note_finish(lat_ticks)
+            extra = take - 32
+            if extra > 0:
+                self.recorder.finished_total += extra
+                if self.recorder.slo_ticks is None \
+                        or lat_ticks <= self.recorder.slo_ticks:
+                    self.recorder.slo_ok_total += extra
+            if head[1] == 0:
+                self.fifo.popleft()
+        self.carry = cap - int(cap) if self.fifo else 0.0
+        self.decode_tokens += done * cfg.tokens_per_request
+        # Occupancy at step end: lambda * tau when keeping up, the
+        # full slot set when a queue persists (saturated).
+        tau = cfg.slots_per_replica / cfg.replica_rps
+        if self.queued > 0:
+            self.active = self.recorder.slots
+        else:
+            self.active = min(self.recorder.slots,
+                              int(round(done * tau / cfg.step)))
+        self.recorder.end_tick(
+            queue_depth=self.queued, active=self.active,
+            kv_used=self.active * cfg.tokens_per_request,
+            kv_capacity=self.recorder.slots * 256,
+            decode_tokens_total=self.decode_tokens)
+
+
+@dataclasses.dataclass
+class ServingReplayResult:
+    mode: str
+    arrived: int
+    served: int
+    unserved: int
+    attainment: float          # whole trace
+    tail_attainment: float     # scored window (the last day)
+    tail_miss_rate: float
+    worst_window_attainment: float
+    latency_p50_s: float
+    latency_p99_s: float
+    peak_replicas: int
+    provisions: int
+    scaleouts: int
+    passes: int
+
+    def as_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        for k in ("attainment", "tail_attainment", "tail_miss_rate",
+                  "worst_window_attainment"):
+            d[k] = round(d[k], 4)
+        return d
+
+
+def _serving_policy(cfg: ServingReplayConfig) -> ServingPolicy:
+    season = max(8, int(cfg.day_seconds // 120.0))
+    return ServingPolicy(
+        target_utilization=cfg.target_utilization,
+        scalein_utilization=cfg.scalein_utilization,
+        scalein_step_div=cfg.scalein_step_div,
+        slo_attainment_target=0.97,
+        max_replicas=cfg.max_replicas,
+        min_replicas=1,
+        scaleout_hold_seconds=PROVISION_DELAY_S + 180.0,
+        replica_grace_seconds=90.0,
+        scalein_hold_seconds=cfg.scalein_hold_seconds,
+        forecast=True, min_confidence=0.35,
+        provision_estimate_seconds=PROVISION_DELAY_S + 60.0,
+        sample_seconds=cfg.day_seconds / season,
+        hw_bin_seconds=cfg.day_seconds / season,
+        hw_season_bins=season)
+
+
+class _Score:
+    """Request-latency scoreboard (exact, cohort-weighted)."""
+
+    def __init__(self, cfg: ServingReplayConfig) -> None:
+        self._cfg = cfg
+        # The scored tail covers the LAST day including its morning
+        # ramp, which (wrap shoulder) starts at the end of the
+        # previous day — the exact window reactive lag bleeds in.
+        self._scored_from = cfg.day_seconds * (
+            cfg.days - 1 - cfg.ramp_fraction)
+        self.served = 0
+        self.ok = 0
+        self.tail_served = 0
+        self.tail_ok = 0
+        # Latency histogram in whole seconds (exact p50/p99 to 1 s).
+        self._lat = np.zeros(4096, np.int64)
+        # Rolling 5-minute windows for worst-window attainment.
+        self._window: dict[int, list[int]] = {}
+
+    def __call__(self, arrival_t: float, finish_t: float,
+                 n: int) -> None:
+        latency = finish_t - arrival_t
+        ok = latency <= self._cfg.slo_seconds
+        self.served += n
+        self.ok += n if ok else 0
+        if arrival_t >= self._scored_from:
+            self.tail_served += n
+            self.tail_ok += n if ok else 0
+        self._lat[min(4095, int(latency))] += n
+        w = int(arrival_t // 300.0)
+        cell = self._window.setdefault(w, [0, 0])
+        cell[0] += n
+        cell[1] += n if ok else 0
+
+    def percentile(self, q: float) -> float:
+        total = int(self._lat.sum())
+        if not total:
+            return 0.0
+        cum = np.cumsum(self._lat)
+        return float(np.searchsorted(cum, q * total, side="left"))
+
+    @property
+    def worst_window(self) -> float:
+        worst = 1.0
+        for n, ok in self._window.values():
+            if n >= 50:
+                worst = min(worst, ok / n)
+        return worst
+
+
+def replay(config: ServingReplayConfig, *, mode: str,
+           probe=None) -> ServingReplayResult:
+    """Drive one traffic program through the real control loop.
+
+    ``probe``: optional per-step callback ``(t, replica_count,
+    backlog, score)`` for tests and trace inspection."""
+    if mode not in ("reactive", "signal"):
+        raise ValueError(f"unknown serving replay mode {mode!r}")
+    from tpu_autoscaler.actuators.fake import FakeActuator
+    from tpu_autoscaler.controller import Controller, ControllerConfig
+    from tpu_autoscaler.engine.planner import PoolPolicy
+    from tpu_autoscaler.k8s.fake import FakeKube
+    from tpu_autoscaler.k8s.informer import ClusterInformer
+    from tpu_autoscaler.k8s.objects import clear_parse_caches
+    from tpu_autoscaler.sim import gang_pods
+    from tpu_autoscaler.topology.catalog import shape_by_name
+
+    clear_parse_caches()
+    cfg = config
+    shape = shape_by_name(REPLICA_SHAPE)
+    accel = shape.accelerator_type
+    kube = FakeKube()
+    actuator = FakeActuator(kube, provision_delay=PROVISION_DELAY_S,
+                            stagger_seconds=HOST_STAGGER_S)
+    informer = ClusterInformer(kube, timeout_seconds=0)
+    adapter = ServingMetricsAdapter()
+    scaler = (ServingScaler(adapter, _serving_policy(cfg))
+              if mode == "signal" else None)
+    controller = Controller(
+        kube, actuator,
+        ControllerConfig(
+            policy=PoolPolicy(spare_nodes=0, max_total_chips=8192),
+            grace_seconds=60.0,
+            idle_threshold_seconds=cfg.idle_threshold_seconds,
+            drain_grace_seconds=30.0,
+            provision_timeout_seconds=600.0),
+        informer=informer, serving_scaler=scaler)
+
+    rng = np.random.default_rng(cfg.seed)
+    score = _Score(cfg)
+    replicas: dict[str, _Replica] = {}   # node name -> replica
+    unassigned: deque[list] = deque()    # pool-level cohorts
+    pod_of: dict[str, str] = {}          # node -> serving pod name
+    # Nodes whose replica drained away: they idle toward reclaim and
+    # the DaemonSet must NOT resurrect them — unless new scale-out
+    # demand re-enlists the warm slice first (cheaper than a
+    # provision; the planner's free-slice adoption models the same).
+    retired: set[str] = set()
+    seq = [0]
+    overload_streak = [0]
+    reactive_surplus_since: list = [None]
+    arrived = 0
+    passes = 0
+    peak = 0
+    scaleouts_metric = "serving_scaleouts"
+
+    def serving_nodes() -> dict[str, Any]:
+        out = {}
+        for n in informer.nodes():
+            if n.is_tpu and n.tpu_accelerator == accel \
+                    and n.is_ready and not n.unschedulable:
+                out[n.name] = n
+            elif not n.is_ready and n.name in replicas:
+                # A host failure mid-replay: reroute and drop.
+                _kill_replica(n.name)
+        return out
+
+    def _kill_replica(node: str) -> None:
+        rep = replicas.pop(node, None)
+        if rep is None:
+            return
+        unassigned.extend(rep.fifo)
+        pod = pod_of.pop(node, None)
+        if pod is not None and kube.get_pod("default", pod):
+            kube.delete_pod("default", pod)
+        adapter.remove(node)
+        retired.add(node)
+
+    def _bind_daemonset(t: float) -> None:
+        """A serving pod on every Ready serving-class node (signal
+        mode's replica source; in reactive mode replicas arrive as
+        scheduled pending pods instead)."""
+        for name in serving_nodes():
+            if name in replicas or name in retired:
+                continue
+            seq[0] += 1
+            pod_name = f"serve-web-{seq[0]}"
+            payload = gang_pods(REPLICA_SHAPE, pod_name)[0]
+            payload["spec"]["nodeName"] = name
+            payload["status"]["phase"] = "Running"
+            payload["status"].pop("conditions", None)
+            kube.add_pod(payload)
+            pod_of[name] = payload["metadata"]["name"]
+            replicas[name] = _Replica(pod_name, name, cfg)
+
+    def _adopt_scheduled(t: float) -> None:
+        """Reactive mode: pending serving pods the toy scheduler bound
+        become replicas."""
+        for p in informer.pods():
+            if p.namespace != "default" or not p.name.startswith(
+                    "serve-web-"):
+                continue
+            if p.node_name and p.phase == "Running" \
+                    and p.node_name not in replicas:
+                retired.discard(p.node_name)
+                pod_of[p.node_name] = p.name
+                replicas[p.node_name] = _Replica(p.name, p.node_name,
+                                                 cfg)
+
+    def _seed_baseline() -> None:
+        """Warm fleet at t=0, identical in both modes."""
+        from tpu_autoscaler.k8s.payloads import tpu_host_payload
+
+        for i in range(cfg.baseline_replicas):
+            kube.add_node(tpu_host_payload(
+                shape, f"serve-seed-{i}", 0, 0.0, ready=True))
+
+    def desired_replicas(backlog: float) -> int:
+        import math
+
+        per = cfg.slots_per_replica * cfg.target_utilization
+        return min(cfg.max_replicas,
+                   max(1, math.ceil(backlog / per)))
+
+    def _reactive_submit(t: float, backlog: float) -> None:
+        live = len(replicas)
+        pending = sum(
+            1 for p in informer.pods()
+            if p.name.startswith("serve-web-") and p.node_name is None)
+        want = desired_replicas(backlog)
+        if want > live + pending:
+            overload_streak[0] += 1
+        else:
+            overload_streak[0] = 0
+            return
+        if overload_streak[0] < cfg.reactive_hold_steps:
+            return
+        for _ in range(want - live - pending):
+            seq[0] += 1
+            for payload in gang_pods(REPLICA_SHAPE,
+                                     f"serve-web-{seq[0]}"):
+                kube.add_pod(payload)
+
+    def _drain_surplus(t: float, surplus: int) -> None:
+        """Mark the least-loaded replicas draining; their queues
+        re-route NOW (serve.py drain contract: nothing is lost)."""
+        candidates = sorted(
+            (r for r in replicas.values() if not r.draining),
+            key=lambda r: r.queued)
+        for rep in candidates[:max(0, surplus)]:
+            rep.draining = True
+            for cohort in rep.reroute():
+                unassigned.append(cohort)
+
+    def _reap_drained(t: float) -> None:
+        for node, rep in list(replicas.items()):
+            if rep.draining and rep.queued == 0:
+                _kill_replica(node)
+
+    def _route(t: float, n_new: int) -> None:
+        nonlocal arrived
+        arrived += n_new
+        if n_new:
+            unassigned.append([t, n_new])
+        live = [r for r in replicas.values() if not r.draining]
+        if not live:
+            return
+        while unassigned:
+            cohort = unassigned.popleft()
+            live.sort(key=lambda r: r.queued)
+            # Spread the cohort over the emptiest third of the fleet.
+            k = max(1, len(live) // 3)
+            share = -(-cohort[1] // k)
+            for rep in live[:k]:
+                take = min(share, cohort[1])
+                if take <= 0:
+                    break
+                rep.assign(cohort[0], take)
+                cohort[1] -= take
+            if cohort[1] > 0:
+                unassigned.appendleft(cohort)
+                break
+
+    _seed_baseline()
+    t = 0.0
+    # Drain-out phase after the trace: arrivals stop, the fleet must
+    # finish every queued request (the zero-lost assertion).
+    horizon = cfg.until + 1200.0
+    while t <= horizon:
+        informer.pump()
+        # Prune retired nodes the controller has reclaimed (or that a
+        # scheduled pod re-occupied, in reactive mode).
+        live_nodes = {n["metadata"]["name"] for n in kube.list_nodes()}
+        retired &= live_nodes
+        if mode == "signal":
+            # Outstanding scale-out demand re-enlists retired warm
+            # slices before the DaemonSet pass (free-slice reuse).
+            advice = controller.serving_advice
+            need = len(advice.advisory) if advice is not None else 0
+            while need > 0 and retired:
+                retired.pop()
+                need -= 1
+            _bind_daemonset(t)
+        else:
+            _adopt_scheduled(t)
+        rate = cfg.rate(t) if t < cfg.until else 0.0
+        n_new = traffic.arrivals_in_step(rng, rate, cfg.step)
+        _route(t, n_new)
+        for rep in replicas.values():
+            rep.step(t, cfg, score)
+        # Load signal AFTER serving: persistent queues + occupancy —
+        # the same quantity the replicas' recorders just exported.
+        backlog = (sum(r.queued + r.active for r in replicas.values())
+                   + sum(c[1] for c in unassigned))
+        _reap_drained(t)
+        peak = max(peak, len(replicas))
+        # Export: staggered snapshot ingest (signal mode only).
+        if mode == "signal":
+            for i, (node, rep) in enumerate(replicas.items()):
+                if (passes + i) % cfg.report_every_steps:
+                    continue
+                adapter.ingest(node, "web", accel, REPLICA_SHAPE,
+                               rep.recorder.snapshot(), now=t)
+        # Scale decisions.  The reactive platform gets the SAME target
+        # math, deadband, and drain caps as the scaler — the measured
+        # difference is the advisory/forecast lead, not a handicapped
+        # baseline.
+        if mode == "reactive":
+            import math as _math
+
+            _reactive_submit(t, backlog)
+            floor_target = max(
+                desired_replicas(backlog),
+                _math.ceil(backlog
+                           / (cfg.slots_per_replica
+                              * cfg.scalein_utilization)))
+            surplus = len(replicas) - floor_target
+            if surplus > 0:
+                if reactive_surplus_since[0] is None:
+                    reactive_surplus_since[0] = t
+                elif (t - reactive_surplus_since[0]
+                      >= cfg.scalein_hold_seconds):
+                    _drain_surplus(
+                        t, min(surplus,
+                               max(1, len(replicas)
+                                   // cfg.scalein_step_div)))
+                    reactive_surplus_since[0] = t
+            else:
+                reactive_surplus_since[0] = None
+        informer.pump()
+        controller.reconcile_once(now=t)
+        passes += 1
+        if mode == "signal" and controller.serving_advice is not None:
+            surplus = controller.serving_advice.scale_in.get("web", 0)
+            if surplus:
+                _drain_surplus(t, surplus)
+        kube.schedule_step()
+        if probe is not None:
+            probe(t, len(replicas), backlog, score)
+        if t >= cfg.until and score.served >= arrived:
+            break
+        t += cfg.step
+
+    snap = controller.metrics.snapshot()
+    counters = snap["counters"]
+    unserved = arrived - score.served
+    return ServingReplayResult(
+        mode=mode, arrived=arrived, served=score.served,
+        unserved=unserved,
+        attainment=(score.ok / score.served) if score.served else 0.0,
+        tail_attainment=(score.tail_ok / score.tail_served
+                         if score.tail_served else 0.0),
+        tail_miss_rate=(1.0 - score.tail_ok / score.tail_served
+                        if score.tail_served else 1.0),
+        worst_window_attainment=score.worst_window,
+        latency_p50_s=score.percentile(0.50),
+        latency_p99_s=score.percentile(0.99),
+        peak_replicas=peak,
+        provisions=int(counters.get("provisions_submitted", 0)),
+        scaleouts=int(counters.get(scaleouts_metric, 0)),
+        passes=passes)
+
+
+def compare(config: ServingReplayConfig) -> dict[str, Any]:
+    """Reactive vs signal-driven scorecard for one traffic program."""
+    reactive = replay(config, mode="reactive")
+    signal = replay(config, mode="signal")
+    r_miss = max(reactive.tail_miss_rate, 1e-6)
+    s_miss = max(signal.tail_miss_rate, 1e-6)
+    return {
+        "trace": {
+            "seed": config.seed,
+            "day_seconds": config.day_seconds, "days": config.days,
+            "peak_rps": config.peak_rps,
+            "trough_rps": config.trough_rps,
+            "spikes": list(config.spikes),
+            "modeled_users": config.modeled_users,
+            "slo_seconds": config.slo_seconds,
+        },
+        "reactive": reactive.as_dict(),
+        "signal": signal.as_dict(),
+        "tail_attainment_reactive": round(reactive.tail_attainment, 4),
+        "tail_attainment_signal": round(signal.tail_attainment, 4),
+        # >1 means the live-signal path beats pod-pending reactive.
+        "miss_rate_ratio": round(r_miss / s_miss, 3),
+    }

@@ -1,0 +1,399 @@
+"""Replica-target controller: SLO pressure -> advisory serving demand.
+
+Closes the ISSUE 9 loop on the decision side: each reconcile pass the
+Controller hands this scaler the pass clock and the actuator statuses;
+the scaler folds the metrics adapter, turns each pool's live signal
+into a desired replica count, and expresses any deficit as synthetic
+one-pod gangs keyed ``("serving", ns, name)`` through the planner's
+existing ``advisory_gangs`` hook — the exact mechanism prewarms (ISSUE
+8) and slice repairs (ISSUE 7) already use, so the planner stays a pure
+function and a serving misprediction can never displace organic demand
+(advisory gangs are admitted last, under the same clamp algebra).
+
+Desired replicas per pool:
+
+- **pressure** — enough replicas to hold the live backlog (queued +
+  in-flight requests) at the target utilization;
+- **SLO bump** — attainment below target adds headroom even when
+  utilization looks fine (tail latency leads utilization);
+- **forecast** — the live queue-depth/throughput series feed a PR 8
+  Holt-Winters forecaster as an arrival source (ROADMAP's "the
+  arrival-series plumbing accepts any demand source"); a confident
+  prediction inside the provisioning horizon raises desired BEFORE the
+  ramp arrives.
+
+Scale-out bookkeeping mirrors the prewarm lifecycle: one record per
+requested replica, re-emitted as advisory demand every pass until its
+provision lands and the replica has had ``replica_grace_seconds`` to
+join (or the record expires).  Scale-in is ADVICE ONLY
+(``ServingAdvice.scale_in``): the serving platform drains the surplus
+replicas through the ``serve.py`` drain contract — stop admitting,
+finish the queue, exit with the final-stats JSON — and the idle slice
+is then reclaimed by the normal maintenance path, so no queued request
+is ever lost to a reclaim.
+
+Reconcile-thread-only state, crash-only wiring (reconciler.py
+``_serving_pass``): a scaler failure degrades to reactive scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Any, Mapping, Sequence
+
+from tpu_autoscaler.k8s.gangs import Gang
+from tpu_autoscaler.k8s.objects import Pod
+from tpu_autoscaler.policy.forecast import HoltWintersForecaster
+from tpu_autoscaler.serving.adapter import (
+    PoolSignal,
+    ServingMetricsAdapter,
+)
+
+log = logging.getLogger(__name__)
+
+#: Namespace serving advisory gangs carry (like the prewarm namespace:
+#: outside tenant quota maps, riding the global chip clamp only).
+SERVING_NAMESPACE = "tpu-serving"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingPolicy:
+    """Scaler tuning (docs/SERVING.md "Autoscaler integration")."""
+
+    target_utilization: float = 0.75     # active / slots to aim for
+    # Scale-in deadband: surplus exists only above the fleet size that
+    # would still sit BELOW this utilization (a wide gap between the
+    # scale-out and scale-in targets is what stops thrash — a drained
+    # replica's queue re-routes onto the rest, which must not
+    # immediately re-trigger scale-out).
+    scalein_utilization: float = 0.45
+    #: Per-decision scale-in cap as a fleet fraction denominator
+    #: (drain at most replicas // this per decision).
+    scalein_step_div: int = 8
+    slo_attainment_target: float = 0.98  # below this, add headroom
+    slo_bump_replicas: int = 1           # replicas added per SLO miss
+    min_replicas: int = 0
+    max_replicas: int = 256
+    # Scale-out record lifecycle.
+    scaleout_hold_seconds: float = 300.0   # unprovisioned record TTL
+    replica_grace_seconds: float = 60.0    # ACTIVE -> replica joined
+    # Scale-in hysteresis: surplus must persist this long.
+    scalein_hold_seconds: float = 180.0
+    # Live-series forecasting (PR 8 Holt-Winters over demand samples).
+    forecast: bool = True
+    min_confidence: float = 0.6
+    provision_estimate_seconds: float = 150.0
+    sample_seconds: float = 30.0         # demand-series sample period
+    hw_bin_seconds: float = 60.0
+    hw_season_bins: int = 24
+
+
+@dataclasses.dataclass
+class ServingAdvice:
+    """One pass's serving-scaler output."""
+
+    advisory: list[tuple[Gang, str]] = dataclasses.field(
+        default_factory=list)
+    #: pool -> surplus replica count the platform should drain
+    #: (serve.py drain contract; never a forced reclaim).
+    scale_in: dict[str, int] = dataclasses.field(default_factory=dict)
+    #: pool -> desired replicas (gauges/tests/debug).
+    desired: dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class _ScaleOut:
+    """Lifecycle record of one requested replica (prewarm-shaped)."""
+
+    gang: Gang
+    pool: str
+    shape_name: str
+    created_at: float
+    provision_id: str | None = None
+    active_at: float | None = None
+
+    def expired(self, now: float, policy: ServingPolicy) -> bool:
+        if self.active_at is not None:
+            return now - self.active_at > policy.replica_grace_seconds
+        return now - self.created_at > policy.scaleout_hold_seconds
+
+
+class ServingScaler:
+    """Fold signals, decide replica targets, emit advisory demand."""
+
+    def __init__(self, adapter: ServingMetricsAdapter,
+                 policy: ServingPolicy | None = None) -> None:
+        self.adapter = adapter
+        self.policy = policy or ServingPolicy()
+        self._metrics: Any = None
+        self._seq = 0
+        self._scaleouts: dict[tuple, _ScaleOut] = {}
+        self._surplus_since: dict[str, float] = {}
+        # Pool replica census as of the last pass: a rise retires the
+        # oldest scale-out records (they were satisfied — whether by a
+        # provision or by the planner adopting a free slice).
+        self._replicas_seen: dict[str, int] = {}
+        self._hw = HoltWintersForecaster(
+            bin_seconds=self.policy.hw_bin_seconds,
+            season_bins=self.policy.hw_season_bins)
+        self._last_sample: dict[str, float] = {}
+
+    def bind(self, metrics: Any = None, tracer: Any = None) -> None:
+        """Adopt the controller's registries (Controller calls this)."""
+        if metrics is not None:
+            self._metrics = metrics
+            if self.adapter._metrics is None:
+                self.adapter._metrics = metrics
+
+    # -- metrics helpers --------------------------------------------------
+
+    def _inc(self, name: str, by: float = 1.0) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name, by)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(name, value)
+
+    # -- decision helpers -------------------------------------------------
+
+    def _slots_per_replica(self, sig: PoolSignal) -> float:
+        if sig.replicas <= 0 or sig.slots <= 0:
+            return 1.0
+        return sig.slots / sig.replicas
+
+    def _chips_per_replica(self, shape_name: str) -> int:
+        from tpu_autoscaler.topology.catalog import shape_by_name
+
+        try:
+            return max(1, shape_by_name(shape_name).chips)
+        except KeyError:
+            return 1
+
+    def _pressure_target(self, sig: PoolSignal) -> int:
+        """Replicas needed to hold the live backlog at target
+        utilization, plus SLO headroom when attainment is burning."""
+        spr = self._slots_per_replica(sig)
+        per_replica = max(1e-9,
+                          spr * self.policy.target_utilization)
+        need = math.ceil(sig.backlog / per_replica)
+        if sig.finished_per_s > 0.0 and sig.slo_attainment \
+                < self.policy.slo_attainment_target:
+            need += self.policy.slo_bump_replicas
+        return need
+
+    def _forecast_target(self, sig: PoolSignal, now: float) -> int:
+        """Predicted near-term demand (Holt-Winters over the live
+        backlog series) converted to replicas; 0 when silent or
+        unconfident."""
+        if not self.policy.forecast:
+            return 0
+        chips_per = self._chips_per_replica(sig.shape_name)
+        last = self._last_sample.get(sig.pool)
+        if last is None or now - last >= self.policy.sample_seconds:
+            # The live arrival source: demand in chip terms, sampled
+            # on a fixed period so the bins mean something.  The queue
+            # term is BOUNDED by the occupancy: an under-provisioned
+            # pool's exploding queue is a symptom of lag, not of
+            # demand — unbounded it would poison the seasonal model
+            # with outliers and the forecaster would never earn
+            # confidence.
+            spr = self._slots_per_replica(sig)
+            demand_slots = sig.active + min(sig.queue_depth,
+                                            sig.active + spr)
+            demand_chips = int(round(
+                demand_slots / max(1e-9, spr) * chips_per))
+            # Series keyed by POOL (the forecaster's "class" slot):
+            # two pools sharing an accelerator class have independent
+            # day-shapes — one interleaved series would poison the
+            # seasonal model and hand each pool the other's forecast.
+            self._hw.note(sig.pool, sig.shape_name, now, demand_chips)
+            self._last_sample[sig.pool] = now
+        horizon = (self.policy.provision_estimate_seconds
+                   + self.policy.hw_bin_seconds)
+        for f in self._hw.forecasts(now):
+            if f.accel_class != sig.pool:
+                continue
+            if f.confidence < self.policy.min_confidence:
+                continue
+            if f.at - now > horizon:
+                continue
+            return math.ceil(
+                f.chips / (chips_per
+                           * self.policy.target_utilization))
+        return 0
+
+    def _advisory_gang(self, pool: str, shape_name: str) -> Gang:
+        from tpu_autoscaler.policy.engine import _probe_pod_payload
+
+        self._seq += 1
+        name = f"serve-{pool}-{self._seq}"
+        return Gang(
+            key=("serving", SERVING_NAMESPACE, name),
+            pods=[Pod(_probe_pod_payload(shape_name, name,
+                                         SERVING_NAMESPACE))])
+
+    # -- the pass ---------------------------------------------------------
+
+    def advise(self, statuses: Sequence[Any], now: float,
+               signals: Mapping[str, PoolSignal] | None = None
+               ) -> ServingAdvice:
+        """One pass: fold the adapter, advance scale-out lifecycles off
+        the actuator statuses, emit this pass's advisory demand."""
+        pol = self.policy
+        if signals is None:
+            self.adapter.fold(now)
+            signals = self.adapter.signals()
+        advice = ServingAdvice()
+
+        # ---- scale-out lifecycle off the actuator statuses -------------
+        by_key: dict[tuple, Any] = {}
+        for status in statuses:
+            key = getattr(status.request, "gang_key", None)
+            if key is not None and key and key[0] == "serving":
+                by_key[key] = status
+        for key, so in list(self._scaleouts.items()):
+            status = by_key.get(key)
+            if status is not None:
+                so.provision_id = status.id
+                if status.state == "ACTIVE" and so.active_at is None:
+                    so.active_at = now
+                elif status.state == "FAILED":
+                    # Keep the record: re-emission resumes and the
+                    # reconciler's per-key backoff paces the retry.
+                    so.provision_id = None
+            if so.expired(now, pol):
+                del self._scaleouts[key]
+
+        # Retire records their pool's replica census has caught up to:
+        # a joined replica satisfied the OLDEST outstanding request,
+        # whether its slice came from a provision or from the planner
+        # adopting a free slice (no actuator status in that case).
+        for pool in sorted(signals):
+            sig = signals[pool]
+            seen = self._replicas_seen.get(pool)
+            self._replicas_seen[pool] = sig.replicas
+            joined = sig.replicas - (seen if seen is not None
+                                     else sig.replicas)
+            if joined <= 0:
+                continue
+            mine = sorted(
+                (k for k, so in self._scaleouts.items()
+                 if so.pool == pool),
+                key=lambda k: self._scaleouts[k].created_at)
+            for key in mine[:joined]:
+                del self._scaleouts[key]
+
+        pending_by_pool: dict[str, int] = {}
+        for so in self._scaleouts.values():
+            pending_by_pool[so.pool] = pending_by_pool.get(so.pool,
+                                                           0) + 1
+
+        # ---- per-pool targets ------------------------------------------
+        total_replicas = 0.0
+        total_queue = 0.0
+        worst_attainment = 1.0
+        for pool in sorted(signals):
+            sig = signals[pool]
+            total_replicas += sig.replicas
+            total_queue += sig.queue_depth
+            if sig.finished_per_s > 0.0:
+                worst_attainment = min(worst_attainment,
+                                       sig.slo_attainment)
+            desired = max(self._pressure_target(sig),
+                          self._forecast_target(sig, now))
+            desired = min(max(desired, pol.min_replicas),
+                          pol.max_replicas)
+            advice.desired[pool] = desired
+            deficit = (desired - sig.replicas
+                       - pending_by_pool.get(pool, 0))
+            for _ in range(max(0, deficit)):
+                gang = self._advisory_gang(pool, sig.shape_name)
+                self._scaleouts[gang.key] = _ScaleOut(
+                    gang=gang, pool=pool, shape_name=sig.shape_name,
+                    created_at=now)
+                self._inc("serving_scaleouts")
+                log.info("serving scale-out decided: %s -> %d replicas "
+                         "(%s)", pool, desired, gang.key[2])
+            # Scale-in: deadband target (the fleet that would still be
+            # UNDER-utilized), persistence through the hysteresis
+            # window, and a per-decision step cap — all three guard
+            # against drain/provision thrash.
+            spr = self._slots_per_replica(sig)
+            floor_target = max(
+                desired, pol.min_replicas,
+                math.ceil(sig.backlog
+                          / max(1e-9,
+                                spr * pol.scalein_utilization)))
+            surplus = sig.replicas - floor_target \
+                - pending_by_pool.get(pool, 0)
+            if surplus > 0:
+                since = self._surplus_since.setdefault(pool, now)
+                if now - since >= pol.scalein_hold_seconds:
+                    step = max(1, sig.replicas // pol.scalein_step_div)
+                    advice.scale_in[pool] = min(surplus, step)
+                    self._inc("serving_scaleins",
+                              advice.scale_in[pool])
+                    self._surplus_since[pool] = now  # re-arm
+            else:
+                self._surplus_since.pop(pool, None)
+
+        # Pools whose census dropped to ZERO vanish from signals() —
+        # they must still be scalable from zero: min_replicas holds,
+        # and their stale scale-in hysteresis must not survive into a
+        # future reappearance (it would bypass the hold).
+        for pool in self.adapter.pools:
+            if pool in signals:
+                continue
+            self._surplus_since.pop(pool, None)
+            self._replicas_seen[pool] = 0
+            want = min(pol.min_replicas, pol.max_replicas)
+            if want <= 0:
+                continue
+            advice.desired[pool] = want
+            _accel, shape = self.adapter.pool_meta(pool)
+            for _ in range(max(0,
+                               want - pending_by_pool.get(pool, 0))):
+                gang = self._advisory_gang(pool, shape)
+                self._scaleouts[gang.key] = _ScaleOut(
+                    gang=gang, pool=pool, shape_name=shape,
+                    created_at=now)
+                self._inc("serving_scaleouts")
+                log.info("serving scale-from-zero: %s -> %d replicas",
+                         pool, want)
+
+        for so in self._scaleouts.values():
+            # A record whose provision went ACTIVE stops emitting
+            # demand (the slice exists; the replica is joining) but
+            # keeps counting toward ``pending`` through its grace —
+            # re-emitting would provision a SECOND slice the moment
+            # the first one's replica pod made it look busy.
+            if so.active_at is None:
+                advice.advisory.append((so.gang, so.shape_name))
+
+        self.set_gauge("serving_replicas", total_replicas)
+        self.set_gauge("serving_queue_depth", total_queue)
+        self.set_gauge("serving_slo_attainment", worst_attainment)
+        self.set_gauge("serving_desired_replicas",
+                    float(sum(advice.desired.values())))
+        self.set_gauge("serving_advisory_gangs", len(advice.advisory))
+        self.set_gauge("serving_pools", float(len(signals)))
+        return advice
+
+    # -- introspection ----------------------------------------------------
+
+    def debug_state(self) -> dict[str, Any]:
+        """JSON-able scale-out table (reconcile-thread callers only —
+        unlike /debugz readers, nothing copies this concurrently)."""
+        return {
+            "scaleouts": {
+                "/".join(k[1:]): {
+                    "pool": so.pool, "shape": so.shape_name,
+                    "created_at": so.created_at,
+                    "provision_id": so.provision_id,
+                    "active_at": so.active_at,
+                } for k, so in self._scaleouts.items()},
+            "replicas": self.adapter.replicas,
+        }

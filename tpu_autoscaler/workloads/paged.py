@@ -420,7 +420,8 @@ class PagedBatcher(ContinuousBatcher):
     def __init__(self, params, cfg: ModelConfig, *, slots: int = 4,
                  max_len: int = 256, block_size: int = 16,
                  num_blocks: int | None = None, chunk: int = 32,
-                 prefill_lanes: int = 2, mesh=None, key=None):
+                 prefill_lanes: int = 2, mesh=None, key=None,
+                 slo_ticks: int | None = None):
         if max_len % block_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"block_size {block_size}")
@@ -434,7 +435,8 @@ class PagedBatcher(ContinuousBatcher):
         self.prefill_lanes = prefill_lanes
         self.preemptions = 0
         super().__init__(params, cfg, slots=slots, max_len=max_len,
-                         chunk=chunk, mesh=mesh, key=key, ring=False)
+                         chunk=chunk, mesh=mesh, key=key, ring=False,
+                         slo_ticks=slo_ticks)
 
     def _build_device_state(self, cfg, slots, max_len, chunk, mesh,
                             ring) -> None:
@@ -548,6 +550,7 @@ class PagedBatcher(ContinuousBatcher):
         self._has_pending[i] = False
         self._release_slot(i)
         self.preemptions += 1
+        self._stats.note_preempt()
 
     # ---- engine loop ---------------------------------------------------
 
@@ -570,11 +573,18 @@ class PagedBatcher(ContinuousBatcher):
                 slot.remaining_prompt = np.asarray(req.prompt, np.int32)
                 slot.seeded = False
                 self._has_pending[i] = False
+                self._stats.note_admit()
                 self.cache = PagedKVCache(
                     k=self.cache.k, v=self.cache.v,
                     lengths=self.cache.lengths.at[i].set(0))
 
-    def tick(self) -> None:
+    def _kv_usage(self) -> tuple[int, int]:
+        """Pool-block accounting: the paged engine's real KV pressure
+        is allocator occupancy, not per-slot logical length."""
+        return (self.allocator.used_blocks * self.block_size,
+                self.allocator.num_blocks * self.block_size)
+
+    def _tick(self) -> None:
         """One engine step: admit, one BATCHED prefill over up to
         ``prefill_lanes`` slots still holding prompt, then one batched
         decode step for every slot with a pending token.  The two

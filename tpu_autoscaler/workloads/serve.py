@@ -15,6 +15,16 @@ line per request, in submission order:
 
     {"id": 0, "prompt_len": 3, "tokens": [..generated..], "done": true}
 
+followed by ONE machine-readable final-stats line (ISSUE 9 — the drain
+contract's receipt; reclaim tests assert ``unserved == 0`` from it
+instead of parsing a log line):
+
+    {"event": "final_stats", "served": N, "unserved": M,
+     "drained": bool, "request_latency_ticks": [...], "stats": {...}}
+
+``--final-stats PATH`` additionally writes the same object to a file
+(the autoscaler side of a reclaim can collect it after exit).
+
 Model flags must match the training run (shared block in _cli.py);
 ``--ring`` turns on the O(window) ring cache for windowed models.
 """
@@ -31,6 +41,29 @@ log = logging.getLogger(__name__)
 
 
 from tpu_autoscaler.workloads._cli import model_arch_options, model_config
+
+
+def final_stats_payload(reqs, engine, elapsed_s: float) -> dict:
+    """The drain contract's machine-readable receipt: what was served,
+    what was not, per-request latencies, and the engine's final stats
+    snapshot — everything a reclaim test needs to assert that no
+    queued request was lost."""
+    latencies = [
+        (r.finished_tick - r.submitted_tick
+         if r.done and r.finished_tick is not None
+         and r.submitted_tick is not None else None)
+        for r in reqs]
+    return {
+        "event": "final_stats",
+        "served": sum(1 for r in reqs if r.done),
+        "unserved": sum(1 for r in reqs if not r.done),
+        "drained": bool(getattr(engine, "draining", False)),
+        "elapsed_s": round(elapsed_s, 3),
+        "ticks": engine.ticks,
+        "decode_tokens": engine.decode_tokens,
+        "request_latency_ticks": latencies,
+        "stats": engine.stats().as_dict(),
+    }
 
 
 @click.command()
@@ -76,6 +109,12 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
                    "data, KV heads + cache over 'model' (the trainer's "
                    "TP layout).  Default: single-device.")
 @click.option("--seed", default=0, show_default=True)
+@click.option("--final-stats", "final_stats_file", default=None,
+              help="Also write the final-stats JSON (the drain "
+                   "contract's receipt: served/unserved counts, "
+                   "per-request latencies, engine stats) to this "
+                   "path; it is always printed as the last stdout "
+                   "line.")
 @click.option("--annotations-file", default=None,
               help="Downward-API annotations path for the drain "
                    "contract (default: the standard "
@@ -88,9 +127,10 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
          max_len, chunk, ring, paged, block_size, num_blocks, spec_k,
-         draft_layers, tp_degree, seed, annotations_file, vocab,
-         seq_len, d_model, n_layers, n_kv_heads, attention_window,
-         no_rope, moe_experts, moe_top_k, platform):
+         draft_layers, tp_degree, seed, final_stats_file,
+         annotations_file, vocab, seq_len, d_model, n_layers,
+         n_kv_heads, attention_window, no_rope, moe_experts, moe_top_k,
+         platform):
     """Serve mixed-length requests from the latest checkpoint."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -285,10 +325,19 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
         log.info("speculative: accept_rate %.3f, target_pass_ratio "
                  "%.3f (plain decode = 1.0)", engine.accept_rate,
                  engine.target_pass_ratio)
+    # The drain contract's machine-readable receipt (ISSUE 9): always
+    # the LAST stdout line, so the reclaim side can assert zero lost
+    # requests without parsing logs.
+    final = final_stats_payload(reqs, engine, dt)
+    print(json.dumps(final))
+    if final_stats_file:
+        with open(final_stats_file, "w", encoding="utf-8") as f:
+            json.dump(final, f, indent=2)
+            f.write("\n")
     if engine.draining:
-        unserved = sum(1 for r in reqs if not r.done)
         log.info("drain requested: in-flight sequences completed, %d "
-                 "queued requests unserved; exiting cleanly", unserved)
+                 "queued requests unserved; exiting cleanly",
+                 final["unserved"])
 
 
 if __name__ == "__main__":

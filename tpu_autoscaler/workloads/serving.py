@@ -40,6 +40,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_autoscaler.serving.stats import (
+    ServingSnapshot,
+    ServingStatsRecorder,
+)
 from tpu_autoscaler.workloads.decode import _sample
 from tpu_autoscaler.workloads.model import (
     ModelConfig,
@@ -456,6 +460,11 @@ class Request:
     # Filled by the engine:
     generated: list = dataclasses.field(default_factory=list)
     done: bool = False
+    # Engine ticks at submission/completion (stats: latency in ticks —
+    # submitted_tick is preserved across preemption re-queues, so a
+    # preempted request's latency counts from its ORIGINAL submit).
+    submitted_tick: int | None = None
+    finished_tick: int | None = None
 
 
 @dataclasses.dataclass
@@ -483,11 +492,16 @@ class ContinuousBatcher:
 
     def __init__(self, params, cfg: ModelConfig, *, slots: int = 4,
                  max_len: int = 256, chunk: int = 32, mesh=None,
-                 key=None, ring: bool = False):
+                 key=None, ring: bool = False,
+                 slo_ticks: int | None = None):
         """``ring=True`` (needs cfg.attention_window): per-slot cache
         HBM becomes O(window + chunk) instead of O(max_len), and
         sequences may run PAST max_len — max_len then only bounds the
-        per-request budget check, not the buffer."""
+        per-request budget check, not the buffer.
+
+        ``slo_ticks``: completions within this many engine ticks of
+        submission count as SLO-attained in ``stats()`` (None = no
+        target)."""
         if mesh is not None:
             # Re-place the params onto THIS mesh's TP layout: restored
             # checkpoints arrive committed to the shardings they were
@@ -516,6 +530,14 @@ class ContinuousBatcher:
         self._key = key if key is not None else jax.random.PRNGKey(0)
         self.ticks = 0
         self.decode_tokens = 0
+        # Signal export (ISSUE 9, serving/stats.py): fixed numpy rings
+        # written from the host-side bookkeeping this scheduler already
+        # does — the decode path never pays a device sync for it.
+        # _stat_lengths mirrors cache.lengths host-side (admission
+        # resets it, prefill/decode advance it) so KV occupancy never
+        # reads a jax.Array.
+        self._stats = ServingStatsRecorder(slots, slo_ticks=slo_ticks)
+        self._stat_lengths = np.zeros(slots, np.int64)
 
         # Device-side batched sampling (the hot path): greedy rows take
         # argmax, temperature rows sample categorically at their own
@@ -585,6 +607,8 @@ class ContinuousBatcher:
                 f"padded to chunk {self.chunk} multiples, + "
                 f"{request.max_new_tokens} new tokens) but max_len is "
                 f"{self.max_len}")
+        if request.submitted_tick is None:
+            request.submitted_tick = self.ticks
         self._queue.append(request)
 
     @property
@@ -602,6 +626,8 @@ class ContinuousBatcher:
                 slot.remaining_prompt = np.asarray(req.prompt, np.int32)
                 slot.seeded = False
                 self._has_pending[i] = False
+                self._stats.note_admit()
+                self._stat_lengths[i] = 0
                 # Reset the slot: stale cache beyond every future write
                 # point is invisible by construction.
                 self.cache = SlotKVCache(
@@ -626,11 +652,48 @@ class ContinuousBatcher:
                 req.eos_id is not None and req.generated
                 and req.generated[-1] == req.eos_id):
             req.done = True
+            req.finished_tick = self.ticks
             slot.request = None
             slot.remaining_prompt = None
             self._has_pending[i] = False
+            # The DEVICE keeps the stale cache until readmission (by
+            # design), but the exported KV signal tracks LIVE
+            # sequences — a freed slot stops counting now, or an idle
+            # engine would report its historical peak forever.
+            self._stat_lengths[i] = 0
+            self._stats.note_finish(
+                self.ticks - (req.submitted_tick or 0))
+
+    def _kv_usage(self) -> tuple[int, int]:
+        """(live KV token-slots, capacity), host-side only.  Ring
+        caches hold at most the buffer width per slot regardless of
+        logical length; PagedBatcher overrides with pool-block
+        accounting."""
+        width = self.cache.max_len
+        used = int(np.minimum(self._stat_lengths, width).sum())
+        return used, self._stat_lengths.size * width
+
+    def stats(self) -> ServingSnapshot:
+        """O(1) export of this engine's serving signals (ISSUE 9):
+        queue depth, admissions/preemptions, token throughput, KV
+        occupancy, per-request SLO attainment — the autoscaler's
+        metrics-adapter feed (serving/adapter.py)."""
+        return self._stats.snapshot()
 
     def tick(self) -> None:
+        """One engine step (then close the stats tick — every engine
+        variant's ``_tick`` runs under this wrapper, so export never
+        depends on which scheduler loop ran)."""
+        self._tick()
+        used, cap = self._kv_usage()
+        self._stats.end_tick(
+            queue_depth=len(self._queue),
+            active=sum(1 for s in self._slots
+                       if s.request is not None),
+            kv_used=used, kv_capacity=cap,
+            decode_tokens_total=self.decode_tokens)
+
+    def _tick(self) -> None:
         """One engine step: admit, at most one prefill chunk, then one
         batched decode step for every slot with a pending token."""
         self._admit()
@@ -649,6 +712,7 @@ class ContinuousBatcher:
             logits, self.cache = self._prefill(
                 self.params, self.cache, jnp.int32(i), jnp.asarray(buf),
                 jnp.int32(take))
+            self._stat_lengths[i] += take
             if len(slot.remaining_prompt) == 0:
                 # Prompt complete: sample the first generated token.
                 tok = self._sample_host(np.asarray(logits), slot.request)
@@ -670,6 +734,7 @@ class ContinuousBatcher:
         logits, self.cache = self._decode(
             self.params, self.cache, jnp.asarray(self._pending_token),
             jnp.asarray(self._has_pending))
+        self._stat_lengths[self._has_pending] += 1
         # Sample ON DEVICE for rows without truncation knobs; only the
         # [slots] token ids come back to host (EOS checks/output need
         # them anyway).  Truncated rows re-sample their own logits row

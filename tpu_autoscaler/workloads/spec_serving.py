@@ -101,7 +101,7 @@ class SpeculativePagedBatcher(PagedBatcher):
                  slots: int = 4, max_len: int = 256,
                  block_size: int = 16, num_blocks: int | None = None,
                  chunk: int = 32, prefill_lanes: int = 2, mesh=None,
-                 key=None, seed: int = 0):
+                 key=None, seed: int = 0, slo_ticks: int | None = None):
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         if k >= chunk:
@@ -122,7 +122,7 @@ class SpeculativePagedBatcher(PagedBatcher):
         super().__init__(params, cfg, slots=slots, max_len=max_len,
                          block_size=block_size, num_blocks=num_blocks,
                          chunk=chunk, prefill_lanes=prefill_lanes,
-                         mesh=mesh, key=key)
+                         mesh=mesh, key=key, slo_ticks=slo_ticks)
 
     # ---- device state ---------------------------------------------------
 
@@ -184,6 +184,13 @@ class SpeculativePagedBatcher(PagedBatcher):
         self.d_cache = PagedKVCache(
             k=self.d_cache.k, v=self.d_cache.v,
             lengths=self.d_cache.lengths.at[i].set(0))
+
+    def _kv_usage(self) -> tuple[int, int]:
+        """Target pool plus the mirrored draft pool: both are real HBM
+        pressure the autoscaler's KV-occupancy signal should see."""
+        t_used, t_cap = super()._kv_usage()
+        return (t_used + self.d_allocator.used_blocks * self.block_size,
+                t_cap + self.d_allocator.num_blocks * self.block_size)
 
     def check_accounting(self) -> None:
         super().check_accounting()
