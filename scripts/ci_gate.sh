@@ -27,6 +27,10 @@
 #      diurnal+spike millions-of-users replay where signal-driven
 #      scaling must beat pod-pending reactive tail SLO attainment;
 #      BENCH_SERVING.json — ISSUE 9, docs/SERVING.md)
+#   10 obs tier (bench.py obs: TSDB+alert marginal per-pass cost
+#      within max(5% of the traced-only observe pass, 0.5 ms),
+#      10k-series ingest + alert-evaluation under their ms gates;
+#      BENCH_OBS.json — ISSUE 10, docs/OBSERVABILITY.md)
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -36,26 +40,26 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/8] invariant analysis (--format=$fmt)"
+echo "== [1/9] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/8] mypy strict islands"
+echo "== [2/9] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/8] deterministic-schedule race tier"
+echo "== [3/9] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
 
-echo "== [4/8] tracer-overhead gate"
+echo "== [4/9] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [5/8] mega-cluster scale tiers"
+echo "== [5/9] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [6/8] generative chaos corpora (200 mixed + 200 policy + 200 serving)"
+echo "== [6/9] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -72,11 +76,19 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 300 --profile policy || exit 7
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 300 --profile serving || exit 7
+# The alert e2e gate (ISSUE 10): regression seeds must fire the
+# burn-rate alert inside the driven phase and resolve after the fault
+# window; quiet seeds must produce ZERO false-positive firings.
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 300 --profile alerts || exit 7
 
-echo "== [7/8] policy replay tier"
+echo "== [7/9] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [8/8] serving tier (adapter hot path + outcome replay)"
+echo "== [8/9] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
+
+echo "== [9/9] obs tier (TSDB ingest + alert evaluation)"
+JAX_PLATFORMS=cpu python bench.py obs || exit 10
 
 echo "CI GATE GREEN"

@@ -62,6 +62,12 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 # counter resets must never yield negative rates, per step.
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 300 --profile serving
+# Alerts profile (ISSUE 10): the burn-rate alert gate — injected
+# scale-up-latency regressions must fire the alert inside the driven
+# phase and resolve after the fault window; quiet seeds must stay
+# silent (zero false positives) — docs/CHAOS.md, OBSERVABILITY.md.
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 300 --profile alerts
 
 # Policy replay tier (ISSUE 8): the recurring north-star trace must
 # show prewarmed detect->running <= 0.25x the reactive baseline, and a
@@ -83,6 +89,13 @@ JAX_PLATFORMS=cpu python bench.py serving
 # decision tracer attached must stay within 5% of untraced (ISSUE 5 —
 # instrumentation can never silently eat the PR-2/PR-3 wins).
 JAX_PLATFORMS=cpu python bench.py trace
+
+# Obs tier (ISSUE 10): TSDB+alert marginal per-pass cost within
+# max(5% of the traced-only observe pass, 0.5 ms absolute);
+# 10k-series per-pass ingest + alert-evaluation cost under their ms
+# gates; results merge into BENCH_OBS.json (docs/OBSERVABILITY.md
+# "Overhead gates").
+JAX_PLATFORMS=cpu python bench.py obs
 
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py

@@ -1,0 +1,664 @@
+"""SLO burn-rate alerting + black-box capture tests (ISSUE 10):
+alert-engine units (every rule kind, hysteresis), the Reconciler's
+crash-only ``_alerts_pass`` wiring (gauges, pass records, notifier,
+automatic bundle capture), black-box file discipline (atomic, unique,
+bounded, rate-limited), and the e2e gate — a chaos seed with an
+injected scale-up-latency regression fires, a captured bundle replays
+offline to the same firing decision."""
+
+import json
+import os
+
+import pytest
+from click.testing import CliRunner
+
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.main import cli
+from tpu_autoscaler.metrics import Metrics
+from tpu_autoscaler.obs import AlertEngine, AlertRule, BlackBox
+from tpu_autoscaler.obs.__main__ import main as obs_main
+from tpu_autoscaler.obs.alerts import default_rules
+from tpu_autoscaler.obs.blackbox import (
+    load_bundle,
+    unique_dump_path,
+    write_atomic,
+)
+from tpu_autoscaler.obs.tsdb import TimeSeriesDB
+
+
+def burn_rule(**kw):
+    base = dict(name="burn", metric="lat_seconds", kind="burn_rate",
+                slo_bound=10.0, objective=0.9, fast_window=60.0,
+                slow_window=300.0, burn_threshold=2.0, for_passes=2,
+                clear_passes=3)
+    base.update(kw)
+    return AlertRule(**base)
+
+
+def feed(db, metrics, t):
+    db.ingest(metrics.snapshot(), t)
+
+
+class TestAlertEngine:
+    def make(self, rule):
+        m = Metrics()
+        m.declare_histogram("lat_seconds", (1.0, 10.0, 100.0))
+        return AlertEngine((rule,)), TimeSeriesDB(), m
+
+    def test_burn_rule_fires_and_resolves_with_hysteresis(self):
+        eng, db, m = self.make(burn_rule())
+        t = 0.0
+        for _ in range(10):  # healthy traffic
+            m.observe("lat_seconds", 2.0)
+            feed(db, m, t)
+            assert eng.evaluate(db, t).transitions == ()
+            t += 5.0
+        # Regression: one miss per pass.  Burn needs the miss fraction
+        # over BOTH windows to clear 2x the 10% budget, then
+        # for_passes=2 consecutive breaches — so firing takes a few
+        # miss passes (bounded) and NEVER happens on the first.
+        fired_after = None
+        for k in range(1, 10):
+            m.observe("lat_seconds", 50.0)
+            feed(db, m, t)
+            r = eng.evaluate(db, t)
+            t += 5.0
+            if any(tr.firing for tr in r.transitions):
+                fired_after = k
+                break
+        assert fired_after is not None and fired_after >= 2
+        st = eng.state_of("burn")
+        assert st.fired_count == 1 and st.fired_at == t - 5.0
+        assert eng.firing() == ("burn",)
+        # Recovery: resolves only after the miss ages out of BOTH
+        # windows and clear_passes clean evaluations accrue.
+        resolved_at = None
+        for _ in range(200):
+            t += 5.0
+            m.observe("lat_seconds", 2.0)
+            feed(db, m, t)
+            for tr in eng.evaluate(db, t).transitions:
+                assert not tr.firing
+                resolved_at = tr.t
+            if resolved_at is not None:
+                break
+        assert resolved_at is not None
+        assert not eng.firing()
+        # No new observations at all must also resolve (total below
+        # min_events is "no verdict", never "still firing").  Note
+        # the first feed anchors the birth baseline (birth is not a
+        # jump from 0), so misses count from the second feed on.
+        eng2, db2, m2 = self.make(burn_rule())
+        m2.observe("lat_seconds", 50.0)
+        feed(db2, m2, 0.0)
+        eng2.evaluate(db2, 0.0)
+        for i in (5.0, 10.0):
+            m2.observe("lat_seconds", 50.0)
+            feed(db2, m2, i)
+            eng2.evaluate(db2, i)
+        assert eng2.firing() == ("burn",)
+        tt = 15.0
+        while eng2.firing() and tt < 2000.0:
+            feed(db2, m2, tt)
+            eng2.evaluate(db2, tt)
+            tt += 5.0
+        assert not eng2.firing()
+
+    def test_burn_needs_both_windows(self):
+        # A miss burst old enough to leave the fast window but not the
+        # slow one must NOT fire (multi-window AND semantics).
+        eng, db, m = self.make(burn_rule(for_passes=1))
+        m.observe("lat_seconds", 50.0)
+        feed(db, m, 0.0)
+        # Advance past the fast window with no new traffic: fast total
+        # is 0 → no verdict → never fires.
+        for i in range(1, 40):
+            feed(db, m, float(i) * 5.0)
+            assert eng.evaluate(db, float(i) * 5.0).transitions == ()
+        assert not eng.firing()
+
+    def test_rate_rule(self):
+        rule = AlertRule(name="wr", metric="watch_failures",
+                         kind="rate", window=60.0, threshold=0.05,
+                         for_passes=2, clear_passes=2)
+        eng = AlertEngine((rule,))
+        db = TimeSeriesDB()
+        m = Metrics()
+        m.inc("watch_failures", 0)
+        for i in range(5):
+            feed(db, m, float(i) * 5.0)
+            eng.evaluate(db, float(i) * 5.0)
+        assert not eng.firing()
+        t = 25.0
+        for _ in range(8):  # 1 failure per 5 s ≈ 0.2/s > 0.05/s
+            m.inc("watch_failures")
+            feed(db, m, t)
+            eng.evaluate(db, t)
+            t += 5.0
+        assert eng.firing() == ("wr",)
+        while eng.firing() and t < 1000.0:
+            feed(db, m, t)
+            eng.evaluate(db, t)
+            t += 5.0
+        assert not eng.firing()
+
+    def test_gauge_below_rule(self):
+        rule = AlertRule(name="slo", metric="serving_slo_attainment",
+                         kind="gauge_below", window=30.0, threshold=0.9,
+                         for_passes=2, clear_passes=2)
+        eng = AlertEngine((rule,))
+        db = TimeSeriesDB()
+        m = Metrics()
+        m.set_gauge("serving_slo_attainment", 0.99)
+        t = 0.0
+        for _ in range(5):
+            feed(db, m, t)
+            eng.evaluate(db, t)
+            t += 5.0
+        assert not eng.firing()
+        m.set_gauge("serving_slo_attainment", 0.5)
+        for _ in range(10):
+            feed(db, m, t)
+            eng.evaluate(db, t)
+            t += 5.0
+        assert eng.firing() == ("slo",)
+
+    def test_pass_duration_rule(self):
+        rule = AlertRule(name="pd", metric="reconcile_seconds",
+                         kind="pass_duration", window=60.0,
+                         threshold=0.1, for_passes=2, clear_passes=2)
+        eng = AlertEngine((rule,))
+        db = TimeSeriesDB()
+        m = Metrics()
+        t = 0.0
+        for _ in range(5):
+            m.observe("reconcile_seconds", 0.01)
+            feed(db, m, t)
+            eng.evaluate(db, t)
+            t += 5.0
+        assert not eng.firing()
+        for _ in range(5):
+            m.observe("reconcile_seconds", 0.5)
+            feed(db, m, t)
+            eng.evaluate(db, t)
+            t += 5.0
+        assert eng.firing() == ("pd",)
+
+    def test_misconfigured_slo_bound_never_false_fires(self):
+        # Review-found: a slo_bound matching no declared histogram
+        # bucket means the :le: series never exists; treating the
+        # missing series as "zero good events" paged a guaranteed
+        # false positive on every healthy observation.  No verdict
+        # instead — visible as last_value staying None.
+        eng, db, m = self.make(burn_rule(slo_bound=7.0,  # not a bucket
+                                         for_passes=1))
+        t = 0.0
+        for _ in range(20):
+            m.observe("lat_seconds", 0.5)  # every scale-up healthy
+            feed(db, m, t)
+            assert eng.evaluate(db, t).transitions == ()
+            t += 5.0
+        assert not eng.firing()
+        assert eng.state_of("burn").last_value is None
+
+    def test_rules_roundtrip_debug_state(self):
+        eng = AlertEngine()
+        eng2 = AlertEngine.from_debug_state(eng.debug_state())
+        assert [r.name for r in eng2.rules] == [r.name for r in eng.rules]
+        assert eng2.rules == eng.rules
+
+    def test_duplicate_rule_names_rejected(self):
+        with pytest.raises(ValueError):
+            AlertEngine((burn_rule(), burn_rule()))
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            AlertRule(name="x", metric="m", kind="nope")
+
+    def test_default_rules_reference_known_metric_names(self):
+        # The AlertDocChecker (TAO603) gates this repo-wide; keep a
+        # direct unit anyway: names must be non-empty and unique.
+        rules = default_rules()
+        assert len({r.name for r in rules}) == len(rules)
+        assert all(r.metric for r in rules)
+
+
+def make_controller(tmp_path=None, rules=None, **cfg_kw):
+    kube = FakeKube()
+    actuator = FakeActuator(kube, provision_delay=0.0)
+    blackbox = None
+    controller = Controller(
+        kube, actuator, ControllerConfig(**cfg_kw),
+        alert_engine=AlertEngine(rules) if rules is not None else None)
+    if tmp_path is not None:
+        blackbox = BlackBox(str(tmp_path), controller.incident_bundle,
+                            min_interval_seconds=0.0,
+                            metrics=controller.metrics)
+        controller.blackbox = blackbox
+    return kube, actuator, controller
+
+
+class TestReconcilerWiring:
+    def test_alert_gauges_exported_zero_from_start(self):
+        _, _, controller = make_controller()
+        gauges = controller.metrics.snapshot()["gauges"]
+        for rule in controller.alerts.rules:
+            name = ("tpu_autoscaler_alerts_active_"
+                    + rule.name.replace("-", "_"))
+            assert gauges[name] == 0.0
+
+    def test_pass_ingests_and_records_alert_transitions(self, tmp_path):
+        rule = AlertRule(name="pd", metric="reconcile_seconds",
+                         kind="pass_duration", window=1e6,
+                         threshold=-1.0,  # every pass breaches
+                         for_passes=2, clear_passes=1000)
+        notes = []
+
+        class Notes:
+            def notify(self, message):
+                notes.append(message)
+
+        kube, _, controller = make_controller(tmp_path, rules=(rule,))
+        controller.notifier = Notes()
+        # Pass 1 anchors the birth baseline; passes 2-3 breach and
+        # clear the for_passes=2 hysteresis.
+        controller.reconcile_once(now=0.0)
+        assert not controller.alerts.firing()
+        controller.reconcile_once(now=5.0)
+        controller.reconcile_once(now=10.0)
+        assert controller.alerts.firing() == ("pd",)
+        snap = controller.metrics.snapshot()
+        assert snap["gauges"]["tpu_autoscaler_alerts_active_pd"] == 1.0
+        assert snap["counters"]["alerts_fired"] == 1
+        assert any("alert pd FIRING" in n for n in notes)
+        # The firing pass's decision record carries the transition.
+        passes = controller.recorder.dump()["passes"]
+        assert passes[-1]["alerts"] == {"active": ["pd"]}
+        assert any(e.get("decision") == "alert firing"
+                   for e in passes[-1]["events"])
+        # The TSDB retained the pass history behind the verdict.
+        assert controller.tsdb.value_at("reconcile_seconds:count",
+                                        5.0) == 2.0
+        assert any(e.get("decision") == "incident capture scheduled"
+                   for e in passes[-1]["events"])
+        # The automatic black-box capture runs on a throwaway thread
+        # (a pass must never pay the serialization): poll for the
+        # atomically-renamed bundle + its success counter.
+        import time as _time
+
+        deadline = _time.time() + 5.0
+        bundles = []
+        while _time.time() < deadline:
+            bundles = [p for p in os.listdir(tmp_path)
+                       if p.endswith(".json")]
+            if bundles and controller.metrics.snapshot()[
+                    "counters"].get("incident_bundles_written"):
+                break
+            _time.sleep(0.02)
+        assert len(bundles) == 1
+        body = load_bundle(str(tmp_path / bundles[0]))
+        assert body["bundle"]["reason"] == "alert:pd"
+        assert body["alerts"]["state"]["pd"]["firing"]
+        assert controller.metrics.snapshot()["counters"][
+            "incident_bundles_written"] == 1
+
+    def test_broken_engine_degrades_not_aborts(self):
+        class Boom:
+            rules = (burn_rule(),)
+
+            def evaluate(self, tsdb, now):
+                raise RuntimeError("alert bug")
+
+        kube, _, controller = make_controller()
+        controller.alerts = Boom()
+        controller.reconcile_once(now=0.0)  # must not raise
+        snap = controller.metrics.snapshot()
+        assert snap["counters"]["alert_eval_errors"] == 1
+
+    def test_broken_tsdb_degrades_not_aborts(self):
+        kube, _, controller = make_controller()
+
+        def boom(snapshot, now):
+            raise RuntimeError("tsdb bug")
+
+        controller.tsdb.ingest = boom
+        controller.reconcile_once(now=0.0)
+        assert controller.metrics.snapshot()["counters"][
+            "tsdb_errors"] == 1
+
+    def test_no_alerts_engine_skips_evaluation(self):
+        kube = FakeKube()
+        controller = Controller(kube, FakeActuator(kube),
+                                ControllerConfig(),
+                                alert_engine=AlertEngine(rules=()))
+        controller.reconcile_once(now=0.0)
+        snap = controller.metrics.snapshot()
+        assert "alerts_fired" not in snap["counters"]
+        # TSDB ingest still runs (history is independent of alerting).
+        assert controller.tsdb.series_count() > 0
+
+    def test_debug_dump_and_bundle_shapes(self):
+        _, _, controller = make_controller()
+        controller.reconcile_once(now=0.0)
+        dump = controller.debug_dump()
+        assert "alerts" in dump and "state" in dump["alerts"]
+        bundle = controller.incident_bundle("unit-test")
+        assert bundle["bundle"]["reason"] == "unit-test"
+        assert bundle["tsdb"]["series_count"] > 0
+        assert bundle["config"]["default_generation"]
+        # Strict-JSON clean (allow_nan=False contract).
+        json.dumps(bundle, default=str, allow_nan=False)
+
+    def test_tsdb_route_filters(self):
+        _, _, controller = make_controller()
+        for t in (0.0, 5.0, 10.0):
+            controller.reconcile_once(now=t)
+        body = controller.tsdb_route({"prefix": "reconcile_seconds",
+                                      "window": "7"})
+        assert body["series"]
+        assert all(n.startswith("reconcile_seconds")
+                   for n in body["series"])
+        for tiers in body["series"].values():
+            assert all(t >= 3.0 for t, _v in tiers["raw"])
+        # Bad window value degrades to unfiltered, never 500s.
+        assert controller.tsdb_route({"window": "bogus"})["series"]
+
+
+class TestBlackBox:
+    def test_unique_paths_same_second(self):
+        paths = {unique_dump_path("/tmp/x", now=123.0)
+                 for _ in range(50)}
+        assert len(paths) == 50
+
+    def test_atomic_write_leaves_no_tmp(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        write_atomic(path, {"ok": 1})
+        assert json.load(open(path)) == {"ok": 1}
+        assert os.listdir(tmp_path) == ["b.json"]
+
+    def test_rate_limit_and_force(self, tmp_path):
+        clock = iter([0.0, 1.0, 2.0, 400.0, 401.0]).__next__
+        box = BlackBox(str(tmp_path), lambda: {"x": 1}, clock=clock,
+                       min_interval_seconds=300.0)
+        assert box.capture("alert:a") is not None
+        assert box.capture("alert:a") is None          # limited
+        assert box.capture("alert:a", force=True) is not None
+        assert box.capture("alert:a") is not None      # window passed
+        assert box.captured == 3
+
+    def test_bounded_retention_prunes_oldest(self, tmp_path):
+        times = iter(float(i * 1000) for i in range(10))
+        box = BlackBox(str(tmp_path), lambda: {"x": 1},
+                       clock=times.__next__, min_interval_seconds=0.0,
+                       max_bundles=3)
+        for i in range(6):
+            box.capture(f"r{i}")
+        names = [p for p in os.listdir(tmp_path) if p.endswith(".json")]
+        assert len(names) == 3
+
+    def test_capture_async_dedups_in_flight(self, tmp_path):
+        import threading
+        import time as _time
+
+        release = threading.Event()
+
+        def slow():
+            release.wait(5.0)
+            return {"ok": 1}
+
+        box = BlackBox(str(tmp_path), slow, min_interval_seconds=0.0)
+        assert box.capture_async("r") is True
+        assert box.capture_async("r") is False  # same reason in flight
+        release.set()
+        deadline = _time.time() + 5.0
+        while _time.time() < deadline and box.captured < 1:
+            _time.sleep(0.02)
+        assert box.captured == 1
+        assert box.capture_async("r") is True  # slot free again
+
+    def test_capture_failure_counted_not_raised(self, tmp_path):
+        def boom():
+            raise RuntimeError("dump bug")
+
+        box = BlackBox(str(tmp_path), boom, min_interval_seconds=0.0)
+        assert box.capture("r") is None
+        assert box.errors == 1
+
+    def test_failed_capture_does_not_consume_rate_limit(self, tmp_path):
+        # Review-found: the rate-limit slot was taken BEFORE the
+        # write, so a transient failure suppressed the retry for the
+        # whole interval — losing the incident's one artifact.
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient disk-full")
+            return {"ok": 1}
+
+        clock = iter([0.0, 1.0, 2.0]).__next__
+        box = BlackBox(str(tmp_path), flaky, clock=clock,
+                       min_interval_seconds=300.0)
+        assert box.capture("alert:a") is None       # failed write
+        assert box.capture("alert:a") is not None   # retry allowed
+        assert box.capture("alert:a") is None       # NOW limited
+        assert box.captured == 1 and box.errors == 1
+
+
+class TestEndToEndReplay:
+    """The ISSUE 10 acceptance path: a chaos seed with an injected
+    scale-up-latency regression fires the burn-rate alert within a
+    bounded number of passes and resolves after the fault window; the
+    captured bundle replays offline to the same firing decision."""
+
+    def _regression_seed(self):
+        from tpu_autoscaler.chaos.scenario import generate
+
+        for seed in range(40):
+            p = generate(seed, profile="alerts")
+            if any(e.kind == "latency_regression" for e in p.events):
+                return p
+        raise AssertionError("no regression seed in the first 40")
+
+    def test_regression_fires_resolves_and_replays(self, tmp_path):
+        from tpu_autoscaler.chaos.engine import ALERT_RULE, _Run
+
+        program = self._regression_seed()
+        run = _Run(program)
+        result = run.execute()
+        assert result.ok, result.violations
+        st = run.controller.alerts.state_of(ALERT_RULE)
+        assert st.fired_count >= 1
+        assert st.fired_at is not None \
+            and st.fired_at <= program.until  # bounded: driven phase
+        assert not st.firing  # resolved after the fault window
+        # Capture a bundle from the live controller and replay it.
+        path = str(tmp_path / "bundle.json")
+        write_atomic(path, run.controller.incident_bundle("test"))
+        rc = obs_main(["replay", path, "-q"])
+        assert rc == 0
+
+    def test_quiet_seed_stays_silent(self):
+        from tpu_autoscaler.chaos.engine import ALERT_RULE, _Run
+        from tpu_autoscaler.chaos.scenario import generate
+
+        for seed in range(40):
+            program = generate(seed, profile="alerts")
+            if not any(e.kind == "latency_regression"
+                       for e in program.events):
+                break
+        run = _Run(program)
+        result = run.execute()
+        assert result.ok, result.violations
+        assert run.controller.alerts.state_of(
+            ALERT_RULE).fired_count == 0
+
+    def test_replay_detects_tampered_state(self, tmp_path):
+        from tpu_autoscaler.chaos.engine import _Run
+
+        program = self._regression_seed()
+        run = _Run(program)
+        run.execute()
+        bundle = run.controller.incident_bundle("test")
+        # Claim the alert never fired: replay must call the lie out.
+        for st in bundle["alerts"]["state"].values():
+            st["firing"] = True
+        path = str(tmp_path / "tampered.json")
+        write_atomic(path, bundle)
+        assert obs_main(["replay", path, "-q"]) == 2
+
+    def test_replay_detects_denied_firing(self, tmp_path):
+        # Review-found: the divergence check must cut BOTH ways — a
+        # bundle claiming the rule never fired while offline
+        # evaluation fires (and resolves) over the same passes is
+        # divergence, not "reproduced".
+        from tpu_autoscaler.chaos.engine import _Run
+
+        program = self._regression_seed()
+        run = _Run(program)
+        run.execute()
+        bundle = run.controller.incident_bundle("test")
+        for st in bundle["alerts"]["state"].values():
+            st["firing"] = False
+            st["fired_at"] = None
+            st["fired_count"] = 0
+        path = str(tmp_path / "denied.json")
+        write_atomic(path, bundle)
+        assert obs_main(["replay", path, "-q"]) == 2
+
+    def test_replay_plain_dump_degrades(self, tmp_path):
+        _, _, controller = make_controller()
+        controller.reconcile_once(now=0.0)
+        path = str(tmp_path / "plain.json")
+        write_atomic(path, controller.debug_dump())
+        del_keys = load_bundle(path)
+        assert "tsdb" not in del_keys
+        assert obs_main(["replay", path]) == 0  # renders, skips alerts
+
+    def test_replay_rejects_future_bundle_version(self, tmp_path):
+        path = str(tmp_path / "future.json")
+        write_atomic(path, {"bundle": {"version": 99}})
+        assert obs_main(["replay", path]) == 1
+
+
+class TestCli:
+    def _dump_file(self, tmp_path):
+        _, _, controller = make_controller()
+        for t in (0.0, 5.0, 10.0):
+            controller.reconcile_once(now=t)
+        path = str(tmp_path / "bundle.json")
+        write_atomic(path, controller.incident_bundle("cli-test"))
+        return path
+
+    def test_metrics_history_lists_series(self, tmp_path):
+        path = self._dump_file(tmp_path)
+        result = CliRunner().invoke(cli, ["metrics-history",
+                                          "--from", path])
+        assert result.exit_code == 0, result.output
+        assert "series retained" in result.output
+        assert "reconcile_seconds:count" in result.output
+
+    def test_metrics_history_renders_one_series(self, tmp_path):
+        path = self._dump_file(tmp_path)
+        result = CliRunner().invoke(cli, [
+            "metrics-history", "--from", path,
+            "reconcile_seconds:count"])
+        assert result.exit_code == 0, result.output
+        assert "raw (" in result.output
+
+    def test_metrics_history_from_file_applies_window(self, tmp_path):
+        # Review-found: --window was silently ignored in the --from
+        # branch (only the --url branch filtered, server-side).
+        path = self._dump_file(tmp_path)
+        full = CliRunner().invoke(cli, [
+            "metrics-history", "--from", path,
+            "reconcile_seconds:count", "--points", "100"])
+        windowed = CliRunner().invoke(cli, [
+            "metrics-history", "--from", path,
+            "reconcile_seconds:count", "--points", "100",
+            "--window", "5"])
+        assert windowed.exit_code == 0, windowed.output
+        assert "t=0 " not in windowed.output
+        assert len(windowed.output) < len(full.output)
+
+    def test_metrics_history_unknown_series_lists_known(self, tmp_path):
+        path = self._dump_file(tmp_path)
+        result = CliRunner().invoke(cli, [
+            "metrics-history", "--from", path, "nope"])
+        assert result.exit_code != 0
+        assert "not retained" in result.output
+
+    def test_debugz_url_normalization(self):
+        from tpu_autoscaler.main import _debugz_url
+
+        # Bare host:port, with/without scheme, trailing slash.
+        assert _debugz_url("h:9090", "/debugz") == "http://h:9090/debugz"
+        assert _debugz_url("http://h:9090/", "/debugz/tsdb") \
+            == "http://h:9090/debugz/tsdb"
+        # The URL form trace/explain accept must work for the tsdb
+        # endpoint too (review-found: yielded /debugz/debugz/tsdb).
+        assert _debugz_url("http://h:9090/debugz", "/debugz/tsdb") \
+            == "http://h:9090/debugz/tsdb"
+        assert _debugz_url("h:9090/debugz/tsdb", "/debugz/tsdb") \
+            == "http://h:9090/debugz/tsdb"
+        assert _debugz_url("h:9090", "/debugz/tsdb",
+                           {"prefix": "x"}) \
+            == "http://h:9090/debugz/tsdb?prefix=x"
+
+    def test_run_help_lists_new_flags(self):
+        result = CliRunner().invoke(cli, ["run", "--help"])
+        assert result.exit_code == 0
+        for flag in ("--recorder-spans", "--recorder-passes",
+                     "--no-alerts", "--incident-dir"):
+            assert flag in result.output
+
+    def test_recorder_capacity_flags_wire_through(self):
+        from tpu_autoscaler.sim import seed_scenario
+
+        from tpu_autoscaler.main import _build
+
+        kube = FakeKube()
+        actuator = FakeActuator(kube, provision_delay=0.0)
+        controller = _build(
+            kube, actuator, sleep=5.0, idle_threshold=1800.0,
+            grace_period=300.0, drain_grace=120.0,
+            utilization_threshold=0.0, gang_settle=0.0,
+            provision_timeout=900.0, preemption=False, spare_agents=0,
+            spare_slices=(), namespace_quotas=(), over_provision=0,
+            default_generation="v5e", generation_fallbacks=(),
+            cpu_machine_type="e2-standard-8", max_cpu_nodes=100,
+            max_total_chips=4096, preemptible=False, fair_share=False,
+            no_scale=False, no_maintenance=False, enable_policy=False,
+            policy_min_confidence=0.6, policy_waste_budget=120000.0,
+            policy_early_reclaim=False, slack_hook=None,
+            slack_channel=None, metrics_port=0, recorder_spans=32,
+            recorder_passes=16, no_alerts=False, incident_dir=None,
+            log_json=False, verbose=False)
+        assert controller.recorder._spans.maxlen == 32
+        assert controller.recorder._passes.maxlen == 16
+        seed_scenario(kube, "v5e-8")
+        controller.reconcile_once(now=0.0)
+        assert controller.alerts.rules  # default catalog attached
+
+    def test_no_alerts_flag_disables_engine(self):
+        from tpu_autoscaler.main import _build
+
+        kube = FakeKube()
+        controller = _build(
+            kube, FakeActuator(kube), sleep=5.0, idle_threshold=1800.0,
+            grace_period=300.0, drain_grace=120.0,
+            utilization_threshold=0.0, gang_settle=0.0,
+            provision_timeout=900.0, preemption=False, spare_agents=0,
+            spare_slices=(), namespace_quotas=(), over_provision=0,
+            default_generation="v5e", generation_fallbacks=(),
+            cpu_machine_type="e2-standard-8", max_cpu_nodes=100,
+            max_total_chips=4096, preemptible=False, fair_share=False,
+            no_scale=False, no_maintenance=False, enable_policy=False,
+            policy_min_confidence=0.6, policy_waste_budget=120000.0,
+            policy_early_reclaim=False, slack_hook=None,
+            slack_channel=None, metrics_port=0, recorder_spans=4096,
+            recorder_passes=512, no_alerts=True, incident_dir=None,
+            log_json=False, verbose=False)
+        assert controller.alerts.rules == ()

@@ -1282,6 +1282,165 @@ class TestMetricsDocChecker:
         assert self.checker().applies_to("tpu_autoscaler/obs/trace.py")
 
 
+class TestAlertDocChecker:
+    """TAO603-605: alert-rule / runbook / metric drift (ISSUE 10),
+    the same both-directions contract as TAO601/602."""
+
+    DOC = textwrap.dedent("""\
+        # Operations runbook
+
+        ## Alert catalog
+
+        | Alert | Metric | Condition | Runbook |
+        |---|---|---|---|
+        | `latency-burn` | `lat_seconds` | burn. | here. |
+        | `queue-floor` | `depth` | below. | here. |
+
+        ## Another section
+
+        | `not-an-alert` | x | Tables elsewhere are not the contract. |
+        """)
+
+    #: The catalog module: the ONLY file whose AlertRule calls define
+    #: the operator catalog.
+    ALERTS = "tpu_autoscaler/obs/alerts.py"
+    #: Full-package sentinel for metric-existence (TAO603).
+    SENTINEL = "tpu_autoscaler/metrics/metrics.py"
+
+    RULES = """
+        def default_rules():
+            return (
+                AlertRule(name="latency-burn", metric="lat_seconds",
+                          kind="burn_rate"),
+                AlertRule(name="queue-floor", metric="depth",
+                          kind="gauge_below"),
+            )
+    """
+
+    #: Exports every metric the fixture rules reference.
+    EMITTERS = """
+        def _emit(m):
+            m.observe("lat_seconds", 1.0)
+            m.set_gauge("depth", 2)
+    """
+
+    def run(self, rules=None, doc=None, emitters=None, sentinel=True):
+        from tpu_autoscaler.analysis import AlertDocChecker
+
+        files = [SourceFile(
+            "<alerts>", self.ALERTS,
+            textwrap.dedent(self.RULES if rules is None else rules))]
+        files.append(SourceFile(
+            "<emitters>", "tpu_autoscaler/mod.py",
+            textwrap.dedent(self.EMITTERS if emitters is None
+                            else emitters)))
+        if sentinel:
+            files.append(SourceFile("<sentinel>", self.SENTINEL, ""))
+        checker = AlertDocChecker(
+            doc_text=self.DOC if doc is None else doc)
+        return checker.check_program(files)
+
+    def test_documented_rules_with_real_metrics_pass(self):
+        assert self.run() == []
+
+    def test_rule_watching_unexported_metric_fails_tao603(self):
+        found = self.run(rules="""
+            def default_rules():
+                return (AlertRule(name="latency-burn",
+                                  metric="lat_seconds",
+                                  kind="burn_rate"),
+                        AlertRule(name="queue-floor",
+                                  metric="ghost_metric",
+                                  kind="gauge_below"))
+        """)
+        assert codes_of(found) == ["TAO603"]
+        assert "ghost_metric" in found[0].message
+        assert found[0].file == self.ALERTS
+
+    def test_metric_existence_skipped_without_full_view(self):
+        found = self.run(rules="""
+            def default_rules():
+                return (AlertRule(name="latency-burn",
+                                  metric="ghost_metric",
+                                  kind="burn_rate"),
+                        AlertRule(name="queue-floor", metric="depth",
+                                  kind="gauge_below"))
+        """, sentinel=False)
+        assert codes_of(found) == []  # absence proves nothing here
+
+    def test_rule_matching_dynamic_family_passes(self):
+        found = self.run(rules="""
+            def default_rules():
+                return (AlertRule(name="latency-burn",
+                                  metric="lat_seconds",
+                                  kind="burn_rate"),
+                        AlertRule(name="queue-floor",
+                                  metric="depth_web",
+                                  kind="gauge_below"))
+        """, emitters="""
+            def _emit(m, pool):
+                m.observe("lat_seconds", 1.0)
+                m.set_gauge(f"depth_{pool}", 2)
+        """)
+        assert found == []
+
+    def test_undocumented_rule_fails_tao604(self):
+        found = self.run(rules=self.RULES + """
+        EXTRA = AlertRule(name="mystery-alert", metric="lat_seconds",
+                          kind="burn_rate")
+        """)
+        assert codes_of(found) == ["TAO604"]
+        assert "mystery-alert" in found[0].message
+
+    def test_dead_doc_alert_fails_tao605(self):
+        found = self.run(rules="""
+            def default_rules():
+                return (AlertRule(name="latency-burn",
+                                  metric="lat_seconds",
+                                  kind="burn_rate"),)
+        """)
+        assert codes_of(found) == ["TAO605"]
+        assert "queue-floor" in found[0].message
+        assert found[0].file == "docs/OPERATIONS.md"
+
+    def test_foreign_alertrule_reference_does_not_mask_tao603(self):
+        # Review-found: a chaos-scale AlertRule elsewhere referencing
+        # the same (renamed-away) metric must not count as an export
+        # and silence the catalog rule's TAO603.
+        found = self.run(rules="""
+            def default_rules():
+                return (AlertRule(name="latency-burn", metric="ghost",
+                                  kind="burn_rate"),
+                        AlertRule(name="queue-floor", metric="depth",
+                                  kind="gauge_below"))
+        """, emitters="""
+            def _emit(m):
+                m.set_gauge("depth", 2)
+            CHAOS = AlertRule(name="latency-burn", metric="ghost",
+                              kind="burn_rate")
+        """)
+        assert codes_of(found) == ["TAO603"]
+        assert "ghost" in found[0].message
+
+    def test_rules_outside_catalog_module_ignored(self):
+        # The chaos engine builds scenario-scale AlertRule instances;
+        # they are instruments, not the catalog.
+        found = self.run(emitters=self.EMITTERS + """
+        CHAOS = AlertRule(name="chaos-only", metric="lat_seconds",
+                          kind="burn_rate")
+        """)
+        assert found == []
+
+    def test_tables_outside_alert_section_ignored(self):
+        found = self.run()
+        assert all("not-an-alert" not in f.message for f in found)
+
+    def test_empty_input_no_findings(self):
+        from tpu_autoscaler.analysis import AlertDocChecker
+
+        assert AlertDocChecker(doc_text=self.DOC).check_program([]) == []
+
+
 class TestRepoIsClean:
     def test_repo_passes_own_linter(self):
         baseline_path = os.path.join(

@@ -198,6 +198,35 @@ class TestEndToEndTrace:
         assert "provision submitted" in text
         assert "digest=" in text
 
+    def test_debugz_tsdb_route_serves_history(self):
+        # ISSUE 10: /debugz/tsdb rides the same port, with query-
+        # string prefix/window filtering handled server-side.
+        controller, _dump = self._scaleup_dump()
+        controller.metrics.serve(
+            0, debugz=controller.debug_dump,
+            routes={"/debugz/tsdb": controller.tsdb_route})
+        port = controller.metrics.bound_port
+        deadline = time.time() + 5
+        body = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debugz/tsdb"
+                        f"?prefix=scale_up_latency_seconds") as r:
+                    body = r.read().decode()
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert body is not None
+        served = json.loads(body)
+        assert served["series_count"] > 0
+        assert served["series"]
+        assert all(n.startswith("scale_up_latency_seconds")
+                   for n in served["series"])
+        # The north-star latency history is queryable from the wire.
+        counts = served["series"]["scale_up_latency_seconds:count"]
+        assert counts["raw"][-1][1] >= 1.0
+
     def test_debugz_and_cli_render_the_trace(self, tmp_path):
         controller, dump = self._scaleup_dump()
         # -- /debugz next to /metrics --------------------------------
@@ -394,12 +423,36 @@ class TestSigusr1Dump:
             deadline = time.time() + 5
             written = []
             while time.time() < deadline and not written:
+                # Atomic-write discipline: the dump appears only via
+                # rename — a reader must never see (or open) the .tmp.
                 written = [p for p in os.listdir(tmp_path)
-                           if p.startswith("dump")]
+                           if p.startswith("dump")
+                           and not p.endswith(".tmp")]
                 time.sleep(0.02)
             assert written
             with open(tmp_path / written[0]) as f:
                 assert json.load(f) == {"ok": 1}
+        finally:
+            signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+    def test_two_dumps_same_second_never_clobber(self, tmp_path):
+        # ISSUE 10 satellite: the old fixed `prefix-<epoch>.json` name
+        # meant a second dump in the same second overwrote the first —
+        # exactly the double-capture an incident produces.
+        prefix = str(tmp_path / "dump")
+        seen = []
+        assert install_sigusr1(lambda: {"n": len(seen)},
+                               path_prefix=prefix)
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            os.kill(os.getpid(), signal.SIGUSR1)
+            deadline = time.time() + 5
+            while time.time() < deadline and len(seen) < 2:
+                seen = [p for p in os.listdir(tmp_path)
+                        if p.startswith("dump")
+                        and not p.endswith(".tmp")]
+                time.sleep(0.02)
+            assert len(seen) == 2, seen
         finally:
             signal.signal(signal.SIGUSR1, signal.SIG_DFL)
 

@@ -103,6 +103,15 @@ class FakeActuator:
         ``error`` — a zonal stockout window.  One window at a time."""
         self._fail_window = (start, end, error)
 
+    def set_provision_delay(self, delay: float) -> None:
+        """Change the provisioning delay mid-run (ISSUE 10 chaos
+        latency-regression knob).  ``poll`` compares elapsed time
+        against the CURRENT delay, so raising it stalls in-flight
+        provisions too — and restoring it releases them on the next
+        poll: a regression window's length is exactly the injected
+        latency."""
+        self._delay = delay
+
     def fail_in_flight(self, error: str = "chaos: provisioning aborted "
                                           "(out of capacity)") -> None:
         """Doom every currently in-flight provision (mid-provision

@@ -20,7 +20,10 @@ from tpu_autoscaler.analysis.core import (
 from tpu_autoscaler.analysis.escape import EscapeRaceChecker
 from tpu_autoscaler.analysis.exceptions import ExceptionHygieneChecker
 from tpu_autoscaler.analysis.jaxpurity import JaxPurityChecker
-from tpu_autoscaler.analysis.metricsdoc import MetricsDocChecker
+from tpu_autoscaler.analysis.metricsdoc import (
+    AlertDocChecker,
+    MetricsDocChecker,
+)
 from tpu_autoscaler.analysis.purity import PurityChecker
 from tpu_autoscaler.analysis.threads import ThreadDisciplineChecker
 
@@ -30,10 +33,12 @@ def default_checkers() -> list[Checker]:
     # interprocedural TAR5xx pass cannot resolve (docs/ANALYSIS.md).
     return [PurityChecker(), ThreadDisciplineChecker(),
             ExceptionHygieneChecker(), JaxPurityChecker(),
-            EscapeRaceChecker(), MetricsDocChecker()]
+            EscapeRaceChecker(), MetricsDocChecker(),
+            AlertDocChecker()]
 
 
 __all__ = [
+    "AlertDocChecker",
     "AnalysisResult",
     "Checker",
     "EscapeRaceChecker",

@@ -1,4 +1,4 @@
-"""Metrics/runbook drift checker (TAO6xx).
+"""Metrics/runbook drift checkers (TAO6xx).
 
 docs/OPERATIONS.md's "Metrics to alert on" table is the operator
 contract for every series the controller exports — but nothing kept it
@@ -11,6 +11,20 @@ checker closes the loop in both directions:
   the package does not appear in the runbook table;
 - **TAO602** — a runbook table entry matches no metric in the code
   (dead documentation — worse than none: operators alert on it).
+
+ISSUE 10 extends the same both-directions contract to the alert
+catalog (:class:`AlertDocChecker`): every ``AlertRule`` declared in
+``obs/alerts.py`` must reference an exported metric family and appear
+in the runbook's "Alert catalog" table, and every documented alert row
+must match a declared rule:
+
+- **TAO603** — a rule's ``metric=`` matches no exported metric family
+  (the alert can never fire: it watches a series nobody emits);
+- **TAO604** — a rule declared in ``obs/alerts.py`` has no row in the
+  runbook's alert catalog (operators get paged by an alert with no
+  runbook);
+- **TAO605** — a documented alert row matches no declared rule (dead
+  runbook: operators trust an alert that no longer exists).
 
 Dynamic names are matched by family: code like
 ``f"namespace_chips_used_{ns}"`` is documented as
@@ -46,6 +60,13 @@ _METRIC_METHODS = frozenset({
 
 #: The runbook section that IS the metrics contract.
 _DOC_SECTION = "## Metrics to alert on"
+
+#: The runbook section that IS the alert contract (ISSUE 10), and the
+#: one module whose ``AlertRule(...)`` calls define the catalog (the
+#: chaos engine builds scenario-scale rules too — those are test
+#: instruments, not the operator catalog, and stay out of scope).
+_ALERT_SECTION = "## Alert catalog"
+_ALERTS_MODULE = "tpu_autoscaler/obs/alerts.py"
 
 _DEFAULT_DOC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
@@ -138,10 +159,21 @@ class MetricsDocChecker(ProgramChecker):
                         and node.args):
                     args.append(node.args[0])
                 # Tracer span→histogram feeds: metric="name" keywords
-                # (obs/trace.py record/end) count as exports too.
-                for kw in node.keywords:
-                    if kw.arg == "metric":
-                        args.append(kw.value)
+                # (obs/trace.py record/end) count as exports too —
+                # EXCEPT on AlertRule(...) constructions, whose
+                # metric= is a REFERENCE to a family exported
+                # elsewhere: counting it would let a rule watching a
+                # renamed-away metric mask its own TAO603 (and fake
+                # a TAO601/602 export).
+                is_alert_rule = (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "AlertRule") or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "AlertRule")
+                if not is_alert_rule:
+                    for kw in node.keywords:
+                        if kw.arg == "metric":
+                            args.append(kw.value)
                 for arg in args:
                     site = (src.rel_path, arg.lineno)
                     if isinstance(arg, ast.Constant) \
@@ -212,4 +244,125 @@ class MetricsDocChecker(ProgramChecker):
                 doc_rel, lineno, "TAO602",
                 f"documented metric family '{prefix}<...>' matches "
                 f"nothing in the code"))
+        return findings
+
+
+class AlertDocChecker(ProgramChecker):
+    """Every declared alert rule watches a real metric and has a
+    runbook row; every runbook row names a real rule (ISSUE 10 — the
+    TAO601/602 contract extended to the alert catalog)."""
+
+    name = "alert-doc"
+    codes = {
+        "TAO603": "alert rule references a metric family the code "
+                  "never exports",
+        "TAO604": "alert rule missing from docs/OPERATIONS.md "
+                  "'Alert catalog'",
+        "TAO605": "documented alert matches no rule in obs/alerts.py",
+    }
+
+    def __init__(self, doc_path: str | None = None,
+                 doc_text: str | None = None) -> None:
+        self._doc_path = doc_path or _DEFAULT_DOC
+        self._doc_text = doc_text  # tests inject the table directly
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.startswith("tpu_autoscaler/")
+
+    def _doc_alerts(self) -> tuple[dict[str, int], str]:
+        """Alert names from backticked tokens in the first column of
+        the 'Alert catalog' table -> line."""
+        if self._doc_text is not None:
+            text = self._doc_text
+        else:
+            try:
+                with open(self._doc_path, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                return {}, "docs/OPERATIONS.md"
+        out: dict[str, int] = {}
+        in_section = False
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if line.startswith("## "):
+                in_section = line.strip() == _ALERT_SECTION
+                continue
+            if not in_section or not line.startswith("|"):
+                continue
+            first_cell = line.split("|")[1] if line.count("|") >= 2 else ""
+            for token in re.findall(r"`([^`]+)`", first_cell):
+                token = token.strip()
+                if token and token not in ("Alert", "---"):
+                    out.setdefault(token, lineno)
+        return out, "docs/OPERATIONS.md"
+
+    @staticmethod
+    def _declared_rules(files: list[SourceFile]
+                        ) -> dict[str, tuple[str, int, int]]:
+        """``AlertRule(name=..., metric=...)`` literals in the catalog
+        module: name -> (metric, line of the call, line of metric)."""
+        out: dict[str, tuple[str, int, int]] = {}
+        for src in files:
+            if src.rel_path != _ALERTS_MODULE:
+                continue
+            for node in ast.walk(src.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "AlertRule"):
+                    continue
+                name = metric = None
+                metric_line = node.lineno
+                for kw in node.keywords:
+                    if kw.arg == "name" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        name = kw.value.value
+                    elif kw.arg == "metric" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        metric = kw.value.value
+                        metric_line = kw.value.lineno
+                if name is not None and metric is not None:
+                    out.setdefault(name, (metric, node.lineno,
+                                          metric_line))
+        return out
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
+        if not files:
+            return []
+        rules = self._declared_rules(files)
+        doc_alerts, doc_rel = self._doc_alerts()
+        # _code_metrics already excludes every AlertRule(metric=...)
+        # kwarg from the export set (a rule's reference — in this
+        # module or anywhere else, e.g. chaos-scale rules — must
+        # never satisfy its own TAO603).
+        code_exact, code_prefixes, _ = \
+            MetricsDocChecker._code_metrics(files)
+        findings: list[Finding] = []
+        # Metric-existence needs the whole package in view (the rule's
+        # family may be exported anywhere); same sentinel as TAO602.
+        full_view = any(
+            s.rel_path == "tpu_autoscaler/metrics/metrics.py"
+            for s in files)
+        for name, (metric, line, metric_line) in sorted(rules.items()):
+            if full_view and metric not in code_exact \
+                    and not any(metric.startswith(p)
+                                for p in code_prefixes):
+                findings.append(Finding(
+                    _ALERTS_MODULE, metric_line, "TAO603",
+                    f"alert rule '{name}' watches metric '{metric}', "
+                    f"which the code never exports"))
+            if name not in doc_alerts:
+                findings.append(Finding(
+                    _ALERTS_MODULE, line, "TAO604",
+                    f"alert rule '{name}' has no row in {doc_rel} "
+                    f"'{_ALERT_SECTION[3:]}'"))
+        # Dead-doc-row findings need the catalog module in view.
+        if not any(s.rel_path == _ALERTS_MODULE for s in files):
+            return findings
+        for name, lineno in sorted(doc_alerts.items()):
+            if name not in rules:
+                findings.append(Finding(
+                    doc_rel, lineno, "TAO605",
+                    f"documented alert '{name}' matches no AlertRule "
+                    f"in {_ALERTS_MODULE}"))
         return findings

@@ -299,6 +299,36 @@ def _policy_engine(program: ScenarioProgram):
         hw_bin_seconds=30.0, hw_season_bins=8))
 
 
+#: The alerts profile's rule name (the gate's assertion target) and
+#: its chaos-scale parameters: the production catalog's burn rule with
+#: windows shrunk to scenario timescales.  slo_bound stays 360 s — the
+#: north-star budget — with quiet-alphabet scale-ups bounded well
+#: under it (provision_delay <= 60 s + a <= 60 s brownout stall).
+ALERT_RULE = "scaleup-latency-burn"
+
+
+def _alert_engine(program: ScenarioProgram):
+    """Chaos-scale AlertEngine for the ``alerts`` profile: ONLY the
+    burn rule, so the corpus verdict (fires on regression seeds,
+    silent on quiet seeds) is about exactly one instrument.  Window
+    and hysteresis timing comes from scenario.py's ALERTS_* constants
+    — generate() derives the driven phase's resolution slack from the
+    SAME numbers.  Other profiles keep the Controller's default
+    engine (prod windows; evaluated, not asserted)."""
+    if not program.alerts:
+        return None
+    from tpu_autoscaler.chaos import scenario as sc
+    from tpu_autoscaler.obs import AlertEngine, AlertRule
+
+    return AlertEngine((AlertRule(
+        name=ALERT_RULE, metric="scale_up_latency_seconds",
+        kind="burn_rate", slo_bound=360.0, objective=0.9,
+        fast_window=sc.ALERTS_FAST_WINDOW,
+        slow_window=sc.ALERTS_SLOW_WINDOW, burn_threshold=2.0,
+        min_events=1, for_passes=sc.ALERTS_FOR_PASSES,
+        clear_passes=sc.ALERTS_CLEAR_PASSES),))
+
+
 def _serving_scaler(program: ScenarioProgram):
     """Chaos-scale ServingScaler over a fresh adapter: small fleet
     cap, short record TTLs (a scenario is minutes, not hours),
@@ -335,12 +365,18 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
                               max_total_chips=program.max_total_chips),
             grace_seconds=30.0, idle_threshold_seconds=120.0,
             drain_grace_seconds=20.0, provision_retry_seconds=30.0,
-            provision_timeout_seconds=150.0,
+            # The alerts profile stalls provisions for up to ~480 s
+            # (latency_regression windows); the stuck-provision
+            # cancel must not race the injected latency or the
+            # regression never yields a COMPLETED slow scale-up.
+            provision_timeout_seconds=(900.0 if program.alerts
+                                       else 150.0),
             unhealthy_timeout_seconds=120.0,
             slice_repair_after_seconds=30.0),
         informer=informer,
         policy_engine=_policy_engine(program),
-        serving_scaler=_serving_scaler(program))
+        serving_scaler=_serving_scaler(program),
+        alert_engine=_alert_engine(program))
     return controller, actuator
 
 
@@ -385,6 +421,9 @@ class _Run:
         self.arrived: set[str] = set()
         self.passes = 0
         self.reconcile_errors = 0
+        #: Open latency-regression window end (ISSUE 10 alerts
+        #: profile); provisions stall until it closes.
+        self._regression_until: float | None = None
         import random
 
         self.rng = random.Random(program.seed ^ 0xC0FFEE)
@@ -529,6 +568,12 @@ class _Run:
                 if event.args["mode"] == "delete":
                     self.monitor.injected_deletes.add(victim)
                 self.actuator.fail_host(victim, event.args["mode"])
+        elif kind == "latency_regression":
+            # Stall every provision until the window closes (the
+            # window length IS the injected scale-up latency); _step
+            # restores the program's delay at the window end.
+            self._regression_until = t + event.args["duration"]
+            self.actuator.set_provision_delay(1e9)
         elif self.serving_fuzz is not None and kind in (
                 "replica_restart", "counter_reset", "stale_burst",
                 "replica_churn"):
@@ -567,6 +612,11 @@ class _Run:
 
     def _step(self, t: float, events, completions: bool = True) -> None:
         self.proxy.set_now(t)
+        if self._regression_until is not None \
+                and t >= self._regression_until:
+            self.actuator.set_provision_delay(
+                self.program.provision_delay)
+            self._regression_until = None
         for event in events:
             self._apply_event(event, t)
         self._arrivals(t)
@@ -593,6 +643,43 @@ class _Run:
         self.monitor.after_pass(t)
         if self.serving_fuzz is not None:
             self.serving_fuzz.check(t)
+
+    def _check_alerts(self, t: float) -> None:
+        """The ISSUE 10 alert gate, asserted at terminal: an injected
+        scale-up-latency regression must have FIRED the burn-rate
+        alert inside the driven phase (a bounded number of passes —
+        hysteresis is ``for_passes`` evaluations past the first
+        in-window miss) and RESOLVED once the fault window aged out of
+        the burn windows; a quiet seed must never have fired it (the
+        zero-false-positive half)."""
+        engine = self.controller.alerts
+        st = engine.state_of(ALERT_RULE)
+        regression = any(e.kind == "latency_regression"
+                         for e in self.program.events)
+        if regression:
+            if st.fired_count < 1:
+                self.monitor._fail(
+                    t, "alert-fires-on-regression",
+                    "injected scale-up-latency regression never fired "
+                    "the burn-rate alert")
+                return
+            if st.fired_at is not None \
+                    and st.fired_at > self.program.until:
+                self.monitor._fail(
+                    t, "alert-fires-bounded",
+                    f"burn-rate alert fired at t={st.fired_at:g}, "
+                    f"after the driven phase ended "
+                    f"({self.program.until:g})")
+            if st.firing:
+                self.monitor._fail(
+                    t, "alert-resolves-after-fault",
+                    "burn-rate alert still firing at terminal, past "
+                    "the fault window")
+        elif st.fired_count:
+            self.monitor._fail(
+                t, "alert-quiet-corpus-silent",
+                f"burn-rate alert fired {st.fired_count}x on a quiet "
+                f"seed (false positive)")
 
     def execute(self) -> ChaosResult:
         t0 = _time.perf_counter()
@@ -641,6 +728,8 @@ class _Run:
         self.monitor.check_terminal(
             t, self.live_jobs, converged=converged_at is not None,
             reclaim_window=reclaim_window)
+        if self.program.alerts:
+            self._check_alerts(t)
         snap = self.controller.metrics.snapshot()
         return ChaosResult(
             seed=program.seed,

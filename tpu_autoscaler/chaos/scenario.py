@@ -45,10 +45,24 @@ GANG_SHAPES = ("v5e-8", "v5e-16", "v5e-32", "v5p-16")
 #: judged (every generated event fires before ``until - QUIET_TAIL``).
 QUIET_TAIL = 300.0
 
+#: Chaos-scale burn-rule timing for the ``alerts`` profile.  The
+#: engine builds its AlertRule FROM these (engine._alert_engine), and
+#: ``generate`` derives the post-regression resolution slack from the
+#: same numbers — widening the rule's windows can never silently
+#: outrun the driven phase and fail the resolves-at-terminal
+#: invariant.
+ALERTS_FAST_WINDOW = 120.0
+ALERTS_SLOW_WINDOW = 600.0
+ALERTS_FOR_PASSES = 2
+ALERTS_CLEAR_PASSES = 3
+
 #: Known profiles (docs/CHAOS.md; ``policy`` is ISSUE 8, ``serving``
 #: is ISSUE 9 — fuzz the serving metrics-adapter path under the mixed
-#: fault alphabet).
-PROFILES = ("mixed", "faults", "api", "repair", "policy", "serving")
+#: fault alphabet; ``alerts`` is ISSUE 10 — the SLO burn-rate alert
+#: gate: injected scale-up-latency regressions must fire the alert
+#: and resolve, quiet seeds must stay silent).
+PROFILES = ("mixed", "faults", "api", "repair", "policy", "serving",
+            "alerts")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +116,13 @@ class ScenarioProgram:
     # step invariant: counter resets must NEVER yield negative rates,
     # and the incremental pool sums must match a from-scratch rebuild.
     serving: bool = False
+    # ISSUE 10: run with the chaos-scale burn-rate AlertEngine
+    # attached.  ~Half the seeds carry a ``latency_regression`` event
+    # (provisions stall until the window closes → scale-up latencies
+    # blow the SLO bound); the terminal invariant asserts the alert
+    # FIRED and RESOLVED on those seeds and NEVER fired on the quiet
+    # ones (the zero-false-positive half of the gate).
+    alerts: bool = False
 
     def describe(self) -> str:
         kinds: dict[str, int] = {}
@@ -116,6 +137,11 @@ class ScenarioProgram:
             tags.append("policy")
         if self.serving:
             tags.append("serving")
+        if self.alerts:
+            tags.append("alerts")
+            tags.append("regression" if any(
+                e.kind == "latency_regression" for e in self.events)
+                else "quiet")
         tagtxt = f" [{'+'.join(tags)}]" if tags else ""
         return (f"seed={self.seed} jobs={len(self.workloads)} "
                 f"({'/'.join(w.shape for w in self.workloads)}){tagtxt} "
@@ -188,7 +214,12 @@ def generate(seed: int, *, profile: str = "mixed",
             shape=rng_ms.choice(("v5e-8", "v5e-16")),
             jobset_slices=2)
 
-    api_chaos = profile in ("mixed", "api", "policy", "serving")
+    # ``alerts`` keeps the quiet API alphabet only: supply-side faults
+    # (stockouts, host failures) produce legitimately slow scale-ups —
+    # true positives — and the quiet half of the alert gate needs
+    # latency guaranteed under the SLO bound.
+    api_chaos = profile in ("mixed", "api", "policy", "serving",
+                            "alerts")
     fault_chaos = profile in ("mixed", "faults", "repair", "policy",
                               "serving")
     events: list[Event] = []
@@ -237,6 +268,41 @@ def generate(seed: int, *, profile: str = "mixed",
                                 {"add": rng.randint(0, 2),
                                  "remove": rng.randint(0, 2)}))
 
+    regression_end = 0.0
+    if profile == "alerts":
+        # ISSUE 10 (new profile: derived rng stream, shifts no legacy
+        # seed program).  ~Half the seeds inject a scale-up-latency
+        # regression: provisions submitted inside the window stall
+        # until it closes (engine applies the delay via
+        # set_provision_delay), so the window length IS the injected
+        # latency — sized to blow the 360 s SLO bound.  A dedicated
+        # workload arrives just inside the window so every regression
+        # seed is guaranteed a provision riding it.
+        rng_al = random.Random(seed ^ 0xA1E27)
+        if rng_al.random() < 0.5:
+            start = rng_al.uniform(60.0, 140.0)
+            # Injected latency ≈ duration − (arrival offset ≤ 25 s)
+            # − (a brownout may hide the pending gang ≤ 60 s) − pass
+            # granularity; the floor keeps the worst case STRICTLY
+            # above the 360 s bound (a miss exactly ON the `le`
+            # bucket bound counts as good — chaos-found, seed 119).
+            duration = rng_al.uniform(475.0, 545.0)
+            events.append(Event(start, "latency_regression",
+                                {"duration": duration}))
+            # The lag gang's shape must differ from every other
+            # workload's: a same-shape slice freed by a completing
+            # job would serve it WITHOUT a provision (bind-only
+            # scale-up, no injected miss — chaos-found at seed 79).
+            used = {w.shape for w in workloads}
+            fresh = [s for s in ("v5e-8", "v5e-16", "v5e-32", "v5p-16")
+                     if s not in used]
+            workloads.append(Workload(
+                job=f"chaos-{seed}-lag",
+                shape=rng_al.choice(fresh),
+                arrival=start + rng_al.uniform(5.0, 25.0),
+                pinned=True))
+            regression_end = start + duration
+
     events.sort(key=lambda e: e.t)
     last = max([e.t + e.args.get("duration", 0.0) for e in events],
                default=0.0)
@@ -246,6 +312,14 @@ def generate(seed: int, *, profile: str = "mixed",
         [w.arrival + (w.repeat + 1) * (w.repeat_gap + 120.0)
          for w in workloads if w.repeat > 0], default=0.0)
     until = max(last, repeats_span, 120.0) + QUIET_TAIL
+    if regression_end:
+        # The burn alert must RESOLVE before the terminal check: keep
+        # the driven phase open until the miss ages out of the slow
+        # burn window plus clear-hysteresis passes (5 s step) and a
+        # few passes of slack — all derived from the rule constants.
+        resolve_slack = (ALERTS_SLOW_WINDOW
+                         + (ALERTS_CLEAR_PASSES + 7) * 5.0)
+        until = max(until, regression_end + resolve_slack + QUIET_TAIL)
     return ScenarioProgram(
         seed=seed, step=5.0, until=until, settle=600.0,
         workloads=tuple(workloads), events=tuple(events),
@@ -254,4 +328,5 @@ def generate(seed: int, *, profile: str = "mixed",
         stagger_seconds=rng.choice((0.0, 0.0, 5.0)),
         max_total_chips=rng.choice((256, 1024)),
         policy=(profile == "policy"),
-        serving=(profile == "serving"))
+        serving=(profile == "serving"),
+        alerts=(profile == "alerts"))

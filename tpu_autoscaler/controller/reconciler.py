@@ -37,7 +37,13 @@ from tpu_autoscaler.k8s.objects import (
 )
 from tpu_autoscaler.metrics import Metrics
 from tpu_autoscaler.notify import LogNotifier, Notifier
-from tpu_autoscaler.obs import FlightRecorder, Span, Tracer
+from tpu_autoscaler.obs import (
+    AlertEngine,
+    FlightRecorder,
+    Span,
+    TimeSeriesDB,
+    Tracer,
+)
 from tpu_autoscaler.state import SliceState, SliceTracker, classify_slice
 from tpu_autoscaler.state.tracker import DRAIN_ANNOTATION
 
@@ -170,7 +176,10 @@ class Controller:
                  informer=None, executor=None,
                  tracer: Tracer | None = None,
                  recorder: FlightRecorder | None = None,
-                 policy_engine=None, serving_scaler=None):
+                 policy_engine=None, serving_scaler=None,
+                 tsdb: TimeSeriesDB | None = None,
+                 alert_engine: AlertEngine | None = None,
+                 blackbox=None):
         self.client = client
         self.actuator = actuator
         self.config = config or ControllerConfig()
@@ -347,6 +356,26 @@ class Controller:
         #: the serving platform / replay driver, not acted on here —
         #: replica drain rides the serve.py drain contract).
         self.serving_advice = None
+        # Time-series health layer (ISSUE 10, docs/OBSERVABILITY.md):
+        # every pass folds the metrics snapshot into the in-process
+        # TSDB (reconcile-thread append, zero new locks on the hot
+        # path) and evaluates the SLO burn-rate alert catalog over it
+        # — the autoscaler watches itself.  Both halves degrade on
+        # failure (counted, logged), never abort a pass.
+        self.tsdb = tsdb if tsdb is not None else TimeSeriesDB()
+        self.alerts = (alert_engine if alert_engine is not None
+                       else AlertEngine())
+        # Black-box incident capture (obs/blackbox.py): when an alert
+        # FIRES, dump a self-contained bundle.  None = no automatic
+        # captures (operators still get SIGUSR1 / /debugz).
+        self.blackbox = blackbox
+        for rule in self.alerts.rules:
+            # Export the whole gauge family as 0 from the first scrape
+            # — an absent series and a resolved alert must not look
+            # alike to the paging layer.
+            self.metrics.set_gauge(
+                f"tpu_autoscaler_alerts_active_"
+                f"{rule.name.replace('-', '_')}", 0.0)
 
     # ------------------------------------------------------------------ #
 
@@ -537,7 +566,12 @@ class Controller:
                   # like any other — "unchanged" must never span a
                   # policy decision.
                   ^ hash(("policy", self._policy_digest)))
-        self.recorder.record_pass({
+        # Retention + self-alerting AFTER this pass's metrics landed
+        # (reconcile_seconds above is part of the ingested snapshot)
+        # and BEFORE the decision record, so alert transitions show up
+        # in the very pass record that caused them.
+        alerts_info = self._obs_pass(now)
+        record = {
             "pass": self._pass_seq,
             "t": now,
             "inputs": {"nodes": len(nodes), "pods": len(pods),
@@ -549,7 +583,10 @@ class Controller:
             "planning": dict(self._pass_plan_info),
             "duration_s": time.perf_counter() - t0,
             "events": self._pass_events,
-        })
+        }
+        if alerts_info:
+            record["alerts"] = alerts_info
+        self.recorder.record_pass(record)
 
     def _observe(self) -> tuple[list[Node], list[Pod], list[Pod]]:
         """One pass's world view: ``(nodes, pods, pending)`` — informer
@@ -1219,6 +1256,122 @@ class Controller:
                     out.append(s.accelerator_type)
         return tuple(out)
 
+    # ---- time-series health layer (ISSUE 10) --------------------------- #
+
+    def _obs_pass(self, now: float) -> dict:
+        """Fold this pass's metrics into the TSDB and evaluate the
+        alert catalog.  Crash-only on both halves: retention or
+        alerting failing must degrade the controller's introspection,
+        never its scaling.  Returns the pass record's ``alerts``
+        section (empty when nothing is active or transitioning)."""
+        try:
+            self.tsdb.ingest(self.metrics.snapshot(), now)
+            self.metrics.set_gauge("tsdb_series",
+                                   self.tsdb.series_count())
+            if self.tsdb.series_dropped:
+                self.metrics.set_gauge("tsdb_series_dropped",
+                                       self.tsdb.series_dropped)
+        except Exception:  # noqa: BLE001 — introspection only
+            self.metrics.inc("tsdb_errors")
+            log.exception("tsdb ingest failed; metric history degrades")
+        if self.alerts is None or not self.alerts.rules:
+            return {}
+        try:
+            result = self.alerts.evaluate(self.tsdb, now)
+        except Exception:  # noqa: BLE001 — introspection only
+            self.metrics.inc("alert_eval_errors")
+            log.exception("alert evaluation failed; continuing unwatched")
+            return {}
+        for tr in result.transitions:
+            gauge = (f"tpu_autoscaler_alerts_active_"
+                     f"{tr.rule.replace('-', '_')}")
+            self.metrics.set_gauge(gauge, 1.0 if tr.firing else 0.0)
+            if tr.firing:
+                self.metrics.inc("alerts_fired")
+                log.warning("%s", tr.summary)
+                self._explain(("alert", tr.rule), "alert firing",
+                              tr.summary, severity=tr.severity)
+                self._notify(tr.summary)
+                if self.blackbox is not None \
+                        and self.blackbox.capture_async(
+                            f"alert:{tr.rule}"):
+                    # The bundle builds + writes on a throwaway
+                    # thread (O(series x points) serialization must
+                    # never stall a pass); the writer counts
+                    # incident_bundles_written on success.
+                    self._explain(("alert", tr.rule),
+                                  "incident capture scheduled")
+            else:
+                self.metrics.inc("alerts_resolved")
+                log.info("%s", tr.summary)
+                self._explain(("alert", tr.rule), "alert resolved",
+                              tr.summary)
+                self._notify(tr.summary)
+        if result.active or result.transitions:
+            return {"active": list(result.active)}
+        return {}
+
+    def tsdb_route(self, params: dict | None = None) -> dict:
+        """The ``/debugz/tsdb`` body: the TSDB dump, filterable by
+        ``?prefix=`` and trimmable by ``?window=`` seconds."""
+        params = params or {}
+        window = None
+        if params.get("window"):
+            try:
+                window = float(params["window"])
+            except ValueError:
+                window = None
+        now = self._last_pass_at if self._last_pass_at is not None \
+            else time.time()
+        return self.tsdb.dump(prefix=params.get("prefix", ""),
+                              window_seconds=window, now=now)
+
+    def incident_bundle(self, reason: str = "manual") -> dict:
+        """The black-box bundle: everything ``debug_dump`` serves plus
+        the TSDB windows, the alert rules + state, informer store
+        digests and a config summary — self-contained input for
+        ``python -m tpu_autoscaler.obs replay`` (docs/OBSERVABILITY.md
+        bundle format)."""
+        from tpu_autoscaler.obs.blackbox import BUNDLE_VERSION
+
+        out = self.debug_dump()
+        out["bundle"] = {"version": BUNDLE_VERSION, "reason": reason,
+                         "captured_at": time.time()}
+        out["tsdb"] = self.tsdb.dump()
+        out["informer"] = self._informer_digest()
+        cfg = self.config
+        out["config"] = {
+            "idle_threshold_seconds": cfg.idle_threshold_seconds,
+            "grace_seconds": cfg.grace_seconds,
+            "drain_grace_seconds": cfg.drain_grace_seconds,
+            "provision_timeout_seconds": cfg.provision_timeout_seconds,
+            "delta_planning": cfg.delta_planning,
+            "enable_slice_repair": cfg.enable_slice_repair,
+            "enable_preemption": cfg.enable_preemption,
+            "max_total_chips": cfg.policy.max_total_chips,
+            "default_generation": cfg.policy.default_generation,
+        }
+        return out
+
+    def _informer_digest(self) -> dict | None:
+        """Cheap informer-store summary for incident bundles: per-kind
+        object counts, sync state and resource versions (the cache's
+        identity — enough to tell two bundles' world views apart
+        without serializing 100k objects)."""
+        if self.informer is None:
+            return None
+        out: dict = {}
+        for kind in ("pod", "node"):
+            cache = getattr(self.informer, f"{kind}_cache", None)
+            if cache is None:
+                continue
+            out[kind + "s"] = {
+                "synced": bool(cache.synced),
+                "objects": len(cache),
+                "resource_version": cache.resource_version,
+            }
+        return out
+
     # ---- observability helpers ----------------------------------------- #
 
     def debug_dump(self) -> dict:
@@ -1244,6 +1397,10 @@ class Controller:
                     continue
             else:
                 out["serving"] = {"unavailable": "mutating"}
+        if self.alerts is not None and self.alerts.rules:
+            # Rule catalog + hysteresis state (bounded-retry copy
+            # inside debug_state — same /debugz concurrency caveats).
+            out["alerts"] = self.alerts.debug_state()
         # This dict is reconcile-thread-owned and deliberately
         # lock-free (giving the Controller a lock would put EVERY
         # field under the thread-discipline checker); the /debugz

@@ -210,7 +210,24 @@ _common = [
                  help="Slack incoming-webhook URL for scale events."),
     click.option("--slack-channel", default=None),
     click.option("--metrics-port", default=0, show_default=True,
-                 help="Serve /metrics and /healthz on this port (0=off)."),
+                 help="Serve /metrics, /healthz, /debugz and "
+                      "/debugz/tsdb on this port (0=off)."),
+    click.option("--recorder-spans", default=4096, show_default=True,
+                 type=click.IntRange(min=16),
+                 help="Flight-recorder completed-span ring capacity "
+                      "(docs/OBSERVABILITY.md retention bounds)."),
+    click.option("--recorder-passes", default=512, show_default=True,
+                 type=click.IntRange(min=16),
+                 help="Flight-recorder decision-record ring capacity."),
+    click.option("--no-alerts", is_flag=True,
+                 help="Disable the SLO burn-rate alert engine "
+                      "(docs/OPERATIONS.md alert catalog; on by "
+                      "default — the autoscaler watches itself)."),
+    click.option("--incident-dir", default=None,
+                 help="Directory for black-box incident bundles, "
+                      "captured automatically when an alert fires "
+                      "(unset = no automatic captures; SIGUSR1 and "
+                      "/debugz still work)."),
     click.option("--log-json", is_flag=True,
                  help="Emit structured JSON log lines."),
     click.option("-v", "--verbose", is_flag=True),
@@ -232,8 +249,10 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            no_scale, no_maintenance, enable_policy,
            policy_min_confidence, policy_waste_budget,
            policy_early_reclaim, slack_hook,
-           slack_channel, metrics_port, log_json, verbose) -> Controller:
+           slack_channel, metrics_port, recorder_spans, recorder_passes,
+           no_alerts, incident_dir, log_json, verbose) -> Controller:
     from tpu_autoscaler.logging_setup import setup_logging
+    from tpu_autoscaler.obs import AlertEngine, BlackBox, FlightRecorder
 
     setup_logging(verbose=verbose, json_format=log_json)
     notifier = (SlackNotifier(slack_hook, slack_channel) if slack_hook
@@ -271,12 +290,26 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
             # the operator configured.
             early_reclaim=policy_early_reclaim,
             idle_ceiling_seconds=max(7200.0, idle_threshold * 4))))
-    controller = Controller(kube, actuator, config, notifier, metrics,
-                            policy_engine=policy_engine)
+    controller = Controller(
+        kube, actuator, config, notifier, metrics,
+        policy_engine=policy_engine,
+        # Ring capacities are operator knobs now (ISSUE 10 satellite):
+        # deep rings for incident-heavy fleets, shallow for tiny ones.
+        recorder=FlightRecorder(max_spans=recorder_spans,
+                                max_passes=recorder_passes),
+        alert_engine=AlertEngine(rules=()) if no_alerts else None)
+    if incident_dir:
+        # Black-box capture on alert fire (obs/blackbox.py).  Wired
+        # post-ctor: the bundle producer IS a controller method.
+        controller.blackbox = BlackBox(incident_dir,
+                                       controller.incident_bundle,
+                                       metrics=metrics)
     if metrics_port:
-        # Serve /metrics + /healthz + /debugz together: the flight-
-        # recorder dump rides the port operators already expose.
-        metrics.serve(metrics_port, debugz=controller.debug_dump)
+        # Serve /metrics + /healthz + /debugz + /debugz/tsdb together:
+        # the flight-recorder dump and the metric history ride the
+        # port operators already expose.
+        metrics.serve(metrics_port, debugz=controller.debug_dump,
+                      routes={"/debugz/tsdb": controller.tsdb_route})
     return controller
 
 
@@ -382,11 +415,14 @@ def run(kube_url, kube_token, kubeconfig, kube_context, actuator_kind,
     # slices and never reach any idle threshold. Run as a long-lived
     # Deployment (deploy/autoscaler.yaml).
     controller = _build(kube, actuator, sleep=sleep, **kw)
-    # SIGUSR1 → flight-recorder dump to /tmp, for controllers whose
-    # metrics port is off or firewalled (docs/OBSERVABILITY.md).
+    # SIGUSR1 → full incident bundle to /tmp (a strict superset of
+    # the old flight-recorder dump: the `trace`/`explain` CLI reads it
+    # unchanged, and `python -m tpu_autoscaler.obs replay` gets the
+    # TSDB + alert sections too), for controllers whose metrics port
+    # is off or firewalled (docs/OBSERVABILITY.md).
     from tpu_autoscaler.obs import install_sigusr1
 
-    install_sigusr1(controller.debug_dump)
+    install_sigusr1(lambda: controller.incident_bundle("sigusr1"))
     lock = None
     if leader_elect:
         from tpu_autoscaler.k8s.leader import LeaseLock
@@ -532,29 +568,47 @@ def demo(scenario, provision_delay, until, scale_down, sleep, **kw):
     sys.exit(0 if result.all_running else 1)
 
 
-def _load_dump(source, url):
-    """Read a flight-recorder dump: a SIGUSR1 file (``--from``) or a
-    live controller's ``/debugz`` endpoint (``--url``, which may be
-    just ``host:port``)."""
+def _read_dump_file(source):
+    """Load one JSON dump file, wrapping failures as clean CLI
+    errors."""
     import json as _json
 
-    if bool(source) == bool(url):
+    try:
+        with open(source, encoding="utf-8") as f:
+            return _json.load(f)
+    except (OSError, ValueError) as e:
         raise click.UsageError(
-            "pass exactly one of --from FILE (a SIGUSR1 dump) or "
-            "--url http://HOST:METRICS_PORT (a live /debugz)")
-    if source:
-        try:
-            with open(source, encoding="utf-8") as f:
-                return _json.load(f)
-        except (OSError, ValueError) as e:
-            raise click.UsageError(
-                f"could not read dump {source!r}: {e}") from e
-    import urllib.request
+            f"could not read dump {source!r}: {e}") from e
+
+
+def _debugz_url(url, endpoint, params=None):
+    """Normalize an operator-supplied controller URL to one debug
+    endpoint: bare ``host:port`` gets a scheme, a trailing ``/debugz``
+    is treated as the PORT'S debug root (so the URL form ``trace``/
+    ``explain`` accept also works for ``/debugz/tsdb`` instead of
+    yielding ``/debugz/debugz/tsdb`` — review-found), and ``endpoint``
+    is appended unless already present."""
+    import urllib.parse
 
     if "://" not in url:
         url = f"http://{url}"
-    if not url.rstrip("/").endswith("/debugz"):
-        url = url.rstrip("/") + "/debugz"
+    url = url.rstrip("/")
+    if not url.endswith(endpoint):
+        if url.endswith("/debugz") and endpoint.startswith("/debugz"):
+            url = url[:-len("/debugz")]
+        url += endpoint
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    return url
+
+
+def _fetch_debugz(url, endpoint, params=None):
+    """GET one debug endpoint off a live controller, wrapping failures
+    as clean CLI errors — shared by every dump-reading subcommand."""
+    import json as _json
+    import urllib.request
+
+    url = _debugz_url(url, endpoint, params)
     try:
         with urllib.request.urlopen(url, timeout=10) as r:
             return _json.loads(r.read().decode())
@@ -562,6 +616,23 @@ def _load_dump(source, url):
         raise click.UsageError(
             f"could not fetch {url!r}: {e} — is the controller running "
             "with --metrics-port?") from e
+
+
+def _require_one_source(source, url, what):
+    if bool(source) == bool(url):
+        raise click.UsageError(
+            f"pass exactly one of --from FILE ({what}) or "
+            "--url http://HOST:METRICS_PORT (a live controller)")
+
+
+def _load_dump(source, url):
+    """Read a flight-recorder dump: a SIGUSR1 file / incident bundle
+    (``--from``) or a live controller's ``/debugz`` endpoint
+    (``--url``, which may be just ``host:port``)."""
+    _require_one_source(source, url, "a SIGUSR1 dump")
+    if source:
+        return _read_dump_file(source)
+    return _fetch_debugz(url, "/debugz")
 
 
 _dump_options = [
@@ -598,6 +669,89 @@ def trace(source, url, trace_id):
         click.echo(render_trace(dump, trace_id))
     else:
         click.echo(list_traces(dump))
+
+
+def _load_tsdb_dump(source, url, prefix, window):
+    """Read a TSDB dump: a live controller's ``/debugz/tsdb`` (with
+    server-side prefix/window filtering) or any incident bundle /
+    SIGUSR1 file (its ``tsdb`` section; filtered client-side)."""
+    _require_one_source(source, url, "an incident bundle")
+    if not source:
+        params = {}
+        if prefix:
+            params["prefix"] = prefix
+        if window:
+            params["window"] = str(window)
+        return _fetch_debugz(url, "/debugz/tsdb", params)
+    raw = _read_dump_file(source)
+    body = dict(raw.get("tsdb", raw))  # bundle section, or a bare dump
+    series = {n: s for n, s in body.get("series", {}).items()
+              if not prefix or n.startswith(prefix)}
+    if window:
+        # Client-side window trim (the --url branch filters
+        # server-side): "now" is the newest timestamp the bundle
+        # retains, matching the capture instant closely enough.
+        newest = max((row[0] for s in series.values()
+                      for tier in ("raw", "mid", "coarse")
+                      for row in s.get(tier, ())), default=0.0)
+        floor = newest - window
+        series = {
+            n: {k: ([row for row in v if row[0] >= floor]
+                    if k in ("raw", "mid", "coarse") else v)
+                for k, v in s.items()}
+            for n, s in series.items()}
+    body["series"] = series
+    return body
+
+
+@cli.command("metrics-history")
+@dump_options
+@click.argument("series", required=False)
+@click.option("--prefix", default="",
+              help="Series-name prefix filter (listing mode).")
+@click.option("--window", default=None, type=float,
+              help="Only this many trailing seconds of history.")
+@click.option("--points", "max_points", default=24, show_default=True,
+              help="Recent points to print per series.")
+def metrics_history(source, url, series, prefix, window, max_points):
+    """Metric history from the in-process TSDB (docs/OBSERVABILITY.md
+    "Time-series history"): list retained series, or render one
+    series' recent points with its downsampled min/max envelope —
+    "when did p99 scale-up start degrading?" without external
+    infrastructure."""
+    dump = _load_tsdb_dump(source, url, prefix if not series else series,
+                           window)
+    all_series = dump.get("series", {})
+    if dump.get("unavailable"):
+        click.echo("(tsdb snapshot unavailable: writer was mutating; "
+                   "retry)")
+        return
+    if not series:
+        tiers = dump.get("tiers", {})
+        click.echo(f"{len(all_series)} series retained "
+                   f"(raw={tiers.get('raw_points')}p, "
+                   f"mid={tiers.get('mid_seconds')}s, "
+                   f"coarse={tiers.get('coarse_seconds')}s)")
+        for name in sorted(all_series):
+            raw = all_series[name].get("raw", [])
+            last = f"{raw[-1][1]:g} @ {raw[-1][0]:g}" if raw else "(empty)"
+            click.echo(f"  {name}  points={len(raw)}  last={last}")
+        return
+    body = all_series.get(series)
+    if body is None:
+        known = ", ".join(sorted(all_series)[:20]) or "(none)"
+        raise click.UsageError(
+            f"series {series!r} not retained; known (first 20): {known}")
+    for tier in ("coarse", "mid"):
+        rows = body.get(tier, [])
+        if rows:
+            click.echo(f"{tier} ({len(rows)} buckets): "
+                       f"min={min(r[2] for r in rows):g} "
+                       f"max={max(r[3] for r in rows):g}")
+    raw = body.get("raw", [])
+    click.echo(f"raw ({len(raw)} points, showing {max_points}):")
+    for t, v in raw[-max_points:]:
+        click.echo(f"  t={t:g}  {v:g}")
 
 
 @cli.command()

@@ -165,7 +165,7 @@ class Metrics:
             lines.append(f"{n}_count {count}")
         return "\n".join(lines) + "\n"
 
-    def serve(self, port: int, debugz=None) -> threading.Thread:
+    def serve(self, port: int, debugz=None, routes=None) -> threading.Thread:
         """Serve /metrics (+ /healthz, + /debugz) on a daemon thread.
 
         ``debugz``: optional zero-arg callable returning a JSON-able
@@ -175,16 +175,30 @@ class Metrics:
         Serialized with ``allow_nan=False``: an ``inf`` anywhere in the
         dump is a bug (empty-summary guard) and must fail loudly here,
         not in whichever strict JSON parser reads the dump later.
+
+        ``routes``: extra JSON endpoints — path → callable(params)
+        where params is the parsed query string (first value per key).
+        How ``/debugz/tsdb`` (``Controller.tsdb_route``) rides the
+        port operators already expose, same serialization contract.
         """
         import http.server
         import json
+        import urllib.parse
 
         metrics = self
+        routes = routes or {}
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib naming)
-                if self.path.split("?", 1)[0] == "/debugz" \
-                        and debugz is not None:
+                path, _, query = self.path.partition("?")
+                if path in routes:
+                    params = {k: v[0] for k, v in
+                              urllib.parse.parse_qs(query).items()}
+                    body = json.dumps(routes[path](params), indent=2,
+                                      default=str,
+                                      allow_nan=False).encode()
+                    ctype = "application/json"
+                elif path == "/debugz" and debugz is not None:
                     body = json.dumps(debugz(), indent=2, default=str,
                                       allow_nan=False).encode()
                     ctype = "application/json"

@@ -1,13 +1,28 @@
-"""Observability layer (ISSUE 5): end-to-end decision tracing.
+"""Observability layer (ISSUE 5 tracing + ISSUE 10 retention/alerting).
 
 - ``trace``    — dependency-free spans + tracer with context propagation
                  (one trace per gang scale-up; docs/OBSERVABILITY.md);
 - ``recorder`` — bounded flight recorder of completed spans and
                  per-pass decision records, served on ``/debugz`` and
                  dumped on SIGUSR1;
-- ``render``   — the ``trace`` / ``explain`` CLI's formatting layer.
+- ``render``   — the ``trace`` / ``explain`` CLI's formatting layer;
+- ``tsdb``     — in-process time-series store (ring-per-series, raw →
+                 10 s → 5 min downsampling) fed per pass from the
+                 metrics snapshot; served on ``/debugz/tsdb`` and the
+                 ``metrics-history`` CLI;
+- ``alerts``   — declarative SLO burn-rate alert engine evaluated each
+                 reconcile pass (the autoscaler watches itself);
+- ``blackbox`` — atomic incident bundles on alert fire / SIGUSR1;
+                 replayed offline via ``python -m tpu_autoscaler.obs
+                 replay``.
 """
 
+from tpu_autoscaler.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_rules,
+)
+from tpu_autoscaler.obs.blackbox import BlackBox, load_bundle
 from tpu_autoscaler.obs.recorder import (
     FlightRecorder,
     install_sigusr1,
@@ -20,14 +35,21 @@ from tpu_autoscaler.obs.trace import (
     current_trace_id,
     maybe_span,
 )
+from tpu_autoscaler.obs.tsdb import TimeSeriesDB
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
+    "BlackBox",
     "FlightRecorder",
     "Span",
+    "TimeSeriesDB",
     "Tracer",
     "current_span",
     "current_trace_id",
+    "default_rules",
     "install_sigusr1",
+    "load_bundle",
     "maybe_span",
     "trace_gaps",
 ]

@@ -1,0 +1,315 @@
+"""SLO burn-rate alerting over the in-process TSDB (ISSUE 10).
+
+The controller emits rich signals but nothing *watched* them: an
+operator learned about a degrading scale-up p99 from a user, not from
+the autoscaler.  This module closes that loop — a declarative rule set
+evaluated once per reconcile pass by the Reconciler (crash-only
+``_alerts_pass``): the autoscaler finally watches itself.
+
+Rule kinds (all windows in the controller's injected clock, so
+simulated-time runs — chaos, replay — evaluate identically):
+
+- ``burn_rate`` — multi-window burn rate over a declared latency
+  histogram, SRE-style: the miss fraction (observations above
+  ``slo_bound``) over BOTH a fast and a slow window must burn the
+  error budget (``1 - objective``) faster than ``burn_threshold``.
+  The fast window makes firing prompt; the slow window keeps one
+  blip from paging.
+- ``rate`` — a counter's per-second rate over ``window`` crosses
+  ``threshold`` (watch staleness, waste-budget spend).
+- ``gauge_below`` — a gauge's window-average sits below ``threshold``
+  (serving SLO attainment).
+- ``pass_duration`` — mean pass duration over ``window`` (delta of
+  ``reconcile_seconds:sum`` over delta of ``:count``) exceeds
+  ``threshold`` — the control loop's own latency budget.
+
+Hysteresis is pass-counted, not wall-clocked: ``for_passes``
+consecutive breaching evaluations fire, ``clear_passes`` consecutive
+clean ones resolve — a rule can never flap faster than the reconcile
+interval.  Transitions land in the notifier, the flight recorder's
+pass record, and a ``tpu_autoscaler_alerts_active_<rule>`` gauge
+family (wired by the Reconciler); a new firing can also trigger a
+black-box incident capture (obs/blackbox.py).
+
+Engine state is reconcile-thread-only; ``debug_state()`` copies with
+the bounded-retry pattern for the ``/debugz`` thread.  The TAO6xx
+checker (analysis/metricsdoc.py) keeps every rule's ``metric``
+pointing at a real exported family AND every rule present in
+docs/OPERATIONS.md's alert catalog, both directions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+_KINDS = ("burn_rate", "rate", "gauge_below", "pass_duration")
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declarative alert.  ``metric`` names the exported family
+    the rule watches (the TAO6xx drift anchor); ``runbook`` points at
+    the operator doc anchor rendered in notifications."""
+
+    name: str
+    metric: str
+    kind: str
+    # burn_rate
+    slo_bound: float = 0.0       # histogram le-bound counted as good
+    objective: float = 0.99      # fraction that must be good
+    fast_window: float = 300.0
+    slow_window: float = 1800.0
+    burn_threshold: float = 2.0
+    min_events: int = 1          # fewer observations in-window: no verdict
+    # rate / gauge_below / pass_duration
+    window: float = 300.0
+    threshold: float = 0.0
+    # hysteresis
+    for_passes: int = 2
+    clear_passes: int = 3
+    severity: str = "page"
+    runbook: str = "docs/OPERATIONS.md#alert-catalog"
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown alert kind {self.kind!r}")
+
+    def as_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, body: dict[str, Any]) -> "AlertRule":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in body.items() if k in known})
+
+
+def default_rules() -> tuple[AlertRule, ...]:
+    """The production alert catalog (docs/OPERATIONS.md "Alert
+    catalog" — the TAO6xx checker holds the two in lockstep)."""
+    return (
+        AlertRule(
+            name="scaleup-latency-burn", metric="scale_up_latency_seconds",
+            kind="burn_rate", slo_bound=360.0, objective=0.99,
+            fast_window=600.0, slow_window=3600.0, burn_threshold=2.0,
+            min_events=1, for_passes=2, clear_passes=5,
+            runbook="docs/OPERATIONS.md#alert-catalog"),
+        AlertRule(
+            name="serving-slo-attainment", metric="serving_slo_attainment",
+            kind="gauge_below", window=600.0, threshold=0.9,
+            for_passes=3, clear_passes=5, severity="page"),
+        AlertRule(
+            name="watch-staleness", metric="watch_failures",
+            kind="rate", window=600.0, threshold=1.0 / 60.0,
+            for_passes=3, clear_passes=5, severity="ticket"),
+        AlertRule(
+            name="policy-waste-budget",
+            metric="wasted_prewarm_chip_seconds",
+            kind="rate", window=3600.0, threshold=120_000.0 / 3600.0,
+            for_passes=2, clear_passes=5, severity="ticket"),
+        AlertRule(
+            name="pass-duration-budget", metric="reconcile_seconds",
+            kind="pass_duration", window=600.0, threshold=0.25,
+            for_passes=3, clear_passes=5, severity="ticket"),
+    )
+
+
+@dataclasses.dataclass
+class AlertState:
+    firing: bool = False
+    breach_streak: int = 0
+    ok_streak: int = 0
+    fired_at: float | None = None
+    resolved_at: float | None = None
+    fired_count: int = 0
+    last_value: float | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Transition:
+    rule: str
+    firing: bool           # True: fired this pass; False: resolved
+    t: float
+    value: float | None
+    severity: str
+    runbook: str
+    summary: str
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertPassResult:
+    transitions: tuple[Transition, ...]
+    active: tuple[str, ...]
+    evaluated: int
+
+
+class AlertEngine:
+    """Evaluates the rule set against a :class:`TimeSeriesDB` each
+    pass.  Pure over (tsdb, now) except for the hysteresis state —
+    which is exactly what the offline replay recomputes."""
+
+    def __init__(self, rules: tuple[AlertRule, ...] | None = None) -> None:
+        self.rules = tuple(rules) if rules is not None else default_rules()
+        names = [r.name for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate alert rule names")
+        self._state: dict[str, AlertState] = {
+            r.name: AlertState() for r in self.rules}
+
+    # -- rule evaluation ----------------------------------------------
+
+    @staticmethod
+    def _burn(rule: AlertRule, tsdb: Any, now: float,
+              window: float) -> tuple[bool, float] | None:
+        total = tsdb.delta(f"{rule.metric}:count", now - window, now)
+        if total is None or total < rule.min_events:
+            return None
+        if total <= 0:
+            return (False, 0.0)
+        good = tsdb.delta(f"{rule.metric}:le:{rule.slo_bound:g}",
+                          now - window, now)
+        if good is None:
+            # The :le: series does not exist — slo_bound matches no
+            # declared histogram bucket bound.  A missing series is a
+            # CONFIGURATION problem, not "zero good events": treating
+            # it as 0 would page a guaranteed false positive on every
+            # observation (review-found).  No verdict; the rule shows
+            # last_value=None in debug_state forever, which is the
+            # visible symptom to fix.
+            return None
+        miss = max(0.0, 1.0 - good / total)
+        burn = miss / max(1e-9, 1.0 - rule.objective)
+        return (burn >= rule.burn_threshold, burn)
+
+    def _breaching(self, rule: AlertRule, tsdb: Any,
+                   now: float) -> tuple[bool, float | None]:
+        """One stateless evaluation: (condition breached, the measured
+        value behind the verdict)."""
+        if rule.kind == "burn_rate":
+            fast = self._burn(rule, tsdb, now, rule.fast_window)
+            slow = self._burn(rule, tsdb, now, rule.slow_window)
+            if fast is None or slow is None:
+                return (False, None)
+            return (fast[0] and slow[0], fast[1])
+        if rule.kind == "rate":
+            d = tsdb.delta(rule.metric, now - rule.window, now)
+            if d is None:
+                return (False, None)
+            rate = d / rule.window
+            return (rate > rule.threshold, rate)
+        if rule.kind == "gauge_below":
+            ts, vs = tsdb.points(rule.metric, now - rule.window, now)
+            if len(vs):
+                mean = float(vs.mean())
+            else:
+                # A flat gauge appends only on change + heartbeat, so
+                # a short window can be point-free while the value is
+                # perfectly known: sparse is not absent.
+                last = tsdb.value_at(rule.metric, now)
+                if last is None:
+                    return (False, None)
+                mean = last
+            return (mean < rule.threshold, mean)
+        # pass_duration
+        count = tsdb.delta(f"{rule.metric}:count", now - rule.window, now)
+        total = tsdb.delta(f"{rule.metric}:sum", now - rule.window, now)
+        if not count or total is None:
+            return (False, None)
+        mean = total / count
+        return (mean > rule.threshold, mean)
+
+    # -- the per-pass entry point -------------------------------------
+
+    def evaluate(self, tsdb: Any, now: float) -> AlertPassResult:
+        """Evaluate every rule once; returns this pass's transitions
+        and the currently-active set.  Reconcile thread only."""
+        transitions: list[Transition] = []
+        for rule in self.rules:
+            state = self._state[rule.name]
+            breached, value = self._breaching(rule, tsdb, now)
+            state.last_value = value
+            if breached:
+                state.breach_streak += 1
+                state.ok_streak = 0
+            else:
+                state.ok_streak += 1
+                state.breach_streak = 0
+            if not state.firing \
+                    and state.breach_streak >= rule.for_passes:
+                state.firing = True
+                state.fired_at = now
+                state.fired_count += 1
+                transitions.append(Transition(
+                    rule=rule.name, firing=True, t=now, value=value,
+                    severity=rule.severity, runbook=rule.runbook,
+                    summary=self._summary(rule, value, firing=True)))
+            elif state.firing and state.ok_streak >= rule.clear_passes:
+                state.firing = False
+                state.resolved_at = now
+                transitions.append(Transition(
+                    rule=rule.name, firing=False, t=now, value=value,
+                    severity=rule.severity, runbook=rule.runbook,
+                    summary=self._summary(rule, value, firing=False)))
+        active = tuple(sorted(n for n, s in self._state.items()
+                              if s.firing))
+        return AlertPassResult(transitions=tuple(transitions),
+                               active=active,
+                               evaluated=len(self.rules))
+
+    @staticmethod
+    def _summary(rule: AlertRule, value: float | None,
+                 firing: bool) -> str:
+        what = "FIRING" if firing else "resolved"
+        shown = "n/a" if value is None else f"{value:.4g}"
+        if rule.kind == "burn_rate":
+            detail = (f"burn={shown} (threshold "
+                      f"{rule.burn_threshold:g}, SLO {rule.objective:g} "
+                      f"within {rule.slo_bound:g}s)")
+        elif rule.kind == "rate":
+            detail = f"rate={shown}/s (threshold {rule.threshold:g}/s)"
+        elif rule.kind == "gauge_below":
+            detail = f"avg={shown} (floor {rule.threshold:g})"
+        else:
+            detail = f"mean={shown}s (budget {rule.threshold:g}s)"
+        return (f"alert {rule.name} {what}: {rule.metric} {detail} — "
+                f"{rule.runbook}")
+
+    # -- introspection -------------------------------------------------
+
+    def firing(self) -> tuple[str, ...]:
+        return tuple(sorted(n for n, s in self._state.items() if s.firing))
+
+    def state_of(self, name: str) -> AlertState:
+        return self._state[name]
+
+    def debug_state(self) -> dict[str, Any]:
+        """JSON-able engine state for ``/debugz`` and incident
+        bundles: the rule set (full params — what the offline replay
+        re-instantiates) plus per-rule hysteresis state.  The /debugz
+        thread reads reconcile-thread state concurrently, but the
+        ``_state`` dict's KEYS are frozen at construction — only
+        AlertState scalar attributes mutate — so a plain single-pass
+        copy can never hit a resize mid-iteration (no bounded-retry
+        needed here, unlike the variable-shape debug tables)."""
+        return {
+            "rules": [r.as_dict() for r in self.rules],
+            "state": {
+                name: {"firing": s.firing,
+                       "breach_streak": s.breach_streak,
+                       "ok_streak": s.ok_streak,
+                       "fired_at": s.fired_at,
+                       "resolved_at": s.resolved_at,
+                       "fired_count": s.fired_count,
+                       "last_value": s.last_value}
+                for name, s in self._state.items()},
+        }
+
+    @classmethod
+    def from_debug_state(cls, body: dict[str, Any]) -> "AlertEngine":
+        """Fresh engine (zeroed hysteresis) with the bundle's rule
+        set — the offline replay's starting point."""
+        return cls(tuple(AlertRule.from_dict(r)
+                         for r in body.get("rules", ())))

@@ -24,7 +24,6 @@ introspection state.  Everything in a dump is JSON-serializable with
 from __future__ import annotations
 
 import collections
-import json
 import logging
 import signal
 import time
@@ -157,6 +156,12 @@ def install_sigusr1(dump_fn: Callable[[], dict[str, Any]],
     handler is crash-only: a failing dump logs and never takes the
     process down.
 
+    File names are UNIQUE per capture (UTC stamp + pid + a monotonic
+    counter, obs/blackbox.py): two signals in the same second used to
+    clobber each other's dump — exactly the double-capture an incident
+    produces — and the write is atomic (tmp + rename), so a reader
+    polling the directory never sees a half-written dump.
+
     The dump runs on a THROWAWAY THREAD, never inline in the handler:
     Python signal handlers interrupt the main thread between bytecodes,
     and ``dump_fn`` acquires the recorder/tracer/metrics locks — all
@@ -169,11 +174,14 @@ def install_sigusr1(dump_fn: Callable[[], dict[str, Any]],
         return False
 
     def _write() -> None:
-        path = f"{path_prefix}-{int(time.time())}.json"
+        from tpu_autoscaler.obs.blackbox import (
+            unique_dump_path,
+            write_atomic,
+        )
+
+        path = unique_dump_path(path_prefix)
         try:
-            with open(path, "w", encoding="utf-8") as f:
-                json.dump(dump_fn(), f, indent=2, default=str,
-                          allow_nan=False)
+            write_atomic(path, dump_fn())
             log.warning("SIGUSR1: flight-recorder dump written to %s", path)
         except Exception:  # noqa: BLE001 — diagnostics must not kill
             log.exception("SIGUSR1 flight-recorder dump failed")

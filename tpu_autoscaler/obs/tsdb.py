@@ -1,0 +1,591 @@
+"""In-process time-series store for the controller's own metrics
+(ISSUE 10).
+
+The metrics registry answers "what is the value NOW"; nothing retained
+*history* — an operator could not ask "when did p99 scale-up start
+degrading?", and the alert engine (obs/alerts.py) needs windows, not
+instants.  This module is the retention layer: a fixed-size
+numpy-ring-per-series store fed once per reconcile pass from the
+existing ``Metrics.snapshot()`` (the same emission path every exporter
+already rides — no new instrumentation seams, no second source of
+truth).
+
+Threading model (the load-bearing part):
+
+- **writes** happen ONLY on the reconcile thread — ``ingest()`` is
+  called from ``reconcile_once`` — so the hot path takes ZERO new
+  locks;
+- **reads** (``/debugz/tsdb``, the ``metrics-history`` CLI, incident
+  bundles, the alert engine) come from other threads and use a
+  seqlock: ``ingest`` bumps ``_wseq`` to odd before mutating and back
+  to even after, and readers copy-then-recheck with a bounded retry —
+  the established ``debug_dump`` bounded-retry pattern, generalized.
+  A torn read is *detected and retried*, never returned.  (The alert
+  engine actually runs on the reconcile thread too and could read
+  bare; it goes through the same guarded reads so there is exactly
+  one read path to verify.)
+
+Retention model (docs/OBSERVABILITY.md):
+
+- **raw** tier: one point per ingest pass in which the value changed
+  (plus a heartbeat so flat series still anchor window queries),
+  ``raw_points`` deep;
+- **mid** tier: 10 s buckets aggregated (last/min/max/mean) as raw
+  points age, ``mid_points`` deep (~2 h at the defaults);
+- **coarse** tier: 5 min buckets, ``coarse_points`` deep (~7 days).
+
+Append is O(1) (ring write + two bucket folds); a range query is
+O(window) — it walks only the retained points inside ``[start, end]``,
+picking the finest tier that still covers each sub-range.
+
+Series naming: counters and gauges keep their metric name; summaries
+contribute ``name:count`` and ``name:sum`` (windows give rate and
+mean); declared histograms contribute one cumulative ``name:le:<le>``
+series per bucket — exactly what a multi-window burn rate needs
+(good/total over a window = two deltas).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable
+
+import numpy as np
+
+#: Default tier geometry (docs/OBSERVABILITY.md "Time-series history").
+RAW_POINTS = 720
+MID_SECONDS = 10.0
+MID_POINTS = 720
+COARSE_SECONDS = 300.0
+COARSE_POINTS = 2016
+#: Flat series still get a point this often, so "last value at-or-
+#: before t" stays answerable across the whole retention window.
+HEARTBEAT_SECONDS = 60.0
+#: Hard series-count bound: a runaway dynamic family must degrade
+#: (drop new series, count them) instead of growing without bound.
+MAX_SERIES = 20_000
+
+#: Aggregate row columns for the downsampled tiers.
+_T, _LAST, _MIN, _MAX, _SUM, _N = range(6)
+
+
+class _Ring:
+    """Fixed-capacity append-only ring of (t, value) float64 pairs.
+
+    Storage grows geometrically up to ``capacity`` (a new series costs
+    a 32-slot allocation, not the full ring — creating ~100 series on
+    a controller's first pass was eating milliseconds of np.zeros);
+    wrap-around only begins once the arrays reach full capacity, so
+    growth never reorders retained points."""
+
+    __slots__ = ("t", "v", "n", "capacity")
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        size = min(32, capacity)
+        self.t = np.zeros(size, dtype=np.float64)
+        self.v = np.zeros(size, dtype=np.float64)
+        self.n = 0  # total appended (retained = min(n, capacity))
+
+    def append(self, t: float, v: float) -> None:
+        size = len(self.t)
+        if self.n == size and size < self.capacity:
+            grown = min(self.capacity, size * 4)
+            nt = np.zeros(grown, dtype=np.float64)
+            nv = np.zeros(grown, dtype=np.float64)
+            nt[:size] = self.t
+            nv[:size] = self.v
+            self.t, self.v = nt, nv
+            size = grown
+        i = self.n % size
+        self.t[i] = t
+        self.v[i] = v
+        self.n += 1
+
+    def ordered(self) -> tuple[np.ndarray, np.ndarray]:
+        """Retained points oldest→newest.  VIEWS while the ring has
+        not wrapped (the common case — callers run inside the seqlock
+        guard and copy anything they keep), copies after the wrap."""
+        cap = len(self.t)
+        if self.n <= cap:
+            return self.t[:self.n], self.v[:self.n]
+        i = self.n % cap
+        return (np.concatenate((self.t[i:], self.t[:i])),
+                np.concatenate((self.v[i:], self.v[:i])))
+
+    def last_at(self, t: float) -> float | None:
+        """Value of the newest point at-or-before ``t`` without
+        materializing the ordered view — the alert engine's per-pass
+        window-edge lookup (O(log n), zero copies)."""
+        cap = len(self.t)
+        if self.n == 0:
+            return None
+        if self.n <= cap:
+            tv = self.t[:self.n]
+            i = int(np.searchsorted(tv, t, side="right")) - 1
+            return float(self.v[i]) if i >= 0 else None
+        i0 = self.n % cap
+        newer_t = self.t[:i0]   # the i0 most recent points
+        if i0 and t >= newer_t[0]:
+            j = int(np.searchsorted(newer_t, t, side="right")) - 1
+            return float(self.v[j])
+        older_t = self.t[i0:]   # the cap - i0 older points
+        j = int(np.searchsorted(older_t, t, side="right")) - 1
+        return float(self.v[i0 + j]) if j >= 0 else None
+
+
+class _AggRing:
+    """Ring of closed downsample buckets: rows (t, last, min, max,
+    sum, count); ``t`` is the bucket START.  Open buckets are plain
+    Python lists (scalar float math beats numpy at this size); rows
+    land in the numpy ring only when the bucket closes."""
+
+    __slots__ = ("rows", "n", "capacity")
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self.rows: np.ndarray | None = None  # lazy: first bucket close
+        self.n = 0
+
+    def append(self, row: list[float]) -> None:
+        if self.rows is None:
+            self.rows = np.zeros((min(32, self.capacity), 6),
+                                 dtype=np.float64)
+        size = len(self.rows)
+        if self.n == size and size < self.capacity:
+            grown = np.zeros((min(self.capacity, size * 4), 6),
+                             dtype=np.float64)
+            grown[:size] = self.rows
+            self.rows = grown
+            size = len(grown)
+        self.rows[self.n % size] = row
+        self.n += 1
+
+    def ordered(self) -> np.ndarray:
+        """Oldest→newest rows: a VIEW until the ring wraps (callers
+        run inside the seqlock guard and copy what they keep)."""
+        if self.rows is None:
+            return np.zeros((0, 6), dtype=np.float64)
+        cap = len(self.rows)
+        if self.n <= cap:
+            return self.rows[:self.n]
+        i = self.n % cap
+        return np.concatenate((self.rows[i:], self.rows[:i]))
+
+
+class _Series:
+    __slots__ = ("raw", "mid", "coarse", "open_mid", "open_coarse",
+                 "last_t", "last_v")
+
+    def __init__(self, raw_points: int, mid_points: int,
+                 coarse_points: int) -> None:
+        self.raw = _Ring(raw_points)
+        self.mid = _AggRing(mid_points)
+        self.coarse = _AggRing(coarse_points)
+        # Open (not-yet-closed) bucket accumulators, or None.
+        self.open_mid: list[float] | None = None
+        self.open_coarse: list[float] | None = None
+        self.last_t = -math.inf
+        self.last_v = math.nan
+
+
+def _fold(open_row: list[float] | None, ring: _AggRing,
+          bucket_start: float, t: float, v: float) -> list[float]:
+    """Fold one point into an open bucket, closing it into ``ring``
+    first if ``t`` has advanced past it."""
+    if open_row is not None and open_row[_T] != bucket_start:
+        ring.append(open_row)
+        open_row = None
+    if open_row is None:
+        return [bucket_start, v, v, v, v, 1.0]
+    open_row[_LAST] = v
+    if v < open_row[_MIN]:
+        open_row[_MIN] = v
+    if v > open_row[_MAX]:
+        open_row[_MAX] = v
+    open_row[_SUM] += v
+    open_row[_N] += 1.0
+    return open_row
+
+
+class TornRead(RuntimeError):
+    """A guarded read raced the reconcile-thread writer past the retry
+    budget (pathological; readers degrade, never return torn data)."""
+
+
+class TimeSeriesDB:
+    """Ring-per-series metric history.  Single writer (the reconcile
+    thread), seqlock-guarded readers — see module docstring."""
+
+    def __init__(self, raw_points: int = RAW_POINTS,
+                 mid_seconds: float = MID_SECONDS,
+                 mid_points: int = MID_POINTS,
+                 coarse_seconds: float = COARSE_SECONDS,
+                 coarse_points: int = COARSE_POINTS,
+                 heartbeat_seconds: float = HEARTBEAT_SECONDS,
+                 max_series: int = MAX_SERIES) -> None:
+        self.raw_points = raw_points
+        self.mid_seconds = mid_seconds
+        self.mid_points = mid_points
+        self.coarse_seconds = coarse_seconds
+        self.coarse_points = coarse_points
+        self.heartbeat_seconds = heartbeat_seconds
+        self.max_series = max_series
+        self._series: dict[str, _Series] = {}
+        #: Seqlock: odd while the writer mutates, even when stable.
+        self._wseq = 0
+        self.points_appended = 0
+        self.series_dropped = 0
+
+    # -- write path (reconcile thread ONLY) ---------------------------
+
+    def ingest(self, snapshot: dict[str, Any], now: float) -> int:
+        """Fold one ``Metrics.snapshot()`` into the store; returns the
+        number of points appended.  Unchanged values are skipped (flat
+        series re-anchor every ``heartbeat_seconds``), so a pass costs
+        O(changed series), not O(all series)."""
+        self._wseq += 1  # odd: mutation in progress
+        try:
+            appended = 0
+            for name, value in snapshot.get("counters", {}).items():
+                appended += self._append(name, now, float(value))
+            for name, value in snapshot.get("gauges", {}).items():
+                appended += self._append(name, now, float(value))
+            for name, s in snapshot.get("summaries", {}).items():
+                # Zero-count summaries ingest too: every cumulative
+                # series must be born at the SAME pass as its
+                # histogram-bucket siblings, or a window whose start
+                # precedes both births computes good/total against
+                # asymmetric baselines and can mask a miss.
+                appended += self._append(f"{name}:count", now,
+                                         float(s.get("count", 0)))
+                appended += self._append(f"{name}:sum", now,
+                                         float(s.get("sum", 0.0)))
+            summaries = snapshot.get("summaries", {})
+            for name, h in snapshot.get("histograms", {}).items():
+                for le, cum in h.get("buckets", ()):
+                    appended += self._append(f"{name}:le:{le:g}", now,
+                                             float(cum))
+                if name not in summaries:
+                    # A declared-but-unobserved histogram has bucket
+                    # series but no summary yet: anchor :count/:sum at
+                    # 0 from the SAME pass, or a burn window spanning
+                    # the series' birth computes good/total against
+                    # asymmetric baselines and can mask a miss.
+                    appended += self._append(f"{name}:count", now, 0.0)
+                    appended += self._append(f"{name}:sum", now, 0.0)
+            self.points_appended += appended
+            return appended
+        finally:
+            self._wseq += 1  # even: stable
+
+    def append(self, name: str, t: float, value: float) -> None:
+        """Direct single-point append (tests, offline rebuild).  Same
+        single-writer contract as ``ingest``."""
+        self._wseq += 1
+        try:
+            self._append(name, t, value, force=True)
+            self.points_appended += 1
+        finally:
+            self._wseq += 1
+
+    def _append(self, name: str, t: float, v: float,
+                force: bool = False) -> int:
+        series = self._series.get(name)
+        if series is None:
+            if len(self._series) >= self.max_series:
+                self.series_dropped += 1
+                return 0
+            series = _Series(self.raw_points, self.mid_points,
+                             self.coarse_points)
+            self._series[name] = series
+        if (not force and v == series.last_v
+                and t - series.last_t < self.heartbeat_seconds):
+            return 0
+        series.last_t = t
+        series.last_v = v
+        series.raw.append(t, v)
+        mid_start = math.floor(t / self.mid_seconds) * self.mid_seconds
+        series.open_mid = _fold(series.open_mid, series.mid,
+                                mid_start, t, v)
+        coarse_start = (math.floor(t / self.coarse_seconds)
+                        * self.coarse_seconds)
+        series.open_coarse = _fold(series.open_coarse, series.coarse,
+                                   coarse_start, t, v)
+        return 1
+
+    # -- guarded read path --------------------------------------------
+
+    def _guarded(self, fn, retries: int = 16):
+        """Copy-then-recheck under the seqlock; bounded retry.  Failed
+        attempts SLEEP briefly before retrying: a no-yield loop would
+        burn every retry in microseconds inside one multi-ms ingest
+        (the writer's critical section at 10k-series scale) and
+        spuriously degrade exactly when a pass is running — the
+        moment the debug endpoints exist for (review-found).  The
+        reconcile thread's own reads (the alert engine) never race
+        the writer — same thread — so they always hit the first,
+        sleep-free attempt."""
+        import time as _time
+
+        for attempt in range(retries):
+            if attempt:
+                _time.sleep(0.002)
+            s0 = self._wseq
+            if s0 % 2:
+                continue  # writer mid-mutation
+            try:
+                out = fn()
+            except (RuntimeError, KeyError, IndexError, ValueError):
+                continue  # mutated mid-copy; retry
+            if self._wseq == s0:
+                return out
+        raise TornRead("tsdb read raced the writer past the retry "
+                       "budget")
+
+    def series_count(self) -> int:
+        return len(self._series)
+
+    def series_names(self, prefix: str = "") -> list[str]:
+        def read() -> list[str]:
+            return sorted(n for n in self._series if n.startswith(prefix))
+        return self._guarded(read)
+
+    def points(self, name: str, start: float = -math.inf,
+               end: float = math.inf) -> tuple[np.ndarray, np.ndarray]:
+        """Range query: (ts, values) inside ``[start, end]``, oldest
+        first — raw resolution where raw retention covers, downsampled
+        ``last`` values (bucket start time) for the older remainder."""
+        def rows_of(ring: _AggRing,
+                    open_row: list[float] | None) -> np.ndarray:
+            rows = ring.ordered()
+            if open_row is not None:
+                rows = np.concatenate(
+                    (rows, np.asarray(open_row)[None, :]))
+            return rows
+
+        def read() -> tuple[np.ndarray, np.ndarray]:
+            series = self._series.get(name)
+            if series is None:
+                return (np.empty(0), np.empty(0))
+            rt, rv = series.raw.ordered()
+            wrapped = series.raw.n > series.raw.capacity
+            if len(rt) and (not wrapped or rt[0] <= start):
+                # Raw retention covers the whole window — either the
+                # ring never evicted anything (the downsample tiers
+                # only DUPLICATE raw history then; bucket starts
+                # truncate below the true birth, so they must not
+                # leak in) or the window starts inside it.  One
+                # binary-searched slice, no tier merge.
+                i0 = int(np.searchsorted(rt, start, side="left"))
+                i1 = int(np.searchsorted(rt, end, side="right"))
+                return rt[i0:i1].copy(), rv[i0:i1].copy()
+            # Coverage boundaries: raw answers [raw_oldest, ∞); mid
+            # answers [mid_oldest, raw_oldest); coarse the remainder.
+            # Segments are disjoint and time-ordered by construction,
+            # so concatenation needs no sort.
+            raw_oldest = rt[0] if len(rt) else math.inf
+            mid = rows_of(series.mid, series.open_mid)
+            mid_oldest = mid[0, _T] if len(mid) else raw_oldest
+            coarse = rows_of(series.coarse, series.open_coarse)
+            ts_parts, vs_parts = [], []
+            if len(coarse) and start < mid_oldest:
+                keep = ((coarse[:, _T] >= start)
+                        & (coarse[:, _T] < mid_oldest)
+                        & (coarse[:, _T] <= end))
+                ts_parts.append(coarse[keep][:, _T])
+                vs_parts.append(coarse[keep][:, _LAST])
+            if len(mid) and start < raw_oldest:
+                keep = ((mid[:, _T] >= start)
+                        & (mid[:, _T] >= mid_oldest)
+                        & (mid[:, _T] < raw_oldest)
+                        & (mid[:, _T] <= end))
+                ts_parts.append(mid[keep][:, _T])
+                vs_parts.append(mid[keep][:, _LAST])
+            keep = (rt >= start) & (rt <= end)
+            ts_parts.append(rt[keep])
+            vs_parts.append(rv[keep])
+            return np.concatenate(ts_parts), np.concatenate(vs_parts)
+        return self._guarded(read)
+
+    def value_at(self, name: str, t: float) -> float | None:
+        """Last recorded value at-or-before ``t`` (None: series unknown
+        or born after ``t``).
+
+        Hot path for the per-pass alert evaluation, so it avoids the
+        full merged-tier assembly wherever it can: O(1) when ``t`` is
+        at-or-after the newest point (every window END is), one raw
+        binary search while raw retention covers ``t`` (every window
+        START within ~raw_points passes is)."""
+        def read() -> float | None:
+            series = self._series.get(name)
+            if series is None:
+                return None
+            if t >= series.last_t:
+                return None if math.isinf(series.last_t) else series.last_v
+            hit = series.raw.last_at(t)
+            if hit is not None:
+                return hit
+            return None  # fall through to the merged-tier view below
+        fast = self._guarded(read)
+        if fast is not None:
+            return fast
+        ts, vs = self.points(name, end=t)
+        if not len(ts):
+            return None
+        return float(vs[-1])
+
+    def _first_value(self, name: str) -> float | None:
+        """Oldest retained value across tiers (the series-birth
+        baseline for ``delta``): the value at the EARLIEST retained
+        timestamp — a raw point while the raw ring hasn't wrapped,
+        else the oldest downsampled bucket."""
+        def read() -> float | None:
+            series = self._series.get(name)
+            if series is None:
+                return None
+            rt, rv = series.raw.ordered()
+            if len(rt) and series.raw.n <= series.raw.capacity:
+                # Raw never evicted: its first point IS the birth
+                # (tier buckets only duplicate raw history here).
+                return float(rv[0])
+            best: tuple[float, float] | None = None
+            if len(rt):
+                best = (float(rt[0]), float(rv[0]))
+            for ring in (series.coarse, series.mid):
+                if ring.n:
+                    row = ring.ordered()[0]
+                    if best is None or row[_T] < best[0]:
+                        best = (float(row[_T]), float(row[_LAST]))
+            return best[1] if best is not None else None
+        return self._guarded(read)
+
+    def delta(self, name: str, start: float, end: float) -> float | None:
+        """Cumulative-series delta over ``[start, end]``: value at
+        ``end`` minus value at ``start``.  A series born inside the
+        window uses its first retained point as the baseline (series
+        birth counts as the start of history, not as a jump from 0 —
+        a freshly-restarted controller must not alert on its own
+        catch-up).  None: no data at-or-before ``end``."""
+        v_end = self.value_at(name, end)
+        if v_end is None:
+            return None
+        v_start = self.value_at(name, start)
+        if v_start is None:
+            v_start = self._first_value(name)
+            if v_start is None:
+                return None
+        return v_end - v_start
+
+    # -- dump / load (bundles, /debugz/tsdb, offline replay) ----------
+
+    def dump(self, prefix: str = "", window_seconds: float | None = None,
+             now: float | None = None) -> dict[str, Any]:
+        """JSON-able snapshot of the store (the ``/debugz/tsdb`` body
+        and the incident bundle's ``tsdb`` section).  ``prefix``
+        filters series; ``window_seconds`` (with ``now``) trims to
+        recent history."""
+        start = -math.inf
+        if window_seconds is not None and now is not None:
+            start = now - window_seconds
+
+        def read() -> dict[str, Any]:
+            out: dict[str, Any] = {}
+            for name in sorted(self._series):
+                if not name.startswith(prefix):
+                    continue
+                series = self._series[name]
+                rt, rv = series.raw.ordered()
+                keep = rt >= start
+                # Full float precision on purpose: a rounded
+                # timestamp can land PAST a replay's query instant
+                # and silently shift window edges offline.
+                tiers: dict[str, Any] = {
+                    "raw": [[float(t), float(v)]
+                            for t, v in zip(rt[keep], rv[keep])],
+                    # True while the raw ring never evicted: the tier
+                    # rows below then only duplicate raw history and
+                    # a rebuild must skip them.
+                    "raw_complete": bool(
+                        series.raw.n <= series.raw.capacity)}
+                for tier_name, ring, open_row in (
+                        ("mid", series.mid, series.open_mid),
+                        ("coarse", series.coarse, series.open_coarse)):
+                    rows = ring.ordered()
+                    if open_row is not None:
+                        rows = np.concatenate(
+                            (rows, np.asarray(open_row)[None, :]))
+                    rows = rows[rows[:, _T] >= start]
+                    tiers[tier_name] = [
+                        [float(r[_T]), float(r[_LAST]),
+                         float(r[_MIN]), float(r[_MAX]), float(r[_SUM]),
+                         int(r[_N])] for r in rows]
+                out[name] = tiers
+            return out
+
+        try:
+            series = self._guarded(read)
+            unavailable = False
+        except TornRead:
+            series, unavailable = {}, True
+        body: dict[str, Any] = {
+            "tiers": {"raw_points": self.raw_points,
+                      "mid_seconds": self.mid_seconds,
+                      "coarse_seconds": self.coarse_seconds,
+                      "heartbeat_seconds": self.heartbeat_seconds},
+            "series_count": len(self._series),
+            "points_appended": self.points_appended,
+            "series_dropped": self.series_dropped,
+            "series": series,
+        }
+        if unavailable:
+            body["unavailable"] = "mutating"
+        return body
+
+    @classmethod
+    def from_dump(cls, dump: dict[str, Any]) -> "TimeSeriesDB":
+        """Rebuild a queryable store from a ``dump()`` body — the
+        offline-replay path (``python -m tpu_autoscaler.obs replay``).
+        Downsampled history is replayed as bucket-last points, so
+        window queries over the rebuilt store answer like the live one
+        did wherever raw retention covered."""
+        tiers = dump.get("tiers", {})
+        db = cls(raw_points=int(tiers.get("raw_points", RAW_POINTS)),
+                 mid_seconds=float(tiers.get("mid_seconds", MID_SECONDS)),
+                 coarse_seconds=float(tiers.get("coarse_seconds",
+                                                COARSE_SECONDS)),
+                 heartbeat_seconds=float(tiers.get("heartbeat_seconds",
+                                                   HEARTBEAT_SECONDS)))
+        for name, body in dump.get("series", {}).items():
+            raw = body.get("raw", [])
+            raw_oldest = raw[0][0] if raw else math.inf
+            seen: list[tuple[float, float]] = []
+            if not body.get("raw_complete", False):
+                # Mirror the live query path's coverage boundaries:
+                # mid answers [mid_oldest, raw_oldest), coarse only
+                # the remainder BELOW mid — replaying a coarse bucket
+                # inside mid's range would inject its end-of-bucket
+                # value up to 300 s early among 10 s-resolution rows.
+                mid_rows = [r for r in body.get("mid", ())
+                            if r[0] < raw_oldest]
+                mid_oldest = mid_rows[0][0] if mid_rows else raw_oldest
+                for row in body.get("coarse", ()):
+                    if row[0] < mid_oldest:
+                        seen.append((float(row[0]), float(row[1])))
+                for row in mid_rows:
+                    seen.append((float(row[0]), float(row[1])))
+            seen.extend((float(t), float(v)) for t, v in raw)
+            for t, v in sorted(seen):
+                db.append(name, t, v)
+        return db
+
+
+def iter_latest(db: TimeSeriesDB, names: Iterable[str],
+                now: float) -> dict[str, float]:
+    """Convenience: latest value per series (None-valued omitted)."""
+    out: dict[str, float] = {}
+    for name in names:
+        v = db.value_at(name, now)
+        if v is not None:
+            out[name] = v
+    return out
