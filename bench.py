@@ -1306,6 +1306,141 @@ def check_obs(series: int = OBS_SCALE_SERIES,
     return ok, info
 
 
+# Cost tier (ISSUE 11, docs/COST.md): the attribution ledger may not
+# eat the pass budget.  Mirrors the PR 9 adapter tier's shape: the
+# GATED number is the per-pass rollup cost (close_pass — conservation
+# check, metric export, frag scoring; O(states+combos), never
+# O(units)); per-dirty-unit ingestion (note_unit — the adapter-ingest
+# analog, charged per observation on the maintain loop the reconciler
+# already owns) is gated per unit.  Both at 10k single-host replica
+# units (the 10k-replica / 100k-pod fleet) with 10% of units flipping
+# state per pass.  The north-star overhead budget is re-checked with
+# the ledger ON (it is always on) as the end-to-end guard.
+COST_LEDGER_UNITS = 10_000
+COST_LEDGER_CHURN = 0.10
+COST_LEDGER_PASSES = 20
+COST_CLOSE_MS_GATE = 0.5
+COST_NOTE_US_GATE = 25.0
+
+
+def bench_cost_ledger(n_units: int = COST_LEDGER_UNITS,
+                      churn: float = COST_LEDGER_CHURN,
+                      passes: int = COST_LEDGER_PASSES) -> dict:
+    """Ledger pass cost at fleet scale: 10k v5e-8 replica units, 10%
+    state churn per pass, conservation + rebuild-oracle asserted."""
+    import random
+
+    from tpu_autoscaler.cost import CostLedger
+    from tpu_autoscaler.k8s.objects import Node, Pod
+    from tpu_autoscaler.k8s.payloads import tpu_host_payload
+    from tpu_autoscaler.topology.catalog import (
+        TPU_RESOURCE,
+        shape_by_name,
+    )
+
+    shape = shape_by_name("v5e-8")
+    units = []
+    for i in range(n_units):
+        sid = f"bench-s{i}"
+        node = Node(tpu_host_payload(
+            shape, sid, 0, created_at=0.0, pool=f"pool-{i % 8}",
+            preemptible=(i % 4 == 0)))
+        pod = Pod({
+            "metadata": {"name": f"bench-p{i}", "namespace": "default",
+                         "uid": f"bench-u{i}",
+                         "labels": {"batch.kubernetes.io/job-name":
+                                    f"bench-job{i}"}},
+            "spec": {"nodeName": node.name, "containers": [
+                {"resources": {"requests": {TPU_RESOURCE: "8"}}}]},
+            "status": {"phase": "Running"}})
+        units.append((sid, [node], [pod]))
+    fleet_chips = n_units * shape.chips
+
+    ledger = CostLedger()
+    now = 0.0
+    for sid, nodes, pods in units:
+        ledger.note_unit(sid, nodes, pods, "busy", now)
+    ledger.close_pass(now, fleet_chips)
+
+    rng = random.Random(0)
+    busy = [True] * n_units
+    moved = max(1, int(n_units * churn))
+    note_s = 0.0
+    best_close = float("inf")
+    conserved = True
+    for _ in range(passes):
+        now += 5.0
+        idxs = rng.sample(range(n_units), moved)
+        t0 = time.perf_counter()
+        for i in idxs:
+            sid, nodes, pods = units[i]
+            busy[i] = not busy[i]
+            ledger.note_unit(sid, nodes, pods if busy[i] else [],
+                             "busy" if busy[i] else "idle", now)
+        note_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        info = ledger.close_pass(now, fleet_chips)
+        best_close = min(best_close, time.perf_counter() - t0)
+        conserved = conserved and info["conserved"]
+    live, rebuilt = ledger.live_counts(), ledger.rebuild()
+    oracle_ok = all(live[k] == {kk: vv for kk, vv in rebuilt[k].items()
+                                if vv}
+                    for k in live)
+    return {
+        "info": "cost_ledger",
+        "units": n_units,
+        "churn_per_pass": moved,
+        "passes": passes,
+        "close_ms_per_pass": round(best_close * 1e3, 4),
+        "note_us_per_dirty_unit": round(
+            note_s / (passes * moved) * 1e6, 2),
+        "conserved_every_pass": conserved,
+        "rebuild_oracle_ok": oracle_ok,
+        "close_gate_ms": COST_CLOSE_MS_GATE,
+        "note_gate_us": COST_NOTE_US_GATE,
+    }
+
+
+def check_cost(units: int = COST_LEDGER_UNITS,
+               close_gate: float = COST_CLOSE_MS_GATE,
+               note_gate: float = COST_NOTE_US_GATE
+               ) -> tuple[bool, dict]:
+    """Gate: ledger pass-close cost <= 0.5 ms at 10k units / 10%
+    churn, per-dirty-unit note cost bounded, conservation + rebuild
+    oracle green, and the north-star overhead budget still green with
+    the ledger ON.  Records BENCH_COST.json."""
+    scale = bench_cost_ledger(n_units=units)
+    print(json.dumps(scale), file=sys.stderr)
+    # End-to-end guard: the full controller (ledger always on) still
+    # inside the overhead budget.  Warm once, gate on best CPU time of
+    # three like the default north-star gate (the 10k-unit ledger
+    # bench above leaves caches cold — one run is all warm-up).
+    run_north_star()
+    results = [run_north_star() for _ in range(3)]
+    north_cpu = min(r["cpu_s"] for r in results)
+    ok = (scale["close_ms_per_pass"] <= close_gate
+          and scale["note_us_per_dirty_unit"] <= note_gate
+          and scale["conserved_every_pass"]
+          and scale["rebuild_oracle_ok"]
+          and north_cpu <= OVERHEAD_BUDGET_S)
+    info = {"scale": scale, "north_star_cpu_s": round(north_cpu, 4),
+            "north_star_budget_s": OVERHEAD_BUDGET_S}
+    _record_tier("BENCH_COST.json", "cost", {
+        "close_ms_per_pass": scale["close_ms_per_pass"],
+        "note_us_per_dirty_unit": scale["note_us_per_dirty_unit"],
+        "units": scale["units"],
+        "churn_per_pass": scale["churn_per_pass"],
+        "north_star_cpu_s": round(north_cpu, 4),
+        "gates": {"close_ms": close_gate, "note_us": note_gate,
+                  "north_star_s": OVERHEAD_BUDGET_S},
+    })
+    if not ok:
+        print(json.dumps({"error": "cost tier regression: ledger pass "
+                          "cost, conservation, or north-star budget "
+                          "above gate", **info}), file=sys.stderr)
+    return ok, info
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -1427,6 +1562,31 @@ def main(argv: list[str] | None = None) -> int:
             # budget" 1.0 (review-found).
             "vs_baseline": (round(budget / marginal, 2)
                             if marginal > 0 else None),
+        }))
+        return 0 if ok else 1
+    if argv and argv[0] == "cost":
+        # Cost-ledger tier (ISSUE 11, scripts/full_suite.sh +
+        # ci_gate.sh): pass-close <= 0.5 ms at 10k units / 10% churn,
+        # per-dirty-unit note bounded, conservation + rebuild oracle
+        # green, north-star budget green with the ledger ON; records
+        # BENCH_COST.json.
+        ap = argparse.ArgumentParser(prog="bench.py cost")
+        ap.add_argument("--units", type=int, default=COST_LEDGER_UNITS)
+        ap.add_argument("--close-gate", type=float,
+                        default=COST_CLOSE_MS_GATE)
+        ap.add_argument("--note-gate", type=float,
+                        default=COST_NOTE_US_GATE)
+        args = ap.parse_args(argv[1:])
+        ok, info = check_cost(units=args.units,
+                              close_gate=args.close_gate,
+                              note_gate=args.note_gate)
+        close_ms = info["scale"]["close_ms_per_pass"]
+        print(json.dumps({
+            "metric": "cost_ledger_close_ms_per_pass",
+            "value": close_ms,
+            "unit": "ms_per_pass",
+            "vs_baseline": (round(args.close_gate / close_ms, 2)
+                            if close_ms else None),
         }))
         return 0 if ok else 1
     if argv and argv[0] == "trace":

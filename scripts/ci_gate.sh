@@ -31,6 +31,11 @@
 #      within max(5% of the traced-only observe pass, 0.5 ms),
 #      10k-series ingest + alert-evaluation under their ms gates;
 #      BENCH_OBS.json — ISSUE 10, docs/OBSERVABILITY.md)
+#   11 cost tier (bench.py cost: attribution-ledger pass-close
+#      <= 0.5 ms at 10k units / 10% churn, per-dirty-unit note
+#      bounded, conservation + rebuild oracle green, north-star
+#      budget green with the ledger ON; BENCH_COST.json — ISSUE 11,
+#      docs/COST.md)
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -40,26 +45,26 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/9] invariant analysis (--format=$fmt)"
+echo "== [1/10] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/9] mypy strict islands"
+echo "== [2/10] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/9] deterministic-schedule race tier"
+echo "== [3/10] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
 
-echo "== [4/9] tracer-overhead gate"
+echo "== [4/10] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [5/9] mega-cluster scale tiers"
+echo "== [5/10] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [6/9] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts)"
+echo "== [6/10] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -82,13 +87,16 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 300 --profile alerts || exit 7
 
-echo "== [7/9] policy replay tier"
+echo "== [7/10] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [8/9] serving tier (adapter hot path + outcome replay)"
+echo "== [8/10] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
-echo "== [9/9] obs tier (TSDB ingest + alert evaluation)"
+echo "== [9/10] obs tier (TSDB ingest + alert evaluation)"
 JAX_PLATFORMS=cpu python bench.py obs || exit 10
+
+echo "== [10/10] cost tier (attribution ledger pass cost + conservation)"
+JAX_PLATFORMS=cpu python bench.py cost || exit 11
 
 echo "CI GATE GREEN"

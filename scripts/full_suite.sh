@@ -97,6 +97,13 @@ JAX_PLATFORMS=cpu python bench.py trace
 # "Overhead gates").
 JAX_PLATFORMS=cpu python bench.py obs
 
+# Cost tier (ISSUE 11): the attribution ledger's pass-close cost
+# <= 0.5 ms at 10k replica units with 10% state churn, per-dirty-unit
+# ingestion bounded, the conservation identity + rebuild oracle green,
+# and the north-star overhead budget (12 ms) still green with the
+# ledger ON; results merge into BENCH_COST.json (docs/COST.md).
+JAX_PLATFORMS=cpu python bench.py cost
+
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py
   --ignore=tests/test_sp.py --ignore=tests/test_pipeline.py

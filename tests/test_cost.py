@@ -1,0 +1,698 @@
+"""Cost & capacity attribution ledger (ISSUE 11, docs/COST.md).
+
+Covers the price book, the pure classification, the ledger's
+incremental accumulators against a from-scratch rebuild oracle under
+seeded churn (the informer-indices suite shape), the conservation
+identity, the gang incarnation-epoch regression, the fragmentation
+scorer, the reconciler wiring (/debugz/cost, pass records, idle
+reclaim, incident bundles), the policy waste refactor, the new alert
+rules, and the `cost-report` / `metrics-history --format csv` CLIs —
+ending with the acceptance path: a chaos alerts-profile incident
+bundle rendering a non-trivial bill.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+from click.testing import CliRunner
+
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.cost import (
+    STATES,
+    CostLedger,
+    PriceBook,
+    classify_cost_state,
+    render_bill,
+    score_pools,
+    tier_of_labels,
+    windowed_bill,
+)
+from tpu_autoscaler.engine.planner import PoolPolicy
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.k8s.payloads import tpu_host_payload
+from tpu_autoscaler.main import cli
+from tpu_autoscaler.metrics import Metrics
+from tpu_autoscaler.sim import gang_pods
+from tpu_autoscaler.topology.catalog import TPU_RESOURCE, shape_by_name
+
+
+def _unit(sid: str, shape_name: str = "v5e-8", *, hosts: int | None = None,
+          pool: str | None = None, preemptible: bool = False,
+          reservation: bool = False, unknown_shape: bool = False
+          ) -> list[Node]:
+    shape = shape_by_name(shape_name)
+    count = shape.hosts if hosts is None else hosts
+    nodes = []
+    for i in range(count):
+        payload = tpu_host_payload(shape, sid, i, created_at=0.0,
+                                   pool=pool, preemptible=preemptible)
+        if reservation:
+            payload["metadata"]["labels"][
+                "cloud.google.com/reservation-name"] = "res-1"
+        if unknown_shape:
+            payload["metadata"]["labels"][
+                "cloud.google.com/gke-tpu-accelerator"] = "tpu-vX-test"
+        nodes.append(Node(payload))
+    return nodes
+
+
+def _pod(uid: str, job: str, *, ns: str = "default", chips: int = 8,
+         node: str | None = None) -> Pod:
+    return Pod({
+        "metadata": {"name": f"{job}-{uid}", "namespace": ns,
+                     "uid": uid,
+                     "labels": {"batch.kubernetes.io/job-name": job}},
+        "spec": {"nodeName": node, "containers": [
+            {"resources": {"requests": {TPU_RESOURCE: str(chips)}}}]},
+        "status": {"phase": "Running"},
+    })
+
+
+class TestPriceBook:
+    def test_default_rates_ordered_by_tier(self):
+        book = PriceBook()
+        od, priced = book.rate("tpu-v5-lite-device", "on_demand")
+        res, _ = book.rate("tpu-v5-lite-device", "reservation")
+        spot, _ = book.rate("tpu-v5-lite-device", "spot")
+        assert priced
+        assert spot < res < od
+
+    def test_unpriced_class_falls_back_and_flags(self):
+        book = PriceBook()
+        rate, priced = book.rate("tpu-vX-test", "on_demand")
+        assert not priced
+        assert rate == book.default_rate
+
+    def test_from_dict_generation_expands(self):
+        book = PriceBook.from_dict({"classes": {"v5e": 9.0},
+                                    "tiers": {"spot": 0.5}})
+        rate, priced = book.rate("tpu-v5-lite-podslice", "spot")
+        assert priced and rate == pytest.approx(4.5)
+
+    def test_from_dict_rejects_unknown_class_and_tier(self):
+        with pytest.raises(ValueError):
+            PriceBook.from_dict({"classes": {"v99": 1.0}})
+        with pytest.raises(ValueError):
+            PriceBook.from_dict({"tiers": {"weekend": 0.1}})
+
+    def test_tier_detection(self):
+        assert tier_of_labels({"cloud.google.com/gke-spot": "true"}) \
+            == "spot"
+        assert tier_of_labels(
+            {"cloud.google.com/reservation-name": "r"}) == "reservation"
+        assert tier_of_labels({}) == "on_demand"
+
+
+class TestClassify:
+    def test_every_branch(self):
+        kw = dict(has_workload=False, serving=False, under_repair=False,
+                  cancellable_drain=False, policy_hold=False,
+                  spare=False, broken=False, stranded_overdue=False)
+        assert classify_cost_state("busy", **{**kw, "has_workload": True}
+                                   ) == "training"
+        assert classify_cost_state(
+            "busy", **{**kw, "has_workload": True, "serving": True}
+        ) == "serving"
+        assert classify_cost_state(
+            "draining", **{**kw, "cancellable_drain": True}) == "idle"
+        assert classify_cost_state(
+            "draining", **{**kw, "under_repair": True}) == "repair"
+        assert classify_cost_state("draining", **kw) == "repair"
+        assert classify_cost_state("unhealthy", **kw) == "stranded"
+        assert classify_cost_state(
+            "unhealthy", **{**kw, "has_workload": True}) == "training"
+        assert classify_cost_state("provisioning", **kw) \
+            == "provisioning"
+        assert classify_cost_state(
+            "provisioning", **{**kw, "broken": True,
+                               "stranded_overdue": True}) == "stranded"
+        assert classify_cost_state(
+            "idle", **{**kw, "policy_hold": True}) == "prewarm"
+        assert classify_cost_state("spare", **kw) == "prewarm"
+        assert classify_cost_state("idle-drainable", **kw) == "idle"
+        assert classify_cost_state("launch-grace", **kw) == "idle"
+
+
+class TestLedger:
+    def test_accrual_and_conservation(self):
+        led = CostLedger(metrics=Metrics())
+        nodes = _unit("s1")
+        pod = _pod("u1", "job-a", node=nodes[0].name)
+        led.note_unit("s1", nodes, [pod], "busy", 0.0)
+        info = led.close_pass(0.0, 8)
+        assert info["conserved"] and info["chips"]["training"] == 8
+        # 10 s busy, then idle for 10 s.
+        led.note_unit("s1", nodes, [pod], "busy", 10.0)  # no-op
+        led.close_pass(10.0, 8)
+        led.note_unit("s1", nodes, [], "idle-drainable", 20.0)
+        led.close_pass(20.0, 8)
+        led.close_pass(30.0, 8)
+        body = led.debug_state(30.0)
+        assert body["states"]["training"]["chip_seconds"] \
+            == pytest.approx(8 * 20.0)
+        assert body["states"]["idle"]["chip_seconds"] \
+            == pytest.approx(8 * 10.0)
+        assert body["conservation"]["violations"] == 0
+
+    def test_conservation_violation_detected(self):
+        metrics = Metrics()
+        led = CostLedger(metrics=metrics)
+        led.note_unit("s1", _unit("s1"), [], "idle", 0.0)
+        info = led.close_pass(0.0, 999)  # fleet lies
+        assert not info["conserved"]
+        assert led.conservation_violations == 1
+        assert metrics.snapshot()["counters"][
+            "cost_conservation_violations"] == 1
+
+    def test_remove_unit_releases_chips(self):
+        led = CostLedger()
+        led.note_unit("s1", _unit("s1"), [], "idle", 0.0)
+        led.close_pass(0.0, 8)
+        led.remove_unit("s1", 5.0)
+        info = led.close_pass(10.0, 0)
+        assert info["conserved"]
+        # Chip-seconds up to the removal stay attributed.
+        assert led.debug_state(10.0)["states"]["idle"]["chip_seconds"] \
+            == pytest.approx(8 * 5.0)
+
+    def test_accrued_chip_seconds_reads_current_state_span(self):
+        led = CostLedger()
+        led.note_unit("s1", _unit("s1"), [], "idle", 0.0,
+                      policy_hold=True)
+        assert led.accrued_chip_seconds(["s1"], 30.0, state="prewarm") \
+            == pytest.approx(8 * 30.0)
+        assert led.accrued_chip_seconds(["s1"], 30.0, state="idle") \
+            is None
+        assert led.accrued_chip_seconds(["nope"], 30.0) is None
+
+    def test_unpriced_class_counted(self):
+        metrics = Metrics()
+        led = CostLedger(metrics=metrics)
+        led.note_unit("sx", _unit("sx", unknown_shape=True), [],
+                      "idle", 0.0)
+        led.close_pass(0.0, 8)
+        led.close_pass(100.0, 8)
+        assert metrics.snapshot()["counters"][
+            "cost_unpriced_chip_seconds"] == pytest.approx(800.0)
+
+    def test_stranded_partial_slice_past_window(self):
+        led = CostLedger(stranded_after_seconds=100.0)
+        nodes = _unit("s1", "v5e-16", hosts=2)  # 2 of 4 hosts
+        led.note_unit("s1", nodes, [], "provisioning", 50.0,
+                      first_seen=0.0)
+        assert led.live_counts()["state"] == {"provisioning": 8}
+        led.note_unit("s1", nodes, [], "provisioning", 150.0,
+                      first_seen=0.0)
+        assert led.live_counts()["state"] == {"stranded": 8}
+
+    def test_gang_epoch_restart_never_double_counts(self):
+        # ISSUE 11 satellite: a Job completing and restarting under
+        # the same (ns,name) within one pass must not double-count its
+        # final partial pass — rollups key by uid-epoch.
+        led = CostLedger()
+        nodes = _unit("s1")
+        led.note_unit("s1", nodes, [_pod("a1", "j")], "busy", 0.0)
+        led.close_pass(0.0, 8)
+        # Restart: disjoint uid set, same gang name, same unit.
+        led.note_unit("s1", nodes, [_pod("b1", "j")], "busy", 10.0)
+        led.close_pass(10.0, 8)
+        led.close_pass(20.0, 8)
+        gangs = led.debug_state(20.0)["gangs"]
+        assert gangs["job/default/j#0"] == pytest.approx(80.0)
+        assert gangs["job/default/j#1"] == pytest.approx(80.0)
+        assert sum(gangs.values()) == pytest.approx(8 * 20.0)
+        # Overlapping uid sets (members materializing gradually) stay
+        # ONE incarnation.
+        led.note_unit("s1", nodes,
+                      [_pod("b1", "j"), _pod("b2", "j")], "busy", 25.0)
+        gangs = led.debug_state(25.0)["gangs"]
+        assert "job/default/j#2" not in gangs
+
+    def test_gang_epoch_table_bounded(self):
+        # Review-found: epoch entries must age out with their gang
+        # rollups (a churn fleet restarting replicas under fresh names
+        # would otherwise grow the table for the process lifetime).
+        led = CostLedger()
+        nodes = _unit("s1")
+        for i in range(5):
+            led.note_unit("s1", nodes, [_pod(f"x{i}", f"job-{i}")],
+                          "busy", float(i))
+            led.note_unit("s1", nodes, [], "idle", float(i) + 0.5)
+        assert len(led._gang_epoch) == 5
+        t = 10_000.0
+        for p in range(65):  # past retention + the amortized sweep
+            led.close_pass(t + p, 8)
+        assert not led._gang_epoch
+        assert not led._gang
+
+    def test_gang_attrs_for_traces(self):
+        led = CostLedger()
+        led.note_unit("s1", _unit("s1"), [_pod("a1", "j")], "busy", 0.0)
+        attrs = led.gang_attrs(("job", "default", "j"), 10.0)
+        assert attrs == {"cost_chip_seconds": pytest.approx(80.0)}
+        assert led.gang_attrs(("job", "default", "nope"), 10.0) is None
+
+
+class TestLedgerPropertySuite:
+    """Seeded churn: the incremental accumulators must match a
+    from-scratch rebuild EXACTLY (chips, ints) and an independent
+    chip-second simulation within float tolerance, with conservation
+    holding at every close."""
+
+    SLICE_STATES = ("busy", "idle", "idle-drainable", "provisioning",
+                    "draining", "unhealthy", "spare", "launch-grace")
+
+    def test_seeded_churn_matches_rebuild(self):
+        for seed in range(12):
+            rng = random.Random(seed)
+            led = CostLedger(stranded_after_seconds=50.0)
+            catalog = []
+            for i in range(24):
+                shape = rng.choice(("v5e-8", "v5e-16"))
+                catalog.append((
+                    f"u{i}",
+                    _unit(f"u{i}", shape,
+                          pool=f"pool-{i % 3}",
+                          preemptible=rng.random() < 0.3,
+                          reservation=rng.random() < 0.3,
+                          unknown_shape=rng.random() < 0.1),
+                    shape))
+            live: dict[str, int] = {}
+            oracle_cs: dict[str, float] = {}
+            state_of: dict[str, str] = {}
+            last_t = 0.0
+            t = 0.0
+            for step in range(60):
+                t += rng.uniform(1.0, 10.0)
+                # Accrue the oracle over [last_t, t] with the OLD states.
+                dt = t - last_t
+                for uid, st in state_of.items():
+                    oracle_cs[st] = oracle_cs.get(st, 0.0) \
+                        + live[uid] * dt
+                last_t = t
+                for _ in range(rng.randint(1, 6)):
+                    uid, nodes, shape = rng.choice(catalog)
+                    if uid in live and rng.random() < 0.15:
+                        led.remove_unit(uid, t)
+                        del live[uid]
+                        del state_of[uid]
+                        continue
+                    slice_state = rng.choice(self.SLICE_STATES)
+                    pods = []
+                    if rng.random() < 0.5:
+                        job = f"job-{rng.randrange(6)}"
+                        ns = ("tpu-serving" if rng.random() < 0.3
+                              else "default")
+                        pods = [_pod(f"{uid}-{rng.randrange(4)}", job,
+                                     ns=ns,
+                                     chips=rng.choice((4, 8, 16)))]
+                    led.note_unit(
+                        uid, nodes, pods, slice_state, t,
+                        under_repair=rng.random() < 0.1,
+                        cancellable_drain=rng.random() < 0.2,
+                        policy_hold=rng.random() < 0.15,
+                        spare=rng.random() < 0.1,
+                        first_seen=0.0 if rng.random() < 0.5 else t)
+                    live[uid] = sum(
+                        int(n.allocatable.get(TPU_RESOURCE))
+                        for n in nodes)
+                    state_of[uid] = led._units[uid].state
+                fleet = sum(live.values())
+                info = led.close_pass(t, fleet)
+                assert info["conserved"], (seed, step, info)
+                # Incremental chip counts == from-scratch rebuild.
+                rebuilt = led.rebuild()
+                liv = led.live_counts()
+                for key in liv:
+                    trimmed = {k: v for k, v in rebuilt[key].items()
+                               if v}
+                    assert liv[key] == trimmed, (seed, step, key)
+            # Chip-second totals vs the independent oracle.
+            body = led.debug_state(last_t)
+            for state in STATES:
+                want = oracle_cs.get(state, 0.0)
+                got = body["states"][state]["chip_seconds"]
+                # debug_state rounds to 3 decimals for JSON hygiene;
+                # the accumulators themselves are exact to float.
+                assert got == pytest.approx(want, rel=1e-9, abs=1e-3), \
+                    (seed, state)
+
+
+class TestFragScorer:
+    def test_stranded_dominates(self):
+        scores = score_pools(pool_chips={"p": 32}, stranded={"p": 16},
+                             over_chips={}, res_busy={}, idle_spot={})
+        assert scores["p"].score == pytest.approx(0.5)
+
+    def test_displacement_matches_same_shape_only(self):
+        scores = score_pools(
+            pool_chips={"p": 16, "q": 8},
+            stranded={}, over_chips={},
+            res_busy={("p", "v5e-16"): 16},
+            idle_spot={"v5e-16": 8, "v5e-8": 64})
+        assert scores["p"].displaced_chips == 8
+        assert scores["q"].displaced_chips == 0
+
+    def test_score_clipped_to_one(self):
+        scores = score_pools(pool_chips={"p": 8}, stranded={"p": 8},
+                             over_chips={"p": 8},
+                             res_busy={("p", "v5e-8"): 8},
+                             idle_spot={"v5e-8": 8})
+        assert scores["p"].score == 1.0
+
+    def test_overprovision_tracked_by_ledger(self):
+        led = CostLedger()
+        nodes = _unit("s1", "v5e-16")  # 16 chips
+        pod = _pod("a1", "j", chips=8, node=nodes[0].name)
+        led.note_unit("s1", nodes, [pod], "busy", 0.0)
+        assert led.live_counts()["over"] == {
+            "tpu-v5-lite-podslice": 8}
+
+
+class TestCostAlertRules:
+    def test_new_rules_present_and_documented_metrics(self):
+        from tpu_autoscaler.obs.alerts import default_rules
+
+        names = {r.name for r in default_rules()}
+        assert {"stranded-capacity-burn", "cost-budget-burn"} <= names
+
+    def test_stranded_burn_fires_on_sustained_strand(self):
+        from tpu_autoscaler.obs import AlertEngine, TimeSeriesDB
+        from tpu_autoscaler.obs.alerts import default_rules
+
+        rule = next(r for r in default_rules()
+                    if r.name == "stranded-capacity-burn")
+        engine = AlertEngine((rule,))
+        db = TimeSeriesDB()
+        total = 0.0
+        fired = False
+        for p in range(800):
+            now = float(p) * 5.0
+            total += 16.0 * 5.0  # 16 chips stranded (rate 16 > 8)
+            db.append("cost_chip_seconds_stranded", now, total)
+            result = engine.evaluate(db, now)
+            fired = fired or any(tr.firing for tr in result.transitions)
+        assert fired
+
+
+def _run_scaleup(passes: int = 60, **cfg_kw):
+    kube = FakeKube()
+    actuator = FakeActuator(kube, provision_delay=10.0,
+                            stagger_seconds=5.0)
+    controller = Controller(
+        kube, actuator,
+        ControllerConfig(policy=PoolPolicy(spare_nodes=0),
+                         grace_seconds=30.0,
+                         idle_threshold_seconds=60.0,
+                         drain_grace_seconds=10.0, **cfg_kw))
+    for p in gang_pods("v5e-16", "job-a"):
+        kube.add_pod(p)
+    t = 0.0
+    for _ in range(passes):
+        controller.reconcile_once(now=t)
+        kube.schedule_step()
+        t += 5.0
+    return kube, controller, t
+
+
+class TestReconcilerWiring:
+    def test_states_conserve_through_a_scaleup_lifecycle(self):
+        kube, controller, t = _run_scaleup()
+        snap = controller.metrics.snapshot()
+        gauges = snap["gauges"]
+        assert sum(gauges[f"cost_chips_{s}"] for s in STATES) \
+            == gauges["fleet_chips"]
+        assert gauges.get("cost_conservation_violations") is None
+        assert "cost_conservation_violations" not in snap["counters"]
+        counters = snap["counters"]
+        # The staggered 4-host provision spent time behind the barrier,
+        # then ran the gang.
+        assert counters.get("cost_chip_seconds_provisioning", 0) > 0
+        assert counters.get("cost_chip_seconds_training", 0) > 0
+        # Pass records carry the cost section.
+        passes = controller.recorder.dump()["passes"]
+        assert passes[-1]["cost"]["conserved"] is True
+
+    def test_idle_reclaim_reads_ledger_waste(self):
+        kube, controller, t = _run_scaleup(passes=40)
+        # Complete the job: pods vanish, the slice idles, then drains.
+        for p in list(kube.list_pods()):
+            kube.delete_pod(p["metadata"].get("namespace", "default"),
+                            p["metadata"]["name"])
+        for _ in range(60):
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 5.0
+        counters = controller.metrics.snapshot()["counters"]
+        assert counters.get("cost_idle_chip_seconds_reclaimed", 0) > 0
+        # Fleet drained to zero and conservation still holds.
+        gauges = controller.metrics.snapshot()["gauges"]
+        assert gauges["fleet_chips"] == 0
+        assert sum(gauges[f"cost_chips_{s}"] for s in STATES) == 0
+
+    def test_cost_route_and_bundle(self):
+        _, controller, t = _run_scaleup(passes=30)
+        body = controller.cost_route()
+        assert body["conservation"]["violations"] == 0
+        assert set(body["states"]) == set(STATES)
+        bundle = controller.incident_bundle("test")
+        assert bundle["cost"]["states"]["training"]["chip_seconds"] > 0
+        # The bundle round-trips through json (allow_nan contract).
+        json.dumps(bundle, allow_nan=False, default=str)
+
+    def test_serving_namespace_attributes_to_serving(self):
+        kube = FakeKube()
+        actuator = FakeActuator(kube, provision_delay=0.0)
+        controller = Controller(
+            kube, actuator,
+            ControllerConfig(policy=PoolPolicy(spare_nodes=0),
+                             grace_seconds=10.0))
+        for p in gang_pods("v5e-8", "web-1"):
+            p["metadata"]["namespace"] = "tpu-serving"
+            kube.add_pod(p)
+        t = 0.0
+        for _ in range(30):
+            controller.reconcile_once(now=t)
+            kube.schedule_step()
+            t += 5.0
+        counters = controller.metrics.snapshot()["counters"]
+        assert counters.get("cost_chip_seconds_serving", 0) > 0
+        assert counters.get("cost_chip_seconds_training", 0) == 0
+
+    def test_no_maintenance_suspends_close(self):
+        kube = FakeKube()
+        controller = Controller(
+            kube, FakeActuator(kube),
+            ControllerConfig(no_maintenance=True))
+        controller.reconcile_once(now=0.0)
+        assert "cost" not in controller.recorder.dump()["passes"][-1]
+        assert controller.cost.pass_seq == 0
+
+
+def _prewarm_gang():
+    from tpu_autoscaler.k8s.gangs import Gang
+    from tpu_autoscaler.policy.engine import _probe_pod_payload
+
+    return Gang(key=("prewarm", "tpu-autoscaler", "pw1"),
+                pods=[Pod(_probe_pod_payload("v5e-8", "pw1",
+                                             "tpu-autoscaler"))])
+
+
+class TestPolicyWasteRefactor:
+    def test_expiry_waste_sourced_from_ledger(self):
+        from tpu_autoscaler.policy import PolicyConfig, PolicyEngine
+        from tpu_autoscaler.policy.engine import _Prewarm
+        from tpu_autoscaler.policy.slo import PrewarmDecision
+
+        class FakeLedger:
+            def accrued_chip_seconds(self, units, now, state=None):
+                assert state == "prewarm"
+                return 123.0
+
+        metrics = Metrics()
+        engine = PolicyEngine(PolicyConfig())
+        engine.bind(metrics=metrics, cost_ledger=FakeLedger())
+        decision = PrewarmDecision(
+            key="k1", shape_name="v5e-8",
+            accel_class="tpu-v5-lite-device", chips=8,
+            predicted_at=0.0, confidence=0.9,
+            expected_waste_chip_seconds=0.0, reason="test")
+        pw = _Prewarm(decision=decision, gang=_prewarm_gang(),
+                      created_at=0.0,
+                      ready_at=10.0, unit_ids=("u1",))
+        engine._prewarms["k1"] = pw
+        engine.observe([], [], [], [], now=10_000.0)
+        assert metrics.snapshot()["counters"][
+            "wasted_prewarm_chip_seconds"] == pytest.approx(123.0)
+
+    def test_expiry_waste_estimate_without_ledger(self):
+        from tpu_autoscaler.policy import PolicyConfig, PolicyEngine
+        from tpu_autoscaler.policy.engine import _Prewarm
+        from tpu_autoscaler.policy.slo import PrewarmDecision
+
+        metrics = Metrics()
+        engine = PolicyEngine(PolicyConfig())
+        engine.bind(metrics=metrics)
+        decision = PrewarmDecision(
+            key="k1", shape_name="v5e-8",
+            accel_class="tpu-v5-lite-device", chips=8,
+            predicted_at=0.0, confidence=0.9,
+            expected_waste_chip_seconds=0.0, reason="test")
+        pw = _Prewarm(decision=decision, gang=_prewarm_gang(),
+                      created_at=0.0,
+                      ready_at=100.0, unit_ids=("u1",))
+        engine._prewarms["k1"] = pw
+        engine.observe([], [], [], [], now=700.0)
+        assert metrics.snapshot()["counters"][
+            "wasted_prewarm_chip_seconds"] == pytest.approx(
+            8 * 600.0)
+
+    def test_rolling_waste_helper(self):
+        from tpu_autoscaler.policy.slo import rolling_waste
+
+        events = [(0.0, 10.0), (50.0, 20.0), (90.0, 30.0)]
+        kept, total = rolling_waste(events, 100.0, 60.0)
+        assert kept == [(50.0, 20.0), (90.0, 30.0)]
+        assert total == pytest.approx(50.0)
+
+
+class TestRenderers:
+    def test_render_bill_nontrivial(self):
+        _, controller, t = _run_scaleup(passes=30)
+        text = render_bill(controller.cost_route())
+        assert "FLEET BILL" in text
+        assert "training" in text
+        assert "conservation: OK" in text
+
+    def test_windowed_bill_from_bundle(self):
+        _, controller, t = _run_scaleup(passes=40)
+        bundle = controller.incident_bundle("test")
+        body = windowed_bill(bundle["tsdb"], 100.0)
+        assert body["chip_seconds_by_state"]
+        assert body["dollar_proxy"] is not None
+
+
+class TestCliSurfaces:
+    def _bundle_file(self, tmp_path, passes=40):
+        _, controller, t = _run_scaleup(passes=passes)
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(controller.incident_bundle("test"),
+                                   default=str))
+        return str(path)
+
+    def test_cost_report_from_bundle(self, tmp_path):
+        path = self._bundle_file(tmp_path)
+        result = CliRunner().invoke(cli, ["cost-report", "--from", path])
+        assert result.exit_code == 0, result.output
+        assert "FLEET BILL" in result.output
+        assert "conservation: OK" in result.output
+
+    def test_cost_report_window(self, tmp_path):
+        path = self._bundle_file(tmp_path)
+        result = CliRunner().invoke(cli, [
+            "cost-report", "--from", path, "--window", "120"])
+        assert result.exit_code == 0, result.output
+        assert "WINDOWED BILL" in result.output
+
+    def test_cost_report_rejects_costless_dump(self, tmp_path):
+        path = tmp_path / "plain.json"
+        path.write_text(json.dumps({"passes": []}))
+        result = CliRunner().invoke(cli, ["cost-report", "--from",
+                                          str(path)])
+        assert result.exit_code != 0
+        assert "no cost section" in result.output
+
+    def test_metrics_history_csv_listing(self, tmp_path):
+        path = self._bundle_file(tmp_path, passes=20)
+        result = CliRunner().invoke(cli, [
+            "metrics-history", "--from", path, "--prefix", "cost_",
+            "--format", "csv"])
+        assert result.exit_code == 0, result.output
+        lines = result.output.strip().splitlines()
+        assert lines[0] == "series,points,last_t,last_value"
+        assert any(line.startswith("cost_chip_seconds_training,")
+                   for line in lines)
+
+    def test_metrics_history_csv_single_series(self, tmp_path):
+        path = self._bundle_file(tmp_path, passes=20)
+        result = CliRunner().invoke(cli, [
+            "metrics-history", "--from", path,
+            "cost_chip_seconds_training", "--format", "csv"])
+        assert result.exit_code == 0, result.output
+        lines = result.output.strip().splitlines()
+        assert lines[0] == "series,tier,t,value,min,max,sum,count"
+        raws = [ln for ln in lines[1:] if ",raw," in ln]
+        assert raws, lines
+        # Values parse back as floats (offline-analysis contract).
+        t, v = raws[-1].split(",")[2:4]
+        float(t), float(v)
+
+    def test_obs_replay_renders_cost_section(self, tmp_path):
+        from tpu_autoscaler.obs.__main__ import main as obs_main
+
+        path = self._bundle_file(tmp_path, passes=20)
+        import io
+        from contextlib import redirect_stdout
+
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = obs_main(["replay", path])
+        assert rc == 0
+        assert "== cost" in out.getvalue()
+        assert "FLEET BILL" in out.getvalue()
+
+
+class TestDebugzIndex:
+    def test_index_lists_registered_routes(self):
+        import urllib.request
+
+        metrics = Metrics()
+        metrics.serve(0, debugz=lambda: {"ok": True},
+                      routes={"/debugz/tsdb": lambda p: {},
+                              "/debugz/cost": lambda p: {}})
+        url = f"http://127.0.0.1:{metrics.bound_port}/debugz/index"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            body = json.loads(r.read().decode())
+        assert set(body["routes"]) == {
+            "/metrics", "/healthz", "/debugz", "/debugz/index",
+            "/debugz/tsdb", "/debugz/cost"}
+
+
+class TestChaosAcceptance:
+    def test_alerts_profile_bundle_renders_nontrivial_bill(
+            self, tmp_path):
+        """The ISSUE 11 acceptance path: an incident bundle captured
+        during the chaos alerts profile renders a non-trivial bill
+        through `cost-report`, windowed and not."""
+        from tpu_autoscaler.chaos.engine import _Run
+        from tpu_autoscaler.chaos.scenario import generate
+
+        seed = next(s for s in range(64)
+                    if any(e.kind == "latency_regression"
+                           for e in generate(s,
+                                             profile="alerts").events))
+        run = _Run(generate(seed, profile="alerts"))
+        result = run.execute()
+        assert result.ok, result.violations
+        bundle = run.controller.incident_bundle("alert:test")
+        path = tmp_path / "incident.json"
+        path.write_text(json.dumps(bundle, default=str))
+        out = CliRunner().invoke(cli, ["cost-report", "--from",
+                                       str(path)])
+        assert out.exit_code == 0, out.output
+        assert "FLEET BILL" in out.output
+        assert "conservation: OK" in out.output
+        # Non-trivial: chips moved through more than one state.
+        states = bundle["cost"]["states"]
+        active = [s for s in STATES
+                  if states[s]["chip_seconds"] > 0]
+        assert len(active) >= 2, states
+        win = CliRunner().invoke(cli, [
+            "cost-report", "--from", str(path), "--window", "600"])
+        assert win.exit_code == 0, win.output
+        assert "WINDOWED BILL" in win.output

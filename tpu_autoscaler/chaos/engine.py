@@ -362,7 +362,12 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
         kube_for_controller, actuator,
         ControllerConfig(
             policy=PoolPolicy(spare_nodes=0,
-                              max_total_chips=program.max_total_chips),
+                              max_total_chips=program.max_total_chips,
+                              # ISSUE 11: spot-tier seeds provision
+                              # preemptible capacity — the cost
+                              # ledger's price-tier dimension under
+                              # the full fault alphabet.
+                              preemptible=program.preemptible),
             grace_seconds=30.0, idle_threshold_seconds=120.0,
             drain_grace_seconds=20.0, provision_retry_seconds=30.0,
             # The alerts profile stalls provisions for up to ~480 s

@@ -158,6 +158,21 @@ class InvariantMonitor:
                            f"{n} concurrent in-flight provisions for "
                            f"gang {key} (supply guard breached)")
 
+        # Cost conservation (ISSUE 11, docs/COST.md): every pass the
+        # ledger closed, the per-state chip attribution must sum
+        # EXACTLY (int equality, zero tolerance) to the fleet chips
+        # the reconciler independently counted — every chip-second is
+        # accounted, none twice.  Crash-only passes (brownouts) skip
+        # the close; the ledger's pair is only compared when fresh.
+        ledger = getattr(self._controller, "cost", None)
+        if ledger is not None and ledger.last_conservation is not None:
+            attributed, fleet = ledger.last_conservation
+            if attributed != fleet:
+                self._fail(t, "cost-conservation",
+                           f"ledger attributed {attributed} chips vs "
+                           f"{fleet} fleet chips (a chip-second went "
+                           f"unaccounted or was counted twice)")
+
     # -- terminal ---------------------------------------------------------
 
     def check_converged(self, t: float, live_jobs: dict[str, list[str]]
@@ -235,6 +250,16 @@ class InvariantMonitor:
                            f"job {job} runs split across slices "
                            f"{sorted(slices)} — one gang, one ICI "
                            f"domain")
+
+        # Cost conservation, terminal half: zero violations across the
+        # WHOLE run — including passes whose per-step check raced a
+        # brownout (the ledger counts its own misses).
+        ledger = getattr(self._controller, "cost", None)
+        if ledger is not None and ledger.conservation_violations:
+            self._fail(t, "cost-conservation",
+                       f"{ledger.conservation_violations} conservation "
+                       f"violation(s) over the run (attributed != "
+                       f"fleet chip-seconds)")
 
         # Flight-recorder completeness: every finished trace is whole.
         from tpu_autoscaler.obs import trace_gaps

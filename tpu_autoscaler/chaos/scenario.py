@@ -123,6 +123,12 @@ class ScenarioProgram:
     # FIRED and RESOLVED on those seeds and NEVER fired on the quiet
     # ones (the zero-false-positive half of the gate).
     alerts: bool = False
+    # ISSUE 11: provision spot/preemptible capacity (~25% of seeds,
+    # drawn from a DERIVED rng stream so every pre-existing seed
+    # program stays byte-identical).  Exercises the cost ledger's
+    # price-tier dimension — spot-labeled nodes must conserve exactly
+    # like on-demand ones — across the whole fault alphabet.
+    preemptible: bool = False
 
     def describe(self) -> str:
         kinds: dict[str, int] = {}
@@ -142,6 +148,8 @@ class ScenarioProgram:
             tags.append("regression" if any(
                 e.kind == "latency_regression" for e in self.events)
                 else "quiet")
+        if self.preemptible:
+            tags.append("spot")
         tagtxt = f" [{'+'.join(tags)}]" if tags else ""
         return (f"seed={self.seed} jobs={len(self.workloads)} "
                 f"({'/'.join(w.shape for w in self.workloads)}){tagtxt} "
@@ -320,6 +328,9 @@ def generate(seed: int, *, profile: str = "mixed",
         resolve_slack = (ALERTS_SLOW_WINDOW
                          + (ALERTS_CLEAR_PASSES + 7) * 5.0)
         until = max(until, regression_end + resolve_slack + QUIET_TAIL)
+    # Spot tier (ISSUE 11): derived stream — legacy seed programs and
+    # promoted fixtures keep their exact draws.
+    rng_cost = random.Random(seed ^ 0x5C057)
     return ScenarioProgram(
         seed=seed, step=5.0, until=until, settle=600.0,
         workloads=tuple(workloads), events=tuple(events),
@@ -329,4 +340,5 @@ def generate(seed: int, *, profile: str = "mixed",
         max_total_chips=rng.choice((256, 1024)),
         policy=(profile == "policy"),
         serving=(profile == "serving"),
-        alerts=(profile == "alerts"))
+        alerts=(profile == "alerts"),
+        preemptible=(rng_cost.random() < 0.25))

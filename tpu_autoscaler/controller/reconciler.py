@@ -26,6 +26,7 @@ from tpu_autoscaler.actuators.base import (
     Actuator,
     in_flight_of,
 )
+from tpu_autoscaler.cost import CostLedger
 from tpu_autoscaler.engine.fitter import free_capacity
 from tpu_autoscaler.engine.planner import InFlight, Planner, PoolPolicy
 from tpu_autoscaler.k8s.client import KubeClient
@@ -140,6 +141,11 @@ class ControllerConfig:
     # parity gate in tests keeps the incremental path byte-identical
     # to full planning on the seeded scenarios.
     verify_delta_plans: bool = False
+    # Cost attribution ledger (ISSUE 11, docs/COST.md): the price book
+    # pricing the $-proxy rollups; None = the built-in catalog-derived
+    # book.  The ledger itself is always on — it rides the _maintain
+    # pass the loop already runs and costs O(churn).
+    price_book: object | None = None
     # Reference parity flags (main.py --no-scale / --no-maintenance).
     no_scale: bool = False
     no_maintenance: bool = False
@@ -326,6 +332,18 @@ class Controller:
         self._repair_roots: dict[tuple, Span] = {}
         self.metrics.declare_histogram("slice_repair_seconds",
                                        LATENCY_BUCKETS)
+        # Fleet cost & capacity attribution (ISSUE 11, docs/COST.md):
+        # every TPU chip-second lands in exactly one state per pass,
+        # conserved against the fleet total (the chaos invariant).
+        # Fed from the _maintain unit loop (O(churn): an unchanged
+        # unit is one tuple compare), closed once per pass BEFORE the
+        # TSDB ingest so cost_*/frag_* series land the same pass.
+        # Reconcile-thread-only; /debugz/cost copies bounded-retry.
+        self.cost = CostLedger(
+            price_book=self.config.price_book,
+            metrics=self.metrics,
+            stranded_after_seconds=(
+                self.config.provision_timeout_seconds))
         # Predictive SLO-driven policy (ISSUE 8, docs/POLICY.md):
         # strictly ADVISORY — the engine forecasts demand and this
         # loop feeds its prewarm demand through the planner's existing
@@ -336,7 +354,8 @@ class Controller:
         if policy_engine is not None:
             policy_engine.bind(
                 metrics=self.metrics, tracer=self.tracer,
-                default_generation=self.config.policy.default_generation)
+                default_generation=self.config.policy.default_generation,
+                cost_ledger=self.cost)
         # This pass's policy outputs: units held for an un-consumed
         # prewarm, per-unit idle-threshold overrides (SLO/cost
         # scale-down tradeoff), and the advice digest folded into the
@@ -542,6 +561,14 @@ class Controller:
         for ns, used in ns_usage.items():
             self.metrics.set_gauge(f"namespace_chips_used_{ns}", used)
         self._seen_namespaces |= set(ns_usage)
+        # Cost ledger close (ISSUE 11): seal this pass's attribution
+        # against the INDEPENDENT fleet sum above, export cost_*/
+        # frag_* metrics, score fragmentation — before _obs_pass so
+        # the series land in the TSDB the same pass they describe.
+        # The _maintain loop fed the unit observations; with
+        # maintenance off nothing classified, so the close (and its
+        # conservation check) is suspended rather than false-alarmed.
+        cost_info = self._cost_pass(now, fleet_chips)
         # Decision record: this pass's inputs digest + per-unit reasons
         # ("why did/didn't we provision"), for `explain` / /debugz.
         # The digest is an O(n) frozenset hash — cheap enough for the
@@ -586,6 +613,11 @@ class Controller:
         }
         if alerts_info:
             record["alerts"] = alerts_info
+        if cost_info:
+            # Per-pass cost attribution in the decision record: "where
+            # did this pass's chips sit" rides the same explain/replay
+            # surfaces as every other decision (docs/COST.md).
+            record["cost"] = cost_info
         self.recorder.record_pass(record)
 
     def _observe(self) -> tuple[list[Node], list[Pod], list[Pod]]:
@@ -946,7 +978,24 @@ class Controller:
                     outcome: str, attrs: dict | None = None,
                     metric: str | None = None) -> None:
         self.tracer.end(st.pop("drain_span", None), t=now)
-        self.tracer.end(st["span"], t=now, attrs=attrs, metric=metric,
+        # Stamp the repair's bill on the closing trace (ISSUE 11):
+        # chip-seconds the broken unit burned in the repair state plus
+        # the served gangs' attribution — cost-to-repair, next to
+        # latency, on the same span operators already read.
+        attrs = dict(attrs or {})
+        repair_cs = self.cost.accrued_chip_seconds([unit_id], now,
+                                                   state="repair")
+        if repair_cs:
+            attrs["cost_repair_chip_seconds"] = round(repair_cs, 3)
+        gang_cs = 0.0
+        for key in st["gang_keys"]:
+            gattrs = self.cost.gang_attrs(key, now)
+            if gattrs:
+                gang_cs += gattrs["cost_chip_seconds"]
+        if gang_cs:
+            attrs["cost_chip_seconds"] = round(gang_cs, 3)
+        self.tracer.end(st["span"], t=now, attrs=attrs or None,
+                        metric=metric,
                         value=(now - st["started"]) if metric else None)
         for key in st["gang_keys"]:
             if self._repair_roots.get(key) is st["span"]:
@@ -1311,6 +1360,48 @@ class Controller:
             return {"active": list(result.active)}
         return {}
 
+    # ---- cost attribution ledger (ISSUE 11) ---------------------------- #
+
+    def _cost_pass(self, now: float, fleet_chips: int) -> dict:
+        """Close the cost ledger's pass.  Crash-only: a ledger bug
+        degrades cost observability, never scaling.  Suspended under
+        ``no_maintenance`` — the unit loop that feeds classifications
+        did not run, so a conservation check would false-alarm."""
+        if self.config.no_maintenance:
+            return {}
+        try:
+            return self.cost.close_pass(now, fleet_chips)
+        except Exception:  # noqa: BLE001 — observability only
+            self.metrics.inc("cost_ledger_errors")
+            log.exception("cost ledger close failed; attribution "
+                          "degrades this pass")
+            return {}
+
+    def cost_route(self, params: dict | None = None) -> dict:
+        """The ``/debugz/cost`` body: the ledger's full bill breakdown
+        (docs/COST.md), plus the serving fleet census when a scaler is
+        attached — the serving share of the bill with its live
+        context."""
+        del params  # no query filters yet
+        out = self.cost.debug_state(now=self._last_pass_at)
+        if self.serving_scaler is not None:
+            adapter = getattr(self.serving_scaler, "adapter", None)
+            if adapter is not None \
+                    and hasattr(adapter, "fleet_summary"):
+                for _ in range(5):
+                    try:
+                        out["serving"] = adapter.fleet_summary()
+                        break
+                    # The adapter registers pools in two steps
+                    # (index first, sums after), so a read landing in
+                    # that window raises IndexError, not just
+                    # RuntimeError — degrade, never 500.
+                    except (RuntimeError, IndexError, KeyError):
+                        continue
+                else:
+                    out["serving"] = {"unavailable": "mutating"}
+        return out
+
     def tsdb_route(self, params: dict | None = None) -> dict:
         """The ``/debugz/tsdb`` body: the TSDB dump, filterable by
         ``?prefix=`` and trimmable by ``?window=`` seconds."""
@@ -1338,6 +1429,10 @@ class Controller:
         out["bundle"] = {"version": BUNDLE_VERSION, "reason": reason,
                          "captured_at": time.time()}
         out["tsdb"] = self.tsdb.dump()
+        # The ledger snapshot (ISSUE 11): `tpu-autoscaler cost-report
+        # --from <bundle>` renders the bill an incident was captured
+        # under, and `--window` reads the cost_* TSDB series above.
+        out["cost"] = self.cost.debug_state(now=self._last_pass_at)
         out["informer"] = self._informer_digest()
         cfg = self.config
         out["config"] = {
@@ -2125,10 +2220,17 @@ class Controller:
                                        end=now, parent=root,
                                        attrs={"bind_start": "untracked"})
                 if root is not None:
+                    # Cost-to-serve so far (ISSUE 11): the ledger's
+                    # attribution for this gang incarnation, when it
+                    # has one — a gang whose members ran across passes
+                    # (or rode a repair) closes with its bill attached.
+                    attrs = {"latency_s": round(latency, 3)}
+                    cost_attrs = self.cost.gang_attrs(key, now)
+                    if cost_attrs:
+                        attrs.update(cost_attrs)
                     self.tracer.end(root, t=now,
                                     metric="scale_up_latency_seconds",
-                                    value=latency,
-                                    attrs={"latency_s": round(latency, 3)})
+                                    value=latency, attrs=attrs)
                 else:
                     self.metrics.observe("scale_up_latency_seconds",
                                          latency)
@@ -2341,6 +2443,22 @@ class Controller:
                 spare=unit_id in spare_ids,
                 utilization_threshold=cfg.utilization_threshold)
             state_counts[state.value] = state_counts.get(state.value, 0) + 1
+            # Cost attribution (ISSUE 11): fold this unit's observation
+            # into the ledger off the classification the pass already
+            # computed — O(1), a tuple compare when nothing changed.
+            # Crash-only: ledger bugs never starve maintenance.
+            try:
+                self.cost.note_unit(
+                    unit_id, unit_nodes, unit_pods, state.value, now,
+                    under_repair=unit_id in self._slice_repairs,
+                    cancellable_drain=unit_id in self._drain_cancellable,
+                    policy_hold=unit_id in self._policy_holds,
+                    spare=unit_id in spare_ids,
+                    first_seen=self._unit_first_seen.get(unit_id))
+            except Exception:  # noqa: BLE001 — observability only
+                self.metrics.inc("cost_ledger_errors")
+                log.exception("cost ledger observe failed for %s",
+                              unit_id)
 
             doomed = any(t.get("key") in TERMINATION_TAINT_KEYS
                          for n in unit_nodes for t in n.taints)
@@ -2376,6 +2494,20 @@ class Controller:
                             # cost won over a demand forecast that
                             # never came (docs/POLICY.md scale-down).
                             self.metrics.inc("policy_early_reclaims")
+                        # The idle clock's waste bill comes from the
+                        # ledger — the ONE source of truth for idle
+                        # chip-seconds (ISSUE 11; the ad-hoc per-unit
+                        # clocks only decide WHEN to reclaim).
+                        idle_cs = self.cost.accrued_chip_seconds(
+                            [unit_id], now, state="idle")
+                        if idle_cs:
+                            self.metrics.inc(
+                                "cost_idle_chip_seconds_reclaimed",
+                                idle_cs)
+                            self._explain(
+                                unit_id, "idle waste reclaimed",
+                                f"{idle_cs:.0f} chip-seconds sat idle "
+                                f"before this reclaim (cost ledger)")
                         self._begin_drain(
                             unit_id, unit_nodes, unit_pods, now,
                             reason=f"idle > {idle_threshold:g}s")
@@ -2415,6 +2547,17 @@ class Controller:
             self.metrics.set_gauge(f"units_{key.replace('-', '_')}", count)
         self._sweep_repairs(units, pods, now)
         # Forget tracker state for units whose nodes are gone.
+        # Ledger units not in this pass's observation left the fleet
+        # (drain-complete deletes forget the tracker mid-pass, so the
+        # tracker sweep below cannot be the removal signal — the
+        # OBSERVED unit set is).
+        try:
+            for known in [u for u in self.cost.known_units()
+                          if u not in units]:
+                self.cost.remove_unit(known, now)
+        except Exception:  # noqa: BLE001 — observability only
+            self.metrics.inc("cost_ledger_errors")
+            log.exception("cost ledger unit sweep failed")
         for known in self.tracker.known_slices():
             if known not in units:
                 self.tracker.forget(known)

@@ -1,0 +1,38 @@
+"""Fleet cost & capacity attribution (ISSUE 11, docs/COST.md).
+
+- ``pricebook`` — declarative $-proxy per accelerator class × price
+  tier (reservation / on-demand / spot), with tier detection off the
+  labels GKE already stamps;
+- ``ledger``    — the per-pass attribution ledger: every TPU
+  chip-second on the fleet lands in exactly one state, conserved
+  against the fleet total every pass (chaos-checked);
+- ``frag``      — topology-aware fragmentation scoring per pool (the
+  future repacker's input);
+- ``report``    — the ``tpu-autoscaler cost-report`` bill renderer.
+"""
+
+from tpu_autoscaler.cost.frag import FragScore, score_pools
+from tpu_autoscaler.cost.ledger import (
+    STATES,
+    CostLedger,
+    classify_cost_state,
+)
+from tpu_autoscaler.cost.pricebook import PriceBook, tier_of_labels
+from tpu_autoscaler.cost.report import (
+    render_bill,
+    render_windowed,
+    windowed_bill,
+)
+
+__all__ = [
+    "STATES",
+    "CostLedger",
+    "FragScore",
+    "PriceBook",
+    "classify_cost_state",
+    "render_bill",
+    "render_windowed",
+    "score_pools",
+    "tier_of_labels",
+    "windowed_bill",
+]

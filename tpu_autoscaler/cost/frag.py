@@ -1,0 +1,82 @@
+"""Topology-aware fragmentation scoring (ISSUE 11, docs/COST.md).
+
+Turns the ledger's incremental per-pool aggregates into one score per
+pool in ``[0, 1]`` — the number the ROADMAP's cost-aware continuous
+repacker will consume: "which pool should I defragment first, and is
+the migration worth its drain cost?".  Three components, each a chip
+count the ledger maintains O(churn):
+
+- **stranded** — capacity no catalog shape can ever use (partial
+  slices past the stranded window, unknown shapes, broken
+  workload-free ICI domains);
+- **displaced** — workload pinned on reservation-tier chips while a
+  same-shape spot unit sits idle: the gang could run identically for a
+  fraction of the $-proxy (``min(reservation-busy, idle-spot)`` per
+  shape — an upper bound: the scorer ranks, the repacker verifies);
+- **overprovisioned** — busy units whose gang requests fewer chips
+  than the slice carries (topology-poor placement: a v5e-16 gang
+  parked on a v5e-32 strands half the slice *inside* a busy unit,
+  where the idle clocks never see it).
+
+Pure functions over injected counts: no clocks, no controller state —
+unit-testable exactly like the SLO algebra (policy/slo.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+#: Component weights: stranded capacity is pure loss; displacement
+#: costs the tier delta; overprovisioning is recoverable only by a
+#: migration, so it weighs least (docs/COST.md "Fragmentation score").
+W_STRANDED = 1.0
+W_DISPLACED = 0.8
+W_OVERPROVISIONED = 0.4
+
+
+@dataclasses.dataclass(frozen=True)
+class FragScore:
+    """One pool's fragmentation verdict."""
+
+    pool: str
+    chips: int
+    stranded_chips: int
+    displaced_chips: int
+    overprovisioned_chips: int
+    score: float                  # weighted fraction of the pool, [0,1]
+
+
+def score_pools(*, pool_chips: Mapping[str, int],
+                stranded: Mapping[str, int],
+                over_chips: Mapping[str, int],
+                res_busy: Mapping[tuple[str, str], int],
+                idle_spot: Mapping[str, int]
+                ) -> dict[str, FragScore]:
+    """Score every pool with chips.  ``res_busy`` is keyed
+    ``(pool, shape)``; ``idle_spot`` by shape — displacement matches
+    reservation-busy chips against idle spot chips of the SAME shape
+    (a like-for-like migration target), attributed to the busy pool.
+    """
+    displaced: dict[str, int] = {}
+    for (pool, shape), busy in res_busy.items():
+        if busy <= 0:
+            continue
+        spot_free = idle_spot.get(shape, 0)
+        if spot_free > 0:
+            displaced[pool] = displaced.get(pool, 0) + min(busy,
+                                                           spot_free)
+    out: dict[str, FragScore] = {}
+    for pool, chips in pool_chips.items():
+        if chips <= 0:
+            continue
+        s = stranded.get(pool, 0)
+        d = displaced.get(pool, 0)
+        o = over_chips.get(pool, 0)
+        weighted = (W_STRANDED * s + W_DISPLACED * d
+                    + W_OVERPROVISIONED * o)
+        out[pool] = FragScore(
+            pool=pool, chips=chips, stranded_chips=s,
+            displaced_chips=d, overprovisioned_chips=o,
+            score=min(1.0, weighted / chips))
+    return out

@@ -1,0 +1,614 @@
+"""Fleet cost & capacity attribution ledger (ISSUE 11, docs/COST.md).
+
+Once per reconcile pass, every TPU chip-second on the fleet is
+attributed to exactly ONE state:
+
+- ``serving``      — chips under serving-replica workload;
+- ``training``     — chips under any other workload gang;
+- ``prewarm``      — warm capacity held on purpose (un-consumed policy
+                     prewarms, operator spare slices);
+- ``repair``       — broken units being cordoned/drained/replaced
+                     (slice repairs, requested/unhealthy/preemption
+                     drains);
+- ``provisioning`` — registered hosts still behind the readiness
+                     barrier;
+- ``idle``         — ready, workload-free capacity on the reclaim
+                     clocks (including cancellable idle-reclaim
+                     drains);
+- ``stranded``     — capacity nothing can ever use: sub-slice
+                     fragments past the stranded window, unknown
+                     shapes, broken workload-free ICI domains.
+
+**Conservation identity**: the per-state chip counts sum EXACTLY (int
+equality, zero tolerance) to the fleet's observed TPU chips every
+pass — checked at ``close_pass`` against the reconciler's own
+independent fleet sum, counted on ``cost_conservation_violations``
+when broken, and asserted per step by the chaos corpus
+(chaos/invariants.py).
+
+**Cost model**: O(churn) per pass like the PR 9 fleet fold.  Every
+rollup is a lazy accumulator ``(chips, since, banked)`` — observing a
+unit whose classification did not change is one tuple compare;
+changes bank ``chips x elapsed`` and restart the clock; ``close_pass``
+reads only the handful of state/class/tier accumulators, never the
+unit table.  ``rebuild()`` recomputes every chip count from the unit
+table from scratch — the property-suite oracle
+(tests/test_cost.py, the informer-indices pattern).
+
+Threading: reconcile-thread-only writes, like every other piece of
+controller bookkeeping — no locks.  ``debug_state()`` is read from
+the /debugz thread and copies with the established bounded-retry
+pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Iterable, Mapping, Sequence
+
+from tpu_autoscaler.cost.pricebook import PriceBook, tier_of_labels
+from tpu_autoscaler.topology.catalog import (
+    TPU_RESOURCE,
+    shape_from_selectors,
+)
+
+log = logging.getLogger(__name__)
+
+#: The attribution states, in bill-rendering order (docs/COST.md).
+STATES = ("serving", "training", "prewarm", "repair", "provisioning",
+          "idle", "stranded")
+
+#: Namespaces whose workload counts as serving (the PR 8/9 advisory
+#: namespaces; real serving fleets deploy their replicas here).
+SERVING_NAMESPACES = frozenset({"tpu-serving"})
+
+#: Terminal per-gang rollups are retained this long for reports, then
+#: folded into the state totals only (bounded state).
+GANG_RETENTION_SECONDS = 3600.0
+
+
+class _Acc:
+    """Lazy chip-second accumulator: ``chips`` holds NOW, ``banked``
+    holds everything before ``since``.  total(t) never mutates."""
+
+    __slots__ = ("chips", "since", "banked")
+
+    def __init__(self, t: float) -> None:
+        self.chips = 0
+        self.since = t
+        self.banked = 0.0
+
+    def adjust(self, delta_chips: int, t: float) -> None:
+        self.banked += self.chips * max(0.0, t - self.since)
+        self.chips += delta_chips
+        self.since = t
+
+    def total(self, t: float) -> float:
+        return self.banked + self.chips * max(0.0, t - self.since)
+
+
+@dataclasses.dataclass
+class _Unit:
+    """Cached classification of one supply unit."""
+
+    state: str
+    chips: int
+    pool: str
+    accel: str
+    tier: str
+    shape: str | None
+    gang_id: str | None        # dominant gang's epoch-rollup id
+    used_chips: int            # workload-requested chips (frag input)
+    entered_at: float          # current state entered (waste reads)
+    state_banked: float = 0.0  # chip-seconds in PRIOR same-state spans
+
+
+def classify_cost_state(slice_state: str, *, has_workload: bool,
+                        serving: bool, under_repair: bool,
+                        cancellable_drain: bool, policy_hold: bool,
+                        spare: bool, broken: bool,
+                        stranded_overdue: bool) -> str:
+    """Map one observed unit to its attribution state — a pure
+    function of what the reconcile pass already knows (docs/COST.md
+    "Attribution states" documents every branch)."""
+    if slice_state == "draining":
+        if cancellable_drain and not under_repair:
+            return "idle"          # an idle-reclaim drain is still waste
+        return "repair"
+    if has_workload:
+        return "serving" if serving else "training"
+    if slice_state == "unhealthy":
+        return "stranded"          # broken ICI domain, nothing aboard
+    if slice_state == "provisioning":
+        if broken and stranded_overdue:
+            return "stranded"      # partial/unknown past the window
+        return "provisioning"
+    if policy_hold or spare or slice_state == "spare":
+        return "prewarm"
+    return "idle"
+
+
+class CostLedger:
+    """Per-pass chip-second attribution over the observed fleet."""
+
+    def __init__(self, price_book: PriceBook | None = None,
+                 metrics: Any = None,
+                 serving_namespaces: Iterable[str] = SERVING_NAMESPACES,
+                 stranded_after_seconds: float = 900.0) -> None:
+        self.price_book = price_book or PriceBook()
+        self._metrics = metrics
+        self.serving_namespaces = frozenset(serving_namespaces)
+        self.stranded_after_seconds = stranded_after_seconds
+        self._units: dict[str, _Unit] = {}
+        # Static per-unit metadata (pool, accel, tier, shape, hosts):
+        # a unit's labels never change over its lifetime, so the label
+        # walks + catalog lookup run ONCE per unit, not per pass.
+        self._meta: dict[str, tuple[str, str, str, str | None, int]] = {}
+        # Rollup accumulators (all lazy; ints conserve exactly).
+        self._state: dict[str, _Acc] = {}
+        self._combo: dict[tuple[str, str, str], _Acc] = {}  # (state,accel,tier)
+        self._pool: dict[tuple[str, str], _Acc] = {}        # (pool,state)
+        self._gang: dict[str, _Acc] = {}
+        self._gang_last_seen: dict[str, float] = {}
+        # Gang incarnation epochs (ISSUE 11 satellite): rollups key on
+        # (gang key, epoch) so a Job completing and restarting under
+        # the same (ns,name) never double-counts its final partial
+        # pass — a disjoint member-uid set is a new incarnation.
+        self._gang_epoch: dict[tuple,
+                               tuple[int, frozenset, float]] = {}
+        # Fragmentation inputs (cost/frag.py), maintained incrementally.
+        self._idle_spot_chips: dict[str, int] = {}          # shape -> chips
+        self._res_busy_chips: dict[tuple[str, str], int] = {}  # (pool,shape)
+        self._over_chips: dict[str, int] = {}               # pool -> chips
+        self._pool_chips: dict[str, int] = {}               # pool -> chips
+        self._stranded_pool: dict[str, int] = {}            # pool -> chips
+        # Export cursors (counters emit deltas per close).
+        self._exported_cs: dict[str, float] = {}
+        self._exported_usd = 0.0
+        self._exported_unpriced = 0.0
+        self._last_close: float | None = None
+        self.pass_seq = 0
+        self.conservation_violations = 0
+        #: Last close's (attributed chips, fleet chips) — the chaos
+        #: conservation invariant reads this pair.
+        self.last_conservation: tuple[int, int] | None = None
+
+    # -- metrics helper ---------------------------------------------------
+
+    def _inc(self, name: str, by: float = 1.0) -> None:
+        if self._metrics is not None and by:
+            self._metrics.inc(name, by)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(name, value)
+
+    # -- classification inputs -------------------------------------------
+
+    def _gang_rollup_id(self, key: tuple, uids: frozenset,
+                        now: float) -> str:
+        """Epoch-keyed rollup id for one gang incarnation.  A member
+        set DISJOINT from the last seen one is a new incarnation (the
+        restart-under-the-same-name case); overlapping sets merge —
+        members materialize gradually and repairs recreate them in
+        waves.  Entries carry a last-touched stamp so the amortized
+        sweep can drop gangs gone past retention (bounded state)."""
+        epoch, seen, _touched = self._gang_epoch.get(
+            key, (0, frozenset(), now))
+        if seen and uids and not (seen & uids):
+            epoch += 1
+            seen = uids
+        else:
+            seen = seen | uids
+        self._gang_epoch[key] = (epoch, seen, now)
+        return "/".join(str(p) for p in key) + f"#{epoch}"
+
+    # -- the write path (reconcile thread only) ---------------------------
+
+    def note_unit(self, unit_id: str, unit_nodes: Sequence[Any],
+                  unit_pods: Sequence[Any], slice_state: str,
+                  now: float, *, under_repair: bool = False,
+                  cancellable_drain: bool = False,
+                  policy_hold: bool = False, spare: bool = False,
+                  first_seen: float | None = None) -> None:
+        """Fold one unit's observation in.  O(1); a no-change
+        observation is one tuple compare (the churn contract)."""
+        if not unit_nodes or not unit_nodes[0].is_tpu:
+            return  # CPU units are outside the chip ledger
+        meta = self._meta.get(unit_id)
+        if meta is None:
+            node = unit_nodes[0]
+            try:
+                shape = shape_from_selectors(node.labels)
+            except KeyError:
+                shape = None
+            pool = node.pool or node.labels.get(
+                "cloud.google.com/gke-nodepool") or (
+                node.tpu_accelerator or "unknown")
+            meta = (pool, node.tpu_accelerator or "unknown",
+                    tier_of_labels(node.labels),
+                    shape.name if shape is not None else None,
+                    shape.hosts if shape is not None else 0)
+            self._meta[unit_id] = meta
+        pool, accel, tier, shape_name, hosts = meta
+        chips = sum(int(n.allocatable.get(TPU_RESOURCE))
+                    for n in unit_nodes)
+        workload = [p for p in unit_pods if p.is_workload]
+        serving = any(p.namespace in self.serving_namespaces
+                      or (p.gang_key is not None
+                          and p.gang_key[0] == "serving")
+                      for p in workload)
+        broken = shape_name is None or len(unit_nodes) < hosts
+        overdue = (first_seen is not None
+                   and now - first_seen > self.stranded_after_seconds)
+        state = classify_cost_state(
+            slice_state, has_workload=bool(workload), serving=serving,
+            under_repair=under_repair,
+            cancellable_drain=cancellable_drain,
+            policy_hold=policy_hold, spare=spare, broken=broken,
+            stranded_overdue=overdue)
+
+        gang_id = None
+        used = 0
+        if workload:
+            by_gang: dict[tuple, list] = {}
+            for p in workload:
+                used += p.tpu_chips
+                if p.gang_key is not None:
+                    by_gang.setdefault(p.gang_key, []).append(p)
+            if by_gang:
+                key = max(by_gang,
+                          key=lambda k: (sum(p.tpu_chips
+                                             for p in by_gang[k]),
+                                         str(k)))
+                gang_id = self._gang_rollup_id(
+                    key, frozenset(p.uid for p in by_gang[key]), now)
+
+        cached = self._units.get(unit_id)
+        if cached is not None and cached.state == state \
+                and cached.chips == chips and cached.pool == pool \
+                and cached.tier == tier and cached.gang_id == gang_id \
+                and cached.used_chips == used:
+            return  # unchanged: the O(churn) early-out
+        if cached is not None:
+            self._retire(unit_id, cached, now)
+        unit = _Unit(state=state, chips=chips, pool=pool, accel=accel,
+                     tier=tier, shape=shape_name, gang_id=gang_id,
+                     used_chips=used, entered_at=now)
+        if cached is not None and cached.state == state:
+            # Same state, different chips/gang: the state clock
+            # continues — _retire just banked everything through
+            # ``now``, so the fresh span starts here (starting it at
+            # the OLD entered_at would double-count the banked span).
+            unit.state_banked = cached.state_banked
+        self._units[unit_id] = unit
+        self._apply(unit, +1, now)
+
+    def known_units(self) -> list[str]:
+        """Unit ids currently attributed (the reconciler sweeps this
+        against its observed unit set every pass)."""
+        return list(self._units)
+
+    def remove_unit(self, unit_id: str, now: float) -> None:
+        """A unit's nodes are gone: its chips leave the fleet."""
+        cached = self._units.pop(unit_id, None)
+        self._meta.pop(unit_id, None)
+        if cached is not None:
+            self._retire(unit_id, cached, now)
+
+    def _retire(self, unit_id: str, unit: _Unit, now: float) -> None:
+        unit.state_banked += unit.chips * max(0.0, now - unit.entered_at)
+        self._apply(unit, -1, now)
+
+    def _apply(self, unit: _Unit, sign: int, now: float) -> None:
+        delta = sign * unit.chips
+        self._acc(self._state, unit.state, now).adjust(delta, now)
+        self._acc(self._combo, (unit.state, unit.accel, unit.tier),
+                  now).adjust(delta, now)
+        self._acc(self._pool, (unit.pool, unit.state),
+                  now).adjust(delta, now)
+        if unit.gang_id is not None and unit.state in ("serving",
+                                                       "training",
+                                                       "repair"):
+            self._acc(self._gang, unit.gang_id, now).adjust(delta, now)
+            self._gang_last_seen[unit.gang_id] = now
+        # Fragmentation inputs (ints; cost/frag.py reads them).
+        self._pool_chips[unit.pool] = (
+            self._pool_chips.get(unit.pool, 0) + delta)
+        if unit.state == "stranded":
+            self._stranded_pool[unit.pool] = (
+                self._stranded_pool.get(unit.pool, 0) + delta)
+        if unit.shape is not None:
+            if unit.state in ("idle", "prewarm") and unit.tier == "spot":
+                self._idle_spot_chips[unit.shape] = (
+                    self._idle_spot_chips.get(unit.shape, 0) + delta)
+            if unit.state in ("serving", "training") \
+                    and unit.tier == "reservation":
+                key = (unit.pool, unit.shape)
+                self._res_busy_chips[key] = (
+                    self._res_busy_chips.get(key, 0) + delta)
+        if unit.state in ("serving", "training") \
+                and unit.used_chips < unit.chips:
+            self._over_chips[unit.pool] = (
+                self._over_chips.get(unit.pool, 0)
+                + sign * (unit.chips - unit.used_chips))
+
+    @staticmethod
+    def _acc(table: dict, key, now: float) -> _Acc:
+        acc = table.get(key)
+        if acc is None:
+            acc = table[key] = _Acc(now)
+        return acc
+
+    # -- per-pass close ---------------------------------------------------
+
+    def close_pass(self, now: float, fleet_chips: int) -> dict[str, Any]:
+        """Seal the pass: conservation check against the reconciler's
+        INDEPENDENT fleet chip sum, metric export (deltas for the
+        cumulative families, levels for the gauges), fragmentation
+        scores, bounded-state pruning.  Returns the pass record's
+        ``cost`` section.  O(states + combos + pools), never O(units).
+        """
+        from tpu_autoscaler.cost.frag import score_pools
+
+        self.pass_seq += 1
+        attributed = sum(acc.chips for acc in self._state.values())
+        self.last_conservation = (attributed, fleet_chips)
+        if attributed != fleet_chips:
+            self.conservation_violations += 1
+            self._inc("cost_conservation_violations")
+            log.warning(
+                "cost ledger conservation broken: attributed %d chips "
+                "vs fleet %d", attributed, fleet_chips)
+
+        usd_total = 0.0
+        unpriced = 0.0
+        usd_per_hour = 0.0
+        for (state, accel, tier), acc in self._combo.items():
+            cs = acc.total(now)
+            rate, priced = self.price_book.rate(accel, tier)
+            usd_total += cs * rate / 3600.0
+            usd_per_hour += acc.chips * rate
+            if not priced:
+                unpriced += cs
+        for state in STATES:
+            acc = self._state.get(state)
+            cs = acc.total(now) if acc is not None else 0.0
+            self.set_gauge(f"cost_chips_{state}",
+                           acc.chips if acc is not None else 0)
+            last = self._exported_cs.get(state, 0.0)
+            if cs > last:
+                self._inc(f"cost_chip_seconds_{state}", cs - last)
+                self._exported_cs[state] = cs
+        if usd_total > self._exported_usd:
+            self._inc("cost_dollar_proxy_total",
+                      usd_total - self._exported_usd)
+            self._exported_usd = usd_total
+        if unpriced > self._exported_unpriced:
+            self._inc("cost_unpriced_chip_seconds",
+                      unpriced - self._exported_unpriced)
+            self._exported_unpriced = unpriced
+        self.set_gauge("cost_dollar_proxy_per_hour", round(usd_per_hour, 6))
+
+        scores = score_pools(
+            pool_chips=self._pool_chips,
+            stranded=self._stranded_pool,
+            over_chips=self._over_chips,
+            res_busy=self._res_busy_chips,
+            idle_spot=self._idle_spot_chips)
+        frag_stranded = sum(self._stranded_pool.values())
+        frag_displaced = sum(s.displaced_chips for s in scores.values())
+        frag_over = sum(self._over_chips.values())
+        self.set_gauge("frag_stranded_chips", frag_stranded)
+        self.set_gauge("frag_displaced_chips", frag_displaced)
+        self.set_gauge("frag_overprovisioned_chips", frag_over)
+        for pool, s in scores.items():
+            self.set_gauge(f"frag_score_{pool}", round(s.score, 4))
+
+        # Bounded state, amortized: the gang-retention and zero-bucket
+        # sweeps walk their whole tables, so they run every 64th close
+        # (O(gangs/64) amortized — a close must stay O(states+combos),
+        # never O(gangs), on the pass budget).
+        if self.pass_seq % 64 == 0:
+            horizon = now - GANG_RETENTION_SECONDS
+            for gid in [g for g, seen in self._gang_last_seen.items()
+                        if seen < horizon and self._gang[g].chips == 0]:
+                del self._gang[gid]
+                del self._gang_last_seen[gid]
+            # Epoch entries of gangs gone past retention go too
+            # (review-found unbounded growth) — but never while the
+            # current incarnation still holds chips: a live steady
+            # gang's epoch may sit untouched for hours (the unchanged
+            # early-out skips _gang_rollup_id) and pruning it would
+            # lose the uid set the next restart is detected against.
+            for key in [
+                    k for k, (ep, _seen, touched)
+                    in self._gang_epoch.items()
+                    if touched < horizon
+                    and getattr(self._gang.get(
+                        "/".join(str(p) for p in k) + f"#{ep}"),
+                        "chips", 0) == 0]:
+                del self._gang_epoch[key]
+            for table in (self._idle_spot_chips, self._res_busy_chips,
+                          self._over_chips, self._stranded_pool):
+                for key in [k for k, v in table.items() if v == 0]:
+                    del table[key]
+
+        self._last_close = now
+        return {
+            "attributed_chips": attributed,
+            "fleet_chips": fleet_chips,
+            "conserved": attributed == fleet_chips,
+            "chips": {s: (self._state[s].chips if s in self._state
+                          else 0) for s in STATES},
+            "dollar_per_hour": round(usd_per_hour, 4),
+        }
+
+    # -- reads ------------------------------------------------------------
+
+    def accrued_chip_seconds(self, unit_ids: Iterable[str], now: float,
+                             state: str | None = None) -> float | None:
+        """Chip-seconds the named units accrued in their CURRENT state
+        span (banked prior same-state spans included) — the policy
+        waste budget's one source of truth.  None when no named unit
+        is tracked (callers fall back to their own estimate)."""
+        total = 0.0
+        hit = False
+        for unit_id in unit_ids:
+            unit = self._units.get(unit_id)
+            if unit is None or (state is not None
+                                and unit.state != state):
+                continue
+            hit = True
+            total += unit.state_banked + unit.chips * max(
+                0.0, now - unit.entered_at)
+        return total if hit else None
+
+    def gang_attrs(self, gang_key: tuple, now: float
+                   ) -> dict[str, float] | None:
+        """Cost-to-serve attrs for a closing trace: the gang's CURRENT
+        incarnation's attributed chip-seconds (None: never attributed
+        — e.g. the gang ran on capacity the ledger never saw busy)."""
+        epoch, _uids, _t = self._gang_epoch.get(
+            gang_key, (0, frozenset(), 0.0))
+        gid = "/".join(str(p) for p in gang_key) + f"#{epoch}"
+        acc = self._gang.get(gid)
+        if acc is None:
+            return None
+        return {"cost_chip_seconds": round(acc.total(now), 3)}
+
+    def rebuild(self) -> dict[str, Any]:
+        """From-scratch chip counts off the unit table — the property
+        oracle the incremental accumulators are checked against."""
+        state: dict[str, int] = {}
+        pool: dict[tuple[str, str], int] = {}
+        combo: dict[tuple[str, str, str], int] = {}
+        gang: dict[str, int] = {}
+        idle_spot: dict[str, int] = {}
+        res_busy: dict[tuple[str, str], int] = {}
+        over: dict[str, int] = {}
+        stranded: dict[str, int] = {}
+        pool_chips: dict[str, int] = {}
+        for u in self._units.values():
+            state[u.state] = state.get(u.state, 0) + u.chips
+            pool[(u.pool, u.state)] = pool.get((u.pool, u.state),
+                                               0) + u.chips
+            combo_key = (u.state, u.accel, u.tier)
+            combo[combo_key] = combo.get(combo_key, 0) + u.chips
+            pool_chips[u.pool] = pool_chips.get(u.pool, 0) + u.chips
+            if u.gang_id is not None and u.state in ("serving",
+                                                     "training",
+                                                     "repair"):
+                gang[u.gang_id] = gang.get(u.gang_id, 0) + u.chips
+            if u.state == "stranded":
+                stranded[u.pool] = stranded.get(u.pool, 0) + u.chips
+            if u.shape is not None:
+                if u.state in ("idle", "prewarm") and u.tier == "spot":
+                    idle_spot[u.shape] = (idle_spot.get(u.shape, 0)
+                                          + u.chips)
+                if u.state in ("serving", "training") \
+                        and u.tier == "reservation":
+                    res_busy[(u.pool, u.shape)] = (
+                        res_busy.get((u.pool, u.shape), 0) + u.chips)
+            if u.state in ("serving", "training") \
+                    and u.used_chips < u.chips:
+                over[u.pool] = over.get(u.pool, 0) + (u.chips
+                                                      - u.used_chips)
+        return {"state": state, "pool": pool, "combo": combo,
+                "gang": gang, "idle_spot": idle_spot,
+                "res_busy": res_busy, "over": over,
+                "stranded": stranded, "pool_chips": pool_chips}
+
+    def live_counts(self) -> dict[str, Any]:
+        """The incremental counters in ``rebuild()``'s shape (the
+        property suite compares the two for equality)."""
+        return {
+            "state": {k: a.chips for k, a in self._state.items()
+                      if a.chips},
+            "pool": {k: a.chips for k, a in self._pool.items()
+                     if a.chips},
+            "combo": {k: a.chips for k, a in self._combo.items()
+                      if a.chips},
+            "gang": {k: a.chips for k, a in self._gang.items()
+                     if a.chips},
+            "idle_spot": {k: v for k, v in self._idle_spot_chips.items()
+                          if v},
+            "res_busy": {k: v for k, v in self._res_busy_chips.items()
+                         if v},
+            "over": {k: v for k, v in self._over_chips.items() if v},
+            "stranded": {k: v for k, v in self._stranded_pool.items()
+                         if v},
+            "pool_chips": {k: v for k, v in self._pool_chips.items()
+                           if v},
+        }
+
+    def debug_state(self, now: float | None = None) -> dict[str, Any]:
+        """The ``/debugz/cost`` body and the incident bundle's ``cost``
+        section: the full bill breakdown (docs/COST.md "The bill").
+        Read from the /debugz thread while the reconcile thread
+        mutates — bounded-retry copy, degrade-not-500."""
+        from tpu_autoscaler.cost.frag import score_pools
+
+        now = self._last_close if now is None else now
+        if now is None:
+            now = 0.0
+        for _ in range(5):
+            try:
+                by_state = {
+                    s: {"chips": (self._state[s].chips
+                                  if s in self._state else 0),
+                        "chip_seconds": round(
+                            self._state[s].total(now), 3)
+                        if s in self._state else 0.0}
+                    for s in STATES}
+                pools: dict[str, dict[str, float]] = {}
+                for (pool, state), acc in list(self._pool.items()):
+                    cs = acc.total(now)
+                    if cs or acc.chips:
+                        pools.setdefault(pool, {})[state] = round(cs, 3)
+                combos = [
+                    {"state": s, "accel": a, "tier": t,
+                     "chips": acc.chips,
+                     "chip_seconds": round(acc.total(now), 3),
+                     "usd": round(acc.total(now)
+                                  * self.price_book.rate(a, t)[0]
+                                  / 3600.0, 6)}
+                    for (s, a, t), acc in list(self._combo.items())
+                    if acc.chips or acc.total(now)]
+                gangs = {
+                    gid: round(acc.total(now), 3)
+                    for gid, acc in list(self._gang.items())}
+                scores = {
+                    pool: dataclasses.asdict(s)
+                    for pool, s in score_pools(
+                        pool_chips=dict(self._pool_chips),
+                        stranded=dict(self._stranded_pool),
+                        over_chips=dict(self._over_chips),
+                        res_busy=dict(self._res_busy_chips),
+                        idle_spot=dict(
+                            self._idle_spot_chips)).items()}
+                break
+            # A reconcile-thread mutation mid-copy surfaces as
+            # RuntimeError (dict resize) or KeyError/IndexError
+            # (entry vanishing between the keys walk and the read).
+            except (RuntimeError, KeyError, IndexError):
+                continue
+        else:
+            return {"unavailable": "mutating"}
+        usd_total = sum(c["usd"] for c in combos)
+        return {
+            "as_of": now,
+            "states": by_state,
+            "pools": pools,
+            "combos": combos,
+            "gangs": gangs,
+            "fragmentation": scores,
+            "dollar_proxy_total": round(usd_total, 4),
+            "unpriced_chip_seconds": round(self._exported_unpriced, 3),
+            "conservation": {
+                "violations": self.conservation_violations,
+                "last": list(self.last_conservation)
+                if self.last_conservation else None,
+            },
+        }

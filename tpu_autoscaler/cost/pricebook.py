@@ -1,0 +1,151 @@
+"""Declarative price book: accelerator class × price tier → $-proxy.
+
+The ledger (cost/ledger.py) attributes chip-seconds; this module turns
+them into money — a *dollar proxy*, deliberately not a billing export:
+the absolute numbers only need to be stable and ordered (spot <
+reservation < on-demand) for the fragmentation scorer, the budget
+alert, and the repacker's future never-costs-more-than-it-saves guard
+to mean anything.  Rates are $/chip-hour.
+
+Tier detection reads the labels GKE already stamps on nodes:
+
+- ``cloud.google.com/gke-spot`` (or the legacy ``gke-preemptible``)
+  → ``spot``;
+- ``cloud.google.com/reservation-name`` → ``reservation``;
+- otherwise ``on_demand``.
+
+An accelerator class absent from the book falls back to
+``default_rate`` and is COUNTED (``cost_unpriced_chip_seconds``) —
+an unpriced class is a config gap, never a silent $0 (docs/COST.md
+"Price book").  Pure data + lookups: no clocks, no I/O (the CLI's
+YAML loading happens in main.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+#: Known price tiers, cheapest-last (docs/COST.md).
+TIERS = ("on_demand", "reservation", "spot")
+
+#: Node labels that betray the tier (GKE conventions; the fake cloud's
+#: preemptible nodes carry the spot label too — k8s/payloads.py).
+SPOT_LABELS = ("cloud.google.com/gke-spot",
+               "cloud.google.com/gke-preemptible")
+RESERVATION_LABEL = "cloud.google.com/reservation-name"
+
+#: Default on-demand $-proxy per chip-hour by TPU generation —
+#: order-of-magnitude public-list-price shaped, NOT billing data.
+DEFAULT_GENERATION_RATES: dict[str, float] = {
+    "v4": 3.22,
+    "v5e": 1.20,
+    "v5p": 4.20,
+    "v6e": 2.70,
+}
+
+#: Tier multipliers over the on-demand rate (reservation: committed
+#: discount; spot: preemptible discount).
+DEFAULT_TIER_FACTORS: dict[str, float] = {
+    "on_demand": 1.0,
+    "reservation": 0.6,
+    "spot": 0.35,
+}
+
+#: Fallback $/chip-hour for classes the book does not price.
+DEFAULT_CLASS_RATE = 2.0
+
+
+def tier_of_labels(labels: Mapping[str, str]) -> str:
+    """Price tier of a node, from its labels."""
+    for label in SPOT_LABELS:
+        if labels.get(label) == "true":
+            return "spot"
+    if labels.get(RESERVATION_LABEL):
+        return "reservation"
+    return "on_demand"
+
+
+def _catalog_class_rates() -> dict[str, float]:
+    """Per-accelerator-class on-demand rates derived from the catalog:
+    every accelerator type of a generation inherits the generation's
+    rate (the catalog is the one place shape↔generation lives)."""
+    from tpu_autoscaler.topology.catalog import SLICE_SHAPES
+
+    out: dict[str, float] = {}
+    for shape in SLICE_SHAPES.values():
+        rate = DEFAULT_GENERATION_RATES.get(shape.generation)
+        if rate is not None:
+            out.setdefault(shape.accelerator_type, rate)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PriceBook:
+    """accel class → on-demand $/chip-hour, with tier factors.
+
+    ``class_rates`` keys are accelerator-type label values (what nodes
+    actually carry); ``rate()`` returns ``(usd_per_chip_hour, priced)``
+    — ``priced=False`` means the class fell back to ``default_rate``
+    and the caller must count the chip-seconds as unpriced."""
+
+    class_rates: Mapping[str, float] = dataclasses.field(
+        default_factory=_catalog_class_rates)
+    tier_factors: Mapping[str, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_TIER_FACTORS))
+    default_rate: float = DEFAULT_CLASS_RATE
+
+    def rate(self, accel_class: str, tier: str) -> tuple[float, bool]:
+        base = self.class_rates.get(accel_class)
+        priced = base is not None
+        if base is None:
+            base = self.default_rate
+        factor = self.tier_factors.get(tier,
+                                       DEFAULT_TIER_FACTORS["on_demand"])
+        return base * factor, priced
+
+    @classmethod
+    def from_dict(cls, body: Mapping[str, Any]) -> "PriceBook":
+        """Build from a YAML-shaped mapping (docs/COST.md format)::
+
+            default_rate: 2.0
+            tiers: {reservation: 0.6, spot: 0.35}
+            classes:
+              v5e: 1.2                    # generation: expands to every
+              tpu-v5p-slice: 4.2          # accelerator type, or exact
+
+        Generation keys expand through the catalog; unknown keys are an
+        error (a typo'd class would silently price as the default)."""
+        from tpu_autoscaler.topology.catalog import SLICE_SHAPES
+
+        by_generation: dict[str, list[str]] = {}
+        known_classes: set[str] = set()
+        for shape in SLICE_SHAPES.values():
+            by_generation.setdefault(shape.generation, []).append(
+                shape.accelerator_type)
+            known_classes.add(shape.accelerator_type)
+
+        class_rates = dict(_catalog_class_rates())
+        for key, value in dict(body.get("classes") or {}).items():
+            rate = float(value)
+            if rate < 0.0:
+                raise ValueError(f"negative rate for {key!r}")
+            if key in by_generation:
+                for accel in by_generation[key]:
+                    class_rates[accel] = rate
+            elif key in known_classes or key.startswith("tpu-"):
+                class_rates[key] = rate
+            else:
+                raise ValueError(
+                    f"unknown price-book class {key!r} (generations: "
+                    f"{', '.join(sorted(by_generation))})")
+        factors = dict(DEFAULT_TIER_FACTORS)
+        for key, value in dict(body.get("tiers") or {}).items():
+            if key not in TIERS:
+                raise ValueError(
+                    f"unknown price tier {key!r} (known: "
+                    f"{', '.join(TIERS)})")
+            factors[key] = float(value)
+        return cls(class_rates=class_rates, tier_factors=factors,
+                   default_rate=float(body.get("default_rate",
+                                               DEFAULT_CLASS_RATE)))

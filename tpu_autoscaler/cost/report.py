@@ -1,0 +1,155 @@
+"""`tpu-autoscaler cost-report`: render the fleet bill (docs/COST.md).
+
+Input is the ledger's ``debug_state()`` body — fetched live from
+``/debugz/cost`` or read from an incident bundle's ``cost`` section —
+plus, for ``--window``, the same dump's TSDB section: the windowed
+bill is computed from ``cost_chip_seconds_<state>`` /
+``cost_dollar_proxy_total`` series deltas, so "what did the last hour
+cost" works offline from any bundle that retains the history.
+
+Pure formatting over dict inputs (CLI wiring lives in main.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from tpu_autoscaler.cost.ledger import STATES
+
+
+def _fmt_cs(cs: float) -> str:
+    if cs >= 3600.0:
+        return f"{cs / 3600.0:.1f} chip-h"
+    return f"{cs:.0f} chip-s"
+
+
+def render_bill(cost: Mapping[str, Any], *, top_gangs: int = 10) -> str:
+    """The full bill breakdown: by state, by pool, by class/tier, the
+    top gangs, fragmentation scores, and the conservation verdict."""
+    lines: list[str] = []
+    states = cost.get("states", {})
+    total_cs = sum(float(s.get("chip_seconds", 0.0))
+                   for s in states.values())
+    total_chips = sum(int(s.get("chips", 0)) for s in states.values())
+    lines.append(f"FLEET BILL  (as of t={cost.get('as_of', 0):g}; "
+                 f"{total_chips} chips live, "
+                 f"{_fmt_cs(total_cs)} attributed, "
+                 f"~${cost.get('dollar_proxy_total', 0.0):.2f} proxy)")
+    lines.append("")
+    lines.append("by state:")
+    usd_by_state: dict[str, float] = {}
+    for combo in cost.get("combos", ()):
+        usd_by_state[combo["state"]] = (
+            usd_by_state.get(combo["state"], 0.0) + combo["usd"])
+    for state in STATES:
+        body = states.get(state, {})
+        cs = float(body.get("chip_seconds", 0.0))
+        if not cs and not body.get("chips"):
+            continue
+        share = (100.0 * cs / total_cs) if total_cs else 0.0
+        lines.append(
+            f"  {state:<13} {body.get('chips', 0):>6} chips  "
+            f"{_fmt_cs(cs):>14}  {share:5.1f}%  "
+            f"~${usd_by_state.get(state, 0.0):.2f}")
+    pools = cost.get("pools", {})
+    if pools:
+        lines.append("")
+        lines.append("by pool (chip-seconds per state):")
+        for pool in sorted(pools):
+            parts = ", ".join(
+                f"{state}={_fmt_cs(cs)}"
+                for state, cs in sorted(pools[pool].items(),
+                                        key=lambda kv: -kv[1]) if cs)
+            lines.append(f"  {pool:<20} {parts or '(none)'}")
+    combos = cost.get("combos", ())
+    if combos:
+        lines.append("")
+        lines.append("by class / tier:")
+        for combo in sorted(combos,
+                            key=lambda c: -c["chip_seconds"])[:12]:
+            lines.append(
+                f"  {combo['accel']:<24} {combo['tier']:<12} "
+                f"{combo['state']:<13} "
+                f"{_fmt_cs(combo['chip_seconds']):>14}  "
+                f"~${combo['usd']:.2f}")
+    gangs = cost.get("gangs", {})
+    if gangs:
+        lines.append("")
+        lines.append(f"top gangs (cost-to-serve, chip-seconds; "
+                     f"#N = incarnation epoch):")
+        ranked = sorted(gangs.items(), key=lambda kv: -kv[1])
+        for gid, cs in ranked[:top_gangs]:
+            lines.append(f"  {gid:<44} {_fmt_cs(cs)}")
+    frag = cost.get("fragmentation", {})
+    if frag:
+        lines.append("")
+        lines.append("fragmentation:")
+        for pool in sorted(frag, key=lambda p: -frag[p]["score"]):
+            s = frag[pool]
+            lines.append(
+                f"  {pool:<20} score={s['score']:.3f}  "
+                f"stranded={s['stranded_chips']} "
+                f"displaced={s['displaced_chips']} "
+                f"overprov={s['overprovisioned_chips']} "
+                f"of {s['chips']} chips")
+    cons = cost.get("conservation", {})
+    if cons:
+        last = cons.get("last")
+        verdict = "OK" if not cons.get("violations") else \
+            f"{cons['violations']} VIOLATION(S)"
+        lines.append("")
+        lines.append(
+            f"conservation: {verdict}"
+            + (f" (last pass: {last[0]}/{last[1]} chips attributed)"
+               if last else ""))
+    unpriced = cost.get("unpriced_chip_seconds", 0.0)
+    if unpriced:
+        lines.append(f"unpriced: {_fmt_cs(unpriced)} fell back to the "
+                     f"default rate (price-book gap)")
+    return "\n".join(lines)
+
+
+def windowed_bill(tsdb_dump: Mapping[str, Any],
+                  window_seconds: float) -> dict[str, Any]:
+    """A by-state bill over the trailing ``window_seconds`` of TSDB
+    history: deltas of the cumulative ``cost_chip_seconds_<state>``
+    and ``cost_dollar_proxy_total`` series — works on any bundle that
+    retains the window."""
+    from tpu_autoscaler.obs.tsdb import TimeSeriesDB
+
+    db = TimeSeriesDB.from_dump(dict(tsdb_dump))
+    newest = 0.0
+    for name in db.series_names("cost_"):
+        v = db.points(name)[0]
+        if len(v):
+            newest = max(newest, float(v[-1]))
+    start = newest - window_seconds
+    by_state = {}
+    for state in STATES:
+        d = db.delta(f"cost_chip_seconds_{state}", start, newest)
+        if d is not None and d > 0:
+            by_state[state] = round(d, 3)
+    usd = db.delta("cost_dollar_proxy_total", start, newest)
+    return {"window_seconds": window_seconds,
+            "window": [start, newest],
+            "chip_seconds_by_state": by_state,
+            "dollar_proxy": round(usd, 4) if usd is not None else None}
+
+
+def render_windowed(body: Mapping[str, Any]) -> str:
+    lines = [f"WINDOWED BILL  (trailing {body['window_seconds']:g}s, "
+             f"t=[{body['window'][0]:g}, {body['window'][1]:g}])"]
+    by_state = body.get("chip_seconds_by_state", {})
+    total = sum(by_state.values())
+    for state in STATES:
+        cs = by_state.get(state)
+        if cs is None:
+            continue
+        share = (100.0 * cs / total) if total else 0.0
+        lines.append(f"  {state:<13} {_fmt_cs(cs):>14}  {share:5.1f}%")
+    if not by_state:
+        lines.append("  (no cost_* history retained in the window)")
+    usd = body.get("dollar_proxy")
+    if usd is not None:
+        lines.append(f"  dollar proxy   ~${usd:.2f}")
+    return "\n".join(lines)

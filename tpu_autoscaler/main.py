@@ -206,6 +206,11 @@ _common = [
                       "idle units may be reclaimed well before "
                       "--idle-threshold). Off by default: --policy "
                       "alone only prewarms and holds."),
+    click.option("--price-book", "price_book", default=None,
+                 type=click.Path(exists=True, dir_okay=False),
+                 help="YAML price book for the cost ledger's $-proxy "
+                      "(per-class rates + tier factors; docs/COST.md). "
+                      "Unset: the built-in catalog-derived book."),
     click.option("--slack-hook", default=None,
                  help="Slack incoming-webhook URL for scale events."),
     click.option("--slack-channel", default=None),
@@ -250,11 +255,25 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            policy_min_confidence, policy_waste_budget,
            policy_early_reclaim, slack_hook,
            slack_channel, metrics_port, recorder_spans, recorder_passes,
-           no_alerts, incident_dir, log_json, verbose) -> Controller:
+           no_alerts, incident_dir, log_json, verbose,
+           price_book=None) -> Controller:
     from tpu_autoscaler.logging_setup import setup_logging
     from tpu_autoscaler.obs import AlertEngine, BlackBox, FlightRecorder
 
     setup_logging(verbose=verbose, json_format=log_json)
+    book = None
+    if price_book:
+        import yaml
+
+        from tpu_autoscaler.cost import PriceBook
+
+        try:
+            with open(price_book, encoding="utf-8") as f:
+                book = PriceBook.from_dict(yaml.safe_load(f) or {})
+        except (OSError, ValueError, yaml.YAMLError) as e:
+            raise click.BadParameter(
+                f"invalid price book {price_book!r}: {e}",
+                param_hint="--price-book") from None
     notifier = (SlackNotifier(slack_hook, slack_channel) if slack_hook
                 else LogNotifier())
     metrics = Metrics()
@@ -271,6 +290,7 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         gang_settle_seconds=gang_settle,
         provision_timeout_seconds=provision_timeout,
         enable_preemption=preemption,
+        price_book=book,
         no_scale=no_scale, no_maintenance=no_maintenance)
     policy_engine = None
     if enable_policy:
@@ -305,11 +325,13 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
                                        controller.incident_bundle,
                                        metrics=metrics)
     if metrics_port:
-        # Serve /metrics + /healthz + /debugz + /debugz/tsdb together:
-        # the flight-recorder dump and the metric history ride the
-        # port operators already expose.
+        # Serve /metrics + /healthz + /debugz + /debugz/tsdb +
+        # /debugz/cost together (discoverable via /debugz/index): the
+        # flight-recorder dump, the metric history and the cost bill
+        # all ride the port operators already expose.
         metrics.serve(metrics_port, debugz=controller.debug_dump,
-                      routes={"/debugz/tsdb": controller.tsdb_route})
+                      routes={"/debugz/tsdb": controller.tsdb_route,
+                              "/debugz/cost": controller.cost_route})
     return controller
 
 
@@ -713,12 +735,21 @@ def _load_tsdb_dump(source, url, prefix, window):
               help="Only this many trailing seconds of history.")
 @click.option("--points", "max_points", default=24, show_default=True,
               help="Recent points to print per series.")
-def metrics_history(source, url, series, prefix, window, max_points):
+@click.option("--format", "fmt", default="table", show_default=True,
+              type=click.Choice(["table", "csv"]),
+              help="table = human rendering; csv = machine-readable "
+                   "rows for offline analysis (works for both --url "
+                   "and --from).")
+def metrics_history(source, url, series, prefix, window, max_points,
+                    fmt):
     """Metric history from the in-process TSDB (docs/OBSERVABILITY.md
     "Time-series history"): list retained series, or render one
     series' recent points with its downsampled min/max envelope —
     "when did p99 scale-up start degrading?" without external
-    infrastructure."""
+    infrastructure.  ``--format csv`` streams the same data as CSV
+    (ISSUE 11 satellite): listing mode emits one summary row per
+    series; single-series mode emits every retained point across all
+    tiers, so ledger/TSDB history pulls straight into pandas."""
     dump = _load_tsdb_dump(source, url, prefix if not series else series,
                            window)
     all_series = dump.get("series", {})
@@ -727,6 +758,14 @@ def metrics_history(source, url, series, prefix, window, max_points):
                    "retry)")
         return
     if not series:
+        if fmt == "csv":
+            click.echo("series,points,last_t,last_value")
+            for name in sorted(all_series):
+                raw = all_series[name].get("raw", [])
+                last_t = f"{raw[-1][0]:g}" if raw else ""
+                last_v = f"{raw[-1][1]:g}" if raw else ""
+                click.echo(f"{name},{len(raw)},{last_t},{last_v}")
+            return
         tiers = dump.get("tiers", {})
         click.echo(f"{len(all_series)} series retained "
                    f"(raw={tiers.get('raw_points')}p, "
@@ -742,6 +781,17 @@ def metrics_history(source, url, series, prefix, window, max_points):
         known = ", ".join(sorted(all_series)[:20]) or "(none)"
         raise click.UsageError(
             f"series {series!r} not retained; known (first 20): {known}")
+    if fmt == "csv":
+        # Every retained point, all tiers: raw rows carry value only;
+        # downsampled buckets carry their full aggregate columns.
+        click.echo("series,tier,t,value,min,max,sum,count")
+        for tier in ("coarse", "mid"):
+            for r in body.get(tier, []):
+                click.echo(f"{series},{tier},{r[0]:g},{r[1]:g},"
+                           f"{r[2]:g},{r[3]:g},{r[4]:g},{int(r[5])}")
+        for t, v in body.get("raw", []):
+            click.echo(f"{series},raw,{t:g},{v:g},,,,")
+        return
     for tier in ("coarse", "mid"):
         rows = body.get(tier, [])
         if rows:
@@ -752,6 +802,53 @@ def metrics_history(source, url, series, prefix, window, max_points):
     click.echo(f"raw ({len(raw)} points, showing {max_points}):")
     for t, v in raw[-max_points:]:
         click.echo(f"  t={t:g}  {v:g}")
+
+
+@cli.command("cost-report")
+@dump_options
+@click.option("--window", default=None, type=float,
+              help="Also render a trailing-window bill from the TSDB's "
+                   "cost_* history (seconds).")
+@click.option("--top", default=10, show_default=True,
+              help="Gangs to list in the cost-to-serve ranking.")
+def cost_report(source, url, window, top):
+    """Render the fleet bill (docs/COST.md): every chip-second
+    attributed by state / pool / accelerator class / price tier, the
+    per-gang cost-to-serve ranking, fragmentation scores, and the
+    conservation verdict — from a live controller's ``/debugz/cost``
+    or any incident bundle / SIGUSR1 dump."""
+    from tpu_autoscaler.cost import (
+        render_bill,
+        render_windowed,
+        windowed_bill,
+    )
+
+    _require_one_source(source, url, "an incident bundle")
+    if source:
+        raw = _read_dump_file(source)
+        cost = raw.get("cost")
+        tsdb = raw.get("tsdb")
+        if cost is None:
+            raise click.UsageError(
+                f"{source!r} carries no cost section — capture a fresh "
+                "bundle (SIGUSR1 / alert firing) from a build with the "
+                "cost ledger")
+    else:
+        cost = _fetch_debugz(url, "/debugz/cost")
+        tsdb = _fetch_debugz(url, "/debugz/tsdb",
+                             {"prefix": "cost_"}) if window else None
+    if cost.get("unavailable"):
+        click.echo("(cost snapshot unavailable: writer was mutating; "
+                   "retry)")
+        return
+    click.echo(render_bill(cost, top_gangs=top))
+    if window:
+        if not tsdb or not tsdb.get("series"):
+            raise click.UsageError(
+                "--window needs cost_* TSDB history (none retained in "
+                "this source)")
+        click.echo("")
+        click.echo(render_windowed(windowed_bill(tsdb, window)))
 
 
 @cli.command()

@@ -180,13 +180,23 @@ class Metrics:
         where params is the parsed query string (first value per key).
         How ``/debugz/tsdb`` (``Controller.tsdb_route``) rides the
         port operators already expose, same serialization contract.
+
+        Every port self-describes: ``/debugz/index`` lists the
+        registered routes (ISSUE 11 satellite) so an operator
+        discovers ``/debugz/cost`` or ``/debugz/tsdb`` from the port
+        itself instead of from OBSERVABILITY.md.
         """
         import http.server
         import json
         import urllib.parse
 
         metrics = self
-        routes = routes or {}
+        routes = dict(routes or {})
+        index = sorted({"/metrics", "/healthz", "/debugz/index"}
+                       | set(routes)
+                       | ({"/debugz"} if debugz is not None else set()))
+        routes.setdefault("/debugz/index", lambda params: {
+            "routes": index})
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib naming)

@@ -135,6 +135,15 @@ def main(argv: list[str] | None = None) -> int:
         print(list_traces(bundle))
         print("\n== recent decisions")
         print(render_passes(bundle, last=args.last))
+        cost = bundle.get("cost")
+        if cost and not cost.get("unavailable"):
+            # The ledger snapshot rides every bundle (ISSUE 11): the
+            # incident's bill renders next to its traces — the same
+            # text `tpu-autoscaler cost-report --from <bundle>` emits.
+            from tpu_autoscaler.cost import render_bill
+
+            print("\n== cost")
+            print(render_bill(cost))
 
     report = replay_alerts(bundle)
     if "skipped" in report:

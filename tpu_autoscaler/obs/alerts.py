@@ -114,6 +114,19 @@ def default_rules() -> tuple[AlertRule, ...]:
             name="pass-duration-budget", metric="reconcile_seconds",
             kind="pass_duration", window=600.0, threshold=0.25,
             for_passes=3, clear_passes=5, severity="ticket"),
+        # Cost ledger rules (ISSUE 11, docs/COST.md).  Both are rate
+        # rules over cumulative ledger counters, so the per-second
+        # rate reads directly: chip-seconds/s == average chips in the
+        # state; $/s == average $-proxy burn.
+        AlertRule(
+            name="stranded-capacity-burn",
+            metric="cost_chip_seconds_stranded",
+            kind="rate", window=1800.0, threshold=8.0,
+            for_passes=3, clear_passes=5, severity="ticket"),
+        AlertRule(
+            name="cost-budget-burn", metric="cost_dollar_proxy_total",
+            kind="rate", window=3600.0, threshold=500.0 / 3600.0,
+            for_passes=2, clear_passes=5, severity="ticket"),
     )
 
 

@@ -51,6 +51,7 @@ from tpu_autoscaler.policy.slo import (
     decide_prewarms,
     expires_at,
     idle_threshold_for,
+    rolling_waste,
 )
 
 log = logging.getLogger(__name__)
@@ -174,6 +175,7 @@ class PolicyEngine:
             max_cv=cfg.recurring_max_cv)
         self._metrics: Any = None
         self._tracer: Any = None
+        self._cost_ledger: Any = None
         self._default_generation = "v5e"
         self._prewarms: dict[str, _Prewarm] = {}
         self._seq = 0
@@ -194,15 +196,23 @@ class PolicyEngine:
     # -- wiring -----------------------------------------------------------
 
     def bind(self, metrics: Any = None, tracer: Any = None,
-             default_generation: str | None = None) -> None:
+             default_generation: str | None = None,
+             cost_ledger: Any = None) -> None:
         """Adopt the controller's metrics/tracer and planner default
-        generation (the Controller calls this at construction)."""
+        generation (the Controller calls this at construction).
+
+        ``cost_ledger`` (ISSUE 11): when attached, realized prewarm
+        waste is read from the ledger's per-unit attribution instead
+        of re-derived from the decision's chips×hold estimate — ONE
+        source of truth for wasted chip-seconds (docs/COST.md)."""
         if metrics is not None:
             self._metrics = metrics
         if tracer is not None:
             self._tracer = tracer
         if default_generation is not None:
             self._default_generation = default_generation
+        if cost_ledger is not None:
+            self._cost_ledger = cost_ledger
 
     def bootstrap(self, dump: Mapping[str, Any]) -> int:
         """Recover learned periods from a flight-recorder dump (a
@@ -353,10 +363,24 @@ class PolicyEngine:
                 pw.expired_at = now
                 self._expired += 1
                 self._inc("prewarm_expired")
-                warm_since = pw.ready_at if pw.ready_at is not None \
-                    else (pw.created_at if pw.covered_unit else None)
-                if warm_since is not None:
-                    waste = pw.decision.chips * max(0.0, now - warm_since)
+                # Realized waste: the cost ledger's attributed prewarm
+                # chip-seconds for the warm units when attached (one
+                # source of truth — ISSUE 11); the decision-based
+                # chips×warm-window estimate only when the ledger
+                # never saw the units (no controller, or the units
+                # vanished before expiry).
+                waste = None
+                if self._cost_ledger is not None and pw.warm_units:
+                    waste = self._cost_ledger.accrued_chip_seconds(
+                        pw.warm_units, now, state="prewarm")
+                if waste is None:
+                    warm_since = pw.ready_at if pw.ready_at is not None \
+                        else (pw.created_at if pw.covered_unit
+                              else None)
+                    if warm_since is not None:
+                        waste = pw.decision.chips * max(
+                            0.0, now - warm_since)
+                if waste:
                     self._inc("wasted_prewarm_chip_seconds", waste)
                     self._waste_events.append((now, waste))
                 log.info("prewarm %s expired unconsumed (%s)",
@@ -369,9 +393,8 @@ class PolicyEngine:
                     and (pw.consumed_at or pw.expired_at or 0.0)
                     < horizon]:
             del self._prewarms[key]
-        window = now - cfg.slo.waste_window_seconds
-        self._waste_events = [(t, w) for t, w in self._waste_events
-                              if t >= window]
+        self._waste_events, _ = rolling_waste(
+            self._waste_events, now, cfg.slo.waste_window_seconds)
         total = self._hits + self._expired
         if total:
             self.set_gauge("prewarm_hit_rate", self._hits / total)
@@ -404,16 +427,24 @@ class PolicyEngine:
             # critical path (docs/OBSERVABILITY.md prewarm model).
             start = pw.submitted_at if pw.submitted_at is not None \
                 else pw.created_at
+            attrs = {"shape": pw.decision.shape_name,
+                     "forecast": pw.key,
+                     "provision_id": pw.provision_id,
+                     "covered": covered,
+                     "hidden_s": round(hidden, 3),
+                     "confidence": round(pw.decision.confidence, 3)}
+            if self._cost_ledger is not None and pw.warm_units:
+                # The prewarm's bill (ISSUE 11): chip-seconds the
+                # slice sat warm before this gang consumed it — the
+                # cost the hidden latency was bought with.
+                warm_cs = self._cost_ledger.accrued_chip_seconds(
+                    pw.warm_units, now, state="prewarm")
+                if warm_cs:
+                    attrs["cost_chip_seconds"] = round(warm_cs, 3)
             self._tracer.record(
                 "prewarm", start=start,
                 end=pw.ready_at if pw.ready_at is not None else now,
-                parent=root,
-                attrs={"shape": pw.decision.shape_name,
-                       "forecast": pw.key,
-                       "provision_id": pw.provision_id,
-                       "covered": covered,
-                       "hidden_s": round(hidden, 3),
-                       "confidence": round(pw.decision.confidence, 3)})
+                parent=root, attrs=attrs)
 
     # -- advise side ------------------------------------------------------
 
@@ -465,7 +496,8 @@ class PolicyEngine:
         active = [pw for pw in self._prewarms.values() if not pw.terminal]
         committed = sum(pw.decision.expected_waste_chip_seconds
                         for pw in active)
-        realized = sum(w for _t, w in self._waste_events)
+        _, realized = rolling_waste(self._waste_events, now,
+                                    slo.waste_window_seconds)
         # Belt over the key-level dedup: one predicted event must never
         # hold two prewarms — drop forecasts whose shape already has an
         # active prewarm with an overlapping predicted window (keys can

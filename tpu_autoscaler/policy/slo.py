@@ -173,6 +173,22 @@ def decide_prewarms(forecasts: list[Forecast], now: float, *,
     return decisions, rejections
 
 
+def rolling_waste(events: list[tuple[float, float]], now: float,
+                  window_seconds: float
+                  ) -> tuple[list[tuple[float, float]], float]:
+    """Trim the realized-waste event series to the rolling window and
+    sum what remains: ``(kept_events, realized_chip_seconds)``.
+
+    One authority for the window algebra (ISSUE 11): the engine's
+    budget gate and any ledger-side consumer trim and sum the SAME
+    way, so "how much waste is in the window" can never disagree with
+    "how much budget is left".  Pure over injected values (TAP1xx
+    scope, like the rest of this module)."""
+    floor = now - window_seconds
+    kept = [(t, w) for t, w in events if t >= floor]
+    return kept, sum(w for _t, w in kept)
+
+
 def idle_threshold_for(accel_class: str, now: float, *,
                        policy: SloPolicy, base_threshold: float,
                        provision_estimate: float,

@@ -345,6 +345,21 @@ class ServingMetricsAdapter:
                     s[_N_GAUGE + _C_PREEMPTED])))
         return out
 
+    def fleet_summary(self) -> dict[str, Any]:
+        """O(pools) serving census for the cost surfaces (ISSUE 11):
+        ``/debugz/cost`` and the cost-report CLI show the serving
+        share of the bill next to its live context — replicas,
+        utilization, SLO attainment per pool."""
+        out: dict[str, Any] = {"replicas": self.replicas, "pools": {}}
+        for pool, sig in self.signals().items():
+            out["pools"][pool] = {
+                "replicas": sig.replicas,
+                "shape": sig.shape_name,
+                "utilization": round(sig.utilization, 4),
+                "slo_attainment": round(sig.slo_attainment, 4),
+            }
+        return out
+
     # -- verification (tests, chaos, bench baseline) ----------------------
 
     def rebuild(self) -> dict[str, list[float]]:
