@@ -72,7 +72,7 @@ def _overhead_trend() -> list:
     return trend
 
 
-def run_north_star() -> dict:
+def run_north_star(config_extra: dict | None = None) -> dict:
     from tpu_autoscaler.actuators.fake import FakeActuator
     from tpu_autoscaler.controller import Controller, ControllerConfig
     from tpu_autoscaler.engine.planner import PoolPolicy
@@ -82,7 +82,7 @@ def run_north_star() -> dict:
     kube = FakeKube()
     actuator = FakeActuator(kube, provision_delay=0.0)
     controller = Controller(kube, actuator, ControllerConfig(
-        policy=PoolPolicy(spare_nodes=0)))
+        policy=PoolPolicy(spare_nodes=0), **(config_extra or {})))
     chips_requested = seed_scenario(kube, "v5p-256")
 
     def all_running() -> bool:
@@ -1441,6 +1441,245 @@ def check_cost(units: int = COST_LEDGER_UNITS,
     return ok, info
 
 
+# Repack tier (ISSUE 12, docs/REPACK.md): a churn-heavy week-long
+# replay — long-running gangs on on-demand supply, a daily spot-market
+# cycle (idle spot slices appear, gangs riding them get preempted
+# later), short churn jobs arriving around the clock — run twice
+# through the REAL controller: repacker ON vs OFF.  Gated never-worse
+# on BOTH steady-state chip utilization and total $-proxy, with the
+# per-migration chip-seconds-saved attribution asserted on every
+# completed `repack` trace; the north-star overhead budget re-checked
+# with the repacker ON.  Recorded in BENCH_REPACK.json.
+REPACK_SIM_SECONDS = 7 * 86400.0
+REPACK_STEP_SECONDS = 60.0
+REPACK_MIN_MIGRATIONS = 3
+
+
+def _repack_week(repack: bool, seed: int = 0) -> dict:
+    import random
+
+    from tpu_autoscaler.actuators.fake import FakeActuator
+    from tpu_autoscaler.controller import Controller, ControllerConfig
+    from tpu_autoscaler.engine.planner import PoolPolicy
+    from tpu_autoscaler.k8s.fake import FakeKube
+    from tpu_autoscaler.k8s.payloads import tpu_host_payload
+    from tpu_autoscaler.repack import RepackConfig
+    from tpu_autoscaler.sim import gang_pods
+    from tpu_autoscaler.topology.catalog import (
+        SLICE_ID_LABEL,
+        shape_by_name,
+    )
+
+    rng = random.Random(seed)
+    kube = FakeKube()
+    actuator = FakeActuator(kube, provision_delay=90.0,
+                            stagger_seconds=2.0)
+    controller = Controller(kube, actuator, ControllerConfig(
+        policy=PoolPolicy(spare_nodes=0),
+        grace_seconds=120.0, idle_threshold_seconds=1800.0,
+        drain_grace_seconds=120.0,
+        enable_repack=repack,
+        repack=RepackConfig() if repack else None))
+
+    base_shapes = ("v5e-16", "v5e-32")
+    live: dict[str, dict] = {}
+    spot_seq = 0
+
+    def launch(job, shape, until=None):
+        names = []
+        for p in gang_pods(shape, job):
+            kube.add_pod(p)
+            names.append(p["metadata"]["name"])
+        live[job] = {"shape": shape, "names": names, "until": until}
+
+    def add_spot(shape_name):
+        nonlocal spot_seq
+        spot_seq += 1
+        shape = shape_by_name(shape_name)
+        sid = f"spot-{spot_seq}-{shape_name}"
+        for h in range(shape.hosts):
+            kube.add_node(tpu_host_payload(
+                shape, sid, h, created_at=t, pool="spot-pool",
+                preemptible=True))
+
+    def world_model():
+        node_names = {n["metadata"]["name"] for n in kube.list_nodes()}
+        for p in list(kube.list_pods()):
+            if p["spec"].get("nodeName") \
+                    and p["spec"]["nodeName"] not in node_names:
+                kube.delete_pod(p["metadata"].get("namespace",
+                                                  "default"),
+                                p["metadata"]["name"])
+        for job, spec in list(live.items()):
+            if spec["until"] is not None and t >= spec["until"]:
+                for n in spec["names"]:
+                    if kube.get_pod("default", n) is not None:
+                        kube.delete_pod("default", n)
+                del live[job]
+                continue
+            fresh = {p["metadata"]["name"]: p
+                     for p in gang_pods(spec["shape"], job)}
+            for n in spec["names"]:
+                if kube.get_pod("default", n) is None:
+                    kube.add_pod(fresh[n])
+
+    def preempt_spot_units():
+        # The spot market reclaims: busy spot slices get the
+        # impending-termination taint (checkpoint drain), idle ones
+        # vanish outright.
+        bound = {p["spec"].get("nodeName") for p in kube.list_pods()
+                 if p["spec"].get("nodeName")}
+        units: dict[str, list[str]] = {}
+        for n in kube.list_nodes():
+            labels = n["metadata"].get("labels", {})
+            sid = labels.get(SLICE_ID_LABEL)
+            if sid and sid.startswith("spot-"):
+                units.setdefault(sid, []).append(n["metadata"]["name"])
+        for sid, hosts in units.items():
+            if any(h in bound for h in hosts):
+                actuator.preempt_unit(sid)
+            else:
+                for h in hosts:
+                    kube.delete_node(h)
+
+    # The week's program, derived deterministically from the seed.
+    for i, shape in enumerate(base_shapes):
+        launch(f"steady-{i}", shape)
+    events = []  # (t, fn)
+    day = 86400.0
+    for d in range(int(REPACK_SIM_SECONDS // day)):
+        # Spot frees up mid-morning, is reclaimed in the evening.
+        at = d * day + rng.uniform(2.0, 4.0) * 3600.0
+        for shape in base_shapes:
+            events.append((at, lambda s=shape: add_spot(s)))
+        events.append((d * day + rng.uniform(14.0, 16.0) * 3600.0,
+                       preempt_spot_units))
+        # Churn: short jobs around the clock.
+        for c in range(2):
+            start = d * day + rng.uniform(0.0, 20.0) * 3600.0
+            dur = rng.uniform(1.0, 2.0) * 3600.0
+            events.append((start,
+                           lambda j=f"churn-{d}-{c}", e=start + dur:
+                           launch(j, "v5e-16", until=e)))
+    events.sort(key=lambda e: e[0])
+
+    t = 0.0
+    util_samples = []
+    while t <= REPACK_SIM_SECONDS:
+        while events and events[0][0] <= t:
+            events.pop(0)[1]()
+        world_model()
+        controller.reconcile_once(now=t)
+        kube.schedule_step()
+        snap = controller.metrics.snapshot()["gauges"]
+        fleet = snap.get("fleet_chips", 0)
+        if fleet:
+            busy = (snap.get("cost_chips_serving", 0)
+                    + snap.get("cost_chips_training", 0))
+            util_samples.append(busy / fleet)
+        t += REPACK_STEP_SECONDS
+
+    counters = controller.metrics.snapshot()["counters"]
+    dump = controller.recorder.dump(tracer=controller.tracer)
+    roots = [s for s in dump["spans"] if s["name"] == "repack"
+             and s["parent_id"] is None and s["end"] is not None]
+    completed = [s for s in roots
+                 if not s["attrs"].get("aborted")
+                 and not s["attrs"].get("error")]
+    return {
+        "repack": repack,
+        "dollar_proxy_total": round(
+            counters.get("cost_dollar_proxy_total", 0.0), 2),
+        "utilization": round(sum(util_samples)
+                             / max(1, len(util_samples)), 4),
+        "migrations_started": int(
+            counters.get("repack_migrations_started", 0)),
+        "migrations_completed": int(
+            counters.get("repack_migrations_completed", 0)),
+        "migrations_aborted": int(
+            counters.get("repack_migrations_aborted", 0)),
+        "chip_seconds_saved": round(
+            counters.get("repack_chip_seconds_saved", 0.0), 1),
+        "dollar_proxy_saved": round(
+            counters.get("repack_dollar_proxy_saved", 0.0), 2),
+        "conservation_violations":
+            controller.cost.conservation_violations,
+        "completed_traces": len(completed),
+        "completed_traces_attributed": sum(
+            1 for s in completed
+            if "chip_seconds_saved" in s["attrs"]
+            and "dollar_proxy_saved" in s["attrs"]),
+    }
+
+
+def bench_repack(seed: int = 0) -> dict:
+    on = _repack_week(repack=True, seed=seed)
+    off = _repack_week(repack=False, seed=seed)
+    return {"info": "repack", "on": on, "off": off,
+            "sim_seconds": REPACK_SIM_SECONDS,
+            "step_seconds": REPACK_STEP_SECONDS}
+
+
+def check_repack(seed: int = 0) -> tuple[bool, dict]:
+    """Gate (ISSUE 12): on the churn-heavy week-long replay the
+    repacker must be NEVER WORSE than no-repack on both steady-state
+    chip utilization and total $-proxy, every completed `repack`
+    trace must carry its chip-seconds-saved attribution, the
+    conservation identity must hold through every migration, and the
+    north-star overhead budget must stay green with the repacker ON.
+    Records BENCH_REPACK.json."""
+    info = bench_repack(seed=seed)
+    on, off = info["on"], info["off"]
+    print(json.dumps(info), file=sys.stderr)
+
+    # North-star overhead with the repacker ON (the always-on repack
+    # pass must fit the same budget every other subsystem honors).
+    from tpu_autoscaler.repack import RepackConfig
+
+    def north_with_repack():
+        return run_north_star(
+            config_extra={"enable_repack": True,
+                          "repack": RepackConfig()})
+
+    north_with_repack()
+    north_cpu = min(north_with_repack()["cpu_s"] for _ in range(3))
+
+    never_worse = (on["dollar_proxy_total"]
+                   <= off["dollar_proxy_total"] * 1.001
+                   and on["utilization"] >= off["utilization"] - 1e-3)
+    attributed = (on["completed_traces"] >= 1
+                  and on["completed_traces_attributed"]
+                  == on["completed_traces"])
+    ok = (never_worse and attributed
+          and on["migrations_completed"] >= REPACK_MIN_MIGRATIONS
+          and on["conservation_violations"] == 0
+          and off["conservation_violations"] == 0
+          and north_cpu <= OVERHEAD_BUDGET_S)
+    result = {**info, "north_star_cpu_s": round(north_cpu, 4),
+              "north_star_budget_s": OVERHEAD_BUDGET_S}
+    _record_tier("BENCH_REPACK.json", "repack", {
+        "dollar_proxy_on": on["dollar_proxy_total"],
+        "dollar_proxy_off": off["dollar_proxy_total"],
+        "utilization_on": on["utilization"],
+        "utilization_off": off["utilization"],
+        "migrations_completed": on["migrations_completed"],
+        "migrations_aborted": on["migrations_aborted"],
+        "chip_seconds_saved": on["chip_seconds_saved"],
+        "dollar_proxy_saved": on["dollar_proxy_saved"],
+        "north_star_cpu_s": round(north_cpu, 4),
+        "gates": {"never_worse": True,
+                  "min_migrations": REPACK_MIN_MIGRATIONS,
+                  "north_star_s": OVERHEAD_BUDGET_S},
+    })
+    if not ok:
+        print(json.dumps({"error": "repack tier regression: repack "
+                          "worse than no-repack, missing trace "
+                          "attribution, conservation broken, or "
+                          "north-star budget blown", **result}),
+              file=sys.stderr)
+    return ok, result
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -1587,6 +1826,29 @@ def main(argv: list[str] | None = None) -> int:
             "unit": "ms_per_pass",
             "vs_baseline": (round(args.close_gate / close_ms, 2)
                             if close_ms else None),
+        }))
+        return 0 if ok else 1
+    if argv and argv[0] == "repack":
+        # Repack tier (ISSUE 12, scripts/full_suite.sh + ci_gate.sh):
+        # week-long churn replay, repack never worse than no-repack on
+        # utilization AND $-proxy, per-migration attribution on every
+        # completed trace, north-star budget green with the repacker
+        # ON; records BENCH_REPACK.json.
+        ap = argparse.ArgumentParser(prog="bench.py repack")
+        ap.add_argument("--seed", type=int, default=0)
+        args = ap.parse_args(argv[1:])
+        ok, info = check_repack(seed=args.seed)
+        saved = info["on"]["dollar_proxy_saved"]
+        off_usd = info["off"]["dollar_proxy_total"]
+        print(json.dumps({
+            "metric": "repack_week_dollar_proxy_saved",
+            "value": saved,
+            "unit": "usd_proxy",
+            "vs_baseline": (round(off_usd
+                                  / info["on"]["dollar_proxy_total"],
+                                  3)
+                            if info["on"]["dollar_proxy_total"]
+                            else None),
         }))
         return 0 if ok else 1
     if argv and argv[0] == "trace":

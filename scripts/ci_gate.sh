@@ -36,6 +36,15 @@
 #      bounded, conservation + rebuild oracle green, north-star
 #      budget green with the ledger ON; BENCH_COST.json — ISSUE 11,
 #      docs/COST.md)
+#   12 repack tier (bench.py repack: churn-heavy week-long replay,
+#      repack NEVER WORSE than no-repack on steady-state utilization
+#      and $-proxy, per-migration chip-seconds-saved attribution on
+#      every completed trace, north-star budget green with the
+#      repacker ON; BENCH_REPACK.json — ISSUE 12, docs/REPACK.md.
+#      The 200-seed chaos `repack` corpus — migrations raced by spot
+#      reclamation, destination stockouts and mid-drain gang deletes,
+#      with the never-net-negative-savings + guard-capped-abort
+#      invariants — runs in the chaos stage above, exit 7.)
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -45,26 +54,26 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/10] invariant analysis (--format=$fmt)"
+echo "== [1/11] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/10] mypy strict islands"
+echo "== [2/11] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/10] deterministic-schedule race tier"
+echo "== [3/11] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
 
-echo "== [4/10] tracer-overhead gate"
+echo "== [4/11] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [5/10] mega-cluster scale tiers"
+echo "== [5/11] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [6/10] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts)"
+echo "== [6/11] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -86,17 +95,27 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 # window; quiet seeds must produce ZERO false-positive firings.
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 300 --profile alerts || exit 7
+# The repack corpus (ISSUE 12): migrations raced by spot reclamation,
+# destination stockouts (spot_dry) and mid-drain gang deletion, with
+# ICI-integrity + cost-conservation live per step and the
+# never-net-negative-savings / guard-capped-abort-cost invariants
+# asserted at terminal (docs/REPACK.md).
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 400 --profile repack || exit 7
 
-echo "== [7/10] policy replay tier"
+echo "== [7/11] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [8/10] serving tier (adapter hot path + outcome replay)"
+echo "== [8/11] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
-echo "== [9/10] obs tier (TSDB ingest + alert evaluation)"
+echo "== [9/11] obs tier (TSDB ingest + alert evaluation)"
 JAX_PLATFORMS=cpu python bench.py obs || exit 10
 
-echo "== [10/10] cost tier (attribution ledger pass cost + conservation)"
+echo "== [10/11] cost tier (attribution ledger pass cost + conservation)"
 JAX_PLATFORMS=cpu python bench.py cost || exit 11
+
+echo "== [11/11] repack tier (week-long churn replay, never-worse gate)"
+JAX_PLATFORMS=cpu python bench.py repack || exit 12
 
 echo "CI GATE GREEN"

@@ -68,6 +68,13 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 # silent (zero false positives) — docs/CHAOS.md, OBSERVABILITY.md.
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 300 --profile alerts
+# Repack profile (ISSUE 12): the repacker ON over on-demand gangs with
+# spot slices arriving mid-run — migrations raced by spot reclamation,
+# destination stockouts and mid-drain gang deletes; conservation and
+# ICI integrity per step, never-net-negative-savings and the
+# guard-capped abort cost at terminal (docs/REPACK.md, CHAOS.md).
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 400 --profile repack
 
 # Policy replay tier (ISSUE 8): the recurring north-star trace must
 # show prewarmed detect->running <= 0.25x the reactive baseline, and a
@@ -103,6 +110,14 @@ JAX_PLATFORMS=cpu python bench.py obs
 # and the north-star overhead budget (12 ms) still green with the
 # ledger ON; results merge into BENCH_COST.json (docs/COST.md).
 JAX_PLATFORMS=cpu python bench.py cost
+
+# Repack tier (ISSUE 12): the churn-heavy week-long replay — repack
+# never worse than no-repack on steady-state chip utilization AND
+# total $-proxy, every completed `repack` trace carrying its
+# chip-seconds-saved attribution, conservation intact through every
+# migration, north-star budget green with the repacker ON; results
+# merge into BENCH_REPACK.json (docs/REPACK.md).
+JAX_PLATFORMS=cpu python bench.py repack
 
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py

@@ -16,6 +16,7 @@ from tpu_autoscaler.testing.chaosfixtures import (
     GANG_SPLIT_BACKFILL,
     LATE_PROVISION_SPAN,
     ORPHANED_PARTIAL_SLICE,
+    REPACK_GUARDLESS_LOSS,
     SABOTAGE,
 )
 
@@ -170,7 +171,8 @@ class TestPromotedRegressions:
 
     @pytest.mark.parametrize("fixture", [LATE_PROVISION_SPAN,
                                          ORPHANED_PARTIAL_SLICE,
-                                         GANG_SPLIT_BACKFILL],
+                                         GANG_SPLIT_BACKFILL,
+                                         REPACK_GUARDLESS_LOSS],
                              ids=lambda f: f.name)
     def test_sabotaged_run_is_caught_by_the_invariant(self, fixture):
         result = fixture.run(sabotage=SABOTAGE[fixture.name])
@@ -179,3 +181,23 @@ class TestPromotedRegressions:
             f"{fixture.invariant} — the fixture has gone stale")
         assert any(fixture.invariant in v for v in result.violations), \
             "\n".join(result.violations)
+
+    def test_repack_fixture_exercises_the_abort_path(self):
+        """The ISSUE 12 acceptance: the budget-guard abort path is
+        exercised by a promoted chaos fixture — the shipped guard
+        ABORTS the destination-gone migration (and the run holds
+        every invariant), where the sabotaged run above completes it
+        net-negative."""
+        from tpu_autoscaler.chaos.engine import _Run
+
+        run = _Run(REPACK_GUARDLESS_LOSS.program())
+        result = run.execute()
+        assert result.ok, "\n".join(result.violations)
+        counters = run.controller.metrics.snapshot()["counters"]
+        assert counters.get("repack_migrations_aborted", 0) >= 1
+        # The abort is traced and explained.
+        dump = run.controller.recorder.dump(tracer=run.controller.tracer)
+        aborted = [s for s in dump["spans"] if s["name"] == "repack"
+                   and s["parent_id"] is None
+                   and s["attrs"].get("aborted")]
+        assert aborted and all("reason" in s["attrs"] for s in aborted)

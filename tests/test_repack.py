@@ -1,0 +1,731 @@
+"""Cost-aware continuous repacking (ISSUE 12, docs/REPACK.md).
+
+Three layers, mirroring the subsystem:
+
+- pure algebra (repack/policy.py): candidate selection, projections,
+  the abort verdict, realized attribution — no controller;
+- lifecycle e2e (Controller + FakeKube + FakeActuator): a displaced
+  gang migrates onto idle spot, an oversized gang right-sizes, the
+  budget guard aborts when the destination vanishes and leaves the
+  fleet planner-reachable;
+- the seeded churn property suite: repack-vs-no-repack $-proxy never
+  worse on every seed, the conservation identity holds through every
+  migration, and the ledger's incremental counters match the rebuild
+  oracle.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.cost.pricebook import PriceBook
+from tpu_autoscaler.engine.planner import PoolPolicy
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.k8s.payloads import tpu_host_payload
+from tpu_autoscaler.repack import (
+    RepackConfig,
+    UnitRow,
+    plan_candidates,
+    realized_attribution,
+    should_abort,
+)
+from tpu_autoscaler.sim import gang_pods
+from tpu_autoscaler.topology.catalog import shape_by_name
+
+
+def _rate(accel: str, tier: str) -> float:
+    return PriceBook().rate(accel, tier)[0]
+
+
+def _row(unit_id="u0", pool="pool-a", accel="tpu-v5-lite-podslice",
+         tier="on_demand", shape="v5e-16", chips=16, used=16,
+         state="training", since=0.0, gang_id="job/default/a#0"):
+    return UnitRow(unit_id=unit_id, pool=pool, accel=accel, tier=tier,
+                   shape=shape, chips=chips, used_chips=used,
+                   state=state, since=since, gang_id=gang_id)
+
+
+CFG = RepackConfig(min_dwell_seconds=60.0, drain_estimate_seconds=30.0,
+                   provision_estimate_seconds=30.0,
+                   savings_horizon_seconds=3600.0)
+
+
+class TestPlanCandidates:
+    def test_displace_needs_idle_spot_of_same_shape(self):
+        plans, _ = plan_candidates(
+            [_row()], {"v5e-16": 16}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=1e9)
+        assert len(plans) == 1
+        assert plans[0].kind == "displace"
+        assert plans[0].target_shape == "v5e-16"
+        plans, _ = plan_candidates(
+            [_row()], {"v5e-8": 16}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=1e9)
+        assert plans == []
+
+    def test_spot_tier_unit_never_displaced(self):
+        plans, _ = plan_candidates(
+            [_row(tier="spot")], {"v5e-16": 16}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=1e9)
+        assert plans == []
+
+    def test_min_dwell_rejects_fresh_unit(self):
+        plans, rejections = plan_candidates(
+            [_row(since=100.0)], {"v5e-16": 16}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=1e9)
+        assert plans == []
+        assert any("min dwell" in r for r in rejections)
+
+    def test_burning_pool_excluded(self):
+        plans, rejections = plan_candidates(
+            [_row(state="serving")], {"v5e-16": 16}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=1e9,
+            burning_pools=frozenset({"pool-a"}))
+        assert plans == []
+        assert any("SLO-burning" in r for r in rejections)
+
+    def test_rightsize_uses_caller_target(self):
+        plans, _ = plan_candidates(
+            [_row(used=8)], {}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=1e9,
+            rightsize_targets={"u0": ("v5e-8", 8)})
+        assert len(plans) == 1
+        assert plans[0].kind == "rightsize"
+        assert plans[0].target_chips == 8
+        # 8 chips freed for an hour vs (16*30 + 8*30) drain+provision.
+        assert plans[0].projected_saving_cs == pytest.approx(8 * 3600.0)
+
+    def test_budget_and_concurrency_gates(self):
+        rows = [_row(unit_id="u0"), _row(unit_id="u1",
+                                         gang_id="job/default/b#0")]
+        plans, rejections = plan_candidates(
+            rows, {"v5e-16": 32}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=1e9)
+        assert len(plans) == 1  # max_concurrent_migrations = 1
+        assert any("max_concurrent_migrations" in r for r in rejections)
+        plans, rejections = plan_candidates(
+            rows, {"v5e-16": 32}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=10.0)
+        assert plans == []
+        assert any("budget" in r for r in rejections)
+
+    def test_one_idle_spot_slice_not_double_counted(self):
+        cfg = RepackConfig(min_dwell_seconds=60.0,
+                           max_concurrent_migrations=4,
+                           drain_estimate_seconds=30.0)
+        rows = [_row(unit_id="u0"), _row(unit_id="u1",
+                                         gang_id="job/default/b#0")]
+        plans, _ = plan_candidates(
+            rows, {"v5e-16": 16}, _rate, 120.0, cfg,
+            active_migrations=0, budget_remaining_cs=1e9)
+        assert len(plans) == 1
+
+    def test_admission_bar_rejects_thin_savings(self):
+        # A horizon so short the drain cost dominates.
+        cfg = RepackConfig(min_dwell_seconds=0.0,
+                           savings_horizon_seconds=10.0,
+                           drain_estimate_seconds=120.0)
+        plans, rejections = plan_candidates(
+            [_row()], {"v5e-16": 16}, _rate, 120.0, cfg,
+            active_migrations=0, budget_remaining_cs=1e9)
+        assert plans == []
+        assert any("admission bar" in r for r in rejections)
+
+
+class TestAbortVerdict:
+    def _plan(self):
+        plans, _ = plan_candidates(
+            [_row()], {"v5e-16": 16}, _rate, 120.0, CFG,
+            active_migrations=0, budget_remaining_cs=1e9)
+        return plans[0]
+
+    def test_destination_gone_aborts(self):
+        verdict = should_abort(self._plan(), CFG, realized_cost_cs=0.0,
+                               elapsed=5.0,
+                               destination_available=False,
+                               provision_pending=False)
+        assert verdict is not None and "destination gone" in verdict
+
+    def test_cost_overrun_aborts(self):
+        plan = self._plan()
+        assert should_abort(plan, CFG, realized_cost_cs=10.0,
+                            elapsed=5.0, destination_available=True,
+                            provision_pending=False) is None
+        verdict = should_abort(
+            plan, CFG, realized_cost_cs=plan.projected_saving_cs + 1,
+            elapsed=5.0, destination_available=True,
+            provision_pending=False)
+        assert verdict is not None and "exceeds projected savings" \
+            in verdict
+
+    def test_attribution_nets_out_cost(self):
+        plan = self._plan()
+        attrs = realized_attribution(plan, CFG, realized_cost_cs=100.0,
+                                     landed_rate=plan.rate_dst)
+        assert attrs["migration_cost_chip_seconds"] == 100.0
+        assert attrs["chip_seconds_saved"] == pytest.approx(
+            plan.freed_cs_per_s * 3600.0 - 100.0)
+        # Landing somewhere expensive (misfire) erases the savings.
+        misfire = realized_attribution(plan, CFG,
+                                       realized_cost_cs=100.0,
+                                       landed_rate=plan.rate_src)
+        assert misfire["chip_seconds_saved"] < 0
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle e2e through the real Controller.
+
+
+class _StubAdvice:
+    advisory: list = []
+    scale_in: dict = {}
+    desired: dict = {}
+
+
+class _StubServingScaler:
+    """Just enough scaler for the burning-pool exclusion test: a live
+    adapter whose ``burning_pools`` is canned."""
+
+    def __init__(self, burning):
+        class _Adapter:
+            def burning_pools(self, floor):
+                return set(burning)
+
+        self.adapter = _Adapter()
+
+    def bind(self, **kw):
+        pass
+
+    def advise(self, statuses, now):
+        return _StubAdvice()
+
+
+class RepackWorld:
+    """FakeKube + FakeActuator + Controller with the world-model bits
+    the chaos engine supplies (node GC, Job controller)."""
+
+    def __init__(self, repack=True, provision_delay=10.0,
+                 serving_scaler=None, **cfg_kw):
+        self.kube = FakeKube()
+        self.actuator = FakeActuator(self.kube,
+                                     provision_delay=provision_delay)
+        repack_cfg = cfg_kw.pop("repack_cfg", None) or RepackConfig(
+            min_dwell_seconds=30.0, drain_estimate_seconds=30.0,
+            provision_estimate_seconds=30.0,
+            savings_horizon_seconds=3600.0,
+            gang_cooldown_seconds=600.0)
+        self.controller = Controller(
+            self.kube, self.actuator,
+            ControllerConfig(
+                policy=PoolPolicy(spare_nodes=0),
+                grace_seconds=30.0, idle_threshold_seconds=600.0,
+                drain_grace_seconds=20.0,
+                enable_repack=repack, repack=repack_cfg, **cfg_kw),
+            serving_scaler=serving_scaler)
+        self.jobs: dict[str, dict] = {}
+        self.t = 0.0
+
+    def launch(self, job, shape, pinned=True, count=None):
+        """``count`` keeps only the first N member pods — a partial
+        gang whose chip demand undershoots the slice shapes it can
+        bind to (the overprovisioned-placement generator)."""
+        spec = {"job": job, "shape": shape, "pinned": pinned,
+                "count": count}
+        names = []
+        for p in gang_pods(shape, job, pin_topology=pinned)[:count]:
+            self.kube.add_pod(p)
+            names.append(p["metadata"]["name"])
+        spec["names"] = names
+        self.jobs[job] = spec
+
+    def add_idle_slice(self, shape_name, sid, *, preemptible=True,
+                       pool=None):
+        shape = shape_by_name(shape_name)
+        for i in range(shape.hosts):
+            self.kube.add_node(tpu_host_payload(
+                shape, sid, i, created_at=self.t,
+                pool=pool or ("spot-pool" if preemptible else "od-pool"),
+                preemptible=preemptible))
+
+    def _world_model(self):
+        node_names = {n["metadata"]["name"]
+                      for n in self.kube.list_nodes()}
+        for p in list(self.kube.list_pods()):
+            if p["spec"].get("nodeName") \
+                    and p["spec"]["nodeName"] not in node_names:
+                self.kube.delete_pod(
+                    p["metadata"].get("namespace", "default"),
+                    p["metadata"]["name"])
+        for spec in self.jobs.values():
+            fresh = {p["metadata"]["name"]: p
+                     for p in gang_pods(spec["shape"], spec["job"],
+                                        pin_topology=spec["pinned"]
+                                        )[:spec.get("count")]}
+            for n in spec["names"]:
+                if self.kube.get_pod("default", n) is None:
+                    self.kube.add_pod(fresh[n])
+
+    def step(self, n=1, dt=5.0):
+        for _ in range(n):
+            self._world_model()
+            self.controller.reconcile_once(now=self.t)
+            self.kube.schedule_step()
+            assert self.controller.cost.conservation_violations == 0
+            self.t += dt
+
+    def counters(self):
+        return self.controller.metrics.snapshot()["counters"]
+
+    def all_running(self):
+        pods = self.kube.list_pods()
+        return bool(pods) and all(p["status"]["phase"] == "Running"
+                                  for p in pods)
+
+    def gang_tiers(self, job):
+        nodes = {n["metadata"]["name"]: n
+                 for n in self.kube.list_nodes()}
+        tiers = set()
+        for p in self.kube.list_pods():
+            if not p["metadata"]["name"].startswith(job):
+                continue
+            labels = nodes.get(p["spec"].get("nodeName", ""),
+                               {}).get("metadata", {}).get("labels", {})
+            tiers.add("spot" if labels.get("cloud.google.com/gke-spot")
+                      else "on_demand")
+        return tiers
+
+
+class TestDisplaceMigration:
+    def test_gang_moves_to_idle_spot_and_source_is_released(self):
+        w = RepackWorld()
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        assert w.all_running()
+        assert w.gang_tiers("job-a") == {"on_demand"}
+        w.add_idle_slice("v5e-16", "spot-s0")
+        w.step(40)
+        c = w.counters()
+        assert c.get("repack_migrations_started") == 1
+        assert c.get("repack_migrations_completed") == 1
+        assert w.all_running()
+        assert w.gang_tiers("job-a") == {"spot"}
+        # The expensive source slice was released whole.
+        assert any(u.startswith("v5e-16-prov")
+                   for u in w.actuator.deleted_units)
+        assert c.get("repack_chip_seconds_saved", 0) > 0
+
+    def test_trace_closes_with_attribution(self):
+        from tpu_autoscaler.obs import trace_gaps
+
+        w = RepackWorld()
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        w.add_idle_slice("v5e-16", "spot-s0")
+        w.step(40)
+        dump = w.controller.debug_dump()
+        roots = [s for s in dump["spans"] if s["name"] == "repack"
+                 and s["parent_id"] is None]
+        assert len(roots) == 1
+        root = roots[0]
+        assert root["end"] is not None
+        assert root["attrs"]["chip_seconds_saved"] > 0
+        assert root["attrs"]["dollar_proxy_saved"] > 0
+        assert "migration_cost_chip_seconds" in root["attrs"]
+        assert trace_gaps(dump, root["trace_id"]) == []
+        # Children: the drain phase at minimum.
+        names = {s["name"] for s in dump["spans"]
+                 if s["trace_id"] == root["trace_id"]}
+        assert "repack_drain" in names
+
+    def test_no_migration_without_repack_enabled(self):
+        w = RepackWorld(repack=False)
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        w.add_idle_slice("v5e-16", "spot-s0")
+        w.step(40)
+        assert w.counters().get("repack_migrations_started") is None
+        assert w.gang_tiers("job-a") == {"on_demand"}
+
+    def test_cooldown_prevents_thrash(self):
+        w = RepackWorld()
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        w.add_idle_slice("v5e-16", "spot-s0")
+        w.step(40)
+        # One more idle spot slice appears; the gang is already on
+        # spot so no displacement — and even a hypothetical candidate
+        # is inside its cooldown.  No second migration.
+        w.add_idle_slice("v5e-16", "spot-s1")
+        w.step(20)
+        assert w.counters().get("repack_migrations_started") == 1
+
+
+class TestRightsizeMigration:
+    def test_oversized_gang_moves_to_fitted_slice(self):
+        w = RepackWorld()
+        # A partial unpinned podslice gang (2 of v5e-16's 4 pods =
+        # 8 chips) binds to the only free supply, an idle on-demand
+        # v5e-32 — a topology-poor placement stranding 24 chips
+        # INSIDE a busy unit.  The fitter right-sizes it to the
+        # smallest feasible podslice shape and the repacker migrates.
+        w.add_idle_slice("v5e-32", "big-s0", preemptible=False)
+        w.launch("job-b", "v5e-16", pinned=False, count=2)
+        w.step(4)
+        assert w.all_running()
+        bound = {p["spec"]["nodeName"] for p in w.kube.list_pods()
+                 if p["spec"].get("nodeName")}
+        assert all(b.startswith("big-s0") for b in bound)
+        w.step(48)
+        c = w.counters()
+        assert c.get("repack_migrations_started") == 1
+        assert c.get("repack_migrations_completed") == 1
+        assert w.all_running()
+        # The gang now runs on a right-sized slice; the v5e-32 is gone.
+        bound = {p["spec"]["nodeName"] for p in w.kube.list_pods()
+                 if p["spec"].get("nodeName")}
+        assert all(not b.startswith("big-s0") for b in bound)
+        assert "big-s0" in w.actuator.deleted_units
+        assert c.get("repack_chip_seconds_saved", 0) > 0
+
+
+class TestBudgetGuardAbort:
+    def test_destination_vanishes_mid_drain_aborts_planner_reachable(
+            self):
+        w = RepackWorld(provision_delay=30.0)
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        assert w.all_running()
+        w.add_idle_slice("v5e-16", "spot-s0")
+        # Step until the migration starts (drain begins).
+        for _ in range(30):
+            w.step(1)
+            if w.counters().get("repack_migrations_started"):
+                break
+        assert w.counters().get("repack_migrations_started") == 1
+        # Spot market dries up: the destination slice disappears
+        # before the gang landed (its nodes are still workload-free).
+        for n in list(w.kube.list_nodes()):
+            if n["metadata"]["name"].startswith("spot-s0"):
+                w.kube.delete_node(n["metadata"]["name"])
+        w.step(30)
+        c = w.counters()
+        assert c.get("repack_migrations_aborted") == 1
+        assert not c.get("repack_migrations_completed")
+        # Planner-reachable: the gang converges Running again and no
+        # bookkeeping is left open.
+        assert w.all_running()
+        assert not w.controller._slice_repairs
+        assert w.gang_tiers("job-a") == {"on_demand"}
+        # The trace closed, explained.
+        dump = w.controller.debug_dump()
+        roots = [s for s in dump["spans"] if s["name"] == "repack"
+                 and s["parent_id"] is None]
+        assert len(roots) == 1
+        assert roots[0]["end"] is not None
+        assert roots[0]["attrs"].get("aborted") is True
+        assert "reason" in roots[0]["attrs"]
+
+
+class TestServingExclusion:
+    def test_burning_pool_replicas_never_migrated(self):
+        """Serving pool names are LOGICAL — the do-not-touch mapping
+        rides the serve-<pool>-<n> gang-name convention, not node-pool
+        labels (review-found: a label-only check never fires)."""
+        w = RepackWorld(
+            serving_scaler=_StubServingScaler({"web"}))
+        w.launch("serve-web-1", "v5e-16")
+        w.step(12)
+        assert w.all_running()
+        w.add_idle_slice("v5e-16", "spot-s0")
+        w.step(30)
+        assert w.counters().get("repack_migrations_started") is None
+
+    def test_healthy_pool_replicas_still_migrate(self):
+        w = RepackWorld(serving_scaler=_StubServingScaler(set()))
+        w.launch("serve-web-1", "v5e-16")
+        w.step(12)
+        w.add_idle_slice("v5e-16", "spot-s0")
+        w.step(40)
+        assert w.counters().get("repack_migrations_completed") == 1
+
+
+class TestAbandonCleanup:
+    def test_timed_out_migration_cancels_provision_and_uncordons(self):
+        """Review-found: the timeout close must run the SAME cleanup
+        as a budget abort — cancel the replacement provision, uncordon
+        the un-landed source — or it leaks an orphan provision and
+        drains a healthy slice for nothing."""
+        w = RepackWorld(
+            slice_repair_timeout_seconds=120.0,
+            repack_cfg=RepackConfig(
+                min_dwell_seconds=30.0, drain_estimate_seconds=30.0,
+                provision_estimate_seconds=1e6,  # guard never trips
+                savings_horizon_seconds=1e9,
+                gang_cooldown_seconds=600.0))
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        assert w.all_running()
+        w.add_idle_slice("v5e-16", "spot-s0")
+        for _ in range(30):
+            w.step(1)
+            if w.counters().get("repack_migrations_started"):
+                break
+        assert w.counters().get("repack_migrations_started") == 1
+        # The destination vanishes and every new provision stalls
+        # forever: with the guard silenced the migration can never
+        # finish and must hit the timeout.
+        for n in list(w.kube.list_nodes()):
+            if n["metadata"]["name"].startswith("spot-s0"):
+                w.kube.delete_node(n["metadata"]["name"])
+        w.controller._guard_repacks = lambda *a, **k: None
+        w.actuator.set_provision_delay(1e9)
+        linked = None
+        for _ in range(40):
+            w.step(1)
+            st = next(iter(w.controller._slice_repairs.values()), None)
+            if st is not None and st.get("provision_id"):
+                linked = st["provision_id"]
+            if w.counters().get("repack_migrations_abandoned"):
+                break
+        c = w.counters()
+        assert c.get("repack_migrations_abandoned") == 1
+        assert not w.controller._slice_repairs
+        # The LINKED replacement provision was cancelled at close (an
+        # organic re-provision for the re-pended gang may follow —
+        # that one is the planner's business, not the migration's).
+        assert linked is not None
+        assert not any(s.id == linked and s.in_flight
+                       for s in w.actuator.statuses())
+        # Planner-reachable: restore the cloud and the gang converges
+        # back onto on-demand supply.
+        w.actuator.set_provision_delay(10.0)
+        w.step(30)
+        assert w.all_running()
+        assert w.gang_tiers("job-a") == {"on_demand"}
+
+
+class TestRepackRoute:
+    def test_debugz_repack_body(self):
+        w = RepackWorld()
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        w.add_idle_slice("v5e-16", "spot-s0")
+        w.step(40)
+        body = w.controller.repack_route()
+        assert body["totals"]["completed"] == 1
+        assert body["recent"][-1]["outcome"] == "completed"
+        assert body["active"] == []
+        # And the incident bundle carries the same section.
+        bundle = w.controller.incident_bundle("test")
+        assert bundle["repack"]["totals"]["completed"] == 1
+
+    def test_disabled_route_says_so(self):
+        w = RepackWorld(repack=False)
+        w.step(2)
+        assert w.controller.repack_route()["disabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# Seeded churn property suite: repack never worse than no-repack.
+
+
+def _churn_world(seed: int, repack: bool) -> RepackWorld:
+    """One seeded churn scenario: gangs on on-demand supply, spot
+    slices appearing over time, deterministic per seed."""
+    rng = random.Random(seed)
+    w = RepackWorld(repack=repack)
+    shapes = [rng.choice(("v5e-8", "v5e-16")) for _ in range(2)]
+    for i, shape in enumerate(shapes):
+        w.launch(f"job-{seed}-{i}", shape)
+    w.step(14)
+    # Spot capacity frees up for a random subset of the shapes.
+    for i, shape in enumerate(shapes):
+        if rng.random() < 0.7:
+            w.add_idle_slice(shape, f"spot-{seed}-{i}")
+    w.step(50)
+    return w
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_repack_dollar_proxy_never_worse(seed):
+    """The acceptance property: on every seed, the fleet's steady-state
+    $-proxy burn with the repacker ON is never worse than OFF, the
+    conservation identity held through every migration (asserted per
+    step inside RepackWorld.step), and the ledger's incremental
+    counters match the rebuild oracle at the end."""
+    on = _churn_world(seed, repack=True)
+    off = _churn_world(seed, repack=False)
+    assert on.all_running() and off.all_running()
+    rate_on = on.controller.metrics.snapshot()["gauges"][
+        "cost_dollar_proxy_per_hour"]
+    rate_off = off.controller.metrics.snapshot()["gauges"][
+        "cost_dollar_proxy_per_hour"]
+    assert rate_on <= rate_off + 1e-9, (
+        f"seed {seed}: repack burned ${rate_on}/h vs ${rate_off}/h "
+        f"without")
+    for w in (on, off):
+        live, rebuilt = (w.controller.cost.live_counts(),
+                         w.controller.cost.rebuild())
+        for key in live:
+            assert live[key] == {k: v for k, v in rebuilt[key].items()
+                                 if v}, f"seed {seed}: {key} drifted"
+
+
+def test_ledger_placement_quality_rows():
+    w = RepackWorld(repack=False)
+    w.launch("job-a", "v5e-16")
+    w.step(12)
+    w.add_idle_slice("v5e-16", "spot-s0")
+    w.step(2)
+    pq = w.controller.cost.placement_quality()
+    rows = {r["unit_id"]: r for r in pq["rows"]}
+    assert len(rows) == 1
+    row = next(iter(rows.values()))
+    assert row["tier"] == "on_demand"
+    assert row["shape"] == "v5e-16"
+    assert row["chips"] == 16 and row["used_chips"] == 16
+    assert pq["idle_spot_chips"] == {"v5e-16": 16}
+
+
+class TestCliSurfaces:
+    """The operator surfaces (ISSUE 12 satellites): ``repack-report``,
+    ``cost-report --frag``, and the glob-capable ``metrics-history``
+    prefix filter with url/file parity."""
+
+    def _migrated_world(self):
+        w = RepackWorld()
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        w.add_idle_slice("v5e-16", "spot-s0")
+        w.step(40)
+        assert w.counters().get("repack_migrations_completed") == 1
+        return w
+
+    def _bundle_file(self, w, tmp_path):
+        import json
+
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(
+            w.controller.incident_bundle("test"), default=str))
+        return str(path)
+
+    def test_repack_report_from_bundle(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tpu_autoscaler.main import cli
+
+        path = self._bundle_file(self._migrated_world(), tmp_path)
+        result = CliRunner().invoke(cli, ["repack-report", "--from",
+                                          path])
+        assert result.exit_code == 0, result.output
+        assert "REPACK REPORT" in result.output
+        assert "1 completed" in result.output
+        assert "saved" in result.output
+
+    def test_repack_report_rejects_sectionless_dump(self, tmp_path):
+        import json
+
+        from click.testing import CliRunner
+
+        from tpu_autoscaler.main import cli
+
+        path = tmp_path / "plain.json"
+        path.write_text(json.dumps({"passes": []}))
+        result = CliRunner().invoke(cli, ["repack-report", "--from",
+                                          str(path)])
+        assert result.exit_code != 0
+        assert "no repack section" in result.output
+
+    def test_cost_report_frag_section(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tpu_autoscaler.main import cli
+
+        w = RepackWorld(repack=False)
+        w.launch("job-a", "v5e-16")
+        w.step(12)
+        path = self._bundle_file(w, tmp_path)
+        result = CliRunner().invoke(cli, ["cost-report", "--from",
+                                          path, "--frag"])
+        assert result.exit_code == 0, result.output
+        assert "FRAGMENTATION" in result.output
+        assert "score=" in result.output
+        # Without the flag the section stays out (the bill is long
+        # enough already).
+        plain = CliRunner().invoke(cli, ["cost-report", "--from",
+                                         path])
+        assert "FRAGMENTATION" not in plain.output
+
+    @pytest.mark.parametrize("pattern,family", [
+        ("repack_*", "repack_"),
+        ("frag_score_*", "frag_score_"),
+    ])
+    def test_metrics_history_glob_url_file_parity(
+            self, tmp_path, monkeypatch, pattern, family):
+        """The ISSUE 12 regression pin: a glob series filter yields
+        IDENTICAL output whether it runs against a live controller's
+        ``/debugz/tsdb`` (server-side literal-head prefix + client
+        glob) or a bundle file (pure client-side)."""
+        from click.testing import CliRunner
+
+        import tpu_autoscaler.main as main_mod
+        from tpu_autoscaler.main import cli
+
+        w = self._migrated_world()
+        path = self._bundle_file(w, tmp_path)
+
+        def fake_fetch(url, endpoint, params=None):
+            assert endpoint == "/debugz/tsdb"
+            # The live route applies the server-side PLAIN prefix —
+            # the glob's literal head must have been sent, never the
+            # raw glob (a server matching 'repack_*' as a literal
+            # would return nothing).
+            assert "*" not in (params or {}).get("prefix", "")
+            return w.controller.tsdb_route(params or {})
+
+        monkeypatch.setattr(main_mod, "_fetch_debugz", fake_fetch)
+        runner = CliRunner()
+        via_url = runner.invoke(cli, [
+            "metrics-history", "--url", "host:1", "--prefix", pattern,
+            "--format", "csv"])
+        via_file = runner.invoke(cli, [
+            "metrics-history", "--from", path, "--prefix", pattern,
+            "--format", "csv"])
+        assert via_url.exit_code == 0, via_url.output
+        assert via_file.exit_code == 0, via_file.output
+        assert via_url.output == via_file.output
+        names = [line.split(",")[0]
+                 for line in via_url.output.strip().splitlines()[1:]]
+        assert names, f"glob {pattern!r} matched nothing"
+        assert all(n.startswith(family) for n in names)
+
+    def test_metrics_history_plain_prefix_still_prefix(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tpu_autoscaler.main import cli
+
+        path = self._bundle_file(self._migrated_world(), tmp_path)
+        result = CliRunner().invoke(cli, [
+            "metrics-history", "--from", path, "--prefix", "repack_",
+            "--format", "csv"])
+        names = [line.split(",")[0]
+                 for line in result.output.strip().splitlines()[1:]]
+        assert names and all(n.startswith("repack_") for n in names)
+
+
+def test_budget_remaining_shared_algebra():
+    from tpu_autoscaler.policy.slo import budget_remaining
+
+    events = [(0.0, 100.0), (50.0, 200.0), (120.0, 50.0)]
+    kept, spent, remaining = budget_remaining(events, 130.0, 100.0,
+                                              300.0)
+    assert kept == [(50.0, 200.0), (120.0, 50.0)]
+    assert spent == 250.0
+    assert remaining == 50.0
+    # Never negative.
+    _, _, remaining = budget_remaining(events, 130.0, 100.0, 100.0)
+    assert remaining == 0.0

@@ -76,10 +76,13 @@ def main(argv: list[str] | None = None) -> int:
     failures = [r for r in results if not r.ok]
     converged = sum(1 for r in results if r.converged_at is not None)
     repairs = sum(r.repairs for r in results)
+    repacks = sum(r.repacks for r in results)
     wall = sum(r.wall_seconds for r in results)
+    rp = (f", {repacks} repack migrations exercised" if repacks
+          else "")
     print(f"chaos corpus: {len(results)}/{len(seeds)} seeds run, "
           f"{len(failures)} failing, {converged} converged, "
-          f"{repairs} slice repairs exercised, {wall:.1f}s wall "
+          f"{repairs} slice repairs exercised{rp}, {wall:.1f}s wall "
           f"(budget {args.budget:g}s)")
     if args.json_path:
         with open(args.json_path, "w", encoding="utf-8") as f:

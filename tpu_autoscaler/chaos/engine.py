@@ -92,6 +92,7 @@ class ChaosResult:
     wall_seconds: float
     reconcile_errors: int
     repairs: int
+    repacks: int = 0
 
     def describe(self) -> str:
         status = "ok" if self.ok else "FAIL"
@@ -99,8 +100,9 @@ class ChaosResult:
             "\n  " + "\n  ".join(self.violations[:5]))
         conv = (f"converged@{self.converged_at:g}s"
                 if self.converged_at is not None else "never converged")
+        rp = f", {self.repacks} repacks" if self.repacks else ""
         return (f"[{status}] {self.description} — {conv}, "
-                f"{self.passes} passes, {self.repairs} repairs, "
+                f"{self.passes} passes, {self.repairs} repairs{rp}, "
                 f"{self.reconcile_errors} brownout-pass errors, "
                 f"{self.wall_seconds:.2f}s wall{tail}")
 
@@ -271,6 +273,33 @@ class _ServingFuzz:
                 f"(scale {scale:g})")
 
 
+#: ISSUE 12: the repack profile pre-seeds idle SPOT slices at t=0 and
+#: runs a longer idle threshold so they survive into the migration
+#: window; migrations themselves hold capacity in repair-family
+#: states.  Widen the stranded-chips reclaim window by this bound.
+REPACK_RECLAIM_ALLOWANCE = 360.0
+
+
+def _repack_config(program: ScenarioProgram):
+    """Chaos-scale RepackConfig: dwell/cooldown short enough to fire
+    inside a scenario, admission margin and horizon small enough that
+    the spot_dry fault actually flips the guard to abort."""
+    if not program.repack:
+        return None
+    from tpu_autoscaler.repack import RepackConfig
+
+    return RepackConfig(
+        max_concurrent_migrations=2,
+        min_savings_ratio=2.0,
+        savings_horizon_seconds=1800.0,
+        budget_chip_seconds=50_000.0,
+        budget_window_seconds=1800.0,
+        drain_estimate_seconds=30.0,
+        provision_estimate_seconds=program.provision_delay + 20.0,
+        min_dwell_seconds=30.0,
+        gang_cooldown_seconds=180.0)
+
+
 #: Chaos-scale PolicyEngine hold/threshold bounds (ISSUE 8): the
 #: reclaim window the no-stranded-chips invariant allows is widened by
 #: exactly this allowance when the policy is on — a prewarm may sit
@@ -368,7 +397,12 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
                               # ledger's price-tier dimension under
                               # the full fault alphabet.
                               preemptible=program.preemptible),
-            grace_seconds=30.0, idle_threshold_seconds=120.0,
+            grace_seconds=30.0,
+            # Repack seeds hold pre-seeded idle spot slices long
+            # enough to be migration destinations (the reclaim-window
+            # allowance widens the stranded check by the same bound).
+            idle_threshold_seconds=(240.0 if program.repack
+                                    else 120.0),
             drain_grace_seconds=20.0, provision_retry_seconds=30.0,
             # The alerts profile stalls provisions for up to ~480 s
             # (latency_regression windows); the stuck-provision
@@ -377,7 +411,9 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
             provision_timeout_seconds=(900.0 if program.alerts
                                        else 150.0),
             unhealthy_timeout_seconds=120.0,
-            slice_repair_after_seconds=30.0),
+            slice_repair_after_seconds=30.0,
+            enable_repack=program.repack,
+            repack=_repack_config(program)),
         informer=informer,
         policy_engine=_policy_engine(program),
         serving_scaler=_serving_scaler(program),
@@ -414,6 +450,10 @@ class _Run:
             self.serving_fuzz = _ServingFuzz(
                 program, self.controller.serving_scaler.adapter,
                 self.monitor)
+        #: ISSUE 12: idle SPOT slices materialized by ``spot_arrive``
+        #: events — the repack profile's migration destinations (and
+        #: the spot_dry event's victims while still workload-free).
+        self._spot_units: list[str] = []
         #: member job name -> its pod names (a multislice jobset
         #: contributes one entry per member job — the ICI-integrity
         #: invariant holds per job/slice, the jobset spans DCN).
@@ -579,6 +619,52 @@ class _Run:
             # restores the program's delay at the window end.
             self._regression_until = t + event.args["duration"]
             self.actuator.set_provision_delay(1e9)
+        elif kind == "spot_arrive":
+            # The spot market frees up: an idle preemptible slice of
+            # the named shape appears — the displacement the repacker
+            # exists to exploit.
+            from tpu_autoscaler.k8s.payloads import tpu_host_payload
+            from tpu_autoscaler.topology.catalog import shape_by_name
+
+            shape = shape_by_name(event.args["shape"])
+            sid = f"chaos-spot-{len(self._spot_units)}-{shape.name}"
+            for h in range(shape.hosts):
+                self.kube.add_node(tpu_host_payload(
+                    shape, sid, h, created_at=t,
+                    pool=f"spot-{shape.name}", preemptible=True))
+            self._spot_units.append(sid)
+        elif kind == "spot_dry":
+            # The spot market dries up: pre-seeded spot slices still
+            # workload-free vanish (engine-injected, never mistaken
+            # for controller deletes).  A migration mid-drain loses
+            # its destination — the budget guard must abort it.
+            bound = {p["spec"].get("nodeName")
+                     for p in self.kube.list_pods()
+                     if p["spec"].get("nodeName")}
+            for sid in self._spot_units:
+                hosts = [n["metadata"]["name"]
+                         for n in self.kube.list_nodes()
+                         if n["metadata"].get("labels", {}).get(
+                             SLICE_LABEL) == sid]
+                if not hosts or any(h in bound for h in hosts):
+                    continue  # in use (or already gone): not "idle spot"
+                for h in hosts:
+                    self.monitor.injected_deletes.add(h)
+                    self.kube.delete_node(h)
+        elif kind == "gang_delete":
+            # The job is deleted outright (operator kubectl delete)
+            # — possibly mid-drain of a repack migration, which must
+            # then close abandoned without leaking bookkeeping.
+            live = sorted(self.live_jobs)
+            if live:
+                job = self.rng.choice(live)
+                for name in self.live_jobs.pop(job):
+                    self.kube.delete_pod("default", name)
+                spec = self._job_spec.pop(job, None)
+                if spec is not None:
+                    self._relaunches = [
+                        r for r in self._relaunches
+                        if r[1].job != spec["workload"]]
         elif self.serving_fuzz is not None and kind in (
                 "replica_restart", "counter_reset", "stale_burst",
                 "replica_churn"):
@@ -686,6 +772,63 @@ class _Run:
                 f"burn-rate alert fired {st.fired_count}x on a quiet "
                 f"seed (false positive)")
 
+    def _check_repack(self, t: float) -> None:
+        """The ISSUE 12 repack gate, asserted at terminal
+        (docs/REPACK.md "The savings guarantee"):
+
+        - **never-net-negative-savings** — a migration's downside is
+          bounded by its own (guard-capped) migration cost.  A
+          completed migration that nets negative can only exist when
+          the scheduler landed the gang on non-destination supply (a
+          *misfire* — the one actor the controller does not command);
+          its loss must never exceed the migration cost (anything
+          more means the savings algebra itself is broken), and every
+          such close must be surfaced on ``repack_misfires``;
+        - **guard-capped abort cost** — an aborted/abandoned
+          migration's realized cost never exceeds 1.5x its projected
+          savings (the guard fires the first pass projected cost
+          crosses savings; the slack covers one pass of drift plus
+          the post-verdict drain teardown).
+        """
+        dump = self.controller.recorder.dump(
+            tracer=self.controller.tracer)
+        misfire_traces = 0
+        for span in dump["spans"]:
+            if span["name"] != "repack" \
+                    or span["parent_id"] is not None \
+                    or span["end"] is None:
+                continue
+            attrs = span["attrs"]
+            unit = attrs.get("unit", "?")
+            if attrs.get("aborted") or attrs.get("error"):
+                cost = attrs.get("migration_cost_chip_seconds", 0.0)
+                cap = attrs.get("projected_saving_chip_seconds", 0.0)
+                if cost > 1.5 * cap + 1e-6:
+                    self.monitor._fail(
+                        t, "repack-abort-cost-capped",
+                        f"aborted migration of {unit} burned {cost:g} "
+                        f"chip-s against {cap:g} projected savings — "
+                        f"the budget guard fired too late")
+            elif attrs.get("chip_seconds_saved", 0.0) < 0.0:
+                misfire_traces += 1
+                loss = -attrs["chip_seconds_saved"]
+                cost = attrs.get("migration_cost_chip_seconds", 0.0)
+                if loss > cost + 1e-6:
+                    self.monitor._fail(
+                        t, "repack-never-net-negative",
+                        f"completed migration of {unit} lost {loss:g} "
+                        f"chip-s, MORE than its {cost:g} migration "
+                        f"cost — the savings algebra is broken, not "
+                        f"just a scheduler misfire")
+        snap = self.controller.metrics.snapshot()
+        counted = int(snap["counters"].get("repack_misfires", 0))
+        if misfire_traces != counted:
+            self.monitor._fail(
+                t, "repack-never-net-negative",
+                f"{misfire_traces} net-negative completed migration "
+                f"trace(s) but repack_misfires={counted} — a loss "
+                f"went unsurfaced")
+
     def execute(self) -> ChaosResult:
         t0 = _time.perf_counter()
         program = self.program
@@ -723,6 +866,10 @@ class _Run:
             # Serving scale-out records may hold an adopted slice
             # through their TTL after the fuzzed load dies.
             reclaim_window += SERVING_RECLAIM_ALLOWANCE
+        if program.repack:
+            # Pre-seeded spot slices ride a longer idle threshold, and
+            # an aborted migration restarts its source's idle clocks.
+            reclaim_window += REPACK_RECLAIM_ALLOWANCE
         if converged_at is not None:
             # Completions freeze here: a job finishing mid-reclaim
             # would reset the idle clocks the stranded check reads.
@@ -735,6 +882,8 @@ class _Run:
             reclaim_window=reclaim_window)
         if self.program.alerts:
             self._check_alerts(t)
+        if self.program.repack:
+            self._check_repack(t)
         snap = self.controller.metrics.snapshot()
         return ChaosResult(
             seed=program.seed,
@@ -744,7 +893,10 @@ class _Run:
             description=program.describe(),
             wall_seconds=_time.perf_counter() - t0,
             reconcile_errors=self.reconcile_errors,
-            repairs=int(snap["counters"].get("slice_repairs_started", 0)))
+            repairs=int(snap["counters"].get("slice_repairs_started",
+                                             0)),
+            repacks=int(snap["counters"].get(
+                "repack_migrations_started", 0)))
 
 
 def run_scenario(program_or_seed, *, profile: str = "mixed",

@@ -268,7 +268,7 @@ class InvariantMonitor:
             tracer=self._controller.tracer)
         finished: set[str] = set()
         for span in dump["spans"]:
-            if span["name"] in ("scale_up", "slice_repair") \
+            if span["name"] in ("scale_up", "slice_repair", "repack") \
                     and span["parent_id"] is None \
                     and span["end"] is not None:
                 finished.add(span["trace_id"])
@@ -276,7 +276,7 @@ class InvariantMonitor:
             for gap in trace_gaps(dump, trace_id):
                 self._fail(t, "trace-completeness", gap)
         for span in dump.get("active_spans", []):
-            if span["name"] in ("scale_up", "slice_repair"):
+            if span["name"] in ("scale_up", "slice_repair", "repack"):
                 self._fail(t, "trace-completeness",
                            f"trace {span['trace_id']}: {span['name']} "
                            f"span still open after convergence")

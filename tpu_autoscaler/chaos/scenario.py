@@ -60,9 +60,12 @@ ALERTS_CLEAR_PASSES = 3
 #: is ISSUE 9 — fuzz the serving metrics-adapter path under the mixed
 #: fault alphabet; ``alerts`` is ISSUE 10 — the SLO burn-rate alert
 #: gate: injected scale-up-latency regressions must fire the alert
-#: and resolve, quiet seeds must stay silent).
+#: and resolve, quiet seeds must stay silent; ``repack`` is ISSUE 12
+#: — long-running gangs on on-demand supply with pre-seeded idle SPOT
+#: slices, the repacker ON, and migrations raced by spot reclamation,
+#: destination stockouts and mid-drain gang deletion).
 PROFILES = ("mixed", "faults", "api", "repair", "policy", "serving",
-            "alerts")
+            "alerts", "repack")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -129,6 +132,12 @@ class ScenarioProgram:
     # price-tier dimension — spot-labeled nodes must conserve exactly
     # like on-demand ones — across the whole fault alphabet.
     preemptible: bool = False
+    # ISSUE 12: run with the repacker ON (chaos-scale RepackConfig)
+    # over ``repack_spot_shapes`` idle spot slices pre-seeded at t=0;
+    # the terminal invariants add never-net-negative-savings and the
+    # guard-capped abort-cost bound on top of the standard catalog.
+    repack: bool = False
+    repack_spot_shapes: tuple[str, ...] = ()
 
     def describe(self) -> str:
         kinds: dict[str, int] = {}
@@ -150,6 +159,9 @@ class ScenarioProgram:
                 else "quiet")
         if self.preemptible:
             tags.append("spot")
+        if self.repack:
+            tags.append(
+                f"repack:{'/'.join(self.repack_spot_shapes) or 'dry'}")
         tagtxt = f" [{'+'.join(tags)}]" if tags else ""
         return (f"seed={self.seed} jobs={len(self.workloads)} "
                 f"({'/'.join(w.shape for w in self.workloads)}){tagtxt} "
@@ -191,8 +203,9 @@ def generate(seed: int, *, profile: str = "mixed",
     workloads = []
     for i in range(jobs):
         shape = rng.choice(GANG_SHAPES)
-        if profile == "repair" and i == 0:
-            # Guarantee a multi-host victim for the host failure.
+        if profile in ("repair", "repack") and i == 0:
+            # Guarantee a multi-host victim for the host failure /
+            # a multi-host migration source for the repacker.
             shape = rng.choice(("v5e-16", "v5e-32", "v5p-16"))
         # Draw order matters: arrival -> completion -> pinned is the
         # pre-ISSUE-8 stream (keyword evaluation order of the original
@@ -229,7 +242,7 @@ def generate(seed: int, *, profile: str = "mixed",
     api_chaos = profile in ("mixed", "api", "policy", "serving",
                             "alerts")
     fault_chaos = profile in ("mixed", "faults", "repair", "policy",
-                              "serving")
+                              "serving", "repack")
     events: list[Event] = []
 
     def fire(probability: float) -> bool:
@@ -275,6 +288,44 @@ def generate(seed: int, *, profile: str = "mixed",
                                 "replica_churn",
                                 {"add": rng.randint(0, 2),
                                  "remove": rng.randint(0, 2)}))
+
+    repack_spot_shapes: tuple[str, ...] = ()
+    if profile == "repack":
+        # ISSUE 12 (new profile: derived rng stream, shifts no legacy
+        # seed program).  Workloads run to scenario end — stable
+        # migration sources.  Idle SPOT slices of the workloads' own
+        # shapes ARRIVE (``spot_arrive``) after every gang has landed
+        # on on-demand supply — the spot market freeing up is what
+        # creates the displacement the repacker exists to fix;
+        # seeding them at t=0 would just hand the gangs spot supply
+        # directly and nothing would ever be wrongly placed.  The
+        # profile-specific faults race the migration window:
+        # ``spot_dry`` (the destination pool dries up — workload-free
+        # spot slices vanish; the budget guard must abort) and
+        # ``gang_delete`` (the job is deleted mid-drain; the
+        # migration must close abandoned, bookkeeping-free).
+        rng_rp = random.Random(seed ^ 0x12EAC)
+        workloads = [dataclasses.replace(w, completion_prob=0.0,
+                                         repeat=0)
+                     for w in workloads]
+        shapes = []
+        for i, w in enumerate(workloads):
+            if w.shape not in shapes \
+                    and (i == 0 or rng_rp.random() < 0.7):
+                shapes.append(w.shape)
+        repack_spot_shapes = tuple(shapes)
+        arrive = rng_rp.uniform(150.0, 210.0)
+        for j, shape in enumerate(repack_spot_shapes):
+            events.append(Event(arrive + 10.0 * j, "spot_arrive",
+                                {"shape": shape}))
+        if rng_rp.random() < 0.45:
+            # Early enough to catch a migration pre-landing (abort),
+            # late enough that some seeds see it land first (no-op).
+            events.append(Event(arrive + rng_rp.uniform(5.0, 60.0),
+                                "spot_dry"))
+        if rng_rp.random() < 0.3:
+            events.append(Event(arrive + rng_rp.uniform(5.0, 45.0),
+                                "gang_delete"))
 
     regression_end = 0.0
     if profile == "alerts":
@@ -341,4 +392,10 @@ def generate(seed: int, *, profile: str = "mixed",
         policy=(profile == "policy"),
         serving=(profile == "serving"),
         alerts=(profile == "alerts"),
-        preemptible=(rng_cost.random() < 0.25))
+        # The repack profile needs its PROVISIONED supply on-demand —
+        # a spot-provisioned gang has nothing cheaper to migrate to
+        # (the pre-seeded idle slices are the spot side).
+        preemptible=(rng_cost.random() < 0.25
+                     and profile != "repack"),
+        repack=(profile == "repack"),
+        repack_spot_shapes=repack_spot_shapes)

@@ -146,6 +146,18 @@ class ControllerConfig:
     # book.  The ledger itself is always on — it rides the _maintain
     # pass the loop already runs and costs O(churn).
     price_book: object | None = None
+    # Cost-aware continuous repacking (ISSUE 12, docs/REPACK.md): a
+    # background repacker reads the ledger's placement rows each pass,
+    # drains wrongly-placed gangs (expensive tier while same-shape
+    # spot sits idle; oversized slices) through the repair pipeline's
+    # drain + advisory-replacement machinery, under a hard
+    # never-costs-more-than-it-saves budget guard.  Off by default:
+    # repacking moves live work (the preemption precedent).
+    enable_repack: bool = False
+    # RepackConfig overriding the defaults (repack/policy.py); None =
+    # defaults.  Typed object (not dataclass field) to keep the
+    # import lazy like price_book.
+    repack: object | None = None
     # Reference parity flags (main.py --no-scale / --no-maintenance).
     no_scale: bool = False
     no_maintenance: bool = False
@@ -344,6 +356,21 @@ class Controller:
             metrics=self.metrics,
             stranded_after_seconds=(
                 self.config.provision_timeout_seconds))
+        # Cost-aware continuous repacking (ISSUE 12, docs/REPACK.md):
+        # migrations ride the _slice_repairs table (kind="repack") so
+        # the drain contract, advisory replacement, supply-guard holds
+        # and solo-planning deferral generalize without a second
+        # pipeline.  Strictly opt-in and crash-only.
+        self.repacker = None
+        if self.config.enable_repack:
+            from tpu_autoscaler.repack import Repacker, RepackConfig
+
+            self.repacker = Repacker(
+                self.config.repack or RepackConfig(),
+                price_book=self.cost.price_book)
+            self.repacker.bind(metrics=self.metrics)
+        self.metrics.declare_histogram("repack_seconds",
+                                       LATENCY_BUCKETS)
         # Predictive SLO-driven policy (ISSUE 8, docs/POLICY.md):
         # strictly ADVISORY — the engine forecasts demand and this
         # loop feeds its prewarm demand through the planner's existing
@@ -1009,8 +1036,17 @@ class Controller:
         """Advance repair bookkeeping: close repairs whose gang runs
         again on healthy supply, bound every repair by the timeout."""
         for unit_id, st in list(self._slice_repairs.items()):
+            repack = st.get("kind") == "repack"
             if now - st["started"] \
                     > self.config.slice_repair_timeout_seconds:
+                if repack:
+                    # Same cleanup as a budget abort (cancel the
+                    # replacement, uncordon an un-landed source) —
+                    # a timed-out migration must not leak either.
+                    self._abort_repack(unit_id, st, units.get(unit_id),
+                                       now, "migration timed out",
+                                       outcome="abandoned")
+                    continue
                 self.metrics.inc("slice_repairs_abandoned")
                 log.warning("slice repair for %s abandoned after %.0fs",
                             unit_id, now - st["started"])
@@ -1035,6 +1071,17 @@ class Controller:
                 gone_since = st.setdefault("members_gone_since", now)
                 if now - gone_since > self.config.drain_grace_seconds \
                         + 30.0:
+                    if repack:
+                        # The gang is gone: cancel the replacement
+                        # (nothing will consume it); the workload-free
+                        # source is NOT uncordoned — its drain
+                        # finishing IS the reclaim (units is the
+                        # observed set and this unit already left it).
+                        self._abort_repack(
+                            unit_id, st, None, now,
+                            "gang disappeared mid-migration",
+                            outcome="abandoned")
+                        continue
                     self.metrics.inc("slice_repairs_abandoned")
                     log.warning("slice repair for %s closed: gang "
                                 "disappeared mid-repair (job deleted "
@@ -1046,6 +1093,10 @@ class Controller:
             st.pop("members_gone_since", None)
             if members and all(p.phase == "Running" for p in members):
                 latency = now - st["started"]
+                if repack:
+                    self._complete_repack(unit_id, st, members, units,
+                                          now, latency)
+                    continue
                 self.metrics.inc("slice_repairs_completed")
                 log.info("slice repair for %s complete in %.1fs",
                          unit_id, latency)
@@ -1065,6 +1116,360 @@ class Controller:
                 self.tracer.event(st["span"], "replacement_submitted",
                                   {"provision_id": status.id,
                                    "shape": req.shape_name}, t=now)
+
+    # ---- cost-aware continuous repacking (ISSUE 12) --------------------
+
+    def _repack_pass(self, units: dict[str, list[Node]],
+                     pods: list[Pod],
+                     pods_by_node: dict[str, list[Pod]],
+                     spare_ids: set[str], now: float) -> None:
+        """One repack pass: budget-guard every in-flight migration,
+        then ask the Repacker for new ones (docs/REPACK.md).
+
+        Migrations ride ``_slice_repairs`` with ``kind="repack"`` —
+        the repair pipeline's cordon + checkpoint drain, advisory
+        like-for-like (or right-sized) replacement, solo-planning
+        deferral and supply-guard holds all generalize for free; only
+        the economics and the trace story are repack's own.
+        """
+        pq = self.cost.placement_quality()
+        idle_spot = pq["idle_spot_chips"]
+        self.repacker.settle(now)
+        # Candidates are re-counted per pass; zero NOW so the early
+        # returns below (max concurrency, no eligible rows) never
+        # leave a previous pass's count frozen on the gauge.
+        self.metrics.set_gauge("repack_candidates", 0)
+        self._guard_repacks(units, pods, idle_spot, now)
+
+        active = sum(1 for st in self._slice_repairs.values()
+                     if st.get("kind") == "repack")
+        self.metrics.set_gauge("repack_active_migrations", active)
+        if active >= self.repacker.config.max_concurrent_migrations:
+            return
+        burning: set[str] = set()
+        if self.serving_scaler is not None:
+            adapter = getattr(self.serving_scaler, "adapter", None)
+            if adapter is not None and hasattr(adapter,
+                                               "burning_pools"):
+                burning = adapter.burning_pools(
+                    self.repacker.config.slo_attainment_floor)
+
+        from tpu_autoscaler.repack import UnitRow
+
+        # Mechanical exclusions first (the Repacker handles the
+        # economics): units already draining/held/spare, units whose
+        # workload cannot honor the checkpoint contract, gangs not
+        # fully settled or not wholly aboard one unit, multislice
+        # members (a jobset migrates as a cohort — out of scope), and
+        # gangs inside their post-migration cooldown.
+        excluded = (set(self._slice_repairs) | set(self._drain_started)
+                    | self._requested_drains | self._policy_holds
+                    | spare_ids)
+        rows: list[UnitRow] = []
+        rightsize: dict[str, tuple[str, int]] = {}
+        unit_pods_of: dict[str, list[Pod]] = {}
+        for r in pq["rows"]:
+            uid = r["unit_id"]
+            if uid in excluded or uid not in units:
+                continue
+            unit_nodes = units[uid]
+            if not unit_nodes[0].is_tpu:
+                continue
+            if any(n.unschedulable or not n.is_ready
+                   for n in unit_nodes):
+                continue  # a damaged unit is the repair path's business
+            unit_pods = [p for n in unit_nodes
+                         for p in pods_by_node.get(n.name, [])]
+            workload = [p for p in unit_pods if p.is_workload]
+            if not workload or any(p.phase != "Running"
+                                   or not p.is_drainable
+                                   or p.jobset_name
+                                   or p.gang_key is None
+                                   for p in workload):
+                continue
+            keys = {p.gang_key for p in workload}
+            if self.repacker.gang_cooled(keys, now):
+                continue
+            if burning and any(
+                    isinstance(k[-1], str)
+                    and any(k[-1].startswith(f"serve-{bp}-")
+                            for bp in burning)
+                    for k in keys):
+                # Serving replicas carry their pool in the gang NAME
+                # (the scaler's serve-<pool>-<n> convention) — the
+                # adapter's pool names are LOGICAL and need not match
+                # node-pool labels, so the row.pool check in
+                # plan_candidates alone would never fire for them.
+                # Conservative on purpose: a false name match merely
+                # skips a candidate.
+                continue
+            names = {n.name for n in unit_nodes}
+            if any(p.node_name not in names
+                   for key in keys
+                   for p in self._gang_members(pods, key)
+                   if p.node_name):
+                continue  # gang spans units: never migrate a fraction
+            unit_pods_of[uid] = unit_pods
+            rows.append(UnitRow(**r))
+            if r["used_chips"] < r["chips"] and len(keys) == 1:
+                gang = Gang(key=next(iter(keys)), pods=list(workload))
+                target = self._rightsize_target(gang, r["accel"],
+                                                r["chips"])
+                if target is not None:
+                    rightsize[uid] = target
+        if not rows:
+            return
+        plans = self.repacker.advise(
+            rows, idle_spot, now, active_migrations=active,
+            burning_pools=burning, rightsize_targets=rightsize)
+        for plan in plans:
+            self._start_repack(plan, units[plan.unit_id],
+                               unit_pods_of[plan.unit_id], now)
+
+    def _rightsize_target(self, gang: Gang, accel: str,
+                          unit_chips: int) -> tuple[str, int] | None:
+        """Smallest catalog shape that actually fits the gang AND can
+        admit its pods: same accelerator type as the unit it runs on
+        (the fitter's accelerator-pin resolution is generation-wide,
+        which would happily name a shape the pods' selector can never
+        bind to — a migration onto it would strand the gang).  A
+        topology-pinned gang is never right-sized: the pin demands
+        this exact torus."""
+        from tpu_autoscaler.engine.fitter import shape_feasible_for_gang
+        from tpu_autoscaler.topology.catalog import (
+            SLICE_SHAPES,
+            TOPOLOGY_LABEL,
+        )
+
+        if TOPOLOGY_LABEL in gang.node_selectors:
+            return None
+        chips = gang.tpu_chips
+        if chips <= 0:
+            return None
+        for shape in sorted(SLICE_SHAPES.values(),
+                            key=lambda s: s.chips):
+            if shape.accelerator_type != accel \
+                    or shape.chips < chips \
+                    or shape.chips >= unit_chips:
+                continue
+            if shape_feasible_for_gang(shape, gang) is None:
+                return (shape.name, shape.chips)
+        return None
+
+    def _guard_repacks(self, units: dict[str, list[Node]],
+                       pods: list[Pod], idle_spot: dict[str, int],
+                       now: float) -> None:
+        """Refresh every in-flight migration's realized cost off the
+        ledger and re-run the budget verdict: the migration aborts the
+        moment projected cost exceeds projected savings — unless the
+        gang already landed on the destination (past the point of no
+        return, the cheapest way out is through)."""
+        for unit_id, st in list(self._slice_repairs.items()):
+            if st.get("kind") != "repack":
+                continue
+            plan = st["plan"]
+            cs = self.cost.accrued_chip_seconds([unit_id], now,
+                                                state="repair")
+            if cs is not None:
+                st["realized_cost_cs"] = cs
+            pid = st.get("provision_id")
+            prov_pending = False
+            if plan.kind == "rightsize":
+                prov_pending = pid is None
+                if pid is not None:
+                    submitted = self._submitted_at.get(pid)
+                    in_flight = any(s.id == pid and s.in_flight
+                                    for s in self.actuator.statuses())
+                    prov_pending = in_flight
+                    if in_flight and submitted is not None:
+                        # Replacement chips burning behind the barrier
+                        # count against the migration, not for it.
+                        st["dest_cost_cs"] = (plan.target_chips
+                                              * (now - submitted))
+            members = [p for key in st["gang_keys"]
+                       for p in self._gang_members(pods, key)]
+            landed = any(p.node_name
+                         and p.node_name not in st["src_nodes"]
+                         and p.phase in ("Pending", "Running")
+                         for p in members)
+            if landed:
+                st["landed"] = True
+            if st.get("landed"):
+                continue
+            dest_avail = (plan.kind == "rightsize"
+                          or idle_spot.get(plan.shape, 0) >= plan.chips)
+            verdict = self.repacker.guard(
+                plan, now, started=st["started"],
+                realized_cost_cs=(st.get("realized_cost_cs", 0.0)
+                                  + st.get("dest_cost_cs", 0.0)),
+                destination_available=dest_avail,
+                provision_pending=prov_pending)
+            if verdict is not None:
+                self._abort_repack(unit_id, st, units.get(unit_id),
+                                   now, verdict)
+
+    def _start_repack(self, plan, unit_nodes: list[Node],
+                      unit_pods: list[Pod], now: float) -> None:
+        """Open one migration: ``repack`` trace root, drain the source
+        whole (ICI-atomic, checkpoint-aware), advisory replacement
+        demand from the next pass on — the repair lifecycle wearing
+        cost clothes."""
+        gang_keys = tuple(sorted({p.gang_key for p in unit_pods
+                                  if p.is_workload
+                                  and p.gang_key is not None}))
+        span = self.tracer.start(
+            "repack", trace_id=self.tracer.new_trace("repack"), t=now,
+            attrs={"unit": plan.unit_id, "kind": plan.kind,
+                   "reason": plan.reason, "shape": plan.shape,
+                   "target_shape": plan.target_shape,
+                   "projected_saving_chip_seconds":
+                       round(plan.projected_saving_cs, 3),
+                   "projected_cost_chip_seconds":
+                       round(plan.projected_cost_cs, 3),
+                   "gangs": [("/".join(str(p) for p in k))
+                             for k in gang_keys]})
+        drain_span = self.tracer.start("repack_drain", parent=span,
+                                       t=now,
+                                       attrs={"unit": plan.unit_id})
+        self._slice_repairs[plan.unit_id] = {
+            "kind": "repack", "gang_keys": gang_keys,
+            "shape_name": plan.target_shape, "started": now,
+            "span": span, "drain_span": drain_span,
+            "provision_id": None, "plan": plan,
+            "src_nodes": tuple(n.name for n in unit_nodes),
+            "realized_cost_cs": 0.0,
+        }
+        for key in gang_keys:
+            self._repair_roots[key] = span
+        self.repacker.note_started(plan, gang_keys, now)
+        log.info("repack (%s): migrating %s off %s — %s", plan.kind,
+                 "/".join(str(p) for p in gang_keys[0])
+                 if gang_keys else "?", plan.unit_id, plan.reason)
+        self._explain(plan.unit_id, "repack migration started",
+                      plan.reason, kind=plan.kind,
+                      target=plan.target_shape)
+        self._notify(f"repacking {plan.unit_id} ({plan.kind}): "
+                     f"{plan.reason}")
+        self._begin_drain(plan.unit_id, unit_nodes, unit_pods, now,
+                          reason=f"repack ({plan.kind}): {plan.reason}")
+
+    def _abort_repack(self, unit_id: str, st: dict,
+                      unit_nodes: list[Node] | None, now: float,
+                      reason: str, *, outcome: str = "aborted") -> None:
+        """Stop a migration and hand the fleet back planner-reachable:
+        cancel any replacement provision (nothing will ever consume
+        it), uncordon the source so the gang re-binds where it was —
+        unless the gang already landed off it, or is gone entirely (a
+        workload-free source should finish draining to reclaim) — and
+        close the trace explained.  ``outcome`` is "aborted" for
+        budget-guard verdicts, "abandoned" for the timeout /
+        gang-deleted closes (same cleanup, different books)."""
+        pid = st.get("provision_id")
+        if pid is not None and any(s.id == pid and s.in_flight
+                                   for s in self.actuator.statuses()):
+            try:
+                self.actuator.cancel(pid)
+            except Exception:  # noqa: BLE001 — abort must not wedge
+                self.metrics.inc("repack_errors")
+                log.exception("could not cancel repack provision %s",
+                              pid)
+        if unit_nodes and not st.get("landed"):
+            self._cancel_drain(unit_id, unit_nodes)
+        log.warning("repack of %s %s: %s", unit_id, outcome, reason)
+        self._notify(f"repack of {unit_id} {outcome}: {reason}")
+        self._close_repack(unit_id, st, now, outcome=outcome,
+                           reason=reason)
+
+    def _repack_realized(self, unit_id: str, st: dict,
+                         now: float) -> float:
+        """Freshest realized migration cost: the ledger's live repair
+        accrual when the unit is still tracked (it outlives the node
+        observation by one sweep), else the last per-pass snapshot."""
+        cs = self.cost.accrued_chip_seconds([unit_id], now,
+                                            state="repair")
+        if cs is not None:
+            st["realized_cost_cs"] = cs
+        return (st.get("realized_cost_cs", 0.0)
+                + st.get("dest_cost_cs", 0.0))
+
+    def _close_repack(self, unit_id: str, st: dict, now: float, *,
+                      outcome: str, reason: str) -> None:
+        """Close an aborted/abandoned migration's books + trace."""
+        realized = self._repack_realized(unit_id, st, now)
+        self.repacker.note_closed(st["plan"], now, outcome=outcome,
+                                  realized_cost_cs=realized,
+                                  reason=reason)
+        self._end_repair(unit_id, st, now, outcome=outcome,
+                         attrs={"aborted": True, "reason": reason,
+                                "migration_cost_chip_seconds":
+                                    round(realized, 3)})
+
+    def _complete_repack(self, unit_id: str, st: dict,
+                         members: list[Pod],
+                         units: dict[str, list[Node]], now: float,
+                         latency: float) -> None:
+        """The gang runs again off the source: settle the migration's
+        bill against the tier it ACTUALLY landed on and stamp the
+        chip-seconds-saved / $-proxy-saved attribution on the closing
+        ``repack`` trace (the acceptance surface)."""
+        from tpu_autoscaler.cost.pricebook import tier_of_labels
+
+        plan = st["plan"]
+        landed_rate = None
+        node_of = {n.name: n for uns in units.values() for n in uns}
+        for p in members:
+            node = node_of.get(p.node_name or "")
+            if node is not None and node.is_tpu:
+                landed_rate = self.repacker.rate(
+                    node.tpu_accelerator or plan.accel,
+                    tier_of_labels(node.labels))
+                break
+        realized = self._repack_realized(unit_id, st, now)
+        attrs = self.repacker.note_completed(
+            plan, now, realized_cost_cs=realized,
+            landed_rate=landed_rate)
+        log.info("repack of %s complete in %.1fs: %s chip-s saved "
+                 "net (~$%.2f proxy)", unit_id, latency,
+                 attrs["chip_seconds_saved"],
+                 attrs["dollar_proxy_saved"])
+        self._notify(
+            f"repack complete: {unit_id} migrated in {latency:.0f}s, "
+            f"{attrs['chip_seconds_saved']:.0f} chip-s saved net")
+        self._end_repair(unit_id, st, now, outcome="completed",
+                         attrs={"latency_s": round(latency, 3),
+                                "kind": plan.kind, **attrs},
+                         metric="repack_seconds")
+
+    def repack_route(self, params: dict | None = None) -> dict:
+        """The ``/debugz/repack`` body: the Repacker's books plus the
+        live in-flight migration table (docs/REPACK.md).  Read from
+        the /debugz thread — bounded-retry copy, degrade-not-500."""
+        del params
+        if self.repacker is None:
+            return {"disabled": True, "active": [], "totals": {},
+                    "recent": [], "last_rejections": []}
+        out = self.repacker.debug_state()
+        for _ in range(5):
+            try:
+                out["active"] = [
+                    {"unit": uid, "kind": st["plan"].kind,
+                     "target_shape": st["plan"].target_shape,
+                     "started": st["started"],
+                     "realized_cost_cs": round(
+                         st.get("realized_cost_cs", 0.0)
+                         + st.get("dest_cost_cs", 0.0), 3),
+                     "projected_saving_cs": round(
+                         st["plan"].projected_saving_cs, 3),
+                     "gangs": ["/".join(str(p) for p in k)
+                               for k in st["gang_keys"]]}
+                    for uid, st in list(self._slice_repairs.items())
+                    if st.get("kind") == "repack"]
+                break
+            except (RuntimeError, KeyError):  # mutated mid-copy
+                continue
+        else:
+            out["active"] = []
+        return out
 
     # ---- observe-side index reads (ISSUE 7 satellite) ------------------
 
@@ -1433,6 +1838,10 @@ class Controller:
         # --from <bundle>` renders the bill an incident was captured
         # under, and `--window` reads the cost_* TSDB series above.
         out["cost"] = self.cost.debug_state(now=self._last_pass_at)
+        # The repacker's books (ISSUE 12): `tpu-autoscaler
+        # repack-report --from <bundle>` renders the migration ledger
+        # an incident was captured under.
+        out["repack"] = self.repack_route()
         out["informer"] = self._informer_digest()
         cfg = self.config
         out["config"] = {
@@ -1443,6 +1852,7 @@ class Controller:
             "delta_planning": cfg.delta_planning,
             "enable_slice_repair": cfg.enable_slice_repair,
             "enable_preemption": cfg.enable_preemption,
+            "enable_repack": cfg.enable_repack,
             "max_total_chips": cfg.policy.max_total_chips,
             "default_generation": cfg.policy.default_generation,
         }
@@ -2546,6 +2956,19 @@ class Controller:
         for key, count in state_counts.items():
             self.metrics.set_gauge(f"units_{key.replace('-', '_')}", count)
         self._sweep_repairs(units, pods, now)
+        # Cost-aware continuous repacking (ISSUE 12): AFTER the unit
+        # loop fed the ledger (placement rows are this pass's truth)
+        # and the repair sweep settled migration completions.  Crash-
+        # only: a repack bug leaves the fleet as placed, never breaks
+        # maintenance.
+        if self.repacker is not None:
+            try:
+                self._repack_pass(units, pods, pods_by_node, spare_ids,
+                                  now)
+            except Exception:  # noqa: BLE001 — advisory only
+                self.metrics.inc("repack_errors")
+                log.exception("repack pass failed; fleet stays as "
+                              "placed")
         # Forget tracker state for units whose nodes are gone.
         # Ledger units not in this pass's observation left the fleet
         # (drain-complete deletes forget the tracker mid-pass, so the

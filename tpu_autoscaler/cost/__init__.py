@@ -20,6 +20,7 @@ from tpu_autoscaler.cost.ledger import (
 from tpu_autoscaler.cost.pricebook import PriceBook, tier_of_labels
 from tpu_autoscaler.cost.report import (
     render_bill,
+    render_frag,
     render_windowed,
     windowed_bill,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "PriceBook",
     "classify_cost_state",
     "render_bill",
+    "render_frag",
     "render_windowed",
     "score_pools",
     "tier_of_labels",

@@ -478,6 +478,28 @@ class CostLedger:
             return None
         return {"cost_chip_seconds": round(acc.total(now), 3)}
 
+    def placement_quality(self) -> dict[str, Any]:
+        """Per-unit placement rows for the repacker (ISSUE 12,
+        docs/REPACK.md): every BUSY unit's pool/tier/shape/chip
+        numbers, plus the current idle-spot-by-shape availability the
+        displacement candidates are matched against.  O(busy units)
+        — consumed once per pass by the (opt-in) repack pass, never
+        by the always-on close."""
+        rows = []
+        for unit_id, u in self._units.items():
+            if u.state not in ("serving", "training"):
+                continue
+            rows.append({
+                "unit_id": unit_id, "pool": u.pool, "accel": u.accel,
+                "tier": u.tier, "shape": u.shape, "chips": u.chips,
+                "used_chips": u.used_chips, "state": u.state,
+                "since": u.entered_at, "gang_id": u.gang_id,
+            })
+        return {"rows": rows,
+                "idle_spot_chips": {k: v for k, v
+                                    in self._idle_spot_chips.items()
+                                    if v > 0}}
+
     def rebuild(self) -> dict[str, Any]:
         """From-scratch chip counts off the unit table — the property
         oracle the incremental accumulators are checked against."""

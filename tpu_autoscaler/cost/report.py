@@ -109,6 +109,54 @@ def render_bill(cost: Mapping[str, Any], *, top_gangs: int = 10) -> str:
     return "\n".join(lines)
 
 
+def render_frag(cost: Mapping[str, Any]) -> str:
+    """The ``--frag`` section (ISSUE 12 satellite): per-pool
+    fragmentation scores with the full stranded / displaced /
+    overprovisioned component breakdown and what each component means
+    for the repacker — the operator-explainable view of why a pool
+    will (or will not) be defragmented (docs/REPACK.md candidate
+    scoring; the weights are cost/frag.py's)."""
+    from tpu_autoscaler.cost.frag import (
+        W_DISPLACED,
+        W_OVERPROVISIONED,
+        W_STRANDED,
+    )
+
+    frag = cost.get("fragmentation", {})
+    lines = ["FRAGMENTATION  (score = "
+             f"({W_STRANDED:g}*stranded + {W_DISPLACED:g}*displaced "
+             f"+ {W_OVERPROVISIONED:g}*overprov) / pool chips, "
+             "capped at 1)"]
+    if not frag:
+        lines.append("  (no pools scored — fleet empty or ledger "
+                     "not yet closed)")
+        return "\n".join(lines)
+    for pool in sorted(frag, key=lambda p: -frag[p]["score"]):
+        s = frag[pool]
+        lines.append(f"  {pool}  score={s['score']:.3f}  "
+                     f"({s['chips']} chips)")
+        if s["stranded_chips"]:
+            lines.append(
+                f"    stranded       {s['stranded_chips']:>6} chips — "
+                f"no catalog shape can ever use them (pure loss; "
+                f"reclaim, not repack)")
+        if s["displaced_chips"]:
+            lines.append(
+                f"    displaced      {s['displaced_chips']:>6} chips — "
+                f"busy on reservation tier while same-shape spot sits "
+                f"idle (a displace migration's target)")
+        if s["overprovisioned_chips"]:
+            lines.append(
+                f"    overprovisioned{s['overprovisioned_chips']:>6} "
+                f"chips — inside busy units beyond what their gangs "
+                f"request (a rightsize migration's target)")
+        if not (s["stranded_chips"] or s["displaced_chips"]
+                or s["overprovisioned_chips"]):
+            lines.append("    (clean: nothing stranded, displaced or "
+                         "overprovisioned)")
+    return "\n".join(lines)
+
+
 def windowed_bill(tsdb_dump: Mapping[str, Any],
                   window_seconds: float) -> dict[str, Any]:
     """A by-state bill over the trailing ``window_seconds`` of TSDB

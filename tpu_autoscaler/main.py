@@ -160,6 +160,13 @@ _common = [
     click.option("--preemption", is_flag=True,
                  help="Let clamp-blocked higher-priority gangs reclaim "
                       "chips from lower-priority jobs (checkpoint-aware)."),
+    click.option("--repack", "enable_repack", is_flag=True,
+                 help="Enable cost-aware continuous repacking: migrate "
+                      "wrongly-placed gangs (expensive tier while "
+                      "same-shape spot sits idle; oversized slices) "
+                      "under a hard never-costs-more-than-it-saves "
+                      "budget guard (docs/REPACK.md). Off by default: "
+                      "repacking moves live work."),
     click.option("--spare-agents", default=1, show_default=True,
                  help="Free CPU nodes kept warm (reference: --spare-agents)."),
     click.option("--spare-slice", "spare_slices", multiple=True,
@@ -256,7 +263,7 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            policy_early_reclaim, slack_hook,
            slack_channel, metrics_port, recorder_spans, recorder_passes,
            no_alerts, incident_dir, log_json, verbose,
-           price_book=None) -> Controller:
+           price_book=None, enable_repack=False) -> Controller:
     from tpu_autoscaler.logging_setup import setup_logging
     from tpu_autoscaler.obs import AlertEngine, BlackBox, FlightRecorder
 
@@ -290,6 +297,7 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         gang_settle_seconds=gang_settle,
         provision_timeout_seconds=provision_timeout,
         enable_preemption=preemption,
+        enable_repack=enable_repack,
         price_book=book,
         no_scale=no_scale, no_maintenance=no_maintenance)
     policy_engine = None
@@ -331,7 +339,9 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         # all ride the port operators already expose.
         metrics.serve(metrics_port, debugz=controller.debug_dump,
                       routes={"/debugz/tsdb": controller.tsdb_route,
-                              "/debugz/cost": controller.cost_route})
+                              "/debugz/cost": controller.cost_route,
+                              "/debugz/repack":
+                                  controller.repack_route})
     return controller
 
 
@@ -693,22 +703,51 @@ def trace(source, url, trace_id):
         click.echo(list_traces(dump))
 
 
+def _series_match(name, pattern):
+    """Series-filter predicate: a plain pattern is a name PREFIX (the
+    original contract); one carrying glob metacharacters (``*?[``)
+    must glob-match the WHOLE name (ISSUE 12 satellite — ``--prefix
+    'repack_*'`` / ``'frag_score_*'``).  One predicate for both the
+    ``--url`` and ``--from`` paths, pinned equal by the parity test
+    (tests/test_repack.py)."""
+    if not pattern:
+        return True
+    if any(ch in pattern for ch in "*?["):
+        import fnmatch
+
+        return fnmatch.fnmatchcase(name, pattern)
+    return name.startswith(pattern)
+
+
 def _load_tsdb_dump(source, url, prefix, window):
     """Read a TSDB dump: a live controller's ``/debugz/tsdb`` (with
     server-side prefix/window filtering) or any incident bundle /
-    SIGUSR1 file (its ``tsdb`` section; filtered client-side)."""
+    SIGUSR1 file (its ``tsdb`` section; filtered client-side).
+    Glob patterns filter client-side in BOTH modes (the server speaks
+    plain prefixes; it is sent the glob's literal head to narrow the
+    transfer, and the glob finishes here — url/file parity)."""
+    import re as _re
+
     _require_one_source(source, url, "an incident bundle")
+    globbing = bool(prefix) and any(ch in prefix for ch in "*?[")
     if not source:
         params = {}
         if prefix:
-            params["prefix"] = prefix
+            head = _re.split(r"[*?\[]", prefix, 1)[0] if globbing \
+                else prefix
+            if head:
+                params["prefix"] = head
         if window:
             params["window"] = str(window)
-        return _fetch_debugz(url, "/debugz/tsdb", params)
+        body = _fetch_debugz(url, "/debugz/tsdb", params)
+        if globbing and isinstance(body.get("series"), dict):
+            body["series"] = {n: s for n, s in body["series"].items()
+                             if _series_match(n, prefix)}
+        return body
     raw = _read_dump_file(source)
     body = dict(raw.get("tsdb", raw))  # bundle section, or a bare dump
     series = {n: s for n, s in body.get("series", {}).items()
-              if not prefix or n.startswith(prefix)}
+              if _series_match(n, prefix)}
     if window:
         # Client-side window trim (the --url branch filters
         # server-side): "now" is the newest timestamp the bundle
@@ -811,7 +850,12 @@ def metrics_history(source, url, series, prefix, window, max_points,
                    "cost_* history (seconds).")
 @click.option("--top", default=10, show_default=True,
               help="Gangs to list in the cost-to-serve ranking.")
-def cost_report(source, url, window, top):
+@click.option("--frag", "frag", is_flag=True,
+              help="Also render the per-pool fragmentation breakdown "
+                   "(stranded / displaced / overprovisioned component "
+                   "chips and what the repacker would do about each — "
+                   "docs/REPACK.md).")
+def cost_report(source, url, window, top, frag):
     """Render the fleet bill (docs/COST.md): every chip-second
     attributed by state / pool / accelerator class / price tier, the
     per-gang cost-to-serve ranking, fragmentation scores, and the
@@ -819,6 +863,7 @@ def cost_report(source, url, window, top):
     or any incident bundle / SIGUSR1 dump."""
     from tpu_autoscaler.cost import (
         render_bill,
+        render_frag,
         render_windowed,
         windowed_bill,
     )
@@ -842,6 +887,9 @@ def cost_report(source, url, window, top):
                    "retry)")
         return
     click.echo(render_bill(cost, top_gangs=top))
+    if frag:
+        click.echo("")
+        click.echo(render_frag(cost))
     if window:
         if not tsdb or not tsdb.get("series"):
             raise click.UsageError(
@@ -849,6 +897,33 @@ def cost_report(source, url, window, top):
                 "this source)")
         click.echo("")
         click.echo(render_windowed(windowed_bill(tsdb, window)))
+
+
+@cli.command("repack-report")
+@dump_options
+def repack_report(source, url):
+    """Render the repacker's books (docs/REPACK.md): migration totals
+    and net savings, the rolling cost budget, in-flight migrations,
+    recent closes with their chip-seconds-saved attribution, and why
+    the last pass's candidates were turned down — from a live
+    controller's ``/debugz/repack`` or any incident bundle."""
+    from tpu_autoscaler.repack import render_repack
+
+    _require_one_source(source, url, "an incident bundle")
+    if source:
+        raw = _read_dump_file(source)
+        body = raw.get("repack")
+        if body is None:
+            raise click.UsageError(
+                f"{source!r} carries no repack section — capture a "
+                "fresh bundle from a build with the repacker")
+    else:
+        body = _fetch_debugz(url, "/debugz/repack")
+    if body.get("unavailable"):
+        click.echo("(repack snapshot unavailable: writer was "
+                   "mutating; retry)")
+        return
+    click.echo(render_repack(body))
 
 
 @cli.command()

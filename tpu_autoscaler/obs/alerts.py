@@ -127,6 +127,15 @@ def default_rules() -> tuple[AlertRule, ...]:
             name="cost-budget-burn", metric="cost_dollar_proxy_total",
             kind="rate", window=3600.0, threshold=500.0 / 3600.0,
             for_passes=2, clear_passes=5, severity="ticket"),
+        # Repack thrash (ISSUE 12, docs/REPACK.md): migrations are
+        # background savings, not churn — more than ~12 an hour means
+        # the repacker is chasing its own tail (gangs bouncing between
+        # tiers, or aborts burning budget with nothing to show).
+        AlertRule(
+            name="repack-thrash",
+            metric="repack_migrations_started",
+            kind="rate", window=3600.0, threshold=12.0 / 3600.0,
+            for_passes=3, clear_passes=5, severity="ticket"),
     )
 
 

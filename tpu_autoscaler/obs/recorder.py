@@ -107,7 +107,9 @@ def trace_gaps(dump: dict[str, Any], trace_id: str) -> list[str]:
       (observe/plan/dispatch/provision/node_registration) plus
       ``pods_running``; one that bound existing supply needs only
       ``pods_running``;
-    - a slice repair carries its drain phase.
+    - a slice repair carries its drain phase;
+    - a repack migration (ISSUE 12) carries its drain phase and, when
+      completed, the chip-seconds-saved attribution on the root.
     """
     spans = [s for s in dump.get("spans", []) if s["trace_id"] == trace_id]
     if not spans:
@@ -144,6 +146,21 @@ def trace_gaps(dump: dict[str, Any], trace_id: str) -> list[str]:
                              or "aborted" in s["attrs"]) for s in spans)
         if not abandoned and "repair_drain" not in names:
             gaps.append(f"trace {trace_id}: missing repair_drain span")
+    elif "repack" in names:
+        closed = [s for s in spans if s["name"] == "repack"
+                  and s["end"] is not None]
+        aborted = any("error" in s["attrs"] or "aborted" in s["attrs"]
+                      for s in closed)
+        if not aborted and closed and "repack_drain" not in names:
+            gaps.append(f"trace {trace_id}: missing repack_drain span")
+        for s in closed:
+            if "aborted" in s["attrs"] or "error" in s["attrs"]:
+                continue
+            # A completed migration's root must carry its bill — the
+            # chip-seconds-saved attribution IS the acceptance surface.
+            if "chip_seconds_saved" not in s["attrs"]:
+                gaps.append(f"trace {trace_id}: completed repack root "
+                            f"missing chip_seconds_saved attribution")
     return gaps
 
 

@@ -189,6 +189,21 @@ def rolling_waste(events: list[tuple[float, float]], now: float,
     return kept, sum(w for _t, w in kept)
 
 
+def budget_remaining(events: list[tuple[float, float]], now: float,
+                     window_seconds: float, budget_chip_seconds: float
+                     ) -> tuple[list[tuple[float, float]], float, float]:
+    """``rolling_waste`` plus the verdict: ``(kept_events, spent,
+    remaining)`` against a rolling chip-seconds budget.
+
+    The ISSUE 12 extension of the one-authority rule above: the
+    prewarm waste gate and the repacker's migration-cost budget
+    (repack/repacker.py) charge, trim and settle the SAME way, so
+    "how much budget is left" can never mean two things.  Pure over
+    injected values (TAP1xx scope)."""
+    kept, spent = rolling_waste(events, now, window_seconds)
+    return kept, spent, max(0.0, budget_chip_seconds - spent)
+
+
 def idle_threshold_for(accel_class: str, now: float, *,
                        policy: SloPolicy, base_threshold: float,
                        provision_estimate: float,

@@ -1,0 +1,44 @@
+"""Cost-aware continuous repacking (ISSUE 12, docs/REPACK.md).
+
+The cost ledger scores the fleet for fragmentation; this package spends
+that signal: a background repacker that finds wrongly-placed gangs
+(expensive-tier chips while same-shape spot sits idle, topology-poor
+oversized slices), generalizes the ISSUE 7 slice-repair pipeline from
+"broken slice" to "wrongly-placed gang" — ICI-atomic cordon +
+checkpoint drain + advisory replacement through the planner's existing
+hook — and keeps every migration under a hard savings budget: a repack
+aborts the moment its projected cost exceeds its ledger-attributed
+projected savings.
+
+- :mod:`tpu_autoscaler.repack.policy` — the pure algebra (candidates,
+  projections, the abort verdict, realized attribution);
+- :mod:`tpu_autoscaler.repack.repacker` — the stateful per-pass engine
+  (rolling budget, cooldowns, totals);
+- :mod:`tpu_autoscaler.repack.report` — the ``repack-report`` CLI
+  rendering.
+
+The migration lifecycle itself (drain, advisory demand, traces) lives
+in the Reconciler beside the repair pipeline it generalizes.
+"""
+
+from tpu_autoscaler.repack.policy import (
+    MigrationPlan,
+    RepackConfig,
+    UnitRow,
+    plan_candidates,
+    realized_attribution,
+    should_abort,
+)
+from tpu_autoscaler.repack.repacker import Repacker
+from tpu_autoscaler.repack.report import render_repack
+
+__all__ = [
+    "MigrationPlan",
+    "RepackConfig",
+    "Repacker",
+    "UnitRow",
+    "plan_candidates",
+    "realized_attribution",
+    "render_repack",
+    "should_abort",
+]

@@ -1,0 +1,330 @@
+"""Repack algebra: which gangs are wrongly placed, and is moving them
+worth it (ISSUE 12, docs/REPACK.md).
+
+The SLO-driven cost-aware autoscaling paper optimizes placement cost at
+admission; this module is the pure half of doing it *continuously*.
+Inputs are the cost ledger's per-unit placement rows
+(``CostLedger.placement_quality``) and price-book rates; outputs are
+:class:`MigrationPlan` decisions plus human-readable rejections, so a
+silent repacker is still an explainable one (the decide_prewarms
+pattern, policy/slo.py).
+
+Two migration kinds, matching the fragmentation scorer's recoverable
+components (cost/frag.py):
+
+- ``displace`` — a gang runs on expensive-tier chips while a same-shape
+  SPOT unit sits idle: drain the source slice and the advisory
+  replacement (the unit's own shape) is satisfied by the idle spot
+  slice without provisioning — the gang runs identically for a
+  fraction of the $-proxy, and the expensive slice is released whole;
+- ``rightsize`` — a gang requests fewer chips than its slice carries
+  (topology-poor placement): the advisory replacement names the
+  fitter's right-sized shape, and the oversized slice is released.
+
+**The budget algebra.**  Everything is measured in chip-seconds — the
+PR 8 wasted-chip-seconds currency — so the repack budget, the policy
+waste budget, and the ledger speak one unit:
+
+- a migration's *cost* is the chip-seconds its source unit burns in
+  the ``repair`` state (cordon + checkpoint drain) plus, for
+  rightsize, the replacement's provisioning chip-seconds;
+- its *savings rate* converts the $-proxy delta back into
+  chip-second-equivalents at the source rate
+  (``chips x (1 - rate_new/rate_old)`` per second for displace; the
+  freed chips per second for rightsize), projected over
+  ``savings_horizon_seconds``;
+- admission requires ``projected savings >= min_savings_ratio x
+  projected cost`` AND headroom in the rolling repack budget
+  (policy/slo.py ``budget_remaining`` — the ONE window algebra);
+- the in-flight guard re-evaluates every pass with REALIZED cost and
+  CURRENT destination availability: the moment projected cost exceeds
+  projected savings (``abort_savings_ratio``), the migration aborts —
+  repacking can never cost more than it saves, by construction.
+
+Pure computation over injected values only (the policy/slo.py
+contract): no clocks, no controller state, no I/O.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping, Sequence
+
+#: Migration kinds, in candidate-ranking order (displacement first:
+#: same chips for a fraction of the price beats freeing chips that
+#: must be re-provisioned elsewhere to matter).
+KINDS = ("displace", "rightsize")
+
+
+@dataclasses.dataclass(frozen=True)
+class RepackConfig:
+    """Knobs of the repack algebra (docs/REPACK.md)."""
+
+    # At most this many concurrent in-flight migrations fleet-wide —
+    # repacking is background work; it must never look like an outage.
+    max_concurrent_migrations: int = 1
+    # Admission bar: projected savings must exceed projected cost by
+    # this factor (headroom for drain overruns and landing slop).
+    min_savings_ratio: float = 2.0
+    # In-flight abort bar: the migration aborts the moment projected
+    # total cost x this ratio exceeds projected savings.  1.0 = abort
+    # exactly when the move stops paying.
+    abort_savings_ratio: float = 1.0
+    # Horizon the savings rate is projected over.  A gang that leaves
+    # sooner realizes less than projected — the min_savings_ratio
+    # margin and the never-worse bench gate absorb that.
+    savings_horizon_seconds: float = 3600.0
+    # Rolling migration-cost budget: committed projected costs of
+    # in-flight migrations plus realized costs of closed ones, per
+    # window (the PR 8 waste-budget shape; policy/slo.py).
+    budget_chip_seconds: float = 50_000.0
+    budget_window_seconds: float = 3600.0
+    # Cost-estimate terms: how long the source burns in the repair
+    # state, and the replacement provision estimate (rightsize only).
+    drain_estimate_seconds: float = 120.0
+    provision_estimate_seconds: float = 240.0
+    # A unit must have been busy this long before it is a candidate —
+    # migrating a gang that just landed is thrash, not savings.
+    min_dwell_seconds: float = 600.0
+    # After any migration (completed, aborted or abandoned) touches a
+    # gang, that gang is left alone this long.
+    gang_cooldown_seconds: float = 1800.0
+    # Serving pools below this SLO attainment are never migrated —
+    # a burning pool needs its replicas where they are
+    # (serving/adapter.py ``burning_pools``).
+    slo_attainment_floor: float = 0.95
+
+
+@dataclasses.dataclass(frozen=True)
+class UnitRow:
+    """One busy unit's placement row (CostLedger.placement_quality)."""
+
+    unit_id: str
+    pool: str
+    accel: str
+    tier: str
+    shape: str | None
+    chips: int
+    used_chips: int
+    state: str                 # "serving" | "training"
+    since: float               # current busy span entered
+    gang_id: str | None
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrationPlan:
+    """One approved migration: drain ``unit_id``, let the advisory
+    replacement (``target_shape``) re-home the gang."""
+
+    unit_id: str
+    kind: str                  # "displace" | "rightsize"
+    pool: str
+    accel: str
+    tier: str
+    shape: str                 # source shape
+    target_shape: str
+    chips: int                 # source unit chips
+    target_chips: int
+    rate_src: float            # $/chip-hour at the source
+    rate_dst: float            # $/chip-hour projected at the target
+    freed_cs_per_s: float      # chip-second-equivalents saved per second
+    saved_usd_per_s: float
+    projected_cost_cs: float
+    projected_saving_cs: float
+    reason: str
+
+
+def projected_cost_cs(kind: str, chips: int, target_chips: int,
+                      cfg: RepackConfig) -> float:
+    """Chip-seconds a migration is expected to burn: the source holds
+    ``chips`` through the drain; a rightsize also pays the
+    replacement's provisioning chip-seconds."""
+    cost = chips * cfg.drain_estimate_seconds
+    if kind == "rightsize":
+        cost += target_chips * cfg.provision_estimate_seconds
+    return cost
+
+
+def saving_rate(kind: str, chips: int, target_chips: int,
+                rate_src: float, rate_dst: float
+                ) -> tuple[float, float]:
+    """(chip-second-equivalents per second, $ per second) a completed
+    migration saves.  Displacement keeps the chips and drops the rate
+    (``chips x (1 - rate_dst/rate_src)``); rightsize frees chips
+    outright."""
+    if kind == "rightsize":
+        freed = max(0, chips - target_chips)
+        return float(freed), freed * rate_src / 3600.0
+    if rate_src <= 0.0:
+        return 0.0, 0.0
+    saved_usd = chips * max(0.0, rate_src - rate_dst) / 3600.0
+    return chips * max(0.0, 1.0 - rate_dst / rate_src), saved_usd
+
+
+def plan_candidates(rows: Sequence[UnitRow],
+                    idle_spot_chips: Mapping[str, int],
+                    rate: Callable[[str, str], float],
+                    now: float, cfg: RepackConfig, *,
+                    active_migrations: int,
+                    budget_remaining_cs: float,
+                    excluded: frozenset[str] = frozenset(),
+                    burning_pools: frozenset[str] = frozenset(),
+                    rightsize_targets: Mapping[str, tuple[str, int]]
+                    | None = None,
+                    ) -> tuple[list[MigrationPlan], list[str]]:
+    """The admission gate.  Returns ``(plans, rejections)``.
+
+    ``rightsize_targets`` maps unit id -> (target shape, target chips)
+    for overprovisioned units the caller's fitter already right-sized
+    (the fitter is the one authority on what a gang actually needs —
+    this module never second-guesses it).  ``excluded`` carries every
+    unit the caller ruled out mechanically (under repair/drain, policy
+    holds, multislice members, pending gangs, cooldowns); economic
+    rejections are produced here so the two layers never disagree on
+    whose "no" it was.
+    """
+    plans: list[MigrationPlan] = []
+    rejections: list[str] = []
+    rightsize_targets = rightsize_targets or {}
+    slots = cfg.max_concurrent_migrations - active_migrations
+    committed = 0.0
+    # Idle spot is consumed as displacements are planned: two same-
+    # shape candidates must not both count the one idle slice.
+    spot_left = dict(idle_spot_chips)
+
+    candidates: list[tuple[float, UnitRow, str, str, int,
+                           float, float]] = []
+    for row in rows:
+        if row.unit_id in excluded or row.shape is None:
+            continue
+        if row.pool in burning_pools:
+            rejections.append(
+                f"{row.unit_id}: pool {row.pool} is SLO-burning — "
+                f"replicas stay where they are")
+            continue
+        if now - row.since < cfg.min_dwell_seconds:
+            rejections.append(
+                f"{row.unit_id}: busy only {now - row.since:.0f}s "
+                f"(< min dwell {cfg.min_dwell_seconds:g}s)")
+            continue
+        rate_src = rate(row.accel, row.tier)
+        rate_spot = rate(row.accel, "spot")
+        if row.tier != "spot" and rate_src > rate_spot \
+                and spot_left.get(row.shape, 0) >= row.chips:
+            freed, usd = saving_rate("displace", row.chips, row.chips,
+                                     rate_src, rate_spot)
+            candidates.append((freed, row, "displace", row.shape,
+                               row.chips, rate_src, rate_spot))
+            continue
+        target = rightsize_targets.get(row.unit_id)
+        if target is not None and target[1] < row.chips:
+            freed, usd = saving_rate("rightsize", row.chips, target[1],
+                                     rate_src, rate_src)
+            candidates.append((freed, row, "rightsize", target[0],
+                               target[1], rate_src, rate_src))
+
+    # Biggest saving rate first; unit id breaks ties deterministically.
+    candidates.sort(key=lambda c: (-c[0], c[1].unit_id))
+    for freed, row, kind, target_shape, target_chips, rate_src, \
+            rate_dst in candidates:
+        if kind == "displace" \
+                and spot_left.get(row.shape, 0) < row.chips:
+            rejections.append(
+                f"{row.unit_id}: idle spot {row.shape} already "
+                f"claimed by a higher-saving displacement this pass")
+            continue
+        cost = projected_cost_cs(kind, row.chips, target_chips, cfg)
+        saving = freed * cfg.savings_horizon_seconds
+        _freed, usd_per_s = saving_rate(kind, row.chips, target_chips,
+                                        rate_src, rate_dst)
+        if saving < cfg.min_savings_ratio * cost:
+            rejections.append(
+                f"{row.unit_id}: {kind} saves {saving:.0f} chip-s over "
+                f"the horizon vs {cost:.0f} projected cost — below the "
+                f"{cfg.min_savings_ratio:g}x admission bar")
+            continue
+        if committed + cost > budget_remaining_cs:
+            rejections.append(
+                f"{row.unit_id}: {kind} cost {cost:.0f} chip-s would "
+                f"blow the rolling repack budget "
+                f"({budget_remaining_cs:.0f} remaining)")
+            continue
+        if slots <= 0:
+            rejections.append(
+                f"{row.unit_id}: max_concurrent_migrations "
+                f"({cfg.max_concurrent_migrations}) reached")
+            continue
+        slots -= 1
+        committed += cost
+        if kind == "displace":
+            spot_left[row.shape] = spot_left.get(row.shape, 0) \
+                - row.chips
+            reason = (f"gang on {row.tier} {row.shape} "
+                      f"(${rate_src:g}/chip-h) while same-shape spot "
+                      f"sits idle (${rate_dst:g}/chip-h)")
+        else:
+            reason = (f"gang uses {row.used_chips} of {row.chips} "
+                      f"chips on {row.shape}; {target_shape} fits it "
+                      f"({row.chips - target_chips} chips freed)")
+        plans.append(MigrationPlan(
+            unit_id=row.unit_id, kind=kind, pool=row.pool,
+            accel=row.accel, tier=row.tier, shape=row.shape,
+            target_shape=target_shape, chips=row.chips,
+            target_chips=target_chips, rate_src=rate_src,
+            rate_dst=rate_dst, freed_cs_per_s=freed,
+            saved_usd_per_s=usd_per_s, projected_cost_cs=cost,
+            projected_saving_cs=saving, reason=reason))
+    return plans, rejections
+
+
+def should_abort(plan: MigrationPlan, cfg: RepackConfig, *,
+                 realized_cost_cs: float, elapsed: float,
+                 destination_available: bool,
+                 provision_pending: bool) -> str | None:
+    """The in-flight budget guard: one stateless verdict per pass.
+
+    Returns the abort reason, or None while the migration still pays.
+    ``destination_available`` is the caller's CURRENT view (idle spot
+    still free for displace, or the gang already landing); a displace
+    whose destination vanished has zero projected savings and aborts
+    immediately.  ``provision_pending`` keeps the rightsize estimate
+    honest while the replacement is still in flight.
+    """
+    if not destination_available:
+        return (f"destination gone: no idle spot {plan.shape} left "
+                f"and the gang has not landed — projected savings "
+                f"collapsed to 0")
+    remaining = plan.chips * max(
+        0.0, cfg.drain_estimate_seconds - elapsed)
+    if plan.kind == "rightsize" and provision_pending:
+        remaining += plan.target_chips * cfg.provision_estimate_seconds
+    projected_total = realized_cost_cs + remaining
+    if cfg.abort_savings_ratio * projected_total \
+            > plan.projected_saving_cs:
+        return (f"projected migration cost {projected_total:.0f} "
+                f"chip-s exceeds projected savings "
+                f"{plan.projected_saving_cs:.0f} chip-s "
+                f"(realized {realized_cost_cs:.0f})")
+    return None
+
+
+def realized_attribution(plan: MigrationPlan, cfg: RepackConfig, *,
+                         realized_cost_cs: float,
+                         landed_rate: float | None) -> dict[str, float]:
+    """The closing trace's bill: chip-seconds-saved / $-proxy-saved,
+    net of the realized migration cost, computed against the tier the
+    gang ACTUALLY landed on (``landed_rate``; None = the projected
+    destination rate — the ledger never saw the landing)."""
+    rate_dst = plan.rate_dst if landed_rate is None else landed_rate
+    freed, usd_per_s = saving_rate(plan.kind, plan.chips,
+                                   plan.target_chips, plan.rate_src,
+                                   rate_dst)
+    horizon = cfg.savings_horizon_seconds
+    cost_usd = realized_cost_cs * plan.rate_src / 3600.0
+    return {
+        "chip_seconds_saved": round(freed * horizon
+                                    - realized_cost_cs, 3),
+        "dollar_proxy_saved": round(usd_per_s * horizon - cost_usd, 6),
+        "migration_cost_chip_seconds": round(realized_cost_cs, 3),
+        "landed_rate_usd_chip_hour": round(rate_dst, 6),
+    }

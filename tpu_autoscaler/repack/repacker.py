@@ -1,0 +1,244 @@
+"""The Repacker: stateful wrapper over the pure repack algebra.
+
+Consulted once per reconcile pass by the Reconciler (crash-only, like
+the PolicyEngine and ServingScaler): candidate rows in, bounded
+:class:`~tpu_autoscaler.repack.policy.MigrationPlan` decisions out.
+The migration *lifecycle* (cordon + checkpoint drain, advisory
+replacement, trace spans) is the Reconciler's — it already owns the
+identical repair pipeline — so this class keeps only what outlives a
+pass:
+
+- the rolling migration-cost budget (committed projected costs of
+  in-flight migrations + realized costs of closed ones, trimmed and
+  summed by policy/slo.py ``budget_remaining`` — the ONE window
+  algebra shared with the prewarm waste budget);
+- per-gang cooldowns (anti-thrash: a migrated gang is left alone);
+- cumulative savings/cost totals (the chaos corpus'
+  never-net-negative-savings invariant reads them) and a bounded ring
+  of recent closes for ``/debugz/repack`` and ``repack-report``.
+
+Threading: reconcile-thread-only writes; ``debug_state()`` copies with
+the established bounded-retry pattern for the /debugz thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+from typing import Any, Iterable, Mapping, Sequence
+
+from tpu_autoscaler.cost.pricebook import PriceBook
+from tpu_autoscaler.policy.slo import budget_remaining
+from tpu_autoscaler.repack.policy import (
+    MigrationPlan,
+    RepackConfig,
+    UnitRow,
+    plan_candidates,
+    realized_attribution,
+    should_abort,
+)
+
+log = logging.getLogger(__name__)
+
+#: Closed migrations retained for the report surfaces.
+RECENT_CLOSES = 64
+
+
+class Repacker:
+    """Per-pass repack advice + budget bookkeeping."""
+
+    def __init__(self, config: RepackConfig | None = None,
+                 price_book: PriceBook | None = None) -> None:
+        self.config = config or RepackConfig()
+        self.price_book = price_book or PriceBook()
+        self._metrics: Any = None
+        # Rolling budget events: (t, chip-seconds charged).  Projected
+        # costs are charged at decision time and trued up at close —
+        # a string of expensive migrations exhausts the window and the
+        # repacker self-mutes, exactly like the prewarm budget.
+        self._budget_events: list[tuple[float, float]] = []
+        # gang key -> cooldown expiry.
+        self._cooldowns: dict[tuple, float] = {}
+        self._last_rejections: list[str] = []
+        self.recent: collections.deque[dict[str, Any]] = \
+            collections.deque(maxlen=RECENT_CLOSES)
+        # Cumulative realized totals (net may go negative on a misfire
+        # — the chaos invariant asserts it never does end-to-end).
+        self.totals = {"started": 0, "completed": 0, "aborted": 0,
+                       "abandoned": 0, "misfires": 0,
+                       "realized_cost_cs": 0.0, "saved_cs": 0.0,
+                       "saved_usd": 0.0, "net_cs": 0.0}
+
+    def bind(self, metrics: Any = None) -> None:
+        if metrics is not None:
+            self._metrics = metrics
+
+    def _inc(self, name: str, by: float = 1.0) -> None:
+        if self._metrics is not None and by:
+            self._metrics.inc(name, by)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(name, value)
+
+    # -- rates ------------------------------------------------------------
+
+    def rate(self, accel: str, tier: str) -> float:
+        return self.price_book.rate(accel, tier)[0]
+
+    # -- the per-pass entry points ----------------------------------------
+
+    def settle(self, now: float) -> float:
+        """Trim the rolling budget window and export its gauge; returns
+        the remaining budget.  Called every repack pass (advise may be
+        skipped when the fleet has no candidates — the gauge must not
+        go stale)."""
+        cfg = self.config
+        self._budget_events, spent, remaining = budget_remaining(
+            self._budget_events, now, cfg.budget_window_seconds,
+            cfg.budget_chip_seconds)
+        self.set_gauge("repack_budget_spent_chip_seconds", round(spent, 3))
+        return remaining
+
+    def advise(self, rows: Sequence[UnitRow],
+               idle_spot_chips: Mapping[str, int], now: float, *,
+               active_migrations: int,
+               excluded: Iterable[str] = (),
+               burning_pools: Iterable[str] = (),
+               rightsize_targets: Mapping[str, tuple[str, int]]
+               | None = None,
+               ) -> list[MigrationPlan]:
+        """This pass's migration decisions (possibly empty)."""
+        cfg = self.config
+        remaining = self.settle(now)
+        plans, rejections = plan_candidates(
+            rows, idle_spot_chips, self.rate, now, cfg,
+            active_migrations=active_migrations,
+            budget_remaining_cs=remaining,
+            excluded=frozenset(excluded),
+            burning_pools=frozenset(burning_pools),
+            rightsize_targets=rightsize_targets)
+        if remaining <= 0.0 and rows:
+            self._inc("repack_budget_muted")
+        self._last_rejections = rejections[:32]
+        self.set_gauge("repack_candidates", len(plans) + len(rejections))
+        return plans
+
+    def gang_cooled(self, keys: Iterable[tuple], now: float) -> bool:
+        """True while ANY of the gang keys is inside its cooldown."""
+        return any(now < self._cooldowns.get(k, 0.0) for k in keys)
+
+    def guard(self, plan: MigrationPlan, now: float, *,
+              started: float, realized_cost_cs: float,
+              destination_available: bool,
+              provision_pending: bool) -> str | None:
+        """In-flight verdict for one migration (None = keep going)."""
+        return should_abort(
+            plan, self.config, realized_cost_cs=realized_cost_cs,
+            elapsed=now - started,
+            destination_available=destination_available,
+            provision_pending=provision_pending)
+
+    # -- lifecycle notes (called by the Reconciler) ------------------------
+
+    def note_started(self, plan: MigrationPlan,
+                     gang_keys: Sequence[tuple], now: float) -> None:
+        # Commit the projected cost against the rolling window NOW —
+        # waiting for the close would let a burst of decisions in one
+        # pass all see the un-charged budget (the prewarm lesson).
+        self._budget_events.append((now, plan.projected_cost_cs))
+        for key in gang_keys:
+            self._cooldowns[key] = now + self.config.gang_cooldown_seconds
+        self.totals["started"] += 1
+        self._inc("repack_migrations_started")
+
+    def _true_up(self, plan: MigrationPlan, realized_cost_cs: float,
+                 now: float) -> None:
+        """Replace the committed projection with the realized cost (the
+        projection was charged at start; drop it, charge reality)."""
+        for i in range(len(self._budget_events) - 1, -1, -1):
+            if self._budget_events[i][1] == plan.projected_cost_cs:
+                del self._budget_events[i]
+                break
+        self._budget_events.append((now, realized_cost_cs))
+        self.totals["realized_cost_cs"] += realized_cost_cs
+        self._inc("repack_migration_cost_chip_seconds",
+                  realized_cost_cs)
+
+    def note_completed(self, plan: MigrationPlan, now: float, *,
+                       realized_cost_cs: float,
+                       landed_rate: float | None) -> dict[str, float]:
+        """Close the books on a completed migration; returns the
+        attribution dict stamped on the closing ``repack`` trace."""
+        attrs = realized_attribution(
+            plan, self.config, realized_cost_cs=realized_cost_cs,
+            landed_rate=landed_rate)
+        self._true_up(plan, realized_cost_cs, now)
+        net_cs = attrs["chip_seconds_saved"]
+        net_usd = attrs["dollar_proxy_saved"]
+        self.totals["completed"] += 1
+        self.totals["net_cs"] += net_cs
+        self._inc("repack_migrations_completed")
+        if net_cs > 0.0:
+            self.totals["saved_cs"] += net_cs
+            self._inc("repack_chip_seconds_saved", net_cs)
+        elif net_cs < 0.0:
+            # Strictly negative only: a zero-net close is neither a
+            # saving nor a loss, and the chaos misfire-surfacing
+            # invariant counts exactly the net-NEGATIVE traces.
+            self.totals["misfires"] += 1
+            self._inc("repack_misfires")
+        if net_usd > 0.0:
+            self.totals["saved_usd"] += net_usd
+            self._inc("repack_dollar_proxy_saved", net_usd)
+        self.set_gauge("repack_net_chip_seconds_saved",
+                    round(self.totals["net_cs"], 3))
+        self.recent.append({"unit": plan.unit_id, "kind": plan.kind,
+                            "outcome": "completed", "t": now, **attrs})
+        return attrs
+
+    def note_closed(self, plan: MigrationPlan, now: float, *,
+                    outcome: str, realized_cost_cs: float,
+                    reason: str = "") -> None:
+        """An aborted or abandoned migration: realized cost is real
+        money, savings are zero — the net gauge carries the hit (the
+        budget guard's job is keeping that hit bounded)."""
+        self._true_up(plan, realized_cost_cs, now)
+        self.totals[outcome] = self.totals.get(outcome, 0) + 1
+        self.totals["net_cs"] -= realized_cost_cs
+        self._inc(f"repack_migrations_{outcome}")
+        self.set_gauge("repack_net_chip_seconds_saved",
+                    round(self.totals["net_cs"], 3))
+        self.recent.append({"unit": plan.unit_id, "kind": plan.kind,
+                            "outcome": outcome, "t": now,
+                            "migration_cost_chip_seconds":
+                                round(realized_cost_cs, 3),
+                            "reason": reason})
+
+    # -- introspection ----------------------------------------------------
+
+    def debug_state(self) -> dict[str, Any]:
+        """The ``/debugz/repack`` body's repacker half (the Reconciler
+        adds the live in-flight table).  Bounded-retry copy: the
+        /debugz thread reads while the reconcile thread mutates."""
+        import dataclasses
+
+        for _ in range(5):
+            try:
+                return {
+                    "config": dataclasses.asdict(self.config),
+                    "totals": dict(self.totals),
+                    "budget": {
+                        "window_seconds":
+                            self.config.budget_window_seconds,
+                        "budget_chip_seconds":
+                            self.config.budget_chip_seconds,
+                        "events": [[t, round(w, 3)] for t, w
+                                   in list(self._budget_events)],
+                    },
+                    "recent": list(self.recent),
+                    "last_rejections": list(self._last_rejections),
+                }
+            except RuntimeError:  # mutated mid-copy; retry
+                continue
+        return {"unavailable": "mutating"}

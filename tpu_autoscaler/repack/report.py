@@ -1,0 +1,84 @@
+"""`tpu-autoscaler repack-report`: render the repacker's books.
+
+Input is ``Controller.repack_route()``'s body — fetched live from
+``/debugz/repack`` or read from an incident bundle's ``repack``
+section.  Pure formatting over dict inputs (CLI wiring in main.py),
+exactly like cost/report.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+
+def _fmt_cs(cs: float) -> str:
+    if abs(cs) >= 3600.0:
+        return f"{cs / 3600.0:.1f} chip-h"
+    return f"{cs:.0f} chip-s"
+
+
+def render_repack(body: Mapping[str, Any]) -> str:
+    """The operator view: totals, the rolling budget, in-flight
+    migrations, recent closes with their attribution, and why the
+    last pass's candidates were turned down."""
+    lines: list[str] = []
+    totals = body.get("totals", {})
+    lines.append(
+        f"REPACK REPORT  (migrations: {totals.get('started', 0)} "
+        f"started, {totals.get('completed', 0)} completed, "
+        f"{totals.get('aborted', 0)} aborted, "
+        f"{totals.get('abandoned', 0)} abandoned, "
+        f"{totals.get('misfires', 0)} misfires)")
+    lines.append(
+        f"  net savings:   {_fmt_cs(totals.get('net_cs', 0.0))}  "
+        f"(~${totals.get('saved_usd', 0.0):.2f} proxy saved, "
+        f"{_fmt_cs(totals.get('realized_cost_cs', 0.0))} migration "
+        f"cost)")
+    budget = body.get("budget", {})
+    if budget:
+        spent = sum(w for _t, w in budget.get("events", ()))
+        lines.append(
+            f"  rolling budget: {_fmt_cs(spent)} committed of "
+            f"{_fmt_cs(budget.get('budget_chip_seconds', 0.0))} per "
+            f"{budget.get('window_seconds', 0):g}s window")
+    active = body.get("active", [])
+    lines.append("")
+    if active:
+        lines.append("in flight:")
+        for m in active:
+            lines.append(
+                f"  {m.get('unit', '?'):<28} {m.get('kind', '?'):<10} "
+                f"-> {m.get('target_shape', '?'):<10} "
+                f"started t={m.get('started', 0):g}  "
+                f"cost so far {_fmt_cs(m.get('realized_cost_cs', 0.0))}"
+                f"  projected saving "
+                f"{_fmt_cs(m.get('projected_saving_cs', 0.0))}")
+    else:
+        lines.append("in flight: (none)")
+    recent = body.get("recent", [])
+    if recent:
+        lines.append("")
+        lines.append("recent closes (newest last):")
+        for m in recent[-10:]:
+            attribution = ""
+            if m.get("outcome") == "completed":
+                attribution = (
+                    f"  saved {_fmt_cs(m.get('chip_seconds_saved', 0.0))}"
+                    f" / ~${m.get('dollar_proxy_saved', 0.0):.2f}, cost "
+                    f"{_fmt_cs(m.get('migration_cost_chip_seconds', 0.0))}")
+            elif m.get("reason"):
+                attribution = f"  ({m['reason']})"
+            lines.append(
+                f"  t={m.get('t', 0):<8g} {m.get('unit', '?'):<28} "
+                f"{m.get('kind', '?'):<10} {m.get('outcome', '?'):<10}"
+                f"{attribution}")
+    rejections = body.get("last_rejections", [])
+    if rejections:
+        lines.append("")
+        lines.append("last pass's rejections:")
+        for r in rejections[:10]:
+            lines.append(f"  {r}")
+    if body.get("disabled"):
+        lines.append("")
+        lines.append("(repacker disabled: --repack not set)")
+    return "\n".join(lines)

@@ -345,6 +345,14 @@ class ServingMetricsAdapter:
                     s[_N_GAUGE + _C_PREEMPTED])))
         return out
 
+    def burning_pools(self, floor: float = 0.95) -> set[str]:
+        """Pools whose live SLO attainment sits below ``floor`` — the
+        repacker's do-not-touch list (ISSUE 12): a pool already
+        missing its SLO needs its replicas where they are; a drain
+        for cost savings would turn a burn into an outage."""
+        return {pool for pool, sig in self.signals().items()
+                if sig.slo_attainment < floor}
+
     def fleet_summary(self) -> dict[str, Any]:
         """O(pools) serving census for the cost surfaces (ISSUE 11):
         ``/debugz/cost`` and the cost-report CLI show the serving

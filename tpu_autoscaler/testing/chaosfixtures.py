@@ -103,11 +103,43 @@ GANG_SPLIT_BACKFILL = ChaosRegression(
     description="recreated member of a broken slice planned solo; gang "
                 "runs split across slices")
 
+
+def _disable_budget_guard(run: _Run) -> None:
+    """Pre-fix emulation for REPACK_GUARDLESS_LOSS: no in-flight
+    budget guard and no misfire accounting — the original design gap.
+    A spot_dry racing the migration leaves the drain running; the
+    advisory replacement then provisions FRESH on-demand supply, the
+    gang lands on it, and the migration completes silently
+    net-negative instead of aborting the moment its destination
+    vanished (ISSUE 12, docs/REPACK.md "The savings guarantee")."""
+    run.controller._guard_repacks = lambda *a, **k: None
+    repacker = run.controller.repacker
+    orig_inc = repacker._inc
+    repacker._inc = lambda name, by=1.0: (
+        None if name == "repack_misfires" else orig_inc(name, by))
+
+
+#: A repack migration whose destination spot slice vanishes mid-drain
+#: must ABORT (budget guard: projected savings collapse to zero), not
+#: complete onto freshly-provisioned expensive supply.  Seed 15's
+#: program races spot_dry into the migration window: the shipped code
+#: aborts (repack_migrations_aborted >= 1, zero violations); with the
+#: guard + misfire accounting sabotaged away, the run completes a
+#: silent net-negative migration and the never-net-negative invariant
+#: catches it.
+REPACK_GUARDLESS_LOSS = ChaosRegression(
+    name="repack-guardless-loss", seed=15, profile="repack",
+    invariant="repack-never-net-negative",
+    description="destination spot slice dries up mid-migration; "
+                "without the budget guard the migration completes "
+                "net-negative on fresh on-demand supply, silently")
+
 SABOTAGE = {
     LATE_PROVISION_SPAN.name: _lose_dispatch_roots,
     ORPHANED_PARTIAL_SLICE.name: _disable_orphan_reclaim,
     GANG_SPLIT_BACKFILL.name: _disable_repair_deferral,
+    REPACK_GUARDLESS_LOSS.name: _disable_budget_guard,
 }
 
 ALL_REGRESSIONS = (LATE_PROVISION_SPAN, ORPHANED_PARTIAL_SLICE,
-                   GANG_SPLIT_BACKFILL)
+                   GANG_SPLIT_BACKFILL, REPACK_GUARDLESS_LOSS)
