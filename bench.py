@@ -1680,6 +1680,421 @@ def check_repack(seed: int = 0) -> tuple[bool, dict]:
     return ok, result
 
 
+# Sharded full-loop tier (ISSUE 13, docs/SHARDING.md): full
+# ``reconcile_once`` passes/sec at the million-pod tier, sharded
+# (--reconcile-shards 8) vs serial (0, the oracle), with decision
+# parity asserted in-bench — the sharded plan must be byte-identical
+# to the serial plan over the same observed world.  The fleet is 8
+# (accelerator class, pool) partitions of pinned demand plus a CPU
+# majority (real fleets are mostly CPU pods); the serial pass's
+# superlinear terms (free-slice matching per gang, the maintain claim
+# scan per unit) are what partitioning collapses.  Results merge into
+# BENCH_SHARD.json; the north-star overhead budget is re-checked with
+# sharding ON.
+LOOP_PODS = 200_000          # CI runs --pods 1000000 --nodes 100000
+LOOP_NODES = 20_000
+LOOP_SHARDS = 8
+LOOP_SPEEDUP_FLOOR = 2.0
+LOOP_PASSES = 2             # measured passes after one warmup (the
+                            # serial oracle pays ~40 s/pass at 1M)
+LOOP_GANGS_PER_POOL = 384    # pending gangs per (class, pool)
+LOOP_FREE_PER_POOL = 128     # idle slices per (class, pool)
+_LOOP_SHAPES = ("v5p-16", "v5e-16", "v6e-16", "v4-16")  # all 4-host
+
+
+def _loop_world(n_pods: int, n_nodes: int):
+    """Payload generators for the loop tier's fleet.
+
+    Returns (node_payloads_iter, pod_payloads_iter, meta).  80% of
+    nodes are TPU hosts in 8 pools (4 accelerator classes x 2 pools,
+    4-host slices; the first LOOP_FREE_PER_POOL slices of each pool
+    idle, the rest hosting one running pod per host), 20% are CPU
+    nodes padded with running CPU pods up to ``n_pods``; pending
+    demand is LOOP_GANGS_PER_POOL 4-pod gangs per pool, pinned to
+    their (accelerator, pool).
+    """
+    from tpu_autoscaler.topology.catalog import (
+        ACCELERATOR_LABEL,
+        POOL_LABEL,
+        SLICE_ID_LABEL,
+        TOPOLOGY_LABEL,
+        shape_by_name,
+    )
+
+    shapes = [shape_by_name(s) for s in _LOOP_SHAPES]
+    pools = [(f"lp{i}", shapes[i % len(shapes)]) for i in range(8)]
+    tpu_nodes_total = (n_nodes * 4 // 5) // (8 * 4) * (8 * 4)
+    per_pool_nodes = tpu_nodes_total // 8
+    slices_per_pool = per_pool_nodes // 4
+    free_per_pool = min(LOOP_FREE_PER_POOL, slices_per_pool // 2)
+    cpu_nodes = n_nodes - tpu_nodes_total
+
+    def tpu_node(pool, shape, s, h, rv=1):
+        name = f"tpu-{pool}-s{s}-h{h}"
+        return {
+            "metadata": {
+                "name": name, "uid": f"uid-{name}",
+                "resourceVersion": str(rv),
+                "labels": {
+                    ACCELERATOR_LABEL: shape.accelerator_type,
+                    TOPOLOGY_LABEL: shape.topology_label,
+                    "node.kubernetes.io/instance-type":
+                        shape.machine_type,
+                    SLICE_ID_LABEL: f"{pool}-s{s}",
+                    POOL_LABEL: pool,
+                },
+                "creationTimestamp": "2026-01-01T00:00:00Z",
+            },
+            "spec": {"taints": [{"key": "google.com/tpu",
+                                 "value": "present",
+                                 "effect": "NoSchedule"}]},
+            "status": {
+                "allocatable": {"cpu": "208", "memory": "400Gi",
+                                "pods": "110",
+                                "google.com/tpu":
+                                    str(shape.chips_per_host)},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+
+    def cpu_node(i, rv=1):
+        return {
+            "metadata": {
+                "name": f"cpu-{i}", "uid": f"uid-cpu-{i}",
+                "resourceVersion": str(rv),
+                "labels": {"node.kubernetes.io/instance-type":
+                           "e2-standard-32"},
+                "creationTimestamp": "2026-01-01T00:00:00Z",
+            },
+            "spec": {},
+            "status": {
+                "allocatable": {"cpu": "32", "memory": "128Gi",
+                                "pods": "110"},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+
+    def running_pod(name, node, ns, job, resources, rv=1,
+                    tolerate_tpu=False):
+        spec = {
+            "nodeName": node,
+            "containers": [{"name": "m",
+                            "resources": {"requests": resources}}],
+        }
+        if tolerate_tpu:
+            spec["tolerations"] = [{"key": "google.com/tpu",
+                                    "operator": "Exists",
+                                    "effect": "NoSchedule"}]
+        return {
+            "metadata": {"name": name, "namespace": ns,
+                         "uid": f"uid-{ns}-{name}",
+                         "resourceVersion": str(rv),
+                         "labels": {"batch.kubernetes.io/job-name": job},
+                         "creationTimestamp": "2026-01-01T00:00:00Z",
+                         "ownerReferences": [{"kind": "Job",
+                                              "name": job}]},
+            "spec": spec,
+            "status": {"phase": "Running"},
+        }
+
+    def pending_pod(pool, shape, g, m, rv=1):
+        name = f"pend-{pool}-g{g}-m{m}"
+        job = f"job-{pool}-g{g}"
+        return {
+            "metadata": {"name": name, "namespace": "default",
+                         "uid": f"uid-{name}",
+                         "resourceVersion": str(rv),
+                         "labels": {"batch.kubernetes.io/job-name": job},
+                         "creationTimestamp": "2026-01-01T00:00:00Z",
+                         "ownerReferences": [{"kind": "Job",
+                                              "name": job}]},
+            "spec": {
+                "nodeSelector": {ACCELERATOR_LABEL:
+                                 shape.accelerator_type,
+                                 POOL_LABEL: pool},
+                "tolerations": [{"key": "google.com/tpu",
+                                 "operator": "Exists",
+                                 "effect": "NoSchedule"}],
+                "containers": [{"name": "m", "resources": {"requests": {
+                    "cpu": "1", "memory": "1Gi",
+                    "google.com/tpu": str(shape.chips_per_host)}}}],
+            },
+            "status": {"phase": "Pending",
+                       "conditions": [{"type": "PodScheduled",
+                                       "status": "False",
+                                       "reason": "Unschedulable"}]},
+        }
+
+    def nodes_iter():
+        for pool, shape in pools:
+            for s in range(slices_per_pool):
+                for h in range(4):
+                    yield tpu_node(pool, shape, s, h)
+        for i in range(cpu_nodes):
+            yield cpu_node(i)
+
+    n_pending = 8 * LOOP_GANGS_PER_POOL * 4
+    n_tpu_running = 8 * (slices_per_pool - free_per_pool) * 4
+    n_cpu_pods = max(0, n_pods - n_tpu_running - n_pending)
+
+    def pods_iter():
+        for pool, shape in pools:
+            for s in range(free_per_pool, slices_per_pool):
+                for h in range(4):
+                    yield running_pod(
+                        f"tp-{pool}-s{s}-h{h}", f"tpu-{pool}-s{s}-h{h}",
+                        "tpu-jobs", f"tjob-{pool}-{s}",
+                        {"cpu": "2", "memory": "4Gi",
+                         "google.com/tpu": str(shape.chips_per_host)},
+                        tolerate_tpu=True)
+        for i in range(n_cpu_pods):
+            yield running_pod(f"cp-{i}", f"cpu-{i % max(1, cpu_nodes)}",
+                              f"ns-{i % 20}", f"cjob-{i // 8}",
+                              {"cpu": "1", "memory": "2Gi"})
+        for pool, shape in pools:
+            for g in range(LOOP_GANGS_PER_POOL):
+                for m in range(4):
+                    yield pending_pod(pool, shape, g, m)
+
+    meta = {"tpu_nodes": tpu_nodes_total, "cpu_nodes": cpu_nodes,
+            "pods": n_tpu_running + n_cpu_pods + n_pending,
+            "pending_gangs": 8 * LOOP_GANGS_PER_POOL,
+            "free_slices": 8 * free_per_pool}
+    return nodes_iter, pods_iter, meta
+
+
+class _LoopClient:
+    """Client stub for the loop tier: the informer caches are pre-
+    seeded, so ANY list call means a path under measurement silently
+    fell back — counted and asserted zero."""
+
+    def __init__(self):
+        self.lists = 0
+
+    def list_pods(self):
+        self.lists += 1
+        return []
+
+    def list_nodes(self):
+        self.lists += 1
+        return []
+
+    def patch_node(self, *a, **kw):
+        pass
+
+    def patch_pod(self, *a, **kw):
+        pass
+
+    def create_event(self, *a, **kw):
+        pass
+
+
+class _LoopActuator:
+    """Discarding actuator: provisions are acknowledged and dropped —
+    the tier measures the planning/maintain loop, and a constant
+    demand set re-plans identically every pass in both modes."""
+
+    def __init__(self):
+        self.provisions = 0
+        self.log = []
+
+    def poll(self, now):
+        pass
+
+    def statuses(self):
+        return []
+
+    def provision(self, request):
+        import types
+
+        self.provisions += 1
+        self.log.append((request.shape_name, request.gang_key,
+                         request.count))
+        return types.SimpleNamespace(
+            id=f"loop-{self.provisions}", request=request,
+            unit_ids=(), state="ACCEPTED", in_flight=True)
+
+    def cancel(self, provision_id):
+        pass
+
+    def delete(self, unit_id):
+        pass
+
+
+def _loop_controller(shards: int, informer):
+    from tpu_autoscaler.controller import Controller, ControllerConfig
+    from tpu_autoscaler.engine.planner import PoolPolicy
+
+    config = ControllerConfig(
+        policy=PoolPolicy(spare_nodes=0, max_total_chips=10**9),
+        reconcile_shards=shards,
+        # Delta planning off: the tier measures FULL planning each
+        # pass (the delta layer is PR 6's orthogonal win, and a
+        # static world would otherwise plan zero gangs after pass 1).
+        delta_planning=False,
+        idle_threshold_seconds=1e12, grace_seconds=1e12,
+        provision_timeout_seconds=1e12,
+        unhealthy_timeout_seconds=1e12)
+    client = _LoopClient()
+    controller = Controller(client, _LoopActuator(), config,
+                            informer=informer)
+    return controller, client
+
+
+def bench_loop(n_pods: int = LOOP_PODS, n_nodes: int = LOOP_NODES,
+               shards: int = LOOP_SHARDS,
+               passes: int = LOOP_PASSES) -> dict:
+    """Full reconcile passes/sec, sharded vs serial, one shared world.
+
+    Both controllers read the SAME pre-seeded informer caches (the
+    world is static; the actuator discards, so every pass replans the
+    same demand).  Decision parity is asserted in-bench: the sharded
+    planner's output over the observed snapshot must be byte-identical
+    to the serial planner's, and the sharded pass must actually have
+    run sharded (a silent serial fallback would fake the ratio).
+    Also audits the 1M-tier memory contract (ISSUE 13 satellite): the
+    parse memos hold their ratcheted bound and the informer's index
+    buckets stay O(store).
+    """
+    from tpu_autoscaler.k8s import objects as k8s_objects
+    from tpu_autoscaler.k8s.gangs import group_into_gangs
+    from tpu_autoscaler.k8s.informer import ClusterInformer
+    from tpu_autoscaler.k8s.objects import clear_parse_caches
+
+    clear_parse_caches()
+    nodes_iter, pods_iter, meta = _loop_world(n_pods, n_nodes)
+    informer_client = _LoopClient()
+    informer = ClusterInformer(informer_client)
+    # Streamed replace: nothing materialized before the caches.
+    informer.pod_cache.replace(pods_iter(), "1")
+    informer.node_cache.replace(nodes_iter(), "1")
+
+    # -- memory-contract audit (the reserve_parse_cache ratchet and
+    # index sizing were tuned at 100k; pin them at this tier) --------
+    store = len(informer.pod_cache)
+    limit = k8s_objects._parse_limits["pods"]
+    assert limit >= 2 * store, (limit, store)
+    assert len(k8s_objects._pod_cache) <= limit
+    index_entries = sum(
+        len(bucket)
+        for index in informer.pod_cache._indices.values()
+        for bucket in index.values())
+    # Each pod lands in at most one bucket per index (4 pod indexes).
+    assert index_entries <= len(informer.pod_cache._indexers) * store, (
+        index_entries, store)
+
+    results = {}
+    parity = None
+    for mode_shards in (0, shards):
+        controller, client = _loop_controller(mode_shards, informer)
+        best = float("inf")
+        for p in range(passes + 1):
+            t0 = time.perf_counter()
+            controller.reconcile_once(now=60.0 * (p + 1))
+            dt = time.perf_counter() - t0
+            if p > 0:  # first pass warms tracker/trace state
+                best = min(best, dt)
+        # BOTH clients: the controller's own, and the one the informer
+        # would LIST through if a cache ever went unsynced mid-bench
+        # (review-found: the latter was unasserted, so a fallback to
+        # an empty world would have silently zeroed the measurement).
+        assert client.lists == 0, "a measured path fell back to LIST"
+        assert informer_client.lists == 0, \
+            "the informer fell back to LIST mid-bench"
+        if mode_shards:
+            nodes, pods, pending = controller._observe()
+            gangs = group_into_gangs(pending)
+            serial_plan = controller.planner.plan(gangs, nodes, pods, [])
+            shard_plan = controller.sharder.plan(
+                gangs, nodes, pods, [],
+                candidate_accels=controller._candidate_accels)
+            assert controller.sharder.last_info.get("mode") \
+                == "sharded", controller.sharder.last_info
+            parity = {
+                "requests_equal":
+                    serial_plan.requests == shard_plan.requests,
+                "unsatisfiable_equal":
+                    [(g.key, r) for g, r in serial_plan.unsatisfiable]
+                    == [(g.key, r) for g, r in shard_plan.unsatisfiable],
+                "requests": len(serial_plan.requests),
+                "sharding": dict(controller.sharder.last_info),
+            }
+        snap = controller.metrics.snapshot()
+        results[mode_shards] = {
+            "pass_s": best,
+            "passes_per_sec": round(1.0 / best, 3),
+            "shard_errors": snap["counters"].get("shard_errors", 0),
+            "merge_conflicts": snap["counters"].get(
+                "shard_merge_conflicts", 0),
+        }
+        controller.close()
+    clear_parse_caches()
+
+    serial_s = results[0]["pass_s"]
+    sharded_s = results[shards]["pass_s"]
+    mismatches = 0 if (parity and parity["requests_equal"]
+                       and parity["unsatisfiable_equal"]) else 1
+    return {
+        "info": "loop", **meta,
+        "requested_pods": n_pods, "requested_nodes": n_nodes,
+        "shards": shards,
+        "serial_pass_ms": round(serial_s * 1e3, 1),
+        "sharded_pass_ms": round(sharded_s * 1e3, 1),
+        "serial_passes_per_sec": results[0]["passes_per_sec"],
+        "sharded_passes_per_sec": results[shards]["passes_per_sec"],
+        "speedup": round(serial_s / sharded_s, 2) if sharded_s else None,
+        "decision_mismatches": mismatches,
+        "shard_errors": results[shards]["shard_errors"],
+        "merge_conflicts": results[shards]["merge_conflicts"],
+        "parity": parity,
+        "floor": LOOP_SPEEDUP_FLOOR,
+    }
+
+
+def check_loop(n_pods: int, n_nodes: int, shards: int = LOOP_SHARDS,
+               floor: float = LOOP_SPEEDUP_FLOOR) -> tuple[bool, dict]:
+    """Gate: sharded full-loop passes/sec >= ``floor`` x serial at the
+    requested tier with ZERO decision mismatches, shard errors and
+    merge conflicts, AND the north-star overhead budget still green
+    with sharding ON.  Records BENCH_SHARD.json."""
+    info = bench_loop(n_pods, n_nodes, shards=shards)
+    info["floor"] = floor
+    print(json.dumps(info), file=sys.stderr)
+    ok = ((info.get("speedup") or 0) >= floor
+          and info["decision_mismatches"] == 0
+          and info["shard_errors"] == 0
+          and info["merge_conflicts"] == 0)
+    if not ok:
+        print(json.dumps({"error": "sharded loop regression: speedup "
+                          "below floor or parity broken", **info}),
+              file=sys.stderr)
+    # North-star budget with sharding ON (prod knobs: the small-pass
+    # cutoff is part of the feature) — warm once, best of 3.
+    run_north_star(config_extra={"reconcile_shards": shards})
+    ns = [run_north_star(config_extra={"reconcile_shards": shards})
+          for _ in range(3)]
+    ns_cpu = min(r["cpu_s"] for r in ns)
+    ns_ok = ns_cpu <= OVERHEAD_BUDGET_S \
+        and all(r["stranded"] == 0 for r in ns)
+    print(json.dumps({"info": "north_star_sharded",
+                      "cpu_s": round(ns_cpu, 4),
+                      "budget_s": OVERHEAD_BUDGET_S,
+                      "ok": ns_ok}), file=sys.stderr)
+    info["north_star_sharded_cpu_s"] = round(ns_cpu, 4)
+    info["north_star_sharded_ok"] = ns_ok
+    _record_tier("BENCH_SHARD.json", "loop", {
+        "pods": info["pods"], "nodes": info["tpu_nodes"]
+        + info["cpu_nodes"], "shards": shards,
+        "serial_pass_ms": info["serial_pass_ms"],
+        "sharded_pass_ms": info["sharded_pass_ms"],
+        "speedup": info["speedup"], "floor": floor,
+        "decision_mismatches": info["decision_mismatches"],
+        "merge_conflicts": info["merge_conflicts"],
+        "north_star_sharded_cpu_s": info["north_star_sharded_cpu_s"],
+    })
+    return ok and ns_ok, info
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -1700,6 +2115,29 @@ def main(argv: list[str] | None = None) -> int:
             args.pods or OBSERVE_SCALE_PODS,
             args.nodes or OBSERVE_SCALE_NODES,
             floor=args.floor) else 1
+    if argv and argv[0] == "loop":
+        # Sharded full-loop tier (ISSUE 13, scripts/full_suite.sh +
+        # ci_gate.sh): full reconcile passes/sec sharded vs serial at
+        # the million-pod tier, decision parity asserted in-bench,
+        # north-star overhead budget re-checked with sharding ON;
+        # records BENCH_SHARD.json.
+        ap = argparse.ArgumentParser(prog="bench.py loop")
+        ap.add_argument("--pods", type=int, default=LOOP_PODS)
+        ap.add_argument("--nodes", type=int, default=LOOP_NODES)
+        ap.add_argument("--shards", type=int, default=LOOP_SHARDS)
+        ap.add_argument("--floor", type=float,
+                        default=LOOP_SPEEDUP_FLOOR)
+        args = ap.parse_args(argv[1:])
+        ok, info = check_loop(args.pods, args.nodes,
+                              shards=args.shards, floor=args.floor)
+        print(json.dumps({
+            "metric": "sharded_loop_speedup",
+            "value": info.get("speedup"),
+            "unit": "x_vs_serial",
+            "vs_baseline": round((info.get("speedup") or 0)
+                                 / args.floor, 2),
+        }))
+        return 0 if ok else 1
     if argv and argv[0] == "fit_batch":
         # Large-batch fit tier (ISSUE 6): python/kernel decision parity
         # + speedup floor at --gangs scale; records BENCH_SCALE.json.

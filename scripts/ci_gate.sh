@@ -45,6 +45,15 @@
 #      reclamation, destination stockouts and mid-drain gang deletes,
 #      with the never-net-negative-savings + guard-capped-abort
 #      invariants — runs in the chaos stage above, exit 7.)
+#   13 sharded reconcile tier (ISSUE 13, docs/SHARDING.md):
+#      bench.py observe at the 1M-pod/100k-node tier (>= 20x), then
+#      bench.py loop — full reconcile passes/sec sharded (8) vs
+#      serial (the oracle), >= 2x with ZERO decision mismatches
+#      (byte-identical plans asserted in-bench), the parse-memo/
+#      index-sizing audit, and the north-star overhead budget green
+#      with sharding ON; BENCH_SHARD.json.  The mixed + repair
+#      corpora re-run with --reconcile-shards 4 in the chaos stage
+#      above (exit 7).
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -54,26 +63,26 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/11] invariant analysis (--format=$fmt)"
+echo "== [1/12] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/11] mypy strict islands"
+echo "== [2/12] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/11] deterministic-schedule race tier"
+echo "== [3/12] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
 
-echo "== [4/11] tracer-overhead gate"
+echo "== [4/12] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [5/11] mega-cluster scale tiers"
+echo "== [5/12] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [6/11] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
+echo "== [6/12] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -102,20 +111,41 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 # asserted at terminal (docs/REPACK.md).
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repack || exit 7
+# Sharded corpora (ISSUE 13, docs/SHARDING.md): the mixed and repair
+# corpora re-run with the sharded planner attached (shard_min_gangs=0
+# so every pass exercises fan-out/merge) — the full step/terminal
+# invariant catalog must hold unchanged, because sharded plans are
+# byte-identical to serial by the merge contract.
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 480 --reconcile-shards 4 || exit 7
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 400 --profile repair --reconcile-shards 4 \
+    || exit 7
 
-echo "== [7/11] policy replay tier"
+echo "== [7/12] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [8/11] serving tier (adapter hot path + outcome replay)"
+echo "== [8/12] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
-echo "== [9/11] obs tier (TSDB ingest + alert evaluation)"
+echo "== [9/12] obs tier (TSDB ingest + alert evaluation)"
 JAX_PLATFORMS=cpu python bench.py obs || exit 10
 
-echo "== [10/11] cost tier (attribution ledger pass cost + conservation)"
+echo "== [10/12] cost tier (attribution ledger pass cost + conservation)"
 JAX_PLATFORMS=cpu python bench.py cost || exit 11
 
-echo "== [11/11] repack tier (week-long churn replay, never-worse gate)"
+echo "== [11/12] repack tier (week-long churn replay, never-worse gate)"
 JAX_PLATFORMS=cpu python bench.py repack || exit 12
+
+echo "== [12/12] sharded reconcile tier (million-pod loop + observe)"
+# ISSUE 13 (docs/SHARDING.md): the 1M-pod observe tier (indexed reads
+# must hold the 20x floor at 10x the PR-6 scale), then the full-loop
+# tier — sharded reconcile >= 2x serial passes/sec at 8 shards with
+# ZERO decision mismatches (byte-identical plans asserted in-bench),
+# the memory-contract audit (parse-memo ratchet, index sizing), and
+# the north-star overhead budget re-checked with sharding ON.
+# Records BENCH_SHARD.json.
+JAX_PLATFORMS=cpu python bench.py observe --pods 1000000 --nodes 100000 --floor 20 || exit 13
+JAX_PLATFORMS=cpu python bench.py loop --pods 1000000 --nodes 100000 || exit 13
 
 echo "CI GATE GREEN"

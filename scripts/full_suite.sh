@@ -75,6 +75,14 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 # guard-capped abort cost at terminal (docs/REPACK.md, CHAOS.md).
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repack
+# Sharded corpora (ISSUE 13, docs/SHARDING.md): mixed + repair re-run
+# with the sharded planner attached (every pass exercises the
+# fan-out/merge path); the invariant catalog must hold unchanged —
+# sharded plans are byte-identical to serial by the merge contract.
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 480 --reconcile-shards 4
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 400 --profile repair --reconcile-shards 4
 
 # Policy replay tier (ISSUE 8): the recurring north-star trace must
 # show prewarmed detect->running <= 0.25x the reactive baseline, and a
@@ -118,6 +126,14 @@ JAX_PLATFORMS=cpu python bench.py cost
 # migration, north-star budget green with the repacker ON; results
 # merge into BENCH_REPACK.json (docs/REPACK.md).
 JAX_PLATFORMS=cpu python bench.py repack
+
+# Sharded reconcile tier (ISSUE 13, docs/SHARDING.md): the 1M-pod
+# observe tier, then full reconcile passes/sec sharded vs serial at
+# the million-pod tier — >= 2x at 8 shards, byte-identical plans
+# asserted in-bench, parse-memo/index-sizing audit, north-star
+# budget green with sharding ON; results merge into BENCH_SHARD.json.
+JAX_PLATFORMS=cpu python bench.py observe --pods 1000000 --nodes 100000 --floor 20
+JAX_PLATFORMS=cpu python bench.py loop --pods 1000000 --nodes 100000
 
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py

@@ -17,7 +17,9 @@ from tpu_autoscaler.testing.chaosfixtures import (
     LATE_PROVISION_SPAN,
     ORPHANED_PARTIAL_SLICE,
     REPACK_GUARDLESS_LOSS,
+    REPAIR_FOREIGN_SLICE_BIND,
     SABOTAGE,
+    SHARD_DOUBLE_MERGE,
 )
 
 
@@ -172,7 +174,9 @@ class TestPromotedRegressions:
     @pytest.mark.parametrize("fixture", [LATE_PROVISION_SPAN,
                                          ORPHANED_PARTIAL_SLICE,
                                          GANG_SPLIT_BACKFILL,
-                                         REPACK_GUARDLESS_LOSS],
+                                         REPACK_GUARDLESS_LOSS,
+                                         SHARD_DOUBLE_MERGE,
+                                         REPAIR_FOREIGN_SLICE_BIND],
                              ids=lambda f: f.name)
     def test_sabotaged_run_is_caught_by_the_invariant(self, fixture):
         result = fixture.run(sabotage=SABOTAGE[fixture.name])

@@ -327,3 +327,73 @@ class TestParseCacheSizing:
         info = parse_cache_info()
         assert info["misses"] == 1 and info["hits"] == 2
         assert info["hit_rate"] == pytest.approx(2 / 3)
+
+
+class TestMillionPodSizingContract:
+    """Directed regressions for the ISSUE 13 memory audit (the
+    in-bench twin runs at the real 1M tier in ``bench.py loop``):
+    allocation under churn must stay O(store), never accrete."""
+
+    def test_churn_never_accretes_index_entries(self):
+        """K modifications of the same pods must leave bucket totals
+        exactly where one pass left them — a leak here is the
+        superlinear allocation the 1M-pod audit exists to catch."""
+        cache = make_pod_cache()
+        cache.replace([pod_payload(i, 1, node=f"n-{i % 4}")
+                       for i in range(50)], "1")
+
+        def entry_total() -> int:
+            with cache._lock:
+                return sum(len(bucket)
+                           for index in cache._indices.values()
+                           for bucket in index.values())
+
+        baseline = entry_total()
+        rv = 2
+        for round_ in range(6):
+            for i in range(50):
+                cache.apply({"type": "MODIFIED",
+                             "object": pod_payload(i, rv,
+                                                   node=f"n-{i % 4}")})
+                rv += 1
+            assert entry_total() == baseline, f"round {round_} leaked"
+
+    def test_parse_memo_holds_its_bound_under_version_churn(self):
+        """Churning more distinct (uid, rv) versions than the limit
+        must evict, not grow — the memo is bounded by the ratchet."""
+        from tpu_autoscaler.k8s import objects as k8s_objects
+        from tpu_autoscaler.k8s.objects import parse_pod
+
+        limit = parse_cache_info()["pods_limit"]
+        for rv in range(1, 4):
+            for i in range(limit // 2):
+                parse_pod(pod_payload(i, rv))
+        assert len(k8s_objects._pod_cache) <= limit
+
+    def test_store_digest_matches_fresh_rebuild(self):
+        """The O(1) incremental store digest equals a from-scratch
+        rebuild over the same content, through churn and deletes —
+        and differs while the content differs."""
+        rng = random.Random(13)
+        live = {i: 1 for i in range(30)}
+        cache = make_pod_cache()
+        cache.replace([pod_payload(i, rv) for i, rv in live.items()],
+                      "1")
+        rv_seq = 2
+        for _ in range(40):
+            i = rng.randrange(40)
+            if i in live and rng.random() < 0.3:
+                cache.apply({"type": "DELETED",
+                             "object": pod_payload(i, live.pop(i))})
+            else:
+                live[i] = rv_seq
+                cache.apply({"type": "MODIFIED",
+                             "object": pod_payload(i, rv_seq)})
+                rv_seq += 1
+            fresh = make_pod_cache()
+            fresh.replace([pod_payload(i, rv)
+                           for i, rv in live.items()], "x")
+            assert cache.store_digest == fresh.store_digest
+        stale = make_pod_cache()
+        stale.replace([pod_payload(0, 999_999)], "y")
+        assert cache.store_digest != stale.store_digest

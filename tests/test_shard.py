@@ -1,0 +1,592 @@
+"""Sharded reconcile tests (ISSUE 13, docs/SHARDING.md).
+
+The contract under test: ``--reconcile-shards N`` produces
+BYTE-IDENTICAL plans and behavior to the serial oracle
+(``--reconcile-shards 0``) — across seeded churn scenarios, CPU
+all-or-none placement, global-clamp merge conflicts (resolved by a
+deterministic serial re-plan), and crash-only worker failure — while
+the fan-out/merge edge survives the DeterministicScheduler's
+interleaving sweep.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.controller import shard as shard_mod
+from tpu_autoscaler.controller.shard import ShardedPlanner
+from tpu_autoscaler.engine.planner import Planner, PoolPolicy
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.k8s.gangs import Gang, group_into_gangs
+from tpu_autoscaler.k8s.informer import ClusterInformer
+from tpu_autoscaler.k8s.objects import Pod, clear_parse_caches
+from tpu_autoscaler.metrics.metrics import Metrics
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    POOL_LABEL,
+    SLICE_ID_LABEL,
+    TOPOLOGY_LABEL,
+    shape_by_name,
+)
+
+ACCELS = {
+    "tpu-v5p-slice": "v5p-16",
+    "tpu-v5-lite-podslice": "v5e-16",
+    "tpu-v6e-slice": "v6e-16",
+    "tpu-v4-podslice": "v4-16",
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_parse_caches():
+    clear_parse_caches()
+    yield
+    clear_parse_caches()
+
+
+def tpu_pod(name: str, job: str, chips: int = 4, ns: str = "default",
+            accel: str | None = None, pool: str | None = None,
+            phase: str = "Pending", node: str | None = None) -> dict:
+    selectors = {}
+    if accel:
+        selectors[ACCELERATOR_LABEL] = accel
+    if pool:
+        selectors[POOL_LABEL] = pool
+    status: dict = {"phase": phase}
+    if phase == "Pending" and node is None:
+        status["conditions"] = [{"type": "PodScheduled",
+                                 "status": "False",
+                                 "reason": "Unschedulable"}]
+    spec: dict = {
+        "nodeSelector": selectors,
+        "tolerations": [{"key": "google.com/tpu", "operator": "Exists",
+                         "effect": "NoSchedule"}],
+        "containers": [{"name": "m", "resources": {
+            "requests": {"cpu": "1", "memory": "1Gi",
+                         "google.com/tpu": str(chips)}}}],
+    }
+    if node is not None:
+        spec["nodeName"] = node
+    return {
+        "metadata": {"name": name, "namespace": ns,
+                     "labels": {"batch.kubernetes.io/job-name": job},
+                     "creationTimestamp": "2026-01-01T00:00:00Z",
+                     "ownerReferences": [{"kind": "Job", "name": job}]},
+        "spec": spec,
+        "status": status,
+    }
+
+
+def cpu_pod(name: str, job: str, cpu: str = "2") -> dict:
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "labels": {"batch.kubernetes.io/job-name": job},
+                     "creationTimestamp": "2026-01-01T00:00:00Z",
+                     "ownerReferences": [{"kind": "Job", "name": job}]},
+        "spec": {"containers": [{"name": "m", "resources": {
+            "requests": {"cpu": cpu, "memory": "1Gi"}}}]},
+        "status": {"phase": "Pending",
+                   "conditions": [{"type": "PodScheduled",
+                                   "status": "False",
+                                   "reason": "Unschedulable"}]},
+    }
+
+
+def slice_nodes(shape_name: str, pool: str, idx: int) -> list[dict]:
+    shape = shape_by_name(shape_name)
+    out = []
+    for h in range(shape.hosts):
+        name = f"n-{pool}-{shape_name}-{idx}-h{h}"
+        out.append({
+            "metadata": {
+                "name": name, "uid": f"uid-{name}",
+                "resourceVersion": "1",
+                "labels": {
+                    ACCELERATOR_LABEL: shape.accelerator_type,
+                    TOPOLOGY_LABEL: shape.topology_label,
+                    SLICE_ID_LABEL: f"{pool}-{shape_name}-{idx}",
+                    POOL_LABEL: pool,
+                    "node.kubernetes.io/instance-type":
+                        shape.machine_type,
+                },
+                "creationTimestamp": "2026-01-01T00:00:00Z",
+            },
+            "spec": {"taints": [{"key": "google.com/tpu",
+                                 "value": "present",
+                                 "effect": "NoSchedule"}]},
+            "status": {
+                "allocatable": {"cpu": "208", "memory": "400Gi",
+                                "pods": "110",
+                                "google.com/tpu":
+                                    str(shape.chips_per_host)},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        })
+    return out
+
+
+def build(shards: int, policy: PoolPolicy | None = None,
+          config_kw: dict | None = None):
+    kube = FakeKube()
+    metrics = Metrics()
+    informer = ClusterInformer(kube, metrics=metrics, timeout_seconds=0)
+    actuator = FakeActuator(kube, provision_delay=0.0)
+    cfg = ControllerConfig(
+        policy=policy or PoolPolicy(spare_nodes=0),
+        reconcile_shards=shards, shard_min_gangs=0,
+        **(config_kw or {}))
+    controller = Controller(kube, actuator, cfg, metrics=metrics,
+                            informer=informer)
+    return kube, informer, controller
+
+
+def seeded_world(kube: FakeKube, rng: random.Random) -> None:
+    """A random mixed fleet: pinned/pooled/unpinned-class TPU demand
+    over four accelerator classes, CPU demand, free and busy slices."""
+    accels = list(ACCELS)
+    for i, (accel, shape_name) in enumerate(ACCELS.items()):
+        for pool in (f"p{i}a", f"p{i}b"):
+            for s in range(rng.randrange(0, 3)):
+                for payload in slice_nodes(shape_name, pool, s):
+                    kube.add_node(payload)
+    n_gangs = rng.randrange(3, 9)
+    for g in range(n_gangs):
+        accel = rng.choice(accels)
+        i = accels.index(accel)
+        kind = rng.random()
+        pool = None
+        if kind < 0.5:
+            pool = rng.choice((f"p{i}a", f"p{i}b"))
+        pinned_accel = accel if kind < 0.85 else None
+        size = rng.choice((1, 2, 4))
+        for m in range(size):
+            kube.add_pod(tpu_pod(f"g{g}-m{m}", f"job-{g}", chips=4,
+                                 accel=pinned_accel, pool=pool))
+    for c in range(rng.randrange(0, 4)):
+        kube.add_pod(cpu_pod(f"c{c}-p0", f"cjob-{c}"))
+
+
+def drive(controller, kube, passes=3, now0=0.0):
+    """Run passes with scheduler steps; return the comparable story."""
+    log = []
+    now = now0
+    for _ in range(passes):
+        controller.reconcile_once(now=now)
+        kube.schedule_step()
+        now += 30.0
+    provisions = [(s.request.shape_name, s.request.gang_key,
+                   s.request.gang_keys, s.request.count)
+                  for s in controller.actuator.statuses()]
+    events = [[(e.get("subject"), e.get("decision"), e.get("reason"))
+               for e in p["events"]]
+              for p in controller.recorder.dump()["passes"]]
+    digests = [p["inputs"]["digest"]
+               for p in controller.recorder.dump()["passes"]]
+    nodes = sorted(n["metadata"]["name"] for n in kube.list_nodes())
+    log.append((provisions, events, digests, nodes))
+    return log
+
+
+class TestSeededParity:
+    """Sharded runs are byte-identical to serial across seeded
+    churn scenarios — provisions, explain events, pass digests, and
+    the resulting fleet all match, pass for pass."""
+
+    def test_twin_controllers_match_across_seeds(self):
+        for seed in range(8):
+            stories = {}
+            for shards in (0, 4):
+                clear_parse_caches()
+                kube, informer, controller = build(shards)
+                seeded_world(kube, random.Random(seed))
+                informer.pump()
+                stories[shards] = drive(controller, kube)
+                assert controller.metrics.snapshot()["counters"].get(
+                    "shard_errors", 0) == 0
+                controller.close()
+            assert stories[0] == stories[4], f"seed {seed} diverged"
+
+    def test_plan_level_parity_with_churn(self):
+        """Direct plan comparison over evolving worlds: every pass's
+        sharded plan (requests, unsatisfiable, deferred) equals the
+        serial planner's over the same snapshot."""
+        for seed in range(6):
+            clear_parse_caches()
+            kube, informer, controller = build(4)
+            rng = random.Random(1000 + seed)
+            seeded_world(kube, rng)
+            for step in range(3):
+                informer.pump()
+                nodes, pods, pending = controller._observe()
+                gangs = group_into_gangs(pending)
+                serial = controller.planner.plan(gangs, nodes, pods, [])
+                sharded = controller.sharder.plan(
+                    gangs, nodes, pods, [],
+                    candidate_accels=controller._candidate_accels)
+                assert serial.requests == sharded.requests
+                assert [(g.key, r) for g, r in serial.unsatisfiable] \
+                    == [(g.key, r) for g, r in sharded.unsatisfiable]
+                assert [(g.key, r) for g, r in serial.deferred] \
+                    == [(g.key, r) for g, r in sharded.deferred]
+                # Churn: a new gang arrives, an old pod vanishes.
+                kube.add_pod(tpu_pod(f"late{step}-m0", f"late-{step}",
+                                     accel=rng.choice(list(ACCELS))))
+                if pending:
+                    kube.delete_pod(pending[0].namespace,
+                                    pending[0].name)
+            controller.close()
+
+
+class TestCpuAllOrNone:
+    def test_cpu_demand_and_nodes_share_one_shard(self):
+        kube, informer, controller = build(4)
+        for c in range(5):
+            kube.add_pod(cpu_pod(f"c{c}-p0", f"cjob-{c}"))
+        for g, accel in enumerate(ACCELS):
+            kube.add_pod(tpu_pod(f"g{g}-m0", f"job-{g}", accel=accel))
+        informer.pump()
+        nodes, pods, pending = controller._observe()
+        gangs = group_into_gangs(pending)
+        part = shard_mod.partition(
+            gangs, (), nodes, controller.config.policy,
+            controller._candidate_accels, 4)
+        cpu_buckets = {part.bucket_of_gang[g.key] for g in gangs
+                       if not g.requests_tpu}
+        assert cpu_buckets == {part.cpu_bucket}
+        serial = controller.planner.plan(gangs, nodes, pods, [])
+        sharded = controller.sharder.plan(
+            gangs, nodes, pods, [],
+            candidate_accels=controller._candidate_accels)
+        assert serial.requests == sharded.requests
+        assert controller.sharder.last_info["mode"] == "sharded"
+        controller.close()
+
+    def test_unpinned_gang_unions_all_tpu_classes(self):
+        """An unpinned gang could bind ANY admitting free slice, so it
+        must land in a component containing every TPU class present —
+        sharding degrades toward serial, never mis-partitions."""
+        kube, informer, controller = build(4)
+        for i, (accel, shape_name) in enumerate(ACCELS.items()):
+            for payload in slice_nodes(shape_name, f"pool{i}", 0):
+                kube.add_node(payload)
+        kube.add_pod(tpu_pod("u-m0", "unpinned-job", accel=None))
+        kube.add_pod(tpu_pod("p-m0", "pinned-job",
+                             accel="tpu-v5p-slice"))
+        informer.pump()
+        nodes, pods, pending = controller._observe()
+        gangs = group_into_gangs(pending)
+        part = shard_mod.partition(
+            gangs, (), nodes, controller.config.policy,
+            controller._candidate_accels, 4)
+        unpinned = next(g for g in gangs if "unpinned" in g.key[2])
+        b = part.bucket_of_gang[unpinned.key]
+        tpu_parts = [k for k in part.bucket_of_part
+                     if k != shard_mod.CPU_PART]
+        assert all(part.bucket_of_part[k] == b for k in tpu_parts)
+        controller.close()
+
+
+class TestMergeConflicts:
+    def test_clamp_conflict_resolves_serially_and_deterministically(
+            self):
+        """Two classes' plans together exceed max_total_chips: the
+        merge must detect the cross-shard global, fall back to the
+        serial plan (identical output), count the conflict — and do
+        the same thing every time."""
+        policy = PoolPolicy(spare_nodes=0, max_total_chips=16)
+        kube, informer, controller = build(4, policy=policy)
+        for m in range(4):  # 16 chips each: together they bust the clamp
+            kube.add_pod(tpu_pod(f"a-m{m}", "job-a",
+                                 accel="tpu-v5p-slice"))
+            kube.add_pod(tpu_pod(f"b-m{m}", "job-b",
+                                 accel="tpu-v6e-slice"))
+        informer.pump()
+        nodes, pods, pending = controller._observe()
+        gangs = group_into_gangs(pending)
+        serial_planner = Planner(policy)
+        serial = serial_planner.plan(gangs, nodes, pods, [])
+        plans = [controller.sharder.plan(
+            gangs, nodes, pods, [],
+            candidate_accels=controller._candidate_accels)
+            for _ in range(3)]
+        for sharded in plans:
+            assert serial.requests == sharded.requests
+            assert [(g.key, r) for g, r in serial.unsatisfiable] \
+                == [(g.key, r) for g, r in sharded.unsatisfiable]
+        assert controller.sharder.last_info["why"] == "merge_conflict"
+        assert controller.metrics.snapshot()["counters"][
+            "shard_merge_conflicts"] >= 3
+        controller.close()
+
+    def test_advisory_parity_and_clamp_deferral(self):
+        """Advisory (prewarm-shaped) demand plans byte-identically;
+        when the clamp defers it, the sharded path conflicts into the
+        serial plan — deferred entries included."""
+        for max_chips in (10_000, 16):
+            clear_parse_caches()
+            policy = PoolPolicy(spare_nodes=0, max_total_chips=max_chips)
+            kube, informer, controller = build(4, policy=policy)
+            kube.add_pod(tpu_pod("a-m0", "job-a",
+                                 accel="tpu-v5p-slice"))
+            informer.pump()
+            nodes, pods, pending = controller._observe()
+            gangs = group_into_gangs(pending)
+            probe = Pod(tpu_pod("pw-m0", "prewarm-x", chips=16))
+            advisory = [(Gang(key=("prewarm", "default", "x"),
+                              pods=[probe]), "v5e-16")]
+            serial = Planner(policy).plan(gangs, nodes, pods, [],
+                                          advisory_gangs=advisory)
+            sharded = controller.sharder.plan(
+                gangs, nodes, pods, [], advisory_gangs=advisory,
+                candidate_accels=controller._candidate_accels)
+            assert serial.requests == sharded.requests
+            assert [(g.key, r) for g, r in serial.deferred] \
+                == [(g.key, r) for g, r in sharded.deferred]
+            controller.close()
+
+
+class TestCrashOnly:
+    def test_worker_crash_degrades_to_serial(self, monkeypatch):
+        kube, informer, controller = build(4)
+        for g, accel in enumerate(ACCELS):
+            kube.add_pod(tpu_pod(f"g{g}-m0", f"job-{g}", accel=accel))
+        informer.pump()
+        nodes, pods, pending = controller._observe()
+        gangs = group_into_gangs(pending)
+        serial = controller.planner.plan(gangs, nodes, pods, [])
+
+        real = shard_mod._plan_shard
+        calls = {"n": 0}
+
+        def flaky(work):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("chaos: shard worker died")
+            return real(work)
+
+        monkeypatch.setattr(shard_mod, "_plan_shard", flaky)
+        sharded = controller.sharder.plan(
+            gangs, nodes, pods, [],
+            candidate_accels=controller._candidate_accels)
+        assert serial.requests == sharded.requests
+        assert controller.sharder.last_info["why"] == "shard_error"
+        assert controller.metrics.snapshot()["counters"][
+            "shard_errors"] == 1
+        controller.close()
+
+    def test_whole_pass_survives_worker_crash(self, monkeypatch):
+        """reconcile_once completes and provisions identically when a
+        shard dies mid-pass (crash-only at the controller level)."""
+        def boom(work):
+            raise RuntimeError("chaos: worker died")
+
+        stories = {}
+        for shards in (0, 4):
+            clear_parse_caches()
+            kube, informer, controller = build(shards)
+            for g, accel in enumerate(ACCELS):
+                kube.add_pod(tpu_pod(f"g{g}-m0", f"job-{g}",
+                                     accel=accel))
+            informer.pump()
+            if shards:
+                monkeypatch.setattr(shard_mod, "_plan_shard", boom)
+            controller.reconcile_once(now=0.0)
+            stories[shards] = [
+                (s.request.shape_name, s.request.gang_key)
+                for s in controller.actuator.statuses()]
+            controller.close()
+        assert stories[0] == stories[4]
+
+
+class TestDispatcher:
+    def test_small_pass_plans_serially(self):
+        kube, informer, controller = build(4, config_kw=None)
+        controller.config.shard_min_gangs = 16
+        controller.sharder.min_gangs = 16
+        kube.add_pod(tpu_pod("g0-m0", "job-0", accel="tpu-v5p-slice"))
+        informer.pump()
+        controller.reconcile_once(now=0.0)
+        assert controller.sharder.last_info == {
+            "mode": "serial", "why": "small_pass"}
+        assert controller.metrics.snapshot()["counters"][
+            "shard_serial_fallbacks"] == 1
+        controller.close()
+
+    def test_fair_share_and_quota_serialize(self):
+        for policy in (PoolPolicy(spare_nodes=0, fair_share=True),
+                       PoolPolicy(spare_nodes=0,
+                                  namespace_chip_quota={"default": 64})):
+            clear_parse_caches()
+            kube, informer, controller = build(4, policy=policy)
+            kube.add_pod(tpu_pod("g0-m0", "job-0",
+                                 accel="tpu-v5p-slice"))
+            informer.pump()
+            nodes, pods, pending = controller._observe()
+            gangs = group_into_gangs(pending)
+            serial = controller.planner.plan(gangs, nodes, pods, [])
+            sharded = controller.sharder.plan(
+                gangs, nodes, pods, [],
+                candidate_accels=controller._candidate_accels)
+            assert serial.requests == sharded.requests
+            assert sharded is not None
+            assert controller.sharder.last_info["mode"] == "serial"
+            assert controller.sharder.last_info["why"] in (
+                "fair_share", "namespace_quota")
+            controller.close()
+
+    def test_pass_record_carries_sharding_section(self):
+        kube, informer, controller = build(4)
+        for g, accel in enumerate(ACCELS):
+            kube.add_pod(tpu_pod(f"g{g}-m0", f"job-{g}", accel=accel))
+        informer.pump()
+        controller.reconcile_once(now=0.0)
+        info = controller.recorder.dump()["passes"][-1]["planning"]
+        assert info["sharding"]["mode"] == "sharded"
+        assert sum(info["sharding"]["items"]) == len(ACCELS)
+        snap = controller.metrics.snapshot()
+        assert snap["gauges"]["shard_count"] >= 1
+        assert snap["gauges"]["shard_balance"] == 1.0
+        controller.close()
+
+
+class TestClaimedByPending:
+    def test_sharded_claim_scan_matches_serial(self):
+        from tpu_autoscaler.k8s.units import group_supply_units
+
+        for seed in range(6):
+            clear_parse_caches()
+            kube, informer, controller = build(4)
+            seeded_world(kube, random.Random(2000 + seed))
+            informer.pump()
+            nodes, pods, pending = controller._observe()
+            gangs = group_into_gangs(pending)
+            units = group_supply_units(nodes)
+            serial = shard_mod.claimed_by_pending(units, gangs, pods)
+            sharded = controller.sharder.claimed_by_pending(
+                units, gangs, pods,
+                candidate_accels=controller._candidate_accels)
+            assert serial == sharded
+            controller.close()
+
+
+class TestSectionPrefixes:
+    """Pins the planner-reason ↔ merge-section coupling: if a reason
+    string is reworded, THIS fails (loudly) instead of the merge
+    silently conflicting every pass."""
+
+    def test_every_section_classified(self):
+        kube, informer, controller = build(0, policy=PoolPolicy(
+            spare_nodes=1, spare_slices={"v5e-16": 1}))
+        kube.add_pod(tpu_pod("g0-m0", "job-0", accel="tpu-v5p-slice"))
+        kube.add_pod(cpu_pod("c0-p0", "cjob-0"))
+        informer.pump()
+        nodes, pods, pending = controller._observe()
+        gangs = group_into_gangs(pending)
+        probe = Pod(tpu_pod("pw-m0", "prewarm-x", chips=16))
+        advisory = [(Gang(key=("prewarm", "default", "x"),
+                          pods=[probe]), "v6e-16")]
+        plan = controller.planner.plan(gangs, nodes, pods, [],
+                                       advisory_gangs=advisory)
+        sections = {shard_mod._section_of(r.reason)
+                    for r in plan.requests if r.kind != "cpu-node"}
+        assert sections == {"organic", "advisory", "spare"}
+        assert any(r.kind == "cpu-node" for r in plan.requests)
+        assert shard_mod._section_of("something new") == "unknown"
+        controller.close()
+
+
+@pytest.mark.race
+class TestShardSchedules:
+    """The fan-out/merge edge under the DeterministicScheduler: the
+    worker pool is adopted by the scheduler, and the merged plan must
+    be identical to serial under EVERY interleaving (the vector-clock
+    checker watches the real concurrency seam underneath)."""
+
+    def test_identical_plan_under_interleavings(self):
+        from tpu_autoscaler.testing.sched import run_schedule
+
+        clear_parse_caches()
+        kube = FakeKube()
+        for g, accel in enumerate(ACCELS):
+            kube.add_pod(tpu_pod(f"g{g}-m0", f"job-{g}", accel=accel))
+        for payload in slice_nodes("v5p-16", "pool0", 0):
+            kube.add_node(payload)
+        informer = ClusterInformer(kube, timeout_seconds=0)
+        informer.pump()
+        nodes = informer.nodes()
+        pods, pending = informer.pods_and_pending()
+        gangs = group_into_gangs(pending)
+        policy = PoolPolicy(spare_nodes=0)
+        serial = Planner(policy).plan(gangs, nodes, pods, [])
+        results = []
+
+        def candidate_accels(gang):
+            pin = gang.node_selectors.get(ACCELERATOR_LABEL)
+            return (pin,) if pin else tuple(ACCELS)
+
+        def scenario(sched) -> None:
+            sharder = ShardedPlanner(4, Planner(policy), min_gangs=0)
+            try:
+                results.append(sharder.plan(
+                    gangs, nodes, pods, [],
+                    candidate_accels=candidate_accels))
+            finally:
+                sharder.close()
+
+        for seed in range(4):
+            run_schedule(scenario, seed=seed, max_steps=500_000)
+        assert len(results) == 4
+        for plan in results:
+            assert plan.requests == serial.requests
+
+
+class TestMultisliceMergeOrder:
+    """Review-found: serial creates a cohort at its first UNMATCHED
+    member, so a multislice group whose first member matched a free
+    slice emits AFTER a solo gang that sits between the members in
+    the gang list — the merge must reproduce that order (or conflict
+    into the serial oracle), never anchor the group at its first
+    member."""
+
+    @staticmethod
+    def jobset_pod(name: str, jobset: str, idx: str,
+                   accel: str) -> dict:
+        payload = tpu_pod(name, f"{jobset}-{idx}", chips=4, accel=accel)
+        payload["metadata"]["labels"] = {
+            "jobset.sigs.k8s.io/jobset-name": jobset,
+            "jobset.sigs.k8s.io/job-index": idx,
+            "batch.kubernetes.io/job-name": f"{jobset}-{idx}",
+        }
+        return payload
+
+    def test_matched_first_member_keeps_serial_order(self):
+        kube, informer, controller = build(4)
+        # Free v5p-16 slice: the jobset's FIRST member matches it.
+        for payload in slice_nodes("v5p-16", "poolA", 0):
+            kube.add_node(payload)
+        for m in range(4):
+            kube.add_pod(self.jobset_pod(f"ms0-m{m}", "msjob", "0",
+                                         "tpu-v5p-slice"))
+        # A solo gang of a DIFFERENT class lands between the members
+        # in gang order (group_into_gangs preserves pod order).
+        kube.add_pod(tpu_pod("solo-m0", "solo-job",
+                             accel="tpu-v6e-slice"))
+        for m in range(4):
+            kube.add_pod(self.jobset_pod(f"ms1-m{m}", "msjob", "1",
+                                         "tpu-v5p-slice"))
+        informer.pump()
+        nodes, pods, pending = controller._observe()
+        gangs = group_into_gangs(pending)
+        assert any(g.multislice_group_key for g in gangs)
+        serial = controller.planner.plan(gangs, nodes, pods, [])
+        sharded = controller.sharder.plan(
+            gangs, nodes, pods, [],
+            candidate_accels=controller._candidate_accels)
+        assert serial.requests == sharded.requests
+        assert [(g.key, r) for g, r in serial.unsatisfiable] \
+            == [(g.key, r) for g, r in sharded.unsatisfiable]
+        controller.close()

@@ -43,6 +43,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="threadless pump (fast) or the "
                              "DeterministicScheduler with real watch "
                              "threads (interleaving sweep)")
+    parser.add_argument("--reconcile-shards", type=int, default=0,
+                        dest="reconcile_shards",
+                        help="attach the ISSUE 13 sharded planner "
+                             "(shard_min_gangs=0 so every pass "
+                             "exercises fan-out/merge; 0 = the "
+                             "serial oracle)")
     parser.add_argument("--budget", type=float, default=600.0,
                         help="corpus wall-clock budget seconds "
                              "(default 600; exit 3 when blown)")
@@ -60,7 +66,8 @@ def main(argv: list[str] | None = None) -> int:
         print(program.describe())
         for event in program.events:
             print(f"  t={event.t:7.1f}  {event.kind}  {event.args}")
-        result = run_scenario(program, drive=args.drive)
+        result = run_scenario(program, drive=args.drive,
+                              reconcile_shards=args.reconcile_shards)
         print(result.describe())
         return 0 if result.ok else 2
 
@@ -72,7 +79,7 @@ def main(argv: list[str] | None = None) -> int:
 
     results, budget_blown = run_corpus(
         seeds, profile=args.profile, budget_seconds=args.budget,
-        progress=progress)
+        progress=progress, reconcile_shards=args.reconcile_shards)
     failures = [r for r in results if not r.ok]
     converged = sum(1 for r in results if r.converged_at is not None)
     repairs = sum(r.repairs for r in results)

@@ -380,7 +380,8 @@ def _serving_scaler(program: ScenarioProgram):
 
 
 def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
-           informer) -> tuple[Controller, FakeActuator]:
+           informer, reconcile_shards: int = 0
+           ) -> tuple[Controller, FakeActuator]:
     import random
 
     actuator = FakeActuator(
@@ -390,6 +391,12 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
     controller = Controller(
         kube_for_controller, actuator,
         ControllerConfig(
+            # ISSUE 13: the corpus re-runs with the sharded planner
+            # attached (shard_min_gangs=0 so even 1-gang passes
+            # exercise the fan-out/merge path); the invariant catalog
+            # must hold unchanged — sharded plans are byte-identical
+            # to serial by contract.
+            reconcile_shards=reconcile_shards, shard_min_gangs=0,
             policy=PoolPolicy(spare_nodes=0,
                               max_total_chips=program.max_total_chips,
                               # ISSUE 11: spot-tier seeds provision
@@ -424,7 +431,8 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
 class _Run:
     """One scenario execution (pump mode)."""
 
-    def __init__(self, program: ScenarioProgram):
+    def __init__(self, program: ScenarioProgram,
+                 reconcile_shards: int = 0):
         from tpu_autoscaler.k8s.objects import clear_parse_caches
 
         # Hermetic seeds: every FakeKube restarts uids/resourceVersions
@@ -440,7 +448,8 @@ class _Run:
 
             self.informer = ClusterInformer(self.proxy, timeout_seconds=0)
         self.controller, self.actuator = _build(
-            program, self.proxy, self.kube, self.informer)
+            program, self.proxy, self.kube, self.informer,
+            reconcile_shards=reconcile_shards)
         self.monitor = InvariantMonitor(program.seed, self.kube,
                                         self.controller)
         # ISSUE 9: serving-profile scenarios drive a fuzzed replica
@@ -830,6 +839,14 @@ class _Run:
                 f"went unsurfaced")
 
     def execute(self) -> ChaosResult:
+        # A sharded controller owns a worker pool; a 200-seed corpus
+        # building one controller per seed must not leak its threads.
+        try:
+            return self._execute()
+        finally:
+            self.controller.close()
+
+    def _execute(self) -> ChaosResult:
         t0 = _time.perf_counter()
         program = self.program
         pending_events = list(program.events)
@@ -900,18 +917,23 @@ class _Run:
 
 
 def run_scenario(program_or_seed, *, profile: str = "mixed",
-                 drive: str = "pump", schedules: int = 3) -> ChaosResult:
+                 drive: str = "pump", schedules: int = 3,
+                 reconcile_shards: int = 0) -> ChaosResult:
     """Execute one scenario program (or generate it from a seed).
 
     ``drive="sched"`` replays the same program under the deterministic
     scheduler with real informer watch threads, sweeping ``schedules``
     seeded interleavings; the LAST interleaving's result is returned
     with any earlier violation carried over.
+
+    ``reconcile_shards`` attaches the ISSUE 13 sharded planner to the
+    controller (0 = the serial oracle); the invariant catalog is
+    asserted unchanged either way.
     """
     program = (generate(program_or_seed, profile=profile)
                if isinstance(program_or_seed, int) else program_or_seed)
     if drive == "pump":
-        return _Run(program).execute()
+        return _Run(program, reconcile_shards=reconcile_shards).execute()
     if drive != "sched":
         raise ValueError(f"unknown drive mode {drive!r}")
     from tpu_autoscaler.testing.sched import run_schedule
@@ -922,7 +944,8 @@ def run_scenario(program_or_seed, *, profile: str = "mixed",
         # Threaded twin of _Run: the normal constructor (informer
         # forced on — interleaving coverage is the point), then live
         # watch threads instead of the pump drive.
-        run = _Run(dataclasses.replace(program, informer=True))
+        run = _Run(dataclasses.replace(program, informer=True),
+                   reconcile_shards=reconcile_shards)
         run.informer.start()
         # Threads pump the caches; _step still calls pump() — with live
         # watches that is a no-op-ish double drain, so drop it.
@@ -944,7 +967,8 @@ def run_scenario(program_or_seed, *, profile: str = "mixed",
 
 def run_corpus(seeds, *, profile: str = "mixed",
                budget_seconds: float | None = None,
-               progress=None) -> tuple[list[ChaosResult], bool]:
+               progress=None,
+               reconcile_shards: int = 0) -> tuple[list[ChaosResult], bool]:
     """Run many seeds; returns (results, budget_blown).  Stops early —
     with the flag set — if the wall-clock budget runs out before the
     corpus completes, so CI fails loudly instead of hanging."""
@@ -954,7 +978,8 @@ def run_corpus(seeds, *, profile: str = "mixed",
         if budget_seconds is not None \
                 and _time.perf_counter() - t0 > budget_seconds:
             return results, True
-        result = run_scenario(seed, profile=profile)
+        result = run_scenario(seed, profile=profile,
+                              reconcile_shards=reconcile_shards)
         results.append(result)
         if progress is not None:
             progress(result)

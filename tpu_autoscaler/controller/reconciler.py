@@ -27,7 +27,6 @@ from tpu_autoscaler.actuators.base import (
     in_flight_of,
 )
 from tpu_autoscaler.cost import CostLedger
-from tpu_autoscaler.engine.fitter import free_capacity
 from tpu_autoscaler.engine.planner import InFlight, Planner, PoolPolicy
 from tpu_autoscaler.k8s.client import KubeClient
 from tpu_autoscaler.k8s.gangs import Gang, group_into_gangs
@@ -141,6 +140,16 @@ class ControllerConfig:
     # parity gate in tests keeps the incremental path byte-identical
     # to full planning on the seeded scenarios.
     verify_delta_plans: bool = False
+    # Sharded reconcile planning (ISSUE 13, docs/SHARDING.md):
+    # partition plan + the maintenance claim scan by accelerator
+    # class/pool across a capped worker pool, merged back on the
+    # reconcile thread with byte-identical output.  0 = serial, the
+    # oracle every sharded pass is provably identical to.  Auto-
+    # serial per pass under fair_share/namespace quotas (cross-shard
+    # admission order is load-bearing there) and below
+    # shard_min_gangs (partition overhead must not tax small passes).
+    reconcile_shards: int = 0
+    shard_min_gangs: int = 16
     # Cost attribution ledger (ISSUE 11, docs/COST.md): the price book
     # pricing the $-proxy rollups; None = the built-in catalog-derived
     # book.  The ledger itself is always on — it rides the _maintain
@@ -247,6 +256,11 @@ class Controller:
         # Sticky staleness guard (_observe): node names a direct LIST
         # saw that the informer's node cache has not delivered yet.
         self._nodes_awaiting_cache: set[str] = set()
+        # The store digests captured BESIDE the last _observe's cache
+        # snapshots (the O(1) pass-digest path; None = the pass
+        # observed via bypass/LIST and the legacy frozenset hash over
+        # the observed lists applies).
+        self._observed_digest: int | None = None
         # Sticky supply guard (_update_supply_guard): provisions that
         # went ACTIVE but whose supply units have not REGISTERED as
         # nodes yet.  The informer guard above closes the cache-lag
@@ -264,6 +278,22 @@ class Controller:
         if hasattr(client, "set_metrics"):
             client.set_metrics(self.metrics)
         self.planner = Planner(self.config.policy)
+        # Sharded planning (ISSUE 13): the fan-out/merge driver, used
+        # only from the reconcile thread; workers see frozen inputs
+        # and the serial planner above stays the byte-identity oracle.
+        # shard_balance/shard_count are exported from startup (1.0 =
+        # "a serial loop is balanced") so the shard-imbalance alert
+        # rule reads a defined series in every mode.
+        self.sharder = None
+        if self.config.reconcile_shards > 0:
+            from tpu_autoscaler.controller.shard import ShardedPlanner
+
+            self.sharder = ShardedPlanner(
+                self.config.reconcile_shards, self.planner,
+                metrics=self.metrics,
+                min_gangs=self.config.shard_min_gangs)
+        self.metrics.set_gauge("shard_balance", 1.0)
+        self.metrics.set_gauge("shard_count", 0)
         self.tracker = SliceTracker()
         for name in PHASE_LATENCY_METRICS:
             self.metrics.declare_histogram(name, LATENCY_BUCKETS)
@@ -605,11 +635,27 @@ class Controller:
         # ledgers: now that digests are load-bearing for delta-driven
         # planning, "unchanged" must never span a node drain, a
         # provision state change, or a guard release/expiry.
-        digest = (hash(frozenset((p.uid, p.phase, p.node_name or "")
-                                 for p in pods))
-                  ^ hash(frozenset(
-                      (n.name, n.resource_version or "", n.is_ready,
-                       n.unschedulable) for n in nodes))
+        # World half of the digest: the informer's O(1) incremental
+        # store digests when this pass observed straight off synced
+        # caches (every pod/node change bumps an rv, so (key, rv)
+        # XORs are strictly MORE change-sensitive than the legacy
+        # field tuples) — the O(pods) frozenset walk was a measurable
+        # slice of the million-pod pass (ISSUE 13).  Captured by
+        # _observe BESIDE the snapshots (live watch threads advance
+        # the caches mid-pass; a record-time read could describe the
+        # NEXT pass's world).  Any bypass/LIST observation keeps the
+        # legacy hash: the cache digest would not describe what the
+        # pass actually saw.
+        if self._observed_digest is not None:
+            world_digest = self._observed_digest
+        else:
+            world_digest = (
+                hash(frozenset((p.uid, p.phase, p.node_name or "")
+                               for p in pods))
+                ^ hash(frozenset(
+                    (n.name, n.resource_version or "", n.is_ready,
+                     n.unschedulable) for n in nodes)))
+        digest = (world_digest
                   ^ hash(frozenset((s.id, s.state)
                                    for s in self.actuator.statuses()))
                   ^ hash(frozenset(
@@ -670,6 +716,7 @@ class Controller:
         direct LIST sees (nodes the cache has EXTRA are fine: deletion
         lag only defers reclaim by a pass).
         """
+        self._observed_digest = None
         if self.informer is None:
             pods = [Pod(p) for p in self.client.list_pods()]
             return ([Node(p) for p in self.client.list_nodes()], pods,
@@ -687,6 +734,20 @@ class Controller:
             else:
                 self._nodes_awaiting_cache = (
                     {n.name for n in nodes} - {n.name for n in snap})
+        elif hasattr(self.informer, "observe_with_digest"):
+            # The one-lock-hold-per-cache read: snapshots AND the
+            # store digests describing exactly them (watch threads
+            # keep the caches moving mid-pass, so a digest read any
+            # later could stamp this pass's record with the NEXT
+            # pass's world; review-found).  None = a cache unsynced —
+            # fall through to the LIST-fallback reads below and the
+            # legacy per-list digest.
+            obs = self.informer.observe_with_digest()
+            if obs is not None:
+                nodes, pods, pending, digest = obs
+                self._observed_digest = digest
+                return nodes, pods, pending
+            nodes = self.informer.nodes()
         else:
             nodes = self.informer.nodes()
         pods, pending = self.informer.pods_and_pending()
@@ -1978,6 +2039,15 @@ class Controller:
         self.metrics.inc("informer_bypass_lists")
         return [parse_node(p) for p in self.client.list_nodes()]
 
+    def close(self) -> None:
+        """Release process resources the controller owns (today: the
+        shard worker pool).  Idempotent; only harnesses that build
+        many controllers per process (chaos corpora, benches, tests)
+        need it — a production controller lives as long as the
+        process."""
+        if self.sharder is not None:
+            self.sharder.close()
+
     def run_forever(self, interval_seconds: float = 5.0,
                     watch: bool = True, leader_lock=None) -> None:
         """Reconcile loop (reference: main.py while True / sleep).
@@ -2077,9 +2147,21 @@ class Controller:
         overrides = self._generation_overrides(all_gangs, now)
         t_plan = time.perf_counter()
         in_flight = self._in_flight()
-        plan = self.planner.plan(gangs, nodes, pods, in_flight,
-                                 generation_overrides=overrides,
-                                 advisory_gangs=advisory)
+        if self.sharder is not None and not self.config.enable_preemption:
+            # Sharded planning (ISSUE 13): byte-identical to the
+            # serial call below by the merge contract; preemption
+            # keeps the serial path (its victim choice reads the
+            # whole unsatisfiable set, like fair_share).
+            plan = self.sharder.plan(
+                gangs, nodes, pods, in_flight,
+                generation_overrides=overrides, advisory_gangs=advisory,
+                candidate_accels=self._candidate_accels)
+            self._pass_plan_info["sharding"] = dict(
+                self.sharder.last_info)
+        else:
+            plan = self.planner.plan(gangs, nodes, pods, in_flight,
+                                     generation_overrides=overrides,
+                                     advisory_gangs=advisory)
         self._pass_plan_s = time.perf_counter() - t_plan
         for gang, reason in plan.deferred:
             # Advisory demand waiting for clamp/quota headroom:
@@ -2104,6 +2186,12 @@ class Controller:
                 self._explain("planner", "delta plan mismatch",
                               f"delta={len(plan.requests)} "
                               f"full={len(full.requests)} requests")
+        # One lookup table for the dispatch loop below: rebuilding
+        # served_gangs by scanning the gang list per request was
+        # O(requests × gangs) — a measurable slice of the million-pod
+        # pass (ISSUE 13 audit).
+        gang_by_key = {g.key: g for g in gangs}
+        gang_pos = {g.key: i for i, g in enumerate(gangs)}
         for req in plan.requests:
             # Respect retry backoff after a failed provision for the same
             # demand (gang, or shape for gang-less spare provisions).
@@ -2141,7 +2229,10 @@ class Controller:
                 # in it and must not get a misleading scale-up event).
                 member_keys = set(req.gang_keys) or {req.gang_key}
                 self._observe_detect(member_keys, now)
-                served_gangs = [g for g in gangs if g.key in member_keys]
+                served_gangs = [gang_by_key[k] for k in
+                                sorted((k for k in member_keys
+                                        if k in gang_by_key),
+                                       key=gang_pos.__getitem__)]
                 for pod in (p for g in served_gangs for p in g.pods):
                     self._emit_event(
                         pod, now, "TriggeredScaleUp",
@@ -2775,40 +2866,20 @@ class Controller:
     def _claimed_by_pending(self, units: dict[str, list[Node]],
                             pending_gangs: list[Gang],
                             pods: list[Pod]) -> set[str]:
-        """Units that currently-pending demand will bind to: NOT drainable.
+        """Units that currently-pending demand will bind to: NOT
+        drainable.  The scan itself is a pure function
+        (controller/shard.py claimed_by_pending — O(units × gangs),
+        the maintenance pass's superlinear term); with sharding on and
+        enough demand it partitions by accelerator class/pool across
+        the same worker pool as planning (ISSUE 13)."""
+        from tpu_autoscaler.controller import shard
 
-        Reference parity: the reference's state machine checked "whether
-        pending pods could use the node" before reclaiming (cluster.py
-        §ClusterNodeState).  Without this, an idle slice can be cordoned
-        in the same pass a matching gang goes Pending — the planner
-        counted it as supply, so reclaiming it both strands the gang and
-        forces a redundant provision.
-        """
-        from tpu_autoscaler.engine.planner import _slice_satisfies
-
-        claimed: set[str] = set()
-        tpu_gangs = [g for g in pending_gangs if g.requests_tpu]
-        cpu_pods = [p for g in pending_gangs if not g.requests_tpu
-                    for p in g.pods]
-        for unit_id, unit_nodes in units.items():
-            if unit_nodes[0].is_tpu:
-                if any(_slice_satisfies(unit_nodes, g) for g in tpu_gangs):
-                    claimed.add(unit_id)
-            else:
-                # Count cordoned nodes: a DRAINING unit's nodes are
-                # unschedulable by construction, and the whole point of
-                # the claim check is to cancel that drain when pending
-                # demand fits it (mirrors _slice_satisfies, which also
-                # ignores the cordon flag for TPU units).
-                free = free_capacity(unit_nodes, pods,
-                                     include_unschedulable=True)
-                if any(node.admits(p) and p.resources.fits_in(cap)
-                       for p in cpu_pods
-                       for node in unit_nodes
-                       for name, cap in free.items()
-                       if name == node.name):
-                    claimed.add(unit_id)
-        return claimed
+        if (self.sharder is not None
+                and len(pending_gangs) >= self.config.shard_min_gangs):
+            return self.sharder.claimed_by_pending(
+                units, pending_gangs, pods,
+                candidate_accels=self._candidate_accels)
+        return shard.claimed_by_pending(units, pending_gangs, pods)
 
     def _maintain(self, nodes: list[Node], pods: list[Pod],
                   now: float, pending_gangs: list[Gang] = ()) -> None:

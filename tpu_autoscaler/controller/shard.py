@@ -1,0 +1,824 @@
+"""Sharded reconcile planning: one control plane, a million pods
+(ISSUE 13, docs/SHARDING.md).
+
+PR 6 made observe O(churn); the planner's remaining cost at fleet
+scale is superlinear in demand × supply (`match_free` scans free
+slices per gang, `claimed_by_pending` scans gangs per unit).  This
+module partitions that work by **accelerator class / pool** — the
+planner's natural independence boundary (delta planning already
+dirties gangs per class) — runs each shard's pure plan on a capped
+``concurrency.pool_executor`` worker pool, and reassembles the result
+at a single merge point on the reconcile thread.
+
+The contract (the whole point — see docs/SHARDING.md for the proof
+sketch):
+
+- **Byte-identical to serial.**  ``--reconcile-shards 0`` is the
+  oracle.  A shard's clamp algebra starts from the globally-snapshotted
+  fleet totals (``extra_existing_chips``), so its admissions are a
+  superset-consistent prefix of serial's; the merge re-validates every
+  cross-shard global (max_total_chips, the kind-wide in-flight ledger)
+  and FALLS BACK TO A SERIAL PLAN the moment any shard's decision
+  could have depended on another shard's consumption
+  (``shard_merge_conflicts``).  Conflict-free merges reassemble
+  requests/unsatisfiable/deferred in exactly serial's order.
+- **CPU stays all-or-none** on one shard: CPU gangs pack into shared
+  nodes (PR 6), so they, every CPU node, and the CPU spare policy ride
+  a single shard.
+- **Workers are pure.**  A worker receives frozen inputs (its gangs,
+  nodes, pods, a per-shard ``Planner``) and returns a plan; it never
+  touches controller state, metrics, or the tracer — all mutation
+  happens at the merge point on the reconcile thread (the
+  ActuationExecutor drain discipline, one layer up).  Crash-only: a
+  worker exception degrades the pass to serial (``shard_errors``).
+
+Partitioning is a union-find over (accelerator class, pool) keys plus
+gang/group keys: a gang pinned to a class/pool stays fine-grained; an
+unpinned gang (which serial ``match_free`` may bind to ANY admitting
+free slice) conservatively unions every TPU class present, so sharding
+degrades gracefully toward serial for unpinned fleets instead of ever
+mis-partitioning one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Sequence
+
+from tpu_autoscaler import concurrency
+from tpu_autoscaler.engine.fitter import free_capacity
+from tpu_autoscaler.engine.planner import Planner, PoolPolicy, ScalePlan
+from tpu_autoscaler.k8s.gangs import Gang
+from tpu_autoscaler.k8s.objects import Node, Pod
+from tpu_autoscaler.topology.catalog import (
+    ACCELERATOR_LABEL,
+    POOL_LABEL,
+    TPU_RESOURCE,
+    shape_by_name,
+)
+
+#: The pool dimension of the partition key: the autoscaler's own pool
+#: label (k8s/objects.py ``Node.pool`` — the GKE node-pool label is
+#: NOT usable here, it doubles as slice identity).  A gang that does
+#: not pin it unions all of its class's pools into one component, so
+#: pool-level sharding is only as fine as the workloads' own
+#: placement contracts — and degrades toward per-class sharding, not
+#: toward a wrong partition.
+POOL_SELECTOR = POOL_LABEL
+
+PartKey = tuple[str, str]  # (accelerator class | "cpu", pool)
+CPU_PART: PartKey = ("cpu", "")
+
+#: Substrings of planner rejection reasons that implicate a
+#: cross-shard global.  A shard producing one means serial's verdict
+#: (or its "(at N)" message) could differ → merge conflict.
+_GLOBAL_REASONS = ("max_total_chips", "chip quota")
+
+
+def node_part(node: Node) -> PartKey:
+    """The partition a node's supply belongs to."""
+    if not node.is_tpu:
+        return CPU_PART
+    return (node.tpu_accelerator or "tpu",
+            node.labels.get(POOL_SELECTOR, ""))
+
+
+def claimed_by_pending(units: dict[str, list[Node]],
+                       pending_gangs: list[Gang],
+                       pods: list[Pod]) -> set[str]:
+    """Units that currently-pending demand will bind to: NOT drainable.
+
+    Reference parity: the reference's state machine checked "whether
+    pending pods could use the node" before reclaiming (cluster.py
+    §ClusterNodeState).  Without this, an idle slice can be cordoned
+    in the same pass a matching gang goes Pending — the planner
+    counted it as supply, so reclaiming it both strands the gang and
+    forces a redundant provision.
+
+    Pure function of its inputs (moved out of the Reconciler for
+    ISSUE 13: it is the maintenance side's superlinear term, sharded
+    by accelerator class alongside planning).
+    """
+    from tpu_autoscaler.engine.planner import _slice_satisfies
+
+    claimed: set[str] = set()
+    tpu_gangs = [g for g in pending_gangs if g.requests_tpu]
+    cpu_pods = [p for g in pending_gangs if not g.requests_tpu
+                for p in g.pods]
+    for unit_id, unit_nodes in units.items():
+        if unit_nodes[0].is_tpu:
+            if any(_slice_satisfies(unit_nodes, g) for g in tpu_gangs):
+                claimed.add(unit_id)
+        elif cpu_pods:
+            # Count cordoned nodes: a DRAINING unit's nodes are
+            # unschedulable by construction, and the whole point of
+            # the claim check is to cancel that drain when pending
+            # demand fits it (mirrors _slice_satisfies, which also
+            # ignores the cordon flag for TPU units).  With no pending
+            # CPU demand the per-unit free-capacity scan is skipped
+            # outright (1M-pod audit: it was O(cpu units × pods) of
+            # pure waste on TPU-only demand).
+            free = free_capacity(unit_nodes, pods,
+                                 include_unschedulable=True)
+            if any(node.admits(p) and p.resources.fits_in(cap)
+                   for p in cpu_pods
+                   for node in unit_nodes
+                   for name, cap in free.items()
+                   if name == node.name):
+                claimed.add(unit_id)
+    return claimed
+
+
+# ---- union-find partitioning ------------------------------------------ #
+
+
+class _UnionFind:
+    def __init__(self) -> None:
+        self._parent: dict = {}
+
+    def find(self, x):
+        parent = self._parent
+        root = x
+        while parent.setdefault(root, root) != root:
+            root = parent[root]
+        while parent[x] != root:  # path compression
+            parent[x], x = root, parent[x]
+        return root
+
+    def union(self, a, b) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self._parent[rb] = ra
+
+
+@dataclasses.dataclass
+class _Partition:
+    """One pass's partition: which shard (bucket) owns which keys."""
+
+    n_buckets: int
+    bucket_of_part: dict[PartKey, int]
+    bucket_of_gang: dict[tuple, int]          # gang key -> bucket
+    cpu_bucket: int
+    #: gang key -> index in the input gang list: the serial planner's
+    #: emission anchor for the byte-identical merge (a cohort is
+    #: created at its first UNMATCHED member, so a request's min
+    #: member index IS its serial emission position; the multi-entry-
+    #: per-group case where that breaks down conflicts into the
+    #: serial oracle instead — see _merge).
+    order: dict[tuple, int]
+    #: gang key -> multislice group key (None for solos), for the
+    #: merge's one-entry-per-group check.
+    group_of: dict[tuple, tuple | None]
+
+    def bucket_of_node(self, node: Node) -> int | None:
+        return self.bucket_of_part.get(node_part(node))
+
+
+def _candidate_parts(gang: Gang, accels_present: Sequence[str],
+                     pools_by_accel: dict[str, list[PartKey]],
+                     candidate_accels: Callable[[Gang], tuple[str, ...]]
+                     ) -> list[PartKey]:
+    """Every partition whose nodes this gang could match or bind.
+
+    Conservative over-approximation (the digest rule, applied to
+    partitioning): missing a partition the serial planner could have
+    matched would silently change the plan, so an unpinned gang takes
+    every TPU class present — serial ``match_free`` admission is
+    selector-based and an unpinned pod admits any tolerated slice.
+    """
+    if not gang.requests_tpu:
+        return [CPU_PART]
+    selectors = gang.node_selectors
+    accel_pin = selectors.get(ACCELERATOR_LABEL)
+    pool_pin = selectors.get(POOL_SELECTOR)
+    if accel_pin is not None:
+        accels: list[str] = [accel_pin]
+    else:
+        accels = list(accels_present)
+        for a in candidate_accels(gang):
+            if a not in accels:
+                accels.append(a)
+    parts: list[PartKey] = []
+    for a in accels:
+        if pool_pin is not None:
+            parts.append((a, pool_pin))
+        else:
+            parts.extend(pools_by_accel.get(a) or [(a, "")])
+    return parts or [("tpu", "")]
+
+
+def partition(gangs: list[Gang],
+              advisory: Sequence[tuple[Gang, str]],
+              nodes: list[Node],
+              policy: PoolPolicy,
+              candidate_accels: Callable[[Gang], tuple[str, ...]],
+              n_shards: int) -> _Partition:
+    """Group (accel class, pool) keys into components and assign them
+    to at most ``n_shards`` buckets, deterministically.
+
+    Components are the transitive closure of "could interact through
+    supply": each gang unions its candidate partitions (plus its gang
+    and multislice-group keys, so advisory demand and cohort siblings
+    co-locate with their organic twins); each advisory entry unions
+    its exact replacement shape's class; each spare-slice shape unions
+    its class's pools (the spare scan ranges over them).  CPU is one
+    partition by construction.
+    """
+    uf = _UnionFind()
+    parts_present: list[PartKey] = []
+    seen: set[PartKey] = set()
+    for n in nodes:
+        key = node_part(n)
+        if key not in seen:
+            seen.add(key)
+            parts_present.append(key)
+    uf.find(CPU_PART)
+    if CPU_PART not in seen:
+        seen.add(CPU_PART)
+        parts_present.append(CPU_PART)
+    accels_present: list[str] = []
+    pools_by_accel: dict[str, list[PartKey]] = {}
+    for key in parts_present:
+        accel = key[0]
+        if key == CPU_PART:
+            continue
+        if accel not in pools_by_accel:
+            pools_by_accel[accel] = []
+            accels_present.append(accel)
+        pools_by_accel[accel].append(key)
+        # All pools of one class start independent; gangs/spares that
+        # range over the class union them below.
+        uf.find(key)
+
+    order: dict[tuple, int] = {}
+    group_of: dict[tuple, tuple | None] = {}
+    for i, gang in enumerate(gangs):
+        group = gang.multislice_group_key
+        order[gang.key] = i
+        group_of[gang.key] = group
+        tokens: list = [("gang", gang.key)]
+        if group is not None:
+            tokens.append(("gang", group))
+        tokens.extend(_candidate_parts(gang, accels_present,
+                                       pools_by_accel, candidate_accels))
+        for tok in tokens[1:]:
+            uf.union(tokens[0], tok)
+    for gang, shape_name in advisory:
+        tokens = [("gang", gang.key)]
+        group = gang.multislice_group_key
+        if group is not None:
+            tokens.append(("gang", group))
+        try:
+            accel = shape_by_name(shape_name).accelerator_type
+        except KeyError:
+            accel = None
+        if accel is not None:
+            tokens.extend(pools_by_accel.get(accel) or [(accel, "")])
+        uf.find(tokens[0])
+        for tok in tokens[1:]:
+            uf.union(tokens[0], tok)
+    for shape_name in policy.spare_slices:
+        try:
+            accel = shape_by_name(shape_name).accelerator_type
+        except KeyError:
+            continue
+        pools = pools_by_accel.get(accel) or [(accel, "")]
+        for key in pools[1:]:
+            uf.union(pools[0], key)
+        uf.find(pools[0])
+
+    # Components -> buckets, deterministically: sort components by
+    # their smallest member partition key, round-robin into buckets.
+    roots: dict = {}
+    for key in list(uf._parent):
+        roots.setdefault(uf.find(key), []).append(key)
+    components = sorted(
+        roots.items(),
+        key=lambda kv: min([k for k in kv[1] if isinstance(k, tuple)
+                            and len(k) == 2 and k[0] != "gang"]
+                           or [("", "")]))
+    bucket_of_root: dict = {}
+    for i, (root, _members) in enumerate(components):
+        bucket_of_root[root] = i % max(1, n_shards)
+    bucket_of_part = {key: bucket_of_root[uf.find(key)]
+                      for key in parts_present}
+    bucket_of_gang = {g.key: bucket_of_root[uf.find(("gang", g.key))]
+                      for g in gangs}
+    for gang, _shape in advisory:
+        bucket_of_gang.setdefault(
+            gang.key, bucket_of_root[uf.find(("gang", gang.key))])
+    return _Partition(
+        n_buckets=max(1, n_shards),
+        bucket_of_part=bucket_of_part,
+        bucket_of_gang=bucket_of_gang,
+        cpu_bucket=bucket_of_part[CPU_PART],
+        order=order, group_of=group_of)
+
+
+# ---- the worker -------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class _ShardWork:
+    """One worker's frozen inputs (built on the reconcile thread)."""
+
+    index: int
+    planner: Planner                 # per-shard policy (spares filtered)
+    gangs: list[Gang]
+    advisory: list[tuple[Gang, str]]
+    nodes: list[Node]
+    pods: list[Pod]
+    in_flight: Sequence
+    gen_overrides: dict
+    extra_existing_chips: int
+
+
+@dataclasses.dataclass
+class _ShardOutcome:
+    index: int
+    plan: ScalePlan
+    seconds: float
+    planned_tpu_chips: int
+    items: int                       # gangs + advisory (load balance)
+
+
+def _plan_shard(work: _ShardWork) -> _ShardOutcome:
+    """Worker body: one pure planner call over the shard's slice of
+    the world.  Touches nothing but its arguments."""
+    t0 = time.perf_counter()
+    plan = work.planner.plan(
+        work.gangs, work.nodes, work.pods, work.in_flight,
+        generation_overrides=work.gen_overrides,
+        advisory_gangs=work.advisory,
+        extra_existing_chips=work.extra_existing_chips)
+    planned = sum(shape_by_name(r.shape_name).chips * r.count
+                  for r in plan.requests if r.kind == "tpu-slice")
+    return _ShardOutcome(index=work.index, plan=plan,
+                         seconds=time.perf_counter() - t0,
+                         planned_tpu_chips=planned,
+                         items=len(work.gangs) + len(work.advisory))
+
+
+def _claim_shard(units: dict[str, list[Node]], gangs: list[Gang],
+                 pods: list[Pod]) -> tuple[set[str], float]:
+    t0 = time.perf_counter()
+    claimed = claimed_by_pending(units, gangs, pods)
+    return claimed, time.perf_counter() - t0
+
+
+# ---- the sharder ------------------------------------------------------- #
+
+
+class ShardConflict(Exception):
+    """A cross-shard global invalidated the merge (internal signal:
+    the caller re-plans serially)."""
+
+
+class ShardedPlanner:
+    """Fan-out/merge driver, owned by the Controller and called ONLY
+    from the reconcile thread.  Holds the worker pool, the previous
+    partition assignment (rebalance detection), and the metrics
+    bridge; workers receive none of it."""
+
+    def __init__(self, shards: int, planner: Planner, metrics=None,
+                 min_gangs: int = 16, max_workers: int | None = None):
+        self.shards = max(1, int(shards))
+        self.planner = planner
+        self.metrics = metrics
+        self.min_gangs = min_gangs
+        self._max_workers = (max_workers if max_workers
+                             else min(self.shards,
+                                      max(2, os.cpu_count() or 2)))
+        self._pool = None
+        self._assignment: dict[PartKey, int] = {}
+        self.last_info: dict = {}
+
+    # -- lifecycle ----------------------------------------------------
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            self._pool = concurrency.pool_executor(
+                self._max_workers, thread_name_prefix="shard")
+        return self._pool
+
+    def close(self) -> None:
+        """Shut the worker pool down.  Idempotent; the next plan()
+        would lazily rebuild it (crash-only symmetry)."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    def _inc(self, name: str, value: float = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, value)
+
+    # -- shardability -------------------------------------------------
+
+    def _serial_reason(self, gangs: list[Gang],
+                       advisory: Sequence[tuple[Gang, str]],
+                       policy: PoolPolicy) -> str | None:
+        """Why this pass must plan serially, or None.
+
+        fair_share re-sorts admissions across the whole demand set and
+        namespace quotas couple namespaces across classes — both make
+        cross-shard order load-bearing, so they serialize (the same
+        rule delta planning applies).  Hard-constrained pending CPU
+        pods serialize because predicate placement may read placements
+        on nodes outside the CPU shard (zone-level anti-affinity).
+        """
+        if policy.fair_share:
+            return "fair_share"
+        if policy.namespace_chip_quota:
+            return "namespace_quota"
+        if len(gangs) + len(advisory) < self.min_gangs:
+            return "small_pass"
+        from tpu_autoscaler.k8s.scheduling import (
+            has_scheduling_constraints,
+        )
+
+        for gang in gangs:
+            if not gang.requests_tpu \
+                    and any(has_scheduling_constraints(p)
+                            for p in gang.pods):
+                return "constrained_cpu"
+        return None
+
+    # -- planning -----------------------------------------------------
+
+    def plan(self, gangs: list[Gang], nodes: list[Node],
+             pods: list[Pod], in_flight: Sequence = (),
+             generation_overrides: dict | None = None,
+             advisory_gangs: Sequence[tuple[Gang, str]] = (),
+             candidate_accels: Callable[[Gang], tuple[str, ...]] = (
+                 lambda g: ()),
+             ) -> ScalePlan:
+        """The sharded twin of ``Planner.plan`` — byte-identical
+        output, with ``self.last_info`` describing how the pass ran
+        (for the pass record's ``planning.sharding`` section)."""
+        advisory = list(advisory_gangs)
+        reason = self._serial_reason(gangs, advisory,
+                                     self.planner.policy)
+        if reason is not None:
+            self._inc("shard_serial_fallbacks")
+            self._set_balance(1.0, 0)
+            self.last_info = {"mode": "serial", "why": reason}
+            return self.planner.plan(
+                gangs, nodes, pods, in_flight,
+                generation_overrides=generation_overrides,
+                advisory_gangs=advisory)
+        try:
+            plan, info = self._plan_sharded(
+                gangs, nodes, pods, in_flight,
+                generation_overrides or {}, advisory, candidate_accels)
+            self.last_info = info
+            return plan
+        except ShardConflict as e:
+            self._inc("shard_merge_conflicts")
+            self._set_balance(1.0, 0)
+            self.last_info = {"mode": "serial", "why": "merge_conflict",
+                              "conflict": str(e)}
+        except Exception:  # noqa: BLE001 — crash-only: a sharding bug
+            # degrades the pass to the serial oracle, never breaks
+            # scaling.  Counted and logged; the plan below is the
+            # same pure function the serial path always ran.
+            import logging
+
+            self._inc("shard_errors")
+            self._set_balance(1.0, 0)
+            logging.getLogger(__name__).exception(
+                "sharded planning failed; serial fallback this pass")
+            self.last_info = {"mode": "serial", "why": "shard_error"}
+        return self.planner.plan(
+            gangs, nodes, pods, in_flight,
+            generation_overrides=generation_overrides,
+            advisory_gangs=advisory)
+
+    def _plan_sharded(self, gangs, nodes, pods, in_flight,
+                      gen_overrides, advisory, candidate_accels):
+        policy = self.planner.policy
+        part = partition(gangs, advisory, nodes, policy,
+                         candidate_accels, self.shards)
+        self._note_rebalance(part)
+
+        # Slice the world.  Node/pod routing is one dict lookup per
+        # object; order within each shard preserves the input order
+        # (free-slice iteration order is part of the byte-identity
+        # contract).
+        shard_nodes: list[list[Node]] = [[] for _ in
+                                         range(part.n_buckets)]
+        node_bucket: dict[str, int] = {}
+        existing_total = 0
+        shard_chips = [0] * part.n_buckets
+        for n in nodes:
+            b = part.bucket_of_node(n)
+            if b is None:
+                b = part.cpu_bucket
+            shard_nodes[b].append(n)
+            node_bucket[n.name] = b
+            if n.is_tpu:
+                chips = int(n.allocatable.get(TPU_RESOURCE))
+                existing_total += chips
+                shard_chips[b] += chips
+        shard_pods: list[list[Pod]] = [[] for _ in range(part.n_buckets)]
+        gang_bucket = part.bucket_of_gang
+        for p in pods:
+            if p.node_name:
+                b = node_bucket.get(p.node_name)
+            else:
+                b = gang_bucket.get(p.gang_key)
+            if b is not None:
+                shard_pods[b].append(p)
+        shard_gangs: list[list[Gang]] = [[] for _ in
+                                         range(part.n_buckets)]
+        for g in gangs:
+            shard_gangs[gang_bucket[g.key]].append(g)
+        shard_adv: list[list] = [[] for _ in range(part.n_buckets)]
+        advisory_index: dict[tuple, int] = {}
+        for i, (g, shape_name) in enumerate(advisory):
+            advisory_index.setdefault(g.key, i)
+            shard_adv[gang_bucket[g.key]].append((g, shape_name))
+
+        # Per-shard policy: spare shapes live with their class's
+        # shard; the CPU extras (spare/over-provision nodes) live
+        # with the CPU shard — any other assignment would plan spare
+        # capacity against a world that cannot see the existing one.
+        works: list[_ShardWork] = []
+        for b in range(part.n_buckets):
+            spares = {name: want
+                      for name, want in policy.spare_slices.items()
+                      if self._spare_bucket(name, part) == b}
+            is_cpu = b == part.cpu_bucket
+            busy = bool(shard_gangs[b] or shard_adv[b] or spares
+                        or (is_cpu and policy.spare_nodes > 0))
+            if not busy:
+                continue
+            shard_policy = dataclasses.replace(
+                policy, spare_slices=spares,
+                spare_nodes=policy.spare_nodes if is_cpu else 0,
+                over_provision_nodes=(policy.over_provision_nodes
+                                      if is_cpu else 0))
+            works.append(_ShardWork(
+                index=b, planner=Planner(shard_policy),
+                gangs=shard_gangs[b], advisory=shard_adv[b],
+                nodes=shard_nodes[b], pods=shard_pods[b],
+                in_flight=in_flight, gen_overrides=gen_overrides,
+                extra_existing_chips=existing_total - shard_chips[b]))
+
+        outcomes = self._run(works, _plan_shard)
+        plan = self._merge(outcomes, in_flight, policy,
+                           existing_total, part.order, part.group_of,
+                           advisory_index, part.cpu_bucket)
+        balance = self._balance([o.items for o in outcomes])
+        self._set_balance(balance, len(outcomes))
+        if self.metrics is not None:
+            for o in outcomes:
+                self.metrics.observe("shard_pass_seconds", o.seconds)
+        return plan, {
+            "mode": "sharded", "shards": len(outcomes),
+            "items": [o.items for o in outcomes],
+            "balance": round(balance, 3),
+        }
+
+    # -- maintenance-side sharding ------------------------------------
+
+    def claimed_by_pending(self, units: dict[str, list[Node]],
+                           pending_gangs: list[Gang],
+                           pods: list[Pod],
+                           candidate_accels) -> set[str]:
+        """Sharded twin of :func:`claimed_by_pending` — the maintain
+        pass's superlinear term, partitioned exactly like planning (a
+        unit can only be claimed by gangs of its own component).
+        Crash-only: any failure degrades to the serial scan."""
+        try:
+            # One representative node per unit is enough for the
+            # partition to learn which (class, pool) keys exist — a
+            # unit's hosts share both labels by construction.
+            rep_nodes = [uns[0] for uns in units.values()]
+            part = partition(pending_gangs, (), rep_nodes,
+                             self.planner.policy, candidate_accels,
+                             self.shards)
+            shard_units: list[dict] = [{} for _ in range(part.n_buckets)]
+            for uid, unit_nodes in units.items():
+                b = part.bucket_of_part.get(node_part(unit_nodes[0]))
+                if b is None:
+                    b = part.cpu_bucket
+                shard_units[b][uid] = unit_nodes
+            shard_gangs: list[list[Gang]] = [[] for _ in
+                                             range(part.n_buckets)]
+            for g in pending_gangs:
+                shard_gangs[part.bucket_of_gang[g.key]].append(g)
+            works = [(b, shard_units[b], shard_gangs[b])
+                     for b in range(part.n_buckets)
+                     if shard_units[b]]
+            pool = self._ensure_pool()
+            futures = [pool.submit(_claim_shard, u, g, pods)
+                       for _b, u, g in works]
+            claimed: set[str] = set()
+            for sub, seconds in self._collect(futures):
+                claimed |= sub
+                if self.metrics is not None:
+                    self.metrics.observe("shard_pass_seconds", seconds)
+            return claimed
+        except Exception:  # noqa: BLE001 — crash-only: degrade to the
+            # serial scan; a sharding bug must never break maintenance.
+            import logging
+
+            self._inc("shard_errors")
+            logging.getLogger(__name__).exception(
+                "sharded claim scan failed; serial fallback this pass")
+            return claimed_by_pending(units, pending_gangs, pods)
+
+    # -- internals ----------------------------------------------------
+
+    def _spare_bucket(self, shape_name: str, part: _Partition) -> int:
+        try:
+            accel = shape_by_name(shape_name).accelerator_type
+        except KeyError:
+            return part.cpu_bucket
+        for key, b in part.bucket_of_part.items():
+            if key[0] == accel:
+                return b
+        return part.cpu_bucket
+
+    def _run(self, works: list[_ShardWork], fn) -> list[_ShardOutcome]:
+        if not works:
+            return []
+        if len(works) == 1:
+            # One busy shard: the fan-out would buy one context switch.
+            return [fn(works[0])]
+        pool = self._ensure_pool()
+        futures = [pool.submit(fn, w) for w in works]
+        return self._collect(futures)
+
+    @staticmethod
+    def _collect(futures: list) -> list:
+        """Barrier-wait on the fan-out.  Under the DeterministicScheduler
+        a raw ``Future.result()`` blocks on a real condition variable
+        that is not a schedule point — poll ``done()`` through the
+        scheduler's step instead, exactly like the harness's own pool
+        tests (testing/sched.py SchedPool)."""
+        sched = concurrency.active_scheduler()
+        if sched is not None:
+            while not all(f.done() for f in futures):
+                sched.step()
+        return [f.result() for f in futures]
+
+    def _note_rebalance(self, part: _Partition) -> None:
+        if self._assignment and any(
+                self._assignment.get(k, b) != b
+                for k, b in part.bucket_of_part.items()):
+            self._inc("shard_rebalance_events")
+        self._assignment = dict(part.bucket_of_part)
+
+    def _balance(self, items: list[int]) -> float:
+        """Mean-over-CONFIGURED-shards / max busy load.  The
+        denominator is the configured shard count, not the busy count:
+        one component owning all demand must read IMBALANCED (that is
+        the shard-imbalance alert's motivating case — sharding buys
+        nothing there), not a perfect 1.0 (review-found)."""
+        if not items or max(items) <= 0:
+            return 1.0
+        return min(1.0, (sum(items) / self.shards) / max(items))
+
+    def _set_balance(self, balance: float, count: int) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("shard_balance", round(balance, 4))
+            self.metrics.set_gauge("shard_count", count)
+
+    # -- the merge point ----------------------------------------------
+
+    def _merge(self, outcomes: list[_ShardOutcome],
+               in_flight, policy: PoolPolicy, existing_total: int,
+               order: dict, group_of: dict, advisory_index: dict,
+               cpu_bucket: int) -> ScalePlan:
+        """Reassemble one serial-identical plan, or raise
+        :class:`ShardConflict` when a cross-shard global could have
+        changed any shard's decision.
+
+        Re-validated globals (snapshotted at fan-out): the
+        max_total_chips clamp against the fleet total plus the
+        kind-wide in-flight ledger.  Any clamp-flavored rejection or
+        deferral inside a shard also conflicts — serial's rejection
+        message embeds the cross-shard running total, so even an
+        "identical" verdict would not be byte-identical.
+
+        Ordering: serial creates a cohort at its first UNMATCHED
+        member and emits FIFO, so a request's min member index IS its
+        serial position — EXCEPT when one multislice group yields
+        multiple separate entries (a heterogeneous jobset degrading
+        to solo units, or FitError'd members beside an emitted unit):
+        serial clusters those at the cohort's creation point, which
+        the merge cannot reconstruct, so that case conflicts into the
+        serial oracle (review-found).
+        """
+        inflight_chips = sum(shape_by_name(f.shape_name).chips * f.count
+                             for f in in_flight
+                             if f.kind == "tpu-slice")
+        total_planned = sum(o.planned_tpu_chips for o in outcomes)
+        if (existing_total + inflight_chips + total_planned
+                > policy.max_total_chips):
+            raise ShardConflict(
+                f"planned {total_planned} chips across shards exceeds "
+                f"max_total_chips={policy.max_total_chips} headroom")
+        for o in outcomes:
+            if o.plan.deferred:
+                raise ShardConflict(
+                    f"shard {o.index} deferred advisory demand at a "
+                    f"global clamp")
+            for _gang, why in o.plan.unsatisfiable:
+                if any(s in why for s in _GLOBAL_REASONS):
+                    raise ShardConflict(
+                        f"shard {o.index} rejected at a global clamp: "
+                        f"{why}")
+
+        spare_rank = {name: i for i, name
+                      in enumerate(policy.spare_slices)}
+        organic: list[tuple[int, object]] = []
+        adv: list[tuple[int, object]] = []
+        spares: list[tuple[tuple, object]] = []
+        cpu: list = []
+        #: multislice group key -> distinct emitted entries (requests
+        #: or unsatisfiable): more than one means the min-index sort
+        #: cannot reproduce serial's cohort clustering — conflict.
+        group_entries: dict[tuple, int] = {}
+
+        def note_group(gang_key) -> None:
+            group = group_of.get(gang_key)
+            if group is not None:
+                group_entries[group] = group_entries.get(group, 0) + 1
+
+        for o in outcomes:
+            for req in o.plan.requests:
+                if req.kind == "cpu-node":
+                    cpu.append(req)
+                    continue
+                section = _section_of(req.reason)
+                if section == "organic":
+                    members = req.gang_keys or (req.gang_key,)
+                    keys = [order[k] for k in members if k in order]
+                    if not keys:
+                        raise ShardConflict(
+                            f"request for unknown gang {req.gang_key}")
+                    note_group((req.gang_keys or (req.gang_key,))[0])
+                    organic.append((min(keys), req))
+                elif section == "advisory":
+                    idx = advisory_index.get(req.gang_key)
+                    if idx is None:
+                        raise ShardConflict(
+                            f"advisory request for unknown key "
+                            f"{req.gang_key}")
+                    adv.append((idx, req))
+                elif section == "spare":
+                    spares.append(
+                        ((spare_rank.get(req.shape_name, 1 << 30),
+                          o.index), req))
+                else:
+                    raise ShardConflict(
+                        f"unclassifiable request reason {req.reason!r}")
+        organic.sort(key=lambda kv: kv[0])
+        adv.sort(key=lambda kv: kv[0])
+        spares.sort(key=lambda kv: kv[0])
+
+        merged = ScalePlan()
+        merged.requests = ([r for _k, r in organic]
+                           + [r for _k, r in adv]
+                           + [r for _k, r in spares] + cpu)
+        tpu_unsat: list[tuple[int, tuple]] = []
+        cpu_unsat: list[tuple] = []
+        for o in outcomes:
+            for entry in o.plan.unsatisfiable:
+                gang = entry[0]
+                if o.index == cpu_bucket and not gang.requests_tpu:
+                    cpu_unsat.append(entry)
+                elif gang.key in order:
+                    note_group(gang.key)
+                    tpu_unsat.append((order[gang.key], entry))
+                else:
+                    raise ShardConflict(
+                        f"unsatisfiable entry for unknown gang "
+                        f"{gang.key}")
+        split_groups = [g for g, n in group_entries.items() if n > 1]
+        if split_groups:
+            raise ShardConflict(
+                f"multislice group(s) {split_groups[:2]} produced "
+                f"multiple separate entries; serial clusters them at "
+                f"cohort creation — re-planning serially")
+        tpu_unsat.sort(key=lambda kv: kv[0])
+        merged.unsatisfiable = ([e for _k, e in tpu_unsat] + cpu_unsat)
+        return merged
+
+
+def _section_of(reason: str) -> str:
+    """Which serial plan section a request came from, by the planner's
+    own reason strings (pinned by tests/test_shard.py so a reworded
+    reason fails loudly there, not silently here; an unknown prefix
+    conflicts the merge into the serial oracle either way)."""
+    if reason.startswith(("gang ", "multislice jobset ")):
+        return "organic"
+    if reason.startswith(("slice repair: ", "predictive prewarm: ")):
+        return "advisory"
+    if reason.startswith("spare slice policy"):
+        return "spare"
+    return "unknown"

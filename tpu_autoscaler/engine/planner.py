@@ -160,15 +160,23 @@ def _free_slices(nodes: list[Node], pods: list[Pod]) -> dict[str, list[Node]]:
     supply (slice-atomicity: a half-busy slice can't take a new gang without
     bisecting the ICI domain between jobs).
     """
-    used_tpu: dict[str, float] = {}
-    for pod in pods:
-        if pod.node_name and pod.phase in {"Pending", "Running"}:
-            used_tpu[pod.node_name] = (used_tpu.get(pod.node_name, 0.0)
-                                       + pod.resources.get(TPU_RESOURCE))
     by_slice: dict[str, list[Node]] = {}
+    slice_hosts: set[str] = set()
     for node in nodes:
         if node.is_tpu and node.slice_id:
             by_slice.setdefault(node.slice_id, []).append(node)
+            slice_hosts.add(node.name)
+    if not by_slice:
+        return {}
+    # Chip usage only matters ON slice hosts: a fleet that is mostly
+    # CPU pods (the common shape at the million-pod tier) must not pay
+    # an O(all pods) accounting walk to learn its TPU slices are busy.
+    used_tpu: dict[str, float] = {}
+    for pod in pods:
+        if pod.node_name in slice_hosts \
+                and pod.phase in {"Pending", "Running"}:
+            used_tpu[pod.node_name] = (used_tpu.get(pod.node_name, 0.0)
+                                       + pod.resources.get(TPU_RESOURCE))
     free: dict[str, list[Node]] = {}
     for slice_id, members in by_slice.items():
         if all(n.is_ready and not n.unschedulable
@@ -361,8 +369,8 @@ class Planner:
     def plan(self, gangs: list[Gang], nodes: list[Node], pods: list[Pod],
              in_flight: Sequence[InFlight] = (),
              generation_overrides: dict[GangKey, str] | None = None,
-             advisory_gangs: Sequence[tuple[Gang, str]] = ()
-             ) -> ScalePlan:
+             advisory_gangs: Sequence[tuple[Gang, str]] = (),
+             extra_existing_chips: int = 0) -> ScalePlan:
         """``generation_overrides`` maps a gang key to the TPU generation
         to fit it on instead of the policy default — the controller sets
         it from failure streaks (capacity stockout fallback).
@@ -378,7 +386,15 @@ class Planner:
         organic demand (advisory work never displaces a real gang).
         Inadmissible advisory demand lands in ``plan.deferred``, never
         ``plan.unsatisfiable``.  The planner stays a pure function of
-        its inputs (TAP1xx)."""
+        its inputs (TAP1xx).
+
+        ``extra_existing_chips`` counts TPU chips that exist in the
+        fleet but are OUTSIDE ``nodes`` — the sharded reconcile path
+        (ISSUE 13, docs/SHARDING.md) plans each accelerator-class
+        shard against its own node slice while the max_total_chips
+        clamp stays fleet-global, so the sharder passes the
+        complement's chip total here.  0 (the default, and the serial
+        path) means ``nodes`` IS the fleet."""
         plan = ScalePlan()
         pol = self.policy
         gen_override = generation_overrides or {}
@@ -390,8 +406,9 @@ class Planner:
         free = _free_slices(nodes, pods)
         claimed: set[str] = set()
         served_keys = {f.gang_key for f in in_flight if f.gang_key}
-        existing_chips = sum(int(n.allocatable.get(TPU_RESOURCE))
-                             for n in nodes if n.is_tpu)
+        existing_chips = extra_existing_chips + sum(
+            int(n.allocatable.get(TPU_RESOURCE))
+            for n in nodes if n.is_tpu)
         inflight_chips = sum(shape_by_name(f.shape_name).chips * f.count
                              for f in in_flight if f.kind == "tpu-slice")
         planned_chips = 0

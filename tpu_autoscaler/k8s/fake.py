@@ -449,8 +449,40 @@ class FakeKube:
                         if n.is_tpu and n.slice_id
                         for q in placed_by_node.get(n.name, [])
                         if q.gang_key == gang.key}
+                # Gang/ICI-aware candidates (GKE multi-host TPU
+                # semantics).  (1) A MULTI-host slice is exclusively
+                # scheduled: a gang's pods never land beside another
+                # gang's workload — chaos-found at repair seed 85,
+                # where a recreated lone member bound beside a foreign
+                # gang on a half-free slice its siblings could never
+                # follow it onto, converging the gang split.  (2) A
+                # gang already holding slice(s) binds ONLY within
+                # them: topology affinity pins a job to its slice, so
+                # a stray member must wait for its siblings (or the
+                # repair replacement) instead of splitting the ICI
+                # domain onto fresh capacity.  Single-host slices stay
+                # shareable supply.
+                foreign = set()
+                for sid, members in by_slice.items():
+                    if len(members) <= 1:
+                        continue
+                    for n in members:
+                        if any(q.is_workload and q.gang_key != gang.key
+                               for q in placed_by_node.get(n.name, [])):
+                            foreign.add(sid)
+                            break
+                # _gang_exclusive is True except under the promoted
+                # chaos fixture's sabotage hook, which re-opens the
+                # pre-fix first-fit semantics (testing only).
+                if not getattr(self, "_gang_exclusive", True):
+                    candidates = list(by_slice)
+                elif mine:
+                    candidates = [s for s in by_slice if s in mine]
+                else:
+                    candidates = [s for s in by_slice
+                                  if s not in foreign]
                 placements = None
-                for sid in sorted(by_slice,
+                for sid in sorted(candidates,
                                   key=lambda s: (s not in mine, s)):
                     trial = dict(free)
                     trial_placed = {k: list(v)

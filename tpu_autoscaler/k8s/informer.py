@@ -153,6 +153,12 @@ class ObjectCache:
         # a replace()/mark_unsynced() happened (full rebuild required).
         self._dirty: dict[str, set[Hashable] | None] = {}
         self._reserve = reserve
+        # Whole-store XOR digest of (key, resourceVersion) pairs,
+        # maintained per delta exactly like the per-bucket index
+        # digests: an O(1) identity for "did ANY object change",
+        # which the reconciler's pass records hash instead of an
+        # O(store) frozenset at the million-pod tier (ISSUE 13).
+        self._store_digest = 0
 
     @staticmethod
     def _key(obj: Mapping[str, Any]) -> str | None:
@@ -169,6 +175,12 @@ class ObjectCache:
         with self._lock:
             return self._resource_version
 
+    @property
+    def store_digest(self) -> int:
+        """O(1) XOR identity of the whole store's (key, rv) pairs."""
+        with self._lock:
+            return self._store_digest
+
     # -- index maintenance (self._lock is re-entrant: callers hold it
     #    around the whole store+index update, and each helper takes it
     #    again so every index mutation is lexically lock-guarded) ------
@@ -181,6 +193,7 @@ class ObjectCache:
     def _index_add(self, key: str, parsed: Any) -> None:
         contrib = self._contrib(key, parsed)
         with self._lock:
+            self._store_digest ^= contrib
             for name, indexer in self._indexers.items():
                 index = self._indices[name]
                 digests = self._idx_digests[name]
@@ -206,6 +219,7 @@ class ObjectCache:
     def _index_remove(self, key: str, parsed: Any) -> None:
         contrib = self._contrib(key, parsed)
         with self._lock:
+            self._store_digest ^= contrib
             for name, indexer in self._indexers.items():
                 index = self._indices[name]
                 digests = self._idx_digests[name]
@@ -240,6 +254,7 @@ class ObjectCache:
             self._indices = {name: {} for name in self._indexers}
             self._idx_digests = {name: {} for name in self._indexers}
             self._fold_state = {name: {} for name in self._fold_defs}
+            self._store_digest = 0
             for key, parsed in self._parsed.items():
                 self._index_add(key, parsed)
 
@@ -369,6 +384,28 @@ class ObjectCache:
             bucket = self._indices[index].get(ikey)
             return (list(self._parsed.values()),
                     list(bucket.values()) if bucket else [])
+
+    def snapshot_with_digest(self) -> tuple[list[Any], int] | None:
+        """``snapshot`` plus the store digest describing EXACTLY that
+        snapshot (one lock hold — a digest read after the lock drops
+        could describe a later world; review-found, ISSUE 13)."""
+        with self._lock:
+            if not self._synced:
+                return None
+            return list(self._parsed.values()), self._store_digest
+
+    def snapshot_select_digest(self, index: str, ikey: Hashable
+                               ) -> tuple[list[Any], list[Any],
+                                          int] | None:
+        """``snapshot_and_select`` plus the matching store digest,
+        all under one lock hold."""
+        with self._lock:
+            if not self._synced:
+                return None
+            bucket = self._indices[index].get(ikey)
+            return (list(self._parsed.values()),
+                    list(bucket.values()) if bucket else [],
+                    self._store_digest)
 
     def index_keys(self, index: str) -> list[Hashable] | None:
         with self._lock:
@@ -889,6 +926,27 @@ class ClusterInformer:
             return both
         pods = self._fallback("pods")
         return pods, [p for p in pods if p.is_unschedulable]
+
+    def observe_with_digest(self):
+        """One pass's full cache-backed world view plus its identity:
+        ``(nodes, pods, pending, digest)`` where ``digest`` is built
+        from each cache's store digest captured UNDER the same lock
+        hold as its snapshot — so a pass record can never be stamped
+        with a world the pass did not observe (watch threads keep the
+        caches moving mid-pass).  None when either cache is unsynced;
+        the caller falls back to the LIST paths and the legacy
+        per-list hash."""
+        node_snap = self.node_cache.snapshot_with_digest()
+        if node_snap is None:
+            return None
+        pod_snap = self.pod_cache.snapshot_select_digest(
+            "unschedulable", PENDING)
+        if pod_snap is None:
+            return None
+        nodes, node_digest = node_snap
+        pods, pending, pod_digest = pod_snap
+        return (nodes, pods, pending,
+                hash(("informer", pod_digest, node_digest)))
 
     def unready_nodes(self):
         """Parsed nodes currently NotReady or cordoned — the node-failure

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import collections
 import datetime
+import functools
 import threading
 from typing import TYPE_CHECKING, Any, Mapping, Sequence, TypeVar
 
@@ -60,6 +61,30 @@ def parse_time(value: str | None) -> datetime.datetime | None:
     if not value:
         return None
     return datetime.datetime.fromisoformat(value.replace("Z", "+00:00"))
+
+
+def _memoized(fn):
+    """Lock-free per-instance memo for the immutable parsed views.
+
+    NOT ``functools.cached_property``: on Python <= 3.11 that guards
+    every cache miss with ONE re-entrant lock per descriptor shared
+    across ALL instances, which would serialize the shard workers'
+    cold-object first touches (ISSUE 13) on exactly the predicates
+    they fan out over.  The benign lost-update race here recomputes an
+    idempotent pure function — last write wins, same value.
+    """
+    attr = "_memo_" + fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(self):
+        try:
+            return getattr(self, attr)
+        except AttributeError:
+            value = fn(self)
+            setattr(self, attr, value)
+            return value
+
+    return property(wrapper)
 
 
 class Pod:
@@ -125,15 +150,23 @@ class Pod:
     # -- classification (reference: kube.py §KubePod is_mirrored/is_replicated
     #    /is_critical) ------------------------------------------------------
 
-    @property
+    # Classification predicates are memoized, not plain properties: a
+    # parsed Pod is an immutable view of ONE (uid, resourceVersion) —
+    # any change arrives as a new object — and these predicates run
+    # per unit per reconcile pass over every bound pod (state machine,
+    # spare/claim accounting, drains), which made the repeated
+    # ownerReferences/annotation walks a measurable slice of the
+    # million-pod pass (ISSUE 13 audit).
+
+    @_memoized
     def owner_kind(self) -> str | None:
         return self._owners[0].get("kind") if self._owners else None
 
-    @property
+    @_memoized
     def is_mirrored(self) -> bool:
         return MIRROR_ANNOTATION in self.annotations
 
-    @property
+    @_memoized
     def is_daemonset(self) -> bool:
         return self.owner_kind == "DaemonSet"
 
@@ -149,13 +182,13 @@ class Pod:
             return True
         return self.annotations.get(SAFE_TO_EVICT_ANNOTATION) == "false"
 
-    @property
+    @_memoized
     def is_drainable(self) -> bool:
         """Evictable during a drain: replicated, not mirror/DS/critical."""
         return (self.is_replicated and not self.is_mirrored
                 and not self.is_daemonset and not self.is_critical)
 
-    @property
+    @_memoized
     def is_workload(self) -> bool:
         """Counts toward a unit being busy: an active pod that is not
         host-plumbing (daemonset/mirror).  THE busy/idle input predicate —
@@ -217,7 +250,7 @@ class Pod:
                 return True
         return False
 
-    @property
+    @_memoized
     def gang_key(self) -> tuple[str, str, str]:
         """Demand-unit identity: pods sharing a key are one gang.
 
@@ -305,8 +338,11 @@ class Node:
     def pool(self) -> str | None:
         return self.labels.get(POOL_LABEL)
 
-    @property
+    @_memoized
     def is_tpu(self) -> bool:
+        # Memoized like the Pod predicates: a parsed Node is an
+        # immutable (uid, resourceVersion) view, and this runs per
+        # node per pass in unit grouping and supply partitioning.
         return self.allocatable.get(TPU_RESOURCE) > 0
 
     @property

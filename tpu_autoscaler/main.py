@@ -167,6 +167,12 @@ _common = [
                       "under a hard never-costs-more-than-it-saves "
                       "budget guard (docs/REPACK.md). Off by default: "
                       "repacking moves live work."),
+    click.option("--reconcile-shards", default=0, show_default=True,
+                 type=click.IntRange(min=0),
+                 help="Shard reconcile planning by accelerator "
+                      "class/pool across this many workers, merged "
+                      "back byte-identical on the reconcile thread "
+                      "(docs/SHARDING.md). 0 = serial, the oracle."),
     click.option("--spare-agents", default=1, show_default=True,
                  help="Free CPU nodes kept warm (reference: --spare-agents)."),
     click.option("--spare-slice", "spare_slices", multiple=True,
@@ -263,7 +269,8 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            policy_early_reclaim, slack_hook,
            slack_channel, metrics_port, recorder_spans, recorder_passes,
            no_alerts, incident_dir, log_json, verbose,
-           price_book=None, enable_repack=False) -> Controller:
+           price_book=None, enable_repack=False,
+           reconcile_shards=0) -> Controller:
     from tpu_autoscaler.logging_setup import setup_logging
     from tpu_autoscaler.obs import AlertEngine, BlackBox, FlightRecorder
 
@@ -297,6 +304,7 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         gang_settle_seconds=gang_settle,
         provision_timeout_seconds=provision_timeout,
         enable_preemption=preemption,
+        reconcile_shards=reconcile_shards,
         enable_repack=enable_repack,
         price_book=book,
         no_scale=no_scale, no_maintenance=no_maintenance)

@@ -136,6 +136,16 @@ def default_rules() -> tuple[AlertRule, ...]:
             metric="repack_migrations_started",
             kind="rate", window=3600.0, threshold=12.0 / 3600.0,
             for_passes=3, clear_passes=5, severity="ticket"),
+        # Shard imbalance (ISSUE 13, docs/SHARDING.md): shard_balance
+        # is mean-load/max-load over busy shards (1.0 = even; the
+        # serial path exports a constant 1.0, so the rule is defined
+        # in every mode).  A sustained sub-0.25 balance means one
+        # class/pool owns nearly all demand and the partition buys
+        # little — repin workloads or lower --reconcile-shards.
+        AlertRule(
+            name="shard-imbalance", metric="shard_balance",
+            kind="gauge_below", window=900.0, threshold=0.25,
+            for_passes=5, clear_passes=5, severity="ticket"),
     )
 
 

@@ -26,6 +26,10 @@ class ChaosRegression:
     profile: str
     invariant: str           # the invariant the original failure tripped
     description: str
+    #: Run with the ISSUE 13 sharded planner attached (0 = serial).
+    #: Shard fixtures need the fan-out/merge path live to re-open
+    #: their bug class.
+    reconcile_shards: int = 0
 
     def program(self) -> ScenarioProgram:
         # multislice=False: these fixtures pin the exact pre-ISSUE-8
@@ -34,7 +38,8 @@ class ChaosRegression:
                         multislice=False)
 
     def run(self, sabotage=None) -> ChaosResult:
-        run = _Run(self.program())
+        run = _Run(self.program(),
+                   reconcile_shards=self.reconcile_shards)
         if sabotage is not None:
             sabotage(run)
         return run.execute()
@@ -65,7 +70,13 @@ def _disable_repair_deferral(run: _Run) -> None:
     """Pre-fix emulation for GANG_SPLIT_BACKFILL: no repair subsystem —
     no whole-gang deferral, no advisory replacement — so a recreated
     member of a broken slice is sized SOLO and the gang converges split
-    across ICI domains."""
+    across ICI domains.  The fake scheduler's pre-ISSUE-13 first-fit
+    semantics are restored too: the world model's multi-host slice
+    exclusivity (REPAIR_FOREIGN_SLICE_BIND's fix) independently masks
+    this symptom in the FAKE, but real schedulers are not
+    gang-exclusive — the controller-layer deferral stays load-bearing,
+    and this fixture pins it under the original bug's environment."""
+    run.kube._gang_exclusive = False
     run.controller.config = dataclasses.replace(
         run.controller.config, enable_slice_repair=False,
         unhealthy_timeout_seconds=60.0)
@@ -134,12 +145,75 @@ REPACK_GUARDLESS_LOSS = ChaosRegression(
                 "without the budget guard the migration completes "
                 "net-negative on fresh on-demand supply, silently")
 
+def _double_merge_request(run: _Run) -> None:
+    """Pre-fix emulation for SHARD_DOUBLE_MERGE: a mis-merge that
+    reassembles one shard's organic request twice — the bug class the
+    merge point's order/conflict re-validation exists to prevent
+    (ISSUE 13, docs/SHARDING.md "Merge-point semantics").  The same
+    gang gets two provisions dispatched in one pass."""
+    sharder = run.controller.sharder
+    assert sharder is not None, "shard fixture needs reconcile_shards"
+    orig = sharder.plan
+
+    def plan(*args, **kwargs):
+        out = orig(*args, **kwargs)
+        if sharder.last_info.get("mode") == "sharded":
+            dup = next((r for r in out.requests
+                        if r.kind == "tpu-slice"
+                        and r.gang_key is not None), None)
+            if dup is not None:
+                out.requests.append(dup)
+        return out
+
+    sharder.plan = plan
+
+
+#: The sharded merge must never reassemble a shard's request twice (or
+#: admit two shards' requests for one gang): the planner-visible
+#: in-flight ledger would carry two entries for one gang key — exactly
+#: the no-double-provision invariant.  The shipped merge (original-
+#: order reassembly + conflict re-validation) holds under the seed
+#: with 4 shards attached; the sabotaged mis-merge is caught.
+SHARD_DOUBLE_MERGE = ChaosRegression(
+    name="shard-double-merge", seed=7, profile="mixed",
+    invariant="no-double-provision",
+    description="a mis-merged sharded plan dispatches one gang's "
+                "provision twice in a single pass",
+    reconcile_shards=4)
+
+def _first_fit_scheduling(run: _Run) -> None:
+    """Pre-fix emulation for REPAIR_FOREIGN_SLICE_BIND: the fake
+    scheduler's pre-ISSUE-13 first-fit candidates — no multi-host
+    slice exclusivity, no bind-only-within-held-slices rule."""
+    run.kube._gang_exclusive = False
+
+
+#: Found by the ISSUE 13 sharded repair corpus (seed 85, latent since
+#: PR 7 — the repair corpus was not in CI): a host_fail evicts one
+#: member of a running gang; the recreated pod bound FIRST-FIT beside
+#: a DIFFERENT gang on a half-free multi-host slice, where its
+#: siblings could never follow (3 free hosts, 7 needed) — the gang
+#: converged split across two ICI domains.  Fixed in the world model
+#: (FakeKube.schedule_step): multi-host slices are exclusively
+#: scheduled (GKE TPU semantics) and a gang holding slices binds only
+#: within them, so the stray member waits for the repair replacement
+#: instead of splitting the domain.
+REPAIR_FOREIGN_SLICE_BIND = ChaosRegression(
+    name="repair-foreign-slice-bind", seed=85, profile="repair",
+    invariant="gang-ici-integrity",
+    description="recreated member of a broken slice binds first-fit "
+                "beside a foreign gang; its siblings can never "
+                "follow and the gang converges split")
+
 SABOTAGE = {
     LATE_PROVISION_SPAN.name: _lose_dispatch_roots,
     ORPHANED_PARTIAL_SLICE.name: _disable_orphan_reclaim,
     GANG_SPLIT_BACKFILL.name: _disable_repair_deferral,
     REPACK_GUARDLESS_LOSS.name: _disable_budget_guard,
+    SHARD_DOUBLE_MERGE.name: _double_merge_request,
+    REPAIR_FOREIGN_SLICE_BIND.name: _first_fit_scheduling,
 }
 
 ALL_REGRESSIONS = (LATE_PROVISION_SPAN, ORPHANED_PARTIAL_SLICE,
-                   GANG_SPLIT_BACKFILL, REPACK_GUARDLESS_LOSS)
+                   GANG_SPLIT_BACKFILL, REPACK_GUARDLESS_LOSS,
+                   SHARD_DOUBLE_MERGE, REPAIR_FOREIGN_SLICE_BIND)
