@@ -45,6 +45,13 @@
 #      reclamation, destination stockouts and mid-drain gang deletes,
 #      with the never-net-negative-savings + guard-capped-abort
 #      invariants — runs in the chaos stage above, exit 7.)
+#   14 serving-trace tier (bench.py serving-trace: data-plane
+#      request tracing — replica step + 10k-replica exemplar fold
+#      traced vs untraced within 2% + noise grace at 1% sampling
+#      with tail capture ON, and the diurnal+spike acceptance
+#      replay: full tail capture gap-free, exemplars resolving,
+#      scale-up-lag attribution with a working cross-link;
+#      BENCH_SERVING.json — ISSUE 14, docs/OBSERVABILITY.md)
 #   13 sharded reconcile tier (ISSUE 13, docs/SHARDING.md):
 #      bench.py observe at the 1M-pod/100k-node tier (>= 20x), then
 #      bench.py loop — full reconcile passes/sec sharded (8) vs
@@ -63,26 +70,26 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/12] invariant analysis (--format=$fmt)"
+echo "== [1/13] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/12] mypy strict islands"
+echo "== [2/13] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/12] deterministic-schedule race tier"
+echo "== [3/13] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh (its static
 # TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
 ./scripts/race.sh || exit 4
 
-echo "== [4/12] tracer-overhead gate"
+echo "== [4/13] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [5/12] mega-cluster scale tiers"
+echo "== [5/13] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [6/12] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
+echo "== [6/13] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -122,22 +129,33 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repair --reconcile-shards 4 \
     || exit 7
 
-echo "== [7/12] policy replay tier"
+echo "== [7/13] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [8/12] serving tier (adapter hot path + outcome replay)"
+echo "== [8/13] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
-echo "== [9/12] obs tier (TSDB ingest + alert evaluation)"
+echo "== [9/13] serving-trace tier (data-plane tracing overhead + acceptance)"
+# ISSUE 14 (docs/OBSERVABILITY.md "Request spans & exemplars"):
+# traced-vs-untraced replica step and 10k-replica exemplar fold
+# within 2% + noise grace at 1% sampling with tail capture ON, plus
+# the diurnal+spike acceptance replay — every SLO-missing cohort
+# tail-captured gap-free, bundle exemplars resolving to real request
+# traces, the miss-onset tail attributed to scale-up lag with a
+# working scaleup-* cross-link.  Records
+# BENCH_SERVING.json["serving_trace"].
+JAX_PLATFORMS=cpu python bench.py serving-trace || exit 14
+
+echo "== [10/13] obs tier (TSDB ingest + alert evaluation)"
 JAX_PLATFORMS=cpu python bench.py obs || exit 10
 
-echo "== [10/12] cost tier (attribution ledger pass cost + conservation)"
+echo "== [11/13] cost tier (attribution ledger pass cost + conservation)"
 JAX_PLATFORMS=cpu python bench.py cost || exit 11
 
-echo "== [11/12] repack tier (week-long churn replay, never-worse gate)"
+echo "== [12/13] repack tier (week-long churn replay, never-worse gate)"
 JAX_PLATFORMS=cpu python bench.py repack || exit 12
 
-echo "== [12/12] sharded reconcile tier (million-pod loop + observe)"
+echo "== [13/13] sharded reconcile tier (million-pod loop + observe)"
 # ISSUE 13 (docs/SHARDING.md): the 1M-pod observe tier (indexed reads
 # must hold the 20x floor at 10x the PR-6 scale), then the full-loop
 # tier — sharded reconcile >= 2x serial passes/sec at 8 shards with

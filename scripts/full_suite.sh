@@ -100,6 +100,17 @@ JAX_PLATFORMS=cpu python bench.py policy
 # "Autoscaler integration").
 JAX_PLATFORMS=cpu python bench.py serving
 
+# Serving-trace tier (ISSUE 14): request-level data-plane tracing —
+# the replica serving step and the 10k-replica exemplar fold traced
+# vs untraced within 2% + noise grace at 1% sampling with tail
+# capture ON, and the end-to-end acceptance replay: every SLO-missing
+# cohort tail-captured gap-free, incident-bundle exemplars resolving
+# to real request traces, the tail attributed to scale-up lag with a
+# working scaleup-* cross-link; results merge into
+# BENCH_SERVING.json (docs/OBSERVABILITY.md "Request spans &
+# exemplars").
+JAX_PLATFORMS=cpu python bench.py serving-trace
+
 # Tracer-overhead tier: the observe + actuate benches re-run with the
 # decision tracer attached must stay within 5% of untraced (ISSUE 5 —
 # instrumentation can never silently eat the PR-2/PR-3 wins).

@@ -1,0 +1,349 @@
+"""Request-level data-plane tracing (ISSUE 14, serving/reqtrace.py).
+
+The sampler's contract: deterministic head sampling, ALWAYS-captured
+tail (SLO misses, preemptions, drain losses), gap-free span trees
+(obs.trace_gaps knows the request shape), bounded memory under any
+load, O(1) hooks wired into the real batcher family's host-side
+bookkeeping, and the queue-wait/execute split riding the stats
+recorder + serve.py's final-stats receipt.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpu_autoscaler.obs.recorder import trace_gaps
+from tpu_autoscaler.serving.reqtrace import (
+    RequestTraceSampler,
+    head_sampled,
+)
+from tpu_autoscaler.serving.stats import ServingStatsRecorder
+
+
+class TestHeadSampling:
+    def test_deterministic_and_rate_shaped(self):
+        ids = [f"r{i}" for i in range(20_000)]
+        picked = [rid for rid in ids if head_sampled(rid, 0.01)]
+        again = [rid for rid in ids if head_sampled(rid, 0.01)]
+        assert picked == again            # pure function of the id
+        assert 0.003 < len(picked) / len(ids) < 0.03
+        assert not any(head_sampled(r, 0.0) for r in ids[:100])
+        assert all(head_sampled(r, 1.0) for r in ids[:100])
+
+
+class TestSamplerLifecycles:
+    def test_unsampled_fast_request_leaves_nothing(self):
+        s = RequestTraceSampler("rep", sample_rate=0.0, slo_ticks=100)
+        s.note_submit("r1", 0)
+        s.note_admit("r1", 1)
+        s.note_seeded("r1", 2)
+        assert s.note_finish("r1", 5) is None
+        assert s.sampled_total == 0
+        assert s.pending == 0
+        assert s.dump()["spans"] == []
+
+    def test_slo_miss_is_tail_captured_and_gap_free(self):
+        s = RequestTraceSampler("rep", sample_rate=0.0, slo_ticks=4)
+        s.note_submit("r1", 0)
+        s.note_admit("r1", 3)
+        s.note_seeded("r1", 4)
+        tid = s.note_finish("r1", 9, tokens=5)
+        assert tid == "request-rep-r1"
+        dump = s.dump()
+        assert trace_gaps(dump, tid) == []
+        root = next(sp for sp in dump["spans"]
+                    if sp["name"] == "request")
+        assert root["attrs"]["slo_miss"] is True
+        assert root["attrs"]["sampled"] == "tail"
+        names = {sp["name"] for sp in dump["spans"]}
+        assert {"queue_wait", "prefill", "decode"} <= names
+        assert s.tail_captured_total == 1
+
+    def test_preempted_request_always_captured_with_requeue_span(self):
+        s = RequestTraceSampler("rep", sample_rate=0.0,
+                                slo_ticks=10_000)
+        s.note_submit("r1", 0)
+        s.note_admit("r1", 1)
+        s.note_seeded("r1", 2)
+        s.note_preempt("r1", 5)
+        s.note_admit("r1", 8)
+        s.note_seeded("r1", 9)
+        tid = s.note_finish("r1", 12)
+        dump = s.dump()
+        assert trace_gaps(dump, tid) == []
+        requeue = [sp for sp in dump["spans"]
+                   if sp["name"] == "preempt_requeue"]
+        assert len(requeue) == 1
+        assert requeue[0]["start"] == 5 and requeue[0]["end"] == 8
+        decodes = [sp for sp in dump["spans"]
+                   if sp["name"] == "decode"]
+        assert len(decodes) == 2   # one per seeded window, not per token
+
+    def test_drain_lost_request_always_captured(self):
+        s = RequestTraceSampler("rep", sample_rate=0.0, slo_ticks=None)
+        s.note_submit("r9", 0)
+        tid = s.note_drain_lost("r9", 7)
+        dump = s.dump()
+        assert trace_gaps(dump, tid) == []
+        root = next(sp for sp in dump["spans"]
+                    if sp["name"] == "request")
+        assert root["attrs"]["lost"] is True
+        assert any(sp["name"] == "drain_handoff"
+                   for sp in dump["spans"])
+
+    def test_forwarded_request_is_not_lost(self):
+        s = RequestTraceSampler("rep", sample_rate=1.0)
+        s.note_submit("r1", 0)
+        s.note_forward("r1")
+        assert s.pending == 0
+        assert s.rerouted_total == 1
+        assert s.dump()["spans"] == []
+
+    def test_note_cohort_fast_path_and_promotion(self):
+        s = RequestTraceSampler("rep", sample_rate=0.0, slo_ticks=10.0)
+        assert s.note_cohort("c1", arrival=0.0, finish=5.0, n=7,
+                             exec_time=2.0) is None
+        tid = s.note_cohort("c1", arrival=0.0, finish=30.0, n=3,
+                            exec_time=2.0)
+        assert tid is not None
+        dump = s.dump()
+        assert trace_gaps(dump, tid) == []
+        root = next(sp for sp in dump["spans"]
+                    if sp["name"] == "request")
+        assert root["attrs"]["n"] == 3
+        qw = next(sp for sp in dump["spans"]
+                  if sp["name"] == "queue_wait")
+        assert qw["end"] - qw["start"] == pytest.approx(28.0)
+
+    def test_exemplar_and_counters_mirror_into_stats(self):
+        rec = ServingStatsRecorder(slots=4, slo_ticks=4)
+        s = RequestTraceSampler("rep", sample_rate=0.0, slo_ticks=4,
+                                stats=rec)
+        s.note_submit("r1", 0)
+        s.note_admit("r1", 1)
+        s.note_seeded("r1", 2)
+        tid = s.note_finish("r1", 9)
+        snap = rec.snapshot()
+        assert snap.exemplar_trace_id == tid
+        assert snap.exemplar_value == 9.0
+        assert snap.exemplar_seq == 1
+        assert snap.trace_sampled_total == 1
+        assert snap.trace_tail_total == 1
+
+
+class TestSamplerBounds:
+    def test_pending_overflow_drops_oldest_and_counts(self):
+        rec = ServingStatsRecorder(slots=1)
+        s = RequestTraceSampler("rep", sample_rate=1.0, max_pending=8,
+                                stats=rec)
+        for i in range(50):
+            s.note_submit(f"r{i}", i)
+        assert s.pending == 8
+        assert s.dropped_total == 42
+        assert rec.snapshot().trace_dropped_total == 42
+        # The survivors still promote normally.
+        assert s.note_finish("r49", 100) is not None
+
+    def test_event_cap_yields_declared_truncation(self):
+        s = RequestTraceSampler("rep", sample_rate=1.0, max_events=6)
+        s.note_submit("r1", 0)
+        for i in range(1, 30):
+            s.note_preempt("r1", i)
+        tid = s.note_finish("r1", 40)
+        dump = s.dump()
+        root = next(sp for sp in dump["spans"]
+                    if sp["name"] == "request")
+        assert root["attrs"]["truncated"] is True
+        # Declared truncation exempts the phase contract.
+        assert trace_gaps(dump, tid) == []
+
+    def test_trace_ring_is_bounded(self):
+        s = RequestTraceSampler("rep", sample_rate=1.0, max_traces=4)
+        for i in range(40):
+            s.note_cohort(f"c{i}", arrival=0.0, finish=1.0)
+        assert s.sampled_total == 40
+        assert len(s.dump()["spans"]) <= 4 * 8
+
+
+class TestEngineIntegration:
+    @pytest.fixture(scope="class")
+    def engine_setup(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from tpu_autoscaler.workloads.model import (
+            ModelConfig,
+            init_params,
+        )
+
+        cfg = ModelConfig(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                          d_ff=32, seq_len=32, dtype=jnp.float32)
+        return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+    def test_continuous_batcher_emits_gap_free_traces(self,
+                                                      engine_setup):
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        params, cfg = engine_setup
+        sampler = RequestTraceSampler("eng", sample_rate=1.0)
+        eng = ContinuousBatcher(params, cfg, slots=2, max_len=32,
+                                chunk=8, slo_ticks=100,
+                                reqtrace=sampler)
+        rng = np.random.default_rng(0)
+        reqs = [Request(prompt=rng.integers(0, cfg.vocab, (n,)).astype(
+                    np.int32), max_new_tokens=2) for n in (3, 5, 2)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        assert sampler.sampled_total == 3
+        dump = sampler.dump()
+        roots = [sp for sp in dump["spans"] if sp["name"] == "request"]
+        assert len(roots) == 3
+        for root in roots:
+            assert trace_gaps(dump, root["trace_id"]) == []
+            assert root["attrs"]["tokens"] == 2
+        # Wait split: every request was scheduled exactly once.
+        snap = eng.stats()
+        assert snap.first_scheduled_total == 3
+        assert all(r.first_scheduled_tick is not None for r in reqs)
+
+    def test_paged_preemption_requeue_split(self, engine_setup):
+        from tpu_autoscaler.workloads.paged import (
+            PagedBatcher,
+            Request,
+        )
+
+        params, cfg = engine_setup
+        sampler = RequestTraceSampler("pag", sample_rate=0.0,
+                                      slo_ticks=10_000)
+        eng = PagedBatcher(params, cfg, slots=2, max_len=32,
+                           block_size=8, num_blocks=4, chunk=8,
+                           reqtrace=sampler)
+        rng = np.random.default_rng(1)
+        for n in (9, 9, 9):
+            eng.submit(Request(
+                prompt=rng.integers(0, cfg.vocab, (n,)).astype(
+                    np.int32),
+                max_new_tokens=4))
+        eng.run()
+        snap = eng.stats()
+        if eng.preemptions:
+            # Tail capture promoted every preempted request, and the
+            # requeue wait landed in the recorder split.
+            assert sampler.tail_captured_total >= 1
+            assert snap.requeue_wait_ticks_total > 0
+            dump = sampler.dump()
+            roots = [sp for sp in dump["spans"]
+                     if sp["name"] == "request"
+                     and sp["attrs"]["preemptions"] > 0]
+            assert roots
+            for root in roots:
+                assert trace_gaps(dump, root["trace_id"]) == []
+        assert snap.first_scheduled_total >= 3
+
+    def test_spec_engine_annotates_accept_economics(self,
+                                                    engine_setup):
+        jax = pytest.importorskip("jax")
+        from tpu_autoscaler.workloads.spec_serving import (
+            Request,
+            SpeculativePagedBatcher,
+        )
+
+        params, cfg = engine_setup
+        sampler = RequestTraceSampler("spec", sample_rate=1.0)
+        eng = SpeculativePagedBatcher(
+            params, cfg, params, cfg, k=2, slots=2, max_len=32,
+            block_size=8, chunk=8, key=jax.random.PRNGKey(0),
+            reqtrace=sampler)
+        rng = np.random.default_rng(2)
+        eng.submit(Request(
+            prompt=rng.integers(0, cfg.vocab, (4,)).astype(np.int32),
+            max_new_tokens=4))
+        eng.run()
+        dump = sampler.dump()
+        root = next(sp for sp in dump["spans"]
+                    if sp["name"] == "request")
+        assert "accept_rate" in root["attrs"]
+        assert "target_pass_ratio" in root["attrs"]
+
+    def test_drain_handoff_traces_lost_requests(self, engine_setup):
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        class _DrainNow:
+            def drain_requested(self):
+                return True
+
+        params, cfg = engine_setup
+        sampler = RequestTraceSampler("drain", sample_rate=0.0)
+        eng = ContinuousBatcher(params, cfg, slots=1, max_len=32,
+                                chunk=8, reqtrace=sampler)
+        rng = np.random.default_rng(3)
+        reqs = [Request(prompt=rng.integers(0, cfg.vocab, (3,)).astype(
+                    np.int32), max_new_tokens=2) for _ in range(3)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run(watcher=_DrainNow())
+        lost = [r for r in reqs if not r.done]
+        assert lost                       # drain left queued requests
+        dump = sampler.dump()
+        roots = [sp for sp in dump["spans"]
+                 if sp["name"] == "request" and sp["attrs"].get("lost")]
+        assert len(roots) == len(lost)
+        for root in roots:
+            assert trace_gaps(dump, root["trace_id"]) == []
+
+
+class TestFinalStatsSplit:
+    @pytest.fixture(scope="class")
+    def engine_setup(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from tpu_autoscaler.workloads.model import (
+            ModelConfig,
+            init_params,
+        )
+
+        cfg = ModelConfig(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                          d_ff=32, seq_len=32, dtype=jnp.float32)
+        return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+    def test_receipt_carries_wait_exec_split(self, engine_setup):
+        import json
+
+        from tpu_autoscaler.workloads.serve import final_stats_payload
+        from tpu_autoscaler.workloads.serving import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        params, cfg = engine_setup
+        eng = ContinuousBatcher(params, cfg, slots=1, max_len=32,
+                                chunk=8)
+        rng = np.random.default_rng(4)
+        reqs = [Request(prompt=rng.integers(0, cfg.vocab, (4,)).astype(
+                    np.int32), max_new_tokens=2) for _ in range(3)]
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        out = final_stats_payload(reqs, eng, 0.5)
+        assert len(out["request_wait_ticks"]) == 3
+        assert len(out["request_exec_ticks"]) == 3
+        for lat, wait, ex in zip(out["request_latency_ticks"],
+                                 out["request_wait_ticks"],
+                                 out["request_exec_ticks"]):
+            assert lat == wait + ex
+            assert wait >= 0 and ex >= 0
+        # One slot: later requests waited for earlier ones.
+        assert max(out["request_wait_ticks"]) > 0
+        assert out["stats"]["first_scheduled_total"] == 3
+        assert out["stats"]["queue_wait_ticks_total"] == sum(
+            out["request_wait_ticks"])
+        json.dumps(out)

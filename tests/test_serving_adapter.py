@@ -410,3 +410,83 @@ class TestSharedTraffic:
             day * 0.75, day, 100.0, 10.0,
             spikes=((day * 0.7, day * 0.1, 3.0),))
         assert spiked == 30.0
+
+
+class TestExemplarPlumbing:
+    """ISSUE 14: request-trace exemplars through the fold (snapshot →
+    adapter → take_exemplars), with restart/stale handling."""
+
+    def _snap(self, rec):
+        return rec.snapshot()
+
+    def test_exemplar_taken_once_and_slowest_wins(self):
+        from tpu_autoscaler.serving.adapter import EXEMPLAR_FAMILY
+        from tpu_autoscaler.serving.stats import ServingStatsRecorder
+
+        adapter = ServingMetricsAdapter()
+        a, b = (ServingStatsRecorder(slots=4) for _ in range(2))
+        a.note_exemplar("request-a-r1", 9.0)
+        b.note_exemplar("request-b-r1", 30.0)
+        for _ in range(2):
+            a.end_tick(queue_depth=0, active=0, kv_used=0,
+                       kv_capacity=0, decode_tokens_total=0)
+            b.end_tick(queue_depth=0, active=0, kv_used=0,
+                       kv_capacity=0, decode_tokens_total=0)
+        adapter.ingest("a", "web", "ac", "v5e-4", self._snap(a), 1.0)
+        adapter.ingest("b", "web", "ac", "v5e-4", self._snap(b), 1.0)
+        taken = adapter.take_exemplars()
+        # Fleet's slowest candidate wins the family slot.
+        assert taken == {EXEMPLAR_FAMILY: ("request-b-r1", 30.0)}
+        # Drained: a re-delivery of the SAME exemplar seq never
+        # re-takes it.
+        adapter.ingest("a", "web", "ac", "v5e-4",
+                       self._snap(a), 2.0)
+        assert adapter.take_exemplars() == {}
+
+    def test_replica_restart_resets_exemplar_highwater(self):
+        from tpu_autoscaler.serving.stats import ServingStatsRecorder
+
+        adapter = ServingMetricsAdapter()
+        rec = ServingStatsRecorder(slots=4)
+        for i in range(5):
+            rec.note_exemplar(f"request-a-r{i}", float(i))
+        rec.end_tick(queue_depth=0, active=0, kv_used=0,
+                     kv_capacity=0, decode_tokens_total=0)
+        adapter.ingest("a", "web", "ac", "v5e-4", self._snap(rec),
+                       1.0)
+        adapter.take_exemplars()
+        # Restart: fresh recorder, exemplar_seq restarts at 1 — the
+        # old high-water mark (5) must not suppress it forever.
+        rec2 = ServingStatsRecorder(slots=4)
+        rec2.note_exemplar("request-a-reborn", 3.0)
+        rec2.end_tick(queue_depth=0, active=0, kv_used=0,
+                      kv_capacity=0, decode_tokens_total=0)
+        adapter.ingest("a", "web", "ac", "v5e-4", self._snap(rec2),
+                       2.0)
+        taken = adapter.take_exemplars()
+        assert list(taken.values()) == [("request-a-reborn", 3.0)]
+
+    def test_trace_counter_rates_fold_per_pool(self):
+        from tpu_autoscaler.serving.stats import ServingStatsRecorder
+
+        adapter = ServingMetricsAdapter()
+        rec = ServingStatsRecorder(slots=4)
+        rec.end_tick(queue_depth=0, active=0, kv_used=0,
+                     kv_capacity=0, decode_tokens_total=0)
+        adapter.ingest("a", "web", "ac", "v5e-4", self._snap(rec),
+                       0.0)
+        adapter.fold(0.0)
+        for _ in range(10):
+            rec.note_trace(tail=True)
+        rec.note_trace_drop()
+        rec.end_tick(queue_depth=0, active=0, kv_used=0,
+                     kv_capacity=0, decode_tokens_total=0)
+        adapter.ingest("a", "web", "ac", "v5e-4", self._snap(rec),
+                       10.0)
+        adapter.fold(10.0)
+        sig = adapter.signals()["web"]
+        assert sig.trace_sampled_per_s > 0.0
+        assert sig.trace_tail_per_s > 0.0
+        assert sig.trace_dropped_per_s > 0.0
+        # Incremental == rebuild still holds with the new columns.
+        assert adapter.drift() < 1e-9

@@ -101,3 +101,157 @@ class TestServingChaosProfile:
         fuzz.check(0.0)
         assert any(v.invariant == "serving-nonnegative-rates"
                    for v in run.monitor.violations)
+
+
+#: The traced twin of MINI (ISSUE 14): sampling on, so the e2e
+#: acceptance assertions run at tier-1 scale (the full 2.2M-user
+#: version lives in ``bench.py serving-trace``).
+TRACED = ServingReplayConfig(
+    seed=0, day_seconds=600.0, days=2, step=5.0,
+    peak_rps=80.0, trough_rps=16.0, spike_duration=60.0,
+    baseline_replicas=3, max_replicas=24, trace_sample_rate=0.01)
+
+
+class TestRequestTracing:
+    """ISSUE 14 acceptance, tier-1 scale: every SLO-missing cohort
+    tail-captured gap-free, exemplars resolving to retained traces,
+    and the tail-report attributing the miss onset to scale-up lag
+    with a working scaleup-* cross-link."""
+
+    @pytest.fixture(scope="class")
+    def traced(self):
+        artifacts = {}
+        result = replay(TRACED, mode="signal", artifacts=artifacts)
+        return result, artifacts
+
+    def test_every_missing_request_is_tail_captured_gap_free(
+            self, traced):
+        from tpu_autoscaler.obs.recorder import trace_gaps
+
+        result, artifacts = traced
+        assert result.unserved == 0
+        score = artifacts["score"]
+        dump = artifacts["controller"].recorder.dump()
+        roots = [s for s in dump["spans"]
+                 if s["name"] == "request"
+                 and s["attrs"].get("slo_miss")]
+        assert len(score.miss_cohorts) > 0
+        assert len(roots) == len(score.miss_cohorts)
+        by_trace = {}
+        for s in dump["spans"]:
+            by_trace.setdefault(s["trace_id"], []).append(s)
+        for root in roots:
+            tid = root["trace_id"]
+            assert trace_gaps({"spans": by_trace[tid]}, tid) == []
+
+    def test_bundle_exemplar_resolves_to_a_retained_trace(
+            self, traced):
+        from tpu_autoscaler.serving.adapter import EXEMPLAR_FAMILY
+
+        _result, artifacts = traced
+        controller = artifacts["controller"]
+        bundle = controller.incident_bundle("test")
+        rows = bundle["tsdb"]["exemplars"][EXEMPLAR_FAMILY]
+        assert rows
+        retained = {s["trace_id"] for s in bundle["spans"]}
+        assert rows[-1][2] in retained
+        # The serving-SLO alert fired during the overload and its
+        # firing summary named an exemplar trace.
+        state = controller.alerts.state_of("serving-slo-attainment")
+        assert state.fired_count >= 1
+
+    def test_tail_report_attributes_scaleup_lag_with_cross_link(
+            self, traced):
+        from tpu_autoscaler.obs import tailcause
+
+        _result, artifacts = traced
+        controller = artifacts["controller"]
+        bundle = controller.incident_bundle("test")
+        assert bundle["tailcause"]["tail_requests"] > 0
+        score = artifacts["score"]
+        onset = min(m[0] for m in score.miss_cohorts)
+        report = tailcause.analyze(bundle,
+                                   window=(onset, onset + 600.0))
+        assert report["dominant_cause"] == "scaleup-lag"
+        link = report["scaleup"]["trace_id"]
+        assert link.startswith("scaleup-")
+        assert any(s["trace_id"] == link for s in bundle["spans"])
+
+    def test_offline_replay_reproduces_the_bundle(self, traced,
+                                                  tmp_path):
+        import json
+
+        from tpu_autoscaler.obs.__main__ import main as replay_main
+
+        _result, artifacts = traced
+        bundle = artifacts["controller"].incident_bundle("test")
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(bundle, default=str))
+        assert replay_main(["replay", str(path), "-q"]) == 0
+
+    def test_untraced_replay_has_zero_sampler_footprint(self):
+        artifacts = {}
+        replay(MINI, mode="signal", artifacts=artifacts)
+        assert artifacts["samplers"] == []
+        dump = artifacts["controller"].recorder.dump()
+        assert not any(s["trace_id"].startswith("request-")
+                       for s in dump["spans"])
+
+
+class TestSlowDecodeChaosProfile:
+    def test_profile_generates_slow_decode(self):
+        from tpu_autoscaler.chaos.scenario import generate
+
+        programs = [generate(s, profile="serving") for s in range(16)]
+        kinds = {e.kind for p in programs for e in p.events}
+        assert "slow_decode" in kinds
+
+    def test_slow_decode_seed_green_with_tail_captures(self):
+        from tpu_autoscaler.chaos.engine import run_scenario
+        from tpu_autoscaler.chaos.scenario import generate
+
+        seed = next(s for s in range(40)
+                    if any(e.kind == "slow_decode"
+                           for e in generate(s,
+                                             profile="serving").events))
+        result = run_scenario(seed, profile="serving")
+        assert result.ok, result.violations
+
+    def test_gap_invariant_is_armed(self):
+        """Sabotage a sampler's retained spans: the per-step gap
+        check must catch the hole (proves the invariant has teeth)."""
+        from tpu_autoscaler.chaos.engine import _Run
+        from tpu_autoscaler.chaos.scenario import generate
+
+        run = _Run(generate(3, profile="serving"))
+        fuzz = run.serving_fuzz
+        assert fuzz is not None
+        for step in range(6):
+            fuzz.step(float(step * 5))
+        name = sorted(fuzz._samplers)[0]
+        sampler = fuzz._samplers[name]
+        # Drive one guaranteed promotion, then corrupt its tree by
+        # deleting a child span from the ring.
+        sampler.note_submit("sab", 0)
+        sampler.note_admit("sab", 1)
+        sampler.note_seeded("sab", 2)
+        tid = sampler.note_finish("sab", 99)  # tail (slo_ticks=4)
+        assert tid is not None
+        spans = sampler.recorder._spans
+        victim = next(s for s in spans
+                      if s.trace_id == tid and s.name == "decode")
+        spans.remove(victim)
+        fuzz.check_traces(99.0)
+        assert any(v.invariant == "reqtrace-gap-free"
+                   for v in run.monitor.violations)
+
+    def test_sampler_memory_bounded_under_restart_and_churn(self):
+        from tpu_autoscaler.chaos.engine import run_scenario
+        from tpu_autoscaler.chaos.scenario import generate
+
+        program = generate(5, profile="serving")
+        assert any(e.kind in ("replica_restart", "counter_reset",
+                              "stale_burst", "replica_churn")
+                   for e in program.events)
+        result = run_scenario(program)
+        assert result.ok, result.violations

@@ -1,0 +1,228 @@
+"""Tail-latency root-cause attribution (ISSUE 14, obs/tailcause.py)
+and its surfaces: the ``tail-report`` CLI, capture-time recording in
+incident bundles, and the offline-replay divergence gate.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_autoscaler.obs import tailcause
+from tpu_autoscaler.obs.recorder import FlightRecorder
+from tpu_autoscaler.obs.trace import Tracer
+from tpu_autoscaler.serving.reqtrace import RequestTraceSampler
+
+
+def _bundle(*, queue_heavy: bool = True, scaleup: bool = True,
+            tsdb: bool = True) -> dict:
+    """Synthetic bundle: a few tail request traces plus (optionally)
+    an overlapping scale-up trace and TSDB context."""
+    rec = FlightRecorder()
+    if scaleup:
+        tracer = Tracer(recorder=rec, clock=lambda: 0.0)
+        root = tracer.start("scale_up", trace_id="scaleup-t-1",
+                            t=100.0, attrs={"gang": "serve-web-9"})
+        tracer.record("provision", start=102.0, end=180.0,
+                      parent=root)
+        tracer.record("pods_running", start=180.0, end=200.0,
+                      parent=root)
+        tracer.end(root, t=200.0)
+    s = RequestTraceSampler("rep", sample_rate=0.0, slo_ticks=15.0,
+                            recorder=rec)
+    for i in range(4):
+        if queue_heavy:
+            s.note_cohort(f"c{i}", arrival=110.0 + i,
+                          finish=150.0 + i, n=5, exec_time=2.0)
+        else:
+            # Decode-dominated: admitted immediately, slow execution.
+            s.note_submit(f"c{i}", 110.0 + i)
+            s.note_admit(f"c{i}", 111.0 + i)
+            s.note_seeded(f"c{i}", 112.0 + i)
+            s.note_finish(f"c{i}", 150.0 + i)
+    out = rec.dump()
+    if tsdb:
+        out["tsdb"] = {"series": {
+            "serving_queue_depth": {"raw": [[100.0, 2.0],
+                                            [140.0, 250.0]]},
+            "serving_kv_occupancy": {"raw": [[100.0, 0.4]]},
+        }}
+    return out
+
+
+class TestAnalyze:
+    def test_queue_dominated_tail_links_scaleup(self):
+        report = tailcause.analyze(_bundle())
+        assert report["tail_requests"] == 4
+        assert report["tail_cohort_weight"] == 20
+        assert report["dominant_phase"] == "queue_wait"
+        assert report["dominant_cause"] == "scaleup-lag"
+        assert report["scaleup"]["trace_id"] == "scaleup-t-1"
+        assert report["scaleup"]["phases"]["provision"] == 78.0
+        assert report["correlates"]["serving_queue_depth"]["max"] \
+            == 250.0
+
+    def test_queue_dominated_without_scaleup_is_queue_wait(self):
+        report = tailcause.analyze(_bundle(scaleup=False))
+        assert report["dominant_cause"] == "queue-wait"
+        assert "scaleup" not in report
+
+    def test_decode_dominated_tail(self):
+        report = tailcause.analyze(_bundle(queue_heavy=False))
+        assert report["dominant_phase"] == "decode"
+        assert report["dominant_cause"] == "decode"
+
+    def test_window_filters_tail_set(self):
+        report = tailcause.analyze(_bundle(), window=(0.0, 50.0))
+        assert report["tail_requests"] == 0
+        assert report["dominant_cause"] is None
+
+    def test_no_request_traces_is_empty_not_an_error(self):
+        rec = FlightRecorder()
+        report = tailcause.analyze(rec.dump())
+        assert report["tail_requests"] == 0
+        assert "tracing was off" in tailcause.render_report(report)
+
+    def test_render_names_the_chain(self):
+        text = tailcause.render_report(tailcause.analyze(_bundle()))
+        assert "dominant cause: scaleup-lag" in text
+        assert "scaleup-t-1" in text
+        assert "queue_wait" in text
+
+    def test_alert_breach_window_is_the_default(self):
+        bundle = _bundle()
+        bundle["alerts"] = {
+            "rules": [{"name": "serving-slo-attainment",
+                       "window": 600.0}],
+            "state": {"serving-slo-attainment": {
+                "firing": True, "fired_at": 700.0,
+                "fired_count": 1}}}
+        bundle["bundle"] = {"captured_at": 720.0}
+        # Breach window [100, 720] contains the tail set.
+        assert tailcause.analyze(bundle)["tail_requests"] == 4
+        bundle["alerts"]["state"]["serving-slo-attainment"][
+            "fired_at"] = 5000.0
+        # Breach window [4400, ...] excludes it.
+        assert tailcause.analyze(bundle)["tail_requests"] == 0
+
+
+class TestOfflineDivergence:
+    def test_replay_reproduces_recorded_tailcause(self, tmp_path):
+        from tpu_autoscaler.obs.__main__ import main as replay_main
+
+        bundle = _bundle()
+        bundle["tailcause"] = tailcause.analyze(bundle)
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(bundle))
+        assert replay_main(["replay", str(path), "-q"]) == 0
+
+    def test_replay_exits_2_on_dominant_cause_divergence(self,
+                                                         tmp_path):
+        from tpu_autoscaler.obs.__main__ import main as replay_main
+
+        bundle = _bundle()
+        recorded = tailcause.analyze(bundle)
+        recorded["dominant_cause"] = "decode"   # tampered verdict
+        bundle["tailcause"] = recorded
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(bundle))
+        assert replay_main(["replay", str(path), "-q"]) == 2
+
+    def test_replay_exits_2_when_capture_recorded_nothing(self,
+                                                          tmp_path):
+        """Both ways: a bundle WITH tail traces but no recorded
+        tail-report means the capture-side analyzer failed."""
+        from tpu_autoscaler.obs.__main__ import main as replay_main
+
+        bundle = _bundle()
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(bundle))
+        assert replay_main(["replay", str(path), "-q"]) == 2
+
+    def test_pre_issue14_bundle_without_request_traces_still_passes(
+            self, tmp_path):
+        from tpu_autoscaler.obs.__main__ import main as replay_main
+
+        rec = FlightRecorder()
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(rec.dump()))
+        assert replay_main(["replay", str(path), "-q"]) == 0
+
+
+class TestCli:
+    def test_tail_report_from_bundle(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tpu_autoscaler.main import cli
+
+        bundle = _bundle()
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(bundle))
+        result = CliRunner().invoke(
+            cli, ["tail-report", "--from", str(path)])
+        assert result.exit_code == 0, result.output
+        assert "scaleup-lag" in result.output
+        assert "scaleup-t-1" in result.output
+
+    def test_tail_report_json(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tpu_autoscaler.main import cli
+
+        path = tmp_path / "bundle.json"
+        path.write_text(json.dumps(_bundle()))
+        result = CliRunner().invoke(
+            cli, ["tail-report", "--from", str(path), "--json"])
+        assert result.exit_code == 0, result.output
+        body = json.loads(result.output)
+        assert body["dominant_cause"] == "scaleup-lag"
+
+    def test_metrics_history_renders_exemplar(self, tmp_path):
+        from click.testing import CliRunner
+
+        from tpu_autoscaler.main import cli
+        from tpu_autoscaler.obs.tsdb import TimeSeriesDB
+
+        db = TimeSeriesDB()
+        db.append("serving_request_latency_ticks:le:10", 1.0, 3.0)
+        db.append_exemplar("serving_request_latency_ticks", 1.0, 9.0,
+                           "request-rep-r1")
+        path = tmp_path / "dump.json"
+        path.write_text(json.dumps({"tsdb": db.dump()}))
+        result = CliRunner().invoke(
+            cli, ["metrics-history", "--from", str(path),
+                  "serving_request_latency_ticks:le:10"])
+        assert result.exit_code == 0, result.output
+        assert "request-rep-r1" in result.output
+
+
+class TestAlertExemplar:
+    def test_firing_transition_carries_exemplar(self):
+        from tpu_autoscaler.obs.alerts import AlertEngine, AlertRule
+        from tpu_autoscaler.obs.tsdb import TimeSeriesDB
+
+        db = TimeSeriesDB()
+        for t in range(0, 100, 5):
+            db.append("serving_slo_attainment", float(t), 0.5)
+        db.append_exemplar("serving_request_latency_ticks", 90.0,
+                           42.0, "request-rep-r7")
+        engine = AlertEngine((AlertRule(
+            name="serving-slo-attainment",
+            metric="serving_slo_attainment", kind="gauge_below",
+            window=60.0, threshold=0.9, for_passes=2,
+            clear_passes=3,
+            exemplar_family="serving_request_latency_ticks"),))
+        transitions = []
+        for t in (95.0, 100.0, 105.0):
+            transitions += engine.evaluate(db, t).transitions
+        fired = [tr for tr in transitions if tr.firing]
+        assert fired
+        assert fired[0].exemplar[2] == "request-rep-r7"
+        assert "request-rep-r7" in fired[0].summary
+
+
+@pytest.mark.parametrize("queue_heavy", [True, False])
+def test_analysis_is_deterministic(queue_heavy):
+    bundle = _bundle(queue_heavy=queue_heavy)
+    assert tailcause.analyze(bundle) == tailcause.analyze(bundle)

@@ -357,3 +357,104 @@ class TestGuardedReads:
         assert body.get("unavailable") == "mutating"
         with pytest.raises(TornRead):
             db.points("s", 0.0, 1.0)
+
+
+class TestExemplars:
+    """ISSUE 14 property suite: a histogram family's exemplar is a
+    real member of that pass's observations, survives downsampling
+    tiers and dump/from_dump, and never leaks across series — even on
+    the 20k-series cap path."""
+
+    def test_exemplar_is_a_member_of_the_pass_observations(self):
+        from tpu_autoscaler.metrics import Metrics
+
+        metrics = Metrics()
+        metrics.declare_histogram("serving_request_latency_ticks",
+                                  (1.0, 10.0, 100.0))
+        db = TimeSeriesDB()
+        for p in range(1, 20):
+            value = float(p % 7 + 1)
+            tid = f"request-rep-r{p}"
+            # The reconciler's contract: observe the exemplar's value
+            # into the family THIS pass, then ingest the pair.
+            metrics.observe("serving_request_latency_ticks", value)
+            snap = metrics.snapshot()
+            db.ingest(snap, float(p * 5),
+                      exemplars={"serving_request_latency_ticks":
+                                 (tid, value)})
+            # The exemplar's value equals the summary's last
+            # observation of the same pass — membership by
+            # construction, asserted.
+            last = snap["summaries"][
+                "serving_request_latency_ticks"]["last"]
+            t, v, got = db.exemplar_latest(
+                "serving_request_latency_ticks")
+            assert (t, v, got) == (float(p * 5), last, tid)
+        assert db.exemplars_appended == 19
+
+    def test_exemplars_survive_tier_downsampling_and_dump_roundtrip(
+            self):
+        # Tiny raw ring: old points evict into the mid/coarse tiers,
+        # but the exemplar from the evicted window must survive (a
+        # trace id cannot be downsampled).
+        db = TimeSeriesDB(raw_points=8)
+        db.append_exemplar("fam", 1.0, 50.0, "request-old-r1")
+        for p in range(200):
+            db.append("fam:le:10", float(p), float(p))
+        series = db._series["fam:le:10"]
+        assert series.raw.n > series.raw.capacity  # raw ring wrapped
+        assert db.exemplar_latest("fam")[2] == "request-old-r1"
+        rebuilt = TimeSeriesDB.from_dump(db.dump())
+        assert rebuilt.exemplar_latest("fam") \
+            == db.exemplar_latest("fam")
+        assert rebuilt.exemplars("fam") == db.exemplars("fam")
+
+    def test_exemplar_ring_is_bounded(self):
+        from tpu_autoscaler.obs.tsdb import EXEMPLAR_RING
+
+        db = TimeSeriesDB()
+        for i in range(EXEMPLAR_RING * 3):
+            db.append_exemplar("fam", float(i), 1.0, f"t{i}")
+        kept = db.exemplars("fam")
+        assert len(kept) == EXEMPLAR_RING
+        assert kept[-1][2] == f"t{EXEMPLAR_RING * 3 - 1}"
+
+    def test_no_cross_family_leak_on_the_series_cap_path(self):
+        # Fill the store to its series cap, then ingest exemplars for
+        # both retained and capped-out families: every exemplar stays
+        # under exactly the family it was attached to.
+        db = TimeSeriesDB(max_series=16)
+        for i in range(40):
+            db.ingest({"gauges": {f"g{i}": 1.0}}, float(i))
+        assert db.series_count() == 16
+        assert db.series_dropped > 0
+        for i in range(40):
+            db.ingest({"gauges": {f"g{i}": 2.0}}, 100.0 + i,
+                      exemplars={f"g{i}": (f"trace-{i}", float(i))})
+        for i in range(40):
+            rows = db.exemplars(f"g{i}")
+            assert all(tid == f"trace-{i}" for _t, _v, tid in rows)
+            assert rows, f"exemplar for g{i} vanished"
+        dump = db.dump()
+        for fam, rows in dump["exemplars"].items():
+            assert all(tid == f"trace-{fam[1:]}"
+                       for _t, _v, tid in rows)
+
+    def test_exemplar_family_cap_degrades_counted(self):
+        from tpu_autoscaler.obs.tsdb import MAX_EXEMPLAR_FAMILIES
+
+        db = TimeSeriesDB()
+        for i in range(MAX_EXEMPLAR_FAMILIES + 10):
+            db.append_exemplar(f"fam{i}", 0.0, 1.0, "t")
+        assert len(db.dump()["exemplars"]) == MAX_EXEMPLAR_FAMILIES
+        assert db.exemplars_dropped == 10
+
+    def test_dump_prefix_and_window_filter_exemplars(self):
+        db = TimeSeriesDB()
+        db.append_exemplar("serving_x", 10.0, 1.0, "t1")
+        db.append_exemplar("serving_x", 90.0, 2.0, "t2")
+        db.append_exemplar("other", 90.0, 3.0, "t3")
+        body = db.dump(prefix="serving_")
+        assert set(body["exemplars"]) == {"serving_x"}
+        body = db.dump(window_seconds=30.0, now=100.0)
+        assert [r[2] for r in body["exemplars"]["serving_x"]] == ["t2"]

@@ -130,9 +130,25 @@ class _ServingFuzz:
       (CapacityView-consistency, tests/test_serving_adapter.py's
       property at corpus scale).
 
+    ISSUE 14 extends the fuzz to the request-trace samplers: every
+    replica owns a :class:`RequestTraceSampler` driven through its
+    full event API (submit/admit/seeded/preempt/finish) by a seeded
+    synthetic request stream; a ``slow_decode`` event inflates one
+    replica's decode durations past the SLO bound so tail capture
+    fires mid-fault.  Step invariants:
+
+    - every promoted request trace has a GAP-FREE span tree
+      (``obs.trace_gaps`` — additive over the trace catalog);
+    - sampler memory stays bounded (pending tracking and retained
+      rings) through ``replica_restart`` / ``counter_reset`` / churn.
+
     Load ramps to zero before the quiet tail so the ServingScaler's
     advisory demand drains and convergence stays decidable.
     """
+
+    #: Sampler bounds: small enough that the per-step gap check stays
+    #: cheap at corpus scale, large enough to hold a fault window.
+    TRACE_BOUNDS = dict(max_traces=24, max_pending=64, max_events=32)
 
     def __init__(self, program: ScenarioProgram, adapter,
                  monitor: InvariantMonitor) -> None:
@@ -149,6 +165,16 @@ class _ServingFuzz:
         self.shape = "v5e-4"
         self.accel = "tpu-v5-lite-device"
         self._replicas: dict[str, object] = {}
+        self._samplers: dict[str, object] = {}
+        #: Per-replica synthetic request stream state (tick counter,
+        #: rid counter, open [rid, admitted] entries).
+        self._req_state: dict[str, dict] = {}
+        #: Trace ids already gap-checked (ids, not spans — bounded by
+        #: the run's promotion count).
+        self._validated: set[str] = set()
+        self._last_spans_recorded: dict[str, int] = {}
+        #: name -> (window end, factor) decode inflation (slow_decode).
+        self._slow_until: dict[str, tuple[float, float]] = {}
         self._last_snap: dict[str, object] = {}
         self._seq = 0
         for _ in range(self.rng.randint(3, 6)):
@@ -161,9 +187,23 @@ class _ServingFuzz:
     def _add_replica(self) -> None:
         self._seq += 1
         name = f"fuzz-rep-{self._seq}"
-        self._replicas[name] = self._recorder_cls(slots=16, slo_ticks=4)
+        self._attach_replica(name)
 
-    def apply_event(self, event) -> None:
+    def _attach_replica(self, name: str) -> None:
+        """Fresh recorder + sampler pair (initial add AND restart —
+        a restarted replica's sampler restarts with it; its old
+        pending set must be dropped, not leaked)."""
+        from tpu_autoscaler.serving.reqtrace import RequestTraceSampler
+
+        rec = self._recorder_cls(slots=16, slo_ticks=4)
+        self._replicas[name] = rec
+        self._samplers[name] = RequestTraceSampler(
+            name, sample_rate=0.1, slo_ticks=4, stats=rec,
+            **self.TRACE_BOUNDS)
+        self._req_state[name] = {"tick": 0, "n": 0, "open": []}
+        self._last_spans_recorded[name] = 0
+
+    def apply_event(self, event, t: float = 0.0) -> None:
         kind = event.kind
         if kind == "replica_restart":
             self._restart_next = True
@@ -171,6 +211,13 @@ class _ServingFuzz:
             self._reset_next = True
         elif kind == "stale_burst":
             self._stale_budget += event.args["count"]
+        elif kind == "slow_decode":
+            # ISSUE 14: one replica's decode durations inflate for the
+            # window — completions blow the SLO bound, the sampler's
+            # tail capture must fire and stay gap-free/bounded.
+            name = self.rng.choice(sorted(self._replicas))
+            self._slow_until[name] = (t + event.args["duration"],
+                                      event.args["factor"])
         elif kind == "replica_churn":
             for _ in range(event.args.get("add", 0)):
                 self._add_replica()
@@ -178,6 +225,10 @@ class _ServingFuzz:
                 if len(self._replicas) > 1:
                     name = self.rng.choice(sorted(self._replicas))
                     del self._replicas[name]
+                    del self._samplers[name]
+                    self._req_state.pop(name, None)
+                    self._slow_until.pop(name, None)
+                    self._last_spans_recorded.pop(name, None)
                     self._last_snap.pop(name, None)
                     self.adapter.remove(name)
         else:
@@ -200,11 +251,13 @@ class _ServingFuzz:
             self._restart_next = False
             name = rng.choice(sorted(self._replicas))
             # Mid-window restart: fresh recorder, fresh epoch — the
-            # adapter must treat the zeroed counters as a reset.
-            self._replicas[name] = self._recorder_cls(slots=16,
-                                                      slo_ticks=4)
+            # adapter must treat the zeroed counters as a reset, and
+            # the sampler restarts WITH the replica (its pending set
+            # dies with the process it mirrors).
+            self._attach_replica(name)
         for name in sorted(self._replicas):
             rec = self._replicas[name]
+            self._drive_requests(name, t)
             load = self._load(t)
             for _ in range(rng.randint(1, 3)):
                 done = rng.randint(0, min(8, load + 4))
@@ -241,10 +294,91 @@ class _ServingFuzz:
                                     self.shape, snap, now=t)
             self._last_snap[name] = snap
 
+    def _drive_requests(self, name: str, t: float) -> None:
+        """Advance one replica's synthetic request stream through the
+        sampler's full event API.  Decode durations inflate inside an
+        open ``slow_decode`` window — those completions miss the SLO
+        and must be tail-captured."""
+        from tpu_autoscaler.chaos.scenario import QUIET_TAIL
+
+        sampler = self._samplers.get(name)
+        if sampler is None:
+            return
+        rng = self.rng
+        st = self._req_state[name]
+        tick = st["tick"]
+        driven = t < self.program.until - QUIET_TAIL
+        if driven:
+            for _ in range(rng.randint(0, 3)):
+                st["n"] += 1
+                rid = f"q{st['n']}"
+                sampler.note_submit(rid, tick)
+                st["open"].append([rid, False])
+        tick += rng.randint(1, 3)
+        keep: list[list] = []
+        # Force progress on the oldest entries once the backlog grows
+        # (bounded open set; in the quiet tail everything completes so
+        # sampler pending drains to zero before terminal).
+        force = len(st["open"]) > 24 or not driven
+        for ent in st["open"]:
+            rid, admitted = ent
+            if not admitted:
+                sampler.note_admit(rid, tick)
+                sampler.note_seeded(rid, tick)
+                ent[1] = True
+                keep.append(ent)
+                continue
+            r = rng.random()
+            if driven and r < 0.1:
+                sampler.note_preempt(rid, tick)
+                sampler.note_admit(rid, tick + 1)
+                sampler.note_seeded(rid, tick + 1)
+                keep.append(ent)
+            elif force or r < 0.6:
+                dur = rng.randint(0, 3)
+                slow = self._slow_until.get(name)
+                if slow is not None and t < slow[0]:
+                    dur = int(dur * slow[1]) + int(slow[1])
+                sampler.note_finish(rid, tick + dur, tokens=dur + 1)
+            else:
+                keep.append(ent)
+        st["open"] = keep
+        st["tick"] = tick + 1
+
+    def check_traces(self, t: float) -> None:
+        """ISSUE 14 step invariants: every promoted request trace is
+        gap-free (``trace_gaps`` — tail captures included by
+        construction), and sampler memory stays bounded.  O(new
+        traces) per step: replicas whose rings did not grow since the
+        last check are skipped."""
+        from tpu_autoscaler.obs import trace_gaps
+
+        for name in sorted(self._samplers):
+            sampler = self._samplers[name]
+            if sampler.pending > sampler.max_pending:
+                self.monitor._fail(
+                    t, "reqtrace-bounded",
+                    f"{name}: sampler pending {sampler.pending} "
+                    f"exceeds max_pending {sampler.max_pending}")
+            recorded = sampler.recorder._spans_recorded
+            if recorded == self._last_spans_recorded.get(name):
+                continue
+            self._last_spans_recorded[name] = recorded
+            dump = sampler.dump()
+            for span in dump["spans"]:
+                if span["name"] != "request" \
+                        or span["trace_id"] in self._validated:
+                    continue
+                self._validated.add(span["trace_id"])
+                for gap in trace_gaps(dump, span["trace_id"]):
+                    self.monitor._fail(t, "reqtrace-gap-free", gap)
+
     def check(self, t: float) -> None:
         """Step invariants over the folded signals (the reconcile pass
         the controller just ran did the fold)."""
         import numpy as np
+
+        self.check_traces(t)
 
         # RAW pool sums, not the clamped PoolSignal view (the export
         # clamps defensively; the invariant is that the fold never
@@ -676,8 +810,8 @@ class _Run:
                         if r[1].job != spec["workload"]]
         elif self.serving_fuzz is not None and kind in (
                 "replica_restart", "counter_reset", "stale_burst",
-                "replica_churn"):
-            self.serving_fuzz.apply_event(event)
+                "replica_churn", "slow_decode"):
+            self.serving_fuzz.apply_event(event, t)
         else:
             raise ValueError(f"unknown chaos event kind {kind!r}")
 

@@ -18,7 +18,14 @@ Event kinds (args in parentheses):
 - ``host_fail`` (mode)        — one host of a live multi-host slice
                                 goes NotReady or is deleted (the
                                 partial-slice failure slice repair
-                                exists for).
+                                exists for);
+- ``slow_decode`` (duration, factor) — serving profile only: one
+                                fuzz replica's decode durations
+                                multiply for the window, pushing its
+                                completions past the SLO bound — the
+                                request-trace sampler's tail capture
+                                must fire, gap-free and bounded
+                                (ISSUE 14).
 
 Workloads (ISSUE 8 additions):
 
@@ -288,6 +295,18 @@ def generate(seed: int, *, profile: str = "mixed",
                                 "replica_churn",
                                 {"add": rng.randint(0, 2),
                                  "remove": rng.randint(0, 2)}))
+        # ISSUE 14 (derived stream: legacy serving seed programs keep
+        # their exact draws): a per-replica decode-tick inflation
+        # window — one replica's decode durations multiply by
+        # ``factor`` for ``duration`` sim-seconds, pushing its
+        # completions past the SLO bound so the request-trace
+        # sampler's tail capture fires under the full fault alphabet.
+        rng_sd = random.Random(seed ^ 0x51DEC)
+        if rng_sd.random() < 0.6:
+            events.append(Event(
+                rng_sd.uniform(60.0, 280.0), "slow_decode",
+                {"duration": rng_sd.uniform(30.0, 90.0),
+                 "factor": rng_sd.uniform(3.0, 8.0)}))
 
     repack_spot_shapes: tuple[str, ...] = ()
     if profile == "repack":

@@ -180,6 +180,12 @@ LATENCY_BUCKETS: tuple[float, ...] = (
     0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0, 240.0, 360.0, 600.0,
     1200.0)
 
+# Request-latency histogram bounds in ENGINE TICKS (ISSUE 14): the
+# data plane's clock is its own tick counter, not wall seconds.
+REQUEST_LATENCY_TICK_BUCKETS: tuple[float, ...] = (
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0, 1000.0,
+    2000.0)
+
 # The per-gang phase anatomy of scale_up_latency_seconds (SURVEY §4.2):
 #   detect    — gang first seen Unschedulable → provision submitted
 #   provision — provision submitted → slice ACTIVE (VM boot + registration)
@@ -297,6 +303,17 @@ class Controller:
         self.tracker = SliceTracker()
         for name in PHASE_LATENCY_METRICS:
             self.metrics.declare_histogram(name, LATENCY_BUCKETS)
+        # Data-plane request latency (ISSUE 14): fed by the exemplar
+        # path — each pass the adapter's taken exemplar value (a
+        # sampled request's latency, in engine ticks) is observed into
+        # this family, so its TSDB series and the exemplar that links
+        # them to a concrete trace are born from the same pass.
+        self.metrics.declare_histogram("serving_request_latency_ticks",
+                                       REQUEST_LATENCY_TICK_BUCKETS)
+        # Pending (family -> (trace_id, value)) exemplars minted by
+        # control-plane span closes this pass (e.g. the scale_up
+        # root); drained into the TSDB by _obs_pass.
+        self._span_exemplars: dict[str, tuple[str, float]] = {}
         # Gang lifecycle: first time each gang was seen Unschedulable, for
         # the north-star latency metric; cleared when the gang runs.
         self._gang_first_pending: dict[tuple, float] = {}
@@ -1780,7 +1797,9 @@ class Controller:
         never its scaling.  Returns the pass record's ``alerts``
         section (empty when nothing is active or transitioning)."""
         try:
-            self.tsdb.ingest(self.metrics.snapshot(), now)
+            exemplars = self._take_exemplars()
+            self.tsdb.ingest(self.metrics.snapshot(), now,
+                             exemplars=exemplars)
             self.metrics.set_gauge("tsdb_series",
                                    self.tsdb.series_count())
             if self.tsdb.series_dropped:
@@ -1825,6 +1844,37 @@ class Controller:
         if result.active or result.transitions:
             return {"active": list(result.active)}
         return {}
+
+    def _take_exemplars(self) -> dict[str, tuple[str, float]]:
+        """This pass's (trace_id, value) exemplars, one per histogram
+        family (ISSUE 14, docs/OBSERVABILITY.md "Request spans &
+        exemplars"):
+
+        - the serving adapter's taken exemplar — a sampled slow
+          request's latency, whose value is observed into
+          ``serving_request_latency_ticks`` HERE (the engines are
+          out-of-process; their latencies reach the registry only
+          through this path), so the exemplar is always a member of
+          the same pass's observations;
+        - control-plane span exemplars (``_span_exemplars``, e.g. the
+          ``scale_up`` root close) whose values the tracer already
+          observed — they must NOT be re-observed.
+        """
+        ex: dict[str, tuple[str, float]] = {}
+        if self.serving_scaler is not None:
+            adapter = getattr(self.serving_scaler, "adapter", None)
+            if adapter is not None \
+                    and hasattr(adapter, "take_exemplars"):
+                for family, (tid, value) in \
+                        adapter.take_exemplars().items():
+                    self.metrics.observe(family, value)
+                    ex[family] = (tid, value)
+        ex.update(self._span_exemplars)
+        self._span_exemplars.clear()
+        if ex:
+            self.metrics.inc("tsdb_exemplars_ingested",
+                             float(len(ex)))
+        return ex
 
     # ---- cost attribution ledger (ISSUE 11) ---------------------------- #
 
@@ -1903,6 +1953,19 @@ class Controller:
         # repack-report --from <bundle>` renders the migration ledger
         # an incident was captured under.
         out["repack"] = self.repack_route()
+        # Tail-latency root-cause attribution recorded AT CAPTURE TIME
+        # (ISSUE 14): the offline replay recomputes the same analysis
+        # from the bundle and exits 2 on dominant-cause divergence —
+        # crash-only, a broken analyzer degrades the bundle, never
+        # the capture.
+        try:
+            from tpu_autoscaler.obs import tailcause
+
+            out["tailcause"] = tailcause.analyze(out)
+        except Exception:  # noqa: BLE001 — diagnostics only
+            self.metrics.inc("tailcause_errors")
+            log.exception("tailcause analysis failed; bundle carries "
+                          "no tail-report section")
         out["informer"] = self._informer_digest()
         cfg = self.config
         out["config"] = {
@@ -2732,6 +2795,17 @@ class Controller:
                     self.tracer.end(root, t=now,
                                     metric="scale_up_latency_seconds",
                                     value=latency, attrs=attrs)
+                    # Histogram exemplar (ISSUE 14): this pass's
+                    # north-star p99 links to the SLOWEST scale-up
+                    # trace that closed in it.  Value already observed
+                    # by the span end above — _obs_pass must not
+                    # re-observe it.
+                    cur = self._span_exemplars.get(
+                        "scale_up_latency_seconds")
+                    if cur is None or latency >= cur[1]:
+                        self._span_exemplars[
+                            "scale_up_latency_seconds"] = (
+                                root.trace_id, latency)
                 else:
                     self.metrics.observe("scale_up_latency_seconds",
                                          latency)

@@ -849,6 +849,14 @@ def metrics_history(source, url, series, prefix, window, max_points,
     click.echo(f"raw ({len(raw)} points, showing {max_points}):")
     for t, v in raw[-max_points:]:
         click.echo(f"  t={t:g}  {v:g}")
+    # Exemplars (ISSUE 14): a histogram family's series resolve to a
+    # concrete sampled trace — the bucket series are named
+    # ``family:le:<bound>``, so match on the family prefix.
+    for fam, rows in sorted(dump.get("exemplars", {}).items()):
+        if rows and (series == fam or series.startswith(f"{fam}:")):
+            t, v, tid = rows[-1]
+            click.echo(f"exemplar: trace {tid}  value={v:g}  "
+                       f"@{t:g}  (tpu-autoscaler trace {tid})")
 
 
 @cli.command("cost-report")
@@ -932,6 +940,46 @@ def repack_report(source, url):
                    "mutating; retry)")
         return
     click.echo(render_repack(body))
+
+
+@cli.command("tail-report")
+@dump_options
+@click.option("--window", nargs=2, type=float, default=None,
+              help="Analysis window [START END] in controller time "
+                   "(default: the serving-SLO alert's breach window "
+                   "when the source carries one, else all retained "
+                   "tail captures).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable report.")
+def tail_report(source, url, window, as_json):
+    """Tail-latency root-cause attribution (docs/OBSERVABILITY.md
+    "Request spans & exemplars"): decompose the sampled SLO-missing
+    requests into attributed phases (queue wait / prefill / decode /
+    preemption requeue / drain), correlate with the TSDB (KV
+    occupancy, queue depth, preemption rate), and — when the tail is
+    dominated by requests waiting for capacity — cross-link the
+    ``scaleup-*`` control-plane trace whose provision would have
+    absorbed it: one causal chain from user-visible p99 burn down to
+    stockout/quota/actuation latency."""
+    import json as _json
+
+    from tpu_autoscaler.obs import tailcause
+
+    _require_one_source(source, url, "an incident bundle")
+    if source:
+        bundle = _read_dump_file(source)
+    else:
+        # Assemble the analyzer's bundle shape from the live debug
+        # endpoints: spans + alerts from /debugz, history + exemplars
+        # from /debugz/tsdb.
+        bundle = _fetch_debugz(url, "/debugz")
+        bundle["tsdb"] = _fetch_debugz(url, "/debugz/tsdb")
+    report = tailcause.analyze(
+        bundle, window=tuple(window) if window else None)
+    if as_json:
+        click.echo(_json.dumps(report, indent=2, default=str))
+        return
+    click.echo(tailcause.render_report(report))
 
 
 @cli.command()

@@ -14,7 +14,11 @@
                  reconcile pass (the autoscaler watches itself);
 - ``blackbox`` — atomic incident bundles on alert fire / SIGUSR1;
                  replayed offline via ``python -m tpu_autoscaler.obs
-                 replay``.
+                 replay``;
+- ``tailcause`` — tail-latency root-cause attribution over sampled
+                 request traces (ISSUE 14): phase decomposition, TSDB
+                 correlation, and the scale-up cross-link behind the
+                 ``tail-report`` CLI.
 """
 
 from tpu_autoscaler.obs.alerts import (
@@ -27,6 +31,10 @@ from tpu_autoscaler.obs.recorder import (
     FlightRecorder,
     install_sigusr1,
     trace_gaps,
+)
+from tpu_autoscaler.obs.tailcause import (
+    analyze as tail_analyze,
+    render_report as render_tail_report,
 )
 from tpu_autoscaler.obs.trace import (
     Span,
@@ -51,5 +59,7 @@ __all__ = [
     "install_sigusr1",
     "load_bundle",
     "maybe_span",
+    "render_tail_report",
+    "tail_analyze",
     "trace_gaps",
 ]

@@ -9,9 +9,18 @@ the bundle, instantiating a fresh engine from the bundled rule set,
 and stepping it over the recorded pass timestamps — then checks the
 offline firing decision against what the live controller recorded.
 
+ISSUE 14 extends the same discipline to the data plane: the replay
+renders the bundle's sampled ``request-*`` traces and re-runs the
+tail-report (obs/tailcause.py) offline; when the bundle recorded a
+tail-report at capture time, the offline dominant-cause attribution
+must match it — both ways (a recorded report the offline run cannot
+reproduce AND an offline report the capture never recorded are
+divergence).
+
 Exit codes (tests and the chaos alert gate key on them):
 
-- 0 — offline evaluation reproduces the live firing decision;
+- 0 — offline evaluation reproduces the live firing decision (and
+      the capture-time tail-report, when recorded);
 - 2 — divergence (the bundle's recorded state and the offline
       re-evaluation disagree — evidence of nondeterminism or a rule
       evaluation bug);
@@ -29,6 +38,7 @@ import argparse
 import sys
 from typing import Any
 
+from tpu_autoscaler.obs import tailcause
 from tpu_autoscaler.obs.alerts import AlertEngine
 from tpu_autoscaler.obs.blackbox import load_bundle
 from tpu_autoscaler.obs.render import list_traces, render_passes
@@ -102,6 +112,37 @@ def replay_alerts(bundle: dict[str, Any]) -> dict[str, Any]:
     }
 
 
+def replay_tailcause(bundle: dict[str, Any]) -> dict[str, Any]:
+    """Re-run the tail-report offline and compare its dominant-cause
+    attribution with the one recorded at capture time.  Both-ways:
+    a recorded verdict the offline run contradicts AND an offline
+    verdict where none was recorded (on a bundle that HAS request
+    traces) are divergence."""
+    offline = tailcause.analyze(bundle)
+    recorded = bundle.get("tailcause")
+    report: dict[str, Any] = {
+        "offline_dominant": offline.get("dominant_cause"),
+        "offline_tail_requests": offline.get("tail_requests", 0),
+        "offline": offline,
+    }
+    if recorded is None:
+        # A pre-ISSUE-14 bundle (or the analyzer crashed at capture):
+        # comparable only when the offline run finds a tail — then
+        # the capture SHOULD have recorded one.
+        report["recorded_dominant"] = None
+        report["reproduced"] = offline.get("tail_requests", 0) == 0
+        return report
+    report["recorded_dominant"] = recorded.get("dominant_cause")
+    report["recorded_tail_requests"] = recorded.get(
+        "tail_requests", 0)
+    report["reproduced"] = (
+        offline.get("dominant_cause")
+        == recorded.get("dominant_cause")
+        and offline.get("tail_requests", 0)
+        == recorded.get("tail_requests", 0))
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpu_autoscaler.obs",
@@ -133,6 +174,10 @@ def main(argv: list[str] | None = None) -> int:
     if not args.quiet:
         print("\n== traces")
         print(list_traces(bundle))
+        req = list_traces(bundle, prefix="request-")
+        if "(no traces" not in req:
+            print("\n== sampled request traces")
+            print(req)
         print("\n== recent decisions")
         print(render_passes(bundle, last=args.last))
         cost = bundle.get("cost")
@@ -145,9 +190,24 @@ def main(argv: list[str] | None = None) -> int:
             print("\n== cost")
             print(render_bill(cost))
 
+    # Data-plane half (ISSUE 14): re-run the tail-report offline and
+    # hold it to the capture-time verdict.
+    tail = replay_tailcause(bundle)
+    if tail["offline_tail_requests"] or tail.get(
+            "recorded_tail_requests"):
+        print("\n== tail-report (offline re-run)")
+        print(tailcause.render_report(tail["offline"]))
+        print(f"recorded dominant cause: "
+              f"{tail.get('recorded_dominant')}  "
+              f"[{'match' if tail['reproduced'] else 'MISMATCH'}]")
+
     report = replay_alerts(bundle)
     if "skipped" in report:
         print(f"\n== alerts: {report['skipped']}")
+        if not tail["reproduced"]:
+            print("OFFLINE TAIL-REPORT DIVERGED from the capture-time "
+                  "attribution", file=sys.stderr)
+            return 2
         return 0
     print(f"\n== alert replay: {report['passes_replayed']} passes over "
           f"window {report['window']}")
@@ -160,6 +220,10 @@ def main(argv: list[str] | None = None) -> int:
             and entry.get("fired_match", True) else "MISMATCH"
         print(f"  {name}: live_firing={entry['live_firing']} "
               f"offline_firing={entry['offline_firing']}  [{verdict}]")
+    if not tail["reproduced"]:
+        print("OFFLINE TAIL-REPORT DIVERGED from the capture-time "
+              "attribution", file=sys.stderr)
+        return 2
     if report["reproduced"]:
         print("offline evaluation reproduces the live firing decision")
         return 0

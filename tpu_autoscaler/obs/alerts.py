@@ -73,6 +73,10 @@ class AlertRule:
     clear_passes: int = 3
     severity: str = "page"
     runbook: str = "docs/OPERATIONS.md#alert-catalog"
+    # Histogram family whose latest TSDB exemplar (trace_id, value)
+    # rides the firing notification (ISSUE 14): the page names a
+    # concrete sampled trace, not just a number ("" = none).
+    exemplar_family: str = ""
 
     def __post_init__(self) -> None:
         if self.kind not in _KINDS:
@@ -100,7 +104,10 @@ def default_rules() -> tuple[AlertRule, ...]:
         AlertRule(
             name="serving-slo-attainment", metric="serving_slo_attainment",
             kind="gauge_below", window=600.0, threshold=0.9,
-            for_passes=3, clear_passes=5, severity="page"),
+            for_passes=3, clear_passes=5, severity="page",
+            # The firing page carries a concrete sampled slow-request
+            # trace (ISSUE 14) — the tail-report CLI's entry point.
+            exemplar_family="serving_request_latency_ticks"),
         AlertRule(
             name="watch-staleness", metric="watch_failures",
             kind="rate", window=600.0, threshold=1.0 / 60.0,
@@ -169,6 +176,9 @@ class Transition:
     severity: str
     runbook: str
     summary: str
+    #: Latest (t, value, trace_id) exemplar of the rule's
+    #: ``exemplar_family`` at fire time, when the TSDB has one.
+    exemplar: tuple | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -274,10 +284,20 @@ class AlertEngine:
                 state.firing = True
                 state.fired_at = now
                 state.fired_count += 1
+                exemplar = None
+                if rule.exemplar_family \
+                        and hasattr(tsdb, "exemplar_latest"):
+                    try:
+                        exemplar = tsdb.exemplar_latest(
+                            rule.exemplar_family)
+                    except Exception:  # noqa: BLE001 — advisory only
+                        exemplar = None
                 transitions.append(Transition(
                     rule=rule.name, firing=True, t=now, value=value,
                     severity=rule.severity, runbook=rule.runbook,
-                    summary=self._summary(rule, value, firing=True)))
+                    summary=self._summary(rule, value, firing=True,
+                                          exemplar=exemplar),
+                    exemplar=exemplar))
             elif state.firing and state.ok_streak >= rule.clear_passes:
                 state.firing = False
                 state.resolved_at = now
@@ -293,7 +313,7 @@ class AlertEngine:
 
     @staticmethod
     def _summary(rule: AlertRule, value: float | None,
-                 firing: bool) -> str:
+                 firing: bool, exemplar: tuple | None = None) -> str:
         what = "FIRING" if firing else "resolved"
         shown = "n/a" if value is None else f"{value:.4g}"
         if rule.kind == "burn_rate":
@@ -306,8 +326,12 @@ class AlertEngine:
             detail = f"avg={shown} (floor {rule.threshold:g})"
         else:
             detail = f"mean={shown}s (budget {rule.threshold:g}s)"
+        tail = ""
+        if exemplar is not None:
+            # (t, value, trace_id): the page names a concrete trace.
+            tail = f" — exemplar trace {exemplar[2]} ({exemplar[1]:g})"
         return (f"alert {rule.name} {what}: {rule.metric} {detail} — "
-                f"{rule.runbook}")
+                f"{rule.runbook}{tail}")
 
     # -- introspection -------------------------------------------------
 

@@ -109,7 +109,13 @@ def trace_gaps(dump: dict[str, Any], trace_id: str) -> list[str]:
       ``pods_running``;
     - a slice repair carries its drain phase;
     - a repack migration (ISSUE 12) carries its drain phase and, when
-      completed, the chip-seconds-saved attribution on the root.
+      completed, the chip-seconds-saved attribution on the root;
+    - a sampled request trace (ISSUE 14, serving/reqtrace.py) carries
+      its ``queue_wait`` phase and — unless the request was lost to a
+      drain handoff — a ``decode`` phase; a lost request carries the
+      ``drain_handoff`` span instead.  Roots whose event journal
+      overflowed (``truncated`` attr) are exempt from the phase
+      checks (the truncation is declared, not silent).
     """
     spans = [s for s in dump.get("spans", []) if s["trace_id"] == trace_id]
     if not spans:
@@ -146,6 +152,32 @@ def trace_gaps(dump: dict[str, Any], trace_id: str) -> list[str]:
                              or "aborted" in s["attrs"]) for s in spans)
         if not abandoned and "repair_drain" not in names:
             gaps.append(f"trace {trace_id}: missing repair_drain span")
+    elif "request" in names:
+        # ISSUE 14: a promoted data-plane request trace.  The phase
+        # contract is shared by the real engines and the queueing-
+        # model replay replicas, so it names only what BOTH record.
+        for s in spans:
+            if s["name"] != "request" or s["end"] is None:
+                continue
+            attrs = s["attrs"]
+            if attrs.get("truncated"):
+                continue
+            if attrs.get("lost"):
+                # A drain-lost request may never have been admitted
+                # at all; its story is the handoff span alone.
+                if "drain_handoff" not in names:
+                    gaps.append(f"trace {trace_id}: lost request "
+                                f"missing drain_handoff span")
+                continue
+            if "queue_wait" not in names:
+                gaps.append(f"trace {trace_id}: missing queue_wait "
+                            f"span")
+            if "decode" not in names:
+                gaps.append(f"trace {trace_id}: missing decode span")
+            if attrs.get("preemptions", 0) \
+                    and "preempt_requeue" not in names:
+                gaps.append(f"trace {trace_id}: preempted request "
+                            f"missing preempt_requeue span")
     elif "repack" in names:
         closed = [s for s in spans if s["name"] == "repack"
                   and s["end"] is not None]

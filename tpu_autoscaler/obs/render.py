@@ -32,18 +32,22 @@ def _all_spans(dump: dict[str, Any]) -> list[dict[str, Any]]:
     return list(dump.get("spans", ())) + list(dump.get("active_spans", ()))
 
 
-def trace_ids(dump: dict[str, Any]) -> list[str]:
-    """Distinct trace ids, oldest first (first-span order)."""
+def trace_ids(dump: dict[str, Any], prefix: str = "") -> list[str]:
+    """Distinct trace ids, oldest first (first-span order).
+    ``prefix`` filters by trace-id family (``request-`` lists only
+    the sampled data-plane traces; ``scaleup-`` only the control
+    plane's)."""
     seen: dict[str, None] = {}
     for span in _all_spans(dump):
-        seen.setdefault(span["trace_id"])
+        if span["trace_id"].startswith(prefix):
+            seen.setdefault(span["trace_id"])
     return list(seen)
 
 
-def list_traces(dump: dict[str, Any]) -> str:
+def list_traces(dump: dict[str, Any], prefix: str = "") -> str:
     """One line per trace: id, root span, start, duration."""
     lines = []
-    for tid in trace_ids(dump):
+    for tid in trace_ids(dump, prefix):
         spans = [s for s in _all_spans(dump) if s["trace_id"] == tid]
         roots = [s for s in spans if s.get("parent_id") is None]
         root = min(roots or spans, key=lambda s: (s["start"], s["seq"]))

@@ -1,0 +1,303 @@
+"""Tail-latency root-cause attribution (ISSUE 14): from "p99 is
+burning" down to WHY, in one causal chain.
+
+Input is any flight-recorder dump or incident bundle that carries
+sampled request traces (serving/reqtrace.py).  The analyzer:
+
+1. collects the **tail set** — every ``request-*`` trace whose root
+   missed the SLO (``slo_miss``) or was lost to a drain handoff —
+   optionally restricted to a time window (defaulting to the
+   ``serving-slo-attainment`` alert's breach window when the bundle
+   carries alert state);
+2. decomposes each tail trace into its attributed phases
+   (``queue_wait`` / ``prefill`` / ``decode`` / ``preempt_requeue`` /
+   ``drain_handoff``) and sums them into per-phase totals and
+   fractions — "where the tail's time went";
+3. correlates with the bundle's TSDB over the same window: KV
+   occupancy, queue depth, preemption rate, sampler drop rate — the
+   aggregate context the per-request story sits in;
+4. when the dominant term is queue wait — requests waiting for a
+   replica that was not there — it **cross-links the control plane**:
+   the ``scaleup-*`` trace overlapping the window whose provision
+   would have absorbed the wait, with its own phase decomposition.
+   The verdict then reads ``scaleup-lag``: user-visible p99 burn
+   attributed through the data plane down to stockout / quota /
+   actuation latency in one chain.
+
+The analysis is a pure function of the dump, so the live capture
+(``Controller.incident_bundle`` records it at alert-fire time) and
+``python -m tpu_autoscaler.obs replay``'s offline re-run must agree —
+the replay exits 2 when the recorded and recomputed dominant cause
+diverge (the PR 10 alert-divergence discipline, extended to the data
+plane).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+from tpu_autoscaler.obs.render import _all_spans
+
+#: Phase span names, in render order.
+PHASES = ("queue_wait", "prefill", "decode", "preempt_requeue",
+          "drain_handoff")
+
+#: TSDB series correlated next to the tail decomposition.
+CORRELATES = ("serving_queue_depth", "serving_kv_occupancy",
+              "serving_preempted_per_s", "serving_trace_dropped_per_s",
+              "serving_slo_attainment")
+
+#: The serving SLO alert whose breach window anchors the default
+#: analysis window.
+SERVING_ALERT = "serving-slo-attainment"
+
+
+def _series_window(tsdb: dict[str, Any] | None, name: str,
+                   start: float, end: float) -> dict[str, float] | None:
+    """mean/max/last of one dumped series inside [start, end], read
+    straight off the dump's tier rows (no TimeSeriesDB rebuild — the
+    analyzer must work on a plain JSON bundle)."""
+    if not tsdb:
+        return None
+    body = (tsdb.get("series") or {}).get(name)
+    if not body:
+        return None
+    vals: list[float] = []
+    for t, v in body.get("raw", ()):
+        if start <= t <= end:
+            vals.append(float(v))
+    if not vals:
+        # Fall back to downsampled last-values, then to the newest
+        # retained point at-or-before the window (a flat gauge may
+        # have no in-window points at all — sparse is not absent).
+        for tier in ("mid", "coarse"):
+            for row in body.get(tier, ()):
+                if start <= row[0] <= end:
+                    vals.append(float(row[1]))
+    if not vals:
+        before = [(t, v) for t, v in body.get("raw", ()) if t <= end]
+        if before:
+            vals = [float(before[-1][1])]
+    if not vals:
+        return None
+    return {"mean": round(sum(vals) / len(vals), 4),
+            "max": round(max(vals), 4),
+            "last": round(vals[-1], 4)}
+
+
+def tail_requests(dump: dict[str, Any],
+                  start: float = -math.inf,
+                  end: float = math.inf) -> list[dict[str, Any]]:
+    """The tail set: per-trace phase decompositions of every sampled
+    request that missed the SLO or was lost, rooted in [start, end]."""
+    spans = _all_spans(dump)
+    by_trace: dict[str, list[dict[str, Any]]] = {}
+    for s in spans:
+        tid = s["trace_id"]
+        if tid.startswith("request-"):
+            by_trace.setdefault(tid, []).append(s)
+    out: list[dict[str, Any]] = []
+    for tid, group in by_trace.items():
+        roots = [s for s in group
+                 if s["name"] == "request" and s["end"] is not None]
+        if not roots:
+            continue
+        root = roots[0]
+        attrs = root.get("attrs", {})
+        if not (attrs.get("slo_miss") or attrs.get("lost")):
+            continue
+        if not (start <= root["start"] <= end):
+            continue
+        phases = {p: 0.0 for p in PHASES}
+        for s in group:
+            if s["name"] in phases and s["end"] is not None:
+                phases[s["name"]] += s["end"] - s["start"]
+        out.append({
+            "trace_id": tid,
+            "start": root["start"],
+            "latency": attrs.get("latency_ticks",
+                                 (root["end"] or root["start"])
+                                 - root["start"]),
+            "lost": bool(attrs.get("lost")),
+            "preemptions": attrs.get("preemptions", 0),
+            "n": attrs.get("n", 1),
+            "phases": phases,
+        })
+    out.sort(key=lambda r: r["start"])
+    return out
+
+
+def _scaleup_link(dump: dict[str, Any], start: float,
+                  end: float) -> dict[str, Any] | None:
+    """The control-plane cross-link: the scale-up trace whose
+    provision window overlaps the tail window — the capacity that,
+    had it landed earlier, would have absorbed the queue wait.  Picks
+    the overlapping scale-up with the LONGEST root duration (the
+    slowest provision is the one that made users wait)."""
+    spans = _all_spans(dump)
+    best: dict[str, Any] | None = None
+    best_dur = -1.0
+    for s in spans:
+        if s["name"] != "scale_up" or not \
+                s["trace_id"].startswith("scaleup-"):
+            continue
+        s_end = s["end"] if s["end"] is not None else end
+        if s_end < start or s["start"] > end:
+            continue
+        dur = s_end - s["start"]
+        if dur > best_dur:
+            best_dur = dur
+            best = s
+    if best is None:
+        return None
+    tid = best["trace_id"]
+    phases: dict[str, float] = {}
+    for s in spans:
+        if s["trace_id"] == tid and s["span_id"] != best["span_id"] \
+                and s["end"] is not None:
+            phases[s["name"]] = round(
+                phases.get(s["name"], 0.0)
+                + (s["end"] - s["start"]), 4)
+    return {
+        "trace_id": tid,
+        "start": best["start"],
+        "end": best["end"],
+        "duration_s": (None if best["end"] is None
+                       else round(best_dur, 4)),
+        "open": best["end"] is None,
+        "gang": best.get("attrs", {}).get("gang"),
+        "phases": phases,
+    }
+
+
+def _window(bundle: dict[str, Any]) -> tuple[float, float]:
+    """Default analysis window: the serving-SLO alert's breach window
+    when the bundle carries one (fired_at - rule window → capture),
+    else unbounded."""
+    alerts = bundle.get("alerts") or {}
+    state = (alerts.get("state") or {}).get(SERVING_ALERT) or {}
+    fired_at = state.get("fired_at")
+    if fired_at is None:
+        return (-math.inf, math.inf)
+    window = 600.0
+    for rule in alerts.get("rules", ()):
+        if rule.get("name") == SERVING_ALERT:
+            window = float(rule.get("window", 600.0))
+            break
+    end = math.inf
+    captured = (bundle.get("bundle") or {}).get("captured_at")
+    resolved = state.get("resolved_at")
+    if resolved is not None and resolved > fired_at:
+        end = resolved
+    elif captured is not None:
+        end = captured
+    return (fired_at - window, end)
+
+
+def analyze(bundle: dict[str, Any], *,
+            window: tuple[float, float] | None = None
+            ) -> dict[str, Any]:
+    """The tail-report: tail set, phase attribution, TSDB correlates,
+    and — when queue wait dominates — the scale-up cross-link.
+    Deterministic over the bundle (the offline-divergence contract)."""
+    start, end = window if window is not None else _window(bundle)
+    tail = tail_requests(bundle, start, end)
+    totals = {p: 0.0 for p in PHASES}
+    weighted = {p: 0.0 for p in PHASES}
+    for r in tail:
+        n = max(1, int(r.get("n", 1)))
+        for p in PHASES:
+            totals[p] += r["phases"][p]
+            weighted[p] += r["phases"][p] * n
+    grand = sum(weighted.values())
+    fractions = {p: (round(weighted[p] / grand, 4) if grand else 0.0)
+                 for p in PHASES}
+    dominant = max(PHASES, key=lambda p: weighted[p]) if grand \
+        else None
+    report: dict[str, Any] = {
+        "window": [None if math.isinf(start) else start,
+                   None if math.isinf(end) else end],
+        "tail_requests": len(tail),
+        "tail_cohort_weight": int(sum(max(1, int(r.get("n", 1)))
+                                      for r in tail)),
+        "phase_ticks": {p: round(totals[p], 4) for p in PHASES},
+        "phase_fractions": fractions,
+        "dominant_phase": dominant,
+        "examples": [r["trace_id"] for r in
+                     sorted(tail, key=lambda r: -r["latency"])[:5]],
+    }
+    correlates: dict[str, Any] = {}
+    tsdb = bundle.get("tsdb")
+    for name in CORRELATES:
+        stats = _series_window(tsdb, name, start, end)
+        if stats is not None:
+            correlates[name] = stats
+    report["correlates"] = correlates
+    exemplars = (tsdb or {}).get("exemplars", {})
+    if exemplars:
+        report["exemplars"] = {
+            fam: rows[-1] for fam, rows in exemplars.items() if rows}
+    cause = dominant
+    if dominant == "queue_wait":
+        # Requests waited for capacity.  If a scale-up was in flight
+        # (or landed late) over the same window, the wait IS the
+        # provision latency: cross-link the control-plane trace.
+        link = _scaleup_link(bundle, start, end)
+        if link is not None:
+            report["scaleup"] = link
+            cause = "scaleup-lag"
+        else:
+            cause = "queue-wait"
+    elif dominant == "preempt_requeue":
+        cause = "kv-pressure"
+    report["dominant_cause"] = cause
+    return report
+
+
+def render_report(report: dict[str, Any]) -> str:
+    """Human rendering for the ``tail-report`` CLI."""
+    if report.get("tail_requests", 0) == 0:
+        return ("no tail-captured requests in the window — either the "
+                "SLO held, or request tracing was off "
+                "(serving/reqtrace.py)")
+    lines = []
+    w = report.get("window") or [None, None]
+    wtxt = " (whole retention)" if w[0] is None else \
+        f" over [{w[0]:g}, {w[1]:g}]" if w[1] is not None else \
+        f" since {w[0]:g}"
+    lines.append(
+        f"{report['tail_requests']} tail-captured request trace(s), "
+        f"cohort weight {report.get('tail_cohort_weight')}{wtxt}")
+    lines.append("phase attribution (cohort-weighted):")
+    fr = report["phase_fractions"]
+    ticks = report["phase_ticks"]
+    for p in PHASES:
+        if ticks.get(p, 0.0) <= 0.0:
+            continue
+        mark = "  <-- dominant" if p == report.get("dominant_phase") \
+            else ""
+        lines.append(f"  {p:<16} {fr[p] * 100:6.1f}%  "
+                     f"({ticks[p]:g} ticks){mark}")
+    if report.get("correlates"):
+        lines.append("aggregate context (TSDB, same window):")
+        for name, stats in sorted(report["correlates"].items()):
+            lines.append(f"  {name:<28} mean={stats['mean']:g} "
+                         f"max={stats['max']:g}")
+    lines.append(f"dominant cause: {report.get('dominant_cause')}")
+    link = report.get("scaleup")
+    if link:
+        dur = ("still open" if link.get("open")
+               else f"{link.get('duration_s'):g}s")
+        lines.append(
+            f"cross-link: scale-up {link['trace_id']} ({dur}) "
+            f"overlapped the tail window — the wait is provision "
+            f"latency; `tpu-autoscaler trace {link['trace_id']}` "
+            f"decomposes it")
+        if link.get("phases"):
+            for name, secs in sorted(link["phases"].items(),
+                                     key=lambda kv: -kv[1]):
+                lines.append(f"    {name:<20} {secs:g}s")
+    for tid in report.get("examples", ()):
+        lines.append(f"  example: {tid}")
+    return "\n".join(lines)

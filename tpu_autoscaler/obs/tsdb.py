@@ -47,6 +47,7 @@ series per bucket — exactly what a multi-window burn rate needs
 
 from __future__ import annotations
 
+import collections
 import math
 from typing import Any, Iterable
 
@@ -64,6 +65,16 @@ HEARTBEAT_SECONDS = 60.0
 #: Hard series-count bound: a runaway dynamic family must degrade
 #: (drop new series, count them) instead of growing without bound.
 MAX_SERIES = 20_000
+
+#: Exemplar retention (ISSUE 14): per histogram family, a small ring
+#: of (t, value, trace_id) triples linking aggregate series to a
+#: concrete sampled trace.  Exemplars live OUTSIDE the tier pipeline
+#: (a trace id cannot be downsampled), so they survive raw-ring
+#: eviction — a p99 from coarse history still resolves to its trace.
+EXEMPLAR_RING = 32
+#: Family-count bound, same degrade-don't-grow discipline as
+#: MAX_SERIES (counted in ``exemplars_dropped``).
+MAX_EXEMPLAR_FAMILIES = 256
 
 #: Aggregate row columns for the downsampled tiers.
 _T, _LAST, _MIN, _MAX, _SUM, _N = range(6)
@@ -232,21 +243,38 @@ class TimeSeriesDB:
         self.heartbeat_seconds = heartbeat_seconds
         self.max_series = max_series
         self._series: dict[str, _Series] = {}
+        #: family -> bounded list of (t, value, trace_id) exemplars.
+        self._exemplars: dict[str, collections.deque] = {}
         #: Seqlock: odd while the writer mutates, even when stable.
         self._wseq = 0
         self.points_appended = 0
         self.series_dropped = 0
+        self.exemplars_appended = 0
+        self.exemplars_dropped = 0
 
     # -- write path (reconcile thread ONLY) ---------------------------
 
-    def ingest(self, snapshot: dict[str, Any], now: float) -> int:
+    def ingest(self, snapshot: dict[str, Any], now: float,
+               exemplars: dict[str, tuple[str, float]] | None = None
+               ) -> int:
         """Fold one ``Metrics.snapshot()`` into the store; returns the
         number of points appended.  Unchanged values are skipped (flat
         series re-anchor every ``heartbeat_seconds``), so a pass costs
-        O(changed series), not O(all series)."""
+        O(changed series), not O(all series).
+
+        ``exemplars``: optional ``{family: (trace_id, value)}`` — at
+        most one exemplar per histogram family per pass (ISSUE 14),
+        linking that family's series to a concrete sampled trace.
+        The caller must have observed ``value`` into the family this
+        same pass (the exemplar-membership property the test suite
+        asserts)."""
         self._wseq += 1  # odd: mutation in progress
         try:
             appended = 0
+            if exemplars:
+                for family, (trace_id, value) in exemplars.items():
+                    self._append_exemplar(family, now, float(value),
+                                          str(trace_id))
             for name, value in snapshot.get("counters", {}).items():
                 appended += self._append(name, now, float(value))
             for name, value in snapshot.get("gauges", {}).items():
@@ -286,6 +314,31 @@ class TimeSeriesDB:
         try:
             self._append(name, t, value, force=True)
             self.points_appended += 1
+        finally:
+            self._wseq += 1
+
+    def _append_exemplar(self, family: str, t: float, v: float,
+                         trace_id: str) -> None:
+        """Keyed by FAMILY NAME in a dedicated map — exemplars can
+        never be misattributed to another series however the 20k
+        series cap churns (the no-cross-series-leak property)."""
+        ring = self._exemplars.get(family)
+        if ring is None:
+            if len(self._exemplars) >= MAX_EXEMPLAR_FAMILIES:
+                self.exemplars_dropped += 1
+                return
+            ring = collections.deque(maxlen=EXEMPLAR_RING)
+            self._exemplars[family] = ring
+        ring.append((float(t), float(v), trace_id))
+        self.exemplars_appended += 1
+
+    def append_exemplar(self, family: str, t: float, v: float,
+                        trace_id: str) -> None:
+        """Direct exemplar append (tests, ``from_dump`` rebuild).
+        Same single-writer contract as ``append``."""
+        self._wseq += 1
+        try:
+            self._append_exemplar(family, t, v, trace_id)
         finally:
             self._wseq += 1
 
@@ -345,6 +398,27 @@ class TimeSeriesDB:
 
     def series_count(self) -> int:
         return len(self._series)
+
+    def exemplar_latest(self, family: str
+                        ) -> tuple[float, float, str] | None:
+        """Most recent (t, value, trace_id) exemplar for ``family`` —
+        the alert engine's "which trace is burning" lookup."""
+        def read() -> tuple[float, float, str] | None:
+            ring = self._exemplars.get(family)
+            return ring[-1] if ring else None
+        return self._guarded(read)
+
+    def exemplars(self, family: str, start: float = -math.inf,
+                  end: float = math.inf
+                  ) -> list[tuple[float, float, str]]:
+        """Retained exemplars for ``family`` inside ``[start, end]``,
+        oldest first."""
+        def read() -> list[tuple[float, float, str]]:
+            ring = self._exemplars.get(family)
+            if not ring:
+                return []
+            return [e for e in ring if start <= e[0] <= end]
+        return self._guarded(read)
 
     def series_names(self, prefix: str = "") -> list[str]:
         def read() -> list[str]:
@@ -523,11 +597,18 @@ class TimeSeriesDB:
                 out[name] = tiers
             return out
 
+        def read_exemplars() -> dict[str, list]:
+            return {fam: [[float(t), float(v), tid]
+                          for t, v, tid in ring if t >= start]
+                    for fam, ring in sorted(self._exemplars.items())
+                    if fam.startswith(prefix)}
+
         try:
             series = self._guarded(read)
+            exemplars = self._guarded(read_exemplars)
             unavailable = False
         except TornRead:
-            series, unavailable = {}, True
+            series, exemplars, unavailable = {}, {}, True
         body: dict[str, Any] = {
             "tiers": {"raw_points": self.raw_points,
                       "mid_seconds": self.mid_seconds,
@@ -536,7 +617,9 @@ class TimeSeriesDB:
             "series_count": len(self._series),
             "points_appended": self.points_appended,
             "series_dropped": self.series_dropped,
+            "exemplars_dropped": self.exemplars_dropped,
             "series": series,
+            "exemplars": exemplars,
         }
         if unavailable:
             body["unavailable"] = "mutating"
@@ -577,6 +660,10 @@ class TimeSeriesDB:
             seen.extend((float(t), float(v)) for t, v in raw)
             for t, v in sorted(seen):
                 db.append(name, t, v)
+        for family, rows in dump.get("exemplars", {}).items():
+            for t, v, trace_id in rows:
+                db.append_exemplar(family, float(t), float(v),
+                                   str(trace_id))
         return db
 
 

@@ -47,9 +47,12 @@ from tpu_autoscaler.serving.stats import ServingSnapshot
 _G_QUEUE, _G_ACTIVE, _G_SLOTS, _G_KV_USED, _G_KV_CAP = range(5)
 _N_GAUGE = 5
 
-#: Cumulative-counter columns differenced into rates.
-_C_FINISHED, _C_SLO_OK, _C_TOKENS, _C_ADMITTED, _C_PREEMPTED = range(5)
-_N_TOTAL = 5
+#: Cumulative-counter columns differenced into rates.  The trace
+#: columns (ISSUE 14) ride the same delta path: replica-side sampler
+#: promotions become fleet rates with restart/reset handling for free.
+(_C_FINISHED, _C_SLO_OK, _C_TOKENS, _C_ADMITTED, _C_PREEMPTED,
+ _C_TRACE_SAMPLED, _C_TRACE_TAIL, _C_TRACE_DROPPED) = range(8)
+_N_TOTAL = 8
 
 #: Per-pool contribution vector: the gauges, then the rate EWMAs.
 _N_CONTRIB = _N_GAUGE + _N_TOTAL
@@ -61,6 +64,13 @@ _RATE_ALPHA = 0.5
 #: maintained by add/subtract; a periodic full re-sum bounds the error
 #: at amortized O(replicas / period) per fold).
 _REPAIR_PERIOD = 256
+
+#: The histogram family request-latency exemplars attach to (ISSUE
+#: 14): the reconciler observes the taken exemplar's value into this
+#: family the same pass it hands the (trace_id, value) pair to the
+#: TSDB, so the exemplar is always a member of the family's
+#: observations.
+EXEMPLAR_FAMILY = "serving_request_latency_ticks"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,6 +91,9 @@ class PoolSignal:
     tokens_per_s: float
     admitted_per_s: float
     preempted_per_s: float
+    trace_sampled_per_s: float = 0.0
+    trace_tail_per_s: float = 0.0
+    trace_dropped_per_s: float = 0.0
 
     @property
     def slo_attainment(self) -> float:
@@ -113,7 +126,10 @@ def _snapshot_rows(snap: ServingSnapshot) -> tuple[list[float],
               float(snap.kv_capacity)]
     totals = [float(snap.finished_total), float(snap.slo_ok_total),
               float(snap.decode_tokens_total),
-              float(snap.admitted_total), float(snap.preempted_total)]
+              float(snap.admitted_total), float(snap.preempted_total),
+              float(snap.trace_sampled_total),
+              float(snap.trace_tail_total),
+              float(snap.trace_dropped_total)]
     return gauges, totals
 
 
@@ -149,6 +165,16 @@ class ServingMetricsAdapter:
         self._pool_sums = np.zeros((0, _N_CONTRIB))
         self._pool_replicas: list[int] = []
         self._folds = 0
+        # Exemplar plumbing (ISSUE 14): per-replica last-taken
+        # exemplar seq (so a re-delivered snapshot never re-takes the
+        # same exemplar) and the pending per-family best — drained
+        # once per pass by ``take_exemplars``.  A plain Python list on
+        # purpose: the ingest fast path reads one element per
+        # delivery, and a list index is ~4x cheaper than a numpy
+        # scalar read (the traced-vs-untraced ingest gate rides on
+        # it).  Trace ids are strings and live beside the rows.
+        self._exemplar_seq: list[int] = [0] * cap
+        self._pending_exemplars: dict[str, tuple[str, float]] = {}
 
     # -- metrics ----------------------------------------------------------
 
@@ -180,6 +206,7 @@ class ServingMetricsAdapter:
         self._pool_of_row = grow2(self._pool_of_row)
         self._contrib = grow2(self._contrib)
         self._live = grow2(self._live)
+        self._exemplar_seq.extend([0] * (new - cap))
 
     def _pool(self, pool: str, accel_class: str, shape_name: str) -> int:
         idx = self._pool_idx.get(pool)
@@ -236,6 +263,7 @@ class ServingMetricsAdapter:
             self._t_old[row] = now
             self._epoch[row] = snap.epoch
             self._seq[row] = -1
+            self._exemplar_seq[row] = 0
         elif snap.epoch < self._epoch[row] or (
                 snap.epoch == self._epoch[row]
                 and snap.seq <= self._seq[row]):
@@ -253,13 +281,39 @@ class ServingMetricsAdapter:
             self._inc("serving_counter_resets")
             self._epoch[row] = snap.epoch
             self._tot_old[row] = 0.0
+            # A rebuilt recorder's exemplar_seq restarts too: the old
+            # high-water mark would suppress every post-restart
+            # exemplar forever.
+            self._exemplar_seq[row] = 0
         self._seq[row] = snap.seq
         self._gauges[row] = gauges
         self._tot_new[row] = totals
         self._t_new[row] = now
         self._dirty.add(row)
+        if snap.exemplar_seq > self._exemplar_seq[row] \
+                and snap.exemplar_trace_id is not None:
+            # New promoted-trace exemplar from this replica: keep the
+            # fleet's SLOWEST candidate this pass (p99 links to a slow
+            # trace, not an arbitrary one).  O(1), no per-pass scan —
+            # and the seq compare comes FIRST, so untraced snapshots
+            # (seq 0) and re-deliveries reject on one int compare.
+            self._exemplar_seq[row] = snap.exemplar_seq
+            cur = self._pending_exemplars.get(EXEMPLAR_FAMILY)
+            if cur is None or snap.exemplar_value >= cur[1]:
+                self._pending_exemplars[EXEMPLAR_FAMILY] = (
+                    snap.exemplar_trace_id,
+                    float(snap.exemplar_value))
         self._inc("serving_snapshots_ingested")
         return True
+
+    def take_exemplars(self) -> dict[str, tuple[str, float]]:
+        """Drain this pass's pending exemplars — at most one
+        (trace_id, value) per family.  The reconciler's ``_obs_pass``
+        observes each value into its histogram family and forwards
+        the pair to ``TimeSeriesDB.ingest``."""
+        out = self._pending_exemplars
+        self._pending_exemplars = {}
+        return out
 
     def remove(self, replica_id: str) -> None:
         """Forget a replica (scale-in / death): its contribution leaves
@@ -342,7 +396,13 @@ class ServingMetricsAdapter:
                 admitted_per_s=max(0.0, float(
                     s[_N_GAUGE + _C_ADMITTED])),
                 preempted_per_s=max(0.0, float(
-                    s[_N_GAUGE + _C_PREEMPTED])))
+                    s[_N_GAUGE + _C_PREEMPTED])),
+                trace_sampled_per_s=max(0.0, float(
+                    s[_N_GAUGE + _C_TRACE_SAMPLED])),
+                trace_tail_per_s=max(0.0, float(
+                    s[_N_GAUGE + _C_TRACE_TAIL])),
+                trace_dropped_per_s=max(0.0, float(
+                    s[_N_GAUGE + _C_TRACE_DROPPED])))
         return out
 
     def burning_pools(self, floor: float = 0.95) -> set[str]:

@@ -43,6 +43,7 @@ import numpy as np
 
 from tpu_autoscaler.policy import traffic
 from tpu_autoscaler.serving.adapter import ServingMetricsAdapter
+from tpu_autoscaler.serving.reqtrace import SAMPLE_DENOM
 from tpu_autoscaler.serving.scaler import ServingPolicy, ServingScaler
 from tpu_autoscaler.serving.stats import ServingStatsRecorder
 
@@ -100,6 +101,13 @@ class ServingReplayConfig:
     # steps before the pod-pending submitter fires (HPA-ish lag).
     reactive_hold_steps: int = 2
     idle_threshold_seconds: float = 180.0
+    # Request-level tracing (ISSUE 14): head-sampling rate for the
+    # per-replica RequestTraceSampler (0 = tracing off).  Tail capture
+    # (SLO misses, drain losses) is always on when tracing is on; the
+    # samplers share the Controller's flight recorder, so request
+    # traces land in the same /debugz dumps and incident bundles as
+    # the control-plane traces.
+    trace_sample_rate: float = 0.0
 
     @property
     def spikes(self) -> tuple[tuple[float, float, float], ...]:
@@ -134,13 +142,16 @@ class _Replica:
     information at steady state."""
 
     __slots__ = ("name", "node", "fifo", "queued", "carry", "draining",
-                 "recorder", "decode_tokens", "active")
+                 "recorder", "decode_tokens", "active", "sampler",
+                 "_aseq", "_hash_base", "_bar")
 
-    def __init__(self, name: str, node: str,
-                 cfg: ServingReplayConfig) -> None:
+    def __init__(self, name: str, node: str, cfg: ServingReplayConfig,
+                 trace_recorder=None) -> None:
         self.name = name
         self.node = node
-        self.fifo: deque[list] = deque()   # [arrival_t, n] cohorts
+        # Cohorts: [arrival_t, n] untraced; [arrival_t, n, rid,
+        # head_sampled] with the sampler on.
+        self.fifo: deque[list] = deque()
         self.queued = 0
         self.carry = 0.0
         self.draining = False
@@ -149,11 +160,45 @@ class _Replica:
         self.recorder = ServingStatsRecorder(
             cfg.slots_per_replica,
             slo_ticks=max(1, int(cfg.slo_seconds // cfg.step)))
+        # Request-trace sampler (ISSUE 14): cohort-granular — one
+        # trace per scored completion cohort, head-sampled by cohort
+        # id plus always-on tail capture.  The latency unit here is
+        # SECONDS (the replay's clock), so the tail bound is the
+        # replay's SLO directly.
+        self.sampler = None
+        self._aseq = 0
+        if cfg.trace_sample_rate > 0.0:
+            import zlib
+
+            from tpu_autoscaler.serving.reqtrace import (
+                RequestTraceSampler,
+            )
+
+            self.sampler = RequestTraceSampler(
+                name, sample_rate=cfg.trace_sample_rate,
+                slo_ticks=cfg.slo_seconds, stats=self.recorder,
+                recorder=trace_recorder)
+            # Integer head-sampling (the assign fast path): one crc32
+            # of the replica name at construction, then a multiply/mod
+            # per cohort — deterministic for a given seed, no string
+            # build or byte hash per assignment.
+            self._hash_base = zlib.crc32(name.encode())
+            self._bar = int(cfg.trace_sample_rate * SAMPLE_DENOM)
 
     def assign(self, t: float, n: int) -> None:
         if n <= 0:
             return
-        self.fifo.append([t, n])
+        if self.sampler is None:
+            self.fifo.append([t, n])
+        else:
+            # Decide the cohort's head-sampling verdict ONCE here
+            # (integer mix of the replica hash and the cohort seq);
+            # the per-completion-chunk path then pays two compares,
+            # and the cohort id string is built only on promotion.
+            self._aseq += 1
+            head = ((self._hash_base + self._aseq * 2654435761)
+                    % SAMPLE_DENOM) < self._bar
+            self.fifo.append([t, n, self._aseq, head])
         self.queued += n
         self.recorder.note_admit(n)
 
@@ -181,6 +226,7 @@ class _Replica:
         """Serve one sim step: FIFO completions at the service rate,
         then close the stats tick."""
         cap = self.carry + cfg.replica_rps * cfg.step
+        tau = cfg.slots_per_replica / cfg.replica_rps
         done = 0
         while cap >= 1.0 and self.fifo:
             head = self.fifo[0]
@@ -193,6 +239,30 @@ class _Replica:
             self.queued -= take
             latency = t + cfg.step - head[0]
             score(head[0], t + cfg.step, take)
+            if self.sampler is not None:
+                # One trace per scored completion cohort: head verdict
+                # decided at assignment, SLO misses ALWAYS captured
+                # (queue_wait = everything beyond the service time —
+                # the queueing model's attribution).  The unpromoted
+                # fast path is these two compares; everything else
+                # happens only for the ~1% + tail.
+                miss = latency > cfg.slo_seconds
+                if head[3] or miss:
+                    self.sampler.note_cohort(
+                        f"{self.name}-a{head[2]}", arrival=head[0],
+                        finish=t + cfg.step, n=take,
+                        exec_time=min(tau, latency), head=head[3])
+                if latency - tau >= cfg.step:
+                    # Wait-split feed, cohort-approximate (one write
+                    # per waiting completion chunk, like the bounded
+                    # note_finish loop below — the per-request exact
+                    # split lives in the real engines'
+                    # _note_admitted).  The guard keeps the value
+                    # positive and sub-tick waits (which would round
+                    # to zero anyway) off the ring — the fast path
+                    # stays one subtract + compare.
+                    self.recorder.note_first_scheduled(
+                        int((latency - tau) // cfg.step))
             lat_ticks = max(0, int(latency // cfg.step))
             for _ in range(min(take, 32)):
                 # Bounded per-cohort recorder writes: the ring only
@@ -285,6 +355,10 @@ class _Score:
         self._lat = np.zeros(4096, np.int64)
         # Rolling 5-minute windows for worst-window attainment.
         self._window: dict[int, list[int]] = {}
+        # SLO-missing completion cohorts (arrival, finish, n) — the
+        # ISSUE 14 tail-coverage oracle: with tracing on, EVERY one of
+        # these must have a tail-captured request trace.
+        self.miss_cohorts: list[tuple[float, float, int]] = []
 
     def __call__(self, arrival_t: float, finish_t: float,
                  n: int) -> None:
@@ -292,6 +366,8 @@ class _Score:
         ok = latency <= self._cfg.slo_seconds
         self.served += n
         self.ok += n if ok else 0
+        if not ok:
+            self.miss_cohorts.append((arrival_t, finish_t, n))
         if arrival_t >= self._scored_from:
             self.tail_served += n
             self.tail_ok += n if ok else 0
@@ -318,11 +394,17 @@ class _Score:
 
 
 def replay(config: ServingReplayConfig, *, mode: str,
-           probe=None) -> ServingReplayResult:
+           probe=None, artifacts: dict | None = None
+           ) -> ServingReplayResult:
     """Drive one traffic program through the real control loop.
 
     ``probe``: optional per-step callback ``(t, replica_count,
-    backlog, score)`` for tests and trace inspection."""
+    backlog, score)`` for tests and trace inspection.
+
+    ``artifacts``: optional dict the replay fills with its live
+    objects (``controller``, ``score``, ``samplers``) — the ISSUE 14
+    acceptance surface (request traces, exemplars, incident bundles)
+    without widening the scorecard result."""
     if mode not in ("reactive", "signal"):
         raise ValueError(f"unknown serving replay mode {mode!r}")
     from tpu_autoscaler.actuators.fake import FakeActuator
@@ -345,6 +427,14 @@ def replay(config: ServingReplayConfig, *, mode: str,
     adapter = ServingMetricsAdapter()
     scaler = (ServingScaler(adapter, _serving_policy(cfg))
               if mode == "signal" else None)
+    recorder = None
+    if cfg.trace_sample_rate > 0.0:
+        # Request traces share the controller's flight recorder (one
+        # dump carries both planes); a deeper ring so a spike's tail
+        # captures survive to the post-replay assertions.
+        from tpu_autoscaler.obs import FlightRecorder
+
+        recorder = FlightRecorder(max_spans=32768)
     controller = Controller(
         kube, actuator,
         ControllerConfig(
@@ -353,11 +443,21 @@ def replay(config: ServingReplayConfig, *, mode: str,
             idle_threshold_seconds=cfg.idle_threshold_seconds,
             drain_grace_seconds=30.0,
             provision_timeout_seconds=600.0),
-        informer=informer, serving_scaler=scaler)
+        informer=informer, serving_scaler=scaler, recorder=recorder)
+    trace_recorder = controller.recorder \
+        if cfg.trace_sample_rate > 0.0 else None
 
     rng = np.random.default_rng(cfg.seed)
     score = _Score(cfg)
     replicas: dict[str, _Replica] = {}   # node name -> replica
+    samplers: list = []                  # every sampler ever built
+
+    def _new_replica(pod_name: str, node: str) -> _Replica:
+        rep = _Replica(pod_name, node, cfg,
+                       trace_recorder=trace_recorder)
+        if rep.sampler is not None:
+            samplers.append(rep.sampler)
+        return rep
     unassigned: deque[list] = deque()    # pool-level cohorts
     pod_of: dict[str, str] = {}          # node -> serving pod name
     # Nodes whose replica drained away: they idle toward reclaim and
@@ -410,7 +510,7 @@ def replay(config: ServingReplayConfig, *, mode: str,
             payload["status"].pop("conditions", None)
             kube.add_pod(payload)
             pod_of[name] = payload["metadata"]["name"]
-            replicas[name] = _Replica(pod_name, name, cfg)
+            replicas[name] = _new_replica(pod_name, name)
 
     def _adopt_scheduled(t: float) -> None:
         """Reactive mode: pending serving pods the toy scheduler bound
@@ -423,8 +523,8 @@ def replay(config: ServingReplayConfig, *, mode: str,
                     and p.node_name not in replicas:
                 retired.discard(p.node_name)
                 pod_of[p.node_name] = p.name
-                replicas[p.node_name] = _Replica(p.name, p.node_name,
-                                                 cfg)
+                replicas[p.node_name] = _new_replica(p.name,
+                                                     p.node_name)
 
     def _seed_baseline() -> None:
         """Warm fleet at t=0, identical in both modes."""
@@ -580,6 +680,10 @@ def replay(config: ServingReplayConfig, *, mode: str,
             break
         t += cfg.step
 
+    if artifacts is not None:
+        artifacts["controller"] = controller
+        artifacts["score"] = score
+        artifacts["samplers"] = samplers
     snap = controller.metrics.snapshot()
     counters = snap["counters"]
     unserved = arrived - score.served

@@ -128,6 +128,7 @@ class ServingScaler:
         self.adapter = adapter
         self.policy = policy or ServingPolicy()
         self._metrics: Any = None
+        self._tracer: Any = None
         self._seq = 0
         self._scaleouts: dict[tuple, _ScaleOut] = {}
         self._surplus_since: dict[str, float] = {}
@@ -146,6 +147,8 @@ class ServingScaler:
             self._metrics = metrics
             if self.adapter._metrics is None:
                 self.adapter._metrics = metrics
+        if tracer is not None:
+            self._tracer = tracer
 
     # -- metrics helpers --------------------------------------------------
 
@@ -284,6 +287,7 @@ class ServingScaler:
                  if so.pool == pool),
                 key=lambda k: self._scaleouts[k].created_at)
             for key in mine[:joined]:
+                self._record_scaleout_trace(self._scaleouts[key], now)
                 del self._scaleouts[key]
 
         pending_by_pool: dict[str, int] = {}
@@ -295,10 +299,19 @@ class ServingScaler:
         total_replicas = 0.0
         total_queue = 0.0
         worst_attainment = 1.0
+        kv_used = kv_cap = 0.0
+        preempted_per_s = 0.0
+        trace_sampled = trace_tail = trace_dropped = 0.0
         for pool in sorted(signals):
             sig = signals[pool]
             total_replicas += sig.replicas
             total_queue += sig.queue_depth
+            kv_used += sig.kv_used
+            kv_cap += sig.kv_capacity
+            preempted_per_s += sig.preempted_per_s
+            trace_sampled += sig.trace_sampled_per_s
+            trace_tail += sig.trace_tail_per_s
+            trace_dropped += sig.trace_dropped_per_s
             if sig.finished_per_s > 0.0:
                 worst_attainment = min(worst_attainment,
                                        sig.slo_attainment)
@@ -380,7 +393,50 @@ class ServingScaler:
                     float(sum(advice.desired.values())))
         self.set_gauge("serving_advisory_gangs", len(advice.advisory))
         self.set_gauge("serving_pools", float(len(signals)))
+        # Data-plane health correlates (ISSUE 14): the series the
+        # tail-cause analyzer reads next to the sampled request spans
+        # — fleet KV pressure, preemption rate, sampler promotion/
+        # drop rates (a rising drop rate means coverage degraded).
+        self.set_gauge("serving_kv_occupancy",
+                       kv_used / kv_cap if kv_cap > 0 else 0.0)
+        self.set_gauge("serving_preempted_per_s", preempted_per_s)
+        self.set_gauge("serving_trace_sampled_per_s", trace_sampled)
+        self.set_gauge("serving_trace_tail_per_s", trace_tail)
+        self.set_gauge("serving_trace_dropped_per_s", trace_dropped)
         return advice
+
+    def _record_scaleout_trace(self, so: _ScaleOut,
+                               now: float) -> None:
+        """A satisfied scale-out record closes as a retroactive
+        ``scaleup-*`` trace (ISSUE 14): root ``scale_up`` span
+        decided→replica-joined, a ``provision`` child when an actual
+        provision served it, and the ``pods_running`` join phase —
+        the control-plane anchor the tail-report cross-links a
+        queue-wait-dominated request tail to.  Serving provisions are
+        advisory (no Unschedulable pod ever exists), so without this
+        the data plane's "replica arrived late" verdict would have
+        nothing to point at."""
+        if self._tracer is None:
+            return
+        trace_id = self._tracer.new_trace("scaleup")
+        root = self._tracer.start(
+            "scale_up", trace_id=trace_id, parent=None,
+            t=so.created_at,
+            attrs={"gang": so.gang.key[2], "serving_pool": so.pool,
+                   "shape": so.shape_name,
+                   "kind": "serving_scaleout"})
+        joined_from = so.created_at
+        if so.active_at is not None:
+            self._tracer.record("provision", start=so.created_at,
+                                end=so.active_at, parent=root,
+                                attrs={"provision_id":
+                                       so.provision_id})
+            joined_from = so.active_at
+        self._tracer.record("pods_running", start=joined_from,
+                            end=now, parent=root)
+        self._tracer.end(root, t=now,
+                         attrs={"latency_s":
+                                round(now - so.created_at, 3)})
 
     # -- introspection ----------------------------------------------------
 

@@ -73,6 +73,25 @@ class ServingSnapshot:
     tokens_per_tick: float      # over the tick window
     latency_p50_ticks: float    # over the latency window (0 if none)
     latency_p95_ticks: float
+    # Queue-wait vs execute split (ISSUE 14 satellite): submitted_tick
+    # is preserved across preemption re-queues, so end-to-end latency
+    # alone cannot say whether time went to waiting or to serving.
+    first_scheduled_total: int = 0   # requests that reached a slot
+    queue_wait_ticks_total: int = 0  # submit -> FIRST admission
+    requeue_wait_ticks_total: int = 0  # preempt -> re-admission
+    queue_wait_p95_ticks: float = 0.0  # over the wait window
+    # Request-trace sampler counters (serving/reqtrace.py), riding the
+    # same cumulative-counter delta path as the admission counters.
+    trace_sampled_total: int = 0
+    trace_tail_total: int = 0
+    trace_dropped_total: int = 0
+    # Latest promoted request-trace exemplar: (trace_id, latency) the
+    # aggregation layer forwards into the TSDB so latency series
+    # resolve to a concrete sampled trace.  ``exemplar_seq`` is
+    # monotone per recorder so the adapter never re-takes one.
+    exemplar_trace_id: str | None = None
+    exemplar_value: float = 0.0
+    exemplar_seq: int = 0
 
     @property
     def slo_attainment(self) -> float:
@@ -125,6 +144,19 @@ class ServingStatsRecorder:
         self._lw = int(latency_window)
         self._lat_ring = np.zeros(self._lw, np.int64)
         self._lat_n = 0
+        # Queue-wait split (ISSUE 14 satellite): first-schedule +
+        # requeue waits, cumulative and windowed.
+        self.first_scheduled_total = 0
+        self.queue_wait_ticks_total = 0
+        self.requeue_wait_ticks_total = 0
+        self._wait_ring = np.zeros(self._lw, np.int64)
+        self._wait_n = 0
+        # Request-trace sampler mirror (serving/reqtrace.py).
+        self.trace_sampled_total = 0
+        self.trace_tail_total = 0
+        self.trace_dropped_total = 0
+        self._exemplar: tuple[str, float] | None = None
+        self._exemplar_seq = 0
         # Last gauge values (the snapshot's instantaneous fields).
         self._queue_depth = 0
         self._active = 0
@@ -145,6 +177,39 @@ class ServingStatsRecorder:
             self.slo_ok_total += 1
         self._lat_ring[self._lat_n % self._lw] = latency_ticks
         self._lat_n += 1
+
+    def note_first_scheduled(self, wait_ticks: int) -> None:
+        """Request reached a slot for the FIRST time: the submit→admit
+        wait lands in the queue-wait split (end-to-end latency minus
+        these waits is pure execute time)."""
+        self.first_scheduled_total += 1
+        self.queue_wait_ticks_total += wait_ticks
+        self._wait_ring[self._wait_n % self._lw] = wait_ticks
+        self._wait_n += 1
+
+    def note_requeue_wait(self, wait_ticks: int) -> None:
+        """A preempted request re-reached a slot: the preempt→re-admit
+        wait is attributed separately (it previously lumped invisibly
+        into end-to-end latency)."""
+        self.requeue_wait_ticks_total += wait_ticks
+        self._wait_ring[self._wait_n % self._lw] = wait_ticks
+        self._wait_n += 1
+
+    def note_trace(self, tail: bool = False) -> None:
+        """One request trace promoted by the sampler."""
+        self.trace_sampled_total += 1
+        if tail:
+            self.trace_tail_total += 1
+
+    def note_trace_drop(self) -> None:
+        self.trace_dropped_total += 1
+
+    def note_exemplar(self, trace_id: str, value: float) -> None:
+        """Latest promoted-trace exemplar (last wins: the sampler only
+        promotes head samples and the slow tail, so during a burn the
+        exemplar is a current slow request)."""
+        self._exemplar = (trace_id, float(value))
+        self._exemplar_seq += 1
 
     def end_tick(self, *, queue_depth: int, active: int, kv_used: int,
                  kv_capacity: int, decode_tokens_total: int) -> None:
@@ -178,6 +243,11 @@ class ServingStatsRecorder:
             p95 = float(np.percentile(lat, 95))
         else:
             p50 = p95 = 0.0
+        wn = min(self._wait_n, self._lw)
+        wait_p95 = float(np.percentile(self._wait_ring[:wn], 95)) \
+            if wn else 0.0
+        ex_id, ex_val = (self._exemplar if self._exemplar is not None
+                         else (None, 0.0))
         return ServingSnapshot(
             epoch=self.epoch, seq=self._seq,
             queue_depth=self._queue_depth, active=self._active,
@@ -189,4 +259,13 @@ class ServingStatsRecorder:
             slo_ok_total=self.slo_ok_total,
             decode_tokens_total=self._decode_tokens_total,
             queue_depth_mean=q_mean, tokens_per_tick=tok_rate,
-            latency_p50_ticks=p50, latency_p95_ticks=p95)
+            latency_p50_ticks=p50, latency_p95_ticks=p95,
+            first_scheduled_total=self.first_scheduled_total,
+            queue_wait_ticks_total=self.queue_wait_ticks_total,
+            requeue_wait_ticks_total=self.requeue_wait_ticks_total,
+            queue_wait_p95_ticks=wait_p95,
+            trace_sampled_total=self.trace_sampled_total,
+            trace_tail_total=self.trace_tail_total,
+            trace_dropped_total=self.trace_dropped_total,
+            exemplar_trace_id=ex_id, exemplar_value=ex_val,
+            exemplar_seq=self._exemplar_seq)

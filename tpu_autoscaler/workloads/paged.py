@@ -421,7 +421,7 @@ class PagedBatcher(ContinuousBatcher):
                  max_len: int = 256, block_size: int = 16,
                  num_blocks: int | None = None, chunk: int = 32,
                  prefill_lanes: int = 2, mesh=None, key=None,
-                 slo_ticks: int | None = None):
+                 slo_ticks: int | None = None, reqtrace=None):
         if max_len % block_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"block_size {block_size}")
@@ -436,7 +436,7 @@ class PagedBatcher(ContinuousBatcher):
         self.preemptions = 0
         super().__init__(params, cfg, slots=slots, max_len=max_len,
                          chunk=chunk, mesh=mesh, key=key, ring=False,
-                         slo_ticks=slo_ticks)
+                         slo_ticks=slo_ticks, reqtrace=reqtrace)
 
     def _build_device_state(self, cfg, slots, max_len, chunk, mesh,
                             ring) -> None:
@@ -543,6 +543,7 @@ class PagedBatcher(ContinuousBatcher):
         # Reset request progress: it will re-prefill from scratch.
         req.generated.clear()
         req.done = False
+        req.preempted_tick = self.ticks
         self._queue.insert(0, req)
         slot.request = None
         slot.remaining_prompt = None
@@ -551,6 +552,8 @@ class PagedBatcher(ContinuousBatcher):
         self._release_slot(i)
         self.preemptions += 1
         self._stats.note_preempt()
+        if self._reqtrace is not None and req.request_id is not None:
+            self._reqtrace.note_preempt(req.request_id, self.ticks)
 
     # ---- engine loop ---------------------------------------------------
 
@@ -574,6 +577,7 @@ class PagedBatcher(ContinuousBatcher):
                 slot.seeded = False
                 self._has_pending[i] = False
                 self._stats.note_admit()
+                self._note_admitted(req)
                 self.cache = PagedKVCache(
                     k=self.cache.k, v=self.cache.v,
                     lengths=self.cache.lengths.at[i].set(0))
@@ -668,6 +672,7 @@ class PagedBatcher(ContinuousBatcher):
                                              slot.request)
                     slot.request.generated.append(tokn)
                     slot.seeded = True
+                    self._note_seeded(slot.request)
                     self._pending_token[i] = tokn
                     self._has_pending[i] = True
             self.cache = PagedKVCache(

@@ -45,14 +45,27 @@ from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 
 def final_stats_payload(reqs, engine, elapsed_s: float) -> dict:
     """The drain contract's machine-readable receipt: what was served,
-    what was not, per-request latencies, and the engine's final stats
-    snapshot — everything a reclaim test needs to assert that no
-    queued request was lost."""
+    what was not, per-request latencies — split into queue-wait vs
+    execute (ISSUE 14: ``submitted_tick`` survives preemption
+    re-queues, so end-to-end latency alone hides requeue wait) — and
+    the engine's final stats snapshot."""
     latencies = [
         (r.finished_tick - r.submitted_tick
          if r.done and r.finished_tick is not None
          and r.submitted_tick is not None else None)
         for r in reqs]
+    # Queue-wait = submit -> FIRST admission; execute = everything
+    # after (which still includes any requeue wait for preempted
+    # requests — the aggregate requeue_wait_ticks_total in ``stats``
+    # carries that remainder's split).
+    waits = [
+        (r.first_scheduled_tick - r.submitted_tick
+         if r.first_scheduled_tick is not None
+         and r.submitted_tick is not None else None)
+        for r in reqs]
+    execs = [
+        (lat - w if lat is not None and w is not None else None)
+        for lat, w in zip(latencies, waits)]
     return {
         "event": "final_stats",
         "served": sum(1 for r in reqs if r.done),
@@ -62,6 +75,8 @@ def final_stats_payload(reqs, engine, elapsed_s: float) -> dict:
         "ticks": engine.ticks,
         "decode_tokens": engine.decode_tokens,
         "request_latency_ticks": latencies,
+        "request_wait_ticks": waits,
+        "request_exec_ticks": execs,
         "stats": engine.stats().as_dict(),
     }
 
@@ -122,15 +137,27 @@ def final_stats_payload(reqs, engine, elapsed_s: float) -> dict:
                    "requests the slice back, the server stops "
                    "admitting, finishes in-flight sequences, and "
                    "exits 0 inside the drain window.")
+@click.option("--trace-sample", default=0.0, show_default=True,
+              type=click.FloatRange(0.0, 1.0),
+              help="Request-trace head-sampling rate (ISSUE 14): "
+                   "sampled requests (plus the ALWAYS-captured tail — "
+                   "SLO misses, preemptions, drain losses) emit span "
+                   "trees; counts ride the final-stats receipt.  "
+                   "0 disables the sampler entirely.")
+@click.option("--slo-ticks", default=None, type=int,
+              help="Engine-tick latency target: completions within "
+                   "this many ticks count as SLO-attained in the "
+                   "stats, and slower ones are tail-captured when "
+                   "--trace-sample is on.")
 @model_arch_options
 @click.option("--platform", default=None,
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
          max_len, chunk, ring, paged, block_size, num_blocks, spec_k,
          draft_layers, tp_degree, seed, final_stats_file,
-         annotations_file, vocab, seq_len, d_model, n_layers,
-         n_kv_heads, attention_window, no_rope, moe_experts, moe_top_k,
-         platform):
+         annotations_file, trace_sample, slo_ticks, vocab, seq_len,
+         d_model, n_layers, n_kv_heads, attention_window, no_rope,
+         moe_experts, moe_top_k, platform):
     """Serve mixed-length requests from the latest checkpoint."""
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(levelname)s: %(message)s")
@@ -269,6 +296,13 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
                 f"batch shards over them")
         mesh = make_mesh(tp=tp_degree)
         log.info("serving under mesh %s", dict(mesh.shape))
+    sampler = None
+    if trace_sample > 0.0:
+        from tpu_autoscaler.serving.reqtrace import RequestTraceSampler
+
+        sampler = RequestTraceSampler("serve",
+                                      sample_rate=trace_sample,
+                                      slo_ticks=slo_ticks)
     if paged:
         from tpu_autoscaler.workloads.paged import PagedBatcher
 
@@ -292,16 +326,19 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
                 params, cfg, dparams, dcfg, k=spec_k, slots=slots,
                 max_len=max_len, block_size=block_size,
                 num_blocks=num_blocks, chunk=chunk, mesh=mesh,
-                key=jax.random.PRNGKey(seed), seed=seed)
+                key=jax.random.PRNGKey(seed), seed=seed,
+                slo_ticks=slo_ticks, reqtrace=sampler)
         else:
             engine = PagedBatcher(
                 params, cfg, slots=slots, max_len=max_len,
                 block_size=block_size, num_blocks=num_blocks,
-                chunk=chunk, mesh=mesh, key=jax.random.PRNGKey(seed))
+                chunk=chunk, mesh=mesh, key=jax.random.PRNGKey(seed),
+                slo_ticks=slo_ticks, reqtrace=sampler)
     else:
         engine = ContinuousBatcher(
             params, cfg, slots=slots, max_len=max_len, chunk=chunk,
-            ring=ring, mesh=mesh, key=jax.random.PRNGKey(seed))
+            ring=ring, mesh=mesh, key=jax.random.PRNGKey(seed),
+            slo_ticks=slo_ticks, reqtrace=sampler)
     import time
 
     watcher = DrainWatcher(annotations_file or DEFAULT_ANNOTATIONS_PATH)
@@ -329,6 +366,8 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
     # the LAST stdout line, so the reclaim side can assert zero lost
     # requests without parsing logs.
     final = final_stats_payload(reqs, engine, dt)
+    if sampler is not None:
+        final["trace"] = sampler.debug_state()
     print(json.dumps(final))
     if final_stats_file:
         with open(final_stats_file, "w", encoding="utf-8") as f:

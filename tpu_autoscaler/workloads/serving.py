@@ -465,6 +465,13 @@ class Request:
     # preempted request's latency counts from its ORIGINAL submit).
     submitted_tick: int | None = None
     finished_tick: int | None = None
+    # Queue-wait/execute split (ISSUE 14): first tick the request held
+    # a slot, and the tick of its last preemption — the engine's
+    # admission path feeds both into the stats recorder's wait
+    # counters and the request-trace sampler.
+    request_id: str | None = None
+    first_scheduled_tick: int | None = None
+    preempted_tick: int | None = None
 
 
 @dataclasses.dataclass
@@ -493,7 +500,7 @@ class ContinuousBatcher:
     def __init__(self, params, cfg: ModelConfig, *, slots: int = 4,
                  max_len: int = 256, chunk: int = 32, mesh=None,
                  key=None, ring: bool = False,
-                 slo_ticks: int | None = None):
+                 slo_ticks: int | None = None, reqtrace=None):
         """``ring=True`` (needs cfg.attention_window): per-slot cache
         HBM becomes O(window + chunk) instead of O(max_len), and
         sequences may run PAST max_len — max_len then only bounds the
@@ -501,7 +508,13 @@ class ContinuousBatcher:
 
         ``slo_ticks``: completions within this many engine ticks of
         submission count as SLO-attained in ``stats()`` (None = no
-        target)."""
+        target).
+
+        ``reqtrace``: an optional
+        :class:`~tpu_autoscaler.serving.reqtrace.RequestTraceSampler`
+        — sampled per-request span trees built from the host-side
+        bookkeeping this scheduler already does (submit/admit/seeded/
+        preempt/finish); None costs one ``if`` per event."""
         if mesh is not None:
             # Re-place the params onto THIS mesh's TP layout: restored
             # checkpoints arrive committed to the shardings they were
@@ -538,6 +551,12 @@ class ContinuousBatcher:
         # reads a jax.Array.
         self._stats = ServingStatsRecorder(slots, slo_ticks=slo_ticks)
         self._stat_lengths = np.zeros(slots, np.int64)
+        # Request-trace sampler (ISSUE 14): wired to this recorder so
+        # promotion counters and exemplars ride the snapshot export.
+        self._reqtrace = reqtrace
+        if reqtrace is not None and reqtrace.stats is None:
+            reqtrace.stats = self._stats
+        self._rid_seq = 0
 
         # Device-side batched sampling (the hot path): greedy rows take
         # argmax, temperature rows sample categorically at their own
@@ -609,12 +628,42 @@ class ContinuousBatcher:
                 f"{self.max_len}")
         if request.submitted_tick is None:
             request.submitted_tick = self.ticks
+        if request.request_id is None:
+            self._rid_seq += 1
+            request.request_id = f"r{self._rid_seq}"
+        if self._reqtrace is not None:
+            self._reqtrace.note_submit(request.request_id, self.ticks)
         self._queue.append(request)
 
     @property
     def idle(self) -> bool:
         return not self._queue and all(
             s.request is None for s in self._slots)
+
+    def _note_admitted(self, req: Request) -> None:
+        """Wait-split + trace bookkeeping for one admission (shared by
+        every engine variant's ``_admit``): the FIRST admission closes
+        the submit→schedule wait, a re-admission closes a preemption
+        requeue wait — the split satellite's attribution point."""
+        if req.first_scheduled_tick is None:
+            req.first_scheduled_tick = self.ticks
+            self._stats.note_first_scheduled(
+                self.ticks - (req.submitted_tick or 0))
+        elif req.preempted_tick is not None:
+            self._stats.note_requeue_wait(
+                self.ticks - req.preempted_tick)
+        if self._reqtrace is not None and req.request_id is not None:
+            self._reqtrace.note_admit(req.request_id, self.ticks)
+
+    def _note_seeded(self, req: Request) -> None:
+        if self._reqtrace is not None and req.request_id is not None:
+            self._reqtrace.note_seeded(req.request_id, self.ticks)
+
+    def _trace_finish_attrs(self, req: Request) -> dict:
+        """Extra root-span attrs for a finished request's trace (the
+        speculative engine annotates accept economics here)."""
+        del req
+        return {}
 
     def _admit(self) -> None:
         if getattr(self, "draining", False):
@@ -627,6 +676,7 @@ class ContinuousBatcher:
                 slot.seeded = False
                 self._has_pending[i] = False
                 self._stats.note_admit()
+                self._note_admitted(req)
                 self._stat_lengths[i] = 0
                 # Reset the slot: stale cache beyond every future write
                 # point is invisible by construction.
@@ -663,6 +713,12 @@ class ContinuousBatcher:
             self._stat_lengths[i] = 0
             self._stats.note_finish(
                 self.ticks - (req.submitted_tick or 0))
+            if self._reqtrace is not None \
+                    and req.request_id is not None:
+                self._reqtrace.note_finish(
+                    req.request_id, self.ticks,
+                    tokens=len(req.generated),
+                    attrs=self._trace_finish_attrs(req) or None)
 
     def _kv_usage(self) -> tuple[int, int]:
         """(live KV token-slots, capacity), host-side only.  Ring
@@ -718,6 +774,7 @@ class ContinuousBatcher:
                 tok = self._sample_host(np.asarray(logits), slot.request)
                 slot.request.generated.append(tok)
                 slot.seeded = True
+                self._note_seeded(slot.request)
                 self._pending_token[i] = tok
                 self._has_pending[i] = True
                 self._finish_if_done(i)
@@ -776,8 +833,20 @@ class ContinuousBatcher:
                 self.draining = True
             if self.draining and all(
                     s.request is None for s in self._slots):
+                self._note_drain_handoff()
                 return
             if self.idle:
                 return
             self.tick()
         raise RuntimeError(f"engine did not drain in {max_ticks} ticks")
+
+    def _note_drain_handoff(self) -> None:
+        """Drain exit with requests still queued: each one's trace (if
+        sampled) closes with a ``drain_handoff`` span — a lost request
+        is always tail-captured, whatever the head sampling said."""
+        if self._reqtrace is None:
+            return
+        for req in self._queue:
+            if req.request_id is not None:
+                self._reqtrace.note_drain_lost(req.request_id,
+                                               self.ticks)

@@ -101,7 +101,8 @@ class SpeculativePagedBatcher(PagedBatcher):
                  slots: int = 4, max_len: int = 256,
                  block_size: int = 16, num_blocks: int | None = None,
                  chunk: int = 32, prefill_lanes: int = 2, mesh=None,
-                 key=None, seed: int = 0, slo_ticks: int | None = None):
+                 key=None, seed: int = 0, slo_ticks: int | None = None,
+                 reqtrace=None):
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         if k >= chunk:
@@ -122,7 +123,16 @@ class SpeculativePagedBatcher(PagedBatcher):
         super().__init__(params, cfg, slots=slots, max_len=max_len,
                          block_size=block_size, num_blocks=num_blocks,
                          chunk=chunk, prefill_lanes=prefill_lanes,
-                         mesh=mesh, key=key, slo_ticks=slo_ticks)
+                         mesh=mesh, key=key, slo_ticks=slo_ticks,
+                         reqtrace=reqtrace)
+
+    def _trace_finish_attrs(self, req) -> dict:
+        """Speculative economics on the request's root span: the
+        engine-wide accept rate / pass ratio as of this completion —
+        the decode span already carries its batched tick count, so a
+        slow-decode tail can be told apart from a cold draft."""
+        return {"accept_rate": round(self.accept_rate, 4),
+                "target_pass_ratio": round(self.target_pass_ratio, 4)}
 
     # ---- device state ---------------------------------------------------
 
