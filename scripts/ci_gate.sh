@@ -61,6 +61,17 @@
 #      with sharding ON; BENCH_SHARD.json.  The mixed + repair
 #      corpora re-run with --reconcile-shards 4 in the chaos stage
 #      above (exit 7).
+#   15 deadlock & determinism layer (ISSUE 15, docs/ANALYSIS.md):
+#      the three whole-program passes re-run --no-baseline and alone
+#      — TAL7xx lock-order graph, TAB8xx blocking-under-lock, TAD9xx
+#      replay-determinism — so these code families can NEVER grow
+#      baseline entries (a fresh inversion/blocking call/determinism
+#      leak fails here even if someone grandfathers it past stage 1),
+#      then the runtime lock-order witness cross-check
+#      (tests/test_lockwitness.py): every lock-order edge witnessed
+#      under the DeterministicScheduler must be modeled by the static
+#      TAL7xx graph — a witnessed-but-unmodeled edge is a checker
+#      blind spot and fails the stage.
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -70,26 +81,37 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/13] invariant analysis (--format=$fmt)"
+echo "== [1/14] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/13] mypy strict islands"
+echo "== [2/14] deadlock & determinism layer (TAL/TAB/TAD --no-baseline + witness cross-check)"
+# Zero-baseline-growth enforcement for the ISSUE 15 code families:
+# stage 1 honors baseline.toml, this stage deliberately does not.
+python -m tpu_autoscaler.analysis --format="$fmt" --no-baseline \
+    --select TAL,TAB,TAD tpu_autoscaler/ || exit 15
+JAX_PLATFORMS=cpu python -m pytest -q tests/test_lockwitness.py \
+    -p no:cacheprovider || exit 15
+
+echo "== [3/14] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [3/13] deterministic-schedule race tier"
-# One source of truth for the tier invocation: race.sh (its static
-# TAR-only pass re-runs here too — sub-2s, and harmless after stage 1).
-./scripts/race.sh || exit 4
+echo "== [4/14] deterministic-schedule race tier"
+# One source of truth for the tier invocation: race.sh.  Its static
+# layer and witness cross-check already ran above (stage 1 runs every
+# program pass over the whole package; stage 2 runs
+# tests/test_lockwitness.py) — RACE_STATIC_COVERED tells race.sh not
+# to pay for the whole-program analysis a third time.
+RACE_STATIC_COVERED=1 ./scripts/race.sh || exit 4
 
-echo "== [4/13] tracer-overhead gate"
+echo "== [5/14] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [5/13] mega-cluster scale tiers"
+echo "== [6/14] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [6/13] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
+echo "== [7/14] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -129,13 +151,13 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repair --reconcile-shards 4 \
     || exit 7
 
-echo "== [7/13] policy replay tier"
+echo "== [8/14] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [8/13] serving tier (adapter hot path + outcome replay)"
+echo "== [9/14] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
-echo "== [9/13] serving-trace tier (data-plane tracing overhead + acceptance)"
+echo "== [10/14] serving-trace tier (data-plane tracing overhead + acceptance)"
 # ISSUE 14 (docs/OBSERVABILITY.md "Request spans & exemplars"):
 # traced-vs-untraced replica step and 10k-replica exemplar fold
 # within 2% + noise grace at 1% sampling with tail capture ON, plus
@@ -146,16 +168,16 @@ echo "== [9/13] serving-trace tier (data-plane tracing overhead + acceptance)"
 # BENCH_SERVING.json["serving_trace"].
 JAX_PLATFORMS=cpu python bench.py serving-trace || exit 14
 
-echo "== [10/13] obs tier (TSDB ingest + alert evaluation)"
+echo "== [11/14] obs tier (TSDB ingest + alert evaluation)"
 JAX_PLATFORMS=cpu python bench.py obs || exit 10
 
-echo "== [11/13] cost tier (attribution ledger pass cost + conservation)"
+echo "== [12/14] cost tier (attribution ledger pass cost + conservation)"
 JAX_PLATFORMS=cpu python bench.py cost || exit 11
 
-echo "== [12/13] repack tier (week-long churn replay, never-worse gate)"
+echo "== [13/14] repack tier (week-long churn replay, never-worse gate)"
 JAX_PLATFORMS=cpu python bench.py repack || exit 12
 
-echo "== [13/13] sharded reconcile tier (million-pod loop + observe)"
+echo "== [14/14] sharded reconcile tier (million-pod loop + observe)"
 # ISSUE 13 (docs/SHARDING.md): the 1M-pod observe tier (indexed reads
 # must hold the 20x floor at 10x the PR-6 scale), then the full-loop
 # tier — sharded reconcile >= 2x serial passes/sec at 8 shards with

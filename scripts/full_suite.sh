@@ -11,15 +11,20 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# Static gate first: the invariant linter is sub-second and catches
-# architectural regressions (planner purity, thread discipline,
-# exception hygiene, jax purity, interprocedural races) before any
-# test burns wall-clock.
-./scripts/lint.sh
+# Static gate first: the invariant linter catches architectural
+# regressions (planner purity, thread discipline, exception hygiene,
+# jax purity, interprocedural races, lock order, blocking-under-lock,
+# replay determinism) before any test burns wall-clock.  --full: this
+# is the pre-release gate, so it must not inherit lint.sh's local
+# changed-only default (ISSUE 15).
+./scripts/lint.sh --full
 
-# Race gate (ISSUE 4): static TAR5xx pass + the deterministic-schedule
-# concurrency tier (seeded interleavings of the real informer/executor/
-# reconciler paths under a vector-clock happens-before checker).
+# Race gate (ISSUE 4, extended ISSUE 15): static TAR5xx + TAL7xx
+# passes, the deterministic-schedule concurrency tier (seeded
+# interleavings of the real informer/executor/reconciler paths under a
+# vector-clock happens-before checker), and the lock-order witness
+# cross-check (witnessed acquisition edges must all be modeled by the
+# static TAL7xx graph — docs/ANALYSIS.md).
 ./scripts/race.sh
 
 # Observe-path tier: informer vs relist-baseline at 5k pods/600 nodes

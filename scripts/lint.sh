@@ -3,11 +3,20 @@
 # on the strict islands (mypy.ini) when mypy is installed.  Sub-second
 # without mypy — run it before every commit; full_suite.sh runs it too.
 #
-#   ./scripts/lint.sh              # analyzer + mypy-if-present
+#   ./scripts/lint.sh              # analyzer (changed files only when
+#                                  # run locally; full tree in CI) +
+#                                  # mypy-if-present
+#   ./scripts/lint.sh --full       # analyzer over the full tree
 #   ./scripts/lint.sh --no-mypy    # analyzer only
 #   ./scripts/lint.sh --mypy-only  # just the mypy stage (ci_gate.sh
 #                                  # reuses this so the strict-island
 #                                  # list lives in exactly one place)
+#
+# Local runs default to `--changed-only`: findings are reported only
+# for git-changed/untracked files (the whole-program passes still see
+# the full tree, so interprocedural results stay sound).  CI (CI=true
+# in the environment, the GitHub Actions convention) and --full always
+# gate the whole tree.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -17,6 +26,9 @@ MYPY_TARGETS=(
   tpu_autoscaler/k8s/objects.py
   tpu_autoscaler/analysis
   tpu_autoscaler/actuators/executor.py
+  tpu_autoscaler/cost
+  tpu_autoscaler/obs/tsdb.py
+  tpu_autoscaler/obs/alerts.py
 )
 
 run_mypy() {
@@ -33,15 +45,39 @@ run_mypy() {
   fi
 }
 
-if [[ "${1:-}" == "--mypy-only" ]]; then
+# All flags combine (`--full --no-mypy`); an unrecognized flag is an
+# error, NOT a silent fall-through to the narrower changed-only
+# default — a typo'd `--fulll` must not scope a release gate down.
+FULL=""
+NO_MYPY=""
+MYPY_ONLY=""
+for arg in "$@"; do
+  case "$arg" in
+    --full)      FULL=1 ;;
+    --no-mypy)   NO_MYPY=1 ;;
+    --mypy-only) MYPY_ONLY=1 ;;
+    *)
+      echo "lint.sh: unknown argument: $arg" >&2
+      exit 2
+      ;;
+  esac
+done
+
+if [[ -n "$MYPY_ONLY" ]]; then
   run_mypy
   exit $?
 fi
 
-echo "== invariant linter (python -m tpu_autoscaler.analysis)"
-python -m tpu_autoscaler.analysis tpu_autoscaler/
+SCOPE_FLAG="--changed-only"
+if [[ "${CI:-}" == "true" || -n "$FULL" ]]; then
+  SCOPE_FLAG=""
+fi
 
-if [[ "${1:-}" != "--no-mypy" ]]; then
+echo "== invariant linter (python -m tpu_autoscaler.analysis ${SCOPE_FLAG:-<full>})"
+# shellcheck disable=SC2086 — SCOPE_FLAG is deliberately word-split
+python -m tpu_autoscaler.analysis $SCOPE_FLAG tpu_autoscaler/
+
+if [[ -z "$NO_MYPY" ]]; then
   run_mypy
 fi
 

@@ -3,22 +3,46 @@
 # detector, cheapest first.
 #
 #   layer 1 — static: the interprocedural escape/lockset pass (TAR5xx)
-#             over the whole package (sub-2s);
+#             and the lock-order pass (TAL7xx) over the whole package
+#             (one shared-graph run, seconds);
 #   layer 2 — dynamic: the deterministic-schedule concurrency tier
 #             (tests/test_sched.py + tests/test_races.py), which drives
 #             the real informer/executor/reconciler code through seeded
-#             interleavings under a vector-clock happens-before checker.
+#             interleavings under a vector-clock happens-before checker,
+#             plus the lock-order witness cross-check
+#             (tests/test_lockwitness.py): actual acquisition orders
+#             recorded at the concurrency seam must all be modeled by
+#             the static TAL7xx graph — a witnessed-but-unmodeled edge
+#             is a checker blind spot and fails here (ISSUE 15,
+#             docs/ANALYSIS.md).
 #
 # Run standalone before touching anything threaded; full_suite.sh runs
 # it too (after the lint gate).
+#
+# RACE_STATIC_COVERED=1 (set by ci_gate.sh only): skip layer 1 and the
+# witness cross-check because the caller ALREADY ran both — ci_gate
+# stage 1 runs every program pass over the whole package and stage 2
+# runs test_lockwitness.py verbatim, so repeating them here would pay
+# for the whole-program analysis a third time and the witness tier a
+# second.  Standalone runs (and full_suite.sh) keep both.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== race layer 1: static TAR5xx (python -m tpu_autoscaler.analysis --races)"
-python -m tpu_autoscaler.analysis --races tpu_autoscaler/
+witness="tests/test_lockwitness.py"
+if [ "${RACE_STATIC_COVERED:-0}" = 1 ]; then
+  echo "== race layer 1: static pass covered by caller (skipped)"
+  witness=""
+else
+  echo "== race layer 1: static TAR5xx + TAL7xx"
+  # One invocation: --select only filters the REPORT — every program
+  # pass runs regardless — so a second run would just repeat the whole
+  # analysis for the other code family.
+  python -m tpu_autoscaler.analysis --select TAR,TAL tpu_autoscaler/
+fi
 
-echo "== race layer 2: deterministic-schedule tier"
+echo "== race layer 2: deterministic-schedule tier${witness:+ + witness cross-check}"
+# shellcheck disable=SC2086  # $witness is deliberately word-split
 JAX_PLATFORMS=cpu python -m pytest -q tests/test_sched.py tests/test_races.py \
-  -p no:cacheprovider
+  $witness -p no:cacheprovider
 
 echo "RACE GATE GREEN"

@@ -45,6 +45,23 @@ class TestPod:
             {"name": "init", "resources": {"requests": {"cpu": "4"}}}]
         assert Pod(payload).resources.get("cpu") == 4.0
 
+    def test_init_container_bump_key_order_is_deterministic(self):
+        # TAD904 regression (ISSUE 15): the init-container max-bump
+        # used to build the merged vector by iterating a set UNION in
+        # hash order, and dict insertion order survives into every
+        # serialization of the vector — so the bytes the offline
+        # bundle-replay gate compares depended on PYTHONHASHSEED.
+        # Sorted construction makes the key order a pure function of
+        # the key set.
+        payload = make_pod(requests={
+            "cpu": "1", "memory": "1Gi", "zebra.example/x": "1",
+            "alpha.example/y": "2", "mango.example/q": "3"})
+        payload["spec"]["initContainers"] = [
+            {"name": "init", "resources": {"requests": {
+                "cpu": "2", "kiwi.example/z": "4", "beta.example/w": "5"}}}]
+        keys = list(Pod(payload).resources.as_dict())
+        assert keys == sorted(keys)
+
     def test_unschedulable_detection(self):
         assert Pod(make_pod()).is_unschedulable
         assert not Pod(make_pod(phase="Running", unschedulable=False,

@@ -200,7 +200,7 @@ class TokenProvider:
 
                 http = self._http if self._http is not None \
                     else requests.get
-                r = http(_METADATA_TOKEN_URL,
+                r = http(_METADATA_TOKEN_URL,  # analysis: allow=TAB801 single-flight refresh BY DESIGN (class docstring): waiters queue on the lock for the one bounded (METADATA_TIMEOUT_S) fetch instead of stampeding the metadata server
                          headers={"Metadata-Flavor": "Google"},
                          timeout=METADATA_TIMEOUT_S)
                 r.raise_for_status()

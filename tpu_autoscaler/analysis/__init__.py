@@ -17,9 +17,12 @@ from tpu_autoscaler.analysis.core import (
     render_baseline,
     run_analysis,
 )
+from tpu_autoscaler.analysis.blocking import BlockingUnderLockChecker
+from tpu_autoscaler.analysis.determinism import DeterminismChecker
 from tpu_autoscaler.analysis.escape import EscapeRaceChecker
 from tpu_autoscaler.analysis.exceptions import ExceptionHygieneChecker
 from tpu_autoscaler.analysis.jaxpurity import JaxPurityChecker
+from tpu_autoscaler.analysis.lockorder import LockOrderChecker
 from tpu_autoscaler.analysis.metricsdoc import (
     AlertDocChecker,
     MetricsDocChecker,
@@ -31,20 +34,26 @@ from tpu_autoscaler.analysis.threads import ThreadDisciplineChecker
 def default_checkers() -> list[Checker]:
     # TAT2xx stays in the lineup as the fallback for sharing the
     # interprocedural TAR5xx pass cannot resolve (docs/ANALYSIS.md).
+    # The four whole-program passes (TAR/TAL/TAB/TAD) share one
+    # PackageGraph per run via callgraph.shared_graph.
     return [PurityChecker(), ThreadDisciplineChecker(),
             ExceptionHygieneChecker(), JaxPurityChecker(),
-            EscapeRaceChecker(), MetricsDocChecker(),
-            AlertDocChecker()]
+            EscapeRaceChecker(), LockOrderChecker(),
+            BlockingUnderLockChecker(), DeterminismChecker(),
+            MetricsDocChecker(), AlertDocChecker()]
 
 
 __all__ = [
     "AlertDocChecker",
     "AnalysisResult",
+    "BlockingUnderLockChecker",
     "Checker",
+    "DeterminismChecker",
     "EscapeRaceChecker",
     "ExceptionHygieneChecker",
     "Finding",
     "JaxPurityChecker",
+    "LockOrderChecker",
     "MetricsDocChecker",
     "ProgramChecker",
     "PurityChecker",

@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from tpu_autoscaler.analysis import (
+    ProgramChecker,
     default_checkers,
     parse_baseline,
     render_baseline,
@@ -27,6 +29,26 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.toml")
 #: or the gate would spuriously fail when run from anywhere else.
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
+
+
+def _changed_files(root: str) -> set[str] | None:
+    """Repo-root-relative paths the working tree changed vs HEAD plus
+    untracked files, or None when git is unavailable/not a repo — the
+    caller then falls back to FULL output (fail open: a broken git must
+    widen the gate, never silently narrow it)."""
+    out: set[str] = set()
+    for args in (("git", "diff", "--name-only", "HEAD", "--"),
+                 ("git", "ls-files", "--others", "--exclude-standard")):
+        try:
+            proc = subprocess.run(args, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -58,9 +80,22 @@ def main(argv: list[str] | None = None) -> int:
                         choices=("text", "github"),
                         help="'github' emits ::error workflow-command "
                              "annotations for CI")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report only findings in files the git "
+                             "working tree changed vs HEAD (plus "
+                             "untracked files); the whole-program "
+                             "passes still analyze the FULL file set, "
+                             "so interprocedural findings stay sound — "
+                             "only the report is scoped.  Falls back "
+                             "to full output when git is unavailable.")
     parser.add_argument("--list-codes", action="store_true",
                         help="print every checker's codes and exit")
     args = parser.parse_args(argv)
+    if args.changed_only and args.write_baseline:
+        # A baseline regenerated from a scoped report would silently
+        # DROP every out-of-scope grandfathered finding.
+        parser.error("--changed-only and --write-baseline are "
+                     "mutually exclusive")
     if args.races:
         if args.select:
             # Refusing beats silently discarding the user's filter: a
@@ -110,9 +145,35 @@ def main(argv: list[str] | None = None) -> int:
     prefixes = tuple(p for p in args.select.split(",") if p)
     shown = [f for f in result.findings
              if not prefixes or f.code.startswith(prefixes)]
+    if args.changed_only:
+        changed = _changed_files(REPO_ROOT)
+        if changed is None:
+            print("warning: --changed-only requested but git is "
+                  "unavailable; reporting everything", file=sys.stderr)
+        else:
+            # Whole-program families bypass the scope filter: the
+            # interprocedural passes mean an edit in changed file A can
+            # mint a finding ANCHORED in unchanged file B (a new lock
+            # held into B's callee, a metric row removed from the docs),
+            # and CI keeps the tree clean of these codes — so any such
+            # finding present locally was caused by the local edits,
+            # whichever file it anchors to.  Per-file checkers anchor
+            # where they are caused and scope soundly.  Derived from
+            # the registered checkers so a future ProgramChecker
+            # family scopes correctly the day it lands.
+            wp = tuple(code for c in checkers
+                       if isinstance(c, ProgramChecker)
+                       for code in c.codes)
+            shown = [f for f in shown
+                     if f.file in changed or f.code.startswith(wp)]
+            print(f"(--changed-only: reporting {len(changed)} changed "
+                  f"file(s); whole-program passes saw the full tree)",
+                  file=sys.stderr)
     # Unused waivers (TAW00x) are meta-findings: always reported, never
-    # code-selectable away — a dead waiver is debt regardless of which
-    # slice of the analysis is being gated.
+    # code-selectable OR scope-able away — the interprocedural passes
+    # mean an edit in file A can kill the finding a waiver in untouched
+    # file B was silencing, and a --changed-only run that hid that dead
+    # waiver would pass locally only to fail CI's full-tree stage.
     shown += result.unused_waivers
     for f in shown:
         if args.format == "github":

@@ -1,0 +1,354 @@
+"""Blocking-call-under-lock lint (TAB8xx).
+
+A lock held across a blocking operation turns one slow syscall into a
+fleet-wide stall: every thread that wants the lock queues behind the
+network.  The same applies to the reconcile pass (the control plane's
+single hot thread — docs/DESIGN.md's 12 ms budget) and to seqlock
+sections in the TSDB (a blocked writer leaves ``_wseq`` odd and spins
+every reader through its bounded retry).  This pass catalogs the
+blocking operations the repo actually contains and reports each one by
+the most damning context it is reachable in:
+
+- TAB801 — blocking call while a lock may be held (held sets come from
+  the TAL7xx propagation: lexical ``with`` blocks plus locks held at
+  function entry across resolved call chains);
+- TAB802 — blocking call reachable from the reconcile hot section
+  (the transitive closure of ``Reconciler.reconcile_once`` — worker
+  thunks handed to the actuation pool are SEPARATE roots by the
+  callgraph's submit modeling and are correctly not in it);
+- TAB803 — blocking call inside a seqlock section (any function of a
+  ``_wseq``-bearing class that touches ``_wseq``, plus its callees).
+
+The catalog (``BLOCKING_CALLS``): HTTP (``requests.*``, ``urlopen``),
+``time.sleep``, ``subprocess.*`` (the ``make`` invocation in
+``native.py``), builtin file I/O (``open``), blocking socket ops, and
+un-timeouted ``Event.wait``/``Condition.wait``/``Queue.get``.  A timed
+wait is still a schedule hazard but a bounded one; the untimeouted form
+can park the holder forever, which is why only it is cataloged.
+
+One finding per site with the highest-severity applicable code
+(801 > 803 > 802) — a site under a lock inside the hot path is ONE
+defect (move the call off the lock), not three.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_autoscaler.analysis.callgraph import (
+    POOL,
+    SYNC_CONDITION,
+    SYNC_EVENT,
+    SYNC_QUEUE,
+    FuncInfo,
+    PackageGraph,
+    _short as _short_fn,
+    canonical_call_name,
+    dotted_name,
+    lock_id,
+    shared_graph,
+)
+from tpu_autoscaler.analysis.core import (
+    Finding,
+    ProgramChecker,
+    SourceFile,
+)
+from tpu_autoscaler.analysis.lockorder import (
+    _short_lock,
+    lock_order_graph,
+)
+
+#: Dotted-call patterns that block the calling thread.  Matched on the
+#: full dotted name (``time.sleep``) or, for ``<root>.*`` entries, on
+#: the root module name.
+BLOCKING_CALLS: dict[str, str] = {
+    "time.sleep": "time.sleep",
+    "subprocess.*": "subprocess (spawns and waits on a child process)",
+    "requests.*": "HTTP request",
+    "urllib.*": "HTTP request",
+    "socket.*": "blocking socket operation",
+    "shutil.*": "bulk file I/O",
+}
+
+#: Bare builtins that block on the filesystem / tty.
+BLOCKING_BUILTINS: dict[str, str] = {
+    "open": "file I/O",
+    "input": "tty read",
+}
+
+#: os.* entry points that hit the filesystem hard enough to matter.
+_OS_BLOCKING = frozenset({
+    "os.replace", "os.rename", "os.makedirs", "os.remove", "os.fsync",
+    "os.sync",
+})
+
+#: The reconcile hot section's root (suffix match over qnames).
+HOT_ROOT_SUFFIX = ".reconcile_once"
+
+#: Roots whose bare ATTRIBUTE reference (not call) is itself a
+#: blocking callable — ``http = self._http or requests.get`` binds the
+#: transport to a local; calling that local blocks (the TokenProvider
+#: single-flight shape).
+_HTTP_ROOTS = frozenset({"requests", "urllib"})
+
+
+def _is_http_ref(expr: ast.AST) -> bool:
+    """A callable-valued expression that (possibly) IS an HTTP entry
+    point: ``requests.get`` referenced un-called, through ``or`` /
+    conditional fallbacks."""
+    if isinstance(expr, ast.Attribute):
+        d = dotted_name(expr)
+        return d is not None and d.split(".")[0] in _HTTP_ROOTS
+    if isinstance(expr, ast.IfExp):
+        return _is_http_ref(expr.body) or _is_http_ref(expr.orelse)
+    if isinstance(expr, ast.BoolOp):
+        return any(_is_http_ref(v) for v in expr.values)
+    return False
+
+
+def _http_locals(fn_node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _is_http_ref(node.value):
+            out.add(node.targets[0].id)
+    return out
+
+#: Attribute marking a seqlock section (obs/tsdb.py's write sequence).
+SEQLOCK_ATTR = "_wseq"
+
+
+def _bounded_timeout(node: ast.Call, pos: int) -> bool:
+    """True when the call carries a timeout that actually bounds it.
+    The timeout rides positionally at index ``pos`` or as
+    ``timeout=``; an explicit ``None`` (either spelling) parks the
+    holder exactly like omitting it, so only a non-None value counts."""
+    t: ast.AST | None
+    if len(node.args) > pos:
+        t = node.args[pos]
+    else:
+        t = next((kw.value for kw in node.keywords
+                  if kw.arg == "timeout"), None)
+    return t is not None and not (isinstance(t, ast.Constant)
+                                  and t.value is None)
+
+
+def _blocking_kind(node: ast.Call, fn: FuncInfo,
+                   locals_: dict[str, str],
+                   graph: PackageGraph) -> str | None:
+    """What (if anything) makes this call blocking — a catalog label,
+    or None."""
+    d = canonical_call_name(node.func, fn, graph)
+    if d is not None:
+        if d in _OS_BLOCKING:
+            return "file I/O"
+        full = BLOCKING_CALLS.get(d)
+        if full is not None:
+            return full
+        root = d.split(".")[0]
+        star = BLOCKING_CALLS.get(f"{root}.*")
+        if star is not None:
+            return star
+        if d in BLOCKING_BUILTINS:
+            return BLOCKING_BUILTINS[d]
+    # Un-timeouted waits on typed receivers.  Timeout positions differ:
+    # ``wait(timeout=None)`` takes it first, ``Queue.get(block=True,
+    # timeout=None)`` second — ``q.get(True)`` and an explicit
+    # ``timeout=None`` (any spelling) are still unbounded, while
+    # ``q.get(False)`` / ``get(block=False)`` never blocks at all (it
+    # raises ``queue.Empty`` immediately).
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("wait", "get"):
+        t = graph.expr_type(node.func.value, fn, locals_)
+        if node.func.attr == "wait" \
+                and t in (SYNC_EVENT, SYNC_CONDITION) \
+                and not _bounded_timeout(node, 0):
+            return "un-timeouted wait (can park the holder forever)"
+        if node.func.attr == "get" and t == SYNC_QUEUE \
+                and not _bounded_timeout(node, 1):
+            block = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "block"), None)
+            if not (isinstance(block, ast.Constant)
+                    and not block.value):
+                return "un-timeouted Queue.get"
+    return None
+
+
+#: Sink marker: the closure is handed to a pool submit / Thread target
+#: and runs on its OWN root (the callgraph models it as one) — its body
+#: is off the enclosing function's hot/seqlock path.
+_ESCAPE = "@escape"
+
+
+def _closure_sinks(fn: FuncInfo, graph: PackageGraph,
+                   locals_: dict[str, str]) -> dict[tuple[int, int],
+                                                    set[str]]:
+    """Where each nested def/lambda in ``fn`` actually RUNS.
+
+    Maps the closure's line span to ``{_ESCAPE}`` when it is handed to
+    a pool ``submit``/``Thread`` (another root) or to the set of
+    resolved callee qnames it is passed to — a closure passed to a
+    package function executes synchronously inside that callee (the
+    tsdb ``_guarded`` read thunks run INSIDE the seqlock retry loop),
+    so its blocking calls inherit the CALLEE's hot/seqlock context.
+    Spans with no entry run where they are defined and keep the
+    enclosing function's context."""
+    named: dict[str, tuple[int, int]] = {}
+    for n in ast.walk(fn.node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n is not fn.node:
+            named[n.name] = (n.lineno, n.end_lineno or n.lineno)
+        elif isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and isinstance(n.value, ast.Lambda):
+            # ``work = lambda: ...; pool.submit(work)`` — the bound
+            # name stands for the lambda's span exactly like a nested
+            # def's name does.
+            named[n.targets[0].id] = (n.value.lineno,
+                                      n.value.end_lineno
+                                      or n.value.lineno)
+    sinks: dict[tuple[int, int], set[str]] = {}
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        spans = []
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                spans.append((arg.lineno, arg.end_lineno or arg.lineno))
+            elif isinstance(arg, ast.Name) and arg.id in named:
+                spans.append(named[arg.id])
+        if not spans:
+            continue
+        label: set[str] | None = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "submit":
+            recv_t = graph.expr_type(node.func.value, fn, locals_)
+            recv_ci = graph.classes.get(recv_t) if recv_t else None
+            if recv_t == POOL or (recv_ci is not None
+                                  and graph._owns_pool(recv_ci)):
+                label = {_ESCAPE}
+        d = dotted_name(node.func)
+        if label is None and d is not None \
+                and d.split(".")[-1] == "Thread":
+            label = {_ESCAPE}
+        if label is None:
+            target = graph.resolve_callable(node.func, fn, locals_)
+            if target is None:
+                continue
+            label = {target.qname}
+        for s in spans:
+            sinks.setdefault(s, set()).update(label)
+    return sinks
+
+
+def _innermost_sink(sinks: dict[tuple[int, int], set[str]],
+                    line: int) -> set[str] | None:
+    """The classification of the innermost CLASSIFIED span containing
+    ``line`` (closures nest: a thunk built inside an escaping thunk is
+    judged by its own sink first)."""
+    best: tuple[int, set[str]] | None = None
+    for (lo, hi), label in sinks.items():
+        if lo <= line <= hi and (best is None or hi - lo < best[0]):
+            best = (hi - lo, label)
+    return best[1] if best else None
+
+
+class BlockingUnderLockChecker(ProgramChecker):
+    name = "blocking-under-lock"
+    codes = {
+        "TAB801": "blocking call while a lock may be held",
+        "TAB802": "blocking call reachable from the reconcile hot "
+                  "section",
+        "TAB803": "blocking call inside a seqlock section",
+    }
+
+    def applies_to(self, rel_path: str) -> bool:
+        return "tpu_autoscaler/testing/" not in rel_path
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
+        graph = shared_graph(files)
+        lg = lock_order_graph(graph)
+
+        hot_roots = {q for q in graph.funcs
+                     if q.endswith(HOT_ROOT_SUFFIX)}
+        hot = graph._closure(hot_roots)
+
+        seq_roots = set()
+        for fn in graph.funcs.values():
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == SEQLOCK_ATTR:
+                    seq_roots.add(fn.qname)
+                    break
+        seq = graph._closure(seq_roots)
+
+        findings: list[Finding] = []
+        for fn in graph.funcs.values():
+            locals_ = graph.local_types(fn)
+            http_locals = _http_locals(fn.node)
+            sinks = _closure_sinks(fn, graph, locals_)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _blocking_kind(node, fn, locals_, graph)
+                if kind is None and isinstance(node.func, ast.Name) \
+                        and node.func.id in http_locals:
+                    kind = "HTTP request (transport bound to a local)"
+                if kind is None:
+                    continue
+                where = _short_fn(fn.qname)
+                held = lg.held_at_line(fn.qname, node.lineno)
+                deferred = lg.in_deferred_scope(fn.qname, node.lineno)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "wait":
+                    # Condition.wait releases its OWN lock for the
+                    # duration — holding only that lock (or, for a
+                    # Condition(lock), the lock it wraps) is the
+                    # canonical idiom, not a stall; waiting with a
+                    # SECOND lock held is TAL702's finding.
+                    rel = lock_id(node.func.value, fn, locals_, graph)
+                    if rel is not None:
+                        held = held - lg.own_locks(rel)
+                if held:
+                    locks = ", ".join(sorted(
+                        _short_lock(h) for h in held))
+                    findings.append(Finding(
+                        fn.rel_path, node.lineno, "TAB801",
+                        f"{where} performs {kind} while holding "
+                        f"[{locks}] — every contender queues behind "
+                        f"the blocking call"))
+                else:
+                    # A nested def/lambda's body runs where the
+                    # closure is CALLED, not where it is defined: a
+                    # pool-submit/Thread-target closure runs on its
+                    # own root (off this function's hot or seqlock
+                    # path entirely), while one passed to a resolved
+                    # package callee runs synchronously INSIDE that
+                    # callee — the tsdb ``_guarded`` read thunks
+                    # execute in the seqlock retry loop, so they are
+                    # judged by the callee's context, not skipped.
+                    ctx = {fn.qname}
+                    if deferred:
+                        sink = _innermost_sink(sinks, node.lineno)
+                        if sink is not None:
+                            if _ESCAPE in sink:
+                                continue
+                            ctx = sink
+                    if ctx & seq:
+                        findings.append(Finding(
+                            fn.rel_path, node.lineno, "TAB803",
+                            f"{where} performs {kind} inside a seqlock "
+                            f"section — readers spin their bounded "
+                            f"retry for the duration"))
+                    elif ctx & hot:
+                        findings.append(Finding(
+                            fn.rel_path, node.lineno, "TAB802",
+                            f"{where} performs {kind} on the reconcile "
+                            f"hot path (reachable from reconcile_once) "
+                            f"— the control loop stalls for the "
+                            f"duration"))
+        findings.sort(key=lambda f: (f.file, f.line, f.code))
+        return findings
+

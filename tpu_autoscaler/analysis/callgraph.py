@@ -61,6 +61,15 @@ _POOL_CTORS = frozenset({"ThreadPoolExecutor", "pool_executor"})
 LOCK_TYPES = frozenset({SYNC_LOCK, SYNC_RLOCK, SYNC_CONDITION})
 SYNC_TYPES = frozenset(_SYNC_CTORS.values())
 
+#: Thread-safe queues get their own marker, deliberately OUTSIDE
+#: SYNC_TYPES: the TAB8xx lint needs to recognize a ``.get()``
+#: receiver as a queue, but a queue attribute must not become a
+#: ``sync_attr`` (that would change TAT2xx/TAR5xx exemptions).
+SYNC_QUEUE = "@sync:Queue"
+_QUEUE_CTORS = frozenset({
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+})
+
 #: The root every externally-callable function belongs to.
 MAIN_ROOT = "main"
 
@@ -89,6 +98,15 @@ class ClassInfo:
         self.elem_types: dict[str, str] = {}   # container attr -> element
         self.sync_attrs: set[str] = set()
         self.lock_attrs: set[str] = set()
+        #: sync attr -> (rel_path, line) of its constructing assignment —
+        #: the identity the runtime lock-order witness records, so the
+        #: static and witnessed graphs can be joined on creation site.
+        self.attr_sites: dict[str, tuple[str, int]] = {}
+        #: condition attr -> the lock attr it was constructed OVER
+        #: (``self._cond = Condition(self._lock)``): waiting on the
+        #: condition releases THAT lock, so the two ids alias for
+        #: lock-order purposes.
+        self.cond_aliases: dict[str, str] = {}
         self.is_thread = False                 # set by PackageGraph
 
 
@@ -100,6 +118,7 @@ class ModuleInfo:
         self.functions: dict[str, FuncInfo] = {}
         self.classes: dict[str, ClassInfo] = {}
         self.global_types: dict[str, str] = {}  # module-level name -> type
+        self.global_sites: dict[str, int] = {}  # module-level name -> line
 
 
 def _module_name(rel_path: str) -> str:
@@ -121,6 +140,11 @@ class PackageGraph:
         self.thread_roots: dict[str, str] = {}
         #: func qname -> set of root ids (incl. MAIN_ROOT)
         self.roots_of: dict[str, frozenset[str]] = {}
+        #: lazily-built lock-order overlay (lockorder.lock_order_graph
+        #: owns the type) — 1:1 with this graph, so it lives here
+        #: instead of a second id-keyed global cache with its own
+        #: eviction policy and staleness guard.
+        self.lock_order: object | None = None
         for src in files:
             self._index_module(src)
         self._resolve_thread_classes()
@@ -171,6 +195,7 @@ class PackageGraph:
                 t = self._value_type_shallow(stmt.value)
                 if t is not None:
                     mod.global_types[stmt.targets[0].id] = t
+                    mod.global_sites[stmt.targets[0].id] = stmt.value.lineno
 
     @staticmethod
     def _value_type_shallow(value: ast.AST) -> str | None:
@@ -182,6 +207,8 @@ class PackageGraph:
                 leaf = d.split(".")[-1]
                 if leaf in _SYNC_CTORS:
                     return _SYNC_CTORS[leaf]
+                if leaf in _QUEUE_CTORS:
+                    return SYNC_QUEUE
                 if leaf in _POOL_CTORS:
                     return POOL
         return None
@@ -273,6 +300,8 @@ class PackageGraph:
         leaf = d.split(".")[-1]
         if leaf in _SYNC_CTORS:
             return _SYNC_CTORS[leaf]
+        if leaf in _QUEUE_CTORS:
+            return SYNC_QUEUE
         target = self.resolve_symbol(self._qualify(d, mod)) \
             if "." in d else self._resolve_name(d, mod)
         if isinstance(target, ClassInfo):
@@ -351,6 +380,8 @@ class PackageGraph:
         leaf = d.split(".")[-1]
         if leaf in _SYNC_CTORS:
             return _SYNC_CTORS[leaf]
+        if leaf in _QUEUE_CTORS:
+            return SYNC_QUEUE
         if leaf in _POOL_CTORS:
             return POOL
         mod = self.modules[_module_name(fn.rel_path)]
@@ -435,6 +466,21 @@ class PackageGraph:
                             ci.attr_types.setdefault(attr, t)
                             if t in SYNC_TYPES:
                                 ci.sync_attrs.add(attr)
+                                if value is not None:
+                                    ci.attr_sites.setdefault(
+                                        attr, (ci.rel_path, value.lineno))
+                                if t == SYNC_CONDITION \
+                                        and isinstance(value, ast.Call):
+                                    lk = value.args[0] if value.args \
+                                        else next(
+                                            (kw.value
+                                             for kw in value.keywords
+                                             if kw.arg == "lock"), None)
+                                    if lk is not None \
+                                            and self._is_self_attr(lk):
+                                        ci.cond_aliases.setdefault(
+                                            attr,
+                                            lk.attr)  # type: ignore[union-attr]
                             if t in LOCK_TYPES:
                                 ci.lock_attrs.add(attr)
 
@@ -592,3 +638,72 @@ def _is_property(node: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
 def _short(qname: str) -> str:
     parts = qname.split(".")
     return ".".join(parts[-2:]) if len(parts) >= 2 else qname
+
+
+def lock_id(expr: ast.AST, fn: FuncInfo, locals_: dict[str, str],
+            graph: PackageGraph) -> str | None:
+    """Stable identity for the lock object in ``with <expr>:`` — the ONE
+    naming scheme shared by the escape pass (TAR5xx locksets), the
+    lock-order pass (TAL7xx graph nodes), and the runtime witness
+    cross-check: ``<ClassQname>.<attr>`` for instance locks,
+    ``<module>.<name>`` for module-level locks, ``<fn>:<name>`` for
+    locals."""
+    t = graph.expr_type(expr, fn, locals_)
+    if t not in LOCK_TYPES:
+        return None
+    if isinstance(expr, ast.Attribute):
+        base_t = graph.expr_type(expr.value, fn, locals_)
+        if base_t is not None:
+            return f"{base_t}.{expr.attr}"
+        return f"{fn.qname}?.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        mod = _module_name(fn.rel_path)
+        if expr.id in graph.modules[mod].global_types:
+            return f"{mod}.{expr.id}"
+        return f"{fn.qname}:{expr.id}"         # local lock variable
+    return None
+
+
+def canonical_call_name(expr: ast.AST, fn: FuncInfo,
+                        graph: PackageGraph) -> str | None:
+    """``dotted_name`` with the leading import alias rewritten to its
+    real target: ``import time as _time`` makes ``_time.sleep(...)``
+    read as ``time.sleep``, and ``from time import sleep as snooze``
+    makes ``snooze(...)`` read as ``time.sleep``.  The syntactic
+    catalogs (TAB8xx blocking ops, TAD9xx clock/randomness) match on
+    the canonical name — without this an alias silently disables the
+    checker for the whole file: it fails OPEN, no finding and no
+    waiver."""
+    d = dotted_name(expr)
+    if d is None:
+        return None
+    mod = graph.modules.get(_module_name(fn.rel_path))
+    if mod is None:
+        return d
+    head, _, rest = d.partition(".")
+    target = mod.imports.get(head)
+    if target is None or target == head:
+        return d
+    return f"{target}.{rest}" if rest else target
+
+
+#: One PackageGraph per (identical) file list per process: the four
+#: whole-program passes run back-to-back over the same SourceFile
+#: objects inside one run_analysis call, and indexing the package is
+#: the dominant cost — share the graph instead of rebuilding it.  The
+#: cache holds strong references to its SourceFiles (via the graph),
+#: so id-reuse cannot alias a dead entry; bounded so long-lived
+#: processes (pytest) cannot accumulate stale trees.
+_GRAPH_CACHE: dict[tuple[int, ...], PackageGraph] = {}
+_GRAPH_CACHE_MAX = 8
+
+
+def shared_graph(files: list[SourceFile]) -> PackageGraph:
+    key = tuple(id(s) for s in files)
+    graph = _GRAPH_CACHE.get(key)
+    if graph is None:
+        graph = PackageGraph(files)
+        if len(_GRAPH_CACHE) >= _GRAPH_CACHE_MAX:
+            _GRAPH_CACHE.pop(next(iter(_GRAPH_CACHE)))
+        _GRAPH_CACHE[key] = graph
+    return graph

@@ -1,0 +1,455 @@
+"""Replay-determinism contract checker (TAD9xx).
+
+The repo's replay oracles are load-bearing: the sharded planner's
+byte-identical merge (docs/SHARDING.md), the black-box bundle replay's
+exit-2 divergence gate (docs/OBSERVABILITY.md), the chaos grammar's
+pure-function-of-seed contract (docs/CHAOS.md), and the
+policy/serving replay benches that score every PR.  All of them reduce
+to one property: a contract function run twice on the same inputs
+produces the same bytes.  Nothing checked it statically — an unseeded
+``random`` call, a wall-clock read, or a hash-order set iteration
+breaks the oracle silently, usually only under a different
+``PYTHONHASHSEED``.
+
+Scope: every function defined in a CONTRACT module (planner + fitter,
+``chaos/scenario.py``, ``policy/replay.py`` + ``forecast.py``,
+``serving/replay.py``, the shard fan-out/merge) plus every digest
+builder (any function whose name contains ``digest``) anywhere in the
+package — closed transitively over the resolved call graph, so a
+helper a contract module calls is held to the same bar.  Unresolvable
+callees produce no edge (the evidence discipline shared with TAR5xx);
+what the closure cannot see, the seeded replay tests still cover.
+
+| code | meaning |
+| --- | --- |
+| TAD901 | wall-clock read (``time.time``/``monotonic``, ``datetime.now`` ...) |
+| TAD902 | unseeded randomness (module-level ``random.*``, ``uuid``, ``os.urandom``, ``secrets``, ``np.random.*``) |
+| TAD903 | ``id()``-keyed map (ids are allocation order — different every run) |
+| TAD904 | unsorted set iteration feeding an order-sensitive fold |
+
+TAD902 flags only MODULE-level randomness: a ``random.Random(seed)``
+instance threaded through parameters is exactly the sanctioned pattern
+(the chaos grammar's), and calls on such instances are not findings.
+TAD904 exempts iteration wrapped in ``sorted(...)``, set expressions
+consumed by order-insensitive folds (``len``/``min``/``max``/``sum``/
+``any``/``all``/``set``/``frozenset``), and loop bodies that only
+XOR-fold (``^=``) — XOR is commutative, which is why the informer's
+bucket digests are legal by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_autoscaler.analysis.callgraph import (
+    FuncInfo,
+    PackageGraph,
+    _short as _short_fn,
+    canonical_call_name,
+    dotted_name,
+    shared_graph,
+)
+from tpu_autoscaler.analysis.core import (
+    Finding,
+    ProgramChecker,
+    SourceFile,
+)
+
+#: Modules whose every function is under the replay contract, tagged
+#: with the contract they anchor (the tag appears in messages so a
+#: finding in a shared helper names WHY it is in scope).
+CONTRACT_MODULES: dict[str, str] = {
+    "tpu_autoscaler/engine/planner.py": "planner",
+    "tpu_autoscaler/engine/fitter.py": "planner",
+    "tpu_autoscaler/chaos/scenario.py": "chaos-grammar",
+    "tpu_autoscaler/policy/replay.py": "policy-replay",
+    "tpu_autoscaler/policy/forecast.py": "policy-replay",
+    "tpu_autoscaler/serving/replay.py": "serving-replay",
+    "tpu_autoscaler/controller/shard.py": "shard-merge",
+}
+
+#: Wall-clock reads (dotted-name match).  ``time.perf_counter`` is
+#: deliberately absent: the repo uses it exclusively as a duration
+#: meter feeding ``metrics.observe`` histograms (the 12 ms overhead
+#: budget's instrumentation), and a duration can only reach a replayed
+#: decision by first failing the TAP1xx purity gate — flagging every
+#: telemetry stopwatch would bury the real leaks.
+_WALL_CLOCK = frozenset({
+    "time.time", "time.monotonic", "time.time_ns", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+    "datetime.now", "datetime.utcnow", "date.today",
+})
+
+#: Seeded-generator constructors: ``random.Random(seed)`` /
+#: ``np.random.default_rng(seed)`` ARE the sanctioned pattern — they
+#: are only findings when called with no seed at all.
+_SEEDED_CTORS = frozenset({
+    "random.Random", "np.random.default_rng", "numpy.random.default_rng",
+})
+
+#: Module-level randomness roots: any call ``<root>.<fn>(...)`` where
+#: the root resolves to one of these MODULES is unseeded (process-
+#: global state), unlike a seeded ``Random`` instance.
+_RANDOM_ROOTS = frozenset({"random", "secrets"})
+
+#: uuid is only non-deterministic through its entropy/clock-reading
+#: constructors; ``uuid3``/``uuid5`` hash their inputs and ``UUID()``
+#: parses, so flagging the whole module would force bogus waivers on
+#: replay-safe name-based ids.
+_UUID_ENTROPY = frozenset({"uuid.uuid1", "uuid.uuid4"})
+
+_ORDER_INSENSITIVE = frozenset({
+    "sorted", "len", "min", "max", "sum", "any", "all", "set",
+    "frozenset",
+})
+
+
+def _is_set_expr(expr: ast.AST, set_locals: set[str]) -> bool:
+    """Shallow evidence that ``expr`` is a set: literal, comprehension,
+    ``set()``/``frozenset()`` call, a local known to hold one, or a
+    union/intersection of such."""
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        d = dotted_name(expr.func)
+        if d in ("set", "frozenset"):
+            return True
+        if isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in ("union", "intersection",
+                                       "difference",
+                                       "symmetric_difference"):
+            return _is_set_expr(expr.func.value, set_locals)
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in set_locals
+    if isinstance(expr, ast.BinOp) \
+            and isinstance(expr.op, (ast.BitOr, ast.BitAnd, ast.Sub,
+                                     ast.BitXor)):
+        return (_is_set_expr(expr.left, set_locals)
+                or _is_set_expr(expr.right, set_locals))
+    return False
+
+
+def _set_locals(fn_node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    assigns: list[ast.Assign] = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            assigns.append(node)
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            ann = node.annotation
+            d = dotted_name(ann.value if isinstance(ann, ast.Subscript)
+                            else ann)
+            if d in ("set", "frozenset", "Set", "FrozenSet",
+                     "typing.Set", "typing.FrozenSet"):
+                out.add(node.target.id)
+    # Fixpoint over the assignment chain: ast.walk is breadth-first, so
+    # `t = s | extra` at function top level is visited BEFORE the
+    # `s = set()` sitting one block deeper (`if cond: s = set()`) — a
+    # single pass would miss t and the downstream order-sensitive fold.
+    changed = True
+    while changed:
+        changed = False
+        for node in assigns:
+            name = node.targets[0].id  # type: ignore[union-attr]
+            if name not in out and _is_set_expr(node.value, out):
+                out.add(name)
+                changed = True
+    # Kill on rebinding: a name whose LAST assignment is not
+    # set-valued was rebound away from a set (`s = sorted(s)` yields a
+    # list) — iterating it afterwards is deterministic, so flagging it
+    # would force a bogus waiver on the canonical TAD904 fix itself.
+    last: dict[str, ast.Assign] = {}
+    for node in assigns:
+        name = node.targets[0].id  # type: ignore[union-attr]
+        if name not in last or node.lineno > last[name].lineno:
+            last[name] = node
+    for name, node in last.items():
+        if name in out and not _is_set_expr(node.value, out):
+            out.discard(name)
+    return out
+
+
+def _order_free_body(body: list[ast.stmt]) -> bool:
+    """True when the loop body is commutative over iteration order:
+    XOR folds (``^=``, the bucket-digest idiom), ``.add``/``.discard``
+    into sets, and conditionals over only such statements."""
+    ok = False
+    for stmt in body:
+        if isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.op, ast.BitXor):
+            ok = True
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Call) \
+                and isinstance(stmt.value.func, ast.Attribute) \
+                and stmt.value.func.attr in ("add", "discard"):
+            ok = True
+            continue
+        if isinstance(stmt, ast.If) \
+                and _order_free_body(stmt.body) \
+                and (not stmt.orelse or _order_free_body(stmt.orelse)):
+            ok = True
+            continue
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        return False
+    return ok
+
+
+class _FnScan(ast.NodeVisitor):
+    """One function body's determinism findings."""
+
+    def __init__(self, fn: FuncInfo, tag: str, graph: PackageGraph):
+        self.fn = fn
+        self.tag = tag
+        self.graph = graph
+        self.set_locals = _set_locals(fn.node)
+        self.findings: list[Finding] = []
+        #: set-iteration nodes blessed by an order-insensitive consumer.
+        self._exempt: set[int] = set()
+        #: wall-clock calls blessed by the virtual-clock-default idiom
+        #: (``now = time.time() if now is None else now``): the clock
+        #: is only the PRODUCTION default — replay always injects.
+        self._clock_default: set[int] = set()
+
+    def _emit(self, line: int, code: str, msg: str) -> None:
+        where = _short_fn(self.fn.qname)
+        self.findings.append(Finding(
+            self.fn.rel_path, line, code,
+            f"{where} {msg} (under the '{self.tag}' replay contract)"))
+
+    # -- calls ------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = canonical_call_name(node.func, self.fn, self.graph)
+        if d is not None:
+            if d in _WALL_CLOCK:
+                if id(node) not in self._clock_default:
+                    self._emit(node.lineno, "TAD901",
+                               f"reads the wall clock via '{d}' — "
+                               f"replay must take 'now' as an input")
+            elif d in _SEEDED_CTORS:
+                if not node.args and not node.keywords:
+                    self._emit(
+                        node.lineno, "TAD902",
+                        f"'{d}()' with no seed draws from OS entropy — "
+                        f"pass an explicit seed")
+            else:
+                root = d.split(".")[0]
+                if (root in _RANDOM_ROOTS and "." in d) \
+                        or d in _UUID_ENTROPY:
+                    self._emit(
+                        node.lineno, "TAD902",
+                        f"draws process-global randomness via '{d}' — "
+                        f"thread a seeded Random through instead")
+                elif root in ("np", "numpy") \
+                        and d.split(".")[1:2] == ["random"]:
+                    self._emit(
+                        node.lineno, "TAD902",
+                        f"draws numpy global randomness via '{d}' — "
+                        f"use a seeded Generator instead")
+                elif d == "os.urandom":
+                    self._emit(node.lineno, "TAD902",
+                               "draws entropy via 'os.urandom'")
+        if d in _ORDER_INSENSITIVE:
+            for arg in node.args:
+                self._bless(arg)
+        self.generic_visit(node)
+
+    # -- virtual-clock defaults -------------------------------------------
+
+    @staticmethod
+    def _none_test(
+            test: ast.AST) -> "tuple[type[ast.cmpop], ast.AST] | None":
+        """(``Is``/``IsNot``, the tested expr) for a ``<x> is [not]
+        None`` comparison, else None."""
+        if (isinstance(test, ast.Compare)
+                and len(test.ops) == 1
+                and isinstance(test.ops[0], (ast.Is, ast.IsNot))):
+            sides = [test.left, *test.comparators]
+            tested = [c for c in sides
+                      if not (isinstance(c, ast.Constant)
+                              and c.value is None)]
+            if len(tested) == 1:
+                return type(test.ops[0]), tested[0]
+        return None
+
+    @staticmethod
+    def _target_key(expr: ast.AST) -> str | None:
+        """A ctx-insensitive spelling of a name/attribute chain (the
+        tested ``now`` / ``self._now`` vs its Store-ctx twin)."""
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            inner = _FnScan._target_key(expr.value)
+            return f"{inner}.{expr.attr}" if inner else None
+        return None
+
+    def _bless_clock(self, *nodes: ast.AST) -> None:
+        for n in nodes:
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Call):
+                    self._clock_default.add(id(sub))
+
+    def _bless_default_stmts(self, tested: ast.AST,
+                             stmts: list[ast.stmt]) -> None:
+        """Bless clock calls in the not-injected branch ONLY where the
+        clock value flows back into the None-tested name: ``if now is
+        None: now = time.time()`` is the injection default, while a
+        lazy-init guard on an UNRELATED attribute (``if self._cache is
+        None: ... self._stamp = time.time()``) leaks a clock value
+        replay never injects and stays a finding."""
+        key = self._target_key(tested)
+        if key is None:
+            return
+        for stmt in stmts:
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) \
+                    and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            if value is not None and any(
+                    self._target_key(t) == key for t in targets):
+                self._bless_clock(value)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        # Only the branch taken when the value was NOT injected is the
+        # production default: ``time.time() if now is None else now``
+        # blesses the body, ``now if now is not None else time.time()``
+        # blesses the orelse.  The other branch runs precisely when the
+        # caller DID pass a value and gets no exemption.  The whole
+        # branch is the default VALUE here — wherever the expression's
+        # result flows, it only carries the clock when nothing was
+        # injected — so no assignment-target check applies.
+        nt = self._none_test(node.test)
+        if nt is not None:
+            op, _ = nt
+            self._bless_clock(node.body if op is ast.Is
+                              else node.orelse)
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        nt = self._none_test(node.test)
+        if nt is not None:
+            op, tested = nt
+            self._bless_default_stmts(
+                tested, node.body if op is ast.Is else node.orelse)
+        self.generic_visit(node)
+
+    def _bless(self, expr: ast.AST) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, (ast.GeneratorExp, ast.ListComp,
+                                ast.SetComp, ast.DictComp)):
+                self._exempt.add(id(sub))
+            elif _is_set_expr(sub, self.set_locals):
+                self._exempt.add(id(sub))
+
+    # -- id()-keyed maps --------------------------------------------------
+
+    @staticmethod
+    def _contains_id_call(expr: ast.AST) -> bool:
+        return any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name) and sub.func.id == "id"
+            for sub in ast.walk(expr))
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if self._contains_id_call(node.slice):
+            self._emit(node.lineno, "TAD903",
+                       "keys a map by 'id(...)' — ids are allocation "
+                       "order, different every run")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        for key in node.keys:
+            if key is not None and self._contains_id_call(key):
+                self._emit(key.lineno, "TAD903",
+                           "keys a dict literal by 'id(...)'")
+        self.generic_visit(node)
+
+    # -- set iteration ----------------------------------------------------
+
+    def visit_For(self, node: ast.For) -> None:
+        if _is_set_expr(node.iter, self.set_locals) \
+                and id(node.iter) not in self._exempt \
+                and not _order_free_body(node.body):
+            self._emit(node.iter.lineno, "TAD904",
+                       "iterates a set in hash order feeding an "
+                       "order-sensitive fold — wrap it in sorted() "
+                       "(XOR-only folds are exempt)")
+        self.generic_visit(node)
+
+    def _visit_comp(self, node: ast.AST,
+                    generators: list[ast.comprehension]) -> None:
+        if id(node) not in self._exempt:
+            for gen in generators:
+                if _is_set_expr(gen.iter, self.set_locals) \
+                        and id(gen.iter) not in self._exempt:
+                    self._emit(gen.iter.lineno, "TAD904",
+                               "iterates a set in hash order inside a "
+                               "comprehension — wrap it in sorted()")
+        self.generic_visit(node)  # type: ignore[arg-type]
+
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        self._visit_comp(node, node.generators)
+
+    def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
+        self._visit_comp(node, node.generators)
+
+    def visit_DictComp(self, node: ast.DictComp) -> None:
+        self._visit_comp(node, node.generators)
+
+    def visit_SetComp(self, node: ast.SetComp) -> None:
+        # Building a SET from a set is order-free by construction.
+        self.generic_visit(node)
+
+
+class DeterminismChecker(ProgramChecker):
+    name = "determinism"
+    codes = {
+        "TAD901": "wall-clock read under the replay contract",
+        "TAD902": "unseeded randomness under the replay contract",
+        "TAD903": "id()-keyed map under the replay contract",
+        "TAD904": "unsorted set iteration feeding an order-sensitive "
+                  "fold",
+    }
+
+    def applies_to(self, rel_path: str) -> bool:
+        return "tpu_autoscaler/testing/" not in rel_path
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
+        graph = shared_graph(files)
+
+        # Roots: contract-module functions + digest builders, each
+        # carrying its contract tag through the closure.
+        tags: dict[str, str] = {}
+        worklist: list[str] = []
+        for fn in graph.funcs.values():
+            tag = CONTRACT_MODULES.get(fn.rel_path)
+            if tag is None and "digest" in fn.node.name.lower():
+                tag = "digest"
+            if tag is not None and fn.qname not in tags:
+                tags[fn.qname] = tag
+                worklist.append(fn.qname)
+        while worklist:
+            q = worklist.pop()
+            for callee in graph.edges.get(q, ()):
+                if callee not in tags and callee in graph.funcs:
+                    tags[callee] = tags[q]
+                    worklist.append(callee)
+
+        findings: list[Finding] = []
+        for qname, tag in sorted(tags.items()):
+            fn = graph.funcs[qname]
+            scan = _FnScan(fn, tag, graph)
+            scan.visit(fn.node)
+            findings.extend(scan.findings)
+        findings.sort(key=lambda f: (f.file, f.line, f.code))
+        return findings
+

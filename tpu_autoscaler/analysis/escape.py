@@ -46,12 +46,11 @@ import ast
 import dataclasses
 
 from tpu_autoscaler.analysis.callgraph import (
-    LOCK_TYPES,
-    MAIN_ROOT,
     ClassInfo,
     FuncInfo,
     PackageGraph,
-    _module_name,
+    lock_id,
+    shared_graph,
 )
 from tpu_autoscaler.analysis.core import (
     Finding,
@@ -106,32 +105,13 @@ class EscapeRaceChecker(ProgramChecker):
 
     # -- access extraction ------------------------------------------------
 
-    def _lock_id(self, expr: ast.AST, fn: FuncInfo,
-                 locals_: dict[str, str], graph: PackageGraph) -> str | None:
-        """Stable identity for the lock object in ``with <expr>:``."""
-        t = graph.expr_type(expr, fn, locals_)
-        if t not in LOCK_TYPES:
-            return None
-        if isinstance(expr, ast.Attribute):
-            base_t = graph.expr_type(expr.value, fn, locals_)
-            if base_t is not None:
-                return f"{base_t}.{expr.attr}"
-            return f"{fn.qname}?.{expr.attr}"
-        if isinstance(expr, ast.Name):
-            mod = _module_name(fn.rel_path)
-            if expr.id in graph.modules[mod].global_types:
-                return f"{mod}.{expr.id}"
-            return f"{fn.qname}:{expr.id}"     # local lock variable
-        return None
-
     def _guard_ranges(self, fn: FuncInfo, locals_: dict[str, str],
                       graph: PackageGraph) -> list[tuple[int, int, str]]:
         out: list[tuple[int, int, str]] = []
         for node in _walk_scoped(fn.node):
             if isinstance(node, ast.With):
                 for item in node.items:
-                    lid = self._lock_id(item.context_expr, fn, locals_,
-                                        graph)
+                    lid = lock_id(item.context_expr, fn, locals_, graph)
                     if lid is not None:
                         out.append((node.lineno,
                                     node.end_lineno or node.lineno, lid))
@@ -184,7 +164,7 @@ class EscapeRaceChecker(ProgramChecker):
     # -- conflict detection -----------------------------------------------
 
     def check_program(self, files: list[SourceFile]) -> list[Finding]:
-        graph = PackageGraph(files)
+        graph = shared_graph(files)
         by_attr: dict[tuple[str, str], list[_Access]] = {}
         for fn in graph.funcs.values():
             for acc in self._accesses_in(fn, graph):

@@ -1,0 +1,595 @@
+"""Whole-program lock-order analysis (TAL7xx).
+
+The escape pass (TAR5xx) proves accesses are *guarded*; nothing proved
+the guards themselves compose.  Two locks taken in opposite orders on
+two threads deadlock with every access correctly guarded — invisible to
+a lockset model, fatal in production.  This pass builds the global
+lock-ACQUISITION-ORDER graph and checks it:
+
+1. **Held-set propagation.**  For every function the pass computes the
+   set of locks that may be held on entry: lexical ``with <lock>:``
+   blocks enclosing each call site, propagated transitively over the
+   package call graph (``callgraph.PackageGraph`` — the same resolved
+   edges, Thread-``run()`` roots, pool-submit thunks and property edges
+   the race pass trusts).  Thread roots and pool thunks start with an
+   EMPTY held set: locks do not follow a ``submit()`` across threads —
+   and for the same reason a nested def/lambda body is its own scope:
+   it runs when CALLED, inheriting neither the definition site's
+   ``with`` blocks nor the enclosing function's entry set.
+2. **Order edges.**  Acquiring lock B at a point where lock A may be
+   held adds the directed edge A → B, tagged with the acquisition site.
+3. **Findings.**
+
+   - TAL701 — a cycle in the order graph: two call chains acquire the
+     same locks in opposite orders; under the wrong interleaving each
+     thread holds what the other wants (potential deadlock);
+   - TAL702 — ``Condition.wait()`` while holding a second lock: the
+     wait releases only the condition's own lock, so the notifier can
+     block forever on the one still held;
+   - TAL703 — acquiring a NON-reentrant ``Lock`` that may already be
+     held on the same call chain: self-deadlock (``RLock``/
+     ``Condition`` re-entry is what those types are for and is not
+     flagged).
+
+Lock identity is ``callgraph.lock_id`` — the same naming the TAR5xx
+locksets use — and every node carries its construction site
+(``ClassInfo.attr_sites`` / ``ModuleInfo.global_sites``), which is the
+join key for the runtime lock-order witness
+(``tpu_autoscaler/concurrency.LockOrderWitness``): a witnessed edge
+whose sites resolve to package locks but which is absent from this
+graph is a checker gap and fails the race tier
+(``tests/test_lockwitness.py``).
+
+Precision notes, deliberately asymmetric like the race pass: an
+unresolvable callee produces no edge, so a reported cycle rests
+entirely on resolved evidence; held sets union over ALL call sites
+(context-insensitive), so a lock can appear held at a callee one
+caller never reaches — that over-approximation can only ADD edges,
+never hide one, which is the right bias for a deadlock detector.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tpu_autoscaler.analysis.callgraph import (
+    SYNC_CONDITION,
+    SYNC_LOCK,
+    FuncInfo,
+    PackageGraph,
+    _is_property,
+    _module_name,
+    _short as _short_fn,
+    lock_id,
+    shared_graph,
+)
+from tpu_autoscaler.analysis.core import (
+    Finding,
+    ProgramChecker,
+    SourceFile,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Acquire:
+    """One static lock acquisition: ``with <lock>:`` in ``fn``."""
+
+    lid: str
+    fn_qname: str
+    rel_path: str
+    line: int
+    #: Locks held LEXICALLY at this with-statement (enclosing blocks
+    #: of the SAME scope — a nested def's body is a separate scope).
+    lexical: frozenset[str]
+    #: True when the site lives inside a nested def/lambda: the body
+    #: runs when CALLED (often on another thread via ``submit()``), so
+    #: neither the enclosing with-blocks nor the function's propagated
+    #: entry set is held there.
+    deferred: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class _Wait:
+    """One ``<condition>.wait()`` call site."""
+
+    lid: str                # the condition's own lock id
+    fn_qname: str
+    rel_path: str
+    line: int
+    lexical: frozenset[str]
+    deferred: bool = False
+
+
+def _split_scope(root: ast.AST) -> tuple[list[ast.AST], list[ast.AST]]:
+    """Partition ``root``'s subtree into nodes of its OWN lexical scope
+    and the nested def/lambda nodes whose bodies are separate (deferred)
+    scopes — code inside them executes when called, not where defined,
+    so definition-site lock context does not apply."""
+    own: list[ast.AST] = []
+    nested: list[ast.AST] = []
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            nested.append(node)
+            continue
+        own.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return own, nested
+
+
+class LockOrderGraph:
+    """The package's lock world: nodes, order edges, construction
+    sites.  Built once per analyzed file set; consumed by the TAL7xx
+    checker and by the runtime-witness cross-check."""
+
+    def __init__(self, graph: PackageGraph) -> None:
+        self.pkg = graph
+        #: lock id -> synthetic type (@sync:Lock / RLock / Condition).
+        self.lock_types: dict[str, str] = {}
+        #: lock id -> (rel_path, line) of its constructing assignment.
+        self.creation_sites: dict[str, tuple[str, int]] = {}
+        #: (held, acquired) -> example acquisition (rel_path, line, fn).
+        self.edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        #: fn qname -> locks possibly held on entry (propagated).
+        self.entry_held: dict[str, set[str]] = {}
+        self._acquires: dict[str, list[_Acquire]] = {}
+        self._waits: dict[str, list[_Wait]] = {}
+        self._calls: dict[
+            str, list[tuple[int, str, frozenset[str], bool]]] = {}
+        #: fn qname -> with-lock ranges (lo, hi, lid, scope) — scope is
+        #: None for the function body, the nested def's (lo, hi) span
+        #: for deferred scopes; a range only holds at lines of its own
+        #: scope.
+        self._ranges: dict[
+            str,
+            list[tuple[int, int, str, tuple[int, int] | None]]] = {}
+        #: fn qname -> nested def/lambda line spans (deferred scopes).
+        self._deferred_spans: dict[str, list[tuple[int, int]]] = {}
+        self._index()
+        self._propagate()
+        self._build_edges()
+
+    # -- per-function extraction ------------------------------------------
+
+    def _index(self) -> None:
+        for fn in self.pkg.funcs.values():
+            locals_ = self.pkg.local_types(fn)
+            ranges: list[tuple[int, int, str,
+                               tuple[int, int] | None]] = []
+            acquires: list[_Acquire] = []
+            calls: list[tuple[int, str, frozenset[str], bool]] = []
+            waits: list[_Wait] = []
+            spans: list[tuple[int, int]] = []
+
+            # One pass per lexical scope: the function body first, then
+            # every nested def/lambda as its own DEFERRED scope with an
+            # empty starting lock context (the body runs when called —
+            # a closure handed to ``submit()`` does not hold the
+            # definition site's locks).
+            pending: list[tuple[ast.AST, tuple[int, int] | None]] = [
+                (fn.node, None)]
+            while pending:
+                scope_root, scope = pending.pop()
+                own, nested = _split_scope(scope_root)
+                for n in nested:
+                    span = (n.lineno, n.end_lineno or n.lineno)
+                    spans.append(span)
+                    pending.append((n, span))
+                deferred = scope is not None
+
+                scope_ranges: list[tuple[int, int, str]] = []
+                withs = sorted(
+                    (n for n in own if isinstance(n, ast.With)),
+                    key=lambda n: (n.lineno,
+                                   -(n.end_lineno or n.lineno)))
+                for node in withs:
+                    for item in node.items:
+                        lid = lock_id(item.context_expr, fn, locals_,
+                                      self.pkg)
+                        if lid is None:
+                            continue
+                        enclosing = frozenset(
+                            r[2] for r in scope_ranges
+                            if r[0] <= node.lineno <= r[1])
+                        scope_ranges.append(
+                            (node.lineno,
+                             node.end_lineno or node.lineno, lid))
+                        acquires.append(_Acquire(
+                            lid, fn.qname, fn.rel_path, node.lineno,
+                            enclosing, deferred))
+                        t = self.pkg.expr_type(item.context_expr, fn,
+                                               locals_)
+                        if t is not None:
+                            self.lock_types.setdefault(lid, t)
+                            self._note_site(lid, item.context_expr, fn,
+                                            locals_)
+                ranges.extend((lo, hi, lid, scope)
+                              for lo, hi, lid in scope_ranges)
+
+                def lexical_at(line: int) -> frozenset[str]:
+                    return frozenset(r[2] for r in scope_ranges
+                                     if r[0] <= line <= r[1])
+
+                for node in own:
+                    if isinstance(node, ast.Call):
+                        target = self.pkg.resolve_callable(node.func, fn,
+                                                           locals_)
+                        if target is not None:
+                            calls.append((node.lineno, target.qname,
+                                          lexical_at(node.lineno),
+                                          deferred))
+                        if isinstance(node.func, ast.Attribute) \
+                                and node.func.attr == "wait":
+                            t = self.pkg.expr_type(node.func.value, fn,
+                                                   locals_)
+                            if t == SYNC_CONDITION:
+                                lid = lock_id(node.func.value, fn,
+                                              locals_, self.pkg)
+                                if lid is not None:
+                                    waits.append(_Wait(
+                                        lid, fn.qname, fn.rel_path,
+                                        node.lineno,
+                                        lexical_at(node.lineno),
+                                        deferred))
+                    elif isinstance(node, ast.Attribute) \
+                            and isinstance(node.ctx, ast.Load):
+                        base_t = self.pkg.expr_type(node.value, fn,
+                                                    locals_)
+                        ci = self.pkg.classes.get(base_t) \
+                            if base_t else None
+                        if ci is not None:
+                            m = self.pkg._method(ci, node.attr)
+                            if m is not None and _is_property(m.node):
+                                calls.append((node.lineno, m.qname,
+                                              lexical_at(node.lineno),
+                                              deferred))
+            self._acquires[fn.qname] = acquires
+            self._waits[fn.qname] = waits
+            self._calls[fn.qname] = calls
+            self._ranges[fn.qname] = ranges
+            self._deferred_spans[fn.qname] = spans
+
+    def _note_site(self, lid: str, expr: ast.AST, fn: FuncInfo,
+                   locals_: dict[str, str]) -> None:
+        if lid in self.creation_sites:
+            return
+        if isinstance(expr, ast.Attribute):
+            base_t = self.pkg.expr_type(expr.value, fn, locals_)
+            ci = self.pkg.classes.get(base_t) if base_t else None
+            # Breadth-first over ALL package bases (left-to-right MRO
+            # preference — a lock created in a SECOND base must still
+            # get its site or the witness join fails open), with a
+            # visited set: statically cyclic inheritance is parseable
+            # work-in-progress source the linter must survive.
+            queue = [ci] if ci is not None else []
+            seen: set[int] = set()
+            while queue:
+                ci = queue.pop(0)
+                if id(ci) in seen:
+                    continue
+                seen.add(id(ci))
+                site = ci.attr_sites.get(expr.attr)
+                if site is not None:
+                    self.creation_sites[lid] = site
+                    return
+                queue.extend(self.pkg._package_bases(ci))
+        elif isinstance(expr, ast.Name):
+            mod = self.pkg.modules.get(_module_name(fn.rel_path))
+            if mod is not None and expr.id in mod.global_sites:
+                self.creation_sites[lid] = (
+                    mod.src.rel_path, mod.global_sites[expr.id])
+
+    # -- interprocedural held-set propagation -----------------------------
+
+    def _propagate(self) -> None:
+        self.entry_held = {q: set() for q in self.pkg.funcs}
+        worklist = list(self.pkg.funcs)
+        in_list = set(worklist)
+        while worklist:
+            caller = worklist.pop()
+            in_list.discard(caller)
+            base = self.entry_held[caller]
+            for line, callee, lexical, deferred in self._calls.get(
+                    caller, ()):
+                if callee not in self.entry_held:
+                    continue
+                # A call inside a nested def runs when the closure is
+                # called (possibly on another thread): the enclosing
+                # function's entry set is not held there.
+                ctx = lexical if deferred else base | lexical
+                tgt = self.entry_held[callee]
+                if not ctx <= tgt:
+                    tgt |= ctx
+                    if callee not in in_list:
+                        in_list.add(callee)
+                        worklist.append(callee)
+
+    # -- order edges ------------------------------------------------------
+
+    def _build_edges(self) -> None:
+        for qname, acquires in self._acquires.items():
+            entry = self.entry_held.get(qname, set())
+            for acq in acquires:
+                held = acq.lexical if acq.deferred \
+                    else entry | acq.lexical
+                own = self.own_locks(acq.lid)
+                for h in sorted(held):
+                    if h in own or acq.lid in self.own_locks(h):
+                        # Re-entry is TAL703's job; a Condition and the
+                        # lock it wraps are ONE lock, not an ordering.
+                        continue
+                    self.edges.setdefault(
+                        (h, acq.lid),
+                        (acq.rel_path, acq.line, acq.fn_qname))
+
+    def own_locks(self, lid: str) -> frozenset[str]:
+        """``lid`` plus, for a Condition constructed over an explicit
+        lock (``self._cond = Condition(self._lock)``), the wrapped
+        lock's id: waiting on the condition releases THAT lock, so the
+        two ids name one mutex for hold/order purposes."""
+        head, _, attr = lid.rpartition(".")
+        ci = self.pkg.classes.get(head)
+        if ci is not None:
+            target = ci.cond_aliases.get(attr)
+            if target is not None:
+                return frozenset((lid, f"{head}.{target}"))
+        return frozenset((lid,))
+
+    def _scope_of(self, fn_qname: str,
+                  line: int) -> tuple[int, int] | None:
+        """The innermost deferred (nested-def) span containing ``line``,
+        or None for the function's own body."""
+        best: tuple[int, int] | None = None
+        for lo, hi in self._deferred_spans.get(fn_qname, ()):
+            if lo <= line <= hi and (best is None or lo >= best[0]):
+                best = (lo, hi)
+        return best
+
+    def in_deferred_scope(self, fn_qname: str, line: int) -> bool:
+        """True when ``line`` sits inside a nested def/lambda of
+        ``fn_qname`` — code that runs when the closure is called, not
+        on the enclosing function's thread."""
+        return self._scope_of(fn_qname, line) is not None
+
+    def held_at(self, acq: "_Acquire | _Wait") -> frozenset[str]:
+        if acq.deferred:
+            return acq.lexical
+        return frozenset(self.entry_held.get(acq.fn_qname, set())
+                         | acq.lexical)
+
+    def held_at_line(self, fn_qname: str, line: int) -> frozenset[str]:
+        """Locks possibly held at an arbitrary line of ``fn_qname``:
+        the propagated entry set plus lexically-enclosing with-blocks
+        of the SAME scope (the TAB8xx blocking lint's query — a with
+        spanning a nested def does not hold inside the def's body, and
+        a deferred scope never inherits the entry set)."""
+        scope = self._scope_of(fn_qname, line)
+        lexical = frozenset(
+            lid for lo, hi, lid, sc in self._ranges.get(fn_qname, ())
+            if sc == scope and lo <= line <= hi)
+        if scope is not None:
+            return lexical
+        return frozenset(self.entry_held.get(fn_qname, set())) | lexical
+
+    def all_acquires(self) -> list[_Acquire]:
+        return [a for accs in self._acquires.values() for a in accs]
+
+    def all_waits(self) -> list[_Wait]:
+        return [w for ws in self._waits.values() for w in ws]
+
+    def cycles(self) -> list[list[str]]:
+        """Elementary cycles via SCC decomposition: every SCC with more
+        than one node yields one canonical cycle (smallest node first,
+        following edges greedily) — enough to NAME the inversion without
+        enumerating the combinatorial set."""
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+        adj: dict[str, list[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        for outs in adj.values():
+            outs.sort()
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(adj.get(v, ())))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(adj.get(w, ()))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+
+        cycles: list[list[str]] = []
+        for scc in sccs:
+            members = set(scc)
+            start = min(scc)
+            # DFS (not a greedy walk — a branching SCC can dead-end a
+            # greedy path and silently drop the cycle) for a simple
+            # path start -> ... -> start inside the SCC; one always
+            # exists because the SCC is strongly connected.
+            path = [start]
+            on_path = {start}
+            iters = [iter(adj.get(start, ()))]
+            found = False
+            while iters and not found:
+                advanced = False
+                for w in iters[-1]:
+                    if w == start:
+                        found = True
+                        break
+                    if w in members and w not in on_path:
+                        path.append(w)
+                        on_path.add(w)
+                        iters.append(iter(adj.get(w, ())))
+                        advanced = True
+                        break
+                if not advanced and not found:
+                    on_path.discard(path.pop())
+                    iters.pop()
+            if found:
+                cycles.append(path)
+        return sorted(cycles)
+
+
+def lock_order_graph(graph: PackageGraph) -> LockOrderGraph:
+    """One LockOrderGraph per PackageGraph: TAL7xx and TAB8xx both
+    consume it inside one run_analysis call.  Memoized on the graph
+    itself — a 1:1 overlay needs no global cache, eviction policy, or
+    identity guard of its own."""
+    lg = graph.lock_order
+    if not isinstance(lg, LockOrderGraph):
+        lg = LockOrderGraph(graph)
+        graph.lock_order = lg
+    return lg
+
+
+
+def _short_lock(lid: str) -> str:
+    """'ObjectCache._lock' for 'tpu_autoscaler.k8s.informer.ObjectCache._lock'."""
+    head, _, attr = lid.rpartition(".")
+    leaf = head.split(".")[-1] if head else ""
+    return f"{leaf}.{attr}" if leaf else lid
+
+
+def witness_gaps(
+    witnessed: "dict[tuple[tuple[str, int], tuple[str, int]], tuple[str, int]]",
+    lg: LockOrderGraph,
+) -> list[str]:
+    """Cross-check runtime-witnessed lock-order edges against the
+    static graph (the race tier's checker-gap gate, docs/ANALYSIS.md).
+
+    ``witnessed`` is ``concurrency.LockOrderWitness.edges``: (held
+    creation site, acquired creation site) -> acquisition file:line.
+    Creation sites are joined to static lock ids through
+    ``LockOrderGraph.creation_sites``; an edge BETWEEN TWO PACKAGE
+    LOCKS that the static graph lacks means the static pass failed to
+    resolve a call chain that nests acquisitions — a blind spot that
+    would also hide a real inversion, so the race tier fails on it.
+    Edges touching locks the static graph never indexed (test-fixture
+    locals, harness plumbing) prove nothing about the checker and are
+    ignored."""
+    # A creation site can carry SEVERAL lids (an inherited lock attr is
+    # noted under both Base._a and Sub._a): the join must try every
+    # combination — keeping one arbitrary lid per site both invents
+    # gaps (the witnessed nesting is modeled under the other lid) and
+    # can mask real ones.  The site IS the runtime identity; any modeled
+    # lid pair on it means the static pass saw the nesting.
+    site_to_lids: dict[tuple[str, int], list[str]] = {}
+    for lid, site in lg.creation_sites.items():
+        site_to_lids.setdefault(site, []).append(lid)
+    gaps: list[str] = []
+    for (held_site, acq_site), at in sorted(witnessed.items()):
+        held_lids = site_to_lids.get(held_site)
+        acq_lids = site_to_lids.get(acq_site)
+        if not held_lids or not acq_lids:
+            continue
+        if not any((h, a) in lg.edges
+                   for h in held_lids for a in acq_lids):
+            held_lid = min(held_lids)
+            acq_lid = min(acq_lids)
+            gaps.append(
+                f"witnessed lock-order edge {_short_lock(held_lid)} -> "
+                f"{_short_lock(acq_lid)} (acquired at {at[0]}:{at[1]}) "
+                f"is ABSENT from the static TAL7xx graph — the static "
+                f"pass has a blind spot")
+    return gaps
+
+
+class LockOrderChecker(ProgramChecker):
+    name = "lock-order"
+    codes = {
+        "TAL701": "lock-order cycle (potential deadlock)",
+        "TAL702": "Condition.wait while holding a second lock",
+        "TAL703": "re-entrant acquisition of a non-reentrant Lock",
+    }
+
+    def applies_to(self, rel_path: str) -> bool:
+        # Same carve-out as TAR5xx: the deterministic scheduler's mutual
+        # exclusion is by construction (semaphore handoff), not locks.
+        return "tpu_autoscaler/testing/" not in rel_path
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
+        lg = lock_order_graph(shared_graph(files))
+        findings: list[Finding] = []
+
+        for cycle in lg.cycles():
+            ring = cycle + [cycle[0]]
+            hops = []
+            site = None
+            for a, b in zip(ring, ring[1:]):
+                edge = lg.edges.get((a, b))
+                if edge is not None and site is None:
+                    site = edge
+                hops.append(f"{_short_lock(a)} -> {_short_lock(b)}"
+                            + (f" (at {_short_fn(edge[2])})"
+                               if edge is not None else ""))
+            rel, line = (site[0], site[1]) if site is not None \
+                else ("<unknown>", 0)
+            findings.append(Finding(
+                rel, line, "TAL701",
+                "lock-order cycle (potential deadlock): "
+                + "; ".join(hops)))
+
+        for w in lg.all_waits():
+            others = lg.held_at(w) - lg.own_locks(w.lid)
+            if others:
+                held = ", ".join(sorted(_short_lock(o) for o in others))
+                findings.append(Finding(
+                    w.rel_path, w.line, "TAL702",
+                    f"{_short_fn(w.fn_qname)} waits on "
+                    f"'{_short_lock(w.lid)}' while holding [{held}] — "
+                    f"the wait releases only the condition's own lock, "
+                    f"so the notifier can block forever on the one "
+                    f"still held"))
+
+        for acq in lg.all_acquires():
+            if lg.lock_types.get(acq.lid) != SYNC_LOCK:
+                continue                        # RLock/Condition re-enter
+            if acq.lid in lg.held_at(acq):
+                findings.append(Finding(
+                    acq.rel_path, acq.line, "TAL703",
+                    f"{_short_fn(acq.fn_qname)} acquires non-reentrant "
+                    f"'{_short_lock(acq.lid)}' which may already be "
+                    f"held on this call chain (self-deadlock)"))
+
+        findings.sort(key=lambda f: (f.file, f.line, f.code))
+        return findings

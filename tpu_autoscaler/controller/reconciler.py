@@ -630,7 +630,9 @@ class Controller:
                     and p.tpu_chips:
                 ns_usage[p.namespace] = (ns_usage.get(p.namespace, 0)
                                          + p.tpu_chips)
-        for ns in self._seen_namespaces - set(ns_usage):
+        # sorted(): gauge creation order feeds snapshot()/TSDB series
+        # order, which bundle digests serialize (TAD904).
+        for ns in sorted(self._seen_namespaces - set(ns_usage)):
             self.metrics.set_gauge(f"namespace_chips_used_{ns}", 0)
         for ns, used in ns_usage.items():
             self.metrics.set_gauge(f"namespace_chips_used_{ns}", used)

@@ -45,7 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Any, Iterable, Mapping, Sequence
+from typing import Any, Iterable, Mapping, Sequence, TypeVar
 
 from tpu_autoscaler.cost.pricebook import PriceBook, tier_of_labels
 from tpu_autoscaler.topology.catalog import (
@@ -66,6 +66,9 @@ SERVING_NAMESPACES = frozenset({"tpu-serving"})
 #: Terminal per-gang rollups are retained this long for reports, then
 #: folded into the state totals only (bounded state).
 GANG_RETENTION_SECONDS = 3600.0
+
+#: Accumulator-table key: str pools, (pool, shape) pairs, state combos.
+_K = TypeVar("_K")
 
 
 class _Acc:
@@ -155,8 +158,8 @@ class CostLedger:
         # (gang key, epoch) so a Job completing and restarting under
         # the same (ns,name) never double-counts its final partial
         # pass — a disjoint member-uid set is a new incarnation.
-        self._gang_epoch: dict[tuple,
-                               tuple[int, frozenset, float]] = {}
+        self._gang_epoch: dict[tuple[str, str, str],
+                               tuple[int, frozenset[str], float]] = {}
         # Fragmentation inputs (cost/frag.py), maintained incrementally.
         self._idle_spot_chips: dict[str, int] = {}          # shape -> chips
         self._res_busy_chips: dict[tuple[str, str], int] = {}  # (pool,shape)
@@ -186,8 +189,8 @@ class CostLedger:
 
     # -- classification inputs -------------------------------------------
 
-    def _gang_rollup_id(self, key: tuple, uids: frozenset,
-                        now: float) -> str:
+    def _gang_rollup_id(self, key: tuple[str, str, str],
+                        uids: frozenset[str], now: float) -> str:
         """Epoch-keyed rollup id for one gang incarnation.  A member
         set DISJOINT from the last seen one is a new incarnation (the
         restart-under-the-same-name case); overlapping sets merge —
@@ -252,7 +255,7 @@ class CostLedger:
         gang_id = None
         used = 0
         if workload:
-            by_gang: dict[tuple, list] = {}
+            by_gang: dict[tuple[str, str, str], list[Any]] = {}
             for p in workload:
                 used += p.tpu_chips
                 if p.gang_key is not None:
@@ -335,7 +338,7 @@ class CostLedger:
                 + sign * (unit.chips - unit.used_chips))
 
     @staticmethod
-    def _acc(table: dict, key, now: float) -> _Acc:
+    def _acc(table: dict[_K, _Acc], key: _K, now: float) -> _Acc:
         acc = table.get(key)
         if acc is None:
             acc = table[key] = _Acc(now)
@@ -465,7 +468,7 @@ class CostLedger:
                 0.0, now - unit.entered_at)
         return total if hit else None
 
-    def gang_attrs(self, gang_key: tuple, now: float
+    def gang_attrs(self, gang_key: tuple[str, str, str], now: float
                    ) -> dict[str, float] | None:
         """Cost-to-serve attrs for a closing trace: the gang's CURRENT
         incarnation's attributed chip-seconds (None: never attributed

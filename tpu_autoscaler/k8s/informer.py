@@ -704,7 +704,7 @@ class ResourceWatch(concurrency.Thread):
         # (rare, and exactly what a slow-detection investigation needs);
         # per-event deltas are NOT traced — the hot path stays hot.
         self._tracer = tracer
-        self._rng = rng or random.Random()
+        self._rng = rng or random.Random()  # analysis: allow=TAD902 watch-backoff jitter is production-only entropy BY DESIGN: replay drives recorded events (never the live watch loop) and the harness injects a seeded rng; jitter never reaches replayed bytes
         self._failure_streak = 0
         self._last_relist_mono: float | None = None
 
@@ -743,7 +743,7 @@ class ResourceWatch(concurrency.Thread):
                                     info["pods"] + info["nodes"])
             self._metrics.set_gauge("parse_cache_hit_rate",
                                     info["hit_rate"])
-        self._last_relist_mono = time.monotonic()  # analysis: allow=TAR503 pump() is the threadless drive mode and is never mixed with start() (see pump docstring)
+        self._last_relist_mono = time.monotonic()  # analysis: allow=TAR503,TAD901 pump() is the threadless drive mode and is never mixed with start() (see pump docstring); the resync clock paces the LIVE watch thread only — replay feeds recorded events and never reaches the relist timer
         if self._wake is not None:
             # The world may have changed arbitrarily across the gap.
             self._wake.set()
@@ -761,7 +761,7 @@ class ResourceWatch(concurrency.Thread):
 
     def _run_once(self) -> None:
         due = (self._last_relist_mono is None
-               or time.monotonic() - self._last_relist_mono
+               or time.monotonic() - self._last_relist_mono  # analysis: allow=TAD901 resync pacing of the live watch thread BY DESIGN: replay never drives _run_once — it feeds recorded events through apply/replace
                >= self._resync_seconds)
         if not self._cache.synced or due:
             self._relist()

@@ -140,9 +140,13 @@ class Pod:
             init = ResourceVector.from_raw(
                 (c.get("resources") or {}).get("requests")
             )
+            # sorted(): the union iterates in hash order, and dict
+            # insertion order survives into every serialization of the
+            # vector — under a different PYTHONHASHSEED the offline
+            # bundle replay would see different bytes (TAD904).
             bumped = {
                 k: max(total.get(k), init.get(k))
-                for k in set(total.as_dict()) | set(init.as_dict())
+                for k in sorted(set(total.as_dict()) | set(init.as_dict()))
             }
             total = ResourceVector(bumped)
         return total

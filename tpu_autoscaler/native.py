@@ -51,7 +51,7 @@ def load_native_lib(so_name: str, *, configure,
         # older than its source would otherwise be loaded stale and
         # silently lack newly-added entry points.
         try:
-            subprocess.run(
+            subprocess.run(  # analysis: allow=TAB801 single-flight build-on-first-use BY DESIGN: concurrent callers must wait for one bounded (timeout=120) make, not race it; after the first call the cache makes the lock hold O(ns)
                 ["make", "-C", _NATIVE_DIR, f"build/{so_name}"],
                 check=True, capture_output=True, timeout=120)
         except Exception:  # noqa: BLE001 — no compiler: stay Python

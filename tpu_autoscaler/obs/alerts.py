@@ -178,7 +178,7 @@ class Transition:
     summary: str
     #: Latest (t, value, trace_id) exemplar of the rule's
     #: ``exemplar_family`` at fire time, when the TSDB has one.
-    exemplar: tuple | None = None
+    exemplar: tuple[float, float, str] | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -312,8 +312,8 @@ class AlertEngine:
                                evaluated=len(self.rules))
 
     @staticmethod
-    def _summary(rule: AlertRule, value: float | None,
-                 firing: bool, exemplar: tuple | None = None) -> str:
+    def _summary(rule: AlertRule, value: float | None, firing: bool,
+                 exemplar: tuple[float, float, str] | None = None) -> str:
         what = "FIRING" if firing else "resolved"
         shown = "n/a" if value is None else f"{value:.4g}"
         if rule.kind == "burn_rate":

@@ -119,7 +119,7 @@ class Tracer:
         # Distinguishes traces across controller restarts in aggregated
         # log stores (trace ids repeat their counter after a crash-only
         # restart; the run id keeps them globally unique).
-        self._run_id = uuid.uuid4().hex[:6]
+        self._run_id = uuid.uuid4().hex[:6]  # analysis: allow=TAD902 the run id exists to be unique ACROSS restarts BY DESIGN (see comment above); replay oracles compare span structure and attribution, never trace-id bytes
 
     # -- wiring -----------------------------------------------------------
 

@@ -49,7 +49,7 @@ from __future__ import annotations
 
 import collections
 import math
-from typing import Any, Iterable
+from typing import Any, Callable, Iterable, TypeVar
 
 import numpy as np
 
@@ -78,6 +78,9 @@ MAX_EXEMPLAR_FAMILIES = 256
 
 #: Aggregate row columns for the downsampled tiers.
 _T, _LAST, _MIN, _MAX, _SUM, _N = range(6)
+
+#: Result type of a seqlock-guarded read thunk (``TimeSeriesDB._guarded``).
+_R = TypeVar("_R")
 
 
 class _Ring:
@@ -369,7 +372,7 @@ class TimeSeriesDB:
 
     # -- guarded read path --------------------------------------------
 
-    def _guarded(self, fn, retries: int = 16):
+    def _guarded(self, fn: Callable[[], _R], retries: int = 16) -> _R:
         """Copy-then-recheck under the seqlock; bounded retry.  Failed
         attempts SLEEP briefly before retrying: a no-yield loop would
         burn every retry in microseconds inside one multi-ms ingest
@@ -383,7 +386,7 @@ class TimeSeriesDB:
 
         for attempt in range(retries):
             if attempt:
-                _time.sleep(0.002)
+                _time.sleep(0.002)  # analysis: allow=TAB803 bounded reader backoff BY DESIGN (docstring above): a retry only happens when the writer is mid-mutation, and yielding 2 ms beats spinning the whole retry budget inside one multi-ms ingest; the reconcile thread never reaches this branch
             s0 = self._wseq
             if s0 % 2:
                 continue  # writer mid-mutation
@@ -597,7 +600,7 @@ class TimeSeriesDB:
                 out[name] = tiers
             return out
 
-        def read_exemplars() -> dict[str, list]:
+        def read_exemplars() -> dict[str, list[list[Any]]]:
             return {fam: [[float(t), float(v), tid]
                           for t, v, tid in ring if t >= start]
                     for fam, ring in sorted(self._exemplars.items())
