@@ -72,6 +72,12 @@
 #      under the DeterministicScheduler must be modeled by the static
 #      TAL7xx graph — a witnessed-but-unmodeled edge is a checker
 #      blind spot and fails the stage.
+#   16 units-of-measure layer (ISSUE 16, docs/ANALYSIS.md): the
+#      TAU10xx dimension checker over the cost algebra re-run
+#      --no-baseline and alone — mixed-dimension arithmetic,
+#      unblessed chip*second / $-per-chip-hour crossings, unsuffixed
+#      dimensioned metrics and cross-currency budget compares can
+#      NEVER grow baseline entries.
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -81,10 +87,10 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/14] invariant analysis (--format=$fmt)"
+echo "== [1/15] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/14] deadlock & determinism layer (TAL/TAB/TAD --no-baseline + witness cross-check)"
+echo "== [2/15] deadlock & determinism layer (TAL/TAB/TAD --no-baseline + witness cross-check)"
 # Zero-baseline-growth enforcement for the ISSUE 15 code families:
 # stage 1 honors baseline.toml, this stage deliberately does not.
 python -m tpu_autoscaler.analysis --format="$fmt" --no-baseline \
@@ -92,11 +98,19 @@ python -m tpu_autoscaler.analysis --format="$fmt" --no-baseline \
 JAX_PLATFORMS=cpu python -m pytest -q tests/test_lockwitness.py \
     -p no:cacheprovider || exit 15
 
-echo "== [3/14] mypy strict islands"
+echo "== [3/15] units-of-measure layer (TAU10xx --no-baseline)"
+# Zero-baseline-growth for the cost-algebra dimension checker, same
+# contract as the stage above: stage 1 honors baseline.toml, this
+# stage deliberately does not — a fresh TAU finding fails CI even if
+# someone grandfathers it past stage 1.
+python -m tpu_autoscaler.analysis --format="$fmt" --units --no-baseline \
+    tpu_autoscaler/ || exit 16
+
+echo "== [4/15] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [4/14] deterministic-schedule race tier"
+echo "== [5/15] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh.  Its static
 # layer and witness cross-check already ran above (stage 1 runs every
 # program pass over the whole package; stage 2 runs
@@ -104,14 +118,14 @@ echo "== [4/14] deterministic-schedule race tier"
 # to pay for the whole-program analysis a third time.
 RACE_STATIC_COVERED=1 ./scripts/race.sh || exit 4
 
-echo "== [5/14] tracer-overhead gate"
+echo "== [6/15] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [6/14] mega-cluster scale tiers"
+echo "== [7/15] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [7/14] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
+echo "== [8/15] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -151,13 +165,13 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repair --reconcile-shards 4 \
     || exit 7
 
-echo "== [8/14] policy replay tier"
+echo "== [9/15] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [9/14] serving tier (adapter hot path + outcome replay)"
+echo "== [10/15] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
-echo "== [10/14] serving-trace tier (data-plane tracing overhead + acceptance)"
+echo "== [11/15] serving-trace tier (data-plane tracing overhead + acceptance)"
 # ISSUE 14 (docs/OBSERVABILITY.md "Request spans & exemplars"):
 # traced-vs-untraced replica step and 10k-replica exemplar fold
 # within 2% + noise grace at 1% sampling with tail capture ON, plus
@@ -168,16 +182,16 @@ echo "== [10/14] serving-trace tier (data-plane tracing overhead + acceptance)"
 # BENCH_SERVING.json["serving_trace"].
 JAX_PLATFORMS=cpu python bench.py serving-trace || exit 14
 
-echo "== [11/14] obs tier (TSDB ingest + alert evaluation)"
+echo "== [12/15] obs tier (TSDB ingest + alert evaluation)"
 JAX_PLATFORMS=cpu python bench.py obs || exit 10
 
-echo "== [12/14] cost tier (attribution ledger pass cost + conservation)"
+echo "== [13/15] cost tier (attribution ledger pass cost + conservation)"
 JAX_PLATFORMS=cpu python bench.py cost || exit 11
 
-echo "== [13/14] repack tier (week-long churn replay, never-worse gate)"
+echo "== [14/15] repack tier (week-long churn replay, never-worse gate)"
 JAX_PLATFORMS=cpu python bench.py repack || exit 12
 
-echo "== [14/14] sharded reconcile tier (million-pod loop + observe)"
+echo "== [15/15] sharded reconcile tier (million-pod loop + observe)"
 # ISSUE 13 (docs/SHARDING.md): the 1M-pod observe tier (indexed reads
 # must hold the 20x floor at 10x the PR-6 scale), then the full-loop
 # tier — sharded reconcile >= 2x serial passes/sec at 8 shards with

@@ -14,10 +14,15 @@ cd "$(dirname "$0")/.."
 # Static gate first: the invariant linter catches architectural
 # regressions (planner purity, thread discipline, exception hygiene,
 # jax purity, interprocedural races, lock order, blocking-under-lock,
-# replay determinism) before any test burns wall-clock.  --full: this
-# is the pre-release gate, so it must not inherit lint.sh's local
-# changed-only default (ISSUE 15).
+# replay determinism, cost-algebra units) before any test burns
+# wall-clock.  --full: this is the pre-release gate, so it must not
+# inherit lint.sh's local changed-only default (ISSUE 15).
 ./scripts/lint.sh --full
+
+# Units-of-measure gate (ISSUE 16): the TAU10xx dimension pass re-run
+# with NO baseline — the cost algebra's unit discipline can never grow
+# grandfathered entries, mirroring ci_gate.sh stage 3.
+python -m tpu_autoscaler.analysis --units --no-baseline tpu_autoscaler/
 
 # Race gate (ISSUE 4, extended ISSUE 15): static TAR5xx + TAL7xx
 # passes, the deterministic-schedule concurrency tier (seeded

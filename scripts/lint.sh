@@ -29,6 +29,8 @@ MYPY_TARGETS=(
   tpu_autoscaler/cost
   tpu_autoscaler/obs/tsdb.py
   tpu_autoscaler/obs/alerts.py
+  tpu_autoscaler/units.py
+  tpu_autoscaler/repack
 )
 
 run_mypy() {

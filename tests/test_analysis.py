@@ -21,6 +21,7 @@ from tpu_autoscaler.analysis import (
     LockOrderChecker,
     PurityChecker,
     ThreadDisciplineChecker,
+    UnitsChecker,
     default_checkers,
     parse_baseline,
     render_baseline,
@@ -856,11 +857,11 @@ class TestEscapeRaceChecker:
         assert check_program(good) == []
 
     def test_repo_scale_run_is_fast(self):
-        # Acceptance (ISSUE 4, re-ratified ISSUE 15): the WHOLE
-        # analysis — all checkers including the four whole-program
-        # passes TAR5xx + TAL7xx + TAB8xx + TAD9xx — stays under 15 s
-        # on this repo (the TAR precedent; the shared PackageGraph is
-        # what keeps adding passes sublinear).
+        # Acceptance (ISSUE 4, re-ratified ISSUE 15/16): the WHOLE
+        # analysis — all checkers including the five whole-program
+        # passes TAR5xx + TAL7xx + TAB8xx + TAD9xx + TAU10xx — stays
+        # under 15 s on this repo (the TAR precedent; the shared
+        # PackageGraph is what keeps adding passes sublinear).
         import time
 
         t0 = time.perf_counter()
@@ -2915,6 +2916,256 @@ class TestAlertDocChecker:
         from tpu_autoscaler.analysis import AlertDocChecker
 
         assert AlertDocChecker(doc_text=self.DOC).check_program([]) == []
+
+
+# --------------------------------------------------------------------- #
+# units of measure over the cost algebra (TAU10xx)
+# --------------------------------------------------------------------- #
+
+def check_units(code, rel="tpu_autoscaler/mod.py"):
+    src = SourceFile("<fixture>", rel, textwrap.dedent(code))
+    checker = UnitsChecker()
+    assert checker.applies_to(rel)
+    return checker.check_program([src])
+
+
+class TestUnitsChecker:
+    def test_tau1001_mixed_add_then_fixed(self):
+        bad = """
+            from tpu_autoscaler.units import ChipSeconds, Seconds
+
+            def total(cs: ChipSeconds, hold: Seconds) -> float:
+                return cs + hold
+        """
+        assert codes_of(check_units(bad)) == ["TAU1001"]
+        good = """
+            from tpu_autoscaler.units import ChipSeconds
+
+            def total(a: ChipSeconds, b: ChipSeconds) -> ChipSeconds:
+                return a + b
+        """
+        assert check_units(good) == []
+
+    def test_tau1001_assignment_against_declaration_then_fixed(self):
+        bad = """
+            from tpu_autoscaler.units import ChipSeconds, Seconds
+
+            def f(hold: Seconds) -> None:
+                committed: ChipSeconds = hold
+        """
+        assert codes_of(check_units(bad)) == ["TAU1001"]
+        good = """
+            from tpu_autoscaler.units import Seconds
+
+            def f(hold: Seconds) -> None:
+                committed: Seconds = hold
+        """
+        assert check_units(good) == []
+
+    def test_tau1001_fraction_proves_but_float_does_not(self):
+        # Fraction is PROVEN dimensionless; a bare float is merely
+        # unknown — the evidence-only discipline (no baseline to
+        # grow, so unproven flow must stay silent).
+        bad = """
+            from tpu_autoscaler.units import ChipSeconds, Fraction
+
+            def f(cs: ChipSeconds, frac: Fraction) -> float:
+                return cs + frac
+        """
+        assert codes_of(check_units(bad)) == ["TAU1001"]
+        good = """
+            from tpu_autoscaler.units import ChipSeconds
+
+            def f(cs: ChipSeconds, x: float) -> float:
+                return cs + x
+        """
+        assert check_units(good) == []
+
+    def test_tau1002_rate_times_seconds_then_blessed(self):
+        # The bug class the family exists for: $/chip-hour x
+        # chip-seconds without the /3600 leaves an hour/seconds
+        # residue at the return boundary.
+        bad = """
+            from tpu_autoscaler.units import ChipSeconds, UsdPerChipHour
+
+            def bill(rate: UsdPerChipHour, cs: ChipSeconds) -> float:
+                return rate * cs
+        """
+        assert codes_of(check_units(bad)) == ["TAU1002"]
+        good = """
+            from tpu_autoscaler.units import ChipSeconds, Usd, UsdPerChipHour
+
+            def bill(rate: UsdPerChipHour, cs: ChipSeconds) -> Usd:
+                return rate * cs / 3600.0
+        """
+        assert check_units(good) == []
+
+    def test_tau1002_literal_conversion_is_not_a_crossing(self):
+        # threshold=500.0/3600.0 (obs/alerts.py) is per-window ->
+        # per-second arithmetic between two literals, not a timebase
+        # crossing: the 3600 factor only bites a DIMENSIONED partner.
+        good = """
+            def threshold() -> float:
+                return 500.0 / 3600.0
+        """
+        assert check_units(good) == []
+
+    def test_tau1003_metric_suffix_then_fixed(self):
+        bad = """
+            from tpu_autoscaler.units import ChipSeconds
+
+            class M:
+                def _inc(self, name, by=1.0): ...
+
+            def f(m: M, cs: ChipSeconds):
+                m._inc("work_total", cs)
+        """
+        assert codes_of(check_units(bad)) == ["TAU1003"]
+        good = """
+            from tpu_autoscaler.units import ChipSeconds
+
+            class M:
+                def _inc(self, name, by=1.0): ...
+
+            def f(m: M, cs: ChipSeconds):
+                m._inc("work_chip_seconds_total", cs)
+        """
+        assert check_units(good) == []
+
+    def test_tau1003_plain_seconds_into_chip_seconds_series(self):
+        # "chip_seconds" contains "seconds": the Seconds rule must
+        # still reject a plain-seconds value fed to a chip-seconds
+        # series (the suffix lies about the integrand).
+        bad = """
+            from tpu_autoscaler.units import Seconds
+
+            class M:
+                def observe(self, name, value): ...
+
+            def f(m: M, hidden: Seconds):
+                m.observe("hidden_chip_seconds", hidden)
+        """
+        assert codes_of(check_units(bad)) == ["TAU1003"]
+        good = """
+            from tpu_autoscaler.units import Seconds
+
+            class M:
+                def observe(self, name, value): ...
+
+            def f(m: M, hidden: Seconds):
+                m.observe("hidden_provision_seconds", hidden)
+        """
+        assert check_units(good) == []
+
+    def test_tau1004_budget_compare_then_fixed(self):
+        bad = """
+            from tpu_autoscaler.units import ChipSeconds, Usd
+
+            def gate(spent_usd: Usd, budget_cs: ChipSeconds) -> bool:
+                return spent_usd > budget_cs
+        """
+        assert codes_of(check_units(bad)) == ["TAU1004"]
+        good = """
+            from tpu_autoscaler.units import ChipSeconds
+
+            def gate(spent: ChipSeconds, budget_cs: ChipSeconds) -> bool:
+                return spent > budget_cs
+        """
+        assert check_units(good) == []
+
+    def test_tau1004_budget_function_argument_then_fixed(self):
+        bad = """
+            from tpu_autoscaler.units import ChipSeconds, Seconds, Usd
+
+            def budget_remaining(events, now: Seconds,
+                                 window_seconds: Seconds,
+                                 budget_chip_seconds: ChipSeconds):
+                return events, 0.0, budget_chip_seconds
+
+            def gate(now: Seconds, spent_usd: Usd):
+                return budget_remaining([], now, now, spent_usd)
+        """
+        assert codes_of(check_units(bad)) == ["TAU1004"]
+        good = """
+            from tpu_autoscaler.units import ChipSeconds, Seconds
+
+            def budget_remaining(events, now: Seconds,
+                                 window_seconds: Seconds,
+                                 budget_chip_seconds: ChipSeconds):
+                return events, 0.0, budget_chip_seconds
+
+            def gate(now: Seconds, spent: ChipSeconds):
+                return budget_remaining([], now, now, spent)
+        """
+        assert check_units(good) == []
+
+    def test_interprocedural_tuple_return_and_accumulator(self):
+        # The ledger shape end-to-end: the rate arrives through a
+        # tuple-returning method on a constructor-typed attribute and
+        # lands in a declared-Usd accumulator.
+        bad = """
+            from tpu_autoscaler.units import ChipSeconds, Usd, UsdPerChipHour
+
+            class Book:
+                def rate(self) -> tuple[UsdPerChipHour, bool]:
+                    return 1.0, True
+
+            class Ledger:
+                def __init__(self):
+                    self.book = Book()
+
+                def close(self, cs: ChipSeconds) -> None:
+                    total: Usd = 0.0
+                    rate, priced = self.book.rate()
+                    total += rate * cs
+        """
+        assert codes_of(check_units(bad)) == ["TAU1001", "TAU1002"]
+        good = """
+            from tpu_autoscaler.units import ChipSeconds, Usd, UsdPerChipHour
+
+            class Book:
+                def rate(self) -> tuple[UsdPerChipHour, bool]:
+                    return 1.0, True
+
+            class Ledger:
+                def __init__(self):
+                    self.book = Book()
+
+                def close(self, cs: ChipSeconds) -> None:
+                    total: Usd = 0.0
+                    rate, priced = self.book.rate()
+                    total += rate * cs / 3600.0
+        """
+        assert check_units(good) == []
+
+    def test_blessed_constructors_are_clean(self):
+        # chip_seconds()/usd() need no special-casing: the bless is
+        # emergent from the 3600 rule, so the constructors themselves
+        # and calls through them sweep clean.
+        good = """
+            from tpu_autoscaler.units import (
+                Chips, ChipSeconds, Seconds, Usd, UsdPerChipHour,
+                chip_seconds, usd)
+
+            def charge(chips: Chips, hold: Seconds,
+                       rate: UsdPerChipHour) -> Usd:
+                cs: ChipSeconds = chip_seconds(chips, hold)
+                return usd(rate, cs)
+        """
+        assert check_units(good) == []
+
+    def test_empty_input_no_findings(self):
+        assert UnitsChecker().check_program([]) == []
+
+    def test_repo_units_clean_with_no_baseline(self):
+        # The ci_gate stage's contract: the TAU family holds with NO
+        # baseline — zero grandfathered entries, ever.
+        res = run_analysis(
+            [os.path.join(REPO_ROOT, "tpu_autoscaler")],
+            [UnitsChecker()], baseline=None, root=REPO_ROOT)
+        assert res.errors == []
+        assert res.findings == [], "\n".join(
+            f.render() for f in res.findings)
 
 
 class TestRepoIsClean:

@@ -100,6 +100,38 @@ class TestPriceBook:
         with pytest.raises(ValueError):
             PriceBook.from_dict({"tiers": {"weekend": 0.1}})
 
+    def test_from_dict_rejects_timebase_slip_rates(self):
+        # ISSUE 16: a $/chip-hour book fed a chip-SECOND-derived value
+        # is off by 3600x in one direction or the other — both sides of
+        # the [0.01, 100] plausibility band must refuse to load.
+        with pytest.raises(ValueError, match="plausibility band"):
+            PriceBook.from_dict({"classes": {"v5p": 4.2 * 3600.0}})
+        with pytest.raises(ValueError, match="timebase slip"):
+            PriceBook.from_dict({"classes": {"v5e": 1.2 / 3600.0}})
+
+    def test_from_dict_band_counts_every_offender(self):
+        # The error names HOW MANY rates are out of band, so a config
+        # with several slips surfaces them all in one failure.
+        with pytest.raises(ValueError, match=r"2 price-book rate"):
+            PriceBook.from_dict({"classes": {"v5e": 4320.0,
+                                             "v5p": 15120.0}})
+
+    def test_from_dict_band_checks_default_rate(self):
+        with pytest.raises(ValueError, match="default_rate"):
+            PriceBook.from_dict({"default_rate": 7200.0})
+
+    def test_from_dict_band_allows_zero_and_in_band(self):
+        # 0.0 is an explicit "free" sentinel (donated/internal
+        # capacity) and stays legal; ordinary in-band rates load.
+        book = PriceBook.from_dict({"default_rate": 0.0,
+                                    "classes": {"v5e": 0.0,
+                                                "v5p": 99.5}})
+        rate, priced = book.rate("tpu-v5-lite-device", "on_demand")
+        assert priced and rate == 0.0
+        assert book.default_rate == 0.0
+        rate_p, _ = book.rate("tpu-v5p-slice", "on_demand")
+        assert rate_p == pytest.approx(99.5)
+
     def test_tier_detection(self):
         assert tier_of_labels({"cloud.google.com/gke-spot": "true"}) \
             == "spot"

@@ -29,18 +29,19 @@ from tpu_autoscaler.analysis.metricsdoc import (
 )
 from tpu_autoscaler.analysis.purity import PurityChecker
 from tpu_autoscaler.analysis.threads import ThreadDisciplineChecker
+from tpu_autoscaler.analysis.units import UnitsChecker
 
 
 def default_checkers() -> list[Checker]:
     # TAT2xx stays in the lineup as the fallback for sharing the
     # interprocedural TAR5xx pass cannot resolve (docs/ANALYSIS.md).
-    # The four whole-program passes (TAR/TAL/TAB/TAD) share one
+    # The five whole-program passes (TAR/TAL/TAB/TAD/TAU) share one
     # PackageGraph per run via callgraph.shared_graph.
     return [PurityChecker(), ThreadDisciplineChecker(),
             ExceptionHygieneChecker(), JaxPurityChecker(),
             EscapeRaceChecker(), LockOrderChecker(),
             BlockingUnderLockChecker(), DeterminismChecker(),
-            MetricsDocChecker(), AlertDocChecker()]
+            MetricsDocChecker(), AlertDocChecker(), UnitsChecker()]
 
 
 __all__ = [
@@ -59,6 +60,7 @@ __all__ = [
     "PurityChecker",
     "SourceFile",
     "ThreadDisciplineChecker",
+    "UnitsChecker",
     "default_checkers",
     "parse_baseline",
     "render_baseline",

@@ -76,6 +76,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="report only the interprocedural race "
                              "pass (TAR5xx) — the static half of "
                              "scripts/race.sh")
+    parser.add_argument("--units", action="store_true",
+                        help="report only the units-of-measure pass "
+                             "(TAU10xx) over the cost algebra — runs "
+                             "with no baseline in scripts/ci_gate.sh")
     parser.add_argument("--format", default="text",
                         choices=("text", "github"),
                         help="'github' emits ::error workflow-command "
@@ -96,6 +100,8 @@ def main(argv: list[str] | None = None) -> int:
         # DROP every out-of-scope grandfathered finding.
         parser.error("--changed-only and --write-baseline are "
                      "mutually exclusive")
+    if args.races and args.units:
+        parser.error("--races and --units are mutually exclusive")
     if args.races:
         if args.select:
             # Refusing beats silently discarding the user's filter: a
@@ -103,6 +109,10 @@ def main(argv: list[str] | None = None) -> int:
             # live TAT findings.
             parser.error("--races and --select are mutually exclusive")
         args.select = "TAR"
+    if args.units:
+        if args.select:
+            parser.error("--units and --select are mutually exclusive")
+        args.select = "TAU"
 
     checkers = default_checkers()
     if args.list_codes:

@@ -1,0 +1,879 @@
+"""Units-of-measure checker for the cost algebra (TAU10xx).
+
+The cost spine (cost/, repack/, policy/slo.py, policy/engine.py,
+serving/scaler.py) is an algebra over FOUR incompatible quantities —
+chips, seconds, chip-seconds and dollars — plus one rate
+($/chip-hour) whose timebase differs from every accumulator by a
+factor of 3600.  ``tpu_autoscaler/units.py`` gives each quantity a
+zero-runtime-cost ``Annotated`` alias and two blessed constructors
+(``chip_seconds``, ``usd``) as the only sanctioned dimension
+crossings.  This checker makes the discipline machine-checked: it
+seeds dimensions from the alias annotations and propagates them
+through assignments, attribute tables, container elements, tuple
+returns and resolved call edges on the shared :class:`PackageGraph`.
+
+The dimension lattice is an exponent vector over four base units —
+``chip``, ``second``, ``hour``, ``usd`` — so ``ChipSeconds`` is
+``chip*s``, ``UsdPerChipHour`` is ``usd/(chip*hour)`` and
+``Fraction`` is the PROVEN-dimensionless point (distinct from
+unknown).  Multiplication adds vectors, division subtracts; the
+literal ``3600``/``3600.0`` and the name ``SECONDS_PER_HOUR`` carry
+``s/hour`` as direct multiply/divide operands (elsewhere a numeric
+literal is polymorphic), which is what makes ``rate * cs / 3600.0``
+come out as clean dollars while ``rate * cs`` leaves the
+mixed-timebase residue TAU1002 exists to catch.
+
+| code | meaning |
+| --- | --- |
+| TAU1001 | mixed-dimension add/sub, or a value bound against a declaration of another dimension |
+| TAU1002 | a flow boundary carries a mixed-timebase dimension (per-hour x seconds without the /3600) |
+| TAU1003 | dimensioned value exported to a metric whose name lacks the matching unit suffix |
+| TAU1004 | budget-guard comparison or budget-function argument across dimensions |
+
+Evidence-only, like TAR5xx/TAD9xx: an unresolved name, an unannotated
+``float``, a dict read or an unresolvable callee is UNKNOWN and
+produces no finding — only flow the checker actually proved
+dimensioned can be flagged, so the pass runs with no baseline
+(scripts/ci_gate.sh re-runs the family with ``--no-baseline``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tpu_autoscaler.analysis.callgraph import (
+    FuncInfo,
+    ModuleInfo,
+    PackageGraph,
+    _module_name,
+    _short as _short_fn,
+    shared_graph,
+)
+from tpu_autoscaler.analysis.core import (
+    Finding,
+    ProgramChecker,
+    SourceFile,
+    dotted_name,
+)
+from tpu_autoscaler.analysis.metricsdoc import (
+    _METRIC_METHODS,
+    _joinedstr_prefix,
+)
+
+#: Exponent vector over (chip, second, hour, usd).
+Dim = tuple[int, int, int, int]
+
+UNITS_MODULE = "tpu_autoscaler.units"
+
+#: The alias lattice.  ``Fraction`` is PROVEN dimensionless — the
+#: distinction from unknown matters: Fraction + ChipSeconds is a
+#: finding, float + ChipSeconds is not (no evidence).
+ALIAS_DIMS: dict[str, Dim] = {
+    "Chips": (1, 0, 0, 0),
+    "Seconds": (0, 1, 0, 0),
+    "ChipSeconds": (1, 1, 0, 0),
+    "UsdPerChipHour": (-1, 0, -1, 1),
+    "Usd": (0, 0, 0, 1),
+    "Fraction": (0, 0, 0, 0),
+}
+
+DIMLESS: Dim = (0, 0, 0, 0)
+
+#: The conversion factor's dimension: multiplying by 3600 (or
+#: SECONDS_PER_HOUR) turns hours into seconds; dividing turns
+#: seconds into hours.  Carried ONLY as a direct mul/div operand —
+#: anywhere else ``3600.0`` is just a number (a compare against it
+#: must stay polymorphic, or every ``cs >= 3600.0`` would lie).
+_SEC_PER_HOUR: Dim = (0, 1, -1, 0)
+
+#: The one window algebra (policy/slo.py): mismatched dimensions fed
+#: to or compared around these are budget-guard bugs (TAU1004), the
+#: class of error where a dollar total silently gates a chip-seconds
+#: budget.
+_BUDGET_FUNCS = frozenset({"budget_remaining", "rolling_waste"})
+
+#: Builtin pass-throughs: the result carries its argument's dimension.
+_PASSTHROUGH = frozenset({"round", "abs", "float", "int", "min", "max"})
+
+_SEQ_CONTAINERS = frozenset({
+    "list", "List", "set", "Set", "frozenset", "FrozenSet", "tuple",
+    "Sequence", "Iterable", "Iterator", "Collection", "deque", "Deque",
+})
+_DICTS = frozenset({
+    "dict", "Dict", "Mapping", "MutableMapping", "OrderedDict",
+    "defaultdict",
+})
+
+#: Metric-name suffix contract (docs/OPERATIONS.md): a series fed an
+#: alias-dimensioned value must carry the unit in its name.  Keyed by
+#: exact alias dimension — derived dimensions (a $/hour gauge) are
+#: out of contract and skipped.
+_SUFFIX_RULES: list[tuple[Dim, str, tuple[str, ...]]] = [
+    (ALIAS_DIMS["ChipSeconds"], "ChipSeconds", ("chip_seconds",)),
+    (ALIAS_DIMS["Usd"], "Usd", ("usd", "dollar")),
+    (ALIAS_DIMS["Seconds"], "Seconds", ("seconds",)),
+    (ALIAS_DIMS["Chips"], "Chips", ("chips",)),
+    (ALIAS_DIMS["UsdPerChipHour"], "UsdPerChipHour", ("per_hour",)),
+]
+
+_BASE_SYMBOLS = ("chip", "s", "hour", "usd")
+
+
+def _dim_str(dim: Dim) -> str:
+    """Human spelling: the alias name when one matches, else the
+    exponent product (``usd*s/hour`` for the classic residue)."""
+    for name, d in ALIAS_DIMS.items():
+        if d == dim:
+            return "dimensionless (Fraction)" if dim == DIMLESS else name
+    num = [sym if e == 1 else f"{sym}^{e}"
+           for sym, e in zip(_BASE_SYMBOLS, dim) if e > 0]
+    den = [sym if e == -1 else f"{sym}^{-e}"
+           for sym, e in zip(_BASE_SYMBOLS, dim) if e < 0]
+    if not num and not den:
+        return "dimensionless"
+    out = "*".join(num) or "1"
+    if den:
+        out += "/" + "*".join(den)
+    return out
+
+
+def _parse_str_ann(ann: ast.AST | None) -> ast.AST | None:
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            return ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    return ann
+
+
+def _ann_leaf(ann: ast.AST) -> str:
+    d = dotted_name(ann)
+    return d.split(".")[-1] if d else ""
+
+
+def _is_numeric_literal(expr: ast.AST) -> bool:
+    return (isinstance(expr, ast.Constant)
+            and isinstance(expr.value, (int, float))
+            and not isinstance(expr.value, bool))
+
+
+@dataclasses.dataclass
+class _Env:
+    """One function's dimension environment (flow-insensitive)."""
+
+    dims: dict[str, Dim]
+    #: annotation-derived only — the contract TAU1001's
+    #: assigned-against-declaration check holds values to.
+    declared: dict[str, Dim]
+    #: name -> (annotation node, module it reads in); feeds container
+    #: element, tuple-part and dict-value queries.
+    anns: dict[str, tuple[ast.AST, ModuleInfo]]
+    #: class types, seeded from the graph and extended with loop
+    #: bindings over annotated containers.
+    types: dict[str, str]
+
+
+class UnitsChecker(ProgramChecker):
+    """Dimension discipline over the cost algebra (docs/ANALYSIS.md)."""
+
+    name = "units"
+    codes = {
+        "TAU1001": "mixed-dimension add/sub or assignment against a "
+                   "declaration of another dimension",
+        "TAU1002": "mixed-timebase residue at a flow boundary (per-hour "
+                   "rate crossed seconds without /3600)",
+        "TAU1003": "dimensioned value exported to a metric whose name "
+                   "lacks the matching unit suffix",
+        "TAU1004": "budget-guard comparison or budget-function argument "
+                   "across dimensions",
+    }
+
+    def applies_to(self, rel_path: str) -> bool:
+        return "tpu_autoscaler/testing/" not in rel_path
+
+    # -- program tables ----------------------------------------------------
+
+    def _build_tables(self, files: list[SourceFile]) -> None:
+        g = self.graph
+        # Class attribute annotations: dataclass fields (class-body
+        # AnnAssign — the graph's method-body inference never sees
+        # them) plus ``self.x: T`` method-body declarations.
+        self._attr_anns: dict[str, dict[str, tuple[ast.AST,
+                                                   ModuleInfo]]] = {}
+        for ci in g.classes.values():
+            mod = g.modules[_module_name(ci.rel_path)]
+            table: dict[str, tuple[ast.AST, ModuleInfo]] = {}
+            for stmt in ci.node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    table.setdefault(stmt.target.id,
+                                     (stmt.annotation, mod))
+            for fn in ci.methods.values():
+                for node in ast.walk(fn.node):
+                    if isinstance(node, ast.AnnAssign) \
+                            and isinstance(node.target, ast.Attribute) \
+                            and isinstance(node.target.value, ast.Name) \
+                            and node.target.value.id == "self":
+                        table.setdefault(node.target.attr,
+                                         (node.annotation, mod))
+            self._attr_anns[ci.qname] = table
+        # Module-level annotated globals.
+        self._global_anns: dict[str, dict[str, tuple[ast.AST,
+                                                     ModuleInfo]]] = {}
+        for mod in g.modules.values():
+            table = {}
+            for stmt in mod.src.tree.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    table[stmt.target.id] = (stmt.annotation, mod)
+            self._global_anns[mod.modname] = table
+        # Return dimensions: annotations first, then a two-iteration
+        # inference pass so an unannotated helper returning ``x * y``
+        # of known dims still propagates to its callers.
+        self._ret_dim: dict[str, Dim] = {}
+        self._ret_ann: dict[str, tuple[ast.AST, ModuleInfo]] = {}
+        for q, fn in g.funcs.items():
+            if fn.node.returns is not None:
+                mod = g.modules[_module_name(fn.rel_path)]
+                self._ret_ann[q] = (fn.node.returns, mod)
+                dim = self._ann_dim(fn.node.returns, mod)
+                if dim is not None:
+                    self._ret_dim[q] = dim
+        for _ in range(2):
+            self._env_cache: dict[str, _Env] = {}
+            for q, fn in g.funcs.items():
+                if q in self._ret_dim or fn.node.returns is not None:
+                    continue
+                env = self._env(fn)
+                dims = {self._expr_dim(node.value, fn, env)
+                        for node in ast.walk(fn.node)
+                        if isinstance(node, ast.Return)
+                        and node.value is not None}
+                if len(dims) == 1:
+                    dim = dims.pop()
+                    if dim is not None:
+                        self._ret_dim[q] = dim
+        self._env_cache = {}
+
+    # -- annotation interpretation ----------------------------------------
+
+    def _alias_name(self, ann: ast.AST, mod: ModuleInfo) -> str | None:
+        """The units alias a Name/Attribute annotation denotes, chased
+        through the import table (never the filesystem — fixtures that
+        merely ``from tpu_autoscaler.units import ...`` resolve too)."""
+        d = dotted_name(ann)
+        if d is None:
+            return None
+        if "." in d:
+            head, _, rest = d.partition(".")
+            target = mod.imports.get(head)
+            full = f"{target}.{rest}" if target else f"{mod.modname}.{d}"
+        else:
+            full = mod.imports.get(d) or f"{mod.modname}.{d}"
+        if full.startswith(UNITS_MODULE + "."):
+            leaf = full.rsplit(".", 1)[1]
+            if leaf in ALIAS_DIMS:
+                return leaf
+        return None
+
+    def _ann_dim(self, ann: ast.AST | None,
+                 mod: ModuleInfo) -> Dim | None:
+        """Scalar dimension of an annotation.  Plain ``float``/``int``
+        is UNKNOWN, not dimensionless — only ``Fraction`` proves."""
+        ann = _parse_str_ann(ann)
+        if ann is None:
+            return None
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._ann_dim(ann.left, mod)
+                    or self._ann_dim(ann.right, mod))
+        if isinstance(ann, ast.Subscript):
+            leaf = _ann_leaf(ann.value)
+            if leaf in ("Optional", "Final", "Annotated"):
+                sl = ann.slice
+                if leaf == "Annotated" and isinstance(sl, ast.Tuple) \
+                        and sl.elts:
+                    sl = sl.elts[0]
+                return self._ann_dim(sl, mod)
+            return None                       # containers: no scalar dim
+        name = self._alias_name(ann, mod)
+        return ALIAS_DIMS.get(name) if name else None
+
+    def _elem_ann(self, ann: ast.AST | None, mod: ModuleInfo
+                  ) -> tuple[ast.AST, ModuleInfo] | None:
+        """Element annotation of a homogeneous container annotation."""
+        ann = _parse_str_ann(ann)
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._elem_ann(ann.left, mod)
+                    or self._elem_ann(ann.right, mod))
+        if isinstance(ann, ast.Subscript):
+            leaf = _ann_leaf(ann.value)
+            if leaf == "Optional":
+                return self._elem_ann(ann.slice, mod)
+            if leaf in _SEQ_CONTAINERS and leaf not in ("tuple", "Tuple") \
+                    and not isinstance(ann.slice, ast.Tuple):
+                return (ann.slice, mod)
+        return None
+
+    def _tuple_anns(self, ann: ast.AST | None, mod: ModuleInfo
+                    ) -> list[ast.AST] | None:
+        ann = _parse_str_ann(ann)
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._tuple_anns(ann.left, mod)
+                    or self._tuple_anns(ann.right, mod))
+        if isinstance(ann, ast.Subscript):
+            leaf = _ann_leaf(ann.value)
+            if leaf == "Optional":
+                return self._tuple_anns(ann.slice, mod)
+            if leaf in ("tuple", "Tuple") \
+                    and isinstance(ann.slice, ast.Tuple):
+                return list(ann.slice.elts)
+        return None
+
+    def _dict_kv_anns(self, ann: ast.AST | None, mod: ModuleInfo
+                      ) -> tuple[ast.AST, ast.AST] | None:
+        ann = _parse_str_ann(ann)
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._dict_kv_anns(ann.left, mod)
+                    or self._dict_kv_anns(ann.right, mod))
+        if isinstance(ann, ast.Subscript):
+            leaf = _ann_leaf(ann.value)
+            if leaf == "Optional":
+                return self._dict_kv_anns(ann.slice, mod)
+            if leaf in _DICTS and isinstance(ann.slice, ast.Tuple) \
+                    and len(ann.slice.elts) == 2:
+                return (ann.slice.elts[0], ann.slice.elts[1])
+        return None
+
+    # -- class/attr resolution --------------------------------------------
+
+    def _attr_ann(self, cls_qname: str, attr: str, depth: int = 0
+                  ) -> tuple[ast.AST, ModuleInfo] | None:
+        table = self._attr_anns.get(cls_qname)
+        if table is not None and attr in table:
+            return table[attr]
+        ci = self.graph.classes.get(cls_qname)
+        if ci is not None and depth < 4:
+            for base in self.graph._package_bases(ci):
+                found = self._attr_ann(base.qname, attr, depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def _expr_cls(self, expr: ast.AST, fn: FuncInfo,
+                  env: _Env) -> str | None:
+        """Class qname of an expression: the graph's resolution plus
+        this checker's dataclass-field annotations."""
+        t = self.graph.expr_type(expr, fn, env.types)
+        if t is not None:
+            return t
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_cls(expr.value, fn, env)
+            if base is not None:
+                aa = self._attr_ann(base, expr.attr)
+                if aa is not None:
+                    return self.graph._annotation_type(aa[0], aa[1])
+        return None
+
+    # -- expression annotations (for container queries) --------------------
+
+    def _call_ret_ann(self, expr: ast.AST, fn: FuncInfo, env: _Env
+                      ) -> tuple[ast.AST, ModuleInfo] | None:
+        if not isinstance(expr, ast.Call):
+            return None
+        target = self.graph.resolve_callable(expr.func, fn, env.types)
+        if target is None:
+            return None
+        return self._ret_ann.get(target.qname)
+
+    def _expr_ann(self, expr: ast.AST, fn: FuncInfo, env: _Env
+                  ) -> tuple[ast.AST, ModuleInfo] | None:
+        if isinstance(expr, ast.Name):
+            if expr.id in env.anns:
+                return env.anns[expr.id]
+            mod = self.graph.modules[_module_name(fn.rel_path)]
+            return self._global_anns.get(mod.modname, {}).get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_cls(expr.value, fn, env)
+            if base is not None:
+                return self._attr_ann(base, expr.attr)
+            d = dotted_name(expr)
+            if d is not None and "." in d:
+                head, _, rest = d.partition(".")
+                mod = self.graph.modules[_module_name(fn.rel_path)]
+                target = mod.imports.get(head)
+                if target is not None and "." not in rest:
+                    return self._global_anns.get(target, {}).get(rest)
+            return None
+        if isinstance(expr, ast.Call):
+            return self._call_ret_ann(expr, fn, env)
+        return None
+
+    # -- dimension evaluation ---------------------------------------------
+
+    @staticmethod
+    def _is_conv_factor(expr: ast.AST) -> bool:
+        if _is_numeric_literal(expr) and expr.value in (3600, 3600.0):
+            return True
+        d = dotted_name(expr)
+        return d is not None and d.split(".")[-1] == "SECONDS_PER_HOUR"
+
+    def _factor_dim(self, expr: ast.AST, fn: FuncInfo,
+                    env: _Env) -> Dim | None:
+        """Operand dimension inside a multiply/divide: numeric
+        literals are dimensionless here (``chips * 2`` is chips), and
+        the 3600 conversion factor carries s/hour."""
+        if self._is_conv_factor(expr):
+            return _SEC_PER_HOUR
+        if _is_numeric_literal(expr):
+            return DIMLESS
+        if isinstance(expr, ast.UnaryOp):
+            return self._factor_dim(expr.operand, fn, env)
+        return self._expr_dim(expr, fn, env)
+
+    def _expr_dim(self, expr: ast.AST, fn: FuncInfo,
+                  env: _Env) -> Dim | None:
+        if isinstance(expr, ast.Constant):
+            return None                        # polymorphic literal
+        if isinstance(expr, ast.Name):
+            if expr.id in env.dims:
+                return env.dims[expr.id]
+            mod = self.graph.modules[_module_name(fn.rel_path)]
+            ga = self._global_anns.get(mod.modname, {}).get(expr.id)
+            return self._ann_dim(*ga) if ga else None
+        if isinstance(expr, ast.Attribute):
+            aa = self._expr_ann(expr, fn, env)
+            return self._ann_dim(*aa) if aa else None
+        if isinstance(expr, ast.UnaryOp):
+            return self._expr_dim(expr.operand, fn, env)
+        if isinstance(expr, ast.BinOp):
+            op = expr.op
+            if isinstance(op, (ast.Mult, ast.Div, ast.FloorDiv)):
+                # The 3600 factor needs a DIMENSIONED partner: between
+                # two bare literals (``threshold=500.0 / 3600.0``) it
+                # is plain arithmetic, not a timebase crossing.
+                if (self._is_conv_factor(expr.left)
+                        and _is_numeric_literal(expr.right)) \
+                        or (self._is_conv_factor(expr.right)
+                            and _is_numeric_literal(expr.left)):
+                    return None
+                left = self._factor_dim(expr.left, fn, env)
+                right = self._factor_dim(expr.right, fn, env)
+                if left is None or right is None:
+                    return None                # dim x unknown: no evidence
+                if isinstance(op, ast.Mult):
+                    return (left[0] + right[0], left[1] + right[1],
+                            left[2] + right[2], left[3] + right[3])
+                return (left[0] - right[0], left[1] - right[1],
+                        left[2] - right[2], left[3] - right[3])
+            if isinstance(op, (ast.Add, ast.Sub)):
+                left = self._expr_dim(expr.left, fn, env)
+                right = self._expr_dim(expr.right, fn, env)
+                if left is not None and right is not None:
+                    return left if left == right else None
+                return left if left is not None else right
+            if isinstance(op, ast.Mod):
+                return self._expr_dim(expr.left, fn, env)
+            return None
+        if isinstance(expr, ast.Call):
+            return self._call_dim(expr, fn, env)
+        if isinstance(expr, ast.IfExp):
+            return (self._expr_dim(expr.body, fn, env)
+                    or self._expr_dim(expr.orelse, fn, env))
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                dim = self._expr_dim(v, fn, env)
+                if dim is not None:
+                    return dim
+            return None
+        if isinstance(expr, ast.Subscript):
+            if isinstance(expr.slice, ast.Constant) \
+                    and isinstance(expr.slice.value, int):
+                src_ann = self._expr_ann(expr.value, fn, env)
+                if src_ann is not None:
+                    parts = self._tuple_anns(*src_ann)
+                    idx = expr.slice.value
+                    if parts and 0 <= idx < len(parts):
+                        return self._ann_dim(parts[idx], src_ann[1])
+            return None                        # dict/list reads: unknown
+        return None
+
+    def _call_dim(self, call: ast.Call, fn: FuncInfo,
+                  env: _Env) -> Dim | None:
+        d = dotted_name(call.func)
+        leaf = d.split(".")[-1] if d else None
+        if leaf in _PASSTHROUGH:
+            for arg in call.args:
+                dim = self._expr_dim(arg, fn, env)
+                if dim is not None:
+                    return dim
+            return None
+        if leaf == "sum" and call.args:
+            arg0 = call.args[0]
+            if isinstance(arg0, (ast.GeneratorExp, ast.ListComp)):
+                # comprehension targets were bound during env build
+                return self._expr_dim(arg0.elt, fn, env)
+            src_ann = self._expr_ann(arg0, fn, env)
+            if src_ann is not None:
+                ea = self._elem_ann(*src_ann)
+                if ea is not None:
+                    return self._ann_dim(*ea)
+            return None
+        target = self.graph.resolve_callable(call.func, fn, env.types)
+        if target is not None:
+            return self._ret_dim.get(target.qname)
+        return None
+
+    # -- per-function environment -----------------------------------------
+
+    def _bind_name(self, node: ast.AST, ann: ast.AST,
+                   mod: ModuleInfo, env: _Env) -> None:
+        if not isinstance(node, ast.Name):
+            return
+        dim = self._ann_dim(ann, mod)
+        if dim is not None:
+            env.dims.setdefault(node.id, dim)
+        env.anns.setdefault(node.id, (ann, mod))
+        cls = self.graph._annotation_type(ann, mod)
+        if cls is not None:
+            env.types.setdefault(node.id, cls)
+
+    def _bind_loop(self, target: ast.AST, it: ast.AST,
+                   fn: FuncInfo, env: _Env) -> None:
+        # dict.items()/.values()/.keys() over an annotated mapping.
+        if isinstance(it, ast.Call) and not it.args \
+                and isinstance(it.func, ast.Attribute) \
+                and it.func.attr in ("items", "values", "keys"):
+            base_ann = self._expr_ann(it.func.value, fn, env)
+            if base_ann is None:
+                return
+            kv = self._dict_kv_anns(*base_ann)
+            if kv is None:
+                return
+            key_ann, val_ann = kv
+            mod = base_ann[1]
+            if it.func.attr == "values":
+                self._bind_name(target, val_ann, mod, env)
+            elif it.func.attr == "keys":
+                self._bind_name(target, key_ann, mod, env)
+            elif isinstance(target, ast.Tuple) \
+                    and len(target.elts) == 2:
+                self._bind_name(target.elts[0], key_ann, mod, env)
+                self._bind_name(target.elts[1], val_ann, mod, env)
+            return
+        src_ann = self._expr_ann(it, fn, env)
+        if src_ann is None:
+            return
+        ea = self._elem_ann(*src_ann)
+        if ea is None:
+            return
+        elem, mod = ea
+        if isinstance(target, ast.Name):
+            self._bind_name(target, elem, mod, env)
+        elif isinstance(target, ast.Tuple):
+            parts = self._tuple_anns(elem, mod)
+            if parts and len(parts) == len(target.elts):
+                for tgt, part in zip(target.elts, parts):
+                    self._bind_name(tgt, part, mod, env)
+
+    def _env(self, fn: FuncInfo) -> _Env:
+        cached = self._env_cache.get(fn.qname)
+        if cached is not None:
+            return cached
+        mod = self.graph.modules[_module_name(fn.rel_path)]
+        env = _Env({}, {}, {}, self.graph.local_types(fn))
+        args = fn.node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            if a.annotation is not None:
+                dim = self._ann_dim(a.annotation, mod)
+                if dim is not None:
+                    env.dims[a.arg] = dim
+                    env.declared[a.arg] = dim
+                env.anns.setdefault(a.arg, (a.annotation, mod))
+        for _ in range(2):                     # aliases chain one hop
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    dim = self._ann_dim(node.annotation, mod)
+                    if dim is not None:
+                        env.dims.setdefault(node.target.id, dim)
+                        env.declared.setdefault(node.target.id, dim)
+                    env.anns.setdefault(node.target.id,
+                                        (node.annotation, mod))
+                elif isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Name):
+                        dim = self._expr_dim(node.value, fn, env)
+                        if dim is not None:
+                            env.dims.setdefault(tgt.id, dim)
+                        ra = self._call_ret_ann(node.value, fn, env)
+                        if ra is not None:
+                            env.anns.setdefault(tgt.id, ra)
+                    elif isinstance(tgt, ast.Tuple):
+                        ra = self._call_ret_ann(node.value, fn, env)
+                        if ra is None:
+                            continue
+                        parts = self._tuple_anns(*ra)
+                        if parts and len(parts) == len(tgt.elts):
+                            for t, part in zip(tgt.elts, parts):
+                                self._bind_name(t, part, ra[1], env)
+                elif isinstance(node, ast.For):
+                    self._bind_loop(node.target, node.iter, fn, env)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    for gen in node.generators:
+                        self._bind_loop(gen.target, gen.iter, fn, env)
+        self._env_cache[fn.qname] = env
+        return env
+
+    # -- the check ---------------------------------------------------------
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
+        if not files:
+            return []
+        self.graph = shared_graph(files)
+        self._build_tables(files)
+        findings: list[Finding] = []
+        for qname in sorted(self.graph.funcs):
+            fn = self.graph.funcs[qname]
+            scan = _FnScan(self, fn, self._env(fn))
+            scan.visit(fn.node)
+            findings.extend(scan.findings)
+        findings.sort(key=lambda f: (f.file, f.line, f.code))
+        return findings
+
+
+class _FnScan(ast.NodeVisitor):
+    """One function body's dimension findings."""
+
+    def __init__(self, checker: UnitsChecker, fn: FuncInfo,
+                 env: _Env) -> None:
+        self.c = checker
+        self.fn = fn
+        self.env = env
+        self.mod = checker.graph.modules[_module_name(fn.rel_path)]
+        self.findings: list[Finding] = []
+
+    def _emit(self, line: int, code: str, msg: str) -> None:
+        where = _short_fn(self.fn.qname)
+        self.findings.append(Finding(self.fn.rel_path, line, code,
+                                     f"{where} {msg}"))
+
+    def _dim(self, expr: ast.AST) -> Dim | None:
+        return self.c._expr_dim(expr, self.fn, self.env)
+
+    # -- TAU1002: mixed-timebase residue at flow boundaries ----------------
+
+    def _check_residue(self, expr: ast.AST) -> None:
+        dim = self._dim(expr)
+        if dim is not None and dim[1] != 0 and dim[2] != 0:
+            self._emit(
+                expr.lineno, "TAU1002",
+                f"carries the mixed-timebase dimension {_dim_str(dim)} "
+                f"— a per-hour rate crossed a seconds quantity without "
+                f"the /3600 conversion; use the blessed constructors "
+                f"(units.chip_seconds / units.usd)")
+
+    # -- statements --------------------------------------------------------
+
+    def _target_declared(self, tgt: ast.AST) -> Dim | None:
+        if isinstance(tgt, ast.Name):
+            return self.env.declared.get(tgt.id)
+        if isinstance(tgt, ast.Attribute):
+            base = self.c._expr_cls(tgt.value, self.fn, self.env)
+            if base is not None:
+                aa = self.c._attr_ann(base, tgt.attr)
+                if aa is not None:
+                    return self.c._ann_dim(*aa)
+        return None
+
+    def _check_binding(self, tgt: ast.AST, tdim: Dim | None,
+                       rhs: Dim | None, line: int,
+                       what: str = "assigns") -> None:
+        if tdim is not None and rhs is not None and tdim != rhs:
+            self._emit(line, "TAU1001",
+                       f"{what} a {_dim_str(rhs)} value to a target "
+                       f"declared {_dim_str(tdim)}")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_residue(node.value)
+        rhs = self._dim(node.value)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Tuple):
+                ra = self.c._call_ret_ann(node.value, self.fn, self.env)
+                if ra is not None:
+                    parts = self.c._tuple_anns(*ra)
+                    if parts and len(parts) == len(tgt.elts):
+                        for t, part in zip(tgt.elts, parts):
+                            self._check_binding(
+                                t, self._target_declared(t),
+                                self.c._ann_dim(part, ra[1]),
+                                node.value.lineno)
+                continue
+            self._check_binding(tgt, self._target_declared(tgt), rhs,
+                                node.value.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_residue(node.value)
+            tdim = self.c._ann_dim(node.annotation, self.mod)
+            self._check_binding(node.target, tdim,
+                                self._dim(node.value),
+                                node.value.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_residue(node.value)
+        if isinstance(node.op, (ast.Add, ast.Sub)):
+            tdim = self._target_declared(node.target)
+            rhs = self._dim(node.value)
+            if tdim is not None and rhs is not None and tdim != rhs:
+                self._emit(node.value.lineno, "TAU1001",
+                           f"accumulates {_dim_str(rhs)} into a target "
+                           f"declared {_dim_str(tdim)}")
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            self._check_residue(node.value)
+            ra = self.c._ret_ann.get(self.fn.qname)
+            if ra is not None:
+                self._check_binding(node.value,
+                                    self.c._ann_dim(*ra),
+                                    self._dim(node.value),
+                                    node.value.lineno, what="returns")
+        self.generic_visit(node)
+
+    # -- expressions -------------------------------------------------------
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, (ast.Add, ast.Sub)):
+            left = self._dim(node.left)
+            right = self._dim(node.right)
+            if left is not None and right is not None and left != right:
+                verb = "adds" if isinstance(node.op, ast.Add) \
+                    else "subtracts"
+                self._emit(node.lineno, "TAU1001",
+                           f"{verb} {_dim_str(right)} "
+                           f"{'to' if verb == 'adds' else 'from'} "
+                           f"{_dim_str(left)} — incompatible dimensions")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _budgetish(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func)
+            return d is not None \
+                and d.split(".")[-1] in _BUDGET_FUNCS
+        d = dotted_name(expr)
+        return d is not None and "budget" in d.split(".")[-1]
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        operands = [node.left, *node.comparators]
+        for op in operands:
+            self._check_residue(op)
+        known = [(op, dim) for op in operands
+                 if (dim := self._dim(op)) is not None]
+        dims = {dim for _op, dim in known}
+        if len(dims) > 1:
+            rendered = " vs ".join(sorted(_dim_str(d) for d in dims))
+            if any(self._budgetish(op) for op in operands):
+                self._emit(node.lineno, "TAU1004",
+                           f"budget guard compares across dimensions "
+                           f"({rendered}) — a budget and its spend "
+                           f"must share one currency")
+            else:
+                self._emit(node.lineno, "TAU1001",
+                           f"compares incompatible dimensions "
+                           f"({rendered})")
+        self.generic_visit(node)
+
+    # -- calls: metric escapes + argument contracts ------------------------
+
+    def _metric_name(self, arg: ast.AST) -> str | None:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.JoinedStr):
+            return _joinedstr_prefix(arg) or None
+        return None
+
+    def _check_metric(self, node: ast.Call) -> None:
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS and node.args):
+            return
+        name = self._metric_name(node.args[0])
+        if name is None:
+            return
+        value = node.args[1] if len(node.args) > 1 else next(
+            (kw.value for kw in node.keywords
+             if kw.arg in ("by", "value")), None)
+        if value is None:
+            return
+        dim = self._dim(value)
+        if dim is None:
+            return
+        for rule_dim, alias, needles in _SUFFIX_RULES:
+            if dim != rule_dim:
+                continue
+            ok = any(n in name for n in needles)
+            if alias == "Seconds" and "chip_seconds" in name:
+                ok = False                     # plain seconds fed to a
+            if not ok:                         # chip-seconds series
+                want = "/".join(f"'{n}'" for n in needles)
+                self._emit(
+                    value.lineno, "TAU1003",
+                    f"feeds a {alias}-dimensioned value to metric "
+                    f"'{name}', whose name lacks the {want} unit "
+                    f"suffix — rename the series or convert the value")
+            return                             # alias dims are disjoint
+
+    def _check_call_args(self, node: ast.Call) -> None:
+        target = self.c.graph.resolve_callable(node.func, self.fn,
+                                               self.env.types)
+        if target is None:
+            return
+        tmod = self.c.graph.modules[_module_name(target.rel_path)]
+        args = target.node.args
+        params = list(args.posonlyargs) + list(args.args)
+        if target.cls is not None and params \
+                and params[0].arg in ("self", "cls") \
+                and not isinstance(node.func, ast.Name):
+            params = params[1:]
+        by_name = {p.arg: p for p in params + list(args.kwonlyargs)}
+        is_budget = target.node.name in _BUDGET_FUNCS
+
+        def check(param: ast.arg, arg: ast.AST) -> None:
+            pdim = self.c._ann_dim(param.annotation, tmod)
+            adim = self._dim(arg)
+            if pdim is None or adim is None or pdim == adim:
+                return
+            budget = is_budget or "budget" in param.arg
+            self._emit(
+                arg.lineno,
+                "TAU1004" if budget else "TAU1001",
+                f"passes a {_dim_str(adim)} value for parameter "
+                f"'{param.arg}' of {_short_fn(target.qname)}, declared "
+                f"{_dim_str(pdim)}"
+                + (" — budget algebra must not mix currencies"
+                   if budget else ""))
+
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred) or i >= len(params):
+                break
+            check(params[i], arg)
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg in by_name:
+                check(by_name[kw.arg], kw.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        for arg in node.args:
+            if not isinstance(arg, ast.Starred):
+                self._check_residue(arg)
+        for kw in node.keywords:
+            self._check_residue(kw.value)
+        self._check_metric(node)
+        self._check_call_args(node)
+        self.generic_visit(node)

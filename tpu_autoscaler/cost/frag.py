@@ -27,6 +27,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Mapping
 
+from tpu_autoscaler.units import Chips, Fraction
+
 #: Component weights: stranded capacity is pure loss; displacement
 #: costs the tier delta; overprovisioning is recoverable only by a
 #: migration, so it weighs least (docs/COST.md "Fragmentation score").
@@ -40,11 +42,11 @@ class FragScore:
     """One pool's fragmentation verdict."""
 
     pool: str
-    chips: int
-    stranded_chips: int
-    displaced_chips: int
-    overprovisioned_chips: int
-    score: float                  # weighted fraction of the pool, [0,1]
+    chips: Chips
+    stranded_chips: Chips
+    displaced_chips: Chips
+    overprovisioned_chips: Chips
+    score: Fraction               # weighted fraction of the pool, [0,1]
 
 
 def score_pools(*, pool_chips: Mapping[str, int],

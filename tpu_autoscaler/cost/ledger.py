@@ -52,6 +52,7 @@ from tpu_autoscaler.topology.catalog import (
     TPU_RESOURCE,
     shape_from_selectors,
 )
+from tpu_autoscaler.units import Chips, ChipSeconds, Seconds, Usd, usd
 
 log = logging.getLogger(__name__)
 
@@ -65,7 +66,7 @@ SERVING_NAMESPACES = frozenset({"tpu-serving"})
 
 #: Terminal per-gang rollups are retained this long for reports, then
 #: folded into the state totals only (bounded state).
-GANG_RETENTION_SECONDS = 3600.0
+GANG_RETENTION_SECONDS: Seconds = 3600.0
 
 #: Accumulator-table key: str pools, (pool, shape) pairs, state combos.
 _K = TypeVar("_K")
@@ -77,17 +78,17 @@ class _Acc:
 
     __slots__ = ("chips", "since", "banked")
 
-    def __init__(self, t: float) -> None:
-        self.chips = 0
-        self.since = t
-        self.banked = 0.0
+    def __init__(self, t: Seconds) -> None:
+        self.chips: Chips = 0
+        self.since: Seconds = t
+        self.banked: ChipSeconds = 0.0
 
-    def adjust(self, delta_chips: int, t: float) -> None:
+    def adjust(self, delta_chips: Chips, t: Seconds) -> None:
         self.banked += self.chips * max(0.0, t - self.since)
         self.chips += delta_chips
         self.since = t
 
-    def total(self, t: float) -> float:
+    def total(self, t: Seconds) -> ChipSeconds:
         return self.banked + self.chips * max(0.0, t - self.since)
 
 
@@ -96,15 +97,15 @@ class _Unit:
     """Cached classification of one supply unit."""
 
     state: str
-    chips: int
+    chips: Chips
     pool: str
     accel: str
     tier: str
     shape: str | None
     gang_id: str | None        # dominant gang's epoch-rollup id
-    used_chips: int            # workload-requested chips (frag input)
-    entered_at: float          # current state entered (waste reads)
-    state_banked: float = 0.0  # chip-seconds in PRIOR same-state spans
+    used_chips: Chips          # workload-requested chips (frag input)
+    entered_at: Seconds        # current state entered (waste reads)
+    state_banked: ChipSeconds = 0.0  # prior same-state spans
 
 
 def classify_cost_state(slice_state: str, *, has_workload: bool,
@@ -138,7 +139,7 @@ class CostLedger:
     def __init__(self, price_book: PriceBook | None = None,
                  metrics: Any = None,
                  serving_namespaces: Iterable[str] = SERVING_NAMESPACES,
-                 stranded_after_seconds: float = 900.0) -> None:
+                 stranded_after_seconds: Seconds = 900.0) -> None:
         self.price_book = price_book or PriceBook()
         self._metrics = metrics
         self.serving_namespaces = frozenset(serving_namespaces)
@@ -167,10 +168,10 @@ class CostLedger:
         self._pool_chips: dict[str, int] = {}               # pool -> chips
         self._stranded_pool: dict[str, int] = {}            # pool -> chips
         # Export cursors (counters emit deltas per close).
-        self._exported_cs: dict[str, float] = {}
-        self._exported_usd = 0.0
-        self._exported_unpriced = 0.0
-        self._last_close: float | None = None
+        self._exported_cs: dict[str, ChipSeconds] = {}
+        self._exported_usd: Usd = 0.0
+        self._exported_unpriced: ChipSeconds = 0.0
+        self._last_close: Seconds | None = None
         self.pass_seq = 0
         self.conservation_violations = 0
         #: Last close's (attributed chips, fleet chips) — the chaos
@@ -190,7 +191,7 @@ class CostLedger:
     # -- classification inputs -------------------------------------------
 
     def _gang_rollup_id(self, key: tuple[str, str, str],
-                        uids: frozenset[str], now: float) -> str:
+                        uids: frozenset[str], now: Seconds) -> str:
         """Epoch-keyed rollup id for one gang incarnation.  A member
         set DISJOINT from the last seen one is a new incarnation (the
         restart-under-the-same-name case); overlapping sets merge —
@@ -211,10 +212,10 @@ class CostLedger:
 
     def note_unit(self, unit_id: str, unit_nodes: Sequence[Any],
                   unit_pods: Sequence[Any], slice_state: str,
-                  now: float, *, under_repair: bool = False,
+                  now: Seconds, *, under_repair: bool = False,
                   cancellable_drain: bool = False,
                   policy_hold: bool = False, spare: bool = False,
-                  first_seen: float | None = None) -> None:
+                  first_seen: Seconds | None = None) -> None:
         """Fold one unit's observation in.  O(1); a no-change
         observation is one tuple compare (the churn contract)."""
         if not unit_nodes or not unit_nodes[0].is_tpu:
@@ -293,18 +294,19 @@ class CostLedger:
         against its observed unit set every pass)."""
         return list(self._units)
 
-    def remove_unit(self, unit_id: str, now: float) -> None:
+    def remove_unit(self, unit_id: str, now: Seconds) -> None:
         """A unit's nodes are gone: its chips leave the fleet."""
         cached = self._units.pop(unit_id, None)
         self._meta.pop(unit_id, None)
         if cached is not None:
             self._retire(unit_id, cached, now)
 
-    def _retire(self, unit_id: str, unit: _Unit, now: float) -> None:
+    def _retire(self, unit_id: str, unit: _Unit,
+                now: Seconds) -> None:
         unit.state_banked += unit.chips * max(0.0, now - unit.entered_at)
         self._apply(unit, -1, now)
 
-    def _apply(self, unit: _Unit, sign: int, now: float) -> None:
+    def _apply(self, unit: _Unit, sign: int, now: Seconds) -> None:
         delta = sign * unit.chips
         self._acc(self._state, unit.state, now).adjust(delta, now)
         self._acc(self._combo, (unit.state, unit.accel, unit.tier),
@@ -338,7 +340,7 @@ class CostLedger:
                 + sign * (unit.chips - unit.used_chips))
 
     @staticmethod
-    def _acc(table: dict[_K, _Acc], key: _K, now: float) -> _Acc:
+    def _acc(table: dict[_K, _Acc], key: _K, now: Seconds) -> _Acc:
         acc = table.get(key)
         if acc is None:
             acc = table[key] = _Acc(now)
@@ -346,7 +348,8 @@ class CostLedger:
 
     # -- per-pass close ---------------------------------------------------
 
-    def close_pass(self, now: float, fleet_chips: int) -> dict[str, Any]:
+    def close_pass(self, now: Seconds,
+                   fleet_chips: Chips) -> dict[str, Any]:
         """Seal the pass: conservation check against the reconciler's
         INDEPENDENT fleet chip sum, metric export (deltas for the
         cumulative families, levels for the gauges), fragmentation
@@ -365,13 +368,13 @@ class CostLedger:
                 "cost ledger conservation broken: attributed %d chips "
                 "vs fleet %d", attributed, fleet_chips)
 
-        usd_total = 0.0
-        unpriced = 0.0
-        usd_per_hour = 0.0
+        usd_total: Usd = 0.0
+        unpriced: ChipSeconds = 0.0
+        usd_per_hour = 0.0     # $/hour: a rate, not an alias currency
         for (state, accel, tier), acc in self._combo.items():
             cs = acc.total(now)
             rate, priced = self.price_book.rate(accel, tier)
-            usd_total += cs * rate / 3600.0
+            usd_total += usd(rate, cs)
             usd_per_hour += acc.chips * rate
             if not priced:
                 unpriced += cs
@@ -450,13 +453,14 @@ class CostLedger:
 
     # -- reads ------------------------------------------------------------
 
-    def accrued_chip_seconds(self, unit_ids: Iterable[str], now: float,
-                             state: str | None = None) -> float | None:
+    def accrued_chip_seconds(self, unit_ids: Iterable[str],
+                             now: Seconds, state: str | None = None
+                             ) -> ChipSeconds | None:
         """Chip-seconds the named units accrued in their CURRENT state
         span (banked prior same-state spans included) — the policy
         waste budget's one source of truth.  None when no named unit
         is tracked (callers fall back to their own estimate)."""
-        total = 0.0
+        total: ChipSeconds = 0.0
         hit = False
         for unit_id in unit_ids:
             unit = self._units.get(unit_id)
@@ -468,7 +472,7 @@ class CostLedger:
                 0.0, now - unit.entered_at)
         return total if hit else None
 
-    def gang_attrs(self, gang_key: tuple[str, str, str], now: float
+    def gang_attrs(self, gang_key: tuple[str, str, str], now: Seconds
                    ) -> dict[str, float] | None:
         """Cost-to-serve attrs for a closing trace: the gang's CURRENT
         incarnation's attributed chip-seconds (None: never attributed
@@ -568,7 +572,8 @@ class CostLedger:
                            if v},
         }
 
-    def debug_state(self, now: float | None = None) -> dict[str, Any]:
+    def debug_state(self,
+                    now: Seconds | None = None) -> dict[str, Any]:
         """The ``/debugz/cost`` body and the incident bundle's ``cost``
         section: the full bill breakdown (docs/COST.md "The bill").
         Read from the /debugz thread while the reconcile thread

@@ -26,6 +26,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Mapping
 
+from tpu_autoscaler.units import UsdPerChipHour
+
 #: Known price tiers, cheapest-last (docs/COST.md).
 TIERS = ("on_demand", "reservation", "spot")
 
@@ -54,6 +56,17 @@ DEFAULT_TIER_FACTORS: dict[str, float] = {
 
 #: Fallback $/chip-hour for classes the book does not price.
 DEFAULT_CLASS_RATE = 2.0
+
+#: Plausibility band for configured $/chip-hour rates (ISSUE 16
+#: pricebook hardening).  Config is the one place the static TAU10xx
+#: pass cannot see, and the classic config slip is a rate written in
+#: the WRONG TIMEBASE: a $/chip-second entry is off by 3600x and lands
+#: far outside this band in either direction (1.20 $/chip-hour written
+#: as-per-second ~ 0.00033; 4.20 $/chip-hour pre-multiplied by 3600 ~
+#: 15120).  Zero stays legal (an explicitly free class is not a
+#: timebase bug).
+MIN_SANE_RATE = 0.01
+MAX_SANE_RATE = 100.0
 
 
 def tier_of_labels(labels: Mapping[str, str]) -> str:
@@ -95,7 +108,8 @@ class PriceBook:
         default_factory=lambda: dict(DEFAULT_TIER_FACTORS))
     default_rate: float = DEFAULT_CLASS_RATE
 
-    def rate(self, accel_class: str, tier: str) -> tuple[float, bool]:
+    def rate(self, accel_class: str,
+             tier: str) -> tuple[UsdPerChipHour, bool]:
         base = self.class_rates.get(accel_class)
         priced = base is not None
         if base is None:
@@ -126,10 +140,15 @@ class PriceBook:
             known_classes.add(shape.accelerator_type)
 
         class_rates = dict(_catalog_class_rates())
+        out_of_band: list[str] = []
         for key, value in dict(body.get("classes") or {}).items():
             rate = float(value)
             if rate < 0.0:
                 raise ValueError(f"negative rate for {key!r}")
+            if rate != 0.0 and not (MIN_SANE_RATE <= rate
+                                    <= MAX_SANE_RATE):
+                out_of_band.append(f"{key}={rate:g}")
+                continue
             if key in by_generation:
                 for accel in by_generation[key]:
                     class_rates[accel] = rate
@@ -146,6 +165,17 @@ class PriceBook:
                     f"unknown price tier {key!r} (known: "
                     f"{', '.join(TIERS)})")
             factors[key] = float(value)
+        default_rate = float(body.get("default_rate",
+                                      DEFAULT_CLASS_RATE))
+        if default_rate != 0.0 and not (MIN_SANE_RATE <= default_rate
+                                        <= MAX_SANE_RATE):
+            out_of_band.append(f"default_rate={default_rate:g}")
+        if out_of_band:
+            raise ValueError(
+                f"{len(out_of_band)} price-book rate(s) outside the "
+                f"[{MIN_SANE_RATE:g}, {MAX_SANE_RATE:g}] $/chip-hour "
+                f"plausibility band ({', '.join(sorted(out_of_band))})"
+                " — a rate this far out is almost always a timebase "
+                "slip (a $/chip-second value is off by 3600x)")
         return cls(class_rates=class_rates, tier_factors=factors,
-                   default_rate=float(body.get("default_rate",
-                                               DEFAULT_CLASS_RATE)))
+                   default_rate=default_rate)

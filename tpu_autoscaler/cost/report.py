@@ -15,9 +15,10 @@ from __future__ import annotations
 from typing import Any, Mapping
 
 from tpu_autoscaler.cost.ledger import STATES
+from tpu_autoscaler.units import ChipSeconds, Seconds
 
 
-def _fmt_cs(cs: float) -> str:
+def _fmt_cs(cs: ChipSeconds) -> str:
     if cs >= 3600.0:
         return f"{cs / 3600.0:.1f} chip-h"
     return f"{cs:.0f} chip-s"
@@ -158,7 +159,7 @@ def render_frag(cost: Mapping[str, Any]) -> str:
 
 
 def windowed_bill(tsdb_dump: Mapping[str, Any],
-                  window_seconds: float) -> dict[str, Any]:
+                  window_seconds: Seconds) -> dict[str, Any]:
     """A by-state bill over the trailing ``window_seconds`` of TSDB
     history: deltas of the cumulative ``cost_chip_seconds_<state>``
     and ``cost_dollar_proxy_total`` series — works on any bundle that

@@ -53,6 +53,7 @@ from tpu_autoscaler.policy.slo import (
     idle_threshold_for,
     rolling_waste,
 )
+from tpu_autoscaler.units import ChipSeconds, Seconds
 
 log = logging.getLogger(__name__)
 
@@ -72,12 +73,12 @@ class PolicyConfig:
     use_holt_winters: bool = True
     use_recurring: bool = True
     ewma_alpha: float = 0.3
-    hw_bin_seconds: float = 300.0
+    hw_bin_seconds: Seconds = 300.0
     hw_season_bins: int = 24
     recurring_max_cv: float = 0.25
     # Terminal (consumed/expired) prewarm records are kept this long
     # for /debugz introspection, then dropped (bounded state).
-    retention_seconds: float = 3600.0
+    retention_seconds: Seconds = 3600.0
 
 
 @dataclasses.dataclass
@@ -87,7 +88,7 @@ class PolicyAdvice:
     advisory: list[tuple[Gang, str]] = dataclasses.field(
         default_factory=list)
     hold_units: set[str] = dataclasses.field(default_factory=set)
-    idle_overrides: dict[str, float] = dataclasses.field(
+    idle_overrides: dict[str, Seconds] = dataclasses.field(
         default_factory=dict)
     rejections: list[str] = dataclasses.field(default_factory=list)
     decisions: list[PrewarmDecision] = dataclasses.field(
@@ -101,15 +102,15 @@ class _Prewarm:
 
     decision: PrewarmDecision
     gang: Gang
-    created_at: float
+    created_at: Seconds
     provision_id: str | None = None
-    submitted_at: float | None = None
-    ready_at: float | None = None
+    submitted_at: Seconds | None = None
+    ready_at: Seconds | None = None
     unit_ids: tuple[str, ...] = ()
     covered_unit: str | None = None     # pre-existing free slice
     consumed_by: GangKey | None = None
-    consumed_at: float | None = None
-    expired_at: float | None = None
+    consumed_at: Seconds | None = None
+    expired_at: Seconds | None = None
 
     @property
     def key(self) -> str:
@@ -184,12 +185,12 @@ class PolicyEngine:
         self._seen_pending: set[GangKey] = set()
         # Per-class nearest active prediction, for forecast error:
         # class -> (predicted_at, forecast key).
-        self._pending_prediction: dict[str, tuple[float, str]] = {}
+        self._pending_prediction: dict[str, tuple[Seconds, str]] = {}
         # Rolling realized-waste events: (t, chip_seconds).
-        self._waste_events: list[tuple[float, float]] = []
+        self._waste_events: list[tuple[Seconds, ChipSeconds]] = []
         # Measured provision durations (prewarms the engine itself
         # timed), EWMA-folded over the configured estimate.
-        self._provision_estimate: float | None = None
+        self._provision_estimate: Seconds | None = None
         self._hits = 0
         self._expired = 0
 
@@ -234,14 +235,14 @@ class PolicyEngine:
         if self._metrics is not None:
             self._metrics.set_gauge(name, value)
 
-    def provision_estimate(self) -> float:
+    def provision_estimate(self) -> Seconds:
         """Reactive provision latency estimate: measured (EWMA over
         provisions the engine timed) when available, else configured."""
         if self._provision_estimate is not None:
             return self._provision_estimate
         return self.config.slo.provision_estimate_seconds
 
-    def _note_provision_duration(self, seconds: float) -> None:
+    def _note_provision_duration(self, seconds: Seconds) -> None:
         if seconds <= 0.0:
             return
         if self._provision_estimate is None:
@@ -273,7 +274,7 @@ class PolicyEngine:
 
     def observe(self, gangs: Sequence[Gang], nodes: Sequence[Node],
                 pods: Sequence[Pod], statuses: Sequence[Any],
-                now: float,
+                now: Seconds,
                 gang_traces: Mapping[GangKey, Any] | None = None
                 ) -> None:
         """Feed one pass's world into the forecasters and advance every
@@ -399,7 +400,7 @@ class PolicyEngine:
         if total:
             self.set_gauge("prewarm_hit_rate", self._hits / total)
 
-    def _consume(self, pw: _Prewarm, consumer: GangKey, now: float,
+    def _consume(self, pw: _Prewarm, consumer: GangKey, now: Seconds,
                  gang_traces: Mapping[GangKey, Any] | None) -> None:
         pw.consumed_by = consumer
         pw.consumed_at = now
@@ -448,7 +449,7 @@ class PolicyEngine:
 
     # -- advise side ------------------------------------------------------
 
-    def forecasts(self, now: float) -> list[Forecast]:
+    def forecasts(self, now: Seconds) -> list[Forecast]:
         cfg = self.config
         streams: list[list[Forecast]] = []
         if cfg.use_recurring:
@@ -476,7 +477,7 @@ class PolicyEngine:
         return out
 
     def advise(self, nodes: Sequence[Node], pods: Sequence[Pod],
-               now: float, *, base_idle_threshold: float
+               now: Seconds, *, base_idle_threshold: Seconds
                ) -> PolicyAdvice:
         """Turn the current forecast set into this pass's advice."""
         cfg = self.config

@@ -42,6 +42,8 @@ import re
 from collections import deque
 from typing import Any, Iterable
 
+from tpu_autoscaler.units import Chips, Fraction, Seconds
+
 #: Trailing run counters stripped to find a recurring gang's identity:
 #: ``nightly-train-17`` and ``nightly-train-18`` are the same job.
 _RUN_SUFFIX = re.compile(r"[-_]?\d+$")
@@ -67,9 +69,9 @@ class Forecast:
 
     accel_class: str        # gke-tpu-accelerator value the demand needs
     shape_name: str | None  # exact catalog shape (recurring model only)
-    at: float               # predicted arrival time (same clock as input)
-    chips: int              # predicted chip demand
-    confidence: float       # 0..1, honest (see per-model docstrings)
+    at: Seconds             # predicted arrival time (same clock as input)
+    chips: Chips            # predicted chip demand
+    confidence: Fraction    # 0..1, honest (see per-model docstrings)
     source: str             # "ewma" | "holt_winters" | "recurring"
     key: str                # stable dedup identity
 

@@ -38,6 +38,13 @@ import dataclasses
 from typing import Mapping
 
 from tpu_autoscaler.policy.forecast import Forecast
+from tpu_autoscaler.units import (
+    Chips,
+    ChipSeconds,
+    Fraction,
+    Seconds,
+    chip_seconds,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,32 +54,32 @@ class SloPolicy:
     # Target detect->Running latency per accelerator class; classes
     # absent from the map use the default.  A class whose reactive
     # latency already meets target is never prewarmed.
-    target_scaleup_seconds: float = 120.0
-    class_targets: Mapping[str, float] = dataclasses.field(
+    target_scaleup_seconds: Seconds = 120.0
+    class_targets: Mapping[str, Seconds] = dataclasses.field(
         default_factory=dict)
     # Forecasts below this confidence emit NO advisory demand.
-    min_confidence: float = 0.6
+    min_confidence: Fraction = 0.6
     # Reactive provision estimate used until the controller has
     # measured provision_latency_seconds itself.
-    provision_estimate_seconds: float = 240.0
+    provision_estimate_seconds: Seconds = 240.0
     # Fire a prewarm this long BEFORE provisioning must start, so a
     # slightly-early arrival still finds the slice Ready.
-    lead_slack_seconds: float = 60.0
+    lead_slack_seconds: Seconds = 60.0
     # How long past the predicted arrival a prewarmed slice is held
     # before it is declared a misprediction and released to reclaim.
-    prewarm_hold_seconds: float = 600.0
+    prewarm_hold_seconds: Seconds = 600.0
     # Rolling wasted-chip-seconds budget: expected waste of decided
     # prewarms plus realized waste of expired ones, per window.
-    waste_budget_chip_seconds: float = 120_000.0
-    waste_window_seconds: float = 3600.0
+    waste_budget_chip_seconds: ChipSeconds = 120_000.0
+    waste_window_seconds: Seconds = 3600.0
     # Scale-down tradeoff bounds (see idle_threshold_for).
-    idle_floor_seconds: float = 120.0
-    idle_ceiling_seconds: float = 7200.0
+    idle_floor_seconds: Seconds = 120.0
+    idle_ceiling_seconds: Seconds = 7200.0
     early_reclaim: bool = True
     # At most this many concurrent un-consumed prewarms fleet-wide.
     max_concurrent_prewarms: int = 4
 
-    def target_for(self, accel_class: str) -> float:
+    def target_for(self, accel_class: str) -> Seconds:
         return self.class_targets.get(accel_class,
                                       self.target_scaleup_seconds)
 
@@ -85,28 +92,28 @@ class PrewarmDecision:
     key: str                # the forecast's dedup identity
     shape_name: str
     accel_class: str
-    chips: int
-    predicted_at: float
-    confidence: float
-    expected_waste_chip_seconds: float
+    chips: Chips
+    predicted_at: Seconds
+    confidence: Fraction
+    expected_waste_chip_seconds: ChipSeconds
     reason: str
 
 
-def fire_at(forecast: Forecast, provision_estimate: float,
-            policy: SloPolicy) -> float:
+def fire_at(forecast: Forecast, provision_estimate: Seconds,
+            policy: SloPolicy) -> Seconds:
     """When provisioning must start for the slice to be Ready on
     arrival."""
     return forecast.at - provision_estimate - policy.lead_slack_seconds
 
 
-def expires_at(predicted_at: float, policy: SloPolicy) -> float:
+def expires_at(predicted_at: Seconds, policy: SloPolicy) -> Seconds:
     """When an unconsumed prewarm becomes a misprediction."""
     return predicted_at + policy.prewarm_hold_seconds
 
 
-def decide_prewarms(forecasts: list[Forecast], now: float, *,
-                    policy: SloPolicy, provision_estimate: float,
-                    waste_spent_chip_seconds: float,
+def decide_prewarms(forecasts: list[Forecast], now: Seconds, *,
+                    policy: SloPolicy, provision_estimate: Seconds,
+                    waste_spent_chip_seconds: ChipSeconds,
                     active_prewarms: int,
                     active_keys: frozenset[str] = frozenset(),
                     ) -> tuple[list[PrewarmDecision], list[str]]:
@@ -147,7 +154,8 @@ def decide_prewarms(forecasts: list[Forecast], now: float, *,
             continue
         hold = (expires_at(f.at, policy)
                 - max(now, fire_at(f, provision_estimate, policy)))
-        expected_waste = f.chips * hold * (1.0 - f.confidence)
+        expected_waste = (chip_seconds(f.chips, hold)
+                          * (1.0 - f.confidence))
         if committed + expected_waste > budget:
             rejections.append(
                 f"{f.key}: expected waste {expected_waste:.0f} "
@@ -173,9 +181,10 @@ def decide_prewarms(forecasts: list[Forecast], now: float, *,
     return decisions, rejections
 
 
-def rolling_waste(events: list[tuple[float, float]], now: float,
-                  window_seconds: float
-                  ) -> tuple[list[tuple[float, float]], float]:
+def rolling_waste(events: list[tuple[Seconds, ChipSeconds]],
+                  now: Seconds, window_seconds: Seconds
+                  ) -> tuple[list[tuple[Seconds, ChipSeconds]],
+                             ChipSeconds]:
     """Trim the realized-waste event series to the rolling window and
     sum what remains: ``(kept_events, realized_chip_seconds)``.
 
@@ -189,9 +198,11 @@ def rolling_waste(events: list[tuple[float, float]], now: float,
     return kept, sum(w for _t, w in kept)
 
 
-def budget_remaining(events: list[tuple[float, float]], now: float,
-                     window_seconds: float, budget_chip_seconds: float
-                     ) -> tuple[list[tuple[float, float]], float, float]:
+def budget_remaining(events: list[tuple[Seconds, ChipSeconds]],
+                     now: Seconds, window_seconds: Seconds,
+                     budget_chip_seconds: ChipSeconds
+                     ) -> tuple[list[tuple[Seconds, ChipSeconds]],
+                                ChipSeconds, ChipSeconds]:
     """``rolling_waste`` plus the verdict: ``(kept_events, spent,
     remaining)`` against a rolling chip-seconds budget.
 
@@ -204,11 +215,11 @@ def budget_remaining(events: list[tuple[float, float]], now: float,
     return kept, spent, max(0.0, budget_chip_seconds - spent)
 
 
-def idle_threshold_for(accel_class: str, now: float, *,
-                       policy: SloPolicy, base_threshold: float,
-                       provision_estimate: float,
-                       next_arrival_at: float | None,
-                       confidence: float) -> float:
+def idle_threshold_for(accel_class: str, now: Seconds, *,
+                       policy: SloPolicy, base_threshold: Seconds,
+                       provision_estimate: Seconds,
+                       next_arrival_at: Seconds | None,
+                       confidence: Fraction) -> Seconds:
     """Effective idle threshold for an idle unit of ``accel_class`` —
     the fixed-threshold scale-down turned into an SLO/cost tradeoff.
 

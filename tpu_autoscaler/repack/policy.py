@@ -50,6 +50,17 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Mapping, Sequence
 
+from tpu_autoscaler.units import (
+    Chips,
+    ChipSeconds,
+    Fraction,
+    Seconds,
+    Usd,
+    UsdPerChipHour,
+    chip_seconds,
+    usd,
+)
+
 #: Migration kinds, in candidate-ranking order (displacement first:
 #: same chips for a fraction of the price beats freeing chips that
 #: must be re-provisioned elsewhere to matter).
@@ -65,34 +76,34 @@ class RepackConfig:
     max_concurrent_migrations: int = 1
     # Admission bar: projected savings must exceed projected cost by
     # this factor (headroom for drain overruns and landing slop).
-    min_savings_ratio: float = 2.0
+    min_savings_ratio: Fraction = 2.0
     # In-flight abort bar: the migration aborts the moment projected
     # total cost x this ratio exceeds projected savings.  1.0 = abort
     # exactly when the move stops paying.
-    abort_savings_ratio: float = 1.0
+    abort_savings_ratio: Fraction = 1.0
     # Horizon the savings rate is projected over.  A gang that leaves
     # sooner realizes less than projected — the min_savings_ratio
     # margin and the never-worse bench gate absorb that.
-    savings_horizon_seconds: float = 3600.0
+    savings_horizon_seconds: Seconds = 3600.0
     # Rolling migration-cost budget: committed projected costs of
     # in-flight migrations plus realized costs of closed ones, per
     # window (the PR 8 waste-budget shape; policy/slo.py).
-    budget_chip_seconds: float = 50_000.0
-    budget_window_seconds: float = 3600.0
+    budget_chip_seconds: ChipSeconds = 50_000.0
+    budget_window_seconds: Seconds = 3600.0
     # Cost-estimate terms: how long the source burns in the repair
     # state, and the replacement provision estimate (rightsize only).
-    drain_estimate_seconds: float = 120.0
-    provision_estimate_seconds: float = 240.0
+    drain_estimate_seconds: Seconds = 120.0
+    provision_estimate_seconds: Seconds = 240.0
     # A unit must have been busy this long before it is a candidate —
     # migrating a gang that just landed is thrash, not savings.
-    min_dwell_seconds: float = 600.0
+    min_dwell_seconds: Seconds = 600.0
     # After any migration (completed, aborted or abandoned) touches a
     # gang, that gang is left alone this long.
-    gang_cooldown_seconds: float = 1800.0
+    gang_cooldown_seconds: Seconds = 1800.0
     # Serving pools below this SLO attainment are never migrated —
     # a burning pool needs its replicas where they are
     # (serving/adapter.py ``burning_pools``).
-    slo_attainment_floor: float = 0.95
+    slo_attainment_floor: Fraction = 0.95
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,10 +115,10 @@ class UnitRow:
     accel: str
     tier: str
     shape: str | None
-    chips: int
-    used_chips: int
+    chips: Chips
+    used_chips: Chips
     state: str                 # "serving" | "training"
-    since: float               # current busy span entered
+    since: Seconds             # current busy span entered
     gang_id: str | None
 
 
@@ -123,30 +134,31 @@ class MigrationPlan:
     tier: str
     shape: str                 # source shape
     target_shape: str
-    chips: int                 # source unit chips
-    target_chips: int
-    rate_src: float            # $/chip-hour at the source
-    rate_dst: float            # $/chip-hour projected at the target
+    chips: Chips               # source unit chips
+    target_chips: Chips
+    rate_src: UsdPerChipHour   # at the source
+    rate_dst: UsdPerChipHour   # projected at the target
     freed_cs_per_s: float      # chip-second-equivalents saved per second
     saved_usd_per_s: float
-    projected_cost_cs: float
-    projected_saving_cs: float
+    projected_cost_cs: ChipSeconds
+    projected_saving_cs: ChipSeconds
     reason: str
 
 
-def projected_cost_cs(kind: str, chips: int, target_chips: int,
-                      cfg: RepackConfig) -> float:
+def projected_cost_cs(kind: str, chips: Chips, target_chips: Chips,
+                      cfg: RepackConfig) -> ChipSeconds:
     """Chip-seconds a migration is expected to burn: the source holds
     ``chips`` through the drain; a rightsize also pays the
     replacement's provisioning chip-seconds."""
-    cost = chips * cfg.drain_estimate_seconds
+    cost = chip_seconds(chips, cfg.drain_estimate_seconds)
     if kind == "rightsize":
-        cost += target_chips * cfg.provision_estimate_seconds
+        cost += chip_seconds(target_chips,
+                             cfg.provision_estimate_seconds)
     return cost
 
 
-def saving_rate(kind: str, chips: int, target_chips: int,
-                rate_src: float, rate_dst: float
+def saving_rate(kind: str, chips: Chips, target_chips: Chips,
+                rate_src: UsdPerChipHour, rate_dst: UsdPerChipHour
                 ) -> tuple[float, float]:
     """(chip-second-equivalents per second, $ per second) a completed
     migration saves.  Displacement keeps the chips and drops the rate
@@ -163,10 +175,10 @@ def saving_rate(kind: str, chips: int, target_chips: int,
 
 def plan_candidates(rows: Sequence[UnitRow],
                     idle_spot_chips: Mapping[str, int],
-                    rate: Callable[[str, str], float],
-                    now: float, cfg: RepackConfig, *,
+                    rate: Callable[[str, str], UsdPerChipHour],
+                    now: Seconds, cfg: RepackConfig, *,
                     active_migrations: int,
-                    budget_remaining_cs: float,
+                    budget_remaining_cs: ChipSeconds,
                     excluded: frozenset[str] = frozenset(),
                     burning_pools: frozenset[str] = frozenset(),
                     rightsize_targets: Mapping[str, tuple[str, int]]
@@ -187,7 +199,7 @@ def plan_candidates(rows: Sequence[UnitRow],
     rejections: list[str] = []
     rightsize_targets = rightsize_targets or {}
     slots = cfg.max_concurrent_migrations - active_migrations
-    committed = 0.0
+    committed: ChipSeconds = 0.0
     # Idle spot is consumed as displacements are planned: two same-
     # shape candidates must not both count the one idle slice.
     spot_left = dict(idle_spot_chips)
@@ -211,15 +223,15 @@ def plan_candidates(rows: Sequence[UnitRow],
         rate_spot = rate(row.accel, "spot")
         if row.tier != "spot" and rate_src > rate_spot \
                 and spot_left.get(row.shape, 0) >= row.chips:
-            freed, usd = saving_rate("displace", row.chips, row.chips,
-                                     rate_src, rate_spot)
+            freed, _ = saving_rate("displace", row.chips, row.chips,
+                                   rate_src, rate_spot)
             candidates.append((freed, row, "displace", row.shape,
                                row.chips, rate_src, rate_spot))
             continue
         target = rightsize_targets.get(row.unit_id)
         if target is not None and target[1] < row.chips:
-            freed, usd = saving_rate("rightsize", row.chips, target[1],
-                                     rate_src, rate_src)
+            freed, _ = saving_rate("rightsize", row.chips, target[1],
+                                   rate_src, rate_src)
             candidates.append((freed, row, "rightsize", target[0],
                                target[1], rate_src, rate_src))
 
@@ -278,7 +290,7 @@ def plan_candidates(rows: Sequence[UnitRow],
 
 
 def should_abort(plan: MigrationPlan, cfg: RepackConfig, *,
-                 realized_cost_cs: float, elapsed: float,
+                 realized_cost_cs: ChipSeconds, elapsed: Seconds,
                  destination_available: bool,
                  provision_pending: bool) -> str | None:
     """The in-flight budget guard: one stateless verdict per pass.
@@ -309,8 +321,9 @@ def should_abort(plan: MigrationPlan, cfg: RepackConfig, *,
 
 
 def realized_attribution(plan: MigrationPlan, cfg: RepackConfig, *,
-                         realized_cost_cs: float,
-                         landed_rate: float | None) -> dict[str, float]:
+                         realized_cost_cs: ChipSeconds,
+                         landed_rate: UsdPerChipHour | None
+                         ) -> dict[str, float]:
     """The closing trace's bill: chip-seconds-saved / $-proxy-saved,
     net of the realized migration cost, computed against the tier the
     gang ACTUALLY landed on (``landed_rate``; None = the projected
@@ -320,7 +333,7 @@ def realized_attribution(plan: MigrationPlan, cfg: RepackConfig, *,
                                    plan.target_chips, plan.rate_src,
                                    rate_dst)
     horizon = cfg.savings_horizon_seconds
-    cost_usd = realized_cost_cs * plan.rate_src / 3600.0
+    cost_usd: Usd = usd(plan.rate_src, realized_cost_cs)
     return {
         "chip_seconds_saved": round(freed * horizon
                                     - realized_cost_cs, 3),

@@ -37,6 +37,7 @@ from tpu_autoscaler.repack.policy import (
     realized_attribution,
     should_abort,
 )
+from tpu_autoscaler.units import ChipSeconds, Seconds, UsdPerChipHour
 
 log = logging.getLogger(__name__)
 
@@ -56,9 +57,9 @@ class Repacker:
         # costs are charged at decision time and trued up at close —
         # a string of expensive migrations exhausts the window and the
         # repacker self-mutes, exactly like the prewarm budget.
-        self._budget_events: list[tuple[float, float]] = []
+        self._budget_events: list[tuple[Seconds, ChipSeconds]] = []
         # gang key -> cooldown expiry.
-        self._cooldowns: dict[tuple, float] = {}
+        self._cooldowns: dict[tuple, Seconds] = {}
         self._last_rejections: list[str] = []
         self.recent: collections.deque[dict[str, Any]] = \
             collections.deque(maxlen=RECENT_CLOSES)
@@ -83,12 +84,12 @@ class Repacker:
 
     # -- rates ------------------------------------------------------------
 
-    def rate(self, accel: str, tier: str) -> float:
+    def rate(self, accel: str, tier: str) -> UsdPerChipHour:
         return self.price_book.rate(accel, tier)[0]
 
     # -- the per-pass entry points ----------------------------------------
 
-    def settle(self, now: float) -> float:
+    def settle(self, now: Seconds) -> ChipSeconds:
         """Trim the rolling budget window and export its gauge; returns
         the remaining budget.  Called every repack pass (advise may be
         skipped when the fleet has no candidates — the gauge must not
@@ -101,7 +102,7 @@ class Repacker:
         return remaining
 
     def advise(self, rows: Sequence[UnitRow],
-               idle_spot_chips: Mapping[str, int], now: float, *,
+               idle_spot_chips: Mapping[str, int], now: Seconds, *,
                active_migrations: int,
                excluded: Iterable[str] = (),
                burning_pools: Iterable[str] = (),
@@ -124,12 +125,13 @@ class Repacker:
         self.set_gauge("repack_candidates", len(plans) + len(rejections))
         return plans
 
-    def gang_cooled(self, keys: Iterable[tuple], now: float) -> bool:
+    def gang_cooled(self, keys: Iterable[tuple],
+                    now: Seconds) -> bool:
         """True while ANY of the gang keys is inside its cooldown."""
         return any(now < self._cooldowns.get(k, 0.0) for k in keys)
 
-    def guard(self, plan: MigrationPlan, now: float, *,
-              started: float, realized_cost_cs: float,
+    def guard(self, plan: MigrationPlan, now: Seconds, *,
+              started: Seconds, realized_cost_cs: ChipSeconds,
               destination_available: bool,
               provision_pending: bool) -> str | None:
         """In-flight verdict for one migration (None = keep going)."""
@@ -142,7 +144,8 @@ class Repacker:
     # -- lifecycle notes (called by the Reconciler) ------------------------
 
     def note_started(self, plan: MigrationPlan,
-                     gang_keys: Sequence[tuple], now: float) -> None:
+                     gang_keys: Sequence[tuple],
+                     now: Seconds) -> None:
         # Commit the projected cost against the rolling window NOW —
         # waiting for the close would let a burst of decisions in one
         # pass all see the un-charged budget (the prewarm lesson).
@@ -152,8 +155,9 @@ class Repacker:
         self.totals["started"] += 1
         self._inc("repack_migrations_started")
 
-    def _true_up(self, plan: MigrationPlan, realized_cost_cs: float,
-                 now: float) -> None:
+    def _true_up(self, plan: MigrationPlan,
+                 realized_cost_cs: ChipSeconds,
+                 now: Seconds) -> None:
         """Replace the committed projection with the realized cost (the
         projection was charged at start; drop it, charge reality)."""
         for i in range(len(self._budget_events) - 1, -1, -1):
@@ -165,9 +169,10 @@ class Repacker:
         self._inc("repack_migration_cost_chip_seconds",
                   realized_cost_cs)
 
-    def note_completed(self, plan: MigrationPlan, now: float, *,
-                       realized_cost_cs: float,
-                       landed_rate: float | None) -> dict[str, float]:
+    def note_completed(self, plan: MigrationPlan, now: Seconds, *,
+                       realized_cost_cs: ChipSeconds,
+                       landed_rate: UsdPerChipHour | None
+                       ) -> dict[str, float]:
         """Close the books on a completed migration; returns the
         attribution dict stamped on the closing ``repack`` trace."""
         attrs = realized_attribution(
@@ -197,8 +202,8 @@ class Repacker:
                             "outcome": "completed", "t": now, **attrs})
         return attrs
 
-    def note_closed(self, plan: MigrationPlan, now: float, *,
-                    outcome: str, realized_cost_cs: float,
+    def note_closed(self, plan: MigrationPlan, now: Seconds, *,
+                    outcome: str, realized_cost_cs: ChipSeconds,
                     reason: str = "") -> None:
         """An aborted or abandoned migration: realized cost is real
         money, savings are zero — the net gauge carries the hit (the

@@ -50,6 +50,7 @@ from tpu_autoscaler.serving.adapter import (
     PoolSignal,
     ServingMetricsAdapter,
 )
+from tpu_autoscaler.units import Fraction, Seconds
 
 log = logging.getLogger(__name__)
 
@@ -62,31 +63,31 @@ SERVING_NAMESPACE = "tpu-serving"
 class ServingPolicy:
     """Scaler tuning (docs/SERVING.md "Autoscaler integration")."""
 
-    target_utilization: float = 0.75     # active / slots to aim for
+    target_utilization: Fraction = 0.75  # active / slots to aim for
     # Scale-in deadband: surplus exists only above the fleet size that
     # would still sit BELOW this utilization (a wide gap between the
     # scale-out and scale-in targets is what stops thrash — a drained
     # replica's queue re-routes onto the rest, which must not
     # immediately re-trigger scale-out).
-    scalein_utilization: float = 0.45
+    scalein_utilization: Fraction = 0.45
     #: Per-decision scale-in cap as a fleet fraction denominator
     #: (drain at most replicas // this per decision).
     scalein_step_div: int = 8
-    slo_attainment_target: float = 0.98  # below this, add headroom
+    slo_attainment_target: Fraction = 0.98  # below this, add headroom
     slo_bump_replicas: int = 1           # replicas added per SLO miss
     min_replicas: int = 0
     max_replicas: int = 256
     # Scale-out record lifecycle.
-    scaleout_hold_seconds: float = 300.0   # unprovisioned record TTL
-    replica_grace_seconds: float = 60.0    # ACTIVE -> replica joined
+    scaleout_hold_seconds: Seconds = 300.0  # unprovisioned record TTL
+    replica_grace_seconds: Seconds = 60.0   # ACTIVE -> replica joined
     # Scale-in hysteresis: surplus must persist this long.
-    scalein_hold_seconds: float = 180.0
+    scalein_hold_seconds: Seconds = 180.0
     # Live-series forecasting (PR 8 Holt-Winters over demand samples).
     forecast: bool = True
-    min_confidence: float = 0.6
-    provision_estimate_seconds: float = 150.0
-    sample_seconds: float = 30.0         # demand-series sample period
-    hw_bin_seconds: float = 60.0
+    min_confidence: Fraction = 0.6
+    provision_estimate_seconds: Seconds = 150.0
+    sample_seconds: Seconds = 30.0       # demand-series sample period
+    hw_bin_seconds: Seconds = 60.0
     hw_season_bins: int = 24
 
 
@@ -110,11 +111,11 @@ class _ScaleOut:
     gang: Gang
     pool: str
     shape_name: str
-    created_at: float
+    created_at: Seconds
     provision_id: str | None = None
-    active_at: float | None = None
+    active_at: Seconds | None = None
 
-    def expired(self, now: float, policy: ServingPolicy) -> bool:
+    def expired(self, now: Seconds, policy: ServingPolicy) -> bool:
         if self.active_at is not None:
             return now - self.active_at > policy.replica_grace_seconds
         return now - self.created_at > policy.scaleout_hold_seconds
@@ -131,7 +132,7 @@ class ServingScaler:
         self._tracer: Any = None
         self._seq = 0
         self._scaleouts: dict[tuple, _ScaleOut] = {}
-        self._surplus_since: dict[str, float] = {}
+        self._surplus_since: dict[str, Seconds] = {}
         # Pool replica census as of the last pass: a rise retires the
         # oldest scale-out records (they were satisfied — whether by a
         # provision or by the planner adopting a free slice).
@@ -139,7 +140,7 @@ class ServingScaler:
         self._hw = HoltWintersForecaster(
             bin_seconds=self.policy.hw_bin_seconds,
             season_bins=self.policy.hw_season_bins)
-        self._last_sample: dict[str, float] = {}
+        self._last_sample: dict[str, Seconds] = {}
 
     def bind(self, metrics: Any = None, tracer: Any = None) -> None:
         """Adopt the controller's registries (Controller calls this)."""
@@ -187,7 +188,7 @@ class ServingScaler:
             need += self.policy.slo_bump_replicas
         return need
 
-    def _forecast_target(self, sig: PoolSignal, now: float) -> int:
+    def _forecast_target(self, sig: PoolSignal, now: Seconds) -> int:
         """Predicted near-term demand (Holt-Winters over the live
         backlog series) converted to replicas; 0 when silent or
         unconfident."""
@@ -240,7 +241,7 @@ class ServingScaler:
 
     # -- the pass ---------------------------------------------------------
 
-    def advise(self, statuses: Sequence[Any], now: float,
+    def advise(self, statuses: Sequence[Any], now: Seconds,
                signals: Mapping[str, PoolSignal] | None = None
                ) -> ServingAdvice:
         """One pass: fold the adapter, advance scale-out lifecycles off
@@ -406,7 +407,7 @@ class ServingScaler:
         return advice
 
     def _record_scaleout_trace(self, so: _ScaleOut,
-                               now: float) -> None:
+                               now: Seconds) -> None:
         """A satisfied scale-out record closes as a retroactive
         ``scaleup-*`` trace (ISSUE 14): root ``scale_up`` span
         decided→replica-joined, a ``provision`` child when an actual

@@ -1,0 +1,84 @@
+"""Dimensioned-quantity aliases for the cost algebra (ISSUE 16).
+
+The control plane's money-denominated guards — the ledger's seven-state
+conservation identity, repack's never-costs-more-than-it-saves budget,
+the prewarm waste self-mute — all compute in four base units: chips,
+seconds, chip-seconds and dollars, with one rate ($/chip-hour) joining
+them.  A silent chips-vs-chip-seconds or per-hour-vs-per-second slip
+corrupts exactly those guards, so every accumulator, budget window and
+rate in the cost spine declares its dimension with one of the aliases
+below, and the whole-program TAU10xx checker (analysis/units.py) audits
+the algebra statically.
+
+Why ``Annotated`` type aliases rather than ``NewType``: the aliases
+must be *zero* runtime cost (no wrapper calls in the reconcile hot
+path) and *transparent* to mypy's strict islands — a ``NewType`` would
+turn every legitimate arithmetic reassignment (``usd_total += cs *
+rate / 3600.0``) into a type error, forcing casts that hide exactly
+the crossings the checker wants to see.  ``Annotated[float, "..."]``
+erases to ``float`` for mypy and the interpreter alike; the TAU
+checker reads the alias *names* from the AST and runs its own
+dimension algebra over them.
+
+The dimension lattice (exponents over chip ``c``, second ``s``, hour
+``H``, dollar ``u``)::
+
+    Chips          c
+    Seconds        s
+    ChipSeconds    c.s
+    UsdPerChipHour u.c^-1.H^-1
+    Usd            u
+    Fraction       1          (known-dimensionless; ratios, confidences)
+
+Multiplication adds exponent vectors, division subtracts them, and the
+literal ``3600`` (or :data:`SECONDS_PER_HOUR`) carries ``s.H^-1`` —
+so ``cs * rate / 3600.0`` lands on ``u`` exactly, while the classic
+per-hour × seconds *without* the ``/3600`` leaves a mixed ``s``/``H``
+residue the checker reports as TAU1002.
+
+The two functions below are the only *blessed* dimension-crossing
+constructors: prefer them at the load-bearing crossings so the intent
+is explicit in the code, not just in the checker's algebra.
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Final, TypeAlias
+
+#: Whole chips of one accelerator unit (dimension ``c``).
+Chips: TypeAlias = Annotated[int, "chip"]
+
+#: Wall-clock or injected-clock seconds (dimension ``s``).
+Seconds: TypeAlias = Annotated[float, "second"]
+
+#: Chip-seconds — the fleet's native cost/budget currency (``c.s``).
+ChipSeconds: TypeAlias = Annotated[float, "chip_second"]
+
+#: Price-book rate in dollars per chip-hour (``u.c^-1.H^-1``).
+UsdPerChipHour: TypeAlias = Annotated[float, "usd_per_chip_hour"]
+
+#: Dollar proxy totals (``u``).
+Usd: TypeAlias = Annotated[float, "usd"]
+
+#: Known-dimensionless ratio in ``[0, 1]``-ish (confidences,
+#: utilizations, savings ratios).  Distinct from an *unannotated*
+#: float: the checker treats ``Fraction`` as proven dimensionless and
+#: will flag it added to a dimensioned quantity.
+Fraction: TypeAlias = Annotated[float, "fraction"]
+
+#: The one unit-conversion constant (dimension ``s.H^-1``): dividing a
+#: ``$/chip-hour x chip-seconds`` product by it yields dollars.
+SECONDS_PER_HOUR: Final[float] = 3600.0
+
+
+def chip_seconds(chips: Chips, seconds: Seconds) -> ChipSeconds:
+    """Blessed ``c x s -> c.s`` crossing: the cost of holding
+    ``chips`` for ``seconds``."""
+    return chips * seconds
+
+
+def usd(rate: UsdPerChipHour, cs: ChipSeconds) -> Usd:
+    """Blessed ``u.c^-1.H^-1 x c.s -> u`` crossing: price
+    chip-seconds at a $/chip-hour rate (the ONE place the 3600
+    timebase conversion lives)."""
+    return rate * cs / SECONDS_PER_HOUR
