@@ -2181,13 +2181,16 @@ class _LoopActuator:
         pass
 
 
-def _loop_controller(shards: int, informer):
+def _loop_controller(shards: int, informer, columnar: bool = False):
     from tpu_autoscaler.controller import Controller, ControllerConfig
     from tpu_autoscaler.engine.planner import PoolPolicy
 
     config = ControllerConfig(
         policy=PoolPolicy(spare_nodes=0, max_total_chips=10**9),
         reconcile_shards=shards,
+        # Explicit either way: the python rows must stay comparable to
+        # the PR 13 baseline, the columnar rows measure ISSUE 17.
+        columnar_planning=columnar,
         # Delta planning off: the tier measures FULL planning each
         # pass (the delta layer is PR 6's orthogonal win, and a
         # static world would otherwise plan zero gangs after pass 1).
@@ -2243,10 +2246,18 @@ def bench_loop(n_pods: int = LOOP_PODS, n_nodes: int = LOOP_NODES,
     assert index_entries <= len(informer.pod_cache._indexers) * store, (
         index_entries, store)
 
+    # Four rows: the PR 13 python pair, then the ISSUE 17 columnar
+    # pair over the SAME informer (the memoized ColumnarView carries
+    # across modes — a static world means later refreshes are free).
+    modes = (("serial", 0, False), ("sharded", shards, False),
+             ("serial_columnar", 0, True),
+             ("sharded_columnar", shards, True))
     results = {}
     parity = None
-    for mode_shards in (0, shards):
-        controller, client = _loop_controller(mode_shards, informer)
+    mismatches = 0
+    for mode, mode_shards, columnar in modes:
+        controller, client = _loop_controller(mode_shards, informer,
+                                              columnar=columnar)
         best = float("inf")
         for p in range(passes + 1):
             t0 = time.perf_counter()
@@ -2261,7 +2272,34 @@ def bench_loop(n_pods: int = LOOP_PODS, n_nodes: int = LOOP_NODES,
         assert client.lists == 0, "a measured path fell back to LIST"
         assert informer_client.lists == 0, \
             "the informer fell back to LIST mid-bench"
-        if mode_shards:
+        snap = controller.metrics.snapshot()
+        if columnar:
+            # The fast path must actually have carried every measured
+            # pass — a silent python fallback would fake the row.
+            counters = snap["counters"]
+            assert counters.get("columnar_passes", 0) >= passes, counters
+            assert counters.get("columnar_fallbacks", 0) == 0, counters
+            assert counters.get("columnar_stale", 0) == 0, counters
+            nodes, pods, pending = controller._observe()
+            gangs = group_into_gangs(pending)
+            oracle = controller.planner.plan(gangs, nodes, pods, [])
+            state = informer.columnar_view().refresh()
+            assert state is not None and state.attachable(nodes, pods)
+            if mode_shards:
+                col_plan = controller.sharder.plan(
+                    gangs, nodes, pods, [],
+                    candidate_accels=controller._candidate_accels,
+                    columnar=state)
+                assert controller.sharder.last_info.get("mode") \
+                    == "sharded", controller.sharder.last_info
+            else:
+                col_plan = controller.planner.plan(gangs, nodes, pods,
+                                                   [], columnar=state)
+            if not (oracle.requests == col_plan.requests
+                    and [(g.key, r) for g, r in oracle.unsatisfiable]
+                    == [(g.key, r) for g, r in col_plan.unsatisfiable]):
+                mismatches += 1
+        elif mode_shards:
             nodes, pods, pending = controller._observe()
             gangs = group_into_gangs(pending)
             serial_plan = controller.planner.plan(gangs, nodes, pods, [])
@@ -2279,8 +2317,7 @@ def bench_loop(n_pods: int = LOOP_PODS, n_nodes: int = LOOP_NODES,
                 "requests": len(serial_plan.requests),
                 "sharding": dict(controller.sharder.last_info),
             }
-        snap = controller.metrics.snapshot()
-        results[mode_shards] = {
+        results[mode] = {
             "pass_s": best,
             "passes_per_sec": round(1.0 / best, 3),
             "shard_errors": snap["counters"].get("shard_errors", 0),
@@ -2290,22 +2327,32 @@ def bench_loop(n_pods: int = LOOP_PODS, n_nodes: int = LOOP_NODES,
         controller.close()
     clear_parse_caches()
 
-    serial_s = results[0]["pass_s"]
-    sharded_s = results[shards]["pass_s"]
-    mismatches = 0 if (parity and parity["requests_equal"]
-                       and parity["unsatisfiable_equal"]) else 1
+    serial_s = results["serial"]["pass_s"]
+    sharded_s = results["sharded"]["pass_s"]
+    serial_col_s = results["serial_columnar"]["pass_s"]
+    sharded_col_s = results["sharded_columnar"]["pass_s"]
+    if not (parity and parity["requests_equal"]
+            and parity["unsatisfiable_equal"]):
+        mismatches += 1
     return {
         "info": "loop", **meta,
         "requested_pods": n_pods, "requested_nodes": n_nodes,
         "shards": shards,
         "serial_pass_ms": round(serial_s * 1e3, 1),
         "sharded_pass_ms": round(sharded_s * 1e3, 1),
-        "serial_passes_per_sec": results[0]["passes_per_sec"],
-        "sharded_passes_per_sec": results[shards]["passes_per_sec"],
+        "serial_columnar_pass_ms": round(serial_col_s * 1e3, 1),
+        "sharded_columnar_pass_ms": round(sharded_col_s * 1e3, 1),
+        "serial_passes_per_sec": results["serial"]["passes_per_sec"],
+        "sharded_passes_per_sec": results["sharded"]["passes_per_sec"],
         "speedup": round(serial_s / sharded_s, 2) if sharded_s else None,
+        "columnar_speedup": (round(serial_s / serial_col_s, 2)
+                             if serial_col_s else None),
+        "sharded_columnar_speedup": (round(serial_s / sharded_col_s, 2)
+                                     if sharded_col_s else None),
         "decision_mismatches": mismatches,
-        "shard_errors": results[shards]["shard_errors"],
-        "merge_conflicts": results[shards]["merge_conflicts"],
+        "shard_errors": max(r["shard_errors"] for r in results.values()),
+        "merge_conflicts": max(r["merge_conflicts"]
+                               for r in results.values()),
         "parity": parity,
         "floor": LOOP_SPEEDUP_FLOOR,
     }
@@ -2347,12 +2394,152 @@ def check_loop(n_pods: int, n_nodes: int, shards: int = LOOP_SHARDS,
         + info["cpu_nodes"], "shards": shards,
         "serial_pass_ms": info["serial_pass_ms"],
         "sharded_pass_ms": info["sharded_pass_ms"],
-        "speedup": info["speedup"], "floor": floor,
+        "serial_columnar_pass_ms": info["serial_columnar_pass_ms"],
+        "sharded_columnar_pass_ms": info["sharded_columnar_pass_ms"],
+        "speedup": info["speedup"],
+        "columnar_speedup": info["columnar_speedup"],
+        "sharded_columnar_speedup": info["sharded_columnar_speedup"],
+        "floor": floor,
         "decision_mismatches": info["decision_mismatches"],
         "merge_conflicts": info["merge_conflicts"],
         "north_star_sharded_cpu_s": info["north_star_sharded_cpu_s"],
     })
     return ok and ns_ok, info
+
+
+# --------------------------------------------------------------------------
+# Columnar planner tier (ISSUE 17, scripts/full_suite.sh + ci_gate.sh):
+# the serial million-pod planning pass, python oracle vs the columnar
+# struct-of-arrays fast path over the informer-maintained view.  Decisions
+# must be byte-identical (requests, unsatisfiable, deferred, AND the
+# claim scan's unit set); the columnar pass must beat the python pass by
+# the floor.  Records BENCH_SCALE.json["plan_columnar"].
+
+PLAN_COLUMNAR_PODS = 1_000_000
+PLAN_COLUMNAR_NODES = 100_000
+PLAN_COLUMNAR_SPEEDUP_FLOOR = 5.0
+PLAN_COLUMNAR_PASSES = 2
+
+
+def bench_plan_columnar(n_pods: int = PLAN_COLUMNAR_PODS,
+                        n_nodes: int = PLAN_COLUMNAR_NODES,
+                        passes: int = PLAN_COLUMNAR_PASSES) -> dict:
+    """Serial planning pass, python vs columnar, one shared world.
+
+    The columnar timing INCLUDES the per-pass ``ColumnarView.refresh``
+    (the incremental maintenance the reconcile loop pays each pass) but
+    not the initial view build — steady state, not cold start.  The
+    claim scan (``shard.claimed_by_pending``) is measured alongside as
+    the third ported hot loop; its unit set must match exactly.
+    """
+    from tpu_autoscaler.controller.shard import claimed_by_pending
+    from tpu_autoscaler.k8s.gangs import group_into_gangs
+    from tpu_autoscaler.k8s.informer import ClusterInformer
+    from tpu_autoscaler.k8s.objects import clear_parse_caches
+    from tpu_autoscaler.k8s.units import group_supply_units
+
+    clear_parse_caches()
+    nodes_iter, pods_iter, meta = _loop_world(n_pods, n_nodes)
+    informer_client = _LoopClient()
+    informer = ClusterInformer(informer_client)
+    informer.pod_cache.replace(pods_iter(), "1")
+    informer.node_cache.replace(nodes_iter(), "1")
+    controller, _ = _loop_controller(0, informer, columnar=True)
+    nodes, pods, pending = controller._observe()
+    gangs = group_into_gangs(pending)
+    view = informer.columnar_view()
+    t0 = time.perf_counter()
+    state = view.refresh()
+    build_s = time.perf_counter() - t0
+    assert state is not None and state.attachable(nodes, pods)
+
+    def timed(fn):
+        best = float("inf")
+        out = fn()  # warm
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            out = fn()
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    def columnar_plan():
+        st = view.refresh()
+        assert st is not None
+        return controller.planner.plan(gangs, nodes, pods, [],
+                                       columnar=st)
+
+    col_s, col_plan = timed(columnar_plan)
+    py_s, py_plan = timed(
+        lambda: controller.planner.plan(gangs, nodes, pods, []))
+    mismatches = 0
+    if not (py_plan.requests == col_plan.requests
+            and [(g.key, r) for g, r in py_plan.unsatisfiable]
+            == [(g.key, r) for g, r in col_plan.unsatisfiable]
+            and [(g.key, r) for g, r in py_plan.deferred]
+            == [(g.key, r) for g, r in col_plan.deferred]):
+        mismatches += 1
+
+    # The claim scan, python vs columnar (single shot each: the python
+    # side is an O(units x gangs) walk at this tier).
+    units = group_supply_units(nodes)
+    t0 = time.perf_counter()
+    py_claim = claimed_by_pending(units, gangs, pods)
+    claim_py_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    col_claim = claimed_by_pending(units, gangs, pods,
+                                   columnar=view.refresh())
+    claim_col_s = time.perf_counter() - t0
+    if py_claim != col_claim:
+        mismatches += 1
+    controller.close()
+    clear_parse_caches()
+
+    return {
+        "info": "plan_columnar", **meta,
+        "requested_pods": n_pods, "requested_nodes": n_nodes,
+        "view_build_ms": round(build_s * 1e3, 1),
+        "python_plan_ms": round(py_s * 1e3, 1),
+        "columnar_plan_ms": round(col_s * 1e3, 1),
+        "speedup": round(py_s / col_s, 2) if col_s else None,
+        "python_claim_ms": round(claim_py_s * 1e3, 1),
+        "columnar_claim_ms": round(claim_col_s * 1e3, 1),
+        "claim_speedup": (round(claim_py_s / claim_col_s, 2)
+                          if claim_col_s else None),
+        "requests": len(py_plan.requests),
+        "claimed_units": len(py_claim),
+        "decision_mismatches": mismatches,
+    }
+
+
+def check_plan_columnar(n_pods: int = PLAN_COLUMNAR_PODS,
+                        n_nodes: int = PLAN_COLUMNAR_NODES,
+                        floor: float = PLAN_COLUMNAR_SPEEDUP_FLOOR
+                        ) -> tuple[bool, dict]:
+    """Gate: columnar planning pass >= ``floor`` x the python pass at
+    the requested tier with ZERO decision mismatches (plan AND claim
+    scan).  Records BENCH_SCALE.json["plan_columnar"]."""
+    info = bench_plan_columnar(n_pods, n_nodes)
+    info["floor"] = floor
+    print(json.dumps(info), file=sys.stderr)
+    ok = ((info.get("speedup") or 0) >= floor
+          and info["decision_mismatches"] == 0)
+    if not ok:
+        print(json.dumps({"error": "columnar planner regression: "
+                          "speedup below floor or decisions diverged",
+                          **info}), file=sys.stderr)
+    _record_tier("BENCH_SCALE.json", "plan_columnar", {
+        "pods": info["pods"],
+        "nodes": info["tpu_nodes"] + info["cpu_nodes"],
+        "python_plan_ms": info["python_plan_ms"],
+        "columnar_plan_ms": info["columnar_plan_ms"],
+        "speedup": info["speedup"],
+        "python_claim_ms": info["python_claim_ms"],
+        "columnar_claim_ms": info["columnar_claim_ms"],
+        "claim_speedup": info["claim_speedup"],
+        "floor": floor,
+        "decision_mismatches": info["decision_mismatches"],
+    })
+    return ok, info
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -2394,6 +2581,28 @@ def main(argv: list[str] | None = None) -> int:
             "metric": "sharded_loop_speedup",
             "value": info.get("speedup"),
             "unit": "x_vs_serial",
+            "vs_baseline": round((info.get("speedup") or 0)
+                                 / args.floor, 2),
+        }))
+        return 0 if ok else 1
+    if argv and argv[0] == "plan_columnar":
+        # Columnar planner tier (ISSUE 17, scripts/full_suite.sh +
+        # ci_gate.sh): serial planning pass python-oracle vs columnar
+        # at the million-pod tier, byte-identical decisions + claim
+        # set, speedup >= floor; records BENCH_SCALE.json.
+        ap = argparse.ArgumentParser(prog="bench.py plan_columnar")
+        ap.add_argument("--pods", type=int, default=PLAN_COLUMNAR_PODS)
+        ap.add_argument("--nodes", type=int,
+                        default=PLAN_COLUMNAR_NODES)
+        ap.add_argument("--floor", type=float,
+                        default=PLAN_COLUMNAR_SPEEDUP_FLOOR)
+        args = ap.parse_args(argv[1:])
+        ok, info = check_plan_columnar(args.pods, args.nodes,
+                                       floor=args.floor)
+        print(json.dumps({
+            "metric": "plan_columnar_speedup",
+            "value": info.get("speedup"),
+            "unit": "x_vs_python",
             "vs_baseline": round((info.get("speedup") or 0)
                                  / args.floor, 2),
         }))

@@ -93,6 +93,11 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 480 --reconcile-shards 4
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repair --reconcile-shards 4
+# Verified-columnar corpus (ISSUE 17, docs/PLANNER.md): the mixed
+# corpus re-runs with verify_columnar_plans ON — the python planner
+# shadows every columnar pass and any plan mismatch fails the seed.
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 480 --verify-columnar
 
 # Policy replay tier (ISSUE 8): the recurring north-star trace must
 # show prewarmed detect->running <= 0.25x the reactive baseline, and a
@@ -155,6 +160,12 @@ JAX_PLATFORMS=cpu python bench.py repack
 # budget green with sharding ON; results merge into BENCH_SHARD.json.
 JAX_PLATFORMS=cpu python bench.py observe --pods 1000000 --nodes 100000 --floor 20
 JAX_PLATFORMS=cpu python bench.py loop --pods 1000000 --nodes 100000
+
+# Columnar planner tier (ISSUE 17, docs/PLANNER.md): the serial
+# million-pod planning pass on the struct-of-arrays fast path vs the
+# python oracle — >= 5x with byte-identical decisions (plan AND the
+# claim scan); results merge into BENCH_SCALE.json.
+JAX_PLATFORMS=cpu python bench.py plan_columnar --pods 1000000 --nodes 100000
 
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py

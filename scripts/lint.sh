@@ -24,6 +24,7 @@ cd "$(dirname "$0")/.."
 MYPY_TARGETS=(
   tpu_autoscaler/engine
   tpu_autoscaler/k8s/objects.py
+  tpu_autoscaler/k8s/columnar.py
   tpu_autoscaler/analysis
   tpu_autoscaler/actuators/executor.py
   tpu_autoscaler/cost

@@ -1,0 +1,484 @@
+"""Columnar planner-core tests (ISSUE 17).
+
+Property-style, mirroring test_informer_indices.py: after ANY seeded
+sequence of watch deltas, 410-Gone relists, and mark_unsynced episodes,
+the informer's incrementally-maintained ``ColumnarView`` must match a
+from-scratch ``ColumnarState.build`` of the snapshot COLUMN FOR COLUMN
+— including the row order (append order == dict insertion order ==
+snapshot order), the intern tables (compared by key, ids may differ),
+the digest stamps, and the derived plan columns.  On top of that, the
+columnar plan paths (serial fast path, sharded fan-out, claim scan)
+must be byte-identical to the serial Python oracle, and the ONE
+free-slice predicate must agree across its three consumers under
+readiness/cordon/occupancy perturbation (the ISSUE 17 dedupe
+regression).  Seeded fixtures: failures print their seed.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+import test_informer_indices as tii
+import test_shard as ts
+from tpu_autoscaler.controller.shard import claimed_by_pending
+from tpu_autoscaler.engine.columnar import (
+    ColumnarState,
+    PlanColumns,
+    slice_free_mask,
+    slice_is_free,
+)
+from tpu_autoscaler.engine.planner import _free_slices
+from tpu_autoscaler.k8s.columnar import ColumnarView
+from tpu_autoscaler.k8s.gangs import group_into_gangs
+from tpu_autoscaler.k8s.informer import (
+    CapacityView,
+    make_node_cache,
+    make_pod_cache,
+)
+from tpu_autoscaler.k8s.objects import clear_parse_caches
+from tpu_autoscaler.k8s.units import group_supply_units
+
+
+@pytest.fixture(autouse=True)
+def _fresh_parse_caches():
+    clear_parse_caches()
+    yield
+    clear_parse_caches()
+
+
+# ---- column-for-column equality vs a from-scratch rebuild ---------------
+
+
+def assert_state_equal(view_state: ColumnarState, oracle: ColumnarState,
+                       ctx) -> None:
+    """Every column, group, intern (by key), stamp, and derived output."""
+    assert view_state.nodes == oracle.nodes, ctx
+    for f in ("n_ready", "n_sched", "n_is_tpu", "n_chips", "n_tmpl",
+              "slice_gid", "unit_gid"):
+        assert np.array_equal(getattr(view_state, f),
+                              getattr(oracle, f)), (ctx, f)
+    for gname in ("slices", "units"):
+        gv, go = getattr(view_state, gname), getattr(oracle, gname)
+        assert gv.keys == go.keys, (ctx, gname)
+        assert np.array_equal(gv.member_rows, go.member_rows), (ctx, gname)
+        assert np.array_equal(gv.offsets, go.offsets), (ctx, gname)
+        assert np.array_equal(gv.tmpl, go.tmpl), (ctx, gname)
+        assert np.array_equal(gv.chips, go.chips), (ctx, gname)
+    assert view_state.n_pods == oracle.n_pods, ctx
+    for f in ("p_node_row", "p_has_node", "p_active", "p_workload",
+              "p_tpu", "p_tpu_chips"):
+        assert np.array_equal(getattr(view_state, f),
+                              getattr(oracle, f)), (ctx, f)
+    # Interned ids may differ between the incremental view (grow-only
+    # across relists) and a fresh build — compare through the keys.
+    assert [view_state.gang_keys[g] for g in view_state.p_gang] == \
+        [oracle.gang_keys[g] for g in oracle.p_gang], ctx
+    assert [view_state.ns_keys[g] for g in view_state.p_ns] == \
+        [oracle.ns_keys[g] for g in oracle.p_ns], ctx
+    va = {a: view_state.p_axes[i] for i, a in enumerate(view_state.axes)}
+    oa = {a: oracle.p_axes[i] for i, a in enumerate(oracle.axes)}
+    for a in set(va) | set(oa):
+        v = va.get(a, np.zeros(view_state.n_pods))
+        o = oa.get(a, np.zeros(oracle.n_pods))
+        assert np.array_equal(v, o), (ctx, "axis", a)
+    assert view_state.first_pod_sig == oracle.first_pod_sig, ctx
+    assert view_state.last_pod_sig == oracle.last_pod_sig, ctx
+    # Derived plan columns: the hot-loop answers the planner consumes.
+    pv, po = PlanColumns(view_state), PlanColumns(oracle)
+    fv, fo = pv.free_slices()[0], po.free_slices()[0]
+    assert list(fv.keys()) == list(fo.keys()), ctx
+    assert fv == fo, ctx
+    assert pv.free_cpu_capacity() == po.free_cpu_capacity(), ctx
+    assert pv.chips_by_namespace() == po.chips_by_namespace(), ctx
+
+
+def _drive_churn(seed: int, steps: int, view: ColumnarView,
+                 ncache, pcache) -> None:
+    rng = random.Random(seed)
+    rvc = [0]
+
+    def rv() -> int:
+        rvc[0] += 1
+        return rvc[0]
+
+    nodes0 = [tii.node_payload(i, rv(), tpu=rng.random() < 0.7)
+              for i in range(10)]
+    pods0 = [tii.pod_payload(i, rv(),
+                             phase=rng.choice(["Pending", "Running",
+                                               "Succeeded"]),
+                             node=(f"node-{rng.randrange(10)}"
+                                   if rng.random() < 0.6 else None),
+                             job=(f"job-{rng.randrange(4)}"
+                                  if rng.random() < 0.7 else None),
+                             chips=rng.choice([0, 4]))
+             for i in range(30)]
+    ncache.replace(list(nodes0), "1")
+    pcache.replace(list(pods0), "1")
+    live_pods = {p["metadata"]["name"]: p for p in pods0}
+    live_nodes = {n["metadata"]["name"]: n for n in nodes0}
+    next_pod, next_node = [30], [10]
+
+    for step in range(steps):
+        op = rng.random()
+        if op < 0.30 or not live_pods:  # add pod
+            i = next_pod[0]
+            next_pod[0] += 1
+            p = tii.pod_payload(
+                i, rv(), phase=rng.choice(["Pending", "Running"]),
+                node=(rng.choice(sorted(live_nodes))
+                      if live_nodes and rng.random() < 0.6 else None),
+                job=(f"job-{rng.randrange(4)}"
+                     if rng.random() < 0.7 else None),
+                chips=rng.choice([0, 4]))
+            live_pods[p["metadata"]["name"]] = p
+            pcache.apply({"type": "ADDED", "object": p})
+        elif op < 0.50:  # modify pod (phase/node/gang flip)
+            name = rng.choice(sorted(live_pods))
+            i = int(name.split("-")[1])
+            p = tii.pod_payload(
+                i, rv(),
+                phase=rng.choice(["Pending", "Running", "Succeeded"]),
+                node=(rng.choice(sorted(live_nodes))
+                      if live_nodes and rng.random() < 0.6 else None),
+                job=(f"job-{rng.randrange(4)}"
+                     if rng.random() < 0.7 else None),
+                chips=rng.choice([0, 4]))
+            live_pods[name] = p
+            pcache.apply({"type": "MODIFIED", "object": p})
+        elif op < 0.65:  # delete pod
+            name = rng.choice(sorted(live_pods))
+            pcache.apply({"type": "DELETED",
+                          "object": live_pods.pop(name)})
+        elif op < 0.75:  # node flip / add / delete
+            sub = rng.random()
+            if sub < 0.5 and live_nodes:
+                name = rng.choice(sorted(live_nodes))
+                i = int(name.split("-")[1])
+                n = tii.node_payload(i, rv(), ready=rng.random() < 0.8,
+                                     cordoned=rng.random() < 0.2,
+                                     tpu=rng.random() < 0.7)
+                live_nodes[name] = n
+                ncache.apply({"type": "MODIFIED", "object": n})
+            elif sub < 0.8:
+                i = next_node[0]
+                next_node[0] += 1
+                n = tii.node_payload(i, rv(), tpu=rng.random() < 0.7)
+                live_nodes[n["metadata"]["name"]] = n
+                ncache.apply({"type": "ADDED", "object": n})
+            elif live_nodes:
+                name = rng.choice(sorted(live_nodes))
+                ncache.apply({"type": "DELETED",
+                              "object": live_nodes.pop(name)})
+        elif op < 0.85:  # 410-Gone relist, shuffled order
+            which = rng.choice(["pods", "nodes", "both"])
+            if which in ("pods", "both"):
+                pcache.replace(
+                    [live_pods[k] for k in
+                     rng.sample(sorted(live_pods), len(live_pods))],
+                    str(rv()))
+            if which in ("nodes", "both"):
+                ncache.replace(
+                    [live_nodes[k] for k in
+                     rng.sample(sorted(live_nodes), len(live_nodes))],
+                    str(rv()))
+        else:  # unsync then relist
+            cache = pcache if rng.random() < 0.5 else ncache
+            cache.mark_unsynced()
+            assert view.refresh() is None, (seed, step)
+            src = live_pods if cache is pcache else live_nodes
+            cache.replace([src[k] for k in sorted(src)], str(rv()))
+
+        if rng.random() < 0.8:  # sometimes batch deltas across steps
+            state = view.refresh()
+            assert state is not None, (seed, step)
+            nodes, pods = ncache.snapshot(), pcache.snapshot()
+            oracle = ColumnarState.build(nodes, pods,
+                                         templates=view.templates)
+            assert state.node_digest == ncache.store_digest, (seed, step)
+            assert state.pod_digest == pcache.store_digest, (seed, step)
+            assert state.attachable(nodes, pods), (seed, step)
+            assert_state_equal(state, oracle, (seed, step))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_churn_view_matches_from_scratch_rebuild(seed):
+    ncache, pcache = make_node_cache(), make_pod_cache()
+    view = ColumnarView(ncache, pcache)
+    try:
+        _drive_churn(seed, 45, view, ncache, pcache)
+    finally:
+        view.close()
+
+
+def test_compaction_keeps_dead_rows_bounded():
+    """Deletes mark rows dead in place; the view compacts once the dead
+    fraction crosses its threshold, WITHOUT a node/pod rebuild, and the
+    exported state still matches a from-scratch build."""
+    ncache, pcache = make_node_cache(), make_pod_cache()
+    view = ColumnarView(ncache, pcache)
+    try:
+        ncache.replace([tii.node_payload(0, 1)], "1")
+        pods = [tii.pod_payload(i, i + 2, phase="Running")
+                for i in range(3000)]
+        pcache.replace(list(pods), "1")
+        assert view.refresh() is not None
+        rebuilds0 = view.rebuilds
+        for p in pods[:1500]:
+            pcache.apply({"type": "DELETED", "object": p})
+            view.refresh()
+        # The threshold is dead > max(1024, live/8): the trailing
+        # partial batch may leave up to 1024 dead rows uncompacted.
+        assert view._dead_count <= 1024
+        assert view.rebuilds == rebuilds0, \
+            "a delete storm must not force full rebuilds"
+        state = view.refresh()
+        oracle = ColumnarState.build(ncache.snapshot(), pcache.snapshot(),
+                                     templates=view.templates)
+        assert_state_equal(state, oracle, "compaction")
+    finally:
+        view.close()
+
+
+def test_dirty_log_cap_forces_rebuild():
+    """An unread event log past max(1024, len(store)) is nulled — the
+    next refresh falls back to a full rebuild instead of replaying an
+    unbounded backlog, and the result still matches the oracle."""
+    ncache, pcache = make_node_cache(), make_pod_cache()
+    view = ColumnarView(ncache, pcache)
+    try:
+        ncache.replace([tii.node_payload(0, 1)], "1")
+        pods = [tii.pod_payload(i, i + 2, phase="Running")
+                for i in range(100)]
+        pcache.replace(list(pods), "1")
+        assert view.refresh() is not None
+        rebuilds0 = view.rebuilds
+        rv = 5000
+        for _ in range(30):  # 3000 MODIFIED events, no refresh between
+            for i in range(100):
+                rv += 1
+                pcache.apply({"type": "MODIFIED",
+                              "object": tii.pod_payload(i, rv,
+                                                        phase="Running")})
+        state = view.refresh()
+        assert view.rebuilds == rebuilds0 + 1, \
+            "the capped log must trigger exactly one rebuild"
+        oracle = ColumnarState.build(ncache.snapshot(), pcache.snapshot(),
+                                     templates=view.templates)
+        assert_state_equal(state, oracle, "log-cap")
+    finally:
+        view.close()
+
+
+# ---- the ONE free-slice predicate (ISSUE 17 satellite) ------------------
+
+
+def _slice_world(perturb: str):
+    """12 TPU nodes = 3 slices of 4 via tii builders, one perturbed."""
+    rv = [0]
+
+    def nrv() -> int:
+        rv[0] += 1
+        return rv[0]
+
+    nodes = [tii.node_payload(i, nrv()) for i in range(12)]
+    pods = []
+    if perturb == "notready":
+        nodes[1] = tii.node_payload(1, nrv(), ready=False)
+    elif perturb == "cordoned":
+        nodes[5] = tii.node_payload(5, nrv(), cordoned=True)
+    elif perturb == "occupied":
+        pods.append(tii.pod_payload(0, nrv(), phase="Running",
+                                    node="node-9", chips=4))
+    elif perturb == "pending_bound":
+        # A Pending pod already bound to a host claims its chips too.
+        pods.append(tii.pod_payload(0, nrv(), phase="Pending",
+                                    node="node-9", chips=4))
+    elif perturb == "succeeded":
+        # Terminal phases release the chips: the slice stays free.
+        pods.append(tii.pod_payload(0, nrv(), phase="Succeeded",
+                                    node="node-9", chips=4))
+    return nodes, pods
+
+
+FREE_BY_PERTURB = {
+    "none": {"slice-0", "slice-1", "slice-2"},
+    "notready": {"slice-1", "slice-2"},
+    "cordoned": {"slice-0", "slice-2"},
+    "occupied": {"slice-0", "slice-1"},
+    "pending_bound": {"slice-0", "slice-1"},
+    "succeeded": {"slice-0", "slice-1", "slice-2"},
+}
+
+
+@pytest.mark.parametrize("perturb", sorted(FREE_BY_PERTURB))
+def test_free_slice_predicate_agrees_three_ways(perturb):
+    """planner._free_slices, CapacityView.free_slice, and the columnar
+    slice_free_mask all evaluate slice_is_free — perturbing readiness,
+    cordon state, and chip occupancy must move all three together."""
+    node_payloads, pod_payloads = _slice_world(perturb)
+    ncache, pcache = make_node_cache(), make_pod_cache()
+    ncache.replace(node_payloads, "1")
+    pcache.replace(pod_payloads, "1")
+    nodes, pods = ncache.snapshot(), pcache.snapshot()
+    want = FREE_BY_PERTURB[perturb]
+
+    assert set(_free_slices(nodes, pods)) == want
+
+    cap = CapacityView(ncache, pcache)
+    try:
+        assert cap.refresh()
+        assert {k for k in cap.free_slices()
+                if k.startswith("slice-")} == want
+    finally:
+        cap.close()
+
+    state = ColumnarState.build(nodes, pods)
+    free_dict, mask = PlanColumns(state).free_slices()
+    assert set(free_dict) == want
+    assert [state.slices.keys[i] for i in np.flatnonzero(mask)] == \
+        list(free_dict)
+    # And the scalar/vector twins agree pointwise on every slice.
+    g = state.slices
+    members = np.diff(g.offsets)
+    ready = np.add.reduceat(
+        (state.n_ready & state.n_sched)[g.member_rows].astype(np.int64),
+        g.offsets[:-1]) if len(g) else np.zeros(0, np.int64)
+    used = PlanColumns(state).used_tpu_per_node()
+    used_g = np.add.reduceat(used[g.member_rows], g.offsets[:-1]) \
+        if len(g) else np.zeros(0)
+    vec = slice_free_mask(members, ready, used_g)
+    for i, key in enumerate(g.keys):
+        assert bool(vec[i]) == slice_is_free(
+            True, int(members[i]), int(ready[i]), float(used_g[i])), key
+
+
+# ---- plan + claim parity over seeded worlds -----------------------------
+
+
+def _plans_equal(a, b) -> bool:
+    return (a.requests == b.requests
+            and [(g.key, r) for g, r in a.unsatisfiable]
+            == [(g.key, r) for g, r in b.unsatisfiable]
+            and [(g.key, r) for g, r in a.deferred]
+            == [(g.key, r) for g, r in b.deferred])
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_columnar_plans_match_python_oracle(seed):
+    """Serial-columnar and sharded-columnar plans are byte-identical to
+    the serial Python oracle over seeded worlds with churn."""
+    kube, informer, controller = ts.build(4)
+    try:
+        rng = random.Random(7000 + seed)
+        ts.seeded_world(kube, rng)
+        for step in range(2):
+            informer.pump()
+            nodes, pods, pending = controller._observe()
+            gangs = group_into_gangs(pending)
+            oracle = controller.planner.plan(gangs, nodes, pods, [])
+            cols = ColumnarState.build(nodes, pods)
+            serial_col = controller.planner.plan(gangs, nodes, pods, [],
+                                                 columnar=cols)
+            sharded = controller.sharder.plan(
+                gangs, nodes, pods, [],
+                candidate_accels=controller._candidate_accels,
+                columnar=ColumnarState.build(nodes, pods))
+            assert _plans_equal(oracle, serial_col), (seed, step)
+            assert _plans_equal(oracle, sharded), (seed, step)
+            snap = controller.metrics.snapshot()["counters"]
+            assert snap.get("shard_errors", 0) == 0, (seed, step, snap)
+            kube.add_pod(ts.tpu_pod(f"late{step}-m0", f"late-{step}",
+                                    accel=rng.choice(list(ts.ACCELS))))
+            if pending:
+                kube.delete_pod(pending[0].namespace, pending[0].name)
+    finally:
+        controller.close()
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_claimed_by_pending_columnar_matches_python(seed):
+    """The columnar claim/partial-claim scan returns exactly the Python
+    loop's claimed-unit set."""
+    kube, informer, controller = ts.build(0)
+    try:
+        ts.seeded_world(kube, random.Random(8000 + seed))
+        informer.pump()
+        nodes, pods, pending = controller._observe()
+        units = group_supply_units(nodes)
+        gangs = group_into_gangs(pending)
+        want = claimed_by_pending(units, gangs, pods)
+        state = ColumnarState.build(nodes, pods)
+        got = claimed_by_pending(units, gangs, pods, columnar=state)
+        assert got == want, (seed, sorted(want), sorted(got))
+    finally:
+        controller.close()
+
+
+# ---- template-memo admission --------------------------------------------
+
+
+def test_template_memo_admission_is_exact():
+    """Nodes sharing (labels, taints, allocatable) intern to ONE
+    template; admit rows match Node.admits per representative and
+    extend (grow-only) when templates arrive after the memo row."""
+    ncache, pcache = make_node_cache(), make_pod_cache()
+    payloads = [tii.node_payload(i, i + 1) for i in range(8)]
+    ncache.replace(payloads, "1")
+    pcache.replace([tii.pod_payload(0, 100, chips=4),
+                    tii.pod_payload(1, 101, chips=0)], "1")
+    nodes, pods = ncache.snapshot(), pcache.snapshot()
+    state = ColumnarState.build(nodes, pods)
+    tmpl = state.templates
+    # tii nodes differ only in name/slice labels -> templates interned
+    # by the slice label; re-interning is stable.
+    assert max(state.n_tmpl) + 1 == len(tmpl.reps)
+    for node, tid in zip(nodes, state.n_tmpl):
+        assert tmpl.template_of(node) == tid
+        for probe in pods:
+            assert tmpl.admits(tid, probe) == node.admits(probe), \
+                (node.name, probe.name)
+    # Grow-only: a memoized row extends when a NEW template shows up.
+    probe = pods[0]
+    row0 = tmpl.admit_row(probe)
+    ncache.apply({"type": "ADDED",
+                  "object": tii.node_payload(99, 999, tpu=False)})
+    new_nodes = ncache.snapshot()
+    state2 = ColumnarState.build(new_nodes, pods, templates=tmpl)
+    row1 = tmpl.admit_row(probe)
+    assert len(row1) == len(tmpl.reps) > len(row0)
+    assert np.array_equal(row1[:len(row0)], row0)
+    for node, tid in zip(new_nodes, state2.n_tmpl):
+        assert tmpl.admits(tid, probe) == node.admits(probe), node.name
+
+
+# ---- verify-mode wiring --------------------------------------------------
+
+
+def test_reconciler_verify_mode_runs_green():
+    """With verify_columnar_plans ON the Python oracle shadows every
+    columnar pass: passes are counted and zero mismatches occur."""
+    kube, informer, controller = ts.build(
+        0, config_kw={"verify_columnar_plans": True})
+    try:
+        ts.seeded_world(kube, random.Random(424242))
+        informer.pump()  # sync the caches; unsynced passes fall back
+        ts.drive(controller, kube, passes=4)
+        snap = controller.metrics.snapshot()["counters"]
+        assert snap.get("columnar_passes", 0) > 0, snap
+        assert snap.get("columnar_plan_mismatches", 0) == 0, snap
+        assert snap.get("columnar_fallbacks", 0) == 0, snap
+    finally:
+        controller.close()
+
+
+def test_chaos_scenario_verify_columnar():
+    """The chaos harness's --verify-columnar plumbing: a full scenario
+    under the fault alphabet with the oracle shadowing every pass."""
+    from tpu_autoscaler.chaos.engine import run_scenario
+
+    result = run_scenario(11, verify_columnar=True)
+    assert result.ok, result.violations
+    assert result.columnar_mismatches == 0

@@ -68,6 +68,7 @@ MUTATING_METHODS = frozenset({
 DEFAULT_SCOPE = (
     "tpu_autoscaler/engine/planner.py",
     "tpu_autoscaler/engine/fitter.py",
+    "tpu_autoscaler/engine/columnar.py",
     "tpu_autoscaler/k8s/scheduling.py",
     "tpu_autoscaler/policy/forecast.py",
     "tpu_autoscaler/policy/slo.py",

@@ -49,6 +49,12 @@ def main(argv: list[str] | None = None) -> int:
                              "(shard_min_gangs=0 so every pass "
                              "exercises fan-out/merge; 0 = the "
                              "serial oracle)")
+    parser.add_argument("--verify-columnar", action="store_true",
+                        dest="verify_columnar",
+                        help="run the Python planner as a property "
+                             "oracle beside the ISSUE 17 columnar "
+                             "fast path on every pass; any plan "
+                             "mismatch fails the seed")
     parser.add_argument("--budget", type=float, default=600.0,
                         help="corpus wall-clock budget seconds "
                              "(default 600; exit 3 when blown)")
@@ -67,7 +73,8 @@ def main(argv: list[str] | None = None) -> int:
         for event in program.events:
             print(f"  t={event.t:7.1f}  {event.kind}  {event.args}")
         result = run_scenario(program, drive=args.drive,
-                              reconcile_shards=args.reconcile_shards)
+                              reconcile_shards=args.reconcile_shards,
+                              verify_columnar=args.verify_columnar)
         print(result.describe())
         return 0 if result.ok else 2
 
@@ -79,7 +86,8 @@ def main(argv: list[str] | None = None) -> int:
 
     results, budget_blown = run_corpus(
         seeds, profile=args.profile, budget_seconds=args.budget,
-        progress=progress, reconcile_shards=args.reconcile_shards)
+        progress=progress, reconcile_shards=args.reconcile_shards,
+        verify_columnar=args.verify_columnar)
     failures = [r for r in results if not r.ok]
     converged = sum(1 for r in results if r.converged_at is not None)
     repairs = sum(r.repairs for r in results)
@@ -87,9 +95,13 @@ def main(argv: list[str] | None = None) -> int:
     wall = sum(r.wall_seconds for r in results)
     rp = (f", {repacks} repack migrations exercised" if repacks
           else "")
+    vc = ""
+    if args.verify_columnar:
+        mismatches = sum(r.columnar_mismatches for r in results)
+        vc = f", {mismatches} columnar plan mismatches"
     print(f"chaos corpus: {len(results)}/{len(seeds)} seeds run, "
           f"{len(failures)} failing, {converged} converged, "
-          f"{repairs} slice repairs exercised{rp}, {wall:.1f}s wall "
+          f"{repairs} slice repairs exercised{rp}{vc}, {wall:.1f}s wall "
           f"(budget {args.budget:g}s)")
     if args.json_path:
         with open(args.json_path, "w", encoding="utf-8") as f:

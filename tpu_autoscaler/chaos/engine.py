@@ -93,6 +93,10 @@ class ChaosResult:
     reconcile_errors: int
     repairs: int
     repacks: int = 0
+    #: ISSUE 17: ``columnar_plan_mismatches`` counter at scenario end —
+    #: nonzero under ``--verify-columnar`` is a failure (the columnar
+    #: fast path must be byte-identical to the Python oracle).
+    columnar_mismatches: int = 0
 
     def describe(self) -> str:
         status = "ok" if self.ok else "FAIL"
@@ -514,7 +518,8 @@ def _serving_scaler(program: ScenarioProgram):
 
 
 def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
-           informer, reconcile_shards: int = 0
+           informer, reconcile_shards: int = 0,
+           verify_columnar: bool = False
            ) -> tuple[Controller, FakeActuator]:
     import random
 
@@ -531,6 +536,10 @@ def _build(program: ScenarioProgram, kube_for_controller, kube: FakeKube,
             # must hold unchanged — sharded plans are byte-identical
             # to serial by contract.
             reconcile_shards=reconcile_shards, shard_min_gangs=0,
+            # ISSUE 17: --verify-columnar runs the Python planner as a
+            # property oracle next to the columnar fast path on every
+            # pass; mismatches are counted and fail the scenario.
+            verify_columnar_plans=verify_columnar,
             policy=PoolPolicy(spare_nodes=0,
                               max_total_chips=program.max_total_chips,
                               # ISSUE 11: spot-tier seeds provision
@@ -566,7 +575,8 @@ class _Run:
     """One scenario execution (pump mode)."""
 
     def __init__(self, program: ScenarioProgram,
-                 reconcile_shards: int = 0):
+                 reconcile_shards: int = 0,
+                 verify_columnar: bool = False):
         from tpu_autoscaler.k8s.objects import clear_parse_caches
 
         # Hermetic seeds: every FakeKube restarts uids/resourceVersions
@@ -581,9 +591,11 @@ class _Run:
             from tpu_autoscaler.k8s.informer import ClusterInformer
 
             self.informer = ClusterInformer(self.proxy, timeout_seconds=0)
+        self.verify_columnar = verify_columnar
         self.controller, self.actuator = _build(
             program, self.proxy, self.kube, self.informer,
-            reconcile_shards=reconcile_shards)
+            reconcile_shards=reconcile_shards,
+            verify_columnar=verify_columnar)
         self.monitor = InvariantMonitor(program.seed, self.kube,
                                         self.controller)
         # ISSUE 9: serving-profile scenarios drive a fuzzed replica
@@ -1036,10 +1048,17 @@ class _Run:
         if self.program.repack:
             self._check_repack(t)
         snap = self.controller.metrics.snapshot()
+        mismatches = int(snap["counters"].get(
+            "columnar_plan_mismatches", 0))
+        violations = [str(v) for v in self.monitor.violations]
+        if self.verify_columnar and mismatches:
+            violations.append(
+                f"columnar: {mismatches} plan mismatch(es) vs the "
+                "Python oracle under verify_columnar_plans")
         return ChaosResult(
             seed=program.seed,
-            ok=not self.monitor.violations,
-            violations=[str(v) for v in self.monitor.violations],
+            ok=not violations,
+            violations=violations,
             passes=self.passes, converged_at=converged_at,
             description=program.describe(),
             wall_seconds=_time.perf_counter() - t0,
@@ -1047,12 +1066,14 @@ class _Run:
             repairs=int(snap["counters"].get("slice_repairs_started",
                                              0)),
             repacks=int(snap["counters"].get(
-                "repack_migrations_started", 0)))
+                "repack_migrations_started", 0)),
+            columnar_mismatches=mismatches)
 
 
 def run_scenario(program_or_seed, *, profile: str = "mixed",
                  drive: str = "pump", schedules: int = 3,
-                 reconcile_shards: int = 0) -> ChaosResult:
+                 reconcile_shards: int = 0,
+                 verify_columnar: bool = False) -> ChaosResult:
     """Execute one scenario program (or generate it from a seed).
 
     ``drive="sched"`` replays the same program under the deterministic
@@ -1067,7 +1088,8 @@ def run_scenario(program_or_seed, *, profile: str = "mixed",
     program = (generate(program_or_seed, profile=profile)
                if isinstance(program_or_seed, int) else program_or_seed)
     if drive == "pump":
-        return _Run(program, reconcile_shards=reconcile_shards).execute()
+        return _Run(program, reconcile_shards=reconcile_shards,
+                    verify_columnar=verify_columnar).execute()
     if drive != "sched":
         raise ValueError(f"unknown drive mode {drive!r}")
     from tpu_autoscaler.testing.sched import run_schedule
@@ -1079,7 +1101,8 @@ def run_scenario(program_or_seed, *, profile: str = "mixed",
         # forced on — interleaving coverage is the point), then live
         # watch threads instead of the pump drive.
         run = _Run(dataclasses.replace(program, informer=True),
-                   reconcile_shards=reconcile_shards)
+                   reconcile_shards=reconcile_shards,
+                   verify_columnar=verify_columnar)
         run.informer.start()
         # Threads pump the caches; _step still calls pump() — with live
         # watches that is a no-op-ish double drain, so drop it.
@@ -1102,7 +1125,9 @@ def run_scenario(program_or_seed, *, profile: str = "mixed",
 def run_corpus(seeds, *, profile: str = "mixed",
                budget_seconds: float | None = None,
                progress=None,
-               reconcile_shards: int = 0) -> tuple[list[ChaosResult], bool]:
+               reconcile_shards: int = 0,
+               verify_columnar: bool = False
+               ) -> tuple[list[ChaosResult], bool]:
     """Run many seeds; returns (results, budget_blown).  Stops early —
     with the flag set — if the wall-clock budget runs out before the
     corpus completes, so CI fails loudly instead of hanging."""
@@ -1113,7 +1138,8 @@ def run_corpus(seeds, *, profile: str = "mixed",
                 and _time.perf_counter() - t0 > budget_seconds:
             return results, True
         result = run_scenario(seed, profile=profile,
-                              reconcile_shards=reconcile_shards)
+                              reconcile_shards=reconcile_shards,
+                              verify_columnar=verify_columnar)
         results.append(result)
         if progress is not None:
             progress(result)

@@ -150,6 +150,17 @@ class ControllerConfig:
     # shard_min_gangs (partition overhead must not tax small passes).
     reconcile_shards: int = 0
     shard_min_gangs: int = 16
+    # Columnar planner core (docs/PLANNER.md): run the planner's hot
+    # loops over the informer-maintained struct-of-arrays state
+    # (k8s/columnar.py) when its digest stamps prove it describes
+    # exactly this pass's observation — otherwise (or on any error)
+    # the Python planner runs alone, crash-only.  Composes with
+    # reconcile_shards (per-shard column slices).
+    columnar_planning: bool = True
+    # Testing/bench hook, the delta/shard landing pattern: plan every
+    # pass BOTH ways and count divergences (columnar_plan_mismatches);
+    # on mismatch the Python oracle's plan is adopted.
+    verify_columnar_plans: bool = False
     # Cost attribution ledger (ISSUE 11, docs/COST.md): the price book
     # pricing the $-proxy rollups; None = the built-in catalog-derived
     # book.  The ledger itself is always on — it rides the _maintain
@@ -267,6 +278,7 @@ class Controller:
         # observed via bypass/LIST and the legacy frozenset hash over
         # the observed lists applies).
         self._observed_digest: int | None = None
+        self._observed_cache_digests: tuple[int, int] | None = None
         # Sticky supply guard (_update_supply_guard): provisions that
         # went ACTIVE but whose supply units have not REGISTERED as
         # nodes yet.  The informer guard above closes the cache-lag
@@ -736,6 +748,8 @@ class Controller:
         lag only defers reclaim by a pass).
         """
         self._observed_digest = None
+        self._observed_cache_digests = None
+        self._columnar_memo = None
         if self.informer is None:
             pods = [Pod(p) for p in self.client.list_pods()]
             return ([Node(p) for p in self.client.list_nodes()], pods,
@@ -753,14 +767,24 @@ class Controller:
             else:
                 self._nodes_awaiting_cache = (
                     {n.name for n in nodes} - {n.name for n in snap})
-        elif hasattr(self.informer, "observe_with_digest"):
+        elif hasattr(self.informer, "observe_with_digests"):
             # The one-lock-hold-per-cache read: snapshots AND the
             # store digests describing exactly them (watch threads
             # keep the caches moving mid-pass, so a digest read any
             # later could stamp this pass's record with the NEXT
             # pass's world; review-found).  None = a cache unsynced —
             # fall through to the LIST-fallback reads below and the
-            # legacy per-list digest.
+            # legacy per-list digest.  The raw (node, pod) digest pair
+            # additionally gates attaching the columnar view's state
+            # to this pass (docs/PLANNER.md).
+            obs = self.informer.observe_with_digests()
+            if obs is not None:
+                nodes, pods, pending, digest, node_d, pod_d = obs
+                self._observed_digest = digest
+                self._observed_cache_digests = (node_d, pod_d)
+                return nodes, pods, pending
+            nodes = self.informer.nodes()
+        elif hasattr(self.informer, "observe_with_digest"):
             obs = self.informer.observe_with_digest()
             if obs is not None:
                 nodes, pods, pending, digest = obs
@@ -2190,6 +2214,55 @@ class Controller:
         self.metrics.set_gauge("gangs_settling", settling)
         return out
 
+    def _attach_columnar(self, nodes: list[Node], pods: list[Pod]):
+        """The informer-maintained columnar planner state for THIS
+        pass (docs/PLANNER.md), or None to plan purely in Python.
+
+        Attachment is gated three ways, all crash-only: the view must
+        refresh (both caches synced), its digest stamps must equal the
+        store digests captured with this pass's observation (the watch
+        threads may have moved the caches since ``_observe``), and the
+        cheap ``attachable`` alignment check must pass.  Any failure
+        or mismatch just forfeits the fast path for one pass —
+        ``columnar_passes``/``columnar_stale``/``columnar_fallbacks``
+        count how often each happens.
+        """
+        if (not self.config.columnar_planning or self.informer is None
+                or not hasattr(self.informer, "columnar_view")):
+            return None
+        # One attach (and one set of counters) per pass: _scale and
+        # _maintain plan over the same observation.  The memo is reset
+        # in _observe, so the id() pair can never alias across passes.
+        memo = getattr(self, "_columnar_memo", None)
+        if memo is not None and memo[0] == (id(nodes), id(pods)):
+            return memo[1]
+        state = self._attach_columnar_uncached(nodes, pods)
+        self._columnar_memo = ((id(nodes), id(pods)), state)
+        return state
+
+    def _attach_columnar_uncached(self, nodes: list[Node],
+                                  pods: list[Pod]):
+        try:
+            state = self.informer.columnar_view().refresh()
+            if state is None:
+                self.metrics.inc("columnar_fallbacks")
+                return None
+            digests = self._observed_cache_digests
+            if (digests is None
+                    or state.node_digest != digests[0]
+                    or state.pod_digest != digests[1]
+                    or not state.attachable(nodes, pods)):
+                self.metrics.inc("columnar_stale")
+                return None
+            self.metrics.inc("columnar_passes")
+            return state
+        except Exception:  # noqa: BLE001 — the columnar state is a
+            # pure optimization; the Python planner carries the pass.
+            self.metrics.inc("columnar_fallbacks")
+            log.exception("columnar attach failed; Python planner "
+                          "this pass")
+            return None
+
     # ---- scale-up ------------------------------------------------------ #
 
     def _scale(self, gangs: list[Gang], nodes: list[Node],
@@ -2212,6 +2285,7 @@ class Controller:
         overrides = self._generation_overrides(all_gangs, now)
         t_plan = time.perf_counter()
         in_flight = self._in_flight()
+        columnar = self._attach_columnar(nodes, pods)
         if self.sharder is not None and not self.config.enable_preemption:
             # Sharded planning (ISSUE 13): byte-identical to the
             # serial call below by the merge contract; preemption
@@ -2220,13 +2294,39 @@ class Controller:
             plan = self.sharder.plan(
                 gangs, nodes, pods, in_flight,
                 generation_overrides=overrides, advisory_gangs=advisory,
-                candidate_accels=self._candidate_accels)
+                candidate_accels=self._candidate_accels,
+                columnar=columnar)
             self._pass_plan_info["sharding"] = dict(
                 self.sharder.last_info)
         else:
             plan = self.planner.plan(gangs, nodes, pods, in_flight,
                                      generation_overrides=overrides,
-                                     advisory_gangs=advisory)
+                                     advisory_gangs=advisory,
+                                     columnar=columnar)
+        if columnar is not None and self.config.verify_columnar_plans:
+            # Parity gate (docs/PLANNER.md, the delta/shard landing
+            # pattern): the Python planner is the property oracle —
+            # replan without the columnar state and gate byte-identical
+            # decisions.  On mismatch the oracle's plan is ADOPTED, so
+            # verify mode cannot actuate a columnar bug.
+            oracle = self.planner.plan(gangs, nodes, pods, in_flight,
+                                       generation_overrides=overrides,
+                                       advisory_gangs=advisory)
+            same = (oracle.requests == plan.requests
+                    and [(g.key, r) for g, r in oracle.unsatisfiable]
+                    == [(g.key, r) for g, r in plan.unsatisfiable]
+                    and [(g.key, r) for g, r in oracle.deferred]
+                    == [(g.key, r) for g, r in plan.deferred])
+            if not same:
+                self.metrics.inc("columnar_plan_mismatches")
+                log.error(
+                    "columnar plan diverged from the Python oracle: "
+                    "%d vs %d requests; adopting the oracle's plan",
+                    len(plan.requests), len(oracle.requests))
+                self._explain("planner", "columnar plan mismatch",
+                              f"columnar={len(plan.requests)} "
+                              f"oracle={len(oracle.requests)} requests")
+                plan = oracle
         self._pass_plan_s = time.perf_counter() - t_plan
         for gang, reason in plan.deferred:
             # Advisory demand waiting for clamp/quota headroom:
@@ -2941,21 +3041,26 @@ class Controller:
 
     def _claimed_by_pending(self, units: dict[str, list[Node]],
                             pending_gangs: list[Gang],
-                            pods: list[Pod]) -> set[str]:
+                            pods: list[Pod],
+                            columnar=None) -> set[str]:
         """Units that currently-pending demand will bind to: NOT
         drainable.  The scan itself is a pure function
         (controller/shard.py claimed_by_pending — O(units × gangs),
         the maintenance pass's superlinear term); with sharding on and
         enough demand it partitions by accelerator class/pool across
-        the same worker pool as planning (ISSUE 13)."""
+        the same worker pool as planning (ISSUE 13).  With a columnar
+        state attached to the pass it vectorizes instead
+        (engine/columnar.py ``claimed_units``)."""
         from tpu_autoscaler.controller import shard
 
         if (self.sharder is not None
                 and len(pending_gangs) >= self.config.shard_min_gangs):
             return self.sharder.claimed_by_pending(
                 units, pending_gangs, pods,
-                candidate_accels=self._candidate_accels)
-        return shard.claimed_by_pending(units, pending_gangs, pods)
+                candidate_accels=self._candidate_accels,
+                columnar=columnar)
+        return shard.claimed_by_pending(units, pending_gangs, pods,
+                                        columnar=columnar)
 
     def _maintain(self, nodes: list[Node], pods: list[Pod],
                   now: float, pending_gangs: list[Gang] = ()) -> None:
@@ -2967,8 +3072,9 @@ class Controller:
 
         units = self._units(nodes)
         spare_ids = self._spare_units(units, pods_by_node)
-        claimed_ids = self._claimed_by_pending(units, list(pending_gangs),
-                                               pods)
+        claimed_ids = self._claimed_by_pending(
+            units, list(pending_gangs), pods,
+            columnar=self._attach_columnar(nodes, pods))
         state_counts: dict[str, int] = {}
         # At most one consolidation drain per pass: gentle repacking, no
         # mass eviction (the reference drained under-utilized nodes one

@@ -47,7 +47,10 @@ import os
 import time
 from typing import Callable, Sequence
 
+import numpy as np
+
 from tpu_autoscaler import concurrency
+from tpu_autoscaler.engine.columnar import ColumnarState, claimed_units
 from tpu_autoscaler.engine.fitter import free_capacity
 from tpu_autoscaler.engine.planner import Planner, PoolPolicy, ScalePlan
 from tpu_autoscaler.k8s.gangs import Gang
@@ -87,7 +90,8 @@ def node_part(node: Node) -> PartKey:
 
 def claimed_by_pending(units: dict[str, list[Node]],
                        pending_gangs: list[Gang],
-                       pods: list[Pod]) -> set[str]:
+                       pods: list[Pod],
+                       columnar: ColumnarState | None = None) -> set[str]:
     """Units that currently-pending demand will bind to: NOT drainable.
 
     Reference parity: the reference's state machine checked "whether
@@ -107,6 +111,18 @@ def claimed_by_pending(units: dict[str, list[Node]],
     tpu_gangs = [g for g in pending_gangs if g.requests_tpu]
     cpu_pods = [p for g in pending_gangs if not g.requests_tpu
                 for p in g.pods]
+    if columnar is not None and columnar.n_pods == len(pods):
+        try:
+            got = claimed_units(columnar, units, tpu_gangs, cpu_pods,
+                                _slice_satisfies)
+            if got is not None:
+                return got
+        except Exception:  # noqa: BLE001 — crash-only: a columnar bug
+            # degrades the claim scan to the Python oracle loop below.
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "columnar claim scan failed; Python fallback")
     for unit_id, unit_nodes in units.items():
         if unit_nodes[0].is_tpu:
             if any(_slice_satisfies(unit_nodes, g) for g in tpu_gangs):
@@ -333,6 +349,7 @@ class _ShardWork:
     in_flight: Sequence
     gen_overrides: dict
     extra_existing_chips: int
+    columnar: ColumnarState | None = None  # per-shard column slice
 
 
 @dataclasses.dataclass
@@ -352,7 +369,8 @@ def _plan_shard(work: _ShardWork) -> _ShardOutcome:
         work.gangs, work.nodes, work.pods, work.in_flight,
         generation_overrides=work.gen_overrides,
         advisory_gangs=work.advisory,
-        extra_existing_chips=work.extra_existing_chips)
+        extra_existing_chips=work.extra_existing_chips,
+        columnar=work.columnar)
     planned = sum(shape_by_name(r.shape_name).chips * r.count
                   for r in plan.requests if r.kind == "tpu-slice")
     return _ShardOutcome(index=work.index, plan=plan,
@@ -453,10 +471,14 @@ class ShardedPlanner:
              advisory_gangs: Sequence[tuple[Gang, str]] = (),
              candidate_accels: Callable[[Gang], tuple[str, ...]] = (
                  lambda g: ()),
+             columnar: ColumnarState | None = None,
              ) -> ScalePlan:
         """The sharded twin of ``Planner.plan`` — byte-identical
         output, with ``self.last_info`` describing how the pass ran
-        (for the pass record's ``planning.sharding`` section)."""
+        (for the pass record's ``planning.sharding`` section).
+        ``columnar`` (engine/columnar.py) rides along: serial
+        fallbacks hand it to the serial planner whole; the sharded
+        path slices it per shard with ``ColumnarState.take``."""
         advisory = list(advisory_gangs)
         reason = self._serial_reason(gangs, advisory,
                                      self.planner.policy)
@@ -467,11 +489,12 @@ class ShardedPlanner:
             return self.planner.plan(
                 gangs, nodes, pods, in_flight,
                 generation_overrides=generation_overrides,
-                advisory_gangs=advisory)
+                advisory_gangs=advisory, columnar=columnar)
         try:
             plan, info = self._plan_sharded(
                 gangs, nodes, pods, in_flight,
-                generation_overrides or {}, advisory, candidate_accels)
+                generation_overrides or {}, advisory, candidate_accels,
+                columnar)
             self.last_info = info
             return plan
         except ShardConflict as e:
@@ -493,10 +516,11 @@ class ShardedPlanner:
         return self.planner.plan(
             gangs, nodes, pods, in_flight,
             generation_overrides=generation_overrides,
-            advisory_gangs=advisory)
+            advisory_gangs=advisory, columnar=columnar)
 
     def _plan_sharded(self, gangs, nodes, pods, in_flight,
-                      gen_overrides, advisory, candidate_accels):
+                      gen_overrides, advisory, candidate_accels,
+                      columnar=None):
         policy = self.planner.policy
         part = partition(gangs, advisory, nodes, policy,
                          candidate_accels, self.shards)
@@ -509,27 +533,61 @@ class ShardedPlanner:
         shard_nodes: list[list[Node]] = [[] for _ in
                                          range(part.n_buckets)]
         node_bucket: dict[str, int] = {}
+        node_rows: list[list[int]] = [[] for _ in range(part.n_buckets)]
+        node_bucket_arr = np.empty(len(nodes), np.int32)
         existing_total = 0
         shard_chips = [0] * part.n_buckets
-        for n in nodes:
+        for row, n in enumerate(nodes):
             b = part.bucket_of_node(n)
             if b is None:
                 b = part.cpu_bucket
             shard_nodes[b].append(n)
             node_bucket[n.name] = b
+            node_rows[b].append(row)
+            node_bucket_arr[row] = b
             if n.is_tpu:
                 chips = int(n.allocatable.get(TPU_RESOURCE))
                 existing_total += chips
                 shard_chips[b] += chips
-        shard_pods: list[list[Pod]] = [[] for _ in range(part.n_buckets)]
         gang_bucket = part.bucket_of_gang
-        for p in pods:
-            if p.node_name:
-                b = node_bucket.get(p.node_name)
-            else:
-                b = gang_bucket.get(p.gang_key)
-            if b is not None:
-                shard_pods[b].append(p)
+        cstate: ColumnarState | None = None
+        if columnar is not None:
+            try:
+                if columnar.attachable(nodes, pods):
+                    cstate = columnar
+            except Exception:  # noqa: BLE001 — crash-only: a stale or
+                # torn columnar state only forfeits the fast routing;
+                # the dict-lookup loop below is always correct.
+                cstate = None
+        shard_pods: list[list[Pod]] = [[] for _ in range(part.n_buckets)]
+        pod_rows: list[np.ndarray] = []
+        if cstate is not None:
+            # Vectorized pod routing over the columnar view: a bound
+            # pod follows its node's bucket; an unbound pod follows
+            # its gang's bucket; pods bound to unknown nodes (row -1)
+            # and gang-less strays drop, exactly like the dict path.
+            bucket = np.full(cstate.n_pods, -1, np.int32)
+            bound = cstate.p_has_node & (cstate.p_node_row >= 0)
+            bucket[bound] = node_bucket_arr[cstate.p_node_row[bound]]
+            gb = np.full(len(cstate.gang_keys) + 1, -1, np.int32)
+            for gi, gkey in enumerate(cstate.gang_keys):
+                got = gang_bucket.get(gkey)
+                if got is not None:
+                    gb[gi] = got
+            unbound = ~cstate.p_has_node
+            bucket[unbound] = gb[cstate.p_gang[unbound]]
+            for b in range(part.n_buckets):
+                rows = np.flatnonzero(bucket == b)
+                pod_rows.append(rows)
+                shard_pods[b] = [pods[i] for i in rows]
+        else:
+            for p in pods:
+                if p.node_name:
+                    b = node_bucket.get(p.node_name)
+                else:
+                    b = gang_bucket.get(p.gang_key)
+                if b is not None:
+                    shard_pods[b].append(p)
         shard_gangs: list[list[Gang]] = [[] for _ in
                                          range(part.n_buckets)]
         for g in gangs:
@@ -559,12 +617,21 @@ class ShardedPlanner:
                 spare_nodes=policy.spare_nodes if is_cpu else 0,
                 over_provision_nodes=(policy.over_provision_nodes
                                       if is_cpu else 0))
+            shard_cols: ColumnarState | None = None
+            if cstate is not None:
+                try:
+                    shard_cols = cstate.take(
+                        np.asarray(node_rows[b], np.int64), pod_rows[b])
+                except Exception:  # noqa: BLE001 — crash-only: the
+                    # shard just plans on Python objects instead.
+                    shard_cols = None
             works.append(_ShardWork(
                 index=b, planner=Planner(shard_policy),
                 gangs=shard_gangs[b], advisory=shard_adv[b],
                 nodes=shard_nodes[b], pods=shard_pods[b],
                 in_flight=in_flight, gen_overrides=gen_overrides,
-                extra_existing_chips=existing_total - shard_chips[b]))
+                extra_existing_chips=existing_total - shard_chips[b],
+                columnar=shard_cols))
 
         outcomes = self._run(works, _plan_shard)
         plan = self._merge(outcomes, in_flight, policy,
@@ -586,11 +653,35 @@ class ShardedPlanner:
     def claimed_by_pending(self, units: dict[str, list[Node]],
                            pending_gangs: list[Gang],
                            pods: list[Pod],
-                           candidate_accels) -> set[str]:
+                           candidate_accels,
+                           columnar: ColumnarState | None = None
+                           ) -> set[str]:
         """Sharded twin of :func:`claimed_by_pending` — the maintain
         pass's superlinear term, partitioned exactly like planning (a
         unit can only be claimed by gangs of its own component).
-        Crash-only: any failure degrades to the serial scan."""
+        Crash-only: any failure degrades to the serial scan.
+
+        When a :class:`ColumnarState` aligned with ``pods`` is handed
+        in, the vectorized claim scan (engine/columnar.py
+        ``claimed_units``) answers serially — it is already faster
+        than the sharded Python fan-out, so no partition is needed."""
+        if columnar is not None and columnar.n_pods == len(pods):
+            try:
+                from tpu_autoscaler.engine.planner import _slice_satisfies
+
+                tpu_gangs = [g for g in pending_gangs if g.requests_tpu]
+                cpu_pods = [p for g in pending_gangs
+                            if not g.requests_tpu for p in g.pods]
+                got = claimed_units(columnar, units, tpu_gangs,
+                                    cpu_pods, _slice_satisfies)
+                if got is not None:
+                    return got
+            except Exception:  # noqa: BLE001 — crash-only: fall back
+                # to the sharded Python scan below.
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "columnar claim scan failed; sharded fallback")
         try:
             # One representative node per unit is enough for the
             # partition to learn which (class, pool) keys exist — a

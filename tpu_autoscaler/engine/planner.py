@@ -20,6 +20,12 @@ import dataclasses
 import logging
 from typing import Any, Sequence
 
+from tpu_autoscaler.engine.columnar import (
+    ColumnarMatcher,
+    ColumnarState,
+    PlanColumns,
+    slice_is_free,
+)
 from tpu_autoscaler.engine.fitter import (
     FitError,
     ShapeChoice,
@@ -179,8 +185,13 @@ def _free_slices(nodes: list[Node], pods: list[Pod]) -> dict[str, list[Node]]:
                                        + pod.resources.get(TPU_RESOURCE))
     free: dict[str, list[Node]] = {}
     for slice_id, members in by_slice.items():
-        if all(n.is_ready and not n.unschedulable
-               and used_tpu.get(n.name, 0.0) == 0 for n in members):
+        # The ONE free-slice predicate, shared with the informer's
+        # CapacityView.free_slice and the columnar mask
+        # (engine/columnar.slice_free_mask) so the three cannot drift.
+        ready = sum(1 for n in members
+                    if n.is_ready and not n.unschedulable)
+        used = sum(used_tpu.get(n.name, 0.0) for n in members)
+        if slice_is_free(True, len(members), ready, used):
             free[slice_id] = members
     return free
 
@@ -229,14 +240,21 @@ def _slice_satisfies(members: list[Node], gang: Gang) -> bool:
 
 
 def _chips_by_namespace(pods: list[Pod],
-                        in_flight: list[InFlight]) -> dict[str, int]:
+                        in_flight: list[InFlight],
+                        base: dict[str, int] | None = None
+                        ) -> dict[str, int]:
     """TPU chips per namespace: bound (Pending/Running) pods plus
     in-flight slice provisions.  The single source of truth for both
-    quota enforcement and fair-share ordering."""
-    used: dict[str, int] = {}
-    for p in pods:
-        if p.node_name and p.phase in {"Pending", "Running"}:
-            used[p.namespace] = used.get(p.namespace, 0) + p.tpu_chips
+    quota enforcement and fair-share ordering.  ``base`` supplies the
+    bound-pod part precomputed (the columnar twin) so the in-flight
+    additions stay single-sourced here."""
+    if base is not None:
+        used = dict(base)
+    else:
+        used = {}
+        for p in pods:
+            if p.node_name and p.phase in {"Pending", "Running"}:
+                used[p.namespace] = used.get(p.namespace, 0) + p.tpu_chips
     for f in in_flight:
         if f.kind == "tpu-slice" and f.gang_key:
             ns = f.gang_key[1]
@@ -370,7 +388,8 @@ class Planner:
              in_flight: Sequence[InFlight] = (),
              generation_overrides: dict[GangKey, str] | None = None,
              advisory_gangs: Sequence[tuple[Gang, str]] = (),
-             extra_existing_chips: int = 0) -> ScalePlan:
+             extra_existing_chips: int = 0,
+             columnar: ColumnarState | None = None) -> ScalePlan:
         """``generation_overrides`` maps a gang key to the TPU generation
         to fit it on instead of the policy default — the controller sets
         it from failure streaks (capacity stockout fallback).
@@ -394,7 +413,15 @@ class Planner:
         shard against its own node slice while the max_total_chips
         clamp stays fleet-global, so the sharder passes the
         complement's chip total here.  0 (the default, and the serial
-        path) means ``nodes`` IS the fleet."""
+        path) means ``nodes`` IS the fleet.
+
+        ``columnar`` is the struct-of-arrays twin of ``(nodes, pods)``
+        (engine/columnar.py, docs/PLANNER.md): when it aligns, the
+        free-slice / admission / claim hot loops run vectorized with
+        value-identical results; any misalignment or columnar error
+        degrades to the Python loops silently (crash-only).  The
+        Python path stays the property oracle — ``verify_columnar_
+        plans`` replans with ``columnar=None`` and compares."""
         plan = ScalePlan()
         pol = self.policy
         gen_override = generation_overrides or {}
@@ -402,13 +429,39 @@ class Planner:
         tpu_gangs = [g for g in gangs if g.requests_tpu]
         cpu_pods = [p for g in gangs if not g.requests_tpu for p in g.pods]
 
+        # ---- columnar fast path (engine/columnar.py) ---------------------
+        # Attach only when the state provably aligns with (nodes, pods);
+        # every consumer below falls back to its Python twin on any error.
+        cols: PlanColumns | None = None
+        matcher: ColumnarMatcher | None = None
+        free: dict[str, list[Node]] | None = None
+        existing_cols: int | None = None
+        ns_base: dict[str, int] | None = None
+        if columnar is not None:
+            try:
+                if columnar.attachable(nodes, pods):
+                    cols = PlanColumns(columnar)
+                    free, _free_mask = cols.free_slices()
+                    matcher = ColumnarMatcher(cols, _slice_satisfies)
+                    existing_cols = cols.existing_tpu_chips()
+                    if pol.namespace_chip_quota or pol.fair_share:
+                        ns_base = cols.chips_by_namespace()
+            except Exception:  # noqa: BLE001 — crash-only: a columnar
+                # bug degrades to the Python oracle path, never fails
+                # the plan pass.
+                log.exception("columnar attach failed; Python fallback")
+                cols = matcher = None
+                free = existing_cols = ns_base = None
+
         # ---- TPU path: one slice per unserved gang -----------------------
-        free = _free_slices(nodes, pods)
+        if free is None:
+            free = _free_slices(nodes, pods)
         claimed: set[str] = set()
         served_keys = {f.gang_key for f in in_flight if f.gang_key}
-        existing_chips = extra_existing_chips + sum(
-            int(n.allocatable.get(TPU_RESOURCE))
-            for n in nodes if n.is_tpu)
+        existing_chips = extra_existing_chips + (
+            existing_cols if existing_cols is not None else sum(
+                int(n.allocatable.get(TPU_RESOURCE))
+                for n in nodes if n.is_tpu))
         inflight_chips = sum(shape_by_name(f.shape_name).chips * f.count
                              for f in in_flight if f.kind == "tpu-slice")
         planned_chips = 0
@@ -418,7 +471,7 @@ class Planner:
         # with planned chips at each admission) serves BOTH quota
         # enforcement and fair-share ordering — one algebra, no drift.
         ns_chips: dict[str, int] = (
-            _chips_by_namespace(pods, in_flight)
+            _chips_by_namespace(pods, in_flight, base=ns_base)
             if pol.namespace_chip_quota or pol.fair_share else {})
 
         # Gang keys served by THIS plan's organic pass (free-slice match
@@ -457,6 +510,16 @@ class Planner:
             return partial_state
 
         def match_free(gang: Gang) -> str | None:
+            nonlocal matcher
+            if matcher is not None:
+                # Vectorized scan, candidate order identical to the dict
+                # walks below (docs/PLANNER.md).
+                try:
+                    return matcher.match(gang, claimed)
+                except Exception:  # noqa: BLE001 — crash-only: degrade
+                    # to the Python scan for the rest of the pass.
+                    log.exception("columnar match failed; Python fallback")
+                    matcher = None
             # An existing fully-free matching slice satisfies the gang; the
             # scheduler will bind it — provisioning would strand chips.
             sid = next(
@@ -694,7 +757,15 @@ class Planner:
 
         # ---- CPU path: first-fit pack, then spare + over-provision -------
         cpu_nodes = [n for n in nodes if not n.is_tpu]
-        free_cpu = free_capacity(cpu_nodes, pods)
+        free_cpu: dict[str, ResourceVector] | None = None
+        if cols is not None:
+            try:
+                free_cpu = cols.free_cpu_capacity()
+            except Exception:  # noqa: BLE001 — crash-only fallback
+                log.exception("columnar free_capacity failed; fallback")
+                free_cpu = None
+        if free_cpu is None:
+            free_cpu = free_capacity(cpu_nodes, pods)
         pending_cpu = [p for p in cpu_pods if p.is_unschedulable]
         inflight_cpu = sum(f.count for f in in_flight
                            if f.kind == "cpu-node")
@@ -767,14 +838,22 @@ class Planner:
         # Spare: keep at least N workload-free CPU nodes warm.  "Free" means
         # no non-daemonset/non-mirror pods — daemonsets run on every node
         # and must not disqualify a node from being spare.
-        workload_nodes = {
-            p.node_name for p in pods
-            if p.node_name and p.phase in {"Pending", "Running"}
-            and not p.is_daemonset and not p.is_mirrored}
-        fully_free = sum(
-            1 for n in cpu_nodes
-            if n.is_ready and not n.unschedulable
-            and n.name not in workload_nodes)
+        fully_free = -1
+        if cols is not None:
+            try:
+                fully_free = cols.fully_free_cpu()
+            except Exception:  # noqa: BLE001 — crash-only fallback
+                log.exception("columnar fully_free failed; fallback")
+                fully_free = -1
+        if fully_free < 0:
+            workload_nodes = {
+                p.node_name for p in pods
+                if p.node_name and p.phase in {"Pending", "Running"}
+                and not p.is_daemonset and not p.is_mirrored}
+            fully_free = sum(
+                1 for n in cpu_nodes
+                if n.is_ready and not n.unschedulable
+                and n.name not in workload_nodes)
         spare_shortfall = max(
             0, pol.spare_nodes - fully_free - inflight_cpu - demand_needed)
         extras += spare_shortfall

@@ -1,0 +1,432 @@
+"""Incrementally-maintained columnar planner state (docs/PLANNER.md).
+
+``engine/columnar.py`` defines :class:`ColumnarState` — a pure
+struct-of-arrays *value* the planner's hot loops run over — and its
+from-scratch constructor ``ColumnarState.build`` (the churn suite's
+oracle).  Rebuilding that value every pass would cost O(pods); this
+module is the informer-side twin of :class:`~tpu_autoscaler.k8s.informer.
+CapacityView`: a :class:`ColumnarView` registers on both object caches
+and folds churn into grow-only column buffers, so a steady-state refresh
+costs O(deltas) and an export costs one gather.
+
+Maintenance contract:
+
+* **Pod side is incremental** (the million-row side).  The pod cache's
+  ordered dirty-KEY event log (``ObjectCache.drain_dirty_keys``)
+  preserves delta order, so replaying it reproduces the store dict's
+  exact insertion order: a MODIFIED pod updates its row in place, a
+  DELETED pod marks its row dead (live rows keep their relative order),
+  a re-ADDED pod appends — exactly the order ``snapshot()`` will list.
+  Dead rows are skipped at export and compacted away past a threshold.
+* **Node side rebuilds on node churn** (the thousand-row side).  Any
+  node dirty tag triggers an O(nodes) column rebuild; pod rows are then
+  relinked through the view's own name->rows reverse index — only names
+  whose row mapping actually changed are touched.
+* **Export is copy-on-read.**  ``refresh()`` returns a
+  :class:`ColumnarState` whose arrays are gathered copies of the live
+  rows, stamped with the per-cache store digests captured under the
+  same lock hold as the deltas they describe — the reconciler attaches
+  the state to a pass only when those stamps equal the digests of the
+  observation the pass planned from, and falls back to the Python
+  planner otherwise (crash-only, like every informer optimization).
+  The returned state is cached until the next mutation.
+
+Single-consumer, like CapacityView: the reconcile loop (or a bench)
+owns the view and calls ``refresh()`` at most once per pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from tpu_autoscaler.engine.columnar import (
+    _ACTIVE_PHASES,
+    ColumnarState,
+    NodeTemplates,
+    _allocatable_axes,
+    build_groups,
+    pod_sig,
+)
+from tpu_autoscaler.k8s.informer import ObjectCache
+from tpu_autoscaler.k8s.units import unit_key_of
+from tpu_autoscaler.topology.catalog import TPU_RESOURCE
+
+_MIN_CAP = 64
+
+
+class ColumnarView:
+    """O(churn) maintenance of the planner's struct-of-arrays state."""
+
+    _SEQ = [0]
+
+    def __init__(self, node_cache: ObjectCache,
+                 pod_cache: ObjectCache) -> None:
+        self._node_cache = node_cache
+        self._pod_cache = pod_cache
+        ColumnarView._SEQ[0] += 1
+        self._consumer = f"columnar-{ColumnarView._SEQ[0]}"
+        node_cache.watch_dirty(self._consumer)
+        pod_cache.watch_dirty_keys(self._consumer)
+        self.templates = NodeTemplates()  # grow-only across rebuilds
+        # -- node side (rebuilt wholesale on node churn) --
+        self._nodes: list[Any] = []
+        self._n_ready = np.zeros(0, bool)
+        self._n_sched = np.zeros(0, bool)
+        self._n_is_tpu = np.zeros(0, bool)
+        self._n_chips = np.zeros(0, np.int64)
+        self._n_tmpl = np.zeros(0, np.int32)
+        self._slice_gid = np.zeros(0, np.int32)
+        self._unit_gid = np.zeros(0, np.int32)
+        self._slices = build_groups([], self._n_tmpl, self._n_chips)[0]
+        self._units = build_groups([], self._n_tmpl, self._n_chips)[0]
+        self._node_row_of: dict[str, int] = {}
+        self._node_digest: int | None = None
+        # -- pod side (incremental; grow-only buffers + dead marks) --
+        self._n = 0
+        self._cap = _MIN_CAP
+        self._dead = np.zeros(self._cap, bool)
+        self._dead_count = 0
+        self._p_node_row = np.zeros(self._cap, np.int32)
+        self._p_has_node = np.zeros(self._cap, bool)
+        self._p_active = np.zeros(self._cap, bool)
+        self._p_workload = np.zeros(self._cap, bool)
+        self._p_tpu = np.zeros(self._cap, np.float64)
+        self._p_tpu_chips = np.zeros(self._cap, np.int64)
+        self._p_gang = np.zeros(self._cap, np.int32)
+        self._p_ns = np.zeros(self._cap, np.int32)
+        self._p_axes: list[Any] = []
+        self._keys: list[str] = []           # store key per row
+        self._sigs: list[tuple] = []         # (uid-or-name, rv) per row
+        self._names: list[str | None] = []   # node name per row
+        self._row_of: dict[str, int] = {}
+        self._rows_by_nodename: dict[str, set[int]] = {}
+        self._pod_digest: int | None = None
+        # -- interned ids (grow-only; shared with exported states) --
+        self._gang_keys: list[Any] = []
+        self._gang_ids: dict[Any, int] = {}
+        self._ns_keys: list[str] = []
+        self._ns_ids: dict[str, int] = {}
+        self._axes: list[str] = []
+        self._axis_ids: dict[str, int] = {}
+        self._export: ColumnarState | None = None
+        #: Counters the reconciler copies into metrics after refresh.
+        self.rebuilds = 0
+        self.events_applied = 0
+
+    def close(self) -> None:
+        """Detach from the caches (a dangling registration costs per-
+        delta log work forever)."""
+        self._node_cache.unwatch_dirty(self._consumer)
+        self._pod_cache.unwatch_dirty_keys(self._consumer)
+
+    # -- the per-pass entry point -----------------------------------------
+
+    def refresh(self) -> ColumnarState | None:
+        """Fold pending churn in; the current state, or None when
+        either cache is unsynced (use the Python planner this pass)."""
+        if not (self._node_cache.synced and self._pod_cache.synced):
+            return None
+        node_dirty = self._node_cache.drain_dirty(self._consumer)
+        if node_dirty is None or node_dirty:
+            if not self._rebuild_nodes():
+                return None
+        events, lookup, digest, synced = (
+            self._pod_cache.drain_dirty_keys(self._consumer))
+        if not synced:
+            return None
+        if events is None:
+            if not self._rebuild_pods():
+                return None
+        else:
+            if events:
+                self._replay(events, lookup)
+                self._export = None
+                self.events_applied += len(events)
+            self._pod_digest = digest
+        if self._dead_count > max(1024,
+                                  (self._n - self._dead_count) // 8):
+            self._compact()
+        return self._export_state()
+
+    # -- node side ---------------------------------------------------------
+
+    def _rebuild_nodes(self) -> bool:
+        snap = self._node_cache.snapshot_with_digest()
+        if snap is None:
+            return False
+        nodes, digest = snap
+        n = len(nodes)
+        n_ready = np.zeros(n, bool)
+        n_sched = np.zeros(n, bool)
+        n_is_tpu = np.zeros(n, bool)
+        n_chips = np.zeros(n, np.int64)
+        n_tmpl = np.zeros(n, np.int32)
+        slice_keys: list[str | None] = [None] * n
+        unit_keys: list[str | None] = [None] * n
+        row_of: dict[str, int] = {}
+        templates = self.templates
+        for i, nd in enumerate(nodes):
+            n_ready[i] = nd.is_ready
+            n_sched[i] = not nd.unschedulable
+            n_is_tpu[i] = nd.is_tpu
+            tid = templates.template_of(nd)
+            n_tmpl[i] = tid
+            n_chips[i] = templates.chips[tid]
+            if nd.is_tpu and nd.slice_id:
+                slice_keys[i] = nd.slice_id
+            unit_keys[i] = unit_key_of(nd)
+            row_of[nd.name] = i
+        self._slices, self._slice_gid = build_groups(slice_keys, n_tmpl,
+                                                     n_chips)
+        self._units, self._unit_gid = build_groups(unit_keys, n_tmpl,
+                                                   n_chips)
+        # The free-vector twin iterates state.axes — allocatable-only
+        # axes (no pod requests them) must still be registered.
+        for axis in _allocatable_axes(templates):
+            self._ensure_axis(axis)
+        self._nodes = nodes
+        self._n_ready, self._n_sched = n_ready, n_sched
+        self._n_is_tpu, self._n_chips = n_is_tpu, n_chips
+        self._n_tmpl = n_tmpl
+        # Relink bound pods whose node row moved (or whose node just
+        # appeared/vanished) through the view's own reverse index — no
+        # cache reads, so rows and links can never be inconsistent.
+        old_row_of = self._node_row_of
+        for name, rows in self._rows_by_nodename.items():
+            new = row_of.get(name, -1)
+            if new != old_row_of.get(name, -1) and rows:
+                idx = np.fromiter(rows, np.int64, count=len(rows))
+                self._p_node_row[idx] = new
+        self._node_row_of = row_of
+        self._node_digest = digest
+        self._export = None
+        self.rebuilds += 1
+        return True
+
+    # -- pod side ----------------------------------------------------------
+
+    def _rebuild_pods(self) -> bool:
+        snap = self._pod_cache.snapshot_items_with_digest()
+        if snap is None:
+            return False
+        items, digest = snap
+        n = len(items)
+        self._n = 0
+        self._cap = max(_MIN_CAP, n)
+        self._dead = np.zeros(self._cap, bool)
+        self._dead_count = 0
+        for field in ("_p_node_row", "_p_has_node", "_p_active",
+                      "_p_workload", "_p_tpu", "_p_tpu_chips",
+                      "_p_gang", "_p_ns"):
+            old = getattr(self, field)
+            setattr(self, field, np.zeros(self._cap, old.dtype))
+        self._p_axes = [np.zeros(self._cap, np.float64)
+                        for _ in self._axes]
+        self._keys, self._sigs, self._names = [], [], []
+        self._row_of = {}
+        self._rows_by_nodename = {}
+        for key, p in items:
+            self._append_row(key, p)
+        self._pod_digest = digest
+        self._export = None
+        self.rebuilds += 1
+        return True
+
+    def _replay(self, events: list[tuple[str, str]],
+                lookup: dict[str, Any]) -> None:
+        for op, key in events:
+            row = self._row_of.get(key)
+            if op == "del":
+                if row is not None:
+                    self._kill_row(key, row)
+                continue
+            p = lookup.get(key)
+            if p is None:
+                # Set then deleted again before the drain: the later
+                # "del" event (or this no-op) nets the key out.
+                continue
+            if row is None:
+                self._append_row(key, p)     # new key -> dict appends
+            else:
+                self._update_row(row, p)     # existing key keeps row
+
+    def _grow(self, need: int) -> None:
+        cap = max(need, self._cap * 2)
+        for field in ("_dead", "_p_node_row", "_p_has_node", "_p_active",
+                      "_p_workload", "_p_tpu", "_p_tpu_chips",
+                      "_p_gang", "_p_ns"):
+            old = getattr(self, field)
+            buf = np.zeros(cap, old.dtype)
+            buf[:self._n] = old[:self._n]
+            setattr(self, field, buf)
+        for i, old in enumerate(self._p_axes):
+            buf = np.zeros(cap, np.float64)
+            buf[:self._n] = old[:self._n]
+            self._p_axes[i] = buf
+        self._cap = cap
+
+    def _ensure_axis(self, axis: str) -> int:
+        aid = self._axis_ids.get(axis)
+        if aid is None:
+            aid = len(self._axes)
+            self._axis_ids[axis] = aid
+            self._axes.append(axis)
+            self._p_axes.append(np.zeros(self._cap, np.float64))
+        return aid
+
+    def _intern_gang(self, key: Any) -> int:
+        gid = self._gang_ids.get(key)
+        if gid is None:
+            gid = len(self._gang_keys)
+            self._gang_ids[key] = gid
+            self._gang_keys.append(key)
+        return gid
+
+    def _intern_ns(self, ns: str) -> int:
+        nid = self._ns_ids.get(ns)
+        if nid is None:
+            nid = len(self._ns_keys)
+            self._ns_ids[ns] = nid
+            self._ns_keys.append(ns)
+        return nid
+
+    def _write_row(self, row: int, p: Any) -> None:
+        name = p.node_name or None
+        self._p_has_node[row] = name is not None
+        self._p_node_row[row] = (self._node_row_of.get(name, -1)
+                                 if name else -1)
+        self._p_active[row] = p.phase in _ACTIVE_PHASES
+        self._p_workload[row] = p.is_workload
+        self._p_tpu[row] = p.resources.get(TPU_RESOURCE)
+        self._p_tpu_chips[row] = p.tpu_chips
+        self._p_gang[row] = self._intern_gang(p.gang_key)
+        self._p_ns[row] = self._intern_ns(p.namespace)
+        for buf in self._p_axes:
+            buf[row] = 0.0
+        for axis, v in p.resources.as_dict().items():
+            self._p_axes[self._ensure_axis(axis)][row] = v
+
+    def _append_row(self, key: str, p: Any) -> None:
+        row = self._n
+        if row + 1 > self._cap:
+            self._grow(row + 1)
+        self._n = row + 1
+        self._dead[row] = False
+        name = p.node_name or None
+        self._keys.append(key)
+        self._sigs.append(pod_sig(p))
+        self._names.append(name)
+        if name:
+            self._rows_by_nodename.setdefault(name, set()).add(row)
+        self._row_of[key] = row
+        self._write_row(row, p)
+
+    def _update_row(self, row: int, p: Any) -> None:
+        old_name = self._names[row]
+        new_name = p.node_name or None
+        if old_name != new_name:
+            if old_name is not None:
+                rows = self._rows_by_nodename.get(old_name)
+                if rows is not None:
+                    rows.discard(row)
+                    if not rows:
+                        del self._rows_by_nodename[old_name]
+            if new_name is not None:
+                self._rows_by_nodename.setdefault(new_name,
+                                                  set()).add(row)
+        self._names[row] = new_name
+        self._sigs[row] = pod_sig(p)
+        self._write_row(row, p)
+
+    def _kill_row(self, key: str, row: int) -> None:
+        self._dead[row] = True
+        self._dead_count += 1
+        del self._row_of[key]
+        name = self._names[row]
+        if name is not None:
+            rows = self._rows_by_nodename.get(name)
+            if rows is not None:
+                rows.discard(row)
+                if not rows:
+                    del self._rows_by_nodename[name]
+            self._names[row] = None
+
+    def _compact(self) -> None:
+        """Squeeze dead rows out (live relative order — and therefore
+        the exported value — is unchanged; only row numbers shift)."""
+        live = np.flatnonzero(~self._dead[:self._n])
+        n = len(live)
+        cap = max(_MIN_CAP, n)
+        for field in ("_p_node_row", "_p_has_node", "_p_active",
+                      "_p_workload", "_p_tpu", "_p_tpu_chips",
+                      "_p_gang", "_p_ns"):
+            old = getattr(self, field)
+            buf = np.zeros(cap, old.dtype)
+            buf[:n] = old[live]
+            setattr(self, field, buf)
+        axes = []
+        for old in self._p_axes:
+            buf = np.zeros(cap, np.float64)
+            buf[:n] = old[live]
+            axes.append(buf)
+        self._p_axes = axes
+        self._keys = [self._keys[i] for i in live]
+        self._sigs = [self._sigs[i] for i in live]
+        self._names = [self._names[i] for i in live]
+        self._row_of = {k: i for i, k in enumerate(self._keys)}
+        self._rows_by_nodename = {}
+        for i, name in enumerate(self._names):
+            if name is not None:
+                self._rows_by_nodename.setdefault(name, set()).add(i)
+        self._dead = np.zeros(cap, bool)
+        self._dead_count = 0
+        self._n = n
+        self._cap = cap
+
+    # -- export ------------------------------------------------------------
+
+    def _export_state(self) -> ColumnarState:
+        if self._export is not None:
+            return self._export
+        if self._dead_count:
+            live = np.flatnonzero(~self._dead[:self._n])
+        else:
+            live = np.arange(self._n)
+        first_sig = last_sig = None
+        if len(live):
+            first_sig = self._sigs[int(live[0])]
+            last_sig = self._sigs[int(live[-1])]
+        state = ColumnarState(
+            templates=self.templates,
+            # Node arrays are replaced (never mutated in place) on
+            # rebuild, so sharing them with the export is safe; pod
+            # columns are gathered copies of the live rows.
+            nodes=self._nodes,
+            n_ready=self._n_ready, n_sched=self._n_sched,
+            n_is_tpu=self._n_is_tpu, n_chips=self._n_chips,
+            n_tmpl=self._n_tmpl,
+            slice_gid=self._slice_gid, unit_gid=self._unit_gid,
+            slices=self._slices, units=self._units,
+            n_pods=len(live),
+            p_node_row=self._p_node_row[live],
+            p_has_node=self._p_has_node[live],
+            p_active=self._p_active[live],
+            p_workload=self._p_workload[live],
+            p_tpu=self._p_tpu[live],
+            p_tpu_chips=self._p_tpu_chips[live],
+            p_gang=self._p_gang[live],
+            p_ns=self._p_ns[live],
+            # Gang/ns interns are grow-only, so sharing is safe; the
+            # axis LIST is copied because p_axes' length must stay
+            # equal to it even if the view later learns a new axis.
+            gang_keys=self._gang_keys, gang_ids=self._gang_ids,
+            ns_keys=self._ns_keys, ns_ids=self._ns_ids,
+            axes=list(self._axes), axis_ids=dict(self._axis_ids),
+            p_axes=[buf[live] for buf in self._p_axes],
+            node_digest=self._node_digest,
+            pod_digest=self._pod_digest,
+            first_pod_sig=first_sig, last_pod_sig=last_sig)
+        self._export = state
+        return state

@@ -152,6 +152,17 @@ class ObjectCache:
         # consumer -> set of tags touched since its last drain; None =
         # a replace()/mark_unsynced() happened (full rebuild required).
         self._dirty: dict[str, set[Hashable] | None] = {}
+        # consumer -> ORDERED ("set"|"del", key) event log since its
+        # last drain; None = full rebuild required.  Unlike the tag
+        # SETS above, the log preserves delta order, so a consumer can
+        # replay it and reproduce the store dict's exact insertion
+        # order (a delete + re-add moves a key to the end; a modify
+        # keeps its position) — the row-order contract the columnar
+        # view (k8s/columnar.py) exports to the planner.  Logged only
+        # from ``apply``'s store mutations, never from the index
+        # helpers, and capped (``_log_dirty_key``) so an abandoned
+        # consumer degrades to rebuild instead of unbounded growth.
+        self._dirty_keys: dict[str, list[tuple[str, str]] | None] = {}
         self._reserve = reserve
         # Whole-store XOR digest of (key, resourceVersion) pairs,
         # maintained per delta exactly like the per-bucket index
@@ -291,6 +302,8 @@ class ObjectCache:
             # sparing O(store) tag-set updates per consumer.
             for consumer in self._dirty:
                 self._dirty[consumer] = None
+            for consumer in self._dirty_keys:
+                self._dirty_keys[consumer] = None
             self._rebuild_indices()
 
     def apply(self, event: Mapping[str, Any]) -> bool:
@@ -316,6 +329,7 @@ class ObjectCache:
                 self._objects[key] = dict(obj)
                 self._parsed[key] = parsed
                 self._index_add(key, parsed)
+                self._log_dirty_key("set", key)
                 if rv:
                     self._resource_version = rv
             return True
@@ -325,10 +339,30 @@ class ObjectCache:
                 self._objects.pop(key, None)
                 if old is not None:
                     self._index_remove(key, old)
+                    self._log_dirty_key("del", key)
             if rv:
                 # BOOKMARK (and DELETED) keep the cursor fresh.
                 self._resource_version = rv
         return etype in RELEVANT_TYPES
+
+    def _log_dirty_key(self, op: str, key: str) -> None:
+        """Append one ordered store event to every dirty-key consumer.
+        Re-entrant like _index_add: the RLock is already held by the
+        apply() caller, so taking it here keeps every write lexically
+        guarded at zero extra cost.  A consumer whose log outgrows the
+        store is nulled — replaying more events than a rebuild costs
+        is strictly worse, and the cap bounds an abandoned consumer."""
+        with self._lock:
+            if not self._dirty_keys:
+                return
+            cap = max(1024, len(self._objects))
+            for consumer, events in self._dirty_keys.items():
+                if events is None:
+                    continue
+                if len(events) >= cap:
+                    self._dirty_keys[consumer] = None
+                else:
+                    events.append((op, key))
 
     def mark_unsynced(self) -> None:
         """Watch failed or gapped: serve LIST fallbacks until relisted.
@@ -342,6 +376,8 @@ class ObjectCache:
             self._resource_version = None
             for consumer in self._dirty:
                 self._dirty[consumer] = None  # gap of unknown size
+            for consumer in self._dirty_keys:
+                self._dirty_keys[consumer] = None
 
     # -- reads (the reconcile thread) ------------------------------------
 
@@ -393,6 +429,16 @@ class ObjectCache:
             if not self._synced:
                 return None
             return list(self._parsed.values()), self._store_digest
+
+    def snapshot_items_with_digest(self) -> tuple[
+            list[tuple[str, Any]], int] | None:
+        """``snapshot_with_digest`` with each object's store KEY — the
+        columnar view's full-rebuild read (its rows are keyed exactly
+        like the store so dirty-key events can address them)."""
+        with self._lock:
+            if not self._synced:
+                return None
+            return list(self._parsed.items()), self._store_digest
 
     def snapshot_select_digest(self, index: str, ikey: Hashable
                                ) -> tuple[list[Any], list[Any],
@@ -455,6 +501,39 @@ class ObjectCache:
             pending = self._dirty.get(consumer)
             self._dirty[consumer] = set()
             return pending
+
+    def watch_dirty_keys(self, consumer: str) -> None:
+        """Register ``consumer`` for ordered dirty-KEY event tracking
+        (starts in the rebuild-required state)."""
+        with self._lock:
+            self._dirty_keys[consumer] = None
+
+    def unwatch_dirty_keys(self, consumer: str) -> None:
+        with self._lock:
+            self._dirty_keys.pop(consumer, None)
+
+    def drain_dirty_keys(self, consumer: str) -> tuple[
+            list[tuple[str, str]] | None, dict[str, Any], int, bool]:
+        """One lock hold returning ``(events, parsed_by_key, digest,
+        synced)``: the ordered event log since the last drain (None =
+        rebuild required), the CURRENT parsed object for every key a
+        "set" event names (a key absent from the map was deleted again
+        before the drain — its later "del" event makes the replay net
+        out), and the store digest describing exactly the drained
+        prefix.  Replaying the events reproduces the store dict's
+        insertion order (docs/PLANNER.md row-order contract)."""
+        with self._lock:
+            events = self._dirty_keys.get(consumer)
+            self._dirty_keys[consumer] = []
+            if events is None:
+                return None, {}, self._store_digest, self._synced
+            lookup: dict[str, Any] = {}
+            for op, key in events:
+                if op == "set" and key not in lookup:
+                    parsed = self._parsed.get(key)
+                    if parsed is not None:
+                        lookup[key] = parsed
+            return events, lookup, self._store_digest, self._synced
 
     def __len__(self) -> int:
         with self._lock:
@@ -543,12 +622,15 @@ class PoolState:
 
     @property
     def free_slice(self) -> bool:
-        """Mirrors planner._free_slices: every host Ready, schedulable,
-        and chip-idle (chip counts are integers, so the incremental
-        float arithmetic is exact)."""
-        return (self.tpu and self.nodes
-                and self.ready == len(self.nodes)
-                and self.used_chips == 0)
+        """THE free-slice predicate (engine/columnar.py
+        ``slice_is_free``) — the same function ``planner._free_slices``
+        and the columnar ``slice_free_mask`` evaluate, so the three can
+        never drift (chip counts are integers, so the incremental float
+        arithmetic is exact)."""
+        from tpu_autoscaler.engine.columnar import slice_is_free
+
+        return slice_is_free(bool(self.tpu), len(self.nodes),
+                             self.ready, self.used_chips)
 
 
 class CapacityView:
@@ -936,6 +1018,18 @@ class ClusterInformer:
         caches moving mid-pass).  None when either cache is unsynced;
         the caller falls back to the LIST paths and the legacy
         per-list hash."""
+        got = self.observe_with_digests()
+        if got is None:
+            return None
+        return got[:4]
+
+    def observe_with_digests(self):
+        """``observe_with_digest`` plus the RAW per-cache digests:
+        ``(nodes, pods, pending, digest, node_digest, pod_digest)`` —
+        the reconciler compares the raw pair against the stamps on the
+        columnar view's exported state to prove the state describes
+        exactly this observation (docs/PLANNER.md).  None when either
+        cache is unsynced."""
         node_snap = self.node_cache.snapshot_with_digest()
         if node_snap is None:
             return None
@@ -946,7 +1040,8 @@ class ClusterInformer:
         nodes, node_digest = node_snap
         pods, pending, pod_digest = pod_snap
         return (nodes, pods, pending,
-                hash(("informer", pod_digest, node_digest)))
+                hash(("informer", pod_digest, node_digest)),
+                node_digest, pod_digest)
 
     def unready_nodes(self):
         """Parsed nodes currently NotReady or cordoned — the node-failure
@@ -990,5 +1085,17 @@ class ClusterInformer:
         view = getattr(self, "_capacity_view", None)
         if view is None:
             view = self._capacity_view = CapacityView(self.node_cache,
+                                                      self.pod_cache)
+        return view
+
+    def columnar_view(self):
+        """THE informer's incrementally-maintained columnar planner
+        state (k8s/columnar.py; single-consumer, ``refresh()`` per
+        pass).  Memoized for the same reason as ``capacity_view``."""
+        view = getattr(self, "_columnar_view", None)
+        if view is None:
+            from tpu_autoscaler.k8s.columnar import ColumnarView
+
+            view = self._columnar_view = ColumnarView(self.node_cache,
                                                       self.pod_cache)
         return view

@@ -12,12 +12,18 @@ from tpu_autoscaler.k8s.objects import Node
 from tpu_autoscaler.topology.catalog import SLICE_ID_LABEL
 
 
+def unit_key_of(node: Node) -> str:
+    """The supply-unit key one node belongs to — the single definition
+    shared by :func:`group_supply_units`, the informer's pool fold
+    (``CapacityView``) and the columnar planner core's unit grouping,
+    so the three can never drift."""
+    if node.is_tpu and node.slice_id:
+        return node.slice_id
+    return node.labels.get(SLICE_ID_LABEL) or node.name
+
+
 def group_supply_units(nodes: list[Node]) -> dict[str, list[Node]]:
     units: dict[str, list[Node]] = {}
     for node in nodes:
-        if node.is_tpu and node.slice_id:
-            units.setdefault(node.slice_id, []).append(node)
-        else:
-            units.setdefault(node.labels.get(SLICE_ID_LABEL) or node.name,
-                             []).append(node)
+        units.setdefault(unit_key_of(node), []).append(node)
     return units
