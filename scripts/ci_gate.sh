@@ -85,6 +85,17 @@
 #      scan; BENCH_SCALE.json), then the 200-seed mixed chaos corpus
 #      with --verify-columnar (the python planner shadows every
 #      columnar pass; any plan mismatch fails the seed).
+#   18 router tier (ISSUE 18, docs/SERVING.md "Request routing"):
+#      bench.py router — amortized routing decision <= 5 us and score
+#      refresh <= 1 ms per pass at 10k replicas, then the 2.2M-user
+#      route_compare replay at equal provisions: router tail-SLO
+#      miss rate >= 2x better than random dispatch AND per-replica
+#      KV-occupancy variance >= 2x lower, zero lost requests;
+#      BENCH_SERVING.json["router"].  The 200-seed chaos `router`
+#      corpus — replica death mid-request, affinity staleness,
+#      hedge storms, counter resets during hedges, with the
+#      no-lost-requests + no-double-completion invariants — runs in
+#      the chaos stage above, exit 7.
 #
 # Analysis output defaults to GitHub Actions workflow-command
 # annotations (::error file=...,line=...); set ANALYSIS_FORMAT=text for
@@ -94,10 +105,10 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/16] invariant analysis (--format=$fmt)"
+echo "== [1/17] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/16] deadlock & determinism layer (TAL/TAB/TAD --no-baseline + witness cross-check)"
+echo "== [2/17] deadlock & determinism layer (TAL/TAB/TAD --no-baseline + witness cross-check)"
 # Zero-baseline-growth enforcement for the ISSUE 15 code families:
 # stage 1 honors baseline.toml, this stage deliberately does not.
 python -m tpu_autoscaler.analysis --format="$fmt" --no-baseline \
@@ -105,7 +116,7 @@ python -m tpu_autoscaler.analysis --format="$fmt" --no-baseline \
 JAX_PLATFORMS=cpu python -m pytest -q tests/test_lockwitness.py \
     -p no:cacheprovider || exit 15
 
-echo "== [3/16] units-of-measure layer (TAU10xx --no-baseline)"
+echo "== [3/17] units-of-measure layer (TAU10xx --no-baseline)"
 # Zero-baseline-growth for the cost-algebra dimension checker, same
 # contract as the stage above: stage 1 honors baseline.toml, this
 # stage deliberately does not — a fresh TAU finding fails CI even if
@@ -113,11 +124,11 @@ echo "== [3/16] units-of-measure layer (TAU10xx --no-baseline)"
 python -m tpu_autoscaler.analysis --format="$fmt" --units --no-baseline \
     tpu_autoscaler/ || exit 16
 
-echo "== [4/16] mypy strict islands"
+echo "== [4/17] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [5/16] deterministic-schedule race tier"
+echo "== [5/17] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh.  Its static
 # layer and witness cross-check already ran above (stage 1 runs every
 # program pass over the whole package; stage 2 runs
@@ -125,14 +136,14 @@ echo "== [5/16] deterministic-schedule race tier"
 # to pay for the whole-program analysis a third time.
 RACE_STATIC_COVERED=1 ./scripts/race.sh || exit 4
 
-echo "== [6/16] tracer-overhead gate"
+echo "== [6/17] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [7/16] mega-cluster scale tiers"
+echo "== [7/17] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [8/16] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack)"
+echo "== [8/17] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack + 200 router)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -161,6 +172,14 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 # asserted at terminal (docs/REPACK.md).
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repack || exit 7
+# The router corpus (ISSUE 18, docs/SERVING.md "Request routing"):
+# the routed replay raced by replica death mid-request, affinity
+# staleness (epoch bumps under the table's feet), hedge storms
+# (stall bursts that make many requests hedge-eligible at once) and
+# counter resets during hedges, with the no-lost-requests and
+# no-double-completion invariants asserted at terminal.
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 300 --profile router || exit 7
 # Sharded corpora (ISSUE 13, docs/SHARDING.md): the mixed and repair
 # corpora re-run with the sharded planner attached (shard_min_gangs=0
 # so every pass exercises fan-out/merge) — the full step/terminal
@@ -172,13 +191,13 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repair --reconcile-shards 4 \
     || exit 7
 
-echo "== [9/16] policy replay tier"
+echo "== [9/17] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [10/16] serving tier (adapter hot path + outcome replay)"
+echo "== [10/17] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
-echo "== [11/16] serving-trace tier (data-plane tracing overhead + acceptance)"
+echo "== [11/17] serving-trace tier (data-plane tracing overhead + acceptance)"
 # ISSUE 14 (docs/OBSERVABILITY.md "Request spans & exemplars"):
 # traced-vs-untraced replica step and 10k-replica exemplar fold
 # within 2% + noise grace at 1% sampling with tail capture ON, plus
@@ -189,16 +208,26 @@ echo "== [11/16] serving-trace tier (data-plane tracing overhead + acceptance)"
 # BENCH_SERVING.json["serving_trace"].
 JAX_PLATFORMS=cpu python bench.py serving-trace || exit 14
 
-echo "== [12/16] obs tier (TSDB ingest + alert evaluation)"
+echo "== [12/17] router tier (dispatch decision cost + route_compare)"
+# ISSUE 18 (docs/SERVING.md "Request routing"): the routing decision
+# must stay <= 5 us amortized and the score refresh <= 1 ms per pass
+# at 10k replicas, then the 2.2M-user route_compare replay at equal
+# provisions — router vs random vs round-robin with byte-identical
+# arrivals — where the router must beat random >= 2x on tail-SLO
+# miss rate AND >= 2x on per-replica KV-occupancy variance with zero
+# lost requests.  Records BENCH_SERVING.json["router"].
+JAX_PLATFORMS=cpu python bench.py router || exit 18
+
+echo "== [13/17] obs tier (TSDB ingest + alert evaluation)"
 JAX_PLATFORMS=cpu python bench.py obs || exit 10
 
-echo "== [13/16] cost tier (attribution ledger pass cost + conservation)"
+echo "== [14/17] cost tier (attribution ledger pass cost + conservation)"
 JAX_PLATFORMS=cpu python bench.py cost || exit 11
 
-echo "== [14/16] repack tier (week-long churn replay, never-worse gate)"
+echo "== [15/17] repack tier (week-long churn replay, never-worse gate)"
 JAX_PLATFORMS=cpu python bench.py repack || exit 12
 
-echo "== [15/16] sharded reconcile tier (million-pod loop + observe)"
+echo "== [16/17] sharded reconcile tier (million-pod loop + observe)"
 # ISSUE 13 (docs/SHARDING.md): the 1M-pod observe tier (indexed reads
 # must hold the 20x floor at 10x the PR-6 scale), then the full-loop
 # tier — sharded reconcile >= 2x serial passes/sec at 8 shards with
@@ -209,7 +238,7 @@ echo "== [15/16] sharded reconcile tier (million-pod loop + observe)"
 JAX_PLATFORMS=cpu python bench.py observe --pods 1000000 --nodes 100000 --floor 20 || exit 13
 JAX_PLATFORMS=cpu python bench.py loop --pods 1000000 --nodes 100000 || exit 13
 
-echo "== [16/16] columnar planner tier (million-pod plan + verified chaos corpus)"
+echo "== [17/17] columnar planner tier (million-pod plan + verified chaos corpus)"
 # ISSUE 17 (docs/PLANNER.md): the columnar planner tier — the serial
 # million-pod planning pass on the struct-of-arrays fast path must
 # beat the python oracle >= 5x with byte-identical decisions (plan

@@ -85,6 +85,13 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
 # guard-capped abort cost at terminal (docs/REPACK.md, CHAOS.md).
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repack
+# Router profile (ISSUE 18): the routed replay raced by replica death
+# mid-request, affinity staleness (epoch bumps under the table),
+# hedge storms and counter resets during hedges; no lost requests and
+# no double completions at terminal (docs/SERVING.md "Request
+# routing", CHAOS.md).
+JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
+    --seeds 200 --budget 300 --profile router
 # Sharded corpora (ISSUE 13, docs/SHARDING.md): mixed + repair re-run
 # with the sharded planner attached (every pass exercises the
 # fan-out/merge path); the invariant catalog must hold unchanged —
@@ -125,6 +132,14 @@ JAX_PLATFORMS=cpu python bench.py serving
 # BENCH_SERVING.json (docs/OBSERVABILITY.md "Request spans &
 # exemplars").
 JAX_PLATFORMS=cpu python bench.py serving-trace
+
+# Router tier (ISSUE 18): amortized routing decision <= 5 us and
+# score refresh <= 1 ms per pass at 10k replicas, then the 2.2M-user
+# route_compare replay at equal provisions — the router must beat
+# random dispatch >= 2x on tail-SLO miss rate AND >= 2x on
+# per-replica KV-occupancy variance with zero lost requests; results
+# merge into BENCH_SERVING.json (docs/SERVING.md "Request routing").
+JAX_PLATFORMS=cpu python bench.py router
 
 # Tracer-overhead tier: the observe + actuate benches re-run with the
 # decision tracer attached must stay within 5% of untraced (ISSUE 5 —

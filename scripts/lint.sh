@@ -32,6 +32,8 @@ MYPY_TARGETS=(
   tpu_autoscaler/obs/alerts.py
   tpu_autoscaler/units.py
   tpu_autoscaler/repack
+  tpu_autoscaler/serving/router.py
+  tpu_autoscaler/serving/drain.py
 )
 
 run_mypy() {

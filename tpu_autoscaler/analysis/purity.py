@@ -72,6 +72,11 @@ DEFAULT_SCOPE = (
     "tpu_autoscaler/k8s/scheduling.py",
     "tpu_autoscaler/policy/forecast.py",
     "tpu_autoscaler/policy/slo.py",
+    # The request router decides placement for every request on the
+    # hot path (ISSUE 18): same contract — the wall clock is injected
+    # (`now` parameters), no I/O, no randomness, so a routing decision
+    # is replayable from the adapter state + the dispatch sequence.
+    "tpu_autoscaler/serving/router.py",
 )
 
 

@@ -411,6 +411,206 @@ class _ServingFuzz:
                 f"(scale {scale:g})")
 
 
+class _RouterFuzz:
+    """Routed request stream over the fuzzed serving fleet (ISSUE 18).
+
+    A :class:`RouterCore` rides the SAME adapter the serving fuzz
+    feeds adversarially — so routing decisions are made over epochs
+    that bump mid-session (``replica_restart``), rates that reset
+    mid-hedge (``counter_reset``), and rows that die with requests in
+    flight (``replica_churn`` removals).  The driver keeps its own
+    request ledger (rid -> assigned replica) and asserts the two
+    router safety invariants the bench gates can't see:
+
+    - **no lost requests** — every submitted rid reaches completion
+      by terminal: orphans of a removed replica are re-homed through
+      the typed :class:`DrainReceipt` migration path (absorb_drain),
+      storm-wedged requests through exactly-once hedging, and the
+      quiet tail force-drains everything else;
+    - **no double completion** — ``router.complete`` returns True
+      exactly once per rid; the driver re-calls it on a sample of
+      completed rids every step and fails the seed if a duplicate is
+      ever acknowledged.  ``maybe_hedge`` likewise must never fire a
+      second hedge for an already-hedged rid.
+
+    A ``hedge_storm`` wedges a victim subset of the fleet: the driver
+    marks them draining (the stall signal ``maybe_hedge`` keys on)
+    and freezes their completions for the window, making every
+    outstanding request on them hedge-eligible at once.
+    """
+
+    #: Hedge budget in sim-seconds: two reconcile steps — storms run
+    #: 15-60 s, so every storm window produces hedge-eligible mass.
+    HEDGE_AFTER_S = 10.0
+
+    def __init__(self, program: ScenarioProgram, fuzz: _ServingFuzz,
+                 adapter, monitor: InvariantMonitor) -> None:
+        import random
+
+        from tpu_autoscaler.serving.router import (
+            RouterConfig,
+            RouterCore,
+        )
+
+        self.program = program
+        self.fuzz = fuzz
+        self.adapter = adapter
+        self.monitor = monitor
+        self.rng = random.Random(program.seed ^ 0x207712)
+        self.router = RouterCore(adapter, RouterConfig(
+            hedge_after_s=self.HEDGE_AFTER_S))
+        #: rid -> [replica, dispatched_at]
+        self.ledger: dict[str, list] = {}
+        self.submitted = 0
+        self.completed = 0
+        self.hedged: set[str] = set()
+        self.migrated = 0
+        #: Completed rids kept for duplicate-completion probes
+        #: (bounded sample, not the whole history).
+        self._done_sample: list[str] = []
+        self._storm_until = 0.0
+        self._wedged: set[str] = set()
+        self._seq = 0
+
+    def apply_event(self, event, t: float) -> None:
+        if event.kind != "hedge_storm":
+            raise ValueError(f"unknown router event kind {event.kind!r}")
+        self._storm_until = max(self._storm_until,
+                                t + event.args["duration"])
+        # Wedge about half the fleet (always leaving at least one
+        # replica routable): draining is the router's stall signal,
+        # the frozen completions are the outage itself.
+        names = sorted(self.fuzz._replicas)
+        victims = names[:max(1, len(names) // 2)] \
+            if len(names) > 1 else []
+        for name in victims:
+            if name not in self._wedged:
+                self._wedged.add(name)
+                self.router.mark_draining(name)
+
+    def _unwedge(self) -> None:
+        for name in sorted(self._wedged):
+            self.router.clear_draining(name)
+        self._wedged.clear()
+
+    def _migrate_orphans(self, t: float) -> None:
+        """Re-home requests whose replica left the fleet (death
+        mid-request) through the typed DrainReceipt path."""
+        from tpu_autoscaler.serving.drain import DrainReceipt
+
+        live = self.fuzz._replicas
+        by_dead: dict[str, list[str]] = {}
+        for rid, (replica, _t0) in sorted(self.ledger.items()):
+            if replica not in live:
+                by_dead.setdefault(replica, []).append(rid)
+        for replica, rids in sorted(by_dead.items()):
+            receipt = DrainReceipt(
+                served=0, unserved=len(rids), drained=False,
+                elapsed_s=0.0, ticks=0, decode_tokens=0,
+                request_latency_ticks=(), request_wait_ticks=(),
+                request_exec_ticks=(), stats={}, replica=replica)
+            moves = self.router.absorb_drain(receipt, t)
+            for rid, d in zip(rids, moves):
+                self.ledger[rid][0] = d.replica
+                self.migrated += 1
+            # Fewer moves than orphans only when the whole fleet is
+            # unroutable this pass — the rest retry next step.
+
+    def step(self, t: float) -> None:
+        from tpu_autoscaler.chaos.scenario import QUIET_TAIL
+
+        rng = self.rng
+        router = self.router
+        if self._wedged and t >= self._storm_until:
+            self._unwedge()
+        router.refresh(t)
+        self._migrate_orphans(t)
+        driven = t < self.program.until - QUIET_TAIL
+
+        # Hedging sweep: every outstanding rid, every step — the
+        # router itself must gate on age/stall/exactly-once.
+        for rid in sorted(self.ledger):
+            d = router.maybe_hedge(rid, t)
+            if d is None:
+                continue
+            if rid in self.hedged:
+                self.monitor._fail(
+                    t, "router-hedge-exactly-once",
+                    f"{rid} hedged a second time (to {d.replica})")
+            self.hedged.add(rid)
+            self.ledger[rid][0] = d.replica
+
+        # New submissions (driven phase only): ~30% session-sticky.
+        if driven:
+            for _ in range(rng.randint(0, 5)):
+                self._seq += 1
+                rid = f"r{self._seq}"
+                session = (f"sess-{rng.randint(0, 15)}"
+                           if rng.random() < 0.3 else None)
+                d = router.dispatch(t, session=session, rid=rid)
+                if d is None:
+                    # Legal only when nothing is routable (every
+                    # replica dead or wedged).
+                    routable = [n for n in self.fuzz._replicas
+                                if n not in self._wedged]
+                    if routable and self.adapter.row_of(
+                            sorted(routable)[0]) >= 0:
+                        self.monitor._fail(
+                            t, "router-no-lost-requests",
+                            f"dispatch refused {rid} with "
+                            f"{len(routable)} routable replica(s)")
+                    continue
+                self.submitted += 1
+                self.ledger[rid] = [d.replica, t]
+                if d.replica in self._wedged:
+                    self.monitor._fail(
+                        t, "router-drain-respected",
+                        f"{rid} routed to draining {d.replica}")
+
+        # Completions: wedged replicas freeze (that's the storm);
+        # everything else completes at a seeded rate, everything
+        # force-drains in the quiet tail.
+        for rid in sorted(self.ledger):
+            replica, _t0 = self.ledger[rid]
+            if replica in self._wedged and replica in self.fuzz._replicas:
+                continue
+            if driven and rng.random() > 0.5:
+                continue
+            if not router.complete(rid):
+                self.monitor._fail(
+                    t, "router-no-double-completion",
+                    f"{rid} completion unacknowledged (lost track "
+                    f"or already completed)")
+            del self.ledger[rid]
+            self.completed += 1
+            if len(self._done_sample) < 32:
+                self._done_sample.append(rid)
+
+        # Duplicate-completion probes: a rid completed earlier must
+        # NEVER be acknowledged again.
+        if self._done_sample and rng.random() < 0.25:
+            rid = rng.choice(self._done_sample)
+            if rid not in self.ledger and router.complete(rid):
+                self.monitor._fail(
+                    t, "router-no-double-completion",
+                    f"{rid} acknowledged a SECOND completion")
+
+    def check_terminal(self, t: float) -> None:
+        if self._wedged:
+            self._unwedge()
+        if self.ledger:
+            sample = ", ".join(sorted(self.ledger)[:5])
+            self.monitor._fail(
+                t, "router-no-lost-requests",
+                f"{len(self.ledger)} request(s) never completed "
+                f"({sample}…)")
+        if self.completed != self.submitted:
+            self.monitor._fail(
+                t, "router-no-double-completion",
+                f"completed {self.completed} != submitted "
+                f"{self.submitted}")
+
+
 #: ISSUE 12: the repack profile pre-seeds idle SPOT slices at t=0 and
 #: runs a longer idle threshold so they survive into the migration
 #: window; migrations themselves hold capacity in repair-family
@@ -605,6 +805,13 @@ class _Run:
             self.serving_fuzz = _ServingFuzz(
                 program, self.controller.serving_scaler.adapter,
                 self.monitor)
+        # ISSUE 18: the router profile drives a routed request stream
+        # over the same fuzzed fleet/adapter.
+        self.router_fuzz = None
+        if program.router and self.serving_fuzz is not None:
+            self.router_fuzz = _RouterFuzz(
+                program, self.serving_fuzz,
+                self.controller.serving_scaler.adapter, self.monitor)
         #: ISSUE 12: idle SPOT slices materialized by ``spot_arrive``
         #: events — the repack profile's migration destinations (and
         #: the spot_dry event's victims while still workload-free).
@@ -820,6 +1027,8 @@ class _Run:
                     self._relaunches = [
                         r for r in self._relaunches
                         if r[1].job != spec["workload"]]
+        elif self.router_fuzz is not None and kind == "hedge_storm":
+            self.router_fuzz.apply_event(event, t)
         elif self.serving_fuzz is not None and kind in (
                 "replica_restart", "counter_reset", "stale_burst",
                 "replica_churn", "slow_decode"):
@@ -889,6 +1098,10 @@ class _Run:
         self.monitor.after_pass(t)
         if self.serving_fuzz is not None:
             self.serving_fuzz.check(t)
+        if self.router_fuzz is not None:
+            # After the pass: the fold this reconcile ran is what the
+            # router's refresh re-prices from.
+            self.router_fuzz.step(t)
 
     def _check_alerts(self, t: float) -> None:
         """The ISSUE 10 alert gate, asserted at terminal: an injected
@@ -1047,6 +1260,8 @@ class _Run:
             self._check_alerts(t)
         if self.program.repack:
             self._check_repack(t)
+        if self.router_fuzz is not None:
+            self.router_fuzz.check_terminal(t)
         snap = self.controller.metrics.snapshot()
         mismatches = int(snap["counters"].get(
             "columnar_plan_mismatches", 0))

@@ -70,9 +70,13 @@ ALERTS_CLEAR_PASSES = 3
 #: and resolve, quiet seeds must stay silent; ``repack`` is ISSUE 12
 #: — long-running gangs on on-demand supply with pre-seeded idle SPOT
 #: slices, the repacker ON, and migrations raced by spot reclamation,
-#: destination stockouts and mid-drain gang deletion).
+#: destination stockouts and mid-drain gang deletion; ``router`` is
+#: ISSUE 18 — the serving alphabet plus a routed request stream
+#: through RouterCore: replica death mid-request, affinity staleness
+#: via restart epoch bumps, hedge storms and counter resets during
+#: hedges, with no-lost-requests + no-double-completion at terminal).
 PROFILES = ("mixed", "faults", "api", "repair", "policy", "serving",
-            "alerts", "repack")
+            "alerts", "repack", "router")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,6 +149,11 @@ class ScenarioProgram:
     # guard-capped abort-cost bound on top of the standard catalog.
     repack: bool = False
     repack_spot_shapes: tuple[str, ...] = ()
+    # ISSUE 18: drive a routed request stream (RouterCore over the
+    # serving adapter) alongside the serving fuzz — dispatch, affinity,
+    # hedging and drain migration raced by the full fault alphabet;
+    # terminal invariants add no-lost-requests + no-double-completion.
+    router: bool = False
 
     def describe(self) -> str:
         kinds: dict[str, int] = {}
@@ -157,7 +166,9 @@ class ScenarioProgram:
             tags.append("multislice")
         if self.policy:
             tags.append("policy")
-        if self.serving:
+        if self.router:
+            tags.append("router")
+        elif self.serving:
             tags.append("serving")
         if self.alerts:
             tags.append("alerts")
@@ -247,9 +258,9 @@ def generate(seed: int, *, profile: str = "mixed",
     # true positives — and the quiet half of the alert gate needs
     # latency guaranteed under the SLO bound.
     api_chaos = profile in ("mixed", "api", "policy", "serving",
-                            "alerts")
+                            "alerts", "router")
     fault_chaos = profile in ("mixed", "faults", "repair", "policy",
-                              "serving", "repack")
+                              "serving", "repack", "router")
     events: list[Event] = []
 
     def fire(probability: float) -> bool:
@@ -278,9 +289,13 @@ def generate(seed: int, *, profile: str = "mixed",
         events.append(Event(
             rng.uniform(150.0, 330.0), "host_fail",
             {"mode": rng.choice(("notready", "delete"))}))
-    if profile == "serving":
+    if profile in ("serving", "router"):
         # Serving-path faults, consumed by the engine's serving
-        # driver (new profile: its draws shift no legacy stream).
+        # driver (new profiles: their draws shift no legacy stream).
+        # The router profile rides the same alphabet — replica
+        # restarts are its affinity-staleness injector (fresh epoch
+        # under the table's feet) and counter resets corrupt the
+        # drain-credit rates mid-hedge.
         for _ in range(rng.randint(1, 3)):
             events.append(Event(rng.uniform(30.0, 300.0),
                                 "replica_restart"))
@@ -307,6 +322,34 @@ def generate(seed: int, *, profile: str = "mixed",
                 rng_sd.uniform(60.0, 280.0), "slow_decode",
                 {"duration": rng_sd.uniform(30.0, 90.0),
                  "factor": rng_sd.uniform(3.0, 8.0)}))
+
+    if profile == "router":
+        # ISSUE 18 (new profile: derived rng stream).  Every seed gets
+        # at least one hedge storm — a window where victim replicas
+        # wedge (stop completing, stop admitting) so a burst of
+        # outstanding requests becomes hedge-eligible at once; the
+        # router must re-dispatch each EXACTLY once.  ~Half the seeds
+        # land a counter reset INSIDE the storm (the drain-credit
+        # rates corrupt mid-hedge), and ~half remove a replica with
+        # requests in flight (death mid-request -> the DrainReceipt
+        # migration path must re-home the remainder losslessly).
+        rng_rt = random.Random(seed ^ 0x207E12)
+        start = rng_rt.uniform(60.0, 240.0)
+        duration = rng_rt.uniform(25.0, 60.0)
+        events.append(Event(start, "hedge_storm",
+                            {"duration": duration}))
+        if rng_rt.random() < 0.5:
+            events.append(Event(
+                start + rng_rt.uniform(0.0, duration), "counter_reset"))
+        if rng_rt.random() < 0.4:
+            events.append(Event(rng_rt.uniform(60.0, 280.0),
+                                "hedge_storm",
+                                {"duration": rng_rt.uniform(15.0, 40.0)}))
+        if rng_rt.random() < 0.6:
+            events.append(Event(rng_rt.uniform(80.0, 260.0),
+                                "replica_churn",
+                                {"add": rng_rt.randint(0, 1),
+                                 "remove": 1}))
 
     repack_spot_shapes: tuple[str, ...] = ()
     if profile == "repack":
@@ -409,8 +452,9 @@ def generate(seed: int, *, profile: str = "mixed",
         stagger_seconds=rng.choice((0.0, 0.0, 5.0)),
         max_total_chips=rng.choice((256, 1024)),
         policy=(profile == "policy"),
-        serving=(profile == "serving"),
+        serving=(profile in ("serving", "router")),
         alerts=(profile == "alerts"),
+        router=(profile == "router"),
         # The repack profile needs its PROVISIONED supply on-demand —
         # a spot-provisioned gang has nothing cheaper to migrate to
         # (the pre-seeded idle slices are the spot side).

@@ -31,6 +31,15 @@ non-advancing seq) are dropped and counted
 
 Threading: single-consumer like CapacityView — ingest and fold run on
 the same thread (the reconcile loop, a bench, or the chaos driver).
+
+The same dirty-fold also refreshes a per-replica **dispatch score**
+column (ISSUE 18): the request router's hot path is a masked argmin
+over this precomputed column (serving/router.py), so routing pays
+O(churn) at fold time and ~O(1) per dispatch — never a per-request
+Python scan over the fleet.  ``dispatch_scores`` is the score algebra
+(docs/SERVING.md "Request routing"); ``rebuild_scores`` is its
+from-scratch oracle, checked by the router property suite the same
+way ``rebuild``/``drift`` check the pool sums.
 """
 
 from __future__ import annotations
@@ -64,6 +73,23 @@ _RATE_ALPHA = 0.5
 #: maintained by add/subtract; a periodic full re-sum bounds the error
 #: at amortized O(replicas / period) per fold).
 _REPAIR_PERIOD = 256
+
+#: Dispatch-score algebra weights (ISSUE 18; docs/SERVING.md "Request
+#: routing").  The score is a COST — lower routes sooner:
+#:
+#:   score = backlog/slots  +  KV_WEIGHT * kv_used/kv_capacity
+#:           + STALL_PENALTY   iff busy and finishing nothing
+#:
+#: The load term is the replica's queueing delay proxy (requests per
+#: service slot); the KV term breaks load ties toward replicas with
+#: free cache blocks so long prompts don't land on a full pager; the
+#: stall penalty pushes wedged replicas (slots occupied, completion
+#: rate ~ 0) to the back of the line before the hedger even fires.
+SCORE_KV_WEIGHT = 0.5
+SCORE_STALL_PENALTY = 4.0
+#: Completion rate (req/s, EWMA) at or below which a busy replica
+#: counts as stalled for the score penalty.
+SCORE_STALL_RATE = 1e-3
 
 #: The histogram family request-latency exemplars attach to (ISSUE
 #: 14): the reconciler observes the taken exemplar's value into this
@@ -133,6 +159,21 @@ def _snapshot_rows(snap: ServingSnapshot) -> tuple[list[float],
     return gauges, totals
 
 
+def dispatch_scores(gauges: np.ndarray, rates: np.ndarray) -> np.ndarray:
+    """Vectorized dispatch-score algebra over gauge/rate rows (the
+    last axis is the column axis).  Pure function of the row data —
+    the fold applies it to dirty rows; ``rebuild_scores`` and the
+    router property suite apply it from scratch as the oracle."""
+    slots = np.maximum(gauges[..., _G_SLOTS], 1.0)
+    load = (gauges[..., _G_QUEUE] + gauges[..., _G_ACTIVE]) / slots
+    kv = gauges[..., _G_KV_USED] / np.maximum(gauges[..., _G_KV_CAP],
+                                              1.0)
+    busy = gauges[..., _G_ACTIVE] >= 0.5 * slots
+    stalled = busy & (rates[..., _C_FINISHED] <= SCORE_STALL_RATE)
+    return (load + SCORE_KV_WEIGHT * kv
+            + np.where(stalled, SCORE_STALL_PENALTY, 0.0))
+
+
 class ServingMetricsAdapter:
     """Incremental per-pool folds over a fleet of replica snapshots."""
 
@@ -158,6 +199,17 @@ class ServingMetricsAdapter:
         self._pool_of_row = np.zeros(cap, np.int64)
         self._contrib = np.zeros((cap, _N_CONTRIB))
         self._live = np.zeros(cap, bool)
+        # Router-facing columns (ISSUE 18): the dispatch-score cost
+        # per row (+inf on dead rows so an unmasked argmin can never
+        # resurrect one) and the row -> replica-id reverse map the
+        # argmin result resolves through.
+        self._score = np.full(cap, np.inf)
+        self._name_of_row: list[str | None] = [None] * cap
+        # Fold stamp per row: which fold last re-priced it.  The
+        # router clears its local in-flight delta for a row ONLY once
+        # that row's own snapshot re-folded — clearing on stale rows
+        # re-creates the join-the-shortest-stale-queue herd.
+        self._fold_stamp = np.zeros(cap, np.int64)
         self._dirty: set[int] = set()
         # Pool registry (pools are never recycled; fleets have few).
         self._pool_idx: dict[str, int] = {}
@@ -206,6 +258,11 @@ class ServingMetricsAdapter:
         self._pool_of_row = grow2(self._pool_of_row)
         self._contrib = grow2(self._contrib)
         self._live = grow2(self._live)
+        score = np.full(new, np.inf)
+        score[:cap] = self._score
+        self._score = score
+        self._fold_stamp = grow2(self._fold_stamp)
+        self._name_of_row.extend([None] * (new - cap))
         self._exemplar_seq.extend([0] * (new - cap))
 
     def _pool(self, pool: str, accel_class: str, shape_name: str) -> int:
@@ -257,6 +314,10 @@ class ServingMetricsAdapter:
             self._live[row] = True
             self._contrib[row] = 0.0
             self._rates[row] = 0.0
+            # A fresh replica dispatches at cost zero until its first
+            # fold prices it — it is empty, so that IS its score.
+            self._score[row] = 0.0
+            self._name_of_row[row] = replica_id
             # First sight: no history, so rates start at zero (the
             # totals become the baseline, not a burst).
             self._tot_old[row] = totals
@@ -328,6 +389,8 @@ class ServingMetricsAdapter:
         self._dirty.discard(row)
         self._seq[row] = -1
         self._contrib[row] = 0.0
+        self._score[row] = np.inf
+        self._name_of_row[row] = None
         self._free.append(row)
 
     def fold(self, now: float) -> int:
@@ -357,6 +420,12 @@ class ServingMetricsAdapter:
             self._contrib[idx] = contrib
             self._tot_old[idx] = self._tot_new[idx]
             self._t_old[idx] = self._t_new[idx]
+            # Router score refresh rides the same dirty set (ISSUE
+            # 18): one more vectorized expression over exactly the
+            # rows whose signals changed — O(churn), never O(fleet).
+            self._score[idx] = dispatch_scores(self._gauges[idx],
+                                               self._rates[idx])
+            self._fold_stamp[idx] = self._folds + 1
         self._folds += 1
         if self._repair_period and self._folds % self._repair_period == 0:
             self._repair()
@@ -428,6 +497,72 @@ class ServingMetricsAdapter:
             }
         return out
 
+    # -- router views (ISSUE 18) ------------------------------------------
+
+    def router_view(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(scores, live, pool_of_row) — live references into the row
+        arrays for :class:`~tpu_autoscaler.serving.router.RouterCore`.
+        The router reads them between folds (same single-consumer
+        thread) and must never write them; its own per-dispatch
+        in-flight deltas live in router-owned columns."""
+        return self._score, self._live, self._pool_of_row
+
+    def name_column(self) -> list[str | None]:
+        """The row -> replica-id column, as a live reference (mutated
+        in place on ingest/retire; replaced only by :meth:`_grow`).
+        Same read-only contract as :meth:`router_view` — the router
+        caches it so the per-decision commit is one list index, not a
+        method call."""
+        return self._name_of_row
+
+    def drain_credit(self, now: float) -> np.ndarray:
+        """Expected score drain since each row's last folded snapshot:
+        ``finished_rate * age / slots`` — the optimistic estimate of
+        how much of the reported (queue+active)/slots load the replica
+        has served in the meantime.  Snapshots age up to a full report
+        period while service times are typically shorter, so a score
+        column read raw makes busy-reported-but-since-drained replicas
+        look loaded for the whole period; routers subtract this credit
+        to kill the resulting starve/slam oscillation.  Fresh array,
+        O(fleet) vectorized."""
+        age = np.maximum(now - self._t_old, 0.0)
+        slots = np.maximum(self._gauges[:, _G_SLOTS], 1.0)
+        return self._rates[:, _C_FINISHED] * age / slots
+
+    def row_of(self, replica_id: str) -> int:
+        """Row index of a registered replica, or -1."""
+        return self._rows.get(replica_id, -1)
+
+    def replica_of_row(self, row: int) -> str | None:
+        """Replica id currently occupying ``row`` (None if freed)."""
+        if 0 <= row < len(self._name_of_row):
+            return self._name_of_row[row]
+        return None
+
+    def row_epoch(self, row: int) -> int:
+        """The recorder epoch last ingested for ``row`` — the router's
+        affinity table keys staleness off this (an epoch bump means
+        the replica restarted and its KV cache is gone)."""
+        return int(self._epoch[row])
+
+    def pool_index(self, pool: str) -> int:
+        """Dense pool index for router pool-masking, or -1."""
+        return self._pool_idx.get(pool, -1)
+
+    def capacity(self) -> int:
+        """Current row-array capacity (routers size their delta
+        columns to this and regrow when it changes)."""
+        return int(self._gauges.shape[0])
+
+    @property
+    def fold_stamps(self) -> np.ndarray:
+        """Per-row fold stamps (see ``_fold_stamp``) — read-only."""
+        return self._fold_stamp
+
+    @property
+    def folds_done(self) -> int:
+        return self._folds
+
     # -- verification (tests, chaos, bench baseline) ----------------------
 
     def rebuild(self) -> dict[str, list[float]]:
@@ -444,6 +579,17 @@ class ServingMetricsAdapter:
             out[pool] = [
                 math.fsum(float(self._contrib[r, c]) for r in rows)
                 for c in range(_N_CONTRIB)]
+        return out
+
+    def rebuild_scores(self) -> np.ndarray:
+        """From-scratch dispatch scores for every live row (dead rows
+        +inf) — the router property suite's oracle for the fold-time
+        incremental refresh."""
+        out = np.full(self._score.shape[0], np.inf)
+        live = np.flatnonzero(self._live)
+        if live.size:
+            out[live] = dispatch_scores(self._gauges[live],
+                                        self._rates[live])
         return out
 
     def drift(self) -> float:

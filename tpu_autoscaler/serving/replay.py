@@ -43,6 +43,7 @@ import numpy as np
 
 from tpu_autoscaler.policy import traffic
 from tpu_autoscaler.serving.adapter import ServingMetricsAdapter
+from tpu_autoscaler.serving.drain import DrainReceipt
 from tpu_autoscaler.serving.reqtrace import SAMPLE_DENOM
 from tpu_autoscaler.serving.scaler import ServingPolicy, ServingScaler
 from tpu_autoscaler.serving.stats import ServingStatsRecorder
@@ -108,6 +109,28 @@ class ServingReplayConfig:
     # traces land in the same /debugz dumps and incident bundles as
     # the control-plane traces.
     trace_sample_rate: float = 0.0
+    # Request dispatch (ISSUE 18): how arriving cohorts land on
+    # replicas.  "spread" is the legacy emptiest-third split;
+    # "random" / "rr" are the router-gate baselines (whole cohort to
+    # one uniformly-random / round-robin replica); "router" drives
+    # the real RouterCore over the adapter's score columns — session
+    # affinity, drain masking and migration included.
+    route_mode: str = "spread"
+    # Sub-cohort granularity for the routed modes: arrivals split
+    # into dispatch units of at most this many requests (one unit ~
+    # one conversation burst).  0 keeps the legacy one-cohort-per-
+    # step granularity (spread mode's historical behavior).
+    cohort_max: int = 0
+    # Fraction of dispatch units carrying a session key, drawn from a
+    # bounded id pool so conversations recur and affinity can earn
+    # hits (router mode only).
+    session_fraction: float = 0.0
+    session_pool: int = 2000
+    # Freeze the fleet at ``baseline_replicas`` for the whole trace:
+    # no scaler, no reactive submitter, no drains — the router gate's
+    # "equal provisions" ground rule (every route mode sees the
+    # identical fleet, so the measured difference is dispatch alone).
+    freeze_fleet: bool = False
 
     @property
     def spikes(self) -> tuple[tuple[float, float, float], ...]:
@@ -185,7 +208,12 @@ class _Replica:
             self._hash_base = zlib.crc32(name.encode())
             self._bar = int(cfg.trace_sample_rate * SAMPLE_DENOM)
 
-    def assign(self, t: float, n: int) -> None:
+    def assign(self, t: float, n: int,
+               decision: str | None = None) -> None:
+        """``decision``: the router's verdict for this cohort (stick/
+        hedge/migrate/dispatch, ISSUE 18) — stamped onto any promoted
+        request trace so a bad affinity table shows up as a named
+        attribute in the tail-report decomposition."""
         if n <= 0:
             return
         if self.sampler is None:
@@ -198,7 +226,7 @@ class _Replica:
             self._aseq += 1
             head = ((self._hash_base + self._aseq * 2654435761)
                     % SAMPLE_DENOM) < self._bar
-            self.fifo.append([t, n, self._aseq, head])
+            self.fifo.append([t, n, self._aseq, head, decision])
         self.queued += n
         self.recorder.note_admit(n)
 
@@ -251,7 +279,9 @@ class _Replica:
                     self.sampler.note_cohort(
                         f"{self.name}-a{head[2]}", arrival=head[0],
                         finish=t + cfg.step, n=take,
-                        exec_time=min(tau, latency), head=head[3])
+                        exec_time=min(tau, latency), head=head[3],
+                        attrs=({"router": head[4]} if head[4]
+                               else None))
                 if latency - tau >= cfg.step:
                     # Wait-split feed, cohort-approximate (one write
                     # per waiting completion chunk, like the bounded
@@ -309,12 +339,20 @@ class ServingReplayResult:
     provisions: int
     scaleouts: int
     passes: int
+    # Mean (over scored-tail steps) population variance of the
+    # per-replica KV-cache occupancy ratio — the router gate's
+    # balance metric (ISSUE 18): random dispatch saturates some
+    # pagers while neighbors idle blocks; the score's KV term keeps
+    # this flat.
+    kv_occ_variance: float = 0.0
+    route_mode: str = "spread"
 
     def as_dict(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
         for k in ("attainment", "tail_attainment", "tail_miss_rate",
                   "worst_window_attainment"):
             d[k] = round(d[k], 4)
+        d["kv_occ_variance"] = round(d["kv_occ_variance"], 6)
         return d
 
 
@@ -407,6 +445,9 @@ def replay(config: ServingReplayConfig, *, mode: str,
     without widening the scorecard result."""
     if mode not in ("reactive", "signal"):
         raise ValueError(f"unknown serving replay mode {mode!r}")
+    if config.route_mode not in ("spread", "random", "rr", "router"):
+        raise ValueError(
+            f"unknown route mode {config.route_mode!r}")
     from tpu_autoscaler.actuators.fake import FakeActuator
     from tpu_autoscaler.controller import Controller, ControllerConfig
     from tpu_autoscaler.engine.planner import PoolPolicy
@@ -425,8 +466,22 @@ def replay(config: ServingReplayConfig, *, mode: str,
                             stagger_seconds=HOST_STAGGER_S)
     informer = ClusterInformer(kube, timeout_seconds=0)
     adapter = ServingMetricsAdapter()
+    # freeze_fleet: no scaler — the fleet stays at baseline size in
+    # every route mode (the "equal provisions" ground rule of the
+    # router gate).
     scaler = (ServingScaler(adapter, _serving_policy(cfg))
-              if mode == "signal" else None)
+              if mode == "signal" and not cfg.freeze_fleet else None)
+    router = None
+    route_rng = None
+    rr_next = [0]
+    if cfg.route_mode == "router":
+        from tpu_autoscaler.serving.router import RouterCore
+
+        router = RouterCore(adapter)
+    if cfg.route_mode in ("random", "rr", "router"):
+        # A dispatch-only RNG stream, distinct from the arrival RNG:
+        # every route mode sees the byte-identical arrival sequence.
+        route_rng = np.random.default_rng((cfg.seed << 1) ^ 0x5E55)
     recorder = None
     if cfg.trace_sample_rate > 0.0:
         # Request traces share the controller's flight recorder (one
@@ -472,6 +527,10 @@ def replay(config: ServingReplayConfig, *, mode: str,
     passes = 0
     peak = 0
     scaleouts_metric = "serving_scaleouts"
+    # KV-occupancy balance accounting over the scored tail (ISSUE 18).
+    scored_from = cfg.day_seconds * (cfg.days - 1 - cfg.ramp_fraction)
+    kv_var_sum = 0.0
+    kv_var_n = 0
 
     def serving_nodes() -> dict[str, Any]:
         out = {}
@@ -484,7 +543,7 @@ def replay(config: ServingReplayConfig, *, mode: str,
                 _kill_replica(n.name)
         return out
 
-    def _kill_replica(node: str) -> None:
+    def _kill_replica(node: str, now: float = 0.0) -> None:
         rep = replicas.pop(node, None)
         if rep is None:
             return
@@ -492,7 +551,30 @@ def replay(config: ServingReplayConfig, *, mode: str,
         pod = pod_of.pop(node, None)
         if pod is not None and kube.get_pod("default", pod):
             kube.delete_pod("default", pod)
-        adapter.remove(node)
+        if router is not None:
+            # The replica's end of life flows through the typed drain
+            # contract (ISSUE 18): a DrainReceipt accounts what it
+            # served and what it hands off; the scaler retires it
+            # from the census, the router stops masking it.  The
+            # unserved remainder migrates via ``unassigned`` above —
+            # the zero-lost assertion covers it.
+            receipt = DrainReceipt(
+                replica=node,
+                served=int(rep.recorder.finished_total),
+                unserved=int(rep.queued),
+                drained=bool(rep.draining),
+                elapsed_s=max(0.0, float(now)),
+                ticks=int(rep.recorder.snapshot().seq),
+                decode_tokens=int(rep.decode_tokens),
+                request_latency_ticks=(), request_wait_ticks=(),
+                request_exec_ticks=(), stats={})
+            if scaler is not None:
+                scaler.confirm_scale_in(receipt)
+            else:
+                adapter.remove(node)
+            router.clear_draining(node)
+        else:
+            adapter.remove(node)
         retired.add(node)
 
     def _bind_daemonset(t: float) -> None:
@@ -564,17 +646,24 @@ def replay(config: ServingReplayConfig, *, mode: str,
         """Mark the least-loaded replicas draining; their queues
         re-route NOW (serve.py drain contract: nothing is lost)."""
         candidates = sorted(
-            (r for r in replicas.values() if not r.draining),
-            key=lambda r: r.queued)
-        for rep in candidates[:max(0, surplus)]:
+            ((node, r) for node, r in replicas.items()
+             if not r.draining),
+            key=lambda nr: nr[1].queued)
+        for node, rep in candidates[:max(0, surplus)]:
             rep.draining = True
+            if router is not None:
+                router.mark_draining(node)
             for cohort in rep.reroute():
+                if router is not None:
+                    # Tag the handoff so its re-dispatch span-stamps
+                    # as a migration, not a fresh arrival.
+                    cohort.append("migrate")
                 unassigned.append(cohort)
 
     def _reap_drained(t: float) -> None:
         for node, rep in list(replicas.items()):
             if rep.draining and rep.queued == 0:
-                _kill_replica(node)
+                _kill_replica(node, t)
 
     def _route(t: float, n_new: int) -> None:
         nonlocal arrived
@@ -584,21 +673,67 @@ def replay(config: ServingReplayConfig, *, mode: str,
         live = [r for r in replicas.values() if not r.draining]
         if not live:
             return
+        if cfg.route_mode == "spread":
+            while unassigned:
+                cohort = unassigned.popleft()
+                live.sort(key=lambda r: r.queued)
+                # Spread the cohort over the emptiest third of the
+                # fleet.
+                k = max(1, len(live) // 3)
+                share = -(-cohort[1] // k)
+                for rep in live[:k]:
+                    take = min(share, cohort[1])
+                    if take <= 0:
+                        break
+                    rep.assign(cohort[0], take)
+                    cohort[1] -= take
+                if cohort[1] > 0:
+                    unassigned.appendleft(cohort)
+                    break
+            return
+        # Routed modes (ISSUE 18): arrivals split into dispatch units
+        # of <= cohort_max requests (one unit ~ one conversation
+        # burst), each unit landing whole on ONE replica — the
+        # granularity at which real dispatch decisions happen.
+        # "random"/"rr" are the gate baselines; "router" is the real
+        # RouterCore over the adapter's score columns.
+        limit = cfg.cohort_max if cfg.cohort_max > 0 else (1 << 30)
         while unassigned:
             cohort = unassigned.popleft()
-            live.sort(key=lambda r: r.queued)
-            # Spread the cohort over the emptiest third of the fleet.
-            k = max(1, len(live) // 3)
-            share = -(-cohort[1] // k)
-            for rep in live[:k]:
-                take = min(share, cohort[1])
-                if take <= 0:
-                    break
-                rep.assign(cohort[0], take)
+            # Handoffs re-queued by a drain carry a forced decision
+            # tag ("migrate") appended by _drain_surplus.
+            forced = (cohort[2] if len(cohort) > 2
+                      and isinstance(cohort[2], str) else None)
+            t0 = cohort[0]
+            while cohort[1] > 0:
+                take = min(limit, cohort[1])
+                session = None
+                if (cfg.session_fraction > 0.0 and forced is None
+                        and route_rng.random()
+                        < cfg.session_fraction):
+                    session = "s%d" % int(
+                        route_rng.integers(cfg.session_pool))
+                if router is not None:
+                    d = router.dispatch(t, session=session,
+                                        weight=float(take))
+                    rep = (replicas.get(d.replica)
+                           if d is not None else None)
+                    if rep is None or rep.draining:
+                        # No routable replica yet (first steps before
+                        # any snapshot folded): hold the unit for the
+                        # next pass.
+                        unassigned.appendleft(cohort)
+                        return
+                    rep.assign(t0, take,
+                               decision=forced or d.decision)
+                elif cfg.route_mode == "random":
+                    rep = live[int(route_rng.integers(len(live)))]
+                    rep.assign(t0, take, decision=forced)
+                else:  # rr
+                    rep = live[rr_next[0] % len(live)]
+                    rr_next[0] += 1
+                    rep.assign(t0, take, decision=forced)
                 cohort[1] -= take
-            if cohort[1] > 0:
-                unassigned.appendleft(cohort)
-                break
 
     _seed_baseline()
     t = 0.0
@@ -627,19 +762,35 @@ def replay(config: ServingReplayConfig, *, mode: str,
         _route(t, n_new)
         for rep in replicas.values():
             rep.step(t, cfg, score)
+        if t >= scored_from and len(replicas) >= 2:
+            occ = np.fromiter(
+                (r.active * cfg.tokens_per_request
+                 / (r.recorder.slots * 256.0)
+                 for r in replicas.values()),
+                float, len(replicas))
+            kv_var_sum += float(occ.var())
+            kv_var_n += 1
         # Load signal AFTER serving: persistent queues + occupancy —
         # the same quantity the replicas' recorders just exported.
         backlog = (sum(r.queued + r.active for r in replicas.values())
                    + sum(c[1] for c in unassigned))
         _reap_drained(t)
         peak = max(peak, len(replicas))
-        # Export: staggered snapshot ingest (signal mode only).
-        if mode == "signal":
+        # Export: staggered snapshot ingest (signal mode, and always
+        # when the router is on — its score columns feed off the same
+        # snapshots whatever drives scaling).
+        if mode == "signal" or router is not None:
             for i, (node, rep) in enumerate(replicas.items()):
                 if (passes + i) % cfg.report_every_steps:
                     continue
                 adapter.ingest(node, "web", accel, REPLICA_SHAPE,
                                rep.recorder.snapshot(), now=t)
+        if router is not None:
+            # Fold + candidate refresh once per step (the scaler's
+            # pass folds again when attached; an empty-dirty fold is
+            # O(1), so the double is free).
+            adapter.fold(t)
+            router.refresh(t)
         # Scale decisions.  The reactive platform gets the SAME target
         # math, deadband, and drain caps as the scaler — the measured
         # difference is the advisory/forecast lead, not a handicapped
@@ -684,6 +835,8 @@ def replay(config: ServingReplayConfig, *, mode: str,
         artifacts["controller"] = controller
         artifacts["score"] = score
         artifacts["samplers"] = samplers
+        artifacts["router"] = router
+        artifacts["adapter"] = adapter
     snap = controller.metrics.snapshot()
     counters = snap["counters"]
     unserved = arrived - score.served
@@ -701,7 +854,9 @@ def replay(config: ServingReplayConfig, *, mode: str,
         peak_replicas=peak,
         provisions=int(counters.get("provisions_submitted", 0)),
         scaleouts=int(counters.get(scaleouts_metric, 0)),
-        passes=passes)
+        passes=passes,
+        kv_occ_variance=(kv_var_sum / kv_var_n) if kv_var_n else 0.0,
+        route_mode=cfg.route_mode)
 
 
 def compare(config: ServingReplayConfig) -> dict[str, Any]:
@@ -726,4 +881,57 @@ def compare(config: ServingReplayConfig) -> dict[str, Any]:
         "tail_attainment_signal": round(signal.tail_attainment, 4),
         # >1 means the live-signal path beats pod-pending reactive.
         "miss_rate_ratio": round(r_miss / s_miss, 3),
+    }
+
+
+def route_compare_config(seed: int = 0, *, replicas: int = 84,
+                         peak_rps: float = 600.0,
+                         day_seconds: float = 1200.0,
+                         days: int = 2) -> ServingReplayConfig:
+    """The router gate's trace geometry (ISSUE 18): the 2.2M-user
+    diurnal day-shape (``modeled_users`` derives from ``peak_rps``
+    alone) over a FROZEN fleet sized to ~0.9 peak utilization — hot
+    enough that dispatch quality is the p99, no spike (a frozen fleet
+    under a 5x burst is a capacity problem in every mode, which would
+    only blur the routing signal)."""
+    return ServingReplayConfig(
+        seed=seed, day_seconds=day_seconds, days=days, step=5.0,
+        peak_rps=peak_rps, trough_rps=peak_rps * 0.1,
+        spike_mult=1.0, spike_duration=0.0,
+        baseline_replicas=replicas, max_replicas=replicas,
+        freeze_fleet=True, cohort_max=8,
+        session_fraction=0.3, route_mode="router")
+
+
+def route_compare(config: ServingReplayConfig | None = None
+                  ) -> dict[str, Any]:
+    """Router vs random vs round-robin scorecard at equal provisions
+    — the same traffic program and frozen fleet per mode, only the
+    dispatch decision differs.  The ``bench.py router`` gates read
+    ``miss_rate_ratio`` (router beats random >= 2x) and
+    ``kv_variance_ratio`` (>= 2x flatter per-replica KV occupancy),
+    plus zero lost requests in every mode."""
+    cfg = config or route_compare_config()
+    modes: dict[str, ServingReplayResult] = {}
+    for rm in ("router", "random", "rr"):
+        modes[rm] = replay(dataclasses.replace(cfg, route_mode=rm),
+                           mode="signal")
+    router_res, random_res = modes["router"], modes["random"]
+    r_miss = max(router_res.tail_miss_rate, 1e-6)
+    rand_miss = max(random_res.tail_miss_rate, 1e-6)
+    r_var = max(router_res.kv_occ_variance, 1e-9)
+    rand_var = max(random_res.kv_occ_variance, 1e-9)
+    return {
+        "trace": {
+            "seed": cfg.seed, "modeled_users": cfg.modeled_users,
+            "peak_rps": cfg.peak_rps, "replicas": cfg.baseline_replicas,
+            "slo_seconds": cfg.slo_seconds,
+            "cohort_max": cfg.cohort_max,
+            "session_fraction": cfg.session_fraction,
+        },
+        "modes": {rm: res.as_dict() for rm, res in modes.items()},
+        "lost_requests": max(res.unserved for res in modes.values()),
+        # >1 means the router beats random dispatch.
+        "miss_rate_ratio": round(rand_miss / r_miss, 3),
+        "kv_variance_ratio": round(rand_var / r_var, 3),
     }

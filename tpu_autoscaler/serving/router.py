@@ -1,0 +1,535 @@
+"""Fleet request router (ISSUE 18): vectorized KV/queue-aware dispatch.
+
+The control plane resizes the fleet (serving/scaler.py) but users hit
+*replicas*: with per-replica queues and caller-pointed dispatch, one
+hot replica burns p99 while its neighbors idle KV blocks.  This module
+is the dispatch decision in front of the batcher family, built on the
+adapter's row arrays so the fleet never gets scanned per request:
+
+- **Dispatch core** — the adapter's dirty-fold refreshes a per-replica
+  dispatch-score column (``adapter.dispatch_scores``: queue backlog
+  per slot + KV occupancy + stall penalty).  The router keeps a small
+  candidate heap over that column plus its *own* per-row in-flight
+  delta (requests it dispatched since the last fold, which the
+  replicas' snapshots can't see yet), so consecutive dispatches spread
+  instead of piling onto one argmin row.  Amortized cost per decision:
+  O(log K) heap ops, with one vectorized ``argpartition`` refill per
+  score refresh — the ``bench.py router`` gate holds this at
+  microseconds per request at 10k replicas.
+- **Affinity** — a bounded session/prefix table sticks a conversation
+  to the replica holding its KV blocks (``workloads/paged.py`` block
+  accounting is the ground truth for why that matters: a hit skips
+  prefill).  Entries are validated on every lookup against the row's
+  current occupant, its snapshot **epoch** (a bump means the replica
+  restarted and the cache is gone), liveness and drain state — a
+  stale entry is dropped and re-routed, never trusted.
+- **Tail defense** — ``maybe_hedge`` re-dispatches a request exactly
+  once when its chosen replica stalls past a budget (dead, draining,
+  epoch-bumped, or score-stalled).  ``absorb_drain`` turns the
+  serve.py :class:`~tpu_autoscaler.serving.drain.DrainReceipt` into
+  migration dispatches for the unserved remainder — the no-lost-
+  requests half of the chaos ``router`` invariant.
+
+Purity contract (analysis TAP1xx scope): no clocks, no randomness, no
+I/O — every decision is a function of the adapter's arrays, the
+router's own bounded state, and caller-injected timestamps.  Ties
+break on row index, so replays are deterministic by construction
+(TAD9xx).  Single-consumer threading like the adapter: dispatch and
+refresh run on the owning loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import typing
+from typing import Any
+
+import numpy as np
+
+from tpu_autoscaler.serving.adapter import (
+    SCORE_STALL_PENALTY,
+    ServingMetricsAdapter,
+)
+from tpu_autoscaler.serving.drain import DrainReceipt
+
+#: Tolerance for "the heap entry's priority still matches the row's
+#: effective score" — entries off by more are lazily re-priced.
+_HEAP_SLACK = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Knobs for one RouterCore (docs/SERVING.md "Request routing")."""
+
+    #: Candidate-heap size: the refill keeps the K cheapest rows; the
+    #: hot path never touches the other fleet rows until the next
+    #: refresh.  Must exceed the dispatches expected per refresh
+    #: divided by how much spread is wanted; 128 is ample at per-pass
+    #: folding cadence (and measured fastest at 10k replicas — wide
+    #: enough that watermark re-partitions stay amortized, small
+    #: enough that the refill listcomp stays trivial).
+    candidates: int = 128
+    #: Score cost one locally-dispatched request adds to its row until
+    #: the next refresh re-prices from real snapshots (~1/slots of a
+    #: typical replica — one more request's worth of backlog).
+    inflight_penalty: float = 1.0 / 16.0
+    #: Bounded affinity-table capacity (FIFO eviction).
+    affinity_capacity: int = 65536
+    #: Effective score past which a sticky replica is too hot to
+    #: honor affinity — the conversation spills to the fleet-best row
+    #: and re-sticks there (KV re-prefills once; p99 doesn't burn).
+    #: 1.0 = one full backlog-per-slot above empty: loose enough that
+    #: steady-state sessions essentially always stick, tight enough
+    #: that sticky traffic cannot pile a replica past saturation
+    #: (measured on the route_compare trace: spill at 4.0 lets
+    #: affinity carry whole bursts and costs ~6% fleet KV balance).
+    affinity_spill_score: float = 1.0
+    #: Seconds a dispatched request may sit unfinished before
+    #: ``maybe_hedge`` considers its replica stalled.
+    hedge_after_s: float = 5.0
+    #: Effective score at or past which a tracked replica counts as
+    #: stalled for hedging (the adapter's stall penalty lands here).
+    hedge_score: float = SCORE_STALL_PENALTY
+    #: Bounded in-flight tracking for hedging (FIFO eviction: a
+    #: runaway submit rate degrades hedge coverage, never memory).
+    max_outstanding: int = 65536
+
+
+class Dispatch(typing.NamedTuple):
+    """One routing decision — span-stamped by callers (PR 14).
+
+    A NamedTuple, not a dataclass: one of these is built per routed
+    request, and tuple construction is what keeps the per-decision
+    bench gate honest."""
+
+    replica: str
+    row: int
+    sticky: bool = False
+    hedged: bool = False
+    migrated: bool = False
+
+    @property
+    def decision(self) -> str:
+        """The reqtrace attribute value: stick/hedge/migrate/dispatch."""
+        if self.hedged:
+            return "hedge"
+        if self.migrated:
+            return "migrate"
+        if self.sticky:
+            return "stick"
+        return "dispatch"
+
+
+class RouterCore:
+    """Masked-argmin dispatch over one adapter's score column.
+
+    Owns three bounded pieces of state beside the adapter references:
+    the per-row in-flight delta (cleared every refresh), the affinity
+    table, and the outstanding-dispatch map that backs hedging.  All
+    are dicts/arrays with explicit caps — fleet growth resizes the
+    delta column, nothing else grows with traffic.
+    """
+
+    def __init__(self, adapter: ServingMetricsAdapter,
+                 config: RouterConfig | None = None,
+                 metrics: Any = None) -> None:
+        self._adapter = adapter
+        self._cfg = config if config is not None else RouterConfig()
+        self._metrics = metrics
+        self._delta = np.zeros(adapter.capacity())
+        self._stamp_seen = 0
+        self._draining_names: set[str] = set()
+        self._drain_mask = np.zeros(adapter.capacity(), bool)
+        #: session/prefix key -> (replica, row, epoch)
+        self._affinity: dict[str, tuple[str, int, int]] = {}
+        #: rid -> [row, epoch, t_dispatch, hedged]
+        self._outstanding: dict[str, list[Any]] = {}
+        self._heap: list[tuple[float, int]] = []
+        self._watermark = float("inf")
+        # Hot-path caches, rebuilt by every _refill (via _effective):
+        # the effective-score vector (kept incrementally true by
+        # _commit), the static validity mask (drain/pool snapshot),
+        # and a reference to the adapter's live column so deaths are
+        # seen without re-fetching the view tuple per decision.
+        self._eff_vec = np.full(adapter.capacity(), np.inf)
+        self._valid_mask = np.zeros(adapter.capacity(), bool)
+        self._live_ref = np.zeros(adapter.capacity(), bool)
+        self._names_ref = adapter.name_column()
+        #: Staleness drain credit from the last refresh (None until a
+        #: refresh with an injected clock; see :meth:`refresh`).
+        self._credit: np.ndarray | None = None
+        self._pool_filter: int = -1
+        # Lifetime counters (debug_state / metric mirrors).
+        self.dispatches_total = 0
+        self.affinity_hits_total = 0
+        self.affinity_stale_total = 0
+        self.affinity_evictions_total = 0
+        self.hedges_total = 0
+        self.migrated_total = 0
+        self.refreshes_total = 0
+
+    # -- metrics ------------------------------------------------------
+
+    def _inc(self, name: str, by: float = 1.0) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name, by)
+
+    # -- refresh (call after every adapter.fold) ----------------------
+
+    def refresh(self, now: float = 0.0,
+                pool: str | None = None) -> None:
+        """Re-price the candidate heap from the adapter's freshly
+        folded score column — one vectorized masked ``argpartition``,
+        O(fleet) numpy but zero Python per row.  Two staleness
+        corrections make argmin-on-snapshots stable where the raw
+        column oscillates:
+
+        - the local in-flight delta is cleared ONLY on rows whose own
+          snapshot re-folded since the last refresh — those scores now
+          carry the load the dispatches created.  Rows still reporting
+          a stale snapshot keep their delta; clearing it would revert
+          them to a pre-dispatch "empty" score and re-create the
+          classic join-the-shortest-stale-queue herd;
+        - symmetric problem, symmetric fix: a row whose last snapshot
+          said "busy" keeps that score for a whole report period even
+          though it typically drains in a fraction of one, so it is
+          starved, empties, then gets slammed on its next report.  The
+          adapter's :meth:`drain_credit` (expected completions since
+          the snapshot) is subtracted, deliberately unfloored (see
+          :meth:`_effective`).
+
+        ``now`` is the injected clock (purity: the router never reads
+        wall time); 0.0 disables the drain credit.  ``pool`` restricts
+        dispatch to one pool's rows (None = whole fleet)."""
+        cap = self._adapter.capacity()
+        if cap != self._delta.shape[0]:
+            self._delta = np.zeros(cap)
+            self._drain_mask = np.zeros(cap, bool)
+        else:
+            folded = self._adapter.fold_stamps > self._stamp_seen
+            self._delta[folded] = 0.0
+        self._stamp_seen = self._adapter.folds_done
+        self._credit = (self._adapter.drain_credit(now) if now > 0.0
+                        else None)
+        self._drain_mask[:] = False
+        for name in self._draining_names:
+            row = self._adapter.row_of(name)
+            if row >= 0:
+                self._drain_mask[row] = True
+        self._pool_filter = (-1 if pool is None
+                             else self._adapter.pool_index(pool))
+        self._refill()
+        self.refreshes_total += 1
+
+    def _effective(self) -> np.ndarray:
+        """Full effective-score vector, and the hot-path caches as a
+        side effect: ``_eff_vec`` (raw effective scores, which
+        ``_commit`` keeps true between refills by adding its penalty
+        in place), ``_valid_mask`` (drain/pool snapshot), and
+        ``_live_ref`` (a *reference* to the adapter's live column, so
+        in-place deaths are visible to ``_valid_row`` without
+        re-fetching the view per decision)."""
+        scores, live, pool_of_row = self._adapter.router_view()
+        eff = scores + self._delta
+        if self._credit is not None:
+            # Credit applies to score AND delta: the replica serves
+            # its reported backlog and our since-report dispatches
+            # alike, so a row two report-periods stale with delta
+            # accrued is NOT (score + delta) loaded — it drained
+            # ~credit of the total in the meantime.  Crediting only
+            # the score term re-creates the stagger-cohort banding
+            # (just-folded rows, delta freshly cleared, soak every
+            # dispatch while stale cohorts sit on unserved deltas).
+            # No floor: a mildly negative estimate still ranks
+            # correctly (the credit is bounded by real completions),
+            # while flooring collapses every drained row into a tie
+            # broken by row index — a deterministic hot spot.
+            eff = eff - self._credit
+        mask = live & ~self._drain_mask
+        if self._pool_filter >= 0:
+            mask = mask & (pool_of_row == self._pool_filter)
+        self._eff_vec = eff
+        self._valid_mask = mask
+        self._live_ref = live
+        self._names_ref = self._adapter.name_column()
+        return np.where(mask, eff, np.inf)
+
+    def _refill(self) -> None:
+        eff = self._effective()
+        k = min(self._cfg.candidates, eff.size)
+        if k < eff.size:
+            # One argpartition with kth=k yields both the candidate
+            # band (indices [:k]) and the watermark (index k is in
+            # sorted position): the cheapest EXCLUDED row's score.
+            # Once in-flight deltas push every candidate past this,
+            # rows outside the band are now the better choice and the
+            # heap must re-partition — without the watermark the
+            # excluded band (typically stale-busy replicas that have
+            # long since drained) receives nothing until the next
+            # refresh, which shows up as bimodal fleet occupancy.
+            part = np.argpartition(eff, k)
+            cand = part[:k]
+            self._watermark = float(eff[part[k]])
+        else:
+            cand = np.arange(eff.size)
+            self._watermark = float("inf")
+        inf = float("inf")
+        self._heap = [(e, r) for e, r in zip(eff[cand].tolist(),
+                                             cand.tolist())
+                      if e != inf]
+        heapq.heapify(self._heap)
+
+    # -- the hot path -------------------------------------------------
+
+    def _eff_row(self, row: int) -> float:
+        """Scalar effective score for one row (score minus staleness
+        drain credit plus local in-flight delta), read off the cached
+        vector the last refill computed and ``_commit`` keeps true."""
+        return float(self._eff_vec[row])
+
+    def _valid_row(self, row: int) -> bool:
+        # _valid_mask is the drain/pool snapshot from the last refill;
+        # _live_ref is the adapter's own live column, so a replica
+        # that died since then is rejected immediately.
+        if not (0 <= row < self._valid_mask.shape[0]):
+            return False
+        return bool(self._valid_mask[row] and self._live_ref[row])
+
+    def _pick(self, exclude: int = -1) -> int:
+        """Cheapest valid candidate row (never ``exclude`` — hedge
+        re-dispatch leaves the original replica out).  Entries whose
+        stored priority drifted are lazily re-priced (our own
+        in-flight deltas are the only drift source between refreshes,
+        and they only grow, so the loop terminates).  Refills when the
+        heap drains or the whole candidate band has drifted past the
+        refill watermark (rows outside the band are now cheaper); an
+        empty fleet returns -1."""
+        pop, push = heapq.heappop, heapq.heappush
+        slack = _HEAP_SLACK
+        for _attempt in range(3):
+            heap = self._heap
+            eff_vec = self._eff_vec
+            valid = self._valid_mask
+            live = self._live_ref
+            wall = self._watermark + slack
+            held: tuple[float, int] | None = None
+            found = -1
+            while heap:
+                prio, row = pop(heap)
+                if row == exclude:
+                    # Keep the excluded row available for OTHER
+                    # requests; just never return it here.
+                    held = (prio, row)
+                    continue
+                if not (valid[row] and live[row]):
+                    continue
+                eff = eff_vec.item(row)
+                push(heap, (eff, row))
+                if eff > prio + slack:
+                    continue
+                if eff > wall:
+                    # Best candidate is worse than the cheapest row
+                    # OUTSIDE the band: re-partition (found stays -1
+                    # so the attempt loop refills).  After a refill
+                    # the best candidate is <= the new watermark by
+                    # construction, so this fires at most once per
+                    # band saturation, amortized over the ~K * gap /
+                    # penalty dispatches that saturated it.
+                    break
+                found = row
+                break
+            if held is not None:
+                push(heap, held)
+            if found >= 0:
+                return found
+            self._refill()
+        return -1
+
+    def _commit(self, row: int, weight: float = 1.0) -> str:
+        pen = self._cfg.inflight_penalty * weight
+        self._delta[row] += pen
+        self._eff_vec[row] += pen
+        self.dispatches_total += 1
+        m = self._metrics
+        if m is not None:
+            m.inc("router_dispatches", 1.0)
+        name = self._names_ref[row]
+        assert name is not None  # _valid_row checked live
+        return name
+
+    def dispatch(self, now: float, *, session: str | None = None,
+                 rid: str | None = None,
+                 weight: float = 1.0) -> Dispatch | None:
+        """Route one request.  ``session``: affinity key (conversation
+        / prefix hash) — a valid entry sticks, a stale one is dropped
+        and re-routed.  ``rid``: track this request for hedging and
+        exactly-once completion.  ``weight``: request count this
+        decision covers (a cohort dispatch scales the local in-flight
+        penalty).  Returns None only when no live, non-draining
+        replica exists."""
+        sticky = False
+        row = -1
+        if session is not None:
+            ent = self._affinity.get(session)
+            if ent is not None:
+                a_name, a_row, a_epoch = ent
+                if (self._valid_row(a_row)
+                        and self._adapter.replica_of_row(a_row) == a_name
+                        and self._adapter.row_epoch(a_row) == a_epoch):
+                    eff = self._eff_row(a_row)
+                    if eff <= self._cfg.affinity_spill_score:
+                        row, sticky = a_row, True
+                        self.affinity_hits_total += 1
+                        self._inc("router_affinity_hits")
+                    else:
+                        del self._affinity[session]
+                else:
+                    del self._affinity[session]
+                    self.affinity_stale_total += 1
+                    self._inc("router_affinity_stale")
+        if row < 0:
+            row = self._pick()
+            if row < 0:
+                return None
+        name = self._commit(row, weight)
+        if session is not None and not sticky:
+            self._remember(session, name, row)
+        if rid is not None:
+            self._track(rid, row, now)
+        return Dispatch(replica=name, row=row, sticky=sticky)
+
+    def _remember(self, session: str, name: str, row: int) -> None:
+        if session not in self._affinity \
+                and len(self._affinity) >= self._cfg.affinity_capacity:
+            self._affinity.pop(next(iter(self._affinity)))
+            self.affinity_evictions_total += 1
+            self._inc("router_affinity_evictions")
+        self._affinity[session] = (name, row,
+                                   self._adapter.row_epoch(row))
+
+    def _track(self, rid: str, row: int, now: float) -> None:
+        if rid not in self._outstanding \
+                and len(self._outstanding) >= self._cfg.max_outstanding:
+            self._outstanding.pop(next(iter(self._outstanding)))
+        self._outstanding[rid] = [row, self._adapter.row_epoch(row),
+                                  now, False]
+
+    # -- tail defense -------------------------------------------------
+
+    def maybe_hedge(self, rid: str, now: float) -> Dispatch | None:
+        """Hedged re-dispatch, exactly once per tracked request: fires
+        iff the request has waited past ``hedge_after_s`` AND its
+        replica looks wedged — dead, draining, restarted (epoch bump:
+        the request died with the old incarnation), or score-stalled.
+        The re-dispatch excludes the original replica.  Returns the
+        hedge Dispatch, or None (not tracked / not due / already
+        hedged / nowhere else to go)."""
+        ent = self._outstanding.get(rid)
+        if ent is None or ent[3]:
+            return None
+        row, epoch, t0, _ = ent
+        if now - t0 < self._cfg.hedge_after_s:
+            return None
+        stalled = (not self._valid_row(row)
+                   or self._adapter.row_epoch(row) != epoch)
+        if not stalled:
+            stalled = self._eff_row(row) >= self._cfg.hedge_score
+        if not stalled:
+            return None
+        new_row = self._pick(exclude=row)
+        if new_row < 0 or new_row == row:
+            return None
+        ent[3] = True  # exactly-once, even if the hedge also stalls
+        name = self._commit(new_row)
+        ent[0] = new_row
+        ent[1] = self._adapter.row_epoch(new_row)
+        self.hedges_total += 1
+        self._inc("router_hedges")
+        return Dispatch(replica=name, row=new_row, hedged=True)
+
+    def complete(self, rid: str) -> bool:
+        """Mark a tracked request finished.  True iff it was still
+        outstanding — a second completion for the same rid returns
+        False, which is the chaos no-double-completion hook."""
+        return self._outstanding.pop(rid, None) is not None
+
+    # -- drain handoff ------------------------------------------------
+
+    def mark_draining(self, replica: str) -> None:
+        """Stop routing NEW requests at a replica the scaler advised
+        for scale-in; its queue keeps draining (serve.py contract)."""
+        self._draining_names.add(replica)
+        row = self._adapter.row_of(replica)
+        if 0 <= row < self._drain_mask.shape[0]:
+            self._drain_mask[row] = True
+            if row < self._valid_mask.shape[0]:
+                self._valid_mask[row] = False
+
+    def clear_draining(self, replica: str) -> None:
+        self._draining_names.discard(replica)
+        row = self._adapter.row_of(replica)
+        if 0 <= row < self._drain_mask.shape[0]:
+            self._drain_mask[row] = False
+            if row < self._valid_mask.shape[0]:
+                # Restore validity from the live view (the row is back
+                # in rotation at its next heap visit or refill).
+                scores, live, pool_of_row = self._adapter.router_view()
+                ok = bool(live[row]) and (
+                    self._pool_filter < 0
+                    or int(pool_of_row[row]) == self._pool_filter)
+                self._valid_mask[row] = ok
+
+    def absorb_drain(self, receipt: DrainReceipt,
+                     now: float) -> list[Dispatch]:
+        """Migrate a drained replica's unserved remainder: one typed
+        receipt in (the serve.py final-stats contract), one migration
+        Dispatch out per unserved request — the caller re-submits
+        each to its new replica.  The drained replica leaves the
+        draining set (its name may be reused by a future incarnation
+        with a fresh epoch)."""
+        self.clear_draining(receipt.replica)
+        out: list[Dispatch] = []
+        for i in range(receipt.unserved):
+            row = self._pick()
+            if row < 0:
+                break
+            name = self._commit(row)
+            d = Dispatch(replica=name, row=row, migrated=True)
+            out.append(d)
+            self._track(f"{receipt.replica}/migrate-{i}", row, now)
+        self.migrated_total += len(out)
+        if out:
+            self._inc("router_migrated_requests", float(len(out)))
+        return out
+
+    # -- introspection ------------------------------------------------
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._outstanding)
+
+    @property
+    def affinity_size(self) -> int:
+        return len(self._affinity)
+
+    def best_row(self) -> int:
+        """The row the next affinity-free dispatch would take — the
+        oracle hook for the property suite (compare against a naive
+        Python argmin over the effective scores)."""
+        return self._pick()
+
+    def debug_state(self) -> dict[str, Any]:
+        return {
+            "dispatches": self.dispatches_total,
+            "affinity_size": len(self._affinity),
+            "affinity_hits": self.affinity_hits_total,
+            "affinity_stale": self.affinity_stale_total,
+            "affinity_evictions": self.affinity_evictions_total,
+            "hedges": self.hedges_total,
+            "migrated": self.migrated_total,
+            "outstanding": len(self._outstanding),
+            "draining": sorted(self._draining_names),
+            "refreshes": self.refreshes_total,
+            "candidates": len(self._heap),
+        }

@@ -28,7 +28,10 @@ provision lands and the replica has had ``replica_grace_seconds`` to
 join (or the record expires).  Scale-in is ADVICE ONLY
 (``ServingAdvice.scale_in``): the serving platform drains the surplus
 replicas through the ``serve.py`` drain contract — stop admitting,
-finish the queue, exit with the final-stats JSON — and the idle slice
+finish the queue, exit with a ``final_stats`` line that parses as a
+typed :class:`~tpu_autoscaler.serving.drain.DrainReceipt`
+(``confirm_scale_in`` validates it and retires the row; the router's
+``absorb_drain`` migrates any unserved remainder) — and the idle slice
 is then reclaimed by the normal maintenance path, so no queued request
 is ever lost to a reclaim.
 
@@ -160,6 +163,31 @@ class ServingScaler:
     def set_gauge(self, name: str, value: float) -> None:
         if self._metrics is not None:
             self._metrics.set_gauge(name, value)
+
+    # -- drain receipts (ISSUE 18) ----------------------------------------
+
+    def confirm_scale_in(self, receipt: Any) -> bool:
+        """Consume one typed drain receipt for a replica this scaler
+        advised out (:class:`~tpu_autoscaler.serving.drain.
+        DrainReceipt` — the same serve.py contract the router's
+        ``absorb_drain`` migrates from, so the two consumers can't
+        drift on field names).  Retires the replica from the adapter
+        census immediately (its contribution leaves the pool sums this
+        pass, not at snapshot timeout) and accounts the drain: True
+        iff it was clean (drained with zero unserved).  A dirty drain
+        is the router migration path's problem — counted here so the
+        ``serving_drain_unserved`` rate surfaces it either way."""
+        from tpu_autoscaler.serving.drain import DrainReceipt
+
+        if not isinstance(receipt, DrainReceipt):
+            receipt = DrainReceipt.from_payload(receipt)
+        if receipt.replica:
+            self.adapter.remove(receipt.replica)
+        self._inc("serving_drains_confirmed")
+        if receipt.unserved:
+            self._inc("serving_drain_unserved",
+                      float(receipt.unserved))
+        return receipt.clean
 
     # -- decision helpers -------------------------------------------------
 

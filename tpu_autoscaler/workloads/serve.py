@@ -15,9 +15,12 @@ line per request, in submission order:
 
     {"id": 0, "prompt_len": 3, "tokens": [..generated..], "done": true}
 
-followed by ONE machine-readable final-stats line (ISSUE 9 — the drain
-contract's receipt; reclaim tests assert ``unserved == 0`` from it
-instead of parsing a log line):
+followed by ONE machine-readable final-stats line — the drain
+contract's receipt (ISSUE 9; typed as
+``serving.drain.DrainReceipt`` since ISSUE 18, so the autoscaler's
+``confirm_scale_in`` and the router's ``absorb_drain`` parse it with
+per-field validation instead of duck-typing a log line; reclaim tests
+assert ``unserved == 0`` from it):
 
     {"event": "final_stats", "served": N, "unserved": M,
      "drained": bool, "request_latency_ticks": [...], "stats": {...}}
@@ -43,12 +46,18 @@ log = logging.getLogger(__name__)
 from tpu_autoscaler.workloads._cli import model_arch_options, model_config
 
 
-def final_stats_payload(reqs, engine, elapsed_s: float) -> dict:
-    """The drain contract's machine-readable receipt: what was served,
+def final_stats_receipt(reqs, engine, elapsed_s: float,
+                        replica_id: str = ""):
+    """The drain contract's machine-readable receipt, built as the
+    typed :class:`~tpu_autoscaler.serving.drain.DrainReceipt` (ISSUE
+    18) so the emitter, the router migration path and the scaler's
+    scale-in advice share one field-name definition: what was served,
     what was not, per-request latencies — split into queue-wait vs
     execute (ISSUE 14: ``submitted_tick`` survives preemption
     re-queues, so end-to-end latency alone hides requeue wait) — and
     the engine's final stats snapshot."""
+    from tpu_autoscaler.serving.drain import DrainReceipt
+
     latencies = [
         (r.finished_tick - r.submitted_tick
          if r.done and r.finished_tick is not None
@@ -66,19 +75,26 @@ def final_stats_payload(reqs, engine, elapsed_s: float) -> dict:
     execs = [
         (lat - w if lat is not None and w is not None else None)
         for lat, w in zip(latencies, waits)]
-    return {
-        "event": "final_stats",
-        "served": sum(1 for r in reqs if r.done),
-        "unserved": sum(1 for r in reqs if not r.done),
-        "drained": bool(getattr(engine, "draining", False)),
-        "elapsed_s": round(elapsed_s, 3),
-        "ticks": engine.ticks,
-        "decode_tokens": engine.decode_tokens,
-        "request_latency_ticks": latencies,
-        "request_wait_ticks": waits,
-        "request_exec_ticks": execs,
-        "stats": engine.stats().as_dict(),
-    }
+    return DrainReceipt(
+        served=sum(1 for r in reqs if r.done),
+        unserved=sum(1 for r in reqs if not r.done),
+        drained=bool(getattr(engine, "draining", False)),
+        elapsed_s=round(elapsed_s, 3),
+        ticks=int(engine.ticks),
+        decode_tokens=int(engine.decode_tokens),
+        request_latency_ticks=tuple(latencies),
+        request_wait_ticks=tuple(waits),
+        request_exec_ticks=tuple(execs),
+        stats=engine.stats().as_dict(),
+        replica=replica_id)
+
+
+def final_stats_payload(reqs, engine, elapsed_s: float,
+                        replica_id: str = "") -> dict:
+    """Wire-dict form of :func:`final_stats_receipt` (the historical
+    key set; older consumers parse it unchanged)."""
+    return final_stats_receipt(reqs, engine, elapsed_s,
+                               replica_id).to_payload()
 
 
 @click.command()
@@ -130,6 +146,11 @@ def final_stats_payload(reqs, engine, elapsed_s: float) -> dict:
                    "per-request latencies, engine stats) to this "
                    "path; it is always printed as the last stdout "
                    "line.")
+@click.option("--replica-id", default="",
+              help="This replica's fleet id, stamped into the drain "
+                   "receipt so the request router's migration path "
+                   "(serving/router.py absorb_drain) knows whose "
+                   "unserved remainder it is re-dispatching.")
 @click.option("--annotations-file", default=None,
               help="Downward-API annotations path for the drain "
                    "contract (default: the standard "
@@ -154,7 +175,7 @@ def final_stats_payload(reqs, engine, elapsed_s: float) -> dict:
               help="Force a jax platform (e.g. cpu).")
 def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
          max_len, chunk, ring, paged, block_size, num_blocks, spec_k,
-         draft_layers, tp_degree, seed, final_stats_file,
+         draft_layers, tp_degree, seed, final_stats_file, replica_id,
          annotations_file, trace_sample, slo_ticks, vocab, seq_len,
          d_model, n_layers, n_kv_heads, attention_window, no_rope,
          moe_experts, moe_top_k, platform):
@@ -365,7 +386,8 @@ def main(checkpoint_dir, requests_file, random_n, max_new_tokens, slots,
     # The drain contract's machine-readable receipt (ISSUE 9): always
     # the LAST stdout line, so the reclaim side can assert zero lost
     # requests without parsing logs.
-    final = final_stats_payload(reqs, engine, dt)
+    final = final_stats_payload(reqs, engine, dt,
+                                replica_id=replica_id)
     if sampler is not None:
         final["trace"] = sampler.debug_state()
     print(json.dumps(final))
