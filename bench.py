@@ -2724,6 +2724,216 @@ def check_plan_columnar(n_pods: int = PLAN_COLUMNAR_PODS,
     return ok, info
 
 
+# Profiler tier (ISSUE 20) — BENCH_PROFILE.json["profile"]:
+#
+# - OVERHEAD: full reconcile passes with the phase-tree profiler ON
+#   within 2% (+ an explicit noise grace — the loop rows are best-of-3
+#   at ~1 s/pass, so run-to-run jitter dwarfs the ~10 context-manager
+#   enters the profiler adds) of the SAME controller with the profiler
+#   disabled, interleaved best-of over one shared 100k-pod world, and
+#   the 10k-replica adapter fold hot path within the same bound;
+# - CONSERVATION: every measured profiled pass satisfies the self-time
+#   identity (sum of phase self-times + other == pass window within
+#   tolerance) — zero violations, asserted in-bench, and the profile
+#   ring stays bounded.
+PROFILE_LOOP_PODS = 100_000
+PROFILE_LOOP_NODES = 10_000
+PROFILE_LOOP_PAIRS = 12
+PROFILE_FOLD_REPLICAS = SERVING_ADAPTER_REPLICAS
+PROFILE_FOLD_PASSES = 120
+PROFILE_OVERHEAD_GATE = 0.02
+PROFILE_NOISE_GRACE = 0.05
+
+
+def bench_profile(n_pods: int = PROFILE_LOOP_PODS,
+                  n_nodes: int = PROFILE_LOOP_NODES,
+                  pairs: int = PROFILE_LOOP_PAIRS,
+                  fold_replicas: int = PROFILE_FOLD_REPLICAS,
+                  fold_passes: int = PROFILE_FOLD_PASSES) -> dict:
+    """Profiler-on vs profiler-off, same-instance alternation.
+
+    Two controllers over one world would hand the ratio their
+    instance-level noise (dict layout, tracker state) — measured at
+    ~±10%/pass, far above a 2% gate.  Instead ONE controller runs
+    alternating on/off passes (order flipped each pair so host drift
+    and pass-sequence effects hit both sides equally) and the ratio
+    compares the per-side medians: the floor pass cost and its drift
+    are common to both modes, so the ratio isolates the profiler's
+    marginal cost.
+    GC is paused over the measured passes — sporadic full collections
+    are the dominant per-pass variance and land on either side at
+    random, and what's gated is the profiler's marginal cost, not GC
+    scheduling.  The serving tier does the same with a REAL reconcile
+    pass over a churned 10k-replica adapter (Controller +
+    ServingScaler, the bench_serving_adapter idiom) so the fold hook,
+    pass bracketing, and per-phase metric observations are all paid
+    where production pays them — inside a full pass.  Conservation is
+    asserted here, in-bench, for every profiled pass.
+    """
+    import gc
+    import numpy as np
+
+    from tpu_autoscaler.k8s.informer import ClusterInformer
+    from tpu_autoscaler.k8s.objects import clear_parse_caches
+    from tpu_autoscaler.obs.profiler import RING_PASSES, PassProfiler
+    from tpu_autoscaler.serving.adapter import ServingMetricsAdapter
+
+    # -- loop tier ----------------------------------------------------
+    clear_parse_caches()
+    nodes_iter, pods_iter, meta = _loop_world(n_pods, n_nodes)
+    informer_client = _LoopClient()
+    informer = ClusterInformer(informer_client)
+    informer.pod_cache.replace(pods_iter(), "1")
+    informer.node_cache.replace(nodes_iter(), "1")
+    controller, client = _loop_controller(0, informer, columnar=True)
+    controller.reconcile_once(now=60.0)  # warm tracker/trace/view
+    loop_samples: dict[str, list] = {"off": [], "on": []}
+    now = 60.0
+    gc.collect()
+    gc.disable()
+    try:
+        for pair in range(pairs):
+            order = ("off", "on") if pair % 2 == 0 else ("on", "off")
+            for mode in order:
+                controller.profiler.enabled = (mode == "on")
+                now += 60.0
+                t0 = time.perf_counter()
+                controller.reconcile_once(now=now)
+                loop_samples[mode].append(time.perf_counter() - t0)
+    finally:
+        gc.enable()
+    controller.profiler.enabled = True
+    # Median per side: pass cost drifts upward as tracker/TSDB state
+    # accumulates, and the interleaving hands each side the same drift
+    # — the medians cancel it where a min would just race the floor.
+    best = {mode: sorted(vals)[len(vals) // 2]
+            for mode, vals in loop_samples.items()}
+    assert client.lists == 0, "a measured path fell back to LIST"
+    assert informer_client.lists == 0, \
+        "the informer fell back to LIST mid-bench"
+    prof = controller.profiler
+    ring = prof.ring()
+    loop_violations = prof.conservation_violations
+    loop_conserved = all(entry["conserved"] for entry in ring)
+    # Warmup + the ``on`` half of every pair reached the ring.
+    assert prof.passes_total == pairs + 1, prof.passes_total
+    assert len(ring) <= RING_PASSES, len(ring)
+    dominants = {entry["dominant"] for entry in ring}
+    controller.close()
+    clear_parse_caches()
+
+    # -- serving-pass tier (10k-replica adapter in a REAL pass) -------
+    from tpu_autoscaler.actuators.fake import FakeActuator
+    from tpu_autoscaler.controller import Controller, ControllerConfig
+    from tpu_autoscaler.engine.planner import PoolPolicy
+    from tpu_autoscaler.k8s.fake import FakeKube
+    from tpu_autoscaler.serving.scaler import (
+        ServingPolicy,
+        ServingScaler,
+    )
+
+    rng = np.random.default_rng(0)
+    pools = [f"pool-{i}" for i in range(SERVING_ADAPTER_POOLS)]
+    adapter = ServingMetricsAdapter(capacity=fold_replicas)
+    seqs = [1] * fold_replicas
+    for i in range(fold_replicas):
+        snap = _serving_snapshot(seqs[i], rng)
+        adapter.ingest(f"rep-{i}", pools[i % len(pools)],
+                       "tpu-v5-lite-device", "v5e-4", snap, now=0.0)
+    kube = FakeKube()
+    serving_controller = Controller(
+        kube, FakeActuator(kube),
+        ControllerConfig(policy=PoolPolicy(spare_nodes=0)),
+        serving_scaler=ServingScaler(
+            adapter, ServingPolicy(forecast=False, max_replicas=0)))
+    serving_controller.reconcile_once(now=1000.0)  # warm
+    n_churn = max(1, int(fold_replicas * SERVING_ADAPTER_CHURN))
+    cursor = 0
+    fold_samples: dict[str, list] = {"off": [], "on": []}
+    for p in range(1, fold_passes + 1):
+        now = float(1000 + p * 5)
+        for _ in range(n_churn):
+            i = cursor % fold_replicas
+            cursor += 1
+            seqs[i] += 1
+            snap = _serving_snapshot(seqs[i], rng)
+            adapter.ingest(f"rep-{i}", pools[i % len(pools)],
+                           "tpu-v5-lite-device", "v5e-4", snap,
+                           now=now)
+        mode = "on" if p % 2 == 0 else "off"
+        serving_controller.profiler.enabled = (mode == "on")
+        t0 = time.perf_counter()
+        serving_controller.reconcile_once(now=now)
+        dt = time.perf_counter() - t0
+        if p > 2:  # first pair warms both code paths
+            fold_samples[mode].append(dt)
+    serving_controller.profiler.enabled = True
+    # Median, not min: at ms granularity the min is an order statistic
+    # of the timer's left tail and jitters several % between runs; the
+    # median of ~60 alternating samples resolves a sub-% overhead.
+    fold_best = {mode: sorted(vals)[len(vals) // 2]
+                 for mode, vals in fold_samples.items()}
+    fold_prof = serving_controller.profiler
+    fold_ring = fold_prof.ring()
+    fold_violations = fold_prof.conservation_violations
+    fold_conserved = all(entry["conserved"] for entry in fold_ring)
+    assert any(entry["phases"].get("adapter_fold", 0.0) > 0.0
+               for entry in fold_ring), \
+        "the profiled serving pass never hit the fold hook"
+    serving_controller.close()
+
+    loop_ratio = (best["on"] / best["off"]
+                  if best["off"] else None)
+    fold_ratio = (fold_best["on"] / fold_best["off"]
+                  if fold_best["off"] else None)
+    return {
+        "info": "profile", **meta,
+        "requested_pods": n_pods, "requested_nodes": n_nodes,
+        "loop_off_pass_ms": round(best["off"] * 1e3, 2),
+        "loop_on_pass_ms": round(best["on"] * 1e3, 2),
+        "loop_overhead_ratio": (round(loop_ratio, 4)
+                                if loop_ratio else None),
+        "fold_replicas": fold_replicas,
+        "serving_off_pass_ms": round(fold_best["off"] * 1e3, 3),
+        "serving_on_pass_ms": round(fold_best["on"] * 1e3, 3),
+        "serving_overhead_ratio": (round(fold_ratio, 4)
+                                   if fold_ratio else None),
+        "conservation_violations": loop_violations + fold_violations,
+        "ring_conserved": loop_conserved and fold_conserved,
+        "ring_passes": len(ring),
+        "dominant_phases": sorted(dominants),
+    }
+
+
+def check_profile(n_pods: int = PROFILE_LOOP_PODS,
+                  n_nodes: int = PROFILE_LOOP_NODES,
+                  gate: float = PROFILE_OVERHEAD_GATE,
+                  grace: float = PROFILE_NOISE_GRACE
+                  ) -> tuple[bool, dict]:
+    """Gate the profiler tier (ISSUE 20): both overhead ratios within
+    (1 + gate + grace), ZERO conservation violations across every
+    profiled pass, every retained ring entry conserved, and the ring
+    bounded.  Records BENCH_PROFILE.json["profile"]."""
+    info = bench_profile(n_pods, n_nodes)
+    bound = 1.0 + gate + grace
+    info["gates"] = {"overhead_gate": gate, "noise_grace": grace}
+    print(json.dumps(info), file=sys.stderr)
+    perf_ok = ((info["loop_overhead_ratio"] or float("inf")) <= bound
+               and (info["serving_overhead_ratio"] or float("inf"))
+               <= bound)
+    conserve_ok = (info["conservation_violations"] == 0
+                   and info["ring_conserved"])
+    ok = perf_ok and conserve_ok
+    if not ok:
+        print(json.dumps({
+            "error": "profiler regression: overhead above the "
+                     "2%+grace gate, or the self-time conservation "
+                     "identity broke in-bench", **info}),
+            file=sys.stderr)
+    _record_tier("BENCH_PROFILE.json", "profile", info)
+    return ok, info
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -3001,6 +3211,32 @@ def main(argv: list[str] | None = None) -> int:
                                   3)
                             if info["on"]["dollar_proxy_total"]
                             else None),
+        }))
+        return 0 if ok else 1
+    if argv and argv[0] == "profile":
+        # Profiler tier (ISSUE 20, scripts/full_suite.sh + ci_gate.sh):
+        # phase-tree profiler overhead within 2%+grace of profiler-off
+        # at the 100k-pod loop tier and the 10k-replica fold tier,
+        # self-time conservation asserted in-bench; records
+        # BENCH_PROFILE.json.
+        ap = argparse.ArgumentParser(prog="bench.py profile")
+        ap.add_argument("--pods", type=int, default=PROFILE_LOOP_PODS)
+        ap.add_argument("--nodes", type=int,
+                        default=PROFILE_LOOP_NODES)
+        ap.add_argument("--gate", type=float,
+                        default=PROFILE_OVERHEAD_GATE)
+        ap.add_argument("--grace", type=float,
+                        default=PROFILE_NOISE_GRACE)
+        args = ap.parse_args(argv[1:])
+        ok, info = check_profile(args.pods, args.nodes,
+                                 gate=args.gate, grace=args.grace)
+        bound = 1.0 + args.gate + args.grace
+        print(json.dumps({
+            "metric": "profiler_overhead_ratio",
+            "value": info.get("loop_overhead_ratio"),
+            "unit": "x_vs_off",
+            "vs_baseline": round(
+                bound / (info.get("loop_overhead_ratio") or bound), 2),
         }))
         return 0 if ok else 1
     if argv and argv[0] == "trace":

@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""Diff fresh BENCH_*.json records against the committed copies.
+
+The bench tiers each gate themselves (speedup floors, overhead
+bounds), but a tier can pass its own gate while quietly giving back
+most of the headroom a previous PR bought.  This script closes that
+gap: after the tiers have re-recorded their BENCH_*.json files, it
+compares every GATED RATIO (speedups, overhead ratios — the
+self-normalizing numbers, not raw wall-clock timings, which vary by
+host) against the copy committed at ``--base`` (default HEAD) and
+fails CI when any of them regressed by more than ``--threshold``
+(default 20%).
+
+Direction is keyed off the metric name: ``*speedup*`` and plain
+``*ratio*`` keys are higher-is-better; ``*overhead*`` and ``tail_*``
+ratios are lower-is-better.  Keys under a ``gates`` mapping (and
+``gate``/``floor``/``*_gate`` keys) are configuration, not
+measurements, and are skipped.  Files absent from the base commit are
+noted and skipped — a brand-new tier has nothing to regress against.
+
+Usage:  python scripts/bench_diff.py [--threshold 0.20] [--base REF]
+                                     [FILE ...]
+Exit codes: 0 clean, 1 regression found, 2 unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Iterator
+
+# Round archives (BENCH_r01.json…) are the driver's history, not tier
+# records; BENCH_TPU.json depends on attached hardware and records
+# skips on CPU-only hosts.  Neither is comparable across commits.
+SKIP_FILES = {"BENCH_TPU.json"}
+
+LOWER_IS_BETTER_MARKERS = ("overhead", "tail_ratio")
+
+
+def _gated_ratio_direction(key: str) -> str | None:
+    """'up' (higher is better), 'down', or None (not a gated ratio)."""
+    k = key.lower()
+    if any(marker in k for marker in LOWER_IS_BETTER_MARKERS):
+        # overhead_ratio / overhead_factor / tail_ratio: a bigger
+        # number means more time burned.
+        if "ratio" in k or "factor" in k:
+            return "down"
+        return None
+    if "speedup" in k or "ratio" in k or k == "vs_baseline":
+        return "up"
+    return None
+
+
+def _numeric_leaves(obj: Any, path: tuple[str, ...] = ()
+                    ) -> Iterator[tuple[tuple[str, ...], float]]:
+    if isinstance(obj, dict):
+        for key, value in obj.items():
+            if key == "gates" or key in ("gate", "floor") \
+                    or key.endswith("_gate"):
+                continue
+            yield from _numeric_leaves(value, path + (str(key),))
+    elif isinstance(obj, bool):
+        return
+    elif isinstance(obj, (int, float)):
+        yield path, float(obj)
+
+
+def _committed(base: str, filename: str) -> dict | None:
+    proc = subprocess.run(
+        ["git", "show", f"{base}:{filename}"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if proc.returncode != 0:
+        return None
+    try:
+        return json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return None
+
+
+def diff_file(filename: str, base: str, threshold: float
+              ) -> tuple[list[str], list[str]]:
+    """(regressions, notes) for one bench record."""
+    notes: list[str] = []
+    regressions: list[str] = []
+    with open(filename, encoding="utf-8") as f:
+        fresh = json.load(f)
+    old = _committed(base, os.path.basename(filename))
+    if old is None:
+        notes.append(f"{filename}: not in {base} (new tier) — skipped")
+        return regressions, notes
+    old_leaves = dict(_numeric_leaves(old))
+    compared = 0
+    for path, new_value in _numeric_leaves(fresh):
+        direction = _gated_ratio_direction(path[-1])
+        if direction is None or path not in old_leaves:
+            continue
+        old_value = old_leaves[path]
+        if old_value == 0:
+            continue
+        compared += 1
+        if direction == "up":
+            change = (old_value - new_value) / abs(old_value)
+        else:
+            change = (new_value - old_value) / abs(old_value)
+        dotted = ".".join(path)
+        if change > threshold:
+            regressions.append(
+                f"{filename}: {dotted} regressed "
+                f"{change:+.1%} ({old_value:g} -> {new_value:g}, "
+                f"{'higher' if direction == 'up' else 'lower'}"
+                f"-is-better, threshold {threshold:.0%})")
+    notes.append(f"{filename}: {compared} gated ratios compared vs "
+                 f"{base}")
+    return regressions, notes
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff.py",
+        description="fail CI when a fresh BENCH_*.json gave back >"
+                    "threshold of any gated ratio vs the committed copy")
+    ap.add_argument("files", nargs="*",
+                    help="bench records to diff (default: BENCH_*.json "
+                         "in the repo root, minus round archives and "
+                         "hardware-dependent tiers)")
+    ap.add_argument("--threshold", type=float, default=0.20)
+    ap.add_argument("--base", default="HEAD")
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = args.files or sorted(
+        f for f in glob.glob(os.path.join(root, "BENCH_*.json"))
+        if os.path.basename(f) not in SKIP_FILES
+        and not os.path.basename(f).startswith("BENCH_r"))
+    if not files:
+        print("bench_diff: no BENCH_*.json records found", file=sys.stderr)
+        return 2
+
+    all_regressions: list[str] = []
+    for filename in files:
+        try:
+            regressions, notes = diff_file(filename, args.base,
+                                           args.threshold)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_diff: cannot read {filename}: {e}",
+                  file=sys.stderr)
+            return 2
+        for note in notes:
+            print(note, file=sys.stderr)
+        all_regressions.extend(regressions)
+
+    if all_regressions:
+        for line in all_regressions:
+            print(f"REGRESSION {line}")
+        print(json.dumps({"error": "bench ratio regression",
+                          "count": len(all_regressions),
+                          "threshold": args.threshold}))
+        return 1
+    print(json.dumps({"info": "bench_diff", "files": len(files),
+                      "threshold": args.threshold, "regressions": 0}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,15 @@
 #      scan; BENCH_SCALE.json), then the 200-seed mixed chaos corpus
 #      with --verify-columnar (the python planner shadows every
 #      columnar pass; any plan mismatch fails the seed).
+#   19 profiler tier (ISSUE 20, docs/OBSERVABILITY.md "Control-plane
+#      profiling"): bench.py profile — the phase-tree profiler's
+#      overhead within 2% + noise grace of profiler-off at the
+#      100k-pod loop tier and the 10k-replica serving-pass tier, with
+#      the self-time conservation identity asserted in-bench on every
+#      profiled pass (BENCH_PROFILE.json); then scripts/bench_diff.py
+#      — every gated ratio in the freshly re-recorded BENCH_*.json
+#      files within 20% of the committed copy, so a tier can't pass
+#      its own floor while quietly giving back a prior PR's headroom.
 #   18 router tier (ISSUE 18, docs/SERVING.md "Request routing"):
 #      bench.py router — amortized routing decision <= 5 us and score
 #      refresh <= 1 ms per pass at 10k replicas, then the 2.2M-user
@@ -105,10 +114,10 @@ cd "$(dirname "$0")/.."
 
 fmt="${ANALYSIS_FORMAT:-github}"
 
-echo "== [1/17] invariant analysis (--format=$fmt)"
+echo "== [1/18] invariant analysis (--format=$fmt)"
 python -m tpu_autoscaler.analysis --format="$fmt" tpu_autoscaler/ || exit 2
 
-echo "== [2/17] deadlock & determinism layer (TAL/TAB/TAD --no-baseline + witness cross-check)"
+echo "== [2/18] deadlock & determinism layer (TAL/TAB/TAD --no-baseline + witness cross-check)"
 # Zero-baseline-growth enforcement for the ISSUE 15 code families:
 # stage 1 honors baseline.toml, this stage deliberately does not.
 python -m tpu_autoscaler.analysis --format="$fmt" --no-baseline \
@@ -116,7 +125,7 @@ python -m tpu_autoscaler.analysis --format="$fmt" --no-baseline \
 JAX_PLATFORMS=cpu python -m pytest -q tests/test_lockwitness.py \
     -p no:cacheprovider || exit 15
 
-echo "== [3/17] units-of-measure layer (TAU10xx --no-baseline)"
+echo "== [3/18] units-of-measure layer (TAU10xx --no-baseline)"
 # Zero-baseline-growth for the cost-algebra dimension checker, same
 # contract as the stage above: stage 1 honors baseline.toml, this
 # stage deliberately does not — a fresh TAU finding fails CI even if
@@ -124,11 +133,11 @@ echo "== [3/17] units-of-measure layer (TAU10xx --no-baseline)"
 python -m tpu_autoscaler.analysis --format="$fmt" --units --no-baseline \
     tpu_autoscaler/ || exit 16
 
-echo "== [4/17] mypy strict islands"
+echo "== [4/18] mypy strict islands"
 # One source of truth for the strict-island list: lint.sh.
 ./scripts/lint.sh --mypy-only || exit 3
 
-echo "== [5/17] deterministic-schedule race tier"
+echo "== [5/18] deterministic-schedule race tier"
 # One source of truth for the tier invocation: race.sh.  Its static
 # layer and witness cross-check already ran above (stage 1 runs every
 # program pass over the whole package; stage 2 runs
@@ -136,14 +145,14 @@ echo "== [5/17] deterministic-schedule race tier"
 # to pay for the whole-program analysis a third time.
 RACE_STATIC_COVERED=1 ./scripts/race.sh || exit 4
 
-echo "== [6/17] tracer-overhead gate"
+echo "== [6/18] tracer-overhead gate"
 JAX_PLATFORMS=cpu python bench.py trace || exit 5
 
-echo "== [7/17] mega-cluster scale tiers"
+echo "== [7/18] mega-cluster scale tiers"
 JAX_PLATFORMS=cpu python bench.py observe --pods 100000 --nodes 10000 --floor 20 || exit 6
 JAX_PLATFORMS=cpu python bench.py fit_batch --gangs 8192 --floor 2 || exit 6
 
-echo "== [8/17] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack + 200 router)"
+echo "== [8/18] generative chaos corpora (200 mixed + 200 policy + 200 serving + 200 alerts + 200 repack + 200 router)"
 # Every seed must hold every property invariant (no stranded chips, no
 # double provision, whole-slice deletes only, gang ICI integrity,
 # convergence, complete traces).  The CLI exits 2 on a violation and 3
@@ -191,13 +200,13 @@ JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 400 --profile repair --reconcile-shards 4 \
     || exit 7
 
-echo "== [9/17] policy replay tier"
+echo "== [9/18] policy replay tier"
 JAX_PLATFORMS=cpu python bench.py policy || exit 8
 
-echo "== [10/17] serving tier (adapter hot path + outcome replay)"
+echo "== [10/18] serving tier (adapter hot path + outcome replay)"
 JAX_PLATFORMS=cpu python bench.py serving || exit 9
 
-echo "== [11/17] serving-trace tier (data-plane tracing overhead + acceptance)"
+echo "== [11/18] serving-trace tier (data-plane tracing overhead + acceptance)"
 # ISSUE 14 (docs/OBSERVABILITY.md "Request spans & exemplars"):
 # traced-vs-untraced replica step and 10k-replica exemplar fold
 # within 2% + noise grace at 1% sampling with tail capture ON, plus
@@ -208,7 +217,7 @@ echo "== [11/17] serving-trace tier (data-plane tracing overhead + acceptance)"
 # BENCH_SERVING.json["serving_trace"].
 JAX_PLATFORMS=cpu python bench.py serving-trace || exit 14
 
-echo "== [12/17] router tier (dispatch decision cost + route_compare)"
+echo "== [12/18] router tier (dispatch decision cost + route_compare)"
 # ISSUE 18 (docs/SERVING.md "Request routing"): the routing decision
 # must stay <= 5 us amortized and the score refresh <= 1 ms per pass
 # at 10k replicas, then the 2.2M-user route_compare replay at equal
@@ -218,16 +227,16 @@ echo "== [12/17] router tier (dispatch decision cost + route_compare)"
 # lost requests.  Records BENCH_SERVING.json["router"].
 JAX_PLATFORMS=cpu python bench.py router || exit 18
 
-echo "== [13/17] obs tier (TSDB ingest + alert evaluation)"
+echo "== [13/18] obs tier (TSDB ingest + alert evaluation)"
 JAX_PLATFORMS=cpu python bench.py obs || exit 10
 
-echo "== [14/17] cost tier (attribution ledger pass cost + conservation)"
+echo "== [14/18] cost tier (attribution ledger pass cost + conservation)"
 JAX_PLATFORMS=cpu python bench.py cost || exit 11
 
-echo "== [15/17] repack tier (week-long churn replay, never-worse gate)"
+echo "== [15/18] repack tier (week-long churn replay, never-worse gate)"
 JAX_PLATFORMS=cpu python bench.py repack || exit 12
 
-echo "== [16/17] sharded reconcile tier (million-pod loop + observe)"
+echo "== [16/18] sharded reconcile tier (million-pod loop + observe)"
 # ISSUE 13 (docs/SHARDING.md): the 1M-pod observe tier (indexed reads
 # must hold the 20x floor at 10x the PR-6 scale), then the full-loop
 # tier — sharded reconcile >= 2x serial passes/sec at 8 shards with
@@ -238,7 +247,7 @@ echo "== [16/17] sharded reconcile tier (million-pod loop + observe)"
 JAX_PLATFORMS=cpu python bench.py observe --pods 1000000 --nodes 100000 --floor 20 || exit 13
 JAX_PLATFORMS=cpu python bench.py loop --pods 1000000 --nodes 100000 || exit 13
 
-echo "== [17/17] columnar planner tier (million-pod plan + verified chaos corpus)"
+echo "== [17/18] columnar planner tier (million-pod plan + verified chaos corpus)"
 # ISSUE 17 (docs/PLANNER.md): the columnar planner tier — the serial
 # million-pod planning pass on the struct-of-arrays fast path must
 # beat the python oracle >= 5x with byte-identical decisions (plan
@@ -249,5 +258,16 @@ echo "== [17/17] columnar planner tier (million-pod plan + verified chaos corpus
 JAX_PLATFORMS=cpu python bench.py plan_columnar --pods 1000000 --nodes 100000 || exit 17
 JAX_PLATFORMS=cpu python -m tpu_autoscaler.chaos --seed-corpus \
     --seeds 200 --budget 480 --verify-columnar || exit 17
+
+echo "== [18/18] profiler tier (overhead + conservation) and bench ratio diff"
+# ISSUE 20 (docs/OBSERVABILITY.md "Control-plane profiling"): the
+# phase-tree profiler within 2% + noise grace of profiler-off at the
+# 100k-pod loop tier and the 10k-replica serving-pass tier, zero
+# conservation violations asserted in-bench (BENCH_PROFILE.json);
+# then the cross-tier ratio diff — the bench stages above re-recorded
+# their BENCH_*.json files, and every gated ratio (speedups, overhead
+# ratios) must sit within 20% of the committed copy.
+JAX_PLATFORMS=cpu python bench.py profile || exit 19
+python scripts/bench_diff.py || exit 19
 
 echo "CI GATE GREEN"

@@ -182,6 +182,18 @@ JAX_PLATFORMS=cpu python bench.py loop --pods 1000000 --nodes 100000
 # claim scan); results merge into BENCH_SCALE.json.
 JAX_PLATFORMS=cpu python bench.py plan_columnar --pods 1000000 --nodes 100000
 
+# Profiler tier (ISSUE 20, docs/OBSERVABILITY.md "Control-plane
+# profiling"): the phase-tree profiler ON vs OFF — overhead within
+# 2% + noise grace at the 100k-pod loop tier and the 10k-replica
+# serving-pass tier, self-time conservation asserted in-bench on
+# every profiled pass; records BENCH_PROFILE.json.  Then the
+# cross-tier ratio diff: every gated ratio in the re-recorded
+# BENCH_*.json files must sit within 20% of the committed copy — a
+# tier passing its own floor can't quietly give back another PR's
+# headroom.
+JAX_PLATFORMS=cpu python bench.py profile
+python scripts/bench_diff.py
+
 controller_ignores=(
   --ignore=tests/test_attention.py --ignore=tests/test_ring_attention.py
   --ignore=tests/test_sp.py --ignore=tests/test_pipeline.py

@@ -34,6 +34,7 @@ MYPY_TARGETS=(
   tpu_autoscaler/repack
   tpu_autoscaler/serving/router.py
   tpu_autoscaler/serving/drain.py
+  tpu_autoscaler/obs/profiler.py
 )
 
 run_mypy() {

@@ -1,0 +1,493 @@
+"""Continuous control-plane profiler tests (ISSUE 20): the self-time
+ledger's conservation identity against the rebuild-from-spans oracle
+(seeded churn property suite), the forced-close/violation distinction
+chaos brownouts depend on, the bounded stack sampler, the
+``phase-share-drift`` sentinel, the sabotage-teeth e2e (an injected
+slow phase is named by BOTH the online sentinel and the offline
+``perf-report`` diff), bundle replay divergence both ways, and the
+trace renderer's self-time column."""
+
+import json
+import threading
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from tpu_autoscaler.actuators.fake import FakeActuator
+from tpu_autoscaler.controller import Controller, ControllerConfig
+from tpu_autoscaler.k8s.fake import FakeKube
+from tpu_autoscaler.main import cli
+from tpu_autoscaler.metrics import Metrics
+from tpu_autoscaler.obs import perfreport
+from tpu_autoscaler.obs.__main__ import main as obs_main, replay_profile
+from tpu_autoscaler.obs.alerts import AlertEngine, default_rules
+from tpu_autoscaler.obs.blackbox import load_bundle, write_atomic
+from tpu_autoscaler.obs.profiler import (
+    PHASE_METRIC_PREFIX,
+    PHASES,
+    PassProfiler,
+    StackSampler,
+    rebuild_from_events,
+)
+from tpu_autoscaler.obs.render import render_trace
+from tpu_autoscaler.obs.tsdb import TimeSeriesDB
+
+
+class FakeClock:
+    """Injected monotonic clock: the profiler never reads wall time."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def make_profiler(**kw):
+    clock = FakeClock()
+    return PassProfiler(clock=clock, **kw), clock
+
+
+class TestSelfTimeLedger:
+    def test_nested_self_times_exact(self):
+        prof, clock = make_profiler()
+        prof.begin_pass(clock())
+        with prof.phase("plan"):
+            clock.tick(0.010)
+            with prof.phase("policy"):
+                clock.tick(0.004)
+            clock.tick(0.006)
+        clock.tick(0.002)  # outside any phase -> "other"
+        with prof.phase("cost_close"):
+            clock.tick(0.001)
+        info = prof.end_pass()
+        assert info["phases"]["plan"] == pytest.approx(0.016)
+        assert info["phases"]["policy"] == pytest.approx(0.004)
+        assert info["phases"]["cost_close"] == pytest.approx(0.001)
+        assert info["phases"]["other"] == pytest.approx(0.002)
+        assert info["conserved"]
+        assert info["dominant"] == "plan"
+        assert sum(info["phases"].values()) == pytest.approx(
+            info["window_s"])
+        assert prof.conservation_violations == 0
+
+    def test_disabled_is_a_noop(self):
+        prof, clock = make_profiler(enabled=False)
+        prof.begin_pass(clock())
+        with prof.phase("plan"):
+            clock.tick(1.0)
+        assert prof.end_pass() == {}
+        assert prof.ring() == []
+        assert prof.passes_total == 0
+
+    def test_forced_close_is_not_a_conservation_violation(self):
+        # A chaos brownout crashes the pass mid-flight; the NEXT
+        # begin_pass force-closes it.  That must count on its own
+        # counter, never on the conservation one — the chaos invariant
+        # asserts violations stay exactly zero on fault-heavy seeds.
+        prof, clock = make_profiler()
+        prof.begin_pass(clock())
+        cm = prof.phase("plan")
+        cm.__enter__()          # pass "crashes" here: never exited
+        clock.tick(0.005)
+        prof.begin_pass(clock())
+        with prof.phase("observe"):
+            clock.tick(0.001)
+        # The orphaned span's exit must drop cleanly, never pop the
+        # NEW pass's stack.
+        cm.__exit__(None, None, None)
+        info = prof.end_pass()
+        assert prof.forced_closes == 1
+        assert prof.conservation_violations == 0
+        assert info["conserved"] and "plan" not in info["phases"]
+        # The abandoned pass never reached the ring.
+        assert len(prof.ring()) == 1
+
+    def test_out_of_pass_ledger_excluded_from_conservation(self):
+        # The router refresh runs BETWEEN passes; its spans ride a
+        # separate ledger and must not unbalance any pass window.
+        prof, clock = make_profiler()
+        with prof.phase("router_refresh"):
+            clock.tick(0.003)
+        prof.begin_pass(clock())
+        with prof.phase("plan"):
+            clock.tick(0.001)
+        info = prof.end_pass()
+        assert info["conserved"]
+        assert "router_refresh" not in info["phases"]
+        assert info["out_of_pass"]["router_refresh"] == pytest.approx(
+            0.003)
+
+    def test_metrics_observe_every_declared_phase(self):
+        m = Metrics()
+        clock = FakeClock()
+        prof = PassProfiler(clock=clock, metrics=m)
+        prof.begin_pass(clock())
+        with prof.phase("plan"):
+            clock.tick(0.002)
+        prof.end_pass()
+        summaries = m.snapshot()["summaries"]
+        for phase in PHASES:
+            assert f"{PHASE_METRIC_PREFIX}{phase}" in summaries
+
+    def test_debug_state_shape(self):
+        prof, clock = make_profiler()
+        prof.begin_pass(clock())
+        with prof.phase("observe"):
+            clock.tick(0.001)
+        prof.end_pass()
+        state = prof.debug_state()
+        assert state["passes_total"] == 1
+        assert state["conservation"]["violations"] == 0
+        assert state["conservation"]["forced_closes"] == 0
+        assert state["ring"][0]["conserved"]
+
+
+class TestChurnPropertySuite:
+    """Seeded churn: arbitrary nested phase trees with idle gaps; the
+    incremental ledger must equal the rebuild-from-spans oracle and
+    conserve every pass, and the ring must hold its bound."""
+
+    def _grow(self, prof, clock, rng, depth):
+        for _ in range(rng.randint(1, 3)):
+            name = rng.choice(PHASES[:-1])  # "other" is the residual
+            with prof.phase(name):
+                clock.tick(rng.random() * 0.01)
+                if depth < 4 and rng.random() < 0.5:
+                    self._grow(prof, clock, rng, depth + 1)
+                clock.tick(rng.random() * 0.01)
+            if rng.random() < 0.3:
+                clock.tick(rng.random() * 0.005)  # gap -> "other"
+
+    @pytest.mark.parametrize("seed", range(25))
+    def test_incremental_equals_rebuild_oracle(self, seed):
+        import random
+
+        rng = random.Random(seed)
+        prof, clock = make_profiler(ring_passes=4)
+        for _ in range(rng.randint(6, 10)):
+            clock.tick(rng.random() * 0.01)
+            prof.begin_pass(clock())
+            self._grow(prof, clock, rng, 0)
+            info = prof.end_pass()
+            assert info["conserved"], info
+            rebuilt = rebuild_from_events(info["events"])
+            incremental = {k: v for k, v in info["phases"].items()
+                           if k != "other"}
+            assert set(rebuilt) == set(incremental)
+            for name, secs in rebuilt.items():
+                assert incremental[name] == pytest.approx(secs, abs=1e-8)
+            assert sum(info["phases"].values()) == pytest.approx(
+                info["window_s"])
+        assert prof.conservation_violations == 0
+        assert len(prof.ring()) <= prof.ring_limit == 4
+
+
+class TestStackSampler:
+    def test_sample_collapses_own_stack(self):
+        s = StackSampler(hz=100.0)
+        s._target = threading.get_ident()
+        s._sample()
+        assert s.samples_total == 1
+        lines = s.collapsed()
+        assert len(lines) == 1
+        stack, count = lines[0].rsplit(" ", 1)
+        assert count == "1"
+        assert "test_profiler" in stack  # leaf frame is this test
+
+    def test_table_bounded_overflow_counted(self):
+        s = StackSampler(hz=100.0, max_stacks=0)
+        s._target = threading.get_ident()
+        s._sample()
+        assert s.dropped_total == 1
+        assert s.collapsed() == []
+
+    def test_live_thread_sampling(self):
+        s = StackSampler(hz=200.0)
+        s.start(threading.get_ident())
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and s.samples_total == 0:
+                time.sleep(0.01)
+        finally:
+            s.stop()
+        assert s.samples_total >= 1
+        assert not s.running
+        assert s.debug_state()["errors_total"] == 0
+
+
+def drift_rule():
+    rule = next(r for r in default_rules()
+                if r.name == "phase-share-drift")
+    assert rule.kind == "phase_share_drift"
+    return rule
+
+
+class TestPhaseShareDriftSentinel:
+    def _feed(self, db, m, t, plan, cost):
+        m.observe(f"{PHASE_METRIC_PREFIX}plan", plan)
+        m.observe(f"{PHASE_METRIC_PREFIX}cost_close", cost)
+        m.observe(f"{PHASE_METRIC_PREFIX}other", 0.0005)
+        db.ingest(m.snapshot(), t)
+
+    def test_drift_fires_naming_the_phase(self):
+        rule = drift_rule()
+        eng = AlertEngine((rule,))
+        db, m = TimeSeriesDB(), Metrics()
+        t = 0.0
+        for _ in range(120):  # healthy baseline: stable mix
+            self._feed(db, m, t, plan=0.004, cost=0.001)
+            assert eng.evaluate(db, t).transitions == ()
+            t += 5.0
+        fired = None
+        for _ in range(120):  # cost_close's share drifts up
+            self._feed(db, m, t, plan=0.004, cost=0.02)
+            result = eng.evaluate(db, t)
+            t += 5.0
+            if result.transitions:
+                fired = result.transitions[0]
+                break
+        assert fired is not None and fired.firing
+        assert "phase cost_close" in fired.summary
+        assert "baseline" in fired.summary
+
+    def test_busier_fleet_is_not_a_regression(self):
+        # Absolute seconds triple but the MIX is identical: shares
+        # cancel the load growth and the sentinel stays silent.
+        rule = drift_rule()
+        eng = AlertEngine((rule,))
+        db, m = TimeSeriesDB(), Metrics()
+        t = 0.0
+        for _ in range(120):
+            self._feed(db, m, t, plan=0.004, cost=0.001)
+            eng.evaluate(db, t)
+            t += 5.0
+        for _ in range(120):
+            self._feed(db, m, t, plan=0.012, cost=0.003)
+            assert eng.evaluate(db, t).transitions == ()
+            t += 5.0
+
+    def test_too_few_passes_never_breach(self):
+        rule = drift_rule()
+        eng = AlertEngine((rule,))
+        db, m = TimeSeriesDB(), Metrics()
+        for i in range(rule.min_events - 1):
+            self._feed(db, m, float(i * 100), plan=0.001, cost=0.05)
+            assert eng.evaluate(db, float(i * 100)).transitions == ()
+
+
+def make_controller(**cfg_kw):
+    kube = FakeKube()
+    actuator = FakeActuator(kube, provision_delay=0.0)
+    return Controller(kube, actuator, ControllerConfig(**cfg_kw))
+
+
+def busy_wait(seconds):
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        pass
+
+
+class TestSabotageTeeth:
+    """The acceptance gate: inject a slow phase into a live controller
+    and BOTH detectors must name it — the online sentinel's transition
+    summary and the offline two-window perf-report diff."""
+
+    def test_injected_slow_phase_named_by_sentinel_and_diff(self):
+        controller = make_controller()
+        notes = []
+        controller.notifier = type(
+            "Notes", (), {"notify": lambda self, msg: notes.append(msg)})()
+        orig_cost = controller._cost_pass
+        orig_scale = controller._scale
+
+        # Deterministic busy-waits dominate BOTH sides of the mix so
+        # the shares are set by known work, not world-size noise: plan
+        # anchors the denominator at ~5ms, cost_close moves 1ms->10ms.
+        def padded_scale(*a, **kw):
+            busy_wait(0.005)
+            return orig_scale(*a, **kw)
+
+        def baseline_cost(now, fleet_chips):
+            busy_wait(0.001)
+            return orig_cost(now, fleet_chips)
+
+        def sabotaged_cost(now, fleet_chips):
+            busy_wait(0.010)
+            return orig_cost(now, fleet_chips)
+
+        controller._scale = padded_scale
+        controller._cost_pass = baseline_cost
+        t = 0.0
+        for _ in range(130):
+            controller.reconcile_once(now=t)
+            t += 5.0
+        assert "phase-share-drift" not in controller.alerts.firing()
+        early = perfreport.decompose(controller.tsdb.dump())
+        assert early["passes"] > 100
+
+        controller._cost_pass = sabotaged_cost
+        t_reg = t
+        fired_summary = None
+        for _ in range(90):
+            controller.reconcile_once(now=t)
+            t += 5.0
+            if "phase-share-drift" in controller.alerts.firing():
+                fired_summary = next(
+                    n for n in notes
+                    if "phase-share-drift FIRING" in n)
+                break
+        assert fired_summary is not None, \
+            "sentinel never fired on a 5x slower cost_close"
+        assert "cost_close" in fired_summary
+
+        # Offline twin: the two-window diff names the same phase.
+        late = perfreport.decompose(controller.tsdb.dump(),
+                                    window=t - t_reg)
+        delta = perfreport.diff(early, late)
+        assert delta["regressing"] == "cost_close"
+        assert delta["worst_share_delta"] > 0.15
+        assert "cost_close" in perfreport.render_diff(delta)
+        # Conservation held throughout the sabotage run.
+        assert controller.profiler.conservation_violations == 0
+
+    def test_profiler_on_by_default_and_route_serves(self):
+        controller = make_controller()
+        controller.reconcile_once(now=0.0)
+        assert controller.profiler.enabled
+        body = controller.profile_route()
+        assert body["passes_total"] == 1
+        assert body["ring"][0]["conserved"]
+        assert json.dumps(body)  # JSON-able: it is a /debugz body
+
+
+class TestReplayProfile:
+    def _bundle(self, tmp_path, passes=6):
+        controller = make_controller()
+        for i in range(passes):
+            controller.reconcile_once(now=float(i * 5))
+        path = str(tmp_path / "bundle.json")
+        write_atomic(path, controller.incident_bundle("test"))
+        return path
+
+    def test_fresh_bundle_reproduces(self, tmp_path):
+        path = self._bundle(tmp_path)
+        bundle = load_bundle(path)
+        assert "report" in bundle["profile"]
+        assert replay_profile(bundle)["reproduced"]
+        assert obs_main(["replay", path, "-q"]) == 0
+
+    def test_tampered_dominant_diverges(self, tmp_path):
+        path = self._bundle(tmp_path)
+        bundle = load_bundle(path)
+        bundle["profile"]["report"]["dominant"] = "bogus"
+        assert not replay_profile(bundle)["reproduced"]
+        write_atomic(path, bundle)
+        assert obs_main(["replay", path, "-q"]) == 2
+
+    def test_tampered_ring_fails_conservation_recheck(self, tmp_path):
+        path = self._bundle(tmp_path)
+        bundle = load_bundle(path)
+        ring = bundle["profile"]["ring"]
+        ring[0]["phases"]["plan"] = ring[0]["phases"].get(
+            "plan", 0.0) + 1.0
+        report = replay_profile(bundle)
+        assert report["ring_violations"] >= 1
+        assert not report["reproduced"]
+
+    def test_missing_profile_with_series_diverges(self, tmp_path):
+        # Divergence the OTHER way: the TSDB carries phase series, so
+        # the capture should have recorded a profile — absence is a
+        # finding, not a degrade.
+        path = self._bundle(tmp_path)
+        bundle = load_bundle(path)
+        del bundle["profile"]
+        assert not replay_profile(bundle)["reproduced"]
+        write_atomic(path, bundle)
+        assert obs_main(["replay", path, "-q"]) == 2
+
+    def test_pre_profiler_bundle_degrades_render_only(self, tmp_path):
+        path = self._bundle(tmp_path)
+        bundle = load_bundle(path)
+        del bundle["profile"]
+        bundle["tsdb"]["series"] = {
+            k: v for k, v in bundle["tsdb"]["series"].items()
+            if not k.startswith(PHASE_METRIC_PREFIX)}
+        report = replay_profile(bundle)
+        assert report["reproduced"]
+        assert "skipped" in report
+        write_atomic(path, bundle)
+        assert obs_main(["replay", path, "-q"]) == 0
+
+
+class TestPerfReportCLI:
+    def test_report_and_diff_from_bundles(self, tmp_path):
+        controller = make_controller()
+        orig = controller._cost_pass
+        for i in range(8):
+            controller.reconcile_once(now=float(i * 5))
+        before = str(tmp_path / "before.json")
+        write_atomic(before, controller.incident_bundle("before"))
+        controller._cost_pass = lambda now, fleet_chips: (
+            busy_wait(0.008) or orig(now, fleet_chips))
+        for i in range(8, 16):
+            controller.reconcile_once(now=float(i * 5))
+        after = str(tmp_path / "after.json")
+        write_atomic(after, controller.incident_bundle("after"))
+
+        runner = CliRunner()
+        res = runner.invoke(cli, ["perf-report", "--from", after])
+        assert res.exit_code == 0, res.output
+        assert "control-plane phase decomposition" in res.output
+        res = runner.invoke(cli, ["perf-report", "--from", after,
+                                  "--against", before])
+        assert res.exit_code == 0, res.output
+        assert "<- regressing" in res.output
+        line = next(ln for ln in res.output.splitlines()
+                    if "<- regressing" in ln)
+        assert "cost_close" in line
+
+    def test_json_report(self, tmp_path):
+        controller = make_controller()
+        for i in range(4):
+            controller.reconcile_once(now=float(i * 5))
+        path = str(tmp_path / "b.json")
+        write_atomic(path, controller.incident_bundle("t"))
+        res = CliRunner().invoke(
+            cli, ["perf-report", "--from", path, "--json"])
+        assert res.exit_code == 0, res.output
+        body = json.loads(res.output)
+        assert body["passes"] >= 1
+        assert body["dominant"] is not None
+
+
+class TestRenderSelfTime:
+    def _dump(self, child_end=2.0):
+        return {"spans": [
+            {"name": "scale_up", "trace_id": "t", "span_id": "s1",
+             "parent_id": None, "start": 0.0, "end": 5.0,
+             "duration_s": 5.0, "seq": 1, "attrs": {}, "events": []},
+            {"name": "provision", "trace_id": "t", "span_id": "s2",
+             "parent_id": "s1", "start": 1.0, "end": child_end,
+             "duration_s": (child_end - 1.0
+                            if child_end is not None else None),
+             "seq": 2, "attrs": {}, "events": []},
+        ]}
+
+    def test_parent_rows_show_self_time(self):
+        out = render_trace(self._dump(), "t")
+        parent = next(ln for ln in out.splitlines() if "scale_up" in ln)
+        assert "self=4" in parent  # 5s minus the 1s child
+        # Leaf rows skip the column: self would just repeat duration.
+        child = next(ln for ln in out.splitlines() if "provision" in ln)
+        assert "self=" not in child
+
+    def test_open_child_suppresses_partial_self(self):
+        out = render_trace(self._dump(child_end=None), "t")
+        parent = next(ln for ln in out.splitlines() if "scale_up" in ln)
+        assert "self=" not in parent

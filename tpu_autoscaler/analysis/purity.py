@@ -77,6 +77,11 @@ DEFAULT_SCOPE = (
     # (`now` parameters), no I/O, no randomness, so a routing decision
     # is replayable from the adapter state + the dispatch sequence.
     "tpu_autoscaler/serving/router.py",
+    # The pass profiler (ISSUE 20): the clock is an injected callable,
+    # no I/O — a pass profile is replayable from its recorded spans
+    # (rebuild_from_events is the oracle the property suite holds the
+    # incremental ledger to).
+    "tpu_autoscaler/obs/profiler.py",
 )
 
 

@@ -459,6 +459,10 @@ class _RouterFuzz:
         self.rng = random.Random(program.seed ^ 0x207712)
         self.router = RouterCore(adapter, RouterConfig(
             hedge_after_s=self.HEDGE_AFTER_S))
+        # Router refreshes run between passes; binding the controller's
+        # profiler charges them to the out-of-pass ledger (ISSUE 20) so
+        # chaos exercises that path under fault load too.
+        self.router.profiler = monitor._controller.profiler
         #: rid -> [replica, dispatched_at]
         self.ledger: dict[str, list] = {}
         self.submitted = 0

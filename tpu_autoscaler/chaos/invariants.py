@@ -173,6 +173,24 @@ class InvariantMonitor:
                            f"{fleet} fleet chips (a chip-second went "
                            f"unaccounted or was counted twice)")
 
+        # Profiler self-time conservation (ISSUE 20,
+        # docs/OBSERVABILITY.md "Control-plane profiling"): every pass
+        # the profiler closed, the per-phase self times plus the
+        # ``other`` residual must sum to the pass window within the
+        # declared tolerance — the profiler's own violation counter is
+        # checked per step so the FIRST broken pass names itself, and
+        # its per-pass ring must hold its bound.
+        prof = getattr(self._controller, "profiler", None)
+        if prof is not None and prof.conservation_violations:
+            self._fail(t, "profiler-conservation",
+                       f"{prof.conservation_violations} self-time "
+                       f"conservation violation(s) (phase self times "
+                       f"no longer sum to the pass window)")
+        if prof is not None and len(prof.ring()) > prof.ring_limit:
+            self._fail(t, "profiler-ring-bounded",
+                       f"profiler ring holds {len(prof.ring())} passes "
+                       f"(bound {prof.ring_limit})")
+
     # -- terminal ---------------------------------------------------------
 
     def check_converged(self, t: float, live_jobs: dict[str, list[str]]
@@ -260,6 +278,18 @@ class InvariantMonitor:
                        f"{ledger.conservation_violations} conservation "
                        f"violation(s) over the run (attributed != "
                        f"fleet chip-seconds)")
+
+        # Profiler conservation, terminal half: zero self-time
+        # violations across the WHOLE run.  Brownout-crashed passes
+        # are fine — an abandoned pass is a forced close (its own
+        # counter), never a conservation violation, so this stays
+        # exactly zero even on fault-heavy seeds.
+        prof = getattr(self._controller, "profiler", None)
+        if prof is not None and prof.conservation_violations:
+            self._fail(t, "profiler-conservation",
+                       f"{prof.conservation_violations} self-time "
+                       f"conservation violation(s) over the run (phase "
+                       f"self times + other != pass window)")
 
         # Flight-recorder completeness: every finished trace is whole.
         from tpu_autoscaler.obs import trace_gaps

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 
 from tpu_autoscaler.actuators.base import (
@@ -44,6 +45,7 @@ from tpu_autoscaler.obs import (
     TimeSeriesDB,
     Tracer,
 )
+from tpu_autoscaler.obs.profiler import PassProfiler
 from tpu_autoscaler.state import SliceState, SliceTracker, classify_slice
 from tpu_autoscaler.state.tracker import DRAIN_ANNOTATION
 
@@ -223,7 +225,8 @@ class Controller:
                  policy_engine=None, serving_scaler=None,
                  tsdb: TimeSeriesDB | None = None,
                  alert_engine: AlertEngine | None = None,
-                 blackbox=None):
+                 blackbox=None,
+                 profiler: PassProfiler | None = None):
         self.client = client
         self.actuator = actuator
         self.config = config or ControllerConfig()
@@ -481,6 +484,25 @@ class Controller:
             self.metrics.set_gauge(
                 f"tpu_autoscaler_alerts_active_"
                 f"{rule.name.replace('-', '_')}", 0.0)
+        # Control-plane profiler (ISSUE 20, docs/OBSERVABILITY.md
+        # "Control-plane profiling"): every pass's wall time attributed
+        # to exactly one phase, conservation-checked in the cost-ledger
+        # style; per-phase self-time series feed the phase-share-drift
+        # sentinel above.  Reconcile-thread-only (the optional stack
+        # sampler reads via sys._current_frames, never touches state);
+        # disabling degrades phase() to a cheap no-op.
+        self.profiler = (profiler if profiler is not None
+                         else PassProfiler(clock=time.perf_counter,
+                                           metrics=self.metrics))
+        if serving_scaler is not None:
+            adapter = getattr(serving_scaler, "adapter", None)
+            if adapter is not None and hasattr(adapter, "profiler"):
+                # The fold's cost nests under the serving phase even
+                # when the scaler drives it from inside advise().
+                adapter.profiler = self.profiler
+        # The sampler targets the reconcile thread, whose identity is
+        # only known once a pass runs — started lazily there.
+        self._sampler_started = False
 
     # ------------------------------------------------------------------ #
 
@@ -490,6 +512,14 @@ class Controller:
         t0 = time.perf_counter()
         self._pass_seq += 1
         self._pass_events = []
+        # Open the profiler's pass window on the SAME t0 the
+        # reconcile_seconds / duration_s measurements use, so the
+        # conservation identity talks about the same wall time.
+        self.profiler.begin_pass(t0)
+        if not self._sampler_started:
+            self._sampler_started = True
+            if self.profiler.sampler is not None:
+                self.profiler.sampler.start(threading.get_ident())
 
         # Drain the actuation executor, then poll the actuator, THEN
         # observe.  Drain first: completed dispatches (create POSTs,
@@ -499,11 +529,13 @@ class Controller:
         # have its nodes visible in this pass's observation, or the
         # planner would see neither the in-flight provision nor the new
         # supply and double-provision.
-        if self.executor is not None:
-            self.executor.drain()
-        self.actuator.poll(now)
+        with self.profiler.phase("actuate_poll"):
+            if self.executor is not None:
+                self.executor.drain()
+            self.actuator.poll(now)
         t_obs = time.perf_counter()
-        nodes, pods, pending = self._observe()
+        with self.profiler.phase("observe"):
+            nodes, pods, pending = self._observe()
         observe_s = time.perf_counter() - t_obs
         self.metrics.observe("observe_seconds", observe_s)
         # Replayed into each served gang's trace at dispatch time: a
@@ -515,11 +547,13 @@ class Controller:
         # Policy pass BEFORE latency tracking: a prediction consumed
         # this pass records its prewarm span into the gang's still-open
         # scale-up trace (the root ends in _track_gang_latency below).
-        policy_advisory = self._policy_pass(gangs, nodes, pods, now)
+        with self.profiler.phase("policy"):
+            policy_advisory = self._policy_pass(gangs, nodes, pods, now)
         # Serving signals fold AFTER policy (both are advisory; order
         # only affects log readability) — live replica-target demand
         # rides the same hook below.
-        serving_advisory = self._serving_pass(now)
+        with self.profiler.phase("serving"):
+            serving_advisory = self._serving_pass(now)
         self._track_gang_latency(gangs, pods, nodes, now)
         # Settling only delays SIZING (the _scale path); _maintain still
         # sees every pending gang so reclaim deferral protects supply a
@@ -575,15 +609,18 @@ class Controller:
         plan_gangs, plan_mode = self._plan_scope(settled_gangs, gangs,
                                                  nodes, now)
         if not self.config.no_scale:
-            self._scale(plan_gangs, nodes, pods, now,
-                        all_gangs=settled_gangs, plan_mode=plan_mode,
-                        advisory=advisory)
+            with self.profiler.phase("plan"):
+                self._scale(plan_gangs, nodes, pods, now,
+                            all_gangs=settled_gangs, plan_mode=plan_mode,
+                            advisory=advisory)
         if not self.config.no_maintenance:
             # Advisory repair gangs count as pending demand for the
             # reclaim-deferral check: an idle slice the repair will
             # hand the gang to must not be reclaimed meanwhile.
-            self._maintain(nodes, pods, now,
-                           pending_gangs=gangs + [g for g, _ in advisory])
+            with self.profiler.phase("maintain"):
+                self._maintain(
+                    nodes, pods, now,
+                    pending_gangs=gangs + [g for g, _ in advisory])
 
         # Bound long-run memory: drop bookkeeping for demands/provisions
         # that no longer exist (actuators prune terminal statuses; gangs
@@ -656,7 +693,8 @@ class Controller:
         # The _maintain loop fed the unit observations; with
         # maintenance off nothing classified, so the close (and its
         # conservation check) is suspended rather than false-alarmed.
-        cost_info = self._cost_pass(now, fleet_chips)
+        with self.profiler.phase("cost_close"):
+            cost_info = self._cost_pass(now, fleet_chips)
         # Decision record: this pass's inputs digest + per-unit reasons
         # ("why did/didn't we provision"), for `explain` / /debugz.
         # The digest is an O(n) frozenset hash — cheap enough for the
@@ -701,7 +739,20 @@ class Controller:
         # (reconcile_seconds above is part of the ingested snapshot)
         # and BEFORE the decision record, so alert transitions show up
         # in the very pass record that caused them.
-        alerts_info = self._obs_pass(now)
+        with self.profiler.phase("obs_pass"):
+            alerts_info = self._obs_pass(now)
+        # Close the profiler window LAST so every phase above is
+        # inside it; its per-phase observations therefore reach the
+        # TSDB on the NEXT pass's ingest — one pass late, like the
+        # span exemplars.  The dominant phase's exemplar names this
+        # pass record, linking a phase spike to the decision record
+        # that produced it.
+        profile_info = self.profiler.end_pass()
+        if profile_info:
+            dominant = profile_info["dominant"]
+            self._span_exemplars[f"pass_phase_seconds_{dominant}"] = (
+                f"pass-{self._pass_seq}",
+                profile_info["phases"].get(dominant, 0.0))
         record = {
             "pass": self._pass_seq,
             "t": now,
@@ -722,6 +773,16 @@ class Controller:
             # did this pass's chips sit" rides the same explain/replay
             # surfaces as every other decision (docs/COST.md).
             record["cost"] = cost_info
+        if profile_info:
+            # Where this pass's milliseconds went (ISSUE 20) — the
+            # span list stays in the profiler's own ring; the record
+            # carries the ledger the conservation oracle re-derives.
+            record["profile"] = {
+                "window_s": profile_info["window_s"],
+                "phases": profile_info["phases"],
+                "conserved": profile_info["conserved"],
+                "dominant": profile_info["dominant"],
+            }
         self.recorder.record_pass(record)
 
     def _observe(self) -> tuple[list[Node], list[Pod], list[Pod]]:
@@ -1959,6 +2020,17 @@ class Controller:
         return self.tsdb.dump(prefix=params.get("prefix", ""),
                               window_seconds=window, now=now)
 
+    def profile_route(self, params: dict | None = None) -> dict:
+        """The ``/debugz/profile`` body: cumulative + recent per-pass
+        phase ledgers, conservation state, and the sampler's collapsed
+        stacks when one is attached (docs/OBSERVABILITY.md
+        "Control-plane profiling")."""
+        del params  # no query filters yet
+        out = self.profiler.debug_state()
+        if self.profiler.sampler is not None:
+            out["collapsed"] = self.profiler.sampler.collapsed()
+        return out
+
     def incident_bundle(self, reason: str = "manual") -> dict:
         """The black-box bundle: everything ``debug_dump`` serves plus
         the TSDB windows, the alert rules + state, informer store
@@ -1992,6 +2064,24 @@ class Controller:
             self.metrics.inc("tailcause_errors")
             log.exception("tailcause analysis failed; bundle carries "
                           "no tail-report section")
+        # Control-plane profile recorded AT CAPTURE TIME (ISSUE 20):
+        # the phase ledgers + collapsed stacks, plus the windowed
+        # decomposition the offline replay recomputes from the
+        # bundle's own TSDB and compares against (exit 2 on
+        # divergence).  Crash-only like the tailcause section — a
+        # broken profiler degrades the bundle, never the capture.
+        try:
+            from tpu_autoscaler.obs import perfreport
+
+            profile = self.profiler.debug_state()
+            if self.profiler.sampler is not None:
+                profile["collapsed"] = self.profiler.sampler.collapsed()
+            profile["report"] = perfreport.decompose(out["tsdb"])
+            out["profile"] = profile
+        except Exception:  # noqa: BLE001 — diagnostics only
+            self.metrics.inc("profiler_report_errors")
+            log.exception("profile capture failed; bundle carries no "
+                          "profile section")
         out["informer"] = self._informer_digest()
         cfg = self.config
         out["config"] = {
@@ -2136,6 +2226,8 @@ class Controller:
         process."""
         if self.sharder is not None:
             self.sharder.close()
+        if self.profiler.sampler is not None:
+            self.profiler.sampler.stop()
 
     def run_forever(self, interval_seconds: float = 5.0,
                     watch: bool = True, leader_lock=None) -> None:
@@ -2368,7 +2460,8 @@ class Controller:
                     retry_at=round(self._retry_at[backoff_key], 3),
                     shape=req.shape_name)
                 continue
-            status = self._dispatch_provision(req, now)
+            with self.profiler.phase("actuate_dispatch"):
+                status = self._dispatch_provision(req, now)
             log.info("provisioning %s x%d (%s): %s", req.shape_name,
                      req.count, status.id, req.reason)
             self._note_repair_provision(req, status, now)

@@ -246,6 +246,16 @@ _common = [
                       "captured automatically when an alert fires "
                       "(unset = no automatic captures; SIGUSR1 and "
                       "/debugz still work)."),
+    click.option("--no-profile", is_flag=True,
+                 help="Disable the control-plane phase profiler "
+                      "(docs/OBSERVABILITY.md \"Control-plane "
+                      "profiling\"; on by default — off degrades "
+                      "phase timing to a no-op)."),
+    click.option("--profile-sampling-hz", default=0.0,
+                 show_default=True,
+                 help="Collapsed-stack sampling rate over the "
+                      "reconcile thread (0=off).  Stacks ride "
+                      "/debugz/profile and incident bundles."),
     click.option("--log-json", is_flag=True,
                  help="Emit structured JSON log lines."),
     click.option("-v", "--verbose", is_flag=True),
@@ -268,11 +278,20 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
            policy_min_confidence, policy_waste_budget,
            policy_early_reclaim, slack_hook,
            slack_channel, metrics_port, recorder_spans, recorder_passes,
-           no_alerts, incident_dir, log_json, verbose,
+           no_alerts, incident_dir, no_profile, profile_sampling_hz,
+           log_json, verbose,
            price_book=None, enable_repack=False,
            reconcile_shards=0) -> Controller:
+    import time as _time
+
     from tpu_autoscaler.logging_setup import setup_logging
-    from tpu_autoscaler.obs import AlertEngine, BlackBox, FlightRecorder
+    from tpu_autoscaler.obs import (
+        AlertEngine,
+        BlackBox,
+        FlightRecorder,
+        PassProfiler,
+        StackSampler,
+    )
 
     setup_logging(verbose=verbose, json_format=log_json)
     book = None
@@ -333,7 +352,17 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
         # deep rings for incident-heavy fleets, shallow for tiny ones.
         recorder=FlightRecorder(max_spans=recorder_spans,
                                 max_passes=recorder_passes),
-        alert_engine=AlertEngine(rules=()) if no_alerts else None)
+        alert_engine=AlertEngine(rules=()) if no_alerts else None,
+        # Control-plane profiler (docs/OBSERVABILITY.md "Control-plane
+        # profiling"): on by default like the alert engine; the
+        # collapsed-stack sampler is a strict opt-in (it spawns a
+        # thread).
+        profiler=PassProfiler(
+            clock=_time.perf_counter, metrics=metrics,
+            enabled=not no_profile,
+            sampler=(StackSampler(hz=profile_sampling_hz,
+                                  metrics=metrics)
+                     if profile_sampling_hz > 0 else None)))
     if incident_dir:
         # Black-box capture on alert fire (obs/blackbox.py).  Wired
         # post-ctor: the bundle producer IS a controller method.
@@ -349,7 +378,9 @@ def _build(kube, actuator, *, sleep, idle_threshold, grace_period,
                       routes={"/debugz/tsdb": controller.tsdb_route,
                               "/debugz/cost": controller.cost_route,
                               "/debugz/repack":
-                                  controller.repack_route})
+                                  controller.repack_route,
+                              "/debugz/profile":
+                                  controller.profile_route})
     return controller
 
 
@@ -980,6 +1011,58 @@ def tail_report(source, url, window, as_json):
         click.echo(_json.dumps(report, indent=2, default=str))
         return
     click.echo(tailcause.render_report(report))
+
+
+@cli.command("perf-report")
+@dump_options
+@click.option("--window", default=None, type=float,
+              help="Trailing window in seconds (default: the whole "
+                   "retained history).")
+@click.option("--against", default=None,
+              type=click.Path(exists=True, dir_okay=False),
+              help="Second bundle / SIGUSR1 dump as the BEFORE side; "
+                   "the main source is the AFTER — the diff names "
+                   "the regressing phase.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable report.")
+def perf_report(source, url, window, against, as_json):
+    """Where the control plane's milliseconds went
+    (docs/OBSERVABILITY.md "Control-plane profiling"): per-phase
+    self-time decomposition of the reconcile pass over the profiler's
+    ``pass_phase_seconds_*`` TSDB series — from a live controller's
+    ``/debugz/tsdb`` or any incident bundle / SIGUSR1 dump.  With
+    ``--against``, diffs the two windows on phase SHARES and names
+    the regressing phase — the offline twin of the
+    ``phase-share-drift`` alert rule."""
+    import json as _json
+
+    from tpu_autoscaler.obs import perfreport
+
+    _require_one_source(source, url, "an incident bundle")
+    if source:
+        raw = _read_dump_file(source)
+        dump = raw.get("tsdb", raw)
+    else:
+        dump = _fetch_debugz(url, "/debugz/tsdb",
+                             {"prefix": "pass_phase_seconds_"})
+    report = perfreport.decompose(dump, window)
+    if against:
+        raw_before = _read_dump_file(against)
+        before = perfreport.decompose(
+            raw_before.get("tsdb", raw_before), window)
+        delta = perfreport.diff(before, report)
+        if as_json:
+            click.echo(_json.dumps({"before": before, "after": report,
+                                    "diff": delta}, indent=2))
+            return
+        click.echo(perfreport.render_report(report))
+        click.echo("")
+        click.echo(perfreport.render_diff(delta))
+        return
+    if as_json:
+        click.echo(_json.dumps(report, indent=2))
+        return
+    click.echo(perfreport.render_report(report))
 
 
 @cli.command()

@@ -19,6 +19,14 @@
                  request traces (ISSUE 14): phase decomposition, TSDB
                  correlation, and the scale-up cross-link behind the
                  ``tail-report`` CLI.
+- ``profiler`` — continuous control-plane profiler (ISSUE 20): the
+                 per-pass phase-tree self-time ledger with its
+                 conservation identity, plus the optional collapsed-
+                 stack sampler; served on ``/debugz/profile``;
+- ``perfreport`` — windowed phase decomposition + two-window diff
+                 over the profiler's TSDB series — the ``perf-report``
+                 CLI's computation layer and the offline twin of the
+                 ``phase-share-drift`` sentinel.
 """
 
 from tpu_autoscaler.obs.alerts import (
@@ -27,6 +35,17 @@ from tpu_autoscaler.obs.alerts import (
     default_rules,
 )
 from tpu_autoscaler.obs.blackbox import BlackBox, load_bundle
+from tpu_autoscaler.obs.perfreport import (
+    decompose as perf_decompose,
+    diff as perf_diff,
+    render_diff as render_perf_diff,
+    render_report as render_perf_report,
+)
+from tpu_autoscaler.obs.profiler import (
+    PassProfiler,
+    StackSampler,
+    rebuild_from_events,
+)
 from tpu_autoscaler.obs.recorder import (
     FlightRecorder,
     install_sigusr1,
@@ -50,7 +69,9 @@ __all__ = [
     "AlertRule",
     "BlackBox",
     "FlightRecorder",
+    "PassProfiler",
     "Span",
+    "StackSampler",
     "TimeSeriesDB",
     "Tracer",
     "current_span",
@@ -59,6 +80,11 @@ __all__ = [
     "install_sigusr1",
     "load_bundle",
     "maybe_span",
+    "perf_decompose",
+    "perf_diff",
+    "rebuild_from_events",
+    "render_perf_diff",
+    "render_perf_report",
     "render_tail_report",
     "tail_analyze",
     "trace_gaps",

@@ -17,10 +17,17 @@ must match it — both ways (a recorded report the offline run cannot
 reproduce AND an offline report the capture never recorded are
 divergence).
 
+ISSUE 20 adds the control-plane half: the replay recomputes the
+perf-report (phase decomposition) from the bundle's TSDB and
+re-verifies the profiler's conservation identity over the bundled
+per-pass ring; divergence from the capture-time report — both ways —
+exits 2 like the tail-report.  Pre-profiler bundles degrade
+render-only.
+
 Exit codes (tests and the chaos alert gate key on them):
 
 - 0 — offline evaluation reproduces the live firing decision (and
-      the capture-time tail-report, when recorded);
+      the capture-time tail-report and perf-report, when recorded);
 - 2 — divergence (the bundle's recorded state and the offline
       re-evaluation disagree — evidence of nondeterminism or a rule
       evaluation bug);
@@ -38,9 +45,13 @@ import argparse
 import sys
 from typing import Any
 
-from tpu_autoscaler.obs import tailcause
+from tpu_autoscaler.obs import perfreport, tailcause
 from tpu_autoscaler.obs.alerts import AlertEngine
 from tpu_autoscaler.obs.blackbox import load_bundle
+from tpu_autoscaler.obs.profiler import (
+    CONSERVATION_ABS,
+    CONSERVATION_REL,
+)
 from tpu_autoscaler.obs.render import list_traces, render_passes
 from tpu_autoscaler.obs.tsdb import TimeSeriesDB
 
@@ -143,6 +154,63 @@ def replay_tailcause(bundle: dict[str, Any]) -> dict[str, Any]:
     return report
 
 
+def replay_profile(bundle: dict[str, Any]) -> dict[str, Any]:
+    """Re-run the control-plane phase decomposition offline (ISSUE 20)
+    and compare it with the report recorded at capture time, plus
+    re-verify the conservation identity over the bundled per-pass
+    ring.  Both ways: a recorded report the offline run contradicts
+    AND an offline decomposition on a bundle that recorded none are
+    divergence.  A pre-profiler bundle (no ``profile`` section, no
+    ``pass_phase_seconds_*`` series) degrades render-only: skipped,
+    reproduced."""
+    offline = perfreport.from_bundle(bundle)
+    recorded_profile = bundle.get("profile")
+    report: dict[str, Any] = {
+        "offline_dominant": offline.get("dominant"),
+        "offline": offline,
+    }
+    if not isinstance(recorded_profile, dict) \
+            or "report" not in recorded_profile:
+        # Comparable only when the offline run finds phase series —
+        # then the capture SHOULD have recorded a profile.
+        report["recorded_dominant"] = None
+        report["reproduced"] = not offline.get("phases")
+        if report["reproduced"]:
+            report["skipped"] = "bundle carries no profile section"
+        return report
+    recorded = recorded_profile.get("report") or {}
+    report["recorded_dominant"] = recorded.get("dominant")
+    # Conservation re-check from the bundle alone: every retained
+    # pass profile must still satisfy sum(self times) == window
+    # within the tolerance the profiler declared at capture.
+    conservation = recorded_profile.get("conservation") or {}
+    tol_abs = conservation.get("tolerance_abs", CONSERVATION_ABS)
+    tol_rel = conservation.get("tolerance_rel", CONSERVATION_REL)
+    ring_violations = 0
+    for p in recorded_profile.get("ring", ()):
+        window = p.get("window_s")
+        phases = p.get("phases") or {}
+        if window is None or not phases:
+            continue
+        attributed = sum(phases.values())
+        if abs(attributed - window) > tol_abs + tol_rel * abs(window):
+            ring_violations += 1
+    report["ring_violations"] = ring_violations
+    report["recorded_violations"] = conservation.get("violations", 0)
+    shares_match = True
+    names = (set(offline.get("phases", {}))
+             | set(recorded.get("phases", {})))
+    for name in names:
+        a = offline.get("phases", {}).get(name, {}).get("share", 0.0)
+        b = recorded.get("phases", {}).get(name, {}).get("share", 0.0)
+        if abs(a - b) > 1e-9:
+            shares_match = False
+    report["reproduced"] = (
+        offline.get("dominant") == recorded.get("dominant")
+        and shares_match and ring_violations == 0)
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpu_autoscaler.obs",
@@ -201,12 +269,28 @@ def main(argv: list[str] | None = None) -> int:
               f"{tail.get('recorded_dominant')}  "
               f"[{'match' if tail['reproduced'] else 'MISMATCH'}]")
 
+    # Control-plane half (ISSUE 20): re-run the perf-report offline
+    # and hold it to the capture-time decomposition + conservation.
+    prof = replay_profile(bundle)
+    if "skipped" not in prof:
+        print("\n== perf-report (offline re-run)")
+        print(perfreport.render_report(prof["offline"]))
+        print(f"recorded dominant phase: "
+              f"{prof.get('recorded_dominant')}  "
+              f"ring conservation violations: "
+              f"{prof.get('ring_violations', 0)}  "
+              f"[{'match' if prof['reproduced'] else 'MISMATCH'}]")
+
     report = replay_alerts(bundle)
     if "skipped" in report:
         print(f"\n== alerts: {report['skipped']}")
         if not tail["reproduced"]:
             print("OFFLINE TAIL-REPORT DIVERGED from the capture-time "
                   "attribution", file=sys.stderr)
+            return 2
+        if not prof["reproduced"]:
+            print("OFFLINE PERF-REPORT DIVERGED from the capture-time "
+                  "phase decomposition", file=sys.stderr)
             return 2
         return 0
     print(f"\n== alert replay: {report['passes_replayed']} passes over "
@@ -223,6 +307,10 @@ def main(argv: list[str] | None = None) -> int:
     if not tail["reproduced"]:
         print("OFFLINE TAIL-REPORT DIVERGED from the capture-time "
               "attribution", file=sys.stderr)
+        return 2
+    if not prof["reproduced"]:
+        print("OFFLINE PERF-REPORT DIVERGED from the capture-time "
+              "phase decomposition", file=sys.stderr)
         return 2
     if report["reproduced"]:
         print("offline evaluation reproduces the live firing decision")

@@ -44,9 +44,15 @@ import dataclasses
 import logging
 from typing import Any
 
+from tpu_autoscaler.obs.profiler import (
+    PHASE_METRIC_PREFIX,
+    PHASES as _PROFILE_PHASES,
+)
+
 log = logging.getLogger(__name__)
 
-_KINDS = ("burn_rate", "rate", "gauge_below", "pass_duration")
+_KINDS = ("burn_rate", "rate", "gauge_below", "pass_duration",
+          "phase_share_drift")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,10 +83,16 @@ class AlertRule:
     # rides the firing notification (ISSUE 14): the page names a
     # concrete sampled trace, not just a number ("" = none).
     exemplar_family: str = ""
+    # phase_share_drift (ISSUE 20): the reconcile phases whose SHARE
+    # of the pass the rule watches (fast window vs slow baseline);
+    # ``threshold`` is the share-point drift that breaches.
+    phases: tuple[str, ...] = ()
 
     def __post_init__(self) -> None:
         if self.kind not in _KINDS:
             raise ValueError(f"unknown alert kind {self.kind!r}")
+        # A JSON round-trip (as_dict -> from_dict) hands back a list.
+        object.__setattr__(self, "phases", tuple(self.phases))
 
     def as_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -153,6 +165,18 @@ def default_rules() -> tuple[AlertRule, ...]:
             name="shard-imbalance", metric="shard_balance",
             kind="gauge_below", window=900.0, threshold=0.25,
             for_passes=5, clear_passes=5, severity="ticket"),
+        # Control-plane phase drift (ISSUE 20, docs/OBSERVABILITY.md
+        # "Control-plane profiling"): watches per-phase SHARES of the
+        # reconcile pass (profiler self-time series), not absolutes —
+        # a busier fleet is fine, a shifted mix is a regression.  The
+        # transition summary names the drifting phase; the offline
+        # twin is `tpu-autoscaler perf-report --against`.
+        AlertRule(
+            name="phase-share-drift", metric="reconcile_seconds",
+            kind="phase_share_drift", fast_window=300.0,
+            slow_window=3600.0, threshold=0.15, min_events=5,
+            for_passes=3, clear_passes=5, severity="ticket",
+            phases=_PROFILE_PHASES),
     )
 
 
@@ -200,6 +224,10 @@ class AlertEngine:
             raise ValueError("duplicate alert rule names")
         self._state: dict[str, AlertState] = {
             r.name: AlertState() for r in self.rules}
+        # Last evaluation detail per rule (the drifting phase's name
+        # for phase_share_drift) — what _summary renders so the page
+        # says WHICH phase moved, not just that one did.
+        self._detail: dict[str, str] = {}
 
     # -- rule evaluation ----------------------------------------------
 
@@ -255,6 +283,8 @@ class AlertEngine:
                     return (False, None)
                 mean = last
             return (mean < rule.threshold, mean)
+        if rule.kind == "phase_share_drift":
+            return self._phase_drift(rule, tsdb, now)
         # pass_duration
         count = tsdb.delta(f"{rule.metric}:count", now - rule.window, now)
         total = tsdb.delta(f"{rule.metric}:sum", now - rule.window, now)
@@ -262,6 +292,50 @@ class AlertEngine:
             return (False, None)
         mean = total / count
         return (mean > rule.threshold, mean)
+
+    def _phase_shares(self, rule: AlertRule, tsdb: Any, now: float,
+                      window: float) -> dict[str, float] | None:
+        """Per-phase share of attributed self time over the window
+        (None: the window saw no phase data at all)."""
+        seconds: dict[str, float] = {}
+        for phase in rule.phases:
+            d = tsdb.delta(f"{PHASE_METRIC_PREFIX}{phase}:sum",
+                           now - window, now)
+            if d is not None and d > 0.0:
+                seconds[phase] = d
+        total = sum(seconds.values())
+        if total <= 0.0:
+            return None
+        return {p: s / total for p, s in seconds.items()}
+
+    def _phase_drift(self, rule: AlertRule, tsdb: Any,
+                     now: float) -> tuple[bool, float | None]:
+        """Multi-window share comparison: breach when any phase's
+        fast-window share exceeds its slow-window baseline by more
+        than ``threshold`` share points.  Shares, not absolutes — the
+        denominator is the same attributed total both sides, so load
+        growth cancels and only a shifted mix registers."""
+        passes = tsdb.delta(f"{PHASE_METRIC_PREFIX}other:count",
+                            now - rule.fast_window, now)
+        if passes is None or passes < rule.min_events:
+            return (False, None)
+        fast = self._phase_shares(rule, tsdb, now, rule.fast_window)
+        slow = self._phase_shares(rule, tsdb, now, rule.slow_window)
+        if fast is None or slow is None:
+            return (False, None)
+        worst, worst_phase = 0.0, None
+        for phase in rule.phases:
+            drift = fast.get(phase, 0.0) - slow.get(phase, 0.0)
+            if worst_phase is None or drift > worst:
+                worst, worst_phase = drift, phase
+        if worst_phase is None:
+            return (False, None)
+        self._detail[rule.name] = (
+            f"phase {worst_phase} share "
+            f"{fast.get(worst_phase, 0.0):.1%} vs "
+            f"{slow.get(worst_phase, 0.0):.1%} baseline "
+            f"(drift {worst:+.1%}, threshold {rule.threshold:.0%})")
+        return (worst > rule.threshold, worst)
 
     # -- the per-pass entry point -------------------------------------
 
@@ -311,8 +385,7 @@ class AlertEngine:
                                active=active,
                                evaluated=len(self.rules))
 
-    @staticmethod
-    def _summary(rule: AlertRule, value: float | None, firing: bool,
+    def _summary(self, rule: AlertRule, value: float | None, firing: bool,
                  exemplar: tuple[float, float, str] | None = None) -> str:
         what = "FIRING" if firing else "resolved"
         shown = "n/a" if value is None else f"{value:.4g}"
@@ -324,6 +397,10 @@ class AlertEngine:
             detail = f"rate={shown}/s (threshold {rule.threshold:g}/s)"
         elif rule.kind == "gauge_below":
             detail = f"avg={shown} (floor {rule.threshold:g})"
+        elif rule.kind == "phase_share_drift":
+            detail = self._detail.get(
+                rule.name,
+                f"share drift={shown} (threshold {rule.threshold:.0%})")
         else:
             detail = f"mean={shown}s (budget {rule.threshold:g}s)"
         tail = ""

@@ -1,0 +1,173 @@
+"""Windowed control-plane phase decomposition (ISSUE 20).
+
+The ``tpu-autoscaler perf-report`` CLI's computation layer — the
+tail-report for the controller's OWN latency.  One code path serves
+every source: a live ``/debugz/tsdb`` fetch, an incident bundle's
+``tsdb`` section, or a SIGUSR1 dump file all carry a
+``TimeSeriesDB.dump()`` body; :func:`decompose` rebuilds a queryable
+store from it and answers "where did the control plane's seconds go"
+over a window, and :func:`diff` names the regressing phase between
+two windows/bundles — the offline twin of the ``phase-share-drift``
+sentinel (obs/alerts.py), sharing its share math so the two can never
+disagree about what "share" means.
+
+Shares are per-phase SELF seconds over the sum of ALL phase self
+seconds in the window (the profiler's conservation identity makes
+that sum the reconcile wall-time, ``other`` included), so a fleet
+that merely got busier does not register: only a shifted *mix* does.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+from tpu_autoscaler.obs.profiler import PHASE_METRIC_PREFIX, PHASES
+from tpu_autoscaler.obs.tsdb import TimeSeriesDB
+
+
+def _phase_seconds(db: TimeSeriesDB, start: float,
+                   end: float) -> dict[str, float]:
+    """Per-phase self seconds accumulated in ``[start, end]``.
+
+    Reads the ``pass_phase_seconds_<phase>:sum`` cumulative series
+    the profiler feeds each pass; a series the window never saw
+    contributes zero.  Phases outside the declared tuple are picked
+    up too (a custom phase must not silently vanish from reports).
+    """
+    names = set(PHASES)
+    for series in db.series_names():
+        if (series.startswith(PHASE_METRIC_PREFIX)
+                and series.endswith(":sum")):
+            names.add(series[len(PHASE_METRIC_PREFIX):-len(":sum")])
+    out: dict[str, float] = {}
+    for phase in sorted(names):
+        d = db.delta(f"{PHASE_METRIC_PREFIX}{phase}:sum", start, end)
+        if d is not None and d > 0.0:
+            out[phase] = d
+    return out
+
+
+def decompose(tsdb_dump: dict[str, Any],
+              window: float | None = None) -> dict[str, Any]:
+    """Phase decomposition of a TSDB dump over its trailing window.
+
+    Returns ``{"start", "end", "seconds", "phases": {phase:
+    {"seconds", "share"}}, "dominant", "passes"}`` — shares of total
+    attributed self time, dominant = largest non-``other`` share.
+    ``window`` trims to the trailing seconds (None: whole dump).
+    """
+    db = TimeSeriesDB.from_dump(tsdb_dump)
+    end = -math.inf
+    for name in db.series_names():
+        if name.startswith(PHASE_METRIC_PREFIX):
+            ts, _ = db.points(name)
+            if len(ts):
+                end = max(end, float(ts[-1]))
+    if math.isinf(end):
+        return {"start": None, "end": None, "seconds": 0.0,
+                "phases": {}, "dominant": None, "passes": 0}
+    start = end - window if window is not None else -math.inf
+    seconds = _phase_seconds(db, start, end)
+    total = sum(seconds.values())
+    phases = {p: {"seconds": round(s, 9),
+                  "share": (s / total) if total > 0 else 0.0}
+              for p, s in sorted(seconds.items())}
+    in_pass = {p: s for p, s in seconds.items() if p != "other"}
+    dominant = max(in_pass, key=lambda p: in_pass[p]) if in_pass else None
+    passes = db.delta(f"{PHASE_METRIC_PREFIX}other:count", start, end)
+    return {
+        "start": None if math.isinf(start) else start,
+        "end": end,
+        "seconds": round(total, 9),
+        "phases": phases,
+        "dominant": dominant,
+        "passes": int(passes) if passes else 0,
+    }
+
+
+def from_bundle(bundle: dict[str, Any],
+                window: float | None = None) -> dict[str, Any]:
+    """Decompose an incident bundle's ``tsdb`` section (empty report
+    when the bundle predates the profiler — render-only degrade)."""
+    dump = bundle.get("tsdb")
+    if not isinstance(dump, dict):
+        return decompose({}, window)
+    return decompose(dump, window)
+
+
+def diff(before: dict[str, Any], after: dict[str, Any],
+         min_share_delta: float = 0.0) -> dict[str, Any]:
+    """Name the regressing phase between two decompositions.
+
+    Compares per-phase SHARES (not absolute seconds — a busier fleet
+    is not a regression, a shifted mix is).  ``regressing`` is the
+    phase with the largest share increase above ``min_share_delta``
+    (None when nothing moved that much).
+    """
+    names = sorted(set(before.get("phases", {}))
+                   | set(after.get("phases", {})))
+    deltas: dict[str, dict[str, float]] = {}
+    for name in names:
+        b = before.get("phases", {}).get(name, {})
+        a = after.get("phases", {}).get(name, {})
+        deltas[name] = {
+            "share_before": b.get("share", 0.0),
+            "share_after": a.get("share", 0.0),
+            "share_delta": a.get("share", 0.0) - b.get("share", 0.0),
+            "seconds_before": b.get("seconds", 0.0),
+            "seconds_after": a.get("seconds", 0.0),
+        }
+    regressing = None
+    worst = min_share_delta
+    for name, row in deltas.items():
+        if name != "other" and row["share_delta"] > worst:
+            worst = row["share_delta"]
+            regressing = name
+    return {"phases": deltas, "regressing": regressing,
+            "worst_share_delta": (deltas[regressing]["share_delta"]
+                                  if regressing else 0.0)}
+
+
+# -- renderers (the CLI's text layer) ---------------------------------
+
+
+def render_report(report: dict[str, Any]) -> str:
+    """Human phase-decomposition table, largest share first."""
+    lines = ["control-plane phase decomposition"]
+    if not report.get("phases"):
+        lines.append("  (no pass_phase_seconds_* series in this "
+                     "window — profiler off or pre-profiler dump)")
+        return "\n".join(lines)
+    span = ("whole dump" if report.get("start") is None
+            else f"{report['end'] - report['start']:.0f}s window")
+    lines.append(f"  window: {span}  attributed: "
+                 f"{report['seconds'] * 1e3:.1f}ms over "
+                 f"{report.get('passes', 0)} passes")
+    rows = sorted(report["phases"].items(),
+                  key=lambda kv: -kv[1]["share"])
+    for name, row in rows:
+        mark = "  <- dominant" if name == report.get("dominant") else ""
+        lines.append(f"  {name:<18} {row['share'] * 100:6.2f}%  "
+                     f"{row['seconds'] * 1e3:10.2f}ms{mark}")
+    return "\n".join(lines)
+
+
+def render_diff(delta: dict[str, Any]) -> str:
+    """Human diff table naming the regressing phase."""
+    lines = ["control-plane phase diff (share points, after - before)"]
+    if not delta.get("phases"):
+        lines.append("  (no phases on either side)")
+        return "\n".join(lines)
+    rows = sorted(delta["phases"].items(),
+                  key=lambda kv: -kv[1]["share_delta"])
+    for name, row in rows:
+        mark = ("  <- regressing"
+                if name == delta.get("regressing") else "")
+        lines.append(
+            f"  {name:<18} {row['share_before'] * 100:6.2f}% -> "
+            f"{row['share_after'] * 100:6.2f}%  "
+            f"({row['share_delta'] * 100:+6.2f}pt){mark}")
+    if delta.get("regressing") is None:
+        lines.append("  no phase regressed")
+    return "\n".join(lines)

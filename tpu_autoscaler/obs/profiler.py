@@ -1,0 +1,464 @@
+"""Continuous control-plane profiler (ISSUE 20).
+
+The reconcile loop can explain every slow request (tailcause) and
+every chip-second (the cost ledger), but not its own milliseconds.
+This module closes that gap with two pieces:
+
+- :class:`PassProfiler` — a phase-tree profiler for the reconcile
+  thread.  ``begin_pass`` / ``phase(name)`` / ``end_pass`` bracket the
+  pass; every span is recorded and each phase is charged its SELF
+  time (duration minus its direct children), the unattributed
+  remainder of the window lands in the ``other`` phase, and the
+  ledger-style conservation identity
+
+      sum(self_seconds) + other == pass window   (within tolerance)
+
+  is checked on every ``end_pass``; violations are counted, never
+  raised (crash-only observability).  The incremental self-times must
+  equal :func:`rebuild_from_events` over the recorded spans — that
+  static rebuild is the property-test oracle, exactly like the cost
+  ledger's rebuild-from-windows oracle.  Phases timed while NO pass
+  is open (the router refresh between passes) accumulate in a
+  separate out-of-pass ledger that is reported but deliberately
+  outside the conservation identity.
+
+- :class:`StackSampler` — an optional, low-rate sampling collector on
+  a crash-only ``concurrency.Thread``: it snapshots the reconcile
+  thread's stack via ``sys._current_frames`` at a few hertz and
+  counts collapsed stacks (``a;b;c 42`` — flamegraph.pl's collapsed
+  format) into a bounded table.  Sampling errors increment a counter
+  and the loop keeps going; the table never grows past ``max_stacks``
+  (overflow is counted, not stored).
+
+Purity contract (TAP, analysis/purity.py): this module never reads a
+wall clock — the caller injects a monotonic ``clock`` callable — and
+performs no I/O, so a pass profile is replayable from its recorded
+spans alone.  Thread discipline (TAT): every post-``__init__`` write
+in :class:`StackSampler` sits under its lock.
+"""
+
+from __future__ import annotations
+
+import sys
+from collections import deque
+from collections.abc import Callable, Iterator
+from contextlib import contextmanager
+from typing import Any, Protocol
+
+
+class MetricsLike(Protocol):
+    """The slice of MetricsRegistry the profiler publishes through."""
+
+    def inc(self, name: str, by: float = 1.0) -> None: ...
+
+    def observe(self, name: str, value: float) -> None: ...
+
+
+#: Phases a reconcile pass decomposes into, in pass order.  Every
+#: phase gets a ``pass_phase_seconds_<phase>`` observation on every
+#: ``end_pass`` (zero when the phase did not run) so the TSDB series
+#: exist in every mode and the share-drift sentinel's denominators
+#: never go undefined mid-window.  ``other`` is the residual: window
+#: time outside every explicit phase (gang grouping, pruning, record
+#: assembly).  ``router_refresh`` is out-of-pass by construction (the
+#: chaos/serving harness refreshes between passes) but keeps a series
+#: here for the same reason.
+PHASES: tuple[str, ...] = (
+    "actuate_poll",
+    "observe",
+    "policy",
+    "serving",
+    "adapter_fold",
+    "plan",
+    "actuate_dispatch",
+    "maintain",
+    "cost_close",
+    "obs_pass",
+    "router_refresh",
+    "other",
+)
+
+#: Conservation tolerance: float summation order differs between the
+#: incremental ledger and the window arithmetic, so the identity holds
+#: to rounding, not exactly.  abs + rel * window, ledger-style.
+CONSERVATION_ABS = 1e-9
+CONSERVATION_REL = 1e-6
+
+#: Default bound on the ring of retained per-pass profiles.
+RING_PASSES = 256
+
+#: Metric family for per-phase self time (one summary per phase).
+PHASE_METRIC_PREFIX = "pass_phase_seconds_"
+
+
+def rebuild_from_events(
+        events: list[tuple[str, float, float, int]]) -> dict[str, float]:
+    """Recompute per-phase SELF seconds from a recorded span list.
+
+    ``events`` rows are ``(name, start, end, parent_index)`` with
+    ``parent_index == -1`` for top-level spans.  This is the static
+    oracle for the incremental ledger: charge each span its duration,
+    then refund that duration to its parent.  Property tests assert
+    the incremental per-pass ``self_seconds`` (minus ``other``) equal
+    this rebuild for arbitrary seeded phase trees.
+    """
+    self_times: dict[str, float] = {}
+    for name, start, end, parent in events:
+        dur = end - start
+        self_times[name] = self_times.get(name, 0.0) + dur
+        if 0 <= parent < len(events):
+            pname = events[parent][0]
+            self_times[pname] = self_times.get(pname, 0.0) - dur
+    return self_times
+
+
+class PassProfiler:
+    """Phase-tree self-time ledger for the reconcile thread.
+
+    Single-writer: ``begin_pass`` / ``phase`` / ``end_pass`` are only
+    ever called from the reconcile thread (``phase`` additionally from
+    whichever thread drives the router refresh between passes — by
+    contract the same one).  Readers (``debug_state`` from the bundle
+    thread) take bounded-retry copies, FlightRecorder-style.
+    """
+
+    def __init__(self, clock: Callable[[], float],
+                 metrics: MetricsLike | None = None,
+                 enabled: bool = True,
+                 tolerance_abs: float = CONSERVATION_ABS,
+                 tolerance_rel: float = CONSERVATION_REL,
+                 ring_passes: int = RING_PASSES,
+                 sampler: "StackSampler | None" = None) -> None:
+        self._clock = clock
+        self._metrics = metrics
+        self.enabled = enabled
+        self._tol_abs = tolerance_abs
+        self._tol_rel = tolerance_rel
+        # Open pass state.  _events rows are [name, start, end, parent]
+        # (end filled on pop); _stack holds (event_index, child_total).
+        self._pass_open = False
+        self._pass_start = 0.0
+        self._pass_seq = 0
+        self._events: list[list[Any]] = []
+        self._stack: list[list[Any]] = []
+        self._self_seconds: dict[str, float] = {}
+        # Cross-pass ledgers.
+        self._cumulative: dict[str, float] = {}
+        self._out_of_pass: dict[str, float] = {}
+        self._pending_out_of_pass: dict[str, float] = {}
+        self._ring: deque[dict[str, Any]] = deque(maxlen=ring_passes)
+        self.passes_total = 0
+        self.conservation_violations = 0
+        self.forced_closes = 0
+        self.last_conservation: tuple[float, float] | None = None
+        self.sampler = sampler
+
+    # -- pass bracketing ------------------------------------------------
+
+    def begin_pass(self, t0: float) -> None:
+        """Open a pass window at ``t0`` (the caller's perf-clock read).
+
+        A still-open previous pass (an exception unwound past
+        ``end_pass``) is force-closed first and counted as a FORCED
+        close, not a conservation violation — an abandoned pass never
+        ran the arithmetic, so it cannot have failed it (and chaos
+        brownouts crash passes by design; its invariant asserts the
+        violation counter stays zero across the run).
+        """
+        if not self.enabled:
+            return
+        if self._pass_open:
+            self.forced_closes += 1
+            if self._metrics is not None:
+                self._metrics.inc("profiler_forced_closes")
+            self._close_pass(t0, record=False)
+        self._pass_open = True
+        self._pass_start = t0
+        self._pass_seq += 1
+        self._events = []
+        self._stack = []
+        self._self_seconds = {}
+
+    @contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        """Time a phase; nests freely; cheap no-op when disabled.
+
+        Outside a pass window the span lands in the out-of-pass
+        ledger instead of the pass tree.
+        """
+        if not self.enabled:
+            yield
+            return
+        start = self._clock()
+        parent = self._stack[-1][0] if self._stack else -1
+        idx = len(self._events)
+        events = self._events
+        events.append([name, start, start, parent])
+        self._stack.append([idx, 0.0])
+        try:
+            yield
+        finally:
+            # A force-close underneath this span swapped the events
+            # list out (crash-only recovery already charged its
+            # window); dropping the orphan beats popping a stack entry
+            # that now belongs to a DIFFERENT pass's tree.
+            if self._events is events:
+                self._pop(self._clock())
+
+    def _pop(self, end: float) -> None:
+        idx, child_total = self._stack.pop()
+        ev = self._events[idx]
+        ev[2] = end
+        dur = end - ev[1]
+        self_time = dur - child_total
+        if self._stack:
+            self._stack[-1][1] += dur
+        if self._pass_open:
+            name = str(ev[0])
+            self._self_seconds[name] = (
+                self._self_seconds.get(name, 0.0) + self_time)
+        else:
+            # Out-of-pass span (router refresh between passes): charge
+            # the ledger that end_pass flushes into the NEXT pass's
+            # metric observations; the current tree is discarded once
+            # the outermost out-of-pass span closes.
+            name = str(ev[0])
+            self._pending_out_of_pass[name] = (
+                self._pending_out_of_pass.get(name, 0.0) + self_time)
+            if not self._stack:
+                self._events = []
+
+    def end_pass(self) -> dict[str, Any]:
+        """Close the pass: conservation check, metrics, ring append.
+
+        Returns the per-pass profile dict for the pass record
+        (``phases`` self-seconds including ``other``, the conservation
+        verdict, and the dominant phase for the exemplar link).
+        """
+        if not self.enabled or not self._pass_open:
+            return {}
+        return self._close_pass(self._clock(), record=True)
+
+    def _close_pass(self, t_end: float, record: bool) -> dict[str, Any]:
+        # Force-close any spans an exception left open so the tree is
+        # well-formed; their tails count toward the enclosing phase.
+        while self._stack:
+            self._pop(t_end)
+        window = t_end - self._pass_start
+        top_total = sum(ev[2] - ev[1] for ev in self._events
+                        if ev[3] == -1)
+        other = window - top_total
+        phases = dict(self._self_seconds)
+        phases["other"] = other
+        attributed = sum(phases.values())
+        tol = self._tol_abs + self._tol_rel * abs(window)
+        violated = abs(attributed - window) > tol
+        self.last_conservation = (attributed, window)
+        self.passes_total += 1
+        if violated:
+            self.conservation_violations += 1
+            if self._metrics is not None:
+                self._metrics.inc("profiler_conservation_violations")
+        for name, secs in phases.items():
+            self._cumulative[name] = (
+                self._cumulative.get(name, 0.0) + secs)
+        out_of_pass = self._pending_out_of_pass
+        self._pending_out_of_pass = {}
+        for name, secs in out_of_pass.items():
+            self._out_of_pass[name] = (
+                self._out_of_pass.get(name, 0.0) + secs)
+        if self._metrics is not None:
+            # Observe EVERY declared phase every pass (zeros included)
+            # so the series stay defined in every mode; out-of-pass
+            # self time rides the same families, one pass late.
+            for name in PHASES:
+                value = phases.get(name, 0.0) + out_of_pass.get(name, 0.0)
+                self._metrics.observe(f"pass_phase_seconds_{name}", value)
+            for name in phases:
+                if name not in PHASES:
+                    self._metrics.observe(
+                        f"pass_phase_seconds_{name}", phases[name])
+        in_pass = {k: v for k, v in phases.items() if k != "other"}
+        dominant = max(in_pass, key=lambda k: in_pass[k],
+                       default="other") if in_pass else "other"
+        info: dict[str, Any] = {
+            "pass": self._pass_seq,
+            "start": self._pass_start,
+            "window_s": window,
+            "phases": {k: round(v, 9) for k, v in phases.items()},
+            "attributed_s": attributed,
+            "conserved": not violated,
+            "dominant": dominant,
+            "events": [(str(e[0]), float(e[1]), float(e[2]), int(e[3]))
+                       for e in self._events],
+        }
+        if out_of_pass:
+            info["out_of_pass"] = {k: round(v, 9)
+                                   for k, v in out_of_pass.items()}
+        self._pass_open = False
+        self._events = []
+        self._self_seconds = {}
+        if record:
+            self._ring.append(info)
+        return info
+
+    # -- read side ------------------------------------------------------
+
+    def ring(self) -> list[dict[str, Any]]:
+        """The retained per-pass profiles, oldest first (bounded)."""
+        return list(self._ring)
+
+    @property
+    def ring_limit(self) -> int:
+        """The ring's declared bound (chaos re-asserts it per step)."""
+        return self._ring.maxlen or 0
+
+    def debug_state(self) -> dict[str, Any]:
+        """Snapshot for ``/debugz/profile`` and incident bundles.
+
+        May be called from the bundle-capture thread while the
+        reconcile thread mutates the ledgers: bounded-retry copies,
+        FlightRecorder-style — a contended snapshot degrades to
+        ``{"unavailable": "mutating"}``, never blocks the pass.
+        """
+        for _ in range(5):
+            try:
+                state: dict[str, Any] = {
+                    "enabled": self.enabled,
+                    "passes_total": self.passes_total,
+                    "phases": dict(self._cumulative),
+                    "out_of_pass": dict(self._out_of_pass),
+                    "conservation": {
+                        "violations": self.conservation_violations,
+                        "forced_closes": self.forced_closes,
+                        "last": self.last_conservation,
+                        "tolerance_abs": self._tol_abs,
+                        "tolerance_rel": self._tol_rel,
+                    },
+                    "ring": [dict(p) for p in self._ring],
+                }
+                break
+            except RuntimeError:  # dict/deque mutated under us
+                continue
+        else:
+            return {"unavailable": "mutating"}
+        if self.sampler is not None:
+            state["sampler"] = self.sampler.debug_state()
+        return state
+
+
+class StackSampler:
+    """Low-rate collapsed-stack sampler on a crash-only thread.
+
+    ``start(thread_id)`` spawns a daemon ``concurrency.Thread`` that
+    snapshots the target thread's stack ``hz`` times a second and
+    counts collapsed stacks into a bounded table; ``collapsed()``
+    renders flamegraph.pl's collapsed format.  A sampling error is
+    counted and the loop keeps going; once ``max_stacks`` distinct
+    stacks are held, new ones are dropped (counted), never stored.
+    """
+
+    def __init__(self, hz: float = 2.0, max_stacks: int = 512,
+                 metrics: MetricsLike | None = None,
+                 max_depth: int = 64) -> None:
+        from tpu_autoscaler import concurrency
+        self._hz = max(hz, 0.1)
+        self._max_stacks = max_stacks
+        self._max_depth = max_depth
+        self._metrics = metrics
+        self._lock = concurrency.Lock()
+        self._stop = concurrency.Event()
+        self._thread: Any = None
+        self._target: int | None = None
+        self._counts: dict[str, int] = {}
+        self.samples_total = 0
+        self.dropped_total = 0
+        self.errors_total = 0
+
+    def start(self, thread_id: int) -> None:
+        """Begin sampling ``thread_id``; idempotent."""
+        from tpu_autoscaler import concurrency
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._target = thread_id
+            thread = concurrency.Thread(
+                target=self._run, name="profiler-sampler", daemon=True)
+            self._thread = thread
+        thread.start()
+
+    def stop(self) -> None:
+        """Stop and join the sampler thread (bounded wait)."""
+        self._stop.set()
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+        if thread is not None:
+            thread.join(timeout=2.0)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None
+
+    def _run(self) -> None:
+        interval = 1.0 / self._hz
+        while not self._stop.wait(interval):
+            try:
+                self._sample()
+            except Exception:
+                with self._lock:
+                    self.errors_total += 1
+                if self._metrics is not None:
+                    self._metrics.inc("profiler_sampler_errors")
+
+    def _sample(self) -> None:
+        with self._lock:
+            target = self._target
+        if target is None:
+            return
+        frame = sys._current_frames().get(target)
+        parts: list[str] = []
+        depth = 0
+        while frame is not None and depth < self._max_depth:
+            code = frame.f_code
+            parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                         f"{code.co_name}")
+            frame = frame.f_back
+            depth += 1
+        if not parts:
+            return
+        key = ";".join(reversed(parts))  # root first, leaf last
+        dropped = False
+        with self._lock:
+            self.samples_total += 1
+            if key in self._counts or len(self._counts) < self._max_stacks:
+                self._counts[key] = self._counts.get(key, 0) + 1
+            else:
+                self.dropped_total += 1
+                dropped = True
+        if self._metrics is not None:
+            self._metrics.inc("profiler_stack_samples")
+            if dropped:
+                self._metrics.inc("profiler_stacks_dropped")
+
+    # -- read side ------------------------------------------------------
+
+    def collapsed(self) -> list[str]:
+        """Flamegraph-format lines (``stack;frames count``), sorted by
+        count descending then stack, bounded by ``max_stacks``."""
+        with self._lock:
+            items = list(self._counts.items())
+        items.sort(key=lambda kv: (-kv[1], kv[0]))
+        return [f"{stack} {count}" for stack, count in items]
+
+    def debug_state(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "hz": self._hz,
+                "running": self._thread is not None,
+                "samples_total": self.samples_total,
+                "dropped_total": self.dropped_total,
+                "errors_total": self.errors_total,
+                "distinct_stacks": len(self._counts),
+                "max_stacks": self._max_stacks,
+            }

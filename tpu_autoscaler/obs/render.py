@@ -84,13 +84,36 @@ def render_trace(dump: dict[str, Any], trace_id: str) -> str:
 
     lines = [f"trace {trace_id}"]
 
+    def self_time(s: dict[str, Any]) -> float | None:
+        """Self time = duration minus the children's durations (ISSUE
+        20 satellite): the tree answers "where did the time go"
+        without the profiler attached.  None while the span (or any
+        child) is still open — a partial subtraction would lie."""
+        dur = s.get("duration_s")
+        if dur is None:
+            return None
+        child_total = 0.0
+        for child in by_parent.get(s["span_id"], ()):
+            child_dur = child.get("duration_s")
+            if child_dur is None:
+                return None
+            child_total += child_dur
+        return max(0.0, dur - child_total)
+
     def walk(parent: str | None, depth: int) -> None:
         for s in by_parent.get(parent, ()):
             events = (f"  ({len(s['events'])} events)"
                       if s.get("events") else "")
+            # The self column only renders where it differs from the
+            # duration (the span has closed children) — leaf rows
+            # would just repeat the duration.
+            self_s = self_time(s)
+            own = ""
+            if self_s is not None and by_parent.get(s["span_id"]):
+                own = f"  self={_fmt_duration(self_s)}"
             lines.append(
                 f"{'  ' * depth}{'└─ ' if depth else ''}{s['name']}"
-                f"  {_fmt_duration(s.get('duration_s'))}"
+                f"  {_fmt_duration(s.get('duration_s'))}{own}"
                 f"  @{s['start']:g}"
                 f"{_fmt_attrs(s.get('attrs', {}))}{events}")
             walk(s["span_id"], depth + 1)

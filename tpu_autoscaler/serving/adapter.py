@@ -227,6 +227,10 @@ class ServingMetricsAdapter:
         # it).  Trace ids are strings and live beside the rows.
         self._exemplar_seq: list[int] = [0] * cap
         self._pending_exemplars: dict[str, tuple[str, float]] = {}
+        # Control-plane profiler hook (ISSUE 20): bound by the
+        # Controller so fold cost nests under the serving phase even
+        # when the scaler drives the fold from inside advise().
+        self.profiler: Any = None
 
     # -- metrics ----------------------------------------------------------
 
@@ -396,6 +400,13 @@ class ServingMetricsAdapter:
     def fold(self, now: float) -> int:
         """Fold pending churn into the pool sums — one vectorized pass
         over the dirty rows, O(churn).  Returns rows folded."""
+        prof = self.profiler
+        if prof is not None:
+            with prof.phase("adapter_fold"):
+                return self._fold_impl(now)
+        return self._fold_impl(now)
+
+    def _fold_impl(self, now: float) -> int:
         n = len(self._dirty)
         if n:
             idx = np.fromiter(self._dirty, np.int64, len(self._dirty))

@@ -168,6 +168,12 @@ class RouterCore:
         self.hedges_total = 0
         self.migrated_total = 0
         self.refreshes_total = 0
+        # Control-plane profiler hook (ISSUE 20): when bound by the
+        # owning loop, refresh cost lands in the ``router_refresh``
+        # phase ledger (out-of-pass when driven between reconcile
+        # passes).  Injected like the clock — the router itself never
+        # measures time.
+        self.profiler: Any = None
 
     # -- metrics ------------------------------------------------------
 
@@ -202,6 +208,14 @@ class RouterCore:
         ``now`` is the injected clock (purity: the router never reads
         wall time); 0.0 disables the drain credit.  ``pool`` restricts
         dispatch to one pool's rows (None = whole fleet)."""
+        prof = self.profiler
+        if prof is not None:
+            with prof.phase("router_refresh"):
+                self._refresh_impl(now, pool)
+            return
+        self._refresh_impl(now, pool)
+
+    def _refresh_impl(self, now: float, pool: str | None) -> None:
         cap = self._adapter.capacity()
         if cap != self._delta.shape[0]:
             self._delta = np.zeros(cap)
